@@ -1,0 +1,35 @@
+"""dynamo_tpu — TPU-native distributed LLM inference framework.
+
+A ground-up, TPU-first re-design of the capabilities of NVIDIA Dynamo
+(reference surveyed in SURVEY.md): an OpenAI-compatible frontend, a
+KV-cache-aware smart router, disaggregated prefill/decode serving, a
+multi-tier KV block manager, request migration / fault tolerance, an
+SLA-driven planner, and — unlike the reference, which wraps external CUDA
+engines — a native JAX/XLA/Pallas serving engine with paged attention,
+continuous batching, and pjit mesh sharding (DP/TP/EP/SP) over ICI.
+
+Layer map (mirrors reference layers L0–L8, SURVEY.md §1):
+  runtime/   — distributed runtime: component model, discovery, request
+               plane (TCP/msgpack), event plane (ZMQ), metrics
+               (analog of lib/runtime, Rust, in the reference)
+  tokens/    — token-block hashing contract (analog of lib/tokens +
+               lib/kv-hashing)
+  router/    — KV-aware routing: radix indexer, cost-based selection,
+               active sequences, event publishing (analog of
+               lib/kv-router + lib/llm/src/kv_router)
+  frontend/  — OpenAI-compatible HTTP frontend, preprocessor,
+               detokenizer/stop handling, migration (analog of lib/llm)
+  engine/    — native JAX serving engine: paged KV cache, continuous
+               batching scheduler, bucketed jit step functions
+               (the reference delegates this to vLLM/SGLang/TRT-LLM)
+  models/    — TPU-native model definitions (Llama family first)
+  ops/       — Pallas TPU kernels: ragged paged attention, flash
+               attention, block copy/permute, ring attention
+  parallel/  — device mesh + sharding specs (dp/tp/ep/sp axes)
+  kvbm/      — multi-tier KV block manager: G1 HBM / G2 host / G3 disk
+  mocker/    — simulated engine with a TPU step-time model (CI without
+               TPUs; analog of lib/mocker)
+  planner/   — SLA autoscaler control loop (analog of dynamo.planner)
+"""
+
+__version__ = "0.1.0"

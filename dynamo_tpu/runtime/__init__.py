@@ -1,0 +1,38 @@
+"""Distributed runtime core (analog of reference lib/runtime, Rust).
+
+Provides the DistributedRuntime handle, the Namespace→Component→Endpoint
+addressing model, pluggable discovery, the TCP/msgpack request plane, the
+ZMQ event plane, streaming engines with cancellation, and metrics.
+"""
+
+from dynamo_tpu.runtime.context import Context, CancellationError
+from dynamo_tpu.runtime.engine import AsyncEngine, EngineStream
+from dynamo_tpu.runtime.component import (
+    Instance,
+    EndpointAddress,
+    TransportKind,
+)
+from dynamo_tpu.runtime.discovery import (
+    DiscoveryBackend,
+    MemDiscovery,
+    FileDiscovery,
+    DiscoveryEvent,
+    make_discovery,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+__all__ = [
+    "Context",
+    "CancellationError",
+    "AsyncEngine",
+    "EngineStream",
+    "Instance",
+    "EndpointAddress",
+    "TransportKind",
+    "DiscoveryBackend",
+    "MemDiscovery",
+    "FileDiscovery",
+    "DiscoveryEvent",
+    "make_discovery",
+    "DistributedRuntime",
+]

@@ -1,0 +1,268 @@
+"""DistributedRuntime — top-level runtime handle.
+
+Analog of reference lib/runtime/src/distributed.rs:46-180: owns the
+discovery client, the request-plane server (one TCP listener hosting all
+endpoints served by this process), the event plane, and the metrics root.
+Offers the Namespace→Component→Endpoint builder used by workers
+(`endpoint.serve(engine)`) and clients (`endpoint.client()`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.runtime.component import (
+    EndpointAddress,
+    Instance,
+    TransportKind,
+    new_instance_id,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import DiscoveryBackend, make_discovery
+from dynamo_tpu.runtime.engine import AsyncEngine, as_engine
+from dynamo_tpu.runtime.event_plane import (
+    EventPublisher,
+    EventSubscriber,
+    make_publisher,
+    make_subscriber,
+)
+from dynamo_tpu.runtime.metrics import make_metrics
+from dynamo_tpu.runtime.request_plane import PushEndpoint, PushRouter, RouterMode
+
+log = logging.getLogger("dynamo_tpu.runtime")
+
+
+class DistributedRuntime:
+    def __init__(
+        self,
+        discovery: Optional[DiscoveryBackend] = None,
+        discovery_backend: Optional[str] = None,
+        event_transport: Optional[str] = None,
+        host: Optional[str] = None,
+        **discovery_kw,
+    ):
+        self.discovery = discovery or make_discovery(discovery_backend, **discovery_kw)
+        self.event_transport = event_transport or os.environ.get("DYN_EVENT_PLANE", "zmq")
+        self.host = host or os.environ.get("DYN_TCP_HOST", "127.0.0.1")
+        self.metrics = make_metrics()
+        self.server = PushEndpoint(host=self.host)
+        self._server_started = False
+        self._served: List[Instance] = []
+        self._event_publisher: Optional[EventPublisher] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self.root_context = Context(request_id="runtime")
+
+    # -- builders ---------------------------------------------------------
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+    def endpoint(self, path: str) -> "Endpoint":
+        addr = EndpointAddress.parse(path)
+        return Namespace(self, addr.namespace).component(addr.component).endpoint(addr.endpoint)
+
+    # -- event plane ------------------------------------------------------
+    def event_publisher(self) -> EventPublisher:
+        """Lazily create this process's PUB socket; address is advertised in
+        instance metadata (event-plane.md brokerless topology)."""
+        if self._event_publisher is None:
+            self._event_publisher = make_publisher(self.event_transport)
+        return self._event_publisher
+
+    def event_subscriber(self, subjects: Optional[List[str]] = None) -> EventSubscriber:
+        return make_subscriber(self.event_transport, subjects)
+
+    # -- serving ----------------------------------------------------------
+    async def _ensure_server(self) -> None:
+        if not self._server_started:
+            await self.server.start()
+            self._server_started = True
+        if self._hb_task is None:
+            self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._closed:
+            try:
+                await self.discovery.heartbeat()
+            except Exception:  # pragma: no cover
+                log.exception("discovery heartbeat failed")
+            await asyncio.sleep(2.0)
+
+    async def serve_endpoint(
+        self,
+        path: str,
+        handler: Any,
+        metadata: Optional[Dict[str, Any]] = None,
+        instance_id: Optional[int] = None,
+    ) -> Instance:
+        """Serve `handler` (AsyncEngine or async fn) at `ns/comp/ep`,
+        registering an Instance in discovery (reference
+        Endpoint.serve_endpoint, bindings _core.pyi:150)."""
+        await self._ensure_server()
+        engine = as_engine(handler)
+        addr = EndpointAddress.parse(path)
+        self.server.add_endpoint(path, engine)
+        inst = Instance(
+            namespace=addr.namespace,
+            component=addr.component,
+            endpoint=addr.endpoint,
+            instance_id=instance_id if instance_id is not None else new_instance_id(),
+            transport=TransportKind.TCP,
+            address=self.server.address,
+            metadata=metadata or {},
+        )
+        await self.discovery.register(inst)
+        self._served.append(inst)
+        log.info("serving %s as instance %x at %s", path, inst.instance_id, inst.address)
+        return inst
+
+    async def update_instance_metadata(self, inst: Instance, metadata: Dict[str, Any]) -> None:
+        inst.metadata.update(metadata)
+        await self.discovery.register(inst)
+
+    # -- clients ----------------------------------------------------------
+    def client(self, path: str, mode: str = RouterMode.ROUND_ROBIN) -> "EndpointClient":
+        return EndpointClient(self, path, mode)
+
+    # -- shutdown ---------------------------------------------------------
+    async def shutdown(self, drain_timeout: float = 30.0) -> None:
+        self._closed = True
+        self.root_context.kill()
+        for inst in self._served:
+            try:
+                await self.discovery.unregister(inst)
+            except Exception:  # pragma: no cover
+                pass
+        self._served.clear()
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        if self._server_started:
+            await self.server.stop(drain_timeout)
+        if self._event_publisher is not None:
+            await self._event_publisher.close()
+        await self.discovery.close()
+
+
+class Namespace:
+    def __init__(self, runtime: DistributedRuntime, name: str):
+        self.runtime = runtime
+        self.name = name
+        self.metrics = runtime.metrics.child(dynamo_namespace=name)
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+
+class Component:
+    def __init__(self, namespace: Namespace, name: str):
+        self.namespace = namespace
+        self.name = name
+        self.metrics = namespace.metrics.child(dynamo_component=name)
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        self.component = component
+        self.name = name
+        self.metrics = component.metrics.child(dynamo_endpoint=name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.component.namespace.name}/{self.component.name}/{self.name}"
+
+    @property
+    def runtime(self) -> DistributedRuntime:
+        return self.component.namespace.runtime
+
+    async def serve(
+        self,
+        handler: Any,
+        metadata: Optional[Dict[str, Any]] = None,
+        instance_id: Optional[int] = None,
+    ) -> Instance:
+        return await self.runtime.serve_endpoint(
+            self.path, handler, metadata=metadata, instance_id=instance_id
+        )
+
+    def client(self, mode: str = RouterMode.ROUND_ROBIN) -> "EndpointClient":
+        return self.runtime.client(self.path, mode)
+
+
+class EndpointClient:
+    """Client handle for one endpoint: watches discovery, keeps the
+    PushRouter's instance set current, exposes generate()/direct().
+
+    Mirrors the reference Client (lib/runtime/src/component/client.rs):
+    instance set shrinks on lease expiry / unregister, grows on discovery.
+    """
+
+    def __init__(self, runtime: DistributedRuntime, path: str, mode: str = RouterMode.ROUND_ROBIN):
+        self.runtime = runtime
+        self.path = path
+        addr = EndpointAddress.parse(path)
+        self._prefix = f"services/{addr.namespace}/{addr.component}/{addr.endpoint}/"
+        self.router = PushRouter(path, mode)
+        self._watch_task: Optional[asyncio.Task] = None
+        self._ready = asyncio.Event()
+        self.instances: Dict[int, Instance] = {}
+
+    async def start(self) -> "EndpointClient":
+        if self._watch_task is None:
+            self._watch_task = asyncio.create_task(self._watch())
+        return self
+
+    async def _watch(self) -> None:
+        try:
+            async for ev in self.runtime.discovery.watch(self._prefix):
+                inst = ev.instance
+                if ev.kind == "put":
+                    self.instances[inst.instance_id] = inst
+                    self.router.update_instance(inst.instance_id, inst.address)
+                    self._ready.set()
+                else:
+                    self.instances.pop(inst.instance_id, None)
+                    self.router.update_instance(inst.instance_id, None)
+        except asyncio.CancelledError:
+            pass
+
+    async def wait_ready(self, timeout: float = 10.0) -> None:
+        await self.start()
+        await asyncio.wait_for(self._ready.wait(), timeout)
+
+    async def generate(self, request: Any, context: Optional[Context] = None):
+        """Push to an instance chosen by the router mode; async iterator of
+        response items."""
+        context = context or Context()
+        async for item in self.router.generate(request, context):
+            yield item
+
+    async def direct(self, request: Any, instance_id: int, context: Optional[Context] = None):
+        """Push to a specific instance (reference RouterMode::Direct)."""
+        context = context or Context()
+        engine = self.router.engine_for(instance_id)
+        async for item in engine.generate(request, context):
+            yield item
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+        self.router.close()
+
+
+def get_host_ip() -> str:  # pragma: no cover
+    """Best-effort routable IP for cross-host deployments."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()

@@ -1,0 +1,215 @@
+"""Event plane: pub/sub for KV events, load metrics (FPM), sequence sync.
+
+Analog of reference lib/runtime/src/transports/event_plane/ with the same
+default topology (docs/design-docs/event-plane.md:21-60): **brokerless ZMQ**
+— each publisher binds a PUB socket and advertises its address via
+discovery; subscribers watch discovery and connect SUB sockets to every
+live publisher. An in-proc transport backs single-process tests.
+
+Wire format: two ZMQ frames [subject: utf-8][payload: msgpack].
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Set, Tuple
+
+import msgpack
+
+try:
+    import zmq
+    import zmq.asyncio
+
+    _HAVE_ZMQ = True
+except ImportError:  # pragma: no cover
+    _HAVE_ZMQ = False
+
+log = logging.getLogger("dynamo_tpu.event_plane")
+
+# well-known subjects (reference lib/kv-router/src/protocols.rs KV_EVENT_SUBJECT)
+KV_EVENT_SUBJECT = "kv_events"
+FPM_SUBJECT = "fpm"
+SEQ_SYNC_SUBJECT = "seq_sync"
+
+
+class EventPublisher:
+    """Publish (subject, payload) events. Implementations: Zmq, InProc."""
+
+    @property
+    def address(self) -> str:
+        raise NotImplementedError
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class EventSubscriber:
+    """Subscribe to subjects across a dynamic set of publisher addresses
+    (the reference's dynamic_subscriber.rs: publisher set tracks discovery)."""
+
+    def connect(self, address: str) -> None:
+        raise NotImplementedError
+
+    def disconnect(self, address: str) -> None:
+        raise NotImplementedError
+
+    async def events(self) -> AsyncIterator[Tuple[str, Any]]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    async def close(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------------------
+# ZMQ transport (default, brokerless)
+# --------------------------------------------------------------------------
+
+
+class ZmqEventPublisher(EventPublisher):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        if not _HAVE_ZMQ:  # pragma: no cover
+            raise RuntimeError("pyzmq not available")
+        self._ctx = zmq.asyncio.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.setsockopt(zmq.SNDHWM, 100_000)
+        if port == 0:
+            port = self._sock.bind_to_random_port(f"tcp://{host}")
+        else:
+            self._sock.bind(f"tcp://{host}:{port}")
+        self._address = f"tcp://{host}:{port}"
+
+    @property
+    def address(self) -> str:
+        return self._address
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        await self._sock.send_multipart(
+            [subject.encode(), msgpack.packb(payload, use_bin_type=True)]
+        )
+
+    async def close(self) -> None:
+        self._sock.close(0)
+
+
+class ZmqEventSubscriber(EventSubscriber):
+    def __init__(self, subjects: Optional[List[str]] = None):
+        if not _HAVE_ZMQ:  # pragma: no cover
+            raise RuntimeError("pyzmq not available")
+        self._ctx = zmq.asyncio.Context.instance()
+        self._sock = self._ctx.socket(zmq.SUB)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.setsockopt(zmq.RCVHWM, 100_000)
+        for s in subjects or [""]:
+            self._sock.setsockopt(zmq.SUBSCRIBE, s.encode())
+        self._connected: Set[str] = set()
+
+    def connect(self, address: str) -> None:
+        if address not in self._connected:
+            self._sock.connect(address)
+            self._connected.add(address)
+
+    def disconnect(self, address: str) -> None:
+        if address in self._connected:
+            try:
+                self._sock.disconnect(address)
+            except zmq.ZMQError:
+                pass
+            self._connected.discard(address)
+
+    async def events(self) -> AsyncIterator[Tuple[str, Any]]:
+        while True:
+            subject, payload = await self._sock.recv_multipart()
+            yield subject.decode(), msgpack.unpackb(payload, raw=False)
+
+    async def close(self) -> None:
+        self._sock.close(0)
+
+
+# --------------------------------------------------------------------------
+# In-proc transport (tests; analog of reference `mem` transports)
+# --------------------------------------------------------------------------
+
+
+class _InProcBus:
+    """Process-wide registry of inproc publishers keyed by address."""
+
+    buses: Dict[str, "_InProcBus"] = {}
+    _next_id = 0
+
+    def __init__(self):
+        self.subscribers: List[Tuple[Optional[Set[str]], asyncio.Queue]] = []
+
+    @classmethod
+    def create(cls) -> Tuple[str, "_InProcBus"]:
+        cls._next_id += 1
+        addr = f"inproc://bus-{cls._next_id}"
+        bus = cls()
+        cls.buses[addr] = bus
+        return addr, bus
+
+    @classmethod
+    def reset(cls) -> None:
+        cls.buses.clear()
+
+
+class InProcEventPublisher(EventPublisher):
+    def __init__(self):
+        self._address, self._bus = _InProcBus.create()
+
+    @property
+    def address(self) -> str:
+        return self._address
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        payload = msgpack.unpackb(msgpack.packb(payload, use_bin_type=True), raw=False)
+        for subjects, q in self._bus.subscribers:
+            if subjects is None or any(subject.startswith(s) for s in subjects):
+                q.put_nowait((subject, payload))
+
+    async def close(self) -> None:
+        _InProcBus.buses.pop(self._address, None)
+
+
+class InProcEventSubscriber(EventSubscriber):
+    def __init__(self, subjects: Optional[List[str]] = None):
+        self._subjects: Optional[Set[str]] = set(subjects) if subjects else None
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._connected: Set[str] = set()
+
+    def connect(self, address: str) -> None:
+        bus = _InProcBus.buses.get(address)
+        if bus is not None and address not in self._connected:
+            bus.subscribers.append((self._subjects, self._queue))
+            self._connected.add(address)
+
+    def disconnect(self, address: str) -> None:
+        bus = _InProcBus.buses.get(address)
+        if bus is not None:
+            bus.subscribers = [(s, q) for s, q in bus.subscribers if q is not self._queue]
+        self._connected.discard(address)
+
+    async def events(self) -> AsyncIterator[Tuple[str, Any]]:
+        while True:
+            yield await self._queue.get()
+
+
+def make_publisher(transport: str = "zmq") -> EventPublisher:
+    if transport == "zmq":
+        return ZmqEventPublisher()
+    if transport == "inproc":
+        return InProcEventPublisher()
+    raise ValueError(f"unknown event transport {transport!r}")
+
+
+def make_subscriber(transport: str = "zmq", subjects: Optional[List[str]] = None) -> EventSubscriber:
+    if transport == "zmq":
+        return ZmqEventSubscriber(subjects)
+    if transport == "inproc":
+        return InProcEventSubscriber(subjects)
+    raise ValueError(f"unknown event transport {transport!r}")

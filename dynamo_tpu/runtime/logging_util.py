@@ -1,0 +1,68 @@
+"""Logging setup (analog of reference lib/runtime/src/logging.rs).
+
+Env-driven like the reference's DYN_LOG: `DYN_LOG=debug` or per-module
+filters `DYN_LOG=info,dynamo_tpu.router=debug`; `DYN_LOG_JSONL=1` switches
+to JSON-lines records (one object per line) for log shippers. OTLP export is
+out of scope in this environment (no collector); the JSONL format carries
+the same fields.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+_CONFIGURED = False
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        for k in ("request_id", "component", "endpoint"):
+            v = getattr(record, k, None)
+            if v is not None:
+                out[k] = v
+        return json.dumps(out)
+
+
+def configure_logging(default_level: str = "info") -> None:
+    """Idempotent; call from every entrypoint."""
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    _CONFIGURED = True
+
+    spec = os.environ.get("DYN_LOG", default_level)
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    root_level = "info"
+    module_levels = {}
+    for p in parts:
+        if "=" in p:
+            mod, lvl = p.split("=", 1)
+            module_levels[mod] = lvl
+        else:
+            root_level = p
+
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("DYN_LOG_JSONL", "").lower() in ("1", "true", "on", "yes"):
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S")
+        )
+    root = logging.getLogger()
+    root.addHandler(handler)
+    root.setLevel(root_level.upper())
+    for mod, lvl in module_levels.items():
+        logging.getLogger(mod).setLevel(lvl.upper())

@@ -1,0 +1,112 @@
+"""Prometheus metrics with auto-injected hierarchy labels.
+
+Analog of the reference MetricsHierarchy (lib/runtime/src/distributed.rs:93-109):
+metrics created through a runtime/component/endpoint handle automatically
+carry dynamo_namespace / dynamo_component / dynamo_endpoint labels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    _HAVE_PROM = True
+except ImportError:  # pragma: no cover
+    _HAVE_PROM = False
+
+PREFIX = "dynamo_"
+HIERARCHY_LABELS = ("dynamo_namespace", "dynamo_component", "dynamo_endpoint")
+
+
+class MetricsHierarchy:
+    """A node in the namespace/component/endpoint label hierarchy."""
+
+    def __init__(
+        self,
+        registry: Optional["CollectorRegistry"] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        self.registry = registry if registry is not None else (CollectorRegistry() if _HAVE_PROM else None)
+        self.labels = {k: "" for k in HIERARCHY_LABELS}
+        self.labels.update(labels or {})
+        self._metrics: Dict[str, object] = {}
+
+    def child(self, **labels: str) -> "MetricsHierarchy":
+        merged = dict(self.labels)
+        merged.update(labels)
+        node = MetricsHierarchy(registry=self.registry, labels=merged)
+        node._metrics = self._metrics  # family cache is shared
+        return node
+
+    def _family(self, cls, name: str, doc: str, extra_labels: Iterable[str] = ()):
+        key = f"{cls.__name__}:{name}"
+        fam = self._metrics.get(key)
+        if fam is None:
+            fam = cls(
+                PREFIX + name,
+                doc,
+                list(HIERARCHY_LABELS) + list(extra_labels),
+                registry=self.registry,
+            )
+            self._metrics[key] = fam
+        return fam
+
+    def counter(self, name: str, doc: str = "", **extra: str):
+        fam = self._family(Counter, name, doc, extra.keys())
+        return fam.labels(**self.labels, **extra)
+
+    def gauge(self, name: str, doc: str = "", **extra: str):
+        fam = self._family(Gauge, name, doc, extra.keys())
+        return fam.labels(**self.labels, **extra)
+
+    def histogram(self, name: str, doc: str = "", **extra: str):
+        fam = self._family(Histogram, name, doc, extra.keys())
+        return fam.labels(**self.labels, **extra)
+
+    def render(self) -> bytes:
+        """Prometheus exposition format (served at /metrics)."""
+        if not _HAVE_PROM or self.registry is None:  # pragma: no cover
+            return b""
+        return generate_latest(self.registry)
+
+
+class NullMetrics:
+    """No-op stand-in when prometheus_client is unavailable."""  # pragma: no cover
+
+    def child(self, **labels):
+        return self
+
+    def _noop(self, *a, **k):
+        class _N:
+            def inc(self, *a, **k):
+                pass
+
+            def dec(self, *a, **k):
+                pass
+
+            def set(self, *a, **k):
+                pass
+
+            def observe(self, *a, **k):
+                pass
+
+        return _N()
+
+    counter = gauge = histogram = _noop
+
+    def render(self) -> bytes:
+        return b""
+
+
+def make_metrics(namespace: str = "") -> MetricsHierarchy:
+    if _HAVE_PROM:
+        return MetricsHierarchy(labels={"dynamo_namespace": namespace})
+    return NullMetrics()  # pragma: no cover

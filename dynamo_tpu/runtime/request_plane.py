@@ -1,0 +1,391 @@
+"""TCP/msgpack request plane (analog of reference
+lib/runtime/src/pipeline/network/: PushEndpoint ingress, PushRouter egress,
+two-part msgpack codec, connection pooling).
+
+Frames are length-prefixed msgpack maps:
+  client→server: {"t":"req","id",...,"endpoint","headers","payload"}
+                 {"t":"cancel","id"}       (graceful stop_generating)
+                 {"t":"kill","id"}         (hard kill)
+  server→client: {"t":"item","id","data"} ...  {"t":"done","id"}
+                 {"t":"err","id","msg","code"}
+
+One in-flight request per pooled connection (the reference pools TCP
+connections similarly; multiplexing is an optimization for a later round).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import struct
+from typing import Any, AsyncIterator, Dict, Optional, Tuple
+
+import msgpack
+
+from dynamo_tpu.runtime.context import CancellationError, Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+log = logging.getLogger("dynamo_tpu.request_plane")
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+class RequestPlaneError(Exception):
+    """Transport-level failure; carries a code used by migration
+    classification (reference migration.rs:60-68)."""
+
+    def __init__(self, msg: str, code: str = "internal"):
+        super().__init__(msg)
+        self.code = code
+
+
+async def _send_frame(writer: asyncio.StreamWriter, obj: Dict[str, Any]) -> None:
+    body = msgpack.packb(obj, use_bin_type=True)
+    writer.write(_LEN.pack(len(body)) + body)
+    await writer.drain()
+
+
+async def _recv_frame(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
+    try:
+        hdr = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise RequestPlaneError(f"frame too large: {n}", code="protocol")
+    try:
+        body = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return msgpack.unpackb(body, raw=False)
+
+
+class PushEndpoint:
+    """Server side: serves one AsyncEngine per endpoint path on a TCP port
+    (reference ingress/push_endpoint.rs:21,36). One server instance can host
+    many endpoints (the reference's NetworkManager role)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._engines: Dict[str, AsyncEngine] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._active: Dict[str, Context] = {}
+        self._conns: set = set()  # open connection writers (for shutdown)
+        self._draining = False
+
+    def add_endpoint(self, path: str, engine: AsyncEngine) -> None:
+        self._engines[path] = engine
+
+    def remove_endpoint(self, path: str) -> None:
+        self._engines.pop(path, None)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def active_requests(self) -> int:
+        return len(self._active)
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.address
+
+    async def stop(self, drain_timeout: float = 30.0) -> None:
+        """Graceful shutdown: refuse new requests, wait for in-flight to
+        drain, then kill stragglers (reference graceful_shutdown.rs)."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+        deadline = asyncio.get_event_loop().time() + drain_timeout
+        while self._active and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        for ctx in list(self._active.values()):
+            ctx.kill()
+        # close lingering (e.g. idle pooled) connections, else wait_closed()
+        # blocks on parked connection handlers (py>=3.12.1 semantics)
+        for w in list(self._conns):
+            w.close()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        """Single reader loop per connection: `req` frames spawn response
+        tasks; `cancel`/`kill` frames route to the matching in-flight context
+        (avoids two tasks racing on one reader)."""
+        conn_ctxs: Dict[str, Context] = {}
+        tasks: set = set()
+        wlock = asyncio.Lock()
+        self._conns.add(writer)
+        try:
+            while True:
+                frame = await _recv_frame(reader)
+                if frame is None:
+                    return
+                t = frame.get("t")
+                if t == "req":
+                    task = asyncio.create_task(
+                        self._handle_request(frame, writer, wlock, conn_ctxs)
+                    )
+                    tasks.add(task)
+                    task.add_done_callback(tasks.discard)
+                elif t == "cancel":
+                    ctx = conn_ctxs.get(frame.get("id"))
+                    if ctx is not None:
+                        ctx.stop_generating()
+                elif t == "kill":
+                    ctx = conn_ctxs.get(frame.get("id"))
+                    if ctx is not None:
+                        ctx.kill()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            for ctx in conn_ctxs.values():
+                ctx.kill()  # client went away
+            for task in tasks:
+                task.cancel()
+            writer.close()
+
+    async def _handle_request(
+        self,
+        frame: Dict[str, Any],
+        writer: asyncio.StreamWriter,
+        wlock: asyncio.Lock,
+        conn_ctxs: Dict[str, Context],
+    ) -> None:
+        rid = frame["id"]
+        path = frame["endpoint"]
+
+        async def send(obj: Dict[str, Any]) -> None:
+            async with wlock:
+                await _send_frame(writer, obj)
+
+        engine = self._engines.get(path)
+        if engine is None or self._draining:
+            code = "draining" if self._draining else "no_endpoint"
+            await send({"t": "err", "id": rid, "msg": f"{code}: {path}", "code": code})
+            return
+        ctx = Context.from_headers(frame.get("headers") or {})
+        self._active[rid] = ctx
+        conn_ctxs[rid] = ctx
+        try:
+            async for item in engine.generate(frame.get("payload"), ctx):
+                if ctx.is_killed:
+                    raise CancellationError(rid)
+                await send({"t": "item", "id": rid, "data": item})
+            await send({"t": "done", "id": rid})
+        except CancellationError:
+            try:
+                await send({"t": "err", "id": rid, "msg": "killed", "code": "cancelled"})
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        except (ConnectionResetError, BrokenPipeError):
+            ctx.kill()
+        except Exception as e:  # engine fault → error frame
+            log.exception("engine error on %s", path)
+            try:
+                await send({"t": "err", "id": rid, "msg": str(e), "code": "engine"})
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        finally:
+            self._active.pop(rid, None)
+            conn_ctxs.pop(rid, None)
+
+
+class _ConnPool:
+    """Per-address pool of idle TCP connections."""
+
+    def __init__(self, max_idle: int = 8, connect_timeout: float = 5.0):
+        self._idle: Dict[str, list] = {}
+        self.max_idle = max_idle
+        self.connect_timeout = connect_timeout
+
+    async def acquire(
+        self, address: str, fresh: bool = False
+    ) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter, bool]:
+        """Returns (reader, writer, pooled). `fresh=True` bypasses the pool
+        (used to retry after a pooled connection turned out stale)."""
+        pool = self._idle.get(address)
+        while pool and not fresh:
+            reader, writer = pool.pop()
+            if not writer.is_closing():
+                return reader, writer, True
+        host, port = address.rsplit(":", 1)
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), self.connect_timeout
+            )
+            return reader, writer, False
+        except (OSError, asyncio.TimeoutError) as e:
+            raise RequestPlaneError(f"cannot connect to {address}: {e}", code="cannot_connect")
+
+    def release(self, address: str, conn: Tuple[asyncio.StreamReader, asyncio.StreamWriter]) -> None:
+        reader, writer = conn
+        pool = self._idle.setdefault(address, [])
+        if writer.is_closing() or len(pool) >= self.max_idle:
+            writer.close()
+        else:
+            pool.append(conn)
+
+    def close(self) -> None:
+        for pool in self._idle.values():
+            for _, writer in pool:
+                writer.close()
+        self._idle.clear()
+
+
+class RemoteEngine:
+    """Client side: an AsyncEngine whose generate() pushes the request to a
+    remote instance over TCP and yields the streamed response items."""
+
+    def __init__(self, pool: _ConnPool, address: str, endpoint_path: str):
+        self._pool = pool
+        self.address = address
+        self.endpoint_path = endpoint_path
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        """Stream the remote response. If a *pooled* connection turns out
+        stale (server restarted since it was pooled) and nothing has been
+        yielded yet, retry once on a fresh connection."""
+        reader, writer, pooled = await self._pool.acquire(self.address)
+        yielded = False
+        while True:
+            try:
+                async for item in self._stream_once(reader, writer, request, context):
+                    yielded = True
+                    yield item
+                return
+            except RequestPlaneError as e:
+                if pooled and not yielded and e.code == "disconnected":
+                    reader, writer, pooled = await self._pool.acquire(self.address, fresh=True)
+                    continue
+                raise
+
+    async def _stream_once(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        request: Any,
+        context: Context,
+    ) -> AsyncIterator[Any]:
+        clean = False
+        canceller: Optional[asyncio.Task] = None
+        try:
+            await _send_frame(
+                writer,
+                {
+                    "t": "req",
+                    "id": context.id,
+                    "endpoint": self.endpoint_path,
+                    "headers": context.to_headers(),
+                    "payload": request,
+                },
+            )
+            # propagate stop/kill to the server even while blocked on recv
+            async def _forward_cancel():
+                await context.wait_stopped()
+                try:
+                    kind = "kill" if context.is_killed else "cancel"
+                    await _send_frame(writer, {"t": kind, "id": context.id})
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass
+
+            canceller = asyncio.create_task(_forward_cancel())
+            while True:
+                frame = await _recv_frame(reader)
+                if frame is None:
+                    raise RequestPlaneError(
+                        f"disconnected from {self.address}", code="disconnected"
+                    )
+                t = frame.get("t")
+                if t == "item":
+                    yield frame["data"]
+                elif t == "done":
+                    clean = True
+                    return
+                elif t == "err":
+                    code = frame.get("code", "engine")
+                    if code in ("draining", "no_endpoint", "cancelled"):
+                        clean = True
+                    raise RequestPlaneError(frame.get("msg", "remote error"), code=code)
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise RequestPlaneError(f"connection lost to {self.address}: {e}", code="disconnected")
+        finally:
+            if canceller is not None:
+                canceller.cancel()
+            # a connection mid-stream is poisoned; only clean completions are pooled
+            if clean:
+                self._pool.release(self.address, (reader, writer))
+            else:
+                writer.close()
+
+
+class RouterMode:
+    ROUND_ROBIN = "round_robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+    KV = "kv"  # handled one level up by KvPushRouter
+
+
+class PushRouter:
+    """Client-side fan-out over the live instance set of an endpoint
+    (reference egress/push_router.rs:184-194). Instance set is maintained by
+    a discovery watch; routing modes: round_robin / random / direct."""
+
+    def __init__(self, endpoint_path: str, mode: str = RouterMode.ROUND_ROBIN):
+        self.endpoint_path = endpoint_path
+        self.mode = mode
+        self._pool = _ConnPool()
+        self._instances: Dict[int, str] = {}  # instance_id -> address
+        self._rr = 0
+
+    def update_instance(self, instance_id: int, address: Optional[str]) -> None:
+        if address is None:
+            self._instances.pop(instance_id, None)
+        else:
+            self._instances[instance_id] = address
+
+    @property
+    def instance_ids(self) -> list:
+        return list(self._instances)
+
+    def _pick(self, instance_id: Optional[int] = None) -> Tuple[int, str]:
+        if not self._instances:
+            raise RequestPlaneError(
+                f"no instances for {self.endpoint_path}", code="no_instances"
+            )
+        if instance_id is not None:
+            addr = self._instances.get(instance_id)
+            if addr is None:
+                raise RequestPlaneError(
+                    f"instance {instance_id:x} not found", code="cannot_connect"
+                )
+            return instance_id, addr
+        if self.mode == RouterMode.DIRECT:
+            raise RequestPlaneError(
+                "direct routing mode requires a target instance_id", code="no_target"
+            )
+        ids = sorted(self._instances)
+        if self.mode == RouterMode.RANDOM:
+            iid = random.choice(ids)
+        else:  # round robin default
+            iid = ids[self._rr % len(ids)]
+            self._rr += 1
+        return iid, self._instances[iid]
+
+    def engine_for(self, instance_id: Optional[int] = None) -> RemoteEngine:
+        _, addr = self._pick(instance_id)
+        return RemoteEngine(self._pool, addr, self.endpoint_path)
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        engine = self.engine_for(context.metadata.get("target_instance"))
+        async for item in engine.generate(request, context):
+            yield item
+
+    def close(self) -> None:
+        self._pool.close()

@@ -1,0 +1,46 @@
+"""Test config.
+
+- Forces JAX onto a virtual 8-device CPU mesh (the reference's mocker-style
+  GPU-free CI, SURVEY.md §4) before jax initializes.
+- Runs `async def` tests via asyncio.run (no pytest-asyncio in this env).
+- Resets in-process discovery/event-bus state between tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+
+        async def _run():
+            return await fn(**kwargs)
+
+        asyncio.run(_run())
+        return True
+    return None
+
+
+@pytest.fixture(autouse=True)
+def _reset_inproc_state():
+    yield
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.event_plane import _InProcBus
+
+    MemDiscovery.reset()
+    _InProcBus.reset()

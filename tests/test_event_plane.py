@@ -1,0 +1,68 @@
+"""Event plane tests: ZMQ brokerless pub/sub and in-proc transport
+(reference docs/design-docs/event-plane.md semantics)."""
+
+import asyncio
+
+from dynamo_tpu.runtime.event_plane import (
+    KV_EVENT_SUBJECT,
+    make_publisher,
+    make_subscriber,
+)
+
+
+async def _pubsub_roundtrip(transport):
+    pub = make_publisher(transport)
+    sub = make_subscriber(transport, subjects=[KV_EVENT_SUBJECT])
+    sub.connect(pub.address)
+    if transport == "zmq":
+        await asyncio.sleep(0.2)  # PUB/SUB join is async
+
+    got = []
+
+    async def reader():
+        async for subject, payload in sub.events():
+            got.append((subject, payload))
+            if len(got) == 2:
+                return
+
+    task = asyncio.create_task(reader())
+    await asyncio.sleep(0.05)
+    await pub.publish(KV_EVENT_SUBJECT, {"event_id": 1, "blocks": [1, 2]})
+    await pub.publish("other_subject", {"ignored": True})
+    await pub.publish(KV_EVENT_SUBJECT, {"event_id": 2, "blocks": [3]})
+    await asyncio.wait_for(task, 3)
+
+    assert [p["event_id"] for _, p in got] == [1, 2]
+    await sub.close()
+    await pub.close()
+
+
+async def test_inproc_pubsub():
+    await _pubsub_roundtrip("inproc")
+
+
+async def test_zmq_pubsub():
+    await _pubsub_roundtrip("zmq")
+
+
+async def test_subscriber_joins_multiple_publishers():
+    pub1 = make_publisher("inproc")
+    pub2 = make_publisher("inproc")
+    sub = make_subscriber("inproc", subjects=[KV_EVENT_SUBJECT])
+    sub.connect(pub1.address)
+    sub.connect(pub2.address)
+
+    got = []
+
+    async def reader():
+        async for _, payload in sub.events():
+            got.append(payload["src"])
+            if len(got) == 2:
+                return
+
+    task = asyncio.create_task(reader())
+    await asyncio.sleep(0.02)
+    await pub1.publish(KV_EVENT_SUBJECT, {"src": 1})
+    await pub2.publish(KV_EVENT_SUBJECT, {"src": 2})
+    await asyncio.wait_for(task, 2)
+    assert sorted(got) == [1, 2]

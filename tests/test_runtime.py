@@ -1,0 +1,250 @@
+"""Runtime core tests: context lifecycle, discovery backends, request plane
+e2e with the echo engine (mirrors reference lib/runtime/tests/{pipeline,
+lifecycle,bidirectional_e2e}.rs test areas)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.component import Instance, TransportKind
+from dynamo_tpu.runtime.context import CancellationError, Context
+from dynamo_tpu.runtime.discovery import FileDiscovery, MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import EchoEngine, as_engine
+from dynamo_tpu.runtime.request_plane import RequestPlaneError, RouterMode
+
+
+# -- context ----------------------------------------------------------------
+
+
+def test_context_stop_and_kill_propagate_to_children():
+    parent = Context()
+    child = parent.child()
+    assert not child.is_stopped
+    parent.stop_generating()
+    assert child.is_stopped and not child.is_killed
+    parent.kill()
+    assert child.is_killed
+    with pytest.raises(CancellationError):
+        child.raise_if_killed()
+
+
+def test_context_headers_roundtrip():
+    ctx = Context(metadata={"trace": "abc"})
+    again = Context.from_headers(ctx.to_headers())
+    assert again.id == ctx.id
+    assert again.metadata == {"trace": "abc"}
+
+
+# -- discovery --------------------------------------------------------------
+
+
+def _inst(iid=1, ep="generate"):
+    return Instance(
+        namespace="ns", component="worker", endpoint=ep,
+        instance_id=iid, transport=TransportKind.TCP, address="127.0.0.1:1",
+    )
+
+
+async def test_mem_discovery_register_list_watch():
+    d = MemDiscovery(realm="t1")
+    await d.register(_inst(1))
+    seen = []
+
+    async def watcher():
+        async for ev in d.watch("services/ns/worker/generate/"):
+            seen.append((ev.kind, ev.instance.instance_id))
+            if len(seen) == 3:
+                return
+
+    task = asyncio.create_task(watcher())
+    await asyncio.sleep(0.05)
+    await d.register(_inst(2))
+    await d.unregister(_inst(1))
+    await asyncio.wait_for(task, 2)
+    assert seen == [("put", 1), ("put", 2), ("delete", 1)]
+    assert {i.instance_id for i in await d.list_instances()} == {2}
+
+
+async def test_file_discovery_roundtrip_and_lease_expiry(tmp_path):
+    d = FileDiscovery(str(tmp_path), lease_ttl=0.3, poll_interval=0.05)
+    await d.register(_inst(7))
+    assert [i.instance_id for i in await d.list_instances()] == [7]
+    # no heartbeat → lease expires
+    await asyncio.sleep(0.4)
+    assert await d.list_instances() == []
+    # heartbeat refreshes the lease
+    await d.register(_inst(7))
+    for _ in range(4):
+        await asyncio.sleep(0.1)
+        await d.heartbeat()
+    assert [i.instance_id for i in await d.list_instances()] == [7]
+
+
+# -- request plane e2e ------------------------------------------------------
+
+
+async def _mk_worker(realm="e2e", iid=None):
+    rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    inst = await rt.serve_endpoint("ns/worker/generate", EchoEngine(), instance_id=iid)
+    return rt, inst
+
+
+async def test_echo_engine_over_tcp():
+    wrt, _ = await _mk_worker()
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="e2e"), event_transport="inproc")
+    client = crt.client("ns/worker/generate")
+    await client.wait_ready()
+    out = []
+    async for item in client.generate({"token_ids": [1, 2, 3]}):
+        out.append(item["token_ids"][0])
+    assert out == [1, 2, 3]
+    await client.close()
+    await crt.shutdown()
+    await wrt.shutdown(drain_timeout=1)
+
+
+async def test_direct_routing_and_round_robin():
+    class TagEngine:
+        def __init__(self, tag):
+            self.tag = tag
+
+        async def generate(self, request, context):
+            yield {"tag": self.tag}
+
+    rt1 = DistributedRuntime(discovery=MemDiscovery(realm="rr"), event_transport="inproc")
+    rt2 = DistributedRuntime(discovery=MemDiscovery(realm="rr"), event_transport="inproc")
+    i1 = await rt1.serve_endpoint("ns/w/gen", TagEngine("a"), instance_id=11)
+    i2 = await rt2.serve_endpoint("ns/w/gen", TagEngine("b"), instance_id=22)
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="rr"), event_transport="inproc")
+    client = crt.client("ns/w/gen", RouterMode.ROUND_ROBIN)
+    await client.wait_ready()
+    while len(client.instances) < 2:
+        await asyncio.sleep(0.01)
+
+    tags = set()
+    for _ in range(4):
+        async for item in client.generate({}):
+            tags.add(item["tag"])
+    assert tags == {"a", "b"}  # round robin hits both
+
+    direct = [item async for item in client.direct({}, 22)]
+    assert direct == [{"tag": "b"}]
+
+    await client.close()
+    for rt in (crt, rt1, rt2):
+        await rt.shutdown(drain_timeout=1)
+
+
+async def test_slow_stream_cancellation():
+    class SlowEngine:
+        async def generate(self, request, context):
+            for i in range(1000):
+                if context.is_stopped:
+                    return
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="c"), event_transport="inproc")
+    await rt.serve_endpoint("ns/w/gen", SlowEngine())
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="c"), event_transport="inproc")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+
+    ctx = Context()
+    got = []
+    async for item in client.generate({}, ctx):
+        got.append(item["i"])
+        if len(got) == 3:
+            ctx.stop_generating()
+    assert 3 <= len(got) < 20  # stopped long before 1000
+    await client.close()
+    await crt.shutdown()
+    await rt.shutdown(drain_timeout=1)
+
+
+async def test_engine_error_propagates():
+    class BadEngine:
+        async def generate(self, request, context):
+            yield {"ok": 1}
+            raise ValueError("boom")
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="err"), event_transport="inproc")
+    await rt.serve_endpoint("ns/w/gen", BadEngine())
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="err"), event_transport="inproc")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+    items = []
+    with pytest.raises(RequestPlaneError) as ei:
+        async for item in client.generate({}):
+            items.append(item)
+    assert items == [{"ok": 1}]
+    assert ei.value.code == "engine"
+    await client.close()
+    await crt.shutdown()
+    await rt.shutdown(drain_timeout=1)
+
+
+async def test_draining_rejects_new_requests():
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="d"), event_transport="inproc")
+    await rt.serve_endpoint("ns/w/gen", EchoEngine())
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="d"), event_transport="inproc")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+    rt.server._draining = True
+    with pytest.raises(RequestPlaneError) as ei:
+        async for _ in client.generate({"token_ids": [1]}):
+            pass
+    assert ei.value.code == "draining"
+    await client.close()
+    await crt.shutdown()
+    rt.server._draining = False
+    await rt.shutdown(drain_timeout=1)
+
+
+async def test_as_engine_coercions():
+    async def gen_fn(request, context):
+        yield request + 1
+
+    async def unary_fn(request, context):
+        return request * 2
+
+    ctx = Context()
+    assert [x async for x in as_engine(gen_fn).generate(1, ctx)] == [2]
+    assert [x async for x in as_engine(unary_fn).generate(3, ctx)] == [6]
+
+
+async def test_shutdown_with_idle_pooled_connection_does_not_hang():
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="sd"), event_transport="inproc")
+    await rt.serve_endpoint("ns/w/gen", EchoEngine())
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="sd"), event_transport="inproc")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+    async for _ in client.generate({"token_ids": [1]}):
+        pass
+    # connection now idle in the client pool; shutdown must still return
+    await asyncio.wait_for(rt.shutdown(drain_timeout=0.5), 5)
+    await client.close()
+    await crt.shutdown()
+
+
+async def test_stale_pooled_connection_retries_on_fresh_socket():
+    rt1 = DistributedRuntime(discovery=MemDiscovery(realm="st"), event_transport="inproc")
+    await rt1.serve_endpoint("ns/w/gen", EchoEngine(), instance_id=5)
+    addr = rt1.server.address
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="st"), event_transport="inproc")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+    async for _ in client.generate({"token_ids": [1]}):
+        pass
+    # restart the server on the same port (pooled conn goes stale)
+    host, port = addr.rsplit(":", 1)
+    await rt1.server.stop(drain_timeout=0.2)
+    rt2 = DistributedRuntime(discovery=MemDiscovery(realm="st"), event_transport="inproc")
+    rt2.server.port = int(port)
+    await rt2.serve_endpoint("ns/w/gen", EchoEngine(), instance_id=5)
+    out = [i async for i in client.generate({"token_ids": [9]})]
+    assert out == [{"token_ids": [9]}]
+    await client.close()
+    await crt.shutdown()
+    await rt2.shutdown(drain_timeout=1)
