@@ -1,0 +1,2 @@
+"""OpenAI-compatible frontend (analog of reference lib/llm: HTTP service,
+preprocessor, detokenizer/stop backend, migration, model discovery)."""

@@ -1,0 +1,64 @@
+"""`python -m dynamo_tpu.frontend` — OpenAI-compatible HTTP frontend.
+
+Analog of reference `python -m dynamo.frontend`
+(components/src/dynamo/frontend/main.py): discovers workers, builds the
+serving pipeline per model, serves HTTP.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging_util import configure_logging
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.frontend")
+    p.add_argument("--http-host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument(
+        "--router-mode",
+        default="round_robin",
+        choices=["round_robin", "random", "kv"],
+        help="worker selection policy (kv = KV-cache-aware)",
+    )
+    p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
+    p.add_argument("--discovery-root", default=None, help="file backend root dir")
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    configure_logging()
+    kw = {}
+    if args.discovery_root:
+        kw["root"] = args.discovery_root
+    runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        runtime, manager, router_mode=args.router_mode, migration_limit=args.migration_limit
+    )
+    svc = HttpService(runtime, manager, watcher, host=args.http_host, port=args.http_port)
+    await svc.start()
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await svc.stop()
+        await runtime.shutdown()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(async_main(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

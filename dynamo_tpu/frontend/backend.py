@@ -1,0 +1,137 @@
+"""Backend pipeline operator: incremental detokenization + stop conditions.
+
+Analog of reference lib/llm/src/backend.rs (837 LoC): sits between the
+preprocessor and the router, converting the engine's token-id stream into
+text deltas and enforcing stop strings / stop ids / max_tokens — including
+the "hold back a partially-matched stop string" behavior so stop text never
+leaks to the client.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from dynamo_tpu.frontend.tokenizer import IncrementalDetokenizer, Tokenizer
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+
+def _longest_partial_suffix(text: str, stops: List[str]) -> int:
+    """Length of the longest suffix of `text` that is a proper prefix of any
+    stop string (that much text must be held back)."""
+    best = 0
+    for s in stops:
+        for k in range(min(len(s) - 1, len(text)), 0, -1):
+            if text.endswith(s[:k]):
+                best = max(best, k)
+                break
+    return best
+
+
+class StopChecker:
+    """Tracks generated tokens/text and decides when and how to stop."""
+
+    def __init__(self, stop: Dict[str, Any]):
+        self.max_tokens = int(stop.get("max_tokens", 512))
+        self.stop_strings = list(stop.get("stop_strings") or [])
+        self.stop_ids = set(stop.get("stop_ids") or [])
+        self.min_tokens = int(stop.get("min_tokens", 0))
+        self.ignore_eos = bool(stop.get("ignore_eos", False))
+        self.n_tokens = 0
+
+    def check_tokens(self, token_ids: List[int]) -> tuple:
+        """Returns (finish_reason | None, tokens_to_emit): on a stop id the
+        stop token is dropped; on max_tokens the item is truncated."""
+        for i, t in enumerate(token_ids):
+            self.n_tokens += 1
+            if (
+                not self.ignore_eos
+                and t in self.stop_ids
+                and self.n_tokens > self.min_tokens
+            ):
+                return "stop", token_ids[:i]
+            if self.n_tokens >= self.max_tokens:
+                return "length", token_ids[: i + 1]
+        return None, token_ids
+
+    def find_stop_string(self, text: str) -> int:
+        """Index in `text` where a stop string starts, or -1."""
+        best = -1
+        for s in self.stop_strings:
+            i = text.find(s)
+            if i >= 0 and (best < 0 or i < best):
+                best = i
+        return best
+
+
+class BackendOperator:
+    """Engine wrapper: downstream yields {"token_ids", "finish_reason", ...};
+    we yield {"text", "token_ids", "finish_reason"} with stops enforced."""
+
+    def __init__(self, tokenizer: Tokenizer, downstream: AsyncEngine):
+        self.tokenizer = tokenizer
+        self.downstream = downstream
+
+    async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        detok = IncrementalDetokenizer(self.tokenizer)
+        checker = StopChecker(request.get("stop") or {})
+        pending = ""  # text held back due to partial stop-string match
+
+        async for item in self.downstream.generate(request, context):
+            token_ids = item.get("token_ids") or []
+            finish = item.get("finish_reason")
+
+            token_stop, emit_ids = checker.check_tokens(token_ids)
+            delta = detok.push(emit_ids)
+            pending += delta
+
+            if checker.stop_strings:
+                cut = checker.find_stop_string(pending)
+                if cut >= 0:
+                    yield {
+                        "text": pending[:cut],
+                        "token_ids": emit_ids,
+                        "finish_reason": "stop",
+                        **_passthrough(item),
+                    }
+                    context.stop_generating()
+                    return
+                hold = _longest_partial_suffix(pending, checker.stop_strings)
+            else:
+                hold = 0
+
+            emit_now = pending[: len(pending) - hold] if hold else pending
+            pending = pending[len(pending) - hold :] if hold else ""
+
+            finish = token_stop or finish
+            if finish:
+                tail = emit_now + (detok.finish() if token_stop is None else "")
+                yield {
+                    "text": tail if token_stop is None else emit_now,
+                    "token_ids": emit_ids,
+                    "finish_reason": finish,
+                    **_passthrough(item),
+                }
+                if finish in ("stop", "length"):
+                    context.stop_generating()
+                return
+
+            if emit_now or token_ids:
+                yield {
+                    "text": emit_now,
+                    "token_ids": emit_ids,
+                    "finish_reason": None,
+                    **_passthrough(item),
+                }
+
+        # stream ended without explicit finish
+        tail = pending + detok.finish()
+        yield {"text": tail, "token_ids": [], "finish_reason": "stop"}
+
+
+def _passthrough(item: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        k: v
+        for k, v in item.items()
+        if k not in ("token_ids", "finish_reason", "text")
+    }

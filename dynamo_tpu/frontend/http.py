@@ -1,0 +1,277 @@
+"""OpenAI-compatible HTTP frontend (analog of reference
+lib/llm/src/http/service/: openai.rs chat/completions handlers,
+service_v2.rs HttpService).
+
+Routes: POST /v1/chat/completions, POST /v1/completions, GET /v1/models,
+GET /v1/models/{model}, GET /health, /live, /ready, GET /metrics.
+Streaming uses SSE with OpenAI chunk objects; client disconnect kills the
+request context (reference disconnect.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.http")
+
+
+class HttpService:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        manager: Optional[ModelManager] = None,
+        watcher: Optional[ModelWatcher] = None,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+    ):
+        self.runtime = runtime
+        self.manager = manager or ModelManager()
+        self.watcher = watcher or ModelWatcher(runtime, self.manager)
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self.chat_completions),
+                web.post("/v1/completions", self.completions),
+                web.get("/v1/models", self.list_models),
+                web.get("/v1/models/{model}", self.get_model),
+                web.get("/health", self.health),
+                web.get("/live", self.live),
+                web.get("/ready", self.ready),
+                web.get("/metrics", self.metrics),
+            ]
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> str:
+        await self.watcher.start()
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve ephemeral port
+        for sock in site._server.sockets:  # type: ignore[union-attr]
+            self.port = sock.getsockname()[1]
+            break
+        log.info("HTTP frontend on http://%s:%d", self.host, self.port)
+        return f"http://{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        await self.watcher.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- ops endpoints -----------------------------------------------------
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy", "models": self.manager.list_models()}
+        )
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"live": True})
+
+    async def ready(self, request: web.Request) -> web.Response:
+        ok = bool(self.manager.models)
+        return web.json_response({"ready": ok}, status=200 if ok else 503)
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.runtime.metrics.render(),
+            content_type="text/plain",
+        )
+
+    # -- model endpoints ---------------------------------------------------
+    async def list_models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": name,
+                        "object": "model",
+                        "created": int(time.time()),
+                        "owned_by": "dynamo_tpu",
+                    }
+                    for name in self.manager.list_models()
+                ],
+            }
+        )
+
+    async def get_model(self, request: web.Request) -> web.Response:
+        name = request.match_info["model"]
+        if name not in self.manager.models:
+            return _error(404, f"model {name!r} not found", "model_not_found")
+        return web.json_response(
+            {"id": name, "object": "model", "owned_by": "dynamo_tpu"}
+        )
+
+    # -- inference endpoints -----------------------------------------------
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._run_inference(request, kind="chat")
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._run_inference(request, kind="completions")
+
+    async def _run_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body", "invalid_request_error")
+        model = body.get("model")
+        try:
+            entry = self.manager.get(model)
+        except KeyError:
+            return _error(404, f"model {model!r} not found", "model_not_found")
+
+        try:
+            if kind == "chat":
+                preprocessed = entry.preprocessor.preprocess_chat(body)
+            else:
+                preprocessed = entry.preprocessor.preprocess_completions(body)
+        except ValueError as e:
+            return _error(400, str(e), "invalid_request_error")
+
+        ctx = Context(metadata={"model": model})
+        rid = f"{'chatcmpl' if kind == 'chat' else 'cmpl'}-{uuid.uuid4().hex[:24]}"
+        stream = bool(body.get("stream", False))
+        created = int(time.time())
+
+        if stream:
+            return await self._stream_response(
+                request, entry, preprocessed, ctx, rid, model, created, kind
+            )
+        return await self._unary_response(entry, preprocessed, ctx, rid, model, created, kind)
+
+    async def _stream_response(
+        self, request, entry, preprocessed, ctx, rid, model, created, kind
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": ctx.id,
+            }
+        )
+        await resp.prepare(request)
+
+        obj = "chat.completion.chunk" if kind == "chat" else "text_completion"
+
+        async def send(payload: Dict[str, Any]) -> None:
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+
+        try:
+            if kind == "chat":
+                await send(_chat_chunk(rid, model, created, {"role": "assistant"}, None))
+            async for item in entry.chain.generate(preprocessed, ctx):
+                text = item.get("text", "")
+                finish = item.get("finish_reason")
+                if text or finish:
+                    if kind == "chat":
+                        delta = {"content": text} if text else {}
+                        await send(_chat_chunk(rid, model, created, delta, finish))
+                    else:
+                        await send(
+                            {
+                                "id": rid,
+                                "object": obj,
+                                "created": created,
+                                "model": model,
+                                "choices": [
+                                    {"index": 0, "text": text, "finish_reason": finish}
+                                ],
+                            }
+                        )
+                if finish:
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()  # client disconnected (reference disconnect.rs)
+            raise
+        except Exception as e:
+            log.exception("stream failed for %s", rid)
+            await send({"error": {"message": str(e), "type": "internal_error"}})
+        finally:
+            ctx.stop_generating()
+        await resp.write_eof()
+        return resp
+
+    async def _unary_response(
+        self, entry, preprocessed, ctx, rid, model, created, kind
+    ) -> web.Response:
+        text_parts = []
+        finish = None
+        n_prompt = len(preprocessed["token_ids"])
+        n_out = 0
+        try:
+            async for item in entry.chain.generate(preprocessed, ctx):
+                text_parts.append(item.get("text", ""))
+                n_out += len(item.get("token_ids") or [])
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+                    break
+        except Exception as e:
+            log.exception("request %s failed", rid)
+            return _error(500, str(e), "internal_error")
+        finally:
+            ctx.stop_generating()
+        text = "".join(text_parts)
+        usage = {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": n_out,
+            "total_tokens": n_prompt + n_out,
+        }
+        if kind == "chat":
+            body = {
+                "id": rid,
+                "object": "chat.completion",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": finish or "stop",
+                    }
+                ],
+                "usage": usage,
+            }
+        else:
+            body = {
+                "id": rid,
+                "object": "text_completion",
+                "created": created,
+                "model": model,
+                "choices": [{"index": 0, "text": text, "finish_reason": finish or "stop"}],
+                "usage": usage,
+            }
+        return web.json_response(body)
+
+
+def _chat_chunk(rid, model, created, delta, finish) -> Dict[str, Any]:
+    return {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+    }
+
+
+def _error(status: int, message: str, err_type: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": status}},
+        status=status,
+    )

@@ -1,0 +1,71 @@
+"""In-flight request migration (analog of reference lib/llm/src/migration.rs).
+
+Pipeline operator between the preprocessor and the router: if the worker
+connection fails mid-stream with a *migratable* error (reference
+migration.rs:60-68 — CannotConnect / Disconnected / ConnectionTimeout /
+EngineShutdown), re-issue the request to a fresh worker with the tokens
+generated so far appended to the prompt, so generation resumes where it
+left off. Bounded by `migration_limit` per request.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Dict
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+log = logging.getLogger("dynamo_tpu.migration")
+
+MIGRATABLE_CODES = {"cannot_connect", "disconnected", "connection_timeout", "draining"}
+
+
+def is_migratable(err: Exception) -> bool:
+    return isinstance(err, RequestPlaneError) and err.code in MIGRATABLE_CODES
+
+
+class Migration:
+    def __init__(self, downstream: AsyncEngine, migration_limit: int = 3):
+        self.downstream = downstream
+        self.migration_limit = migration_limit
+
+    async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        retries_left = self.migration_limit
+        accumulated: list[int] = []  # tokens already delivered downstream
+
+        while True:
+            try:
+                # re-issues go out with a fresh child context so a stop on
+                # the dead stream doesn't poison the retry
+                attempt_ctx = context.child()
+                async for item in self.downstream.generate(request, attempt_ctx):
+                    accumulated.extend(item.get("token_ids") or [])
+                    yield item
+                return
+            except RequestPlaneError as e:
+                if not is_migratable(e) or retries_left <= 0 or context.is_stopped:
+                    raise
+                retries_left -= 1
+                request = self._replay_request(request, accumulated)
+                accumulated = []  # folded into the replayed prompt
+                log.warning(
+                    "migrating request %s after %s (%d retries left, %d tokens replayed)",
+                    context.id, e.code, retries_left, len(accumulated),
+                )
+
+    @staticmethod
+    def _replay_request(request: Dict[str, Any], accumulated: list[int]) -> Dict[str, Any]:
+        if not accumulated:
+            return request
+        req = dict(request)
+        req["token_ids"] = list(request["token_ids"]) + accumulated
+        stop = dict(req.get("stop") or {})
+        if "max_tokens" in stop:
+            stop["max_tokens"] = max(1, int(stop["max_tokens"]) - len(accumulated))
+        req["stop"] = stop
+        ann = dict(req.get("annotations") or {})
+        ann["migrated_tokens"] = ann.get("migrated_tokens", 0) + len(accumulated)
+        req["annotations"] = ann
+        return req

@@ -1,0 +1,112 @@
+"""OpenAI request → PreprocessedRequest (analog of reference
+OpenAIPreprocessor, lib/llm/src/preprocessor.rs:286,837: chat-template
+rendering + tokenization + sampling-param mapping).
+
+Operates as a pipeline engine: wraps a downstream engine that consumes
+PreprocessedRequests and returns engine outputs; exposes generate() over
+OpenAI-shaped dict requests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jinja2
+
+from dynamo_tpu.frontend.protocols import (
+    ModelCard,
+    SamplingOptions,
+    StopConditions,
+    make_preprocessed_request,
+)
+from dynamo_tpu.frontend.tokenizer import Tokenizer, load_tokenizer
+
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ message['role'] }}: {{ message['content'] }}\n"
+    "{% endfor %}"
+    "assistant:"
+)
+
+
+class Preprocessor:
+    def __init__(self, card: ModelCard, tokenizer: Optional[Tokenizer] = None):
+        self.card = card
+        self.tokenizer = tokenizer or load_tokenizer(card.tokenizer)
+        self._jinja = jinja2.Environment()
+        self._template = self._jinja.from_string(card.chat_template or DEFAULT_CHAT_TEMPLATE)
+
+    # -- prompt assembly ---------------------------------------------------
+    def render_chat(self, messages: List[Dict[str, Any]]) -> str:
+        return self._template.render(messages=messages, add_generation_prompt=True)
+
+    def tokenize_prompt(self, prompt: str, add_bos: bool = True) -> List[int]:
+        ids = self.tokenizer.encode(prompt)
+        bos = self.tokenizer.bos_id
+        if add_bos and bos is not None and (not ids or ids[0] != bos):
+            ids = [bos] + ids
+        return ids
+
+    # -- request mapping ---------------------------------------------------
+    def _sampling(self, req: Dict[str, Any]) -> SamplingOptions:
+        return SamplingOptions(
+            temperature=req.get("temperature", 1.0) or 0.0,
+            top_p=req.get("top_p", 1.0) or 1.0,
+            top_k=req.get("top_k", 0) or 0,
+            seed=req.get("seed"),
+            frequency_penalty=req.get("frequency_penalty", 0.0) or 0.0,
+            presence_penalty=req.get("presence_penalty", 0.0) or 0.0,
+        )
+
+    def _stop(self, req: Dict[str, Any], prompt_len: int) -> StopConditions:
+        stop = req.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        max_tokens = req.get("max_tokens") or req.get("max_completion_tokens")
+        if max_tokens is None:
+            max_tokens = min(512, max(1, self.card.context_length - prompt_len))
+        stop_ids = list(req.get("stop_token_ids") or [])
+        eos = self.tokenizer.eos_id
+        if eos is not None and eos not in stop_ids:
+            stop_ids.append(eos)
+        return StopConditions(
+            max_tokens=int(max_tokens),
+            stop_strings=list(stop),
+            stop_ids=stop_ids,
+            min_tokens=int(req.get("min_tokens") or 0),
+            ignore_eos=bool(req.get("ignore_eos", False)),
+        )
+
+    def preprocess_chat(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = self.render_chat(req.get("messages") or [])
+        ids = self.tokenize_prompt(prompt)
+        self._check_context(len(ids))
+        return make_preprocessed_request(
+            model=req.get("model", self.card.name),
+            token_ids=ids,
+            sampling=self._sampling(req),
+            stop=self._stop(req, len(ids)),
+            annotations={"kind": "chat"},
+        )
+
+    def preprocess_completions(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = req.get("prompt") or ""
+        if isinstance(prompt, list):  # token-id prompt passthrough
+            ids = [int(t) for t in prompt]
+        else:
+            ids = self.tokenize_prompt(str(prompt))
+        self._check_context(len(ids))
+        return make_preprocessed_request(
+            model=req.get("model", self.card.name),
+            token_ids=ids,
+            sampling=self._sampling(req),
+            stop=self._stop(req, len(ids)),
+            annotations={"kind": "completions"},
+        )
+
+    def _check_context(self, prompt_len: int) -> None:
+        if prompt_len >= self.card.context_length:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds model context length "
+                f"{self.card.context_length}"
+            )

@@ -1,0 +1,83 @@
+"""Frontend protocols: model card + preprocessed request + engine output.
+
+Analogs of reference lib/llm/src/model_card.rs:821 (ModelDeploymentCard),
+protocols/common/preprocessor.rs:168 (PreprocessedRequest) and
+protocols/common/llm_backend.rs:82,163 (BackendOutput/LLMEngineOutput).
+Kept as plain dicts on the wire (msgpack-friendly); these dataclasses are
+the typed construction points.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ModelCard:
+    """Published in instance metadata under key 'model_card'; the frontend's
+    ModelWatcher builds a serving pipeline per discovered card."""
+
+    name: str
+    tokenizer: str = "byte"  # 'byte' or path to tokenizer.json
+    chat_template: Optional[str] = None  # jinja2; None → default template
+    context_length: int = 8192
+    kv_block_size: int = 16
+    model_type: str = "completions"  # completions | embeddings
+    runtime_config: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelCard":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    seed: Optional[int] = None
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+
+
+@dataclass
+class StopConditions:
+    max_tokens: int = 512
+    stop_strings: List[str] = field(default_factory=list)
+    stop_ids: List[int] = field(default_factory=list)
+    min_tokens: int = 0
+    ignore_eos: bool = False
+
+
+def make_preprocessed_request(
+    model: str,
+    token_ids: List[int],
+    sampling: SamplingOptions,
+    stop: StopConditions,
+    annotations: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    return {
+        "model": model,
+        "token_ids": token_ids,
+        "sampling": asdict(sampling),
+        "stop": asdict(stop),
+        "annotations": annotations or {},
+    }
+
+
+# Engine output stream item keys (worker → frontend):
+#   token_ids: list[int]       new tokens this step
+#   finish_reason: None | "stop" | "length" | "eos" | "error" | "cancelled"
+#   kv_transfer_params: dict   (disagg handoff, prefill → decode)
+def engine_output(
+    token_ids: List[int],
+    finish_reason: Optional[str] = None,
+    **extra: Any,
+) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"token_ids": token_ids, "finish_reason": finish_reason}
+    out.update(extra)
+    return out

@@ -1,0 +1,159 @@
+"""Model discovery + per-model pipeline assembly.
+
+Analogs: ModelManager (reference lib/llm/src/discovery/model_manager.rs:134),
+ModelWatcher (discovery/watcher.rs:217,472), and the pipeline linking of
+entrypoint/input/common.rs:498-519:
+
+    HTTP → Preprocessor → Migration → Backend(detok/stop) → Router → worker
+
+Workers publish a ModelCard in their instance metadata; the watcher reacts
+to discovery events, creating an engine chain per model and removing it when
+the last instance disappears.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Set
+
+from dynamo_tpu.frontend.backend import BackendOperator
+from dynamo_tpu.frontend.migration import Migration
+from dynamo_tpu.frontend.preprocessor import Preprocessor
+from dynamo_tpu.frontend.protocols import ModelCard
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime, EndpointClient
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.request_plane import RouterMode
+
+log = logging.getLogger("dynamo_tpu.frontend")
+
+
+@dataclass
+class ModelEntry:
+    card: ModelCard
+    endpoint_path: str
+    preprocessor: Preprocessor
+    client: EndpointClient
+    chain: AsyncEngine
+    instance_ids: Set[int] = field(default_factory=set)
+
+
+class ModelManager:
+    """Holds the per-model serving pipelines the HTTP layer dispatches to."""
+
+    def __init__(self):
+        self.models: Dict[str, ModelEntry] = {}
+
+    def get(self, model: str) -> ModelEntry:
+        entry = self.models.get(model)
+        if entry is None:
+            raise KeyError(f"model {model!r} not found")
+        return entry
+
+    def list_models(self) -> list:
+        return sorted(self.models)
+
+
+class ModelWatcher:
+    """Watches discovery; builds/tears down ModelEntries.
+
+    router_mode: round_robin | random | kv (kv wired once the KV router
+    lands; falls back to round_robin until then).
+    """
+
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        manager: ModelManager,
+        router_mode: str = RouterMode.ROUND_ROBIN,
+        migration_limit: int = 3,
+        chain_factory=None,
+    ):
+        self.runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        self.migration_limit = migration_limit
+        self._task: Optional[asyncio.Task] = None
+        self._ready = asyncio.Event()
+        # chain_factory(entry_args...) -> AsyncEngine; overridable (kv router)
+        self._chain_factory = chain_factory or self._default_chain
+
+    def _default_chain(self, card: ModelCard, client: EndpointClient, pre: Preprocessor) -> AsyncEngine:
+        router_engine = _ClientEngine(client)
+        backend = BackendOperator(pre.tokenizer, router_engine)
+        return Migration(backend, migration_limit=self.migration_limit)
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._watch())
+
+    async def wait_for_model(self, timeout: float = 30.0) -> None:
+        await self.start()
+        await asyncio.wait_for(self._ready.wait(), timeout)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for entry in self.manager.models.values():
+            await entry.client.close()
+        self.manager.models.clear()
+
+    async def _watch(self) -> None:
+        try:
+            async for ev in self.runtime.discovery.watch("services/"):
+                inst = ev.instance
+                card_dict = (inst.metadata or {}).get("model_card")
+                if not card_dict:
+                    continue
+                card = ModelCard.from_dict(card_dict)
+                if ev.kind == "put":
+                    await self._on_put(card, inst)
+                else:
+                    await self._on_delete(card, inst)
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # pragma: no cover
+            log.exception("model watcher failed")
+
+    async def _on_put(self, card: ModelCard, inst) -> None:
+        entry = self.manager.models.get(card.name)
+        if entry is None:
+            pre = Preprocessor(card)
+            client = self.runtime.client(inst.endpoint_address.path, self.router_mode)
+            await client.start()
+            chain = self._chain_factory(card, client, pre)
+            entry = ModelEntry(
+                card=card,
+                endpoint_path=inst.endpoint_address.path,
+                preprocessor=pre,
+                client=client,
+                chain=chain,
+            )
+            self.manager.models[card.name] = entry
+            log.info("model %s added (endpoint %s)", card.name, entry.endpoint_path)
+        entry.instance_ids.add(inst.instance_id)
+        self._ready.set()
+
+    async def _on_delete(self, card: ModelCard, inst) -> None:
+        entry = self.manager.models.get(card.name)
+        if entry is None:
+            return
+        entry.instance_ids.discard(inst.instance_id)
+        if not entry.instance_ids:
+            await entry.client.close()
+            del self.manager.models[card.name]
+            log.info("model %s removed (last instance gone)", card.name)
+
+
+class _ClientEngine:
+    """EndpointClient as an AsyncEngine (router egress node)."""
+
+    def __init__(self, client: EndpointClient):
+        self.client = client
+
+    async def generate(self, request: Any, context: Context):
+        async for item in self.client.generate(request, context):
+            yield item

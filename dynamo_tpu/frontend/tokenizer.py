@@ -1,0 +1,109 @@
+"""Tokenizer abstraction + incremental (streaming) detokenization.
+
+Analog of the reference's external `dynamo-tokenizers` crate (HF tokenizer
+wrapper) plus the incremental-detokenization logic in lib/llm/src/backend.rs.
+
+Two implementations:
+- HFTokenizer: wraps a `tokenizers.Tokenizer` loaded from tokenizer.json
+  (the standard path for real models).
+- ByteTokenizer: deterministic byte-level tokenizer (ids 0..255 + special
+  ids) requiring no model assets — used by tests, the mocker, and
+  random-weight benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class Tokenizer:
+    bos_id: Optional[int] = None
+    eos_id: Optional[int] = None
+    vocab_size: int = 0
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+
+class ByteTokenizer(Tokenizer):
+    """ids 0..255 = raw bytes; 256 = BOS, 257 = EOS."""
+
+    BOS = 256
+    EOS = 257
+
+    def __init__(self):
+        self.bos_id = self.BOS
+        self.eos_id = self.EOS
+        self.vocab_size = 258
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer(Tokenizer):
+    def __init__(self, tokenizer_file: str):
+        from tokenizers import Tokenizer as _HfTok
+
+        self._tok = _HfTok.from_file(tokenizer_file)
+        self.vocab_size = self._tok.get_vocab_size()
+        # common special tokens; model cards can override eos via stop ids
+        for name in ("</s>", "<|end_of_text|>", "<|eot_id|>", "<|endoftext|>"):
+            tid = self._tok.token_to_id(name)
+            if tid is not None:
+                self.eos_id = tid
+                break
+        for name in ("<s>", "<|begin_of_text|>"):
+            tid = self._tok.token_to_id(name)
+            if tid is not None:
+                self.bos_id = tid
+                break
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(spec: Optional[str]) -> Tokenizer:
+    """spec: None/'byte' → ByteTokenizer; otherwise a tokenizer.json path."""
+    if not spec or spec == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(spec)
+
+
+class IncrementalDetokenizer:
+    """Streaming token→text conversion that only emits text once it is
+    unambiguous (reference backend.rs incremental detokenization): decode the
+    full prefix, emit the delta beyond what was already emitted, and hold
+    back trailing bytes that end in a replacement char (partial UTF-8 /
+    partial multi-token grapheme).
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._emitted = 0  # chars of decode(self._ids) already emitted
+
+    def push(self, ids: Sequence[int]) -> str:
+        self._ids.extend(ids)
+        text = self._tok.decode(self._ids)
+        # hold back a trailing replacement char: likely a partial sequence
+        safe_end = len(text)
+        while safe_end > 0 and text[safe_end - 1] == "�":
+            safe_end -= 1
+        delta = text[self._emitted : safe_end]
+        self._emitted = safe_end
+        return delta
+
+    def finish(self) -> str:
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted :]
+        self._emitted = len(text)
+        return delta

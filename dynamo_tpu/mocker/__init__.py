@@ -1,0 +1,3 @@
+"""Simulation stack (analog of reference lib/mocker + dynamo.mocker):
+GPU/TPU-free engines with real registration, KV events, and timing models.
+"""

@@ -1,0 +1,59 @@
+"""`python -m dynamo_tpu.mocker` — simulated worker process.
+
+Analog of reference `python -m dynamo.mocker`: registers as a real worker
+(discovery + request plane + model card) with a simulated engine. Currently
+serves the EchoWorkerEngine; the TPU step-time scheduler mock replaces it in
+the full mocker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.frontend.protocols import ModelCard
+from dynamo_tpu.mocker.echo import EchoWorkerEngine
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging_util import configure_logging
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.mocker")
+    p.add_argument("--model-name", default="echo-model")
+    p.add_argument("--namespace", default="dyn")
+    p.add_argument("--component", default="mocker")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--token-delay-ms", type=float, default=0.0)
+    p.add_argument("--discovery-backend", default=None)
+    p.add_argument("--discovery-root", default=None)
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    configure_logging()
+    kw = {}
+    if args.discovery_root:
+        kw["root"] = args.discovery_root
+    runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
+    card = ModelCard(name=args.model_name, tokenizer="byte")
+    engine = EchoWorkerEngine(token_delay_s=args.token_delay_ms / 1000.0)
+    path = f"{args.namespace}/{args.component}/{args.endpoint}"
+    await runtime.serve_endpoint(path, engine, metadata={"model_card": card.to_dict()})
+    print(f"mocker serving {args.model_name} at {path}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await runtime.shutdown()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(async_main(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Echo worker engine: a no-model completion engine speaking the real
+worker protocol (PreprocessedRequest in, engine-output items out).
+
+Mirror of reference lib/llm/src/engines.rs:77 EchoEngine — used for
+frontend/runtime e2e tests and demos with zero accelerators. Generates by
+replaying the prompt tokens (cycled) up to max_tokens, at a configurable
+per-token delay.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Dict
+
+from dynamo_tpu.frontend.protocols import engine_output
+from dynamo_tpu.runtime.context import Context
+
+
+class EchoWorkerEngine:
+    def __init__(self, token_delay_s: float = 0.0, tokens_per_item: int = 1):
+        self.token_delay_s = token_delay_s
+        self.tokens_per_item = tokens_per_item
+
+    async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        prompt = request.get("token_ids") or [0]
+        stop = request.get("stop") or {}
+        max_tokens = int(stop.get("max_tokens", 16))
+        stop_ids = set(stop.get("stop_ids") or [])
+
+        emitted = 0
+        buf = []
+        i = 0
+        while emitted < max_tokens:
+            if context.is_stopped:
+                if buf:
+                    yield engine_output(buf, None)
+                yield engine_output([], "cancelled")
+                return
+            tok = prompt[i % len(prompt)]
+            i += 1
+            # never emit a stop id by accident (echoing BOS/EOS prompts)
+            if tok in stop_ids:
+                continue
+            buf.append(tok)
+            emitted += 1
+            if len(buf) >= self.tokens_per_item or emitted >= max_tokens:
+                finish = "length" if emitted >= max_tokens else None
+                yield engine_output(buf, finish)
+                buf = []
+            if self.token_delay_s:
+                await asyncio.sleep(self.token_delay_s)

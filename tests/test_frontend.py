@@ -1,0 +1,240 @@
+"""Frontend tests: preprocessor, incremental detok + stop conditions,
+migration replay, and HTTP e2e (frontend → TCP → echo worker → SSE) —
+mirrors reference lib/llm/tests/{http-service,preprocessor}.rs areas."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.backend import BackendOperator, _longest_partial_suffix
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.frontend.migration import Migration
+from dynamo_tpu.frontend.preprocessor import Preprocessor
+from dynamo_tpu.frontend.protocols import ModelCard, engine_output
+from dynamo_tpu.frontend.tokenizer import ByteTokenizer, IncrementalDetokenizer
+from dynamo_tpu.mocker.echo import EchoWorkerEngine
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+
+def _card(name="echo-model"):
+    return ModelCard(name=name, tokenizer="byte", context_length=1024)
+
+
+# -- preprocessor -----------------------------------------------------------
+
+
+def test_preprocess_chat_renders_and_tokenizes():
+    pre = Preprocessor(_card())
+    req = {
+        "model": "echo-model",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 8,
+        "temperature": 0.5,
+        "stop": ["END"],
+    }
+    out = pre.preprocess_chat(req)
+    text = ByteTokenizer().decode(out["token_ids"])
+    assert "user: hi" in text and text.endswith("assistant:")
+    assert out["token_ids"][0] == ByteTokenizer.BOS
+    assert out["sampling"]["temperature"] == 0.5
+    assert out["stop"]["max_tokens"] == 8
+    assert out["stop"]["stop_strings"] == ["END"]
+    assert ByteTokenizer.EOS in out["stop"]["stop_ids"]
+
+
+def test_preprocess_rejects_over_context():
+    pre = Preprocessor(ModelCard(name="m", context_length=10))
+    with pytest.raises(ValueError):
+        pre.preprocess_completions({"prompt": "x" * 100})
+
+
+# -- incremental detok ------------------------------------------------------
+
+
+def test_incremental_detok_holds_partial_utf8():
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    euro = "€".encode("utf-8")  # 3 bytes
+    assert detok.push([euro[0]]) == ""
+    assert detok.push([euro[1]]) == ""
+    assert detok.push([euro[2]]) == "€"
+    assert detok.finish() == ""
+
+
+def test_partial_suffix_helper():
+    assert _longest_partial_suffix("hello wo", ["world"]) == 2
+    assert _longest_partial_suffix("hello", ["world"]) == 0
+    assert _longest_partial_suffix("abcEN", ["END"]) == 2
+
+
+class _ListEngine:
+    """Yields preset engine outputs."""
+
+    def __init__(self, items):
+        self.items = items
+
+    async def generate(self, request, context):
+        for it in self.items:
+            yield it
+
+
+async def test_backend_stop_string_cuts_stream():
+    tok = ByteTokenizer()
+    items = [
+        engine_output(list(b"hello ")),
+        engine_output(list(b"EN")),  # partial stop → held back
+        engine_output(list(b"D trailing")),  # completes "END"
+        engine_output(list(b"never")),
+    ]
+    op = BackendOperator(tok, _ListEngine(items))
+    ctx = Context()
+    out = [i async for i in op.generate({"stop": {"stop_strings": ["END"], "max_tokens": 100}}, ctx)]
+    text = "".join(i["text"] for i in out)
+    assert text == "hello "  # END and everything after suppressed
+    assert out[-1]["finish_reason"] == "stop"
+    assert ctx.is_stopped
+
+
+async def test_backend_stop_id_and_max_tokens():
+    tok = ByteTokenizer()
+    items = [engine_output([104, 105, ByteTokenizer.EOS])]
+    op = BackendOperator(tok, _ListEngine(items))
+    out = [
+        i
+        async for i in op.generate(
+            {"stop": {"stop_ids": [ByteTokenizer.EOS], "max_tokens": 100}}, Context()
+        )
+    ]
+    assert "".join(i["text"] for i in out) == "hi"
+    assert out[-1]["finish_reason"] == "stop"
+
+    op2 = BackendOperator(tok, _ListEngine([engine_output(list(b"abcdef"))]))
+    out2 = [i async for i in op2.generate({"stop": {"max_tokens": 3}}, Context())]
+    assert "".join(i["text"] for i in out2) == "abc"
+    assert out2[-1]["finish_reason"] == "length"
+
+
+# -- migration --------------------------------------------------------------
+
+
+async def test_migration_replays_accumulated_tokens():
+    class FlakyEngine:
+        def __init__(self):
+            self.calls = []
+
+        async def generate(self, request, context):
+            self.calls.append(list(request["token_ids"]))
+            if len(self.calls) == 1:
+                yield engine_output([100, 101])
+                raise RequestPlaneError("worker died", code="disconnected")
+            yield engine_output([102], "length")
+
+    flaky = FlakyEngine()
+    mig = Migration(flaky, migration_limit=2)
+    req = {"token_ids": [1, 2], "stop": {"max_tokens": 10}}
+    out = [i async for i in mig.generate(req, Context())]
+    toks = [t for i in out for t in i["token_ids"]]
+    assert toks == [100, 101, 102]
+    # second attempt got prompt + generated-so-far, and a reduced budget
+    assert flaky.calls == [[1, 2], [1, 2, 100, 101]]
+
+
+async def test_migration_gives_up_after_limit():
+    class DeadEngine:
+        async def generate(self, request, context):
+            raise RequestPlaneError("nope", code="cannot_connect")
+            yield
+
+    mig = Migration(DeadEngine(), migration_limit=1)
+    with pytest.raises(RequestPlaneError):
+        async for _ in mig.generate({"token_ids": [1], "stop": {}}, Context()):
+            pass
+
+
+# -- HTTP e2e ---------------------------------------------------------------
+
+
+async def _start_stack(realm="http-e2e"):
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    await wrt.serve_endpoint(
+        "dyn/worker/generate",
+        EchoWorkerEngine(),
+        metadata={"model_card": _card().to_dict()},
+    )
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=5)
+    return wrt, frt, svc, base
+
+
+async def test_http_models_health_and_unary_chat():
+    wrt, frt, svc, base = await _start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/models") as r:
+                models = await r.json()
+            assert [m["id"] for m in models["data"]] == ["echo-model"]
+
+            async with s.get(f"{base}/health") as r:
+                assert (await r.json())["status"] == "healthy"
+
+            payload = {
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 12,
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body["object"] == "chat.completion"
+            assert body["usage"]["completion_tokens"] == 12
+            assert len(body["choices"][0]["message"]["content"]) > 0
+
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "missing", "messages": []},
+            ) as r:
+                assert r.status == 404
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+
+
+async def test_http_streaming_sse():
+    wrt, frt, svc, base = await _start_stack(realm="http-sse")
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "echo-model",
+                "prompt": "abc",
+                "max_tokens": 6,
+                "stream": True,
+            }
+            chunks = []
+            async with s.post(f"{base}/v1/completions", json=payload) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        data = line[len("data: "):]
+                        if data == "[DONE]":
+                            chunks.append(None)
+                            break
+                        chunks.append(json.loads(data))
+            assert chunks[-1] is None
+            text = "".join(c["choices"][0]["text"] for c in chunks[:-1])
+            # 6 echoed tokens = [BOS a b c BOS a]; BOS decodes to nothing
+            assert text == "abca"
+            assert chunks[-2]["choices"][0]["finish_reason"] == "length"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
