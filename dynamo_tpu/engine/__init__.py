@@ -1,0 +1,8 @@
+"""Native JAX serving engine: paged KV cache, continuous batching scheduler,
+bucketed jit step functions, on-device sampling.
+
+The reference orchestrates external engines (vLLM/SGLang/TRT-LLM); this
+package is the TPU-native engine those adapters would wrap — it speaks the
+same worker protocol (PreprocessedRequest in, engine-output items out) as
+the rest of the stack.
+"""

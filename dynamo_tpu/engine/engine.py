@@ -1,0 +1,263 @@
+"""InferenceEngine: the native TPU serving engine as an AsyncEngine.
+
+Bridges the asyncio worker process and the blocking JAX step loop: requests
+enter via `generate()` (the standard worker protocol — PreprocessedRequest
+in, engine-output items out), a dedicated step thread runs the
+scheduler/runner loop, and sampled tokens flow back through per-request
+asyncio queues (one cross-thread hop per engine step, not per token).
+
+Fills the role the reference delegates to vLLM/SGLang/TRT-LLM AsyncLLM
+(components/src/dynamo/vllm/handlers.py), natively on TPU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import queue as thread_queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import numpy as np
+
+from dynamo_tpu.engine.kv_pool import KvEvent, PagePool
+from dynamo_tpu.engine.model_runner import ModelRunner
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import (
+    DecodePlan,
+    PrefillPlan,
+    Scheduler,
+    SchedulerStats,
+    Sequence,
+    SeqState,
+)
+from dynamo_tpu.frontend.protocols import engine_output
+from dynamo_tpu.runtime.context import Context
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Per-iteration engine metrics published for the planner (analog of
+    reference FPM, docs/design-docs/planner-design.md:237-246)."""
+
+    ts: float
+    kind: str  # "prefill" | "decode"
+    wall_time_s: float
+    scheduled_tokens: int
+    n_running: int
+    n_waiting: int
+    kv_usage: float
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        runner: ModelRunner,
+        *,
+        max_batch: int = 64,
+        chunk_size: int = 512,
+        idle_sleep_s: float = 0.002,
+    ):
+        self.runner = runner
+        self.pool = PagePool(runner.num_pages, runner.page_size)
+        self.scheduler = Scheduler(
+            self.pool,
+            max_batch=max_batch,
+            chunk_size=chunk_size,
+            max_seq_pages=runner.max_pages_per_seq,
+        )
+        self.idle_sleep_s = idle_sleep_s
+        self._inbox: thread_queue.Queue = thread_queue.Queue()
+        self._streams: Dict[str, tuple[asyncio.Queue, asyncio.AbstractEventLoop]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._step_counter = 0
+        self.fpm_history: List[ForwardPassMetrics] = []
+        self._fpm_listeners: List[Any] = []
+        self._kv_listeners: List[Any] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, name="engine-step", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def on_fpm(self, cb) -> None:
+        """cb(ForwardPassMetrics) from the step thread."""
+        self._fpm_listeners.append(cb)
+
+    def on_kv_event(self, cb) -> None:
+        """cb(List[KvEvent]) from the step thread."""
+        self._kv_listeners.append(cb)
+
+    # -- AsyncEngine protocol ----------------------------------------------
+    async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        self.start()
+        loop = asyncio.get_running_loop()
+        out: asyncio.Queue = asyncio.Queue()
+        rid = context.id
+        self._streams[rid] = (out, loop)
+
+        seq = Sequence(
+            request_id=rid,
+            prompt=[int(t) for t in request.get("token_ids") or [0]],
+            sampling=request.get("sampling") or {},
+            stop=request.get("stop") or {},
+            arrival=time.monotonic(),
+        )
+        self._inbox.put(("add", seq))
+        finished = False
+        try:
+            while True:
+                if context.is_stopped:
+                    return
+                get = asyncio.create_task(out.get())
+                stop_wait = asyncio.create_task(context.wait_stopped())
+                done, pending = await asyncio.wait(
+                    {get, stop_wait}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in pending:
+                    t.cancel()
+                if get not in done:
+                    return
+                item = get.result()
+                yield item
+                if item.get("finish_reason"):
+                    finished = True
+                    return
+        finally:
+            # runs on normal end, cancel, AND consumer break/close
+            self._streams.pop(rid, None)
+            if not finished:
+                self._inbox.put(("abort", rid))
+
+    # -- step loop (dedicated thread) --------------------------------------
+    def _loop(self) -> None:
+        log.info("engine step loop started")
+        while not self._stop.is_set():
+            self._drain_inbox()
+            plan = self.scheduler.step_plan()
+            if plan is None:
+                if not self.scheduler.has_work():
+                    time.sleep(self.idle_sleep_s)
+                continue
+            t0 = time.monotonic()
+            if isinstance(plan, PrefillPlan):
+                self._run_prefill(plan)
+                kind, n_tok = "prefill", len(plan.chunk)
+            else:
+                self._run_decode(plan)
+                kind, n_tok = "decode", len(plan.seqs)
+            self._publish_fpm(kind, time.monotonic() - t0, n_tok)
+            self._publish_kv_events()
+        log.info("engine step loop stopped")
+
+    def _drain_inbox(self) -> None:
+        while True:
+            try:
+                op, arg = self._inbox.get_nowait()
+            except thread_queue.Empty:
+                return
+            if op == "add":
+                self.scheduler.add(arg)
+            elif op == "abort":
+                self.scheduler.abort(arg)
+
+    def _run_prefill(self, plan: PrefillPlan) -> None:
+        seq = plan.seq
+        logits = self.runner.prefill(
+            plan.chunk,
+            plan.start_pos,
+            seq.pages,
+            prior_len=plan.start_pos,
+        )
+        self.scheduler.complete_prefill(plan)
+        if plan.is_last_chunk:
+            token = self.runner.sample_one(
+                logits, _sampling_params([seq]), self._next_step()
+            )
+            reason = self.scheduler.complete_decode(seq, token, advance_computed=False)
+            emitted = token if reason != "stop" else None
+            self._emit(seq, [token] if emitted is not None else [], reason)
+
+    def _run_decode(self, plan: DecodePlan) -> None:
+        seqs = plan.seqs
+        tokens = [s.tokens[-1] for s in seqs]
+        positions = [s.computed_len for s in seqs]
+        page_tables = [s.pages for s in seqs]
+        kv_lens = [s.computed_len + 1 for s in seqs]
+        sampled = self.runner.decode(
+            tokens, positions, page_tables, kv_lens,
+            _sampling_params(seqs), self._next_step(),
+        )
+        for i, seq in enumerate(seqs):
+            token = int(sampled[i])
+            reason = self.scheduler.complete_decode(seq, token)
+            emit = [] if reason == "stop" else [token]
+            self._emit(seq, emit, reason)
+
+    def _next_step(self) -> int:
+        self._step_counter += 1
+        return self._step_counter
+
+    # -- emission ----------------------------------------------------------
+    def _emit(self, seq: Sequence, token_ids: List[int], finish: Optional[str]) -> None:
+        entry = self._streams.get(seq.request_id)
+        if entry is None:
+            return
+        out, loop = entry
+        item = engine_output(token_ids, finish)
+        loop.call_soon_threadsafe(out.put_nowait, item)
+
+    def _publish_fpm(self, kind: str, wall: float, n_tok: int) -> None:
+        st = self.scheduler.stats
+        m = ForwardPassMetrics(
+            ts=time.time(),
+            kind=kind,
+            wall_time_s=wall,
+            scheduled_tokens=n_tok,
+            n_running=st.n_running,
+            n_waiting=st.n_waiting,
+            kv_usage=st.kv_usage,
+        )
+        self.fpm_history.append(m)
+        if len(self.fpm_history) > 2048:
+            del self.fpm_history[:1024]
+        for cb in self._fpm_listeners:
+            try:
+                cb(m)
+            except Exception:  # pragma: no cover
+                log.exception("fpm listener failed")
+
+    def _publish_kv_events(self) -> None:
+        events = self.pool.drain_events()
+        if not events:
+            return
+        for cb in self._kv_listeners:
+            try:
+                cb(events)
+            except Exception:  # pragma: no cover
+                log.exception("kv listener failed")
+
+
+def _sampling_params(seqs: List[Sequence]) -> SamplingParams:
+    return SamplingParams.make(
+        temperature=[float(s.sampling.get("temperature", 1.0)) for s in seqs],
+        top_k=[int(s.sampling.get("top_k", 0)) for s in seqs],
+        top_p=[float(s.sampling.get("top_p", 1.0)) for s in seqs],
+        seeds=[
+            (s.sampling.get("seed") if s.sampling.get("seed") is not None
+             else (hash(s.request_id) & 0x7FFFFFFF))
+            for s in seqs
+        ],
+    )

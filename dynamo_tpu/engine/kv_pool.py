@@ -1,0 +1,141 @@
+"""Host-side paged KV allocator with content-addressed prefix caching.
+
+This is the G1 (device HBM) tier's logical block manager — the TPU analog
+of the reference's in-engine prefix cache plus the kvbm-logical block
+lifecycle (Reset → Partial → Complete → Registered,
+docs/design-docs/kvbm-design.md:121-150):
+
+- pages are allocated from a free list per sequence;
+- when a page fills, it is *registered* under its lineage hash
+  (dynamo_tpu.tokens.hashing) and becomes shareable: later requests with a
+  matching prefix reuse it (ref-counted) without recompute;
+- freed pages with refcount 0 stay cached (LRU) until capacity demands
+  eviction;
+- register/evict produce KV events (store/remove) that the worker's
+  publisher forwards to the router's indexer.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dynamo_tpu.tokens.hashing import block_hashes
+
+
+@dataclass
+class KvEvent:
+    kind: str  # "store" | "remove"
+    block_hashes: List[int]
+    # parent hash of the first stored block (lineage anchoring), store only
+    parent_hash: Optional[int] = None
+
+
+class NoSpace(Exception):
+    """Raised when allocation fails even after eviction (caller preempts)."""
+
+
+class PagePool:
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.free: List[int] = list(range(num_pages - 1, -1, -1))
+        self.ref: Dict[int, int] = {}  # page -> refcount (allocated pages)
+        # registered (complete, content-addressed) pages
+        self.by_hash: Dict[int, int] = {}  # block_hash -> page
+        self.hash_of: Dict[int, int] = {}  # page -> block_hash
+        # cached = registered pages with ref 0, LRU order (evict from front)
+        self.cached: "OrderedDict[int, None]" = OrderedDict()
+        self.events: List[KvEvent] = []
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def n_free(self) -> int:
+        return len(self.free) + len(self.cached)
+
+    def usage(self) -> float:
+        return 1.0 - self.n_free / self.num_pages
+
+    # -- allocation --------------------------------------------------------
+    def _pop_free(self) -> int:
+        if self.free:
+            return self.free.pop()
+        # evict LRU cached page
+        if self.cached:
+            page, _ = self.cached.popitem(last=False)
+            h = self.hash_of.pop(page)
+            del self.by_hash[h]
+            self.events.append(KvEvent("remove", [h]))
+            return page
+        raise NoSpace("no free or evictable pages")
+
+    def alloc(self, n: int) -> List[int]:
+        if self.n_free < n:
+            raise NoSpace(f"need {n} pages, have {self.n_free} evictable")
+        pages = [self._pop_free() for _ in range(n)]
+        for p in pages:
+            self.ref[p] = 1
+        return pages
+
+    # -- prefix cache ------------------------------------------------------
+    def match_prefix(self, tokens: List[int]) -> Tuple[List[int], List[int]]:
+        """Longest cached prefix → (pages, hashes). Bumps refcounts."""
+        pages: List[int] = []
+        hashes: List[int] = []
+        for h in block_hashes(tokens, self.page_size):
+            page = self.by_hash.get(h)
+            if page is None:
+                break
+            pages.append(page)
+            hashes.append(h)
+        for p in pages:
+            self._ref_inc(p)
+        return pages, hashes
+
+    def lookup_prefix_len(self, tokens: List[int]) -> int:
+        """Cached-prefix length in tokens, without taking refs (router use)."""
+        n = 0
+        for h in block_hashes(tokens, self.page_size):
+            if h not in self.by_hash:
+                break
+            n += self.page_size
+        return n
+
+    def _ref_inc(self, page: int) -> None:
+        if page in self.cached:
+            del self.cached[page]
+            self.ref[page] = 1
+        else:
+            self.ref[page] = self.ref.get(page, 0) + 1
+
+    def register(self, page: int, block_hash: int, parent_hash: Optional[int]) -> int:
+        """Mark a full page content-addressed. If the hash is already
+        registered to another page (race between concurrent prefills of the
+        same prefix), keep the existing mapping. Returns the canonical page."""
+        existing = self.by_hash.get(block_hash)
+        if existing is not None and existing != page:
+            return existing
+        self.by_hash[block_hash] = page
+        self.hash_of[page] = block_hash
+        self.events.append(KvEvent("store", [block_hash], parent_hash))
+        return page
+
+    def release(self, pages: List[int]) -> None:
+        """Drop one reference; refcount-0 registered pages go to the LRU
+        cache, unregistered ones back to the free list."""
+        for p in pages:
+            r = self.ref.get(p, 0) - 1
+            if r > 0:
+                self.ref[p] = r
+                continue
+            self.ref.pop(p, None)
+            if p in self.hash_of:
+                self.cached[p] = None  # most-recently-used end
+                self.cached.move_to_end(p)
+            else:
+                self.free.append(p)
+
+    def drain_events(self) -> List[KvEvent]:
+        ev, self.events = self.events, []
+        return ev

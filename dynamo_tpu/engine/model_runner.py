@@ -1,0 +1,172 @@
+"""ModelRunner: compiled, sharded prefill/decode step functions.
+
+XLA-first execution model (SURVEY.md §7 "continuous batching under XLA's
+static shapes"):
+- every step shape is drawn from a fixed bucket set (decode batch buckets,
+  prefill chunk buckets) so each shape compiles once and is cached;
+- the paged KV pool is carried as two sharded jax.Arrays and **donated** on
+  every step — XLA updates it in place, no reallocation;
+- params are placed with the ShardingPolicy's megatron-style specs over the
+  (data, model, expert, seq) mesh; XLA inserts the per-block all-reduces
+  over ICI;
+- sampling runs fused at the end of the decode step, so one int32 per
+  sequence is the only per-token device→host transfer.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.sampling import SamplingParams, sample
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig, ShardingPolicy, make_mesh
+
+log = logging.getLogger("dynamo_tpu.engine.runner")
+
+
+def _next_bucket(buckets: Sequence[int], n: int) -> int:
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: ModelConfig,
+        mesh_config: Optional[MeshConfig] = None,
+        *,
+        num_pages: int = 512,
+        page_size: int = 16,
+        max_pages_per_seq: int = 128,
+        decode_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+        prefill_buckets: Sequence[int] = (16, 32, 64, 128, 256, 512, 1024),
+        dtype=jnp.bfloat16,
+        seed: int = 0,
+        params: Optional[Any] = None,
+        devices: Optional[list] = None,
+    ):
+        self.config = config
+        self.mesh_config = mesh_config or MeshConfig()
+        self.mesh = make_mesh(self.mesh_config, devices)
+        self.policy = ShardingPolicy(self.mesh)
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.decode_buckets = tuple(decode_buckets)
+        self.prefill_buckets = tuple(prefill_buckets)
+        self.dtype = dtype
+
+        t0 = time.monotonic()
+        if params is None:
+            params = llama.init_params(config, jax.random.PRNGKey(seed), dtype)
+        self.params = jax.device_put(params, self.policy.params_sharding(params))
+        # padding writes scatter to page index == num_pages, out of bounds,
+        # and are dropped (scatter mode="drop" in llama._write_kv)
+        k_pool, v_pool = llama.make_kv_pool(config, num_pages, page_size, dtype)
+        kv_sharding = self.policy.kv_pool_sharding()
+        self.k_pool = jax.device_put(k_pool, kv_sharding)
+        self.v_pool = jax.device_put(v_pool, kv_sharding)
+        log.info(
+            "runner ready: %s params+pool placed in %.1fs (mesh %s, %d pages x %d tokens)",
+            config.name, time.monotonic() - t0, self.mesh_config.shape, num_pages, page_size,
+        )
+
+        self._jit_forward = jax.jit(
+            partial(llama.forward, self.config),
+            donate_argnums=(3, 4),  # k_pool, v_pool
+        )
+        self._jit_sample = jax.jit(sample)
+
+    # -- steps -------------------------------------------------------------
+    def prefill(
+        self,
+        tokens: List[int],
+        start_pos: int,
+        page_table_row: List[int],
+        prior_len: int,
+    ) -> jax.Array:
+        """Run one prefill chunk for a single sequence. `tokens` are the
+        uncomputed prompt tokens starting at absolute position `start_pos`;
+        `prior_len` is the context length already in the pool (prefix-cache
+        hits + earlier chunks). Returns last-token logits [V] (device)."""
+        n = len(tokens)
+        S = _next_bucket(self.prefill_buckets, n)
+        tok = np.zeros((1, S), np.int32)
+        tok[0, :n] = tokens
+        pos = np.full((1, S), -1, np.int32)
+        pos[0, :n] = np.arange(start_pos, start_pos + n)
+        pt = self._pad_page_table([page_table_row])
+        kv_lens = np.asarray([prior_len + n], np.int32)
+
+        logits, self.k_pool, self.v_pool = self._jit_forward(
+            self.params, jnp.asarray(tok), jnp.asarray(pos),
+            self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kv_lens),
+        )
+        return logits[0, n - 1]
+
+    def decode(
+        self,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        kv_lens: List[int],
+        sampling: SamplingParams,
+        step: int,
+    ) -> np.ndarray:
+        """One decode step over the active batch (padded to a bucket).
+        Returns sampled token ids [B_bucket] (host numpy)."""
+        n = len(tokens)
+        B = _next_bucket(self.decode_buckets, n)
+        tok = np.zeros(B, np.int32)
+        tok[:n] = tokens
+        pos = np.full(B, -1, np.int32)
+        pos[:n] = positions
+        kvl = np.zeros(B, np.int32)
+        kvl[:n] = kv_lens
+        pt = self._pad_page_table(page_tables, B)
+
+        logits, self.k_pool, self.v_pool = self._jit_forward(
+            self.params, jnp.asarray(tok)[:, None], jnp.asarray(pos)[:, None],
+            self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kvl),
+        )
+        sampled = self._jit_sample(logits[:, 0, :], _pad_sampling(sampling, B), jnp.int32(step))
+        return np.asarray(jax.device_get(sampled))
+
+    def sample_one(self, logits: jax.Array, sampling: SamplingParams, step: int) -> int:
+        out = self._jit_sample(logits[None, :], sampling, jnp.int32(step))
+        return int(jax.device_get(out)[0])
+
+    def _pad_page_table(self, rows: List[List[int]], B: Optional[int] = None) -> np.ndarray:
+        B = B or len(rows)
+        pt = np.zeros((B, self.max_pages_per_seq), np.int32)
+        for i, row in enumerate(rows):
+            pt[i, : len(row)] = row
+        return pt
+
+    # -- memory ------------------------------------------------------------
+    def kv_pool_bytes(self) -> int:
+        return 2 * int(np.prod(self.k_pool.shape)) * self.k_pool.dtype.itemsize
+
+
+def _pad_sampling(s: SamplingParams, B: int) -> SamplingParams:
+    n = s.temperature.shape[0]
+    if n == B:
+        return s
+    pad = B - n
+    return SamplingParams(
+        temperature=jnp.pad(s.temperature, (0, pad)),
+        top_k=jnp.pad(s.top_k, (0, pad)),
+        top_p=jnp.pad(s.top_p, (0, pad), constant_values=1.0),
+        key=jnp.pad(s.key, ((0, pad), (0, 0))),
+    )

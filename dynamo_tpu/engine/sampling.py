@@ -1,0 +1,68 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+Runs fused at the end of the jitted decode step (logits never leave the
+device except as one sampled token id per sequence).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    """Per-sequence device-resident sampling state (arrays of shape [B])."""
+
+    temperature: jax.Array  # f32; 0 → greedy
+    top_k: jax.Array  # i32; 0 → disabled
+    top_p: jax.Array  # f32; 1.0 → disabled
+    key: jax.Array  # [B, 2] u32 PRNG keys
+
+    @classmethod
+    def make(cls, temperature, top_k, top_p, seeds) -> "SamplingParams":
+        return cls(
+            temperature=jnp.asarray(temperature, jnp.float32),
+            top_k=jnp.asarray(top_k, jnp.int32),
+            top_p=jnp.asarray(top_p, jnp.float32),
+            key=jax.vmap(lambda s: jax.random.key_data(jax.random.PRNGKey(s)))(
+                jnp.asarray(seeds, jnp.uint32)
+            ),
+        )
+
+
+def sample(logits: jax.Array, params: SamplingParams, step: jax.Array) -> jax.Array:
+    """logits [B, V] f32 → token ids [B] i32. `step` folds the decode step
+    index into each sequence's key so repeated calls draw fresh samples."""
+    B, V = logits.shape
+
+    def one(logit, temp, top_k, top_p, key_data):
+        key = jax.random.wrap_key_data(key_data, impl="threefry2x32")
+        key = jax.random.fold_in(key, step)
+
+        # top-k filter
+        def apply_top_k(l):
+            kth = jnp.sort(l)[V - jnp.clip(top_k, 1, V)]
+            return jnp.where(l < kth, -jnp.inf, l)
+
+        logit = jax.lax.cond(top_k > 0, apply_top_k, lambda l: l, logit)
+
+        # top-p (nucleus) filter
+        def apply_top_p(l):
+            sorted_l = jnp.sort(l)[::-1]
+            probs = jax.nn.softmax(sorted_l)
+            cum = jnp.cumsum(probs)
+            # keep tokens until cumulative prob exceeds top_p (always >= 1 tok)
+            cutoff_idx = jnp.sum(cum < top_p)
+            cutoff = sorted_l[jnp.clip(cutoff_idx, 0, V - 1)]
+            return jnp.where(l < cutoff, -jnp.inf, l)
+
+        logit = jax.lax.cond(top_p < 1.0, apply_top_p, lambda l: l, logit)
+
+        greedy = jnp.argmax(logit).astype(jnp.int32)
+        scaled = logit / jnp.maximum(temp, 1e-6)
+        sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+        return jnp.where(temp <= 0.0, greedy, sampled)
+
+    return jax.vmap(one)(logits, params.temperature, params.top_k, params.top_p, params.key)

@@ -1,0 +1,317 @@
+"""Continuous-batching scheduler with chunked prefill, prefix-cache reuse,
+and recompute-preemption.
+
+Pure host logic (no JAX): decides, per engine iteration, either one prefill
+chunk (single sequence) or one decode step (whole running batch) — the
+vLLM-style alternating schedule the reference's mocker also models
+(lib/mocker: "simulates KV allocation, prefix caching, batching,
+preemption"). The engine executes the plan on the ModelRunner.
+
+Invariants:
+- `computed_len` = tokens whose KV is in the pool. While RUNNING,
+  computed_len == len(tokens) - 1 (the newest sampled token's KV is written
+  by the next decode step).
+- prefix-matched pages are complete and shared (read-only); writes happen
+  only at positions >= computed_len, which always land on unshared pages.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from dynamo_tpu.engine.kv_pool import NoSpace, PagePool
+from dynamo_tpu.tokens.hashing import hash_block
+
+log = logging.getLogger("dynamo_tpu.engine.scheduler")
+
+
+class SeqState(Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class Sequence:
+    request_id: str
+    prompt: List[int]
+    sampling: Dict[str, Any]
+    stop: Dict[str, Any]
+    arrival: float = 0.0
+    state: SeqState = SeqState.WAITING
+    tokens: List[int] = field(default_factory=list)  # prompt + generated
+    pages: List[int] = field(default_factory=list)
+    computed_len: int = 0
+    n_shared_pages: int = 0  # leading pages from prefix-cache hits
+    hash_chain: List[int] = field(default_factory=list)  # registered block hashes
+    finish_reason: Optional[str] = None
+    n_preemptions: int = 0
+    n_prompt0: int = 0  # original prompt length (preemption rewrites prompt)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.tokens) - self.n_prompt0
+
+    @property
+    def prompt_remaining(self) -> int:
+        return max(0, len(self.prompt) - self.computed_len)
+
+
+@dataclass
+class PrefillPlan:
+    seq: Sequence
+    chunk: List[int]
+    start_pos: int
+    is_last_chunk: bool
+
+
+@dataclass
+class DecodePlan:
+    seqs: List[Sequence]
+
+
+@dataclass
+class SchedulerStats:
+    """Per-iteration ForwardPassMetrics feed (planner observes these)."""
+
+    n_waiting: int = 0
+    n_running: int = 0
+    scheduled_tokens: int = 0
+    kv_usage: float = 0.0
+
+
+class Scheduler:
+    def __init__(
+        self,
+        pool: PagePool,
+        *,
+        max_batch: int = 64,
+        chunk_size: int = 512,
+        max_seq_pages: int = 128,
+        enable_prefix_cache: bool = True,
+    ):
+        self.pool = pool
+        self.max_batch = max_batch
+        self.chunk_size = chunk_size
+        self.max_seq_pages = max_seq_pages
+        self.enable_prefix_cache = enable_prefix_cache
+        self.waiting: deque[Sequence] = deque()
+        self.active: List[Sequence] = []
+        self.stats = SchedulerStats()
+
+    # -- API ---------------------------------------------------------------
+    def add(self, seq: Sequence) -> None:
+        seq.tokens = list(seq.prompt)
+        seq.n_prompt0 = len(seq.prompt)
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> None:
+        for i, s in enumerate(self.active):
+            if s.request_id == request_id:
+                self._finish(s, "cancelled")
+                return
+        for s in list(self.waiting):
+            if s.request_id == request_id:
+                s.state = SeqState.FINISHED
+                s.finish_reason = "cancelled"
+                self.waiting.remove(s)
+                return
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.active)
+
+    def step_plan(self) -> Optional[PrefillPlan | DecodePlan]:
+        """Admit what fits, then plan this iteration's work."""
+        self._admit()
+        # prefill first: any active sequence with uncomputed prompt
+        for seq in self.active:
+            if seq.state == SeqState.PREFILL:
+                return self._plan_prefill(seq)
+        running = [s for s in self.active if s.state == SeqState.RUNNING]
+        if not running:
+            self._update_stats(0)
+            return None
+        running = self._ensure_decode_capacity(running)
+        if not running:
+            self._update_stats(0)
+            return None
+        self._update_stats(len(running))
+        return DecodePlan(running)
+
+    # -- admission ---------------------------------------------------------
+    def _admit(self) -> None:
+        while self.waiting and len(self.active) < self.max_batch:
+            seq = self.waiting[0]
+            if not self._try_allocate(seq):
+                break
+            self.waiting.popleft()
+            self.active.append(seq)
+            seq.state = SeqState.PREFILL
+
+    def _try_allocate(self, seq: Sequence) -> bool:
+        PS = self.pool.page_size
+        prompt = seq.prompt
+        matched_pages: List[int] = []
+        hashes: List[int] = []
+        if self.enable_prefix_cache and seq.n_preemptions == 0:
+            matched_pages, hashes = self.pool.match_prefix(prompt)
+            # never share the page containing the final prompt token: its
+            # logits must be recomputed, so cap the match below it
+            max_shared = (len(prompt) - 1) // PS
+            while len(matched_pages) > max_shared:
+                self.pool.release([matched_pages.pop()])
+                hashes.pop()
+        match_len = len(matched_pages) * PS
+        # pages for the rest of the prompt plus the first generated token
+        need = -(-(len(prompt) + 1) // PS) - len(matched_pages)
+        try:
+            fresh = self.pool.alloc(need)
+        except NoSpace:
+            self.pool.release(matched_pages)
+            return False
+        seq.pages = matched_pages + fresh
+        seq.n_shared_pages = len(matched_pages)
+        seq.hash_chain = hashes
+        seq.computed_len = match_len
+        return True
+
+    # -- prefill -----------------------------------------------------------
+    def _plan_prefill(self, seq: Sequence) -> PrefillPlan:
+        start = seq.computed_len
+        end = min(len(seq.prompt), start + self.chunk_size)
+        return PrefillPlan(
+            seq=seq,
+            chunk=seq.prompt[start:end],
+            start_pos=start,
+            is_last_chunk=end == len(seq.prompt),
+        )
+
+    def complete_prefill(self, plan: PrefillPlan) -> None:
+        seq = plan.seq
+        seq.computed_len += len(plan.chunk)
+        self._register_complete_pages(seq)
+        if plan.is_last_chunk:
+            seq.state = SeqState.RUNNING
+
+    # -- decode ------------------------------------------------------------
+    def _ensure_decode_capacity(self, running: List[Sequence]) -> List[Sequence]:
+        """Each running seq needs a page slot for position computed_len; on
+        pool exhaustion preempt the youngest sequences (recompute-style)."""
+        survivors: List[Sequence] = []
+        for seq in running:
+            if seq.state != SeqState.RUNNING:  # preempted by an earlier turn
+                continue
+            need_page = seq.computed_len // self.pool.page_size >= len(seq.pages)
+            if not need_page:
+                survivors.append(seq)
+                continue
+            while True:
+                try:
+                    seq.pages.extend(self.pool.alloc(1))
+                    survivors.append(seq)
+                    break
+                except NoSpace:
+                    victim = self._pick_victim(exclude=seq)
+                    if victim is None:
+                        self._preempt(seq)
+                        break
+                    self._preempt(victim)
+                    if victim in survivors:
+                        survivors.remove(victim)
+        return survivors
+
+    def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        for seq in reversed(self.active):  # youngest first
+            if seq is not exclude and seq.state == SeqState.RUNNING:
+                return seq
+        return None
+
+    def _preempt(self, seq: Sequence) -> None:
+        log.info("preempting %s (recompute)", seq.request_id)
+        self.pool.release(seq.pages)
+        seq.pages = []
+        seq.hash_chain = []
+        seq.n_shared_pages = 0
+        seq.computed_len = 0
+        seq.n_preemptions += 1
+        seq.state = SeqState.WAITING
+        # re-admit with prompt = all tokens so far (already-emitted ones are
+        # not re-emitted; generation resumes with the next sampled token)
+        seq.prompt = list(seq.tokens)
+        self.active.remove(seq)
+        self.waiting.appendleft(seq)
+
+    def complete_decode(
+        self, seq: Sequence, new_token: int, advance_computed: bool = True
+    ) -> Optional[str]:
+        """Append a sampled token; returns finish_reason if the engine-level
+        stop fires (frontend-level stop strings are handled downstream).
+
+        advance_computed=True for decode steps (the step wrote the fed
+        token's KV at position computed_len); False for the token sampled
+        from prefill logits (its KV is written by the *next* decode step) —
+        the invariant computed_len == len(tokens) - 1 must hold either way.
+        """
+        if advance_computed:
+            seq.computed_len += 1
+        seq.tokens.append(new_token)
+        self._register_complete_pages(seq)
+
+        stop = seq.stop or {}
+        reason = None
+        if (
+            not stop.get("ignore_eos")
+            and new_token in (stop.get("stop_ids") or [])
+            and seq.n_generated > int(stop.get("min_tokens") or 0)
+        ):
+            reason = "stop"
+        elif seq.n_generated >= int(stop.get("max_tokens", 1 << 30)):
+            reason = "length"
+        elif len(seq.tokens) >= self.max_seq_pages * self.pool.page_size:
+            reason = "length"
+        if reason:
+            self._finish(seq, reason)
+        return reason
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        seq.state = SeqState.FINISHED
+        seq.finish_reason = reason
+        self.pool.release(seq.pages)
+        seq.pages = []
+        if seq in self.active:
+            self.active.remove(seq)
+
+    # -- prefix registration ----------------------------------------------
+    def _register_complete_pages(self, seq: Sequence) -> None:
+        """Register pages that became complete (content-addressed) so other
+        requests can share them; source of router 'store' events."""
+        if not self.enable_prefix_cache:
+            return
+        PS = self.pool.page_size
+        n_complete = min(seq.computed_len // PS, len(seq.pages))
+        while len(seq.hash_chain) < n_complete:
+            i = len(seq.hash_chain)
+            parent = seq.hash_chain[-1] if seq.hash_chain else None
+            h = hash_block(parent, seq.tokens[i * PS : (i + 1) * PS])
+            canonical = self.pool.register(seq.pages[i], h, parent)
+            if canonical != seq.pages[i]:
+                # another seq registered this block first; swap to the
+                # canonical page and free ours
+                self.pool._ref_inc(canonical)
+                self.pool.release([seq.pages[i]])
+                seq.pages[i] = canonical
+            seq.hash_chain.append(h)
+
+    # -- stats -------------------------------------------------------------
+    def _update_stats(self, scheduled: int) -> None:
+        self.stats = SchedulerStats(
+            n_waiting=len(self.waiting),
+            n_running=len([s for s in self.active if s.state == SeqState.RUNNING]),
+            scheduled_tokens=scheduled,
+            kv_usage=self.pool.usage(),
+        )

@@ -1,0 +1,5 @@
+"""TPU-native model definitions (functional JAX; params are pytrees)."""
+
+from dynamo_tpu.models.config import ModelConfig, PRESETS, get_config
+
+__all__ = ["ModelConfig", "PRESETS", "get_config"]

@@ -1,0 +1,87 @@
+"""Model architecture configs (Llama family first; MoE fields for
+DeepSeek/Mixtral-style wide-EP later)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny"
+    vocab_size: int = 512
+    dim: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    ffn_dim: int = 128
+    max_seq_len: int = 2048
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    # MoE (0 experts = dense)
+    n_experts: int = 0
+    n_experts_active: int = 0
+    moe_ffn_dim: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+
+PRESETS: Dict[str, ModelConfig] = {
+    # test-size model (CPU-mesh CI)
+    "tiny": ModelConfig(),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", n_experts=4, n_experts_active=2, moe_ffn_dim=96
+    ),
+    # Llama 3.2 1B (fits one v5e chip in bf16 with room for KV)
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b",
+        vocab_size=128256,
+        dim=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=8192,
+        max_seq_len=131072,
+        rope_theta=500000.0,
+        tie_embeddings=True,
+    ),
+    # Llama 3.1 8B (reference BASELINE config #1 model)
+    "llama-3.1-8b": ModelConfig(
+        name="llama-3.1-8b",
+        vocab_size=128256,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        max_seq_len=131072,
+    ),
+    # Llama 3.1 70B (BASELINE north-star model; TP=8 on v5e)
+    "llama-3.1-70b": ModelConfig(
+        name="llama-3.1-70b",
+        vocab_size=128256,
+        dim=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        ffn_dim=28672,
+        max_seq_len=131072,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model config {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]

@@ -1,0 +1,254 @@
+"""Llama-family transformer, functional JAX with a paged KV cache.
+
+TPU-first design notes:
+- Layer params are **stacked** on a leading [n_layers] axis and the forward
+  runs `lax.scan` over layers → one compiled layer body, fast XLA compiles
+  even at 80 layers, and scan-carried KV pool updates.
+- The KV cache is a global paged pool `[L, num_pages, page_size, Hk, Dh]`;
+  sequences own pages via a page table (flat position p lives at
+  `page_table[p // page_size], p % page_size`). Gathered attention reads are
+  the jnp reference path; the Pallas ragged-paged-attention kernel
+  (dynamo_tpu/ops) replaces them on TPU.
+- GQA, RoPE (HF half-rotation convention), RMSNorm(fp32), SwiGLU; bf16
+  params/activations, fp32 softmax and logits.
+
+The reference framework delegates all of this to vLLM/SGLang/TRT-LLM
+(SURVEY.md: "the engine layer is the reference's biggest delegated
+dependency"); this module is the native TPU replacement.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init params (benchmarks / tests; checkpoint loading in
+    engine/weights.py replaces values with the same tree structure)."""
+    c = config
+    k = jax.random.split(key, 12)
+    hd = c.head_dim
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def w(key, fan_in, *shape):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
+
+    L = c.n_layers
+    params: Params = {
+        "embed": w(k[0], c.dim, c.vocab_size, c.dim),
+        "layers": {
+            "attn_norm": norm_init(L, c.dim),
+            "wq": w(k[1], c.dim, L, c.dim, c.n_heads * hd),
+            "wk": w(k[2], c.dim, L, c.dim, c.n_kv_heads * hd),
+            "wv": w(k[3], c.dim, L, c.dim, c.n_kv_heads * hd),
+            "wo": w(k[4], c.n_heads * hd, L, c.n_heads * hd, c.dim),
+            "mlp_norm": norm_init(L, c.dim),
+        },
+        "norm_f": norm_init(c.dim),
+    }
+    if c.is_moe:
+        params["layers"].update(
+            {
+                "w_router": w(k[5], c.dim, L, c.dim, c.n_experts),
+                "we_gate": w(k[6], c.dim, L, c.n_experts, c.dim, c.moe_ffn_dim),
+                "we_up": w(k[7], c.dim, L, c.n_experts, c.dim, c.moe_ffn_dim),
+                "we_down": w(k[8], c.moe_ffn_dim, L, c.n_experts, c.moe_ffn_dim, c.dim),
+            }
+        )
+    else:
+        params["layers"].update(
+            {
+                "w_gate": w(k[5], c.dim, L, c.dim, c.ffn_dim),
+                "w_up": w(k[6], c.dim, L, c.dim, c.ffn_dim),
+                "w_down": w(k[7], c.ffn_dim, L, c.ffn_dim, c.dim),
+            }
+        )
+    if not c.tie_embeddings:
+        params["lm_head"] = w(k[9], c.dim, c.dim, c.vocab_size)
+    return params
+
+
+def make_kv_pool(
+    config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+) -> Tuple[jax.Array, jax.Array]:
+    shape = (config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim)
+    return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# building blocks
+# --------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    normed = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (normed * weight).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """HF-Llama half-rotation RoPE. x: [..., S, n_heads, head_dim],
+    positions: [..., S]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = jnp.arange(0, half, dtype=jnp.float32) / half
+    inv_freq = theta**-freqs  # [half]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., S, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def paged_attention_jnp(
+    q: jax.Array,  # [B, S, Hk, G, Dh] (grouped query heads)
+    k_pool_l: jax.Array,  # [NP, PS, Hk, Dh] one layer's key pool
+    v_pool_l: jax.Array,
+    page_table: jax.Array,  # [B, MP] int32
+    q_positions: jax.Array,  # [B, S] absolute positions of the queries
+    kv_lens: jax.Array,  # [B] context length (tokens valid in pool)
+) -> jax.Array:
+    """Reference (jnp gather) paged attention with causal masking by
+    absolute position. Flat context index c == absolute position c because
+    page tables map positions in order. Returns [B, S, Hk, G, Dh]."""
+    NP, PS, Hk, Dh = k_pool_l.shape
+    B, MP = page_table.shape
+    C = MP * PS
+    k = k_pool_l[page_table].reshape(B, C, Hk, Dh)
+    v = v_pool_l[page_table].reshape(B, C, Hk, Dh)
+
+    scale = Dh**-0.5
+    scores = jnp.einsum("bskgd,bckd->bkgsc", q, k).astype(jnp.float32) * scale
+    ctx_pos = jnp.arange(C, dtype=jnp.int32)
+    valid = (ctx_pos[None, :] < kv_lens[:, None])[:, None, None, None, :]
+    causal = ctx_pos[None, None, :] <= q_positions[:, :, None]  # [B,S,C]
+    mask = valid & causal[:, None, None, :, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgsc,bckd->bskgd", probs, v)
+
+
+def _write_kv(pool_l, new, page_table, positions):
+    """Scatter new KV into a layer pool. new: [B, S, Hk, Dh]; positions:
+    [B, S] absolute positions, -1 marks padding (dropped via out-of-bounds
+    scatter + mode='drop')."""
+    NP, PS, Hk, Dh = pool_l.shape
+    B, S = positions.shape
+    MP = page_table.shape[1]
+    valid = positions >= 0
+    pos = jnp.maximum(positions, 0)
+    page_of_pos = jnp.clip((pos // PS).astype(jnp.int32), 0, MP - 1)
+    page_idx = jnp.take_along_axis(page_table, page_of_pos, axis=1)  # [B, S]
+    page_idx = jnp.where(valid, page_idx, NP)  # OOB → dropped
+    slot = (pos % PS).astype(jnp.int32)
+    return pool_l.at[page_idx.reshape(-1), slot.reshape(-1)].set(
+        new.reshape(B * S, Hk, Dh), mode="drop"
+    )
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def forward(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, S]
+    positions: jax.Array,  # [B, S] absolute positions (padding = -1)
+    k_pool: jax.Array,  # [L, NP, PS, Hk, Dh]
+    v_pool: jax.Array,
+    page_table: jax.Array,  # [B, MP]
+    kv_lens: jax.Array,  # [B] context length AFTER this step's tokens
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One forward pass (covers prefill chunks S>1 and decode S=1).
+
+    Writes this step's K/V into the pool pages, attends over the full
+    context, returns (logits[B, S, V], k_pool, v_pool). Padding tokens
+    (position < 0) are dropped from pool writes via scatter mode='drop'.
+    """
+    c = config
+    B, S = tokens.shape
+    hd = c.head_dim
+    G = c.n_heads // c.n_kv_heads
+
+    h = params["embed"][tokens]  # [B, S, E] (gather)
+    safe_pos = jnp.maximum(positions, 0)
+
+    def layer(h, xs):
+        lp, k_pool_l, v_pool_l = xs
+        x = rms_norm(h, lp["attn_norm"], c.norm_eps)
+        q = (x @ lp["wq"]).reshape(B, S, c.n_heads, hd)
+        k = (x @ lp["wk"]).reshape(B, S, c.n_kv_heads, hd)
+        v = (x @ lp["wv"]).reshape(B, S, c.n_kv_heads, hd)
+        q = rope(q, safe_pos, c.rope_theta)
+        k = rope(k, safe_pos, c.rope_theta)
+
+        k_pool_l = _write_kv(k_pool_l, k, page_table, positions)
+        v_pool_l = _write_kv(v_pool_l, v, page_table, positions)
+
+        qg = q.reshape(B, S, c.n_kv_heads, G, hd)
+        attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
+        attn = attn.reshape(B, S, c.n_heads * hd)
+        h = h + attn @ lp["wo"]
+
+        x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
+        if c.is_moe:
+            h = h + _moe_block(c, lp, x)
+        else:
+            gate = jax.nn.silu(x @ lp["w_gate"])
+            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+        return h, (k_pool_l, v_pool_l)
+
+    h, (k_pool, v_pool) = lax.scan(layer, h, (params["layers"], k_pool, v_pool))
+
+    h = rms_norm(h, params["norm_f"], c.norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:  # tied embeddings
+        logits = h @ params["embed"].T
+    else:
+        logits = h @ lm_head
+    return logits.astype(jnp.float32), k_pool, v_pool
+
+
+def _moe_block(c: ModelConfig, lp, x: jax.Array) -> jax.Array:
+    """Token-choice top-k MoE (dense compute over experts for now; the
+    shard_map all-to-all EP path lands with the wide-EP milestone). x:
+    [B, S, E] → [B, S, E]."""
+    B, S, E = x.shape
+    router_logits = (x @ lp["w_router"]).astype(jnp.float32)  # [B,S,n_exp]
+    weights, sel = lax.top_k(router_logits, c.n_experts_active)
+    weights = jax.nn.softmax(weights, axis=-1).astype(x.dtype)
+
+    # compute every expert on every token (fine at test scale; EP replaces it)
+    def one_expert(we_gate, we_up, we_down):
+        gate = jax.nn.silu(x @ we_gate)
+        return (gate * (x @ we_up)) @ we_down  # [B,S,E]
+
+    expert_out = jax.vmap(one_expert)(lp["we_gate"], lp["we_up"], lp["we_down"])
+    # expert_out: [n_exp, B, S, E]; select & mix
+    sel_out = jnp.take_along_axis(
+        expert_out.transpose(1, 2, 0, 3),  # [B,S,n_exp,E]
+        sel[..., None].astype(jnp.int32),
+        axis=2,
+    )  # [B,S,k,E]
+    return jnp.sum(sel_out * weights[..., None], axis=2)

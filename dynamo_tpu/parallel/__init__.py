@@ -1,0 +1,11 @@
+"""Device mesh + sharding policy (TPU-first parallelism layer).
+
+The reference delegates intra-model sharding to its engines (SURVEY.md
+§2.10); here it is first-class: a named `jax.sharding.Mesh` with axes
+(data, model, expert, seq) and PartitionSpec policies for params,
+activations, and the paged KV pool. XLA inserts the collectives over ICI.
+"""
+
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh, ShardingPolicy
+
+__all__ = ["MeshConfig", "make_mesh", "ShardingPolicy"]

@@ -1,0 +1,115 @@
+"""Named device mesh + sharding policy.
+
+Mesh axes (SURVEY.md §2.10 parallelism inventory):
+  data   — DP / attention-DP replicas (router targets (worker, dp_rank))
+  model  — tensor parallelism (megatron-style column/row splits)
+  expert — MoE expert parallelism (all-to-all over ICI)
+  seq    — sequence/context parallelism (ring attention)
+
+On a v5e-64 slice a typical decode mesh is (data=2, model=8, expert=1,
+seq=1) per 16-chip group; the policy below maps Llama-family params onto
+(model) and the paged KV pool onto kv-heads×(model).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_EXPERT = "expert"
+AXIS_SEQ = "seq"
+ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_EXPERT, AXIS_SEQ)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    data: int = 1
+    model: int = 1
+    expert: int = 1
+    seq: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.model * self.expert * self.seq
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int]:
+        return (self.data, self.model, self.expert, self.seq)
+
+
+def make_mesh(config: MeshConfig, devices: Optional[list] = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < config.n_devices:
+        raise ValueError(
+            f"mesh {config.shape} needs {config.n_devices} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[: config.n_devices]).reshape(config.shape)
+    return Mesh(arr, ALL_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(MeshConfig())
+
+
+@dataclass
+class ShardingPolicy:
+    """PartitionSpecs for a transformer served on the mesh.
+
+    Column-parallel projections shard their output dim on `model`;
+    row-parallel shard their input dim — XLA emits the single all-reduce per
+    block (attention out-proj + MLP down-proj), the standard megatron split.
+    The paged KV pool shards kv-heads on `model` so decode attention needs
+    no cross-chip traffic for cache reads.
+    """
+
+    mesh: Mesh
+
+    # -- params ------------------------------------------------------------
+    def param_spec(self, path: str) -> P:
+        """Spec by parameter name; used via tree_map_with_path."""
+        if path.endswith(("wq", "wk", "wv", "w_gate", "w_up")):
+            return P(None, AXIS_MODEL)  # column parallel [E, out]
+        if path.endswith(("wo", "w_down")):
+            return P(AXIS_MODEL, None)  # row parallel [in, E]
+        if path.endswith("embed"):
+            return P(None, AXIS_MODEL)  # [V, E] shard E
+        if path.endswith("lm_head"):
+            return P(None, AXIS_MODEL)  # [E, V] shard V
+        if path.endswith("w_router"):
+            return P(None, None)  # MoE router stays replicated
+        if path.endswith(("we_gate", "we_up")):
+            return P(AXIS_EXPERT, None, AXIS_MODEL)  # [n_exp, E, F]
+        if path.endswith("we_down"):
+            return P(AXIS_EXPERT, AXIS_MODEL, None)  # [n_exp, F, E]
+        return P()  # norms, scalars: replicated
+
+    def params_sharding(self, params) -> dict:
+        def _one(path_tuple, leaf):
+            path = "/".join(str(getattr(k, "key", k)) for k in path_tuple)
+            return NamedSharding(self.mesh, self.param_spec(path))
+
+        return jax.tree_util.tree_map_with_path(_one, params)
+
+    # -- kv cache ----------------------------------------------------------
+    def kv_pool_spec(self) -> P:
+        # [layers, num_pages, page_size, kv_heads, head_dim]
+        return P(None, None, None, AXIS_MODEL, None)
+
+    def kv_pool_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.kv_pool_spec())
+
+    # -- activations -------------------------------------------------------
+    def batch_spec(self) -> P:
+        return P(AXIS_DATA)  # [B, ...] sharded over data axis
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec())

@@ -1,0 +1,11 @@
+"""Token-block hashing contract (analog of reference lib/tokens +
+lib/kv-hashing): the single block-identity definition shared by the engine's
+prefix cache, the KV router's indexer, and the tiered block manager."""
+
+from dynamo_tpu.tokens.hashing import (
+    block_hashes,
+    hash_block,
+    BLOCK_HASH_SEED,
+)
+
+__all__ = ["block_hashes", "hash_block", "BLOCK_HASH_SEED"]

@@ -1,0 +1,39 @@
+"""Positional lineage hashing of token blocks.
+
+Analog of reference lib/kv-hashing (lib/kv-hashing/src/lib.rs:6-12): a pure
+`tokens → [block_hash]` computation that every component agrees on — the
+router indexes these hashes, the engine's prefix cache registers pages under
+them, and KV events carry them on the wire.
+
+Hash i covers tokens [0, (i+1)*block_size) by chaining: each block hash
+mixes the parent block's hash with this block's token ids, so equal hashes
+imply equal full prefixes (lineage), not just equal block contents. u64
+values (msgpack/wire friendly); blake2b-8 keyed with a fixed seed so every
+process computes identical hashes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import List, Optional, Sequence
+
+BLOCK_HASH_SEED = b"dynamo-tpu-kv-v1"
+
+
+def hash_block(parent_hash: Optional[int], tokens: Sequence[int]) -> int:
+    h = hashlib.blake2b(digest_size=8, key=BLOCK_HASH_SEED)
+    if parent_hash is not None:
+        h.update(struct.pack("<Q", parent_hash))
+    h.update(struct.pack(f"<{len(tokens)}I", *[t & 0xFFFFFFFF for t in tokens]))
+    return struct.unpack("<Q", h.digest())[0]
+
+
+def block_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+    """Hashes for every *complete* block of `tokens`."""
+    out: List[int] = []
+    parent: Optional[int] = None
+    for i in range(len(tokens) // block_size):
+        parent = hash_block(parent, tokens[i * block_size : (i + 1) * block_size])
+        out.append(parent)
+    return out

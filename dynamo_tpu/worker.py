@@ -1,0 +1,111 @@
+"""`python -m dynamo_tpu.worker` — native TPU engine worker process.
+
+Analog of reference `python -m dynamo.vllm` (components/src/dynamo/vllm/
+main.py worker startup call stack, SURVEY.md §3.2), with the JAX engine in
+place of vLLM: parse args → build runner/engine → register model card in
+discovery → serve the generate endpoint over the request plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.engine.model_runner import ModelRunner
+from dynamo_tpu.frontend.protocols import ModelCard
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.parallel.mesh import MeshConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging_util import configure_logging
+
+log = logging.getLogger("dynamo_tpu.worker")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.worker")
+    p.add_argument("--model", default="tiny", help="model config preset name")
+    p.add_argument("--model-name", default=None, help="served model name (default: config name)")
+    p.add_argument("--namespace", default="dyn")
+    p.add_argument("--component", default="tpu-worker")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--tokenizer", default="byte", help="'byte' or path to tokenizer.json")
+    # parallelism (mesh axes)
+    p.add_argument("--data-parallel", type=int, default=1)
+    p.add_argument("--tensor-parallel", type=int, default=1)
+    p.add_argument("--expert-parallel", type=int, default=1)
+    p.add_argument("--seq-parallel", type=int, default=1)
+    # KV cache
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=4096)
+    # batching
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--chunk-size", type=int, default=512)
+    # infra
+    p.add_argument("--discovery-backend", default=None)
+    p.add_argument("--discovery-root", default=None)
+    return p.parse_args(argv)
+
+
+def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
+    config = get_config(args.model)
+    mesh = MeshConfig(
+        data=args.data_parallel,
+        model=args.tensor_parallel,
+        expert=args.expert_parallel,
+        seq=args.seq_parallel,
+    )
+    max_pages_per_seq = -(-args.max_seq_len // args.page_size)
+    runner = ModelRunner(
+        config,
+        mesh,
+        num_pages=args.num_pages,
+        page_size=args.page_size,
+        max_pages_per_seq=max_pages_per_seq,
+    )
+    engine = InferenceEngine(runner, max_batch=args.max_batch, chunk_size=args.chunk_size)
+    card = ModelCard(
+        name=args.model_name or config.name,
+        tokenizer=args.tokenizer,
+        context_length=args.max_seq_len,
+        kv_block_size=args.page_size,
+        runtime_config={
+            "mesh": list(mesh.shape),
+            "num_pages": args.num_pages,
+            "max_batch": args.max_batch,
+        },
+    )
+    return engine, card
+
+
+async def async_main(args) -> None:
+    configure_logging()
+    kw = {}
+    if args.discovery_root:
+        kw["root"] = args.discovery_root
+    runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
+    engine, card = build_engine(args)
+    engine.start()
+    path = f"{args.namespace}/{args.component}/{args.endpoint}"
+    await runtime.serve_endpoint(path, engine, metadata={"model_card": card.to_dict()})
+    print(f"worker serving {card.name} at {path}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        engine.stop()
+        await runtime.shutdown()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(async_main(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()
