@@ -1,0 +1,94 @@
+"""Benchmark: native engine decode throughput on the local accelerator.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Measures steady-state batched decode throughput (tokens/second) of the
+Llama-3.2-1B configuration in bf16 with the paged KV cache, batch 32 —
+the per-chip engine hot loop that aggregate goodput is built from.
+
+vs_baseline: ratio against 1000 tok/s, a proxy for a single H100 running a
+1B-class model under vLLM at the same batch (the reference stack's engine
+tier; BASELINE.md publishes no directly comparable single-accelerator
+scalar). >1.0 = faster than the proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+PROXY_BASELINE_TOK_S = 1000.0
+
+
+def main() -> None:
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.models.config import get_config
+
+    B = 32
+    prompt_len = 128
+    decode_steps = 128
+    page_size = 16
+    max_pages = 32  # 512-token max context for the bench
+
+    config = get_config("llama-3.2-1b")
+    runner = ModelRunner(
+        config,
+        num_pages=B * max_pages + 8,
+        page_size=page_size,
+        max_pages_per_seq=max_pages,
+        decode_buckets=(B,),
+        prefill_buckets=(prompt_len,),
+        seed=0,
+    )
+
+    rng = np.random.default_rng(0)
+    sampling = SamplingParams.make(
+        temperature=[1.0] * B, top_k=[0] * B, top_p=[1.0] * B, seeds=list(range(B))
+    )
+
+    # per-seq page tables (disjoint)
+    tables = [list(range(i * max_pages, i * max_pages + max_pages)) for i in range(B)]
+
+    # prefill each sequence once (fills KV to prompt_len)
+    for i in range(B):
+        prompt = rng.integers(1, config.vocab_size, prompt_len).tolist()
+        runner.prefill(prompt, 0, tables[i], prior_len=0)
+
+    tokens = rng.integers(1, config.vocab_size, B).tolist()
+    lens = [prompt_len] * B
+    T = 16  # fused decode steps per dispatch (engine multi-step decode)
+
+    def run_fused(step_idx):
+        nonlocal tokens, lens
+        out = runner.decode_multi(T, tokens, lens, tables, sampling, step_idx)
+        tokens = [int(t) for t in out[:B, -1]]
+        lens = [l + T for l in lens]
+
+    # warmup (compile); decode_multi device_gets, which is the honest sync
+    run_fused(0)
+
+    n_dispatch = decode_steps // T
+    t0 = time.perf_counter()
+    for s in range(n_dispatch):
+        run_fused(1 + s * T)
+    dt = time.perf_counter() - t0
+
+    tok_s = B * n_dispatch * T / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_throughput_{config.name}_bf16_b{B}",
+                "value": round(tok_s, 1),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / PROXY_BASELINE_TOK_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

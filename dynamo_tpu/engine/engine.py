@@ -60,6 +60,7 @@ class InferenceEngine:
         *,
         max_batch: int = 64,
         chunk_size: int = 512,
+        decode_steps: int = 4,
         idle_sleep_s: float = 0.002,
     ):
         self.runner = runner
@@ -69,6 +70,7 @@ class InferenceEngine:
             max_batch=max_batch,
             chunk_size=chunk_size,
             max_seq_pages=runner.max_pages_per_seq,
+            decode_steps=decode_steps,
         )
         self.idle_sleep_s = idle_sleep_s
         self._inbox: thread_queue.Queue = thread_queue.Queue()
@@ -191,19 +193,29 @@ class InferenceEngine:
             self._emit(seq, [token] if emitted is not None else [], reason)
 
     def _run_decode(self, plan: DecodePlan) -> None:
+        """Fused multi-step decode: plan.n_steps iterations in one jit with
+        on-device token feedback (one host sync per plan, not per token).
+        Tokens sampled past a stop are discarded host-side."""
         seqs = plan.seqs
+        T = plan.n_steps
         tokens = [s.tokens[-1] for s in seqs]
         positions = [s.computed_len for s in seqs]
         page_tables = [s.pages for s in seqs]
-        kv_lens = [s.computed_len + 1 for s in seqs]
-        sampled = self.runner.decode(
-            tokens, positions, page_tables, kv_lens,
-            _sampling_params(seqs), self._next_step(),
+        step0 = self._step_counter + 1
+        self._step_counter += T
+        sampled = self.runner.decode_multi(
+            T, tokens, positions, page_tables, _sampling_params(seqs), step0
         )
         for i, seq in enumerate(seqs):
-            token = int(sampled[i])
-            reason = self.scheduler.complete_decode(seq, token)
-            emit = [] if reason == "stop" else [token]
+            emit: List[int] = []
+            reason = None
+            for j in range(T):
+                token = int(sampled[i, j])
+                reason = self.scheduler.complete_decode(seq, token)
+                if reason != "stop":
+                    emit.append(token)
+                if reason:
+                    break
             self._emit(seq, emit, reason)
 
     def _next_step(self) -> int:

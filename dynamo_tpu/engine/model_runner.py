@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine.sampling import SamplingParams, sample
@@ -31,6 +32,39 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.parallel.mesh import MeshConfig, ShardingPolicy, make_mesh
 
 log = logging.getLogger("dynamo_tpu.engine.runner")
+
+
+def _decode_loop(
+    config: ModelConfig,
+    n_steps: int,
+    params,
+    tokens0,  # [B] current token per seq
+    positions0,  # [B] write position of tokens0 (-1 = padding slot)
+    k_pool,
+    v_pool,
+    page_table,  # [B, MP]
+    sampling: SamplingParams,
+    step0,  # scalar int32 PRNG step base
+):
+    """n_steps decode iterations fused in one jit: forward → sample → feed
+    the sampled token back, entirely on device (lax.scan). Amortizes the
+    per-dispatch host sync (dominant through remote-TPU links) over n_steps
+    tokens. Returns (tokens [B, n_steps], k_pool, v_pool)."""
+
+    def body(carry, t):
+        tok, kp, vp = carry
+        pos = jnp.where(positions0 < 0, -1, positions0 + t)
+        kvl = jnp.where(positions0 < 0, 0, positions0 + t + 1)
+        logits, kp, vp = llama.forward(
+            config, params, tok[:, None], pos[:, None], kp, vp, page_table, kvl
+        )
+        s = sample(logits[:, 0, :], sampling, step0 + t)
+        return (s, kp, vp), s
+
+    (_, k_pool, v_pool), toks = lax.scan(
+        body, (tokens0, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32)
+    )
+    return toks.T, k_pool, v_pool  # [B, n_steps]
 
 
 def _next_bucket(buckets: Sequence[int], n: int) -> int:
@@ -87,6 +121,11 @@ class ModelRunner:
             donate_argnums=(3, 4),  # k_pool, v_pool
         )
         self._jit_sample = jax.jit(sample)
+        self._jit_decode_loop = jax.jit(
+            partial(_decode_loop, self.config),
+            static_argnums=(0,),  # n_steps
+            donate_argnums=(4, 5),  # k_pool, v_pool
+        )
 
     # -- steps -------------------------------------------------------------
     def prefill(
@@ -112,8 +151,9 @@ class ModelRunner:
         logits, self.k_pool, self.v_pool = self._jit_forward(
             self.params, jnp.asarray(tok), jnp.asarray(pos),
             self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kv_lens),
+            jnp.int32(n - 1),
         )
-        return logits[0, n - 1]
+        return logits[0, 0]
 
     def decode(
         self,
@@ -142,6 +182,33 @@ class ModelRunner:
         )
         sampled = self._jit_sample(logits[:, 0, :], _pad_sampling(sampling, B), jnp.int32(step))
         return np.asarray(jax.device_get(sampled))
+
+    def decode_multi(
+        self,
+        n_steps: int,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        sampling: SamplingParams,
+        step: int,
+    ) -> np.ndarray:
+        """n_steps fused decode iterations (one host sync total). Page
+        tables must already cover positions[i] + n_steps slots. Returns
+        sampled tokens [B_bucket, n_steps]."""
+        n = len(tokens)
+        B = _next_bucket(self.decode_buckets, n)
+        tok = np.zeros(B, np.int32)
+        tok[:n] = tokens
+        pos = np.full(B, -1, np.int32)
+        pos[:n] = positions
+        pt = self._pad_page_table(page_tables, B)
+
+        toks, self.k_pool, self.v_pool = self._jit_decode_loop(
+            n_steps, self.params, jnp.asarray(tok), jnp.asarray(pos),
+            self.k_pool, self.v_pool, jnp.asarray(pt),
+            _pad_sampling(sampling, B), jnp.int32(step),
+        )
+        return np.asarray(jax.device_get(toks))
 
     def sample_one(self, logits: jax.Array, sampling: SamplingParams, step: int) -> int:
         out = self._jit_sample(logits[None, :], sampling, jnp.int32(step))

@@ -73,6 +73,7 @@ class PrefillPlan:
 @dataclass
 class DecodePlan:
     seqs: List[Sequence]
+    n_steps: int = 1  # fused decode iterations (multi-step decode)
 
 
 @dataclass
@@ -94,12 +95,14 @@ class Scheduler:
         chunk_size: int = 512,
         max_seq_pages: int = 128,
         enable_prefix_cache: bool = True,
+        decode_steps: int = 1,
     ):
         self.pool = pool
         self.max_batch = max_batch
         self.chunk_size = chunk_size
         self.max_seq_pages = max_seq_pages
         self.enable_prefix_cache = enable_prefix_cache
+        self.decode_steps = decode_steps
         self.waiting: deque[Sequence] = deque()
         self.active: List[Sequence] = []
         self.stats = SchedulerStats()
@@ -136,12 +139,22 @@ class Scheduler:
         if not running:
             self._update_stats(0)
             return None
-        running = self._ensure_decode_capacity(running)
+        # fuse up to decode_steps iterations, bounded by the per-seq budget
+        # remaining (max_tokens / context cap) so fused steps aren't wasted
+        cap = self.max_seq_pages * self.pool.page_size
+        n_steps = self.decode_steps
+        for s in running:
+            budget = min(
+                cap - s.computed_len,
+                int((s.stop or {}).get("max_tokens", 1 << 30)) - s.n_generated,
+            )
+            n_steps = min(n_steps, max(1, budget))
+        running = self._ensure_decode_capacity(running, lookahead=n_steps)
         if not running:
             self._update_stats(0)
             return None
-        self._update_stats(len(running))
-        return DecodePlan(running)
+        self._update_stats(len(running) * n_steps)
+        return DecodePlan(running, n_steps)
 
     # -- admission ---------------------------------------------------------
     def _admit(self) -> None:
@@ -199,20 +212,24 @@ class Scheduler:
             seq.state = SeqState.RUNNING
 
     # -- decode ------------------------------------------------------------
-    def _ensure_decode_capacity(self, running: List[Sequence]) -> List[Sequence]:
-        """Each running seq needs a page slot for position computed_len; on
-        pool exhaustion preempt the youngest sequences (recompute-style)."""
+    def _ensure_decode_capacity(
+        self, running: List[Sequence], lookahead: int = 1
+    ) -> List[Sequence]:
+        """Each running seq needs page slots for positions computed_len ..
+        computed_len+lookahead-1; on pool exhaustion preempt the youngest
+        sequences (recompute-style)."""
         survivors: List[Sequence] = []
         for seq in running:
             if seq.state != SeqState.RUNNING:  # preempted by an earlier turn
                 continue
-            need_page = seq.computed_len // self.pool.page_size >= len(seq.pages)
-            if not need_page:
-                survivors.append(seq)
-                continue
+            last_pos = seq.computed_len + lookahead - 1
             while True:
+                need = last_pos // self.pool.page_size + 1 - len(seq.pages)
+                if need <= 0:
+                    survivors.append(seq)
+                    break
                 try:
-                    seq.pages.extend(self.pool.alloc(1))
+                    seq.pages.extend(self.pool.alloc(need))
                     survivors.append(seq)
                     break
                 except NoSpace:

@@ -179,12 +179,16 @@ def forward(
     v_pool: jax.Array,
     page_table: jax.Array,  # [B, MP]
     kv_lens: jax.Array,  # [B] context length AFTER this step's tokens
+    last_index: Optional[jax.Array] = None,  # scalar: only compute logits here
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass (covers prefill chunks S>1 and decode S=1).
 
     Writes this step's K/V into the pool pages, attends over the full
     context, returns (logits[B, S, V], k_pool, v_pool). Padding tokens
     (position < 0) are dropped from pool writes via scatter mode='drop'.
+    With `last_index` (prefill), the vocab projection runs on that single
+    position only — logits come back [B, 1, V], skipping S-1 lm_head
+    matmuls over a 100k+ vocab.
     """
     c = config
     B, S = tokens.shape
@@ -222,6 +226,8 @@ def forward(
     h, (k_pool, v_pool) = lax.scan(layer, h, (params["layers"], k_pool, v_pool))
 
     h = rms_norm(h, params["norm_f"], c.norm_eps)
+    if last_index is not None:
+        h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, E]
     lm_head = params.get("lm_head")
     if lm_head is None:  # tied embeddings
         logits = h @ params["embed"].T

@@ -72,21 +72,23 @@ class ShardingPolicy:
 
     # -- params ------------------------------------------------------------
     def param_spec(self, path: str) -> P:
-        """Spec by parameter name; used via tree_map_with_path."""
+        """Spec by parameter name. Per-layer weights are stacked on a
+        leading [n_layers] axis (models/llama.py), so layer params carry a
+        leading None."""
         if path.endswith(("wq", "wk", "wv", "w_gate", "w_up")):
-            return P(None, AXIS_MODEL)  # column parallel [E, out]
+            return P(None, None, AXIS_MODEL)  # [L, E, out] column parallel
         if path.endswith(("wo", "w_down")):
-            return P(AXIS_MODEL, None)  # row parallel [in, E]
+            return P(None, AXIS_MODEL, None)  # [L, in, E] row parallel
         if path.endswith("embed"):
             return P(None, AXIS_MODEL)  # [V, E] shard E
         if path.endswith("lm_head"):
             return P(None, AXIS_MODEL)  # [E, V] shard V
         if path.endswith("w_router"):
-            return P(None, None)  # MoE router stays replicated
+            return P()  # [L, E, n_exp] MoE router replicated
         if path.endswith(("we_gate", "we_up")):
-            return P(AXIS_EXPERT, None, AXIS_MODEL)  # [n_exp, E, F]
+            return P(None, AXIS_EXPERT, None, AXIS_MODEL)  # [L, n_exp, E, F]
         if path.endswith("we_down"):
-            return P(AXIS_EXPERT, AXIS_MODEL, None)  # [n_exp, F, E]
+            return P(None, AXIS_EXPERT, AXIS_MODEL, None)  # [L, n_exp, F, E]
         return P()  # norms, scalars: replicated
 
     def params_sharding(self, params) -> dict:

@@ -8,10 +8,18 @@
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-set: the environment pins JAX_PLATFORMS=axon (one real TPU) and its
+# sitecustomize pre-imports jax with that config; tests must run on the
+# virtual 8-device CPU mesh instead, so override both env and jax config
+# before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
