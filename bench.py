@@ -31,10 +31,10 @@ def main() -> None:
     B = 32
     prompt_len = 128
     decode_steps = 128
-    page_size = 16
-    max_pages = 32  # 512-token max context for the bench
+    page_size = 64
+    max_pages = 8  # 512-token max context for the bench
 
-    config = get_config("llama-3.2-1b")
+    config = get_config("llama-3.2-3b")
     runner = ModelRunner(
         config,
         num_pages=B * max_pages + 8,

@@ -36,6 +36,7 @@ log = logging.getLogger("dynamo_tpu.engine.runner")
 
 def _decode_loop(
     config: ModelConfig,
+    attn_impl: str,
     n_steps: int,
     params,
     tokens0,  # [B] current token per seq
@@ -56,7 +57,8 @@ def _decode_loop(
         pos = jnp.where(positions0 < 0, -1, positions0 + t)
         kvl = jnp.where(positions0 < 0, 0, positions0 + t + 1)
         logits, kp, vp = llama.forward(
-            config, params, tok[:, None], pos[:, None], kp, vp, page_table, kvl
+            config, params, tok[:, None], pos[:, None], kp, vp, page_table, kvl,
+            attn_impl=attn_impl,
         )
         s = sample(logits[:, 0, :], sampling, step0 + t)
         return (s, kp, vp), s
@@ -89,6 +91,7 @@ class ModelRunner:
         seed: int = 0,
         params: Optional[Any] = None,
         devices: Optional[list] = None,
+        attn_impl: Optional[str] = None,  # None → pallas on TPU, jnp elsewhere
     ):
         self.config = config
         self.mesh_config = mesh_config or MeshConfig()
@@ -116,13 +119,23 @@ class ModelRunner:
             config.name, time.monotonic() - t0, self.mesh_config.shape, num_pages, page_size,
         )
 
+        if attn_impl is None:
+            platform = self.mesh.devices.flat[0].platform
+            # pallas on a real accelerator; pallas_call is not yet wrapped in
+            # shard_map, so multi-device meshes use the jnp path (GSPMD
+            # partitions it) until the sharded-kernel milestone
+            single = self.mesh_config.n_devices == 1
+            attn_impl = "pallas" if (platform != "cpu" and single) else "jnp"
+        self.attn_impl = attn_impl
+
+        # prefill always uses the jnp path (S>1); decode uses attn_impl
         self._jit_forward = jax.jit(
             partial(llama.forward, self.config),
             donate_argnums=(3, 4),  # k_pool, v_pool
         )
         self._jit_sample = jax.jit(sample)
         self._jit_decode_loop = jax.jit(
-            partial(_decode_loop, self.config),
+            partial(_decode_loop, self.config, self.attn_impl),
             static_argnums=(0,),  # n_steps
             donate_argnums=(4, 5),  # k_pool, v_pool
         )

@@ -56,6 +56,20 @@ PRESETS: Dict[str, ModelConfig] = {
         rope_theta=500000.0,
         tie_embeddings=True,
     ),
+    # Llama 3.2 3B — single-chip flagship: head_dim 128 (TPU lane-aligned KV
+    # tiles), ~6.4GB bf16, fits one v5e chip with a large KV pool
+    "llama-3.2-3b": ModelConfig(
+        name="llama-3.2-3b",
+        vocab_size=128256,
+        dim=3072,
+        n_layers=28,
+        n_heads=24,
+        n_kv_heads=8,
+        ffn_dim=8192,
+        max_seq_len=131072,
+        rope_theta=500000.0,
+        tie_embeddings=True,
+    ),
     # Llama 3.1 8B (reference BASELINE config #1 model)
     "llama-3.1-8b": ModelConfig(
         name="llama-3.1-8b",

@@ -87,7 +87,10 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
 def make_kv_pool(
     config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
 ) -> Tuple[jax.Array, jax.Array]:
-    shape = (config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim)
+    """Pool layout [L, Hk, NP, PS, D]: kv-heads leading so (a) the pool
+    shards over the model axis on a leading dim and (b) Pallas can block
+    (page, head) slices with TPU-legal (PS, D) tiles."""
+    shape = (config.n_layers, config.n_kv_heads, num_pages, page_size, config.head_dim)
     return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
 
 
@@ -121,7 +124,7 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def paged_attention_jnp(
     q: jax.Array,  # [B, S, Hk, G, Dh] (grouped query heads)
-    k_pool_l: jax.Array,  # [NP, PS, Hk, Dh] one layer's key pool
+    k_pool_l: jax.Array,  # [Hk, NP, PS, Dh] one layer's key pool
     v_pool_l: jax.Array,
     page_table: jax.Array,  # [B, MP] int32
     q_positions: jax.Array,  # [B, S] absolute positions of the queries
@@ -130,28 +133,30 @@ def paged_attention_jnp(
     """Reference (jnp gather) paged attention with causal masking by
     absolute position. Flat context index c == absolute position c because
     page tables map positions in order. Returns [B, S, Hk, G, Dh]."""
-    NP, PS, Hk, Dh = k_pool_l.shape
+    Hk, NP, PS, Dh = k_pool_l.shape
     B, MP = page_table.shape
     C = MP * PS
-    k = k_pool_l[page_table].reshape(B, C, Hk, Dh)
-    v = v_pool_l[page_table].reshape(B, C, Hk, Dh)
+    k = k_pool_l[:, page_table].reshape(Hk, B, C, Dh)
+    v = v_pool_l[:, page_table].reshape(Hk, B, C, Dh)
 
     scale = Dh**-0.5
-    scores = jnp.einsum("bskgd,bckd->bkgsc", q, k).astype(jnp.float32) * scale
+    scores = jnp.einsum("bskgd,kbcd->bkgsc", q, k).astype(jnp.float32) * scale
     ctx_pos = jnp.arange(C, dtype=jnp.int32)
     valid = (ctx_pos[None, :] < kv_lens[:, None])[:, None, None, None, :]
     causal = ctx_pos[None, None, :] <= q_positions[:, :, None]  # [B,S,C]
     mask = valid & causal[:, None, None, :, :]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bkgsc,bckd->bskgd", probs, v)
+    return jnp.einsum("bkgsc,kbcd->bskgd", probs, v)
 
 
-def _write_kv(pool_l, new, page_table, positions):
-    """Scatter new KV into a layer pool. new: [B, S, Hk, Dh]; positions:
-    [B, S] absolute positions, -1 marks padding (dropped via out-of-bounds
-    scatter + mode='drop')."""
-    NP, PS, Hk, Dh = pool_l.shape
+def _write_kv(pool, l_idx, new, page_table, positions):
+    """Scatter new KV for layer l_idx into the full stacked pool
+    [L, Hk, NP, PS, Dh] — the pool stays a single carried buffer across the
+    layer scan (XLA keeps the update in place), never a per-layer copy.
+    new: [B, S, Hk, Dh]; positions: [B, S] absolute positions, -1 marks
+    padding (dropped via out-of-bounds scatter + mode='drop')."""
+    L, Hk, NP, PS, Dh = pool.shape
     B, S = positions.shape
     MP = page_table.shape[1]
     valid = positions >= 0
@@ -160,7 +165,10 @@ def _write_kv(pool_l, new, page_table, positions):
     page_idx = jnp.take_along_axis(page_table, page_of_pos, axis=1)  # [B, S]
     page_idx = jnp.where(valid, page_idx, NP)  # OOB → dropped
     slot = (pos % PS).astype(jnp.int32)
-    return pool_l.at[page_idx.reshape(-1), slot.reshape(-1)].set(
+    # advanced indices (l_idx, page_idx, slot) are non-contiguous (the Hk
+    # slice sits between them), so their broadcast dim lands in front:
+    # the updated selection has shape [B*S, Hk, Dh]
+    return pool.at[l_idx, :, page_idx.reshape(-1), slot.reshape(-1)].set(
         new.reshape(B * S, Hk, Dh), mode="drop"
     )
 
@@ -180,6 +188,7 @@ def forward(
     page_table: jax.Array,  # [B, MP]
     kv_lens: jax.Array,  # [B] context length AFTER this step's tokens
     last_index: Optional[jax.Array] = None,  # scalar: only compute logits here
+    attn_impl: str = "jnp",  # "jnp" | "pallas" (pallas: decode S=1 on TPU)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass (covers prefill chunks S>1 and decode S=1).
 
@@ -198,8 +207,9 @@ def forward(
     h = params["embed"][tokens]  # [B, S, E] (gather)
     safe_pos = jnp.maximum(positions, 0)
 
-    def layer(h, xs):
-        lp, k_pool_l, v_pool_l = xs
+    def layer(carry, xs):
+        h, k_pool, v_pool = carry
+        lp, l_idx = xs
         x = rms_norm(h, lp["attn_norm"], c.norm_eps)
         q = (x @ lp["wq"]).reshape(B, S, c.n_heads, hd)
         k = (x @ lp["wk"]).reshape(B, S, c.n_kv_heads, hd)
@@ -207,11 +217,21 @@ def forward(
         q = rope(q, safe_pos, c.rope_theta)
         k = rope(k, safe_pos, c.rope_theta)
 
-        k_pool_l = _write_kv(k_pool_l, k, page_table, positions)
-        v_pool_l = _write_kv(v_pool_l, v, page_table, positions)
+        # surgical in-place scatter into the carried pools (no pool copy)
+        k_pool = _write_kv(k_pool, l_idx, k, page_table, positions)
+        v_pool = _write_kv(v_pool, l_idx, v, page_table, positions)
+        k_pool_l = k_pool[l_idx]
+        v_pool_l = v_pool[l_idx]
 
         qg = q.reshape(B, S, c.n_kv_heads, G, hd)
-        attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
+        if attn_impl == "pallas" and S == 1:
+            from dynamo_tpu.ops.paged_attention import decode_paged_attention
+
+            attn = decode_paged_attention(
+                qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens
+            )[:, None]  # [B, 1, Hk, G, hd]
+        else:
+            attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
         attn = attn.reshape(B, S, c.n_heads * hd)
         h = h + attn @ lp["wo"]
 
@@ -221,9 +241,13 @@ def forward(
         else:
             gate = jax.nn.silu(x @ lp["w_gate"])
             h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
-        return h, (k_pool_l, v_pool_l)
+        return (h, k_pool, v_pool), None
 
-    h, (k_pool, v_pool) = lax.scan(layer, h, (params["layers"], k_pool, v_pool))
+    (h, k_pool, v_pool), _ = lax.scan(
+        layer,
+        (h, k_pool, v_pool),
+        (params["layers"], jnp.arange(c.n_layers, dtype=jnp.int32)),
+    )
 
     h = rms_norm(h, params["norm_f"], c.norm_eps)
     if last_index is not None:

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the serving hot ops (the role CUDA kernels play
+in the reference: kvbm-kernels/cuda/tensor_kernels.cu, block_copy.cu — here
+they are paged attention + block copy, TPU-first)."""

@@ -1,0 +1,138 @@
+"""Pallas TPU decode paged attention.
+
+The decode hot op: one query token per sequence attends over that
+sequence's paged KV (pages scattered in a global HBM pool, owned via a page
+table). The jnp reference path (models/llama.py paged_attention_jnp)
+gathers all pages into a dense [B, ctx] tensor per layer — an extra HBM
+round trip of the whole KV working set. This kernel streams each page
+HBM→VMEM once via BlockSpec index_maps driven by the scalar-prefetched page
+table and accumulates flash-attention-style online softmax in VMEM scratch.
+
+Grid: (B, MP) — page index innermost so the per-sequence running softmax
+state lives across the page loop; all kv heads are processed per step (one
+[Hk, PS, D] DMA per page rather than Hk tiny ones). Pages past kv_len are
+masked (their DMA is wasted; a ragged grid is a later optimization).
+
+The reference framework ships CUDA kernels for its block engine
+(lib/llm/src/kernels/block_copy.cu, lib/kvbm-kernels/cuda/
+tensor_kernels.cu); attention itself lives in vLLM. This is the TPU-native
+equivalent of that hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, MP] int32 (SMEM)
+    kv_lens_ref,  # [B] int32 (SMEM)
+    # blocks
+    q_ref,  # [Hk, G, D] all query heads for seq b
+    k_ref,  # [Hk, PS, D] one page of keys (all heads)
+    v_ref,  # [Hk, PS, D]
+    o_ref,  # [Hk, G, D]
+    # scratch (persist across the page loop)
+    m_ref,  # [Hk, G, 1] f32 running max
+    l_ref,  # [Hk, G, 1] f32 running denom
+    acc_ref,  # [Hk, G, D] f32 running numerator
+    *,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_lens_ref[b]
+    n_valid = jnp.clip(kv_len - i * page_size, 0, page_size)
+
+    @pl.when(n_valid > 0)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)  # [Hk, G, D]
+        k = k_ref[...].astype(jnp.float32)  # [Hk, PS, D]
+        # s[h, g, p] = q[h, g, :] · k[h, p, :]
+        s = lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale  # [Hk, G, PS]
+        valid = lax.broadcasted_iota(jnp.int32, s.shape, 2) < n_valid
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[...]  # [Hk, G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [Hk, G, PS]
+        alpha = jnp.exp(m_prev - m_new)
+
+        v = v_ref[...].astype(jnp.float32)  # [Hk, PS, D]
+        pv = lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )  # [Hk, G, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        m_ref[...] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_paged_attention(
+    q: jax.Array,  # [B, Hk, G, D]
+    k_pool_l: jax.Array,  # [Hk, NP, PS, D] one layer's key pool
+    v_pool_l: jax.Array,
+    page_table: jax.Array,  # [B, MP] int32
+    kv_lens: jax.Array,  # [B] int32 (context length incl. current token)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, Hk, G, D]. KV for the current token must already be
+    written to the pool (same contract as paged_attention_jnp)."""
+    B, Hk, G, D = q.shape
+    _, NP, PS, _ = k_pool_l.shape
+    MP = page_table.shape[1]
+    scale = D**-0.5
+
+    kernel = functools.partial(_decode_kernel, page_size=PS, scale=scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, kv_lens
+        grid=(B, MP),
+        in_specs=[
+            pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0)),
+            # the page addressed by the prefetched page table; out-of-range
+            # rows hold garbage that n_valid masking discards
+            pl.BlockSpec((Hk, None, PS, D), lambda b, i, pt, kl: (0, pt[b, i], 0, 0)),
+            pl.BlockSpec((Hk, None, PS, D), lambda b, i, pt, kl: (0, pt[b, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hk, G, 1), jnp.float32),
+            pltpu.VMEM((Hk, G, 1), jnp.float32),
+            pltpu.VMEM((Hk, G, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hk, G, D), q.dtype),
+        interpret=interpret,
+    )(page_table, kv_lens, q, k_pool_l, v_pool_l)
+    return out

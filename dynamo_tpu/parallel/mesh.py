@@ -100,8 +100,8 @@ class ShardingPolicy:
 
     # -- kv cache ----------------------------------------------------------
     def kv_pool_spec(self) -> P:
-        # [layers, num_pages, page_size, kv_heads, head_dim]
-        return P(None, None, None, AXIS_MODEL, None)
+        # [layers, kv_heads, num_pages, page_size, head_dim]
+        return P(None, AXIS_MODEL, None, None, None)
 
     def kv_pool_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.kv_pool_spec())

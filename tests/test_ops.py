@@ -1,0 +1,43 @@
+"""Pallas kernel tests (interpret mode on CPU; compiled mode is exercised
+on real TPU via bench/worker runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.llama import paged_attention_jnp
+from dynamo_tpu.ops.paged_attention import decode_paged_attention
+
+
+@pytest.mark.parametrize("kv_lens", [[5, 17, 32, 1], [32, 32, 32, 32], [1, 1, 1, 1]])
+def test_decode_paged_attention_matches_reference(kv_lens):
+    rng = np.random.default_rng(0)
+    B, Hk, G, D, NP, PS, MP = 4, 2, 4, 64, 16, 8, 4
+    q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    kv = jnp.asarray(np.asarray(kv_lens, np.int32))
+
+    out = decode_paged_attention(q, kp, vp, pt, kv, interpret=True)
+    ref = paged_attention_jnp(q[:, None], kp, vp, pt, (kv - 1)[:, None], kv)[:, 0]
+    d = np.abs(np.asarray(out, np.float32) - np.asarray(ref, np.float32)).max()
+    assert d < 3e-2, d
+
+
+def test_decode_paged_attention_ignores_garbage_pages():
+    """Pages past kv_len may point anywhere (even shared page 0); masked."""
+    rng = np.random.default_rng(1)
+    B, Hk, G, D, NP, PS, MP = 2, 1, 2, 64, 8, 8, 4
+    q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)) * 100, jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)) * 100, jnp.bfloat16)
+    pt_a = jnp.asarray(np.array([[1, 0, 0, 0], [2, 0, 0, 0]], np.int32))
+    pt_b = jnp.asarray(np.array([[1, 7, 6, 5], [2, 3, 4, 5]], np.int32))
+    kv = jnp.asarray(np.array([6, 8], np.int32))  # only first page used
+    out_a = decode_paged_attention(q, kp, vp, pt_a, kv, interpret=True)
+    out_b = decode_paged_attention(q, kp, vp, pt_b, kv, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_a, np.float32), np.asarray(out_b, np.float32)
+    )
