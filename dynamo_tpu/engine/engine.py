@@ -22,9 +22,12 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 
 import numpy as np
 
+from typing import TYPE_CHECKING
+
 from dynamo_tpu.engine.kv_pool import KvEvent, PagePool
-from dynamo_tpu.engine.model_runner import ModelRunner
-from dynamo_tpu.engine.sampling import SamplingParams
+
+if TYPE_CHECKING:  # jax stays un-imported in mocker processes
+    from dynamo_tpu.engine.model_runner import ModelRunner
 from dynamo_tpu.engine.scheduler import (
     DecodePlan,
     PrefillPlan,
@@ -56,7 +59,7 @@ class ForwardPassMetrics:
 class InferenceEngine:
     def __init__(
         self,
-        runner: ModelRunner,
+        runner: "ModelRunner",
         *,
         max_batch: int = 64,
         chunk_size: int = 512,
@@ -262,14 +265,16 @@ class InferenceEngine:
                 log.exception("kv listener failed")
 
 
-def _sampling_params(seqs: List[Sequence]) -> SamplingParams:
-    return SamplingParams.make(
-        temperature=[float(s.sampling.get("temperature", 1.0)) for s in seqs],
-        top_k=[int(s.sampling.get("top_k", 0)) for s in seqs],
-        top_p=[float(s.sampling.get("top_p", 1.0)) for s in seqs],
-        seeds=[
+def _sampling_params(seqs: List[Sequence]) -> Dict[str, list]:
+    """Plain host lists; the runner converts to device arrays (keeps the
+    mocker's SimRunner — and thus mocker processes — entirely jax-free)."""
+    return {
+        "temperature": [float(s.sampling.get("temperature", 1.0)) for s in seqs],
+        "top_k": [int(s.sampling.get("top_k", 0)) for s in seqs],
+        "top_p": [float(s.sampling.get("top_p", 1.0)) for s in seqs],
+        "seeds": [
             (s.sampling.get("seed") if s.sampling.get("seed") is not None
              else (hash(s.request_id) & 0x7FFFFFFF))
             for s in seqs
         ],
-    )
+    }

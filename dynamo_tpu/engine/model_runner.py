@@ -174,7 +174,7 @@ class ModelRunner:
         positions: List[int],
         page_tables: List[List[int]],
         kv_lens: List[int],
-        sampling: SamplingParams,
+        sampling,  # SamplingParams or dict of host lists
         step: int,
     ) -> np.ndarray:
         """One decode step over the active batch (padded to a bucket).
@@ -193,7 +193,7 @@ class ModelRunner:
             self.params, jnp.asarray(tok)[:, None], jnp.asarray(pos)[:, None],
             self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kvl),
         )
-        sampled = self._jit_sample(logits[:, 0, :], _pad_sampling(sampling, B), jnp.int32(step))
+        sampled = self._jit_sample(logits[:, 0, :], _pad_sampling(_as_sampling(sampling), B), jnp.int32(step))
         return np.asarray(jax.device_get(sampled))
 
     def decode_multi(
@@ -202,7 +202,7 @@ class ModelRunner:
         tokens: List[int],
         positions: List[int],
         page_tables: List[List[int]],
-        sampling: SamplingParams,
+        sampling,  # SamplingParams or dict of host lists
         step: int,
     ) -> np.ndarray:
         """n_steps fused decode iterations (one host sync total). Page
@@ -219,12 +219,12 @@ class ModelRunner:
         toks, self.k_pool, self.v_pool = self._jit_decode_loop(
             n_steps, self.params, jnp.asarray(tok), jnp.asarray(pos),
             self.k_pool, self.v_pool, jnp.asarray(pt),
-            _pad_sampling(sampling, B), jnp.int32(step),
+            _pad_sampling(_as_sampling(sampling), B), jnp.int32(step),
         )
         return np.asarray(jax.device_get(toks))
 
-    def sample_one(self, logits: jax.Array, sampling: SamplingParams, step: int) -> int:
-        out = self._jit_sample(logits[None, :], sampling, jnp.int32(step))
+    def sample_one(self, logits: jax.Array, sampling, step: int) -> int:
+        out = self._jit_sample(logits[None, :], _as_sampling(sampling), jnp.int32(step))
         return int(jax.device_get(out)[0])
 
     def _pad_page_table(self, rows: List[List[int]], B: Optional[int] = None) -> np.ndarray:
@@ -237,6 +237,14 @@ class ModelRunner:
     # -- memory ------------------------------------------------------------
     def kv_pool_bytes(self) -> int:
         return 2 * int(np.prod(self.k_pool.shape)) * self.k_pool.dtype.itemsize
+
+
+def _as_sampling(s) -> SamplingParams:
+    if isinstance(s, SamplingParams):
+        return s
+    return SamplingParams.make(
+        temperature=s["temperature"], top_k=s["top_k"], top_p=s["top_p"], seeds=s["seeds"]
+    )
 
 
 def _pad_sampling(s: SamplingParams, B: int) -> SamplingParams:

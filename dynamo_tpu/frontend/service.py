@@ -81,7 +81,13 @@ class ModelWatcher:
         self._chain_factory = chain_factory or self._default_chain
 
     def _default_chain(self, card: ModelCard, client: EndpointClient, pre: Preprocessor) -> AsyncEngine:
-        router_engine = _ClientEngine(client)
+        if self.router_mode == "kv":
+            from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
+
+            kv_router = KvRouter(self.runtime, client, block_size=card.kv_block_size)
+            router_engine: AsyncEngine = KvPushRouter(kv_router)
+        else:
+            router_engine = _ClientEngine(client)
         backend = BackendOperator(pre.tokenizer, router_engine)
         return Migration(backend, migration_limit=self.migration_limit)
 

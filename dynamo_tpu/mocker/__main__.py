@@ -1,9 +1,9 @@
 """`python -m dynamo_tpu.mocker` — simulated worker process.
 
-Analog of reference `python -m dynamo.mocker`: registers as a real worker
-(discovery + request plane + model card) with a simulated engine. Currently
-serves the EchoWorkerEngine; the TPU step-time scheduler mock replaces it in
-the full mocker.
+Analog of reference `python -m dynamo.mocker` (docs/dynosim/README.md:23):
+registers as a real worker — real discovery, request plane, KV events, FPM
+— with the engine replaced by SimRunner's TPU step-time model. Drives
+router/planner/frontend testing with zero TPUs.
 """
 
 from __future__ import annotations
@@ -11,22 +11,52 @@ from __future__ import annotations
 import argparse
 import asyncio
 
+from dynamo_tpu.engine.engine import InferenceEngine
 from dynamo_tpu.frontend.protocols import ModelCard
-from dynamo_tpu.mocker.echo import EchoWorkerEngine
+from dynamo_tpu.mocker.sim import SimRunner, SimTiming
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging_util import configure_logging
+from dynamo_tpu.worker_common import serve_worker
 
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser("dynamo_tpu.mocker")
-    p.add_argument("--model-name", default="echo-model")
+    p.add_argument("--model-name", default="mock-model")
     p.add_argument("--namespace", default="dyn")
     p.add_argument("--component", default="mocker")
     p.add_argument("--endpoint", default="generate")
-    p.add_argument("--token-delay-ms", type=float, default=0.0)
+    p.add_argument("--num-pages", type=int, default=2048)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=4096)
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--chunk-size", type=int, default=512)
+    p.add_argument("--decode-steps", type=int, default=4)
+    p.add_argument("--speed", type=float, default=1.0, help="timing scale; 0 = no sleeps")
+    p.add_argument("--decode-base-ms", type=float, default=4.0)
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
     return p.parse_args(argv)
+
+
+def build_mock_engine(args) -> tuple[InferenceEngine, ModelCard]:
+    timing = SimTiming(speed=args.speed, decode_base_s=args.decode_base_ms / 1000.0)
+    runner = SimRunner(
+        num_pages=args.num_pages,
+        page_size=args.page_size,
+        max_pages_per_seq=-(-args.max_seq_len // args.page_size),
+        timing=timing,
+    )
+    engine = InferenceEngine(
+        runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
+        decode_steps=args.decode_steps,
+    )
+    card = ModelCard(
+        name=args.model_name,
+        tokenizer="byte",
+        context_length=args.max_seq_len,
+        kv_block_size=args.page_size,
+    )
+    return engine, card
 
 
 async def async_main(args) -> None:
@@ -35,16 +65,18 @@ async def async_main(args) -> None:
     if args.discovery_root:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
-    card = ModelCard(name=args.model_name, tokenizer="byte")
-    engine = EchoWorkerEngine(token_delay_s=args.token_delay_ms / 1000.0)
-    path = f"{args.namespace}/{args.component}/{args.endpoint}"
-    await runtime.serve_endpoint(path, engine, metadata={"model_card": card.to_dict()})
-    print(f"mocker serving {args.model_name} at {path}", flush=True)
+    engine, card = build_mock_engine(args)
+    worker = await serve_worker(
+        runtime, engine, card,
+        namespace=args.namespace, component=args.component, endpoint=args.endpoint,
+    )
+    print(f"mocker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
     try:
         await asyncio.Event().wait()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        await worker.stop()
         await runtime.shutdown()
 
 

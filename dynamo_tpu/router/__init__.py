@@ -1,0 +1,4 @@
+"""KV-cache-aware routing (analog of reference lib/kv-router +
+lib/llm/src/kv_router): block-hash indexer fed by worker KV events, cost-
+based worker selection with overlap credits, active-sequence load tracking,
+and the KvPushRouter pipeline engine."""

@@ -1,0 +1,129 @@
+"""Router-side KV indexer: event-plane subscriber → BlockIndex, with gap
+detection and worker-dump recovery (analog of reference KvIndexer +
+indexer/recovery/worker_query.rs, router-design.md:162-219).
+
+Freshness loop: worker PagePool mutation → KvEventPublisher → event plane →
+this subscriber → BlockIndex.apply_event → next find_matches sees it.
+Recovery: a gap in a worker's monotonic event_ids (lost ZMQ messages)
+triggers a full-state re-dump from that worker's kv_state endpoint; the
+same dump seeds the index when a worker is first discovered.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+from dynamo_tpu.router.protocols import KV_EVENT_SUBJECT, RouterEvent
+from dynamo_tpu.router.radix_tree import BlockIndex
+from dynamo_tpu.runtime.event_plane import EventSubscriber
+
+log = logging.getLogger("dynamo_tpu.router.indexer")
+
+Worker = Tuple[int, int]
+
+
+class KvIndexer:
+    def __init__(
+        self,
+        subscriber: EventSubscriber,
+        index: Optional[BlockIndex] = None,
+        dump_fn=None,  # async (instance_id) -> dump dict; wired by KvRouter
+        ttl: Optional[float] = None,  # approximate-mode TTL
+    ):
+        self.index = index or BlockIndex()
+        self._sub = subscriber
+        self._dump_fn = dump_fn
+        self.ttl = ttl
+        self._last_event_id: Dict[Worker, int] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._resyncing: set = set()
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._consume())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def connect_publisher(self, address: str) -> None:
+        self._sub.connect(address)
+
+    def disconnect_publisher(self, address: str) -> None:
+        self._sub.disconnect(address)
+
+    def remove_worker(self, worker: Worker) -> None:
+        self.index.remove_worker(worker)
+        self._last_event_id.pop(worker, None)
+
+    async def _consume(self) -> None:
+        try:
+            async for subject, payload in self._sub.events():
+                for wire in payload.get("events", []):
+                    ev = RouterEvent.from_wire(wire)
+                    self._apply(ev)
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # pragma: no cover
+            log.exception("kv event consumer failed")
+
+    def _apply(self, ev: RouterEvent) -> None:
+        worker = tuple(ev.worker)
+        last = self._last_event_id.get(worker, 0)
+        if ev.event_id <= last:
+            return  # replay/duplicate
+        if ev.event_id != last + 1 and last != 0:
+            log.warning(
+                "kv event gap for worker %s: %d -> %d; scheduling resync",
+                worker, last, ev.event_id,
+            )
+            self._schedule_resync(worker)
+        self._last_event_id[worker] = ev.event_id
+        self.index.apply_event(ev, ttl=self.ttl)
+
+    # -- recovery ----------------------------------------------------------
+    def _schedule_resync(self, worker: Worker) -> None:
+        if self._dump_fn is None or worker in self._resyncing:
+            return
+        self._resyncing.add(worker)
+        asyncio.create_task(self._resync(worker))
+
+    async def resync_worker(self, worker: Worker) -> None:
+        """Full-state seed/resync from the worker's dump endpoint."""
+        if self._dump_fn is None:
+            return
+        try:
+            dump = await self._dump_fn(worker[0])
+        except Exception as e:
+            log.warning("kv dump from worker %s failed: %s", worker, e)
+            return
+        self.index.remove_worker(worker)
+        # replay the snapshot as store events, parent-first so chains link
+        blocks = {int(h): (int(p) if p is not None else None) for h, p in dump.get("blocks", [])}
+        emitted = set()
+
+        def emit(h: int) -> None:
+            if h in emitted or h not in blocks:
+                return
+            p = blocks[h]
+            if p is not None:
+                emit(p)
+            self.index.apply_event(
+                RouterEvent(worker=worker, event_id=0, kind="store",
+                            block_hashes=[h], parent_hash=p),
+                ttl=self.ttl,
+            )
+            emitted.add(h)
+
+        for h in list(blocks):
+            emit(h)
+        self._last_event_id[worker] = int(dump.get("last_event_id", 0))
+
+    async def _resync(self, worker: Worker) -> None:
+        try:
+            await self.resync_worker(worker)
+        finally:
+            self._resyncing.discard(worker)

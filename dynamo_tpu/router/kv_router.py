@@ -1,0 +1,182 @@
+"""KvRouter + KvPushRouter (analog of reference lib/llm/src/kv_router.rs:
+201,247,516 and kv_router/push_router.rs).
+
+KvRouter combines the BlockIndex overlap scores with ActiveSequences load
+and the cost-based selector to pick (worker, dp_rank) per request; it
+watches the worker set via the EndpointClient and wires each discovered
+worker's event publisher into the indexer (seeding via full dump).
+
+KvPushRouter is the pipeline engine: hash the request's prompt blocks,
+select a worker, push direct to that instance, and maintain the
+active-sequence lifecycle (AddRequest → MarkPrefillCompleted on first
+token → Free on completion/error). In approximate mode
+(--no-router-kv-events, event-plane.md:105-117) routing decisions predict
+cache state with a TTL instead of consuming worker events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.protocols import OverlapScores, RouterEvent
+from dynamo_tpu.router.radix_tree import BlockIndex
+from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector
+from dynamo_tpu.router.sequences import ActiveSequences
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime, EndpointClient
+from dynamo_tpu.tokens.hashing import block_hashes
+
+log = logging.getLogger("dynamo_tpu.router")
+
+Worker = Tuple[int, int]
+
+
+class KvRouter:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        client: EndpointClient,
+        block_size: int,
+        config: Optional[KvRouterConfig] = None,
+        use_kv_events: bool = True,
+        approx_ttl: float = 120.0,
+    ):
+        self.runtime = runtime
+        self.client = client
+        self.block_size = block_size
+        self.config = config or KvRouterConfig()
+        self.use_kv_events = use_kv_events
+        self.selector = WorkerSelector(self.config)
+        self.sequences = ActiveSequences()
+        self.indexer = KvIndexer(
+            runtime.event_subscriber(["kv_events"]) if use_kv_events else _NullSub(),
+            dump_fn=self._dump_worker if use_kv_events else None,
+            ttl=None if use_kv_events else approx_ttl,
+        )
+        self._started = False
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        await self.client.start()
+        self.client.on_instance_change(self._on_instance)
+        if self.use_kv_events:
+            await self.indexer.start()
+            for inst in list(self.client.instances.values()):
+                await self._connect_worker(inst)
+
+    async def _on_instance(self, kind: str, inst) -> None:
+        worker = (inst.instance_id, 0)
+        if kind == "put" and self.use_kv_events:
+            await self._connect_worker(inst)
+        elif kind == "delete":
+            self.indexer.remove_worker(worker)
+            self.sequences.remove_worker(worker)
+
+    async def _connect_worker(self, inst) -> None:
+        addr = (inst.metadata or {}).get("kv_publisher")
+        if addr:
+            self.indexer.connect_publisher(addr)
+            await self.indexer.resync_worker((inst.instance_id, 0))
+
+    async def _dump_worker(self, instance_id: int) -> Dict[str, Any]:
+        inst = self.client.instances.get(instance_id)
+        if inst is None:
+            raise RuntimeError(f"worker {instance_id:x} gone")
+        path = inst.endpoint_address.path.rsplit("/", 1)[0] + "/kv_state"
+        dump_client = self.runtime.client(path)
+        await dump_client.start()
+        dump_client.router.update_instance(instance_id, inst.address)
+        try:
+            async for item in dump_client.direct({}, instance_id):
+                return item
+        finally:
+            await dump_client.close()
+        raise RuntimeError("empty kv dump")
+
+    # -- selection ---------------------------------------------------------
+    def workers(self) -> List[Worker]:
+        out: List[Worker] = []
+        for inst in self.client.instances.values():
+            dp = int((inst.metadata or {}).get("dp_size", 1))
+            out.extend((inst.instance_id, r) for r in range(dp))
+        return sorted(out)
+
+    def find_best_match(self, token_ids: List[int]) -> Tuple[Worker, int, int]:
+        """Returns (worker, overlap_blocks, total_blocks)."""
+        hashes = block_hashes(token_ids, self.block_size)
+        overlaps = self.indexer.index.find_matches(hashes)
+        workers = self.workers()
+        worker, overlap = self.selector.select(
+            workers, len(hashes), overlaps, self.sequences
+        )
+        return worker, overlap, len(hashes)
+
+    # -- lifecycle charging -------------------------------------------------
+    def add_request(
+        self, request_id: str, worker: Worker, total_blocks: int, overlap: int,
+        token_ids: Optional[List[int]] = None,
+    ) -> None:
+        self.sequences.add_request(request_id, worker, total_blocks, overlap)
+        if not self.use_kv_events and token_ids is not None:
+            # approximate mode: predict the worker will cache these blocks
+            hashes = block_hashes(token_ids, self.block_size)
+            parent = None
+            ev = RouterEvent(worker=worker, event_id=0, kind="store",
+                             block_hashes=hashes, parent_hash=None)
+            self.indexer.index.apply_event(ev, ttl=self.indexer.ttl)
+
+    def mark_prefill_completed(self, request_id: str) -> None:
+        self.sequences.mark_prefill_completed(request_id)
+
+    def free(self, request_id: str) -> None:
+        self.sequences.free(request_id)
+
+    async def stop(self) -> None:
+        await self.indexer.stop()
+
+
+class KvPushRouter:
+    """Pipeline engine: KV-aware select → direct push → lifecycle hooks."""
+
+    def __init__(self, router: KvRouter):
+        self.router = router
+
+    async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        await self.router.start()
+        token_ids = request.get("token_ids") or []
+        worker, overlap, total = self.router.find_best_match(token_ids)
+        rid = context.id
+        self.router.add_request(rid, worker, total, overlap, token_ids=token_ids)
+        context.metadata["kv_overlap_blocks"] = overlap
+        first = True
+        try:
+            async for item in self.router.client.direct(
+                request, worker[0], context
+            ):
+                if first:
+                    self.router.mark_prefill_completed(rid)
+                    first = False
+                yield item
+        finally:
+            self.router.free(rid)
+
+
+class _NullSub:
+    def connect(self, address: str) -> None:
+        pass
+
+    def disconnect(self, address: str) -> None:
+        pass
+
+    async def events(self):
+        while True:
+            await asyncio.sleep(3600)
+        yield  # pragma: no cover
+
+    async def close(self) -> None:
+        pass

@@ -1,0 +1,69 @@
+"""Router wire protocols (analog of reference lib/kv-router/src/protocols.rs:
+RouterEvent, LocalBlockHash, KV_EVENT_SUBJECT, WorkerWithDpRank).
+
+Events ride the event plane as msgpack dicts; block identity is the lineage
+hash from dynamo_tpu.tokens.hashing (shared with the engine's prefix cache
+and the KVBM)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Optional, Tuple
+
+KV_EVENT_SUBJECT = "kv_events"
+FPM_SUBJECT = "fpm"
+
+
+@dataclass(frozen=True)
+class WorkerId:
+    """Routing target: (instance_id, dp_rank) — reference WorkerWithDpRank."""
+
+    instance_id: int
+    dp_rank: int = 0
+
+    def key(self) -> Tuple[int, int]:
+        return (self.instance_id, self.dp_rank)
+
+
+@dataclass
+class RouterEvent:
+    """One KV-cache mutation on a worker. Monotonic event_id per
+    (worker, dp_rank) enables gap detection (router-design.md:162-219)."""
+
+    worker: Tuple[int, int]  # (instance_id, dp_rank)
+    event_id: int
+    kind: str  # "store" | "remove" | "clear"
+    block_hashes: List[int] = field(default_factory=list)
+    parent_hash: Optional[int] = None  # lineage anchor of block_hashes[0]
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "worker": list(self.worker),
+            "event_id": self.event_id,
+            "kind": self.kind,
+            "block_hashes": self.block_hashes,
+            "parent_hash": self.parent_hash,
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "RouterEvent":
+        return cls(
+            worker=tuple(d["worker"]),
+            event_id=int(d["event_id"]),
+            kind=d["kind"],
+            block_hashes=list(d.get("block_hashes") or []),
+            parent_hash=d.get("parent_hash"),
+        )
+
+
+@dataclass
+class OverlapScores:
+    """find_matches result: per-worker count of matched leading blocks."""
+
+    scores: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    total_blocks: int = 0
+
+    def best(self) -> Optional[Tuple[Tuple[int, int], int]]:
+        if not self.scores:
+            return None
+        return max(self.scores.items(), key=lambda kv: kv[1])

@@ -1,0 +1,82 @@
+"""Worker selection policy (analog of reference lib/kv-router/scheduling/:
+cost function + softmax temperature sampling, router-design.md:61-85).
+
+cost(worker) = prefill_load_scale * adjusted_prefill_blocks + decode_blocks
+  adjusted_prefill_blocks = request's new blocks (total - overlap credit)
+                            + worker's queued prefill blocks
+Selection samples softmax(-cost / temperature); temperature 0 = argmin.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from dynamo_tpu.router.protocols import OverlapScores
+from dynamo_tpu.router.sequences import ActiveSequences
+
+Worker = Tuple[int, int]
+
+
+@dataclass
+class KvRouterConfig:
+    """Routing knobs (reference KvRouterConfig, scheduling/config.rs)."""
+
+    prefill_load_scale: float = 1.5  # prefill tokens cost more than decode
+    temperature: float = 0.0  # 0 = deterministic argmin
+    # overlap credit weights per tier (device hits count fully; host/disk
+    # hits — via lower-tier events from the KVBM — count partially)
+    device_credit: float = 1.0
+    host_credit: float = 0.6
+    disk_credit: float = 0.3
+    seed: Optional[int] = None
+
+
+class WorkerSelector:
+    def __init__(self, config: Optional[KvRouterConfig] = None):
+        self.config = config or KvRouterConfig()
+        self._rng = random.Random(self.config.seed)
+
+    def select(
+        self,
+        workers: List[Worker],
+        total_blocks: int,
+        overlaps: OverlapScores,
+        sequences: ActiveSequences,
+        host_overlaps: Optional[Dict[Worker, int]] = None,
+    ) -> Tuple[Worker, int]:
+        """Returns (worker, device_overlap_blocks). Raises if no workers."""
+        if not workers:
+            raise RuntimeError("no workers available for KV routing")
+        cfg = self.config
+        costs: List[float] = []
+        for w in workers:
+            dev = overlaps.scores.get(w, 0)
+            host = (host_overlaps or {}).get(w, 0)
+            credit = cfg.device_credit * dev + cfg.host_credit * max(0, host - dev)
+            new_blocks = max(0.0, total_blocks - credit)
+            prefill = new_blocks + sequences.prefill_blocks(w)
+            decode = sequences.decode_blocks(w)
+            costs.append(cfg.prefill_load_scale * prefill + decode)
+
+        if cfg.temperature <= 0.0:
+            best = min(range(len(workers)), key=lambda i: (costs[i], workers[i]))
+        else:
+            # softmax over -cost/temperature (normalized for stability)
+            m = min(costs)
+            logits = [-(c - m) / cfg.temperature for c in costs]
+            mx = max(logits)
+            ws = [math.exp(l - mx) for l in logits]
+            total = sum(ws)
+            r = self._rng.random() * total
+            acc = 0.0
+            best = len(workers) - 1
+            for i, wgt in enumerate(ws):
+                acc += wgt
+                if r <= acc:
+                    best = i
+                    break
+        w = workers[best]
+        return w, overlaps.scores.get(w, 0)

@@ -1,0 +1,89 @@
+"""Active-sequence load tracking (analog of reference lib/kv-router
+sequences/ "slot manager": AddRequest / MarkPrefillCompleted / Free,
+router-design.md:150-160).
+
+The router predicts each worker's load without waiting for engine metrics:
+on routing a request it charges the worker the request's prefill blocks
+(minus overlap credits) and a decode-block projection; prefill completion
+converts prefill charge to decode charge; free releases everything.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+Worker = Tuple[int, int]
+
+
+@dataclass
+class _ActiveRequest:
+    worker: Worker
+    prefill_blocks: int  # blocks still being prefilled (not yet cached)
+    decode_blocks: int  # blocks projected for the active decode
+    started: float = field(default_factory=time.monotonic)
+    prefill_done: bool = False
+
+
+class ActiveSequences:
+    def __init__(self):
+        self._requests: Dict[str, _ActiveRequest] = {}
+        self._prefill: Dict[Worker, int] = {}
+        self._decode: Dict[Worker, int] = {}
+        self._count: Dict[Worker, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def add_request(
+        self,
+        request_id: str,
+        worker: Worker,
+        total_blocks: int,
+        overlap_blocks: int,
+        expected_output_blocks: int = 1,
+    ) -> None:
+        new_prefill = max(0, total_blocks - overlap_blocks)
+        req = _ActiveRequest(
+            worker=worker,
+            prefill_blocks=new_prefill,
+            decode_blocks=total_blocks + expected_output_blocks,
+        )
+        self._requests[request_id] = req
+        self._prefill[worker] = self._prefill.get(worker, 0) + new_prefill
+        self._decode[worker] = self._decode.get(worker, 0) + req.decode_blocks
+        self._count[worker] = self._count.get(worker, 0) + 1
+
+    def mark_prefill_completed(self, request_id: str) -> None:
+        req = self._requests.get(request_id)
+        if req is None or req.prefill_done:
+            return
+        req.prefill_done = True
+        self._prefill[req.worker] = max(0, self._prefill.get(req.worker, 0) - req.prefill_blocks)
+
+    def free(self, request_id: str) -> None:
+        req = self._requests.pop(request_id, None)
+        if req is None:
+            return
+        if not req.prefill_done:
+            self._prefill[req.worker] = max(
+                0, self._prefill.get(req.worker, 0) - req.prefill_blocks
+            )
+        self._decode[req.worker] = max(0, self._decode.get(req.worker, 0) - req.decode_blocks)
+        self._count[req.worker] = max(0, self._count.get(req.worker, 0) - 1)
+
+    def remove_worker(self, worker: Worker) -> None:
+        for rid in [r for r, req in self._requests.items() if req.worker == worker]:
+            self.free(rid)
+        self._prefill.pop(worker, None)
+        self._decode.pop(worker, None)
+        self._count.pop(worker, None)
+
+    # -- load queries ------------------------------------------------------
+    def prefill_blocks(self, worker: Worker) -> int:
+        return self._prefill.get(worker, 0)
+
+    def decode_blocks(self, worker: Worker) -> int:
+        return self._decode.get(worker, 0)
+
+    def active_requests(self, worker: Worker) -> int:
+        return self._count.get(worker, 0)

@@ -212,11 +212,17 @@ class EndpointClient:
         self._watch_task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
         self.instances: Dict[int, Instance] = {}
+        self._change_cbs: list = []  # cb(kind: "put"|"delete", Instance)
 
     async def start(self) -> "EndpointClient":
         if self._watch_task is None:
             self._watch_task = asyncio.create_task(self._watch())
         return self
+
+    def on_instance_change(self, cb) -> None:
+        """cb(kind: "put"|"delete", Instance); put also fires on metadata
+        updates (discovery emits puts for changed records)."""
+        self._change_cbs.append(cb)
 
     async def _watch(self) -> None:
         try:
@@ -229,6 +235,13 @@ class EndpointClient:
                 else:
                     self.instances.pop(inst.instance_id, None)
                     self.router.update_instance(inst.instance_id, None)
+                for cb in self._change_cbs:
+                    try:
+                        res = cb(ev.kind, inst)
+                        if asyncio.iscoroutine(res):
+                            await res
+                    except Exception:  # pragma: no cover
+                        log.exception("instance-change callback failed")
         except asyncio.CancelledError:
             pass
 

@@ -87,16 +87,19 @@ async def async_main(args) -> None:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     engine, card = build_engine(args)
-    engine.start()
-    path = f"{args.namespace}/{args.component}/{args.endpoint}"
-    await runtime.serve_endpoint(path, engine, metadata={"model_card": card.to_dict()})
-    print(f"worker serving {card.name} at {path}", flush=True)
+    from dynamo_tpu.worker_common import serve_worker
+
+    worker = await serve_worker(
+        runtime, engine, card,
+        namespace=args.namespace, component=args.component, endpoint=args.endpoint,
+    )
+    print(f"worker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
     try:
         await asyncio.Event().wait()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
-        engine.stop()
+        await worker.stop()
         await runtime.shutdown()
 
 

@@ -1,0 +1,93 @@
+"""Shared worker wiring: serve an InferenceEngine (real or mocker) with KV
+event publishing, FPM publishing, and the kv_state recovery endpoint.
+
+Mirrors the reference worker startup (components/src/dynamo/vllm/main.py:
+engine boot → KV event publisher per dp_rank → register model → FPM relay →
+serve_endpoint; SURVEY.md §3.2), collapsed into one helper both
+`python -m dynamo_tpu.worker` and `python -m dynamo_tpu.mocker` use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.frontend.protocols import ModelCard
+from dynamo_tpu.router.protocols import FPM_SUBJECT
+from dynamo_tpu.router.publisher import KvEventPublisher
+from dynamo_tpu.runtime.component import new_instance_id
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.worker")
+
+
+class ServedWorker:
+    def __init__(self, runtime, engine, instance, publisher):
+        self.runtime = runtime
+        self.engine = engine
+        self.instance = instance
+        self.publisher = publisher
+
+    async def stop(self) -> None:
+        self.engine.stop()
+        if self.publisher is not None:
+            await self.publisher.stop()
+
+
+async def serve_worker(
+    runtime: DistributedRuntime,
+    engine: InferenceEngine,
+    card: ModelCard,
+    namespace: str = "dyn",
+    component: str = "tpu-worker",
+    endpoint: str = "generate",
+    publish_kv_events: bool = True,
+    publish_fpm: bool = True,
+    dp_rank: int = 0,
+) -> ServedWorker:
+    instance_id = new_instance_id()
+    metadata = {"model_card": card.to_dict(), "dp_rank": dp_rank}
+
+    publisher = None
+    if publish_kv_events:
+        publisher = KvEventPublisher(
+            runtime.event_publisher(), instance_id, dp_rank=dp_rank
+        )
+        await publisher.start()
+        engine.on_kv_event(publisher.on_engine_events)
+        metadata["kv_publisher"] = publisher.address
+        await runtime.serve_endpoint(
+            f"{namespace}/{component}/kv_state",
+            publisher.dump_state,
+            instance_id=instance_id,
+        )
+
+    if publish_fpm:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        pub = runtime.event_publisher()
+
+        def on_fpm(m) -> None:  # called from the engine step thread
+            payload = dataclasses.asdict(m)
+            payload["worker"] = [instance_id, dp_rank]
+
+            def _send() -> None:
+                asyncio.ensure_future(pub.publish(FPM_SUBJECT, payload))
+
+            loop.call_soon_threadsafe(_send)
+
+        engine.on_fpm(on_fpm)
+        metadata["fpm_publisher"] = pub.address
+
+    engine.start()
+    inst = await runtime.serve_endpoint(
+        f"{namespace}/{component}/{endpoint}",
+        engine,
+        metadata=metadata,
+        instance_id=instance_id,
+    )
+    log.info("worker %x serving %s", instance_id, card.name)
+    return ServedWorker(runtime, engine, inst, publisher)

@@ -1,0 +1,248 @@
+"""KV router tests: block index semantics, cost selector, active sequences,
+publisher→indexer roundtrip, gap recovery, and the mocker-based e2e
+(analog of reference tests/router/test_router_e2e_with_mockers.py)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.kv_pool import KvEvent
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.protocols import RouterEvent
+from dynamo_tpu.router.publisher import KvEventPublisher
+from dynamo_tpu.router.radix_tree import BlockIndex
+from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector
+from dynamo_tpu.router.sequences import ActiveSequences
+from dynamo_tpu.runtime.event_plane import make_publisher, make_subscriber
+from dynamo_tpu.tokens.hashing import block_hashes
+
+W1, W2 = (1, 0), (2, 0)
+
+
+def _store(worker, hashes, parent=None, eid=1):
+    return RouterEvent(worker=worker, event_id=eid, kind="store",
+                       block_hashes=hashes, parent_hash=parent)
+
+
+# -- block index ------------------------------------------------------------
+
+
+def test_index_overlap_scores():
+    idx = BlockIndex()
+    hs = block_hashes(list(range(1, 17)), 4)  # 4 blocks
+    idx.apply_event(_store(W1, hs))
+    idx.apply_event(_store(W2, hs[:2]))
+
+    m = idx.find_matches(hs)
+    assert m.scores[W1] == 4 and m.scores[W2] == 2
+
+    # divergent suffix only matches the shared prefix
+    other = block_hashes(list(range(1, 9)) + [99, 98, 97, 96], 4)
+    m2 = idx.find_matches(other)
+    assert m2.scores[W1] == 2 and m2.scores[W2] == 2
+
+
+def test_index_remove_and_hole_semantics():
+    idx = BlockIndex()
+    hs = block_hashes(list(range(1, 17)), 4)
+    idx.apply_event(_store(W1, hs))
+    # evict a middle block: overlap walk must stop before the hole
+    idx.apply_event(RouterEvent(worker=W1, event_id=2, kind="remove",
+                                block_hashes=[hs[1]]))
+    m = idx.find_matches(hs)
+    assert m.scores.get(W1) == 1
+
+
+def test_index_worker_removal_prunes():
+    idx = BlockIndex()
+    hs = block_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    idx.apply_event(_store(W1, hs))
+    idx.remove_worker(W1)
+    assert len(idx) == 0
+    assert idx.find_matches(hs).scores == {}
+
+
+def test_index_ttl_expiry_approximate_mode():
+    idx = BlockIndex()
+    hs = block_hashes([1, 2, 3, 4], 2)
+    idx.apply_event(_store(W1, hs), ttl=0.01)
+    assert idx.find_matches(hs).scores.get(W1) == 2
+    import time
+
+    time.sleep(0.03)
+    assert idx.find_matches(hs).scores == {}
+
+
+# -- selector ---------------------------------------------------------------
+
+
+def test_selector_prefers_overlap_then_load():
+    sel = WorkerSelector(KvRouterConfig())
+    seqs = ActiveSequences()
+    from dynamo_tpu.router.protocols import OverlapScores
+
+    # W1 has 3 of 4 blocks cached → cheaper
+    ov = OverlapScores(scores={W1: 3}, total_blocks=4)
+    w, overlap = sel.select([W1, W2], 4, ov, seqs)
+    assert w == W1 and overlap == 3
+
+    # pile load on W1 until W2 wins despite no overlap
+    for i in range(20):
+        seqs.add_request(f"r{i}", W1, 10, 0)
+    w2, _ = sel.select([W1, W2], 4, ov, seqs)
+    assert w2 == W2
+
+
+def test_selector_softmax_spreads():
+    sel = WorkerSelector(KvRouterConfig(temperature=5.0, seed=42))
+    seqs = ActiveSequences()
+    from dynamo_tpu.router.protocols import OverlapScores
+
+    picks = {W1: 0, W2: 0}
+    for _ in range(200):
+        w, _ = sel.select([W1, W2], 4, OverlapScores(), seqs)
+        picks[w] += 1
+    assert picks[W1] > 20 and picks[W2] > 20  # both get traffic
+
+
+# -- active sequences -------------------------------------------------------
+
+
+def test_sequences_lifecycle_accounting():
+    seqs = ActiveSequences()
+    seqs.add_request("a", W1, total_blocks=10, overlap_blocks=4)
+    assert seqs.prefill_blocks(W1) == 6
+    assert seqs.decode_blocks(W1) == 11
+    seqs.mark_prefill_completed("a")
+    assert seqs.prefill_blocks(W1) == 0
+    assert seqs.decode_blocks(W1) == 11
+    seqs.free("a")
+    assert seqs.decode_blocks(W1) == 0 and seqs.active_requests(W1) == 0
+
+
+# -- publisher → indexer roundtrip ------------------------------------------
+
+
+async def test_publisher_indexer_roundtrip_and_gap_recovery():
+    pub = KvEventPublisher(make_publisher("inproc"), instance_id=1, flush_interval=0.001)
+    await pub.start()
+    sub = make_subscriber("inproc", subjects=["kv_events"])
+    dumps = []
+
+    async def dump_fn(instance_id):
+        dumps.append(instance_id)
+        return await pub.dump_state({}, None)
+
+    idx = KvIndexer(sub, dump_fn=dump_fn)
+    idx.connect_publisher(pub.address)
+    await idx.start()
+
+    hs = block_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    pub.on_engine_events([KvEvent("store", hs, None)])
+    await asyncio.sleep(0.05)
+    assert idx.index.find_matches(hs).scores.get((1, 0)) == 2
+
+    # simulate a lost message: bump the publisher's event counter secretly
+    pub._event_id += 5
+    hs2 = block_hashes([9, 9, 9, 9], 4)
+    pub.on_engine_events([KvEvent("store", hs2, None)])
+    await asyncio.sleep(0.1)
+    assert dumps, "gap should trigger a dump resync"
+    # after resync the full snapshot is indexed
+    assert idx.index.find_matches(hs).scores.get((1, 0)) == 2
+    await idx.stop()
+    await pub.stop()
+
+
+# -- e2e with mockers -------------------------------------------------------
+
+
+async def _mock_stack(n_workers=2, realm="router-e2e"):
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import build_mock_engine, parse_args
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    workers = []
+    for i in range(n_workers):
+        rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+        args = parse_args(["--speed", "0", "--page-size", "4", "--decode-steps", "1"])
+        engine, card = build_mock_engine(args)
+        w = await serve_worker(rt, engine, card)
+        workers.append((rt, w))
+
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager, router_mode="kv")
+    svc = HttpService(frt, manager, watcher, port=0)
+    base = await svc.start()
+    await watcher.wait_for_model(timeout=10)
+    return workers, frt, svc, base
+
+
+async def test_kv_router_e2e_prefix_affinity():
+    import aiohttp
+
+    workers, frt, svc, base = await _mock_stack()
+    try:
+        entry = svc.manager.get("mock-model")
+        kv_router = entry.chain.downstream.downstream.router  # Migration→Backend→KvPushRouter
+        await kv_router.start()
+        while len(kv_router.workers()) < 2:
+            await asyncio.sleep(0.02)
+
+        shared_prefix = "x" * 64  # 64 byte-tokens = 16 blocks of 4
+        async with aiohttp.ClientSession() as s:
+            # first request seeds one worker's cache
+            async with s.post(
+                f"{base}/v1/completions",
+                json={"model": "mock-model", "prompt": shared_prefix, "max_tokens": 4},
+            ) as r:
+                assert r.status == 200
+            await asyncio.sleep(0.1)  # events propagate
+
+            hs = block_hashes(
+                entry.preprocessor.tokenize_prompt(shared_prefix), 4
+            )
+            m = kv_router.indexer.index.find_matches(hs)
+            assert m.scores, "router should have indexed the first worker's blocks"
+            seeded = max(m.scores, key=lambda w: m.scores[w])
+
+            # follow-ups with the same prefix must hit the seeded worker
+            for i in range(4):
+                token_ids = entry.preprocessor.tokenize_prompt(shared_prefix + str(i))
+                w, overlap, total = kv_router.find_best_match(token_ids)
+                assert w == seeded
+                assert overlap > 0
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for rt, w in workers:
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+async def test_kv_router_e2e_load_spreads_distinct_prompts():
+    workers, frt, svc, base = await _mock_stack(realm="router-e2e-2")
+    try:
+        entry = svc.manager.get("mock-model")
+        kv_router = entry.chain.downstream.downstream.router
+        await kv_router.start()
+        while len(kv_router.workers()) < 2:
+            await asyncio.sleep(0.02)
+
+        targets = set()
+        for i in range(8):
+            token_ids = [100 + i] * 40  # distinct prompts, no overlap
+            w, overlap, total = kv_router.find_best_match(token_ids)
+            kv_router.add_request(f"req-{i}", w, total, overlap)
+            targets.add(w)
+        assert len(targets) == 2, "load-based routing should use both workers"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for rt, w in workers:
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
