@@ -177,24 +177,11 @@ class ModelRunner:
         sampling,  # SamplingParams or dict of host lists
         step: int,
     ) -> np.ndarray:
-        """One decode step over the active batch (padded to a bucket).
+        """One decode step (thin wrapper over the fused loop so single-step
+        and multi-step use the identical compiled path and attn_impl).
         Returns sampled token ids [B_bucket] (host numpy)."""
-        n = len(tokens)
-        B = _next_bucket(self.decode_buckets, n)
-        tok = np.zeros(B, np.int32)
-        tok[:n] = tokens
-        pos = np.full(B, -1, np.int32)
-        pos[:n] = positions
-        kvl = np.zeros(B, np.int32)
-        kvl[:n] = kv_lens
-        pt = self._pad_page_table(page_tables, B)
-
-        logits, self.k_pool, self.v_pool = self._jit_forward(
-            self.params, jnp.asarray(tok)[:, None], jnp.asarray(pos)[:, None],
-            self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kvl),
-        )
-        sampled = self._jit_sample(logits[:, 0, :], _pad_sampling(_as_sampling(sampling), B), jnp.int32(step))
-        return np.asarray(jax.device_get(sampled))
+        out = self.decode_multi(1, tokens, positions, page_tables, sampling, step)
+        return out[:, 0]
 
     def decode_multi(
         self,

@@ -38,6 +38,12 @@ class ModelEntry:
     client: EndpointClient
     chain: AsyncEngine
     instance_ids: Set[int] = field(default_factory=set)
+    teardown: Any = None  # async callable closing chain-owned resources
+
+    async def close(self) -> None:
+        if self.teardown is not None:
+            await self.teardown()
+        await self.client.close()
 
 
 class ModelManager:
@@ -80,16 +86,19 @@ class ModelWatcher:
         # chain_factory(entry_args...) -> AsyncEngine; overridable (kv router)
         self._chain_factory = chain_factory or self._default_chain
 
-    def _default_chain(self, card: ModelCard, client: EndpointClient, pre: Preprocessor) -> AsyncEngine:
+    def _default_chain(self, card: ModelCard, client: EndpointClient, pre: Preprocessor):
+        """Returns (chain, teardown|None)."""
+        teardown = None
         if self.router_mode == "kv":
             from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
 
             kv_router = KvRouter(self.runtime, client, block_size=card.kv_block_size)
             router_engine: AsyncEngine = KvPushRouter(kv_router)
+            teardown = kv_router.stop
         else:
             router_engine = _ClientEngine(client)
         backend = BackendOperator(pre.tokenizer, router_engine)
-        return Migration(backend, migration_limit=self.migration_limit)
+        return Migration(backend, migration_limit=self.migration_limit), teardown
 
     async def start(self) -> None:
         if self._task is None:
@@ -104,7 +113,7 @@ class ModelWatcher:
             self._task.cancel()
             self._task = None
         for entry in self.manager.models.values():
-            await entry.client.close()
+            await entry.close()
         self.manager.models.clear()
 
     async def _watch(self) -> None:
@@ -130,13 +139,15 @@ class ModelWatcher:
             pre = Preprocessor(card)
             client = self.runtime.client(inst.endpoint_address.path, self.router_mode)
             await client.start()
-            chain = self._chain_factory(card, client, pre)
+            made = self._chain_factory(card, client, pre)
+            chain, teardown = made if isinstance(made, tuple) else (made, None)
             entry = ModelEntry(
                 card=card,
                 endpoint_path=inst.endpoint_address.path,
                 preprocessor=pre,
                 client=client,
                 chain=chain,
+                teardown=teardown,
             )
             self.manager.models[card.name] = entry
             log.info("model %s added (endpoint %s)", card.name, entry.endpoint_path)
@@ -149,7 +160,7 @@ class ModelWatcher:
             return
         entry.instance_ids.discard(inst.instance_id)
         if not entry.instance_ids:
-            await entry.client.close()
+            await entry.close()
             del self.manager.models[card.name]
             log.info("model %s removed (last instance gone)", card.name)
 

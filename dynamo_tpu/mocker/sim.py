@@ -64,9 +64,11 @@ class SimRunner:
     def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int):
         t = self.timing
         t.sleep(t.prefill_base_s + len(tokens) * t.prefill_per_token_s)
-        # "logits": seed derived from the full prefix so generation is a
-        # deterministic function of prompt content (prefix-cache friendly)
-        seed = (sum(tokens) + 31 * len(tokens) + prior_len) & 0x7FFFFFFF
+        # "logits": seeded by the LAST prompt token + position only, so the
+        # first sampled token is identical whether the prefix came from
+        # cache or was recomputed (chunk-invariant); subsequent decode
+        # tokens chain deterministically off the fed token
+        seed = tokens[-1] if tokens else 0
         return ("sim-logits", seed, start_pos + len(tokens))
 
     def sample_one(self, logits, sampling, step: int) -> int:

@@ -102,24 +102,22 @@ class KvIndexer:
             return
         self.index.remove_worker(worker)
         # replay the snapshot as store events, parent-first so chains link
+        # (iterative chain walk — lineage chains reach thousands of blocks)
         blocks = {int(h): (int(p) if p is not None else None) for h, p in dump.get("blocks", [])}
         emitted = set()
-
-        def emit(h: int) -> None:
-            if h in emitted or h not in blocks:
-                return
-            p = blocks[h]
-            if p is not None:
-                emit(p)
-            self.index.apply_event(
-                RouterEvent(worker=worker, event_id=0, kind="store",
-                            block_hashes=[h], parent_hash=p),
-                ttl=self.ttl,
-            )
-            emitted.add(h)
-
-        for h in list(blocks):
-            emit(h)
+        for h0 in list(blocks):
+            chain = []
+            h = h0
+            while h is not None and h not in emitted and h in blocks:
+                chain.append(h)
+                h = blocks[h]
+            for h in reversed(chain):
+                self.index.apply_event(
+                    RouterEvent(worker=worker, event_id=0, kind="store",
+                                block_hashes=[h], parent_hash=blocks[h]),
+                    ttl=self.ttl,
+                )
+                emitted.add(h)
         self._last_event_id[worker] = int(dump.get("last_event_id", 0))
 
     async def _resync(self, worker: Worker) -> None:

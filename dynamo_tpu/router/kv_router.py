@@ -69,19 +69,26 @@ class KvRouter:
             for inst in list(self.client.instances.values()):
                 await self._connect_worker(inst)
 
-    async def _on_instance(self, kind: str, inst) -> None:
+    def _on_instance(self, kind: str, inst) -> None:
         worker = (inst.instance_id, 0)
         if kind == "put" and self.use_kv_events:
-            await self._connect_worker(inst)
+            # never block the discovery watch loop on a worker RPC
+            asyncio.create_task(self._connect_worker(inst))
         elif kind == "delete":
             self.indexer.remove_worker(worker)
             self.sequences.remove_worker(worker)
 
     async def _connect_worker(self, inst) -> None:
         addr = (inst.metadata or {}).get("kv_publisher")
-        if addr:
-            self.indexer.connect_publisher(addr)
-            await self.indexer.resync_worker((inst.instance_id, 0))
+        if not addr:
+            return
+        self.indexer.connect_publisher(addr)
+        try:
+            await asyncio.wait_for(
+                self.indexer.resync_worker((inst.instance_id, 0)), timeout=10.0
+            )
+        except asyncio.TimeoutError:
+            log.warning("kv_state seed dump from %x timed out", inst.instance_id)
 
     async def _dump_worker(self, instance_id: int) -> Dict[str, Any]:
         inst = self.client.instances.get(instance_id)
@@ -106,26 +113,23 @@ class KvRouter:
             out.extend((inst.instance_id, r) for r in range(dp))
         return sorted(out)
 
-    def find_best_match(self, token_ids: List[int]) -> Tuple[Worker, int, int]:
-        """Returns (worker, overlap_blocks, total_blocks)."""
+    def find_best_match(self, token_ids: List[int]) -> Tuple[Worker, int, List[int]]:
+        """Returns (worker, overlap_blocks, block_hashes)."""
         hashes = block_hashes(token_ids, self.block_size)
         overlaps = self.indexer.index.find_matches(hashes)
         workers = self.workers()
         worker, overlap = self.selector.select(
             workers, len(hashes), overlaps, self.sequences
         )
-        return worker, overlap, len(hashes)
+        return worker, overlap, hashes
 
     # -- lifecycle charging -------------------------------------------------
     def add_request(
-        self, request_id: str, worker: Worker, total_blocks: int, overlap: int,
-        token_ids: Optional[List[int]] = None,
+        self, request_id: str, worker: Worker, hashes: List[int], overlap: int
     ) -> None:
-        self.sequences.add_request(request_id, worker, total_blocks, overlap)
-        if not self.use_kv_events and token_ids is not None:
+        self.sequences.add_request(request_id, worker, len(hashes), overlap)
+        if not self.use_kv_events and hashes:
             # approximate mode: predict the worker will cache these blocks
-            hashes = block_hashes(token_ids, self.block_size)
-            parent = None
             ev = RouterEvent(worker=worker, event_id=0, kind="store",
                              block_hashes=hashes, parent_hash=None)
             self.indexer.index.apply_event(ev, ttl=self.indexer.ttl)
@@ -149,9 +153,9 @@ class KvPushRouter:
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
         await self.router.start()
         token_ids = request.get("token_ids") or []
-        worker, overlap, total = self.router.find_best_match(token_ids)
+        worker, overlap, hashes = self.router.find_best_match(token_ids)
         rid = context.id
-        self.router.add_request(rid, worker, total, overlap, token_ids=token_ids)
+        self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap
         first = True
         try:

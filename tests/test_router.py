@@ -213,7 +213,7 @@ async def test_kv_router_e2e_prefix_affinity():
             # follow-ups with the same prefix must hit the seeded worker
             for i in range(4):
                 token_ids = entry.preprocessor.tokenize_prompt(shared_prefix + str(i))
-                w, overlap, total = kv_router.find_best_match(token_ids)
+                w, overlap, hashes = kv_router.find_best_match(token_ids)
                 assert w == seeded
                 assert overlap > 0
     finally:
@@ -236,8 +236,8 @@ async def test_kv_router_e2e_load_spreads_distinct_prompts():
         targets = set()
         for i in range(8):
             token_ids = [100 + i] * 40  # distinct prompts, no overlap
-            w, overlap, total = kv_router.find_best_match(token_ids)
-            kv_router.add_request(f"req-{i}", w, total, overlap)
+            w, overlap, hashes = kv_router.find_best_match(token_ids)
+            kv_router.add_request(f"req-{i}", w, hashes, overlap)
             targets.add(w)
         assert len(targets) == 2, "load-based routing should use both workers"
     finally:
