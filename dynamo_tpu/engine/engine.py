@@ -84,6 +84,10 @@ class InferenceEngine:
         self.fpm_history: List[ForwardPassMetrics] = []
         self._fpm_listeners: List[Any] = []
         self._kv_listeners: List[Any] = []
+        # disaggregation state
+        self._parked: Dict[str, tuple] = {}  # rid -> (Sequence, deadline)
+        self._kv_pending: List[Sequence] = []  # disagg-decode awaiting space
+        self.parked_ttl_s = 60.0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -113,14 +117,20 @@ class InferenceEngine:
         rid = context.id
         self._streams[rid] = (out, loop)
 
+        annotations = request.get("annotations") or {}
         seq = Sequence(
             request_id=rid,
             prompt=[int(t) for t in request.get("token_ids") or [0]],
             sampling=request.get("sampling") or {},
             stop=request.get("stop") or {},
             arrival=time.monotonic(),
+            disagg=annotations.get("disagg"),
+            kv_import=request.get("kv_import"),
         )
-        self._inbox.put(("add", seq))
+        if seq.disagg == "decode" and seq.kv_import is not None:
+            self._inbox.put(("add_kv", seq))
+        else:
+            self._inbox.put(("add", seq))
         finished = False
         try:
             while True:
@@ -172,11 +182,57 @@ class InferenceEngine:
             try:
                 op, arg = self._inbox.get_nowait()
             except thread_queue.Empty:
-                return
+                break
             if op == "add":
                 self.scheduler.add(arg)
             elif op == "abort":
                 self.scheduler.abort(arg)
+                parked = self._parked.pop(arg, None)
+                if parked is not None:
+                    self.scheduler.release_parked(parked[0])
+            elif op == "add_kv":
+                self._kv_pending.append(arg)
+            elif op == "export":
+                rid, fut, loop = arg
+                self._export_parked(rid, fut, loop)
+        self._admit_kv_pending()
+        self._expire_parked()
+
+    def _admit_kv_pending(self) -> None:
+        """Disagg-decode sequences: admit + import transferred KV pages."""
+        still: List[Sequence] = []
+        for seq in self._kv_pending:
+            seq.tokens = list(seq.prompt)
+            seq.n_prompt0 = len(seq.prompt)
+            if not self.scheduler.admit_with_kv(seq):
+                still.append(seq)
+                continue
+            payload = seq.kv_import or {}
+            seq.kv_import = None
+            n_kv_pages = (len(seq.prompt) - 1 + self.pool.page_size - 1) // self.pool.page_size
+            target = seq.pages[seq.n_shared_pages:n_kv_pages]
+            if target and payload.get("data"):
+                self.runner.import_pages(target, seq.n_shared_pages, payload)
+        self._kv_pending = still
+
+    def _expire_parked(self) -> None:
+        if not self._parked:
+            return
+        now = time.monotonic()
+        for rid in [r for r, (s, dl) in self._parked.items() if dl < now]:
+            seq, _ = self._parked.pop(rid)
+            self.scheduler.release_parked(seq)
+
+    def _export_parked(self, rid: str, fut, loop) -> None:
+        entry = self._parked.pop(rid, None)
+        if entry is None:
+            loop.call_soon_threadsafe(fut.set_result, None)
+            return
+        seq, _ = entry
+        n_kv_pages = (len(seq.prompt) + self.pool.page_size - 1) // self.pool.page_size
+        payload = self.runner.export_pages(seq.pages[:n_kv_pages])
+        self.scheduler.release_parked(seq)
+        loop.call_soon_threadsafe(fut.set_result, payload)
 
     def _run_prefill(self, plan: PrefillPlan) -> None:
         seq = plan.seq
@@ -187,13 +243,34 @@ class InferenceEngine:
             prior_len=plan.start_pos,
         )
         self.scheduler.complete_prefill(plan)
-        if plan.is_last_chunk:
-            token = self.runner.sample_one(
-                logits, _sampling_params([seq]), self._next_step()
+        if not plan.is_last_chunk:
+            return
+        token = self.runner.sample_one(
+            logits, _sampling_params([seq]), self._next_step()
+        )
+        if seq.disagg == "prefill":
+            # disagg: first token + transfer handle; pages stay pinned for
+            # the decode worker's pull (disagg-serving.md bootstrap model)
+            self.scheduler.park(seq)
+            self._parked[seq.request_id] = (
+                seq, time.monotonic() + self.parked_ttl_s
             )
-            reason = self.scheduler.complete_decode(seq, token, advance_computed=False)
-            emitted = token if reason != "stop" else None
-            self._emit(seq, [token] if emitted is not None else [], reason)
+            self._emit_item(
+                seq,
+                engine_output(
+                    [token],
+                    "prefill_complete",
+                    kv_transfer={
+                        "request_id": seq.request_id,
+                        "prompt_len": len(seq.prompt),
+                        "first_token": token,
+                    },
+                ),
+            )
+            return
+        reason = self.scheduler.complete_decode(seq, token, advance_computed=False)
+        emitted = token if reason != "stop" else None
+        self._emit(seq, [token] if emitted is not None else [], reason)
 
     def _run_decode(self, plan: DecodePlan) -> None:
         """Fused multi-step decode: plan.n_steps iterations in one jit with
@@ -227,12 +304,23 @@ class InferenceEngine:
 
     # -- emission ----------------------------------------------------------
     def _emit(self, seq: Sequence, token_ids: List[int], finish: Optional[str]) -> None:
+        self._emit_item(seq, engine_output(token_ids, finish))
+
+    def _emit_item(self, seq: Sequence, item: Dict[str, Any]) -> None:
         entry = self._streams.get(seq.request_id)
         if entry is None:
             return
         out, loop = entry
-        item = engine_output(token_ids, finish)
         loop.call_soon_threadsafe(out.put_nowait, item)
+
+    # -- disagg export (called from the asyncio side) -----------------------
+    async def export_parked_kv(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """Pull a parked request's KV pages (runs the device read on the
+        step thread between steps); releases the parked pages."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("export", (request_id, fut, loop)))
+        return await fut
 
     def _publish_fpm(self, kind: str, wall: float, n_tok: int) -> None:
         st = self.scheduler.stats

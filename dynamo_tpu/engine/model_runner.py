@@ -221,6 +221,39 @@ class ModelRunner:
             pt[i, : len(row)] = row
         return pt
 
+    # -- disagg KV transfer (host-staged DCN path, SURVEY.md §2.11) ---------
+    def export_pages(self, pages: List[int]) -> Dict[str, Any]:
+        """Device→host read of whole KV pages for P→D transfer. Layout on
+        the wire: [L, Hk, n_pages, PS, D] per pool, raw bytes."""
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        k = np.asarray(jax.device_get(self.k_pool[:, :, idx]))
+        v = np.asarray(jax.device_get(self.v_pool[:, :, idx]))
+        return {
+            "data": True,
+            "k": k.tobytes(),
+            "v": v.tobytes(),
+            "shape": list(k.shape),
+            "dtype": str(self.k_pool.dtype),
+            "n_pages": len(pages),
+        }
+
+    def import_pages(self, target_pages: List[int], offset: int, payload: Dict[str, Any]) -> None:
+        """Host→device write of transferred pages into this pool's page
+        slots. `offset` = first payload page to use (earlier pages were
+        satisfied by the local prefix cache)."""
+        if not payload.get("k"):
+            return
+        import ml_dtypes
+
+        dtype = np.dtype(ml_dtypes.bfloat16) if "bfloat16" in payload["dtype"] else np.dtype(payload["dtype"])
+        shape = tuple(payload["shape"])
+        k = np.frombuffer(payload["k"], dtype=dtype).reshape(shape)
+        v = np.frombuffer(payload["v"], dtype=dtype).reshape(shape)
+        sel = slice(offset, offset + len(target_pages))
+        idx = jnp.asarray(np.asarray(target_pages, np.int32))
+        self.k_pool = self.k_pool.at[:, :, idx].set(jnp.asarray(k[:, :, sel]))
+        self.v_pool = self.v_pool.at[:, :, idx].set(jnp.asarray(v[:, :, sel]))
+
     # -- memory ------------------------------------------------------------
     def kv_pool_bytes(self) -> int:
         return 2 * int(np.prod(self.k_pool.shape)) * self.k_pool.dtype.itemsize

@@ -43,6 +43,11 @@ class Sequence:
     sampling: Dict[str, Any]
     stop: Dict[str, Any]
     arrival: float = 0.0
+    # disaggregation (docs/design-docs/disagg-serving.md roles):
+    #   None = aggregated; "prefill" = compute KV + first token then park;
+    #   "decode" = KV arrives via transfer, skip prefill compute
+    disagg: Optional[str] = None
+    kv_import: Any = None  # opaque page payload for disagg-decode admission
     state: SeqState = SeqState.WAITING
     tokens: List[int] = field(default_factory=list)  # prompt + generated
     pages: List[int] = field(default_factory=list)
@@ -210,6 +215,35 @@ class Scheduler:
         self._register_complete_pages(seq)
         if plan.is_last_chunk:
             seq.state = SeqState.RUNNING
+
+    def park(self, seq: Sequence) -> None:
+        """Disagg-prefill: KV computed; hold pages (still ref'd) for the
+        decode worker's pull, out of the active set."""
+        seq.state = SeqState.FINISHED
+        seq.finish_reason = "prefill_complete"
+        if seq in self.active:
+            self.active.remove(seq)
+
+    def release_parked(self, seq: Sequence) -> None:
+        self.pool.release(seq.pages)
+        seq.pages = []
+
+    def admit_with_kv(self, seq: Sequence) -> bool:
+        """Disagg-decode admission: allocate pages for the full (computed)
+        prompt; caller imports transferred KV into the non-shared pages and
+        the sequence starts RUNNING with no prefill pass.
+
+        The prompt's last token is the prefill-sampled token whose KV is
+        *not* yet computed, so computed_len = len(prompt) - 1."""
+        if len(self.active) >= self.max_batch:
+            return False
+        if not self._try_allocate(seq):
+            return False
+        seq.computed_len = len(seq.prompt) - 1
+        seq.state = SeqState.RUNNING
+        self.active.append(seq)
+        self._register_complete_pages(seq)
+        return True
 
     # -- decode ------------------------------------------------------------
     def _ensure_decode_capacity(

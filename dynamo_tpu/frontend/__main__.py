@@ -27,6 +27,8 @@ def parse_args(argv=None):
         help="worker selection policy (kv = KV-cache-aware)",
     )
     p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--disagg-min-prefill-tokens", type=int, default=256,
+                   help="prompts at least this long go to prefill workers when present")
     p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
     p.add_argument("--discovery-root", default=None, help="file backend root dir")
     return p.parse_args(argv)
@@ -40,7 +42,9 @@ async def async_main(args) -> None:
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     manager = ModelManager()
     watcher = ModelWatcher(
-        runtime, manager, router_mode=args.router_mode, migration_limit=args.migration_limit
+        runtime, manager, router_mode=args.router_mode,
+        migration_limit=args.migration_limit,
+        disagg_min_prefill_tokens=args.disagg_min_prefill_tokens,
     )
     svc = HttpService(runtime, manager, watcher, host=args.http_host, port=args.http_port)
     await svc.start()

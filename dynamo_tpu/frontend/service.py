@@ -39,10 +39,15 @@ class ModelEntry:
     chain: AsyncEngine
     instance_ids: Set[int] = field(default_factory=set)
     teardown: Any = None  # async callable closing chain-owned resources
+    prefill_router: Any = None  # PrefillRouter operator in the chain
+    prefill_client: Any = None
+    prefill_instance_ids: Set[int] = field(default_factory=set)
 
     async def close(self) -> None:
         if self.teardown is not None:
             await self.teardown()
+        if self.prefill_client is not None:
+            await self.prefill_client.close()
         await self.client.close()
 
 
@@ -76,18 +81,26 @@ class ModelWatcher:
         router_mode: str = RouterMode.ROUND_ROBIN,
         migration_limit: int = 3,
         chain_factory=None,
+        disagg_min_prefill_tokens: int = 256,
     ):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.migration_limit = migration_limit
+        self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
         self._task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
+        # prefill-role instances seen before their model entry existed
+        self._pending_prefill: Dict[str, list] = {}
         # chain_factory(entry_args...) -> AsyncEngine; overridable (kv router)
         self._chain_factory = chain_factory or self._default_chain
 
     def _default_chain(self, card: ModelCard, client: EndpointClient, pre: Preprocessor):
-        """Returns (chain, teardown|None)."""
+        """Returns (chain, teardown|None, prefill_router). Order mirrors the
+        reference pipeline: Migration → Backend(detok) → PrefillRouter →
+        router egress (entrypoint/input/common.rs:498-519)."""
+        from dynamo_tpu.router.prefill_router import DisaggPolicy, PrefillRouter
+
         teardown = None
         if self.router_mode == "kv":
             from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
@@ -97,8 +110,12 @@ class ModelWatcher:
             teardown = kv_router.stop
         else:
             router_engine = _ClientEngine(client)
-        backend = BackendOperator(pre.tokenizer, router_engine)
-        return Migration(backend, migration_limit=self.migration_limit), teardown
+        prefill_router = PrefillRouter(
+            router_engine,
+            DisaggPolicy(min_prefill_tokens=self.disagg_min_prefill_tokens),
+        )
+        backend = BackendOperator(pre.tokenizer, prefill_router)
+        return Migration(backend, migration_limit=self.migration_limit), teardown, prefill_router
 
     async def start(self) -> None:
         if self._task is None:
@@ -134,13 +151,19 @@ class ModelWatcher:
             log.exception("model watcher failed")
 
     async def _on_put(self, card: ModelCard, inst) -> None:
+        if (inst.metadata or {}).get("disagg_role") == "prefill":
+            await self._on_prefill_put(card, inst)
+            return
         entry = self.manager.models.get(card.name)
         if entry is None:
             pre = Preprocessor(card)
             client = self.runtime.client(inst.endpoint_address.path, self.router_mode)
             await client.start()
             made = self._chain_factory(card, client, pre)
-            chain, teardown = made if isinstance(made, tuple) else (made, None)
+            if isinstance(made, tuple):
+                chain, teardown, prefill_router = (list(made) + [None, None])[:3]
+            else:
+                chain, teardown, prefill_router = made, None, None
             entry = ModelEntry(
                 card=card,
                 endpoint_path=inst.endpoint_address.path,
@@ -148,15 +171,43 @@ class ModelWatcher:
                 client=client,
                 chain=chain,
                 teardown=teardown,
+                prefill_router=prefill_router,
             )
             self.manager.models[card.name] = entry
             log.info("model %s added (endpoint %s)", card.name, entry.endpoint_path)
+            for pending in self._pending_prefill.pop(card.name, []):
+                await self._on_prefill_put(card, pending)
         entry.instance_ids.add(inst.instance_id)
         self._ready.set()
+
+    async def _on_prefill_put(self, card: ModelCard, inst) -> None:
+        entry = self.manager.models.get(card.name)
+        if entry is None:
+            self._pending_prefill.setdefault(card.name, []).append(inst)
+            return
+        if entry.prefill_router is None:
+            return
+        if entry.prefill_client is None:
+            entry.prefill_client = self.runtime.client(inst.endpoint_address.path)
+            await entry.prefill_client.start()
+            fetch_path = (
+                f"{inst.endpoint_address.namespace}/"
+                f"{inst.endpoint_address.component}/kv_fetch"
+            )
+            entry.prefill_router.activate(entry.prefill_client, fetch_path)
+        entry.prefill_instance_ids.add(inst.instance_id)
 
     async def _on_delete(self, card: ModelCard, inst) -> None:
         entry = self.manager.models.get(card.name)
         if entry is None:
+            return
+        if (inst.metadata or {}).get("disagg_role") == "prefill":
+            entry.prefill_instance_ids.discard(inst.instance_id)
+            if not entry.prefill_instance_ids and entry.prefill_router is not None:
+                entry.prefill_router.deactivate()
+                if entry.prefill_client is not None:
+                    await entry.prefill_client.close()
+                    entry.prefill_client = None
             return
         entry.instance_ids.discard(inst.instance_id)
         if not entry.instance_ids:

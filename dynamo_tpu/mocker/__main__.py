@@ -33,6 +33,7 @@ def parse_args(argv=None):
     p.add_argument("--decode-steps", type=int, default=4)
     p.add_argument("--speed", type=float, default=1.0, help="timing scale; 0 = no sleeps")
     p.add_argument("--decode-base-ms", type=float, default=4.0)
+    p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"])
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
     return p.parse_args(argv)
@@ -69,6 +70,7 @@ async def async_main(args) -> None:
     worker = await serve_worker(
         runtime, engine, card,
         namespace=args.namespace, component=args.component, endpoint=args.endpoint,
+        disagg_role=args.disagg_role,
     )
     print(f"mocker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
     try:

@@ -92,3 +92,10 @@ class SimRunner:
 
     def decode(self, tokens, positions, page_tables, kv_lens, sampling, step):
         return self.decode_multi(1, tokens, positions, page_tables, sampling, step)[:, 0]
+
+    # -- disagg KV transfer (simulated) ------------------------------------
+    def export_pages(self, pages: List[int]):
+        return {"data": True, "sim": True, "n_pages": len(pages)}
+
+    def import_pages(self, target_pages, offset: int, payload) -> None:
+        pass

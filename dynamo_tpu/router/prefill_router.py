@@ -1,0 +1,136 @@
+"""PrefillRouter: disaggregated prefill/decode orchestration.
+
+Analog of reference lib/llm/src/kv_router/prefill_router/ (lifecycle
+{Pending, Active}, admission policy, prefill-hop + transfer-info injection;
+docs/design-docs/disagg-serving.md:20-63), with the TPU transfer model:
+the prefill worker computes KV + the first token and parks the pages; the
+decode worker pulls them worker-to-worker over the request plane
+(host-staged DCN path — the NIXL-RDMA analog on TPU hosts) and resumes
+decode with no prefill recompute.
+
+Pipeline position (entrypoint/input/common.rs:498-519 ordering):
+  Preprocessor → Migration → Backend(detok) → **PrefillRouter** → decode router
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Dict, Optional
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+log = logging.getLogger("dynamo_tpu.prefill_router")
+
+
+@dataclass
+class DisaggPolicy:
+    """Conditional disaggregation (reference conditional_disagg.rs): only
+    prompts at least this long are worth the transfer hop."""
+
+    min_prefill_tokens: int = 256
+    enabled: bool = True
+
+    def should_disagg(self, token_ids) -> bool:
+        return self.enabled and len(token_ids) >= self.min_prefill_tokens
+
+
+class PrefillRouter:
+    """Engine wrapper. Inactive (no prefill workers) → pure passthrough.
+
+    Active: push the request to a prefill worker with disagg=prefill, emit
+    its first token immediately, then push the decode continuation (with
+    the transfer source) to the decode path. Prefill-hop failures fall back
+    to aggregated serving on the decode worker.
+    """
+
+    def __init__(
+        self,
+        downstream: AsyncEngine,
+        policy: Optional[DisaggPolicy] = None,
+    ):
+        self.downstream = downstream
+        self.policy = policy or DisaggPolicy()
+        self._prefill_client = None  # EndpointClient for the prefill component
+        self._fetch_path: Optional[str] = None
+
+    # -- lifecycle (reference activation.rs) --------------------------------
+    def activate(self, prefill_client, fetch_path: str) -> None:
+        self._prefill_client = prefill_client
+        self._fetch_path = fetch_path
+        log.info("prefill router ACTIVE (fetch path %s)", fetch_path)
+
+    def deactivate(self) -> None:
+        self._prefill_client = None
+        self._fetch_path = None
+        log.info("prefill router inactive (no prefill workers)")
+
+    @property
+    def active(self) -> bool:
+        return self._prefill_client is not None and bool(self._prefill_client.instances)
+
+    # -- engine -------------------------------------------------------------
+    async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        token_ids = request.get("token_ids") or []
+        if not self.active or not self.policy.should_disagg(token_ids):
+            async for item in self.downstream.generate(request, context):
+                yield item
+            return
+
+        prefill_result = await self._run_prefill_hop(request, context)
+        if prefill_result is None:  # fall back to aggregated
+            async for item in self.downstream.generate(request, context):
+                yield item
+            return
+
+        first_token, transfer_src, prefill_inst = prefill_result
+        stop = dict(request.get("stop") or {})
+        if first_token in set(stop.get("stop_ids") or []) and not stop.get("ignore_eos"):
+            yield {"token_ids": [], "finish_reason": "stop"}
+            return
+        yield {"token_ids": [first_token], "finish_reason": None}
+        if int(stop.get("max_tokens", 1)) <= 1:
+            yield {"token_ids": [], "finish_reason": "length"}
+            return
+
+        # decode continuation: prompt += first token, budget -= 1
+        dreq = dict(request)
+        dreq["token_ids"] = list(token_ids) + [int(first_token)]
+        stop["max_tokens"] = int(stop.get("max_tokens", 512)) - 1
+        dreq["stop"] = stop
+        ann = dict(dreq.get("annotations") or {})
+        ann["disagg"] = "decode"
+        dreq["annotations"] = ann
+        dreq["kv_transfer_src"] = transfer_src
+
+        async for item in self.downstream.generate(dreq, context):
+            yield item
+
+    async def _run_prefill_hop(self, request, context):
+        preq = dict(request)
+        ann = dict(preq.get("annotations") or {})
+        ann["disagg"] = "prefill"
+        preq["annotations"] = ann
+        pctx = Context(request_id=context.id + ":prefill", parent=context)
+        try:
+            client = self._prefill_client
+            iid, _ = client.router._pick()
+            inst = client.instances.get(iid)
+            async for item in client.direct(preq, iid, pctx):
+                kt = item.get("kv_transfer")
+                if kt is not None:
+                    src = {
+                        "instance_id": iid,
+                        "address": inst.address if inst else "",
+                        "path": self._fetch_path,
+                        "request_id": kt["request_id"],
+                    }
+                    return int(item["token_ids"][0]), src, inst
+            log.warning("prefill hop returned no kv_transfer; falling back")
+            return None
+        except RequestPlaneError as e:
+            log.warning("prefill hop failed (%s); falling back to aggregated", e.code)
+            return None

@@ -44,6 +44,8 @@ def parse_args(argv=None):
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
     # infra
+    p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
+                   help="disaggregation role; prefill workers park KV for decode pulls")
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
     return p.parse_args(argv)
@@ -92,6 +94,7 @@ async def async_main(args) -> None:
     worker = await serve_worker(
         runtime, engine, card,
         namespace=args.namespace, component=args.component, endpoint=args.endpoint,
+        disagg_role=args.disagg_role,
     )
     print(f"worker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
     try:

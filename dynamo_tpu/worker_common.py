@@ -36,6 +36,50 @@ class ServedWorker:
             await self.publisher.stop()
 
 
+class DisaggDecodeAdapter:
+    """Wraps the engine endpoint: requests carrying kv_transfer_src pull
+    the parked KV pages from the prefill worker (worker↔worker over the
+    request plane) before admission — the decode side of the host-staged
+    P→D transfer."""
+
+    def __init__(self, engine: InferenceEngine, runtime: DistributedRuntime):
+        self.engine = engine
+        self.runtime = runtime
+        self._fetch_clients = {}
+
+    async def _fetch(self, src) -> Optional[dict]:
+        path = src["path"]
+        client = self._fetch_clients.get(path)
+        if client is None:
+            client = self.runtime.client(path)
+            await client.start()
+            self._fetch_clients[path] = client
+        client.router.update_instance(src["instance_id"], src["address"])
+        async for item in client.direct({"request_id": src["request_id"]}, src["instance_id"]):
+            return item
+        return None
+
+    async def generate(self, request, context):
+        src = request.get("kv_transfer_src")
+        if src is not None:
+            try:
+                payload = await self._fetch(src)
+            except Exception as e:
+                log.warning("kv fetch from prefill worker failed: %s", e)
+                payload = None
+            request = dict(request)
+            if payload is not None and payload.get("data"):
+                request["kv_import"] = payload
+            else:
+                # transfer failed → recompute prefill locally (aggregated)
+                ann = dict(request.get("annotations") or {})
+                ann.pop("disagg", None)
+                request["annotations"] = ann
+            request.pop("kv_transfer_src", None)
+        async for item in self.engine.generate(request, context):
+            yield item
+
+
 async def serve_worker(
     runtime: DistributedRuntime,
     engine: InferenceEngine,
@@ -46,9 +90,12 @@ async def serve_worker(
     publish_kv_events: bool = True,
     publish_fpm: bool = True,
     dp_rank: int = 0,
+    disagg_role: Optional[str] = None,  # None/"both" | "prefill" | "decode"
 ) -> ServedWorker:
     instance_id = new_instance_id()
     metadata = {"model_card": card.to_dict(), "dp_rank": dp_rank}
+    if disagg_role:
+        metadata["disagg_role"] = disagg_role
 
     publisher = None
     if publish_kv_events:
@@ -82,12 +129,22 @@ async def serve_worker(
         engine.on_fpm(on_fpm)
         metadata["fpm_publisher"] = pub.address
 
+    # disagg endpoints: prefill workers serve parked-KV pulls; decode
+    # workers (and aggregated) accept transfer-carrying requests
+    async def kv_fetch(request, context):
+        return await engine.export_parked_kv((request or {}).get("request_id"))
+
+    await runtime.serve_endpoint(
+        f"{namespace}/{component}/kv_fetch", kv_fetch, instance_id=instance_id
+    )
+    handler = DisaggDecodeAdapter(engine, runtime)
+
     engine.start()
     inst = await runtime.serve_endpoint(
         f"{namespace}/{component}/{endpoint}",
-        engine,
+        handler,
         metadata=metadata,
         instance_id=instance_id,
     )
-    log.info("worker %x serving %s", instance_id, card.name)
+    log.info("worker %x serving %s (role=%s)", instance_id, card.name, disagg_role or "both")
     return ServedWorker(runtime, engine, inst, publisher)

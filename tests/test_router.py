@@ -188,7 +188,8 @@ async def test_kv_router_e2e_prefix_affinity():
     workers, frt, svc, base = await _mock_stack()
     try:
         entry = svc.manager.get("mock-model")
-        kv_router = entry.chain.downstream.downstream.router  # Migration→Backend→KvPushRouter
+        # Migration→Backend→PrefillRouter→KvPushRouter
+        kv_router = entry.chain.downstream.downstream.downstream.router
         await kv_router.start()
         while len(kv_router.workers()) < 2:
             await asyncio.sleep(0.02)
@@ -228,7 +229,7 @@ async def test_kv_router_e2e_load_spreads_distinct_prompts():
     workers, frt, svc, base = await _mock_stack(realm="router-e2e-2")
     try:
         entry = svc.manager.get("mock-model")
-        kv_router = entry.chain.downstream.downstream.router
+        kv_router = entry.chain.downstream.downstream.downstream.router
         await kv_router.start()
         while len(kv_router.workers()) < 2:
             await asyncio.sleep(0.02)
