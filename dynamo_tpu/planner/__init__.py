@@ -1,0 +1,4 @@
+"""SLA-driven autoscaling planner (analog of reference dynamo.planner,
+docs/design-docs/planner-design.md): a control loop OBSERVE → PREDICT →
+PROPOSE → CONSTRAIN → EXECUTE over FPM engine metrics, scaling prefill and
+decode worker counts through pluggable connectors."""

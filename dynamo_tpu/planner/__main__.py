@@ -1,0 +1,98 @@
+"""`python -m dynamo_tpu.planner` — autoscaler process.
+
+Analog of reference `python -m dynamo.planner`: watches worker discovery to
+find FPM publishers, runs the tick loop, and executes decisions through the
+selected connector (virtual decision files, or local process spawning)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from dynamo_tpu.planner.connector import LocalProcessConnector, VirtualConnector
+from dynamo_tpu.planner.observer import FpmObserver
+from dynamo_tpu.planner.planner import Planner, PlannerConfig, SloConfig
+from dynamo_tpu.router.protocols import FPM_SUBJECT
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging_util import configure_logging
+
+log = logging.getLogger("dynamo_tpu.planner.main")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.planner")
+    p.add_argument("--mode", default="load", choices=["load", "throughput"])
+    p.add_argument("--tick-interval", type=float, default=10.0)
+    p.add_argument("--predictor", default="ema", choices=["constant", "ema", "trend"])
+    p.add_argument("--ttft-slo", type=float, default=2.0)
+    p.add_argument("--itl-slo", type=float, default=0.05)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--connector", default="virtual", choices=["virtual", "local"])
+    p.add_argument("--virtual-root", default="/tmp/dynamo_tpu_planner")
+    p.add_argument(
+        "--local-worker-cmd",
+        default=None,
+        help="shell command for spawning one worker (local connector)",
+    )
+    p.add_argument("--discovery-backend", default=None)
+    p.add_argument("--discovery-root", default=None)
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    configure_logging()
+    kw = {}
+    if args.discovery_root:
+        kw["root"] = args.discovery_root
+    runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
+
+    observer = FpmObserver(runtime.event_subscriber([FPM_SUBJECT]))
+    if args.connector == "local":
+        if not args.local_worker_cmd:
+            sys.exit("--local-worker-cmd required for the local connector")
+        connector = LocalProcessConnector({"decode": args.local_worker_cmd.split()})
+    else:
+        connector = VirtualConnector(args.virtual_root)
+
+    config = PlannerConfig(
+        mode=args.mode,
+        tick_interval_s=args.tick_interval,
+        predictor=args.predictor,
+        slo=SloConfig(ttft_s=args.ttft_slo, itl_s=args.itl_slo),
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+    )
+    planner = Planner(observer, connector, config)
+
+    # wire FPM publishers as workers come and go
+    async def watch_workers():
+        async for ev in runtime.discovery.watch("services/"):
+            addr = (ev.instance.metadata or {}).get("fpm_publisher")
+            if ev.kind == "put" and addr:
+                observer.connect_publisher(addr)
+
+    watcher = asyncio.create_task(watch_workers())
+    await planner.start()
+    print("planner running", flush=True)
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        watcher.cancel()
+        await planner.stop()
+        await runtime.shutdown()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(async_main(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""EXECUTE stage connectors (reference planner connectors,
+planner-design.md:171-207).
+
+- VirtualConnector: publishes the decision for an external actuator and
+  waits for acknowledgment (decision-handshake model) — the file-backed
+  variant works across processes; tests and the k8s-less deployments use it.
+- LocalProcessConnector: actually spawns/kills local worker processes
+  (mocker or TPU workers) — the single-host realization of scaling.
+- KubernetesConnector: would PATCH the graph deployment CRD; stubbed until
+  the operator milestone (no k8s client in this environment).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+log = logging.getLogger("dynamo_tpu.planner.connector")
+
+
+@dataclass
+class ScaleDecision:
+    decision_id: int
+    component: str  # "prefill" | "decode"
+    target_replicas: int
+    ts: float = field(default_factory=time.time)
+
+
+class Connector:
+    async def scale_to(self, component: str, target_replicas: int) -> None:
+        raise NotImplementedError
+
+    async def current_replicas(self, component: str) -> Optional[int]:
+        return None
+
+
+class VirtualConnector(Connector):
+    """Writes decisions to `{root}/decisions.jsonl`; an external poller
+    applies them and appends to `{root}/acks.jsonl`."""
+
+    def __init__(self, root: str):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._next_id = 1
+        self.decisions: List[ScaleDecision] = []
+
+    async def scale_to(self, component: str, target_replicas: int) -> None:
+        d = ScaleDecision(self._next_id, component, target_replicas)
+        self._next_id += 1
+        self.decisions.append(d)
+        with open(self.root / "decisions.jsonl", "a") as f:
+            f.write(json.dumps(d.__dict__) + "\n")
+        log.info("decision %d: scale %s -> %d", d.decision_id, component, target_replicas)
+
+    def acked(self) -> int:
+        """Highest acknowledged decision id."""
+        try:
+            lines = (self.root / "acks.jsonl").read_text().splitlines()
+            return max(json.loads(l)["decision_id"] for l in lines) if lines else 0
+        except FileNotFoundError:
+            return 0
+
+
+class LocalProcessConnector(Connector):
+    """Spawns/terminates worker subprocesses to honor the target count."""
+
+    def __init__(self, command_for_component: Dict[str, List[str]]):
+        self._cmds = command_for_component
+        self._procs: Dict[str, List[subprocess.Popen]] = {c: [] for c in command_for_component}
+
+    async def scale_to(self, component: str, target_replicas: int) -> None:
+        procs = self._procs[component]
+        procs[:] = [p for p in procs if p.poll() is None]
+        while len(procs) < target_replicas:
+            p = subprocess.Popen(
+                self._cmds[component],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+            procs.append(p)
+            log.info("spawned %s worker pid=%d", component, p.pid)
+        while len(procs) > target_replicas:
+            p = procs.pop()
+            p.send_signal(signal.SIGINT)  # graceful drain
+            log.info("stopping %s worker pid=%d", component, p.pid)
+
+    async def current_replicas(self, component: str) -> int:
+        self._procs[component] = [p for p in self._procs[component] if p.poll() is None]
+        return len(self._procs[component])
+
+    def shutdown(self) -> None:
+        for procs in self._procs.values():
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+
+class KubernetesConnector(Connector):  # pragma: no cover
+    """PATCHes the DynamoGraphDeployment-analog CRD; requires a cluster."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "kubernetes connector requires a cluster client; use virtual or "
+            "local-process connectors in this environment"
+        )

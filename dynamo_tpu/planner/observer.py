@@ -1,0 +1,96 @@
+"""OBSERVE stage: subscribe to ForwardPassMetrics on the event plane and
+maintain per-worker sliding windows (reference FpmEventSubscriber,
+planner-design.md:237-246)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dynamo_tpu.router.protocols import FPM_SUBJECT
+from dynamo_tpu.runtime.event_plane import EventSubscriber
+
+log = logging.getLogger("dynamo_tpu.planner.observer")
+
+Worker = Tuple[int, int]
+
+
+@dataclass
+class WorkerLoad:
+    """Aggregated over the observation window."""
+
+    worker: Worker
+    n_samples: int = 0
+    decode_tok_s: float = 0.0  # decoded tokens per second
+    prefill_tok_s: float = 0.0
+    mean_running: float = 0.0
+    mean_waiting: float = 0.0
+    kv_usage: float = 0.0
+    mean_decode_step_s: float = 0.0  # ITL proxy
+    last_seen: float = 0.0
+
+
+class FpmObserver:
+    def __init__(self, subscriber: EventSubscriber, window_s: float = 30.0):
+        self._sub = subscriber
+        self.window_s = window_s
+        self._samples: Dict[Worker, Deque[dict]] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def connect_publisher(self, address: str) -> None:
+        self._sub.connect(address)
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._consume())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _consume(self) -> None:
+        try:
+            async for subject, payload in self._sub.events():
+                if subject != FPM_SUBJECT:
+                    continue
+                worker = tuple(payload.get("worker") or (0, 0))
+                q = self._samples.setdefault(worker, deque(maxlen=4096))
+                q.append(payload)
+        except asyncio.CancelledError:
+            pass
+
+    def ingest(self, payload: dict) -> None:
+        """Direct feed (tests / in-process planners)."""
+        worker = tuple(payload.get("worker") or (0, 0))
+        self._samples.setdefault(worker, deque(maxlen=4096)).append(payload)
+
+    # -- aggregation --------------------------------------------------------
+    def loads(self, now: Optional[float] = None) -> List[WorkerLoad]:
+        now = now if now is not None else time.time()
+        cutoff = now - self.window_s
+        out: List[WorkerLoad] = []
+        for worker, q in list(self._samples.items()):
+            recent = [s for s in q if s.get("ts", 0) >= cutoff]
+            if not recent:
+                if q and now - q[-1].get("ts", 0) > 3 * self.window_s:
+                    del self._samples[worker]  # worker gone
+                continue
+            wl = WorkerLoad(worker=worker, n_samples=len(recent))
+            span = max(1e-6, now - min(s["ts"] for s in recent))
+            dec = [s for s in recent if s.get("kind") == "decode"]
+            pre = [s for s in recent if s.get("kind") == "prefill"]
+            wl.decode_tok_s = sum(s.get("scheduled_tokens", 0) for s in dec) / span
+            wl.prefill_tok_s = sum(s.get("scheduled_tokens", 0) for s in pre) / span
+            wl.mean_running = sum(s.get("n_running", 0) for s in recent) / len(recent)
+            wl.mean_waiting = sum(s.get("n_waiting", 0) for s in recent) / len(recent)
+            wl.kv_usage = sum(s.get("kv_usage", 0.0) for s in recent) / len(recent)
+            if dec:
+                wl.mean_decode_step_s = sum(s.get("wall_time_s", 0.0) for s in dec) / len(dec)
+            wl.last_seen = max(s.get("ts", 0) for s in recent)
+            out.append(wl)
+        return out

@@ -1,0 +1,156 @@
+"""Planner core: the OBSERVE → PREDICT → PROPOSE → CONSTRAIN → EXECUTE tick
+loop (reference NativePlannerBase + orchestrator plugin pipeline,
+planner-design.md:13-41).
+
+Two proposal policies, mirroring the reference's two modes:
+- load-based (±1): react to sustained pressure signals — waiting queues,
+  KV-cache usage, decode-step latency above SLO (planner-design.md:259-269);
+- throughput-based: predict demand (tok/s) per component, divide by the
+  per-replica capacity learned from live FPM, clamp to the SLO headroom
+  factor (planner-design.md:125-156's perf-model shape, bootstrapped from
+  live metrics instead of offline NPZ profiles).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dynamo_tpu.planner.connector import Connector
+from dynamo_tpu.planner.observer import FpmObserver, WorkerLoad
+from dynamo_tpu.planner.predictors import Predictor, make_predictor
+
+log = logging.getLogger("dynamo_tpu.planner")
+
+
+@dataclass
+class SloConfig:
+    ttft_s: float = 2.0  # time-to-first-token target
+    itl_s: float = 0.05  # inter-token latency target (decode step proxy)
+
+
+@dataclass
+class PlannerConfig:
+    mode: str = "load"  # "load" | "throughput"
+    tick_interval_s: float = 10.0
+    window_s: float = 30.0
+    predictor: str = "ema"
+    slo: SloConfig = field(default_factory=SloConfig)
+    # load mode thresholds
+    kv_usage_high: float = 0.85
+    kv_usage_low: float = 0.3
+    waiting_high: float = 1.0  # mean queued requests per worker
+    # throughput mode
+    headroom: float = 1.3  # provision this factor above predicted demand
+    # constraints
+    min_replicas: int = 1
+    max_replicas: int = 8
+    components: tuple = ("decode",)  # scale decode (and "prefill" if disagg)
+
+
+class Planner:
+    def __init__(
+        self,
+        observer: FpmObserver,
+        connector: Connector,
+        config: Optional[PlannerConfig] = None,
+    ):
+        self.observer = observer
+        self.connector = connector
+        self.config = config or PlannerConfig()
+        self._predictors: Dict[str, Predictor] = {
+            c: make_predictor(self.config.predictor) for c in self.config.components
+        }
+        self.targets: Dict[str, int] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.history: List[dict] = []
+
+    # component membership: callers register worker → component mapping
+    # (discovery metadata disagg_role drives this in the service wiring)
+    def component_of(self, load: WorkerLoad) -> str:
+        return "decode"
+
+    async def start(self) -> None:
+        await self.observer.start()
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        await self.observer.stop()
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.config.tick_interval_s)
+                await self.tick()
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # pragma: no cover
+            log.exception("planner loop failed")
+
+    # -- one tick -----------------------------------------------------------
+    async def tick(self, now: Optional[float] = None) -> Dict[str, int]:
+        cfg = self.config
+        loads = self.observer.loads(now)
+        by_comp: Dict[str, List[WorkerLoad]] = {c: [] for c in cfg.components}
+        for wl in loads:
+            comp = self.component_of(wl)
+            if comp in by_comp:
+                by_comp[comp].append(wl)
+
+        decisions: Dict[str, int] = {}
+        for comp, comp_loads in by_comp.items():
+            current = self.targets.get(comp) or max(1, len(comp_loads))
+            if cfg.mode == "throughput":
+                target = self._propose_throughput(comp, comp_loads, current)
+            else:
+                target = self._propose_load(comp, comp_loads, current)
+            target = max(cfg.min_replicas, min(cfg.max_replicas, target))  # CONSTRAIN
+            decisions[comp] = target
+            if target != current:
+                await self.connector.scale_to(comp, target)  # EXECUTE
+            self.targets[comp] = target
+
+        self.history.append({"ts": now or time.time(), "targets": dict(decisions)})
+        return decisions
+
+    # -- PROPOSE: load-based ±1 --------------------------------------------
+    def _propose_load(self, comp: str, loads: List[WorkerLoad], current: int) -> int:
+        if not loads:
+            return current
+        cfg = self.config
+        mean_kv = sum(l.kv_usage for l in loads) / len(loads)
+        mean_wait = sum(l.mean_waiting for l in loads) / len(loads)
+        mean_itl = sum(l.mean_decode_step_s for l in loads) / len(loads)
+        pressured = (
+            mean_kv > cfg.kv_usage_high
+            or mean_wait > cfg.waiting_high
+            or mean_itl > cfg.slo.itl_s
+        )
+        idle = mean_kv < cfg.kv_usage_low and mean_wait < 0.1 and current > 1
+        if pressured:
+            return current + 1
+        if idle:
+            return current - 1
+        return current
+
+    # -- PROPOSE: throughput-based -----------------------------------------
+    def _propose_throughput(self, comp: str, loads: List[WorkerLoad], current: int) -> int:
+        if not loads:
+            return current
+        cfg = self.config
+        demand = sum(l.decode_tok_s + l.prefill_tok_s for l in loads)
+        self._predictors[comp].observe(demand)
+        predicted = self._predictors[comp].predict()
+        # per-replica capacity: best observed rate, bounded away from 0
+        per_replica = max(
+            1e-6, max(l.decode_tok_s + l.prefill_tok_s for l in loads)
+        )
+        needed = predicted * cfg.headroom / per_replica
+        return max(1, round(needed))

@@ -1,0 +1,74 @@
+"""PREDICT stage: load forecasting (reference builtin_load_predict with
+Constant/ARIMA/Kalman/Prophet backends, planner-design.md:125-135 — here
+Constant, EMA, and linear-trend least squares; heavier models plug in via
+the same interface)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+
+class Predictor:
+    def observe(self, value: float) -> None:
+        raise NotImplementedError
+
+    def predict(self, horizon_steps: int = 1) -> float:
+        raise NotImplementedError
+
+
+class ConstantPredictor(Predictor):
+    def __init__(self):
+        self._last = 0.0
+
+    def observe(self, value: float) -> None:
+        self._last = value
+
+    def predict(self, horizon_steps: int = 1) -> float:
+        return self._last
+
+
+class EmaPredictor(Predictor):
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self._ema: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        self._ema = value if self._ema is None else (
+            self.alpha * value + (1 - self.alpha) * self._ema
+        )
+
+    def predict(self, horizon_steps: int = 1) -> float:
+        return self._ema or 0.0
+
+
+class TrendPredictor(Predictor):
+    """Least-squares linear trend over a sliding window, clamped at 0."""
+
+    def __init__(self, window: int = 20):
+        self._vals: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._vals.append(value)
+
+    def predict(self, horizon_steps: int = 1) -> float:
+        n = len(self._vals)
+        if n == 0:
+            return 0.0
+        if n == 1:
+            return self._vals[0]
+        xs = range(n)
+        mean_x = (n - 1) / 2
+        mean_y = sum(self._vals) / n
+        cov = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, self._vals))
+        var = sum((x - mean_x) ** 2 for x in xs)
+        slope = cov / var if var else 0.0
+        return max(0.0, mean_y + slope * (n - 1 - mean_x + horizon_steps))
+
+
+def make_predictor(kind: str) -> Predictor:
+    return {
+        "constant": ConstantPredictor,
+        "ema": EmaPredictor,
+        "trend": TrendPredictor,
+    }[kind]()

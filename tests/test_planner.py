@@ -1,0 +1,164 @@
+"""Planner tests: observer aggregation, predictors, load/throughput
+proposals with constraints, virtual connector handshake, and live FPM flow
+from a mocker engine."""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.planner.connector import VirtualConnector
+from dynamo_tpu.planner.observer import FpmObserver
+from dynamo_tpu.planner.planner import Planner, PlannerConfig, SloConfig
+from dynamo_tpu.planner.predictors import make_predictor
+from dynamo_tpu.runtime.event_plane import make_subscriber
+
+
+def _fpm(worker, kind="decode", tokens=32, running=4, waiting=0, kv=0.5, wall=0.02, ts=None):
+    return {
+        "ts": ts if ts is not None else time.time(),
+        "kind": kind,
+        "wall_time_s": wall,
+        "scheduled_tokens": tokens,
+        "n_running": running,
+        "n_waiting": waiting,
+        "kv_usage": kv,
+        "worker": list(worker),
+    }
+
+
+def _observer():
+    return FpmObserver(make_subscriber("inproc", subjects=["fpm"]), window_s=30)
+
+
+# -- observer ---------------------------------------------------------------
+
+
+def test_observer_aggregates_recent_window():
+    obs = _observer()
+    now = time.time()
+    for i in range(10):
+        obs.ingest(_fpm((1, 0), tokens=32, ts=now - i))
+    obs.ingest(_fpm((1, 0), tokens=9999, ts=now - 100))  # outside window
+    loads = obs.loads(now)
+    assert len(loads) == 1
+    wl = loads[0]
+    assert wl.n_samples == 10
+    assert 10 < wl.decode_tok_s < 40  # 320 tokens over ~9-30s span
+
+
+# -- predictors -------------------------------------------------------------
+
+
+def test_predictors():
+    c = make_predictor("constant")
+    c.observe(5.0)
+    assert c.predict() == 5.0
+
+    e = make_predictor("ema")
+    for v in (10, 10, 10):
+        e.observe(v)
+    assert abs(e.predict() - 10) < 1e-6
+
+    t = make_predictor("trend")
+    for v in (1, 2, 3, 4, 5):
+        t.observe(v)
+    assert t.predict(1) > 5  # rising trend extrapolates up
+
+
+# -- proposals --------------------------------------------------------------
+
+
+async def test_load_mode_scales_up_on_pressure_down_on_idle():
+    obs = _observer()
+    conn = VirtualConnector("/tmp/test_planner_v1")
+    cfg = PlannerConfig(mode="load", components=("decode",), max_replicas=4)
+    p = Planner(obs, conn, cfg)
+    now = time.time()
+
+    # pressure: queue + high kv
+    for i in range(5):
+        obs.ingest(_fpm((1, 0), waiting=5, kv=0.95, ts=now - i))
+    d = await p.tick(now)
+    assert d["decode"] == 2
+    assert conn.decisions[-1].target_replicas == 2
+
+    # idle: scale back down (from the planner's current target of 2)
+    obs2 = _observer()
+    p.observer = obs2
+    for i in range(5):
+        obs2.ingest(_fpm((1, 0), waiting=0, kv=0.05, ts=now - i))
+        obs2.ingest(_fpm((2, 0), waiting=0, kv=0.05, ts=now - i))
+    d = await p.tick(now)
+    assert d["decode"] == 1
+
+
+async def test_load_mode_respects_max_replicas():
+    obs = _observer()
+    conn = VirtualConnector("/tmp/test_planner_v2")
+    p = Planner(obs, conn, PlannerConfig(mode="load", max_replicas=2))
+    now = time.time()
+    for tick in range(4):
+        for i in range(5):
+            obs.ingest(_fpm((1, 0), waiting=9, kv=0.99, ts=now - i))
+        d = await p.tick(now)
+    assert d["decode"] == 2  # clamped
+
+
+async def test_throughput_mode_provisions_headroom():
+    obs = _observer()
+    conn = VirtualConnector("/tmp/test_planner_v3")
+    cfg = PlannerConfig(mode="throughput", predictor="constant", headroom=1.5)
+    p = Planner(obs, conn, cfg)
+    now = time.time()
+    # 3 workers each pushing ~100 tok/s → demand 300, capacity 100/replica,
+    # need ceil(300*1.5/100) ≈ 4-5
+    for w in (1, 2, 3):
+        for i in range(10):
+            obs.ingest(_fpm((w, 0), tokens=300, ts=now - i * 3))
+    d = await p.tick(now)
+    assert 4 <= d["decode"] <= 6
+
+
+def test_virtual_connector_ack_roundtrip(tmp_path):
+    import json
+
+    conn = VirtualConnector(str(tmp_path))
+    asyncio.run(conn.scale_to("decode", 3))
+    assert conn.acked() == 0
+    (tmp_path / "acks.jsonl").write_text(json.dumps({"decision_id": 1}) + "\n")
+    assert conn.acked() == 1
+
+
+# -- live FPM from a mocker engine ------------------------------------------
+
+
+async def test_fpm_flows_from_engine_to_observer():
+    from dynamo_tpu.frontend.protocols import ModelCard
+    from dynamo_tpu.mocker.__main__ import build_mock_engine, parse_args
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="fpm"), event_transport="inproc")
+    args = parse_args(["--speed", "0", "--page-size", "4"])
+    engine, card = build_mock_engine(args)
+    w = await serve_worker(rt, engine, card)
+
+    obs = FpmObserver(rt.event_subscriber(["fpm"]), window_s=30)
+    obs.connect_publisher(w.instance.metadata["fpm_publisher"])
+    await obs.start()
+
+    req = {"token_ids": [1, 2, 3, 4], "sampling": {}, "stop": {"max_tokens": 8, "stop_ids": []}}
+    async for item in engine.generate(req, Context()):
+        if item["finish_reason"]:
+            break
+    await asyncio.sleep(0.2)
+
+    loads = obs.loads()
+    assert loads and loads[0].worker == (w.instance.instance_id, 0)
+    assert loads[0].decode_tok_s > 0
+    await obs.stop()
+    await w.stop()
+    await rt.shutdown(drain_timeout=1)
