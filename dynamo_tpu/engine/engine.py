@@ -65,15 +65,26 @@ class InferenceEngine:
         chunk_size: int = 512,
         decode_steps: int = 4,
         idle_sleep_s: float = 0.002,
+        host_kv_blocks: int = 0,  # G2 host-tier capacity (0 = disabled)
     ):
         self.runner = runner
         self.pool = PagePool(runner.num_pages, runner.page_size)
+        self.host_pool = None
+        self._host_events: List[KvEvent] = []
+        if host_kv_blocks > 0:
+            from dynamo_tpu.kvbm.host_pool import HostKvPool
+
+            self.host_pool = HostKvPool(capacity_blocks=host_kv_blocks)
+            self.pool.evict_hook = self._offload_page
+            self.host_pool.on_evict(self._on_host_evicted)
         self.scheduler = Scheduler(
             self.pool,
             max_batch=max_batch,
             chunk_size=chunk_size,
             max_seq_pages=runner.max_pages_per_seq,
             decode_steps=decode_steps,
+            host_tier=self.host_pool,
+            host_onboard=self._onboard_from_host if self.host_pool is not None else None,
         )
         self.idle_sleep_s = idle_sleep_s
         self._inbox: thread_queue.Queue = thread_queue.Queue()
@@ -343,7 +354,8 @@ class InferenceEngine:
                 log.exception("fpm listener failed")
 
     def _publish_kv_events(self) -> None:
-        events = self.pool.drain_events()
+        events = self.pool.drain_events() + self._host_events
+        self._host_events = []
         if not events:
             return
         for cb in self._kv_listeners:
@@ -351,6 +363,39 @@ class InferenceEngine:
                 cb(events)
             except Exception:  # pragma: no cover
                 log.exception("kv listener failed")
+
+    # -- KVBM G2 tier (step-thread callbacks) -------------------------------
+    def _offload_page(self, page: int, block_hash: int, parent: Optional[int]) -> None:
+        """Device page being evicted → copy its KV to the host tier."""
+        payload = self.runner.export_pages([page])
+        k = v = None
+        if payload.get("k"):
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16) if "bfloat16" in payload["dtype"] else np.dtype(payload["dtype"])
+            shape = tuple(payload["shape"])
+            k = np.frombuffer(payload["k"], dtype=dtype).reshape(shape)
+            v = np.frombuffer(payload["v"], dtype=dtype).reshape(shape)
+        self.host_pool.put([block_hash], [parent], k, v)
+        self._host_events.append(KvEvent("store", [block_hash], parent, tier="host"))
+
+    def _on_host_evicted(self, hashes: List[int]) -> None:
+        self._host_events.append(KvEvent("remove", hashes, tier="host"))
+
+    def _onboard_from_host(self, pages: List[int], hashes: List[int]) -> bool:
+        """Host-tier blocks → device pages during admission."""
+        k, v = self.host_pool.get(hashes)
+        if k is not None:
+            payload = {
+                "data": True,
+                "k": k.tobytes(),
+                "v": v.tobytes(),
+                "shape": list(k.shape),
+                "dtype": "bfloat16",
+                "n_pages": len(pages),
+            }
+            self.runner.import_pages(pages, 0, payload)
+        return True
 
 
 def _sampling_params(seqs: List[Sequence]) -> Dict[str, list]:

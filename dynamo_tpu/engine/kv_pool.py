@@ -30,6 +30,7 @@ class KvEvent:
     block_hashes: List[int]
     # parent hash of the first stored block (lineage anchoring), store only
     parent_hash: Optional[int] = None
+    tier: str = "device"  # "device" (G1) | "host" (G2) — router credit tiers
 
 
 class NoSpace(Exception):
@@ -47,7 +48,11 @@ class PagePool:
         self.hash_of: Dict[int, int] = {}  # page -> block_hash
         # cached = registered pages with ref 0, LRU order (evict from front)
         self.cached: "OrderedDict[int, None]" = OrderedDict()
+        self.parent_of: Dict[int, Optional[int]] = {}  # hash -> parent hash
         self.events: List[KvEvent] = []
+        # offload hook: cb(page, block_hash, parent_hash) invoked just
+        # before an evicted page's slot is reused (KVBM G1→G2 offload)
+        self.evict_hook = None
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -61,11 +66,14 @@ class PagePool:
     def _pop_free(self) -> int:
         if self.free:
             return self.free.pop()
-        # evict LRU cached page
+        # evict LRU cached page (offloading its contents first if hooked)
         if self.cached:
             page, _ = self.cached.popitem(last=False)
             h = self.hash_of.pop(page)
             del self.by_hash[h]
+            parent = self.parent_of.pop(h, None)
+            if self.evict_hook is not None:
+                self.evict_hook(page, h, parent)
             self.events.append(KvEvent("remove", [h]))
             return page
         raise NoSpace("no free or evictable pages")
@@ -118,6 +126,7 @@ class PagePool:
             return existing
         self.by_hash[block_hash] = page
         self.hash_of[page] = block_hash
+        self.parent_of[block_hash] = parent_hash
         self.events.append(KvEvent("store", [block_hash], parent_hash))
         return page
 

@@ -24,7 +24,7 @@ from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
 
 from dynamo_tpu.engine.kv_pool import NoSpace, PagePool
-from dynamo_tpu.tokens.hashing import hash_block
+from dynamo_tpu.tokens.hashing import block_hashes, hash_block
 
 log = logging.getLogger("dynamo_tpu.engine.scheduler")
 
@@ -101,6 +101,8 @@ class Scheduler:
         max_seq_pages: int = 128,
         enable_prefix_cache: bool = True,
         decode_steps: int = 1,
+        host_tier=None,  # HostKvPool-like: .match(hashes) -> n
+        host_onboard=None,  # cb(pages, hashes) -> bool (imports G2→G1 data)
     ):
         self.pool = pool
         self.max_batch = max_batch
@@ -108,6 +110,8 @@ class Scheduler:
         self.max_seq_pages = max_seq_pages
         self.enable_prefix_cache = enable_prefix_cache
         self.decode_steps = decode_steps
+        self.host_tier = host_tier
+        self.host_onboard = host_onboard
         self.waiting: deque[Sequence] = deque()
         self.active: List[Sequence] = []
         self.stats = SchedulerStats()
@@ -176,14 +180,26 @@ class Scheduler:
         prompt = seq.prompt
         matched_pages: List[int] = []
         hashes: List[int] = []
-        if self.enable_prefix_cache and seq.n_preemptions == 0:
+        use_cache = self.enable_prefix_cache and seq.n_preemptions == 0
+        max_shared = (len(prompt) - 1) // PS
+        if use_cache:
             matched_pages, hashes = self.pool.match_prefix(prompt)
             # never share the page containing the final prompt token: its
             # logits must be recomputed, so cap the match below it
-            max_shared = (len(prompt) - 1) // PS
             while len(matched_pages) > max_shared:
                 self.pool.release([matched_pages.pop()])
                 hashes.pop()
+
+        # G2 host-tier continuation: blocks beyond the device match that the
+        # host pool holds get onboarded into freshly-allocated pages
+        host_n = 0
+        host_hashes: List[int] = []
+        if use_cache and self.host_tier is not None and self.host_onboard is not None:
+            all_hashes = block_hashes(prompt, PS)
+            candidates = all_hashes[len(matched_pages):max_shared]
+            host_n = self.host_tier.match(candidates)
+            host_hashes = candidates[:host_n]
+
         match_len = len(matched_pages) * PS
         # pages for the rest of the prompt plus the first generated token
         need = -(-(len(prompt) + 1) // PS) - len(matched_pages)
@@ -192,6 +208,20 @@ class Scheduler:
         except NoSpace:
             self.pool.release(matched_pages)
             return False
+
+        if host_n:
+            if self.host_onboard(fresh[:host_n], host_hashes):
+                parent = hashes[-1] if hashes else None
+                for page, h in zip(fresh[:host_n], host_hashes):
+                    canonical = self.pool.register(page, h, parent)
+                    if canonical != page:  # raced with another registration
+                        self.pool._ref_inc(canonical)
+                        self.pool.release([page])
+                        fresh[fresh.index(page)] = canonical
+                    parent = h
+                hashes = hashes + host_hashes
+                match_len = (len(matched_pages) + host_n) * PS
+
         seq.pages = matched_pages + fresh
         seq.n_shared_pages = len(matched_pages)
         seq.hash_chain = hashes

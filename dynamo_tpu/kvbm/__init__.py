@@ -1,0 +1,3 @@
+"""Multi-tier KV block manager (analog of reference KVBM v2 crates,
+lib/kvbm-{logical,physical,engine}: G1 = TPU HBM paged pool, G2 = host
+DRAM, G3 = NVMe (later), G4 = object store (later))."""

@@ -1,0 +1,106 @@
+"""G2 host-DRAM KV block pool.
+
+Analog of the reference's G2 tier (lib/kvbm-engine/src/lib.rs:9-24 tier
+model; kvbm-logical block registry + dedup + LRU): content-addressed
+storage of complete KV blocks evicted from device HBM, onboarded back on
+prefix-cache hits. The TPU "transfer manager" here is a host array copy —
+device↔host movement happens via the runner's export/import (the same
+primitives the P→D disagg path uses; the reference uses NIXL/GDS).
+
+Capacity is bounded in blocks; eviction is LRU. Data may be None (mocker
+workers track hash-level residency without bytes).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("dynamo_tpu.kvbm.host")
+
+
+@dataclass
+class HostBlock:
+    block_hash: int
+    parent_hash: Optional[int]
+    k: Any  # np.ndarray [L, Hk, PS, D] or None (sim)
+    v: Any
+    stored_at: float = field(default_factory=time.monotonic)
+
+
+class HostKvPool:
+    def __init__(self, capacity_blocks: int = 4096):
+        self.capacity = capacity_blocks
+        self._blocks: "OrderedDict[int, HostBlock]" = OrderedDict()  # LRU
+        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
+        self._evict_listeners: List[Any] = []
+
+    def on_evict(self, cb) -> None:
+        """cb(list[int]) — hashes dropped from the host tier."""
+        self._evict_listeners.append(cb)
+
+    def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._blocks
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    # -- offload (G1 → G2) --------------------------------------------------
+    def put(
+        self,
+        hashes: List[int],
+        parents: List[Optional[int]],
+        k: Optional[np.ndarray],  # [L, Hk, n, PS, D] or None
+        v: Optional[np.ndarray],
+    ) -> None:
+        for i, (h, p) in enumerate(zip(hashes, parents)):
+            if h in self._blocks:
+                self._blocks.move_to_end(h)
+                continue
+            kb = np.ascontiguousarray(k[:, :, i]) if k is not None else None
+            vb = np.ascontiguousarray(v[:, :, i]) if v is not None else None
+            self._blocks[h] = HostBlock(h, p, kb, vb)
+            self.stats["offloaded"] += 1
+        self._enforce_capacity()
+
+    def _enforce_capacity(self) -> None:
+        dropped: List[int] = []
+        while len(self._blocks) > self.capacity:
+            h, _ = self._blocks.popitem(last=False)
+            dropped.append(h)
+            self.stats["evicted"] += 1
+        if dropped:
+            for cb in self._evict_listeners:
+                cb(dropped)
+
+    # -- onboard (G2 → G1) --------------------------------------------------
+    def match(self, hashes: List[int]) -> int:
+        """Leading blocks of `hashes` resident in this tier."""
+        n = 0
+        for h in hashes:
+            if h not in self._blocks:
+                break
+            n += 1
+        return n
+
+    def get(
+        self, hashes: List[int]
+    ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """Stacked [L, Hk, n, PS, D] arrays (None if sim/hash-only)."""
+        blocks = [self._blocks[h] for h in hashes]
+        for b in blocks:
+            self._blocks.move_to_end(b.block_hash)
+        self.stats["onboarded"] += len(blocks)
+        if not blocks or blocks[0].k is None:
+            return None, None
+        k = np.stack([b.k for b in blocks], axis=2)
+        v = np.stack([b.v for b in blocks], axis=2)
+        return k, v
+
+    def lookup_chain(self, hashes: List[int]) -> List[int]:
+        return [h for h in hashes if h in self._blocks]

@@ -33,6 +33,7 @@ class KvIndexer:
         ttl: Optional[float] = None,  # approximate-mode TTL
     ):
         self.index = index or BlockIndex()
+        self.host_index = BlockIndex()  # G2-tier residency (partial credits)
         self._sub = subscriber
         self._dump_fn = dump_fn
         self.ttl = ttl
@@ -57,6 +58,7 @@ class KvIndexer:
 
     def remove_worker(self, worker: Worker) -> None:
         self.index.remove_worker(worker)
+        self.host_index.remove_worker(worker)
         self._last_event_id.pop(worker, None)
 
     async def _consume(self) -> None:
@@ -82,7 +84,8 @@ class KvIndexer:
             )
             self._schedule_resync(worker)
         self._last_event_id[worker] = ev.event_id
-        self.index.apply_event(ev, ttl=self.ttl)
+        target = self.host_index if ev.tier == "host" else self.index
+        target.apply_event(ev, ttl=self.ttl)
 
     # -- recovery ----------------------------------------------------------
     def _schedule_resync(self, worker: Worker) -> None:

@@ -117,9 +117,11 @@ class KvRouter:
         """Returns (worker, overlap_blocks, block_hashes)."""
         hashes = block_hashes(token_ids, self.block_size)
         overlaps = self.indexer.index.find_matches(hashes)
+        host_overlaps = self.indexer.host_index.find_matches(hashes).scores
         workers = self.workers()
         worker, overlap = self.selector.select(
-            workers, len(hashes), overlaps, self.sequences
+            workers, len(hashes), overlaps, self.sequences,
+            host_overlaps=host_overlaps,
         )
         return worker, overlap, hashes
 

@@ -35,6 +35,7 @@ class RouterEvent:
     kind: str  # "store" | "remove" | "clear"
     block_hashes: List[int] = field(default_factory=list)
     parent_hash: Optional[int] = None  # lineage anchor of block_hashes[0]
+    tier: str = "device"  # "device" (G1) | "host" (G2) — overlap credit tier
 
     def to_wire(self) -> Dict[str, Any]:
         return {
@@ -43,6 +44,7 @@ class RouterEvent:
             "kind": self.kind,
             "block_hashes": self.block_hashes,
             "parent_hash": self.parent_hash,
+            "tier": self.tier,
         }
 
     @classmethod
@@ -53,6 +55,7 @@ class RouterEvent:
             kind=d["kind"],
             block_hashes=list(d.get("block_hashes") or []),
             parent_hash=d.get("parent_hash"),
+            tier=d.get("tier", "device"),
         )
 
 

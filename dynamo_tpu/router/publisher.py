@@ -70,8 +70,11 @@ class KvEventPublisher:
                     kind=ev.kind,
                     block_hashes=list(ev.block_hashes),
                     parent_hash=ev.parent_hash,
+                    tier=getattr(ev, "tier", "device"),
                 )
             )
+            if getattr(ev, "tier", "device") != "device":
+                continue  # the recovery snapshot tracks the device tier
             if ev.kind == "store":
                 parent = ev.parent_hash
                 for h in ev.block_hashes:

@@ -40,6 +40,8 @@ def parse_args(argv=None):
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-seq-len", type=int, default=4096)
+    p.add_argument("--host-kv-blocks", type=int, default=0,
+                   help="G2 host-DRAM KV tier capacity in blocks (0 = off)")
     # batching
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
@@ -67,7 +69,10 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         page_size=args.page_size,
         max_pages_per_seq=max_pages_per_seq,
     )
-    engine = InferenceEngine(runner, max_batch=args.max_batch, chunk_size=args.chunk_size)
+    engine = InferenceEngine(
+        runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
+        host_kv_blocks=args.host_kv_blocks,
+    )
     card = ModelCard(
         name=args.model_name or config.name,
         tokenizer=args.tokenizer,
