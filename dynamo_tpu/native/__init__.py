@@ -1,0 +1,7 @@
+"""Native (C++) components with build-on-demand ctypes bindings.
+
+Mirrors the reference's Rust-for-hot-paths / Python-for-control split
+(README.md:38 "Built in Rust for performance, Python for extensibility"):
+the hot data structures compile to a shared library at first use; every
+consumer has a pure-Python fallback so the framework degrades gracefully
+where no toolchain exists."""

@@ -1,0 +1,145 @@
+"""ctypes binding for the C++ concurrent block index
+(native/block_index.cpp) with the same interface as the Python BlockIndex
+(dynamo_tpu/router/radix_tree.py) for the event-driven (non-TTL) mode.
+
+Worker tuples (instance_id, dp_rank) are interned to dense u32 ids on the
+Python side; block hashes cross the boundary as u64 arrays.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+from dynamo_tpu.native.build import build_library
+from dynamo_tpu.router.protocols import OverlapScores, RouterEvent
+
+Worker = Tuple[int, int]
+
+_LIB = None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = build_library("block_index")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.bi_new.restype = ctypes.c_void_p
+    lib.bi_free.argtypes = [ctypes.c_void_p]
+    lib.bi_apply_store.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.bi_apply_remove.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.bi_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.bi_find_matches.restype = ctypes.c_int
+    lib.bi_find_matches.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int,
+    ]
+    lib.bi_len.restype = ctypes.c_uint64
+    lib.bi_len.argtypes = [ctypes.c_void_p]
+    lib.bi_worker_block_count.restype = ctypes.c_uint64
+    lib.bi_worker_block_count.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u64_array(values: List[int]):
+    return (ctypes.c_uint64 * len(values))(*[v & 0xFFFFFFFFFFFFFFFF for v in values])
+
+
+class CppBlockIndex:
+    """Drop-in for router BlockIndex (event mode; TTL/approximate mode uses
+    the Python index)."""
+
+    MAX_WORKERS_OUT = 1024
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native block index unavailable")
+        self._lib = lib
+        self._h = lib.bi_new()
+        self._worker_ids: Dict[Worker, int] = {}
+        self._worker_by_id: Dict[int, Worker] = {}
+        self._next = 0
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.bi_free(self._h)
+            self._h = None
+
+    def _wid(self, worker: Worker) -> int:
+        w = tuple(worker)
+        i = self._worker_ids.get(w)
+        if i is None:
+            i = self._next
+            self._next += 1
+            self._worker_ids[w] = i
+            self._worker_by_id[i] = w
+        return i
+
+    # -- BlockIndex interface ----------------------------------------------
+    def apply_event(self, ev: RouterEvent, ttl: Optional[float] = None) -> None:
+        worker = self._wid(ev.worker)
+        if ev.kind == "store":
+            arr = _u64_array(ev.block_hashes)
+            parent = ev.parent_hash
+            self._lib.bi_apply_store(
+                self._h, worker,
+                (parent or 0) & 0xFFFFFFFFFFFFFFFF,
+                1 if parent is not None else 0,
+                arr, len(ev.block_hashes),
+            )
+        elif ev.kind == "remove":
+            arr = _u64_array(ev.block_hashes)
+            self._lib.bi_apply_remove(self._h, worker, arr, len(ev.block_hashes))
+        elif ev.kind == "clear":
+            self.remove_worker(ev.worker)
+
+    def find_matches(self, block_hashes: List[int], early_exit: bool = False, now=None) -> OverlapScores:
+        if not block_hashes:
+            return OverlapScores(total_blocks=0)
+        arr = _u64_array(block_hashes)
+        out_w = (ctypes.c_uint32 * self.MAX_WORKERS_OUT)()
+        out_s = (ctypes.c_uint32 * self.MAX_WORKERS_OUT)()
+        n = self._lib.bi_find_matches(
+            self._h, arr, len(block_hashes), out_w, out_s, self.MAX_WORKERS_OUT
+        )
+        scores = {
+            self._worker_by_id[out_w[i]]: int(out_s[i])
+            for i in range(n)
+            if out_s[i] > 0
+        }
+        return OverlapScores(scores=scores, total_blocks=len(block_hashes))
+
+    def remove_worker(self, worker: Worker) -> None:
+        self._lib.bi_remove_worker(self._h, self._wid(worker))
+
+    def worker_block_count(self, worker: Worker) -> int:
+        return int(self._lib.bi_worker_block_count(self._h, self._wid(worker)))
+
+    def __len__(self) -> int:
+        return int(self._lib.bi_len(self._h))
+
+
+def make_block_index(prefer_native: bool = True, ttl_mode: bool = False):
+    """Best index for the mode: native (event mode) or Python (TTL mode /
+    no toolchain)."""
+    if prefer_native and not ttl_mode and available():
+        return CppBlockIndex()
+    from dynamo_tpu.router.radix_tree import BlockIndex
+
+    return BlockIndex()

@@ -1,0 +1,41 @@
+"""Build-on-demand for native components: compiles native/*.cpp into
+shared libraries cached under native/build/ (keyed by source mtime)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.native")
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+BUILD_DIR = NATIVE_DIR / "build"
+
+
+def build_library(name: str, cxxflags: Optional[list] = None) -> Optional[Path]:
+    """Compile native/{name}.cpp → native/build/lib{name}.so; returns the
+    path, or None if the toolchain is unavailable or compilation fails."""
+    src = NATIVE_DIR / f"{name}.cpp"
+    if not src.exists():
+        return None
+    out = BUILD_DIR / f"lib{name}.so"
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
+    BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        *(cxxflags or []),
+        str(src), "-o", str(out),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        log.info("built native library %s", out)
+        return out
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        log.warning("native build of %s failed (%s); using Python fallback",
+                    name, stderr.decode(errors="replace")[:500])
+        return None

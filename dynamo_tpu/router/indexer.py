@@ -32,7 +32,12 @@ class KvIndexer:
         dump_fn=None,  # async (instance_id) -> dump dict; wired by KvRouter
         ttl: Optional[float] = None,  # approximate-mode TTL
     ):
-        self.index = index or BlockIndex()
+        if index is None:
+            from dynamo_tpu.native.block_index import make_block_index
+
+            # native C++ index in event mode; Python index in TTL mode
+            index = make_block_index(ttl_mode=ttl is not None)
+        self.index = index
         self.host_index = BlockIndex()  # G2-tier residency (partial credits)
         self._sub = subscriber
         self._dump_fn = dump_fn

@@ -1,0 +1,199 @@
+// Concurrent KV block index — native core of the router's indexer.
+//
+// Role of the reference's lib/kv-router radix-tree generations
+// (radix_tree.rs → concurrent_radix_tree*/ → cuckoo): a shared-lock hash
+// index over lineage block hashes with per-worker residency sets. Reads
+// (find_matches, the routing hot path) take a shared lock and are
+// wait-free with respect to each other; writes (event application) take
+// the exclusive lock. Exposed through a C ABI for ctypes (no pybind11 in
+// the build image).
+//
+// Workers are dense u32 indices assigned by the Python wrapper; block
+// hashes are the u64 lineage hashes of dynamo_tpu.tokens.hashing.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC block_index.cpp -o libblockindex.so
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Node {
+    uint64_t parent = 0;
+    bool has_parent = false;
+    // small worker sets: linear vectors beat hash sets for <32 entries
+    std::vector<uint32_t> workers;
+    uint32_t n_children = 0;
+
+    bool has_worker(uint32_t w) const {
+        for (uint32_t x : workers)
+            if (x == w) return true;
+        return false;
+    }
+    void add_worker(uint32_t w) {
+        if (!has_worker(w)) workers.push_back(w);
+    }
+    bool remove_worker(uint32_t w) {
+        for (size_t i = 0; i < workers.size(); ++i) {
+            if (workers[i] == w) {
+                workers[i] = workers.back();
+                workers.pop_back();
+                return true;
+            }
+        }
+        return false;
+    }
+};
+
+struct BlockIndex {
+    mutable std::shared_mutex mu;
+    std::unordered_map<uint64_t, Node> nodes;
+    std::unordered_map<uint32_t, std::unordered_set<uint64_t>> worker_blocks;
+
+    void prune_chain(uint64_t h) {
+        // remove h if orphaned, then walk up the parent chain
+        while (true) {
+            auto it = nodes.find(h);
+            if (it == nodes.end()) return;
+            Node &n = it->second;
+            if (!n.workers.empty() || n.n_children > 0) return;
+            uint64_t parent = n.parent;
+            bool has_parent = n.has_parent;
+            nodes.erase(it);
+            if (!has_parent) return;
+            auto pit = nodes.find(parent);
+            if (pit == nodes.end()) return;
+            if (pit->second.n_children > 0) pit->second.n_children--;
+            h = parent;
+        }
+    }
+
+    void remove_worker_block(uint32_t w, uint64_t h) {
+        auto it = nodes.find(h);
+        if (it == nodes.end()) return;
+        it->second.remove_worker(w);
+        auto wit = worker_blocks.find(w);
+        if (wit != worker_blocks.end()) wit->second.erase(h);
+        prune_chain(h);
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *bi_new() { return new BlockIndex(); }
+
+void bi_free(void *p) { delete static_cast<BlockIndex *>(p); }
+
+// store: hashes form a lineage chain; parent0 anchors hashes[0]
+// (has_parent0 = 0 means hashes[0] is a root block)
+void bi_apply_store(void *p, uint32_t worker, uint64_t parent0,
+                    int has_parent0, const uint64_t *hashes, int n) {
+    auto *bi = static_cast<BlockIndex *>(p);
+    std::unique_lock lk(bi->mu);
+    uint64_t parent = parent0;
+    bool has_parent = has_parent0 != 0;
+    auto &wb = bi->worker_blocks[worker];
+    for (int i = 0; i < n; ++i) {
+        uint64_t h = hashes[i];
+        auto [it, inserted] = bi->nodes.try_emplace(h);
+        if (inserted) {
+            it->second.parent = parent;
+            it->second.has_parent = has_parent;
+            if (has_parent) {
+                auto pit = bi->nodes.find(parent);
+                if (pit != bi->nodes.end()) pit->second.n_children++;
+            }
+        }
+        it->second.add_worker(worker);
+        wb.insert(h);
+        parent = h;
+        has_parent = true;
+    }
+}
+
+void bi_apply_remove(void *p, uint32_t worker, const uint64_t *hashes, int n) {
+    auto *bi = static_cast<BlockIndex *>(p);
+    std::unique_lock lk(bi->mu);
+    for (int i = 0; i < n; ++i) bi->remove_worker_block(worker, hashes[i]);
+}
+
+void bi_remove_worker(void *p, uint32_t worker) {
+    auto *bi = static_cast<BlockIndex *>(p);
+    std::unique_lock lk(bi->mu);
+    auto wit = bi->worker_blocks.find(worker);
+    if (wit == bi->worker_blocks.end()) return;
+    std::vector<uint64_t> blocks(wit->second.begin(), wit->second.end());
+    for (uint64_t h : blocks) bi->remove_worker_block(worker, h);
+    bi->worker_blocks.erase(worker);
+}
+
+// find_matches: walk the chain; score[w] = contiguous leading blocks w
+// holds. out_workers/out_scores sized max_out; returns count written.
+int bi_find_matches(void *p, const uint64_t *hashes, int n,
+                    uint32_t *out_workers, uint32_t *out_scores, int max_out) {
+    auto *bi = static_cast<BlockIndex *>(p);
+    std::shared_lock lk(bi->mu);
+    std::vector<uint32_t> alive;  // workers matching blocks [0, i)
+    std::vector<uint32_t> final_workers;
+    std::vector<uint32_t> final_scores;
+
+    int i = 0;
+    for (; i < n; ++i) {
+        auto it = bi->nodes.find(hashes[i]);
+        if (it == bi->nodes.end()) break;
+        const Node &node = it->second;
+        if (i == 0) {
+            alive = node.workers;
+        } else {
+            std::vector<uint32_t> still;
+            still.reserve(alive.size());
+            for (uint32_t w : alive) {
+                if (node.has_worker(w)) {
+                    still.push_back(w);
+                } else {
+                    // dropped out: keeps the score accumulated so far
+                    final_workers.push_back(w);
+                    final_scores.push_back(static_cast<uint32_t>(i));
+                }
+            }
+            alive.swap(still);
+        }
+        if (alive.empty()) break;
+    }
+    // survivors matched i leading blocks
+    for (uint32_t w : alive) {
+        final_workers.push_back(w);
+        final_scores.push_back(static_cast<uint32_t>(i));
+    }
+
+    int count = 0;
+    for (size_t i = 0; i < final_workers.size() && count < max_out; ++i) {
+        out_workers[count] = final_workers[i];
+        out_scores[count] = final_scores[i];
+        count++;
+    }
+    return count;
+}
+
+uint64_t bi_len(void *p) {
+    auto *bi = static_cast<BlockIndex *>(p);
+    std::shared_lock lk(bi->mu);
+    return bi->nodes.size();
+}
+
+uint64_t bi_worker_block_count(void *p, uint32_t worker) {
+    auto *bi = static_cast<BlockIndex *>(p);
+    std::shared_lock lk(bi->mu);
+    auto it = bi->worker_blocks.find(worker);
+    return it == bi->worker_blocks.end() ? 0 : it->second.size();
+}
+
+}  // extern "C"
