@@ -99,6 +99,7 @@ class InferenceEngine:
         self._parked: Dict[str, tuple] = {}  # rid -> (Sequence, deadline)
         self._kv_pending: List[Sequence] = []  # disagg-decode awaiting space
         self.parked_ttl_s = 60.0
+        self._embed_pending: List[tuple] = []  # (tokens, future, loop)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -129,6 +130,18 @@ class InferenceEngine:
         self._streams[rid] = (out, loop)
 
         annotations = request.get("annotations") or {}
+        if annotations.get("kind") == "embedding":
+            fut: asyncio.Future = loop.create_future()
+            self._inbox.put(
+                ("embed", ([int(t) for t in request.get("token_ids") or [0]], fut, loop))
+            )
+            try:
+                vec = await fut
+                yield {"embedding": vec, "finish_reason": "stop", "token_ids": []}
+            finally:
+                self._streams.pop(rid, None)
+            return
+
         seq = Sequence(
             request_id=rid,
             prompt=[int(t) for t in request.get("token_ids") or [0]],
@@ -206,8 +219,11 @@ class InferenceEngine:
             elif op == "export":
                 rid, fut, loop = arg
                 self._export_parked(rid, fut, loop)
+            elif op == "embed":
+                self._embed_pending.append(arg)
         self._admit_kv_pending()
         self._expire_parked()
+        self._run_embeds()
 
     def _admit_kv_pending(self) -> None:
         """Disagg-decode sequences: admit + import transferred KV pages."""
@@ -225,6 +241,20 @@ class InferenceEngine:
             if target and payload.get("data"):
                 self.runner.import_pages(target, seq.n_shared_pages, payload)
         self._kv_pending = still
+
+    def _run_embeds(self) -> None:
+        """Batch all pending embedding requests into one encoder pass."""
+        if not self._embed_pending:
+            return
+        batch, self._embed_pending = self._embed_pending, []
+        try:
+            vecs = self.runner.embed([t for t, _, _ in batch])
+            for i, (_, fut, loop) in enumerate(batch):
+                loop.call_soon_threadsafe(_set_future, fut, vecs[i].tolist())
+        except Exception as e:  # pragma: no cover
+            log.exception("embed batch failed")
+            for _, fut, loop in batch:
+                loop.call_soon_threadsafe(_set_future_exc, fut, e)
 
     def _expire_parked(self) -> None:
         if not self._parked:
@@ -396,6 +426,16 @@ class InferenceEngine:
             }
             self.runner.import_pages(pages, 0, payload)
         return True
+
+
+def _set_future(fut: asyncio.Future, value) -> None:
+    if not fut.done():
+        fut.set_result(value)
+
+
+def _set_future_exc(fut: asyncio.Future, exc: Exception) -> None:
+    if not fut.done():
+        fut.set_exception(exc)
 
 
 def _sampling_params(seqs: List[Sequence]) -> Dict[str, list]:

@@ -221,6 +221,21 @@ class ModelRunner:
             pt[i, : len(row)] = row
         return pt
 
+    def embed(self, token_lists: List[List[int]]) -> np.ndarray:
+        """Batched embedding forward → [n, E] float32 (L2-normalized)."""
+        if not hasattr(self, "_jit_encode"):
+            self._jit_encode = jax.jit(partial(llama.encode, self.config))
+        n = len(token_lists)
+        B = _next_bucket(self.decode_buckets, n)
+        S = _next_bucket(self.prefill_buckets, max(len(t) for t in token_lists))
+        toks = np.zeros((B, S), np.int32)
+        lens = np.zeros(B, np.int32)
+        for i, t in enumerate(token_lists):
+            toks[i, : len(t)] = t
+            lens[i] = len(t)
+        out = self._jit_encode(self.params, jnp.asarray(toks), jnp.asarray(lens))
+        return np.asarray(jax.device_get(out))[:n]
+
     # -- disagg KV transfer (host-staged DCN path, SURVEY.md §2.11) ---------
     def export_pages(self, pages: List[int]) -> Dict[str, Any]:
         """Device→host read of whole KV pages for P→D transfer. Layout on

@@ -34,18 +34,26 @@ class HttpService:
         watcher: Optional[ModelWatcher] = None,
         host: str = "127.0.0.1",
         port: int = 8080,
+        busy_threshold: int = 0,  # max in-flight requests per model (0 = off)
+        trace_path: Optional[str] = None,
     ):
+        from dynamo_tpu.frontend.request_trace import RequestTracer
+
         self.runtime = runtime
         self.manager = manager or ModelManager()
         self.watcher = watcher or ModelWatcher(runtime, self.manager)
         self.host = host
         self.port = port
+        self.busy_threshold = busy_threshold
+        self.tracer = RequestTracer(trace_path)
+        self._in_flight: Dict[str, int] = {}
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
         self.app.add_routes(
             [
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
+                web.post("/v1/embeddings", self.embeddings),
                 web.get("/v1/models", self.list_models),
                 web.get("/v1/models/{model}", self.get_model),
                 web.get("/health", self.health),
@@ -125,6 +133,64 @@ class HttpService:
     async def completions(self, request: web.Request) -> web.StreamResponse:
         return await self._run_inference(request, kind="completions")
 
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI embeddings API (reference http/service/openai.rs:2902):
+        routed straight to workers (no detok/migration pipeline)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body", "invalid_request_error")
+        model = body.get("model")
+        try:
+            entry = self.manager.get(model)
+        except KeyError:
+            return _error(404, f"model {model!r} not found", "model_not_found")
+
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not inputs:
+            return _error(400, "input must be a string or non-empty list", "invalid_request_error")
+        if all(isinstance(x, int) for x in inputs):
+            inputs = [inputs]  # single token-id prompt
+
+        data = []
+        n_tokens = 0
+        for i, inp in enumerate(inputs):
+            if isinstance(inp, str):
+                token_ids = entry.preprocessor.tokenize_prompt(inp, add_bos=False)
+            else:
+                token_ids = [int(t) for t in inp]
+            n_tokens += len(token_ids)
+            req = {
+                "token_ids": token_ids,
+                "annotations": {"kind": "embedding"},
+                "model": model,
+            }
+            ctx = Context(metadata={"model": model})
+            vec = None
+            try:
+                async for item in entry.client.generate(req, ctx):
+                    if "embedding" in item:
+                        vec = item["embedding"]
+                    if item.get("finish_reason"):
+                        break
+            except Exception as e:
+                log.exception("embedding request failed")
+                return _error(500, str(e), "internal_error")
+            if vec is None:
+                return _error(500, "worker returned no embedding", "internal_error")
+            data.append({"object": "embedding", "index": i, "embedding": vec})
+
+        return web.json_response(
+            {
+                "object": "list",
+                "data": data,
+                "model": model,
+                "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+            }
+        )
+
     async def _run_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -135,6 +201,10 @@ class HttpService:
             entry = self.manager.get(model)
         except KeyError:
             return _error(404, f"model {model!r} not found", "model_not_found")
+
+        # busy-threshold load shedding (reference busy_threshold.rs)
+        if self.busy_threshold and self._in_flight.get(model, 0) >= self.busy_threshold:
+            return _error(503, "server busy, retry later", "server_busy")
 
         try:
             if kind == "chat":
@@ -149,14 +219,25 @@ class HttpService:
         stream = bool(body.get("stream", False))
         created = int(time.time())
 
-        if stream:
-            return await self._stream_response(
-                request, entry, preprocessed, ctx, rid, model, created, kind
+        from dynamo_tpu.frontend.request_trace import RequestTiming
+
+        timing = RequestTiming(ctx.id, model, kind, len(preprocessed["token_ids"]))
+        self._in_flight[model] = self._in_flight.get(model, 0) + 1
+        try:
+            if stream:
+                return await self._stream_response(
+                    request, entry, preprocessed, ctx, rid, model, created, kind, timing
+                )
+            return await self._unary_response(
+                entry, preprocessed, ctx, rid, model, created, kind, timing
             )
-        return await self._unary_response(entry, preprocessed, ctx, rid, model, created, kind)
+        finally:
+            self._in_flight[model] = max(0, self._in_flight.get(model, 1) - 1)
+            if self.tracer.enabled:
+                self.tracer.record(**timing.fields(stream=stream))
 
     async def _stream_response(
-        self, request, entry, preprocessed, ctx, rid, model, created, kind
+        self, request, entry, preprocessed, ctx, rid, model, created, kind, timing=None
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             headers={
@@ -178,6 +259,10 @@ class HttpService:
             async for item in entry.chain.generate(preprocessed, ctx):
                 text = item.get("text", "")
                 finish = item.get("finish_reason")
+                if timing is not None:
+                    timing.on_tokens(len(item.get("token_ids") or []))
+                    if finish:
+                        timing.finish_reason = finish
                 if text or finish:
                     if kind == "chat":
                         delta = {"content": text} if text else {}
@@ -209,7 +294,7 @@ class HttpService:
         return resp
 
     async def _unary_response(
-        self, entry, preprocessed, ctx, rid, model, created, kind
+        self, entry, preprocessed, ctx, rid, model, created, kind, timing=None
     ) -> web.Response:
         text_parts = []
         finish = None
@@ -219,8 +304,12 @@ class HttpService:
             async for item in entry.chain.generate(preprocessed, ctx):
                 text_parts.append(item.get("text", ""))
                 n_out += len(item.get("token_ids") or [])
+                if timing is not None:
+                    timing.on_tokens(len(item.get("token_ids") or []))
                 if item.get("finish_reason"):
                     finish = item["finish_reason"]
+                    if timing is not None:
+                        timing.finish_reason = finish
                     break
         except Exception as e:
             log.exception("request %s failed", rid)

@@ -93,6 +93,15 @@ class SimRunner:
     def decode(self, tokens, positions, page_tables, kv_lens, sampling, step):
         return self.decode_multi(1, tokens, positions, page_tables, sampling, step)[:, 0]
 
+    def embed(self, token_lists: List[List[int]]) -> np.ndarray:
+        self.timing.sleep(self.timing.prefill_base_s)
+        out = np.zeros((len(token_lists), 16), np.float32)
+        for i, t in enumerate(token_lists):
+            rng = np.random.default_rng(sum(t) % (2**31))
+            v = rng.standard_normal(16)
+            out[i] = v / np.linalg.norm(v)
+        return out
+
     # -- disagg KV transfer (simulated) ------------------------------------
     def export_pages(self, pages: List[int]):
         return {"data": True, "sim": True, "n_pages": len(pages)}

@@ -260,6 +260,54 @@ def forward(
     return logits.astype(jnp.float32), k_pool, v_pool
 
 
+def encode(
+    config: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, S]
+    lengths: jax.Array,  # [B] real lengths (padding masked)
+) -> jax.Array:
+    """Embedding forward: dense causal self-attention (no KV pool), masked
+    mean-pool of the final-norm hidden states, L2-normalized → [B, E].
+    Serves /v1/embeddings (reference http/service/openai.rs:2902)."""
+    c = config
+    B, S = tokens.shape
+    hd = c.head_dim
+    G = c.n_heads // c.n_kv_heads
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
+
+    h = params["embed"][tokens]
+
+    def layer(h, xs):
+        lp, _ = xs
+        x = rms_norm(h, lp["attn_norm"], c.norm_eps)
+        q = rope((x @ lp["wq"]).reshape(B, S, c.n_heads, hd), positions, c.rope_theta)
+        k = rope((x @ lp["wk"]).reshape(B, S, c.n_kv_heads, hd), positions, c.rope_theta)
+        v = (x @ lp["wv"]).reshape(B, S, c.n_kv_heads, hd)
+        qg = q.reshape(B, S, c.n_kv_heads, G, hd)
+        scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * hd**-0.5
+        ti = jnp.arange(S)
+        mask = (ti[None, :] <= ti[:, None])[None, None, None] & (
+            ti[None, :] < lengths[:, None]
+        )[:, None, None, None, :]
+        probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1).astype(h.dtype)
+        attn = jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, S, c.n_heads * hd)
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
+        if c.is_moe:
+            h = h + _moe_block(c, lp, x)
+        else:
+            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return h, None
+
+    h, _ = lax.scan(
+        layer, h, (params["layers"], jnp.arange(c.n_layers, dtype=jnp.int32))
+    )
+    h = rms_norm(h, params["norm_f"], c.norm_eps).astype(jnp.float32)
+    valid = (jnp.arange(S)[None, :] < lengths[:, None]).astype(jnp.float32)
+    pooled = (h * valid[..., None]).sum(1) / jnp.maximum(valid.sum(1), 1)[:, None]
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
 def _moe_block(c: ModelConfig, lp, x: jax.Array) -> jax.Array:
     """Token-choice top-k MoE (dense compute over experts for now; the
     shard_map all-to-all EP path lands with the wide-EP milestone). x:

@@ -1,0 +1,69 @@
+"""Per-process system status server (analog of reference
+system_status_server.rs + system_health.rs): /live, /health, /metrics on a
+side port for workers and routers (the HTTP frontend has its own)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, Optional
+
+from aiohttp import web
+
+log = logging.getLogger("dynamo_tpu.status")
+
+
+class StatusServer:
+    def __init__(self, runtime, port: int = 0, host: str = "0.0.0.0"):
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        self._checks: Dict[str, Callable[[], bool]] = {}
+        self._started_at = time.time()
+        self._runner: Optional[web.AppRunner] = None
+
+    def add_check(self, name: str, fn: Callable[[], bool]) -> None:
+        self._checks[name] = fn
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.add_routes(
+            [
+                web.get("/live", self._live),
+                web.get("/health", self._health),
+                web.get("/metrics", self._metrics),
+            ]
+        )
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for sock in site._server.sockets:  # type: ignore[union-attr]
+            self.port = sock.getsockname()[1]
+            break
+        return f"http://{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _live(self, request) -> web.Response:
+        return web.json_response({"live": True, "uptime_s": time.time() - self._started_at})
+
+    async def _health(self, request) -> web.Response:
+        results = {}
+        healthy = True
+        for name, fn in self._checks.items():
+            try:
+                ok = bool(fn())
+            except Exception:
+                ok = False
+            results[name] = ok
+            healthy = healthy and ok
+        return web.json_response(
+            {"status": "healthy" if healthy else "unhealthy", "checks": results},
+            status=200 if healthy else 503,
+        )
+
+    async def _metrics(self, request) -> web.Response:
+        return web.Response(body=self.runtime.metrics.render(), content_type="text/plain")

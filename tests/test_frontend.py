@@ -159,11 +159,11 @@ async def test_migration_gives_up_after_limit():
 # -- HTTP e2e ---------------------------------------------------------------
 
 
-async def _start_stack(realm="http-e2e"):
+async def _start_stack(realm="http-e2e", token_delay_s=0.0):
     wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
     await wrt.serve_endpoint(
         "dyn/worker/generate",
-        EchoWorkerEngine(),
+        EchoWorkerEngine(token_delay_s=token_delay_s),
         metadata={"model_card": _card().to_dict()},
     )
     frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
@@ -234,6 +234,80 @@ async def test_http_streaming_sse():
             # 6 echoed tokens = [BOS a b c BOS a]; BOS decodes to nothing
             assert text == "abca"
             assert chunks[-2]["choices"][0]["finish_reason"] == "length"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+
+
+async def test_embeddings_endpoint():
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    realm = "embed-e2e"
+    runner = ModelRunner(
+        get_config("tiny"), num_pages=32, page_size=4, max_pages_per_seq=8,
+        decode_buckets=(1, 2, 4), prefill_buckets=(8, 16), seed=3,
+    )
+    engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+    engine.start()
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    card = ModelCard(name="tiny", tokenizer="byte", context_length=64, kv_block_size=4)
+    await wrt.serve_endpoint("dyn/w/generate", engine, metadata={"model_card": card.to_dict()})
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=10)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/embeddings",
+                json={"model": "tiny", "input": ["hello world", "hello world", "different"]},
+            ) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+        vecs = [d["embedding"] for d in body["data"]]
+        assert len(vecs) == 3 and len(vecs[0]) == 64  # tiny dim
+        assert vecs[0] == vecs[1]  # same input, same embedding
+        assert vecs[0] != vecs[2]
+        norm = sum(x * x for x in vecs[0]) ** 0.5
+        assert abs(norm - 1.0) < 1e-3  # L2 normalized
+        assert body["usage"]["prompt_tokens"] == len("hello world") * 2 + len("different")
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+        engine.stop()
+
+
+async def test_busy_threshold_sheds_load():
+    wrt, frt, svc, base = await _start_stack(realm="busy", token_delay_s=0.01)
+    svc.busy_threshold = 1
+    try:
+        import asyncio as aio
+
+        async with aiohttp.ClientSession() as s:
+            async def slow_req():
+                async with s.post(
+                    f"{base}/v1/completions",
+                    json={"model": "echo-model", "prompt": "abc", "max_tokens": 500},
+                ) as r:
+                    return r.status
+
+            # saturate with one long request, then expect 503
+            t1 = aio.create_task(slow_req())
+            await aio.sleep(0.05)
+            async with s.post(
+                f"{base}/v1/completions",
+                json={"model": "echo-model", "prompt": "x", "max_tokens": 1},
+            ) as r:
+                assert r.status == 503
+                body = await r.json()
+                assert body["error"]["type"] == "server_busy"
+            await t1
     finally:
         await svc.stop()
         await frt.shutdown()
