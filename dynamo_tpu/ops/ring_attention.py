@@ -1,0 +1,108 @@
+"""Ring attention: blockwise causal attention with K/V rotation over the
+`seq` mesh axis (sequence/context parallelism).
+
+The reference framework has no in-tree sequence parallelism (SURVEY.md
+§2.10: absent from the core; long context is handled by chunked prefill +
+disagg + KVBM). The TPU build makes SP native: the sequence is sharded
+[B, S/n, ...] across the ring; each step computes the local Q block against
+the resident K/V block with a flash-style online softmax, then rotates K/V
+to the next ring neighbor with ppermute — n steps see the full context
+while ICI carries exactly one K/V shard per hop (the Ring Attention
+construction; Pallas fusion of the per-block kernel is a later
+optimization — XLA already overlaps the ppermute with compute).
+
+Causality is handled by absolute positions: block (i ← j) contributes only
+where q_pos >= kv_pos, so out-of-order ring arrival needs no special-casing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn_update(q, k, v, q_pos, kv_pos, m, l, acc, scale):
+    """One blockwise online-softmax update.
+    q [B,s,Hk,G,D]; k/v [B,t,Hk,D]; q_pos [B,s]; kv_pos [B,t];
+    m,l [B,s,Hk,G,1]; acc [B,s,Hk,G,D] (all fp32 accumulators)."""
+    s = jnp.einsum("bskgd,btkd->bskgt", q, k).astype(jnp.float32) * scale
+    mask = (q_pos[:, :, None] >= kv_pos[:, None, :])[:, :, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m - m_new)
+    acc = acc * alpha + jnp.einsum("bskgt,btkd->bskgd", p.astype(v.dtype), v).astype(jnp.float32)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    return m_new, l, acc
+
+
+def _ring_attention_sharded(q, k, v, q_pos, kv_pos, axis_name: str, scale: float):
+    """Runs inside shard_map: local shards, full-context result."""
+    n = lax.psum(1, axis_name)
+    B, s_len, Hk, G, D = q.shape
+
+    # mark accumulators as device-varying along the ring axis (vma typing)
+    def _varying(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    m = _varying(jnp.full((B, s_len, Hk, G, 1), NEG_INF, jnp.float32))
+    l = _varying(jnp.zeros((B, s_len, Hk, G, 1), jnp.float32))
+    acc = _varying(jnp.zeros((B, s_len, Hk, G, D), jnp.float32))
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, _):
+        k_cur, v_cur, kv_pos_cur, m, l, acc = carry
+        m, l, acc = _block_attn_update(q, k_cur, v_cur, q_pos, kv_pos_cur, m, l, acc, scale)
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        kv_pos_cur = lax.ppermute(kv_pos_cur, axis_name, perm)
+        return (k_cur, v_cur, kv_pos_cur, m, l, acc), None
+
+    (k, v, kv_pos, m, l, acc), _ = lax.scan(step, (k, v, kv_pos, m, l, acc), None, length=n)
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, Hk, G, D] sequence-sharded over `axis_name`
+    k: jax.Array,  # [B, S, Hk, D]
+    v: jax.Array,
+    q_positions: jax.Array,  # [B, S] absolute positions
+    kv_positions: jax.Array,  # [B, S]
+    mesh: Mesh,
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Full causal attention over a sequence sharded across `axis_name`.
+    Returns [B, S, Hk, G, D] with the same sharding as q."""
+    D = q.shape[-1]
+    scale = D**-0.5
+    seq = P(None, axis_name)
+    spec_q = P(None, axis_name, None, None, None)
+    spec_kv = P(None, axis_name, None, None)
+
+    fn = jax.shard_map(
+        partial(_ring_attention_sharded, axis_name=axis_name, scale=scale),
+        mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv, seq, seq),
+        out_specs=spec_q,
+    )
+    return fn(q, k, v, q_positions, kv_positions)
+
+
+def full_attention_reference(q, k, v, q_positions, kv_positions):
+    """Unsharded reference for testing."""
+    D = q.shape[-1]
+    s = jnp.einsum("bskgd,btkd->bskgt", q, k).astype(jnp.float32) * (D**-0.5)
+    mask = (q_positions[:, :, None] >= kv_positions[:, None, :])[:, :, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bskgt,btkd->bskgd", p.astype(v.dtype), v)
