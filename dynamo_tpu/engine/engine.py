@@ -214,11 +214,12 @@ class InferenceEngine:
                 parked = self._parked.pop(arg, None)
                 if parked is not None:
                     self.scheduler.release_parked(parked[0])
+                self._kv_pending = [s for s in self._kv_pending if s.request_id != arg]
             elif op == "add_kv":
                 self._kv_pending.append(arg)
             elif op == "export":
-                rid, fut, loop = arg
-                self._export_parked(rid, fut, loop)
+                rid, fut, loop, discard = arg
+                self._export_parked(rid, fut, loop, discard)
             elif op == "embed":
                 self._embed_pending.append(arg)
         self._admit_kv_pending()
@@ -264,14 +265,16 @@ class InferenceEngine:
             seq, _ = self._parked.pop(rid)
             self.scheduler.release_parked(seq)
 
-    def _export_parked(self, rid: str, fut, loop) -> None:
+    def _export_parked(self, rid: str, fut, loop, discard: bool = False) -> None:
         entry = self._parked.pop(rid, None)
         if entry is None:
             loop.call_soon_threadsafe(fut.set_result, None)
             return
         seq, _ = entry
-        n_kv_pages = (len(seq.prompt) + self.pool.page_size - 1) // self.pool.page_size
-        payload = self.runner.export_pages(seq.pages[:n_kv_pages])
+        payload = None
+        if not discard:
+            n_kv_pages = (len(seq.prompt) + self.pool.page_size - 1) // self.pool.page_size
+            payload = self.runner.export_pages(seq.pages[:n_kv_pages])
         self.scheduler.release_parked(seq)
         loop.call_soon_threadsafe(fut.set_result, payload)
 
@@ -355,12 +358,15 @@ class InferenceEngine:
         loop.call_soon_threadsafe(out.put_nowait, item)
 
     # -- disagg export (called from the asyncio side) -----------------------
-    async def export_parked_kv(self, request_id: str) -> Optional[Dict[str, Any]]:
+    async def export_parked_kv(
+        self, request_id: str, discard: bool = False
+    ) -> Optional[Dict[str, Any]]:
         """Pull a parked request's KV pages (runs the device read on the
-        step thread between steps); releases the parked pages."""
+        step thread between steps); releases the parked pages. discard=True
+        releases without reading (early-finished disagg requests)."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._inbox.put(("export", (request_id, fut, loop)))
+        self._inbox.put(("export", (request_id, fut, loop, discard)))
         return await fut
 
     def _publish_fpm(self, kind: str, wall: float, n_tok: int) -> None:
@@ -397,15 +403,10 @@ class InferenceEngine:
     # -- KVBM G2 tier (step-thread callbacks) -------------------------------
     def _offload_page(self, page: int, block_hash: int, parent: Optional[int]) -> None:
         """Device page being evicted → copy its KV to the host tier."""
-        payload = self.runner.export_pages([page])
-        k = v = None
-        if payload.get("k"):
-            import ml_dtypes
+        from dynamo_tpu.engine.model_runner import kv_payload_to_arrays
 
-            dtype = np.dtype(ml_dtypes.bfloat16) if "bfloat16" in payload["dtype"] else np.dtype(payload["dtype"])
-            shape = tuple(payload["shape"])
-            k = np.frombuffer(payload["k"], dtype=dtype).reshape(shape)
-            v = np.frombuffer(payload["v"], dtype=dtype).reshape(shape)
+        arrays = kv_payload_to_arrays(self.runner.export_pages([page]))
+        k, v = arrays if arrays is not None else (None, None)
         self.host_pool.put([block_hash], [parent], k, v)
         self._host_events.append(KvEvent("store", [block_hash], parent, tier="host"))
 
@@ -414,17 +415,11 @@ class InferenceEngine:
 
     def _onboard_from_host(self, pages: List[int], hashes: List[int]) -> bool:
         """Host-tier blocks → device pages during admission."""
+        from dynamo_tpu.engine.model_runner import kv_arrays_to_payload
+
         k, v = self.host_pool.get(hashes)
         if k is not None:
-            payload = {
-                "data": True,
-                "k": k.tobytes(),
-                "v": v.tobytes(),
-                "shape": list(k.shape),
-                "dtype": "bfloat16",
-                "n_pages": len(pages),
-            }
-            self.runner.import_pages(pages, 0, payload)
+            self.runner.import_pages(pages, 0, kv_arrays_to_payload(k, v))
         return True
 
 

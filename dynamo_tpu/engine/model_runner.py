@@ -69,6 +69,35 @@ def _decode_loop(
     return toks.T, k_pool, v_pool  # [B, n_steps]
 
 
+def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
+    """KV wire format for P→D transfer and G2 offload: [L, Hk, n, PS, D]
+    arrays as raw bytes + shape/dtype metadata. Single definition — the
+    engine and host tier must not re-implement it."""
+    return {
+        "data": True,
+        "k": k.tobytes(),
+        "v": v.tobytes(),
+        "shape": list(k.shape),
+        "dtype": str(k.dtype),
+        "n_pages": int(k.shape[2]),
+    }
+
+
+def kv_payload_to_arrays(payload: Dict[str, Any]):
+    """Inverse of kv_arrays_to_payload; None if the payload carries no data
+    (simulated workers)."""
+    if not payload or not payload.get("k"):
+        return None
+    import ml_dtypes
+
+    name = payload["dtype"]
+    dtype = np.dtype(ml_dtypes.bfloat16) if "bfloat16" in name else np.dtype(name)
+    shape = tuple(payload["shape"])
+    k = np.frombuffer(payload["k"], dtype=dtype).reshape(shape)
+    v = np.frombuffer(payload["v"], dtype=dtype).reshape(shape)
+    return k, v
+
+
 def _next_bucket(buckets: Sequence[int], n: int) -> int:
     for b in buckets:
         if b >= n:
@@ -243,27 +272,16 @@ class ModelRunner:
         idx = jnp.asarray(np.asarray(pages, np.int32))
         k = np.asarray(jax.device_get(self.k_pool[:, :, idx]))
         v = np.asarray(jax.device_get(self.v_pool[:, :, idx]))
-        return {
-            "data": True,
-            "k": k.tobytes(),
-            "v": v.tobytes(),
-            "shape": list(k.shape),
-            "dtype": str(self.k_pool.dtype),
-            "n_pages": len(pages),
-        }
+        return kv_arrays_to_payload(k, v)
 
     def import_pages(self, target_pages: List[int], offset: int, payload: Dict[str, Any]) -> None:
         """Host→device write of transferred pages into this pool's page
         slots. `offset` = first payload page to use (earlier pages were
         satisfied by the local prefix cache)."""
-        if not payload.get("k"):
+        arrays = kv_payload_to_arrays(payload)
+        if arrays is None:
             return
-        import ml_dtypes
-
-        dtype = np.dtype(ml_dtypes.bfloat16) if "bfloat16" in payload["dtype"] else np.dtype(payload["dtype"])
-        shape = tuple(payload["shape"])
-        k = np.frombuffer(payload["k"], dtype=dtype).reshape(shape)
-        v = np.frombuffer(payload["v"], dtype=dtype).reshape(shape)
+        k, v = arrays
         sel = slice(offset, offset + len(target_pages))
         idx = jnp.asarray(np.asarray(target_pages, np.int32))
         self.k_pool = self.k_pool.at[:, :, idx].set(jnp.asarray(k[:, :, sel]))

@@ -29,6 +29,10 @@ def parse_args(argv=None):
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--disagg-min-prefill-tokens", type=int, default=256,
                    help="prompts at least this long go to prefill workers when present")
+    p.add_argument("--busy-threshold", type=int, default=0,
+                   help="shed load (503) above this many in-flight requests per model")
+    p.add_argument("--request-trace", default=None,
+                   help="JSONL per-request trace path (also DYN_REQUEST_TRACE)")
     p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
     p.add_argument("--discovery-root", default=None, help="file backend root dir")
     return p.parse_args(argv)
@@ -46,7 +50,10 @@ async def async_main(args) -> None:
         migration_limit=args.migration_limit,
         disagg_min_prefill_tokens=args.disagg_min_prefill_tokens,
     )
-    svc = HttpService(runtime, manager, watcher, host=args.http_host, port=args.http_port)
+    svc = HttpService(
+        runtime, manager, watcher, host=args.http_host, port=args.http_port,
+        busy_threshold=args.busy_threshold, trace_path=args.request_trace,
+    )
     await svc.start()
     try:
         await asyncio.Event().wait()

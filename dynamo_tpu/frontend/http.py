@@ -154,33 +154,39 @@ class HttpService:
         if all(isinstance(x, int) for x in inputs):
             inputs = [inputs]  # single token-id prompt
 
-        data = []
-        n_tokens = 0
-        for i, inp in enumerate(inputs):
+        token_lists = []
+        for inp in inputs:
             if isinstance(inp, str):
-                token_ids = entry.preprocessor.tokenize_prompt(inp, add_bos=False)
+                token_lists.append(entry.preprocessor.tokenize_prompt(inp, add_bos=False))
             else:
-                token_ids = [int(t) for t in inp]
-            n_tokens += len(token_ids)
+                token_lists.append([int(t) for t in inp])
+        n_tokens = sum(len(t) for t in token_lists)
+
+        async def one(token_ids):
             req = {
                 "token_ids": token_ids,
                 "annotations": {"kind": "embedding"},
                 "model": model,
             }
-            ctx = Context(metadata={"model": model})
-            vec = None
-            try:
-                async for item in entry.client.generate(req, ctx):
-                    if "embedding" in item:
-                        vec = item["embedding"]
-                    if item.get("finish_reason"):
-                        break
-            except Exception as e:
-                log.exception("embedding request failed")
-                return _error(500, str(e), "internal_error")
-            if vec is None:
-                return _error(500, "worker returned no embedding", "internal_error")
-            data.append({"object": "embedding", "index": i, "embedding": vec})
+            async for item in entry.client.generate(req, Context(metadata={"model": model})):
+                if "embedding" in item:
+                    return item["embedding"]
+                if item.get("finish_reason"):
+                    break
+            return None
+
+        # concurrent: the engine batches co-pending embeds into one pass
+        try:
+            vecs = await asyncio.gather(*[one(t) for t in token_lists])
+        except Exception as e:
+            log.exception("embedding request failed")
+            return _error(500, str(e), "internal_error")
+        if any(v is None for v in vecs):
+            return _error(500, "worker returned no embedding", "internal_error")
+        data = [
+            {"object": "embedding", "index": i, "embedding": v}
+            for i, v in enumerate(vecs)
+        ]
 
         return web.json_response(
             {

@@ -38,6 +38,27 @@ class DisaggPolicy:
         return self.enabled and len(token_ids) >= self.min_prefill_tokens
 
 
+class _FetchClient:
+    """One-shot client to a prefill worker's kv_fetch endpoint."""
+
+    def __init__(self, gen_client, src):
+        self.runtime = gen_client.runtime
+        self.src = src
+
+    async def discard(self) -> None:
+        c = self.runtime.client(self.src["path"])
+        await c.start()
+        c.router.update_instance(self.src["instance_id"], self.src["address"])
+        try:
+            async for _ in c.direct(
+                {"request_id": self.src["request_id"], "discard": True},
+                self.src["instance_id"],
+            ):
+                pass
+        finally:
+            await c.close()
+
+
 class PrefillRouter:
     """Engine wrapper. Inactive (no prefill workers) → pure passthrough.
 
@@ -88,18 +109,22 @@ class PrefillRouter:
 
         first_token, transfer_src, prefill_inst = prefill_result
         stop = dict(request.get("stop") or {})
+        max_tokens = stop.get("max_tokens")  # None = unlimited (engine semantics)
         if first_token in set(stop.get("stop_ids") or []) and not stop.get("ignore_eos"):
+            self._discard_parked(transfer_src)
             yield {"token_ids": [], "finish_reason": "stop"}
             return
         yield {"token_ids": [first_token], "finish_reason": None}
-        if int(stop.get("max_tokens", 1)) <= 1:
+        if max_tokens is not None and int(max_tokens) <= 1:
+            self._discard_parked(transfer_src)
             yield {"token_ids": [], "finish_reason": "length"}
             return
 
         # decode continuation: prompt += first token, budget -= 1
         dreq = dict(request)
         dreq["token_ids"] = list(token_ids) + [int(first_token)]
-        stop["max_tokens"] = int(stop.get("max_tokens", 512)) - 1
+        if max_tokens is not None:
+            stop["max_tokens"] = int(max_tokens) - 1
         dreq["stop"] = stop
         ann = dict(dreq.get("annotations") or {})
         ann["disagg"] = "decode"
@@ -108,6 +133,22 @@ class PrefillRouter:
 
         async for item in self.downstream.generate(dreq, context):
             yield item
+
+    def _discard_parked(self, transfer_src) -> None:
+        """Early finish: release the prefill worker's parked pages without
+        transferring them (fire-and-forget; the parked TTL is the backstop)."""
+
+        async def _release():
+            try:
+                client = self._prefill_client
+                if client is None:
+                    return
+                fetch = _FetchClient(client, transfer_src)
+                await fetch.discard()
+            except Exception:
+                pass  # TTL reclaims
+
+        asyncio.create_task(_release())
 
     async def _run_prefill_hop(self, request, context):
         preq = dict(request)

@@ -132,7 +132,10 @@ async def serve_worker(
     # disagg endpoints: prefill workers serve parked-KV pulls; decode
     # workers (and aggregated) accept transfer-carrying requests
     async def kv_fetch(request, context):
-        return await engine.export_parked_kv((request or {}).get("request_id"))
+        req = request or {}
+        return await engine.export_parked_kv(
+            req.get("request_id"), discard=bool(req.get("discard"))
+        )
 
     await runtime.serve_endpoint(
         f"{namespace}/{component}/kv_fetch", kv_fetch, instance_id=instance_id
