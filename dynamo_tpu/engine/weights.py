@@ -1,0 +1,132 @@
+"""Checkpoint loading: HF safetensors / orbax → the engine's param tree.
+
+Fills the role of the reference's model-fetch path (lib/llm/src/hub.rs +
+per-backend weight loading inside vLLM/TRT-LLM): map a HuggingFace
+Llama-family checkpoint directory onto models/llama.py's stacked-layer
+pytree, casting to the serving dtype, ready for ShardingPolicy placement.
+
+HF → dynamo_tpu name map (Llama architecture):
+  model.embed_tokens.weight            → embed                [V, E]
+  model.layers.{i}.input_layernorm     → layers/attn_norm[i]
+  model.layers.{i}.self_attn.{q,k,v}_proj (transposed) → layers/w{q,k,v}[i]
+  model.layers.{i}.self_attn.o_proj    (transposed)    → layers/wo[i]
+  model.layers.{i}.post_attention_layernorm → layers/mlp_norm[i]
+  model.layers.{i}.mlp.{gate,up,down}_proj (transposed) → layers/w_{gate,up,down}[i]
+  model.norm.weight                    → norm_f
+  lm_head.weight (transposed)          → lm_head (absent if tied)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+log = logging.getLogger("dynamo_tpu.engine.weights")
+
+
+def load_hf_checkpoint(
+    checkpoint_dir: str, config: ModelConfig, dtype="bfloat16"
+) -> Dict[str, Any]:
+    """Load a HF Llama safetensors checkpoint into the stacked param tree
+    (numpy arrays; the ModelRunner device_puts them with shardings)."""
+    import ml_dtypes
+    from safetensors import safe_open
+
+    np_dtype = np.dtype(ml_dtypes.bfloat16) if dtype == "bfloat16" else np.dtype(dtype)
+    d = Path(checkpoint_dir)
+    files = sorted(d.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {checkpoint_dir}")
+
+    # name -> file handle index
+    tensors: Dict[str, Any] = {}
+    handles = []
+    for f in files:
+        h = safe_open(str(f), framework="numpy")
+        handles.append(h)
+        for name in h.keys():
+            tensors[name] = h
+
+    def get(name: str, transpose: bool = False) -> np.ndarray:
+        arr = tensors[name].get_tensor(name)
+        if transpose:
+            arr = arr.T
+        return np.ascontiguousarray(arr).astype(np_dtype)
+
+    def get_f32(name: str) -> np.ndarray:
+        return tensors[name].get_tensor(name).astype(np.float32)
+
+    L = config.n_layers
+    first_q = get("model.layers.0.self_attn.q_proj.weight", transpose=True)
+    if first_q.shape != (config.dim, config.n_heads * config.head_dim):
+        raise ValueError(
+            f"checkpoint shape {first_q.shape} does not match config "
+            f"{config.name} ({config.dim}, {config.n_heads * config.head_dim})"
+        )
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        return np.stack([get(fmt.format(i=i), transpose=transpose) for i in range(L)])
+
+    def stack_f32(fmt: str) -> np.ndarray:
+        return np.stack([get_f32(fmt.format(i=i)) for i in range(L)])
+
+    params: Dict[str, Any] = {
+        "embed": get("model.embed_tokens.weight"),
+        "layers": {
+            "attn_norm": stack_f32("model.layers.{i}.input_layernorm.weight"),
+            "wq": stack("model.layers.{i}.self_attn.q_proj.weight", True),
+            "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
+            "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
+            "wo": stack("model.layers.{i}.self_attn.o_proj.weight", True),
+            "mlp_norm": stack_f32("model.layers.{i}.post_attention_layernorm.weight"),
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", True),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight", True),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight", True),
+        },
+        "norm_f": get_f32("model.norm.weight"),
+    }
+    if "lm_head.weight" in tensors and not config.tie_embeddings:
+        params["lm_head"] = get("lm_head.weight", transpose=True)
+    log.info("loaded HF checkpoint %s (%d files)", checkpoint_dir, len(files))
+    return params
+
+
+def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConfig:
+    """Derive a ModelConfig from a HF config.json (Llama family)."""
+    cfg = json.loads((Path(checkpoint_dir) / "config.json").read_text())
+    return ModelConfig(
+        name=name or cfg.get("_name_or_path", "hf-model"),
+        vocab_size=cfg["vocab_size"],
+        dim=cfg["hidden_size"],
+        n_layers=cfg["num_hidden_layers"],
+        n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+        ffn_dim=cfg["intermediate_size"],
+        max_seq_len=cfg.get("max_position_embeddings", 8192),
+        rope_theta=float(cfg.get("rope_theta", 500000.0)),
+        norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+    )
+
+
+def save_orbax(params: Dict[str, Any], path: str) -> None:
+    """Persist a param tree with orbax (fast-resume staging; the TPU analog
+    of the reference's GMS/ModelExpress fast-restart role)."""
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(Path(path).resolve(), params, force=True)
+    ckpt.wait_until_finished()
+
+
+def load_orbax(path: str) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.StandardCheckpointer()
+    return ckpt.restore(Path(path).resolve())

@@ -26,6 +26,8 @@ log = logging.getLogger("dynamo_tpu.worker")
 def parse_args(argv=None):
     p = argparse.ArgumentParser("dynamo_tpu.worker")
     p.add_argument("--model", default="tiny", help="model config preset name")
+    p.add_argument("--checkpoint", default=None,
+                   help="HF safetensors checkpoint dir (config derived from its config.json)")
     p.add_argument("--model-name", default=None, help="served model name (default: config name)")
     p.add_argument("--namespace", default="dyn")
     p.add_argument("--component", default="tpu-worker")
@@ -54,7 +56,14 @@ def parse_args(argv=None):
 
 
 def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
-    config = get_config(args.model)
+    params = None
+    if args.checkpoint:
+        from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
+
+        config = config_from_hf(args.checkpoint, name=args.model_name or args.model)
+        params = load_hf_checkpoint(args.checkpoint, config)
+    else:
+        config = get_config(args.model)
     mesh = MeshConfig(
         data=args.data_parallel,
         model=args.tensor_parallel,
@@ -68,6 +77,7 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         num_pages=args.num_pages,
         page_size=args.page_size,
         max_pages_per_seq=max_pages_per_seq,
+        params=params,
     )
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
