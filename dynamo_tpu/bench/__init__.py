@@ -1,0 +1,4 @@
+"""Benchmark harness (analog of reference lib/bench + benchmarks/ +
+DynoSim replay): synthetic trace generation, load generation against a
+serving stack, and SLO-goodput reporting — the BASELINE.md north-star
+metric (output tok/s under TTFT+ITL SLO)."""

@@ -1,0 +1,194 @@
+"""Load generation + goodput measurement.
+
+Traces are JSONL records {"ts": s_offset, "isl": n, "osl": n, "prefix_group":
+k} (the Mooncake-style schema of reference lib/data-gen): ts is the request
+start offset, isl/osl the input/output lengths, prefix_group selects a
+shared prompt prefix (prefix-reuse workloads for KV-router A/B).
+
+Goodput (docs/benchmarks/benchmarking.md:449): output tokens/s summed over
+requests that met BOTH the TTFT and ITL SLOs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.runtime.context import Context
+
+
+@dataclass
+class TraceRequest:
+    ts: float
+    isl: int
+    osl: int
+    prefix_group: int = -1  # -1 = unique prompt
+
+
+def generate_trace(
+    n_requests: int,
+    rps: float,
+    isl_mean: int = 512,
+    osl_mean: int = 128,
+    prefix_groups: int = 0,
+    prefix_fraction: float = 0.5,
+    seed: int = 0,
+    burstiness: float = 1.0,  # 1 = poisson; >1 burstier
+) -> List[TraceRequest]:
+    rng = random.Random(seed)
+    out: List[TraceRequest] = []
+    t = 0.0
+    for i in range(n_requests):
+        t += rng.expovariate(rps) * (burstiness if rng.random() < 0.2 else 1.0)
+        isl = max(8, int(rng.gauss(isl_mean, isl_mean / 4)))
+        osl = max(4, int(rng.gauss(osl_mean, osl_mean / 4)))
+        group = (
+            rng.randrange(prefix_groups)
+            if prefix_groups and rng.random() < prefix_fraction
+            else -1
+        )
+        out.append(TraceRequest(ts=t, isl=isl, osl=osl, prefix_group=group))
+    return out
+
+
+def save_trace(trace: List[TraceRequest], path: str) -> None:
+    with open(path, "w") as f:
+        for r in trace:
+            f.write(json.dumps(r.__dict__) + "\n")
+
+
+def load_trace(path: str) -> List[TraceRequest]:
+    out = []
+    for line in open(path):
+        d = json.loads(line)
+        out.append(TraceRequest(**{k: d[k] for k in ("ts", "isl", "osl") if k in d}
+                                | {"prefix_group": d.get("prefix_group", -1)}))
+    return out
+
+
+@dataclass
+class RequestResult:
+    ok: bool
+    ttft_s: Optional[float] = None
+    total_s: Optional[float] = None
+    osl: int = 0
+    error: Optional[str] = None
+
+    @property
+    def itl_s(self) -> Optional[float]:
+        if self.ttft_s is None or self.osl <= 1 or self.total_s is None:
+            return None
+        return (self.total_s - self.ttft_s) / (self.osl - 1)
+
+
+@dataclass
+class GoodputReport:
+    n_requests: int
+    n_ok: int
+    n_slo_met: int
+    duration_s: float
+    output_tokens: int
+    goodput_tok_s: float  # SLO-meeting output tokens / duration
+    throughput_tok_s: float  # all output tokens / duration
+    ttft_p50_s: float
+    ttft_p99_s: float
+    itl_p50_s: float
+    itl_p99_s: float
+
+    def to_json(self) -> str:
+        return json.dumps({k: round(v, 4) if isinstance(v, float) else v
+                           for k, v in self.__dict__.items()})
+
+
+def _pct(vals: List[float], p: float) -> float:
+    if not vals:
+        return float("nan")
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(math.ceil(p * len(vals))) - 1)]
+
+
+def compute_goodput(
+    results: List[RequestResult],
+    duration_s: float,
+    ttft_slo_s: float,
+    itl_slo_s: float,
+) -> GoodputReport:
+    ok = [r for r in results if r.ok]
+    met = [
+        r for r in ok
+        if r.ttft_s is not None and r.ttft_s <= ttft_slo_s
+        and (r.itl_s is None or r.itl_s <= itl_slo_s)
+    ]
+    ttfts = [r.ttft_s for r in ok if r.ttft_s is not None]
+    itls = [r.itl_s for r in ok if r.itl_s is not None]
+    return GoodputReport(
+        n_requests=len(results),
+        n_ok=len(ok),
+        n_slo_met=len(met),
+        duration_s=duration_s,
+        output_tokens=sum(r.osl for r in ok),
+        goodput_tok_s=sum(r.osl for r in met) / max(duration_s, 1e-9),
+        throughput_tok_s=sum(r.osl for r in ok) / max(duration_s, 1e-9),
+        ttft_p50_s=_pct(ttfts, 0.5),
+        ttft_p99_s=_pct(ttfts, 0.99),
+        itl_p50_s=_pct(itls, 0.5),
+        itl_p99_s=_pct(itls, 0.99),
+    )
+
+
+def _prompt_tokens(req: TraceRequest, rng: random.Random) -> List[int]:
+    """Token-id prompt; prefix groups share leading tokens."""
+    if req.prefix_group >= 0:
+        g = random.Random(1000 + req.prefix_group)
+        shared_len = max(8, int(req.isl * 0.75))
+        prompt = [g.randrange(300, 50000) for _ in range(shared_len)]
+        prompt += [rng.randrange(300, 50000) for _ in range(req.isl - shared_len)]
+        return prompt
+    return [rng.randrange(300, 50000) for _ in range(req.isl)]
+
+
+async def run_trace_against_engine(
+    trace: List[TraceRequest],
+    generate_fn,  # async fn(request_dict, Context) -> async iterator of items
+    time_scale: float = 1.0,  # <1 compresses the trace clock
+    seed: int = 0,
+) -> tuple[List[RequestResult], float]:
+    """Fire the trace at a generate endpoint (engine chain, client, or HTTP
+    adapter), honoring arrival times. Returns (results, duration)."""
+    rng = random.Random(seed)
+    t0 = time.monotonic()
+    results: List[RequestResult] = [None] * len(trace)  # type: ignore
+
+    async def one(i: int, req: TraceRequest) -> None:
+        delay = req.ts * time_scale - (time.monotonic() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        start = time.monotonic()
+        first = None
+        n_out = 0
+        try:
+            payload = {
+                "token_ids": _prompt_tokens(req, rng),
+                "sampling": {"temperature": 0.0},
+                "stop": {"max_tokens": req.osl, "stop_ids": [], "ignore_eos": True},
+            }
+            async for item in generate_fn(payload, Context()):
+                n = len(item.get("token_ids") or [])
+                if n and first is None:
+                    first = time.monotonic() - start
+                n_out += n
+                if item.get("finish_reason"):
+                    break
+            results[i] = RequestResult(
+                ok=True, ttft_s=first, total_s=time.monotonic() - start, osl=n_out
+            )
+        except Exception as e:
+            results[i] = RequestResult(ok=False, error=str(e))
+
+    await asyncio.gather(*[one(i, r) for i, r in enumerate(trace)])
+    return results, time.monotonic() - t0

@@ -54,6 +54,8 @@ class HttpService:
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
                 web.post("/v1/embeddings", self.embeddings),
+                web.post("/v1/messages", self.anthropic_messages),
+                web.post("/v1/messages/count_tokens", self.anthropic_count_tokens),
                 web.get("/v1/models", self.list_models),
                 web.get("/v1/models/{model}", self.get_model),
                 web.get("/health", self.health),
@@ -132,6 +134,104 @@ class HttpService:
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         return await self._run_inference(request, kind="completions")
+
+    # -- Anthropic Messages API (reference http/service/anthropic.rs:67,557)
+    @staticmethod
+    def _anthropic_to_chat(body: Dict[str, Any]) -> Dict[str, Any]:
+        """Map an Anthropic Messages request onto the internal chat shape."""
+        messages = []
+        if body.get("system"):
+            sys_content = body["system"]
+            if isinstance(sys_content, list):  # content-block form
+                sys_content = "".join(b.get("text", "") for b in sys_content)
+            messages.append({"role": "system", "content": sys_content})
+        for m in body.get("messages") or []:
+            content = m.get("content")
+            if isinstance(content, list):
+                content = "".join(
+                    b.get("text", "") for b in content if b.get("type") == "text"
+                )
+            messages.append({"role": m.get("role", "user"), "content": content})
+        mapped = {
+            "model": body.get("model"),
+            "messages": messages,
+            "max_tokens": body.get("max_tokens", 512),
+            "temperature": body.get("temperature", 1.0),
+            "top_p": body.get("top_p", 1.0),
+            "top_k": body.get("top_k", 0),
+            "stop": body.get("stop_sequences") or [],
+        }
+        return mapped
+
+    async def anthropic_messages(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body", "invalid_request_error")
+        model = body.get("model")
+        try:
+            entry = self.manager.get(model)
+        except KeyError:
+            return _error(404, f"model {model!r} not found", "not_found_error")
+        chat = self._anthropic_to_chat(body)
+        try:
+            preprocessed = entry.preprocessor.preprocess_chat(chat)
+        except ValueError as e:
+            return _error(400, str(e), "invalid_request_error")
+
+        ctx = Context(metadata={"model": model})
+        text_parts: list = []
+        finish = None
+        n_out = 0
+        try:
+            async for item in entry.chain.generate(preprocessed, ctx):
+                text_parts.append(item.get("text", ""))
+                n_out += len(item.get("token_ids") or [])
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+                    break
+        except Exception as e:
+            log.exception("anthropic messages request failed")
+            return _error(500, str(e), "api_error")
+        finally:
+            ctx.stop_generating()
+        stop_reason = {"stop": "stop_sequence", "length": "max_tokens"}.get(
+            finish or "stop", "end_turn"
+        )
+        if finish == "stop":
+            stop_reason = "end_turn"
+        return web.json_response(
+            {
+                "id": f"msg_{uuid.uuid4().hex[:24]}",
+                "type": "message",
+                "role": "assistant",
+                "model": model,
+                "content": [{"type": "text", "text": "".join(text_parts)}],
+                "stop_reason": stop_reason,
+                "stop_sequence": None,
+                "usage": {
+                    "input_tokens": len(preprocessed["token_ids"]),
+                    "output_tokens": n_out,
+                },
+            }
+        )
+
+    async def anthropic_count_tokens(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body", "invalid_request_error")
+        model = body.get("model")
+        try:
+            entry = self.manager.get(model)
+        except KeyError:
+            return _error(404, f"model {model!r} not found", "not_found_error")
+        chat = self._anthropic_to_chat(body)
+        try:
+            preprocessed = entry.preprocessor.preprocess_chat(chat)
+        except ValueError as e:
+            return _error(400, str(e), "invalid_request_error")
+        return web.json_response({"input_tokens": len(preprocessed["token_ids"])})
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """OpenAI embeddings API (reference http/service/openai.rs:2902):

@@ -1,0 +1,104 @@
+"""`python -m dynamo_tpu.replay` — offline simulation runs (DynoSim analog,
+reference `python -m dynamo.replay`, docs/dynosim/README.md:17-26).
+
+Builds an in-process serving stack — N mocker workers with the TPU
+step-time model behind the real scheduler/page-pool/router — replays a
+trace (generated or loaded), and reports SLO goodput. TPU-free router and
+scheduler A/B evaluation:
+
+  python -m dynamo_tpu.replay --workers 2 --router-mode kv \
+      --requests 200 --rps 20 --prefix-groups 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from dynamo_tpu.bench.loadgen import (
+    compute_goodput,
+    generate_trace,
+    load_trace,
+    run_trace_against_engine,
+)
+from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+from dynamo_tpu.mocker.__main__ import build_mock_engine
+from dynamo_tpu.mocker.__main__ import parse_args as mocker_args
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.logging_util import configure_logging
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.worker_common import serve_worker
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.replay")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--router-mode", default="kv", choices=["round_robin", "random", "kv"])
+    p.add_argument("--trace", default=None, help="trace JSONL (else generated)")
+    p.add_argument("--requests", type=int, default=100)
+    p.add_argument("--rps", type=float, default=20.0)
+    p.add_argument("--isl", type=int, default=256)
+    p.add_argument("--osl", type=int, default=64)
+    p.add_argument("--prefix-groups", type=int, default=0)
+    p.add_argument("--ttft-slo", type=float, default=2.0)
+    p.add_argument("--itl-slo", type=float, default=0.05)
+    p.add_argument("--speed", type=float, default=1.0, help="sim timing scale")
+    p.add_argument("--decode-base-ms", type=float, default=4.0)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+async def run_replay(args) -> dict:
+    realm = f"replay-{args.seed}"
+    workers = []
+    for _ in range(args.workers):
+        rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+        margs = mocker_args([
+            "--speed", str(args.speed),
+            "--decode-base-ms", str(args.decode_base_ms),
+            "--page-size", str(args.page_size),
+        ])
+        engine, card = build_mock_engine(margs)
+        w = await serve_worker(rt, engine, card)
+        workers.append((rt, w))
+
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager, router_mode=args.router_mode)
+    await watcher.start()
+    await watcher.wait_for_model(timeout=10)
+    entry = manager.get("mock-model")
+
+    if args.trace:
+        trace = load_trace(args.trace)
+    else:
+        trace = generate_trace(
+            args.requests, args.rps, isl_mean=args.isl, osl_mean=args.osl,
+            prefix_groups=args.prefix_groups, seed=args.seed,
+        )
+
+    try:
+        results, duration = await run_trace_against_engine(
+            trace, entry.chain.generate, seed=args.seed
+        )
+        report = compute_goodput(results, duration, args.ttft_slo, args.itl_slo)
+        return json.loads(report.to_json())
+    finally:
+        await watcher.stop()
+        await frt.shutdown()
+        for rt, w in workers:
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+def main(argv=None) -> None:
+    configure_logging()
+    args = parse_args(argv)
+    report = asyncio.run(run_replay(args))
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

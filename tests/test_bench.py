@@ -1,0 +1,48 @@
+"""Loadgen/goodput harness + offline replay tests."""
+
+import pytest
+
+from dynamo_tpu.bench.loadgen import (
+    RequestResult,
+    compute_goodput,
+    generate_trace,
+    load_trace,
+    save_trace,
+)
+
+
+def test_trace_generation_and_roundtrip(tmp_path):
+    trace = generate_trace(50, rps=10, isl_mean=100, osl_mean=20, prefix_groups=4, seed=1)
+    assert len(trace) == 50
+    assert all(trace[i].ts <= trace[i + 1].ts for i in range(49))
+    assert any(r.prefix_group >= 0 for r in trace)
+    p = tmp_path / "t.jsonl"
+    save_trace(trace, str(p))
+    again = load_trace(str(p))
+    assert [r.ts for r in again] == [r.ts for r in trace]
+
+
+def test_goodput_slo_accounting():
+    results = [
+        RequestResult(ok=True, ttft_s=0.1, total_s=1.0, osl=10),   # meets
+        RequestResult(ok=True, ttft_s=5.0, total_s=6.0, osl=10),   # ttft miss
+        RequestResult(ok=True, ttft_s=0.1, total_s=10.0, osl=10),  # itl miss
+        RequestResult(ok=False, error="boom"),
+    ]
+    rep = compute_goodput(results, duration_s=10.0, ttft_slo_s=2.0, itl_slo_s=0.5)
+    assert rep.n_ok == 3 and rep.n_slo_met == 1
+    assert rep.goodput_tok_s == pytest.approx(1.0)
+    assert rep.throughput_tok_s == pytest.approx(3.0)
+
+
+async def test_offline_replay_end_to_end():
+    from dynamo_tpu.replay import parse_args, run_replay
+
+    args = parse_args([
+        "--workers", "2", "--requests", "20", "--rps", "100",
+        "--speed", "0", "--router-mode", "kv", "--prefix-groups", "3",
+    ])
+    report = await run_replay(args)
+    assert report["n_ok"] == 20
+    assert report["output_tokens"] > 0
+    assert report["goodput_tok_s"] > 0

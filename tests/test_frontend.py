@@ -312,3 +312,31 @@ async def test_busy_threshold_sheds_load():
         await svc.stop()
         await frt.shutdown()
         await wrt.shutdown(drain_timeout=1)
+
+
+async def test_anthropic_messages_endpoint():
+    wrt, frt, svc, base = await _start_stack(realm="anthropic")
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "echo-model",
+                "system": "be brief",
+                "messages": [{"role": "user", "content": [{"type": "text", "text": "hi"}]}],
+                "max_tokens": 10,
+            }
+            async with s.post(f"{base}/v1/messages", json=payload) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["type"] == "message" and body["role"] == "assistant"
+            assert body["stop_reason"] == "max_tokens"
+            assert body["usage"]["output_tokens"] == 10
+            assert body["content"][0]["type"] == "text"
+
+            async with s.post(f"{base}/v1/messages/count_tokens", json=payload) as r:
+                assert r.status == 200
+                count = await r.json()
+            assert count["input_tokens"] == body["usage"]["input_tokens"]
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
