@@ -1,0 +1,138 @@
+"""Expert-parallel MoE with all-to-all token dispatch over the `expert`
+mesh axis.
+
+The wide-EP building block (reference deploys DeepSeek-class wide-EP via
+engine backends + recipes, SURVEY.md §2.10; here it is native): tokens are
+sharded across expert ranks; each rank routes its local tokens, packs them
+into per-destination capacity buffers, exchanges them with one
+`all_to_all` over ICI, runs its resident experts, and returns results with
+a second all_to_all, combining with router weights.
+
+Capacity model: each (src rank → dst rank) lane carries up to C tokens,
+C = ceil(T_local * k / n_ranks * capacity_factor). Overflow tokens are
+dropped (contribute zero), standard Switch/GShard semantics — with
+capacity_factor ≥ n_experts/k the dispatch is lossless and matches the
+dense reference exactly.
+
+Engine integration note: models/llama.py currently computes MoE densely
+with expert-sharded weights (GSPMD all-gather EP); this op replaces that
+path once engine activations are token-sharded over `expert` (round 2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis: str):
+    """Per-shard body. x: [T, E] local tokens; we_*: [n_local, ...] resident
+    experts; router weights replicated. Returns [T, E]."""
+    n_ranks = lax.psum(1, axis)
+    rank = lax.axis_index(axis)
+    T, E = x.shape
+    n_local = we_gate.shape[0]
+    n_experts = n_local * n_ranks
+
+    logits = (x @ w_router).astype(jnp.float32)  # [T, n_experts]
+    weights, sel = lax.top_k(logits, k)  # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1).astype(x.dtype)
+
+    # flatten (token, choice) pairs and bucket by destination rank
+    flat_sel = sel.reshape(-1)  # [T*k] expert ids
+    flat_tok = jnp.repeat(jnp.arange(T), k)  # [T*k]
+    flat_w = weights.reshape(-1)
+    dest = flat_sel // n_local  # destination rank per pair
+
+    # position of each pair within its (dest rank, capacity) lane: running
+    # count of earlier pairs with the same destination
+    onehot = jax.nn.one_hot(dest, n_ranks, dtype=jnp.int32)  # [T*k, R]
+    pos_in_dest = ((jnp.cumsum(onehot, axis=0) - onehot) * onehot).sum(-1)
+    keep = pos_in_dest < capacity
+
+    # dispatch buffers [R, C, E] + bookkeeping [R, C]
+    disp_x = jnp.zeros((n_ranks, capacity, E), x.dtype)
+    disp_expert = jnp.zeros((n_ranks, capacity), jnp.int32)
+    slot_r = jnp.where(keep, dest, n_ranks)  # OOB drop
+    slot_c = jnp.where(keep, pos_in_dest, capacity)
+    disp_x = disp_x.at[slot_r, slot_c].set(x[flat_tok], mode="drop")
+    disp_expert = disp_expert.at[slot_r, slot_c].set(flat_sel % n_local, mode="drop")
+
+    # exchange: [R, C, E] → every rank receives its inbound tokens
+    recv_x = lax.all_to_all(disp_x, axis, split_axis=0, concat_axis=0, tiled=False)
+    recv_expert = lax.all_to_all(disp_expert, axis, split_axis=0, concat_axis=0, tiled=False)
+    # recv_x: [R, C, E] — row r = tokens sent by rank r to us
+
+    rx = recv_x.reshape(n_ranks * capacity, E)
+    re_ = recv_expert.reshape(n_ranks * capacity)
+
+    # run resident experts on every received token, select by expert id
+    def expert_fn(wg, wu, wd):
+        return (jax.nn.silu(rx @ wg) * (rx @ wu)) @ wd  # [RC, E]
+
+    all_out = jax.vmap(expert_fn)(we_gate, we_up, we_down)  # [n_local, RC, E]
+    out_tok = jnp.take_along_axis(
+        all_out.transpose(1, 0, 2), re_[:, None, None], axis=1
+    )[:, 0]  # [RC, E]
+
+    # send results back
+    back = lax.all_to_all(
+        out_tok.reshape(n_ranks, capacity, E), axis, split_axis=0, concat_axis=0
+    )  # [R, C, E] — row r = results for pairs we sent to rank r
+
+    # combine: scatter-add weighted results back to source tokens
+    y = jnp.zeros((T, E), jnp.float32)
+    gathered = back[slot_r.clip(0, n_ranks - 1), slot_c.clip(0, capacity - 1)]
+    gathered = jnp.where(keep[:, None], gathered.astype(jnp.float32), 0.0)
+    y = y.at[flat_tok].add(gathered * flat_w[:, None].astype(jnp.float32))
+    return y.astype(x.dtype)
+
+
+def moe_ep(
+    x: jax.Array,  # [T, E] tokens, sharded over `axis` on dim 0
+    w_router: jax.Array,  # [E, n_experts] replicated
+    we_gate: jax.Array,  # [n_experts, E, F] sharded over `axis` on dim 0
+    we_up: jax.Array,
+    we_down: jax.Array,  # [n_experts, F, E]
+    mesh: Mesh,
+    n_experts_active: int,
+    capacity_factor: float = 2.0,
+    axis: str = "expert",
+) -> jax.Array:
+    """Token-dispatch EP MoE. Returns [T, E] with x's sharding."""
+    n_ranks = mesh.shape[axis]
+    T_local = x.shape[0] // n_ranks
+    n_experts = we_gate.shape[0]
+    capacity = int(np.ceil(T_local * n_experts_active / n_ranks * capacity_factor))
+
+    fn = jax.shard_map(
+        partial(
+            _local_moe, k=n_experts_active, capacity=capacity, axis=axis
+        ),
+        mesh=mesh,
+        in_specs=(P(axis, None), P(), P(axis, None, None), P(axis, None, None), P(axis, None, None)),
+        out_specs=P(axis, None),
+    )
+    return fn(x, w_router, we_gate, we_up, we_down)
+
+
+def moe_dense_reference(x, w_router, we_gate, we_up, we_down, k: int):
+    """Unsharded dense top-k MoE (same math as models/llama.py _moe_block)."""
+    logits = (x @ w_router).astype(jnp.float32)
+    weights, sel = lax.top_k(logits, k)
+    weights = jax.nn.softmax(weights, axis=-1).astype(x.dtype)
+
+    def expert_fn(wg, wu, wd):
+        return (jax.nn.silu(x @ wg) * (x @ wu)) @ wd
+
+    all_out = jax.vmap(expert_fn)(we_gate, we_up, we_down)  # [n_exp, T, E]
+    sel_out = jnp.take_along_axis(
+        all_out.transpose(1, 0, 2), sel[..., None], axis=1
+    )  # [T, k, E]
+    return jnp.sum(sel_out * weights[..., None], axis=1)
