@@ -20,6 +20,8 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser("dynamo_tpu.frontend")
     p.add_argument("--http-host", default="0.0.0.0")
     p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--grpc-port", type=int, default=0,
+                   help="also serve the KServe v2 gRPC frontend on this port (0 = off)")
     p.add_argument(
         "--router-mode",
         default="round_robin",
@@ -55,11 +57,19 @@ async def async_main(args) -> None:
         busy_threshold=args.busy_threshold, trace_path=args.request_trace,
     )
     await svc.start()
+    grpc_server = None
+    if args.grpc_port:
+        from dynamo_tpu.frontend.grpc_kserve import KServeGrpcServer
+
+        grpc_server = KServeGrpcServer(manager, host=args.http_host, port=args.grpc_port)
+        await grpc_server.start()
     try:
         await asyncio.Event().wait()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if grpc_server is not None:
+            await grpc_server.stop()
         await svc.stop()
         await runtime.shutdown()
 

@@ -1,0 +1,156 @@
+"""KServe v2 gRPC frontend (analog of reference lib/llm/src/grpc/: the
+Triton-compatible KServe service, SURVEY.md §2.3).
+
+The image lacks the grpc python codegen plugin, so message classes come
+from plain protoc (protos/kserve_pb2.py) and the service is registered via
+grpc.aio generic method handlers — same wire protocol, no generated stubs.
+
+Supported inference shape: input tensor "text" (BYTES, one element per
+request) or "input_ids" (INT32/INT64); parameters max_tokens/temperature/
+top_p/top_k; output tensors "text_output" (BYTES) and "output_ids" (INT32).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from pathlib import Path
+from typing import Optional
+
+import grpc
+
+sys.path.insert(0, str(Path(__file__).parent / "protos"))
+import kserve_pb2 as pb  # noqa: E402
+
+from dynamo_tpu.frontend.service import ModelManager  # noqa: E402
+from dynamo_tpu.runtime.context import Context  # noqa: E402
+
+log = logging.getLogger("dynamo_tpu.grpc")
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+class KServeService:
+    def __init__(self, manager: ModelManager):
+        self.manager = manager
+
+    # -- handlers -----------------------------------------------------------
+    async def server_live(self, request, context) -> pb.ServerLiveResponse:
+        return pb.ServerLiveResponse(live=True)
+
+    async def server_ready(self, request, context) -> pb.ServerReadyResponse:
+        return pb.ServerReadyResponse(ready=bool(self.manager.models))
+
+    async def model_ready(self, request, context) -> pb.ModelReadyResponse:
+        return pb.ModelReadyResponse(ready=request.name in self.manager.models)
+
+    async def model_metadata(self, request, context) -> pb.ModelMetadataResponse:
+        if request.name not in self.manager.models:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"model {request.name!r} not found")
+        return pb.ModelMetadataResponse(
+            name=request.name, versions=["1"], platform="dynamo_tpu"
+        )
+
+    async def model_infer(self, request, context) -> pb.ModelInferResponse:
+        try:
+            entry = self.manager.get(request.model_name)
+        except KeyError:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"model {request.model_name!r} not found"
+            )
+
+        token_ids = None
+        text = None
+        for inp in request.inputs:
+            if inp.name == "input_ids":
+                token_ids = list(inp.contents.int_contents) or list(
+                    inp.contents.int64_contents
+                )
+            elif inp.name == "text" and inp.contents.bytes_contents:
+                text = inp.contents.bytes_contents[0].decode("utf-8", errors="replace")
+        if token_ids is None and text is None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "need input tensor 'text' or 'input_ids'"
+            )
+
+        p = request.parameters
+
+        def param(name, default, kind):
+            if name not in p:
+                return default
+            v = p[name]
+            return getattr(v, kind)
+
+        body = {
+            "model": request.model_name,
+            "prompt": text if text is not None else token_ids,
+            "max_tokens": int(param("max_tokens", 64, "int64_param")) or 64,
+            "temperature": param("temperature", 0.0, "double_param"),
+            "top_p": param("top_p", 1.0, "double_param") or 1.0,
+            "top_k": int(param("top_k", 0, "int64_param")),
+        }
+        preprocessed = entry.preprocessor.preprocess_completions(body)
+
+        ctx = Context(metadata={"model": request.model_name})
+        parts, out_ids = [], []
+        try:
+            async for item in entry.chain.generate(preprocessed, ctx):
+                parts.append(item.get("text", ""))
+                out_ids.extend(item.get("token_ids") or [])
+                if item.get("finish_reason"):
+                    break
+        finally:
+            ctx.stop_generating()
+
+        resp = pb.ModelInferResponse(
+            model_name=request.model_name, model_version="1", id=request.id
+        )
+        t = resp.outputs.add()
+        t.name = "text_output"
+        t.datatype = "BYTES"
+        t.shape.extend([1])
+        t.contents.bytes_contents.append("".join(parts).encode())
+        t2 = resp.outputs.add()
+        t2.name = "output_ids"
+        t2.datatype = "INT32"
+        t2.shape.extend([len(out_ids)])
+        t2.contents.int_contents.extend(int(x) for x in out_ids)
+        return resp
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+class KServeGrpcServer:
+    def __init__(self, manager: ModelManager, host: str = "127.0.0.1", port: int = 0):
+        self.service = KServeService(manager)
+        self.host = host
+        self.port = port
+        self._server: Optional[grpc.aio.Server] = None
+
+    async def start(self) -> str:
+        svc = self.service
+        handlers = {
+            "ServerLive": _unary(svc.server_live, pb.ServerLiveRequest),
+            "ServerReady": _unary(svc.server_ready, pb.ServerReadyRequest),
+            "ModelReady": _unary(svc.model_ready, pb.ModelReadyRequest),
+            "ModelMetadata": _unary(svc.model_metadata, pb.ModelMetadataRequest),
+            "ModelInfer": _unary(svc.model_infer, pb.ModelInferRequest),
+        }
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        log.info("KServe gRPC frontend on %s:%d", self.host, self.port)
+        return f"{self.host}:{self.port}"
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=5)

@@ -74,7 +74,17 @@ async def async_main(args) -> None:
     )
     print(f"mocker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
     try:
-        await asyncio.Event().wait()
+        stop_ev = asyncio.Event()
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop_ev.set)
+            except NotImplementedError:  # pragma: no cover
+                pass
+        await stop_ev.wait()
+        print("draining...", flush=True)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
