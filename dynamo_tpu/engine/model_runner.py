@@ -157,10 +157,11 @@ class ModelRunner:
             attn_impl = "pallas" if (platform != "cpu" and single) else "jnp"
         self.attn_impl = attn_impl
 
-        # prefill always uses the jnp path (S>1); decode uses attn_impl
+        # prefill uses the flash kernel on TPU (S>1), jnp elsewhere
         self._jit_forward = jax.jit(
             partial(llama.forward, self.config),
             donate_argnums=(3, 4),  # k_pool, v_pool
+            static_argnames=("attn_impl",),
         )
         self._jit_sample = jax.jit(sample)
         self._jit_decode_loop = jax.jit(
@@ -193,7 +194,7 @@ class ModelRunner:
         logits, self.k_pool, self.v_pool = self._jit_forward(
             self.params, jnp.asarray(tok), jnp.asarray(pos),
             self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kv_lens),
-            jnp.int32(n - 1),
+            jnp.int32(n - 1), attn_impl=self.attn_impl,
         )
         return logits[0, 0]
 

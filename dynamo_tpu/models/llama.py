@@ -206,6 +206,10 @@ def forward(
 
     h = params["embed"][tokens]  # [B, S, E] (gather)
     safe_pos = jnp.maximum(positions, 0)
+    # prefill-kernel metadata: valid tokens are a contiguous run from s=0
+    # (ModelRunner contract), so start/len fully describe the positions
+    q_start = safe_pos[:, 0]
+    q_len = jnp.sum((positions >= 0).astype(jnp.int32), axis=1)
 
     def layer(carry, xs):
         h, k_pool, v_pool = carry
@@ -230,6 +234,12 @@ def forward(
             attn = decode_paged_attention(
                 qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens
             )[:, None]  # [B, 1, Hk, G, hd]
+        elif attn_impl == "pallas":
+            from dynamo_tpu.ops.flash_prefill import prefill_paged_attention
+
+            attn = prefill_paged_attention(
+                qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens
+            )
         else:
             attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
         attn = attn.reshape(B, S, c.n_heads * hd)

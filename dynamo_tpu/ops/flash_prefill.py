@@ -1,0 +1,182 @@
+"""Pallas TPU prefill flash attention over the paged KV pool.
+
+The prefill hot op: a chunk of S new query tokens per sequence attends over
+the full paged context (prior prefix-cache/chunk pages + this chunk's own
+pages, already written to the pool). The jnp path materializes
+[B, Hk, G, S, C] fp32 scores in HBM — O(S·C) traffic that dominates long
+prompts. This kernel streams K/V pages HBM→VMEM once per (q-block, page)
+pair with flash online softmax in VMEM scratch, and skips both the DMA and
+the compute for pages that are entirely masked:
+
+- pages at/after the q-block's last causal position, and pages past
+  kv_len, are clamped in the index_map to the last needed page, so the
+  block index repeats and Pallas elides the copy (same trick as the decode
+  kernel). A causal chunk therefore costs ~half the rectangular DMA.
+
+Layout: q arrives [B, Hk, S, G, D] (wrapper transposes from the model's
+[B, S, Hk, G, D]) so a block is [Hk, Sq, G, D] and the matmul runs as one
+Hk-batched [Sq*G, D] x [D, PS] — MXU-shaped at Sq=128.
+
+Positions contract (same as models/llama.py paged_attention_jnp): flat
+context index c IS absolute position c; query token s of sequence b sits at
+absolute position q_start[b] + s for s < q_len[b], padding after.
+
+The reference delegates prefill attention to vLLM/TRT-LLM FlashAttention
+CUDA kernels (SURVEY.md: engine tier); this is the TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, MP] int32
+    q_start_ref,  # [B] int32 absolute position of query token 0
+    q_len_ref,  # [B] int32 number of valid query tokens
+    kv_lens_ref,  # [B] int32 context length (incl. this chunk)
+    # blocks
+    q_ref,  # [Hk, Sq, G, D]
+    k_ref,  # [Hk, PS, D] one page
+    v_ref,  # [Hk, PS, D]
+    o_ref,  # [Hk, Sq, G, D]
+    # scratch (persist across the page loop)
+    m_ref,  # [Hk, Sq*G, 1] f32
+    l_ref,  # [Hk, Sq*G, 1] f32
+    acc_ref,  # [Hk, Sq*G, D] f32
+    *,
+    page_size: int,
+    q_block: int,
+    n_groups: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    sb = pl.program_id(1)
+    i = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = q_start_ref[b]
+    q_len = q_len_ref[b]
+    kv_len = kv_lens_ref[b]
+    # last absolute position any valid query row in this block can see
+    blk_rows = jnp.minimum(q_len - sb * q_block, q_block)  # valid rows here
+    blk_max_pos = q_start + sb * q_block + blk_rows - 1
+    page_first = i * page_size
+    needed = (blk_rows > 0) & (page_first <= blk_max_pos) & (page_first < kv_len)
+
+    @pl.when(needed)
+    def _compute():
+        Hk, Sq, G, D = q_ref.shape
+        q = q_ref[...].astype(jnp.float32).reshape(Hk, Sq * G, D)
+        k = k_ref[...].astype(jnp.float32)  # [Hk, PS, D]
+        s = lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale  # [Hk, Sq*G, PS]
+
+        row = lax.broadcasted_iota(jnp.int32, s.shape, 1) // n_groups  # sq idx
+        col = lax.broadcasted_iota(jnp.int32, s.shape, 2)  # slot in page
+        q_pos = q_start + sb * q_block + row
+        kv_pos = page_first + col
+        mask = (row < blk_rows) & (kv_pos <= q_pos) & (kv_pos < kv_len)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+
+        v = v_ref[...].astype(jnp.float32)  # [Hk, PS, D]
+        pv = lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )  # [Hk, Sq*G, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        m_ref[...] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        Hk, Sq, G, D = o_ref.shape
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype).reshape(Hk, Sq, G, D)
+
+
+@functools.partial(jax.jit, static_argnames=("q_block", "interpret"))
+def prefill_paged_attention(
+    q: jax.Array,  # [B, S, Hk, G, D]
+    k_pool_l: jax.Array,  # [Hk, NP, PS, D]
+    v_pool_l: jax.Array,
+    page_table: jax.Array,  # [B, MP] int32
+    q_start: jax.Array,  # [B] int32 absolute position of query token 0
+    q_len: jax.Array,  # [B] int32 valid query tokens (rest are padding)
+    kv_lens: jax.Array,  # [B] int32 context length incl. this chunk
+    *,
+    q_block: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, S, Hk, G, D]; padding rows (s >= q_len[b]) return 0.
+    The chunk's own K/V must already be written to the pool."""
+    B, S, Hk, G, D = q.shape
+    _, NP, PS, _ = k_pool_l.shape
+    MP = page_table.shape[1]
+    q_block = min(q_block, S)
+    assert S % q_block == 0, (S, q_block)
+    n_sblk = S // q_block
+    scale = D**-0.5
+
+    qt = q.transpose(0, 2, 1, 3, 4)  # [B, Hk, S, G, D]
+
+    kernel = functools.partial(
+        _prefill_kernel, page_size=PS, q_block=q_block, n_groups=G, scale=scale
+    )
+
+    def kv_index(b, sb, i, pt, qs, ql, kl):
+        # clamp to the last page this q-block can causally see (and within
+        # kv_len): repeated indices across grid steps → Pallas skips the DMA
+        rows = jnp.minimum(ql[b] - sb * q_block, q_block)
+        blk_max_pos = qs[b] + sb * q_block + jnp.maximum(rows, 1) - 1
+        last = jnp.minimum(blk_max_pos, jnp.maximum(kl[b] - 1, 0)) // PS
+        last = jnp.clip(last, 0, MP - 1)
+        return (0, pt[b, jnp.minimum(i, last)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # page_table, q_start, q_len, kv_lens
+        grid=(B, n_sblk, MP),
+        in_specs=[
+            pl.BlockSpec(
+                (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
+            ),
+            pl.BlockSpec((Hk, None, PS, D), kv_index),
+            pl.BlockSpec((Hk, None, PS, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Hk, q_block * G, 1), jnp.float32),
+            pltpu.VMEM((Hk, q_block * G, 1), jnp.float32),
+            pltpu.VMEM((Hk, q_block * G, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hk, S, G, D), q.dtype),
+        interpret=interpret,
+    )(page_table, q_start, q_len, kv_lens, qt, k_pool_l, v_pool_l)
+    return out.transpose(0, 2, 1, 3, 4)  # [B, S, Hk, G, D]

@@ -10,8 +10,10 @@ table and accumulates flash-attention-style online softmax in VMEM scratch.
 
 Grid: (B, MP) — page index innermost so the per-sequence running softmax
 state lives across the page loop; all kv heads are processed per step (one
-[Hk, PS, D] DMA per page rather than Hk tiny ones). Pages past kv_len are
-masked (their DMA is wasted; a ragged grid is a later optimization).
+[Hk, PS, D] DMA per page rather than Hk tiny ones). Ragged contexts cost
+only what they use: the index_map clamps pages past kv_len to the last
+valid page, so consecutive grid steps see an unchanged block index and
+Pallas elides the HBM→VMEM copy (and pl.when skips the compute).
 
 The reference framework ships CUDA kernels for its block engine
 (lib/llm/src/kernels/block_copy.cu, lib/kvbm-kernels/cuda/
@@ -111,15 +113,21 @@ def decode_paged_attention(
 
     kernel = functools.partial(_decode_kernel, page_size=PS, scale=scale)
 
+    def kv_index(b, i, pt, kl):
+        # clamp past-the-end pages to the last valid page: the block index
+        # then repeats across those grid steps and Pallas skips the DMA, so
+        # a 128-token context in an 8192-token table costs 2 page copies,
+        # not 128
+        last = jnp.maximum(kl[b] - 1, 0) // PS
+        return (0, pt[b, jnp.minimum(i, last)], 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, kv_lens
         grid=(B, MP),
         in_specs=[
             pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0)),
-            # the page addressed by the prefetched page table; out-of-range
-            # rows hold garbage that n_valid masking discards
-            pl.BlockSpec((Hk, None, PS, D), lambda b, i, pt, kl: (0, pt[b, i], 0, 0)),
-            pl.BlockSpec((Hk, None, PS, D), lambda b, i, pt, kl: (0, pt[b, i], 0, 0)),
+            pl.BlockSpec((Hk, None, PS, D), kv_index),
+            pl.BlockSpec((Hk, None, PS, D), kv_index),
         ],
         out_specs=pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0)),
         scratch_shapes=[

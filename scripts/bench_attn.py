@@ -1,0 +1,71 @@
+"""Microbench: decode paged attention, Pallas kernel vs jnp gather.
+
+Bench shapes: Hk=8, D=128 (llama-3.2-3b), B=32, PS=64, MP=8, kv_len=256.
+Timing rule (axon relay): many iters fused in one jit via lax.scan with a
+data dependency (out feeds next q), then ONE device_get — the only honest
+sync through the relay.
+"""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+from dynamo_tpu.models.llama import paged_attention_jnp
+from dynamo_tpu.ops.paged_attention import decode_paged_attention
+
+B, Hk, G, D = 32, 8, 3, 128
+PS, MP = 64, 8
+NP = B * MP + 8
+KV_LEN = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+ITERS = 64
+
+rng = np.random.default_rng(0)
+k_pool = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+v_pool = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+pt = jnp.asarray(
+    np.stack([np.arange(i * MP, (i + 1) * MP) for i in range(B)]).astype(np.int32)
+)
+kv_lens = jnp.full((B,), KV_LEN, jnp.int32)
+q0 = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
+
+
+@partial(jax.jit, static_argnames=("impl",))
+def loop(q, k_pool, v_pool, pt, kv_lens, impl):
+    def body(q, _):
+        if impl == "pallas":
+            o = decode_paged_attention(q, k_pool, v_pool, pt, kv_lens)
+        else:
+            o = paged_attention_jnp(
+                q[:, None], k_pool, v_pool, pt, kv_lens[:, None] - 1, kv_lens
+            )[:, 0]
+        return o.astype(q.dtype), None
+
+    q, _ = lax.scan(body, q, None, length=ITERS)
+    return q
+
+
+for impl in ("jnp", "pallas"):
+    out = loop(q0, k_pool, v_pool, pt, kv_lens, impl)
+    np.asarray(jax.device_get(out))  # warmup + compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = loop(q0, k_pool, v_pool, pt, kv_lens, impl)
+        np.asarray(jax.device_get(out))
+        times.append((time.perf_counter() - t0) / ITERS * 1e6)
+    print(f"kv_len={KV_LEN} {impl:7s} per-iter: {min(times):8.1f} us", flush=True)
+
+# numeric agreement
+o1 = np.asarray(jax.device_get(decode_paged_attention(q0, k_pool, v_pool, pt, kv_lens)), np.float32)
+o2 = np.asarray(
+    jax.device_get(paged_attention_jnp(q0[:, None], k_pool, v_pool, pt, kv_lens[:, None] - 1, kv_lens)[:, 0]),
+    np.float32,
+)
+print("max abs diff:", np.abs(o1 - o2).max(), flush=True)

@@ -1,0 +1,17 @@
+"""Run a dynamo_tpu module on the CPU backend regardless of the host's
+default accelerator pinning: `python scripts/run_cpu.py <module> [args...]`.
+
+Needed because site customization may select an accelerator platform at
+interpreter start; flipping jax_platforms before first backend use wins.
+"""
+
+import runpy
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+module = sys.argv[1]
+sys.argv = sys.argv[1:]
+runpy.run_module(module, run_name="__main__", alter_sys=True)

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from dynamo_tpu.models.llama import paged_attention_jnp
+from dynamo_tpu.ops.flash_prefill import prefill_paged_attention
 from dynamo_tpu.ops.paged_attention import decode_paged_attention
 
 
@@ -41,3 +42,41 @@ def test_decode_paged_attention_ignores_garbage_pages():
     np.testing.assert_allclose(
         np.asarray(out_a, np.float32), np.asarray(out_b, np.float32)
     )
+
+
+@pytest.mark.parametrize(
+    "q_start,q_len,kv_extra",
+    [
+        ([0, 0], [16, 9], [0, 0]),  # fresh prefill, one padded seq
+        ([24, 8], [16, 16], [0, 0]),  # chunked prefill (prior context)
+        ([0, 40], [16, 16], [0, 3]),  # prior ctx + garbage tail pages
+    ],
+)
+def test_prefill_paged_attention_matches_reference(q_start, q_len, kv_extra):
+    rng = np.random.default_rng(2)
+    B, S, Hk, G, D, NP, PS, MP = 2, 16, 2, 3, 64, 16, 8, 8
+    q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = np.asarray(q_start, np.int32)
+    ql = np.asarray(q_len, np.int32)
+    # kv_extra > 0: kv_len admits tokens past the last query position — the
+    # causal mask (not kv_len) must exclude them
+    kv = jnp.asarray(qs + ql + np.asarray(kv_extra, np.int32))
+
+    out = prefill_paged_attention(
+        q, kp, vp, pt, jnp.asarray(qs), jnp.asarray(ql), kv, q_block=8, interpret=True
+    )
+    # jnp reference: positions with -1 padding
+    pos = np.full((B, S), -1, np.int32)
+    for b in range(B):
+        pos[b, : ql[b]] = np.arange(qs[b], qs[b] + ql[b])
+    ref = paged_attention_jnp(q, kp, vp, pt, jnp.asarray(np.maximum(pos, 0)), kv)
+    for b in range(B):
+        d = np.abs(
+            np.asarray(out[b, : ql[b]], np.float32) - np.asarray(ref[b, : ql[b]], np.float32)
+        ).max()
+        assert d < 3e-2, (b, d)
+        # padding rows are zero
+        assert np.all(np.asarray(out[b, ql[b] :], np.float32) == 0.0)
