@@ -157,11 +157,13 @@ class ModelRunner:
             attn_impl = "pallas" if (platform != "cpu" and single) else "jnp"
         self.attn_impl = attn_impl
 
-        # prefill uses the flash kernel on TPU (S>1), jnp elsewhere
+        # prefill uses the flash kernel on TPU (S>1), jnp elsewhere; with a
+        # seq mesh axis, prefill goes sequence-parallel (ring attention)
+        self.sp_enabled = self.mesh_config.seq > 1
         self._jit_forward = jax.jit(
             partial(llama.forward, self.config),
             donate_argnums=(3, 4),  # k_pool, v_pool
-            static_argnames=("attn_impl",),
+            static_argnames=("attn_impl", "mesh", "sp_has_prior"),
         )
         self._jit_sample = jax.jit(sample)
         self._jit_decode_loop = jax.jit(
@@ -191,10 +193,13 @@ class ModelRunner:
         pt = self._pad_page_table([page_table_row])
         kv_lens = np.asarray([prior_len + n], np.int32)
 
+        impl = "ring" if self.sp_enabled else self.attn_impl
         logits, self.k_pool, self.v_pool = self._jit_forward(
             self.params, jnp.asarray(tok), jnp.asarray(pos),
             self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kv_lens),
-            jnp.int32(n - 1), attn_impl=self.attn_impl,
+            jnp.int32(n - 1), attn_impl=impl,
+            mesh=self.mesh if impl == "ring" else None,
+            sp_has_prior=prior_len > 0,
         )
         return logits[0, 0]
 

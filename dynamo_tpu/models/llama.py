@@ -129,10 +129,14 @@ def paged_attention_jnp(
     page_table: jax.Array,  # [B, MP] int32
     q_positions: jax.Array,  # [B, S] absolute positions of the queries
     kv_lens: jax.Array,  # [B] context length (tokens valid in pool)
-) -> jax.Array:
+    return_stats: bool = False,
+):
     """Reference (jnp gather) paged attention with causal masking by
     absolute position. Flat context index c == absolute position c because
-    page tables map positions in order. Returns [B, S, Hk, G, Dh]."""
+    page tables map positions in order. Returns [B, S, Hk, G, Dh]; with
+    `return_stats`, also fp32 (m, l) [B, S, Hk, G, 1] online-softmax stats
+    (rows with an empty context get l == 0 and out == 0, so merging with
+    attention over other context stays exact)."""
     Hk, NP, PS, Dh = k_pool_l.shape
     B, MP = page_table.shape
     C = MP * PS
@@ -146,8 +150,14 @@ def paged_attention_jnp(
     causal = ctx_pos[None, None, :] <= q_positions[:, :, None]  # [B,S,C]
     mask = valid & causal[:, None, None, :, :]
     scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bkgsc,kbcd->bskgd", probs, v)
+    m = jnp.max(scores, axis=-1, keepdims=True)  # [B,Hk,G,S,1]
+    p = jnp.where(mask, jnp.exp(scores - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgsc,kbcd->bskgd", (p / jnp.maximum(l, 1e-30)).astype(q.dtype), v)
+    if return_stats:
+        t = lambda x: x.transpose(0, 3, 1, 2, 4)  # [B,Hk,G,S,1] → [B,S,Hk,G,1]
+        return out, t(m), t(l)
+    return out
 
 
 def _write_kv(pool, l_idx, new, page_table, positions):
@@ -188,7 +198,10 @@ def forward(
     page_table: jax.Array,  # [B, MP]
     kv_lens: jax.Array,  # [B] context length AFTER this step's tokens
     last_index: Optional[jax.Array] = None,  # scalar: only compute logits here
-    attn_impl: str = "jnp",  # "jnp" | "pallas" (pallas: decode S=1 on TPU)
+    attn_impl: str = "jnp",  # "jnp" | "pallas" | "ring" (sequence-parallel)
+    mesh=None,  # jax.sharding.Mesh, required for attn_impl="ring"
+    sp_has_prior: bool = True,  # ring: False skips the paged prior-context
+    #   pass entirely (fresh prefill — the common SP case)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass (covers prefill chunks S>1 and decode S=1).
 
@@ -210,6 +223,12 @@ def forward(
     # (ModelRunner contract), so start/len fully describe the positions
     q_start = safe_pos[:, 0]
     q_len = jnp.sum((positions >= 0).astype(jnp.int32), axis=1)
+    if attn_impl == "ring":
+        # sequence parallelism: pin activations sharded over the seq mesh
+        # axis from the embedding on, so every projection runs on S/n tokens
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        h = lax.with_sharding_constraint(h, NamedSharding(mesh, _P(None, "seq", None)))
 
     def layer(carry, xs):
         h, k_pool, v_pool = carry
@@ -240,6 +259,35 @@ def forward(
             attn = prefill_paged_attention(
                 qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens
             )
+        elif attn_impl == "ring":
+            # sequence-parallel prefill: ring attention over this chunk's
+            # fresh K/V (seq-sharded, ppermute over ICI) merged with paged
+            # attention over prior context (prefix-cache hits / earlier
+            # chunks, read from the seq-replicated pool) via online-softmax
+            # stats — exact full-context softmax, no dense gather of the
+            # chunk
+            from dynamo_tpu.ops.ring_attention import ring_attention
+
+            kv_sentinel = jnp.where(positions >= 0, positions, jnp.int32(2**30))
+            out_r, m_r, l_r = ring_attention(
+                qg, k, v, positions, kv_sentinel, mesh, return_stats=True
+            )
+            if not sp_has_prior:
+                attn = out_r  # fresh prefill: chunk IS the full context
+            else:
+                prior_lens = jnp.maximum(kv_lens - q_len, 0)
+                out_p, m_p, l_p = paged_attention_jnp(
+                    qg, k_pool_l, v_pool_l, page_table, safe_pos, prior_lens,
+                    return_stats=True,
+                )
+                m_star = jnp.maximum(m_r, m_p)
+                w_r = l_r * jnp.exp(m_r - m_star)
+                w_p = l_p * jnp.exp(m_p - m_star)
+                denom = jnp.maximum(w_r + w_p, 1e-30)
+                attn = (
+                    (out_r.astype(jnp.float32) * w_r + out_p.astype(jnp.float32) * w_p)
+                    / denom
+                ).astype(h.dtype)
         else:
             attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
         attn = attn.reshape(B, S, c.n_heads * hd)

@@ -134,7 +134,8 @@ def prefill_paged_attention(
     _, NP, PS, _ = k_pool_l.shape
     MP = page_table.shape[1]
     q_block = min(q_block, S)
-    assert S % q_block == 0, (S, q_block)
+    while S % q_block:  # largest divisor of S at most the requested block
+        q_block -= 1
     n_sblk = S // q_block
     scale = D**-0.5
 

@@ -46,7 +46,9 @@ def _block_attn_update(q, k, v, q_pos, kv_pos, m, l, acc, scale):
 
 
 def _ring_attention_sharded(q, k, v, q_pos, kv_pos, axis_name: str, scale: float):
-    """Runs inside shard_map: local shards, full-context result."""
+    """Runs inside shard_map: local shards, full-context result. Returns
+    (out, m, l) — normalized output plus online-softmax stats so callers can
+    merge with attention over other context (e.g. prior paged KV)."""
     n = lax.psum(1, axis_name)
     B, s_len, Hk, G, D = q.shape
 
@@ -69,7 +71,7 @@ def _ring_attention_sharded(q, k, v, q_pos, kv_pos, axis_name: str, scale: float
         return (k_cur, v_cur, kv_pos_cur, m, l, acc), None
 
     (k, v, kv_pos, m, l, acc), _ = lax.scan(step, (k, v, kv_pos, m, l, acc), None, length=n)
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype), m, l
 
 
 def ring_attention(
@@ -77,12 +79,16 @@ def ring_attention(
     k: jax.Array,  # [B, S, Hk, D]
     v: jax.Array,
     q_positions: jax.Array,  # [B, S] absolute positions
-    kv_positions: jax.Array,  # [B, S]
+    kv_positions: jax.Array,  # [B, S] (use a huge sentinel for padding slots
+    #         so no query position reaches them)
     mesh: Mesh,
     axis_name: str = "seq",
-) -> jax.Array:
+    return_stats: bool = False,
+):
     """Full causal attention over a sequence sharded across `axis_name`.
-    Returns [B, S, Hk, G, D] with the same sharding as q."""
+    Returns [B, S, Hk, G, D] with the same sharding as q; with
+    `return_stats`, also the per-row online-softmax (m, l) [B, S, Hk, G, 1]
+    fp32 stats for merging with attention over disjoint context."""
     D = q.shape[-1]
     scale = D**-0.5
     seq = P(None, axis_name)
@@ -93,9 +99,12 @@ def ring_attention(
         partial(_ring_attention_sharded, axis_name=axis_name, scale=scale),
         mesh=mesh,
         in_specs=(spec_q, spec_kv, spec_kv, seq, seq),
-        out_specs=spec_q,
+        out_specs=(spec_q, spec_q, spec_q),
     )
-    return fn(q, k, v, q_positions, kv_positions)
+    out, m, l = fn(q, k, v, q_positions, kv_positions)
+    if return_stats:
+        return out, m, l
+    return out
 
 
 def full_attention_reference(q, k, v, q_positions, kv_positions):

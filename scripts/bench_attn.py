@@ -6,6 +6,7 @@ data dependency (out feeds next q), then ONE device_get — the only honest
 sync through the relay.
 """
 
+import os
 import sys
 import time
 from functools import partial
@@ -15,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dynamo_tpu.models.llama import paged_attention_jnp
 from dynamo_tpu.ops.paged_attention import decode_paged_attention

@@ -96,3 +96,22 @@ async def test_moe_tp2_runs():
     )
     toks = await _generate(runner, [1, 2, 3, 4], n=3)
     assert len(toks) == 3
+
+
+async def test_sp4_ring_prefill_matches_single_device():
+    """Sequence-parallel prefill (ring attention over the seq axis) must be
+    greedy-equivalent to the single-device path, including the decode steps
+    that read the pool the SP prefill wrote."""
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    t_single = await _generate(_runner(MeshConfig()), prompt)
+    t_sp = await _generate(_runner(MeshConfig(seq=4)), prompt)
+    assert t_single == t_sp
+
+
+async def test_sp2_tp2_chunked_prefill_merges_prior_context():
+    """Chunked prefill under SP: the second chunk's ring part must merge
+    with paged attention over the first chunk's pool pages (prior context)."""
+    prompt = list(range(1, 25))  # 24 tokens, chunk_size 16 → 2 chunks
+    t_single = await _generate(_runner(MeshConfig()), prompt)
+    t_sp = await _generate(_runner(MeshConfig(model=2, seq=2)), prompt)
+    assert t_single == t_sp
