@@ -241,6 +241,19 @@ class InferenceEngine:
             target = seq.pages[seq.n_shared_pages:n_kv_pages]
             if target and payload.get("data"):
                 self.runner.import_pages(target, seq.n_shared_pages, payload)
+            if getattr(self.runner, "has_draft", False):
+                # transferred KV covers the target model only; rebuild the
+                # draft pools by (cheap) draft prefill — starting after the
+                # prefix-cache-shared pages, whose draft KV the sequence
+                # that populated them already wrote
+                toks = seq.prompt[:-1]
+                chunk = self.scheduler.chunk_size
+                shared = seq.n_shared_pages * self.pool.page_size
+                for start in range(shared, len(toks), chunk):
+                    self.runner.draft_prefill(
+                        toks[start : start + chunk], start, seq.pages,
+                        prior_len=start,
+                    )
         self._kv_pending = still
 
     def _run_embeds(self) -> None:
@@ -286,6 +299,14 @@ class InferenceEngine:
             seq.pages,
             prior_len=plan.start_pos,
         )
+        if getattr(self.runner, "has_draft", False) and seq.disagg != "prefill":
+            # keep the draft model's KV pools in lockstep so spec decode
+            # can propose over the full context (skipped on disagg-prefill
+            # workers: draft KV isn't exported — the decode worker rebuilds
+            # it on admission)
+            self.runner.draft_prefill(
+                plan.chunk, plan.start_pos, seq.pages, prior_len=plan.start_pos
+            )
         self.scheduler.complete_prefill(plan)
         if not plan.is_last_chunk:
             return
@@ -326,6 +347,38 @@ class InferenceEngine:
         positions = [s.computed_len for s in seqs]
         page_tables = [s.pages for s in seqs]
         step0 = self._step_counter + 1
+        gamma = getattr(self.runner, "spec_gamma", 0)
+        if getattr(self.runner, "has_draft", False):
+            # speculative path: R fused draft-propose + target-verify
+            # rounds; each round yields 1..gamma+1 tokens per sequence.
+            # Near a token budget (T < gamma+1) shrink gamma instead of
+            # falling back to plain decode — the plain path writes no draft
+            # KV, which would leave batch-wide draft-pool holes (gamma=0 is
+            # plain decoding plus the draft bookkeeping)
+            if T < gamma + 1:
+                gamma, R = T - 1, 1
+            else:
+                R = T // (gamma + 1)
+            self._step_counter += R
+            toks, counts = self.runner.spec_decode_multi(
+                R, tokens, positions, page_tables, _sampling_params(seqs), step0,
+                gamma=gamma,
+            )
+            for i, seq in enumerate(seqs):
+                emit: List[int] = []
+                reason = None
+                for r in range(R):
+                    for j in range(int(counts[i, r])):
+                        token = int(toks[i, r, j])
+                        reason = self.scheduler.complete_decode(seq, token)
+                        if reason != "stop":
+                            emit.append(token)
+                        if reason:
+                            break
+                    if reason:
+                        break
+                self._emit(seq, emit, reason)
+            return
         self._step_counter += T
         sampled = self.runner.decode_multi(
             T, tokens, positions, page_tables, _sampling_params(seqs), step0

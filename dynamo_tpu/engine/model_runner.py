@@ -121,6 +121,9 @@ class ModelRunner:
         params: Optional[Any] = None,
         devices: Optional[list] = None,
         attn_impl: Optional[str] = None,  # None → pallas on TPU, jnp elsewhere
+        draft_config: Optional[ModelConfig] = None,  # enables spec decode
+        draft_params: Optional[Any] = None,
+        spec_gamma: int = 4,  # draft tokens proposed per verify pass
     ):
         self.config = config
         self.mesh_config = mesh_config or MeshConfig()
@@ -148,6 +151,25 @@ class ModelRunner:
             config.name, time.monotonic() - t0, self.mesh_config.shape, num_pages, page_size,
         )
 
+        # speculative decoding: the draft model owns parallel KV pools
+        # addressed by the SAME page tables (block management, prefix
+        # sharing, preemption all come for free; pages onboarded from the
+        # host tier lack draft KV, which only costs acceptance rate, never
+        # correctness — the verify pass is authoritative)
+        self.draft_config = draft_config
+        self.spec_gamma = spec_gamma
+        if draft_config is not None:
+            if draft_params is None:
+                draft_params = llama.init_params(
+                    draft_config, jax.random.PRNGKey(seed + 1), dtype
+                )
+            self.draft_params = jax.device_put(
+                draft_params, self.policy.params_sharding(draft_params)
+            )
+            dk, dv = llama.make_kv_pool(draft_config, num_pages, page_size, dtype)
+            self.draft_k_pool = jax.device_put(dk, kv_sharding)
+            self.draft_v_pool = jax.device_put(dv, kv_sharding)
+
         if attn_impl is None:
             platform = self.mesh.devices.flat[0].platform
             # pallas on a real accelerator; pallas_call is not yet wrapped in
@@ -171,6 +193,22 @@ class ModelRunner:
             static_argnums=(0,),  # n_steps
             donate_argnums=(4, 5),  # k_pool, v_pool
         )
+        if draft_config is not None:
+            from dynamo_tpu.engine.spec_decode import spec_rounds
+
+            self._jit_spec = jax.jit(
+                partial(
+                    spec_rounds, self.config, draft_config,
+                    self.attn_impl, self.attn_impl,
+                ),
+                static_argnums=(0, 1),  # gamma, n_rounds
+                donate_argnums=(6, 7, 8, 9),  # both KV pool pairs
+            )
+            self._jit_draft_forward = jax.jit(
+                partial(llama.forward, draft_config),
+                donate_argnums=(3, 4),
+                static_argnames=("attn_impl",),
+            )
 
     # -- steps -------------------------------------------------------------
     def prefill(
@@ -184,6 +222,19 @@ class ModelRunner:
         uncomputed prompt tokens starting at absolute position `start_pos`;
         `prior_len` is the context length already in the pool (prefix-cache
         hits + earlier chunks). Returns last-token logits [V] (device)."""
+        tok, pos, pt, kv_lens, n = self._prep_prefill(tokens, start_pos, page_table_row, prior_len)
+        impl = "ring" if self.sp_enabled else self.attn_impl
+        logits, self.k_pool, self.v_pool = self._jit_forward(
+            self.params, tok, pos, self.k_pool, self.v_pool, pt, kv_lens,
+            jnp.int32(n - 1), attn_impl=impl,
+            mesh=self.mesh if impl == "ring" else None,
+            sp_has_prior=prior_len > 0,
+        )
+        return logits[0, 0]
+
+    def _prep_prefill(self, tokens: List[int], start_pos: int, page_table_row: List[int], prior_len: int):
+        """Bucket-pad one prefill chunk into device inputs (shared by the
+        target and draft prefill paths)."""
         n = len(tokens)
         S = _next_bucket(self.prefill_buckets, n)
         tok = np.zeros((1, S), np.int32)
@@ -192,16 +243,7 @@ class ModelRunner:
         pos[0, :n] = np.arange(start_pos, start_pos + n)
         pt = self._pad_page_table([page_table_row])
         kv_lens = np.asarray([prior_len + n], np.int32)
-
-        impl = "ring" if self.sp_enabled else self.attn_impl
-        logits, self.k_pool, self.v_pool = self._jit_forward(
-            self.params, jnp.asarray(tok), jnp.asarray(pos),
-            self.k_pool, self.v_pool, jnp.asarray(pt), jnp.asarray(kv_lens),
-            jnp.int32(n - 1), attn_impl=impl,
-            mesh=self.mesh if impl == "ring" else None,
-            sp_has_prior=prior_len > 0,
-        )
-        return logits[0, 0]
+        return jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(pt), jnp.asarray(kv_lens), n
 
     def decode(
         self,
@@ -244,6 +286,63 @@ class ModelRunner:
             _pad_sampling(_as_sampling(sampling), B), jnp.int32(step),
         )
         return np.asarray(jax.device_get(toks))
+
+    @property
+    def has_draft(self) -> bool:
+        return self.draft_config is not None
+
+    def spec_decode_multi(
+        self,
+        n_rounds: int,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        sampling,
+        step: int,
+        gamma: Optional[int] = None,
+    ):
+        """n_rounds fused speculative rounds (one host sync). Returns
+        (tokens [B_bucket, R, gamma+1], counts [B_bucket, R]); row i's
+        round r contributes counts[i, r] valid tokens. Page tables must
+        cover positions[i] + n_rounds*(gamma+1) slots. `gamma` overrides
+        the configured draft length (the engine shrinks it near token
+        budgets so the draft pool never gaps)."""
+        gamma = self.spec_gamma if gamma is None else gamma
+        n = len(tokens)
+        B = _next_bucket(self.decode_buckets, n)
+        tok = np.zeros(B, np.int32)
+        tok[:n] = tokens
+        pos = np.full(B, -1, np.int32)
+        pos[:n] = positions
+        pt = self._pad_page_table(page_tables, B)
+
+        toks, counts, self.k_pool, self.v_pool, self.draft_k_pool, self.draft_v_pool = (
+            self._jit_spec(
+                gamma, n_rounds, self.params, self.draft_params,
+                jnp.asarray(tok), jnp.asarray(pos),
+                self.k_pool, self.v_pool, self.draft_k_pool, self.draft_v_pool,
+                jnp.asarray(pt), _pad_sampling(_as_sampling(sampling), B),
+                jnp.int32(step),
+            )
+        )
+        toks_h, counts_h = jax.device_get((toks, counts))
+        return np.asarray(toks_h), np.asarray(counts_h)
+
+    def draft_prefill(
+        self,
+        tokens: List[int],
+        start_pos: int,
+        page_table_row: List[int],
+        prior_len: int,
+    ) -> None:
+        """Prefill the DRAFT model's KV pools for a chunk (same page
+        table as the target). Logits are discarded — only the KV matters
+        for later proposals."""
+        tok, pos, pt, kv_lens, n = self._prep_prefill(tokens, start_pos, page_table_row, prior_len)
+        _, self.draft_k_pool, self.draft_v_pool = self._jit_draft_forward(
+            self.draft_params, tok, pos, self.draft_k_pool, self.draft_v_pool,
+            pt, kv_lens, jnp.int32(n - 1), attn_impl=self.attn_impl,
+        )
 
     def sample_one(self, logits: jax.Array, sampling, step: int) -> int:
         out = self._jit_sample(logits[None, :], _as_sampling(sampling), jnp.int32(step))

@@ -39,9 +39,9 @@ class SamplingParams(NamedTuple):
 MAX_CANDIDATES = 64
 
 
-def sample(logits: jax.Array, params: SamplingParams, step: jax.Array) -> jax.Array:
-    """logits [B, V] f32 → token ids [B] i32. `step` folds the decode step
-    index into each sequence's key so repeated calls draw fresh samples."""
+def _filtered_scaled(logits: jax.Array, params: SamplingParams):
+    """Shared filter pipeline: top-K truncate, apply top-k/top-p masks,
+    temperature-scale. Returns (idx [B,K] token ids desc, scaled [B,K])."""
     B, V = logits.shape
     K = min(MAX_CANDIDATES, V)
     vals, idx = jax.lax.top_k(logits, K)  # [B, K] descending
@@ -57,6 +57,26 @@ def sample(logits: jax.Array, params: SamplingParams, step: jax.Array) -> jax.Ar
     vals = jnp.where(cum_before < params.top_p[:, None], vals, -jnp.inf)
 
     scaled = vals / jnp.maximum(params.temperature, 1e-6)[:, None]
+    return idx, scaled
+
+
+def filtered_probs(logits: jax.Array, params: SamplingParams):
+    """The EXACT distribution `sample` draws from, as explicit
+    probabilities: (idx [B,K] candidate token ids, probs [B,K]). Greedy
+    rows (temperature <= 0) come back one-hot on idx[:, 0]. This is what
+    speculative decoding's accept/resample math consumes for both the
+    draft (q) and target (p) models."""
+    idx, scaled = _filtered_scaled(logits, params)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    greedy = jnp.zeros_like(probs).at[:, 0].set(1.0)
+    probs = jnp.where((params.temperature <= 0.0)[:, None], greedy, probs)
+    return idx, probs
+
+
+def sample(logits: jax.Array, params: SamplingParams, step: jax.Array) -> jax.Array:
+    """logits [B, V] f32 → token ids [B] i32. `step` folds the decode step
+    index into each sequence's key so repeated calls draw fresh samples."""
+    idx, scaled = _filtered_scaled(logits, params)
 
     def draw(key_data, row):
         key = jax.random.wrap_key_data(key_data, impl="threefry2x32")

@@ -1,0 +1,218 @@
+"""Speculative decoding: draft-model propose, single fused target verify,
+lossless accept/resample — multi-round, entirely on device.
+
+TPU-first shape of the classic scheme (Leviathan et al.): the draft model
+runs gamma cheap S=1 decode steps, then the target verifies all gamma+1
+positions in ONE S=gamma+1 forward — converting gamma sequential HBM-bound
+target steps into a single compute-dense MXU pass. R rounds are fused in a
+`lax.scan` with on-device position/token feedback, so a dispatch costs one
+host sync for up to R*(gamma+1) tokens (the per-dispatch sync dominates on
+remote-TPU links).
+
+Losslessness: tokens are accepted with probability min(1, p(x)/q(x)) and
+the first rejection resamples from norm(max(p - q, 0)), where p/q are the
+EXACT filtered distributions `engine.sampling.sample` draws from
+(temperature/top-k/top-p applied, greedy = one-hot) — the output stream is
+distributed identically to plain decoding of the target model. Greedy
+requests therefore reproduce plain greedy decoding token-for-token,
+regardless of draft quality.
+
+KV discipline: the verify pass writes target KV for all gamma+1 proposed
+positions; entries past the accepted prefix are stale but are never read
+(kv_lens masks attention) and are overwritten by the next round's writes at
+those positions — same for the draft pool. The draft model owns parallel
+KV pools addressed by the SAME page tables, so block management, prefix
+sharing, and preemption need no extra bookkeeping.
+
+The reference framework inherits speculative decoding from its delegated
+engines (vLLM/TRT-LLM spec-decode configs surfaced through
+components/src/dynamo/vllm flags); this is the native TPU implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.engine.sampling import SamplingParams, filtered_probs
+from dynamo_tpu.models import llama
+
+# PRNG fold tags: keep spec streams disjoint from plain sample() (which
+# folds only the step index) and from each other
+_TAG_DRAFT = 1_000_000
+_TAG_ACCEPT = 2_000_000
+_TAG_FINAL = 3_000_000
+
+
+def _per_row_key(key_data: jax.Array, step, tag):
+    key = jax.random.wrap_key_data(key_data, impl="threefry2x32")
+    return jax.random.fold_in(jax.random.fold_in(key, step), tag)
+
+
+def _categorical_rows(key: SamplingParams, probs: jax.Array, step, tag) -> jax.Array:
+    """Per-row categorical draw from explicit probabilities [B, K] → [B].
+    One-hot rows (greedy) come out deterministic."""
+
+    def draw(key_data, row):
+        return jax.random.categorical(_per_row_key(key_data, step, tag), jnp.log(row))
+
+    return jax.vmap(draw)(key.key, probs).astype(jnp.int32)
+
+
+def accept_and_finalize(
+    drafts: jax.Array,  # [B, g] proposed token ids
+    q_d: jax.Array,  # [B, g] draft prob of each proposed token
+    q_on_t: jax.Array,  # [B, g, K] draft probs evaluated on target candidates
+    t_idx: jax.Array,  # [B, g+1, K] target candidate token ids
+    t_probs: jax.Array,  # [B, g+1, K] target probs (the sampling dist)
+    sampling: SamplingParams,
+    step,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pure accept/resample math → (out_tokens [B, g+1], counts [B]).
+    out_tokens[:, :n_acc] are accepted drafts; out_tokens[:, n_acc] is the
+    rejection-resample (or the bonus token when everything was accepted);
+    columns past counts are junk. Separated from the model loop so its
+    distribution-preservation is unit-testable in bulk."""
+    B, g1, K = t_probs.shape
+    g = g1 - 1
+
+    # p(d_i): target prob of draft token i (0 when outside target's
+    # candidate set → certain rejection)
+    match = t_idx[:, :g, :] == drafts[:, :, None]  # [B, g, K]
+    p_d = jnp.sum(jnp.where(match, t_probs[:, :g, :], 0.0), axis=-1)
+
+    def row_uniform(key_data):
+        return jax.random.uniform(_per_row_key(key_data, step, _TAG_ACCEPT), (max(g, 1),))
+
+    u = jax.vmap(row_uniform)(sampling.key)[:, :g]  # [B, g]
+    accept = u < p_d / jnp.maximum(q_d, 1e-30)
+    acc_prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)  # [B, g]
+    n_acc = jnp.sum(acc_prefix, axis=1)  # [B] length of accepted prefix
+
+    # residual distribution at the first rejected position r = n_acc:
+    # norm(max(p_r - q_r, 0)); padding q with zeros at position g makes the
+    # all-accepted case fall out as the plain bonus draw from p_{g+1}
+    q_ext = jnp.concatenate([q_on_t, jnp.zeros((B, 1, K), q_on_t.dtype)], axis=1)
+    sel = n_acc[:, None, None]
+    p_r = jnp.take_along_axis(t_probs, sel, axis=1)[:, 0]  # [B, K]
+    q_r = jnp.take_along_axis(q_ext, sel, axis=1)[:, 0]
+    resid = jnp.maximum(p_r - q_r, 0.0)
+    rs = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(rs > 1e-12, resid / jnp.maximum(rs, 1e-30), p_r)
+
+    j = _categorical_rows(sampling, resid, step, _TAG_FINAL)
+    idx_r = jnp.take_along_axis(t_idx, sel, axis=1)[:, 0]  # [B, K]
+    final = jnp.take_along_axis(idx_r, j[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+    out = jnp.concatenate([drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    out = out.at[jnp.arange(B), n_acc].set(final)
+    return out, (n_acc + 1).astype(jnp.int32)
+
+
+def spec_rounds(
+    config,
+    draft_config,
+    decode_impl: str,  # draft S=1 attention impl ("jnp" | "pallas")
+    verify_impl: str,  # target S=g+1 attention impl
+    gamma: int,
+    n_rounds: int,
+    params,
+    draft_params,
+    tokens0: jax.Array,  # [B] current last token per seq
+    positions0: jax.Array,  # [B] its write position (-1 = padding slot)
+    k_pool,
+    v_pool,
+    dk_pool,
+    dv_pool,
+    page_table: jax.Array,  # [B, MP]
+    sampling: SamplingParams,
+    step0,
+):
+    """R speculative rounds fused in one jit. Returns
+    (tokens [B, R, gamma+1], counts [B, R], k_pool, v_pool, dk_pool,
+    dv_pool). Page tables must cover positions0 + R*(gamma+1) slots."""
+    B = tokens0.shape[0]
+
+    def round_body(carry, r):
+        tok, pos, kp, vp, dkp, dvp = carry
+        step = step0 + r
+
+        # -- draft: sequential S=1 proposals. The scan runs gamma+1 steps:
+        # step i writes the FED token's KV at pos+i, so the extra step
+        # writes d_gamma's KV at pos+gamma — without it, a fully-accepted
+        # round leaves a permanent zero-KV hole at that position (the next
+        # round starts writing at pos+gamma+1) and acceptance decays
+        # exactly when the draft is good. The last step's proposal is
+        # discarded.
+        def draft_body(dc, i):
+            t, dkp, dvp = dc
+            p_i = jnp.where(pos < 0, -1, pos + i)
+            kvl = jnp.where(pos < 0, 0, pos + i + 1)
+            logits, dkp, dvp = llama.forward(
+                draft_config, draft_params, t[:, None], p_i[:, None],
+                dkp, dvp, page_table, kvl, attn_impl=decode_impl,
+            )
+            idx, probs = filtered_probs(logits[:, 0], sampling)
+            j = _categorical_rows(sampling, probs, step, _TAG_DRAFT + i)
+            d = jnp.take_along_axis(idx, j[:, None], axis=1)[:, 0].astype(jnp.int32)
+            qd = jnp.take_along_axis(probs, j[:, None], axis=1)[:, 0]
+            return (d, dkp, dvp), (d, idx, probs, qd)
+
+        (_, dkp, dvp), (d_seq, d_idx, d_probs, q_d) = lax.scan(
+            draft_body, (tok, dkp, dvp), jnp.arange(gamma + 1, dtype=jnp.int32)
+        )
+        drafts = d_seq.T[:, :gamma]  # [B, g]
+        d_idx = jnp.moveaxis(d_idx, 0, 1)[:, :gamma]  # [B, g, K]
+        d_probs = jnp.moveaxis(d_probs, 0, 1)[:, :gamma]
+        q_d = q_d.T[:, :gamma]  # [B, g]
+
+        # -- target: one S=gamma+1 verify pass -----------------------------
+        ver_toks = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, g+1]
+        offs = jnp.arange(gamma + 1, dtype=jnp.int32)
+        ver_pos = jnp.where(pos[:, None] < 0, -1, pos[:, None] + offs)
+        kvl = jnp.where(pos < 0, 0, pos + gamma + 1)
+        logits, kp, vp = llama.forward(
+            config, params, ver_toks, ver_pos, kp, vp, page_table, kvl,
+            attn_impl=verify_impl,
+        )  # [B, g+1, V]
+        V = logits.shape[-1]
+        rep = SamplingParams(
+            temperature=jnp.repeat(sampling.temperature, gamma + 1),
+            top_k=jnp.repeat(sampling.top_k, gamma + 1),
+            top_p=jnp.repeat(sampling.top_p, gamma + 1),
+            key=jnp.repeat(sampling.key, gamma + 1, axis=0),
+        )
+        t_idx, t_probs = filtered_probs(logits.reshape(B * (gamma + 1), V), rep)
+        K = t_idx.shape[-1]
+        t_idx = t_idx.reshape(B, gamma + 1, K)
+        t_probs = t_probs.reshape(B, gamma + 1, K)
+
+        # draft distribution evaluated on the target's candidate ids
+        pair = t_idx[:, :gamma, :, None] == d_idx[:, :, None, :]  # [B,g,K,K]
+        q_on_t = jnp.sum(jnp.where(pair, d_probs[:, :, None, :], 0.0), axis=-1)
+
+        out_toks, counts = accept_and_finalize(
+            drafts, q_d, q_on_t, t_idx, t_probs, sampling, step
+        )
+
+        new_pos = jnp.where(pos < 0, pos, pos + counts)
+        last = jnp.take_along_axis(out_toks, (counts - 1)[:, None], axis=1)[:, 0]
+        return (last, new_pos, kp, vp, dkp, dvp), (out_toks, counts)
+
+    (_, _, k_pool, v_pool, dk_pool, dv_pool), (toks, counts) = lax.scan(
+        round_body,
+        (tokens0, positions0, k_pool, v_pool, dk_pool, dv_pool),
+        jnp.arange(n_rounds, dtype=jnp.int32),
+    )
+    # scan stacks rounds on axis 0 → [B, R, ...]
+    return (
+        jnp.moveaxis(toks, 0, 1),
+        counts.T,
+        k_pool,
+        v_pool,
+        dk_pool,
+        dv_pool,
+    )

@@ -47,6 +47,13 @@ def parse_args(argv=None):
     # batching
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
+    # speculative decoding
+    p.add_argument("--draft-model", default=None,
+                   help="draft model config preset (enables speculative decoding)")
+    p.add_argument("--draft-checkpoint", default=None,
+                   help="HF safetensors dir for draft weights")
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="draft tokens proposed per target verify pass")
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")
@@ -71,6 +78,17 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         seq=args.seq_parallel,
     )
     max_pages_per_seq = -(-args.max_seq_len // args.page_size)
+    draft_config = draft_params = None
+    if args.draft_model or args.draft_checkpoint:
+        if args.draft_checkpoint:
+            from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
+
+            draft_config = config_from_hf(
+                args.draft_checkpoint, name=args.draft_model or "draft"
+            )
+            draft_params = load_hf_checkpoint(args.draft_checkpoint, draft_config)
+        else:
+            draft_config = get_config(args.draft_model)
     runner = ModelRunner(
         config,
         mesh,
@@ -78,6 +96,9 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         page_size=args.page_size,
         max_pages_per_seq=max_pages_per_seq,
         params=params,
+        draft_config=draft_config,
+        draft_params=draft_params,
+        spec_gamma=args.spec_gamma,
     )
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
