@@ -66,15 +66,32 @@ class InferenceEngine:
         decode_steps: int = 4,
         idle_sleep_s: float = 0.002,
         host_kv_blocks: int = 0,  # G2 host-tier capacity (0 = disabled)
+        disk_kv_blocks: int = 0,  # G3 disk-tier capacity (needs G2 enabled)
+        disk_kv_root: Optional[str] = None,
     ):
         self.runner = runner
         self.pool = PagePool(runner.num_pages, runner.page_size)
         self.host_pool = None
         self._host_events: List[KvEvent] = []
+        if disk_kv_blocks > 0 and host_kv_blocks <= 0:
+            log.warning(
+                "disk_kv_blocks=%d ignored: the G3 disk tier spills from the "
+                "G2 host tier — also set host_kv_blocks > 0", disk_kv_blocks,
+            )
         if host_kv_blocks > 0:
+            from dynamo_tpu.kvbm.disk_pool import DiskKvPool, TieredKv
             from dynamo_tpu.kvbm.host_pool import HostKvPool
 
-            self.host_pool = HostKvPool(capacity_blocks=host_kv_blocks)
+            host = HostKvPool(capacity_blocks=host_kv_blocks)
+            disk = None
+            if disk_kv_blocks > 0:
+                import tempfile
+
+                disk = DiskKvPool(
+                    disk_kv_root or tempfile.mkdtemp(prefix="dyn_kv_g3_"),
+                    capacity_blocks=disk_kv_blocks,
+                )
+            self.host_pool = TieredKv(host, disk)
             self.pool.evict_hook = self._offload_page
             self.host_pool.on_evict(self._on_host_evicted)
         self.scheduler = Scheduler(
@@ -467,10 +484,17 @@ class InferenceEngine:
         self._host_events.append(KvEvent("remove", hashes, tier="host"))
 
     def _onboard_from_host(self, pages: List[int], hashes: List[int]) -> bool:
-        """Host-tier blocks → device pages during admission."""
+        """Host-tier blocks → device pages during admission. Returns False
+        when a matched block was evicted between match and get (lower-tier
+        LRU churn under memory pressure) — the scheduler then recomputes
+        instead of trusting a partial import."""
         from dynamo_tpu.engine.model_runner import kv_arrays_to_payload
 
-        k, v = self.host_pool.get(hashes)
+        try:
+            k, v = self.host_pool.get(hashes)
+        except KeyError:
+            log.info("lower-tier block evicted before onboard; recomputing")
+            return False
         if k is not None:
             self.runner.import_pages(pages, 0, kv_arrays_to_payload(k, v))
         return True

@@ -1,0 +1,289 @@
+"""G3 disk (NVMe/SSD) KV block tier.
+
+Third rung of the KVBM memory ladder (reference tier model
+lib/kvbm-engine/src/lib.rs:9-24: G1 device / G2 host / G3 disk / G4 object
+store): content-addressed KV blocks spilled from the host tier land in
+files; prefix-cache misses in G1/G2 onboard from here instead of
+recomputing. The reference moves G3 data with GDS/NIXL; on TPU the path is
+plain file IO into host arrays followed by the runner's host→device import
+(the same primitive the disagg transfer uses).
+
+Layout: one file per block — an 8-byte little-endian JSON-header length,
+the JSON header (shape/dtype/parent), then raw k bytes followed by raw v
+bytes. Capacity is bounded in blocks with LRU eviction (files unlinked).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import struct
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("dynamo_tpu.kvbm.disk")
+
+
+def _np_dtype(name: str):
+    if "bfloat16" in name:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+class DiskKvPool:
+    """Content-addressed KV block store on disk. Same match/get/put surface
+    as HostKvPool so the tier chain composes them uniformly."""
+
+    def __init__(self, root: str, capacity_blocks: int = 1 << 16):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.capacity = capacity_blocks
+        # LRU index: hash → parent (file presence is authoritative for data)
+        self._blocks: "OrderedDict[int, Optional[int]]" = OrderedDict()
+        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
+        self._evict_listeners: List[Any] = []
+        self._lock = threading.Lock()
+        # spill runs on the engine step thread; do the file write on a
+        # background writer so a device-eviction burst doesn't add disk
+        # latency to the decode hot path. _pending holds not-yet-written
+        # blocks so get_block stays consistent.
+        self._pending: Dict[int, Tuple[Any, Any]] = {}
+        self._write_q: "queue.Queue" = queue.Queue()
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+        self._writer.start()
+        self._rescan()
+
+    def _rescan(self) -> None:
+        """Adopt .kvb files left by a previous process with the same root:
+        rebuild the LRU index (mtime order) so they stay matchable and
+        capacity-managed instead of leaking forever."""
+        entries = []
+        for name in os.listdir(self.root):
+            if not name.endswith(".kvb"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                with open(path, "rb") as f:
+                    (hlen,) = struct.unpack("<Q", f.read(8))
+                    header = json.loads(f.read(hlen))
+                entries.append(
+                    (os.path.getmtime(path), int(name[:-4], 16), header.get("parent"))
+                )
+            except (OSError, ValueError, struct.error):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        for _, h, parent in sorted(entries):
+            self._blocks[h] = parent
+        if entries:
+            log.info("G3 rescan adopted %d blocks from %s", len(entries), self.root)
+        self._enforce_capacity()
+
+    def _write_loop(self) -> None:
+        while True:
+            item = self._write_q.get()
+            if item is None:
+                return
+            block_hash, parent_hash, k, v = item
+            with self._lock:
+                if block_hash not in self._pending:
+                    continue  # evicted before the write happened
+            try:
+                self._write_file(block_hash, parent_hash, k, v)
+            except OSError:
+                log.exception("G3 write failed for %x", block_hash)
+                with self._lock:
+                    self._blocks.pop(block_hash, None)
+            finally:
+                with self._lock:
+                    self._pending.pop(block_hash, None)
+
+    def _write_file(self, block_hash, parent_hash, k, v) -> None:
+        header = json.dumps(
+            {"shape": list(k.shape), "dtype": str(k.dtype), "parent": parent_hash}
+        ).encode()
+        tmp = self._path(block_hash) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<Q", len(header)))
+            f.write(header)
+            f.write(np.ascontiguousarray(k).tobytes())
+            f.write(np.ascontiguousarray(v).tobytes())
+        os.replace(tmp, self._path(block_hash))
+
+    def on_evict(self, cb) -> None:
+        self._evict_listeners.append(cb)
+
+    def __contains__(self, block_hash: int) -> bool:
+        with self._lock:
+            return block_hash in self._blocks
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def _path(self, block_hash: int) -> str:
+        return os.path.join(self.root, f"{block_hash & 0xFFFFFFFFFFFFFFFF:016x}.kvb")
+
+    # -- offload (G2 → G3) --------------------------------------------------
+    def put_block(
+        self,
+        block_hash: int,
+        parent_hash: Optional[int],
+        k: Optional[np.ndarray],  # [L, Hk, PS, D] one block, or None (sim)
+        v: Optional[np.ndarray],
+    ) -> None:
+        with self._lock:
+            if block_hash in self._blocks:
+                self._blocks.move_to_end(block_hash)
+                return
+            self._blocks[block_hash] = parent_hash
+            if k is not None:
+                self._pending[block_hash] = (k, v)
+            self.stats["offloaded"] += 1
+        if k is not None:
+            self._write_q.put((block_hash, parent_hash, k, v))
+        self._enforce_capacity()
+
+    def flush(self) -> None:
+        """Block until queued writes are durable (tests / shutdown)."""
+        import time
+
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+            time.sleep(0.005)
+
+    def _enforce_capacity(self) -> None:
+        dropped: List[int] = []
+        with self._lock:
+            while len(self._blocks) > self.capacity:
+                h, _ = self._blocks.popitem(last=False)
+                self._pending.pop(h, None)
+                try:
+                    os.unlink(self._path(h))
+                except FileNotFoundError:
+                    pass
+                dropped.append(h)
+                self.stats["evicted"] += 1
+        if dropped:
+            for cb in self._evict_listeners:
+                cb(dropped)
+
+    # -- onboard (G3 → up) --------------------------------------------------
+    def match(self, hashes: List[int]) -> int:
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._blocks:
+                    break
+                n += 1
+        return n
+
+    def get_block(self, block_hash: int) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """One block's (k, v) [L, Hk, PS, D]; (None, None) for hash-only
+        (sim) entries. Raises KeyError if the block was evicted since the
+        caller's match() — onboard callers treat that as a failed onboard
+        and fall back to recompute (never a silent partial import)."""
+        with self._lock:
+            self._blocks.move_to_end(block_hash)  # KeyError if evicted
+            pending = self._pending.get(block_hash)
+        self.stats["onboarded"] += 1
+        if pending is not None:  # spilled but not yet on disk
+            return pending
+        path = self._path(block_hash)
+        if not os.path.exists(path):
+            return None, None
+        with open(path, "rb") as f:
+            (hlen,) = struct.unpack("<Q", f.read(8))
+            header = json.loads(f.read(hlen))
+            dtype = _np_dtype(header["dtype"])
+            shape = tuple(header["shape"])
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            k = np.frombuffer(f.read(nbytes), dtype=dtype).reshape(shape)
+            v = np.frombuffer(f.read(nbytes), dtype=dtype).reshape(shape)
+        return k, v
+
+    def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """Stacked [L, Hk, n, PS, D] arrays (HostKvPool-compatible)."""
+        pairs = [self.get_block(h) for h in hashes]
+        if not pairs or pairs[0][0] is None:
+            return None, None
+        k = np.stack([p[0] for p in pairs], axis=2)
+        v = np.stack([p[1] for p in pairs], axis=2)
+        return k, v
+
+
+class TieredKv:
+    """G2 (host DRAM) + optional G3 (disk) presented as one lower-tier pool
+    to the scheduler/engine: match() walks the leading run across both
+    tiers, get() reads each block from whichever tier holds it, and
+    host-tier evictions spill block data down to disk instead of dropping
+    it (the KVBM ladder's demotion path). Lower-tier removal events fire
+    only from the terminal tier, so router credits persist while data
+    merely demotes."""
+
+    def __init__(self, host, disk: Optional[DiskKvPool] = None):
+        self.host = host
+        self.disk = disk
+        if disk is not None:
+            host.spill_hook = self._spill
+
+    def _spill(self, block) -> None:  # HostBlock
+        self.disk.put_block(block.block_hash, block.parent_hash, block.k, block.v)
+
+    def on_evict(self, cb) -> None:
+        # only terminal drops (disk evictions, or host evictions with no
+        # disk below) remove lower-tier residency. NB: pools define __len__,
+        # so `self.disk or self.host` would treat an EMPTY disk as absent
+        terminal = self.host if self.disk is None else self.disk
+        terminal.on_evict(cb)
+
+    def match(self, hashes: List[int]) -> int:
+        n = 0
+        for h in hashes:
+            if h in self.host or (self.disk is not None and h in self.disk):
+                n += 1
+            else:
+                break
+        return n
+
+    def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """Raises KeyError if any block was evicted (from BOTH tiers) after
+        the caller's match() — concurrent spills can churn the disk LRU."""
+        ks, vs = [], []
+        for h in hashes:
+            if h in self.host:
+                k, v = self.host.get([h])
+                k = k[:, :, 0] if k is not None else None
+                v = v[:, :, 0] if v is not None else None
+            elif self.disk is not None:
+                k, v = self.disk.get_block(h)
+            else:
+                raise KeyError(h)
+            if k is None:
+                return None, None
+            ks.append(k)
+            vs.append(v)
+        return np.stack(ks, axis=2), np.stack(vs, axis=2)
+
+    def put(self, hashes, parents, k, v) -> None:
+        self.host.put(hashes, parents, k, v)
+
+    @property
+    def stats(self):
+        s = dict(self.host.stats)
+        if self.disk is not None:
+            s.update({f"disk_{k}": val for k, val in self.disk.stats.items()})
+        return s
+
+    def __contains__(self, h: int) -> bool:
+        return h in self.host or (self.disk is not None and h in self.disk)

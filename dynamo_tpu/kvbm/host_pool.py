@@ -39,6 +39,9 @@ class HostKvPool:
         self._blocks: "OrderedDict[int, HostBlock]" = OrderedDict()  # LRU
         self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
         self._evict_listeners: List[Any] = []
+        # demotion: called with the full HostBlock before an LRU drop so a
+        # lower tier (G3 disk) can absorb the data
+        self.spill_hook: Optional[Any] = None
 
     def on_evict(self, cb) -> None:
         """cb(list[int]) — hashes dropped from the host tier."""
@@ -71,7 +74,9 @@ class HostKvPool:
     def _enforce_capacity(self) -> None:
         dropped: List[int] = []
         while len(self._blocks) > self.capacity:
-            h, _ = self._blocks.popitem(last=False)
+            h, block = self._blocks.popitem(last=False)
+            if self.spill_hook is not None:
+                self.spill_hook(block)
             dropped.append(h)
             self.stats["evicted"] += 1
         if dropped:

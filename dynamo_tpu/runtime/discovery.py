@@ -197,17 +197,25 @@ class FileDiscovery(DiscoveryBackend):
 
 def make_discovery(backend: Optional[str] = None, **kw) -> DiscoveryBackend:
     """Select a backend, env-first (DYN_DISCOVERY_BACKEND; reference
-    lib/runtime/src/distributed.rs:149-180). etcd/kubernetes are recognized
-    but gated off in this environment (no etcd client available)."""
+    lib/runtime/src/distributed.rs:149-180)."""
     backend = backend or os.environ.get("DYN_DISCOVERY_BACKEND", "mem")
     if backend == "mem":
         return MemDiscovery(realm=kw.get("realm", "default"))
     if backend == "file":
         root = kw.get("root") or os.environ.get("DYN_DISCOVERY_FILE_ROOT", "/tmp/dynamo_tpu_discovery")
         return FileDiscovery(root, lease_ttl=float(kw.get("lease_ttl", 10.0)))
-    if backend in ("etcd", "kubernetes"):
+    if backend == "etcd":
+        from dynamo_tpu.runtime.etcd import EtcdDiscovery
+
+        endpoint = (
+            kw.get("endpoint")
+            or os.environ.get("DYN_ETCD_ENDPOINT")
+            or os.environ.get("ETCD_ENDPOINTS", "http://127.0.0.1:2379").split(",")[0]
+        )
+        return EtcdDiscovery(endpoint, lease_ttl=int(kw.get("lease_ttl", 10)))
+    if backend == "kubernetes":
         raise NotImplementedError(
-            f"discovery backend {backend!r} requires an external client not "
-            "present in this environment; use 'file' for multi-process or 'mem'"
+            "kubernetes discovery requires a cluster API client; use 'etcd', "
+            "'file', or 'mem'"
         )
     raise ValueError(f"unknown discovery backend {backend!r}")

@@ -1,0 +1,214 @@
+"""etcd discovery backend over the v3 JSON gRPC-gateway.
+
+The reference runtime's primary discovery/lease store is etcd
+(lib/runtime/src/distributed.rs:149-180, transports/etcd.rs: lease-scoped
+instance keys + prefix watches feeding ModelWatcher). This backend speaks
+the same etcd semantics through the v3 HTTP/JSON gateway (`/v3/kv/*`,
+`/v3/lease/*`, `/v3/watch`) so no native client library is required:
+
+- register  → LeaseGrant(ttl) + Put(key, value, lease)
+- heartbeat → LeaseKeepAlive (re-registers if the lease was lost)
+- watch     → streaming /v3/watch with an initial Range replay; DELETE
+              events are synthesized from the last-seen record since etcd
+              delete notifications carry no value
+
+Keys are the instance paths (`services/...`), values the instance JSON —
+identical layout to the file backend, so operators can inspect state with
+plain etcdctl.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import AsyncIterator, Dict, List, Optional
+
+from dynamo_tpu.runtime.component import Instance
+from dynamo_tpu.runtime.discovery import DiscoveryBackend, DiscoveryEvent
+
+log = logging.getLogger("dynamo_tpu.runtime.etcd")
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+def _prefix_end(prefix: str) -> str:
+    """etcd range_end for a prefix scan: prefix with last byte + 1."""
+    b = bytearray(prefix.encode())
+    b[-1] += 1
+    return base64.b64encode(bytes(b)).decode()
+
+
+class EtcdDiscovery(DiscoveryBackend):
+    def __init__(
+        self,
+        endpoint: str = "http://127.0.0.1:2379",
+        lease_ttl: int = 10,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.lease_ttl = max(2, int(lease_ttl))
+        self._session = None  # aiohttp.ClientSession, lazy
+        self._lease_id: Optional[int] = None
+        self._mine: Dict[str, Instance] = {}
+
+    async def _http(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def _post(self, path: str, body: dict) -> dict:
+        s = await self._http()
+        async with s.post(self.endpoint + path, json=body) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def _lease(self) -> int:
+        if self._lease_id is None:
+            out = await self._post("/v3/lease/grant", {"TTL": self.lease_ttl})
+            self._lease_id = int(out["ID"])
+        return self._lease_id
+
+    # -- DiscoveryBackend ---------------------------------------------------
+    async def register(self, instance: Instance) -> None:
+        lease = await self._lease()
+        await self._post(
+            "/v3/kv/put",
+            {
+                "key": _b64(instance.path),
+                "value": _b64(json.dumps(instance.to_dict())),
+                "lease": lease,
+            },
+        )
+        self._mine[instance.path] = instance
+
+    async def unregister(self, instance: Instance) -> None:
+        self._mine.pop(instance.path, None)
+        await self._post("/v3/kv/deleterange", {"key": _b64(instance.path)})
+
+    async def heartbeat(self) -> None:
+        if self._lease_id is None:
+            return
+        try:
+            out = await self._post("/v3/lease/keepalive", {"ID": self._lease_id})
+            ttl = int(out.get("result", out).get("TTL", 0))
+        except Exception:
+            ttl = 0
+        if ttl <= 0:
+            # lease expired (e.g. long GC pause / etcd restart): new lease,
+            # re-register everything — the reference's lease-recovery path
+            log.warning("etcd lease %s lost; re-registering %d instances",
+                        self._lease_id, len(self._mine))
+            self._lease_id = None
+            for inst in list(self._mine.values()):
+                await self.register(inst)
+
+    async def _range(self, prefix: str):
+        """(instances, revision) — the revision anchors a gap-free watch."""
+        out = await self._post(
+            "/v3/kv/range",
+            {"key": _b64(prefix), "range_end": _prefix_end(prefix)},
+        )
+        result: List[Instance] = []
+        for kv in out.get("kvs") or []:
+            try:
+                result.append(Instance.from_dict(json.loads(_unb64(kv["value"]))))
+            except (ValueError, KeyError):
+                continue
+        rev = int((out.get("header") or {}).get("revision", 0))
+        return result, rev
+
+    async def list_instances(self, prefix: str = "") -> List[Instance]:
+        return (await self._range(prefix or "services/"))[0]
+
+    async def watch(self, prefix: str = "") -> AsyncIterator[DiscoveryEvent]:
+        prefix = prefix or "services/"
+        known: Dict[str, dict] = {}
+        rev = 0
+        # initial replay (retry until etcd is reachable)
+        while True:
+            try:
+                insts, rev = await self._range(prefix)
+                break
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("etcd initial range failed (%s); retrying", e)
+                await asyncio.sleep(0.5)
+        for inst in insts:
+            known[inst.path] = inst.to_dict()
+            yield DiscoveryEvent("put", inst)
+        s = await self._http()
+        while True:
+            # watch from rev+1: events between the range/resync and the
+            # stream creation are replayed, not lost
+            body = {
+                "create_request": {
+                    "key": _b64(prefix),
+                    "range_end": _prefix_end(prefix),
+                    "start_revision": str(rev + 1),
+                }
+            }
+            try:
+                async with s.post(self.endpoint + "/v3/watch", json=body) as resp:
+                    resp.raise_for_status()
+                    async for line in resp.content:
+                        if not line.strip():
+                            continue
+                        msg = json.loads(line)
+                        result = msg.get("result") or {}
+                        rev = max(
+                            rev, int((result.get("header") or {}).get("revision", 0))
+                        )
+                        for ev in result.get("events") or []:
+                            kind = "delete" if ev.get("type") == "DELETE" else "put"
+                            key = _unb64(ev["kv"]["key"])
+                            if kind == "put":
+                                rec = json.loads(_unb64(ev["kv"]["value"]))
+                                known[key] = rec
+                                yield DiscoveryEvent("put", Instance.from_dict(rec))
+                            else:
+                                rec = known.pop(key, None)
+                                if rec is not None:
+                                    yield DiscoveryEvent(
+                                        "delete", Instance.from_dict(rec)
+                                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("etcd watch stream error (%s); resyncing", e)
+                await asyncio.sleep(0.5)
+                try:
+                    current_insts, rev = await self._range(prefix)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    continue  # still down; keep retrying, don't kill the watch
+                current = {i.path: i.to_dict() for i in current_insts}
+                for path, rec in current.items():
+                    if known.get(path) != rec:
+                        known[path] = rec
+                        yield DiscoveryEvent("put", Instance.from_dict(rec))
+                for path in list(known):
+                    if path not in current:
+                        rec = known.pop(path)
+                        yield DiscoveryEvent("delete", Instance.from_dict(rec))
+
+    async def close(self) -> None:
+        if self._lease_id is not None:
+            try:
+                await self._post("/v3/lease/revoke", {"ID": self._lease_id})
+            except Exception:
+                pass
+            self._lease_id = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

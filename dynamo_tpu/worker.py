@@ -44,6 +44,10 @@ def parse_args(argv=None):
     p.add_argument("--max-seq-len", type=int, default=4096)
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="G2 host-DRAM KV tier capacity in blocks (0 = off)")
+    p.add_argument("--disk-kv-blocks", type=int, default=0,
+                   help="G3 disk KV tier capacity in blocks (needs G2 on)")
+    p.add_argument("--disk-kv-root", default=None,
+                   help="G3 tier directory (default: a temp dir)")
     # batching
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
@@ -103,6 +107,7 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         host_kv_blocks=args.host_kv_blocks,
+        disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
     )
     card = ModelCard(
         name=args.model_name or config.name,

@@ -1,0 +1,170 @@
+"""Minimal in-process etcd v3 JSON-gateway for testing EtcdDiscovery:
+implements /v3/kv/{put,range,deleterange}, /v3/lease/{grant,keepalive,
+revoke}, and streaming /v3/watch with lease-expiry deletes — the exact
+subset the backend speaks."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+
+class FakeEtcd:
+    def __init__(self):
+        self.kv: Dict[bytes, Tuple[bytes, Optional[int]]] = {}  # key -> (value, lease)
+        self.leases: Dict[int, Tuple[int, float]] = {}  # id -> (ttl, deadline)
+        self._next_lease = 1000
+        self.revision = 1
+        self.journal: List[Tuple[int, str, bytes, bytes]] = []  # (rev, typ, key, value)
+        self._watchers: List[Tuple[bytes, bytes, asyncio.Queue]] = []
+        self._runner = None
+        self.port = None
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_post("/v3/kv/put", self._put)
+        app.router.add_post("/v3/kv/range", self._range)
+        app.router.add_post("/v3/kv/deleterange", self._delete)
+        app.router.add_post("/v3/lease/grant", self._grant)
+        app.router.add_post("/v3/lease/keepalive", self._keepalive)
+        app.router.add_post("/v3/lease/revoke", self._revoke)
+        app.router.add_post("/v3/watch", self._watch)
+        # short shutdown grace: open /v3/watch streams otherwise hold
+        # cleanup for the default 60s
+        self._runner = web.AppRunner(app, shutdown_timeout=0.5)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._expiry_task = asyncio.create_task(self._expire_loop())
+        return f"http://127.0.0.1:{self.port}"
+
+    async def stop(self) -> None:
+        self._expiry_task.cancel()
+        await self._runner.cleanup()
+
+    async def _expire_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.1)
+            now = time.monotonic()
+            for lid, (ttl, deadline) in list(self.leases.items()):
+                if deadline < now:
+                    del self.leases[lid]
+                    for k, (v, lease) in list(self.kv.items()):
+                        if lease == lid:
+                            del self.kv[k]
+                            self._notify("DELETE", k, b"")
+
+    # -- handlers -----------------------------------------------------------
+    def _notify(self, typ: str, key: bytes, value: bytes) -> None:
+        self.revision += 1
+        self.journal.append((self.revision, typ, key, value))
+        del self.journal[:-1000]
+        for lo, hi, q in self._watchers:
+            if lo <= key < hi:
+                q.put_nowait((typ, key, value, self.revision))
+
+    async def _put(self, req):
+        body = await req.json()
+        key = base64.b64decode(body["key"])
+        value = base64.b64decode(body["value"])
+        self.kv[key] = (value, body.get("lease"))
+        self._notify("PUT", key, value)
+        return web.json_response({"header": {}})
+
+    async def _range(self, req):
+        body = await req.json()
+        lo = base64.b64decode(body["key"])
+        hi = base64.b64decode(body.get("range_end", "")) if body.get("range_end") else lo + b"\x00"
+        kvs = [
+            {"key": base64.b64encode(k).decode(), "value": base64.b64encode(v).decode()}
+            for k, (v, _) in sorted(self.kv.items())
+            if lo <= k < hi
+        ]
+        return web.json_response({
+            "header": {"revision": str(self.revision)},
+            "kvs": kvs, "count": str(len(kvs)),
+        })
+
+    async def _delete(self, req):
+        body = await req.json()
+        key = base64.b64decode(body["key"])
+        if key in self.kv:
+            del self.kv[key]
+            self._notify("DELETE", key, b"")
+        return web.json_response({"deleted": "1"})
+
+    async def _grant(self, req):
+        body = await req.json()
+        ttl = int(body["TTL"])
+        self._next_lease += 1
+        lid = self._next_lease
+        self.leases[lid] = (ttl, time.monotonic() + ttl)
+        return web.json_response({"ID": str(lid), "TTL": str(ttl)})
+
+    async def _keepalive(self, req):
+        body = await req.json()
+        lid = int(body["ID"])
+        if lid not in self.leases:
+            return web.json_response({"result": {"ID": str(lid), "TTL": "0"}})
+        ttl = self.leases[lid][0]
+        self.leases[lid] = (ttl, time.monotonic() + ttl)
+        return web.json_response({"result": {"ID": str(lid), "TTL": str(ttl)}})
+
+    async def _revoke(self, req):
+        body = await req.json()
+        lid = int(body["ID"])
+        self.leases.pop(lid, None)
+        for k, (v, lease) in list(self.kv.items()):
+            if lease == lid:
+                del self.kv[k]
+                self._notify("DELETE", k, b"")
+        return web.json_response({"header": {}})
+
+    async def _watch(self, req):
+        body = await req.json()
+        cr = body["create_request"]
+        lo = base64.b64decode(cr["key"])
+        hi = base64.b64decode(cr["range_end"])
+        start_rev = int(cr.get("start_revision", 0))
+        q: asyncio.Queue = asyncio.Queue()
+        # replay journaled events at/after start_revision (etcd watch
+        # history semantics) BEFORE going live
+        if start_rev:
+            for rev, typ, key, value in self.journal:
+                if rev >= start_rev and lo <= key < hi:
+                    q.put_nowait((typ, key, value, rev))
+        self._watchers.append((lo, hi, q))
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(req)
+        try:
+            await resp.write(json.dumps({"result": {"created": True}}).encode() + b"\n")
+            while True:
+                typ, key, value, rev = await q.get()
+                ev = {
+                    "result": {
+                        "header": {"revision": str(rev)},
+                        "events": [
+                            {
+                                "type": typ,
+                                "kv": {
+                                    "key": base64.b64encode(key).decode(),
+                                    "value": base64.b64encode(value).decode(),
+                                },
+                            }
+                        ]
+                    }
+                }
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            self._watchers.remove((lo, hi, q))
+        return resp

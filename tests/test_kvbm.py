@@ -94,3 +94,148 @@ async def test_host_tier_events_published(tiered_engine):
     await asyncio.sleep(0.05)
     tiers = {e.tier for b in batches for e in b}
     assert "host" in tiers and "device" in tiers
+
+
+def test_disk_pool_roundtrip_and_lru(tmp_path):
+    import numpy as np
+
+    from dynamo_tpu.kvbm.disk_pool import DiskKvPool
+
+    pool = DiskKvPool(str(tmp_path), capacity_blocks=2)
+    dropped = []
+    pool.on_evict(dropped.extend)
+
+    k = np.arange(2 * 1 * 4 * 8, dtype=np.float32).reshape(2, 1, 4, 8)
+    pool.put_block(201, None, k, k * 3)
+    pool.put_block(202, 201, k + 1, k * 5)
+    pool.put_block(203, 202, k + 2, k * 7)
+    assert len(pool) == 2 and dropped == [201]
+    assert pool.match([201]) == 0 and pool.match([202, 203]) == 2
+
+    k2, v2 = pool.get([202, 203])
+    assert k2.shape == (2, 1, 2, 4, 8)
+    np.testing.assert_array_equal(k2[:, :, 0], k + 1)
+    np.testing.assert_array_equal(v2[:, :, 1], k * 7)
+    # evicted file is gone from disk (flush: writes are async)
+    pool.flush()
+    assert len(list(tmp_path.glob("*.kvb"))) == 2
+
+
+def test_tiered_host_disk_spill_and_match(tmp_path):
+    import numpy as np
+
+    from dynamo_tpu.kvbm.disk_pool import DiskKvPool, TieredKv
+
+    host = HostKvPool(capacity_blocks=1)
+    tier = TieredKv(host, DiskKvPool(str(tmp_path), capacity_blocks=8))
+    terminal_drops = []
+    tier.on_evict(terminal_drops.extend)
+
+    k = np.ones((2, 1, 3, 4, 8), np.float32)
+    tier.put([301, 302, 303], [None, 301, 302], k, k * 2)
+    # host keeps only the newest block; the others spilled to disk
+    assert len(host) == 1 and 303 in host
+    assert tier.match([301, 302, 303]) == 3  # across both tiers
+    assert terminal_drops == []  # demotion is not removal
+
+    k2, v2 = tier.get([301, 302, 303])
+    assert k2.shape == (2, 1, 3, 4, 8)
+    assert (v2 == 2).all()
+
+
+@pytest.fixture(scope="module")
+def disk_engine(tmp_path_factory):
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    runner = ModelRunner(
+        get_config("tiny"),
+        num_pages=16,
+        page_size=4,
+        max_pages_per_seq=8,
+        decode_buckets=(1, 2),
+        prefill_buckets=(8, 16, 32),
+        seed=11,
+    )
+    # host tier of 2 blocks: almost everything demotes straight to disk
+    engine = InferenceEngine(
+        runner, max_batch=2, chunk_size=32, host_kv_blocks=2,
+        disk_kv_blocks=128,
+        disk_kv_root=str(tmp_path_factory.mktemp("g3")),
+    )
+    engine.start()
+    yield engine
+    engine.stop()
+
+
+async def test_g3_onboard_bit_identical(disk_engine):
+    """KV that demoted device→host→disk must onboard back and continue
+    bit-identically (same greedy tokens as the fresh computation)."""
+    eng = disk_engine
+    prompt_a = list(range(50, 66))  # 16 tokens = 4 pages
+    out_a = await _generate(eng, prompt_a)
+
+    for i in range(8):
+        await _generate(eng, [200 + 5 * i + j for j in range(16)])
+    await asyncio.sleep(0.05)
+    st = eng.host_pool.stats
+    assert st["disk_offloaded"] > 0, f"host tier should spill to disk: {st}"
+
+    out_a2 = await _generate(eng, prompt_a)
+    assert out_a2 == out_a
+    assert eng.host_pool.stats["disk_onboarded"] > 0
+
+
+async def test_onboard_eviction_race_falls_back_to_recompute(tmp_path):
+    """A matched lower-tier block evicted between match() and get() (LRU
+    churn under pressure) must NOT corrupt the prefix: onboard reports
+    failure and the scheduler recomputes, with identical output."""
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    runner = ModelRunner(
+        get_config("tiny"), num_pages=16, page_size=4, max_pages_per_seq=8,
+        decode_buckets=(1, 2), prefill_buckets=(8, 16, 32), seed=11,
+    )
+    engine = InferenceEngine(
+        runner, max_batch=2, chunk_size=32, host_kv_blocks=2,
+        disk_kv_blocks=64, disk_kv_root=str(tmp_path),
+    )
+    engine.start()
+    try:
+        prompt = list(range(70, 86))
+        out = await _generate(engine, prompt)
+        for i in range(6):  # churn device pool → blocks demote
+            await _generate(engine, [400 + 9 * i + j for j in range(16)])
+
+        # sabotage: every get now behaves as if the block was just evicted
+        real_get = engine.host_pool.get
+        engine.host_pool.get = lambda hashes: (_ for _ in ()).throw(KeyError(hashes[0]))
+        out2 = await _generate(engine, prompt)
+        assert out2 == out  # recomputed, not corrupted
+        engine.host_pool.get = real_get
+    finally:
+        engine.stop()
+
+
+def test_disk_pool_rescan_adopts_previous_files(tmp_path):
+    import numpy as np
+
+    from dynamo_tpu.kvbm.disk_pool import DiskKvPool
+
+    k = np.full((2, 1, 4, 8), 5.0, np.float32)
+    p1 = DiskKvPool(str(tmp_path), capacity_blocks=8)
+    p1.put_block(11, None, k, k * 2)
+    p1.put_block(12, 11, k + 1, k * 3)
+    p1.flush()
+
+    # a new process with the same root adopts the files
+    p2 = DiskKvPool(str(tmp_path), capacity_blocks=8)
+    assert len(p2) == 2 and p2.match([11, 12]) == 2
+    k2, v2 = p2.get([11, 12])
+    np.testing.assert_array_equal(v2[:, :, 1], k * 3)
+
+    # and capacity applies to adopted blocks too
+    p3 = DiskKvPool(str(tmp_path), capacity_blocks=1)
+    assert len(p3) == 1
+    assert len(list(tmp_path.glob("*.kvb"))) == 1

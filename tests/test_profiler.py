@@ -1,0 +1,42 @@
+"""Profiler SLA sweep: parallelism configs evaluated on the in-process
+mocker stack; the recommendation must meet the SLA and report per-chip
+goodput."""
+
+import pytest
+
+from dynamo_tpu.planner.profiler import TpuPerfModel, parse_args, sweep
+
+
+def test_tp_scaling_model_monotone():
+    perf = TpuPerfModel(decode_base_s=0.008, tp_efficiency=0.85)
+    t1, t4 = perf.timing_for(1), perf.timing_for(4)
+    assert t4.decode_base_s < t1.decode_base_s / 2
+    # dispatch floor does not shrink with tp
+    assert t4.dispatch_overhead_s == t1.dispatch_overhead_s
+
+
+async def test_sweep_recommends_config():
+    args = parse_args([
+        "--chips", "4", "--requests", "24", "--rps", "40",
+        "--isl", "32", "--osl", "8", "--speed", "0.25",
+        "--ttft-slo", "2.0", "--itl-slo", "0.2",
+    ])
+    out = await sweep(args)
+    tps = [c["tp"] for c in out["configs"]]
+    assert tps == [1, 2, 4]
+    for c in out["configs"]:
+        assert c["chips"] == 4
+        assert 0.0 <= c["attainment"] <= 1.0
+        assert c["n_ok"] == 24
+    rec = out["recommendation"]
+    assert rec is not None and rec["attainment"] >= 0.9
+
+
+async def test_sweep_fails_impossible_slo():
+    args = parse_args([
+        "--chips", "2", "--requests", "12", "--rps", "40",
+        "--isl", "32", "--osl", "8", "--speed", "0.25",
+        "--ttft-slo", "0.0001", "--itl-slo", "0.0001",
+    ])
+    out = await sweep(args)
+    assert out["recommendation"] is None
