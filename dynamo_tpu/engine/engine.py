@@ -167,7 +167,19 @@ class InferenceEngine:
             arrival=time.monotonic(),
             disagg=annotations.get("disagg"),
             kv_import=request.get("kv_import"),
+            adapter=request.get("adapter"),
         )
+        if seq.adapter:
+            try:
+                seq.adapter_idx = self.runner.adapter_slot(seq.adapter)
+            except (KeyError, AttributeError):
+                yield {
+                    "finish_reason": "error",
+                    "error": f"unknown LoRA adapter {seq.adapter!r}",
+                    "token_ids": [],
+                }
+                self._streams.pop(rid, None)
+                return
         if seq.disagg == "decode" and seq.kv_import is not None:
             self._inbox.put(("add_kv", seq))
         else:
@@ -315,6 +327,7 @@ class InferenceEngine:
             plan.start_pos,
             seq.pages,
             prior_len=plan.start_pos,
+            adapter=seq.adapter_idx,
         )
         if getattr(self.runner, "has_draft", False) and seq.disagg != "prefill":
             # keep the draft model's KV pools in lockstep so spec decode
@@ -379,7 +392,7 @@ class InferenceEngine:
             self._step_counter += R
             toks, counts = self.runner.spec_decode_multi(
                 R, tokens, positions, page_tables, _sampling_params(seqs), step0,
-                gamma=gamma,
+                gamma=gamma, adapters=[s.adapter_idx for s in seqs],
             )
             for i, seq in enumerate(seqs):
                 emit: List[int] = []
@@ -398,7 +411,8 @@ class InferenceEngine:
             return
         self._step_counter += T
         sampled = self.runner.decode_multi(
-            T, tokens, positions, page_tables, _sampling_params(seqs), step0
+            T, tokens, positions, page_tables, _sampling_params(seqs), step0,
+            adapters=[s.adapter_idx for s in seqs],
         )
         for i, seq in enumerate(seqs):
             emit: List[int] = []

@@ -87,11 +87,14 @@ class PagePool:
         return pages
 
     # -- prefix cache ------------------------------------------------------
-    def match_prefix(self, tokens: List[int]) -> Tuple[List[int], List[int]]:
-        """Longest cached prefix → (pages, hashes). Bumps refcounts."""
+    def match_prefix(
+        self, tokens: List[int], parent: "Optional[int]" = None
+    ) -> Tuple[List[int], List[int]]:
+        """Longest cached prefix → (pages, hashes). Bumps refcounts.
+        `parent` seeds the hash chain (per-adapter KV isolation)."""
         pages: List[int] = []
         hashes: List[int] = []
-        for h in block_hashes(tokens, self.page_size):
+        for h in block_hashes(tokens, self.page_size, parent):
             page = self.by_hash.get(h)
             if page is None:
                 break

@@ -46,6 +46,8 @@ def _decode_loop(
     page_table,  # [B, MP]
     sampling: SamplingParams,
     step0,  # scalar int32 PRNG step base
+    lora=None,  # stacked multi-LoRA tree (models/lora.py)
+    adapter_idx=None,  # [B] adapter slot per sequence
 ):
     """n_steps decode iterations fused in one jit: forward → sample → feed
     the sampled token back, entirely on device (lax.scan). Amortizes the
@@ -58,7 +60,7 @@ def _decode_loop(
         kvl = jnp.where(positions0 < 0, 0, positions0 + t + 1)
         logits, kp, vp = llama.forward(
             config, params, tok[:, None], pos[:, None], kp, vp, page_table, kvl,
-            attn_impl=attn_impl,
+            attn_impl=attn_impl, lora=lora, adapter_idx=adapter_idx,
         )
         s = sample(logits[:, 0, :], sampling, step0 + t)
         return (s, kp, vp), s
@@ -124,6 +126,9 @@ class ModelRunner:
         draft_config: Optional[ModelConfig] = None,  # enables spec decode
         draft_params: Optional[Any] = None,
         spec_gamma: int = 4,  # draft tokens proposed per verify pass
+        lora_slots: int = 0,  # >0 enables multi-LoRA (slot 0 = base)
+        lora_rank: int = 8,
+        lora_targets=None,  # defaults to models/lora.py DEFAULT_TARGETS
     ):
         self.config = config
         self.mesh_config = mesh_config or MeshConfig()
@@ -169,6 +174,20 @@ class ModelRunner:
             dk, dv = llama.make_kv_pool(draft_config, num_pages, page_size, dtype)
             self.draft_k_pool = jax.device_put(dk, kv_sharding)
             self.draft_v_pool = jax.device_put(dv, kv_sharding)
+
+        # multi-LoRA: stacked adapter factors, one slot per adapter, batched
+        # per-sequence adapter indices through every step function
+        self.lora = None
+        self._adapter_slots: Dict[str, int] = {}
+        self.lora_rank = lora_rank
+        if lora_slots > 0:
+            from dynamo_tpu.models import lora as lora_mod
+
+            self.lora_targets = tuple(lora_targets or lora_mod.DEFAULT_TARGETS)
+            tree = lora_mod.init_lora_params(
+                config, lora_slots + 1, lora_rank, self.lora_targets, dtype
+            )
+            self.lora = jax.device_put(tree, self.policy.params_sharding(tree))
 
         if attn_impl is None:
             platform = self.mesh.devices.flat[0].platform
@@ -217,6 +236,7 @@ class ModelRunner:
         start_pos: int,
         page_table_row: List[int],
         prior_len: int,
+        adapter: int = 0,
     ) -> jax.Array:
         """Run one prefill chunk for a single sequence. `tokens` are the
         uncomputed prompt tokens starting at absolute position `start_pos`;
@@ -229,6 +249,8 @@ class ModelRunner:
             jnp.int32(n - 1), attn_impl=impl,
             mesh=self.mesh if impl == "ring" else None,
             sp_has_prior=prior_len > 0,
+            lora=self.lora,
+            adapter_idx=jnp.asarray([adapter], jnp.int32) if self.lora is not None else None,
         )
         return logits[0, 0]
 
@@ -260,6 +282,14 @@ class ModelRunner:
         out = self.decode_multi(1, tokens, positions, page_tables, sampling, step)
         return out[:, 0]
 
+    def _adapter_array(self, adapters: Optional[List[int]], B: int):
+        if self.lora is None:
+            return None
+        idx = np.zeros(B, np.int32)
+        if adapters:
+            idx[: len(adapters)] = adapters
+        return jnp.asarray(idx)
+
     def decode_multi(
         self,
         n_steps: int,
@@ -268,6 +298,7 @@ class ModelRunner:
         page_tables: List[List[int]],
         sampling,  # SamplingParams or dict of host lists
         step: int,
+        adapters: Optional[List[int]] = None,
     ) -> np.ndarray:
         """n_steps fused decode iterations (one host sync total). Page
         tables must already cover positions[i] + n_steps slots. Returns
@@ -284,12 +315,42 @@ class ModelRunner:
             n_steps, self.params, jnp.asarray(tok), jnp.asarray(pos),
             self.k_pool, self.v_pool, jnp.asarray(pt),
             _pad_sampling(_as_sampling(sampling), B), jnp.int32(step),
+            self.lora, self._adapter_array(adapters, B),
         )
         return np.asarray(jax.device_get(toks))
 
     @property
     def has_draft(self) -> bool:
         return self.draft_config is not None
+
+    # -- multi-LoRA registry ------------------------------------------------
+    @property
+    def adapter_names(self) -> List[str]:
+        return list(self._adapter_slots)
+
+    def register_adapter(self, name: str, factors: Dict[str, Any]) -> int:
+        """Install an adapter's factors into the next free slot; returns
+        the slot index sequences reference. factors: models/lora.py layout
+        ({t}_a [L,in,r], {t}_b [L,r,out], scaling folded into B)."""
+        from dynamo_tpu.models import lora as lora_mod
+
+        if self.lora is None:
+            raise RuntimeError("runner built without lora_slots")
+        if name in self._adapter_slots:
+            return self._adapter_slots[name]
+        slot = len(self._adapter_slots) + 1  # 0 is the base slot
+        n_slots = next(iter(self.lora["layers"].values())).shape[1]
+        if slot >= n_slots:
+            raise RuntimeError(f"all {n_slots - 1} LoRA slots in use")
+        self.lora = lora_mod.set_adapter_slot(self.lora, slot, factors)
+        self._adapter_slots[name] = slot
+        log.info("registered LoRA adapter %r in slot %d", name, slot)
+        return slot
+
+    def adapter_slot(self, name: Optional[str]) -> int:
+        if not name:
+            return 0
+        return self._adapter_slots[name]
 
     def spec_decode_multi(
         self,
@@ -300,6 +361,7 @@ class ModelRunner:
         sampling,
         step: int,
         gamma: Optional[int] = None,
+        adapters: Optional[List[int]] = None,
     ):
         """n_rounds fused speculative rounds (one host sync). Returns
         (tokens [B_bucket, R, gamma+1], counts [B_bucket, R]); row i's
@@ -322,7 +384,7 @@ class ModelRunner:
                 jnp.asarray(tok), jnp.asarray(pos),
                 self.k_pool, self.v_pool, self.draft_k_pool, self.draft_v_pool,
                 jnp.asarray(pt), _pad_sampling(_as_sampling(sampling), B),
-                jnp.int32(step),
+                jnp.int32(step), self.lora, self._adapter_array(adapters, B),
             )
         )
         toks_h, counts_h = jax.device_get((toks, counts))

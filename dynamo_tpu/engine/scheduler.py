@@ -24,7 +24,14 @@ from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
 
 from dynamo_tpu.engine.kv_pool import NoSpace, PagePool
-from dynamo_tpu.tokens.hashing import block_hashes, hash_block
+from dynamo_tpu.tokens.hashing import adapter_seed, block_hashes, hash_block
+
+
+def _chain_seed(seq: "Sequence") -> Optional[int]:
+    """Hash-chain seed: LoRA-attributed sequences get a disjoint block
+    lineage (their K/V is adapter-dependent and must never be shared with
+    base-model or other-adapter sequences)."""
+    return adapter_seed(seq.adapter) if seq.adapter else None
 
 log = logging.getLogger("dynamo_tpu.engine.scheduler")
 
@@ -48,6 +55,8 @@ class Sequence:
     #   "decode" = KV arrives via transfer, skip prefill compute
     disagg: Optional[str] = None
     kv_import: Any = None  # opaque page payload for disagg-decode admission
+    adapter: Optional[str] = None  # LoRA adapter name (None = base model)
+    adapter_idx: int = 0  # resolved slot (engine sets at admission)
     state: SeqState = SeqState.WAITING
     tokens: List[int] = field(default_factory=list)  # prompt + generated
     pages: List[int] = field(default_factory=list)
@@ -183,7 +192,7 @@ class Scheduler:
         use_cache = self.enable_prefix_cache and seq.n_preemptions == 0
         max_shared = (len(prompt) - 1) // PS
         if use_cache:
-            matched_pages, hashes = self.pool.match_prefix(prompt)
+            matched_pages, hashes = self.pool.match_prefix(prompt, _chain_seed(seq))
             # never share the page containing the final prompt token: its
             # logits must be recomputed, so cap the match below it
             while len(matched_pages) > max_shared:
@@ -195,7 +204,7 @@ class Scheduler:
         host_n = 0
         host_hashes: List[int] = []
         if use_cache and self.host_tier is not None and self.host_onboard is not None:
-            all_hashes = block_hashes(prompt, PS)
+            all_hashes = block_hashes(prompt, PS, _chain_seed(seq))
             candidates = all_hashes[len(matched_pages):max_shared]
             host_n = self.host_tier.match(candidates)
             host_hashes = candidates[:host_n]
@@ -377,7 +386,7 @@ class Scheduler:
         n_complete = min(seq.computed_len // PS, len(seq.pages))
         while len(seq.hash_chain) < n_complete:
             i = len(seq.hash_chain)
-            parent = seq.hash_chain[-1] if seq.hash_chain else None
+            parent = seq.hash_chain[-1] if seq.hash_chain else _chain_seed(seq)
             h = hash_block(parent, seq.tokens[i * PS : (i + 1) * PS])
             canonical = self.pool.register(seq.pages[i], h, parent)
             if canonical != seq.pages[i]:

@@ -130,10 +130,16 @@ def spec_rounds(
     page_table: jax.Array,  # [B, MP]
     sampling: SamplingParams,
     step0,
+    lora=None,  # target-model multi-LoRA tree; the draft proposes base-only
+    adapter_idx=None,  # [B]
 ):
     """R speculative rounds fused in one jit. Returns
     (tokens [B, R, gamma+1], counts [B, R], k_pool, v_pool, dk_pool,
-    dv_pool). Page tables must cover positions0 + R*(gamma+1) slots."""
+    dv_pool). Page tables must cover positions0 + R*(gamma+1) slots.
+
+    With LoRA, only the target verify applies adapters (authoritative for
+    the output distribution); the draft proposes from the base model, which
+    costs acceptance rate on heavily-adapted models but never correctness."""
     B = tokens0.shape[0]
 
     def round_body(carry, r):
@@ -176,7 +182,7 @@ def spec_rounds(
         kvl = jnp.where(pos < 0, 0, pos + gamma + 1)
         logits, kp, vp = llama.forward(
             config, params, ver_toks, ver_pos, kp, vp, page_table, kvl,
-            attn_impl=verify_impl,
+            attn_impl=verify_impl, lora=lora, adapter_idx=adapter_idx,
         )  # [B, g+1, V]
         V = logits.shape[-1]
         rep = SamplingParams(

@@ -30,8 +30,10 @@ DEFAULT_CHAT_TEMPLATE = (
 
 
 class Preprocessor:
-    def __init__(self, card: ModelCard, tokenizer: Optional[Tokenizer] = None):
+    def __init__(self, card: ModelCard, tokenizer: Optional[Tokenizer] = None,
+                 adapter: Optional[str] = None):
         self.card = card
+        self.adapter = adapter  # LoRA adapter this entry serves (None = base)
         self.tokenizer = tokenizer or load_tokenizer(card.tokenizer)
         self._jinja = jinja2.Environment()
         self._template = self._jinja.from_string(card.chat_template or DEFAULT_CHAT_TEMPLATE)
@@ -87,6 +89,7 @@ class Preprocessor:
             sampling=self._sampling(req),
             stop=self._stop(req, len(ids)),
             annotations={"kind": "chat"},
+            adapter=self.adapter,
         )
 
     def preprocess_completions(self, req: Dict[str, Any]) -> Dict[str, Any]:
@@ -102,6 +105,7 @@ class Preprocessor:
             sampling=self._sampling(req),
             stop=self._stop(req, len(ids)),
             annotations={"kind": "completions"},
+            adapter=self.adapter,
         )
 
     def _check_context(self, prompt_len: int) -> None:

@@ -24,6 +24,7 @@ class ModelCard:
     context_length: int = 8192
     kv_block_size: int = 16
     model_type: str = "completions"  # completions | embeddings
+    adapters: List[str] = field(default_factory=list)  # served LoRA names
     runtime_config: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -59,14 +60,18 @@ def make_preprocessed_request(
     sampling: SamplingOptions,
     stop: StopConditions,
     annotations: Optional[Dict[str, Any]] = None,
+    adapter: Optional[str] = None,
 ) -> Dict[str, Any]:
-    return {
+    out = {
         "model": model,
         "token_ids": token_ids,
         "sampling": asdict(sampling),
         "stop": asdict(stop),
         "annotations": annotations or {},
     }
+    if adapter:
+        out["adapter"] = adapter
+    return out
 
 
 # Engine output stream item keys (worker → frontend):

@@ -42,13 +42,16 @@ class ModelEntry:
     prefill_router: Any = None  # PrefillRouter operator in the chain
     prefill_client: Any = None
     prefill_instance_ids: Set[int] = field(default_factory=set)
+    owns_client: bool = True  # False for LoRA adapter entries (shared client)
+    adapter_names: Set[str] = field(default_factory=set)  # entries this base spawned
 
     async def close(self) -> None:
         if self.teardown is not None:
             await self.teardown()
         if self.prefill_client is not None:
             await self.prefill_client.close()
-        await self.client.close()
+        if self.owns_client:
+            await self.client.close()
 
 
 class ModelManager:
@@ -175,9 +178,41 @@ class ModelWatcher:
             )
             self.manager.models[card.name] = entry
             log.info("model %s added (endpoint %s)", card.name, entry.endpoint_path)
+            # LoRA adapters served by this worker: each becomes a servable
+            # model name whose preprocessor stamps the adapter into requests
+            # (parity with reference lora-modules-as-models discovery)
+            import dataclasses as _dc
+
+            for aname in card.adapters or []:
+                if aname in self.manager.models:
+                    entry.adapter_names.add(aname)
+                    continue
+                acard = _dc.replace(card, name=aname, adapters=[])
+                apre = Preprocessor(acard, tokenizer=pre.tokenizer, adapter=aname)
+                amade = self._chain_factory(acard, client, apre)
+                if isinstance(amade, tuple):
+                    achain, ateardown, aprefill = (list(amade) + [None, None])[:3]
+                else:
+                    achain, ateardown, aprefill = amade, None, None
+                self.manager.models[aname] = ModelEntry(
+                    card=acard,
+                    endpoint_path=entry.endpoint_path,
+                    preprocessor=apre,
+                    client=client,
+                    chain=achain,
+                    teardown=ateardown,
+                    prefill_router=aprefill,
+                    owns_client=False,
+                )
+                entry.adapter_names.add(aname)
+                log.info("adapter %s added (base %s)", aname, card.name)
             for pending in self._pending_prefill.pop(card.name, []):
                 await self._on_prefill_put(card, pending)
         entry.instance_ids.add(inst.instance_id)
+        for aname in entry.adapter_names:
+            aentry = self.manager.models.get(aname)
+            if aentry is not None:
+                aentry.instance_ids.add(inst.instance_id)
         self._ready.set()
 
     async def _on_prefill_put(self, card: ModelCard, inst) -> None:
@@ -195,6 +230,11 @@ class ModelWatcher:
                 f"{inst.endpoint_address.component}/kv_fetch"
             )
             entry.prefill_router.activate(entry.prefill_client, fetch_path)
+            # adapter entries disaggregate too, sharing the prefill client
+            for aname in entry.adapter_names:
+                aentry = self.manager.models.get(aname)
+                if aentry is not None and aentry.prefill_router is not None:
+                    aentry.prefill_router.activate(entry.prefill_client, fetch_path)
         entry.prefill_instance_ids.add(inst.instance_id)
 
     async def _on_delete(self, card: ModelCard, inst) -> None:
@@ -205,11 +245,23 @@ class ModelWatcher:
             entry.prefill_instance_ids.discard(inst.instance_id)
             if not entry.prefill_instance_ids and entry.prefill_router is not None:
                 entry.prefill_router.deactivate()
+                for aname in entry.adapter_names:
+                    aentry = self.manager.models.get(aname)
+                    if aentry is not None and aentry.prefill_router is not None:
+                        aentry.prefill_router.deactivate()
                 if entry.prefill_client is not None:
                     await entry.prefill_client.close()
                     entry.prefill_client = None
             return
         entry.instance_ids.discard(inst.instance_id)
+        for aname in list(entry.adapter_names):
+            aentry = self.manager.models.get(aname)
+            if aentry is None:
+                continue
+            aentry.instance_ids.discard(inst.instance_id)
+            if not aentry.instance_ids:
+                await aentry.close()
+                del self.manager.models[aname]
         if not entry.instance_ids:
             await entry.close()
             del self.manager.models[card.name]

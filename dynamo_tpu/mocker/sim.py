@@ -61,7 +61,7 @@ class SimRunner:
         self.vocab_size = vocab_size
 
     # -- ModelRunner interface ---------------------------------------------
-    def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int):
+    def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int, adapter: int = 0):
         t = self.timing
         t.sleep(t.prefill_base_s + len(tokens) * t.prefill_per_token_s)
         # "logits": seeded by the LAST prompt token + position only, so the
@@ -77,7 +77,7 @@ class SimRunner:
 
     def decode_multi(
         self, n_steps: int, tokens: List[int], positions: List[int],
-        page_tables, sampling, step: int,
+        page_tables, sampling, step: int, adapters=None,
     ) -> np.ndarray:
         t = self.timing
         t.sleep(

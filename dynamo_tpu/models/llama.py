@@ -202,6 +202,8 @@ def forward(
     mesh=None,  # jax.sharding.Mesh, required for attn_impl="ring"
     sp_has_prior: bool = True,  # ring: False skips the paged prior-context
     #   pass entirely (fresh prefill — the common SP case)
+    lora: Optional[Params] = None,  # stacked multi-adapter tree (models/lora.py)
+    adapter_idx: Optional[jax.Array] = None,  # [B] slot per sequence (0=base)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass (covers prefill chunks S>1 and decode S=1).
 
@@ -230,13 +232,26 @@ def forward(
 
         h = lax.with_sharding_constraint(h, NamedSharding(mesh, _P(None, "seq", None)))
 
+    lora_layers = (lora or {}).get("layers", {})
+
     def layer(carry, xs):
         h, k_pool, v_pool = carry
-        lp, l_idx = xs
+        lp, ll, l_idx = xs
+
+        def lproj(y, x, name):
+            """y = x @ W (+ per-sequence LoRA delta x @ A[a] @ B[a])."""
+            a = ll.get(name + "_a")
+            if a is None:
+                return y
+            Ag = a[adapter_idx]  # [B, in, r]
+            Bg = ll[name + "_b"][adapter_idx]  # [B, r, out]
+            z = jnp.einsum("bsi,bir->bsr", x, Ag)
+            return y + jnp.einsum("bsr,bro->bso", z, Bg)
+
         x = rms_norm(h, lp["attn_norm"], c.norm_eps)
-        q = (x @ lp["wq"]).reshape(B, S, c.n_heads, hd)
-        k = (x @ lp["wk"]).reshape(B, S, c.n_kv_heads, hd)
-        v = (x @ lp["wv"]).reshape(B, S, c.n_kv_heads, hd)
+        q = lproj(x @ lp["wq"], x, "wq").reshape(B, S, c.n_heads, hd)
+        k = lproj(x @ lp["wk"], x, "wk").reshape(B, S, c.n_kv_heads, hd)
+        v = lproj(x @ lp["wv"], x, "wv").reshape(B, S, c.n_kv_heads, hd)
         q = rope(q, safe_pos, c.rope_theta)
         k = rope(k, safe_pos, c.rope_theta)
 
@@ -291,20 +306,21 @@ def forward(
         else:
             attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
         attn = attn.reshape(B, S, c.n_heads * hd)
-        h = h + attn @ lp["wo"]
+        h = h + lproj(attn @ lp["wo"], attn, "wo")
 
         x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
         if c.is_moe:
             h = h + _moe_block(c, lp, x)
         else:
-            gate = jax.nn.silu(x @ lp["w_gate"])
-            h = h + (gate * (x @ lp["w_up"])) @ lp["w_down"]
+            gate = jax.nn.silu(lproj(x @ lp["w_gate"], x, "w_gate"))
+            up = lproj(x @ lp["w_up"], x, "w_up")
+            h = h + lproj((gate * up) @ lp["w_down"], gate * up, "w_down")
         return (h, k_pool, v_pool), None
 
     (h, k_pool, v_pool), _ = lax.scan(
         layer,
         (h, k_pool, v_pool),
-        (params["layers"], jnp.arange(c.n_layers, dtype=jnp.int32)),
+        (params["layers"], lora_layers, jnp.arange(c.n_layers, dtype=jnp.int32)),
     )
 
     h = rms_norm(h, params["norm_f"], c.norm_eps)

@@ -1,0 +1,169 @@
+"""Multi-LoRA adapter parameters for the Llama-family engine.
+
+Batched multi-adapter serving, TPU-first: every registered adapter's
+low-rank factors are stacked on an [n_slots] axis (slot 0 is the
+all-zero base slot), and each sequence carries an adapter index. The
+forward pass gathers that sequence's (A, B) per layer and adds
+x @ A @ B to the base projection — one pair of small einsums per target, so
+a decode batch freely mixes adapters with no per-adapter dispatch (the
+S-LoRA/punica batching model, expressed as XLA gathers instead of custom
+CUDA kernels).
+
+The reference serves LoRA through its engines' adapter support surfaced in
+model discovery (vLLM --lora-modules; adapters published as model names);
+parity here: ModelCard.adapters lists adapter names, the frontend registers
+each as a servable model, and requests carry `adapter` through the plane.
+
+Layout per target projection t with base weight [L, in, out]:
+  {t}_a: [L, n_slots, in, r]   {t}_b: [L, n_slots, r, out]
+(L leading so the layer scan slices adapters alongside base weights).
+alpha/rank scaling is folded into B at registration time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+# target name -> (in_dim, out_dim) resolvers
+def _target_dims(c: ModelConfig) -> Dict[str, tuple]:
+    hd = c.head_dim
+    dims = {
+        "wq": (c.dim, c.n_heads * hd),
+        "wk": (c.dim, c.n_kv_heads * hd),
+        "wv": (c.dim, c.n_kv_heads * hd),
+        "wo": (c.n_heads * hd, c.dim),
+    }
+    if not c.is_moe:
+        dims.update(
+            {
+                "w_gate": (c.dim, c.ffn_dim),
+                "w_up": (c.dim, c.ffn_dim),
+                "w_down": (c.ffn_dim, c.dim),
+            }
+        )
+    return dims
+
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora_params(
+    config: ModelConfig,
+    n_slots: int,
+    rank: int = 8,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    dtype=None,
+) -> Dict[str, Any]:
+    """Zero-initialized stacked adapter tree ({"layers": {...}}); slot 0 is
+    the base (stays all-zero). Registration fills slots 1..n_slots-1."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    dims = _target_dims(config)
+    L = config.n_layers
+    layers: Dict[str, Any] = {}
+    for t in targets:
+        di, do = dims[t]
+        layers[t + "_a"] = jnp.zeros((L, n_slots, di, rank), dtype)
+        layers[t + "_b"] = jnp.zeros((L, n_slots, rank, do), dtype)
+    return {"layers": layers}
+
+
+def random_adapter(
+    config: ModelConfig,
+    rank: int = 8,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+    seed: int = 0,
+    scale: float = 1.0,
+) -> Dict[str, np.ndarray]:
+    """A synthetic non-zero adapter (tests/dev): {"{t}_a": [L, in, r],
+    "{t}_b": [L, r, out]} with both factors random so outputs actually
+    change."""
+    rng = np.random.default_rng(seed)
+    dims = _target_dims(config)
+    L = config.n_layers
+    out: Dict[str, np.ndarray] = {}
+    for t in targets:
+        di, do = dims[t]
+        out[t + "_a"] = (rng.standard_normal((L, di, rank)) * (di**-0.5)).astype(np.float32)
+        out[t + "_b"] = (rng.standard_normal((L, rank, do)) * scale * (rank**-0.5)).astype(
+            np.float32
+        )
+    return out
+
+
+def set_adapter_slot(lora: Dict[str, Any], slot: int, adapter: Dict[str, np.ndarray]):
+    """Write one adapter's factors into a slot of the stacked tree (host →
+    device .at[].set; alpha/rank scaling must already be folded into B)."""
+    import jax.numpy as jnp
+
+    layers = dict(lora["layers"])
+    unknown = [n for n in adapter if n not in layers]
+    if unknown:
+        raise ValueError(
+            f"adapter factors {unknown} target projections the stacked tree "
+            f"was not built for (targets {sorted({k[:-2] for k in layers})}); "
+            "build the runner with lora_targets covering them"
+        )
+    for name, arr in adapter.items():
+        layers[name] = layers[name].at[:, slot].set(jnp.asarray(arr, layers[name].dtype))
+    return {"layers": layers}
+
+
+def load_peft_adapter(adapter_dir: str, config: ModelConfig) -> Dict[str, np.ndarray]:
+    """Load a HuggingFace PEFT LoRA checkpoint (adapter_model.safetensors +
+    adapter_config.json) into the per-adapter factor dict, with alpha/rank
+    folded into B. HF stores lora_A [r, in] and lora_B [out, r] (torch
+    convention); ours are transposed."""
+    import json
+    from pathlib import Path
+
+    from safetensors import safe_open
+
+    d = Path(adapter_dir)
+    cfg = json.loads((d / "adapter_config.json").read_text())
+    rank = int(cfg["r"])
+    scaling = float(cfg.get("lora_alpha", rank)) / rank
+    hf_to_ours = {
+        "q_proj": "wq", "k_proj": "wk", "v_proj": "wv", "o_proj": "wo",
+        "gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down",
+    }
+    files = sorted(d.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {adapter_dir}")
+    tensors: Dict[str, np.ndarray] = {}
+    for f in files:
+        with safe_open(str(f), framework="numpy") as h:
+            for name in h.keys():
+                tensors[name] = h.get_tensor(name)
+
+    L = config.n_layers
+    out: Dict[str, List[Optional[np.ndarray]]] = {}
+    for name, arr in tensors.items():
+        # ...model.layers.{i}.self_attn.q_proj.lora_A.weight
+        parts = name.split(".")
+        try:
+            i = parts.index("layers")
+            layer = int(parts[i + 1])
+            proj = next(p for p in parts if p in hf_to_ours)
+            which = "a" if "lora_A" in name else "b"
+        except (ValueError, StopIteration, IndexError):
+            continue
+        t = hf_to_ours[proj]
+        key = f"{t}_{which}"
+        out.setdefault(key, [None] * L)
+        mat = np.ascontiguousarray(arr.T).astype(np.float32)  # → [in,r]/[r,out]
+        if which == "b":
+            mat = mat * scaling
+        out[key][layer] = mat
+
+    stacked: Dict[str, np.ndarray] = {}
+    for key, mats in out.items():
+        if any(m is None for m in mats):
+            raise ValueError(f"adapter {adapter_dir}: missing layers for {key}")
+        stacked[key] = np.stack(mats)
+    return stacked

@@ -75,6 +75,15 @@ class ShardingPolicy:
         """Spec by parameter name. Per-layer weights are stacked on a
         leading [n_layers] axis (models/llama.py), so layer params carry a
         leading None."""
+        # LoRA factors [L, n_slots, in, r] / [L, n_slots, r, out]: shard the
+        # dim that matches the target's megatron split; the rank dim and the
+        # tiny opposite factor stay replicated
+        if path.endswith(("wo_a", "w_down_a")):
+            return P(None, None, AXIS_MODEL, None)  # in sharded (row-parallel target)
+        if path.endswith(("wq_b", "wk_b", "wv_b", "w_gate_b", "w_up_b")):
+            return P(None, None, None, AXIS_MODEL)  # out sharded (column-parallel)
+        if path.endswith(("_a", "_b")):
+            return P()
         if path.endswith(("wq", "wk", "wv", "w_gate", "w_up")):
             return P(None, None, AXIS_MODEL)  # [L, E, out] column parallel
         if path.endswith(("wo", "w_down")):

@@ -113,9 +113,17 @@ class KvRouter:
             out.extend((inst.instance_id, r) for r in range(dp))
         return sorted(out)
 
-    def find_best_match(self, token_ids: List[int]) -> Tuple[Worker, int, List[int]]:
-        """Returns (worker, overlap_blocks, block_hashes)."""
-        hashes = block_hashes(token_ids, self.block_size)
+    def find_best_match(
+        self, token_ids: List[int], adapter: Optional[str] = None
+    ) -> Tuple[Worker, int, List[int]]:
+        """Returns (worker, overlap_blocks, block_hashes). `adapter` seeds
+        the hash chain exactly like the worker scheduler does, so LoRA
+        requests score overlap only against their own adapter's cached
+        blocks (never false-matching base-model KV)."""
+        from dynamo_tpu.tokens.hashing import adapter_seed
+
+        seed = adapter_seed(adapter) if adapter else None
+        hashes = block_hashes(token_ids, self.block_size, seed)
         overlaps = self.indexer.index.find_matches(hashes)
         host_overlaps = self.indexer.host_index.find_matches(hashes).scores
         workers = self.workers()
@@ -155,7 +163,9 @@ class KvPushRouter:
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
         await self.router.start()
         token_ids = request.get("token_ids") or []
-        worker, overlap, hashes = self.router.find_best_match(token_ids)
+        worker, overlap, hashes = self.router.find_best_match(
+            token_ids, adapter=request.get("adapter")
+        )
         rid = context.id
         self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap

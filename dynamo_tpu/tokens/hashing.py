@@ -29,11 +29,22 @@ def hash_block(parent_hash: Optional[int], tokens: Sequence[int]) -> int:
     return struct.unpack("<Q", h.digest())[0]
 
 
-def block_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
-    """Hashes for every *complete* block of `tokens`."""
+def block_hashes(
+    tokens: Sequence[int], block_size: int, parent: Optional[int] = None
+) -> List[int]:
+    """Hashes for every *complete* block of `tokens`. `parent` seeds the
+    chain — used to salt per-adapter KV (LoRA changes K/V projections, so
+    equal tokens under different adapters must never share cache blocks)."""
     out: List[int] = []
-    parent: Optional[int] = None
     for i in range(len(tokens) // block_size):
         parent = hash_block(parent, tokens[i * block_size : (i + 1) * block_size])
         out.append(parent)
     return out
+
+
+def adapter_seed(name: str) -> int:
+    """Chain seed for a LoRA adapter: block hashes of adapter-attributed
+    sequences live in a disjoint lineage from base-model hashes."""
+    h = hashlib.blake2b(digest_size=8, key=BLOCK_HASH_SEED)
+    h.update(b"lora:" + name.encode())
+    return struct.unpack("<Q", h.digest())[0]

@@ -58,12 +58,66 @@ def parse_args(argv=None):
                    help="HF safetensors dir for draft weights")
     p.add_argument("--spec-gamma", type=int, default=4,
                    help="draft tokens proposed per target verify pass")
+    # multi-LoRA
+    p.add_argument("--lora", action="append", default=[],
+                   help="serve a LoRA adapter: NAME=<peft_dir> (HF PEFT "
+                        "safetensors) or bare NAME (random factors, dev). "
+                        "Repeatable; each name becomes a servable model.")
+    p.add_argument("--lora-rank", type=int, default=8,
+                   help="rank for randomly-initialized dev adapters")
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
     return p.parse_args(argv)
+
+
+def _lora_kwargs(args, config) -> dict:
+    """Load every --lora spec up front: duplicate names are an error (a
+    repeat would silently keep the first checkpoint's weights), and the
+    stacked tree's targets are the union of what the checkpoints actually
+    adapt (a PEFT adapter touching MLP projections must not be silently
+    half-applied)."""
+    if not args.lora:
+        return {}
+    from dynamo_tpu.models import lora as lora_mod
+
+    names = [s.partition("=")[0] for s in args.lora]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise SystemExit(f"duplicate --lora adapter names: {sorted(dupes)}")
+    loaded = []
+    targets = set()
+    for i, spec in enumerate(args.lora):
+        name, _, path = spec.partition("=")
+        if path:
+            factors = lora_mod.load_peft_adapter(path, config)
+        else:
+            factors = lora_mod.random_adapter(config, rank=args.lora_rank, seed=100 + i)
+        targets.update(k[:-2] for k in factors)
+        loaded.append((name, factors))
+    # mixed-rank checkpoints share one stacked tree: zero-pad factors up to
+    # the max rank (padded rows/cols contribute nothing to A @ B)
+    import numpy as np
+
+    rank = max(
+        [args.lora_rank] + [f[k].shape[-1] for _, f in loaded for k in f if k.endswith("_a")]
+    )
+    for _, factors in loaded:
+        for k, arr in list(factors.items()):
+            r = arr.shape[-1] if k.endswith("_a") else arr.shape[-2]
+            if r == rank:
+                continue
+            pad = [(0, 0)] * arr.ndim
+            pad[-1 if k.endswith("_a") else -2] = (0, rank - r)
+            factors[k] = np.pad(arr, pad)
+    args._lora_factors = loaded
+    return {
+        "lora_slots": len(loaded),
+        "lora_rank": rank,
+        "lora_targets": tuple(sorted(targets)),
+    }
 
 
 def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
@@ -103,7 +157,10 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         draft_config=draft_config,
         draft_params=draft_params,
         spec_gamma=args.spec_gamma,
+        **_lora_kwargs(args, config),
     )
+    for name, factors in getattr(args, "_lora_factors", []):
+        runner.register_adapter(name, factors)
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         host_kv_blocks=args.host_kv_blocks,
@@ -114,6 +171,7 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         tokenizer=args.tokenizer,
         context_length=args.max_seq_len,
         kv_block_size=args.page_size,
+        adapters=[s.partition("=")[0] for s in args.lora],
         runtime_config={
             "mesh": list(mesh.shape),
             "num_pages": args.num_pages,
