@@ -29,6 +29,9 @@ def parse_args(argv=None):
         help="worker selection policy (kv = KV-cache-aware)",
     )
     p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--router-replica-sync", action="store_true",
+                   help="broadcast router load deltas so parallel frontend "
+                        "replicas share one load view (kv mode)")
     p.add_argument("--disagg-min-prefill-tokens", type=int, default=256,
                    help="prompts at least this long go to prefill workers when present")
     p.add_argument("--busy-threshold", type=int, default=0,
@@ -49,6 +52,7 @@ async def async_main(args) -> None:
     manager = ModelManager()
     watcher = ModelWatcher(
         runtime, manager, router_mode=args.router_mode,
+        router_replica_sync=args.router_replica_sync,
         migration_limit=args.migration_limit,
         disagg_min_prefill_tokens=args.disagg_min_prefill_tokens,
     )

@@ -359,6 +359,32 @@ class HttpService:
         async def send(payload: Dict[str, Any]) -> None:
             await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
 
+        # tool-enabled chats buffer the text: the call markup only parses
+        # complete, and OpenAI clients expect tool_calls deltas, not raw
+        # markup fragments (incremental tool-call streaming: later round)
+        buffer_tools = kind == "chat" and (preprocessed.get("annotations") or {}).get("tools")
+        buffered: list = []
+        tools_flushed = False
+
+        async def flush_tools(finish_reason) -> None:
+            nonlocal tools_flushed
+            tools_flushed = True
+            from dynamo_tpu.frontend.tool_calls import parse_tool_calls
+
+            content, calls = parse_tool_calls("".join(buffered))
+            if calls:
+                delta = {"tool_calls": [
+                    {**c, "index": i} for i, c in enumerate(calls)
+                ]}
+                if content:
+                    delta["content"] = content
+                await send(_chat_chunk(rid, model, created, delta, "tool_calls"))
+            else:
+                await send(_chat_chunk(
+                    rid, model, created,
+                    {"content": content} if content else {}, finish_reason,
+                ))
+
         try:
             if kind == "chat":
                 await send(_chat_chunk(rid, model, created, {"role": "assistant"}, None))
@@ -369,6 +395,12 @@ class HttpService:
                     timing.on_tokens(len(item.get("token_ids") or []))
                     if finish:
                         timing.finish_reason = finish
+                if buffer_tools:
+                    buffered.append(text)
+                    if finish:
+                        await flush_tools(finish)
+                        break
+                    continue
                 if text or finish:
                     if kind == "chat":
                         delta = {"content": text} if text else {}
@@ -387,6 +419,10 @@ class HttpService:
                         )
                 if finish:
                     break
+            if buffer_tools and not tools_flushed:
+                # generator ended without a finish_reason (drain/migration
+                # edge): the buffered text must still reach the client
+                await flush_tools("stop")
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()  # client disconnected (reference disconnect.rs)
@@ -429,6 +465,18 @@ class HttpService:
             "total_tokens": n_prompt + n_out,
         }
         if kind == "chat":
+            message: Dict[str, Any] = {"role": "assistant", "content": text}
+            if (preprocessed.get("annotations") or {}).get("tools"):
+                from dynamo_tpu.frontend.tool_calls import parse_tool_calls
+
+                content, calls = parse_tool_calls(text)
+                if calls:
+                    message = {
+                        "role": "assistant",
+                        "content": content or None,
+                        "tool_calls": calls,
+                    }
+                    finish = "tool_calls"
             body = {
                 "id": rid,
                 "object": "chat.completion",
@@ -437,7 +485,7 @@ class HttpService:
                 "choices": [
                     {
                         "index": 0,
-                        "message": {"role": "assistant", "content": text},
+                        "message": message,
                         "finish_reason": finish or "stop",
                     }
                 ],

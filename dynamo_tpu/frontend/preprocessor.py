@@ -22,8 +22,15 @@ from dynamo_tpu.frontend.protocols import (
 from dynamo_tpu.frontend.tokenizer import Tokenizer, load_tokenizer
 
 DEFAULT_CHAT_TEMPLATE = (
+    "{% if tools %}"
+    "system: You may call tools. Available tools: {{ tools | tojson }}\n"
+    "To call one, reply with <tool_call>{\"name\": ..., \"arguments\": {...}}"
+    "</tool_call>\n"
+    "{% endif %}"
     "{% for message in messages %}"
-    "{{ message['role'] }}: {{ message['content'] }}\n"
+    "{{ message['role'] }}: "
+    "{% if message.get('tool_calls') %}{{ message['tool_calls'] | tojson }}"
+    "{% else %}{{ message['content'] }}{% endif %}\n"
     "{% endfor %}"
     "assistant:"
 )
@@ -39,8 +46,12 @@ class Preprocessor:
         self._template = self._jinja.from_string(card.chat_template or DEFAULT_CHAT_TEMPLATE)
 
     # -- prompt assembly ---------------------------------------------------
-    def render_chat(self, messages: List[Dict[str, Any]]) -> str:
-        return self._template.render(messages=messages, add_generation_prompt=True)
+    def render_chat(
+        self, messages: List[Dict[str, Any]], tools: Optional[List[Dict[str, Any]]] = None
+    ) -> str:
+        return self._template.render(
+            messages=messages, tools=tools, add_generation_prompt=True
+        )
 
     def tokenize_prompt(self, prompt: str, add_bos: bool = True) -> List[int]:
         ids = self.tokenizer.encode(prompt)
@@ -80,15 +91,20 @@ class Preprocessor:
         )
 
     def preprocess_chat(self, req: Dict[str, Any]) -> Dict[str, Any]:
-        prompt = self.render_chat(req.get("messages") or [])
+        tools = req.get("tools") or None
+        prompt = self.render_chat(req.get("messages") or [], tools=tools)
         ids = self.tokenize_prompt(prompt)
         self._check_context(len(ids))
+        annotations: Dict[str, Any] = {"kind": "chat"}
+        if tools:
+            # response assembly runs the tool-call parser on the output
+            annotations["tools"] = True
         return make_preprocessed_request(
             model=req.get("model", self.card.name),
             token_ids=ids,
             sampling=self._sampling(req),
             stop=self._stop(req, len(ids)),
-            annotations={"kind": "chat"},
+            annotations=annotations,
             adapter=self.adapter,
         )
 

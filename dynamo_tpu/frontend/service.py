@@ -82,6 +82,7 @@ class ModelWatcher:
         runtime: DistributedRuntime,
         manager: ModelManager,
         router_mode: str = RouterMode.ROUND_ROBIN,
+        router_replica_sync: bool = False,
         migration_limit: int = 3,
         chain_factory=None,
         disagg_min_prefill_tokens: int = 256,
@@ -89,6 +90,7 @@ class ModelWatcher:
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
+        self.router_replica_sync = router_replica_sync
         self.migration_limit = migration_limit
         self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
         self._task: Optional[asyncio.Task] = None
@@ -108,7 +110,10 @@ class ModelWatcher:
         if self.router_mode == "kv":
             from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
 
-            kv_router = KvRouter(self.runtime, client, block_size=card.kv_block_size)
+            kv_router = KvRouter(
+                self.runtime, client, block_size=card.kv_block_size,
+                replica_sync=self.router_replica_sync,
+            )
             router_engine: AsyncEngine = KvPushRouter(kv_router)
             teardown = kv_router.stop
         else:

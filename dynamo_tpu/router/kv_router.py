@@ -43,6 +43,7 @@ class KvRouter:
         config: Optional[KvRouterConfig] = None,
         use_kv_events: bool = True,
         approx_ttl: float = 120.0,
+        replica_sync: bool = False,
     ):
         self.runtime = runtime
         self.client = client
@@ -57,6 +58,19 @@ class KvRouter:
             ttl=None if use_kv_events else approx_ttl,
         )
         self._started = False
+        # replica sync (reference kv_router router-replica-sync): frontends
+        # running parallel router replicas broadcast add/prefill_done/free
+        # deltas so every replica's load view includes the others' in-flight
+        # requests (worker KV state already converges via kv_events)
+        self.replica_sync = replica_sync
+        import uuid as _uuid
+
+        self._replica_id = _uuid.uuid4().hex[:16]
+        self._sync_pub = None
+        self._sync_sub = None
+        self._sync_inst = None
+        self._sync_tasks: List[asyncio.Task] = []
+        self._peer_requests: Dict[str, set] = {}  # replica -> remote rids
 
     async def start(self) -> None:
         if self._started:
@@ -68,6 +82,99 @@ class KvRouter:
             await self.indexer.start()
             for inst in list(self.client.instances.values()):
                 await self._connect_worker(inst)
+        if self.replica_sync:
+            await self._start_replica_sync()
+
+    # -- replica sync -------------------------------------------------------
+    async def _start_replica_sync(self) -> None:
+        from dynamo_tpu.runtime.component import Instance
+        from dynamo_tpu.runtime.event_plane import SEQ_SYNC_SUBJECT
+
+        self._sync_pub = self.runtime.event_publisher()
+        self._sync_sub = self.runtime.event_subscriber([SEQ_SYNC_SUBJECT])
+        self._sync_inst = Instance(
+            namespace="_sys",
+            component="router_sync",
+            endpoint="seq",
+            instance_id=int(self._replica_id[:15], 16),
+            metadata={"publisher": self._sync_pub.address,
+                      "replica": self._replica_id},
+        )
+        await self.runtime.discovery.register(self._sync_inst)
+        self._sync_tasks = [
+            asyncio.create_task(self._peer_watch()),
+            asyncio.create_task(self._sync_loop()),
+        ]
+
+    async def _peer_watch(self) -> None:
+        try:
+            async for ev in self.runtime.discovery.watch("services/_sys/router_sync/"):
+                inst = ev.instance
+                if inst.instance_id == self._sync_inst.instance_id:
+                    continue
+                addr = (inst.metadata or {}).get("publisher")
+                if not addr:
+                    continue
+                if ev.kind == "put":
+                    self._sync_sub.connect(addr)
+                else:
+                    self._sync_sub.disconnect(addr)
+                    # dead replica: release every request it had charged, or
+                    # its load is attributed to workers forever
+                    replica = (inst.metadata or {}).get("replica")
+                    for rid in self._peer_requests.pop(replica, set()):
+                        self.sequences.free(rid)
+        except asyncio.CancelledError:
+            pass
+
+    async def _sync_loop(self) -> None:
+        from dynamo_tpu.runtime.event_plane import SEQ_SYNC_SUBJECT
+
+        try:
+            async for subject, payload in self._sync_sub.events():
+                if subject != SEQ_SYNC_SUBJECT:
+                    continue
+                replica = payload.get("replica")
+                if replica == self._replica_id:
+                    continue
+                rid = f"{replica}:{payload['rid']}"
+                op = payload["op"]
+                if op == "add":
+                    self.sequences.add_request(
+                        rid, tuple(payload["worker"]), payload["blocks"],
+                        payload["overlap"],
+                    )
+                    self._peer_requests.setdefault(replica, set()).add(rid)
+                elif op == "prefill_done":
+                    self.sequences.mark_prefill_completed(rid)
+                elif op == "free":
+                    self.sequences.free(rid)
+                    self._peer_requests.get(replica, set()).discard(rid)
+        except asyncio.CancelledError:
+            pass
+
+    def _publish_sync(self, op: str, rid: str, worker=None, blocks=0, overlap=0) -> None:
+        if self._sync_pub is None:
+            return
+        from dynamo_tpu.runtime.event_plane import SEQ_SYNC_SUBJECT
+
+        payload = {"replica": self._replica_id, "op": op, "rid": rid,
+                   "worker": list(worker) if worker else None,
+                   "blocks": blocks, "overlap": overlap}
+        # hold a strong ref until done (the loop keeps only weak refs) and
+        # surface publish errors instead of 'never retrieved' warnings
+        task = asyncio.get_running_loop().create_task(
+            self._sync_pub.publish(SEQ_SYNC_SUBJECT, payload)
+        )
+        self._sync_tasks.append(task)
+        def _done(t, tasks=self._sync_tasks):
+            try:
+                tasks.remove(t)
+            except ValueError:
+                pass
+            if not t.cancelled() and t.exception() is not None:
+                log.warning("seq_sync publish failed: %s", t.exception())
+        task.add_done_callback(_done)
 
     def _on_instance(self, kind: str, inst) -> None:
         worker = (inst.instance_id, 0)
@@ -138,6 +245,7 @@ class KvRouter:
         self, request_id: str, worker: Worker, hashes: List[int], overlap: int
     ) -> None:
         self.sequences.add_request(request_id, worker, len(hashes), overlap)
+        self._publish_sync("add", request_id, worker, len(hashes), overlap)
         if not self.use_kv_events and hashes:
             # approximate mode: predict the worker will cache these blocks
             ev = RouterEvent(worker=worker, event_id=0, kind="store",
@@ -146,11 +254,27 @@ class KvRouter:
 
     def mark_prefill_completed(self, request_id: str) -> None:
         self.sequences.mark_prefill_completed(request_id)
+        self._publish_sync("prefill_done", request_id)
 
     def free(self, request_id: str) -> None:
         self.sequences.free(request_id)
+        self._publish_sync("free", request_id)
 
     async def stop(self) -> None:
+        tasks = list(self._sync_tasks)
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if self._sync_inst is not None:
+            try:
+                await self.runtime.discovery.unregister(self._sync_inst)
+            except Exception:
+                pass
+        if self._sync_sub is not None:
+            await self._sync_sub.close()
+        # _sync_pub is the runtime-owned singleton publisher; the runtime
+        # closes it at shutdown
         await self.indexer.stop()
 
 

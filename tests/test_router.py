@@ -247,3 +247,46 @@ async def test_kv_router_e2e_load_spreads_distinct_prompts():
         for rt, w in workers:
             await w.stop()
             await rt.shutdown(drain_timeout=1)
+
+
+async def test_replica_sync_shares_load_view():
+    """Two router replicas: requests routed by A must appear in B's load
+    view (and be released on free), so parallel frontends don't all pick
+    the same 'idle' worker."""
+    import asyncio
+
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EchoEngine
+
+    from dynamo_tpu.router.kv_router import KvRouter
+
+    realm = "replica-sync"
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    await wrt.serve_endpoint("dyn/w/generate", EchoEngine(), metadata={})
+
+    async def mk_router():
+        rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+        client = rt.client("dyn/w/generate")
+        r = KvRouter(rt, client, block_size=4, use_kv_events=False, replica_sync=True)
+        await r.start()
+        return rt, r
+
+    rt_a, ra = await mk_router()
+    rt_b, rb = await mk_router()
+    try:
+        await asyncio.sleep(0.3)  # peer discovery
+        worker = ra.workers()[0]
+        ra.add_request("req-1", worker, [1, 2, 3, 4], 0)
+        await asyncio.sleep(0.3)
+        assert rb.sequences.active_requests(worker) == 1, "B must see A's request"
+        ra.free("req-1")
+        await asyncio.sleep(0.3)
+        assert rb.sequences.active_requests(worker) == 0
+        assert ra.sequences.active_requests(worker) == 0
+    finally:
+        await ra.stop()
+        await rb.stop()
+        await rt_a.shutdown(drain_timeout=1)
+        await rt_b.shutdown(drain_timeout=1)
+        await wrt.shutdown(drain_timeout=1)

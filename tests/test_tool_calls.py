@@ -1,0 +1,223 @@
+"""Tool calling: parser formats, template injection, and HTTP-level chat
+responses (unary + streaming) with `tool_calls` / finish_reason."""
+
+import json
+
+import aiohttp
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.frontend.preprocessor import Preprocessor
+from dynamo_tpu.frontend.protocols import ModelCard, engine_output
+from dynamo_tpu.frontend.tool_calls import parse_tool_calls
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+# -- parsers ----------------------------------------------------------------
+
+
+def test_parse_hermes():
+    text = 'sure!\n<tool_call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>'
+    content, calls = parse_tool_calls(text)
+    assert content == "sure!"
+    assert len(calls) == 1
+    f = calls[0]["function"]
+    assert f["name"] == "get_weather"
+    assert json.loads(f["arguments"]) == {"city": "SF"}
+    assert calls[0]["id"].startswith("call_")
+
+
+def test_parse_mistral_multiple():
+    text = '[TOOL_CALLS] [{"name": "a", "arguments": {}}, {"name": "b", "arguments": {"x": 1}}]'
+    content, calls = parse_tool_calls(text)
+    assert content == ""
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+def test_parse_llama3_json_with_python_tag():
+    text = '<|python_tag|>{"name": "lookup", "parameters": {"q": "tpu"}}'
+    content, calls = parse_tool_calls(text)
+    assert content == ""
+    assert calls[0]["function"]["name"] == "lookup"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"q": "tpu"}
+
+
+def test_parse_json_array():
+    text = '[{"name": "t1", "arguments": {"k": 2}}]'
+    _, calls = parse_tool_calls(text)
+    assert calls[0]["function"]["name"] == "t1"
+
+
+def test_parse_plain_text_returns_none():
+    content, calls = parse_tool_calls("just a normal answer about {objects}")
+    assert calls is None and content.startswith("just a normal")
+
+
+def test_parse_malformed_json_not_a_call():
+    content, calls = parse_tool_calls("<tool_call>{broken</tool_call>")
+    assert calls is None
+
+
+# -- template ---------------------------------------------------------------
+
+
+def test_chat_template_injects_tools_and_sets_annotation():
+    pre = Preprocessor(ModelCard(name="m", tokenizer="byte", context_length=4096))
+    tools = [{"type": "function", "function": {"name": "get_weather", "parameters": {}}}]
+    req = {
+        "messages": [{"role": "user", "content": "weather?"}],
+        "tools": tools,
+        "max_tokens": 8,
+    }
+    out = pre.preprocess_chat(req)
+    from dynamo_tpu.frontend.tokenizer import ByteTokenizer
+
+    text = ByteTokenizer().decode(out["token_ids"])
+    assert "get_weather" in text and "<tool_call>" in text
+    assert out["annotations"]["tools"] is True
+    # without tools: no annotation, no injection
+    out2 = pre.preprocess_chat({"messages": req["messages"], "max_tokens": 8})
+    assert "tools" not in out2["annotations"]
+
+
+# -- HTTP --------------------------------------------------------------------
+
+
+class _FixedTextEngine:
+    """Worker engine yielding fixed byte tokens (simulates a model that
+    emits tool-call markup)."""
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+
+    async def generate(self, request, context):
+        yield engine_output(list(self.payload), None)
+        yield engine_output([], "stop")
+
+
+async def _stack(payload: bytes, realm: str):
+    card = ModelCard(name="tool-model", tokenizer="byte", context_length=4096)
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    await wrt.serve_endpoint(
+        "dyn/worker/generate",
+        _FixedTextEngine(payload),
+        metadata={"model_card": card.to_dict()},
+    )
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=5)
+    return wrt, frt, svc, base
+
+
+TOOL_TEXT = b'<tool_call>{"name": "get_time", "arguments": {"tz": "UTC"}}</tool_call>'
+REQ = {
+    "model": "tool-model",
+    "messages": [{"role": "user", "content": "time?"}],
+    "tools": [{"type": "function", "function": {"name": "get_time", "parameters": {}}}],
+    "max_tokens": 128,
+}
+
+
+async def test_http_unary_chat_tool_calls():
+    wrt, frt, svc, base = await _stack(TOOL_TEXT, "tools-unary")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=REQ) as r:
+                assert r.status == 200
+                body = await r.json()
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        calls = choice["message"]["tool_calls"]
+        assert calls[0]["function"]["name"] == "get_time"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"tz": "UTC"}
+        assert choice["message"]["content"] is None
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+
+
+async def test_http_streaming_chat_tool_calls_buffered():
+    wrt, frt, svc, base = await _stack(TOOL_TEXT, "tools-stream")
+    try:
+        chunks = []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/chat/completions", json={**REQ, "stream": True}
+            ) as r:
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+        # role chunk + one buffered tool_calls chunk (no markup fragments)
+        deltas = [c["choices"][0]["delta"] for c in chunks]
+        assert not any("tool_call>" in (d.get("content") or "") for d in deltas)
+        final = chunks[-1]["choices"][0]
+        assert final["finish_reason"] == "tool_calls"
+        assert final["delta"]["tool_calls"][0]["function"]["name"] == "get_time"
+        assert final["delta"]["tool_calls"][0]["index"] == 0
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+
+
+async def test_http_chat_with_tools_but_plain_answer():
+    wrt, frt, svc, base = await _stack(b"it is noon.", "tools-plain")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=REQ) as r:
+                body = await r.json()
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "stop"
+        assert choice["message"]["content"] == "it is noon."
+        assert "tool_calls" not in choice["message"]
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+
+
+def test_bare_json_with_name_but_no_arguments_is_not_a_call():
+    """A plain JSON answer that happens to contain 'name' (e.g. a contact
+    record) must survive untouched."""
+    text = '{"name": "Alice", "phone": "555"}'
+    content, calls = parse_tool_calls(text)
+    assert calls is None and content == text
+    content2, calls2 = parse_tool_calls('[{"name": "Bob", "age": 3}]')
+    assert calls2 is None
+
+
+async def test_http_streaming_tools_flushes_without_finish():
+    """A stream that ends without finish_reason still delivers buffered
+    content on tools-enabled chats."""
+
+    class _NoFinishEngine:
+        async def generate(self, request, context):
+            yield engine_output(list(b"partial answer"), None)
+
+    card = ModelCard(name="tool-model", tokenizer="byte", context_length=4096)
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm="tools-nf"), event_transport="inproc")
+    await wrt.serve_endpoint("dyn/worker/generate", _NoFinishEngine(),
+                             metadata={"model_card": card.to_dict()})
+    frt = DistributedRuntime(discovery=MemDiscovery(realm="tools-nf"), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=5)
+    try:
+        texts = []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/chat/completions", json={**REQ, "stream": True}
+            ) as r:
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        d = json.loads(line[6:])["choices"][0]["delta"]
+                        if d.get("content"):
+                            texts.append(d["content"])
+        assert "".join(texts) == "partial answer"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
