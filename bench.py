@@ -34,6 +34,9 @@ def main() -> None:
     page_size = 64
     max_pages = 8  # 512-token max context for the bench
 
+    import os
+
+    quantize = os.environ.get("DYN_BENCH_QUANTIZE") or None  # e.g. "int8"
     config = get_config("llama-3.2-3b")
     runner = ModelRunner(
         config,
@@ -43,6 +46,7 @@ def main() -> None:
         decode_buckets=(B,),
         prefill_buckets=(prompt_len,),
         seed=0,
+        quantize=quantize,
     )
 
     rng = np.random.default_rng(0)

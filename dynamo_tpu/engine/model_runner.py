@@ -129,6 +129,7 @@ class ModelRunner:
         lora_slots: int = 0,  # >0 enables multi-LoRA (slot 0 = base)
         lora_rank: int = 8,
         lora_targets=None,  # defaults to models/lora.py DEFAULT_TARGETS
+        quantize: Optional[str] = None,  # "int8" → weight-only quant
     ):
         self.config = config
         self.mesh_config = mesh_config or MeshConfig()
@@ -144,6 +145,13 @@ class ModelRunner:
         t0 = time.monotonic()
         if params is None:
             params = llama.init_params(config, jax.random.PRNGKey(seed), dtype)
+        self.quantize = quantize
+        if quantize == "int8":
+            from dynamo_tpu.models.quant import quantize_params
+
+            params = quantize_params(params)
+        elif quantize is not None:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         self.params = jax.device_put(params, self.policy.params_sharding(params))
         # padding writes scatter to page index == num_pages, out of bounds,
         # and are dropped (scatter mode="drop" in llama._write_kv)

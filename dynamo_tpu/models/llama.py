@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import mm
 
 Params = Dict[str, Any]
 
@@ -249,9 +250,9 @@ def forward(
             return y + jnp.einsum("bsr,bro->bso", z, Bg)
 
         x = rms_norm(h, lp["attn_norm"], c.norm_eps)
-        q = lproj(x @ lp["wq"], x, "wq").reshape(B, S, c.n_heads, hd)
-        k = lproj(x @ lp["wk"], x, "wk").reshape(B, S, c.n_kv_heads, hd)
-        v = lproj(x @ lp["wv"], x, "wv").reshape(B, S, c.n_kv_heads, hd)
+        q = lproj(mm(x, lp["wq"]), x, "wq").reshape(B, S, c.n_heads, hd)
+        k = lproj(mm(x, lp["wk"]), x, "wk").reshape(B, S, c.n_kv_heads, hd)
+        v = lproj(mm(x, lp["wv"]), x, "wv").reshape(B, S, c.n_kv_heads, hd)
         q = rope(q, safe_pos, c.rope_theta)
         k = rope(k, safe_pos, c.rope_theta)
 
@@ -306,15 +307,15 @@ def forward(
         else:
             attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
         attn = attn.reshape(B, S, c.n_heads * hd)
-        h = h + lproj(attn @ lp["wo"], attn, "wo")
+        h = h + lproj(mm(attn, lp["wo"]), attn, "wo")
 
         x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
         if c.is_moe:
             h = h + _moe_block(c, lp, x)
         else:
-            gate = jax.nn.silu(lproj(x @ lp["w_gate"], x, "w_gate"))
-            up = lproj(x @ lp["w_up"], x, "w_up")
-            h = h + lproj((gate * up) @ lp["w_down"], gate * up, "w_down")
+            gate = jax.nn.silu(lproj(mm(x, lp["w_gate"]), x, "w_gate"))
+            up = lproj(mm(x, lp["w_up"]), x, "w_up")
+            h = h + lproj(mm(gate * up, lp["w_down"]), gate * up, "w_down")
         return (h, k_pool, v_pool), None
 
     (h, k_pool, v_pool), _ = lax.scan(
@@ -354,9 +355,9 @@ def encode(
     def layer(h, xs):
         lp, _ = xs
         x = rms_norm(h, lp["attn_norm"], c.norm_eps)
-        q = rope((x @ lp["wq"]).reshape(B, S, c.n_heads, hd), positions, c.rope_theta)
-        k = rope((x @ lp["wk"]).reshape(B, S, c.n_kv_heads, hd), positions, c.rope_theta)
-        v = (x @ lp["wv"]).reshape(B, S, c.n_kv_heads, hd)
+        q = rope(mm(x, lp["wq"]).reshape(B, S, c.n_heads, hd), positions, c.rope_theta)
+        k = rope(mm(x, lp["wk"]).reshape(B, S, c.n_kv_heads, hd), positions, c.rope_theta)
+        v = mm(x, lp["wv"]).reshape(B, S, c.n_kv_heads, hd)
         qg = q.reshape(B, S, c.n_kv_heads, G, hd)
         scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * hd**-0.5
         ti = jnp.arange(S)
@@ -365,12 +366,12 @@ def encode(
         )[:, None, None, None, :]
         probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1).astype(h.dtype)
         attn = jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, S, c.n_heads * hd)
-        h = h + attn @ lp["wo"]
+        h = h + mm(attn, lp["wo"])
         x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
         if c.is_moe:
             h = h + _moe_block(c, lp, x)
         else:
-            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+            h = h + mm(jax.nn.silu(mm(x, lp["w_gate"])) * mm(x, lp["w_up"]), lp["w_down"])
         return h, None
 
     h, _ = lax.scan(
@@ -393,8 +394,8 @@ def _moe_block(c: ModelConfig, lp, x: jax.Array) -> jax.Array:
 
     # compute every expert on every token (fine at test scale; EP replaces it)
     def one_expert(we_gate, we_up, we_down):
-        gate = jax.nn.silu(x @ we_gate)
-        return (gate * (x @ we_up)) @ we_down  # [B,S,E]
+        gate = jax.nn.silu(mm(x, we_gate))
+        return mm(gate * mm(x, we_up), we_down)  # [B,S,E]
 
     expert_out = jax.vmap(one_expert)(lp["we_gate"], lp["we_up"], lp["we_down"])
     # expert_out: [n_exp, B, S, E]; select & mix

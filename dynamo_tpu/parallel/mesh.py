@@ -75,6 +75,20 @@ class ShardingPolicy:
         """Spec by parameter name. Per-layer weights are stacked on a
         leading [n_layers] axis (models/llama.py), so layer params carry a
         leading None."""
+        # int8 weight-only quantization (models/quant.py): the q tensor
+        # shards exactly like the base weight; the scale [.., 1, out]
+        # shards only where the base sharded its LAST (output) dim
+        if path.endswith(("/q", "/s")):
+            base = self.param_spec(path[:-2])
+            if path.endswith("/q"):
+                return base
+            # scale = base shape with the contraction dim (-2) collapsed to
+            # 1: keep every base axis (incl. expert) except that dim, or
+            # MoE scales replicate across EP ranks and waste the memory the
+            # quantization saved
+            if len(base) < 2:
+                return base
+            return P(*base[:-2], None, base[-1])
         # LoRA factors [L, n_slots, in, r] / [L, n_slots, r, out]: shard the
         # dim that matches the target's megatron split; the rank dim and the
         # tiny opposite factor stay replicated

@@ -71,6 +71,8 @@ class KvRouter:
         self._sync_inst = None
         self._sync_tasks: List[asyncio.Task] = []
         self._peer_requests: Dict[str, set] = {}  # replica -> remote rids
+        # local in-flight requests (for join snapshots to late replicas)
+        self._local_requests: Dict[str, dict] = {}
 
     async def start(self) -> None:
         if self._started:
@@ -107,49 +109,101 @@ class KvRouter:
         ]
 
     async def _peer_watch(self) -> None:
+        seen: set = set()
         try:
             async for ev in self.runtime.discovery.watch("services/_sys/router_sync/"):
-                inst = ev.instance
-                if inst.instance_id == self._sync_inst.instance_id:
-                    continue
-                addr = (inst.metadata or {}).get("publisher")
-                if not addr:
-                    continue
-                if ev.kind == "put":
-                    self._sync_sub.connect(addr)
-                else:
-                    self._sync_sub.disconnect(addr)
-                    # dead replica: release every request it had charged, or
-                    # its load is attributed to workers forever
+                try:
+                    inst = ev.instance
+                    if inst.instance_id == self._sync_inst.instance_id:
+                        continue
+                    addr = (inst.metadata or {}).get("publisher")
                     replica = (inst.metadata or {}).get("replica")
-                    for rid in self._peer_requests.pop(replica, set()):
-                        self.sequences.free(rid)
+                    if not addr:
+                        continue
+                    if ev.kind == "put":
+                        self._sync_sub.connect(addr)
+                        if replica not in seen:
+                            seen.add(replica)
+                            # seed the newcomer with our in-flight set (a
+                            # late-joining replica would otherwise see every
+                            # worker as idle until those requests free).
+                            # small delay: its SUB socket is still
+                            # connecting (zmq slow joiner)
+                            self._track_task(
+                                asyncio.get_running_loop().create_task(
+                                    self._publish_snapshot_later()
+                                )
+                            )
+                    else:
+                        seen.discard(replica)
+                        self._sync_sub.disconnect(addr)
+                        # dead replica: release every request it had
+                        # charged, or its load sticks to workers forever
+                        for rid in self._peer_requests.pop(replica, set()):
+                            self.sequences.free(rid)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("replica-sync peer event failed; continuing")
         except asyncio.CancelledError:
             pass
+
+    async def _publish_snapshot_later(self) -> None:
+        from dynamo_tpu.runtime.event_plane import SEQ_SYNC_SUBJECT
+
+        await asyncio.sleep(0.2)
+        if self._sync_pub is None or not self._local_requests:
+            return
+        try:
+            await self._sync_pub.publish(
+                SEQ_SYNC_SUBJECT,
+                {"replica": self._replica_id, "op": "snapshot",
+                 "requests": list(self._local_requests.values())},
+            )
+        except Exception:
+            log.exception("replica-sync snapshot publish failed")
 
     async def _sync_loop(self) -> None:
         from dynamo_tpu.runtime.event_plane import SEQ_SYNC_SUBJECT
 
         try:
             async for subject, payload in self._sync_sub.events():
-                if subject != SEQ_SYNC_SUBJECT:
-                    continue
-                replica = payload.get("replica")
-                if replica == self._replica_id:
-                    continue
-                rid = f"{replica}:{payload['rid']}"
-                op = payload["op"]
-                if op == "add":
-                    self.sequences.add_request(
-                        rid, tuple(payload["worker"]), payload["blocks"],
-                        payload["overlap"],
-                    )
-                    self._peer_requests.setdefault(replica, set()).add(rid)
-                elif op == "prefill_done":
-                    self.sequences.mark_prefill_completed(rid)
-                elif op == "free":
-                    self.sequences.free(rid)
-                    self._peer_requests.get(replica, set()).discard(rid)
+                try:
+                    if subject != SEQ_SYNC_SUBJECT:
+                        continue
+                    replica = payload.get("replica")
+                    if replica == self._replica_id:
+                        continue
+                    op = payload["op"]
+                    if op == "snapshot":
+                        known = self._peer_requests.setdefault(replica, set())
+                        for r in payload.get("requests") or []:
+                            rid = f"{replica}:{r['rid']}"
+                            if rid in known:
+                                continue  # already charged via deltas
+                            self.sequences.add_request(
+                                rid, tuple(r["worker"]), r["blocks"], r["overlap"]
+                            )
+                            if r.get("prefill_done"):
+                                self.sequences.mark_prefill_completed(rid)
+                            known.add(rid)
+                        continue
+                    rid = f"{replica}:{payload['rid']}"
+                    if op == "add":
+                        self.sequences.add_request(
+                            rid, tuple(payload["worker"]), payload["blocks"],
+                            payload["overlap"],
+                        )
+                        self._peer_requests.setdefault(replica, set()).add(rid)
+                    elif op == "prefill_done":
+                        self.sequences.mark_prefill_completed(rid)
+                    elif op == "free":
+                        self.sequences.free(rid)
+                        self._peer_requests.get(replica, set()).discard(rid)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("replica-sync event failed; continuing")
         except asyncio.CancelledError:
             pass
 
@@ -163,17 +217,23 @@ class KvRouter:
                    "blocks": blocks, "overlap": overlap}
         # hold a strong ref until done (the loop keeps only weak refs) and
         # surface publish errors instead of 'never retrieved' warnings
-        task = asyncio.get_running_loop().create_task(
-            self._sync_pub.publish(SEQ_SYNC_SUBJECT, payload)
+        self._track_task(
+            asyncio.get_running_loop().create_task(
+                self._sync_pub.publish(SEQ_SYNC_SUBJECT, payload)
+            )
         )
+
+    def _track_task(self, task: asyncio.Task) -> None:
         self._sync_tasks.append(task)
+
         def _done(t, tasks=self._sync_tasks):
             try:
                 tasks.remove(t)
             except ValueError:
                 pass
             if not t.cancelled() and t.exception() is not None:
-                log.warning("seq_sync publish failed: %s", t.exception())
+                log.warning("replica-sync task failed: %s", t.exception())
+
         task.add_done_callback(_done)
 
     def _on_instance(self, kind: str, inst) -> None:
@@ -245,6 +305,10 @@ class KvRouter:
         self, request_id: str, worker: Worker, hashes: List[int], overlap: int
     ) -> None:
         self.sequences.add_request(request_id, worker, len(hashes), overlap)
+        self._local_requests[request_id] = {
+            "rid": request_id, "worker": list(worker),
+            "blocks": len(hashes), "overlap": overlap, "prefill_done": False,
+        }
         self._publish_sync("add", request_id, worker, len(hashes), overlap)
         if not self.use_kv_events and hashes:
             # approximate mode: predict the worker will cache these blocks
@@ -254,10 +318,13 @@ class KvRouter:
 
     def mark_prefill_completed(self, request_id: str) -> None:
         self.sequences.mark_prefill_completed(request_id)
+        if request_id in self._local_requests:
+            self._local_requests[request_id]["prefill_done"] = True
         self._publish_sync("prefill_done", request_id)
 
     def free(self, request_id: str) -> None:
         self.sequences.free(request_id)
+        self._local_requests.pop(request_id, None)
         self._publish_sync("free", request_id)
 
     async def stop(self) -> None:

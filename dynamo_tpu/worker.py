@@ -65,6 +65,9 @@ def parse_args(argv=None):
                         "Repeatable; each name becomes a servable model.")
     p.add_argument("--lora-rank", type=int, default=8,
                    help="rank for randomly-initialized dev adapters")
+    p.add_argument("--quantize", default=None, choices=[None, "int8"],
+                   help="weight-only quantization (int8 halves decode HBM "
+                        "weight traffic)")
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")
@@ -157,6 +160,7 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         draft_config=draft_config,
         draft_params=draft_params,
         spec_gamma=args.spec_gamma,
+        quantize=args.quantize,
         **_lora_kwargs(args, config),
     )
     for name, factors in getattr(args, "_lora_factors", []):

@@ -290,3 +290,48 @@ async def test_replica_sync_shares_load_view():
         await rt_a.shutdown(drain_timeout=1)
         await rt_b.shutdown(drain_timeout=1)
         await wrt.shutdown(drain_timeout=1)
+
+
+async def test_replica_sync_snapshot_seeds_late_joiner():
+    """A replica that starts AFTER requests are in flight must receive a
+    snapshot of the existing load."""
+    import asyncio
+
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EchoEngine
+    from dynamo_tpu.router.kv_router import KvRouter
+
+    realm = "replica-snap"
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    await wrt.serve_endpoint("dyn/w/generate", EchoEngine(), metadata={})
+
+    async def mk_router():
+        rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+        client = rt.client("dyn/w/generate")
+        r = KvRouter(rt, client, block_size=4, use_kv_events=False, replica_sync=True)
+        await r.start()
+        return rt, r
+
+    rt_a, ra = await mk_router()
+    try:
+        await asyncio.sleep(0.2)
+        worker = ra.workers()[0]
+        ra.add_request("old-1", worker, [1, 2, 3], 0)
+        ra.add_request("old-2", worker, [4, 5], 1)
+        ra.mark_prefill_completed("old-2")
+
+        rt_b, rb = await mk_router()  # late joiner
+        try:
+            await asyncio.sleep(0.8)  # discovery + snapshot delay
+            assert rb.sequences.active_requests(worker) == 2
+            ra.free("old-1")
+            await asyncio.sleep(0.3)
+            assert rb.sequences.active_requests(worker) == 1
+        finally:
+            await rb.stop()
+            await rt_b.shutdown(drain_timeout=1)
+    finally:
+        await ra.stop()
+        await rt_a.shutdown(drain_timeout=1)
+        await wrt.shutdown(drain_timeout=1)
