@@ -249,6 +249,9 @@ class InferenceEngine:
             elif op == "export":
                 rid, fut, loop, discard = arg
                 self._export_parked(rid, fut, loop, discard)
+            elif op == "export_device":
+                rid, fut, loop = arg
+                self._export_parked_device(rid, fut, loop)
             elif op == "embed":
                 self._embed_pending.append(arg)
         self._admit_kv_pending()
@@ -268,7 +271,12 @@ class InferenceEngine:
             seq.kv_import = None
             n_kv_pages = (len(seq.prompt) - 1 + self.pool.page_size - 1) // self.pool.page_size
             target = seq.pages[seq.n_shared_pages:n_kv_pages]
-            if target and payload.get("data"):
+            if target and payload.get("device"):
+                # colocated transfer: staged buffers are already on device
+                self.runner.import_pages_device(
+                    target, seq.n_shared_pages, payload["k"], payload["v"]
+                )
+            elif target and payload.get("data"):
                 self.runner.import_pages(target, seq.n_shared_pages, payload)
             if getattr(self.runner, "has_draft", False):
                 # transferred KV covers the target model only; rebuild the
@@ -306,6 +314,31 @@ class InferenceEngine:
         for rid in [r for r, (s, dl) in self._parked.items() if dl < now]:
             seq, _ = self._parked.pop(rid)
             self.scheduler.release_parked(seq)
+
+    def _export_parked_device(self, rid: str, fut, loop) -> None:
+        """Colocated P→D: gather the parked pages into device staging
+        buffers on THIS engine's step thread (the only thread allowed to
+        touch this runner's pools — they are donated every step)."""
+        entry = self._parked.pop(rid, None)
+        if entry is None:
+            loop.call_soon_threadsafe(_set_future, fut, None)
+            return
+        seq, _ = entry
+        n_kv_pages = (len(seq.prompt) + self.pool.page_size - 1) // self.pool.page_size
+        k, v = self.runner.export_pages_device(seq.pages[:n_kv_pages])
+        self.scheduler.release_parked(seq)
+        loop.call_soon_threadsafe(
+            _set_future, fut,
+            {"device": True, "k": k, "v": v, "n_pages": n_kv_pages},
+        )
+
+    async def export_parked_kv_device(self, request_id: str):
+        """Device-resident parked-KV export (same-process decode engine
+        imports the staged buffers without a host round trip)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("export_device", (request_id, fut, loop)))
+        return await fut
 
     def _export_parked(self, rid: str, fut, loop, discard: bool = False) -> None:
         entry = self._parked.pop(rid, None)

@@ -440,6 +440,23 @@ class ModelRunner:
         out = self._jit_encode(self.params, jnp.asarray(toks), jnp.asarray(lens))
         return np.asarray(jax.device_get(out))[:n]
 
+    # -- disagg KV transfer: device-resident path (colocated P/D) ----------
+    def export_pages_device(self, pages: List[int]):
+        """Gather whole KV pages into fresh device buffers (no host copy).
+        The gather materializes a new array, so the source pool can keep
+        being donated by its engine's step loop afterwards."""
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        return self.k_pool[:, :, idx], self.v_pool[:, :, idx]
+
+    def import_pages_device(self, target_pages: List[int], offset: int, k, v) -> None:
+        """Scatter device-staged pages into this pool's slots (the TPU
+        analog of the reference's NIXL device-to-device transfer; the
+        host-staged path below is the DCN fallback)."""
+        idx = jnp.asarray(np.asarray(target_pages, np.int32))
+        n = len(target_pages)
+        self.k_pool = self.k_pool.at[:, :, idx].set(k[:, :, offset : offset + n])
+        self.v_pool = self.v_pool.at[:, :, idx].set(v[:, :, offset : offset + n])
+
     # -- disagg KV transfer (host-staged DCN path, SURVEY.md §2.11) ---------
     def export_pages(self, pages: List[int]) -> Dict[str, Any]:
         """Device→host read of whole KV pages for P→D transfer. Layout on

@@ -54,6 +54,7 @@ class HttpService:
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
                 web.post("/v1/embeddings", self.embeddings),
+                web.post("/v1/responses", self.responses),
                 web.post("/v1/messages", self.anthropic_messages),
                 web.post("/v1/messages/count_tokens", self.anthropic_count_tokens),
                 web.get("/v1/models", self.list_models),
@@ -134,6 +135,152 @@ class HttpService:
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         return await self._run_inference(request, kind="completions")
+
+    # -- OpenAI Responses API (reference http/service/openai.rs /v1/responses)
+    @staticmethod
+    def _responses_to_chat(body: Dict[str, Any]) -> Dict[str, Any]:
+        """Map a Responses-API request onto the internal chat shape."""
+        messages = []
+        if body.get("instructions"):
+            messages.append({"role": "system", "content": body["instructions"]})
+        inp = body.get("input")
+        if isinstance(inp, str):
+            messages.append({"role": "user", "content": inp})
+        else:
+            for m in inp or []:
+                t = m.get("type")
+                if t == "function_call":
+                    # a prior turn's call echoed back: render as an
+                    # assistant tool_calls message
+                    messages.append({
+                        "role": "assistant",
+                        "content": None,
+                        "tool_calls": [{
+                            "id": m.get("call_id") or m.get("id"),
+                            "type": "function",
+                            "function": {"name": m.get("name"),
+                                         "arguments": m.get("arguments", "{}")},
+                        }],
+                    })
+                    continue
+                if t == "function_call_output":
+                    messages.append({"role": "tool",
+                                     "content": str(m.get("output", ""))})
+                    continue
+                content = m.get("content")
+                if isinstance(content, list):
+                    content = "".join(
+                        b.get("text", "")
+                        for b in content
+                        if b.get("type") in ("input_text", "output_text", "text")
+                    )
+                messages.append({"role": m.get("role", "user"),
+                                 "content": content if content is not None else ""})
+        return {
+            "model": body.get("model"),
+            "messages": messages,
+            "max_tokens": body.get("max_output_tokens", 512),
+            "temperature": body.get("temperature", 1.0),
+            "top_p": body.get("top_p", 1.0),
+            "tools": _responses_tools_to_chat(body.get("tools")),
+        }
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body", "invalid_request_error")
+        model = body.get("model")
+        try:
+            entry = self.manager.get(model)
+        except KeyError:
+            return _error(404, f"model {model!r} not found", "model_not_found")
+        chat = self._responses_to_chat(body)
+        try:
+            preprocessed = entry.preprocessor.preprocess_chat(chat)
+        except ValueError as e:
+            return _error(400, str(e), "invalid_request_error")
+
+        rid = f"resp_{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        ctx = Context(metadata={"model": model})
+
+        if body.get("stream"):
+            return await self._responses_stream(
+                request, entry, preprocessed, ctx, rid, model, created,
+                has_tools=bool(body.get("tools")),
+            )
+
+        text_parts: list = []
+        finish = None
+        n_out = 0
+        try:
+            async for item in entry.chain.generate(preprocessed, ctx):
+                text_parts.append(item.get("text", ""))
+                n_out += len(item.get("token_ids") or [])
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+                    break
+        except Exception as e:
+            log.exception("responses request failed")
+            return _error(500, str(e), "api_error")
+        finally:
+            ctx.stop_generating()
+        return web.json_response(
+            _response_body(rid, model, created, "".join(text_parts),
+                           len(preprocessed["token_ids"]), n_out, finish,
+                           has_tools=bool(body.get("tools")))
+        )
+
+    async def _responses_stream(
+        self, request, entry, preprocessed, ctx, rid, model, created,
+        has_tools: bool = False,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
+        )
+        await resp.prepare(request)
+
+        async def send(event: str, payload: Dict[str, Any]) -> None:
+            await resp.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
+            )
+
+        text_parts: list = []
+        finish = None
+        n_out = 0
+        try:
+            await send("response.created", {"type": "response.created",
+                                            "response": {"id": rid, "status": "in_progress"}})
+            async for item in entry.chain.generate(preprocessed, ctx):
+                text = item.get("text", "")
+                n_out += len(item.get("token_ids") or [])
+                if text:
+                    text_parts.append(text)
+                    await send(
+                        "response.output_text.delta",
+                        {"type": "response.output_text.delta", "delta": text},
+                    )
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+                    break
+            await send(
+                "response.completed",
+                {"type": "response.completed",
+                 "response": _response_body(rid, model, created, "".join(text_parts),
+                                            len(preprocessed["token_ids"]), n_out,
+                                            finish, has_tools=has_tools)},
+            )
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()
+            raise
+        except Exception as e:
+            log.exception("responses stream failed")
+            await send("error", {"type": "error", "message": str(e)})
+        finally:
+            ctx.stop_generating()
+        await resp.write_eof()
+        return resp
 
     # -- Anthropic Messages API (reference http/service/anthropic.rs:67,557)
     @staticmethod
@@ -501,6 +648,63 @@ class HttpService:
                 "usage": usage,
             }
         return web.json_response(body)
+
+
+def _responses_tools_to_chat(tools):
+    """Responses-API tools (flat: {type, name, parameters}) → chat-API
+    shape ({type, function: {...}}) the preprocessor's template renders."""
+    if not tools:
+        return None
+    out = []
+    for t in tools:
+        if "function" in t:
+            out.append(t)
+        else:
+            out.append({"type": t.get("type", "function"),
+                        "function": {k: v for k, v in t.items() if k != "type"}})
+    return out
+
+
+def _response_body(
+    rid, model, created, text, n_in, n_out, finish, has_tools: bool = False
+) -> Dict[str, Any]:
+    # only parse tool markup when tools were requested (same gating as the
+    # chat path): otherwise text that merely looks like a call is returned
+    # verbatim
+    content, calls = text, None
+    if has_tools:
+        from dynamo_tpu.frontend.tool_calls import parse_tool_calls
+
+        content, calls = parse_tool_calls(text)
+    output = []
+    if calls:
+        for c in calls:
+            output.append({
+                "type": "function_call",
+                "id": c["id"],
+                "call_id": c["id"],
+                "name": c["function"]["name"],
+                "arguments": c["function"]["arguments"],
+            })
+    if content or not calls:
+        output.insert(0, {
+            "type": "message",
+            "id": f"msg_{rid[5:]}",
+            "role": "assistant",
+            "status": "completed",
+            "content": [{"type": "output_text", "text": content if calls else text,
+                         "annotations": []}],
+        })
+    return {
+        "id": rid,
+        "object": "response",
+        "created_at": created,
+        "model": model,
+        "status": "incomplete" if finish == "length" else "completed",
+        "output": output,
+        "usage": {"input_tokens": n_in, "output_tokens": n_out,
+                  "total_tokens": n_in + n_out},
+    }
 
 
 def _chat_chunk(rid, model, created, delta, finish) -> Dict[str, Any]:

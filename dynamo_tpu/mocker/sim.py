@@ -36,6 +36,43 @@ class SimTiming:
         if self.speed > 0:
             time.sleep(seconds * self.speed)
 
+    @classmethod
+    def fit(cls, fpm_history, decode_steps: int = 1, speed: float = 1.0) -> "SimTiming":
+        """Fit the linear step-time model to observed ForwardPassMetrics
+        (real engine runs → calibrated mocker; the reference's DynoSim
+        fits its simulator from profiling data the same way). Accepts
+        dataclasses or plain dicts (FPM events off the event plane)."""
+
+        def get(m, k):
+            return getattr(m, k, None) if not isinstance(m, dict) else m.get(k)
+
+        def lstsq(xs, ys, d0, s0):
+            if len(xs) < 2 or len(set(xs)) < 2:
+                return d0, s0
+            slope, intercept = np.polyfit(np.asarray(xs, float), np.asarray(ys, float), 1)
+            return max(float(intercept), 0.0), max(float(slope), 0.0)
+
+        dec = [(get(m, "n_running"), get(m, "wall_time_s"))
+               for m in fpm_history if get(m, "kind") == "decode"]
+        pre = [(get(m, "scheduled_tokens"), get(m, "wall_time_s"))
+               for m in fpm_history if get(m, "kind") == "prefill"]
+        base = cls()
+        T = max(decode_steps, 1)
+        # fallbacks are expressed per-DISPATCH (x T) so the division below
+        # lands back on the per-step defaults when there's nothing to fit
+        d_int, d_slope = lstsq([x for x, _ in dec], [y for _, y in dec],
+                               base.decode_base_s * T, base.decode_per_seq_s * T)
+        p_int, p_slope = lstsq([x for x, _ in pre], [y for _, y in pre],
+                               base.prefill_base_s, base.prefill_per_token_s)
+        return cls(
+            prefill_base_s=p_int,
+            prefill_per_token_s=p_slope,
+            decode_base_s=d_int / T,
+            decode_per_seq_s=d_slope / T,
+            dispatch_overhead_s=0.0,  # folded into the decode intercept
+            speed=speed,
+        )
+
 
 def _sim_token(seed: int, position: int, vocab: int = 50000) -> int:
     # deterministic, avoids special ids < 16

@@ -151,17 +151,22 @@ class KvRouter:
     async def _publish_snapshot_later(self) -> None:
         from dynamo_tpu.runtime.event_plane import SEQ_SYNC_SUBJECT
 
-        await asyncio.sleep(0.2)
-        if self._sync_pub is None or not self._local_requests:
-            return
-        try:
-            await self._sync_pub.publish(
-                SEQ_SYNC_SUBJECT,
-                {"replica": self._replica_id, "op": "snapshot",
-                 "requests": list(self._local_requests.values())},
-            )
-        except Exception:
-            log.exception("replica-sync snapshot publish failed")
+        # republish with backoff: the newcomer's SUB may take longer than
+        # any single delay to connect (zmq slow joiner); receivers dedupe
+        # snapshot entries against already-applied deltas, so repeats are
+        # idempotent
+        for delay in (0.2, 1.0, 3.0):
+            await asyncio.sleep(delay)
+            if self._sync_pub is None or not self._local_requests:
+                continue
+            try:
+                await self._sync_pub.publish(
+                    SEQ_SYNC_SUBJECT,
+                    {"replica": self._replica_id, "op": "snapshot",
+                     "requests": list(self._local_requests.values())},
+                )
+            except Exception:
+                log.exception("replica-sync snapshot publish failed")
 
     async def _sync_loop(self) -> None:
         from dynamo_tpu.runtime.event_plane import SEQ_SYNC_SUBJECT

@@ -36,11 +36,19 @@ class ServedWorker:
             await self.publisher.stop()
 
 
+import weakref
+
+# in-process engine registry: when prefill and decode engines share one
+# process (colocated disagg — one TPU slice partitioned by role), the KV
+# transfer stays entirely on device instead of a host-staged RPC
+LOCAL_ENGINES: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
 class DisaggDecodeAdapter:
     """Wraps the engine endpoint: requests carrying kv_transfer_src pull
-    the parked KV pages from the prefill worker (worker↔worker over the
-    request plane) before admission — the decode side of the host-staged
-    P→D transfer."""
+    the parked KV pages from the prefill worker before admission. Same-
+    process prefill engines (colocated disagg) transfer device-to-device;
+    remote ones go over the request plane (host-staged DCN path)."""
 
     def __init__(self, engine: InferenceEngine, runtime: DistributedRuntime):
         self.engine = engine
@@ -48,6 +56,18 @@ class DisaggDecodeAdapter:
         self._fetch_clients = {}
 
     async def _fetch(self, src) -> Optional[dict]:
+        local = LOCAL_ENGINES.get(src["instance_id"])
+        # device path needs real runners on BOTH ends (mockers track KV at
+        # hash level only and must never touch jax)
+        if (
+            local is not None
+            and local is not self.engine
+            and hasattr(local.runner, "export_pages_device")
+            and hasattr(self.engine.runner, "import_pages_device")
+        ):
+            # device-resident transfer: gather on the prefill engine's step
+            # thread, scatter on ours — no bytes touch the host
+            return await local.export_parked_kv_device(src["request_id"])
         path = src["path"]
         client = self._fetch_clients.get(path)
         if client is None:
@@ -68,7 +88,7 @@ class DisaggDecodeAdapter:
                 log.warning("kv fetch from prefill worker failed: %s", e)
                 payload = None
             request = dict(request)
-            if payload is not None and payload.get("data"):
+            if payload is not None and (payload.get("data") or payload.get("device")):
                 request["kv_import"] = payload
             else:
                 # transfer failed → recompute prefill locally (aggregated)
@@ -93,6 +113,7 @@ async def serve_worker(
     disagg_role: Optional[str] = None,  # None/"both" | "prefill" | "decode"
 ) -> ServedWorker:
     instance_id = new_instance_id()
+    LOCAL_ENGINES[instance_id] = engine  # colocated-disagg device transfer
     metadata = {"model_card": card.to_dict(), "dp_rank": dp_rank}
     if disagg_role:
         metadata["disagg_role"] = disagg_role

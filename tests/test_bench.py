@@ -46,3 +46,35 @@ async def test_offline_replay_end_to_end():
     assert report["n_ok"] == 20
     assert report["output_tokens"] > 0
     assert report["goodput_tok_s"] > 0
+
+
+def test_sim_timing_fit_recovers_model():
+    """Fitting FPM records generated from a known SimTiming recovers its
+    parameters (the real-run → calibrated-mocker path)."""
+    from dynamo_tpu.engine.engine import ForwardPassMetrics
+    from dynamo_tpu.mocker.sim import SimTiming
+
+    truth = SimTiming(decode_base_s=0.006, decode_per_seq_s=0.0004,
+                      prefill_base_s=0.003, prefill_per_token_s=0.00005)
+    T = 4
+    hist = []
+    for b in (1, 2, 4, 8, 16, 32):
+        wall = 0.002 + T * (truth.decode_base_s + b * truth.decode_per_seq_s)
+        hist.append(ForwardPassMetrics(ts=0, kind="decode", wall_time_s=wall,
+                                       scheduled_tokens=b * T, n_running=b,
+                                       n_waiting=0, kv_usage=0.1))
+    for n in (16, 64, 256, 512):
+        wall = truth.prefill_base_s + n * truth.prefill_per_token_s
+        hist.append(ForwardPassMetrics(ts=0, kind="prefill", wall_time_s=wall,
+                                       scheduled_tokens=n, n_running=1,
+                                       n_waiting=0, kv_usage=0.1))
+
+    fit = SimTiming.fit(hist, decode_steps=T)
+    assert abs(fit.decode_per_seq_s - truth.decode_per_seq_s) / truth.decode_per_seq_s < 0.05
+    assert abs(fit.prefill_per_token_s - truth.prefill_per_token_s) / truth.prefill_per_token_s < 0.05
+    # intercept folds dispatch overhead: decode_base >= truth's
+    assert fit.decode_base_s >= truth.decode_base_s * 0.9
+    # dict-form records (off the event plane) work too
+    as_dicts = [m.__dict__ for m in hist]
+    fit2 = SimTiming.fit(as_dicts, decode_steps=T)
+    assert abs(fit2.decode_per_seq_s - fit.decode_per_seq_s) < 1e-9

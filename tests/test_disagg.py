@@ -162,3 +162,84 @@ async def test_disagg_mockers_and_fallback():
         rt0, w0 = rts[0]
         await w0.stop()
         await rt0.shutdown(drain_timeout=1)
+
+
+async def test_disagg_colocated_uses_device_transfer():
+    """P and D engines in one process: the KV transfer must take the
+    device-resident path (no host-staged bytes), with identical output to
+    the aggregated run."""
+    from dynamo_tpu import worker_common
+
+    prompt = list(range(70, 90))
+
+    rt_a, w_a = await _serve_real_engine("coloc-agg", "tpu-worker", None)
+    frt_a, svc_a, base_a = await _stack("coloc-agg", None)
+    try:
+        agg = await _completion_tokens(base_a, prompt)
+    finally:
+        await svc_a.stop()
+        await frt_a.shutdown()
+        await w_a.stop()
+        await rt_a.shutdown(drain_timeout=1)
+
+    rt_d, w_d = await _serve_real_engine("coloc", "tpu-worker", None)
+    rt_p, w_p = await _serve_real_engine("coloc", "prefill", "prefill")
+    frt, svc, base = await _stack("coloc", None)
+
+    device_imports = []
+    host_imports = []
+    runner_d = w_d.engine.runner
+    orig_dev, orig_host = runner_d.import_pages_device, runner_d.import_pages
+    runner_d.import_pages_device = lambda *a, **k: (device_imports.append(1), orig_dev(*a, **k))[1]
+    runner_d.import_pages = lambda *a, **k: (host_imports.append(1), orig_host(*a, **k))[1]
+    try:
+        entry = svc.manager.get("tiny")
+        for _ in range(100):
+            if entry.prefill_router is not None and entry.prefill_router.active:
+                break
+            await asyncio.sleep(0.05)
+        dis = await _completion_tokens(base, prompt)
+        assert dis["choices"][0]["text"] == agg["choices"][0]["text"]
+        assert dis["usage"] == agg["usage"]
+        assert device_imports and not host_imports, (
+            f"expected device transfer, got device={len(device_imports)} "
+            f"host={len(host_imports)}"
+        )
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for w, rt in ((w_d, rt_d), (w_p, rt_p)):
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+async def test_disagg_remote_path_still_works_without_local_registry():
+    """With the in-process registry empty (separate-process topology), the
+    host-staged RPC transfer carries the KV."""
+    from dynamo_tpu import worker_common
+
+    prompt = list(range(90, 110))
+    rt_d, w_d = await _serve_real_engine("remote-kv", "tpu-worker", None)
+    rt_p, w_p = await _serve_real_engine("remote-kv", "prefill", "prefill")
+    worker_common.LOCAL_ENGINES.clear()  # simulate cross-process workers
+    frt, svc, base = await _stack("remote-kv", None)
+    try:
+        entry = svc.manager.get("tiny")
+        for _ in range(100):
+            if entry.prefill_router is not None and entry.prefill_router.active:
+                break
+            await asyncio.sleep(0.05)
+        dis = await _completion_tokens(base, prompt)
+        assert dis["usage"]["completion_tokens"] == 6
+        kinds = [m.kind for m in w_d.engine.fpm_history]
+        assert "decode" in kinds, "decode worker must have decoded"
+        prefill_tokens = sum(
+            m.scheduled_tokens for m in w_d.engine.fpm_history if m.kind == "prefill"
+        )
+        assert prefill_tokens == 0, "KV must arrive via RPC, not recompute"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for w, rt in ((w_d, rt_d), (w_p, rt_p)):
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)

@@ -340,3 +340,47 @@ async def test_anthropic_messages_endpoint():
         await svc.stop()
         await frt.shutdown()
         await wrt.shutdown(drain_timeout=1)
+
+
+async def test_responses_api_unary_and_stream():
+    """OpenAI Responses API: /v1/responses maps input → chat, returns
+    output_text (unary) and typed SSE events (stream)."""
+    wrt, frt, svc, base = await _start_stack(realm="responses-e2e")
+    try:
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "echo-model",
+                "instructions": "be brief",
+                "input": "hello responses",
+                "max_output_tokens": 10,
+            }
+            async with s.post(f"{base}/v1/responses", json=payload) as r:
+                assert r.status == 200, await r.text()
+                body = await r.json()
+            assert body["object"] == "response" and body["status"] in ("completed", "incomplete")
+            msg = body["output"][0]
+            assert msg["type"] == "message"
+            assert msg["content"][0]["type"] == "output_text"
+            assert body["usage"]["output_tokens"] == 10
+
+            events = []
+            async with s.post(f"{base}/v1/responses", json={**payload, "stream": True}) as r:
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("event: "):
+                        events.append(line[7:])
+            assert events[0] == "response.created"
+            assert "response.output_text.delta" in events
+            assert events[-1] == "response.completed"
+
+            # structured input form
+            async with s.post(f"{base}/v1/responses", json={
+                "model": "echo-model",
+                "input": [{"role": "user", "content": [{"type": "input_text", "text": "hi"}]}],
+                "max_output_tokens": 4,
+            }) as r:
+                assert r.status == 200
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
