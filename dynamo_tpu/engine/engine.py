@@ -68,15 +68,17 @@ class InferenceEngine:
         host_kv_blocks: int = 0,  # G2 host-tier capacity (0 = disabled)
         disk_kv_blocks: int = 0,  # G3 disk-tier capacity (needs G2 enabled)
         disk_kv_root: Optional[str] = None,
+        obj_kv_root: Optional[str] = None,  # G4 object store (fs backend /
+        #   shared mount; S3 via kvbm.object_store.S3Backend)
     ):
         self.runner = runner
         self.pool = PagePool(runner.num_pages, runner.page_size)
         self.host_pool = None
         self._host_events: List[KvEvent] = []
-        if disk_kv_blocks > 0 and host_kv_blocks <= 0:
+        if (disk_kv_blocks > 0 or obj_kv_root) and host_kv_blocks <= 0:
             log.warning(
-                "disk_kv_blocks=%d ignored: the G3 disk tier spills from the "
-                "G2 host tier — also set host_kv_blocks > 0", disk_kv_blocks,
+                "disk/object KV tiers ignored: they spill from the G2 host "
+                "tier — also set host_kv_blocks > 0",
             )
         if host_kv_blocks > 0:
             from dynamo_tpu.kvbm.disk_pool import DiskKvPool, TieredKv
@@ -91,7 +93,12 @@ class InferenceEngine:
                     disk_kv_root or tempfile.mkdtemp(prefix="dyn_kv_g3_"),
                     capacity_blocks=disk_kv_blocks,
                 )
-            self.host_pool = TieredKv(host, disk)
+            obj = None
+            if obj_kv_root:
+                from dynamo_tpu.kvbm.object_store import FsBackend, ObjectKvPool
+
+                obj = ObjectKvPool(FsBackend(obj_kv_root))
+            self.host_pool = TieredKv(host, disk, obj)
             self.pool.evict_hook = self._offload_page
             self.host_pool.on_evict(self._on_host_evicted)
         self.scheduler = Scheduler(
@@ -542,8 +549,16 @@ class InferenceEngine:
         except KeyError:
             log.info("lower-tier block evicted before onboard; recomputing")
             return False
-        if k is not None:
-            self.runner.import_pages(pages, 0, kv_arrays_to_payload(k, v))
+        if k is None:
+            # real engines need bytes (a hash-indexed block whose data is
+            # gone — e.g. a shared G4 object deleted externally — must be
+            # recomputed, not trusted); sim runners track KV at hash level
+            # only and None is their normal case
+            if hasattr(self.runner, "export_pages_device"):
+                log.info("lower-tier block has no data; recomputing")
+                return False
+            return True
+        self.runner.import_pages(pages, 0, kv_arrays_to_payload(k, v))
         return True
 
 

@@ -37,6 +37,7 @@ log = logging.getLogger("dynamo_tpu.engine.runner")
 def _decode_loop(
     config: ModelConfig,
     attn_impl: str,
+    mesh,  # for sharded pallas attention on TP meshes (None = single dev)
     n_steps: int,
     params,
     tokens0,  # [B] current token per seq
@@ -60,7 +61,7 @@ def _decode_loop(
         kvl = jnp.where(positions0 < 0, 0, positions0 + t + 1)
         logits, kp, vp = llama.forward(
             config, params, tok[:, None], pos[:, None], kp, vp, page_table, kvl,
-            attn_impl=attn_impl, lora=lora, adapter_idx=adapter_idx,
+            attn_impl=attn_impl, mesh=mesh, lora=lora, adapter_idx=adapter_idx,
         )
         s = sample(logits[:, 0, :], sampling, step0 + t)
         return (s, kp, vp), s
@@ -199,12 +200,17 @@ class ModelRunner:
 
         if attn_impl is None:
             platform = self.mesh.devices.flat[0].platform
-            # pallas on a real accelerator; pallas_call is not yet wrapped in
-            # shard_map, so multi-device meshes use the jnp path (GSPMD
-            # partitions it) until the sharded-kernel milestone
-            single = self.mesh_config.n_devices == 1
-            attn_impl = "pallas" if (platform != "cpu" and single) else "jnp"
+            # pallas on a real accelerator; TP meshes run the kernel inside
+            # shard_map over the model axis (heads are independent). Other
+            # parallel axes (data/expert/seq) are not yet covered by the
+            # sharded wrappers, so those meshes keep the jnp path (GSPMD
+            # partitions it)
+            mc = self.mesh_config
+            tp_only = mc.data == mc.expert == mc.seq == 1
+            attn_impl = "pallas" if (platform != "cpu" and tp_only) else "jnp"
         self.attn_impl = attn_impl
+        # static mesh handle threaded to forward for sharded kernels / ring
+        self._fwd_mesh = self.mesh if self.mesh_config.n_devices > 1 else None
 
         # prefill uses the flash kernel on TPU (S>1), jnp elsewhere; with a
         # seq mesh axis, prefill goes sequence-parallel (ring attention)
@@ -216,7 +222,7 @@ class ModelRunner:
         )
         self._jit_sample = jax.jit(sample)
         self._jit_decode_loop = jax.jit(
-            partial(_decode_loop, self.config, self.attn_impl),
+            partial(_decode_loop, self.config, self.attn_impl, self._fwd_mesh),
             static_argnums=(0,),  # n_steps
             donate_argnums=(4, 5),  # k_pool, v_pool
         )
@@ -226,7 +232,7 @@ class ModelRunner:
             self._jit_spec = jax.jit(
                 partial(
                     spec_rounds, self.config, draft_config,
-                    self.attn_impl, self.attn_impl,
+                    self.attn_impl, self.attn_impl, self._fwd_mesh,
                 ),
                 static_argnums=(0, 1),  # gamma, n_rounds
                 donate_argnums=(6, 7, 8, 9),  # both KV pool pairs
@@ -255,7 +261,7 @@ class ModelRunner:
         logits, self.k_pool, self.v_pool = self._jit_forward(
             self.params, tok, pos, self.k_pool, self.v_pool, pt, kv_lens,
             jnp.int32(n - 1), attn_impl=impl,
-            mesh=self.mesh if impl == "ring" else None,
+            mesh=self.mesh if impl == "ring" else self._fwd_mesh,
             sp_has_prior=prior_len > 0,
             lora=self.lora,
             adapter_idx=jnp.asarray([adapter], jnp.int32) if self.lora is not None else None,
@@ -412,6 +418,7 @@ class ModelRunner:
         _, self.draft_k_pool, self.draft_v_pool = self._jit_draft_forward(
             self.draft_params, tok, pos, self.draft_k_pool, self.draft_v_pool,
             pt, kv_lens, jnp.int32(n - 1), attn_impl=self.attn_impl,
+            mesh=self._fwd_mesh,
         )
 
     def sample_one(self, logits: jax.Array, sampling, step: int) -> int:

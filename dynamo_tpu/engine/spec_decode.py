@@ -117,6 +117,7 @@ def spec_rounds(
     draft_config,
     decode_impl: str,  # draft S=1 attention impl ("jnp" | "pallas")
     verify_impl: str,  # target S=g+1 attention impl
+    mesh,  # for sharded pallas attention on TP meshes (None = single dev)
     gamma: int,
     n_rounds: int,
     params,
@@ -159,7 +160,7 @@ def spec_rounds(
             kvl = jnp.where(pos < 0, 0, pos + i + 1)
             logits, dkp, dvp = llama.forward(
                 draft_config, draft_params, t[:, None], p_i[:, None],
-                dkp, dvp, page_table, kvl, attn_impl=decode_impl,
+                dkp, dvp, page_table, kvl, attn_impl=decode_impl, mesh=mesh,
             )
             idx, probs = filtered_probs(logits[:, 0], sampling)
             j = _categorical_rows(sampling, probs, step, _TAG_DRAFT + i)
@@ -182,7 +183,7 @@ def spec_rounds(
         kvl = jnp.where(pos < 0, 0, pos + gamma + 1)
         logits, kp, vp = llama.forward(
             config, params, ver_toks, ver_pos, kp, vp, page_table, kvl,
-            attn_impl=verify_impl, lora=lora, adapter_idx=adapter_idx,
+            attn_impl=verify_impl, mesh=mesh, lora=lora, adapter_idx=adapter_idx,
         )  # [B, g+1, V]
         V = logits.shape[-1]
         rep = SamplingParams(

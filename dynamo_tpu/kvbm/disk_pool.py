@@ -37,6 +37,32 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
+def encode_block(parent_hash, k: np.ndarray, v: np.ndarray) -> bytes:
+    """Shared tier codec: 8-byte LE header length, JSON header, raw k, raw
+    v. Both the G3 files and G4 objects use exactly this format so blocks
+    demote across tiers byte-for-byte."""
+    header = json.dumps(
+        {"shape": list(k.shape), "dtype": str(k.dtype), "parent": parent_hash}
+    ).encode()
+    return (
+        struct.pack("<Q", len(header)) + header
+        + np.ascontiguousarray(k).tobytes() + np.ascontiguousarray(v).tobytes()
+    )
+
+
+def decode_block(data: bytes):
+    """Inverse of encode_block → (parent_hash, k, v)."""
+    (hlen,) = struct.unpack("<Q", data[:8])
+    header = json.loads(data[8 : 8 + hlen])
+    dtype = _np_dtype(header["dtype"])
+    shape = tuple(header["shape"])
+    n = int(np.prod(shape)) * dtype.itemsize
+    off = 8 + hlen
+    k = np.frombuffer(data[off : off + n], dtype=dtype).reshape(shape)
+    v = np.frombuffer(data[off + n : off + 2 * n], dtype=dtype).reshape(shape)
+    return header.get("parent"), k, v
+
+
 class DiskKvPool:
     """Content-addressed KV block store on disk. Same match/get/put surface
     as HostKvPool so the tier chain composes them uniformly."""
@@ -50,11 +76,15 @@ class DiskKvPool:
         self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
         self._evict_listeners: List[Any] = []
         self._lock = threading.Lock()
+        # demotion: called with (hash, parent, k, v) before an LRU drop so
+        # a lower tier (G4 object store) can absorb the block
+        self.spill_hook = None
         # spill runs on the engine step thread; do the file write on a
         # background writer so a device-eviction burst doesn't add disk
         # latency to the decode hot path. _pending holds not-yet-written
         # blocks so get_block stays consistent.
         self._pending: Dict[int, Tuple[Any, Any]] = {}
+        self._outstanding = 0  # queued-but-unprocessed writer items
         self._write_q: "queue.Queue" = queue.Queue()
         self._writer = threading.Thread(target=self._write_loop, daemon=True)
         self._writer.start()
@@ -87,35 +117,57 @@ class DiskKvPool:
             log.info("G3 rescan adopted %d blocks from %s", len(entries), self.root)
         self._enforce_capacity()
 
+    def _put_q(self, item) -> None:
+        with self._lock:
+            self._outstanding += 1
+        self._write_q.put(item)
+
     def _write_loop(self) -> None:
         while True:
             item = self._write_q.get()
             if item is None:
                 return
-            block_hash, parent_hash, k, v = item
-            with self._lock:
-                if block_hash not in self._pending:
-                    continue  # evicted before the write happened
             try:
-                self._write_file(block_hash, parent_hash, k, v)
-            except OSError:
-                log.exception("G3 write failed for %x", block_hash)
-                with self._lock:
-                    self._blocks.pop(block_hash, None)
+                self._process(item)
             finally:
                 with self._lock:
-                    self._pending.pop(block_hash, None)
+                    self._outstanding -= 1
+
+    def _process(self, item) -> None:
+        if item[0] == "spill":
+            # deferred demotion of an already-flushed block: read the
+            # file off the hot path, hand it down, then unlink
+            _, h, parent = item
+            try:
+                k, v = self._read_file(h)
+                if self.spill_hook is not None:
+                    self.spill_hook(h, parent, k, v)
+            except (OSError, ValueError):
+                log.warning("G3 spill read failed for %x; block lost", h)
+            finally:
+                try:
+                    os.unlink(self._path(h))
+                except FileNotFoundError:
+                    pass
+            return
+        _, block_hash, parent_hash, k, v = item
+        with self._lock:
+            if block_hash not in self._pending:
+                return  # evicted before the write happened
+        try:
+            self._write_file(block_hash, parent_hash, k, v)
+        except OSError:
+            log.exception("G3 write failed for %x", block_hash)
+            with self._lock:
+                self._blocks.pop(block_hash, None)
+        finally:
+            with self._lock:
+                self._pending.pop(block_hash, None)
 
     def _write_file(self, block_hash, parent_hash, k, v) -> None:
-        header = json.dumps(
-            {"shape": list(k.shape), "dtype": str(k.dtype), "parent": parent_hash}
-        ).encode()
         tmp = self._path(block_hash) + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(struct.pack("<Q", len(header)))
-            f.write(header)
-            f.write(np.ascontiguousarray(k).tobytes())
-            f.write(np.ascontiguousarray(v).tobytes())
+            f.write(encode_block(parent_hash, k, v))
         os.replace(tmp, self._path(block_hash))
 
     def on_evict(self, cb) -> None:
@@ -149,31 +201,51 @@ class DiskKvPool:
                 self._pending[block_hash] = (k, v)
             self.stats["offloaded"] += 1
         if k is not None:
-            self._write_q.put((block_hash, parent_hash, k, v))
+            self._put_q(("write", block_hash, parent_hash, k, v))
         self._enforce_capacity()
 
     def flush(self) -> None:
-        """Block until queued writes are durable (tests / shutdown)."""
+        """Block until queued writes AND deferred spills are processed."""
         import time
 
         while True:
             with self._lock:
-                if not self._pending:
+                if not self._pending and self._outstanding == 0:
                     return
             time.sleep(0.005)
 
     def _enforce_capacity(self) -> None:
         dropped: List[int] = []
+        unlink_now: List[int] = []
+        spill_mem = []
+        spill_deferred = []
         with self._lock:
             while len(self._blocks) > self.capacity:
-                h, _ = self._blocks.popitem(last=False)
-                self._pending.pop(h, None)
-                try:
-                    os.unlink(self._path(h))
-                except FileNotFoundError:
-                    pass
+                h, parent = self._blocks.popitem(last=False)
+                pend = self._pending.pop(h, None)
                 dropped.append(h)
                 self.stats["evicted"] += 1
+                if self.spill_hook is None:
+                    unlink_now.append(h)
+                elif pend is not None:
+                    spill_mem.append((h, parent, pend))
+                    unlink_now.append(h)
+                else:
+                    # already on disk: read + demote on the writer thread,
+                    # never on the engine step thread (it unlinks after)
+                    spill_deferred.append((h, parent))
+        for h, parent, pend in spill_mem:
+            try:
+                self.spill_hook(h, parent, pend[0], pend[1])
+            except Exception:
+                log.exception("G3 spill hook failed for %x", h)
+        for h, parent in spill_deferred:
+            self._put_q(("spill", h, parent))
+        for h in unlink_now:
+            try:
+                os.unlink(self._path(h))
+            except FileNotFoundError:
+                pass
         if dropped:
             for cb in self._evict_listeners:
                 cb(dropped)
@@ -202,14 +274,11 @@ class DiskKvPool:
         path = self._path(block_hash)
         if not os.path.exists(path):
             return None, None
-        with open(path, "rb") as f:
-            (hlen,) = struct.unpack("<Q", f.read(8))
-            header = json.loads(f.read(hlen))
-            dtype = _np_dtype(header["dtype"])
-            shape = tuple(header["shape"])
-            nbytes = int(np.prod(shape)) * dtype.itemsize
-            k = np.frombuffer(f.read(nbytes), dtype=dtype).reshape(shape)
-            v = np.frombuffer(f.read(nbytes), dtype=dtype).reshape(shape)
+        return self._read_file(block_hash)
+
+    def _read_file(self, block_hash: int):
+        with open(self._path(block_hash), "rb") as f:
+            _, k, v = decode_block(f.read())
         return k, v
 
     def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
@@ -231,26 +300,38 @@ class TieredKv:
     only from the terminal tier, so router credits persist while data
     merely demotes."""
 
-    def __init__(self, host, disk: Optional[DiskKvPool] = None):
+    def __init__(self, host, disk: Optional[DiskKvPool] = None, obj=None):
         self.host = host
         self.disk = disk
+        self.obj = obj  # G4 ObjectKvPool (kvbm/object_store.py)
         if disk is not None:
             host.spill_hook = self._spill
+            if obj is not None:
+                disk.spill_hook = obj.put_block
+        elif obj is not None:
+            host.spill_hook = self._spill_to_obj
 
     def _spill(self, block) -> None:  # HostBlock
         self.disk.put_block(block.block_hash, block.parent_hash, block.k, block.v)
 
+    def _spill_to_obj(self, block) -> None:  # HostBlock (no G3 tier)
+        self.obj.put_block(block.block_hash, block.parent_hash, block.k, block.v)
+
     def on_evict(self, cb) -> None:
-        # only terminal drops (disk evictions, or host evictions with no
-        # disk below) remove lower-tier residency. NB: pools define __len__,
-        # so `self.disk or self.host` would treat an EMPTY disk as absent
+        # only terminal drops remove lower-tier residency. NB: pools define
+        # __len__, so `a or b` would treat an EMPTY tier as absent
         terminal = self.host if self.disk is None else self.disk
+        terminal = terminal if self.obj is None else self.obj
         terminal.on_evict(cb)
+
+    def _tiers(self):
+        return [t for t in (self.host, self.disk, self.obj) if t is not None]
 
     def match(self, hashes: List[int]) -> int:
         n = 0
+        tiers = self._tiers()
         for h in hashes:
-            if h in self.host or (self.disk is not None and h in self.disk):
+            if any(h in t for t in tiers):
                 n += 1
             else:
                 break
@@ -265,8 +346,10 @@ class TieredKv:
                 k, v = self.host.get([h])
                 k = k[:, :, 0] if k is not None else None
                 v = v[:, :, 0] if v is not None else None
-            elif self.disk is not None:
+            elif self.disk is not None and h in self.disk:
                 k, v = self.disk.get_block(h)
+            elif self.obj is not None:
+                k, v = self.obj.get_block(h)
             else:
                 raise KeyError(h)
             if k is None:
@@ -283,7 +366,9 @@ class TieredKv:
         s = dict(self.host.stats)
         if self.disk is not None:
             s.update({f"disk_{k}": val for k, val in self.disk.stats.items()})
+        if self.obj is not None:
+            s.update({f"obj_{k}": val for k, val in self.obj.stats.items()})
         return s
 
     def __contains__(self, h: int) -> bool:
-        return h in self.host or (self.disk is not None and h in self.disk)
+        return any(h in t for t in self._tiers())

@@ -1,0 +1,237 @@
+"""G4 object-store KV tier.
+
+Bottom rung of the KVBM ladder (reference tier model
+lib/kvbm-engine/src/lib.rs:9-24: G1 device / G2 host / G3 disk / G4 object
+store): blocks evicted from local disk demote into a durable,
+cluster-shared object store keyed by content hash, so any worker can
+onboard a prefix another worker computed — cross-node KV reuse without a
+transfer plane.
+
+Backends are pluggable: `FsBackend` (a shared/mounted directory — also the
+test double) and `S3Backend` (boto3, gated on availability; zero-egress
+environments use Fs). Blocks are serialized with the same header+raw
+format as the G3 tier (kvbm/disk_pool.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.kvbm.disk_pool import decode_block, encode_block
+
+log = logging.getLogger("dynamo_tpu.kvbm.object")
+
+
+class FsBackend:
+    """Object store over a (shared) filesystem directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def put(self, key: str, data: bytes) -> None:
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._path(key))
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list_keys(self) -> List[str]:
+        return [n for n in os.listdir(self.root) if n.endswith(".kvb")]
+
+
+class S3Backend:  # pragma: no cover - requires boto3 + network
+    """Object store over S3-compatible storage (reference G4 via NIXL
+    object plugins). Gated: raises if boto3 is unavailable."""
+
+    def __init__(self, bucket: str, prefix: str = "kv/", **client_kw):
+        try:
+            import boto3
+        except ImportError as e:
+            raise RuntimeError(
+                "S3 G4 backend requires boto3 (not present in this "
+                "environment); use FsBackend over a shared mount"
+            ) from e
+        self._s3 = boto3.client("s3", **client_kw)
+        self.bucket = bucket
+        self.prefix = prefix
+
+    def put(self, key: str, data: bytes) -> None:
+        self._s3.put_object(Bucket=self.bucket, Key=self.prefix + key, Body=data)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            return self._s3.get_object(Bucket=self.bucket, Key=self.prefix + key)[
+                "Body"
+            ].read()
+        except self._s3.exceptions.NoSuchKey:
+            return None
+
+    def delete(self, key: str) -> None:
+        self._s3.delete_object(Bucket=self.bucket, Key=self.prefix + key)
+
+    def list_keys(self) -> List[str]:
+        out, token = [], None
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": self.prefix}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self._s3.list_objects_v2(**kw)
+            out.extend(o["Key"][len(self.prefix):] for o in resp.get("Contents", []))
+            if not resp.get("IsTruncated"):
+                return out
+            token = resp.get("NextContinuationToken")
+
+
+class ObjectKvPool:
+    """Content-addressed KV blocks in an object store; same pool surface
+    as DiskKvPool so TieredKv chains it as the terminal tier. Writes run on
+    a background thread; capacity is TTL-free LRU in block count (object
+    stores are effectively unbounded — the cap only bounds the local
+    index)."""
+
+    def __init__(self, backend, capacity_blocks: int = 1 << 20):
+        self.backend = backend
+        self.capacity = capacity_blocks
+        self._blocks: "OrderedDict[int, Optional[int]]" = OrderedDict()
+        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
+        self._evict_listeners: List[Any] = []
+        self._lock = threading.Lock()
+        self._hash_only: set = set()  # entries with no data behind them
+        self._pending: Dict[int, Tuple[np.ndarray, np.ndarray, Optional[int]]] = {}
+        import queue
+
+        self._write_q: "queue.Queue" = queue.Queue()
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+        self._writer.start()
+        # adopt existing objects (shared store: another worker's blocks)
+        for key in backend.list_keys():
+            try:
+                self._blocks[int(key[:-4], 16)] = None
+            except ValueError:
+                continue
+        if self._blocks:
+            log.info("G4 adopted %d existing objects", len(self._blocks))
+
+    def _key(self, block_hash: int) -> str:
+        return f"{block_hash & 0xFFFFFFFFFFFFFFFF:016x}.kvb"
+
+    def on_evict(self, cb) -> None:
+        self._evict_listeners.append(cb)
+
+    def __contains__(self, h: int) -> bool:
+        with self._lock:
+            return h in self._blocks
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def put_block(self, block_hash, parent_hash, k, v) -> None:
+        with self._lock:
+            if block_hash in self._blocks:
+                self._blocks.move_to_end(block_hash)
+                # upgrade a hash-only entry (sim / failed earlier spill)
+                # when real data arrives; data-bearing entries are final
+                if k is None or block_hash not in self._hash_only:
+                    return
+                self._hash_only.discard(block_hash)
+            else:
+                self._blocks[block_hash] = parent_hash
+                self.stats["offloaded"] += 1
+            if k is not None:
+                self._pending[block_hash] = (k, v, parent_hash)
+            else:
+                self._hash_only.add(block_hash)
+        if k is not None:
+            self._write_q.put(block_hash)
+        self._enforce_capacity()
+
+    def _write_loop(self) -> None:
+        while True:
+            h = self._write_q.get()
+            if h is None:
+                return
+            with self._lock:
+                entry = self._pending.get(h)
+            if entry is None:
+                continue
+            k, v, parent = entry
+            try:
+                self.backend.put(self._key(h), encode_block(parent, k, v))
+            except Exception:
+                log.exception("G4 write failed for %x", h)
+                with self._lock:
+                    self._blocks.pop(h, None)
+            finally:
+                with self._lock:
+                    self._pending.pop(h, None)
+
+    def flush(self) -> None:
+        import time
+
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+            time.sleep(0.005)
+
+    def _enforce_capacity(self) -> None:
+        # capacity bounds the LOCAL index only: the store is shared, other
+        # workers may still index these objects, so nothing is deleted from
+        # the backend (lifecycle/GC is the store operator's policy)
+        dropped: List[int] = []
+        with self._lock:
+            while len(self._blocks) > self.capacity:
+                h, _ = self._blocks.popitem(last=False)
+                self._pending.pop(h, None)
+                dropped.append(h)
+                self.stats["evicted"] += 1
+        if dropped:
+            for cb in self._evict_listeners:
+                cb(dropped)
+
+    def match(self, hashes: List[int]) -> int:
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._blocks:
+                    break
+                n += 1
+        return n
+
+    def get_block(self, block_hash: int):
+        with self._lock:
+            self._blocks.move_to_end(block_hash)  # KeyError if gone
+            pending = self._pending.get(block_hash)
+        self.stats["onboarded"] += 1
+        if pending is not None:
+            return pending[0], pending[1]
+        data = self.backend.get(self._key(block_hash))
+        if data is None:
+            return None, None
+        _, k, v = decode_block(data)
+        return k, v

@@ -263,18 +263,36 @@ def forward(
         v_pool_l = v_pool[l_idx]
 
         qg = q.reshape(B, S, c.n_kv_heads, G, hd)
+        tp = mesh is not None and mesh.shape.get("model", 1) > 1
         if attn_impl == "pallas" and S == 1:
-            from dynamo_tpu.ops.paged_attention import decode_paged_attention
-
-            attn = decode_paged_attention(
-                qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens
-            )[:, None]  # [B, 1, Hk, G, hd]
-        elif attn_impl == "pallas":
-            from dynamo_tpu.ops.flash_prefill import prefill_paged_attention
-
-            attn = prefill_paged_attention(
-                qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens
+            from dynamo_tpu.ops.paged_attention import (
+                decode_paged_attention,
+                decode_paged_attention_sharded,
             )
+
+            if tp:
+                attn = decode_paged_attention_sharded(
+                    qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens, mesh
+                )[:, None]
+            else:
+                attn = decode_paged_attention(
+                    qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens
+                )[:, None]  # [B, 1, Hk, G, hd]
+        elif attn_impl == "pallas":
+            from dynamo_tpu.ops.flash_prefill import (
+                prefill_paged_attention,
+                prefill_paged_attention_sharded,
+            )
+
+            if tp:
+                attn = prefill_paged_attention_sharded(
+                    qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens,
+                    mesh,
+                )
+            else:
+                attn = prefill_paged_attention(
+                    qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens
+                )
         elif attn_impl == "ring":
             # sequence-parallel prefill: ring attention over this chunk's
             # fresh K/V (seq-sharded, ppermute over ICI) merged with paged

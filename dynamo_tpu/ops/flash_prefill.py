@@ -115,6 +115,36 @@ def _prefill_kernel(
         o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype).reshape(Hk, Sq, G, D)
 
 
+def prefill_paged_attention_sharded(
+    q: jax.Array,  # [B, S, Hk, G, D] heads sharded over `axis_name`
+    k_pool_l: jax.Array,  # [Hk, NP, PS, D]
+    v_pool_l: jax.Array,
+    page_table: jax.Array,
+    q_start: jax.Array,
+    q_len: jax.Array,
+    kv_lens: jax.Array,
+    mesh,
+    axis_name: str = "model",
+    *,
+    q_block: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel wrapper (see decode_paged_attention_sharded): each
+    model-axis shard runs the kernel over its local kv-heads."""
+    from jax.sharding import PartitionSpec as P
+
+    heads = P(None, None, axis_name, None, None)
+    pool = P(axis_name, None, None, None)
+    fn = jax.shard_map(
+        functools.partial(prefill_paged_attention, q_block=q_block, interpret=interpret),
+        mesh=mesh,
+        in_specs=(heads, pool, pool, P(None, None), P(None), P(None), P(None)),
+        out_specs=heads,
+        check_vma=False,
+    )
+    return fn(q, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens)
+
+
 @functools.partial(jax.jit, static_argnames=("q_block", "interpret"))
 def prefill_paged_attention(
     q: jax.Array,  # [B, S, Hk, G, D]

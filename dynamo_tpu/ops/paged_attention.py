@@ -94,6 +94,37 @@ def _decode_kernel(
         o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
+def decode_paged_attention_sharded(
+    q: jax.Array,  # [B, Hk, G, D] heads sharded over `axis_name`
+    k_pool_l: jax.Array,  # [Hk, NP, PS, D] heads sharded over `axis_name`
+    v_pool_l: jax.Array,
+    page_table: jax.Array,  # [B, MP] replicated
+    kv_lens: jax.Array,  # [B] replicated
+    mesh,
+    axis_name: str = "model",
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel wrapper: attention is independent per kv-head, and
+    the KV pool shards kv-heads over the model axis (ShardingPolicy), so
+    each shard runs the kernel on its local heads — zero collectives (the
+    block all-reduce happens later in the out-projection as usual)."""
+    from jax.sharding import PartitionSpec as P
+
+    heads = P(None, axis_name, None, None)
+    pool = P(axis_name, None, None, None)
+    rep2 = P(None, None)
+    rep1 = P(None)
+    fn = jax.shard_map(
+        functools.partial(decode_paged_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(heads, pool, pool, rep2, rep1),
+        out_specs=heads,
+        check_vma=False,
+    )
+    return fn(q, k_pool_l, v_pool_l, page_table, kv_lens)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def decode_paged_attention(
     q: jax.Array,  # [B, Hk, G, D]

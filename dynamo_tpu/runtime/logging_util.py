@@ -2,10 +2,11 @@
 
 Env-driven like the reference's DYN_LOG: `DYN_LOG=debug` or per-module
 filters `DYN_LOG=info,dynamo_tpu.router=debug`; `DYN_LOG_JSONL=1` switches
-to JSON-lines records (one object per line) for log shippers. OTLP export is
-out of scope in this environment (no collector); the JSONL format carries
-the same fields.
-"""
+to JSON-lines records (one object per line) for log shippers.
+`DYN_OTLP_ENDPOINT=http://collector:4318` additionally ships records to an
+OpenTelemetry collector over OTLP/HTTP JSON (/v1/logs) — plain urllib in a
+background thread, no otel SDK dependency (reference: OTLP exporter wired
+through tracing-subscriber, logging.rs)."""
 
 from __future__ import annotations
 
@@ -66,3 +67,82 @@ def configure_logging(default_level: str = "info") -> None:
     root.setLevel(root_level.upper())
     for mod, lvl in module_levels.items():
         logging.getLogger(mod).setLevel(lvl.upper())
+
+    otlp = os.environ.get("DYN_OTLP_ENDPOINT")
+    if otlp:
+        root.addHandler(OtlpLogHandler(otlp))
+
+
+_SEVERITY = {"DEBUG": 5, "INFO": 9, "WARNING": 13, "ERROR": 17, "CRITICAL": 21}
+
+
+class OtlpLogHandler(logging.Handler):
+    """Ship log records to an OTLP/HTTP collector (/v1/logs, JSON
+    encoding). Batched and posted from a daemon thread so logging never
+    blocks the serving path; drops on collector failure (telemetry is
+    best-effort)."""
+
+    def __init__(self, endpoint: str, service_name: str = "dynamo_tpu",
+                 flush_interval_s: float = 2.0, max_batch: int = 512):
+        super().__init__()
+        self.url = endpoint.rstrip("/") + "/v1/logs"
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=8192)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._q.put_nowait(
+                {
+                    "timeUnixNano": str(int(record.created * 1e9)),
+                    "severityNumber": _SEVERITY.get(record.levelname, 9),
+                    "severityText": record.levelname,
+                    "body": {"stringValue": record.getMessage()},
+                    "attributes": [
+                        {"key": "target",
+                         "value": {"stringValue": record.name}},
+                    ],
+                }
+            )
+        except Exception:
+            pass  # full queue: drop
+
+    def _loop(self) -> None:
+        import queue
+        import urllib.request
+
+        while True:
+            batch = [self._q.get()]
+            deadline = time.monotonic() + self.flush_interval_s
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._q.get(timeout=max(0.01, deadline - time.monotonic())))
+                except queue.Empty:
+                    break
+            payload = json.dumps(
+                {
+                    "resourceLogs": [
+                        {
+                            "resource": {"attributes": [
+                                {"key": "service.name",
+                                 "value": {"stringValue": self.service_name}},
+                            ]},
+                            "scopeLogs": [{"scope": {}, "logRecords": batch}],
+                        }
+                    ]
+                }
+            ).encode()
+            try:
+                req = urllib.request.Request(
+                    self.url, data=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                pass  # collector down: telemetry drops, serving unaffected

@@ -48,6 +48,9 @@ def parse_args(argv=None):
                    help="G3 disk KV tier capacity in blocks (needs G2 on)")
     p.add_argument("--disk-kv-root", default=None,
                    help="G3 tier directory (default: a temp dir)")
+    p.add_argument("--obj-kv-root", default=None,
+                   help="G4 object-store root (shared mount; enables the "
+                        "terminal KV tier)")
     # batching
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
@@ -169,6 +172,7 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
+        obj_kv_root=args.obj_kv_root,
     )
     card = ModelCard(
         name=args.model_name or config.name,

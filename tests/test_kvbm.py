@@ -239,3 +239,45 @@ def test_disk_pool_rescan_adopts_previous_files(tmp_path):
     p3 = DiskKvPool(str(tmp_path), capacity_blocks=1)
     assert len(p3) == 1
     assert len(list(tmp_path.glob("*.kvb"))) == 1
+
+
+def test_g4_object_pool_and_disk_spill(tmp_path):
+    import numpy as np
+
+    from dynamo_tpu.kvbm.disk_pool import DiskKvPool, TieredKv
+    from dynamo_tpu.kvbm.object_store import FsBackend, ObjectKvPool
+
+    host = HostKvPool(capacity_blocks=1)
+    disk = DiskKvPool(str(tmp_path / "g3"), capacity_blocks=2)
+    obj = ObjectKvPool(FsBackend(str(tmp_path / "g4")))
+    tier = TieredKv(host, disk, obj)
+    terminal = []
+    tier.on_evict(terminal.extend)
+
+    k = np.ones((2, 1, 5, 4, 8), np.float32)
+    tier.put([501, 502, 503, 504, 505], [None, 501, 502, 503, 504], k, k * 2)
+    disk.flush(); obj.flush()
+    # host keeps 1; disk keeps 2; the remaining 2 demoted to the object store
+    assert len(host) == 1 and len(disk) == 2 and len(obj) == 2
+    assert terminal == []  # demotion, never removal
+    assert tier.match([501, 502, 503, 504, 505]) == 5
+    k2, v2 = tier.get([501, 502, 503, 504, 505])
+    assert k2.shape == (2, 1, 5, 4, 8) and (v2 == 2).all()
+
+
+def test_g4_shared_store_cross_worker_adoption(tmp_path):
+    """A second pool over the same object root sees the first's blocks
+    (cross-node KV reuse through the shared store)."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.object_store import FsBackend, ObjectKvPool
+
+    k = np.full((2, 1, 4, 8), 3.0, np.float32)
+    p1 = ObjectKvPool(FsBackend(str(tmp_path)))
+    p1.put_block(601, None, k, k * 2)
+    p1.flush()
+
+    p2 = ObjectKvPool(FsBackend(str(tmp_path)))  # "another worker"
+    assert p2.match([601]) == 1
+    k2, v2 = p2.get_block(601)
+    np.testing.assert_array_equal(v2, k * 2)

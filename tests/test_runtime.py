@@ -248,3 +248,48 @@ async def test_stale_pooled_connection_retries_on_fresh_socket():
     await client.close()
     await crt.shutdown()
     await rt2.shutdown(drain_timeout=1)
+
+
+async def test_otlp_log_handler_ships_batches():
+    """OtlpLogHandler posts OTLP/HTTP JSON log batches to a collector."""
+    import asyncio
+    import logging
+
+    from aiohttp import web
+
+    from dynamo_tpu.runtime.logging_util import OtlpLogHandler
+
+    received = []
+
+    async def v1_logs(req):
+        received.append(await req.json())
+        return web.json_response({})
+
+    app = web.Application()
+    app.router.add_post("/v1/logs", v1_logs)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    handler = OtlpLogHandler(f"http://127.0.0.1:{port}", flush_interval_s=0.1)
+    lg = logging.getLogger("otlp-test")
+    lg.addHandler(handler)
+    lg.setLevel(logging.INFO)
+    try:
+        lg.info("hello otlp %d", 42)
+        lg.warning("warn line")
+        for _ in range(50):
+            if received:
+                break
+            await asyncio.sleep(0.1)
+        assert received, "collector should have received a batch"
+        recs = received[0]["resourceLogs"][0]["scopeLogs"][0]["logRecords"]
+        bodies = [r["body"]["stringValue"] for r in recs]
+        assert "hello otlp 42" in bodies
+        svc = received[0]["resourceLogs"][0]["resource"]["attributes"][0]
+        assert svc["value"]["stringValue"] == "dynamo_tpu"
+    finally:
+        lg.removeHandler(handler)
+        await runner.cleanup()
