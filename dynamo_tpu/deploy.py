@@ -1,0 +1,184 @@
+"""`python -m dynamo_tpu.deploy` — Kubernetes manifest generation.
+
+Analog of the reference's deploy tooling (deploy/: operator + CRDs +
+`dynamo deploy` graph targets): renders a complete serving graph —
+frontend Deployment+Service, worker Deployment(s) with TPU resources,
+optional disagg prefill pool, etcd discovery wiring — as plain
+Kubernetes YAML the planner's KubernetesConnector can then scale. No
+operator process is required: the CRD layer is flattened into core
+objects (the operator milestone can layer a controller on top).
+
+  python -m dynamo_tpu.deploy --model llama-3.2-3b --workers 4 \
+      --tensor-parallel 4 --tpu-type v5e --etcd http://etcd:2379 > graph.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _labels(component: str, graph: str) -> Dict[str, str]:
+    return {
+        "app.kubernetes.io/part-of": graph,
+        "app.kubernetes.io/component": component,
+        "app.kubernetes.io/managed-by": "dynamo-tpu-deploy",
+    }
+
+
+def _env(args, extra: Optional[Dict[str, str]] = None) -> List[Dict[str, str]]:
+    env = {"DYN_DISCOVERY_BACKEND": "etcd", "DYN_ETCD_ENDPOINT": args.etcd}
+    if args.otlp:
+        env["DYN_OTLP_ENDPOINT"] = args.otlp
+    env.update(extra or {})
+    return [{"name": k, "value": v} for k, v in sorted(env.items())]
+
+
+def worker_deployment(args, component: str, replicas: int, disagg_role: Optional[str]) -> Dict[str, Any]:
+    cmd = [
+        "python", "-m", "dynamo_tpu.worker",
+        "--model", args.model,
+        "--tensor-parallel", str(args.tensor_parallel),
+        "--discovery-backend", "etcd",
+        "--status-port", "8081",
+    ]
+    if args.checkpoint:
+        cmd += ["--checkpoint", args.checkpoint]
+    if disagg_role:
+        cmd += ["--disagg-role", disagg_role, "--component", component]
+    if args.quantize:
+        cmd += ["--quantize", args.quantize]
+    name = f"{args.graph}-{component}"
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": args.namespace,
+                     "labels": _labels(component, args.graph)},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": _labels(component, args.graph)},
+            "template": {
+                "metadata": {"labels": _labels(component, args.graph)},
+                "spec": {
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator": args.tpu_type,
+                        "cloud.google.com/gke-tpu-topology": args.tpu_topology,
+                    },
+                    "containers": [
+                        {
+                            "name": "worker",
+                            "image": args.image,
+                            "command": cmd,
+                            "env": _env(args),
+                            "resources": {
+                                "limits": {"google.com/tpu": str(args.tensor_parallel)}
+                            },
+                            "ports": [{"containerPort": 8081, "name": "status"}],
+                        }
+                    ],
+                    # SIGTERM → drain (worker_common handles it)
+                    "terminationGracePeriodSeconds": args.drain_seconds,
+                },
+            },
+        },
+    }
+
+
+def frontend_objects(args) -> List[Dict[str, Any]]:
+    name = f"{args.graph}-frontend"
+    labels = _labels("frontend", args.graph)
+    cmd = [
+        "python", "-m", "dynamo_tpu.frontend",
+        "--http-port", "8000",
+        "--router-mode", args.router_mode,
+        "--discovery-backend", "etcd",
+    ]
+    if args.frontend_replicas > 1:
+        cmd.append("--router-replica-sync")
+    dep = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": args.namespace, "labels": labels},
+        "spec": {
+            "replicas": args.frontend_replicas,
+            "selector": {"matchLabels": _labels("frontend", args.graph)},
+            "template": {
+                "metadata": {"labels": _labels("frontend", args.graph)},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "frontend",
+                            "image": args.image,
+                            "command": cmd,
+                            "env": _env(args),
+                            "ports": [{"containerPort": 8000, "name": "http"}],
+                            "readinessProbe": {
+                                "httpGet": {"path": "/ready", "port": 8000}
+                            },
+                            "livenessProbe": {
+                                "httpGet": {"path": "/live", "port": 8000}
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": args.namespace,
+                     "labels": _labels("frontend", args.graph)},
+        "spec": {
+            "selector": _labels("frontend", args.graph),
+            "ports": [{"name": "http", "port": 80, "targetPort": 8000}],
+        },
+    }
+    return [dep, svc]
+
+
+def render(args) -> List[Dict[str, Any]]:
+    objs = frontend_objects(args)
+    if args.disagg:
+        objs.append(worker_deployment(args, "decode", args.workers, "decode"))
+        objs.append(worker_deployment(args, "prefill", args.prefill_workers, "prefill"))
+    else:
+        objs.append(worker_deployment(args, "worker", args.workers, None))
+    return objs
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.deploy")
+    p.add_argument("--graph", default="dynamo-tpu", help="deployment graph name")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--image", default="dynamo-tpu:latest")
+    p.add_argument("--model", default="llama-3.2-3b")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--frontend-replicas", type=int, default=1)
+    p.add_argument("--tensor-parallel", type=int, default=1)
+    p.add_argument("--tpu-type", default="tpu-v5-lite-podslice")
+    p.add_argument("--tpu-topology", default="1x1")
+    p.add_argument("--router-mode", default="kv",
+                   choices=["round_robin", "random", "kv"])
+    p.add_argument("--disagg", action="store_true",
+                   help="split into prefill + decode worker pools")
+    p.add_argument("--prefill-workers", type=int, default=1)
+    p.add_argument("--quantize", default=None, choices=[None, "int8"])
+    p.add_argument("--etcd", default="http://etcd:2379")
+    p.add_argument("--otlp", default=None)
+    p.add_argument("--drain-seconds", type=int, default=120)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    import yaml
+
+    args = parse_args(argv)
+    docs = render(args)
+    sys.stdout.write(yaml.safe_dump_all(docs, sort_keys=False))
+
+
+if __name__ == "__main__":
+    main()

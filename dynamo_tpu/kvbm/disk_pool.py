@@ -73,6 +73,7 @@ class DiskKvPool:
         self.capacity = capacity_blocks
         # LRU index: hash → parent (file presence is authoritative for data)
         self._blocks: "OrderedDict[int, Optional[int]]" = OrderedDict()
+        self._hash_only: set = set()  # sim entries with no file behind them
         self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
         self._evict_listeners: List[Any] = []
         self._lock = threading.Lock()
@@ -199,6 +200,8 @@ class DiskKvPool:
             self._blocks[block_hash] = parent_hash
             if k is not None:
                 self._pending[block_hash] = (k, v)
+            else:
+                self._hash_only.add(block_hash)
             self.stats["offloaded"] += 1
         if k is not None:
             self._put_q(("write", block_hash, parent_hash, k, v))
@@ -226,17 +229,25 @@ class DiskKvPool:
                 dropped.append(h)
                 self.stats["evicted"] += 1
                 if self.spill_hook is None:
+                    self._hash_only.discard(h)
                     unlink_now.append(h)
                 elif pend is not None:
                     spill_mem.append((h, parent, pend))
                     unlink_now.append(h)
+                elif h in self._hash_only:
+                    # data-free (sim) entry: demote the hash itself
+                    self._hash_only.discard(h)
+                    spill_mem.append((h, parent, None))
                 else:
                     # already on disk: read + demote on the writer thread,
                     # never on the engine step thread (it unlinks after)
                     spill_deferred.append((h, parent))
         for h, parent, pend in spill_mem:
             try:
-                self.spill_hook(h, parent, pend[0], pend[1])
+                if pend is None:
+                    self.spill_hook(h, parent, None, None)
+                else:
+                    self.spill_hook(h, parent, pend[0], pend[1])
             except Exception:
                 log.exception("G3 spill hook failed for %x", h)
         for h, parent in spill_deferred:

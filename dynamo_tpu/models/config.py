@@ -24,6 +24,9 @@ class ModelConfig:
     n_experts: int = 0
     n_experts_active: int = 0
     moe_ffn_dim: int = 0
+    # EP dispatch capacity per (src,dst) lane as a multiple of the even
+    # split; n_experts/n_experts_active makes dispatch lossless
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:

@@ -329,7 +329,7 @@ def forward(
 
         x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
         if c.is_moe:
-            h = h + _moe_block(c, lp, x)
+            h = h + _moe_block(c, lp, x, mesh)
         else:
             gate = jax.nn.silu(lproj(mm(x, lp["w_gate"]), x, "w_gate"))
             up = lproj(mm(x, lp["w_up"]), x, "w_up")
@@ -401,11 +401,28 @@ def encode(
     return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
 
 
-def _moe_block(c: ModelConfig, lp, x: jax.Array) -> jax.Array:
-    """Token-choice top-k MoE (dense compute over experts for now; the
-    shard_map all-to-all EP path lands with the wide-EP milestone). x:
+def _moe_block(c: ModelConfig, lp, x: jax.Array, mesh=None) -> jax.Array:
+    """Token-choice top-k MoE. With an expert mesh axis (and unquantized
+    experts), tokens dispatch to their experts with one all_to_all over ICI
+    and return with a second (ops/moe_dispatch.py — wide-EP); otherwise the
+    dense path computes every expert under GSPMD expert sharding. x:
     [B, S, E] → [B, S, E]."""
+    from dynamo_tpu.models.quant import is_quantized
+
     B, S, E = x.shape
+    ep = mesh is not None and mesh.shape.get("expert", 1) > 1
+    if ep and not is_quantized(lp["we_gate"]) and (B * S) % mesh.shape["expert"] == 0:
+        from dynamo_tpu.ops.moe_dispatch import moe_ep
+
+        model_axis = "model" if mesh.shape.get("model", 1) > 1 else None
+        y = moe_ep(
+            x.reshape(B * S, E),
+            lp["w_router"], lp["we_gate"], lp["we_up"], lp["we_down"],
+            mesh, c.n_experts_active,
+            capacity_factor=c.moe_capacity_factor,
+            model_axis=model_axis,
+        )
+        return y.reshape(B, S, E)
     router_logits = (x @ lp["w_router"]).astype(jnp.float32)  # [B,S,n_exp]
     weights, sel = lax.top_k(router_logits, c.n_experts_active)
     weights = jax.nn.softmax(weights, axis=-1).astype(x.dtype)

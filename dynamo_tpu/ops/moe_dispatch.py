@@ -31,7 +31,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis: str):
+def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis: str,
+               model_axis=None):
     """Per-shard body. x: [T, E] local tokens; we_*: [n_local, ...] resident
     experts; router weights replicated. Returns [T, E]."""
     n_ranks = lax.psum(1, axis)
@@ -72,11 +73,16 @@ def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis
     rx = recv_x.reshape(n_ranks * capacity, E)
     re_ = recv_expert.reshape(n_ranks * capacity)
 
-    # run resident experts on every received token, select by expert id
+    # run resident experts on every received token, select by expert id.
+    # With a model axis, each expert's F dim is TP-sharded: the down-proj
+    # produces partial sums that one psum over `model` completes (the
+    # megatron row-parallel pattern inside the EP shard)
     def expert_fn(wg, wu, wd):
         return (jax.nn.silu(rx @ wg) * (rx @ wu)) @ wd  # [RC, E]
 
     all_out = jax.vmap(expert_fn)(we_gate, we_up, we_down)  # [n_local, RC, E]
+    if model_axis is not None:
+        all_out = lax.psum(all_out, model_axis)
     out_tok = jnp.take_along_axis(
         all_out.transpose(1, 0, 2), re_[:, None, None], axis=1
     )[:, 0]  # [RC, E]
@@ -104,6 +110,7 @@ def moe_ep(
     n_experts_active: int,
     capacity_factor: float = 2.0,
     axis: str = "expert",
+    model_axis=None,  # set to "model" for EP x TP expert weights
 ) -> jax.Array:
     """Token-dispatch EP MoE. Returns [T, E] with x's sharding."""
     n_ranks = mesh.shape[axis]
@@ -111,12 +118,20 @@ def moe_ep(
     n_experts = we_gate.shape[0]
     capacity = int(np.ceil(T_local * n_experts_active / n_ranks * capacity_factor))
 
+    ma = model_axis
     fn = jax.shard_map(
         partial(
-            _local_moe, k=n_experts_active, capacity=capacity, axis=axis
+            _local_moe, k=n_experts_active, capacity=capacity, axis=axis,
+            model_axis=ma,
         ),
         mesh=mesh,
-        in_specs=(P(axis, None), P(), P(axis, None, None), P(axis, None, None), P(axis, None, None)),
+        in_specs=(
+            P(axis, None),
+            P(),
+            P(axis, None, ma),  # [n_exp, E, F]: F TP-sharded when ma set
+            P(axis, None, ma),
+            P(axis, ma, None),  # [n_exp, F, E]
+        ),
         out_specs=P(axis, None),
     )
     return fn(x, w_router, we_gate, we_up, we_down)

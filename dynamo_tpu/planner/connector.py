@@ -6,8 +6,9 @@ planner-design.md:171-207).
   variant works across processes; tests and the k8s-less deployments use it.
 - LocalProcessConnector: actually spawns/kills local worker processes
   (mocker or TPU workers) — the single-host realization of scaling.
-- KubernetesConnector: would PATCH the graph deployment CRD; stubbed until
-  the operator milestone (no k8s client in this environment).
+- KubernetesConnector: scales per-component Deployments through the
+  apps/v1 scale subresource (plain REST + service-account auth; the
+  reference's connector PATCHes its operator's CRDs instead).
 """
 
 from __future__ import annotations
@@ -105,11 +106,88 @@ class LocalProcessConnector(Connector):
                     p.kill()
 
 
-class KubernetesConnector(Connector):  # pragma: no cover
-    """PATCHes the DynamoGraphDeployment-analog CRD; requires a cluster."""
+class KubernetesConnector(Connector):
+    """Scales worker Deployments through the Kubernetes API (the
+    reference's planner connector PATCHes DynamoGraphDeployment CRDs;
+    here each component maps to a Deployment named by
+    `deployment_for_component`). Speaks the plain REST API with the
+    service-account bearer token — no kubernetes client library."""
 
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "kubernetes connector requires a cluster client; use virtual or "
-            "local-process connectors in this environment"
+    def __init__(
+        self,
+        namespace: str = "default",
+        deployment_for_component: Optional[Dict[str, str]] = None,
+        api_base: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_verify: bool = True,
+    ):
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in a cluster (KUBERNETES_SERVICE_HOST unset) and no "
+                    "api_base given; use virtual or local-process connectors"
+                )
+            api_base = f"https://{host}:{port}"
+        if token is None and os.path.exists(f"{sa}/token"):
+            token = Path(f"{sa}/token").read_text().strip()
+        self.api_base = api_base.rstrip("/")
+        self.namespace = namespace
+        self.token = token
+        # in-cluster apiserver certs are signed by the cluster CA, not the
+        # system trust store — verify against the mounted bundle
+        self._ssl = True if ca_verify else False
+        if ca_verify and os.path.exists(f"{sa}/ca.crt"):
+            import ssl as _ssl
+
+            self._ssl = _ssl.create_default_context(cafile=f"{sa}/ca.crt")
+        self._names = deployment_for_component or {}
+        self._session = None
+
+    def _deployment(self, component: str) -> str:
+        return self._names.get(component, f"dynamo-tpu-{component}")
+
+    async def _http(self):
+        if self._session is None:
+            import aiohttp
+
+            headers = {}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            self._session = aiohttp.ClientSession(
+                headers=headers,
+                connector=aiohttp.TCPConnector(ssl=self._ssl),
+            )
+        return self._session
+
+    def _scale_url(self, component: str) -> str:
+        return (
+            f"{self.api_base}/apis/apps/v1/namespaces/{self.namespace}"
+            f"/deployments/{self._deployment(component)}/scale"
         )
+
+    async def scale_to(self, component: str, target_replicas: int) -> None:
+        s = await self._http()
+        async with s.patch(
+            self._scale_url(component),
+            json={"spec": {"replicas": int(target_replicas)}},
+            headers={"Content-Type": "application/merge-patch+json"},
+        ) as resp:
+            resp.raise_for_status()
+        log.info("k8s: scaled %s -> %d", self._deployment(component), target_replicas)
+
+    async def current_replicas(self, component: str) -> Optional[int]:
+        s = await self._http()
+        async with s.get(self._scale_url(component)) as resp:
+            if resp.status == 404:
+                return None
+            resp.raise_for_status()
+            body = await resp.json()
+        return int((body.get("spec") or {}).get("replicas", 0))
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

@@ -74,6 +74,8 @@ def parse_args(argv=None):
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")
+    p.add_argument("--status-port", type=int, default=0,
+                   help="serve /live /health /metrics on this port (0 = off)")
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
     return p.parse_args(argv)
@@ -196,6 +198,13 @@ async def async_main(args) -> None:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     engine, card = build_engine(args)
+    status = None
+    if args.status_port:
+        from dynamo_tpu.runtime.status import StatusServer
+
+        status = StatusServer(runtime, port=args.status_port)
+        status.add_check("engine", lambda: engine._thread is not None)
+        await status.start()
     from dynamo_tpu.worker_common import serve_worker
 
     worker = await serve_worker(
@@ -220,6 +229,8 @@ async def async_main(args) -> None:
         pass
     finally:
         await worker.stop()
+        if status is not None:
+            await status.stop()
         await runtime.shutdown()
 
 

@@ -1,0 +1,103 @@
+"""Kubernetes integration: manifest generation and the planner's
+KubernetesConnector against a fake apps/v1 scale API."""
+
+import asyncio
+import json
+
+import pytest
+import yaml
+from aiohttp import web
+
+from dynamo_tpu.deploy import parse_args, render
+from dynamo_tpu.planner.connector import KubernetesConnector
+
+
+def test_render_aggregated_graph():
+    docs = render(parse_args([
+        "--model", "llama-3.2-3b", "--workers", "3", "--tensor-parallel", "4",
+        "--frontend-replicas", "2",
+    ]))
+    kinds = [(d["kind"], d["metadata"]["name"]) for d in docs]
+    assert ("Deployment", "dynamo-tpu-frontend") in kinds
+    assert ("Service", "dynamo-tpu-frontend") in kinds
+    assert ("Deployment", "dynamo-tpu-worker") in kinds
+
+    worker = next(d for d in docs if d["metadata"]["name"] == "dynamo-tpu-worker")
+    spec = worker["spec"]["template"]["spec"]
+    assert worker["spec"]["replicas"] == 3
+    assert spec["containers"][0]["resources"]["limits"]["google.com/tpu"] == "4"
+    assert "--tensor-parallel" in spec["containers"][0]["command"]
+    env = {e["name"]: e["value"] for e in spec["containers"][0]["env"]}
+    assert env["DYN_DISCOVERY_BACKEND"] == "etcd"
+
+    fe = next(d for d in docs if d["kind"] == "Deployment"
+              and d["metadata"]["name"] == "dynamo-tpu-frontend")
+    assert "--router-replica-sync" in fe["spec"]["template"]["spec"]["containers"][0]["command"]
+    # round-trips through YAML
+    assert len(list(yaml.safe_load_all(yaml.safe_dump_all(docs)))) == len(docs)
+
+
+def test_render_disagg_graph():
+    docs = render(parse_args(["--disagg", "--workers", "2", "--prefill-workers", "1"]))
+    names = [d["metadata"]["name"] for d in docs if d["kind"] == "Deployment"]
+    assert "dynamo-tpu-decode" in names and "dynamo-tpu-prefill" in names
+    prefill = next(d for d in docs if d["metadata"]["name"] == "dynamo-tpu-prefill")
+    cmd = prefill["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--disagg-role" in cmd and "prefill" in cmd
+
+
+class FakeKubeApi:
+    def __init__(self):
+        self.replicas = {"dynamo-tpu-decode": 2}
+        self.auth_seen = []
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_get(
+            "/apis/apps/v1/namespaces/{ns}/deployments/{name}/scale", self._get
+        )
+        app.router.add_patch(
+            "/apis/apps/v1/namespaces/{ns}/deployments/{name}/scale", self._patch
+        )
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{port}"
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+    async def _get(self, req):
+        name = req.match_info["name"]
+        self.auth_seen.append(req.headers.get("Authorization"))
+        if name not in self.replicas:
+            return web.json_response({}, status=404)
+        return web.json_response(
+            {"kind": "Scale", "spec": {"replicas": self.replicas[name]}}
+        )
+
+    async def _patch(self, req):
+        name = req.match_info["name"]
+        body = await req.json()
+        self.replicas[name] = body["spec"]["replicas"]
+        return web.json_response({"kind": "Scale", "spec": body["spec"]})
+
+
+async def test_kubernetes_connector_scales_deployment():
+    api = FakeKubeApi()
+    base = await api.start()
+    conn = KubernetesConnector(
+        namespace="prod", api_base=base, token="sekrit-token",
+    )
+    try:
+        assert await conn.current_replicas("decode") == 2
+        await conn.scale_to("decode", 5)
+        assert api.replicas["dynamo-tpu-decode"] == 5
+        assert await conn.current_replicas("decode") == 5
+        assert await conn.current_replicas("nonexistent") is None
+        assert all(a == "Bearer sekrit-token" for a in api.auth_seen)
+    finally:
+        await conn.close()
+        await api.stop()

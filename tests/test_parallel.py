@@ -115,3 +115,32 @@ async def test_sp2_tp2_chunked_prefill_merges_prior_context():
     t_single = await _generate(_runner(MeshConfig()), prompt)
     t_sp = await _generate(_runner(MeshConfig(model=2, seq=2)), prompt)
     assert t_single == t_sp
+
+
+async def test_moe_ep2_token_dispatch_matches_single_device():
+    """Engine-level wide-EP: all-to-all token dispatch over the expert
+    axis must reproduce the single-device dense MoE greedily (lossless
+    capacity)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        get_config("tiny-moe"),
+        # lossless: capacity covers every routed token
+        moe_capacity_factor=float(get_config("tiny-moe").n_experts)
+        / get_config("tiny-moe").n_experts_active,
+    )
+
+    def mk(mesh_config):
+        return ModelRunner(
+            cfg, mesh_config,
+            num_pages=32, page_size=4, max_pages_per_seq=8,
+            decode_buckets=(1, 2, 4), prefill_buckets=(8,), seed=3,
+        )
+
+    prompt = [1, 2, 3, 4, 5, 6]
+    single = await _generate(mk(MeshConfig()), prompt, n=4)
+    ep2 = await _generate(mk(MeshConfig(expert=2)), prompt, n=4)
+    assert single == ep2
+
+    ep2_tp2 = await _generate(mk(MeshConfig(expert=2, model=2)), prompt, n=4)
+    assert single == ep2_tp2
