@@ -176,6 +176,16 @@ class InferenceEngine:
             kv_import=request.get("kv_import"),
             adapter=request.get("adapter"),
         )
+        mm = request.get("mm")
+        if mm:
+            import numpy as np
+
+            from dynamo_tpu.tokens.hashing import mm_content_seed
+
+            arr = np.frombuffer(mm["data"], dtype=np.dtype(mm["dtype"])).reshape(mm["shape"])
+            seq.mm_embeds = arr  # [n_img_tokens, E]
+            seq.mm_positions = [int(p) for p in mm["positions"]]
+            seq.mm_seed = mm_content_seed(mm["data"])
         if seq.adapter:
             try:
                 seq.adapter_idx = self.runner.adapter_slot(seq.adapter)
@@ -360,14 +370,34 @@ class InferenceEngine:
         self.scheduler.release_parked(seq)
         loop.call_soon_threadsafe(fut.set_result, payload)
 
+    def _mm_chunk(self, seq: Sequence, start: int, n: int):
+        """Multimodal embeddings falling inside [start, start+n) of the
+        prompt, re-based to chunk-local offsets (None if none do)."""
+        if seq.mm_embeds is None:
+            return None
+        idx = [
+            (i, p - start)
+            for i, p in enumerate(seq.mm_positions)
+            if start <= p < start + n
+        ]
+        if not idx:
+            return None
+        import numpy as np
+
+        rows, offs = zip(*idx)
+        return {"embeds": np.ascontiguousarray(seq.mm_embeds[list(rows)]),
+                "offsets": list(offs)}
+
     def _run_prefill(self, plan: PrefillPlan) -> None:
         seq = plan.seq
+        mm_chunk = self._mm_chunk(seq, plan.start_pos, len(plan.chunk))
         logits = self.runner.prefill(
             plan.chunk,
             plan.start_pos,
             seq.pages,
             prior_len=plan.start_pos,
             adapter=seq.adapter_idx,
+            mm=mm_chunk,
         )
         if getattr(self.runner, "has_draft", False) and seq.disagg != "prefill":
             # keep the draft model's KV pools in lockstep so spec decode
@@ -375,7 +405,8 @@ class InferenceEngine:
             # workers: draft KV isn't exported — the decode worker rebuilds
             # it on admission)
             self.runner.draft_prefill(
-                plan.chunk, plan.start_pos, seq.pages, prior_len=plan.start_pos
+                plan.chunk, plan.start_pos, seq.pages, prior_len=plan.start_pos,
+                mm=mm_chunk,
             )
         self.scheduler.complete_prefill(plan)
         if not plan.is_last_chunk:

@@ -251,13 +251,16 @@ class ModelRunner:
         page_table_row: List[int],
         prior_len: int,
         adapter: int = 0,
+        mm: Optional[Dict[str, Any]] = None,  # {"embeds": [n,E], "offsets": [n]}
     ) -> jax.Array:
         """Run one prefill chunk for a single sequence. `tokens` are the
         uncomputed prompt tokens starting at absolute position `start_pos`;
         `prior_len` is the context length already in the pool (prefix-cache
-        hits + earlier chunks). Returns last-token logits [V] (device)."""
+        hits + earlier chunks). `mm` injects multimodal embeddings at
+        chunk-local offsets. Returns last-token logits [V] (device)."""
         tok, pos, pt, kv_lens, n = self._prep_prefill(tokens, start_pos, page_table_row, prior_len)
         impl = "ring" if self.sp_enabled else self.attn_impl
+        mm_embeds, mm_mask = self._mm_arrays(mm, tok.shape[1])
         logits, self.k_pool, self.v_pool = self._jit_forward(
             self.params, tok, pos, self.k_pool, self.v_pool, pt, kv_lens,
             jnp.int32(n - 1), attn_impl=impl,
@@ -265,8 +268,22 @@ class ModelRunner:
             sp_has_prior=prior_len > 0,
             lora=self.lora,
             adapter_idx=jnp.asarray([adapter], jnp.int32) if self.lora is not None else None,
+            mm_embeds=mm_embeds, mm_mask=mm_mask,
         )
         return logits[0, 0]
+
+    def _mm_arrays(self, mm: Optional[Dict[str, Any]], S: int):
+        """(mm_embeds [1,S,E], mm_mask [1,S]) padded to the bucket, or
+        (None, None)."""
+        if mm is None:
+            return None, None
+        E = self.config.dim
+        embeds = np.zeros((1, S, E), np.float32)
+        mask = np.zeros((1, S), bool)
+        for row, off in zip(mm["embeds"], mm["offsets"]):
+            embeds[0, off] = row
+            mask[0, off] = True
+        return jnp.asarray(embeds), jnp.asarray(mask)
 
     def _prep_prefill(self, tokens: List[int], start_pos: int, page_table_row: List[int], prior_len: int):
         """Bucket-pad one prefill chunk into device inputs (shared by the
@@ -410,15 +427,20 @@ class ModelRunner:
         start_pos: int,
         page_table_row: List[int],
         prior_len: int,
+        mm: Optional[Dict[str, Any]] = None,
     ) -> None:
         """Prefill the DRAFT model's KV pools for a chunk (same page
         table as the target). Logits are discarded — only the KV matters
-        for later proposals."""
+        for later proposals. mm is injected only when the draft's hidden
+        size matches (otherwise proposals just degrade, never correctness)."""
         tok, pos, pt, kv_lens, n = self._prep_prefill(tokens, start_pos, page_table_row, prior_len)
+        mm_embeds = mm_mask = None
+        if mm is not None and self.draft_config.dim == self.config.dim:
+            mm_embeds, mm_mask = self._mm_arrays(mm, tok.shape[1])
         _, self.draft_k_pool, self.draft_v_pool = self._jit_draft_forward(
             self.draft_params, tok, pos, self.draft_k_pool, self.draft_v_pool,
             pt, kv_lens, jnp.int32(n - 1), attn_impl=self.attn_impl,
-            mesh=self._fwd_mesh,
+            mesh=self._fwd_mesh, mm_embeds=mm_embeds, mm_mask=mm_mask,
         )
 
     def sample_one(self, logits: jax.Array, sampling, step: int) -> int:

@@ -24,14 +24,14 @@ from enum import Enum
 from typing import Any, Dict, List, Optional, Tuple
 
 from dynamo_tpu.engine.kv_pool import NoSpace, PagePool
-from dynamo_tpu.tokens.hashing import adapter_seed, block_hashes, hash_block
+from dynamo_tpu.tokens.hashing import block_hashes, hash_block, request_seed
 
 
 def _chain_seed(seq: "Sequence") -> Optional[int]:
-    """Hash-chain seed: LoRA-attributed sequences get a disjoint block
-    lineage (their K/V is adapter-dependent and must never be shared with
-    base-model or other-adapter sequences)."""
-    return adapter_seed(seq.adapter) if seq.adapter else None
+    """Hash-chain seed: LoRA adapters and multimodal content each fork the
+    block lineage (K/V depends on both; equal token ids under different
+    adapters or images must never share cache blocks)."""
+    return request_seed(seq.adapter, seq.mm_seed)
 
 log = logging.getLogger("dynamo_tpu.engine.scheduler")
 
@@ -57,6 +57,11 @@ class Sequence:
     kv_import: Any = None  # opaque page payload for disagg-decode admission
     adapter: Optional[str] = None  # LoRA adapter name (None = base model)
     adapter_idx: int = 0  # resolved slot (engine sets at admission)
+    # multimodal: embeddings for image-placeholder positions (np [n, E]),
+    # their absolute prompt positions, and a content hash for KV isolation
+    mm_embeds: Any = None
+    mm_positions: Any = None
+    mm_seed: Optional[int] = None
     state: SeqState = SeqState.WAITING
     tokens: List[int] = field(default_factory=list)  # prompt + generated
     pages: List[int] = field(default_factory=list)

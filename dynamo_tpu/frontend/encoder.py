@@ -1,0 +1,117 @@
+"""Multimodal EPD: the Encode hop and its router.
+
+Reference model (multimodal EPD docs + EncoderRouter): requests carrying
+images first visit an encoder worker that runs the vision model; the
+resulting embeddings travel with the request into prefill, where they are
+injected at image-placeholder token positions. The EncoderOperator below is
+the frontend pipeline stage; `serve_encoder` is the worker side.
+
+Wire contract:
+  encode request:  {"images": [png/jpeg bytes, ...]}
+  encode response: {"embeds": {"data": bytes, "shape": [n, T_img, E],
+                               "dtype": str}}
+  engine request gains: {"mm": {"data", "shape" [n_tok, E], "dtype",
+                                "positions": [prompt offsets]}}
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Dict, List
+
+import numpy as np
+
+log = logging.getLogger("dynamo_tpu.frontend.encoder")
+
+ENCODE_ENDPOINT = "encoder/encode"  # {namespace}/encoder/encode
+
+
+class EncoderOperator:
+    """Pipeline stage: requests with `images` call the encoder component
+    (EncoderRouter = round-robin over discovered encoder instances), map
+    the returned embeddings onto the prompt's image-placeholder positions,
+    and forward with the `mm` payload."""
+
+    def __init__(self, runtime, card, inner, namespace: str = "dyn"):
+        self.runtime = runtime
+        self.card = card
+        self.inner = inner
+        self.namespace = namespace
+        self._client = None
+
+    async def _encode(self, images: List[bytes]) -> np.ndarray:
+        if self._client is None:
+            self._client = self.runtime.client(f"{self.namespace}/{ENCODE_ENDPOINT}")
+            await self._client.start()
+            await self._client.wait_ready(timeout=10)
+        async for item in self._client.generate({"images": list(images)}):
+            e = item["embeds"]
+            return np.frombuffer(e["data"], dtype=np.dtype(e["dtype"])).reshape(e["shape"])
+        raise RuntimeError("encoder returned no embeddings")
+
+    async def generate(self, request: Dict[str, Any], context) -> AsyncIterator[Any]:
+        images = request.get("images")
+        if images:
+            vision = self.card.vision or {}
+            tok_id = vision.get("image_token_id")
+            positions = [
+                i for i, t in enumerate(request.get("token_ids") or []) if t == tok_id
+            ]
+            embeds = await self._encode(images)  # [n_img, T_img, E]
+            flat = embeds.reshape(-1, embeds.shape[-1])
+            if len(positions) != flat.shape[0]:
+                raise ValueError(
+                    f"prompt has {len(positions)} image-placeholder tokens but "
+                    f"the encoder produced {flat.shape[0]} embeddings"
+                )
+            request = dict(request)
+            request["mm"] = {
+                "data": np.ascontiguousarray(flat, np.float32).tobytes(),
+                "shape": [flat.shape[0], flat.shape[1]],
+                "dtype": "float32",
+                "positions": positions,
+            }
+            request.pop("images", None)
+        async for item in self.inner.generate(request, context):
+            yield item
+
+
+class EncodeEngine:
+    """Worker-side encode endpoint: decode + resize images, run the vision
+    encoder, return embeddings (AsyncEngine over the request plane)."""
+
+    def __init__(self, vision_config, vision_params):
+        self.config = vision_config
+        self.params = vision_params
+
+    def _pixels(self, blobs: List[bytes]) -> np.ndarray:
+        import io
+
+        from PIL import Image
+
+        size = self.config.image_size
+        out = np.zeros((len(blobs), size, size, 3), np.float32)
+        for i, blob in enumerate(blobs):
+            img = Image.open(io.BytesIO(blob)).convert("RGB").resize((size, size))
+            out[i] = np.asarray(img, np.float32) / 255.0
+        return out
+
+    async def generate(self, request: Dict[str, Any], context) -> AsyncIterator[Any]:
+        from dynamo_tpu.models.vision import encode_images
+
+        blobs = request.get("images") or []
+        pixels = self._pixels(blobs)
+        import jax
+
+        embeds = np.asarray(
+            jax.device_get(encode_images(self.config, self.params, pixels)),
+            np.float32,
+        )
+        yield {
+            "embeds": {
+                "data": embeds.tobytes(),
+                "shape": list(embeds.shape),
+                "dtype": "float32",
+            },
+            "finish_reason": "stop",
+        }

@@ -90,16 +90,70 @@ class Preprocessor:
             ignore_eos=bool(req.get("ignore_eos", False)),
         )
 
+    _IMG_SENTINEL = "\x00<image>\x00"
+
+    def _flatten_multimodal(self, messages, images_out: list):
+        """Content-block messages → plain-text messages with an image
+        sentinel per image (replaced by placeholder token runs after
+        tokenization); collects decoded image bytes in order."""
+        import base64
+
+        flat = []
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, list):
+                flat.append(m)
+                continue
+            parts = []
+            for b in content:
+                t = b.get("type")
+                if t in ("text", "input_text"):
+                    parts.append(b.get("text", ""))
+                elif t == "image_url":
+                    url = (b.get("image_url") or {}).get("url", "")
+                    if not url.startswith("data:") or "," not in url:
+                        raise ValueError(
+                            "image_url must be a data: URL with base64 "
+                            "payload (no egress from this deployment)"
+                        )
+                    try:
+                        images_out.append(base64.b64decode(url.split(",", 1)[1]))
+                    except Exception as e:
+                        raise ValueError(f"invalid base64 image payload: {e}")
+                    parts.append(self._IMG_SENTINEL)
+                else:
+                    raise ValueError(
+                        f"unsupported content block type {t!r} "
+                        "(supported: text, image_url)"
+                    )
+            flat.append({**m, "content": "".join(parts)})
+        return flat
+
     def preprocess_chat(self, req: Dict[str, Any]) -> Dict[str, Any]:
         tools = req.get("tools") or None
-        prompt = self.render_chat(req.get("messages") or [], tools=tools)
-        ids = self.tokenize_prompt(prompt)
+        images: list = []
+        messages = self._flatten_multimodal(req.get("messages") or [], images)
+        prompt = self.render_chat(messages, tools=tools)
+        if images:
+            vision = self.card.vision or {}
+            if not vision:
+                raise ValueError("model serves no vision encoder (no images)")
+            n_tok = int(vision["n_image_tokens"])
+            img_id = int(vision["image_token_id"])
+            ids: List[int] = []
+            for i, seg in enumerate(prompt.split(self._IMG_SENTINEL)):
+                seg_ids = self.tokenize_prompt(seg, add_bos=(i == 0))
+                ids.extend(seg_ids)
+                if i < len(images):
+                    ids.extend([img_id] * n_tok)
+        else:
+            ids = self.tokenize_prompt(prompt)
         self._check_context(len(ids))
         annotations: Dict[str, Any] = {"kind": "chat"}
         if tools:
             # response assembly runs the tool-call parser on the output
             annotations["tools"] = True
-        return make_preprocessed_request(
+        out = make_preprocessed_request(
             model=req.get("model", self.card.name),
             token_ids=ids,
             sampling=self._sampling(req),
@@ -107,6 +161,9 @@ class Preprocessor:
             annotations=annotations,
             adapter=self.adapter,
         )
+        if images:
+            out["images"] = images
+        return out
 
     def preprocess_completions(self, req: Dict[str, Any]) -> Dict[str, Any]:
         prompt = req.get("prompt") or ""

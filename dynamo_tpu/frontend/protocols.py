@@ -25,6 +25,9 @@ class ModelCard:
     kv_block_size: int = 16
     model_type: str = "completions"  # completions | embeddings
     adapters: List[str] = field(default_factory=list)  # served LoRA names
+    # multimodal: {"image_token_id", "n_image_tokens", "image_size"} when
+    # the graph includes encoder workers
+    vision: Optional[Dict[str, Any]] = None
     runtime_config: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:

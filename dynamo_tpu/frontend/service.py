@@ -123,7 +123,14 @@ class ModelWatcher:
             DisaggPolicy(min_prefill_tokens=self.disagg_min_prefill_tokens),
         )
         backend = BackendOperator(pre.tokenizer, prefill_router)
-        return Migration(backend, migration_limit=self.migration_limit), teardown, prefill_router
+        chain: AsyncEngine = Migration(backend, migration_limit=self.migration_limit)
+        if card.vision:
+            from dynamo_tpu.frontend.encoder import EncoderOperator
+
+            # encode endpoint lives in the worker's namespace
+            ns = client.path.split("/")[0]
+            chain = EncoderOperator(self.runtime, card, chain, namespace=ns)
+        return chain, teardown, prefill_router
 
     async def start(self) -> None:
         if self._task is None:

@@ -98,7 +98,7 @@ class SimRunner:
         self.vocab_size = vocab_size
 
     # -- ModelRunner interface ---------------------------------------------
-    def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int, adapter: int = 0):
+    def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int, adapter: int = 0, mm=None):
         t = self.timing
         t.sleep(t.prefill_base_s + len(tokens) * t.prefill_per_token_s)
         # "logits": seeded by the LAST prompt token + position only, so the

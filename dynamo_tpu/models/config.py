@@ -25,8 +25,11 @@ class ModelConfig:
     n_experts_active: int = 0
     moe_ffn_dim: int = 0
     # EP dispatch capacity per (src,dst) lane as a multiple of the even
-    # split; n_experts/n_experts_active makes dispatch lossless
-    moe_capacity_factor: float = 2.0
+    # split. 0.0 (default) = lossless (n_experts/n_experts_active): the EP
+    # path then matches the dense path exactly, so the shape-dependent
+    # EP/dense selection never changes results. Operators trade memory for
+    # drops by setting e.g. 1.5.
+    moe_capacity_factor: float = 0.0
 
     @property
     def head_dim(self) -> int:

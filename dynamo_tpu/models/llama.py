@@ -205,6 +205,8 @@ def forward(
     #   pass entirely (fresh prefill — the common SP case)
     lora: Optional[Params] = None,  # stacked multi-adapter tree (models/lora.py)
     adapter_idx: Optional[jax.Array] = None,  # [B] slot per sequence (0=base)
+    mm_embeds: Optional[jax.Array] = None,  # [B, S, E] multimodal embeddings
+    mm_mask: Optional[jax.Array] = None,  # [B, S] True → replace token embed
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass (covers prefill chunks S>1 and decode S=1).
 
@@ -221,6 +223,12 @@ def forward(
     G = c.n_heads // c.n_kv_heads
 
     h = params["embed"][tokens]  # [B, S, E] (gather)
+    if mm_embeds is not None:
+        # multimodal injection: image-placeholder positions take the vision
+        # encoder's embeddings instead of the token embedding (prefix-cache
+        # correctness relies on the scheduler salting block hashes with the
+        # image content — scheduler._chain_seed)
+        h = jnp.where(mm_mask[..., None], mm_embeds.astype(h.dtype), h)
     safe_pos = jnp.maximum(positions, 0)
     # prefill-kernel metadata: valid tokens are a contiguous run from s=0
     # (ModelRunner contract), so start/len fully describe the positions
@@ -415,11 +423,12 @@ def _moe_block(c: ModelConfig, lp, x: jax.Array, mesh=None) -> jax.Array:
         from dynamo_tpu.ops.moe_dispatch import moe_ep
 
         model_axis = "model" if mesh.shape.get("model", 1) > 1 else None
+        cf = c.moe_capacity_factor or (c.n_experts / c.n_experts_active)
         y = moe_ep(
             x.reshape(B * S, E),
             lp["w_router"], lp["we_gate"], lp["we_up"], lp["we_down"],
             mesh, c.n_experts_active,
-            capacity_factor=c.moe_capacity_factor,
+            capacity_factor=cf,
             model_axis=model_axis,
         )
         return y.reshape(B, S, E)

@@ -1,0 +1,107 @@
+"""Vision encoder for multimodal (image → LLM-space embeddings).
+
+The E in EPD disaggregation (reference: encoder workers + EncoderRouter
+route image inputs through a vision model before prefill,
+docs multimodal EPD): a compact ViT — patchify, pre-LN transformer,
+project to the language model's hidden size — whose output embeddings are
+injected into the prompt at image-placeholder positions
+(models/llama.py `mm_embeds`).
+
+TPU-first: fixed image size → static shapes; all images in a request are
+encoded as one batch; layers stacked + lax.scan like the LLM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    mlp_dim: int = 1024
+    out_dim: int = 256  # language model hidden size
+    norm_eps: float = 1e-5
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+TINY_VISION = VisionConfig(image_size=32, patch_size=8, dim=64, n_layers=2,
+                           n_heads=2, mlp_dim=128, out_dim=64)
+
+
+def init_params(config: VisionConfig, key: jax.Array, dtype=jnp.bfloat16) -> Dict[str, Any]:
+    c = config
+    k = jax.random.split(key, 8)
+    pdim = c.patch_size * c.patch_size * 3
+
+    def w(key, fan_in, *shape):
+        return (jax.random.normal(key, shape) * (fan_in**-0.5)).astype(dtype)
+
+    L = c.n_layers
+    return {
+        "patch_proj": w(k[0], pdim, pdim, c.dim),
+        "pos_embed": w(k[1], c.dim, c.n_patches, c.dim),
+        "layers": {
+            "ln1": jnp.ones((L, c.dim), jnp.float32),
+            "wqkv": w(k[2], c.dim, L, c.dim, 3 * c.dim),
+            "wo": w(k[3], c.dim, L, c.dim, c.dim),
+            "ln2": jnp.ones((L, c.dim), jnp.float32),
+            "w1": w(k[4], c.dim, L, c.dim, c.mlp_dim),
+            "w2": w(k[5], c.mlp_dim, L, c.mlp_dim, c.dim),
+        },
+        "ln_f": jnp.ones((c.dim,), jnp.float32),
+        "out_proj": w(k[6], c.dim, c.dim, c.out_dim),
+    }
+
+
+def _ln(x, g, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps) * g).astype(x.dtype)
+
+
+def patchify(pixels: jax.Array, patch: int) -> jax.Array:
+    """[N, H, W, 3] → [N, n_patches, patch*patch*3]."""
+    N, H, W, C = pixels.shape
+    gh, gw = H // patch, W // patch
+    x = pixels.reshape(N, gh, patch, gw, patch, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(N, gh * gw, patch * patch * C)
+
+
+def encode_images(config: VisionConfig, params, pixels: jax.Array) -> jax.Array:
+    """pixels [N, H, W, 3] float in [0,1] → embeddings [N, n_patches,
+    out_dim] in the language model's hidden space."""
+    c = config
+    x = patchify(pixels.astype(jnp.bfloat16), c.patch_size) @ params["patch_proj"]
+    x = x + params["pos_embed"][None]
+    N, T, D = x.shape
+    hd = c.dim // c.n_heads
+
+    def layer(x, lp):
+        h = _ln(x, lp["ln1"], c.norm_eps)
+        qkv = (h @ lp["wqkv"]).reshape(N, T, 3, c.n_heads, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * hd**-0.5
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhts,bshd->bthd", p, v).reshape(N, T, c.dim)
+        x = x + attn @ lp["wo"]
+        h = _ln(x, lp["ln2"], c.norm_eps)
+        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        return x, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = _ln(x, params["ln_f"], c.norm_eps)
+    return x @ params["out_proj"]  # [N, T, out_dim]

@@ -286,16 +286,18 @@ class KvRouter:
         return sorted(out)
 
     def find_best_match(
-        self, token_ids: List[int], adapter: Optional[str] = None
+        self, token_ids: List[int], adapter: Optional[str] = None,
+        mm_seed: Optional[int] = None,
     ) -> Tuple[Worker, int, List[int]]:
-        """Returns (worker, overlap_blocks, block_hashes). `adapter` seeds
-        the hash chain exactly like the worker scheduler does, so LoRA
-        requests score overlap only against their own adapter's cached
-        blocks (never false-matching base-model KV)."""
-        from dynamo_tpu.tokens.hashing import adapter_seed
+        """Returns (worker, overlap_blocks, block_hashes). `adapter` and
+        `mm_seed` seed the hash chain exactly like the worker scheduler
+        (tokens/hashing.request_seed), so LoRA and multimodal requests
+        score overlap only against their own lineage's cached blocks."""
+        from dynamo_tpu.tokens.hashing import request_seed
 
-        seed = adapter_seed(adapter) if adapter else None
-        hashes = block_hashes(token_ids, self.block_size, seed)
+        hashes = block_hashes(
+            token_ids, self.block_size, request_seed(adapter, mm_seed)
+        )
         overlaps = self.indexer.index.find_matches(hashes)
         host_overlaps = self.indexer.host_index.find_matches(hashes).scores
         workers = self.workers()
@@ -359,8 +361,14 @@ class KvPushRouter:
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
         await self.router.start()
         token_ids = request.get("token_ids") or []
+        mm = request.get("mm")
+        mm_seed = None
+        if mm:
+            from dynamo_tpu.tokens.hashing import mm_content_seed
+
+            mm_seed = mm_content_seed(mm["data"])
         worker, overlap, hashes = self.router.find_best_match(
-            token_ids, adapter=request.get("adapter")
+            token_ids, adapter=request.get("adapter"), mm_seed=mm_seed
         )
         rid = context.id
         self.router.add_request(rid, worker, hashes, overlap)

@@ -42,6 +42,22 @@ def block_hashes(
     return out
 
 
+def request_seed(adapter: Optional[str], mm_seed: Optional[int]) -> Optional[int]:
+    """Canonical hash-chain seed for a request: LoRA adapter and multimodal
+    content each fork the block lineage. The router and the worker
+    scheduler MUST compose seeds identically or overlap scoring breaks."""
+    seed = adapter_seed(adapter) if adapter else None
+    if mm_seed:
+        seed = hash_block(seed, [mm_seed & 0xFFFFFFFF, mm_seed >> 32])
+    return seed
+
+
+def mm_content_seed(data: bytes) -> int:
+    """Content hash of a multimodal embedding payload (blake2b-8)."""
+    h = hashlib.blake2b(data, digest_size=8)
+    return int.from_bytes(h.digest(), "little")
+
+
 def adapter_seed(name: str) -> int:
     """Chain seed for a LoRA adapter: block hashes of adapter-attributed
     sequences live in a disjoint lineage from base-model hashes."""

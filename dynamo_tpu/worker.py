@@ -74,6 +74,11 @@ def parse_args(argv=None):
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")
+    p.add_argument("--vision", action="store_true",
+                   help="serve a vision encoder (multimodal EPD): publishes "
+                        "the encode endpoint + vision card info")
+    p.add_argument("--image-token-id", type=int, default=None,
+                   help="placeholder token id (default: vocab_size - 1)")
     p.add_argument("--status-port", type=int, default=0,
                    help="serve /live /health /metrics on this port (0 = off)")
     p.add_argument("--discovery-backend", default=None)
@@ -176,12 +181,32 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
         disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
         obj_kv_root=args.obj_kv_root,
     )
+    vision = None
+    if args.vision:
+        from dynamo_tpu.models.vision import TINY_VISION, VisionConfig
+
+        import dataclasses as _dc
+
+        vcfg = _dc.replace(
+            TINY_VISION if config.dim <= 256 else VisionConfig(),
+            out_dim=config.dim,
+        )
+        args._vision_config = vcfg
+        vision = {
+            "image_token_id": (
+                args.image_token_id if args.image_token_id is not None
+                else config.vocab_size - 1
+            ),
+            "n_image_tokens": vcfg.n_patches,
+            "image_size": vcfg.image_size,
+        }
     card = ModelCard(
         name=args.model_name or config.name,
         tokenizer=args.tokenizer,
         context_length=args.max_seq_len,
         kv_block_size=args.page_size,
         adapters=[s.partition("=")[0] for s in args.lora],
+        vision=vision,
         runtime_config={
             "mesh": list(mesh.shape),
             "num_pages": args.num_pages,
@@ -198,6 +223,17 @@ async def async_main(args) -> None:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     engine, card = build_engine(args)
+    if args.vision:
+        import jax
+
+        from dynamo_tpu.frontend.encoder import ENCODE_ENDPOINT, EncodeEngine
+        from dynamo_tpu.models import vision as vision_mod
+
+        vparams = vision_mod.init_params(args._vision_config, jax.random.PRNGKey(7))
+        await runtime.serve_endpoint(
+            f"{args.namespace}/{ENCODE_ENDPOINT}",
+            EncodeEngine(args._vision_config, vparams),
+        )
     status = None
     if args.status_port:
         from dynamo_tpu.runtime.status import StatusServer
