@@ -1,0 +1,233 @@
+"""`python -m dynamo_tpu.global_router` — multi-cluster routing tier.
+
+Analog of the reference's global router / multi-cluster story: a thin HTTP
+tier above per-cluster frontends. Each cluster runs its own frontend +
+workers + (optionally) planner; the global router unions their model
+lists, routes each request to the healthiest cluster serving that model
+(least in-flight, with periodic health probes), streams SSE through, and
+fails over when a cluster stops answering.
+
+Clusters come from --cluster flags (repeatable); add_cluster /
+remove_cluster let an external controller (e.g. a config watcher) manage
+the set at runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+import aiohttp
+from aiohttp import web
+
+log = logging.getLogger("dynamo_tpu.global_router")
+
+HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection"}
+
+
+@dataclass
+class Cluster:
+    base: str  # http://frontend:8000
+    healthy: bool = True
+    models: Set[str] = field(default_factory=set)
+    in_flight: int = 0
+    last_ok: float = 0.0
+
+
+class GlobalRouter:
+    def __init__(self, clusters: List[str], probe_interval_s: float = 2.0):
+        self.clusters: Dict[str, Cluster] = {
+            c.rstrip("/"): Cluster(c.rstrip("/")) for c in clusters
+        }
+        self.probe_interval_s = probe_interval_s
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._probe_task: Optional[asyncio.Task] = None
+        self._runner = None
+
+    def add_cluster(self, base: str) -> None:
+        base = base.rstrip("/")
+        if base not in self.clusters:
+            self.clusters[base] = Cluster(base)
+
+    def remove_cluster(self, base: str) -> None:
+        self.clusters.pop(base.rstrip("/"), None)
+
+    async def _http(self) -> aiohttp.ClientSession:
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    # -- health / model discovery ------------------------------------------
+    async def _probe_once(self) -> None:
+        s = await self._http()
+
+        async def probe(c: Cluster) -> None:
+            try:
+                async with s.get(
+                    c.base + "/v1/models", timeout=aiohttp.ClientTimeout(total=3)
+                ) as r:
+                    body = await r.json()
+                c.models = {m["id"] for m in body.get("data", [])}
+                c.healthy = True
+                c.last_ok = time.monotonic()
+            except Exception:
+                c.healthy = False
+
+        # concurrent: dead clusters must not serialize their timeouts into
+        # the probe cycle (failure detection stays ~O(timeout), not O(n))
+        await asyncio.gather(*(probe(c) for c in list(self.clusters.values())))
+
+    async def _probe_loop(self) -> None:
+        while True:
+            try:
+                await self._probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # pragma: no cover
+                log.exception("probe loop error")
+            await asyncio.sleep(self.probe_interval_s)
+
+    # -- selection ----------------------------------------------------------
+    def pick(self, model: Optional[str]) -> Optional[Cluster]:
+        candidates = [
+            c for c in self.clusters.values()
+            if c.healthy and (model is None or model in c.models)
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda c: c.in_flight)
+
+    # -- handlers -----------------------------------------------------------
+    async def list_models(self, request: web.Request) -> web.Response:
+        seen: Dict[str, dict] = {}
+        for c in self.clusters.values():
+            if not c.healthy:
+                continue
+            for m in sorted(c.models):
+                seen.setdefault(m, {"id": m, "object": "model",
+                                    "owned_by": "dynamo_tpu"})
+        return web.json_response({"object": "list", "data": list(seen.values())})
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "healthy" if any(c.healthy for c in self.clusters.values())
+                else "unhealthy",
+                "clusters": {
+                    c.base: {"healthy": c.healthy, "models": sorted(c.models),
+                             "in_flight": c.in_flight}
+                    for c in self.clusters.values()
+                },
+            }
+        )
+
+    async def proxy(self, request: web.Request) -> web.StreamResponse:
+        model = None
+        body = await request.read()
+        if body:
+            try:
+                parsed = json.loads(body)
+                if isinstance(parsed, dict):
+                    model = parsed.get("model")
+            except ValueError:
+                pass
+        cluster = self.pick(model)
+        if cluster is None:
+            return web.json_response(
+                {"error": {"message": f"no healthy cluster serves {model!r}",
+                           "type": "no_cluster", "code": 503}},
+                status=503,
+            )
+        s = await self._http()
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in HOP_HEADERS}
+        cluster.in_flight += 1
+        resp: Optional[web.StreamResponse] = None
+        try:
+            async with s.request(
+                request.method, cluster.base + request.path_qs,
+                data=body, headers=headers,
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10),
+            ) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in HOP_HEADERS:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except aiohttp.ClientError as e:
+            cluster.healthy = False  # fast failover; probe re-admits
+            log.warning("cluster %s failed mid-request: %s", cluster.base, e)
+            if resp is not None and resp.prepared:
+                # headers already on the wire: nothing valid can follow —
+                # close the (truncated) stream rather than corrupt it with
+                # a second response
+                return resp
+            return web.json_response(
+                {"error": {"message": f"upstream cluster error: {e}",
+                           "type": "cluster_error", "code": 502}},
+                status=502,
+            )
+        finally:
+            cluster.in_flight -= 1
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> str:
+        app = web.Application()
+        app.router.add_get("/v1/models", self.list_models)
+        app.router.add_get("/health", self.health)
+        app.router.add_route("*", "/{tail:.*}", self.proxy)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        await self._probe_once()
+        self._probe_task = asyncio.create_task(self._probe_loop())
+        actual = site._server.sockets[0].getsockname()[1]
+        log.info("global router on :%d over %d clusters", actual, len(self.clusters))
+        return f"http://127.0.0.1:{actual}"
+
+    async def stop(self) -> None:
+        if self._probe_task:
+            self._probe_task.cancel()
+        if self._session:
+            await self._session.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.global_router")
+    p.add_argument("--cluster", action="append", default=[],
+                   help="frontend base URL (repeatable)")
+    p.add_argument("--http-port", type=int, default=8500)
+    p.add_argument("--probe-interval", type=float, default=2.0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    from dynamo_tpu.runtime.logging_util import configure_logging
+
+    configure_logging()
+    args = parse_args(argv)
+    if not args.cluster:
+        raise SystemExit("at least one --cluster required")
+
+    async def run():
+        gr = GlobalRouter(args.cluster, probe_interval_s=args.probe_interval)
+        await gr.start(port=args.http_port)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Global router: multi-cluster model union, per-model routing, SSE
+passthrough, and failover when a cluster dies."""
+
+import asyncio
+import json
+
+import aiohttp
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.frontend.protocols import ModelCard
+from dynamo_tpu.global_router import GlobalRouter
+from dynamo_tpu.mocker.echo import EchoWorkerEngine
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+async def _cluster(realm: str, model: str):
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    await wrt.serve_endpoint(
+        "dyn/worker/generate", EchoWorkerEngine(),
+        metadata={"model_card": ModelCard(name=model, tokenizer="byte",
+                                          context_length=1024).to_dict()},
+    )
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=5)
+    return wrt, frt, svc, base
+
+
+async def test_global_router_union_routing_and_failover():
+    a = await _cluster("gr-a", "model-a")
+    b = await _cluster("gr-b", "model-b")
+    gr = GlobalRouter([a[3], b[3]], probe_interval_s=0.3)
+    base = await gr.start(port=0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/models") as r:
+                models = sorted(m["id"] for m in (await r.json())["data"])
+            assert models == ["model-a", "model-b"]
+
+            # routes by model to the right cluster (unary)
+            for model in ("model-a", "model-b"):
+                async with s.post(f"{base}/v1/completions", json={
+                    "model": model, "prompt": "hi there", "max_tokens": 4,
+                }) as r:
+                    assert r.status == 200, await r.text()
+                    body = await r.json()
+                assert body["usage"]["completion_tokens"] == 4
+
+            # SSE streams through
+            lines = []
+            async with s.post(f"{base}/v1/chat/completions", json={
+                "model": "model-a", "stream": True, "max_tokens": 4,
+                "messages": [{"role": "user", "content": "hello"}],
+            }) as r:
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                async for raw in r.content:
+                    t = raw.decode().strip()
+                    if t.startswith("data: "):
+                        lines.append(t)
+            assert lines[-1] == "data: [DONE]" and len(lines) > 1
+
+            # unknown model → 503 no_cluster
+            async with s.post(f"{base}/v1/completions", json={
+                "model": "nope", "prompt": "x",
+            }) as r:
+                assert r.status == 503
+
+            # failover: kill cluster b, probe marks it unhealthy, model-b
+            # requests get a clean 503 while model-a keeps serving
+            await b[2].stop()
+            await b[1].shutdown()
+            await b[0].shutdown(drain_timeout=1)
+            await asyncio.sleep(1.0)
+            async with s.post(f"{base}/v1/completions", json={
+                "model": "model-b", "prompt": "x", "max_tokens": 2,
+            }) as r:
+                assert r.status in (502, 503)
+            async with s.post(f"{base}/v1/completions", json={
+                "model": "model-a", "prompt": "still fine", "max_tokens": 2,
+            }) as r:
+                assert r.status == 200
+            async with s.get(f"{base}/health") as r:
+                h = await r.json()
+            assert h["status"] == "healthy"
+            assert sum(1 for c in h["clusters"].values() if c["healthy"]) == 1
+    finally:
+        await gr.stop()
+        await a[2].stop()
+        await a[1].shutdown()
+        await a[0].shutdown(drain_timeout=1)
