@@ -55,6 +55,7 @@ class HttpService:
                 web.post("/v1/completions", self.completions),
                 web.post("/v1/embeddings", self.embeddings),
                 web.post("/v1/responses", self.responses),
+                web.get("/v1/realtime", self.realtime),
                 web.post("/v1/messages", self.anthropic_messages),
                 web.post("/v1/messages/count_tokens", self.anthropic_count_tokens),
                 web.get("/v1/models", self.list_models),
@@ -128,6 +129,11 @@ class HttpService:
         return web.json_response(
             {"id": name, "object": "model", "owned_by": "dynamo_tpu"}
         )
+
+    async def realtime(self, request: web.Request):
+        from dynamo_tpu.frontend.realtime import handle_realtime
+
+        return await handle_realtime(self, request)
 
     # -- inference endpoints -----------------------------------------------
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:

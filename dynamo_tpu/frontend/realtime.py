@@ -1,0 +1,172 @@
+"""OpenAI Realtime-style WebSocket endpoint (/v1/realtime).
+
+Session-scoped bidirectional streaming (reference: realtime WS surface of
+the OpenAI frontend): the client opens a WS with `?model=...`, sends
+conversation items and `response.create` events, and receives streamed
+`response.text.delta` events. Conversation state lives on the connection,
+so multi-turn exchanges reuse the prefix cache naturally (same token
+prefix → same block hashes).
+
+Implemented event subset (text modality):
+  server → client: session.created, conversation.item.created,
+                   response.created, response.text.delta, response.done,
+                   error
+  client → server: session.update (acknowledged), conversation.item.create
+                   ({"item": {"role", "content": [{"type": "input_text",
+                   "text"}]}}), response.create, response.cancel
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import uuid
+from typing import Any, Dict, List
+
+from aiohttp import WSMsgType, web
+
+from dynamo_tpu.runtime.context import Context
+
+log = logging.getLogger("dynamo_tpu.frontend.realtime")
+
+
+def _event(kind: str, **fields) -> str:
+    return json.dumps({"type": kind, "event_id": f"evt_{uuid.uuid4().hex[:12]}",
+                       **fields})
+
+
+def _item_text(item: Any) -> str:
+    if not isinstance(item, dict):
+        raise ValueError("item must be an object")
+    content = item.get("content")
+    if isinstance(content, str):
+        return content
+    if not isinstance(content, list):
+        raise ValueError("item.content must be a string or a block list")
+    return "".join(
+        b.get("text", "") for b in content
+        if isinstance(b, dict) and b.get("type") in ("input_text", "text")
+    )
+
+
+async def handle_realtime(service, request: web.Request) -> web.WebSocketResponse:
+    """aiohttp handler bound by HttpService; `service` provides .manager."""
+    model = request.query.get("model")
+    try:
+        entry = service.manager.get(model)
+    except KeyError:
+        return web.json_response(
+            {"error": {"message": f"model {model!r} not found",
+                       "type": "model_not_found", "code": 404}}, status=404,
+        )
+
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+    session_id = f"sess_{uuid.uuid4().hex[:16]}"
+    await ws.send_str(_event("session.created",
+                             session={"id": session_id, "model": model,
+                                      "modalities": ["text"]}))
+    messages: List[Dict[str, str]] = []
+    state: Dict[str, Any] = {}  # "ctx": Context, "task": asyncio.Task
+
+    async def run_response() -> None:
+        import asyncio
+
+        rid = f"resp_{uuid.uuid4().hex[:16]}"
+        ctx = Context(metadata={"model": model})
+        state["ctx"] = ctx
+        # same admission controls as the HTTP endpoints: shed at the busy
+        # threshold and count toward per-model in-flight + traces
+        if (
+            service.busy_threshold
+            and service._in_flight.get(model, 0) >= service.busy_threshold
+        ):
+            await ws.send_str(_event("response.done", response={
+                "id": rid, "status": "failed",
+                "error": {"message": "server busy", "type": "server_busy"}}))
+            return
+        service._in_flight[model] = service._in_flight.get(model, 0) + 1
+        await ws.send_str(_event("response.created", response={"id": rid}))
+        parts: List[str] = []
+        status = "completed"
+        n_out = 0
+        try:
+            preprocessed = entry.preprocessor.preprocess_chat(
+                {"messages": list(messages), "max_tokens": 512}
+            )
+            async for item in entry.chain.generate(preprocessed, ctx):
+                text = item.get("text", "")
+                n_out += len(item.get("token_ids") or [])
+                if text:
+                    parts.append(text)
+                    await ws.send_str(_event("response.text.delta",
+                                             response_id=rid, delta=text))
+                if item.get("finish_reason"):
+                    break
+        except asyncio.CancelledError:
+            status = "cancelled"
+        except Exception as e:
+            log.exception("realtime response failed")
+            status = "failed"
+            await ws.send_str(_event("error",
+                                     error={"message": str(e), "type": "api_error"}))
+        finally:
+            ctx.stop_generating()
+            state.pop("ctx", None)
+            state.pop("task", None)
+            service._in_flight[model] = max(0, service._in_flight.get(model, 1) - 1)
+        full = "".join(parts)
+        if status == "completed":
+            messages.append({"role": "assistant", "content": full})
+        # ALWAYS terminal: clients loop until response.done
+        await ws.send_str(_event("response.done",
+                                 response={"id": rid, "status": status,
+                                           "output_text": full}))
+
+    import asyncio
+
+    try:
+        async for msg in ws:
+            if msg.type != WSMsgType.TEXT:
+                break
+            try:
+                ev = json.loads(msg.data)
+                kind = ev.get("type")
+                if kind == "session.update":
+                    await ws.send_str(_event("session.updated",
+                                             session={"id": session_id}))
+                elif kind == "conversation.item.create":
+                    item = ev.get("item") or {}
+                    messages.append({"role": item.get("role", "user")
+                                     if isinstance(item, dict) else "user",
+                                     "content": _item_text(item)})
+                    await ws.send_str(_event("conversation.item.created",
+                                             item={"id": f"item_{uuid.uuid4().hex[:12]}"}))
+                elif kind == "response.create":
+                    if state.get("task") is not None and not state["task"].done():
+                        await ws.send_str(_event("error", error={
+                            "message": "a response is already in progress",
+                            "type": "invalid_request_error"}))
+                    else:
+                        # background task so cancel events stay readable
+                        state["task"] = asyncio.create_task(run_response())
+                elif kind == "response.cancel":
+                    ctx = state.get("ctx")
+                    if ctx is not None:
+                        ctx.stop_generating()
+                else:
+                    await ws.send_str(_event("error", error={
+                        "message": f"unsupported event type {kind!r}",
+                        "type": "invalid_request_error"}))
+            except ValueError as e:
+                await ws.send_str(_event("error", error={
+                    "message": str(e) or "invalid JSON",
+                    "type": "invalid_request_error"}))
+    finally:
+        task = state.get("task")
+        if task is not None and not task.done():
+            task.cancel()
+        ctx = state.get("ctx")
+        if ctx is not None:
+            ctx.kill()
+    return ws

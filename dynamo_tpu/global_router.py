@@ -126,7 +126,46 @@ class GlobalRouter:
             }
         )
 
+    async def _proxy_ws(self, request: web.Request, cluster: Cluster) -> web.StreamResponse:
+        """Bridge a WebSocket (e.g. /v1/realtime) to the chosen cluster."""
+        s = await self._http()
+        server_ws = web.WebSocketResponse(heartbeat=30)
+        await server_ws.prepare(request)
+        cluster.in_flight += 1
+        try:
+            async with s.ws_connect(cluster.base + str(request.path_qs)) as client_ws:
+
+                async def pump(src_ws, dst_ws):
+                    async for msg in src_ws:
+                        if msg.type == aiohttp.WSMsgType.TEXT:
+                            await dst_ws.send_str(msg.data)
+                        elif msg.type == aiohttp.WSMsgType.BINARY:
+                            await dst_ws.send_bytes(msg.data)
+                        else:
+                            break
+                    await dst_ws.close()
+
+                await asyncio.gather(
+                    pump(server_ws, client_ws), pump(client_ws, server_ws)
+                )
+        except aiohttp.ClientError as e:
+            cluster.healthy = False
+            log.warning("ws upstream %s failed: %s", cluster.base, e)
+            await server_ws.close()
+        finally:
+            cluster.in_flight -= 1
+        return server_ws
+
     async def proxy(self, request: web.Request) -> web.StreamResponse:
+        if request.headers.get("Upgrade", "").lower() == "websocket":
+            model = request.query.get("model")
+            cluster = self.pick(model)
+            if cluster is None:
+                return web.json_response(
+                    {"error": {"message": f"no healthy cluster serves {model!r}",
+                               "type": "no_cluster", "code": 503}}, status=503,
+                )
+            return await self._proxy_ws(request, cluster)
         model = None
         body = await request.read()
         if body:

@@ -384,3 +384,65 @@ async def test_responses_api_unary_and_stream():
         await svc.stop()
         await frt.shutdown()
         await wrt.shutdown(drain_timeout=1)
+
+
+async def test_realtime_websocket_session():
+    """Realtime WS: session lifecycle, item create, streamed text deltas,
+    multi-turn context reuse."""
+    wrt, frt, svc, base = await _start_stack(realm="rt-ws")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.ws_connect(f"{base}/v1/realtime?model=echo-model") as ws:
+                first = json.loads((await ws.receive()).data)
+                assert first["type"] == "session.created"
+                assert first["session"]["model"] == "echo-model"
+
+                await ws.send_str(json.dumps({
+                    "type": "conversation.item.create",
+                    "item": {"role": "user", "content": [
+                        {"type": "input_text", "text": "hello realtime"}]},
+                }))
+                ack = json.loads((await ws.receive()).data)
+                assert ack["type"] == "conversation.item.created"
+
+                await ws.send_str(json.dumps({"type": "response.create"}))
+                deltas, done = [], None
+                while True:
+                    ev = json.loads((await ws.receive()).data)
+                    if ev["type"] == "response.text.delta":
+                        deltas.append(ev["delta"])
+                    elif ev["type"] == "response.done":
+                        done = ev
+                        break
+                    elif ev["type"] == "response.created":
+                        continue
+                    else:
+                        raise AssertionError(ev)
+                assert done["response"]["status"] == "completed"
+                assert "".join(deltas) == done["response"]["output_text"]
+                assert len(done["response"]["output_text"]) > 0
+
+                # second turn includes the first turn's context
+                await ws.send_str(json.dumps({
+                    "type": "conversation.item.create",
+                    "item": {"role": "user", "content": [
+                        {"type": "input_text", "text": "again"}]},
+                }))
+                await ws.receive()  # item.created
+                await ws.send_str(json.dumps({"type": "response.create"}))
+                types = []
+                while True:
+                    ev = json.loads((await ws.receive()).data)
+                    types.append(ev["type"])
+                    if ev["type"] == "response.done":
+                        break
+                assert "response.text.delta" in types
+
+                # unknown event type → structured error, connection stays up
+                await ws.send_str(json.dumps({"type": "bogus.event"}))
+                ev = json.loads((await ws.receive()).data)
+                assert ev["type"] == "error"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)

@@ -61,6 +61,26 @@ async def test_global_router_union_routing_and_failover():
                         lines.append(t)
             assert lines[-1] == "data: [DONE]" and len(lines) > 1
 
+            # WebSocket bridging (realtime endpoint through the tier)
+            async with s.ws_connect(f"{base}/v1/realtime?model=model-a") as ws:
+                ev = json.loads((await ws.receive()).data)
+                assert ev["type"] == "session.created"
+                await ws.send_str(json.dumps({
+                    "type": "conversation.item.create",
+                    "item": {"role": "user", "content": [
+                        {"type": "input_text", "text": "via global"}]},
+                }))
+                await ws.receive()
+                await ws.send_str(json.dumps({"type": "response.create"}))
+                saw_delta = False
+                while True:
+                    ev = json.loads((await ws.receive()).data)
+                    if ev["type"] == "response.text.delta":
+                        saw_delta = True
+                    if ev["type"] == "response.done":
+                        break
+                assert saw_delta
+
             # unknown model → 503 no_cluster
             async with s.post(f"{base}/v1/completions", json={
                 "model": "nope", "prompt": "x",
