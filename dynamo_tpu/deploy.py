@@ -165,7 +165,7 @@ def parse_args(argv=None):
     p.add_argument("--disagg", action="store_true",
                    help="split into prefill + decode worker pools")
     p.add_argument("--prefill-workers", type=int, default=1)
-    p.add_argument("--quantize", default=None, choices=[None, "int8"])
+    p.add_argument("--quantize", default=None, choices=[None, "int8", "fp8"])
     p.add_argument("--etcd", default="http://etcd:2379")
     p.add_argument("--otlp", default=None)
     p.add_argument("--drain-seconds", type=int, default=120)

@@ -147,10 +147,10 @@ class ModelRunner:
         if params is None:
             params = llama.init_params(config, jax.random.PRNGKey(seed), dtype)
         self.quantize = quantize
-        if quantize == "int8":
+        if quantize in ("int8", "fp8"):
             from dynamo_tpu.models.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(params, mode=quantize)
         elif quantize is not None:
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.params = jax.device_put(params, self.policy.params_sharding(params))

@@ -89,39 +89,60 @@ async def handle_realtime(service, request: web.Request) -> web.WebSocketRespons
         await ws.send_str(_event("response.created", response={"id": rid}))
         parts: List[str] = []
         status = "completed"
-        n_out = 0
+        timing = None
+        cancelled = False
         try:
+            from dynamo_tpu.frontend.request_trace import RequestTiming
+
             preprocessed = entry.preprocessor.preprocess_chat(
                 {"messages": list(messages), "max_tokens": 512}
             )
+            timing = RequestTiming(ctx.id, model, "realtime",
+                                   len(preprocessed["token_ids"]))
             async for item in entry.chain.generate(preprocessed, ctx):
                 text = item.get("text", "")
-                n_out += len(item.get("token_ids") or [])
+                timing.on_tokens(len(item.get("token_ids") or []))
                 if text:
                     parts.append(text)
                     await ws.send_str(_event("response.text.delta",
                                              response_id=rid, delta=text))
-                if item.get("finish_reason"):
+                finish = item.get("finish_reason")
+                if finish:
+                    timing.finish_reason = finish
+                    if finish == "cancelled":
+                        status = "cancelled"
                     break
         except asyncio.CancelledError:
+            cancelled = True
             status = "cancelled"
         except Exception as e:
             log.exception("realtime response failed")
             status = "failed"
-            await ws.send_str(_event("error",
-                                     error={"message": str(e), "type": "api_error"}))
+            if not ws.closed:
+                await ws.send_str(_event("error",
+                                         error={"message": str(e), "type": "api_error"}))
         finally:
             ctx.stop_generating()
             state.pop("ctx", None)
             state.pop("task", None)
             service._in_flight[model] = max(0, service._in_flight.get(model, 1) - 1)
+            if timing is not None and service.tracer.enabled:
+                timing.finish_reason = timing.finish_reason or status
+                service.tracer.record(**timing.fields(stream=True))
         full = "".join(parts)
         if status == "completed":
+            # cancelled/failed turns never pollute later turns' context
             messages.append({"role": "assistant", "content": full})
-        # ALWAYS terminal: clients loop until response.done
-        await ws.send_str(_event("response.done",
-                                 response={"id": rid, "status": status,
-                                           "output_text": full}))
+        # ALWAYS terminal (clients loop until response.done) — unless the
+        # socket itself is gone
+        if not ws.closed:
+            await ws.send_str(_event("response.done",
+                                     response={"id": rid, "status": status,
+                                               "output_text": full,
+                                               "usage": {"output_tokens":
+                                                         timing.osl if timing else 0}}))
+        if cancelled:
+            raise asyncio.CancelledError
 
     import asyncio
 

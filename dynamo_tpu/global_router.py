@@ -133,25 +133,42 @@ class GlobalRouter:
         await server_ws.prepare(request)
         cluster.in_flight += 1
         try:
-            async with s.ws_connect(cluster.base + str(request.path_qs)) as client_ws:
+            try:
+                client_ws = await s.ws_connect(cluster.base + str(request.path_qs))
+            except aiohttp.WSServerHandshakeError as e:
+                # upstream rejected the handshake (e.g. unknown model →
+                # 404): a REQUEST problem, not a cluster problem
+                log.info("ws handshake rejected by %s: %s", cluster.base, e)
+                await server_ws.close(code=1008, message=str(e).encode()[:120])
+                return server_ws
+            except aiohttp.ClientError as e:
+                # connect-level failure: the cluster itself is unreachable
+                cluster.healthy = False
+                log.warning("ws upstream %s unreachable: %s", cluster.base, e)
+                await server_ws.close(code=1011)
+                return server_ws
 
-                async def pump(src_ws, dst_ws):
-                    async for msg in src_ws:
-                        if msg.type == aiohttp.WSMsgType.TEXT:
-                            await dst_ws.send_str(msg.data)
-                        elif msg.type == aiohttp.WSMsgType.BINARY:
-                            await dst_ws.send_bytes(msg.data)
-                        else:
-                            break
-                    await dst_ws.close()
+            async def pump(src_ws, dst_ws):
+                async for msg in src_ws:
+                    if msg.type == aiohttp.WSMsgType.TEXT:
+                        await dst_ws.send_str(msg.data)
+                    elif msg.type == aiohttp.WSMsgType.BINARY:
+                        await dst_ws.send_bytes(msg.data)
+                    else:
+                        break
+                await dst_ws.close()
 
-                await asyncio.gather(
-                    pump(server_ws, client_ws), pump(client_ws, server_ws)
-                )
-        except aiohttp.ClientError as e:
-            cluster.healthy = False
-            log.warning("ws upstream %s failed: %s", cluster.base, e)
-            await server_ws.close()
+            try:
+                async with client_ws:
+                    await asyncio.gather(
+                        pump(server_ws, client_ws), pump(client_ws, server_ws)
+                    )
+            except aiohttp.ClientError as e:
+                # mid-stream errors are frequently the CLIENT side bailing;
+                # never blacklist the cluster for them (health probes keep
+                # watching the cluster itself)
+                log.info("ws bridge to %s ended: %s", cluster.base, e)
+                await server_ws.close()
         finally:
             cluster.in_flight -= 1
         return server_ws

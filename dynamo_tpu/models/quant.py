@@ -39,12 +39,18 @@ def mm(x: jax.Array, w: Any) -> jax.Array:
     return x @ w
 
 
-def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
-    """Per-output-channel symmetric int8. w [..., in, out] → q/s dict."""
+def quantize_weight(w: jax.Array, mode: str = "int8") -> Dict[str, jax.Array]:
+    """Per-output-channel symmetric quantization. w [..., in, out] → q/s
+    dict. Modes: int8 (127-step, robust everywhere) and fp8 (e4m3 — keeps
+    more dynamic range per channel; v5p+ has native fp8 matmul paths)."""
     wf = jnp.asarray(w, jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # [..., 1, out]
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    if mode == "fp8":
+        scale = jnp.maximum(amax, 1e-8) / 448.0  # e4m3 finite max
+        q = (wf / scale).astype(jnp.float8_e4m3fn)
+    else:
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return {"q": q, "s": scale}
 
 
@@ -53,7 +59,8 @@ def dequantize_weight(w: Dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
 
 
 def quantize_params(
-    params: Dict[str, Any], names: Iterable[str] = DEFAULT_QUANT_NAMES
+    params: Dict[str, Any], names: Iterable[str] = DEFAULT_QUANT_NAMES,
+    mode: str = "int8",
 ) -> Dict[str, Any]:
     """Quantize the named layer weights of a llama param tree in place-ish
     (returns a new tree; unquantized leaves pass through)."""
@@ -62,6 +69,6 @@ def quantize_params(
     layers = dict(params["layers"])
     for name in list(layers):
         if name in names:
-            layers[name] = quantize_weight(layers[name])
+            layers[name] = quantize_weight(layers[name], mode)
     out["layers"] = layers
     return out

@@ -68,9 +68,9 @@ def parse_args(argv=None):
                         "Repeatable; each name becomes a servable model.")
     p.add_argument("--lora-rank", type=int, default=8,
                    help="rank for randomly-initialized dev adapters")
-    p.add_argument("--quantize", default=None, choices=[None, "int8"],
-                   help="weight-only quantization (int8 halves decode HBM "
-                        "weight traffic)")
+    p.add_argument("--quantize", default=None, choices=[None, "int8", "fp8"],
+                   help="weight-only quantization (halves decode HBM weight "
+                        "traffic; fp8 = e4m3 per-channel)")
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")

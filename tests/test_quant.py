@@ -100,3 +100,17 @@ def test_quantized_moe_runs():
         quantize="int8",
     )
     assert len(_generate(runner, [1, 2, 3, 4], n=3)) == 3
+
+
+def test_fp8_quantize_and_generate():
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    qw = quantize_weight(w, mode="fp8")
+    assert str(qw["q"].dtype) == "float8_e4m3fn"
+    deq = np.asarray(dequantize_weight(qw, jnp.float32))
+    # e4m3 relative error per channel bounded (~6% worst case mid-range)
+    rel = np.abs(deq - np.asarray(w)) / (np.abs(np.asarray(w)) + 1e-3)
+    assert np.median(rel) < 0.05
+
+    toks = _generate(_runner(quantize="fp8"), [2, 7, 1, 8], n=4)
+    assert len(toks) == 4
