@@ -158,16 +158,21 @@ class GlobalRouter:
                         break
                 await dst_ws.close()
 
+            t1 = asyncio.create_task(pump(server_ws, client_ws))
+            t2 = asyncio.create_task(pump(client_ws, server_ws))
             try:
                 async with client_ws:
-                    await asyncio.gather(
-                        pump(server_ws, client_ws), pump(client_ws, server_ws)
-                    )
-            except aiohttp.ClientError as e:
-                # mid-stream errors are frequently the CLIENT side bailing;
-                # never blacklist the cluster for them (health probes keep
+                    await asyncio.gather(t1, t2)
+            except (aiohttp.ClientError, ConnectionError) as e:
+                # mid-stream errors are frequently the CLIENT side bailing
+                # (server_ws.send_str raises ConnectionResetError); never
+                # blacklist the cluster for them (health probes keep
                 # watching the cluster itself)
                 log.info("ws bridge to %s ended: %s", cluster.base, e)
+                for t in (t1, t2):
+                    if not t.done():
+                        t.cancel()
+                await asyncio.gather(t1, t2, return_exceptions=True)
                 await server_ws.close()
         finally:
             cluster.in_flight -= 1

@@ -55,6 +55,33 @@ class DiscoveryBackend:
         pass
 
 
+async def poll_diff_watch(scan, poll_interval: float, on_error=None):
+    """Shared poll-based watch: diff successive scans into put/delete
+    events (used by the file and kubernetes backends). `scan` is an async
+    callable returning {path: Instance}."""
+    known: Dict[str, dict] = {}
+    while True:
+        try:
+            current = await scan()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if on_error is not None:
+                on_error(e)
+            await asyncio.sleep(poll_interval)
+            continue
+        for path, inst in current.items():
+            rec = inst.to_dict()
+            if known.get(path) != rec:  # new or changed (metadata/address)
+                known[path] = rec
+                yield DiscoveryEvent("put", inst)
+        for path in list(known):
+            if path not in current:
+                rec = known.pop(path)
+                yield DiscoveryEvent("delete", Instance.from_dict(rec))
+        await asyncio.sleep(poll_interval)
+
+
 class MemDiscovery(DiscoveryBackend):
     """In-process discovery; all MemDiscovery() instances created with the
     same `realm` share one registry, so N workers + a frontend in one process
@@ -180,19 +207,12 @@ class FileDiscovery(DiscoveryBackend):
 
     async def watch(self, prefix: str = "") -> AsyncIterator[DiscoveryEvent]:
         prefix = prefix or "services/"
-        known: Dict[str, dict] = {}  # path -> serialized record (detects updates)
-        while True:
-            current = self._scan(prefix)
-            for path, inst in current.items():
-                rec = inst.to_dict()
-                if known.get(path) != rec:  # new or changed (metadata/address)
-                    known[path] = rec
-                    yield DiscoveryEvent("put", inst)
-            for path in list(known):
-                if path not in current:
-                    rec = known.pop(path)
-                    yield DiscoveryEvent("delete", Instance.from_dict(rec))
-            await asyncio.sleep(self.poll_interval)
+
+        async def scan():
+            return self._scan(prefix)
+
+        async for ev in poll_diff_watch(scan, self.poll_interval):
+            yield ev
 
 
 def make_discovery(backend: Optional[str] = None, **kw) -> DiscoveryBackend:
@@ -214,8 +234,13 @@ def make_discovery(backend: Optional[str] = None, **kw) -> DiscoveryBackend:
         )
         return EtcdDiscovery(endpoint, lease_ttl=int(kw.get("lease_ttl", 10)))
     if backend == "kubernetes":
-        raise NotImplementedError(
-            "kubernetes discovery requires a cluster API client; use 'etcd', "
-            "'file', or 'mem'"
+        from dynamo_tpu.runtime.kube_discovery import KubeDiscovery
+
+        return KubeDiscovery(
+            namespace=kw.get("namespace")
+            or os.environ.get("DYN_K8S_NAMESPACE", "default"),
+            # DYN_K8S_API overrides the in-cluster endpoint (dev/test)
+            api_base=kw.get("api_base") or os.environ.get("DYN_K8S_API"),
+            lease_ttl=float(kw.get("lease_ttl", 10.0)),
         )
     raise ValueError(f"unknown discovery backend {backend!r}")

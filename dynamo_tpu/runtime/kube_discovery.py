@@ -1,0 +1,183 @@
+"""Kubernetes discovery backend.
+
+The reference runtime's alternative to etcd discovery in-cluster
+(lib/runtime discovery backends): instances live as ConfigMap-backed
+registrations (one ConfigMap per instance, labeled for list/watch) in a
+namespace, with liveness via a heartbeat timestamp annotation — the same
+record/lease semantics as the file backend, expressed as Kubernetes
+objects so `kubectl get cm -l app=dynamo-tpu` shows the live topology.
+
+Uses the plain REST API with service-account auth (no kubernetes client
+library), matching planner/connector.py's KubernetesConnector. Watching is
+poll-based (list with labelSelector) — robust against watch-stream
+bookmarks and adequate at control-plane rates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import AsyncIterator, Dict, List, Optional
+
+from dynamo_tpu.runtime.component import Instance
+from dynamo_tpu.runtime.discovery import DiscoveryBackend, DiscoveryEvent
+
+log = logging.getLogger("dynamo_tpu.runtime.kube")
+
+LABEL = "app.kubernetes.io/managed-by=dynamo-tpu-discovery"
+
+
+class KubeDiscovery(DiscoveryBackend):
+    def __init__(
+        self,
+        namespace: str = "default",
+        api_base: Optional[str] = None,
+        token: Optional[str] = None,
+        lease_ttl: float = 30.0,  # generous: heartbeat annotations compare
+        #   WRITER wall clocks against the reader's (same caveat as k8s
+        #   leader election) — keep ttl >> worst-case NTP skew
+        poll_interval: float = 1.0,
+    ):
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in a cluster and no api_base given; use etcd/file/mem"
+                )
+            api_base = f"https://{host}:{port}"
+        if token is None and os.path.exists(f"{sa}/token"):
+            token = Path(f"{sa}/token").read_text().strip()
+        self.api_base = api_base.rstrip("/")
+        self.namespace = namespace
+        self.token = token
+        self.lease_ttl = lease_ttl
+        self.poll_interval = poll_interval
+        self._ssl = True
+        if os.path.exists(f"{sa}/ca.crt"):
+            import ssl as _ssl
+
+            self._ssl = _ssl.create_default_context(cafile=f"{sa}/ca.crt")
+        self._session = None
+        self._mine: Dict[str, Instance] = {}
+
+    # -- REST helpers -------------------------------------------------------
+    async def _http(self):
+        if self._session is None:
+            import aiohttp
+
+            headers = {}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            self._session = aiohttp.ClientSession(
+                headers=headers,
+                connector=aiohttp.TCPConnector(ssl=self._ssl),
+            )
+        return self._session
+
+    def _cm_url(self, name: str = "") -> str:
+        base = f"{self.api_base}/api/v1/namespaces/{self.namespace}/configmaps"
+        return f"{base}/{name}" if name else base
+
+    @staticmethod
+    def _cm_name(instance: Instance) -> str:
+        # DNS-1123 slug + content hash of the EXACT path: the slug is lossy
+        # ("/", "_" → "-", lowercased), so the hash keeps distinct paths
+        # from colliding onto one ConfigMap
+        import hashlib
+
+        slug = instance.path.replace("/", "-").replace("_", "-").lower()[:200]
+        h = hashlib.blake2b(instance.path.encode(), digest_size=4).hexdigest()
+        return f"dyn-{slug}-{h}"
+
+    def _to_cm(self, instance: Instance) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": self._cm_name(instance),
+                "labels": {LABEL.split("=")[0]: LABEL.split("=")[1]},
+                "annotations": {"dynamo-tpu/heartbeat": str(time.time())},
+            },
+            "data": {
+                "path": instance.path,
+                "instance": json.dumps(instance.to_dict()),
+            },
+        }
+
+    # -- DiscoveryBackend ---------------------------------------------------
+    async def register(self, instance: Instance) -> None:
+        s = await self._http()
+        body = self._to_cm(instance)
+        async with s.post(self._cm_url(), json=body) as r:
+            if r.status == 409:  # exists: replace
+                async with s.put(self._cm_url(self._cm_name(instance)), json=body) as r2:
+                    r2.raise_for_status()
+            else:
+                r.raise_for_status()
+        self._mine[instance.path] = instance
+
+    async def unregister(self, instance: Instance) -> None:
+        self._mine.pop(instance.path, None)
+        s = await self._http()
+        async with s.delete(self._cm_url(self._cm_name(instance))) as r:
+            if r.status not in (200, 404):
+                r.raise_for_status()
+
+    async def heartbeat(self) -> None:
+        # refresh the heartbeat annotation (re-PUT keeps it one round trip)
+        for inst in list(self._mine.values()):
+            try:
+                s = await self._http()
+                async with s.put(
+                    self._cm_url(self._cm_name(inst)), json=self._to_cm(inst)
+                ) as r:
+                    if r.status == 404:  # lost (GC'd): re-create
+                        await self.register(inst)
+                    else:
+                        r.raise_for_status()
+            except Exception:
+                log.warning("kube heartbeat failed for %s", inst.path, exc_info=True)
+
+    async def _scan(self, prefix: str) -> Dict[str, Instance]:
+        s = await self._http()
+        out: Dict[str, Instance] = {}
+        cutoff = time.time() - self.lease_ttl
+        async with s.get(self._cm_url(), params={"labelSelector": LABEL}) as r:
+            r.raise_for_status()
+            body = await r.json()
+        for item in body.get("items", []):
+            try:
+                hb = float((item["metadata"].get("annotations") or {})
+                           .get("dynamo-tpu/heartbeat", 0))
+                if hb < cutoff:
+                    continue  # lease expired (stale pod)
+                inst = Instance.from_dict(json.loads(item["data"]["instance"]))
+                if inst.path.startswith(prefix):
+                    out[inst.path] = inst
+            except (KeyError, ValueError):
+                continue
+        return out
+
+    async def list_instances(self, prefix: str = "") -> List[Instance]:
+        return list((await self._scan(prefix or "services/")).values())
+
+    async def watch(self, prefix: str = "") -> AsyncIterator[DiscoveryEvent]:
+        from dynamo_tpu.runtime.discovery import poll_diff_watch
+
+        prefix = prefix or "services/"
+        async for ev in poll_diff_watch(
+            lambda: self._scan(prefix), self.poll_interval,
+            on_error=lambda e: log.warning("kube scan failed (%s); retrying", e),
+        ):
+            yield ev
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

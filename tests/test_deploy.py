@@ -101,3 +101,100 @@ async def test_kubernetes_connector_scales_deployment():
     finally:
         await conn.close()
         await api.stop()
+
+
+class FakeKubeCmApi:
+    """ConfigMap subset for KubeDiscovery: POST/PUT/DELETE/list+label."""
+
+    def __init__(self):
+        self.cms = {}
+
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_post("/api/v1/namespaces/{ns}/configmaps", self._post)
+        app.router.add_get("/api/v1/namespaces/{ns}/configmaps", self._list)
+        app.router.add_put("/api/v1/namespaces/{ns}/configmaps/{name}", self._put)
+        app.router.add_delete("/api/v1/namespaces/{ns}/configmaps/{name}", self._delete)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        return f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+    async def _post(self, req):
+        body = await req.json()
+        name = body["metadata"]["name"]
+        if name in self.cms:
+            return web.json_response({}, status=409)
+        self.cms[name] = body
+        return web.json_response(body, status=201)
+
+    async def _put(self, req):
+        name = req.match_info["name"]
+        if name not in self.cms:
+            return web.json_response({}, status=404)
+        self.cms[name] = await req.json()
+        return web.json_response(self.cms[name])
+
+    async def _delete(self, req):
+        self.cms.pop(req.match_info["name"], None)
+        return web.json_response({})
+
+    async def _list(self, req):
+        sel = req.query.get("labelSelector", "")
+        k, _, v = sel.partition("=")
+        items = [cm for cm in self.cms.values()
+                 if not sel or (cm["metadata"].get("labels") or {}).get(k) == v]
+        return web.json_response({"items": items})
+
+
+async def test_kube_discovery_backend():
+    """Register/list/watch/lease-expiry over the ConfigMap registry."""
+    import asyncio
+
+    from dynamo_tpu.runtime.component import Instance
+    from dynamo_tpu.runtime.kube_discovery import KubeDiscovery
+
+    api = FakeKubeCmApi()
+    base = await api.start()
+    d = KubeDiscovery(namespace="prod", api_base=base, token="t",
+                      lease_ttl=1.0, poll_interval=0.1)
+    watcher = KubeDiscovery(namespace="prod", api_base=base, token="t",
+                            lease_ttl=1.0, poll_interval=0.1)
+    events = []
+
+    async def consume():
+        async for ev in watcher.watch():
+            events.append((ev.kind, ev.instance.instance_id))
+
+    try:
+        inst = Instance(namespace="t", component="w", endpoint="gen",
+                        instance_id=9, address="127.0.0.1:9009", metadata={})
+        await d.register(inst)
+        got = await d.list_instances()
+        assert [i.instance_id for i in got] == [9]
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.3)
+        assert ("put", 9) in events
+
+        # no heartbeats → lease expires → watch emits delete
+        await asyncio.sleep(1.2)
+        assert ("delete", 9) in events
+
+        # heartbeat revives (re-put refreshes the annotation)
+        await d.heartbeat()
+        await asyncio.sleep(0.3)
+        assert events.count(("put", 9)) >= 2
+
+        await d.unregister(inst)
+        await asyncio.sleep(0.3)
+        assert events[-1] == ("delete", 9)
+        task.cancel()
+    finally:
+        await d.close()
+        await watcher.close()
+        await api.stop()
