@@ -169,11 +169,14 @@ class GlobalRouter:
                 # blacklist the cluster for them (health probes keep
                 # watching the cluster itself)
                 log.info("ws bridge to %s ended: %s", cluster.base, e)
+            finally:
+                # whatever ended the bridge, never orphan the sibling pump
                 for t in (t1, t2):
                     if not t.done():
                         t.cancel()
                 await asyncio.gather(t1, t2, return_exceptions=True)
-                await server_ws.close()
+                if not server_ws.closed:
+                    await server_ws.close()
         finally:
             cluster.in_flight -= 1
         return server_ws

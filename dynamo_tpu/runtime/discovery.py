@@ -206,12 +206,18 @@ class FileDiscovery(DiscoveryBackend):
         return list(self._scan(prefix or "services/").values())
 
     async def watch(self, prefix: str = "") -> AsyncIterator[DiscoveryEvent]:
+        import logging
+
         prefix = prefix or "services/"
+        log = logging.getLogger("dynamo_tpu.runtime.discovery")
 
         async def scan():
             return self._scan(prefix)
 
-        async for ev in poll_diff_watch(scan, self.poll_interval):
+        async for ev in poll_diff_watch(
+            scan, self.poll_interval,
+            on_error=lambda e: log.warning("file discovery scan failed (%s); retrying", e),
+        ):
             yield ev
 
 
@@ -241,6 +247,7 @@ def make_discovery(backend: Optional[str] = None, **kw) -> DiscoveryBackend:
             or os.environ.get("DYN_K8S_NAMESPACE", "default"),
             # DYN_K8S_API overrides the in-cluster endpoint (dev/test)
             api_base=kw.get("api_base") or os.environ.get("DYN_K8S_API"),
-            lease_ttl=float(kw.get("lease_ttl", 10.0)),
+            # only override the backend's skew-aware default when asked
+            **({"lease_ttl": float(kw["lease_ttl"])} if "lease_ttl" in kw else {}),
         )
     raise ValueError(f"unknown discovery backend {backend!r}")

@@ -29,6 +29,10 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint", default=None,
                    help="HF safetensors checkpoint dir (config derived from its config.json)")
     p.add_argument("--model-name", default=None, help="served model name (default: config name)")
+    p.add_argument("--orbax-cache", default=None,
+                   help="params snapshot dir: load if present, else save "
+                        "after build (fast worker restarts — the snapshot-"
+                        "restore role of the reference's fast-restart path)")
     p.add_argument("--namespace", default="dyn")
     p.add_argument("--component", default="tpu-worker")
     p.add_argument("--endpoint", default="generate")
@@ -134,14 +138,40 @@ def _lora_kwargs(args, config) -> dict:
 
 
 def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
+    import os
+
     params = None
+    # warm snapshot short-circuits the expensive HF checkpoint load (only
+    # the config.json is read) — that is the whole point of fast restart
+    snapshot_warm = bool(
+        args.orbax_cache
+        and os.path.isdir(args.orbax_cache)
+        and os.listdir(args.orbax_cache)
+    )
     if args.checkpoint:
         from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
 
         config = config_from_hf(args.checkpoint, name=args.model_name or args.model)
-        params = load_hf_checkpoint(args.checkpoint, config)
+        if not snapshot_warm:
+            params = load_hf_checkpoint(args.checkpoint, config)
     else:
         config = get_config(args.model)
+    save_snapshot = False
+    if snapshot_warm:
+        from dynamo_tpu.engine.weights import load_orbax
+
+        log.info("fast restart: loading params snapshot %s", args.orbax_cache)
+        params = load_orbax(args.orbax_cache)
+        embed = params.get("embed")
+        if embed is None or tuple(embed.shape) != (config.vocab_size, config.dim):
+            raise SystemExit(
+                f"snapshot {args.orbax_cache} does not match model config "
+                f"{config.name} (embed {getattr(embed, 'shape', None)} vs "
+                f"{(config.vocab_size, config.dim)}); delete the snapshot "
+                "to rebuild it"
+            )
+    elif args.orbax_cache and params is not None:
+        save_snapshot = True
     mesh = MeshConfig(
         data=args.data_parallel,
         model=args.tensor_parallel,
@@ -175,6 +205,11 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
     )
     for name, factors in getattr(args, "_lora_factors", []):
         runner.register_adapter(name, factors)
+    if save_snapshot:
+        from dynamo_tpu.engine.weights import save_orbax
+
+        log.info("writing params snapshot to %s", args.orbax_cache)
+        save_orbax(params, args.orbax_cache)
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         host_kv_blocks=args.host_kv_blocks,

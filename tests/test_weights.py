@@ -97,3 +97,25 @@ def test_loader_rejects_mismatched_config(tmp_path):
     _write_hf_checkpoint(tmp_path, get_config("tiny"))
     with pytest.raises(ValueError):
         load_hf_checkpoint(str(tmp_path), get_config("tiny").with_(dim=128, n_heads=8))
+
+
+def test_orbax_snapshot_roundtrip(tmp_path):
+    """Fast-restart snapshot: save a param tree, load it back identically
+    (the worker's --orbax-cache path)."""
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine.weights import load_orbax, save_orbax
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import get_config
+
+    params = llama.init_params(get_config("tiny"), jax.random.PRNGKey(5))
+    save_orbax(params, str(tmp_path / "snap"))
+    loaded = load_orbax(str(tmp_path / "snap"))
+
+    flat_a, tree_a = jax.tree_util.tree_flatten(params)
+    flat_b, tree_b = jax.tree_util.tree_flatten(loaded)
+    assert tree_a == tree_b
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
