@@ -603,6 +603,16 @@ def _set_future_exc(fut: asyncio.Future, exc: Exception) -> None:
         fut.set_exception(exc)
 
 
+def _stable_seed(request_id: str) -> int:
+    """Process-independent sampling seed so a migrated/retried request samples
+    the same stream on whichever worker replays it (Python's hash() is salted
+    per process)."""
+    import hashlib
+
+    d = hashlib.blake2b(request_id.encode(), digest_size=4).digest()
+    return int.from_bytes(d, "big") & 0x7FFFFFFF
+
+
 def _sampling_params(seqs: List[Sequence]) -> Dict[str, list]:
     """Plain host lists; the runner converts to device arrays (keeps the
     mocker's SimRunner — and thus mocker processes — entirely jax-free)."""
@@ -612,7 +622,7 @@ def _sampling_params(seqs: List[Sequence]) -> Dict[str, list]:
         "top_p": [float(s.sampling.get("top_p", 1.0)) for s in seqs],
         "seeds": [
             (s.sampling.get("seed") if s.sampling.get("seed") is not None
-             else (hash(s.request_id) & 0x7FFFFFFF))
+             else _stable_seed(s.request_id))
             for s in seqs
         ],
     }

@@ -104,15 +104,6 @@ class PagePool:
             self._ref_inc(p)
         return pages, hashes
 
-    def lookup_prefix_len(self, tokens: List[int]) -> int:
-        """Cached-prefix length in tokens, without taking refs (router use)."""
-        n = 0
-        for h in block_hashes(tokens, self.page_size):
-            if h not in self.by_hash:
-                break
-            n += self.page_size
-        return n
-
     def _ref_inc(self, page: int) -> None:
         if page in self.cached:
             del self.cached[page]

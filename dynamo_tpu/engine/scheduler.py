@@ -225,7 +225,7 @@ class Scheduler:
 
         if host_n:
             if self.host_onboard(fresh[:host_n], host_hashes):
-                parent = hashes[-1] if hashes else None
+                parent = hashes[-1] if hashes else _chain_seed(seq)
                 for page, h in zip(fresh[:host_n], host_hashes):
                     canonical = self.pool.register(page, h, parent)
                     if canonical != page:  # raced with another registration

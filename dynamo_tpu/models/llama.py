@@ -4,7 +4,7 @@ TPU-first design notes:
 - Layer params are **stacked** on a leading [n_layers] axis and the forward
   runs `lax.scan` over layers → one compiled layer body, fast XLA compiles
   even at 80 layers, and scan-carried KV pool updates.
-- The KV cache is a global paged pool `[L, num_pages, page_size, Hk, Dh]`;
+- The KV cache is a global paged pool `[L, Hk, num_pages, page_size, Dh]`;
   sequences own pages via a page table (flat position p lives at
   `page_table[p // page_size], p % page_size`). Gathered attention reads are
   the jnp reference path; the Pallas ragged-paged-attention kernel
@@ -194,7 +194,7 @@ def forward(
     params: Params,
     tokens: jax.Array,  # [B, S]
     positions: jax.Array,  # [B, S] absolute positions (padding = -1)
-    k_pool: jax.Array,  # [L, NP, PS, Hk, Dh]
+    k_pool: jax.Array,  # [L, Hk, NP, PS, Dh]
     v_pool: jax.Array,
     page_table: jax.Array,  # [B, MP]
     kv_lens: jax.Array,  # [B] context length AFTER this step's tokens

@@ -110,7 +110,11 @@ class PrefillRouter:
         first_token, transfer_src, prefill_inst = prefill_result
         stop = dict(request.get("stop") or {})
         max_tokens = stop.get("max_tokens")  # None = unlimited (engine semantics)
-        if first_token in set(stop.get("stop_ids") or []) and not stop.get("ignore_eos"):
+        # Scheduler.complete_decode only honors stop_ids past min_tokens; match it
+        # so a request terminates identically on the agg and disagg paths.
+        if (first_token in set(stop.get("stop_ids") or [])
+                and not stop.get("ignore_eos")
+                and int(stop.get("min_tokens") or 0) < 1):
             self._discard_parked(transfer_src)
             yield {"token_ids": [], "finish_reason": "stop"}
             return
@@ -125,6 +129,8 @@ class PrefillRouter:
         dreq["token_ids"] = list(token_ids) + [int(first_token)]
         if max_tokens is not None:
             stop["max_tokens"] = int(max_tokens) - 1
+        if int(stop.get("min_tokens") or 0) >= 1:
+            stop["min_tokens"] = int(stop["min_tokens"]) - 1
         dreq["stop"] = stop
         ann = dict(dreq.get("annotations") or {})
         ann["disagg"] = "decode"

@@ -1,0 +1,28 @@
+"""Probe whether the axon TPU backend is alive.
+
+Run with `timeout 90 python scripts/tpu_probe.py`; exit 0 iff a matmul
+round-trips device->host. All timing/aliveness checks MUST end in a
+device->host read (block_until_ready lies through the relay).
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    print("devices:", devs, flush=True)
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    t0 = time.time()
+    r = np.asarray(jax.device_get(f(x)))
+    print(f"matmul ok {r.shape} in {time.time()-t0:.1f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
