@@ -34,6 +34,9 @@ def parse_args(argv=None):
                         "replicas share one load view (kv mode)")
     p.add_argument("--disagg-min-prefill-tokens", type=int, default=256,
                    help="prompts at least this long go to prefill workers when present")
+    p.add_argument("--session-affinity-ttl", type=float, default=0,
+                   help="pin sessions (x-dynamo-session-id) to their first "
+                        "worker for this many idle seconds (0 = off)")
     p.add_argument("--busy-threshold", type=int, default=0,
                    help="shed load (503) above this many in-flight requests per model")
     p.add_argument("--request-trace", default=None,
@@ -55,6 +58,7 @@ async def async_main(args) -> None:
         router_replica_sync=args.router_replica_sync,
         migration_limit=args.migration_limit,
         disagg_min_prefill_tokens=args.disagg_min_prefill_tokens,
+        session_affinity_ttl=args.session_affinity_ttl or None,
     )
     svc = HttpService(
         runtime, manager, watcher, host=args.http_host, port=args.http_port,

@@ -26,6 +26,43 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime
 log = logging.getLogger("dynamo_tpu.http")
 
 
+
+def _request_context(request: "web.Request", model: str) -> Context:
+    """Build the request Context, threading routing headers into metadata
+    (reference http/service/openai.rs context_from_headers +
+    extensions.rs apply_header_routing_overrides):
+
+    - x-dynamo-session-id (alias x-session-id) -> session affinity key
+    - x-dynamo-worker-instance-id -> explicit worker target (hex)
+    """
+    md: Dict[str, Any] = {"model": model}
+    sid = request.headers.get("x-dynamo-session-id") or \
+        request.headers.get("x-session-id")
+    if sid:
+        md["session_id"] = sid
+    # instance ids are rendered in hex everywhere user-visible, so the
+    # header is hex too — decimal-first parsing would silently misread
+    # all-digit hex ids
+    tgt = request.headers.get("x-dynamo-worker-instance-id")
+    if tgt:
+        try:
+            md["target_instance"] = int(tgt, 16)
+        except ValueError:
+            # an explicit target must fail loudly, never silently re-route
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": {
+                    "message": f"invalid x-dynamo-worker-instance-id "
+                               f"{tgt!r} (hex instance id expected)",
+                    "type": "invalid_request_error",
+                }}),
+                content_type="application/json",
+            )
+    traceparent = request.headers.get("traceparent")
+    if traceparent:
+        md["traceparent"] = traceparent
+    return Context(metadata=md)
+
+
 class HttpService:
     def __init__(
         self,
@@ -209,7 +246,7 @@ class HttpService:
 
         rid = f"resp_{uuid.uuid4().hex[:24]}"
         created = int(time.time())
-        ctx = Context(metadata={"model": model})
+        ctx = _request_context(request, model)
 
         if body.get("stream"):
             return await self._responses_stream(
@@ -228,6 +265,10 @@ class HttpService:
                     finish = item["finish_reason"]
                     break
         except Exception as e:
+            from dynamo_tpu.frontend.session_affinity import AffinityError
+
+            if isinstance(e, AffinityError):
+                return _error(400, str(e), "invalid_request_error")
             log.exception("responses request failed")
             return _error(500, str(e), "api_error")
         finally:
@@ -332,7 +373,7 @@ class HttpService:
         except ValueError as e:
             return _error(400, str(e), "invalid_request_error")
 
-        ctx = Context(metadata={"model": model})
+        ctx = _request_context(request, model)
         text_parts: list = []
         finish = None
         n_out = 0
@@ -344,6 +385,10 @@ class HttpService:
                     finish = item["finish_reason"]
                     break
         except Exception as e:
+            from dynamo_tpu.frontend.session_affinity import AffinityError
+
+            if isinstance(e, AffinityError):
+                return _error(400, str(e), "invalid_request_error")
             log.exception("anthropic messages request failed")
             return _error(500, str(e), "api_error")
         finally:
@@ -421,7 +466,7 @@ class HttpService:
                 "annotations": {"kind": "embedding"},
                 "model": model,
             }
-            async for item in entry.client.generate(req, Context(metadata={"model": model})):
+            async for item in entry.client.generate(req, _request_context(request, model)):
                 if "embedding" in item:
                     return item["embedding"]
                 if item.get("finish_reason"):
@@ -473,7 +518,7 @@ class HttpService:
         except ValueError as e:
             return _error(400, str(e), "invalid_request_error")
 
-        ctx = Context(metadata={"model": model})
+        ctx = _request_context(request, model)
         rid = f"{'chatcmpl' if kind == 'chat' else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         stream = bool(body.get("stream", False))
         created = int(time.time())
@@ -607,6 +652,12 @@ class HttpService:
                         timing.finish_reason = finish
                     break
         except Exception as e:
+            from dynamo_tpu.frontend.session_affinity import AffinityError
+
+            if isinstance(e, AffinityError):
+                # client-input error (oversized session id, explicit-target
+                # conflict), not a server fault
+                return _error(400, str(e), "invalid_request_error")
             log.exception("request %s failed", rid)
             return _error(500, str(e), "internal_error")
         finally:

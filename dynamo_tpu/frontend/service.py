@@ -86,6 +86,7 @@ class ModelWatcher:
         migration_limit: int = 3,
         chain_factory=None,
         disagg_min_prefill_tokens: int = 256,
+        session_affinity_ttl: Optional[float] = None,
     ):
         self.runtime = runtime
         self.manager = manager
@@ -93,6 +94,16 @@ class ModelWatcher:
         self.router_replica_sync = router_replica_sync
         self.migration_limit = migration_limit
         self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
+        self.affinity = None
+        if session_affinity_ttl:
+            from dynamo_tpu.frontend.session_affinity import AffinityCoordinator
+
+            # one coordinator per frontend, shared across models (reference
+            # entrypoint/input/common.rs:254-271 create_affinity_coordinator)
+            self.affinity = AffinityCoordinator(
+                session_affinity_ttl, runtime=runtime,
+                replica_sync=router_replica_sync,
+            )
         self._task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
         # prefill-role instances seen before their model entry existed
@@ -118,6 +129,10 @@ class ModelWatcher:
             teardown = kv_router.stop
         else:
             router_engine = _ClientEngine(client)
+        if self.affinity is not None:
+            from dynamo_tpu.frontend.session_affinity import SessionAffinityEngine
+
+            router_engine = SessionAffinityEngine(router_engine, client, self.affinity)
         prefill_router = PrefillRouter(
             router_engine,
             DisaggPolicy(min_prefill_tokens=self.disagg_min_prefill_tokens),
@@ -144,6 +159,8 @@ class ModelWatcher:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self.affinity is not None:
+            await self.affinity.stop()
         for entry in self.manager.models.values():
             await entry.close()
         self.manager.models.clear()

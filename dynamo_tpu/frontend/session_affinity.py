@@ -1,0 +1,456 @@
+"""Sticky session→worker affinity.
+
+Analog of the reference session-affinity stack
+(lib/llm/src/session_affinity/: coordinator.rs — Initializing/Bound entry
+state machine with idle-TTL leases; push_router.rs — route-then-bind
+wrapping of the egress router; replica_sync.rs — bind broadcast between
+frontend replicas; wired at entrypoint/input/common.rs:206-238).
+
+Semantics:
+- A session id (``x-dynamo-session-id`` header → ``ctx.metadata["session_id"]``)
+  pins all of a session's requests to the worker that served its first
+  request, so multi-turn conversations hit that worker's warm KV cache.
+- The first request of a session holds an *initializing* slot while it
+  routes; concurrent same-session requests wait on it instead of racing to
+  bind different workers (reference coordinator.rs Initializing + Notify).
+- The TTL is an *idle* TTL: it starts counting when the session's last
+  in-flight request finishes and is refreshed by each new request.
+- Binding is load-aware only at bind time (the underlying router mode —
+  kv/round_robin/random — picks the first worker); after that the pin wins
+  until TTL expiry or worker death, matching the reference.
+- If the bound worker disappears from discovery, the session transparently
+  rebinds on its next request (reference push_router.rs fallback).
+- With ``replica_sync``, binds/refreshes/invalidates broadcast over the
+  event plane so parallel frontend replicas share one session table.
+
+Scope note: affinity applies to the aggregated/decode hop. The disagg
+prefill hop stays KV/load routed (prefill output is transferred anyway, so
+stickiness buys nothing there) — same shape as the reference, which keys
+affinity per RequestPhase and defaults the prefill phase to router choice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, AsyncIterator, Dict, Optional
+
+from dynamo_tpu.runtime.context import Context
+
+log = logging.getLogger("dynamo_tpu.affinity")
+
+# reference session_affinity/mod.rs:17-19
+MAX_SESSION_AFFINITY_TTL_SECS = 31_536_000
+MAX_SESSION_AFFINITY_ENTRIES = 65_536
+MAX_SESSION_AFFINITY_ID_BYTES = 256
+
+AFFINITY_SYNC_SUBJECT = "affinity_sync"
+
+
+class AffinityError(ValueError):
+    """Invalid-argument class errors (bad session id, bound-target conflict)."""
+
+
+class _Entry:
+    __slots__ = ("state", "revision", "event", "instance_id", "leases",
+                 "idle_deadline")
+
+    def __init__(self, state: str, revision: int):
+        self.state = state  # "init" | "bound"
+        self.revision = revision
+        self.event: Optional[asyncio.Event] = (
+            asyncio.Event() if state == "init" else None
+        )
+        self.instance_id: Optional[int] = None
+        self.leases = 0
+        self.idle_deadline = 0.0
+
+
+class AffinityLease:
+    """Held for the duration of one routed request.
+
+    ``target`` is the bound instance id, or None when this lease holds the
+    session's initializing slot (the caller must ``bind()`` the instance the
+    router picked, or the slot is released on ``release()``).
+    """
+
+    def __init__(self, coord: "AffinityCoordinator", session_id: str,
+                 entry: _Entry, target: Optional[int]):
+        self._coord = coord
+        self._session_id = session_id
+        self._entry = entry
+        self.target = target
+        self._done = False
+
+    def bind(self, instance_id: int) -> None:
+        if self._done or self.target is not None:
+            return
+        self._coord._bind(self._session_id, self._entry, instance_id)
+        self.target = instance_id
+
+    def release(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._coord._release(self._session_id, self._entry,
+                             bound=self.target is not None)
+
+
+class AffinityCoordinator:
+    """session_id → worker instance table shared by all models of a frontend.
+
+    Reference coordinator.rs AffinityCoordinatorInner: entry state machine,
+    capacity/id-size limits, idle reaper, optional replica sync.
+    """
+
+    def __init__(
+        self,
+        ttl: float,
+        runtime=None,
+        replica_sync: bool = False,
+        max_entries: int = MAX_SESSION_AFFINITY_ENTRIES,
+        max_id_bytes: int = MAX_SESSION_AFFINITY_ID_BYTES,
+        clock=time.monotonic,
+    ):
+        if not (1.0 <= ttl <= MAX_SESSION_AFFINITY_TTL_SECS):
+            raise AffinityError(
+                f"session affinity TTL must be between 1 and "
+                f"{MAX_SESSION_AFFINITY_TTL_SECS} seconds"
+            )
+        self.ttl = float(ttl)
+        self.runtime = runtime
+        self.replica_sync = replica_sync and runtime is not None
+        self.max_entries = max_entries
+        self.max_id_bytes = max_id_bytes
+        self._clock = clock
+        self.entries: Dict[str, _Entry] = {}
+        self._next_revision = 0
+        self._started = False
+        self._stopped = False
+        self._tasks: list = []
+        self._publish_tasks: set = set()
+        self._sync_pub = None
+        self._sync_sub = None
+        self._replica_id = f"{id(self):x}{int(time.time()*1e6):x}"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        # _stopped latches: a request still in flight during shutdown must
+        # not resurrect the reaper / re-register the replica-sync instance
+        if self._started or self._stopped:
+            return
+        self._started = True
+        self._tasks.append(asyncio.create_task(self._reaper()))
+        if self.replica_sync:
+            await self._start_replica_sync()
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in list(self._tasks) + list(self._publish_tasks):
+            t.cancel()
+        self._tasks.clear()
+        self._publish_tasks.clear()
+        if self._sync_inst is not None and self.runtime is not None:
+            try:
+                await self.runtime.discovery.unregister(self._sync_inst)
+            except Exception:
+                pass
+        self._started = False
+
+    _sync_inst = None
+
+    async def _reaper(self) -> None:
+        period = max(0.05, min(self.ttl / 2.0, 30.0))
+        try:
+            while True:
+                await asyncio.sleep(period)
+                now = self._clock()
+                for sid in [
+                    s for s, e in self.entries.items()
+                    if e.state == "bound" and e.leases == 0
+                    and now >= e.idle_deadline
+                ]:
+                    self.entries.pop(sid, None)
+        except asyncio.CancelledError:
+            pass
+
+    # -- acquire / bind / release -------------------------------------------
+
+    async def acquire(self, session_id: str,
+                      explicit: Optional[int] = None,
+                      scope: str = "") -> AffinityLease:
+        """Resolve a session to a lease.
+
+        Returns a bound lease (target set) or an initializing lease (caller
+        binds). Waits when another request of the same session is currently
+        initializing. ``explicit`` is an explicitly requested worker id
+        (x-dynamo-worker-instance-id); a conflict with an existing live
+        binding is an error (reference coordinator.rs validate_bound_target).
+
+        ``scope`` partitions the table (one entry per (model, session)): the
+        same session id used against two models must not share a binding —
+        each model has its own worker set, and a shared entry would thrash
+        invalidate/rebind on every alternation.
+        """
+        if len(session_id.encode()) > self.max_id_bytes:
+            raise AffinityError(
+                f"session id exceeds {self.max_id_bytes} bytes"
+            )
+        key = (scope, session_id)
+        while True:
+            entry = self.entries.get(key)
+            now = self._clock()
+            if entry is not None and entry.state == "init":
+                await entry.event.wait()
+                continue
+            if (entry is None
+                    or (entry.leases == 0 and now >= entry.idle_deadline)):
+                # claim the initializing slot (fresh or replacing expired)
+                if entry is None and len(self.entries) >= self.max_entries:
+                    self._evict_one_expired(now)
+                    if len(self.entries) >= self.max_entries:
+                        raise AffinityError("session affinity table is full")
+                self._next_revision += 1
+                fresh = _Entry("init", self._next_revision)
+                self.entries[key] = fresh
+                return AffinityLease(self, key, fresh, None)
+            # live binding
+            if explicit is not None and explicit != entry.instance_id:
+                raise AffinityError(
+                    f"session {session_id!r} is bound to worker "
+                    f"{entry.instance_id:x}, not {explicit:x}"
+                )
+            entry.leases += 1
+            return AffinityLease(self, key, entry, entry.instance_id)
+
+    def _evict_one_expired(self, now: float) -> None:
+        for sid, e in self.entries.items():
+            if e.state == "bound" and e.leases == 0 and now >= e.idle_deadline:
+                del self.entries[sid]
+                return
+
+    def _bind(self, session_id: str, entry: _Entry, instance_id: int) -> None:
+        if self.entries.get(session_id) is not entry:
+            return  # invalidated while initializing; binding is moot
+        event = entry.event
+        entry.state = "bound"
+        entry.event = None
+        entry.instance_id = int(instance_id)
+        entry.leases = 1
+        entry.idle_deadline = self._clock() + self.ttl
+        if event is not None:
+            event.set()
+        self._publish("bind", session_id, entry.instance_id)
+
+    def _release(self, session_id: str, entry: _Entry, bound: bool) -> None:
+        if self.entries.get(session_id) is not entry:
+            return
+        if not bound and entry.state == "init":
+            # routed without ever learning the instance (error before first
+            # item, or inner router exposed nothing): free the slot so
+            # waiters retry rather than deadlock
+            del self.entries[session_id]
+            entry.event.set()
+            return
+        entry.leases = max(0, entry.leases - 1)
+        if entry.leases == 0:
+            entry.idle_deadline = self._clock() + self.ttl
+            self._publish("refresh", session_id, entry.instance_id)
+
+    def invalidate(self, session_id: str, scope: str = "") -> None:
+        key = (scope, session_id)
+        entry = self.entries.pop(key, None)
+        if entry is not None and entry.event is not None:
+            entry.event.set()
+        if entry is not None:
+            self._publish("invalidate", key, entry.instance_id)
+
+    def invalidate_instance(self, instance_id: int) -> None:
+        """Worker died: drop every session pinned to it (next request of each
+        session rebinds via the router). Not replica-synced — every replica
+        observes the same discovery delete."""
+        for sid in [s for s, e in self.entries.items()
+                    if e.state == "bound" and e.instance_id == instance_id]:
+            del self.entries[sid]
+
+    # -- replica sync (reference replica_sync.rs) ---------------------------
+
+    async def _start_replica_sync(self) -> None:
+        from dynamo_tpu.runtime.component import Instance
+
+        self._sync_pub = self.runtime.event_publisher()
+        self._sync_sub = self.runtime.event_subscriber([AFFINITY_SYNC_SUBJECT])
+        self._sync_inst = Instance(
+            namespace="_sys",
+            component="affinity_sync",
+            endpoint="sessions",
+            instance_id=int(self._replica_id[:15], 16),
+            metadata={"publisher": self._sync_pub.address,
+                      "replica": self._replica_id},
+        )
+        await self.runtime.discovery.register(self._sync_inst)
+        self._tasks.append(asyncio.create_task(self._peer_watch()))
+        self._tasks.append(asyncio.create_task(self._sync_loop()))
+
+    async def _peer_watch(self) -> None:
+        try:
+            async for ev in self.runtime.discovery.watch(
+                "services/_sys/affinity_sync/"
+            ):
+                try:
+                    inst = ev.instance
+                    if inst.instance_id == self._sync_inst.instance_id:
+                        continue
+                    addr = (inst.metadata or {}).get("publisher")
+                    if not addr:
+                        continue
+                    if ev.kind == "put":
+                        self._sync_sub.connect(addr)
+                    else:
+                        self._sync_sub.disconnect(addr)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("affinity peer event failed; continuing")
+        except asyncio.CancelledError:
+            pass
+
+    async def _sync_loop(self) -> None:
+        try:
+            async for subject, payload in self._sync_sub.events():
+                try:
+                    if subject != AFFINITY_SYNC_SUBJECT:
+                        continue
+                    if payload.get("replica") == self._replica_id:
+                        continue
+                    self._apply_peer(payload)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception("affinity sync event failed; continuing")
+        except asyncio.CancelledError:
+            pass
+
+    def _apply_peer(self, payload: Dict[str, Any]) -> str:
+        """Apply a peer's bind/refresh/invalidate. Returns the outcome name
+        (reference coordinator.rs ReplicaApplyOutcome) — used by tests."""
+        sid = payload.get("sid")
+        iid = payload.get("instance")
+        op = payload.get("op")
+        if not isinstance(sid, str) or len(sid.encode()) > self.max_id_bytes:
+            return "rejected_session_id"
+        key = (payload.get("scope") or "", sid)
+        now = self._clock()
+        entry = self.entries.get(key)
+        if op == "invalidate":
+            if entry is not None and entry.state == "bound" \
+                    and entry.instance_id == iid:
+                del self.entries[key]
+            return "invalidated"
+        if entry is None:
+            if len(self.entries) >= self.max_entries:
+                self._evict_one_expired(now)
+                if len(self.entries) >= self.max_entries:
+                    return "rejected_capacity"
+            self._next_revision += 1
+            e = _Entry("bound", self._next_revision)
+            e.instance_id = int(iid)
+            e.idle_deadline = now + self.ttl
+            self.entries[key] = e
+            return "inserted"
+        if entry.state == "init":
+            return "ignored_initializing"  # local binder wins
+        if entry.instance_id == iid:
+            entry.idle_deadline = max(entry.idle_deadline, now + self.ttl)
+            return "refreshed"
+        if entry.leases == 0 and now >= entry.idle_deadline:
+            self._next_revision += 1
+            entry.revision = self._next_revision
+            entry.instance_id = int(iid)
+            entry.idle_deadline = now + self.ttl
+            return "replaced_expired"
+        return "ignored_conflict"
+
+    def _publish(self, op: str, key, instance_id: Optional[int]) -> None:
+        if self._sync_pub is None:
+            return
+        payload = {"replica": self._replica_id, "op": op, "scope": key[0],
+                   "sid": key[1], "instance": instance_id}
+        task = asyncio.get_running_loop().create_task(
+            self._sync_pub.publish(AFFINITY_SYNC_SUBJECT, payload)
+        )
+        self._publish_tasks.add(task)
+        task.add_done_callback(self._publish_tasks.discard)
+
+
+class SessionAffinityEngine:
+    """Routing-chain node wrapping the egress router (reference
+    push_router.rs SessionAffinityPushRouter).
+
+    Bound sessions route direct (``target_instance``); unbound sessions let
+    the inner router pick, then bind the instance the router reports back
+    via ``ctx.metadata["routed_instance"]``. Sessions whose bound worker
+    left discovery are invalidated and rebound."""
+
+    def __init__(self, inner, client, coordinator: AffinityCoordinator):
+        self.inner = inner
+        self.client = client
+        self.coordinator = coordinator
+        client.on_instance_change(self._on_instance_change)
+
+    def _on_instance_change(self, kind: str, inst) -> None:
+        if kind == "delete":
+            self.coordinator.invalidate_instance(inst.instance_id)
+
+    # connect-class request plane errors: the pinned worker is unreachable,
+    # so drop the binding before Migration retries — otherwise every retry
+    # re-targets the dead worker until migration_limit is exhausted, even
+    # though healthy workers exist
+    _CONNECT_ERRORS = ("cannot_connect", "disconnected", "no_endpoint")
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        session_id = context.metadata.get("session_id")
+        if not session_id:
+            async for item in self.inner.generate(request, context):
+                yield item
+            return
+        await self.coordinator.start()
+        scope = str(context.metadata.get("model") or "")
+        explicit = context.metadata.get("target_instance")
+        lease = await self.coordinator.acquire(
+            session_id, explicit=explicit, scope=scope
+        )
+        # bound worker gone from discovery → rebind (reference push_router.rs
+        # stale-binding fallback)
+        if lease.target is not None and lease.target not in self.client.instances:
+            lease.release()
+            self.coordinator.invalidate(session_id, scope=scope)
+            lease = await self.coordinator.acquire(
+                session_id, explicit=explicit, scope=scope
+            )
+        try:
+            if lease.target is not None or explicit is not None:
+                if lease.target is None:
+                    lease.bind(explicit)
+                context.metadata["target_instance"] = lease.target
+                async for item in self.inner.generate(request, context):
+                    yield item
+                return
+            bound = False
+            async for item in self.inner.generate(request, context):
+                if not bound:
+                    routed = context.metadata.get("routed_instance")
+                    if routed is not None:
+                        lease.bind(routed)
+                        bound = True
+                yield item
+        except Exception as e:
+            if getattr(e, "code", None) in self._CONNECT_ERRORS:
+                self.coordinator.invalidate(session_id, scope=scope)
+                # let the migration retry re-route instead of re-pinning
+                context.metadata.pop("target_instance", None)
+            raise
+        finally:
+            lease.release()

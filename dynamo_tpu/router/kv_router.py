@@ -27,6 +27,7 @@ from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector
 from dynamo_tpu.router.sequences import ActiveSequences
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.distributed import DistributedRuntime, EndpointClient
+from dynamo_tpu.runtime.request_plane import RequestPlaneError
 from dynamo_tpu.tokens.hashing import block_hashes
 
 log = logging.getLogger("dynamo_tpu.router")
@@ -287,12 +288,16 @@ class KvRouter:
 
     def find_best_match(
         self, token_ids: List[int], adapter: Optional[str] = None,
-        mm_seed: Optional[int] = None,
+        mm_seed: Optional[int] = None, pinned_instance: Optional[int] = None,
     ) -> Tuple[Worker, int, List[int]]:
         """Returns (worker, overlap_blocks, block_hashes). `adapter` and
         `mm_seed` seed the hash chain exactly like the worker scheduler
         (tokens/hashing.request_seed), so LoRA and multimodal requests
-        score overlap only against their own lineage's cached blocks."""
+        score overlap only against their own lineage's cached blocks.
+
+        `pinned_instance` restricts selection to that instance's workers
+        (session affinity / explicit targeting): the selector still picks
+        the best dp rank and the overlap bookkeeping stays accurate."""
         from dynamo_tpu.tokens.hashing import request_seed
 
         hashes = block_hashes(
@@ -301,6 +306,15 @@ class KvRouter:
         overlaps = self.indexer.index.find_matches(hashes)
         host_overlaps = self.indexer.host_index.find_matches(hashes).scores
         workers = self.workers()
+        if pinned_instance is not None:
+            workers = [w for w in workers if w[0] == pinned_instance]
+            if not workers:
+                # same contract as PushRouter._pick: a named target that is
+                # gone fails loudly (migratable), never silently re-routes
+                raise RequestPlaneError(
+                    f"instance {pinned_instance:x} not found",
+                    code="cannot_connect",
+                )
         worker, overlap = self.selector.select(
             workers, len(hashes), overlaps, self.sequences,
             host_overlaps=host_overlaps,
@@ -368,11 +382,13 @@ class KvPushRouter:
 
             mm_seed = mm_content_seed(mm["data"])
         worker, overlap, hashes = self.router.find_best_match(
-            token_ids, adapter=request.get("adapter"), mm_seed=mm_seed
+            token_ids, adapter=request.get("adapter"), mm_seed=mm_seed,
+            pinned_instance=context.metadata.get("target_instance"),
         )
         rid = context.id
         self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap
+        context.metadata["routed_instance"] = worker[0]
         first = True
         try:
             async for item in self.router.client.direct(

@@ -383,7 +383,10 @@ class PushRouter:
         return RemoteEngine(self._pool, addr, self.endpoint_path)
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
-        engine = self.engine_for(context.metadata.get("target_instance"))
+        iid, addr = self._pick(context.metadata.get("target_instance"))
+        # report the choice so wrappers (session affinity) can pin to it
+        context.metadata["routed_instance"] = iid
+        engine = RemoteEngine(self._pool, addr, self.endpoint_path)
         async for item in engine.generate(request, context):
             yield item
 

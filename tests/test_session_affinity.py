@@ -1,0 +1,317 @@
+"""Session affinity: coordinator state machine (reference
+lib/llm/src/session_affinity/coordinator.rs semantics) and e2e stickiness +
+failover through the HTTP frontend (push_router.rs role)."""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.frontend.protocols import ModelCard, engine_output
+from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+from dynamo_tpu.frontend.session_affinity import (
+    AffinityCoordinator,
+    AffinityError,
+)
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+# -- coordinator unit tests -------------------------------------------------
+
+
+async def test_bind_then_sticky_and_idle_expiry():
+    clock = _Clock()
+    coord = AffinityCoordinator(ttl=10, clock=clock)
+    lease = await coord.acquire("s1")
+    assert lease.target is None  # first request holds the init slot
+    lease.bind(0xAB)
+    lease.release()
+
+    lease2 = await coord.acquire("s1")
+    assert lease2.target == 0xAB
+    lease2.release()
+
+    clock.now += 11  # idle TTL elapsed -> session unbinds
+    lease3 = await coord.acquire("s1")
+    assert lease3.target is None
+    lease3.bind(0xCD)
+    lease3.release()
+    assert (await coord.acquire("s1")).target == 0xCD
+
+
+async def test_ttl_is_idle_not_absolute():
+    clock = _Clock()
+    coord = AffinityCoordinator(ttl=10, clock=clock)
+    lease = await coord.acquire("s1")
+    lease.bind(1)
+    lease.release()
+    for _ in range(5):
+        clock.now += 8  # each request refreshes the idle deadline
+        lease = await coord.acquire("s1")
+        assert lease.target == 1
+        lease.release()
+
+
+async def test_concurrent_first_requests_serialize_on_init():
+    coord = AffinityCoordinator(ttl=10)
+    first = await coord.acquire("s1")
+    got = []
+
+    async def waiter():
+        lease = await coord.acquire("s1")
+        got.append(lease.target)
+        lease.release()
+
+    t = asyncio.create_task(waiter())
+    await asyncio.sleep(0.05)
+    assert not got  # waiter parked on the initializing entry
+    first.bind(7)
+    await asyncio.wait_for(t, 2)
+    assert got == [7]
+    first.release()
+
+
+async def test_release_without_bind_frees_slot():
+    coord = AffinityCoordinator(ttl=10)
+    first = await coord.acquire("s1")
+
+    async def waiter():
+        lease = await coord.acquire("s1")
+        try:
+            return lease.target
+        finally:
+            lease.bind(9)
+            lease.release()
+
+    t = asyncio.create_task(waiter())
+    await asyncio.sleep(0.05)
+    first.release()  # inner route failed before the instance was known
+    assert await asyncio.wait_for(t, 2) is None  # waiter got a fresh slot
+
+
+async def test_explicit_target_conflict_and_limits():
+    coord = AffinityCoordinator(ttl=10)
+    lease = await coord.acquire("s1")
+    lease.bind(1)
+    lease.release()
+    with pytest.raises(AffinityError):
+        await coord.acquire("s1", explicit=2)
+    # matching explicit target is fine
+    (await coord.acquire("s1", explicit=1)).release()
+    with pytest.raises(AffinityError):
+        await coord.acquire("x" * 300)
+    with pytest.raises(AffinityError):
+        AffinityCoordinator(ttl=0.5)
+
+
+async def test_capacity_evicts_expired_else_rejects():
+    clock = _Clock()
+    coord = AffinityCoordinator(ttl=10, max_entries=2, clock=clock)
+    for sid in ("a", "b"):
+        lease = await coord.acquire(sid)
+        lease.bind(1)
+        lease.release()
+    with pytest.raises(AffinityError):
+        await coord.acquire("c")
+    clock.now += 11  # expired entries may be evicted to make room
+    (await coord.acquire("c")).bind(2)
+
+
+async def test_invalidate_instance_drops_its_sessions():
+    coord = AffinityCoordinator(ttl=10)
+    for sid, iid in (("a", 1), ("b", 2)):
+        lease = await coord.acquire(sid)
+        lease.bind(iid)
+        lease.release()
+    coord.invalidate_instance(1)
+    assert (await coord.acquire("a")).target is None
+    assert (await coord.acquire("b")).target == 2
+
+
+async def test_replica_apply_outcomes():
+    clock = _Clock()
+    coord = AffinityCoordinator(ttl=10, clock=clock)
+    assert coord._apply_peer({"op": "bind", "sid": "s", "instance": 5}) == "inserted"
+    assert (await coord.acquire("s")).target == 5
+    assert coord._apply_peer({"op": "refresh", "sid": "s", "instance": 5}) == "refreshed"
+    # live conflict: local binding wins
+    assert coord._apply_peer({"op": "bind", "sid": "s", "instance": 6}) == "ignored_conflict"
+    # local initializing wins over peer binds
+    hold = await coord.acquire("init")
+    assert coord._apply_peer({"op": "bind", "sid": "init", "instance": 6}) == "ignored_initializing"
+    hold.release()
+    # expired local entry is replaced
+    coord.invalidate("s")
+    assert coord._apply_peer({"op": "bind", "sid": "s", "instance": 5}) == "inserted"
+    clock.now += 11
+    assert coord._apply_peer({"op": "bind", "sid": "s", "instance": 7}) == "replaced_expired"
+    assert coord._apply_peer({"op": "invalidate", "sid": "s", "instance": 7}) == "invalidated"
+    assert "s" not in coord.entries
+
+
+# -- e2e through the HTTP frontend ------------------------------------------
+
+
+class _TagEngine:
+    """Emits its tag token so responses identify which worker served them."""
+
+    def __init__(self, tag: int):
+        self.tag = tag
+
+    async def generate(self, request, context):
+        stop = request.get("stop") or {}
+        for _ in range(int(stop.get("max_tokens", 4))):
+            yield engine_output([self.tag], None)
+        yield engine_output([], "length")
+
+
+def _card():
+    return ModelCard(name="tag-model", tokenizer="byte", context_length=1024)
+
+
+async def _start_affinity_stack(realm):
+    workers = []
+    for tag in (ord("A"), ord("B")):
+        wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+        await wrt.serve_endpoint(
+            "dyn/worker/generate", _TagEngine(tag),
+            metadata={"model_card": _card().to_dict()},
+        )
+        workers.append(wrt)
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager, session_affinity_ttl=30)
+    svc = HttpService(frt, manager, watcher, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=5)
+    # both instances discovered before routing begins
+    entry = manager.get("tag-model")
+    for _ in range(100):
+        if len(entry.instance_ids) == 2:
+            break
+        await asyncio.sleep(0.05)
+    assert len(entry.instance_ids) == 2
+    return workers, frt, svc, base
+
+
+async def _served_by(s, base, headers=None):
+    payload = {"model": "tag-model", "prompt": "hi", "max_tokens": 3}
+    async with s.post(f"{base}/v1/completions", json=payload,
+                      headers=headers or {}) as r:
+        assert r.status == 200, await r.text()
+        body = await r.json()
+    text = body["choices"][0]["text"]
+    assert text and len(set(text)) == 1  # one worker per request
+    return text[0]
+
+
+async def test_session_pins_and_fails_over():
+    workers, frt, svc, base = await _start_affinity_stack("affinity-e2e")
+    try:
+        async with aiohttp.ClientSession() as s:
+            # without a session: round robin uses both workers
+            seen = {await _served_by(s, base) for _ in range(4)}
+            assert seen == {"A", "B"}
+
+            # with a session: every turn hits the same worker
+            hdr = {"x-dynamo-session-id": "conv-1"}
+            first = await _served_by(s, base, hdr)
+            for _ in range(4):
+                assert await _served_by(s, base, hdr) == first
+
+            # a different session may bind independently of conv-1
+            hdr2 = {"x-dynamo-session-id": "conv-2"}
+            second = await _served_by(s, base, hdr2)
+            for _ in range(2):
+                assert await _served_by(s, base, hdr2) == second
+
+            # bound worker dies -> session rebinds to the survivor
+            dead = 0 if first == "A" else 1
+            await workers[dead].shutdown(drain_timeout=1)
+            survivor = "B" if first == "A" else "A"
+            for _ in range(100):
+                entry = svc.manager.get("tag-model")
+                if len(entry.instance_ids) == 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert await _served_by(s, base, hdr) == survivor
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for w in workers:
+            try:
+                await w.shutdown(drain_timeout=1)
+            except Exception:
+                pass
+
+
+async def test_scope_partitions_models():
+    # same session id against two models must bind independently, never
+    # thrash invalidate/rebind between the models' worker sets
+    coord = AffinityCoordinator(ttl=10)
+    la = await coord.acquire("sid", scope="model-a")
+    la.bind(1)
+    la.release()
+    lb = await coord.acquire("sid", scope="model-b")
+    assert lb.target is None  # fresh slot, not model-a's binding
+    lb.bind(2)
+    lb.release()
+    assert (await coord.acquire("sid", scope="model-a")).target == 1
+    assert (await coord.acquire("sid", scope="model-b")).target == 2
+
+
+async def test_connect_error_unbinds_before_migration_retry():
+    from dynamo_tpu.frontend.session_affinity import SessionAffinityEngine
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+    class _Client:
+        instances = {1: object(), 2: object()}
+
+        def on_instance_change(self, cb):
+            pass
+
+    class _Inner:
+        def __init__(self):
+            self.dead = {1}
+            self.served = []
+
+        async def generate(self, request, context):
+            tgt = context.metadata.get("target_instance")
+            iid = tgt if tgt is not None else 2
+            if iid in self.dead:
+                raise RequestPlaneError("gone", code="disconnected")
+            context.metadata["routed_instance"] = iid
+            self.served.append(iid)
+            yield {"token_ids": [iid]}
+
+    coord = AffinityCoordinator(ttl=30)
+    inner = _Inner()
+    eng = SessionAffinityEngine(inner, _Client(), coord)
+    md = {"model": "m", "session_id": "s"}
+
+    lease = await coord.acquire("s", scope="m")
+    lease.bind(1)  # stale binding: worker 1 still in discovery but dead
+    lease.release()
+
+    ctx = Context(metadata=dict(md))
+    with pytest.raises(RequestPlaneError):
+        async for _ in eng.generate({}, ctx):
+            pass
+    # binding dropped and the pin cleared so a retry re-routes freely
+    assert ("m", "s") not in coord.entries
+    assert "target_instance" not in ctx.metadata
+
+    out = [i async for i in eng.generate({}, Context(metadata=dict(md)))]
+    assert out and inner.served == [2]
+    assert (await coord.acquire("s", scope="m")).target == 2
