@@ -1,0 +1,441 @@
+"""`python -m dynamo_tpu.operator` — Kubernetes operator controller.
+
+Analog of the reference operator's DynamoGraphDeployment controller
+(deploy/operator/api/v1beta1/dynamographdeployment_types.go:87 spec/status,
+deploy/operator/internal/controller/ reconcile loop), scoped to the DGD CRD
+(the reference's other CRDs — component deployments, scaling adapters,
+requests — are expressed through the same reconcile here).
+
+The controller watches `DynamoGraphDeployment` custom resources and drives
+the cluster to the declared state:
+
+- **create**: each `spec.components[]` entry becomes a child Deployment
+  (frontend components also get a Service), rendered by the same
+  `dynamo_tpu.deploy` templates `kubectl apply` users get.
+- **scale**: a replicas-only change PATCHes the child's `/scale`
+  subresource (this is how the planner's DGD-mode connector scales:
+  planner → DGD spec → operator → Deployment, matching the reference's
+  planner→CRD→operator flow).
+- **rolling update**: a pod-template change (image, model, args, env)
+  PUTs the child Deployment, delegating the actual rollout to the
+  Deployment controller; DGD status reports `updating` until child
+  `updatedReplicas` catches up.
+- **garbage collection**: children labeled as operator-managed whose
+  component (or whole graph) left the spec are deleted.
+- **status**: after each pass the DGD `/status` subresource is PATCHed
+  with observedGeneration, per-component replica counts, a coarse state,
+  and a Ready condition whose reason matches the reference enum
+  (all_resources_are_ready / pods_not_ready / updating /
+  some_resources_are_not_ready).
+
+Like the other control-plane pieces (kube_discovery, KubernetesConnector),
+it speaks the plain REST API with the service-account bearer token and
+poll-based watching — no kubernetes client library.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import logging
+import os
+import time
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.deploy import frontend_objects, worker_deployment
+from dynamo_tpu.runtime.kube_client import KubeApiClient
+
+log = logging.getLogger("dynamo_tpu.operator")
+
+GROUP = "dynamo.tpu"
+VERSION = "v1"
+PLURAL = "dynamographdeployments"
+MANAGED_BY = "dynamo-tpu-operator"
+
+# status condition reasons (reference dynamographdeployment_types.go)
+READY_ALL = "all_resources_are_ready"
+READY_PODS_NOT_READY = "pods_not_ready"
+READY_UPDATING = "updating"
+READY_SOME_NOT_READY = "some_resources_are_not_ready"
+
+
+def crd_manifest() -> Dict[str, Any]:
+    """The DynamoGraphDeployment CRD itself (apply once per cluster)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": "DynamoGraphDeployment",
+                "plural": PLURAL,
+                "singular": "dynamographdeployment",
+                "shortNames": ["dgd"],
+            },
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {"type": "object",
+                                 "x-kubernetes-preserve-unknown-fields": True},
+                        "status": {"type": "object",
+                                   "x-kubernetes-preserve-unknown-fields": True},
+                    },
+                }},
+            }],
+        },
+    }
+
+
+def _component_args(dgd: Dict[str, Any], comp: Dict[str, Any]) -> SimpleNamespace:
+    """Map a DGD spec + one component onto the deploy.py template args."""
+    spec = dgd.get("spec") or {}
+    return SimpleNamespace(
+        graph=dgd["metadata"]["name"],
+        namespace=dgd["metadata"].get("namespace", "default"),
+        image=comp.get("image") or spec.get("image", "dynamo-tpu:latest"),
+        model=comp.get("model") or spec.get("model", "llama-3.2-3b"),
+        checkpoint=comp.get("checkpoint") or spec.get("checkpoint"),
+        workers=int(comp.get("replicas", 1)),
+        frontend_replicas=int(comp.get("replicas", 1)),
+        tensor_parallel=int(comp.get("tensorParallel", spec.get("tensorParallel", 1))),
+        tpu_type=comp.get("tpuType") or spec.get("tpuType", "tpu-v5-lite-podslice"),
+        tpu_topology=comp.get("tpuTopology") or spec.get("tpuTopology", "1x1"),
+        router_mode=spec.get("routerMode", "kv"),
+        quantize=comp.get("quantize") or spec.get("quantize"),
+        etcd=spec.get("etcd", "http://etcd:2379"),
+        otlp=spec.get("otlp"),
+        drain_seconds=int(spec.get("drainSeconds", 120)),
+    )
+
+
+def render_children(dgd: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Desired child objects for a DGD (Deployments + frontend Services)."""
+    out: List[Dict[str, Any]] = []
+    for comp in (dgd.get("spec") or {}).get("components") or []:
+        name = comp.get("name") or comp.get("type", "worker")
+        ctype = comp.get("type", "worker")
+        args = _component_args(dgd, comp)
+        if ctype == "frontend":
+            objs = frontend_objects(args)
+        elif ctype in ("worker", "prefill", "decode"):
+            role = None if ctype == "worker" else ctype
+            objs = [worker_deployment(args, name, args.workers, role)]
+        else:  # planner/epp-style components: not templated yet, skip
+            log.warning("component %s has untemplated type %s; skipping", name, ctype)
+            continue
+        for o in objs:
+            # child names follow the component *name* (unique per spec), and
+            # children carry the operator's managed-by for GC discovery
+            o["metadata"]["name"] = f"{args.graph}-{name}"
+            labels = o["metadata"].setdefault("labels", {})
+            labels["app.kubernetes.io/managed-by"] = MANAGED_BY
+            labels["app.kubernetes.io/part-of"] = args.graph
+            labels["dynamo.tpu/component"] = name
+            if o["kind"] == "Deployment":
+                o["spec"]["replicas"] = int(comp.get("replicas", 1))
+                # the rendered pod template's hash rides along as an
+                # annotation; update detection compares annotations instead
+                # of raw templates, which the apiserver mutates with
+                # server-side defaults (restartPolicy, dnsPolicy, ...)
+                o["metadata"].setdefault("annotations", {})[
+                    TEMPLATE_HASH_ANNOTATION
+                ] = _pod_template_fingerprint(o)
+            out.append(o)
+    return out
+
+
+TEMPLATE_HASH_ANNOTATION = "dynamo.tpu/template-hash"
+
+
+def _pod_template_fingerprint(dep: Dict[str, Any]) -> str:
+    """Stable digest of the parts whose change requires a rolling update
+    (pod template), as opposed to a bare scale."""
+    tpl = ((dep.get("spec") or {}).get("template")) or {}
+    return hashlib.blake2b(
+        json.dumps(tpl, sort_keys=True).encode(), digest_size=8
+    ).hexdigest()
+
+
+def _live_fingerprint(dep: Dict[str, Any]) -> str:
+    return (dep.get("metadata", {}).get("annotations") or {}).get(
+        TEMPLATE_HASH_ANNOTATION, "")
+
+
+class Reconciler:
+    """One reconcile pass = drive children of every DGD to the spec.
+
+    Level-triggered (reference controller-runtime semantics): each pass
+    recomputes desired state from scratch and diffs against the cluster,
+    so missed events only delay convergence, never lose it.
+    """
+
+    def __init__(
+        self,
+        namespace: str = "default",
+        api_base: Optional[str] = None,
+        token: Optional[str] = None,
+        poll_interval: float = 2.0,
+    ):
+        self._client = KubeApiClient(api_base=api_base, token=token)
+        self.api_base = self._client.api_base
+        self.namespace = namespace
+        self.poll_interval = poll_interval
+
+    # -- REST helpers -------------------------------------------------------
+
+    async def _http(self):
+        return await self._client.http()
+
+    def _dgd_url(self, name: str = "", sub: str = "") -> str:
+        base = (f"{self.api_base}/apis/{GROUP}/{VERSION}/namespaces/"
+                f"{self.namespace}/{PLURAL}")
+        url = f"{base}/{name}" if name else base
+        return f"{url}/{sub}" if sub else url
+
+    def _obj_url(self, kind: str, name: str = "", sub: str = "") -> str:
+        if kind == "Deployment":
+            base = (f"{self.api_base}/apis/apps/v1/namespaces/"
+                    f"{self.namespace}/deployments")
+        elif kind == "Service":
+            base = f"{self.api_base}/api/v1/namespaces/{self.namespace}/services"
+        else:
+            raise ValueError(kind)
+        url = f"{base}/{name}" if name else base
+        return f"{url}/{sub}" if sub else url
+
+    async def _get_json(self, url: str, params=None) -> Optional[Dict[str, Any]]:
+        s = await self._http()
+        async with s.get(url, params=params) as r:
+            if r.status == 404:
+                return None
+            r.raise_for_status()
+            return await r.json()
+
+    # -- reconcile ----------------------------------------------------------
+
+    async def list_dgds(self) -> List[Dict[str, Any]]:
+        body = await self._get_json(self._dgd_url())
+        return (body or {}).get("items", [])
+
+    async def _list_children(self, kind: str) -> Dict[str, Dict[str, Any]]:
+        body = await self._get_json(
+            self._obj_url(kind),
+            params={"labelSelector":
+                    f"app.kubernetes.io/managed-by={MANAGED_BY}"},
+        )
+        return {o["metadata"]["name"]: o for o in (body or {}).get("items", [])}
+
+    async def reconcile_all(self) -> None:
+        dgds = await self.list_dgds()
+        live_deps = await self._list_children("Deployment")
+        live_svcs = await self._list_children("Service")
+        desired_names = {"Deployment": set(), "Service": set()}
+        for dgd in dgds:
+            try:
+                await self._reconcile_one(dgd, live_deps, live_svcs, desired_names)
+            except Exception:
+                log.exception("reconcile failed for %s", dgd["metadata"]["name"])
+                # a failed pass may not have registered all of this graph's
+                # children as desired — protect every live child of the graph
+                # from the GC sweep rather than delete healthy workloads on
+                # a transient error or bad spec edit
+                graph = dgd["metadata"]["name"]
+                for kind, live in (("Deployment", live_deps),
+                                   ("Service", live_svcs)):
+                    for name, obj in live.items():
+                        part_of = (obj["metadata"].get("labels") or {}).get(
+                            "app.kubernetes.io/part-of")
+                        if part_of == graph:
+                            desired_names[kind].add(name)
+        # GC: operator-managed children not desired by any DGD (component
+        # removed from a spec, or the DGD itself deleted)
+        s = await self._http()
+        for kind, live in (("Deployment", live_deps), ("Service", live_svcs)):
+            for name in set(live) - desired_names[kind]:
+                log.info("deleting orphaned %s %s", kind, name)
+                async with s.delete(self._obj_url(kind, name)) as r:
+                    if r.status not in (200, 404):
+                        r.raise_for_status()
+
+    async def _reconcile_one(
+        self,
+        dgd: Dict[str, Any],
+        live_deps: Dict[str, Dict[str, Any]],
+        live_svcs: Dict[str, Dict[str, Any]],
+        desired_names: Dict[str, set],
+    ) -> None:
+        s = await self._http()
+        children = render_children(dgd)
+        comp_status: Dict[str, Dict[str, Any]] = {}
+        updating = False
+        for desired in children:
+            kind = desired["kind"]
+            name = desired["metadata"]["name"]
+            desired_names[kind].add(name)
+            live = (live_deps if kind == "Deployment" else live_svcs).get(name)
+            if live is None:
+                log.info("creating %s %s", kind, name)
+                async with s.post(self._obj_url(kind), json=desired) as r:
+                    if r.status == 409:  # raced another pass: treat as update
+                        async with s.put(self._obj_url(kind, name), json=desired) as r2:
+                            r2.raise_for_status()
+                    else:
+                        r.raise_for_status()
+                live = desired
+            elif kind == "Deployment":
+                want_repl = int(desired["spec"]["replicas"])
+                have_repl = int((live.get("spec") or {}).get("replicas", 0))
+                # compare rendered hash vs the annotation stamped at the
+                # last write: comparing raw templates would see the
+                # apiserver's server-side defaulting as a perpetual diff
+                if (_pod_template_fingerprint(desired)
+                        != _live_fingerprint(live)):
+                    # rolling update: replace the spec, let the Deployment
+                    # controller roll pods (reference RollingUpdateStatus path)
+                    log.info("updating %s (pod template changed)", name)
+                    async with s.put(self._obj_url(kind, name), json=desired) as r:
+                        r.raise_for_status()
+                    updating = True
+                elif want_repl != have_repl:
+                    log.info("scaling %s %d -> %d", name, have_repl, want_repl)
+                    async with s.patch(
+                        self._obj_url(kind, name, "scale"),
+                        json={"spec": {"replicas": want_repl}},
+                    ) as r:
+                        r.raise_for_status()
+            if kind == "Deployment":
+                comp = live.get("metadata", {}).get("labels", {}).get(
+                    "dynamo.tpu/component", name)
+                st = live.get("status") or {}
+                comp_status[comp] = {
+                    "replicas": int(desired["spec"]["replicas"]),
+                    "readyReplicas": int(st.get("readyReplicas", 0)),
+                    "updatedReplicas": int(st.get("updatedReplicas", 0)),
+                }
+                # a child that has never reported status is newly created
+                # (pending), not mid-rollout — only deployments with a
+                # status can be "behind" on updated replicas
+                comp_status[comp]["_rolling"] = bool(st)
+        await self._update_status(dgd, comp_status, updating)
+
+    async def _update_status(
+        self, dgd: Dict[str, Any], comps: Dict[str, Dict[str, Any]],
+        updating: bool,
+    ) -> None:
+        all_ready = comps and all(
+            c["readyReplicas"] >= c["replicas"] for c in comps.values()
+        )
+        behind = any(
+            c["_rolling"] and c["updatedReplicas"] < c["replicas"]
+            for c in comps.values()
+        )
+        for c in comps.values():
+            c.pop("_rolling", None)
+        # an update issued THIS pass wins over the (stale) pre-update child
+        # statuses that may still read fully ready
+        if updating or behind:
+            reason, ready, state = READY_UPDATING, "False", "updating"
+        elif all_ready:
+            reason, ready, state = READY_ALL, "True", "successful"
+        elif comps:
+            reason, ready, state = READY_PODS_NOT_READY, "False", "pending"
+        else:
+            reason, ready, state = READY_SOME_NOT_READY, "False", "initializing"
+        prev = dgd.get("status") or {}
+        prev_cond = next((c for c in prev.get("conditions") or []
+                          if c.get("type") == "Ready"), {})
+        if prev_cond.get("status") == ready and prev_cond.get("reason") == reason:
+            # condition unchanged: keep its original transition time
+            transition = prev_cond.get("lastTransitionTime")
+        else:
+            transition = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        status = {
+            "observedGeneration": dgd["metadata"].get("generation", 0),
+            "state": state,
+            "components": comps,
+            "conditions": [{
+                "type": "Ready",
+                "status": ready,
+                "reason": reason,
+                "lastTransitionTime": transition,
+            }],
+        }
+        if status == prev:
+            return  # converged: don't spam the apiserver every poll
+        s = await self._http()
+        async with s.patch(
+            self._dgd_url(dgd["metadata"]["name"], "status"),
+            json={"status": status},
+            headers={"Content-Type": "application/merge-patch+json"},
+        ) as r:
+            if r.status == 404:
+                return  # DGD deleted mid-pass; GC handles the children
+            r.raise_for_status()
+
+    # -- control loop -------------------------------------------------------
+
+    async def run(self) -> None:
+        """Poll-and-reconcile forever (level-triggered resync each pass)."""
+        while True:
+            try:
+                await self.reconcile_all()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("reconcile pass failed; retrying")
+            await asyncio.sleep(self.poll_interval)
+
+    async def close(self) -> None:
+        await self._client.close()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.operator")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--api-base", default=os.environ.get("DYN_K8S_API"),
+                   help="apiserver base URL (default: in-cluster)")
+    p.add_argument("--poll-interval", type=float, default=2.0)
+    p.add_argument("--print-crd", action="store_true",
+                   help="print the DGD CRD manifest and exit")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    from dynamo_tpu.runtime.logging_util import configure_logging
+
+    args = parse_args(argv)
+    if args.print_crd:
+        import sys
+
+        import yaml
+
+        sys.stdout.write(yaml.safe_dump(crd_manifest(), sort_keys=False))
+        return
+    configure_logging()
+    rec = Reconciler(namespace=args.namespace, api_base=args.api_base,
+                     poll_interval=args.poll_interval)
+
+    async def _run():
+        try:
+            await rec.run()
+        finally:
+            await rec.close()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -120,47 +120,26 @@ class KubernetesConnector(Connector):
         api_base: Optional[str] = None,
         token: Optional[str] = None,
         ca_verify: bool = True,
+        dgd: Optional[str] = None,
     ):
-        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
-        if api_base is None:
-            host = os.environ.get("KUBERNETES_SERVICE_HOST")
-            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-            if not host:
-                raise RuntimeError(
-                    "not in a cluster (KUBERNETES_SERVICE_HOST unset) and no "
-                    "api_base given; use virtual or local-process connectors"
-                )
-            api_base = f"https://{host}:{port}"
-        if token is None and os.path.exists(f"{sa}/token"):
-            token = Path(f"{sa}/token").read_text().strip()
-        self.api_base = api_base.rstrip("/")
-        self.namespace = namespace
-        self.token = token
-        # in-cluster apiserver certs are signed by the cluster CA, not the
-        # system trust store — verify against the mounted bundle
-        self._ssl = True if ca_verify else False
-        if ca_verify and os.path.exists(f"{sa}/ca.crt"):
-            import ssl as _ssl
+        # dgd: name of a DynamoGraphDeployment to scale *through* — the
+        # planner edits spec.components[name].replicas and the operator
+        # reconciles the child Deployment (the reference's planner→CRD→
+        # operator flow). Without it, child Deployments are scaled directly.
+        from dynamo_tpu.runtime.kube_client import KubeApiClient
 
-            self._ssl = _ssl.create_default_context(cafile=f"{sa}/ca.crt")
+        self._client = KubeApiClient(api_base=api_base, token=token,
+                                     ca_verify=ca_verify)
+        self.api_base = self._client.api_base
+        self.namespace = namespace
         self._names = deployment_for_component or {}
-        self._session = None
+        self.dgd = dgd
 
     def _deployment(self, component: str) -> str:
         return self._names.get(component, f"dynamo-tpu-{component}")
 
     async def _http(self):
-        if self._session is None:
-            import aiohttp
-
-            headers = {}
-            if self.token:
-                headers["Authorization"] = f"Bearer {self.token}"
-            self._session = aiohttp.ClientSession(
-                headers=headers,
-                connector=aiohttp.TCPConnector(ssl=self._ssl),
-            )
-        return self._session
+        return await self._client.http()
 
     def _scale_url(self, component: str) -> str:
         return (
@@ -168,7 +147,55 @@ class KubernetesConnector(Connector):
             f"/deployments/{self._deployment(component)}/scale"
         )
 
+    def _dgd_url(self) -> str:
+        from dynamo_tpu.operator import GROUP, PLURAL, VERSION
+
+        return (f"{self.api_base}/apis/{GROUP}/{VERSION}/namespaces/"
+                f"{self.namespace}/{PLURAL}/{self.dgd}")
+
+    async def _dgd_components(self) -> Optional[list]:
+        s = await self._http()
+        async with s.get(self._dgd_url()) as resp:
+            if resp.status == 404:
+                return None
+            resp.raise_for_status()
+            body = await resp.json()
+        return ((body.get("spec") or {}).get("components")) or []
+
     async def scale_to(self, component: str, target_replicas: int) -> None:
+        if self.dgd is not None:
+            comps = await self._dgd_components()
+            if comps is None:
+                raise RuntimeError(f"DGD {self.dgd!r} not found")
+            for i, c in enumerate(comps):
+                if (c.get("name") or c.get("type")) == component:
+                    idx = i
+                    guard_key = "name" if "name" in c else "type"
+                    guard_val = c[guard_key]
+                    break
+            else:
+                raise KeyError(f"component {component!r} not in DGD {self.dgd}")
+            # JSON Patch with a guarding test op: only the one component's
+            # replicas field is written, and the write aborts (409/422) if a
+            # concurrent editor moved/renamed the entry — a whole-list
+            # merge-patch would silently revert concurrent spec edits
+            ops = [
+                {"op": "test",
+                 "path": f"/spec/components/{idx}/{guard_key}",
+                 "value": guard_val},
+                {"op": "replace",
+                 "path": f"/spec/components/{idx}/replicas",
+                 "value": int(target_replicas)},
+            ]
+            s = await self._http()
+            async with s.patch(
+                self._dgd_url(), json=ops,
+                headers={"Content-Type": "application/json-patch+json"},
+            ) as resp:
+                resp.raise_for_status()
+            log.info("k8s: DGD %s component %s -> %d replicas",
+                     self.dgd, component, target_replicas)
+            return
         s = await self._http()
         async with s.patch(
             self._scale_url(component),
@@ -179,6 +206,14 @@ class KubernetesConnector(Connector):
         log.info("k8s: scaled %s -> %d", self._deployment(component), target_replicas)
 
     async def current_replicas(self, component: str) -> Optional[int]:
+        if self.dgd is not None:
+            comps = await self._dgd_components()
+            if comps is None:
+                return None
+            for c in comps:
+                if (c.get("name") or c.get("type")) == component:
+                    return int(c.get("replicas", 1))
+            return None
         s = await self._http()
         async with s.get(self._scale_url(component)) as resp:
             if resp.status == 404:
@@ -188,6 +223,4 @@ class KubernetesConnector(Connector):
         return int((body.get("spec") or {}).get("replicas", 0))
 
     async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        await self._client.close()

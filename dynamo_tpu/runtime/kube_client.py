@@ -1,0 +1,64 @@
+"""Shared Kubernetes REST bootstrap.
+
+One implementation of the in-cluster client conventions used by every
+control-plane piece (operator, planner KubernetesConnector, KubeDiscovery):
+service-account token + CA bundle, api-base resolution from the in-cluster
+env, and a lazily created aiohttp session with bearer auth. The reference
+operator gets this from client-go; here it is the plain REST equivalent.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeApiClient:
+    def __init__(
+        self,
+        api_base: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_verify: bool = True,
+    ):
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in a cluster (KUBERNETES_SERVICE_HOST unset) and no "
+                    "api_base given"
+                )
+            api_base = f"https://{host}:{port}"
+        if token is None and os.path.exists(f"{SA_DIR}/token"):
+            token = Path(f"{SA_DIR}/token").read_text().strip()
+        self.api_base = api_base.rstrip("/")
+        self.token = token
+        # in-cluster apiserver certs are signed by the cluster CA, not the
+        # system trust store — verify against the mounted bundle
+        self._ssl = True if ca_verify else False
+        if ca_verify and os.path.exists(f"{SA_DIR}/ca.crt"):
+            import ssl as _ssl
+
+            self._ssl = _ssl.create_default_context(cafile=f"{SA_DIR}/ca.crt")
+        self._session = None
+
+    async def http(self):
+        if self._session is None:
+            import aiohttp
+
+            headers = {}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            self._session = aiohttp.ClientSession(
+                headers=headers,
+                connector=aiohttp.TCPConnector(ssl=self._ssl),
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

@@ -42,43 +42,18 @@ class KubeDiscovery(DiscoveryBackend):
         #   leader election) — keep ttl >> worst-case NTP skew
         poll_interval: float = 1.0,
     ):
-        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
-        if api_base is None:
-            host = os.environ.get("KUBERNETES_SERVICE_HOST")
-            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-            if not host:
-                raise RuntimeError(
-                    "not in a cluster and no api_base given; use etcd/file/mem"
-                )
-            api_base = f"https://{host}:{port}"
-        if token is None and os.path.exists(f"{sa}/token"):
-            token = Path(f"{sa}/token").read_text().strip()
-        self.api_base = api_base.rstrip("/")
+        from dynamo_tpu.runtime.kube_client import KubeApiClient
+
+        self._client = KubeApiClient(api_base=api_base, token=token)
+        self.api_base = self._client.api_base
         self.namespace = namespace
-        self.token = token
         self.lease_ttl = lease_ttl
         self.poll_interval = poll_interval
-        self._ssl = True
-        if os.path.exists(f"{sa}/ca.crt"):
-            import ssl as _ssl
-
-            self._ssl = _ssl.create_default_context(cafile=f"{sa}/ca.crt")
-        self._session = None
         self._mine: Dict[str, Instance] = {}
 
     # -- REST helpers -------------------------------------------------------
     async def _http(self):
-        if self._session is None:
-            import aiohttp
-
-            headers = {}
-            if self.token:
-                headers["Authorization"] = f"Bearer {self.token}"
-            self._session = aiohttp.ClientSession(
-                headers=headers,
-                connector=aiohttp.TCPConnector(ssl=self._ssl),
-            )
-        return self._session
+        return await self._client.http()
 
     def _cm_url(self, name: str = "") -> str:
         base = f"{self.api_base}/api/v1/namespaces/{self.namespace}/configmaps"
@@ -178,6 +153,4 @@ class KubeDiscovery(DiscoveryBackend):
             yield ev
 
     async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        await self._client.close()

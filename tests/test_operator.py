@@ -1,0 +1,419 @@
+"""Operator reconcile loop against a fake apiserver: DGD create →
+children, scale via DGD patch (planner flow), rolling update on pod
+template change, orphan GC, and status conditions. Mirrors the reference
+operator controller role (deploy/operator, dynamographdeployment_types.go)."""
+
+import asyncio
+import copy
+import json
+
+import pytest
+from aiohttp import web
+
+from dynamo_tpu.operator import (
+    GROUP,
+    PLURAL,
+    READY_ALL,
+    READY_PODS_NOT_READY,
+    READY_UPDATING,
+    VERSION,
+    Reconciler,
+    crd_manifest,
+    render_children,
+)
+from dynamo_tpu.planner.connector import KubernetesConnector
+
+
+class FakeClusterApi:
+    """Subset of the k8s API the operator touches: DGD CRs (+/status),
+    apps/v1 Deployments (+/scale), core/v1 Services, labelSelector list."""
+
+    def __init__(self):
+        self.dgds = {}
+        self.deployments = {}
+        self.services = {}
+
+    async def start(self) -> str:
+        app = web.Application()
+        r = app.router
+        dgd = f"/apis/{GROUP}/{VERSION}/namespaces/{{ns}}/{PLURAL}"
+        r.add_get(dgd, self._dgd_list)
+        r.add_get(dgd + "/{name}", self._dgd_get)
+        r.add_patch(dgd + "/{name}", self._dgd_patch)
+        r.add_patch(dgd + "/{name}/status", self._dgd_status)
+        r.add_get("/apis/apps/v1/namespaces/{ns}/deployments", self._dep_list)
+        r.add_post("/apis/apps/v1/namespaces/{ns}/deployments", self._dep_post)
+        r.add_put("/apis/apps/v1/namespaces/{ns}/deployments/{name}", self._dep_put)
+        r.add_delete("/apis/apps/v1/namespaces/{ns}/deployments/{name}", self._dep_delete)
+        r.add_patch("/apis/apps/v1/namespaces/{ns}/deployments/{name}/scale", self._dep_scale)
+        r.add_get("/api/v1/namespaces/{ns}/services", self._svc_list)
+        r.add_post("/api/v1/namespaces/{ns}/services", self._svc_post)
+        r.add_put("/api/v1/namespaces/{ns}/services/{name}", self._svc_put)
+        r.add_delete("/api/v1/namespaces/{ns}/services/{name}", self._svc_delete)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        return f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+    # -- helpers -------------------------------------------------------------
+
+    def put_dgd(self, obj):
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("generation", 1)
+        self.dgds[meta["name"]] = obj
+
+    def mark_ready(self, name, updated=None):
+        dep = self.deployments[name]
+        n = int(dep["spec"]["replicas"])
+        dep["status"] = {"readyReplicas": n,
+                         "updatedReplicas": updated if updated is not None else n}
+
+    @staticmethod
+    def _match(obj, sel):
+        if not sel:
+            return True
+        k, _, v = sel.partition("=")
+        return (obj["metadata"].get("labels") or {}).get(k) == v
+
+    # -- DGD -----------------------------------------------------------------
+
+    async def _dgd_list(self, req):
+        return web.json_response({"items": list(self.dgds.values())})
+
+    async def _dgd_get(self, req):
+        o = self.dgds.get(req.match_info["name"])
+        return web.json_response(o or {}, status=200 if o else 404)
+
+    async def _dgd_patch(self, req):
+        name = req.match_info["name"]
+        if name not in self.dgds:
+            return web.json_response({}, status=404)
+        patch = await req.json()
+        dgd = self.dgds[name]
+        if req.content_type == "application/json-patch+json":
+            # minimal RFC 6902: test + replace on pointer paths
+            for op in patch:
+                parts = op["path"].lstrip("/").split("/")
+                tgt = dgd
+                for part in parts[:-1]:
+                    tgt = tgt[int(part)] if isinstance(tgt, list) else tgt[part]
+                leaf = int(parts[-1]) if isinstance(tgt, list) else parts[-1]
+                if op["op"] == "test":
+                    try:
+                        ok = tgt[leaf] == op["value"]
+                    except (KeyError, IndexError):
+                        ok = False
+                    if not ok:
+                        return web.json_response(
+                            {"reason": "test failed"}, status=409)
+                elif op["op"] == "replace":
+                    tgt[leaf] = op["value"]
+            dgd["metadata"]["generation"] = dgd["metadata"].get("generation", 1) + 1
+        elif "spec" in patch:
+            dgd.setdefault("spec", {}).update(patch["spec"])
+            dgd["metadata"]["generation"] = dgd["metadata"].get("generation", 1) + 1
+        return web.json_response(dgd)
+
+    async def _dgd_status(self, req):
+        name = req.match_info["name"]
+        if name not in self.dgds:
+            return web.json_response({}, status=404)
+        self.dgds[name]["status"] = (await req.json())["status"]
+        return web.json_response(self.dgds[name])
+
+    # -- Deployments ---------------------------------------------------------
+
+    async def _dep_list(self, req):
+        sel = req.query.get("labelSelector", "")
+        return web.json_response(
+            {"items": [d for d in self.deployments.values() if self._match(d, sel)]}
+        )
+
+    async def _dep_post(self, req):
+        body = await req.json()
+        name = body["metadata"]["name"]
+        if name in self.deployments:
+            return web.json_response({}, status=409)
+        self.deployments[name] = body
+        return web.json_response(body, status=201)
+
+    async def _dep_put(self, req):
+        name = req.match_info["name"]
+        if name not in self.deployments:
+            return web.json_response({}, status=404)
+        old_status = self.deployments[name].get("status")
+        body = await req.json()
+        if old_status is not None:
+            # a spec replacement resets updatedReplicas (rollout in progress)
+            body["status"] = dict(old_status, updatedReplicas=0)
+        self.deployments[name] = body
+        return web.json_response(body)
+
+    async def _dep_delete(self, req):
+        self.deployments.pop(req.match_info["name"], None)
+        return web.json_response({})
+
+    async def _dep_scale(self, req):
+        name = req.match_info["name"]
+        if name not in self.deployments:
+            return web.json_response({}, status=404)
+        body = await req.json()
+        self.deployments[name]["spec"]["replicas"] = body["spec"]["replicas"]
+        return web.json_response(body)
+
+    # -- Services ------------------------------------------------------------
+
+    async def _svc_list(self, req):
+        sel = req.query.get("labelSelector", "")
+        return web.json_response(
+            {"items": [s for s in self.services.values() if self._match(s, sel)]}
+        )
+
+    async def _svc_post(self, req):
+        body = await req.json()
+        name = body["metadata"]["name"]
+        if name in self.services:
+            return web.json_response({}, status=409)
+        self.services[name] = body
+        return web.json_response(body, status=201)
+
+    async def _svc_put(self, req):
+        self.services[req.match_info["name"]] = await req.json()
+        return web.json_response({})
+
+    async def _svc_delete(self, req):
+        self.services.pop(req.match_info["name"], None)
+        return web.json_response({})
+
+
+def _dgd(components=None, **spec):
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {"name": "g1", "namespace": "prod"},
+        "spec": dict(
+            {"model": "llama-3.2-3b", "image": "dynamo-tpu:v1",
+             "components": components or [
+                 {"name": "frontend", "type": "frontend", "replicas": 1},
+                 {"name": "decode", "type": "decode", "replicas": 2,
+                  "tensorParallel": 4},
+             ]},
+            **spec,
+        ),
+    }
+
+
+def test_crd_manifest_shape():
+    crd = crd_manifest()
+    assert crd["metadata"]["name"] == f"{PLURAL}.{GROUP}"
+    v = crd["spec"]["versions"][0]
+    assert v["subresources"] == {"status": {}}
+
+
+def test_render_children_maps_components():
+    objs = render_children(_dgd())
+    names = [(o["kind"], o["metadata"]["name"]) for o in objs]
+    assert ("Deployment", "g1-frontend") in names
+    assert ("Service", "g1-frontend") in names
+    assert ("Deployment", "g1-decode") in names
+    dec = next(o for o in objs if o["metadata"]["name"] == "g1-decode"
+               and o["kind"] == "Deployment")
+    assert dec["spec"]["replicas"] == 2
+    cmd = dec["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--disagg-role" in cmd and "decode" in cmd
+    limits = dec["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == "4"
+
+
+async def _with_cluster(fn):
+    api = FakeClusterApi()
+    base = await api.start()
+    rec = Reconciler(namespace="prod", api_base=base, token="t")
+    try:
+        await fn(api, rec, base)
+    finally:
+        await rec.close()
+        await api.stop()
+
+
+async def test_reconcile_creates_children_and_reports_status():
+    async def body(api, rec, base):
+        api.put_dgd(_dgd())
+        await rec.reconcile_all()
+        assert set(api.deployments) == {"g1-frontend", "g1-decode"}
+        assert set(api.services) == {"g1-frontend"}
+        assert api.deployments["g1-decode"]["spec"]["replicas"] == 2
+        st = api.dgds["g1"]["status"]
+        assert st["state"] == "pending"
+        assert st["conditions"][0]["reason"] == READY_PODS_NOT_READY
+        assert st["components"]["decode"]["replicas"] == 2
+
+        # pods come up -> Ready
+        api.mark_ready("g1-frontend")
+        api.mark_ready("g1-decode")
+        await rec.reconcile_all()
+        st = api.dgds["g1"]["status"]
+        assert st["state"] == "successful"
+        assert st["conditions"][0]["reason"] == READY_ALL
+        assert st["components"]["decode"]["readyReplicas"] == 2
+        assert st["observedGeneration"] == 1
+
+    await _with_cluster(body)
+
+
+async def test_planner_scales_through_dgd():
+    async def body(api, rec, base):
+        api.put_dgd(_dgd())
+        await rec.reconcile_all()
+        conn = KubernetesConnector(namespace="prod", api_base=base,
+                                   token="t", dgd="g1")
+        try:
+            assert await conn.current_replicas("decode") == 2
+            await conn.scale_to("decode", 5)
+            assert await conn.current_replicas("decode") == 5
+            with pytest.raises(KeyError):
+                await conn.scale_to("nope", 1)
+        finally:
+            await conn.close()
+        # operator propagates the DGD change to the child Deployment
+        await rec.reconcile_all()
+        assert api.deployments["g1-decode"]["spec"]["replicas"] == 5
+        assert api.dgds["g1"]["metadata"]["generation"] == 2
+        assert api.dgds["g1"]["status"]["observedGeneration"] == 2
+
+    await _with_cluster(body)
+
+
+async def test_rolling_update_on_pod_template_change():
+    async def body(api, rec, base):
+        api.put_dgd(_dgd())
+        await rec.reconcile_all()
+        api.mark_ready("g1-frontend")
+        api.mark_ready("g1-decode")
+        await rec.reconcile_all()
+        assert api.dgds["g1"]["status"]["state"] == "successful"
+
+        # image bump -> PUT deployment, status 'updating' until rollout done
+        dgd = api.dgds["g1"]
+        dgd["spec"]["image"] = "dynamo-tpu:v2"
+        dgd["metadata"]["generation"] += 1
+        await rec.reconcile_all()
+        img = api.deployments["g1-decode"]["spec"]["template"]["spec"][
+            "containers"][0]["image"]
+        assert img == "dynamo-tpu:v2"
+        st = api.dgds["g1"]["status"]
+        assert st["state"] == "updating"
+        assert st["conditions"][0]["reason"] == READY_UPDATING
+
+        # rollout completes
+        api.mark_ready("g1-frontend")
+        api.mark_ready("g1-decode")
+        await rec.reconcile_all()
+        assert api.dgds["g1"]["status"]["state"] == "successful"
+
+    await _with_cluster(body)
+
+
+async def test_gc_component_removed_and_dgd_deleted():
+    async def body(api, rec, base):
+        api.put_dgd(_dgd())
+        await rec.reconcile_all()
+        assert "g1-decode" in api.deployments
+
+        # component removed from the spec -> its Deployment is GC'd
+        dgd = api.dgds["g1"]
+        dgd["spec"]["components"] = [c for c in dgd["spec"]["components"]
+                                     if c["name"] != "decode"]
+        dgd["metadata"]["generation"] += 1
+        await rec.reconcile_all()
+        assert "g1-decode" not in api.deployments
+        assert "g1-frontend" in api.deployments
+
+        # whole DGD deleted -> all children GC'd
+        del api.dgds["g1"]
+        await rec.reconcile_all()
+        assert not api.deployments and not api.services
+
+    await _with_cluster(body)
+
+
+async def test_unmanaged_objects_untouched():
+    async def body(api, rec, base):
+        # a user Deployment without operator labels must never be GC'd
+        api.deployments["user-app"] = {
+            "kind": "Deployment",
+            "metadata": {"name": "user-app", "labels": {}},
+            "spec": {"replicas": 1},
+        }
+        api.put_dgd(_dgd())
+        await rec.reconcile_all()
+        del api.dgds["g1"]
+        await rec.reconcile_all()
+        assert "user-app" in api.deployments
+
+    await _with_cluster(body)
+
+
+async def test_failed_reconcile_never_gcs_live_children():
+    async def body(api, rec, base):
+        api.put_dgd(_dgd())
+        await rec.reconcile_all()
+        assert "g1-decode" in api.deployments
+
+        # corrupt the spec so render_children raises mid-pass: the graph's
+        # live children must survive the GC sweep (transient error or bad
+        # edit must not take down serving workloads)
+        api.dgds["g1"]["spec"]["components"][1]["replicas"] = "not-a-number"
+        await rec.reconcile_all()
+        assert "g1-decode" in api.deployments
+        assert "g1-frontend" in api.deployments
+        assert "g1-frontend" in api.services
+
+        # spec repaired -> reconcile resumes normally
+        api.dgds["g1"]["spec"]["components"][1]["replicas"] = 3
+        await rec.reconcile_all()
+        assert api.deployments["g1-decode"]["spec"]["replicas"] == 3
+
+    await _with_cluster(body)
+
+
+async def test_scale_guard_rejects_concurrent_shape_change():
+    async def body(api, rec, base):
+        api.put_dgd(_dgd())
+        await rec.reconcile_all()
+        conn = KubernetesConnector(namespace="prod", api_base=base,
+                                   token="t", dgd="g1")
+        try:
+            # between the planner's GET and PATCH, a user reshapes the list:
+            # the JSON-Patch test op must refuse the stale write
+            comps = await conn._dgd_components()
+            api.dgds["g1"]["spec"]["components"].insert(
+                0, {"name": "prefill", "type": "prefill", "replicas": 1})
+            import aiohttp
+
+            with pytest.raises(aiohttp.ClientResponseError):
+                # index 1 now holds 'frontend', not 'decode' -> 409
+                s = await conn._http()
+                async with s.patch(
+                    conn._dgd_url(),
+                    json=[{"op": "test", "path": "/spec/components/1/name",
+                           "value": "decode"},
+                          {"op": "replace",
+                           "path": "/spec/components/1/replicas", "value": 9}],
+                    headers={"Content-Type": "application/json-patch+json"},
+                ) as resp:
+                    resp.raise_for_status()
+            # scale_to re-reads and lands on the right entry
+            await conn.scale_to("decode", 9)
+            decode = next(c for c in api.dgds["g1"]["spec"]["components"]
+                          if c["name"] == "decode")
+            assert decode["replicas"] == 9
+        finally:
+            await conn.close()
+
+    await _with_cluster(body)
