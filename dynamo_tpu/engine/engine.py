@@ -72,6 +72,10 @@ class InferenceEngine:
         #   shared mount; S3 via kvbm.object_store.S3Backend)
     ):
         self.runner = runner
+        # cross-worker KVBM onboarding: worker_common injects an async
+        # callable(hint) -> payload that pulls blocks from a peer's
+        # kv_host_fetch endpoint (None = feature off)
+        self.remote_kv_fetch = None
         self.pool = PagePool(runner.num_pages, runner.page_size)
         self.host_pool = None
         self._host_events: List[KvEvent] = []
@@ -119,6 +123,8 @@ class InferenceEngine:
         self.fpm_history: List[ForwardPassMetrics] = []
         self._fpm_listeners: List[Any] = []
         self._kv_listeners: List[Any] = []
+        # sick peers for cross-worker pulls: instance -> retry-after time
+        self._remote_fetch_backoff: Dict[int, float] = {}
         # disaggregation state
         self._parked: Dict[str, tuple] = {}  # rid -> (Sequence, deadline)
         self._kv_pending: List[Sequence] = []  # disagg-decode awaiting space
@@ -197,6 +203,13 @@ class InferenceEngine:
                 }
                 self._streams.pop(rid, None)
                 return
+        remote = request.get("kv_remote_host")
+        if (remote and self.host_pool is not None
+                and self.remote_kv_fetch is not None):
+            # pull the peer's lower-tier blocks into the LOCAL host tier
+            # before admission; the inbox is FIFO, so the import lands
+            # before the scheduler sees the request
+            await self._pull_remote_host(remote)
         if seq.disagg == "decode" and seq.kv_import is not None:
             self._inbox.put(("add_kv", seq))
         else:
@@ -225,6 +238,34 @@ class InferenceEngine:
             self._streams.pop(rid, None)
             if not finished:
                 self._inbox.put(("abort", rid))
+
+    async def _pull_remote_host(self, hint: Dict[str, Any]) -> None:
+        """Best-effort remote-G2 pull (reference onboarding session
+        search→pull, lib/kvbm-engine/docs/architecture.md). Failures fall
+        back to recompute — never block admission on a sick peer."""
+        hashes = [int(h) for h in hint.get("hashes") or []]
+        parents = list(hint.get("parents") or [])
+        if not hashes or len(parents) != len(hashes):
+            return
+        peer = int(hint.get("instance") or 0)
+        now = time.monotonic()
+        if now < self._remote_fetch_backoff.get(peer, 0.0):
+            return  # peer recently failed: recompute instead of stalling
+        try:
+            # bounded timeout: a wedged peer must cost little — the
+            # fallback (recompute) is always available (covers the
+            # fetcher's up-to-2s discovery wait plus the transfer)
+            payload = await asyncio.wait_for(
+                self.remote_kv_fetch(hint), timeout=5.0
+            )
+        except Exception as e:
+            self._remote_fetch_backoff[peer] = now + 30.0
+            log.info("remote host-tier pull failed (%s); recomputing", e)
+            return
+        n = int((payload or {}).get("n") or 0)
+        if n <= 0:
+            return
+        self._inbox.put(("host_import", (hashes[:n], parents[:n], payload)))
 
     # -- step loop (dedicated thread) --------------------------------------
     def _loop(self) -> None:
@@ -271,6 +312,11 @@ class InferenceEngine:
                 self._export_parked_device(rid, fut, loop)
             elif op == "embed":
                 self._embed_pending.append(arg)
+            elif op == "host_export":
+                hashes, fut, loop = arg
+                self._host_export(hashes, fut, loop)
+            elif op == "host_import":
+                self._host_import(*arg)
         self._admit_kv_pending()
         self._expire_parked()
         self._run_embeds()
@@ -513,6 +559,14 @@ class InferenceEngine:
         loop.call_soon_threadsafe(out.put_nowait, item)
 
     # -- disagg export (called from the asyncio side) -----------------------
+    async def export_host_blocks(self, hashes: List[int]) -> Dict[str, Any]:
+        """Serve a peer's cross-worker onboarding pull (runs the lower-tier
+        read on the step thread — the pools are step-thread state)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("host_export", ([int(h) for h in hashes], fut, loop)))
+        return await fut
+
     async def export_parked_kv(
         self, request_id: str, discard: bool = False
     ) -> Optional[Dict[str, Any]]:
@@ -567,6 +621,53 @@ class InferenceEngine:
 
     def _on_host_evicted(self, hashes: List[int]) -> None:
         self._host_events.append(KvEvent("remove", hashes, tier="host"))
+
+    def _host_export(self, hashes: List[int], fut, loop) -> None:
+        """Serve a peer's cross-worker onboarding pull: the leading run of
+        `hashes` resident in this worker's lower tiers, as a KV payload
+        (reference kvbm-engine onboarding sessions: the remote-G2 read)."""
+        from dynamo_tpu.engine.model_runner import kv_arrays_to_payload
+
+        out: Dict[str, Any] = {"n": 0}
+        if self.host_pool is not None and hashes:
+            n = self.host_pool.match(hashes)
+            if n:
+                try:
+                    k, v = self.host_pool.get(hashes[:n])
+                except Exception:
+                    # eviction races raise KeyError; G3/G4 reads can raise
+                    # IO/network errors — a peer's pull must never kill the
+                    # step thread, so fail the export, not the loop
+                    log.warning("host export failed; replying empty",
+                                exc_info=True)
+                    n = 0
+                    k = v = None
+                if k is None and hasattr(self.runner, "export_pages_device"):
+                    # real engine with hash-only entries (data lost, e.g. a
+                    # shared G4 object deleted): advertising n>0 without
+                    # data would spread phantom residency cluster-wide
+                    n = 0
+                out["n"] = n
+                if n and k is not None:
+                    out.update(kv_arrays_to_payload(k, v))
+        loop.call_soon_threadsafe(_set_future, fut, out)
+
+    def _host_import(self, hashes: List[int], parents: List[Optional[int]],
+                     payload: Dict[str, Any]) -> None:
+        """Blocks pulled from a peer's lower tier land in the local G2 (the
+        admission path then onboards them like any host-tier hit). Emits
+        host store events so the router's lower-tier credits follow."""
+        from dynamo_tpu.engine.model_runner import kv_payload_to_arrays
+
+        if self.host_pool is None or not hashes:
+            return
+        arrays = kv_payload_to_arrays(payload)
+        k, v = arrays if arrays is not None else (None, None)
+        self.host_pool.put(hashes, parents, k, v)
+        self._host_events.append(
+            KvEvent("store", list(hashes), parents[0] if parents else None,
+                    tier="host")
+        )
 
     def _onboard_from_host(self, pages: List[int], hashes: List[int]) -> bool:
         """Host-tier blocks → device pages during admission. Returns False

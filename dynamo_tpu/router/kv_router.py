@@ -289,6 +289,7 @@ class KvRouter:
     def find_best_match(
         self, token_ids: List[int], adapter: Optional[str] = None,
         mm_seed: Optional[int] = None, pinned_instance: Optional[int] = None,
+        collect: Optional[Dict[str, Any]] = None,
     ) -> Tuple[Worker, int, List[int]]:
         """Returns (worker, overlap_blocks, block_hashes). `adapter` and
         `mm_seed` seed the hash chain exactly like the worker scheduler
@@ -305,6 +306,10 @@ class KvRouter:
         )
         overlaps = self.indexer.index.find_matches(hashes)
         host_overlaps = self.indexer.host_index.find_matches(hashes).scores
+        if collect is not None:
+            # callers (remote_host_hint) reuse these instead of a second
+            # radix walk on the per-request hot path
+            collect["host_overlaps"] = host_overlaps
         workers = self.workers()
         if pinned_instance is not None:
             workers = [w for w in workers if w[0] == pinned_instance]
@@ -320,6 +325,43 @@ class KvRouter:
             host_overlaps=host_overlaps,
         )
         return worker, overlap, hashes
+
+    def remote_host_hint(
+        self, hashes: List[int], selected: Worker, overlap: int,
+        seed: Optional[int],
+        host_overlaps: Optional[Dict[Worker, int]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Cross-worker KVBM onboarding hint (reference kvbm-engine
+        onboarding sessions, lib/kvbm-engine/docs/architecture.md): when a
+        peer's lower tier holds a longer prefix than the selected worker
+        has anywhere, tell the selected worker where to pull from. The
+        worker imports the blocks into its own G2 and admission proceeds
+        through the ordinary host-tier onboard."""
+        if not hashes:
+            return None
+        host = (host_overlaps if host_overlaps is not None
+                else self.indexer.host_index.find_matches(hashes).scores)
+        local_best = max(
+            [overlap] + [n for w, n in host.items() if w[0] == selected[0]]
+        )
+        peer, peer_n = None, local_best
+        for w, n in sorted(host.items()):
+            if w[0] != selected[0] and n > peer_n:
+                peer, peer_n = w, n
+        if peer is None:
+            return None
+        ns, comp = self.client.path.split("/")[:2]
+        # suffix-only: the selected worker already holds the first
+        # local_best blocks (device or its own G2) — re-shipping them
+        # would waste MB-scale transfer and eat the per-pull block cap
+        chain = hashes[local_best:peer_n]
+        anchor = hashes[local_best - 1] if local_best > 0 else seed
+        return {
+            "instance": peer[0],
+            "path": f"{ns}/{comp}/kv_host_fetch",
+            "hashes": chain,
+            "parents": [anchor] + chain[:-1],
+        }
 
     # -- lifecycle charging -------------------------------------------------
     def add_request(
@@ -381,10 +423,22 @@ class KvPushRouter:
             from dynamo_tpu.tokens.hashing import mm_content_seed
 
             mm_seed = mm_content_seed(mm["data"])
+        collect: Dict[str, Any] = {}
         worker, overlap, hashes = self.router.find_best_match(
             token_ids, adapter=request.get("adapter"), mm_seed=mm_seed,
             pinned_instance=context.metadata.get("target_instance"),
+            collect=collect,
         )
+        from dynamo_tpu.tokens.hashing import request_seed
+
+        hint = self.router.remote_host_hint(
+            hashes, worker, overlap,
+            request_seed(request.get("adapter"), mm_seed),
+            host_overlaps=collect.get("host_overlaps"),
+        )
+        if hint is not None:
+            request = dict(request)
+            request["kv_remote_host"] = hint
         rid = context.id
         self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap

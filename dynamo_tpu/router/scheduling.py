@@ -30,6 +30,10 @@ class KvRouterConfig:
     # hits — via lower-tier events from the KVBM — count partially)
     device_credit: float = 1.0
     host_credit: float = 0.6
+    # a prefix resident in a PEER's lower tier is still cheaper than
+    # recompute (cross-worker onboarding pulls it over the network), but
+    # costs more than local host DRAM
+    remote_credit: float = 0.3
     disk_credit: float = 0.3
     seed: Optional[int] = None
 
@@ -52,10 +56,14 @@ class WorkerSelector:
             raise RuntimeError("no workers available for KV routing")
         cfg = self.config
         costs: List[float] = []
+        cluster_host = max((host_overlaps or {}).values(), default=0)
         for w in workers:
             dev = overlaps.scores.get(w, 0)
             host = (host_overlaps or {}).get(w, 0)
             credit = cfg.device_credit * dev + cfg.host_credit * max(0, host - dev)
+            # cluster-wide lower-tier residency: blocks any peer holds can
+            # be onboarded cross-worker, so they discount every candidate
+            credit += cfg.remote_credit * max(0, cluster_host - max(dev, host))
             new_blocks = max(0.0, total_blocks - credit)
             prefill = new_blocks + sequences.prefill_blocks(w)
             decode = sequences.decode_blocks(w)

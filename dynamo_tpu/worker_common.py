@@ -9,6 +9,7 @@ serve_endpoint; SURVEY.md §3.2), collapsed into one helper both
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import logging
 from typing import Optional
@@ -22,18 +23,30 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime
 
 log = logging.getLogger("dynamo_tpu.worker")
 
+# cap per-pull payload (whole-KV msgpack messages; chunking is the P->D
+# hardening item) — 64 blocks of a 3B model ~ 50MB bf16
+MAX_HOST_FETCH_BLOCKS = 64
+
 
 class ServedWorker:
-    def __init__(self, runtime, engine, instance, publisher):
+    def __init__(self, runtime, engine, instance, publisher, close_hooks=None):
         self.runtime = runtime
         self.engine = engine
         self.instance = instance
         self.publisher = publisher
+        self._close_hooks = list(close_hooks or [])
 
     async def stop(self) -> None:
         self.engine.stop()
         if self.publisher is not None:
             await self.publisher.stop()
+        for hook in self._close_hooks:
+            try:
+                r = hook()
+                if hasattr(r, "__await__"):
+                    await r
+            except Exception:
+                log.exception("worker close hook failed")
 
 
 import weakref
@@ -161,6 +174,50 @@ async def serve_worker(
     await runtime.serve_endpoint(
         f"{namespace}/{component}/kv_fetch", kv_fetch, instance_id=instance_id
     )
+
+    # cross-worker KVBM onboarding (reference kvbm-engine onboarding
+    # sessions): peers pull lower-tier blocks from this worker, and this
+    # worker pulls from peers when the router's hint names one
+    async def kv_host_fetch(request, context):
+        hashes = [int(h) for h in (request or {}).get("hashes") or []]
+        return await engine.export_host_blocks(hashes[:MAX_HOST_FETCH_BLOCKS])
+
+    await runtime.serve_endpoint(
+        f"{namespace}/{component}/kv_host_fetch", kv_host_fetch,
+        instance_id=instance_id,
+    )
+
+    _fetch_clients: dict = {}
+
+    async def _remote_kv_fetch(hint):
+        path = hint["path"]
+        client = _fetch_clients.get(path)
+        if client is None:
+            client = runtime.client(path)
+            # cache before any await that can raise: a failed first pull
+            # must not leak a client (and its discovery-watch task) per
+            # request; direct() surfaces cannot_connect on its own
+            _fetch_clients[path] = client
+            await client.start()
+        # first pull after client creation races the discovery watch: give
+        # the target instance a moment to appear instead of failing into
+        # the engine's 30s peer backoff
+        deadline = asyncio.get_running_loop().time() + 2.0
+        while (int(hint["instance"]) not in client.instances
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.05)
+        req = {"hashes": [int(h) for h in hint["hashes"][:MAX_HOST_FETCH_BLOCKS]]}
+        async for item in client.direct(req, int(hint["instance"])):
+            return item
+        return None
+
+    engine.remote_kv_fetch = _remote_kv_fetch
+
+    async def _close_fetch_clients():
+        for c in _fetch_clients.values():
+            await c.close()
+
+    close_hooks = [_close_fetch_clients]
     handler = DisaggDecodeAdapter(engine, runtime)
 
     engine.start()
@@ -171,4 +228,4 @@ async def serve_worker(
         instance_id=instance_id,
     )
     log.info("worker %x serving %s (role=%s)", instance_id, card.name, disagg_role or "both")
-    return ServedWorker(runtime, engine, inst, publisher)
+    return ServedWorker(runtime, engine, inst, publisher, close_hooks=close_hooks)

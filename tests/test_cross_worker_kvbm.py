@@ -1,0 +1,195 @@
+"""Cross-worker KVBM onboarding (reference kvbm-engine onboarding
+sessions, lib/kvbm-engine/docs/architecture.md): worker B pulls prefix
+blocks out of worker A's host tier instead of recomputing them, and the
+router hints the pull + credits cluster-wide lower-tier residency."""
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.frontend.protocols import ModelCard
+from dynamo_tpu.router.kv_router import KvRouter
+from dynamo_tpu.router.protocols import RouterEvent
+from dynamo_tpu.router.radix_tree import BlockIndex
+from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector
+from dynamo_tpu.router.sequences import ActiveSequences
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.tokens.hashing import block_hashes
+from dynamo_tpu.worker_common import serve_worker
+
+PS = 4
+
+
+async def _serve_tiered(realm, component, seed=7):
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    runner = ModelRunner(
+        get_config("tiny"),
+        num_pages=16,  # tiny device pool -> quick eviction to host tier
+        page_size=PS,
+        max_pages_per_seq=8,
+        decode_buckets=(1, 2),
+        prefill_buckets=(8, 16, 32),
+        seed=seed,  # same seed on both workers = identical weights
+    )
+    engine = InferenceEngine(runner, max_batch=2, chunk_size=32, host_kv_blocks=64)
+    card = ModelCard(name="tiny", tokenizer="byte", context_length=64, kv_block_size=PS)
+    w = await serve_worker(rt, engine, card, component=component)
+    return rt, w, engine
+
+
+async def _generate_direct(rt, path, instance_id, prompt, req_extra=None, n=4):
+    client = rt.client(path)
+    await client.start()
+    await client.wait_ready(timeout=5)
+    req = {
+        "token_ids": prompt,
+        "sampling": {"temperature": 0.0},
+        "stop": {"max_tokens": n, "stop_ids": []},
+    }
+    req.update(req_extra or {})
+    toks = []
+    try:
+        async for item in client.direct(req, instance_id, Context()):
+            toks.extend(item.get("token_ids") or [])
+            if item.get("finish_reason"):
+                break
+    finally:
+        await client.close()
+    return toks
+
+
+async def test_worker_pulls_prefix_from_peer_host_tier():
+    realm = "xworker-kvbm"
+    rt_a, wa, eng_a = await _serve_tiered(realm, "wa")
+    rt_b, wb, eng_b = await _serve_tiered(realm, "wb")
+    cli = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    try:
+        prompt = list(range(30, 46))  # 16 tokens = 4 pages
+        out_a = await _generate_direct(
+            cli, "dyn/wa/generate", wa.instance.instance_id, prompt
+        )
+        # churn A's device pool until the prompt's pages offload to host
+        for i in range(6):
+            await _generate_direct(
+                cli, "dyn/wa/generate", wa.instance.instance_id,
+                [100 + 7 * i + j for j in range(16)],
+            )
+        await asyncio.sleep(0.05)
+        hashes = block_hashes(prompt, PS)
+        assert eng_a.host_pool.match(hashes) > 0, "A must hold prefix in G2"
+
+        # B gets the same prompt plus the router-style remote hint
+        hint = {
+            "instance": wa.instance.instance_id,
+            "path": "dyn/wa/kv_host_fetch",
+            "hashes": hashes,
+            "parents": [None] + hashes[:-1],
+        }
+        out_b = await _generate_direct(
+            cli, "dyn/wb/generate", wb.instance.instance_id, prompt,
+            req_extra={"kv_remote_host": hint},
+        )
+        assert out_b == out_a, "pulled KV must reproduce identical output"
+        assert eng_b.host_pool.stats["onboarded"] > 0, \
+            "B should onboard the pulled blocks, not recompute"
+        # and B republishes host residency so the router learns it
+        assert eng_a.host_pool.stats["onboarded"] > 0  # A's G2 served the pull
+    finally:
+        await cli.shutdown()
+        await rt_a.shutdown(drain_timeout=1)
+        await rt_b.shutdown(drain_timeout=1)
+
+
+async def test_remote_pull_failure_falls_back_to_recompute():
+    realm = "xworker-kvbm-fail"
+    rt_b, wb, eng_b = await _serve_tiered(realm, "wb")
+    cli = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    try:
+        prompt = list(range(50, 66))
+        hashes = block_hashes(prompt, PS)
+        hint = {
+            "instance": 0xDEAD,  # no such worker
+            "path": "dyn/nope/kv_host_fetch",
+            "hashes": hashes,
+            "parents": [None] + hashes[:-1],
+        }
+        out = await _generate_direct(
+            cli, "dyn/wb/generate", wb.instance.instance_id, prompt,
+            req_extra={"kv_remote_host": hint},
+        )
+        assert len(out) == 4  # request served by recompute despite sick hint
+    finally:
+        await cli.shutdown()
+        await rt_b.shutdown(drain_timeout=1)
+
+
+# -- router hint + cluster-wide credits (unit) ------------------------------
+
+
+def _fake_router(host_events):
+    host_index = BlockIndex()
+    for ev in host_events:
+        host_index.apply_event(ev)
+    return SimpleNamespace(
+        indexer=SimpleNamespace(host_index=host_index),
+        client=SimpleNamespace(path="ns/comp/generate"),
+    )
+
+
+def test_remote_host_hint_points_at_best_peer():
+    hashes = [11, 12, 13, 14]
+    r = _fake_router([
+        RouterEvent(worker=(0xA, 0), event_id=1, kind="store",
+                    block_hashes=hashes[:3], parent_hash=None, tier="host"),
+        RouterEvent(worker=(0xB, 0), event_id=1, kind="store",
+                    block_hashes=hashes[:1], parent_hash=None, tier="host"),
+    ])
+    hint = KvRouter.remote_host_hint(r, hashes, (0xC, 0), 0, None)
+    assert hint is not None
+    assert hint["instance"] == 0xA
+    assert hint["hashes"] == hashes[:3]
+    assert hint["parents"] == [None, 11, 12]
+    assert hint["path"] == "ns/comp/kv_host_fetch"
+
+    # selected worker already covers the peer's run on device -> no hint
+    assert KvRouter.remote_host_hint(r, hashes, (0xC, 0), 3, None) is None
+    # the peer IS the selected instance -> nothing to pull
+    assert KvRouter.remote_host_hint(r, hashes, (0xA, 0), 0, None) is None
+
+
+def test_selector_credits_cluster_host_residency():
+    cfg = KvRouterConfig(temperature=0.0)
+    sel = WorkerSelector(cfg)
+    workers = [(1, 0), (2, 0)]
+    seqs = ActiveSequences()
+    # worker 1 holds 4 blocks in ITS host tier; a pure-local credit model
+    # would see worker 2 at full cost, but cluster-wide credits discount
+    # worker 2 too (it can onboard from worker 1)
+    host = {(1, 0): 4}
+    from dynamo_tpu.router.protocols import OverlapScores
+
+    _, overlap = sel.select(workers, 8, OverlapScores(scores={}), seqs,
+                            host_overlaps=host)
+    cfg2 = KvRouterConfig(temperature=0.0, remote_credit=0.0)
+    # with remote_credit on, worker 2's cost drops vs remote_credit=0
+    def cost_of(c, w):
+        s = WorkerSelector(c)
+        costs = []
+        for ww in workers:
+            dev = 0
+            h = host.get(ww, 0)
+            cluster = max(host.values())
+            credit = c.device_credit * dev + c.host_credit * max(0, h - dev)
+            credit += c.remote_credit * max(0, cluster - max(dev, h))
+            costs.append(max(0.0, 8 - credit))
+        return costs[workers.index(w)]
+
+    assert cost_of(cfg, (2, 0)) < cost_of(cfg2, (2, 0))
+    assert cost_of(cfg, (1, 0)) < cost_of(cfg, (2, 0))  # local still wins
