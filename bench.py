@@ -1,15 +1,21 @@
-"""Benchmark: native engine decode throughput on the local accelerator.
+"""Benchmark entry. Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Default mode: steady-state batched decode throughput (tokens/second) of
+the Llama-3.2-3B configuration in bf16 with the paged KV cache, batch 32
+— the per-chip engine hot loop that aggregate goodput is built from.
+vs_baseline: ratio against 1000 tok/s, a proxy for a single H100 running
+a 3B-class model under vLLM at the same batch (the reference stack's
+engine tier; BASELINE.md publishes no directly comparable
+single-accelerator scalar). >1.0 = faster than the proxy.
 
-Measures steady-state batched decode throughput (tokens/second) of the
-Llama-3.2-1B configuration in bf16 with the paged KV cache, batch 32 —
-the per-chip engine hot loop that aggregate goodput is built from.
-
-vs_baseline: ratio against 1000 tok/s, a proxy for a single H100 running a
-1B-class model under vLLM at the same batch (the reference stack's engine
-tier; BASELINE.md publishes no directly comparable single-accelerator
-scalar). >1.0 = faster than the proxy.
+`--goodput [goodput args...]`: SLO goodput through the REAL serving stack
+(frontend pipeline + KV router + TCP request plane + engine) — the
+north-star metric shape (BASELINE.md / reference benchmarking.md:449:
+output tokens/s over requests meeting TTFT+ITL SLOs). Extra args pass
+through to dynamo_tpu.bench.goodput (e.g. --disagg, --mocker,
+--quantize int8). vs_baseline: ratio against an 800 tok/s proxy for a
+single H100 serving 3B-class interactive traffic under the reference
+stack at the same SLOs.
 """
 
 from __future__ import annotations
@@ -21,9 +27,37 @@ import jax
 import numpy as np
 
 PROXY_BASELINE_TOK_S = 1000.0
+PROXY_GOODPUT_TOK_S = 800.0
+
+
+def goodput_main(argv) -> None:
+    import asyncio
+
+    from dynamo_tpu.bench.goodput import parse_args, run_goodput
+
+    # run directly (not goodput.main) so exactly ONE JSON line is printed
+    report = asyncio.run(run_goodput(parse_args(argv)))
+    print(
+        json.dumps(
+            {
+                "metric": "slo_goodput",
+                "value": round(report.goodput_tok_s, 1),
+                "unit": "tok/s",
+                "vs_baseline": round(
+                    report.goodput_tok_s / PROXY_GOODPUT_TOK_S, 3
+                ),
+            }
+        )
+    )
 
 
 def main() -> None:
+    import sys
+
+    if "--goodput" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--goodput"]
+        goodput_main(argv)
+        return
     from dynamo_tpu.engine.model_runner import ModelRunner
     from dynamo_tpu.engine.sampling import SamplingParams
     from dynamo_tpu.models.config import get_config

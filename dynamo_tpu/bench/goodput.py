@@ -1,0 +1,277 @@
+"""SLO goodput benchmark against the real serving stack.
+
+`python -m dynamo_tpu.bench.goodput --model llama-3.2-3b --rps 4 ...`
+
+Boots the full in-process stack — worker engine(s) (real ModelRunner on
+the local accelerator, or the calibrated SimRunner mocker), the discovery
+plane, the TCP request plane, and the frontend pipeline (Migration →
+Backend detok → PrefillRouter → KV router) — then fires a Poisson trace at
+it and reports **goodput**: output tokens/s over requests that met BOTH
+the TTFT and ITL SLOs. This is BASELINE.md's metric (reference
+docs/benchmarks/benchmarking.md:449), not raw decode throughput.
+
+Modes:
+- aggregated (default): N workers, each prefill+decode
+- --disagg: decode worker(s) plus a prefill worker pool (the reference's
+  P/D split; on one chip both engines share the accelerator)
+- --mocker: SimRunner workers — measures the serving plane itself
+  (frontend+router+transport ceiling, SURVEY §2.9 hardening item)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from dynamo_tpu.bench.loadgen import (
+    GoodputReport,
+    compute_goodput,
+    generate_trace,
+    load_trace,
+    run_trace_against_engine,
+)
+
+log = logging.getLogger("dynamo_tpu.bench")
+
+
+@dataclass
+class Stack:
+    """A booted serving stack: frontend chain + workers, all in-process
+    but talking over the real discovery/request/event planes."""
+
+    frontend_runtime: Any
+    worker_runtimes: List[Any]
+    workers: List[Any]
+    watcher: Any
+    entry: Any  # ModelEntry: .chain is the frontend pipeline
+
+    async def generate(self, request, context):
+        async for item in self.entry.chain.generate(request, context):
+            yield item
+
+    async def close(self) -> None:
+        await self.watcher.stop()
+        await self.frontend_runtime.shutdown()
+        for w in self.workers:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+        for rt in self.worker_runtimes:
+            try:
+                await rt.shutdown(drain_timeout=2)
+            except Exception:
+                pass
+
+
+def _make_engine(args, mocker: bool):
+    from dynamo_tpu.engine.engine import InferenceEngine
+
+    if mocker:
+        from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+
+        runner = SimRunner(
+            num_pages=args.num_pages,
+            page_size=args.page_size,
+            max_pages_per_seq=args.max_pages_per_seq,
+            timing=SimTiming(speed=args.sim_speed),
+        )
+    else:
+        from dynamo_tpu.engine.model_runner import ModelRunner
+        from dynamo_tpu.models.config import get_config
+
+        runner = ModelRunner(
+            get_config(args.model),
+            num_pages=args.num_pages,
+            page_size=args.page_size,
+            max_pages_per_seq=args.max_pages_per_seq,
+            decode_buckets=tuple(args.decode_buckets),
+            prefill_buckets=tuple(args.prefill_buckets),
+            seed=0,
+            quantize=args.quantize,
+        )
+    return InferenceEngine(
+        runner,
+        max_batch=args.max_batch,
+        chunk_size=args.chunk_size,
+        host_kv_blocks=args.host_kv_blocks,
+    )
+
+
+async def boot_stack(args, mocker: bool = False, disagg: bool = False) -> Stack:
+    from dynamo_tpu.frontend.protocols import ModelCard
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    realm = f"goodput-{id(args):x}"
+    card = ModelCard(
+        name=args.model, tokenizer="byte",
+        context_length=args.page_size * args.max_pages_per_seq,
+        kv_block_size=args.page_size,
+    )
+    worker_runtimes, workers = [], []
+
+    async def add_worker(role: Optional[str], component: str):
+        rt = DistributedRuntime(
+            discovery=MemDiscovery(realm=realm), event_transport="inproc"
+        )
+        engine = _make_engine(args, mocker)
+        w = await serve_worker(
+            rt, engine, card, component=component, disagg_role=role
+        )
+        worker_runtimes.append(rt)
+        workers.append(w)
+
+    if disagg:
+        for _ in range(args.workers):
+            await add_worker("decode", "decode")
+        for _ in range(args.prefill_workers):
+            await add_worker("prefill", "prefill")
+    else:
+        for _ in range(args.workers):
+            await add_worker(None, "worker")
+
+    frt = DistributedRuntime(
+        discovery=MemDiscovery(realm=realm), event_transport="inproc"
+    )
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        frt, manager, router_mode=args.router_mode,
+        disagg_min_prefill_tokens=args.disagg_min_prefill_tokens,
+    )
+    await watcher.start()
+    await watcher.wait_for_model(timeout=60)
+    entry = manager.get(args.model)
+    # wait for every instance to be routable — timing a half-booted stack
+    # would report a plausible-looking goodput of 0 instead of failing
+    for _ in range(200):
+        ready = len(entry.instance_ids) >= args.workers
+        if disagg:
+            ready = ready and len(entry.prefill_instance_ids) >= args.prefill_workers
+        if ready:
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise TimeoutError(
+            f"stack not routable: {len(entry.instance_ids)}/{args.workers} "
+            f"workers (+{len(entry.prefill_instance_ids)} prefill)"
+        )
+    return Stack(frt, worker_runtimes, workers, watcher, entry)
+
+
+async def run_goodput(args) -> GoodputReport:
+    if args.trace:
+        trace = load_trace(args.trace)
+    else:
+        trace = generate_trace(
+            args.n_requests, rps=args.rps, isl_mean=args.isl, osl_mean=args.osl,
+            prefix_groups=args.prefix_groups, seed=args.seed,
+        )
+    stack = await boot_stack(args, mocker=args.mocker, disagg=args.disagg)
+    try:
+        if not args.mocker:
+            await _warmup(stack, args)
+        results, duration = await run_trace_against_engine(
+            trace, stack.generate, time_scale=args.time_scale, seed=args.seed
+        )
+    finally:
+        await stack.close()
+    return compute_goodput(
+        results, duration, ttft_slo_s=args.ttft_slo, itl_slo_s=args.itl_slo
+    )
+
+
+async def _warmup(stack, args) -> None:
+    """Compile outside the measured window (first XLA compile is minutes on
+    TPU): per worker instance, one prefill per prefill bucket, plus a
+    concurrent burst sized to the largest decode bucket so the big decode
+    shapes compile too. Intermediate decode buckets hit during the run
+    still compile lazily — shrink --decode-buckets if that matters."""
+    from dynamo_tpu.runtime.context import Context
+
+    max_ctx = args.page_size * args.max_pages_per_seq
+
+    async def one(target, isl, max_tokens=4):
+        req = {
+            "token_ids": list(range(300, 300 + isl)),
+            "sampling": {"temperature": 0.0},
+            "stop": {"max_tokens": max_tokens, "stop_ids": [],
+                     "ignore_eos": True},
+        }
+        ctx = Context(metadata={"target_instance": target} if target else {})
+        try:
+            async for item in stack.generate(req, ctx):
+                if item.get("finish_reason"):
+                    break
+        except Exception as e:
+            log.warning("warmup request failed: %s", e)
+
+    instances = sorted(stack.entry.instance_ids)
+    for iid in instances:
+        for pb in args.prefill_buckets:
+            isl = max(8, min(pb, max_ctx - 8))
+            await one(iid, isl)
+    burst = max(args.decode_buckets)
+    for iid in instances:
+        await asyncio.gather(*[one(iid, 8) for _ in range(burst)])
+    if stack.entry.prefill_instance_ids:
+        # disagg: long prompts route through the prefill pool via the chain
+        for pb in args.prefill_buckets:
+            isl = max(args.disagg_min_prefill_tokens, min(pb, max_ctx - 8))
+            for _ in range(len(stack.entry.prefill_instance_ids)):
+                await one(None, isl)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.bench.goodput")
+    p.add_argument("--model", default="llama-3.2-3b")
+    p.add_argument("--mocker", action="store_true",
+                   help="SimRunner workers: measures the serving-plane ceiling")
+    p.add_argument("--sim-speed", type=float, default=1.0)
+    p.add_argument("--disagg", action="store_true")
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--prefill-workers", type=int, default=1)
+    p.add_argument("--router-mode", default="kv",
+                   choices=["round_robin", "random", "kv"])
+    p.add_argument("--disagg-min-prefill-tokens", type=int, default=256)
+    p.add_argument("--quantize", default=None, choices=[None, "int8", "fp8"])
+    # engine shape
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=64)
+    p.add_argument("--max-pages-per-seq", type=int, default=16)
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--chunk-size", type=int, default=512)
+    p.add_argument("--host-kv-blocks", type=int, default=0)
+    p.add_argument("--decode-buckets", type=int, nargs="+", default=[8, 16, 32])
+    p.add_argument("--prefill-buckets", type=int, nargs="+",
+                   default=[128, 256, 512])
+    # workload
+    p.add_argument("--trace", default=None, help="JSONL trace file (else synthetic)")
+    p.add_argument("--n-requests", type=int, default=64)
+    p.add_argument("--rps", type=float, default=4.0)
+    p.add_argument("--isl", type=int, default=256)
+    p.add_argument("--osl", type=int, default=64)
+    p.add_argument("--prefix-groups", type=int, default=0)
+    p.add_argument("--time-scale", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    # SLOs (reference benchmarking.md interactive defaults)
+    p.add_argument("--ttft-slo", type=float, default=2.0, help="seconds")
+    p.add_argument("--itl-slo", type=float, default=0.05, help="seconds")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> GoodputReport:
+    args = parse_args(argv)
+    report = asyncio.run(run_goodput(args))
+    print(report.to_json())
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -78,3 +78,58 @@ def test_sim_timing_fit_recovers_model():
     as_dicts = [m.__dict__ for m in hist]
     fit2 = SimTiming.fit(as_dicts, decode_steps=T)
     assert abs(fit2.decode_per_seq_s - fit.decode_per_seq_s) < 1e-9
+
+
+# -- goodput bench against the real stack -----------------------------------
+
+
+def _goodput_args(extra=()):
+    from dynamo_tpu.bench.goodput import parse_args
+
+    return parse_args([
+        "--model", "tiny", "--num-pages", "64", "--page-size", "4",
+        "--max-pages-per-seq", "8", "--max-batch", "4", "--chunk-size", "16",
+        "--decode-buckets", "1", "2", "4",
+        "--prefill-buckets", "8", "16", "32",
+        "--n-requests", "12", "--rps", "20", "--isl", "12", "--osl", "6",
+        "--ttft-slo", "30", "--itl-slo", "30",
+        *extra,
+    ])
+
+
+async def test_goodput_real_engine_aggregated():
+    from dynamo_tpu.bench.goodput import run_goodput
+
+    rep = await run_goodput(_goodput_args())
+    assert rep.n_requests == 12
+    assert rep.n_ok == 12, "all requests must succeed through the stack"
+    assert rep.goodput_tok_s > 0
+    # osl is drawn per-request around the mean; with generous SLOs every
+    # token is good tokens
+    assert rep.n_slo_met == 12
+    assert rep.output_tokens > 0
+    assert rep.ttft_p50_s > 0 and rep.itl_p50_s >= 0
+
+
+async def test_goodput_real_engine_disagg():
+    from dynamo_tpu.bench.goodput import run_goodput
+
+    rep = await run_goodput(_goodput_args(
+        ["--disagg", "--disagg-min-prefill-tokens", "8"]
+    ))
+    assert rep.n_ok == 12
+    assert rep.goodput_tok_s > 0
+
+
+async def test_goodput_mocker_plane_ceiling():
+    """Mocker mode: the serving-plane throughput ceiling (SURVEY §2.9) —
+    frontend pipeline + router + TCP with a simulated accelerator."""
+    from dynamo_tpu.bench.goodput import run_goodput
+
+    rep = await run_goodput(_goodput_args(
+        ["--mocker", "--n-requests", "24", "--rps", "100", "--osl", "8"]
+    ))
+    assert rep.n_ok == 24
+    assert rep.throughput_tok_s > 0
+    # SLO accounting distinguishes goodput from raw throughput
+    assert rep.goodput_tok_s <= rep.throughput_tok_s + 1e-9
