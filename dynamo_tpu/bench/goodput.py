@@ -153,7 +153,13 @@ async def boot_stack(args, mocker: bool = False, disagg: bool = False) -> Stack:
     for _ in range(200):
         ready = len(entry.instance_ids) >= args.workers
         if disagg:
-            ready = ready and len(entry.prefill_instance_ids) >= args.prefill_workers
+            # prefill_router.active requires the prefill CLIENT's own
+            # discovery watch to have seen the instances, not just the
+            # watcher's registry — route-ready is what matters
+            ready = (ready
+                     and len(entry.prefill_instance_ids) >= args.prefill_workers
+                     and entry.prefill_router is not None
+                     and entry.prefill_router.active)
         if ready:
             break
         await asyncio.sleep(0.05)

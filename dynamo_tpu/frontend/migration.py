@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 from typing import Any, AsyncIterator, Dict
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.request_plane import RequestPlaneError
@@ -35,25 +36,42 @@ class Migration:
         retries_left = self.migration_limit
         accumulated: list[int] = []  # tokens already delivered downstream
 
-        while True:
-            try:
-                # re-issues go out with a fresh child context so a stop on
-                # the dead stream doesn't poison the retry
-                attempt_ctx = context.child()
-                async for item in self.downstream.generate(request, attempt_ctx):
-                    accumulated.extend(item.get("token_ids") or [])
-                    yield item
-                return
-            except RequestPlaneError as e:
-                if not is_migratable(e) or retries_left <= 0 or context.is_stopped:
-                    raise
-                retries_left -= 1
-                request = self._replay_request(request, accumulated)
-                accumulated = []  # folded into the replayed prompt
-                log.warning(
-                    "migrating request %s after %s (%d retries left, %d tokens replayed)",
-                    context.id, e.code, retries_left, len(accumulated),
-                )
+        # root span of the serving pipeline (every frontend surface funnels
+        # through Migration): continues the caller's traceparent if the
+        # HTTP layer captured one, and re-points the request metadata so
+        # every downstream hop (router, workers, KV pulls) joins the trace
+        with tracing.span(
+            "frontend.request",
+            parent=context.metadata.get("traceparent"), kind=2,
+            attributes={"request.id": context.id,
+                        "model": str(context.metadata.get("model") or "")},
+        ) as root:
+            tracing.child_traceparent(context.metadata, root)
+            while True:
+                try:
+                    # re-issues go out with a fresh child context so a stop
+                    # on the dead stream doesn't poison the retry
+                    attempt_ctx = context.child()
+                    async for item in self.downstream.generate(request, attempt_ctx):
+                        accumulated.extend(item.get("token_ids") or [])
+                        yield item
+                    return
+                except RequestPlaneError as e:
+                    if not is_migratable(e) or retries_left <= 0 or context.is_stopped:
+                        raise
+                    retries_left -= 1
+                    # the reference's migration TraceLink: replayed hops are
+                    # attributable to the same trace with an attempt count
+                    attempts = self.migration_limit - retries_left
+                    root.set_attribute("migration.attempts", attempts)
+                    context.metadata["migration_attempt"] = attempts
+                    request = self._replay_request(request, accumulated)
+                    n_replayed = len(accumulated)
+                    accumulated = []  # folded into the replayed prompt
+                    log.warning(
+                        "migrating request %s after %s (%d retries left, %d tokens replayed)",
+                        context.id, e.code, retries_left, n_replayed,
+                    )
 
     @staticmethod
     def _replay_request(request: Dict[str, Any], accumulated: list[int]) -> Dict[str, Any]:

@@ -161,7 +161,14 @@ class PrefillRouter:
         ann = dict(preq.get("annotations") or {})
         ann["disagg"] = "prefill"
         preq["annotations"] = ann
-        pctx = Context(request_id=context.id + ":prefill", parent=context)
+        # fresh metadata (routing pins must not leak to the prefill pool),
+        # but the trace context carries over so the prefill hop's server
+        # span joins the request's trace (reference TraceLink role)
+        pmeta = {}
+        if context.metadata.get("traceparent"):
+            pmeta["traceparent"] = context.metadata["traceparent"]
+        pctx = Context(request_id=context.id + ":prefill", parent=context,
+                       metadata=pmeta)
         try:
             client = self._prefill_client
             iid, _ = client.router._pick()

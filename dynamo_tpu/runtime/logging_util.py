@@ -72,6 +72,12 @@ def configure_logging(default_level: str = "info") -> None:
     if otlp:
         root.addHandler(OtlpLogHandler(otlp))
 
+    # span export rides the same env configuration (reference logging.rs
+    # wires logs and traces through one OTLP pipeline)
+    from dynamo_tpu.runtime.tracing import configure_tracing
+
+    configure_tracing()
+
 
 _SEVERITY = {"DEBUG": 5, "INFO": 9, "WARNING": 13, "ERROR": 17, "CRITICAL": 21}
 

@@ -173,11 +173,29 @@ class PushEndpoint:
         ctx = Context.from_headers(frame.get("headers") or {})
         self._active[rid] = ctx
         conn_ctxs[rid] = ctx
+        # server-hop span: continues the trace the caller's metadata carries
+        # (reference: span per ingress hop, logging.rs:76-105) and re-points
+        # the metadata so the engine's own egress calls nest under this hop
+        from dynamo_tpu.runtime import tracing
+
+        attrs = {"rpc.endpoint": path, "request.id": rid}
         try:
-            async for item in engine.generate(frame.get("payload"), ctx):
-                if ctx.is_killed:
-                    raise CancellationError(rid)
-                await send({"t": "item", "id": rid, "data": item})
+            # metadata is raw wire input — a malformed value must not crash
+            # the handler before the err-frame machinery is armed
+            attrs["migration.attempt"] = int(ctx.metadata["migration_attempt"])
+        except (KeyError, TypeError, ValueError):
+            pass
+        span_cm = tracing.span(
+            f"rpc {path}", parent=ctx.metadata.get("traceparent"),
+            kind=2, attributes=attrs,
+        )
+        try:
+            with span_cm as sp:
+                tracing.child_traceparent(ctx.metadata, sp)
+                async for item in engine.generate(frame.get("payload"), ctx):
+                    if ctx.is_killed:
+                        raise CancellationError(rid)
+                    await send({"t": "item", "id": rid, "data": item})
             await send({"t": "done", "id": rid})
         except CancellationError:
             try:

@@ -1,0 +1,274 @@
+"""Distributed tracing: W3C trace context + OTLP/HTTP span export.
+
+Analog of the reference's OTel span pipeline (lib/runtime/src/logging.rs:
+76-105 — OTLP span exporter, W3C `traceparent` propagation across the
+request plane, spans per ingress/egress hop; migration links via
+TraceLink, lib/llm/src/migration.rs:33-35). Same implementation stance as
+logging_util.OtlpLogHandler: plain urllib + a daemon batch thread, no otel
+SDK dependency.
+
+How a trace forms:
+- the HTTP frontend starts a root span per inference request (continuing a
+  caller's `traceparent` header when present) and writes the new span's
+  traceparent into `ctx.metadata["traceparent"]`;
+- Context.metadata rides the request-plane frame headers, so every server
+  hop (PushEndpoint._handle_request) opens a child span named after its
+  endpoint path and re-points the metadata at itself before the engine
+  runs — frontend → prefill worker → decode worker → cross-worker KV
+  pulls all land in ONE trace;
+- Migration stamps `migration.attempt` on retries (the reference's
+  TraceLink role) so replayed hops are distinguishable.
+
+Disabled (no exporter) the only cost is forwarding an existing
+traceparent string; span objects are created only when an exporter is
+installed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("dynamo_tpu.tracing")
+
+
+@dataclass
+class SpanContext:
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+    flags: str = "01"
+
+    @property
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags}"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
+    """W3C trace-context header -> SpanContext (None when absent/invalid)."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    if parts[1] == "0" * 32 or parts[2] == "0" * 16:
+        return None
+    return SpanContext(trace_id=parts[1].lower(), span_id=parts[2].lower(),
+                       flags=parts[3][:2] or "01")
+
+
+@dataclass
+class Span:
+    name: str
+    context: SpanContext
+    parent_span_id: Optional[str]
+    start_ns: int
+    end_ns: int = 0
+    kind: int = 1  # OTLP SpanKind: 1=internal, 2=server, 3=client
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    status_error: Optional[str] = None
+
+    @property
+    def traceparent(self) -> str:
+        return self.context.traceparent
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def record_error(self, err: str) -> None:
+        self.status_error = err
+
+
+class _NoopSpan:
+    """Returned when tracing is disabled; forwards nothing, costs nothing."""
+
+    traceparent = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def record_error(self, err: str) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class MemorySpanExporter:
+    """Test exporter: finished spans in a list."""
+
+    def __init__(self):
+        self.spans: List[Span] = []
+
+    def export(self, span: Span) -> None:
+        self.spans.append(span)
+
+
+class OtlpSpanExporter:
+    """Batch spans to an OTLP/HTTP collector (/v1/traces, JSON encoding)
+    from a daemon thread; drops on failure (telemetry is best-effort)."""
+
+    def __init__(self, endpoint: str, service_name: str = "dynamo_tpu",
+                 flush_interval_s: float = 2.0, max_batch: int = 256):
+        import queue
+
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self._q: "queue.Queue" = queue.Queue(maxsize=8192)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def export(self, span: Span) -> None:
+        try:
+            self._q.put_nowait(span)
+        except Exception:
+            pass  # full queue: drop
+
+    @staticmethod
+    def _attr(k: str, v: Any) -> Dict[str, Any]:
+        if isinstance(v, bool):
+            val = {"boolValue": v}
+        elif isinstance(v, int):
+            val = {"intValue": str(v)}
+        elif isinstance(v, float):
+            val = {"doubleValue": v}
+        else:
+            val = {"stringValue": str(v)}
+        return {"key": k, "value": val}
+
+    def _wire(self, s: Span) -> Dict[str, Any]:
+        out = {
+            "traceId": s.context.trace_id,
+            "spanId": s.context.span_id,
+            "name": s.name,
+            "kind": s.kind,  # already the OTLP enum (1=internal, 2=server, 3=client)
+            "startTimeUnixNano": str(s.start_ns),
+            "endTimeUnixNano": str(s.end_ns),
+            "attributes": [self._attr(k, v) for k, v in s.attributes.items()],
+        }
+        if s.parent_span_id:
+            out["parentSpanId"] = s.parent_span_id
+        if s.status_error is not None:
+            out["status"] = {"code": 2, "message": s.status_error}
+        return out
+
+    def _loop(self) -> None:
+        import queue
+        import urllib.request
+
+        while True:
+            batch = [self._q.get()]
+            deadline = time.monotonic() + self.flush_interval_s
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(
+                        self._q.get(timeout=max(0.01, deadline - time.monotonic()))
+                    )
+                except queue.Empty:
+                    break
+            payload = json.dumps({
+                "resourceSpans": [{
+                    "resource": {"attributes": [
+                        {"key": "service.name",
+                         "value": {"stringValue": self.service_name}},
+                    ]},
+                    "scopeSpans": [{
+                        "scope": {"name": "dynamo_tpu"},
+                        "spans": [self._wire(s) for s in batch],
+                    }],
+                }]
+            }).encode()
+            try:
+                req = urllib.request.Request(
+                    self.url, data=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                pass  # collector down: drop
+
+
+_exporter = None
+_configured = False
+
+
+def set_exporter(exporter) -> None:
+    """Install a span exporter (tests use MemorySpanExporter; production
+    configuration happens via DYN_OTLP_ENDPOINT in configure_tracing)."""
+    global _exporter, _configured
+    _exporter = exporter
+    _configured = True
+
+
+def configure_tracing(service_name: str = "dynamo_tpu") -> None:
+    """Idempotent env-driven setup: DYN_OTLP_ENDPOINT enables span export
+    (shared with the OTLP log handler endpoint, like the reference)."""
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    endpoint = os.environ.get("DYN_OTLP_TRACES_ENDPOINT") \
+        or os.environ.get("DYN_OTLP_ENDPOINT")
+    if endpoint:
+        set_exporter(OtlpSpanExporter(endpoint, service_name=service_name))
+
+
+def enabled() -> bool:
+    return _exporter is not None
+
+
+@contextlib.contextmanager
+def span(name: str, parent: Optional[str] = None, kind: int = 1,
+         attributes: Optional[Dict[str, Any]] = None):
+    """Open a span. `parent` is a traceparent string (e.g. from
+    ctx.metadata); the yielded span's `.traceparent` is what downstream
+    metadata should carry. No exporter installed -> a shared no-op span
+    (callers still forward the incoming parent themselves)."""
+    if _exporter is None:
+        yield NOOP_SPAN
+        return
+    pctx = parse_traceparent(parent)
+    ctx = SpanContext(
+        trace_id=pctx.trace_id if pctx else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+    )
+    s = Span(
+        name=name,
+        context=ctx,
+        parent_span_id=pctx.span_id if pctx else None,
+        start_ns=time.time_ns(),
+        kind=kind,
+        attributes=dict(attributes or {}),
+    )
+    try:
+        yield s
+    except BaseException as e:
+        # GeneratorExit is the normal close of a streaming consumer and
+        # CancelledError is cooperative shutdown — neither is a span error
+        if not isinstance(e, (GeneratorExit, asyncio.CancelledError)):
+            s.record_error(f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        s.end_ns = time.time_ns()
+        try:
+            _exporter.export(s)
+        except Exception:
+            log.exception("span export failed")
+
+
+def child_traceparent(metadata: Dict[str, Any], s) -> None:
+    """Point request metadata at `s` so downstream hops become children.
+    With tracing disabled (no-op span) the existing traceparent is left
+    for downstream services that DO trace."""
+    tp = getattr(s, "traceparent", None)
+    if tp is not None:
+        metadata["traceparent"] = tp

@@ -68,7 +68,7 @@ class DisaggDecodeAdapter:
         self.runtime = runtime
         self._fetch_clients = {}
 
-    async def _fetch(self, src) -> Optional[dict]:
+    async def _fetch(self, src, parent_ctx=None) -> Optional[dict]:
         local = LOCAL_ENGINES.get(src["instance_id"])
         # device path needs real runners on BOTH ends (mockers track KV at
         # hash level only and must never touch jax)
@@ -88,7 +88,16 @@ class DisaggDecodeAdapter:
             await client.start()
             self._fetch_clients[path] = client
         client.router.update_instance(src["instance_id"], src["address"])
-        async for item in client.direct({"request_id": src["request_id"]}, src["instance_id"]):
+        # carry the trace across the P->D pull so the kv_fetch hop joins
+        # the request's trace
+        md = {}
+        if parent_ctx is not None and parent_ctx.metadata.get("traceparent"):
+            md["traceparent"] = parent_ctx.metadata["traceparent"]
+        from dynamo_tpu.runtime.context import Context as _Ctx
+
+        async for item in client.direct(
+            {"request_id": src["request_id"]}, src["instance_id"], _Ctx(metadata=md)
+        ):
             return item
         return None
 
@@ -96,7 +105,7 @@ class DisaggDecodeAdapter:
         src = request.get("kv_transfer_src")
         if src is not None:
             try:
-                payload = await self._fetch(src)
+                payload = await self._fetch(src, parent_ctx=context)
             except Exception as e:
                 log.warning("kv fetch from prefill worker failed: %s", e)
                 payload = None

@@ -1,0 +1,148 @@
+"""Distributed tracing: W3C traceparent propagation + spans across the
+serving pipeline — one trace id covers the frontend root and the disagg
+prefill and decode worker hops (reference lib/runtime/src/logging.rs:76-105
+span export + propagation; migration.rs TraceLink)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.tracing import (
+    MemorySpanExporter,
+    OtlpSpanExporter,
+    parse_traceparent,
+    set_exporter,
+)
+
+
+@pytest.fixture
+def mem_spans():
+    exp = MemorySpanExporter()
+    set_exporter(exp)
+    yield exp
+    set_exporter(None)
+
+
+def test_traceparent_parse_and_format():
+    ctx = parse_traceparent("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+    assert ctx.trace_id == "ab" * 16 and ctx.span_id == "cd" * 8
+    assert ctx.traceparent == "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "cd" * 8 + "-01") is None
+
+
+def test_span_parenting_and_error(mem_spans):
+    with tracing.span("root") as root:
+        with tracing.span("child", parent=root.traceparent) as child:
+            child.set_attribute("k", 1)
+        with pytest.raises(ValueError):
+            with tracing.span("bad", parent=root.traceparent):
+                raise ValueError("boom")
+    spans = {s.name: s for s in mem_spans.spans}
+    assert spans["child"].context.trace_id == spans["root"].context.trace_id
+    assert spans["child"].parent_span_id == spans["root"].context.span_id
+    assert spans["root"].parent_span_id is None
+    assert spans["bad"].status_error and "boom" in spans["bad"].status_error
+    assert spans["child"].end_ns >= spans["child"].start_ns
+
+
+def test_disabled_tracing_is_noop_but_forwards():
+    set_exporter(None)
+    md = {"traceparent": "00-" + "11" * 16 + "-" + "22" * 8 + "-01"}
+    with tracing.span("x", parent=md["traceparent"]) as s:
+        tracing.child_traceparent(md, s)
+    # no exporter: metadata untouched so downstream tracers still connect
+    assert md["traceparent"].startswith("00-" + "11" * 16)
+
+
+def test_otlp_wire_format():
+    exp = OtlpSpanExporter.__new__(OtlpSpanExporter)  # no thread
+    from dynamo_tpu.runtime.tracing import Span, SpanContext
+
+    s = Span(name="n", context=SpanContext("a" * 32, "b" * 16),
+             parent_span_id="c" * 16, start_ns=1, end_ns=2, kind=2,
+             attributes={"i": 3, "f": 1.5, "b": True, "s": "x"})
+    s.record_error("bad")
+    w = exp._wire(s)
+    assert w["traceId"] == "a" * 32 and w["parentSpanId"] == "c" * 16
+    assert w["kind"] == 2  # OTLP SERVER
+    attrs = {a["key"]: a["value"] for a in w["attributes"]}
+    assert attrs["i"] == {"intValue": "3"}
+    assert attrs["b"] == {"boolValue": True}
+    assert w["status"]["code"] == 2
+
+
+# -- e2e: one trace across disagg prefill + decode hops ---------------------
+
+
+async def test_single_trace_spans_disagg_request(mem_spans):
+    from dynamo_tpu.bench.goodput import boot_stack, parse_args
+    from dynamo_tpu.runtime.context import Context
+
+    args = parse_args([
+        "--model", "tiny", "--num-pages", "64", "--page-size", "4",
+        "--max-pages-per-seq", "8", "--max-batch", "4", "--chunk-size", "16",
+        "--decode-buckets", "1", "2", "4",
+        "--prefill-buckets", "8", "16", "32",
+        "--disagg-min-prefill-tokens", "8",
+    ])
+    stack = await boot_stack(args, disagg=True)
+    try:
+        caller = "00-" + "77" * 16 + "-" + "88" * 8 + "-01"
+        ctx = Context(metadata={"model": "tiny", "traceparent": caller})
+        req = {
+            "token_ids": list(range(40, 56)),  # 16 >= disagg threshold
+            "sampling": {"temperature": 0.0},
+            "stop": {"max_tokens": 4, "stop_ids": [], "ignore_eos": True},
+        }
+        out = []
+        async for item in stack.entry.chain.generate(req, ctx):
+            out.extend(item.get("token_ids") or [])
+            if item.get("finish_reason"):
+                break
+        assert out
+    finally:
+        await stack.close()
+
+    # background control-plane RPCs (e.g. the router's kv_state resync)
+    # legitimately start their own traces — the request's hops must all
+    # land in the CALLER's trace
+    spans = [s for s in mem_spans.spans if s.context.trace_id == "77" * 16]
+    request_names = {s.name for s in mem_spans.spans} - {
+        s.name for s in spans}
+    assert all("kv_state" in n for n in request_names), \
+        f"request-path span escaped the trace: {request_names}"
+    names = [s.name for s in spans]
+    root = next(s for s in spans if s.name == "frontend.request")
+    assert root.parent_span_id == "88" * 8  # continues the caller's span
+    prefill = [s for s in spans if "prefill" in s.name]
+    decode = [s for s in spans if "decode" in s.name]
+    assert prefill and decode, f"need prefill+decode hops, got {names}"
+    # both worker hops are children of the frontend root span
+    assert all(s.parent_span_id == root.context.span_id
+               for s in prefill + decode)
+
+
+async def test_migration_attempt_recorded(mem_spans):
+    from dynamo_tpu.frontend.migration import Migration
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+    class Flaky:
+        calls = 0
+
+        async def generate(self, request, context):
+            Flaky.calls += 1
+            if Flaky.calls == 1:
+                raise RequestPlaneError("gone", code="disconnected")
+                yield
+            yield {"token_ids": [1], "finish_reason": "stop"}
+
+    mig = Migration(Flaky(), migration_limit=2)
+    out = []
+    async for item in mig.generate({"token_ids": [5], "stop": {}}, Context()):
+        out.append(item)
+    root = next(s for s in mem_spans.spans if s.name == "frontend.request")
+    assert root.attributes.get("migration.attempts") == 1
