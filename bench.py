@@ -71,6 +71,7 @@ def main() -> None:
     import os
 
     quantize = os.environ.get("DYN_BENCH_QUANTIZE") or None  # e.g. "int8"
+    attn_impl = os.environ.get("DYN_BENCH_ATTN") or None  # "jnp" | "pallas"
     config = get_config("llama-3.2-3b")
     runner = ModelRunner(
         config,
@@ -81,6 +82,7 @@ def main() -> None:
         prefill_buckets=(prompt_len,),
         seed=0,
         quantize=quantize,
+        attn_impl=attn_impl,
     )
 
     rng = np.random.default_rng(0)

@@ -144,13 +144,17 @@ class ModelRunner:
         self.dtype = dtype
 
         t0 = time.monotonic()
+        owns_params = params is None
         if params is None:
             params = llama.init_params(config, jax.random.PRNGKey(seed), dtype)
         self.quantize = quantize
         if quantize in ("int8", "fp8"):
             from dynamo_tpu.models.quant import quantize_params
 
-            params = quantize_params(params, mode=quantize)
+            # donate only self-initialized trees: donation frees each bf16
+            # leaf as it converts (halves peak HBM during quantization) but
+            # deletes the caller's arrays on accelerator backends
+            params = quantize_params(params, mode=quantize, donate=owns_params)
         elif quantize is not None:
             raise ValueError(f"unknown quantize mode {quantize!r}")
         self.params = jax.device_put(params, self.policy.params_sharding(params))

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.models.config import ModelConfig
-from dynamo_tpu.models.quant import mm
+from dynamo_tpu.models.quant import embed_lookup, mm, tied_logits
 
 Params = Dict[str, Any]
 
@@ -222,7 +222,7 @@ def forward(
     hd = c.head_dim
     G = c.n_heads // c.n_kv_heads
 
-    h = params["embed"][tokens]  # [B, S, E] (gather)
+    h = embed_lookup(params["embed"], tokens)  # [B, S, E] (gather)
     if mm_embeds is not None:
         # multimodal injection: image-placeholder positions take the vision
         # encoder's embeddings instead of the token embedding (prefix-cache
@@ -355,9 +355,9 @@ def forward(
         h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, E]
     lm_head = params.get("lm_head")
     if lm_head is None:  # tied embeddings
-        logits = h @ params["embed"].T
+        logits = tied_logits(h, params["embed"])
     else:
-        logits = h @ lm_head
+        logits = mm(h, lm_head)
     return logits.astype(jnp.float32), k_pool, v_pool
 
 
@@ -376,7 +376,7 @@ def encode(
     G = c.n_heads // c.n_kv_heads
     positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, 0)
 
-    h = params["embed"][tokens]
+    h = embed_lookup(params["embed"], tokens)
 
     def layer(h, xs):
         lp, _ = xs

@@ -75,6 +75,11 @@ class ShardingPolicy:
         """Spec by parameter name. Per-layer weights are stacked on a
         leading [n_layers] axis (models/llama.py), so layer params carry a
         leading None."""
+        # embed quantizes per-ROW (scale [V, 1], reduced over E) unlike the
+        # [..., in, out] weights, so its scale replicates instead of
+        # following the generic collapsed-contraction rule below
+        if path.endswith("embed/s"):
+            return P()
         # int8 weight-only quantization (models/quant.py): the q tensor
         # shards exactly like the base weight; the scale [.., 1, out]
         # shards only where the base sharded its LAST (output) dim

@@ -60,6 +60,12 @@ def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
         return None
     if parts[1] == "0" * 32 or parts[2] == "0" * 16:
         return None
+    try:
+        # non-hex ids would poison the whole OTLP export batch downstream
+        # (a collector 400s the entire /v1/traces request on one bad id)
+        int(parts[1], 16), int(parts[2], 16), int(parts[3][:2] or "01", 16)
+    except ValueError:
+        return None
     return SpanContext(trace_id=parts[1].lower(), span_id=parts[2].lower(),
                        flags=parts[3][:2] or "01")
 
