@@ -72,6 +72,7 @@ def main() -> None:
 
     quantize = os.environ.get("DYN_BENCH_QUANTIZE") or None  # e.g. "int8"
     attn_impl = os.environ.get("DYN_BENCH_ATTN") or None  # "jnp" | "pallas"
+    kv_quantize = os.environ.get("DYN_BENCH_KV_QUANTIZE") or None  # "int8"
     config = get_config("llama-3.2-3b")
     runner = ModelRunner(
         config,
@@ -83,6 +84,7 @@ def main() -> None:
         seed=0,
         quantize=quantize,
         attn_impl=attn_impl,
+        kv_quantize=kv_quantize,
     )
 
     rng = np.random.default_rng(0)

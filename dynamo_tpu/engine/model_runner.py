@@ -131,6 +131,7 @@ class ModelRunner:
         lora_rank: int = 8,
         lora_targets=None,  # defaults to models/lora.py DEFAULT_TARGETS
         quantize: Optional[str] = None,  # "int8" → weight-only quant
+        kv_quantize: Optional[str] = None,  # "int8" → quantized KV pools
     ):
         self.config = config
         self.mesh_config = mesh_config or MeshConfig()
@@ -160,8 +161,11 @@ class ModelRunner:
         self.params = jax.device_put(params, self.policy.params_sharding(params))
         # padding writes scatter to page index == num_pages, out of bounds,
         # and are dropped (scatter mode="drop" in llama._write_kv)
-        k_pool, v_pool = llama.make_kv_pool(config, num_pages, page_size, dtype)
-        kv_sharding = self.policy.kv_pool_sharding()
+        self.kv_quantize = kv_quantize
+        k_pool, v_pool = llama.make_kv_pool(
+            config, num_pages, page_size, dtype, kv_quantize=kv_quantize
+        )
+        kv_sharding = self.policy.kv_pool_sharding_tree(k_pool)
         self.k_pool = jax.device_put(k_pool, kv_sharding)
         self.v_pool = jax.device_put(v_pool, kv_sharding)
         log.info(
@@ -184,9 +188,12 @@ class ModelRunner:
             self.draft_params = jax.device_put(
                 draft_params, self.policy.params_sharding(draft_params)
             )
-            dk, dv = llama.make_kv_pool(draft_config, num_pages, page_size, dtype)
-            self.draft_k_pool = jax.device_put(dk, kv_sharding)
-            self.draft_v_pool = jax.device_put(dv, kv_sharding)
+            dk, dv = llama.make_kv_pool(
+                draft_config, num_pages, page_size, dtype, kv_quantize=kv_quantize
+            )
+            dk_sharding = self.policy.kv_pool_sharding_tree(dk)
+            self.draft_k_pool = jax.device_put(dk, dk_sharding)
+            self.draft_v_pool = jax.device_put(dv, dk_sharding)
 
         # multi-LoRA: stacked adapter factors, one slot per adapter, batched
         # per-sequence adapter indices through every step function
@@ -474,12 +481,39 @@ class ModelRunner:
         return np.asarray(jax.device_get(out))[:n]
 
     # -- disagg KV transfer: device-resident path (colocated P/D) ----------
+    # Transfer/offload boundary contract: pages always cross it DENSE (the
+    # pool dtype, normally bf16) regardless of kv_quantize — host tiers,
+    # the disagg wire format and peer workers see one layout, so quantized
+    # and unquantized workers interoperate. Export dequantizes, import
+    # re-quantizes (per-vector scales are recomputed; error is one extra
+    # rounding, bounded by the int8 step).
+    def _dense_pages(self, pool, idx):
+        sel = jax.tree.map(lambda a: a[:, :, idx], pool)
+        if isinstance(sel, dict):
+            from dynamo_tpu.models.quant import kv_dequantize
+
+            return kv_dequantize(
+                {"q": sel["q"], "s": sel["s"]}, dtype=self.dtype
+            )
+        return sel
+
+    def _store_pages(self, pool, idx, dense):
+        if isinstance(pool, dict):
+            from dynamo_tpu.models.quant import kv_quantize
+
+            d = kv_quantize(dense)
+            return {
+                "q": pool["q"].at[:, :, idx].set(d["q"]),
+                "s": pool["s"].at[:, :, idx].set(d["s"]),
+            }
+        return pool.at[:, :, idx].set(dense)
+
     def export_pages_device(self, pages: List[int]):
         """Gather whole KV pages into fresh device buffers (no host copy).
         The gather materializes a new array, so the source pool can keep
         being donated by its engine's step loop afterwards."""
         idx = jnp.asarray(np.asarray(pages, np.int32))
-        return self.k_pool[:, :, idx], self.v_pool[:, :, idx]
+        return self._dense_pages(self.k_pool, idx), self._dense_pages(self.v_pool, idx)
 
     def import_pages_device(self, target_pages: List[int], offset: int, k, v) -> None:
         """Scatter device-staged pages into this pool's slots (the TPU
@@ -487,16 +521,16 @@ class ModelRunner:
         host-staged path below is the DCN fallback)."""
         idx = jnp.asarray(np.asarray(target_pages, np.int32))
         n = len(target_pages)
-        self.k_pool = self.k_pool.at[:, :, idx].set(k[:, :, offset : offset + n])
-        self.v_pool = self.v_pool.at[:, :, idx].set(v[:, :, offset : offset + n])
+        self.k_pool = self._store_pages(self.k_pool, idx, k[:, :, offset : offset + n])
+        self.v_pool = self._store_pages(self.v_pool, idx, v[:, :, offset : offset + n])
 
     # -- disagg KV transfer (host-staged DCN path, SURVEY.md §2.11) ---------
     def export_pages(self, pages: List[int]) -> Dict[str, Any]:
         """Device→host read of whole KV pages for P→D transfer. Layout on
         the wire: [L, Hk, n_pages, PS, D] per pool, raw bytes."""
         idx = jnp.asarray(np.asarray(pages, np.int32))
-        k = np.asarray(jax.device_get(self.k_pool[:, :, idx]))
-        v = np.asarray(jax.device_get(self.v_pool[:, :, idx]))
+        k = np.asarray(jax.device_get(self._dense_pages(self.k_pool, idx)))
+        v = np.asarray(jax.device_get(self._dense_pages(self.v_pool, idx)))
         return kv_arrays_to_payload(k, v)
 
     def import_pages(self, target_pages: List[int], offset: int, payload: Dict[str, Any]) -> None:
@@ -509,12 +543,13 @@ class ModelRunner:
         k, v = arrays
         sel = slice(offset, offset + len(target_pages))
         idx = jnp.asarray(np.asarray(target_pages, np.int32))
-        self.k_pool = self.k_pool.at[:, :, idx].set(jnp.asarray(k[:, :, sel]))
-        self.v_pool = self.v_pool.at[:, :, idx].set(jnp.asarray(v[:, :, sel]))
+        self.k_pool = self._store_pages(self.k_pool, idx, jnp.asarray(k[:, :, sel]))
+        self.v_pool = self._store_pages(self.v_pool, idx, jnp.asarray(v[:, :, sel]))
 
     # -- memory ------------------------------------------------------------
     def kv_pool_bytes(self) -> int:
-        return 2 * int(np.prod(self.k_pool.shape)) * self.k_pool.dtype.itemsize
+        leaves = jax.tree.leaves((self.k_pool, self.v_pool))
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in leaves)
 
 
 def _as_sampling(s) -> SamplingParams:

@@ -86,12 +86,25 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
 
 
 def make_kv_pool(
-    config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
-) -> Tuple[jax.Array, jax.Array]:
+    config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16,
+    kv_quantize: Optional[str] = None,
+):
     """Pool layout [L, Hk, NP, PS, D]: kv-heads leading so (a) the pool
     shards over the model axis on a leading dim and (b) Pallas can block
-    (page, head) slices with TPU-legal (PS, D) tiles."""
+    (page, head) slices with TPU-legal (PS, D) tiles.
+
+    kv_quantize="int8" returns dict pools {"q": int8, "s": f32 [L, Hk, NP,
+    PS]} (models/quant.py KV convention) — same page axis (2) everywhere,
+    so page indexing tree_maps over either representation."""
     shape = (config.n_layers, config.n_kv_heads, num_pages, page_size, config.head_dim)
+    if kv_quantize == "int8":
+        mk = lambda: {
+            "q": jnp.zeros(shape, jnp.int8),
+            "s": jnp.zeros(shape[:-1], jnp.float32),
+        }
+        return mk(), mk()
+    if kv_quantize is not None:
+        raise ValueError(f"unknown kv_quantize mode {kv_quantize!r}")
     return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
 
 
@@ -138,11 +151,20 @@ def paged_attention_jnp(
     `return_stats`, also fp32 (m, l) [B, S, Hk, G, 1] online-softmax stats
     (rows with an empty context get l == 0 and out == 0, so merging with
     attention over other context stays exact)."""
-    Hk, NP, PS, Dh = k_pool_l.shape
-    B, MP = page_table.shape
-    C = MP * PS
-    k = k_pool_l[:, page_table].reshape(Hk, B, C, Dh)
-    v = v_pool_l[:, page_table].reshape(Hk, B, C, Dh)
+    def gather(pool_l, dtype):
+        if isinstance(pool_l, dict):  # int8 KV (models/quant.py): dequant
+            # rides the gather; XLA fuses the cast+scale into operand load
+            g = pool_l["q"][:, page_table].astype(dtype)
+            s = pool_l["s"][:, page_table].astype(dtype)[..., None]
+            pool_l = g * s
+        else:
+            pool_l = pool_l[:, page_table]
+        Hk, B, MP, PS, Dh = pool_l.shape
+        return pool_l.reshape(Hk, B, MP * PS, Dh)
+
+    k = gather(k_pool_l, q.dtype)
+    v = gather(v_pool_l, q.dtype)
+    Hk, _, C, Dh = k.shape
 
     scale = Dh**-0.5
     scores = jnp.einsum("bskgd,kbcd->bkgsc", q, k).astype(jnp.float32) * scale
@@ -166,8 +188,13 @@ def _write_kv(pool, l_idx, new, page_table, positions):
     [L, Hk, NP, PS, Dh] — the pool stays a single carried buffer across the
     layer scan (XLA keeps the update in place), never a per-layer copy.
     new: [B, S, Hk, Dh]; positions: [B, S] absolute positions, -1 marks
-    padding (dropped via out-of-bounds scatter + mode='drop')."""
-    L, Hk, NP, PS, Dh = pool.shape
+    padding (dropped via out-of-bounds scatter + mode='drop'). Dict pools
+    (int8 KV, models/quant.py) quantize on write — one scale per written
+    (token, head) vector."""
+    if isinstance(pool, dict):
+        L, Hk, NP, PS, Dh = pool["q"].shape
+    else:
+        L, Hk, NP, PS, Dh = pool.shape
     B, S = positions.shape
     MP = page_table.shape[1]
     valid = positions >= 0
@@ -176,12 +203,19 @@ def _write_kv(pool, l_idx, new, page_table, positions):
     page_idx = jnp.take_along_axis(page_table, page_of_pos, axis=1)  # [B, S]
     page_idx = jnp.where(valid, page_idx, NP)  # OOB → dropped
     slot = (pos % PS).astype(jnp.int32)
+    pg, sl = page_idx.reshape(-1), slot.reshape(-1)
     # advanced indices (l_idx, page_idx, slot) are non-contiguous (the Hk
     # slice sits between them), so their broadcast dim lands in front:
     # the updated selection has shape [B*S, Hk, Dh]
-    return pool.at[l_idx, :, page_idx.reshape(-1), slot.reshape(-1)].set(
-        new.reshape(B * S, Hk, Dh), mode="drop"
-    )
+    if isinstance(pool, dict):
+        from dynamo_tpu.models.quant import kv_quantize
+
+        d = kv_quantize(new.reshape(B * S, Hk, Dh))
+        return {
+            "q": pool["q"].at[l_idx, :, pg, sl].set(d["q"], mode="drop"),
+            "s": pool["s"].at[l_idx, :, pg, sl].set(d["s"], mode="drop"),
+        }
+    return pool.at[l_idx, :, pg, sl].set(new.reshape(B * S, Hk, Dh), mode="drop")
 
 
 # --------------------------------------------------------------------------
@@ -267,8 +301,8 @@ def forward(
         # surgical in-place scatter into the carried pools (no pool copy)
         k_pool = _write_kv(k_pool, l_idx, k, page_table, positions)
         v_pool = _write_kv(v_pool, l_idx, v, page_table, positions)
-        k_pool_l = k_pool[l_idx]
-        v_pool_l = v_pool[l_idx]
+        k_pool_l = jax.tree.map(lambda a: a[l_idx], k_pool)
+        v_pool_l = jax.tree.map(lambda a: a[l_idx], v_pool)
 
         qg = q.reshape(B, S, c.n_kv_heads, G, hd)
         tp = mesh is not None and mesh.shape.get("model", 1) > 1

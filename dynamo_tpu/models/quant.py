@@ -119,6 +119,36 @@ def tied_logits(h: jax.Array, embed: Any) -> jax.Array:
     return h @ embed.T
 
 
+# -- KV-cache quantization --------------------------------------------------
+# A quantized KV pool is the dict {"q": int8 [L, Hk, NP, PS, D],
+# "s": f32 [L, Hk, NP, PS]} — one symmetric scale per cached (token, head)
+# vector, reduced over the head dim. 132 bytes per vector vs 256 bf16, so
+# decode's per-step KV stream nearly halves. The pool rides through jit /
+# lax.scan / donation as a pytree; attention folds the scales into the
+# softmax scores (K) and probabilities (V) instead of dequantizing whole
+# pages. Reference analog: the KV block manager's fp8 KV layouts
+# (lib/kvbm-kernels/cuda/tensor_kernels.cu) — engine-owned quantized cache.
+
+
+@jax.jit
+def kv_quantize(x: jax.Array) -> Dict[str, jax.Array]:
+    """Quantize KV vectors over the last (head) dim: [..., D] → {"q":
+    int8 [..., D], "s": f32 [...]}. Used for pool writes and onboarding."""
+    amax = jnp.max(jnp.abs(x).astype(jnp.float32), axis=-1)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def kv_dequantize(d: Dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
+    """{"q","s"} → dense [..., D] in `dtype` (transfer/offload boundary —
+    host tiers and the disagg wire format stay bf16 so heterogeneous
+    workers interoperate; onboarding re-quantizes)."""
+    return (d["q"].astype(jnp.float32) * d["s"][..., None]).astype(dtype)
+
+
 def quantize_params(
     params: Dict[str, Any], names: Iterable[str] = DEFAULT_QUANT_NAMES,
     mode: str = "int8", donate: bool = False,

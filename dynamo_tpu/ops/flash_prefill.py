@@ -38,7 +38,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _prefill_kernel(
+def _prefill_kernel_body(
     # scalar prefetch
     page_table_ref,  # [B, MP] int32
     q_start_ref,  # [B] int32 absolute position of query token 0
@@ -48,6 +48,8 @@ def _prefill_kernel(
     q_ref,  # [Hk, Sq, G, D]
     k_ref,  # [Hk, PS, D] one page
     v_ref,  # [Hk, PS, D]
+    ks_ref,  # [Hk, PS] f32 per-vector K scales (int8 KV) or None
+    vs_ref,  # [Hk, PS] f32 per-vector V scales or None
     o_ref,  # [Hk, Sq, G, D]
     # scratch (persist across the page loop)
     m_ref,  # [Hk, Sq*G, 1] f32
@@ -87,6 +89,9 @@ def _prefill_kernel(
         s = lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale  # [Hk, Sq*G, PS]
+        if ks_ref is not None:
+            # int8 KV: fold per-(token, head) K scales into the scores
+            s = s * ks_ref[...][:, None, :]
 
         row = lax.broadcasted_iota(jnp.int32, s.shape, 1) // n_groups  # sq idx
         col = lax.broadcasted_iota(jnp.int32, s.shape, 2)  # slot in page
@@ -100,12 +105,15 @@ def _prefill_kernel(
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
 
+        l_add = jnp.sum(p, axis=2, keepdims=True)  # raw-probability denom
+        if vs_ref is not None:
+            p = p * vs_ref[...][:, None, :]  # fold V scales into p
         v = v_ref[...].astype(jnp.float32)  # [Hk, PS, D]
         pv = lax.dot_general(
             p, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
         )  # [Hk, Sq*G, D]
         acc_ref[...] = acc_ref[...] * alpha + pv
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        l_ref[...] = l_ref[...] * alpha + l_add
         m_ref[...] = m_new
 
     @pl.when(i == n_pages - 1)
@@ -113,6 +121,14 @@ def _prefill_kernel(
         Hk, Sq, G, D = o_ref.shape
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype).reshape(Hk, Sq, G, D)
+
+
+def _prefill_kernel(pt, qs, ql, kl, q, k, v, o, m, l, acc, **kw):
+    _prefill_kernel_body(pt, qs, ql, kl, q, k, v, None, None, o, m, l, acc, **kw)
+
+
+def _prefill_kernel_int8(pt, qs, ql, kl, q, k, ks, v, vs, o, m, l, acc, **kw):
+    _prefill_kernel_body(pt, qs, ql, kl, q, k, v, ks, vs, o, m, l, acc, **kw)
 
 
 def prefill_paged_attention_sharded(
@@ -135,6 +151,8 @@ def prefill_paged_attention_sharded(
 
     heads = P(None, None, axis_name, None, None)
     pool = P(axis_name, None, None, None)
+    if isinstance(k_pool_l, dict):  # int8 KV: scales shard like the pool
+        pool = {"q": pool, "s": P(axis_name, None, None)}
     fn = jax.shard_map(
         functools.partial(prefill_paged_attention, q_block=q_block, interpret=interpret),
         mesh=mesh,
@@ -161,7 +179,9 @@ def prefill_paged_attention(
     """Returns [B, S, Hk, G, D]; padding rows (s >= q_len[b]) return 0.
     The chunk's own K/V must already be written to the pool."""
     B, S, Hk, G, D = q.shape
-    _, NP, PS, _ = k_pool_l.shape
+    quantized = isinstance(k_pool_l, dict)
+    kq = k_pool_l["q"] if quantized else k_pool_l
+    _, NP, PS, _ = kq.shape
     MP = page_table.shape[1]
     q_block = min(q_block, S)
     while S % q_block:  # largest divisor of S at most the requested block
@@ -170,10 +190,6 @@ def prefill_paged_attention(
     scale = D**-0.5
 
     qt = q.transpose(0, 2, 1, 3, 4)  # [B, Hk, S, G, D]
-
-    kernel = functools.partial(
-        _prefill_kernel, page_size=PS, q_block=q_block, n_groups=G, scale=scale
-    )
 
     def kv_index(b, sb, i, pt, qs, ql, kl):
         # clamp to the last page this q-block can causally see (and within
@@ -184,16 +200,28 @@ def prefill_paged_attention(
         last = jnp.clip(last, 0, MP - 1)
         return (0, pt[b, jnp.minimum(i, last)], 0, 0)
 
+    def scale_index(b, sb, i, pt, qs, ql, kl):
+        return kv_index(b, sb, i, pt, qs, ql, kl)[:3]
+
+    q_spec = pl.BlockSpec(
+        (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
+    )
+    kv_spec = pl.BlockSpec((Hk, None, PS, D), kv_index)
+    kw = dict(page_size=PS, q_block=q_block, n_groups=G, scale=scale)
+    if quantized:
+        kernel = functools.partial(_prefill_kernel_int8, **kw)
+        s_spec = pl.BlockSpec((Hk, None, PS), scale_index)
+        in_specs = [q_spec, kv_spec, s_spec, kv_spec, s_spec]
+        operands = (qt, kq, k_pool_l["s"], v_pool_l["q"], v_pool_l["s"])
+    else:
+        kernel = functools.partial(_prefill_kernel, **kw)
+        in_specs = [q_spec, kv_spec, kv_spec]
+        operands = (qt, kq, v_pool_l)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,  # page_table, q_start, q_len, kv_lens
         grid=(B, n_sblk, MP),
-        in_specs=[
-            pl.BlockSpec(
-                (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
-            ),
-            pl.BlockSpec((Hk, None, PS, D), kv_index),
-            pl.BlockSpec((Hk, None, PS, D), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
         ),
@@ -209,5 +237,5 @@ def prefill_paged_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hk, S, G, D), q.dtype),
         interpret=interpret,
-    )(page_table, q_start, q_len, kv_lens, qt, k_pool_l, v_pool_l)
+    )(page_table, q_start, q_len, kv_lens, *operands)
     return out.transpose(0, 2, 1, 3, 4)  # [B, S, Hk, G, D]

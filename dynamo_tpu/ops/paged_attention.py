@@ -34,14 +34,14 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _decode_kernel(
-    # scalar prefetch
+def _decode_kernel_body(
     page_table_ref,  # [B, MP] int32 (SMEM)
     kv_lens_ref,  # [B] int32 (SMEM)
-    # blocks
     q_ref,  # [Hk, G, D] all query heads for seq b
     k_ref,  # [Hk, PS, D] one page of keys (all heads)
     v_ref,  # [Hk, PS, D]
+    ks_ref,  # [Hk, PS] f32 per-vector K scales (int8 KV) or None
+    vs_ref,  # [Hk, PS] f32 per-vector V scales or None
     o_ref,  # [Hk, G, D]
     # scratch (persist across the page loop)
     m_ref,  # [Hk, G, 1] f32 running max
@@ -72,6 +72,11 @@ def _decode_kernel(
         s = lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale  # [Hk, G, PS]
+        if ks_ref is not None:
+            # int8 KV: fold the per-(token, head) K scale into the scores
+            # instead of dequantizing K over D (one [Hk, 1, PS] multiply
+            # replaces a [Hk, PS, D] one)
+            s = s * ks_ref[...][:, None, :]
         valid = lax.broadcasted_iota(jnp.int32, s.shape, 2) < n_valid
         s = jnp.where(valid, s, NEG_INF)
 
@@ -80,18 +85,35 @@ def _decode_kernel(
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [Hk, G, PS]
         alpha = jnp.exp(m_prev - m_new)
 
+        l_add = jnp.sum(p, axis=2, keepdims=True)  # BEFORE any V scaling:
+        # the softmax denominator sums raw probabilities
+        if vs_ref is not None:
+            # fold the V scale into p before the PV matmul (same trick)
+            p = p * vs_ref[...][:, None, :]
         v = v_ref[...].astype(jnp.float32)  # [Hk, PS, D]
         pv = lax.dot_general(
             p, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
         )  # [Hk, G, D]
         acc_ref[...] = acc_ref[...] * alpha + pv
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        l_ref[...] = l_ref[...] * alpha + l_add
         m_ref[...] = m_new
 
     @pl.when(i == n_pages - 1)
     def _finalize():
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _decode_kernel(pt, kl, q, k, v, o, m, l, acc, *, page_size, scale):
+    _decode_kernel_body(
+        pt, kl, q, k, v, None, None, o, m, l, acc, page_size=page_size, scale=scale
+    )
+
+
+def _decode_kernel_int8(pt, kl, q, k, ks, v, vs, o, m, l, acc, *, page_size, scale):
+    _decode_kernel_body(
+        pt, kl, q, k, v, ks, vs, o, m, l, acc, page_size=page_size, scale=scale
+    )
 
 
 def decode_paged_attention_sharded(
@@ -113,6 +135,8 @@ def decode_paged_attention_sharded(
 
     heads = P(None, axis_name, None, None)
     pool = P(axis_name, None, None, None)
+    if isinstance(k_pool_l, dict):  # int8 KV: scales shard like the pool
+        pool = {"q": pool, "s": P(axis_name, None, None)}
     rep2 = P(None, None)
     rep1 = P(None)
     fn = jax.shard_map(
@@ -138,11 +162,11 @@ def decode_paged_attention(
     """Returns [B, Hk, G, D]. KV for the current token must already be
     written to the pool (same contract as paged_attention_jnp)."""
     B, Hk, G, D = q.shape
-    _, NP, PS, _ = k_pool_l.shape
+    quantized = isinstance(k_pool_l, dict)
+    kq = k_pool_l["q"] if quantized else k_pool_l
+    _, NP, PS, _ = kq.shape
     MP = page_table.shape[1]
     scale = D**-0.5
-
-    kernel = functools.partial(_decode_kernel, page_size=PS, scale=scale)
 
     def kv_index(b, i, pt, kl):
         # clamp past-the-end pages to the last valid page: the block index
@@ -152,14 +176,25 @@ def decode_paged_attention(
         last = jnp.maximum(kl[b] - 1, 0) // PS
         return (0, pt[b, jnp.minimum(i, last)], 0, 0)
 
+    def scale_index(b, i, pt, kl):
+        return kv_index(b, i, pt, kl)[:3]
+
+    q_spec = pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0))
+    kv_spec = pl.BlockSpec((Hk, None, PS, D), kv_index)
+    if quantized:
+        kernel = functools.partial(_decode_kernel_int8, page_size=PS, scale=scale)
+        s_spec = pl.BlockSpec((Hk, None, PS), scale_index)
+        in_specs = [q_spec, kv_spec, s_spec, kv_spec, s_spec]
+        operands = (q, kq, k_pool_l["s"], v_pool_l["q"], v_pool_l["s"])
+    else:
+        kernel = functools.partial(_decode_kernel, page_size=PS, scale=scale)
+        in_specs = [q_spec, kv_spec, kv_spec]
+        operands = (q, kq, v_pool_l)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, kv_lens
         grid=(B, MP),
-        in_specs=[
-            pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0)),
-            pl.BlockSpec((Hk, None, PS, D), kv_index),
-            pl.BlockSpec((Hk, None, PS, D), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hk, G, 1), jnp.float32),
@@ -173,5 +208,5 @@ def decode_paged_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hk, G, D), q.dtype),
         interpret=interpret,
-    )(page_table, kv_lens, q, k_pool_l, v_pool_l)
+    )(page_table, kv_lens, *operands)
     return out

@@ -134,6 +134,15 @@ class ShardingPolicy:
     def kv_pool_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.kv_pool_spec())
 
+    def kv_pool_sharding_tree(self, pool):
+        """Sharding for a pool that may be a plain array or an int8-KV
+        dict {"q": [L,Hk,NP,PS,D], "s": [L,Hk,NP,PS]} — scales shard over
+        the same kv-head axis as the data."""
+        scale = NamedSharding(self.mesh, P(None, AXIS_MODEL, None, None))
+        return jax.tree.map(
+            lambda a: self.kv_pool_sharding() if a.ndim == 5 else scale, pool
+        )
+
     # -- activations -------------------------------------------------------
     def batch_spec(self) -> P:
         return P(AXIS_DATA)  # [B, ...] sharded over data axis

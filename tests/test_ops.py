@@ -133,3 +133,82 @@ def test_prefill_paged_attention_sharded_matches_reference():
             np.asarray(out[b, : ql[b]], np.float32) - np.asarray(ref[b, : ql[b]], np.float32)
         ).max()
         assert d < 3e-2, (b, d)
+
+
+# -- int8 KV pools (models/quant.py KV convention) --------------------------
+def _q_pools(kp, vp):
+    from dynamo_tpu.models.quant import kv_quantize
+
+    return kv_quantize(kp), kv_quantize(vp)
+
+
+@pytest.mark.parametrize("kv_lens", [[5, 17, 32, 1], [32, 32, 32, 32]])
+def test_decode_paged_attention_int8_kv(kv_lens):
+    """Quantized-pool kernel == jnp path on the same quantized pools, and
+    both stay within the int8 rounding envelope of the bf16 reference."""
+    rng = np.random.default_rng(11)
+    B, Hk, G, D, NP, PS, MP = 4, 2, 4, 64, 16, 8, 4
+    q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    kv = jnp.asarray(np.asarray(kv_lens, np.int32))
+    kq, vq = _q_pools(kp, vp)
+
+    out = decode_paged_attention(q, kq, vq, pt, kv, interpret=True)
+    ref_q = paged_attention_jnp(q[:, None], kq, vq, pt, (kv - 1)[:, None], kv)[:, 0]
+    d = np.abs(np.asarray(out, np.float32) - np.asarray(ref_q, np.float32)).max()
+    assert d < 3e-2, d
+
+    ref = paged_attention_jnp(q[:, None], kp, vp, pt, (kv - 1)[:, None], kv)[:, 0]
+    d_bf16 = np.abs(np.asarray(out, np.float32) - np.asarray(ref, np.float32)).max()
+    assert d_bf16 < 8e-2, d_bf16
+
+
+def test_prefill_paged_attention_int8_kv():
+    rng = np.random.default_rng(12)
+    B, S, Hk, G, D, NP, PS, MP = 2, 16, 2, 3, 64, 16, 8, 8
+    q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = np.asarray([24, 0], np.int32)
+    ql = np.asarray([16, 11], np.int32)
+    kv = jnp.asarray(qs + ql)
+    kq, vq = _q_pools(kp, vp)
+
+    out = prefill_paged_attention(
+        q, kq, vq, pt, jnp.asarray(qs), jnp.asarray(ql), kv, q_block=8,
+        interpret=True,
+    )
+    pos = np.full((B, S), 0, np.int32)
+    for b in range(B):
+        pos[b, : ql[b]] = np.arange(qs[b], qs[b] + ql[b])
+    ref_q = paged_attention_jnp(q, kq, vq, pt, jnp.asarray(pos), kv)
+    for b in range(B):
+        d = np.abs(
+            np.asarray(out[b, : ql[b]], np.float32)
+            - np.asarray(ref_q[b, : ql[b]], np.float32)
+        ).max()
+        assert d < 3e-2, (b, d)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs multi-device mesh")
+def test_decode_paged_attention_sharded_int8_kv():
+    from dynamo_tpu.ops.paged_attention import decode_paged_attention_sharded
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    rng = np.random.default_rng(13)
+    B, Hk, G, D, NP, PS, MP = 4, 2, 4, 64, 40, 8, 8
+    mesh = make_mesh(MeshConfig(model=2))
+    q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    kv = jnp.asarray(np.array([5, 17, 32, 64], np.int32))
+    kq, vq = _q_pools(kp, vp)
+
+    out = decode_paged_attention_sharded(q, kq, vq, pt, kv, mesh, interpret=True)
+    ref_q = paged_attention_jnp(q[:, None], kq, vq, pt, (kv - 1)[:, None], kv)[:, 0]
+    d = np.abs(np.asarray(out, np.float32) - np.asarray(ref_q, np.float32)).max()
+    assert d < 3e-2, d
