@@ -492,9 +492,7 @@ class ModelRunner:
         if isinstance(sel, dict):
             from dynamo_tpu.models.quant import kv_dequantize
 
-            return kv_dequantize(
-                {"q": sel["q"], "s": sel["s"]}, dtype=self.dtype
-            )
+            return kv_dequantize(sel, dtype=self.dtype)
         return sel
 
     def _store_pages(self, pool, idx, dense):

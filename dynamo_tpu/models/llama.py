@@ -153,10 +153,12 @@ def paged_attention_jnp(
     attention over other context stays exact)."""
     def gather(pool_l, dtype):
         if isinstance(pool_l, dict):  # int8 KV (models/quant.py): dequant
-            # rides the gather; XLA fuses the cast+scale into operand load
-            g = pool_l["q"][:, page_table].astype(dtype)
-            s = pool_l["s"][:, page_table].astype(dtype)[..., None]
-            pool_l = g * s
+            # rides the gather; XLA fuses the cast+scale into operand load.
+            # Multiply in f32 (scales are f32) so this path and the Pallas
+            # kernels apply identical scale math, then cast the product.
+            g = pool_l["q"][:, page_table].astype(jnp.float32)
+            s = pool_l["s"][:, page_table][..., None]
+            pool_l = (g * s).astype(dtype)
         else:
             pool_l = pool_l[:, page_table]
         Hk, B, MP, PS, Dh = pool_l.shape

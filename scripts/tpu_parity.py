@@ -1,0 +1,116 @@
+"""Hardware kernel-parity gate: compiled Pallas kernels vs the jnp
+reference paths ON THE REAL TPU (the CPU suite only exercises interpret
+mode — compiled Mosaic lowering is a different code path and must be
+revalidated whenever a chip is available; VERDICT r1 weak #9).
+
+Checks, each compiled and executed on the default (non-CPU) backend:
+  1. decode paged attention bf16      vs paged_attention_jnp
+  2. decode paged attention int8 KV   vs jnp on the same quantized pools
+  3. prefill flash attention bf16     vs paged_attention_jnp
+  4. prefill flash attention int8 KV  vs jnp on the same quantized pools
+
+Exit 0 = all parities within tolerance; nonzero = mismatch (printed).
+Run via `python scripts/tpu_parity.py` with no JAX_PLATFORMS override, or
+through tests/test_tpu_hw.py (DYN_TPU_TESTS=1 pytest tests/test_tpu_hw.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# sitecustomize pre-imports jax pinned to the axon TPU relay; honor an
+# explicit JAX_PLATFORMS override (the relay can wedge when the chip is
+# down, so CPU sanity runs must never touch it)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.llama import paged_attention_jnp
+from dynamo_tpu.models.quant import kv_quantize
+from dynamo_tpu.ops.flash_prefill import prefill_paged_attention
+from dynamo_tpu.ops.paged_attention import decode_paged_attention
+
+TOL = 3e-2
+
+
+def _pools(rng, Hk, NP, PS, D):
+    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    return kp, vp
+
+
+def check_decode(quantized: bool) -> float:
+    rng = np.random.default_rng(0)
+    B, Hk, G, D, NP, PS, MP = 8, 8, 3, 128, 72, 64, 8
+    q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
+    kp, vp = _pools(rng, Hk, NP, PS, D)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    kv = jnp.asarray(rng.integers(1, MP * PS, B).astype(np.int32))
+    if quantized:
+        kp, vp = kv_quantize(kp), kv_quantize(vp)
+    out = decode_paged_attention(q, kp, vp, pt, kv)
+    ref = paged_attention_jnp(q[:, None], kp, vp, pt, (kv - 1)[:, None], kv)[:, 0]
+    return float(
+        np.abs(np.asarray(out, np.float32) - np.asarray(ref, np.float32)).max()
+    )
+
+
+def check_prefill(quantized: bool) -> float:
+    rng = np.random.default_rng(1)
+    B, S, Hk, G, D, NP, PS, MP = 4, 128, 8, 3, 128, 40, 64, 8
+    q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
+    kp, vp = _pools(rng, Hk, NP, PS, D)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = np.asarray([0, 64, 128, 0], np.int32)
+    ql = np.asarray([128, 128, 100, 77], np.int32)
+    kv = jnp.asarray(qs + ql)
+    if quantized:
+        kp, vp = kv_quantize(kp), kv_quantize(vp)
+    out = prefill_paged_attention(
+        q, kp, vp, pt, jnp.asarray(qs), jnp.asarray(ql), kv
+    )
+    pos = np.zeros((B, S), np.int32)
+    for b in range(B):
+        pos[b, : ql[b]] = np.arange(qs[b], qs[b] + ql[b])
+    ref = paged_attention_jnp(q, kp, vp, pt, jnp.asarray(pos), kv)
+    worst = 0.0
+    for b in range(B):
+        worst = max(
+            worst,
+            float(
+                np.abs(
+                    np.asarray(out[b, : ql[b]], np.float32)
+                    - np.asarray(ref[b, : ql[b]], np.float32)
+                ).max()
+            ),
+        )
+    return worst
+
+
+def main() -> int:
+    platform = jax.devices()[0].platform
+    print(f"backend: {platform} ({jax.devices()})")
+    if platform == "cpu":
+        print("SKIP: no accelerator backend (this gate checks compiled Mosaic)")
+        return 0
+    failures = 0
+    for name, fn in (
+        ("decode bf16", lambda: check_decode(False)),
+        ("decode int8-kv", lambda: check_decode(True)),
+        ("prefill bf16", lambda: check_prefill(False)),
+        ("prefill int8-kv", lambda: check_prefill(True)),
+    ):
+        d = fn()
+        ok = d < TOL
+        failures += 0 if ok else 1
+        print(f"{'PASS' if ok else 'FAIL'} {name}: max|Δ|={d:.4f} (tol {TOL})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,9 @@ def test_kv_quantized_transfer_boundary_roundtrip():
     _generate(r, prompt, n=3)  # populate some pages
     payload = r.export_pages([0, 1])
     k0 = np.asarray(jax.device_get(r._dense_pages(r.k_pool, jnp.asarray([0, 1]))))
+    v0 = np.asarray(jax.device_get(r._dense_pages(r.v_pool, jnp.asarray([0, 1]))))
     r.import_pages([4, 5], 0, payload)
     k1 = np.asarray(jax.device_get(r._dense_pages(r.k_pool, jnp.asarray([4, 5]))))
+    v1 = np.asarray(jax.device_get(r._dense_pages(r.v_pool, jnp.asarray([4, 5]))))
     assert np.abs(k0.astype(np.float32) - k1.astype(np.float32)).max() < 0.1
+    assert np.abs(v0.astype(np.float32) - v1.astype(np.float32)).max() < 0.1
