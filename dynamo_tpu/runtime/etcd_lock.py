@@ -1,0 +1,181 @@
+"""Distributed read-write lock over etcd transactions.
+
+TPU-native analog of the reference's DistributedRWLock
+(lib/runtime/src/transports/etcd/lock.rs:87-230): writer exclusivity via an
+atomic version-compare txn on `{prefix}/writer`, shared readers under
+`{prefix}/readers/{id}`, every key bound to the holder's lease so a crashed
+holder releases automatically when its lease expires. Used by HA control
+paths (e.g. single-writer planner execution, router snapshot election).
+
+Semantics match the reference:
+- try_write_lock: txn-create writer key if version==0, then verify no
+  readers (rollback if any). Non-blocking; returns None on contention.
+- write_lock / read_lock: 100ms polling with a deadline.
+- read locks exclude the writer atomically (txn: writer version==0 →
+  put reader key); multiple readers coexist.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import time
+import uuid
+from typing import Optional
+
+from dynamo_tpu.runtime.etcd import EtcdDiscovery, _b64, _prefix_end
+
+POLL_S = 0.1
+DEFAULT_TIMEOUT_S = 5.0
+
+
+class LockGuard:
+    """Releases the held key on __aexit__/release; the lease releases it
+    if the holder dies first."""
+
+    def __init__(self, lock: "DistributedRWLock", key: str, token: str):
+        self._lock = lock
+        self._key = key
+        self._token = token
+        self._released = False
+
+    async def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        # guarded delete: only remove the key if it still holds OUR token.
+        # A stale ex-holder (lease expired during a pause, key re-acquired
+        # by someone else) must not delete the current holder's lock —
+        # unconditional delete would hand the mutex to a third party.
+        await self._lock._etcd._post(
+            "/v3/kv/txn",
+            {
+                "compare": [
+                    {
+                        "key": _b64(self._key),
+                        "target": "VALUE",
+                        "result": "EQUAL",
+                        "value": _b64(self._token),
+                    }
+                ],
+                "success": [
+                    {"request_delete_range": {"key": _b64(self._key)}}
+                ],
+                "failure": [],
+            },
+        )
+
+    async def __aenter__(self) -> "LockGuard":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.release()
+
+
+class DistributedRWLock:
+    def __init__(self, etcd: EtcdDiscovery, prefix: str):
+        self._etcd = etcd
+        self.prefix = f"locks/{prefix}"
+        self.writer_key = f"{self.prefix}/writer"
+        self.reader_prefix = f"{self.prefix}/readers/"
+
+    async def _txn_create(self, key: str, value: str) -> bool:
+        """Atomically create `key` (only if absent) bound to our lease."""
+        lease = await self._etcd._lease()
+        out = await self._etcd._post(
+            "/v3/kv/txn",
+            {
+                "compare": [
+                    {
+                        "key": _b64(key),
+                        "target": "VERSION",
+                        "result": "EQUAL",
+                        "version": "0",
+                    }
+                ],
+                "success": [
+                    {
+                        "request_put": {
+                            "key": _b64(key),
+                            "value": _b64(value),
+                            "lease": str(lease),
+                        }
+                    }
+                ],
+                "failure": [],
+            },
+        )
+        return bool(out.get("succeeded"))
+
+    async def _reader_count(self) -> int:
+        out = await self._etcd._post(
+            "/v3/kv/range",
+            {
+                "key": _b64(self.reader_prefix),
+                "range_end": _prefix_end(self.reader_prefix),
+                "count_only": True,
+            },
+        )
+        return int(out.get("count", len(out.get("kvs") or [])))
+
+    async def try_write_lock(self) -> Optional[LockGuard]:
+        """Non-blocking exclusive acquire; None if a writer or readers
+        exist. (Same sub-ms create-then-check window as the reference.)"""
+        token = f"writing:{uuid.uuid4().hex}"
+        if not await self._txn_create(self.writer_key, token):
+            return None
+        guard = LockGuard(self, self.writer_key, token)
+        if await self._reader_count() > 0:
+            await guard.release()  # rollback
+            return None
+        return guard
+
+    async def write_lock(self, timeout: Optional[float] = None) -> LockGuard:
+        deadline = time.monotonic() + (timeout or DEFAULT_TIMEOUT_S)
+        while True:
+            guard = await self.try_write_lock()
+            if guard is not None:
+                return guard
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"write lock {self.prefix} not acquired")
+            await asyncio.sleep(POLL_S)
+
+    async def read_lock(
+        self, reader_id: Optional[str] = None, timeout: Optional[float] = None
+    ) -> LockGuard:
+        """Shared acquire: atomically excludes the writer, coexists with
+        other readers."""
+        reader_id = reader_id or uuid.uuid4().hex[:12]
+        key = self.reader_prefix + reader_id
+        token = f"reading:{uuid.uuid4().hex}"
+        deadline = time.monotonic() + (timeout or DEFAULT_TIMEOUT_S)
+        while True:
+            lease = await self._etcd._lease()
+            out = await self._etcd._post(
+                "/v3/kv/txn",
+                {
+                    "compare": [
+                        {
+                            "key": _b64(self.writer_key),
+                            "target": "VERSION",
+                            "result": "EQUAL",
+                            "version": "0",
+                        }
+                    ],
+                    "success": [
+                        {
+                            "request_put": {
+                                "key": _b64(key),
+                                "value": _b64(token),
+                                "lease": str(lease),
+                            }
+                        }
+                    ],
+                    "failure": [],
+                },
+            )
+            if out.get("succeeded"):
+                return LockGuard(self, key, token)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"read lock {self.prefix} not acquired")
+            await asyncio.sleep(POLL_S)

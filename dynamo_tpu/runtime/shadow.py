@@ -1,0 +1,175 @@
+"""Shadow (active/passive) engine failover.
+
+TPU-native analog of the reference's Shadow Engine Failover
+(docs/kubernetes/shadow-engine-failover.md): a standby worker pays the
+expensive startup — weight load (orbax fast-restart snapshot), jit
+compilation, KV-pool allocation — up front, then waits WITHOUT serving.
+When the active instance's discovery record disappears (lease expiry on
+crash, delete on shutdown), the shadow promotes itself by registering the
+already-warm engine, so recovery skips the model (re)load exactly like the
+reference's GMS-attached standby skips it on GPU.
+
+The reference gates promotion on GPU Memory Service + DRA (same-node
+weight residency); on TPU the warm state is the shadow's own HBM, so the
+shadow is a full process and promotion is a discovery-record flip.
+
+Standbys register a `standby/...` record (lease-bound) for observability:
+operators and the planner can see a shadow exists without it taking
+traffic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, Optional
+
+from dynamo_tpu.runtime.component import EndpointAddress, Instance, new_instance_id
+
+log = logging.getLogger("dynamo_tpu.runtime.shadow")
+
+
+class ShadowServer:
+    """Holds a warm engine; serves `path` only once no active instance
+    remains. `start()` returns immediately; `promoted` resolves when the
+    shadow went live (tests/await points)."""
+
+    def __init__(
+        self,
+        runtime,
+        path: str,
+        handler: Any = None,
+        metadata: Optional[Dict[str, Any]] = None,
+        poll_s: float = 0.25,
+        activate=None,  # async callable run on promotion instead of
+        #   serve_endpoint(handler) — lets a full worker (multiple
+        #   endpoints, publishers) arm itself as one shadow unit
+    ):
+        self.runtime = runtime
+        self.path = path
+        self.handler = handler
+        self.activate = activate
+        self.metadata = metadata or {}
+        self.poll_s = poll_s
+        self.promoted: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.instance: Optional[Instance] = None
+        self._standby: Optional[Instance] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        addr = EndpointAddress.parse(self.path)
+        # lease-bound standby record: visible, never routed (different
+        # discovery prefix than services/)
+        self._standby = Instance(
+            namespace=addr.namespace,
+            component=addr.component,
+            endpoint=addr.endpoint,
+            instance_id=new_instance_id(),
+            metadata={**self.metadata, "role": "shadow"},
+        )
+        # Instance.path is a property pinned to services/, so register a
+        # shallow proxy whose key lives under standby/ instead.
+        standby = _StandbyRecord(self._standby)
+        await self.runtime.discovery.register(standby)
+        self._task = asyncio.create_task(self._watch_loop(standby))
+
+    async def _watch_loop(self, standby) -> None:
+        """Track live actives via the discovery watch (push-style DELETE on
+        lease expiry — no poll load, failover latency = event latency).
+        Promotion requires having SEEN an active first: a shadow that wins
+        the startup race against its active must not grab the slot (that
+        would yield two actives and no standby). Transient discovery errors
+        retry with backoff — a shadow that silently stops watching is a
+        fleet with no failover."""
+        prefix = f"services/{self.path}/"
+        while True:
+            seen_active = False
+            alive: set = set()
+            try:
+                async for ev in self.runtime.discovery.watch(prefix):
+                    if ev.kind == "put":
+                        seen_active = True
+                        alive.add(ev.instance.instance_id)
+                    else:
+                        alive.discard(ev.instance.instance_id)
+                    if seen_active and not alive:
+                        await self._promote(standby)
+                        return
+                # watch stream ended without promotion: resync and retry
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if self.promoted.done():
+                    return  # promotion already happened/failed terminally
+                log.warning(
+                    "shadow watch for %s errored (%s); retrying", self.path, e
+                )
+            await asyncio.sleep(self.poll_s)
+
+    async def _promote(self, standby) -> None:
+        log.warning("shadow promoting for %s (active gone)", self.path)
+        for attempt in range(3):  # a stale standby record misleads the
+            # planner/operators, so retry the unregister briefly; the
+            # lease bound to it still reaps it if all retries fail
+            try:
+                await self.runtime.discovery.unregister(standby)
+                break
+            except Exception:
+                await asyncio.sleep(0.2 * (attempt + 1))
+        try:
+            if self.activate is not None:
+                self.instance = await self.activate()
+            else:
+                self.instance = await self.runtime.serve_endpoint(
+                    self.path, self.handler, metadata=self.metadata
+                )
+        except Exception as e:
+            log.exception("shadow promotion for %s FAILED", self.path)
+            if not self.promoted.done():
+                self.promoted.set_exception(e)
+            raise
+        if not self.promoted.done():
+            self.promoted.set_result(self.instance)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+
+class _StandbyRecord:
+    """Instance proxy whose discovery key lives under standby/ instead of
+    services/, so clients and routers never select it."""
+
+    def __init__(self, inst: Instance):
+        self._inst = inst
+
+    @property
+    def path(self) -> str:
+        i = self._inst
+        return (
+            f"standby/{i.namespace}/{i.component}/{i.endpoint}/{i.instance_id:x}"
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self._inst.to_dict()
+
+    def __getattr__(self, name):
+        return getattr(self._inst, name)
+
+
+async def serve_shadow(
+    runtime,
+    path: str,
+    handler: Any,
+    metadata: Optional[Dict[str, Any]] = None,
+    poll_s: float = 0.25,
+) -> ShadowServer:
+    """Arm a shadow for `path`: engine stays warm, promotion happens when
+    the last active instance disappears from discovery."""
+    s = ShadowServer(runtime, path, handler, metadata, poll_s)
+    await s.start()
+    return s

@@ -82,6 +82,10 @@ def parse_args(argv=None):
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")
+    p.add_argument("--shadow", action="store_true",
+                   help="active/passive failover: load+warm the engine but "
+                        "only register when the active worker's discovery "
+                        "record disappears (shadow-engine-failover analog)")
     p.add_argument("--vision", action="store_true",
                    help="serve a vision encoder (multimodal EPD): publishes "
                         "the encode endpoint + vision card info")
@@ -283,12 +287,36 @@ async def async_main(args) -> None:
         await status.start()
     from dynamo_tpu.worker_common import serve_worker
 
-    worker = await serve_worker(
-        runtime, engine, card,
-        namespace=args.namespace, component=args.component, endpoint=args.endpoint,
-        disagg_role=args.disagg_role,
-    )
-    print(f"worker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
+    path = f"{args.namespace}/{args.component}/{args.endpoint}"
+    shadow = None
+    worker = None
+    if args.shadow:
+        # active/passive failover (runtime/shadow.py): the engine above is
+        # already warm (weights + jit + pools); hold it out of discovery
+        # until the active worker's record disappears, then register — the
+        # restart skips the model load, matching the reference's
+        # shadow-engine-failover recovery path.
+        from dynamo_tpu.runtime.shadow import ShadowServer
+
+        async def _activate():
+            return await serve_worker(
+                runtime, engine, card,
+                namespace=args.namespace, component=args.component,
+                endpoint=args.endpoint, disagg_role=args.disagg_role,
+            )
+
+        shadow = ShadowServer(
+            runtime, path, activate=_activate, metadata={"model": card.name}
+        )
+        await shadow.start()
+        print(f"worker standing by as shadow for {path}", flush=True)
+    else:
+        worker = await serve_worker(
+            runtime, engine, card,
+            namespace=args.namespace, component=args.component, endpoint=args.endpoint,
+            disagg_role=args.disagg_role,
+        )
+        print(f"worker serving {card.name} at {path}", flush=True)
     try:
         stop_ev = asyncio.Event()
         import signal
@@ -304,7 +332,12 @@ async def async_main(args) -> None:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
-        await worker.stop()
+        if shadow is not None:
+            await shadow.stop()
+            if shadow.promoted.done() and shadow.promoted.exception() is None:
+                worker = shadow.promoted.result()
+        if worker is not None:
+            await worker.stop()
         if status is not None:
             await status.stop()
         await runtime.shutdown()

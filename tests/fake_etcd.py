@@ -1,7 +1,11 @@
 """Minimal in-process etcd v3 JSON-gateway for testing EtcdDiscovery:
-implements /v3/kv/{put,range,deleterange}, /v3/lease/{grant,keepalive,
+implements /v3/kv/{put,range,deleterange,txn}, /v3/lease/{grant,keepalive,
 revoke}, and streaming /v3/watch with lease-expiry deletes — the exact
-subset the backend speaks."""
+subset the backend + DistributedRWLock speak. txn supports VERSION
+compares with request_put/request_range/request_delete_range ops (the
+lock.rs acquisition pattern). start(port=...) allows restarting on the
+same address for etcd-HA fault injection (state is NOT kept across
+restarts — harsher than a real etcd restart, which persists its WAL)."""
 
 from __future__ import annotations
 
@@ -17,6 +21,7 @@ from aiohttp import web
 class FakeEtcd:
     def __init__(self):
         self.kv: Dict[bytes, Tuple[bytes, Optional[int]]] = {}  # key -> (value, lease)
+        self.versions: Dict[bytes, int] = {}  # key -> version (0 = absent)
         self.leases: Dict[int, Tuple[int, float]] = {}  # id -> (ttl, deadline)
         self._next_lease = 1000
         self.revision = 1
@@ -26,11 +31,12 @@ class FakeEtcd:
         self.port = None
 
     # -- lifecycle ----------------------------------------------------------
-    async def start(self) -> str:
+    async def start(self, port: int = 0) -> str:
         app = web.Application()
         app.router.add_post("/v3/kv/put", self._put)
         app.router.add_post("/v3/kv/range", self._range)
         app.router.add_post("/v3/kv/deleterange", self._delete)
+        app.router.add_post("/v3/kv/txn", self._txn)
         app.router.add_post("/v3/lease/grant", self._grant)
         app.router.add_post("/v3/lease/keepalive", self._keepalive)
         app.router.add_post("/v3/lease/revoke", self._revoke)
@@ -39,7 +45,7 @@ class FakeEtcd:
         # cleanup for the default 60s
         self._runner = web.AppRunner(app, shutdown_timeout=0.5)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
         self._expiry_task = asyncio.create_task(self._expire_loop())
@@ -58,8 +64,7 @@ class FakeEtcd:
                     del self.leases[lid]
                     for k, (v, lease) in list(self.kv.items()):
                         if lease == lid:
-                            del self.kv[k]
-                            self._notify("DELETE", k, b"")
+                            self._do_delete(k)
 
     # -- handlers -----------------------------------------------------------
     def _notify(self, typ: str, key: bytes, value: bytes) -> None:
@@ -70,35 +75,98 @@ class FakeEtcd:
             if lo <= key < hi:
                 q.put_nowait((typ, key, value, self.revision))
 
+    def _do_put(self, key: bytes, value: bytes, lease) -> None:
+        self.kv[key] = (value, int(lease) if lease else None)
+        self.versions[key] = self.versions.get(key, 0) + 1
+        self._notify("PUT", key, value)
+
+    def _do_delete(self, key: bytes) -> None:
+        if key in self.kv:
+            del self.kv[key]
+            self.versions[key] = 0
+            self._notify("DELETE", key, b"")
+
     async def _put(self, req):
         body = await req.json()
-        key = base64.b64decode(body["key"])
-        value = base64.b64decode(body["value"])
-        self.kv[key] = (value, body.get("lease"))
-        self._notify("PUT", key, value)
+        self._do_put(
+            base64.b64decode(body["key"]),
+            base64.b64decode(body["value"]),
+            body.get("lease"),
+        )
         return web.json_response({"header": {}})
 
     async def _range(self, req):
-        body = await req.json()
-        lo = base64.b64decode(body["key"])
-        hi = base64.b64decode(body.get("range_end", "")) if body.get("range_end") else lo + b"\x00"
-        kvs = [
-            {"key": base64.b64encode(k).decode(), "value": base64.b64encode(v).decode()}
-            for k, (v, _) in sorted(self.kv.items())
-            if lo <= k < hi
-        ]
-        return web.json_response({
-            "header": {"revision": str(self.revision)},
-            "kvs": kvs, "count": str(len(kvs)),
-        })
+        return web.json_response(self._range_result(await req.json()))
 
     async def _delete(self, req):
         body = await req.json()
-        key = base64.b64decode(body["key"])
-        if key in self.kv:
-            del self.kv[key]
-            self._notify("DELETE", key, b"")
+        self._do_delete(base64.b64decode(body["key"]))
         return web.json_response({"deleted": "1"})
+
+    def _range_result(self, body: dict) -> dict:
+        lo = base64.b64decode(body["key"])
+        hi = base64.b64decode(body.get("range_end", "")) if body.get("range_end") else lo + b"\x00"
+        hits = [(k, v) for k, (v, _) in sorted(self.kv.items()) if lo <= k < hi]
+        out = {
+            "header": {"revision": str(self.revision)},
+            "count": str(len(hits)),
+        }
+        if not body.get("count_only"):
+            out["kvs"] = [
+                {
+                    "key": base64.b64encode(k).decode(),
+                    "value": base64.b64encode(v).decode(),
+                    "version": str(self.versions.get(k, 0)),
+                }
+                for k, v in hits
+            ]
+        return out
+
+    async def _txn(self, req):
+        """etcd txn subset: VERSION compares + put/range/delete ops."""
+        body = await req.json()
+        ok = True
+        for cmp in body.get("compare") or []:
+            key = base64.b64decode(cmp["key"])
+            target = cmp.get("target", "VERSION")
+            if target == "VERSION":
+                want = int(cmp.get("version", 0))
+                have = self.versions.get(key, 0)
+            elif target == "VALUE":
+                want = base64.b64decode(cmp.get("value", ""))
+                have = self.kv.get(key, (b"", None))[0]
+            else:
+                return web.json_response({"error": "unsupported target"}, status=400)
+            result = cmp.get("result", "EQUAL")
+            if result == "EQUAL":
+                ok &= have == want
+            elif result == "NOT_EQUAL":
+                ok &= have != want
+            elif result == "GREATER":
+                ok &= have > want
+            elif result == "LESS":
+                ok &= have < want
+        responses = []
+        for op in body.get("success" if ok else "failure") or []:
+            if "request_put" in op:
+                p = op["request_put"]
+                self._do_put(
+                    base64.b64decode(p["key"]),
+                    base64.b64decode(p["value"]),
+                    p.get("lease"),
+                )
+                responses.append({"response_put": {}})
+            elif "request_range" in op:
+                responses.append(
+                    {"response_range": self._range_result(op["request_range"])}
+                )
+            elif "request_delete_range" in op:
+                self._do_delete(base64.b64decode(op["request_delete_range"]["key"]))
+                responses.append({"response_delete_range": {}})
+        return web.json_response(
+            {"header": {"revision": str(self.revision)},
+             "succeeded": ok, "responses": responses}
+        )
 
     async def _grant(self, req):
         body = await req.json()
@@ -123,8 +191,7 @@ class FakeEtcd:
         self.leases.pop(lid, None)
         for k, (v, lease) in list(self.kv.items()):
             if lease == lid:
-                del self.kv[k]
-                self._notify("DELETE", k, b"")
+                self._do_delete(k)
         return web.json_response({"header": {}})
 
     async def _watch(self, req):

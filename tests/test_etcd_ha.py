@@ -1,0 +1,215 @@
+"""etcd-HA + distributed lock + shadow failover (VERDICT r1 item 8;
+reference docs/fault-tolerance/README.md infrastructure layer,
+transports/etcd/lock.rs, docs/kubernetes/shadow-engine-failover.md).
+
+- DistributedRWLock: writer exclusivity, reader sharing, crash release
+  via lease expiry.
+- etcd gateway restart: a serving runtime re-registers (lease recovery)
+  and a watching client resyncs; requests flow again afterwards.
+- ShadowServer: a warm standby promotes when the active dies, and a
+  client request completes against the promoted instance.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import EchoEngine
+from dynamo_tpu.runtime.etcd import EtcdDiscovery
+from dynamo_tpu.runtime.etcd_lock import DistributedRWLock
+from dynamo_tpu.runtime.shadow import ShadowServer
+
+from fake_etcd import FakeEtcd
+
+
+async def _start_etcd(port=0):
+    server = FakeEtcd()
+    url = await server.start(port=port)
+    return server, url
+
+
+# -- DistributedRWLock ------------------------------------------------------
+async def test_write_lock_excludes_writers_and_readers():
+    server, url = await _start_etcd()
+    a = EtcdDiscovery(url, lease_ttl=5)
+    b = EtcdDiscovery(url, lease_ttl=5)
+    try:
+        la, lb = DistributedRWLock(a, "m"), DistributedRWLock(b, "m")
+        g = await la.try_write_lock()
+        assert g is not None
+        assert await lb.try_write_lock() is None  # writer excluded
+        with pytest.raises(TimeoutError):
+            await lb.read_lock(timeout=0.3)  # reader excluded by writer
+        await g.release()
+        g2 = await lb.try_write_lock()
+        assert g2 is not None
+        await g2.release()
+    finally:
+        await a.close()
+        await b.close()
+        await server.stop()
+
+
+async def test_readers_share_and_block_writer():
+    server, url = await _start_etcd()
+    a = EtcdDiscovery(url, lease_ttl=5)
+    b = EtcdDiscovery(url, lease_ttl=5)
+    try:
+        la, lb = DistributedRWLock(a, "m"), DistributedRWLock(b, "m")
+        r1 = await la.read_lock(reader_id="r1")
+        r2 = await lb.read_lock(reader_id="r2")  # readers coexist
+        assert await lb.try_write_lock() is None  # readers block writer
+        await r1.release()
+        assert await lb.try_write_lock() is None  # one reader remains
+        await r2.release()
+        g = await lb.write_lock(timeout=2.0)
+        await g.release()
+    finally:
+        await a.close()
+        await b.close()
+        await server.stop()
+
+
+async def test_crashed_writer_releases_via_lease_expiry():
+    server, url = await _start_etcd()
+    a = EtcdDiscovery(url, lease_ttl=2)  # min ttl is 2s
+    b = EtcdDiscovery(url, lease_ttl=5)
+    try:
+        g = await DistributedRWLock(a, "m").try_write_lock()
+        assert g is not None
+        # "crash": no release, no heartbeat — lease expires server-side
+        lb = DistributedRWLock(b, "m")
+        g2 = await lb.write_lock(timeout=6.0)
+        await g2.release()
+    finally:
+        await a.close()
+        await b.close()
+        await server.stop()
+
+
+# -- etcd gateway restart (HA) ----------------------------------------------
+async def test_serving_survives_etcd_restart():
+    server, url = await _start_etcd()
+    port = server.port
+    wrt = DistributedRuntime(discovery=EtcdDiscovery(url, lease_ttl=3),
+                             event_transport="inproc")
+    frt = DistributedRuntime(discovery=EtcdDiscovery(url, lease_ttl=3),
+                             event_transport="inproc")
+    try:
+        await wrt.serve_endpoint("t/w/gen", EchoEngine())
+        client = frt.client("t/w/gen")
+        await client.wait_ready()
+        out = [x async for x in client.generate({"v": 1})]
+        assert out
+
+        # gateway goes down and comes back EMPTY (harsher than real etcd,
+        # which persists state): heartbeat must detect the lost lease and
+        # re-register, the client must re-resolve and succeed
+        await server.stop()
+        await asyncio.sleep(0.3)
+        server2 = FakeEtcd()
+        await server2.start(port=port)
+        for _ in range(80):  # heartbeat interval re-registers the worker
+            try:
+                insts = await frt.discovery.list_instances("services/t/w/gen/")
+                if insts:
+                    break
+            except Exception:
+                pass
+            await asyncio.sleep(0.25)
+        insts = await frt.discovery.list_instances("services/t/w/gen/")
+        assert insts, "worker did not re-register after etcd restart"
+        c2 = frt.client("t/w/gen")
+        await c2.wait_ready()
+        out = [x async for x in c2.generate({"v": 2})]
+        assert out
+        await server2.stop()
+    finally:
+        await wrt.shutdown()
+        await frt.shutdown()
+
+
+# -- shadow failover --------------------------------------------------------
+async def test_shadow_promotes_on_active_death_and_serves():
+    realm = "shadow-ha"
+    active = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                event_transport="inproc")
+    standby = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                 event_transport="inproc")
+    client_rt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                   event_transport="inproc")
+    try:
+        await active.serve_endpoint("t/w/gen", EchoEngine())
+        shadow = ShadowServer(
+            standby, "t/w/gen", handler=EchoEngine(), poll_s=0.1
+        )
+        await shadow.start()
+        await asyncio.sleep(0.3)
+        assert not shadow.promoted.done()  # no promotion while active lives
+        # standby record is visible for observability, never routed
+        sb = await client_rt.discovery.list_instances("standby/t/w/gen/")
+        assert len(sb) == 1 and sb[0].metadata.get("role") == "shadow"
+
+        c = client_rt.client("t/w/gen")
+        await c.wait_ready()
+        assert [x async for x in c.generate({"v": 1})]
+
+        await active.shutdown()  # active dies (unregisters)
+        inst = await asyncio.wait_for(shadow.promoted, timeout=5.0)
+        assert inst is not None
+        c2 = client_rt.client("t/w/gen")
+        await c2.wait_ready()
+        out = [x async for x in c2.generate({"v": 2})]
+        assert out
+        sb = await client_rt.discovery.list_instances("standby/t/w/gen/")
+        assert not sb  # standby record cleared on promotion
+    finally:
+        await standby.shutdown()
+        await client_rt.shutdown()
+
+
+async def test_stale_release_does_not_break_new_holder():
+    """A guard whose key was lease-expired and re-acquired by another
+    holder must not delete the new holder's lock on release."""
+    server, url = await _start_etcd()
+    a = EtcdDiscovery(url, lease_ttl=2)
+    b = EtcdDiscovery(url, lease_ttl=5)
+    try:
+        la, lb = DistributedRWLock(a, "m"), DistributedRWLock(b, "m")
+        g_a = await la.try_write_lock()
+        assert g_a is not None
+        g_b = await lb.write_lock(timeout=6.0)  # acquires after a's lease dies
+        await g_a.release()  # stale release: must be a no-op
+        assert await la.try_write_lock() is None  # b still holds it
+        await g_b.release()
+    finally:
+        await a.close()
+        await b.close()
+        await server.stop()
+
+
+async def test_shadow_does_not_promote_before_seeing_an_active():
+    """Startup race: shadow armed before the active registers must wait,
+    not steal the slot."""
+    realm = "shadow-race"
+    standby = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                 event_transport="inproc")
+    active = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                event_transport="inproc")
+    try:
+        shadow = ShadowServer(standby, "t/w/gen", handler=EchoEngine(), poll_s=0.05)
+        await shadow.start()
+        await asyncio.sleep(0.4)
+        assert not shadow.promoted.done()  # empty path != dead active
+
+        await active.serve_endpoint("t/w/gen", EchoEngine())
+        await asyncio.sleep(0.3)
+        assert not shadow.promoted.done()  # active alive
+
+        await active.shutdown()
+        inst = await asyncio.wait_for(shadow.promoted, timeout=5.0)
+        assert inst is not None
+    finally:
+        await standby.shutdown()
