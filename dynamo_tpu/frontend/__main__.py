@@ -25,9 +25,14 @@ def parse_args(argv=None):
     p.add_argument(
         "--router-mode",
         default="round_robin",
-        choices=["round_robin", "random", "kv"],
-        help="worker selection policy (kv = KV-cache-aware)",
+        choices=["round_robin", "random", "kv", "kv-remote"],
+        help="worker selection policy (kv = embedded KV-cache-aware "
+             "router; kv-remote = delegate to a standalone "
+             "dynamo_tpu.router.services selection service)",
     )
+    p.add_argument("--router-service", default=None,
+                   help="ns/component of the selection service for "
+                        "kv-remote mode (default {worker-ns}/kv-router)")
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--router-replica-sync", action="store_true",
                    help="broadcast router load deltas so parallel frontend "
@@ -59,6 +64,7 @@ async def async_main(args) -> None:
         migration_limit=args.migration_limit,
         disagg_min_prefill_tokens=args.disagg_min_prefill_tokens,
         session_affinity_ttl=args.session_affinity_ttl or None,
+        router_service=args.router_service,
     )
     svc = HttpService(
         runtime, manager, watcher, host=args.http_host, port=args.http_port,

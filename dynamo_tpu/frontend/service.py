@@ -87,10 +87,12 @@ class ModelWatcher:
         chain_factory=None,
         disagg_min_prefill_tokens: int = 256,
         session_affinity_ttl: Optional[float] = None,
+        router_service: Optional[str] = None,  # kv-remote: ns/component
     ):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
+        self.router_service = router_service
         self.router_replica_sync = router_replica_sync
         self.migration_limit = migration_limit
         self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
@@ -127,6 +129,19 @@ class ModelWatcher:
             )
             router_engine: AsyncEngine = KvPushRouter(kv_router)
             teardown = kv_router.stop
+        elif self.router_mode == "kv-remote":
+            # selection lives in a standalone KvRouterService
+            # (router/services.py); this frontend only pushes streams
+            from dynamo_tpu.router.services import (
+                SELECTION_COMPONENT,
+                RemoteKvRouter,
+            )
+
+            ns = client.path.split("/")[0]
+            base = self.router_service or f"{ns}/{SELECTION_COMPONENT}"
+            remote = RemoteKvRouter(self.runtime, client, base)
+            router_engine = remote
+            teardown = remote.close
         else:
             router_engine = _ClientEngine(client)
         if self.affinity is not None:

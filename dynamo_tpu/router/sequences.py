@@ -87,3 +87,14 @@ class ActiveSequences:
 
     def active_requests(self, worker: Worker) -> int:
         return self._count.get(worker, 0)
+
+    def active_count(self) -> int:
+        """Total in-flight bookings across all workers."""
+        return len(self._requests)
+
+    def stale_requests(self, ttl_s: float) -> list:
+        """Request ids booked longer than ttl_s ago. Remote callers
+        (router/services.py) can crash between reserve and free; their
+        phantom charges must be reaped or selection skews forever."""
+        cutoff = time.monotonic() - ttl_s
+        return [rid for rid, req in self._requests.items() if req.started < cutoff]

@@ -1,0 +1,352 @@
+"""Standalone KV router / indexer services.
+
+The reference ships the router's pieces as independently deployable
+services (lib/kv-router/src/services/: indexer = HTTP query server fed by
+worker KV events; selection = /select + /select_and_reserve composing the
+catalog, indexer and active-sequence accounting; python bindings
+run_kv_indexer, lib/bindings/python/rust/lib.rs:176). Here the same two
+roles run as request-plane endpoints discovered like any other component —
+frontends scale independently of routers, several frontends share one
+router's load view, and router replicas sync exactly as embedded ones do
+(KvRouter.replica_sync).
+
+Endpoints (component default `kv-router` / `kv-indexer`):
+- query            tokens/hashes -> multi-tier per-instance overlap counts
+                   (device + host tiers, Mooncake-style instances map)
+- select           query-only best worker (no booking)
+- select_and_reserve  books the request (active-sequence charge) and
+                   returns {reservation_id, instance_id, ...} + onboarding
+                   hint; the caller pushes to the worker itself
+- prefill_complete / free   lifecycle notifications for a reservation
+
+The frontend consumes a selection service via --router-mode kv-remote
+(RemoteKvRouter below): selection state lives in the service, streaming
+stays frontend->worker direct, so the router never touches token traffic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from dynamo_tpu.router.kv_router import KvRouter, KvRouterConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.router.services")
+
+SELECTION_COMPONENT = "kv-router"
+INDEXER_COMPONENT = "kv-indexer"
+
+
+class KvRouterService:
+    """Standalone selection service: one KvRouter owned by this process,
+    exposed over the request plane."""
+
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        workers_path: str,  # ns/component/endpoint of the worker fleet
+        block_size: int,
+        component: str = SELECTION_COMPONENT,
+        config: Optional[KvRouterConfig] = None,
+        replica_sync: bool = False,
+        indexer_only: bool = False,
+        reservation_ttl_s: float = 900.0,  # reap bookings whose frontend
+        #   died between reserve and free (the embedded router frees
+        #   in-process and never needs this)
+    ):
+        self.runtime = runtime
+        self.workers_path = workers_path
+        self.namespace = workers_path.split("/", 1)[0]
+        self.component = component
+        self.indexer_only = indexer_only
+        self.reservation_ttl_s = reservation_ttl_s
+        self._reaper: Optional[asyncio.Task] = None
+        self.router = KvRouter(
+            runtime,
+            runtime.client(workers_path),
+            block_size=block_size,
+            config=config,
+            replica_sync=replica_sync,
+        )
+        self._insts: List[Any] = []
+
+    # -- endpoint handlers (single-item streams) ---------------------------
+    async def query(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        """Multi-tier overlap query (reference standalone indexer /query,
+        services/indexer/mod.rs: per-instance gpu/cpu counts). Accepts
+        token_ids (hashed here) or pre-computed block_hashes."""
+        from dynamo_tpu.tokens.hashing import block_hashes, request_seed
+
+        hashes = request.get("block_hashes")
+        if hashes is None:
+            hashes = block_hashes(
+                request.get("token_ids") or [],
+                self.router.block_size,
+                request_seed(request.get("adapter"), request.get("mm_seed")),
+            )
+        idx = self.router.indexer
+        device = idx.index.find_matches(hashes)
+        host = idx.host_index.find_matches(hashes).scores
+        instances: Dict[str, Dict[str, Any]] = {}
+        for (iid, dp), n in device.scores.items():
+            e = instances.setdefault(f"{iid:x}", {"device": 0, "host": 0, "dp": {}})
+            e["device"] = max(e["device"], n)
+            e["dp"][str(dp)] = n
+        for (iid, dp), n in host.items():
+            e = instances.setdefault(f"{iid:x}", {"device": 0, "host": 0, "dp": {}})
+            e["host"] = max(e["host"], n)
+        yield {"blocks": len(hashes), "instances": instances}
+
+    async def select(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        yield self._select(request, reserve=False, rid=None)
+
+    async def select_and_reserve(
+        self, request: Dict[str, Any], context: Context
+    ) -> AsyncIterator[Any]:
+        rid = request.get("reservation_id") or uuid.uuid4().hex
+        yield self._select(request, reserve=True, rid=rid)
+
+    def _select(self, request: Dict[str, Any], reserve: bool, rid: Optional[str]) -> Dict[str, Any]:
+        from dynamo_tpu.tokens.hashing import request_seed
+
+        collect: Dict[str, Any] = {}
+        worker, overlap, hashes = self.router.find_best_match(
+            request.get("token_ids") or [],
+            adapter=request.get("adapter"),
+            mm_seed=request.get("mm_seed"),
+            pinned_instance=request.get("pinned_instance"),
+            collect=collect,
+        )
+        hint = self.router.remote_host_hint(
+            hashes, worker, overlap,
+            request_seed(request.get("adapter"), request.get("mm_seed")),
+            host_overlaps=collect.get("host_overlaps"),
+        )
+        out = {
+            "instance_id": worker[0],
+            "dp_rank": worker[1],
+            "overlap_blocks": overlap,
+            "blocks": len(hashes),
+        }
+        if hint is not None:
+            out["kv_remote_host"] = hint
+        if reserve:
+            self.router.add_request(rid, worker, hashes, overlap)
+            out["reservation_id"] = rid
+        return out
+
+    async def prefill_complete(
+        self, request: Dict[str, Any], context: Context
+    ) -> AsyncIterator[Any]:
+        self.router.mark_prefill_completed(request["reservation_id"])
+        yield {"ok": True}
+
+    async def free(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        self.router.free(request["reservation_id"])
+        yield {"ok": True}
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> None:
+        await self.router.start()
+        base = f"{self.namespace}/{self.component}"
+        meta = {
+            "workers_path": self.workers_path,
+            "block_size": self.router.block_size,
+            "role": "indexer" if self.indexer_only else "selection",
+        }
+        eps = [("query", self.query)]
+        if not self.indexer_only:
+            eps += [
+                ("select", self.select),
+                ("select_and_reserve", self.select_and_reserve),
+                ("prefill_complete", self.prefill_complete),
+                ("free", self.free),
+            ]
+        iid = None
+        for name, fn in eps:
+            inst = await self.runtime.serve_endpoint(
+                f"{base}/{name}", fn, metadata=meta, instance_id=iid
+            )
+            iid = inst.instance_id  # one instance id across our endpoints
+            self._insts.append(inst)
+        if not self.indexer_only and self.reservation_ttl_s:
+            self._reaper = asyncio.create_task(self._reap_loop())
+
+    async def _reap_loop(self) -> None:
+        while True:
+            await asyncio.sleep(max(self.reservation_ttl_s / 4, 1.0))
+            for rid in self.router.sequences.stale_requests(self.reservation_ttl_s):
+                log.warning("reaping stale reservation %s (ttl %.0fs)",
+                            rid, self.reservation_ttl_s)
+                self.router.free(rid)
+
+    async def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+            try:
+                await self._reaper
+            except (asyncio.CancelledError, Exception):
+                pass
+        # deregister before stopping the router: a discoverable endpoint
+        # backed by a stopped router hands out stale selections
+        for inst in self._insts:
+            try:
+                await self.runtime.discovery.unregister(inst)
+            except Exception:
+                pass
+        self._insts.clear()
+        await self.router.stop()
+
+
+class RemoteKvRouter:
+    """Frontend-side pipeline engine delegating selection to a standalone
+    KvRouterService; token streaming stays frontend->worker direct (same
+    shape as KvPushRouter, reference kv_push_router semantics)."""
+
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        worker_client,  # EndpointClient for the worker fleet
+        service_base: str,  # ns/component of the selection service
+    ):
+        self.runtime = runtime
+        self.client = worker_client
+        self.base = service_base
+        self._reserve = runtime.client(f"{service_base}/select_and_reserve")
+        self._prefill = runtime.client(f"{service_base}/prefill_complete")
+        self._free = runtime.client(f"{service_base}/free")
+        self._bg: set = set()  # fire-and-forget notification tasks
+
+    def _notify(self, client, payload: Dict[str, Any]) -> None:
+        """Bookkeeping RPCs must not sit on the token path: awaiting
+        prefill_complete before yielding the first item would add a full
+        service round trip to every request's TTFT."""
+        t = asyncio.create_task(self._call(client, payload))
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+        # retrieve the exception (losses are fine: the service-side
+        # reservation TTL reaper covers a dropped free/prefill_complete)
+        t.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception()
+        )
+
+    async def _call(self, client, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if not client._ready.is_set():
+            await client.wait_ready()
+        async for item in client.generate(payload):
+            return item
+        raise RuntimeError(f"empty response from {client.path}")
+
+    async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        await self.client.start()
+        payload: Dict[str, Any] = {
+            "token_ids": request.get("token_ids") or [],
+            "adapter": request.get("adapter"),
+        }
+        mm = request.get("mm")
+        if mm:
+            from dynamo_tpu.tokens.hashing import mm_content_seed
+
+            # hash mm content locally — only the seed crosses the wire,
+            # never the (MB-scale) payload
+            payload["mm_seed"] = mm_content_seed(mm["data"])
+        pinned = context.metadata.get("target_instance")
+        if pinned is not None:
+            payload["pinned_instance"] = pinned
+        sel = await self._call(self._reserve, payload)
+        rid = sel["reservation_id"]
+        if sel.get("kv_remote_host") is not None:
+            request = dict(request)
+            request["kv_remote_host"] = sel["kv_remote_host"]
+        context.metadata["kv_overlap_blocks"] = sel["overlap_blocks"]
+        context.metadata["routed_instance"] = sel["instance_id"]
+        first = True
+        try:
+            async for item in self.client.direct(
+                request, sel["instance_id"], context
+            ):
+                if first:
+                    first = False
+                    self._notify(self._prefill, {"reservation_id": rid})
+                yield item
+        finally:
+            self._notify(self._free, {"reservation_id": rid})
+
+    async def close(self) -> None:
+        if self._bg:  # let in-flight free/prefill notifications land
+            await asyncio.gather(*list(self._bg), return_exceptions=True)
+        for c in (self._reserve, self._prefill, self._free):
+            try:
+                await c.close()
+            except Exception:
+                pass
+
+
+def parse_args(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        "dynamo_tpu.router.service",
+        description="standalone KV selection/indexer service",
+    )
+    p.add_argument("--role", default="selection", choices=["selection", "indexer"])
+    p.add_argument("--workers", default="dyn/tpu-worker/generate",
+                   help="ns/component/endpoint of the worker fleet")
+    p.add_argument("--component", default=None,
+                   help="service component name (default kv-router/kv-indexer)")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--replica-sync", action="store_true")
+    p.add_argument("--discovery-backend", default=None)
+    p.add_argument("--discovery-root", default=None)
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    from dynamo_tpu.runtime.logging_util import configure_logging
+
+    configure_logging()
+    kw = {}
+    if args.discovery_root:
+        kw["root"] = args.discovery_root
+    runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
+    indexer_only = args.role == "indexer"
+    svc = KvRouterService(
+        runtime,
+        args.workers,
+        block_size=args.block_size,
+        component=args.component
+        or (INDEXER_COMPONENT if indexer_only else SELECTION_COMPONENT),
+        replica_sync=args.replica_sync,
+        indexer_only=indexer_only,
+    )
+    await svc.start()
+    print(f"{args.role} service up for {args.workers}", flush=True)
+    try:
+        stop = asyncio.Event()
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover
+                pass
+        await stop.wait()
+    finally:
+        await svc.stop()
+        await runtime.shutdown()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(async_main(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

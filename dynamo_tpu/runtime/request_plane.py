@@ -206,8 +206,13 @@ class PushEndpoint:
             ctx.kill()
         except Exception as e:  # engine fault → error frame
             log.exception("engine error on %s", path)
+            # preserve a handler-supplied error code (e.g. a remote router
+            # service re-raising cannot_connect): flattening everything to
+            # "engine" would break the caller's migration / affinity-
+            # failover classification across a service hop
+            code = getattr(e, "code", None) or "engine"
             try:
-                await send({"t": "err", "id": rid, "msg": str(e), "code": "engine"})
+                await send({"t": "err", "id": rid, "msg": str(e), "code": code})
             except (ConnectionResetError, BrokenPipeError):
                 pass
         finally:

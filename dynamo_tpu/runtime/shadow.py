@@ -82,8 +82,9 @@ class ShadowServer:
         retry with backoff — a shadow that silently stops watching is a
         fleet with no failover."""
         prefix = f"services/{self.path}/"
+        seen_active = False  # persists across watch retries: an active
+        # that dies while the stream is broken must still trigger promotion
         while True:
-            seen_active = False
             alive: set = set()
             try:
                 async for ev in self.runtime.discovery.watch(prefix):
@@ -93,8 +94,9 @@ class ShadowServer:
                     else:
                         alive.discard(ev.instance.instance_id)
                     if seen_active and not alive:
-                        await self._promote(standby)
-                        return
+                        if await self._try_promote(standby):
+                            return
+                        break  # another shadow won: re-arm on a new watch
                 # watch stream ended without promotion: resync and retry
             except asyncio.CancelledError:
                 raise
@@ -105,6 +107,59 @@ class ShadowServer:
                     "shadow watch for %s errored (%s); retrying", self.path, e
                 )
             await asyncio.sleep(self.poll_s)
+            if seen_active:
+                # the death may have happened during the outage — the new
+                # watch's replay of an empty prefix yields no events, so
+                # check explicitly before re-arming
+                try:
+                    if not await self.runtime.discovery.list_instances(prefix):
+                        if await self._try_promote(standby):
+                            return
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    if self.promoted.done():
+                        return  # promotion failed terminally
+                    # else discovery still down; next retry
+
+    async def _try_promote(self, standby) -> bool:
+        """Promotion election without a CAS primitive (mem/file backends
+        have none): shadows order themselves by their standby records'
+        instance ids — rank 0 promotes immediately, rank k waits k
+        stagger periods and stands down if an active appeared. Best-effort
+        (a brief dual-active under partition converges when the loser's
+        next watch sees the winner), same class of window the reference
+        lock acquisition documents."""
+        rank = 0
+        try:
+            sbs = await self.runtime.discovery.list_instances(
+                f"standby/{self.path}/"
+            )
+            ids = sorted(i.instance_id for i in sbs)
+            me = self._standby.instance_id
+            rank = ids.index(me) if me in ids else len(ids)
+        except Exception:
+            pass
+        if rank > 0:
+            # poll through the winner's whole promotion window (a single
+            # post-stagger check races a winner whose serve/register is
+            # still in flight); promote only if no active ever appears
+            import time as _time
+
+            deadline = (
+                _time.monotonic() + rank * max(2 * self.poll_s, 0.5) + 2.0
+            )
+            while _time.monotonic() < deadline:
+                try:
+                    if await self.runtime.discovery.list_instances(
+                        f"services/{self.path}/"
+                    ):
+                        return False  # a lower-ranked shadow promoted
+                except Exception:
+                    return False  # can't verify; don't double-promote
+                await asyncio.sleep(max(self.poll_s, 0.1))
+        await self._promote(standby)
+        return True
 
     async def _promote(self, standby) -> None:
         log.warning("shadow promoting for %s (active gone)", self.path)

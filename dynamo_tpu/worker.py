@@ -317,6 +317,7 @@ async def async_main(args) -> None:
             disagg_role=args.disagg_role,
         )
         print(f"worker serving {card.name} at {path}", flush=True)
+    promotion_failed = False
     try:
         stop_ev = asyncio.Event()
         import signal
@@ -327,8 +328,20 @@ async def async_main(args) -> None:
                 loop.add_signal_handler(sig, stop_ev.set)
             except NotImplementedError:  # pragma: no cover
                 pass
+        if shadow is not None:
+            # a failed promotion must kill the process (exit nonzero so
+            # the supervisor restarts it) — not leave an invisible zombie
+            # that neither serves nor stands by
+            shadow.promoted.add_done_callback(
+                lambda f: stop_ev.set() if f.exception() is not None else None
+            )
         await stop_ev.wait()
-        print("draining...", flush=True)
+        if (shadow is not None and shadow.promoted.done()
+                and shadow.promoted.exception() is not None):
+            promotion_failed = True
+            print("shadow promotion FAILED; exiting", flush=True)
+        else:
+            print("draining...", flush=True)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
@@ -341,6 +354,8 @@ async def async_main(args) -> None:
         if status is not None:
             await status.stop()
         await runtime.shutdown()
+    if promotion_failed:
+        raise SystemExit(1)
 
 
 def main(argv=None) -> None:

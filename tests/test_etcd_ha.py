@@ -213,3 +213,39 @@ async def test_shadow_does_not_promote_before_seeing_an_active():
         assert inst is not None
     finally:
         await standby.shutdown()
+
+
+async def test_two_shadows_exactly_one_promotes():
+    """Dual-standby election: when the active dies, exactly one shadow
+    promotes (rank order on standby ids); the loser keeps standing by."""
+    realm = "shadow-two"
+    active = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                event_transport="inproc")
+    s1 = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                            event_transport="inproc")
+    s2 = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                            event_transport="inproc")
+    obs = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    try:
+        await active.serve_endpoint("t/w/gen", EchoEngine())
+        sh1 = ShadowServer(s1, "t/w/gen", handler=EchoEngine(), poll_s=0.05)
+        sh2 = ShadowServer(s2, "t/w/gen", handler=EchoEngine(), poll_s=0.05)
+        await sh1.start()
+        await sh2.start()
+        await asyncio.sleep(0.3)
+        await active.shutdown()
+        await asyncio.sleep(2.5)  # rank-1 stagger window passes
+        promoted = [s for s in (sh1, sh2) if s.promoted.done()]
+        assert len(promoted) == 1, "exactly one shadow must promote"
+        insts = await obs.discovery.list_instances("services/t/w/gen/")
+        assert len(insts) == 1
+        # the loser is still armed as a standby
+        sbs = await obs.discovery.list_instances("standby/t/w/gen/")
+        assert len(sbs) == 1
+        for s in (sh1, sh2):
+            await s.stop()
+    finally:
+        await s1.shutdown()
+        await s2.shutdown()
+        await obs.shutdown()

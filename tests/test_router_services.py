@@ -1,0 +1,206 @@
+"""Standalone KV router/indexer services (reference
+lib/kv-router/src/services/: standalone indexer /query + selection
+/select_and_reserve): a selection service process owns the router state,
+frontends in kv-remote mode delegate selection and keep streaming direct,
+and the indexer role answers multi-tier overlap queries."""
+
+import asyncio
+
+from dynamo_tpu.router.services import KvRouterService, RemoteKvRouter
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.tokens.hashing import block_hashes
+
+
+async def _workers(realm, n=2):
+    from dynamo_tpu.mocker.__main__ import build_mock_engine, parse_args
+    from dynamo_tpu.worker_common import serve_worker
+
+    out = []
+    for i in range(n):
+        rt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                event_transport="inproc")
+        args = parse_args(["--speed", "0", "--page-size", "4", "--decode-steps", "1"])
+        engine, card = build_mock_engine(args)
+        w = await serve_worker(rt, engine, card)
+        out.append((rt, w))
+    return out
+
+
+async def test_selection_service_with_kv_remote_frontend():
+    """Full shape: mock workers + standalone selection service + HTTP
+    frontend in kv-remote mode. Requests stream through the frontend while
+    selection state (active sequences, indexer) lives in the service."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+
+    realm = "router-svc-e2e"
+    workers = await _workers(realm)
+    srt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    svc = KvRouterService(srt, "dyn/tpu-worker/generate", block_size=4)
+    await svc.start()
+
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager, router_mode="kv-remote")
+    http = HttpService(frt, manager, watcher, port=0)
+    base = await http.start()
+    try:
+        await watcher.wait_for_model(timeout=10)
+        while len(svc.router.workers()) < 2:
+            await asyncio.sleep(0.02)
+
+        shared = "y" * 64  # 16 blocks of 4 byte-tokens
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/completions",
+                json={"model": "mock-model", "prompt": shared, "max_tokens": 4},
+            ) as r:
+                assert r.status == 200
+            await asyncio.sleep(0.15)
+
+            # service indexed the seeded worker; bookings were freed
+            entry = http.manager.get("mock-model")
+            hs = block_hashes(entry.preprocessor.tokenize_prompt(shared), 4)
+            m = svc.router.indexer.index.find_matches(hs)
+            assert m.scores, "selection service must index worker KV events"
+            seeded = max(m.scores, key=lambda w: m.scores[w])
+            assert svc.router.sequences.active_count() == 0
+
+            # follow-ups with the shared prefix route to the seeded worker
+            for i in range(3):
+                async with s.post(
+                    f"{base}/v1/completions",
+                    json={"model": "mock-model", "prompt": shared + str(i),
+                          "max_tokens": 2},
+                ) as r:
+                    assert r.status == 200
+            await asyncio.sleep(0.15)
+            m2 = svc.router.indexer.index.find_matches(hs)
+            assert max(m2.scores, key=lambda w: m2.scores[w]) == seeded
+    finally:
+        await http.stop()
+        await frt.shutdown()
+        await svc.stop()
+        await srt.shutdown()
+        for rt, w in workers:
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+async def test_select_and_reserve_books_and_free_releases():
+    realm = "router-svc-book"
+    workers = await _workers(realm, n=1)
+    srt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    svc = KvRouterService(srt, "dyn/tpu-worker/generate", block_size=4)
+    await svc.start()
+    crt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    try:
+        while len(svc.router.workers()) < 1:
+            await asyncio.sleep(0.02)
+        reserve = crt.client("dyn/kv-router/select_and_reserve")
+        free = crt.client("dyn/kv-router/free")
+        await reserve.wait_ready()
+        await free.wait_ready()
+        sel = None
+        async for item in reserve.generate({"token_ids": list(range(16))}):
+            sel = item
+        assert sel["reservation_id"] and sel["blocks"] == 4
+        assert svc.router.sequences.active_count() == 1
+        async for item in free.generate({"reservation_id": sel["reservation_id"]}):
+            assert item["ok"]
+        assert svc.router.sequences.active_count() == 0
+    finally:
+        await crt.shutdown()
+        await svc.stop()
+        await srt.shutdown()
+        for rt, w in workers:
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+async def test_indexer_service_query_multi_tier():
+    """Indexer role: query returns per-instance device counts after worker
+    KV events arrive (reference standalone indexer /query instances map)."""
+    realm = "router-svc-idx"
+    workers = await _workers(realm, n=1)
+    srt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    svc = KvRouterService(
+        srt, "dyn/tpu-worker/generate", block_size=4, indexer_only=True,
+        component="kv-indexer",
+    )
+    await svc.start()
+    crt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    try:
+        # seed the worker's cache directly through its generate endpoint
+        wclient = crt.client("dyn/tpu-worker/generate")
+        await wclient.wait_ready()
+        toks = list(range(32))
+        async for _ in wclient.generate(
+            {"token_ids": toks, "stop": {"max_tokens": 2}, "sampling": {}}
+        ):
+            pass
+        await asyncio.sleep(0.15)
+
+        q = crt.client("dyn/kv-indexer/query")
+        await q.wait_ready()
+        out = None
+        async for item in q.generate({"token_ids": toks}):
+            out = item
+        assert out["blocks"] == 8
+        assert out["instances"], "indexer must report the seeded worker"
+        inst = next(iter(out["instances"].values()))
+        assert inst["device"] > 0
+        await wclient.close()
+        await q.close()
+    finally:
+        await crt.shutdown()
+        await svc.stop()
+        await srt.shutdown()
+        for rt, w in workers:
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+async def test_stale_reservations_reaped():
+    """A frontend that dies between reserve and free must not skew the
+    service's load view forever (reservation TTL reaper)."""
+    realm = "router-svc-reap"
+    workers = await _workers(realm, n=1)
+    srt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    svc = KvRouterService(srt, "dyn/tpu-worker/generate", block_size=4,
+                          reservation_ttl_s=0.6)
+    await svc.start()
+    crt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                             event_transport="inproc")
+    try:
+        while len(svc.router.workers()) < 1:
+            await asyncio.sleep(0.02)
+        reserve = crt.client("dyn/kv-router/select_and_reserve")
+        await reserve.wait_ready()
+        async for _ in reserve.generate({"token_ids": list(range(16))}):
+            pass
+        assert svc.router.sequences.active_count() == 1
+        # no free() ever arrives (caller "crashed")
+        for _ in range(40):
+            if svc.router.sequences.active_count() == 0:
+                break
+            await asyncio.sleep(0.1)
+        assert svc.router.sequences.active_count() == 0
+        await reserve.close()
+    finally:
+        await crt.shutdown()
+        await svc.stop()
+        await srt.shutdown()
+        for rt, w in workers:
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
