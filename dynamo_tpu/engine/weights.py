@@ -5,13 +5,19 @@ per-backend weight loading inside vLLM/TRT-LLM): map a HuggingFace
 Llama-family checkpoint directory onto models/llama.py's stacked-layer
 pytree, casting to the serving dtype, ready for ShardingPolicy placement.
 
-HF → dynamo_tpu name map (Llama architecture):
+HF → dynamo_tpu name map (Llama/Qwen2/Qwen3/Qwen-MoE architectures):
   model.embed_tokens.weight            → embed                [V, E]
   model.layers.{i}.input_layernorm     → layers/attn_norm[i]
   model.layers.{i}.self_attn.{q,k,v}_proj (transposed) → layers/w{q,k,v}[i]
+  model.layers.{i}.self_attn.{q,k,v}_proj.bias → layers/b{q,k,v}[i] (Qwen2)
+  model.layers.{i}.self_attn.{q,k}_norm.weight → layers/{q,k}_norm[i] (Qwen3)
   model.layers.{i}.self_attn.o_proj    (transposed)    → layers/wo[i]
   model.layers.{i}.post_attention_layernorm → layers/mlp_norm[i]
   model.layers.{i}.mlp.{gate,up,down}_proj (transposed) → layers/w_{gate,up,down}[i]
+  model.layers.{i}.mlp.gate.weight (transposed)        → layers/w_router[i] (MoE)
+  model.layers.{i}.mlp.experts.{e}.{gate,up,down}_proj → layers/we_*[i, e]
+  model.layers.{i}.mlp.shared_expert.{gate,up,down}_proj → layers/ws_*[i]
+    (DeepSeek naming `shared_experts` accepted too)
   model.norm.weight                    → norm_f
   lm_head.weight (transposed)          → lm_head (absent if tied)
 """
@@ -85,12 +91,54 @@ def load_hf_checkpoint(
             "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
             "wo": stack("model.layers.{i}.self_attn.o_proj.weight", True),
             "mlp_norm": stack_f32("model.layers.{i}.post_attention_layernorm.weight"),
-            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", True),
-            "w_up": stack("model.layers.{i}.mlp.up_proj.weight", True),
-            "w_down": stack("model.layers.{i}.mlp.down_proj.weight", True),
         },
         "norm_f": get_f32("model.norm.weight"),
     }
+    layers = params["layers"]
+    if config.attn_bias:
+        layers["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", False)
+        layers["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", False)
+        layers["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", False)
+    if config.qk_norm:
+        layers["q_norm"] = stack_f32("model.layers.{i}.self_attn.q_norm.weight")
+        layers["k_norm"] = stack_f32("model.layers.{i}.self_attn.k_norm.weight")
+    if config.is_moe:
+        layers["w_router"] = stack("model.layers.{i}.mlp.gate.weight", True)
+
+        def stack_experts(part: str) -> np.ndarray:
+            return np.stack(
+                [
+                    np.stack(
+                        [
+                            get(
+                                f"model.layers.{i}.mlp.experts.{e}.{part}.weight",
+                                transpose=True,
+                            )
+                            for e in range(config.n_experts)
+                        ]
+                    )
+                    for i in range(L)
+                ]
+            )
+
+        layers["we_gate"] = stack_experts("gate_proj")
+        layers["we_up"] = stack_experts("up_proj")
+        layers["we_down"] = stack_experts("down_proj")
+        if config.n_shared_experts:
+            base = "model.layers.{i}.mlp.shared_expert"
+            if f"model.layers.0.mlp.shared_experts.gate_proj.weight" in tensors:
+                base = "model.layers.{i}.mlp.shared_experts"  # deepseek naming
+            layers["ws_gate"] = stack(base + ".gate_proj.weight", True)
+            layers["ws_up"] = stack(base + ".up_proj.weight", True)
+            layers["ws_down"] = stack(base + ".down_proj.weight", True)
+            if "model.layers.0.mlp.shared_expert_gate.weight" in tensors:
+                layers["ws_gatectl"] = stack(
+                    "model.layers.{i}.mlp.shared_expert_gate.weight", True
+                )
+    else:
+        layers["w_gate"] = stack("model.layers.{i}.mlp.gate_proj.weight", True)
+        layers["w_up"] = stack("model.layers.{i}.mlp.up_proj.weight", True)
+        layers["w_down"] = stack("model.layers.{i}.mlp.down_proj.weight", True)
     if "lm_head.weight" in tensors and not config.tie_embeddings:
         params["lm_head"] = get("lm_head.weight", transpose=True)
     log.info("loaded HF checkpoint %s (%d files)", checkpoint_dir, len(files))
@@ -98,8 +146,20 @@ def load_hf_checkpoint(
 
 
 def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConfig:
-    """Derive a ModelConfig from a HF config.json (Llama family)."""
+    """Derive a ModelConfig from a HF config.json (llama / qwen2 / qwen3 /
+    qwen2_moe / qwen3_moe model types)."""
     cfg = json.loads((Path(checkpoint_dir) / "config.json").read_text())
+    mt = cfg.get("model_type", "llama")
+    if mt.startswith("deepseek"):
+        # DeepSeek checkpoints need MLA attention, leading dense layers
+        # (first_k_dense_replace) and bias-corrected sigmoid routing with
+        # routed_scaling_factor — none of which this loader maps yet.
+        # Refusing beats silently mis-mapping a 600B checkpoint.
+        raise ValueError(
+            f"model_type {mt!r} (MLA) is not supported by this loader; "
+            "supported: llama, qwen2, qwen3, qwen2_moe, qwen3_moe"
+        )
+    n_experts = int(cfg.get("num_experts") or cfg.get("n_routed_experts") or 0)
     return ModelConfig(
         name=name or cfg.get("_name_or_path", "hf-model"),
         vocab_size=cfg["vocab_size"],
@@ -112,6 +172,22 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
         rope_theta=float(cfg.get("rope_theta", 500000.0)),
         norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+        # qwen2 ships biases by default; qwen3 advertises them explicitly
+        attn_bias=bool(cfg.get("attention_bias", mt in ("qwen2", "qwen2_moe"))),
+        qk_norm=mt in ("qwen3", "qwen3_moe"),
+        head_dim_override=int(cfg.get("head_dim") or 0),
+        n_experts=n_experts,
+        n_experts_active=int(cfg.get("num_experts_per_tok") or 0),
+        moe_ffn_dim=int(cfg.get("moe_intermediate_size") or 0),
+        n_shared_experts=int(
+            cfg.get("n_shared_experts")
+            or (1 if cfg.get("shared_expert_intermediate_size") else 0)
+        ),
+        shared_expert_ffn_dim=int(cfg.get("shared_expert_intermediate_size") or 0),
+        moe_scoring="sigmoid" if cfg.get("scoring_func") == "sigmoid" else "softmax",
+        # Qwen2-MoE ships norm_topk_prob=false: keep softmax-over-all
+        # probabilities un-renormalized (HF semantics)
+        moe_norm_topk=bool(cfg.get("norm_topk_prob", True)),
     )
 
 

@@ -20,10 +20,30 @@ class ModelConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # attention variants (one forward serves the whole family):
+    # Qwen2-style q/k/v projection biases
+    attn_bias: bool = False
+    # Qwen3-style per-head RMSNorm on q and k before RoPE
+    qk_norm: bool = False
+    # explicit head_dim when it differs from dim // n_heads (Qwen3-MoE)
+    head_dim_override: int = 0
     # MoE (0 experts = dense)
     n_experts: int = 0
     n_experts_active: int = 0
     moe_ffn_dim: int = 0
+    # DeepSeek/Qwen2-MoE-style always-active shared experts, fused into one
+    # dense FFN of width shared_ffn_dim (explicit when it isn't simply
+    # n_shared_experts * moe_ffn_dim, e.g. Qwen2-MoE's 20480)
+    n_shared_experts: int = 0
+    shared_expert_ffn_dim: int = 0
+    # router scoring: softmax over top-k logits (Mixtral/Qwen) or sigmoid
+    # gates renormalized over the top-k (DeepSeek-V3)
+    moe_scoring: str = "softmax"
+    # HF norm_topk_prob: True renormalizes the selected weights to sum to
+    # 1 (softmax-over-selected; Mixtral/Qwen3-MoE). False keeps the
+    # softmax-over-ALL-experts probabilities un-renormalized (Qwen2-MoE) —
+    # the routed output is deliberately scaled by sum(top-k probs) < 1.
+    moe_norm_topk: bool = True
     # EP dispatch capacity per (src,dst) lane as a multiple of the even
     # split. 0.0 (default) = lossless (n_experts/n_experts_active): the EP
     # path then matches the dense path exactly, so the shape-dependent
@@ -33,11 +53,15 @@ class ModelConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or (self.dim // self.n_heads)
 
     @property
     def is_moe(self) -> bool:
         return self.n_experts > 0
+
+    @property
+    def shared_ffn_dim(self) -> int:
+        return self.shared_expert_ffn_dim or self.n_shared_experts * self.moe_ffn_dim
 
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
@@ -48,6 +72,16 @@ PRESETS: Dict[str, ModelConfig] = {
     "tiny": ModelConfig(),
     "tiny-moe": ModelConfig(
         name="tiny-moe", n_experts=4, n_experts_active=2, moe_ffn_dim=96
+    ),
+    # test-size second/third architectures (CPU CI for the qwen family)
+    "tiny-qwen2": ModelConfig(name="tiny-qwen2", attn_bias=True),
+    "tiny-qwen3": ModelConfig(
+        name="tiny-qwen3", qk_norm=True, head_dim_override=32,
+    ),
+    # deepseek-style MoE: shared expert + sigmoid router scoring
+    "tiny-moe-shared": ModelConfig(
+        name="tiny-moe-shared", n_experts=4, n_experts_active=2,
+        moe_ffn_dim=96, n_shared_experts=1, moe_scoring="sigmoid",
     ),
     # Llama 3.2 1B (fits one v5e chip in bf16 with room for KV)
     "llama-3.2-1b": ModelConfig(
@@ -86,6 +120,55 @@ PRESETS: Dict[str, ModelConfig] = {
         n_kv_heads=8,
         ffn_dim=14336,
         max_seq_len=131072,
+    ),
+    # Qwen 2.5 7B (second architecture family: attention biases)
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b",
+        vocab_size=152064,
+        dim=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        ffn_dim=18944,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        norm_eps=1e-6,
+        attn_bias=True,
+    ),
+    # Qwen3 8B (qk-norm family)
+    "qwen3-8b": ModelConfig(
+        name="qwen3-8b",
+        vocab_size=151936,
+        dim=4096,
+        n_layers=36,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=12288,
+        max_seq_len=40960,
+        rope_theta=1000000.0,
+        norm_eps=1e-6,
+        qk_norm=True,
+        head_dim_override=128,
+    ),
+    # Qwen3 30B-A3B: wide-EP flagship recipe (128 experts, top-8) — the
+    # analog of the reference's wide-EP MoE recipes (recipes/deepseek-r1):
+    # EP=8..32 meshes dispatch tokens over ICI via ops/moe_dispatch.py
+    "qwen3-30b-a3b": ModelConfig(
+        name="qwen3-30b-a3b",
+        vocab_size=151936,
+        dim=2048,
+        n_layers=48,
+        n_heads=32,
+        n_kv_heads=4,
+        ffn_dim=6144,  # unused (all layers MoE)
+        max_seq_len=40960,
+        rope_theta=1000000.0,
+        norm_eps=1e-6,
+        qk_norm=True,
+        head_dim_override=128,
+        n_experts=128,
+        n_experts_active=8,
+        moe_ffn_dim=768,
     ),
     # Llama 3.1 70B (BASELINE north-star model; TP=8 on v5e)
     "llama-3.1-70b": ModelConfig(

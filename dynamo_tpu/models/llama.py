@@ -41,7 +41,7 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
     """Random-init params (benchmarks / tests; checkpoint loading in
     engine/weights.py replaces values with the same tree structure)."""
     c = config
-    k = jax.random.split(key, 12)
+    k = jax.random.split(key, 15)
     hd = c.head_dim
 
     def norm_init(*shape):
@@ -63,6 +63,18 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
         },
         "norm_f": norm_init(c.dim),
     }
+    if c.attn_bias:  # Qwen2 family: biases on the q/k/v projections
+        params["layers"].update(
+            {
+                "bq": jnp.zeros((L, c.n_heads * hd), dtype),
+                "bk": jnp.zeros((L, c.n_kv_heads * hd), dtype),
+                "bv": jnp.zeros((L, c.n_kv_heads * hd), dtype),
+            }
+        )
+    if c.qk_norm:  # Qwen3 family: per-head RMSNorm on q/k before RoPE
+        params["layers"].update(
+            {"q_norm": norm_init(L, hd), "k_norm": norm_init(L, hd)}
+        )
     if c.is_moe:
         params["layers"].update(
             {
@@ -72,6 +84,15 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
                 "we_down": w(k[8], c.moe_ffn_dim, L, c.n_experts, c.moe_ffn_dim, c.dim),
             }
         )
+        if c.n_shared_experts:  # deepseek/qwen2-moe shared experts (fused)
+            sf = c.shared_ffn_dim
+            params["layers"].update(
+                {
+                    "ws_gate": w(k[12], c.dim, L, c.dim, sf),
+                    "ws_up": w(k[13], c.dim, L, c.dim, sf),
+                    "ws_down": w(k[14], sf, L, sf, c.dim),
+                }
+            )
     else:
         params["layers"].update(
             {
@@ -294,9 +315,17 @@ def forward(
             return y + jnp.einsum("bsr,bro->bso", z, Bg)
 
         x = rms_norm(h, lp["attn_norm"], c.norm_eps)
-        q = lproj(mm(x, lp["wq"]), x, "wq").reshape(B, S, c.n_heads, hd)
-        k = lproj(mm(x, lp["wk"]), x, "wk").reshape(B, S, c.n_kv_heads, hd)
-        v = lproj(mm(x, lp["wv"]), x, "wv").reshape(B, S, c.n_kv_heads, hd)
+        q = lproj(mm(x, lp["wq"]), x, "wq")
+        k = lproj(mm(x, lp["wk"]), x, "wk")
+        v = lproj(mm(x, lp["wv"]), x, "wv")
+        if c.attn_bias:  # Qwen2 projection biases
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, S, c.n_heads, hd)
+        k = k.reshape(B, S, c.n_kv_heads, hd)
+        v = v.reshape(B, S, c.n_kv_heads, hd)
+        if c.qk_norm:  # Qwen3 per-head RMSNorm before RoPE
+            q = rms_norm(q, lp["q_norm"], c.norm_eps)
+            k = rms_norm(k, lp["k_norm"], c.norm_eps)
         q = rope(q, safe_pos, c.rope_theta)
         k = rope(k, safe_pos, c.rope_theta)
 
@@ -417,9 +446,17 @@ def encode(
     def layer(h, xs):
         lp, _ = xs
         x = rms_norm(h, lp["attn_norm"], c.norm_eps)
-        q = rope(mm(x, lp["wq"]).reshape(B, S, c.n_heads, hd), positions, c.rope_theta)
-        k = rope(mm(x, lp["wk"]).reshape(B, S, c.n_kv_heads, hd), positions, c.rope_theta)
-        v = mm(x, lp["wv"]).reshape(B, S, c.n_kv_heads, hd)
+        q, k, v = mm(x, lp["wq"]), mm(x, lp["wk"]), mm(x, lp["wv"])
+        if c.attn_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, S, c.n_heads, hd)
+        k = k.reshape(B, S, c.n_kv_heads, hd)
+        v = v.reshape(B, S, c.n_kv_heads, hd)
+        if c.qk_norm:
+            q = rms_norm(q, lp["q_norm"], c.norm_eps)
+            k = rms_norm(k, lp["k_norm"], c.norm_eps)
+        q = rope(q, positions, c.rope_theta)
+        k = rope(k, positions, c.rope_theta)
         qg = q.reshape(B, S, c.n_kv_heads, G, hd)
         scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * hd**-0.5
         ti = jnp.arange(S)
@@ -454,6 +491,15 @@ def _moe_block(c: ModelConfig, lp, x: jax.Array, mesh=None) -> jax.Array:
     from dynamo_tpu.models.quant import is_quantized
 
     B, S, E = x.shape
+    # always-active shared experts (DeepSeek / Qwen2-MoE): a plain dense
+    # FFN added to the routed output — never dispatched, so it stays out
+    # of the EP all_to_all entirely
+    shared = 0.0
+    if c.n_shared_experts:
+        gate = jax.nn.silu(mm(x, lp["ws_gate"]))
+        shared = mm(gate * mm(x, lp["ws_up"]), lp["ws_down"])
+        if "ws_gatectl" in lp:  # qwen2-moe: sigmoid-gated shared expert
+            shared = shared * jax.nn.sigmoid(x @ lp["ws_gatectl"])
     ep = mesh is not None and mesh.shape.get("expert", 1) > 1
     if ep and not is_quantized(lp["we_gate"]) and (B * S) % mesh.shape["expert"] == 0:
         from dynamo_tpu.ops.moe_dispatch import moe_ep
@@ -466,11 +512,17 @@ def _moe_block(c: ModelConfig, lp, x: jax.Array, mesh=None) -> jax.Array:
             mesh, c.n_experts_active,
             capacity_factor=cf,
             model_axis=model_axis,
+            scoring=c.moe_scoring,
+            norm_topk=c.moe_norm_topk,
         )
-        return y.reshape(B, S, E)
+        return y.reshape(B, S, E) + shared
+    from dynamo_tpu.ops.moe_dispatch import router_topk
+
     router_logits = (x @ lp["w_router"]).astype(jnp.float32)  # [B,S,n_exp]
-    weights, sel = lax.top_k(router_logits, c.n_experts_active)
-    weights = jax.nn.softmax(weights, axis=-1).astype(x.dtype)
+    weights, sel = router_topk(
+        router_logits, c.n_experts_active, c.moe_scoring, c.moe_norm_topk
+    )
+    weights = weights.astype(x.dtype)
 
     # compute every expert on every token (fine at test scale; EP replaces it)
     def one_expert(we_gate, we_up, we_down):
@@ -484,4 +536,4 @@ def _moe_block(c: ModelConfig, lp, x: jax.Array, mesh=None) -> jax.Array:
         sel[..., None].astype(jnp.int32),
         axis=2,
     )  # [B,S,k,E]
-    return jnp.sum(sel_out * weights[..., None], axis=2)
+    return jnp.sum(sel_out * weights[..., None], axis=2) + shared

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 # int8 keeps argmax/top-k sampling stable. Norms stay f32.
 DEFAULT_QUANT_NAMES = (
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-    "we_gate", "we_up", "we_down", "embed", "lm_head",
+    "we_gate", "we_up", "we_down", "ws_gate", "ws_up", "ws_down",
+    "embed", "lm_head",
 )
 
 

@@ -31,8 +31,33 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def router_topk(logits: jax.Array, k: int, scoring: str = "softmax",
+                norm_topk: bool = True):
+    """Top-k routing weights from f32 router logits. softmax = Mixtral/
+    Qwen (softmax over the selected logits); sigmoid = DeepSeek-V3
+    (independent gates, renormalized over the top-k). norm_topk=False
+    (HF norm_topk_prob: false, Qwen2-MoE) keeps softmax-over-ALL-experts
+    probabilities without renormalizing — the routed sum is deliberately
+    < 1. One helper shared by every MoE path so dense, EP, and reference
+    all route identically."""
+    if scoring == "sigmoid":
+        gates = jax.nn.sigmoid(logits)
+        weights, sel = lax.top_k(gates, k)
+        if norm_topk:
+            weights = weights / jnp.maximum(
+                jnp.sum(weights, axis=-1, keepdims=True), 1e-9
+            )
+    elif not norm_topk:
+        probs = jax.nn.softmax(logits, axis=-1)
+        weights, sel = lax.top_k(probs, k)
+    else:
+        weights, sel = lax.top_k(logits, k)
+        weights = jax.nn.softmax(weights, axis=-1)
+    return weights, sel
+
+
 def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis: str,
-               model_axis=None):
+               model_axis=None, scoring: str = "softmax", norm_topk: bool = True):
     """Per-shard body. x: [T, E] local tokens; we_*: [n_local, ...] resident
     experts; router weights replicated. Returns [T, E]."""
     n_ranks = lax.psum(1, axis)
@@ -42,8 +67,8 @@ def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis
     n_experts = n_local * n_ranks
 
     logits = (x @ w_router).astype(jnp.float32)  # [T, n_experts]
-    weights, sel = lax.top_k(logits, k)  # [T, k]
-    weights = jax.nn.softmax(weights, axis=-1).astype(x.dtype)
+    weights, sel = router_topk(logits, k, scoring, norm_topk)  # [T, k]
+    weights = weights.astype(x.dtype)
 
     # flatten (token, choice) pairs and bucket by destination rank
     flat_sel = sel.reshape(-1)  # [T*k] expert ids
@@ -111,6 +136,8 @@ def moe_ep(
     capacity_factor: float = 2.0,
     axis: str = "expert",
     model_axis=None,  # set to "model" for EP x TP expert weights
+    scoring: str = "softmax",
+    norm_topk: bool = True,
 ) -> jax.Array:
     """Token-dispatch EP MoE. Returns [T, E] with x's sharding."""
     n_ranks = mesh.shape[axis]
@@ -122,7 +149,7 @@ def moe_ep(
     fn = jax.shard_map(
         partial(
             _local_moe, k=n_experts_active, capacity=capacity, axis=axis,
-            model_axis=ma,
+            model_axis=ma, scoring=scoring, norm_topk=norm_topk,
         ),
         mesh=mesh,
         in_specs=(
@@ -137,11 +164,12 @@ def moe_ep(
     return fn(x, w_router, we_gate, we_up, we_down)
 
 
-def moe_dense_reference(x, w_router, we_gate, we_up, we_down, k: int):
+def moe_dense_reference(x, w_router, we_gate, we_up, we_down, k: int,
+                        scoring: str = "softmax", norm_topk: bool = True):
     """Unsharded dense top-k MoE (same math as models/llama.py _moe_block)."""
     logits = (x @ w_router).astype(jnp.float32)
-    weights, sel = lax.top_k(logits, k)
-    weights = jax.nn.softmax(weights, axis=-1).astype(x.dtype)
+    weights, sel = router_topk(logits, k, scoring, norm_topk)
+    weights = weights.astype(x.dtype)
 
     def expert_fn(wg, wu, wd):
         return (jax.nn.silu(x @ wg) * (x @ wu)) @ wd

@@ -103,10 +103,12 @@ class ShardingPolicy:
             return P(None, None, None, AXIS_MODEL)  # out sharded (column-parallel)
         if path.endswith(("_a", "_b")):
             return P()
-        if path.endswith(("wq", "wk", "wv", "w_gate", "w_up")):
+        if path.endswith(("wq", "wk", "wv", "w_gate", "w_up", "ws_gate", "ws_up")):
             return P(None, None, AXIS_MODEL)  # [L, E, out] column parallel
-        if path.endswith(("wo", "w_down")):
+        if path.endswith(("wo", "w_down", "ws_down")):
             return P(None, AXIS_MODEL, None)  # [L, in, E] row parallel
+        if path.endswith(("bq", "bk", "bv")):
+            return P(None, AXIS_MODEL)  # [L, out] follows the column split
         if path.endswith("embed"):
             return P(None, AXIS_MODEL)  # [V, E] shard E
         if path.endswith("lm_head"):

@@ -1,0 +1,199 @@
+"""Second/third model architectures (VERDICT r1 item 10; reference serves
+Qwen/DeepSeek recipes through its engines): Qwen2 attention biases, Qwen3
+per-head qk-norm + head_dim override, deepseek-style shared-expert MoE
+with sigmoid routing — all through the SAME forward, engine, and
+checkpoint loader as llama."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.engine.model_runner import ModelRunner
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.runtime.context import Context
+
+
+def _generate(runner, prompt, n=5):
+    import asyncio
+
+    async def run():
+        engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+        engine.start()
+        try:
+            toks = []
+            req = {"token_ids": prompt, "sampling": {"temperature": 0.0},
+                   "stop": {"max_tokens": n, "stop_ids": []}}
+            async for item in engine.generate(req, Context()):
+                toks.extend(item["token_ids"])
+                if item["finish_reason"]:
+                    break
+            return toks
+        finally:
+            engine.stop()
+
+    return asyncio.run(run())
+
+
+def _runner(name, **kw):
+    return ModelRunner(
+        get_config(name), None, num_pages=64, page_size=4,
+        max_pages_per_seq=16, decode_buckets=(1, 2, 4),
+        prefill_buckets=(8, 16), seed=11, **kw,
+    )
+
+
+def test_qwen2_bias_generates_and_bias_changes_logits():
+    toks = _generate(_runner("tiny-qwen2"), [5, 3, 8, 1, 9, 2])
+    assert len(toks) == 5
+    # nonzero biases must change the forward (wiring check)
+    c = get_config("tiny-qwen2")
+    p = llama.init_params(c, jax.random.PRNGKey(0))
+    pools = llama.make_kv_pool(c, 8, 4)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    tk = jnp.asarray([[1, 2, 3, 4]])
+    pos = jnp.asarray([[0, 1, 2, 3]])
+    kvl = jnp.asarray([4])
+    base, _, _ = llama.forward(c, p, tk, pos, pools[0], pools[1], pt, kvl)
+    p2 = dict(p)
+    p2["layers"] = dict(p["layers"])
+    p2["layers"]["bq"] = p["layers"]["bq"] + 1.0
+    pools2 = llama.make_kv_pool(c, 8, 4)
+    alt, _, _ = llama.forward(c, p2, tk, pos, pools2[0], pools2[1], pt, kvl)
+    assert np.abs(np.asarray(base) - np.asarray(alt)).max() > 1e-3
+
+
+def test_qwen3_qk_norm_and_head_dim_override():
+    c = get_config("tiny-qwen3")
+    assert c.head_dim == 32 and c.dim // c.n_heads == 16
+    toks = _generate(_runner("tiny-qwen3"), [2, 7, 1, 8])
+    assert len(toks) == 5
+
+
+def test_shared_expert_moe_generates_and_contributes():
+    c = get_config("tiny-moe-shared")
+    toks = _generate(_runner("tiny-moe-shared"), [4, 4, 2, 9])
+    assert len(toks) == 5
+    # shared expert must contribute: zeroing it changes logits
+    p = llama.init_params(c, jax.random.PRNGKey(1))
+    pools = llama.make_kv_pool(c, 8, 4)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    tk = jnp.asarray([[1, 2, 3, 4]])
+    pos = jnp.asarray([[0, 1, 2, 3]])
+    kvl = jnp.asarray([4])
+    base, _, _ = llama.forward(c, p, tk, pos, pools[0], pools[1], pt, kvl)
+    p2 = dict(p)
+    p2["layers"] = dict(p["layers"])
+    p2["layers"]["ws_down"] = jnp.zeros_like(p["layers"]["ws_down"])
+    pools2 = llama.make_kv_pool(c, 8, 4)
+    alt, _, _ = llama.forward(c, p2, tk, pos, pools2[0], pools2[1], pt, kvl)
+    assert np.abs(np.asarray(base) - np.asarray(alt)).max() > 1e-3
+
+
+def _write_fake_qwen_checkpoint(tmp_path, c):
+    """Synthetic HF-format qwen2 checkpoint (safetensors + config.json)."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    t = {}
+    hd = c.head_dim
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    t["model.embed_tokens.weight"] = w(c.vocab_size, c.dim)
+    for i in range(c.n_layers):
+        pre = f"model.layers.{i}."
+        t[pre + "input_layernorm.weight"] = np.ones(c.dim, np.float32)
+        t[pre + "self_attn.q_proj.weight"] = w(c.n_heads * hd, c.dim)
+        t[pre + "self_attn.k_proj.weight"] = w(c.n_kv_heads * hd, c.dim)
+        t[pre + "self_attn.v_proj.weight"] = w(c.n_kv_heads * hd, c.dim)
+        t[pre + "self_attn.q_proj.bias"] = w(c.n_heads * hd)
+        t[pre + "self_attn.k_proj.bias"] = w(c.n_kv_heads * hd)
+        t[pre + "self_attn.v_proj.bias"] = w(c.n_kv_heads * hd)
+        t[pre + "self_attn.o_proj.weight"] = w(c.dim, c.n_heads * hd)
+        t[pre + "post_attention_layernorm.weight"] = np.ones(c.dim, np.float32)
+        t[pre + "mlp.gate_proj.weight"] = w(c.ffn_dim, c.dim)
+        t[pre + "mlp.up_proj.weight"] = w(c.ffn_dim, c.dim)
+        t[pre + "mlp.down_proj.weight"] = w(c.dim, c.ffn_dim)
+    t["model.norm.weight"] = np.ones(c.dim, np.float32)
+    t["lm_head.weight"] = w(c.vocab_size, c.dim)
+    save_file(t, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "qwen2",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.dim,
+        "num_hidden_layers": c.n_layers,
+        "num_attention_heads": c.n_heads,
+        "num_key_value_heads": c.n_kv_heads,
+        "intermediate_size": c.ffn_dim,
+        "max_position_embeddings": 2048,
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-6,
+        "tie_word_embeddings": False,
+    }))
+    return t
+
+
+def test_hf_qwen2_checkpoint_roundtrip(tmp_path):
+    """config_from_hf detects qwen2 (attn_bias) and load_hf_checkpoint maps
+    bias tensors into the stacked tree; forward runs on the loaded tree."""
+    from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
+
+    base = get_config("tiny-qwen2")
+    t = _write_fake_qwen_checkpoint(tmp_path, base)
+    c = config_from_hf(str(tmp_path), name="tiny-qwen2-ckpt")
+    assert c.attn_bias and not c.qk_norm
+    params = load_hf_checkpoint(str(tmp_path), c)
+    assert params["layers"]["bq"].shape == (c.n_layers, c.n_heads * c.head_dim)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["wq"][0], np.float32),
+        t["model.layers.0.self_attn.q_proj.weight"].T,
+        rtol=1e-2, atol=1e-2,
+    )
+    pools = llama.make_kv_pool(c, 8, 4)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    logits, _, _ = llama.forward(
+        c, jax.tree.map(jnp.asarray, params),
+        jnp.asarray([[1, 2, 3, 4]]), jnp.asarray([[0, 1, 2, 3]]),
+        pools[0], pools[1], pt, jnp.asarray([4]),
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_norm_topk_false_scales_routed_output():
+    """norm_topk_prob=false (Qwen2-MoE): routed weights are the softmax-
+    over-ALL-experts probabilities, NOT renormalized over the top-k."""
+    from dynamo_tpu.ops.moe_dispatch import router_topk
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((5, 8)), jnp.float32)
+    w_norm, sel_n = router_topk(logits, 2, "softmax", norm_topk=True)
+    w_raw, sel_r = router_topk(logits, 2, "softmax", norm_topk=False)
+    np.testing.assert_array_equal(np.asarray(sel_n), np.asarray(sel_r))
+    assert np.allclose(np.asarray(w_norm).sum(-1), 1.0, atol=1e-5)
+    raw_sum = np.asarray(w_raw).sum(-1)
+    assert (raw_sum < 0.999).any()  # deliberately < 1
+    # raw weights == full softmax probabilities at the selected experts
+    full = np.asarray(jax.nn.softmax(logits, axis=-1))
+    np.testing.assert_allclose(
+        np.asarray(w_raw), np.take_along_axis(full, np.asarray(sel_r), -1),
+        rtol=1e-5,
+    )
+
+
+def test_deepseek_checkpoints_rejected_loudly(tmp_path):
+    from dynamo_tpu.engine.weights import config_from_hf
+
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "deepseek_v3", "vocab_size": 32, "hidden_size": 16,
+        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "intermediate_size": 32,
+    }))
+    import pytest
+
+    with pytest.raises(ValueError, match="MLA"):
+        config_from_hf(str(tmp_path))
