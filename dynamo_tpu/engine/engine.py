@@ -307,6 +307,12 @@ class InferenceEngine:
             elif op == "export":
                 rid, fut, loop, discard = arg
                 self._export_parked(rid, fut, loop, discard)
+            elif op == "export_meta":
+                rid, fut, loop = arg
+                self._export_meta(rid, fut, loop)
+            elif op == "export_chunk":
+                rid, start, n, last, fut, loop = arg
+                self._export_chunk(rid, start, n, last, fut, loop)
             elif op == "export_device":
                 rid, fut, loop = arg
                 self._export_parked_device(rid, fut, loop)
@@ -339,6 +345,17 @@ class InferenceEngine:
                 self.runner.import_pages_device(
                     target, seq.n_shared_pages, payload["k"], payload["v"]
                 )
+            elif target and payload.get("chunks"):
+                # chunked host-staged transfer: each chunk covers global
+                # pages [offset, offset+n); skip the prefix-cache-shared
+                # span and scatter the rest
+                ns = seq.n_shared_pages
+                for ch in payload["chunks"]:
+                    off, n = int(ch.get("offset", 0)), int(ch["n_pages"])
+                    lo, hi = max(off, ns), min(off + n, n_kv_pages)
+                    if lo >= hi or not ch.get("data"):
+                        continue
+                    self.runner.import_pages(seq.pages[lo:hi], lo - off, ch)
             elif target and payload.get("data"):
                 self.runner.import_pages(target, seq.n_shared_pages, payload)
             if getattr(self.runner, "has_draft", False):
@@ -387,7 +404,7 @@ class InferenceEngine:
             loop.call_soon_threadsafe(_set_future, fut, None)
             return
         seq, _ = entry
-        n_kv_pages = (len(seq.prompt) + self.pool.page_size - 1) // self.pool.page_size
+        n_kv_pages = self._n_prompt_pages(seq)
         k, v = self.runner.export_pages_device(seq.pages[:n_kv_pages])
         self.scheduler.release_parked(seq)
         loop.call_soon_threadsafe(
@@ -403,6 +420,42 @@ class InferenceEngine:
         self._inbox.put(("export_device", (request_id, fut, loop)))
         return await fut
 
+    def _n_prompt_pages(self, seq) -> int:
+        """Pages a parked prompt's KV occupies (export side). The import
+        side deliberately uses one page less when the prompt's final token
+        starts a fresh page (_admit_kv_pending: ceil((len-1)/ps)) — the
+        decode step recomputes that token's KV as it generates."""
+        return (len(seq.prompt) + self.pool.page_size - 1) // self.pool.page_size
+
+    def _export_meta(self, rid: str, fut, loop) -> None:
+        """Page count of a parked request (no pop — the stream export
+        reads chunk by chunk while the request stays parked)."""
+        entry = self._parked.get(rid)
+        if entry is None:
+            loop.call_soon_threadsafe(_set_future, fut, None)
+            return
+        seq, _ = entry
+        loop.call_soon_threadsafe(_set_future, fut, self._n_prompt_pages(seq))
+
+    def _export_chunk(self, rid: str, start: int, n: int, last: bool, fut, loop) -> None:
+        """Export pages [start, start+n) of a parked request; `last` pops
+        and releases. Runs on the step thread between steps, so each chunk
+        read interleaves with decode work instead of one long pool read."""
+        entry = self._parked.get(rid)
+        if entry is None:
+            loop.call_soon_threadsafe(_set_future, fut, None)
+            return
+        seq, _ = entry
+        payload = self.runner.export_pages(seq.pages[start : start + n])
+        payload["offset"] = start
+        # importers validate coverage against this before trusting the
+        # stream (a truncated transfer must recompute, never half-import)
+        payload["total_pages"] = self._n_prompt_pages(seq)
+        if last:
+            self._parked.pop(rid, None)
+            self.scheduler.release_parked(seq)
+        loop.call_soon_threadsafe(_set_future, fut, payload)
+
     def _export_parked(self, rid: str, fut, loop, discard: bool = False) -> None:
         entry = self._parked.pop(rid, None)
         if entry is None:
@@ -411,7 +464,7 @@ class InferenceEngine:
         seq, _ = entry
         payload = None
         if not discard:
-            n_kv_pages = (len(seq.prompt) + self.pool.page_size - 1) // self.pool.page_size
+            n_kv_pages = self._n_prompt_pages(seq)
             payload = self.runner.export_pages(seq.pages[:n_kv_pages])
         self.scheduler.release_parked(seq)
         loop.call_soon_threadsafe(fut.set_result, payload)
@@ -577,6 +630,32 @@ class InferenceEngine:
         fut: asyncio.Future = loop.create_future()
         self._inbox.put(("export", (request_id, fut, loop, discard)))
         return await fut
+
+    async def export_parked_kv_stream(self, request_id: str, chunk_pages: int = 16):
+        """Chunked parked-KV export (reference disagg-serving.md bootstrap
+        handoff: the decode worker pulls KV in bounded pieces instead of
+        one monolithic message). Each chunk is read on the step thread
+        between decode steps, so a 70B-scale transfer neither stalls
+        decode for its full duration nor materializes the whole prompt's
+        KV in one host buffer. Yields payload dicts carrying "offset"."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("export_meta", (request_id, fut, loop)))
+        total = await fut
+        if total is None:
+            return
+        chunk_pages = max(1, int(chunk_pages))
+        for start in range(0, total, chunk_pages):
+            n = min(chunk_pages, total - start)
+            last = start + n >= total
+            fut = loop.create_future()
+            self._inbox.put(
+                ("export_chunk", (request_id, start, n, last, fut, loop))
+            )
+            payload = await fut
+            if payload is None:  # parked entry expired mid-stream
+                return
+            yield payload
 
     def _publish_fpm(self, kind: str, wall: float, n_tok: int) -> None:
         st = self.scheduler.stats

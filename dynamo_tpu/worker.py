@@ -82,6 +82,8 @@ def parse_args(argv=None):
     # infra
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"],
                    help="disaggregation role; prefill workers park KV for decode pulls")
+    p.add_argument("--disagg-chunk-pages", type=int, default=16,
+                   help="P->D KV pull chunk size in pages (0 = one message)")
     p.add_argument("--shadow", action="store_true",
                    help="active/passive failover: load+warm the engine but "
                         "only register when the active worker's discovery "
@@ -303,6 +305,7 @@ async def async_main(args) -> None:
                 runtime, engine, card,
                 namespace=args.namespace, component=args.component,
                 endpoint=args.endpoint, disagg_role=args.disagg_role,
+                disagg_chunk_pages=args.disagg_chunk_pages,
             )
 
         shadow = ShadowServer(
@@ -315,6 +318,7 @@ async def async_main(args) -> None:
             runtime, engine, card,
             namespace=args.namespace, component=args.component, endpoint=args.endpoint,
             disagg_role=args.disagg_role,
+            disagg_chunk_pages=args.disagg_chunk_pages,
         )
         print(f"worker serving {card.name} at {path}", flush=True)
     promotion_failed = False

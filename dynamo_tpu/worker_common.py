@@ -63,9 +63,11 @@ class DisaggDecodeAdapter:
     process prefill engines (colocated disagg) transfer device-to-device;
     remote ones go over the request plane (host-staged DCN path)."""
 
-    def __init__(self, engine: InferenceEngine, runtime: DistributedRuntime):
+    def __init__(self, engine: InferenceEngine, runtime: DistributedRuntime,
+                 chunk_pages: int = 16):
         self.engine = engine
         self.runtime = runtime
+        self.chunk_pages = chunk_pages  # 0 = monolithic single-message pull
         self._fetch_clients = {}
 
     async def _fetch(self, src, parent_ctx=None) -> Optional[dict]:
@@ -95,11 +97,34 @@ class DisaggDecodeAdapter:
             md["traceparent"] = parent_ctx.metadata["traceparent"]
         from dynamo_tpu.runtime.context import Context as _Ctx
 
+        req = {"request_id": src["request_id"]}
+        if self.chunk_pages:
+            req["chunk_pages"] = self.chunk_pages
+        chunks = []
         async for item in client.direct(
-            {"request_id": src["request_id"]}, src["instance_id"], _Ctx(metadata=md)
+            req, src["instance_id"], _Ctx(metadata=md)
         ):
-            return item
-        return None
+            if not self.chunk_pages:
+                return item
+            if item:
+                chunks.append(item)
+        if not chunks:
+            return None
+        if len(chunks) == 1 and "offset" not in chunks[0]:
+            return chunks[0]  # server fell back to the monolithic path
+        if not any(c.get("data") or c.get("device") for c in chunks):
+            return None  # simulated / empty transfer: recompute locally
+        # a truncated stream (prefill-side expiry/abort mid-transfer) must
+        # trigger local recompute, never a half-imported KV cache
+        total = int(chunks[0].get("total_pages") or 0)
+        covered = sum(int(c.get("n_pages") or 0) for c in chunks)
+        if total and covered < total:
+            log.warning(
+                "chunked KV pull truncated (%d/%d pages); recomputing",
+                covered, total,
+            )
+            return None
+        return {"chunks": chunks}
 
     async def generate(self, request, context):
         src = request.get("kv_transfer_src")
@@ -110,7 +135,9 @@ class DisaggDecodeAdapter:
                 log.warning("kv fetch from prefill worker failed: %s", e)
                 payload = None
             request = dict(request)
-            if payload is not None and (payload.get("data") or payload.get("device")):
+            if payload is not None and (
+                payload.get("data") or payload.get("device") or payload.get("chunks")
+            ):
                 request["kv_import"] = payload
             else:
                 # transfer failed → recompute prefill locally (aggregated)
@@ -133,6 +160,7 @@ async def serve_worker(
     publish_fpm: bool = True,
     dp_rank: int = 0,
     disagg_role: Optional[str] = None,  # None/"both" | "prefill" | "decode"
+    disagg_chunk_pages: int = 16,  # P->D pull chunk size (0 = monolithic)
 ) -> ServedWorker:
     instance_id = new_instance_id()
     LOCAL_ENGINES[instance_id] = engine  # colocated-disagg device transfer
@@ -173,10 +201,39 @@ async def serve_worker(
         metadata["fpm_publisher"] = pub.address
 
     # disagg endpoints: prefill workers serve parked-KV pulls; decode
-    # workers (and aggregated) accept transfer-carrying requests
+    # workers (and aggregated) accept transfer-carrying requests.
+    # chunk_pages in the request selects the streamed export (bounded
+    # message sizes, chunk reads interleaved with the prefill engine's
+    # decode steps — disagg-serving.md bootstrap handoff); absent keeps
+    # the single-message path (mockers, old callers).
     async def kv_fetch(request, context):
         req = request or {}
-        return await engine.export_parked_kv(
+        chunk = int(req.get("chunk_pages") or 0)
+        if chunk > 0 and hasattr(engine, "export_parked_kv_stream"):
+            any_sent = False
+            finished = False
+            try:
+                async for part in engine.export_parked_kv_stream(
+                    req.get("request_id"), chunk
+                ):
+                    any_sent = True
+                    yield part
+                finished = True
+                if not any_sent:
+                    yield {}  # parked entry gone: caller recomputes
+            finally:
+                if not finished:
+                    # puller died mid-stream (disconnect/cancel): release
+                    # the parked pages now instead of pinning them for the
+                    # full TTL (the monolithic path releases on first read)
+                    try:
+                        await engine.export_parked_kv(
+                            req.get("request_id"), discard=True
+                        )
+                    except Exception:
+                        pass
+            return
+        yield await engine.export_parked_kv(
             req.get("request_id"), discard=bool(req.get("discard"))
         )
 
@@ -227,7 +284,7 @@ async def serve_worker(
             await c.close()
 
     close_hooks = [_close_fetch_clients]
-    handler = DisaggDecodeAdapter(engine, runtime)
+    handler = DisaggDecodeAdapter(engine, runtime, chunk_pages=disagg_chunk_pages)
 
     engine.start()
     inst = await runtime.serve_endpoint(

@@ -243,3 +243,63 @@ async def test_disagg_remote_path_still_works_without_local_registry():
         for w, rt in ((w_d, rt_d), (w_p, rt_p)):
             await w.stop()
             await rt.shutdown(drain_timeout=1)
+
+
+async def test_disagg_chunked_transfer_matches_aggregated():
+    """Chunked host-staged P->D pull (chunk_pages < prompt pages → multi-
+    frame stream): decode output and usage match the aggregated baseline
+    exactly, and the decode worker still skips prefill compute."""
+    from dynamo_tpu import worker_common
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    prompt = list(range(120, 148))  # 28 tokens = 7 pages of 4
+
+    rt_a, w_a = await _serve_real_engine("agg-chunk", "tpu-worker", None)
+    frt_a, svc_a, base_a = await _stack("agg-chunk", None)
+    try:
+        agg = await _completion_tokens(base_a, prompt)
+    finally:
+        await svc_a.stop()
+        await frt_a.shutdown()
+        await w_a.stop()
+        await rt_a.shutdown(drain_timeout=1)
+
+    async def _serve(realm, component, role, chunk):
+        rt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                                event_transport="inproc")
+        runner = ModelRunner(
+            get_config("tiny"), num_pages=64, page_size=4,
+            max_pages_per_seq=16, decode_buckets=(1, 2, 4),
+            prefill_buckets=(8, 16, 32), seed=7,
+        )
+        engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+        card = ModelCard(name="tiny", tokenizer="byte", context_length=64,
+                         kv_block_size=4)
+        w = await serve_worker(rt, engine, card, component=component,
+                               disagg_role=role, disagg_chunk_pages=chunk)
+        return rt, w
+
+    rt_d, w_d = await _serve("chunk-kv", "tpu-worker", None, 2)
+    rt_p, w_p = await _serve("chunk-kv", "prefill", "prefill", 2)
+    worker_common.LOCAL_ENGINES.clear()  # force the host-staged RPC path
+    frt, svc, base = await _stack("chunk-kv", None)
+    try:
+        entry = svc.manager.get("tiny")
+        for _ in range(100):
+            if entry.prefill_router is not None and entry.prefill_router.active:
+                break
+            await asyncio.sleep(0.05)
+        dis = await _completion_tokens(base, prompt)
+        assert dis["choices"][0]["text"] == agg["choices"][0]["text"]
+        assert dis["usage"] == agg["usage"]
+        prefill_tokens = sum(
+            m.scheduled_tokens for m in w_d.engine.fpm_history if m.kind == "prefill"
+        )
+        assert prefill_tokens == 0, "KV must arrive chunked, not recompute"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for w, rt in ((w_d, rt_d), (w_p, rt_p)):
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
