@@ -66,7 +66,7 @@ def main() -> None:
     prompt_len = 128
     decode_steps = 128
     page_size = 64
-    max_pages = 8  # 512-token max context for the bench
+    max_pages = 8
 
     import os
 
@@ -102,7 +102,8 @@ def main() -> None:
 
     tokens = rng.integers(1, config.vocab_size, B).tolist()
     lens = [prompt_len] * B
-    T = 16  # fused decode steps per dispatch (engine multi-step decode)
+    # fused decode steps per dispatch (engine multi-step decode cadence)
+    T = int(os.environ.get("DYN_BENCH_T", "32"))
 
     def run_fused(step_idx):
         nonlocal tokens, lens
@@ -113,7 +114,7 @@ def main() -> None:
     # warmup (compile); decode_multi device_gets, which is the honest sync
     run_fused(0)
 
-    n_dispatch = decode_steps // T
+    n_dispatch = max(decode_steps // T, 1)
     t0 = time.perf_counter()
     for s in range(n_dispatch):
         run_fused(1 + s * T)

@@ -278,15 +278,61 @@ class InferenceEngine:
                     time.sleep(self.idle_sleep_s)
                 continue
             t0 = time.monotonic()
-            if isinstance(plan, PrefillPlan):
-                self._run_prefill(plan)
-                kind, n_tok = "prefill", len(plan.chunk)
-            else:
-                self._run_decode(plan)
-                kind, n_tok = "decode", len(plan.seqs)
+            try:
+                if isinstance(plan, PrefillPlan):
+                    self._run_prefill(plan)
+                    kind, n_tok = "prefill", len(plan.chunk)
+                else:
+                    self._run_decode(plan)
+                    kind, n_tok = "decode", len(plan.seqs)
+            except Exception:
+                # one bad step (malformed import, shape bug, OOM) must fail
+                # ITS sequences, never kill the step thread: a dead loop
+                # strands every queued request with no error and no stream
+                # end (the failure surfaces only as a distributed hang)
+                seqs = [plan.seq] if isinstance(plan, PrefillPlan) else plan.seqs
+                log.exception(
+                    "engine step failed; erroring %d sequence(s)", len(seqs)
+                )
+                for seq in seqs:
+                    try:
+                        self._emit(seq, [], "error")
+                        self.scheduler.abort(seq.request_id)
+                    except Exception:
+                        log.exception("failed to fail sequence %s", seq.request_id)
+                self._recover_poisoned_pools()
+                continue
             self._publish_fpm(kind, time.monotonic() - t0, n_tok)
             self._publish_kv_events()
         log.info("engine step loop stopped")
+
+    def _recover_poisoned_pools(self) -> None:
+        """A step that fails AFTER its jit dispatch consumed the donated
+        KV pools leaves them deleted — every later step would raise
+        'Array has been deleted' and the worker degrades into an error
+        loop while still registered healthy. Detect that, rebuild zeroed
+        pools, and fail everything whose device KV was lost (waiting
+        sequences keep: they own no pages yet and prefill from scratch).
+        Host/disk tiers keep their copies — those bytes are real."""
+        if not getattr(self.runner, "pools_deleted", lambda: False)():
+            return
+        log.error("KV pools were consumed by a failed step; rebuilding "
+                  "(all device-cached blocks lost)")
+        for seq in list(self.scheduler.active):
+            try:
+                self._emit(seq, [], "error")
+                self.scheduler.abort(seq.request_id)
+            except Exception:
+                log.exception("failed to fail sequence %s", seq.request_id)
+        for rid, (seq, _) in list(self._parked.items()):
+            try:
+                self._parked.pop(rid, None)
+                self.scheduler.release_parked(seq)
+            except Exception:
+                log.exception("failed to release parked %s", rid)
+        self.runner.reset_kv_pools()
+        self.pool.reset()
+        self._publish_kv_events()
 
     def _drain_inbox(self) -> None:
         while True:
@@ -331,47 +377,62 @@ class InferenceEngine:
         """Disagg-decode sequences: admit + import transferred KV pages."""
         still: List[Sequence] = []
         for seq in self._kv_pending:
-            seq.tokens = list(seq.prompt)
-            seq.n_prompt0 = len(seq.prompt)
-            if not self.scheduler.admit_with_kv(seq):
-                still.append(seq)
-                continue
-            payload = seq.kv_import or {}
-            seq.kv_import = None
-            n_kv_pages = (len(seq.prompt) - 1 + self.pool.page_size - 1) // self.pool.page_size
-            target = seq.pages[seq.n_shared_pages:n_kv_pages]
-            if target and payload.get("device"):
-                # colocated transfer: staged buffers are already on device
-                self.runner.import_pages_device(
-                    target, seq.n_shared_pages, payload["k"], payload["v"]
-                )
-            elif target and payload.get("chunks"):
-                # chunked host-staged transfer: each chunk covers global
-                # pages [offset, offset+n); skip the prefix-cache-shared
-                # span and scatter the rest
-                ns = seq.n_shared_pages
-                for ch in payload["chunks"]:
-                    off, n = int(ch.get("offset", 0)), int(ch["n_pages"])
-                    lo, hi = max(off, ns), min(off + n, n_kv_pages)
-                    if lo >= hi or not ch.get("data"):
-                        continue
-                    self.runner.import_pages(seq.pages[lo:hi], lo - off, ch)
-            elif target and payload.get("data"):
-                self.runner.import_pages(target, seq.n_shared_pages, payload)
-            if getattr(self.runner, "has_draft", False):
-                # transferred KV covers the target model only; rebuild the
-                # draft pools by (cheap) draft prefill — starting after the
-                # prefix-cache-shared pages, whose draft KV the sequence
-                # that populated them already wrote
-                toks = seq.prompt[:-1]
-                chunk = self.scheduler.chunk_size
-                shared = seq.n_shared_pages * self.pool.page_size
-                for start in range(shared, len(toks), chunk):
-                    self.runner.draft_prefill(
-                        toks[start : start + chunk], start, seq.pages,
-                        prior_len=start,
-                    )
+            try:
+                self._admit_one_kv(seq, still)
+            except Exception:
+                # a malformed/corrupt transfer payload (bad shape metadata,
+                # truncated bytes) must fail THIS request, not kill the
+                # step thread — this runs from _drain_inbox, outside the
+                # step-loop guard
+                log.exception("KV import failed; erroring %s", seq.request_id)
+                try:
+                    self._emit(seq, [], "error")
+                    self.scheduler.abort(seq.request_id)
+                except Exception:
+                    log.exception("failed to fail sequence %s", seq.request_id)
         self._kv_pending = still
+
+    def _admit_one_kv(self, seq: Sequence, still: List[Sequence]) -> None:
+        seq.tokens = list(seq.prompt)
+        seq.n_prompt0 = len(seq.prompt)
+        if not self.scheduler.admit_with_kv(seq):
+            still.append(seq)
+            return
+        payload = seq.kv_import or {}
+        seq.kv_import = None
+        n_kv_pages = (len(seq.prompt) - 1 + self.pool.page_size - 1) // self.pool.page_size
+        target = seq.pages[seq.n_shared_pages:n_kv_pages]
+        if target and payload.get("device"):
+            # colocated transfer: staged buffers are already on device
+            self.runner.import_pages_device(
+                target, seq.n_shared_pages, payload["k"], payload["v"]
+            )
+        elif target and payload.get("chunks"):
+            # chunked host-staged transfer: each chunk covers global
+            # pages [offset, offset+n); skip the prefix-cache-shared
+            # span and scatter the rest
+            ns = seq.n_shared_pages
+            for ch in payload["chunks"]:
+                off, n = int(ch.get("offset", 0)), int(ch["n_pages"])
+                lo, hi = max(off, ns), min(off + n, n_kv_pages)
+                if lo >= hi or not ch.get("data"):
+                    continue
+                self.runner.import_pages(seq.pages[lo:hi], lo - off, ch)
+        elif target and payload.get("data"):
+            self.runner.import_pages(target, seq.n_shared_pages, payload)
+        if getattr(self.runner, "has_draft", False):
+            # transferred KV covers the target model only; rebuild the
+            # draft pools by (cheap) draft prefill — starting after the
+            # prefix-cache-shared pages, whose draft KV the sequence
+            # that populated them already wrote
+            toks = seq.prompt[:-1]
+            chunk = self.scheduler.chunk_size
+            shared = seq.n_shared_pages * self.pool.page_size
+            for start in range(shared, len(toks), chunk):
+                self.runner.draft_prefill(
+                    toks[start : start + chunk], start, seq.pages,
+                    prior_len=start,
+                )
 
     def _run_embeds(self) -> None:
         """Batch all pending embedding requests into one encoder pass."""
@@ -446,6 +507,10 @@ class InferenceEngine:
             loop.call_soon_threadsafe(_set_future, fut, None)
             return
         seq, _ = entry
+        # an actively-consumed transfer must not expire between chunks: a
+        # multi-GB pull interleaved with decode steps can legitimately
+        # outlive the parked TTL, so each chunk read renews the lease
+        self._parked[rid] = (seq, time.monotonic() + self.parked_ttl_s)
         payload = self.runner.export_pages(seq.pages[start : start + n])
         payload["offset"] = start
         # importers validate coverage against this before trusting the

@@ -142,3 +142,19 @@ class PagePool:
     def drain_events(self) -> List[KvEvent]:
         ev, self.events = self.events, []
         return ev
+
+    def reset(self) -> None:
+        """Forget every block and reference: the device pool's CONTENTS
+        were lost (e.g. rebuilt after a failed donated step), so every
+        cached page and in-flight allocation is garbage. Emits remove
+        events for all registered hashes so router indices and lower-tier
+        credits stay truthful. Callers must have failed/aborted the
+        sequences that held references."""
+        if self.by_hash:
+            self.events.append(KvEvent("remove", list(self.by_hash)))
+        self.free = list(range(self.num_pages - 1, -1, -1))
+        self.ref.clear()
+        self.by_hash.clear()
+        self.hash_of.clear()
+        self.cached.clear()
+        self.parent_of.clear()

@@ -40,20 +40,30 @@ def _decode_loop(
     mesh,  # for sharded pallas attention on TP meshes (None = single dev)
     n_steps: int,
     params,
-    tokens0,  # [B] current token per seq
-    positions0,  # [B] write position of tokens0 (-1 = padding slot)
+    tokens0,  # [B] int32 current token per seq — host-packed OR a device
+    # array chained from the previous dispatch's output (pipelining: the
+    # caller never has to sync tokens to host between dispatches)
+    packed,  # int32 [B + B*MP (+B if lora) + 1]: pos|pt|adapters|step
     k_pool,
     v_pool,
-    page_table,  # [B, MP]
     sampling: SamplingParams,
-    step0,  # scalar int32 PRNG step base
     lora=None,  # stacked multi-LoRA tree (models/lora.py)
-    adapter_idx=None,  # [B] adapter slot per sequence
 ):
     """n_steps decode iterations fused in one jit: forward → sample → feed
     the sampled token back, entirely on device (lax.scan). Amortizes the
     per-dispatch host sync (dominant through remote-TPU links) over n_steps
-    tokens. Returns (tokens [B, n_steps], k_pool, v_pool)."""
+    tokens. All per-dispatch dynamic ints arrive in ONE packed array —
+    each separate host array would be its own host→device transfer, and on
+    a relay-attached TPU each transfer costs a full round trip (measured
+    ~5-10 ms each, dwarfing the step itself).
+    Returns (tokens [B, n_steps], k_pool, v_pool)."""
+    B = sampling.temperature.shape[0]
+    n_fields = 2 if lora is not None else 1
+    MP = (packed.shape[0] - 1 - n_fields * B) // B
+    positions0 = packed[:B]
+    page_table = packed[B : B + B * MP].reshape(B, MP)
+    adapter_idx = packed[B + B * MP : 2 * B + B * MP] if lora is not None else None
+    step0 = packed[-1]
 
     def body(carry, t):
         tok, kp, vp = carry
@@ -66,23 +76,28 @@ def _decode_loop(
         s = sample(logits[:, 0, :], sampling, step0 + t)
         return (s, kp, vp), s
 
-    (_, k_pool, v_pool), toks = lax.scan(
+    (last, k_pool, v_pool), toks = lax.scan(
         body, (tokens0, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32)
     )
-    return toks.T, k_pool, v_pool  # [B, n_steps]
+    # `last` (== toks[:, -1]) is returned as its own output so a chaining
+    # caller can feed it straight into the next dispatch — slicing the
+    # token matrix caller-side would be an extra eager device program,
+    # which through a TPU relay costs a full program round trip
+    return toks.T, last, k_pool, v_pool  # [B, n_steps], [B]
 
 
 def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
-    """KV wire format for P→D transfer and G2 offload: [L, Hk, n, PS, D]
-    arrays as raw bytes + shape/dtype metadata. Single definition — the
-    engine and host tier must not re-implement it."""
+    """KV wire format for P→D transfer and G2 offload: [L, n, PS, Hk, D]
+    (token-major, page axis 1 — the pool layout) arrays as raw bytes +
+    shape/dtype metadata. Single definition — the engine and host tier
+    must not re-implement it."""
     return {
         "data": True,
         "k": k.tobytes(),
         "v": v.tobytes(),
         "shape": list(k.shape),
         "dtype": str(k.dtype),
-        "n_pages": int(k.shape[2]),
+        "n_pages": int(k.shape[1]),
     }
 
 
@@ -237,6 +252,10 @@ class ModelRunner:
             static_argnums=(0,),  # n_steps
             donate_argnums=(4, 5),  # k_pool, v_pool
         )
+        # device-resident sampling cache: batches re-send identical sampling
+        # params every dispatch; transferring them each time costs one relay
+        # round trip PER ARRAY (see _decode_loop)
+        self._sampling_cache: Dict[Any, SamplingParams] = {}
         if draft_config is not None:
             from dynamo_tpu.engine.spec_decode import spec_rounds
 
@@ -345,21 +364,90 @@ class ModelRunner:
         """n_steps fused decode iterations (one host sync total). Page
         tables must already cover positions[i] + n_steps slots. Returns
         sampled tokens [B_bucket, n_steps]."""
-        n = len(tokens)
-        B = _next_bucket(self.decode_buckets, n)
-        tok = np.zeros(B, np.int32)
-        tok[:n] = tokens
-        pos = np.full(B, -1, np.int32)
-        pos[:n] = positions
-        pt = self._pad_page_table(page_tables, B)
-
-        toks, self.k_pool, self.v_pool = self._jit_decode_loop(
-            n_steps, self.params, jnp.asarray(tok), jnp.asarray(pos),
-            self.k_pool, self.v_pool, jnp.asarray(pt),
-            _pad_sampling(_as_sampling(sampling), B), jnp.int32(step),
-            self.lora, self._adapter_array(adapters, B),
+        toks, _ = self.decode_multi_async(
+            n_steps, tokens, positions, page_tables, sampling, step, adapters
         )
         return np.asarray(jax.device_get(toks))
+
+    def decode_multi_async(
+        self,
+        n_steps: int,
+        tokens,  # List[int] OR device int32 [>=B] (previous out[:, -1])
+        positions: List[int],
+        page_tables: List[List[int]],
+        sampling,
+        step: int,
+        adapters: Optional[List[int]] = None,
+    ):
+        """decode_multi without the host sync: returns (toks, last) DEVICE
+        arrays — toks [B_bucket, n_steps] and last [B_bucket] (the final
+        column, produced inside the jit). `tokens` may be the previous
+        dispatch's `last`, so consecutive dispatches pipeline on device
+        with no round trip between them — the caller device_gets token
+        batches one dispatch behind the chip (the continuous-batching
+        engine overlaps its bookkeeping the same way)."""
+        n = len(positions)
+        B = _next_bucket(self.decode_buckets, n)
+        pt = self._pad_page_table(page_tables, B)
+        MP = pt.shape[1]
+        # one packed transfer for all per-dispatch ints (see _decode_loop)
+        packed = np.zeros(B * (1 + MP) + (B if self.lora is not None else 0) + 1,
+                          np.int32)
+        packed[:B] = -1
+        packed[:n] = positions
+        packed[B : B + B * MP] = pt.ravel()
+        if self.lora is not None and adapters:
+            packed[B + B * MP : B + B * MP + len(adapters)] = adapters
+        packed[-1] = step
+
+        if isinstance(tokens, jax.Array):
+            if tokens.shape[0] != B:
+                raise ValueError(
+                    f"chained token array has batch {tokens.shape[0]}, "
+                    f"dispatch bucket is {B} — chaining requires a stable "
+                    "bucket (sync to host when the batch re-buckets)"
+                )
+            tok = tokens  # pass through untouched: no eager slice programs
+        else:
+            tok_h = np.zeros(B, np.int32)
+            tok_h[:n] = tokens
+            tok = jnp.asarray(tok_h)
+
+        toks, last, self.k_pool, self.v_pool = self._jit_decode_loop(
+            n_steps, self.params, tok, jnp.asarray(packed),
+            self.k_pool, self.v_pool,
+            self._device_sampling(sampling, B), self.lora,
+        )
+        return toks, last
+
+    def _device_sampling(self, sampling, B: int) -> SamplingParams:
+        """Device-resident cache of padded sampling params. Batches resend
+        identical sampling lists every dispatch; materializing them fresh
+        costs several host→device transfers per dispatch (each a full relay
+        round trip). SamplingParams instances pass through (assumed already
+        on device and bucket-sized by the caller)."""
+        if isinstance(sampling, SamplingParams):
+            return _pad_sampling(sampling, B)
+        key = (
+            B,
+            tuple(sampling["temperature"]),
+            tuple(sampling["top_k"]),
+            tuple(sampling["top_p"]),
+            tuple(sampling["seeds"]),
+        )
+        hit = self._sampling_cache.get(key)
+        if hit is None:
+            pad = B - len(sampling["temperature"])
+            hit = SamplingParams.make(
+                temperature=list(sampling["temperature"]) + [0.0] * pad,
+                top_k=list(sampling["top_k"]) + [0] * pad,
+                top_p=list(sampling["top_p"]) + [1.0] * pad,
+                seeds=list(sampling["seeds"]) + [0] * pad,
+            )
+            if len(self._sampling_cache) >= 512:
+                self._sampling_cache.clear()
+            self._sampling_cache[key] = hit
+        return hit
 
     @property
     def has_draft(self) -> bool:
@@ -425,7 +513,7 @@ class ModelRunner:
                 gamma, n_rounds, self.params, self.draft_params,
                 jnp.asarray(tok), jnp.asarray(pos),
                 self.k_pool, self.v_pool, self.draft_k_pool, self.draft_v_pool,
-                jnp.asarray(pt), _pad_sampling(_as_sampling(sampling), B),
+                jnp.asarray(pt), self._device_sampling(sampling, B),
                 jnp.int32(step), self.lora, self._adapter_array(adapters, B),
             )
         )
@@ -488,23 +576,21 @@ class ModelRunner:
     # re-quantizes (per-vector scales are recomputed; error is one extra
     # rounding, bounded by the int8 step).
     def _dense_pages(self, pool, idx):
-        sel = jax.tree.map(lambda a: a[:, :, idx], pool)
-        if isinstance(sel, dict):
-            from dynamo_tpu.models.quant import kv_dequantize
+        # token-major pools: page axis 1 for every representation
+        if isinstance(pool, dict):
+            from dynamo_tpu.models.quant import kv_pool_dequantize
 
-            return kv_dequantize(sel, dtype=self.dtype)
-        return sel
+            sel = jax.tree.map(lambda a: a[:, idx], pool)
+            return kv_pool_dequantize(sel, dtype=self.dtype)
+        return pool[:, idx]
 
     def _store_pages(self, pool, idx, dense):
         if isinstance(pool, dict):
-            from dynamo_tpu.models.quant import kv_quantize
+            from dynamo_tpu.models.quant import kv_pool_quantize
 
-            d = kv_quantize(dense)
-            return {
-                "q": pool["q"].at[:, :, idx].set(d["q"]),
-                "s": pool["s"].at[:, :, idx].set(d["s"]),
-            }
-        return pool.at[:, :, idx].set(dense)
+            d = kv_pool_quantize(dense)
+            return jax.tree.map(lambda a, u: a.at[:, idx].set(u), pool, d)
+        return pool.at[:, idx].set(dense)
 
     def export_pages_device(self, pages: List[int]):
         """Gather whole KV pages into fresh device buffers (no host copy).
@@ -519,13 +605,13 @@ class ModelRunner:
         host-staged path below is the DCN fallback)."""
         idx = jnp.asarray(np.asarray(target_pages, np.int32))
         n = len(target_pages)
-        self.k_pool = self._store_pages(self.k_pool, idx, k[:, :, offset : offset + n])
-        self.v_pool = self._store_pages(self.v_pool, idx, v[:, :, offset : offset + n])
+        self.k_pool = self._store_pages(self.k_pool, idx, k[:, offset : offset + n])
+        self.v_pool = self._store_pages(self.v_pool, idx, v[:, offset : offset + n])
 
     # -- disagg KV transfer (host-staged DCN path, SURVEY.md §2.11) ---------
     def export_pages(self, pages: List[int]) -> Dict[str, Any]:
         """Device→host read of whole KV pages for P→D transfer. Layout on
-        the wire: [L, Hk, n_pages, PS, D] per pool, raw bytes."""
+        the wire: [L, n_pages, PS, Hk, D] per pool, raw bytes."""
         idx = jnp.asarray(np.asarray(pages, np.int32))
         k = np.asarray(jax.device_get(self._dense_pages(self.k_pool, idx)))
         v = np.asarray(jax.device_get(self._dense_pages(self.v_pool, idx)))
@@ -541,8 +627,40 @@ class ModelRunner:
         k, v = arrays
         sel = slice(offset, offset + len(target_pages))
         idx = jnp.asarray(np.asarray(target_pages, np.int32))
-        self.k_pool = self._store_pages(self.k_pool, idx, jnp.asarray(k[:, :, sel]))
-        self.v_pool = self._store_pages(self.v_pool, idx, jnp.asarray(v[:, :, sel]))
+        self.k_pool = self._store_pages(self.k_pool, idx, jnp.asarray(k[:, sel]))
+        self.v_pool = self._store_pages(self.v_pool, idx, jnp.asarray(v[:, sel]))
+
+    def pools_deleted(self) -> bool:
+        """True when the KV pool buffers were consumed by donation into a
+        step that then FAILED — the arrays exist as tracers but their
+        device memory is gone, and every later step raises."""
+        try:
+            return any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in jax.tree.leaves((self.k_pool, self.v_pool))
+            )
+        except Exception:
+            return True
+
+    def reset_kv_pools(self) -> None:
+        """Rebuild zeroed KV pools with the original shapes/sharding (the
+        recovery path after pools_deleted()). All cached KV content is
+        lost — the caller must also reset its PagePool bookkeeping."""
+        k_pool, v_pool = llama.make_kv_pool(
+            self.config, self.num_pages, self.page_size, self.dtype,
+            kv_quantize=self.kv_quantize,
+        )
+        sh = self.policy.kv_pool_sharding_tree(k_pool)
+        self.k_pool = jax.device_put(k_pool, sh)
+        self.v_pool = jax.device_put(v_pool, sh)
+        if self.draft_config is not None:
+            dk, dv = llama.make_kv_pool(
+                self.draft_config, self.num_pages, self.page_size, self.dtype,
+                kv_quantize=self.kv_quantize,
+            )
+            dsh = self.policy.kv_pool_sharding_tree(dk)
+            self.draft_k_pool = jax.device_put(dk, dsh)
+            self.draft_v_pool = jax.device_put(dv, dsh)
 
     # -- memory ------------------------------------------------------------
     def kv_pool_bytes(self) -> int:

@@ -37,12 +37,29 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
+# On-disk/object block layout version. v2 = token-major [L, PS, Hk, D]
+# pages (models/llama.py make_kv_pool); v1 (implicit, no field) was
+# head-major [L, Hk, PS, D]. Readers reject other versions — adopting an
+# old-layout block would import transposed KV (silently wrong activations
+# when PS == Hk, a shape crash otherwise).
+BLOCK_LAYOUT_VERSION = 2
+
+
+class BlockLayoutMismatch(ValueError):
+    pass
+
+
 def encode_block(parent_hash, k: np.ndarray, v: np.ndarray) -> bytes:
     """Shared tier codec: 8-byte LE header length, JSON header, raw k, raw
     v. Both the G3 files and G4 objects use exactly this format so blocks
     demote across tiers byte-for-byte."""
     header = json.dumps(
-        {"shape": list(k.shape), "dtype": str(k.dtype), "parent": parent_hash}
+        {
+            "shape": list(k.shape),
+            "dtype": str(k.dtype),
+            "parent": parent_hash,
+            "layout": BLOCK_LAYOUT_VERSION,
+        }
     ).encode()
     return (
         struct.pack("<Q", len(header)) + header
@@ -51,9 +68,14 @@ def encode_block(parent_hash, k: np.ndarray, v: np.ndarray) -> bytes:
 
 
 def decode_block(data: bytes):
-    """Inverse of encode_block → (parent_hash, k, v)."""
+    """Inverse of encode_block → (parent_hash, k, v). Raises
+    BlockLayoutMismatch for blocks written under another pool layout."""
     (hlen,) = struct.unpack("<Q", data[:8])
     header = json.loads(data[8 : 8 + hlen])
+    if header.get("layout") != BLOCK_LAYOUT_VERSION:
+        raise BlockLayoutMismatch(
+            f"block layout {header.get('layout')} != {BLOCK_LAYOUT_VERSION}"
+        )
     dtype = _np_dtype(header["dtype"])
     shape = tuple(header["shape"])
     n = int(np.prod(shape)) * dtype.itemsize
@@ -104,6 +126,14 @@ class DiskKvPool:
                 with open(path, "rb") as f:
                     (hlen,) = struct.unpack("<Q", f.read(8))
                     header = json.loads(f.read(hlen))
+                if header.get("layout") != BLOCK_LAYOUT_VERSION:
+                    # a previous process wrote this under another pool
+                    # layout — unusable; drop it rather than serving
+                    # transposed KV later
+                    log.warning("dropping %s: stale block layout %s",
+                                name, header.get("layout"))
+                    os.unlink(path)
+                    continue
                 entries.append(
                     (os.path.getmtime(path), int(name[:-4], 16), header.get("parent"))
                 )
@@ -289,16 +319,24 @@ class DiskKvPool:
 
     def _read_file(self, block_hash: int):
         with open(self._path(block_hash), "rb") as f:
-            _, k, v = decode_block(f.read())
+            try:
+                _, k, v = decode_block(f.read())
+            except BlockLayoutMismatch:
+                # rescan drops stale-layout files, but a shared root can
+                # gain them underneath a live process — data miss
+                log.warning("block %x has a stale layout on disk; ignoring",
+                            block_hash)
+                return None, None
         return k, v
 
     def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
-        """Stacked [L, Hk, n, PS, D] arrays (HostKvPool-compatible)."""
+        """Stacked [L, n, PS, Hk, D] arrays (HostKvPool-compatible)."""
         pairs = [self.get_block(h) for h in hashes]
         if not pairs or pairs[0][0] is None:
             return None, None
-        k = np.stack([p[0] for p in pairs], axis=2)
-        v = np.stack([p[1] for p in pairs], axis=2)
+        # token-major wire layout: page axis 1
+        k = np.stack([p[0] for p in pairs], axis=1)
+        v = np.stack([p[1] for p in pairs], axis=1)
         return k, v
 
 
@@ -355,8 +393,8 @@ class TieredKv:
         for h in hashes:
             if h in self.host:
                 k, v = self.host.get([h])
-                k = k[:, :, 0] if k is not None else None
-                v = v[:, :, 0] if v is not None else None
+                k = k[:, 0] if k is not None else None
+                v = v[:, 0] if v is not None else None
             elif self.disk is not None and h in self.disk:
                 k, v = self.disk.get_block(h)
             elif self.obj is not None:
@@ -367,7 +405,8 @@ class TieredKv:
                 return None, None
             ks.append(k)
             vs.append(v)
-        return np.stack(ks, axis=2), np.stack(vs, axis=2)
+        # token-major wire layout: page axis 1
+        return np.stack(ks, axis=1), np.stack(vs, axis=1)
 
     def put(self, hashes, parents, k, v) -> None:
         self.host.put(hashes, parents, k, v)

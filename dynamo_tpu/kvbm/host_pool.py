@@ -28,7 +28,7 @@ log = logging.getLogger("dynamo_tpu.kvbm.host")
 class HostBlock:
     block_hash: int
     parent_hash: Optional[int]
-    k: Any  # np.ndarray [L, Hk, PS, D] or None (sim)
+    k: Any  # np.ndarray [L, PS, Hk, D] (one token-major page) or None (sim)
     v: Any
     stored_at: float = field(default_factory=time.monotonic)
 
@@ -58,15 +58,16 @@ class HostKvPool:
         self,
         hashes: List[int],
         parents: List[Optional[int]],
-        k: Optional[np.ndarray],  # [L, Hk, n, PS, D] or None
+        k: Optional[np.ndarray],  # [L, n, PS, Hk, D] or None
         v: Optional[np.ndarray],
     ) -> None:
         for i, (h, p) in enumerate(zip(hashes, parents)):
             if h in self._blocks:
                 self._blocks.move_to_end(h)
                 continue
-            kb = np.ascontiguousarray(k[:, :, i]) if k is not None else None
-            vb = np.ascontiguousarray(v[:, :, i]) if v is not None else None
+            # token-major wire layout [L, n, PS, Hk, D]: page axis 1
+            kb = np.ascontiguousarray(k[:, i]) if k is not None else None
+            vb = np.ascontiguousarray(v[:, i]) if v is not None else None
             self._blocks[h] = HostBlock(h, p, kb, vb)
             self.stats["offloaded"] += 1
         self._enforce_capacity()
@@ -96,15 +97,15 @@ class HostKvPool:
     def get(
         self, hashes: List[int]
     ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
-        """Stacked [L, Hk, n, PS, D] arrays (None if sim/hash-only)."""
+        """Stacked [L, n, PS, Hk, D] arrays (None if sim/hash-only)."""
         blocks = [self._blocks[h] for h in hashes]
         for b in blocks:
             self._blocks.move_to_end(b.block_hash)
         self.stats["onboarded"] += len(blocks)
         if not blocks or blocks[0].k is None:
             return None, None
-        k = np.stack([b.k for b in blocks], axis=2)
-        v = np.stack([b.v for b in blocks], axis=2)
+        k = np.stack([b.k for b in blocks], axis=1)
+        v = np.stack([b.v for b in blocks], axis=1)
         return k, v
 
     def lookup_chain(self, hashes: List[int]) -> List[int]:

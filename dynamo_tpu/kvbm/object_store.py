@@ -233,5 +233,15 @@ class ObjectKvPool:
         data = self.backend.get(self._key(block_hash))
         if data is None:
             return None, None
-        _, k, v = decode_block(data)
+        from dynamo_tpu.kvbm.disk_pool import BlockLayoutMismatch
+
+        try:
+            _, k, v = decode_block(data)
+        except BlockLayoutMismatch:
+            # a shared store can hold objects written by workers running
+            # another pool layout — treat as a data miss (recompute), the
+            # same path as an externally-deleted object
+            log.warning("G4 object %x has a stale block layout; ignoring",
+                        block_hash)
+            return None, None
         return k, v

@@ -110,14 +110,23 @@ def make_kv_pool(
     config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16,
     kv_quantize: Optional[str] = None,
 ):
-    """Pool layout [L, Hk, NP, PS, D]: kv-heads leading so (a) the pool
-    shards over the model axis on a leading dim and (b) Pallas can block
-    (page, head) slices with TPU-legal (PS, D) tiles.
+    """Pool layout [L, NP, PS, Hk, D] — token-major. Chosen for the TPU
+    memory system, measured on v5e:
+    - a page is one CONTIGUOUS PS*Hk*D slab, so the Pallas kernels DMA it
+      in a single transfer (the head-major layout needed Hk strided
+      chunks per page), with a legal (PS, Hk, D) → minor (Hk=8, D=128)
+      tile;
+    - the decode KV append is a scatter whose index dim is the LEADING
+      axis of a [L, NP*PS, Hk, D] view with contiguous [Hk, D] rows —
+      the only scatter form XLA:TPU lowers to a fast in-place update
+      (~6x faster than head-major scatters in the decode loop);
+    - every pool representation (dense, int8 "q", int8 "s") has the page
+      axis at 1, so page indexing tree_maps uniformly.
 
-    kv_quantize="int8" returns dict pools {"q": int8, "s": f32 [L, Hk, NP,
-    PS]} (models/quant.py KV convention) — same page axis (2) everywhere,
-    so page indexing tree_maps over either representation."""
-    shape = (config.n_layers, config.n_kv_heads, num_pages, page_size, config.head_dim)
+    kv_quantize="int8" returns dict pools {"q": int8 [L, NP, PS, Hk, D],
+    "s": f32 [L, NP, PS, Hk]} (models/quant.py KV convention — the scale
+    tree aligns with "q" minus the vector dim, no transposes anywhere)."""
+    shape = (config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim)
     if kv_quantize == "int8":
         mk = lambda: {
             "q": jnp.zeros(shape, jnp.int8),
@@ -159,7 +168,7 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def paged_attention_jnp(
     q: jax.Array,  # [B, S, Hk, G, Dh] (grouped query heads)
-    k_pool_l: jax.Array,  # [Hk, NP, PS, Dh] one layer's key pool
+    k_pool_l: jax.Array,  # [NP, PS, Hk, Dh] one layer's key pool
     v_pool_l: jax.Array,
     page_table: jax.Array,  # [B, MP] int32
     q_positions: jax.Array,  # [B, S] absolute positions of the queries
@@ -177,20 +186,20 @@ def paged_attention_jnp(
             # rides the gather; XLA fuses the cast+scale into operand load.
             # Multiply in f32 (scales are f32) so this path and the Pallas
             # kernels apply identical scale math, then cast the product.
-            g = pool_l["q"][:, page_table].astype(jnp.float32)
-            s = pool_l["s"][:, page_table][..., None]
+            g = pool_l["q"][page_table].astype(jnp.float32)
+            s = pool_l["s"][page_table][..., None]  # aligned with g
             pool_l = (g * s).astype(dtype)
         else:
-            pool_l = pool_l[:, page_table]
-        Hk, B, MP, PS, Dh = pool_l.shape
-        return pool_l.reshape(Hk, B, MP * PS, Dh)
+            pool_l = pool_l[page_table]
+        B, MP, PS, Hk, Dh = pool_l.shape
+        return pool_l.reshape(B, MP * PS, Hk, Dh)
 
     k = gather(k_pool_l, q.dtype)
     v = gather(v_pool_l, q.dtype)
-    Hk, _, C, Dh = k.shape
+    _, C, Hk, Dh = k.shape
 
     scale = Dh**-0.5
-    scores = jnp.einsum("bskgd,kbcd->bkgsc", q, k).astype(jnp.float32) * scale
+    scores = jnp.einsum("bskgd,bckd->bkgsc", q, k).astype(jnp.float32) * scale
     ctx_pos = jnp.arange(C, dtype=jnp.int32)
     valid = (ctx_pos[None, :] < kv_lens[:, None])[:, None, None, None, :]
     causal = ctx_pos[None, None, :] <= q_positions[:, :, None]  # [B,S,C]
@@ -199,7 +208,7 @@ def paged_attention_jnp(
     m = jnp.max(scores, axis=-1, keepdims=True)  # [B,Hk,G,S,1]
     p = jnp.where(mask, jnp.exp(scores - m), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bkgsc,kbcd->bskgd", (p / jnp.maximum(l, 1e-30)).astype(q.dtype), v)
+    out = jnp.einsum("bkgsc,bckd->bskgd", (p / jnp.maximum(l, 1e-30)).astype(q.dtype), v)
     if return_stats:
         t = lambda x: x.transpose(0, 3, 1, 2, 4)  # [B,Hk,G,S,1] → [B,S,Hk,G,1]
         return out, t(m), t(l)
@@ -207,38 +216,51 @@ def paged_attention_jnp(
 
 
 def _write_kv(pool, l_idx, new, page_table, positions):
-    """Scatter new KV for layer l_idx into the full stacked pool
-    [L, Hk, NP, PS, Dh] — the pool stays a single carried buffer across the
-    layer scan (XLA keeps the update in place), never a per-layer copy.
-    new: [B, S, Hk, Dh]; positions: [B, S] absolute positions, -1 marks
-    padding (dropped via out-of-bounds scatter + mode='drop'). Dict pools
-    (int8 KV, models/quant.py) quantize on write — one scale per written
-    (token, head) vector."""
+    """Scatter new KV for layer l_idx into the full stacked token-major
+    pool [L, NP, PS, Hk, Dh] — the pool stays a single carried buffer
+    across the layer scan, never a per-layer copy. new: [B, S, Hk, Dh];
+    positions: [B, S] absolute positions, -1 marks padding (dropped via
+    out-of-bounds scatter + mode='drop'). Dict pools (int8 KV,
+    models/quant.py) quantize on write — one scale per written
+    (token, head) vector.
+
+    The scatter runs on a [L, NP*PS, Hk, Dh] view with ONE flat token
+    index per written vector, immediately after the (scalar) layer index:
+    the update rows are contiguous [Hk, Dh] slabs addressed by a single
+    leading index — the form XLA:TPU keeps in place (measured ~6x faster
+    in the decode loop than indices straddling a sliced head axis)."""
     if isinstance(pool, dict):
-        L, Hk, NP, PS, Dh = pool["q"].shape
+        L, NP, PS, Hk, Dh = pool["q"].shape
     else:
-        L, Hk, NP, PS, Dh = pool.shape
+        L, NP, PS, Hk, Dh = pool.shape
     B, S = positions.shape
     MP = page_table.shape[1]
     valid = positions >= 0
     pos = jnp.maximum(positions, 0)
     page_of_pos = jnp.clip((pos // PS).astype(jnp.int32), 0, MP - 1)
     page_idx = jnp.take_along_axis(page_table, page_of_pos, axis=1)  # [B, S]
-    page_idx = jnp.where(valid, page_idx, NP)  # OOB → dropped
+    # OOB → dropped; distinct OOB values per padding token keep the index
+    # set duplicate-free so unique_indices=True below stays honest
+    oob = NP + jnp.arange(B * S, dtype=jnp.int32).reshape(B, S)
+    page_idx = jnp.where(valid, page_idx, oob)
     slot = (pos % PS).astype(jnp.int32)
-    pg, sl = page_idx.reshape(-1), slot.reshape(-1)
-    # advanced indices (l_idx, page_idx, slot) are non-contiguous (the Hk
-    # slice sits between them), so their broadcast dim lands in front:
-    # the updated selection has shape [B*S, Hk, Dh]
+    flat = (page_idx * PS + slot).reshape(-1)  # [B*S] flat token cells
+    kw = dict(mode="drop", unique_indices=True)
     if isinstance(pool, dict):
         from dynamo_tpu.models.quant import kv_quantize
 
         d = kv_quantize(new.reshape(B * S, Hk, Dh))
         return {
-            "q": pool["q"].at[l_idx, :, pg, sl].set(d["q"], mode="drop"),
-            "s": pool["s"].at[l_idx, :, pg, sl].set(d["s"], mode="drop"),
+            "q": pool["q"].reshape(L, NP * PS, Hk, Dh)
+            .at[l_idx, flat].set(d["q"], **kw).reshape(L, NP, PS, Hk, Dh),
+            "s": pool["s"].reshape(L, NP * PS, Hk)
+            .at[l_idx, flat].set(d["s"], **kw).reshape(L, NP, PS, Hk),
         }
-    return pool.at[l_idx, :, pg, sl].set(new.reshape(B * S, Hk, Dh), mode="drop")
+    return (
+        pool.reshape(L, NP * PS, Hk, Dh)
+        .at[l_idx, flat].set(new.reshape(B * S, Hk, Dh), **kw)
+        .reshape(L, NP, PS, Hk, Dh)
+    )
 
 
 # --------------------------------------------------------------------------
@@ -251,7 +273,7 @@ def forward(
     params: Params,
     tokens: jax.Array,  # [B, S]
     positions: jax.Array,  # [B, S] absolute positions (padding = -1)
-    k_pool: jax.Array,  # [L, Hk, NP, PS, Dh]
+    k_pool: jax.Array,  # [L, NP, PS, Hk, Dh] (token-major, make_kv_pool)
     v_pool: jax.Array,
     page_table: jax.Array,  # [B, MP]
     kv_lens: jax.Array,  # [B] context length AFTER this step's tokens

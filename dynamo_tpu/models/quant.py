@@ -121,10 +121,14 @@ def tied_logits(h: jax.Array, embed: Any) -> jax.Array:
 
 
 # -- KV-cache quantization --------------------------------------------------
-# A quantized KV pool is the dict {"q": int8 [L, Hk, NP, PS, D],
-# "s": f32 [L, Hk, NP, PS]} — one symmetric scale per cached (token, head)
+# A quantized KV pool is the dict {"q": int8 [L, NP, PS, Hk, D],
+# "s": f32 [L, NP, PS, Hk]} — one symmetric scale per cached (token, head)
 # vector, reduced over the head dim. 132 bytes per vector vs 256 bf16, so
-# decode's per-step KV stream nearly halves. The pool rides through jit /
+# decode's per-step KV stream nearly halves. The token-major pool layout
+# (models/llama.py make_kv_pool) leaves the scales naturally aligned with
+# "q" minus the vector dim — kv_quantize/kv_dequantize apply verbatim,
+# and Pallas blocks one page of scales as a legal (None, PS, Hk) tile
+# (minor dims (PS, Hk) = full array dims). The pool rides through jit /
 # lax.scan / donation as a pytree; attention folds the scales into the
 # softmax scores (K) and probabilities (V) instead of dequantizing whole
 # pages. Reference analog: the KV block manager's fp8 KV layouts
@@ -148,6 +152,21 @@ def kv_dequantize(d: Dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
     host tiers and the disagg wire format stay bf16 so heterogeneous
     workers interoperate; onboarding re-quantizes)."""
     return (d["q"].astype(jnp.float32) * d["s"][..., None]).astype(dtype)
+
+
+def kv_pool_quantize(pool: jax.Array) -> Dict[str, jax.Array]:
+    """Quantize a dense token-major KV pool [..., NP, PS, Hk, D] into the
+    pool convention. With the token-major layout the scales align with
+    "q" minus the vector dim, so this IS kv_quantize — kept as a named
+    entry point so pool-building callers don't depend on that
+    coincidence."""
+    return kv_quantize(pool)
+
+
+def kv_pool_dequantize(pool: Dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of kv_pool_quantize: pool-convention dict → dense
+    [..., NP, PS, Hk, D]."""
+    return kv_dequantize(pool, dtype)
 
 
 def quantize_params(

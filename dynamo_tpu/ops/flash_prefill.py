@@ -46,10 +46,10 @@ def _prefill_kernel_body(
     kv_lens_ref,  # [B] int32 context length (incl. this chunk)
     # blocks
     q_ref,  # [Hk, Sq, G, D]
-    k_ref,  # [Hk, PS, D] one page
-    v_ref,  # [Hk, PS, D]
-    ks_ref,  # [Hk, PS] f32 per-vector K scales (int8 KV) or None
-    vs_ref,  # [Hk, PS] f32 per-vector V scales or None
+    k_ref,  # [PS, Hk, D] one token-major page (one contiguous DMA)
+    v_ref,  # [PS, Hk, D]
+    ks_ref,  # [PS, Hk] f32 per-vector K scales (int8 KV) or None
+    vs_ref,  # [PS, Hk] f32 per-vector V scales or None
     o_ref,  # [Hk, Sq, G, D]
     # scratch (persist across the page loop)
     m_ref,  # [Hk, Sq*G, 1] f32
@@ -85,13 +85,14 @@ def _prefill_kernel_body(
     def _compute():
         Hk, Sq, G, D = q_ref.shape
         q = q_ref[...].astype(jnp.float32).reshape(Hk, Sq * G, D)
-        k = k_ref[...].astype(jnp.float32)  # [Hk, PS, D]
+        k = k_ref[...].astype(jnp.float32)  # [PS, Hk, D]
         s = lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+            q, k, (((2,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
         ) * scale  # [Hk, Sq*G, PS]
         if ks_ref is not None:
             # int8 KV: fold per-(token, head) K scales into the scores
-            s = s * ks_ref[...][:, None, :]
+            # ((PS, Hk) block transposed in-register — 2 KiB)
+            s = s * ks_ref[...].T[:, None, :]
 
         row = lax.broadcasted_iota(jnp.int32, s.shape, 1) // n_groups  # sq idx
         col = lax.broadcasted_iota(jnp.int32, s.shape, 2)  # slot in page
@@ -107,10 +108,10 @@ def _prefill_kernel_body(
 
         l_add = jnp.sum(p, axis=2, keepdims=True)  # raw-probability denom
         if vs_ref is not None:
-            p = p * vs_ref[...][:, None, :]  # fold V scales into p
-        v = v_ref[...].astype(jnp.float32)  # [Hk, PS, D]
+            p = p * vs_ref[...].T[:, None, :]  # fold V scales into p
+        v = v_ref[...].astype(jnp.float32)  # [PS, Hk, D]
         pv = lax.dot_general(
-            p, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+            p, v, (((2,), (0,)), ((0,), (1,))), preferred_element_type=jnp.float32
         )  # [Hk, Sq*G, D]
         acc_ref[...] = acc_ref[...] * alpha + pv
         l_ref[...] = l_ref[...] * alpha + l_add
@@ -133,7 +134,7 @@ def _prefill_kernel_int8(pt, qs, ql, kl, q, k, ks, v, vs, o, m, l, acc, **kw):
 
 def prefill_paged_attention_sharded(
     q: jax.Array,  # [B, S, Hk, G, D] heads sharded over `axis_name`
-    k_pool_l: jax.Array,  # [Hk, NP, PS, D]
+    k_pool_l: jax.Array,  # [NP, PS, Hk, D] (token-major)
     v_pool_l: jax.Array,
     page_table: jax.Array,
     q_start: jax.Array,
@@ -150,9 +151,10 @@ def prefill_paged_attention_sharded(
     from jax.sharding import PartitionSpec as P
 
     heads = P(None, None, axis_name, None, None)
-    pool = P(axis_name, None, None, None)
-    if isinstance(k_pool_l, dict):  # int8 KV: scales shard like the pool
-        pool = {"q": pool, "s": P(axis_name, None, None)}
+    pool = P(None, None, axis_name, None)
+    if isinstance(k_pool_l, dict):  # int8 KV: scales [NP, PS, Hk] shard
+        # the same head axis
+        pool = {"q": pool, "s": P(None, None, axis_name)}
     fn = jax.shard_map(
         functools.partial(prefill_paged_attention, q_block=q_block, interpret=interpret),
         mesh=mesh,
@@ -166,7 +168,7 @@ def prefill_paged_attention_sharded(
 @functools.partial(jax.jit, static_argnames=("q_block", "interpret"))
 def prefill_paged_attention(
     q: jax.Array,  # [B, S, Hk, G, D]
-    k_pool_l: jax.Array,  # [Hk, NP, PS, D]
+    k_pool_l: jax.Array,  # [NP, PS, Hk, D] (token-major)
     v_pool_l: jax.Array,
     page_table: jax.Array,  # [B, MP] int32
     q_start: jax.Array,  # [B] int32 absolute position of query token 0
@@ -181,7 +183,7 @@ def prefill_paged_attention(
     B, S, Hk, G, D = q.shape
     quantized = isinstance(k_pool_l, dict)
     kq = k_pool_l["q"] if quantized else k_pool_l
-    _, NP, PS, _ = kq.shape
+    NP, PS, _, _ = kq.shape
     MP = page_table.shape[1]
     q_block = min(q_block, S)
     while S % q_block:  # largest divisor of S at most the requested block
@@ -198,7 +200,7 @@ def prefill_paged_attention(
         blk_max_pos = qs[b] + sb * q_block + jnp.maximum(rows, 1) - 1
         last = jnp.minimum(blk_max_pos, jnp.maximum(kl[b] - 1, 0)) // PS
         last = jnp.clip(last, 0, MP - 1)
-        return (0, pt[b, jnp.minimum(i, last)], 0, 0)
+        return (pt[b, jnp.minimum(i, last)], 0, 0, 0)
 
     def scale_index(b, sb, i, pt, qs, ql, kl):
         return kv_index(b, sb, i, pt, qs, ql, kl)[:3]
@@ -206,11 +208,13 @@ def prefill_paged_attention(
     q_spec = pl.BlockSpec(
         (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
     )
-    kv_spec = pl.BlockSpec((Hk, None, PS, D), kv_index)
+    # one token-major page = one contiguous PS*Hk*D slab (single DMA)
+    kv_spec = pl.BlockSpec((None, PS, Hk, D), kv_index)
     kw = dict(page_size=PS, q_block=q_block, n_groups=G, scale=scale)
     if quantized:
         kernel = functools.partial(_prefill_kernel_int8, **kw)
-        s_spec = pl.BlockSpec((Hk, None, PS), scale_index)
+        # (None, PS, Hk): minor dims are full array dims — legal tile
+        s_spec = pl.BlockSpec((None, PS, Hk), scale_index)
         in_specs = [q_spec, kv_spec, s_spec, kv_spec, s_spec]
         operands = (qt, kq, k_pool_l["s"], v_pool_l["q"], v_pool_l["s"])
     else:

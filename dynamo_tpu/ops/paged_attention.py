@@ -9,8 +9,10 @@ HBM→VMEM once via BlockSpec index_maps driven by the scalar-prefetched page
 table and accumulates flash-attention-style online softmax in VMEM scratch.
 
 Grid: (B, MP) — page index innermost so the per-sequence running softmax
-state lives across the page loop; all kv heads are processed per step (one
-[Hk, PS, D] DMA per page rather than Hk tiny ones). Ragged contexts cost
+state lives across the page loop; all kv heads are processed per step. A
+token-major page [PS, Hk, D] is one CONTIGUOUS slab in the pool, so each
+grid step issues a single large DMA (the head-major layout needed Hk
+strided chunks per page). Ragged contexts cost
 only what they use: the index_map clamps pages past kv_len to the last
 valid page, so consecutive grid steps see an unchanged block index and
 Pallas elides the HBM→VMEM copy (and pl.when skips the compute).
@@ -38,10 +40,10 @@ def _decode_kernel_body(
     page_table_ref,  # [B, MP] int32 (SMEM)
     kv_lens_ref,  # [B] int32 (SMEM)
     q_ref,  # [Hk, G, D] all query heads for seq b
-    k_ref,  # [Hk, PS, D] one page of keys (all heads)
-    v_ref,  # [Hk, PS, D]
-    ks_ref,  # [Hk, PS] f32 per-vector K scales (int8 KV) or None
-    vs_ref,  # [Hk, PS] f32 per-vector V scales or None
+    k_ref,  # [PS, Hk, D] one token-major page of keys (one contiguous DMA)
+    v_ref,  # [PS, Hk, D]
+    ks_ref,  # [PS, Hk] f32 per-vector K scales (int8 KV) or None
+    vs_ref,  # [PS, Hk] f32 per-vector V scales or None
     o_ref,  # [Hk, G, D]
     # scratch (persist across the page loop)
     m_ref,  # [Hk, G, 1] f32 running max
@@ -67,16 +69,18 @@ def _decode_kernel_body(
     @pl.when(n_valid > 0)
     def _compute():
         q = q_ref[...].astype(jnp.float32)  # [Hk, G, D]
-        k = k_ref[...].astype(jnp.float32)  # [Hk, PS, D]
-        # s[h, g, p] = q[h, g, :] · k[h, p, :]
+        k = k_ref[...].astype(jnp.float32)  # [PS, Hk, D]
+        # s[h, g, p] = q[h, g, :] · k[p, h, :] (batch dim Hk sits at k
+        # axis 1 — dot_general takes batch dims at any position)
         s = lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+            q, k, (((2,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
         ) * scale  # [Hk, G, PS]
         if ks_ref is not None:
             # int8 KV: fold the per-(token, head) K scale into the scores
             # instead of dequantizing K over D (one [Hk, 1, PS] multiply
-            # replaces a [Hk, PS, D] one)
-            s = s * ks_ref[...][:, None, :]
+            # replaces a [PS, Hk, D] one); the (PS, Hk) block transposes
+            # in-register — 2 KiB, negligible next to the page DMA
+            s = s * ks_ref[...].T[:, None, :]
         valid = lax.broadcasted_iota(jnp.int32, s.shape, 2) < n_valid
         s = jnp.where(valid, s, NEG_INF)
 
@@ -89,10 +93,10 @@ def _decode_kernel_body(
         # the softmax denominator sums raw probabilities
         if vs_ref is not None:
             # fold the V scale into p before the PV matmul (same trick)
-            p = p * vs_ref[...][:, None, :]
-        v = v_ref[...].astype(jnp.float32)  # [Hk, PS, D]
+            p = p * vs_ref[...].T[:, None, :]
+        v = v_ref[...].astype(jnp.float32)  # [PS, Hk, D]
         pv = lax.dot_general(
-            p, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+            p, v, (((2,), (0,)), ((0,), (1,))), preferred_element_type=jnp.float32
         )  # [Hk, G, D]
         acc_ref[...] = acc_ref[...] * alpha + pv
         l_ref[...] = l_ref[...] * alpha + l_add
@@ -118,7 +122,7 @@ def _decode_kernel_int8(pt, kl, q, k, ks, v, vs, o, m, l, acc, *, page_size, sca
 
 def decode_paged_attention_sharded(
     q: jax.Array,  # [B, Hk, G, D] heads sharded over `axis_name`
-    k_pool_l: jax.Array,  # [Hk, NP, PS, D] heads sharded over `axis_name`
+    k_pool_l: jax.Array,  # [NP, PS, Hk, D] heads sharded over `axis_name`
     v_pool_l: jax.Array,
     page_table: jax.Array,  # [B, MP] replicated
     kv_lens: jax.Array,  # [B] replicated
@@ -134,9 +138,10 @@ def decode_paged_attention_sharded(
     from jax.sharding import PartitionSpec as P
 
     heads = P(None, axis_name, None, None)
-    pool = P(axis_name, None, None, None)
-    if isinstance(k_pool_l, dict):  # int8 KV: scales shard like the pool
-        pool = {"q": pool, "s": P(axis_name, None, None)}
+    pool = P(None, None, axis_name, None)
+    if isinstance(k_pool_l, dict):  # int8 KV: scales [NP, PS, Hk] shard
+        # the same head axis
+        pool = {"q": pool, "s": P(None, None, axis_name)}
     rep2 = P(None, None)
     rep1 = P(None)
     fn = jax.shard_map(
@@ -152,7 +157,7 @@ def decode_paged_attention_sharded(
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def decode_paged_attention(
     q: jax.Array,  # [B, Hk, G, D]
-    k_pool_l: jax.Array,  # [Hk, NP, PS, D] one layer's key pool
+    k_pool_l: jax.Array,  # [NP, PS, Hk, D] one layer's token-major key pool
     v_pool_l: jax.Array,
     page_table: jax.Array,  # [B, MP] int32
     kv_lens: jax.Array,  # [B] int32 (context length incl. current token)
@@ -164,7 +169,7 @@ def decode_paged_attention(
     B, Hk, G, D = q.shape
     quantized = isinstance(k_pool_l, dict)
     kq = k_pool_l["q"] if quantized else k_pool_l
-    _, NP, PS, _ = kq.shape
+    NP, PS, _, _ = kq.shape
     MP = page_table.shape[1]
     scale = D**-0.5
 
@@ -174,16 +179,19 @@ def decode_paged_attention(
         # a 128-token context in an 8192-token table costs 2 page copies,
         # not 128
         last = jnp.maximum(kl[b] - 1, 0) // PS
-        return (0, pt[b, jnp.minimum(i, last)], 0, 0)
+        return (pt[b, jnp.minimum(i, last)], 0, 0, 0)
 
     def scale_index(b, i, pt, kl):
         return kv_index(b, i, pt, kl)[:3]
 
     q_spec = pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0))
-    kv_spec = pl.BlockSpec((Hk, None, PS, D), kv_index)
+    # one token-major page = one contiguous PS*Hk*D slab: a single DMA,
+    # with a legal (PS, Hk, D) tile (minor dims (Hk, D))
+    kv_spec = pl.BlockSpec((None, PS, Hk, D), kv_index)
     if quantized:
         kernel = functools.partial(_decode_kernel_int8, page_size=PS, scale=scale)
-        s_spec = pl.BlockSpec((Hk, None, PS), scale_index)
+        # (None, PS, Hk): minor dims are full array dims — legal tile
+        s_spec = pl.BlockSpec((None, PS, Hk), scale_index)
         in_specs = [q_spec, kv_spec, s_spec, kv_spec, s_spec]
         operands = (q, kq, k_pool_l["s"], v_pool_l["q"], v_pool_l["s"])
     else:

@@ -130,17 +130,17 @@ class ShardingPolicy:
 
     # -- kv cache ----------------------------------------------------------
     def kv_pool_spec(self) -> P:
-        # [layers, kv_heads, num_pages, page_size, head_dim]
-        return P(None, AXIS_MODEL, None, None, None)
+        # token-major [layers, num_pages, page_size, kv_heads, head_dim]
+        return P(None, None, None, AXIS_MODEL, None)
 
     def kv_pool_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.kv_pool_spec())
 
     def kv_pool_sharding_tree(self, pool):
         """Sharding for a pool that may be a plain array or an int8-KV
-        dict {"q": [L,Hk,NP,PS,D], "s": [L,Hk,NP,PS]} — scales shard over
-        the same kv-head axis as the data."""
-        scale = NamedSharding(self.mesh, P(None, AXIS_MODEL, None, None))
+        dict {"q": [L,NP,PS,Hk,D], "s": [L,NP,PS,Hk]} — scales shard over
+        the same kv-head axis as the data (axis 3 in both layouts)."""
+        scale = NamedSharding(self.mesh, P(None, None, None, AXIS_MODEL))
         return jax.tree.map(
             lambda a: self.kv_pool_sharding() if a.ndim == 5 else scale, pool
         )

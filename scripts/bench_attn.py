@@ -28,8 +28,8 @@ KV_LEN = int(sys.argv[1]) if len(sys.argv) > 1 else 256
 ITERS = 64
 
 rng = np.random.default_rng(0)
-k_pool = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-v_pool = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+k_pool = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+v_pool = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
 pt = jnp.asarray(
     np.stack([np.arange(i * MP, (i + 1) * MP) for i in range(B)]).astype(np.int32)
 )

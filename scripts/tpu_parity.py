@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.models.llama import paged_attention_jnp
-from dynamo_tpu.models.quant import kv_quantize
+from dynamo_tpu.models.quant import kv_pool_quantize
 from dynamo_tpu.ops.flash_prefill import prefill_paged_attention
 from dynamo_tpu.ops.paged_attention import decode_paged_attention
 
@@ -39,8 +39,8 @@ TOL = 3e-2
 
 
 def _pools(rng, Hk, NP, PS, D):
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     return kp, vp
 
 
@@ -52,9 +52,13 @@ def check_decode(quantized: bool) -> float:
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     kv = jnp.asarray(rng.integers(1, MP * PS, B).astype(np.int32))
     if quantized:
-        kp, vp = kv_quantize(kp), kv_quantize(vp)
+        kp, vp = kv_pool_quantize(kp), kv_pool_quantize(vp)
     out = decode_paged_attention(q, kp, vp, pt, kv)
-    ref = paged_attention_jnp(q[:, None], kp, vp, pt, (kv - 1)[:, None], kv)[:, 0]
+    # f32 reference: the kernel accumulates in f32, but a bf16 jnp
+    # reference adds its OWN MXU rounding (dequantized K re-rounded to
+    # bf16) — compare both paths to the same f32 ground truth instead
+    q32 = q.astype(jnp.float32)
+    ref = paged_attention_jnp(q32[:, None], kp, vp, pt, (kv - 1)[:, None], kv)[:, 0]
     return float(
         np.abs(np.asarray(out, np.float32) - np.asarray(ref, np.float32)).max()
     )
@@ -70,14 +74,15 @@ def check_prefill(quantized: bool) -> float:
     ql = np.asarray([128, 128, 100, 77], np.int32)
     kv = jnp.asarray(qs + ql)
     if quantized:
-        kp, vp = kv_quantize(kp), kv_quantize(vp)
+        kp, vp = kv_pool_quantize(kp), kv_pool_quantize(vp)
     out = prefill_paged_attention(
         q, kp, vp, pt, jnp.asarray(qs), jnp.asarray(ql), kv
     )
     pos = np.zeros((B, S), np.int32)
     for b in range(B):
         pos[b, : ql[b]] = np.arange(qs[b], qs[b] + ql[b])
-    ref = paged_attention_jnp(q, kp, vp, pt, jnp.asarray(pos), kv)
+    # f32 reference (see check_decode)
+    ref = paged_attention_jnp(q.astype(jnp.float32), kp, vp, pt, jnp.asarray(pos), kv)
     worst = 0.0
     for b in range(B):
         worst = max(

@@ -20,14 +20,14 @@ def test_host_pool_put_match_get_evict():
     evicted = []
     pool.on_evict(evicted.extend)
 
-    k = np.ones((2, 1, 3, 4, 8), np.float32)  # [L, Hk, n=3, PS, D]
+    k = np.ones((2, 3, 4, 1, 8), np.float32)  # [L, n=3, PS, Hk, D]
     pool.put([101, 102, 103], [None, 101, 102], k, k * 2)
     # capacity 2 → first block evicted LRU
     assert len(pool) == 2 and evicted == [101]
     assert pool.match([101]) == 0
     assert pool.match([102, 103]) == 2
     k2, v2 = pool.get([102, 103])
-    assert k2.shape == (2, 1, 2, 4, 8)
+    assert k2.shape == (2, 2, 4, 1, 8)
     assert (v2 == 2).all()
     assert pool.stats["offloaded"] == 3 and pool.stats["onboarded"] == 2
 
@@ -105,7 +105,7 @@ def test_disk_pool_roundtrip_and_lru(tmp_path):
     dropped = []
     pool.on_evict(dropped.extend)
 
-    k = np.arange(2 * 1 * 4 * 8, dtype=np.float32).reshape(2, 1, 4, 8)
+    k = np.arange(2 * 4 * 1 * 8, dtype=np.float32).reshape(2, 4, 1, 8)
     pool.put_block(201, None, k, k * 3)
     pool.put_block(202, 201, k + 1, k * 5)
     pool.put_block(203, 202, k + 2, k * 7)
@@ -113,9 +113,9 @@ def test_disk_pool_roundtrip_and_lru(tmp_path):
     assert pool.match([201]) == 0 and pool.match([202, 203]) == 2
 
     k2, v2 = pool.get([202, 203])
-    assert k2.shape == (2, 1, 2, 4, 8)
-    np.testing.assert_array_equal(k2[:, :, 0], k + 1)
-    np.testing.assert_array_equal(v2[:, :, 1], k * 7)
+    assert k2.shape == (2, 2, 4, 1, 8)
+    np.testing.assert_array_equal(k2[:, 0], k + 1)
+    np.testing.assert_array_equal(v2[:, 1], k * 7)
     # evicted file is gone from disk (flush: writes are async)
     pool.flush()
     assert len(list(tmp_path.glob("*.kvb"))) == 2
@@ -131,7 +131,7 @@ def test_tiered_host_disk_spill_and_match(tmp_path):
     terminal_drops = []
     tier.on_evict(terminal_drops.extend)
 
-    k = np.ones((2, 1, 3, 4, 8), np.float32)
+    k = np.ones((2, 3, 4, 1, 8), np.float32)
     tier.put([301, 302, 303], [None, 301, 302], k, k * 2)
     # host keeps only the newest block; the others spilled to disk
     assert len(host) == 1 and 303 in host
@@ -139,7 +139,7 @@ def test_tiered_host_disk_spill_and_match(tmp_path):
     assert terminal_drops == []  # demotion is not removal
 
     k2, v2 = tier.get([301, 302, 303])
-    assert k2.shape == (2, 1, 3, 4, 8)
+    assert k2.shape == (2, 3, 4, 1, 8)
     assert (v2 == 2).all()
 
 
@@ -223,7 +223,7 @@ def test_disk_pool_rescan_adopts_previous_files(tmp_path):
 
     from dynamo_tpu.kvbm.disk_pool import DiskKvPool
 
-    k = np.full((2, 1, 4, 8), 5.0, np.float32)
+    k = np.full((2, 4, 1, 8), 5.0, np.float32)
     p1 = DiskKvPool(str(tmp_path), capacity_blocks=8)
     p1.put_block(11, None, k, k * 2)
     p1.put_block(12, 11, k + 1, k * 3)
@@ -233,7 +233,7 @@ def test_disk_pool_rescan_adopts_previous_files(tmp_path):
     p2 = DiskKvPool(str(tmp_path), capacity_blocks=8)
     assert len(p2) == 2 and p2.match([11, 12]) == 2
     k2, v2 = p2.get([11, 12])
-    np.testing.assert_array_equal(v2[:, :, 1], k * 3)
+    np.testing.assert_array_equal(v2[:, 1], k * 3)
 
     # and capacity applies to adopted blocks too
     p3 = DiskKvPool(str(tmp_path), capacity_blocks=1)
@@ -254,7 +254,7 @@ def test_g4_object_pool_and_disk_spill(tmp_path):
     terminal = []
     tier.on_evict(terminal.extend)
 
-    k = np.ones((2, 1, 5, 4, 8), np.float32)
+    k = np.ones((2, 5, 4, 1, 8), np.float32)
     tier.put([501, 502, 503, 504, 505], [None, 501, 502, 503, 504], k, k * 2)
     disk.flush(); obj.flush()
     # host keeps 1; disk keeps 2; the remaining 2 demoted to the object store
@@ -262,7 +262,7 @@ def test_g4_object_pool_and_disk_spill(tmp_path):
     assert terminal == []  # demotion, never removal
     assert tier.match([501, 502, 503, 504, 505]) == 5
     k2, v2 = tier.get([501, 502, 503, 504, 505])
-    assert k2.shape == (2, 1, 5, 4, 8) and (v2 == 2).all()
+    assert k2.shape == (2, 5, 4, 1, 8) and (v2 == 2).all()
 
 
 def test_g4_shared_store_cross_worker_adoption(tmp_path):
@@ -272,7 +272,7 @@ def test_g4_shared_store_cross_worker_adoption(tmp_path):
 
     from dynamo_tpu.kvbm.object_store import FsBackend, ObjectKvPool
 
-    k = np.full((2, 1, 4, 8), 3.0, np.float32)
+    k = np.full((2, 4, 1, 8), 3.0, np.float32)
     p1 = ObjectKvPool(FsBackend(str(tmp_path)))
     p1.put_block(601, None, k, k * 2)
     p1.flush()

@@ -16,8 +16,8 @@ def test_decode_paged_attention_matches_reference(kv_lens):
     rng = np.random.default_rng(0)
     B, Hk, G, D, NP, PS, MP = 4, 2, 4, 64, 16, 8, 4
     q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     kv = jnp.asarray(np.asarray(kv_lens, np.int32))
 
@@ -32,8 +32,8 @@ def test_decode_paged_attention_ignores_garbage_pages():
     rng = np.random.default_rng(1)
     B, Hk, G, D, NP, PS, MP = 2, 1, 2, 64, 8, 8, 4
     q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)) * 100, jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)) * 100, jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)) * 100, jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)) * 100, jnp.bfloat16)
     pt_a = jnp.asarray(np.array([[1, 0, 0, 0], [2, 0, 0, 0]], np.int32))
     pt_b = jnp.asarray(np.array([[1, 7, 6, 5], [2, 3, 4, 5]], np.int32))
     kv = jnp.asarray(np.array([6, 8], np.int32))  # only first page used
@@ -56,8 +56,8 @@ def test_prefill_paged_attention_matches_reference(q_start, q_len, kv_extra):
     rng = np.random.default_rng(2)
     B, S, Hk, G, D, NP, PS, MP = 2, 16, 2, 3, 64, 16, 8, 8
     q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     qs = np.asarray(q_start, np.int32)
     ql = np.asarray(q_len, np.int32)
@@ -93,8 +93,8 @@ def test_decode_paged_attention_sharded_matches_reference():
     B, Hk, G, D, NP, PS, MP = 4, 4, 2, 64, 16, 8, 4
     mesh = make_mesh(MeshConfig(model=2))
     q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     kv = jnp.asarray(np.array([5, 17, 32, 9], np.int32))
 
@@ -113,8 +113,8 @@ def test_prefill_paged_attention_sharded_matches_reference():
     B, S, Hk, G, D, NP, PS, MP = 2, 16, 2, 3, 64, 16, 8, 8
     mesh = make_mesh(MeshConfig(model=2))
     q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     qs = np.asarray([8, 0], np.int32)
     ql = np.asarray([16, 11], np.int32)
@@ -137,9 +137,9 @@ def test_prefill_paged_attention_sharded_matches_reference():
 
 # -- int8 KV pools (models/quant.py KV convention) --------------------------
 def _q_pools(kp, vp):
-    from dynamo_tpu.models.quant import kv_quantize
+    from dynamo_tpu.models.quant import kv_pool_quantize
 
-    return kv_quantize(kp), kv_quantize(vp)
+    return kv_pool_quantize(kp), kv_pool_quantize(vp)
 
 
 @pytest.mark.parametrize("kv_lens", [[5, 17, 32, 1], [32, 32, 32, 32]])
@@ -149,8 +149,8 @@ def test_decode_paged_attention_int8_kv(kv_lens):
     rng = np.random.default_rng(11)
     B, Hk, G, D, NP, PS, MP = 4, 2, 4, 64, 16, 8, 4
     q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     kv = jnp.asarray(np.asarray(kv_lens, np.int32))
     kq, vq = _q_pools(kp, vp)
@@ -169,8 +169,8 @@ def test_prefill_paged_attention_int8_kv():
     rng = np.random.default_rng(12)
     B, S, Hk, G, D, NP, PS, MP = 2, 16, 2, 3, 64, 16, 8, 8
     q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     qs = np.asarray([24, 0], np.int32)
     ql = np.asarray([16, 11], np.int32)
@@ -202,8 +202,8 @@ def test_decode_paged_attention_sharded_int8_kv():
     B, Hk, G, D, NP, PS, MP = 4, 2, 4, 64, 40, 8, 8
     mesh = make_mesh(MeshConfig(model=2))
     q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((Hk, NP, PS, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
     pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
     kv = jnp.asarray(np.array([5, 17, 32, 64], np.int32))
     kq, vq = _q_pools(kp, vp)
