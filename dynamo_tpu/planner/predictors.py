@@ -1,12 +1,17 @@
 """PREDICT stage: load forecasting (reference builtin_load_predict with
-Constant/ARIMA/Kalman/Prophet backends, planner-design.md:125-135 — here
-Constant, EMA, and linear-trend least squares; heavier models plug in via
-the same interface)."""
+Constant/ARIMA/Kalman/Prophet backends, planner-design.md:125-135).
+
+Backends here: Constant, EMA, linear-trend least squares, ARIMA(p,d,0)
+(OLS-fit AR on a differenced window), a Kalman local-linear-trend filter,
+and a seasonal trend decomposition (the Prophet role: periodic traffic —
+diurnal request waves — forecast as trend + per-phase seasonal offsets).
+All are pure-python/numpy incremental models behind one observe/predict
+interface; swapping in heavier offline-fit models is a constructor away."""
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Optional
+from typing import Deque, List, Optional
 
 
 class Predictor:
@@ -66,9 +71,153 @@ class TrendPredictor(Predictor):
         return max(0.0, mean_y + slope * (n - 1 - mean_x + horizon_steps))
 
 
+class KalmanPredictor(Predictor):
+    """Kalman filter over a local linear trend model: hidden state
+    x = [level, trend], level_{t+1} = level_t + trend_t + w. Smooths
+    noisy load signals while still tracking ramps; q/r set the
+    responsiveness-vs-smoothing tradeoff (process vs observation
+    noise). Reference analog: the Kalman backend of
+    builtin_load_predict (planner-design.md:125-135)."""
+
+    def __init__(self, q: float = 0.05, r: float = 1.0):
+        self.q = q  # process noise (per-step state drift variance)
+        self.r = r  # observation noise variance
+        self._x = [0.0, 0.0]  # level, trend
+        # covariance, initialized diffuse so the first observations snap
+        self._p = [[1e6, 0.0], [0.0, 1e6]]
+        self._seen = False
+
+    def observe(self, value: float) -> None:
+        x, p, q, r = self._x, self._p, self.q, self.r
+        if not self._seen:
+            x[0], self._seen = value, True
+        # predict: x = F x, P = F P F' + Q, with F = [[1, 1], [0, 1]]
+        x0 = x[0] + x[1]
+        x1 = x[1]
+        p00 = p[0][0] + p[1][0] + p[0][1] + p[1][1] + q
+        p01 = p[0][1] + p[1][1]
+        p10 = p[1][0] + p[1][1]
+        p11 = p[1][1] + q
+        # update with observation z = value (H = [1, 0])
+        s = p00 + r
+        k0, k1 = p00 / s, p10 / s
+        innov = value - x0
+        self._x = [x0 + k0 * innov, x1 + k1 * innov]
+        self._p = [
+            [(1 - k0) * p00, (1 - k0) * p01],
+            [p10 - k1 * p00, p11 - k1 * p01],
+        ]
+
+    def predict(self, horizon_steps: int = 1) -> float:
+        return max(0.0, self._x[0] + horizon_steps * self._x[1])
+
+
+class ArimaPredictor(Predictor):
+    """ARIMA(p,d,0): difference the window d times, fit AR(p) by
+    conditional least squares (refit each predict — windows are tens of
+    points, the solve is microseconds), forecast recursively, then
+    integrate the differences back. d=1 handles the non-stationary
+    ramps scaling cares about; the MA term is omitted (OLS has no
+    closed form for it) — the Kalman backend covers the smoothing role.
+    Reference analog: the ARIMA backend of builtin_load_predict."""
+
+    def __init__(self, p: int = 3, d: int = 1, window: int = 60):
+        self.p = p
+        self.d = d
+        self._vals: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._vals.append(value)
+
+    def predict(self, horizon_steps: int = 1) -> float:
+        import numpy as np
+
+        series = list(self._vals)
+        if not series:
+            return 0.0
+        if len(series) < self.p + self.d + 2:
+            return series[-1]
+        # difference d times, keeping the tails needed to re-integrate
+        tails: List[float] = []
+        x = np.asarray(series, np.float64)
+        for _ in range(self.d):
+            tails.append(float(x[-1]))
+            x = np.diff(x)
+        p = min(self.p, len(x) - 1)
+        # OLS: x_t ≈ c + sum_i a_i x_{t-i}
+        rows = [
+            np.concatenate(([1.0], x[t - p : t][::-1]))
+            for t in range(p, len(x))
+        ]
+        A = np.stack(rows)
+        y = x[p:]
+        coef, *_ = np.linalg.lstsq(A, y, rcond=None)
+        hist = list(x[-p:])
+        fcs: List[float] = []
+        for _ in range(horizon_steps):
+            f = float(coef[0] + np.dot(coef[1:], hist[::-1]))
+            fcs.append(f)
+            hist = hist[1:] + [f]
+        # invert each differencing: level-k forecasts are the level-k tail
+        # plus the cumulative sum of the level-(k+1) forecasts
+        arr = np.asarray(fcs, np.float64)
+        for t in reversed(tails):
+            arr = t + np.cumsum(arr)
+        return max(0.0, float(arr[-1]))
+
+
+class SeasonalPredictor(Predictor):
+    """Prophet-role backend: trend + seasonality for periodic traffic
+    (diurnal/weekly request waves). Per-phase seasonal offsets are the
+    mean residual of each phase against a least-squares linear trend
+    over the window; forecast = trend(t+h) + seasonal[(t+h) % period]."""
+
+    def __init__(self, period: int = 24, window: int = 96):
+        self.period = period
+        self._vals: Deque[float] = deque(maxlen=window)
+        self._t = 0
+
+    def observe(self, value: float) -> None:
+        self._vals.append(value)
+        self._t += 1
+
+    def predict(self, horizon_steps: int = 1) -> float:
+        import numpy as np
+
+        n = len(self._vals)
+        if n == 0:
+            return 0.0
+        y = np.asarray(self._vals, np.float64)
+        if n < max(self.period + 2, 4):
+            return float(y[-1])
+        xs = np.arange(n, dtype=np.float64)
+        # phase of window index i is (t - n + i) mod period
+        start = self._t - n
+        phases = (start + np.arange(n)) % self.period
+        # JOINT least squares on [1, t, phase dummies]: fitting trend
+        # first and seasonal on the residual biases both (over a sampled
+        # period the ramp·seasonal covariance is not zero); the basis is
+        # rank-deficient (intercept vs dummies) but lstsq's min-norm
+        # solution gives the same fitted/predicted values
+        X = np.zeros((n, 2 + self.period))
+        X[:, 0] = 1.0
+        X[:, 1] = xs
+        X[np.arange(n), 2 + phases] = 1.0
+        coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+        target_phase = int((self._t + horizon_steps - 1) % self.period)
+        row = np.zeros(2 + self.period)
+        row[0] = 1.0
+        row[1] = n - 1 + horizon_steps
+        row[2 + target_phase] = 1.0
+        return max(0.0, float(row @ coef))
+
+
 def make_predictor(kind: str) -> Predictor:
     return {
         "constant": ConstantPredictor,
         "ema": EmaPredictor,
         "trend": TrendPredictor,
+        "kalman": KalmanPredictor,
+        "arima": ArimaPredictor,
+        "seasonal": SeasonalPredictor,
     }[kind]()

@@ -141,20 +141,32 @@ class ShadowServer:
         except Exception:
             pass
         if rank > 0:
-            # poll through the winner's whole promotion window (a single
-            # post-stagger check races a winner whose serve/register is
-            # still in flight); promote only if no active ever appears
+            # wait for the lower-ranked shadow to win: promotion serves the
+            # endpoint BEFORE dropping the standby record (see _promote), so
+            # while the winner is mid-promotion we still see its standby
+            # entry — there is no instant where a live winner is invisible.
+            # We promote early only if we BECOME rank 0 (dead peers' standby
+            # leases expire and reap their records); a long deadline remains
+            # as an availability fallback for a live-but-wedged peer (brief
+            # dual-active converges, the documented best-effort semantics).
             import time as _time
 
             deadline = (
-                _time.monotonic() + rank * max(2 * self.poll_s, 0.5) + 2.0
+                _time.monotonic() + rank * max(2 * self.poll_s, 0.5) + 10.0
             )
+            me = self._standby.instance_id
             while _time.monotonic() < deadline:
                 try:
                     if await self.runtime.discovery.list_instances(
                         f"services/{self.path}/"
                     ):
                         return False  # a lower-ranked shadow promoted
+                    sbs = await self.runtime.discovery.list_instances(
+                        f"standby/{self.path}/"
+                    )
+                    ids = sorted(i.instance_id for i in sbs)
+                    if me in ids and ids.index(me) == 0:
+                        break  # lower-ranked peers are gone: my turn
                 except Exception:
                     return False  # can't verify; don't double-promote
                 await asyncio.sleep(max(self.poll_s, 0.1))
@@ -163,14 +175,11 @@ class ShadowServer:
 
     async def _promote(self, standby) -> None:
         log.warning("shadow promoting for %s (active gone)", self.path)
-        for attempt in range(3):  # a stale standby record misleads the
-            # planner/operators, so retry the unregister briefly; the
-            # lease bound to it still reaps it if all retries fail
-            try:
-                await self.runtime.discovery.unregister(standby)
-                break
-            except Exception:
-                await asyncio.sleep(0.2 * (attempt + 1))
+        # serve FIRST, drop the standby record SECOND: higher-ranked
+        # shadows must never observe a live winner as absent from BOTH
+        # lists (that gap is a double-promotion window); a moment of
+        # active+standby overlap is harmless, and on serve failure the
+        # standby record survives so this shadow stays armed
         try:
             if self.activate is not None:
                 self.instance = await self.activate()
@@ -183,6 +192,14 @@ class ShadowServer:
             if not self.promoted.done():
                 self.promoted.set_exception(e)
             raise
+        for attempt in range(3):  # a stale standby record misleads the
+            # planner/operators, so retry the unregister briefly; the
+            # lease bound to it still reaps it if all retries fail
+            try:
+                await self.runtime.discovery.unregister(standby)
+                break
+            except Exception:
+                await asyncio.sleep(0.2 * (attempt + 1))
         if not self.promoted.done():
             self.promoted.set_result(self.instance)
 

@@ -66,6 +66,52 @@ def test_predictors():
     assert t.predict(1) > 5  # rising trend extrapolates up
 
 
+def test_kalman_predictor_tracks_ramp_and_smooths_noise():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    k = make_predictor("kalman")
+    for i in range(60):
+        k.observe(10.0 + 2.0 * i + float(rng.normal(0, 0.5)))
+    # one-step forecast near the true next value (132), despite noise
+    assert abs(k.predict(1) - 132.0) < 3.0
+    # multi-step extrapolates the learned slope
+    assert abs(k.predict(5) - 140.0) < 5.0
+
+
+def test_arima_predictor_forecasts_ar_process_with_drift():
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    # drifting AR(1) on the differences: non-stationary, d=1 handles it
+    series, x = [], 0.0
+    for i in range(80):
+        x = x + 1.0 + 0.6 * (x - (i and series[-1] or 0.0)) * 0 + float(rng.normal(0, 0.2))
+        series.append(x)
+    a = make_predictor("arima")
+    for v in series:
+        a.observe(v)
+    # series rises ~1/step; 4-step forecast should land near last+4
+    assert abs(a.predict(4) - (series[-1] + 4.0)) < 2.0
+
+
+def test_seasonal_predictor_learns_period():
+    import math
+
+    s = make_predictor("seasonal")  # period 24
+    for i in range(96):
+        s.observe(100.0 + 30.0 * math.sin(2 * math.pi * i / 24))
+    # forecast one full period ahead of the last phase: the next index is
+    # 96, same phase as 0 -> value near 100 + 30*sin(0) = 100
+    f = s.predict(1)
+    truth = 100.0 + 30.0 * math.sin(2 * math.pi * 96 / 24)
+    assert abs(f - truth) < 6.0
+    # a quarter period ahead (i=102 -> sin peak region)
+    f2 = s.predict(7)
+    truth2 = 100.0 + 30.0 * math.sin(2 * math.pi * 102 / 24)
+    assert abs(f2 - truth2) < 8.0
+
+
 # -- proposals --------------------------------------------------------------
 
 
