@@ -1,0 +1,133 @@
+// Event-storm soak for the concurrent block index (SURVEY §5.2: native
+// code carries a race/sanitizer gate; reference router-design.md:144-148
+// — the index must survive thousands of events/s concurrent with routing
+// lookups). Drives the C ABI exactly as the ctypes wrapper does:
+// writer threads apply store/remove event batches and worker churn while
+// reader threads run find_matches over random lineage prefixes.
+//
+// Built and run three ways by tests/test_native_soak.py: -O2 (throughput
+// floor), -fsanitize=thread (data races), -fsanitize=address (memory).
+//
+// Usage: stress_block_index [seconds=2] [writers=4] [readers=4]
+// Exits 0 on success; prints applied-events/s and lookup/s.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <chrono>
+#include <thread>
+#include <vector>
+
+#include "block_index.cpp"
+
+namespace {
+
+constexpr int kChains = 32;
+constexpr int kChainLen = 64;
+
+// deterministic per-thread xorshift
+struct Rng {
+    uint64_t s;
+    explicit Rng(uint64_t seed) : s(seed * 2654435761u + 1) {}
+    uint64_t next() {
+        s ^= s << 13;
+        s ^= s >> 7;
+        s ^= s << 17;
+        return s;
+    }
+};
+
+// lineage chains: chain c block i has hash f(c, i), parent f(c, i-1)
+uint64_t block_hash(int chain, int i) {
+    uint64_t x = (uint64_t)chain * 1000003u + (uint64_t)i * 10007u + 12345u;
+    x *= 0x9E3779B97F4A7C15ull;
+    x ^= x >> 29;
+    return x | 1;  // never 0
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    double seconds = argc > 1 ? atof(argv[1]) : 2.0;
+    int n_writers = argc > 2 ? atoi(argv[2]) : 4;
+    int n_readers = argc > 3 ? atoi(argv[3]) : 4;
+
+    void *idx = bi_new();
+    std::atomic<bool> stop{false};
+    std::atomic<uint64_t> events{0}, lookups{0}, failures{0};
+
+    auto writer = [&](int wid) {
+        Rng rng(wid + 1);
+        while (!stop.load(std::memory_order_relaxed)) {
+            int chain = (int)(rng.next() % kChains);
+            int k = 1 + (int)(rng.next() % kChainLen);
+            uint64_t hs[kChainLen];
+            for (int i = 0; i < k; ++i) hs[i] = block_hash(chain, i);
+            uint64_t r = rng.next() % 100;
+            if (r < 60) {
+                bi_apply_store(idx, (uint32_t)wid, 0, 0, hs, k);
+            } else if (r < 90) {
+                bi_apply_remove(idx, (uint32_t)wid, hs, k);
+            } else {
+                // worker churn: drop all residency, then re-store a prefix
+                bi_remove_worker(idx, (uint32_t)wid);
+                bi_apply_store(idx, (uint32_t)wid, 0, 0, hs, k / 2 + 1);
+            }
+            events.fetch_add(1, std::memory_order_relaxed);
+        }
+    };
+
+    auto reader = [&](int rid) {
+        Rng rng(1000 + rid);
+        uint32_t out_w[256];
+        uint32_t out_c[256];
+        while (!stop.load(std::memory_order_relaxed)) {
+            int chain = (int)(rng.next() % kChains);
+            int k = 1 + (int)(rng.next() % kChainLen);
+            uint64_t hs[kChainLen];
+            for (int i = 0; i < k; ++i) hs[i] = block_hash(chain, i);
+            int n = bi_find_matches(idx, hs, k, out_w, out_c, 256);
+            if (n < 0 || n > 256) {
+                failures.fetch_add(1);
+            } else {
+                for (int i = 0; i < n; ++i) {
+                    // an overlap count can never exceed the query length
+                    if (out_c[i] == 0 || out_c[i] > (uint32_t)k)
+                        failures.fetch_add(1);
+                }
+            }
+            lookups.fetch_add(1, std::memory_order_relaxed);
+        }
+    };
+
+    std::vector<std::thread> threads;
+    for (int i = 0; i < n_writers; ++i) threads.emplace_back(writer, i);
+    for (int i = 0; i < n_readers; ++i) threads.emplace_back(reader, i);
+
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds((int)(seconds * 1000)));
+    stop.store(true);
+    for (auto &t : threads) t.join();
+
+    uint64_t len = bi_len(idx);
+    // post-soak single-threaded sanity: a fresh store is findable
+    uint64_t probe[4] = {block_hash(0, 0), block_hash(0, 1), block_hash(0, 2),
+                         block_hash(0, 3)};
+    bi_apply_store(idx, 0, 0, 0, probe, 4);
+    uint32_t ow[8], oc[8];
+    int n = bi_find_matches(idx, probe, 4, ow, oc, 8);
+    bool found = false;
+    for (int i = 0; i < n; ++i)
+        if (ow[i] == 0 && oc[i] == 4) found = true;
+    bi_free(idx);
+
+    printf("events=%llu lookups=%llu len=%llu events_per_s=%.0f "
+           "lookups_per_s=%.0f failures=%llu post_probe=%s\n",
+           (unsigned long long)events.load(), (unsigned long long)lookups.load(),
+           (unsigned long long)len, events.load() / seconds,
+           lookups.load() / seconds, (unsigned long long)failures.load(),
+           found ? "ok" : "MISSING");
+    if (failures.load() != 0 || !found) return 1;
+    return 0;
+}
