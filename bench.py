@@ -21,59 +21,159 @@ stack at the same SLOs.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
-import jax
 import numpy as np
 
 PROXY_BASELINE_TOK_S = 1000.0
 PROXY_GOODPUT_TOK_S = 800.0
 
+# TPU init retry schedule (seconds between attempts). The axon tunnel has
+# shown transient UNAVAILABLE at process start in both prior rounds
+# (BENCH_r01/r02 rc=1) — one flaky init must not zero a round's evidence.
+DEFAULT_INIT_BACKOFF = (5.0, 15.0, 30.0, 60.0, 120.0)
+
+
+def _init_backoff() -> tuple:
+    raw = os.environ.get("DYN_BENCH_INIT_BACKOFF", "")
+    if not raw:
+        return DEFAULT_INIT_BACKOFF
+    try:
+        return tuple(float(x) for x in raw.split(",") if x)
+    except ValueError:  # malformed env must not beat the JSON contract
+        print(f"# bad DYN_BENCH_INIT_BACKOFF={raw!r}; using default",
+              file=sys.stderr, flush=True)
+        return DEFAULT_INIT_BACKOFF
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def init_backend(metric_name: str) -> None:
+    """Bring up the JAX backend with retry/backoff AND a hard deadline.
+
+    Two observed failure modes on the axon tunnel: backend setup raises
+    UNAVAILABLE (BENCH_r02), or `jax.devices()` simply hangs waiting on
+    the relay. Retries handle the former; a daemon-thread deadline
+    handles the latter. Returns normally when devices are live. On
+    persistent failure prints ONE parseable JSON line
+    ({"tpu_unavailable": true, ...}) and exits the process with rc=0
+    (os._exit — a hung backend thread would block normal shutdown).
+    """
+    import threading
+
+    deadline_s = float(os.environ.get("DYN_BENCH_INIT_TIMEOUT", "480"))
+    state = {"ok": False, "err": None}
+    done = threading.Event()
+
+    def _attempts():
+        try:
+            import jax
+
+            # the image's sitecustomize pre-imports jax pinned to the axon
+            # platform; a JAX_PLATFORMS env override (e.g. cpu smoke runs)
+            # must be re-asserted on the live config to take effect
+            want = os.environ.get("JAX_PLATFORMS")
+            if want and want != "axon":
+                try:
+                    jax.config.update("jax_platforms", want)
+                except Exception:
+                    pass
+
+            for i, pause in enumerate((0.0,) + _init_backoff()):
+                if pause:
+                    print(
+                        f"# tpu init attempt {i} failed ({state['err']}); "
+                        f"retrying in {pause:.0f}s",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    time.sleep(pause)
+                try:
+                    if jax.devices():
+                        state["ok"] = True
+                        done.set()
+                        return
+                    state["err"] = "no devices"
+                except Exception as e:  # JaxRuntimeError on backend setup
+                    state["err"] = f"{type(e).__name__}: {str(e)[:160]}"
+        except BaseException as e:  # e.g. import failure — report, don't
+            # die silently and masquerade as a deadline hang
+            state["err"] = f"{type(e).__name__}: {str(e)[:160]}"
+        done.set()
+
+    t = threading.Thread(target=_attempts, daemon=True)
+    t.start()
+    done.wait(deadline_s)
+    if state["ok"]:
+        return
+    if not done.is_set():
+        state["err"] = f"backend init hung > {deadline_s:.0f}s"
+    _emit(
+        {
+            "metric": metric_name,
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": 0.0,
+            "tpu_unavailable": True,
+            "error": str(state["err"]),
+        }
+    )
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # a hung backend thread can block interpreter shutdown; exit hard —
+    # the one JSON line above is already on stdout
+    os._exit(0)
+
 
 def goodput_main(argv) -> None:
     import asyncio
 
+    if "--mocker" in argv and os.environ.get("JAX_PLATFORMS") in (None, "", "axon"):
+        # simulated workers need no accelerator; don't let a down TPU
+        # tunnel zero a measurement that never touches it
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    init_backend("slo_goodput")
     from dynamo_tpu.bench.goodput import parse_args, run_goodput
 
     # run directly (not goodput.main) so exactly ONE JSON line is printed
     report = asyncio.run(run_goodput(parse_args(argv)))
-    print(
-        json.dumps(
-            {
-                "metric": "slo_goodput",
-                "value": round(report.goodput_tok_s, 1),
-                "unit": "tok/s",
-                "vs_baseline": round(
-                    report.goodput_tok_s / PROXY_GOODPUT_TOK_S, 3
-                ),
-            }
-        )
+    _emit(
+        {
+            "metric": "slo_goodput",
+            "value": round(report.goodput_tok_s, 1),
+            "unit": "tok/s",
+            "vs_baseline": round(report.goodput_tok_s / PROXY_GOODPUT_TOK_S, 3),
+        }
     )
 
 
 def main() -> None:
-    import sys
-
     if "--goodput" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--goodput"]
         goodput_main(argv)
         return
-    from dynamo_tpu.engine.model_runner import ModelRunner
-    from dynamo_tpu.engine.sampling import SamplingParams
-    from dynamo_tpu.models.config import get_config
 
-    B = 32
+    B = int(os.environ.get("DYN_BENCH_B", "32"))
     prompt_len = 128
     decode_steps = 128
     page_size = 64
     max_pages = 8
+    model_name = os.environ.get("DYN_BENCH_MODEL", "llama-3.2-3b")
+    metric_name = f"decode_throughput_{model_name}_bf16_b{B}"
+    init_backend(metric_name)
 
-    import os
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.models.config import get_config
 
     quantize = os.environ.get("DYN_BENCH_QUANTIZE") or None  # e.g. "int8"
     attn_impl = os.environ.get("DYN_BENCH_ATTN") or None  # "jnp" | "pallas"
     kv_quantize = os.environ.get("DYN_BENCH_KV_QUANTIZE") or None  # "int8"
-    config = get_config("llama-3.2-3b")
+    config = get_config(model_name)
     runner = ModelRunner(
         config,
         num_pages=B * max_pages + 8,
@@ -121,17 +221,30 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     tok_s = B * n_dispatch * T / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"decode_throughput_{config.name}_bf16_b{B}",
-                "value": round(tok_s, 1),
-                "unit": "tok/s",
-                "vs_baseline": round(tok_s / PROXY_BASELINE_TOK_S, 3),
-            }
-        )
+    _emit(
+        {
+            "metric": metric_name,
+            "value": round(tok_s, 1),
+            "unit": "tok/s",
+            "vs_baseline": round(tok_s / PROXY_BASELINE_TOK_S, 3),
+        }
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # never hand the driver a bare traceback
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit(
+            {
+                "metric": "bench_error",
+                "value": 0.0,
+                "unit": "tok/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {str(e)[:300]}",
+            }
+        )
+        sys.exit(0)

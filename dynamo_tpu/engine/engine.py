@@ -373,10 +373,38 @@ class InferenceEngine:
         self._expire_parked()
         self._run_embeds()
 
+    @staticmethod
+    def _kv_layout_mismatch(payload: Dict[str, Any]) -> Optional[str]:
+        """Non-None when a host-staged payload was produced under a
+        different pool layout version (mixed-version cluster). Device
+        payloads are same-process buffers and never re-sliced."""
+        from dynamo_tpu.engine.model_runner import KV_WIRE_LAYOUT_VERSION
+
+        if payload.get("device"):
+            return None
+        parts = payload.get("chunks") or ([payload] if payload.get("data") else [])
+        for p in parts:
+            if p.get("k") and p.get("layout") != KV_WIRE_LAYOUT_VERSION:
+                return f"layout {p.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
+        return None
+
     def _admit_kv_pending(self) -> None:
         """Disagg-decode sequences: admit + import transferred KV pages."""
         still: List[Sequence] = []
         for seq in self._kv_pending:
+            bad = self._kv_layout_mismatch(seq.kv_import or {})
+            if bad:
+                # checked BEFORE admit_with_kv marks the prompt computed:
+                # fall back to local prefill (recompute) — never error the
+                # request for a peer's stale wire format, and never adopt
+                # transposed bytes
+                log.warning(
+                    "P->D KV payload rejected (%s); recomputing %s locally",
+                    bad, seq.request_id,
+                )
+                seq.kv_import = None
+                self.scheduler.add(seq)
+                continue
             try:
                 self._admit_one_kv(seq, still)
             except Exception:
@@ -805,7 +833,13 @@ class InferenceEngine:
 
         if self.host_pool is None or not hashes:
             return
-        arrays = kv_payload_to_arrays(payload)
+        try:
+            arrays = kv_payload_to_arrays(payload)
+        except Exception:
+            # mixed-version peer (KvWireLayoutMismatch) or corrupt bytes:
+            # drop the pull — admission recomputes; never adopt the blocks
+            log.warning("peer KV payload rejected; recomputing", exc_info=True)
+            return
         k, v = arrays if arrays is not None else (None, None)
         self.host_pool.put(hashes, parents, k, v)
         self._host_events.append(

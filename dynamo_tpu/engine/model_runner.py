@@ -86,6 +86,18 @@ def _decode_loop(
     return toks.T, last, k_pool, v_pool  # [B, n_steps], [B]
 
 
+# Wire layout version for P→D / cross-worker KV payloads. v2 = token-major
+# [L, n, PS, Hk, D]; v1 (implicit, no field) was head-major. Mirrors the
+# disk tier's BLOCK_LAYOUT_VERSION: in a mixed-version cluster (rolling
+# upgrade) an old-layout peer's bytes sliced under the new axis order import
+# transposed KV silently — reject and force recompute instead.
+KV_WIRE_LAYOUT_VERSION = 2
+
+
+class KvWireLayoutMismatch(ValueError):
+    pass
+
+
 def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
     """KV wire format for P→D transfer and G2 offload: [L, n, PS, Hk, D]
     (token-major, page axis 1 — the pool layout) arrays as raw bytes +
@@ -98,14 +110,21 @@ def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
         "shape": list(k.shape),
         "dtype": str(k.dtype),
         "n_pages": int(k.shape[1]),
+        "layout": KV_WIRE_LAYOUT_VERSION,
     }
 
 
 def kv_payload_to_arrays(payload: Dict[str, Any]):
     """Inverse of kv_arrays_to_payload; None if the payload carries no data
-    (simulated workers)."""
+    (simulated workers). Raises KvWireLayoutMismatch when the sender used a
+    different pool layout version — the importer must fail the transfer
+    (recompute locally) rather than adopt transposed bytes."""
     if not payload or not payload.get("k"):
         return None
+    if payload.get("layout") != KV_WIRE_LAYOUT_VERSION:
+        raise KvWireLayoutMismatch(
+            f"kv wire layout {payload.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
+        )
     import ml_dtypes
 
     name = payload["dtype"]

@@ -332,7 +332,10 @@ class DiskKvPool:
     def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
         """Stacked [L, n, PS, Hk, D] arrays (HostKvPool-compatible)."""
         pairs = [self.get_block(h) for h in hashes]
-        if not pairs or pairs[0][0] is None:
+        # ANY data-less block fails the whole read (stale-layout file can
+        # appear mid-chain under a shared root) — np.stack over a None
+        # would raise where callers expect a data-miss result
+        if not pairs or any(p[0] is None for p in pairs):
             return None, None
         # token-major wire layout: page axis 1
         k = np.stack([p[0] for p in pairs], axis=1)

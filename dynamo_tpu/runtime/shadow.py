@@ -157,14 +157,21 @@ class ShadowServer:
             me = self._standby.instance_id
             while _time.monotonic() < deadline:
                 try:
-                    if await self.runtime.discovery.list_instances(
-                        f"services/{self.path}/"
-                    ):
-                        return False  # a lower-ranked shadow promoted
+                    # standby BEFORE services: _promote serves first and
+                    # drops the standby record second, so a winner absent
+                    # from standby has necessarily already registered its
+                    # service — a services check issued AFTER the standby
+                    # read must see it. The reverse order had a TOCTOU:
+                    # winner completes both steps between our two reads
+                    # and we'd see empty-services + rank-0 → dual-active.
                     sbs = await self.runtime.discovery.list_instances(
                         f"standby/{self.path}/"
                     )
                     ids = sorted(i.instance_id for i in sbs)
+                    if await self.runtime.discovery.list_instances(
+                        f"services/{self.path}/"
+                    ):
+                        return False  # a lower-ranked shadow promoted
                     if me in ids and ids.index(me) == 0:
                         break  # lower-ranked peers are gone: my turn
                 except Exception:

@@ -244,3 +244,35 @@ async def test_engine_cancellation(tiny_engine):
     assert not tiny_engine.scheduler.active or all(
         s.request_id != ctx.id for s in tiny_engine.scheduler.active
     )
+
+
+async def test_engine_stale_layout_kv_import_recomputes(tiny_engine):
+    """A disagg-decode request whose transferred KV carries a stale wire
+    layout version (mixed-version cluster, ADVICE r2) must fall back to
+    local prefill — same greedy output as a plain request, no error."""
+    from dynamo_tpu.engine.model_runner import KV_WIRE_LAYOUT_VERSION
+
+    prompt = [31, 32, 33, 34, 35, 36, 37, 38]
+    want, wf = await _collect(tiny_engine, _req(prompt, max_tokens=5))
+
+    stale = {
+        "data": True,
+        "k": b"\x00" * 64,  # bytes would be mis-sliced if adopted
+        "v": b"\x00" * 64,
+        "shape": [1, 1, 4, 2, 4],
+        "dtype": "bfloat16",
+        "n_pages": 1,
+        "layout": KV_WIRE_LAYOUT_VERSION - 1,
+    }
+    req = _req(prompt, max_tokens=5)
+    req["annotations"] = {"disagg": "decode"}
+    req["kv_import"] = stale
+    before = sum(
+        m.scheduled_tokens for m in tiny_engine.fpm_history if m.kind == "prefill"
+    )
+    got, gf = await _collect(tiny_engine, req)
+    assert (got, gf) == (want, wf)
+    after = sum(
+        m.scheduled_tokens for m in tiny_engine.fpm_history if m.kind == "prefill"
+    )
+    assert after > before, "fallback must prefill locally, not adopt stale KV"

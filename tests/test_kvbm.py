@@ -281,3 +281,33 @@ def test_g4_shared_store_cross_worker_adoption(tmp_path):
     assert p2.match([601]) == 1
     k2, v2 = p2.get_block(601)
     np.testing.assert_array_equal(v2, k * 2)
+
+
+def test_disk_pool_stale_layout_mid_chain_is_data_miss(tmp_path):
+    """A stale-layout file appearing mid-chain under a shared root must
+    turn the whole get() into a data miss (None, None) — not raise from
+    np.stack over a None (ADVICE r2)."""
+    import json
+    import struct
+
+    import numpy as np
+
+    from dynamo_tpu.kvbm.disk_pool import DiskKvPool
+
+    pool = DiskKvPool(str(tmp_path), capacity_blocks=8)
+    k = np.arange(2 * 4 * 1 * 8, dtype=np.float32).reshape(2, 4, 1, 8)
+    pool.put_block(301, None, k, k)
+    pool.put_block(302, 301, k + 1, k + 1)
+    pool.put_block(303, 302, k + 2, k + 2)
+    pool.flush()
+
+    # overwrite the MIDDLE block's file with a v1 (stale-layout) encoding
+    header = json.dumps(
+        {"shape": list(k.shape), "dtype": str(k.dtype), "parent": 301, "layout": 1}
+    ).encode()
+    data = struct.pack("<Q", len(header)) + header + k.tobytes() + k.tobytes()
+    path = [p for p in tmp_path.glob("*.kvb") if format(302, "x") in p.name]
+    assert path, "block 302 file should exist"
+    path[0].write_bytes(data)
+
+    assert pool.get([301, 302, 303]) == (None, None)
