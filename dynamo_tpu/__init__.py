@@ -33,3 +33,20 @@ Layer map (mirrors reference layers L0–L8, SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+
+def ensure_platform() -> None:
+    """Make a JAX_PLATFORMS env override effective even when the image's
+    sitecustomize pre-imported jax pinned to another platform (the axon
+    TPU relay). Call at process entrypoints before touching any backend —
+    tests/subprocesses rely on it to force CPU."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and want != "axon":
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass

@@ -171,6 +171,12 @@ class ModelRunner:
         self.mesh_config = mesh_config or MeshConfig()
         self.mesh = make_mesh(self.mesh_config, devices)
         self.policy = ShardingPolicy(self.mesh)
+        # mesh spanning several processes (multi-host group,
+        # parallel/multihost.py): pool reads must gather to a replicated
+        # sharding before device_get — remote shards aren't addressable
+        self.multihost = any(
+            d.process_index != jax.process_index() for d in self.mesh.devices.flat
+        )
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
@@ -630,8 +636,26 @@ class ModelRunner:
     # -- disagg KV transfer (host-staged DCN path, SURVEY.md §2.11) ---------
     def export_pages(self, pages: List[int]) -> Dict[str, Any]:
         """Device→host read of whole KV pages for P→D transfer. Layout on
-        the wire: [L, n_pages, PS, Hk, D] per pool, raw bytes."""
+        the wire: [L, n_pages, PS, Hk, D] per pool, raw bytes. On a
+        multi-host mesh the gather runs jitted with a replicated output
+        sharding (an all-gather over ICI) so every process holds the full
+        pages and the host read is local."""
         idx = jnp.asarray(np.asarray(pages, np.int32))
+        if self.multihost:
+            if not hasattr(self, "_jit_export_repl"):
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self.mesh, P())
+                self._jit_export_repl = jax.jit(
+                    lambda kp, vp, i: (
+                        self._dense_pages(kp, i), self._dense_pages(vp, i)
+                    ),
+                    out_shardings=(repl, repl),
+                )
+            k_d, v_d = self._jit_export_repl(self.k_pool, self.v_pool, idx)
+            k = np.asarray(jax.device_get(k_d))
+            v = np.asarray(jax.device_get(v_d))
+            return kv_arrays_to_payload(k, v)
         k = np.asarray(jax.device_get(self._dense_pages(self.k_pool, idx)))
         v = np.asarray(jax.device_get(self._dense_pages(self.v_pool, idx)))
         return kv_arrays_to_payload(k, v)

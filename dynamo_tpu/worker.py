@@ -97,6 +97,20 @@ def parse_args(argv=None):
                    help="serve /live /health /metrics on this port (0 = off)")
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
+    # multi-host worker group (parallel/multihost.py): N processes form one
+    # logical worker over a single jax.distributed global mesh. Process 0
+    # serves; 1..N-1 replay its step stream. Mesh axis sizes above refer to
+    # the GLOBAL device count.
+    p.add_argument("--mh-coordinator", default=None,
+                   help="host:port of the group coordinator (rank 0); "
+                        "enables multi-host mode")
+    p.add_argument("--mh-num-processes", type=int, default=1)
+    p.add_argument("--mh-process-id", type=int, default=0)
+    p.add_argument("--mh-step-port", type=int, default=0,
+                   help="leader step-plane port (required when "
+                        "--mh-num-processes > 1)")
+    p.add_argument("--mh-local-devices", type=int, default=None,
+                   help="virtual CPU devices per process (tests)")
     return p.parse_args(argv)
 
 
@@ -147,7 +161,13 @@ def _lora_kwargs(args, config) -> dict:
     }
 
 
-def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
+def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "object"]:
+    """Construct the ModelRunner (and its model config) from CLI args —
+    shared by the serving leader and multi-host follower replicas, which
+    must build bit-identical runners (same config/seed/checkpoint).
+    save_snapshot_ok=False suppresses the cold orbax-cache write: in a
+    group every process sees the same args, and N concurrent writers
+    would corrupt one snapshot directory — only the leader writes."""
     import os
 
     params = None
@@ -216,11 +236,21 @@ def build_engine(args) -> tuple[InferenceEngine, ModelCard]:
     )
     for name, factors in getattr(args, "_lora_factors", []):
         runner.register_adapter(name, factors)
-    if save_snapshot:
+    if save_snapshot and save_snapshot_ok:
         from dynamo_tpu.engine.weights import save_orbax
 
         log.info("writing params snapshot to %s", args.orbax_cache)
         save_orbax(params, args.orbax_cache)
+    return runner, config
+
+
+def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
+    if runner is None:
+        runner, config = build_runner(args)
+    else:
+        # multi-host leader: runner was built (and wrapped) by the caller
+        config = runner.config
+    mesh = runner.mesh_config
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         host_kv_blocks=args.host_kv_blocks,
@@ -268,7 +298,22 @@ async def async_main(args) -> None:
     if args.discovery_root:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
-    engine, card = build_engine(args)
+    spec = getattr(args, "_mh_spec", None)
+    plane = None
+    if spec is not None:
+        # multi-host leader: accept the follower connections first, then
+        # build the runner (followers build theirs concurrently) and wrap
+        # it so every device-touching call replays group-wide
+        from dynamo_tpu.parallel import multihost as mh
+
+        plane = mh.StepPlaneLeader(spec.step_port, spec.num_processes - 1)
+        plane.wait_followers()
+        leader_runner, _ = build_runner(args)
+        engine, card = build_engine(
+            args, runner=mh.ReplicatingRunner(leader_runner, plane)
+        )
+    else:
+        engine, card = build_engine(args)
     if args.vision:
         import jax
 
@@ -357,14 +402,51 @@ async def async_main(args) -> None:
             await worker.stop()
         if status is not None:
             await status.stop()
+        if plane is not None:
+            plane.close()  # releases followers from their replay loops
         await runtime.shutdown()
     if promotion_failed:
         raise SystemExit(1)
 
 
 def main(argv=None) -> None:
+    import dynamo_tpu
+
+    dynamo_tpu.ensure_platform()
+    args = parse_args(argv)
+    if args.mh_coordinator and args.mh_num_processes > 1:
+        from dynamo_tpu.parallel import multihost as mh
+
+        if not args.mh_step_port:
+            raise SystemExit("--mh-step-port is required for a multi-host group")
+        spec = mh.MultihostSpec(
+            coordinator=args.mh_coordinator,
+            num_processes=args.mh_num_processes,
+            process_id=args.mh_process_id,
+            step_port=args.mh_step_port,
+            local_devices=args.mh_local_devices,
+        )
+        mh.initialize(spec)
+        if not spec.is_leader:
+            configure_logging()
+            # connect BEFORE building: runner construction device_puts over
+            # the global mesh, which needs every process participating —
+            # the leader only starts ITS build once all followers are
+            # connected, so connecting late deadlocks the group
+            sock = mh.follower_connect(
+                spec.leader_host, spec.step_port, spec.process_id
+            )
+            runner, _ = build_runner(args, save_snapshot_ok=False)
+            print(f"follower {spec.process_id} replaying for {spec.coordinator}",
+                  flush=True)
+            try:
+                mh.follower_loop(runner, sock)
+            finally:
+                sock.close()
+            return
+        args._mh_spec = spec
     try:
-        asyncio.run(async_main(parse_args(argv)))
+        asyncio.run(async_main(args))
     except KeyboardInterrupt:
         pass
 

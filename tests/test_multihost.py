@@ -1,0 +1,234 @@
+"""Multi-process serving tests (VERDICT r2 item 2).
+
+Two distinct multi-process shapes, both run as REAL OS processes:
+
+1. A multi-host worker GROUP: leader + follower join one jax.distributed
+   global mesh (1 virtual CPU device each → TP=2 spanning processes); the
+   follower replays the leader's step stream (parallel/multihost.py).
+   Greedy output must equal a single-process TP=2 run of the same model.
+
+2. A 1P:1D disaggregated pair as two separate worker processes with the
+   frontend in the test process — KV moves over the wire (host-staged
+   request-plane pull), output byte-identical to an aggregated run.
+   (Reference: MultiNodeConfig lib/llm/src/engines.rs:38; kv transfer
+   docs/design-docs/disagg-serving.md.)
+"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+import aiohttp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_worker(extra_args, discovery_root, local_devices=None):
+    """Launch `python -m dynamo_tpu.worker` with file discovery + zmq
+    events in a clean CPU-jax environment (no conftest: real process)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    if local_devices:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={local_devices}"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, "-m", "dynamo_tpu.worker",
+        "--model", "tiny",
+        "--discovery-backend", "file",
+        "--discovery-root", discovery_root,
+        "--num-pages", "64",
+        "--page-size", "4",
+        "--max-seq-len", "64",
+        "--max-batch", "4",
+        "--chunk-size", "16",
+        *extra_args,
+    ]
+    return subprocess.Popen(
+        cmd, env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _drain(proc) -> str:
+    try:
+        out = proc.stdout.read() if proc.stdout else ""
+    except Exception:
+        out = ""
+    return out or ""
+
+
+async def _wait_line(proc, needle: str, timeout: float = 180.0) -> None:
+    """Wait until the process prints a line containing `needle`."""
+    loop = asyncio.get_running_loop()
+
+    def _scan():
+        for line in proc.stdout:
+            if needle in line:
+                return True
+        return False
+
+    ok = await asyncio.wait_for(loop.run_in_executor(None, _scan), timeout)
+    assert ok, f"worker exited before printing {needle!r}"
+
+
+async def _http_stack(discovery_root, min_prefill=8):
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime.discovery import FileDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    frt = DistributedRuntime(
+        discovery=FileDiscovery(discovery_root, lease_ttl=10),
+        event_transport="zmq",
+    )
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager, disagg_min_prefill_tokens=min_prefill)
+    svc = HttpService(frt, manager, watcher, port=0)
+    base = await svc.start()
+    await watcher.wait_for_model(timeout=120)
+    return frt, svc, base
+
+
+async def _completion(base, prompt_ids, max_tokens=6):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"{base}/v1/completions",
+            json={
+                "model": "tiny",
+                "prompt": prompt_ids,
+                "max_tokens": max_tokens,
+                "temperature": 0,
+            },
+        ) as r:
+            assert r.status == 200, await r.text()
+            return await r.json()
+
+
+async def test_multihost_group_matches_single_process(tmp_path):
+    """Leader+follower (1 CPU device each) form a TP=2 global mesh; greedy
+    output must equal a single-process TP=2 worker running the identical
+    engine path (same fused-step cadence, same jit programs)."""
+    prompt = list(range(40, 52))
+
+    # reference: ONE process holding both mesh devices
+    droot_ref = str(tmp_path / "ref")
+    ref = _spawn_worker(["--tensor-parallel", "2"], droot_ref, local_devices=2)
+    frt = svc = None
+    try:
+        await _wait_line(ref, "worker serving")
+        frt, svc, base = await _http_stack(droot_ref)
+        ref_body = await _completion(base, prompt, max_tokens=6)
+    finally:
+        if svc is not None:
+            await svc.stop()
+        if frt is not None:
+            await frt.shutdown()
+        ref.terminate()
+        try:
+            ref.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            ref.kill()
+
+    # group: the same two mesh devices split across two processes
+    droot = str(tmp_path / "disc")
+    coord = f"127.0.0.1:{_free_port()}"
+    step_port = _free_port()
+    mh = [
+        "--mh-coordinator", coord,
+        "--mh-num-processes", "2",
+        "--mh-step-port", str(step_port),
+        "--mh-local-devices", "1",
+        "--tensor-parallel", "2",
+    ]
+    leader = _spawn_worker([*mh, "--mh-process-id", "0"], droot)
+    follower = _spawn_worker([*mh, "--mh-process-id", "1"], droot)
+    frt = svc = None
+    try:
+        await _wait_line(leader, "worker serving")
+        frt, svc, base = await _http_stack(droot)
+        body = await _completion(base, prompt, max_tokens=6)
+        assert body["choices"][0]["text"] == ref_body["choices"][0]["text"], (
+            body["choices"][0]["text"], ref_body["choices"][0]["text"],
+        )
+        assert body["usage"] == ref_body["usage"]
+    finally:
+        if svc is not None:
+            await svc.stop()
+        if frt is not None:
+            await frt.shutdown()
+        for p in (leader, follower):
+            p.terminate()
+        for p in (leader, follower):
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+async def test_disagg_across_os_processes_byte_identical(tmp_path):
+    """1P:1D as two separate OS processes; KV crosses the request plane.
+    Output must be byte-identical to a single aggregated worker process."""
+    # aggregated baseline: one worker process
+    droot_a = str(tmp_path / "agg")
+    agg = _spawn_worker([], droot_a)
+    prompt = list(range(40, 60))  # 20 tokens ≥ disagg threshold 8
+    frt = svc = None
+    try:
+        await _wait_line(agg, "worker serving")
+        frt, svc, base = await _http_stack(droot_a)
+        agg_body = await _completion(base, prompt)
+    finally:
+        if svc is not None:
+            await svc.stop()
+        if frt is not None:
+            await frt.shutdown()
+        agg.terminate()
+        try:
+            agg.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            agg.kill()
+
+    # disaggregated: decode worker + prefill worker, separate processes
+    droot = str(tmp_path / "disagg")
+    dec = _spawn_worker([], droot)
+    pre = _spawn_worker(
+        ["--component", "prefill", "--disagg-role", "prefill"], droot
+    )
+    frt = svc = None
+    try:
+        await _wait_line(dec, "worker serving")
+        await _wait_line(pre, "worker serving")
+        frt, svc, base = await _http_stack(droot)
+        entry = svc.manager.get("tiny")
+        for _ in range(200):
+            if entry.prefill_router is not None and entry.prefill_router.active:
+                break
+            await asyncio.sleep(0.05)
+        assert entry.prefill_router and entry.prefill_router.active
+        dis_body = await _completion(base, prompt)
+        assert dis_body["choices"][0]["text"] == agg_body["choices"][0]["text"]
+        assert dis_body["usage"] == agg_body["usage"]
+    finally:
+        if svc is not None:
+            await svc.stop()
+        if frt is not None:
+            await frt.shutdown()
+        for p in (dec, pre):
+            p.terminate()
+        for p in (dec, pre):
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
