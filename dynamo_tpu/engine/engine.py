@@ -373,19 +373,34 @@ class InferenceEngine:
         self._expire_parked()
         self._run_embeds()
 
-    @staticmethod
-    def _kv_layout_mismatch(payload: Dict[str, Any]) -> Optional[str]:
-        """Non-None when a host-staged payload was produced under a
-        different pool layout version (mixed-version cluster). Device
-        payloads are same-process buffers and never re-sliced."""
-        from dynamo_tpu.engine.model_runner import KV_WIRE_LAYOUT_VERSION
+    def _kv_layout_mismatch(self, payload: Dict[str, Any]) -> Optional[str]:
+        """Non-None when a host-staged payload can't be imported into the
+        local pool: produced under a different pool layout version
+        (mixed-version cluster) or a different page geometry (L, PS, Hk, D)
+        — a peer serving a different model or page size. A differing TP
+        degree is NOT a mismatch (dense full-head wire, see
+        model_runner.kv_arrays_to_payload). Device payloads are
+        same-process buffers and never re-sliced."""
+        from dynamo_tpu.engine.model_runner import kv_payload_incompatible
 
         if payload.get("device"):
             return None
+        page_shape = getattr(self.runner, "kv_page_shape", None)
         parts = payload.get("chunks") or ([payload] if payload.get("data") else [])
         for p in parts:
-            if p.get("k") and p.get("layout") != KV_WIRE_LAYOUT_VERSION:
-                return f"layout {p.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
+            if not p.get("k"):
+                continue
+            if page_shape is not None:
+                bad = kv_payload_incompatible(p, page_shape)
+            else:  # sim runners without pools: version check only
+                from dynamo_tpu.engine.model_runner import KV_WIRE_LAYOUT_VERSION
+
+                bad = (
+                    None if p.get("layout") == KV_WIRE_LAYOUT_VERSION
+                    else f"layout {p.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
+                )
+            if bad:
+                return bad
         return None
 
     def _admit_kv_pending(self) -> None:

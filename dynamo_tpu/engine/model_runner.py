@@ -98,11 +98,22 @@ class KvWireLayoutMismatch(ValueError):
     pass
 
 
-def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
+def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray, tp: int = 1) -> Dict[str, Any]:
     """KV wire format for P→D transfer and G2 offload: [L, n, PS, Hk, D]
     (token-major, page axis 1 — the pool layout) arrays as raw bytes +
     shape/dtype metadata. Single definition — the engine and host tier
-    must not re-implement it."""
+    must not re-implement it.
+
+    Cross-TP layout handshake (ref docs/design-docs/kvbm-design.md:161–237,
+    esp. :188–197 — the reference negotiates serialized layout metadata and
+    permutes blocks when P and D run different TP degrees): the wire format
+    is always DENSE FULL-HEAD pages — export all-gathers the head shards
+    over ICI, import scatters into the local pool under whatever sharding
+    the importer's mesh uses, with GSPMD inserting the reshard. So a TP=1
+    prefill worker and a TP=4 decode worker interoperate without an
+    explicit permute protocol; the metadata below (page geometry + exporter
+    tp degree) lets the importer VALIDATE compatibility and fall back to
+    local recompute instead of adopting mis-shaped bytes."""
     return {
         "data": True,
         "k": k.tobytes(),
@@ -111,13 +122,40 @@ def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
         "dtype": str(k.dtype),
         "n_pages": int(k.shape[1]),
         "layout": KV_WIRE_LAYOUT_VERSION,
+        # layout handshake metadata: [L, n, PS, Hk, D] geometry, explicit
+        "page_size": int(k.shape[2]),
+        "kv_heads": int(k.shape[3]),
+        "head_dim": int(k.shape[4]),
+        "layers": int(k.shape[0]),
+        "tp": int(tp),
     }
 
 
-def kv_payload_to_arrays(payload: Dict[str, Any]):
+def kv_payload_incompatible(
+    payload: Dict[str, Any], page_shape: Tuple[int, int, int, int]
+) -> Optional[str]:
+    """Reason string when `payload` cannot be imported into a pool whose
+    per-page geometry is `page_shape` = (L, PS, Hk, D); None when
+    compatible. Wire version and page geometry must match exactly — the
+    exporter's TP degree is deliberately NOT checked (the dense full-head
+    wire makes it irrelevant; see kv_arrays_to_payload)."""
+    if payload.get("layout") != KV_WIRE_LAYOUT_VERSION:
+        return f"layout {payload.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
+    L, PS, Hk, D = page_shape
+    shape = payload.get("shape") or []
+    if len(shape) != 5:
+        return f"malformed shape {shape}"
+    got = (shape[0], shape[2], shape[3], shape[4])
+    if got != (L, PS, Hk, D):
+        return f"page geometry {got} != local (L={L}, PS={PS}, Hk={Hk}, D={D})"
+    return None
+
+
+def kv_payload_to_arrays(payload: Dict[str, Any], page_shape=None):
     """Inverse of kv_arrays_to_payload; None if the payload carries no data
     (simulated workers). Raises KvWireLayoutMismatch when the sender used a
-    different pool layout version — the importer must fail the transfer
+    different pool layout version or (when `page_shape` is given) a
+    different page geometry — the importer must fail the transfer
     (recompute locally) rather than adopt transposed bytes."""
     if not payload or not payload.get("k"):
         return None
@@ -125,6 +163,10 @@ def kv_payload_to_arrays(payload: Dict[str, Any]):
         raise KvWireLayoutMismatch(
             f"kv wire layout {payload.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
         )
+    if page_shape is not None:
+        bad = kv_payload_incompatible(payload, page_shape)
+        if bad:
+            raise KvWireLayoutMismatch(bad)
     import ml_dtypes
 
     name = payload["dtype"]
@@ -655,16 +697,26 @@ class ModelRunner:
             k_d, v_d = self._jit_export_repl(self.k_pool, self.v_pool, idx)
             k = np.asarray(jax.device_get(k_d))
             v = np.asarray(jax.device_get(v_d))
-            return kv_arrays_to_payload(k, v)
+            return kv_arrays_to_payload(k, v, tp=self.mesh_config.model)
         k = np.asarray(jax.device_get(self._dense_pages(self.k_pool, idx)))
         v = np.asarray(jax.device_get(self._dense_pages(self.v_pool, idx)))
-        return kv_arrays_to_payload(k, v)
+        return kv_arrays_to_payload(k, v, tp=self.mesh_config.model)
+
+    @property
+    def kv_page_shape(self) -> Tuple[int, int, int, int]:
+        """(L, PS, Hk, D) page geometry of this runner's pools — the local
+        side of the cross-TP layout handshake."""
+        c = self.config
+        return (c.n_layers, self.page_size, c.n_kv_heads, c.head_dim)
 
     def import_pages(self, target_pages: List[int], offset: int, payload: Dict[str, Any]) -> None:
         """Host→device write of transferred pages into this pool's page
         slots. `offset` = first payload page to use (earlier pages were
-        satisfied by the local prefix cache)."""
-        arrays = kv_payload_to_arrays(payload)
+        satisfied by the local prefix cache). Validates the payload's layout
+        metadata against the local pool geometry (KvWireLayoutMismatch on
+        any divergence); a cross-TP exporter is fine — the dense wire pages
+        reshard into this mesh's pool sharding on the scatter below."""
+        arrays = kv_payload_to_arrays(payload, self.kv_page_shape)
         if arrays is None:
             return
         k, v = arrays

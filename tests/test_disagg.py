@@ -21,13 +21,12 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.worker_common import serve_worker
 
 
-async def _serve_real_engine(realm, component, role, instance_seed=0):
+async def _serve_real_engine(realm, component, role, instance_seed=0, **runner_kwargs):
     from dynamo_tpu.engine.model_runner import ModelRunner
     from dynamo_tpu.models.config import get_config
 
     rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
-    runner = ModelRunner(
-        get_config("tiny"),
+    kw = dict(
         num_pages=64,
         page_size=4,
         max_pages_per_seq=16,
@@ -35,6 +34,8 @@ async def _serve_real_engine(realm, component, role, instance_seed=0):
         prefill_buckets=(8, 16, 32),
         seed=7,  # identical weights on P and D
     )
+    kw.update(runner_kwargs)
+    runner = ModelRunner(get_config("tiny"), **kw)
     engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
     card = ModelCard(name="tiny", tokenizer="byte", context_length=64, kv_block_size=4)
     w = await serve_worker(rt, engine, card, component=component, disagg_role=role)
@@ -297,6 +298,105 @@ async def test_disagg_chunked_transfer_matches_aggregated():
             m.scheduled_tokens for m in w_d.engine.fpm_history if m.kind == "prefill"
         )
         assert prefill_tokens == 0, "KV must arrive chunked, not recompute"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for w, rt in ((w_d, rt_d), (w_p, rt_p)):
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+async def test_disagg_cross_tp_parity():
+    """Cross-TP layout handshake (ref docs/design-docs/kvbm-design.md:188-197):
+    prefill worker at TP=1 feeds a decode worker at TP=2 over the
+    host-staged wire. The dense full-head wire format plus geometry
+    metadata must interoperate across differing TP degrees — output
+    identical to an aggregated TP=2 run, decode worker skips prefill."""
+    import jax
+
+    from dynamo_tpu import worker_common
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    prompt = list(range(30, 50))
+    tp2 = dict(mesh_config=MeshConfig(model=2), devices=jax.devices()[:2])
+
+    # aggregated baseline on the SAME decode-side compute (TP=2)
+    rt_a, w_a = await _serve_real_engine("xtp-agg", "tpu-worker", None, **tp2)
+    frt_a, svc_a, base_a = await _stack("xtp-agg", None)
+    try:
+        agg = await _completion_tokens(base_a, prompt)
+    finally:
+        await svc_a.stop()
+        await frt_a.shutdown()
+        await w_a.stop()
+        await rt_a.shutdown(drain_timeout=1)
+
+    rt_d, w_d = await _serve_real_engine("xtp", "tpu-worker", None, **tp2)
+    rt_p, w_p = await _serve_real_engine("xtp", "prefill", "prefill")  # TP=1
+    assert w_p.engine.runner.mesh_config.model == 1
+    assert w_d.engine.runner.mesh_config.model == 2
+    worker_common.LOCAL_ENGINES.clear()  # force the host-staged wire
+    frt, svc, base = await _stack("xtp", None)
+    try:
+        entry = svc.manager.get("tiny")
+        for _ in range(100):
+            if entry.prefill_router is not None and entry.prefill_router.active:
+                break
+            await asyncio.sleep(0.05)
+        assert entry.prefill_router.active
+
+        dis = await _completion_tokens(base, prompt)
+        assert dis["choices"][0]["text"] == agg["choices"][0]["text"]
+        assert dis["usage"] == agg["usage"]
+        prefill_tokens = sum(
+            m.scheduled_tokens for m in w_d.engine.fpm_history if m.kind == "prefill"
+        )
+        assert prefill_tokens == 0, "KV must cross TP degrees, not recompute"
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        for w, rt in ((w_d, rt_d), (w_p, rt_p)):
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+
+async def test_disagg_page_geometry_mismatch_recomputes():
+    """A prefill peer running a DIFFERENT page size must be rejected by the
+    layout handshake: the decode worker falls back to local prefill
+    (correct output, no error surfaced to the client)."""
+    from dynamo_tpu import worker_common
+
+    prompt = list(range(60, 80))
+
+    rt_a, w_a = await _serve_real_engine("psz-agg", "tpu-worker", None)
+    frt_a, svc_a, base_a = await _stack("psz-agg", None)
+    try:
+        agg = await _completion_tokens(base_a, prompt)
+    finally:
+        await svc_a.stop()
+        await frt_a.shutdown()
+        await w_a.stop()
+        await rt_a.shutdown(drain_timeout=1)
+
+    rt_d, w_d = await _serve_real_engine("psz", "tpu-worker", None)  # PS=4
+    rt_p, w_p = await _serve_real_engine("psz", "prefill", "prefill", page_size=8)
+    worker_common.LOCAL_ENGINES.clear()  # host-staged wire carries metadata
+    frt, svc, base = await _stack("psz", None)
+    try:
+        entry = svc.manager.get("tiny")
+        for _ in range(100):
+            if entry.prefill_router is not None and entry.prefill_router.active:
+                break
+            await asyncio.sleep(0.05)
+
+        dis = await _completion_tokens(base, prompt)
+        # fallback recompute must still produce the aggregated answer
+        assert dis["choices"][0]["text"] == agg["choices"][0]["text"]
+        assert dis["usage"] == agg["usage"]
+        prefill_tokens = sum(
+            m.scheduled_tokens for m in w_d.engine.fpm_history if m.kind == "prefill"
+        )
+        assert prefill_tokens > 0, "mismatched geometry must trigger recompute"
     finally:
         await svc.stop()
         await frt.shutdown()
