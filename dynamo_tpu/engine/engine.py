@@ -386,12 +386,13 @@ class InferenceEngine:
         if payload.get("device"):
             return None
         page_shape = getattr(self.runner, "kv_page_shape", None)
+        wire_dtype = getattr(self.runner, "kv_wire_dtype", None)
         parts = payload.get("chunks") or ([payload] if payload.get("data") else [])
         for p in parts:
             if not p.get("k"):
                 continue
             if page_shape is not None:
-                bad = kv_payload_incompatible(p, page_shape)
+                bad = kv_payload_incompatible(p, page_shape, wire_dtype)
             else:  # sim runners without pools: version check only
                 from dynamo_tpu.engine.model_runner import KV_WIRE_LAYOUT_VERSION
 
@@ -849,7 +850,14 @@ class InferenceEngine:
         if self.host_pool is None or not hashes:
             return
         try:
-            arrays = kv_payload_to_arrays(payload)
+            # geometry/dtype validated at INGEST: a mismatched peer block
+            # stored into G2 would otherwise pass host_pool and explode as
+            # an unhandled KvWireLayoutMismatch at onboard time
+            arrays = kv_payload_to_arrays(
+                payload,
+                getattr(self.runner, "kv_page_shape", None),
+                getattr(self.runner, "kv_wire_dtype", None),
+            )
         except Exception:
             # mixed-version peer (KvWireLayoutMismatch) or corrupt bytes:
             # drop the pull — admission recomputes; never adopt the blocks

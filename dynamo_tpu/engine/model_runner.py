@@ -132,13 +132,16 @@ def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray, tp: int = 1) -> Dict[str,
 
 
 def kv_payload_incompatible(
-    payload: Dict[str, Any], page_shape: Tuple[int, int, int, int]
+    payload: Dict[str, Any],
+    page_shape: Tuple[int, int, int, int],
+    dtype: Optional[str] = None,
 ) -> Optional[str]:
     """Reason string when `payload` cannot be imported into a pool whose
-    per-page geometry is `page_shape` = (L, PS, Hk, D); None when
-    compatible. Wire version and page geometry must match exactly — the
-    exporter's TP degree is deliberately NOT checked (the dense full-head
-    wire makes it irrelevant; see kv_arrays_to_payload)."""
+    per-page geometry is `page_shape` = (L, PS, Hk, D) and (optionally)
+    whose wire dtype name is `dtype`; None when compatible. Wire version,
+    page geometry and dtype must match exactly — the exporter's TP degree
+    is deliberately NOT checked (the dense full-head wire makes it
+    irrelevant; see kv_arrays_to_payload)."""
     if payload.get("layout") != KV_WIRE_LAYOUT_VERSION:
         return f"layout {payload.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
     L, PS, Hk, D = page_shape
@@ -148,15 +151,17 @@ def kv_payload_incompatible(
     got = (shape[0], shape[2], shape[3], shape[4])
     if got != (L, PS, Hk, D):
         return f"page geometry {got} != local (L={L}, PS={PS}, Hk={Hk}, D={D})"
+    if dtype is not None and payload.get("dtype") != dtype:
+        return f"dtype {payload.get('dtype')} != local {dtype}"
     return None
 
 
-def kv_payload_to_arrays(payload: Dict[str, Any], page_shape=None):
+def kv_payload_to_arrays(payload: Dict[str, Any], page_shape=None, dtype=None):
     """Inverse of kv_arrays_to_payload; None if the payload carries no data
     (simulated workers). Raises KvWireLayoutMismatch when the sender used a
-    different pool layout version or (when `page_shape` is given) a
-    different page geometry — the importer must fail the transfer
-    (recompute locally) rather than adopt transposed bytes."""
+    different pool layout version or (when `page_shape`/`dtype` is given) a
+    different page geometry or element type — the importer must fail the
+    transfer (recompute locally) rather than adopt mis-shaped bytes."""
     if not payload or not payload.get("k"):
         return None
     if payload.get("layout") != KV_WIRE_LAYOUT_VERSION:
@@ -164,7 +169,7 @@ def kv_payload_to_arrays(payload: Dict[str, Any], page_shape=None):
             f"kv wire layout {payload.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
         )
     if page_shape is not None:
-        bad = kv_payload_incompatible(payload, page_shape)
+        bad = kv_payload_incompatible(payload, page_shape, dtype)
         if bad:
             raise KvWireLayoutMismatch(bad)
     import ml_dtypes
@@ -709,6 +714,12 @@ class ModelRunner:
         c = self.config
         return (c.n_layers, self.page_size, c.n_kv_heads, c.head_dim)
 
+    @property
+    def kv_wire_dtype(self) -> str:
+        """Dtype name pages cross the transfer boundary with (the DENSE
+        pool dtype — quantized pools dequantize at export)."""
+        return str(np.dtype(self.dtype))
+
     def import_pages(self, target_pages: List[int], offset: int, payload: Dict[str, Any]) -> None:
         """Host→device write of transferred pages into this pool's page
         slots. `offset` = first payload page to use (earlier pages were
@@ -716,7 +727,7 @@ class ModelRunner:
         metadata against the local pool geometry (KvWireLayoutMismatch on
         any divergence); a cross-TP exporter is fine — the dense wire pages
         reshard into this mesh's pool sharding on the scatter below."""
-        arrays = kv_payload_to_arrays(payload, self.kv_page_shape)
+        arrays = kv_payload_to_arrays(payload, self.kv_page_shape, self.kv_wire_dtype)
         if arrays is None:
             return
         k, v = arrays

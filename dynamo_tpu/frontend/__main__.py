@@ -25,7 +25,7 @@ def parse_args(argv=None):
     p.add_argument(
         "--router-mode",
         default="round_robin",
-        choices=["round_robin", "random", "kv", "kv-remote"],
+        choices=["round_robin", "random", "p2c", "least_loaded", "kv", "kv-remote"],
         help="worker selection policy (kv = embedded KV-cache-aware "
              "router; kv-remote = delegate to a standalone "
              "dynamo_tpu.router.services selection service)",

@@ -9,8 +9,12 @@ Frames are length-prefixed msgpack maps:
   server→client: {"t":"item","id","data"} ...  {"t":"done","id"}
                  {"t":"err","id","msg","code"}
 
-One in-flight request per pooled connection (the reference pools TCP
-connections similarly; multiplexing is an optimization for a later round).
+Connections are MULTIPLEXED: many id-tagged request streams interleave on
+one TCP connection (reference zero_copy_decoder.rs + conn pooling — the
+server has always demuxed by id; the client-side _MuxConn completes the
+pair). A small per-address connection set fans out streams by
+least-streams-first, so hundreds of concurrent requests ride a handful of
+sockets instead of one socket each.
 """
 
 from __future__ import annotations
@@ -220,46 +224,174 @@ class PushEndpoint:
             conn_ctxs.pop(rid, None)
 
 
-class _ConnPool:
-    """Per-address pool of idle TCP connections."""
+class _MuxConn:
+    """One TCP connection carrying many concurrent id-tagged streams. A
+    single reader task demuxes inbound frames into per-stream queues; the
+    shared writer is serialized by a lock. Death (EOF, reset, oversized
+    frame) fans a disconnect sentinel out to every open stream."""
 
-    def __init__(self, max_idle: int = 8, connect_timeout: float = 5.0):
-        self._idle: Dict[str, list] = {}
-        self.max_idle = max_idle
+    _DISCONNECT = object()
+
+    # Per-stream inbound buffer, in frames. Bounded so one slow consumer
+    # (or a multi-GB chunked KV pull) applies TCP backpressure through the
+    # shared socket instead of materializing in client memory — the cost is
+    # head-of-line blocking on that conn once a stream is 16 frames behind,
+    # which is the standard mux trade (HTTP/2 flow control plays this role).
+    STREAM_BUF_FRAMES = 16
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 gen: int = 0):
+        self._reader = reader
+        self._writer = writer
+        self._wlock = asyncio.Lock()
+        self._streams: Dict[str, asyncio.Queue] = {}
+        self.closed = False
+        self.gen = gen  # pool dial generation (stale-retry bookkeeping)
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+    @property
+    def n_streams(self) -> int:
+        return len(self._streams)
+
+    def open_stream(self, rid: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.STREAM_BUF_FRAMES)
+        self._streams[rid] = q
+        return q
+
+    def close_stream(self, rid: str) -> None:
+        q = self._streams.pop(rid, None)
+        # drain so a reader blocked on a full queue for this (now dead)
+        # stream wakes up instead of wedging the whole connection
+        while q is not None:
+            try:
+                q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+
+    async def send(self, obj: Dict[str, Any]) -> None:
+        async with self._wlock:
+            await _send_frame(self._writer, obj)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await _recv_frame(self._reader)
+                if frame is None:
+                    break
+                q = self._streams.get(frame.get("id"))
+                # frames for unknown ids (stream abandoned client-side
+                # before the server noticed the cancel) are dropped
+                if q is not None:
+                    await q.put(frame)
+        except Exception:
+            pass
+        finally:
+            self.close()
+
+    @classmethod
+    def _push_sentinel(cls, q: asyncio.Queue) -> None:
+        try:
+            q.put_nowait(cls._DISCONNECT)
+        except asyncio.QueueFull:
+            try:
+                q.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            q.put_nowait(cls._DISCONNECT)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._writer.close()
+        for q in self._streams.values():
+            self._push_sentinel(q)
+
+    def shutdown(self) -> None:
+        self.close()
+        self._reader_task.cancel()
+
+
+class _ConnPool:
+    """Per-address set of multiplexed connections. Streams land on the
+    live connection with the fewest open streams; a new connection is
+    dialed only when every existing one is at `streams_per_conn`, up to
+    `max_conns` (beyond that, streams keep stacking on the least-loaded
+    socket — they're cheap, sockets aren't)."""
+
+    def __init__(
+        self,
+        max_conns: int = 8,
+        streams_per_conn: int = 32,
+        connect_timeout: float = 5.0,
+    ):
+        self._conns: Dict[str, list] = {}
+        self._dial_locks: Dict[str, asyncio.Lock] = {}
+        self._gen: Dict[str, int] = {}  # per-address dial generation
+        self.max_conns = max_conns
+        self.streams_per_conn = streams_per_conn
         self.connect_timeout = connect_timeout
 
-    async def acquire(
-        self, address: str, fresh: bool = False
-    ) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter, bool]:
-        """Returns (reader, writer, pooled). `fresh=True` bypasses the pool
-        (used to retry after a pooled connection turned out stale)."""
-        pool = self._idle.get(address)
-        while pool and not fresh:
-            reader, writer = pool.pop()
-            if not writer.is_closing():
-                return reader, writer, True
+    async def _dial(self, address: str) -> _MuxConn:
         host, port = address.rsplit(":", 1)
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, int(port)), self.connect_timeout
             )
-            return reader, writer, False
         except (OSError, asyncio.TimeoutError) as e:
             raise RequestPlaneError(f"cannot connect to {address}: {e}", code="cannot_connect")
+        gen = self._gen.get(address, 0) + 1
+        self._gen[address] = gen
+        conn = _MuxConn(reader, writer, gen=gen)
+        self._conns.setdefault(address, []).append(conn)
+        return conn
 
-    def release(self, address: str, conn: Tuple[asyncio.StreamReader, asyncio.StreamWriter]) -> None:
-        reader, writer = conn
-        pool = self._idle.setdefault(address, [])
-        if writer.is_closing() or len(pool) >= self.max_idle:
-            writer.close()
-        else:
-            pool.append(conn)
+    def _best_live(self, address: str, gen_floor: int = -1) -> Optional[_MuxConn]:
+        conns = self._conns.get(address, [])
+        live = [c for c in conns if not c.closed]
+        if len(live) != len(conns):
+            self._conns[address] = live
+        cands = [c for c in live if c.gen > gen_floor]
+        if not cands:
+            return None
+        best = min(cands, key=lambda c: c.n_streams)
+        if best.n_streams < self.streams_per_conn or len(live) >= self.max_conns:
+            return best
+        return None
+
+    async def acquire(
+        self, address: str, rid: str, after: Optional[_MuxConn] = None
+    ) -> Tuple[_MuxConn, asyncio.Queue, bool]:
+        """Returns (conn, stream queue, pooled) with stream `rid` already
+        registered — registration happens HERE so concurrent acquires see
+        each other's load and don't all stampede into new sockets.
+
+        `after` marks a stale-retry (the given conn just died, e.g. the
+        server restarted under a pooled socket): only connections dialed
+        AFTER it qualify for reuse, so the retry is guaranteed a
+        post-restart socket — but N simultaneous retries still share a
+        handful of new dials instead of opening N (the dial lock
+        serializes, and waiters land on the winner's socket)."""
+        gen_floor = after.gen if after is not None else -1
+        best = self._best_live(address, gen_floor)
+        if best is not None:
+            return best, best.open_stream(rid), after is None
+        # dials are serialized per address, and capacity is re-checked
+        # under the lock: waiters queued behind the winning dial land on
+        # its socket instead of each opening their own
+        lock = self._dial_locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            best = self._best_live(address, gen_floor)
+            if best is not None:
+                return best, best.open_stream(rid), after is None
+            conn = await self._dial(address)
+            return conn, conn.open_stream(rid), False
 
     def close(self) -> None:
-        for pool in self._idle.values():
-            for _, writer in pool:
-                writer.close()
-        self._idle.clear()
+        for conns in self._conns.values():
+            for c in conns:
+                c.shutdown()
+        self._conns.clear()
 
 
 class RemoteEngine:
@@ -273,37 +405,35 @@ class RemoteEngine:
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
         """Stream the remote response. If a *pooled* connection turns out
-        stale (server restarted since it was pooled) and nothing has been
+        stale (server restarted since it was dialed) and nothing has been
         yielded yet, retry once on a fresh connection."""
-        reader, writer, pooled = await self._pool.acquire(self.address)
+        conn, q, pooled = await self._pool.acquire(self.address, context.id)
         yielded = False
         while True:
             try:
-                async for item in self._stream_once(reader, writer, request, context):
+                async for item in self._stream_once(conn, q, request, context):
                     yielded = True
                     yield item
                 return
             except RequestPlaneError as e:
                 if pooled and not yielded and e.code == "disconnected":
-                    reader, writer, pooled = await self._pool.acquire(self.address, fresh=True)
+                    conn, q, pooled = await self._pool.acquire(
+                        self.address, context.id, after=conn
+                    )
                     continue
                 raise
 
     async def _stream_once(
-        self,
-        reader: asyncio.StreamReader,
-        writer: asyncio.StreamWriter,
-        request: Any,
-        context: Context,
+        self, conn: _MuxConn, q: asyncio.Queue, request: Any, context: Context
     ) -> AsyncIterator[Any]:
-        clean = False
+        rid = context.id
         canceller: Optional[asyncio.Task] = None
+        finished = False
         try:
-            await _send_frame(
-                writer,
+            await conn.send(
                 {
                     "t": "req",
-                    "id": context.id,
+                    "id": rid,
                     "endpoint": self.endpoint_path,
                     "headers": context.to_headers(),
                     "payload": request,
@@ -314,14 +444,14 @@ class RemoteEngine:
                 await context.wait_stopped()
                 try:
                     kind = "kill" if context.is_killed else "cancel"
-                    await _send_frame(writer, {"t": kind, "id": context.id})
+                    await conn.send({"t": kind, "id": rid})
                 except (ConnectionResetError, BrokenPipeError, OSError):
                     pass
 
             canceller = asyncio.create_task(_forward_cancel())
             while True:
-                frame = await _recv_frame(reader)
-                if frame is None:
+                frame = await q.get()
+                if frame is _MuxConn._DISCONNECT:
                     raise RequestPlaneError(
                         f"disconnected from {self.address}", code="disconnected"
                     )
@@ -329,23 +459,32 @@ class RemoteEngine:
                 if t == "item":
                     yield frame["data"]
                 elif t == "done":
-                    clean = True
+                    finished = True
                     return
                 elif t == "err":
+                    finished = True  # server already ended this stream
                     code = frame.get("code", "engine")
-                    if code in ("draining", "no_endpoint", "cancelled"):
-                        clean = True
                     raise RequestPlaneError(frame.get("msg", "remote error"), code=code)
         except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            conn.close()  # writer failed mid-frame: poison the whole conn
+            finished = True
             raise RequestPlaneError(f"connection lost to {self.address}: {e}", code="disconnected")
         finally:
             if canceller is not None:
                 canceller.cancel()
-            # a connection mid-stream is poisoned; only clean completions are pooled
-            if clean:
-                self._pool.release(self.address, (reader, writer))
-            else:
-                writer.close()
+            conn.close_stream(rid)
+            if not finished and not conn.closed:
+                # stream abandoned mid-flight (consumer stopped iterating):
+                # the shared socket stays open, so tell the server to stop
+                # instead of letting it stream into the void (best-effort —
+                # the conn may die first, which achieves the same thing)
+                async def _bg_kill():
+                    try:
+                        await conn.send({"t": "kill", "id": rid})
+                    except Exception:
+                        pass
+
+                asyncio.ensure_future(_bg_kill())
 
 
 class RouterMode:
@@ -353,12 +492,21 @@ class RouterMode:
     RANDOM = "random"
     DIRECT = "direct"
     KV = "kv"  # handled one level up by KvPushRouter
+    P2C = "p2c"  # power-of-two-choices by load
+    LEAST_LOADED = "least_loaded"
 
 
 class PushRouter:
     """Client-side fan-out over the live instance set of an endpoint
-    (reference egress/push_router.rs:184-194). Instance set is maintained by
-    a discovery watch; routing modes: round_robin / random / direct."""
+    (reference egress/push_router.rs:184-194 RouterMode{RoundRobin, Random,
+    PowerOfTwoChoices, KV, Direct, LeastLoaded, ...}). Instance set is
+    maintained by a discovery watch.
+
+    Load-aware modes (p2c / least_loaded) rank instances by the router's
+    own count of outstanding requests per instance; a worker-published
+    load signal (FPM kv utilization, queue depth) can override it via
+    update_load() — when present it wins, since it sees load from OTHER
+    frontends too."""
 
     def __init__(self, endpoint_path: str, mode: str = RouterMode.ROUND_ROBIN):
         self.endpoint_path = endpoint_path
@@ -366,12 +514,38 @@ class PushRouter:
         self._pool = _ConnPool()
         self._instances: Dict[int, str] = {}  # instance_id -> address
         self._rr = 0
+        self._inflight: Dict[int, int] = {}  # instance_id -> outstanding reqs
+        self._ext_load: Dict[int, float] = {}  # worker-published load
 
     def update_instance(self, instance_id: int, address: Optional[str]) -> None:
         if address is None:
             self._instances.pop(instance_id, None)
+            self._inflight.pop(instance_id, None)
+            self._ext_load.pop(instance_id, None)
         else:
             self._instances[instance_id] = address
+
+    def update_load(self, instance_id: int, load: Optional[float]) -> None:
+        """Feed a worker-published load value (None clears it, falling back
+        to the local outstanding-request count)."""
+        if load is None:
+            self._ext_load.pop(instance_id, None)
+        else:
+            self._ext_load[instance_id] = load
+
+    def load_of(self, instance_id: int) -> float:
+        ext = self._ext_load.get(instance_id)
+        return ext if ext is not None else float(self._inflight.get(instance_id, 0))
+
+    def _load_key(self, ids):
+        """Comparable load metric across `ids`: worker-published load only
+        when EVERY candidate has published one — mixing published
+        utilization (0..1) with local in-flight counts (0..N) would
+        systematically misroute toward whichever instance happens to have
+        the external signal."""
+        if all(i in self._ext_load for i in ids):
+            return self._ext_load.__getitem__
+        return lambda i: float(self._inflight.get(i, 0))
 
     @property
     def instance_ids(self) -> list:
@@ -396,6 +570,22 @@ class PushRouter:
         ids = sorted(self._instances)
         if self.mode == RouterMode.RANDOM:
             iid = random.choice(ids)
+        elif self.mode == RouterMode.P2C:
+            # two independent uniform picks, keep the less loaded: load
+            # awareness with O(1) state reads and provably exponential
+            # improvement over random in the balls-in-bins sense
+            load = self._load_key(ids)
+            a, b = random.choice(ids), random.choice(ids)
+            iid = a if load(a) <= load(b) else b
+        elif self.mode == RouterMode.LEAST_LOADED:
+            # round-robin tiebreak so equal-load instances share work
+            # instead of the lowest id absorbing every burst
+            self._rr += 1
+            n = len(ids)
+            iid = min(
+                (ids[(self._rr + i) % n] for i in range(n)),
+                key=self._load_key(ids),
+            )
         else:  # round robin default
             iid = ids[self._rr % len(ids)]
             self._rr += 1
@@ -410,8 +600,16 @@ class PushRouter:
         # report the choice so wrappers (session affinity) can pin to it
         context.metadata["routed_instance"] = iid
         engine = RemoteEngine(self._pool, addr, self.endpoint_path)
-        async for item in engine.generate(request, context):
-            yield item
+        self._inflight[iid] = self._inflight.get(iid, 0) + 1
+        try:
+            async for item in engine.generate(request, context):
+                yield item
+        finally:
+            left = self._inflight.get(iid, 1) - 1
+            if left > 0:
+                self._inflight[iid] = left
+            else:
+                self._inflight.pop(iid, None)
 
     def close(self) -> None:
         self._pool.close()

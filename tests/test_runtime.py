@@ -293,3 +293,155 @@ async def test_otlp_log_handler_ships_batches():
     finally:
         lg.removeHandler(handler)
         await runner.cleanup()
+
+
+# -- request plane multiplexing + load-aware routing ------------------------
+
+
+async def test_mux_soak_200_streams_over_few_sockets():
+    """200 concurrent streams must interleave over at most max_conns (8)
+    TCP connections per address (reference multiplexes with an id-tagged
+    codec, codec/zero_copy_decoder.rs), all completing correctly."""
+
+    class StreamEngine:
+        async def generate(self, request, context):
+            for i in range(3):
+                await asyncio.sleep(0.001)
+                yield {"n": request["n"], "i": i}
+
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm="mux"), event_transport="inproc")
+    await wrt.serve_endpoint("ns/w/gen", StreamEngine())
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="mux"), event_transport="inproc")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+
+    async def one(n):
+        got = []
+        async for item in client.generate({"n": n}):
+            got.append(item)
+        assert [it["i"] for it in got] == [0, 1, 2]
+        assert all(it["n"] == n for it in got)
+
+    await asyncio.gather(*(one(n) for n in range(200)))
+
+    pools = client.router._pool._conns
+    n_client_conns = sum(len(v) for v in pools.values())
+    assert 0 < n_client_conns <= 8, f"expected <=8 sockets, dialed {n_client_conns}"
+    assert len(wrt.server._conns) <= 8
+    await client.close()
+    await crt.shutdown()
+    await wrt.shutdown(drain_timeout=1)
+
+
+async def test_mux_stream_abandon_kills_server_side_only_that_stream():
+    """Abandoning one stream on a shared connection must stop its server
+    handler (kill frame) without disturbing the other stream."""
+
+    class SlowEngine:
+        async def generate(self, request, context):
+            for i in range(1000):
+                await asyncio.sleep(0.005)
+                yield {"i": i}
+
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm="mux2"), event_transport="inproc")
+    await wrt.serve_endpoint("ns/w/gen", SlowEngine())
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="mux2"), event_transport="inproc")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+
+    async def abandoner():
+        agen = client.generate({}).__aiter__()
+        await agen.__anext__()
+        await agen.aclose()  # walk away mid-stream
+
+    async def survivor():
+        got = 0
+        async for item in client.generate({}):
+            got += 1
+            if got == 20:
+                break
+        return got
+
+    res = await asyncio.gather(abandoner(), survivor())
+    assert res[1] == 20
+    # the abandoned handler must die server-side (kill frame propagated)
+    for _ in range(100):
+        if wrt.server.active_requests == 0:
+            break
+        await asyncio.sleep(0.05)
+    assert wrt.server.active_requests == 0
+    await client.close()
+    await crt.shutdown()
+    await wrt.shutdown(drain_timeout=1)
+
+
+def test_p2c_and_least_loaded_prefer_lighter_instance():
+    from dynamo_tpu.runtime.request_plane import PushRouter
+
+    r = PushRouter("ns/w/gen", RouterMode.P2C)
+    r.update_instance(1, "127.0.0.1:1")
+    r.update_instance(2, "127.0.0.1:2")
+    r.update_load(1, 50.0)
+    r.update_load(2, 0.0)
+    picks = [r._pick()[0] for _ in range(100)]
+    # p2c picks 2 whenever it appears in the sample: >= 3/4 expected
+    assert picks.count(2) >= 60
+
+    r.mode = RouterMode.LEAST_LOADED
+    assert all(r._pick()[0] == 2 for _ in range(10))
+    r.update_load(2, 100.0)
+    assert all(r._pick()[0] == 1 for _ in range(10))
+    # clearing external load falls back to local in-flight (both 0 → rr
+    # tiebreak alternates)
+    r.update_load(1, None)
+    r.update_load(2, None)
+    assert {r._pick()[0] for _ in range(4)} == {1, 2}
+
+
+async def test_least_loaded_balances_by_outstanding_requests():
+    """With no worker-published load, least_loaded must spread concurrent
+    requests by the router's own in-flight counts."""
+
+    class GateEngine:
+        def __init__(self, tag, gate):
+            self.tag = tag
+            self.gate = gate
+
+        async def generate(self, request, context):
+            yield {"tag": self.tag, "phase": "start"}
+            await self.gate.wait()
+            yield {"tag": self.tag, "phase": "end"}
+
+    gate = asyncio.Event()
+    rt1 = DistributedRuntime(discovery=MemDiscovery(realm="ll"), event_transport="inproc")
+    rt2 = DistributedRuntime(discovery=MemDiscovery(realm="ll"), event_transport="inproc")
+    await rt1.serve_endpoint("ns/w/gen", GateEngine("a", gate), instance_id=11)
+    await rt2.serve_endpoint("ns/w/gen", GateEngine("b", gate), instance_id=22)
+    crt = DistributedRuntime(discovery=MemDiscovery(realm="ll"), event_transport="inproc")
+    client = crt.client("ns/w/gen", RouterMode.LEAST_LOADED)
+    await client.wait_ready()
+    while len(client.instances) < 2:
+        await asyncio.sleep(0.01)
+
+    tags = []
+
+    async def one(first_item_evt):
+        async for item in client.generate({}):
+            if item["phase"] == "start":
+                tags.append(item["tag"])
+                first_item_evt.set()
+
+    tasks = []
+    for _ in range(4):
+        evt = asyncio.Event()
+        tasks.append(asyncio.create_task(one(evt)))
+        # wait until the request is routed + started before launching the
+        # next, so the in-flight counts are deterministic
+        await asyncio.wait_for(evt.wait(), 5)
+    gate.set()
+    await asyncio.gather(*tasks)
+    assert sorted(tags)[:2] == ["a", "a"] and sorted(tags)[2:] == ["b", "b"], tags
+    await client.close()
+    await crt.shutdown()
+    await rt1.shutdown(drain_timeout=1)
+    await rt2.shutdown(drain_timeout=1)
