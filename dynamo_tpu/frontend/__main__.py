@@ -44,6 +44,13 @@ def parse_args(argv=None):
                         "worker for this many idle seconds (0 = off)")
     p.add_argument("--busy-threshold", type=int, default=0,
                    help="shed load (503) above this many in-flight requests per model")
+    p.add_argument("--router-busy-blocks", type=int, default=0,
+                   help="kv mode: queue requests once every worker carries "
+                        "this many charged KV blocks (0 = no queue)")
+    p.add_argument("--router-queue-depth", type=int, default=256,
+                   help="waiting requests beyond this are rejected with 429")
+    p.add_argument("--router-queue-timeout", type=float, default=30.0,
+                   help="queued longer than this is rejected with 429")
     p.add_argument("--request-trace", default=None,
                    help="JSONL per-request trace path (also DYN_REQUEST_TRACE)")
     p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
@@ -58,6 +65,15 @@ async def async_main(args) -> None:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     manager = ModelManager()
+    admission = None
+    if args.router_busy_blocks > 0:
+        from dynamo_tpu.router.queue import AdmissionConfig
+
+        admission = AdmissionConfig(
+            busy_blocks=args.router_busy_blocks,
+            max_depth=args.router_queue_depth,
+            max_wait_s=args.router_queue_timeout,
+        )
     watcher = ModelWatcher(
         runtime, manager, router_mode=args.router_mode,
         router_replica_sync=args.router_replica_sync,
@@ -65,6 +81,7 @@ async def async_main(args) -> None:
         disagg_min_prefill_tokens=args.disagg_min_prefill_tokens,
         session_affinity_ttl=args.session_affinity_ttl or None,
         router_service=args.router_service,
+        admission_config=admission,
     )
     svc = HttpService(
         runtime, manager, watcher, host=args.http_host, port=args.http_port,

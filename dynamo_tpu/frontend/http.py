@@ -517,6 +517,13 @@ class HttpService:
                 preprocessed = entry.preprocessor.preprocess_completions(body)
         except ValueError as e:
             return _error(400, str(e), "invalid_request_error")
+        if "priority" in body:
+            # admission-queue class (0 = most urgent); router-level knob,
+            # not part of the OpenAI schema, so it is opt-in per request
+            try:
+                preprocessed["priority"] = int(body["priority"])
+            except (TypeError, ValueError):
+                return _error(400, "priority must be an integer", "invalid_request_error")
 
         ctx = _request_context(request, model)
         rid = f"{'chatcmpl' if kind == 'chat' else 'cmpl'}-{uuid.uuid4().hex[:24]}"
@@ -626,8 +633,16 @@ class HttpService:
             ctx.kill()  # client disconnected (reference disconnect.rs)
             raise
         except Exception as e:
-            log.exception("stream failed for %s", rid)
-            await send({"error": {"message": str(e), "type": "internal_error"}})
+            from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+            if isinstance(e, RequestPlaneError) and e.code in (
+                "queue_full", "queue_timeout",
+            ):
+                # SSE headers already went out; signal overload in-band
+                await send({"error": {"message": str(e), "type": "server_overloaded"}})
+            else:
+                log.exception("stream failed for %s", rid)
+                await send({"error": {"message": str(e), "type": "internal_error"}})
         finally:
             ctx.stop_generating()
         await resp.write_eof()
@@ -653,11 +668,18 @@ class HttpService:
                     break
         except Exception as e:
             from dynamo_tpu.frontend.session_affinity import AffinityError
+            from dynamo_tpu.runtime.request_plane import RequestPlaneError
 
             if isinstance(e, AffinityError):
                 # client-input error (oversized session id, explicit-target
                 # conflict), not a server fault
                 return _error(400, str(e), "invalid_request_error")
+            if isinstance(e, RequestPlaneError) and e.code in (
+                "queue_full", "queue_timeout",
+            ):
+                # router admission queue rejected: the standard
+                # at-capacity contract is 429, not 500
+                return _error(429, str(e), "server_overloaded")
             log.exception("request %s failed", rid)
             return _error(500, str(e), "internal_error")
         finally:

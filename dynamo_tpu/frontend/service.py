@@ -88,11 +88,13 @@ class ModelWatcher:
         disagg_min_prefill_tokens: int = 256,
         session_affinity_ttl: Optional[float] = None,
         router_service: Optional[str] = None,  # kv-remote: ns/component
+        admission_config=None,  # router.queue.AdmissionConfig (kv mode)
     ):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.router_service = router_service
+        self.admission_config = admission_config
         self.router_replica_sync = router_replica_sync
         self.migration_limit = migration_limit
         self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
@@ -126,6 +128,7 @@ class ModelWatcher:
             kv_router = KvRouter(
                 self.runtime, client, block_size=card.kv_block_size,
                 replica_sync=self.router_replica_sync,
+                admission=self.admission_config,
             )
             router_engine: AsyncEngine = KvPushRouter(kv_router)
             teardown = kv_router.stop

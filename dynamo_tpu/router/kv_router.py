@@ -45,7 +45,10 @@ class KvRouter:
         use_kv_events: bool = True,
         approx_ttl: float = 120.0,
         replica_sync: bool = False,
+        admission: Optional["AdmissionConfig"] = None,
     ):
+        from dynamo_tpu.router.queue import AdmissionConfig, AdmissionQueue
+
         self.runtime = runtime
         self.client = client
         self.block_size = block_size
@@ -53,12 +56,23 @@ class KvRouter:
         self.use_kv_events = use_kv_events
         self.selector = WorkerSelector(self.config)
         self.sequences = ActiveSequences()
+        # admission queue: parks requests while every worker is saturated
+        # (reference scheduling/{queue,policy_queue}.rs); disabled unless
+        # busy_blocks > 0
+        self.admission = AdmissionQueue(
+            admission or AdmissionConfig(),
+            load_fn=lambda w: (
+                self.sequences.prefill_blocks(w) + self.sequences.decode_blocks(w)
+            ),
+            workers_fn=self.workers,
+        )
         self.indexer = KvIndexer(
             runtime.event_subscriber(["kv_events"]) if use_kv_events else _NullSub(),
             dump_fn=self._dump_worker if use_kv_events else None,
             ttl=None if use_kv_events else approx_ttl,
         )
         self._started = False
+        self._known_workers: set = set()
         # replica sync (reference kv_router router-replica-sync): frontends
         # running parallel router replicas broadcast add/prefill_done/free
         # deltas so every replica's load view includes the others' in-flight
@@ -140,8 +154,11 @@ class KvRouter:
                         self._sync_sub.disconnect(addr)
                         # dead replica: release every request it had
                         # charged, or its load sticks to workers forever
-                        for rid in self._peer_requests.pop(replica, set()):
+                        peer_rids = self._peer_requests.pop(replica, set())
+                        for rid in peer_rids:
                             self.sequences.free(rid)
+                        # freed peer capacity must wake local waiters too
+                        self.admission.notify(len(peer_rids))
                 except asyncio.CancelledError:
                     raise
                 except Exception:
@@ -206,6 +223,9 @@ class KvRouter:
                     elif op == "free":
                         self.sequences.free(rid)
                         self._peer_requests.get(replica, set()).discard(rid)
+                        # a slot freed on a PEER replica is capacity for
+                        # our waiters just the same
+                        self.admission.notify(1)
                 except asyncio.CancelledError:
                     raise
                 except Exception:
@@ -244,12 +264,32 @@ class KvRouter:
 
     def _on_instance(self, kind: str, inst) -> None:
         worker = (inst.instance_id, 0)
-        if kind == "put" and self.use_kv_events:
-            # never block the discovery watch loop on a worker RPC
-            asyncio.create_task(self._connect_worker(inst))
+        if kind == "put":
+            if self.use_kv_events:
+                # never block the discovery watch loop on a worker RPC
+                asyncio.create_task(self._connect_worker(inst))
+            # fresh capacity: drain the admission queue into it. Only for
+            # a genuinely NEW instance — discovery also emits puts for
+            # metadata updates and lease re-registrations of known
+            # (possibly saturated) workers, which must not dump the queue
+            if inst.instance_id not in self._known_workers:
+                self._known_workers.add(inst.instance_id)
+                self.admission.notify(self.admission.depth)
         elif kind == "delete":
+            self._known_workers.discard(inst.instance_id)
             self.indexer.remove_worker(worker)
             self.sequences.remove_worker(worker)
+            if not self.workers():
+                # nothing left to route to: reject waiters loudly instead
+                # of letting them ripen into queue timeouts
+                self.admission.fail_all(
+                    f"no workers for {self.client.path}", code="no_instances"
+                )
+            elif not self.admission.saturated():
+                # the departed worker's charges just freed; release waiters
+                # only if that actually lifted saturation (survivors may
+                # still be past the threshold)
+                self.admission.notify(self.admission.depth)
 
     async def _connect_worker(self, inst) -> None:
         addr = (inst.metadata or {}).get("kv_publisher")
@@ -389,6 +429,8 @@ class KvRouter:
         self.sequences.free(request_id)
         self._local_requests.pop(request_id, None)
         self._publish_sync("free", request_id)
+        # one request slot freed → admit one queued waiter
+        self.admission.notify(1)
 
     async def stop(self) -> None:
         tasks = list(self._sync_tasks)
@@ -416,6 +458,9 @@ class KvPushRouter:
 
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
         await self.router.start()
+        # admission gate: parks here while every worker is saturated;
+        # raises queue_full / queue_timeout (→ HTTP 429) on rejection
+        await self.router.admission.acquire(request.get("priority"))
         token_ids = request.get("token_ids") or []
         mm = request.get("mm")
         mm_seed = None

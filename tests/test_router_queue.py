@@ -1,0 +1,170 @@
+"""Router admission/policy queue tests (reference
+lib/kv-router/src/scheduling/{queue,policy_queue}.rs): queue order under
+saturation, priority classes, bounded rejection (429), drain."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.router.queue import AdmissionConfig, AdmissionQueue
+from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+
+def _queue(busy=1, depth=8, wait=5.0, load=None, workers=None):
+    load = load if load is not None else {}
+    workers = workers if workers is not None else [(1, 0)]
+    q = AdmissionQueue(
+        AdmissionConfig(busy_blocks=busy, max_depth=depth, max_wait_s=wait),
+        load_fn=lambda w: load.get(w, 0),
+        workers_fn=lambda: workers,
+    )
+    return q, load
+
+
+async def test_admission_passes_while_any_worker_has_headroom():
+    q, load = _queue(busy=10, workers=[(1, 0), (2, 0)])
+    load[(1, 0)] = 50
+    await asyncio.wait_for(q.acquire(), 1)  # (2,0) has headroom
+    load[(2, 0)] = 10
+    assert q.saturated()
+
+
+async def test_admission_queue_priority_order_and_fifo_within_class():
+    q, load = _queue()
+    load[(1, 0)] = 5  # saturated
+    order = []
+
+    async def waiter(tag, pri):
+        await q.acquire(pri)
+        order.append(tag)
+
+    tasks = [
+        asyncio.create_task(waiter("batch-1", 2)),
+        asyncio.create_task(waiter("interactive", 0)),
+        asyncio.create_task(waiter("batch-2", 2)),
+        asyncio.create_task(waiter("default", None)),  # class 1
+    ]
+    await asyncio.sleep(0.05)
+    assert q.depth == 4
+    for _ in range(4):
+        q.notify(1)
+        await asyncio.sleep(0.01)
+    await asyncio.gather(*tasks)
+    assert order == ["interactive", "default", "batch-1", "batch-2"]
+
+
+async def test_admission_queue_depth_overflow_rejects():
+    q, load = _queue(depth=2)
+    load[(1, 0)] = 5
+    t1 = asyncio.create_task(q.acquire())
+    t2 = asyncio.create_task(q.acquire())
+    await asyncio.sleep(0.02)
+    with pytest.raises(RequestPlaneError) as ei:
+        await q.acquire()
+    assert ei.value.code == "queue_full"
+    q.notify(2)
+    await asyncio.gather(t1, t2)
+
+
+async def test_admission_queue_timeout_rejects():
+    q, load = _queue(wait=0.1)
+    load[(1, 0)] = 5
+    with pytest.raises(RequestPlaneError) as ei:
+        await q.acquire()
+    assert ei.value.code == "queue_timeout"
+    # tombstone must not absorb a later release
+    t = asyncio.create_task(q.acquire())
+    await asyncio.sleep(0.02)
+    q.notify(1)
+    await asyncio.wait_for(t, 1)
+
+
+async def test_admission_queue_fail_all():
+    q, load = _queue()
+    load[(1, 0)] = 5
+    t = asyncio.create_task(q.acquire())
+    await asyncio.sleep(0.02)
+    q.fail_all("workers gone")
+    with pytest.raises(RequestPlaneError) as ei:
+        await t
+    assert ei.value.code == "no_instances"
+
+
+# -- e2e: saturate a mocker, verify queueing + 429 + drain -------------------
+
+
+async def test_router_admission_queue_e2e():
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import build_mock_engine, parse_args
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    realm = "adm-e2e"
+    rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    # slow decode so the first request holds the worker saturated while the
+    # others arrive
+    margs = parse_args([
+        "--speed", "1", "--decode-base-ms", "40", "--page-size", "4",
+        "--decode-steps", "1", "--max-batch", "1",
+    ])
+    engine, card = build_mock_engine(margs)
+    w = await serve_worker(rt, engine, card)
+
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        frt, manager, router_mode="kv",
+        admission_config=AdmissionConfig(busy_blocks=1, max_depth=2, max_wait_s=10),
+    )
+    svc = HttpService(frt, manager, watcher, port=0)
+    base = await svc.start()
+    await watcher.wait_for_model(timeout=10)
+    try:
+        async with aiohttp.ClientSession() as s:
+
+            async def req(prompt, max_tokens=12):
+                async with s.post(
+                    f"{base}/v1/completions",
+                    json={"model": "mock-model", "prompt": prompt,
+                          "max_tokens": max_tokens},
+                ) as r:
+                    return r.status, await r.json()
+
+            # A saturates the single worker (busy_blocks=1)
+            a = asyncio.create_task(req("a" * 16, 25))
+            await asyncio.sleep(0.25)
+            entry = svc.manager.get("mock-model")
+            kv_router = entry.chain.downstream.downstream.downstream.router
+            assert kv_router.admission.saturated(), "one in-flight must saturate"
+
+            # B and C queue (depth 2)
+            b = asyncio.create_task(req("b" * 16))
+            c = asyncio.create_task(req("c" * 16))
+            for _ in range(100):
+                if kv_router.admission.depth == 2:
+                    break
+                await asyncio.sleep(0.02)
+            assert kv_router.admission.depth == 2
+
+            # D overflows the queue → 429
+            status_d, body_d = await req("d" * 16)
+            assert status_d == 429, body_d
+            assert body_d["error"]["type"] == "server_overloaded"
+
+            # drain: as slots free, B and C run to completion
+            results = await asyncio.gather(a, b, c)
+            for status, body in results:
+                assert status == 200
+                assert body["usage"]["completion_tokens"] > 0
+            assert kv_router.admission.depth == 0
+            assert kv_router.admission.stats["queued"] == 2
+            assert kv_router.admission.stats["rejected_full"] == 1
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await w.stop()
+        await rt.shutdown(drain_timeout=1)
