@@ -127,6 +127,7 @@ class InferenceEngine:
         self._remote_fetch_backoff: Dict[int, float] = {}
         # disaggregation state
         self._parked: Dict[str, tuple] = {}  # rid -> (Sequence, deadline)
+        self._spec_sampling_warned: set = set()
         self._kv_pending: List[Sequence] = []  # disagg-decode awaiting space
         self.parked_ttl_s = 60.0
         self._embed_pending: List[tuple] = []  # (tokens, future, loop)
@@ -619,9 +620,20 @@ class InferenceEngine:
         self.scheduler.complete_prefill(plan)
         if not plan.is_last_chunk:
             return
-        token = self.runner.sample_one(
-            logits, _sampling_params([seq]), self._next_step()
-        )
+        first_lp = None
+        n_lp1 = _batch_logprobs([seq])
+        if (n_lp1 >= 0 or _batch_penalties([seq])) and hasattr(
+            self.runner, "sample_one_ex"
+        ):
+            token, first_lp = self.runner.sample_one_ex(
+                logits, _sampling_params([seq]), self._next_step(),
+                history=list(seq.tokens) if _batch_penalties([seq]) else None,
+                n_logprobs=n_lp1,
+            )
+        else:
+            token = self.runner.sample_one(
+                logits, _sampling_params([seq]), self._next_step()
+            )
         if seq.disagg == "prefill":
             # disagg: first token + transfer handle; pages stay pinned for
             # the decode worker's pull (disagg-serving.md bootstrap model)
@@ -629,6 +641,9 @@ class InferenceEngine:
             self._parked[seq.request_id] = (
                 seq, time.monotonic() + self.parked_ttl_s
             )
+            extra = {}
+            if first_lp is not None:
+                extra["logprobs"] = [_first_lp_entry(first_lp, seq)]
             self._emit_item(
                 seq,
                 engine_output(
@@ -639,12 +654,19 @@ class InferenceEngine:
                         "prompt_len": len(seq.prompt),
                         "first_token": token,
                     },
+                    **extra,
                 ),
             )
             return
         reason = self.scheduler.complete_decode(seq, token, advance_computed=False)
         emitted = token if reason != "stop" else None
-        self._emit(seq, [token] if emitted is not None else [], reason)
+        lp_entries = None
+        if first_lp is not None and emitted is not None:
+            lp_entries = [_first_lp_entry(first_lp, seq)]
+        self._emit(
+            seq, [token] if emitted is not None else [], reason,
+            logprobs=lp_entries,
+        )
 
     def _run_decode(self, plan: DecodePlan) -> None:
         """Fused multi-step decode: plan.n_steps iterations in one jit with
@@ -658,6 +680,18 @@ class InferenceEngine:
         step0 = self._step_counter + 1
         gamma = getattr(self.runner, "spec_gamma", 0)
         if getattr(self.runner, "has_draft", False):
+            # the speculative verify distribution must equal the draft's
+            # view of the model, so penalties/logprobs are NOT applied on
+            # this path — surface the drop instead of silently ignoring it
+            if _batch_logprobs(seqs) >= 0 or _batch_penalties(seqs):
+                for s in seqs:
+                    if s.request_id not in self._spec_sampling_warned:
+                        self._spec_sampling_warned.add(s.request_id)
+                        log.warning(
+                            "request %s: logprobs/penalties are unsupported "
+                            "with speculative decoding and were ignored",
+                            s.request_id,
+                        )
             # speculative path: R fused draft-propose + target-verify
             # rounds; each round yields 1..gamma+1 tokens per sequence.
             # Near a token budget (T < gamma+1) shrink gamma instead of
@@ -689,29 +723,54 @@ class InferenceEngine:
                 self._emit(seq, emit, reason)
             return
         self._step_counter += T
-        sampled = self.runner.decode_multi(
-            T, tokens, positions, page_tables, _sampling_params(seqs), step0,
-            adapters=[s.adapter_idx for s in seqs],
+        n_lp = _batch_logprobs(seqs)
+        histories = (
+            [list(s.tokens) for s in seqs] if _batch_penalties(seqs) else None
         )
+        lp = None
+        if (n_lp >= 0 or histories is not None) and hasattr(
+            self.runner, "decode_multi_ex"
+        ):
+            sampled, lp = self.runner.decode_multi_ex(
+                T, tokens, positions, page_tables, _sampling_params(seqs), step0,
+                adapters=[s.adapter_idx for s in seqs],
+                n_logprobs=n_lp, histories=histories,
+                prompt_lens=[s.n_prompt0 for s in seqs],
+            )
+        else:
+            sampled = self.runner.decode_multi(
+                T, tokens, positions, page_tables, _sampling_params(seqs), step0,
+                adapters=[s.adapter_idx for s in seqs],
+            )
         for i, seq in enumerate(seqs):
             emit: List[int] = []
+            lp_entries: List[Dict[str, Any]] = []
             reason = None
             for j in range(T):
                 token = int(sampled[i, j])
                 reason = self.scheduler.complete_decode(seq, token)
                 if reason != "stop":
                     emit.append(token)
+                    if lp is not None and seq.sampling.get("logprobs") is not None:
+                        lp_entries.append(_lp_entry(lp, i, j, seq))
                 if reason:
                     break
-            self._emit(seq, emit, reason)
+            self._emit(seq, emit, reason, logprobs=lp_entries or None)
 
     def _next_step(self) -> int:
         self._step_counter += 1
         return self._step_counter
 
     # -- emission ----------------------------------------------------------
-    def _emit(self, seq: Sequence, token_ids: List[int], finish: Optional[str]) -> None:
-        self._emit_item(seq, engine_output(token_ids, finish))
+    def _emit(
+        self,
+        seq: Sequence,
+        token_ids: List[int],
+        finish: Optional[str],
+        logprobs: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
+        extra = {"logprobs": logprobs} if logprobs else {}
+        self._emit_item(seq, engine_output(token_ids, finish, **extra))
 
     def _emit_item(self, seq: Sequence, item: Dict[str, Any]) -> None:
         entry = self._streams.get(seq.request_id)
@@ -927,4 +986,59 @@ def _sampling_params(seqs: List[Sequence]) -> Dict[str, list]:
              else _stable_seed(s.request_id))
             for s in seqs
         ],
+        "rep": [float(s.sampling.get("repetition_penalty", 1.0)) for s in seqs],
+        "freq": [float(s.sampling.get("frequency_penalty", 0.0)) for s in seqs],
+        "presence": [float(s.sampling.get("presence_penalty", 0.0)) for s in seqs],
+    }
+
+
+def _batch_penalties(seqs: List[Sequence]) -> bool:
+    """True when any sequence in the batch asked for a repetition/
+    frequency/presence penalty (switches on the token-history transfer +
+    on-device count table; no-op rows keep default parameters)."""
+    return any(
+        float(s.sampling.get("repetition_penalty", 1.0)) != 1.0
+        or float(s.sampling.get("frequency_penalty", 0.0)) != 0.0
+        or float(s.sampling.get("presence_penalty", 0.0)) != 0.0
+        for s in seqs
+    )
+
+
+def _batch_logprobs(seqs: List[Sequence]) -> int:
+    """Top-N logprob report size for the batch (-1 = nobody asked). One
+    compiled variant serves the whole batch; the report width is bucketed
+    to a fixed menu because it is a jit-static argument — arbitrary widths
+    would let clients induce a fresh decode-loop compile per request.
+    Per-sequence responses are trimmed to each request's own N."""
+    want = [int(s.sampling.get("logprobs") or 0)
+            for s in seqs if s.sampling.get("logprobs") is not None]
+    if not want:
+        return -1
+    mx = max(want)
+    for b in (0, 5, 20):
+        if mx <= b:
+            return b
+    return 20
+
+
+def _first_lp_entry(first_lp, seq: Sequence) -> Dict[str, Any]:
+    """Prefill-first-token logprob record, trimmed to the sequence's own
+    requested top-N (the compiled report width is the bucketed batch max)."""
+    n = int(seq.sampling.get("logprobs") or 0)
+    return {
+        "logprob": first_lp[0],
+        "top_ids": first_lp[1][:n],
+        "top_logprobs": first_lp[2][:n],
+    }
+
+
+def _lp_entry(lp, i: int, j: int, seq: Sequence) -> Dict[str, Any]:
+    """One emitted token's logprob record from the decode loop's stacked
+    report, trimmed to the sequence's own requested top-N."""
+    tok_lp, ids, vals = lp
+    n = int(seq.sampling.get("logprobs") or 0)
+    return {
+        "logprob": float(tok_lp[i, j]),
+        "top_ids": [int(t) for t in ids[i, j, :n]],
+        "top_logprobs": [float(v) for v in vals[i, j, :n]],
     }

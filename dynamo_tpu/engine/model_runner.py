@@ -39,11 +39,14 @@ def _decode_loop(
     attn_impl: str,
     mesh,  # for sharded pallas attention on TP meshes (None = single dev)
     n_steps: int,
+    n_logprobs: int,  # static; -1 = no logprob outputs, >=0 = top-N report
     params,
     tokens0,  # [B] int32 current token per seq — host-packed OR a device
     # array chained from the previous dispatch's output (pipelining: the
     # caller never has to sync tokens to host between dispatches)
     packed,  # int32 [B + B*MP (+B if lora) + 1]: pos|pt|adapters|step
+    hist,  # None (no penalties) or int32 [B, H] token history padded with
+    # vocab_size — builds the on-device count table the penalties read
     k_pool,
     v_pool,
     sampling: SamplingParams,
@@ -55,8 +58,11 @@ def _decode_loop(
     tokens. All per-dispatch dynamic ints arrive in ONE packed array —
     each separate host array would be its own host→device transfer, and on
     a relay-attached TPU each transfer costs a full round trip (measured
-    ~5-10 ms each, dwarfing the step itself).
-    Returns (tokens [B, n_steps], k_pool, v_pool)."""
+    ~5-10 ms each, dwarfing the step itself). `hist` (penalties) is the
+    one exception: it is batch×history sized, so it rides as its own array
+    only when a request actually uses penalties.
+    Returns (tokens [B, n_steps], last [B], lp, k_pool, v_pool) where lp is
+    None or (tok_lp [B, T], top_ids [B, T, K], top_lps [B, T, K])."""
     B = sampling.temperature.shape[0]
     n_fields = 2 if lora is not None else 1
     MP = (packed.shape[0] - 1 - n_fields * B) // B
@@ -65,25 +71,69 @@ def _decode_loop(
     adapter_idx = packed[B + B * MP : 2 * B + B * MP] if lora is not None else None
     step0 = packed[-1]
 
+    use_pen = hist is not None
+    counts0 = out0 = None
+    if use_pen:
+        # hist = (tokens [B, H] padded with vocab_size, prompt_len [B]);
+        # the count of GENERATED tokens only (positions >= prompt_len)
+        # feeds the OpenAI frequency/presence pair, the full count feeds
+        # HF repetition — see sampling.apply_penalties
+        hist_tok, prompt_len = hist
+        V = config.vocab_size
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        cols = jnp.arange(hist_tok.shape[1], dtype=jnp.int32)[None, :]
+        # pad tokens == V scatter out of bounds and drop
+        counts0 = jnp.zeros((B, V), jnp.float32).at[
+            rows, hist_tok
+        ].add(1.0, mode="drop")
+        out_tok = jnp.where(cols >= prompt_len[:, None], hist_tok, V)
+        out0 = jnp.zeros((B, V), jnp.float32).at[
+            rows, out_tok
+        ].add(1.0, mode="drop")
+
     def body(carry, t):
-        tok, kp, vp = carry
+        if use_pen:
+            tok, kp, vp, cnt, cnt_out = carry
+        else:
+            (tok, kp, vp), cnt, cnt_out = carry, None, None
         pos = jnp.where(positions0 < 0, -1, positions0 + t)
         kvl = jnp.where(positions0 < 0, 0, positions0 + t + 1)
         logits, kp, vp = llama.forward(
             config, params, tok[:, None], pos[:, None], kp, vp, page_table, kvl,
             attn_impl=attn_impl, mesh=mesh, lora=lora, adapter_idx=adapter_idx,
         )
-        s = sample(logits[:, 0, :], sampling, step0 + t)
-        return (s, kp, vp), s
+        raw = logits[:, 0, :]
+        l = raw
+        if use_pen:
+            from dynamo_tpu.engine.sampling import apply_penalties
 
-    (last, k_pool, v_pool), toks = lax.scan(
-        body, (tokens0, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32)
-    )
+            l = apply_penalties(raw, cnt, cnt_out, sampling)
+        s = sample(l, sampling, step0 + t)
+        outs = (s,)
+        if n_logprobs >= 0:
+            from dynamo_tpu.engine.sampling import top_logprobs
+
+            outs = (s,) + top_logprobs(raw, s, n_logprobs)
+        if use_pen:
+            r = jnp.arange(B, dtype=jnp.int32)
+            cnt = cnt.at[r, s].add(1.0)
+            cnt_out = cnt_out.at[r, s].add(1.0)
+            return (s, kp, vp, cnt, cnt_out), outs
+        return (s, kp, vp), outs
+
+    carry0 = (tokens0, k_pool, v_pool) + ((counts0, out0) if use_pen else ())
+    carry, ys = lax.scan(body, carry0, jnp.arange(n_steps, dtype=jnp.int32))
+    last, k_pool, v_pool = carry[0], carry[1], carry[2]
+    toks = ys[0]
+    lp = None
+    if n_logprobs >= 0:
+        # scan stacks along T as the leading axis; report [B, T, ...]
+        lp = (ys[1].T, jnp.swapaxes(ys[2], 0, 1), jnp.swapaxes(ys[3], 0, 1))
     # `last` (== toks[:, -1]) is returned as its own output so a chaining
     # caller can feed it straight into the next dispatch — slicing the
     # token matrix caller-side would be an extra eager device program,
     # which through a TPU relay costs a full program round trip
-    return toks.T, last, k_pool, v_pool  # [B, n_steps], [B]
+    return toks.T, last, lp, k_pool, v_pool  # [B, n_steps], [B]
 
 
 # Wire layout version for P→D / cross-worker KV payloads. v2 = token-major
@@ -321,8 +371,8 @@ class ModelRunner:
         self._jit_sample = jax.jit(sample)
         self._jit_decode_loop = jax.jit(
             partial(_decode_loop, self.config, self.attn_impl, self._fwd_mesh),
-            static_argnums=(0,),  # n_steps
-            donate_argnums=(4, 5),  # k_pool, v_pool
+            static_argnums=(0, 1),  # n_steps, n_logprobs
+            donate_argnums=(6, 7),  # k_pool, v_pool
         )
         # device-resident sampling cache: batches re-send identical sampling
         # params every dispatch; transferring them each time costs one relay
@@ -441,6 +491,37 @@ class ModelRunner:
         )
         return np.asarray(jax.device_get(toks))
 
+    def decode_multi_ex(
+        self,
+        n_steps: int,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        sampling,
+        step: int,
+        adapters: Optional[List[int]] = None,
+        n_logprobs: int = -1,
+        histories: Optional[List[List[int]]] = None,
+        prompt_lens: Optional[List[int]] = None,
+    ):
+        """decode_multi with the sampling extras: `histories` (per-sequence
+        prompt+generated token ids) switches on repetition/frequency/
+        presence penalties — `prompt_lens[i]` marks where generated output
+        starts in histories[i] (frequency/presence are output-only; absent
+        = whole history is prompt); `n_logprobs` >= 0 additionally returns
+        (tok_lp [B, T], top_ids [B, T, K], top_lps [B, T, K]) host arrays.
+        Returns (sampled [B, T], lp | None)."""
+        out = self.decode_multi_async(
+            n_steps, tokens, positions, page_tables, sampling, step, adapters,
+            n_logprobs=n_logprobs, histories=histories, prompt_lens=prompt_lens,
+        )
+        if n_logprobs >= 0:
+            toks, _, lp = out
+            toks_h, lp_h = jax.device_get((toks, lp))
+            return np.asarray(toks_h), tuple(np.asarray(a) for a in lp_h)
+        toks, _ = out
+        return np.asarray(jax.device_get(toks)), None
+
     def decode_multi_async(
         self,
         n_steps: int,
@@ -450,6 +531,9 @@ class ModelRunner:
         sampling,
         step: int,
         adapters: Optional[List[int]] = None,
+        n_logprobs: int = -1,
+        histories: Optional[List[List[int]]] = None,
+        prompt_lens: Optional[List[int]] = None,
     ):
         """decode_multi without the host sync: returns (toks, last) DEVICE
         arrays — toks [B_bucket, n_steps] and last [B_bucket] (the final
@@ -457,7 +541,9 @@ class ModelRunner:
         dispatch's `last`, so consecutive dispatches pipeline on device
         with no round trip between them — the caller device_gets token
         batches one dispatch behind the chip (the continuous-batching
-        engine overlaps its bookkeeping the same way)."""
+        engine overlaps its bookkeeping the same way).
+        With n_logprobs >= 0 the return grows to (toks, last, lp) — see
+        decode_multi_ex."""
         n = len(positions)
         B = _next_bucket(self.decode_buckets, n)
         pt = self._pad_page_table(page_tables, B)
@@ -485,11 +571,28 @@ class ModelRunner:
             tok_h[:n] = tokens
             tok = jnp.asarray(tok_h)
 
-        toks, last, self.k_pool, self.v_pool = self._jit_decode_loop(
-            n_steps, self.params, tok, jnp.asarray(packed),
+        hist = None
+        if histories is not None:
+            # bucketed so history growth re-compiles per bucket, not per
+            # token; pad token == vocab_size scatters drop in _decode_loop
+            H = max(8, max((len(h) for h in histories), default=1))
+            H = -(-H // 128) * 128
+            hist_h = np.full((B, H), self.config.vocab_size, np.int32)
+            plen_h = np.zeros(B, np.int32)
+            for i, h in enumerate(histories):
+                hist_h[i, : len(h)] = h
+                plen_h[i] = (
+                    prompt_lens[i] if prompt_lens is not None else len(h)
+                )
+            hist = (jnp.asarray(hist_h), jnp.asarray(plen_h))
+
+        toks, last, lp, self.k_pool, self.v_pool = self._jit_decode_loop(
+            n_steps, n_logprobs, self.params, tok, jnp.asarray(packed), hist,
             self.k_pool, self.v_pool,
             self._device_sampling(sampling, B), self.lora,
         )
+        if n_logprobs >= 0:
+            return toks, last, lp
         return toks, last
 
     def _device_sampling(self, sampling, B: int) -> SamplingParams:
@@ -500,21 +603,29 @@ class ModelRunner:
         on device and bucket-sized by the caller)."""
         if isinstance(sampling, SamplingParams):
             return _pad_sampling(sampling, B)
+        n = len(sampling["temperature"])
+        rep = list(sampling.get("rep") or [1.0] * n)
+        freq = list(sampling.get("freq") or [0.0] * n)
+        presence = list(sampling.get("presence") or [0.0] * n)
         key = (
             B,
             tuple(sampling["temperature"]),
             tuple(sampling["top_k"]),
             tuple(sampling["top_p"]),
             tuple(sampling["seeds"]),
+            tuple(rep), tuple(freq), tuple(presence),
         )
         hit = self._sampling_cache.get(key)
         if hit is None:
-            pad = B - len(sampling["temperature"])
+            pad = B - n
             hit = SamplingParams.make(
                 temperature=list(sampling["temperature"]) + [0.0] * pad,
                 top_k=list(sampling["top_k"]) + [0] * pad,
                 top_p=list(sampling["top_p"]) + [1.0] * pad,
                 seeds=list(sampling["seeds"]) + [0] * pad,
+                rep_penalty=rep + [1.0] * pad,
+                freq_penalty=freq + [0.0] * pad,
+                presence_penalty=presence + [0.0] * pad,
             )
             if len(self._sampling_cache) >= 512:
                 self._sampling_cache.clear()
@@ -617,6 +728,42 @@ class ModelRunner:
     def sample_one(self, logits: jax.Array, sampling, step: int) -> int:
         out = self._jit_sample(logits[None, :], _as_sampling(sampling), jnp.int32(step))
         return int(jax.device_get(out)[0])
+
+    def sample_one_ex(
+        self,
+        logits: jax.Array,
+        sampling,
+        step: int,
+        history: Optional[List[int]] = None,
+        n_logprobs: int = -1,
+    ):
+        """sample_one with penalties (over `history` token ids) and/or a
+        logprob report. Returns (token, lp) where lp is None or
+        (tok_lp, top_ids list, top_lps list) for the sampled position."""
+        if not hasattr(self, "_jit_sample_one_ex"):
+            self._jit_sample_one_ex = jax.jit(
+                partial(_sample_one_ex, self.config.vocab_size),
+                static_argnums=(0,),
+            )
+        hist = None
+        if history is not None:
+            H = -(-max(1, len(history)) // 128) * 128
+            h = np.full(H, self.config.vocab_size, np.int32)
+            h[: len(history)] = history
+            hist = jnp.asarray(h)
+        out = self._jit_sample_one_ex(
+            n_logprobs, logits, hist, _as_sampling(sampling), jnp.int32(step)
+        )
+        out = jax.device_get(out)
+        tok = int(out[0][0])
+        if n_logprobs < 0:
+            return tok, None
+        tok_lp, ids, vals = out[1], out[2], out[3]
+        return tok, (
+            float(tok_lp[0]),
+            [int(i) for i in ids[0]],
+            [float(v) for v in vals[0]],
+        )
 
     def _pad_page_table(self, rows: List[List[int]], B: Optional[int] = None) -> np.ndarray:
         B = B or len(rows)
@@ -774,11 +921,34 @@ class ModelRunner:
         return sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in leaves)
 
 
+def _sample_one_ex(vocab_size: int, n_logprobs: int, logits, hist, sampling, step):
+    """Single-position sampling with optional penalties + logprob report
+    (the prefill-first-token path of the decode loop's extras). `hist`
+    here is the PROMPT only — nothing has been generated yet, so the
+    output-only frequency/presence counts are zero and only repetition
+    (prompt+generated semantics) can bite."""
+    from dynamo_tpu.engine.sampling import apply_penalties, top_logprobs
+
+    raw = logits[None, :]
+    l = raw
+    if hist is not None:
+        counts = jnp.zeros((1, vocab_size), jnp.float32).at[0, hist].add(
+            1.0, mode="drop"
+        )
+        l = apply_penalties(raw, counts, jnp.zeros_like(counts), sampling)
+    s = sample(l, sampling, step)
+    if n_logprobs >= 0:
+        return (s,) + top_logprobs(raw, s, n_logprobs)
+    return (s,)
+
+
 def _as_sampling(s) -> SamplingParams:
     if isinstance(s, SamplingParams):
         return s
     return SamplingParams.make(
-        temperature=s["temperature"], top_k=s["top_k"], top_p=s["top_p"], seeds=s["seeds"]
+        temperature=s["temperature"], top_k=s["top_k"], top_p=s["top_p"],
+        seeds=s["seeds"], rep_penalty=s.get("rep"),
+        freq_penalty=s.get("freq"), presence_penalty=s.get("presence"),
     )
 
 
@@ -792,4 +962,7 @@ def _pad_sampling(s: SamplingParams, B: int) -> SamplingParams:
         top_k=jnp.pad(s.top_k, (0, pad)),
         top_p=jnp.pad(s.top_p, (0, pad), constant_values=1.0),
         key=jnp.pad(s.key, ((0, pad), (0, 0))),
+        rep_penalty=jnp.pad(s.rep_penalty, (0, pad), constant_values=1.0),
+        freq_penalty=jnp.pad(s.freq_penalty, (0, pad)),
+        presence_penalty=jnp.pad(s.presence_penalty, (0, pad)),
     )

@@ -19,9 +19,16 @@ class SamplingParams(NamedTuple):
     top_k: jax.Array  # i32; 0 → disabled
     top_p: jax.Array  # f32; 1.0 → disabled
     key: jax.Array  # [B, 2] u32 PRNG keys
+    rep_penalty: jax.Array  # f32; 1.0 → disabled (HF-style multiplicative)
+    freq_penalty: jax.Array  # f32; 0.0 → disabled (count-scaled subtract)
+    presence_penalty: jax.Array  # f32; 0.0 → disabled (flat subtract)
 
     @classmethod
-    def make(cls, temperature, top_k, top_p, seeds) -> "SamplingParams":
+    def make(
+        cls, temperature, top_k, top_p, seeds,
+        rep_penalty=None, freq_penalty=None, presence_penalty=None,
+    ) -> "SamplingParams":
+        n = len(temperature)
         return cls(
             temperature=jnp.asarray(temperature, jnp.float32),
             top_k=jnp.asarray(top_k, jnp.int32),
@@ -29,7 +36,47 @@ class SamplingParams(NamedTuple):
             key=jax.vmap(lambda s: jax.random.key_data(jax.random.PRNGKey(s)))(
                 jnp.asarray(seeds, jnp.uint32)
             ),
+            rep_penalty=jnp.asarray(
+                [1.0] * n if rep_penalty is None else rep_penalty, jnp.float32
+            ),
+            freq_penalty=jnp.asarray(
+                [0.0] * n if freq_penalty is None else freq_penalty, jnp.float32
+            ),
+            presence_penalty=jnp.asarray(
+                [0.0] * n if presence_penalty is None else presence_penalty,
+                jnp.float32,
+            ),
         )
+
+
+def apply_penalties(
+    logits: jax.Array,
+    counts_all: jax.Array,
+    counts_out: jax.Array,
+    params: SamplingParams,
+) -> jax.Array:
+    """Repetition / frequency / presence penalties over raw logits
+    (reference sampling mapping, lib/llm/src/protocols/openai/).
+
+    Two count tables [B, V] f32, matching the de-facto split (HF vs
+    OpenAI/vLLM semantics):
+    - `counts_all` (prompt + generated) drives HF-style repetition: seen
+      tokens' positive logits are divided by the penalty, negative
+      multiplied — pushes uniformly away from any reuse;
+    - `counts_out` (GENERATED ONLY) drives the OpenAI pair: frequency
+      subtracts penalty * count, presence subtracts the penalty once for
+      any generated token. Prompt content must not pre-penalize the first
+      generated token.
+    All-default params make this an exact no-op, so one compiled path
+    serves penalized and unpenalized batches."""
+    seen_all = counts_all > 0.0
+    rp = params.rep_penalty[:, None]
+    logits = jnp.where(
+        seen_all, jnp.where(logits > 0, logits / rp, logits * rp), logits
+    )
+    logits = logits - params.freq_penalty[:, None] * counts_out
+    logits = logits - params.presence_penalty[:, None] * (counts_out > 0.0)
+    return logits
 
 
 # Sampling truncates to the top MAX_CANDIDATES logits first (one lax.top_k,
@@ -71,6 +118,20 @@ def filtered_probs(logits: jax.Array, params: SamplingParams):
     greedy = jnp.zeros_like(probs).at[:, 0].set(1.0)
     probs = jnp.where((params.temperature <= 0.0)[:, None], greedy, probs)
     return idx, probs
+
+
+def top_logprobs(logits: jax.Array, sampled: jax.Array, k: int):
+    """Logprob report for the OpenAI `logprobs` surface, computed from the
+    RAW model distribution (pre temperature/top-k/top-p — what clients use
+    logprobs for: inspecting the model, not the sampler). Returns
+    (tok_lp [B], top_ids [B, k], top_lps [B, k]); k=0 → empty top arrays."""
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    tok_lp = jnp.take_along_axis(lp, sampled[:, None], axis=1)[:, 0]
+    if k <= 0:
+        B = logits.shape[0]
+        return tok_lp, jnp.zeros((B, 0), jnp.int32), jnp.zeros((B, 0), jnp.float32)
+    vals, ids = jax.lax.top_k(lp, k)
+    return tok_lp, ids.astype(jnp.int32), vals
 
 
 def sample(logits: jax.Array, params: SamplingParams, step: jax.Array) -> jax.Array:

@@ -186,11 +186,17 @@ def spec_rounds(
             attn_impl=verify_impl, mesh=mesh, lora=lora, adapter_idx=adapter_idx,
         )  # [B, g+1, V]
         V = logits.shape[-1]
+        # penalties are not applied on the spec-decode path (the verify
+        # distribution must match the draft's, and both see raw logits);
+        # the fields still repeat so the NamedTuple stays well-formed
         rep = SamplingParams(
             temperature=jnp.repeat(sampling.temperature, gamma + 1),
             top_k=jnp.repeat(sampling.top_k, gamma + 1),
             top_p=jnp.repeat(sampling.top_p, gamma + 1),
             key=jnp.repeat(sampling.key, gamma + 1, axis=0),
+            rep_penalty=jnp.repeat(sampling.rep_penalty, gamma + 1),
+            freq_penalty=jnp.repeat(sampling.freq_penalty, gamma + 1),
+            presence_penalty=jnp.repeat(sampling.presence_penalty, gamma + 1),
         )
         t_idx, t_probs = filtered_probs(logits.reshape(B * (gamma + 1), V), rep)
         K = t_idx.shape[-1]

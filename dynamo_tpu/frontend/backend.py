@@ -82,6 +82,11 @@ class BackendOperator:
             finish = item.get("finish_reason")
 
             token_stop, emit_ids = checker.check_tokens(token_ids)
+            if item.get("logprobs") and len(emit_ids) != len(token_ids):
+                # keep the logprob report aligned with the tokens that
+                # actually reach the client (stop/length may truncate)
+                item = dict(item)
+                item["logprobs"] = item["logprobs"][: len(emit_ids)]
             delta = detok.push(emit_ids)
             pending += delta
 

@@ -590,6 +590,13 @@ class HttpService:
                     {"content": content} if content else {}, finish_reason,
                 ))
 
+        # logprob entries from items whose chunk wasn't sent yet (empty
+        # text deltas: partial stop-string holds, partial UTF-8) ride on
+        # the next sent chunk — dropping them would leave the streamed
+        # report missing tokens vs the unary response
+        lp_hold_ids: list = []
+        lp_hold: list = []
+        sent_text_len = 0
         try:
             if kind == "chat":
                 await send(_chat_chunk(rid, model, created, {"role": "assistant"}, None))
@@ -600,6 +607,9 @@ class HttpService:
                     timing.on_tokens(len(item.get("token_ids") or []))
                     if finish:
                         timing.finish_reason = finish
+                if item.get("logprobs"):
+                    lp_hold_ids.extend(item.get("token_ids") or [])
+                    lp_hold.extend(item["logprobs"])
                 if buffer_tools:
                     buffered.append(text)
                     if finish:
@@ -607,19 +617,31 @@ class HttpService:
                         break
                     continue
                 if text or finish:
+                    chunk_lp = None
+                    if lp_hold:
+                        chunk_lp = _format_logprobs(
+                            entry.preprocessor.tokenizer, kind,
+                            lp_hold_ids, lp_hold, offset0=sent_text_len,
+                        )
+                        lp_hold_ids, lp_hold = [], []
+                    sent_text_len += len(text)
                     if kind == "chat":
                         delta = {"content": text} if text else {}
-                        await send(_chat_chunk(rid, model, created, delta, finish))
+                        chunk = _chat_chunk(rid, model, created, delta, finish)
+                        if chunk_lp is not None:
+                            chunk["choices"][0]["logprobs"] = chunk_lp
+                        await send(chunk)
                     else:
+                        choice = {"index": 0, "text": text, "finish_reason": finish}
+                        if chunk_lp is not None:
+                            choice["logprobs"] = chunk_lp
                         await send(
                             {
                                 "id": rid,
                                 "object": obj,
                                 "created": created,
                                 "model": model,
-                                "choices": [
-                                    {"index": 0, "text": text, "finish_reason": finish}
-                                ],
+                                "choices": [choice],
                             }
                         )
                 if finish:
@@ -655,10 +677,15 @@ class HttpService:
         finish = None
         n_prompt = len(preprocessed["token_ids"])
         n_out = 0
+        lp_tokens: list = []  # token ids with logprob entries (aligned)
+        lp_entries: list = []
         try:
             async for item in entry.chain.generate(preprocessed, ctx):
                 text_parts.append(item.get("text", ""))
                 n_out += len(item.get("token_ids") or [])
+                if item.get("logprobs"):
+                    lp_tokens.extend(item.get("token_ids") or [])
+                    lp_entries.extend(item["logprobs"])
                 if timing is not None:
                     timing.on_tokens(len(item.get("token_ids") or []))
                 if item.get("finish_reason"):
@@ -726,6 +753,10 @@ class HttpService:
                 "choices": [{"index": 0, "text": text, "finish_reason": finish or "stop"}],
                 "usage": usage,
             }
+        if lp_entries:
+            body["choices"][0]["logprobs"] = _format_logprobs(
+                entry.preprocessor.tokenizer, kind, lp_tokens, lp_entries
+            )
         return web.json_response(body)
 
 
@@ -783,6 +814,57 @@ def _response_body(
         "output": output,
         "usage": {"input_tokens": n_in, "output_tokens": n_out,
                   "total_tokens": n_in + n_out},
+    }
+
+
+def _format_logprobs(
+    tokenizer, kind: str, token_ids, entries, offset0: int = 0
+) -> Dict[str, Any]:
+    """Engine logprob records → the OpenAI response shape: completions use
+    the parallel-arrays form, chat uses per-token content entries (ref
+    lib/llm/src/protocols/openai/ logprobs types). `entries` align 1:1
+    with `token_ids` (the Backend operator maintains that invariant).
+    `offset0` seeds text_offset for streaming chunks, which must accumulate
+    across the whole completion."""
+
+    def tok_str(tid: int) -> str:
+        try:
+            return tokenizer.decode([tid])
+        except Exception:
+            return f"<{tid}>"
+
+    if kind == "chat":
+        content = []
+        for tid, e in zip(token_ids, entries):
+            content.append({
+                "token": tok_str(tid),
+                "logprob": e["logprob"],
+                "bytes": None,
+                "top_logprobs": [
+                    {"token": tok_str(i), "logprob": v, "bytes": None}
+                    for i, v in zip(e["top_ids"], e["top_logprobs"])
+                ],
+            })
+        return {"content": content}
+    offset = offset0
+    tokens, token_logprobs, top_logprobs, text_offset = [], [], [], []
+    for tid, e in zip(token_ids, entries):
+        s = tok_str(tid)
+        tokens.append(s)
+        token_logprobs.append(e["logprob"])
+        top: Dict[str, float] = {}
+        for i, v in zip(e["top_ids"], e["top_logprobs"]):
+            # first (highest) value wins when distinct ids decode to the
+            # same string (byte-level tokenizers → U+FFFD collisions)
+            top.setdefault(tok_str(i), v)
+        top_logprobs.append(top)
+        text_offset.append(offset)
+        offset += len(s)
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_logprobs,
+        "top_logprobs": top_logprobs,
+        "text_offset": text_offset,
     }
 
 

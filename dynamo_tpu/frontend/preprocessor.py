@@ -62,6 +62,20 @@ class Preprocessor:
 
     # -- request mapping ---------------------------------------------------
     def _sampling(self, req: Dict[str, Any]) -> SamplingOptions:
+        # logprobs: completions uses `logprobs: <int top-N>`; chat uses
+        # `logprobs: true` + optional `top_logprobs: <int>` (OpenAI
+        # protocol split, ref lib/llm/src/protocols/openai/)
+        lp = req.get("logprobs")
+        if lp is True:
+            lp = int(req.get("top_logprobs") or 0)
+        elif lp is False:
+            lp = None
+        elif lp is not None:
+            lp = int(lp)
+        if lp is not None:
+            # OpenAI caps top_logprobs at 20; the cap also bounds the
+            # compiled report-width variants (jit-static) a client can force
+            lp = max(0, min(lp, 20))
         return SamplingOptions(
             temperature=req.get("temperature", 1.0) or 0.0,
             top_p=req.get("top_p", 1.0) or 1.0,
@@ -69,6 +83,8 @@ class Preprocessor:
             seed=req.get("seed"),
             frequency_penalty=req.get("frequency_penalty", 0.0) or 0.0,
             presence_penalty=req.get("presence_penalty", 0.0) or 0.0,
+            repetition_penalty=req.get("repetition_penalty", 1.0) or 1.0,
+            logprobs=lp,
         )
 
     def _stop(self, req: Dict[str, Any], prompt_len: int) -> StopConditions:

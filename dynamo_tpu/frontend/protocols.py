@@ -46,6 +46,8 @@ class SamplingOptions:
     seed: Optional[int] = None
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    repetition_penalty: float = 1.0  # HF-style multiplicative; 1.0 = off
+    logprobs: Optional[int] = None  # top-N logprob report (None = off)
 
 
 @dataclass
