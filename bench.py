@@ -83,6 +83,24 @@ def init_backend(metric_name: str) -> None:
                 except Exception:
                     pass
 
+            # persistent compilation cache: repeat bench invocations skip
+            # the 20-40s first-compile on the tunnel (worker.py fast-resume
+            # uses the same knobs)
+            try:
+                from dynamo_tpu import enable_compilation_cache
+
+                enable_compilation_cache(
+                    os.environ.get(
+                        "JAX_COMPILATION_CACHE_DIR",
+                        os.path.expanduser("~/.cache/dynamo_tpu_xla"),
+                    )
+                )
+            except Exception as e:
+                # an optimization, never a bench blocker — but say so, or
+                # a 20-40s-per-compile regression has no explanation
+                print(f"# compilation cache not enabled: {e}",
+                      file=sys.stderr, flush=True)
+
             for i, pause in enumerate((0.0,) + _init_backoff()):
                 if pause:
                     print(

@@ -50,3 +50,27 @@ def ensure_platform() -> None:
             jax.config.update("jax_platforms", want)
         except Exception:
             pass
+
+
+def enable_compilation_cache(path=None):
+    """Turn on JAX's persistent compilation cache (SURVEY.md §5.4 — fast
+    replica spin-up; the compiled-program half of fast restart, next to
+    the orbax weight snapshot). `path` falls back to
+    JAX_COMPILATION_CACHE_DIR; returns the directory in effect (None =
+    disabled). Zero thresholds so even small step programs are cached — a
+    restarted worker's first request must not recompile ANY bucket it
+    already served. Lives here (beside ensure_platform) because it is
+    env-sensitive jax config every process entrypoint may need — the
+    worker and bench.py both call it."""
+    import os
+
+    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not path:
+        return None
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path
