@@ -36,6 +36,28 @@ class SimTiming:
         if self.speed > 0:
             time.sleep(seconds * self.speed)
 
+
+    @classmethod
+    def from_profile(cls, profile, speed: float = 1.0,
+                     variant=None) -> "SimTiming":
+        """Calibrate from a HARDWARE profile artifact (planner/
+        hw_profile.py) — the measured counterpart of fit(): mockers then
+        simulate the chip that was actually profiled, not guessed
+        constants."""
+        from dynamo_tpu.planner.hw_profile import load_profile, profile_fit
+
+        if isinstance(profile, str):
+            profile = load_profile(profile)
+        f = profile_fit(profile, variant)
+        return cls(
+            prefill_base_s=f["prefill_base_s"],
+            prefill_per_token_s=f["prefill_per_token_s"],
+            decode_base_s=f["decode_base_s"],
+            decode_per_seq_s=f["decode_per_seq_s"],
+            dispatch_overhead_s=0.0,  # measured per-step times include it
+            speed=speed,
+        )
+
     @classmethod
     def fit(cls, fpm_history, decode_steps: int = 1, speed: float = 1.0) -> "SimTiming":
         """Fit the linear step-time model to observed ForwardPassMetrics
@@ -47,10 +69,10 @@ class SimTiming:
             return getattr(m, k, None) if not isinstance(m, dict) else m.get(k)
 
         def lstsq(xs, ys, d0, s0):
-            if len(xs) < 2 or len(set(xs)) < 2:
-                return d0, s0
-            slope, intercept = np.polyfit(np.asarray(xs, float), np.asarray(ys, float), 1)
-            return max(float(intercept), 0.0), max(float(slope), 0.0)
+            # shared fitting routine with the hardware profiler
+            from dynamo_tpu.planner.hw_profile import fit_line
+
+            return fit_line(zip(xs, ys), d0, s0)
 
         dec = [(get(m, "n_running"), get(m, "wall_time_s"))
                for m in fpm_history if get(m, "kind") == "decode"]

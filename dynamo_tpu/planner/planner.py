@@ -49,6 +49,11 @@ class PlannerConfig:
     min_replicas: int = 1
     max_replicas: int = 8
     components: tuple = ("decode",)  # scale decode (and "prefill" if disagg)
+    # hardware profile artifact (planner/hw_profile.py): its measured
+    # per-replica decode capacity floors the throughput-mode capacity
+    # estimate — observed rates under LOW demand badly underestimate what
+    # a replica can actually do, which otherwise over-scales on cold start
+    hw_profile: Optional[str] = None
 
 
 class Planner:
@@ -148,9 +153,30 @@ class Planner:
         demand = sum(l.decode_tok_s + l.prefill_tok_s for l in loads)
         self._predictors[comp].observe(demand)
         predicted = self._predictors[comp].predict()
-        # per-replica capacity: best observed rate, bounded away from 0
+        # per-replica capacity: best observed rate (a lower bound on true
+        # capacity), floored by the hardware profile's measured ceiling
         per_replica = max(
-            1e-6, max(l.decode_tok_s + l.prefill_tok_s for l in loads)
+            1e-6, max(l.decode_tok_s + l.prefill_tok_s for l in loads),
+            self._profile_capacity(comp),
         )
         needed = predicted * cfg.headroom / per_replica
         return max(1, round(needed))
+
+    def _profile_capacity(self, comp: str) -> float:
+        """Measured per-replica capacity from the hardware profile
+        artifact, per component (prefill workers are floored by prefill
+        throughput, decode by decode); 0.0 when none configured."""
+        if self.config.hw_profile is None:
+            return 0.0
+        if not hasattr(self, "_profile_fit"):
+            from dynamo_tpu.planner.hw_profile import load_profile, profile_fit
+
+            try:
+                self._profile_fit = profile_fit(load_profile(self.config.hw_profile))
+            except Exception:
+                log.warning("hw profile %s unusable; ignoring",
+                            self.config.hw_profile, exc_info=True)
+                self._profile_fit = {}
+        key = ("prefill_capacity_tok_s" if "prefill" in comp
+               else "decode_capacity_tok_s")
+        return float(self._profile_fit.get(key, 0.0))

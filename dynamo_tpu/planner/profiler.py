@@ -64,6 +64,28 @@ class TpuPerfModel:
             speed=speed,
         )
 
+    @classmethod
+    def from_profile(cls, profile, variant: str = None, **overrides) -> "TpuPerfModel":
+        """Baselines MEASURED on hardware (planner/hw_profile.py artifact
+        or its path) instead of the sim's guessed constants — the de-
+        circularized path: engine → profile → perf model → capacity."""
+        from dynamo_tpu.planner.hw_profile import load_profile, profile_fit
+
+        if isinstance(profile, str):
+            profile = load_profile(profile)
+        fit = profile_fit(profile, variant)
+        # the measured wall-clock per dispatch already contains the host
+        # dispatch overhead (folded into the fitted intercepts) — adding
+        # the default 2ms again would double-count it
+        overrides.setdefault("dispatch_overhead_s", 0.0)
+        return cls(
+            decode_base_s=fit["decode_base_s"],
+            decode_per_seq_s=fit["decode_per_seq_s"],
+            prefill_base_s=fit["prefill_base_s"],
+            prefill_per_token_s=fit["prefill_per_token_s"],
+            **overrides,
+        )
+
 
 @dataclass
 class ConfigResult:
@@ -142,10 +164,15 @@ async def _evaluate_config(
 
 
 async def sweep(args) -> dict:
-    perf = TpuPerfModel(
-        decode_base_s=args.decode_base_ms / 1000.0,
-        tp_efficiency=args.tp_efficiency,
-    )
+    if getattr(args, "hw_profile", None):
+        perf = TpuPerfModel.from_profile(
+            args.hw_profile, tp_efficiency=args.tp_efficiency
+        )
+    else:
+        perf = TpuPerfModel(
+            decode_base_s=args.decode_base_ms / 1000.0,
+            tp_efficiency=args.tp_efficiency,
+        )
     if args.trace:
         trace = load_trace(args.trace)
     else:
@@ -195,6 +222,9 @@ def parse_args(argv=None):
     p.add_argument("--osl", type=int, default=64)
     p.add_argument("--prefix-groups", type=int, default=0)
     p.add_argument("--decode-base-ms", type=float, default=4.0)
+    p.add_argument("--hw-profile", default=None,
+                   help="hardware profile artifact (planner/hw_profile.py) "
+                        "to base step times on instead of the defaults")
     p.add_argument("--tp-efficiency", type=float, default=0.85)
     p.add_argument("--speed", type=float, default=1.0,
                    help="sim clock compression (<1 runs the sweep faster)")
