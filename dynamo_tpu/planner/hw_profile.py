@@ -104,18 +104,23 @@ def run_hw_sweep(
                 "temperature": [0.0] * n, "top_k": [0] * n,
                 "top_p": [1.0] * n, "seeds": [0] * n,
             }
-            pages_per_seq = max_pages_per_seq
-
             decode_pts: List[List[float]] = []
+            # each sequence decodes decode_steps tokens starting at
+            # position 4 — size its page-table row to cover every KV slot
+            # it will touch; ids past num_pages would be silently clamped
+            # by XLA and the timing would measure aliased nonsense
+            pos0 = 4
+            seq_pages = -(-(pos0 + decode_steps + 1) // page_size)
+            seq_pages = min(seq_pages, max_pages_per_seq)
             for B in batches:
-                if B * 4 > num_pages:
-                    break
-                # 4 distinct pool pages per sequence — ids must stay inside
-                # num_pages or XLA silently clamps/drops the OOB addressing
-                # and the timing measures aliased nonsense
-                tables = [list(range(i * 4, i * 4 + 4)) for i in range(B)]
+                if B * seq_pages > num_pages:
+                    continue  # inputs may be unsorted; later Bs might fit
+                tables = [
+                    list(range(i * seq_pages, (i + 1) * seq_pages))
+                    for i in range(B)
+                ]
                 args = (
-                    decode_steps, [1] * B, [4] * B, tables, sampling(B), 1,
+                    decode_steps, [1] * B, [pos0] * B, tables, sampling(B), 1,
                 )
                 ts = []
                 for it in range(warmup + iters):
@@ -129,10 +134,11 @@ def run_hw_sweep(
 
             prefill_pts: List[List[float]] = []
             for chunk in prefill_chunks:
-                if chunk > max_seq_len:
-                    break
-                row = list(range(pages_per_seq))
-                toks = list(range(1, chunk + 1))
+                chunk_pages = -(-chunk // page_size)
+                if chunk > max_seq_len or chunk_pages > min(num_pages, max_pages_per_seq):
+                    continue
+                row = list(range(chunk_pages))
+                toks = [i % config.vocab_size for i in range(chunk)]
                 ts = []
                 for it in range(warmup + iters):
                     t0 = time.perf_counter()
