@@ -85,6 +85,23 @@ def worker_deployment(args, component: str, replicas: int, disagg_role: Optional
     }
 
 
+def mocker_deployment(args, component: str, replicas: int) -> Dict[str, Any]:
+    """Simulated workers (no TPU nodeSelector/resources): cluster smoke
+    tests and router/planner soak without accelerators."""
+    dep = worker_deployment(args, component, replicas, None)
+    pod = dep["spec"]["template"]["spec"]
+    pod.pop("nodeSelector", None)
+    c = pod["containers"][0]
+    c.pop("resources", None)
+    c.pop("ports", None)  # mocker runs no status server
+    c["command"] = [
+        "python", "-m", "dynamo_tpu.mocker",
+        "--model-name", args.model,
+        "--discovery-backend", "etcd",
+    ]
+    return dep
+
+
 def frontend_objects(args) -> List[Dict[str, Any]]:
     name = f"{args.graph}-frontend"
     labels = _labels("frontend", args.graph)

@@ -1,0 +1,104 @@
+"""Model fetch: resolve a checkpoint reference to a local directory.
+
+Analog of the reference's model-hub path (lib/llm/src/hub.rs:728
+`fetch_model`: HF-Hub + ModelExpress download before engine boot). Every
+entrypoint that takes --checkpoint accepts:
+
+- a local directory (returned as-is),
+- `hf://org/name` or a bare `org/name` repo id → downloaded into the
+  model cache via huggingface_hub (safetensors + config + tokenizer only
+  — no torch .bin duplicates),
+
+with DYN_MODEL_CACHE (default ~/.cache/dynamo_tpu/models) as the cache
+root. Offline clusters keep working: a previously-downloaded snapshot is
+served from cache (HF_HUB_OFFLINE=1 semantics), and a cache miss with no
+egress fails with an actionable error instead of a hang.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Optional
+
+log = logging.getLogger("dynamo_tpu.hub")
+
+_REPO_ID = re.compile(r"^[\w.-]+/[\w.-]+$")
+
+# weights + metadata the engine loader reads; excludes .bin/.pt duplicates
+ALLOW_PATTERNS = [
+    "*.safetensors", "*.safetensors.index.json", "config.json",
+    "generation_config.json", "tokenizer.json", "tokenizer_config.json",
+    "special_tokens_map.json", "*.model",
+]
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "DYN_MODEL_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "dynamo_tpu", "models"),
+    )
+
+
+def is_repo_id(source: str) -> bool:
+    """True for `hf://org/name` or a bare `org/name` that is not a local
+    path (an existing directory always wins — never surprise-download
+    when the user pointed at files on disk)."""
+    if source.startswith("hf://"):
+        return True
+    return bool(_REPO_ID.match(source)) and not os.path.isdir(source)
+
+
+def fetch_model(
+    source: str, cache_dir: Optional[str] = None, config_only: bool = False
+) -> str:
+    """Resolve `source` to a local checkpoint dir, downloading from the
+    HF Hub when it names a repo id. `config_only` fetches just the
+    metadata files (a warm-snapshot restart derives the model config from
+    config.json but loads weights from the orbax snapshot — multi-GB
+    safetensors must not be re-pulled for that). Raises FileNotFoundError
+    for a missing local path and RuntimeError with remediation steps when
+    the hub is unreachable and nothing is cached."""
+    if os.path.isdir(source):
+        return source
+    if not is_repo_id(source):
+        raise FileNotFoundError(
+            f"checkpoint {source!r} is neither a local directory nor an "
+            "HF repo id (org/name or hf://org/name)"
+        )
+    repo = source[5:] if source.startswith("hf://") else source
+    cache = cache_dir or default_cache_dir()
+    os.makedirs(cache, exist_ok=True)
+    patterns = (
+        [p for p in ALLOW_PATTERNS if "safetensors" not in p]
+        if config_only else ALLOW_PATTERNS
+    )
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            f"checkpoint {source!r} needs huggingface_hub to download; "
+            "install it or pre-stage the files and pass the local dir"
+        ) from e
+    try:
+        path = snapshot_download(
+            repo_id=repo, cache_dir=cache, allow_patterns=patterns
+        )
+    except Exception:
+        # no egress / auth failure: one more chance from local cache only
+        try:
+            path = snapshot_download(
+                repo_id=repo, cache_dir=cache,
+                allow_patterns=patterns, local_files_only=True,
+            )
+            log.info("hub unreachable; serving %s from cache", repo)
+        except Exception as e:
+            raise RuntimeError(
+                f"cannot fetch {repo!r}: hub unreachable and not cached "
+                f"under {cache}. Pre-stage with `huggingface-cli download "
+                f"{repo}` on a connected host, or pass a local checkpoint "
+                "dir."
+            ) from e
+    log.info("checkpoint %s resolved to %s", source, path)
+    return path

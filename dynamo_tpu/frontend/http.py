@@ -104,6 +104,21 @@ class HttpService:
             ]
         )
 
+    def inflight_inc(self, model: str) -> None:
+        """Single place that tracks in-flight load: the busy-threshold
+        shed counter AND the dynamo_frontend_in_flight gauge (dashboards)
+        move together — every entrypoint (HTTP, realtime WS) uses this."""
+        self._in_flight[model] = self._in_flight.get(model, 0) + 1
+        self.runtime.metrics.gauge(
+            "frontend_in_flight", "in-flight requests", model=model
+        ).inc()
+
+    def inflight_dec(self, model: str) -> None:
+        self._in_flight[model] = max(0, self._in_flight.get(model, 1) - 1)
+        self.runtime.metrics.gauge(
+            "frontend_in_flight", "in-flight requests", model=model
+        ).dec()
+
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> str:
         await self.watcher.start()
@@ -533,7 +548,8 @@ class HttpService:
         from dynamo_tpu.frontend.request_trace import RequestTiming
 
         timing = RequestTiming(ctx.id, model, kind, len(preprocessed["token_ids"]))
-        self._in_flight[model] = self._in_flight.get(model, 0) + 1
+        self.inflight_inc(model)
+        m = self.runtime.metrics
         try:
             if stream:
                 return await self._stream_response(
@@ -543,9 +559,29 @@ class HttpService:
                 entry, preprocessed, ctx, rid, model, created, kind, timing
             )
         finally:
-            self._in_flight[model] = max(0, self._in_flight.get(model, 1) - 1)
+            self.inflight_dec(model)
             if self.tracer.enabled:
                 self.tracer.record(**timing.fields(stream=stream))
+            # Prometheus request metrics (reference frontend_perf metrics,
+            # lib/runtime/src/metrics/) — what the shipped Grafana
+            # dashboards (deploy/observability/) query
+            f = timing.fields()
+            m.counter(
+                "frontend_requests_total", "completed requests",
+                model=model, finish=str(f["finish_reason"] or "none"),
+            ).inc()
+            m.counter(
+                "frontend_output_tokens_total", "generated tokens", model=model,
+            ).inc(max(0, f["osl"]))
+            m.histogram(
+                "frontend_request_duration_seconds", "request wall time",
+                model=model,
+            ).observe(f["total_s"])
+            if f["ttft_s"] is not None:
+                m.histogram(
+                    "frontend_ttft_seconds", "time to first token",
+                    model=model,
+                ).observe(f["ttft_s"])
 
     async def _stream_response(
         self, request, entry, preprocessed, ctx, rid, model, created, kind, timing=None

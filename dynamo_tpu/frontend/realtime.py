@@ -85,13 +85,16 @@ async def handle_realtime(service, request: web.Request) -> web.WebSocketRespons
                 "id": rid, "status": "failed",
                 "error": {"message": "server busy", "type": "server_busy"}}))
             return
-        service._in_flight[model] = service._in_flight.get(model, 0) + 1
-        await ws.send_str(_event("response.created", response={"id": rid}))
+        # inc strictly inside the try whose finally decs — a send failure
+        # (client already gone) must not leak the in-flight charge, or the
+        # busy threshold ratchets shut one disconnect at a time
         parts: List[str] = []
         status = "completed"
         timing = None
         cancelled = False
+        service.inflight_inc(model)
         try:
+            await ws.send_str(_event("response.created", response={"id": rid}))
             from dynamo_tpu.frontend.request_trace import RequestTiming
 
             preprocessed = entry.preprocessor.preprocess_chat(
@@ -125,7 +128,7 @@ async def handle_realtime(service, request: web.Request) -> web.WebSocketRespons
             ctx.stop_generating()
             state.pop("ctx", None)
             state.pop("task", None)
-            service._in_flight[model] = max(0, service._in_flight.get(model, 1) - 1)
+            service.inflight_dec(model)
             if timing is not None and service.tracer.enabled:
                 timing.finish_reason = timing.finish_reason or status
                 service.tracer.record(**timing.fields(stream=True))

@@ -45,7 +45,7 @@ import time
 from types import SimpleNamespace
 from typing import Any, Dict, List, Optional
 
-from dynamo_tpu.deploy import frontend_objects, worker_deployment
+from dynamo_tpu.deploy import frontend_objects, mocker_deployment, worker_deployment
 from dynamo_tpu.runtime.kube_client import KubeApiClient
 
 log = logging.getLogger("dynamo_tpu.operator")
@@ -130,6 +130,8 @@ def render_children(dgd: Dict[str, Any]) -> List[Dict[str, Any]]:
         elif ctype in ("worker", "prefill", "decode"):
             role = None if ctype == "worker" else ctype
             objs = [worker_deployment(args, name, args.workers, role)]
+        elif ctype == "mocker":
+            objs = [mocker_deployment(args, name, args.workers)]
         else:  # planner/epp-style components: not templated yet, skip
             log.warning("component %s has untemplated type %s; skipping", name, ctype)
             continue

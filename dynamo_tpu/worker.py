@@ -198,8 +198,14 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
         and os.listdir(args.orbax_cache)
     )
     if args.checkpoint:
+        from dynamo_tpu.engine.hub import fetch_model
         from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
 
+        # --checkpoint accepts hub repo ids too (hf://org/name or
+        # org/name); local dirs pass through untouched (hub.rs role). A
+        # warm snapshot restart only needs config.json — never re-pull
+        # multi-GB weights the orbax snapshot already holds
+        args.checkpoint = fetch_model(args.checkpoint, config_only=snapshot_warm)
         config = config_from_hf(args.checkpoint, name=args.model_name or args.model)
         if not snapshot_warm:
             params = load_hf_checkpoint(args.checkpoint, config)

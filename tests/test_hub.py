@@ -1,0 +1,68 @@
+"""Model-hub fetch tests (reference lib/llm/src/hub.rs:728 fetch_model):
+local dirs pass through, repo ids resolve through huggingface_hub into the
+model cache, offline falls back to cache then fails actionably."""
+
+import os
+
+import pytest
+
+from dynamo_tpu.engine import hub
+
+
+def test_local_dir_passthrough(tmp_path):
+    assert hub.fetch_model(str(tmp_path)) == str(tmp_path)
+
+
+def test_is_repo_id():
+    assert hub.is_repo_id("hf://meta-llama/Llama-3.2-3B")
+    assert hub.is_repo_id("meta-llama/Llama-3.2-3B")
+    assert not hub.is_repo_id("/abs/path/to/ckpt")
+    assert not hub.is_repo_id("tiny")
+
+
+def test_missing_local_path_is_actionable():
+    with pytest.raises(FileNotFoundError, match="neither a local directory"):
+        hub.fetch_model("/nonexistent/ckpt/dir")
+
+
+def test_repo_id_downloads_into_cache(tmp_path, monkeypatch):
+    calls = []
+
+    def fake_snapshot_download(repo_id, cache_dir, allow_patterns, **kw):
+        calls.append({"repo": repo_id, "cache": cache_dir,
+                      "patterns": allow_patterns, **kw})
+        d = tmp_path / "snap"
+        d.mkdir(exist_ok=True)
+        return str(d)
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", fake_snapshot_download)
+    out = hub.fetch_model("hf://org/model", cache_dir=str(tmp_path / "cache"))
+    assert out == str(tmp_path / "snap")
+    assert calls[0]["repo"] == "org/model"
+    assert "*.safetensors" in calls[0]["patterns"]
+    assert os.path.isdir(str(tmp_path / "cache"))
+
+
+def test_offline_serves_cache_then_fails_actionably(tmp_path, monkeypatch):
+    state = {"n": 0}
+
+    def flaky(repo_id, cache_dir, allow_patterns, local_files_only=False, **kw):
+        state["n"] += 1
+        if not local_files_only:
+            raise OSError("no egress")
+        if state.get("cached"):
+            return str(tmp_path / "cached")
+        raise OSError("not in cache")
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", flaky)
+
+    with pytest.raises(RuntimeError, match="hub unreachable and not cached"):
+        hub.fetch_model("org/model", cache_dir=str(tmp_path))
+
+    (tmp_path / "cached").mkdir()
+    state["cached"] = True
+    assert hub.fetch_model("org/model", cache_dir=str(tmp_path)) == str(tmp_path / "cached")
