@@ -84,6 +84,12 @@ class HttpService:
         self.busy_threshold = busy_threshold
         self.tracer = RequestTracer(trace_path)
         self._in_flight: Dict[str, int] = {}
+        # CPU-bound preprocessing (template render + tokenize) offloads to
+        # the compute pool for LARGE prompts so it never stalls the event
+        # loop that carries every other stream (runtime/compute.py)
+        from dynamo_tpu.runtime.compute import ComputePool
+
+        self.compute = ComputePool(metrics=runtime.metrics)
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
         self.app.add_routes(
@@ -137,6 +143,7 @@ class HttpService:
         await self.watcher.stop()
         if self._runner is not None:
             await self._runner.cleanup()
+        self.compute.close()
 
     # -- ops endpoints -----------------------------------------------------
     async def health(self, request: web.Request) -> web.Response:
@@ -525,13 +532,21 @@ class HttpService:
         if self.busy_threshold and self._in_flight.get(model, 0) >= self.busy_threshold:
             return _error(503, "server busy, retry later", "server_busy")
 
+        pre_fn = (
+            entry.preprocessor.preprocess_chat
+            if kind == "chat" else entry.preprocessor.preprocess_completions
+        )
         try:
-            if kind == "chat":
-                preprocessed = entry.preprocessor.preprocess_chat(body)
-            else:
-                preprocessed = entry.preprocessor.preprocess_completions(body)
+            preprocessed = await self.compute.run(
+                pre_fn, body, size_hint=_payload_chars(body)
+            )
         except ValueError as e:
             return _error(400, str(e), "invalid_request_error")
+        # re-check the shed threshold AFTER the (awaited) preprocessing
+        # offload: a burst of large prompts all passed the first check
+        # before any of them charged _in_flight
+        if self.busy_threshold and self._in_flight.get(model, 0) >= self.busy_threshold:
+            return _error(503, "server busy, retry later", "server_busy")
         if "priority" in body:
             # admission-queue class (0 = most urgent); router-level knob,
             # not part of the OpenAI schema, so it is opt-in per request
@@ -851,6 +866,32 @@ def _response_body(
         "usage": {"input_tokens": n_in, "output_tokens": n_out,
                   "total_tokens": n_in + n_out},
     }
+
+
+def resolve_bound_port(site) -> int:
+    """Ephemeral-port lookup for an aiohttp TCPSite (single point for the
+    private-attribute access; also used by router/dc_relay.py)."""
+    for sock in site._server.sockets:  # type: ignore[union-attr]
+        return sock.getsockname()[1]
+    raise RuntimeError("site has no bound sockets")
+
+
+def _payload_chars(body: Dict[str, Any]) -> int:
+    """Rough prompt size for the compute-offload decision (chars, not
+    tokens — close enough to pick inline vs pool)."""
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        return len(prompt)
+    if isinstance(prompt, list):
+        return len(prompt)
+    n = 0
+    for m in body.get("messages") or []:
+        c = m.get("content")
+        if isinstance(c, str):
+            n += len(c)
+        elif isinstance(c, list):
+            n += sum(len(str(p.get("text", ""))) for p in c if isinstance(p, dict))
+    return n
 
 
 def _format_logprobs(

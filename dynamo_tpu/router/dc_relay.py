@@ -1,0 +1,227 @@
+"""KV datacenter relay (analog of reference lib/llm/src/kv_dc_relay/ +
+components/src/dynamo/kv_dc_relay: aggregate a DC's KV-cache state behind
+one identity for cross-DC routing).
+
+Inside a DC, the KV router tracks per-worker block residency. ACROSS DCs
+that detail must not leak (the reference's "CKF identity boundary"): a
+remote global router only needs "how much of this prefix does the DC hold
+anywhere". The relay subscribes to the DC's kv_events, folds every
+worker's store/remove stream into one hash→refcount table, and serves a
+small HTTP surface:
+
+  POST /kv_overlap {"hashes": [...]}  -> {"overlap": N}  (leading run
+       of the chain resident on ANY worker in this DC)
+  GET  /stats                         -> {"blocks": ..., "events": ...}
+
+The global router (global_router.py pick_kv) queries each DC's relay and
+sends the request to the DC with the deepest prefix, tiebroken by load —
+making cross-DC routing KV-aware without shipping per-worker state over
+the WAN.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+log = logging.getLogger("dynamo_tpu.dc_relay")
+
+
+class DcKvAggregate:
+    """Worker-collapsed residency: hash → number of workers holding it.
+    Stores and removes arrive per worker over the event plane; the
+    refcount keeps a block "present" while ANY worker still holds it.
+    Per-worker block sets let a crashed worker's residency be dropped the
+    moment discovery reports it gone (it never published removes).
+
+    Loss model: the relay deliberately has NO event-id gap recovery (the
+    in-DC KvIndexer does, router/indexer.py). A dropped message skews the
+    aggregate until the affected worker departs — acceptable because
+    pick_kv only uses overlap as a preference and degrades to load-based
+    selection; precision stays the in-DC router's job."""
+
+    def __init__(self):
+        self.refcount: Dict[int, int] = {}
+        self.events = 0
+        self._worker_blocks: Dict[tuple, set] = {}
+
+    def apply(self, event: Dict) -> None:
+        self.events += 1
+        kind = event.get("kind")
+        worker = tuple(event.get("worker") or ())
+        held = self._worker_blocks.setdefault(worker, set())
+        for h in event.get("block_hashes") or []:
+            if kind == "store":
+                if h not in held:
+                    held.add(h)
+                    self.refcount[h] = self.refcount.get(h, 0) + 1
+            elif kind == "remove":
+                if h in held:
+                    held.discard(h)
+                    self._dec(h)
+
+    def _dec(self, h: int) -> None:
+        left = self.refcount.get(h, 0) - 1
+        if left > 0:
+            self.refcount[h] = left
+        else:
+            self.refcount.pop(h, None)
+
+    def drop_instance(self, instance_id: int) -> None:
+        """A worker left (crash or drain): its residency leaves with it —
+        without this, a dead DC keeps winning pick_kv on blocks it no
+        longer holds."""
+        for worker in [w for w in self._worker_blocks if w and w[0] == instance_id]:
+            for h in self._worker_blocks.pop(worker):
+                self._dec(h)
+
+    def overlap(self, hashes: List[int]) -> int:
+        n = 0
+        for h in hashes:
+            if self.refcount.get(h, 0) <= 0:
+                break
+            n += 1
+        return n
+
+    @property
+    def blocks(self) -> int:
+        return len(self.refcount)
+
+
+class KvDcRelay:
+    """Event-plane consumer + HTTP server. Worker publishers are wired the
+    same way the KV router wires them: a discovery watch connects each
+    worker's advertised publisher address."""
+
+    def __init__(self, runtime: DistributedRuntime, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        self.agg = DcKvAggregate()
+        self._sub = runtime.event_subscriber(["kv_events"])
+        self._tasks: List[asyncio.Task] = []
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.add_routes([
+            web.post("/kv_overlap", self._kv_overlap),
+            web.get("/stats", self._stats),
+        ])
+
+    async def start(self) -> str:
+        self._tasks.append(asyncio.create_task(self._event_loop()))
+        self._tasks.append(asyncio.create_task(self._discovery_loop()))
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        from dynamo_tpu.frontend.http import resolve_bound_port
+
+        self.port = resolve_bound_port(site)
+        log.info("kv dc relay on http://%s:%d", self.host, self.port)
+        return f"http://{self.host}:{self.port}"
+
+    async def _event_loop(self) -> None:
+        while True:
+            try:
+                async for subject, payload in self._sub.events():
+                    if subject != "kv_events":
+                        continue
+                    try:
+                        events = payload.get("events") or [payload]
+                        for ev in events:
+                            self.agg.apply(ev)
+                    except Exception:
+                        log.exception("bad kv event payload; skipping")
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                # the subscriber iterator died (transport hiccup): the
+                # relay must keep consuming, not freeze its aggregate
+                log.exception("dc relay event stream failed; reconnecting")
+                await asyncio.sleep(1.0)
+
+    async def _discovery_loop(self) -> None:
+        """Connect every worker's advertised event publisher (same wiring
+        as KvRouter._connect_worker); a departed worker's residency is
+        dropped with it. Watch errors retry — exiting permanently would
+        orphan every worker that registers afterwards."""
+        while True:
+            try:
+                async for ev in self.runtime.discovery.watch("services/"):
+                    addr = (ev.instance.metadata or {}).get("kv_publisher")
+                    if ev.kind == "put":
+                        if addr:
+                            self._sub.connect(addr)
+                    else:
+                        if addr:
+                            self._sub.disconnect(addr)
+                        self.agg.drop_instance(ev.instance.instance_id)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                log.exception("dc relay discovery watch failed; retrying")
+                await asyncio.sleep(1.0)
+
+    async def _kv_overlap(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            hashes = [int(h) for h in body["hashes"]]
+        except Exception:
+            return web.json_response({"error": "hashes required"}, status=400)
+        return web.json_response({"overlap": self.agg.overlap(hashes)})
+
+    async def _stats(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"blocks": self.agg.blocks, "events": self.agg.events}
+        )
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self._sub.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.router.dc_relay")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9301)
+    p.add_argument("--discovery-backend", default=None)
+    p.add_argument("--discovery-root", default=None)
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    kw = {}
+    if args.discovery_root:
+        kw["root"] = args.discovery_root
+    rt = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
+    relay = KvDcRelay(rt, host=args.host, port=args.port)
+    base = await relay.start()
+    print(f"kv dc relay at {base}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await relay.stop()
+        await rt.shutdown()
+
+
+def main(argv=None) -> None:
+    from dynamo_tpu.runtime.logging_util import configure_logging
+
+    configure_logging()
+    asyncio.run(async_main(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

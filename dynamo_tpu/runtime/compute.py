@@ -1,0 +1,69 @@
+"""CPU compute offload pool (analog of reference lib/runtime/src/compute/:
+pool + timing/validation macros).
+
+The asyncio event loop is the request plane: every frame, SSE chunk and
+discovery event flows through it. CPU-bound work — chat-template
+rendering, tokenizing a 100k-char prompt, detokenization bursts — stalls
+every in-flight stream while it runs inline. The ComputePool pushes such
+work onto a bounded thread pool with per-call wall-time metrics, and only
+when it is worth it: small inputs stay inline (a thread hop costs more
+than tokenizing a tweet).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import os
+import time
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("dynamo_tpu.runtime.compute")
+
+# inputs smaller than this run inline: the pool exists to keep the event
+# loop responsive under BIG payloads, not to tax every call with a hop
+DEFAULT_OFFLOAD_THRESHOLD = 4096
+
+
+class ComputePool:
+    def __init__(
+        self,
+        max_workers: Optional[int] = None,
+        metrics=None,
+        offload_threshold: int = DEFAULT_OFFLOAD_THRESHOLD,
+    ):
+        workers = max_workers or int(
+            os.environ.get("DYN_COMPUTE_WORKERS", min(4, os.cpu_count() or 1))
+        )
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="dyn-compute"
+        )
+        self.metrics = metrics
+        self.offload_threshold = offload_threshold
+        self.stats = {"offloaded": 0, "inline": 0}
+
+    async def run(
+        self, fn: Callable, *args: Any, size_hint: Optional[int] = None, **kw: Any
+    ) -> Any:
+        """Run fn(*args, **kw): inline when the size hint says it's cheap,
+        on the pool otherwise. Exceptions propagate unchanged either way."""
+        if size_hint is not None and size_hint < self.offload_threshold:
+            self.stats["inline"] += 1
+            return fn(*args, **kw)
+        self.stats["offloaded"] += 1
+        t0 = time.monotonic()
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                self._pool, lambda: fn(*args, **kw)
+            )
+        finally:
+            if self.metrics is not None:
+                self.metrics.histogram(
+                    "compute_offload_seconds", "offloaded compute wall time",
+                    op=getattr(fn, "__name__", "fn"),
+                ).observe(time.monotonic() - t0)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,90 @@
+"""Compute offload pool tests (reference lib/runtime/src/compute/)."""
+
+import asyncio
+import threading
+
+from dynamo_tpu.runtime.compute import ComputePool
+
+
+def test_small_inputs_run_inline_large_offload():
+    pool = ComputePool(max_workers=2, offload_threshold=100)
+    main = threading.get_ident()
+    seen = []
+
+    def probe(x):
+        seen.append(threading.get_ident())
+        return x * 2
+
+    async def run():
+        a = await pool.run(probe, 3, size_hint=10)     # inline
+        b = await pool.run(probe, 4, size_hint=1000)   # offloaded
+        c = await pool.run(probe, 5)                   # no hint → offloaded
+        return a, b, c
+
+    out = asyncio.run(run())
+    assert out == (6, 8, 10)
+    assert seen[0] == main and seen[1] != main and seen[2] != main
+    assert pool.stats == {"inline": 1, "offloaded": 2}
+    pool.close()
+
+
+def test_exceptions_propagate_and_loop_stays_live():
+    pool = ComputePool(max_workers=2)
+
+    def boom():
+        raise ValueError("nope")
+
+    async def run():
+        try:
+            await pool.run(boom)
+        except ValueError as e:
+            # the loop still schedules other work fine
+            await asyncio.sleep(0)
+            return str(e)
+
+    assert asyncio.run(run()) == "nope"
+    pool.close()
+
+
+def test_frontend_preprocessing_uses_pool():
+    """A big prompt must go through the pool (the wiring in http.py), a
+    tiny one inline."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import build_mock_engine, parse_args
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    async def run():
+        rt = DistributedRuntime(discovery=MemDiscovery(realm="cp"), event_transport="inproc")
+        engine, card = build_mock_engine(parse_args(["--speed", "0", "--max-seq-len", "16384"]))
+        w = await serve_worker(rt, engine, card)
+        frt = DistributedRuntime(discovery=MemDiscovery(realm="cp"), event_transport="inproc")
+        manager = ModelManager()
+        watcher = ModelWatcher(frt, manager)
+        svc = HttpService(frt, manager, watcher, port=0)
+        base = await svc.start()
+        await watcher.wait_for_model(timeout=10)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/completions",
+                                  json={"model": "mock-model", "prompt": "hi",
+                                        "max_tokens": 2}) as r:
+                    assert r.status == 200
+                assert svc.compute.stats["inline"] >= 1
+                big = "x" * 8000  # > offload threshold, < KV pool capacity
+                async with s.post(f"{base}/v1/completions",
+                                  json={"model": "mock-model", "prompt": big,
+                                        "max_tokens": 2}) as r:
+                    assert r.status == 200
+                assert svc.compute.stats["offloaded"] >= 1
+        finally:
+            await svc.stop()
+            await frt.shutdown()
+            await w.stop()
+            await rt.shutdown(drain_timeout=1)
+
+    asyncio.run(run())

@@ -1,0 +1,145 @@
+"""KV DC relay tests (reference lib/llm/src/kv_dc_relay/): worker-collapsed
+residency aggregation, the relay's HTTP surface fed by real worker KV
+events, and KV-aware cross-DC selection in the global router."""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.router.dc_relay import DcKvAggregate, KvDcRelay
+from dynamo_tpu.tokens.hashing import block_hashes
+
+
+def test_aggregate_refcounts_collapse_workers():
+    agg = DcKvAggregate()
+    agg.apply({"kind": "store", "block_hashes": [1, 2, 3], "worker": [1, 0]})
+    agg.apply({"kind": "store", "block_hashes": [1, 2], "worker": [2, 0]})
+    assert agg.overlap([1, 2, 3, 4]) == 3
+    # A evicts: 1,2 still held by B → overlap shrinks only past B's run
+    agg.apply({"kind": "remove", "block_hashes": [1, 2, 3], "worker": [1, 0]})
+    assert agg.overlap([1, 2, 3, 4]) == 2
+    agg.apply({"kind": "remove", "block_hashes": [1, 2], "worker": [2, 0]})
+    assert agg.overlap([1, 2, 3, 4]) == 0
+    assert agg.blocks == 0
+
+
+def test_aggregate_drops_crashed_worker_residency():
+    agg = DcKvAggregate()
+    agg.apply({"kind": "store", "block_hashes": [1, 2, 3], "worker": [7, 0]})
+    agg.apply({"kind": "store", "block_hashes": [1], "worker": [8, 0]})
+    # worker 7 crashes without publishing removes: discovery delete drops
+    # its residency so a cold DC stops winning pick_kv
+    agg.drop_instance(7)
+    assert agg.overlap([1, 2, 3]) == 1  # only worker 8's block remains
+    # duplicate stores from one worker must not inflate the refcount
+    agg.apply({"kind": "store", "block_hashes": [1], "worker": [8, 0]})
+    agg.drop_instance(8)
+    assert agg.blocks == 0
+
+
+async def test_relay_aggregates_real_worker_events():
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker.__main__ import build_mock_engine, parse_args
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    realm = "dcrelay"
+    rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    engine, card = build_mock_engine(parse_args(["--speed", "0", "--page-size", "4"]))
+    w = await serve_worker(rt, engine, card)
+
+    rrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    relay = KvDcRelay(rrt)
+    base_relay = await relay.start()
+
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager)
+    svc = HttpService(frt, manager, watcher, port=0)
+    base = await svc.start()
+    await watcher.wait_for_model(timeout=10)
+    try:
+        prompt = "q" * 32  # 32 byte-tokens = 8 blocks of 4
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/completions",
+                json={"model": "mock-model", "prompt": prompt, "max_tokens": 3},
+            ) as r:
+                assert r.status == 200
+
+            entry = svc.manager.get("mock-model")
+            hashes = block_hashes(entry.preprocessor.tokenize_prompt(prompt), 4)
+
+            overlap = 0
+            for _ in range(100):
+                async with s.post(f"{base_relay}/kv_overlap",
+                                  json={"hashes": hashes}) as r:
+                    overlap = (await r.json())["overlap"]
+                if overlap > 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert overlap >= len(hashes) - 1, "DC must report prefix residency"
+
+            async with s.get(f"{base_relay}/stats") as r:
+                stats = await r.json()
+            assert stats["blocks"] > 0 and stats["events"] > 0
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await relay.stop()
+        await rrt.shutdown()
+        await w.stop()
+        await rt.shutdown(drain_timeout=1)
+
+
+async def test_global_router_pick_kv_prefers_deeper_prefix():
+    from dynamo_tpu.global_router import GlobalRouter
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt_a = DistributedRuntime(discovery=MemDiscovery(realm="dc-a"), event_transport="inproc")
+    rt_b = DistributedRuntime(discovery=MemDiscovery(realm="dc-b"), event_transport="inproc")
+    relay_a = KvDcRelay(rt_a)
+    relay_b = KvDcRelay(rt_b)
+    url_a = await relay_a.start()
+    url_b = await relay_b.start()
+    # DC A holds a 2-block prefix, DC B holds 5
+    relay_a.agg.apply({"kind": "store", "block_hashes": [1, 2]})
+    relay_b.agg.apply({"kind": "store", "block_hashes": [1, 2, 3, 4, 5]})
+
+    gr = GlobalRouter([f"http://a.invalid@{url_a}", f"http://b.invalid@{url_b}"])
+    for c in gr.clusters.values():
+        c.healthy = True
+        c.models = {"m"}
+    try:
+        pick = await gr.pick_kv("m", [1, 2, 3, 4, 5, 6])
+        assert pick.base == "http://b.invalid"
+        # load tiebreak when overlaps equal
+        pick = await gr.pick_kv("m", [9, 9, 9])  # nobody holds it
+        assert pick is not None
+        # relay down → degrade to least-loaded, never fail
+        await relay_b.stop()
+        pick = await gr.pick_kv("m", [1, 2, 3])
+        assert pick.base == "http://a.invalid"
+    finally:
+        await gr.stop()
+        await relay_a.stop()
+        await rt_a.shutdown()
+        await rt_b.shutdown()
+
+
+async def test_pick_kv_without_relays_degrades_to_load():
+    from dynamo_tpu.global_router import GlobalRouter
+
+    gr = GlobalRouter(["http://x.invalid", "http://y.invalid"])
+    for c in gr.clusters.values():
+        c.healthy = True
+        c.models = {"m"}
+    gr.clusters["http://x.invalid"].in_flight = 5
+    try:
+        pick = await gr.pick_kv("m", [1, 2, 3])
+        assert pick.base == "http://y.invalid"
+    finally:
+        await gr.stop()
