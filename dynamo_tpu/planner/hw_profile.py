@@ -111,7 +111,12 @@ def run_hw_sweep(
             # by XLA and the timing would measure aliased nonsense
             pos0 = 4
             seq_pages = -(-(pos0 + decode_steps + 1) // page_size)
-            seq_pages = min(seq_pages, max_pages_per_seq)
+            if seq_pages > max_pages_per_seq:
+                raise ValueError(
+                    f"decode_steps={decode_steps} needs {seq_pages} pages/seq "
+                    f"but max_seq_len={max_seq_len} allows {max_pages_per_seq} "
+                    "— clamping would silently measure out-of-range addressing"
+                )
             for B in batches:
                 if B * seq_pages > num_pages:
                     continue  # inputs may be unsorted; later Bs might fit
@@ -152,8 +157,10 @@ def run_hw_sweep(
             if not decode_pts or not prefill_pts:
                 raise ValueError(
                     f"nothing measurable: batches={list(batches)} need "
-                    f"B*4 <= num_pages={num_pages}, chunks="
-                    f"{list(prefill_chunks)} need <= max_seq_len={max_seq_len}"
+                    f"B*{seq_pages} <= num_pages={num_pages}; chunks="
+                    f"{list(prefill_chunks)} need <= max_seq_len={max_seq_len} "
+                    f"and ceil(chunk/{page_size}) <= "
+                    f"{min(num_pages, max_pages_per_seq)}"
                 )
             d_base, d_slope = fit_line(decode_pts, 0.004, 0.0003)
             p_base, p_slope = fit_line(prefill_pts, 0.004, 0.00004)

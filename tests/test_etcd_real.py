@@ -69,7 +69,11 @@ def etcd_server():
         # also covers wait_up failure — an orphaned etcd would hold its
         # ports and poison later runs on this host
         proc.terminate()
-        proc.wait(timeout=10)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
         shutil.rmtree(data, ignore_errors=True)
 
 
