@@ -628,18 +628,29 @@ class HttpService:
             from dynamo_tpu.frontend.tool_calls import parse_tool_calls
 
             content, calls = parse_tool_calls("".join(buffered))
+            # the buffered final emit carries the whole logprob report —
+            # without this, tools+logprobs streams silently lose what the
+            # unary path returns (ADVICE r3)
+            chunk_lp = None
+            if lp_hold:
+                chunk_lp = _format_logprobs(
+                    entry.preprocessor.tokenizer, kind, lp_hold_ids, lp_hold,
+                )
             if calls:
                 delta = {"tool_calls": [
                     {**c, "index": i} for i, c in enumerate(calls)
                 ]}
                 if content:
                     delta["content"] = content
-                await send(_chat_chunk(rid, model, created, delta, "tool_calls"))
+                chunk = _chat_chunk(rid, model, created, delta, "tool_calls")
             else:
-                await send(_chat_chunk(
+                chunk = _chat_chunk(
                     rid, model, created,
                     {"content": content} if content else {}, finish_reason,
-                ))
+                )
+            if chunk_lp is not None:
+                chunk["choices"][0]["logprobs"] = chunk_lp
+            await send(chunk)
 
         # logprob entries from items whose chunk wasn't sent yet (empty
         # text deltas: partial stop-string holds, partial UTF-8) ride on

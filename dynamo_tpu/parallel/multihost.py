@@ -229,7 +229,9 @@ REPLICATED_METHODS = (
     "prefill",
     "draft_prefill",
     "sample_one",
+    "sample_one_ex",
     "decode_multi",
+    "decode_multi_ex",
     "spec_decode_multi",
     "embed",
     "import_pages",
@@ -348,11 +350,24 @@ def selftest_main(argv=None) -> None:
     )
     s = {"temperature": [0.0], "top_k": [0], "top_p": [1.0], "seeds": [0]}
     logits = runner.prefill([1, 2, 3, 4, 5], 0, [0, 1, 2], prior_len=0)
-    tok = runner.sample_one(logits, s, 0)
-    out = runner.decode_multi(3, [tok], [5], [[0, 1, 2]], s, 1)
+    # plain path first (what every logprob-free request takes) ...
+    tok0 = runner.sample_one(logits, s, 0)
+    runner.decode_multi(2, [tok0], [5], [[0, 1, 2]], s, 1)
+    # ... then the _ex variants (penalties + logprobs), REPLICATED_METHODS
+    # too — group replay must cover the paths the engine prefers whenever
+    # a request carries logprobs/penalties
+    tok, lp1 = runner.sample_one_ex(
+        logits, s, 0, history=[1, 2, 3, 4, 5], n_logprobs=2
+    )
+    out, lp = runner.decode_multi_ex(
+        3, [tok], [7], [[0, 1, 2]], s, 3,
+        n_logprobs=2, histories=[[1, 2, 3, 4, 5, tok]], prompt_lens=[5],
+    )
     payload = runner.export_pages([0, 1])  # replicated-gather path
     runner.import_pages([3, 4], 0, payload)
-    print(f"MULTIHOST_SELFTEST {[tok] + out[0].tolist()}", flush=True)
+    lp_sig = [round(float(lp1[0]), 4)] + [round(float(v), 4) for v in lp[0][0]]
+    print(f"MULTIHOST_SELFTEST {[tok] + out[0].tolist()} LP {lp_sig}",
+          flush=True)
 
 
 if __name__ == "__main__":

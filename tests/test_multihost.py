@@ -101,7 +101,7 @@ async def _http_stack(discovery_root, min_prefill=8):
     return frt, svc, base
 
 
-async def _completion(base, prompt_ids, max_tokens=6):
+async def _completion(base, prompt_ids, max_tokens=6, **extra):
     async with aiohttp.ClientSession() as s:
         async with s.post(
             f"{base}/v1/completions",
@@ -110,6 +110,7 @@ async def _completion(base, prompt_ids, max_tokens=6):
                 "prompt": prompt_ids,
                 "max_tokens": max_tokens,
                 "temperature": 0,
+                **extra,
             },
         ) as r:
             assert r.status == 200, await r.text()
@@ -130,6 +131,12 @@ async def test_multihost_group_matches_single_process(tmp_path):
         await _wait_line(ref, "worker serving")
         frt, svc, base = await _http_stack(droot_ref)
         ref_body = await _completion(base, prompt, max_tokens=6)
+        # penalties+logprobs route through decode_multi_ex/sample_one_ex,
+        # which must be REPLICATED_METHODS (ADVICE r3 high): a group whose
+        # leader runs the _ex programs alone deadlocks on the collectives
+        ref_ex = await _completion(
+            base, prompt, max_tokens=6, frequency_penalty=0.5, logprobs=2
+        )
     finally:
         if svc is not None:
             await svc.stop()
@@ -163,6 +170,14 @@ async def test_multihost_group_matches_single_process(tmp_path):
             body["choices"][0]["text"], ref_body["choices"][0]["text"],
         )
         assert body["usage"] == ref_body["usage"]
+        body_ex = await _completion(
+            base, prompt, max_tokens=6, frequency_penalty=0.5, logprobs=2
+        )
+        assert body_ex["choices"][0]["text"] == ref_ex["choices"][0]["text"]
+        assert (
+            body_ex["choices"][0]["logprobs"]["token_logprobs"]
+            == ref_ex["choices"][0]["logprobs"]["token_logprobs"]
+        )
     finally:
         if svc is not None:
             await svc.stop()
