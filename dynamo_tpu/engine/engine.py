@@ -70,6 +70,8 @@ class InferenceEngine:
         disk_kv_root: Optional[str] = None,
         obj_kv_root: Optional[str] = None,  # G4 object store (fs backend /
         #   shared mount; S3 via kvbm.object_store.S3Backend)
+        tokenizer_spec: str = "byte",  # guided decoding lifts byte DFAs to
+        #   token masks against THIS tokenizer (must match the frontend's)
     ):
         self.runner = runner
         # cross-worker KVBM onboarding: worker_common injects an async
@@ -131,6 +133,71 @@ class InferenceEngine:
         self._kv_pending: List[Sequence] = []  # disagg-decode awaiting space
         self.parked_ttl_s = 60.0
         self._embed_pending: List[tuple] = []  # (tokens, future, loop)
+        # guided decoding: tokenizer-lifted constraint compile cache
+        self.tokenizer_spec = tokenizer_spec
+        self._guided_lifter = None
+        self._guided_cache: Dict[str, Any] = {}
+        self._guided_lock = threading.Lock()
+
+    # -- guided decoding ---------------------------------------------------
+    def _compile_guided(self, spec: Dict[str, Any]):
+        """Wire spec → GuidedMatcher (cached per spec+engine). Runs in an
+        executor (DFA compilation for a big schema can take ~100ms); the
+        lock keeps concurrent first requests from each building the
+        (expensive, per-vocab) TokenLifter."""
+        import json as _json
+
+        key = _json.dumps(spec, sort_keys=True)
+        with self._guided_lock:
+            hit = self._guided_cache.get(key)
+            if hit is not None:
+                return hit
+            from dynamo_tpu.guided import compile_regex, compile_structural
+            from dynamo_tpu.guided.token_mask import TokenLifter
+
+            kind = spec.get("kind")
+            if kind == "regex":
+                dfa = compile_regex(spec["pattern"])
+            elif kind == "structural":
+                dfa = compile_structural(spec)
+            else:
+                raise ValueError(f"unknown guided kind {kind!r}")
+            if self._guided_lifter is None:
+                from dynamo_tpu.frontend.tokenizer import load_tokenizer
+
+                cfg = getattr(self.runner, "config", None)
+                vocab = (
+                    cfg.vocab_size if cfg is not None else self.runner.vocab_size
+                )
+                self._guided_lifter = TokenLifter.for_tokenizer(
+                    load_tokenizer(self.tokenizer_spec), vocab,
+                )
+            matcher = self._guided_lifter.lift(dfa)
+            # small cap: each matcher holds up to _ROW_CACHE_MAX full-vocab
+            # rows, so this bounds worker memory at tens of MB, not GB
+            while len(self._guided_cache) >= 32:
+                self._guided_cache.pop(next(iter(self._guided_cache)))
+            self._guided_cache[key] = matcher
+            return matcher
+
+    def _guided_mask(self, seq: Sequence) -> Optional[np.ndarray]:
+        """Sampling mask for a constrained sequence. An all-False row (no
+        token in this vocab can extend the constraint — possible when the
+        tokenizer lacks a needed byte) degrades to force-EOS so the
+        sequence stops instead of emitting garbage."""
+        m = seq.guided_m
+        if m is None:
+            return None
+        mask = m.allowed(seq.guided_s)
+        if not mask.any():
+            log.warning(
+                "request %s: no token can extend the constraint from state "
+                "%d — forcing EOS", seq.request_id, seq.guided_s,
+            )
+            if 0 <= m.lifter.eos_id < len(mask):
+                mask = mask.copy()
+                mask[m.lifter.eos_id] = True
+        return mask
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -182,7 +249,56 @@ class InferenceEngine:
             disagg=annotations.get("disagg"),
             kv_import=request.get("kv_import"),
             adapter=request.get("adapter"),
+            guided=request.get("guided"),
         )
+        if seq.guided and getattr(self.runner, "has_draft", False):
+            # speculative verify can't honor per-token masks; silently
+            # dropping the constraint would hand back schema-invalid output
+            # with finish_reason "stop" — reject up front instead
+            yield {
+                "finish_reason": "error",
+                "error": "guided decoding is unsupported on a "
+                         "speculative-decoding worker",
+                "token_ids": [],
+            }
+            self._streams.pop(rid, None)
+            return
+        if seq.guided:
+            try:
+                seq.guided_m = await loop.run_in_executor(
+                    None, self._compile_guided, seq.guided
+                )
+                seq.guided_s = seq.guided_m.start
+                # disagg decode continuation: the prefill worker already
+                # generated the trailing N prompt tokens under this
+                # constraint — replay them so the DFA state matches
+                n_adv = int(request.get("guided_advanced") or 0)
+                for t in seq.prompt[len(seq.prompt) - n_adv:] if n_adv else []:
+                    seq.guided_s = seq.guided_m.advance(seq.guided_s, int(t))
+            except Exception as e:
+                yield {
+                    "finish_reason": "error",
+                    "error": f"guided decoding spec rejected: {e}",
+                    "token_ids": [],
+                }
+                self._streams.pop(rid, None)
+                return
+        # reject prompts that can NEVER be admitted (more pages than the
+        # pool/per-seq cap) — without this the sequence waits forever and
+        # head-of-line-blocks every request behind it
+        PS = self.pool.page_size
+        cap_tokens = min(self.scheduler.max_seq_pages, self.pool.num_pages) * PS
+        if len(seq.prompt) + 1 > cap_tokens:
+            yield {
+                "finish_reason": "error",
+                "error": (
+                    f"prompt of {len(seq.prompt)} tokens exceeds this "
+                    f"worker's KV capacity ({cap_tokens - 1} tokens)"
+                ),
+                "token_ids": [],
+            }
+            self._streams.pop(rid, None)
+            return
         mm = request.get("mm")
         if mm:
             import numpy as np
@@ -237,6 +353,9 @@ class InferenceEngine:
         finally:
             # runs on normal end, cancel, AND consumer break/close
             self._streams.pop(rid, None)
+            # GIL-atomic discard; without it the warned-id set grows
+            # unbounded on a long-lived spec-decode worker (ADVICE r3)
+            self._spec_sampling_warned.discard(rid)
             if not finished:
                 self._inbox.put(("abort", rid))
 
@@ -349,6 +468,11 @@ class InferenceEngine:
                 if parked is not None:
                     self.scheduler.release_parked(parked[0])
                 self._kv_pending = [s for s in self._kv_pending if s.request_id != arg]
+                # step-thread discard: the asyncio-side discard can race a
+                # warn for a still-batched sequence (the abort lands after
+                # the step that warned); this one runs on the warning
+                # thread itself, after the sequence left the scheduler
+                self._spec_sampling_warned.discard(arg)
             elif op == "add_kv":
                 self._kv_pending.append(arg)
             elif op == "export":
@@ -621,19 +745,23 @@ class InferenceEngine:
         if not plan.is_last_chunk:
             return
         first_lp = None
+        mask1 = self._guided_mask(seq)
         n_lp1 = _batch_logprobs([seq])
         if (n_lp1 >= 0 or _batch_penalties([seq])) and hasattr(
             self.runner, "sample_one_ex"
         ):
+            kw1 = {"mask": mask1} if mask1 is not None else {}
             token, first_lp = self.runner.sample_one_ex(
                 logits, _sampling_params([seq]), self._next_step(),
                 history=list(seq.tokens) if _batch_penalties([seq]) else None,
-                n_logprobs=n_lp1,
+                n_logprobs=n_lp1, **kw1,
             )
         else:
+            kw1 = {"mask": mask1} if mask1 is not None else {}
             token = self.runner.sample_one(
-                logits, _sampling_params([seq]), self._next_step()
+                logits, _sampling_params([seq]), self._next_step(), **kw1,
             )
+        self._guided_advance(seq, token)
         if seq.disagg == "prefill":
             # disagg: first token + transfer handle; pages stay pinned for
             # the decode worker's pull (disagg-serving.md bootstrap model)
@@ -692,6 +820,8 @@ class InferenceEngine:
                             "with speculative decoding and were ignored",
                             s.request_id,
                         )
+            # (guided requests were rejected at admission on draft workers,
+            # so no mask handling is needed on this path)
             # speculative path: R fused draft-propose + target-verify
             # rounds; each round yields 1..gamma+1 tokens per sequence.
             # Near a token budget (T < gamma+1) shrink gamma instead of
@@ -722,6 +852,19 @@ class InferenceEngine:
                         break
                 self._emit(seq, emit, reason)
             return
+        masks = None
+        if any(s.guided_m is not None for s in seqs):
+            # constrained sequences need a fresh mask per sampled token —
+            # clamp to one step per dispatch (the mask is an input array,
+            # so this costs a host turnaround, not a recompile)
+            T = 1
+            vocab = next(
+                s.guided_m for s in seqs if s.guided_m is not None
+            ).lifter.vocab_size
+            masks = np.ones((len(seqs), vocab), bool)
+            for i, s in enumerate(seqs):
+                if s.guided_m is not None:
+                    masks[i] = self._guided_mask(s)
         self._step_counter += T
         n_lp = _batch_logprobs(seqs)
         histories = (
@@ -731,16 +874,20 @@ class InferenceEngine:
         if (n_lp >= 0 or histories is not None) and hasattr(
             self.runner, "decode_multi_ex"
         ):
+            mkw = {"masks": masks} if masks is not None else {}
             sampled, lp = self.runner.decode_multi_ex(
                 T, tokens, positions, page_tables, _sampling_params(seqs), step0,
                 adapters=[s.adapter_idx for s in seqs],
                 n_logprobs=n_lp, histories=histories,
                 prompt_lens=[s.n_prompt0 for s in seqs],
+                **mkw,
             )
         else:
+            mkw = {"masks": masks} if masks is not None else {}
             sampled = self.runner.decode_multi(
                 T, tokens, positions, page_tables, _sampling_params(seqs), step0,
                 adapters=[s.adapter_idx for s in seqs],
+                **mkw,
             )
         for i, seq in enumerate(seqs):
             emit: List[int] = []
@@ -749,6 +896,8 @@ class InferenceEngine:
             for j in range(T):
                 token = int(sampled[i, j])
                 reason = self.scheduler.complete_decode(seq, token)
+                if not reason:
+                    self._guided_advance(seq, token)
                 if reason != "stop":
                     emit.append(token)
                     if lp is not None and seq.sampling.get("logprobs") is not None:
@@ -756,6 +905,19 @@ class InferenceEngine:
                 if reason:
                     break
             self._emit(seq, emit, reason, logprobs=lp_entries or None)
+
+    def _guided_advance(self, seq: Sequence, token: int) -> None:
+        """Advance a sequence's constraint DFA past an accepted token. A
+        desync (should be impossible while masks are honored) drops the
+        constraint and logs rather than killing the whole batch."""
+        m = seq.guided_m
+        if m is None or token == m.lifter.eos_id:
+            return
+        try:
+            seq.guided_s = m.advance(seq.guided_s, int(token))
+        except ValueError as e:
+            log.error("request %s: %s — constraint dropped", seq.request_id, e)
+            seq.guided_m = None
 
     def _next_step(self) -> int:
         self._step_counter += 1

@@ -47,6 +47,8 @@ def _decode_loop(
     packed,  # int32 [B + B*MP (+B if lora) + 1]: pos|pt|adapters|step
     hist,  # None (no penalties) or int32 [B, H] token history padded with
     # vocab_size — builds the on-device count table the penalties read
+    mask,  # None or bool [B, V] guided-decoding sampling mask (constrained
+    # dispatches run n_steps=1, so one mask covers the whole loop)
     k_pool,
     v_pool,
     sampling: SamplingParams,
@@ -108,7 +110,7 @@ def _decode_loop(
             from dynamo_tpu.engine.sampling import apply_penalties
 
             l = apply_penalties(raw, cnt, cnt_out, sampling)
-        s = sample(l, sampling, step0 + t)
+        s = sample(l, sampling, step0 + t, mask=mask)
         outs = (s,)
         if n_logprobs >= 0:
             from dynamo_tpu.engine.sampling import top_logprobs
@@ -372,7 +374,7 @@ class ModelRunner:
         self._jit_decode_loop = jax.jit(
             partial(_decode_loop, self.config, self.attn_impl, self._fwd_mesh),
             static_argnums=(0, 1),  # n_steps, n_logprobs
-            donate_argnums=(6, 7),  # k_pool, v_pool
+            donate_argnums=(7, 8),  # k_pool, v_pool
         )
         # device-resident sampling cache: batches re-send identical sampling
         # params every dispatch; transferring them each time costs one relay
@@ -482,12 +484,14 @@ class ModelRunner:
         sampling,  # SamplingParams or dict of host lists
         step: int,
         adapters: Optional[List[int]] = None,
+        masks: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         """n_steps fused decode iterations (one host sync total). Page
         tables must already cover positions[i] + n_steps slots. Returns
         sampled tokens [B_bucket, n_steps]."""
         toks, _ = self.decode_multi_async(
-            n_steps, tokens, positions, page_tables, sampling, step, adapters
+            n_steps, tokens, positions, page_tables, sampling, step, adapters,
+            masks=masks,
         )
         return np.asarray(jax.device_get(toks))
 
@@ -503,6 +507,7 @@ class ModelRunner:
         n_logprobs: int = -1,
         histories: Optional[List[List[int]]] = None,
         prompt_lens: Optional[List[int]] = None,
+        masks: Optional[np.ndarray] = None,
     ):
         """decode_multi with the sampling extras: `histories` (per-sequence
         prompt+generated token ids) switches on repetition/frequency/
@@ -514,6 +519,7 @@ class ModelRunner:
         out = self.decode_multi_async(
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
             n_logprobs=n_logprobs, histories=histories, prompt_lens=prompt_lens,
+            masks=masks,
         )
         if n_logprobs >= 0:
             toks, _, lp = out
@@ -534,6 +540,7 @@ class ModelRunner:
         n_logprobs: int = -1,
         histories: Optional[List[List[int]]] = None,
         prompt_lens: Optional[List[int]] = None,
+        masks: Optional[np.ndarray] = None,  # [n, V] bool guided masks
     ):
         """decode_multi without the host sync: returns (toks, last) DEVICE
         arrays — toks [B_bucket, n_steps] and last [B_bucket] (the final
@@ -586,9 +593,15 @@ class ModelRunner:
                 )
             hist = (jnp.asarray(hist_h), jnp.asarray(plen_h))
 
+        mask_dev = None
+        if masks is not None:
+            m = np.ones((B, self.config.vocab_size), bool)
+            m[: masks.shape[0]] = masks  # pad rows stay all-allowed
+            mask_dev = jnp.asarray(m)
+
         toks, last, lp, self.k_pool, self.v_pool = self._jit_decode_loop(
             n_steps, n_logprobs, self.params, tok, jnp.asarray(packed), hist,
-            self.k_pool, self.v_pool,
+            mask_dev, self.k_pool, self.v_pool,
             self._device_sampling(sampling, B), self.lora,
         )
         if n_logprobs >= 0:
@@ -725,8 +738,12 @@ class ModelRunner:
             mesh=self._fwd_mesh, mm_embeds=mm_embeds, mm_mask=mm_mask,
         )
 
-    def sample_one(self, logits: jax.Array, sampling, step: int) -> int:
-        out = self._jit_sample(logits[None, :], _as_sampling(sampling), jnp.int32(step))
+    def sample_one(self, logits: jax.Array, sampling, step: int,
+                   mask: Optional[np.ndarray] = None) -> int:
+        out = self._jit_sample(
+            logits[None, :], _as_sampling(sampling), jnp.int32(step),
+            mask=jnp.asarray(mask[None, :]) if mask is not None else None,
+        )
         return int(jax.device_get(out)[0])
 
     def sample_one_ex(
@@ -736,6 +753,7 @@ class ModelRunner:
         step: int,
         history: Optional[List[int]] = None,
         n_logprobs: int = -1,
+        mask: Optional[np.ndarray] = None,
     ):
         """sample_one with penalties (over `history` token ids) and/or a
         logprob report. Returns (token, lp) where lp is None or
@@ -752,7 +770,8 @@ class ModelRunner:
             h[: len(history)] = history
             hist = jnp.asarray(h)
         out = self._jit_sample_one_ex(
-            n_logprobs, logits, hist, _as_sampling(sampling), jnp.int32(step)
+            n_logprobs, logits, hist, _as_sampling(sampling), jnp.int32(step),
+            jnp.asarray(mask[None, :]) if mask is not None else None,
         )
         out = jax.device_get(out)
         tok = int(out[0][0])
@@ -921,7 +940,8 @@ class ModelRunner:
         return sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in leaves)
 
 
-def _sample_one_ex(vocab_size: int, n_logprobs: int, logits, hist, sampling, step):
+def _sample_one_ex(vocab_size: int, n_logprobs: int, logits, hist, sampling,
+                   step, mask=None):
     """Single-position sampling with optional penalties + logprob report
     (the prefill-first-token path of the decode loop's extras). `hist`
     here is the PROMPT only — nothing has been generated yet, so the
@@ -936,7 +956,7 @@ def _sample_one_ex(vocab_size: int, n_logprobs: int, logits, hist, sampling, ste
             1.0, mode="drop"
         )
         l = apply_penalties(raw, counts, jnp.zeros_like(counts), sampling)
-    s = sample(l, sampling, step)
+    s = sample(l, sampling, step, mask=mask)
     if n_logprobs >= 0:
         return (s,) + top_logprobs(raw, s, n_logprobs)
     return (s,)

@@ -134,9 +134,15 @@ def top_logprobs(logits: jax.Array, sampled: jax.Array, k: int):
     return tok_lp, ids.astype(jnp.int32), vals
 
 
-def sample(logits: jax.Array, params: SamplingParams, step: jax.Array) -> jax.Array:
+def sample(
+    logits: jax.Array, params: SamplingParams, step: jax.Array, mask=None
+) -> jax.Array:
     """logits [B, V] f32 → token ids [B] i32. `step` folds the decode step
-    index into each sequence's key so repeated calls draw fresh samples."""
+    index into each sequence's key so repeated calls draw fresh samples.
+    `mask` [B, V] bool (guided decoding) bans False tokens outright; the
+    caller guarantees every live row keeps at least one allowed token."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
     idx, scaled = _filtered_scaled(logits, params)
 
     def draw(key_data, row):

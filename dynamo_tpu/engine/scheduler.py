@@ -62,6 +62,10 @@ class Sequence:
     mm_embeds: Any = None
     mm_positions: Any = None
     mm_seed: Optional[int] = None
+    # guided decoding: wire spec (dict), compiled GuidedMatcher, DFA state
+    guided: Any = None
+    guided_m: Any = None
+    guided_s: int = 0
     state: SeqState = SeqState.WAITING
     tokens: List[int] = field(default_factory=list)  # prompt + generated
     pages: List[int] = field(default_factory=list)

@@ -145,8 +145,76 @@ class Preprocessor:
             flat.append({**m, "content": "".join(parts)})
         return flat
 
+    # -- guided decoding spec (reference preprocessor.rs:286 tool_choice /
+    # response_format / structural-tag enforcement) ------------------------
+    def _guided(self, req: Dict[str, Any],
+                tools: Optional[List[Dict[str, Any]]]) -> Optional[Dict[str, Any]]:
+        """Map OpenAI constraint surfaces onto the wire spec:
+        - tool_choice: "required" | {"function": {"name": ...}} → hermes
+          tool-call regex over the declared tools;
+        - response_format: json_object / json_schema / structural_tag;
+        - vLLM-style extensions: guided_regex / guided_json / guided_choice.
+        """
+        from dynamo_tpu.guided.json_schema import (
+            GENERIC_JSON, schema_to_regex, tool_call_regex,
+        )
+        from dynamo_tpu.guided.regex_dfa import escape
+
+        tc = req.get("tool_choice")
+        if tools and tc == "required":
+            return {"kind": "regex", "pattern": tool_call_regex(tools)}
+        if tools and isinstance(tc, dict):
+            name = (tc.get("function") or {}).get("name")
+            if name:
+                return {
+                    "kind": "regex",
+                    "pattern": tool_call_regex(tools, name=name),
+                }
+        rf = req.get("response_format") or {}
+        kind = rf.get("type")
+        if kind == "json_object":
+            return {"kind": "regex", "pattern": GENERIC_JSON}
+        if kind == "json_schema":
+            schema = (rf.get("json_schema") or {}).get("schema", rf.get("schema"))
+            if schema is None:
+                raise ValueError("response_format.json_schema needs a schema")
+            return {"kind": "regex", "pattern": schema_to_regex(schema)}
+        if kind == "structural_tag":
+            structures = [
+                {
+                    "begin": s.get("begin", ""),
+                    "end": s.get("end", ""),
+                    **(
+                        {"pattern": schema_to_regex(s["schema"])}
+                        if s.get("schema") is not None else
+                        {"pattern": s.get("pattern", GENERIC_JSON)}
+                    ),
+                }
+                for s in rf.get("structures") or []
+            ]
+            return {
+                "kind": "structural",
+                "triggers": rf.get("triggers") or [],
+                "structures": structures,
+            }
+        if req.get("guided_regex"):
+            return {"kind": "regex", "pattern": req["guided_regex"]}
+        if req.get("guided_json") is not None:
+            schema = req["guided_json"]
+            if isinstance(schema, str):
+                import json as _json
+
+                schema = _json.loads(schema)
+            return {"kind": "regex", "pattern": schema_to_regex(schema)}
+        if req.get("guided_choice"):
+            pat = "(" + "|".join(escape(str(c)) for c in req["guided_choice"]) + ")"
+            return {"kind": "regex", "pattern": pat}
+        return None
+
     def preprocess_chat(self, req: Dict[str, Any]) -> Dict[str, Any]:
         tools = req.get("tools") or None
+        if req.get("tool_choice") == "none":
+            tools = None  # the model must not see or call tools
         images: list = []
         messages = self._flatten_multimodal(req.get("messages") or [], images)
         prompt = self.render_chat(messages, tools=tools)
@@ -176,6 +244,7 @@ class Preprocessor:
             stop=self._stop(req, len(ids)),
             annotations=annotations,
             adapter=self.adapter,
+            guided=self._guided(req, tools),
         )
         if images:
             out["images"] = images
@@ -195,6 +264,7 @@ class Preprocessor:
             stop=self._stop(req, len(ids)),
             annotations={"kind": "completions"},
             adapter=self.adapter,
+            guided=self._guided(req, None),
         )
 
     def _check_context(self, prompt_len: int) -> None:

@@ -66,6 +66,7 @@ def make_preprocessed_request(
     stop: StopConditions,
     annotations: Optional[Dict[str, Any]] = None,
     adapter: Optional[str] = None,
+    guided: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     out = {
         "model": model,
@@ -76,6 +77,10 @@ def make_preprocessed_request(
     }
     if adapter:
         out["adapter"] = adapter
+    if guided:
+        # constraint spec for the worker's guided-decoding hook
+        # (dynamo_tpu/guided/): {"kind": "regex"|"structural", ...}
+        out["guided"] = guided
     return out
 
 

@@ -1,0 +1,36 @@
+"""Guided decoding: constrained generation via byte-level DFAs.
+
+Analog of the reference's guided-decoding surface (tool_choice
+enforcement, JSON-schema response_format, structural tags — ref
+lib/llm/src/preprocessor.rs:286 and lib/llm/src/preprocessor/tools/),
+re-designed for the TPU engine:
+
+- constraints compile on the FRONTEND to a compact byte-level DFA
+  (regex subset / JSON schema → regex / structural-tag composite);
+- the worker lifts the byte DFA to per-state TOKEN masks against its
+  tokenizer (lazy per-state rows, so 128k-vocab tables never
+  materialize);
+- the engine samples with the mask applied to logits inside the jitted
+  step (mask rides as a [B, V] input array — no recompile per schema),
+  host-advancing each sequence's DFA state per accepted token.
+
+Wire format (PreprocessedRequest["guided"]):
+  {"kind": "regex", "pattern": <pattern>}
+  {"kind": "structural", "triggers": [...],
+   "structures": [{"begin": s, "pattern": p, "end": s}, ...]}
+"""
+
+from dynamo_tpu.guided.regex_dfa import ByteDFA, compile_regex
+from dynamo_tpu.guided.json_schema import schema_to_regex, GENERIC_JSON
+from dynamo_tpu.guided.token_mask import GuidedMatcher, TokenLifter
+from dynamo_tpu.guided.structural import compile_structural
+
+__all__ = [
+    "ByteDFA",
+    "compile_regex",
+    "schema_to_regex",
+    "GENERIC_JSON",
+    "GuidedMatcher",
+    "TokenLifter",
+    "compile_structural",
+]

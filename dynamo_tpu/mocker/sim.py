@@ -130,13 +130,21 @@ class SimRunner:
         seed = tokens[-1] if tokens else 0
         return ("sim-logits", seed, start_pos + len(tokens))
 
-    def sample_one(self, logits, sampling, step: int) -> int:
+    def sample_one(self, logits, sampling, step: int, mask=None) -> int:
         _, seed, position = logits
-        return _sim_token(seed, position, self.vocab_size)
+        tok = _sim_token(seed, position, self.vocab_size)
+        if mask is not None and not mask[tok]:
+            # guided decoding against the mocker: honor the mask by
+            # remapping onto the allowed set (deterministic in the seed);
+            # an empty mask passes through (engine force-stops it)
+            allowed = np.flatnonzero(mask)
+            if len(allowed):
+                tok = int(allowed[tok % len(allowed)])
+        return tok
 
     def decode_multi(
         self, n_steps: int, tokens: List[int], positions: List[int],
-        page_tables, sampling, step: int, adapters=None,
+        page_tables, sampling, step: int, adapters=None, masks=None,
     ) -> np.ndarray:
         t = self.timing
         t.sleep(
@@ -147,6 +155,10 @@ class SimRunner:
         for i, (tok, pos) in enumerate(zip(tokens, positions)):
             for j in range(n_steps):
                 out[i, j] = _sim_token(tok, pos + 1 + j, self.vocab_size)
+            if masks is not None and not masks[i, out[i, 0]]:
+                allowed = np.flatnonzero(masks[i])
+                if len(allowed):
+                    out[i, 0] = int(allowed[out[i, 0] % len(allowed)])
         return out
 
     def decode(self, tokens, positions, page_tables, kv_lens, sampling, step):

@@ -127,6 +127,11 @@ class PrefillRouter:
         # decode continuation: prompt += first token, budget -= 1
         dreq = dict(request)
         dreq["token_ids"] = list(token_ids) + [int(first_token)]
+        if request.get("guided"):
+            # the prefill worker sampled first_token under the constraint;
+            # the decode worker must replay it through its own DFA copy
+            # instead of restarting at the start state
+            dreq["guided_advanced"] = 1
         if max_tokens is not None:
             stop["max_tokens"] = int(max_tokens) - 1
         if int(stop.get("min_tokens") or 0) >= 1:

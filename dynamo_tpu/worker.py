@@ -281,6 +281,7 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
         obj_kv_root=args.obj_kv_root,
+        tokenizer_spec=args.tokenizer,
     )
     vision = None
     if args.vision:

@@ -1,0 +1,415 @@
+"""Guided decoding: regex→DFA compiler, JSON-schema lowering, structural
+tags, token-mask lifting, and the engine mask path end-to-end (tiny model,
+CPU). Reference surface: tool_choice enforcement / response_format
+json_schema / structural tags (lib/llm/src/preprocessor.rs:286,
+lib/llm/src/preprocessor/tools/)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.guided.json_schema import (
+    GENERIC_JSON,
+    SchemaError,
+    schema_to_regex,
+    tool_call_regex,
+)
+from dynamo_tpu.guided.regex_dfa import RegexError, compile_regex, escape
+from dynamo_tpu.guided.structural import compile_structural
+from dynamo_tpu.guided.token_mask import TokenLifter, _gpt2_byte_decoder
+from dynamo_tpu.frontend.tokenizer import ByteTokenizer
+
+# -- regex → byte DFA --------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "pattern,yes,no",
+    [
+        (r"-?(0|[1-9][0-9]*)", ["0", "-7", "42"], ["01", "", "-", "a"]),
+        (r"a{2,3}b?", ["aa", "aaa", "aab", "aaab"], ["a", "aaaa", "b"]),
+        (r"(foo|ba[rz])+", ["foo", "barbaz", "foobar"], ["ba", "fo", ""]),
+        (r"[^x-z]n", ["an", "mn"], ["xn", "yn", "n"]),
+        (r"\d+\.\d+", ["3.14"], ["3.", ".5", "3"]),
+        (r"héllo", ["héllo"], ["hello", "h?llo"]),  # UTF-8 literal bytes
+        # anchors are zero-width no-ops (vLLM/outlines guided_regex style)
+        (r"^(yes|no)$", ["yes", "no"], ["^yes$", "maybe"]),
+    ],
+)
+def test_regex_dfa_matches(pattern, yes, no):
+    d = compile_regex(pattern)
+    for s in yes:
+        assert d.matches(s.encode()), (pattern, s)
+    for s in no:
+        assert not d.matches(s.encode()), (pattern, s)
+
+
+def test_regex_dfa_no_dead_ends():
+    # every non-accepting reachable state must keep a path to acceptance
+    d = compile_regex(r"abc(de)?")
+    s = d.start
+    for b in b"abc":
+        s = int(d.trans[s, b])
+        assert s >= 0
+    # from here both EOS (accept) and 'd' continue
+    assert d.accept[s] and int(d.trans[s, ord("d")]) >= 0
+    assert int(d.trans[s, ord("x")]) == -1
+
+
+def test_regex_wire_roundtrip():
+    d = compile_regex(r"[ab]{1,4}")
+    from dynamo_tpu.guided.regex_dfa import ByteDFA
+
+    d2 = ByteDFA.from_wire(d.to_wire())
+    assert d2.matches(b"abba") and not d2.matches(b"abbba c")
+
+
+def test_regex_errors():
+    with pytest.raises(RegexError):
+        compile_regex("(unclosed")
+    with pytest.raises(RegexError):
+        compile_regex("*dangling")
+
+
+# -- JSON schema → regex -----------------------------------------------------
+
+
+def _valid(schema, text):
+    d = compile_regex(schema_to_regex(schema))
+    return d.matches(text.encode())
+
+
+def test_schema_object_required_and_optional():
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "tag": {"type": "string"},
+        },
+        "required": ["name", "age"],
+        "additionalProperties": False,
+    }
+    assert _valid(schema, '{"name": "bob", "age": 4, "tag": "x"}')
+    assert _valid(schema, '{"name":"b","age":0}')
+    assert not _valid(schema, '{"age": 4}')  # missing required
+    assert not _valid(schema, '{"name":"b","age":1,"zzz":2}')  # unknown key
+
+
+def test_schema_enum_const_anyof_ref():
+    schema = {
+        "type": "object",
+        "properties": {
+            "kind": {"enum": ["a", "b"]},
+            "v": {"anyOf": [{"type": "integer"}, {"type": "null"}]},
+            "r": {"$ref": "#/$defs/pos"},
+        },
+        "required": ["kind", "v", "r"],
+        "$defs": {"pos": {"type": "boolean"}},
+    }
+    assert _valid(schema, '{"kind": "a", "v": 3, "r": true}')
+    assert _valid(schema, '{"kind": "b", "v": null, "r": false}')
+    assert not _valid(schema, '{"kind": "c", "v": 3, "r": true}')
+
+
+def test_schema_array_bounds():
+    schema = {"type": "array", "items": {"type": "integer"},
+              "minItems": 1, "maxItems": 3}
+    assert _valid(schema, "[1]") and _valid(schema, "[1, 2, 3]")
+    assert not _valid(schema, "[]") and not _valid(schema, "[1,2,3,4]")
+
+
+def test_schema_string_bounds_and_pattern():
+    assert _valid({"type": "string", "minLength": 2, "maxLength": 3}, '"ab"')
+    assert not _valid({"type": "string", "minLength": 2}, '"a"')
+    assert _valid({"type": "string", "pattern": "^[A-Z]{2}$"}, '"AB"')
+    assert not _valid({"type": "string", "pattern": "^[A-Z]{2}$"}, '"ab"')
+
+
+def test_schema_recursive_ref_rejected():
+    schema = {"$defs": {"n": {"type": "object",
+                              "properties": {"next": {"$ref": "#/$defs/n"}},
+                              "required": ["next"]}},
+              "$ref": "#/$defs/n"}
+    with pytest.raises(SchemaError):
+        schema_to_regex(schema)
+
+
+def test_generic_json_accepts_nested():
+    d = compile_regex(GENERIC_JSON)
+    assert d.matches(b'{"a": [1, {"b": null}], "c": "x"}')
+    assert not d.matches(b"[1]")  # json_object means a top-level object
+
+
+# -- structural tags ---------------------------------------------------------
+
+
+def test_structural_free_then_constrained():
+    st = compile_structural({
+        "triggers": ["<fn>"],
+        "structures": [{
+            "begin": "<fn>",
+            "schema": {"type": "object", "properties": {"x": {"type": "integer"}},
+                       "required": ["x"], "additionalProperties": False},
+            "end": "</fn>",
+        }],
+    })
+    assert st.matches(b"free text, no calls")
+    assert st.matches(b'before <fn>{"x": 1}</fn> after')
+    assert st.matches(b'<fn>{"x": 1}</fn><fn>{"x": 2}</fn>')
+    assert not st.matches(b'<fn>{"y": 1}</fn>')
+    assert not st.matches(b'<fn>{"x": 1}')  # EOS inside a structure
+
+
+# -- token lifting -----------------------------------------------------------
+
+
+def test_gpt2_byte_decoder_roundtrip():
+    dec = _gpt2_byte_decoder()
+    assert dec["Ġ"] == 0x20 and dec["Ċ"] == 0x0A and dec["a"] == ord("a")
+    assert len(set(dec.values())) == 256
+
+
+def test_token_lifter_byte_walk():
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, vocab_size=512)
+    m = lf.lift(compile_regex(r'\{"k": (true|false)\}'))
+    s, out = m.start, []
+    for _ in range(32):
+        mask = m.allowed(s)
+        assert mask.any()
+        t = int(np.argmax(mask))
+        if t == tok.eos_id:
+            break
+        out.append(t)
+        s = m.advance(s, t)
+    assert m.is_accepting(s)
+    body = json.loads(bytes(out).decode())
+    assert body == {"k": False}  # 'f' < 't' so greedy-min picks false
+    # ids past the byte range are always banned
+    assert not m.allowed(m.start)[300]
+
+
+def test_token_lifter_row_cache_bounded():
+    from dynamo_tpu.guided import token_mask
+
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex("a{500}"))  # long literal chain of states
+    s = m.start
+    for _ in range(400):
+        assert m.allowed(s)[ord("a")]
+        s = m.advance(s, ord("a"))
+    assert len(m._rows) <= token_mask._ROW_CACHE_MAX
+
+
+def test_token_lifter_eos_only_when_accepting():
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex("ab"))
+    assert not m.allowed(m.start)[tok.eos_id]
+    s = m.advance(m.start, ord("a"))
+    s = m.advance(s, ord("b"))
+    mask = m.allowed(s)
+    assert mask[tok.eos_id] and mask.sum() == 1  # nothing but EOS
+
+
+# -- preprocessor spec mapping ----------------------------------------------
+
+
+def _prep():
+    from dynamo_tpu.frontend.preprocessor import Preprocessor
+    from dynamo_tpu.frontend.protocols import ModelCard
+
+    return Preprocessor(ModelCard(name="m", tokenizer="byte"))
+
+
+TOOLS = [{
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+            "additionalProperties": False,
+        },
+    },
+}]
+
+
+def test_preprocessor_tool_choice_required():
+    out = _prep().preprocess_chat({
+        "messages": [{"role": "user", "content": "hi"}],
+        "tools": TOOLS, "tool_choice": "required",
+    })
+    spec = out["guided"]
+    assert spec["kind"] == "regex"
+    d = compile_regex(spec["pattern"])
+    assert d.matches(
+        b'<tool_call>{"name": "get_weather", "arguments": {"city": "x"}}'
+        b"</tool_call>"
+    )
+    assert not d.matches(b"plain text")
+
+
+def test_preprocessor_response_format_json_schema():
+    schema = {"type": "object", "properties": {"ok": {"type": "boolean"}},
+              "required": ["ok"], "additionalProperties": False}
+    out = _prep().preprocess_chat({
+        "messages": [{"role": "user", "content": "hi"}],
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"name": "t", "schema": schema}},
+    })
+    d = compile_regex(out["guided"]["pattern"])
+    assert d.matches(b'{"ok": true}') and not d.matches(b"yes")
+
+
+def test_preprocessor_guided_choice_and_none():
+    p = _prep()
+    out = p.preprocess_completions({"prompt": "q: ", "guided_choice": ["yes", "no"]})
+    d = compile_regex(out["guided"]["pattern"])
+    assert d.matches(b"yes") and d.matches(b"no") and not d.matches(b"maybe")
+    assert "guided" not in p.preprocess_completions({"prompt": "q"})
+    # tool_choice none strips tools from the prompt
+    out2 = p.preprocess_chat({
+        "messages": [{"role": "user", "content": "hi"}],
+        "tools": TOOLS, "tool_choice": "none",
+    })
+    assert "guided" not in out2 and "tools" not in out2["annotations"]
+
+
+# -- engine e2e (tiny model, CPU) -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def guided_engine():
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    runner = ModelRunner(
+        get_config("tiny"),
+        num_pages=64,
+        page_size=4,
+        max_pages_per_seq=16,
+        decode_buckets=(1, 2, 4, 8),
+        prefill_buckets=(8, 16, 32),
+    )
+    engine = InferenceEngine(runner, max_batch=8, chunk_size=16,
+                             tokenizer_spec="byte")
+    engine.start()
+    yield engine
+    engine.stop()
+
+
+async def _run(engine, req):
+    from dynamo_tpu.runtime.context import Context
+
+    toks, finish = [], None
+    async for item in engine.generate(req, Context()):
+        toks.extend(item["token_ids"])
+        if item["finish_reason"]:
+            finish = item["finish_reason"]
+    return toks, finish
+
+
+def _greq(prompt, guided, max_tokens=64, temperature=1.0, seed=7):
+    return {
+        "token_ids": prompt,
+        "sampling": {"temperature": temperature, "seed": seed},
+        "stop": {"max_tokens": max_tokens, "stop_ids": [257]},
+        "guided": guided,
+    }
+
+
+async def test_engine_guided_regex(guided_engine):
+    toks, finish = await _run(
+        guided_engine,
+        _greq([10, 11, 12], {"kind": "regex", "pattern": r"(yes|no) sir!"}),
+    )
+    text = bytes(t for t in toks if t < 256).decode()
+    assert text in ("yes sir!", "no sir!")
+    assert finish == "stop"
+
+
+async def test_engine_guided_json_schema(guided_engine):
+    schema = {
+        "type": "object",
+        "properties": {"flag": {"type": "boolean"},
+                       "n": {"type": "integer"}},
+        "required": ["flag", "n"],
+        "additionalProperties": False,
+    }
+    toks, finish = await _run(
+        guided_engine,
+        _greq([3, 4, 5],
+              {"kind": "regex", "pattern": schema_to_regex(schema)},
+              max_tokens=96),
+    )
+    text = bytes(t for t in toks if t < 256).decode()
+    if finish == "length":
+        pytest.skip(f"integer tail unbounded and budget hit: {text!r}")
+    body = json.loads(text)
+    assert isinstance(body["flag"], bool) and isinstance(body["n"], int)
+    assert finish == "stop"
+
+
+async def test_engine_guided_batch_mixed(guided_engine):
+    """Constrained and free sequences co-batch correctly."""
+    g = _run(
+        guided_engine,
+        _greq([20, 21], {"kind": "regex", "pattern": "[ab]{3}"}),
+    )
+    free = _run(guided_engine, _greq([30, 31], None, max_tokens=6))
+    (gt, gf), (ft, ff) = await asyncio.gather(g, free)
+    text = bytes(t for t in gt if t < 256).decode()
+    assert len(text) == 3 and set(text) <= {"a", "b"}
+    assert gf == "stop" and len(ft) == 6
+
+
+async def test_engine_guided_structural(guided_engine):
+    spec = {
+        "kind": "structural",
+        "triggers": ["<f>"],
+        "structures": [{"begin": "<f>", "pattern": "(on|off)", "end": "</f>"}],
+    }
+    toks, _ = await _run(
+        guided_engine, _greq([40, 41, 42], spec, max_tokens=24)
+    )
+    text = bytes(t for t in toks if t < 256).decode(errors="replace")
+    # free text is unconstrained, but any opened structure must be valid
+    if "<f>" in text:
+        rest = text.split("<f>", 1)[1]
+        assert rest.startswith(("on", "off")) and "</f>" in rest
+
+
+async def test_engine_rejects_never_fitting_prompt(guided_engine):
+    """A prompt needing more KV pages than the pool holds must error
+    immediately, not wait forever (and head-of-line-block the queue).
+    Found live in round-4 /verify: tools prompts through the byte
+    tokenizer exceeded a small worker's pool and the request hung."""
+    cap = guided_engine.pool.num_pages * guided_engine.pool.page_size
+    toks, finish = [], None
+    from dynamo_tpu.runtime.context import Context
+
+    items = []
+    async for item in guided_engine.generate(
+        _greq(list(range(1, 2)) * (cap + 8), None, max_tokens=4), Context()
+    ):
+        items.append(item)
+    assert items[-1]["finish_reason"] == "error"
+    assert "KV capacity" in items[-1]["error"]
+
+
+async def test_engine_guided_bad_spec_errors(guided_engine):
+    from dynamo_tpu.runtime.context import Context
+
+    items = []
+    async for item in guided_engine.generate(
+        _greq([1, 2], {"kind": "regex", "pattern": "(unclosed"}), Context()
+    ):
+        items.append(item)
+    assert items[-1]["finish_reason"] == "error"
+    assert "guided" in items[-1]["error"]
