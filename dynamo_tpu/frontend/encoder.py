@@ -16,8 +16,10 @@ Wire contract:
 
 from __future__ import annotations
 
+import hashlib
 import logging
-from typing import Any, AsyncIterator, Dict, List
+from collections import OrderedDict
+from typing import Any, AsyncIterator, Dict, List, Optional
 
 import numpy as np
 
@@ -26,20 +28,76 @@ log = logging.getLogger("dynamo_tpu.frontend.encoder")
 ENCODE_ENDPOINT = "encoder/encode"  # {namespace}/encoder/encode
 
 
+class EmbeddingCache:
+    """Content-addressed host-side cache of vision-encoder outputs
+    (reference docs/benchmarks/embedding_cache.md:30-58 — its best
+    published win: +29.8% RPS, -87.4% TTFT p50 on repeated images).
+    Keyed per IMAGE (blake2b of the encoded bytes), so requests sharing
+    any subset of images hit for that subset. LRU-bounded by bytes."""
+
+    def __init__(self, cap_bytes: int = 256 << 20):
+        self.cap_bytes = cap_bytes
+        self._d: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(blob: bytes) -> bytes:
+        return hashlib.blake2b(blob, digest_size=16).digest()
+
+    def get(self, key: bytes) -> Optional[np.ndarray]:
+        hit = self._d.get(key)
+        if hit is None:
+            self.misses += 1
+            return None
+        self._d.move_to_end(key)
+        self.hits += 1
+        return hit
+
+    def put(self, key: bytes, embed: np.ndarray) -> None:
+        if key in self._d:
+            return
+        # always copy: rows of the batched hop result are views pinning
+        # the whole response buffer — caching a view would make eviction
+        # free nothing while the byte accounting claims otherwise
+        embed = np.array(embed, copy=True)
+        embed.setflags(write=False)
+        self._d[key] = embed
+        self.bytes += embed.nbytes
+        while self.bytes > self.cap_bytes and len(self._d) > 1:
+            _, old = self._d.popitem(last=False)
+            self.bytes -= old.nbytes
+
+
 class EncoderOperator:
     """Pipeline stage: requests with `images` call the encoder component
     (EncoderRouter = round-robin over discovered encoder instances), map
     the returned embeddings onto the prompt's image-placeholder positions,
-    and forward with the `mm` payload."""
+    and forward with the `mm` payload. A content-addressed embedding
+    cache short-circuits the encode hop for repeated images."""
 
-    def __init__(self, runtime, card, inner, namespace: str = "dyn"):
+    def __init__(self, runtime, card, inner, namespace: str = "dyn",
+                 cache_bytes: int = 256 << 20):
         self.runtime = runtime
         self.card = card
         self.inner = inner
         self.namespace = namespace
         self._client = None
+        self.cache = EmbeddingCache(cache_bytes) if cache_bytes > 0 else None
+        m = getattr(runtime, "metrics", None)
+        self._hits_c = self._miss_c = None
+        if m is not None:
+            self._hits_c = m.counter(
+                "mm_embed_cache_hits_total", "embedding cache hits",
+                model=card.name,
+            )
+            self._miss_c = m.counter(
+                "mm_embed_cache_misses_total", "embedding cache misses",
+                model=card.name,
+            )
 
-    async def _encode(self, images: List[bytes]) -> np.ndarray:
+    async def _encode_hop(self, images: List[bytes]) -> np.ndarray:
         if self._client is None:
             self._client = self.runtime.client(f"{self.namespace}/{ENCODE_ENDPOINT}")
             await self._client.start()
@@ -48,6 +106,30 @@ class EncoderOperator:
             e = item["embeds"]
             return np.frombuffer(e["data"], dtype=np.dtype(e["dtype"])).reshape(e["shape"])
         raise RuntimeError("encoder returned no embeddings")
+
+    async def _encode(self, images: List[bytes]) -> np.ndarray:
+        """[n_img, T_img, E] embeddings, encoding only cache misses (one
+        batched hop for all missing images, in request order)."""
+        if self.cache is None:
+            return await self._encode_hop(images)
+        keys = [self.cache.key(b) for b in images]
+        found: Dict[int, np.ndarray] = {}
+        miss_idx = []
+        for i, k in enumerate(keys):
+            hit = self.cache.get(k)
+            if hit is not None:
+                found[i] = hit
+            else:
+                miss_idx.append(i)
+        if self._hits_c is not None:
+            self._hits_c.inc(len(found))
+            self._miss_c.inc(len(miss_idx))
+        if miss_idx:
+            fresh = await self._encode_hop([images[i] for i in miss_idx])
+            for j, i in enumerate(miss_idx):
+                found[i] = fresh[j]
+                self.cache.put(keys[i], fresh[j])
+        return np.stack([found[i] for i in range(len(images))])
 
     async def generate(self, request: Dict[str, Any], context) -> AsyncIterator[Any]:
         images = request.get("images")

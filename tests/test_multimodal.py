@@ -97,6 +97,76 @@ async def test_injection_changes_output_and_cache_isolated():
         engine.stop()
 
 
+def test_embedding_cache_lru_and_partial_hits():
+    from dynamo_tpu.frontend.encoder import EmbeddingCache
+
+    e = np.ones((4, 8), np.float32)
+    c = EmbeddingCache(cap_bytes=3 * e.nbytes)
+    k = [EmbeddingCache.key(bytes([i])) for i in range(5)]
+    for i in range(3):
+        c.put(k[i], e * i)
+    assert c.get(k[0]) is not None  # refresh 0
+    c.put(k[3], e * 3)  # evicts LRU (1)
+    assert c.get(k[1]) is None and c.get(k[0]) is not None
+    assert c.bytes <= c.cap_bytes
+    assert c.hits == 2 and c.misses == 1
+
+
+async def test_embedding_cache_skips_encode_hop():
+    """Repeated images must NOT re-run the encoder (the reference's
+    embedding-cache win, docs/benchmarks/embedding_cache.md:30-58); a
+    request mixing one cached and one new image encodes only the new one."""
+    from dynamo_tpu.frontend.encoder import EncoderOperator
+    from dynamo_tpu.frontend.protocols import ModelCard
+
+    calls = []
+
+    class _Sink:
+        async def generate(self, request, context):
+            yield {"token_ids": [1], "finish_reason": "stop",
+                   "mm": request.get("mm")}
+
+    card = ModelCard(name="m", vision={"image_token_id": IMG_ID,
+                                       "n_image_tokens": 2})
+    op = EncoderOperator(runtime=None, card=card, inner=_Sink())
+
+    async def fake_hop(images):
+        calls.append(len(images))
+        out = np.zeros((len(images), 2, 4), np.float32)
+        for i, b in enumerate(images):
+            out[i] = np.frombuffer(
+                EmbeddingCacheKeyPad(b), np.uint8
+            )[:8].reshape(2, 4)
+        return out
+
+    def EmbeddingCacheKeyPad(b):
+        return (b * 8)[:8]
+
+    op._encode_hop = fake_hop
+
+    async def run(images, n_img_tokens):
+        req = {"token_ids": [7] + [IMG_ID] * n_img_tokens, "images": images}
+        out = []
+        async for item in op.generate(req, Context()):
+            out.append(item)
+        return out[-1]["mm"]
+
+    a, b = b"image-a!", b"image-b!"
+    mm1 = await run([a], 2)
+    assert calls == [1]
+    mm2 = await run([a], 2)  # full hit: no encoder call
+    assert calls == [1]
+    assert mm1["data"] == mm2["data"]
+    mm3 = await run([a, b], 4)  # partial: only b encodes
+    assert calls == [1, 1]
+    assert op.cache.hits == 2 and op.cache.misses == 2
+    # per-image embeddings keep request order on the mixed path
+    flat = np.frombuffer(mm3["data"], np.float32).reshape(4, 4)
+    np.testing.assert_array_equal(
+        flat[:2], np.frombuffer(mm1["data"], np.float32).reshape(2, 4)
+    )
+
+
 async def test_epd_flow_through_frontend():
     """chat request with a data-URL image → encoder worker → mm payload →
     LLM worker; deterministic per image, different across images."""
