@@ -115,13 +115,8 @@ class ModelWatcher:
         # chain_factory(entry_args...) -> AsyncEngine; overridable (kv router)
         self._chain_factory = chain_factory or self._default_chain
 
-    def _default_chain(self, card: ModelCard, client: EndpointClient, pre: Preprocessor):
-        """Returns (chain, teardown|None, prefill_router). Order mirrors the
-        reference pipeline: Migration → Backend(detok) → PrefillRouter →
-        router egress (entrypoint/input/common.rs:498-519)."""
-        from dynamo_tpu.router.prefill_router import DisaggPolicy, PrefillRouter
-
-        teardown = None
+    def _build_sink(self, card: ModelCard, client: EndpointClient):
+        """Router egress engine per router_mode. Returns (sink, teardown)."""
         if self.router_mode == "kv":
             from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
 
@@ -130,9 +125,8 @@ class ModelWatcher:
                 replica_sync=self.router_replica_sync,
                 admission=self.admission_config,
             )
-            router_engine: AsyncEngine = KvPushRouter(kv_router)
-            teardown = kv_router.stop
-        elif self.router_mode == "kv-remote":
+            return KvPushRouter(kv_router), kv_router.stop
+        if self.router_mode == "kv-remote":
             # selection lives in a standalone KvRouterService
             # (router/services.py); this frontend only pushes streams
             from dynamo_tpu.router.services import (
@@ -143,27 +137,54 @@ class ModelWatcher:
             ns = client.path.split("/")[0]
             base = self.router_service or f"{ns}/{SELECTION_COMPONENT}"
             remote = RemoteKvRouter(self.runtime, client, base)
-            router_engine = remote
-            teardown = remote.close
-        else:
-            router_engine = _ClientEngine(client)
-        if self.affinity is not None:
-            from dynamo_tpu.frontend.session_affinity import SessionAffinityEngine
+            return remote, remote.close
+        return _ClientEngine(client), None
 
-            router_engine = SessionAffinityEngine(router_engine, client, self.affinity)
-        prefill_router = PrefillRouter(
-            router_engine,
-            DisaggPolicy(min_prefill_tokens=self.disagg_min_prefill_tokens),
-        )
-        backend = BackendOperator(pre.tokenizer, prefill_router)
-        chain: AsyncEngine = Migration(backend, migration_limit=self.migration_limit)
-        if card.vision:
+    def _stage_specs(self, card: ModelCard, client: EndpointClient,
+                     pre: Preprocessor):
+        """The standard operator chain, head-first (reference pipeline
+        order, entrypoint/input/common.rs:498-519). Adding an operator =
+        adding one StageSpec here; conditions are per-model data."""
+        from dynamo_tpu.router.prefill_router import DisaggPolicy, PrefillRouter
+        from dynamo_tpu.runtime.pipeline import StageSpec
+
+        def _encoder(inner, ctx):
             from dynamo_tpu.frontend.encoder import EncoderOperator
 
             # encode endpoint lives in the worker's namespace
             ns = client.path.split("/")[0]
-            chain = EncoderOperator(self.runtime, card, chain, namespace=ns)
-        return chain, teardown, prefill_router
+            return EncoderOperator(self.runtime, card, inner, namespace=ns)
+
+        def _affinity(inner, ctx):
+            from dynamo_tpu.frontend.session_affinity import SessionAffinityEngine
+
+            return SessionAffinityEngine(inner, client, self.affinity)
+
+        return [
+            StageSpec("encoder", _encoder, enabled=lambda ctx: bool(card.vision)),
+            StageSpec("migration", lambda inner, ctx: Migration(
+                inner, migration_limit=self.migration_limit)),
+            StageSpec("backend", lambda inner, ctx: BackendOperator(
+                pre.tokenizer, inner)),
+            StageSpec("prefill_router", lambda inner, ctx: PrefillRouter(
+                inner,
+                DisaggPolicy(min_prefill_tokens=self.disagg_min_prefill_tokens),
+            )),
+            StageSpec("session_affinity", _affinity,
+                      enabled=lambda ctx: self.affinity is not None),
+        ]
+
+    def _default_chain(self, card: ModelCard, client: EndpointClient, pre: Preprocessor):
+        """Returns (chain, teardown|None, prefill_router): the stage specs
+        folded onto the router egress (runtime/pipeline.py)."""
+        from dynamo_tpu.runtime.pipeline import build_chain
+
+        sink, sink_teardown = self._build_sink(card, client)
+        chain = build_chain(
+            self._stage_specs(card, client, pre), sink, self,
+            sink_teardown=sink_teardown,
+        )
+        return chain, chain.teardown, chain.get("prefill_router")
 
     async def start(self) -> None:
         if self._task is None:

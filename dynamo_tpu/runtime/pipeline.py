@@ -1,0 +1,92 @@
+"""Generic pipeline graph: declarative operator chains over AsyncEngines.
+
+Analog of the reference's Source/Operator/Sink pipeline nodes
+(lib/runtime/src/pipeline.rs:8-29 and the linking at
+entrypoint/input/common.rs:498-519). In this framework every pipeline
+stage is an AsyncEngine wrapping an inner AsyncEngine, so a chain is
+fully described by an ordered list of *stage specs*: (name, condition,
+factory). `build_chain` folds them right-to-left onto a sink engine and
+returns a `Chain` that serves from the head, exposes the built stages by
+name (the frontend needs e.g. the PrefillRouter to activate/deactivate
+it on discovery events), and tears them down in build order.
+
+This replaces hand-splicing each new operator into the frontend's chain
+assembly: a new operator is one list entry with its enabling condition,
+and per-model variation (vision → encoder stage, affinity configured →
+affinity stage) is data, not control flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+
+@dataclass
+class StageSpec:
+    """One prospective operator in a chain.
+
+    factory(inner, ctx) -> AsyncEngine — wraps the downstream engine.
+    enabled(ctx) -> bool — stage is skipped entirely when False.
+    teardown(built) -> Optional[awaitable-factory] — how to close the
+    built stage; default looks for `.stop`/`.close` on the instance.
+    """
+
+    name: str
+    factory: Callable[[AsyncEngine, Any], AsyncEngine]
+    enabled: Callable[[Any], bool] = lambda ctx: True
+
+
+class Chain(AsyncEngine):
+    """A built operator chain. `generate` enters at the head (first
+    enabled stage); `stages` maps name → built engine for the operators
+    that were enabled."""
+
+    def __init__(self, head: AsyncEngine, stages: Dict[str, AsyncEngine],
+                 order: List[str], extra_teardown: Any = None,
+                 sink: Optional[AsyncEngine] = None):
+        self.head = head
+        self.stages = stages
+        self.order = order  # head-first stage names (diagnostics)
+        self.sink = sink  # the egress engine the specs folded onto
+        self._extra_teardown = extra_teardown
+
+    async def generate(self, request: Any, context: Any) -> AsyncIterator[Any]:
+        async for item in self.head.generate(request, context):
+            yield item
+
+    def get(self, name: str) -> Optional[AsyncEngine]:
+        return self.stages.get(name)
+
+    async def teardown(self) -> None:
+        """Close stages head-first (upstream stops feeding downstream),
+        then the sink's teardown. A stage participates by exposing
+        `stop` or `close` (async)."""
+        for name in self.order:
+            stage = self.stages[name]
+            closer = getattr(stage, "stop", None) or getattr(stage, "close", None)
+            if closer is not None:
+                await closer()
+        if self._extra_teardown is not None:
+            await self._extra_teardown()
+
+
+def build_chain(specs: List[StageSpec], sink: AsyncEngine, ctx: Any,
+                sink_teardown: Any = None) -> Chain:
+    """Fold stage specs (listed head-first) onto `sink`.
+
+    specs[0] is the outermost operator (sees requests first); `sink` is
+    the egress (typically the router/push engine); `sink_teardown` is an
+    async callable closing sink-owned resources, run last."""
+    built: Dict[str, AsyncEngine] = {}
+    order: List[str] = []
+    inner = sink
+    for spec in reversed(specs):
+        if not spec.enabled(ctx):
+            continue
+        inner = spec.factory(inner, ctx)
+        built[spec.name] = inner
+        order.insert(0, spec.name)
+    return Chain(inner, built, order, extra_teardown=sink_teardown, sink=sink)

@@ -1,0 +1,101 @@
+"""Generic pipeline operator graph (runtime/pipeline.py): declarative
+chain assembly, conditional stages, named lookup, teardown order
+(reference lib/runtime/src/pipeline.rs:8-29 Source/Operator/Sink)."""
+
+import pytest
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.pipeline import Chain, StageSpec, build_chain
+
+
+class _Tag:
+    """Operator that tags items with its name (records traversal order)."""
+
+    def __init__(self, name, inner):
+        self.name = name
+        self.inner = inner
+        self.closed = False
+
+    async def generate(self, request, context):
+        async for item in self.inner.generate(request, context):
+            item["path"].append(self.name)
+            yield item
+
+    async def close(self):
+        self.closed = True
+
+
+class _Sink:
+    async def generate(self, request, context):
+        yield {"path": ["sink"], "request": request}
+
+
+def _spec(name, enabled=True):
+    return StageSpec(
+        name, lambda inner, ctx: _Tag(name, inner),
+        enabled=lambda ctx: enabled,
+    )
+
+
+async def test_chain_order_and_conditionals():
+    chain = build_chain(
+        [_spec("a"), _spec("b", enabled=False), _spec("c")], _Sink(), ctx=None
+    )
+    assert chain.order == ["a", "c"]
+    assert chain.get("b") is None and chain.get("a") is not None
+    out = []
+    async for item in chain.generate({}, Context()):
+        out.append(item)
+    # items flow sink → c → a (response path), so tags append inner-first
+    assert out[0]["path"] == ["sink", "c", "a"]
+
+
+async def test_chain_teardown_head_first_then_sink():
+    closed = []
+
+    async def sink_td():
+        closed.append("sink")
+
+    chain = build_chain([_spec("a"), _spec("b")], _Sink(), None,
+                        sink_teardown=sink_td)
+    # monkey-patch stage closers to record order
+    for name in chain.order:
+        stage = chain.get(name)
+
+        async def _close(n=name):
+            closed.append(n)
+
+        stage.close = _close
+    await chain.teardown()
+    assert closed == ["a", "b", "sink"]
+
+
+async def test_watcher_default_chain_uses_pipeline(tmp_path):
+    """The frontend's standard chain is assembled from stage specs: the
+    structural order is data, and the prefill_router is reachable by name."""
+    from dynamo_tpu.frontend.preprocessor import Preprocessor
+    from dynamo_tpu.frontend.protocols import ModelCard
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="pl"),
+                            event_transport="inproc")
+    try:
+        watcher = ModelWatcher(rt, ModelManager(), session_affinity_ttl=5)
+        card = ModelCard(name="m")
+        client = rt.client("ns/comp/ep")
+        pre = Preprocessor(card)
+        chain, teardown, prefill = watcher._chain_factory(card, client, pre)
+        assert chain.order == [
+            "migration", "backend", "prefill_router", "session_affinity"
+        ]
+        assert prefill is chain.get("prefill_router")
+        vision_card = ModelCard(name="v", vision={"image_token_id": 1,
+                                                  "n_image_tokens": 2})
+        vchain, _, _ = watcher._chain_factory(vision_card, client, pre)
+        assert vchain.order[0] == "encoder"
+        await teardown()
+        await watcher.stop()
+    finally:
+        await rt.shutdown(drain_timeout=1)

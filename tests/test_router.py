@@ -189,7 +189,7 @@ async def test_kv_router_e2e_prefix_affinity():
     try:
         entry = svc.manager.get("mock-model")
         # Migration→Backend→PrefillRouter→KvPushRouter
-        kv_router = entry.chain.downstream.downstream.downstream.router
+        kv_router = entry.chain.sink.router
         await kv_router.start()
         while len(kv_router.workers()) < 2:
             await asyncio.sleep(0.02)
@@ -229,7 +229,7 @@ async def test_kv_router_e2e_load_spreads_distinct_prompts():
     workers, frt, svc, base = await _mock_stack(realm="router-e2e-2")
     try:
         entry = svc.manager.get("mock-model")
-        kv_router = entry.chain.downstream.downstream.downstream.router
+        kv_router = entry.chain.sink.router
         await kv_router.start()
         while len(kv_router.workers()) < 2:
             await asyncio.sleep(0.02)

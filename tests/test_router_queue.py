@@ -138,7 +138,7 @@ async def test_router_admission_queue_e2e():
             a = asyncio.create_task(req("a" * 16, 25))
             await asyncio.sleep(0.25)
             entry = svc.manager.get("mock-model")
-            kv_router = entry.chain.downstream.downstream.downstream.router
+            kv_router = entry.chain.sink.router
             assert kv_router.admission.saturated(), "one in-flight must saturate"
 
             # B and C queue (depth 2)
