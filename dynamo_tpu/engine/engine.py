@@ -37,6 +37,7 @@ from dynamo_tpu.engine.scheduler import (
     SeqState,
 )
 from dynamo_tpu.frontend.protocols import engine_output
+from dynamo_tpu.runtime.annotations import annotate
 from dynamo_tpu.runtime.context import Context
 
 log = logging.getLogger("dynamo_tpu.engine")
@@ -722,6 +723,10 @@ class InferenceEngine:
                 "offsets": list(offs)}
 
     def _run_prefill(self, plan: PrefillPlan) -> None:
+        with annotate("engine.prefill", tokens=len(plan.chunk)):
+            self._run_prefill_inner(plan)
+
+    def _run_prefill_inner(self, plan: PrefillPlan) -> None:
         seq = plan.seq
         mm_chunk = self._mm_chunk(seq, plan.start_pos, len(plan.chunk))
         logits = self.runner.prefill(
@@ -797,6 +802,11 @@ class InferenceEngine:
         )
 
     def _run_decode(self, plan: DecodePlan) -> None:
+        with annotate("engine.decode", batch=len(plan.seqs),
+                      steps=plan.n_steps):
+            self._run_decode_inner(plan)
+
+    def _run_decode_inner(self, plan: DecodePlan) -> None:
         """Fused multi-step decode: plan.n_steps iterations in one jit with
         on-device token feedback (one host sync per plan, not per token).
         Tokens sampled past a stop are discarded host-side."""

@@ -25,9 +25,11 @@ def parse_args(argv=None):
     p.add_argument(
         "--router-mode",
         default="round_robin",
-        choices=["round_robin", "random", "p2c", "least_loaded", "kv", "kv-remote"],
-        help="worker selection policy (kv = embedded KV-cache-aware "
-             "router; kv-remote = delegate to a standalone "
+        choices=["round_robin", "random", "p2c", "least_loaded",
+                 "device_aware", "kv", "kv-remote"],
+        help="worker selection policy (device_aware = weighted by each "
+             "worker's published slice capacity over load; kv = embedded "
+             "KV-cache-aware router; kv-remote = delegate to a standalone "
              "dynamo_tpu.router.services selection service)",
     )
     p.add_argument("--router-service", default=None,

@@ -231,6 +231,10 @@ class EndpointClient:
                 if ev.kind == "put":
                     self.instances[inst.instance_id] = inst
                     self.router.update_instance(inst.instance_id, inst.address)
+                    self.router.update_weight(
+                        inst.instance_id,
+                        (inst.metadata or {}).get("device_weight"),
+                    )
                     self._ready.set()
                 else:
                     self.instances.pop(inst.instance_id, None)

@@ -494,6 +494,11 @@ class RouterMode:
     KV = "kv"  # handled one level up by KvPushRouter
     P2C = "p2c"  # power-of-two-choices by load
     LEAST_LOADED = "least_loaded"
+    # weighted by published device capacity over current load (reference
+    # push_router.rs:193 DeviceAwareWeighted); on TPU the natural weight is
+    # the worker's slice size (chips spanned), published as instance
+    # metadata `device_weight`
+    DEVICE_AWARE = "device_aware"
 
 
 class PushRouter:
@@ -516,14 +521,24 @@ class PushRouter:
         self._rr = 0
         self._inflight: Dict[int, int] = {}  # instance_id -> outstanding reqs
         self._ext_load: Dict[int, float] = {}  # worker-published load
+        self._weights: Dict[int, float] = {}  # published device capacity
 
     def update_instance(self, instance_id: int, address: Optional[str]) -> None:
         if address is None:
             self._instances.pop(instance_id, None)
             self._inflight.pop(instance_id, None)
             self._ext_load.pop(instance_id, None)
+            self._weights.pop(instance_id, None)
         else:
             self._instances[instance_id] = address
+
+    def update_weight(self, instance_id: int, weight: Optional[float]) -> None:
+        """Feed a published device-capacity weight (metadata
+        `device_weight`; None clears → default 1.0)."""
+        if weight is None:
+            self._weights.pop(instance_id, None)
+        else:
+            self._weights[instance_id] = max(0.0, float(weight))
 
     def update_load(self, instance_id: int, load: Optional[float]) -> None:
         """Feed a worker-published load value (None clears it, falling back
@@ -577,6 +592,27 @@ class PushRouter:
             load = self._load_key(ids)
             a, b = random.choice(ids), random.choice(ids)
             iid = a if load(a) <= load(b) else b
+        elif self.mode == RouterMode.DEVICE_AWARE:
+            # weighted draw by capacity / (1 + load): a worker spanning a
+            # 4-chip slice absorbs ~4x a single-chip worker's share when
+            # idle, degrading toward load-balance as queues build. Workers
+            # that published no weight count as capacity 1.0.
+            load = self._load_key(ids)
+            ws = [
+                self._weights.get(i, 1.0) / (1.0 + max(0.0, float(load(i))))
+                for i in ids
+            ]
+            total = sum(ws)
+            if total <= 0.0:
+                iid = random.choice(ids)
+            else:
+                r = random.random() * total
+                iid = ids[-1]
+                for i, w in zip(ids, ws):
+                    r -= w
+                    if r <= 0.0:
+                        iid = i
+                        break
         elif self.mode == RouterMode.LEAST_LOADED:
             # round-robin tiebreak so equal-load instances share work
             # instead of the lowest id absorbing every burst

@@ -45,6 +45,10 @@ def parse_args(argv=None):
     p.add_argument("--component", default="tpu-worker")
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--tokenizer", default="byte", help="'byte' or path to tokenizer.json")
+    p.add_argument("--profiler-port", type=int, default=0,
+                   help="start the XLA profiler server on this port for "
+                        "TensorBoard capture (0 = off); pair with "
+                        "DYN_ENABLE_JAX_TRACE=1 for engine-phase ranges")
     # parallelism (mesh axes)
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--tensor-parallel", type=int, default=1)
@@ -320,6 +324,10 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
 
 async def async_main(args) -> None:
     configure_logging()
+    if args.profiler_port:
+        from dynamo_tpu.runtime.annotations import start_profiler_server
+
+        start_profiler_server(args.profiler_port)
     kw = {}
     if args.discovery_root:
         kw["root"] = args.discovery_root

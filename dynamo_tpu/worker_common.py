@@ -161,12 +161,20 @@ async def serve_worker(
     dp_rank: int = 0,
     disagg_role: Optional[str] = None,  # None/"both" | "prefill" | "decode"
     disagg_chunk_pages: int = 16,  # P->D pull chunk size (0 = monolithic)
+    device_weight: Optional[float] = None,  # capacity for device_aware
+    #   routing (default: chips this worker's mesh spans)
 ) -> ServedWorker:
     instance_id = new_instance_id()
     LOCAL_ENGINES[instance_id] = engine  # colocated-disagg device transfer
     metadata = {"model_card": card.to_dict(), "dp_rank": dp_rank}
     if disagg_role:
         metadata["disagg_role"] = disagg_role
+    if device_weight is None:
+        mesh = getattr(getattr(engine, "runner", None), "mesh_config", None)
+        if mesh is not None:
+            device_weight = float(mesh.n_devices)
+    if device_weight is not None:
+        metadata["device_weight"] = device_weight
 
     publisher = None
     if publish_kv_events:

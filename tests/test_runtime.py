@@ -398,6 +398,56 @@ def test_p2c_and_least_loaded_prefer_lighter_instance():
     assert {r._pick()[0] for _ in range(4)} == {1, 2}
 
 
+def test_device_aware_weighted_by_capacity_over_load():
+    """DeviceAwareWeighted (reference push_router.rs:193): a worker
+    spanning a 4-chip slice absorbs ~4x an idle single-chip worker's
+    share; load discounts the weight."""
+    from dynamo_tpu.runtime.request_plane import PushRouter
+
+    r = PushRouter("ns/w/gen", RouterMode.DEVICE_AWARE)
+    r.update_instance(1, "127.0.0.1:1")
+    r.update_instance(2, "127.0.0.1:2")
+    r.update_weight(1, 4.0)  # 4-chip slice
+    r.update_weight(2, 1.0)
+    picks = [r._pick()[0] for _ in range(1000)]
+    share = picks.count(1) / 1000
+    assert 0.72 <= share <= 0.88  # expected 0.8
+
+    # heavy load on the big worker flips the preference: 4/(1+7)=0.5 vs 1
+    r.update_load(1, 7.0)
+    r.update_load(2, 0.0)
+    picks = [r._pick()[0] for _ in range(1000)]
+    assert picks.count(2) / 1000 >= 0.55  # expected 2/3
+
+    # unweighted instances default to capacity 1.0; deletes clear weights
+    r.update_instance(1, None)
+    assert r._pick()[0] == 2
+    assert 1 not in r._weights
+
+
+async def test_device_weight_flows_from_worker_metadata():
+    """serve_worker publishes device_weight; EndpointClient feeds it into
+    its PushRouter on discovery."""
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EchoEngine
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="dw"),
+                            event_transport="inproc")
+    try:
+        await rt.serve_endpoint(
+            "dw/w/gen", EchoEngine(), metadata={"device_weight": 8.0}
+        )
+        client = rt.client("dw/w/gen", RouterMode.DEVICE_AWARE)
+        await client.start()
+        await client.wait_ready()
+        (iid,) = client.router.instance_ids
+        assert client.router._weights[iid] == 8.0
+        await client.close()
+    finally:
+        await rt.shutdown(drain_timeout=1)
+
+
 async def test_least_loaded_balances_by_outstanding_requests():
     """With no worker-published load, least_loaded must spread concurrent
     requests by the router's own in-flight counts."""

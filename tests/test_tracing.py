@@ -146,3 +146,24 @@ async def test_migration_attempt_recorded(mem_spans):
         out.append(item)
     root = next(s for s in mem_spans.spans if s.name == "frontend.request")
     assert root.attributes.get("migration.attempts") == 1
+
+
+def test_trace_annotations_gate(monkeypatch):
+    """NVTX-analog ranges (runtime/annotations.py): no-op context when the
+    env gate is off; real jax TraceAnnotation when on."""
+    import contextlib
+
+    from dynamo_tpu.runtime import annotations as ann
+
+    monkeypatch.delenv("DYN_ENABLE_JAX_TRACE", raising=False)
+    ann._enabled.cache_clear()
+    cm = ann.annotate("x", n=1)
+    assert isinstance(cm, contextlib.nullcontext)
+
+    monkeypatch.setenv("DYN_ENABLE_JAX_TRACE", "1")
+    ann._enabled.cache_clear()
+    try:
+        with ann.annotate("engine.decode", batch=2):  # must not raise on CPU
+            pass
+    finally:
+        ann._enabled.cache_clear()
