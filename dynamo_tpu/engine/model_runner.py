@@ -166,12 +166,17 @@ def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray, tp: int = 1) -> Dict[str,
     explicit permute protocol; the metadata below (page geometry + exporter
     tp degree) lets the importer VALIDATE compatibility and fall back to
     local recompute instead of adopting mis-shaped bytes."""
+    out_extra = {}
+    if v.shape != k.shape:
+        # MLA pools are asymmetric: k = latent pages, v = 1-wide stub
+        out_extra["v_shape"] = list(v.shape)
     return {
         "data": True,
         "k": k.tobytes(),
         "v": v.tobytes(),
         "shape": list(k.shape),
         "dtype": str(k.dtype),
+        **out_extra,
         "n_pages": int(k.shape[1]),
         "layout": KV_WIRE_LAYOUT_VERSION,
         # layout handshake metadata: [L, n, PS, Hk, D] geometry, explicit
@@ -229,8 +234,9 @@ def kv_payload_to_arrays(payload: Dict[str, Any], page_shape=None, dtype=None):
     name = payload["dtype"]
     dtype = np.dtype(ml_dtypes.bfloat16) if "bfloat16" in name else np.dtype(name)
     shape = tuple(payload["shape"])
+    v_shape = tuple(payload.get("v_shape") or shape)
     k = np.frombuffer(payload["k"], dtype=dtype).reshape(shape)
-    v = np.frombuffer(payload["v"], dtype=dtype).reshape(shape)
+    v = np.frombuffer(payload["v"], dtype=dtype).reshape(v_shape)
     return k, v
 
 
@@ -876,9 +882,12 @@ class ModelRunner:
     @property
     def kv_page_shape(self) -> Tuple[int, int, int, int]:
         """(L, PS, Hk, D) page geometry of this runner's pools — the local
-        side of the cross-TP layout handshake."""
-        c = self.config
-        return (c.n_layers, self.page_size, c.n_kv_heads, c.head_dim)
+        side of the cross-TP layout handshake. Derived from the ACTUAL
+        k-pool shape, so MLA's latent pool (Hk=1, D=d_c+d_rh) advertises
+        its real geometry instead of a phantom full-head one."""
+        k = self.k_pool["q"] if isinstance(self.k_pool, dict) else self.k_pool
+        L, _, PS, Hk, D = k.shape
+        return (L, PS, Hk, D)
 
     @property
     def kv_wire_dtype(self) -> str:

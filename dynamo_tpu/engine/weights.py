@@ -69,6 +69,8 @@ def load_hf_checkpoint(
         return tensors[name].get_tensor(name).astype(np.float32)
 
     L = config.n_layers
+    if config.is_mla:
+        return _load_mla(config, tensors, get, get_f32, checkpoint_dir)
     first_q = get("model.layers.0.self_attn.q_proj.weight", transpose=True)
     if first_q.shape != (config.dim, config.n_heads * config.head_dim):
         raise ValueError(
@@ -145,22 +147,155 @@ def load_hf_checkpoint(
     return params
 
 
+def _rope_deinterleave(d: int) -> np.ndarray:
+    """Column permutation converting HF DeepSeek's INTERLEAVED rope layout
+    (x0,y0,x1,y1,...) to this module's half-rotation layout (all x then
+    all y). The HF modeling file performs this view-transpose at runtime
+    on q_pe/k_pe every step; folding it into the weights once at load
+    makes the layouts agree with models/llama.py's rope()."""
+    return np.concatenate([np.arange(0, d, 2), np.arange(1, d, 2)])
+
+
+def _load_mla(config: ModelConfig, tensors, get, get_f32,
+              checkpoint_dir: str) -> Dict[str, Any]:
+    """DeepSeek V2/V3 MLA checkpoint → the stacked (layers_dense, layers)
+    trees. HF names: kv_a_proj_with_mqa / kv_a_layernorm / kv_b_proj,
+    q_proj or q_a_proj/q_a_layernorm/q_b_proj, o_proj; MoE layers carry
+    mlp.experts.{e}.* + mlp.shared_experts.* + mlp.gate.weight (+
+    e_score_correction_bias)."""
+    c = config
+    L, kD = c.n_layers, c.n_dense_layers
+    dn, dr, dv, dc = (c.qk_nope_head_dim, c.qk_rope_head_dim,
+                      c.v_head_dim, c.kv_lora_rank)
+    rp = _rope_deinterleave(dr)
+
+    def attn_rows(i: int) -> Dict[str, Any]:
+        pre = f"model.layers.{i}."
+        wkv_a = get(pre + "self_attn.kv_a_proj_with_mqa.weight", True)
+        # de-interleave the k_pe block (last dr output columns)
+        wkv_a[:, dc:] = wkv_a[:, dc:][:, rp]
+        row = {
+            "attn_norm": get_f32(pre + "input_layernorm.weight"),
+            "wkv_a": wkv_a,
+            "kv_norm": get_f32(pre + "self_attn.kv_a_layernorm.weight"),
+            "wkv_b": get(pre + "self_attn.kv_b_proj.weight", True),
+            "wo": get(pre + "self_attn.o_proj.weight", True),
+            "mlp_norm": get_f32(pre + "post_attention_layernorm.weight"),
+        }
+
+        def fix_q(wq: np.ndarray) -> np.ndarray:
+            # per head, de-interleave the rope block [dn:dn+dr]
+            w3 = wq.reshape(wq.shape[0], c.n_heads, dn + dr)
+            w3[:, :, dn:] = w3[:, :, dn:][:, :, rp]
+            return w3.reshape(wq.shape)
+
+        if c.q_lora_rank:
+            row["wq_lat"] = get(pre + "self_attn.q_a_proj.weight", True)
+            row["q_lat_norm"] = get_f32(pre + "self_attn.q_a_layernorm.weight")
+            row["wq_up"] = fix_q(get(pre + "self_attn.q_b_proj.weight", True))
+        else:
+            row["wq"] = fix_q(get(pre + "self_attn.q_proj.weight", True))
+        return row
+
+    def dense_rows(i: int) -> Dict[str, Any]:
+        pre = f"model.layers.{i}.mlp."
+        return {
+            "w_gate": get(pre + "gate_proj.weight", True),
+            "w_up": get(pre + "up_proj.weight", True),
+            "w_down": get(pre + "down_proj.weight", True),
+        }
+
+    def moe_rows(i: int) -> Dict[str, Any]:
+        pre = f"model.layers.{i}.mlp."
+        row = {
+            "w_router": get(pre + "gate.weight", True),
+            "we_gate": np.stack([
+                get(f"{pre}experts.{e}.gate_proj.weight", True)
+                for e in range(c.n_experts)
+            ]),
+            "we_up": np.stack([
+                get(f"{pre}experts.{e}.up_proj.weight", True)
+                for e in range(c.n_experts)
+            ]),
+            "we_down": np.stack([
+                get(f"{pre}experts.{e}.down_proj.weight", True)
+                for e in range(c.n_experts)
+            ]),
+        }
+        if c.moe_router_bias:
+            row["router_bias"] = get_f32(pre + "gate.e_score_correction_bias")
+        if c.n_shared_experts:
+            row["ws_gate"] = get(pre + "shared_experts.gate_proj.weight", True)
+            row["ws_up"] = get(pre + "shared_experts.up_proj.weight", True)
+            row["ws_down"] = get(pre + "shared_experts.down_proj.weight", True)
+        return row
+
+    def stack_rows(rows: list) -> Dict[str, Any]:
+        return {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+
+    moe_layers = [
+        {**attn_rows(i), **(moe_rows(i) if c.is_moe else dense_rows(i))}
+        for i in range(kD, L)
+    ]
+    params: Dict[str, Any] = {
+        "embed": get("model.embed_tokens.weight"),
+        "layers": stack_rows(moe_layers),
+        "norm_f": get_f32("model.norm.weight"),
+    }
+    if kD:
+        params["layers_dense"] = stack_rows(
+            [{**attn_rows(i), **dense_rows(i)} for i in range(kD)]
+        )
+    if "lm_head.weight" in tensors and not c.tie_embeddings:
+        params["lm_head"] = get("lm_head.weight", True)
+    log.info("loaded DeepSeek MLA checkpoint %s", checkpoint_dir)
+    return params
+
+
 def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConfig:
     """Derive a ModelConfig from a HF config.json (llama / qwen2 / qwen3 /
     qwen2_moe / qwen3_moe model types)."""
     cfg = json.loads((Path(checkpoint_dir) / "config.json").read_text())
     mt = cfg.get("model_type", "llama")
+    rope_kw = _rope_scaling_from_hf(cfg)
     if mt.startswith("deepseek"):
-        # DeepSeek checkpoints need MLA attention, leading dense layers
-        # (first_k_dense_replace) and bias-corrected sigmoid routing with
-        # routed_scaling_factor — none of which this loader maps yet.
-        # Refusing beats silently mis-mapping a 600B checkpoint.
-        raise ValueError(
-            f"model_type {mt!r} (MLA) is not supported by this loader; "
-            "supported: llama, qwen2, qwen3, qwen2_moe, qwen3_moe"
+        return ModelConfig(
+            **rope_kw,
+            n_expert_groups=int(cfg.get("n_group") or 0),
+            topk_groups=int(cfg.get("topk_group") or 0),
+            name=name or cfg.get("_name_or_path", "deepseek-hf"),
+            vocab_size=cfg["vocab_size"],
+            dim=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"],
+            n_heads=cfg["num_attention_heads"],
+            n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            ffn_dim=cfg["intermediate_size"],
+            max_seq_len=cfg.get("max_position_embeddings", 8192),
+            rope_theta=float(cfg.get("rope_theta", 10000.0)),
+            norm_eps=float(cfg.get("rms_norm_eps", 1e-6)),
+            tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+            attn_type="mla",
+            kv_lora_rank=int(cfg["kv_lora_rank"]),
+            q_lora_rank=int(cfg.get("q_lora_rank") or 0),
+            qk_rope_head_dim=int(cfg["qk_rope_head_dim"]),
+            qk_nope_head_dim=int(cfg["qk_nope_head_dim"]),
+            v_head_dim=int(cfg["v_head_dim"]),
+            n_experts=int(cfg.get("n_routed_experts") or 0),
+            n_experts_active=int(cfg.get("num_experts_per_tok") or 0),
+            moe_ffn_dim=int(cfg.get("moe_intermediate_size") or 0),
+            n_shared_experts=int(cfg.get("n_shared_experts") or 0),
+            moe_scoring=(
+                "sigmoid" if cfg.get("scoring_func") == "sigmoid" else "softmax"
+            ),
+            moe_norm_topk=bool(cfg.get("norm_topk_prob", True)),
+            # V3's aux-loss-free balancing ships the correction bias
+            moe_router_bias=cfg.get("topk_method") == "noaux_tc",
+            moe_routed_scale=float(cfg.get("routed_scaling_factor") or 1.0),
+            n_dense_layers=int(cfg.get("first_k_dense_replace") or 0),
         )
     n_experts = int(cfg.get("num_experts") or cfg.get("n_routed_experts") or 0)
     return ModelConfig(
+        **rope_kw,
         name=name or cfg.get("_name_or_path", "hf-model"),
         vocab_size=cfg["vocab_size"],
         dim=cfg["hidden_size"],
@@ -188,6 +323,41 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
         # Qwen2-MoE ships norm_topk_prob=false: keep softmax-over-all
         # probabilities un-renormalized (HF semantics)
         moe_norm_topk=bool(cfg.get("norm_topk_prob", True)),
+    )
+
+
+def _rope_scaling_from_hf(cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """HF rope_scaling dict → ModelConfig rope_* kwargs. Unknown scaling
+    types raise — silently ignoring one produces numerically wrong
+    long-context attention."""
+    rs = cfg.get("rope_scaling")
+    if not rs:
+        return {}
+    kind = rs.get("rope_type") or rs.get("type") or ""
+    if kind == "llama3":
+        return {
+            "rope_scaling": "llama3",
+            "rope_factor": float(rs.get("factor", 8.0)),
+            "rope_orig_max_seq": int(
+                rs.get("original_max_position_embeddings") or 8192
+            ),
+            "rope_low_freq_factor": float(rs.get("low_freq_factor", 1.0)),
+            "rope_high_freq_factor": float(rs.get("high_freq_factor", 4.0)),
+        }
+    if kind == "yarn":
+        return {
+            "rope_scaling": "yarn",
+            "rope_factor": float(rs.get("factor", 1.0)),
+            "rope_orig_max_seq": int(
+                rs.get("original_max_position_embeddings") or 4096
+            ),
+            "rope_beta_fast": float(rs.get("beta_fast", 32.0)),
+            "rope_beta_slow": float(rs.get("beta_slow", 1.0)),
+            "rope_mscale": float(rs.get("mscale", 1.0)),
+            "rope_mscale_all_dim": float(rs.get("mscale_all_dim", 0.0)),
+        }
+    raise ValueError(
+        f"unsupported rope_scaling type {kind!r} (supported: llama3, yarn)"
     )
 
 

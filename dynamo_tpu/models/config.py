@@ -50,10 +50,56 @@ class ModelConfig:
     # EP/dense selection never changes results. Operators trade memory for
     # drops by setting e.g. 1.5.
     moe_capacity_factor: float = 0.0
+    # DeepSeek-V3 router fidelity: e_score_correction_bias param present
+    # (aux-loss-free balancing — shifts top-k SELECTION only) and
+    # routed_scaling_factor multiplying the final mixing weights
+    moe_router_bias: bool = False
+    moe_routed_scale: float = 1.0
+    # first k layers use a dense FFN instead of MoE (HF
+    # first_k_dense_replace; DeepSeek-V3 = 3)
+    n_dense_layers: int = 0
+    # DeepSeek-V3 group-limited expert routing (HF n_group/topk_group):
+    # experts partition into n_expert_groups; selection first keeps the
+    # topk_groups best groups (by sum of each group's top-2 scores), then
+    # picks top-k experts within them
+    n_expert_groups: int = 0
+    topk_groups: int = 0
+    # RoPE long-context scaling (HF rope_scaling):
+    #   "llama3" — Llama-3.1+ frequency smoothing (factor, low/high freq)
+    #   "yarn"   — DeepSeek/Qwen yarn (factor, betas, mscale): also scales
+    #              attention scores by mscale(factor)^2
+    rope_scaling: str = "none"
+    rope_factor: float = 1.0
+    rope_orig_max_seq: int = 0  # original_max_position_embeddings
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_mscale: float = 1.0
+    rope_mscale_all_dim: float = 0.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    # MLA — multi-head latent attention (DeepSeek V2/V3/R1; reference
+    # flagship model family, recipes/deepseek-r1). The KV cache stores one
+    # compressed latent + decoupled-RoPE key per token instead of full
+    # K/V heads: cache dim = kv_lora_rank + qk_rope_head_dim (e.g. 576 vs
+    # 128 heads x 2 x 128 = 32768 for V3 — 57x smaller).
+    attn_type: str = "gqa"  # "gqa" | "mla"
+    kv_lora_rank: int = 0  # d_c: KV latent dim
+    q_lora_rank: int = 0  # query compression rank (0 = direct q proj)
+    qk_rope_head_dim: int = 0  # decoupled positional key dim (shared head)
+    qk_nope_head_dim: int = 0  # per-head content key dim
+    v_head_dim: int = 0
 
     @property
     def head_dim(self) -> int:
         return self.head_dim_override or (self.dim // self.n_heads)
+
+    @property
+    def is_mla(self) -> bool:
+        return self.attn_type == "mla"
+
+    @property
+    def mla_cache_dim(self) -> int:
+        return self.kv_lora_rank + self.qk_rope_head_dim
 
     @property
     def is_moe(self) -> bool:
@@ -83,6 +129,25 @@ PRESETS: Dict[str, ModelConfig] = {
         name="tiny-moe-shared", n_experts=4, n_experts_active=2,
         moe_ffn_dim=96, n_shared_experts=1, moe_scoring="sigmoid",
     ),
+    # MLA test models (CPU CI for the DeepSeek attention family)
+    "tiny-mla": ModelConfig(
+        name="tiny-mla", attn_type="mla", kv_lora_rank=32,
+        qk_rope_head_dim=16, qk_nope_head_dim=32, v_head_dim=32,
+    ),
+    "tiny-mla-q": ModelConfig(  # with query compression (V3-style q path)
+        name="tiny-mla-q", attn_type="mla", kv_lora_rank=32, q_lora_rank=48,
+        qk_rope_head_dim=16, qk_nope_head_dim=32, v_head_dim=32,
+    ),
+    # MLA + wide-EP MoE (the deepseek-style-wideep recipe's dryrun model):
+    # full V3 feature set at test size — router selection bias, routed
+    # scale, one leading dense layer
+    "tiny-mla-moe": ModelConfig(
+        name="tiny-mla-moe", n_layers=3, attn_type="mla", kv_lora_rank=32,
+        qk_rope_head_dim=16, qk_nope_head_dim=32, v_head_dim=32,
+        n_experts=4, n_experts_active=2, moe_ffn_dim=96,
+        n_shared_experts=1, moe_scoring="sigmoid",
+        moe_router_bias=True, moe_routed_scale=2.5, n_dense_layers=1,
+    ),
     # Llama 3.2 1B (fits one v5e chip in bf16 with room for KV)
     "llama-3.2-1b": ModelConfig(
         name="llama-3.2-1b",
@@ -95,6 +160,7 @@ PRESETS: Dict[str, ModelConfig] = {
         max_seq_len=131072,
         rope_theta=500000.0,
         tie_embeddings=True,
+        rope_scaling="llama3", rope_factor=32.0, rope_orig_max_seq=8192,
     ),
     # Llama 3.2 3B — single-chip flagship: head_dim 128 (TPU lane-aligned KV
     # tiles), ~6.4GB bf16, fits one v5e chip with a large KV pool
@@ -109,6 +175,7 @@ PRESETS: Dict[str, ModelConfig] = {
         max_seq_len=131072,
         rope_theta=500000.0,
         tie_embeddings=True,
+        rope_scaling="llama3", rope_factor=32.0, rope_orig_max_seq=8192,
     ),
     # Llama 3.1 8B (reference BASELINE config #1 model)
     "llama-3.1-8b": ModelConfig(
@@ -120,6 +187,7 @@ PRESETS: Dict[str, ModelConfig] = {
         n_kv_heads=8,
         ffn_dim=14336,
         max_seq_len=131072,
+        rope_scaling="llama3", rope_factor=8.0, rope_orig_max_seq=8192,
     ),
     # Qwen 2.5 7B (second architecture family: attention biases)
     "qwen2.5-7b": ModelConfig(
@@ -170,6 +238,45 @@ PRESETS: Dict[str, ModelConfig] = {
         n_experts_active=8,
         moe_ffn_dim=768,
     ),
+    # DeepSeek-V3/R1 (671B-A37B): the reference's flagship BASELINE model
+    # (README.md:78, recipes/deepseek-r1 wide-EP). MLA + 256-expert
+    # sigmoid-scored MoE (selection-bias balancing, routed scale 2.5, one
+    # shared expert) with the first 3 layers dense (first_k_dense_replace).
+    "deepseek-v3": ModelConfig(
+        name="deepseek-v3",
+        vocab_size=129280,
+        dim=7168,
+        n_layers=61,
+        n_heads=128,
+        n_kv_heads=128,
+        ffn_dim=18432,
+        max_seq_len=163840,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        attn_type="mla",
+        kv_lora_rank=512,
+        q_lora_rank=1536,
+        qk_rope_head_dim=64,
+        qk_nope_head_dim=128,
+        v_head_dim=128,
+        n_experts=256,
+        n_experts_active=8,
+        moe_ffn_dim=2048,
+        n_shared_experts=1,
+        moe_scoring="sigmoid",
+        moe_router_bias=True,
+        moe_routed_scale=2.5,
+        n_dense_layers=3,
+        n_expert_groups=8,
+        topk_groups=4,
+        rope_scaling="yarn",
+        rope_factor=40.0,
+        rope_orig_max_seq=4096,
+        rope_beta_fast=32.0,
+        rope_beta_slow=1.0,
+        rope_mscale=1.0,
+        rope_mscale_all_dim=1.0,
+    ),
     # Llama 3.1 70B (BASELINE north-star model; TP=8 on v5e)
     "llama-3.1-70b": ModelConfig(
         name="llama-3.1-70b",
@@ -180,6 +287,7 @@ PRESETS: Dict[str, ModelConfig] = {
         n_kv_heads=8,
         ffn_dim=28672,
         max_seq_len=131072,
+        rope_scaling="llama3", rope_factor=8.0, rope_orig_max_seq=8192,
     ),
 }
 

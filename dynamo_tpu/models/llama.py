@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from dynamo_tpu.models.config import ModelConfig
@@ -39,7 +40,50 @@ Params = Dict[str, Any]
 
 def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     """Random-init params (benchmarks / tests; checkpoint loading in
-    engine/weights.py replaces values with the same tree structure)."""
+    engine/weights.py replaces values with the same tree structure).
+
+    MoE models with `n_dense_layers` (DeepSeek first_k_dense_replace) get
+    a SECOND stacked tree `layers_dense` for the leading dense-FFN layers
+    — the forward runs two scans, one compiled body each."""
+    c = config
+    if c.is_moe and c.n_dense_layers:
+        moe_part = _init_layer_stack(
+            c, key, c.n_layers - c.n_dense_layers, moe=True, dtype=dtype
+        )
+        dense_part = _init_layer_stack(
+            c, jax.random.fold_in(key, 1), c.n_dense_layers, moe=False,
+            dtype=dtype,
+        )
+        params = _init_top(c, key, dtype)
+        params["layers"] = moe_part
+        params["layers_dense"] = dense_part
+        return params
+    params = _init_top(c, key, dtype)
+    params["layers"] = _init_layer_stack(
+        c, key, c.n_layers, moe=c.is_moe, dtype=dtype
+    )
+    return params
+
+
+def _init_top(c: ModelConfig, key: jax.Array, dtype) -> Params:
+    k = jax.random.split(key, 15)
+
+    def w(kk, fan_in, *shape):
+        return (jax.random.normal(kk, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": w(k[0], c.dim, c.vocab_size, c.dim),
+        "norm_f": jnp.ones((c.dim,), jnp.float32),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = w(k[9], c.dim, c.dim, c.vocab_size)
+    return params
+
+
+def _init_layer_stack(config: ModelConfig, key: jax.Array, L: int,
+                      moe: bool, dtype) -> Dict[str, Any]:
+    """One stacked per-layer tree covering L layers (attention + either a
+    dense FFN or the MoE block)."""
     c = config
     k = jax.random.split(key, 15)
     hd = c.head_dim
@@ -50,21 +94,38 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
     def w(key, fan_in, *shape):
         return (jax.random.normal(key, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
 
-    L = c.n_layers
-    params: Params = {
-        "embed": w(k[0], c.dim, c.vocab_size, c.dim),
-        "layers": {
+    if c.is_mla:
+        # MLA (DeepSeek V2/V3): KV compressed to a per-token latent +
+        # decoupled-RoPE shared key; q optionally compressed too
+        dn, dr, dv = c.qk_nope_head_dim, c.qk_rope_head_dim, c.v_head_dim
+        attn_p = {
+            "attn_norm": norm_init(L, c.dim),
+            "wkv_a": w(k[2], c.dim, L, c.dim, c.kv_lora_rank + dr),
+            "kv_norm": norm_init(L, c.kv_lora_rank),
+            "wkv_b": w(k[3], c.kv_lora_rank, L, c.kv_lora_rank,
+                       c.n_heads * (dn + dv)),
+            "wo": w(k[4], c.n_heads * dv, L, c.n_heads * dv, c.dim),
+            "mlp_norm": norm_init(L, c.dim),
+        }
+        if c.q_lora_rank:
+            attn_p["wq_lat"] = w(k[1], c.dim, L, c.dim, c.q_lora_rank)
+            attn_p["q_lat_norm"] = norm_init(L, c.q_lora_rank)
+            attn_p["wq_up"] = w(k[10], c.q_lora_rank, L, c.q_lora_rank,
+                                c.n_heads * (dn + dr))
+        else:
+            attn_p["wq"] = w(k[1], c.dim, L, c.dim, c.n_heads * (dn + dr))
+    else:
+        attn_p = {
             "attn_norm": norm_init(L, c.dim),
             "wq": w(k[1], c.dim, L, c.dim, c.n_heads * hd),
             "wk": w(k[2], c.dim, L, c.dim, c.n_kv_heads * hd),
             "wv": w(k[3], c.dim, L, c.dim, c.n_kv_heads * hd),
             "wo": w(k[4], c.n_heads * hd, L, c.n_heads * hd, c.dim),
             "mlp_norm": norm_init(L, c.dim),
-        },
-        "norm_f": norm_init(c.dim),
-    }
+        }
+    layers = attn_p
     if c.attn_bias:  # Qwen2 family: biases on the q/k/v projections
-        params["layers"].update(
+        layers.update(
             {
                 "bq": jnp.zeros((L, c.n_heads * hd), dtype),
                 "bk": jnp.zeros((L, c.n_kv_heads * hd), dtype),
@@ -72,11 +133,11 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
             }
         )
     if c.qk_norm:  # Qwen3 family: per-head RMSNorm on q/k before RoPE
-        params["layers"].update(
+        layers.update(
             {"q_norm": norm_init(L, hd), "k_norm": norm_init(L, hd)}
         )
-    if c.is_moe:
-        params["layers"].update(
+    if moe:
+        layers.update(
             {
                 "w_router": w(k[5], c.dim, L, c.dim, c.n_experts),
                 "we_gate": w(k[6], c.dim, L, c.n_experts, c.dim, c.moe_ffn_dim),
@@ -84,9 +145,11 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
                 "we_down": w(k[8], c.moe_ffn_dim, L, c.n_experts, c.moe_ffn_dim, c.dim),
             }
         )
+        if c.moe_router_bias:  # DeepSeek-V3 e_score_correction_bias
+            layers["router_bias"] = jnp.zeros((L, c.n_experts), jnp.float32)
         if c.n_shared_experts:  # deepseek/qwen2-moe shared experts (fused)
             sf = c.shared_ffn_dim
-            params["layers"].update(
+            layers.update(
                 {
                     "ws_gate": w(k[12], c.dim, L, c.dim, sf),
                     "ws_up": w(k[13], c.dim, L, c.dim, sf),
@@ -94,16 +157,14 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Para
                 }
             )
     else:
-        params["layers"].update(
+        layers.update(
             {
                 "w_gate": w(k[5], c.dim, L, c.dim, c.ffn_dim),
                 "w_up": w(k[6], c.dim, L, c.dim, c.ffn_dim),
                 "w_down": w(k[7], c.ffn_dim, L, c.ffn_dim, c.dim),
             }
         )
-    if not c.tie_embeddings:
-        params["lm_head"] = w(k[9], c.dim, c.dim, c.vocab_size)
-    return params
+    return layers
 
 
 def make_kv_pool(
@@ -125,7 +186,20 @@ def make_kv_pool(
 
     kv_quantize="int8" returns dict pools {"q": int8 [L, NP, PS, Hk, D],
     "s": f32 [L, NP, PS, Hk]} (models/quant.py KV convention — the scale
-    tree aligns with "q" minus the vector dim, no transposes anywhere)."""
+    tree aligns with "q" minus the vector dim, no transposes anywhere).
+
+    MLA models cache ONE latent vector per token ([..., 1, d_c + d_rh] —
+    the whole point of the architecture: V3's cache is 57x smaller than
+    its full-head equivalent). The "k" pool holds the latent; the "v"
+    pool shrinks to a 1-wide placeholder so every page-indexed code path
+    (transfer, tiering, disagg export) keeps its uniform k/v shape
+    contract without meaningful memory."""
+    if config.is_mla:
+        if kv_quantize is not None:
+            raise ValueError("kv_quantize is not supported with MLA yet")
+        lat = (config.n_layers, num_pages, page_size, 1, config.mla_cache_dim)
+        stub = (config.n_layers, num_pages, page_size, 1, 1)
+        return jnp.zeros(lat, dtype=dtype), jnp.zeros(stub, dtype=dtype)
     shape = (config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim)
     if kv_quantize == "int8":
         mk = lambda: {
@@ -149,21 +223,97 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (normed * weight).astype(x.dtype)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def _yarn_mscale(scale: float, mscale: float) -> float:
+    import math
+
+    if scale <= 1.0 or mscale == 0.0:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
+def rope_inv_freq(config: Optional[ModelConfig], hd: int, theta: float):
+    """[hd//2] f32 inverse frequencies with the config's long-context
+    scaling applied (HF rope_scaling semantics):
+    - "llama3": wavelengths past orig_max/low_freq_factor interpolate by
+      1/factor; short ones keep base; a smooth band blends between.
+    - "yarn": NTK-by-parts — per-dim blend of interpolated (1/factor)
+      and base frequencies with a ramp between the beta_fast/beta_slow
+      correction dims (DeepSeek V2/V3 long-context recipe).
+    Computed in numpy (static per compile — positions vary, these don't).
+    """
+    import math
+
+    half = hd // 2
+    base = theta ** -(np.arange(0, half, dtype=np.float64) / half)
+    if config is None or config.rope_scaling == "none":
+        return jnp.asarray(base, jnp.float32)
+    c = config
+    if c.rope_scaling == "llama3":
+        orig = c.rope_orig_max_seq or c.max_seq_len
+        wavelen = 2.0 * math.pi / base
+        low_wl = orig / c.rope_low_freq_factor
+        high_wl = orig / c.rope_high_freq_factor
+        smooth = (orig / wavelen - c.rope_low_freq_factor) / max(
+            c.rope_high_freq_factor - c.rope_low_freq_factor, 1e-9
+        )
+        smooth = np.clip(smooth, 0.0, 1.0)
+        blended = (1 - smooth) * base / c.rope_factor + smooth * base
+        out = np.where(
+            wavelen < high_wl, base,
+            np.where(wavelen > low_wl, base / c.rope_factor, blended),
+        )
+        return jnp.asarray(out, jnp.float32)
+    if c.rope_scaling == "yarn":
+        orig = c.rope_orig_max_seq or c.max_seq_len
+
+        def corr_dim(n_rot: float) -> float:
+            return (hd * math.log(orig / (n_rot * 2 * math.pi))) / (
+                2 * math.log(theta)
+            )
+
+        low = max(math.floor(corr_dim(c.rope_beta_fast)), 0)
+        high = min(math.ceil(corr_dim(c.rope_beta_slow)), hd - 1)
+        ramp = np.clip(
+            (np.arange(half, dtype=np.float64) - low) / max(high - low, 1),
+            0.0, 1.0,
+        )
+        extrap_mask = 1.0 - ramp  # 1 → keep base (high-freq dims)
+        out = (base / c.rope_factor) * (1 - extrap_mask) + base * extrap_mask
+        return jnp.asarray(out, jnp.float32)
+    raise ValueError(f"unknown rope_scaling {c.rope_scaling!r}")
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         config: Optional[ModelConfig] = None) -> jax.Array:
     """HF-Llama half-rotation RoPE. x: [..., S, n_heads, head_dim],
-    positions: [..., S]."""
+    positions: [..., S]. `config` applies its rope_scaling (llama3/yarn
+    frequency remap + yarn's cos/sin magnitude mscale)."""
     hd = x.shape[-1]
     half = hd // 2
-    freqs = jnp.arange(0, half, dtype=jnp.float32) / half
-    inv_freq = theta**-freqs  # [half]
+    inv_freq = rope_inv_freq(config, hd, theta)
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., S, half]
-    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
-    sin = jnp.sin(angles)[..., None, :]
+    m = 1.0
+    if config is not None and config.rope_scaling == "yarn":
+        m = _yarn_mscale(config.rope_factor, config.rope_mscale)
+        if config.rope_mscale_all_dim:
+            m = m / _yarn_mscale(config.rope_factor, config.rope_mscale_all_dim)
+    cos = (jnp.cos(angles) * m)[..., None, :]  # broadcast over heads
+    sin = (jnp.sin(angles) * m)[..., None, :]
     x1, x2 = x[..., :half], x[..., half:]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     return jnp.concatenate(
         [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
     ).astype(x.dtype)
+
+
+def attn_score_scale(config: ModelConfig, qk_dim: int) -> float:
+    """Softmax scale incl. yarn's mscale^2 correction (DeepSeek modeling:
+    softmax_scale = qk_dim^-0.5 * mscale(factor, mscale_all_dim)^2)."""
+    scale = qk_dim ** -0.5
+    if config.rope_scaling == "yarn" and config.rope_mscale_all_dim:
+        m = _yarn_mscale(config.rope_factor, config.rope_mscale_all_dim)
+        scale = scale * m * m
+    return scale
 
 
 def paged_attention_jnp(
@@ -174,6 +324,8 @@ def paged_attention_jnp(
     q_positions: jax.Array,  # [B, S] absolute positions of the queries
     kv_lens: jax.Array,  # [B] context length (tokens valid in pool)
     return_stats: bool = False,
+    scale: Optional[float] = None,  # score scale override (MLA: the
+    #   effective qk dim differs from the cached vector's dim)
 ):
     """Reference (jnp gather) paged attention with causal masking by
     absolute position. Flat context index c == absolute position c because
@@ -198,7 +350,8 @@ def paged_attention_jnp(
     v = gather(v_pool_l, q.dtype)
     _, C, Hk, Dh = k.shape
 
-    scale = Dh**-0.5
+    if scale is None:
+        scale = Dh**-0.5
     scores = jnp.einsum("bskgd,bckd->bkgsc", q, k).astype(jnp.float32) * scale
     ctx_pos = jnp.arange(C, dtype=jnp.int32)
     valid = (ctx_pos[None, :] < kv_lens[:, None])[:, None, None, None, :]
@@ -263,6 +416,57 @@ def _write_kv(pool, l_idx, new, page_table, positions):
     )
 
 
+def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
+                   kv_lens):
+    """Multi-head latent attention (DeepSeek V2/V3/R1), absorbed form.
+
+    Per token the pool caches one [d_c + d_rh] vector: the RMS-normed KV
+    latent c_kv plus the decoupled-RoPE shared key k_R. The W_UK
+    up-projection is absorbed into the query (q_abs = q_nope @ W_UK), so
+    attention runs DIRECTLY over the latent cache — scores are
+    q_abs·c_kv + q_R·k_R, i.e. standard paged attention with Hk=1,
+    G=n_heads, Dh=d_c+d_rh and values = the latent slice of the same
+    pool; W_UV then lifts the attended latent to per-head values. That
+    reuse means every pool mechanism (paging, prefix cache, tiering,
+    disagg export) serves MLA unchanged.
+
+    RoPE uses this module's half-rotation convention; HF DeepSeek
+    checkpoints interleave — engine/weights.py must permute on import.
+    Returns (attn [B, S, H*d_v], k_pool)."""
+    B, S = positions.shape
+    H = c.n_heads
+    dn, dr, dv, dc = (c.qk_nope_head_dim, c.qk_rope_head_dim,
+                      c.v_head_dim, c.kv_lora_rank)
+
+    x = rms_norm(h, lp["attn_norm"], c.norm_eps)
+    if c.q_lora_rank:
+        q_lat = rms_norm(mm(x, lp["wq_lat"]), lp["q_lat_norm"], c.norm_eps)
+        q = mm(q_lat, lp["wq_up"])
+    else:
+        q = mm(x, lp["wq"])
+    q = q.reshape(B, S, H, dn + dr)
+    q_nope, q_r = q[..., :dn], q[..., dn:]
+    q_r = rope(q_r, safe_pos, c.rope_theta, config=c)
+
+    kv = mm(x, lp["wkv_a"])  # [B, S, d_c + d_rh]
+    c_kv = rms_norm(kv[..., :dc], lp["kv_norm"], c.norm_eps)
+    k_r = rope(kv[..., None, dc:], safe_pos, c.rope_theta, config=c)[..., 0, :]
+    lat = jnp.concatenate([c_kv, k_r], axis=-1)[:, :, None, :]  # [B,S,1,D]
+    k_pool = _write_kv(k_pool, l_idx, lat, page_table, positions)
+    lat_pool_l = k_pool[l_idx]
+
+    wkv_b = lp["wkv_b"].reshape(dc, H, dn + dv)
+    w_uk, w_uv = wkv_b[..., :dn], wkv_b[..., dn:]
+    q_abs = jnp.einsum("bshn,chn->bshc", q_nope, w_uk)  # [B,S,H,d_c]
+    qg = jnp.concatenate([q_abs, q_r], axis=-1)[:, :, None, :, :]
+    attn_lat = paged_attention_jnp(
+        qg, lat_pool_l, lat_pool_l[..., :dc], page_table, safe_pos, kv_lens,
+        scale=attn_score_scale(c, dn + dr),
+    )[:, :, 0]  # [B, S, H, d_c]
+    attn = jnp.einsum("bshc,chv->bshv", attn_lat, w_uv)
+    return attn.reshape(B, S, H * dv), k_pool
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
@@ -321,8 +525,17 @@ def forward(
         h = lax.with_sharding_constraint(h, NamedSharding(mesh, _P(None, "seq", None)))
 
     lora_layers = (lora or {}).get("layers", {})
+    if lora_layers and c.is_mla:
+        # the MLA branch never consults the LoRA factors; failing loudly
+        # beats an adapter that appears to load but changes nothing
+        raise NotImplementedError("LoRA is not supported for MLA models")
 
-    def layer(carry, xs):
+    def make_layer(use_moe):
+        def layer(carry, xs):
+            return _layer_body(carry, xs, use_moe)
+        return layer
+
+    def _layer_body(carry, xs, use_moe):
         h, k_pool, v_pool = carry
         lp, ll, l_idx = xs
 
@@ -336,6 +549,20 @@ def forward(
             z = jnp.einsum("bsi,bir->bsr", x, Ag)
             return y + jnp.einsum("bsr,bro->bso", z, Bg)
 
+        if c.is_mla:
+            attn, k_pool = _mla_attention(
+                c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
+                kv_lens,
+            )
+            h = h + mm(attn, lp["wo"])
+            x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
+            if use_moe:
+                h = h + _moe_block(c, lp, x, mesh)
+            else:
+                gate = jax.nn.silu(mm(x, lp["w_gate"]))
+                h = h + mm(gate * mm(x, lp["w_up"]), lp["w_down"])
+            return (h, k_pool, v_pool), None
+
         x = rms_norm(h, lp["attn_norm"], c.norm_eps)
         q = lproj(mm(x, lp["wq"]), x, "wq")
         k = lproj(mm(x, lp["wk"]), x, "wk")
@@ -348,8 +575,8 @@ def forward(
         if c.qk_norm:  # Qwen3 per-head RMSNorm before RoPE
             q = rms_norm(q, lp["q_norm"], c.norm_eps)
             k = rms_norm(k, lp["k_norm"], c.norm_eps)
-        q = rope(q, safe_pos, c.rope_theta)
-        k = rope(k, safe_pos, c.rope_theta)
+        q = rope(q, safe_pos, c.rope_theta, config=c)
+        k = rope(k, safe_pos, c.rope_theta, config=c)
 
         # surgical in-place scatter into the carried pools (no pool copy)
         k_pool = _write_kv(k_pool, l_idx, k, page_table, positions)
@@ -423,7 +650,7 @@ def forward(
         h = h + lproj(mm(attn, lp["wo"]), attn, "wo")
 
         x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
-        if c.is_moe:
+        if use_moe:
             h = h + _moe_block(c, lp, x, mesh)
         else:
             gate = jax.nn.silu(lproj(mm(x, lp["w_gate"]), x, "w_gate"))
@@ -431,11 +658,33 @@ def forward(
             h = h + lproj(mm(gate * up, lp["w_down"]), gate * up, "w_down")
         return (h, k_pool, v_pool), None
 
-    (h, k_pool, v_pool), _ = lax.scan(
-        layer,
-        (h, k_pool, v_pool),
-        (params["layers"], lora_layers, jnp.arange(c.n_layers, dtype=jnp.int32)),
-    )
+    dense_stack = params.get("layers_dense")
+    if dense_stack is not None:
+        # DeepSeek first_k_dense_replace: leading dense-FFN layers run in
+        # their own scan (own compiled body), then the MoE layers
+        if lora_layers:
+            raise NotImplementedError(
+                "LoRA is not supported with n_dense_layers models"
+            )
+        kD = c.n_dense_layers
+        (h, k_pool, v_pool), _ = lax.scan(
+            make_layer(False),
+            (h, k_pool, v_pool),
+            (dense_stack, {}, jnp.arange(kD, dtype=jnp.int32)),
+        )
+        (h, k_pool, v_pool), _ = lax.scan(
+            make_layer(True),
+            (h, k_pool, v_pool),
+            (params["layers"], {},
+             jnp.arange(kD, c.n_layers, dtype=jnp.int32)),
+        )
+    else:
+        (h, k_pool, v_pool), _ = lax.scan(
+            make_layer(c.is_moe),
+            (h, k_pool, v_pool),
+            (params["layers"], lora_layers,
+             jnp.arange(c.n_layers, dtype=jnp.int32)),
+        )
 
     h = rms_norm(h, params["norm_f"], c.norm_eps)
     if last_index is not None:
@@ -458,6 +707,12 @@ def encode(
     mean-pool of the final-norm hidden states, L2-normalized → [B, E].
     Serves /v1/embeddings (reference http/service/openai.rs:2902)."""
     c = config
+    if c.is_mla:
+        raise ValueError("embedding forward is not supported for MLA models")
+    if c.n_dense_layers:
+        raise ValueError(
+            "embedding forward is not supported for mixed dense/MoE models"
+        )
     B, S = tokens.shape
     hd = c.head_dim
     G = c.n_heads // c.n_kv_heads
@@ -477,8 +732,8 @@ def encode(
         if c.qk_norm:
             q = rms_norm(q, lp["q_norm"], c.norm_eps)
             k = rms_norm(k, lp["k_norm"], c.norm_eps)
-        q = rope(q, positions, c.rope_theta)
-        k = rope(k, positions, c.rope_theta)
+        q = rope(q, positions, c.rope_theta, config=c)
+        k = rope(k, positions, c.rope_theta, config=c)
         qg = q.reshape(B, S, c.n_kv_heads, G, hd)
         scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * hd**-0.5
         ti = jnp.arange(S)
@@ -536,13 +791,19 @@ def _moe_block(c: ModelConfig, lp, x: jax.Array, mesh=None) -> jax.Array:
             model_axis=model_axis,
             scoring=c.moe_scoring,
             norm_topk=c.moe_norm_topk,
+            router_bias=lp.get("router_bias"),
+            routed_scale=c.moe_routed_scale,
+            n_groups=c.n_expert_groups,
+            topk_groups=c.topk_groups,
         )
         return y.reshape(B, S, E) + shared
     from dynamo_tpu.ops.moe_dispatch import router_topk
 
     router_logits = (x @ lp["w_router"]).astype(jnp.float32)  # [B,S,n_exp]
     weights, sel = router_topk(
-        router_logits, c.n_experts_active, c.moe_scoring, c.moe_norm_topk
+        router_logits, c.n_experts_active, c.moe_scoring, c.moe_norm_topk,
+        bias=lp.get("router_bias"), routed_scale=c.moe_routed_scale,
+        n_groups=c.n_expert_groups, topk_groups=c.topk_groups,
     )
     weights = weights.astype(x.dtype)
 

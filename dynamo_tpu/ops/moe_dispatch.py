@@ -32,17 +32,44 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def router_topk(logits: jax.Array, k: int, scoring: str = "softmax",
-                norm_topk: bool = True):
+                norm_topk: bool = True, bias=None, routed_scale: float = 1.0,
+                n_groups: int = 0, topk_groups: int = 0):
     """Top-k routing weights from f32 router logits. softmax = Mixtral/
     Qwen (softmax over the selected logits); sigmoid = DeepSeek-V3
     (independent gates, renormalized over the top-k). norm_topk=False
     (HF norm_topk_prob: false, Qwen2-MoE) keeps softmax-over-ALL-experts
     probabilities without renormalizing — the routed sum is deliberately
     < 1. One helper shared by every MoE path so dense, EP, and reference
-    all route identically."""
+    all route identically.
+
+    `bias` [n_experts] is DeepSeek-V3's e_score_correction_bias
+    (aux-loss-free load balancing): it shifts SELECTION only — the mixing
+    weights come from the unbiased gates. `routed_scale` multiplies the
+    final weights (HF routed_scaling_factor). `n_groups`/`topk_groups`
+    enable V3's group-limited selection: keep the topk_groups expert
+    groups whose top-2 member scores sum highest, ban the rest."""
     if scoring == "sigmoid":
         gates = jax.nn.sigmoid(logits)
-        weights, sel = lax.top_k(gates, k)
+        sel_scores = (
+            gates + bias.astype(gates.dtype) if bias is not None else gates
+        )
+        if n_groups > 1 and 0 < topk_groups < n_groups:
+            *lead, n_exp = sel_scores.shape
+            per = n_exp // n_groups
+            grouped = sel_scores.reshape(*lead, n_groups, per)
+            top2, _ = lax.top_k(grouped, min(2, per))
+            group_score = top2.sum(-1)  # [..., n_groups]
+            _, keep_g = lax.top_k(group_score, topk_groups)
+            keep = jnp.zeros(group_score.shape, bool)
+            keep = jnp.put_along_axis(keep, keep_g, True, axis=-1,
+                                      inplace=False)
+            mask = jnp.repeat(keep, per, axis=-1)
+            sel_scores = jnp.where(mask, sel_scores, -jnp.inf)
+        if bias is not None or n_groups > 1:
+            _, sel = lax.top_k(sel_scores, k)
+            weights = jnp.take_along_axis(gates, sel, axis=-1)
+        else:
+            weights, sel = lax.top_k(gates, k)
         if norm_topk:
             weights = weights / jnp.maximum(
                 jnp.sum(weights, axis=-1, keepdims=True), 1e-9
@@ -53,11 +80,15 @@ def router_topk(logits: jax.Array, k: int, scoring: str = "softmax",
     else:
         weights, sel = lax.top_k(logits, k)
         weights = jax.nn.softmax(weights, axis=-1)
+    if routed_scale != 1.0:
+        weights = weights * routed_scale
     return weights, sel
 
 
 def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis: str,
-               model_axis=None, scoring: str = "softmax", norm_topk: bool = True):
+               model_axis=None, scoring: str = "softmax", norm_topk: bool = True,
+               router_bias=None, routed_scale: float = 1.0,
+               n_groups: int = 0, topk_groups: int = 0):
     """Per-shard body. x: [T, E] local tokens; we_*: [n_local, ...] resident
     experts; router weights replicated. Returns [T, E]."""
     n_ranks = lax.psum(1, axis)
@@ -67,7 +98,9 @@ def _local_moe(x, w_router, we_gate, we_up, we_down, k: int, capacity: int, axis
     n_experts = n_local * n_ranks
 
     logits = (x @ w_router).astype(jnp.float32)  # [T, n_experts]
-    weights, sel = router_topk(logits, k, scoring, norm_topk)  # [T, k]
+    weights, sel = router_topk(logits, k, scoring, norm_topk,
+                               bias=router_bias, routed_scale=routed_scale,
+                               n_groups=n_groups, topk_groups=topk_groups)
     weights = weights.astype(x.dtype)
 
     # flatten (token, choice) pairs and bucket by destination rank
@@ -138,6 +171,10 @@ def moe_ep(
     model_axis=None,  # set to "model" for EP x TP expert weights
     scoring: str = "softmax",
     norm_topk: bool = True,
+    router_bias=None,  # [n_experts] selection bias (DeepSeek-V3)
+    routed_scale: float = 1.0,
+    n_groups: int = 0,  # group-limited selection (DeepSeek-V3)
+    topk_groups: int = 0,
 ) -> jax.Array:
     """Token-dispatch EP MoE. Returns [T, E] with x's sharding."""
     n_ranks = mesh.shape[axis]
@@ -146,22 +183,34 @@ def moe_ep(
     capacity = int(np.ceil(T_local * n_experts_active / n_ranks * capacity_factor))
 
     ma = model_axis
+    # router_bias rides as an explicit replicated input: a traced array
+    # captured in the shard_map closure would be rejected under jit
+    has_bias = router_bias is not None
+
+    def body(x, w_router, we_gate, we_up, we_down, *rest):
+        return _local_moe(
+            x, w_router, we_gate, we_up, we_down, k=n_experts_active,
+            capacity=capacity, axis=axis, model_axis=ma, scoring=scoring,
+            norm_topk=norm_topk, router_bias=rest[0] if has_bias else None,
+            routed_scale=routed_scale, n_groups=n_groups,
+            topk_groups=topk_groups,
+        )
+
+    in_specs = [
+        P(axis, None),
+        P(),
+        P(axis, None, ma),  # [n_exp, E, F]: F TP-sharded when ma set
+        P(axis, None, ma),
+        P(axis, ma, None),  # [n_exp, F, E]
+    ]
+    args = [x, w_router, we_gate, we_up, we_down]
+    if has_bias:
+        in_specs.append(P())
+        args.append(router_bias)
     fn = jax.shard_map(
-        partial(
-            _local_moe, k=n_experts_active, capacity=capacity, axis=axis,
-            model_axis=ma, scoring=scoring, norm_topk=norm_topk,
-        ),
-        mesh=mesh,
-        in_specs=(
-            P(axis, None),
-            P(),
-            P(axis, None, ma),  # [n_exp, E, F]: F TP-sharded when ma set
-            P(axis, None, ma),
-            P(axis, ma, None),  # [n_exp, F, E]
-        ),
-        out_specs=P(axis, None),
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=P(axis, None)
     )
-    return fn(x, w_router, we_gate, we_up, we_down)
+    return fn(*args)
 
 
 def moe_dense_reference(x, w_router, we_gate, we_up, we_down, k: int,

@@ -139,11 +139,20 @@ class ShardingPolicy:
     def kv_pool_sharding_tree(self, pool):
         """Sharding for a pool that may be a plain array or an int8-KV
         dict {"q": [L,NP,PS,Hk,D], "s": [L,NP,PS,Hk]} — scales shard over
-        the same kv-head axis as the data (axis 3 in both layouts)."""
+        the same kv-head axis as the data (axis 3 in both layouts).
+        Pools whose head axis doesn't divide the model axis replicate
+        instead: MLA latent pools have Hk=1 by construction (the cache is
+        per-token, not per-head) and are small enough to replicate."""
+        n_model = self.mesh.shape.get(AXIS_MODEL, 1)
         scale = NamedSharding(self.mesh, P(None, None, None, AXIS_MODEL))
-        return jax.tree.map(
-            lambda a: self.kv_pool_sharding() if a.ndim == 5 else scale, pool
-        )
+        repl = NamedSharding(self.mesh, P())
+
+        def _one(a):
+            if a.shape[3] % n_model != 0:
+                return repl
+            return self.kv_pool_sharding() if a.ndim == 5 else scale
+
+        return jax.tree.map(_one, pool)
 
     # -- activations -------------------------------------------------------
     def batch_spec(self) -> P:

@@ -185,15 +185,90 @@ def test_norm_topk_false_scales_routed_output():
     )
 
 
-def test_deepseek_checkpoints_rejected_loudly(tmp_path):
-    from dynamo_tpu.engine.weights import config_from_hf
+def test_hf_deepseek_mla_checkpoint_roundtrip(tmp_path):
+    """Synthetic DeepSeek-V3-shaped checkpoint: config detection (MLA +
+    noaux_tc router bias + first_k_dense), tensor mapping into the split
+    (layers_dense, layers) trees with the rope de-interleave fold, and a
+    finite forward on the loaded tree."""
+    from dynamo_tpu.engine.weights import (
+        config_from_hf, load_hf_checkpoint, _rope_deinterleave,
+    )
+    from safetensors.numpy import save_file
 
+    dims = dict(V=64, E=32, L=3, H=2, dc=16, dr=8, dn=16, dv=16,
+                F=48, MF=24, NEXP=4, K=2, KD=1)
+    V, E, L, H = dims["V"], dims["E"], dims["L"], dims["H"]
+    dc, dr, dn, dv = dims["dc"], dims["dr"], dims["dn"], dims["dv"]
+    rng = np.random.default_rng(5)
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+
+    t = {"model.embed_tokens.weight": w(V, E),
+         "model.norm.weight": np.ones(E, np.float32),
+         "lm_head.weight": w(V, E)}
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        t[pre + "input_layernorm.weight"] = np.ones(E, np.float32)
+        t[pre + "post_attention_layernorm.weight"] = np.ones(E, np.float32)
+        t[pre + "self_attn.q_proj.weight"] = w(H * (dn + dr), E)
+        t[pre + "self_attn.kv_a_proj_with_mqa.weight"] = w(dc + dr, E)
+        t[pre + "self_attn.kv_a_layernorm.weight"] = np.ones(dc, np.float32)
+        t[pre + "self_attn.kv_b_proj.weight"] = w(H * (dn + dv), dc)
+        t[pre + "self_attn.o_proj.weight"] = w(E, H * dv)
+        if i < dims["KD"]:  # dense layer
+            t[pre + "mlp.gate_proj.weight"] = w(dims["F"], E)
+            t[pre + "mlp.up_proj.weight"] = w(dims["F"], E)
+            t[pre + "mlp.down_proj.weight"] = w(E, dims["F"])
+        else:
+            t[pre + "mlp.gate.weight"] = w(dims["NEXP"], E)
+            t[pre + "mlp.gate.e_score_correction_bias"] = w(dims["NEXP"])
+            for e in range(dims["NEXP"]):
+                t[pre + f"mlp.experts.{e}.gate_proj.weight"] = w(dims["MF"], E)
+                t[pre + f"mlp.experts.{e}.up_proj.weight"] = w(dims["MF"], E)
+                t[pre + f"mlp.experts.{e}.down_proj.weight"] = w(E, dims["MF"])
+            t[pre + "mlp.shared_experts.gate_proj.weight"] = w(dims["MF"], E)
+            t[pre + "mlp.shared_experts.up_proj.weight"] = w(dims["MF"], E)
+            t[pre + "mlp.shared_experts.down_proj.weight"] = w(E, dims["MF"])
+    save_file(t, str(tmp_path / "model.safetensors"))
     (tmp_path / "config.json").write_text(json.dumps({
-        "model_type": "deepseek_v3", "vocab_size": 32, "hidden_size": 16,
-        "num_hidden_layers": 1, "num_attention_heads": 2,
-        "intermediate_size": 32,
+        "model_type": "deepseek_v3", "vocab_size": V, "hidden_size": E,
+        "num_hidden_layers": L, "num_attention_heads": H,
+        "intermediate_size": dims["F"], "kv_lora_rank": dc,
+        "qk_rope_head_dim": dr, "qk_nope_head_dim": dn, "v_head_dim": dv,
+        "n_routed_experts": dims["NEXP"], "num_experts_per_tok": dims["K"],
+        "moe_intermediate_size": dims["MF"], "n_shared_experts": 1,
+        "scoring_func": "sigmoid", "topk_method": "noaux_tc",
+        "routed_scaling_factor": 2.5, "first_k_dense_replace": dims["KD"],
+        "rope_theta": 10000.0, "rms_norm_eps": 1e-6,
+        "n_group": 2, "topk_group": 1,
+        "rope_scaling": {"type": "yarn", "factor": 40.0,
+                         "original_max_position_embeddings": 4096,
+                         "beta_fast": 32, "beta_slow": 1,
+                         "mscale": 1.0, "mscale_all_dim": 1.0},
     }))
-    import pytest
 
-    with pytest.raises(ValueError, match="MLA"):
-        config_from_hf(str(tmp_path))
+    c = config_from_hf(str(tmp_path), name="tiny-ds")
+    assert c.is_mla and c.moe_router_bias and c.n_dense_layers == 1
+    assert c.moe_routed_scale == 2.5 and c.moe_scoring == "sigmoid"
+    assert c.rope_scaling == "yarn" and c.rope_factor == 40.0
+    assert c.n_expert_groups == 2 and c.topk_groups == 1
+    params = load_hf_checkpoint(str(tmp_path), c)
+    assert params["layers_dense"]["wkv_a"].shape == (1, E, dc + dr)
+    assert params["layers"]["we_gate"].shape == (L - 1, dims["NEXP"], E, dims["MF"])
+    assert params["layers"]["router_bias"].shape == (L - 1, dims["NEXP"])
+    # rope fold: k_pe columns of wkv_a are de-interleaved (x0x2.. then x1x3..)
+    perm = _rope_deinterleave(dr)
+    raw = t["model.layers.0.self_attn.kv_a_proj_with_mqa.weight"].T
+    np.testing.assert_allclose(
+        np.asarray(params["layers_dense"]["wkv_a"][0, :, dc:], np.float32),
+        raw[:, dc:][:, perm], rtol=1e-2, atol=1e-2,
+    )
+    pools = llama.make_kv_pool(c, 8, 4)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    logits, _, _ = llama.forward(
+        c, jax.tree.map(jnp.asarray, params),
+        jnp.asarray([[1, 2, 3, 4]]), jnp.asarray([[0, 1, 2, 3]]),
+        pools[0], pools[1], pt, jnp.asarray([4]),
+    )
+    assert np.isfinite(np.asarray(logits)).all()
