@@ -57,6 +57,12 @@ def parse_args(argv=None):
                    help="JSONL per-request trace path (also DYN_REQUEST_TRACE)")
     p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
     p.add_argument("--discovery-root", default=None, help="file backend root dir")
+    p.add_argument("--http-workers", type=int, default=1,
+                   help="frontend PROCESSES sharing the port via "
+                        "SO_REUSEPORT (share-nothing scale-out past one "
+                        "process's plane ceiling; requires a fixed "
+                        "--http-port and a multi-process discovery "
+                        "backend, i.e. file/etcd/kube)")
     return p.parse_args(argv)
 
 
@@ -85,11 +91,28 @@ async def async_main(args) -> None:
         router_service=args.router_service,
         admission_config=admission,
     )
+    import os
+
+    parent_pid = os.environ.get("DYN_PARENT_PID")
+    if parent_pid:
+        # SO_REUSEPORT child: a leaked orphan would keep the shared port
+        # and silently swallow a share of new connections forever — exit
+        # when the spawning parent is gone
+        async def _watch_parent():
+            while os.getppid() == int(parent_pid):
+                await asyncio.sleep(2.0)
+            raise SystemExit(0)
+
+        asyncio.get_running_loop().create_task(_watch_parent())
+
     svc = HttpService(
         runtime, manager, watcher, host=args.http_host, port=args.http_port,
         busy_threshold=args.busy_threshold, trace_path=args.request_trace,
     )
-    await svc.start()
+    await svc.start(
+        reuse_port=args.http_workers > 1
+        or bool(os.environ.get("DYN_HTTP_REUSE_PORT"))
+    )
     grpc_server = None
     if args.grpc_port:
         from dynamo_tpu.frontend.grpc_kserve import KServeGrpcServer
@@ -108,10 +131,64 @@ async def async_main(args) -> None:
 
 
 def main(argv=None) -> None:
+    import os
+    import subprocess
+    import sys
+
+    args = parse_args(argv)
+    procs = []
+    if args.http_workers > 1:
+        if not args.http_port:
+            raise SystemExit("--http-workers requires a fixed --http-port")
+        if (args.discovery_backend or os.environ.get("DYN_DISCOVERY_BACKEND")
+                or "").strip() in ("", "mem"):
+            raise SystemExit(
+                "--http-workers requires a multi-process discovery backend "
+                "(file/etcd/kube) — mem discovery is per-process"
+            )
+        # re-exec siblings (spawn-multiprocessing can't re-import a -m
+        # __main__); each child is a full single-process frontend binding
+        # the same port via SO_REUSEPORT. Strip BOTH --http-workers forms
+        # ('--http-workers N' and '--http-workers=N') — a missed match
+        # would make every child re-spawn its own children (fork bomb).
+        src = list(argv if argv is not None else sys.argv[1:])
+        child_argv = []
+        skip = False
+        for a in src:
+            if skip:
+                skip = False
+                continue
+            if a == "--http-workers":
+                skip = True
+                continue
+            if a.startswith("--http-workers="):
+                continue
+            child_argv.append(a)
+        env = dict(os.environ, DYN_HTTP_REUSE_PORT="1",
+                   DYN_PARENT_PID=str(os.getpid()))
+        for _ in range(args.http_workers - 1):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.frontend", *child_argv],
+                env=env,
+            ))
+        # SIGTERM must unwind through the finally below — the default
+        # handler would kill this parent instantly and leak the children
+        # (which then hold the SO_REUSEPORT socket and eat connections)
+        import signal
+
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
     try:
-        asyncio.run(async_main(parse_args(argv)))
+        asyncio.run(async_main(args))
     except KeyboardInterrupt:
         pass
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 if __name__ == "__main__":

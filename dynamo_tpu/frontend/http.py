@@ -126,11 +126,17 @@ class HttpService:
         ).dec()
 
     # -- lifecycle ---------------------------------------------------------
-    async def start(self) -> str:
+    async def start(self, reuse_port: bool = False) -> str:
+        """`reuse_port=True` lets N frontend PROCESSES bind the same port
+        (SO_REUSEPORT): the kernel spreads accepted connections across
+        them — the share-nothing scale-out path past one process's
+        ~15.5k tok/s plane ceiling (docs/perf_notes.md; the reference
+        gets the same headroom from its Rust plane's thread pool)."""
         await self.watcher.start()
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           reuse_port=reuse_port or None)
         await site.start()
         # resolve ephemeral port
         for sock in site._server.sockets:  # type: ignore[union-attr]

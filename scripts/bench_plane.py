@@ -1,0 +1,150 @@
+"""Serving-plane aggregate-throughput benchmark: N SO_REUSEPORT frontend
+processes x M zero-cost mocker workers, real CLIs, real TCP.
+
+Measures the multi-process plane ceiling (docs/perf_notes.md escalation
+path: one Python frontend tops out ~15.5k tok/s; BASELINE's v5e-64 shape
+needs the frontend TIER to move 5-10x that). Run:
+
+    python scripts/bench_plane.py --frontends 4 --workers 4 \
+        --n-requests 1200 --concurrency 256
+
+Prints one JSON line: {"tok_s": ..., "frontends": N, ...}.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn(cmd, log):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.Popen(
+        cmd, stdout=log, stderr=subprocess.STDOUT, env=env, cwd=REPO
+    )
+
+
+async def wait_ready(base, timeout=60.0):
+    import aiohttp
+
+    t0 = time.monotonic()
+    async with aiohttp.ClientSession() as s:
+        while time.monotonic() - t0 < timeout:
+            try:
+                async with s.get(f"{base}/v1/models") as r:
+                    body = await r.json()
+                    if body.get("data"):
+                        return
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+    raise RuntimeError("frontend never became ready")
+
+
+async def drive(base, n_requests, concurrency, isl, osl):
+    import aiohttp
+
+    prompt = list(range(1, isl + 1))
+    sem = asyncio.Semaphore(concurrency)
+    out_tokens = 0
+    errors = 0
+
+    async def one(session):
+        nonlocal out_tokens, errors
+        async with sem:
+            try:
+                async with session.post(
+                    f"{base}/v1/completions",
+                    json={"model": "mock-model", "prompt": prompt,
+                          "max_tokens": osl, "temperature": 0.0,
+                          "ignore_eos": True},
+                ) as r:
+                    body = await r.json()
+                    if r.status == 200:
+                        u = body.get("usage") or {}
+                        out_tokens += int(u.get("completion_tokens") or 0)
+                    else:
+                        errors += 1
+            except Exception:
+                errors += 1
+
+    conn = aiohttp.TCPConnector(limit=concurrency)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        t0 = time.monotonic()
+        await asyncio.gather(*[one(session) for _ in range(n_requests)])
+        wall = time.monotonic() - t0
+    return out_tokens, wall, errors
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--frontends", type=int, default=4)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--port", type=int, default=18970)
+    p.add_argument("--n-requests", type=int, default=1200)
+    p.add_argument("--concurrency", type=int, default=256)
+    p.add_argument("--isl", type=int, default=64)
+    p.add_argument("--osl", type=int, default=32)
+    args = p.parse_args()
+
+    droot = tempfile.mkdtemp(prefix="plane_bench_")
+    logdir = tempfile.mkdtemp(prefix="plane_bench_logs_")
+    procs = []
+    try:
+        for i in range(args.workers):
+            procs.append(spawn(
+                [sys.executable, "-m", "dynamo_tpu.mocker", "--speed", "0",
+                 "--component", f"mocker{i}",
+                 "--max-batch", "128", "--decode-steps", "8",
+                 "--discovery-backend", "file", "--discovery-root", droot],
+                open(f"{logdir}/worker{i}.log", "w"),
+            ))
+        # one frontend CLI process that self-forks via --http-workers
+        procs.append(spawn(
+            [sys.executable, "-m", "dynamo_tpu.frontend",
+             "--http-port", str(args.port),
+             "--http-workers", str(args.frontends),
+             "--router-mode", "round_robin",
+             "--discovery-backend", "file", "--discovery-root", droot],
+            open(f"{logdir}/frontend.log", "w"),
+        ))
+        base = f"http://127.0.0.1:{args.port}"
+        asyncio.run(wait_ready(base))
+        # warmup
+        asyncio.run(drive(base, min(64, args.n_requests), 32, args.isl, args.osl))
+        toks, wall, errors = asyncio.run(
+            drive(base, args.n_requests, args.concurrency, args.isl, args.osl)
+        )
+        print(json.dumps({
+            "tok_s": round(toks / wall, 1),
+            "out_tokens": toks,
+            "wall_s": round(wall, 2),
+            "errors": errors,
+            "frontends": args.frontends,
+            "workers": args.workers,
+            "concurrency": args.concurrency,
+            "isl": args.isl, "osl": args.osl,
+            "logs": logdir,
+        }))
+    finally:
+        for pr in procs:
+            try:
+                pr.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+
+
+if __name__ == "__main__":
+    main()

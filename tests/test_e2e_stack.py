@@ -78,3 +78,67 @@ async def test_http_to_jax_engine_stream():
         await frt.shutdown()
         await wrt.shutdown(drain_timeout=1)
         engine.stop()
+
+
+async def test_multiprocess_frontend_reuse_port(tmp_path):
+    """--http-workers N: N frontend processes bind ONE port via
+    SO_REUSEPORT and all serve traffic (the share-nothing plane
+    scale-out, docs/perf_notes.md round 4)."""
+    import asyncio
+    import os
+    import subprocess
+    import sys
+
+    import aiohttp
+
+    droot = str(tmp_path / "disc")
+    os.makedirs(droot)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    port = 18961
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.mocker", "--speed", "0",
+             "--discovery-backend", "file", "--discovery-root", droot],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ),
+        subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.frontend",
+             "--http-port", str(port), "--http-workers", "2",
+             "--discovery-backend", "file", "--discovery-root", droot],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ),
+    ]
+    try:
+        base = f"http://127.0.0.1:{port}"
+        async with aiohttp.ClientSession() as s:
+            for _ in range(120):
+                try:
+                    async with s.get(f"{base}/v1/models") as r:
+                        if (await r.json()).get("data"):
+                            break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.5)
+            else:
+                raise AssertionError("frontend never ready")
+
+            async def one():
+                async with s.post(
+                    f"{base}/v1/completions",
+                    json={"model": "mock-model", "prompt": [1, 2, 3],
+                          "max_tokens": 4, "temperature": 0},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    return (await r.json())["usage"]["completion_tokens"]
+
+            # enough requests that the kernel spreads across both acceptors
+            results = await asyncio.gather(*[one() for _ in range(16)])
+            assert all(c == 4 for c in results)
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
