@@ -53,7 +53,14 @@ log = logging.getLogger("dynamo_tpu.operator")
 GROUP = "dynamo.tpu"
 VERSION = "v1"
 PLURAL = "dynamographdeployments"
+DGDR_PLURAL = "dynamographdeploymentrequests"
 MANAGED_BY = "dynamo-tpu-operator"
+
+# DGDR phases (reference dynamographdeploymentrequest_types.go lifecycle:
+# profiling request → recommended topology → deployed graph)
+DGDR_PROFILING = "profiling"
+DGDR_DEPLOYED = "deployed"
+DGDR_FAILED = "failed"
 
 # status condition reasons (reference dynamographdeployment_types.go)
 READY_ALL = "all_resources_are_ready"
@@ -75,6 +82,41 @@ def crd_manifest() -> Dict[str, Any]:
                 "plural": PLURAL,
                 "singular": "dynamographdeployment",
                 "shortNames": ["dgd"],
+            },
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {"type": "object",
+                                 "x-kubernetes-preserve-unknown-fields": True},
+                        "status": {"type": "object",
+                                   "x-kubernetes-preserve-unknown-fields": True},
+                    },
+                }},
+            }],
+        },
+    }
+
+
+def crd_manifest_dgdr() -> Dict[str, Any]:
+    """The DynamoGraphDeploymentRequest CRD (profile-then-deploy
+    automation, reference dynamographdeploymentrequest_types.go)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{DGDR_PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": "DynamoGraphDeploymentRequest",
+                "plural": DGDR_PLURAL,
+                "singular": "dynamographdeploymentrequest",
+                "shortNames": ["dgdr"],
             },
             "scope": "Namespaced",
             "versions": [{
@@ -192,6 +234,8 @@ class Reconciler:
         self.api_base = self._client.api_base
         self.namespace = namespace
         self.poll_interval = poll_interval
+        # in-flight DGDR profile→deploy background tasks, keyed (name, gen)
+        self._dgdr_tasks: Dict[tuple, asyncio.Task] = {}
 
     # -- REST helpers -------------------------------------------------------
 
@@ -237,7 +281,194 @@ class Reconciler:
         )
         return {o["metadata"]["name"]: o for o in (body or {}).get("items", [])}
 
+    # -- DGDR: profile-then-deploy ------------------------------------------
+
+    def _dgdr_url(self, name: str = "", sub: str = "") -> str:
+        base = (f"{self.api_base}/apis/{GROUP}/{VERSION}/namespaces/"
+                f"{self.namespace}/{DGDR_PLURAL}")
+        url = f"{base}/{name}" if name else base
+        return f"{url}/{sub}" if sub else url
+
+    async def list_dgdrs(self) -> List[Dict[str, Any]]:
+        try:
+            body = await self._get_json(self._dgdr_url())
+        except Exception as e:
+            # a 404 route (CRD not installed) returns None from _get_json;
+            # anything that raises here (auth, 5xx, timeout) is a REAL
+            # error and must not silently masquerade as "no CRD"
+            log.warning("listing DGDRs failed (%s); retrying next pass", e)
+            return []
+        return (body or {}).get("items", [])
+
+    async def _reconcile_dgdrs(self) -> None:
+        """Spawn one background profile→deploy task per out-of-date DGDR.
+        Profiling runs a multi-config serving simulation (seconds+), so it
+        must NOT block the DGD reconcile pass behind it."""
+        for dgdr in await self.list_dgdrs():
+            gen = dgdr["metadata"].get("generation", 1)
+            st = dgdr.get("status") or {}
+            if st.get("observedGeneration") == gen and st.get("phase") in (
+                DGDR_DEPLOYED, DGDR_FAILED,
+            ):
+                continue
+            name = dgdr["metadata"]["name"]
+            key = (name, gen)
+            task = self._dgdr_tasks.get(key)
+            if task is not None and not task.done():
+                continue
+            self._dgdr_tasks = {
+                k: t for k, t in self._dgdr_tasks.items() if not t.done()
+            }
+            self._dgdr_tasks[key] = asyncio.create_task(
+                self._profile_and_deploy(dgdr, gen)
+            )
+
+    async def wait_dgdr_tasks(self) -> None:
+        """Drain in-flight DGDR work (tests / shutdown)."""
+        tasks = list(self._dgdr_tasks.values())
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _profile_and_deploy(self, dgdr: Dict[str, Any], gen: int) -> None:
+        name = dgdr["metadata"]["name"]
+        try:
+            await self._dgdr_status(name, {
+                "observedGeneration": gen, "phase": DGDR_PROFILING,
+                "reason": None,
+            })
+            profile = await self._run_profile(dgdr)
+            rec = profile.get("recommendation")
+            if rec is None:
+                await self._dgdr_status(name, {
+                    "observedGeneration": gen,
+                    "phase": DGDR_FAILED,
+                    "reason": "no configuration met the SLO attainment "
+                              "target within the chip budget",
+                    "profile": profile,
+                    "deployment": None,
+                    "recommendation": None,
+                })
+                return
+            dgd = self._dgd_from_recommendation(dgdr, rec)
+            await self._apply_dgd(dgd, owner=name)
+            await self._dgdr_status(name, {
+                "observedGeneration": gen,
+                "phase": DGDR_DEPLOYED,
+                "deployment": dgd["metadata"]["name"],
+                "reason": None,
+                "recommendation": {
+                    "tensorParallel": rec["tp"],
+                    "workers": rec["workers"],
+                    "chips": rec["chips"],
+                    "goodputPerChip": rec["goodput_per_chip"],
+                    "attainment": rec["attainment"],
+                },
+                "profile": profile,
+            })
+            log.info("DGDR %s deployed: tp=%d x %d workers",
+                     name, rec["tp"], rec["workers"])
+        except Exception as e:
+            log.exception("DGDR %s failed", name)
+            try:
+                await self._dgdr_status(name, {
+                    "observedGeneration": gen,
+                    "phase": DGDR_FAILED,
+                    "reason": str(e),
+                    "deployment": None,
+                    "recommendation": None,
+                })
+            except Exception:
+                pass
+
+    async def _run_profile(self, dgdr: Dict[str, Any]) -> Dict[str, Any]:
+        """SLA profiling sweep (planner/profiler.py rapid mode: the real
+        serving stack over mocker workers with the TPU step-time model,
+        clock-compressed). Returns the sweep dict incl. recommendation."""
+        from dynamo_tpu.planner.profiler import parse_args as profiler_args
+        from dynamo_tpu.planner.profiler import sweep
+
+        spec = dgdr.get("spec") or {}
+        prof = spec.get("profiling") or {}
+        argv = [
+            "--chips", str(spec.get("chips", 8)),
+            "--ttft-slo", str(spec.get("ttftSlo", 0.5)),
+            "--itl-slo", str(spec.get("itlSlo", 0.05)),
+            "--min-attainment", str(spec.get("minAttainment", 0.9)),
+            "--router-mode", str(spec.get("routerMode", "kv")),
+            "--requests", str(prof.get("requests", 60)),
+            "--rps", str(prof.get("rps", 30.0)),
+            "--isl", str(prof.get("isl", 256)),
+            "--osl", str(prof.get("osl", 64)),
+            "--speed", str(prof.get("speed", 0.05)),
+        ]
+        if prof.get("hwProfile"):
+            argv += ["--hw-profile", str(prof["hwProfile"])]
+        return await sweep(profiler_args(argv))
+
+    def _dgd_from_recommendation(
+        self, dgdr: Dict[str, Any], rec: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        spec = dgdr.get("spec") or {}
+        name = spec.get("deploymentName") or dgdr["metadata"]["name"]
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "DynamoGraphDeployment",
+            "metadata": {
+                "name": name,
+                "namespace": dgdr["metadata"].get("namespace", self.namespace),
+                "labels": {"dynamo.tpu/from-request": dgdr["metadata"]["name"]},
+            },
+            "spec": {
+                "image": spec.get("image", "dynamo-tpu:latest"),
+                "model": spec.get("model", "llama-3.2-3b"),
+                "routerMode": spec.get("routerMode", "kv"),
+                "etcd": spec.get("etcd", "http://etcd:2379"),
+                "tpuType": spec.get("tpuType", "tpu-v5-lite-podslice"),
+                "tpuTopology": spec.get("tpuTopology", "1x1"),
+                "components": [
+                    {"name": "frontend", "type": "frontend",
+                     "replicas": int(
+                         (spec.get("frontend") or {}).get("replicas", 1))},
+                    {"name": "workers", "type": "worker",
+                     "replicas": int(rec["workers"]),
+                     "tensorParallel": int(rec["tp"])},
+                ],
+            },
+        }
+
+    async def _apply_dgd(self, dgd: Dict[str, Any], owner: str) -> None:
+        s = await self._http()
+        name = dgd["metadata"]["name"]
+        async with s.post(self._dgd_url(), json=dgd) as r:
+            if r.status not in (409, 405):  # 405 = PUT-only apiservers
+                r.raise_for_status()
+                return
+        # conflict: only overwrite a DGD this DGDR created — clobbering an
+        # unrelated hand-written graph would roll its workloads wholesale
+        existing = await self._get_json(self._dgd_url(name)) or {}
+        from_req = (existing.get("metadata", {}).get("labels") or {}).get(
+            "dynamo.tpu/from-request")
+        if existing and from_req != owner:
+            raise RuntimeError(
+                f"a DynamoGraphDeployment named {name!r} already exists and "
+                "was not created by this request; set spec.deploymentName "
+                "to a free name"
+            )
+        async with s.put(self._dgd_url(name), json=dgd) as r2:
+            r2.raise_for_status()
+
+    async def _dgdr_status(self, name: str, status: Dict[str, Any]) -> None:
+        s = await self._http()
+        async with s.patch(
+            self._dgdr_url(name, "status"),
+            json={"status": status},
+            headers={"Content-Type": "application/merge-patch+json"},
+        ) as r:
+            if r.status != 404:
+                r.raise_for_status()
+
     async def reconcile_all(self) -> None:
+        await self._reconcile_dgdrs()
         dgds = await self.list_dgds()
         live_deps = await self._list_children("Deployment")
         live_svcs = await self._list_children("Service")
@@ -422,6 +653,8 @@ def main(argv=None) -> None:
         import yaml
 
         sys.stdout.write(yaml.safe_dump(crd_manifest(), sort_keys=False))
+        sys.stdout.write("---\n")
+        sys.stdout.write(yaml.safe_dump(crd_manifest_dgdr(), sort_keys=False))
         return
     configure_logging()
     rec = Reconciler(namespace=args.namespace, api_base=args.api_base,

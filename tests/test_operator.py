@@ -11,6 +11,8 @@ import pytest
 from aiohttp import web
 
 from dynamo_tpu.operator import (
+    DGDR_DEPLOYED,
+    DGDR_PLURAL,
     GROUP,
     PLURAL,
     READY_ALL,
@@ -19,6 +21,7 @@ from dynamo_tpu.operator import (
     VERSION,
     Reconciler,
     crd_manifest,
+    crd_manifest_dgdr,
     render_children,
 )
 from dynamo_tpu.planner.connector import KubernetesConnector
@@ -30,6 +33,7 @@ class FakeClusterApi:
 
     def __init__(self):
         self.dgds = {}
+        self.dgdrs = {}
         self.deployments = {}
         self.services = {}
 
@@ -38,9 +42,14 @@ class FakeClusterApi:
         r = app.router
         dgd = f"/apis/{GROUP}/{VERSION}/namespaces/{{ns}}/{PLURAL}"
         r.add_get(dgd, self._dgd_list)
+        r.add_post(dgd, self._dgd_post)
         r.add_get(dgd + "/{name}", self._dgd_get)
+        r.add_put(dgd + "/{name}", self._dgd_put)
         r.add_patch(dgd + "/{name}", self._dgd_patch)
         r.add_patch(dgd + "/{name}/status", self._dgd_status)
+        dgdr = f"/apis/{GROUP}/{VERSION}/namespaces/{{ns}}/{DGDR_PLURAL}"
+        r.add_get(dgdr, self._dgdr_list)
+        r.add_patch(dgdr + "/{name}/status", self._dgdr_status)
         r.add_get("/apis/apps/v1/namespaces/{ns}/deployments", self._dep_list)
         r.add_post("/apis/apps/v1/namespaces/{ns}/deployments", self._dep_post)
         r.add_put("/apis/apps/v1/namespaces/{ns}/deployments/{name}", self._dep_put)
@@ -125,6 +134,39 @@ class FakeClusterApi:
             return web.json_response({}, status=404)
         self.dgds[name]["status"] = (await req.json())["status"]
         return web.json_response(self.dgds[name])
+
+    async def _dgd_post(self, req):
+        body = await req.json()
+        name = body["metadata"]["name"]
+        if name in self.dgds:
+            return web.json_response({}, status=409)
+        body["metadata"].setdefault("generation", 1)
+        self.dgds[name] = body
+        return web.json_response(body, status=201)
+
+    async def _dgd_put(self, req):
+        body = await req.json()
+        body["metadata"].setdefault("generation", 1)
+        self.dgds[req.match_info["name"]] = body
+        return web.json_response(body)
+
+    # -- DGDR ----------------------------------------------------------------
+
+    def put_dgdr(self, obj):
+        obj = copy.deepcopy(obj)
+        obj.setdefault("metadata", {}).setdefault("generation", 1)
+        self.dgdrs[obj["metadata"]["name"]] = obj
+
+    async def _dgdr_list(self, req):
+        return web.json_response({"items": list(self.dgdrs.values())})
+
+    async def _dgdr_status(self, req):
+        name = req.match_info["name"]
+        if name not in self.dgdrs:
+            return web.json_response({}, status=404)
+        st = (await req.json())["status"]
+        self.dgdrs[name].setdefault("status", {}).update(st)
+        return web.json_response(self.dgdrs[name])
 
     # -- Deployments ---------------------------------------------------------
 
@@ -417,3 +459,86 @@ async def test_scale_guard_rejects_concurrent_shape_change():
             await conn.close()
 
     await _with_cluster(body)
+
+
+async def test_dgdr_profile_then_deploy():
+    """DGDR automation (reference dynamographdeploymentrequest_types.go):
+    a profiling request triggers a mocker-backed SLA sweep, the operator
+    emits a DGD with the recommended (tp, workers) topology, child
+    Deployments materialize, and the DGDR status carries the profile."""
+    api = FakeClusterApi()
+    base = await api.start()
+    rec = Reconciler(namespace="prod", api_base=base, token="t")
+    try:
+        api.put_dgdr({
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "DynamoGraphDeploymentRequest",
+            "metadata": {"name": "req1", "namespace": "prod"},
+            "spec": {
+                "model": "llama-3.2-3b",
+                "image": "dynamo-tpu:v2",
+                "chips": 4,
+                "ttftSlo": 5.0, "itlSlo": 1.0,  # lax: every config passes
+                "minAttainment": 0.5,
+                "profiling": {"requests": 12, "rps": 50, "isl": 32,
+                              "osl": 8, "speed": 0.02},
+            },
+        })
+        await rec.reconcile_all()  # spawns the profiling task (non-blocking)
+        await rec.wait_dgdr_tasks()
+        await rec.reconcile_all()  # materializes the emitted DGD's children
+        dgdr = api.dgdrs["req1"]
+        assert dgdr["status"]["phase"] == DGDR_DEPLOYED, dgdr["status"]
+        r = dgdr["status"]["recommendation"]
+        assert r["tensorParallel"] * r["workers"] <= 4
+        assert dgdr["status"]["profile"]["configs"]
+        # the emitted DGD exists and rendered children on the same pass
+        dgd = api.dgds["req1"]
+        comps = {c["name"]: c for c in dgd["spec"]["components"]}
+        assert comps["workers"]["replicas"] == r["workers"]
+        assert comps["workers"]["tensorParallel"] == r["tensorParallel"]
+        assert "req1-workers" in api.deployments
+        assert "req1-frontend" in api.deployments
+
+        # converged: a second pass re-profiles nothing (phase sticks)
+        before = dgdr["status"]
+        await rec.reconcile_all()
+        await rec.wait_dgdr_tasks()
+        assert api.dgdrs["req1"]["status"] == before
+    finally:
+        await rec.close()
+        await api.stop()
+
+
+async def test_dgdr_refuses_to_clobber_foreign_dgd():
+    """A DGDR whose name collides with a hand-written DGD must fail
+    instead of silently replacing the user's graph."""
+    api = FakeClusterApi()
+    base = await api.start()
+    rec = Reconciler(namespace="prod", api_base=base, token="t")
+    try:
+        api.put_dgd(_dgd())  # hand-written graph named g1
+        api.put_dgdr({
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "DynamoGraphDeploymentRequest",
+            "metadata": {"name": "g1", "namespace": "prod"},
+            "spec": {"chips": 2, "ttftSlo": 5.0, "itlSlo": 1.0,
+                     "minAttainment": 0.1,
+                     "profiling": {"requests": 6, "rps": 50, "isl": 16,
+                                   "osl": 4, "speed": 0.02}},
+        })
+        await rec.reconcile_all()
+        await rec.wait_dgdr_tasks()
+        st = api.dgdrs["g1"]["status"]
+        assert st["phase"] == "failed" and "already exists" in st["reason"]
+        # the user's DGD is untouched
+        assert api.dgds["g1"]["spec"]["image"] == "dynamo-tpu:v1"
+    finally:
+        await rec.close()
+        await api.stop()
+
+
+def test_dgdr_crd_manifest():
+    m = crd_manifest_dgdr()
+    assert m["spec"]["names"]["shortNames"] == ["dgdr"]
+    assert m["metadata"]["name"] == f"{DGDR_PLURAL}.{GROUP}"
