@@ -1,0 +1,266 @@
+"""`python -m dynamo_tpu.global_planner` — multi-cluster scaling policy.
+
+Analog of reference `components/src/dynamo/global_planner` (multi-DGD
+shared-policy coordination): where each cluster's local Planner scales
+its own workers against its own SLOs, the GLOBAL planner owns one shared
+accelerator budget across clusters/DGDs and divides it by observed
+demand — so a traffic surge in one region borrows chips another region
+isn't using, instead of both planners fighting independent budgets.
+
+Control loop (the reference's OBSERVE → PROPOSE → EXECUTE shape, one
+level up):
+
+  OBSERVE  — per cluster: demand signal (in-flight requests + queue
+             depth from the frontend's Prometheus /metrics, or any
+             injected observer callable)
+  PROPOSE  — water-filling allocation: every cluster gets its floor
+             (min_replicas), the remaining budget splits proportionally
+             to demand-per-replica pressure, clamped to [min, max] and
+             to the total budget
+  EXECUTE  — per-cluster Connector.scale_to (KubernetesConnector PATCHes
+             the DGD, the operator rolls pods; VirtualConnector for
+             tests/sim)
+
+Hysteresis: a cluster's allocation only moves when the proposal differs
+from current by >= `step_threshold` replicas, and never more often than
+`cooldown_s` per cluster — the same dampening the local planner applies,
+preventing global/local oscillation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional
+
+log = logging.getLogger("dynamo_tpu.global_planner")
+
+
+@dataclass
+class ClusterSpec:
+    name: str
+    connector: object  # planner.connector.Connector
+    component: str = "workers"
+    # demand observer: async () -> float (e.g. in-flight + queued reqs).
+    observe: Optional[Callable[[], Awaitable[float]]] = None
+    metrics_url: Optional[str] = None  # fallback: frontend /metrics
+    min_replicas: int = 1
+    max_replicas: int = 1 << 30
+    last_scaled: float = field(default=0.0, compare=False)
+
+
+async def _prometheus_demand(url: str) -> float:
+    """Sum dynamo_frontend_in_flight + router queue depth from a
+    frontend's Prometheus exposition (the same series the dashboards
+    plot)."""
+    import aiohttp
+
+    total = 0.0
+    async with aiohttp.ClientSession() as s:
+        async with s.get(url, timeout=aiohttp.ClientTimeout(total=5)) as r:
+            text = await r.text()
+    for line in text.splitlines():
+        if line.startswith(("dynamo_frontend_in_flight{",
+                            "dynamo_frontend_in_flight ",
+                            "dynamo_router_queue_depth{",
+                            "dynamo_router_queue_depth ")):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def allocate(
+    demands: Dict[str, float],
+    current: Dict[str, int],
+    budget: int,
+    mins: Dict[str, int],
+    maxs: Dict[str, int],
+) -> Dict[str, int]:
+    """Water-filling proposal: floors first, then the remaining budget
+    proportional to demand, clamped per-cluster. Pure function (tested
+    directly; the loop wraps it with hysteresis)."""
+    names = list(demands)
+    out = {n: min(mins[n], maxs[n]) for n in names}
+    spend = sum(out.values())
+    remaining = max(0, budget - spend)
+    # proportional shares of the remaining budget by demand
+    total_demand = sum(max(0.0, demands[n]) for n in names)
+    if total_demand <= 0:
+        return out  # idle everywhere: floors only
+    # largest-remainder rounding so shares sum exactly to `remaining`
+    raw = {
+        n: remaining * max(0.0, demands[n]) / total_demand for n in names
+    }
+    base = {n: int(raw[n]) for n in names}
+    leftover = remaining - sum(base.values())
+    by_frac = sorted(names, key=lambda n: raw[n] - base[n], reverse=True)
+    for n in by_frac[:leftover]:
+        base[n] += 1
+    # clamp to max, returning the overflow to the most-demanding others
+    overflow = 0
+    for n in names:
+        want = out[n] + base[n]
+        cap = maxs[n]
+        if want > cap:
+            overflow += want - cap
+            want = cap
+        out[n] = want
+    if overflow:
+        for n in sorted(names, key=lambda n: demands[n], reverse=True):
+            room = maxs[n] - out[n]
+            take = min(room, overflow)
+            out[n] += take
+            overflow -= take
+            if overflow <= 0:
+                break
+    return out
+
+
+class GlobalPlanner:
+    def __init__(
+        self,
+        clusters: List[ClusterSpec],
+        budget: int,
+        interval_s: float = 30.0,
+        step_threshold: int = 1,
+        cooldown_s: float = 60.0,
+    ):
+        self.clusters = {c.name: c for c in clusters}
+        self.budget = budget
+        self.interval_s = interval_s
+        self.step_threshold = step_threshold
+        self.cooldown_s = cooldown_s
+        self._task: Optional[asyncio.Task] = None
+        self.last_decision: Dict[str, int] = {}
+
+    async def _demand(self, c: ClusterSpec) -> float:
+        try:
+            if c.observe is not None:
+                return float(await c.observe())
+            if c.metrics_url:
+                return await _prometheus_demand(c.metrics_url)
+        except Exception:
+            log.exception("observe failed for %s", c.name)
+        return 0.0
+
+    async def tick(self, now: Optional[float] = None) -> Dict[str, int]:
+        """One OBSERVE→PROPOSE→EXECUTE pass; returns the executed targets
+        (clusters skipped by hysteresis keep their current count)."""
+        now = time.monotonic() if now is None else now
+        names = list(self.clusters)
+        demands, current = {}, {}
+        for n in names:
+            c = self.clusters[n]
+            demands[n] = await self._demand(c)
+            cur = await c.connector.current_replicas(c.component)
+            current[n] = int(cur if cur is not None else c.min_replicas)
+        proposal = allocate(
+            demands, current, self.budget,
+            {n: self.clusters[n].min_replicas for n in names},
+            {n: self.clusters[n].max_replicas for n in names},
+        )
+        executed: Dict[str, int] = {}
+        for n in names:
+            c = self.clusters[n]
+            target = proposal[n]
+            if abs(target - current[n]) < self.step_threshold:
+                executed[n] = current[n]
+                continue
+            if now - c.last_scaled < self.cooldown_s:
+                executed[n] = current[n]
+                continue
+            log.info("global: %s %d -> %d (demand %.1f)",
+                     n, current[n], target, demands[n])
+            await c.connector.scale_to(c.component, target)
+            c.last_scaled = now
+            executed[n] = target
+        self.last_decision = executed
+        return executed
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                try:
+                    await self.tick()
+                except Exception:
+                    log.exception("global planner tick failed")
+                await asyncio.sleep(self.interval_s)
+        except asyncio.CancelledError:
+            pass
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.global_planner")
+    p.add_argument(
+        "--cluster", action="append", default=[], metavar="SPEC",
+        help="name=k8s_api_base,namespace,dgd,component[,metrics_url]"
+             " — repeat per cluster",
+    )
+    p.add_argument("--budget", type=int, required=True,
+                   help="total worker replicas shared across clusters")
+    p.add_argument("--interval", type=float, default=30.0)
+    p.add_argument("--cooldown", type=float, default=60.0)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=1 << 30)
+    return p.parse_args(argv)
+
+
+def build_clusters(args) -> List[ClusterSpec]:
+    from dynamo_tpu.planner.connector import KubernetesConnector
+
+    out = []
+    for spec in args.cluster:
+        name, _, rest = spec.partition("=")
+        parts = rest.split(",")
+        if len(parts) < 4:
+            raise SystemExit(f"bad --cluster spec {spec!r}")
+        api, ns, dgd, comp = parts[:4]
+        out.append(ClusterSpec(
+            name=name,
+            connector=KubernetesConnector(
+                namespace=ns, dgd=dgd or None, api_base=api,
+            ),
+            component=comp,
+            metrics_url=parts[4] if len(parts) > 4 else None,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+        ))
+    return out
+
+
+def main(argv=None) -> None:
+    from dynamo_tpu.runtime.logging_util import configure_logging
+
+    configure_logging()
+    args = parse_args(argv)
+    gp = GlobalPlanner(
+        build_clusters(args), budget=args.budget,
+        interval_s=args.interval, cooldown_s=args.cooldown,
+    )
+
+    async def _run():
+        await gp.start()
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

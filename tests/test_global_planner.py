@@ -1,0 +1,101 @@
+"""Global planner: shared accelerator budget across clusters (reference
+components/src/dynamo/global_planner multi-DGD policy coordination) —
+water-filling allocation, hysteresis/cooldown, connector execution."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.global_planner import ClusterSpec, GlobalPlanner, allocate
+
+
+def test_allocate_proportional_with_floors_and_caps():
+    demands = {"us": 300.0, "eu": 100.0, "ap": 0.0}
+    mins = {"us": 1, "eu": 1, "ap": 1}
+    maxs = {"us": 100, "eu": 100, "ap": 100}
+    out = allocate(demands, {}, budget=19, mins=mins, maxs=maxs)
+    assert sum(out.values()) == 19
+    assert out["ap"] == 1  # idle cluster stays at its floor
+    assert out["us"] == 13 and out["eu"] == 5  # 16 split 3:1 on top of floors
+
+    # max clamp returns overflow to the other demanding cluster
+    out = allocate(demands, {}, 19, mins, {"us": 6, "eu": 100, "ap": 100})
+    assert out["us"] == 6 and sum(out.values()) == 19
+
+    # zero demand everywhere: floors only, budget not burned
+    out = allocate({"a": 0.0, "b": 0.0}, {}, 10, {"a": 2, "b": 2},
+                   {"a": 9, "b": 9})
+    assert out == {"a": 2, "b": 2}
+
+
+class _FakeConnector:
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.calls = []
+
+    async def scale_to(self, component, n):
+        self.calls.append((component, n))
+        self.replicas = n
+
+    async def current_replicas(self, component):
+        return self.replicas
+
+
+async def test_tick_scales_by_demand_and_respects_cooldown():
+    demand = {"us": 90.0, "eu": 10.0}
+
+    def obs(name):
+        async def _o():
+            return demand[name]
+        return _o
+
+    us, eu = _FakeConnector(4), _FakeConnector(4)
+    gp = GlobalPlanner(
+        [
+            ClusterSpec("us", us, observe=obs("us")),
+            ClusterSpec("eu", eu, observe=obs("eu")),
+        ],
+        budget=10, cooldown_s=60.0,
+    )
+    out = await gp.tick(now=1000.0)
+    # floors 1+1, remaining 8 split 9:1 → us 1+7=8, eu 1+1=2
+    assert out == {"us": 8, "eu": 2}
+    assert us.replicas == 8 and eu.replicas == 2
+
+    # demand flips, but cooldown pins both clusters
+    demand["us"], demand["eu"] = 10.0, 90.0
+    out = await gp.tick(now=1010.0)
+    assert out == {"us": 8, "eu": 2} and len(us.calls) == 1
+
+    # past the cooldown the flip executes
+    out = await gp.tick(now=1100.0)
+    assert out == {"us": 2, "eu": 8}
+
+
+async def test_tick_hysteresis_skips_small_moves():
+    a, b = _FakeConnector(5), _FakeConnector(5)
+
+    async def even():
+        return 50.0
+
+    gp = GlobalPlanner(
+        [ClusterSpec("a", a, observe=even), ClusterSpec("b", b, observe=even)],
+        budget=10, step_threshold=2, cooldown_s=0.0,
+    )
+    out = await gp.tick(now=0.0)
+    # proposal equals current (5/5): nothing moves
+    assert out == {"a": 5, "b": 5} and not a.calls and not b.calls
+
+
+async def test_observer_failure_treated_as_idle():
+    async def boom():
+        raise RuntimeError("metrics down")
+
+    a = _FakeConnector(3)
+    gp = GlobalPlanner(
+        [ClusterSpec("a", a, observe=boom, min_replicas=2)],
+        budget=10, cooldown_s=0.0,
+    )
+    out = await gp.tick(now=0.0)
+    # unobservable cluster degrades to its floor, not to a crash
+    assert out == {"a": 2}
