@@ -1,0 +1,172 @@
+"""Engine gRPC sidecar: out-of-process engine attachment.
+
+Analog of the reference's engine sidecars (lib/sidecar/{common,vllm,
+sglang,trtllm} — a runtime-side process speaking one gRPC Generate
+shape to the engine, lib/sidecar/vllm/proto/vllm_grpc.proto:7-12).
+
+Two halves:
+
+- `EngineSidecarServer` — wraps any AsyncEngine (normally the native
+  JAX InferenceEngine) behind `dynamo.sidecar.EngineSidecar/Generate`
+  (unary→stream). Run standalone via `python -m dynamo_tpu.sidecar`:
+  the engine lives in THIS process (owning the TPU), while a separate
+  worker process owns discovery + request plane and forwards to it.
+- `SidecarEngine` — the worker-side AsyncEngine that dials a sidecar.
+  `python -m dynamo_tpu.worker --engine-sidecar HOST:PORT` serves the
+  normal worker surface with this in place of an in-process engine,
+  so engine and runtime restart/upgrade independently (the reference's
+  reason for sidecars).
+
+Payloads are msgpack engine wire dicts — identical to the in-process
+schema, so ANY engine implementing the worker protocol can sit behind
+the socket (not just ours). Client-side stream cancellation propagates:
+dropping the gRPC stream aborts the request in the engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import sys
+from pathlib import Path
+from typing import Any, AsyncIterator, Dict, Optional
+
+import grpc
+import msgpack
+
+sys.path.insert(0, str(Path(__file__).parent / "protos"))
+import engine_sidecar_pb2 as pb  # noqa: E402
+
+log = logging.getLogger("dynamo_tpu.sidecar")
+
+SERVICE = "dynamo.sidecar.EngineSidecar"
+
+
+def _pack(obj: Dict[str, Any]) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpack(b: bytes) -> Dict[str, Any]:
+    return msgpack.unpackb(b, raw=False, strict_map_key=False)
+
+
+class EngineSidecarServer:
+    """Serves an AsyncEngine over gRPC (generic method handlers — same
+    no-codegen-plugin pattern as frontend/grpc_kserve.py)."""
+
+    def __init__(self, engine, model_name: str = "", host: str = "0.0.0.0",
+                 port: int = 9345):
+        self.engine = engine
+        self.model_name = model_name
+        self.host = host
+        self.port = port
+        self._server: Optional[grpc.aio.Server] = None
+
+    async def _generate(self, request: pb.GenerateRequest, context):
+        from dynamo_tpu.runtime.context import Context
+
+        req = _unpack(request.request)
+        ctx = Context(request_id=request.request_id or None)
+        # grpc.aio cancels this handler coroutine on client drop; the
+        # finally clause then aborts the engine-side request
+        try:
+            async for item in self.engine.generate(req, ctx):
+                yield pb.GenerateItem(item=_pack(item))
+        finally:
+            ctx.stop_generating()
+
+    async def _health(self, request, context):
+        return pb.HealthResponse(ready=True, model=self.model_name)
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        handlers = {
+            "Generate": grpc.unary_stream_rpc_method_handler(
+                self._generate,
+                request_deserializer=pb.GenerateRequest.FromString,
+                response_serializer=pb.GenerateItem.SerializeToString,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                self._health,
+                request_deserializer=pb.HealthRequest.FromString,
+                response_serializer=pb.HealthResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        log.info("engine sidecar serving on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=5)
+            self._server = None
+
+
+class SidecarEngine:
+    """Worker-side AsyncEngine forwarding to a remote sidecar. Exposes
+    the hook surface serve_worker touches (on_fpm/on_kv_event no-op:
+    engine-side metrics/events stay in the engine process)."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel: Optional[grpc.aio.Channel] = None
+
+    def _chan(self) -> grpc.aio.Channel:
+        if self._channel is None:
+            self._channel = grpc.aio.insecure_channel(self.address)
+        return self._channel
+
+    async def generate(self, request: Dict[str, Any], context) -> AsyncIterator[Any]:
+        call = self._chan().unary_stream(
+            f"/{SERVICE}/Generate",
+            request_serializer=pb.GenerateRequest.SerializeToString,
+            response_deserializer=pb.GenerateItem.FromString,
+        )(pb.GenerateRequest(request_id=context.id, request=_pack(request)))
+        try:
+            async for item in call:
+                yield _unpack(item.item)
+                if context.is_stopped:
+                    break
+        finally:
+            call.cancel()
+
+    async def health(self, timeout: float = 5.0) -> Dict[str, Any]:
+        call = self._chan().unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+        resp = await call(pb.HealthRequest(), timeout=timeout)
+        return {"ready": resp.ready, "model": resp.model}
+
+    # serve_worker hook surface: engine-side concerns stay engine-side
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        if self._channel is not None:
+            ch, self._channel = self._channel, None
+            try:
+                loop = asyncio.get_running_loop()
+                loop.create_task(ch.close())
+            except RuntimeError:
+                pass
+
+    def on_fpm(self, cb) -> None:
+        pass
+
+    def on_kv_event(self, cb) -> None:
+        pass
+
+    # disagg KV transfer does not cross the sidecar boundary (yet): the
+    # worker's kv_fetch endpoints degrade to "entry gone" and peers
+    # recompute — requests stay correct, transfer is just skipped
+    async def export_parked_kv(self, request_id, discard: bool = False):
+        return {}
+
+    async def export_host_blocks(self, hashes):
+        return {}

@@ -45,6 +45,10 @@ def parse_args(argv=None):
     p.add_argument("--component", default="tpu-worker")
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--tokenizer", default="byte", help="'byte' or path to tokenizer.json")
+    p.add_argument("--engine-sidecar", default=None, metavar="HOST:PORT",
+                   help="attach an OUT-OF-PROCESS engine over gRPC "
+                        "(python -m dynamo_tpu.sidecar) instead of "
+                        "building one in this process")
     p.add_argument("--profiler-port", type=int, default=0,
                    help="start the XLA profiler server on this port for "
                         "TensorBoard capture (0 = off); pair with "
@@ -334,7 +338,26 @@ async def async_main(args) -> None:
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     spec = getattr(args, "_mh_spec", None)
     plane = None
-    if spec is not None:
+    if getattr(args, "engine_sidecar", None):
+        # out-of-process engine (reference lib/sidecar role): this worker
+        # owns discovery + request plane; generate calls forward over gRPC
+        from dynamo_tpu.frontend.protocols import ModelCard
+        from dynamo_tpu.sidecar import SidecarEngine
+
+        if args.vision:
+            raise SystemExit(
+                "--vision requires an in-process engine (the encoder runs "
+                "next to the model); drop it or run without --engine-sidecar"
+            )
+        engine = SidecarEngine(args.engine_sidecar)
+        health = await engine.health(timeout=30.0)
+        card = ModelCard(
+            name=args.model_name or health.get("model") or args.model,
+            tokenizer=args.tokenizer,
+            context_length=args.max_seq_len,
+            kv_block_size=args.page_size,
+        )
+    elif spec is not None:
         # multi-host leader: accept the follower connections first, then
         # build the runner (followers build theirs concurrently) and wrap
         # it so every device-touching call replays group-wide
@@ -364,7 +387,11 @@ async def async_main(args) -> None:
         from dynamo_tpu.runtime.status import StatusServer
 
         status = StatusServer(runtime, port=args.status_port)
-        status.add_check("engine", lambda: engine._thread is not None)
+        # SidecarEngine has no step thread — the remote engine's health is
+        # its own; this check then only covers the local process
+        status.add_check(
+            "engine", lambda: getattr(engine, "_thread", True) is not None
+        )
         await status.start()
     from dynamo_tpu.worker_common import serve_worker
 
