@@ -417,7 +417,7 @@ def _write_kv(pool, l_idx, new, page_table, positions):
 
 
 def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
-                   kv_lens):
+                   kv_lens, attn_impl="jnp", mesh=None):
     """Multi-head latent attention (DeepSeek V2/V3/R1), absorbed form.
 
     Per token the pool caches one [d_c + d_rh] vector: the RMS-normed KV
@@ -458,11 +458,31 @@ def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
     wkv_b = lp["wkv_b"].reshape(dc, H, dn + dv)
     w_uk, w_uv = wkv_b[..., :dn], wkv_b[..., dn:]
     q_abs = jnp.einsum("bshn,chn->bshc", q_nope, w_uk)  # [B,S,H,d_c]
-    qg = jnp.concatenate([q_abs, q_r], axis=-1)[:, :, None, :, :]
-    attn_lat = paged_attention_jnp(
-        qg, lat_pool_l, lat_pool_l[..., :dc], page_table, safe_pos, kv_lens,
-        scale=attn_score_scale(c, dn + dr),
-    )[:, :, 0]  # [B, S, H, d_c]
+    scale = attn_score_scale(c, dn + dr)
+    if attn_impl == "pallas" and S == 1:
+        # decode hot path: Pallas streams latent pages once — the same
+        # DMA feeds both score (full latent) and value (first d_c cols)
+        from dynamo_tpu.ops.mla_attention import (
+            decode_mla_attention,
+            decode_mla_attention_sharded,
+        )
+
+        qd = jnp.concatenate([q_abs, q_r], axis=-1)[:, 0]  # [B, H, Dl]
+        tp = mesh is not None and mesh.shape.get("model", 1) > 1
+        if tp:
+            attn_lat = decode_mla_attention_sharded(
+                qd, lat_pool_l, page_table, kv_lens, mesh, dc=dc, scale=scale,
+            )[:, None]
+        else:
+            attn_lat = decode_mla_attention(
+                qd, lat_pool_l, page_table, kv_lens, dc=dc, scale=scale,
+            )[:, None]  # [B, 1, H, d_c]
+    else:
+        qg = jnp.concatenate([q_abs, q_r], axis=-1)[:, :, None, :, :]
+        attn_lat = paged_attention_jnp(
+            qg, lat_pool_l, lat_pool_l[..., :dc], page_table, safe_pos,
+            kv_lens, scale=scale,
+        )[:, :, 0]  # [B, S, H, d_c]
     attn = jnp.einsum("bshc,chv->bshv", attn_lat, w_uv)
     return attn.reshape(B, S, H * dv), k_pool
 
@@ -552,7 +572,7 @@ def forward(
         if c.is_mla:
             attn, k_pool = _mla_attention(
                 c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
-                kv_lens,
+                kv_lens, attn_impl=attn_impl, mesh=mesh,
             )
             h = h + mm(attn, lp["wo"])
             x = rms_norm(h, lp["mlp_norm"], c.norm_eps)

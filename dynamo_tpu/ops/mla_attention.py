@@ -1,0 +1,165 @@
+"""Pallas TPU decode attention for MLA (DeepSeek latent-cache) models.
+
+The MLA decode hot op in absorbed form: each sequence's single query
+token carries per-head absorbed vectors q = [q_absorbed ; q_rope]
+([H, d_c + d_rh]) and attends over the sequence's paged LATENT cache
+([NP, PS, d_c + d_rh] — one vector per token, no heads). Scores are
+q · latent; values are the latent's first d_c columns — so ONE page DMA
+feeds both the K and the V side of the computation (the GQA kernel
+needs two pools; MLA's cache compression pays again here in bandwidth).
+
+Same streaming structure as ops/paged_attention.py: grid (B, MP), page
+index innermost, scalar-prefetched page table driving BlockSpec index
+maps with past-the-end pages clamped (repeat block index → Pallas elides
+the copy), online-softmax state in VMEM scratch.
+
+Tiling note: the latent dim for DeepSeek-V3 is 576 = 4.5 x 128 lanes;
+Pallas pads the last tile. Splitting the score matmul into an aligned
+512-wide latent part and a 64-wide rope part would avoid the padding —
+measured on hardware before bothering.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _mla_kernel(
+    page_table_ref,  # [B, MP] int32 (SMEM, scalar-prefetched)
+    kv_lens_ref,  # [B] int32 (SMEM)
+    q_ref,  # [H, Dl] absorbed+rope query for seq b
+    lat_ref,  # [PS, Dl] one latent page (single contiguous DMA)
+    o_ref,  # [H, dc]
+    m_ref,  # [H, 1] f32 running max
+    l_ref,  # [H, 1] f32 running denom
+    acc_ref,  # [H, dc] f32 running numerator
+    *,
+    page_size: int,
+    scale: float,
+    dc: int,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_lens_ref[b]
+    n_valid = jnp.clip(kv_len - i * page_size, 0, page_size)
+
+    @pl.when(n_valid > 0)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)  # [H, Dl]
+        lat = lat_ref[...].astype(jnp.float32)  # [PS, Dl]
+        s = lax.dot_general(
+            q, lat, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [H, PS]
+        valid = lax.broadcasted_iota(jnp.int32, s.shape, 1) < n_valid
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[...]  # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [H, PS]
+        alpha = jnp.exp(m_prev - m_new)
+        l_add = jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p, lat[:, :dc], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [H, dc]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        l_ref[...] = l_ref[...] * alpha + l_add
+        m_ref[...] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("dc", "scale", "interpret"))
+def decode_mla_attention(
+    q: jax.Array,  # [B, H, Dl] absorbed+rope queries
+    lat_pool_l: jax.Array,  # [NP, PS, 1, Dl] one layer's latent pool
+    page_table: jax.Array,  # [B, MP] int32
+    kv_lens: jax.Array,  # [B] int32 (context incl. current token)
+    *,
+    dc: int,  # latent (value) width = kv_lora_rank
+    scale: float,  # score scale ((d_nope + d_rh)^-0.5 [* yarn mscale^2])
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns the attended latents [B, H, dc] (the caller lifts them
+    through W_UV). The current token's latent must already be written."""
+    B, H, Dl = q.shape
+    NP, PS, _, _ = lat_pool_l.shape
+    MP = page_table.shape[1]
+    lat = lat_pool_l.reshape(NP, PS, Dl)
+
+    def lat_index(b, i, pt, kl):
+        last = jnp.maximum(kl[b] - 1, 0) // PS
+        return (pt[b, jnp.minimum(i, last)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MP),
+        in_specs=[
+            pl.BlockSpec((None, H, Dl), lambda b, i, pt, kl: (b, 0, 0)),
+            pl.BlockSpec((None, PS, Dl), lat_index),
+        ],
+        out_specs=pl.BlockSpec((None, H, dc), lambda b, i, pt, kl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, dc), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_mla_kernel, page_size=PS, scale=scale, dc=dc),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, dc), q.dtype),
+        interpret=interpret,
+    )(page_table, kv_lens, q, lat)
+
+
+def decode_mla_attention_sharded(
+    q: jax.Array,  # [B, H, Dl] heads sharded over `axis_name`
+    lat_pool_l: jax.Array,  # [NP, PS, 1, Dl] REPLICATED (Hk=1 — no head
+    #   axis to shard; the latent pool is small by design)
+    page_table: jax.Array,
+    kv_lens: jax.Array,
+    mesh,
+    axis_name: str = "model",
+    *,
+    dc: int,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel wrapper: per-head independence means each shard
+    runs the kernel on its local heads against the replicated latent pool
+    — zero collectives (the block all-reduce happens in the
+    out-projection as usual)."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.shard_map(
+        functools.partial(
+            decode_mla_attention, dc=dc, scale=scale, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(P(None, axis_name, None), P(None, None, None, None),
+                  P(None, None), P(None)),
+        out_specs=P(None, axis_name, None),
+        check_vma=False,
+    )
+    return fn(q, lat_pool_l, page_table, kv_lens)

@@ -1,6 +1,8 @@
 """Pallas kernel tests (interpret mode on CPU; compiled mode is exercised
 on real TPU via bench/worker runs)."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -212,3 +214,110 @@ def test_decode_paged_attention_sharded_int8_kv():
     ref_q = paged_attention_jnp(q[:, None], kq, vq, pt, (kv - 1)[:, None], kv)[:, 0]
     d = np.abs(np.asarray(out, np.float32) - np.asarray(ref_q, np.float32)).max()
     assert d < 3e-2, d
+
+
+# -- MLA decode kernel -------------------------------------------------------
+
+
+def _mla_setup(B=3, H=4, dc=32, dr=16, NP=32, PS=4, MP=6, seed=3):
+    rng = np.random.default_rng(seed)
+    Dl = dc + dr
+    q = jnp.asarray(rng.standard_normal((B, H, Dl)), jnp.float32)
+    lat = jnp.asarray(rng.standard_normal((NP, PS, 1, Dl)), jnp.float32)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    return q, lat, pt
+
+
+@pytest.mark.parametrize("kv_lens", [[1, 9, 24], [4, 4, 4], [24, 1, 13]])
+def test_decode_mla_attention_matches_reference(kv_lens):
+    from dynamo_tpu.models.llama import paged_attention_jnp
+    from dynamo_tpu.ops.mla_attention import decode_mla_attention
+
+    dc, dr = 32, 16
+    q, lat, pt = _mla_setup(dc=dc, dr=dr)
+    kv = jnp.asarray(kv_lens, jnp.int32)
+    scale = (24 + dr) ** -0.5  # distinct from Dl**-0.5: must be honored
+    out = decode_mla_attention(q, lat, pt, kv, dc=dc, scale=scale,
+                               interpret=True)
+    B, H, Dl = q.shape
+    qg = q[:, None, None, :, :].transpose(0, 2, 1, 3, 4)  # [B,1,1,H,Dl]
+    ref = paged_attention_jnp(
+        qg, lat, lat[..., :dc], pt,
+        (kv - 1)[:, None], kv, scale=scale,
+    )[:, 0, 0]  # [B, H, dc]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_mla_attention_ignores_garbage_pages():
+    from dynamo_tpu.ops.mla_attention import decode_mla_attention
+
+    dc = 32
+    q, lat, pt = _mla_setup(dc=dc)
+    kv = jnp.asarray([2, 5, 9], jnp.int32)
+    # clobber page-table entries past each sequence's last valid page
+    pt_bad = np.asarray(pt).copy()
+    pt_bad[0, 1:] = 31
+    pt_bad[1, 2:] = 30
+    out_a = decode_mla_attention(q, lat, pt, kv, dc=dc, scale=0.1,
+                                 interpret=True)
+    out_b = decode_mla_attention(q, lat, jnp.asarray(pt_bad), kv, dc=dc,
+                                 scale=0.1, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+
+def test_decode_mla_attention_sharded_matches_reference():
+    from dynamo_tpu.ops.mla_attention import (
+        decode_mla_attention,
+        decode_mla_attention_sharded,
+    )
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    dc = 32
+    q, lat, pt = _mla_setup(H=4, dc=dc)
+    kv = jnp.asarray([3, 11, 20], jnp.int32)
+    mesh = make_mesh(MeshConfig(model=2))
+    out = decode_mla_attention_sharded(
+        q, lat, pt, kv, mesh, dc=dc, scale=0.12, interpret=True
+    )
+    ref = decode_mla_attention(q, lat, pt, kv, dc=dc, scale=0.12,
+                               interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mla_forward_pallas_decode_matches_jnp():
+    """Full-layer check: forward with attn_impl='pallas' (interpret via
+    CPU is not available for compiled mode, so drive _mla_attention's
+    kernel path through decode directly)."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import get_config
+
+    c = get_config("tiny-mla")
+    p = llama.init_params(c, jax.random.PRNGKey(0))
+    toks = [5, 9, 2, 7, 1]
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    k1, v1 = llama.make_kv_pool(c, 8, 4)
+    out, k1, v1 = llama.forward(
+        c, p, jnp.asarray([toks]), jnp.asarray([list(range(5))]),
+        k1, v1, pt, jnp.asarray([5]),
+    )
+    # decode step via the jnp path vs the kernel path (interpret mode)
+    import dynamo_tpu.ops.mla_attention as mla_ops
+
+    orig = mla_ops.decode_mla_attention
+    ref, _, _ = llama.forward(
+        c, p, jnp.asarray([[8]]), jnp.asarray([[5]]), k1, v1, pt,
+        jnp.asarray([6]),
+    )
+    try:
+        mla_ops.decode_mla_attention = functools.partial(orig, interpret=True)
+        got, _, _ = llama.forward(
+            c, p, jnp.asarray([[8]]), jnp.asarray([[5]]), k1, v1, pt,
+            jnp.asarray([6]), attn_impl="pallas",
+        )
+    finally:
+        mla_ops.decode_mla_attention = orig
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
