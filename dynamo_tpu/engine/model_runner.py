@@ -307,6 +307,24 @@ class ModelRunner:
         # padding writes scatter to page index == num_pages, out of bounds,
         # and are dropped (scatter mode="drop" in llama._write_kv)
         self.kv_quantize = kv_quantize
+        # transfer-path page movement via the Pallas batched copy kernels
+        # (ops/block_copy.py) instead of XLA gather/scatter — opt-in until
+        # a hardware A/B lands (same rollout policy as attn_impl).
+        # Single-device pools only: on TP meshes the pool is head-sharded
+        # and the plain pallas_call would force replication (the XLA path
+        # partitions fine there).
+        import os
+
+        flag = os.environ.get("DYN_KV_COPY_KERNEL", "").lower()
+        self._kv_copy_kernel = (
+            flag in ("1", "true", "on", "yes")
+            and self.mesh_config.n_devices == 1
+        )
+        # non-TPU runs (CPU tests) execute the copy kernels in interpret
+        # mode (platform from the mesh's devices, like attn_impl)
+        self._kv_copy_interpret = (
+            self.mesh.devices.flat[0].platform != "tpu"
+        )
         k_pool, v_pool = llama.make_kv_pool(
             config, num_pages, page_size, dtype, kv_quantize=kv_quantize
         )
@@ -826,6 +844,10 @@ class ModelRunner:
 
             sel = jax.tree.map(lambda a: a[:, idx], pool)
             return kv_pool_dequantize(sel, dtype=self.dtype)
+        if self._kv_copy_kernel:
+            from dynamo_tpu.ops.block_copy import gather_pages
+
+            return gather_pages(pool, idx, interpret=self._kv_copy_interpret)
         return pool[:, idx]
 
     def _store_pages(self, pool, idx, dense):
@@ -834,6 +856,11 @@ class ModelRunner:
 
             d = kv_pool_quantize(dense)
             return jax.tree.map(lambda a, u: a.at[:, idx].set(u), pool, d)
+        if self._kv_copy_kernel:
+            from dynamo_tpu.ops.block_copy import scatter_pages
+
+            return scatter_pages(pool, idx, dense.astype(pool.dtype),
+                                 interpret=self._kv_copy_interpret)
         return pool.at[:, idx].set(dense)
 
     def export_pages_device(self, pages: List[int]):

@@ -1,0 +1,129 @@
+"""Pallas batched KV page copy / layout permute kernels.
+
+TPU-native equivalents of the reference's block-movement CUDA kernels
+(lib/llm/src/kernels/block_copy.cu copy_blocks_kernel:40-46 — batched
+block copies for transfers — and lib/kvbm-kernels/cuda/
+tensor_kernels.cu:33-58 — universal↔NHD/HND layout permutes for
+cross-engine adoption).
+
+The transfer/offload path (disagg P→D export, G2 offload, host import)
+moves SETS of non-contiguous pages between the paged pool and dense
+staging buffers. The jnp path (`pool[idx]` / scatter `.at[idx].set`)
+materializes XLA gather/scatter HLOs; these kernels instead stream one
+page per grid step with the page list scalar-prefetched — each step is
+a single contiguous [PS, Hk, D] DMA, and the permuted variant fuses the
+token-major → head-major transpose into the same pass (what the
+reference does with a dedicated permute kernel).
+
+All kernels run in interpret mode on CPU for CI; compiled mode is
+exercised on hardware. Integration: model_runner's export/import keeps
+the jnp path by default and switches here under DYN_KV_COPY_KERNEL=1
+(flip after hardware A/B, same policy as attn_impl).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _copy_kernel(idx_ref, src_ref, dst_ref):
+    dst_ref[...] = src_ref[...]
+
+
+def _permute_kernel(idx_ref, src_ref, dst_ref):
+    # token-major [..., PS, Hk, D] page → head-major [..., Hk, PS, D]
+    # (fused into the copy; the reference runs a standalone permute
+    # kernel for this)
+    dst_ref[...] = jnp.swapaxes(src_ref[...], -3, -2)
+
+
+@functools.partial(jax.jit, static_argnames=("head_major", "interpret"))
+def gather_pages(
+    pool: jax.Array,  # [NP, PS, Hk, D] one layer OR [L, NP, PS, Hk, D]
+    idx: jax.Array,  # [n] int32 page ids
+    *,
+    head_major: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Copy pages `idx` out of the pool into a dense buffer:
+    [(L,) n, PS, Hk, D] (token-major) or [(L,) n, Hk, PS, D]
+    (head_major=True — the cross-layout adoption format). Stacked pools
+    add a leading layer grid dim (same page list every layer)."""
+    stacked = pool.ndim == 5
+    if stacked:
+        L, NP, PS, Hk, D = pool.shape
+    else:
+        NP, PS, Hk, D = pool.shape
+        L = 1
+        pool = pool[None]
+    n = idx.shape[0]
+    page = (Hk, PS, D) if head_major else (PS, Hk, D)
+    kernel = _permute_kernel if head_major else _copy_kernel
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # idx
+        grid=(L, n),
+        in_specs=[
+            pl.BlockSpec((None, None, PS, Hk, D),
+                         lambda l, i, idx: (l, idx[i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None) + page,
+                               lambda l, i, idx: (l, i, 0, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((L, n) + page, pool.dtype),
+        interpret=interpret,
+    )(idx, pool)
+    return out if stacked else out[0]
+
+
+def _scatter_kernel(idx_ref, pool_in_ref, pages_ref, pool_ref):
+    del pool_in_ref  # aliased through to the output; only written blocks move
+    pool_ref[...] = pages_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
+def scatter_pages(
+    pool: jax.Array,  # [(L,) NP, PS, Hk, D] (donated: updated in place)
+    idx: jax.Array,  # [n] int32 target page ids (unique)
+    pages: jax.Array,  # [(L,) n, PS, Hk, D] token-major pages
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Write dense pages into pool slots `idx` (the import half of a
+    transfer). The pool buffer is donated and aliased to the output, so
+    pages the grid never touches stay in place without a copy."""
+    stacked = pool.ndim == 5
+    if stacked:
+        L, NP, PS, Hk, D = pool.shape
+    else:
+        NP, PS, Hk, D = pool.shape
+        L = 1
+        pool = pool[None]
+        pages = pages[None]
+    n = idx.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(L, n),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # pool: aliased, unread
+            pl.BlockSpec((None, None, PS, Hk, D),
+                         lambda l, i, idx: (l, i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, PS, Hk, D),
+                               lambda l, i, idx: (l, idx[i], 0, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _scatter_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases={1: 0},  # pool (after the prefetched idx) → out
+        interpret=interpret,
+    )(idx, pool, pages)
+    return out if stacked else out[0]
