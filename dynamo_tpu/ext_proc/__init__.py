@@ -1,0 +1,239 @@
+"""Envoy ext-proc endpoint picker (inference-gateway integration).
+
+Analog of reference deploy/inference-gateway/ext-proc (Rust): an Envoy
+`ext_proc` gRPC filter that picks the destination worker for each HTTP
+request and returns it as a header mutation — the Gateway API Inference
+Extension (GAIE) endpoint-picker pattern (docs/design-docs/
+architecture.md:131-138). Envoy routes the request to the chosen pod's
+frontend (each worker pod runs `python -m dynamo_tpu.frontend
+--router-mode direct` as its sidecar, same topology as the reference).
+
+Flow per request stream:
+  request_headers  → if the picker can decide from headers alone
+                     (no model-specific routing), respond immediately
+                     with `x-gateway-destination-endpoint`; otherwise
+                     CONTINUE and wait for the body
+  request_body     → parse the JSON body's "model" (and optionally
+                     session id header captured earlier), pick, respond
+  no live endpoint → ImmediateResponse 503 (load shed at the edge)
+
+The picker consults the same discovery the serving stack uses: workers
+publish `http_address` in instance metadata; selection reuses
+PushRouter's policies (round_robin / p2c / least_loaded /
+device_aware). Session stickiness honors `x-dynamo-session-id` with a
+TTL map, mirroring frontend/session_affinity.py semantics at the edge.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+import grpc
+
+sys.path.insert(0, str(Path(__file__).parent / "protos"))
+import ext_proc_min_pb2 as pb  # noqa: E402
+
+log = logging.getLogger("dynamo_tpu.ext_proc")
+
+SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
+DEST_HEADER = "x-gateway-destination-endpoint"
+SESSION_HEADER = "x-dynamo-session-id"
+
+
+class EndpointPicker:
+    """Selection core: discovery-fed instance set → (address) pick."""
+
+    def __init__(self, client, session_ttl_s: float = 0.0):
+        from dynamo_tpu.frontend.session_affinity import (
+            MAX_SESSION_AFFINITY_ENTRIES,
+        )
+
+        self.client = client  # runtime EndpointClient (watching workers)
+        self.session_ttl_s = session_ttl_s
+        self.max_sessions = MAX_SESSION_AFFINITY_ENTRIES
+        self._sessions: Dict[str, Tuple[int, float]] = {}  # sid -> (iid, exp)
+        self._rr = 0
+
+    def _http_address(self, iid: int) -> Optional[str]:
+        inst = self.client.instances.get(iid)
+        if inst is None:
+            return None
+        return (inst.metadata or {}).get("http_address")
+
+    def _serves(self, iid: int, model: Optional[str]) -> bool:
+        if not model:
+            return True
+        md = (self.client.instances[iid].metadata or {})
+        card = md.get("model_card") or {}
+        return model == card.get("name") or model in (card.get("adapters") or [])
+
+    def _eligible(self, model: Optional[str]) -> list:
+        """Instances that are routable (publish http_address) AND serve
+        the requested model; falls back to any routable instance when
+        nothing matches the model filter (the pod's frontend answers
+        model-not-found with a proper error body)."""
+        routable = [
+            i for i in self.client.router.instance_ids
+            if self._http_address(i)
+        ]
+        serving = [i for i in routable if self._serves(i, model)]
+        return serving or routable
+
+    def pick(self, model: Optional[str], session_id: Optional[str]) -> Optional[str]:
+        router = self.client.router
+        now = time.monotonic()
+        if session_id and self.session_ttl_s > 0:
+            hit = self._sessions.get(session_id)
+            if (hit and hit[1] > now and hit[0] in self.client.instances
+                    and self._serves(hit[0], model)
+                    and self._http_address(hit[0])):
+                self._sessions[session_id] = (hit[0], now + self.session_ttl_s)
+                return self._http_address(hit[0])
+        ids = self._eligible(model)
+        if not ids:
+            return None
+        # honor the router's policy OVER THE ELIGIBLE SET: take its pick
+        # when eligible, otherwise the least-loaded eligible instance with
+        # a rotating tiebreak (never a fixed ids[0] hotspot)
+        iid = None
+        try:
+            cand, _ = router._pick()
+            if cand in ids:
+                iid = cand
+        except Exception:
+            pass
+        if iid is None:
+            self._rr += 1
+            n = len(ids)
+            iid = min(
+                (ids[(self._rr + j) % n] for j in range(n)),
+                key=router.load_of,
+            )
+        if session_id and self.session_ttl_s > 0:
+            if len(self._sessions) >= self.max_sessions:
+                # hard cap (same bound as frontend/session_affinity.py):
+                # drop expired first, then the soonest-to-expire
+                self._sessions = {
+                    k: v for k, v in self._sessions.items() if v[1] > now
+                }
+                while len(self._sessions) >= self.max_sessions:
+                    oldest = min(self._sessions, key=lambda k: self._sessions[k][1])
+                    del self._sessions[oldest]
+            self._sessions[session_id] = (iid, now + self.session_ttl_s)
+        return self._http_address(iid)
+
+
+def _headers_dict(http_headers: pb.HttpHeaders) -> Dict[str, str]:
+    out = {}
+    for h in http_headers.headers.headers:
+        v = h.value or (h.raw_value.decode("utf-8", "replace") if h.raw_value else "")
+        out[h.key.lower()] = v
+    return out
+
+
+def _route_response(kind: str, address: str) -> pb.ProcessingResponse:
+    common = pb.CommonResponse(
+        status=pb.CommonResponse.CONTINUE,
+        header_mutation=pb.HeaderMutation(set_headers=[
+            pb.HeaderValueOption(header=pb.HeaderValue(
+                key=DEST_HEADER, raw_value=address.encode()))
+        ]),
+        clear_route_cache=True,  # the mutation must re-run route matching
+    )
+    if kind == "headers":
+        return pb.ProcessingResponse(
+            request_headers=pb.HeadersResponse(response=common))
+    return pb.ProcessingResponse(request_body=pb.BodyResponse(response=common))
+
+
+def _shed_response() -> pb.ProcessingResponse:
+    return pb.ProcessingResponse(immediate_response=pb.ImmediateResponse(
+        status=pb.HttpStatus(code=503),
+        body=json.dumps({"error": {
+            "message": "no live worker endpoint", "code": 503}}).encode(),
+        details="dynamo_tpu ext-proc: empty endpoint set",
+    ))
+
+
+class ExtProcServer:
+    """grpc.aio bidi ExternalProcessor (generic handlers, same
+    no-codegen-plugin pattern as the KServe frontend)."""
+
+    def __init__(self, picker: EndpointPicker, host: str = "0.0.0.0",
+                 port: int = 9002):
+        self.picker = picker
+        self.host = host
+        self.port = port
+        self._server: Optional[grpc.aio.Server] = None
+
+    async def _process(self, request_iter, context):
+        session_id = None
+        routed = False  # a destination was already chosen for this request
+        async for req in request_iter:
+            which = req.WhichOneof("request")
+            if which == "request_headers":
+                hdrs = _headers_dict(req.request_headers)
+                session_id = hdrs.get(SESSION_HEADER)
+                model = hdrs.get("x-dynamo-model")
+                if model or req.request_headers.end_of_stream:
+                    # decidable now (explicit model header, or no body
+                    # coming): pick immediately
+                    addr = self.picker.pick(model, session_id)
+                    routed = addr is not None
+                    yield (_route_response("headers", addr) if addr
+                           else _shed_response())
+                else:
+                    # wait for the body to learn the model
+                    yield pb.ProcessingResponse(
+                        request_headers=pb.HeadersResponse(
+                            response=pb.CommonResponse(
+                                status=pb.CommonResponse.CONTINUE)))
+            elif which == "request_body":
+                if routed:
+                    # already answered at the headers phase (Envoy's
+                    # static processing mode may still stream the body):
+                    # don't pick twice — it would advance routing state
+                    # and could rebind the session
+                    yield pb.ProcessingResponse(
+                        request_body=pb.BodyResponse(
+                            response=pb.CommonResponse(
+                                status=pb.CommonResponse.CONTINUE)))
+                    continue
+                model = None
+                try:
+                    model = json.loads(
+                        req.request_body.body.decode() or "{}").get("model")
+                except (ValueError, UnicodeDecodeError):
+                    pass
+                addr = self.picker.pick(model, session_id)
+                routed = addr is not None
+                yield (_route_response("body", addr) if addr
+                       else _shed_response())
+            # response_* phases need no action from the picker
+
+    async def start(self) -> int:
+        self._server = grpc.aio.server()
+        handlers = {
+            "Process": grpc.stream_stream_rpc_method_handler(
+                self._process,
+                request_deserializer=pb.ProcessingRequest.FromString,
+                response_serializer=pb.ProcessingResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        log.info("ext-proc endpoint picker on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=5)
+            self._server = None

@@ -1,0 +1,65 @@
+"""`python -m dynamo_tpu.ext_proc` — Envoy endpoint-picker process.
+
+Deployed next to an Envoy gateway with an `ext_proc` HTTP filter
+pointing here (reference deploy/inference-gateway topology): picks the
+worker pod per request from live discovery and returns it as the
+x-gateway-destination-endpoint header mutation."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.ext_proc import EndpointPicker, ExtProcServer
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging_util import configure_logging
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.ext_proc")
+    p.add_argument("--port", type=int, default=9002)
+    p.add_argument("--endpoint", default="dyn/tpu-worker/generate",
+                   help="worker endpoint path to watch")
+    p.add_argument("--router-mode", default="least_loaded",
+                   choices=["round_robin", "random", "p2c", "least_loaded",
+                            "device_aware"])
+    p.add_argument("--session-ttl", type=float, default=0.0,
+                   help="sticky-session TTL for x-dynamo-session-id (0=off)")
+    p.add_argument("--discovery-backend", default=None)
+    p.add_argument("--discovery-root", default=None)
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    configure_logging()
+    kw = {}
+    if args.discovery_root:
+        kw["root"] = args.discovery_root
+    runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
+    client = runtime.client(args.endpoint, args.router_mode)
+    await client.start()
+    server = ExtProcServer(
+        EndpointPicker(client, session_ttl_s=args.session_ttl),
+        port=args.port,
+    )
+    await server.start()
+    print(f"ext-proc picker on :{server.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+        await client.close()
+        await runtime.shutdown()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(async_main(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

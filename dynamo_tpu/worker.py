@@ -45,6 +45,11 @@ def parse_args(argv=None):
     p.add_argument("--component", default="tpu-worker")
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--tokenizer", default="byte", help="'byte' or path to tokenizer.json")
+    p.add_argument("--http-address", default=None, metavar="HOST:PORT",
+                   help="this pod's direct-mode HTTP frontend address, "
+                        "published for the Envoy ext-proc endpoint picker "
+                        "(env DYN_HTTP_ADDRESS; operators set it from the "
+                        "pod IP)")
     p.add_argument("--engine-sidecar", default=None, metavar="HOST:PORT",
                    help="attach an OUT-OF-PROCESS engine over gRPC "
                         "(python -m dynamo_tpu.sidecar) instead of "
@@ -412,6 +417,7 @@ async def async_main(args) -> None:
                 namespace=args.namespace, component=args.component,
                 endpoint=args.endpoint, disagg_role=args.disagg_role,
                 disagg_chunk_pages=args.disagg_chunk_pages,
+                http_address=args.http_address,
             )
 
         shadow = ShadowServer(
@@ -425,6 +431,7 @@ async def async_main(args) -> None:
             namespace=args.namespace, component=args.component, endpoint=args.endpoint,
             disagg_role=args.disagg_role,
             disagg_chunk_pages=args.disagg_chunk_pages,
+            http_address=args.http_address,
         )
         print(f"worker serving {card.name} at {path}", flush=True)
     promotion_failed = False

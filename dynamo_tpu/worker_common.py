@@ -163,10 +163,17 @@ async def serve_worker(
     disagg_chunk_pages: int = 16,  # P->D pull chunk size (0 = monolithic)
     device_weight: Optional[float] = None,  # capacity for device_aware
     #   routing (default: chips this worker's mesh spans)
+    http_address: Optional[str] = None,  # this pod's HTTP frontend (direct-
+    #   mode sidecar) for the ext-proc endpoint picker (DYN_HTTP_ADDRESS)
 ) -> ServedWorker:
+    import os as _os
+
     instance_id = new_instance_id()
     LOCAL_ENGINES[instance_id] = engine  # colocated-disagg device transfer
     metadata = {"model_card": card.to_dict(), "dp_rank": dp_rank}
+    http_address = http_address or _os.environ.get("DYN_HTTP_ADDRESS")
+    if http_address:
+        metadata["http_address"] = http_address
     if disagg_role:
         metadata["disagg_role"] = disagg_role
     if device_weight is None:

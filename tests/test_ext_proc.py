@@ -1,0 +1,175 @@
+"""Envoy ext-proc endpoint picker (reference deploy/inference-gateway/
+ext-proc): header/body-phase picking over live discovery, session
+stickiness, model filtering, and 503 shed on an empty endpoint set."""
+
+import asyncio
+import json
+
+import grpc
+import pytest
+
+from dynamo_tpu.ext_proc import (
+    DEST_HEADER,
+    SERVICE,
+    SESSION_HEADER,
+    EndpointPicker,
+    ExtProcServer,
+    pb,
+)
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import EchoEngine
+
+
+def _hdr_req(headers, end_of_stream=False):
+    return pb.ProcessingRequest(request_headers=pb.HttpHeaders(
+        headers=pb.HeaderMap(headers=[
+            pb.HeaderValue(key=k, value=v) for k, v in headers.items()
+        ]),
+        end_of_stream=end_of_stream,
+    ))
+
+
+def _body_req(obj):
+    return pb.ProcessingRequest(request_body=pb.HttpBody(
+        body=json.dumps(obj).encode(), end_of_stream=True))
+
+
+def _dest(resp):
+    which = resp.WhichOneof("response")
+    assert which in ("request_headers", "request_body"), which
+    common = getattr(resp, which).response
+    assert common.clear_route_cache
+    (opt,) = common.header_mutation.set_headers
+    assert opt.header.key == DEST_HEADER
+    return opt.header.raw_value.decode()
+
+
+class _Stack:
+    async def __aenter__(self):
+        self.rt = DistributedRuntime(discovery=MemDiscovery(realm="xp"),
+                                     event_transport="inproc")
+        for i, (addr, model) in enumerate(
+            [("10.0.0.1:8000", "llama"), ("10.0.0.2:8000", "qwen")]
+        ):
+            await self.rt.serve_endpoint(
+                "xp/worker/generate", EchoEngine(),
+                metadata={"http_address": addr,
+                          "model_card": {"name": model, "adapters": []}},
+                instance_id=100 + i,
+            )
+        self.client = self.rt.client("xp/worker/generate", "round_robin")
+        await self.client.start()
+        await self.client.wait_ready()
+        while len(self.client.instances) < 2:
+            await asyncio.sleep(0.05)
+        self.server = ExtProcServer(
+            EndpointPicker(self.client, session_ttl_s=30.0),
+            host="127.0.0.1", port=0,
+        )
+        port = await self.server.start()
+        self.chan = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        self.call = self.chan.stream_stream(
+            f"/{SERVICE}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString,
+        )
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.chan.close()
+        await self.server.stop()
+        await self.client.close()
+        await self.rt.shutdown(drain_timeout=1)
+
+
+async def test_body_phase_model_filtered_pick():
+    async with _Stack() as s:
+        async def drive():
+            call = s.call()
+            await call.write(_hdr_req({":path": "/v1/chat/completions"}))
+            first = await call.read()
+            # no model yet: CONTINUE without a destination
+            assert first.WhichOneof("response") == "request_headers"
+            assert not first.request_headers.response.header_mutation.set_headers
+            await call.write(_body_req({"model": "qwen", "messages": []}))
+            second = await call.read()
+            await call.done_writing()
+            return _dest(second)
+
+        assert await drive() == "10.0.0.2:8000"
+
+
+async def test_header_phase_pick_and_session_stickiness():
+    async with _Stack() as s:
+        async def once(sid):
+            call = s.call()
+            await call.write(_hdr_req(
+                {"x-dynamo-model": "llama", SESSION_HEADER: sid},
+                end_of_stream=True,
+            ))
+            resp = await call.read()
+            await call.done_writing()
+            return _dest(resp)
+
+        a = await once("sess-1")
+        assert a == "10.0.0.1:8000"  # model filter pins the llama worker
+        # same session keeps the same destination across requests
+        for _ in range(3):
+            assert await once("sess-1") == a
+
+
+async def test_empty_endpoint_set_sheds_503():
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="xp2"),
+                            event_transport="inproc")
+    client = rt.client("xp2/worker/generate")
+    await client.start()
+    server = ExtProcServer(EndpointPicker(client), host="127.0.0.1", port=0)
+    port = await server.start()
+    chan = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        call = chan.stream_stream(
+            f"/{SERVICE}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString,
+        )()
+        await call.write(_hdr_req({"x-dynamo-model": "x"}, end_of_stream=True))
+        resp = await call.read()
+        await call.done_writing()
+        assert resp.WhichOneof("response") == "immediate_response"
+        assert resp.immediate_response.status.code == 503
+    finally:
+        await chan.close()
+        await server.stop()
+        await client.close()
+        await rt.shutdown(drain_timeout=1)
+
+
+async def test_worker_publishes_http_address(monkeypatch):
+    """serve_worker publishes http_address (flag or DYN_HTTP_ADDRESS) so
+    real deployments feed the picker — not just hand-built metadata."""
+    from dynamo_tpu.frontend.protocols import ModelCard
+    from dynamo_tpu.worker_common import serve_worker
+
+    class _Eng:
+        def on_kv_event(self, cb): pass
+        def on_fpm(self, cb): pass
+        async def generate(self, req, ctx):
+            yield {"token_ids": [], "finish_reason": "stop"}
+        def start(self): pass
+        def stop(self): pass
+
+    monkeypatch.setenv("DYN_HTTP_ADDRESS", "10.9.9.9:8000")
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="xp3"),
+                            event_transport="inproc")
+    try:
+        w = await serve_worker(rt, _Eng(), ModelCard(name="m"),
+                               publish_kv_events=False, publish_fpm=False)
+        client = rt.client("dyn/tpu-worker/generate")
+        await client.start()
+        await client.wait_ready()
+        (inst,) = client.instances.values()
+        assert inst.metadata["http_address"] == "10.9.9.9:8000"
+        await w.stop()
+    finally:
+        await rt.shutdown(drain_timeout=1)
