@@ -1,0 +1,167 @@
+"""Pipeline-parallel transformer forward (GPipe microbatching over a
+`pipe` mesh axis).
+
+SURVEY §2.10: the reference delegates PP to its engines (vLLM Ray PP
+workers); here it is native. TPU-first shape: layers stay STACKED
+[L, ...] and shard over the pipe axis on axis 0 — each stage owns
+L/S contiguous layers (params AND its slice of the paged KV pool), and
+one `shard_map` runs the classic GPipe schedule: M microbatches flow
+through S stages over S+M-1 ticks, activations hopping stage→stage with
+a single `ppermute` per tick over ICI. Bubble ticks compute on garbage
+and are neutralized by masking (positions = -1 drops their KV writes;
+their outputs are never committed), so the whole schedule is one
+compiled program with static shapes — no per-stage host orchestration.
+
+Scope: the dense GQA family (no MoE/MLA/LoRA here yet); engine
+integration is pending a hardware profile — TP+DP cover ≤70B on v5e
+(SURVEY §2.10), so PP is for the tail beyond that.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import (
+    _write_kv,
+    paged_attention_jnp,
+    rms_norm,
+    rope,
+)
+from dynamo_tpu.models.quant import embed_lookup, mm, tied_logits
+
+
+def _check(config: ModelConfig) -> None:
+    if config.is_moe or config.is_mla or config.attn_bias or config.qk_norm:
+        raise NotImplementedError(
+            "pipeline-parallel forward currently covers the plain dense "
+            "GQA family"
+        )
+
+
+def pp_forward(
+    config: ModelConfig,
+    params,
+    tokens: jax.Array,  # [B, T]
+    positions: jax.Array,  # [B, T] (-1 = padding)
+    k_pool: jax.Array,  # [L, NP, PS, Hk, D] sharded over `axis` on dim 0
+    v_pool: jax.Array,
+    page_table: jax.Array,  # [B, MP]
+    kv_lens: jax.Array,  # [B]
+    mesh: Mesh,
+    axis: str = "pipe",
+    n_microbatches: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (logits [B, T, V], k_pool, v_pool) — numerically the plain
+    llama.forward, computed stage-parallel."""
+    _check(config)
+    c = config
+    S = mesh.shape[axis]
+    B, T = tokens.shape
+    M = n_microbatches or min(B, S)
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if c.n_layers % S != 0:
+        raise ValueError(f"{c.n_layers} layers not divisible by {S} stages")
+    mb = B // M
+    hd = c.head_dim
+    G = c.n_heads // c.n_kv_heads
+
+    layer_spec = jax.tree.map(lambda _: P(axis), params["layers"])
+    tied = params.get("lm_head") is None
+
+    def body(layers, embed, norm_f, *rest):
+        kp, vp, tok, pos, pt, kvl = rest
+        sid = lax.axis_index(axis)
+
+        def run_layers(h, pos_mb, pt_mb, kvl_mb, kp, vp):
+            def layer(carry, xs):
+                h, kp, vp = carry
+                lp, l_idx = xs
+                x = rms_norm(h, lp["attn_norm"], c.norm_eps)
+                q = mm(x, lp["wq"]).reshape(mb, T, c.n_heads, hd)
+                k = mm(x, lp["wk"]).reshape(mb, T, c.n_kv_heads, hd)
+                v = mm(x, lp["wv"]).reshape(mb, T, c.n_kv_heads, hd)
+                safe_pos = jnp.maximum(pos_mb, 0)
+                q = rope(q, safe_pos, c.rope_theta, config=c)
+                k = rope(k, safe_pos, c.rope_theta, config=c)
+                kp = _write_kv(kp, l_idx, k, pt_mb, pos_mb)
+                vp = _write_kv(vp, l_idx, v, pt_mb, pos_mb)
+                qg = q.reshape(mb, T, c.n_kv_heads, G, hd)
+                kp_l = jax.tree.map(lambda a: a[l_idx], kp)  # dict-safe
+                vp_l = jax.tree.map(lambda a: a[l_idx], vp)
+                attn = paged_attention_jnp(
+                    qg, kp_l, vp_l, pt_mb, safe_pos, kvl_mb
+                ).reshape(mb, T, c.n_heads * hd)
+                h = h + mm(attn, lp["wo"])
+                x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
+                gate = jax.nn.silu(mm(x, lp["w_gate"]))
+                h = h + mm(gate * mm(x, lp["w_up"]), lp["w_down"])
+                return (h, kp, vp), None
+
+            L_local = jax.tree.leaves(layers)[0].shape[0]
+            (h, kp, vp), _ = lax.scan(
+                layer, (h, kp, vp),
+                (layers, jnp.arange(L_local, dtype=jnp.int32)),
+            )
+            return h, kp, vp
+
+        # committed FINAL HIDDEN states, not logits: psum'ing [B, T, dim]
+        # and projecting to the vocab ONCE outside the shard_map is
+        # ~V/dim cheaper in both lm_head matmuls and ICI all-reduce bytes
+        out = jnp.zeros((B, T, c.dim), jnp.float32)
+        h = jnp.zeros((mb, T, c.dim), embed.dtype if not isinstance(embed, dict)
+                      else jnp.bfloat16)
+        for t in range(M + S - 1):  # static schedule, unrolled
+            mb_idx = t - sid  # which microbatch this stage sees this tick
+            valid = (mb_idx >= 0) & (mb_idx < M)
+            safe = jnp.clip(mb_idx, 0, M - 1)
+            tok_mb = lax.dynamic_slice(tok, (safe * mb, 0), (mb, T))
+            pos_mb = lax.dynamic_slice(pos, (safe * mb, 0), (mb, T))
+            pos_mb = jnp.where(valid, pos_mb, -1)  # bubbles write nothing
+            pt_mb = lax.dynamic_slice(pt, (safe * mb, 0), (mb, pt.shape[1]))
+            kvl_mb = jnp.where(
+                valid, lax.dynamic_slice(kvl, (safe * mb,), (mb,)), 0
+            )
+            x0 = embed_lookup(embed, tok_mb)
+            h_in = jnp.where(sid == 0, x0.astype(h.dtype), h)
+            h_out, kp, vp = run_layers(h_in, pos_mb, pt_mb, kvl_mb, kp, vp)
+            # last stage commits its (valid) microbatch's hidden states
+            commit = valid & (sid == S - 1)
+            cur = lax.dynamic_slice(out, (safe * mb, 0, 0), (mb, T, c.dim))
+            out = lax.dynamic_update_slice(
+                out,
+                jnp.where(commit, h_out.astype(jnp.float32), cur),
+                (safe * mb, 0, 0),
+            )
+            # activations hop to the next stage (ring; the wrap-around
+            # into stage 0 is overwritten by fresh input)
+            h = lax.ppermute(h_out, axis, [(i, (i + 1) % S) for i in range(S)])
+        # every rank holds only its committed slots; sum replicates the
+        # full hidden states (non-last stages contributed zeros)
+        return lax.psum(out, axis), kp, vp
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_spec, P(), P(),
+                  P(axis), P(axis), P(), P(), P(), P()),
+        out_specs=(P(), P(axis), P(axis)),
+        check_vma=False,
+    )
+    hidden, kp, vp = fn(
+        params["layers"], params["embed"], params["norm_f"],
+        k_pool, v_pool, tokens, positions, page_table, kv_lens,
+    )
+    # final norm + vocab projection once, on the replicated result
+    hf = rms_norm(hidden.astype(jnp.bfloat16), params["norm_f"], c.norm_eps)
+    logits = (
+        tied_logits(hf, params["embed"]) if tied
+        else mm(hf, params["lm_head"])
+    ).astype(jnp.float32)
+    return logits, kp, vp

@@ -1,0 +1,93 @@
+"""Pipeline-parallel forward (ops/pipeline_parallel.py): GPipe
+microbatching over a `pipe` mesh axis must reproduce the plain forward
+bit-for-bit-ish — logits AND the paged KV pools (bubble ticks write
+nothing)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.ops.pipeline_parallel import pp_forward
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs the 8-device CPU mesh"
+)
+
+
+def _pipe_mesh(S):
+    if len(jax.devices()) < S:
+        pytest.skip(f"needs {S} devices")
+    return jax.sharding.Mesh(np.array(jax.devices()[:S]), ("pipe",))
+
+
+@pytest.mark.parametrize("S,M", [(2, 2), (4, 2), (2, 4)])
+def test_pp_forward_matches_plain(S, M):
+    # 4 layers so every stage count divides evenly
+    c = get_config("tiny").with_(n_layers=4)
+    p = llama.init_params(c, jax.random.PRNGKey(0))
+    B, T = 4, 8
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(1, c.vocab_size, (B, T)), jnp.int32)
+    pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
+    pt = jnp.asarray(np.arange(B * 2).reshape(B, 2), jnp.int32)
+    kvl = jnp.full((B,), T, jnp.int32)
+
+    k0, v0 = llama.make_kv_pool(c, B * 2, 4)
+    ref, kr, vr = llama.forward(c, p, toks, pos, k0, v0, pt, kvl)
+
+    mesh = _pipe_mesh(S)
+    k1, v1 = llama.make_kv_pool(c, B * 2, 4)
+    out, kp, vp = pp_forward(
+        c, p, toks, pos, k1, v1, pt, kvl, mesh, n_microbatches=M
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
+    # the paged pools must match too: bubbles wrote nothing
+    np.testing.assert_allclose(
+        np.asarray(kp, np.float32), np.asarray(kr, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_pp_forward_then_decode_step():
+    """Prefill via PP, then a decode step via PP: the pool carried across
+    calls serves attention exactly like the single-device path."""
+    c = get_config("tiny")
+    p = llama.init_params(c, jax.random.PRNGKey(2))
+    B, T = 2, 8
+    rng = np.random.default_rng(3)
+    toks = jnp.asarray(rng.integers(1, c.vocab_size, (B, T)), jnp.int32)
+    pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
+    pt = jnp.asarray(np.arange(B * 3).reshape(B, 3), jnp.int32)
+
+    k0, v0 = llama.make_kv_pool(c, B * 3, 4)
+    _, k0, v0 = llama.forward(c, p, toks, pos, k0, v0, pt,
+                              jnp.full((B,), T, jnp.int32))
+    nxt = jnp.asarray(rng.integers(1, c.vocab_size, (B, 1)), jnp.int32)
+    ref, _, _ = llama.forward(
+        c, p, nxt, jnp.full((B, 1), T, jnp.int32), k0, v0, pt,
+        jnp.full((B,), T + 1, jnp.int32),
+    )
+
+    mesh = _pipe_mesh(2)
+    k1, v1 = llama.make_kv_pool(c, B * 3, 4)
+    _, k1, v1 = pp_forward(c, p, toks, pos, k1, v1, pt,
+                           jnp.full((B,), T, jnp.int32), mesh,
+                           n_microbatches=2)
+    got, _, _ = pp_forward(
+        c, p, nxt, jnp.full((B, 1), T, jnp.int32), k1, v1, pt,
+        jnp.full((B,), T + 1, jnp.int32), mesh, n_microbatches=2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_pp_rejects_unsupported_families():
+    c = get_config("tiny-mla")
+    with pytest.raises(NotImplementedError):
+        pp_forward(c, {}, None, None, None, None, None, None, _pipe_mesh(2))
