@@ -84,6 +84,14 @@ def load_hf_checkpoint(
     def stack_f32(fmt: str) -> np.ndarray:
         return np.stack([get_f32(fmt.format(i=i)) for i in range(L)])
 
+    # Gemma-2 renames: post_attention_layernorm is the POST-attn sandwich
+    # norm (not the pre-FFW norm llama uses it for); the pre-FFW norm is
+    # pre_feedforward_layernorm
+    mlp_norm_name = (
+        "model.layers.{i}.pre_feedforward_layernorm.weight"
+        if config.post_norms
+        else "model.layers.{i}.post_attention_layernorm.weight"
+    )
     params: Dict[str, Any] = {
         "embed": get("model.embed_tokens.weight"),
         "layers": {
@@ -92,11 +100,18 @@ def load_hf_checkpoint(
             "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
             "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
             "wo": stack("model.layers.{i}.self_attn.o_proj.weight", True),
-            "mlp_norm": stack_f32("model.layers.{i}.post_attention_layernorm.weight"),
+            "mlp_norm": stack_f32(mlp_norm_name),
         },
         "norm_f": get_f32("model.norm.weight"),
     }
     layers = params["layers"]
+    if config.post_norms:
+        layers["post_attn_norm"] = stack_f32(
+            "model.layers.{i}.post_attention_layernorm.weight"
+        )
+        layers["post_mlp_norm"] = stack_f32(
+            "model.layers.{i}.post_feedforward_layernorm.weight"
+        )
     if config.attn_bias:
         layers["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", False)
         layers["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", False)
@@ -294,8 +309,33 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
             n_dense_layers=int(cfg.get("first_k_dense_replace") or 0),
         )
     n_experts = int(cfg.get("num_experts") or cfg.get("n_routed_experts") or 0)
+    if mt.startswith("gemma3"):
+        # Gemma-3 adds qk-norm, a 5:1 local/global sliding pattern and
+        # dual rope bases this loader does not map yet — refuse rather
+        # than silently modeling it as Gemma-2 (wrong logits, no error)
+        raise ValueError(
+            f"model_type {mt!r} is not supported yet (gemma2 is)"
+        )
+    gemma = mt == "gemma2"
+    gemma_kw = {}
+    if gemma:
+        gemma_kw = dict(
+            act="gelu_tanh",
+            embed_scale=True,
+            norm_zero_centered=True,
+            post_norms=True,
+            attn_logit_softcap=float(cfg.get("attn_logit_softcapping") or 0.0),
+            final_logit_softcap=float(
+                cfg.get("final_logit_softcapping") or 0.0
+            ),
+            query_pre_attn_scalar=float(
+                cfg.get("query_pre_attn_scalar") or 0.0
+            ),
+            sliding_window=int(cfg.get("sliding_window") or 0),
+        )
     return ModelConfig(
         **rope_kw,
+        **gemma_kw,
         name=name or cfg.get("_name_or_path", "hf-model"),
         vocab_size=cfg["vocab_size"],
         dim=cfg["hidden_size"],

@@ -25,6 +25,25 @@ class ModelConfig:
     attn_bias: bool = False
     # Qwen3-style per-head RMSNorm on q and k before RoPE
     qk_norm: bool = False
+    # Gemma family:
+    #   gelu_tanh MLP activation (GeGLU) instead of SiLU
+    act: str = "silu"  # "silu" | "gelu_tanh"
+    #   embeddings scaled by sqrt(dim) after lookup
+    embed_scale: bool = False
+    #   RMSNorm weights are zero-centered: output = normed * (1 + w)
+    norm_zero_centered: bool = False
+    #   Gemma-2 sandwich norms: post-attention and post-FFW RMSNorms on
+    #   the residual branches (in addition to the pre-norms)
+    post_norms: bool = False
+    #   attention-score soft capping: s = cap * tanh(s / cap); 0 = off
+    attn_logit_softcap: float = 0.0
+    #   final-logit soft capping; 0 = off
+    final_logit_softcap: float = 0.0
+    #   attention scale = query_pre_attn_scalar^-0.5 (0 → head_dim^-0.5)
+    query_pre_attn_scalar: float = 0.0
+    #   sliding-window attention on alternating layers (Gemma-2 pattern:
+    #   even layers sliding, odd global); 0 = all-global
+    sliding_window: int = 0
     # explicit head_dim when it differs from dim // n_heads (Qwen3-MoE)
     head_dim_override: int = 0
     # MoE (0 experts = dense)
@@ -128,6 +147,14 @@ PRESETS: Dict[str, ModelConfig] = {
     "tiny-moe-shared": ModelConfig(
         name="tiny-moe-shared", n_experts=4, n_experts_active=2,
         moe_ffn_dim=96, n_shared_experts=1, moe_scoring="sigmoid",
+    ),
+    # Gemma-2 test model (CPU CI for the Gemma family: GeGLU, scaled
+    # embeddings, zero-centered sandwich norms, softcaps, sliding window)
+    "tiny-gemma2": ModelConfig(
+        name="tiny-gemma2", tie_embeddings=True, act="gelu_tanh",
+        embed_scale=True, norm_zero_centered=True, post_norms=True,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        query_pre_attn_scalar=16.0, sliding_window=8, rope_theta=10000.0,
     ),
     # MLA test models (CPU CI for the DeepSeek attention family)
     "tiny-mla": ModelConfig(
@@ -276,6 +303,29 @@ PRESETS: Dict[str, ModelConfig] = {
         rope_beta_slow=1.0,
         rope_mscale=1.0,
         rope_mscale_all_dim=1.0,
+    ),
+    # Gemma 2 9B (fourth architecture family)
+    "gemma-2-9b": ModelConfig(
+        name="gemma-2-9b",
+        vocab_size=256000,
+        dim=3584,
+        n_layers=42,
+        n_heads=16,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        max_seq_len=8192,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        tie_embeddings=True,
+        head_dim_override=256,
+        act="gelu_tanh",
+        embed_scale=True,
+        norm_zero_centered=True,
+        post_norms=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        query_pre_attn_scalar=256.0,
+        sliding_window=4096,
     ),
     # Llama 3.1 70B (BASELINE north-star model; TP=8 on v5e)
     "llama-3.1-70b": ModelConfig(

@@ -73,7 +73,9 @@ def _init_top(c: ModelConfig, key: jax.Array, dtype) -> Params:
 
     params: Params = {
         "embed": w(k[0], c.dim, c.vocab_size, c.dim),
-        "norm_f": jnp.ones((c.dim,), jnp.float32),
+        "norm_f": jnp.full(
+            (c.dim,), 0.0 if c.norm_zero_centered else 1.0, jnp.float32
+        ),
     }
     if not c.tie_embeddings:
         params["lm_head"] = w(k[9], c.dim, c.dim, c.vocab_size)
@@ -89,7 +91,9 @@ def _init_layer_stack(config: ModelConfig, key: jax.Array, L: int,
     hd = c.head_dim
 
     def norm_init(*shape):
-        return jnp.ones(shape, dtype=jnp.float32)
+        # zero-centered norms (Gemma) store w with runtime (1 + w)
+        fill = 0.0 if c.norm_zero_centered else 1.0
+        return jnp.full(shape, fill, dtype=jnp.float32)
 
     def w(key, fan_in, *shape):
         return (jax.random.normal(key, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
@@ -136,6 +140,11 @@ def _init_layer_stack(config: ModelConfig, key: jax.Array, L: int,
         layers.update(
             {"q_norm": norm_init(L, hd), "k_norm": norm_init(L, hd)}
         )
+    if c.post_norms:  # Gemma-2 sandwich norms on the residual branches
+        layers.update({
+            "post_attn_norm": norm_init(L, c.dim),
+            "post_mlp_norm": norm_init(L, c.dim),
+        })
     if moe:
         layers.update(
             {
@@ -217,10 +226,13 @@ def make_kv_pool(
 # --------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             zero_centered: bool = False) -> jax.Array:
+    """zero_centered (Gemma): weights store w with output = normed*(1+w)."""
     xf = x.astype(jnp.float32)
     normed = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (normed * weight).astype(x.dtype)
+    w = weight + 1.0 if zero_centered else weight
+    return (normed * w).astype(x.dtype)
 
 
 def _yarn_mscale(scale: float, mscale: float) -> float:
@@ -326,6 +338,8 @@ def paged_attention_jnp(
     return_stats: bool = False,
     scale: Optional[float] = None,  # score scale override (MLA: the
     #   effective qk dim differs from the cached vector's dim)
+    softcap: float = 0.0,  # Gemma-2 attention-score soft capping
+    window=None,  # sliding window (traced per-layer scalar; None/0 = off)
 ):
     """Reference (jnp gather) paged attention with causal masking by
     absolute position. Flat context index c == absolute position c because
@@ -353,9 +367,19 @@ def paged_attention_jnp(
     if scale is None:
         scale = Dh**-0.5
     scores = jnp.einsum("bskgd,bckd->bkgsc", q, k).astype(jnp.float32) * scale
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
     ctx_pos = jnp.arange(C, dtype=jnp.int32)
     valid = (ctx_pos[None, :] < kv_lens[:, None])[:, None, None, None, :]
     causal = ctx_pos[None, None, :] <= q_positions[:, :, None]  # [B,S,C]
+    if window is not None:
+        # sliding window: only the last `window` positions are visible
+        # (window <= 0 disables — the per-layer Gemma-2 pattern rides a
+        # scanned scalar, so this stays trace-friendly)
+        win = jnp.where(jnp.asarray(window) > 0, jnp.asarray(window), 1 << 30)
+        causal = causal & (
+            ctx_pos[None, None, :] > q_positions[:, :, None] - win
+        )
     mask = valid & causal[:, None, None, :, :]
     scores = jnp.where(mask, scores, -1e30)
     m = jnp.max(scores, axis=-1, keepdims=True)  # [B,Hk,G,S,1]
@@ -526,6 +550,10 @@ def forward(
     G = c.n_heads // c.n_kv_heads
 
     h = embed_lookup(params["embed"], tokens)  # [B, S, E] (gather)
+    if c.embed_scale:
+        # Gemma: embeddings scaled by sqrt(dim), with the normalizer
+        # rounded through the embedding dtype (HF semantics)
+        h = h * jnp.asarray(c.dim**0.5, h.dtype)
     if mm_embeds is not None:
         # multimodal injection: image-placeholder positions take the vision
         # encoder's embeddings instead of the token embedding (prefix-cache
@@ -583,7 +611,8 @@ def forward(
                 h = h + mm(gate * mm(x, lp["w_up"]), lp["w_down"])
             return (h, k_pool, v_pool), None
 
-        x = rms_norm(h, lp["attn_norm"], c.norm_eps)
+        zc = c.norm_zero_centered
+        x = rms_norm(h, lp["attn_norm"], c.norm_eps, zero_centered=zc)
         q = lproj(mm(x, lp["wq"]), x, "wq")
         k = lproj(mm(x, lp["wk"]), x, "wk")
         v = lproj(mm(x, lp["wv"]), x, "wv")
@@ -593,8 +622,8 @@ def forward(
         k = k.reshape(B, S, c.n_kv_heads, hd)
         v = v.reshape(B, S, c.n_kv_heads, hd)
         if c.qk_norm:  # Qwen3 per-head RMSNorm before RoPE
-            q = rms_norm(q, lp["q_norm"], c.norm_eps)
-            k = rms_norm(k, lp["k_norm"], c.norm_eps)
+            q = rms_norm(q, lp["q_norm"], c.norm_eps, zero_centered=zc)
+            k = rms_norm(k, lp["k_norm"], c.norm_eps, zero_centered=zc)
         q = rope(q, safe_pos, c.rope_theta, config=c)
         k = rope(k, safe_pos, c.rope_theta, config=c)
 
@@ -606,7 +635,29 @@ def forward(
 
         qg = q.reshape(B, S, c.n_kv_heads, G, hd)
         tp = mesh is not None and mesh.shape.get("model", 1) > 1
-        if attn_impl == "pallas" and S == 1:
+        gemma_attn = (
+            c.attn_logit_softcap > 0 or c.sliding_window > 0
+            or c.query_pre_attn_scalar > 0
+        )
+        if gemma_attn:
+            # softcap / sliding-window / scalar-scaled attention: jnp path
+            # (the Pallas kernels don't carry these yet). window_l rides
+            # the scan: Gemma-2 alternates sliding (even) / global (odd).
+            win = None
+            if c.sliding_window > 0:
+                win = jnp.where(
+                    l_idx % 2 == 0, jnp.int32(c.sliding_window), jnp.int32(0)
+                )
+            attn = paged_attention_jnp(
+                qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens,
+                scale=(
+                    c.query_pre_attn_scalar ** -0.5
+                    if c.query_pre_attn_scalar > 0 else None
+                ),
+                softcap=c.attn_logit_softcap,
+                window=win,
+            )
+        elif attn_impl == "pallas" and S == 1:
             from dynamo_tpu.ops.paged_attention import (
                 decode_paged_attention,
                 decode_paged_attention_sharded,
@@ -667,15 +718,29 @@ def forward(
         else:
             attn = paged_attention_jnp(qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens)
         attn = attn.reshape(B, S, c.n_heads * hd)
-        h = h + lproj(mm(attn, lp["wo"]), attn, "wo")
+        attn_out = lproj(mm(attn, lp["wo"]), attn, "wo")
+        if c.post_norms:  # Gemma-2: norm the branch before the residual
+            attn_out = rms_norm(
+                attn_out, lp["post_attn_norm"], c.norm_eps, zero_centered=zc
+            )
+        h = h + attn_out
 
-        x = rms_norm(h, lp["mlp_norm"], c.norm_eps)
+        x = rms_norm(h, lp["mlp_norm"], c.norm_eps, zero_centered=zc)
         if use_moe:
             h = h + _moe_block(c, lp, x, mesh)
         else:
-            gate = jax.nn.silu(lproj(mm(x, lp["w_gate"]), x, "w_gate"))
+            act = (
+                partial(jax.nn.gelu, approximate=True)
+                if c.act == "gelu_tanh" else jax.nn.silu
+            )
+            gate = act(lproj(mm(x, lp["w_gate"]), x, "w_gate"))
             up = lproj(mm(x, lp["w_up"]), x, "w_up")
-            h = h + lproj(mm(gate * up, lp["w_down"]), gate * up, "w_down")
+            ffw = lproj(mm(gate * up, lp["w_down"]), gate * up, "w_down")
+            if c.post_norms:
+                ffw = rms_norm(
+                    ffw, lp["post_mlp_norm"], c.norm_eps, zero_centered=zc
+                )
+            h = h + ffw
         return (h, k_pool, v_pool), None
 
     dense_stack = params.get("layers_dense")
@@ -706,7 +771,8 @@ def forward(
              jnp.arange(c.n_layers, dtype=jnp.int32)),
         )
 
-    h = rms_norm(h, params["norm_f"], c.norm_eps)
+    h = rms_norm(h, params["norm_f"], c.norm_eps,
+                 zero_centered=c.norm_zero_centered)
     if last_index is not None:
         h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, E]
     lm_head = params.get("lm_head")
@@ -714,7 +780,11 @@ def forward(
         logits = tied_logits(h, params["embed"])
     else:
         logits = mm(h, lm_head)
-    return logits.astype(jnp.float32), k_pool, v_pool
+    logits = logits.astype(jnp.float32)
+    if c.final_logit_softcap:
+        cap = c.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits, k_pool, v_pool
 
 
 def encode(
@@ -732,6 +802,12 @@ def encode(
     if c.n_dense_layers:
         raise ValueError(
             "embedding forward is not supported for mixed dense/MoE models"
+        )
+    if (c.post_norms or c.norm_zero_centered or c.embed_scale
+            or c.attn_logit_softcap or c.sliding_window
+            or c.query_pre_attn_scalar or c.act != "silu"):
+        raise ValueError(
+            "embedding forward is not supported for Gemma-family configs"
         )
     B, S = tokens.shape
     hd = c.head_dim

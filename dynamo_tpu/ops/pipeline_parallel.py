@@ -37,10 +37,15 @@ from dynamo_tpu.models.quant import embed_lookup, mm, tied_logits
 
 
 def _check(config: ModelConfig) -> None:
-    if config.is_moe or config.is_mla or config.attn_bias or config.qk_norm:
+    c = config
+    if (c.is_moe or c.is_mla or c.attn_bias or c.qk_norm
+            or c.act != "silu" or c.post_norms or c.norm_zero_centered
+            or c.embed_scale or c.attn_logit_softcap
+            or c.final_logit_softcap or c.query_pre_attn_scalar
+            or c.sliding_window):
         raise NotImplementedError(
             "pipeline-parallel forward currently covers the plain dense "
-            "GQA family"
+            "GQA family (llama/mistral-style)"
         )
 
 

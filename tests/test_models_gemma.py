@@ -1,0 +1,140 @@
+"""Gemma-2 family (fourth architecture: GeGLU, scaled embeddings,
+zero-centered sandwich norms, attention/final softcaps, alternating
+sliding-window layers) — verified NUMERICALLY against HF transformers'
+Gemma2 implementation on a tiny random checkpoint (the strongest parity
+evidence available without real weights)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+
+
+def test_gemma2_forward_and_softcap_bound():
+    c = get_config("tiny-gemma2")
+    p = llama.init_params(c, jax.random.PRNGKey(0))
+    assert "post_attn_norm" in p["layers"]
+    k, v = llama.make_kv_pool(c, 8, 4)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    logits, _, _ = llama.forward(
+        c, p, jnp.asarray([[1, 2, 3, 4]]), jnp.asarray([[0, 1, 2, 3]]),
+        k, v, pt, jnp.asarray([4]),
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+    assert np.abs(np.asarray(logits)).max() <= c.final_logit_softcap + 1e-3
+
+
+def test_gemma2_engine_greedy_deterministic():
+    import asyncio
+
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.runtime.context import Context
+
+    runner = ModelRunner(
+        get_config("tiny-gemma2"), num_pages=64, page_size=4,
+        max_pages_per_seq=16, decode_buckets=(1, 2), prefill_buckets=(8, 16),
+        seed=9,
+    )
+
+    async def run():
+        engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+        engine.start()
+        try:
+            req = {"token_ids": [7, 3, 9, 2], "sampling": {"temperature": 0.0},
+                   "stop": {"max_tokens": 5, "stop_ids": []}}
+            outs = []
+            for _ in range(2):
+                toks = []
+                async for item in engine.generate(dict(req), Context()):
+                    toks.extend(item["token_ids"])
+                    if item["finish_reason"]:
+                        break
+                outs.append(toks)
+            assert outs[0] == outs[1] and len(outs[0]) == 5
+        finally:
+            engine.stop()
+
+    asyncio.run(run())
+
+
+def test_gemma2_matches_hf_transformers(tmp_path):
+    """End-to-end fidelity: a tiny random Gemma2 checkpoint produces the
+    same logits through (config_from_hf → load_hf_checkpoint → forward)
+    as through transformers' own Gemma2ForCausalLM (eager attention,
+    float32). Covers softcaps, sandwich norms, GeGLU, embed scaling, the
+    query_pre_attn scale, and the alternating sliding-window pattern."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from safetensors.torch import save_file
+
+    from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
+
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=4,  # exercises both sliding and global layers
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        query_pre_attn_scalar=16.0,
+        sliding_window=4,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+
+    sd = {k: v.contiguous() for k, v in model.state_dict().items()
+          if not k.endswith("lm_head.weight")}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "gemma2",
+        "vocab_size": hf_cfg.vocab_size,
+        "hidden_size": hf_cfg.hidden_size,
+        "intermediate_size": hf_cfg.intermediate_size,
+        "num_hidden_layers": hf_cfg.num_hidden_layers,
+        "num_attention_heads": hf_cfg.num_attention_heads,
+        "num_key_value_heads": hf_cfg.num_key_value_heads,
+        "head_dim": hf_cfg.head_dim,
+        "max_position_embeddings": 64,
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-6,
+        "attn_logit_softcapping": 50.0,
+        "final_logit_softcapping": 30.0,
+        "query_pre_attn_scalar": 16.0,
+        "sliding_window": 4,
+        "tie_word_embeddings": True,
+    }))
+
+    c = config_from_hf(str(tmp_path), name="tiny-g2")
+    assert c.post_norms and c.attn_logit_softcap == 50.0
+    assert c.sliding_window == 4 and c.embed_scale
+    params = load_hf_checkpoint(str(tmp_path), c, dtype="float32")
+
+    toks = [[3, 9, 27, 41, 5, 11, 60, 2]]  # long enough to hit the window
+    with torch.no_grad():
+        ref = model(torch.tensor(toks)).logits.numpy()
+
+    k, v = llama.make_kv_pool(c, 8, 4, dtype=jnp.float32)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    got, _, _ = llama.forward(
+        c, jax.tree.map(jnp.asarray, params),
+        jnp.asarray(toks), jnp.asarray([list(range(8))]),
+        k, v, pt, jnp.asarray([8]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[0], ref[0], rtol=2e-3, atol=2e-3
+    )
