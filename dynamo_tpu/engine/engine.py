@@ -139,6 +139,30 @@ class InferenceEngine:
         self._guided_lifter = None
         self._guided_cache: Dict[str, Any] = {}
         self._guided_lock = threading.Lock()
+        # called (from the step thread) on unrecoverable engine failure
+        # (multi-host GroupBroken): the worker wires it to process exit
+        self._fatal_cb = None
+
+    def on_fatal(self, cb) -> None:
+        self._fatal_cb = cb
+
+    def _fail_everything(self, message: str) -> None:
+        """Terminate every active/waiting/pending sequence with an error
+        item (clients see a proper stream end and can migrate)."""
+        seqs = list(self.scheduler.active) + list(self.scheduler.waiting)
+        seqs += [s for s in self._kv_pending]
+        for seq in seqs:
+            try:
+                self.scheduler.abort(seq.request_id)
+            except Exception:
+                pass
+            try:
+                self._emit_item(seq, {
+                    "finish_reason": "error", "error": message,
+                    "token_ids": [],
+                })
+            except Exception:
+                pass
 
     # -- guided decoding ---------------------------------------------------
     def _compile_guided(self, spec: Dict[str, Any]):
@@ -390,42 +414,71 @@ class InferenceEngine:
 
     # -- step loop (dedicated thread) --------------------------------------
     def _loop(self) -> None:
+        from dynamo_tpu.parallel.multihost import GroupBroken
+
         log.info("engine step loop started")
         while not self._stop.is_set():
-            self._drain_inbox()
-            plan = self.scheduler.step_plan()
-            if plan is None:
-                if not self.scheduler.has_work():
-                    time.sleep(self.idle_sleep_s)
-                continue
-            t0 = time.monotonic()
             try:
-                if isinstance(plan, PrefillPlan):
-                    self._run_prefill(plan)
-                    kind, n_tok = "prefill", len(plan.chunk)
-                else:
-                    self._run_decode(plan)
-                    kind, n_tok = "decode", len(plan.seqs)
-            except Exception:
-                # one bad step (malformed import, shape bug, OOM) must fail
-                # ITS sequences, never kill the step thread: a dead loop
-                # strands every queued request with no error and no stream
-                # end (the failure surfaces only as a distributed hang)
-                seqs = [plan.seq] if isinstance(plan, PrefillPlan) else plan.seqs
-                log.exception(
-                    "engine step failed; erroring %d sequence(s)", len(seqs)
-                )
-                for seq in seqs:
+                self._loop_once()
+            except GroupBroken as e:
+                # a multi-host group member died: limping along would hang
+                # the next program's collectives — fail EVERY request
+                # loudly and tell the process to exit so the supervisor
+                # restarts the whole group (requests migrate to other
+                # workers meanwhile). This catch sits OUTSIDE _loop_once
+                # so inbox paths (exports, imports, embeds, evict hooks)
+                # get the same fail-fast as the step itself.
+                log.critical("worker group broken: %s — failing all "
+                             "requests and shutting down", e)
+                self._fail_everything(f"worker group broken: {e}")
+                self._stop.set()
+                cb = self._fatal_cb
+                if cb is not None:
                     try:
-                        self._emit(seq, [], "error")
-                        self.scheduler.abort(seq.request_id)
+                        cb()
                     except Exception:
-                        log.exception("failed to fail sequence %s", seq.request_id)
-                self._recover_poisoned_pools()
-                continue
-            self._publish_fpm(kind, time.monotonic() - t0, n_tok)
-            self._publish_kv_events()
+                        pass
+                break
         log.info("engine step loop stopped")
+
+    def _loop_once(self) -> None:
+        from dynamo_tpu.parallel.multihost import GroupBroken
+
+        self._drain_inbox()
+        plan = self.scheduler.step_plan()
+        if plan is None:
+            if not self.scheduler.has_work():
+                time.sleep(self.idle_sleep_s)
+            return
+        t0 = time.monotonic()
+        try:
+            if isinstance(plan, PrefillPlan):
+                self._run_prefill(plan)
+                kind, n_tok = "prefill", len(plan.chunk)
+            else:
+                self._run_decode(plan)
+                kind, n_tok = "decode", len(plan.seqs)
+        except GroupBroken:
+            raise  # unrecoverable: handled by _loop's fail-fast
+        except Exception:
+            # one bad step (malformed import, shape bug, OOM) must fail
+            # ITS sequences, never kill the step thread: a dead loop
+            # strands every queued request with no error and no stream
+            # end (the failure surfaces only as a distributed hang)
+            seqs = [plan.seq] if isinstance(plan, PrefillPlan) else plan.seqs
+            log.exception(
+                "engine step failed; erroring %d sequence(s)", len(seqs)
+            )
+            for seq in seqs:
+                try:
+                    self._emit(seq, [], "error")
+                    self.scheduler.abort(seq.request_id)
+                except Exception:
+                    log.exception("failed to fail sequence %s", seq.request_id)
+            self._recover_poisoned_pools()
+            return
+        self._publish_fpm(kind, time.monotonic() - t0, n_tok)
+        self._publish_kv_events()
 
     def _recover_poisoned_pools(self) -> None:
         """A step that fails AFTER its jit dispatch consumed the donated
@@ -549,7 +602,11 @@ class InferenceEngine:
                 continue
             try:
                 self._admit_one_kv(seq, still)
-            except Exception:
+            except Exception as admit_err:
+                from dynamo_tpu.parallel.multihost import GroupBroken as _GB
+
+                if isinstance(admit_err, _GB):
+                    raise  # unrecoverable: _loop's fail-fast handles it
                 # a malformed/corrupt transfer payload (bad shape metadata,
                 # truncated bytes) must fail THIS request, not kill the
                 # step thread — this runs from _drain_inbox, outside the
@@ -617,6 +674,10 @@ class InferenceEngine:
             log.exception("embed batch failed")
             for _, fut, loop in batch:
                 loop.call_soon_threadsafe(_set_future_exc, fut, e)
+            from dynamo_tpu.parallel.multihost import GroupBroken as _GB
+
+            if isinstance(e, _GB):
+                raise  # unrecoverable: _loop's fail-fast handles it
 
     def _expire_parked(self) -> None:
         if not self._parked:

@@ -150,6 +150,16 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 # -- step plane ---------------------------------------------------------------
 
 
+class GroupBroken(RuntimeError):
+    """A group member died. The group CANNOT limp along: the next XLA
+    program's collectives would wait on the dead rank forever, so the
+    correct response is fail-fast — the leader fails in-flight requests,
+    exits, the followers see its socket close and exit too, and the
+    supervisor (k8s operator / systemd) restarts the whole group. In-flight
+    requests migrate to other workers via the frontend's Migration
+    operator, same as any worker death."""
+
+
 class StepPlaneLeader:
     """Leader side: accepts follower connections, broadcasts call frames.
 
@@ -181,7 +191,15 @@ class StepPlaneLeader:
         frame = _pack([method, list(args), kwargs])
         with self._lock:
             for c in self._conns:
-                c.sendall(frame)
+                try:
+                    c.sendall(frame)
+                except OSError as e:
+                    # a dead follower breaks the group (see GroupBroken);
+                    # detect it HERE, before enqueuing the local program
+                    # whose collectives would hang on the missing rank
+                    raise GroupBroken(
+                        f"step-plane send to a follower failed: {e}"
+                    ) from e
 
     def close(self) -> None:
         with self._lock:

@@ -376,6 +376,21 @@ async def async_main(args) -> None:
         )
     else:
         engine, card = build_engine(args)
+    group_broken_box = [False]
+    stop_box = []  # filled with (loop, stop_ev) once serving starts
+    if plane is not None and hasattr(engine, "on_fatal"):
+        # multi-host group leader: a dead follower is unrecoverable
+        # (GroupBroken) — exit nonzero so the supervisor restarts the
+        # whole group. Wired BEFORE the worker serves: a request hitting
+        # an already-broken group on the very first step must still
+        # trigger the exit path.
+        def _group_fatal():
+            group_broken_box[0] = True
+            if stop_box:
+                lp, ev = stop_box[0]
+                lp.call_soon_threadsafe(ev.set)
+
+        engine.on_fatal(_group_fatal)
     if args.vision:
         import jax
 
@@ -435,6 +450,7 @@ async def async_main(args) -> None:
         )
         print(f"worker serving {card.name} at {path}", flush=True)
     promotion_failed = False
+    group_broken = False
     try:
         stop_ev = asyncio.Event()
         import signal
@@ -452,8 +468,14 @@ async def async_main(args) -> None:
             shadow.promoted.add_done_callback(
                 lambda f: stop_ev.set() if f.exception() is not None else None
             )
+        stop_box.append((loop, stop_ev))
+        if group_broken_box[0]:
+            stop_ev.set()  # broke before we started waiting
         await stop_ev.wait()
-        if (shadow is not None and shadow.promoted.done()
+        group_broken = group_broken_box[0]
+        if group_broken:
+            print("worker group BROKEN; exiting for restart", flush=True)
+        elif (shadow is not None and shadow.promoted.done()
                 and shadow.promoted.exception() is not None):
             promotion_failed = True
             print("shadow promotion FAILED; exiting", flush=True)
@@ -462,19 +484,39 @@ async def async_main(args) -> None:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        # teardown steps are individually guarded: after a group break the
+        # jax.distributed coordination service is already unhealthy and a
+        # raising cleanup step must not mask the intended exit code
+        async def _safe(coro):
+            try:
+                await coro
+            except Exception:
+                log.exception("teardown step failed")
+
         if shadow is not None:
-            await shadow.stop()
+            await _safe(shadow.stop())
             if shadow.promoted.done() and shadow.promoted.exception() is None:
                 worker = shadow.promoted.result()
         if worker is not None:
-            await worker.stop()
+            await _safe(worker.stop())
         if status is not None:
-            await status.stop()
+            await _safe(status.stop())
         if plane is not None:
-            plane.close()  # releases followers from their replay loops
-        await runtime.shutdown()
+            try:
+                plane.close()  # releases followers from their replay loops
+            except Exception:
+                pass
+        await _safe(runtime.shutdown())
     if promotion_failed:
         raise SystemExit(1)
+    if group_broken:
+        # bypass interpreter teardown: the coordination service raises on
+        # atexit with a dead rank, which would repaint the exit code
+        import os as _os
+        import sys as _sys
+
+        _sys.stdout.flush()
+        _os._exit(13)
 
 
 def main(argv=None) -> None:
