@@ -204,6 +204,10 @@ def make_publisher(transport: str = "zmq") -> EventPublisher:
         return ZmqEventPublisher()
     if transport == "inproc":
         return InProcEventPublisher()
+    if transport == "nats":
+        from dynamo_tpu.runtime.nats_plane import NatsEventPublisher
+
+        return NatsEventPublisher()
     raise ValueError(f"unknown event transport {transport!r}")
 
 
@@ -212,4 +216,8 @@ def make_subscriber(transport: str = "zmq", subjects: Optional[List[str]] = None
         return ZmqEventSubscriber(subjects)
     if transport == "inproc":
         return InProcEventSubscriber(subjects)
+    if transport == "nats":
+        from dynamo_tpu.runtime.nats_plane import NatsEventSubscriber
+
+        return NatsEventSubscriber(subjects)
     raise ValueError(f"unknown event transport {transport!r}")

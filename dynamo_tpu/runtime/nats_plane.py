@@ -1,0 +1,391 @@
+"""NATS-core event transport (the reference's alternative event plane).
+
+Analog of reference lib/runtime/src/transports/event_plane/
+nats_transport.rs: where the default ZMQ plane is brokerless (publishers
+bind, subscribers track discovery), NATS routes everything through a
+broker — operationally simpler subscription management at the cost of a
+hop. This module speaks the NATS CORE wire protocol (text verbs:
+INFO/CONNECT/PING/PONG/SUB/UNSUB/PUB/MSG) directly over asyncio — no
+client library — so it interoperates with a real `nats-server` AND with
+the `MiniNatsServer` below (a protocol-faithful broker used by tests and
+dev stacks: `python -m dynamo_tpu.runtime.nats_plane --port 4222`).
+
+Select with `DistributedRuntime(event_transport="nats")` +
+`DYN_NATS_URL=nats://host:4222`. Payloads stay msgpack, subjects are the
+same KV_EVENT/FPM/seq_sync names — only the transport changes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import msgpack
+
+from dynamo_tpu.runtime.event_plane import EventPublisher, EventSubscriber
+
+log = logging.getLogger("dynamo_tpu.nats")
+
+DEFAULT_URL = "nats://127.0.0.1:4222"
+
+
+def _parse_url(url: str) -> Tuple[str, int]:
+    body = url.split("://", 1)[-1]
+    host, _, port = body.partition(":")
+    return host or "127.0.0.1", int(port or 4222)
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """NATS subject matching: '.'-separated tokens, '*' matches one
+    token, '>' matches the rest."""
+    pt, st = pattern.split("."), subject.split(".")
+    for i, p in enumerate(pt):
+        if i >= len(st):
+            return False
+        if p == ">":  # requires at least one remaining token (NATS)
+            return True
+        if p != "*" and p != st[i]:
+            return False
+    return len(pt) == len(st)
+
+
+class NatsClient:
+    """Minimal shared core-protocol client (publisher + subscriber)."""
+
+    def __init__(self, url: str):
+        self.url = url
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._sid = 0
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._reader_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+        self._closed = False  # closed by US (no reconnect)
+        self._subs: Dict[int, str] = {}  # sid -> pattern (re-SUB on redial)
+
+    async def ensure_connected(self) -> None:
+        async with self._lock:
+            if self._writer is not None or self._closed:
+                return
+            host, port = _parse_url(self.url)
+            self._reader, self._writer = await asyncio.open_connection(host, port)
+            info = await self._reader.readline()  # INFO {...}
+            if not info.startswith(b"INFO"):
+                raise ConnectionError(f"not a NATS server: {info[:40]!r}")
+            self._writer.write(
+                b'CONNECT {"verbose":false,"protocol":0,'
+                b'"name":"dynamo_tpu"}\r\nPING\r\n'
+            )
+            # re-establish subscriptions after a broker restart (ZMQ
+            # reconnects transparently; the brokered transport must too)
+            for sid, pattern in self._subs.items():
+                self._writer.write(f"SUB {pattern} {sid}\r\n".encode())
+            await self._writer.drain()
+            self._reader_task = asyncio.create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                if line.startswith(b"MSG "):
+                    # MSG <subject> <sid> [reply-to] <#bytes>
+                    parts = line.decode().strip().split(" ")
+                    n = int(parts[-1])
+                    payload = await self._reader.readexactly(n + 2)  # +\r\n
+                    await self._queue.put((parts[1], payload[:n]))
+                elif line.startswith(b"PING"):
+                    self._writer.write(b"PONG\r\n")
+                    await self._writer.drain()
+                # PONG / +OK / INFO updates: ignored
+        except (asyncio.CancelledError, ConnectionError, OSError):
+            pass
+        finally:
+            # mark dead so the next ensure_connected() re-dials
+            self._writer = None
+            self._reader = None
+            await self._queue.put(None)  # wake consumers on disconnect
+
+    async def publish(self, subject: str, payload: bytes) -> None:
+        frame = (
+            f"PUB {subject} {len(payload)}\r\n".encode() + payload + b"\r\n"
+        )
+        for attempt in (0, 1):  # one transparent redial on a dead broker
+            await self.ensure_connected()
+            if self._writer is None:
+                raise ConnectionError("nats client closed")
+            try:
+                self._writer.write(frame)
+                await self._writer.drain()
+                return
+            except (ConnectionError, OSError):
+                self._writer = None
+                if attempt:
+                    raise
+
+    async def subscribe(self, subject: str) -> int:
+        await self.ensure_connected()
+        self._sid += 1
+        self._subs[self._sid] = subject
+        if self._writer is not None:
+            self._writer.write(f"SUB {subject} {self._sid}\r\n".encode())
+            await self._writer.drain()
+        return self._sid
+
+    async def next_msg(self):
+        """Next (subject, payload) or None when the connection dropped;
+        the caller may loop — ensure_connected() will redial."""
+        return await self._queue.get()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class NatsEventPublisher(EventPublisher):
+    def __init__(self, url: Optional[str] = None):
+        self.url = url or os.environ.get("DYN_NATS_URL", DEFAULT_URL)
+        self._client = NatsClient(self.url)
+
+    @property
+    def address(self) -> str:
+        # brokered topology: the advertised address IS the broker —
+        # subscribers "connecting to a publisher" just join the broker
+        return self.url
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        await self._client.publish(
+            subject, msgpack.packb(payload, use_bin_type=True)
+        )
+
+    async def close(self) -> None:
+        await self._client.close()
+
+
+class NatsEventSubscriber(EventSubscriber):
+    def __init__(self, subjects: Optional[List[str]] = None,
+                 url: Optional[str] = None):
+        self.subjects = list(subjects or [">"])
+        self.url = url or os.environ.get("DYN_NATS_URL", DEFAULT_URL)
+        self._clients: Dict[str, NatsClient] = {}
+
+    def connect(self, address: str) -> None:
+        url = address if address.startswith("nats://") else self.url
+        if url not in self._clients:
+            self._clients[url] = NatsClient(url)
+
+    def disconnect(self, address: str) -> None:
+        # brokered: publisher departure needs no action (the broker stays)
+        pass
+
+    async def events(self) -> AsyncIterator[Tuple[str, Any]]:
+        if not self._clients:
+            self.connect(self.url)
+        queues = []
+        for c in self._clients.values():
+            await c.ensure_connected()
+            for s in self.subjects:
+                # '' (ZMQ subscribe-all) → '>'; other subjects match
+                # EXACTLY / by NATS wildcard — NATS cannot express ZMQ's
+                # byte-prefix filters (all in-tree subjects are exact)
+                await c.subscribe(s if s else ">")
+            queues.append(c)
+        if len(queues) == 1:
+            c = queues[0]
+            while True:
+                item = await c.next_msg()
+                if item is None:
+                    if c._closed:
+                        return
+                    # broker dropped: redial (with backoff) UNTIL it
+                    # comes back — only then return to next_msg(), since
+                    # nothing refills the queue while disconnected
+                    while not c._closed:
+                        await asyncio.sleep(0.5)
+                        try:
+                            await c.ensure_connected()
+                            break
+                        except (ConnectionError, OSError):
+                            continue
+                    continue
+                subject, raw = item
+                yield subject, msgpack.unpackb(raw, raw=False)
+        else:  # pragma: no cover - multiple brokers is unusual
+            pending = {
+                asyncio.create_task(c.next_msg()): c for c in queues
+            }
+            while pending:
+                done, _ = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    c = pending.pop(t)
+                    item = t.result()
+                    if item is None:
+                        continue
+                    subject, raw = item
+                    yield subject, msgpack.unpackb(raw, raw=False)
+                    pending[asyncio.create_task(c.next_msg())] = c
+
+    async def close(self) -> None:
+        for c in self._clients.values():
+            await c.close()
+
+
+# --------------------------------------------------------------------------
+# MiniNatsServer: protocol-faithful core broker (tests / dev stacks)
+# --------------------------------------------------------------------------
+
+
+class MiniNatsServer:
+    """Asyncio NATS-core broker: INFO/CONNECT/PING/SUB/UNSUB/PUB/MSG with
+    '*'/'>' wildcards. Enough protocol for real NATS core clients; no JetStream,
+    auth, or clustering (use a real nats-server for those)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        # conn id -> (writer, {sid: pattern})
+        self._conns: Dict[int, Tuple[asyncio.StreamWriter, Dict[str, str]]] = {}
+        self._next = 0
+
+    @property
+    def url(self) -> str:
+        return f"nats://{self.host}:{self.port}"
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("mini nats broker on %s", self.url)
+        return self.url
+
+    async def stop(self) -> None:
+        # sever client connections FIRST: Python 3.12's wait_closed()
+        # waits for live handlers, which are blocked in readline()
+        for wr, _ in list(self._conns.values()):
+            try:
+                wr.close()
+            except Exception:
+                pass
+        self._conns.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        cid = self._next
+        self._next += 1
+        subs: Dict[str, str] = {}
+        self._conns[cid] = (writer, subs)
+        writer.write(
+            b'INFO {"server_id":"dynamo-mini","version":"0.0.1",'
+            b'"proto":0,"max_payload":16777216}\r\n'
+        )
+        try:
+            await writer.drain()
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                verb = line.decode(errors="replace").strip()
+                up = verb.upper()
+                if up.startswith("CONNECT"):
+                    continue
+                if up.startswith("PING"):
+                    writer.write(b"PONG\r\n")
+                    await writer.drain()
+                elif up.startswith("PONG"):
+                    continue
+                elif up.startswith("SUB "):
+                    parts = verb.split(" ")
+                    # SUB <subject> [queue] <sid>
+                    subs[parts[-1]] = parts[1]
+                elif up.startswith("UNSUB "):
+                    subs.pop(verb.split(" ")[1], None)
+                elif up.startswith("PUB "):
+                    parts = verb.split(" ")
+                    subject = parts[1]
+                    n = int(parts[-1])
+                    payload = await reader.readexactly(n + 2)
+                    await self._fanout(subject, payload[:n])
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            pass
+        finally:
+            self._conns.pop(cid, None)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _fanout(self, subject: str, payload: bytes) -> None:
+        # real NATS delivers once PER MATCHING SUBSCRIPTION (sid), not per
+        # connection — overlapping patterns must double-deliver here too
+        # or tests pass against this broker and double-count in prod
+        writers = []
+        for cid, (wr, subs) in list(self._conns.items()):
+            wrote = False
+            for sid, pattern in subs.items():
+                if subject_matches(pattern, subject):
+                    try:
+                        wr.write(
+                            f"MSG {subject} {sid} {len(payload)}\r\n".encode()
+                            + payload + b"\r\n"
+                        )
+                        wrote = True
+                    except (ConnectionError, OSError):
+                        self._conns.pop(cid, None)
+                        wrote = False
+                        break
+            if wrote:
+                writers.append((cid, wr))
+
+        async def _drain(cid, wr):
+            try:
+                # a stalled consumer must not wedge the whole broker: cap
+                # the drain and drop the laggard connection instead
+                await asyncio.wait_for(wr.drain(), timeout=5.0)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                self._conns.pop(cid, None)
+                try:
+                    wr.close()
+                except Exception:
+                    pass
+
+        if writers:
+            await asyncio.gather(*[_drain(c, w) for c, w in writers])
+
+
+def main(argv=None) -> None:  # pragma: no cover - dev helper
+    import argparse
+
+    p = argparse.ArgumentParser("dynamo_tpu.runtime.nats_plane")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=4222)
+    args = p.parse_args(argv)
+
+    async def run():
+        srv = MiniNatsServer(args.host, args.port)
+        await srv.start()
+        print(f"mini nats broker on {srv.url}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()
