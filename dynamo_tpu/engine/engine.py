@@ -142,6 +142,22 @@ class InferenceEngine:
         # called (from the step thread) on unrecoverable engine failure
         # (multi-host GroupBroken): the worker wires it to process exit
         self._fatal_cb = None
+        # RL admin surface (reference lib/rl role): pause gates NEW
+        # admissions during weight refreshes; weights_version counts
+        # successful reloads
+        self.paused = False
+        self.weights_version = 0
+
+    async def update_weights(self, orbax_path: str) -> int:
+        """Swap serving weights from an orbax snapshot on the STEP thread
+        (never racing an in-flight jit dispatch). Returns the new
+        weights_version. Pause first for a clean cut between rollouts —
+        running sequences otherwise continue on the new weights."""
+        self.start()
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inbox.put(("reload_weights", (orbax_path, fut, loop)))
+        return await fut
 
     def on_fatal(self, cb) -> None:
         self._fatal_cb = cb
@@ -252,6 +268,14 @@ class InferenceEngine:
         rid = context.id
         self._streams[rid] = (out, loop)
 
+        if self.paused:
+            yield {
+                "finish_reason": "error",
+                "error": "worker paused (weight update in progress)",
+                "token_ids": [],
+            }
+            self._streams.pop(rid, None)
+            return
         annotations = request.get("annotations") or {}
         if annotations.get("kind") == "embedding":
             fut: asyncio.Future = loop.create_future()
@@ -492,9 +516,24 @@ class InferenceEngine:
             return
         log.error("KV pools were consumed by a failed step; rebuilding "
                   "(all device-cached blocks lost)")
+        # host/disk tiers keep their copies (those bytes are real) and
+        # pending disagg imports stay admittable into the fresh pools
+        self._flush_kv_state("error", drop_pending=False, clear_tiers=False)
+
+    def _flush_kv_state(self, error_message: str, *, drop_pending: bool,
+                        clear_tiers: bool) -> None:
+        """Fail active sequences, release parked entries, zero the device
+        pools + prefix cache; optionally drop queued disagg imports and
+        flush the lower KV tiers (weight-update policy invalidation)."""
         for seq in list(self.scheduler.active):
             try:
-                self._emit(seq, [], "error")
+                if error_message == "error":
+                    self._emit(seq, [], "error")
+                else:
+                    self._emit_item(seq, {
+                        "finish_reason": "error", "error": error_message,
+                        "token_ids": [],
+                    })
                 self.scheduler.abort(seq.request_id)
             except Exception:
                 log.exception("failed to fail sequence %s", seq.request_id)
@@ -504,8 +543,20 @@ class InferenceEngine:
                 self.scheduler.release_parked(seq)
             except Exception:
                 log.exception("failed to release parked %s", rid)
+        if drop_pending:
+            pending, self._kv_pending = self._kv_pending, []
+            for seq in pending:
+                try:
+                    self._emit_item(seq, {
+                        "finish_reason": "error", "error": error_message,
+                        "token_ids": [],
+                    })
+                except Exception:
+                    pass
         self.runner.reset_kv_pools()
         self.pool.reset()
+        if clear_tiers and self.host_pool is not None:
+            self.host_pool.clear()
         self._publish_kv_events()
 
     def _drain_inbox(self) -> None:
@@ -548,6 +599,26 @@ class InferenceEngine:
                 self._host_export(hashes, fut, loop)
             elif op == "host_import":
                 self._host_import(*arg)
+            elif op == "reload_weights":
+                path, fut, loop = arg
+                try:
+                    self.runner.reload_params(path)
+                    # ALL cached KV was computed under the old policy:
+                    # serving it against the new weights silently mixes
+                    # policies (caught by the RL parity test)
+                    self._flush_kv_state(
+                        "weights updated mid-flight; retry",
+                        drop_pending=True,  # queued disagg imports carry
+                        # old-policy KV bytes — admitting them would mix
+                        clear_tiers=True,
+                    )
+                    self.weights_version += 1
+                    loop.call_soon_threadsafe(
+                        _set_future, fut, self.weights_version
+                    )
+                except Exception as e:
+                    log.exception("weight reload failed")
+                    loop.call_soon_threadsafe(_set_future_exc, fut, e)
         self._admit_kv_pending()
         self._expire_parked()
         self._run_embeds()

@@ -669,6 +669,26 @@ class ModelRunner:
             self._sampling_cache[key] = hit
         return hit
 
+    def reload_params(self, path: str) -> None:
+        """Swap the serving weights from an orbax snapshot IN PLACE (the
+        RL weight-update path, reference lib/rl role: policy weights
+        refresh between rollouts without restarting the worker). The
+        jitted step functions take params as an argument, so the swap is
+        just a device_put with the same shardings — no recompilation."""
+        from dynamo_tpu.engine.weights import load_orbax
+
+        new = load_orbax(path)
+        new = jax.tree.map(jnp.asarray, new)
+        if self.quantize in ("int8", "fp8"):
+            # the jitted step fns were traced against the QUANTIZED tree
+            # (scale leaves, int8 dtypes) — a raw tree would retrace/crash
+            from dynamo_tpu.models.quant import quantize_params
+
+            new = quantize_params(new, mode=self.quantize, donate=True)
+        self.params = jax.device_put(
+            new, self.policy.params_sharding(new)
+        )
+
     @property
     def has_draft(self) -> bool:
         return self.draft_config is not None

@@ -102,6 +102,7 @@ class HttpService:
                 web.post("/v1/messages", self.anthropic_messages),
                 web.post("/v1/messages/count_tokens", self.anthropic_count_tokens),
                 web.get("/v1/models", self.list_models),
+                web.get("/v1/rl", self.rl_overview),
                 web.get("/v1/models/{model}", self.get_model),
                 web.get("/health", self.health),
                 web.get("/live", self.live),
@@ -163,6 +164,47 @@ class HttpService:
     async def ready(self, request: web.Request) -> web.Response:
         ok = bool(self.manager.models)
         return web.json_response({"ready": ok}, status=200 if ok else 503)
+
+    async def rl_overview(self, request: web.Request) -> web.Response:
+        """Read-only fan-in over every discovered worker's RL admin
+        surface (reference lib/rl: frontend aggregates dyn://ns.comp.rl):
+        per-instance paused state + weights version."""
+        async def probe(ns, comp, instance_ids):
+            rows = []
+            client = self.runtime.client(f"{ns}/{comp}/rl")
+            await client.start()
+            try:
+                try:
+                    # the watch needs a beat to deliver the rl instances
+                    await client.wait_ready(timeout=2)
+                except asyncio.TimeoutError:
+                    return rows  # no RL surface (e.g. sidecar worker)
+                for iid in instance_ids:
+                    try:
+                        async for item in client.direct(
+                            {"op": "describe"}, iid
+                        ):
+                            rows.append(dict(item, endpoint=f"{ns}/{comp}"))
+                            break
+                    except Exception as e:
+                        rows.append({"instance": iid, "error": str(e),
+                                     "endpoint": f"{ns}/{comp}"})
+            finally:
+                await client.close()
+            return rows
+
+        seen = set()
+        tasks = []
+        for name, entry in self.manager.models.items():
+            ns, comp, _ = entry.endpoint_path.split("/", 2)
+            if (ns, comp) in seen:
+                continue
+            seen.add((ns, comp))
+            # components probe CONCURRENTLY: a surface-less component costs
+            # one shared 2s timeout, not a serial 2s each
+            tasks.append(probe(ns, comp, list(entry.instance_ids)))
+        out = [r for rows in await asyncio.gather(*tasks) for r in rows]
+        return web.json_response({"workers": out})
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(

@@ -201,6 +201,26 @@ class DiskKvPool:
             f.write(encode_block(parent_hash, k, v))
         os.replace(tmp, self._path(block_hash))
 
+    def clear(self) -> List[int]:
+        """Policy flush: drop the index AND the backing files (a restart
+        rescan must not resurrect stale-policy blocks). No spilling."""
+        import os as _os
+
+        with self._lock:
+            dropped = list(self._blocks)
+            self._blocks.clear()
+            self._hash_only.clear()
+            self._pending.clear()
+        for h in dropped:
+            try:
+                _os.unlink(self._path(h))
+            except OSError:
+                pass
+        if dropped:
+            for cb in self._evict_listeners:
+                cb(dropped)
+        return dropped
+
     def on_evict(self, cb) -> None:
         self._evict_listeners.append(cb)
 
@@ -378,6 +398,15 @@ class TieredKv:
 
     def _tiers(self):
         return [t for t in (self.host, self.disk, self.obj) if t is not None]
+
+    def clear(self) -> None:
+        """Flush every tier (weight-update policy invalidation): blocks
+        cached under the old weights must not be onboarded under the new
+        ones. Tiers fire their removal events themselves."""
+        for t in self._tiers():
+            clear = getattr(t, "clear", None)
+            if clear is not None:
+                clear()
 
     def match(self, hashes: List[int]) -> int:
         n = 0

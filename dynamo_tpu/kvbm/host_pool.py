@@ -84,6 +84,17 @@ class HostKvPool:
             for cb in self._evict_listeners:
                 cb(dropped)
 
+    def clear(self) -> List[int]:
+        """Drop EVERY block without spilling (policy flush: the data is
+        invalid, demotion would preserve it). Fires removal events so
+        router lower-tier credits drop too; returns the cleared hashes."""
+        dropped = list(self._blocks)
+        self._blocks.clear()
+        if dropped:
+            for cb in self._evict_listeners:
+                cb(dropped)
+        return dropped
+
     # -- onboard (G2 → G1) --------------------------------------------------
     def match(self, hashes: List[int]) -> int:
         """Leading blocks of `hashes` resident in this tier."""

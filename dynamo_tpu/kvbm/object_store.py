@@ -139,6 +139,20 @@ class ObjectKvPool:
     def _key(self, block_hash: int) -> str:
         return f"{block_hash & 0xFFFFFFFFFFFFFFFF:016x}.kvb"
 
+    def clear(self) -> List[int]:
+        """Policy flush: drop the local index and pending writes. Stored
+        objects become unreachable (content-addressed; the backend may
+        garbage-collect them out of band)."""
+        with self._lock:
+            dropped = list(self._blocks)
+            self._blocks.clear()
+            self._hash_only.clear()
+            self._pending.clear()
+        if dropped:
+            for cb in self._evict_listeners:
+                cb(dropped)
+        return dropped
+
     def on_evict(self, cb) -> None:
         self._evict_listeners.append(cb)
 

@@ -256,6 +256,46 @@ async def serve_worker(
         f"{namespace}/{component}/kv_fetch", kv_fetch, instance_id=instance_id
     )
 
+    # RL admin surface (reference lib/rl: dyn://ns.comp.rl endpoints with
+    # frontend read-only fan-in): pause/resume admission around weight
+    # refreshes, orbax weight hot-swap, version reporting
+    async def rl_admin(request, context):
+        req = request or {}
+        op = req.get("op", "describe")
+        if op == "pause":
+            engine.paused = True
+        elif op == "resume":
+            engine.paused = False
+        elif op == "update_weights":
+            path = req.get("orbax")
+            if not path:
+                yield {"error": "update_weights needs 'orbax': <snapshot dir>"}
+                return
+            try:
+                version = await engine.update_weights(path)
+            except Exception as e:
+                yield {"error": f"weight reload failed: {e}"}
+                return
+            yield {
+                "model": card.name, "paused": bool(engine.paused),
+                "weights_version": version, "instance": instance_id,
+            }
+            return
+        elif op != "describe":
+            yield {"error": f"unknown rl op {op!r}"}
+            return
+        yield {
+            "model": card.name,
+            "paused": bool(getattr(engine, "paused", False)),
+            "weights_version": int(getattr(engine, "weights_version", 0)),
+            "instance": instance_id,
+        }
+
+    if hasattr(engine, "update_weights"):
+        await runtime.serve_endpoint(
+            f"{namespace}/{component}/rl", rl_admin, instance_id=instance_id
+        )
+
     # cross-worker KVBM onboarding (reference kvbm-engine onboarding
     # sessions): peers pull lower-tier blocks from this worker, and this
     # worker pulls from peers when the router's hint names one

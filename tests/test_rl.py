@@ -1,0 +1,130 @@
+"""RL admin surface (reference lib/rl role): pause/resume admission,
+orbax weight hot-swap on the step thread, version reporting, and the
+frontend's read-only /v1/rl fan-in."""
+
+import asyncio
+
+import jax
+import pytest
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.engine.model_runner import ModelRunner
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.runtime.context import Context
+
+
+def _runner(seed):
+    return ModelRunner(
+        get_config("tiny"), num_pages=64, page_size=4, max_pages_per_seq=16,
+        decode_buckets=(1, 2), prefill_buckets=(8, 16), seed=seed,
+    )
+
+
+async def _gen(engine, prompt=(5, 6, 7, 8), n=5):
+    toks = []
+    items = []
+    async for item in engine.generate(
+        {"token_ids": list(prompt), "sampling": {"temperature": 0.0},
+         "stop": {"max_tokens": n, "stop_ids": []}},
+        Context(),
+    ):
+        items.append(item)
+        toks.extend(item["token_ids"])
+        if item["finish_reason"]:
+            break
+    return toks, items
+
+
+async def test_pause_update_weights_resume(tmp_path):
+    from dynamo_tpu.engine.weights import save_orbax
+
+    engine = InferenceEngine(_runner(seed=0), max_batch=4, chunk_size=16)
+    engine.start()
+    try:
+        before, _ = await _gen(engine)
+
+        engine.paused = True
+        _, items = await _gen(engine)
+        assert items[-1]["finish_reason"] == "error"
+        assert "paused" in items[-1]["error"]
+
+        # hot-swap to a DIFFERENT set of weights (seed 1)
+        other = llama.init_params(get_config("tiny"), jax.random.PRNGKey(1))
+        snap = tmp_path / "snap"
+        save_orbax(other, str(snap))
+        v = await engine.update_weights(str(snap))
+        assert v == 1 and engine.weights_version == 1
+
+        engine.paused = False
+        after, _ = await _gen(engine)
+        assert after != before  # new policy weights actually serve
+        # reference output under seed-1 weights built fresh
+        ref_engine = InferenceEngine(_runner(seed=1), max_batch=4,
+                                     chunk_size=16)
+        ref_engine.start()
+        try:
+            ref, _ = await _gen(ref_engine)
+        finally:
+            ref_engine.stop()
+        assert after == ref
+    finally:
+        engine.stop()
+
+
+async def test_rl_endpoint_and_frontend_fanin(tmp_path):
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.protocols import ModelCard
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="rl"),
+                            event_transport="inproc")
+    engine = InferenceEngine(_runner(seed=3), max_batch=4, chunk_size=16)
+    w = await serve_worker(rt, engine, ModelCard(name="tiny"))
+    frt = DistributedRuntime(discovery=MemDiscovery(realm="rl"),
+                             event_transport="inproc")
+    svc = None
+    try:
+        manager = ModelManager()
+        watcher = ModelWatcher(frt, manager)
+        svc = HttpService(frt, manager, watcher, port=0)
+        base = await svc.start()
+        await watcher.wait_for_model(timeout=20)
+
+        # direct admin ops over the request plane
+        client = rt.client("dyn/tpu-worker/rl")
+        await client.start()
+        await client.wait_ready()
+
+        async def op(o, **kw):
+            async for item in client.generate({"op": o, **kw}):
+                return item
+
+        d = await op("describe")
+        assert d["model"] == "tiny" and d["weights_version"] == 0
+        assert not d["paused"]
+        await op("pause")
+        assert (await op("describe"))["paused"]
+        await op("resume")
+        assert not (await op("describe"))["paused"]
+
+        # frontend read-only fan-in
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/rl") as r:
+                assert r.status == 200
+                body = await r.json()
+        assert len(body["workers"]) == 1
+        assert body["workers"][0]["model"] == "tiny"
+        assert body["workers"][0]["weights_version"] == 0
+        await client.close()
+    finally:
+        if svc is not None:
+            await svc.stop()
+        await frt.shutdown(drain_timeout=1)
+        await w.stop()
+        await rt.shutdown(drain_timeout=1)
