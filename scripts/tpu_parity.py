@@ -8,6 +8,8 @@ Checks, each compiled and executed on the default (non-CPU) backend:
   2. decode paged attention int8 KV   vs jnp on the same quantized pools
   3. prefill flash attention bf16     vs paged_attention_jnp
   4. prefill flash attention int8 KV  vs jnp on the same quantized pools
+  5. MLA decode attention bf16        vs paged_attention_jnp over latents
+  6. batched page copy/permute + scatter roundtrip (exact)
 
 Exit 0 = all parities within tolerance; nonzero = mismatch (printed).
 Run via `python scripts/tpu_parity.py` with no JAX_PLATFORMS override, or
@@ -97,6 +99,50 @@ def check_prefill(quantized: bool) -> float:
     return worst
 
 
+def check_mla() -> float:
+    from dynamo_tpu.ops.mla_attention import decode_mla_attention
+
+    rng = np.random.default_rng(5)
+    B, H, dc, dr, NP, PS, MP = 8, 16, 512, 64, 48, 16, 6
+    Dl = dc + dr
+    q = jnp.asarray(rng.standard_normal((B, H, Dl)), jnp.bfloat16)
+    lat = jnp.asarray(rng.standard_normal((NP, PS, 1, Dl)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    kv = jnp.asarray(rng.integers(1, MP * PS, B).astype(np.int32))
+    scale = (128 + dr) ** -0.5
+    out = decode_mla_attention(q, lat, pt, kv, dc=dc, scale=scale)
+    qg = q[:, None, None, :, :].transpose(0, 2, 1, 3, 4)
+    ref = paged_attention_jnp(
+        qg.astype(jnp.float32), lat.astype(jnp.float32),
+        lat[..., :dc].astype(jnp.float32), pt, (kv - 1)[:, None], kv,
+        scale=scale,
+    )[:, 0, 0]
+    return float(np.abs(
+        np.asarray(out, np.float32) - np.asarray(ref, np.float32)
+    ).max())
+
+
+def check_block_copy() -> float:
+    from dynamo_tpu.ops.block_copy import gather_pages, scatter_pages
+
+    rng = np.random.default_rng(6)
+    pool = jnp.asarray(rng.standard_normal((3, 32, 16, 8, 128)), jnp.bfloat16)
+    idx = jnp.asarray([7, 0, 19, 30], jnp.int32)
+    out = gather_pages(pool, idx)
+    ref = np.asarray(pool)[:, [7, 0, 19, 30]]
+    d1 = float(np.abs(np.asarray(out, np.float32) - ref.astype(np.float32)).max())
+    hm = gather_pages(pool, idx, head_major=True)
+    d2 = float(np.abs(
+        np.asarray(hm, np.float32) - ref.transpose(0, 1, 3, 2, 4).astype(np.float32)
+    ).max())
+    dst = jnp.zeros_like(pool)
+    back = scatter_pages(dst, jnp.asarray([1, 2, 3, 4], jnp.int32), out)
+    d3 = float(np.abs(
+        np.asarray(back, np.float32)[:, 1:5] - ref.astype(np.float32)
+    ).max())
+    return max(d1, d2, d3)
+
+
 def main() -> int:
     platform = jax.devices()[0].platform
     print(f"backend: {platform} ({jax.devices()})")
@@ -109,6 +155,8 @@ def main() -> int:
         ("decode int8-kv", lambda: check_decode(True)),
         ("prefill bf16", lambda: check_prefill(False)),
         ("prefill int8-kv", lambda: check_prefill(True)),
+        ("mla decode bf16", check_mla),
+        ("block copy/permute", check_block_copy),
     ):
         d = fn()
         ok = d < TOL
