@@ -441,7 +441,8 @@ def _write_kv(pool, l_idx, new, page_table, positions):
 
 
 def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
-                   kv_lens, attn_impl="jnp", mesh=None):
+                   kv_lens, attn_impl="jnp", mesh=None, q_start=None,
+                   q_len=None):
     """Multi-head latent attention (DeepSeek V2/V3/R1), absorbed form.
 
     Per token the pool caches one [d_c + d_rh] vector: the RMS-normed KV
@@ -483,7 +484,19 @@ def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
     w_uk, w_uv = wkv_b[..., :dn], wkv_b[..., dn:]
     q_abs = jnp.einsum("bshn,chn->bshc", q_nope, w_uk)  # [B,S,H,d_c]
     scale = attn_score_scale(c, dn + dr)
-    if attn_impl == "pallas" and S == 1:
+    tp = mesh is not None and mesh.shape.get("model", 1) > 1
+    if (attn_impl == "pallas" and S > 1 and not tp
+            and q_start is not None):
+        # chunked-prefill hot path: flash MLA over latent pages (the TP
+        # variant reuses the jnp path until a sharded wrapper lands)
+        from dynamo_tpu.ops.mla_attention import prefill_mla_attention
+
+        qp = jnp.concatenate([q_abs, q_r], axis=-1)  # [B, S, H, Dl]
+        attn_lat = prefill_mla_attention(
+            qp, lat_pool_l, page_table, q_start, q_len, kv_lens,
+            dc=dc, scale=scale,
+        )
+    elif attn_impl == "pallas" and S == 1:
         # decode hot path: Pallas streams latent pages once — the same
         # DMA feeds both score (full latent) and value (first d_c cols)
         from dynamo_tpu.ops.mla_attention import (
@@ -492,7 +505,6 @@ def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
         )
 
         qd = jnp.concatenate([q_abs, q_r], axis=-1)[:, 0]  # [B, H, Dl]
-        tp = mesh is not None and mesh.shape.get("model", 1) > 1
         if tp:
             attn_lat = decode_mla_attention_sharded(
                 qd, lat_pool_l, page_table, kv_lens, mesh, dc=dc, scale=scale,
@@ -601,6 +613,7 @@ def forward(
             attn, k_pool = _mla_attention(
                 c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
                 kv_lens, attn_impl=attn_impl, mesh=mesh,
+                q_start=q_start, q_len=q_len,
             )
             h = h + mm(attn, lp["wo"])
             x = rms_norm(h, lp["mlp_norm"], c.norm_eps)

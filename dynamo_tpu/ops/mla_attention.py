@@ -133,6 +133,147 @@ def decode_mla_attention(
     )(page_table, kv_lens, q, lat)
 
 
+def _mla_prefill_kernel(
+    page_table_ref,  # [B, MP] int32
+    q_start_ref,  # [B] int32
+    q_len_ref,  # [B] int32
+    kv_lens_ref,  # [B] int32
+    q_ref,  # [Sq, H, Dl] one query block
+    lat_ref,  # [PS, Dl] one latent page
+    o_ref,  # [Sq, H, dc]
+    m_ref,  # [Sq*H, 1] f32
+    l_ref,  # [Sq*H, 1] f32
+    acc_ref,  # [Sq*H, dc] f32
+    *,
+    page_size: int,
+    q_block: int,
+    scale: float,
+    dc: int,
+):
+    b = pl.program_id(0)
+    sb = pl.program_id(1)
+    i = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = q_start_ref[b]
+    q_len = q_len_ref[b]
+    kv_len = kv_lens_ref[b]
+    blk_rows = jnp.minimum(q_len - sb * q_block, q_block)
+    blk_max_pos = q_start + sb * q_block + blk_rows - 1
+    page_first = i * page_size
+    needed = (blk_rows > 0) & (page_first <= blk_max_pos) & (page_first < kv_len)
+
+    @pl.when(needed)
+    def _compute():
+        Sq, H, Dl = q_ref.shape
+        q = q_ref[...].astype(jnp.float32).reshape(Sq * H, Dl)
+        lat = lat_ref[...].astype(jnp.float32)  # [PS, Dl]
+        s = lax.dot_general(
+            q, lat, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Sq*H, PS]
+        row = lax.broadcasted_iota(jnp.int32, s.shape, 0) // H
+        col = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        q_pos = q_start + sb * q_block + row
+        kv_pos = page_first + col
+        mask = (row < blk_rows) & (kv_pos <= q_pos) & (kv_pos < kv_len)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_add = jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p, lat[:, :dc], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Sq*H, dc]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        l_ref[...] = l_ref[...] * alpha + l_add
+        m_ref[...] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        Sq, H, dcw = o_ref.shape
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype).reshape(Sq, H, dcw)
+
+
+@functools.partial(jax.jit, static_argnames=("dc", "scale", "q_block", "interpret"))
+def prefill_mla_attention(
+    q: jax.Array,  # [B, S, H, Dl] absorbed+rope queries (chunk)
+    lat_pool_l: jax.Array,  # [NP, PS, 1, Dl]
+    page_table: jax.Array,  # [B, MP]
+    q_start: jax.Array,  # [B] absolute position of query token 0
+    q_len: jax.Array,  # [B] valid query tokens
+    kv_lens: jax.Array,  # [B] context incl. this chunk
+    *,
+    dc: int,
+    scale: float,
+    q_block: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-style MLA prefill over latent pages (one DMA per page feeds
+    scores AND values; causally-dead/past-kv pages are clamped in the
+    index_map so Pallas elides their copies). Returns the attended
+    latents [B, S, H, dc]; padding rows return 0. Same positions
+    contract as ops/flash_prefill.py."""
+    B, S, H, Dl = q.shape
+    NP, PS, _, _ = lat_pool_l.shape
+    MP = page_table.shape[1]
+    lat = lat_pool_l.reshape(NP, PS, Dl)
+    # VMEM budget: the f32 acc scratch is q_block*H x dc — at flagship MLA
+    # dims (H=128, dc=512) a 128-row block would need ~34MiB of scratch
+    # alone. Cap the block so acc stays ~<=4MiB; tiny test dims keep the
+    # requested block.
+    q_block = min(q_block, max(8, (4 << 20) // max(H * dc * 4, 1)))
+    q_block = min(q_block, S)
+    while S % q_block:
+        q_block -= 1
+    n_sblk = S // q_block
+
+    def lat_index(b, sb, i, pt, qs, ql, kl):
+        rows = jnp.minimum(ql[b] - sb * q_block, q_block)
+        blk_max_pos = qs[b] + sb * q_block + jnp.maximum(rows, 1) - 1
+        last = jnp.minimum(blk_max_pos, jnp.maximum(kl[b] - 1, 0)) // PS
+        last = jnp.clip(last, 0, MP - 1)
+        return (pt[b, jnp.minimum(i, last)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, n_sblk, MP),
+        in_specs=[
+            pl.BlockSpec((None, q_block, H, Dl),
+                         lambda b, sb, i, pt, qs, ql, kl: (b, sb, 0, 0)),
+            pl.BlockSpec((None, PS, Dl), lat_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, q_block, H, dc),
+            lambda b, sb, i, pt, qs, ql, kl: (b, sb, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((q_block * H, 1), jnp.float32),
+            pltpu.VMEM((q_block * H, 1), jnp.float32),
+            pltpu.VMEM((q_block * H, dc), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _mla_prefill_kernel, page_size=PS, q_block=q_block,
+            scale=scale, dc=dc,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, dc), q.dtype),
+        interpret=interpret,
+    )(page_table, q_start, q_len, kv_lens, q, lat)
+
+
 def decode_mla_attention_sharded(
     q: jax.Array,  # [B, H, Dl] heads sharded over `axis_name`
     lat_pool_l: jax.Array,  # [NP, PS, 1, Dl] REPLICATED (Hk=1 — no head

@@ -9,7 +9,8 @@ Checks, each compiled and executed on the default (non-CPU) backend:
   3. prefill flash attention bf16     vs paged_attention_jnp
   4. prefill flash attention int8 KV  vs jnp on the same quantized pools
   5. MLA decode attention bf16        vs paged_attention_jnp over latents
-  6. batched page copy/permute + scatter roundtrip (exact)
+  6. MLA prefill flash attention bf16 vs the same reference
+  7. batched page copy/permute + scatter roundtrip (exact)
 
 Exit 0 = all parities within tolerance; nonzero = mismatch (printed).
 Run via `python scripts/tpu_parity.py` with no JAX_PLATFORMS override, or
@@ -122,6 +123,35 @@ def check_mla() -> float:
     ).max())
 
 
+def check_mla_prefill() -> float:
+    from dynamo_tpu.ops.mla_attention import prefill_mla_attention
+
+    rng = np.random.default_rng(7)
+    B, S, H, dc, dr, NP, PS, MP = 2, 128, 16, 512, 64, 40, 16, 16
+    Dl = dc + dr
+    q = jnp.asarray(rng.standard_normal((B, S, H, Dl)), jnp.bfloat16)
+    lat = jnp.asarray(rng.standard_normal((NP, PS, 1, Dl)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = np.asarray([0, 64], np.int32)
+    ql = np.asarray([128, 128], np.int32)
+    kv = jnp.asarray(qs + ql)
+    scale = (128 + dr) ** -0.5
+    out = prefill_mla_attention(
+        q, lat, pt, jnp.asarray(qs), jnp.asarray(ql), kv, dc=dc, scale=scale
+    )
+    pos = np.zeros((B, S), np.int32)
+    for b in range(B):
+        pos[b] = np.arange(qs[b], qs[b] + S)
+    ref = paged_attention_jnp(
+        q.astype(jnp.float32)[:, :, None], lat.astype(jnp.float32),
+        lat[..., :dc].astype(jnp.float32), pt, jnp.asarray(pos), kv,
+        scale=scale,
+    )[:, :, 0]
+    return float(np.abs(
+        np.asarray(out, np.float32) - np.asarray(ref, np.float32)
+    ).max())
+
+
 def check_block_copy() -> float:
     from dynamo_tpu.ops.block_copy import gather_pages, scatter_pages
 
@@ -156,6 +186,7 @@ def main() -> int:
         ("prefill bf16", lambda: check_prefill(False)),
         ("prefill int8-kv", lambda: check_prefill(True)),
         ("mla decode bf16", check_mla),
+        ("mla prefill bf16", check_mla_prefill),
         ("block copy/permute", check_block_copy),
     ):
         d = fn()
