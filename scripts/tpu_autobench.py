@@ -1,0 +1,261 @@
+"""Unattended TPU evidence harness (VERDICT r4 next-round #1).
+
+The axon tunnel comes and goes; rounds 3-4 produced zero driver-verified
+hardware numbers because probing was manual. This supervisor converts any
+availability window into evidence with no human in the loop:
+
+    nohup python scripts/tpu_autobench.py --out PERF_r5.json \
+        --log docs/autobench_r5.log &
+
+Loop: probe the chip (scripts/tpu_probe.py under a hard timeout). While
+the probe fails, sleep and retry. The moment it succeeds, run the full
+battery, each stage a separate subprocess with its own timeout and
+process group (a hung axon backend must never wedge the supervisor):
+
+  1. kernel parity gate   scripts/tpu_parity.py (incl. MLA + block-copy)
+  2. bench.py sweeps      bf16 / T=64 / int8 weights / int8 KV /
+                          pallas-vs-jnp attn / long-context ISL=1024
+  3. hw_profile artifact  docs/profiles/<model>-hw.json (planner input)
+  4. SLO goodput          bench.py --goodput through the real stack
+
+Stage results accumulate across windows into --out (machine-readable)
+and a markdown section appended to docs/PERF.md per completed battery.
+The supervisor exits once every stage has succeeded at least once, or at
+--max-hours. Stages that already succeeded are not re-run in later
+windows (the chip window is the scarce resource).
+
+Reference bar this feeds: BASELINE.md's engine-tier numbers; the r4
+verdict asks decode >= 60% of the ~819 GB/s v5e HBM roofline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log_line(path: str, msg: str) -> None:
+    line = f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+
+
+def run_stage(cmd, timeout_s: float, extra_env=None):
+    """Run one battery stage in its own process group; kill the whole
+    group on timeout (axon leaves libtpu-holding zombies otherwise).
+    Returns (rc, seconds, tail, parsed_json_lines)."""
+    env = dict(os.environ)
+    # a lingering JAX_PLATFORMS=cpu (the documented axon-hang workaround)
+    # would make every stage "succeed" on CPU and record the numbers as
+    # hardware evidence — the exact failure this harness exists to prevent
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra_env or {})
+    t0 = time.time()
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            # bounded: a setsid'd grandchild holding the stdout pipe open
+            # past the killpg would otherwise block communicate() forever
+            out, _ = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out = b""
+        rc = -9
+    dt = time.time() - t0
+    text = (out or b"").decode(errors="replace")
+    tail = "\n".join(text.strip().splitlines()[-15:])
+    parsed = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            try:
+                parsed.append(json.loads(ln))
+            except ValueError:
+                pass
+    return rc, dt, tail, parsed
+
+
+def stage_ok(name: str, rc: int, parsed) -> bool:
+    if rc != 0:
+        return False
+    # bench stages emit {"tpu_unavailable": true} with rc=0 by contract
+    for obj in parsed:
+        if obj.get("tpu_unavailable") or obj.get("metric") == "bench_error":
+            return False
+    if name.startswith("bench") or name == "goodput":
+        return any("metric" in o and o.get("value", 0) > 0 for o in parsed)
+    return True
+
+
+BENCH_TIMEOUT = 1500.0
+
+
+def make_stages(model: str):
+    """(name, cmd, timeout_s, env) battery, cheapest-evidence first."""
+    py = sys.executable
+    bench = [py, "bench.py"]
+    prof_out = os.path.join("docs", "profiles", f"{model}-hw.json")
+    return [
+        ("parity", [py, "scripts/tpu_parity.py"], 2400.0, {}),
+        ("bench_bf16", bench, BENCH_TIMEOUT, {}),
+        ("bench_t64", bench, BENCH_TIMEOUT, {"DYN_BENCH_T": "64"}),
+        ("bench_int8w", bench, BENCH_TIMEOUT, {"DYN_BENCH_QUANTIZE": "int8"}),
+        ("bench_int8kv", bench, BENCH_TIMEOUT, {"DYN_BENCH_KV_QUANTIZE": "int8"}),
+        ("bench_attn_pallas", bench, BENCH_TIMEOUT, {"DYN_BENCH_ATTN": "pallas"}),
+        ("bench_attn_jnp", bench, BENCH_TIMEOUT, {"DYN_BENCH_ATTN": "jnp"}),
+        ("bench_isl1024", bench, BENCH_TIMEOUT,
+         {"DYN_BENCH_ISL": "1024", "DYN_BENCH_PAGES": "24"}),
+        ("hw_profile",
+         [py, "-m", "dynamo_tpu.planner.hw_profile", "--model", model,
+          "--out", prof_out, "--batches", "1,4,8,16,32",
+          "--prefill-chunks", "128,512", "--page-size", "64",
+          "--num-pages", "320", "--decode-steps", "16", "--kv-int8"],
+         3000.0, {}),
+        ("goodput",
+         bench + ["--goodput", "--model", model, "--n-requests", "48",
+                  "--rps", "3.0", "--max-batch", "32"],
+         2400.0, {}),
+    ]
+
+
+def append_perf_md(state: dict, window_stages) -> None:
+    """Record ONLY the stages run in this window (re-listing accumulated
+    ones would imply they ran now)."""
+    path = os.path.join(REPO, "docs", "PERF.md")
+    lines = [
+        "",
+        f"## {time.strftime('%Y-%m-%d %H:%M')} — autobench window "
+        f"(round 5, scripts/tpu_autobench.py)",
+        "",
+        "| stage | ok | seconds | result |",
+        "|---|---|---|---|",
+    ]
+    for name in window_stages:
+        rec = state["stages"].get(name)
+        if rec is None:
+            continue
+        res = ""
+        for obj in rec.get("json", []):
+            if "metric" in obj:
+                res += f"{obj['metric']}={obj.get('value')} {obj.get('unit', '')} "
+            elif "best_variant" in obj:
+                res += f"best={obj['best_variant']} "
+        cell = (res.strip() or rec["tail"][-120:]).replace("\n", " ").replace("|", "/")
+        lines.append(
+            f"| {name} | {'yes' if rec['ok'] else 'NO'} | "
+            f"{rec['seconds']:.0f} | {cell} |"
+        )
+    with open(path, "a") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser("tpu_autobench")
+    p.add_argument("--out", default="PERF_r5.json")
+    p.add_argument("--log", default="docs/autobench_r5.log")
+    p.add_argument("--model", default="llama-3.2-3b")
+    p.add_argument("--interval", type=float, default=300.0,
+                   help="seconds between probe attempts while the chip is down")
+    p.add_argument("--probe-timeout", type=float, default=120.0)
+    p.add_argument("--max-hours", type=float, default=10.5)
+    args = p.parse_args()
+
+    out_path = os.path.join(REPO, args.out)
+    log_path = os.path.join(REPO, args.log)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    deadline = time.time() + args.max_hours * 3600
+
+    state = {"started": time.strftime("%Y-%m-%d %H:%M:%S"),
+             "probe_attempts": 0, "windows": 0, "stages": {}}
+    if os.path.exists(out_path):  # resume across supervisor restarts
+        try:
+            with open(out_path) as f:
+                prev = json.load(f)
+            state["stages"] = {
+                k: v for k, v in prev.get("stages", {}).items() if v.get("ok")
+            }
+        except (ValueError, OSError):
+            pass
+
+    stages = make_stages(args.model)
+    log_line(log_path, f"autobench start: {len(stages)} stages, "
+             f"interval={args.interval:.0f}s, deadline in {args.max_hours}h")
+
+    def save():
+        state["updated"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        state["all_ok"] = all(
+            state["stages"].get(n, {}).get("ok") for n, *_ in stages
+        )
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, out_path)
+
+    save()
+    while time.time() < deadline:
+        pending = [s for s in stages if not state["stages"].get(s[0], {}).get("ok")]
+        if not pending:
+            log_line(log_path, "every stage has succeeded; exiting")
+            return 0
+        state["probe_attempts"] += 1
+        rc, dt, tail, _ = run_stage(
+            [sys.executable, "scripts/tpu_probe.py"], args.probe_timeout)
+        if rc != 0:
+            log_line(log_path, f"probe #{state['probe_attempts']} down "
+                     f"(rc={rc}, {dt:.0f}s): {tail.splitlines()[-1] if tail else ''}")
+            save()
+            time.sleep(args.interval)
+            continue
+
+        state["windows"] += 1
+        log_line(log_path, f"probe OK ({dt:.1f}s) — window #{state['windows']}, "
+                 f"running {len(pending)} pending stages")
+        ran = []
+        for name, cmd, timeout_s, env in pending:
+            if time.time() > deadline:
+                break
+            rc, dt, tail, parsed = run_stage(cmd, timeout_s, env)
+            ok = stage_ok(name, rc, parsed)
+            state["stages"][name] = {
+                "ok": ok, "rc": rc, "seconds": round(dt, 1),
+                "tail": tail[-600:], "json": parsed,
+                "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+            }
+            ran.append(name)
+            log_line(log_path, f"stage {name}: {'OK' if ok else 'FAIL'} "
+                     f"rc={rc} {dt:.0f}s")
+            save()
+            # tunnel died mid-battery: ANY stage reporting tpu_unavailable
+            # (or a bench stage killed on timeout) means the rest of the
+            # battery would just burn serial timeouts — re-enter the cheap
+            # probe loop instead
+            lost = any(o.get("tpu_unavailable") for o in parsed)
+            if not ok and (lost or rc == -9):
+                log_line(log_path, "chip lost mid-window; back to probing")
+                break
+        append_perf_md(state, ran)
+        save()
+    log_line(log_path, "deadline reached")
+    return 0 if state.get("all_ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

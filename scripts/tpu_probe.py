@@ -16,6 +16,11 @@ def main() -> int:
 
     devs = jax.devices()
     print("devices:", devs, flush=True)
+    if devs[0].platform == "cpu":
+        # a leaked JAX_PLATFORMS=cpu must never count as chip-alive —
+        # autobench would record CPU numbers as hardware evidence
+        print("probe refused: platform is cpu, not a TPU", flush=True)
+        return 2
     x = jnp.ones((1024, 1024), jnp.bfloat16)
     f = jax.jit(lambda a: a @ a)
     t0 = time.time()
