@@ -1,0 +1,133 @@
+"""ctypes wrapper for native/frame_codec.cpp — the C++ request-plane
+codec (reference zero_copy_decoder.rs role; VERDICT r4 #5 escalation).
+
+`NativeSplitter.feed(chunk)` returns the msgpack bodies of every frame
+completed by that chunk as memoryviews into the splitter's persistent
+buffer — one Python call per socket burst instead of two awaited
+readexactly() calls plus a struct unpack per frame. The views are decoded
+(msgpack-python's C extension) before the next feed, which compacts the
+buffer.
+
+`encode_frames(bodies)` length-prefixes a burst of already-packed msgpack
+bodies into one bytes object → one writer.write() per burst.
+
+Falls back to None when the toolchain is unavailable; callers keep the
+pure-Python per-frame path (request_plane._recv_frame).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+from dynamo_tpu.native.build import build_library
+
+_LIB = None
+_LOAD_TRIED = False
+
+MAX_FRAME = 256 * 1024 * 1024  # mirror request_plane.MAX_FRAME
+_BATCH = 512  # frames returned per fc_frames call (looped until drained)
+
+
+def _load():
+    global _LIB, _LOAD_TRIED
+    if _LOAD_TRIED:
+        return _LIB
+    _LOAD_TRIED = True
+    path = build_library("frame_codec")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(str(path))
+    lib.fc_new.restype = ctypes.c_void_p
+    lib.fc_free.argtypes = [ctypes.c_void_p]
+    lib.fc_feed.restype = ctypes.c_int
+    lib.fc_feed.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.fc_frames.restype = ctypes.c_long
+    lib.fc_frames.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_long, ctypes.c_size_t,
+    ]
+    lib.fc_data.restype = ctypes.c_void_p
+    lib.fc_data.argtypes = [ctypes.c_void_p]
+    lib.fc_consume.argtypes = [ctypes.c_void_p]
+    lib.fc_buffered.restype = ctypes.c_size_t
+    lib.fc_buffered.argtypes = [ctypes.c_void_p]
+    lib.fc_encode.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t), ctypes.c_long,
+        ctypes.c_char_p,
+    ]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class FrameProtocolError(ValueError):
+    pass
+
+
+class NativeSplitter:
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native frame codec unavailable")
+        self._lib = lib
+        self._h = lib.fc_new()
+        if not self._h:
+            raise MemoryError("fc_new failed")
+        self._offs = (ctypes.c_size_t * _BATCH)()
+        self._lens = (ctypes.c_size_t * _BATCH)()
+
+    def feed(self, chunk: bytes) -> List[memoryview]:
+        """Append a socket chunk; return the bodies of every frame it
+        completed (memoryviews — decode before the next feed)."""
+        lib = self._lib
+        if lib.fc_feed(self._h, chunk, len(chunk)) != 0:
+            raise MemoryError("fc_feed failed")
+        out: List[memoryview] = []
+        while True:
+            n = lib.fc_frames(self._h, self._offs, self._lens, _BATCH,
+                              MAX_FRAME)
+            if n == -2:
+                raise FrameProtocolError("frame too large")
+            if n <= 0:
+                break
+            base = lib.fc_data(self._h)
+            for i in range(n):
+                buf = (ctypes.c_char * self._lens[i]).from_address(
+                    base + self._offs[i]
+                )
+                out.append(memoryview(buf))
+            if n < _BATCH:
+                break
+        return out
+
+    def compact(self) -> None:
+        """Drop parsed frames (call after decoding the feed() views)."""
+        self._lib.fc_consume(self._h)
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.fc_free(h)
+
+
+def encode_frames(bodies: List[bytes]) -> bytes:
+    """Length-prefix a burst of packed msgpack bodies into one buffer.
+    Pure-Python fallback when the toolchain is unavailable — callers get
+    identical bytes either way."""
+    lib = _load()
+    if lib is None:
+        import struct
+
+        return b"".join(
+            struct.pack(">I", len(b)) + b for b in bodies
+        )
+    n = len(bodies)
+    cat = b"".join(bodies)
+    lens = (ctypes.c_size_t * n)(*[len(b) for b in bodies])
+    out = ctypes.create_string_buffer(len(cat) + 4 * n)
+    lib.fc_encode(cat, lens, n, out)
+    return out.raw

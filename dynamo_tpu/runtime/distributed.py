@@ -43,13 +43,30 @@ class DistributedRuntime:
         discovery_backend: Optional[str] = None,
         event_transport: Optional[str] = None,
         host: Optional[str] = None,
+        request_plane: Optional[str] = None,  # "tcp" (default) | "nats"
         **discovery_kw,
     ):
         self.discovery = discovery or make_discovery(discovery_backend, **discovery_kw)
         self.event_transport = event_transport or os.environ.get("DYN_EVENT_PLANE", "zmq")
         self.host = host or os.environ.get("DYN_TCP_HOST", "127.0.0.1")
         self.metrics = make_metrics()
-        self.server = PushEndpoint(host=self.host)
+        # RequestPlaneMode{Tcp,Nats} (reference distributed.rs:773-779):
+        # the server advertises a self-describing address, so clients need
+        # no mode flag — PushRouter dials TCP or the broker per address
+        self.request_plane = (
+            request_plane or os.environ.get("DYN_REQUEST_PLANE", "tcp")
+        ).lower()
+        if self.request_plane == "nats":
+            from dynamo_tpu.runtime.request_plane import NatsPushEndpoint
+
+            self.server = NatsPushEndpoint()
+        elif self.request_plane == "tcp":
+            self.server = PushEndpoint(host=self.host)
+        else:
+            raise ValueError(
+                f"unknown request plane {self.request_plane!r} "
+                "(expected tcp or nats)"
+            )
         self._server_started = False
         self._served: List[Instance] = []
         self._event_publisher: Optional[EventPublisher] = None

@@ -70,6 +70,17 @@ class NatsClient:
         async with self._lock:
             if self._writer is not None or self._closed:
                 return
+            # retire the previous connection's reader BEFORE dialing: its
+            # cleanup must not clobber the fresh writer, and a stale loop
+            # still SUBed would double-deliver every event into the shared
+            # queue after a broker restart
+            prev = self._reader_task
+            if prev is not None and not prev.done():
+                prev.cancel()
+                try:
+                    await prev
+                except (asyncio.CancelledError, Exception):
+                    pass
             host, port = _parse_url(self.url)
             self._reader, self._writer = await asyncio.open_connection(host, port)
             info = await self._reader.readline()  # INFO {...}
@@ -87,27 +98,37 @@ class NatsClient:
             self._reader_task = asyncio.create_task(self._read_loop())
 
     async def _read_loop(self) -> None:
+        # operate on THIS connection's streams (not self._reader/_writer):
+        # after a reconnect the instance attributes point at the fresh
+        # connection, and this loop's cleanup must only retire its own
+        reader, writer = self._reader, self._writer
         try:
             while True:
-                line = await self._reader.readline()
+                line = await reader.readline()
                 if not line:
                     break
                 if line.startswith(b"MSG "):
                     # MSG <subject> <sid> [reply-to] <#bytes>
                     parts = line.decode().strip().split(" ")
                     n = int(parts[-1])
-                    payload = await self._reader.readexactly(n + 2)  # +\r\n
+                    payload = await reader.readexactly(n + 2)  # +\r\n
                     await self._queue.put((parts[1], payload[:n]))
                 elif line.startswith(b"PING"):
-                    self._writer.write(b"PONG\r\n")
-                    await self._writer.drain()
+                    writer.write(b"PONG\r\n")
+                    await writer.drain()
                 # PONG / +OK / INFO updates: ignored
         except (asyncio.CancelledError, ConnectionError, OSError):
             pass
         finally:
-            # mark dead so the next ensure_connected() re-dials
-            self._writer = None
-            self._reader = None
+            # mark dead so the next ensure_connected() re-dials — but only
+            # if we still own the live connection
+            if self._writer is writer:
+                self._writer = None
+                self._reader = None
+            try:
+                writer.close()
+            except Exception:
+                pass
             await self._queue.put(None)  # wake consumers on disconnect
 
     async def publish(self, subject: str, payload: bytes) -> None:
@@ -141,13 +162,19 @@ class NatsClient:
         the caller may loop — ensure_connected() will redial."""
         return await self._queue.get()
 
-    async def close(self) -> None:
+    def close_nowait(self) -> None:
+        """Synchronous teardown (callers in non-async close paths — the
+        request-plane _NatsMuxConn.close — share ONE implementation with
+        the async close instead of poking private state)."""
         self._closed = True
         if self._reader_task is not None:
             self._reader_task.cancel()
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+
+    async def close(self) -> None:
+        self.close_nowait()
 
 
 class NatsEventPublisher(EventPublisher):

@@ -66,6 +66,48 @@ async def _recv_frame(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
     return msgpack.unpackb(body, raw=False)
 
 
+def _native_codec_on() -> bool:
+    """C++ frame codec opt-in (DYN_NATIVE_CODEC=1; reference
+    zero_copy_decoder.rs role): bulk-read both plane read loops and split
+    frames natively — one Python call per socket burst instead of two
+    awaited readexactly() per frame. Same wire protocol; rollout policy
+    mirrors attn_impl (flip the default after the hardware-host A/B)."""
+    import os
+
+    if os.environ.get("DYN_NATIVE_CODEC", "").lower() not in (
+        "1", "true", "on", "yes"
+    ):
+        return False
+    try:
+        from dynamo_tpu.native.frame_codec import available
+
+        return available()
+    except Exception:  # toolchain missing → Python path
+        return False
+
+
+async def _bulk_frames(reader: asyncio.StreamReader, splitter, on_frame):
+    """Native-codec read loop body: drain the socket in 256 KiB bursts,
+    decode every completed frame, await `on_frame(dict)` for each.
+    Returns on EOF; raises RequestPlaneError on protocol violations."""
+    from dynamo_tpu.native.frame_codec import FrameProtocolError
+
+    while True:
+        try:
+            chunk = await reader.read(262144)
+        except (ConnectionResetError, BrokenPipeError):
+            return
+        if not chunk:
+            return
+        try:
+            bodies = splitter.feed(chunk)
+        except FrameProtocolError:
+            raise RequestPlaneError("frame too large", code="protocol")
+        for body in bodies:
+            await on_frame(msgpack.unpackb(body, raw=False))
+        splitter.compact()
+
+
 class PushEndpoint:
     """Server side: serves one AsyncEngine per endpoint path on a TCP port
     (reference ingress/push_endpoint.rs:21,36). One server instance can host
@@ -125,26 +167,40 @@ class PushEndpoint:
         tasks: set = set()
         wlock = asyncio.Lock()
         self._conns.add(writer)
+
+        async def on_frame(frame: Dict[str, Any]) -> None:
+            t = frame.get("t")
+            if t == "req":
+
+                async def send(obj: Dict[str, Any]) -> None:
+                    async with wlock:
+                        await _send_frame(writer, obj)
+
+                task = asyncio.create_task(
+                    self._handle_request(frame, send, conn_ctxs)
+                )
+                tasks.add(task)
+                task.add_done_callback(tasks.discard)
+            elif t == "cancel":
+                ctx = conn_ctxs.get(frame.get("id"))
+                if ctx is not None:
+                    ctx.stop_generating()
+            elif t == "kill":
+                ctx = conn_ctxs.get(frame.get("id"))
+                if ctx is not None:
+                    ctx.kill()
+
         try:
+            if _native_codec_on():
+                from dynamo_tpu.native.frame_codec import NativeSplitter
+
+                await _bulk_frames(reader, NativeSplitter(), on_frame)
+                return
             while True:
                 frame = await _recv_frame(reader)
                 if frame is None:
                     return
-                t = frame.get("t")
-                if t == "req":
-                    task = asyncio.create_task(
-                        self._handle_request(frame, writer, wlock, conn_ctxs)
-                    )
-                    tasks.add(task)
-                    task.add_done_callback(tasks.discard)
-                elif t == "cancel":
-                    ctx = conn_ctxs.get(frame.get("id"))
-                    if ctx is not None:
-                        ctx.stop_generating()
-                elif t == "kill":
-                    ctx = conn_ctxs.get(frame.get("id"))
-                    if ctx is not None:
-                        ctx.kill()
+                await on_frame(frame)
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
@@ -158,17 +214,11 @@ class PushEndpoint:
     async def _handle_request(
         self,
         frame: Dict[str, Any],
-        writer: asyncio.StreamWriter,
-        wlock: asyncio.Lock,
+        send,  # async callable(obj) — TCP frame write or NATS publish
         conn_ctxs: Dict[str, Context],
     ) -> None:
         rid = frame["id"]
         path = frame["endpoint"]
-
-        async def send(obj: Dict[str, Any]) -> None:
-            async with wlock:
-                await _send_frame(writer, obj)
-
         engine = self._engines.get(path)
         if engine is None or self._draining:
             code = "draining" if self._draining else "no_endpoint"
@@ -204,9 +254,9 @@ class PushEndpoint:
         except CancellationError:
             try:
                 await send({"t": "err", "id": rid, "msg": "killed", "code": "cancelled"})
-            except (ConnectionResetError, BrokenPipeError):
+            except ConnectionError:
                 pass
-        except (ConnectionResetError, BrokenPipeError):
+        except ConnectionError:
             ctx.kill()
         except Exception as e:  # engine fault → error frame
             log.exception("engine error on %s", path)
@@ -217,7 +267,7 @@ class PushEndpoint:
             code = getattr(e, "code", None) or "engine"
             try:
                 await send({"t": "err", "id": rid, "msg": str(e), "code": code})
-            except (ConnectionResetError, BrokenPipeError):
+            except ConnectionError:
                 pass
         finally:
             self._active.pop(rid, None)
@@ -273,16 +323,24 @@ class _MuxConn:
             await _send_frame(self._writer, obj)
 
     async def _read_loop(self) -> None:
+        async def on_frame(frame: Dict[str, Any]) -> None:
+            q = self._streams.get(frame.get("id"))
+            # frames for unknown ids (stream abandoned client-side
+            # before the server noticed the cancel) are dropped
+            if q is not None:
+                await q.put(frame)
+
         try:
-            while True:
-                frame = await _recv_frame(self._reader)
-                if frame is None:
-                    break
-                q = self._streams.get(frame.get("id"))
-                # frames for unknown ids (stream abandoned client-side
-                # before the server noticed the cancel) are dropped
-                if q is not None:
-                    await q.put(frame)
+            if _native_codec_on():
+                from dynamo_tpu.native.frame_codec import NativeSplitter
+
+                await _bulk_frames(self._reader, NativeSplitter(), on_frame)
+            else:
+                while True:
+                    frame = await _recv_frame(self._reader)
+                    if frame is None:
+                        break
+                    await on_frame(frame)
         except Exception:
             pass
         finally:
@@ -333,6 +391,22 @@ class _ConnPool:
         self.connect_timeout = connect_timeout
 
     async def _dial(self, address: str) -> _MuxConn:
+        gen = self._gen.get(address, 0) + 1
+        if address.startswith("nats://"):
+            # brokered request plane: nats://host:port/rpc.<id> — one
+            # broker connection per pooled "conn", same mux surface
+            url, _, subject = address.rpartition("/")
+            conn = _NatsMuxConn(url, subject, gen=gen)
+            try:
+                await asyncio.wait_for(conn.start(), self.connect_timeout)
+            except (OSError, asyncio.TimeoutError) as e:
+                conn.shutdown()
+                raise RequestPlaneError(
+                    f"cannot connect to {address}: {e}", code="cannot_connect"
+                )
+            self._gen[address] = gen
+            self._conns.setdefault(address, []).append(conn)
+            return conn
         host, port = address.rsplit(":", 1)
         try:
             reader, writer = await asyncio.wait_for(
@@ -340,7 +414,6 @@ class _ConnPool:
             )
         except (OSError, asyncio.TimeoutError) as e:
             raise RequestPlaneError(f"cannot connect to {address}: {e}", code="cannot_connect")
-        gen = self._gen.get(address, 0) + 1
         self._gen[address] = gen
         conn = _MuxConn(reader, writer, gen=gen)
         self._conns.setdefault(address, []).append(conn)
@@ -566,12 +639,27 @@ class PushRouter:
     def instance_ids(self) -> list:
         return list(self._instances)
 
-    def _pick(self, instance_id: Optional[int] = None) -> Tuple[int, str]:
+    def _pick(
+        self, instance_id: Optional[int] = None, allowed=None
+    ) -> Tuple[int, str]:
+        """`allowed`: optional instance-id collection restricting selection
+        (LoRA-filtered routing — only replicas holding the request's
+        adapter are candidates; reference two-stage filter-then-cost
+        routing, lib/llm entrypoint/input/common.rs:154-185)."""
         if not self._instances:
             raise RequestPlaneError(
                 f"no instances for {self.endpoint_path}", code="no_instances"
             )
         if instance_id is not None:
+            if allowed is not None and instance_id not in allowed:
+                # an explicit pin (session affinity / direct) to a replica
+                # outside the restriction fails loudly — silently ignoring
+                # the filter would land the request on a worker without
+                # the adapter
+                raise RequestPlaneError(
+                    f"instance {instance_id:x} excluded by the adapter "
+                    "restriction", code="cannot_connect",
+                )
             addr = self._instances.get(instance_id)
             if addr is None:
                 raise RequestPlaneError(
@@ -582,7 +670,15 @@ class PushRouter:
             raise RequestPlaneError(
                 "direct routing mode requires a target instance_id", code="no_target"
             )
-        ids = sorted(self._instances)
+        ids = sorted(
+            self._instances if allowed is None
+            else (i for i in self._instances if i in allowed)
+        )
+        if not ids:
+            raise RequestPlaneError(
+                f"no instances for {self.endpoint_path} satisfy the "
+                "adapter restriction", code="no_instances",
+            )
         if self.mode == RouterMode.RANDOM:
             iid = random.choice(ids)
         elif self.mode == RouterMode.P2C:
@@ -632,7 +728,11 @@ class PushRouter:
         return RemoteEngine(self._pool, addr, self.endpoint_path)
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
-        iid, addr = self._pick(context.metadata.get("target_instance"))
+        allowed = context.metadata.get("allowed_instances")
+        iid, addr = self._pick(
+            context.metadata.get("target_instance"),
+            set(allowed) if allowed is not None else None,
+        )
         # report the choice so wrappers (session affinity) can pin to it
         context.metadata["routed_instance"] = iid
         engine = RemoteEngine(self._pool, addr, self.endpoint_path)
@@ -649,3 +749,196 @@ class PushRouter:
 
     def close(self) -> None:
         self._pool.close()
+
+
+class NatsPushEndpoint(PushEndpoint):
+    """Request-plane mode over the NATS broker — `RequestPlaneMode::Nats`
+    (reference lib/runtime/src/distributed.rs:773-779). Same msgpack
+    frames and stream semantics as the TCP plane; the transport is broker
+    subjects instead of sockets: the server subscribes to one rpc.<id>
+    subject, clients attach a `reply` inbox subject per request and
+    responses stream there. The advertised address is self-contained:
+    nats://host:port/rpc.<id> (clients parse broker + subject out of it).
+
+    Delivery is NATS-core at-most-once: a broker restart drops in-flight
+    streams, which surfaces as `disconnected` — exactly the migratable
+    error class the TCP plane produces on a cut socket, so frontend
+    Migration replays the request transparently."""
+
+    def __init__(self, nats_url: Optional[str] = None):
+        super().__init__()
+        import os as _os
+        import uuid as _uuid
+
+        from dynamo_tpu.runtime.nats_plane import DEFAULT_URL
+
+        self.nats_url = nats_url or _os.environ.get("DYN_NATS_URL", DEFAULT_URL)
+        self.subject = f"rpc.{_uuid.uuid4().hex[:12]}"
+        self._client = None
+        self._dispatch_task: Optional[asyncio.Task] = None
+        self._nats_ctxs: Dict[str, Context] = {}
+
+    @property
+    def address(self) -> str:
+        return f"{self.nats_url}/{self.subject}"
+
+    async def start(self) -> str:
+        from dynamo_tpu.runtime.nats_plane import NatsClient
+
+        self._client = NatsClient(self.nats_url)
+        await self._client.subscribe(self.subject)
+        self._dispatch_task = asyncio.create_task(self._dispatch())
+        return self.address
+
+    async def _dispatch(self) -> None:
+        tasks: set = set()
+        client = self._client
+        try:
+            while True:
+                item = await client.next_msg()
+                if item is None:
+                    if client._closed:
+                        return
+                    # broker dropped: redial until it returns (the SUB is
+                    # re-established by ensure_connected's re-SUB replay)
+                    while not client._closed:
+                        await asyncio.sleep(0.2)
+                        try:
+                            await client.ensure_connected()
+                            break
+                        except (ConnectionError, OSError):
+                            continue
+                    continue
+                _, raw = item
+                try:
+                    frame = msgpack.unpackb(raw, raw=False)
+                except Exception:
+                    continue  # malformed wire input must not kill dispatch
+                t = frame.get("t")
+                if t == "req":
+                    reply = frame.get("reply")
+                    if not reply:
+                        continue
+
+                    async def send(obj: Dict[str, Any], _r=reply) -> None:
+                        await client.publish(
+                            _r, msgpack.packb(obj, use_bin_type=True)
+                        )
+
+                    task = asyncio.create_task(
+                        self._handle_request(frame, send, self._nats_ctxs)
+                    )
+                    tasks.add(task)
+                    task.add_done_callback(tasks.discard)
+                elif t == "cancel":
+                    ctx = self._nats_ctxs.get(frame.get("id"))
+                    if ctx is not None:
+                        ctx.stop_generating()
+                elif t == "kill":
+                    ctx = self._nats_ctxs.get(frame.get("id"))
+                    if ctx is not None:
+                        ctx.kill()
+        finally:
+            for task in tasks:
+                task.cancel()
+
+    async def stop(self, drain_timeout: float = 30.0) -> None:
+        self._draining = True
+        deadline = asyncio.get_event_loop().time() + drain_timeout
+        while self._active and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        for ctx in list(self._active.values()):
+            ctx.kill()
+        if self._dispatch_task is not None:
+            self._dispatch_task.cancel()
+        if self._client is not None:
+            await self._client.close()
+
+
+class _NatsMuxConn:
+    """Client half of the NATS request plane: the _MuxConn surface
+    (open/close_stream, send, closed/gen/n_streams) over one broker
+    connection. Requests go to the server's rpc subject with this conn's
+    private inbox as `reply`; a reader task demuxes inbox frames into the
+    per-stream queues. Queues are unbounded — a broker provides no
+    per-stream backpressure, and blocking the shared demux on one slow
+    stream would stall every other (the TCP plane gets this from the
+    socket; here at-most-once semantics bound the exposure)."""
+
+    _DISCONNECT = _MuxConn._DISCONNECT
+
+    def __init__(self, url: str, subject: str, gen: int = 0):
+        import uuid as _uuid
+
+        from dynamo_tpu.runtime.nats_plane import NatsClient
+
+        self._subject = subject
+        self._client = NatsClient(url)
+        self._inbox = f"_INBOX.{_uuid.uuid4().hex[:12]}"
+        self._streams: Dict[str, asyncio.Queue] = {}
+        self.closed = False
+        self.gen = gen
+        self._reader_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        await self._client.subscribe(self._inbox)
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+    @property
+    def n_streams(self) -> int:
+        return len(self._streams)
+
+    def open_stream(self, rid: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        return q
+
+    def close_stream(self, rid: str) -> None:
+        self._streams.pop(rid, None)
+
+    async def send(self, obj: Dict[str, Any]) -> None:
+        if obj.get("t") == "req":
+            obj = dict(obj)
+            obj["reply"] = self._inbox
+        try:
+            await self._client.publish(
+                self._subject, msgpack.packb(obj, use_bin_type=True)
+            )
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                item = await self._client.next_msg()
+                if item is None:
+                    # broker dropped: in-flight streams cannot be resumed
+                    # (core NATS replays nothing) — fan disconnect so the
+                    # pool retires this conn and callers migrate/retry
+                    break
+                _, raw = item
+                try:
+                    frame = msgpack.unpackb(raw, raw=False)
+                except Exception:
+                    continue
+                q = self._streams.get(frame.get("id"))
+                if q is not None:
+                    q.put_nowait(frame)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        for q in self._streams.values():
+            q.put_nowait(self._DISCONNECT)
+        self._client.close_nowait()
+
+    def shutdown(self) -> None:
+        self.close()
+        if self._reader_task is not None:
+            self._reader_task.cancel()

@@ -1,0 +1,115 @@
+// Request-plane frame codec: incremental length-prefixed frame splitting
+// and batch encoding, C ABI for ctypes (dynamo_tpu/native/frame_codec.py).
+//
+// Role analog: the reference's zero-copy two-part codec
+// (lib/runtime/src/pipeline/network/codec/zero_copy_decoder.rs) — split a
+// byte stream into frames without per-frame syscalls or per-frame Python
+// bytecode. The Python plane's per-frame cost is two awaited readexactly()
+// calls plus a struct unpack; the native path is one bulk read per burst,
+// then this splitter hands back (offset, length) pairs into a persistent
+// buffer in a single call. msgpack body decode stays in msgpack-python's C
+// extension — duplicating it here would add surface, not speed.
+//
+// Memory model: fc_feed appends to an internal contiguous buffer (frames
+// can straddle feeds); fc_frames scans complete frames and returns their
+// body extents; fc_consume drops the parsed prefix (memmove of the
+// partial tail only). Pointers from fc_data are valid until the next
+// feed/consume — the Python wrapper decodes bodies before feeding again.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Splitter {
+  std::vector<uint8_t> buf;
+  size_t parsed = 0;  // bytes covered by frames already returned
+};
+
+inline uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fc_new() { return new (std::nothrow) Splitter(); }
+
+void fc_free(void* h) { delete static_cast<Splitter*>(h); }
+
+// Append a chunk from the socket. Returns 0, or -1 on allocation failure.
+int fc_feed(void* h, const uint8_t* data, size_t n) {
+  auto* s = static_cast<Splitter*>(h);
+  try {
+    s->buf.insert(s->buf.end(), data, data + n);
+  } catch (...) {
+    return -1;
+  }
+  return 0;
+}
+
+// Scan complete frames past the already-parsed point. Fills up to `cap`
+// (body_offset, body_len) pairs; returns the count, or -2 if a frame
+// exceeds max_frame (protocol error — connection must die, matching the
+// Python MAX_FRAME contract). Parsed extent advances so repeated calls
+// continue where the last stopped.
+long fc_frames(void* h, size_t* offs, size_t* lens, long cap,
+               size_t max_frame) {
+  auto* s = static_cast<Splitter*>(h);
+  long n = 0;
+  size_t pos = s->parsed;
+  const size_t end = s->buf.size();
+  while (n < cap && pos + 4 <= end) {
+    const uint32_t body = be32(s->buf.data() + pos);
+    if (body > max_frame) return -2;
+    if (pos + 4 + body > end) break;  // partial frame: wait for more bytes
+    offs[n] = pos + 4;
+    lens[n] = body;
+    ++n;
+    pos += 4 + size_t(body);
+  }
+  s->parsed = pos;
+  return n;
+}
+
+const uint8_t* fc_data(void* h) {
+  return static_cast<Splitter*>(h)->buf.data();
+}
+
+// Drop the parsed prefix, keeping any partial tail frame.
+void fc_consume(void* h) {
+  auto* s = static_cast<Splitter*>(h);
+  if (s->parsed == 0) return;
+  const size_t tail = s->buf.size() - s->parsed;
+  if (tail) std::memmove(s->buf.data(), s->buf.data() + s->parsed, tail);
+  s->buf.resize(tail);
+  s->parsed = 0;
+}
+
+size_t fc_buffered(void* h) {
+  auto* s = static_cast<Splitter*>(h);
+  return s->buf.size() - s->parsed;
+}
+
+// Batch framing: bodies concatenated in `bodies` with per-body lengths;
+// writes length-prefixed frames into `out` (caller allocates
+// sum(lens) + 4*n). One writer.write() per burst instead of per frame.
+void fc_encode(const uint8_t* bodies, const size_t* lens, long n,
+               uint8_t* out) {
+  size_t in_off = 0, out_off = 0;
+  for (long i = 0; i < n; ++i) {
+    const size_t len = lens[i];
+    out[out_off + 0] = uint8_t(len >> 24);
+    out[out_off + 1] = uint8_t(len >> 16);
+    out[out_off + 2] = uint8_t(len >> 8);
+    out[out_off + 3] = uint8_t(len);
+    std::memcpy(out + out_off + 4, bodies + in_off, len);
+    in_off += len;
+    out_off += 4 + len;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,104 @@
+"""Request-plane codec A/B: per-frame Python read loop vs the native C++
+bulk splitter (DYN_NATIVE_CODEC=1, native/frame_codec.cpp).
+
+Measures the frame-ingest ceiling the frontend tier lives under: one
+server streaming many small item frames per request, one client process
+consuming them over the multiplexed TCP plane. Run:
+
+    python scripts/bench_codec.py [--requests 64] [--items 400]
+
+Prints one JSON line {"python_fps": ..., "native_fps": ..., "speedup": ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+
+class _Spray:
+    """Engine yielding `items` tiny frames per request, no think time —
+    the stream shape of a fast decode worker feeding a frontend."""
+
+    def __init__(self, items: int):
+        self.items = items
+
+    async def generate(self, request, context):
+        payload = {"token_ids": [1], "finish_reason": None}
+        for _ in range(self.items - 1):
+            yield payload
+        yield {"token_ids": [1], "finish_reason": "stop"}
+
+
+async def run_phase(native: bool, n_requests: int, items: int,
+                    concurrency: int) -> float:
+    os.environ["DYN_NATIVE_CODEC"] = "1" if native else "0"
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    realm = f"codec-{native}-{time.time()}"
+    rt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                            event_transport="inproc")
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm),
+                            event_transport="inproc")
+    try:
+        await rt.serve_endpoint("bench/spray/generate", _Spray(items))
+        client = frt.client("bench/spray/generate")
+        await client.wait_ready()
+
+        sem = asyncio.Semaphore(concurrency)
+        got = 0
+
+        async def one():
+            nonlocal got
+            async with sem:
+                async for item in client.generate({"x": 1}):
+                    got += 1
+
+        # warmup (connection dial + first streams)
+        await asyncio.gather(*[one() for _ in range(4)])
+        got = 0
+        t0 = time.perf_counter()
+        await asyncio.gather(*[one() for _ in range(n_requests)])
+        dt = time.perf_counter() - t0
+        assert got == n_requests * items, (got, n_requests * items)
+        await client.close()
+        return got / dt
+    finally:
+        await frt.shutdown(drain_timeout=1)
+        await rt.shutdown(drain_timeout=1)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("bench_codec")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--items", type=int, default=400)
+    p.add_argument("--concurrency", type=int, default=32)
+    p.add_argument("--repeat", type=int, default=3)
+    args = p.parse_args()
+
+    from dynamo_tpu.native.frame_codec import available
+
+    if not available():
+        print(json.dumps({"error": "native toolchain unavailable"}))
+        sys.exit(0)
+
+    results = {}
+    for native in (False, True):
+        best = 0.0
+        for _ in range(args.repeat):
+            fps = asyncio.run(
+                run_phase(native, args.requests, args.items, args.concurrency)
+            )
+            best = max(best, fps)
+        results["native_fps" if native else "python_fps"] = round(best, 1)
+    results["speedup"] = round(results["native_fps"] / results["python_fps"], 3)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -177,3 +177,107 @@ async def test_broker_restart_reconnects():
         await pub.close()
         await sub.close()
         await srv.stop()
+
+
+# -- NATS request-plane mode (VERDICT r4 #9) ---------------------------------
+
+
+async def test_nats_request_plane_e2e(monkeypatch):
+    """`RequestPlaneMode::Nats` (ref distributed.rs:773-779): RPC streams
+    ride broker subjects instead of TCP sockets — same frames, same
+    multiplexing. A worker served with request_plane="nats" advertises a
+    nats:// address; clients dial the broker transparently (the address
+    is self-describing, so mixed tcp/nats fleets interoperate)."""
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EchoEngine
+
+    srv = MiniNatsServer()
+    url = await srv.start()
+    monkeypatch.setenv("DYN_NATS_URL", url)
+
+    rt = DistributedRuntime(
+        discovery=MemDiscovery(realm="natsrpc"), event_transport="inproc",
+        request_plane="nats",
+    )
+    frt = DistributedRuntime(
+        discovery=MemDiscovery(realm="natsrpc"), event_transport="inproc",
+    )
+    try:
+        inst = await rt.serve_endpoint(
+            "prod/worker/generate", EchoEngine(), metadata={"m": 1}
+        )
+        assert inst.address.startswith("nats://"), inst.address
+        client = frt.client("prod/worker/generate")
+        await client.wait_ready()
+
+        async def one(i):
+            items = []
+            async for item in client.generate(
+                {"token_ids": [i, i + 1, i + 2]}
+            ):
+                items.append(item)
+            return items
+
+        # concurrent streams multiplex over the shared broker conn
+        results = await asyncio.gather(*[one(i) for i in range(6)])
+        for i, items in enumerate(results):
+            assert items, i
+            got = [t for it in items for t in (it.get("token_ids") or [])]
+            assert got == [i, i + 1, i + 2], (i, got)
+        await client.close()
+    finally:
+        await frt.shutdown(drain_timeout=1)
+        await rt.shutdown(drain_timeout=1)
+        await srv.stop()
+
+
+async def test_nats_request_plane_error_and_down_broker(monkeypatch):
+    """Engine faults surface as error frames over the broker; a dead
+    broker yields cannot_connect (the migratable class, not a hang)."""
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.request_plane import RequestPlaneError
+
+    srv = MiniNatsServer()
+    url = await srv.start()
+    monkeypatch.setenv("DYN_NATS_URL", url)
+
+    class Boom:
+        async def generate(self, request, context):
+            raise RuntimeError("kaboom")
+            yield  # pragma: no cover
+
+    rt = DistributedRuntime(
+        discovery=MemDiscovery(realm="natsrpc2"), event_transport="inproc",
+        request_plane="nats",
+    )
+    frt = DistributedRuntime(
+        discovery=MemDiscovery(realm="natsrpc2"), event_transport="inproc",
+    )
+    try:
+        await rt.serve_endpoint("prod/boom/generate", Boom())
+        client = frt.client("prod/boom/generate")
+        await client.wait_ready()
+        with pytest.raises(RequestPlaneError) as ei:
+            async for _ in client.generate({"x": 1}):
+                pass
+        assert ei.value.code == "engine"
+        await client.close()
+
+        # broker gone: dialing the advertised nats address fails loudly
+        await srv.stop()
+        client2 = frt.client("prod/boom/generate")
+        await client2.start()
+        # instance set was already watched; generate must error, not hang
+        for _ in range(100):
+            if client2.router.instance_ids:
+                break
+            await asyncio.sleep(0.02)
+        with pytest.raises(RequestPlaneError):
+            async for _ in client2.generate({"x": 1}):
+                pass
+        await client2.close()
+    finally:
+        await frt.shutdown(drain_timeout=1)
+        await rt.shutdown(drain_timeout=1)

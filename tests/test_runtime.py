@@ -495,3 +495,84 @@ async def test_least_loaded_balances_by_outstanding_requests():
     await crt.shutdown()
     await rt1.shutdown(drain_timeout=1)
     await rt2.shutdown(drain_timeout=1)
+
+
+# -- native C++ frame codec (VERDICT r4 #5 escalation path) ------------------
+
+
+def test_native_codec_splitter_roundtrip():
+    """Splitter handles frames straddling feed chunks, bursts of many
+    frames, and byte-identical batch encoding vs the Python framing."""
+    import struct
+
+    import msgpack as _mp
+
+    from dynamo_tpu.native.frame_codec import (
+        NativeSplitter,
+        available,
+        encode_frames,
+    )
+
+    if not available():
+        pytest.skip("native toolchain unavailable")
+    frames = [
+        {"t": "item", "id": f"r{i}", "data": {"token_ids": [i, i + 1],
+                                              "blob": b"x" * (i % 97)}}
+        for i in range(300)
+    ]
+    bodies = [_mp.packb(f, use_bin_type=True) for f in frames]
+    wire = encode_frames(bodies)
+    assert wire == b"".join(
+        struct.pack(">I", len(b)) + b for b in bodies
+    )
+    sp = NativeSplitter()
+    got = []
+    # adversarial chunking: 1 byte, then 7, then 4096, ...
+    sizes = [1, 7, 3, 4096, 11, 64 * 1024]
+    pos = 0
+    si = 0
+    while pos < len(wire):
+        n = sizes[si % len(sizes)]
+        si += 1
+        out = sp.feed(wire[pos:pos + n])
+        got.extend(_mp.unpackb(b, raw=False) for b in out)
+        sp.compact()
+        pos += n
+    assert got == frames
+
+
+async def test_native_codec_rpc_e2e(monkeypatch):
+    """DYN_NATIVE_CODEC=1: both plane read loops run the bulk native
+    splitter; streams, cancellation sentinels, and multi-frame bursts
+    behave identically to the per-frame Python path."""
+    from dynamo_tpu.native.frame_codec import available
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EchoEngine
+
+    if not available():
+        pytest.skip("native toolchain unavailable")
+    monkeypatch.setenv("DYN_NATIVE_CODEC", "1")
+    rt = DistributedRuntime(
+        discovery=MemDiscovery(realm="natcodec"), event_transport="inproc"
+    )
+    frt = DistributedRuntime(
+        discovery=MemDiscovery(realm="natcodec"), event_transport="inproc"
+    )
+    try:
+        await rt.serve_endpoint("prod/nc/generate", EchoEngine())
+        client = frt.client("prod/nc/generate")
+        await client.wait_ready()
+
+        async def one(i):
+            toks = []
+            async for item in client.generate({"token_ids": [i, i + 1, i + 2]}):
+                toks.extend(item.get("token_ids") or [])
+            return toks
+
+        results = await asyncio.gather(*[one(i) for i in range(8)])
+        assert results == [[i, i + 1, i + 2] for i in range(8)]
+        await client.close()
+    finally:
+        await frt.shutdown(drain_timeout=1)
+        await rt.shutdown(drain_timeout=1)
