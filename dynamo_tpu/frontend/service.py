@@ -44,6 +44,12 @@ class ModelEntry:
     prefill_instance_ids: Set[int] = field(default_factory=set)
     owns_client: bool = True  # False for LoRA adapter entries (shared client)
     adapter_names: Set[str] = field(default_factory=set)  # entries this base spawned
+    # per-PREFILL-instance adapter inventory (base entries): feeds each
+    # adapter entry's prefill-pool restriction. (Decode-side inventory
+    # lives directly as the adapter entries' instance_ids — the set the
+    # routing filter reads.)
+    prefill_instance_adapters: Dict[int, Set[str]] = field(default_factory=dict)
+    prefill_fetch_path: Optional[str] = None  # for late adapter activation
 
     async def close(self) -> None:
         if self.teardown is not None:
@@ -226,7 +232,8 @@ class ModelWatcher:
             await self._on_prefill_put(card, inst)
             return
         entry = self.manager.models.get(card.name)
-        if entry is None:
+        created = entry is None
+        if created:
             pre = Preprocessor(card)
             client = self.runtime.client(inst.endpoint_address.path, self.router_mode)
             await client.start()
@@ -246,42 +253,77 @@ class ModelWatcher:
             )
             self.manager.models[card.name] = entry
             log.info("model %s added (endpoint %s)", card.name, entry.endpoint_path)
-            # LoRA adapters served by this worker: each becomes a servable
-            # model name whose preprocessor stamps the adapter into requests
-            # (parity with reference lora-modules-as-models discovery)
-            import dataclasses as _dc
-
-            for aname in card.adapters or []:
-                if aname in self.manager.models:
-                    entry.adapter_names.add(aname)
-                    continue
-                acard = _dc.replace(card, name=aname, adapters=[])
-                apre = Preprocessor(acard, tokenizer=pre.tokenizer, adapter=aname)
-                amade = self._chain_factory(acard, client, apre)
-                if isinstance(amade, tuple):
-                    achain, ateardown, aprefill = (list(amade) + [None, None])[:3]
-                else:
-                    achain, ateardown, aprefill = amade, None, None
-                self.manager.models[aname] = ModelEntry(
-                    card=acard,
-                    endpoint_path=entry.endpoint_path,
-                    preprocessor=apre,
-                    client=client,
-                    chain=achain,
-                    teardown=ateardown,
-                    prefill_router=aprefill,
-                    owns_client=False,
-                )
-                entry.adapter_names.add(aname)
-                log.info("adapter %s added (base %s)", aname, card.name)
+        # LoRA adapters served by THIS instance: each becomes a servable
+        # model name whose preprocessor stamps the adapter into requests
+        # (parity with reference lora-modules-as-models discovery). Runs on
+        # every put, not just entry creation, so a later replica bringing a
+        # NEW adapter registers it too.
+        held = set(card.adapters or [])
+        for aname in held:
+            self._ensure_adapter_entry(entry, card, aname)
+        if created:
             for pending in self._pending_prefill.pop(card.name, []):
                 await self._on_prefill_put(card, pending)
         entry.instance_ids.add(inst.instance_id)
+        # adapter entries list ONLY the replicas that hold the adapter —
+        # routing filters on this set (two-stage LoRA-filtered routing,
+        # reference lib/llm/src/entrypoint/input/common.rs:154-185)
         for aname in entry.adapter_names:
             aentry = self.manager.models.get(aname)
-            if aentry is not None:
+            if aentry is None:
+                continue
+            if aname in held:
                 aentry.instance_ids.add(inst.instance_id)
+            else:
+                aentry.instance_ids.discard(inst.instance_id)
         self._ready.set()
+
+    def _ensure_adapter_entry(self, entry: ModelEntry, card: ModelCard,
+                              aname: str) -> None:
+        if aname in self.manager.models:
+            entry.adapter_names.add(aname)
+            return
+        import dataclasses as _dc
+
+        acard = _dc.replace(card, name=aname, adapters=[])
+        apre = Preprocessor(
+            acard, tokenizer=entry.preprocessor.tokenizer, adapter=aname
+        )
+        amade = self._chain_factory(acard, entry.client, apre)
+        if isinstance(amade, tuple):
+            achain, ateardown, aprefill = (list(amade) + [None, None])[:3]
+        else:
+            achain, ateardown, aprefill = amade, None, None
+        aentry = ModelEntry(
+            card=acard,
+            endpoint_path=entry.endpoint_path,
+            preprocessor=apre,
+            client=entry.client,
+            chain=achain,
+            teardown=ateardown,
+            prefill_router=aprefill,
+            owns_client=False,
+        )
+        aentry.chain = _AdapterGate(achain, aentry)
+        self.manager.models[aname] = aentry
+        entry.adapter_names.add(aname)
+        if aprefill is not None:
+            self._restrict_adapter_prefill(entry, aname, aentry)
+            if entry.prefill_client is not None and entry.prefill_fetch_path:
+                # adapter arrived after disagg activation: join it now
+                aprefill.activate(entry.prefill_client, entry.prefill_fetch_path)
+        log.info("adapter %s added (base %s)", aname, card.name)
+
+    def _restrict_adapter_prefill(self, entry: ModelEntry, aname: str,
+                                  aentry: ModelEntry) -> None:
+        """Prefill-pool face of the LoRA filter: hops for this adapter go
+        only to prefill replicas holding it; with none, the (meaningful)
+        empty set makes every hop fall back to aggregated serving."""
+        if aentry.prefill_router is not None:
+            aentry.prefill_router.restrict_prefill({
+                pid for pid, pads in entry.prefill_instance_adapters.items()
+                if aname in pads
+            })
 
     async def _on_prefill_put(self, card: ModelCard, inst) -> None:
         entry = self.manager.models.get(card.name)
@@ -297,6 +339,7 @@ class ModelWatcher:
                 f"{inst.endpoint_address.namespace}/"
                 f"{inst.endpoint_address.component}/kv_fetch"
             )
+            entry.prefill_fetch_path = fetch_path
             entry.prefill_router.activate(entry.prefill_client, fetch_path)
             # adapter entries disaggregate too, sharing the prefill client
             for aname in entry.adapter_names:
@@ -304,6 +347,11 @@ class ModelWatcher:
                 if aentry is not None and aentry.prefill_router is not None:
                     aentry.prefill_router.activate(entry.prefill_client, fetch_path)
         entry.prefill_instance_ids.add(inst.instance_id)
+        entry.prefill_instance_adapters[inst.instance_id] = set(card.adapters or [])
+        for aname in entry.adapter_names:
+            aentry = self.manager.models.get(aname)
+            if aentry is not None:
+                self._restrict_adapter_prefill(entry, aname, aentry)
 
     async def _on_delete(self, card: ModelCard, inst) -> None:
         entry = self.manager.models.get(card.name)
@@ -311,6 +359,11 @@ class ModelWatcher:
             return
         if (inst.metadata or {}).get("disagg_role") == "prefill":
             entry.prefill_instance_ids.discard(inst.instance_id)
+            entry.prefill_instance_adapters.pop(inst.instance_id, None)
+            for aname in entry.adapter_names:
+                aentry = self.manager.models.get(aname)
+                if aentry is not None:
+                    self._restrict_adapter_prefill(entry, aname, aentry)
             if not entry.prefill_instance_ids and entry.prefill_router is not None:
                 entry.prefill_router.deactivate()
                 for aname in entry.adapter_names:
@@ -334,6 +387,26 @@ class ModelWatcher:
             await entry.close()
             del self.manager.models[card.name]
             log.info("model %s removed (last instance gone)", card.name)
+
+
+class _AdapterGate:
+    """Chain head for adapter entries: stamps the live candidate set —
+    replicas whose card lists this adapter — into the routing context, so
+    every downstream picker (PushRouter modes, KvRouter cost selection)
+    filters BEFORE selecting (reference two-stage LoRA-filtered routing,
+    lib/llm/src/entrypoint/input/common.rs:154-185). With no holder left
+    the pick raises no_instances → a clean HTTP error instead of an
+    "unknown adapter" failure on an arbitrary worker."""
+
+    def __init__(self, inner, entry: ModelEntry):
+        self.inner = inner
+        self.entry = entry
+
+    async def generate(self, request: Any, context: Context):
+        # a list (not set): context metadata must stay msgpack-serializable
+        context.metadata["allowed_instances"] = sorted(self.entry.instance_ids)
+        async for item in self.inner.generate(request, context):
+            yield item
 
 
 class _ClientEngine:

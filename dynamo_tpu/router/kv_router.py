@@ -330,6 +330,7 @@ class KvRouter:
         self, token_ids: List[int], adapter: Optional[str] = None,
         mm_seed: Optional[int] = None, pinned_instance: Optional[int] = None,
         collect: Optional[Dict[str, Any]] = None,
+        allowed_instances=None,
     ) -> Tuple[Worker, int, List[int]]:
         """Returns (worker, overlap_blocks, block_hashes). `adapter` and
         `mm_seed` seed the hash chain exactly like the worker scheduler
@@ -338,7 +339,12 @@ class KvRouter:
 
         `pinned_instance` restricts selection to that instance's workers
         (session affinity / explicit targeting): the selector still picks
-        the best dp rank and the overlap bookkeeping stays accurate."""
+        the best dp rank and the overlap bookkeeping stays accurate.
+
+        `allowed_instances` is the LoRA filter stage: candidates are
+        restricted to replicas that hold the request's adapter BEFORE
+        cost-based selection (reference two-stage LoRA-filtered routing,
+        lib/llm/src/entrypoint/input/common.rs:154-185)."""
         from dynamo_tpu.tokens.hashing import request_seed
 
         hashes = block_hashes(
@@ -351,6 +357,13 @@ class KvRouter:
             # radix walk on the per-request hot path
             collect["host_overlaps"] = host_overlaps
         workers = self.workers()
+        if allowed_instances is not None:
+            workers = [w for w in workers if w[0] in allowed_instances]
+            if not workers:
+                raise RequestPlaneError(
+                    f"no workers hold adapter {adapter!r}",
+                    code="no_instances",
+                )
         if pinned_instance is not None:
             workers = [w for w in workers if w[0] == pinned_instance]
             if not workers:
@@ -469,10 +482,12 @@ class KvPushRouter:
 
             mm_seed = mm_content_seed(mm["data"])
         collect: Dict[str, Any] = {}
+        allowed = context.metadata.get("allowed_instances")
         worker, overlap, hashes = self.router.find_best_match(
             token_ids, adapter=request.get("adapter"), mm_seed=mm_seed,
             pinned_instance=context.metadata.get("target_instance"),
             collect=collect,
+            allowed_instances=set(allowed) if allowed is not None else None,
         )
         from dynamo_tpu.tokens.hashing import request_seed
 

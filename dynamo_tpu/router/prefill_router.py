@@ -77,6 +77,18 @@ class PrefillRouter:
         self.policy = policy or DisaggPolicy()
         self._prefill_client = None  # EndpointClient for the prefill component
         self._fetch_path: Optional[str] = None
+        # LoRA filter over the prefill pool: None = unrestricted; a set of
+        # instance ids = only those prefill replicas hold this entry's
+        # adapter. An EMPTY set is meaningful — no prefill replica holds
+        # the adapter, so every hop falls back to aggregated (the decode
+        # worker, which does hold it, prefills locally) instead of landing
+        # on a prefill worker that would error "unknown adapter".
+        self.allowed_prefill = None
+
+    def restrict_prefill(self, instance_ids) -> None:
+        self.allowed_prefill = (
+            None if instance_ids is None else set(instance_ids)
+        )
 
     # -- lifecycle (reference activation.rs) --------------------------------
     def activate(self, prefill_client, fetch_path: str) -> None:
@@ -176,7 +188,7 @@ class PrefillRouter:
                        metadata=pmeta)
         try:
             client = self._prefill_client
-            iid, _ = client.router._pick()
+            iid, _ = client.router._pick(allowed=self.allowed_prefill)
             inst = client.instances.get(iid)
             async for item in client.direct(preq, iid, pctx):
                 kt = item.get("kv_transfer")

@@ -202,3 +202,132 @@ async def test_adapter_served_as_model_through_frontend():
         await worker.stop()
         await rt.shutdown(drain_timeout=1)
         engine.stop()
+
+
+async def test_lora_filtered_routing():
+    """Two-stage LoRA routing (VERDICT r4 #4): a request for adapter X is
+    only ever routed to replicas whose card holds X; base-model requests
+    spread over everyone; a replica joining later with a NEW adapter gets
+    it registered; when the last holder leaves, the adapter 404s while
+    the base model keeps serving."""
+    from dynamo_tpu.frontend.protocols import ModelCard
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
+
+    realm = "lora-routing"
+
+    async def boot(adapters):
+        runner = _runner(lora_slots=2)
+        for aname, seed in adapters:
+            runner.register_adapter(
+                aname, lora_mod.random_adapter(CFG, seed=seed, scale=2.0))
+        engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+        rt = DistributedRuntime(
+            discovery=MemDiscovery(realm=realm), event_transport="inproc")
+        card = ModelCard(name="tiny", tokenizer="byte", context_length=256,
+                         kv_block_size=4, adapters=[a for a, _ in adapters])
+        w = await serve_worker(rt, engine, card)
+        return rt, engine, w
+
+    rt_a, eng_a, w_a = await boot([("tuned", 3)])
+    rt_b, eng_b, w_b = await boot([])  # same base model, NO adapter
+
+    frt = DistributedRuntime(
+        discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager, router_mode="round_robin")
+    await watcher.start()
+    closers = [watcher.stop, frt.shutdown]
+    try:
+        await watcher.wait_for_model(timeout=10)
+        for _ in range(100):
+            if len(manager.get("tiny").instance_ids) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(manager.get("tiny").instance_ids) == 2
+
+        async def via(model):
+            entry = manager.get(model)
+            req = entry.preprocessor.preprocess_completions(
+                {"model": model, "prompt": [4, 2, 4, 2], "max_tokens": 3,
+                 "temperature": 0.0})
+            toks = []
+            async for item in entry.chain.generate(req, Context()):
+                if item.get("finish_reason") == "error":
+                    # a mis-routed adapter request surfaces exactly here
+                    # ("unknown LoRA adapter" from the non-holding worker)
+                    raise RuntimeError(item.get("error"))
+                toks.extend(item.get("token_ids") or [])
+                if item.get("finish_reason"):
+                    break
+            return toks
+
+        # adapter requests: every one lands on the holder, despite
+        # round-robin over a 2-instance endpoint — a single request on the
+        # adapterless replica would error, and its engine would show work
+        for _ in range(6):
+            assert await via("tuned")
+        assert not eng_b.fpm_history, "adapter request reached non-holder"
+        # base requests reach both replicas (round robin)
+        for _ in range(6):
+            assert await via("tiny")
+        assert eng_b.fpm_history, "base requests never reached replica B"
+
+        # a THIRD replica joining with a new adapter registers it late
+        rt_c, eng_c, w_c = await boot([("late", 9)])
+        closers += [w_c.stop, rt_c.shutdown, eng_c.stop]
+        for _ in range(100):
+            if "late" in manager.list_models():
+                break
+            await asyncio.sleep(0.05)
+        for _ in range(4):
+            assert await via("late")  # would error on replicas A/B
+
+        # last holder of "tuned" leaves: adapter 404s, base keeps serving
+        await w_a.stop()
+        await rt_a.shutdown(drain_timeout=1)
+        eng_a.stop()
+        for _ in range(200):
+            if "tuned" not in manager.list_models():
+                break
+            await asyncio.sleep(0.05)
+        assert "tuned" not in manager.list_models()
+        with pytest.raises(KeyError):
+            manager.get("tuned")
+        assert await via("tiny")
+    finally:
+        for c in [w_b.stop, rt_b.shutdown, eng_b.stop] + closers:
+            try:
+                r = c()
+                if asyncio.iscoroutine(r):
+                    await r
+            except Exception:
+                pass
+
+
+def test_push_router_allowed_filter():
+    """PushRouter._pick honors the candidate restriction in every mode and
+    fails loudly when the restriction empties the set or conflicts with an
+    explicit pin."""
+    from dynamo_tpu.runtime.request_plane import (
+        PushRouter,
+        RequestPlaneError,
+        RouterMode,
+    )
+
+    for mode in (RouterMode.ROUND_ROBIN, RouterMode.RANDOM, RouterMode.P2C,
+                 RouterMode.LEAST_LOADED, RouterMode.DEVICE_AWARE):
+        r = PushRouter("ns/c/e", mode)
+        r.update_instance(1, "tcp://a")
+        r.update_instance(2, "tcp://b")
+        r.update_instance(3, "tcp://c")
+        picks = {r._pick(allowed={2})[0] for _ in range(8)}
+        assert picks == {2}, (mode, picks)
+        with pytest.raises(RequestPlaneError) as ei:
+            r._pick(allowed=set())
+        assert ei.value.code == "no_instances"
+        with pytest.raises(RequestPlaneError) as ei:
+            r._pick(instance_id=1, allowed={2})
+        assert ei.value.code == "cannot_connect"
