@@ -98,6 +98,7 @@ def _make_engine(args, mocker: bool):
         runner,
         max_batch=args.max_batch,
         chunk_size=args.chunk_size,
+        mixed_prefill_tokens=args.mixed_prefill_tokens,
         host_kv_blocks=args.host_kv_blocks,
     )
 
@@ -253,6 +254,9 @@ def parse_args(argv=None):
     p.add_argument("--max-pages-per-seq", type=int, default=16)
     p.add_argument("--max-batch", type=int, default=32)
     p.add_argument("--chunk-size", type=int, default=512)
+    p.add_argument("--mixed-prefill-tokens", type=int, default=256,
+                   help="prefill chunk cap when co-scheduled with decode "
+                        "(0 = strict prefill-first alternation)")
     p.add_argument("--host-kv-blocks", type=int, default=0)
     p.add_argument("--decode-buckets", type=int, nargs="+", default=[8, 16, 32])
     p.add_argument("--prefill-buckets", type=int, nargs="+",

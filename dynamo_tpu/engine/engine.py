@@ -30,6 +30,7 @@ if TYPE_CHECKING:  # jax stays un-imported in mocker processes
     from dynamo_tpu.engine.model_runner import ModelRunner
 from dynamo_tpu.engine.scheduler import (
     DecodePlan,
+    MixedPlan,
     PrefillPlan,
     Scheduler,
     SchedulerStats,
@@ -65,6 +66,8 @@ class InferenceEngine:
         max_batch: int = 64,
         chunk_size: int = 512,
         decode_steps: int = 4,
+        mixed_prefill_tokens: int = 256,  # chunk cap when co-scheduled
+        #   with decode (0 = strict prefill-first alternation)
         idle_sleep_s: float = 0.002,
         host_kv_blocks: int = 0,  # G2 host-tier capacity (0 = disabled)
         disk_kv_blocks: int = 0,  # G3 disk-tier capacity (needs G2 enabled)
@@ -114,6 +117,7 @@ class InferenceEngine:
             chunk_size=chunk_size,
             max_seq_pages=runner.max_pages_per_seq,
             decode_steps=decode_steps,
+            mixed_prefill_tokens=mixed_prefill_tokens,
             host_tier=self.host_pool,
             host_onboard=self._onboard_from_host if self.host_pool is not None else None,
         )
@@ -475,10 +479,22 @@ class InferenceEngine:
                 time.sleep(self.idle_sleep_s)
             return
         t0 = time.monotonic()
+        decode_done = False
         try:
             if isinstance(plan, PrefillPlan):
                 self._run_prefill(plan)
                 kind, n_tok = "prefill", len(plan.chunk)
+            elif isinstance(plan, MixedPlan):
+                # decode first: ITL never waits behind prompt processing.
+                # Publish the halves as separate FPM events so observers
+                # fitting per-kind step-time models keep clean samples.
+                self._run_decode(plan.decode)
+                decode_done = True
+                t1 = time.monotonic()
+                self._publish_fpm("decode", t1 - t0, len(plan.decode.seqs))
+                self._run_prefill(plan.prefill)
+                kind, n_tok = "prefill", len(plan.prefill.chunk)
+                t0 = t1
             else:
                 self._run_decode(plan)
                 kind, n_tok = "decode", len(plan.seqs)
@@ -488,8 +504,18 @@ class InferenceEngine:
             # one bad step (malformed import, shape bug, OOM) must fail
             # ITS sequences, never kill the step thread: a dead loop
             # strands every queued request with no error and no stream
-            # end (the failure surfaces only as a distributed hang)
-            seqs = [plan.seq] if isinstance(plan, PrefillPlan) else plan.seqs
+            # end (the failure surfaces only as a distributed hang).
+            # For a mixed step whose decode half already completed, only
+            # the prefill sequence is at risk — its decode batch has
+            # emitted this iteration's tokens and stays healthy.
+            if isinstance(plan, PrefillPlan):
+                seqs = [plan.seq]
+            elif isinstance(plan, MixedPlan):
+                seqs = [plan.prefill.seq] if decode_done else (
+                    list(plan.decode.seqs) + [plan.prefill.seq]
+                )
+            else:
+                seqs = plan.seqs
             log.exception(
                 "engine step failed; erroring %d sequence(s)", len(seqs)
             )
@@ -1012,6 +1038,22 @@ class InferenceEngine:
         histories = (
             [list(s.tokens) for s in seqs] if _batch_penalties(seqs) else None
         )
+        if (n_lp >= 0 or histories is not None) and getattr(
+            self.runner, "pp", False
+        ):
+            # the PP decode loop has no logprob/penalty wiring yet — drop
+            # the extras with a warning (same contract as spec decode
+            # above) instead of letting a raise inside the shared dispatch
+            # error EVERY sequence in the plan
+            for s in seqs:
+                if s.request_id not in self._spec_sampling_warned:
+                    self._spec_sampling_warned.add(s.request_id)
+                    log.warning(
+                        "request %s: logprobs/penalties are unsupported on "
+                        "pipeline-parallel workers and were ignored",
+                        s.request_id,
+                    )
+            n_lp, histories = -1, None
         lp = None
         if (n_lp >= 0 or histories is not None) and hasattr(
             self.runner, "decode_multi_ex"

@@ -100,6 +100,19 @@ class DecodePlan:
 
 
 @dataclass
+class MixedPlan:
+    """One engine iteration that co-schedules the running decode batch
+    with a bounded prefill chunk (vLLM-style chunked-prefill batching —
+    the semantics the reference's planner models,
+    docs/design-docs/planner-design.md:262). Decode runs first so ITL
+    never waits behind prompt processing; the chunk is capped at
+    `mixed_prefill_tokens` so its cost per iteration is bounded."""
+
+    prefill: PrefillPlan
+    decode: DecodePlan
+
+
+@dataclass
 class SchedulerStats:
     """Per-iteration ForwardPassMetrics feed (planner observes these)."""
 
@@ -119,6 +132,7 @@ class Scheduler:
         max_seq_pages: int = 128,
         enable_prefix_cache: bool = True,
         decode_steps: int = 1,
+        mixed_prefill_tokens: int = 256,
         host_tier=None,  # HostKvPool-like: .match(hashes) -> n
         host_onboard=None,  # cb(pages, hashes) -> bool (imports G2→G1 data)
     ):
@@ -128,6 +142,12 @@ class Scheduler:
         self.max_seq_pages = max_seq_pages
         self.enable_prefix_cache = enable_prefix_cache
         self.decode_steps = decode_steps
+        # co-scheduling budget: when decode work exists, prefill chunks are
+        # capped at this many tokens and run IN THE SAME iteration as the
+        # decode dispatch (0 = legacy strict prefill-first alternation).
+        # With no running sequences the full chunk_size still applies —
+        # the cap trades TTFT for bounded ITL only when both compete.
+        self.mixed_prefill_tokens = mixed_prefill_tokens
         self.host_tier = host_tier
         self.host_onboard = host_onboard
         self.waiting: deque[Sequence] = deque()
@@ -155,14 +175,24 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.active)
 
-    def step_plan(self) -> Optional[PrefillPlan | DecodePlan]:
-        """Admit what fits, then plan this iteration's work."""
+    def step_plan(self) -> Optional[PrefillPlan | DecodePlan | MixedPlan]:
+        """Admit what fits, then plan this iteration's work.
+
+        With `mixed_prefill_tokens > 0` the plan co-schedules: the whole
+        running batch decodes every iteration, and at most one bounded
+        prefill chunk rides along (MixedPlan). Strict prefill-first
+        alternation (mixed_prefill_tokens=0) stalls every decode for the
+        full chunk pipeline of each arriving prompt — the ITL inflation
+        the reference planner's chunked-prefill model exists to avoid."""
         self._admit()
-        # prefill first: any active sequence with uncomputed prompt
-        for seq in self.active:
-            if seq.state == SeqState.PREFILL:
-                return self._plan_prefill(seq)
+        prefill_seq = next(
+            (s for s in self.active if s.state == SeqState.PREFILL), None
+        )
         running = [s for s in self.active if s.state == SeqState.RUNNING]
+        if prefill_seq is not None and (
+            not running or self.mixed_prefill_tokens <= 0
+        ):
+            return self._plan_prefill(prefill_seq)
         if not running:
             self._update_stats(0)
             return None
@@ -178,10 +208,18 @@ class Scheduler:
             n_steps = min(n_steps, max(1, budget))
         running = self._ensure_decode_capacity(running, lookahead=n_steps)
         if not running:
+            if prefill_seq is not None:
+                return self._plan_prefill(prefill_seq)
             self._update_stats(0)
             return None
-        self._update_stats(len(running) * n_steps)
-        return DecodePlan(running, n_steps)
+        if prefill_seq is None:
+            self._update_stats(len(running) * n_steps)
+            return DecodePlan(running, n_steps)
+        pplan = self._plan_prefill(
+            prefill_seq, max_tokens=self.mixed_prefill_tokens
+        )
+        self._update_stats(len(running) * n_steps + len(pplan.chunk))
+        return MixedPlan(prefill=pplan, decode=DecodePlan(running, n_steps))
 
     # -- admission ---------------------------------------------------------
     def _admit(self) -> None:
@@ -247,9 +285,14 @@ class Scheduler:
         return True
 
     # -- prefill -----------------------------------------------------------
-    def _plan_prefill(self, seq: Sequence) -> PrefillPlan:
+    def _plan_prefill(
+        self, seq: Sequence, max_tokens: Optional[int] = None
+    ) -> PrefillPlan:
         start = seq.computed_len
-        end = min(len(seq.prompt), start + self.chunk_size)
+        budget = self.chunk_size if max_tokens is None else min(
+            self.chunk_size, max(1, max_tokens)
+        )
+        end = min(len(seq.prompt), start + budget)
         return PrefillPlan(
             seq=seq,
             chunk=seq.prompt[start:end],

@@ -103,6 +103,54 @@ def test_scheduler_prefill_then_decode_cycle():
     assert pool.n_free == 16  # everything released (some pages cached)
 
 
+def test_scheduler_mixed_coschedule():
+    """With decode work present, an arriving prompt prefills in bounded
+    chunks IN THE SAME iteration as the decode batch (MixedPlan) — decode
+    never stalls behind prompt processing (VERDICT r4 #2 / the reference
+    planner's chunked-prefill model)."""
+    from dynamo_tpu.engine.scheduler import DecodePlan, MixedPlan, PrefillPlan
+
+    pool = PagePool(32, 4)
+    sch = Scheduler(pool, max_batch=4, chunk_size=64, mixed_prefill_tokens=4)
+    a = _seq("a", [1, 2, 3], max_tokens=20)
+    sch.add(a)
+    plan = sch.step_plan()
+    assert isinstance(plan, PrefillPlan)  # no decode work yet: full chunk
+    sch.complete_prefill(plan)
+    sch.complete_decode(a, 10, advance_computed=False)
+
+    b = _seq("b", list(range(1, 13)), max_tokens=20)  # 12-token prompt
+    sch.add(b)
+    decode_iterations = 0
+    while b.state != SeqState.RUNNING:  # admission happens inside step_plan
+        plan = sch.step_plan()
+        assert isinstance(plan, MixedPlan), plan
+        assert plan.decode.seqs == [a] and len(plan.prefill.chunk) <= 4
+        sch.complete_decode(a, 20 + decode_iterations)  # decode half ran
+        sch.complete_prefill(plan.prefill)
+        decode_iterations += 1
+    # 12 tokens / 4-token mixed cap = 3 iterations, decode advanced in each
+    assert decode_iterations == 3 and a.n_generated == 4
+    sch.complete_decode(b, 50, advance_computed=False)
+    plan = sch.step_plan()
+    assert isinstance(plan, DecodePlan) and len(plan.seqs) == 2
+    assert a in plan.seqs and b in plan.seqs
+
+
+def test_scheduler_mixed_disabled_is_prefill_first():
+    from dynamo_tpu.engine.scheduler import PrefillPlan
+
+    pool = PagePool(32, 4)
+    sch = Scheduler(pool, max_batch=4, chunk_size=4, mixed_prefill_tokens=0)
+    a = _seq("a", [1, 2, 3], max_tokens=20)
+    sch.add(a)
+    sch.complete_prefill(sch.step_plan())
+    sch.complete_decode(a, 10, advance_computed=False)
+    sch.add(_seq("b", list(range(1, 10)), max_tokens=20))
+    plan = sch.step_plan()  # legacy: prefill preempts the decode batch
+    assert isinstance(plan, PrefillPlan) and plan.chunk == [1, 2, 3, 4]
+
+
 def test_scheduler_stop_id_finishes():
     pool = PagePool(16, 4)
     sch = Scheduler(pool, max_batch=4, chunk_size=64)
@@ -135,7 +183,9 @@ def test_scheduler_prefix_cache_reuse_across_requests():
 
 def test_scheduler_preemption_recompute():
     pool = PagePool(6, 2)  # very tight: 12 token slots
-    sch = Scheduler(pool, max_batch=4, chunk_size=64, enable_prefix_cache=False)
+    # strict alternation: this test drives prefill completion by hand
+    sch = Scheduler(pool, max_batch=4, chunk_size=64,
+                    enable_prefix_cache=False, mixed_prefill_tokens=0)
     a = _seq("a", [1, 2, 3], max_tokens=20)
     b = _seq("b", [4, 5, 6], max_tokens=20)
     sch.add(a)
