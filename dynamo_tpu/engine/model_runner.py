@@ -276,6 +276,34 @@ class ModelRunner:
         self.mesh_config = mesh_config or MeshConfig()
         self.mesh = make_mesh(self.mesh_config, devices)
         self.policy = ShardingPolicy(self.mesh)
+        # pipeline parallelism: layer-stacked params and the KV pool shard
+        # their leading [L] axis over `pipe`; step functions run the GPipe
+        # schedule (ops/pipeline_parallel.py). v1 composition envelope —
+        # the schedule's inner ops are plain jnp, so other mesh axes and
+        # the feature planes that thread extra per-layer state are gated
+        # off explicitly rather than silently miscomputed.
+        self.pp = self.mesh_config.pipe > 1
+        if self.pp:
+            from dynamo_tpu.ops import pipeline_parallel as _ppmod
+
+            mc = self.mesh_config
+            if (mc.model, mc.expert, mc.seq, mc.data) != (1, 1, 1, 1):
+                raise NotImplementedError(
+                    "pipe>1 composes with no other mesh axis yet "
+                    f"(got {mc.shape})"
+                )
+            if config.n_layers % mc.pipe != 0:
+                raise ValueError(
+                    f"{config.n_layers} layers not divisible by "
+                    f"pipe={mc.pipe} stages"
+                )
+            if draft_config is not None or lora_slots > 0 or kv_quantize:
+                raise NotImplementedError(
+                    "speculative decoding / LoRA / int8-KV are not wired "
+                    "on the pipeline-parallel path yet"
+                )
+            _ppmod._check(config)  # dense GQA family only
+            self._ppmod = _ppmod
         # mesh spanning several processes (multi-host group,
         # parallel/multihost.py): pool reads must gather to a replicated
         # sharding before device_get — remote shards aren't addressable
@@ -310,16 +338,22 @@ class ModelRunner:
         # transfer-path page movement via the Pallas batched copy kernels
         # (ops/block_copy.py) instead of XLA gather/scatter — opt-in until
         # a hardware A/B lands (same rollout policy as attn_impl).
-        # Single-device pools only: on TP meshes the pool is head-sharded
-        # and the plain pallas_call would force replication (the XLA path
-        # partitions fine there).
+        # Single-device pools run the plain pallas_call; TP-only meshes run
+        # it under shard_map over the head-sharded pool (per-shard page
+        # streams, zero collectives — the decode_paged_attention_sharded
+        # pattern). Other mesh axes keep the XLA path (GSPMD partitions it).
         import os
 
+        mc = self.mesh_config
+        tp_only_mesh = (
+            mc.model > 1 and mc.data == mc.expert == mc.seq == mc.pipe == 1
+        )
         flag = os.environ.get("DYN_KV_COPY_KERNEL", "").lower()
         self._kv_copy_kernel = (
             flag in ("1", "true", "on", "yes")
-            and self.mesh_config.n_devices == 1
+            and (self.mesh_config.n_devices == 1 or tp_only_mesh)
         )
+        self._kv_copy_sharded = self._kv_copy_kernel and tp_only_mesh
         # non-TPU runs (CPU tests) execute the copy kernels in interpret
         # mode (platform from the mesh's devices, like attn_impl)
         self._kv_copy_interpret = (
@@ -400,6 +434,22 @@ class ModelRunner:
             static_argnums=(0, 1),  # n_steps, n_logprobs
             donate_argnums=(7, 8),  # k_pool, v_pool
         )
+        if self.pp:
+            from dynamo_tpu.parallel.mesh import AXIS_PIPE
+
+            self._jit_pp_prefill = jax.jit(
+                partial(self._ppmod.pp_forward, self.config),
+                donate_argnums=(3, 4),  # k_pool, v_pool
+                static_argnames=("mesh", "axis"),
+            )
+            self._jit_pp_decode = jax.jit(
+                partial(
+                    self._ppmod.pp_decode_loop, self.config, self.mesh,
+                    AXIS_PIPE,
+                ),
+                static_argnums=(0,),  # n_steps
+                donate_argnums=(5, 6),  # k_pool, v_pool
+            )
         # device-resident sampling cache: batches re-send identical sampling
         # params every dispatch; transferring them each time costs one relay
         # round trip PER ARRAY (see _decode_loop)
@@ -437,6 +487,16 @@ class ModelRunner:
         hits + earlier chunks). `mm` injects multimodal embeddings at
         chunk-local offsets. Returns last-token logits [V] (device)."""
         tok, pos, pt, kv_lens, n = self._prep_prefill(tokens, start_pos, page_table_row, prior_len)
+        if self.pp:
+            if mm is not None:
+                raise NotImplementedError(
+                    "multimodal prefill is not wired on the PP path yet"
+                )
+            logits, self.k_pool, self.v_pool = self._jit_pp_prefill(
+                self.params, tok, pos, self.k_pool, self.v_pool, pt, kv_lens,
+                mesh=self.mesh, axis="pipe",
+            )
+            return logits[0, n - 1]
         impl = "ring" if self.sp_enabled else self.attn_impl
         mm_embeds, mm_mask = self._mm_arrays(mm, tok.shape[1])
         logits, self.k_pool, self.v_pool = self._jit_forward(
@@ -622,6 +682,19 @@ class ModelRunner:
             m = np.ones((B, self.config.vocab_size), bool)
             m[: masks.shape[0]] = masks  # pad rows stay all-allowed
             mask_dev = jnp.asarray(m)
+
+        if self.pp:
+            if n_logprobs >= 0 or hist is not None:
+                raise NotImplementedError(
+                    "logprobs/penalties are not wired on the "
+                    "pipeline-parallel decode path yet"
+                )
+            toks, last, self.k_pool, self.v_pool = self._jit_pp_decode(
+                n_steps, self.params, tok, jnp.asarray(packed), mask_dev,
+                self.k_pool, self.v_pool,
+                self._device_sampling(sampling, B),
+            )
+            return toks, last
 
         toks, last, lp, self.k_pool, self.v_pool = self._jit_decode_loop(
             n_steps, n_logprobs, self.params, tok, jnp.asarray(packed), hist,
@@ -865,8 +938,15 @@ class ModelRunner:
             sel = jax.tree.map(lambda a: a[:, idx], pool)
             return kv_pool_dequantize(sel, dtype=self.dtype)
         if self._kv_copy_kernel:
-            from dynamo_tpu.ops.block_copy import gather_pages
+            from dynamo_tpu.ops.block_copy import (
+                gather_pages,
+                gather_pages_sharded,
+            )
 
+            if self._kv_copy_sharded:
+                return gather_pages_sharded(
+                    pool, idx, self.mesh, interpret=self._kv_copy_interpret
+                )
             return gather_pages(pool, idx, interpret=self._kv_copy_interpret)
         return pool[:, idx]
 
@@ -877,8 +957,16 @@ class ModelRunner:
             d = kv_pool_quantize(dense)
             return jax.tree.map(lambda a, u: a.at[:, idx].set(u), pool, d)
         if self._kv_copy_kernel:
-            from dynamo_tpu.ops.block_copy import scatter_pages
+            from dynamo_tpu.ops.block_copy import (
+                scatter_pages,
+                scatter_pages_sharded,
+            )
 
+            if self._kv_copy_sharded:
+                return scatter_pages_sharded(
+                    pool, idx, dense.astype(pool.dtype), self.mesh,
+                    interpret=self._kv_copy_interpret,
+                )
             return scatter_pages(pool, idx, dense.astype(pool.dtype),
                                  interpret=self._kv_copy_interpret)
         return pool.at[:, idx].set(dense)

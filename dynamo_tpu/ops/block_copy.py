@@ -83,6 +83,64 @@ def gather_pages(
     return out if stacked else out[0]
 
 
+def gather_pages_sharded(
+    pool: jax.Array,  # [L, NP, PS, Hk, D], kv-heads sharded over `axis`
+    idx: jax.Array,  # [n] int32 page ids, replicated
+    mesh,
+    axis: str = "model",
+    *,
+    head_major: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel wrapper (same pattern as
+    decode_paged_attention_sharded): page copies are independent per
+    kv-head, and the pool shards kv-heads over the model axis
+    (ShardingPolicy), so each shard streams its local head slice of every
+    page — zero collectives. Output keeps the pool's head sharding."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    pool_spec = P(None, None, None, axis, None)
+    out_spec = (P(None, None, axis, None, None) if head_major
+                else P(None, None, None, axis, None))
+    fn = jax.shard_map(
+        functools.partial(
+            gather_pages, head_major=head_major, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(pool_spec, P(None)),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+    return fn(pool, idx)
+
+
+def scatter_pages_sharded(
+    pool: jax.Array,  # [L, NP, PS, Hk, D], kv-heads sharded over `axis`
+    idx: jax.Array,  # [n] int32 target page ids, replicated
+    pages: jax.Array,  # [L, n, PS, Hk, D] dense pages (head-sharded or
+    #   replicated — GSPMD reshards to match)
+    mesh,
+    axis: str = "model",
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, None, axis, None)
+    fn = jax.shard_map(
+        functools.partial(scatter_pages, interpret=interpret),
+        mesh=mesh,
+        in_specs=(spec, P(None), spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(pool, idx, pages)
+
+
 def _scatter_kernel(idx_ref, pool_in_ref, pages_ref, pool_ref):
     del pool_in_ref  # aliased through to the output; only written blocks move
     pool_ref[...] = pages_ref[...]

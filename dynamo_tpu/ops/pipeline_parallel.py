@@ -12,9 +12,12 @@ and are neutralized by masking (positions = -1 drops their KV writes;
 their outputs are never committed), so the whole schedule is one
 compiled program with static shapes — no per-stage host orchestration.
 
-Scope: the dense GQA family (no MoE/MLA/LoRA here yet); engine
-integration is pending a hardware profile — TP+DP cover ≤70B on v5e
-(SURVEY §2.10), so PP is for the tail beyond that.
+Scope: the dense GQA family (no MoE/MLA/LoRA here yet). Engine
+integration: ModelRunner dispatches its prefill/decode step functions
+through pp_forward / pp_decode_loop when the mesh has a pipe axis
+(MeshConfig(pipe=S)); TP+DP cover ≤70B on v5e (SURVEY §2.10), so PP is
+for the tail beyond that — the reference delegates the same role to its
+engines (components/src/dynamo/vllm/main.py:133-137).
 """
 
 from __future__ import annotations
@@ -170,3 +173,48 @@ def pp_forward(
         else mm(hf, params["lm_head"])
     ).astype(jnp.float32)
     return logits, kp, vp
+
+
+def pp_decode_loop(
+    config: ModelConfig,
+    mesh: Mesh,
+    axis: str,
+    n_steps: int,
+    params,
+    tokens0: jax.Array,  # [B] current token per seq
+    packed: jax.Array,  # int32 [B + B*MP + 1]: positions | page_table | step
+    mask,  # None or bool [B, V] guided sampling mask (n_steps=1 dispatches)
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    sampling,
+):
+    """Fused multi-step decode through the GPipe schedule: each scan step
+    runs one pipelined forward over the whole batch (B microbatched over
+    the stages), samples on the replicated logits, and feeds the token
+    back — the pipeline-parallel twin of model_runner._decode_loop, same
+    packed-ints dispatch contract. Logprobs/penalties/LoRA are not wired
+    on the PP path yet (ModelRunner rejects them at construction /
+    dispatch). Returns (toks [B, n_steps], last [B], k_pool, v_pool)."""
+    from dynamo_tpu.engine.sampling import sample
+
+    B = sampling.temperature.shape[0]
+    MP = (packed.shape[0] - 1 - B) // B
+    positions0 = packed[:B]
+    page_table = packed[B : B + B * MP].reshape(B, MP)
+    step0 = packed[-1]
+
+    def body(carry, t):
+        tok, kp, vp = carry
+        pos = jnp.where(positions0 < 0, -1, positions0 + t)
+        kvl = jnp.where(positions0 < 0, 0, positions0 + t + 1)
+        logits, kp, vp = pp_forward(
+            config, params, tok[:, None], pos[:, None], kp, vp,
+            page_table, kvl, mesh, axis,
+        )
+        s = sample(logits[:, 0, :], sampling, step0 + t, mask=mask)
+        return (s, kp, vp), s
+
+    (last, k_pool, v_pool), toks = lax.scan(
+        body, (tokens0, k_pool, v_pool), jnp.arange(n_steps, dtype=jnp.int32)
+    )
+    return toks.T, last, k_pool, v_pool  # [B, n_steps], [B]

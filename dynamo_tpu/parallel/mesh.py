@@ -24,7 +24,8 @@ AXIS_DATA = "data"
 AXIS_MODEL = "model"
 AXIS_EXPERT = "expert"
 AXIS_SEQ = "seq"
-ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_EXPERT, AXIS_SEQ)
+AXIS_PIPE = "pipe"
+ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_EXPERT, AXIS_SEQ, AXIS_PIPE)
 
 
 @dataclass(frozen=True)
@@ -33,14 +34,19 @@ class MeshConfig:
     model: int = 1
     expert: int = 1
     seq: int = 1
+    # pipeline stages: layer-stacked params and the KV pool shard their
+    # leading [L] axis; the GPipe schedule (ops/pipeline_parallel.py)
+    # runs them stage-parallel. Trailing axis so pipe=1 configs keep
+    # their device layout from earlier rounds.
+    pipe: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.model * self.expert * self.seq
+        return self.data * self.model * self.expert * self.seq * self.pipe
 
     @property
-    def shape(self) -> Tuple[int, int, int, int]:
-        return (self.data, self.model, self.expert, self.seq)
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.data, self.model, self.expert, self.seq, self.pipe)
 
 
 def make_mesh(config: MeshConfig, devices: Optional[list] = None) -> Mesh:
@@ -78,6 +84,11 @@ class ShardingPolicy:
         # embed quantizes per-ROW (scale [V, 1], reduced over E) unlike the
         # [..., in, out] weights, so its scale replicates instead of
         # following the generic collapsed-contraction rule below
+        # pipeline stages own contiguous layer blocks: every layer-stacked
+        # leaf shards its leading [L] axis on `pipe` (other dims stay
+        # replicated — pipe>1 requires model==1, enforced by ModelRunner)
+        if self.mesh.shape.get(AXIS_PIPE, 1) > 1 and path.startswith("layers/"):
+            return P(AXIS_PIPE)
         if path.endswith("embed/s"):
             return P()
         # int8 weight-only quantization (models/quant.py): the q tensor
@@ -130,8 +141,10 @@ class ShardingPolicy:
 
     # -- kv cache ----------------------------------------------------------
     def kv_pool_spec(self) -> P:
-        # token-major [layers, num_pages, page_size, kv_heads, head_dim]
-        return P(None, None, None, AXIS_MODEL, None)
+        # token-major [layers, num_pages, page_size, kv_heads, head_dim];
+        # pipeline stages hold their own layers' KV (pipe shards L)
+        pipe = AXIS_PIPE if self.mesh.shape.get(AXIS_PIPE, 1) > 1 else None
+        return P(pipe, None, None, AXIS_MODEL, None)
 
     def kv_pool_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.kv_pool_spec())
@@ -144,7 +157,8 @@ class ShardingPolicy:
         instead: MLA latent pools have Hk=1 by construction (the cache is
         per-token, not per-head) and are small enough to replicate."""
         n_model = self.mesh.shape.get(AXIS_MODEL, 1)
-        scale = NamedSharding(self.mesh, P(None, None, None, AXIS_MODEL))
+        pipe = AXIS_PIPE if self.mesh.shape.get(AXIS_PIPE, 1) > 1 else None
+        scale = NamedSharding(self.mesh, P(pipe, None, None, AXIS_MODEL))
         repl = NamedSharding(self.mesh, P())
 
         def _one(a):

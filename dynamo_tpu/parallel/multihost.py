@@ -346,6 +346,9 @@ def selftest_main(argv=None) -> None:
     p.add_argument("--process-id", type=int, required=True)
     p.add_argument("--num", type=int, required=True)
     p.add_argument("--coordinator", required=True)
+    p.add_argument("--axis", default="model", choices=["model", "pipe"],
+                   help="mesh axis the group spans: TP (default) or "
+                        "pipeline stages (GPipe serving path)")
     args = p.parse_args(argv)
 
     spec = MultihostSpec(
@@ -361,8 +364,10 @@ def selftest_main(argv=None) -> None:
     from dynamo_tpu.models.config import get_config
     from dynamo_tpu.parallel.mesh import MeshConfig
 
+    mesh = (MeshConfig(pipe=args.num) if args.axis == "pipe"
+            else MeshConfig(model=args.num))
     runner = ModelRunner(
-        get_config("tiny"), MeshConfig(model=args.num),
+        get_config("tiny"), mesh,
         num_pages=32, page_size=4, max_pages_per_seq=8,
         decode_buckets=(1, 2, 4), prefill_buckets=(8, 16), seed=0,
     )
@@ -371,6 +376,15 @@ def selftest_main(argv=None) -> None:
     # plain path first (what every logprob-free request takes) ...
     tok0 = runner.sample_one(logits, s, 0)
     runner.decode_multi(2, [tok0], [5], [[0, 1, 2]], s, 1)
+    if args.axis == "pipe":
+        # each process is one GPipe stage; the _ex sampling extras are not
+        # wired on the PP path, so the group signature is the plain tokens
+        out = runner.decode_multi(3, [tok0], [7], [[0, 1, 2]], s, 3)
+        payload = runner.export_pages([0, 1])  # replicated-gather path
+        runner.import_pages([3, 4], 0, payload)
+        print(f"MULTIHOST_SELFTEST pipe {[tok0] + out[0].tolist()}",
+              flush=True)
+        return
     # ... then the _ex variants (penalties + logprobs), REPLICATED_METHODS
     # too — group replay must cover the paths the engine prefers whenever
     # a request carries logprobs/penalties

@@ -63,6 +63,9 @@ def parse_args(argv=None):
     p.add_argument("--tensor-parallel", type=int, default=1)
     p.add_argument("--expert-parallel", type=int, default=1)
     p.add_argument("--seq-parallel", type=int, default=1)
+    p.add_argument("--pipeline-parallel", type=int, default=1,
+                   help="GPipe stages over a pipe mesh axis (dense GQA "
+                        "family; composes with no other axis yet)")
     # KV cache
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--page-size", type=int, default=16)
@@ -79,6 +82,10 @@ def parse_args(argv=None):
     # batching
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
+    p.add_argument("--mixed-prefill-tokens", type=int, default=256,
+                   help="prefill chunk cap when co-scheduled with decode "
+                        "(0 = strict prefill-first). Align with a prefill "
+                        "bucket: the chunk pads to the next bucket anyway")
     # speculative decoding
     p.add_argument("--draft-model", default=None,
                    help="draft model config preset (enables speculative decoding)")
@@ -118,6 +125,9 @@ def parse_args(argv=None):
                    help="serve /live /health /metrics on this port (0 = off)")
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
+    p.add_argument("--request-plane", default=None, choices=[None, "tcp", "nats"],
+                   help="RPC transport: tcp (default) or nats broker "
+                        "subjects (env DYN_REQUEST_PLANE / DYN_NATS_URL)")
     # multi-host worker group (parallel/multihost.py): N processes form one
     # logical worker over a single jax.distributed global mesh. Process 0
     # serves; 1..N-1 replay its step stream. Mesh axis sizes above refer to
@@ -245,6 +255,7 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
         model=args.tensor_parallel,
         expert=args.expert_parallel,
         seq=args.seq_parallel,
+        pipe=getattr(args, "pipeline_parallel", 1),
     )
     max_pages_per_seq = -(-args.max_seq_len // args.page_size)
     draft_config = draft_params = None
@@ -291,6 +302,7 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
     mesh = runner.mesh_config
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
+        mixed_prefill_tokens=getattr(args, "mixed_prefill_tokens", 256),
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
         obj_kv_root=args.obj_kv_root,
@@ -340,6 +352,8 @@ async def async_main(args) -> None:
     kw = {}
     if args.discovery_root:
         kw["root"] = args.discovery_root
+    if getattr(args, "request_plane", None):
+        kw["request_plane"] = args.request_plane
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     spec = getattr(args, "_mh_spec", None)
     plane = None

@@ -419,3 +419,45 @@ async def test_multiprocess_group_disagg_pair(tmp_path):
                 p.wait(timeout=20)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+async def test_two_stage_pipeline_process_group(tmp_path):
+    """2-process group where each OS process is one GPipe STAGE
+    (MeshConfig(pipe=2)): requests flow prefill→decode through the
+    stage-sharded engine path and both ranks print identical tokens
+    (VERDICT r4 #3/#7: a pp axis gated by the suite, not just the op)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.parallel.multihost",
+             "--process-id", str(k), "--num", "2",
+             "--coordinator", f"127.0.0.1:{port}", "--axis", "pipe"],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for k in range(2)
+    ]
+    try:
+        loop = asyncio.get_running_loop()
+        outs = await asyncio.wait_for(
+            asyncio.gather(*[
+                loop.run_in_executor(None, p.communicate) for p in procs
+            ]),
+            timeout=300,
+        )
+        lines = []
+        for p, (out, _) in zip(procs, outs):
+            assert p.returncode == 0, out
+            sig = [l for l in out.splitlines() if "MULTIHOST_SELFTEST" in l]
+            assert sig, out
+            lines.append(sig[0])
+        assert len(set(lines)) == 1, lines
+        assert "pipe" in lines[0]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

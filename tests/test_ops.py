@@ -478,3 +478,41 @@ def test_mla_forward_pallas_prefill_matches_jnp():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=7e-2, atol=7e-2
     )
+
+
+def test_block_copy_kernel_tp2_mesh(monkeypatch):
+    """VERDICT r4 #8: the Pallas copy/permute kernels run under shard_map
+    on a TP=2 head-sharded pool — export/import through the kernel path
+    must be byte-identical to the XLA gather/scatter path."""
+    import numpy as np
+
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    def build(kernel_on):
+        if kernel_on:
+            monkeypatch.setenv("DYN_KV_COPY_KERNEL", "1")
+        else:
+            monkeypatch.delenv("DYN_KV_COPY_KERNEL", raising=False)
+        r = ModelRunner(
+            get_config("tiny"), MeshConfig(model=2), num_pages=16,
+            page_size=4, max_pages_per_seq=8, decode_buckets=(1,),
+            prefill_buckets=(8,), seed=3,
+        )
+        r.prefill([5, 4, 3, 2, 1, 6, 7, 2], 0, [0, 1, 2], prior_len=0)
+        return r
+
+    r_kernel = build(True)
+    assert r_kernel._kv_copy_kernel and r_kernel._kv_copy_sharded
+    r_xla = build(False)
+    assert not r_xla._kv_copy_kernel
+
+    pk = r_kernel.export_pages([0, 1])
+    px = r_xla.export_pages([0, 1])
+    assert pk["k"] == px["k"] and pk["v"] == px["v"]
+
+    # import through the kernel scatter into fresh slots, re-export
+    r_kernel.import_pages([8, 9], 0, pk)
+    back = r_kernel.export_pages([8, 9])
+    assert back["k"] == pk["k"] and back["v"] == pk["v"]

@@ -91,3 +91,108 @@ def test_pp_rejects_unsupported_families():
     c = get_config("tiny-mla")
     with pytest.raises(NotImplementedError):
         pp_forward(c, {}, None, None, None, None, None, None, _pipe_mesh(2))
+
+
+# -- serving integration (VERDICT r4 #3) -------------------------------------
+
+
+def _pp_runner(mesh_config):
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    return ModelRunner(
+        get_config("tiny"), mesh_config=mesh_config, num_pages=64,
+        page_size=4, max_pages_per_seq=16, decode_buckets=(1, 2, 4),
+        prefill_buckets=(8, 16), seed=7,
+    )
+
+
+async def _serve_tokens(runner, prompts, max_tokens=5):
+    import asyncio
+
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.runtime.context import Context
+
+    engine = InferenceEngine(runner, max_batch=4, chunk_size=8)
+    engine.start()
+    try:
+        async def one(prompt):
+            toks = []
+            async for item in engine.generate(
+                {"token_ids": prompt, "sampling": {"temperature": 0.0},
+                 "stop": {"max_tokens": max_tokens, "stop_ids": []}},
+                Context(),
+            ):
+                if item.get("finish_reason") == "error":
+                    raise RuntimeError(item.get("error"))
+                toks.extend(item["token_ids"])
+                if item["finish_reason"]:
+                    break
+            return toks
+
+        return await asyncio.gather(*[one(p) for p in prompts])
+    finally:
+        engine.stop()
+
+
+async def test_pp2_engine_serves_and_matches_single_device():
+    """e2e tokens through a PP=2 worker: the GPipe serving path (prefill
+    chunks + fused multi-step decode + continuous batching) reproduces
+    the single-device engine's greedy output exactly."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    prompts = [[4, 2, 4, 2, 7, 5], [9, 8, 7], [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]]
+    single = await _serve_tokens(_pp_runner(MeshConfig()), prompts)
+    pp2 = await _serve_tokens(_pp_runner(MeshConfig(pipe=2)), prompts)
+    assert single == pp2, (single, pp2)
+    assert all(len(t) == 5 for t in pp2)
+
+
+def test_pp_runner_rejects_unsupported_compositions():
+    import pytest as _pytest
+
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    kw = dict(num_pages=16, page_size=4, max_pages_per_seq=4,
+              decode_buckets=(1,), prefill_buckets=(8,))
+    with _pytest.raises(NotImplementedError):
+        ModelRunner(get_config("tiny"), MeshConfig(pipe=2, model=2), **kw)
+    with _pytest.raises(NotImplementedError):
+        ModelRunner(get_config("tiny"), MeshConfig(pipe=2), lora_slots=1, **kw)
+    with _pytest.raises(ValueError):
+        # tiny has 2 layers; 2 % 3 != 0 has no even stage split
+        ModelRunner(get_config("tiny"), MeshConfig(pipe=3), **kw)
+
+
+async def test_pp2_engine_drops_logprobs_with_warning(caplog):
+    """A logprobs request on a PP worker must stream tokens (extras
+    dropped, spec-decode contract) — not error the whole decode plan."""
+    import asyncio
+    import logging
+
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.runtime.context import Context
+
+    engine = InferenceEngine(_pp_runner(MeshConfig(pipe=2)), max_batch=4,
+                             chunk_size=8)
+    engine.start()
+    try:
+        toks = []
+        with caplog.at_level(logging.WARNING):
+            async for item in engine.generate(
+                {"token_ids": [4, 2, 4], "sampling": {"temperature": 0.0,
+                                                      "logprobs": 2},
+                 "stop": {"max_tokens": 4, "stop_ids": []}},
+                Context(),
+            ):
+                assert item.get("finish_reason") != "error", item
+                toks.extend(item["token_ids"])
+                if item["finish_reason"]:
+                    break
+        assert len(toks) == 4
+        assert any("pipeline-parallel" in r.message for r in caplog.records)
+    finally:
+        engine.stop()
