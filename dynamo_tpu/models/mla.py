@@ -1,0 +1,100 @@
+"""Multi-head latent attention (DeepSeek V2/V3/R1) — the MLA family's
+one divergence from the shared toolkit: attention runs absorbed over a
+per-token latent cache instead of full-head K/V pools.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dynamo_tpu.models.quant import mm
+from dynamo_tpu.models.toolkit import (
+    _write_kv,
+    attn_score_scale,
+    paged_attention_jnp,
+    rms_norm,
+    rope,
+)
+
+
+def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
+                   kv_lens, attn_impl="jnp", mesh=None, q_start=None,
+                   q_len=None):
+    """Multi-head latent attention (DeepSeek V2/V3/R1), absorbed form.
+
+    Per token the pool caches one [d_c + d_rh] vector: the RMS-normed KV
+    latent c_kv plus the decoupled-RoPE shared key k_R. The W_UK
+    up-projection is absorbed into the query (q_abs = q_nope @ W_UK), so
+    attention runs DIRECTLY over the latent cache — scores are
+    q_abs·c_kv + q_R·k_R, i.e. standard paged attention with Hk=1,
+    G=n_heads, Dh=d_c+d_rh and values = the latent slice of the same
+    pool; W_UV then lifts the attended latent to per-head values. That
+    reuse means every pool mechanism (paging, prefix cache, tiering,
+    disagg export) serves MLA unchanged.
+
+    RoPE uses this module's half-rotation convention; HF DeepSeek
+    checkpoints interleave — engine/weights.py must permute on import.
+    Returns (attn [B, S, H*d_v], k_pool)."""
+    B, S = positions.shape
+    H = c.n_heads
+    dn, dr, dv, dc = (c.qk_nope_head_dim, c.qk_rope_head_dim,
+                      c.v_head_dim, c.kv_lora_rank)
+
+    x = rms_norm(h, lp["attn_norm"], c.norm_eps)
+    if c.q_lora_rank:
+        q_lat = rms_norm(mm(x, lp["wq_lat"]), lp["q_lat_norm"], c.norm_eps)
+        q = mm(q_lat, lp["wq_up"])
+    else:
+        q = mm(x, lp["wq"])
+    q = q.reshape(B, S, H, dn + dr)
+    q_nope, q_r = q[..., :dn], q[..., dn:]
+    q_r = rope(q_r, safe_pos, c.rope_theta, config=c)
+
+    kv = mm(x, lp["wkv_a"])  # [B, S, d_c + d_rh]
+    c_kv = rms_norm(kv[..., :dc], lp["kv_norm"], c.norm_eps)
+    k_r = rope(kv[..., None, dc:], safe_pos, c.rope_theta, config=c)[..., 0, :]
+    lat = jnp.concatenate([c_kv, k_r], axis=-1)[:, :, None, :]  # [B,S,1,D]
+    k_pool = _write_kv(k_pool, l_idx, lat, page_table, positions)
+    lat_pool_l = k_pool[l_idx]
+
+    wkv_b = lp["wkv_b"].reshape(dc, H, dn + dv)
+    w_uk, w_uv = wkv_b[..., :dn], wkv_b[..., dn:]
+    q_abs = jnp.einsum("bshn,chn->bshc", q_nope, w_uk)  # [B,S,H,d_c]
+    scale = attn_score_scale(c, dn + dr)
+    tp = mesh is not None and mesh.shape.get("model", 1) > 1
+    if (attn_impl == "pallas" and S > 1 and not tp
+            and q_start is not None):
+        # chunked-prefill hot path: flash MLA over latent pages (the TP
+        # variant reuses the jnp path until a sharded wrapper lands)
+        from dynamo_tpu.ops.mla_attention import prefill_mla_attention
+
+        qp = jnp.concatenate([q_abs, q_r], axis=-1)  # [B, S, H, Dl]
+        attn_lat = prefill_mla_attention(
+            qp, lat_pool_l, page_table, q_start, q_len, kv_lens,
+            dc=dc, scale=scale,
+        )
+    elif attn_impl == "pallas" and S == 1:
+        # decode hot path: Pallas streams latent pages once — the same
+        # DMA feeds both score (full latent) and value (first d_c cols)
+        from dynamo_tpu.ops.mla_attention import (
+            decode_mla_attention,
+            decode_mla_attention_sharded,
+        )
+
+        qd = jnp.concatenate([q_abs, q_r], axis=-1)[:, 0]  # [B, H, Dl]
+        if tp:
+            attn_lat = decode_mla_attention_sharded(
+                qd, lat_pool_l, page_table, kv_lens, mesh, dc=dc, scale=scale,
+            )[:, None]
+        else:
+            attn_lat = decode_mla_attention(
+                qd, lat_pool_l, page_table, kv_lens, dc=dc, scale=scale,
+            )[:, None]  # [B, 1, H, d_c]
+    else:
+        qg = jnp.concatenate([q_abs, q_r], axis=-1)[:, :, None, :, :]
+        attn_lat = paged_attention_jnp(
+            qg, lat_pool_l, lat_pool_l[..., :dc], page_table, safe_pos,
+            kv_lens, scale=scale,
+        )[:, :, 0]  # [B, S, H, d_c]
+    attn = jnp.einsum("bshc,chv->bshv", attn_lat, w_uv)
+    return attn.reshape(B, S, H * dv), k_pool

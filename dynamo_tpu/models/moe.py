@@ -1,0 +1,76 @@
+"""Mixture-of-experts block: token-choice top-k routing with the wide-EP
+all-to-all dispatch (ops/moe_dispatch.py) on expert meshes, dense
+every-expert fallback elsewhere. Shared experts (DeepSeek/Qwen2-MoE)
+stay out of the dispatch entirely.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import mm
+
+
+def _moe_block(c: ModelConfig, lp, x: jax.Array, mesh=None) -> jax.Array:
+    """Token-choice top-k MoE. With an expert mesh axis (and unquantized
+    experts), tokens dispatch to their experts with one all_to_all over ICI
+    and return with a second (ops/moe_dispatch.py — wide-EP); otherwise the
+    dense path computes every expert under GSPMD expert sharding. x:
+    [B, S, E] → [B, S, E]."""
+    from dynamo_tpu.models.quant import is_quantized
+
+    B, S, E = x.shape
+    # always-active shared experts (DeepSeek / Qwen2-MoE): a plain dense
+    # FFN added to the routed output — never dispatched, so it stays out
+    # of the EP all_to_all entirely
+    shared = 0.0
+    if c.n_shared_experts:
+        gate = jax.nn.silu(mm(x, lp["ws_gate"]))
+        shared = mm(gate * mm(x, lp["ws_up"]), lp["ws_down"])
+        if "ws_gatectl" in lp:  # qwen2-moe: sigmoid-gated shared expert
+            shared = shared * jax.nn.sigmoid(x @ lp["ws_gatectl"])
+    ep = mesh is not None and mesh.shape.get("expert", 1) > 1
+    if ep and not is_quantized(lp["we_gate"]) and (B * S) % mesh.shape["expert"] == 0:
+        from dynamo_tpu.ops.moe_dispatch import moe_ep
+
+        model_axis = "model" if mesh.shape.get("model", 1) > 1 else None
+        cf = c.moe_capacity_factor or (c.n_experts / c.n_experts_active)
+        y = moe_ep(
+            x.reshape(B * S, E),
+            lp["w_router"], lp["we_gate"], lp["we_up"], lp["we_down"],
+            mesh, c.n_experts_active,
+            capacity_factor=cf,
+            model_axis=model_axis,
+            scoring=c.moe_scoring,
+            norm_topk=c.moe_norm_topk,
+            router_bias=lp.get("router_bias"),
+            routed_scale=c.moe_routed_scale,
+            n_groups=c.n_expert_groups,
+            topk_groups=c.topk_groups,
+        )
+        return y.reshape(B, S, E) + shared
+    from dynamo_tpu.ops.moe_dispatch import router_topk
+
+    router_logits = (x @ lp["w_router"]).astype(jnp.float32)  # [B,S,n_exp]
+    weights, sel = router_topk(
+        router_logits, c.n_experts_active, c.moe_scoring, c.moe_norm_topk,
+        bias=lp.get("router_bias"), routed_scale=c.moe_routed_scale,
+        n_groups=c.n_expert_groups, topk_groups=c.topk_groups,
+    )
+    weights = weights.astype(x.dtype)
+
+    # compute every expert on every token (fine at test scale; EP replaces it)
+    def one_expert(we_gate, we_up, we_down):
+        gate = jax.nn.silu(mm(x, we_gate))
+        return mm(gate * mm(x, we_up), we_down)  # [B,S,E]
+
+    expert_out = jax.vmap(one_expert)(lp["we_gate"], lp["we_up"], lp["we_down"])
+    # expert_out: [n_exp, B, S, E]; select & mix
+    sel_out = jnp.take_along_axis(
+        expert_out.transpose(1, 2, 0, 3),  # [B,S,n_exp,E]
+        sel[..., None].astype(jnp.int32),
+        axis=2,
+    )  # [B,S,k,E]
+    return jnp.sum(sel_out * weights[..., None], axis=2) + shared
