@@ -154,6 +154,7 @@ def _string(schema: Dict[str, Any]) -> str:
             pat = pat[1:]
         if pat.endswith("$") and not pat.endswith("\\$"):
             pat = pat[:-1]
+        _check_string_pattern(pat)
         return f'"({pat})"'
     lo = schema.get("minLength")
     hi = schema.get("maxLength")
@@ -162,6 +163,30 @@ def _string(schema: Dict[str, Any]) -> str:
     lo = int(lo or 0)
     rep = f"{{{lo},{int(hi)}}}" if hi is not None else f"{{{lo},}}"
     return f'"{STRING_CHAR}{rep}"'
+
+
+def _check_string_pattern(pat: str) -> None:
+    """The user pattern is embedded verbatim inside '"(pat)"' at the JSON
+    TEXT level, with no escaping translation — so a pattern able to emit
+    a raw '"' would let generation escape the string context entirely,
+    and a bare '\\' or control byte would force output that is not valid
+    JSON. Enforce the restriction exactly: compile the pattern to its
+    byte DFA and reject if any transition accepts an offending byte.
+    (Schema `pattern` semantics apply to the DECODED value; supporting
+    those bytes would need a JSON-escape-transducing compile.)"""
+    from dynamo_tpu.guided.regex_dfa import RegexError, compile_regex
+
+    try:
+        dfa = compile_regex(pat)
+    except RegexError as e:
+        raise SchemaError(f"unsupported string pattern {pat!r}: {e}") from e
+    bad = [0x22, 0x5C] + list(range(0x20))
+    if (dfa.trans[:, bad] >= 0).any():
+        raise SchemaError(
+            f"string pattern {pat!r} can match '\"', '\\' or a control "
+            "character, which cannot be embedded in a JSON string "
+            "constraint without escape translation"
+        )
 
 
 def _array(schema: Dict[str, Any], root: Any, depth: int) -> str:

@@ -47,6 +47,10 @@ class _Parser:
     def __init__(self, pattern: str):
         self.p = pattern
         self.i = 0
+        # per-instance (NOT class-level): a shared set mutated in place
+        # would leak character-class escapes across concurrently compiled
+        # patterns, silently corrupting their DFAs
+        self._cls_extra: set = set()
 
     def peek(self) -> Optional[str]:
         return self.p[self.i] if self.i < len(self.p) else None
@@ -178,8 +182,6 @@ class _Parser:
             self._cls_extra = set()
         s = frozenset(out)
         return _ALL - s if neg else s
-
-    _cls_extra: set = set()
 
     def _class_char(self) -> Optional[int]:
         c = self.next()

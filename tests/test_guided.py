@@ -413,3 +413,20 @@ async def test_engine_guided_bad_spec_errors(guided_engine):
         items.append(item)
     assert items[-1]["finish_reason"] == "error"
     assert "guided" in items[-1]["error"]
+
+
+def test_json_schema_pattern_cannot_break_string_context():
+    """ADVICE r4: a user `pattern` able to emit '"', a bare backslash, or
+    control bytes would break the response_format=json_schema guarantee
+    (a '"' even escapes the string context) — rejected with SchemaError."""
+    import pytest as _pytest
+
+    from dynamo_tpu.guided.json_schema import SchemaError, schema_to_regex
+
+    def compile_pat(pattern):
+        return schema_to_regex({"type": "string", "pattern": pattern})
+
+    assert compile_pat("[a-z]{2,8}")  # benign patterns still compile
+    for evil in ('a"b', "a\\\\b", "[\\x00-\\x7f]+", 'a|"'):
+        with _pytest.raises(SchemaError):
+            compile_pat(evil)
