@@ -229,8 +229,13 @@ class Reconciler:
         api_base: Optional[str] = None,
         token: Optional[str] = None,
         poll_interval: float = 2.0,
+        ca_verify: bool = True,  # False: dev apiservers with self-signed
+        #   serving certs (the real-apiserver test gate); in-cluster runs
+        #   keep verification against the mounted CA bundle
     ):
-        self._client = KubeApiClient(api_base=api_base, token=token)
+        self._client = KubeApiClient(
+            api_base=api_base, token=token, ca_verify=ca_verify
+        )
         self.api_base = self._client.api_base
         self.namespace = namespace
         self.poll_interval = poll_interval

@@ -1,0 +1,312 @@
+"""Operator reconcile against a LIVE kube-apiserver (VERDICT r4 #6).
+
+tests/test_operator.py drives the controllers against an in-process fake;
+this module is the real-apiserver gate, mirroring tests/test_etcd_real.py:
+it launches a genuine `kube-apiserver` backed by a real `etcd`, installs
+the CRDs through the apiextensions API, and exercises the surfaces whose
+quirks a fake cannot reproduce — CRD establishment, generation /
+observedGeneration bookkeeping, the /status and /scale subresources, and
+the watch stream. Skips wherever the binaries are absent; the container
+stage `kube-gate` (container/Dockerfile) provides them repeatably via the
+kubebuilder envtest tarball.
+
+Auth model: static token file + --authorization-mode=AlwaysAllow — real
+API machinery (registration, validation, subresources, watch) without
+cluster RBAC bootstrap; serving certs are the apiserver's self-signed
+dev certs (clients run ca_verify=False).
+"""
+
+import asyncio
+import json
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+pytestmark = [
+    pytest.mark.skipif(
+        shutil.which("kube-apiserver") is None
+        or shutil.which("etcd") is None
+        or shutil.which("openssl") is None,
+        reason="kube-apiserver/etcd/openssl not on PATH",
+    ),
+    pytest.mark.asyncio,
+]
+
+TOKEN = "real-gate-token"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class _Cluster:
+    def __init__(self, base: str, procs):
+        self.base = base
+        self.procs = procs
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    etcd_client = _free_port()
+    etcd_peer = _free_port()
+    api_port = _free_port()
+    procs = []
+    logs = open(tmp_path / "cluster.log", "w")
+
+    procs.append(subprocess.Popen(
+        [shutil.which("etcd"),
+         "--data-dir", str(tmp_path / "etcd"),
+         "--listen-client-urls", f"http://127.0.0.1:{etcd_client}",
+         "--advertise-client-urls", f"http://127.0.0.1:{etcd_client}",
+         "--listen-peer-urls", f"http://127.0.0.1:{etcd_peer}"],
+        stdout=logs, stderr=logs,
+    ))
+
+    sa_key = tmp_path / "sa.key"
+    subprocess.run(
+        ["openssl", "genrsa", "-out", str(sa_key), "2048"],
+        check=True, capture_output=True,
+    )
+    tokens = tmp_path / "tokens.csv"
+    tokens.write_text(f"{TOKEN},admin,admin,system:masters\n")
+
+    procs.append(subprocess.Popen(
+        [shutil.which("kube-apiserver"),
+         "--etcd-servers", f"http://127.0.0.1:{etcd_client}",
+         "--secure-port", str(api_port),
+         "--bind-address", "127.0.0.1",
+         "--cert-dir", str(tmp_path / "certs"),  # self-signed dev certs
+         "--service-account-key-file", str(sa_key),
+         "--service-account-signing-key-file", str(sa_key),
+         "--service-account-issuer", "https://kubernetes.default.svc",
+         "--token-auth-file", str(tokens),
+         "--authorization-mode", "AlwaysAllow",
+         "--disable-admission-plugins", "ServiceAccount",
+         "--service-cluster-ip-range", "10.96.0.0/16"],
+        stdout=logs, stderr=logs,
+    ))
+
+    base = f"https://127.0.0.1:{api_port}"
+    cl = _Cluster(base, procs)
+    try:
+        _wait_healthy(cl)
+        yield cl
+    finally:
+        cl.stop()
+        logs.close()
+
+
+def _wait_healthy(cl: _Cluster, timeout: float = 90.0) -> None:
+    import ssl
+    import urllib.request
+
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        for p in cl.procs:
+            if p.poll() is not None:
+                raise RuntimeError(f"cluster process died rc={p.returncode}")
+        try:
+            req = urllib.request.Request(
+                cl.base + "/healthz",
+                headers={"Authorization": f"Bearer {TOKEN}"},
+            )
+            with urllib.request.urlopen(req, timeout=2, context=ctx) as r:
+                if r.status == 200:
+                    return
+        except Exception as e:
+            last = e
+        time.sleep(1.0)
+    raise TimeoutError(f"apiserver never became healthy: {last}")
+
+
+async def _api(base):
+    from dynamo_tpu.runtime.kube_client import KubeApiClient
+
+    return KubeApiClient(api_base=base, token=TOKEN, ca_verify=False)
+
+
+async def _req(client, method, path, body=None, ok=(200, 201, 409)):
+    http = await client.http()
+    kwargs = {"json": body} if body is not None else {}
+    async with http.request(method, client.api_base + path, **kwargs) as r:
+        data = await r.json()
+        assert r.status in ok, (r.status, json.dumps(data)[:500])
+        return r.status, data
+
+
+async def _install_crds(client) -> None:
+    from dynamo_tpu.operator import crd_manifest, crd_manifest_dgdr
+
+    for m in (crd_manifest(), crd_manifest_dgdr()):
+        await _req(
+            client, "POST",
+            "/apis/apiextensions.k8s.io/v1/customresourcedefinitions", m,
+        )
+        # wait Established — a fake can't model the registration delay
+        name = m["metadata"]["name"]
+        for _ in range(120):
+            _, got = await _req(
+                client, "GET",
+                f"/apis/apiextensions.k8s.io/v1/customresourcedefinitions/{name}",
+            )
+            conds = (got.get("status") or {}).get("conditions") or []
+            if any(c["type"] == "Established" and c["status"] == "True"
+                   for c in conds):
+                break
+            await asyncio.sleep(0.5)
+        else:
+            raise TimeoutError(f"CRD {name} never established")
+    await _req(client, "POST", "/api/v1/namespaces",
+               {"metadata": {"name": "prod"}})
+
+
+def _dgd(name="g1"):
+    return {
+        "apiVersion": "dynamo.tpu/v1",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {"name": name},
+        "spec": {
+            "model": "llama-3.2-3b",
+            "image": "dynamo-tpu:v1",
+            "components": [
+                {"name": "frontend", "type": "frontend", "replicas": 1},
+                {"name": "decode", "type": "decode", "replicas": 2},
+            ],
+        },
+    }
+
+
+async def test_reconcile_against_real_apiserver(cluster):
+    """CRD install → DGD create → reconcile creates real child
+    Deployments/Services → /status subresource carries conditions →
+    planner scales via the DGD spec → observedGeneration tracks the
+    server-assigned generation."""
+    from dynamo_tpu.operator import Reconciler
+    from dynamo_tpu.planner.connector import KubernetesConnector
+
+    client = await _api(cluster.base)
+    rec = Reconciler(namespace="prod", api_base=cluster.base, token=TOKEN,
+                     ca_verify=False)
+    try:
+        await _install_crds(client)
+        await _req(
+            client, "POST",
+            "/apis/dynamo.tpu/v1/namespaces/prod/dynamographdeployments",
+            _dgd(),
+        )
+        await rec.reconcile_all()
+
+        _, deps = await _req(
+            client, "GET", "/apis/apps/v1/namespaces/prod/deployments")
+        names = {d["metadata"]["name"] for d in deps["items"]}
+        assert {"g1-frontend", "g1-decode"} <= names, names
+        _, dec = await _req(
+            client, "GET",
+            "/apis/apps/v1/namespaces/prod/deployments/g1-decode")
+        assert dec["spec"]["replicas"] == 2
+
+        _, svcs = await _req(
+            client, "GET", "/api/v1/namespaces/prod/services")
+        assert "g1-frontend" in {s["metadata"]["name"] for s in svcs["items"]}
+
+        # /status subresource was PATCHed on the real server
+        _, dgd = await _req(
+            client, "GET",
+            "/apis/dynamo.tpu/v1/namespaces/prod/"
+            "dynamographdeployments/g1",
+        )
+        st = dgd.get("status") or {}
+        assert st.get("state") == "pending", st  # no kubelet → pods not ready
+        assert st["components"]["decode"]["replicas"] == 2
+        gen1 = dgd["metadata"]["generation"]
+        assert st["observedGeneration"] == gen1
+
+        # planner scales THROUGH the DGD; the operator propagates
+        conn = KubernetesConnector(namespace="prod", api_base=cluster.base,
+                                   token=TOKEN, ca_verify=False, dgd="g1")
+        try:
+            assert await conn.current_replicas("decode") == 2
+            await conn.scale_to("decode", 5)
+        finally:
+            await conn.close()
+        await rec.reconcile_all()
+        _, dec = await _req(
+            client, "GET",
+            "/apis/apps/v1/namespaces/prod/deployments/g1-decode")
+        assert dec["spec"]["replicas"] == 5
+        _, dgd = await _req(
+            client, "GET",
+            "/apis/dynamo.tpu/v1/namespaces/prod/"
+            "dynamographdeployments/g1",
+        )
+        assert dgd["metadata"]["generation"] > gen1
+        assert dgd["status"]["observedGeneration"] == dgd["metadata"]["generation"]
+    finally:
+        await rec.close()
+        await client.close()
+
+
+async def test_watch_stream_real_apiserver(cluster):
+    """A real watch: ADDED arrives for an existing DGD, MODIFIED for a
+    live spec change — the semantics kube_discovery and the operator rely
+    on, which the fake serves from memory without chunked encoding."""
+    client = await _api(cluster.base)
+    try:
+        await _install_crds(client)
+        await _req(
+            client, "POST",
+            "/apis/dynamo.tpu/v1/namespaces/prod/dynamographdeployments",
+            _dgd("w1"),
+        )
+        http = await client.http()
+        url = (cluster.base + "/apis/dynamo.tpu/v1/namespaces/prod/"
+               "dynamographdeployments?watch=true&timeoutSeconds=30")
+        events = []
+        async with http.get(url) as r:
+            assert r.status == 200
+            it = r.content.__aiter__()
+            line = await asyncio.wait_for(it.__anext__(), timeout=15)
+            events.append(json.loads(line))
+            # live modification while the watch is open
+            _, cur = await _req(
+                client, "GET",
+                "/apis/dynamo.tpu/v1/namespaces/prod/"
+                "dynamographdeployments/w1",
+            )
+            cur["spec"]["components"][1]["replicas"] = 3
+            await _req(
+                client, "PUT",
+                "/apis/dynamo.tpu/v1/namespaces/prod/"
+                "dynamographdeployments/w1",
+                cur,
+            )
+            line = await asyncio.wait_for(it.__anext__(), timeout=15)
+            events.append(json.loads(line))
+        assert events[0]["type"] == "ADDED"
+        assert events[0]["object"]["metadata"]["name"] == "w1"
+        assert events[1]["type"] == "MODIFIED"
+        comps = events[1]["object"]["spec"]["components"]
+        assert comps[1]["replicas"] == 3
+    finally:
+        await client.close()
