@@ -1,0 +1,212 @@
+"""Cross-process host-memory weight staging — the TPU answer to the
+reference's gpu_memory_service (lib/gpu_memory_service/README.md:1-40).
+
+The reference keeps weights resident in a GPU-memory service so a
+restarting worker re-attaches via CUDA IPC handles instead of reloading
+from disk. TPUs expose no cross-process device-memory handles, so the
+TPU-first equivalent stages the HOST copy in POSIX shared memory
+(/dev/shm): the first worker on a host publishes the flattened param
+tree once; every peer — SO_REUSEPORT tier members, DP replicas on the
+same host, crash-restarted workers — attaches zero-copy numpy views and
+device_puts straight out of the mapping. No disk read, no per-process
+host duplicate of a multi-GB tree, and the staging survives the death of
+the process that created it (we detach the segments from Python's
+resource tracker exactly so worker crashes don't tear the cache down).
+
+Layout: two segments per stage name —
+  dynshm_<name>_idx   msgpack index {version, entries: [(path, shape,
+                      dtype, offset, nbytes)], total}
+  dynshm_<name>_data  the concatenated array bytes (64-byte aligned)
+The index is created LAST, so attachers treat its existence as the
+commit mark; concurrent cold boots race on data creation and the losers
+wait for the index.
+
+Pairs with the persistent XLA compilation cache (worker --compilation-
+cache): together a warm restart skips both recompiles and weight I/O.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from multiprocessing import shared_memory
+from typing import Any, Dict, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+log = logging.getLogger("dynamo_tpu.shm_weights")
+
+VERSION = 1
+_ALIGN = 64
+
+
+def _seg_names(name: str) -> Tuple[str, str]:
+    safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+    return f"dynshm_{safe}_idx", f"dynshm_{safe}_data"
+
+
+def _keep_after_exit(shm: shared_memory.SharedMemory) -> None:
+    """Detach the segment from the resource tracker: staging must outlive
+    the creating worker (the whole point — a crashed worker's successor
+    attaches instead of reloading). Cleanup is explicit via unlink()."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # tracker internals shifted — staging still works,
+        pass  # it just dies with the creator on this Python
+
+
+def _flatten(params: Any):
+    import jax
+
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        leaves.append((key, np.asarray(leaf)))
+    return leaves
+
+
+def _unflatten(entries: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for key, arr in entries.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def publish(name: str, params: Any, orphan_grace_s: float = 60.0) -> bool:
+    """Stage `params` (pytree of host arrays) under `name`. Returns True
+    when this process created the stage, False when another process beat
+    us to it (its copy is used). Never raises on a lost race.
+
+    Orphan repair: a publisher killed between creating the data segment
+    and committing the index would otherwise brick the name forever
+    (publish loses the create race, attach never finds an index). On a
+    create collision we wait up to `orphan_grace_s` for the racer's index
+    to appear; if it never does, the segment is an orphan — unlink and
+    retry the create once."""
+    idx_name, data_name = _seg_names(name)
+    leaves = _flatten(params)
+    entries = []
+    off = 0
+    for key, arr in leaves:
+        off = (off + _ALIGN - 1) // _ALIGN * _ALIGN
+        entries.append((key, list(arr.shape), str(arr.dtype), off, arr.nbytes))
+        off += arr.nbytes
+    total = max(off, 1)
+    data = None
+    try:
+        data = shared_memory.SharedMemory(name=data_name, create=True,
+                                          size=total)
+    except FileExistsError:
+        stage = attach(name, wait_s=orphan_grace_s)
+        if stage is not None:
+            stage.close()
+            return False  # healthy racer staged it
+        log.warning(
+            "shm stage %s: data segment with no index after %.0fs — "
+            "repairing an orphaned publish", name, orphan_grace_s,
+        )
+        unlink(name)
+        try:
+            data = shared_memory.SharedMemory(name=data_name, create=True,
+                                              size=total)
+        except FileExistsError:
+            return False  # a racer re-created it concurrently
+    try:
+        _keep_after_exit(data)
+        for (key, arr), (_, _, _, o, nb) in zip(leaves, entries):
+            dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=data.buf,
+                             offset=o)
+            dst[...] = arr
+        blob = msgpack.packb(
+            {"version": VERSION, "total": total, "entries": entries},
+            use_bin_type=True,
+        )
+        idx = shared_memory.SharedMemory(name=idx_name, create=True,
+                                         size=len(blob))
+        _keep_after_exit(idx)
+        idx.buf[: len(blob)] = blob
+        idx.close()
+        log.info("staged %d arrays (%.1f MB) in shm as %s",
+                 len(entries), total / 1e6, name)
+        return True
+    finally:
+        data.close()
+
+
+class Stage:
+    """An attached stage: `params` is a pytree of zero-copy numpy views
+    into shared memory. Keep this object alive as long as the views are
+    in use (it pins the mapping)."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, params: Any,
+                 n_arrays: int, nbytes: int):
+        self._shm = shm
+        self.params = params
+        self.n_arrays = n_arrays
+        self.nbytes = nbytes
+
+    def close(self) -> None:
+        self.params = None
+        self._shm.close()
+
+
+def attach(name: str, wait_s: float = 0.0) -> Optional[Stage]:
+    """Attach to a published stage; None when absent. `wait_s` > 0 polls
+    for a stage a racing publisher is still writing (its index appears
+    only once the data is complete)."""
+    idx_name, data_name = _seg_names(name)
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            idx = shared_memory.SharedMemory(name=idx_name)
+            break
+        except FileNotFoundError:
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.1)
+    try:
+        meta = msgpack.unpackb(bytes(idx.buf), raw=False)
+    finally:
+        idx.close()
+    if meta.get("version") != VERSION:
+        log.warning("shm stage %s has version %s != %s; ignoring",
+                    name, meta.get("version"), VERSION)
+        return None
+    try:
+        data = shared_memory.SharedMemory(name=data_name)
+    except FileNotFoundError:
+        # unlink() raced between our idx open and here — stage is gone,
+        # which contractually means "absent", never an exception
+        return None
+    import ml_dtypes
+
+    entries: Dict[str, np.ndarray] = {}
+    for key, shape, dtype, off, _nb in meta["entries"]:
+        dt = (np.dtype(ml_dtypes.bfloat16) if "bfloat16" in dtype
+              else np.dtype(dtype))
+        arr = np.ndarray(tuple(shape), dtype=dt, buffer=data.buf, offset=off)
+        # the mapping is shared by every co-hosted worker: an in-place
+        # write would corrupt the weights for all of them and for every
+        # future restart — make that an immediate local ValueError
+        arr.flags.writeable = False
+        entries[key] = arr
+    return Stage(data, _unflatten(entries), len(entries), meta["total"])
+
+
+def unlink(name: str) -> None:
+    """Explicitly remove a stage (weight-version invalidation — the RL
+    hot-swap path unlinks before publishing new weights)."""
+    for seg in _seg_names(name):
+        try:
+            shm = shared_memory.SharedMemory(name=seg)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass

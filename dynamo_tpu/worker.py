@@ -23,6 +23,10 @@ from dynamo_tpu.runtime.logging_util import configure_logging
 
 log = logging.getLogger("dynamo_tpu.worker")
 
+# attached shm weight stages pinned for the process lifetime (their numpy
+# views back device_put and snapshot writes; unmapping would invalidate)
+_SHM_STAGES: list = []
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser("dynamo_tpu.worker")
@@ -30,6 +34,11 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint", default=None,
                    help="HF safetensors checkpoint dir (config derived from its config.json)")
     p.add_argument("--model-name", default=None, help="served model name (default: config name)")
+    p.add_argument("--shm-weights", default=None, metavar="NAME",
+                   help="host shared-memory weight staging (gpu_memory_"
+                        "service analog): attach the staged tree if a "
+                        "host peer published it, else load cold and "
+                        "publish for peers/restarts")
     p.add_argument("--orbax-cache", default=None,
                    help="params snapshot dir: load if present, else save "
                         "after build (fast worker restarts — the snapshot-"
@@ -212,30 +221,62 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
     would corrupt one snapshot directory — only the leader writes."""
     import os
 
+    # resolve the model CONFIG first (config.json only — no weights);
+    # every warm tier below validates against it
+    if args.checkpoint:
+        from dynamo_tpu.engine.hub import fetch_model
+        from dynamo_tpu.engine.weights import config_from_hf
+
+        # --checkpoint accepts hub repo ids too (hf://org/name or
+        # org/name); local dirs pass through untouched (hub.rs role)
+        args.checkpoint = fetch_model(args.checkpoint, config_only=True)
+        config = config_from_hf(args.checkpoint, name=args.model_name or args.model)
+    else:
+        config = get_config(args.model)
+
     params = None
-    # warm snapshot short-circuits the expensive HF checkpoint load (only
-    # the config.json is read) — that is the whole point of fast restart
-    snapshot_warm = bool(
+    # warm tier 1 — host-shm staging (gpu_memory_service analog,
+    # engine/shm_weights.py): a peer on this host (or our own previous
+    # incarnation) already holds the tree in /dev/shm — attach zero-copy
+    # views and skip disk entirely. A stale stage for a DIFFERENT model
+    # under the same name is ignored with a warning (unlike the snapshot
+    # mismatch below, the fallback is free: just load cold).
+    shm_stage = None
+    if getattr(args, "shm_weights", None):
+        from dynamo_tpu.engine import shm_weights
+
+        stage = shm_weights.attach(args.shm_weights)
+        if stage is not None:
+            embed = (stage.params or {}).get("embed")
+            if embed is not None and tuple(embed.shape) == (
+                config.vocab_size, config.dim,
+            ):
+                log.info(
+                    "fast restart: attached %d staged arrays (%.1f MB shm) "
+                    "as %r", stage.n_arrays, stage.nbytes / 1e6,
+                    args.shm_weights,
+                )
+                params = stage.params
+                shm_stage = stage
+                # pin the mapping for the life of the process: the views
+                # feed device_put now and any later snapshot write
+                _SHM_STAGES.append(stage)
+            else:
+                log.warning(
+                    "shm stage %r does not match model config %s (embed %s "
+                    "vs %s); ignoring it", args.shm_weights, config.name,
+                    getattr(embed, "shape", None),
+                    (config.vocab_size, config.dim),
+                )
+                stage.close()
+    # warm tier 2 — orbax snapshot: short-circuits the expensive HF
+    # checkpoint load (that is the whole point of fast restart)
+    snapshot_present = bool(
         args.orbax_cache
         and os.path.isdir(args.orbax_cache)
         and os.listdir(args.orbax_cache)
     )
-    if args.checkpoint:
-        from dynamo_tpu.engine.hub import fetch_model
-        from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
-
-        # --checkpoint accepts hub repo ids too (hf://org/name or
-        # org/name); local dirs pass through untouched (hub.rs role). A
-        # warm snapshot restart only needs config.json — never re-pull
-        # multi-GB weights the orbax snapshot already holds
-        args.checkpoint = fetch_model(args.checkpoint, config_only=snapshot_warm)
-        config = config_from_hf(args.checkpoint, name=args.model_name or args.model)
-        if not snapshot_warm:
-            params = load_hf_checkpoint(args.checkpoint, config)
-    else:
-        config = get_config(args.model)
-    save_snapshot = False
-    if snapshot_warm:
+    if params is None and snapshot_present:
         from dynamo_tpu.engine.weights import load_orbax
 
         log.info("fast restart: loading params snapshot %s", args.orbax_cache)
@@ -248,8 +289,26 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
                 f"{(config.vocab_size, config.dim)}); delete the snapshot "
                 "to rebuild it"
             )
-    elif args.orbax_cache and params is not None:
-        save_snapshot = True
+    # cold — HF checkpoint weights
+    if params is None and args.checkpoint:
+        from dynamo_tpu.engine.hub import fetch_model
+        from dynamo_tpu.engine.weights import load_hf_checkpoint
+
+        args.checkpoint = fetch_model(args.checkpoint)  # now the weights
+        params = load_hf_checkpoint(args.checkpoint, config)
+    # re-warm whichever tier is empty: the snapshot is written even when
+    # params came from shm (a host reboot clears /dev/shm; disk must not
+    # depend on which peer happened to boot first), and the shm stage is
+    # published from any cold/snapshot load (losing a publish race to a
+    # peer is fine)
+    save_snapshot = bool(
+        args.orbax_cache and params is not None and not snapshot_present
+    )
+    if (getattr(args, "shm_weights", None) and shm_stage is None
+            and params is not None):
+        from dynamo_tpu.engine import shm_weights
+
+        shm_weights.publish(args.shm_weights, params)
     mesh = MeshConfig(
         data=args.data_parallel,
         model=args.tensor_parallel,

@@ -1,0 +1,185 @@
+"""Host-shm weight staging (engine/shm_weights.py — gpu_memory_service
+analog): zero-copy publish/attach roundtrip, survival of the creating
+process (the restart story), worker build_runner integration, and the
+publish race."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import shm_weights
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _params(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "embed": np.asarray(jax.random.normal(k, (32, 16), jnp_dtype())),
+        "norm_f": np.ones((16,), np.float32),
+        "layers": {
+            "wq": np.arange(2 * 16 * 16, dtype=np.float32).reshape(2, 16, 16),
+        },
+    }
+
+
+def jnp_dtype():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_publish_attach_roundtrip_zero_copy():
+    name = f"t{os.getpid()}a"
+    shm_weights.unlink(name)
+    try:
+        params = _params()
+        assert shm_weights.publish(name, params) is True
+        stage = shm_weights.attach(name)
+        assert stage is not None and stage.n_arrays == 3
+        _tree_equal(params, stage.params)
+        # views, not copies: the arrays do not own their memory
+        assert not stage.params["layers"]["wq"].flags["OWNDATA"]
+        # bf16 dtype survives the msgpack index roundtrip
+        assert str(stage.params["embed"].dtype) == "bfloat16"
+        # second publish loses gracefully
+        assert shm_weights.publish(name, params) is False
+        stage.close()
+    finally:
+        shm_weights.unlink(name)
+
+
+def test_stage_survives_creator_process_exit():
+    """The restart story: a subprocess publishes and EXITS; this process
+    then attaches — the stage must still be there (the segments are
+    detached from the creator's resource tracker)."""
+    name = f"t{os.getpid()}b"
+    shm_weights.unlink(name)
+    code = f"""
+import numpy as np
+from dynamo_tpu.engine import shm_weights
+ok = shm_weights.publish({name!r}, {{"w": np.full((8, 8), 7.0, np.float32)}})
+print("PUBLISHED", ok)
+"""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120,
+        )
+        assert "PUBLISHED True" in out.stdout, out.stdout + out.stderr
+        stage = shm_weights.attach(name)
+        assert stage is not None
+        np.testing.assert_array_equal(
+            stage.params["w"], np.full((8, 8), 7.0, np.float32)
+        )
+        stage.close()
+    finally:
+        shm_weights.unlink(name)
+
+
+def test_worker_build_runner_attaches_stage():
+    """build_runner with --shm-weights: first build publishes the loaded
+    tree; a second build attaches it and produces an identical runner
+    (no reload). Uses an orbax snapshot as the cold source so `params`
+    is non-None."""
+    import tempfile
+
+    from dynamo_tpu.engine.weights import save_orbax
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.worker import build_runner, parse_args
+
+    name = f"t{os.getpid()}c"
+    shm_weights.unlink(name)
+    cfg = get_config("tiny")
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    with tempfile.TemporaryDirectory() as d:
+        snap = os.path.join(d, "snap")
+        save_orbax(params, snap)
+        argv = ["--model", "tiny", "--orbax-cache", snap,
+                "--shm-weights", name, "--num-pages", "16",
+                "--page-size", "4", "--max-seq-len", "32"]
+        try:
+            r1, _ = build_runner(parse_args(argv))
+            stage = shm_weights.attach(name)
+            assert stage is not None, "first build did not publish"
+            stage.close()
+            r2, _ = build_runner(parse_args(argv))
+            _tree_equal(r1.params, r2.params)
+        finally:
+            shm_weights.unlink(name)
+
+
+def test_attach_missing_returns_none():
+    assert shm_weights.attach("definitely-not-there") is None
+
+
+def test_orphan_data_segment_is_repaired():
+    """A publisher killed between data create and index commit must not
+    brick the stage name: the next publish detects the index never
+    appearing and repairs the orphan."""
+    from multiprocessing import shared_memory
+
+    name = f"t{os.getpid()}d"
+    shm_weights.unlink(name)
+    _, data_name = shm_weights._seg_names(name)
+    orphan = shared_memory.SharedMemory(name=data_name, create=True, size=64)
+    shm_weights._keep_after_exit(orphan)
+    orphan.close()
+    try:
+        params = {"w": np.ones((4,), np.float32)}
+        assert shm_weights.publish(name, params, orphan_grace_s=0.5) is True
+        stage = shm_weights.attach(name)
+        assert stage is not None
+        np.testing.assert_array_equal(stage.params["w"], params["w"])
+        stage.close()
+    finally:
+        shm_weights.unlink(name)
+
+
+def test_attached_views_are_read_only():
+    name = f"t{os.getpid()}e"
+    shm_weights.unlink(name)
+    try:
+        shm_weights.publish(name, {"w": np.zeros((4,), np.float32)})
+        stage = shm_weights.attach(name)
+        with pytest.raises(ValueError):
+            stage.params["w"][0] = 1.0  # shared mapping: writes must fail
+        stage.close()
+    finally:
+        shm_weights.unlink(name)
+
+
+def test_worker_ignores_mismatched_stage():
+    """A stale stage for a different model under the same name is ignored
+    with a cold-load fallback, never handed to the runner."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.worker import build_runner, parse_args
+
+    name = f"t{os.getpid()}f"
+    shm_weights.unlink(name)
+    try:
+        wrong = llama.init_params(
+            get_config("tiny").with_(vocab_size=99), jax.random.PRNGKey(0))
+        shm_weights.publish(name, wrong)
+        r, cfg = build_runner(parse_args(
+            ["--model", "tiny", "--shm-weights", name, "--num-pages", "16",
+             "--page-size", "4", "--max-seq-len", "32"]))
+        assert r.params["embed"].shape == (cfg.vocab_size, cfg.dim)
+        assert cfg.vocab_size != 99
+    finally:
+        shm_weights.unlink(name)
