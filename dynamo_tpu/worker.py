@@ -238,19 +238,22 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
     # warm tier 1 — host-shm staging (gpu_memory_service analog,
     # engine/shm_weights.py): a peer on this host (or our own previous
     # incarnation) already holds the tree in /dev/shm — attach zero-copy
-    # views and skip disk entirely. A stale stage for a DIFFERENT model
-    # under the same name is ignored with a warning (unlike the snapshot
-    # mismatch below, the fallback is free: just load cold).
+    # views and skip disk entirely. The stage carries a model-config
+    # fingerprint; a stale stage for a DIFFERENT model under the same
+    # name is ignored (and later REPLACED by our publish — the fallback
+    # is free: just load cold).
     shm_stage = None
+    shm_meta = {
+        "model": config.name, "vocab": config.vocab_size, "dim": config.dim,
+        "n_layers": config.n_layers, "n_heads": config.n_heads,
+        "n_kv_heads": config.n_kv_heads,
+    }
     if getattr(args, "shm_weights", None):
         from dynamo_tpu.engine import shm_weights
 
         stage = shm_weights.attach(args.shm_weights)
         if stage is not None:
-            embed = (stage.params or {}).get("embed")
-            if embed is not None and tuple(embed.shape) == (
-                config.vocab_size, config.dim,
-            ):
+            if stage.meta == shm_meta:
                 log.info(
                     "fast restart: attached %d staged arrays (%.1f MB shm) "
                     "as %r", stage.n_arrays, stage.nbytes / 1e6,
@@ -263,10 +266,10 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
                 _SHM_STAGES.append(stage)
             else:
                 log.warning(
-                    "shm stage %r does not match model config %s (embed %s "
-                    "vs %s); ignoring it", args.shm_weights, config.name,
-                    getattr(embed, "shape", None),
-                    (config.vocab_size, config.dim),
+                    "shm stage %r fingerprint %s does not match model "
+                    "config %s; loading cold (our publish will replace "
+                    "the stale stage)", args.shm_weights, stage.meta,
+                    shm_meta,
                 )
                 stage.close()
     # warm tier 2 — orbax snapshot: short-circuits the expensive HF
@@ -299,8 +302,8 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
     # re-warm whichever tier is empty: the snapshot is written even when
     # params came from shm (a host reboot clears /dev/shm; disk must not
     # depend on which peer happened to boot first), and the shm stage is
-    # published from any cold/snapshot load (losing a publish race to a
-    # peer is fine)
+    # published from any cold/snapshot load (publish replaces atomically,
+    # so a stale other-model stage under our name is repaired here too)
     save_snapshot = bool(
         args.orbax_cache and params is not None and not snapshot_present
     )
@@ -308,7 +311,7 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
             and params is not None):
         from dynamo_tpu.engine import shm_weights
 
-        shm_weights.publish(args.shm_weights, params)
+        shm_weights.publish(args.shm_weights, params, meta=shm_meta)
     mesh = MeshConfig(
         data=args.data_parallel,
         model=args.tensor_parallel,

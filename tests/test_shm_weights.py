@@ -54,8 +54,14 @@ def test_publish_attach_roundtrip_zero_copy():
         assert not stage.params["layers"]["wq"].flags["OWNDATA"]
         # bf16 dtype survives the msgpack index roundtrip
         assert str(stage.params["embed"].dtype) == "bfloat16"
-        # second publish loses gracefully
-        assert shm_weights.publish(name, params) is False
+        # second publish REPLACES atomically (rename commit) while the
+        # old attach keeps its complete mapping
+        p2 = _params(seed=1)
+        assert shm_weights.publish(name, p2) is True
+        stage2 = shm_weights.attach(name)
+        _tree_equal(p2, stage2.params)
+        _tree_equal(params, stage.params)  # old inode still intact
+        stage2.close()
         stage.close()
     finally:
         shm_weights.unlink(name)
@@ -127,21 +133,24 @@ def test_attach_missing_returns_none():
     assert shm_weights.attach("definitely-not-there") is None
 
 
-def test_orphan_data_segment_is_repaired():
-    """A publisher killed between data create and index commit must not
-    brick the stage name: the next publish detects the index never
-    appearing and repairs the orphan."""
-    from multiprocessing import shared_memory
-
+def test_corrupt_segment_treated_as_absent_and_replaced():
+    """Garbage bytes under our segment name (torn hand-copy, old layout
+    version) must read as absent — and the next publish replaces them
+    atomically. Abandoned temp files from dead publishers are collected."""
+    seg = shm_weights._seg_name(f"t{os.getpid()}d")
     name = f"t{os.getpid()}d"
     shm_weights.unlink(name)
-    _, data_name = shm_weights._seg_names(name)
-    orphan = shared_memory.SharedMemory(name=data_name, create=True, size=64)
-    shm_weights._keep_after_exit(orphan)
-    orphan.close()
+    with open(os.path.join(shm_weights.SHM_DIR, seg), "wb") as f:
+        f.write(b"\x00" * 64)  # header says index length 0 -> unparseable
+    # an abandoned temp from a (dead) publisher pid
+    tmp = os.path.join(shm_weights.SHM_DIR, f"{seg}.p999999999")
+    with open(tmp, "wb") as f:
+        f.write(b"junk")
     try:
+        assert shm_weights.attach(name) is None
         params = {"w": np.ones((4,), np.float32)}
-        assert shm_weights.publish(name, params, orphan_grace_s=0.5) is True
+        assert shm_weights.publish(name, params) is True
+        assert not os.path.exists(tmp), "dead publisher temp not collected"
         stage = shm_weights.attach(name)
         assert stage is not None
         np.testing.assert_array_equal(stage.params["w"], params["w"])
@@ -163,9 +172,13 @@ def test_attached_views_are_read_only():
         shm_weights.unlink(name)
 
 
-def test_worker_ignores_mismatched_stage():
-    """A stale stage for a different model under the same name is ignored
-    with a cold-load fallback, never handed to the runner."""
+def test_worker_replaces_mismatched_stage():
+    """A stale stage whose config fingerprint disagrees is ignored (cold
+    load) AND replaced by this worker's publish, so the shm tier heals
+    instead of staying dead under that name."""
+    import tempfile
+
+    from dynamo_tpu.engine.weights import save_orbax
     from dynamo_tpu.models import llama
     from dynamo_tpu.models.config import get_config
     from dynamo_tpu.worker import build_runner, parse_args
@@ -175,11 +188,20 @@ def test_worker_ignores_mismatched_stage():
     try:
         wrong = llama.init_params(
             get_config("tiny").with_(vocab_size=99), jax.random.PRNGKey(0))
-        shm_weights.publish(name, wrong)
-        r, cfg = build_runner(parse_args(
-            ["--model", "tiny", "--shm-weights", name, "--num-pages", "16",
-             "--page-size", "4", "--max-seq-len", "32"]))
-        assert r.params["embed"].shape == (cfg.vocab_size, cfg.dim)
-        assert cfg.vocab_size != 99
+        shm_weights.publish(name, wrong, meta={"model": "other"})
+        cfg = get_config("tiny")
+        good = llama.init_params(cfg, jax.random.PRNGKey(3))
+        with tempfile.TemporaryDirectory() as d:
+            snap = os.path.join(d, "snap")
+            save_orbax(good, snap)
+            r, cfg2 = build_runner(parse_args(
+                ["--model", "tiny", "--orbax-cache", snap, "--shm-weights",
+                 name, "--num-pages", "16", "--page-size", "4",
+                 "--max-seq-len", "32"]))
+        assert r.params["embed"].shape == (cfg2.vocab_size, cfg2.dim)
+        stage = shm_weights.attach(name)  # healed: now holds OUR tree
+        assert stage is not None and stage.meta.get("model") == cfg2.name
+        _tree_equal(good, stage.params)
+        stage.close()
     finally:
         shm_weights.unlink(name)
