@@ -485,16 +485,33 @@ class InferenceEngine:
                 self._run_prefill(plan)
                 kind, n_tok = "prefill", len(plan.chunk)
             elif isinstance(plan, MixedPlan):
-                # decode first: ITL never waits behind prompt processing.
-                # Publish the halves as separate FPM events so observers
-                # fitting per-kind step-time models keep clean samples.
-                self._run_decode(plan.decode)
-                decode_done = True
-                t1 = time.monotonic()
-                self._publish_fpm("decode", t1 - t0, len(plan.decode.seqs))
-                self._run_prefill(plan.prefill)
-                kind, n_tok = "prefill", len(plan.prefill.chunk)
-                t0 = t1
+                if self._mixed_fusible(plan):
+                    chunk_logits = self._run_mixed_dispatch(plan)
+                    # decode tokens are emitted: from here on a failure
+                    # (e.g. in the chunk's sampling extras) must only
+                    # fail the prefill sequence
+                    decode_done = True
+                    self.scheduler.complete_prefill(plan.prefill)
+                    self._finish_prefill(plan.prefill, chunk_logits)
+                    # one dispatch ran both halves — a per-kind wall split
+                    # doesn't exist; observers ignore the mixed kind
+                    kind = "mixed"
+                    n_tok = (len(plan.decode.seqs) * plan.decode.n_steps
+                             + len(plan.prefill.chunk))
+                else:
+                    # decode first: ITL never waits behind prompt
+                    # processing. Publish the halves as separate FPM
+                    # events so observers fitting per-kind step-time
+                    # models keep clean samples.
+                    self._run_decode(plan.decode)
+                    decode_done = True
+                    t1 = time.monotonic()
+                    self._publish_fpm(
+                        "decode", t1 - t0, len(plan.decode.seqs)
+                    )
+                    self._run_prefill(plan.prefill)
+                    kind, n_tok = "prefill", len(plan.prefill.chunk)
+                    t0 = t1
             else:
                 self._run_decode(plan)
                 kind, n_tok = "decode", len(plan.seqs)
@@ -905,6 +922,14 @@ class InferenceEngine:
                 mm=mm_chunk,
             )
         self.scheduler.complete_prefill(plan)
+        self._finish_prefill(plan, logits)
+
+    def _finish_prefill(self, plan: PrefillPlan, logits) -> None:
+        """Post-chunk bookkeeping shared by the standalone and fused mixed
+        dispatch paths: sample the first token on the LAST chunk (guided
+        mask / logprobs / penalties variants), then park (disagg) or start
+        the sequence RUNNING."""
+        seq = plan.seq
         if not plan.is_last_chunk:
             return
         first_lp = None
@@ -958,6 +983,67 @@ class InferenceEngine:
             seq, [token] if emitted is not None else [], reason,
             logprobs=lp_entries,
         )
+
+    def _mixed_fusible(self, plan: MixedPlan) -> bool:
+        """Whether this MixedPlan can run as ONE dispatch (runner
+        decode_multi_with_prefill). Feature planes the fused program
+        doesn't carry fall back to the two-dispatch path."""
+        runner = self.runner
+        if (not hasattr(runner, "decode_multi_with_prefill")
+                or getattr(runner, "has_draft", False)
+                or getattr(runner, "pp", False)
+                or getattr(runner, "sp_enabled", False)):
+            # SP runners prefill with ring attention on the full mesh —
+            # the fused program's plain attn_impl would miscompute the
+            # chunk's KV there
+            return False
+        seqs = plan.decode.seqs
+        if any(s.guided_m is not None for s in seqs):
+            return False  # per-step masks need the T=1 masked path
+        if _batch_logprobs(seqs) >= 0 or _batch_penalties(seqs):
+            return False
+        pplan = plan.prefill
+        if self._mm_chunk(pplan.seq, pplan.start_pos, len(pplan.chunk)) is not None:
+            return False  # multimodal chunks ride the standalone prefill
+        return True
+
+    def _run_mixed_dispatch(self, plan: MixedPlan):
+        """The fused dispatch + decode-half bookkeeping: the decode
+        batch's fused steps and the bounded prefill chunk share a single
+        jitted program — one host sync per iteration instead of two (each
+        dispatch is a full RTT through a relay-attached chip). Returns
+        the chunk's last-token logits; the caller finishes the prefill
+        half separately so a failure THERE only fails the prefill
+        sequence (the decode tokens are already emitted)."""
+        seqs = plan.decode.seqs
+        pplan = plan.prefill
+        T = plan.decode.n_steps
+        with annotate("engine.mixed", batch=len(seqs), steps=T,
+                      chunk=len(pplan.chunk)):
+            tokens = [s.tokens[-1] for s in seqs]
+            positions = [s.computed_len for s in seqs]
+            tables = [s.pages for s in seqs]
+            step0 = self._step_counter + 1
+            self._step_counter += T
+            sampled, chunk_logits = self.runner.decode_multi_with_prefill(
+                T, tokens, positions, tables, _sampling_params(seqs), step0,
+                pplan.chunk, pplan.start_pos, pplan.seq.pages,
+                pplan.start_pos,
+                adapters=[s.adapter_idx for s in seqs],
+                chunk_adapter=pplan.seq.adapter_idx,
+            )
+            for i, seq in enumerate(seqs):
+                emit: List[int] = []
+                reason = None
+                for j in range(T):
+                    token = int(sampled[i, j])
+                    reason = self.scheduler.complete_decode(seq, token)
+                    if reason != "stop":
+                        emit.append(token)
+                    if reason:
+                        break
+                self._emit(seq, emit, reason)
+        return chunk_logits
 
     def _run_decode(self, plan: DecodePlan) -> None:
         with annotate("engine.decode", batch=len(plan.seqs),

@@ -138,6 +138,45 @@ def _decode_loop(
     return toks.T, last, lp, k_pool, v_pool  # [B, n_steps], [B]
 
 
+def _mixed_loop(
+    config: ModelConfig,
+    attn_impl: str,
+    mesh,
+    n_steps: int,
+    params,
+    ptok,  # [1, S] prefill chunk tokens (bucket-padded)
+    ppos,  # [1, S] positions (-1 padding)
+    ppt,  # [1, MP] chunk page table
+    pkvl,  # [1] chunk kv len
+    plast,  # scalar: last valid chunk index (logits computed there only)
+    padapter,  # [1] LoRA slot for the chunk's sequence (None w/o LoRA)
+    tokens0,
+    packed,
+    k_pool,
+    v_pool,
+    sampling: SamplingParams,
+    lora=None,
+):
+    """One fused engine iteration under mixed scheduling: the bounded
+    prefill chunk AND the n_steps decode loop in a single jit — ONE host
+    sync per iteration instead of two. Through a relay-attached chip each
+    dispatch costs a full RTT (~3.7 ms measured, docs/PERF.md), so the
+    unfused MixedPlan pays that twice per iteration; local-PCIe chips
+    still save a program launch. The chunk belongs to a different
+    sequence (disjoint pages) than the decode batch, so ordering inside
+    the program is free for XLA to choose. Returns (toks [B, n_steps],
+    last [B], chunk_logits [V], k_pool, v_pool)."""
+    logits, k_pool, v_pool = llama.forward(
+        config, params, ptok, ppos, k_pool, v_pool, ppt, pkvl, plast,
+        attn_impl=attn_impl, mesh=mesh, lora=lora, adapter_idx=padapter,
+    )
+    toks, last, _, k_pool, v_pool = _decode_loop(
+        config, attn_impl, mesh, n_steps, -1, params, tokens0, packed,
+        None, None, k_pool, v_pool, sampling, lora,
+    )
+    return toks, last, logits[0, 0], k_pool, v_pool
+
+
 # Wire layout version for P→D / cross-worker KV payloads. v2 = token-major
 # [L, n, PS, Hk, D]; v1 (implicit, no field) was head-major. Mirrors the
 # disk tier's BLOCK_LAYOUT_VERSION: in a mixed-version cluster (rolling
@@ -450,6 +489,13 @@ class ModelRunner:
                 static_argnums=(0,),  # n_steps
                 donate_argnums=(5, 6),  # k_pool, v_pool
             )
+        if not self.pp:
+            self._jit_mixed = jax.jit(
+                partial(_mixed_loop, self.config, self.attn_impl,
+                        self._fwd_mesh),
+                static_argnums=(0,),  # n_steps
+                donate_argnums=(10, 11),  # k_pool, v_pool
+            )
         # device-resident sampling cache: batches re-send identical sampling
         # params every dispatch; transferring them each time costs one relay
         # round trip PER ARRAY (see _decode_loop)
@@ -704,6 +750,58 @@ class ModelRunner:
         if n_logprobs >= 0:
             return toks, last, lp
         return toks, last
+
+    def decode_multi_with_prefill(
+        self,
+        n_steps: int,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        sampling,
+        step: int,
+        chunk_tokens: List[int],
+        chunk_start: int,
+        chunk_table: List[int],
+        chunk_prior: int,
+        adapters: Optional[List[int]] = None,
+        chunk_adapter: int = 0,
+    ) -> Tuple[np.ndarray, jax.Array]:
+        """Fused mixed iteration (_mixed_loop): the decode batch's fused
+        n_steps AND one bounded prefill chunk in a single dispatch.
+        Returns (sampled [B_bucket, n_steps] host, chunk last-token
+        logits [V] device). The engine falls back to the two-dispatch
+        path for feature planes this doesn't carry (logprobs/penalties/
+        guided masks/spec decode/multimodal chunks/PP meshes)."""
+        if self.pp:
+            raise NotImplementedError("fused mixed step has no PP path")
+        ptok, ppos, ppt, pkvl, n = self._prep_prefill(
+            chunk_tokens, chunk_start, chunk_table, chunk_prior
+        )
+        B = _next_bucket(self.decode_buckets, len(positions))
+        pt = self._pad_page_table(page_tables, B)
+        MP = pt.shape[1]
+        packed = np.zeros(
+            B * (1 + MP) + (B if self.lora is not None else 0) + 1, np.int32
+        )
+        packed[:B] = -1
+        packed[: len(positions)] = positions
+        packed[B : B + B * MP] = pt.ravel()
+        if self.lora is not None and adapters:
+            packed[B + B * MP : B + B * MP + len(adapters)] = adapters
+        packed[-1] = step
+        tok_h = np.zeros(B, np.int32)
+        tok_h[: len(positions)] = tokens
+        padapter = (
+            jnp.asarray([chunk_adapter], jnp.int32)
+            if self.lora is not None else None
+        )
+        toks, _, chunk_logits, self.k_pool, self.v_pool = self._jit_mixed(
+            n_steps, self.params, ptok, ppos, ppt, pkvl, jnp.int32(n - 1),
+            padapter, jnp.asarray(tok_h), jnp.asarray(packed),
+            self.k_pool, self.v_pool, self._device_sampling(sampling, B),
+            self.lora,
+        )
+        return np.asarray(jax.device_get(toks)), chunk_logits
 
     def _device_sampling(self, sampling, B: int) -> SamplingParams:
         """Device-resident cache of padded sampling params. Batches resend

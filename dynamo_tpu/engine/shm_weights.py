@@ -131,6 +131,17 @@ def publish(name: str, params: Any, meta: Optional[Dict[str, Any]] = None) -> bo
     if not available():
         log.warning("%s missing: shm weight staging disabled", SHM_DIR)
         return False
+    # N co-hosted workers cold-booting concurrently would otherwise each
+    # write a full temp copy into tmpfs (transient N x multi-GB): when a
+    # fingerprinted stage equal to ours already exists, staging is done —
+    # skip the copy entirely
+    if meta:
+        existing = attach(name)
+        if existing is not None:
+            same = existing.meta == meta
+            existing.close()
+            if same:
+                return False
     seg = _seg_name(name)
     _gc_temp_segments(seg)
     leaves = _flatten(params)
@@ -142,10 +153,10 @@ def publish(name: str, params: Any, meta: Optional[Dict[str, Any]] = None) -> bo
         use_bin_type=True,
     )
     # data starts after header+index, aligned; offsets are absolute.
-    # (index size is stable under offset/total rewrites: msgpack ints up
-    # to 2**64-1 re-pack into <= the 9 bytes reserved by packing the
-    # final layout twice below.)
-    base = (_HDR.size + len(blob_guess) + 9 * (2 * len(entries) + 1)
+    # The guess packed every offset and total as 0 (1 msgpack byte);
+    # the real values re-pack into at most 9 bytes each — reserve that
+    # growth for ONE offset per leaf plus the total field.
+    base = (_HDR.size + len(blob_guess) + 9 * (len(leaves) + 1)
             + _ALIGN - 1) // _ALIGN * _ALIGN
     off = base
     for key, arr in leaves:
@@ -222,6 +233,11 @@ def attach(name: str, wait_s: float = 0.0) -> Optional[Stage]:
     while True:
         try:
             shm = shared_memory.SharedMemory(name=seg)
+            # CPython < 3.13 registers ATTACH-side handles with the
+            # resource tracker too, which unlinks "leaked" segments at
+            # interpreter exit — i.e. the first attacher to exit would
+            # destroy the stage for every other worker. Detach it.
+            _keep_after_exit(shm)
             break
         except FileNotFoundError:
             if time.monotonic() >= deadline:

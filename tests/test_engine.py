@@ -326,3 +326,62 @@ async def test_engine_stale_layout_kv_import_recomputes(tiny_engine):
         m.scheduled_tokens for m in tiny_engine.fpm_history if m.kind == "prefill"
     )
     assert after > before, "fallback must prefill locally, not adopt stale KV"
+
+
+async def test_fused_mixed_dispatch_matches_sequential():
+    """Concurrent requests drive MixedPlan through the FUSED single-
+    dispatch path (runner.decode_multi_with_prefill); greedy outputs must
+    be identical to each prompt served alone (scheduling must never
+    change results), and the fused path must actually engage."""
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.runtime.context import Context
+
+    def mk():
+        return ModelRunner(
+            get_config("tiny"), num_pages=96, page_size=4,
+            max_pages_per_seq=16, decode_buckets=(1, 2, 4),
+            prefill_buckets=(8, 16), seed=7,
+        )
+
+    prompts = [[4, 2, 4, 2, 7, 5], [9, 8, 7, 1], [1, 2, 3, 4, 5, 6, 7, 8, 9]]
+
+    async def serve(runner, concurrent):
+        engine = InferenceEngine(runner, max_batch=4, chunk_size=8,
+                                 mixed_prefill_tokens=8)
+        engine.start()
+        fused_calls = 0
+        orig = runner.decode_multi_with_prefill
+
+        def counting(*a, **k):
+            nonlocal fused_calls
+            fused_calls += 1
+            return orig(*a, **k)
+
+        runner.decode_multi_with_prefill = counting
+        try:
+            async def one(p):
+                toks = []
+                async for item in engine.generate(
+                    {"token_ids": p, "sampling": {"temperature": 0.0},
+                     "stop": {"max_tokens": 6, "stop_ids": []}}, Context(),
+                ):
+                    assert item.get("finish_reason") != "error", item
+                    toks.extend(item["token_ids"])
+                    if item["finish_reason"]:
+                        break
+                return toks
+
+            if concurrent:
+                out = await asyncio.gather(*[one(p) for p in prompts])
+            else:
+                out = [await one(p) for p in prompts]
+            return out, fused_calls
+        finally:
+            engine.stop()
+
+    seq_out, _ = await serve(mk(), concurrent=False)
+    conc_out, fused_calls = await serve(mk(), concurrent=True)
+    assert seq_out == conc_out, (seq_out, conc_out)
+    assert fused_calls > 0, "concurrent load never engaged the fused path"

@@ -205,3 +205,31 @@ def test_worker_replaces_mismatched_stage():
         stage.close()
     finally:
         shm_weights.unlink(name)
+
+
+def test_stage_survives_attacher_process_exit():
+    """CPython < 3.13 registers ATTACH-side SharedMemory handles with the
+    resource tracker, which unlinks 'leaked' segments at interpreter exit
+    — without the detach in attach(), the first attacher to exit would
+    destroy the stage for every other worker on the host."""
+    name = f"t{os.getpid()}g"
+    shm_weights.unlink(name)
+    try:
+        shm_weights.publish(name, {"w": np.ones((8,), np.float32)})
+        code = (
+            "from dynamo_tpu.engine import shm_weights\n"
+            f"st = shm_weights.attach({name!r})\n"
+            "assert st is not None\n"
+            "print('ATTACHED')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120,
+        )
+        assert "ATTACHED" in out.stdout, out.stdout + out.stderr
+        st = shm_weights.attach(name)
+        assert st is not None, "stage destroyed by an exiting attacher"
+        st.close()
+    finally:
+        shm_weights.unlink(name)
