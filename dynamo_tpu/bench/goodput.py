@@ -48,6 +48,8 @@ class Stack:
     workers: List[Any]
     watcher: Any
     entry: Any  # ModelEntry: .chain is the frontend pipeline
+    broker: Any = None  # MiniNatsServer when --request-plane nats booted one
+    nats_env_prev: Any = False  # False = untouched; None/str = prior value
 
     async def generate(self, request, context):
         async for item in self.entry.chain.generate(request, context):
@@ -66,6 +68,17 @@ class Stack:
                 await rt.shutdown(drain_timeout=2)
             except Exception:
                 pass
+        if self.broker is not None:
+            await self.broker.stop()
+        if self.nats_env_prev is not False:
+            import os as _os
+
+            # restore DYN_NATS_URL: leaving it pointing at the dead
+            # in-process broker would break the next boot in this process
+            if self.nats_env_prev is None:
+                _os.environ.pop("DYN_NATS_URL", None)
+            else:
+                _os.environ["DYN_NATS_URL"] = self.nats_env_prev
 
 
 def _make_engine(args, mocker: bool):
@@ -117,10 +130,51 @@ async def boot_stack(args, mocker: bool = False, disagg: bool = False) -> Stack:
         kv_block_size=args.page_size,
     )
     worker_runtimes, workers = [], []
+    # --request-plane nats: RPC rides broker subjects; boot an in-process
+    # broker when none is configured, so the SLO bench measures the NATS
+    # plane standalone (addresses are self-describing — the frontend
+    # needs no flag)
+    plane = getattr(args, "request_plane", None) or "tcp"
+    broker = None
+    nats_env_prev: Any = False
+    import os as _os
+
+    if plane == "nats" and not _os.environ.get("DYN_NATS_URL"):
+        from dynamo_tpu.runtime.nats_plane import MiniNatsServer
+
+        broker = MiniNatsServer()
+        nats_env_prev = _os.environ.get("DYN_NATS_URL")
+        _os.environ["DYN_NATS_URL"] = await broker.start()
+
+    try:
+        return await _boot_rest(
+            args, mocker, disagg, plane, realm, card, worker_runtimes,
+            workers, broker, nats_env_prev,
+        )
+    except BaseException:
+        # a failed boot must not leak the in-process broker or leave
+        # DYN_NATS_URL pointing at it — a retry would dial a dead port
+        if broker is not None:
+            await broker.stop()
+        if nats_env_prev is not False:
+            if nats_env_prev is None:
+                _os.environ.pop("DYN_NATS_URL", None)
+            else:
+                _os.environ["DYN_NATS_URL"] = nats_env_prev
+        raise
+
+
+async def _boot_rest(args, mocker, disagg, plane, realm, card,
+                     worker_runtimes, workers, broker, nats_env_prev) -> Stack:
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker_common import serve_worker
 
     async def add_worker(role: Optional[str], component: str):
         rt = DistributedRuntime(
-            discovery=MemDiscovery(realm=realm), event_transport="inproc"
+            discovery=MemDiscovery(realm=realm), event_transport="inproc",
+            request_plane=plane,
         )
         engine = _make_engine(args, mocker)
         w = await serve_worker(
@@ -169,7 +223,8 @@ async def boot_stack(args, mocker: bool = False, disagg: bool = False) -> Stack:
             f"stack not routable: {len(entry.instance_ids)}/{args.workers} "
             f"workers (+{len(entry.prefill_instance_ids)} prefill)"
         )
-    return Stack(frt, worker_runtimes, workers, watcher, entry)
+    return Stack(frt, worker_runtimes, workers, watcher, entry,
+                 broker=broker, nats_env_prev=nats_env_prev)
 
 
 async def run_goodput(args) -> GoodputReport:
@@ -244,6 +299,9 @@ def parse_args(argv=None):
     p.add_argument("--disagg", action="store_true")
     p.add_argument("--workers", type=int, default=1)
     p.add_argument("--prefill-workers", type=int, default=1)
+    p.add_argument("--request-plane", default=None, choices=[None, "tcp", "nats"],
+                   help="worker RPC transport (nats boots an in-process "
+                        "broker when DYN_NATS_URL is unset)")
     p.add_argument("--router-mode", default="kv",
                    choices=["round_robin", "random", "kv"])
     p.add_argument("--disagg-min-prefill-tokens", type=int, default=256)

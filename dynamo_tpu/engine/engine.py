@@ -78,6 +78,26 @@ class InferenceEngine:
         #   token masks against THIS tokenizer (must match the frontend's)
     ):
         self.runner = runner
+        # fused mixed dispatch (one program per iteration instead of two):
+        # the win is the per-dispatch RTT, which matters on accelerators
+        # (relay-attached chips pay ~3.7 ms each) — but the fused program
+        # adds one compile unit per (decode bucket x prefill bucket)
+        # combination, which on cold CPU test rigs inflates first-request
+        # TTFT for no latency benefit. Default: fuse on accelerators,
+        # not on cpu; DYN_FUSED_MIXED=0/1 overrides for A/Bs.
+        import os as _os
+
+        _fuse_env = _os.environ.get("DYN_FUSED_MIXED", "").lower()
+        if _fuse_env in ("1", "true", "on", "yes"):
+            self.fused_mixed = True
+        elif _fuse_env in ("0", "false", "off", "no"):
+            self.fused_mixed = False
+        else:
+            try:
+                platform = runner.mesh.devices.flat[0].platform
+            except AttributeError:  # SimRunner (no mesh, no fused method)
+                platform = "cpu"
+            self.fused_mixed = platform != "cpu"
         # cross-worker KVBM onboarding: worker_common injects an async
         # callable(hint) -> payload that pulls blocks from a peer's
         # kv_host_fetch endpoint (None = feature off)
@@ -989,7 +1009,8 @@ class InferenceEngine:
         decode_multi_with_prefill). Feature planes the fused program
         doesn't carry fall back to the two-dispatch path."""
         runner = self.runner
-        if (not hasattr(runner, "decode_multi_with_prefill")
+        if (not self.fused_mixed
+                or not hasattr(runner, "decode_multi_with_prefill")
                 or getattr(runner, "has_draft", False)
                 or getattr(runner, "pp", False)
                 or getattr(runner, "sp_enabled", False)):

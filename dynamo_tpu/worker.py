@@ -370,6 +370,58 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
         obj_kv_root=args.obj_kv_root,
         tokenizer_spec=args.tokenizer,
     )
+    if getattr(args, "shm_weights", None) or args.orbax_cache:
+        # RL weight hot-swap: after update_weights the WARM TIERS hold a
+        # superseded policy — a crash-restart would attach the stale shm
+        # stage, or (shm gone) reload the old orbax snapshot from disk
+        # and republish THAT, serving the old policy next to refreshed
+        # peers. On every swap: drop the shm stage and refresh the orbax
+        # cache from the new snapshot (atomic dir swap), so the restart
+        # invariant holds: the warm tiers always contain the weights
+        # being served. (Without --orbax-cache a restart falls back to
+        # the ORIGINAL checkpoint — choose warm tiers accordingly for RL
+        # workers.)
+        _inner_update = engine.update_weights
+        _stage_name = getattr(args, "shm_weights", None)
+        _cache_dir = args.orbax_cache
+
+        def _refresh_snapshot(src: str) -> None:
+            import os
+            import shutil as _sh
+            import tempfile as _tf
+
+            if os.path.realpath(src) == os.path.realpath(_cache_dir):
+                return
+            parent = os.path.dirname(os.path.abspath(_cache_dir)) or "."
+            tmp = _tf.mkdtemp(prefix=".orbax_swap_", dir=parent)
+            new = os.path.join(tmp, "new")
+            _sh.copytree(src, new)
+            old = os.path.join(tmp, "old")
+            if os.path.exists(_cache_dir):
+                os.rename(_cache_dir, old)
+            os.rename(new, _cache_dir)
+            _sh.rmtree(tmp, ignore_errors=True)
+
+        async def _update_and_invalidate(path: str) -> int:
+            import asyncio as _aio
+
+            version = await _inner_update(path)
+            if _stage_name:
+                from dynamo_tpu.engine import shm_weights as _shm
+
+                _shm.unlink(_stage_name)
+            if _cache_dir:
+                try:
+                    await _aio.to_thread(_refresh_snapshot, path)
+                except Exception:
+                    log.exception(
+                        "orbax cache refresh from %s failed — a restart "
+                        "would reload the superseded snapshot", path,
+                    )
+            log.info("warm tiers refreshed after weight update v%d", version)
+            return version
+
+        engine.update_weights = _update_and_invalidate
     vision = None
     if args.vision:
         from dynamo_tpu.models.vision import TINY_VISION, VisionConfig

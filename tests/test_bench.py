@@ -133,3 +133,21 @@ async def test_goodput_mocker_plane_ceiling():
     assert rep.throughput_tok_s > 0
     # SLO accounting distinguishes goodput from raw throughput
     assert rep.goodput_tok_s <= rep.throughput_tok_s + 1e-9
+
+
+async def test_goodput_mocker_over_nats_plane_twice():
+    """--request-plane nats boots an in-process broker, measures the SLO
+    shape through broker subjects, and restores DYN_NATS_URL on close —
+    a SECOND boot in the same process must get a fresh broker instead of
+    dialing the first one's dead port."""
+    import os
+
+    from dynamo_tpu.bench.goodput import parse_args, run_goodput
+
+    argv = ["--mocker", "--request-plane", "nats", "--isl", "32",
+            "--osl", "8", "--n-requests", "6", "--rps", "8",
+            "--workers", "1"]
+    for _ in range(2):
+        report = await run_goodput(parse_args(argv))
+        assert report.n_ok == 6, report
+        assert "DYN_NATS_URL" not in os.environ

@@ -328,11 +328,13 @@ async def test_engine_stale_layout_kv_import_recomputes(tiny_engine):
     assert after > before, "fallback must prefill locally, not adopt stale KV"
 
 
-async def test_fused_mixed_dispatch_matches_sequential():
+async def test_fused_mixed_dispatch_matches_sequential(monkeypatch):
     """Concurrent requests drive MixedPlan through the FUSED single-
     dispatch path (runner.decode_multi_with_prefill); greedy outputs must
     be identical to each prompt served alone (scheduling must never
-    change results), and the fused path must actually engage."""
+    change results), and the fused path must actually engage. (Fusion
+    defaults off on cpu — forced on here.)"""
+    monkeypatch.setenv("DYN_FUSED_MIXED", "1")
     from dynamo_tpu.engine.engine import InferenceEngine
     from dynamo_tpu.engine.model_runner import ModelRunner
     from dynamo_tpu.models.config import get_config

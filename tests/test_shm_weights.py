@@ -233,3 +233,40 @@ def test_stage_survives_attacher_process_exit():
         st.close()
     finally:
         shm_weights.unlink(name)
+
+
+async def test_rl_weight_update_invalidates_stage(tmp_path):
+    """After an RL weight hot-swap the staged tree holds a superseded
+    policy — build_engine's wrapper must drop the stage so crash-restarts
+    never attach stale weights next to refreshed peers."""
+    from dynamo_tpu.engine.weights import save_orbax
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.worker import build_engine, parse_args
+
+    name = f"t{os.getpid()}h"
+    shm_weights.unlink(name)
+    cfg = get_config("tiny")
+    snap0 = str(tmp_path / "v0")
+    snap1 = str(tmp_path / "v1")
+    save_orbax(llama.init_params(cfg, jax.random.PRNGKey(0)), snap0)
+    save_orbax(llama.init_params(cfg, jax.random.PRNGKey(1)), snap1)
+    args = parse_args(
+        ["--model", "tiny", "--orbax-cache", snap0, "--shm-weights", name,
+         "--num-pages", "16", "--page-size", "4", "--max-seq-len", "32"])
+    engine, _ = build_engine(args)
+    try:
+        assert shm_weights.attach(name) is not None  # boot published
+        await engine.update_weights(snap1)
+        assert shm_weights.attach(name) is None, "stale stage survived swap"
+        # the on-disk warm tier must also hold the NEW policy: a restart
+        # reloading the superseded snapshot would re-publish stale weights
+        from dynamo_tpu.engine.weights import load_orbax
+
+        refreshed = load_orbax(snap0)
+        new = load_orbax(snap1)
+        np.testing.assert_array_equal(
+            np.asarray(refreshed["embed"]), np.asarray(new["embed"]))
+    finally:
+        engine.stop()
+        shm_weights.unlink(name)
