@@ -328,38 +328,47 @@ def forward(
             c.attn_logit_softcap > 0 or c.sliding_window > 0
             or c.query_pre_attn_scalar > 0
         )
-        if gemma_attn:
-            # softcap / sliding-window / scalar-scaled attention: jnp path
-            # (the Pallas kernels don't carry these yet). window_l rides
-            # the scan: Gemma-2 alternates sliding (even) / global (odd).
-            win = None
-            if c.sliding_window > 0:
-                win = jnp.where(
-                    l_idx % 2 == 0, jnp.int32(c.sliding_window), jnp.int32(0)
-                )
-            attn = paged_attention_jnp(
-                qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens,
-                scale=(
-                    c.query_pre_attn_scalar ** -0.5
-                    if c.query_pre_attn_scalar > 0 else None
-                ),
-                softcap=c.attn_logit_softcap,
-                window=win,
+        # Gemma-family extras (softcap / sliding-window / scalar scale)
+        # collapse to the kernel/jnp defaults for every other config, so
+        # ONE decode dispatch covers all families. window_l rides the
+        # scan: Gemma-2 alternates sliding (even) / global (odd) — the
+        # kernel takes it as a scalar-prefetch operand so the alternation
+        # stays one compiled body.
+        win = None
+        if gemma_attn and c.sliding_window > 0:
+            win = jnp.where(
+                l_idx % 2 == 0, jnp.int32(c.sliding_window), jnp.int32(0)
             )
-        elif attn_impl == "pallas" and S == 1:
+        g_scale = (
+            c.query_pre_attn_scalar ** -0.5
+            if c.query_pre_attn_scalar > 0 else None
+        )
+        if attn_impl == "pallas" and S == 1:
             from dynamo_tpu.ops.paged_attention import (
                 decode_paged_attention,
                 decode_paged_attention_sharded,
             )
 
+            kwg = dict(scale=g_scale, softcap=c.attn_logit_softcap)
             if tp:
                 attn = decode_paged_attention_sharded(
-                    qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens, mesh
+                    qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens,
+                    mesh, window=win, **kwg,
                 )[:, None]
             else:
                 attn = decode_paged_attention(
-                    qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens
+                    qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens,
+                    win, **kwg,
                 )[:, None]  # [B, 1, Hk, G, hd]
+        elif gemma_attn:
+            # gemma prefill (and non-pallas runs): jnp path — once per
+            # chunk, not the steady-state cost
+            attn = paged_attention_jnp(
+                qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens,
+                scale=g_scale,
+                softcap=c.attn_logit_softcap,
+                window=win,
+            )
         elif attn_impl == "pallas":
             from dynamo_tpu.ops.flash_prefill import (
                 prefill_paged_attention,

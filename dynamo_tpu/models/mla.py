@@ -62,17 +62,26 @@ def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
     q_abs = jnp.einsum("bshn,chn->bshc", q_nope, w_uk)  # [B,S,H,d_c]
     scale = attn_score_scale(c, dn + dr)
     tp = mesh is not None and mesh.shape.get("model", 1) > 1
-    if (attn_impl == "pallas" and S > 1 and not tp
-            and q_start is not None):
-        # chunked-prefill hot path: flash MLA over latent pages (the TP
-        # variant reuses the jnp path until a sharded wrapper lands)
-        from dynamo_tpu.ops.mla_attention import prefill_mla_attention
+    if attn_impl == "pallas" and S > 1 and q_start is not None:
+        # chunked-prefill hot path: flash MLA over latent pages; on TP
+        # meshes the kernel runs per-head-shard under shard_map against
+        # the replicated latent pool (zero collectives)
+        from dynamo_tpu.ops.mla_attention import (
+            prefill_mla_attention,
+            prefill_mla_attention_sharded,
+        )
 
         qp = jnp.concatenate([q_abs, q_r], axis=-1)  # [B, S, H, Dl]
-        attn_lat = prefill_mla_attention(
-            qp, lat_pool_l, page_table, q_start, q_len, kv_lens,
-            dc=dc, scale=scale,
-        )
+        if tp:
+            attn_lat = prefill_mla_attention_sharded(
+                qp, lat_pool_l, page_table, q_start, q_len, kv_lens,
+                mesh, dc=dc, scale=scale,
+            )
+        else:
+            attn_lat = prefill_mla_attention(
+                qp, lat_pool_l, page_table, q_start, q_len, kv_lens,
+                dc=dc, scale=scale,
+            )
     elif attn_impl == "pallas" and S == 1:
         # decode hot path: Pallas streams latent pages once — the same
         # DMA feeds both score (full latent) and value (first d_c cols)

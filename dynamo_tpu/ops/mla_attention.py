@@ -274,6 +274,41 @@ def prefill_mla_attention(
     )(page_table, q_start, q_len, kv_lens, q, lat)
 
 
+def prefill_mla_attention_sharded(
+    q: jax.Array,  # [B, S, H, Dl] heads sharded over `axis_name`
+    lat_pool_l: jax.Array,  # [NP, PS, 1, Dl] REPLICATED (Hk=1)
+    page_table: jax.Array,
+    q_start: jax.Array,
+    q_len: jax.Array,
+    kv_lens: jax.Array,
+    mesh,
+    axis_name: str = "model",
+    *,
+    dc: int,
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel wrapper for the flash MLA prefill: per-head
+    independence means each shard runs the kernel on its local heads
+    against the replicated latent pool — zero collectives (the block
+    all-reduce happens in the out-projection as usual; the
+    decode_mla_attention_sharded pattern applied to the chunk path, so
+    TP meshes no longer fall back to the jnp gather)."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.shard_map(
+        functools.partial(
+            prefill_mla_attention, dc=dc, scale=scale, interpret=interpret
+        ),
+        mesh=mesh,
+        in_specs=(P(None, None, axis_name, None), P(None, None, None, None),
+                  P(None, None), P(None), P(None), P(None)),
+        out_specs=P(None, None, axis_name, None),
+        check_vma=False,
+    )
+    return fn(q, lat_pool_l, page_table, q_start, q_len, kv_lens)
+
+
 def decode_mla_attention_sharded(
     q: jax.Array,  # [B, H, Dl] heads sharded over `axis_name`
     lat_pool_l: jax.Array,  # [NP, PS, 1, Dl] REPLICATED (Hk=1 — no head

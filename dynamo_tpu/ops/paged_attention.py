@@ -39,6 +39,9 @@ NEG_INF = -1e30
 def _decode_kernel_body(
     page_table_ref,  # [B, MP] int32 (SMEM)
     kv_lens_ref,  # [B] int32 (SMEM)
+    win_ref,  # [1] int32 sliding window (0 = global) or None (no-window
+    #   compile: Gemma-2 alternates sliding/global per layer with a
+    #   TRACED scalar, so the window rides as a prefetch operand)
     q_ref,  # [Hk, G, D] all query heads for seq b
     k_ref,  # [PS, Hk, D] one token-major page of keys (one contiguous DMA)
     v_ref,  # [PS, Hk, D]
@@ -52,6 +55,7 @@ def _decode_kernel_body(
     *,
     page_size: int,
     scale: float,
+    softcap: float = 0.0,  # Gemma-2 attention-score soft capping (0 = off)
 ):
     b = pl.program_id(0)
     i = pl.program_id(1)
@@ -65,8 +69,17 @@ def _decode_kernel_body(
 
     kv_len = kv_lens_ref[b]
     n_valid = jnp.clip(kv_len - i * page_size, 0, page_size)
+    # sliding window: the decode query sits at position kv_len-1, so only
+    # positions >= lo = kv_len - window are visible. Pages wholly below lo
+    # contribute nothing (their DMA is already elided by the index_map's
+    # low clamp); partially-covered pages mask their leading slots.
+    lo = jnp.int32(0)
+    if win_ref is not None:
+        w = win_ref[0]
+        lo = jnp.where(w > 0, jnp.maximum(kv_len - w, 0), 0)
+    lo_in_page = jnp.clip(lo - i * page_size, 0, page_size)
 
-    @pl.when(n_valid > 0)
+    @pl.when((n_valid > 0) & (lo_in_page < n_valid))
     def _compute():
         q = q_ref[...].astype(jnp.float32)  # [Hk, G, D]
         k = k_ref[...].astype(jnp.float32)  # [PS, Hk, D]
@@ -81,7 +94,12 @@ def _decode_kernel_body(
             # replaces a [PS, Hk, D] one); the (PS, Hk) block transposes
             # in-register — 2 KiB, negligible next to the page DMA
             s = s * ks_ref[...].T[:, None, :]
-        valid = lax.broadcasted_iota(jnp.int32, s.shape, 2) < n_valid
+        if softcap:
+            # applied to the TRUE score (after any int8 scale fold),
+            # matching paged_attention_jnp's order
+            s = softcap * jnp.tanh(s / softcap)
+        pos = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        valid = (pos < n_valid) & (pos >= lo_in_page)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[...]  # [Hk, G, 1]
@@ -108,15 +126,35 @@ def _decode_kernel_body(
         o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
-def _decode_kernel(pt, kl, q, k, v, o, m, l, acc, *, page_size, scale):
+def _decode_kernel(pt, kl, q, k, v, o, m, l, acc, *, page_size, scale,
+                   softcap=0.0):
     _decode_kernel_body(
-        pt, kl, q, k, v, None, None, o, m, l, acc, page_size=page_size, scale=scale
+        pt, kl, None, q, k, v, None, None, o, m, l, acc,
+        page_size=page_size, scale=scale, softcap=softcap,
     )
 
 
-def _decode_kernel_int8(pt, kl, q, k, ks, v, vs, o, m, l, acc, *, page_size, scale):
+def _decode_kernel_win(pt, kl, win, q, k, v, o, m, l, acc, *, page_size,
+                       scale, softcap=0.0):
     _decode_kernel_body(
-        pt, kl, q, k, v, ks, vs, o, m, l, acc, page_size=page_size, scale=scale
+        pt, kl, win, q, k, v, None, None, o, m, l, acc,
+        page_size=page_size, scale=scale, softcap=softcap,
+    )
+
+
+def _decode_kernel_int8(pt, kl, q, k, ks, v, vs, o, m, l, acc, *, page_size,
+                        scale, softcap=0.0):
+    _decode_kernel_body(
+        pt, kl, None, q, k, v, ks, vs, o, m, l, acc,
+        page_size=page_size, scale=scale, softcap=softcap,
+    )
+
+
+def _decode_kernel_int8_win(pt, kl, win, q, k, ks, v, vs, o, m, l, acc, *,
+                            page_size, scale, softcap=0.0):
+    _decode_kernel_body(
+        pt, kl, win, q, k, v, ks, vs, o, m, l, acc,
+        page_size=page_size, scale=scale, softcap=softcap,
     )
 
 
@@ -128,7 +166,10 @@ def decode_paged_attention_sharded(
     kv_lens: jax.Array,  # [B] replicated
     mesh,
     axis_name: str = "model",
+    window=None,  # traced int32 scalar (see decode_paged_attention)
     *,
+    scale=None,
+    softcap: float = 0.0,
     interpret: bool = False,
 ) -> jax.Array:
     """Tensor-parallel wrapper: attention is independent per kv-head, and
@@ -144,24 +185,44 @@ def decode_paged_attention_sharded(
         pool = {"q": pool, "s": P(None, None, axis_name)}
     rep2 = P(None, None)
     rep1 = P(None)
+    part = functools.partial(
+        decode_paged_attention, scale=scale, softcap=softcap,
+        interpret=interpret,
+    )
+    if window is None:
+        fn = jax.shard_map(
+            part,
+            mesh=mesh,
+            in_specs=(heads, pool, pool, rep2, rep1),
+            out_specs=heads,
+            check_vma=False,
+        )
+        return fn(q, k_pool_l, v_pool_l, page_table, kv_lens)
     fn = jax.shard_map(
-        functools.partial(decode_paged_attention, interpret=interpret),
+        part,
         mesh=mesh,
-        in_specs=(heads, pool, pool, rep2, rep1),
+        in_specs=(heads, pool, pool, rep2, rep1, P()),
         out_specs=heads,
         check_vma=False,
     )
-    return fn(q, k_pool_l, v_pool_l, page_table, kv_lens)
+    return fn(q, k_pool_l, v_pool_l, page_table, kv_lens,
+              jnp.asarray(window, jnp.int32).reshape(1))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "scale", "softcap")
+)
 def decode_paged_attention(
     q: jax.Array,  # [B, Hk, G, D]
     k_pool_l: jax.Array,  # [NP, PS, Hk, D] one layer's token-major key pool
     v_pool_l: jax.Array,
     page_table: jax.Array,  # [B, MP] int32
     kv_lens: jax.Array,  # [B] int32 (context length incl. current token)
+    window=None,  # None = no-window compile; else a traced int32 scalar
+    #   (0 = global at runtime) — Gemma-2 alternates per layer in the scan
     *,
+    scale=None,  # static score-scale override (query_pre_attn_scalar)
+    softcap: float = 0.0,  # Gemma-2 logit soft capping (static; 0 = off)
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, Hk, G, D]. KV for the current token must already be
@@ -171,39 +232,60 @@ def decode_paged_attention(
     kq = k_pool_l["q"] if quantized else k_pool_l
     NP, PS, _, _ = kq.shape
     MP = page_table.shape[1]
-    scale = D**-0.5
+    if scale is None:
+        scale = D**-0.5
+    windowed = window is not None
+    n_prefetch = 3 if windowed else 2
 
-    def kv_index(b, i, pt, kl):
+    def _clamp(b, i, pt, kl, *rest):
         # clamp past-the-end pages to the last valid page: the block index
-        # then repeats across those grid steps and Pallas skips the DMA, so
-        # a 128-token context in an 8192-token table costs 2 page copies,
-        # not 128
+        # then repeats across those grid steps and Pallas skips the DMA,
+        # so a 128-token context in an 8192-token table costs 2 page
+        # copies, not 128. With a sliding window, pages wholly below the
+        # window likewise clamp UP to the first live page.
         last = jnp.maximum(kl[b] - 1, 0) // PS
-        return (pt[b, jnp.minimum(i, last)], 0, 0, 0)
+        i_eff = jnp.minimum(i, last)
+        if rest:
+            (win,) = rest
+            w = win[0]
+            lo = jnp.where(w > 0, jnp.maximum(kl[b] - w, 0), 0)
+            i_eff = jnp.maximum(i_eff, jnp.minimum(lo // PS, last))
+        return i_eff
 
-    def scale_index(b, i, pt, kl):
-        return kv_index(b, i, pt, kl)[:3]
+    def kv_index(b, i, pt, kl, *rest):
+        return (pt[b, _clamp(b, i, pt, kl, *rest)], 0, 0, 0)
 
-    q_spec = pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0))
+    def scale_index(b, i, pt, kl, *rest):
+        return kv_index(b, i, pt, kl, *rest)[:3]
+
+    def fixed_index(b, i, pt, kl, *rest):
+        return (b, 0, 0, 0)
+
+    q_spec = pl.BlockSpec((None, Hk, G, D), fixed_index)
     # one token-major page = one contiguous PS*Hk*D slab: a single DMA,
     # with a legal (PS, Hk, D) tile (minor dims (Hk, D))
     kv_spec = pl.BlockSpec((None, PS, Hk, D), kv_index)
+    kw = dict(page_size=PS, scale=scale, softcap=softcap)
     if quantized:
-        kernel = functools.partial(_decode_kernel_int8, page_size=PS, scale=scale)
+        kernel = functools.partial(
+            _decode_kernel_int8_win if windowed else _decode_kernel_int8, **kw
+        )
         # (None, PS, Hk): minor dims are full array dims — legal tile
         s_spec = pl.BlockSpec((None, PS, Hk), scale_index)
         in_specs = [q_spec, kv_spec, s_spec, kv_spec, s_spec]
         operands = (q, kq, k_pool_l["s"], v_pool_l["q"], v_pool_l["s"])
     else:
-        kernel = functools.partial(_decode_kernel, page_size=PS, scale=scale)
+        kernel = functools.partial(
+            _decode_kernel_win if windowed else _decode_kernel, **kw
+        )
         in_specs = [q_spec, kv_spec, kv_spec]
         operands = (q, kq, v_pool_l)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # page_table, kv_lens
+        num_scalar_prefetch=n_prefetch,  # page_table, kv_lens (+ window)
         grid=(B, MP),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, Hk, G, D), lambda b, i, pt, kl: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((None, Hk, G, D), fixed_index),
         scratch_shapes=[
             pltpu.VMEM((Hk, G, 1), jnp.float32),
             pltpu.VMEM((Hk, G, 1), jnp.float32),
@@ -211,10 +293,15 @@ def decode_paged_attention(
         ],
     )
 
+    prefetch = (page_table, kv_lens)
+    if windowed:
+        prefetch = prefetch + (
+            jnp.asarray(window, jnp.int32).reshape(1),
+        )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hk, G, D), q.dtype),
         interpret=interpret,
-    )(page_table, kv_lens, *operands)
+    )(*prefetch, *operands)
     return out

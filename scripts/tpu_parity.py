@@ -152,6 +152,33 @@ def check_mla_prefill() -> float:
     ).max())
 
 
+def check_gemma_decode() -> float:
+    """Softcap + sliding-window + scalar-scaled decode (Gemma-2 family):
+    the kernel's window rides as a scalar-prefetch operand."""
+    from dynamo_tpu.ops.paged_attention import decode_paged_attention
+
+    rng = np.random.default_rng(11)
+    B, Hk, G, D, NP, PS, MP = 8, 8, 2, 128, 48, 16, 6
+    q = jnp.asarray(rng.standard_normal((B, Hk, G, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    kv = jnp.asarray(rng.integers(1, MP * PS, B).astype(np.int32))
+    scale, cap, win = 0.35 ** -0.5, 30.0, 24
+    out = decode_paged_attention(
+        q, k, v, pt, kv, jnp.int32(win), scale=scale, softcap=cap
+    )
+    ref = paged_attention_jnp(
+        q.astype(jnp.float32)[:, None],
+        k.astype(jnp.float32), v.astype(jnp.float32), pt,
+        (kv - 1)[:, None], kv, scale=scale, softcap=cap,
+        window=jnp.int32(win),
+    )[:, 0]
+    return float(np.abs(
+        np.asarray(out, np.float32) - np.asarray(ref, np.float32)
+    ).max())
+
+
 def check_block_copy() -> float:
     from dynamo_tpu.ops.block_copy import gather_pages, scatter_pages
 
@@ -187,6 +214,7 @@ def main() -> int:
         ("prefill int8-kv", lambda: check_prefill(True)),
         ("mla decode bf16", check_mla),
         ("mla prefill bf16", check_mla_prefill),
+        ("gemma decode (softcap+window)", check_gemma_decode),
         ("block copy/permute", check_block_copy),
     ):
         d = fn()
