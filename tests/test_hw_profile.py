@@ -60,8 +60,14 @@ def test_perf_model_and_sim_timing_load_profile(profile):
     pm = TpuPerfModel.from_profile(path)
     assert pm.decode_base_s == fit["decode_base_s"]
     assert pm.prefill_per_token_s == fit["prefill_per_token_s"]
-    # tp scaling still applies on top of measured baselines
-    assert pm.timing_for(2).decode_base_s < pm.timing_for(1).decode_base_s
+    # tp scaling still applies on top of measured baselines. <= not <:
+    # under heavy CI-host contention the least-squares intercept can fit
+    # negative and clamp to 0.0 (fit_line), making both sides equal —
+    # the scaling law is what's under test, not the noisy measurement
+    t1, t2 = pm.timing_for(1).decode_base_s, pm.timing_for(2).decode_base_s
+    assert t2 <= t1
+    if t1 > 0:
+        assert t2 < t1
 
     st = SimTiming.from_profile(prof)
     assert st.decode_base_s == fit["decode_base_s"]
