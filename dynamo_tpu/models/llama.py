@@ -360,30 +360,34 @@ def forward(
                     qg[:, 0], k_pool_l, v_pool_l, page_table, kv_lens,
                     win, **kwg,
                 )[:, None]  # [B, 1, Hk, G, hd]
+        elif attn_impl == "pallas":
+            # flash prefill carries the gemma extras the same way the
+            # decode kernel does (softcap/scale static, window as a
+            # scalar-prefetch operand) — one dispatch for all families
+            from dynamo_tpu.ops.flash_prefill import (
+                prefill_paged_attention,
+                prefill_paged_attention_sharded,
+            )
+
+            kwp = dict(scale=g_scale, softcap=c.attn_logit_softcap)
+            if tp:
+                attn = prefill_paged_attention_sharded(
+                    qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens,
+                    mesh, window=win, **kwp,
+                )
+            else:
+                attn = prefill_paged_attention(
+                    qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens,
+                    win, **kwp,
+                )
         elif gemma_attn:
-            # gemma prefill (and non-pallas runs): jnp path — once per
-            # chunk, not the steady-state cost
+            # non-pallas gemma runs: jnp path
             attn = paged_attention_jnp(
                 qg, k_pool_l, v_pool_l, page_table, safe_pos, kv_lens,
                 scale=g_scale,
                 softcap=c.attn_logit_softcap,
                 window=win,
             )
-        elif attn_impl == "pallas":
-            from dynamo_tpu.ops.flash_prefill import (
-                prefill_paged_attention,
-                prefill_paged_attention_sharded,
-            )
-
-            if tp:
-                attn = prefill_paged_attention_sharded(
-                    qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens,
-                    mesh,
-                )
-            else:
-                attn = prefill_paged_attention(
-                    qg, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens
-                )
         elif attn_impl == "ring":
             # sequence-parallel prefill: ring attention over this chunk's
             # fresh K/V (seq-sharded, ppermute over ICI) merged with paged

@@ -44,6 +44,8 @@ def _prefill_kernel_body(
     q_start_ref,  # [B] int32 absolute position of query token 0
     q_len_ref,  # [B] int32 number of valid query tokens
     kv_lens_ref,  # [B] int32 context length (incl. this chunk)
+    win_ref,  # [1] int32 sliding window (0 = global) or None (no-window
+    #   compile) — Gemma-2 alternates per layer with a traced scalar
     # blocks
     q_ref,  # [Hk, Sq, G, D]
     k_ref,  # [PS, Hk, D] one token-major page (one contiguous DMA)
@@ -60,6 +62,7 @@ def _prefill_kernel_body(
     q_block: int,
     n_groups: int,
     scale: float,
+    softcap: float = 0.0,
 ):
     b = pl.program_id(0)
     sb = pl.program_id(1)
@@ -80,6 +83,15 @@ def _prefill_kernel_body(
     blk_max_pos = q_start + sb * q_block + blk_rows - 1
     page_first = i * page_size
     needed = (blk_rows > 0) & (page_first <= blk_max_pos) & (page_first < kv_len)
+    if win_ref is not None:
+        # sliding window: the EARLIEST position any row here can see is
+        # first_row_pos - w + 1; pages wholly before that are dead (their
+        # DMA is already elided by the index_map's low clamp)
+        w = win_ref[0]
+        blk_lo = jnp.where(
+            w > 0, jnp.maximum(q_start + sb * q_block - w + 1, 0), 0
+        )
+        needed = needed & (page_first + page_size > blk_lo)
 
     @pl.when(needed)
     def _compute():
@@ -93,12 +105,18 @@ def _prefill_kernel_body(
             # int8 KV: fold per-(token, head) K scales into the scores
             # ((PS, Hk) block transposed in-register — 2 KiB)
             s = s * ks_ref[...].T[:, None, :]
+        if softcap:
+            # the TRUE score (post any int8 fold), matching the jnp path
+            s = softcap * jnp.tanh(s / softcap)
 
         row = lax.broadcasted_iota(jnp.int32, s.shape, 1) // n_groups  # sq idx
         col = lax.broadcasted_iota(jnp.int32, s.shape, 2)  # slot in page
         q_pos = q_start + sb * q_block + row
         kv_pos = page_first + col
         mask = (row < blk_rows) & (kv_pos <= q_pos) & (kv_pos < kv_len)
+        if win_ref is not None:
+            w = win_ref[0]
+            mask = mask & ((w <= 0) | (kv_pos > q_pos - w))
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[...]
@@ -125,11 +143,24 @@ def _prefill_kernel_body(
 
 
 def _prefill_kernel(pt, qs, ql, kl, q, k, v, o, m, l, acc, **kw):
-    _prefill_kernel_body(pt, qs, ql, kl, q, k, v, None, None, o, m, l, acc, **kw)
+    _prefill_kernel_body(pt, qs, ql, kl, None, q, k, v, None, None,
+                         o, m, l, acc, **kw)
+
+
+def _prefill_kernel_win(pt, qs, ql, kl, win, q, k, v, o, m, l, acc, **kw):
+    _prefill_kernel_body(pt, qs, ql, kl, win, q, k, v, None, None,
+                         o, m, l, acc, **kw)
 
 
 def _prefill_kernel_int8(pt, qs, ql, kl, q, k, ks, v, vs, o, m, l, acc, **kw):
-    _prefill_kernel_body(pt, qs, ql, kl, q, k, v, ks, vs, o, m, l, acc, **kw)
+    _prefill_kernel_body(pt, qs, ql, kl, None, q, k, v, ks, vs,
+                         o, m, l, acc, **kw)
+
+
+def _prefill_kernel_int8_win(pt, qs, ql, kl, win, q, k, ks, v, vs, o, m, l,
+                             acc, **kw):
+    _prefill_kernel_body(pt, qs, ql, kl, win, q, k, v, ks, vs,
+                         o, m, l, acc, **kw)
 
 
 def prefill_paged_attention_sharded(
@@ -142,8 +173,11 @@ def prefill_paged_attention_sharded(
     kv_lens: jax.Array,
     mesh,
     axis_name: str = "model",
+    window=None,  # traced int32 scalar (see prefill_paged_attention)
     *,
     q_block: int = 128,
+    scale=None,
+    softcap: float = 0.0,
     interpret: bool = False,
 ) -> jax.Array:
     """Tensor-parallel wrapper (see decode_paged_attention_sharded): each
@@ -155,17 +189,27 @@ def prefill_paged_attention_sharded(
     if isinstance(k_pool_l, dict):  # int8 KV: scales [NP, PS, Hk] shard
         # the same head axis
         pool = {"q": pool, "s": P(None, None, axis_name)}
-    fn = jax.shard_map(
-        functools.partial(prefill_paged_attention, q_block=q_block, interpret=interpret),
-        mesh=mesh,
-        in_specs=(heads, pool, pool, P(None, None), P(None), P(None), P(None)),
-        out_specs=heads,
-        check_vma=False,
+    part = functools.partial(
+        prefill_paged_attention, q_block=q_block, scale=scale,
+        softcap=softcap, interpret=interpret,
     )
-    return fn(q, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens)
+    base_specs = (heads, pool, pool, P(None, None), P(None), P(None), P(None))
+    extra = (
+        () if window is None
+        else (jnp.asarray(window, jnp.int32).reshape(1),)
+    )
+    fn = jax.shard_map(
+        part, mesh=mesh,
+        in_specs=base_specs + ((P(),) if extra else ()),
+        out_specs=heads, check_vma=False,
+    )
+    return fn(q, k_pool_l, v_pool_l, page_table, q_start, q_len, kv_lens,
+              *extra)
 
 
-@functools.partial(jax.jit, static_argnames=("q_block", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("q_block", "interpret", "scale", "softcap")
+)
 def prefill_paged_attention(
     q: jax.Array,  # [B, S, Hk, G, D]
     k_pool_l: jax.Array,  # [NP, PS, Hk, D] (token-major)
@@ -174,8 +218,12 @@ def prefill_paged_attention(
     q_start: jax.Array,  # [B] int32 absolute position of query token 0
     q_len: jax.Array,  # [B] int32 valid query tokens (rest are padding)
     kv_lens: jax.Array,  # [B] int32 context length incl. this chunk
+    window=None,  # None = no-window compile; else traced int32 scalar
+    #   (0 = global at runtime) — see decode_paged_attention
     *,
     q_block: int = 128,
+    scale=None,  # static score-scale override (query_pre_attn_scalar)
+    softcap: float = 0.0,  # Gemma-2 logit soft capping (static; 0 = off)
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, S, Hk, G, D]; padding rows (s >= q_len[b]) return 0.
@@ -189,46 +237,66 @@ def prefill_paged_attention(
     while S % q_block:  # largest divisor of S at most the requested block
         q_block -= 1
     n_sblk = S // q_block
-    scale = D**-0.5
+    if scale is None:
+        scale = D**-0.5
+    windowed = window is not None
+    n_prefetch = 5 if windowed else 4
 
     qt = q.transpose(0, 2, 1, 3, 4)  # [B, Hk, S, G, D]
 
-    def kv_index(b, sb, i, pt, qs, ql, kl):
-        # clamp to the last page this q-block can causally see (and within
-        # kv_len): repeated indices across grid steps → Pallas skips the DMA
+    def _clamp(b, sb, i, pt, qs, ql, kl, *rest):
+        # clamp to the page range this q-block can actually see (causal
+        # top, kv_len, and — with a window — the sliding low bound):
+        # repeated indices across grid steps → Pallas skips the DMA
         rows = jnp.minimum(ql[b] - sb * q_block, q_block)
         blk_max_pos = qs[b] + sb * q_block + jnp.maximum(rows, 1) - 1
         last = jnp.minimum(blk_max_pos, jnp.maximum(kl[b] - 1, 0)) // PS
         last = jnp.clip(last, 0, MP - 1)
-        return (pt[b, jnp.minimum(i, last)], 0, 0, 0)
+        i_eff = jnp.minimum(i, last)
+        if rest:
+            (win,) = rest
+            w = win[0]
+            lo = jnp.where(
+                w > 0, jnp.maximum(qs[b] + sb * q_block - w + 1, 0), 0
+            )
+            i_eff = jnp.maximum(i_eff, jnp.minimum(lo // PS, last))
+        return i_eff
 
-    def scale_index(b, sb, i, pt, qs, ql, kl):
-        return kv_index(b, sb, i, pt, qs, ql, kl)[:3]
+    def kv_index(b, sb, i, pt, qs, ql, kl, *rest):
+        return (pt[b, _clamp(b, sb, i, pt, qs, ql, kl, *rest)], 0, 0, 0)
 
-    q_spec = pl.BlockSpec(
-        (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
-    )
+    def scale_index(b, sb, i, pt, qs, ql, kl, *rest):
+        return kv_index(b, sb, i, pt, qs, ql, kl, *rest)[:3]
+
+    def q_index(b, sb, i, pt, qs, ql, kl, *rest):
+        return (b, 0, sb, 0, 0)
+
+    q_spec = pl.BlockSpec((None, Hk, q_block, G, D), q_index)
     # one token-major page = one contiguous PS*Hk*D slab (single DMA)
     kv_spec = pl.BlockSpec((None, PS, Hk, D), kv_index)
-    kw = dict(page_size=PS, q_block=q_block, n_groups=G, scale=scale)
+    kw = dict(page_size=PS, q_block=q_block, n_groups=G, scale=scale,
+              softcap=softcap)
     if quantized:
-        kernel = functools.partial(_prefill_kernel_int8, **kw)
+        kernel = functools.partial(
+            _prefill_kernel_int8_win if windowed else _prefill_kernel_int8,
+            **kw,
+        )
         # (None, PS, Hk): minor dims are full array dims — legal tile
         s_spec = pl.BlockSpec((None, PS, Hk), scale_index)
         in_specs = [q_spec, kv_spec, s_spec, kv_spec, s_spec]
         operands = (qt, kq, k_pool_l["s"], v_pool_l["q"], v_pool_l["s"])
     else:
-        kernel = functools.partial(_prefill_kernel, **kw)
+        kernel = functools.partial(
+            _prefill_kernel_win if windowed else _prefill_kernel, **kw
+        )
         in_specs = [q_spec, kv_spec, kv_spec]
         operands = (qt, kq, v_pool_l)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,  # page_table, q_start, q_len, kv_lens
+        num_scalar_prefetch=n_prefetch,  # pt, q_start, q_len, kv (+ window)
         grid=(B, n_sblk, MP),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (None, Hk, q_block, G, D), lambda b, sb, i, pt, qs, ql, kl: (b, 0, sb, 0, 0)
-        ),
+        out_specs=pl.BlockSpec((None, Hk, q_block, G, D), q_index),
         scratch_shapes=[
             pltpu.VMEM((Hk, q_block * G, 1), jnp.float32),
             pltpu.VMEM((Hk, q_block * G, 1), jnp.float32),
@@ -236,10 +304,15 @@ def prefill_paged_attention(
         ],
     )
 
+    prefetch = (page_table, q_start, q_len, kv_lens)
+    if windowed:
+        prefetch = prefetch + (
+            jnp.asarray(window, jnp.int32).reshape(1),
+        )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hk, S, G, D), q.dtype),
         interpret=interpret,
-    )(page_table, q_start, q_len, kv_lens, *operands)
+    )(*prefetch, *operands)
     return out.transpose(0, 2, 1, 3, 4)  # [B, S, Hk, G, D]

@@ -179,6 +179,37 @@ def check_gemma_decode() -> float:
     ).max())
 
 
+def check_gemma_prefill() -> float:
+    """Softcap + sliding-window flash prefill (per-row window mask and
+    low-clamped page DMAs) in compiled Mosaic."""
+    from dynamo_tpu.ops.flash_prefill import prefill_paged_attention
+
+    rng = np.random.default_rng(12)
+    B, S, Hk, G, D, NP, PS, MP = 2, 128, 8, 2, 128, 40, 16, 16
+    q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = np.asarray([64, 0], np.int32)
+    ql = np.asarray([128, 128], np.int32)
+    kv = jnp.asarray(qs + ql)
+    cap, win = 30.0, 48
+    out = prefill_paged_attention(
+        q, k, v, pt, jnp.asarray(qs), jnp.asarray(ql), kv, jnp.int32(win),
+        softcap=cap,
+    )
+    pos = np.zeros((B, S), np.int32)
+    for b in range(B):
+        pos[b] = np.arange(qs[b], qs[b] + S)
+    ref = paged_attention_jnp(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        pt, jnp.asarray(pos), kv, softcap=cap, window=jnp.int32(win),
+    )
+    return float(np.abs(
+        np.asarray(out, np.float32) - np.asarray(ref, np.float32)
+    ).max())
+
+
 def check_block_copy() -> float:
     from dynamo_tpu.ops.block_copy import gather_pages, scatter_pages
 
@@ -215,6 +246,7 @@ def main() -> int:
         ("mla decode bf16", check_mla),
         ("mla prefill bf16", check_mla_prefill),
         ("gemma decode (softcap+window)", check_gemma_decode),
+        ("gemma prefill (softcap+window)", check_gemma_prefill),
         ("block copy/permute", check_block_copy),
     ):
         d = fn()

@@ -689,3 +689,103 @@ def test_decode_kernel_int8_window_softcap_matches_jnp():
     )[:, 0]
     d = np.abs(np.asarray(out, np.float32) - np.asarray(ref, np.float32)).max()
     assert d < 3e-2, d
+
+
+@pytest.mark.parametrize("softcap,window,scale", [
+    (40.0, None, None),
+    (0.0, 5, None),
+    (25.0, 9, 0.5 ** -0.5),
+    (0.0, 0, None),  # window operand present but 0 = global at runtime
+])
+def test_prefill_kernel_gemma_variants_match_jnp(softcap, window, scale):
+    """Gemma extras in the FLASH PREFILL kernel: per-row sliding window,
+    softcap, scale — against the jnp path, with prior context (q_start>0)
+    so the window reaches back across page boundaries."""
+    rng = np.random.default_rng(21)
+    B, S, Hk, G, D, NP, PS, MP = 2, 16, 2, 3, 64, 16, 8, 8
+    q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = np.asarray([11, 0], np.int32)
+    ql = np.asarray([16, 13], np.int32)
+    kv = jnp.asarray(qs + ql)
+    win = None if window is None else jnp.int32(window)
+    out = prefill_paged_attention(
+        q, kp, vp, pt, jnp.asarray(qs), jnp.asarray(ql), kv, win,
+        q_block=8, scale=scale, softcap=softcap, interpret=True,
+    )
+    pos = np.full((B, S), -1, np.int32)
+    for b in range(B):
+        pos[b, : ql[b]] = np.arange(qs[b], qs[b] + ql[b])
+    jwin = None if not window else jnp.int32(window)
+    ref = paged_attention_jnp(
+        q, kp, vp, pt, jnp.asarray(np.maximum(pos, 0)), kv,
+        scale=scale, softcap=softcap, window=jwin,
+    )
+    # the >1 scale amplifies bf16 input rounding (kernel vs jnp differ in
+    # f32 reduction order); the same combo in f32 agrees to 4e-6
+    tol = 3e-2 if not (scale and scale > 1) else 6e-2
+    for b in range(B):
+        d = np.abs(
+            np.asarray(out[b, : ql[b]], np.float32)
+            - np.asarray(ref[b, : ql[b]], np.float32)
+        ).max()
+        assert d < tol, (b, d)
+
+
+def test_prefill_kernel_int8_window_matches_jnp():
+    rng = np.random.default_rng(22)
+    B, S, Hk, G, D, NP, PS, MP = 2, 8, 2, 3, 64, 16, 8, 8
+    q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = np.asarray([9, 0], np.int32)
+    ql = np.asarray([8, 6], np.int32)
+    kv = jnp.asarray(qs + ql)
+    kq, vq = _q_pools(kp, vp)
+    out = prefill_paged_attention(
+        q, kq, vq, pt, jnp.asarray(qs), jnp.asarray(ql), kv, jnp.int32(6),
+        q_block=8, softcap=15.0, interpret=True,
+    )
+    pos = np.full((B, S), -1, np.int32)
+    for b in range(B):
+        pos[b, : ql[b]] = np.arange(qs[b], qs[b] + ql[b])
+    ref = paged_attention_jnp(
+        q, kq, vq, pt, jnp.asarray(np.maximum(pos, 0)), kv,
+        softcap=15.0, window=jnp.int32(6),
+    )
+    for b in range(B):
+        d = np.abs(
+            np.asarray(out[b, : ql[b]], np.float32)
+            - np.asarray(ref[b, : ql[b]], np.float32)
+        ).max()
+        assert d < 3e-2, (b, d)
+
+
+def test_prefill_kernel_gemma_sharded_matches_jnp():
+    from dynamo_tpu.ops.flash_prefill import prefill_paged_attention_sharded
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    rng = np.random.default_rng(23)
+    B, S, Hk, G, D, NP, PS, MP = 2, 8, 2, 3, 64, 16, 8, 8
+    q = jnp.asarray(rng.standard_normal((B, S, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    qs = jnp.asarray([3, 0], jnp.int32)
+    ql = jnp.asarray([8, 8], jnp.int32)
+    kv = qs + ql
+    mesh = make_mesh(MeshConfig(model=2))
+    out = prefill_paged_attention_sharded(
+        q, kp, vp, pt, qs, ql, kv, mesh, window=jnp.int32(5), softcap=20.0,
+        q_block=8, interpret=True,
+    )
+    pos = jnp.stack([jnp.arange(3, 11), jnp.arange(0, 8)])
+    ref = paged_attention_jnp(
+        q, kp, vp, pt, pos, kv, softcap=20.0, window=jnp.int32(5),
+    )
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
