@@ -109,6 +109,9 @@ def parse_args(argv=None):
                         "Repeatable; each name becomes a servable model.")
     p.add_argument("--lora-rank", type=int, default=8,
                    help="rank for randomly-initialized dev adapters")
+    p.add_argument("--lora-slots", type=int, default=0,
+                   help="EXTRA free adapter slots beyond --lora specs, for "
+                        "runtime registration via the rl load_adapter op")
     p.add_argument("--quantize", default=None, choices=[None, "int8", "fp8"],
                    help="weight-only quantization (halves decode HBM weight "
                         "traffic; fp8 = e4m3 per-channel)")
@@ -160,7 +163,12 @@ def _lora_kwargs(args, config) -> dict:
     stacked tree's targets are the union of what the checkpoints actually
     adapt (a PEFT adapter touching MLP projections must not be silently
     half-applied)."""
+    extra = int(getattr(args, "lora_slots", 0) or 0)
     if not args.lora:
+        if extra > 0:
+            # dynamic-only: free slots for rl load_adapter, nothing at boot
+            args._lora_factors = []
+            return {"lora_slots": extra, "lora_rank": args.lora_rank}
         return {}
     from dynamo_tpu.models import lora as lora_mod
 
@@ -195,7 +203,7 @@ def _lora_kwargs(args, config) -> dict:
             factors[k] = np.pad(arr, pad)
     args._lora_factors = loaded
     return {
-        "lora_slots": len(loaded),
+        "lora_slots": len(loaded) + extra,
         "lora_rank": rank,
         "lora_targets": tuple(sorted(targets)),
     }

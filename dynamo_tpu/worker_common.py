@@ -258,7 +258,10 @@ async def serve_worker(
 
     # RL admin surface (reference lib/rl: dyn://ns.comp.rl endpoints with
     # frontend read-only fan-in): pause/resume admission around weight
-    # refreshes, orbax weight hot-swap, version reporting
+    # refreshes, orbax weight hot-swap, version reporting, dynamic LoRA
+    # registration
+    _served = {"inst": None}  # generate instance (set at the end of boot)
+
     async def rl_admin(request, context):
         req = request or {}
         op = req.get("op", "describe")
@@ -266,6 +269,76 @@ async def serve_worker(
             engine.paused = True
         elif op == "resume":
             engine.paused = False
+        elif op == "load_adapter":
+            # dynamic multi-LoRA: install an adapter into a free slot and
+            # republish the model card — the frontend watcher registers
+            # the new name as a servable model and routes ONLY to holders
+            # (the late-adapter path of LoRA-filtered routing)
+            name = req.get("name")
+            runner = getattr(engine, "runner", None)
+            if not name:
+                yield {"error": "load_adapter needs 'name'"}
+                return
+            if runner is None or getattr(runner, "lora", None) is None:
+                yield {"error": "worker built without --lora slots"}
+                return
+            if name in getattr(runner, "_adapter_slots", {}):
+                # register_adapter would return the existing slot WITHOUT
+                # touching its factors — reporting success while serving
+                # stale weights. Make rollover explicit: new name, or
+                # restart (slots are append-only by design).
+                yield {"error": f"adapter {name!r} already registered; "
+                                "weight rollover needs a new name"}
+                return
+            import asyncio as _aio
+
+            import numpy as _np
+
+            from dynamo_tpu.models import lora as lora_mod
+
+            try:
+                if req.get("peft"):
+                    factors = await _aio.to_thread(
+                        lora_mod.load_peft_adapter, req["peft"], runner.config
+                    )
+                else:  # dev adapters: random factors, seeded
+                    factors = lora_mod.random_adapter(
+                        runner.config, seed=int(req.get("seed") or 0),
+                        scale=float(req.get("scale") or 2.0),
+                        rank=min(int(req.get("rank") or runner.lora_rank),
+                                 runner.lora_rank),
+                        targets=runner.lora_targets,
+                    )
+                # zero-pad up to the stacked tree's rank (same contract as
+                # the boot path, worker._lora_kwargs): padded rows/cols
+                # contribute nothing to A @ B. A HIGHER rank cannot fit
+                # the fixed slot arrays — fail it loudly below instead of
+                # truncating weights.
+                for k, arr in list(factors.items()):
+                    axis = -1 if k.endswith("_a") else -2
+                    r = arr.shape[axis]
+                    if r > runner.lora_rank:
+                        raise ValueError(
+                            f"adapter rank {r} exceeds the worker's "
+                            f"--lora-rank {runner.lora_rank}"
+                        )
+                    if r < runner.lora_rank:
+                        pad = [(0, 0)] * arr.ndim
+                        pad[axis] = (0, runner.lora_rank - r)
+                        factors[k] = _np.pad(arr, pad)
+                slot = runner.register_adapter(name, factors)
+            except Exception as e:
+                yield {"error": f"adapter load failed: {e}"}
+                return
+            if name not in (card.adapters or []):
+                card.adapters = list(card.adapters or []) + [name]
+            if _served["inst"] is not None:
+                await runtime.update_instance_metadata(
+                    _served["inst"], {"model_card": card.to_dict()}
+                )
+            yield {"model": card.name, "adapter": name, "slot": slot,
+                   "adapters": list(card.adapters), "instance": instance_id}
+            return
         elif op == "update_weights":
             path = req.get("orbax")
             if not path:
@@ -348,5 +421,6 @@ async def serve_worker(
         metadata=metadata,
         instance_id=instance_id,
     )
+    _served["inst"] = inst  # rl load_adapter republishes this card
     log.info("worker %x serving %s (role=%s)", instance_id, card.name, disagg_role or "both")
     return ServedWorker(runtime, engine, inst, publisher, close_hooks=close_hooks)

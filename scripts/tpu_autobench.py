@@ -133,6 +133,14 @@ def make_stages(model: str):
          bench + ["--goodput", "--model", model, "--n-requests", "48",
                   "--rps", "3.0", "--max-batch", "32"],
          2400.0, {}),
+        # mixed-scheduling A/B on hardware: same trace with strict
+        # prefill-first alternation — the ITL delta vs the stage above is
+        # the on-chip version of the mocker A/B in docs/perf_notes.md
+        ("goodput_prefill_first",
+         bench + ["--goodput", "--model", model, "--n-requests", "48",
+                  "--rps", "3.0", "--max-batch", "32",
+                  "--mixed-prefill-tokens", "0"],
+         2400.0, {}),
     ]
 
 
