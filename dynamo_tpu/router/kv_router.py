@@ -365,6 +365,9 @@ class KvRouter:
                     code="no_instances",
                 )
         if pinned_instance is not None:
+            # an explicit pin bypasses the sick filter (same contract as
+            # PushRouter._pick): a transiently-cooled but live instance
+            # must not read as "not found" and cost its session binding
             workers = [w for w in workers if w[0] == pinned_instance]
             if not workers:
                 # same contract as PushRouter._pick: a named target that is
@@ -373,6 +376,16 @@ class KvRouter:
                     f"instance {pinned_instance:x} not found",
                     code="cannot_connect",
                 )
+        else:
+            # skip replicas in their transport-failure cooldown (PushRouter
+            # mark_sick): between a worker's death and its lease expiry the
+            # index still lists it, and cost selection would happily
+            # re-pick the corpse until migration's budget ran out
+            sick = self.client.router.sick_instances()
+            if sick:
+                healthy = [w for w in workers if w[0] not in sick]
+                if healthy:
+                    workers = healthy
         worker, overlap = self.selector.select(
             workers, len(hashes), overlaps, self.sequences,
             host_overlaps=host_overlaps,
@@ -512,6 +525,13 @@ class KvPushRouter:
                     self.router.mark_prefill_completed(rid)
                     first = False
                 yield item
+        except RequestPlaneError as e:
+            if e.code in ("cannot_connect", "disconnected"):
+                # direct() bypasses PushRouter.generate's sick-marking —
+                # record the corpse here so the migration retry's
+                # find_best_match avoids it
+                self.router.client.router.mark_sick(worker[0])
+            raise
         finally:
             self.router.free(rid)
 

@@ -586,6 +586,12 @@ class PushRouter:
     update_load() — when present it wins, since it sees load from OTHER
     frontends too."""
 
+    # how long a transport-failed instance is avoided. Discovery lease
+    # expiry (seconds) is the authoritative removal; this cooldown only
+    # bridges the gap so migration retries don't re-pick a corpse and
+    # exhaust their budget before the lease lapses.
+    SICK_COOLDOWN_S = 5.0
+
     def __init__(self, endpoint_path: str, mode: str = RouterMode.ROUND_ROBIN):
         self.endpoint_path = endpoint_path
         self.mode = mode
@@ -595,6 +601,7 @@ class PushRouter:
         self._inflight: Dict[int, int] = {}  # instance_id -> outstanding reqs
         self._ext_load: Dict[int, float] = {}  # worker-published load
         self._weights: Dict[int, float] = {}  # published device capacity
+        self._sick: Dict[int, float] = {}  # instance_id -> retry-after
 
     def update_instance(self, instance_id: int, address: Optional[str]) -> None:
         if address is None:
@@ -602,8 +609,28 @@ class PushRouter:
             self._inflight.pop(instance_id, None)
             self._ext_load.pop(instance_id, None)
             self._weights.pop(instance_id, None)
+            self._sick.pop(instance_id, None)
         else:
             self._instances[instance_id] = address
+
+    def mark_sick(self, instance_id: int, cooldown: Optional[float] = None) -> None:
+        """Record a transport failure: selection avoids this instance for
+        `cooldown` seconds (unless nothing else is available)."""
+        import time as _time
+
+        self._sick[instance_id] = _time.monotonic() + (
+            cooldown if cooldown is not None else self.SICK_COOLDOWN_S
+        )
+
+    def sick_instances(self) -> set:
+        """Instances currently in their failure cooldown."""
+        import time as _time
+
+        now = _time.monotonic()
+        for iid, until in list(self._sick.items()):
+            if until <= now:
+                del self._sick[iid]
+        return set(self._sick)
 
     def update_weight(self, instance_id: int, weight: Optional[float]) -> None:
         """Feed a published device-capacity weight (metadata
@@ -679,6 +706,11 @@ class PushRouter:
                 f"no instances for {self.endpoint_path} satisfy the "
                 "adapter restriction", code="no_instances",
             )
+        sick = self.sick_instances()
+        if sick:
+            healthy = [i for i in ids if i not in sick]
+            if healthy:  # all-sick: keep trying rather than failing hard
+                ids = healthy
         if self.mode == RouterMode.RANDOM:
             iid = random.choice(ids)
         elif self.mode == RouterMode.P2C:
@@ -740,6 +772,12 @@ class PushRouter:
         try:
             async for item in engine.generate(request, context):
                 yield item
+        except RequestPlaneError as e:
+            if e.code in ("cannot_connect", "disconnected"):
+                # dead/unreachable replica: cool it down so the migration
+                # retry lands on a healthy one instead of this corpse
+                self.mark_sick(iid)
+            raise
         finally:
             left = self._inflight.get(iid, 1) - 1
             if left > 0:

@@ -301,12 +301,13 @@ async def serve_worker(
                     factors = await _aio.to_thread(
                         lora_mod.load_peft_adapter, req["peft"], runner.config
                     )
-                else:  # dev adapters: random factors, seeded
+                else:  # dev adapters: random factors, seeded (an
+                    # over-rank request hits the loud check below, same
+                    # as the PEFT path — never a silent clamp)
                     factors = lora_mod.random_adapter(
                         runner.config, seed=int(req.get("seed") or 0),
                         scale=float(req.get("scale") or 2.0),
-                        rank=min(int(req.get("rank") or runner.lora_rank),
-                                 runner.lora_rank),
+                        rank=int(req.get("rank") or runner.lora_rank),
                         targets=runner.lora_targets,
                     )
                 # zero-pad up to the stacked tree's rank (same contract as

@@ -100,7 +100,7 @@ def stage_ok(name: str, rc: int, parsed) -> bool:
     for obj in parsed:
         if obj.get("tpu_unavailable") or obj.get("metric") == "bench_error":
             return False
-    if name.startswith("bench") or name == "goodput":
+    if name.startswith(("bench", "goodput")):
         return any("metric" in o and o.get("value", 0) > 0 for o in parsed)
     return True
 

@@ -576,3 +576,26 @@ async def test_native_codec_rpc_e2e(monkeypatch):
     finally:
         await frt.shutdown(drain_timeout=1)
         await rt.shutdown(drain_timeout=1)
+
+
+def test_push_router_sick_cooldown():
+    """mark_sick removes an instance from selection for the cooldown,
+    falls back to sick instances when nothing else is live, and expiry
+    restores it."""
+    import time
+
+    from dynamo_tpu.runtime.request_plane import PushRouter, RouterMode
+
+    r = PushRouter("ns/c/e", RouterMode.ROUND_ROBIN)
+    r.update_instance(1, "tcp://a")
+    r.update_instance(2, "tcp://b")
+    r.mark_sick(1, cooldown=0.2)
+    assert {r._pick()[0] for _ in range(6)} == {2}
+    r.mark_sick(2, cooldown=0.2)  # ALL sick: keep routing, don't fail
+    assert {r._pick()[0] for _ in range(6)} == {1, 2}
+    time.sleep(0.25)
+    assert {r._pick()[0] for _ in range(6)} == {1, 2}  # cooldown expired
+    # departure clears sickness state
+    r.mark_sick(1, cooldown=60)
+    r.update_instance(1, None)
+    assert r.sick_instances() == set()
