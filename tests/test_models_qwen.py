@@ -357,3 +357,74 @@ def test_qwen3_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "qwen3", **kw}, "tiny-hf-qwen3",
         check_cfg=check,
     )
+
+
+def test_deepseek_v3_matches_hf_transformers(tmp_path):
+    """DeepSeek-V3 fidelity vs transformers' own DeepseekV3ForCausalLM:
+    MLA (latent KV + decoupled rope with the HF interleave → our
+    half-rotation de-interleave fold), the noaux_tc sigmoid router with
+    e_score_correction_bias, group-limited top-k, routed scaling, shared
+    experts, and the leading dense layer. Until now MLA was validated
+    self-consistently; this pins it to the upstream implementation."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "DeepseekV3ForCausalLM"):
+        pytest.skip("transformers too old for DeepseekV3")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=3, num_attention_heads=2, num_key_value_heads=2,
+        kv_lora_rank=16, q_lora_rank=None, qk_rope_head_dim=8,
+        qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=24,
+        n_shared_experts=1, routed_scaling_factor=2.5,
+        scoring_func="sigmoid", topk_method="noaux_tc", norm_topk_prob=True,
+        n_group=2, topk_group=1, first_k_dense_replace=1,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    model = transformers.DeepseekV3ForCausalLM(
+        transformers.DeepseekV3Config(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.is_mla and c.moe_router_bias and c.n_dense_layers == 1
+        assert c.moe_routed_scale == 2.5 and c.n_expert_groups == 2
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "deepseek_v3", **kw},
+        "tiny-hf-ds3", check_cfg=check,
+    )
+
+
+def test_qwen3_moe_matches_hf_transformers(tmp_path):
+    """Qwen3-MoE fidelity vs transformers: softmax top-k routing with
+    norm_topk_prob over every layer — pins the dense-fallback MoE block
+    (and the router math the wide-EP dispatch shares) to upstream."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Qwen3MoeForCausalLM"):
+        pytest.skip("transformers too old for Qwen3Moe")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=24, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(4)
+    model = transformers.Qwen3MoeForCausalLM(
+        transformers.Qwen3MoeConfig(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.is_moe and c.qk_norm and c.n_experts == 4
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "qwen3_moe", **kw},
+        "tiny-hf-q3moe", check_cfg=check,
+    )
