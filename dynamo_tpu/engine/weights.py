@@ -57,16 +57,24 @@ def load_hf_checkpoint(
         h = safe_open(str(f), framework="numpy")
         handles.append(h)
         for name in h.keys():
-            tensors[name] = h
+            # multimodal wrappers (Gemma-3 vision+text) prefix the LM
+            # tree with "language_model."; alias the stripped name so the
+            # text mapping below serves both checkpoint shapes (the value
+            # keeps the REAL key the file must be read with)
+            if name.startswith("language_model."):
+                tensors[name[len("language_model."):]] = (h, name)
+            tensors[name] = (h, name)
 
     def get(name: str, transpose: bool = False) -> np.ndarray:
-        arr = tensors[name].get_tensor(name)
+        h, key = tensors[name]
+        arr = h.get_tensor(key)
         if transpose:
             arr = arr.T
         return np.ascontiguousarray(arr).astype(np_dtype)
 
     def get_f32(name: str) -> np.ndarray:
-        return tensors[name].get_tensor(name).astype(np.float32)
+        h, key = tensors[name]
+        return h.get_tensor(key).astype(np.float32)
 
     L = config.n_layers
     if config.is_mla:
@@ -272,6 +280,11 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
     qwen2_moe / qwen3_moe model types)."""
     cfg = json.loads((Path(checkpoint_dir) / "config.json").read_text())
     mt = cfg.get("model_type", "llama")
+    if mt == "gemma3" and isinstance(cfg.get("text_config"), dict):
+        # multimodal wrapper config: the LM (incl. its rope_scaling!)
+        # lives under text_config — unwrap BEFORE any field is read
+        cfg = {**cfg["text_config"], "model_type": "gemma3_text"}
+        mt = "gemma3_text"
     rope_kw = _rope_scaling_from_hf(cfg)
     if mt.startswith("deepseek"):
         return ModelConfig(
@@ -309,16 +322,10 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
             n_dense_layers=int(cfg.get("first_k_dense_replace") or 0),
         )
     n_experts = int(cfg.get("num_experts") or cfg.get("n_routed_experts") or 0)
-    if mt.startswith("gemma3"):
-        # Gemma-3 adds qk-norm, a 5:1 local/global sliding pattern and
-        # dual rope bases this loader does not map yet — refuse rather
-        # than silently modeling it as Gemma-2 (wrong logits, no error)
-        raise ValueError(
-            f"model_type {mt!r} is not supported yet (gemma2 is)"
-        )
     gemma = mt == "gemma2"
+    gemma3 = mt.startswith("gemma3")
     gemma_kw = {}
-    if gemma:
+    if gemma or gemma3:
         gemma_kw = dict(
             act="gelu_tanh",
             embed_scale=True,
@@ -332,6 +339,39 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
                 cfg.get("query_pre_attn_scalar") or 0.0
             ),
             sliding_window=int(cfg.get("sliding_window") or 0),
+        )
+    if gemma3:
+        # 5 local : 1 global pattern + dual rope bases. Derive the
+        # period/residue from layer_types when present and verify it is
+        # the canonical periodic pattern — silently mis-phasing the
+        # window schedule would corrupt logits with no error.
+        layer_types = cfg.get("layer_types")
+        period = int(cfg.get("sliding_window_pattern") or 6)
+        if layer_types:
+            globals_ = [i for i, t in enumerate(layer_types)
+                        if t == "full_attention"]
+            if globals_:
+                period = globals_[0] + 1
+            expect = [
+                "full_attention" if (i % period) == period - 1
+                else "sliding_attention"
+                for i in range(len(layer_types))
+            ]
+            if layer_types != expect:
+                raise ValueError(
+                    "gemma3 layer_types is not the canonical "
+                    f"{period - 1}:1 local/global pattern; refusing to "
+                    "mis-phase the sliding schedule"
+                )
+        gemma_kw.update(
+            sw_period=period,
+            sw_global_residue=period - 1,
+            # HF's default when the field is omitted is 10000.0; falling
+            # back to 0.0 would silently disable the dual rope and rotate
+            # sliding layers with the 1e6 global base
+            rope_local_theta=float(
+                cfg.get("rope_local_base_freq", 10000.0) or 10000.0
+            ),
         )
     return ModelConfig(
         **rope_kw,
@@ -349,7 +389,7 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
         tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
         # qwen2 ships biases by default; qwen3 advertises them explicitly
         attn_bias=bool(cfg.get("attention_bias", mt in ("qwen2", "qwen2_moe"))),
-        qk_norm=mt in ("qwen3", "qwen3_moe"),
+        qk_norm=mt in ("qwen3", "qwen3_moe") or gemma3,
         head_dim_override=int(cfg.get("head_dim") or 0),
         n_experts=n_experts,
         n_experts_active=int(cfg.get("num_experts_per_tok") or 0),
@@ -384,6 +424,13 @@ def _rope_scaling_from_hf(cfg: Dict[str, Any]) -> Dict[str, Any]:
             "rope_low_freq_factor": float(rs.get("low_freq_factor", 1.0)),
             "rope_high_freq_factor": float(rs.get("high_freq_factor", 4.0)),
         }
+    if kind in ("linear", "default"):
+        # uniform position interpolation (Gemma-3 global rope: factor 8);
+        # "default" is HF's explicit no-op
+        f = float(rs.get("factor", 1.0))
+        if f == 1.0 or kind == "default":
+            return {}
+        return {"rope_scaling": "linear", "rope_factor": f}
     if kind == "yarn":
         return {
             "rope_scaling": "yarn",

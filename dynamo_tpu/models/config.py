@@ -44,6 +44,15 @@ class ModelConfig:
     #   sliding-window attention on alternating layers (Gemma-2 pattern:
     #   even layers sliding, odd global); 0 = all-global
     sliding_window: int = 0
+    #   sliding pattern generalization: layer l is GLOBAL when
+    #   l % sw_period == sw_global_residue, else sliding. Defaults encode
+    #   Gemma-2 (period 2, residue 1: even sliding / odd global);
+    #   Gemma-3 is period 6, residue 5 (5 local : 1 global).
+    sw_period: int = 2
+    sw_global_residue: int = 1
+    #   Gemma-3 dual rope: sliding layers use this base frequency while
+    #   global layers use rope_theta (+ its rope_scaling); 0 = single rope
+    rope_local_theta: float = 0.0
     # explicit head_dim when it differs from dim // n_heads (Qwen3-MoE)
     head_dim_override: int = 0
     # MoE (0 experts = dense)
@@ -155,6 +164,15 @@ PRESETS: Dict[str, ModelConfig] = {
         embed_scale=True, norm_zero_centered=True, post_norms=True,
         attn_logit_softcap=50.0, final_logit_softcap=30.0,
         query_pre_attn_scalar=16.0, sliding_window=8, rope_theta=10000.0,
+    ),
+    # Gemma-3 test model (qk-norm, 2:1 local/global window pattern, dual
+    # rope bases — the production pattern is 5:1 with period 6)
+    "tiny-gemma3": ModelConfig(
+        name="tiny-gemma3", n_layers=3, tie_embeddings=True,
+        act="gelu_tanh", embed_scale=True, norm_zero_centered=True,
+        post_norms=True, qk_norm=True, query_pre_attn_scalar=16.0,
+        sliding_window=8, sw_period=3, sw_global_residue=2,
+        rope_theta=100000.0, rope_local_theta=10000.0,
     ),
     # MLA test models (CPU CI for the DeepSeek attention family)
     "tiny-mla": ModelConfig(

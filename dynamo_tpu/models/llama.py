@@ -266,6 +266,13 @@ def forward(
         # beats an adapter that appears to load but changes nothing
         raise NotImplementedError("LoRA is not supported for MLA models")
 
+    # Gemma-3 dual rope tables (static per compile; selected per layer
+    # inside the scan)
+    rope_if_global = rope_if_local = None
+    if c.rope_local_theta:
+        rope_if_global = rope_inv_freq(c, hd, c.rope_theta)
+        rope_if_local = rope_inv_freq(None, hd, c.rope_local_theta)
+
     def make_layer(use_moe):
         def layer(carry, xs):
             return _layer_body(carry, xs, use_moe)
@@ -310,11 +317,21 @@ def forward(
         q = q.reshape(B, S, c.n_heads, hd)
         k = k.reshape(B, S, c.n_kv_heads, hd)
         v = v.reshape(B, S, c.n_kv_heads, hd)
-        if c.qk_norm:  # Qwen3 per-head RMSNorm before RoPE
+        if c.qk_norm:  # Qwen3/Gemma-3 per-head RMSNorm before RoPE
             q = rms_norm(q, lp["q_norm"], c.norm_eps, zero_centered=zc)
             k = rms_norm(k, lp["k_norm"], c.norm_eps, zero_centered=zc)
-        q = rope(q, safe_pos, c.rope_theta, config=c)
-        k = rope(k, safe_pos, c.rope_theta, config=c)
+        if c.rope_local_theta:
+            # Gemma-3 dual rope: sliding layers rotate with the local
+            # base, global layers with rope_theta (+ its scaling). Both
+            # tables are static; the per-layer pick is one [hd/2] select
+            # riding the scan — still one compiled body.
+            is_global = (l_idx % c.sw_period) == c.sw_global_residue
+            iv = jnp.where(is_global, rope_if_global, rope_if_local)
+            q = rope(q, safe_pos, c.rope_theta, inv_freq=iv)
+            k = rope(k, safe_pos, c.rope_theta, inv_freq=iv)
+        else:
+            q = rope(q, safe_pos, c.rope_theta, config=c)
+            k = rope(k, safe_pos, c.rope_theta, config=c)
 
         # surgical in-place scatter into the carried pools (no pool copy)
         k_pool = _write_kv(k_pool, l_idx, k, page_table, positions)
@@ -336,8 +353,11 @@ def forward(
         # stays one compiled body.
         win = None
         if gemma_attn and c.sliding_window > 0:
+            # global iff l % sw_period == sw_global_residue (Gemma-2:
+            # even sliding / odd global; Gemma-3: 5 local : 1 global)
             win = jnp.where(
-                l_idx % 2 == 0, jnp.int32(c.sliding_window), jnp.int32(0)
+                (l_idx % c.sw_period) == c.sw_global_residue,
+                jnp.int32(0), jnp.int32(c.sliding_window),
             )
         g_scale = (
             c.query_pre_attn_scalar ** -0.5

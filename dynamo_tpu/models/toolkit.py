@@ -107,6 +107,9 @@ def rope_inv_freq(config: Optional[ModelConfig], hd: int, theta: float):
     if config is None or config.rope_scaling == "none":
         return jnp.asarray(base, jnp.float32)
     c = config
+    if c.rope_scaling == "linear":
+        # uniform position interpolation (Gemma-3 global layers: factor 8)
+        return jnp.asarray(base / c.rope_factor, jnp.float32)
     if c.rope_scaling == "llama3":
         orig = c.rope_orig_max_seq or c.max_seq_len
         wavelen = 2.0 * math.pi / base
@@ -143,13 +146,17 @@ def rope_inv_freq(config: Optional[ModelConfig], hd: int, theta: float):
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float,
-         config: Optional[ModelConfig] = None) -> jax.Array:
+         config: Optional[ModelConfig] = None,
+         inv_freq: Optional[jax.Array] = None) -> jax.Array:
     """HF-Llama half-rotation RoPE. x: [..., S, n_heads, head_dim],
     positions: [..., S]. `config` applies its rope_scaling (llama3/yarn
-    frequency remap + yarn's cos/sin magnitude mscale)."""
+    frequency remap + yarn's cos/sin magnitude mscale). An explicit
+    `inv_freq` overrides the table (dual-rope models select per layer —
+    Gemma-3's local/global bases — inside the layer scan)."""
     hd = x.shape[-1]
     half = hd // 2
-    inv_freq = rope_inv_freq(config, hd, theta)
+    if inv_freq is None:
+        inv_freq = rope_inv_freq(config, hd, theta)
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., S, half]
     m = 1.0
     if config is not None and config.rope_scaling == "yarn":

@@ -138,3 +138,184 @@ def test_gemma2_matches_hf_transformers(tmp_path):
     np.testing.assert_allclose(
         np.asarray(got)[0], ref[0], rtol=2e-3, atol=2e-3
     )
+
+
+def test_gemma3_matches_hf_transformers(tmp_path):
+    """Gemma-3 fidelity vs transformers' Gemma3ForCausalLM: the 5:1
+    local/global sliding pattern (layer_types), DUAL rope bases (local
+    10k on sliding layers, the scaled global base on full-attention
+    layers), per-head zero-centered q/k norms, sandwich norms, GeGLU,
+    embed scaling — no softcaps (unlike Gemma-2)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Gemma3ForCausalLM"):
+        pytest.skip("transformers too old for Gemma3")
+    from safetensors.torch import save_file
+
+    from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=6,  # one full period: 5 sliding + 1 global
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=64, rope_theta=100000.0,
+        rope_local_base_freq=10000.0, rms_norm_eps=1e-6,
+        query_pre_attn_scalar=16.0, sliding_window=4,
+        tie_word_embeddings=True,
+    )
+    hf_cfg = transformers.Gemma3TextConfig(**kw, attn_implementation="eager")
+    torch.manual_seed(5)
+    model = transformers.Gemma3ForCausalLM(hf_cfg).eval()
+
+    sd = {k: v.contiguous() for k, v in model.state_dict().items()
+          if not k.endswith("lm_head.weight")}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "gemma3_text", **kw,
+        "layer_types": list(hf_cfg.layer_types),
+    }))
+
+    c = config_from_hf(str(tmp_path), name="tiny-hf-g3")
+    assert c.qk_norm and c.post_norms and c.rope_local_theta == 10000.0
+    assert c.sw_period == 6 and c.sw_global_residue == 5
+    assert c.attn_logit_softcap == 0.0
+    params = load_hf_checkpoint(str(tmp_path), c, dtype="float32")
+
+    toks = [[3, 9, 27, 41, 5, 11, 60, 2]]  # past the window on sliding layers
+    with torch.no_grad():
+        ref = model(torch.tensor(toks)).logits.numpy()
+    k, v = llama.make_kv_pool(c, 8, 4, dtype=jnp.float32)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    got, _, _ = llama.forward(
+        c, jax.tree.map(jnp.asarray, params),
+        jnp.asarray(toks), jnp.asarray([list(range(8))]),
+        k, v, pt, jnp.asarray([8]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[0], ref[0], rtol=2e-3, atol=2e-3
+    )
+
+
+def test_gemma3_serves_and_pallas_decode_matches_jnp():
+    """tiny-gemma3 through the continuous-batching engine, plus the
+    windowed Pallas decode (interpret) against the jnp path under the
+    period-3 window schedule and dual rope."""
+    import functools as _ft
+
+    import dynamo_tpu.ops.paged_attention as pa_ops
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.runtime.context import Context
+
+    c = get_config("tiny-gemma3")
+    runner = ModelRunner(
+        c, num_pages=64, page_size=4, max_pages_per_seq=16,
+        decode_buckets=(1, 2), prefill_buckets=(8, 16), seed=3,
+    )
+    import asyncio
+
+    engine = InferenceEngine(runner, max_batch=4, chunk_size=8)
+    engine.start()
+    try:
+        async def run():
+            toks = []
+            async for item in engine.generate(
+                {"token_ids": list(range(2, 14)),
+                 "sampling": {"temperature": 0.0},
+                 "stop": {"max_tokens": 6, "stop_ids": []}},
+                Context(),
+            ):
+                assert item.get("finish_reason") != "error", item
+                toks.extend(item["token_ids"])
+                if item["finish_reason"]:
+                    break
+            return toks
+
+        out = asyncio.run(run())
+        assert len(out) == 6
+    finally:
+        engine.stop()
+
+    # pallas decode vs jnp on the same pools (dual rope affects KV
+    # content identically on both paths; the kernel must apply the same
+    # per-layer window/scale)
+    p = llama.init_params(c, jax.random.PRNGKey(0))
+    toks = [5, 9, 2, 7, 1, 3, 8, 4, 6, 2, 9, 1]
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    k1, v1 = llama.make_kv_pool(c, 8, 4)
+    out, k1, v1 = llama.forward(
+        c, p, jnp.asarray([toks]), jnp.asarray([list(range(len(toks)))]),
+        k1, v1, pt, jnp.asarray([len(toks)]),
+    )
+    ref, _, _ = llama.forward(
+        c, p, jnp.asarray([[8]]), jnp.asarray([[len(toks)]]), k1, v1, pt,
+        jnp.asarray([len(toks) + 1]),
+    )
+    orig = pa_ops.decode_paged_attention
+    try:
+        pa_ops.decode_paged_attention = _ft.partial(orig, interpret=True)
+        got, _, _ = llama.forward(
+            c, p, jnp.asarray([[8]]), jnp.asarray([[len(toks)]]), k1, v1,
+            pt, jnp.asarray([len(toks) + 1]), attn_impl="pallas",
+        )
+    finally:
+        pa_ops.decode_paged_attention = orig
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_gemma3_multimodal_wrapper_checkpoint(tmp_path):
+    """The MULTIMODAL checkpoint shape: nested text_config (carrying the
+    rope_scaling), 'language_model.'-prefixed tensor names, and the
+    linear global-rope factor — all must load and match HF exactly
+    (these were the silent-wrong-logits edges of the gemma3 loader)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Gemma3ForCausalLM"):
+        pytest.skip("transformers too old for Gemma3")
+    from safetensors.torch import save_file
+
+    from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=100000.0,
+        rope_local_base_freq=10000.0, rms_norm_eps=1e-6,
+        query_pre_attn_scalar=16.0, sliding_window=4,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        tie_word_embeddings=True,
+    )
+    hf_cfg = transformers.Gemma3TextConfig(**kw, attn_implementation="eager")
+    torch.manual_seed(6)
+    model = transformers.Gemma3ForCausalLM(hf_cfg).eval()
+    sd = {("language_model." + k): v.contiguous()
+          for k, v in model.state_dict().items()
+          if not k.endswith("lm_head.weight")}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    json_kw = dict(kw)
+    json_kw["layer_types"] = list(hf_cfg.layer_types)
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"model_type": "gemma3", "text_config": json_kw}
+    ))
+
+    c = config_from_hf(str(tmp_path), name="mm-g3")
+    assert c.rope_scaling == "linear" and c.rope_factor == 8.0
+    assert c.rope_local_theta == 10000.0 and c.sw_period == 6
+    params = load_hf_checkpoint(str(tmp_path), c, dtype="float32")
+
+    toks = [[3, 9, 27, 41, 5, 11, 60, 2]]
+    with torch.no_grad():
+        ref = model(torch.tensor(toks)).logits.numpy()
+    k, v = llama.make_kv_pool(c, 8, 4, dtype=jnp.float32)
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    got, _, _ = llama.forward(
+        c, jax.tree.map(jnp.asarray, params),
+        jnp.asarray(toks), jnp.asarray([list(range(8))]),
+        k, v, pt, jnp.asarray([8]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[0], ref[0], rtol=2e-3, atol=2e-3
+    )
