@@ -282,8 +282,28 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
     mt = cfg.get("model_type", "llama")
     if mt == "gemma3" and isinstance(cfg.get("text_config"), dict):
         # multimodal wrapper config: the LM (incl. its rope_scaling!)
-        # lives under text_config — unwrap BEFORE any field is read
-        cfg = {**cfg["text_config"], "model_type": "gemma3_text"}
+        # lives under text_config — unwrap BEFORE any field is read.
+        # HF serializes NESTED configs as diffs against the class
+        # defaults, so a real gemma-3-*-it text_config omits defaulted
+        # fields (rope_theta 1e6, sliding_window, query_pre_attn_scalar,
+        # ...) — overlay the upstream defaults underneath or those fields
+        # silently pick up OUR generic fallbacks (wrong logits).
+        defaults: Dict[str, Any] = {}
+        try:
+            import transformers as _tf
+
+            defaults = _tf.Gemma3TextConfig().to_dict()
+        except Exception:
+            # loader must work without transformers: pin the defaults our
+            # mapping reads (upstream Gemma3TextConfig values)
+            defaults = {
+                "rope_theta": 1_000_000.0, "rope_local_base_freq": 10_000.0,
+                "sliding_window": 4096, "query_pre_attn_scalar": 256.0,
+                "head_dim": 256, "rms_norm_eps": 1e-6,
+                "max_position_embeddings": 131072,
+                "tie_word_embeddings": True,
+            }
+        cfg = {**defaults, **cfg["text_config"], "model_type": "gemma3_text"}
         mt = "gemma3_text"
     rope_kw = _rope_scaling_from_hf(cfg)
     if mt.startswith("deepseek"):

@@ -90,6 +90,9 @@ class HttpService:
         from dynamo_tpu.runtime.compute import ComputePool
 
         self.compute = ComputePool(metrics=runtime.metrics)
+        from dynamo_tpu.frontend.batch import BatchService
+
+        self.batch = BatchService(self.manager, compute=self.compute)
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
         self.app.add_routes(
@@ -104,6 +107,15 @@ class HttpService:
                 web.get("/v1/models", self.list_models),
                 web.get("/v1/rl", self.rl_overview),
                 web.get("/v1/models/{model}", self.get_model),
+                # OpenAI Batch API — a WORKING implementation of the
+                # surface the reference 501-skeletons (openai.rs
+                # batch_router); executed through the real serving chain
+                web.post("/v1/files", self.upload_file),
+                web.get("/v1/files/{file_id}/content", self.file_content),
+                web.post("/v1/batches", self.create_batch),
+                web.get("/v1/batches/{batch_id}", self.get_batch),
+                web.get("/v1/batches", self.list_batches),
+                web.post("/v1/batches/{batch_id}/cancel", self.cancel_batch),
                 web.get("/health", self.health),
                 web.get("/live", self.live),
                 web.get("/ready", self.ready),
@@ -147,6 +159,7 @@ class HttpService:
         return f"http://{self.host}:{self.port}"
 
     async def stop(self) -> None:
+        await self.batch.close()
         await self.watcher.stop()
         if self._runner is not None:
             await self._runner.cleanup()
@@ -565,6 +578,73 @@ class HttpService:
             }
         )
 
+    # -- OpenAI Batch API ---------------------------------------------------
+    async def upload_file(self, request: web.Request) -> web.Response:
+        """multipart/form-data with `file` (+ optional `purpose`), or a
+        raw body with ?purpose=... — both land in the batch file store."""
+        purpose = request.query.get("purpose", "batch")
+        filename = "file.jsonl"
+        if request.content_type.startswith("multipart/"):
+            data = b""
+            async for part in (await request.multipart()):
+                if part.name == "purpose":
+                    purpose = (await part.text()).strip() or purpose
+                elif part.name == "file":
+                    filename = part.filename or filename
+                    data = await part.read(decode=False)
+            if not data:
+                return _error(400, "multipart upload missing 'file' part",
+                              "invalid_request_error")
+        else:
+            data = await request.read()
+            if not data:
+                return _error(400, "empty file body", "invalid_request_error")
+        return web.json_response(
+            self.batch.store_file(data, filename=filename, purpose=purpose)
+        )
+
+    async def file_content(self, request: web.Request) -> web.Response:
+        data = self.batch.file_content(request.match_info["file_id"])
+        if data is None:
+            return _error(404, "file not found", "not_found_error")
+        return web.Response(body=data, content_type="application/jsonl")
+
+    async def create_batch(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body", "invalid_request_error")
+        try:
+            batch = self.batch.create_batch(
+                body.get("input_file_id") or "",
+                body.get("endpoint") or "/v1/chat/completions",
+                metadata=body.get("metadata"),
+            )
+        except KeyError as e:
+            return _error(404, str(e), "not_found_error")
+        except ValueError as e:
+            return _error(400, str(e), "invalid_request_error")
+        return web.json_response(batch)
+
+    async def get_batch(self, request: web.Request) -> web.Response:
+        batch = self.batch.get_batch(request.match_info["batch_id"])
+        if batch is None:
+            return _error(404, "batch not found", "not_found_error")
+        return web.json_response(batch)
+
+    async def list_batches(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": sorted(self.batch.batches.values(),
+                           key=lambda b: b["created_at"]),
+        })
+
+    async def cancel_batch(self, request: web.Request) -> web.Response:
+        batch = self.batch.cancel_batch(request.match_info["batch_id"])
+        if batch is None:
+            return _error(404, "batch not found", "not_found_error")
+        return web.json_response(batch)
+
     async def _run_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -783,26 +863,11 @@ class HttpService:
     async def _unary_response(
         self, entry, preprocessed, ctx, rid, model, created, kind, timing=None
     ) -> web.Response:
-        text_parts = []
-        finish = None
-        n_prompt = len(preprocessed["token_ids"])
-        n_out = 0
-        lp_tokens: list = []  # token ids with logprob entries (aligned)
-        lp_entries: list = []
         try:
-            async for item in entry.chain.generate(preprocessed, ctx):
-                text_parts.append(item.get("text", ""))
-                n_out += len(item.get("token_ids") or [])
-                if item.get("logprobs"):
-                    lp_tokens.extend(item.get("token_ids") or [])
-                    lp_entries.extend(item["logprobs"])
-                if timing is not None:
-                    timing.on_tokens(len(item.get("token_ids") or []))
-                if item.get("finish_reason"):
-                    finish = item["finish_reason"]
-                    if timing is not None:
-                        timing.finish_reason = finish
-                    break
+            body = await generate_unary_body(
+                entry, preprocessed, ctx, rid, model, created, kind,
+                timing=timing,
+            )
         except Exception as e:
             from dynamo_tpu.frontend.session_affinity import AffinityError
             from dynamo_tpu.runtime.request_plane import RequestPlaneError
@@ -819,55 +884,88 @@ class HttpService:
                 return _error(429, str(e), "server_overloaded")
             log.exception("request %s failed", rid)
             return _error(500, str(e), "internal_error")
-        finally:
-            ctx.stop_generating()
-        text = "".join(text_parts)
-        usage = {
-            "prompt_tokens": n_prompt,
-            "completion_tokens": n_out,
-            "total_tokens": n_prompt + n_out,
-        }
-        if kind == "chat":
-            message: Dict[str, Any] = {"role": "assistant", "content": text}
-            if (preprocessed.get("annotations") or {}).get("tools"):
-                from dynamo_tpu.frontend.tool_calls import parse_tool_calls
-
-                content, calls = parse_tool_calls(text)
-                if calls:
-                    message = {
-                        "role": "assistant",
-                        "content": content or None,
-                        "tool_calls": calls,
-                    }
-                    finish = "tool_calls"
-            body = {
-                "id": rid,
-                "object": "chat.completion",
-                "created": created,
-                "model": model,
-                "choices": [
-                    {
-                        "index": 0,
-                        "message": message,
-                        "finish_reason": finish or "stop",
-                    }
-                ],
-                "usage": usage,
-            }
-        else:
-            body = {
-                "id": rid,
-                "object": "text_completion",
-                "created": created,
-                "model": model,
-                "choices": [{"index": 0, "text": text, "finish_reason": finish or "stop"}],
-                "usage": usage,
-            }
-        if lp_entries:
-            body["choices"][0]["logprobs"] = _format_logprobs(
-                entry.preprocessor.tokenizer, kind, lp_tokens, lp_entries
-            )
         return web.json_response(body)
+
+
+async def generate_unary_body(
+    entry, preprocessed, ctx, rid, model, created, kind, timing=None
+) -> Dict[str, Any]:
+    """Run one request through the serving chain and assemble the
+    OpenAI unary response body (text, usage, logprobs, tool calls).
+    Raises on failure — the interactive handler maps exceptions to HTTP
+    statuses; the Batch API records them per line. ONE implementation,
+    so batch responses carry the same decorations as live ones."""
+    text_parts = []
+    finish = None
+    n_prompt = len(preprocessed["token_ids"])
+    n_out = 0
+    lp_tokens: list = []  # token ids with logprob entries (aligned)
+    lp_entries: list = []
+    try:
+        async for item in entry.chain.generate(preprocessed, ctx):
+            if item.get("finish_reason") == "error":
+                raise RuntimeError(item.get("error") or "engine error")
+            text_parts.append(item.get("text", ""))
+            n_out += len(item.get("token_ids") or [])
+            if item.get("logprobs"):
+                lp_tokens.extend(item.get("token_ids") or [])
+                lp_entries.extend(item["logprobs"])
+            if timing is not None:
+                timing.on_tokens(len(item.get("token_ids") or []))
+            if item.get("finish_reason"):
+                finish = item["finish_reason"]
+                if timing is not None:
+                    timing.finish_reason = finish
+                break
+    finally:
+        ctx.stop_generating()
+    text = "".join(text_parts)
+    usage = {
+        "prompt_tokens": n_prompt,
+        "completion_tokens": n_out,
+        "total_tokens": n_prompt + n_out,
+    }
+    if kind == "chat":
+        message: Dict[str, Any] = {"role": "assistant", "content": text}
+        if (preprocessed.get("annotations") or {}).get("tools"):
+            from dynamo_tpu.frontend.tool_calls import parse_tool_calls
+
+            content, calls = parse_tool_calls(text)
+            if calls:
+                message = {
+                    "role": "assistant",
+                    "content": content or None,
+                    "tool_calls": calls,
+                }
+                finish = "tool_calls"
+        body = {
+            "id": rid,
+            "object": "chat.completion",
+            "created": created,
+            "model": model,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": message,
+                    "finish_reason": finish or "stop",
+                }
+            ],
+            "usage": usage,
+        }
+    else:
+        body = {
+            "id": rid,
+            "object": "text_completion",
+            "created": created,
+            "model": model,
+            "choices": [{"index": 0, "text": text, "finish_reason": finish or "stop"}],
+            "usage": usage,
+        }
+    if lp_entries:
+        body["choices"][0]["logprobs"] = _format_logprobs(
+            entry.preprocessor.tokenizer, kind, lp_tokens, lp_entries
+        )
+    return body
 
 
 def _responses_tools_to_chat(tools):

@@ -142,3 +142,87 @@ async def test_multiprocess_frontend_reuse_port(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+async def test_openai_batch_api_end_to_end():
+    """/v1/files + /v1/batches executed for REAL (the reference serves
+    this surface as a 501 skeleton): upload a JSONL request file, create
+    a batch against /v1/completions, poll to completion, fetch the
+    output file, and check per-line responses incl. a failed line
+    (unknown model) landing in the error file."""
+    import json as _json
+
+    realm = "batch-e2e"
+    runner = ModelRunner(
+        get_config("tiny"), num_pages=64, page_size=4, max_pages_per_seq=16,
+        decode_buckets=(1, 2, 4), prefill_buckets=(8, 16, 32),
+    )
+    engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+    engine.start()
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    card = ModelCard(name="tiny", tokenizer="byte", context_length=64, kv_block_size=4)
+    await wrt.serve_endpoint("dyn/tpu-worker/generate", engine,
+                             metadata={"model_card": card.to_dict()})
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=10)
+    try:
+        lines = [
+            {"custom_id": "a", "method": "POST", "url": "/v1/completions",
+             "body": {"model": "tiny", "prompt": "hi", "max_tokens": 4}},
+            {"custom_id": "b", "method": "POST", "url": "/v1/completions",
+             "body": {"model": "tiny", "prompt": "yo", "max_tokens": 3}},
+            {"custom_id": "bad", "method": "POST", "url": "/v1/completions",
+             "body": {"model": "nope", "prompt": "x", "max_tokens": 2}},
+        ]
+        payload = "\n".join(_json.dumps(l) for l in lines).encode()
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/files?purpose=batch",
+                              data=payload) as r:
+                assert r.status == 200
+                file_id = (await r.json())["id"]
+            async with s.post(f"{base}/v1/batches", json={
+                "input_file_id": file_id, "endpoint": "/v1/completions",
+                "metadata": {"suite": "e2e"},
+            }) as r:
+                assert r.status == 200
+                batch = await r.json()
+                assert batch["status"] in ("validating", "in_progress")
+            for _ in range(400):
+                async with s.get(f"{base}/v1/batches/{batch['id']}") as r:
+                    batch = await r.json()
+                if batch["status"] in ("completed", "failed", "cancelled"):
+                    break
+                await asyncio.sleep(0.05)
+            assert batch["status"] == "completed", batch
+            assert batch["request_counts"] == {
+                "total": 3, "completed": 2, "failed": 1}
+            async with s.get(
+                f"{base}/v1/files/{batch['output_file_id']}/content"
+            ) as r:
+                out = {(_json.loads(l))["custom_id"]: _json.loads(l)
+                       for l in (await r.text()).splitlines() if l}
+            assert set(out) == {"a", "b"}
+            assert out["a"]["response"]["status_code"] == 200
+            assert out["a"]["response"]["body"]["usage"]["completion_tokens"] == 4
+            assert out["b"]["response"]["body"]["usage"]["completion_tokens"] == 3
+            async with s.get(
+                f"{base}/v1/files/{batch['error_file_id']}/content"
+            ) as r:
+                errs = [_json.loads(l) for l in (await r.text()).splitlines() if l]
+            assert len(errs) == 1 and errs[0]["custom_id"] == "bad"
+            # bad endpoint is a clean 400, unknown file a 404
+            async with s.post(f"{base}/v1/batches", json={
+                "input_file_id": file_id, "endpoint": "/v1/images/generations",
+            }) as r:
+                assert r.status == 400
+            async with s.post(f"{base}/v1/batches", json={
+                "input_file_id": "file-missing", "endpoint": "/v1/completions",
+            }) as r:
+                assert r.status == 404
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+        engine.stop()
