@@ -13,7 +13,11 @@ import asyncio
 from dynamo_tpu.frontend.http import HttpService
 from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+import logging
+
 from dynamo_tpu.runtime.logging_util import configure_logging
+
+log = logging.getLogger("dynamo_tpu.frontend.cli")
 
 
 def parse_args(argv=None):
@@ -53,6 +57,15 @@ def parse_args(argv=None):
                    help="waiting requests beyond this are rejected with 429")
     p.add_argument("--router-queue-timeout", type=float, default=30.0,
                    help="queued longer than this is rejected with 429")
+    p.add_argument("--router-temperature", type=float, default=0.0,
+                   help="kv-router softmax sampling temperature over "
+                        "-cost (0 = deterministic argmin; reference "
+                        "--router-temperature)")
+    p.add_argument("--kv-overlap-score-weight", type=float, default=1.0,
+                   help="scale on the prefix-overlap credit in the "
+                        "kv-router cost: >1 cache-greedier (lower TTFT), "
+                        "<1 load-flatter (reference "
+                        "--kv-overlap-score-weight)")
     p.add_argument("--request-trace", default=None,
                    help="JSONL per-request trace path (also DYN_REQUEST_TRACE)")
     p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
@@ -82,6 +95,22 @@ async def async_main(args) -> None:
             max_depth=args.router_queue_depth,
             max_wait_s=args.router_queue_timeout,
         )
+    from dynamo_tpu.router.scheduling import KvRouterConfig
+
+    router_config = KvRouterConfig(
+        temperature=args.router_temperature,
+        overlap_weight=args.kv_overlap_score_weight,
+    )
+    if args.router_mode == "kv-remote" and (
+        args.router_temperature or args.kv_overlap_score_weight != 1.0
+    ):
+        # selection lives in the standalone KvRouterService process —
+        # tune THAT service's flags; silently ignoring these here would
+        # make the operator believe the knobs took effect
+        log.warning(
+            "--router-temperature/--kv-overlap-score-weight have no "
+            "effect in kv-remote mode: configure the router service"
+        )
     watcher = ModelWatcher(
         runtime, manager, router_mode=args.router_mode,
         router_replica_sync=args.router_replica_sync,
@@ -90,6 +119,7 @@ async def async_main(args) -> None:
         session_affinity_ttl=args.session_affinity_ttl or None,
         router_service=args.router_service,
         admission_config=admission,
+        router_config=router_config,
     )
     import os
 

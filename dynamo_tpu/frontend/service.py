@@ -95,12 +95,15 @@ class ModelWatcher:
         session_affinity_ttl: Optional[float] = None,
         router_service: Optional[str] = None,  # kv-remote: ns/component
         admission_config=None,  # router.queue.AdmissionConfig (kv mode)
+        router_config=None,  # router.scheduling.KvRouterConfig (kv mode):
+        #   temperature / overlap weight / tier credits
     ):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.router_service = router_service
         self.admission_config = admission_config
+        self.router_config = router_config
         self.router_replica_sync = router_replica_sync
         self.migration_limit = migration_limit
         self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
@@ -128,6 +131,7 @@ class ModelWatcher:
 
             kv_router = KvRouter(
                 self.runtime, client, block_size=card.kv_block_size,
+                config=self.router_config,
                 replica_sync=self.router_replica_sync,
                 admission=self.admission_config,
             )

@@ -26,6 +26,11 @@ class KvRouterConfig:
 
     prefill_load_scale: float = 1.5  # prefill tokens cost more than decode
     temperature: float = 0.0  # 0 = deterministic argmin
+    # global scale on the overlap credit (reference
+    # --kv-overlap-score-weight): >1 = cache-greedier routing (lower
+    # TTFT, worse load spread), <1 = load-flatter (better ITL under
+    # pressure, router-design.md:61-85 tradeoff)
+    overlap_weight: float = 1.0
     # overlap credit weights per tier (device hits count fully; host/disk
     # hits — via lower-tier events from the KVBM — count partially)
     device_credit: float = 1.0
@@ -64,7 +69,7 @@ class WorkerSelector:
             # cluster-wide lower-tier residency: blocks any peer holds can
             # be onboarded cross-worker, so they discount every candidate
             credit += cfg.remote_credit * max(0, cluster_host - max(dev, host))
-            new_blocks = max(0.0, total_blocks - credit)
+            new_blocks = max(0.0, total_blocks - cfg.overlap_weight * credit)
             prefill = new_blocks + sequences.prefill_blocks(w)
             decode = sequences.decode_blocks(w)
             costs.append(cfg.prefill_load_scale * prefill + decode)

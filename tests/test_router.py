@@ -335,3 +335,34 @@ async def test_replica_sync_snapshot_seeds_late_joiner():
         await ra.stop()
         await rt_a.shutdown(drain_timeout=1)
         await wrt.shutdown(drain_timeout=1)
+
+
+def test_overlap_weight_trades_cache_affinity_for_load():
+    """--kv-overlap-score-weight semantics: with weight 1 the cached-but-
+    loaded worker wins on overlap credit; weight 0 ignores the cache and
+    routes to the idle worker; a large weight stays cache-greedy even
+    under more load."""
+    from dynamo_tpu.router.scheduling import (
+        KvRouterConfig,
+        WorkerSelector,
+    )
+    from dynamo_tpu.router.sequences import ActiveSequences
+
+    class _Ov:
+        def __init__(self, scores):
+            self.scores = scores
+
+    w_cached, w_idle = (1, 0), (2, 0)
+    seqs = ActiveSequences()
+    # cached worker carries active decode load
+    seqs.add_request("r0", w_cached, 6, 0)
+    seqs.mark_prefill_completed("r0")
+    ov = _Ov({w_cached: 8})  # 8 of 10 blocks cached there
+
+    def pick(weight):
+        sel = WorkerSelector(KvRouterConfig(overlap_weight=weight))
+        return sel.select([w_cached, w_idle], 10, ov, seqs)[0]
+
+    assert pick(1.0) == w_cached  # credit outweighs its decode load
+    assert pick(0.0) == w_idle    # cache ignored: idle worker wins
+    assert pick(3.0) == w_cached
