@@ -61,6 +61,11 @@ def parse_args(argv=None):
                    help="kv-router softmax sampling temperature over "
                         "-cost (0 = deterministic argmin; reference "
                         "--router-temperature)")
+    p.add_argument("--no-kv-events", action="store_true",
+                   help="kv-router approximate mode: skip the worker KV "
+                        "event subscription and predict cache state from "
+                        "routed requests with TTL decay (reference "
+                        "--no-router-kv-events)")
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0,
                    help="scale on the prefix-overlap credit in the "
                         "kv-router cost: >1 cache-greedier (lower TTFT), "
@@ -120,6 +125,7 @@ async def async_main(args) -> None:
         router_service=args.router_service,
         admission_config=admission,
         router_config=router_config,
+        router_kv_events=not args.no_kv_events,
     )
     import os
 

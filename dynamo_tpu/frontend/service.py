@@ -97,6 +97,8 @@ class ModelWatcher:
         admission_config=None,  # router.queue.AdmissionConfig (kv mode)
         router_config=None,  # router.scheduling.KvRouterConfig (kv mode):
         #   temperature / overlap weight / tier credits
+        router_kv_events: bool = True,  # False = approximate mode (no
+        #   worker event subscription; TTL-predicted cache state)
     ):
         self.runtime = runtime
         self.manager = manager
@@ -104,6 +106,7 @@ class ModelWatcher:
         self.router_service = router_service
         self.admission_config = admission_config
         self.router_config = router_config
+        self.router_kv_events = router_kv_events
         self.router_replica_sync = router_replica_sync
         self.migration_limit = migration_limit
         self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
@@ -132,6 +135,7 @@ class ModelWatcher:
             kv_router = KvRouter(
                 self.runtime, client, block_size=card.kv_block_size,
                 config=self.router_config,
+                use_kv_events=self.router_kv_events,
                 replica_sync=self.router_replica_sync,
                 admission=self.admission_config,
             )
