@@ -428,3 +428,37 @@ def test_qwen3_moe_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "qwen3_moe", **kw},
         "tiny-hf-q3moe", check_cfg=check,
     )
+
+
+def test_qwen2_moe_matches_hf_transformers(tmp_path):
+    """Qwen2-MoE fidelity vs transformers: softmax routing WITHOUT top-k
+    renormalization (norm_topk_prob=False — routed output deliberately
+    scaled by sum(top-k probs)), plus the sigmoid-GATED shared expert
+    (ws_gatectl) and qwen2 attention biases."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Qwen2MoeForCausalLM"):
+        pytest.skip("transformers too old for Qwen2Moe")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=24,
+        shared_expert_intermediate_size=40, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(7)
+    model = transformers.Qwen2MoeForCausalLM(
+        transformers.Qwen2MoeConfig(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.is_moe and c.attn_bias and not c.moe_norm_topk
+        assert c.n_shared_experts and c.shared_ffn_dim == 40
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "qwen2_moe", **kw},
+        "tiny-hf-q2moe", check_cfg=check,
+    )
