@@ -5,6 +5,7 @@ per-token latent cache instead of full-head K/V pools.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models.quant import mm
@@ -55,14 +56,27 @@ def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
     k_r = rope(kv[..., None, dc:], safe_pos, c.rope_theta, config=c)[..., 0, :]
     lat = jnp.concatenate([c_kv, k_r], axis=-1)[:, :, None, :]  # [B,S,1,D]
     k_pool = _write_kv(k_pool, l_idx, lat, page_table, positions)
-    lat_pool_l = k_pool[l_idx]
+    quantized = isinstance(k_pool, dict)  # int8 latent cache
+    lat_pool_l = jax.tree.map(lambda a: a[l_idx], k_pool)
 
     wkv_b = lp["wkv_b"].reshape(dc, H, dn + dv)
     w_uk, w_uv = wkv_b[..., :dn], wkv_b[..., dn:]
     q_abs = jnp.einsum("bshn,chn->bshc", q_nope, w_uk)  # [B,S,H,d_c]
     scale = attn_score_scale(c, dn + dr)
     tp = mesh is not None and mesh.shape.get("model", 1) > 1
-    if attn_impl == "pallas" and S > 1 and q_start is not None:
+    if quantized:
+        # int8 latent pages: scores and values dequantize inside the jnp
+        # gather (the Pallas MLA kernels don't carry int8 scales yet).
+        # The value view slices q's leading d_c columns while KEEPING the
+        # per-vector scale — elementwise dequant makes column slicing
+        # scale-exact.
+        qg = jnp.concatenate([q_abs, q_r], axis=-1)[:, :, None, :, :]
+        v_view = {"q": lat_pool_l["q"][..., :dc], "s": lat_pool_l["s"]}
+        attn_lat = paged_attention_jnp(
+            qg, lat_pool_l, v_view, page_table, safe_pos, kv_lens,
+            scale=scale,
+        )[:, :, 0]
+    elif attn_impl == "pallas" and S > 1 and q_start is not None:
         # chunked-prefill hot path: flash MLA over latent pages; on TP
         # meshes the kernel runs per-head-shard under shard_map against
         # the replicated latent pool (zero collectives)

@@ -51,10 +51,21 @@ def make_kv_pool(
     (transfer, tiering, disagg export) keeps its uniform k/v shape
     contract without meaningful memory."""
     if config.is_mla:
-        if kv_quantize is not None:
-            raise ValueError("kv_quantize is not supported with MLA yet")
         lat = (config.n_layers, num_pages, page_size, 1, config.mla_cache_dim)
         stub = (config.n_layers, num_pages, page_size, 1, 1)
+        if kv_quantize == "int8":
+            # int8 latent cache: one f32 scale per (token) latent vector —
+            # halves V3's already-57x-smaller cache again. The Pallas MLA
+            # kernels don't carry int8 yet, so the model falls back to
+            # the jnp gather path for quantized MLA (models/mla.py).
+            return (
+                {"q": jnp.zeros(lat, jnp.int8),
+                 "s": jnp.zeros(lat[:-1], jnp.float32)},
+                {"q": jnp.zeros(stub, jnp.int8),
+                 "s": jnp.zeros(stub[:-1], jnp.float32)},
+            )
+        if kv_quantize is not None:
+            raise ValueError(f"unknown kv_quantize mode {kv_quantize!r}")
         return jnp.zeros(lat, dtype=dtype), jnp.zeros(stub, dtype=dtype)
     shape = (config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim)
     if kv_quantize == "int8":
