@@ -65,17 +65,35 @@ def _mla_attention(c, lp, h, k_pool, l_idx, page_table, positions, safe_pos,
     scale = attn_score_scale(c, dn + dr)
     tp = mesh is not None and mesh.shape.get("model", 1) > 1
     if quantized:
-        # int8 latent pages: scores and values dequantize inside the jnp
-        # gather (the Pallas MLA kernels don't carry int8 scales yet).
-        # The value view slices q's leading d_c columns while KEEPING the
-        # per-vector scale — elementwise dequant makes column slicing
-        # scale-exact.
-        qg = jnp.concatenate([q_abs, q_r], axis=-1)[:, :, None, :, :]
-        v_view = {"q": lat_pool_l["q"][..., :dc], "s": lat_pool_l["s"]}
-        attn_lat = paged_attention_jnp(
-            qg, lat_pool_l, v_view, page_table, safe_pos, kv_lens,
-            scale=scale,
-        )[:, :, 0]
+        # int8 latent pages. Decode can ride the Pallas kernel (scales
+        # fold into scores/values per token) — opt-in via
+        # DYN_MLA_INT8_KERNEL until the hardware parity gate proves the
+        # (PS,) scale tile in compiled Mosaic (same rollout policy as
+        # DYN_KV_COPY_KERNEL). Default, and all prefill, uses the jnp
+        # gather: the value view slices q's leading d_c columns while
+        # KEEPING the per-vector scale — elementwise dequant makes
+        # column slicing scale-exact.
+        import os as _os
+
+        use_kernel = (
+            attn_impl == "pallas" and S == 1 and not tp
+            and _os.environ.get("DYN_MLA_INT8_KERNEL", "").lower()
+            in ("1", "true", "on", "yes")
+        )
+        if use_kernel:
+            from dynamo_tpu.ops.mla_attention import decode_mla_attention
+
+            qd = jnp.concatenate([q_abs, q_r], axis=-1)[:, 0]
+            attn_lat = decode_mla_attention(
+                qd, lat_pool_l, page_table, kv_lens, dc=dc, scale=scale,
+            )[:, None]
+        else:
+            qg = jnp.concatenate([q_abs, q_r], axis=-1)[:, :, None, :, :]
+            v_view = {"q": lat_pool_l["q"][..., :dc], "s": lat_pool_l["s"]}
+            attn_lat = paged_attention_jnp(
+                qg, lat_pool_l, v_view, page_table, safe_pos, kv_lens,
+                scale=scale,
+            )[:, :, 0]
     elif attn_impl == "pallas" and S > 1 and q_start is not None:
         # chunked-prefill hot path: flash MLA over latent pages; on TP
         # meshes the kernel runs per-head-shard under shard_map against

@@ -32,11 +32,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _mla_kernel(
+def _mla_kernel_body(
     page_table_ref,  # [B, MP] int32 (SMEM, scalar-prefetched)
     kv_lens_ref,  # [B] int32 (SMEM)
     q_ref,  # [H, Dl] absorbed+rope query for seq b
     lat_ref,  # [PS, Dl] one latent page (single contiguous DMA)
+    ls_ref,  # [PS] f32 per-token latent scales (int8 pool) or None
     o_ref,  # [H, dc]
     m_ref,  # [H, 1] f32 running max
     l_ref,  # [H, 1] f32 running denom
@@ -67,6 +68,10 @@ def _mla_kernel(
             q, lat, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [H, PS]
+        if ls_ref is not None:
+            # int8 latent: fold the per-token scale into the scores —
+            # one [1, PS] multiply instead of dequantizing over Dl
+            s = s * ls_ref[...][None, :]
         valid = lax.broadcasted_iota(jnp.int32, s.shape, 1) < n_valid
         s = jnp.where(valid, s, NEG_INF)
 
@@ -74,7 +79,11 @@ def _mla_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # [H, PS]
         alpha = jnp.exp(m_prev - m_new)
-        l_add = jnp.sum(p, axis=1, keepdims=True)
+        l_add = jnp.sum(p, axis=1, keepdims=True)  # raw-probability denom
+        if ls_ref is not None:
+            # same scale dequantizes the VALUE side (values are the
+            # latent's first d_c columns of the same vector)
+            p = p * ls_ref[...][None, :]
         pv = lax.dot_general(
             p, lat[:, :dc], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -89,6 +98,14 @@ def _mla_kernel(
         o_ref[...] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
+def _mla_kernel(pt, kl, q, lat, o, m, l, acc, **kw):
+    _mla_kernel_body(pt, kl, q, lat, None, o, m, l, acc, **kw)
+
+
+def _mla_kernel_int8(pt, kl, q, lat, ls, o, m, l, acc, **kw):
+    _mla_kernel_body(pt, kl, q, lat, ls, o, m, l, acc, **kw)
+
+
 @functools.partial(jax.jit, static_argnames=("dc", "scale", "interpret"))
 def decode_mla_attention(
     q: jax.Array,  # [B, H, Dl] absorbed+rope queries
@@ -101,23 +118,37 @@ def decode_mla_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """Returns the attended latents [B, H, dc] (the caller lifts them
-    through W_UV). The current token's latent must already be written."""
+    through W_UV). The current token's latent must already be written.
+    `lat_pool_l` may be the int8 dict ({"q": [NP,PS,1,Dl] int8, "s":
+    [NP,PS,1] f32}) — scales fold into scores/values per token."""
+    quantized = isinstance(lat_pool_l, dict)
+    lq = lat_pool_l["q"] if quantized else lat_pool_l
     B, H, Dl = q.shape
-    NP, PS, _, _ = lat_pool_l.shape
+    NP, PS, _, _ = lq.shape
     MP = page_table.shape[1]
-    lat = lat_pool_l.reshape(NP, PS, Dl)
+    lat = lq.reshape(NP, PS, Dl)
 
     def lat_index(b, i, pt, kl):
         last = jnp.maximum(kl[b] - 1, 0) // PS
         return (pt[b, jnp.minimum(i, last)], 0, 0)
 
+    def scale_index(b, i, pt, kl):
+        return lat_index(b, i, pt, kl)[:2]
+
+    in_specs = [
+        pl.BlockSpec((None, H, Dl), lambda b, i, pt, kl: (b, 0, 0)),
+        pl.BlockSpec((None, PS, Dl), lat_index),
+    ]
+    operands = (q, lat)
+    kernel = _mla_kernel
+    if quantized:
+        in_specs.append(pl.BlockSpec((None, PS), scale_index))
+        operands = operands + (lat_pool_l["s"].reshape(NP, PS),)
+        kernel = _mla_kernel_int8
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, MP),
-        in_specs=[
-            pl.BlockSpec((None, H, Dl), lambda b, i, pt, kl: (b, 0, 0)),
-            pl.BlockSpec((None, PS, Dl), lat_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, H, dc), lambda b, i, pt, kl: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, 1), jnp.float32),
@@ -126,11 +157,11 @@ def decode_mla_attention(
         ],
     )
     return pl.pallas_call(
-        functools.partial(_mla_kernel, page_size=PS, scale=scale, dc=dc),
+        functools.partial(kernel, page_size=PS, scale=scale, dc=dc),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, dc), q.dtype),
         interpret=interpret,
-    )(page_table, kv_lens, q, lat)
+    )(page_table, kv_lens, *operands)
 
 
 def _mla_prefill_kernel(

@@ -324,3 +324,77 @@ async def _generate_async(runner, prompt, n=5):
         return toks
     finally:
         engine.stop()
+
+
+def test_decode_mla_attention_int8_matches_jnp():
+    """int8 MLA decode kernel (per-token scale folds into scores AND
+    values) vs the jnp dict-pool path on the same quantized pool."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.quant import kv_pool_quantize
+    from dynamo_tpu.models.toolkit import paged_attention_jnp
+    from dynamo_tpu.ops.mla_attention import decode_mla_attention
+
+    rng = np.random.default_rng(9)
+    B, H, dc, dr, NP, PS, MP = 3, 4, 32, 16, 16, 4, 4
+    Dl = dc + dr
+    q = jnp.asarray(rng.standard_normal((B, H, Dl)), jnp.float32)
+    lat_dense = jnp.asarray(rng.standard_normal((NP, PS, 1, Dl)), jnp.float32)
+    lat_q = kv_pool_quantize(lat_dense)
+    pt = jnp.asarray(rng.permutation(NP)[: B * MP].reshape(B, MP).astype(np.int32))
+    kv = jnp.asarray([3, 9, 14], jnp.int32)
+    out = decode_mla_attention(
+        q, lat_q, pt, kv, dc=dc, scale=0.13, interpret=True
+    )
+    v_view = {"q": lat_q["q"][..., :dc], "s": lat_q["s"]}
+    ref = paged_attention_jnp(
+        q[:, None, None], lat_q, v_view, pt, (kv - 1)[:, None], kv,
+        scale=0.13,
+    )[:, 0, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mla_int8_kernel_full_layer_matches_jnp(monkeypatch):
+    """Full-layer: quantized MLA decode through the kernel path
+    (DYN_MLA_INT8_KERNEL=1, interpret) == the jnp dict-pool path."""
+    import functools as _ft
+
+    import jax.numpy as jnp
+
+    import dynamo_tpu.ops.mla_attention as mla_ops
+    from dynamo_tpu.models import llama
+
+    c = get_config("tiny-mla")
+    p = llama.init_params(c, jax.random.PRNGKey(0))
+    toks = [5, 9, 2, 7, 1]
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    k1, v1 = llama.make_kv_pool(c, 8, 4, kv_quantize="int8")
+    out, k1, v1 = llama.forward(
+        c, p, jnp.asarray([toks]), jnp.asarray([list(range(5))]),
+        k1, v1, pt, jnp.asarray([5]),
+    )
+    ref, _, _ = llama.forward(
+        c, p, jnp.asarray([[8]]), jnp.asarray([[5]]), k1, v1, pt,
+        jnp.asarray([6]),
+    )
+    monkeypatch.setenv("DYN_MLA_INT8_KERNEL", "1")
+    orig = mla_ops.decode_mla_attention
+    calls = {"n": 0}
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, interpret=True, **kw)
+
+    try:
+        mla_ops.decode_mla_attention = counting
+        got, _, _ = llama.forward(
+            c, p, jnp.asarray([[8]]), jnp.asarray([[5]]), k1, v1, pt,
+            jnp.asarray([6]), attn_impl="pallas",
+        )
+    finally:
+        mla_ops.decode_mla_attention = orig
+    assert calls["n"] > 0, "int8 kernel path never engaged (gate regressed)"
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
