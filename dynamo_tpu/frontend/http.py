@@ -696,7 +696,10 @@ class HttpService:
         try:
             if stream:
                 return await self._stream_response(
-                    request, entry, preprocessed, ctx, rid, model, created, kind, timing
+                    request, entry, preprocessed, ctx, rid, model, created, kind, timing,
+                    include_usage=bool(
+                        (body.get("stream_options") or {}).get("include_usage")
+                    ),
                 )
             return await self._unary_response(
                 entry, preprocessed, ctx, rid, model, created, kind, timing
@@ -727,7 +730,8 @@ class HttpService:
                 ).observe(f["ttft_s"])
 
     async def _stream_response(
-        self, request, entry, preprocessed, ctx, rid, model, created, kind, timing=None
+        self, request, entry, preprocessed, ctx, rid, model, created, kind,
+        timing=None, include_usage=False,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             headers={
@@ -787,12 +791,14 @@ class HttpService:
         lp_hold_ids: list = []
         lp_hold: list = []
         sent_text_len = 0
+        n_out = 0  # stream_options.include_usage final-chunk accounting
         try:
             if kind == "chat":
                 await send(_chat_chunk(rid, model, created, {"role": "assistant"}, None))
             async for item in entry.chain.generate(preprocessed, ctx):
                 text = item.get("text", "")
                 finish = item.get("finish_reason")
+                n_out += len(item.get("token_ids") or [])
                 if timing is not None:
                     timing.on_tokens(len(item.get("token_ids") or []))
                     if finish:
@@ -840,6 +846,21 @@ class HttpService:
                 # generator ended without a finish_reason (drain/migration
                 # edge): the buffered text must still reach the client
                 await flush_tools("stop")
+            if include_usage:
+                # OpenAI stream_options.include_usage: one final chunk
+                # with EMPTY choices carrying the usage totals (the
+                # reference force-includes this, delta_common::
+                # force_include_usage)
+                n_prompt = len(preprocessed["token_ids"])
+                await send({
+                    "id": rid, "object": obj, "created": created,
+                    "model": model, "choices": [],
+                    "usage": {
+                        "prompt_tokens": n_prompt,
+                        "completion_tokens": n_out,
+                        "total_tokens": n_prompt + n_out,
+                    },
+                })
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             ctx.kill()  # client disconnected (reference disconnect.rs)

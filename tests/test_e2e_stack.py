@@ -226,3 +226,57 @@ async def test_openai_batch_api_end_to_end():
         await frt.shutdown()
         await wrt.shutdown(drain_timeout=1)
         engine.stop()
+
+
+async def test_stream_options_include_usage():
+    """OpenAI stream_options.include_usage: the stream ends with one
+    extra chunk carrying usage totals and EMPTY choices, before [DONE]
+    (the reference force-includes this; delta_common)."""
+    import json as _json
+
+    realm = "usage-e2e"
+    runner = ModelRunner(
+        get_config("tiny"), num_pages=64, page_size=4, max_pages_per_seq=16,
+        decode_buckets=(1, 2, 4), prefill_buckets=(8, 16, 32),
+    )
+    engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+    engine.start()
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    card = ModelCard(name="tiny", tokenizer="byte", context_length=64, kv_block_size=4)
+    await wrt.serve_endpoint("dyn/tpu-worker/generate", engine,
+                             metadata={"model_card": card.to_dict()})
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=10)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "ab"}],
+                "max_tokens": 5, "stream": True,
+                "stream_options": {"include_usage": True},
+            }) as r:
+                assert r.status == 200
+                usage = None
+                saw_done = False
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        saw_done = True
+                        break
+                    chunk = _json.loads(data)
+                    if chunk.get("usage") is not None:
+                        assert chunk["choices"] == []
+                        usage = chunk["usage"]
+                assert saw_done and usage is not None
+                assert usage["completion_tokens"] == 5
+                assert usage["total_tokens"] == usage["prompt_tokens"] + 5
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+        engine.stop()
