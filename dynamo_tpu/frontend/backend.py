@@ -54,14 +54,15 @@ class StopChecker:
                 return "length", token_ids[: i + 1]
         return None, token_ids
 
-    def find_stop_string(self, text: str) -> int:
-        """Index in `text` where a stop string starts, or -1."""
-        best = -1
+    def find_stop_string(self, text: str):
+        """(index, matched string) of the earliest stop-string hit in
+        `text`, or (-1, None)."""
+        best, match = -1, None
         for s in self.stop_strings:
             i = text.find(s)
             if i >= 0 and (best < 0 or i < best):
-                best = i
-        return best
+                best, match = i, s
+        return best, match
 
 
 class BackendOperator:
@@ -91,12 +92,16 @@ class BackendOperator:
             pending += delta
 
             if checker.stop_strings:
-                cut = checker.find_stop_string(pending)
+                cut, matched = checker.find_stop_string(pending)
                 if cut >= 0:
                     yield {
                         "text": pending[:cut],
                         "token_ids": emit_ids,
                         "finish_reason": "stop",
+                        # which CLIENT stop string fired — protocols that
+                        # distinguish stop-sequence from eos (Anthropic
+                        # stop_reason) report it truthfully
+                        "stop_sequence": matched,
                         **_passthrough(item),
                     }
                     context.stop_generating()

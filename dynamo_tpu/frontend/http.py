@@ -457,15 +457,23 @@ class HttpService:
             return _error(400, str(e), "invalid_request_error")
 
         ctx = _request_context(request, model)
+        if body.get("stream"):
+            return await self._anthropic_stream(
+                request, entry, preprocessed, ctx, model
+            )
         text_parts: list = []
         finish = None
+        stop_seq = None
         n_out = 0
         try:
             async for item in entry.chain.generate(preprocessed, ctx):
+                if item.get("finish_reason") == "error":
+                    raise RuntimeError(item.get("error") or "engine error")
                 text_parts.append(item.get("text", ""))
                 n_out += len(item.get("token_ids") or [])
                 if item.get("finish_reason"):
                     finish = item["finish_reason"]
+                    stop_seq = item.get("stop_sequence")
                     break
         except Exception as e:
             from dynamo_tpu.frontend.session_affinity import AffinityError
@@ -476,11 +484,7 @@ class HttpService:
             return _error(500, str(e), "api_error")
         finally:
             ctx.stop_generating()
-        stop_reason = {"stop": "stop_sequence", "length": "max_tokens"}.get(
-            finish or "stop", "end_turn"
-        )
-        if finish == "stop":
-            stop_reason = "end_turn"
+        stop_reason, stop_seq = _anthropic_stop(finish, stop_seq)
         return web.json_response(
             {
                 "id": f"msg_{uuid.uuid4().hex[:24]}",
@@ -489,13 +493,86 @@ class HttpService:
                 "model": model,
                 "content": [{"type": "text", "text": "".join(text_parts)}],
                 "stop_reason": stop_reason,
-                "stop_sequence": None,
+                "stop_sequence": stop_seq,
                 "usage": {
                     "input_tokens": len(preprocessed["token_ids"]),
                     "output_tokens": n_out,
                 },
             }
         )
+
+    async def _anthropic_stream(
+        self, request, entry, preprocessed, ctx, model
+    ) -> web.StreamResponse:
+        """Anthropic Messages streaming protocol: named SSE events —
+        message_start (input usage), content_block_start,
+        content_block_delta (text_delta), content_block_stop,
+        message_delta (stop_reason + output usage), message_stop
+        (reference anthropic.rs streaming path)."""
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Request-Id": ctx.id,
+        })
+        await resp.prepare(request)
+
+        async def send(event: str, payload: Dict[str, Any]) -> None:
+            payload = {"type": event, **payload}
+            await resp.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n".encode()
+            )
+
+        mid = f"msg_{uuid.uuid4().hex[:24]}"
+        n_prompt = len(preprocessed["token_ids"])
+        await send("message_start", {"message": {
+            "id": mid, "type": "message", "role": "assistant",
+            "model": model, "content": [], "stop_reason": None,
+            "stop_sequence": None,
+            "usage": {"input_tokens": n_prompt, "output_tokens": 0},
+        }})
+        await send("content_block_start", {
+            "index": 0, "content_block": {"type": "text", "text": ""},
+        })
+        finish = None
+        stop_seq = None
+        n_out = 0
+        try:
+            async for item in entry.chain.generate(preprocessed, ctx):
+                if item.get("finish_reason") == "error":
+                    # a clean end_turn here would present an engine
+                    # failure as a successful empty message
+                    raise RuntimeError(item.get("error") or "engine error")
+                text = item.get("text", "")
+                n_out += len(item.get("token_ids") or [])
+                if text:
+                    await send("content_block_delta", {
+                        "index": 0,
+                        "delta": {"type": "text_delta", "text": text},
+                    })
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+                    stop_seq = item.get("stop_sequence")
+                    break
+            await send("content_block_stop", {"index": 0})
+            stop_reason, stop_seq = _anthropic_stop(finish, stop_seq)
+            await send("message_delta", {
+                "delta": {"stop_reason": stop_reason,
+                          "stop_sequence": stop_seq},
+                "usage": {"output_tokens": n_out},
+            })
+            await send("message_stop", {})
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()
+            raise
+        except Exception as e:
+            log.exception("anthropic stream failed for %s", mid)
+            await send("error", {
+                "error": {"type": "api_error", "message": str(e)},
+            })
+        finally:
+            ctx.stop_generating()
+        await resp.write_eof()
+        return resp
 
     async def anthropic_count_tokens(self, request: web.Request) -> web.Response:
         try:
@@ -1131,6 +1208,18 @@ def _chat_chunk(rid, model, created, delta, finish) -> Dict[str, Any]:
         "model": model,
         "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
     }
+
+
+def _anthropic_stop(finish, stop_seq):
+    """Map the engine finish_reason (+ the backend's matched stop string)
+    to Anthropic (stop_reason, stop_sequence): a CLIENT stop string →
+    ("stop_sequence", the string); eos/natural stop → end_turn;
+    max_tokens → max_tokens."""
+    if stop_seq:
+        return "stop_sequence", stop_seq
+    if finish == "length":
+        return "max_tokens", None
+    return "end_turn", None
 
 
 def _error(status: int, message: str, err_type: str) -> web.Response:
