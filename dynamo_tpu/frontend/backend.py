@@ -33,7 +33,9 @@ class StopChecker:
 
     def __init__(self, stop: Dict[str, Any]):
         self.max_tokens = int(stop.get("max_tokens", 512))
-        self.stop_strings = list(stop.get("stop_strings") or [])
+        # "" would match at index 0 of everything (str.find('') == 0) and
+        # stop generation instantly — drop degenerate entries
+        self.stop_strings = [s for s in (stop.get("stop_strings") or []) if s]
         self.stop_ids = set(stop.get("stop_ids") or [])
         self.min_tokens = int(stop.get("min_tokens", 0))
         self.ignore_eos = bool(stop.get("ignore_eos", False))

@@ -764,11 +764,25 @@ class HttpService:
         rid = f"{'chatcmpl' if kind == 'chat' else 'cmpl'}-{uuid.uuid4().hex[:24]}"
         stream = bool(body.get("stream", False))
         created = int(time.time())
+        try:
+            n_choices = max(1, int(body.get("n") or 1))
+        except (TypeError, ValueError):
+            return _error(400, "n must be an integer", "invalid_request_error")
+        if n_choices > 1 and stream:
+            return _error(
+                400, "streaming with n>1 is not supported",
+                "invalid_request_error",
+            )
+        if n_choices > 16:
+            return _error(400, "n is capped at 16", "invalid_request_error")
 
         from dynamo_tpu.frontend.request_trace import RequestTiming
 
         timing = RequestTiming(ctx.id, model, kind, len(preprocessed["token_ids"]))
-        self.inflight_inc(model)
+        # n concurrent generations charge n units of load, or a client
+        # could drive n x the engine load past the busy_threshold shed
+        for _ in range(n_choices):
+            self.inflight_inc(model)
         m = self.runtime.metrics
         try:
             if stream:
@@ -779,10 +793,12 @@ class HttpService:
                     ),
                 )
             return await self._unary_response(
-                entry, preprocessed, ctx, rid, model, created, kind, timing
+                entry, preprocessed, ctx, rid, model, created, kind, timing,
+                n=n_choices,
             )
         finally:
-            self.inflight_dec(model)
+            for _ in range(n_choices):
+                self.inflight_dec(model)
             if self.tracer.enabled:
                 self.tracer.record(**timing.fields(stream=stream))
             # Prometheus request metrics (reference frontend_perf metrics,
@@ -959,13 +975,66 @@ class HttpService:
         return resp
 
     async def _unary_response(
-        self, entry, preprocessed, ctx, rid, model, created, kind, timing=None
+        self, entry, preprocessed, ctx, rid, model, created, kind,
+        timing=None, n=1,
     ) -> web.Response:
         try:
-            body = await generate_unary_body(
-                entry, preprocessed, ctx, rid, model, created, kind,
-                timing=timing,
-            )
+            if n == 1:
+                body = await generate_unary_body(
+                    entry, preprocessed, ctx, rid, model, created, kind,
+                    timing=timing,
+                )
+            else:
+                # OpenAI n>1: n generations with per-choice derived seeds
+                # (greedy requests legitimately return identical choices)
+                import random as _random
+
+                base_seed = (preprocessed.get("sampling") or {}).get("seed")
+                if base_seed is None:
+                    base_seed = _random.getrandbits(31)
+
+                async def one(i):
+                    req_i = dict(preprocessed)
+                    req_i["sampling"] = dict(preprocessed.get("sampling") or {})
+                    req_i["sampling"]["seed"] = int(base_seed) + i
+                    return await generate_unary_body(
+                        entry, req_i, ctx.child(f"{ctx.id}-c{i}"), rid,
+                        model, created, kind,
+                        timing=timing if i == 0 else None,
+                    )
+
+                tasks = [asyncio.ensure_future(one(i)) for i in range(n)]
+                try:
+                    bodies = await asyncio.gather(*tasks)
+                except BaseException:
+                    # one failed choice must not leave the siblings
+                    # generating to max_tokens on detached tasks
+                    for t in tasks:
+                        t.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    raise
+                body = bodies[0]
+                choices = []
+                completion_tokens = 0
+                for i, b in enumerate(bodies):
+                    c = b["choices"][0]
+                    c["index"] = i
+                    choices.append(c)
+                    completion_tokens += b["usage"]["completion_tokens"]
+                body["choices"] = choices
+                n_prompt = body["usage"]["prompt_tokens"]
+                body["usage"] = {
+                    "prompt_tokens": n_prompt,
+                    "completion_tokens": completion_tokens,
+                    "total_tokens": n_prompt + completion_tokens,
+                }
+                if timing is not None:
+                    # choice 0's tokens were counted live; fold the other
+                    # choices in so the osl metrics see all n generations
+                    timing.on_tokens(
+                        completion_tokens
+                        - bodies[0]["usage"]["completion_tokens"]
+                    )
         except Exception as e:
             from dynamo_tpu.frontend.session_affinity import AffinityError
             from dynamo_tpu.runtime.request_plane import RequestPlaneError
@@ -1215,7 +1284,7 @@ def _anthropic_stop(finish, stop_seq):
     to Anthropic (stop_reason, stop_sequence): a CLIENT stop string →
     ("stop_sequence", the string); eos/natural stop → end_turn;
     max_tokens → max_tokens."""
-    if stop_seq:
+    if stop_seq is not None:
         return "stop_sequence", stop_seq
     if finish == "length":
         return "max_tokens", None

@@ -307,6 +307,9 @@ async def test_anthropic_messages_streaming_protocol():
         async with aiohttp.ClientSession() as s:
             async with s.post(f"{base}/v1/messages", json={
                 "model": "tiny", "max_tokens": 5, "stream": True,
+                "temperature": 0,  # sampled runs can emit only special
+                # ids (empty text) on the tiny random model — greedy is
+                # deterministic and provably produces text here
                 "messages": [{"role": "user", "content": "hey"}],
             }) as r:
                 assert r.status == 200
@@ -348,6 +351,65 @@ async def test_anthropic_messages_streaming_protocol():
                 assert stopped["stop_reason"] == "stop_sequence"
                 assert stopped["stop_sequence"] == stop_char
                 assert stop_char not in stopped["content"][0]["text"]
+    finally:
+        await svc.stop()
+        await frt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+        engine.stop()
+
+
+async def test_n_choices_unary():
+    """OpenAI n>1: n sampled choices with distinct derived seeds, correct
+    per-choice indices, summed usage; streaming with n>1 is a clean 400."""
+    realm = "nchoices-e2e"
+    runner = ModelRunner(
+        get_config("tiny"), num_pages=96, page_size=4, max_pages_per_seq=16,
+        decode_buckets=(1, 2, 4), prefill_buckets=(8, 16, 32),
+    )
+    engine = InferenceEngine(runner, max_batch=4, chunk_size=16)
+    engine.start()
+    wrt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    card = ModelCard(name="tiny", tokenizer="byte", context_length=64, kv_block_size=4)
+    await wrt.serve_endpoint("dyn/tpu-worker/generate", engine,
+                             metadata={"model_card": card.to_dict()})
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    svc = HttpService(frt, port=0)
+    base = await svc.start()
+    await svc.watcher.wait_for_model(timeout=10)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/completions", json={
+                "model": "tiny", "prompt": "hi", "max_tokens": 6,
+                "n": 3, "temperature": 1.0, "seed": 7,
+            }) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+            texts = [c["text"] for c in body["choices"]]
+            # sampled specials can truncate/empty a choice on the tiny
+            # random model, so distinctness and exact token counts are
+            # not guaranteed — usage consistency and indices are
+            assert body["usage"]["completion_tokens"] >= 3
+            assert (body["usage"]["total_tokens"]
+                    == body["usage"]["prompt_tokens"]
+                    + body["usage"]["completion_tokens"])
+            # (note: the engine folds its global step counter into the
+            # sampling keys, so same-seed REPLAY is not bit-reproducible
+            # across requests — the seed's job here is differentiating
+            # the n choices, which the derived per-choice seeds do)
+            # greedy: all n identical (correct, not a bug)
+            async with s.post(f"{base}/v1/completions", json={
+                "model": "tiny", "prompt": "hi", "max_tokens": 4,
+                "n": 2, "temperature": 0,
+            }) as r:
+                g = await r.json()
+            assert g["choices"][0]["text"] == g["choices"][1]["text"]
+            # streaming + n>1: clean 400
+            async with s.post(f"{base}/v1/completions", json={
+                "model": "tiny", "prompt": "hi", "max_tokens": 4,
+                "n": 2, "stream": True,
+            }) as r:
+                assert r.status == 400
     finally:
         await svc.stop()
         await frt.shutdown()
