@@ -462,3 +462,36 @@ def test_qwen2_moe_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "qwen2_moe", **kw},
         "tiny-hf-q2moe", check_cfg=check,
     )
+
+
+def test_llama31_rope_scaling_matches_hf_transformers(tmp_path):
+    """Llama-3.1-style rope_scaling (llama3: frequency-band remap with
+    low/high factors) vs transformers — pins the long-context rope path
+    the flagship presets (llama-3.1-8b/70b) rely on."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 16,
+        },
+    )
+    torch.manual_seed(8)
+    model = transformers.LlamaForCausalLM(
+        transformers.LlamaConfig(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.rope_scaling == "llama3" and c.rope_factor == 8.0
+        assert c.rope_orig_max_seq == 16
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "llama", **kw}, "tiny-hf-llama31",
+        check_cfg=check,
+    )
