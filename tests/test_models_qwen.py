@@ -495,3 +495,42 @@ def test_llama31_rope_scaling_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "llama", **kw}, "tiny-hf-llama31",
         check_cfg=check,
     )
+
+
+def test_deepseek_v3_yarn_qlora_matches_hf_transformers(tmp_path):
+    """DeepSeek yarn rope scaling (NTK-by-parts + mscale) AND the
+    q-compression path (q_lora_rank) vs transformers — the long-context
+    recipe the deepseek-v3 preset ships with."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "DeepseekV3ForCausalLM"):
+        pytest.skip("transformers too old for DeepseekV3")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        kv_lora_rank=16, q_lora_rank=24, qk_rope_head_dim=8,
+        qk_nope_head_dim=16, v_head_dim=16,
+        n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=24,
+        n_shared_experts=1, routed_scaling_factor=2.5,
+        scoring_func="sigmoid", topk_method="noaux_tc", norm_topk_prob=True,
+        n_group=2, topk_group=1, first_k_dense_replace=1,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 16,
+                      "beta_fast": 32, "beta_slow": 1,
+                      "mscale": 1.0, "mscale_all_dim": 1.0},
+    )
+    torch.manual_seed(9)
+    model = transformers.DeepseekV3ForCausalLM(
+        transformers.DeepseekV3Config(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.rope_scaling == "yarn" and c.q_lora_rank == 24
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "deepseek_v3", **kw},
+        "tiny-hf-ds3-yarn", check_cfg=check,
+    )
