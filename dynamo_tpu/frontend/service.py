@@ -50,10 +50,14 @@ class ModelEntry:
     # routing filter reads.)
     prefill_instance_adapters: Dict[int, Set[str]] = field(default_factory=dict)
     prefill_fetch_path: Optional[str] = None  # for late adapter activation
+    prefill_kv_router: Any = None  # KvRouter over the prefill pool (kv mode)
 
     async def close(self) -> None:
         if self.teardown is not None:
             await self.teardown()
+        if self.prefill_kv_router is not None:
+            await self.prefill_kv_router.stop()
+            self.prefill_kv_router = None
         if self.prefill_client is not None:
             await self.prefill_client.close()
         if self.owns_client:
@@ -319,7 +323,10 @@ class ModelWatcher:
             self._restrict_adapter_prefill(entry, aname, aentry)
             if entry.prefill_client is not None and entry.prefill_fetch_path:
                 # adapter arrived after disagg activation: join it now
-                aprefill.activate(entry.prefill_client, entry.prefill_fetch_path)
+                aprefill.activate(
+                    entry.prefill_client, entry.prefill_fetch_path,
+                    kv_router=entry.prefill_kv_router,
+                )
         log.info("adapter %s added (base %s)", aname, card.name)
 
     def _restrict_adapter_prefill(self, entry: ModelEntry, aname: str,
@@ -348,12 +355,34 @@ class ModelWatcher:
                 f"{inst.endpoint_address.component}/kv_fetch"
             )
             entry.prefill_fetch_path = fetch_path
-            entry.prefill_router.activate(entry.prefill_client, fetch_path)
+            if self.router_mode == "kv":
+                # KV-overlap-aware prefill selection: a second KvRouter
+                # over the PREFILL pool (its workers publish KV events
+                # like any other), so repeated prefixes hop to the
+                # replica already holding their blocks
+                from dynamo_tpu.router.kv_router import KvRouter
+
+                entry.prefill_kv_router = KvRouter(
+                    self.runtime, entry.prefill_client,
+                    block_size=card.kv_block_size,
+                    config=self.router_config,
+                    use_kv_events=self.router_kv_events,
+                )
+                # eager start: the per-worker kv_state seeding must not
+                # ride the first request's TTFT
+                await entry.prefill_kv_router.start()
+            entry.prefill_router.activate(
+                entry.prefill_client, fetch_path,
+                kv_router=entry.prefill_kv_router,
+            )
             # adapter entries disaggregate too, sharing the prefill client
             for aname in entry.adapter_names:
                 aentry = self.manager.models.get(aname)
                 if aentry is not None and aentry.prefill_router is not None:
-                    aentry.prefill_router.activate(entry.prefill_client, fetch_path)
+                    aentry.prefill_router.activate(
+                        entry.prefill_client, fetch_path,
+                        kv_router=entry.prefill_kv_router,
+                    )
         entry.prefill_instance_ids.add(inst.instance_id)
         entry.prefill_instance_adapters[inst.instance_id] = set(card.adapters or [])
         for aname in entry.adapter_names:
@@ -378,6 +407,9 @@ class ModelWatcher:
                     aentry = self.manager.models.get(aname)
                     if aentry is not None and aentry.prefill_router is not None:
                         aentry.prefill_router.deactivate()
+                if entry.prefill_kv_router is not None:
+                    await entry.prefill_kv_router.stop()
+                    entry.prefill_kv_router = None
                 if entry.prefill_client is not None:
                     await entry.prefill_client.close()
                     entry.prefill_client = None

@@ -84,6 +84,7 @@ class PrefillRouter:
         # worker, which does hold it, prefills locally) instead of landing
         # on a prefill worker that would error "unknown adapter".
         self.allowed_prefill = None
+        self._kv_router = None  # set by activate(kv_router=...)
 
     def restrict_prefill(self, instance_ids) -> None:
         self.allowed_prefill = (
@@ -91,14 +92,23 @@ class PrefillRouter:
         )
 
     # -- lifecycle (reference activation.rs) --------------------------------
-    def activate(self, prefill_client, fetch_path: str) -> None:
+    def activate(self, prefill_client, fetch_path: str,
+                 kv_router=None) -> None:
+        """`kv_router`: optional KvRouter over the PREFILL pool — hops
+        then route by prefix-overlap cost instead of round-robin, so
+        repeated prefixes land on the prefill replica already holding
+        their blocks (prefill-side cache hits cut TTFT exactly like
+        decode-side ones)."""
         self._prefill_client = prefill_client
         self._fetch_path = fetch_path
-        log.info("prefill router ACTIVE (fetch path %s)", fetch_path)
+        self._kv_router = kv_router
+        log.info("prefill router ACTIVE (fetch path %s, %s selection)",
+                 fetch_path, "kv-overlap" if kv_router else "round-robin")
 
     def deactivate(self) -> None:
         self._prefill_client = None
         self._fetch_path = None
+        self._kv_router = None
         log.info("prefill router inactive (no prefill workers)")
 
     @property
@@ -186,9 +196,32 @@ class PrefillRouter:
             pmeta["traceparent"] = context.metadata["traceparent"]
         pctx = Context(request_id=context.id + ":prefill", parent=context,
                        metadata=pmeta)
+        kv = self._kv_router
+        rid = None
+        iid = None
         try:
             client = self._prefill_client
-            iid, _ = client.router._pick(allowed=self.allowed_prefill)
+            if kv is not None:
+                await kv.start()  # idempotent; watcher starts it eagerly
+                mm_seed = None
+                if request.get("mm"):
+                    # hash lineage must match what the workers publish
+                    # (same seeding as the decode-side KvPushRouter) or
+                    # multimodal prefixes never score overlap
+                    from dynamo_tpu.tokens.hashing import mm_content_seed
+
+                    mm_seed = mm_content_seed(request["mm"]["data"])
+                worker, overlap, hashes = kv.find_best_match(
+                    request.get("token_ids") or [],
+                    adapter=request.get("adapter"),
+                    mm_seed=mm_seed,
+                    allowed_instances=self.allowed_prefill,
+                )
+                iid = worker[0]
+                rid = pctx.id
+                kv.add_request(rid, worker, hashes, overlap)
+            else:
+                iid, _ = client.router._pick(allowed=self.allowed_prefill)
             inst = client.instances.get(iid)
             async for item in client.direct(preq, iid, pctx):
                 kt = item.get("kv_transfer")
@@ -203,5 +236,23 @@ class PrefillRouter:
             log.warning("prefill hop returned no kv_transfer; falling back")
             return None
         except RequestPlaneError as e:
+            if (kv is not None and iid is not None
+                    and e.code in ("cannot_connect", "disconnected")):
+                # cool the dead prefill replica so the next hop's cost
+                # selection avoids it (same contract as the decode side)
+                try:
+                    client.router.mark_sick(iid)
+                except Exception:
+                    pass
             log.warning("prefill hop failed (%s); falling back to aggregated", e.code)
             return None
+        except RuntimeError as e:
+            # e.g. the KV selector's empty-worker-list error when the last
+            # prefill instance deregisters mid-race — the hop's contract
+            # is ALWAYS fall back to aggregated, matching the
+            # RequestPlaneError path the round-robin picker raised
+            log.warning("prefill hop failed (%s); falling back to aggregated", e)
+            return None
+        finally:
+            if kv is not None and rid is not None:
+                kv.free(rid)

@@ -403,3 +403,77 @@ async def test_disagg_page_geometry_mismatch_recomputes():
         for w, rt in ((w_d, rt_d), (w_p, rt_p)):
             await w.stop()
             await rt.shutdown(drain_timeout=1)
+
+
+async def test_prefill_kv_overlap_routing():
+    """KV-overlap-aware prefill selection (kv router mode): with TWO
+    prefill workers, a repeated prefix must hop to the replica already
+    holding its blocks instead of round-robining — measured by each
+    prefill engine's processed work (fpm history)."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    realm = "disagg-kvpick"
+    rts = []
+    engines = {}
+    rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    args = mock_args(["--speed", "0", "--page-size", "4"])
+    engine, card = build_mock_engine(args)
+    w = await serve_worker(rt, engine, card, component="decode", disagg_role="decode")
+    rts.append((rt, w))
+    for i in range(2):
+        prt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+        pargs = mock_args(["--speed", "0", "--page-size", "4"])
+        pengine, pcard = build_mock_engine(pargs)
+        pw = await serve_worker(prt, pengine, pcard, component="prefill",
+                                disagg_role="prefill")
+        engines[pw.instance.instance_id] = pengine
+        rts.append((prt, pw))
+
+    frt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
+    manager = ModelManager()
+    watcher = ModelWatcher(frt, manager, router_mode="kv",
+                           disagg_min_prefill_tokens=8)
+    await watcher.start()
+    try:
+        await watcher.wait_for_model(timeout=10)
+        entry = manager.get("mock-model")
+        for _ in range(200):
+            if (entry.prefill_router is not None and entry.prefill_router.active
+                    and len(entry.prefill_instance_ids) == 2):
+                break
+            await asyncio.sleep(0.05)
+        assert entry.prefill_kv_router is not None, "kv prefill pick not wired"
+
+        async def one(prompt):
+            req = entry.preprocessor.preprocess_completions(
+                {"model": "mock-model", "prompt": prompt, "max_tokens": 3,
+                 "temperature": 0.0})
+            async for item in entry.chain.generate(req, Context()):
+                if item.get("finish_reason"):
+                    assert item["finish_reason"] != "error", item
+                    break
+
+        # warm: first long-prefix request lands somewhere; repeats of the
+        # SAME prefix must all land on that same (warm) prefill replica
+        prefix = "z" * 32
+        await one(prefix + "a")
+        counts0 = {iid: len(e.fpm_history) for iid, e in engines.items()}
+        warm = max(engines, key=lambda i: len(engines[i].fpm_history))
+        assert counts0[warm] > 0, "first request never reached a prefill replica"
+        for i in range(4):
+            await one(prefix + "bcde"[i])
+        counts1 = {iid: len(e.fpm_history) for iid, e in engines.items()}
+        cold = next(i for i in engines if i != warm)
+        assert counts1[warm] > counts0[warm], "warm replica got no repeats"
+        assert counts1[cold] == counts0[cold], (
+            "repeated prefix round-robined onto the cold prefill replica"
+        )
+    finally:
+        await watcher.stop()
+        await frt.shutdown()
+        for rt_, w_ in rts:
+            try:
+                await w_.stop()
+                await rt_.shutdown(drain_timeout=1)
+            except Exception:
+                pass
