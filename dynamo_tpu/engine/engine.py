@@ -323,7 +323,24 @@ class InferenceEngine:
             kv_import=request.get("kv_import"),
             adapter=request.get("adapter"),
             guided=request.get("guided"),
+            logit_bias=request.get("logit_bias"),
         )
+        if seq.logit_bias and (
+            getattr(self.runner, "has_draft", False)
+            or getattr(self.runner, "pp", False)
+            or not getattr(self.runner, "supports_logit_bias", False)
+        ):
+            # spec-decode verify can't honor a biased target distribution,
+            # the PP loop has no bias operand, and sim runners have no
+            # bias plumbing — reject up front rather than silently sample
+            # the unbiased distribution (a dropped ban is a safety bug)
+            yield {
+                "finish_reason": "error",
+                "error": "logit_bias is unsupported on this worker",
+                "token_ids": [],
+            }
+            self._streams.pop(rid, None)
+            return
         if seq.guided and getattr(self.runner, "has_draft", False):
             # speculative verify can't honor per-token masks; silently
             # dropping the constraint would hand back schema-invalid output
@@ -952,6 +969,11 @@ class InferenceEngine:
         seq = plan.seq
         if not plan.is_last_chunk:
             return
+        bias1 = None
+        if seq.logit_bias:
+            rows = _batch_biases([seq], self.runner)
+            if rows is not None:
+                bias1 = rows[0]
         first_lp = None
         mask1 = self._guided_mask(seq)
         n_lp1 = _batch_logprobs([seq])
@@ -959,6 +981,8 @@ class InferenceEngine:
             self.runner, "sample_one_ex"
         ):
             kw1 = {"mask": mask1} if mask1 is not None else {}
+            if bias1 is not None:
+                kw1["bias"] = bias1
             token, first_lp = self.runner.sample_one_ex(
                 logits, _sampling_params([seq]), self._next_step(),
                 history=list(seq.tokens) if _batch_penalties([seq]) else None,
@@ -966,6 +990,8 @@ class InferenceEngine:
             )
         else:
             kw1 = {"mask": mask1} if mask1 is not None else {}
+            if bias1 is not None:
+                kw1["bias"] = bias1
             token = self.runner.sample_one(
                 logits, _sampling_params([seq]), self._next_step(), **kw1,
             )
@@ -1023,6 +1049,8 @@ class InferenceEngine:
             return False  # per-step masks need the T=1 masked path
         if _batch_logprobs(seqs) >= 0 or _batch_penalties(seqs):
             return False
+        if any(s.logit_bias for s in seqs) or plan.prefill.seq.logit_bias:
+            return False  # the fused program has no bias operand
         pplan = plan.prefill
         if self._mm_chunk(pplan.seq, pplan.start_pos, len(pplan.chunk)) is not None:
             return False  # multimodal chunks ride the standalone prefill
@@ -1140,6 +1168,7 @@ class InferenceEngine:
             for i, s in enumerate(seqs):
                 if s.guided_m is not None:
                     masks[i] = self._guided_mask(s)
+        biases = _batch_biases(seqs, self.runner)
         self._step_counter += T
         n_lp = _batch_logprobs(seqs)
         histories = (
@@ -1166,6 +1195,8 @@ class InferenceEngine:
             self.runner, "decode_multi_ex"
         ):
             mkw = {"masks": masks} if masks is not None else {}
+            if biases is not None:
+                mkw["biases"] = biases
             sampled, lp = self.runner.decode_multi_ex(
                 T, tokens, positions, page_tables, _sampling_params(seqs), step0,
                 adapters=[s.adapter_idx for s in seqs],
@@ -1175,6 +1206,8 @@ class InferenceEngine:
             )
         else:
             mkw = {"masks": masks} if masks is not None else {}
+            if biases is not None:
+                mkw["biases"] = biases
             sampled = self.runner.decode_multi(
                 T, tokens, positions, page_tables, _sampling_params(seqs), step0,
                 adapters=[s.adapter_idx for s in seqs],
@@ -1443,6 +1476,33 @@ def _sampling_params(seqs: List[Sequence]) -> Dict[str, list]:
         "freq": [float(s.sampling.get("frequency_penalty", 0.0)) for s in seqs],
         "presence": [float(s.sampling.get("presence_penalty", 0.0)) for s in seqs],
     }
+
+
+def _batch_biases(seqs: List[Sequence], runner):
+    """[n, V] f32 additive logit-bias rows for the batch, or None when no
+    sequence carries one (out-of-range token ids are ignored — the
+    preprocessor validates, but the wire is untrusted). The vocab lookup
+    happens only when a bias exists: sim runners expose vocab_size
+    directly and have no .config."""
+    if not any(s.logit_bias for s in seqs):
+        return None
+    vocab_size = getattr(
+        getattr(runner, "config", None), "vocab_size", None
+    ) or getattr(runner, "vocab_size")
+    rows = np.zeros((len(seqs), vocab_size), np.float32)
+    for i, s in enumerate(seqs):
+        if not s.logit_bias:
+            continue
+        cached = getattr(s, "_bias_row", None)
+        if cached is None or cached.shape[0] != vocab_size:
+            cached = np.zeros(vocab_size, np.float32)
+            for tok, b in s.logit_bias:
+                t = int(tok)
+                if 0 <= t < vocab_size:
+                    cached[t] = float(b)
+            s._bias_row = cached  # constant for the sequence's lifetime
+        rows[i] = cached
+    return rows
 
 
 def _batch_penalties(seqs: List[Sequence]) -> bool:

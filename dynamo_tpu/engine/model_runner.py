@@ -49,6 +49,8 @@ def _decode_loop(
     # vocab_size — builds the on-device count table the penalties read
     mask,  # None or bool [B, V] guided-decoding sampling mask (constrained
     # dispatches run n_steps=1, so one mask covers the whole loop)
+    bias,  # None or f32 [B, V] additive logit bias (OpenAI logit_bias;
+    # constant per request, so it rides full fused loops unlike masks)
     k_pool,
     v_pool,
     sampling: SamplingParams,
@@ -110,7 +112,7 @@ def _decode_loop(
             from dynamo_tpu.engine.sampling import apply_penalties
 
             l = apply_penalties(raw, cnt, cnt_out, sampling)
-        s = sample(l, sampling, step0 + t, mask=mask)
+        s = sample(l, sampling, step0 + t, mask=mask, bias=bias)
         outs = (s,)
         if n_logprobs >= 0:
             from dynamo_tpu.engine.sampling import top_logprobs
@@ -172,7 +174,7 @@ def _mixed_loop(
     )
     toks, last, _, k_pool, v_pool = _decode_loop(
         config, attn_impl, mesh, n_steps, -1, params, tokens0, packed,
-        None, None, k_pool, v_pool, sampling, lora,
+        None, None, None, k_pool, v_pool, sampling, lora,
     )
     return toks, last, logits[0, 0], k_pool, v_pool
 
@@ -287,6 +289,8 @@ def _next_bucket(buckets: Sequence[int], n: int) -> int:
 
 
 class ModelRunner:
+    supports_logit_bias = True  # engine gates biased requests on this
+
     def __init__(
         self,
         config: ModelConfig,
@@ -471,7 +475,7 @@ class ModelRunner:
         self._jit_decode_loop = jax.jit(
             partial(_decode_loop, self.config, self.attn_impl, self._fwd_mesh),
             static_argnums=(0, 1),  # n_steps, n_logprobs
-            donate_argnums=(7, 8),  # k_pool, v_pool
+            donate_argnums=(8, 9),  # k_pool, v_pool
         )
         if self.pp:
             from dynamo_tpu.parallel.mesh import AXIS_PIPE
@@ -615,13 +619,14 @@ class ModelRunner:
         step: int,
         adapters: Optional[List[int]] = None,
         masks: Optional[np.ndarray] = None,
+        biases: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         """n_steps fused decode iterations (one host sync total). Page
         tables must already cover positions[i] + n_steps slots. Returns
         sampled tokens [B_bucket, n_steps]."""
         toks, _ = self.decode_multi_async(
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
-            masks=masks,
+            masks=masks, biases=biases,
         )
         return np.asarray(jax.device_get(toks))
 
@@ -638,6 +643,7 @@ class ModelRunner:
         histories: Optional[List[List[int]]] = None,
         prompt_lens: Optional[List[int]] = None,
         masks: Optional[np.ndarray] = None,
+        biases: Optional[np.ndarray] = None,
     ):
         """decode_multi with the sampling extras: `histories` (per-sequence
         prompt+generated token ids) switches on repetition/frequency/
@@ -649,7 +655,7 @@ class ModelRunner:
         out = self.decode_multi_async(
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
             n_logprobs=n_logprobs, histories=histories, prompt_lens=prompt_lens,
-            masks=masks,
+            masks=masks, biases=biases,
         )
         if n_logprobs >= 0:
             toks, _, lp = out
@@ -671,6 +677,7 @@ class ModelRunner:
         histories: Optional[List[List[int]]] = None,
         prompt_lens: Optional[List[int]] = None,
         masks: Optional[np.ndarray] = None,  # [n, V] bool guided masks
+        biases: Optional[np.ndarray] = None,  # [n, V] f32 logit_bias rows
     ):
         """decode_multi without the host sync: returns (toks, last) DEVICE
         arrays — toks [B_bucket, n_steps] and last [B_bucket] (the final
@@ -730,9 +737,9 @@ class ModelRunner:
             mask_dev = jnp.asarray(m)
 
         if self.pp:
-            if n_logprobs >= 0 or hist is not None:
+            if n_logprobs >= 0 or hist is not None or biases is not None:
                 raise NotImplementedError(
-                    "logprobs/penalties are not wired on the "
+                    "logprobs/penalties/logit_bias are not wired on the "
                     "pipeline-parallel decode path yet"
                 )
             toks, last, self.k_pool, self.v_pool = self._jit_pp_decode(
@@ -742,9 +749,15 @@ class ModelRunner:
             )
             return toks, last
 
+        bias_dev = None
+        if biases is not None:
+            bz = np.zeros((B, self.config.vocab_size), np.float32)
+            bz[: biases.shape[0]] = biases  # pad rows stay unbiased
+            bias_dev = jnp.asarray(bz)
+
         toks, last, lp, self.k_pool, self.v_pool = self._jit_decode_loop(
             n_steps, n_logprobs, self.params, tok, jnp.asarray(packed), hist,
-            mask_dev, self.k_pool, self.v_pool,
+            mask_dev, bias_dev, self.k_pool, self.v_pool,
             self._device_sampling(sampling, B), self.lora,
         )
         if n_logprobs >= 0:
@@ -954,10 +967,12 @@ class ModelRunner:
         )
 
     def sample_one(self, logits: jax.Array, sampling, step: int,
-                   mask: Optional[np.ndarray] = None) -> int:
+                   mask: Optional[np.ndarray] = None,
+                   bias: Optional[np.ndarray] = None) -> int:
         out = self._jit_sample(
             logits[None, :], _as_sampling(sampling), jnp.int32(step),
             mask=jnp.asarray(mask[None, :]) if mask is not None else None,
+            bias=jnp.asarray(bias[None, :]) if bias is not None else None,
         )
         return int(jax.device_get(out)[0])
 
@@ -969,6 +984,7 @@ class ModelRunner:
         history: Optional[List[int]] = None,
         n_logprobs: int = -1,
         mask: Optional[np.ndarray] = None,
+        bias: Optional[np.ndarray] = None,
     ):
         """sample_one with penalties (over `history` token ids) and/or a
         logprob report. Returns (token, lp) where lp is None or
@@ -987,6 +1003,7 @@ class ModelRunner:
         out = self._jit_sample_one_ex(
             n_logprobs, logits, hist, _as_sampling(sampling), jnp.int32(step),
             jnp.asarray(mask[None, :]) if mask is not None else None,
+            jnp.asarray(bias[None, :]) if bias is not None else None,
         )
         out = jax.device_get(out)
         tok = int(out[0][0])
@@ -1183,7 +1200,7 @@ class ModelRunner:
 
 
 def _sample_one_ex(vocab_size: int, n_logprobs: int, logits, hist, sampling,
-                   step, mask=None):
+                   step, mask=None, bias=None):
     """Single-position sampling with optional penalties + logprob report
     (the prefill-first-token path of the decode loop's extras). `hist`
     here is the PROMPT only — nothing has been generated yet, so the
@@ -1198,7 +1215,7 @@ def _sample_one_ex(vocab_size: int, n_logprobs: int, logits, hist, sampling,
             1.0, mode="drop"
         )
         l = apply_penalties(raw, counts, jnp.zeros_like(counts), sampling)
-    s = sample(l, sampling, step, mask=mask)
+    s = sample(l, sampling, step, mask=mask, bias=bias)
     if n_logprobs >= 0:
         return (s,) + top_logprobs(raw, s, n_logprobs)
     return (s,)

@@ -135,12 +135,17 @@ def top_logprobs(logits: jax.Array, sampled: jax.Array, k: int):
 
 
 def sample(
-    logits: jax.Array, params: SamplingParams, step: jax.Array, mask=None
+    logits: jax.Array, params: SamplingParams, step: jax.Array, mask=None,
+    bias=None,
 ) -> jax.Array:
     """logits [B, V] f32 → token ids [B] i32. `step` folds the decode step
     index into each sequence's key so repeated calls draw fresh samples.
     `mask` [B, V] bool (guided decoding) bans False tokens outright; the
-    caller guarantees every live row keeps at least one allowed token."""
+    caller guarantees every live row keeps at least one allowed token.
+    `bias` [B, V] f32 (OpenAI logit_bias) adds to the logits before
+    filtering — ±100 effectively forces/bans per the OpenAI contract."""
+    if bias is not None:
+        logits = logits + bias
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     idx, scaled = _filtered_scaled(logits, params)

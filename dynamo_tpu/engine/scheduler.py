@@ -57,6 +57,7 @@ class Sequence:
     kv_import: Any = None  # opaque page payload for disagg-decode admission
     adapter: Optional[str] = None  # LoRA adapter name (None = base model)
     adapter_idx: int = 0  # resolved slot (engine sets at admission)
+    logit_bias: Any = None  # [[token_id, bias], ...] (OpenAI logit_bias)
     # multimodal: embeddings for image-placeholder positions (np [n, E]),
     # their absolute prompt positions, and a content hash for KV isolation
     mm_embeds: Any = None

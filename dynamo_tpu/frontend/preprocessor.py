@@ -241,6 +241,7 @@ class Preprocessor:
             model=req.get("model", self.card.name),
             token_ids=ids,
             sampling=self._sampling(req),
+            logit_bias=self._logit_bias(req),
             stop=self._stop(req, len(ids)),
             annotations=annotations,
             adapter=self.adapter,
@@ -261,11 +262,36 @@ class Preprocessor:
             model=req.get("model", self.card.name),
             token_ids=ids,
             sampling=self._sampling(req),
+            logit_bias=self._logit_bias(req),
             stop=self._stop(req, len(ids)),
             annotations={"kind": "completions"},
             adapter=self.adapter,
             guided=self._guided(req, None),
         )
+
+    def _logit_bias(self, req: Dict[str, Any]):
+        """OpenAI logit_bias {token_id_str: bias} → [[id, bias], ...].
+        Validates ids against the vocab and clamps biases to ±100 (the
+        documented effective ban/force range)."""
+        lb = req.get("logit_bias")
+        if not lb:
+            return None
+        if not isinstance(lb, dict):
+            raise ValueError("logit_bias must be an object of token_id -> bias")
+        if len(lb) > 300:  # OpenAI caps the map size
+            raise ValueError("logit_bias supports at most 300 entries")
+        out = []
+        vocab = self.tokenizer.vocab_size or (1 << 30)
+        for k, v in lb.items():
+            try:
+                tok = int(k)
+                b = float(v)
+            except (TypeError, ValueError):
+                raise ValueError(f"invalid logit_bias entry {k!r}: {v!r}")
+            if not 0 <= tok < vocab:
+                raise ValueError(f"logit_bias token id {tok} out of vocab")
+            out.append([tok, max(-100.0, min(100.0, b))])
+        return out
 
     def _check_context(self, prompt_len: int) -> None:
         if prompt_len >= self.card.context_length:

@@ -67,6 +67,7 @@ def make_preprocessed_request(
     annotations: Optional[Dict[str, Any]] = None,
     adapter: Optional[str] = None,
     guided: Optional[Dict[str, Any]] = None,
+    logit_bias: Optional[List] = None,
 ) -> Dict[str, Any]:
     out = {
         "model": model,
@@ -77,6 +78,10 @@ def make_preprocessed_request(
     }
     if adapter:
         out["adapter"] = adapter
+    if logit_bias:
+        # [[token_id, bias], ...] — additive sampling bias (OpenAI
+        # logit_bias); the engine builds the [B, V] operand from it
+        out["logit_bias"] = logit_bias
     if guided:
         # constraint spec for the worker's guided-decoding hook
         # (dynamo_tpu/guided/): {"kind": "regex"|"structural", ...}
