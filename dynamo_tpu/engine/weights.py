@@ -128,15 +128,32 @@ def load_hf_checkpoint(
         layers["q_norm"] = stack_f32("model.layers.{i}.self_attn.q_norm.weight")
         layers["k_norm"] = stack_f32("model.layers.{i}.self_attn.k_norm.weight")
     if config.is_moe:
-        layers["w_router"] = stack("model.layers.{i}.mlp.gate.weight", True)
+        # two MoE tensor layouts in the wild: qwen/deepseek
+        # (mlp.gate + mlp.experts.N.{gate,up,down}_proj) and Mixtral
+        # (block_sparse_moe.gate + experts.N.{w1,w3,w2} where w1=gate,
+        # w3=up, w2=down)
+        mixtral = (
+            "model.layers.0.block_sparse_moe.gate.weight" in tensors
+        )
+        moe_base = "block_sparse_moe" if mixtral else "mlp"
+        part_names = (
+            {"gate_proj": "w1", "up_proj": "w3", "down_proj": "w2"}
+            if mixtral else
+            {"gate_proj": "gate_proj", "up_proj": "up_proj",
+             "down_proj": "down_proj"}
+        )
+        layers["w_router"] = stack(
+            "model.layers.{i}." + moe_base + ".gate.weight", True
+        )
 
         def stack_experts(part: str) -> np.ndarray:
+            p = part_names[part]
             return np.stack(
                 [
                     np.stack(
                         [
                             get(
-                                f"model.layers.{i}.mlp.experts.{e}.{part}.weight",
+                                f"model.layers.{i}.{moe_base}.experts.{e}.{p}.weight",
                                 transpose=True,
                             )
                             for e in range(config.n_experts)
@@ -341,7 +358,8 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
             moe_routed_scale=float(cfg.get("routed_scaling_factor") or 1.0),
             n_dense_layers=int(cfg.get("first_k_dense_replace") or 0),
         )
-    n_experts = int(cfg.get("num_experts") or cfg.get("n_routed_experts") or 0)
+    n_experts = int(cfg.get("num_experts") or cfg.get("n_routed_experts")
+                    or cfg.get("num_local_experts") or 0)  # mixtral naming
     gemma = mt == "gemma2"
     gemma3 = mt.startswith("gemma3")
     gemma_kw = {}
@@ -413,7 +431,16 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
         head_dim_override=int(cfg.get("head_dim") or 0),
         n_experts=n_experts,
         n_experts_active=int(cfg.get("num_experts_per_tok") or 0),
-        moe_ffn_dim=int(cfg.get("moe_intermediate_size") or 0),
+        # mixtral has no separate moe_intermediate_size: its experts use
+        # the dense intermediate width. The fallback is gated on the
+        # MODEL TYPE, not n_experts — a qwen-family MoE config that
+        # diff-omits moe_intermediate_size must keep failing loudly on
+        # wrong shapes, not silently adopt the dense width
+        moe_ffn_dim=int(
+            cfg.get("moe_intermediate_size")
+            or (cfg.get("intermediate_size") if mt == "mixtral" else 0)
+            or 0
+        ),
         n_shared_experts=int(
             cfg.get("n_shared_experts")
             or (1 if cfg.get("shared_expert_intermediate_size") else 0)

@@ -534,3 +534,34 @@ def test_deepseek_v3_yarn_qlora_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "deepseek_v3", **kw},
         "tiny-hf-ds3-yarn", check_cfg=check,
     )
+
+
+def test_mixtral_matches_hf_transformers(tmp_path):
+    """Mixtral fidelity vs transformers: the block_sparse_moe tensor
+    layout (gate + experts.N.{w1,w3,w2}), num_local_experts naming, and
+    renormalized softmax top-k routing."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "MixtralForCausalLM"):
+        pytest.skip("transformers too old for Mixtral")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(11)
+    model = transformers.MixtralForCausalLM(
+        transformers.MixtralConfig(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.is_moe and c.n_experts == 4 and c.moe_ffn_dim == 48
+        assert c.moe_norm_topk
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "mixtral", **kw}, "tiny-hf-mixtral",
+        check_cfg=check,
+    )
