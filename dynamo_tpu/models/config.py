@@ -322,6 +322,24 @@ PRESETS: Dict[str, ModelConfig] = {
         rope_mscale=1.0,
         rope_mscale_all_dim=1.0,
     ),
+    # Mixtral 8x7B (classic sparse-MoE family; block_sparse_moe layout)
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        norm_eps=1e-5,
+        n_experts=8,
+        n_experts_active=2,
+        moe_ffn_dim=14336,
+        moe_scoring="softmax",
+        moe_norm_topk=True,
+    ),
     # Gemma 2 9B (fourth architecture family)
     "gemma-2-9b": ModelConfig(
         name="gemma-2-9b",
