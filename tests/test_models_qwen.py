@@ -565,3 +565,29 @@ def test_mixtral_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "mixtral", **kw}, "tiny-hf-mixtral",
         check_cfg=check,
     )
+
+
+def test_mixtral_flagship_preset_serves_shrunk():
+    """The mixtral-8x7b preset (vocab 32000, 8 experts top-2, theta 1e6)
+    drives a real forward when shrunk to CI size — guards the preset's
+    field combination (softmax scoring + renormalized top-k + no shared
+    experts) against drift from the family the HF gate pins."""
+    c = get_config("mixtral-8x7b")
+    assert c.n_experts == 8 and c.n_experts_active == 2
+    assert c.moe_scoring == "softmax" and c.moe_norm_topk
+    assert c.rope_theta == 1000000.0 and not c.n_shared_experts
+
+    c = c.with_(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                n_kv_heads=2, ffn_dim=48, moe_ffn_dim=48,
+                n_experts=4, max_seq_len=64)
+    params = llama.init_params(c, jax.random.PRNGKey(0))
+    k_pool, v_pool = llama.make_kv_pool(c, num_pages=4, page_size=16)
+    tokens = jnp.arange(8, dtype=jnp.int32)[None, :]
+    positions = jnp.arange(8, dtype=jnp.int32)[None, :]
+    logits, _, _ = llama.forward(
+        c, params, tokens, positions, k_pool, v_pool,
+        jnp.arange(4, dtype=jnp.int32)[None, :],
+        jnp.array([8], dtype=jnp.int32),
+    )
+    assert logits.shape == (1, 8, 64)
+    assert bool(jnp.isfinite(logits).all())
