@@ -363,6 +363,16 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
     gemma = mt == "gemma2"
     gemma3 = mt.startswith("gemma3")
     gemma_kw = {}
+    if mt in ("mistral", "mixtral") and cfg.get("sliding_window"):
+        # Mistral-family sliding window applies to EVERY layer (HF
+        # masks q-k >= sliding_window on all of them — no alternation).
+        # Expressed in the generalized schedule as period 1 with an
+        # unreachable global residue: (l % 1) == 1 is never true.
+        gemma_kw = dict(
+            sliding_window=int(cfg["sliding_window"]),
+            sw_period=1,
+            sw_global_residue=1,
+        )
     if gemma or gemma3:
         gemma_kw = dict(
             act="gelu_tanh",

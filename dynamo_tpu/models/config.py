@@ -322,6 +322,23 @@ PRESETS: Dict[str, ModelConfig] = {
         rope_mscale=1.0,
         rope_mscale_all_dim=1.0,
     ),
+    # Mistral 7B v0.1 (every-layer sliding window via the period-1
+    # schedule: (l % 1) == 1 never holds, so no layer is global)
+    "mistral-7b": ModelConfig(
+        name="mistral-7b",
+        vocab_size=32000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        max_seq_len=32768,
+        rope_theta=10000.0,
+        norm_eps=1e-5,
+        sliding_window=4096,
+        sw_period=1,
+        sw_global_residue=1,
+    ),
     # Mixtral 8x7B (classic sparse-MoE family; block_sparse_moe layout)
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

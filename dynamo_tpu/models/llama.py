@@ -345,6 +345,16 @@ def forward(
             c.attn_logit_softcap > 0 or c.sliding_window > 0
             or c.query_pre_attn_scalar > 0
         )
+        if gemma_attn and attn_impl == "ring":
+            # the ring kernel has no window/softcap operands: falling
+            # through to the dense jnp path would silently replace the
+            # seq-sharded prefill with a replicated gather (huge slowdown
+            # or OOM on exactly the long prompts SP exists for)
+            raise NotImplementedError(
+                "sequence-parallel ring attention does not support "
+                "sliding-window/softcap models (Mistral/Gemma); run this "
+                "model without --seq-parallel"
+            )
         # Gemma-family extras (softcap / sliding-window / scalar scale)
         # collapse to the kernel/jnp defaults for every other config, so
         # ONE decode dispatch covers all families. window_l rides the

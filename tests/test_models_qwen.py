@@ -591,3 +591,35 @@ def test_mixtral_flagship_preset_serves_shrunk():
     )
     assert logits.shape == (1, 8, 64)
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_mistral_sliding_window_matches_hf_transformers(tmp_path):
+    """Mistral dense fidelity vs transformers: the every-layer sliding
+    window (HF masks q-k >= sliding_window on ALL layers) must survive
+    config_from_hf as the period-1 schedule — with window 4 over 8
+    tokens, dropping it shifts late-position logits measurably."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        sliding_window=4, tie_word_embeddings=False,
+    )
+    torch.manual_seed(13)
+    model = transformers.MistralForCausalLM(
+        transformers.MistralConfig(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.sliding_window == 4
+        assert c.sw_period == 1 and c.sw_global_residue == 1
+        # no layer is ever global under the period-1 schedule
+        assert all((l % c.sw_period) != c.sw_global_residue
+                   for l in range(c.n_layers))
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "mistral", **kw}, "tiny-hf-mistral",
+        check_cfg=check,
+    )
