@@ -65,16 +65,43 @@ def load_hf_checkpoint(
                 tensors[name[len("language_model."):]] = (h, name)
             tensors[name] = (h, name)
 
+    def _raw(name: str) -> np.ndarray:
+        if name in tensors:
+            h, key = tensors[name]
+            return h.get_tensor(key)
+        # Phi-3 fuses q/k/v into qkv_proj and gate/up into gate_up_proj
+        # (rows [q; k; v] resp. [gate; up] in the HF [out, in] layout).
+        # Resolve the split names virtually so one mapping serves both
+        # checkpoint shapes.
+        parts = name.split(".")
+        proj = parts[-2] if len(parts) >= 2 else ""
+        if proj in ("q_proj", "k_proj", "v_proj"):
+            fused = ".".join(parts[:-2] + ["qkv_proj", parts[-1]])
+            if fused in tensors:
+                h, key = tensors[fused]
+                q = config.n_heads * config.head_dim
+                kv = config.n_kv_heads * config.head_dim
+                lo = {"q_proj": 0, "k_proj": q, "v_proj": q + kv}[proj]
+                # get_slice reads only the needed rows (q is read 3x per
+                # layer otherwise — gigabytes of redundant IO at 7B scale)
+                return h.get_slice(key)[lo:lo + (q if proj == "q_proj" else kv)]
+        if proj in ("gate_proj", "up_proj"):
+            fused = ".".join(parts[:-2] + ["gate_up_proj", parts[-1]])
+            if fused in tensors:
+                h, key = tensors[fused]
+                f = config.ffn_dim
+                sl = h.get_slice(key)
+                return sl[:f] if proj == "gate_proj" else sl[f:2 * f]
+        raise KeyError(name)
+
     def get(name: str, transpose: bool = False) -> np.ndarray:
-        h, key = tensors[name]
-        arr = h.get_tensor(key)
+        arr = _raw(name)
         if transpose:
             arr = arr.T
         return np.ascontiguousarray(arr).astype(np_dtype)
 
     def get_f32(name: str) -> np.ndarray:
-        h, key = tensors[name]
-        return h.get_tensor(key).astype(np.float32)
+        return _raw(name).astype(np.float32)
 
     L = config.n_layers
     if config.is_mla:
@@ -363,7 +390,7 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
     gemma = mt == "gemma2"
     gemma3 = mt.startswith("gemma3")
     gemma_kw = {}
-    if mt in ("mistral", "mixtral") and cfg.get("sliding_window"):
+    if mt in ("mistral", "mixtral", "phi3") and cfg.get("sliding_window"):
         # Mistral-family sliding window applies to EVERY layer (HF
         # masks q-k >= sliding_window on all of them — no alternation).
         # Expressed in the generalized schedule as period 1 with an

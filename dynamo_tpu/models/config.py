@@ -322,6 +322,23 @@ PRESETS: Dict[str, ModelConfig] = {
         rope_mscale=1.0,
         rope_mscale_all_dim=1.0,
     ),
+    # Phi-3 mini 4k (fused qkv/gate_up checkpoint layout; every-layer
+    # sliding window like Mistral)
+    "phi-3-mini-4k": ModelConfig(
+        name="phi-3-mini-4k",
+        vocab_size=32064,
+        dim=3072,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,
+        ffn_dim=8192,
+        max_seq_len=4096,
+        rope_theta=10000.0,
+        norm_eps=1e-5,
+        sliding_window=2047,
+        sw_period=1,
+        sw_global_residue=1,
+    ),
     # Mistral 7B v0.1 (every-layer sliding window via the period-1
     # schedule: (l % 1) == 1 never holds, so no layer is global)
     "mistral-7b": ModelConfig(

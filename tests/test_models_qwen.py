@@ -623,3 +623,33 @@ def test_mistral_sliding_window_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "mistral", **kw}, "tiny-hf-mistral",
         check_cfg=check,
     )
+
+
+def test_phi3_fused_qkv_matches_hf_transformers(tmp_path):
+    """Phi-3 fidelity vs transformers: the fused qkv_proj / gate_up_proj
+    checkpoint layout resolved through virtual row-splits, plus the
+    every-layer sliding window (same period-1 schedule as Mistral)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Phi3ForCausalLM"):
+        pytest.skip("transformers too old for Phi3")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        sliding_window=4, tie_word_embeddings=False, pad_token_id=0,
+    )
+    torch.manual_seed(17)
+    model = transformers.Phi3ForCausalLM(
+        transformers.Phi3Config(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.sliding_window == 4
+        assert c.sw_period == 1 and c.sw_global_residue == 1
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "phi3", **kw}, "tiny-hf-phi3",
+        check_cfg=check,
+    )
