@@ -387,20 +387,28 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
         )
     n_experts = int(cfg.get("num_experts") or cfg.get("n_routed_experts")
                     or cfg.get("num_local_experts") or 0)  # mixtral naming
-    gemma = mt == "gemma2"
+    gemma2 = mt == "gemma2"
     gemma3 = mt.startswith("gemma3")
     gemma_kw = {}
+    if mt == "gemma":
+        # Gemma-1: the GeGLU/scaled-embed/zero-centered-norm subset of
+        # the Gemma-2 flags — no sandwich norms, softcaps, or window
+        gemma_kw.update(
+            act="gelu_tanh",
+            embed_scale=True,
+            norm_zero_centered=True,
+        )
     if mt in ("mistral", "mixtral", "phi3") and cfg.get("sliding_window"):
         # Mistral-family sliding window applies to EVERY layer (HF
         # masks q-k >= sliding_window on all of them — no alternation).
         # Expressed in the generalized schedule as period 1 with an
         # unreachable global residue: (l % 1) == 1 is never true.
-        gemma_kw = dict(
+        gemma_kw.update(
             sliding_window=int(cfg["sliding_window"]),
             sw_period=1,
             sw_global_residue=1,
         )
-    if gemma or gemma3:
+    if gemma2 or gemma3:
         gemma_kw = dict(
             act="gelu_tanh",
             embed_scale=True,

@@ -374,6 +374,25 @@ PRESETS: Dict[str, ModelConfig] = {
         moe_scoring="softmax",
         moe_norm_topk=True,
     ),
+    # Gemma 1 7B (GeGLU + scaled embeddings + zero-centered norms; MHA
+    # with head_dim 256 wider than dim/n_heads)
+    "gemma-7b": ModelConfig(
+        name="gemma-7b",
+        vocab_size=256000,
+        dim=3072,
+        n_layers=28,
+        n_heads=16,
+        n_kv_heads=16,
+        ffn_dim=24576,
+        max_seq_len=8192,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        tie_embeddings=True,
+        act="gelu_tanh",
+        embed_scale=True,
+        norm_zero_centered=True,
+        head_dim_override=256,
+    ),
     # Gemma 2 9B (fourth architecture family)
     "gemma-2-9b": ModelConfig(
         name="gemma-2-9b",

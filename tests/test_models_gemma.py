@@ -319,3 +319,37 @@ def test_gemma3_multimodal_wrapper_checkpoint(tmp_path):
     np.testing.assert_allclose(
         np.asarray(got)[0], ref[0], rtol=2e-3, atol=2e-3
     )
+
+
+def test_gemma1_matches_hf_transformers(tmp_path):
+    """Gemma-1 fidelity vs transformers: GeGLU, sqrt(dim)-scaled
+    embeddings, zero-centered RMSNorm, explicit head_dim wider than
+    dim // n_heads, tied lm_head — but none of Gemma-2's sandwich
+    norms, softcaps, or sliding window."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from tests.test_models_qwen import _hf_fidelity_roundtrip
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=True,
+        hidden_act="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(19)
+    model = transformers.GemmaForCausalLM(
+        transformers.GemmaConfig(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.act == "gelu_tanh" and c.embed_scale
+        assert c.norm_zero_centered and not c.post_norms
+        assert c.attn_logit_softcap == 0 and c.sliding_window == 0
+        assert c.head_dim == 16 and c.tie_embeddings
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "gemma", **kw}, "tiny-hf-gemma1",
+        check_cfg=check,
+    )

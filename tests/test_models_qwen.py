@@ -284,7 +284,7 @@ def _hf_fidelity_roundtrip(tmp_path, model, config_json, name, check_cfg=None):
 
     from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
 
-    save_file({k: v.contiguous() for k, v in model.state_dict().items()},
+    save_file({k: v.clone().contiguous() for k, v in model.state_dict().items()},
               str(tmp_path / "model.safetensors"))
     (tmp_path / "config.json").write_text(json.dumps(config_json))
     c = config_from_hf(str(tmp_path), name=name)
