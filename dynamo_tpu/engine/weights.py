@@ -130,16 +130,19 @@ def load_hf_checkpoint(
     params: Dict[str, Any] = {
         "embed": get("model.embed_tokens.weight"),
         "layers": {
-            "attn_norm": stack_f32("model.layers.{i}.input_layernorm.weight"),
             "wq": stack("model.layers.{i}.self_attn.q_proj.weight", True),
             "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
             "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
             "wo": stack("model.layers.{i}.self_attn.o_proj.weight", True),
-            "mlp_norm": stack_f32(mlp_norm_name),
         },
         "norm_f": get_f32("model.norm.weight"),
     }
     layers = params["layers"]
+    if config.pre_norms:
+        layers["attn_norm"] = stack_f32(
+            "model.layers.{i}.input_layernorm.weight"
+        )
+        layers["mlp_norm"] = stack_f32(mlp_norm_name)
     if config.post_norms:
         layers["post_attn_norm"] = stack_f32(
             "model.layers.{i}.post_attention_layernorm.weight"
@@ -390,6 +393,13 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
     gemma2 = mt == "gemma2"
     gemma3 = mt.startswith("gemma3")
     gemma_kw = {}
+    if mt == "olmo2":
+        # OLMo-2 reorders the norms: NO pre-norms — the residual stream
+        # feeds attention/MLP raw and post_{attention,feedforward}_
+        # layernorm norm the branch OUTPUTS (same tensor names Gemma-2
+        # uses for its sandwich); qk-norm runs over the FULL projection
+        # width before the head reshape.
+        gemma_kw.update(post_norms=True, pre_norms=False, qk_norm_wide=True)
     if mt == "gemma":
         # Gemma-1: the GeGLU/scaled-embed/zero-centered-norm subset of
         # the Gemma-2 flags — no sandwich norms, softcaps, or window
@@ -472,7 +482,7 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
         tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
         # qwen2 ships biases by default; qwen3 advertises them explicitly
         attn_bias=bool(cfg.get("attention_bias", mt in ("qwen2", "qwen2_moe"))),
-        qk_norm=mt in ("qwen3", "qwen3_moe") or gemma3,
+        qk_norm=mt in ("qwen3", "qwen3_moe", "olmo2") or gemma3,
         head_dim_override=int(cfg.get("head_dim") or 0),
         n_experts=n_experts,
         n_experts_active=int(cfg.get("num_experts_per_tok") or 0),

@@ -25,6 +25,10 @@ class ModelConfig:
     attn_bias: bool = False
     # Qwen3-style per-head RMSNorm on q and k before RoPE
     qk_norm: bool = False
+    # OLMo-2-style qk-norm statistics over the FULL projection width
+    # (weight [H*hd], applied before the head reshape) instead of
+    # per-head; only meaningful with qk_norm=True
+    qk_norm_wide: bool = False
     # Gemma family:
     #   gelu_tanh MLP activation (GeGLU) instead of SiLU
     act: str = "silu"  # "silu" | "gelu_tanh"
@@ -35,6 +39,10 @@ class ModelConfig:
     #   Gemma-2 sandwich norms: post-attention and post-FFW RMSNorms on
     #   the residual branches (in addition to the pre-norms)
     post_norms: bool = False
+    #   OLMo-2 drops the pre-norms entirely: the sublayer reads the raw
+    #   residual stream and ONLY the post_norms above apply (set
+    #   post_norms=True together with pre_norms=False)
+    pre_norms: bool = True
     #   attention-score soft capping: s = cap * tanh(s / cap); 0 = off
     attn_logit_softcap: float = 0.0
     #   final-logit soft capping; 0 = off
@@ -116,6 +124,15 @@ class ModelConfig:
     qk_rope_head_dim: int = 0  # decoupled positional key dim (shared head)
     qk_nope_head_dim: int = 0  # per-head content key dim
     v_head_dim: int = 0
+
+    def __post_init__(self):
+        if not self.pre_norms and not self.post_norms:
+            # the layer would have NO norms at all — and paths gated only
+            # on post_norms/qk_norm would KeyError deep inside lax.scan
+            raise ValueError(
+                "pre_norms=False requires post_norms=True (OLMo-2 style: "
+                "the branch outputs are normed instead of the inputs)"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -321,6 +338,24 @@ PRESETS: Dict[str, ModelConfig] = {
         rope_beta_slow=1.0,
         rope_mscale=1.0,
         rope_mscale_all_dim=1.0,
+    ),
+    # OLMo-2 7B (reordered norms: post-only on the branch outputs; wide
+    # qk-norm over the full projection width)
+    "olmo-2-7b": ModelConfig(
+        name="olmo-2-7b",
+        vocab_size=100352,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=32,
+        ffn_dim=11008,
+        max_seq_len=4096,
+        rope_theta=500000.0,
+        norm_eps=1e-6,
+        pre_norms=False,
+        post_norms=True,
+        qk_norm=True,
+        qk_norm_wide=True,
     ),
     # Phi-3 mini 4k (fused qkv/gate_up checkpoint layout; every-layer
     # sliding window like Mistral)

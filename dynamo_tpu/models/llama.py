@@ -143,13 +143,14 @@ def _init_layer_stack(config: ModelConfig, key: jax.Array, L: int,
             attn_p["wq"] = w(k[1], c.dim, L, c.dim, c.n_heads * (dn + dr))
     else:
         attn_p = {
-            "attn_norm": norm_init(L, c.dim),
             "wq": w(k[1], c.dim, L, c.dim, c.n_heads * hd),
             "wk": w(k[2], c.dim, L, c.dim, c.n_kv_heads * hd),
             "wv": w(k[3], c.dim, L, c.dim, c.n_kv_heads * hd),
             "wo": w(k[4], c.n_heads * hd, L, c.n_heads * hd, c.dim),
-            "mlp_norm": norm_init(L, c.dim),
         }
+        if c.pre_norms:
+            attn_p["attn_norm"] = norm_init(L, c.dim)
+            attn_p["mlp_norm"] = norm_init(L, c.dim)
     layers = attn_p
     if c.attn_bias:  # Qwen2 family: biases on the q/k/v projections
         layers.update(
@@ -160,8 +161,10 @@ def _init_layer_stack(config: ModelConfig, key: jax.Array, L: int,
             }
         )
     if c.qk_norm:  # Qwen3 family: per-head RMSNorm on q/k before RoPE
+        qd, kd = ((c.n_heads * hd, c.n_kv_heads * hd)  # OLMo-2: full width
+                  if c.qk_norm_wide else (hd, hd))
         layers.update(
-            {"q_norm": norm_init(L, hd), "k_norm": norm_init(L, hd)}
+            {"q_norm": norm_init(L, qd), "k_norm": norm_init(L, kd)}
         )
     if c.post_norms:  # Gemma-2 sandwich norms on the residual branches
         layers.update({
@@ -308,16 +311,24 @@ def forward(
             return (h, k_pool, v_pool), None
 
         zc = c.norm_zero_centered
-        x = rms_norm(h, lp["attn_norm"], c.norm_eps, zero_centered=zc)
+        # OLMo-2 (pre_norms=False): the sublayer reads the raw residual
+        x = (rms_norm(h, lp["attn_norm"], c.norm_eps, zero_centered=zc)
+             if c.pre_norms else h)
         q = lproj(mm(x, lp["wq"]), x, "wq")
         k = lproj(mm(x, lp["wk"]), x, "wk")
         v = lproj(mm(x, lp["wv"]), x, "wv")
         if c.attn_bias:  # Qwen2 projection biases
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        if c.qk_norm and c.qk_norm_wide:
+            # OLMo-2: RMS statistics over the FULL projection width,
+            # before the head reshape (per-head norm is a different op)
+            q = rms_norm(q, lp["q_norm"], c.norm_eps, zero_centered=zc)
+            k = rms_norm(k, lp["k_norm"], c.norm_eps, zero_centered=zc)
         q = q.reshape(B, S, c.n_heads, hd)
         k = k.reshape(B, S, c.n_kv_heads, hd)
         v = v.reshape(B, S, c.n_kv_heads, hd)
-        if c.qk_norm:  # Qwen3/Gemma-3 per-head RMSNorm before RoPE
+        if c.qk_norm and not c.qk_norm_wide:
+            # Qwen3/Gemma-3 per-head RMSNorm before RoPE
             q = rms_norm(q, lp["q_norm"], c.norm_eps, zero_centered=zc)
             k = rms_norm(k, lp["k_norm"], c.norm_eps, zero_centered=zc)
         if c.rope_local_theta:
@@ -457,7 +468,8 @@ def forward(
             )
         h = h + attn_out
 
-        x = rms_norm(h, lp["mlp_norm"], c.norm_eps, zero_centered=zc)
+        x = (rms_norm(h, lp["mlp_norm"], c.norm_eps, zero_centered=zc)
+             if c.pre_norms else h)
         if use_moe:
             h = h + _moe_block(c, lp, x, mesh)
         else:

@@ -653,3 +653,35 @@ def test_phi3_fused_qkv_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "phi3", **kw}, "tiny-hf-phi3",
         check_cfg=check,
     )
+
+
+def test_olmo2_matches_hf_transformers(tmp_path):
+    """OLMo-2 fidelity vs transformers: the reordered norms (no
+    pre-norms; post_attention/post_feedforward layernorms on the branch
+    OUTPUTS) and full-projection-width qk-norm — both statistically
+    different from the Gemma sandwich / per-head variants, so a wiring
+    mistake shifts logits measurably."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Olmo2ForCausalLM"):
+        pytest.skip("transformers too old for OLMo-2")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(23)
+    model = transformers.Olmo2ForCausalLM(
+        transformers.Olmo2Config(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert not c.pre_norms and c.post_norms
+        assert c.qk_norm and c.qk_norm_wide
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "olmo2", **kw}, "tiny-hf-olmo2",
+        check_cfg=check,
+    )
