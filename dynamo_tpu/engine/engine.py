@@ -136,6 +136,9 @@ class InferenceEngine:
             max_batch=max_batch,
             chunk_size=chunk_size,
             max_seq_pages=runner.max_pages_per_seq,
+            max_seq_tokens=getattr(
+                getattr(runner, "config", None), "max_seq_len", 0
+            ) or 0,
             decode_steps=decode_steps,
             mixed_prefill_tokens=mixed_prefill_tokens,
             host_tier=self.host_pool,
@@ -378,6 +381,10 @@ class InferenceEngine:
         # head-of-line-blocks every request behind it
         PS = self.pool.page_size
         cap_tokens = min(self.scheduler.max_seq_pages, self.pool.num_pages) * PS
+        if self.scheduler.max_seq_tokens:
+            # the model context also bounds the PROMPT: prefilling past
+            # the rope-valid range yields garbage logits, not an error
+            cap_tokens = min(cap_tokens, self.scheduler.max_seq_tokens)
         if len(seq.prompt) + 1 > cap_tokens:
             yield {
                 "finish_reason": "error",

@@ -136,11 +136,17 @@ class Scheduler:
         mixed_prefill_tokens: int = 256,
         host_tier=None,  # HostKvPool-like: .match(hashes) -> n
         host_onboard=None,  # cb(pages, hashes) -> bool (imports G2→G1 data)
+        max_seq_tokens: int = 0,  # model context length (0 = page cap only)
     ):
         self.pool = pool
         self.max_batch = max_batch
         self.chunk_size = chunk_size
         self.max_seq_pages = max_seq_pages
+        # rope-validity cap: page capacity bounds what FITS, the model's
+        # max_seq_len bounds what is NUMERICALLY MEANINGFUL — a request
+        # without max_tokens must stop at the context limit, not push
+        # positions past the rope table into garbage logits
+        self.max_seq_tokens = int(max_seq_tokens or 0)
         self.enable_prefix_cache = enable_prefix_cache
         self.decode_steps = decode_steps
         # co-scheduling budget: when decode work exists, prefill chunks are
@@ -200,6 +206,8 @@ class Scheduler:
         # fuse up to decode_steps iterations, bounded by the per-seq budget
         # remaining (max_tokens / context cap) so fused steps aren't wasted
         cap = self.max_seq_pages * self.pool.page_size
+        if self.max_seq_tokens:
+            cap = min(cap, self.max_seq_tokens)
         n_steps = self.decode_steps
         for s in running:
             budget = min(
@@ -416,6 +424,8 @@ class Scheduler:
         elif seq.n_generated >= int(stop.get("max_tokens", 1 << 30)):
             reason = "length"
         elif len(seq.tokens) >= self.max_seq_pages * self.pool.page_size:
+            reason = "length"
+        elif self.max_seq_tokens and len(seq.tokens) >= self.max_seq_tokens:
             reason = "length"
         if reason:
             self._finish(seq, reason)

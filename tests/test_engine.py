@@ -387,3 +387,43 @@ async def test_fused_mixed_dispatch_matches_sequential(monkeypatch):
     conc_out, fused_calls = await serve(mk(), concurrent=True)
     assert seq_out == conc_out, (seq_out, conc_out)
     assert fused_calls > 0, "concurrent load never engaged the fused path"
+
+
+def test_uncapped_generation_stops_at_model_context():
+    """A request with no max_tokens must finish with reason=length at the
+    MODEL's max_seq_len, not run on to the page-table capacity: positions
+    past the rope table produce garbage logits silently."""
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.runtime.context import Context
+
+    cfg = get_config("tiny").with_(max_seq_len=32)
+    runner = ModelRunner(cfg, num_pages=64, page_size=8, max_pages_per_seq=16)
+    eng = InferenceEngine(runner)
+
+    async def run():
+        ctx = Context()
+        toks = []
+        finish = None
+        async for item in eng.generate({"token_ids": [1, 2, 3]}, ctx):
+            toks += item.get("token_ids") or []
+            finish = item.get("finish_reason") or finish
+        return toks, finish
+
+    toks, finish = asyncio.run(run())
+    # page capacity is 16*8=128 tokens; the model context (32) must bind
+    assert len(toks) + 3 <= 32
+    assert finish == "length"
+
+    # a PROMPT past the model context must be rejected at admission, not
+    # silently prefilled beyond the rope-valid range
+    async def run_long():
+        ctx = Context()
+        async for item in eng.generate({"token_ids": list(range(100))}, ctx):
+            return item
+
+    item = asyncio.run(run_long())
+    assert item["finish_reason"] == "error"
+    assert "exceeds" in item["error"]
+    eng.stop()
