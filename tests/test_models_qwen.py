@@ -685,3 +685,28 @@ def test_olmo2_matches_hf_transformers(tmp_path):
         tmp_path, model, {"model_type": "olmo2", **kw}, "tiny-hf-olmo2",
         check_cfg=check,
     )
+
+
+def test_every_preset_constructs_with_consistent_fields():
+    """Sweep the whole PRESETS dict: every preset must build (the frozen
+    dataclass validation runs) and carry self-consistent family fields —
+    a typo in a flagship preset otherwise surfaces only when someone
+    serves it."""
+    from dynamo_tpu.models.config import PRESETS
+
+    for name, c in PRESETS.items():
+        assert c.name == name
+        assert c.vocab_size > 0 and c.dim > 0 and c.n_layers > 0
+        assert c.n_heads % c.n_kv_heads == 0, name
+        if not c.head_dim_override and not c.is_mla:
+            assert c.dim % c.n_heads == 0, name
+        if c.is_moe:
+            assert 0 < c.n_experts_active <= c.n_experts, name
+            assert c.moe_ffn_dim > 0, name
+        if c.is_mla:
+            assert c.kv_lora_rank > 0 and c.qk_rope_head_dim > 0, name
+            assert c.qk_nope_head_dim > 0 and c.v_head_dim > 0, name
+        if c.sliding_window:
+            assert c.sw_period >= 1, name
+        if not c.pre_norms:
+            assert c.post_norms, name
