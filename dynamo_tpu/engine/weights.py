@@ -5,7 +5,11 @@ per-backend weight loading inside vLLM/TRT-LLM): map a HuggingFace
 Llama-family checkpoint directory onto models/llama.py's stacked-layer
 pytree, casting to the serving dtype, ready for ShardingPolicy placement.
 
-HF → dynamo_tpu name map (Llama/Qwen2/Qwen3/Qwen-MoE architectures):
+HF → dynamo_tpu name map (Llama/Mistral/Qwen2/Qwen3/Qwen-MoE/OLMo-2
+architectures; Phi-3's fused qkv_proj/gate_up_proj resolve to the split
+names below via virtual get_slice row-splits, Mixtral's
+block_sparse_moe.experts.N.{w1,w3,w2} map to we_{gate,up,down}, and
+Gemma-1/2/3 / DeepSeek-MLA deviations are noted inline):
   model.embed_tokens.weight            → embed                [V, E]
   model.layers.{i}.input_layernorm     → layers/attn_norm[i]
   model.layers.{i}.self_attn.{q,k,v}_proj (transposed) → layers/w{q,k,v}[i]
