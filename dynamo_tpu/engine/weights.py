@@ -397,6 +397,17 @@ def config_from_hf(checkpoint_dir: str, name: Optional[str] = None) -> ModelConf
     gemma2 = mt == "gemma2"
     gemma3 = mt.startswith("gemma3")
     gemma_kw = {}
+    if mt == "granite":
+        # Granite: Llama layout + four scalar multipliers (HF
+        # GraniteConfig); logits_scaling DIVIDES the final logits
+        gemma_kw.update(
+            embed_multiplier=float(cfg.get("embedding_multiplier") or 0.0),
+            residual_multiplier=float(cfg.get("residual_multiplier") or 1.0),
+            # HF's default when the field is omitted is 1.0 — i.e. a
+            # softmax scale of ONE, not head_dim**-0.5
+            attn_scale=float(cfg.get("attention_multiplier", 1.0) or 1.0),
+            logits_divider=float(cfg.get("logits_scaling") or 1.0),
+        )
     if mt == "olmo2":
         # OLMo-2 reorders the norms: NO pre-norms — the residual stream
         # feeds attention/MLP raw and post_{attention,feedforward}_

@@ -34,6 +34,14 @@ class ModelConfig:
     act: str = "silu"  # "silu" | "gelu_tanh"
     #   embeddings scaled by sqrt(dim) after lookup
     embed_scale: bool = False
+    # Granite scalar multipliers (HF GraniteConfig): explicit embedding
+    # multiplier (wins over embed_scale's sqrt(dim)), residual-branch
+    # multiplier, direct attention softmax scale (wins over
+    # query_pre_attn_scalar/head_dim), and a DIVIDER on the final logits
+    embed_multiplier: float = 0.0
+    residual_multiplier: float = 1.0
+    attn_scale: float = 0.0
+    logits_divider: float = 1.0
     #   RMSNorm weights are zero-centered: output = normed * (1 + w)
     norm_zero_centered: bool = False
     #   Gemma-2 sandwich norms: post-attention and post-FFW RMSNorms on
@@ -338,6 +346,25 @@ PRESETS: Dict[str, ModelConfig] = {
         rope_beta_slow=1.0,
         rope_mscale=1.0,
         rope_mscale_all_dim=1.0,
+    ),
+    # Granite 3.1 8B (Llama layout + the four Granite scalar
+    # multipliers; logits_scaling divides the final logits)
+    "granite-3.1-8b": ModelConfig(
+        name="granite-3.1-8b",
+        vocab_size=49155,
+        dim=4096,
+        n_layers=40,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=12800,
+        max_seq_len=131072,
+        rope_theta=10000000.0,
+        norm_eps=1e-5,
+        tie_embeddings=True,
+        embed_multiplier=12.0,
+        residual_multiplier=0.22,
+        attn_scale=0.0078125,
+        logits_divider=16.0,
     ),
     # OLMo-2 7B (reordered norms: post-only on the branch outputs; wide
     # qk-norm over the full projection width)

@@ -241,7 +241,10 @@ def forward(
     G = c.n_heads // c.n_kv_heads
 
     h = embed_lookup(params["embed"], tokens)  # [B, S, E] (gather)
-    if c.embed_scale:
+    if c.embed_multiplier:
+        # Granite: explicit embedding multiplier
+        h = h * jnp.asarray(c.embed_multiplier, h.dtype)
+    elif c.embed_scale:
         # Gemma: embeddings scaled by sqrt(dim), with the normalizer
         # rounded through the embedding dtype (HF semantics)
         h = h * jnp.asarray(c.dim**0.5, h.dtype)
@@ -354,7 +357,7 @@ def forward(
         tp = mesh is not None and mesh.shape.get("model", 1) > 1
         gemma_attn = (
             c.attn_logit_softcap > 0 or c.sliding_window > 0
-            or c.query_pre_attn_scalar > 0
+            or c.query_pre_attn_scalar > 0 or c.attn_scale > 0
         )
         if gemma_attn and attn_impl == "ring":
             # the ring kernel has no window/softcap operands: falling
@@ -384,6 +387,8 @@ def forward(
             c.query_pre_attn_scalar ** -0.5
             if c.query_pre_attn_scalar > 0 else None
         )
+        if c.attn_scale:  # Granite: the softmax scale given directly
+            g_scale = c.attn_scale
         if attn_impl == "pallas" and S == 1:
             from dynamo_tpu.ops.paged_attention import (
                 decode_paged_attention,
@@ -466,12 +471,17 @@ def forward(
             attn_out = rms_norm(
                 attn_out, lp["post_attn_norm"], c.norm_eps, zero_centered=zc
             )
+        if c.residual_multiplier != 1.0:  # Granite branch scaling
+            attn_out = attn_out * jnp.asarray(
+                c.residual_multiplier, attn_out.dtype
+            )
         h = h + attn_out
 
         x = (rms_norm(h, lp["mlp_norm"], c.norm_eps, zero_centered=zc)
              if c.pre_norms else h)
+        rm = c.residual_multiplier
         if use_moe:
-            h = h + _moe_block(c, lp, x, mesh)
+            ffw = _moe_block(c, lp, x, mesh)
         else:
             act = (
                 partial(jax.nn.gelu, approximate=True)
@@ -484,7 +494,9 @@ def forward(
                 ffw = rms_norm(
                     ffw, lp["post_mlp_norm"], c.norm_eps, zero_centered=zc
                 )
-            h = h + ffw
+        if rm != 1.0:  # Granite branch scaling
+            ffw = ffw * jnp.asarray(rm, ffw.dtype)
+        h = h + ffw
         return (h, k_pool, v_pool), None
 
     dense_stack = params.get("layers_dense")
@@ -525,6 +537,8 @@ def forward(
     else:
         logits = mm(h, lm_head)
     logits = logits.astype(jnp.float32)
+    if c.logits_divider != 1.0:  # Granite
+        logits = logits / c.logits_divider
     if c.final_logit_softcap:
         cap = c.final_logit_softcap
         logits = cap * jnp.tanh(logits / cap)

@@ -45,7 +45,9 @@ def _check(config: ModelConfig) -> None:
             or c.act != "silu" or c.post_norms or c.norm_zero_centered
             or c.embed_scale or c.attn_logit_softcap
             or c.final_logit_softcap or c.query_pre_attn_scalar
-            or c.sliding_window):
+            or c.sliding_window or not c.pre_norms
+            or c.embed_multiplier or c.residual_multiplier != 1.0
+            or c.attn_scale or c.logits_divider != 1.0):
         raise NotImplementedError(
             "pipeline-parallel forward currently covers the plain dense "
             "GQA family (llama/mistral-style)"

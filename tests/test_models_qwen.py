@@ -710,3 +710,37 @@ def test_every_preset_constructs_with_consistent_fields():
             assert c.sw_period >= 1, name
         if not c.pre_norms:
             assert c.post_norms, name
+
+
+def test_granite_matches_hf_transformers(tmp_path):
+    """Granite fidelity vs transformers: the four scalar multipliers
+    (embedding, residual-branch, direct attention scale, logits
+    DIVIDER) — each deliberately non-default here so dropping any one
+    of them shifts the logits."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "GraniteForCausalLM"):
+        pytest.skip("transformers too old for Granite")
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, embedding_multiplier=6.0,
+        residual_multiplier=0.5, attention_multiplier=0.25,
+        logits_scaling=3.0,
+    )
+    torch.manual_seed(29)
+    model = transformers.GraniteForCausalLM(
+        transformers.GraniteConfig(**kw, attn_implementation="eager")
+    ).eval()
+
+    def check(c):
+        assert c.embed_multiplier == 6.0
+        assert c.residual_multiplier == 0.5
+        assert c.attn_scale == 0.25 and c.logits_divider == 3.0
+
+    _hf_fidelity_roundtrip(
+        tmp_path, model, {"model_type": "granite", **kw}, "tiny-hf-granite",
+        check_cfg=check,
+    )
