@@ -30,6 +30,7 @@ from typing import Any, List, Optional
 from dynamo_tpu.bench.loadgen import (
     GoodputReport,
     compute_goodput,
+    generate_burst_trace,
     generate_trace,
     load_trace,
     run_trace_against_engine,
@@ -112,6 +113,8 @@ def _make_engine(args, mocker: bool):
         max_batch=args.max_batch,
         chunk_size=args.chunk_size,
         mixed_prefill_tokens=args.mixed_prefill_tokens,
+        mixed_prefill_seqs=getattr(args, "mixed_prefill_seqs", 8),
+        mixed_min_chunk=getattr(args, "mixed_min_chunk", 16),
         host_kv_blocks=args.host_kv_blocks,
     )
 
@@ -230,6 +233,13 @@ async def _boot_rest(args, mocker, disagg, plane, realm, card,
 async def run_goodput(args) -> GoodputReport:
     if args.trace:
         trace = load_trace(args.trace)
+    elif getattr(args, "burst_size", 0) > 0:
+        trace = generate_burst_trace(
+            args.n_requests, burst_size=args.burst_size,
+            burst_interval_s=args.burst_interval,
+            isl_mean=args.isl, osl_mean=args.osl,
+            prefix_groups=args.prefix_groups, seed=args.seed,
+        )
     else:
         trace = generate_trace(
             args.n_requests, rps=args.rps, isl_mean=args.isl, osl_mean=args.osl,
@@ -313,8 +323,14 @@ def parse_args(argv=None):
     p.add_argument("--max-batch", type=int, default=32)
     p.add_argument("--chunk-size", type=int, default=512)
     p.add_argument("--mixed-prefill-tokens", type=int, default=256,
-                   help="prefill chunk cap when co-scheduled with decode "
+                   help="per-iteration prefill token POOL when co-scheduled "
+                        "with decode, fair-shared across packed chunks "
                         "(0 = strict prefill-first alternation)")
+    p.add_argument("--mixed-prefill-seqs", type=int, default=8,
+                   help="max distinct prefills packed per iteration "
+                        "(1 = legacy single-chunk MixedPlan)")
+    p.add_argument("--mixed-min-chunk", type=int, default=16,
+                   help="fair-share floor per packed prefill sequence")
     p.add_argument("--host-kv-blocks", type=int, default=0)
     p.add_argument("--decode-buckets", type=int, nargs="+", default=[8, 16, 32])
     p.add_argument("--prefill-buckets", type=int, nargs="+",
@@ -323,6 +339,11 @@ def parse_args(argv=None):
     p.add_argument("--trace", default=None, help="JSONL trace file (else synthetic)")
     p.add_argument("--n-requests", type=int, default=64)
     p.add_argument("--rps", type=float, default=4.0)
+    p.add_argument("--burst-size", type=int, default=0,
+                   help="bursty arrivals: cohorts of this many simultaneous "
+                        "requests instead of a poisson trace (0 = off)")
+    p.add_argument("--burst-interval", type=float, default=2.0,
+                   help="seconds between burst cohorts")
     p.add_argument("--isl", type=int, default=256)
     p.add_argument("--osl", type=int, default=64)
     p.add_argument("--prefix-groups", type=int, default=0)

@@ -66,8 +66,12 @@ class InferenceEngine:
         max_batch: int = 64,
         chunk_size: int = 512,
         decode_steps: int = 4,
-        mixed_prefill_tokens: int = 256,  # chunk cap when co-scheduled
-        #   with decode (0 = strict prefill-first alternation)
+        mixed_prefill_tokens: int = 256,  # per-iteration prefill token POOL
+        #   when co-scheduled with decode, fair-shared across packed chunks
+        #   (0 = strict prefill-first alternation)
+        mixed_prefill_seqs: int = 8,  # max distinct prefills packed per
+        #   iteration (1 = legacy single-chunk MixedPlan)
+        mixed_min_chunk: int = 16,  # fair-share floor per packed sequence
         idle_sleep_s: float = 0.002,
         host_kv_blocks: int = 0,  # G2 host-tier capacity (0 = disabled)
         disk_kv_blocks: int = 0,  # G3 disk-tier capacity (needs G2 enabled)
@@ -141,6 +145,8 @@ class InferenceEngine:
             ) or 0,
             decode_steps=decode_steps,
             mixed_prefill_tokens=mixed_prefill_tokens,
+            mixed_prefill_seqs=mixed_prefill_seqs,
+            mixed_min_chunk=mixed_min_chunk,
             host_tier=self.host_pool,
             host_onboard=self._onboard_from_host if self.host_pool is not None else None,
         )
@@ -532,16 +538,35 @@ class InferenceEngine:
                 if self._mixed_fusible(plan):
                     chunk_logits = self._run_mixed_dispatch(plan)
                     # decode tokens are emitted: from here on a failure
-                    # (e.g. in the chunk's sampling extras) must only
-                    # fail the prefill sequence
+                    # (e.g. in a chunk's sampling extras) must only
+                    # fail the prefill sequences
                     decode_done = True
-                    self.scheduler.complete_prefill(plan.prefill)
-                    self._finish_prefill(plan.prefill, chunk_logits)
+                    for pplan, lg in zip(plan.prefills, chunk_logits):
+                        # per-chunk isolation: one chunk's sampling extras
+                        # failing must not error sibling prefills whose KV
+                        # landed in the same dispatch
+                        try:
+                            self.scheduler.complete_prefill(pplan)
+                            self._finish_prefill(pplan, lg)
+                        except GroupBroken:
+                            raise
+                        except Exception:
+                            log.exception(
+                                "packed chunk bookkeeping failed; erroring %s",
+                                pplan.seq.request_id,
+                            )
+                            try:
+                                self._emit(pplan.seq, [], "error")
+                                self.scheduler.abort(pplan.seq.request_id)
+                            except Exception:
+                                log.exception("failed to fail sequence %s",
+                                              pplan.seq.request_id)
+                            self._recover_poisoned_pools()
                     # one dispatch ran both halves — a per-kind wall split
                     # doesn't exist; observers ignore the mixed kind
                     kind = "mixed"
                     n_tok = (len(plan.decode.seqs) * plan.decode.n_steps
-                             + len(plan.prefill.chunk))
+                             + sum(len(p.chunk) for p in plan.prefills))
                 else:
                     # decode first: ITL never waits behind prompt
                     # processing. Publish the halves as separate FPM
@@ -553,8 +578,9 @@ class InferenceEngine:
                     self._publish_fpm(
                         "decode", t1 - t0, len(plan.decode.seqs)
                     )
-                    self._run_prefill(plan.prefill)
-                    kind, n_tok = "prefill", len(plan.prefill.chunk)
+                    self._run_prefills(plan.prefills)
+                    kind = "prefill"
+                    n_tok = sum(len(p.chunk) for p in plan.prefills)
                     t0 = t1
             else:
                 self._run_decode(plan)
@@ -572,8 +598,9 @@ class InferenceEngine:
             if isinstance(plan, PrefillPlan):
                 seqs = [plan.seq]
             elif isinstance(plan, MixedPlan):
-                seqs = [plan.prefill.seq] if decode_done else (
-                    list(plan.decode.seqs) + [plan.prefill.seq]
+                pseqs = [p.seq for p in plan.prefills]
+                seqs = pseqs if decode_done else (
+                    list(plan.decode.seqs) + pseqs
                 )
             else:
                 seqs = plan.seqs
@@ -945,6 +972,39 @@ class InferenceEngine:
         with annotate("engine.prefill", tokens=len(plan.chunk)):
             self._run_prefill_inner(plan)
 
+    def _run_prefills(self, plans: List[PrefillPlan]) -> None:
+        """Non-fused execution of a packed chunk set. Runners exposing
+        `prefill_packed` (the mocker, whose step-time model charges one
+        dispatch for the whole set) get all chunks in one call; others
+        (PP, interpreter fallback) run the chunks sequentially —
+        scheduling still packs, only the dispatch is serial."""
+        packed = getattr(self.runner, "prefill_packed", None)
+        if (packed is None or len(plans) <= 1
+                or getattr(self.runner, "has_draft", False)
+                or any(
+                    self._mm_chunk(p.seq, p.start_pos, len(p.chunk))
+                    is not None
+                    for p in plans
+                )):
+            for plan in plans:
+                self._run_prefill(plan)
+            return
+        with annotate("engine.prefill_packed", chunks=len(plans),
+                      tokens=sum(len(p.chunk) for p in plans)):
+            logits_rows = packed([
+                {
+                    "tokens": p.chunk,
+                    "start": p.start_pos,
+                    "table": p.seq.pages,
+                    "prior": p.start_pos,
+                    "adapter": p.seq.adapter_idx,
+                }
+                for p in plans
+            ])
+            for plan, lg in zip(plans, logits_rows):
+                self.scheduler.complete_prefill(plan)
+                self._finish_prefill(plan, lg)
+
     def _run_prefill_inner(self, plan: PrefillPlan) -> None:
         seq = plan.seq
         mm_chunk = self._mm_chunk(seq, plan.start_pos, len(plan.chunk))
@@ -1051,43 +1111,71 @@ class InferenceEngine:
             # the fused program's plain attn_impl would miscompute the
             # chunk's KV there
             return False
+        if len(plan.prefills) > 1 and not hasattr(
+            runner, "decode_multi_with_prefills"
+        ):
+            return False  # packed ragged program unavailable on this runner
         seqs = plan.decode.seqs
         if any(s.guided_m is not None for s in seqs):
             return False  # per-step masks need the T=1 masked path
         if _batch_logprobs(seqs) >= 0 or _batch_penalties(seqs):
             return False
-        if any(s.logit_bias for s in seqs) or plan.prefill.seq.logit_bias:
+        if any(s.logit_bias for s in seqs) or any(
+            p.seq.logit_bias for p in plan.prefills
+        ):
             return False  # the fused program has no bias operand
-        pplan = plan.prefill
-        if self._mm_chunk(pplan.seq, pplan.start_pos, len(pplan.chunk)) is not None:
-            return False  # multimodal chunks ride the standalone prefill
+        for pplan in plan.prefills:
+            if self._mm_chunk(
+                pplan.seq, pplan.start_pos, len(pplan.chunk)
+            ) is not None:
+                return False  # multimodal chunks ride the standalone prefill
         return True
 
     def _run_mixed_dispatch(self, plan: MixedPlan):
         """The fused dispatch + decode-half bookkeeping: the decode
-        batch's fused steps and the bounded prefill chunk share a single
-        jitted program — one host sync per iteration instead of two (each
-        dispatch is a full RTT through a relay-attached chip). Returns
-        the chunk's last-token logits; the caller finishes the prefill
-        half separately so a failure THERE only fails the prefill
-        sequence (the decode tokens are already emitted)."""
+        batch's fused steps and the packed prefill chunk set share a
+        single jitted program — one host sync per iteration instead of
+        1 + n_chunks (each dispatch is a full RTT through a
+        relay-attached chip). Returns the per-chunk last-token logits
+        (one row per packed chunk); the caller finishes the prefill half
+        separately so a failure THERE only fails prefill sequences (the
+        decode tokens are already emitted)."""
         seqs = plan.decode.seqs
-        pplan = plan.prefill
         T = plan.decode.n_steps
+        n_chunk_tok = sum(len(p.chunk) for p in plan.prefills)
         with annotate("engine.mixed", batch=len(seqs), steps=T,
-                      chunk=len(pplan.chunk)):
+                      chunks=len(plan.prefills), chunk=n_chunk_tok):
             tokens = [s.tokens[-1] for s in seqs]
             positions = [s.computed_len for s in seqs]
             tables = [s.pages for s in seqs]
             step0 = self._step_counter + 1
             self._step_counter += T
-            sampled, chunk_logits = self.runner.decode_multi_with_prefill(
-                T, tokens, positions, tables, _sampling_params(seqs), step0,
-                pplan.chunk, pplan.start_pos, pplan.seq.pages,
-                pplan.start_pos,
-                adapters=[s.adapter_idx for s in seqs],
-                chunk_adapter=pplan.seq.adapter_idx,
-            )
+            if len(plan.prefills) == 1:
+                pplan = plan.prefill
+                sampled, lg = self.runner.decode_multi_with_prefill(
+                    T, tokens, positions, tables, _sampling_params(seqs),
+                    step0, pplan.chunk, pplan.start_pos, pplan.seq.pages,
+                    pplan.start_pos,
+                    adapters=[s.adapter_idx for s in seqs],
+                    chunk_adapter=pplan.seq.adapter_idx,
+                )
+                chunk_logits = [lg]
+            else:
+                sampled, chunk_logits = self.runner.decode_multi_with_prefills(
+                    T, tokens, positions, tables, _sampling_params(seqs),
+                    step0,
+                    [
+                        {
+                            "tokens": p.chunk,
+                            "start": p.start_pos,
+                            "table": p.seq.pages,
+                            "prior": p.start_pos,
+                            "adapter": p.seq.adapter_idx,
+                        }
+                        for p in plan.prefills
+                    ],
+                    adapters=[s.adapter_idx for s in seqs],
+                )
             for i, seq in enumerate(seqs):
                 emit: List[int] = []
                 reason = None

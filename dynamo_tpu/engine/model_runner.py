@@ -146,12 +146,12 @@ def _mixed_loop(
     mesh,
     n_steps: int,
     params,
-    ptok,  # [1, S] prefill chunk tokens (bucket-padded)
-    ppos,  # [1, S] positions (-1 padding)
-    ppt,  # [1, MP] chunk page table
-    pkvl,  # [1] chunk kv len
-    plast,  # scalar: last valid chunk index (logits computed there only)
-    padapter,  # [1] LoRA slot for the chunk's sequence (None w/o LoRA)
+    ptok,  # [N, S] packed prefill chunk tokens (bucket-padded; N=1 legacy)
+    ppos,  # [N, S] positions (-1 padding)
+    ppt,  # [N, MP] per-chunk page tables
+    pkvl,  # [N] per-chunk kv lens
+    plast,  # scalar (N=1) or [N]: last valid index per chunk row
+    padapter,  # [N] LoRA slot per chunk's sequence (None w/o LoRA)
     tokens0,
     packed,
     k_pool,
@@ -159,15 +159,17 @@ def _mixed_loop(
     sampling: SamplingParams,
     lora=None,
 ):
-    """One fused engine iteration under mixed scheduling: the bounded
-    prefill chunk AND the n_steps decode loop in a single jit — ONE host
-    sync per iteration instead of two. Through a relay-attached chip each
-    dispatch costs a full RTT (~3.7 ms measured, docs/PERF.md), so the
-    unfused MixedPlan pays that twice per iteration; local-PCIe chips
-    still save a program launch. The chunk belongs to a different
-    sequence (disjoint pages) than the decode batch, so ordering inside
-    the program is free for XLA to choose. Returns (toks [B, n_steps],
-    last [B], chunk_logits [V], k_pool, v_pool)."""
+    """One fused engine iteration under mixed scheduling: the token-
+    budgeted prefill chunk set (one ragged segment per batch row) AND
+    the n_steps decode loop in a single jit — ONE host sync per
+    iteration instead of 1 + n_chunks. Through a relay-attached chip
+    each dispatch costs a full RTT (~3.7 ms measured, docs/PERF.md), so
+    the unfused packed MixedPlan pays that once per chunk; local-PCIe
+    chips still save the program launches. Every chunk belongs to a
+    different sequence (disjoint pages) than the decode batch and its
+    packed siblings, so ordering inside the program is free for XLA to
+    choose. Returns (toks [B, n_steps], last [B], chunk_logits — [V]
+    for the legacy scalar plast, else [N, V] — k_pool, v_pool)."""
     logits, k_pool, v_pool = llama.forward(
         config, params, ptok, ppos, k_pool, v_pool, ppt, pkvl, plast,
         attn_impl=attn_impl, mesh=mesh, lora=lora, adapter_idx=padapter,
@@ -176,7 +178,11 @@ def _mixed_loop(
         config, attn_impl, mesh, n_steps, -1, params, tokens0, packed,
         None, None, None, k_pool, v_pool, sampling, lora,
     )
-    return toks, last, logits[0, 0], k_pool, v_pool
+    if getattr(plast, "ndim", 0) >= 1:
+        chunk_logits = logits[:, 0]  # [N, V], one row per packed chunk
+    else:
+        chunk_logits = logits[0, 0]  # [V], legacy single-chunk caller
+    return toks, last, chunk_logits, k_pool, v_pool
 
 
 # Wire layout version for P→D / cross-worker KV payloads. v2 = token-major
@@ -358,6 +364,9 @@ class ModelRunner:
         self.max_pages_per_seq = max_pages_per_seq
         self.decode_buckets = tuple(decode_buckets)
         self.prefill_buckets = tuple(prefill_buckets)
+        # packed-prefill row-count buckets: the fused mixed program
+        # compiles per (decode bucket, chunk bucket, pack bucket) triple
+        self.pack_buckets = (1, 2, 4, 8, 16, 32)
         self.dtype = dtype
 
         t0 = time.monotonic()
@@ -810,6 +819,85 @@ class ModelRunner:
         )
         toks, _, chunk_logits, self.k_pool, self.v_pool = self._jit_mixed(
             n_steps, self.params, ptok, ppos, ppt, pkvl, jnp.int32(n - 1),
+            padapter, jnp.asarray(tok_h), jnp.asarray(packed),
+            self.k_pool, self.v_pool, self._device_sampling(sampling, B),
+            self.lora,
+        )
+        return np.asarray(jax.device_get(toks)), chunk_logits
+
+    def _prep_prefill_packed(self, chunks: List[Dict[str, Any]]):
+        """Bucket-pad a packed chunk set into ragged [N, S] device inputs,
+        one row per chunk (each row's valid tokens are a contiguous run
+        from s=0, which is the layout the prefill attention kernels'
+        q_start/q_len metadata requires — a flat concatenation of
+        segments would break their causal masking). Rows past the real
+        chunk count replicate row 0: the duplicate rewrites identical KV
+        bytes to the same pages (harmless) and avoids q_len=0 edge cases
+        in the kernels; its logits row is discarded by the caller."""
+        N = _next_bucket(self.pack_buckets, len(chunks))
+        S = _next_bucket(
+            self.prefill_buckets, max(len(c["tokens"]) for c in chunks)
+        )
+        tok = np.zeros((N, S), np.int32)
+        pos = np.full((N, S), -1, np.int32)
+        kvl = np.zeros(N, np.int32)
+        last = np.zeros(N, np.int32)
+        adapters = np.zeros(N, np.int32)
+        rows = []
+        for i in range(N):
+            c = chunks[i] if i < len(chunks) else chunks[0]
+            n = len(c["tokens"])
+            tok[i, :n] = c["tokens"]
+            pos[i, :n] = np.arange(c["start"], c["start"] + n)
+            kvl[i] = c["prior"] + n
+            last[i] = n - 1
+            adapters[i] = c.get("adapter") or 0
+            rows.append(c["table"])
+        pt = self._pad_page_table(rows, N)
+        padapter = jnp.asarray(adapters) if self.lora is not None else None
+        return (jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(pt),
+                jnp.asarray(kvl), jnp.asarray(last), padapter)
+
+    def decode_multi_with_prefills(
+        self,
+        n_steps: int,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        sampling,
+        step: int,
+        chunks: List[Dict[str, Any]],  # {"tokens", "start", "table",
+        #   "prior", "adapter"} per packed chunk (distinct sequences)
+        adapters: Optional[List[int]] = None,
+    ) -> Tuple[np.ndarray, jax.Array]:
+        """Packed fused mixed iteration: the decode batch's fused n_steps
+        AND the whole token-budgeted prefill chunk set in a SINGLE
+        dispatch (the ragged chunks ride as rows of one [N, S] prefill
+        batch). Returns (sampled [B_bucket, n_steps] host, per-chunk
+        last-token logits [N_bucket, V] device — row i belongs to
+        chunks[i], rows past len(chunks) are padding). Same feature-plane
+        limits as decode_multi_with_prefill."""
+        if self.pp:
+            raise NotImplementedError("fused mixed step has no PP path")
+        ptok, ppos, ppt, pkvl, plast, padapter = self._prep_prefill_packed(
+            chunks
+        )
+        B = _next_bucket(self.decode_buckets, len(positions))
+        pt = self._pad_page_table(page_tables, B)
+        MP = pt.shape[1]
+        packed = np.zeros(
+            B * (1 + MP) + (B if self.lora is not None else 0) + 1, np.int32
+        )
+        packed[:B] = -1
+        packed[: len(positions)] = positions
+        packed[B : B + B * MP] = pt.ravel()
+        if self.lora is not None and adapters:
+            packed[B + B * MP : B + B * MP + len(adapters)] = adapters
+        packed[-1] = step
+        tok_h = np.zeros(B, np.int32)
+        tok_h[: len(positions)] = tokens
+        toks, _, chunk_logits, self.k_pool, self.v_pool = self._jit_mixed(
+            n_steps, self.params, ptok, ppos, ppt, pkvl, plast,
             padapter, jnp.asarray(tok_h), jnp.asarray(packed),
             self.k_pool, self.v_pool, self._device_sampling(sampling, B),
             self.lora,

@@ -103,14 +103,22 @@ class DecodePlan:
 @dataclass
 class MixedPlan:
     """One engine iteration that co-schedules the running decode batch
-    with a bounded prefill chunk (vLLM-style chunked-prefill batching —
-    the semantics the reference's planner models,
-    docs/design-docs/planner-design.md:262). Decode runs first so ITL
-    never waits behind prompt processing; the chunk is capped at
-    `mixed_prefill_tokens` so its cost per iteration is bounded."""
+    with a token-budgeted SET of prefill chunks (vLLM-style chunked
+    prefill, extended to ragged packing — the semantics the reference's
+    planner models, docs/design-docs/planner-design.md:262). Decode runs
+    first so ITL never waits behind prompt processing; the chunks come
+    from distinct PREFILL sequences and their combined length is capped
+    at `mixed_prefill_tokens`, so the prefill cost per iteration stays
+    bounded no matter how many prompts are in flight."""
 
-    prefill: PrefillPlan
+    prefills: List[PrefillPlan]
     decode: DecodePlan
+
+    @property
+    def prefill(self) -> PrefillPlan:
+        """Oldest chunk — compatibility accessor for single-chunk-era
+        call sites (and the natural chunk for single-chunk fallbacks)."""
+        return self.prefills[0]
 
 
 @dataclass
@@ -134,6 +142,8 @@ class Scheduler:
         enable_prefix_cache: bool = True,
         decode_steps: int = 1,
         mixed_prefill_tokens: int = 256,
+        mixed_prefill_seqs: int = 8,
+        mixed_min_chunk: int = 16,
         host_tier=None,  # HostKvPool-like: .match(hashes) -> n
         host_onboard=None,  # cb(pages, hashes) -> bool (imports G2→G1 data)
         max_seq_tokens: int = 0,  # model context length (0 = page cap only)
@@ -149,12 +159,17 @@ class Scheduler:
         self.max_seq_tokens = int(max_seq_tokens or 0)
         self.enable_prefix_cache = enable_prefix_cache
         self.decode_steps = decode_steps
-        # co-scheduling budget: when decode work exists, prefill chunks are
-        # capped at this many tokens and run IN THE SAME iteration as the
-        # decode dispatch (0 = legacy strict prefill-first alternation).
+        # co-scheduling budget: when decode work exists, this is the POOL
+        # of prefill tokens per iteration, fair-shared across up to
+        # `mixed_prefill_seqs` PREFILL sequences (oldest first, at least
+        # `mixed_min_chunk` tokens each) and run IN THE SAME iteration as
+        # the decode dispatch (0 = legacy strict prefill-first
+        # alternation; mixed_prefill_seqs=1 = legacy single-chunk cap).
         # With no running sequences the full chunk_size still applies —
-        # the cap trades TTFT for bounded ITL only when both compete.
+        # the budget trades TTFT for bounded ITL only when both compete.
         self.mixed_prefill_tokens = mixed_prefill_tokens
+        self.mixed_prefill_seqs = max(1, mixed_prefill_seqs)
+        self.mixed_min_chunk = max(1, mixed_min_chunk)
         self.host_tier = host_tier
         self.host_onboard = host_onboard
         self.waiting: deque[Sequence] = deque()
@@ -186,15 +201,18 @@ class Scheduler:
         """Admit what fits, then plan this iteration's work.
 
         With `mixed_prefill_tokens > 0` the plan co-schedules: the whole
-        running batch decodes every iteration, and at most one bounded
-        prefill chunk rides along (MixedPlan). Strict prefill-first
-        alternation (mixed_prefill_tokens=0) stalls every decode for the
-        full chunk pipeline of each arriving prompt — the ITL inflation
-        the reference planner's chunked-prefill model exists to avoid."""
+        running batch decodes every iteration, and a token-budgeted set
+        of prefill chunks from distinct PREFILL sequences rides along
+        (MixedPlan). The budget is fair-shared oldest-first with a
+        per-seq minimum so one long prompt cannot starve the rest, and
+        leftover share from short prompts flows to the next in line.
+        Strict prefill-first alternation (mixed_prefill_tokens=0) stalls
+        every decode for the full chunk pipeline of each arriving
+        prompt — the ITL inflation the reference planner's
+        chunked-prefill model exists to avoid."""
         self._admit()
-        prefill_seq = next(
-            (s for s in self.active if s.state == SeqState.PREFILL), None
-        )
+        prefill_seqs = [s for s in self.active if s.state == SeqState.PREFILL]
+        prefill_seq = prefill_seqs[0] if prefill_seqs else None
         running = [s for s in self.active if s.state == SeqState.RUNNING]
         if prefill_seq is not None and (
             not running or self.mixed_prefill_tokens <= 0
@@ -224,11 +242,11 @@ class Scheduler:
         if prefill_seq is None:
             self._update_stats(len(running) * n_steps)
             return DecodePlan(running, n_steps)
-        pplan = self._plan_prefill(
-            prefill_seq, max_tokens=self.mixed_prefill_tokens
+        pplans = self._plan_prefills(prefill_seqs)
+        self._update_stats(
+            len(running) * n_steps + sum(len(p.chunk) for p in pplans)
         )
-        self._update_stats(len(running) * n_steps + len(pplan.chunk))
-        return MixedPlan(prefill=pplan, decode=DecodePlan(running, n_steps))
+        return MixedPlan(prefills=pplans, decode=DecodePlan(running, n_steps))
 
     # -- admission ---------------------------------------------------------
     def _admit(self) -> None:
@@ -308,6 +326,28 @@ class Scheduler:
             start_pos=start,
             is_last_chunk=end == len(seq.prompt),
         )
+
+    def _plan_prefills(self, cands: List[Sequence]) -> List[PrefillPlan]:
+        """Fair-share the `mixed_prefill_tokens` pool across up to
+        `mixed_prefill_seqs` PREFILL sequences, oldest first.
+
+        Each packed sequence is offered at least `mixed_min_chunk`
+        tokens (so progress is never sliced to nothing under load) and
+        at most its equal share of what is left — a long prompt at the
+        head of the line cannot drain the pool, and budget a short
+        prompt leaves unused flows to the sequences behind it."""
+        plans: List[PrefillPlan] = []
+        left = self.mixed_prefill_tokens
+        for i, seq in enumerate(cands):
+            if left <= 0 or len(plans) >= self.mixed_prefill_seqs:
+                break
+            slots = min(len(cands) - i, self.mixed_prefill_seqs - len(plans))
+            share = max(self.mixed_min_chunk, left // max(1, slots))
+            plan = self._plan_prefill(seq, max_tokens=min(share, left))
+            if plan.chunk:
+                plans.append(plan)
+                left -= len(plan.chunk)
+        return plans
 
     def complete_prefill(self, plan: PrefillPlan) -> None:
         seq = plan.seq

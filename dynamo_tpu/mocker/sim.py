@@ -10,6 +10,9 @@ every byte of the orchestration path.
 
 Timing model (fitted to v5e single-chip measurements; override per test):
   prefill(chunk)          = prefill_base_s + chunk_tokens * prefill_per_token_s
+  prefill_packed(chunks)  = prefill_base_s + sum(chunk_tokens)
+                            * prefill_per_token_s   (ONE dispatch base for
+                            the whole token-budget packed set)
   decode_multi(T, batch)  = dispatch_overhead_s + T * (decode_base_s +
                             batch * decode_per_seq_s)
 """
@@ -129,6 +132,23 @@ class SimRunner:
         # tokens chain deterministically off the fed token
         seed = tokens[-1] if tokens else 0
         return ("sim-logits", seed, start_pos + len(tokens))
+
+    def prefill_packed(self, chunks):
+        """Token-budget packed prefill: the whole chunk set rides ONE
+        simulated dispatch, so the step-time model charges the dispatch
+        base once plus the per-token cost of every packed token — the
+        timing shape of the runner's fused ragged program. Takes the
+        engine's chunk dicts ({"tokens", "start", ...}); returns one
+        sim-logits tuple per chunk."""
+        t = self.timing
+        total = sum(len(c["tokens"]) for c in chunks)
+        t.sleep(t.prefill_base_s + total * t.prefill_per_token_s)
+        out = []
+        for c in chunks:
+            toks = c["tokens"]
+            seed = toks[-1] if toks else 0
+            out.append(("sim-logits", seed, c["start"] + len(toks)))
+        return out
 
     def sample_one(self, logits, sampling, step: int, mask=None) -> int:
         _, seed, position = logits

@@ -216,7 +216,8 @@ def forward(
     v_pool: jax.Array,
     page_table: jax.Array,  # [B, MP]
     kv_lens: jax.Array,  # [B] context length AFTER this step's tokens
-    last_index: Optional[jax.Array] = None,  # scalar: only compute logits here
+    last_index: Optional[jax.Array] = None,  # scalar (or [B] per-row, for
+    #   ragged packed chunks): only compute logits at this position
     attn_impl: str = "jnp",  # "jnp" | "pallas" | "ring" (sequence-parallel)
     mesh=None,  # jax.sharding.Mesh, required for attn_impl="ring"
     sp_has_prior: bool = True,  # ring: False skips the paged prior-context
@@ -530,7 +531,14 @@ def forward(
     h = rms_norm(h, params["norm_f"], c.norm_eps,
                  zero_centered=c.norm_zero_centered)
     if last_index is not None:
-        h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, E]
+        if getattr(last_index, "ndim", 0) >= 1:
+            # ragged packed prefill: each batch row is a different chunk
+            # with its own last valid position
+            h = jnp.take_along_axis(
+                h, last_index.reshape(-1, 1, 1), axis=1
+            )  # [B, 1, E]
+        else:
+            h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)  # [B, 1, E]
     lm_head = params.get("lm_head")
     if lm_head is None:  # tied embeddings
         logits = tied_logits(h, params["embed"])

@@ -67,16 +67,19 @@ async def _recv_frame(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
 
 
 def _native_codec_on() -> bool:
-    """C++ frame codec opt-in (DYN_NATIVE_CODEC=1; reference
-    zero_copy_decoder.rs role): bulk-read both plane read loops and split
-    frames natively — one Python call per socket burst instead of two
-    awaited readexactly() per frame. Same wire protocol; rollout policy
-    mirrors attn_impl (flip the default after the hardware-host A/B)."""
+    """C++ frame codec (reference zero_copy_decoder.rs role): bulk-read
+    both plane read loops and split frames natively — one Python call per
+    socket burst instead of two awaited readexactly() per frame. Same
+    wire protocol. ON by default when the toolchain is available: the
+    scripts/bench_codec.py A/B has native ahead on every run even on a
+    single-core host (1.01-1.12x, docs/perf_notes.md), and the native
+    splitter additionally stays off the GIL on multi-core frontends.
+    DYN_NATIVE_CODEC=0 forces the pure-Python loop (and remains the
+    safety valve if a platform's build misbehaves)."""
     import os
 
-    if os.environ.get("DYN_NATIVE_CODEC", "").lower() not in (
-        "1", "true", "on", "yes"
-    ):
+    raw = os.environ.get("DYN_NATIVE_CODEC", "").lower()
+    if raw in ("0", "false", "off", "no"):
         return False
     try:
         from dynamo_tpu.native.frame_codec import available

@@ -92,9 +92,17 @@ def parse_args(argv=None):
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
     p.add_argument("--mixed-prefill-tokens", type=int, default=256,
-                   help="prefill chunk cap when co-scheduled with decode "
-                        "(0 = strict prefill-first). Align with a prefill "
-                        "bucket: the chunk pads to the next bucket anyway")
+                   help="per-iteration prefill token POOL when co-scheduled "
+                        "with decode: fair-shared across up to "
+                        "--mixed-prefill-seqs packed chunks from distinct "
+                        "sequences (0 = strict prefill-first). Align with a "
+                        "prefill bucket: the set pads to the next bucket")
+    p.add_argument("--mixed-prefill-seqs", type=int, default=8,
+                   help="max distinct prefills packed per iteration "
+                        "(1 = legacy single-chunk MixedPlan)")
+    p.add_argument("--mixed-min-chunk", type=int, default=16,
+                   help="fair-share floor: each packed sequence is offered "
+                        "at least this many prefill tokens per iteration")
     # speculative decoding
     p.add_argument("--draft-model", default=None,
                    help="draft model config preset (enables speculative decoding)")
@@ -373,6 +381,8 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         mixed_prefill_tokens=getattr(args, "mixed_prefill_tokens", 256),
+        mixed_prefill_seqs=getattr(args, "mixed_prefill_seqs", 8),
+        mixed_min_chunk=getattr(args, "mixed_min_chunk", 16),
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
         obj_kv_root=args.obj_kv_root,

@@ -93,6 +93,40 @@ def run_stage(cmd, timeout_s: float, extra_env=None):
     return rc, dt, tail, parsed
 
 
+def probe_diag(parsed) -> dict:
+    """Collapse tpu_probe's staged JSON lines into one diagnostics dict.
+    The split tells WHERE a down window is broken: tcp_connect_s present
+    with no libtpu_init_s = relay reachable but chip/init wedged (or the
+    init outlived the probe timeout); tcp_error = the tunnel itself is
+    down; both present = healthy, numbers show init vs network cost."""
+    out = {}
+    for obj in parsed:
+        stage = obj.get("probe_stage")
+        if stage == "tcp":
+            for k in ("endpoint", "tcp_connect_s", "tcp_error", "tcp_skipped"):
+                if obj.get(k) is not None:
+                    out[k] = obj[k]
+        elif stage == "full":
+            out["libtpu_init_s"] = obj.get("libtpu_init_s")
+            out["matmul_s"] = obj.get("matmul_s")
+    return out
+
+
+def probe_summary(diag: dict) -> str:
+    if diag.get("tcp_error"):
+        return f"tcp FAIL {diag['tcp_error']}"
+    parts = []
+    if diag.get("tcp_connect_s") is not None:
+        parts.append(f"tcp={diag['tcp_connect_s']:.3f}s")
+    if diag.get("libtpu_init_s") is not None:
+        parts.append(f"init={diag['libtpu_init_s']:.1f}s")
+    elif parts:
+        # relay answered but libtpu never finished initializing — the
+        # distinction VERDICT r3 asked for vs a plain "down"
+        parts.append("init=HUNG/failed")
+    return " ".join(parts) or "no probe diagnostics"
+
+
 def stage_ok(name: str, rc: int, parsed) -> bool:
     if rc != 0:
         return False
@@ -224,17 +258,22 @@ def main() -> int:
             log_line(log_path, "every stage has succeeded; exiting")
             return 0
         state["probe_attempts"] += 1
-        rc, dt, tail, _ = run_stage(
+        rc, dt, tail, probe_json = run_stage(
             [sys.executable, "scripts/tpu_probe.py"], args.probe_timeout)
+        diag = probe_diag(probe_json)
+        state["last_probe"] = {"rc": rc, "seconds": round(dt, 1), **diag,
+                               "ts": time.strftime("%Y-%m-%d %H:%M:%S")}
         if rc != 0:
             log_line(log_path, f"probe #{state['probe_attempts']} down "
-                     f"(rc={rc}, {dt:.0f}s): {tail.splitlines()[-1] if tail else ''}")
+                     f"(rc={rc}, {dt:.0f}s, {probe_summary(diag)}): "
+                     f"{tail.splitlines()[-1] if tail else ''}")
             save()
             time.sleep(args.interval)
             continue
 
         state["windows"] += 1
-        log_line(log_path, f"probe OK ({dt:.1f}s) — window #{state['windows']}, "
+        log_line(log_path, f"probe OK ({dt:.1f}s, {probe_summary(diag)}) — "
+                 f"window #{state['windows']}, "
                  f"running {len(pending)} pending stages")
         ran = []
         for name, cmd, timeout_s, env in pending:

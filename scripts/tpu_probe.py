@@ -3,18 +3,56 @@
 Run with `timeout 90 python scripts/tpu_probe.py`; exit 0 iff a matmul
 round-trips device->host. All timing/aliveness checks MUST end in a
 device->host read (block_until_ready lies through the relay).
+
+Emits staged-timing JSON lines so the supervisor can tell the failure
+modes apart instead of logging an undifferentiated "down":
+
+  {"probe_stage": "tcp", "endpoint": ..., "tcp_connect_s": ...}
+  {"probe_stage": "full", "libtpu_init_s": ..., "matmul_s": ...}
+
+TCP connect time is measured FIRST (against the relay endpoint in
+DYN_AXON_ENDPOINT / AXON_ENDPOINT, "host:port"; skipped when unset) and
+printed before jax is imported, so a libtpu init that hangs until the
+caller's kill still leaves the network-layer evidence on stdout:
+tcp ok + no full line = tunnel up, chip/init wedged; tcp refused =
+the relay itself is down.
 """
+import json
+import os
+import socket
 import sys
 import time
 
 import numpy as np
 
 
+def _tcp_probe() -> dict:
+    """Time a bare TCP connect to the relay endpoint (no protocol)."""
+    ep = os.environ.get("DYN_AXON_ENDPOINT") or os.environ.get("AXON_ENDPOINT")
+    if not ep or ":" not in ep:
+        return {"endpoint": ep or None, "tcp_connect_s": None,
+                "tcp_skipped": "no endpoint env (DYN_AXON_ENDPOINT)"}
+    host, _, port = ep.rpartition(":")
+    t0 = time.time()
+    try:
+        with socket.create_connection((host.strip("[]"), int(port)), timeout=10):
+            pass
+        return {"endpoint": ep, "tcp_connect_s": round(time.time() - t0, 4)}
+    except (OSError, ValueError) as e:
+        return {"endpoint": ep, "tcp_connect_s": None,
+                "tcp_error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+
 def main() -> int:
+    diag = _tcp_probe()
+    print(json.dumps({"probe_stage": "tcp", **diag}), flush=True)
+
+    t0 = time.time()
     import jax
     import jax.numpy as jnp
 
     devs = jax.devices()
+    init_s = round(time.time() - t0, 2)
     print("devices:", devs, flush=True)
     if devs[0].platform == "cpu":
         # a leaked JAX_PLATFORMS=cpu must never count as chip-alive —
@@ -25,7 +63,11 @@ def main() -> int:
     f = jax.jit(lambda a: a @ a)
     t0 = time.time()
     r = np.asarray(jax.device_get(f(x)))
-    print(f"matmul ok {r.shape} in {time.time()-t0:.1f}s", flush=True)
+    matmul_s = round(time.time() - t0, 2)
+    print(f"matmul ok {r.shape} in {matmul_s:.1f}s", flush=True)
+    print(json.dumps({"probe_stage": "full", "libtpu_init_s": init_s,
+                      "matmul_s": matmul_s,
+                      "tcp_connect_s": diag.get("tcp_connect_s")}), flush=True)
     return 0
 
 

@@ -541,6 +541,19 @@ def test_native_codec_splitter_roundtrip():
     assert got == frames
 
 
+def test_native_codec_default_on(monkeypatch):
+    """The native codec defaults ON when the toolchain is available
+    (bench_codec A/B: native ahead on every run, docs/perf_notes.md);
+    DYN_NATIVE_CODEC=0 is the opt-out safety valve."""
+    from dynamo_tpu.native.frame_codec import available
+    from dynamo_tpu.runtime.request_plane import _native_codec_on
+
+    monkeypatch.delenv("DYN_NATIVE_CODEC", raising=False)
+    assert _native_codec_on() == available()
+    monkeypatch.setenv("DYN_NATIVE_CODEC", "0")
+    assert _native_codec_on() is False
+
+
 async def test_native_codec_rpc_e2e(monkeypatch):
     """DYN_NATIVE_CODEC=1: both plane read loops run the bulk native
     splitter; streams, cancellation sentinels, and multi-frame bursts
