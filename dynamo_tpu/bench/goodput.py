@@ -116,6 +116,10 @@ def _make_engine(args, mocker: bool):
         mixed_prefill_seqs=getattr(args, "mixed_prefill_seqs", 8),
         mixed_min_chunk=getattr(args, "mixed_min_chunk", 16),
         host_kv_blocks=args.host_kv_blocks,
+        disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
+        prefetch=getattr(args, "prefetch", False),
+        prefetch_max_inflight=getattr(args, "prefetch_max_inflight", 4),
+        prefetch_bandwidth_mbps=getattr(args, "prefetch_bandwidth_mbps", 0.0),
     )
 
 
@@ -252,11 +256,27 @@ async def run_goodput(args) -> GoodputReport:
         results, duration = await run_trace_against_engine(
             trace, stack.generate, time_scale=args.time_scale, seed=args.seed
         )
+        # aggregate worker-side prefetch counters before teardown so a
+        # --prefetch A/B can tell "hints landed" from "nothing fired"
+        prefetch_stats = None
+        if getattr(args, "prefetch", False):
+            prefetch_stats = {}
+            for w in stack.workers:
+                pf = getattr(w.engine, "prefetch", None)
+                if pf is None:
+                    continue
+                for k, v in pf.stats.items():
+                    prefetch_stats[k] = prefetch_stats.get(k, 0) + v
     finally:
         await stack.close()
-    return compute_goodput(
+    report = compute_goodput(
         results, duration, ttft_slo_s=args.ttft_slo, itl_slo_s=args.itl_slo
     )
+    if prefetch_stats is not None:
+        report.extras["prefetch"] = {
+            k: round(v, 6) for k, v in prefetch_stats.items()
+        }
+    return report
 
 
 async def _warmup(stack, args) -> None:
@@ -332,6 +352,13 @@ def parse_args(argv=None):
     p.add_argument("--mixed-min-chunk", type=int, default=16,
                    help="fair-share floor per packed prefill sequence")
     p.add_argument("--host-kv-blocks", type=int, default=0)
+    p.add_argument("--disk-kv-blocks", type=int, default=0)
+    p.add_argument("--prefetch", action="store_true",
+                   help="router-hinted predictive KV promotion (needs "
+                        "--host-kv-blocks > 0); the off/on pair is the "
+                        "prefetch A/B")
+    p.add_argument("--prefetch-max-inflight", type=int, default=4)
+    p.add_argument("--prefetch-bandwidth-mbps", type=float, default=0.0)
     p.add_argument("--decode-buckets", type=int, nargs="+", default=[8, 16, 32])
     p.add_argument("--prefill-buckets", type=int, nargs="+",
                    default=[128, 256, 512])

@@ -131,10 +131,14 @@ class GoodputReport:
     ttft_p99_s: float
     itl_p50_s: float
     itl_p99_s: float
+    # optional bench-specific counters (e.g. aggregated prefetch stats);
+    # omitted from the JSON line when empty so existing parsers are stable
+    extras: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps({k: round(v, 4) if isinstance(v, float) else v
-                           for k, v in self.__dict__.items()})
+                           for k, v in self.__dict__.items()
+                           if not (k == "extras" and not v)})
 
 
 def _pct(vals: List[float], p: float) -> float:

@@ -78,6 +78,12 @@ class InferenceEngine:
         disk_kv_root: Optional[str] = None,
         obj_kv_root: Optional[str] = None,  # G4 object store (fs backend /
         #   shared mount; S3 via kvbm.object_store.S3Backend)
+        prefetch: bool = False,  # router-hinted tier promotion ahead of
+        #   dispatch (kvbm/prefetch.py; needs host_kv_blocks > 0)
+        prefetch_max_inflight: int = 4,  # concurrent G3→G2 reads
+        prefetch_bandwidth_mbps: float = 0.0,  # promoted bytes/s (0 = off)
+        prefetch_hint_ttl_s: float = 10.0,  # unserved hint cancellation
+        prefetch_pin_ttl_s: float = 5.0,  # promoted-block pin lifetime
         tokenizer_spec: str = "byte",  # guided decoding lifts byte DFAs to
         #   token masks against THIS tokenizer (must match the frontend's)
     ):
@@ -135,6 +141,21 @@ class InferenceEngine:
             self.host_pool = TieredKv(host, disk, obj)
             self.pool.evict_hook = self._offload_page
             self.host_pool.on_evict(self._on_host_evicted)
+        self.prefetch = None
+        if prefetch and self.host_pool is not None:
+            from dynamo_tpu.kvbm.prefetch import PrefetchManager
+
+            self.prefetch = PrefetchManager(
+                self,
+                max_inflight=prefetch_max_inflight,
+                bandwidth_mbps=prefetch_bandwidth_mbps,
+                hint_ttl_s=prefetch_hint_ttl_s,
+                pin_ttl_s=prefetch_pin_ttl_s,
+            )
+        elif prefetch:
+            log.warning(
+                "prefetch requested without a host KV tier "
+                "(host_kv_blocks=0); disabled")
         self.scheduler = Scheduler(
             self.pool,
             max_batch=max_batch,
@@ -284,6 +305,8 @@ class InferenceEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.prefetch is not None:
+            self.prefetch.stop()
 
     def on_fpm(self, cb) -> None:
         """cb(ForwardPassMetrics) from the step thread."""
@@ -470,6 +493,9 @@ class InferenceEngine:
         parents = list(hint.get("parents") or [])
         if not hashes or len(parents) != len(hashes):
             return
+        if (self.host_pool is not None
+                and self.host_pool.match(hashes) >= len(hashes)):
+            return  # already local (e.g. the prefetch hint pulled them)
         peer = int(hint.get("instance") or 0)
         now = time.monotonic()
         if now < self._remote_fetch_backoff.get(peer, 0.0):
@@ -489,6 +515,22 @@ class InferenceEngine:
         if n <= 0:
             return
         self._inbox.put(("host_import", (hashes[:n], parents[:n], payload)))
+
+    async def prefetch_hint_async(self, hint: Dict[str, Any]) -> bool:
+        """Router `kv_prefetch` hint ingress (worker_common endpoint):
+        promote the hinted blocks up the KVBM ladder before the request
+        itself arrives. A hint with a `remote` leg first pulls the peer's
+        G2 blocks into the local host tier (the cross-worker machinery the
+        admission path uses) — the inbox is FIFO, so the import lands
+        before the promotion looks for it."""
+        if self.prefetch is None:
+            return False
+        remote = hint.get("remote")
+        if (remote and self.host_pool is not None
+                and self.remote_kv_fetch is not None):
+            await self._pull_remote_host(remote)
+        self._inbox.put(("prefetch", hint))
+        return True
 
     # -- step loop (dedicated thread) --------------------------------------
     def _loop(self) -> None:
@@ -713,6 +755,12 @@ class InferenceEngine:
                 self._host_export(hashes, fut, loop)
             elif op == "host_import":
                 self._host_import(*arg)
+            elif op == "prefetch":
+                if self.prefetch is not None:
+                    self.prefetch.on_hint(arg)
+            elif op == "prefetch_disk":
+                if self.prefetch is not None:
+                    self.prefetch.on_disk_read(*arg)
             elif op == "reload_weights":
                 path, fut, loop = arg
                 try:
@@ -736,6 +784,8 @@ class InferenceEngine:
         self._admit_kv_pending()
         self._expire_parked()
         self._run_embeds()
+        if self.prefetch is not None:
+            self.prefetch.tick()
 
     def _kv_layout_mismatch(self, payload: Dict[str, Any]) -> Optional[str]:
         """Non-None when a host-staged payload can't be imported into the
@@ -1517,6 +1567,11 @@ class InferenceEngine:
         instead of trusting a partial import."""
         from dynamo_tpu.engine.model_runner import kv_arrays_to_payload
 
+        if self.prefetch is not None:
+            # any of these blocks still mid-promotion arrived LATE: this
+            # synchronous import wins, the prefetch job is cancelled (a
+            # duplicate in-flight import dedups via pool.register)
+            self.prefetch.note_sync_onboard(hashes)
         try:
             k, v = self.host_pool.get(hashes)
         except KeyError:
@@ -1526,10 +1581,15 @@ class InferenceEngine:
             # real engines need bytes (a hash-indexed block whose data is
             # gone — e.g. a shared G4 object deleted externally — must be
             # recomputed, not trusted); sim runners track KV at hash level
-            # only and None is their normal case
+            # only and None is their normal case — but the transfer still
+            # takes wall time, so charge the import (SimRunner sleeps it;
+            # without this, mocker prefetch A/Bs would credit the
+            # synchronous path with a free onboard)
             if hasattr(self.runner, "export_pages_device"):
                 log.info("lower-tier block has no data; recomputing")
                 return False
+            self.runner.import_pages(
+                pages, 0, {"sim": True, "data": True, "n_pages": len(pages)})
             return True
         self.runner.import_pages(pages, 0, kv_arrays_to_payload(k, v))
         return True

@@ -53,11 +53,21 @@ class PagePool:
         # offload hook: cb(page, block_hash, parent_hash) invoked just
         # before an evicted page's slot is reused (KVBM G1→G2 offload)
         self.evict_hook = None
+        # prefetch-pinned hashes: cached pages eviction must skip (promoted
+        # speculatively for an inbound request; pins are TTL-bounded by the
+        # PrefetchManager, never held across a pool reset)
+        self.pinned: set = set()
+        # cb(block_hash) when match_prefix claims a pinned hash (the
+        # prefetch hit signal; the pin is dropped before the call)
+        self.claim_hook = None
 
     # -- capacity ----------------------------------------------------------
     @property
     def n_free(self) -> int:
-        return len(self.free) + len(self.cached)
+        # pinned pages sit in `cached` but eviction skips them, so they are
+        # not allocatable headroom (pinned hashes always map to cached
+        # pages: pin() requires it, claiming unpins)
+        return len(self.free) + len(self.cached) - len(self.pinned)
 
     def usage(self) -> float:
         return 1.0 - self.n_free / self.num_pages
@@ -66,16 +76,23 @@ class PagePool:
     def _pop_free(self) -> int:
         if self.free:
             return self.free.pop()
-        # evict LRU cached page (offloading its contents first if hooked)
-        if self.cached:
-            page, _ = self.cached.popitem(last=False)
-            h = self.hash_of.pop(page)
+        # evict LRU cached page (offloading its contents first if hooked),
+        # skipping prefetch-pinned pages — if EVERY cached page is pinned
+        # the pool is genuinely out (pins are brief and TTL-bounded)
+        victim = None
+        for page in self.cached:
+            if self.hash_of[page] not in self.pinned:
+                victim = page
+                break
+        if victim is not None:
+            del self.cached[victim]
+            h = self.hash_of.pop(victim)
             del self.by_hash[h]
             parent = self.parent_of.pop(h, None)
             if self.evict_hook is not None:
-                self.evict_hook(page, h, parent)
+                self.evict_hook(victim, h, parent)
             self.events.append(KvEvent("remove", [h]))
-            return page
+            return victim
         raise NoSpace("no free or evictable pages")
 
     def alloc(self, n: int) -> List[int]:
@@ -102,6 +119,11 @@ class PagePool:
             hashes.append(h)
         for p in pages:
             self._ref_inc(p)
+        for h in hashes:
+            if h in self.pinned:  # prefetched block claimed by a request
+                self.pinned.discard(h)
+                if self.claim_hook is not None:
+                    self.claim_hook(h)
         return pages, hashes
 
     def _ref_inc(self, page: int) -> None:
@@ -123,6 +145,19 @@ class PagePool:
         self.parent_of[block_hash] = parent_hash
         self.events.append(KvEvent("store", [block_hash], parent_hash))
         return page
+
+    def pin(self, block_hash: int) -> bool:
+        """Shield a cached (registered, ref-0) page from eviction until
+        unpin/claim. Pinning a hash that is not a cached page is a no-op
+        (returns False) — the n_free accounting depends on the invariant."""
+        page = self.by_hash.get(block_hash)
+        if page is None or page not in self.cached:
+            return False
+        self.pinned.add(block_hash)
+        return True
+
+    def unpin(self, block_hash: int) -> None:
+        self.pinned.discard(block_hash)
 
     def release(self, pages: List[int]) -> None:
         """Drop one reference; refcount-0 registered pages go to the LRU
@@ -158,3 +193,4 @@ class PagePool:
         self.hash_of.clear()
         self.cached.clear()
         self.parent_of.clear()
+        self.pinned.clear()

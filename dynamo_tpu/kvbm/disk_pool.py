@@ -107,6 +107,9 @@ class DiskKvPool:
         # latency to the decode hot path. _pending holds not-yet-written
         # blocks so get_block stays consistent.
         self._pending: Dict[int, Tuple[Any, Any]] = {}
+        # prefetch pins: hashes capacity enforcement must not drop while a
+        # promotion read is queued/in flight (brief, TTL-bounded)
+        self._pinned: set = set()
         self._outstanding = 0  # queued-but-unprocessed writer items
         self._write_q: "queue.Queue" = queue.Queue()
         self._writer = threading.Thread(target=self._write_loop, daemon=True)
@@ -164,7 +167,40 @@ class DiskKvPool:
                 with self._lock:
                     self._outstanding -= 1
 
+    def pin(self, block_hash: int) -> None:
+        with self._lock:
+            self._pinned.add(block_hash)
+
+    def unpin(self, block_hash: int) -> None:
+        with self._lock:
+            self._pinned.discard(block_hash)
+
     def _process(self, item) -> None:
+        if item[0] == "read":
+            # async promotion read (G3→G2 prefetch): file IO rides this
+            # thread like the spills so the step thread never blocks on it
+            _, block_hash, parent, cb = item
+            with self._lock:
+                present = block_hash in self._blocks
+                pending = self._pending.get(block_hash)
+                hash_only = block_hash in self._hash_only
+            k = v = None
+            if present and pending is not None:
+                k, v = pending
+            elif present and not hash_only:
+                try:
+                    if os.path.exists(self._path(block_hash)):
+                        k, v = self._read_file(block_hash)
+                    else:
+                        present = False
+                except Exception:
+                    log.exception("G3 async read failed for %x", block_hash)
+                    k = v = None
+            try:
+                cb(block_hash, parent, k, v, present)
+            except Exception:
+                log.exception("G3 read callback failed for %x", block_hash)
+            return
         if item[0] == "spill":
             # deferred demotion of an already-flushed block: read the
             # file off the hot path, hand it down, then unlink
@@ -211,6 +247,7 @@ class DiskKvPool:
             self._blocks.clear()
             self._hash_only.clear()
             self._pending.clear()
+            self._pinned.clear()
         for h in dropped:
             try:
                 _os.unlink(self._path(h))
@@ -274,7 +311,13 @@ class DiskKvPool:
         spill_deferred = []
         with self._lock:
             while len(self._blocks) > self.capacity:
-                h, parent = self._blocks.popitem(last=False)
+                # LRU order, skipping prefetch-pinned blocks; all pinned →
+                # overshoot until the pins release (pins are TTL-bounded)
+                h = next(
+                    (b for b in self._blocks if b not in self._pinned), None)
+                if h is None:
+                    break
+                parent = self._blocks.pop(h)
                 pend = self._pending.pop(h, None)
                 dropped.append(h)
                 self.stats["evicted"] += 1
@@ -337,16 +380,48 @@ class DiskKvPool:
             return None, None
         return self._read_file(block_hash)
 
+    def read_block_async(self, block_hash: int, cb) -> bool:
+        """Queue a block read on the writer thread (G3→G2 prefetch
+        promotion: file IO off the step thread, behind any queued writes
+        for the same block). `cb(block_hash, parent, k, v, found)` fires
+        on the writer thread — k/v None for hash-only (sim) or corrupt
+        blocks, found=False if the block was evicted before the read ran.
+        Returns False (cb never fires) when the block is already absent."""
+        with self._lock:
+            if block_hash not in self._blocks:
+                return False
+            parent = self._blocks[block_hash]
+            self._blocks.move_to_end(block_hash)
+        self.stats["onboarded"] += 1
+        self._put_q(("read", block_hash, parent, cb))
+        return True
+
     def _read_file(self, block_hash: int):
-        with open(self._path(block_hash), "rb") as f:
-            try:
+        try:
+            with open(self._path(block_hash), "rb") as f:
                 _, k, v = decode_block(f.read())
-            except BlockLayoutMismatch:
-                # rescan drops stale-layout files, but a shared root can
-                # gain them underneath a live process — data miss
-                log.warning("block %x has a stale layout on disk; ignoring",
-                            block_hash)
-                return None, None
+        except BlockLayoutMismatch:
+            # rescan drops stale-layout files, but a shared root can
+            # gain them underneath a live process — data miss
+            log.warning("block %x has a stale layout on disk; ignoring",
+                        block_hash)
+            return None, None
+        except (OSError, KeyError, ValueError, struct.error):
+            # truncated or corrupt file (short header, bad JSON, short
+            # payload — e.g. half-written by a crashed process): a data
+            # miss the onboard path recomputes through, NEVER an exception
+            # into it. Unlink + drop the index entry so it stops matching.
+            log.warning("block %x truncated/corrupt on disk; unlinking",
+                        block_hash, exc_info=True)
+            try:
+                os.unlink(self._path(block_hash))
+            except OSError:
+                pass
+            with self._lock:
+                self._blocks.pop(block_hash, None)
+                self._hash_only.discard(block_hash)
+                self._pinned.discard(block_hash)
+            return None, None
         return k, v
 
     def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:

@@ -42,6 +42,16 @@ class HostKvPool:
         # demotion: called with the full HostBlock before an LRU drop so a
         # lower tier (G3 disk) can absorb the data
         self.spill_hook: Optional[Any] = None
+        # prefetch pins: hashes capacity enforcement must not drop (a
+        # promotion is reading them); capacity may transiently overshoot
+        # while pins are held — pins are brief and TTL-bounded
+        self._pinned: set = set()
+
+    def pin(self, block_hash: int) -> None:
+        self._pinned.add(block_hash)
+
+    def unpin(self, block_hash: int) -> None:
+        self._pinned.discard(block_hash)
 
     def on_evict(self, cb) -> None:
         """cb(list[int]) — hashes dropped from the host tier."""
@@ -75,10 +85,16 @@ class HostKvPool:
     def _enforce_capacity(self) -> None:
         dropped: List[int] = []
         while len(self._blocks) > self.capacity:
-            h, block = self._blocks.popitem(last=False)
+            # LRU order, skipping pinned blocks; all-pinned → overshoot
+            # until the pins release (prefetch pins are TTL-bounded)
+            victim = next(
+                (h for h in self._blocks if h not in self._pinned), None)
+            if victim is None:
+                break
+            block = self._blocks.pop(victim)
             if self.spill_hook is not None:
                 self.spill_hook(block)
-            dropped.append(h)
+            dropped.append(victim)
             self.stats["evicted"] += 1
         if dropped:
             for cb in self._evict_listeners:
@@ -90,6 +106,7 @@ class HostKvPool:
         router lower-tier credits drop too; returns the cleared hashes."""
         dropped = list(self._blocks)
         self._blocks.clear()
+        self._pinned.clear()
         if dropped:
             for cb in self._evict_listeners:
                 cb(dropped)

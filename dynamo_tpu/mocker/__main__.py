@@ -31,6 +31,18 @@ def parse_args(argv=None):
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
     p.add_argument("--decode-steps", type=int, default=4)
+    p.add_argument("--host-kv-blocks", type=int, default=0,
+                   help="G2 host KV tier capacity in blocks (0 = off)")
+    p.add_argument("--disk-kv-blocks", type=int, default=0,
+                   help="G3 disk KV tier capacity in blocks (needs G2 on)")
+    p.add_argument("--disk-kv-root", default=None)
+    p.add_argument("--prefetch", action="store_true",
+                   help="router-hinted predictive KV promotion (needs "
+                        "--host-kv-blocks > 0)")
+    p.add_argument("--prefetch-max-inflight", type=int, default=4)
+    p.add_argument("--prefetch-bandwidth-mbps", type=float, default=0.0)
+    p.add_argument("--prefetch-hint-ttl-s", type=float, default=10.0)
+    p.add_argument("--prefetch-pin-ttl-s", type=float, default=5.0)
     p.add_argument("--speed", type=float, default=1.0, help="timing scale; 0 = no sleeps")
     p.add_argument("--decode-base-ms", type=float, default=4.0)
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"])
@@ -50,6 +62,14 @@ def build_mock_engine(args) -> tuple[InferenceEngine, ModelCard]:
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         decode_steps=args.decode_steps,
+        host_kv_blocks=getattr(args, "host_kv_blocks", 0),
+        disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
+        disk_kv_root=getattr(args, "disk_kv_root", None),
+        prefetch=getattr(args, "prefetch", False),
+        prefetch_max_inflight=getattr(args, "prefetch_max_inflight", 4),
+        prefetch_bandwidth_mbps=getattr(args, "prefetch_bandwidth_mbps", 0.0),
+        prefetch_hint_ttl_s=getattr(args, "prefetch_hint_ttl_s", 10.0),
+        prefetch_pin_ttl_s=getattr(args, "prefetch_pin_ttl_s", 5.0),
     )
     card = ModelCard(
         name=args.model_name,

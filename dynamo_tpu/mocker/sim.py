@@ -33,6 +33,12 @@ class SimTiming:
     decode_base_s: float = 0.004
     decode_per_seq_s: float = 0.0003
     dispatch_overhead_s: float = 0.002
+    # host→device KV onboarding (import_pages): dispatch setup plus a
+    # per-page DMA cost. Charged by BOTH the synchronous admission-time
+    # onboard and the prefetch promotion path, so prefetch A/Bs measure
+    # overlap, not a fictional free copy.
+    onboard_base_s: float = 0.002
+    onboard_per_page_s: float = 0.0002
     speed: float = 1.0  # scale all sleeps; 0 disables (unit tests)
 
     def sleep(self, seconds: float) -> None:
@@ -198,4 +204,7 @@ class SimRunner:
         return {"data": True, "sim": True, "n_pages": len(pages)}
 
     def import_pages(self, target_pages, offset: int, payload) -> None:
-        pass
+        # the transfer isn't free: charge the step-time model so KVBM
+        # onboarding (sync or prefetched) costs simulated wall time
+        t = self.timing
+        t.sleep(t.onboard_base_s + len(target_pages) * t.onboard_per_page_s)

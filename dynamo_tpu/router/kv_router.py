@@ -46,6 +46,8 @@ class KvRouter:
         approx_ttl: float = 120.0,
         replica_sync: bool = False,
         admission: Optional["AdmissionConfig"] = None,
+        prefetch_hints: bool = True,  # emit kv_prefetch ahead of dispatch
+        #   to workers advertising a PrefetchManager (kvbm/prefetch.py)
     ):
         from dynamo_tpu.router.queue import AdmissionConfig, AdmissionQueue
 
@@ -81,6 +83,12 @@ class KvRouter:
         import uuid as _uuid
 
         self._replica_id = _uuid.uuid4().hex[:16]
+        # predictive prefetch plane (hint emission is fire-and-forget;
+        # instances whose hint endpoint errors are dropped from hinting)
+        self.prefetch_hints = prefetch_hints
+        self._prefetch_client = None  # lazy: {ns}/{comp}/kv_prefetch
+        self._prefetch_bad: set = set()
+        self._prefetch_tasks: set = set()
         self._sync_pub = None
         self._sync_sub = None
         self._sync_inst = None
@@ -429,6 +437,82 @@ class KvRouter:
             "parents": [anchor] + chain[:-1],
         }
 
+    # -- predictive prefetch (kvbm/prefetch.py) -----------------------------
+    def prefetch_hint(
+        self, hashes: List[int], selected: Worker, overlap: int,
+        seed: Optional[int],
+        host_overlaps: Optional[Dict[Worker, int]] = None,
+        remote: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """The blocks the selected worker will onboard from its lower
+        tiers (beyond its device overlap) — plus, when a remote_host_hint
+        exists, the peer blocks that pull will land in its G2. Emitted
+        ahead of dispatch so the worker's PrefetchManager overlaps the
+        promotion with the request's queueing time. None when there is
+        nothing below G1 worth promoting."""
+        if not self.prefetch_hints or not hashes:
+            return None
+        if selected[0] in self._prefetch_bad:
+            return None
+        inst = self.client.instances.get(selected[0])
+        if inst is None or not (inst.metadata or {}).get("kv_prefetch"):
+            return None  # worker runs no PrefetchManager
+        host = (host_overlaps if host_overlaps is not None
+                else self.indexer.host_index.find_matches(hashes).scores)
+        end = max(
+            [overlap] + [n for w, n in host.items() if w[0] == selected[0]]
+        )
+        if remote is not None:
+            # remote chain continues exactly where the local tiers end
+            # (remote_host_hint anchors it at local_best == end)
+            end += len(remote.get("hashes") or [])
+        chain = hashes[overlap:end]
+        if not chain:
+            return None
+        anchor = hashes[overlap - 1] if overlap > 0 else seed
+        hint: Dict[str, Any] = {
+            "hashes": chain, "parents": [anchor] + chain[:-1],
+        }
+        if remote is not None:
+            hint["remote"] = remote
+        return hint
+
+    def emit_prefetch(self, instance_id: int, hint: Dict[str, Any]) -> None:
+        """Fire-and-forget: the hint races the request by design — losing
+        the race only means the worker's synchronous onboard runs as it
+        always did."""
+        t = asyncio.get_running_loop().create_task(
+            self._send_prefetch(instance_id, hint))
+        self._prefetch_tasks.add(t)
+        t.add_done_callback(self._prefetch_tasks.discard)
+
+    async def _send_prefetch(self, instance_id: int, hint: Dict[str, Any]) -> None:
+        try:
+            if self._prefetch_client is None:
+                ns, comp = self.client.path.split("/")[:2]
+                # cache before the awaits (worker_common fetch-client
+                # idiom); start() is idempotent for concurrent first sends
+                self._prefetch_client = self.runtime.client(
+                    f"{ns}/{comp}/kv_prefetch")
+            await self._prefetch_client.start()
+            # the first hint after client creation races the discovery
+            # watch (worker_common._remote_kv_fetch idiom): wait briefly
+            # for the target instead of poisoning _prefetch_bad forever
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 2.0
+            while (instance_id not in self._prefetch_client.instances
+                   and loop.time() < deadline):
+                await asyncio.sleep(0.05)
+            async for _ in self._prefetch_client.direct(
+                {"kv_prefetch": hint}, instance_id
+            ):
+                break
+        except Exception as e:
+            # dead instance or a build without the endpoint: stop hinting
+            # it — hints are an optimization, never worth a retry storm
+            self._prefetch_bad.add(instance_id)
+            log.debug("kv_prefetch hint to %x failed: %s", instance_id, e)
+
     # -- lifecycle charging -------------------------------------------------
     def add_request(
         self, request_id: str, worker: Worker, hashes: List[int], overlap: int
@@ -464,6 +548,13 @@ class KvRouter:
             t.cancel()
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        for t in list(self._prefetch_tasks):
+            t.cancel()
+        if self._prefetch_client is not None:
+            try:
+                await self._prefetch_client.close()
+            except Exception:
+                pass
         if self._sync_inst is not None:
             try:
                 await self.runtime.discovery.unregister(self._sync_inst)
@@ -512,6 +603,14 @@ class KvPushRouter:
         if hint is not None:
             request = dict(request)
             request["kv_remote_host"] = hint
+        pf = self.router.prefetch_hint(
+            hashes, worker, overlap,
+            request_seed(request.get("adapter"), mm_seed),
+            host_overlaps=collect.get("host_overlaps"),
+            remote=hint,
+        )
+        if pf is not None:
+            self.router.emit_prefetch(worker[0], pf)
         rid = context.id
         self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap

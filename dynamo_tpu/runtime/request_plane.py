@@ -594,6 +594,12 @@ class PushRouter:
     # bridges the gap so migration retries don't re-pick a corpse and
     # exhaust their budget before the lease lapses.
     SICK_COOLDOWN_S = 5.0
+    # worker-published load goes stale after this long without an update:
+    # a crashed/wedged worker must not pin routing with its last value
+    # (a frozen low load would attract every request; a frozen high one
+    # would starve a recovered worker) — fall back to the local
+    # in-flight count until it publishes again.
+    EXT_LOAD_TTL_S = 15.0
 
     def __init__(self, endpoint_path: str, mode: str = RouterMode.ROUND_ROBIN):
         self.endpoint_path = endpoint_path
@@ -603,6 +609,7 @@ class PushRouter:
         self._rr = 0
         self._inflight: Dict[int, int] = {}  # instance_id -> outstanding reqs
         self._ext_load: Dict[int, float] = {}  # worker-published load
+        self._ext_load_ts: Dict[int, float] = {}  # last update (monotonic)
         self._weights: Dict[int, float] = {}  # published device capacity
         self._sick: Dict[int, float] = {}  # instance_id -> retry-after
 
@@ -611,6 +618,7 @@ class PushRouter:
             self._instances.pop(instance_id, None)
             self._inflight.pop(instance_id, None)
             self._ext_load.pop(instance_id, None)
+            self._ext_load_ts.pop(instance_id, None)
             self._weights.pop(instance_id, None)
             self._sick.pop(instance_id, None)
         else:
@@ -646,23 +654,47 @@ class PushRouter:
     def update_load(self, instance_id: int, load: Optional[float]) -> None:
         """Feed a worker-published load value (None clears it, falling back
         to the local outstanding-request count)."""
+        import time as _time
+
         if load is None:
             self._ext_load.pop(instance_id, None)
+            self._ext_load_ts.pop(instance_id, None)
         else:
             self._ext_load[instance_id] = load
+            self._ext_load_ts[instance_id] = _time.monotonic()
+
+    def _fresh_ext(self, instance_id: int, now: Optional[float] = None):
+        """The published load iff it is younger than EXT_LOAD_TTL_S;
+        lazily expires stale entries (mark_sick/sick_instances idiom)."""
+        ext = self._ext_load.get(instance_id)
+        if ext is None:
+            return None
+        import time as _time
+
+        if (now if now is not None else _time.monotonic()) - \
+                self._ext_load_ts.get(instance_id, 0.0) > self.EXT_LOAD_TTL_S:
+            self._ext_load.pop(instance_id, None)
+            self._ext_load_ts.pop(instance_id, None)
+            return None
+        return ext
 
     def load_of(self, instance_id: int) -> float:
-        ext = self._ext_load.get(instance_id)
+        ext = self._fresh_ext(instance_id)
         return ext if ext is not None else float(self._inflight.get(instance_id, 0))
 
     def _load_key(self, ids):
         """Comparable load metric across `ids`: worker-published load only
-        when EVERY candidate has published one — mixing published
+        when EVERY candidate has published one RECENTLY — mixing published
         utilization (0..1) with local in-flight counts (0..N) would
         systematically misroute toward whichever instance happens to have
-        the external signal."""
-        if all(i in self._ext_load for i in ids):
-            return self._ext_load.__getitem__
+        the external signal, and a stale publication (crashed or wedged
+        worker) would pin routing with its last value."""
+        import time as _time
+
+        now = _time.monotonic()
+        ext = {i: self._fresh_ext(i, now) for i in ids}
+        if all(v is not None for v in ext.values()):
+            return ext.__getitem__
         return lambda i: float(self._inflight.get(i, 0))
 
     @property

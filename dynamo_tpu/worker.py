@@ -88,6 +88,18 @@ def parse_args(argv=None):
     p.add_argument("--obj-kv-root", default=None,
                    help="G4 object-store root (shared mount; enables the "
                         "terminal KV tier)")
+    p.add_argument("--prefetch", action="store_true",
+                   help="router-hinted predictive KV promotion (needs "
+                        "--host-kv-blocks > 0); advertises kv_prefetch so "
+                        "routers send tier-promotion hints ahead of dispatch")
+    p.add_argument("--prefetch-max-inflight", type=int, default=4,
+                   help="max concurrent G3->G2 disk reads per worker")
+    p.add_argument("--prefetch-bandwidth-mbps", type=float, default=0.0,
+                   help="promotion bandwidth budget in MB/s (0 = unlimited)")
+    p.add_argument("--prefetch-hint-ttl-s", type=float, default=10.0,
+                   help="drop a hint whose request never arrives after this")
+    p.add_argument("--prefetch-pin-ttl-s", type=float, default=5.0,
+                   help="how long promoted blocks stay pinned against eviction")
     # batching
     p.add_argument("--max-batch", type=int, default=64)
     p.add_argument("--chunk-size", type=int, default=512)
@@ -386,6 +398,11 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
         obj_kv_root=args.obj_kv_root,
+        prefetch=getattr(args, "prefetch", False),
+        prefetch_max_inflight=getattr(args, "prefetch_max_inflight", 4),
+        prefetch_bandwidth_mbps=getattr(args, "prefetch_bandwidth_mbps", 0.0),
+        prefetch_hint_ttl_s=getattr(args, "prefetch_hint_ttl_s", 10.0),
+        prefetch_pin_ttl_s=getattr(args, "prefetch_pin_ttl_s", 5.0),
         tokenizer_spec=args.tokenizer,
     )
     if getattr(args, "shm_weights", None) or args.orbax_cache:

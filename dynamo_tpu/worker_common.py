@@ -382,6 +382,29 @@ async def serve_worker(
         instance_id=instance_id,
     )
 
+    # predictive prefetch plane (kvbm/prefetch.py): the router announces
+    # what the inbound request will need BEFORE dispatching it; the
+    # engine's PrefetchManager promotes those blocks up the KVBM ladder
+    # while the request is still queueing. Advertised via metadata so
+    # routers skip workers without a manager.
+    if getattr(engine, "prefetch", None) is not None:
+        metadata["kv_prefetch"] = True
+        # counters must live in the runtime's registry or the status
+        # port's /metrics never sees them
+        engine.prefetch.bind_metrics(runtime.metrics.child(dynamo_namespace=namespace))
+
+    async def kv_prefetch(request, context):
+        hint = (request or {}).get("kv_prefetch") or {}
+        ok = False
+        if getattr(engine, "prefetch", None) is not None and hint:
+            ok = await engine.prefetch_hint_async(hint)
+        yield {"ok": bool(ok)}
+
+    await runtime.serve_endpoint(
+        f"{namespace}/{component}/kv_prefetch", kv_prefetch,
+        instance_id=instance_id,
+    )
+
     _fetch_clients: dict = {}
 
     async def _remote_kv_fetch(hint):

@@ -1,0 +1,77 @@
+"""Tier-1 test-suite health gate: fail loudly on collection errors.
+
+Runs pytest in collection-only mode over tests/ (the tier-1 suite) and
+exits non-zero if any test file fails to import or collect. A broken
+import silently shrinks the suite under --continue-on-collection-errors,
+so CI and pre-commit hooks should run this first to make shrinkage loud
+instead. CPU-only, no tests are executed. Run:
+
+    python scripts/check_tier1.py [--tests-dir tests]
+
+Prints one JSON line {"metric": "tier1_collection", "ok": ...,
+"collected": ..., "errors": ...} and exits 0 only when collection is
+clean and non-empty.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tests-dir", default="tests",
+                    help="test directory relative to the repo root")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="collection timeout in seconds")
+    args = ap.parse_args()
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable, "-m", "pytest", args.tests_dir, "-q",
+        "--collect-only", "-m", "not slow", "-p", "no:cacheprovider",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=args.timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"metric": "tier1_collection", "ok": False,
+                          "collected": 0, "errors": -1,
+                          "detail": "collection timed out"}))
+        print("TIER-1 CHECK FAILED: pytest collection timed out",
+              file=sys.stderr)
+        return 2
+
+    out = proc.stdout + proc.stderr
+    # pytest -q --collect-only ends with e.g. "123 tests collected in 1.2s"
+    # or "120/123 tests collected (3 errors)" / "no tests collected"
+    m = re.search(r"(\d+)(?:/\d+)? tests? collected", out)
+    collected = int(m.group(1)) if m else 0
+    m_err = re.search(r"(\d+) errors?", out)
+    errors = int(m_err.group(1)) if m_err else 0
+    ok = proc.returncode == 0 and errors == 0 and collected > 0
+
+    print(json.dumps({"metric": "tier1_collection", "ok": ok,
+                      "collected": collected, "errors": errors}))
+    if not ok:
+        # loud: surface the collection tracebacks so the broken import is
+        # visible in CI logs, not just the count
+        print("TIER-1 CHECK FAILED: test collection is broken or empty",
+              file=sys.stderr)
+        tail = "\n".join(out.splitlines()[-60:])
+        print(tail, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

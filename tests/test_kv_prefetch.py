@@ -1,0 +1,454 @@
+"""Predictive KV prefetch plane (kvbm/prefetch.py): router-hinted tier
+promotion overlapped with request queueing. Covers the acceptance
+behaviors: hint → async promote → the scheduler claims warm blocks with
+no synchronous onboard; hint/pin TTL expiry; bandwidth + in-flight caps;
+eviction respecting pins at every tier; and the late-arrival fallback to
+the synchronous onboard path (byte-identical output either way)."""
+
+import asyncio
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.engine.kv_pool import NoSpace, PagePool
+from dynamo_tpu.kvbm.disk_pool import DiskKvPool
+from dynamo_tpu.kvbm.host_pool import HostKvPool
+from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens.hashing import block_hashes
+
+PS = 4
+
+
+# -- eviction respects pins (every tier) -------------------------------------
+
+
+def test_page_pool_eviction_respects_pins():
+    pool = PagePool(4, PS)
+    pages = pool.alloc(4)
+    hashes = [11, 12, 13, 14]
+    for pg, h, p in zip(pages, hashes, [None, 11, 12, 13]):
+        pool.register(pg, h, p)
+    pool.release(pages)  # all four registered, ref 0 → LRU cache
+    assert pool.n_free == 4
+
+    assert pool.pin(12) and pool.pin(13)
+    assert pool.n_free == 2  # pinned pages are not allocatable headroom
+
+    pool.alloc(2)  # must evict the two UNPINNED pages (11, 14)
+    assert 12 in pool.by_hash and 13 in pool.by_hash
+    assert 11 not in pool.by_hash and 14 not in pool.by_hash
+
+    with pytest.raises(NoSpace):
+        pool.alloc(1)  # only pinned cache left
+    pool.unpin(12)
+    pool.alloc(1)  # evictable again
+    assert 13 in pool.by_hash  # the still-pinned block survived throughout
+
+
+def test_page_pool_pin_requires_cached_page():
+    pool = PagePool(2, PS)
+    assert pool.pin(999) is False  # unknown hash: no-op
+    (pg,) = pool.alloc(1)
+    pool.register(pg, 21, None)
+    assert pool.pin(21) is False  # in use (ref > 0), not cached
+    pool.release([pg])
+    assert pool.pin(21) is True
+    pool.reset()
+    assert not pool.pinned  # reset never leaks pins
+
+
+def test_page_pool_claim_hook_fires_on_pinned_match():
+    pool = PagePool(4, PS)
+    toks = list(range(40, 48))  # 2 blocks
+    hashes = block_hashes(toks, PS)
+    pages = pool.alloc(2)
+    for pg, h, p in zip(pages, hashes, [None, hashes[0]]):
+        pool.register(pg, h, p)
+    pool.release(pages)
+    claimed = []
+    pool.claim_hook = claimed.append
+    assert pool.pin(hashes[0]) and pool.pin(hashes[1])
+    got_pages, got_hashes = pool.match_prefix(toks)
+    assert got_hashes == hashes and len(got_pages) == 2
+    assert claimed == hashes  # hit signal per pinned block
+    assert not pool.pinned  # claiming unpins
+
+
+def test_host_pool_eviction_respects_pins():
+    pool = HostKvPool(capacity_blocks=2)
+    k = np.ones((2, 3, PS, 1, 8), np.float32)
+    pool.pin(101)
+    pool.put([101, 102, 103], [None, 101, 102], k, k)
+    # LRU victim would be 101, but it is pinned → 102 drops instead
+    assert 101 in pool and 103 in pool and 102 not in pool
+    pool.unpin(101)
+    k1 = np.ones((2, 1, PS, 1, 8), np.float32)
+    pool.put([104], [103], k1, k1)
+    assert 101 not in pool  # unpinned → ordinary LRU victim
+
+    # all pinned: capacity overshoots rather than dropping a pinned block
+    for h in (103, 104):
+        pool.pin(h)
+    pool.pin(105)
+    pool.put([105], [104], k1, k1)
+    assert len(pool) == 3
+
+
+def test_disk_pool_eviction_respects_pins(tmp_path):
+    pool = DiskKvPool(str(tmp_path), capacity_blocks=2)
+    k = np.arange(2 * PS * 1 * 8, dtype=np.float32).reshape(2, PS, 1, 8)
+    pool.put_block(201, None, k, k)
+    pool.pin(201)
+    pool.put_block(202, 201, k, k)
+    pool.put_block(203, 202, k, k)
+    assert 201 in pool and 203 in pool and 202 not in pool
+    pool.unpin(201)
+    pool.put_block(204, 203, k, k)
+    assert 201 not in pool
+
+
+def test_disk_read_block_async(tmp_path):
+    pool = DiskKvPool(str(tmp_path), capacity_blocks=8)
+    k = np.arange(2 * PS * 1 * 8, dtype=np.float32).reshape(2, PS, 1, 8)
+    pool.put_block(301, None, k, k * 2)
+    pool.flush()
+    results = []
+    done = threading.Event()
+
+    def cb(*args):
+        results.append(args)
+        done.set()
+
+    assert pool.read_block_async(301, cb) is True
+    assert done.wait(5), "callback must fire on the writer thread"
+    h, parent, kk, vv, found = results[0]
+    assert (h, parent, found) == (301, None, True)
+    np.testing.assert_array_equal(kk, k)
+    np.testing.assert_array_equal(vv, k * 2)
+    # absent block: refused synchronously, callback never queued
+    assert pool.read_block_async(999, cb) is False
+
+
+# -- manual-drive sim engines: TTLs, budget, in-flight cap --------------------
+# The engine is NOT started; the test thread drives _drain_inbox() itself and
+# injects a fake clock into the manager, so TTL and token-bucket behavior is
+# fully deterministic.
+
+
+def _sim_engine(**kw):
+    runner = SimRunner(
+        num_pages=16, page_size=PS, max_pages_per_seq=8,
+        timing=SimTiming(speed=0),
+    )
+    return InferenceEngine(
+        runner, max_batch=2, chunk_size=32, prefetch=True, **kw)
+
+
+def _fake_clock(manager, start=0.0):
+    t = [start]
+    manager._clock = lambda: t[0]
+    manager._last_refill = start
+    return t
+
+
+def test_hint_promotes_host_blocks_and_pins():
+    eng = _sim_engine(host_kv_blocks=32)
+    pf = eng.prefetch
+    hashes = [101, 102, 103]
+    parents = [None, 101, 102]
+    eng.host_pool.put(hashes, parents, None, None)  # hash-only (sim) G2
+    eng._inbox.put(("prefetch", {"hashes": hashes, "parents": parents}))
+    eng._drain_inbox()
+    assert pf.stats["promoted"] == 3
+    assert all(h in eng.pool.by_hash for h in hashes)  # device-resident
+    assert set(eng.pool.pinned) == set(hashes)
+    # re-hinting warm blocks is a no-op
+    eng._inbox.put(("prefetch", {"hashes": hashes, "parents": parents}))
+    eng._drain_inbox()
+    assert pf.stats["promoted"] == 3 and pf.stats["hinted_blocks"] == 3
+
+
+def test_pin_ttl_expiry_unpins_promoted_blocks():
+    eng = _sim_engine(host_kv_blocks=32, prefetch_pin_ttl_s=5.0)
+    pf = eng.prefetch
+    t = _fake_clock(pf)
+    hashes = [111, 112]
+    eng.host_pool.put(hashes, [None, 111], None, None)
+    eng._inbox.put(("prefetch", {"hashes": hashes, "parents": [None, 111]}))
+    eng._drain_inbox()
+    assert set(eng.pool.pinned) == set(hashes)
+    t[0] = 4.9
+    eng._drain_inbox()
+    assert set(eng.pool.pinned) == set(hashes)  # pins hold until the TTL
+    t[0] = 5.1
+    eng._drain_inbox()
+    assert not eng.pool.pinned
+    assert pf.stats["cancelled"] == 2
+    # the pages stay registered as ordinary LRU cache — just evictable now
+    assert all(h in eng.pool.by_hash for h in hashes)
+
+
+def test_hint_ttl_expiry_cancels_unserved_hints():
+    eng = _sim_engine(
+        host_kv_blocks=32, prefetch_bandwidth_mbps=1.0,
+        prefetch_hint_ttl_s=10.0,
+    )
+    pf = eng.prefetch
+    t = _fake_clock(pf)
+    pf._bps = 0.0  # still budget-limited, but no refill: hints stay QUEUED
+    pf._budget_bytes = 0.0
+    hashes = [121, 122, 123]
+    eng.host_pool.put(hashes, [None, 121, 122], None, None)
+    eng._inbox.put(("prefetch", {"hashes": hashes, "parents": [None, 121, 122]}))
+    eng._drain_inbox()
+    assert pf.stats["hinted_blocks"] == 3 and pf.stats["promoted"] == 0
+    t[0] = 9.9
+    eng._drain_inbox()
+    assert pf.stats["cancelled"] == 0
+    t[0] = 10.1
+    eng._drain_inbox()
+    assert pf.stats["cancelled"] == 3
+    assert not pf._jobs and not eng.pool.pinned
+
+
+def test_bandwidth_budget_gates_promotions():
+    eng = _sim_engine(
+        host_kv_blocks=64, prefetch_bandwidth_mbps=1.0,
+        prefetch_hint_ttl_s=1e9,  # the fake clock leaps far past real TTLs
+    )
+    pf = eng.prefetch
+    t = _fake_clock(pf)
+    pf._budget_bytes = pf._bps * 0.1  # 100 KB; one 256 KB sim block allowed
+    hashes = list(range(131, 137))  # 6 blocks
+    parents = [None] + hashes[:-1]
+    eng.host_pool.put(hashes, parents, None, None)
+    eng._inbox.put(("prefetch", {"hashes": hashes, "parents": parents}))
+    eng._drain_inbox()
+    # dispatch is gated on a positive balance; the first promotion
+    # overdraws it, so exactly one block moves per budget window
+    assert pf.stats["promoted"] == 1
+    eng._drain_inbox()  # no time passed → no refill → no progress
+    assert pf.stats["promoted"] == 1
+    # a long idle refills to the burst cap (0.5 s worth = 2 sim blocks)
+    t[0] = 100.0
+    eng._drain_inbox()
+    assert pf.stats["promoted"] == 3
+    t[0] = 200.0
+    eng._drain_inbox()
+    assert pf.stats["promoted"] == 5
+    t[0] = 300.0
+    eng._drain_inbox()
+    assert pf.stats["promoted"] == 6
+    assert pf.stats["bytes_promoted"] == 6 * pf.sim_block_bytes
+
+
+def test_max_inflight_caps_concurrent_disk_reads(tmp_path):
+    eng = _sim_engine(
+        host_kv_blocks=32, disk_kv_blocks=64, disk_kv_root=str(tmp_path),
+        prefetch_max_inflight=2,
+    )
+    pf = eng.prefetch
+    disk = eng.host_pool.disk
+    hashes = list(range(141, 147))  # 6 disk-resident (hash-only) blocks
+    parents = [None] + hashes[:-1]
+    for h, p in zip(hashes, parents):
+        disk.put_block(h, p, None, None)
+    eng._inbox.put(("prefetch", {"hashes": hashes, "parents": parents}))
+    deadline = time.monotonic() + 10
+    while pf.stats["promoted"] < 6 and time.monotonic() < deadline:
+        eng._drain_inbox()  # read results arrive via the inbox
+        time.sleep(0.005)
+    assert pf.stats["promoted"] == 6
+    assert pf.stats["reading_peak"] == 2  # never more than max_inflight
+    assert all(h in eng.pool.by_hash for h in hashes)
+
+
+def test_hint_for_unknown_block_is_dropped():
+    eng = _sim_engine(host_kv_blocks=32)
+    pf = eng.prefetch
+    eng._inbox.put(("prefetch", {"hashes": [9999], "parents": [None]}))
+    eng._drain_inbox()
+    assert pf.stats["lost"] == 1 and not pf._jobs  # no tier holds it
+
+
+# -- router-side hint construction (unit) -------------------------------------
+
+
+def _fake_kv_router(host_scores, instances):
+    from dynamo_tpu.router.protocols import OverlapScores
+
+    return SimpleNamespace(
+        prefetch_hints=True,
+        _prefetch_bad=set(),
+        client=SimpleNamespace(
+            path="ns/comp/generate",
+            instances={
+                iid: SimpleNamespace(metadata=md) for iid, md in instances.items()
+            },
+        ),
+        indexer=SimpleNamespace(
+            host_index=SimpleNamespace(
+                find_matches=lambda hashes: OverlapScores(scores=host_scores)
+            )
+        ),
+    )
+
+
+def test_router_prefetch_hint_chain_and_gating():
+    from dynamo_tpu.router.kv_router import KvRouter
+
+    hashes = [11, 12, 13, 14]
+    r = _fake_kv_router(
+        host_scores={(0xA, 0): 3}, instances={0xA: {"kv_prefetch": True}})
+    # device overlap 1, host residency 3 → promote blocks [1:3)
+    hint = KvRouter.prefetch_hint(r, hashes, (0xA, 0), 1, None)
+    assert hint == {"hashes": [12, 13], "parents": [11, 12]}
+
+    # overlap 0 with an adapter seed anchors the chain at the seed
+    hint = KvRouter.prefetch_hint(r, hashes, (0xA, 0), 0, 777)
+    assert hint == {"hashes": [11, 12, 13], "parents": [777, 11, 12]}
+
+    # a remote pull extends the chain past the local host residency
+    remote = {"instance": 0xB, "path": "ns/comp/kv_host_fetch",
+              "hashes": [14], "parents": [13]}
+    hint = KvRouter.prefetch_hint(
+        r, hashes, (0xA, 0), 1, None, remote=remote)
+    assert hint["hashes"] == [12, 13, 14] and hint["remote"] is remote
+
+    # device already covers the lower-tier run → nothing to promote
+    assert KvRouter.prefetch_hint(r, hashes, (0xA, 0), 3, None) is None
+    # workers that don't advertise kv_prefetch never get hints
+    r2 = _fake_kv_router(host_scores={(0xA, 0): 3}, instances={0xA: {}})
+    assert KvRouter.prefetch_hint(r2, hashes, (0xA, 0), 1, None) is None
+    # per-instance failure cache disables emission
+    r.client.instances[0xA].metadata = {"kv_prefetch": True}
+    r._prefetch_bad.add(0xA)
+    assert KvRouter.prefetch_hint(r, hashes, (0xA, 0), 1, None) is None
+
+
+# -- real tiny engine: promote → claim, and the late fallback -----------------
+
+
+async def _generate(engine, prompt, n=4):
+    toks = []
+    req = {
+        "token_ids": prompt,
+        "sampling": {"temperature": 0.0},
+        "stop": {"max_tokens": n, "stop_ids": []},
+    }
+    async for item in engine.generate(req, Context()):
+        toks.extend(item["token_ids"])
+        if item["finish_reason"]:
+            break
+    return toks
+
+
+def _tiny_runner():
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    return ModelRunner(
+        get_config("tiny"),
+        num_pages=16,
+        page_size=PS,
+        max_pages_per_seq=8,
+        decode_buckets=(1, 2),
+        prefill_buckets=(8, 16, 32),
+        seed=11,
+    )
+
+
+@pytest.fixture(scope="module")
+def prefetch_engine():
+    engine = InferenceEngine(
+        _tiny_runner(), max_batch=2, chunk_size=32, host_kv_blocks=64,
+        prefetch=True,
+    )
+    engine.start()
+    yield engine
+    engine.stop()
+
+
+async def test_hint_promotes_and_request_claims_without_sync_onboard(
+    prefetch_engine,
+):
+    eng = prefetch_engine
+    pf = eng.prefetch
+    prompt_a = list(range(30, 46))  # 16 tokens = 4 pages
+    out_a = await _generate(eng, prompt_a)
+
+    # churn the device pool until A's pages demote to the host tier
+    for i in range(6):
+        await _generate(eng, [100 + 7 * i + j for j in range(16)])
+    await asyncio.sleep(0.05)
+    assert eng.host_pool.stats["offloaded"] > 0
+
+    hashes = block_hashes(prompt_a, PS)
+    parents = [None] + hashes[:-1]
+    assert await eng.prefetch_hint_async(
+        {"hashes": hashes, "parents": parents})
+    # promotion is asynchronous w.r.t. the request: wait for the blocks to
+    # become device-resident with no request in flight at all
+    for _ in range(300):
+        if all(h in eng.pool.by_hash for h in hashes):
+            break
+        await asyncio.sleep(0.02)
+    assert all(h in eng.pool.by_hash for h in hashes)
+    assert pf.stats["promoted"] >= 1
+
+    onboarded_before = eng.host_pool.stats["onboarded"]
+    hits_before = pf.stats["hits"]
+    out_a2 = await _generate(eng, prompt_a)
+    assert out_a2 == out_a, "prefetched KV must reproduce identical output"
+    assert eng.host_pool.stats["onboarded"] == onboarded_before, \
+        "the request must claim warm blocks with NO synchronous onboard"
+    assert pf.stats["hits"] > hits_before  # pinned blocks were claimed
+    assert not eng.pool.pinned  # claims released every pin
+
+
+async def test_late_request_falls_back_to_sync_path_bit_identical(tmp_path):
+    """A request arriving mid-promote (disk reads still in flight) must be
+    served by the untouched synchronous onboard path, byte-identically."""
+    engine = InferenceEngine(
+        _tiny_runner(), max_batch=2, chunk_size=32, host_kv_blocks=2,
+        disk_kv_blocks=64, disk_kv_root=str(tmp_path), prefetch=True,
+    )
+    engine.start()
+    try:
+        pf = engine.prefetch
+        prompt = list(range(50, 66))
+        out = await _generate(engine, prompt)
+        for i in range(8):  # churn until A's blocks spill host → disk
+            await _generate(engine, [200 + 5 * i + j for j in range(16)])
+        await asyncio.sleep(0.05)
+        assert engine.host_pool.stats["disk_offloaded"] > 0
+
+        # stall the promotion reads: hints park in READING forever
+        disk = engine.host_pool.disk
+        stalled = []
+        disk.read_block_async = lambda h, cb: (stalled.append(h), True)[1]
+        try:
+            hashes = block_hashes(prompt, PS)
+            await engine.prefetch_hint_async(
+                {"hashes": hashes, "parents": [None] + hashes[:-1]})
+            for _ in range(200):
+                if stalled:
+                    break
+                await asyncio.sleep(0.01)
+            assert stalled, "promotion must have dispatched disk reads"
+
+            out2 = await _generate(engine, prompt)
+            assert out2 == out, "sync fallback must be byte-identical"
+            assert pf.stats["late"] >= 1, \
+                "mid-promote arrival must be accounted as late"
+        finally:
+            del disk.read_block_async  # restore the bound method
+            for h in stalled:
+                disk.unpin(h)
+    finally:
+        engine.stop()

@@ -311,3 +311,48 @@ def test_disk_pool_stale_layout_mid_chain_is_data_miss(tmp_path):
     path[0].write_bytes(data)
 
     assert pool.get([301, 302, 303]) == (None, None)
+
+
+@pytest.mark.parametrize(
+    "corrupt",
+    ["short_header", "bad_json", "short_payload", "truncated_len"],
+)
+def test_disk_pool_corrupt_file_is_miss_and_unlinked(tmp_path, corrupt):
+    """Truncated/corrupt block files (half-written by a crashed process,
+    disk error) must read as a data miss — unlinked and dropped from the
+    index, never an exception into the onboard path."""
+    import json
+    import struct
+
+    import numpy as np
+
+    from dynamo_tpu.kvbm.disk_pool import BLOCK_LAYOUT_VERSION, DiskKvPool
+
+    pool = DiskKvPool(str(tmp_path), capacity_blocks=8)
+    k = np.arange(2 * 4 * 1 * 8, dtype=np.float32).reshape(2, 4, 1, 8)
+    pool.put_block(401, None, k, k)
+    pool.flush()
+    path = next(p for p in tmp_path.glob("*.kvb"))
+
+    header = json.dumps(
+        {"shape": list(k.shape), "dtype": str(k.dtype), "parent": None,
+         "layout": BLOCK_LAYOUT_VERSION}
+    ).encode()
+    if corrupt == "short_header":
+        path.write_bytes(b"\x03")  # not even a full 8-byte length field
+    elif corrupt == "bad_json":
+        path.write_bytes(struct.pack("<Q", 16) + b"{not json at all" + b"x" * 64)
+    elif corrupt == "short_payload":
+        # valid header, but the k/v bytes were cut off mid-write
+        path.write_bytes(struct.pack("<Q", len(header)) + header + k.tobytes()[:40])
+    else:  # truncated_len: header length field points past EOF mid-JSON
+        path.write_bytes(struct.pack("<Q", 1 << 20) + header[:20])
+
+    assert pool.get_block(401) == (None, None)  # miss, not an exception
+    assert not path.exists(), "corrupt file must be unlinked"
+    assert 401 not in pool, "index entry must drop so it stops matching"
+    # and the multi-block read path degrades the same way
+    pool.put_block(402, None, k, k)
+    pool.flush()
+    k2, _v2 = pool.get_block(402)
+    assert k2 is not None  # healthy sibling still serves

@@ -79,6 +79,107 @@ async def test_admission_queue_timeout_rejects():
     await asyncio.wait_for(t, 1)
 
 
+async def test_admission_queue_priority_ties_drain_fifo():
+    """Within one priority class the queue is strictly FIFO: releasing one
+    slot at a time must wake waiters in arrival order, never heap order."""
+    q, load = _queue()
+    load[(1, 0)] = 5
+    order = []
+
+    async def waiter(tag):
+        await q.acquire(1)
+        order.append(tag)
+
+    tags = [f"w{i}" for i in range(6)]
+    tasks = [asyncio.create_task(waiter(t)) for t in tags]
+    await asyncio.sleep(0.05)
+    assert q.depth == 6
+    for _ in tags:
+        q.notify(1)
+        await asyncio.sleep(0.01)
+    await asyncio.gather(*tasks)
+    assert order == tags
+
+
+async def test_admission_queue_cancelled_waiter_passes_wakeup_on(monkeypatch):
+    """A waiter cancelled AFTER notify() granted it must hand the wakeup to
+    the next waiter — the capacity it represents is real, and losing it
+    would stall the queue until an unrelated request completes.
+
+    Python 3.10's wait_for swallows a cancellation that races a completed
+    future (bpo-37658) — the waiter then just completes and the caller's
+    cancellation lands at its next await, so nothing is lost. On >=3.12 the
+    cancellation wins and acquire's hand-off branch is load-bearing; this
+    shim models that delivery so the branch is exercised deterministically
+    on either interpreter."""
+
+    async def strict_wait_for(fut, timeout):
+        loop = asyncio.get_running_loop()
+        waiter = loop.create_future()
+        timed_out = []
+
+        def on_timeout():
+            timed_out.append(True)
+            if not waiter.done():
+                waiter.cancel()
+
+        cb = lambda _f: None if waiter.done() else waiter.set_result(None)
+        fut.add_done_callback(cb)
+        handle = loop.call_later(timeout, on_timeout)
+        try:
+            try:
+                await waiter
+            except asyncio.CancelledError:
+                if timed_out:
+                    raise asyncio.TimeoutError from None
+                raise  # task cancellation beats the completed future
+            return fut.result()
+        finally:
+            handle.cancel()
+            fut.remove_done_callback(cb)
+
+    monkeypatch.setattr(asyncio, "wait_for", strict_wait_for)
+    q, load = _queue()
+    load[(1, 0)] = 5
+    w2_done = asyncio.Event()
+
+    async def w2():
+        await q.acquire()
+        w2_done.set()
+
+    t1 = asyncio.create_task(q.acquire())
+    await asyncio.sleep(0.02)
+    t2 = asyncio.create_task(w2())
+    await asyncio.sleep(0.02)
+    assert q.depth == 2
+
+    q.notify(1)  # grants t1's future...
+    t1.cancel()  # ...but t1 dies before it resumes
+    with pytest.raises(asyncio.CancelledError):
+        await t1
+    # t1's granted wakeup must reach t2 with no further notify()
+    await w2_done.wait()
+    await t2
+    assert q.depth == 0
+
+
+async def test_admission_queue_cancel_before_notify_leaves_no_ghost_wakeup():
+    """Cancelling a waiter that was never granted must NOT inject a wakeup:
+    a later waiter still needs a real notify()."""
+    q, load = _queue()
+    load[(1, 0)] = 5
+    t1 = asyncio.create_task(q.acquire())
+    await asyncio.sleep(0.02)
+    t1.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await t1
+    t2 = asyncio.create_task(q.acquire())
+    await asyncio.sleep(0.05)
+    assert not t2.done()  # no ghost wakeup from the cancellation
+    q.notify(1)
+    await asyncio.wait_for(t2, 1)
+
+
 async def test_admission_queue_fail_all():
     q, load = _queue()
     load[(1, 0)] = 5

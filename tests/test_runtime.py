@@ -398,6 +398,36 @@ def test_p2c_and_least_loaded_prefer_lighter_instance():
     assert {r._pick()[0] for _ in range(4)} == {1, 2}
 
 
+def test_push_router_ext_load_goes_stale():
+    """Worker-published load must expire after EXT_LOAD_TTL_S without an
+    update: a crashed worker's frozen value (low OR high) would otherwise
+    pin routing forever. Stale entries fall back to the local in-flight
+    count, per instance for load_of() and collectively for _load_key()."""
+    from dynamo_tpu.runtime.request_plane import PushRouter
+
+    r = PushRouter("ns/w/gen", RouterMode.LEAST_LOADED)
+    r.update_instance(1, "127.0.0.1:1")
+    r.update_instance(2, "127.0.0.1:2")
+    r.update_load(1, 90.0)
+    r.update_load(2, 10.0)
+    r._inflight[1] = 0
+    r._inflight[2] = 5
+    assert r.load_of(1) == 90.0
+    assert all(r._pick()[0] == 2 for _ in range(5))  # published load wins
+
+    # instance 1's publisher goes silent past the TTL
+    r._ext_load_ts[1] -= r.EXT_LOAD_TTL_S + 1
+    assert r.load_of(1) == 0.0  # fell back to local in-flight
+    assert 1 not in r._ext_load  # lazily expired
+    # mixed freshness: _load_key must not compare published (2) against
+    # in-flight (1) — it drops to in-flight for everyone
+    assert all(r._pick()[0] == 1 for _ in range(5))
+
+    # a fresh publication restores the external signal
+    r.update_load(1, 90.0)
+    assert all(r._pick()[0] == 2 for _ in range(5))
+
+
 def test_device_aware_weighted_by_capacity_over_load():
     """DeviceAwareWeighted (reference push_router.rs:193): a worker
     spanning a 4-chip slice absorbs ~4x an idle single-chip worker's
