@@ -92,7 +92,10 @@ def _make_engine(args, mocker: bool):
             num_pages=args.num_pages,
             page_size=args.page_size,
             max_pages_per_seq=args.max_pages_per_seq,
-            timing=SimTiming(speed=args.sim_speed),
+            timing=SimTiming(
+                speed=args.sim_speed,
+                prefill_cost=getattr(args, "sim_prefill_cost", "ragged"),
+            ),
         )
     else:
         from dynamo_tpu.engine.model_runner import ModelRunner
@@ -267,6 +270,22 @@ async def run_goodput(args) -> GoodputReport:
                     continue
                 for k, v in pf.stats.items():
                     prefetch_stats[k] = prefetch_stats.get(k, 0) + v
+        # compile-cache observability: per step-function family, summed
+        # across workers — the ragged path's acceptance criterion (mixed
+        # variants <= |T buckets|) is checked off this artifact
+        compile_stats = {}
+        sim_stats = {}
+        for w in stack.workers:
+            runner = getattr(w.engine, "runner", None)
+            if hasattr(runner, "compile_stats"):
+                for fam, st in runner.compile_stats().items():
+                    agg = compile_stats.setdefault(
+                        fam, {"variants": 0, "compile_s": 0.0, "calls": 0}
+                    )
+                    for k in agg:
+                        agg[k] += st.get(k, 0)
+            for k, v in getattr(runner, "stats", {}).items():
+                sim_stats[k] = sim_stats.get(k, 0) + v
     finally:
         await stack.close()
     report = compute_goodput(
@@ -276,6 +295,15 @@ async def run_goodput(args) -> GoodputReport:
         report.extras["prefetch"] = {
             k: round(v, 6) for k, v in prefetch_stats.items()
         }
+    if compile_stats:
+        report.extras["compile"] = {
+            fam: {"variants": st["variants"],
+                  "compile_s": round(st["compile_s"], 4),
+                  "calls": st["calls"]}
+            for fam, st in compile_stats.items()
+        }
+    if sim_stats:
+        report.extras["sim"] = sim_stats
     return report
 
 
@@ -326,6 +354,12 @@ def parse_args(argv=None):
     p.add_argument("--mocker", action="store_true",
                    help="SimRunner workers: measures the serving-plane ceiling")
     p.add_argument("--sim-speed", type=float, default=1.0)
+    p.add_argument("--sim-prefill-cost", default="ragged",
+                   choices=["ragged", "padded"],
+                   help="mocker packed-prefill cost model: 'ragged' bills "
+                        "sum(chunk_tokens) like the flat-token dispatch, "
+                        "'padded' bills N_bucket*S_bucket like the legacy "
+                        "[N, S] device path (for honest pre-ragged A/Bs)")
     p.add_argument("--disagg", action="store_true")
     p.add_argument("--workers", type=int, default=1)
     p.add_argument("--prefill-workers", type=int, default=1)

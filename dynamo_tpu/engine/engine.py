@@ -171,6 +171,13 @@ class InferenceEngine:
             host_tier=self.host_pool,
             host_onboard=self._onboard_from_host if self.host_pool is not None else None,
         )
+        # The scheduler caps a mixed plan at max_batch decode rows +
+        # mixed_prefill_tokens chunk tokens, so registering that exact sum
+        # as a ragged T bucket makes the token budget BE the compile
+        # bucket: a full mixed iteration compiles (and reuses) one ragged
+        # variant instead of rounding up to the next power of two.
+        if hasattr(runner, "ensure_ragged_bucket"):
+            runner.ensure_ragged_bucket(mixed_prefill_tokens + max_batch)
         self.idle_sleep_s = idle_sleep_s
         self._inbox: thread_queue.Queue = thread_queue.Queue()
         self._streams: Dict[str, tuple[asyncio.Queue, asyncio.AbstractEventLoop]] = {}
@@ -1190,9 +1197,12 @@ class InferenceEngine:
         (one row per packed chunk); the caller finishes the prefill half
         separately so a failure THERE only fails prefill sequences (the
         decode tokens are already emitted)."""
+        from dynamo_tpu.engine.model_runner import BucketOverflowError
+
         seqs = plan.decode.seqs
         T = plan.decode.n_steps
         n_chunk_tok = sum(len(p.chunk) for p in plan.prefills)
+        prefills = list(plan.prefills)
         with annotate("engine.mixed", batch=len(seqs), steps=T,
                       chunks=len(plan.prefills), chunk=n_chunk_tok):
             tokens = [s.tokens[-1] for s in seqs]
@@ -1200,32 +1210,56 @@ class InferenceEngine:
             tables = [s.pages for s in seqs]
             step0 = self._step_counter + 1
             self._step_counter += T
-            if len(plan.prefills) == 1:
-                pplan = plan.prefill
-                sampled, lg = self.runner.decode_multi_with_prefill(
-                    T, tokens, positions, tables, _sampling_params(seqs),
-                    step0, pplan.chunk, pplan.start_pos, pplan.seq.pages,
-                    pplan.start_pos,
-                    adapters=[s.adapter_idx for s in seqs],
-                    chunk_adapter=pplan.seq.adapter_idx,
-                )
-                chunk_logits = [lg]
-            else:
-                sampled, chunk_logits = self.runner.decode_multi_with_prefills(
-                    T, tokens, positions, tables, _sampling_params(seqs),
-                    step0,
-                    [
-                        {
-                            "tokens": p.chunk,
-                            "start": p.start_pos,
-                            "table": p.seq.pages,
-                            "prior": p.start_pos,
-                            "adapter": p.seq.adapter_idx,
-                        }
-                        for p in plan.prefills
-                    ],
-                    adapters=[s.adapter_idx for s in seqs],
-                )
+            while True:
+                # Bucket-overflow degradation: a pack the runner can't
+                # shape (pack/chunk/T bucket exceeded) sheds its newest
+                # chunk and retries. Shed chunks were never
+                # complete_prefill'd, so the scheduler re-plans them
+                # verbatim next iteration (planning is side-effect-free;
+                # their pages are already held). The caller's
+                # zip(plan.prefills, chunk_logits) pairs only the served
+                # prefix — chunks are shed strictly from the tail.
+                try:
+                    if len(prefills) == 1:
+                        pplan = prefills[0]
+                        sampled, lg = self.runner.decode_multi_with_prefill(
+                            T, tokens, positions, tables,
+                            _sampling_params(seqs),
+                            step0, pplan.chunk, pplan.start_pos,
+                            pplan.seq.pages, pplan.start_pos,
+                            adapters=[s.adapter_idx for s in seqs],
+                            chunk_adapter=pplan.seq.adapter_idx,
+                        )
+                        chunk_logits = [lg]
+                    else:
+                        sampled, chunk_logits = (
+                            self.runner.decode_multi_with_prefills(
+                                T, tokens, positions, tables,
+                                _sampling_params(seqs),
+                                step0,
+                                [
+                                    {
+                                        "tokens": p.chunk,
+                                        "start": p.start_pos,
+                                        "table": p.seq.pages,
+                                        "prior": p.start_pos,
+                                        "adapter": p.seq.adapter_idx,
+                                    }
+                                    for p in prefills
+                                ],
+                                adapters=[s.adapter_idx for s in seqs],
+                            )
+                        )
+                    break
+                except BucketOverflowError as e:
+                    if len(prefills) <= 1:
+                        raise  # even one chunk won't fit any shape
+                    shed = prefills.pop()
+                    log.warning(
+                        "mixed pack overflows runner buckets (%s); "
+                        "deferring chunk of %s to the next iteration",
+                        e, shed.seq.request_id,
+                    )
             for i, seq in enumerate(seqs):
                 emit: List[int] = []
                 reason = None

@@ -185,6 +185,89 @@ def _mixed_loop(
     return toks, last, chunk_logits, k_pool, v_pool
 
 
+def _ragged_step(
+    config: ModelConfig,
+    attn_impl: str,
+    mesh,
+    params,
+    tokens,  # [1, T] flat step tokens: decode batch (one each) + chunks
+    positions,  # [1, T] per-token absolute positions (-1 padding)
+    tok_pt,  # [T, MP] per-token page-table rows (KV writes, jnp fallback)
+    tok_kvl,  # [T] per-token context lengths
+    seg_pt,  # [SEG, MP] per-segment page-table rows (kernel SMEM operand)
+    seg_kvl,  # [SEG] per-segment context lengths
+    meta,  # [5, NW] work units (ops.ragged_paged_attention)
+    gather_idx,  # [SEG_CAP] flat index of each segment's LAST token
+    k_pool,
+    v_pool,
+    sampling: SamplingParams,  # padded to SEG_CAP rows
+    step,  # traced scalar int32
+):
+    """The ragged mixed step: ONE forward serves the whole decode batch
+    (each sequence a q_len=1 segment) and every packed prefill chunk from
+    a single flat [T] token axis. Logits come back only at the SEG_CAP
+    gathered last-token rows; sampling covers all of them (decode rows
+    use their real per-sequence params, the rest ride padding params and
+    are discarded host-side). Every shape here is a function of the T
+    bucket alone, so the mixed family compiles |T buckets| variants
+    instead of the (decode x chunk x pack) triple product.
+
+    Decode steps 1..n-1 of a fused iteration run through the UNCHANGED
+    _decode_loop as a second dispatch chained on this one's sampled
+    tokens — its variants are the plain decode-bucket set the engine
+    already pays for, and sampling row seeds/steps line up exactly with
+    the legacy fused path (sample() derives randomness per row from the
+    sequence seed and the step counter only)."""
+    logits, k_pool, v_pool = llama.forward(
+        config, params, tokens, positions, k_pool, v_pool, tok_pt, tok_kvl,
+        last_index=gather_idx, attn_impl=attn_impl, mesh=mesh,
+        ragged=(seg_pt, seg_kvl, meta),
+    )
+    seg_logits = logits[0]  # [SEG_CAP, V]
+    toks = sample(seg_logits, sampling, step)  # [SEG_CAP]
+    return toks, seg_logits, k_pool, v_pool
+
+
+class _CompiledFamily:
+    """Wraps one jitted step-function family to count distinct compiled
+    variants (jit cache growth) and the cumulative wall seconds of calls
+    that compiled (trace+lower+compile — the host-side stall each new
+    bucket costs). The ragged path's compile-cardinality collapse is
+    invisible without this; compile_stats() feeds the worker /metrics
+    gauges and the goodput report's extras["compile"]."""
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self._fn = fn
+        self.variants = 0
+        self.compile_s = 0.0
+        self.calls = 0
+
+    def _cache_size(self):
+        try:
+            return self._fn._cache_size()
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        before = self._cache_size()
+        t0 = time.monotonic()
+        out = self._fn(*args, **kwargs)
+        after = self._cache_size()
+        if before is not None and after is not None and after > before:
+            self.variants += after - before
+            self.compile_s += time.monotonic() - t0
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "variants": self.variants,
+            "compile_s": round(self.compile_s, 4),
+            "calls": self.calls,
+        }
+
+
 # Wire layout version for P→D / cross-worker KV payloads. v2 = token-major
 # [L, n, PS, Hk, D]; v1 (implicit, no field) was head-major. Mirrors the
 # disk tier's BLOCK_LAYOUT_VERSION: in a mixed-version cluster (rolling
@@ -287,11 +370,23 @@ def kv_payload_to_arrays(payload: Dict[str, Any], page_shape=None, dtype=None):
     return k, v
 
 
+class BucketOverflowError(ValueError):
+    """A dispatch needs a shape past the largest configured bucket. Carries
+    what overflowed so the engine can degrade gracefully — shed chunks
+    from the pack and defer them to the next iteration — instead of
+    failing every sequence in the plan mid-iteration."""
+
+    def __init__(self, n: int, buckets: Sequence[int]):
+        super().__init__(f"{n} exceeds largest bucket {buckets[-1]}")
+        self.n = n
+        self.largest = buckets[-1]
+
+
 def _next_bucket(buckets: Sequence[int], n: int) -> int:
     for b in buckets:
         if b >= n:
             return b
-    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+    raise BucketOverflowError(n, buckets)
 
 
 class ModelRunner:
@@ -307,6 +402,7 @@ class ModelRunner:
         max_pages_per_seq: int = 128,
         decode_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
         prefill_buckets: Sequence[int] = (16, 32, 64, 128, 256, 512, 1024),
+        ragged_buckets: Sequence[int] = (32, 64, 128, 256, 512, 1024, 2048),
         dtype=jnp.bfloat16,
         seed: int = 0,
         params: Optional[Any] = None,
@@ -364,9 +460,15 @@ class ModelRunner:
         self.max_pages_per_seq = max_pages_per_seq
         self.decode_buckets = tuple(decode_buckets)
         self.prefill_buckets = tuple(prefill_buckets)
-        # packed-prefill row-count buckets: the fused mixed program
+        # packed-prefill row-count buckets: the legacy fused mixed program
         # compiles per (decode bucket, chunk bucket, pack bucket) triple
         self.pack_buckets = (1, 2, 4, 8, 16, 32)
+        # ragged flat-token mixed path: ONE [T] bucket per compile. The
+        # engine inserts mixed_prefill_tokens + max decode batch via
+        # ensure_ragged_bucket so the scheduler's budget IS a compile
+        # bucket (full mixed iterations never round up).
+        self.ragged_buckets = tuple(sorted(ragged_buckets))
+        self.ragged_q_block = 8
         self.dtype = dtype
 
         t0 = time.monotonic()
@@ -475,17 +577,26 @@ class ModelRunner:
         # prefill uses the flash kernel on TPU (S>1), jnp elsewhere; with a
         # seq mesh axis, prefill goes sequence-parallel (ring attention)
         self.sp_enabled = self.mesh_config.seq > 1
-        self._jit_forward = jax.jit(
+        # per-family compile observability (variant counts + compile
+        # seconds); see _CompiledFamily / compile_stats()
+        self._families: Dict[str, _CompiledFamily] = {}
+
+        def _family(name, fn):
+            fam = _CompiledFamily(name, fn)
+            self._families[name] = fam
+            return fam
+
+        self._jit_forward = _family("forward", jax.jit(
             partial(llama.forward, self.config),
             donate_argnums=(3, 4),  # k_pool, v_pool
             static_argnames=("attn_impl", "mesh", "sp_has_prior"),
-        )
+        ))
         self._jit_sample = jax.jit(sample)
-        self._jit_decode_loop = jax.jit(
+        self._jit_decode_loop = _family("decode_loop", jax.jit(
             partial(_decode_loop, self.config, self.attn_impl, self._fwd_mesh),
             static_argnums=(0, 1),  # n_steps, n_logprobs
             donate_argnums=(8, 9),  # k_pool, v_pool
-        )
+        ))
         if self.pp:
             from dynamo_tpu.parallel.mesh import AXIS_PIPE
 
@@ -503,12 +614,33 @@ class ModelRunner:
                 donate_argnums=(5, 6),  # k_pool, v_pool
             )
         if not self.pp:
-            self._jit_mixed = jax.jit(
+            self._jit_mixed = _family("mixed", jax.jit(
                 partial(_mixed_loop, self.config, self.attn_impl,
                         self._fwd_mesh),
                 static_argnums=(0,),  # n_steps
                 donate_argnums=(10, 11),  # k_pool, v_pool
-            )
+            ))
+            self._jit_ragged = _family("ragged", jax.jit(
+                partial(_ragged_step, self.config, self.attn_impl,
+                        self._fwd_mesh),
+                donate_argnums=(9, 10),  # k_pool, v_pool
+            ))
+        # ragged flat-token mixed dispatch: default ON wherever the fused
+        # mixed path runs; DYN_RAGGED_MIXED=0 forces the legacy [N, S]
+        # padded path (the A/B baseline), =1 forces it on. PP/SP keep the
+        # legacy fallback; LoRA batches carry per-row adapters the single
+        # flat row cannot, and MLA has no ragged attention yet.
+        _renv = os.environ.get("DYN_RAGGED_MIXED", "").lower()
+        if _renv in ("1", "true", "on", "yes"):
+            _ragged_ok = True
+        elif _renv in ("0", "false", "off", "no"):
+            _ragged_ok = False
+        else:
+            _ragged_ok = True
+        self.ragged_mixed = (
+            _ragged_ok and not self.pp and not self.sp_enabled
+            and self.lora is None and not config.is_mla
+        )
         # device-resident sampling cache: batches re-send identical sampling
         # params every dispatch; transferring them each time costs one relay
         # round trip PER ARRAY (see _decode_loop)
@@ -796,6 +928,23 @@ class ModelRunner:
         guided masks/spec decode/multimodal chunks/PP meshes)."""
         if self.pp:
             raise NotImplementedError("fused mixed step has no PP path")
+        if self._use_ragged(len(positions), 1):
+            chunk = {
+                "tokens": chunk_tokens, "start": chunk_start,
+                "table": chunk_table, "prior": chunk_prior,
+                "adapter": chunk_adapter,
+            }
+            try:
+                toks, chunk_logits = self._decode_multi_with_prefills_ragged(
+                    n_steps, tokens, positions, page_tables, sampling,
+                    step, [chunk],
+                )
+                return toks, chunk_logits[0]
+            except BucketOverflowError as e:
+                log.warning(
+                    "mixed plan (%d tokens) overflows ragged T buckets "
+                    "(largest %d); using the padded fallback", e.n, e.largest,
+                )
         ptok, ppos, ppt, pkvl, n = self._prep_prefill(
             chunk_tokens, chunk_start, chunk_table, chunk_prior
         )
@@ -879,6 +1028,17 @@ class ModelRunner:
         limits as decode_multi_with_prefill."""
         if self.pp:
             raise NotImplementedError("fused mixed step has no PP path")
+        if self._use_ragged(len(positions), len(chunks)):
+            try:
+                return self._decode_multi_with_prefills_ragged(
+                    n_steps, tokens, positions, page_tables, sampling, step,
+                    chunks,
+                )
+            except BucketOverflowError as e:
+                log.warning(
+                    "mixed plan (%d tokens) overflows ragged T buckets "
+                    "(largest %d); using the padded fallback", e.n, e.largest,
+                )
         ptok, ppos, ppt, pkvl, plast, padapter = self._prep_prefill_packed(
             chunks
         )
@@ -903,6 +1063,135 @@ class ModelRunner:
             self.lora,
         )
         return np.asarray(jax.device_get(toks)), chunk_logits
+
+    # -- ragged flat-token mixed path -------------------------------------
+    def _use_ragged(self, n_decode: int, n_chunks: int) -> bool:
+        from dynamo_tpu.ops.ragged_paged_attention import RAGGED_MAX_SEGS
+
+        return (
+            self.ragged_mixed
+            and n_decode + n_chunks <= RAGGED_MAX_SEGS
+        )
+
+    def ensure_ragged_bucket(self, t: int) -> None:
+        """Insert an exact T bucket (rounded up to the q-block). The
+        engine wires the scheduler's mixed_prefill_tokens + max decode
+        batch here at startup, so the token budget IS the compile bucket
+        and a full mixed iteration never rounds up to the next power of
+        two."""
+        qb = self.ragged_q_block
+        t = max(qb, -(-int(t) // qb) * qb)
+        if t not in self.ragged_buckets:
+            self.ragged_buckets = tuple(sorted(set(self.ragged_buckets) | {t}))
+
+    def _prep_ragged(
+        self,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        chunks: List[Dict[str, Any]],
+    ):
+        """Flatten one mixed plan — the decode batch (q_len=1 segments,
+        first) + the packed prefill chunks — into a single [T_bucket]
+        token axis with the kernel/model metadata from
+        build_ragged_metadata. T is the TRUE token sum (no per-segment
+        alignment padding): 1x512 + 3x32 chunks + 4 decode rows cost 612
+        tokens, not 4x512 padded rows. Raises BucketOverflowError past
+        the largest T bucket (the engine sheds chunks and retries)."""
+        from dynamo_tpu.ops.ragged_paged_attention import build_ragged_metadata
+
+        n_dec = len(positions)
+        q_lens = [1] * n_dec + [len(c["tokens"]) for c in chunks]
+        q_starts = list(positions) + [c["start"] for c in chunks]
+        kv_lens = [p + 1 for p in positions] + [
+            c["prior"] + len(c["tokens"]) for c in chunks
+        ]
+        rows = list(page_tables) + [c["table"] for c in chunks]
+        t_real = sum(q_lens)
+        t_bucket = _next_bucket(self.ragged_buckets, t_real)
+        md = build_ragged_metadata(
+            q_lens, q_starts, kv_lens, rows, t_bucket,
+            q_block=self.ragged_q_block, max_pages=self.max_pages_per_seq,
+        )
+        seg_cap = md["seg_page_table"].shape[0]
+        flat = np.zeros(t_bucket, np.int32)
+        flat[:n_dec] = tokens
+        off = n_dec
+        for c in chunks:
+            flat[off : off + len(c["tokens"])] = c["tokens"]
+            off += len(c["tokens"])
+        gather = np.zeros(seg_cap, np.int32)
+        gather[: n_dec + len(chunks)] = md["last_index"]
+        return (
+            jnp.asarray(flat[None]),
+            jnp.asarray(md["tok_positions"])[None],
+            jnp.asarray(md["tok_page_table"]),
+            jnp.asarray(md["tok_kv_lens"]),
+            jnp.asarray(md["seg_page_table"]),
+            jnp.asarray(md["seg_kv_lens"]),
+            jnp.asarray(md["meta"]),
+            jnp.asarray(gather),
+            seg_cap,
+        )
+
+    def _decode_multi_with_prefills_ragged(
+        self,
+        n_steps: int,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        sampling,
+        step: int,
+        chunks: List[Dict[str, Any]],
+    ) -> Tuple[np.ndarray, jax.Array]:
+        """Ragged mixed iteration, two dispatches with T-bucket-only and
+        decode-bucket-only compile keys respectively:
+        1. _ragged_step: flat forward over [T] (decode step 0 + all
+           chunks) + last-token gather + sampling at SEG_CAP rows;
+        2. steps 1..n-1 through the UNCHANGED _decode_loop, chained on
+           the step-0 tokens (positions/step advanced by one, so row
+           seeds and step indices match the legacy fused loop exactly).
+        Returns the same (sampled [B_bucket, n_steps] host, chunk logits
+        [N, V] device) contract as decode_multi_with_prefills."""
+        n_dec = len(positions)
+        (ftok, fpos, tok_pt, tok_kvl, seg_pt, seg_kvl, meta, gather,
+         seg_cap) = self._prep_ragged(tokens, positions, page_tables, chunks)
+        sampled, seg_logits, self.k_pool, self.v_pool = self._jit_ragged(
+            self.params, ftok, fpos, tok_pt, tok_kvl, seg_pt, seg_kvl,
+            meta, gather, self.k_pool, self.v_pool,
+            self._device_sampling(sampling, seg_cap), jnp.int32(step),
+        )
+        B = _next_bucket(self.decode_buckets, n_dec)
+        tok0 = sampled[:B]  # decode rows lead the segment order
+        if n_steps > 1:
+            pt = self._pad_page_table(page_tables, B)
+            MP = pt.shape[1]
+            packed = np.zeros(B * (1 + MP) + 1, np.int32)
+            packed[:B] = -1
+            packed[:n_dec] = [p + 1 for p in positions]
+            packed[B : B + B * MP] = pt.ravel()
+            packed[-1] = step + 1
+            rest, _, _, self.k_pool, self.v_pool = self._jit_decode_loop(
+                n_steps - 1, -1, self.params, tok0, jnp.asarray(packed),
+                None, None, None, self.k_pool, self.v_pool,
+                self._device_sampling(sampling, B), None,
+            )
+            tok0_h, rest_h = jax.device_get((tok0, rest))
+            toks = np.concatenate(
+                [np.asarray(tok0_h)[:, None], np.asarray(rest_h)], axis=1
+            )
+        else:
+            toks = np.asarray(jax.device_get(tok0))[:, None]
+        chunk_logits = seg_logits[n_dec : n_dec + len(chunks)]  # [N, V]
+        return toks, chunk_logits
+
+    def compile_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per step-function family: compiled-variant count, cumulative
+        compile seconds, call count. Ships as worker gauges
+        (worker_common) and the goodput report's extras["compile"] so
+        the ragged path's cache-cardinality collapse is a CI artifact,
+        not a claim."""
+        return {name: fam.stats() for name, fam in self._families.items()}
 
     def _device_sampling(self, sampling, B: int) -> SamplingParams:
         """Device-resident cache of padded sampling params. Batches resend

@@ -10,9 +10,12 @@ every byte of the orchestration path.
 
 Timing model (fitted to v5e single-chip measurements; override per test):
   prefill(chunk)          = prefill_base_s + chunk_tokens * prefill_per_token_s
-  prefill_packed(chunks)  = prefill_base_s + sum(chunk_tokens)
-                            * prefill_per_token_s   (ONE dispatch base for
-                            the whole token-budget packed set)
+  prefill_packed(chunks)  = prefill_base_s + charged * prefill_per_token_s
+                            (ONE dispatch base for the whole token-budget
+                            packed set; charged = sum(chunk_tokens) under
+                            prefill_cost="ragged" [default], or
+                            N_bucket * S_bucket under "padded" — the
+                            legacy [N, S] device path's real bill)
   decode_multi(T, batch)  = dispatch_overhead_s + T * (decode_base_s +
                             batch * decode_per_seq_s)
 """
@@ -40,6 +43,28 @@ class SimTiming:
     onboard_base_s: float = 0.002
     onboard_per_page_s: float = 0.0002
     speed: float = 1.0  # scale all sleeps; 0 disables (unit tests)
+    # prefill_packed cost mode. "ragged" (default) charges
+    # sum(chunk_tokens) — the flat-token dispatch the ragged runner path
+    # actually issues. "padded" charges N_bucket x S_bucket — the legacy
+    # [N, S] bucket-padded dispatch — so pre/post mocker A/Bs compare the
+    # ragged kernel against what the padded device path really cost, not
+    # against an already-ideal simulator.
+    prefill_cost: str = "ragged"
+    pack_buckets: tuple = (1, 2, 4, 8, 16, 32)
+    chunk_buckets: tuple = (16, 32, 64, 128, 256, 512, 1024)
+
+    def packed_charge_tokens(self, chunk_lens: List[int]) -> int:
+        """Token count one packed-prefill dispatch is charged for."""
+        if self.prefill_cost == "padded":
+            n = _sat_bucket(self.pack_buckets, len(chunk_lens))
+            s = _sat_bucket(self.chunk_buckets, max(chunk_lens))
+            return n * s
+        if self.prefill_cost != "ragged":
+            raise ValueError(
+                f"unknown prefill_cost {self.prefill_cost!r} "
+                "(expected 'ragged' or 'padded')"
+            )
+        return sum(chunk_lens)
 
     def sleep(self, seconds: float) -> None:
         if self.speed > 0:
@@ -105,6 +130,15 @@ class SimTiming:
         )
 
 
+def _sat_bucket(buckets, n: int) -> int:
+    """Smallest bucket >= n, saturating at the largest (the mocker never
+    fails a dispatch — an overflowing pack just pays the biggest shape)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
 def _sim_token(seed: int, position: int, vocab: int = 50000) -> int:
     # deterministic, avoids special ids < 16
     return (seed * 1103515245 + position * 2654435761) % (vocab - 16) + 16
@@ -127,6 +161,14 @@ class SimRunner:
         self.max_pages_per_seq = max_pages_per_seq
         self.timing = timing or SimTiming()
         self.vocab_size = vocab_size
+        # dispatched-vs-charged token accounting for packed prefills, so
+        # A/Bs can assert what the cost model billed (acceptance: ragged
+        # mode bills sum(chunk_tokens), padded bills N_bucket x S_bucket)
+        self.stats = {
+            "packed_dispatches": 0,
+            "packed_tokens_real": 0,
+            "packed_tokens_charged": 0,
+        }
 
     # -- ModelRunner interface ---------------------------------------------
     def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int, adapter: int = 0, mm=None):
@@ -148,7 +190,11 @@ class SimRunner:
         sim-logits tuple per chunk."""
         t = self.timing
         total = sum(len(c["tokens"]) for c in chunks)
-        t.sleep(t.prefill_base_s + total * t.prefill_per_token_s)
+        charged = t.packed_charge_tokens([len(c["tokens"]) for c in chunks])
+        self.stats["packed_dispatches"] += 1
+        self.stats["packed_tokens_real"] += total
+        self.stats["packed_tokens_charged"] += charged
+        t.sleep(t.prefill_base_s + charged * t.prefill_per_token_s)
         out = []
         for c in chunks:
             toks = c["tokens"]

@@ -226,6 +226,15 @@ def forward(
     adapter_idx: Optional[jax.Array] = None,  # [B] slot per sequence (0=base)
     mm_embeds: Optional[jax.Array] = None,  # [B, S, E] multimodal embeddings
     mm_mask: Optional[jax.Array] = None,  # [B, S] True → replace token embed
+    ragged: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    # flat-segment mixed forward: (seg_page_table [SEG, MP], seg_kv_lens
+    #   [SEG], meta [5, NW]) from ops.ragged_paged_attention
+    #   .build_ragged_metadata. tokens/positions come in [1, T]; the
+    #   page_table/kv_lens args switch meaning to the builder's PER-TOKEN
+    #   arrays ([T, MP] / [T]) so KV writes and the jnp fallback stay
+    #   exactly correct for arbitrary segment layouts, while the pallas
+    #   branch uses the seg-level arrays (SMEM-sized). last_index holds
+    #   FLAT per-segment last-token indices.
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One forward pass (covers prefill chunks S>1 and decode S=1).
 
@@ -238,6 +247,18 @@ def forward(
     """
     c = config
     B, S = tokens.shape
+    if ragged is not None:
+        if B != 1:
+            raise ValueError("ragged forward takes a single flat [1, T] row")
+        if c.is_mla:
+            raise NotImplementedError(
+                "ragged mixed forward is not supported for MLA models"
+            )
+        if attn_impl == "ring":
+            raise NotImplementedError(
+                "ragged mixed forward is incompatible with sequence "
+                "parallelism; the runner keeps the padded path for SP/PP"
+            )
     hd = c.head_dim
     G = c.n_heads // c.n_kv_heads
 
@@ -349,8 +370,20 @@ def forward(
             k = rope(k, safe_pos, c.rope_theta, config=c)
 
         # surgical in-place scatter into the carried pools (no pool copy)
-        k_pool = _write_kv(k_pool, l_idx, k, page_table, positions)
-        v_pool = _write_kv(v_pool, l_idx, v, page_table, positions)
+        if ragged is not None:
+            # per-token page-table rows: view the flat [1, T] step as
+            # B=T, S=1 so the same scatter covers mixed segment layouts
+            k_pool = _write_kv(
+                k_pool, l_idx, k.reshape(S, 1, c.n_kv_heads, hd),
+                page_table, positions.reshape(S, 1),
+            )
+            v_pool = _write_kv(
+                v_pool, l_idx, v.reshape(S, 1, c.n_kv_heads, hd),
+                page_table, positions.reshape(S, 1),
+            )
+        else:
+            k_pool = _write_kv(k_pool, l_idx, k, page_table, positions)
+            v_pool = _write_kv(v_pool, l_idx, v, page_table, positions)
         k_pool_l = jax.tree.map(lambda a: a[l_idx], k_pool)
         v_pool_l = jax.tree.map(lambda a: a[l_idx], v_pool)
 
@@ -390,7 +423,34 @@ def forward(
         )
         if c.attn_scale:  # Granite: the softmax scale given directly
             g_scale = c.attn_scale
-        if attn_impl == "pallas" and S == 1:
+        if ragged is not None:
+            seg_pt, seg_kvl, rmeta = ragged
+            if attn_impl == "pallas":
+                from dynamo_tpu.ops.ragged_paged_attention import (
+                    ragged_paged_attention,
+                    ragged_paged_attention_sharded,
+                )
+
+                kwr = dict(scale=g_scale, softcap=c.attn_logit_softcap)
+                if tp:
+                    attn = ragged_paged_attention_sharded(
+                        qg[0], k_pool_l, v_pool_l, seg_pt, seg_kvl, rmeta,
+                        mesh, window=win, **kwr,
+                    )[None]
+                else:
+                    attn = ragged_paged_attention(
+                        qg[0], k_pool_l, v_pool_l, seg_pt, seg_kvl, rmeta,
+                        win, **kwr,
+                    )[None]  # [1, T, Hk, G, hd]
+            else:
+                # per-token B=T, S=1 rows of the canonical jnp reference;
+                # gemma extras collapse to the defaults for other configs
+                attn = paged_attention_jnp(
+                    qg[0][:, None], k_pool_l, v_pool_l, page_table,
+                    safe_pos.reshape(S, 1), kv_lens,
+                    scale=g_scale, softcap=c.attn_logit_softcap, window=win,
+                )[:, 0][None]
+        elif attn_impl == "pallas" and S == 1:
             from dynamo_tpu.ops.paged_attention import (
                 decode_paged_attention,
                 decode_paged_attention_sharded,
@@ -531,7 +591,13 @@ def forward(
     h = rms_norm(h, params["norm_f"], c.norm_eps,
                  zero_centered=c.norm_zero_centered)
     if last_index is not None:
-        if getattr(last_index, "ndim", 0) >= 1:
+        if getattr(last_index, "ndim", 0) >= 1 and ragged is not None:
+            # flat-segment forward: indices are flat token positions of
+            # each segment's last token — gather them all from the one row
+            h = jnp.take_along_axis(
+                h, last_index.reshape(1, -1, 1), axis=1
+            )  # [1, NSEG, E]
+        elif getattr(last_index, "ndim", 0) >= 1:
             # ragged packed prefill: each batch row is a different chunk
             # with its own last valid position
             h = jnp.take_along_axis(

@@ -1,0 +1,484 @@
+"""Pallas TPU ragged paged attention: one grid for decode + packed prefills.
+
+The mixed iteration's hot op. PR 1's token-budget scheduler packs the decode
+batch plus several partial-prefill chunks into one fused dispatch, but the
+device path pads them into a dense [N, S] batch: a pack of one 512-token
+chunk and three 32-token chunks pays 4x512 tokens of attention+MLP, and the
+runner compiles a variant per (decode, chunk, pack) bucket triple. This
+kernel serves every segment — each decode sequence is a q_len=1 segment,
+each prefill chunk a q_len=n segment — from ONE flat [T, Hk, G, D] query
+buffer whose length T comes from a small set of token-budget buckets, so
+mixed-iteration cost is proportional to real tokens and the compile key is
+T alone.
+
+Work-unit grid. The flat token axis is cut into q_block-row blocks; a block
+that spans a segment boundary would mix two segments' (page table, kv_len,
+positions), so the host emits one WORK UNIT per (block, segment) overlap:
+
+    meta [5, NW] int32 rows:           (scalar-prefetched, SMEM)
+      0 seg    segment row into seg_page_table / seg_kv_lens
+      1 qblk   flat q block index (block of q_block tokens)
+      2 rs     first valid row of this unit within the block
+      3 rows   valid row count (0 = padding unit, a no-op)
+      4 qpos0  absolute position of row rs
+
+NW and the segment capacity are functions of the T bucket only
+(`ragged_work_cap` / `ragged_seg_cap`), so they never add compile keys.
+Grid is (NW, MP) with the page index innermost: consecutive units sharing a
+block keep the q and out blocks resident (same block index -> Pallas elides
+the DMA), and each unit read-modify-writes ONLY its rows of the out block
+under a row mask at finalize. Units are emitted in increasing-row order so
+a later unit never clobbers an earlier one's rows. K/V pages stream exactly
+as in the decode kernel (ops/paged_attention.py): the index_map clamps dead
+pages (causal top, kv_len, window low bound) to a repeated index so their
+copies are elided, and a `needed` guard skips their compute.
+
+Parity: GQA (G groups per kv head), sliding window (traced scalar, 0 =
+global at runtime), logit softcap, and int8-KV per-(token, head) scales all
+follow the exact op order of the two kernels this subsumes — scales fold
+into scores BEFORE softcap, V scales fold into p AFTER the raw-probability
+denominator.
+
+The flat layout itself is the "Ragged Paged Attention" TPU kernel design
+(PAPERS.md); the reference framework reaches the same shape through
+vLLM's ragged query batch on GPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# decode batch (<=64) + packed chunks (<=32) in one mixed iteration
+RAGGED_MAX_SEGS = 96
+DEFAULT_Q_BLOCK = 8
+
+
+def ragged_seg_cap(t_bucket: int, max_segs: int = RAGGED_MAX_SEGS) -> int:
+    """Segment-row capacity for a T bucket (+1 for the padding-tail
+    segment). A function of the bucket ONLY — it must not add compile
+    keys beyond |T buckets|."""
+    return min(t_bucket, max_segs) + 1
+
+
+def ragged_work_cap(
+    t_bucket: int,
+    q_block: int = DEFAULT_Q_BLOCK,
+    max_segs: int = RAGGED_MAX_SEGS,
+) -> int:
+    """Work-unit capacity: every block yields one unit plus one extra per
+    segment that starts mid-block, so blocks + segments bounds it."""
+    if t_bucket % q_block:
+        raise ValueError(f"t_bucket {t_bucket} not a multiple of {q_block}")
+    return t_bucket // q_block + ragged_seg_cap(t_bucket, max_segs)
+
+
+def build_ragged_metadata(
+    q_lens: Sequence[int],  # true (unpadded) query tokens per segment
+    q_starts: Sequence[int],  # absolute position of each segment's token 0
+    kv_lens: Sequence[int],  # context length per segment (incl. its chunk)
+    page_rows: Sequence[Sequence[int]],  # page-table row per segment
+    t_bucket: int,
+    *,
+    q_block: int = DEFAULT_Q_BLOCK,
+    max_pages: Optional[int] = None,
+    max_segs: int = RAGGED_MAX_SEGS,
+) -> Dict[str, np.ndarray]:
+    """Host-side (numpy) metadata for one ragged dispatch.
+
+    Segments are laid out back to back in the flat [t_bucket] token axis in
+    the given order; the tail [sum(q_lens), t_bucket) is covered by a dummy
+    segment with kv_len=0 (no compute, finalize writes zeros). Returns the
+    kernel operands (seg_page_table, seg_kv_lens, meta) padded to the
+    bucket's static caps, plus per-token arrays for the model's KV writes /
+    RoPE / jnp fallback (tok_*) and the per-segment last-token gather
+    (last_index). Padding tokens get tok_pos=-1 (KV write drops them) but
+    tok_kv_len=1 so the jnp fallback's softmax stays finite.
+    """
+    n = len(q_lens)
+    t_real = int(sum(q_lens))
+    if t_real > t_bucket:
+        raise ValueError(f"{t_real} tokens exceed bucket {t_bucket}")
+    if n > max_segs:
+        raise ValueError(f"{n} segments exceed cap {max_segs}")
+    seg_cap = ragged_seg_cap(t_bucket, max_segs)
+    nw = ragged_work_cap(t_bucket, q_block, max_segs)
+    if max_pages is None:
+        max_pages = max((len(r) for r in page_rows), default=1)
+
+    seg_pt = np.zeros((seg_cap, max_pages), np.int32)
+    seg_kvl = np.zeros((seg_cap,), np.int32)
+    for s, row in enumerate(page_rows):
+        seg_pt[s, : len(row)] = row
+    seg_kvl[:n] = kv_lens
+
+    # flat extents per segment, dummy tail included
+    lens_all: List[int] = list(int(x) for x in q_lens)
+    if t_real < t_bucket:
+        lens_all.append(t_bucket - t_real)
+    meta = np.zeros((5, nw), np.int32)
+    w = 0
+    lo = 0
+    for s, ln in enumerate(lens_all):
+        hi = lo + ln
+        for b in range(lo // q_block, (hi - 1) // q_block + 1):
+            blo = max(lo, b * q_block)
+            bhi = min(hi, (b + 1) * q_block)
+            qp0 = int(q_starts[s]) + (blo - lo) if s < n else 0
+            meta[:, w] = (s, b, blo - b * q_block, bhi - blo, qp0)
+            w += 1
+        lo = hi
+    # padding units: rows=0 no-ops pointing at the last real block (its
+    # buffers stay resident, so the repeat elides every DMA)
+    if w:
+        pad_blk = meta[1, w - 1]
+    else:
+        pad_blk = 0
+    pad_seg = min(n, seg_cap - 1)
+    for j in range(w, nw):
+        meta[:, j] = (pad_seg, pad_blk, 0, 0, 0)
+
+    tok_pt = np.zeros((t_bucket, max_pages), np.int32)
+    tok_kvl = np.ones((t_bucket,), np.int32)
+    tok_pos = np.full((t_bucket,), -1, np.int32)
+    cu = np.zeros((n + 1,), np.int32)
+    off = 0
+    for s in range(n):
+        ln = int(q_lens[s])
+        tok_pt[off : off + ln] = seg_pt[s]
+        tok_kvl[off : off + ln] = kv_lens[s]
+        tok_pos[off : off + ln] = int(q_starts[s]) + np.arange(ln)
+        off += ln
+        cu[s + 1] = off
+    return {
+        "seg_page_table": seg_pt,
+        "seg_kv_lens": seg_kvl,
+        "meta": meta,
+        "tok_page_table": tok_pt,
+        "tok_kv_lens": tok_kvl,
+        "tok_positions": tok_pos,
+        "cu_q_lens": cu,
+        "last_index": (cu[1:] - 1).astype(np.int32),
+        "n_work": np.int32(w),
+    }
+
+
+def _ragged_kernel_body(
+    # scalar prefetch
+    meta_ref,  # [5, NW] int32 (seg, qblk, rs, rows, qpos0)
+    pt_ref,  # [SEG, MP] int32 per-segment page-table rows
+    kvl_ref,  # [SEG] int32 per-segment context length
+    win_ref,  # [1] int32 sliding window (0 = global) or None
+    # blocks
+    q_ref,  # [Hk, QB, G, D]
+    k_ref,  # [PS, Hk, D] one token-major page
+    v_ref,  # [PS, Hk, D]
+    ks_ref,  # [PS, Hk] f32 per-vector K scales (int8 KV) or None
+    vs_ref,  # [PS, Hk] f32 per-vector V scales or None
+    o_ref,  # [Hk, QB, G, D]
+    # scratch (persist across the page loop)
+    m_ref,  # [Hk, QB*G, 1] f32
+    l_ref,  # [Hk, QB*G, 1] f32
+    acc_ref,  # [Hk, QB*G, D] f32
+    *,
+    page_size: int,
+    n_groups: int,
+    scale: float,
+    softcap: float = 0.0,
+):
+    w = pl.program_id(0)
+    i = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seg = meta_ref[0, w]
+    row_start = meta_ref[2, w]
+    n_rows = meta_ref[3, w]
+    qpos0 = meta_ref[4, w]
+    kv_len = kvl_ref[seg]
+    # last absolute position any valid row of this work unit can see
+    blk_max_pos = qpos0 + n_rows - 1
+    page_first = i * page_size
+    needed = (n_rows > 0) & (page_first <= blk_max_pos) & (page_first < kv_len)
+    if win_ref is not None:
+        wv = win_ref[0]
+        blk_lo = jnp.where(wv > 0, jnp.maximum(qpos0 - wv + 1, 0), 0)
+        needed = needed & (page_first + page_size > blk_lo)
+
+    @pl.when(needed)
+    def _compute():
+        Hk, QB, G, D = q_ref.shape
+        q = q_ref[...].astype(jnp.float32).reshape(Hk, QB * G, D)
+        k = k_ref[...].astype(jnp.float32)  # [PS, Hk, D]
+        s = lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        ) * scale  # [Hk, QB*G, PS]
+        if ks_ref is not None:
+            s = s * ks_ref[...].T[:, None, :]
+        if softcap:
+            # the TRUE score (post any int8 fold), matching the jnp path
+            s = softcap * jnp.tanh(s / softcap)
+
+        row = lax.broadcasted_iota(jnp.int32, s.shape, 1) // n_groups
+        col = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        q_pos = qpos0 + row - row_start  # valid only inside the row band
+        kv_pos = page_first + col
+        mask = (
+            (row >= row_start)
+            & (row < row_start + n_rows)
+            & (kv_pos <= q_pos)
+            & (kv_pos < kv_len)
+        )
+        if win_ref is not None:
+            wv = win_ref[0]
+            mask = mask & ((wv <= 0) | (kv_pos > q_pos - wv))
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+
+        l_add = jnp.sum(p, axis=2, keepdims=True)  # raw-probability denom
+        if vs_ref is not None:
+            p = p * vs_ref[...].T[:, None, :]
+        v = v_ref[...].astype(jnp.float32)
+        pv = lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        l_ref[...] = l_ref[...] * alpha + l_add
+        m_ref[...] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        # read-modify-write ONLY this unit's row band: units sharing the
+        # block run back to back on the same resident out buffer, each
+        # masking in its own rows (increasing-row emission order)
+        Hk, QB, G, D = o_ref.shape
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        res = acc_ref[...] / denom  # [Hk, QB*G, D]
+        row = lax.broadcasted_iota(jnp.int32, res.shape, 1) // n_groups
+        keep = (row >= row_start) & (row < row_start + n_rows)
+        prev = o_ref[...].astype(jnp.float32).reshape(Hk, QB * G, D)
+        o_ref[...] = (
+            jnp.where(keep, res, prev).astype(o_ref.dtype).reshape(Hk, QB, G, D)
+        )
+
+
+def _ragged_kernel(meta, pt, kl, q, k, v, o, m, l, acc, **kw):
+    _ragged_kernel_body(meta, pt, kl, None, q, k, v, None, None,
+                        o, m, l, acc, **kw)
+
+
+def _ragged_kernel_win(meta, pt, kl, win, q, k, v, o, m, l, acc, **kw):
+    _ragged_kernel_body(meta, pt, kl, win, q, k, v, None, None,
+                        o, m, l, acc, **kw)
+
+
+def _ragged_kernel_int8(meta, pt, kl, q, k, ks, v, vs, o, m, l, acc, **kw):
+    _ragged_kernel_body(meta, pt, kl, None, q, k, v, ks, vs,
+                        o, m, l, acc, **kw)
+
+
+def _ragged_kernel_int8_win(meta, pt, kl, win, q, k, ks, v, vs, o, m, l,
+                            acc, **kw):
+    _ragged_kernel_body(meta, pt, kl, win, q, k, v, ks, vs,
+                        o, m, l, acc, **kw)
+
+
+def ragged_attention_reference(
+    q: jax.Array,  # [T, Hk, G, D]
+    k_pool_l,
+    v_pool_l,
+    tok_page_table: jax.Array,  # [T, MP]
+    tok_positions: jax.Array,  # [T] (-1 = padding)
+    tok_kv_lens: jax.Array,  # [T]
+    *,
+    scale=None,
+    softcap: float = 0.0,
+    window=None,
+) -> jax.Array:
+    """jnp reference (and CPU fallback): each flat token is a B=T, S=1 row
+    of the canonical paged_attention_jnp — per-token page table / kv_len /
+    position make arbitrary segment layouts exactly correct."""
+    from ..models.toolkit import paged_attention_jnp
+
+    out = paged_attention_jnp(
+        q[:, None],
+        k_pool_l,
+        v_pool_l,
+        tok_page_table,
+        jnp.maximum(tok_positions, 0)[:, None],
+        tok_kv_lens,
+        scale=scale,
+        softcap=softcap,
+        window=window,
+    )
+    return out[:, 0]
+
+
+def ragged_paged_attention_sharded(
+    q: jax.Array,  # [T, Hk, G, D] heads sharded over `axis_name`
+    k_pool_l,
+    v_pool_l,
+    seg_page_table: jax.Array,
+    seg_kv_lens: jax.Array,
+    meta: jax.Array,
+    mesh,
+    axis_name: str = "model",
+    window=None,
+    *,
+    q_block: int = DEFAULT_Q_BLOCK,
+    scale=None,
+    softcap: float = 0.0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tensor-parallel wrapper (see decode_paged_attention_sharded): each
+    model-axis shard runs the kernel over its local kv-heads."""
+    from jax.sharding import PartitionSpec as P
+
+    heads = P(None, axis_name, None, None)
+    pool = P(None, None, axis_name, None)
+    if isinstance(k_pool_l, dict):  # int8 KV: scales [NP, PS, Hk]
+        pool = {"q": pool, "s": P(None, None, axis_name)}
+    part = functools.partial(
+        ragged_paged_attention, q_block=q_block, scale=scale,
+        softcap=softcap, interpret=interpret,
+    )
+    base_specs = (heads, pool, pool, P(None, None), P(None), P(None, None))
+    extra = (
+        () if window is None
+        else (jnp.asarray(window, jnp.int32).reshape(1),)
+    )
+    fn = jax.shard_map(
+        part, mesh=mesh,
+        in_specs=base_specs + ((P(),) if extra else ()),
+        out_specs=heads, check_vma=False,
+    )
+    return fn(q, k_pool_l, v_pool_l, seg_page_table, seg_kv_lens, meta,
+              *extra)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("q_block", "interpret", "scale", "softcap")
+)
+def ragged_paged_attention(
+    q: jax.Array,  # [T, Hk, G, D] flat query tokens (all segments)
+    k_pool_l,  # [NP, PS, Hk, D] token-major (or int8 {"q","s"} dict)
+    v_pool_l,
+    seg_page_table: jax.Array,  # [SEG, MP] int32
+    seg_kv_lens: jax.Array,  # [SEG] int32
+    meta: jax.Array,  # [5, NW] int32 work units (build_ragged_metadata)
+    window=None,  # None = no-window compile; else traced int32 scalar
+    *,
+    q_block: int = DEFAULT_Q_BLOCK,
+    scale=None,
+    softcap: float = 0.0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [T, Hk, G, D]; rows covered by no real segment return 0.
+    Every segment's K/V (including its own chunk) must already be written
+    to the pool. The compile key is (T, NW, SEG, q_block) — all functions
+    of the T bucket, so variants stay at |T buckets|."""
+    T, Hk, G, D = q.shape
+    quantized = isinstance(k_pool_l, dict)
+    kq = k_pool_l["q"] if quantized else k_pool_l
+    NP, PS, _, _ = kq.shape
+    MP = seg_page_table.shape[1]
+    if T % q_block:
+        raise ValueError(f"T {T} not a multiple of q_block {q_block}")
+    NW = meta.shape[1]
+    if scale is None:
+        scale = D**-0.5
+    windowed = window is not None
+    n_prefetch = 4 if windowed else 3
+
+    qt = q.transpose(1, 0, 2, 3)  # [Hk, T, G, D]
+
+    def _clamp(w, i, mt, pt, kl, *rest):
+        # clamp dead pages (causal top, kv_len, window low bound) to a
+        # repeated index so Pallas elides their DMA — flash-prefill trick,
+        # per work unit instead of per (b, sb)
+        seg = mt[0, w]
+        rows = mt[3, w]
+        qpos0 = mt[4, w]
+        blk_max_pos = qpos0 + jnp.maximum(rows, 1) - 1
+        last = jnp.minimum(blk_max_pos, jnp.maximum(kl[seg] - 1, 0)) // PS
+        last = jnp.clip(last, 0, MP - 1)
+        i_eff = jnp.minimum(i, last)
+        if rest:
+            (win,) = rest
+            wv = win[0]
+            lo = jnp.where(wv > 0, jnp.maximum(qpos0 - wv + 1, 0), 0)
+            i_eff = jnp.maximum(i_eff, jnp.minimum(lo // PS, last))
+        return seg, i_eff
+
+    def kv_index(w, i, mt, pt, kl, *rest):
+        seg, i_eff = _clamp(w, i, mt, pt, kl, *rest)
+        return (pt[seg, i_eff], 0, 0, 0)
+
+    def scale_index(w, i, mt, pt, kl, *rest):
+        return kv_index(w, i, mt, pt, kl, *rest)[:3]
+
+    def q_index(w, i, mt, pt, kl, *rest):
+        return (0, mt[1, w], 0, 0)
+
+    q_spec = pl.BlockSpec((Hk, q_block, G, D), q_index)
+    # one token-major page = one contiguous PS*Hk*D slab (single DMA)
+    kv_spec = pl.BlockSpec((None, PS, Hk, D), kv_index)
+    kw = dict(page_size=PS, n_groups=G, scale=scale, softcap=softcap)
+    if quantized:
+        kernel = functools.partial(
+            _ragged_kernel_int8_win if windowed else _ragged_kernel_int8,
+            **kw,
+        )
+        s_spec = pl.BlockSpec((None, PS, Hk), scale_index)
+        in_specs = [q_spec, kv_spec, s_spec, kv_spec, s_spec]
+        operands = (qt, kq, k_pool_l["s"], v_pool_l["q"], v_pool_l["s"])
+    else:
+        kernel = functools.partial(
+            _ragged_kernel_win if windowed else _ragged_kernel, **kw
+        )
+        in_specs = [q_spec, kv_spec, kv_spec]
+        operands = (qt, kq, v_pool_l)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,  # meta, seg_pt, seg_kvl (+ window)
+        grid=(NW, MP),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((Hk, q_block, G, D), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((Hk, q_block * G, 1), jnp.float32),
+            pltpu.VMEM((Hk, q_block * G, 1), jnp.float32),
+            pltpu.VMEM((Hk, q_block * G, D), jnp.float32),
+        ],
+    )
+
+    prefetch = (meta, seg_page_table, seg_kv_lens)
+    if windowed:
+        prefetch = prefetch + (
+            jnp.asarray(window, jnp.int32).reshape(1),
+        )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Hk, T, G, D), q.dtype),
+        interpret=interpret,
+    )(*prefetch, *operands)
+    return out.transpose(1, 0, 2, 3)  # [T, Hk, G, D]

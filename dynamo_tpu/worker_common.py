@@ -393,6 +393,32 @@ async def serve_worker(
         # port's /metrics never sees them
         engine.prefetch.bind_metrics(runtime.metrics.child(dynamo_namespace=namespace))
 
+    # compile-cache observability: per step-function family (forward /
+    # decode_loop / mixed / ragged), compiled-variant count and cumulative
+    # trace+compile seconds. Refreshed from the step thread's FPM hook —
+    # compiles only happen during steps, so the gauges are never stale
+    # when someone scrapes after a step completed. The ragged mixed path's
+    # cardinality collapse (variants <= |T buckets|) is read off these.
+    _runner = getattr(engine, "runner", None)
+    if hasattr(_runner, "compile_stats"):
+        _cm = runtime.metrics.child(dynamo_namespace=namespace)
+
+        def _update_compile_gauges(_m=None) -> None:
+            for fam, st in _runner.compile_stats().items():
+                _cm.gauge(
+                    "compile_variants",
+                    "compiled XLA variants per step-function family",
+                    family=fam,
+                ).set(st["variants"])
+                _cm.gauge(
+                    "compile_seconds_total",
+                    "cumulative trace+compile wall seconds per family",
+                    family=fam,
+                ).set(st["compile_s"])
+
+        engine.on_fpm(_update_compile_gauges)
+        _update_compile_gauges()
+
     async def kv_prefetch(request, context):
         hint = (request or {}).get("kv_prefetch") or {}
         ok = False

@@ -1,0 +1,136 @@
+"""Padded-vs-ragged mixed-prefill cost micro-bench (mocker; CPU-only).
+
+Two measurements over the ISSUE-3 pack shape (one 512-token chunk + three
+32-token chunks, 608 real tokens):
+
+1. dispatch: SimRunner.prefill_packed in a tight loop under each cost
+   model — prefill_cost="padded" bills the legacy [N_bucket, S_bucket]
+   rectangle (4 x 512 = 2048 tokens), "ragged" bills sum(chunk_tokens)
+   (608) — reporting tokens dispatched vs charged and wall seconds.
+2. serving (--serve): the same mixed-size burst through a full
+   InferenceEngine + SimRunner under each mode, reporting TTFT/ITL
+   percentiles (the mocker A/B recorded in docs/perf_notes.md).
+
+Deterministic, no JAX, no TPUs. Run:
+
+    python scripts/bench_ragged.py [--iters 20] [--serve]
+
+Prints one JSON line {"metric": "ragged_mixed_cost", "padded": {...},
+"ragged": {...}, "charged_token_ratio": ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dynamo_tpu.mocker.sim import SimRunner, SimTiming  # noqa: E402
+
+PACK = (512, 32, 32, 32)
+
+
+def _dispatch_arm(mode: str, iters: int) -> dict:
+    runner = SimRunner(timing=SimTiming(prefill_cost=mode))
+    chunks = [
+        {"tokens": [300 + j for j in range(n)], "start": 0,
+         "table": [0], "prior": 0}
+        for n in PACK
+    ]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        runner.prefill_packed(chunks)
+    wall = time.perf_counter() - t0
+    st = runner.stats
+    return {
+        "dispatches": st["packed_dispatches"],
+        "tokens_real": st["packed_tokens_real"],
+        "tokens_charged": st["packed_tokens_charged"],
+        "wall_s": round(wall, 4),
+        "s_per_dispatch": round(wall / iters, 6),
+    }
+
+
+async def _serve_arm(mode: str) -> dict:
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.runtime.context import Context
+
+    runner = SimRunner(num_pages=512, page_size=16, max_pages_per_seq=64,
+                       timing=SimTiming(prefill_cost=mode))
+    engine = InferenceEngine(
+        runner, max_batch=16, chunk_size=512, decode_steps=4,
+        mixed_prefill_tokens=608, mixed_prefill_seqs=4, mixed_min_chunk=16,
+    )
+    engine.start()
+    try:
+        async def one(isl, osl, delay):
+            await asyncio.sleep(delay)
+            start = time.monotonic()
+            first = None
+            stamps = []
+            async for item in engine.generate(
+                {"token_ids": [300 + isl] * isl,
+                 "sampling": {"temperature": 0.0},
+                 "stop": {"max_tokens": osl, "stop_ids": [],
+                          "ignore_eos": True}}, Context(),
+            ):
+                assert item.get("finish_reason") != "error", item
+                now = time.monotonic()
+                for _ in item.get("token_ids") or []:
+                    stamps.append(now)
+                if first is None and stamps:
+                    first = now - start
+                if item.get("finish_reason"):
+                    break
+            itls = [b - a for a, b in zip(stamps, stamps[1:])]
+            return first, itls
+
+        # a warm decode row first, then the mixed-size pack arrives at
+        # once — the pack rides MixedPlan prefill_packed dispatches
+        jobs = [one(8, 48, 0.0)]
+        jobs += [one(isl, 16, 0.05) for isl in PACK]
+        out = await asyncio.gather(*jobs)
+    finally:
+        engine.stop()
+    ttfts = sorted(x[0] for x in out)
+    itls = sorted(v for x in out for v in x[1])
+
+    def pct(vals, p):
+        return round(vals[min(len(vals) - 1, int(p * len(vals)))], 4)
+
+    return {
+        "ttft_p50_s": pct(ttfts, 0.5), "ttft_max_s": pct(ttfts, 1.0),
+        "itl_p50_s": pct(itls, 0.5), "itl_p99_s": pct(itls, 0.99),
+        "packed_tokens_real": runner.stats["packed_tokens_real"],
+        "packed_tokens_charged": runner.stats["packed_tokens_charged"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--serve", action="store_true",
+                    help="also run the engine-level TTFT/ITL A/B")
+    args = ap.parse_args()
+
+    report = {"metric": "ragged_mixed_cost", "pack": list(PACK)}
+    for mode in ("padded", "ragged"):
+        report[mode] = _dispatch_arm(mode, args.iters)
+    report["charged_token_ratio"] = round(
+        report["padded"]["tokens_charged"]
+        / report["ragged"]["tokens_charged"], 4
+    )
+    if args.serve:
+        for mode in ("padded", "ragged"):
+            report[mode]["serve"] = asyncio.run(_serve_arm(mode))
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

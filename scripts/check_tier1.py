@@ -31,7 +31,15 @@ def main() -> int:
                     help="test directory relative to the repo root")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="collection timeout in seconds")
+    ap.add_argument("--require", action="append", default=None,
+                    help="test module that MUST appear in the collected "
+                         "set (repeatable); defaults to the modules newer "
+                         "PRs added, whose silent loss the count alone "
+                         "would not catch")
     args = ap.parse_args()
+    required = args.require if args.require is not None else [
+        "test_sched_packing.py", "test_ragged_mixed.py",
+    ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [
@@ -58,10 +66,13 @@ def main() -> int:
     collected = int(m.group(1)) if m else 0
     m_err = re.search(r"(\d+) errors?", out)
     errors = int(m_err.group(1)) if m_err else 0
-    ok = proc.returncode == 0 and errors == 0 and collected > 0
+    missing = [mod for mod in required if mod not in out]
+    ok = (proc.returncode == 0 and errors == 0 and collected > 0
+          and not missing)
 
     print(json.dumps({"metric": "tier1_collection", "ok": ok,
-                      "collected": collected, "errors": errors}))
+                      "collected": collected, "errors": errors,
+                      "missing": missing}))
     if not ok:
         # loud: surface the collection tracebacks so the broken import is
         # visible in CI logs, not just the count

@@ -789,3 +789,135 @@ def test_prefill_kernel_gemma_sharded_matches_jnp():
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                rtol=3e-2, atol=3e-2)
+
+
+# -- ragged paged attention (ops/ragged_paged_attention.py) -----------------
+def _ragged_case(seed, Tb=32, Hk=2, G=3, D=64, NP=48, PS=8, MP=8):
+    """Mixed dispatch shapes: two decode rows (q_len=1) + a fresh prefill
+    chunk + a chunked prefill with prior context, disjoint pages, flat
+    token axis padded to the Tb bucket."""
+    from dynamo_tpu.ops.ragged_paged_attention import build_ragged_metadata
+
+    rng = np.random.default_rng(seed)
+    q_lens = [1, 1, 9, 16]
+    q_starts = [11, 0, 0, 8]
+    kv_lens = [12, 1, 9, 24]
+    perm = rng.permutation(NP)
+    rows = [perm[i * MP : (i + 1) * MP].astype(np.int32).tolist()
+            for i in range(len(q_lens))]
+    q = jnp.asarray(rng.standard_normal((Tb, Hk, G, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, Hk, D)), jnp.bfloat16)
+    md = build_ragged_metadata(q_lens, q_starts, kv_lens, rows, Tb,
+                               max_pages=MP)
+    return q, kp, vp, md, (q_lens, q_starts, kv_lens, rows)
+
+
+@pytest.mark.parametrize(
+    "softcap,window",
+    [(0.0, None), (30.0, None), (0.0, 16), (30.0, 16)],
+)
+def test_ragged_paged_attention_matches_reference(softcap, window):
+    from dynamo_tpu.ops.ragged_paged_attention import (
+        ragged_attention_reference, ragged_paged_attention,
+    )
+
+    q, kp, vp, md, (q_lens, *_rest) = _ragged_case(20)
+    win = jnp.int32(window) if window is not None else None
+    out = ragged_paged_attention(
+        q, kp, vp, jnp.asarray(md["seg_page_table"]),
+        jnp.asarray(md["seg_kv_lens"]), jnp.asarray(md["meta"]), win,
+        softcap=softcap, interpret=True,
+    )
+    ref = ragged_attention_reference(
+        q, kp, vp, jnp.asarray(md["tok_page_table"]),
+        jnp.asarray(md["tok_positions"]), jnp.asarray(md["tok_kv_lens"]),
+        softcap=softcap, window=win,
+    )
+    T = int(sum(q_lens))
+    d = np.abs(np.asarray(out[:T], np.float32)
+               - np.asarray(ref[:T], np.float32)).max()
+    assert d < 3e-2, d
+    # bucket-padding rows (covered by the dummy tail segment) are zero
+    assert np.all(np.asarray(out[T:], np.float32) == 0.0)
+
+
+def test_ragged_paged_attention_matches_subsumed_kernels():
+    """Parity with the two kernels it replaces: each decode segment ==
+    decode_paged_attention, each chunk segment == prefill_paged_attention
+    on the same pools/pages."""
+    from dynamo_tpu.ops.ragged_paged_attention import ragged_paged_attention
+
+    q, kp, vp, md, (q_lens, q_starts, kv_lens, rows) = _ragged_case(21)
+    out = ragged_paged_attention(
+        q, kp, vp, jnp.asarray(md["seg_page_table"]),
+        jnp.asarray(md["seg_kv_lens"]), jnp.asarray(md["meta"]),
+        interpret=True,
+    )
+    cu = md["cu_q_lens"]
+    for s, ql in enumerate(q_lens):
+        pt1 = jnp.asarray(np.asarray(rows[s], np.int32)[None])
+        kv1 = jnp.asarray([kv_lens[s]], jnp.int32)
+        lo = int(cu[s])
+        if ql == 1:
+            ref = decode_paged_attention(q[lo][None], kp, vp, pt1, kv1,
+                                         interpret=True)[0]
+            seg = out[lo]
+        else:
+            S = 16
+            qb = jnp.zeros((1, S) + q.shape[1:], q.dtype)
+            qb = qb.at[0, :ql].set(q[lo : lo + ql])
+            ref = prefill_paged_attention(
+                qb, kp, vp, pt1, jnp.asarray([q_starts[s]], jnp.int32),
+                jnp.asarray([ql], jnp.int32), kv1, q_block=8, interpret=True,
+            )[0, :ql]
+            seg = out[lo : lo + ql]
+        d = np.abs(np.asarray(seg, np.float32)
+                   - np.asarray(ref, np.float32)).max()
+        assert d < 3e-2, (s, d)
+
+
+@pytest.mark.parametrize("window", [None, 16])
+def test_ragged_paged_attention_int8_kv(window):
+    from dynamo_tpu.ops.ragged_paged_attention import (
+        ragged_attention_reference, ragged_paged_attention,
+    )
+
+    q, kp, vp, md, (q_lens, *_rest) = _ragged_case(22)
+    kq, vq = _q_pools(kp, vp)
+    win = jnp.int32(window) if window is not None else None
+    out = ragged_paged_attention(
+        q, kq, vq, jnp.asarray(md["seg_page_table"]),
+        jnp.asarray(md["seg_kv_lens"]), jnp.asarray(md["meta"]), win,
+        interpret=True,
+    )
+    ref_q = ragged_attention_reference(
+        q, kq, vq, jnp.asarray(md["tok_page_table"]),
+        jnp.asarray(md["tok_positions"]), jnp.asarray(md["tok_kv_lens"]),
+        window=win,
+    )
+    T = int(sum(q_lens))
+    d = np.abs(np.asarray(out[:T], np.float32)
+               - np.asarray(ref_q[:T], np.float32)).max()
+    assert d < 3e-2, d
+    # and within the int8 rounding envelope of the bf16 pools
+    ref = ragged_attention_reference(
+        q, kp, vp, jnp.asarray(md["tok_page_table"]),
+        jnp.asarray(md["tok_positions"]), jnp.asarray(md["tok_kv_lens"]),
+        window=win,
+    )
+    d_bf16 = np.abs(np.asarray(out[:T], np.float32)
+                    - np.asarray(ref[:T], np.float32)).max()
+    assert d_bf16 < 8e-2, d_bf16
+
+
+def test_build_ragged_metadata_overflow():
+    """The metadata builder refuses shapes past the bucket's static caps
+    (the runner maps these onto BucketOverflowError → engine deferral)."""
+    from dynamo_tpu.ops.ragged_paged_attention import build_ragged_metadata
+
+    with pytest.raises(ValueError):
+        build_ragged_metadata([16, 17], [0, 0], [16, 17], [[0], [1]], 32)
+    with pytest.raises(ValueError):
+        build_ragged_metadata([1] * 5, [0] * 5, [1] * 5, [[0]] * 5, 8,
+                              max_segs=4)
