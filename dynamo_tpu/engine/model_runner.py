@@ -1171,7 +1171,11 @@ class ModelRunner:
             packed[:n_dec] = [p + 1 for p in positions]
             packed[B : B + B * MP] = pt.ravel()
             packed[-1] = step + 1
-            rest, _, _, self.k_pool, self.v_pool = self._jit_decode_loop(
+            # n_steps is the scheduler's fixed multi-step count, so
+            # n_steps-1 adds exactly ONE decode_loop variant alongside the
+            # legacy path's n_steps — bounded by design (ragged two-
+            # dispatch split, docs/ragged_attention.md)
+            rest, _, _, self.k_pool, self.v_pool = self._jit_decode_loop(  # dynlint: disable=DYN-J004
                 n_steps - 1, -1, self.params, tok0, jnp.asarray(packed),
                 None, None, None, self.k_pool, self.v_pool,
                 self._device_sampling(sampling, B), None,

@@ -131,8 +131,11 @@ class BatchService:
         for t in list(self._tasks.values()):
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                log.debug("batch task exited with error during close",
+                          exc_info=True)
         self._tasks.clear()
         if self._own_root:
             import shutil

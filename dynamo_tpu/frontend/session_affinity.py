@@ -156,7 +156,8 @@ class AffinityCoordinator:
             try:
                 await self.runtime.discovery.unregister(self._sync_inst)
             except Exception:
-                pass
+                log.debug("affinity sync unregister failed; lease expiry "
+                          "reclaims it", exc_info=True)
         self._started = False
 
     _sync_inst = None

@@ -1,0 +1,36 @@
+"""dynlint — AST-based invariant checker for the dynamo_tpu stack.
+
+Three planes of latent bugs are invisible to pytest: blocking calls that
+stall the single event loop shared by ~180 coroutines (DYN-A), Python
+control flow on JAX tracers / unbounded compile keys that silently
+multiply the jit cache the ragged kernel collapsed (DYN-J), and
+cross-coroutine races or swallowed failures in the runtime planes
+(DYN-R). dynlint machine-checks those invariants as a tier-1 gate; see
+docs/static_analysis.md for the rule catalog and suppression policy.
+"""
+
+from dynamo_tpu.lint.core import (
+    Violation,
+    Rule,
+    lint_file,
+    lint_paths,
+    default_rules,
+    format_human,
+    format_json,
+    load_baseline,
+    baseline_counts,
+    diff_against_baseline,
+)
+
+__all__ = [
+    "Violation",
+    "Rule",
+    "lint_file",
+    "lint_paths",
+    "default_rules",
+    "format_human",
+    "format_json",
+    "load_baseline",
+    "baseline_counts",
+    "diff_against_baseline",
+]

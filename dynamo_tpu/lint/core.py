@@ -1,0 +1,692 @@
+"""dynlint visitor engine.
+
+One pass per file: a pre-pass (`ModuleIndex`) collects import aliases,
+threading/asyncio lock bindings, jitted-callable bindings, and Pallas
+kernel names anywhere in the module, so rules can resolve
+`t.sleep` → `time.sleep` or `self._lock` → threading.Lock without
+executing anything. The main traversal (`_Engine`) maintains the
+function/loop/lock/timeout stacks and dispatches structured events to
+the active rules. Rules never walk the tree themselves except within
+the node they were handed.
+
+Suppression: a trailing `# dynlint: disable=RULE[,RULE...]` comment
+silences those rules on that line (bare `disable` silences all);
+`# dynlint: disable-file=RULE` anywhere silences a rule for the whole
+file. Suppressions are deliberate, reviewable markers — prefer them to
+baseline entries for true-but-accepted findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Violation",
+    "Rule",
+    "LintContext",
+    "FunctionScope",
+    "lint_file",
+    "lint_paths",
+    "default_rules",
+    "format_human",
+    "format_json",
+    "load_baseline",
+    "baseline_counts",
+    "diff_against_baseline",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dynlint:\s*(disable-file|disable)\s*(?:=\s*([A-Za-z0-9_\-,\s]+))?"
+)
+
+# names whose assignment marks a threading-plane lock (held across await
+# = whole-loop stall) vs an asyncio lock (fine to hold across await)
+_THREAD_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+_ASYNC_LOCK_CTORS = {
+    "asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> str:
+        """Baseline key: rule + path, no line numbers — unrelated edits
+        above a legacy finding must not turn it into a 'new' one."""
+        return f"{self.rule}:{self.path}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class JitBinding:
+    """A name bound to a jitted callable: `x = jax.jit(f, ...)` or
+    `self._jit_x = _family("x", jax.jit(f, ...))`."""
+
+    name: str  # bare name or attribute name (for self.<attr> bindings)
+    static_names: Set[str] = field(default_factory=set)
+    static_pos: Set[int] = field(default_factory=set)
+    inner_params: List[str] = field(default_factory=list)  # empty if unknown
+
+
+@dataclass
+class FunctionScope:
+    node: ast.AST
+    name: str
+    is_async: bool
+    params: List[str] = field(default_factory=list)
+    jit_static: Optional[Set[str]] = None  # set => function is traced
+    is_kernel: bool = False
+
+    @property
+    def is_traced(self) -> bool:
+        return self.jit_static is not None or self.is_kernel
+
+
+class ModuleIndex(ast.NodeVisitor):
+    """Whole-module pre-pass: aliases, lock bindings, jit bindings,
+    kernel functions, top-level defs, module-level mutables."""
+
+    def __init__(self) -> None:
+        self.aliases: Dict[str, str] = {}
+        self.lock_names: Set[str] = set()
+        self.lock_attrs: Set[str] = set()
+        self.async_lock_names: Set[str] = set()
+        self.async_lock_attrs: Set[str] = set()
+        self.jit_bindings: Dict[str, JitBinding] = {}
+        self.kernel_fns: Set[str] = set()
+        self.top_defs: Dict[str, ast.AST] = {}
+        self.module_mutables: Dict[str, int] = {}
+
+    # -- alias helpers ----------------------------------------------------
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical name for a Name/Attribute chain, through
+        import aliases; `self.x` resolves to "self.x"."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            if base is None:
+                return None
+            return f"{base}.{node.attr}"
+        return None
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.aliases[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module is None or node.level:
+            return  # relative imports keep their local names
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    # -- binding collection -----------------------------------------------
+    def _record_lock(self, target: ast.AST, value: ast.AST) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        ctor = self.resolve(value.func)
+        if ctor in _THREAD_LOCK_CTORS:
+            names, attrs = self.lock_names, self.lock_attrs
+        elif ctor in _ASYNC_LOCK_CTORS:
+            names, attrs = self.async_lock_names, self.async_lock_attrs
+        else:
+            return
+        if isinstance(target, ast.Name):
+            names.add(target.id)
+        elif isinstance(target, ast.Attribute):
+            attrs.add(target.attr)
+
+    def _unwrap_jit_call(self, value: ast.AST) -> Optional[ast.Call]:
+        """Return the inner `jax.jit(...)` Call for `jax.jit(...)` or a
+        single-level wrapper like `_family("name", jax.jit(...))`."""
+        if not isinstance(value, ast.Call):
+            return None
+        if self.resolve(value.func) in ("jax.jit", "jit"):
+            return value
+        for arg in value.args:
+            if isinstance(arg, ast.Call) and self.resolve(arg.func) in (
+                "jax.jit", "jit",
+            ):
+                return arg
+        return None
+
+    def _record_jit(self, target: ast.AST, value: ast.AST) -> None:
+        call = self._unwrap_jit_call(value)
+        if call is None:
+            return
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        else:
+            return
+        b = JitBinding(name)
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                b.static_names |= set(_string_elts(kw.value))
+            elif kw.arg == "static_argnums":
+                b.static_pos |= set(_int_elts(kw.value))
+        if call.args:
+            inner = call.args[0]
+            fn_name = inner.id if isinstance(inner, ast.Name) else None
+            fn = self.top_defs.get(fn_name) if fn_name else None
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                b.inner_params = [a.arg for a in fn.args.args]
+        self.jit_bindings[name] = b
+
+    def _record_jit_def(self, node) -> None:
+        """@jax.jit / @partial(jax.jit, static_argnames=...) decorated
+        defs are jit bindings too — their call sites look identical to
+        assignment-form `f = jax.jit(...)` wrappers."""
+        for dec in node.decorator_list:
+            kws = []
+            if self.resolve(dec) in ("jax.jit", "jit"):
+                pass
+            elif isinstance(dec, ast.Call):
+                fn = self.resolve(dec.func)
+                if fn in ("jax.jit", "jit"):
+                    kws = dec.keywords
+                elif (fn in ("functools.partial", "partial") and dec.args
+                      and self.resolve(dec.args[0]) in ("jax.jit", "jit")):
+                    kws = dec.keywords
+                else:
+                    continue
+            else:
+                continue
+            b = JitBinding(node.name)
+            for kw in kws:
+                if kw.arg == "static_argnames":
+                    b.static_names |= set(_string_elts(kw.value))
+                elif kw.arg == "static_argnums":
+                    b.static_pos |= set(_int_elts(kw.value))
+            b.inner_params = [a.arg for a in node.args.args]
+            self.jit_bindings[node.name] = b
+            return
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._record_jit_def(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._record_jit_def(node)
+        self.generic_visit(node)
+
+    def _record_kernel(self, node: ast.Call) -> None:
+        fn = self.resolve(node.func)
+        if fn is None or not fn.endswith("pallas_call"):
+            return
+        args = list(node.args)
+        for kw in node.keywords:
+            if kw.arg in ("kernel", "f"):
+                args.insert(0, kw.value)
+        if args and isinstance(args[0], ast.Name):
+            self.kernel_fns.add(args[0].id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_lock(t, node.value)
+            self._record_jit(t, node.value)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._record_kernel(node)
+        self.generic_visit(node)
+
+    def index_module(self, tree: ast.Module) -> None:
+        # defs first so jit bindings can see inner-fn signatures
+        for st in tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.top_defs[st.name] = st
+            elif isinstance(st, ast.Assign) and len(st.targets) == 1:
+                t = st.targets[0]
+                if isinstance(t, ast.Name) and _is_mutable_literal(st.value):
+                    self.module_mutables[t.id] = st.lineno
+        self.visit(tree)
+
+
+def _string_elts(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: List[str] = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return out
+    return []
+
+
+def _int_elts(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        return name in ("dict", "list", "set", "defaultdict", "deque",
+                        "OrderedDict", "Counter")
+    return False
+
+
+class LintContext:
+    """Per-file state handed to every rule callback."""
+
+    def __init__(self, path: str, tree: ast.Module, index: ModuleIndex,
+                 suppress_lines: Dict[int, Set[str]],
+                 suppress_file: Set[str]) -> None:
+        self.path = path
+        self.tree = tree
+        self.index = index
+        self._suppress_lines = suppress_lines
+        self._suppress_file = suppress_file
+        self.violations: List[Violation] = []
+        # traversal stacks, maintained by the engine
+        self.func_stack: List[FunctionScope] = []
+        self.loop_depth = 0
+        self.thread_lock_depth = 0
+        self.async_lock_depth = 0
+        self.timeout_depth = 0
+
+    @property
+    def any_lock_depth(self) -> int:
+        return self.thread_lock_depth + self.async_lock_depth
+
+    # -- state queries ----------------------------------------------------
+    @property
+    def func(self) -> Optional[FunctionScope]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    @property
+    def in_async(self) -> bool:
+        f = self.func
+        return bool(f and f.is_async)
+
+    @property
+    def at_module_level(self) -> bool:
+        return not self.func_stack
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        return self.index.resolve(node)
+
+    def is_thread_lock(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.index.async_lock_names:
+                return False
+            return (expr.id in self.index.lock_names
+                    or bool(re.search(r"(^|_)r?lock$", expr.id)))
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in self.index.async_lock_attrs:
+                return False
+            return (expr.attr in self.index.lock_attrs
+                    or bool(re.search(r"(^|_)r?lock$", expr.attr)))
+        return False
+
+    def is_async_lock(self, expr: ast.AST) -> bool:
+        """Only meaningful under `async with` — asyncio locks are fine to
+        hold across await, but still count as 'a lock in scope'."""
+        if isinstance(expr, ast.Name):
+            return (expr.id in self.index.async_lock_names
+                    or bool(re.search(r"(^|_)r?lock$", expr.id)))
+        if isinstance(expr, ast.Attribute):
+            return (expr.attr in self.index.async_lock_attrs
+                    or bool(re.search(r"(^|_)r?lock$", expr.attr)))
+        return False
+
+    # -- reporting --------------------------------------------------------
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if rule in self._suppress_file or "*" in self._suppress_file:
+            return
+        sup = self._suppress_lines.get(line, ())
+        if rule in sup or "*" in sup:
+            return
+        self.violations.append(
+            Violation(rule, self.path, line,
+                      getattr(node, "col_offset", 0), message)
+        )
+
+
+class Rule:
+    """Base rule: override the hooks you need. `id` must be stable — it
+    is the suppression token and the baseline key prefix."""
+
+    id = "DYN-X000"
+    description = ""
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None: ...
+    def check_await(self, ctx: LintContext, node: ast.Await) -> None: ...
+    def check_branch(self, ctx: LintContext, node: ast.AST) -> None: ...
+    def check_expr_stmt(self, ctx: LintContext, node: ast.Expr) -> None: ...
+    def check_assign(self, ctx: LintContext, node: ast.AST) -> None: ...
+    def check_except(self, ctx: LintContext,
+                     node: ast.ExceptHandler) -> None: ...
+    def check_function(self, ctx: LintContext, scope: FunctionScope) -> None:
+        ...
+    def finish_module(self, ctx: LintContext) -> None: ...
+
+
+class _Engine(ast.NodeVisitor):
+    def __init__(self, ctx: LintContext, rules: Sequence[Rule]) -> None:
+        self.ctx = ctx
+        self.rules = rules
+
+    def _each(self, hook: str, node: ast.AST) -> None:
+        for r in self.rules:
+            getattr(r, hook)(self.ctx, node)
+
+    # -- functions --------------------------------------------------------
+    def _function_scope(self, node) -> FunctionScope:
+        idx = self.ctx.index
+        params = [a.arg for a in node.args.args] + [
+            a.arg for a in node.args.kwonlyargs
+        ]
+        static: Optional[Set[str]] = None
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = idx.resolve(target)
+            if name in ("jax.jit", "jit"):
+                static = set()
+                if isinstance(dec, ast.Call):
+                    for kw in dec.keywords:
+                        if kw.arg == "static_argnames":
+                            static |= set(_string_elts(kw.value))
+                        elif kw.arg == "static_argnums":
+                            for i in _int_elts(kw.value):
+                                if i < len(params):
+                                    static.add(params[i])
+            elif name in ("functools.partial", "partial") and isinstance(
+                dec, ast.Call
+            ) and dec.args and idx.resolve(dec.args[0]) in ("jax.jit", "jit"):
+                static = set()
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        static |= set(_string_elts(kw.value))
+                    elif kw.arg == "static_argnums":
+                        for i in _int_elts(kw.value):
+                            if i < len(params):
+                                static.add(params[i])
+        return FunctionScope(
+            node=node, name=node.name,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            params=params, jit_static=static,
+            is_kernel=node.name in idx.kernel_fns,
+        )
+
+    def _visit_function(self, node) -> None:
+        scope = self._function_scope(node)
+        self.ctx.func_stack.append(scope)
+        for r in self.rules:
+            r.check_function(self.ctx, scope)
+        saved_loop, self.ctx.loop_depth = self.ctx.loop_depth, 0
+        self.generic_visit(node)
+        self.ctx.loop_depth = saved_loop
+        self.ctx.func_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.ctx.func_stack.append(
+            FunctionScope(node=node, name="<lambda>", is_async=False,
+                          params=[a.arg for a in node.args.args])
+        )
+        self.generic_visit(node)
+        self.ctx.func_stack.pop()
+
+    # -- loops ------------------------------------------------------------
+    def _visit_loop(self, node) -> None:
+        if isinstance(node, ast.While):
+            self._each("check_branch", node)
+        self.ctx.loop_depth += 1
+        self.generic_visit(node)
+        self.ctx.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    # -- with blocks (lock / timeout tracking) ----------------------------
+    def _with_kinds(self, node) -> Tuple[int, int, int]:
+        locks = alocks = timeouts = 0
+        is_async = isinstance(node, ast.AsyncWith)
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                name = self.ctx.resolve(expr.func)
+                if name in ("asyncio.timeout", "asyncio.timeout_at",
+                            "async_timeout.timeout"):
+                    timeouts += 1
+                continue  # `with Lock():` — fresh lock, not shared state
+            if not is_async and self.ctx.is_thread_lock(expr):
+                locks += 1
+            elif is_async and self.ctx.is_async_lock(expr):
+                alocks += 1
+        return locks, alocks, timeouts
+
+    def _visit_with(self, node) -> None:
+        locks, alocks, timeouts = self._with_kinds(node)
+        self.ctx.thread_lock_depth += locks
+        self.ctx.async_lock_depth += alocks
+        self.ctx.timeout_depth += timeouts
+        self.generic_visit(node)
+        self.ctx.thread_lock_depth -= locks
+        self.ctx.async_lock_depth -= alocks
+        self.ctx.timeout_depth -= timeouts
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # -- leaf events ------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        self._each("check_call", node)
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._each("check_await", node)
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._each("check_branch", node)
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        self._each("check_expr_stmt", node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._each("check_assign", node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._each("check_assign", node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        self._each("check_except", node)
+        self.generic_visit(node)
+
+
+def _collect_suppressions(
+    source: str,
+) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    lines: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = (
+                {r.strip() for r in m.group(2).split(",") if r.strip()}
+                if m.group(2) else {"*"}
+            )
+            if m.group(1) == "disable-file":
+                file_wide |= rules
+            else:
+                lines.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return lines, file_wide
+
+
+def default_rules() -> List[Rule]:
+    from dynamo_tpu.lint.rules_async import ASYNC_RULES
+    from dynamo_tpu.lint.rules_jax import JAX_RULES
+    from dynamo_tpu.lint.rules_runtime import RUNTIME_RULES
+
+    return [cls() for cls in (*ASYNC_RULES, *JAX_RULES, *RUNTIME_RULES)]
+
+
+def lint_file(path: str, rules: Optional[Sequence[Rule]] = None,
+              source: Optional[str] = None,
+              rel_path: Optional[str] = None) -> List[Violation]:
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Violation("DYN-E000", rel_path or path, e.lineno or 0,
+                          e.offset or 0, f"syntax error: {e.msg}")]
+    index = ModuleIndex()
+    index.index_module(tree)
+    sup_lines, sup_file = _collect_suppressions(source)
+    ctx = LintContext(rel_path or path, tree, index, sup_lines, sup_file)
+    active = list(rules) if rules is not None else default_rules()
+    _Engine(ctx, active).visit(tree)
+    for r in active:
+        r.finish_module(ctx)
+    ctx.violations.sort(key=lambda v: (v.line, v.col, v.rule))
+    return ctx.violations
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build"}
+_SKIP_FILE_RE = re.compile(r"_pb2(_grpc)?\.py$")
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Sequence[Rule]] = None,
+               root: Optional[str] = None) -> List[Violation]:
+    out: List[Violation] = []
+    for path in paths:
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                files.extend(
+                    os.path.join(dirpath, f) for f in sorted(filenames)
+                    if f.endswith(".py") and not _SKIP_FILE_RE.search(f)
+                )
+        for f in files:
+            rel = os.path.relpath(f, root) if root else f
+            out.extend(lint_file(f, rules=rules, rel_path=rel))
+    return out
+
+
+# -- output + baseline ----------------------------------------------------
+def format_human(violations: Sequence[Violation]) -> str:
+    return "\n".join(
+        f"{v.path}:{v.line}:{v.col}: {v.rule} {v.message}"
+        for v in violations
+    )
+
+
+def format_json(violations: Sequence[Violation]) -> str:
+    return json.dumps([v.as_dict() for v in violations], indent=2)
+
+
+def baseline_counts(violations: Sequence[Violation]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.key()] = counts.get(v.key(), 0) + 1
+    return counts
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts = data.get("counts", {})
+    return {str(k): int(n) for k, n in counts.items()}
+
+
+def diff_against_baseline(
+    violations: Sequence[Violation], baseline: Dict[str, int],
+) -> Tuple[List[Violation], Dict[str, int], Dict[str, int]]:
+    """Split current violations into (new, regressed_keys, fixed_keys).
+
+    A key regresses when its count exceeds the baseline; the *newest*
+    (highest-line) findings for that key are reported as new, which is
+    the best line-level attribution a count ratchet can give. Keys whose
+    count dropped are 'fixed' — `--update-baseline` ratchets them down.
+    """
+    current = baseline_counts(violations)
+    regressed: Dict[str, int] = {}
+    fixed: Dict[str, int] = {}
+    for key, n in current.items():
+        base = baseline.get(key, 0)
+        if n > base:
+            regressed[key] = n - base
+    for key, base in baseline.items():
+        n = current.get(key, 0)
+        if n < base:
+            fixed[key] = base - n
+    new: List[Violation] = []
+    by_key: Dict[str, List[Violation]] = {}
+    for v in violations:
+        by_key.setdefault(v.key(), []).append(v)
+    for key, extra in regressed.items():
+        vs = sorted(by_key.get(key, []), key=lambda v: (v.line, v.col))
+        new.extend(vs[-extra:])
+    new.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return new, regressed, fixed

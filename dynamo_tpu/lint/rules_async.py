@@ -1,0 +1,154 @@
+"""DYN-A rule pack: async-safety.
+
+Every worker/router/frontend process runs ONE event loop; a single
+blocking call in any of the ~180 coroutines stalls every request that
+process is serving (heartbeats miss, leases lapse, routers see a dead
+instance). These rules catch the failure classes that have actually
+bitten this stack: blocking syscalls inside `async def`, awaits while a
+*threading* lock is held (the engine step thread then deadlocks against
+the loop), and fire-and-forget `create_task` whose only reference is
+dropped — the task can be garbage-collected mid-flight and its
+exception is never observed (use `dynamo_tpu.runtime.spawn_tracked`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.lint.core import LintContext, Rule
+
+# canonical (post-alias) dotted names that block the calling thread
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or "
+                      "`asyncio.to_thread`",
+    "subprocess.call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+    "os.system": "use `asyncio.create_subprocess_shell`",
+    "os.popen": "use `asyncio.create_subprocess_shell`",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "socket.getaddrinfo": "use `loop.getaddrinfo`",
+    "socket.gethostbyname": "use `loop.getaddrinfo`",
+    "urllib.request.urlopen": "use an async HTTP client or "
+                              "`asyncio.to_thread`",
+}
+for _verb in ("get", "post", "put", "patch", "delete", "head", "request"):
+    _BLOCKING_CALLS[f"requests.{_verb}"] = (
+        "use an async HTTP client (aiohttp) or `asyncio.to_thread`"
+    )
+
+_SPAWN_TAILS = (".create_task", ".ensure_future")
+_FILE_READ_ATTRS = {"read", "readline", "readlines", "write", "writelines"}
+
+
+def _is_spawn_call(ctx: LintContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = ctx.resolve(node.func)
+    if name is None:
+        return False
+    return (name in ("asyncio.create_task", "asyncio.ensure_future")
+            or name.endswith(_SPAWN_TAILS))
+
+
+class BlockingCallInAsync(Rule):
+    id = "DYN-A001"
+    description = "blocking call inside `async def` stalls the event loop"
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_async:
+            return
+        name = ctx.resolve(node.func)
+        fix = _BLOCKING_CALLS.get(name or "")
+        if fix is not None:
+            ctx.report(self.id, node,
+                       f"blocking `{name}` inside a coroutine stalls the "
+                       f"whole event loop; {fix}")
+
+
+class SyncFileIOInAsync(Rule):
+    id = "DYN-A002"
+    description = "sync file I/O inside `async def`"
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.in_async:
+            return
+        # open(...) inside a loop: repeated sync disk I/O on the loop
+        if (isinstance(node.func, ast.Name)
+                and ctx.resolve(node.func) == "open"
+                and ctx.loop_depth > 0):
+            ctx.report(self.id, node,
+                       "sync `open()` in a loop inside a coroutine; use "
+                       "`asyncio.to_thread` (or move I/O off the loop)")
+            return
+        # open(...).read() / .write() chained — blocking however brief
+        fn = node.func
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr in _FILE_READ_ATTRS
+                and isinstance(fn.value, ast.Call)
+                and ctx.resolve(fn.value.func) == "open"):
+            ctx.report(self.id, node,
+                       f"sync `open().{fn.attr}()` inside a coroutine "
+                       "blocks the event loop; use `asyncio.to_thread`")
+
+
+class AwaitHoldingThreadLock(Rule):
+    id = "DYN-A003"
+    description = "`await` while holding a threading.Lock"
+
+    def check_await(self, ctx: LintContext, node: ast.Await) -> None:
+        if ctx.thread_lock_depth > 0:
+            ctx.report(self.id, node,
+                       "`await` while holding a threading lock: the loop "
+                       "may suspend here with the lock held, deadlocking "
+                       "every thread (e.g. the engine step thread) that "
+                       "wants it; shrink the critical section or use "
+                       "`asyncio.Lock`")
+
+
+class DroppedTaskRef(Rule):
+    id = "DYN-A004"
+    description = "fire-and-forget create_task/ensure_future ref dropped"
+    _MSG = ("task reference dropped: asyncio keeps only a weak ref, so the "
+            "task can be garbage-collected mid-flight and its exception is "
+            "never logged; use `dynamo_tpu.runtime.spawn_tracked(...)`")
+
+    def check_expr_stmt(self, ctx: LintContext, node: ast.Expr) -> None:
+        if _is_spawn_call(ctx, node.value):
+            ctx.report(self.id, node, self._MSG)
+
+    def check_assign(self, ctx: LintContext, node: ast.AST) -> None:
+        if not isinstance(node, ast.Assign):
+            return
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_"
+                and _is_spawn_call(ctx, node.value)):
+            ctx.report(self.id, node, self._MSG)
+
+
+class WaitForShield(Rule):
+    id = "DYN-A005"
+    description = "asyncio.wait_for wrapping asyncio.shield"
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if ctx.resolve(node.func) != "asyncio.wait_for":
+            return
+        inner = node.args[0] if node.args else None
+        if (isinstance(inner, ast.Call)
+                and ctx.resolve(inner.func) == "asyncio.shield"):
+            ctx.report(self.id, node,
+                       "`wait_for(shield(...))`: on timeout the inner task "
+                       "keeps running detached with no owner to observe its "
+                       "result — if that is intended, retain the inner "
+                       "task explicitly and handle its completion")
+
+
+ASYNC_RULES = (
+    BlockingCallInAsync,
+    SyncFileIOInAsync,
+    AwaitHoldingThreadLock,
+    DroppedTaskRef,
+    WaitForShield,
+)

@@ -1,0 +1,188 @@
+"""DYN-J rule pack: JAX trace hygiene and compile-key cardinality.
+
+A jitted function is *traced*: Python control flow runs once per compile
+key, so branching on a tracer raises at best (ConcretizationTypeError)
+and silently bakes in one branch at worst. Worse for a serving system is
+cardinality: every distinct static-arg value is a fresh XLA compile
+(seconds of host stall each — the exact cache growth `_CompiledFamily`
+counts and the ragged kernel collapsed to ~|T buckets|, see
+docs/ragged_attention.md). DYN-J004 enforces that discipline at call
+sites: a static arg must be a constant or routed through a bucketing
+helper (`ensure_ragged_bucket`, `pack_buckets`, any `*bucket*` name),
+never a raw `len(...)`/`.shape` of request-sized data.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from dynamo_tpu.lint.core import JitBinding, LintContext, Rule
+
+# attributes of a tracer that are static (safe to branch on at trace time)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "at"}
+
+
+def _static_exempt_names(test: ast.AST) -> Set[str]:
+    """Names that only feed trace-time-static expressions: `x.shape[0]`,
+    `x.ndim`, `len(x)` are Python ints during tracing."""
+    exempt: Set[str] = set()
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            exempt |= {n.id for n in ast.walk(sub)
+                       if isinstance(n, ast.Name)}
+        elif (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+              and sub.func.id in ("len", "isinstance", "type", "getattr",
+                                  "hasattr")):
+            exempt |= {n.id for n in ast.walk(sub)
+                       if isinstance(n, ast.Name)}
+    return exempt
+
+
+def _tracer_params(ctx: LintContext) -> Set[str]:
+    scope = ctx.func
+    if scope is None or not scope.is_traced:
+        return set()
+    static = scope.jit_static or set()
+    return set(scope.params) - static - {"self", "cls"}
+
+
+class TracerBranch(Rule):
+    id = "DYN-J001"
+    description = "Python if/while on a tracer inside a jitted function"
+
+    def check_branch(self, ctx: LintContext, node: ast.AST) -> None:
+        tracers = _tracer_params(ctx)
+        if not tracers:
+            return
+        test = getattr(node, "test", None)
+        if test is None:
+            return
+        names = {n.id for n in ast.walk(test) if isinstance(n, ast.Name)}
+        hot = (names - _static_exempt_names(test)) & tracers
+        if hot:
+            kind = "while" if isinstance(node, ast.While) else "if"
+            ctx.report(self.id, node,
+                       f"Python `{kind}` on tracer value(s) "
+                       f"{sorted(hot)} inside a traced function; use "
+                       "`jax.lax.cond`/`select`/`jnp.where` (or mark the "
+                       "arg static and bucket it)")
+
+
+class TracerMaterialize(Rule):
+    id = "DYN-J002"
+    description = ".item()/int()/float() on a tracer inside jit"
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        tracers = _tracer_params(ctx)
+        if not tracers:
+            return
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in ("item", "tolist"):
+            ctx.report(self.id, node,
+                       f"`.{fn.attr}()` inside a traced function forces a "
+                       "host sync / fails on tracers; keep the value on "
+                       "device or compute it outside jit")
+            return
+        if (isinstance(fn, ast.Name) and fn.id in ("int", "float", "bool")
+                and node.args):
+            sub = node.args[0]
+            names = {n.id for n in ast.walk(sub) if isinstance(n, ast.Name)}
+            hot = (names - _static_exempt_names(sub)) & tracers
+            if hot:
+                ctx.report(self.id, node,
+                           f"`{fn.id}()` on tracer value(s) {sorted(hot)} "
+                           "inside a traced function raises "
+                           "ConcretizationTypeError at runtime")
+
+
+class ImportTimeJnp(Rule):
+    id = "DYN-J003"
+    description = "jnp.* executed at module import time"
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not ctx.at_module_level:
+            return
+        name = ctx.resolve(node.func)
+        if name and name.startswith("jax.numpy."):
+            ctx.report(self.id, node,
+                       f"`{name}` runs at import time: it initializes the "
+                       "JAX backend before the process can configure "
+                       "platforms/mesh (breaks JAX_PLATFORMS=cpu test "
+                       "runs); build the array lazily or use numpy")
+
+
+class CompileKeyCardinality(Rule):
+    id = "DYN-J004"
+    description = "jit static arg not provably drawn from a bucket set"
+
+    def _binding_for(self, ctx: LintContext,
+                     func: ast.AST) -> Optional[JitBinding]:
+        name = ctx.resolve(func)
+        if name is None:
+            return None
+        return ctx.index.jit_bindings.get(name.split(".")[-1])
+
+    def _unbucketed(self, ctx: LintContext, expr: ast.AST) -> bool:
+        """True when the static-arg expression derives from runtime data
+        (len()/.shape/arithmetic) with no bucketing step in the chain."""
+        if isinstance(expr, (ast.Constant, ast.Name, ast.Attribute)):
+            return False  # constants and pre-bound names are accepted
+        if isinstance(expr, ast.IfExp):
+            # a conditional between two bounded values is itself bounded
+            return (self._unbucketed(ctx, expr.body)
+                    or self._unbucketed(ctx, expr.orelse))
+        derived = False
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                name = ctx.resolve(sub.func) or ""
+                if "bucket" in name.lower():
+                    return False  # provably routed through a bucket helper
+                if name.split(".")[-1] == "len":
+                    derived = True
+            elif isinstance(sub, ast.Name) and "bucket" in sub.id.lower():
+                return False
+            elif isinstance(sub, ast.Attribute):
+                if "bucket" in sub.attr.lower():
+                    return False
+                if sub.attr == "shape":
+                    derived = True
+            elif isinstance(sub, ast.BinOp):
+                derived = True
+        return derived
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        b = self._binding_for(ctx, node.func)
+        if b is None:
+            return
+        static_pos = set(b.static_pos)
+        if b.inner_params:
+            static_pos |= {
+                i for i, p in enumerate(b.inner_params)
+                if p in b.static_names
+            }
+        for i, arg in enumerate(node.args):
+            if i in static_pos and self._unbucketed(ctx, arg):
+                ctx.report(self.id, node,
+                           f"static arg {i} of jitted `{b.name}` is "
+                           "computed from runtime values without a "
+                           "bucketing step: every distinct value is a "
+                           "fresh XLA compile; round through "
+                           "`ensure_ragged_bucket`/`pack_buckets` (see "
+                           "docs/ragged_attention.md)")
+        for kw in node.keywords:
+            if kw.arg in b.static_names and self._unbucketed(ctx, kw.value):
+                ctx.report(self.id, node,
+                           f"static arg `{kw.arg}` of jitted `{b.name}` "
+                           "is computed from runtime values without a "
+                           "bucketing step: every distinct value is a "
+                           "fresh XLA compile; round through "
+                           "`ensure_ragged_bucket`/`pack_buckets`")
+
+
+JAX_RULES = (
+    TracerBranch,
+    TracerMaterialize,
+    ImportTimeJnp,
+    CompileKeyCardinality,
+)

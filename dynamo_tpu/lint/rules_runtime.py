@@ -1,0 +1,120 @@
+"""DYN-R rule pack: runtime races and robustness.
+
+The runtime planes (request/event/discovery) are long-lived: a swallowed
+exception or a hung await doesn't crash the process, it degrades it —
+the worker keeps its lease while silently serving nothing. These rules
+flag the three shapes that produce that state: module-level mutable
+state mutated from multiple coroutines with no lock (loop interleaving
+at any await corrupts it), `except Exception: pass` that erases the
+evidence, and cross-plane socket reads with no timeout (a half-dead
+peer then parks the coroutine forever — the request-plane connection is
+the only thing that notices).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from dynamo_tpu.lint.core import LintContext, Rule
+
+_MUTATORS = {
+    "append", "add", "update", "pop", "setdefault", "clear", "extend",
+    "discard", "remove", "insert", "popitem",
+}
+
+# awaited cross-plane reads that hang forever when the peer half-dies;
+# each needs asyncio.wait_for / asyncio.timeout (or a documented reason)
+_RPC_ATTRS = {"readexactly", "next_msg", "round_trip", "request_once"}
+
+
+class SharedMutableState(Rule):
+    id = "DYN-R001"
+    description = "module-level mutable written from >=2 coroutines unlocked"
+
+    def __init__(self) -> None:
+        # name -> list of (coroutine name, write node, lock held)
+        self._writes: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+
+    def _record(self, ctx: LintContext, name: str, node: ast.AST) -> None:
+        if name not in ctx.index.module_mutables or not ctx.in_async:
+            return
+        self._writes.setdefault(name, []).append(
+            (ctx.func.name, node, ctx.any_lock_depth > 0)
+        )
+
+    def check_assign(self, ctx: LintContext, node: ast.AST) -> None:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                self._record(ctx, t.value.id, node)
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS
+                and isinstance(fn.value, ast.Name)):
+            self._record(ctx, fn.value.id, node)
+
+    def finish_module(self, ctx: LintContext) -> None:
+        for name, writes in self._writes.items():
+            writers = {fn for fn, _, _ in writes}
+            unlocked = [(fn, node) for fn, node, locked in writes
+                        if not locked]
+            if len(writers) >= 2 and unlocked:
+                for fn, node in unlocked:
+                    ctx.report(self.id, node,
+                               f"module-level mutable `{name}` written "
+                               f"from {len(writers)} coroutines "
+                               f"({sorted(writers)}) with no lock in "
+                               "scope: loop interleaving at any await "
+                               "corrupts it; guard with one asyncio.Lock")
+        self._writes.clear()
+
+
+class ExceptPassSwallow(Rule):
+    id = "DYN-R002"
+    description = "`except Exception: pass` swallows failures silently"
+
+    def _too_broad(self, ctx: LintContext, node: ast.ExceptHandler) -> bool:
+        t = node.type
+        if t is None:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(ctx.resolve(e) in ("Exception", "BaseException")
+                       for e in t.elts)
+        return ctx.resolve(t) in ("Exception", "BaseException")
+
+    def check_except(self, ctx: LintContext,
+                     node: ast.ExceptHandler) -> None:
+        if (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+                and self._too_broad(ctx, node)):
+            ctx.report(self.id, node,
+                       "broad `except` with bare `pass` erases the only "
+                       "evidence of a failure; narrow the exception type "
+                       "and/or log at debug level")
+
+
+class MissingRpcTimeout(Rule):
+    id = "DYN-R003"
+    description = "cross-plane await with no timeout"
+
+    def check_await(self, ctx: LintContext, node: ast.Await) -> None:
+        if ctx.timeout_depth > 0:
+            return
+        val = node.value
+        if (isinstance(val, ast.Call)
+                and isinstance(val.func, ast.Attribute)
+                and val.func.attr in _RPC_ATTRS):
+            ctx.report(self.id, node,
+                       f"`await ...{val.func.attr}()` with no timeout: a "
+                       "half-dead peer parks this coroutine forever; wrap "
+                       "in `asyncio.wait_for` (or an `asyncio.timeout` "
+                       "scope)")
+
+
+RUNTIME_RULES = (
+    SharedMutableState,
+    ExceptPassSwallow,
+    MissingRpcTimeout,
+)

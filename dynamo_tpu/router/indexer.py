@@ -18,6 +18,7 @@ from typing import Dict, Optional, Tuple
 from dynamo_tpu.router.protocols import KV_EVENT_SUBJECT, RouterEvent
 from dynamo_tpu.router.radix_tree import BlockIndex
 from dynamo_tpu.runtime.event_plane import EventSubscriber
+from dynamo_tpu.runtime.tasks import spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.router.indexer")
 
@@ -97,7 +98,7 @@ class KvIndexer:
         if self._dump_fn is None or worker in self._resyncing:
             return
         self._resyncing.add(worker)
-        asyncio.create_task(self._resync(worker))
+        spawn_tracked(self._resync(worker), logger=log)
 
     async def resync_worker(self, worker: Worker) -> None:
         """Full-state seed/resync from the worker's dump endpoint."""

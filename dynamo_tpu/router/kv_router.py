@@ -28,6 +28,7 @@ from dynamo_tpu.router.sequences import ActiveSequences
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.distributed import DistributedRuntime, EndpointClient
 from dynamo_tpu.runtime.request_plane import RequestPlaneError
+from dynamo_tpu.runtime.tasks import spawn_tracked
 from dynamo_tpu.tokens.hashing import block_hashes
 
 log = logging.getLogger("dynamo_tpu.router")
@@ -275,7 +276,7 @@ class KvRouter:
         if kind == "put":
             if self.use_kv_events:
                 # never block the discovery watch loop on a worker RPC
-                asyncio.create_task(self._connect_worker(inst))
+                spawn_tracked(self._connect_worker(inst), logger=log)
             # fresh capacity: drain the admission queue into it. Only for
             # a genuinely NEW instance — discovery also emits puts for
             # metadata updates and lease re-registrations of known
@@ -554,12 +555,13 @@ class KvRouter:
             try:
                 await self._prefetch_client.close()
             except Exception:
-                pass
+                log.debug("prefetch client close failed", exc_info=True)
         if self._sync_inst is not None:
             try:
                 await self.runtime.discovery.unregister(self._sync_inst)
             except Exception:
-                pass
+                log.debug("sync-instance unregister failed; lease expiry "
+                          "reclaims it", exc_info=True)
         if self._sync_sub is not None:
             await self._sync_sub.close()
         # _sync_pub is the runtime-owned singleton publisher; the runtime

@@ -22,6 +22,7 @@ from typing import Any, AsyncIterator, Dict, Optional
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.request_plane import RequestPlaneError
+from dynamo_tpu.runtime.tasks import spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.prefill_router")
 
@@ -179,9 +180,10 @@ class PrefillRouter:
                 fetch = _FetchClient(client, transfer_src)
                 await fetch.discard()
             except Exception:
-                pass  # TTL reclaims
+                log.debug("parked-page discard failed; TTL reclaims",
+                          exc_info=True)
 
-        asyncio.create_task(_release())
+        spawn_tracked(_release(), logger=log)
 
     async def _run_prefill_hop(self, request, context):
         preq = dict(request)
@@ -243,7 +245,7 @@ class PrefillRouter:
                 try:
                     client.router.mark_sick(iid)
                 except Exception:
-                    pass
+                    log.debug("mark_sick(%s) failed", iid, exc_info=True)
             log.warning("prefill hop failed (%s); falling back to aggregated", e.code)
             return None
         except RuntimeError as e:

@@ -189,15 +189,18 @@ class KvRouterService:
             self._reaper.cancel()
             try:
                 await self._reaper
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                log.debug("reaper task exited with error", exc_info=True)
         # deregister before stopping the router: a discoverable endpoint
         # backed by a stopped router hands out stale selections
         for inst in self._insts:
             try:
                 await self.runtime.discovery.unregister(inst)
             except Exception:
-                pass
+                log.debug("unregister %x failed during stop; lease expiry "
+                          "reclaims it", inst.instance_id, exc_info=True)
         self._insts.clear()
         await self.router.stop()
 
@@ -283,7 +286,7 @@ class RemoteKvRouter:
             try:
                 await c.close()
             except Exception:
-                pass
+                log.debug("service client close failed", exc_info=True)
 
 
 def parse_args(argv=None):

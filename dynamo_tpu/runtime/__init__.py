@@ -20,6 +20,7 @@ from dynamo_tpu.runtime.discovery import (
     make_discovery,
 )
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.tasks import spawn_tracked, tracked_count
 
 __all__ = [
     "Context",
@@ -35,4 +36,6 @@ __all__ = [
     "DiscoveryEvent",
     "make_discovery",
     "DistributedRuntime",
+    "spawn_tracked",
+    "tracked_count",
 ]

@@ -153,7 +153,9 @@ class DistributedRuntime:
             try:
                 await self.discovery.unregister(inst)
             except Exception:  # pragma: no cover
-                pass
+                log.debug("unregister %x failed during shutdown (lease "
+                          "expiry will reclaim it)", inst.instance_id,
+                          exc_info=True)
         self._served.clear()
         if self._hb_task is not None:
             self._hb_task.cancel()

@@ -207,7 +207,8 @@ class EtcdDiscovery(DiscoveryBackend):
             try:
                 await self._post("/v3/lease/revoke", {"ID": self._lease_id})
             except Exception:
-                pass
+                log.debug("lease revoke failed on close; etcd TTL will "
+                          "expire it", exc_info=True)
             self._lease_id = None
         if self._session is not None:
             await self._session.close()

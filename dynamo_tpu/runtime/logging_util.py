@@ -103,20 +103,27 @@ class OtlpLogHandler(logging.Handler):
         self._thread.start()
 
     def emit(self, record: logging.LogRecord) -> None:
+        import queue
+
+        # no logging in here: a log call from the log exporter recurses
+        # straight back into emit
         try:
-            self._q.put_nowait(
-                {
-                    "timeUnixNano": str(int(record.created * 1e9)),
-                    "severityNumber": _SEVERITY.get(record.levelname, 9),
-                    "severityText": record.levelname,
-                    "body": {"stringValue": record.getMessage()},
-                    "attributes": [
-                        {"key": "target",
-                         "value": {"stringValue": record.name}},
-                    ],
-                }
-            )
+            wire = {
+                "timeUnixNano": str(int(record.created * 1e9)),
+                "severityNumber": _SEVERITY.get(record.levelname, 9),
+                "severityText": record.levelname,
+                "body": {"stringValue": record.getMessage()},
+                "attributes": [
+                    {"key": "target",
+                     "value": {"stringValue": record.name}},
+                ],
+            }
         except Exception:
+            self.handleError(record)  # bad format args: stderr, not a raise
+            return
+        try:
+            self._q.put_nowait(wire)
+        except queue.Full:
             pass  # full queue: drop
 
     def _loop(self) -> None:
@@ -150,5 +157,8 @@ class OtlpLogHandler(logging.Handler):
                     headers={"Content-Type": "application/json"},
                 )
                 urllib.request.urlopen(req, timeout=5).read()
-            except Exception:
-                pass  # collector down: telemetry drops, serving unaffected
+            except (OSError, ValueError):
+                # collector down / bad endpoint: telemetry drops, serving
+                # unaffected (no logging here — it would feed back into
+                # this exporter's own queue)
+                pass

@@ -29,6 +29,7 @@ import msgpack
 
 from dynamo_tpu.runtime.context import CancellationError, Context
 from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.tasks import spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.request_plane")
 
@@ -53,14 +54,17 @@ async def _send_frame(writer: asyncio.StreamWriter, obj: Dict[str, Any]) -> None
 
 async def _recv_frame(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
     try:
-        hdr = await reader.readexactly(4)
+        # the idle wait between frames: blocking here forever is the
+        # contract, and peer death surfaces as IncompleteReadError
+        hdr = await reader.readexactly(4)  # dynlint: disable=DYN-R003
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     (n,) = _LEN.unpack(hdr)
     if n > MAX_FRAME:
         raise RequestPlaneError(f"frame too large: {n}", code="protocol")
     try:
-        body = await reader.readexactly(n)
+        # body follows its length header; conn death is handled below
+        body = await reader.readexactly(n)  # dynlint: disable=DYN-R003
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     return msgpack.unpackb(body, raw=False)
@@ -344,8 +348,10 @@ class _MuxConn:
                     if frame is None:
                         break
                     await on_frame(frame)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            pass  # peer went away: close() below poisons pending streams
         except Exception:
-            pass
+            log.debug("connection reader failed", exc_info=True)
         finally:
             self.close()
 
@@ -558,9 +564,10 @@ class RemoteEngine:
                     try:
                         await conn.send({"t": "kill", "id": rid})
                     except Exception:
-                        pass
+                        log.debug("kill for abandoned stream %s not "
+                                  "delivered", rid, exc_info=True)
 
-                asyncio.ensure_future(_bg_kill())
+                spawn_tracked(_bg_kill(), logger=log)
 
 
 class RouterMode:
@@ -868,7 +875,9 @@ class NatsPushEndpoint(PushEndpoint):
         client = self._client
         try:
             while True:
-                item = await client.next_msg()
+                # endpoint dispatch loop: waiting forever for the next
+                # request is the contract; broker death yields None
+                item = await client.next_msg()  # dynlint: disable=DYN-R003
                 if item is None:
                     if client._closed:
                         return
@@ -984,7 +993,9 @@ class _NatsMuxConn:
     async def _read_loop(self) -> None:
         try:
             while True:
-                item = await self._client.next_msg()
+                # mux reader loop: idle conns legitimately wait forever;
+                # broker death yields None and fans out disconnect below
+                item = await self._client.next_msg()  # dynlint: disable=DYN-R003
                 if item is None:
                     # broker dropped: in-flight streams cannot be resumed
                     # (core NATS replays nothing) — fan disconnect so the

@@ -139,7 +139,8 @@ class ShadowServer:
             me = self._standby.instance_id
             rank = ids.index(me) if me in ids else len(ids)
         except Exception:
-            pass
+            log.debug("standby rank probe failed; assuming rank 0",
+                      exc_info=True)
         if rank > 0:
             # wait for the lower-ranked shadow to win: promotion serves the
             # endpoint BEFORE dropping the standby record (see _promote), so
@@ -215,8 +216,11 @@ class ShadowServer:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                log.debug("shadow watch task exited with error",
+                          exc_info=True)
 
 
 class _StandbyRecord:

@@ -134,9 +134,11 @@ class OtlpSpanExporter:
         self._thread.start()
 
     def export(self, span: Span) -> None:
+        import queue
+
         try:
             self._q.put_nowait(span)
-        except Exception:
+        except queue.Full:
             pass  # full queue: drop
 
     @staticmethod
@@ -199,8 +201,8 @@ class OtlpSpanExporter:
                     headers={"Content-Type": "application/json"},
                 )
                 urllib.request.urlopen(req, timeout=5).read()
-            except Exception:
-                pass  # collector down: drop
+            except (OSError, ValueError):
+                pass  # collector down / bad endpoint: drop
 
 
 _exporter = None

@@ -34,6 +34,8 @@ from typing import Any, AsyncIterator, Dict, Optional
 import grpc
 import msgpack
 
+from dynamo_tpu.runtime.tasks import spawn_tracked
+
 sys.path.insert(0, str(Path(__file__).parent / "protos"))
 import engine_sidecar_pb2 as pb  # noqa: E402
 
@@ -151,10 +153,9 @@ class SidecarEngine:
         if self._channel is not None:
             ch, self._channel = self._channel, None
             try:
-                loop = asyncio.get_running_loop()
-                loop.create_task(ch.close())
+                spawn_tracked(ch.close(), logger=log)
             except RuntimeError:
-                pass
+                pass  # no running loop: process is exiting anyway
 
     def on_fpm(self, cb) -> None:
         pass

@@ -20,6 +20,7 @@ from dynamo_tpu.router.protocols import FPM_SUBJECT
 from dynamo_tpu.router.publisher import KvEventPublisher
 from dynamo_tpu.runtime.component import new_instance_id
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.tasks import spawn_tracked
 
 log = logging.getLogger("dynamo_tpu.worker")
 
@@ -208,7 +209,7 @@ async def serve_worker(
             payload["worker"] = [instance_id, dp_rank]
 
             def _send() -> None:
-                asyncio.ensure_future(pub.publish(FPM_SUBJECT, payload))
+                spawn_tracked(pub.publish(FPM_SUBJECT, payload), logger=log)
 
             loop.call_soon_threadsafe(_send)
 

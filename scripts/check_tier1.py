@@ -36,9 +36,16 @@ def main() -> int:
                          "set (repeatable); defaults to the modules newer "
                          "PRs added, whose silent loss the count alone "
                          "would not catch")
+    lint_group = ap.add_mutually_exclusive_group()
+    lint_group.add_argument("--lint", dest="lint", action="store_true",
+                            default=True,
+                            help="also run the dynlint gate (default)")
+    lint_group.add_argument("--no-lint", dest="lint", action="store_false",
+                            help="skip the dynlint gate")
     args = ap.parse_args()
     required = args.require if args.require is not None else [
         "test_sched_packing.py", "test_ragged_mixed.py",
+        "test_dynlint.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -70,9 +77,33 @@ def main() -> int:
     ok = (proc.returncode == 0 and errors == 0 and collected > 0
           and not missing)
 
+    lint_ok = True
+    if args.lint:
+        # hard gate: NEW dynlint violations (vs the committed baseline)
+        # fail tier-1 exactly like a broken import would
+        lint_proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dynlint.py"),
+             "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=args.timeout,
+        )
+        lint_ok = lint_proc.returncode == 0
+        print(lint_proc.stdout, end="")
+        if not lint_ok:
+            # re-run human-readable so the offending lines reach CI logs
+            detail = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "scripts", "dynlint.py")],
+                cwd=REPO, capture_output=True, text=True,
+                timeout=args.timeout,
+            )
+            print("TIER-1 CHECK FAILED: new dynlint violations "
+                  "(see docs/static_analysis.md)", file=sys.stderr)
+            print(detail.stdout + detail.stderr, file=sys.stderr)
+    ok = ok and lint_ok
+
     print(json.dumps({"metric": "tier1_collection", "ok": ok,
                       "collected": collected, "errors": errors,
-                      "missing": missing}))
+                      "missing": missing, "lint_ok": lint_ok}))
     if not ok:
         # loud: surface the collection tracebacks so the broken import is
         # visible in CI logs, not just the count

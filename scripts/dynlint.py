@@ -1,0 +1,103 @@
+"""dynlint CLI — AST invariant checker with a baseline ratchet.
+
+Checks async-safety (DYN-A), JAX trace hygiene / compile-key
+cardinality (DYN-J), and runtime robustness (DYN-R) invariants over the
+given paths (default: dynamo_tpu/). Violations already recorded in the
+committed baseline (lint_baseline.json) are legacy debt and pass; any
+NEW violation fails. The ratchet only goes down: when you fix legacy
+findings, run --update-baseline and commit the shrunken file.
+
+    python scripts/dynlint.py dynamo_tpu/            # gate (exit 1 on new)
+    python scripts/dynlint.py --all                  # list everything
+    python scripts/dynlint.py --update-baseline      # ratchet the baseline
+    python scripts/dynlint.py --json                 # one summary line
+
+Suppress a deliberate single-line exception with
+`# dynlint: disable=DYN-A001` (policy: docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+from dynamo_tpu.lint import (  # noqa: E402
+    baseline_counts,
+    diff_against_baseline,
+    format_human,
+    lint_paths,
+    load_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(REPO, "lint_baseline.json")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: dynamo_tpu/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every violation fails")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON summary line (bench/PROGRESS mode)")
+    ap.add_argument("--all", action="store_true",
+                    help="print all findings, not just new-vs-baseline")
+    args = ap.parse_args()
+
+    paths = args.paths or [os.path.join(REPO, "dynamo_tpu")]
+    violations = lint_paths(paths, root=REPO)
+    per_rule: dict = {}
+    for v in violations:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+
+    if args.update_baseline:
+        counts = baseline_counts(violations)
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump({"version": 1,
+                       "counts": dict(sorted(counts.items()))},
+                      f, indent=2, sort_keys=False)
+            f.write("\n")
+        print(f"baseline updated: {len(violations)} findings over "
+              f"{len(counts)} rule:file keys -> {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, regressed, fixed = diff_against_baseline(violations, baseline)
+    ok = not new
+
+    if args.json:
+        print(json.dumps({
+            "metric": "dynlint", "ok": ok, "total": len(violations),
+            "new": len(new), "fixed_keys": len(fixed),
+            "baseline_keys": len(baseline), "rules": per_rule,
+        }))
+        return 0 if ok else 1
+
+    if args.all:
+        print(format_human(violations) or "clean: no findings")
+    elif new:
+        print(format_human(new))
+    if new:
+        print(f"\ndynlint: {len(new)} NEW violation(s) vs baseline "
+              f"({len(violations)} total, {len(baseline)} legacy keys). "
+              "Fix them, add `# dynlint: disable=<RULE>` with a reason, "
+              "or (legacy burn-down only) --update-baseline.",
+              file=sys.stderr)
+    else:
+        print(f"dynlint: ok — {len(violations)} finding(s), all covered "
+              f"by baseline ({len(fixed)} key(s) improved)"
+              + ("; run --update-baseline to ratchet down" if fixed else ""))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

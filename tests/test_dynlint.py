@@ -1,0 +1,507 @@
+"""dynlint framework + rule packs (fast tier-1 suite).
+
+Fixture tests per rule pack (positive / negative / suppression / alias
+cases, ISSUE 4 acceptance: every DYN-A / DYN-J / DYN-R rule catches its
+seeded violation), the baseline-ratchet diff semantics, a whole-repo
+cleanliness check against the committed baseline, and the satellite-3
+regression that the request/event planes still degrade gracefully after
+the except-narrowing fix pass.
+"""
+
+import asyncio
+import json
+import textwrap
+
+import pytest
+
+from dynamo_tpu.lint import (
+    baseline_counts,
+    diff_against_baseline,
+    format_json,
+    lint_file,
+    lint_paths,
+)
+
+
+def _lint(src, path="fixture.py"):
+    return lint_file(path, source=textwrap.dedent(src))
+
+
+def _rules(src, **kw):
+    return [v.rule for v in _lint(src, **kw)]
+
+
+# -- DYN-A: async-safety ----------------------------------------------------
+
+
+def test_a001_blocking_call_in_async():
+    vs = _lint("""
+        import time
+
+        async def worker():
+            time.sleep(1.0)
+    """)
+    assert [v.rule for v in vs] == ["DYN-A001"]
+    assert "asyncio.sleep" in vs[0].message  # suggests the async twin
+
+
+def test_a001_resolves_import_aliases():
+    """`import time as t` and `from subprocess import run as launch` must
+    canonicalize back to the blocked names — aliasing is not an escape."""
+    assert _rules("""
+        import time as t
+
+        async def a():
+            t.sleep(0.5)
+    """) == ["DYN-A001"]
+    assert _rules("""
+        from subprocess import run as launch
+
+        async def b():
+            launch(["ls"])
+    """) == ["DYN-A001"]
+
+
+def test_a001_negative_sync_fn_and_async_sleep():
+    assert _rules("""
+        import asyncio
+        import time
+
+        def sync_worker():
+            time.sleep(1.0)  # fine: not on the event loop
+
+        async def a():
+            await asyncio.sleep(1.0)
+    """) == []
+
+
+def test_a002_file_io_in_async_loop():
+    assert _rules("""
+        async def dump(items):
+            for it in items:
+                with open(it.path) as f:
+                    f.read()
+    """) == ["DYN-A002"]
+
+
+def test_a003_await_holding_thread_lock():
+    """await under `with threading.Lock()` parks the coroutine while the
+    OS lock is held — the engine step thread then deadlocks the loop.
+    asyncio.Lock is the async-aware twin and must NOT flag."""
+    assert _rules("""
+        import asyncio
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def step(self):
+                with self._lock:
+                    await asyncio.sleep(0)
+    """) == ["DYN-A003"]
+    assert _rules("""
+        import asyncio
+
+        class S:
+            def __init__(self):
+                self._lock = asyncio.Lock()
+
+            async def step(self):
+                async with self._lock:
+                    await asyncio.sleep(0)
+    """) == []
+
+
+def test_a004_dropped_create_task():
+    vs = _lint("""
+        import asyncio
+
+        async def fire(coro):
+            asyncio.create_task(coro)
+    """)
+    assert [v.rule for v in vs] == ["DYN-A004"]
+    assert "spawn_tracked" in vs[0].message
+    # retaining the handle (any real name) is the accepted pattern
+    assert _rules("""
+        import asyncio
+
+        async def fire(self, coro):
+            self._task = asyncio.create_task(coro)
+    """) == []
+
+
+def test_a005_wait_for_shield():
+    assert _rules("""
+        import asyncio
+
+        async def call(op):
+            await asyncio.wait_for(asyncio.shield(op()), timeout=5)
+    """) == ["DYN-A005"]
+
+
+# -- suppression comments ---------------------------------------------------
+
+
+def test_line_suppression_comment():
+    assert _rules("""
+        import time
+
+        async def worker():
+            time.sleep(1.0)  # dynlint: disable=DYN-A001
+    """) == []
+
+
+def test_line_suppression_is_rule_specific():
+    """Disabling one rule must not blanket-silence the line."""
+    assert _rules("""
+        import time
+
+        async def worker():
+            time.sleep(1.0)  # dynlint: disable=DYN-A002
+    """) == ["DYN-A001"]
+
+
+def test_file_suppression_comment():
+    assert _rules("""
+        # dynlint: disable-file=DYN-A001
+        import time
+
+        async def a():
+            time.sleep(1)
+
+        async def b():
+            time.sleep(2)
+    """) == []
+
+
+# -- DYN-J: JAX trace hygiene ----------------------------------------------
+
+
+def test_j001_tracer_branch():
+    assert _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """) == ["DYN-J001"]
+
+
+def test_j001_negative_static_argnames():
+    """Branching on a static arg re-traces per value by design — the
+    static_argnames declaration IS the opt-in, so no finding."""
+    assert _rules("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "fast":
+                return x
+            return x * 2
+    """) == []
+
+
+def test_j002_tracer_materialize():
+    assert _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """) == ["DYN-J002"]
+    # materializing in plain Python (no trace) is fine
+    assert _rules("""
+        def g(x):
+            return x.item()
+    """) == []
+
+
+def test_j003_import_time_jnp():
+    assert _rules("""
+        import jax.numpy as jnp
+
+        ZEROS = jnp.zeros((8, 8))
+    """) == ["DYN-J003"]
+    # an unconventional alias still resolves to jax.numpy
+    assert _rules("""
+        import jax.numpy as np
+
+        EYE = np.eye(4)
+    """) == ["DYN-J003"]
+    # calling inside a function defers to first use: fine
+    assert _rules("""
+        import jax.numpy as jnp
+
+        def make():
+            return jnp.zeros((8, 8))
+    """) == []
+
+
+def test_j004_compile_key_cardinality():
+    """Passing a raw length-derived value as a jit static arg compiles one
+    program per distinct value; routing through a bucket fn caps the
+    family (docs/ragged_attention.md discipline)."""
+    assert _rules("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            return x
+
+        def drive(xs):
+            return step(xs, len(xs))
+    """) == ["DYN-J004"]
+    assert _rules("""
+        from functools import partial
+        import jax
+
+        def ensure_ragged_bucket(n):
+            return max(8, 1 << (n - 1).bit_length())
+
+        @partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            return x
+
+        def drive(xs):
+            return step(xs, ensure_ragged_bucket(len(xs)))
+    """) == []
+    # constants never explode the compile family
+    assert _rules("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def step(x, n):
+            return x
+
+        def drive(xs):
+            return step(xs, 128)
+    """) == []
+
+
+# -- DYN-R: runtime invariants ----------------------------------------------
+
+
+def test_r001_shared_mutable_state():
+    assert _rules("""
+        PENDING = []
+
+        async def producer(x):
+            PENDING.append(x)
+
+        async def consumer():
+            PENDING.clear()
+    """) == ["DYN-R001", "DYN-R001"]
+    # same shape, writes serialized under an asyncio.Lock: clean
+    assert _rules("""
+        import asyncio
+
+        PENDING = []
+        _lock = asyncio.Lock()
+
+        async def producer(x):
+            async with _lock:
+                PENDING.append(x)
+
+        async def consumer():
+            async with _lock:
+                PENDING.clear()
+    """) == []
+
+
+def test_r002_except_pass_swallow():
+    assert _rules("""
+        def close(ch):
+            try:
+                ch.close()
+            except Exception:
+                pass
+    """) == ["DYN-R002"]
+    # a narrowed type documents WHICH failure is acceptable: clean
+    assert _rules("""
+        def close(ch):
+            try:
+                ch.close()
+            except OSError:
+                pass
+    """) == []
+
+
+def test_r003_missing_rpc_timeout():
+    assert _rules("""
+        async def rpc(reader):
+            return await reader.readexactly(4)
+    """) == ["DYN-R003"]
+    assert _rules("""
+        import asyncio
+
+        async def rpc(reader):
+            return await asyncio.wait_for(reader.readexactly(4), timeout=30)
+    """) == []
+
+
+# -- baseline ratchet -------------------------------------------------------
+
+
+def test_baseline_diff_semantics():
+    vs = _lint("""
+        def a(ch):
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+        def b(ch):
+            try:
+                ch.close()
+            except Exception:
+                pass
+    """)
+    assert len(vs) == 2
+    counts = baseline_counts(vs)
+    assert counts == {"DYN-R002:fixture.py": 2}
+    # same counts → nothing new, nothing fixed
+    new, regressed, fixed = diff_against_baseline(vs, counts)
+    assert (new, regressed, fixed) == ([], {}, {})
+    # baseline knew of 1 → the extra (highest-line) finding is NEW
+    new, regressed, fixed = diff_against_baseline(
+        vs, {"DYN-R002:fixture.py": 1})
+    assert len(new) == 1 and new[0].line == vs[1].line
+    assert regressed == {"DYN-R002:fixture.py": 1}
+    # baseline knew of 3 → one key improved; ratchet can tighten
+    new, regressed, fixed = diff_against_baseline(
+        vs, {"DYN-R002:fixture.py": 3})
+    assert new == [] and fixed == {"DYN-R002:fixture.py": 1}
+    # a fully-fixed key reports too
+    new, regressed, fixed = diff_against_baseline(
+        [], {"DYN-R002:fixture.py": 2})
+    assert fixed == {"DYN-R002:fixture.py": 2}
+
+
+def test_json_and_human_output_shapes():
+    vs = _lint("""
+        import time
+
+        async def a():
+            time.sleep(1)
+    """)
+    payload = json.loads(format_json(vs))
+    assert [p["rule"] for p in payload] == ["DYN-A001"]
+    assert payload[0]["path"] == "fixture.py"
+    from dynamo_tpu.lint import format_human
+
+    assert format_human(vs).startswith("fixture.py:5:")
+
+
+def test_repo_is_clean_against_committed_baseline():
+    """The tree must carry no dynlint findings beyond lint_baseline.json —
+    the same ratchet check_tier1.py enforces, runnable from pytest."""
+    import os
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    with open(os.path.join(repo, "lint_baseline.json")) as f:
+        baseline = json.load(f)["counts"]
+    vs = lint_paths([os.path.join(repo, "dynamo_tpu")], root=repo)
+    new, regressed, _fixed = diff_against_baseline(vs, baseline)
+    assert not new and not regressed, (
+        "new dynlint violations (fix them or, for true-but-accepted "
+        "findings, add an inline `# dynlint: disable=RULE` with a reason):\n"
+        + "\n".join(f"{v.path}:{v.line} {v.rule} {v.message}"
+                    for v in new + regressed)
+    )
+
+
+# -- satellite 3: planes degrade gracefully after except-narrowing ----------
+
+
+async def test_request_plane_survives_garbage_then_serves():
+    """An abrupt, mid-frame client disconnect (the case the narrowed
+    reader-loop excepts must absorb) must not wedge the endpoint: a
+    well-formed request on a fresh connection still streams."""
+    import struct
+
+    import msgpack
+
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.request_plane import (
+        PushEndpoint,
+        _recv_frame,
+        _send_frame,
+    )
+
+    class Echo:
+        async def generate(self, request, context: Context):
+            yield {"echo": request}
+
+    ep = PushEndpoint()
+    ep.add_endpoint("ns/w/echo", Echo())
+    addr = await ep.start()
+    host, port = addr.rsplit(":", 1)
+    try:
+        # 1) abrupt: declare an 8-byte body, send 3 bytes, slam the socket
+        r1, w1 = await asyncio.open_connection(host, int(port))
+        w1.write(struct.pack(">I", 8) + b"\x01\x02\x03")
+        await w1.drain()
+        w1.close()
+        # 2) the endpoint must still serve a clean connection
+        r2, w2 = await asyncio.open_connection(host, int(port))
+        await _send_frame(w2, {"t": "req", "id": "r1",
+                               "endpoint": "ns/w/echo", "headers": {},
+                               "payload": {"x": 1}})
+        frames = []
+        while True:
+            frame = await asyncio.wait_for(_recv_frame(r2), timeout=10)
+            assert frame is not None
+            frames.append(frame)
+            if frame["t"] in ("done", "err"):
+                break
+        assert [f["t"] for f in frames] == ["item", "done"]
+        assert frames[0]["data"] == {"echo": {"x": 1}}
+        w2.close()
+    finally:
+        await ep.stop(drain_timeout=1)
+
+
+async def test_event_plane_survives_abrupt_peer():
+    """Same contract on the NATS event plane: a peer that connects and
+    dies mid-handshake must not take the broker down for real clients."""
+    from dynamo_tpu.runtime.nats_plane import (
+        MiniNatsServer,
+        NatsEventPublisher,
+        NatsEventSubscriber,
+    )
+
+    srv = MiniNatsServer()
+    url = await srv.start()
+    host, port = url.replace("nats://", "").rsplit(":", 1)
+    # garbage peer: invalid protocol line, then vanish
+    r, w = await asyncio.open_connection(host, int(port))
+    w.write(b"NOT A NATS OP\r\n")
+    await w.drain()
+    w.close()
+
+    pub = NatsEventPublisher(url=url)
+    sub = NatsEventSubscriber(subjects=["kv"], url=url)
+    sub.connect(url)
+    try:
+        got = []
+
+        async def consume():
+            async for _subject, payload in sub.events():
+                got.append(payload)
+                return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.2)
+        await pub.publish("kv", {"ok": True})
+        await asyncio.wait_for(task, timeout=10)
+        assert got == [{"ok": True}]
+    finally:
+        await pub.close()
+        await sub.close()
+        await srv.stop()
