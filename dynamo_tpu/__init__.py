@@ -49,7 +49,13 @@ def ensure_platform() -> None:
 
             jax.config.update("jax_platforms", want)
         except Exception:
-            pass
+            # jax absent or backend already initialized — the env var
+            # still applies to any later first-touch initialization
+            import logging
+
+            logging.getLogger("dynamo_tpu").debug(
+                "jax_platforms override to %r not applied", want,
+                exc_info=True)
 
 
 def enable_compilation_cache(path=None):

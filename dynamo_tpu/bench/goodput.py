@@ -29,6 +29,7 @@ from typing import Any, List, Optional
 
 from dynamo_tpu.bench.loadgen import (
     GoodputReport,
+    aggregate_phases,
     compute_goodput,
     generate_burst_trace,
     generate_trace,
@@ -63,12 +64,15 @@ class Stack:
             try:
                 await w.stop()
             except Exception:
-                pass
+                # teardown is best-effort: a worker that died mid-bench
+                # must not mask the runtimes' shutdown below
+                log.debug("worker stop failed during teardown", exc_info=True)
         for rt in self.worker_runtimes:
             try:
                 await rt.shutdown(drain_timeout=2)
             except Exception:
-                pass
+                log.debug("runtime shutdown failed during teardown",
+                          exc_info=True)
         if self.broker is not None:
             await self.broker.stop()
         if self.nats_env_prev is not False:
@@ -304,6 +308,16 @@ async def run_goodput(args) -> GoodputReport:
         }
     if sim_stats:
         report.extras["sim"] = sim_stats
+    # per-request latency spine: queue_wait / TTFT / ITL / kv_onboard
+    # breakdowns from the phase stamps that rode each final item
+    phase_agg = aggregate_phases(results)
+    if phase_agg:
+        report.extras["phases"] = {
+            key: {"n": st["n"],
+                  "p50_s": round(st["p50_s"], 6),
+                  "p95_s": round(st["p95_s"], 6)}
+            for key, st in phase_agg.items()
+        }
     return report
 
 

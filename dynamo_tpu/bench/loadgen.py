@@ -110,6 +110,10 @@ class RequestResult:
     total_s: Optional[float] = None
     osl: int = 0
     error: Optional[str] = None
+    # per-request latency spine from the final item (engine/_emit_item):
+    # queue_wait_s, kv_onboard_s, ttft_s, e2e_s, itl_s samples, plus any
+    # frontend/router stamps that rode the request plane
+    phases: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def itl_s(self) -> Optional[float]:
@@ -177,6 +181,28 @@ def compute_goodput(
     )
 
 
+def aggregate_phases(results: List[RequestResult]) -> Dict[str, Any]:
+    """Fold per-request phase spines into p50/p95 per phase.  itl_s is a
+    per-request sample LIST (flattened); everything else is a scalar per
+    request.  Empty dict when no request carried phases."""
+    series: Dict[str, List[float]] = {}
+    for r in results:
+        if not r.ok or not r.phases:
+            continue
+        for key, val in r.phases.items():
+            if isinstance(val, list):
+                series.setdefault(key, []).extend(
+                    float(v) for v in val if isinstance(v, (int, float)))
+            elif isinstance(val, (int, float)):
+                series.setdefault(key, []).append(float(val))
+    return {
+        key: {"n": len(vals),
+              "p50_s": _pct(vals, 0.5),
+              "p95_s": _pct(vals, 0.95)}
+        for key, vals in sorted(series.items()) if vals
+    }
+
+
 def _prompt_tokens(req: TraceRequest, rng: random.Random) -> List[int]:
     """Token-id prompt; prefix groups share leading tokens."""
     if req.prefix_group >= 0:
@@ -207,6 +233,7 @@ async def run_trace_against_engine(
         start = time.monotonic()
         first = None
         n_out = 0
+        phases: Dict[str, Any] = {}
         try:
             payload = {
                 "token_ids": _prompt_tokens(req, rng),
@@ -219,9 +246,12 @@ async def run_trace_against_engine(
                     first = time.monotonic() - start
                 n_out += n
                 if item.get("finish_reason"):
+                    if isinstance(item.get("phases"), dict):
+                        phases = item["phases"]
                     break
             results[i] = RequestResult(
-                ok=True, ttft_s=first, total_s=time.monotonic() - start, osl=n_out
+                ok=True, ttft_s=first, total_s=time.monotonic() - start,
+                osl=n_out, phases=phases,
             )
         except Exception as e:
             results[i] = RequestResult(ok=False, error=str(e))

@@ -40,8 +40,12 @@ from dynamo_tpu.engine.scheduler import (
 from dynamo_tpu.frontend.protocols import engine_output
 from dynamo_tpu.runtime.annotations import annotate
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.flight_recorder import FlightRecorder, IterationRecord
 
 log = logging.getLogger("dynamo_tpu.engine")
+
+# per-request ITL sample cap: bounds the spine's memory on long generations
+_ITL_CAP = 512
 
 
 @dataclass
@@ -86,6 +90,11 @@ class InferenceEngine:
         prefetch_pin_ttl_s: float = 5.0,  # promoted-block pin lifetime
         tokenizer_spec: str = "byte",  # guided decoding lifts byte DFAs to
         #   token masks against THIS tokenizer (must match the frontend's)
+        recorder_size: int = 4096,  # flight-recorder ring capacity (0 = off)
+        anomaly_k: float = 4.0,  # iteration wall > EWMA*k fires the trigger
+        anomaly_dump_dir: Optional[str] = None,  # None = count, don't dump
+        anomaly_dump_last_n: int = 256,  # ring records per anomaly dump
+        anomaly_profile_ms: int = 0,  # >0: jax.profiler window per dump
     ):
         self.runner = runner
         # fused mixed dispatch (one program per iteration instead of two):
@@ -187,6 +196,17 @@ class InferenceEngine:
         self.fpm_history: List[ForwardPassMetrics] = []
         self._fpm_listeners: List[Any] = []
         self._kv_listeners: List[Any] = []
+        self._phase_listeners: List[Any] = []
+        # always-on iteration flight recorder (runtime/flight_recorder.py);
+        # recorder_size=0 builds the disabled no-op variant for A/Bs
+        self.recorder = FlightRecorder(
+            recorder_size,
+            anomaly_k=anomaly_k,
+            anomaly_dump_dir=anomaly_dump_dir,
+            anomaly_dump_last_n=anomaly_dump_last_n,
+            anomaly_profile_ms=anomaly_profile_ms,
+        )
+        self._rec_prev_charged = 0  # runner packed_tokens_charged watermark
         # sick peers for cross-worker pulls: instance -> retry-after time
         self._remote_fetch_backoff: Dict[int, float] = {}
         # disaggregation state
@@ -232,14 +252,19 @@ class InferenceEngine:
             try:
                 self.scheduler.abort(seq.request_id)
             except Exception:
-                pass
+                # fail-everything must visit EVERY sequence even when one
+                # abort races its normal finish; note it, keep going
+                log.debug("abort of %s during fail-everything raced",
+                          seq.request_id, exc_info=True)
             try:
                 self._emit_item(seq, {
                     "finish_reason": "error", "error": message,
                     "token_ids": [],
                 })
             except Exception:
-                pass
+                log.debug("error emit to %s failed during fail-everything "
+                          "(stream already gone)", seq.request_id,
+                          exc_info=True)
 
     # -- guided decoding ---------------------------------------------------
     def _compile_guided(self, spec: Dict[str, Any]):
@@ -323,6 +348,11 @@ class InferenceEngine:
         """cb(List[KvEvent]) from the step thread."""
         self._kv_listeners.append(cb)
 
+    def on_phases(self, cb) -> None:
+        """cb(phases: Dict[str, float]) from the step thread, once per
+        finished request (worker_common feeds /metrics histograms)."""
+        self._phase_listeners.append(cb)
+
     # -- AsyncEngine protocol ----------------------------------------------
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
         self.start()
@@ -364,6 +394,19 @@ class InferenceEngine:
             guided=request.get("guided"),
             logit_bias=request.get("logit_bias"),
         )
+        # latency spine: upstream hops (frontend, router) stamped their
+        # locally-measured durations into ctx.metadata["phases"]; seed the
+        # sequence's phase dict so the final item carries the whole spine.
+        # Durations only — monotonic clocks don't compare across processes.
+        upstream = context.metadata.get("phases")
+        if isinstance(upstream, dict):
+            seq.phases.update({
+                k: float(v) for k, v in upstream.items()
+                if isinstance(v, (int, float))
+            })
+        if context.metadata.get("migration_attempt"):
+            seq.phases["migration_attempts"] = float(
+                context.metadata["migration_attempt"])
         if seq.logit_bias and (
             getattr(self.runner, "has_draft", False)
             or getattr(self.runner, "pp", False)
@@ -564,7 +607,9 @@ class InferenceEngine:
                     try:
                         cb()
                     except Exception:
-                        pass
+                        # the callback is the worker's process-exit hook;
+                        # its failure must not mask the fatal path itself
+                        log.exception("fatal callback failed")
                 break
         log.info("engine step loop stopped")
 
@@ -578,14 +623,32 @@ class InferenceEngine:
                 time.sleep(self.idle_sleep_s)
             return
         t0 = time.monotonic()
+        t_start, ts_wall = t0, time.time()
+        # plan-composition fields for this iteration's flight record;
+        # branches fill in what they actually served
+        rinfo = {"decode_seqs": 0, "decode_steps": 0, "n_chunks": 0,
+                 "chunk_tokens": 0, "fused": False, "ragged": False}
         decode_done = False
         try:
             if isinstance(plan, PrefillPlan):
                 self._run_prefill(plan)
                 kind, n_tok = "prefill", len(plan.chunk)
+                rinfo.update(n_chunks=1, chunk_tokens=len(plan.chunk))
             elif isinstance(plan, MixedPlan):
                 if self._mixed_fusible(plan):
                     chunk_logits = self._run_mixed_dispatch(plan)
+                    served = plan.prefills[:len(chunk_logits)]
+                    rinfo.update(
+                        decode_seqs=len(plan.decode.seqs),
+                        decode_steps=plan.decode.n_steps,
+                        n_chunks=len(served),
+                        chunk_tokens=sum(len(p.chunk) for p in served),
+                        fused=True,
+                        # the packed multi-chunk program is the ragged
+                        # flat-token path; single-chunk fused rides the
+                        # padded decode_multi_with_prefill fallback
+                        ragged=len(served) > 1,
+                    )
                     # decode tokens are emitted: from here on a failure
                     # (e.g. in a chunk's sampling extras) must only
                     # fail the prefill sequences
@@ -631,9 +694,17 @@ class InferenceEngine:
                     kind = "prefill"
                     n_tok = sum(len(p.chunk) for p in plan.prefills)
                     t0 = t1
+                    rinfo.update(
+                        decode_seqs=len(plan.decode.seqs),
+                        decode_steps=plan.decode.n_steps,
+                        n_chunks=len(plan.prefills),
+                        chunk_tokens=n_tok,
+                    )
             else:
                 self._run_decode(plan)
                 kind, n_tok = "decode", len(plan.seqs)
+                rinfo.update(decode_seqs=len(plan.seqs),
+                             decode_steps=plan.n_steps)
         except GroupBroken:
             raise  # unrecoverable: handled by _loop's fail-fast
         except Exception:
@@ -666,6 +737,62 @@ class InferenceEngine:
             return
         self._publish_fpm(kind, time.monotonic() - t0, n_tok)
         self._publish_kv_events()
+        self._record_iteration(
+            ts_wall, time.monotonic() - t_start,
+            "mixed" if isinstance(plan, MixedPlan) else kind, rinfo,
+        )
+
+    def _record_iteration(self, ts: float, wall: float, kind: str,
+                          rinfo: Dict[str, Any]) -> None:
+        """Assemble and append this iteration's flight record (step
+        thread; cheap field reads only — see DYN-R004)."""
+        rec = self.recorder
+        if not rec.enabled:
+            return
+        st = self.scheduler.stats
+        g2 = g3 = 0
+        if self.host_pool is not None:
+            g2 = len(self.host_pool.host)
+            if self.host_pool.disk is not None:
+                g3 = len(self.host_pool.disk)
+        hits = self.prefetch.stats["hits"] if self.prefetch is not None else 0
+        variants = calls = 0
+        fams = getattr(self.runner, "_families", None)
+        if fams:
+            for fam in fams.values():
+                variants += fam.variants
+                calls += fam.calls
+        charged = rinfo["chunk_tokens"]
+        rstats = getattr(self.runner, "stats", None)
+        if isinstance(rstats, dict) and "packed_tokens_charged" in rstats:
+            # SimRunner keeps an honest cumulative padded-charge counter;
+            # its per-iteration delta is the real charged-token figure
+            cum = int(rstats.get("packed_tokens_charged") or 0)
+            delta = cum - self._rec_prev_charged
+            self._rec_prev_charged = cum
+            if delta > 0:
+                charged = delta
+        rec.append(IterationRecord(
+            seq=self._step_counter,
+            ts=ts,
+            wall_s=wall,
+            kind=kind,
+            decode_seqs=rinfo["decode_seqs"],
+            decode_steps=rinfo["decode_steps"],
+            n_chunks=rinfo["n_chunks"],
+            chunk_tokens=rinfo["chunk_tokens"],
+            charged_tokens=charged,
+            ragged=rinfo["ragged"],
+            fused=rinfo["fused"],
+            n_waiting=st.n_waiting,
+            n_running=st.n_running,
+            kv_usage=st.kv_usage,
+            g2_blocks=g2,
+            g3_blocks=g3,
+            prefetch_hits=hits,
+            compile_variants=variants,
+            compile_calls=calls,
+        ))
 
     def _recover_poisoned_pools(self) -> None:
         """A step that fails AFTER its jit dispatch consumed the donated
@@ -715,7 +842,8 @@ class InferenceEngine:
                         "token_ids": [],
                     })
                 except Exception:
-                    pass
+                    log.debug("error emit to pending %s failed (stream "
+                              "already gone)", seq.request_id, exc_info=True)
         self.runner.reset_kv_pools()
         self.pool.reset()
         if clear_tiers and self.host_pool is not None:
@@ -1435,9 +1563,35 @@ class InferenceEngine:
         logprobs: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         extra = {"logprobs": logprobs} if logprobs else {}
+        if token_ids:
+            # latency spine: first emitted token fixes TTFT; later emit
+            # groups contribute per-token ITL samples (bounded list — a
+            # long generation keeps its first _ITL_CAP samples)
+            now = time.monotonic()
+            if "ttft_s" not in seq.phases:
+                if seq.arrival:
+                    seq.phases["ttft_s"] = max(0.0, now - seq.arrival)
+            elif seq.t_last_emit and len(seq.itl) < _ITL_CAP:
+                seq.itl.append(
+                    max(0.0, now - seq.t_last_emit) / len(token_ids))
+            seq.t_last_emit = now
         self._emit_item(seq, engine_output(token_ids, finish, **extra))
 
     def _emit_item(self, seq: Sequence, item: Dict[str, Any]) -> None:
+        if item.get("finish_reason"):
+            # final item carries the request's phase spine downstream
+            # (loadgen/goodput aggregate it; the frontend adds span events)
+            phases = dict(seq.phases)
+            if seq.arrival:
+                phases["e2e_s"] = max(0.0, time.monotonic() - seq.arrival)
+            if seq.itl:
+                phases["itl_s"] = list(seq.itl)
+            item.setdefault("phases", phases)
+            for cb in self._phase_listeners:
+                try:
+                    cb(phases)
+                except Exception:  # pragma: no cover
+                    log.exception("phase listener failed")
         entry = self._streams.get(seq.request_id)
         if entry is None:
             return

@@ -18,6 +18,7 @@ Invariants:
 from __future__ import annotations
 
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
@@ -76,6 +77,12 @@ class Sequence:
     finish_reason: Optional[str] = None
     n_preemptions: int = 0
     n_prompt0: int = 0  # original prompt length (preemption rewrites prompt)
+    # latency spine (runtime/flight_recorder.py docs): locally-measured
+    # phase durations, seeded with upstream-hop stamps from ctx.metadata
+    # and attached to the final emitted item as item["phases"]
+    phases: Dict[str, float] = field(default_factory=dict)
+    itl: List[float] = field(default_factory=list)  # bounded ITL samples
+    t_last_emit: float = 0.0  # monotonic time of the last token emission
 
     @property
     def n_generated(self) -> int:
@@ -257,6 +264,11 @@ class Scheduler:
             self.waiting.popleft()
             self.active.append(seq)
             seq.state = SeqState.PREFILL
+            # latency spine: WAITING -> PREFILL transition ends queue_wait
+            # (first admission only — preemption re-admits don't reset it)
+            if seq.arrival and "queue_wait_s" not in seq.phases:
+                seq.phases["queue_wait_s"] = max(
+                    0.0, time.monotonic() - seq.arrival)
 
     def _try_allocate(self, seq: Sequence) -> bool:
         PS = self.pool.page_size
@@ -293,7 +305,12 @@ class Scheduler:
             return False
 
         if host_n:
+            t_onboard = time.monotonic()
             if self.host_onboard(fresh[:host_n], host_hashes):
+                # latency spine: lower-tier KV promotion paid at admission
+                seq.phases["kv_onboard_s"] = (
+                    seq.phases.get("kv_onboard_s", 0.0)
+                    + (time.monotonic() - t_onboard))
                 parent = hashes[-1] if hashes else _chain_seed(seq)
                 for page, h in zip(fresh[:host_n], host_hashes):
                     canonical = self.pool.register(page, h, parent)

@@ -75,8 +75,11 @@ def _keep_after_exit(shm: shared_memory.SharedMemory) -> None:
         from multiprocessing import resource_tracker
 
         resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:  # tracker internals shifted — staging still works,
-        pass  # it just dies with the creator on this Python
+    except Exception:
+        # tracker internals shifted — staging still works, it just dies
+        # with the creator on this Python
+        log.debug("resource_tracker unregister failed for %s", shm._name,
+                  exc_info=True)
 
 
 def _flatten(params: Any):

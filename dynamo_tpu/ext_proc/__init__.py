@@ -106,7 +106,10 @@ class EndpointPicker:
             if cand in ids:
                 iid = cand
         except Exception:
-            pass
+            # router not warmed yet (no KV events) — fall through to the
+            # least-loaded pick below
+            log.debug("router pick failed; using least-loaded fallback",
+                      exc_info=True)
         if iid is None:
             self._rr += 1
             n = len(ids)

@@ -11,6 +11,7 @@ left off. Bounded by `migration_limit` per request.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, AsyncIterator, Dict
 
 from dynamo_tpu.runtime import tracing
@@ -47,6 +48,15 @@ class Migration:
                         "model": str(context.metadata.get("model") or "")},
         ) as root:
             tracing.child_traceparent(context.metadata, root)
+            # latency spine: frontend-side pre-dispatch wait, stamped into
+            # the metadata phase dict that rides the request plane so the
+            # worker folds it into the final item's phases (durations only
+            # — monotonic clocks don't compare across processes)
+            t_dispatch = time.monotonic()
+            ph = context.metadata.setdefault("phases", {})
+            ph["frontend_queue_s"] = max(
+                0.0, t_dispatch - context.created_at)
+            first_token_seen = False
             while True:
                 try:
                     # re-issues go out with a fresh child context so a stop
@@ -54,6 +64,14 @@ class Migration:
                     attempt_ctx = context.child()
                     async for item in self.downstream.generate(request, attempt_ctx):
                         accumulated.extend(item.get("token_ids") or [])
+                        if not first_token_seen and item.get("token_ids"):
+                            first_token_seen = True
+                            root.add_event("first_token", {
+                                "frontend_ttft_s":
+                                    time.monotonic() - t_dispatch,
+                            })
+                        if item.get("finish_reason"):
+                            self._finish_phases(item, root, t_dispatch)
                         yield item
                     return
                 except RequestPlaneError as e:
@@ -65,6 +83,7 @@ class Migration:
                     attempts = self.migration_limit - retries_left
                     root.set_attribute("migration.attempts", attempts)
                     context.metadata["migration_attempt"] = attempts
+                    root.add_event("migration", {"attempt": attempts})
                     request = self._replay_request(request, accumulated)
                     n_replayed = len(accumulated)
                     accumulated = []  # folded into the replayed prompt
@@ -72,6 +91,19 @@ class Migration:
                         "migrating request %s after %s (%d retries left, %d tokens replayed)",
                         context.id, e.code, retries_left, n_replayed,
                     )
+
+    @staticmethod
+    def _finish_phases(item: Dict[str, Any], root, t_dispatch: float) -> None:
+        """Fold frontend-side stamps into the final item's phase spine and
+        surface every scalar phase as a span event on the root span."""
+        phases = item.get("phases")
+        if not isinstance(phases, dict):
+            phases = {}
+            item["phases"] = phases
+        phases["frontend_e2e_s"] = max(0.0, time.monotonic() - t_dispatch)
+        for key, val in phases.items():
+            if isinstance(val, (int, float)):
+                root.add_event(f"phase.{key}", {"seconds": float(val)})
 
     @staticmethod
     def _replay_request(request: Dict[str, Any], accumulated: list[int]) -> Dict[str, Any]:

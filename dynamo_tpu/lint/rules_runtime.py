@@ -113,8 +113,50 @@ class MissingRpcTimeout(Rule):
                        "scope)")
 
 
+# the flight recorder's append path runs inline in the engine step loop:
+# ONE blocking syscall there shows up in every iteration's wall time and
+# poisons the very EWMA the recorder uses to spot anomalies. Dump/profile
+# work must stay on the hand-off thread (_dump_loop / _write_dump).
+_BLOCKING_NAMES = {"open", "print"}
+_BLOCKING_ATTRS = {
+    "sleep", "write", "flush", "fsync", "fdatasync", "dump", "urlopen",
+    "sendall", "send", "recv", "put",  # queue.put blocks when full;
+}                                      # put_nowait is the allowed spelling
+_HOT_PREFIXES = ("append", "record", "observe", "on_")
+
+
+class RecorderBlockingIo(Rule):
+    id = "DYN-R004"
+    description = "blocking I/O in a flight-recorder append path"
+
+    def _in_hot_path(self, ctx: LintContext) -> bool:
+        if "flight_recorder" not in ctx.path:
+            return False
+        for scope in ctx.func_stack:
+            if scope.name.lstrip("_").startswith(_HOT_PREFIXES):
+                return True
+        return False
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if not self._in_hot_path(ctx):
+            return
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Name) and fn.id in _BLOCKING_NAMES:
+            name = fn.id
+        elif isinstance(fn, ast.Attribute) and fn.attr in _BLOCKING_ATTRS:
+            name = fn.attr
+        if name is not None:
+            ctx.report(self.id, node,
+                       f"`{name}(...)` in a flight-recorder append path "
+                       "runs inline in the engine step loop and skews "
+                       "every iteration it touches; hand the work to the "
+                       "dump thread (queue.put_nowait) instead")
+
+
 RUNTIME_RULES = (
     SharedMutableState,
     ExceptPassSwallow,
     MissingRpcTimeout,
+    RecorderBlockingIo,
 )

@@ -45,6 +45,13 @@ def parse_args(argv=None):
     p.add_argument("--prefetch-pin-ttl-s", type=float, default=5.0)
     p.add_argument("--speed", type=float, default=1.0, help="timing scale; 0 = no sleeps")
     p.add_argument("--decode-base-ms", type=float, default=4.0)
+    p.add_argument("--recorder-size", type=int, default=4096,
+                   help="flight-recorder ring capacity (0 = off)")
+    p.add_argument("--anomaly-k", type=float, default=4.0)
+    p.add_argument("--anomaly-dump-dir", default=None)
+    p.add_argument("--anomaly-dump-last-n", type=int, default=256)
+    p.add_argument("--status-port", type=int, default=0,
+                   help="serve /live /health /metrics /debug/timeline here")
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"])
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
@@ -70,6 +77,10 @@ def build_mock_engine(args) -> tuple[InferenceEngine, ModelCard]:
         prefetch_bandwidth_mbps=getattr(args, "prefetch_bandwidth_mbps", 0.0),
         prefetch_hint_ttl_s=getattr(args, "prefetch_hint_ttl_s", 10.0),
         prefetch_pin_ttl_s=getattr(args, "prefetch_pin_ttl_s", 5.0),
+        recorder_size=getattr(args, "recorder_size", 4096),
+        anomaly_k=getattr(args, "anomaly_k", 4.0),
+        anomaly_dump_dir=getattr(args, "anomaly_dump_dir", None),
+        anomaly_dump_last_n=getattr(args, "anomaly_dump_last_n", 256),
     )
     card = ModelCard(
         name=args.model_name,
@@ -87,6 +98,22 @@ async def async_main(args) -> None:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
     engine, card = build_mock_engine(args)
+    status = None
+    if args.status_port:
+        from dynamo_tpu.runtime.status import StatusServer
+
+        status = StatusServer(runtime, port=args.status_port)
+        status.add_check(
+            "engine", lambda: getattr(engine, "_thread", True) is not None
+        )
+        rec = engine.recorder
+        if rec is not None and rec.enabled:
+            from dynamo_tpu.runtime.flight_recorder import to_chrome_trace
+
+            status.add_timeline(
+                lambda last_n=None: to_chrome_trace(rec.snapshot(last_n))
+            )
+        await status.start()
     worker = await serve_worker(
         runtime, engine, card,
         namespace=args.namespace, component=args.component, endpoint=args.endpoint,
@@ -108,6 +135,8 @@ async def async_main(args) -> None:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if status is not None:
+            await status.stop()
         await worker.stop()
         await runtime.shutdown()
 

@@ -383,7 +383,10 @@ class Reconciler:
                     "recommendation": None,
                 })
             except Exception:
-                pass
+                # status write is best-effort after the deploy already
+                # failed; the log.exception above carries the root cause
+                log.debug("DGDR %s failure-status report failed", name,
+                          exc_info=True)
 
     async def _run_profile(self, dgdr: Dict[str, Any]) -> Dict[str, Any]:
         """SLA profiling sweep (planner/profiler.py rapid mode: the real

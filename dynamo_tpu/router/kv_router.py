@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 from dynamo_tpu.router.indexer import KvIndexer
@@ -576,6 +577,7 @@ class KvPushRouter:
         self.router = router
 
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
+        t_route = time.monotonic()
         await self.router.start()
         # admission gate: parks here while every worker is saturated;
         # raises queue_full / queue_timeout (→ HTTP 429) on rejection
@@ -617,6 +619,12 @@ class KvPushRouter:
         self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap
         context.metadata["routed_instance"] = worker[0]
+        # latency spine: KV-aware selection cost (admission wait included —
+        # that's real time the router held the request), accumulated across
+        # migration retries; the metadata dict rides to the worker
+        ph = context.metadata.setdefault("phases", {})
+        ph["route_s"] = (ph.get("route_s", 0.0)
+                        + (time.monotonic() - t_route))
         first = True
         try:
             async for item in self.router.client.direct(

@@ -164,6 +164,12 @@ class DistributedRuntime:
         if self._event_publisher is not None:
             await self._event_publisher.close()
         await self.discovery.close()
+        # drain the span batch queue (bounded) so a short-lived worker's
+        # tail spans reach the collector before the process exits
+        from dynamo_tpu.runtime import tracing
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, tracing.flush_tracing, 5.0)
 
 
 class Namespace:

@@ -3,11 +3,20 @@
 Analog of the reference MetricsHierarchy (lib/runtime/src/distributed.rs:93-109):
 metrics created through a runtime/component/endpoint handle automatically
 carry dynamo_namespace / dynamo_component / dynamo_endpoint labels.
+
+When `prometheus_client` is absent, `make_metrics` degrades to
+`SimpleMetrics` — plain dict-backed counters/gauges/histograms with a
+minimal text-exposition `render()` — so StatusServer `/metrics` is never
+empty. The degradation is logged once at startup.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Optional
+import logging
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+log = logging.getLogger("dynamo_tpu.metrics")
 
 try:
     from prometheus_client import (
@@ -78,35 +87,114 @@ class MetricsHierarchy:
         return generate_latest(self.registry)
 
 
-class NullMetrics:
-    """No-op stand-in when prometheus_client is unavailable."""  # pragma: no cover
+class _SimpleValue:
+    """One labeled series in the fallback store. Counter/gauge hold a
+    float; histogram keeps count/sum (no buckets — the fallback trades
+    quantiles for zero dependencies)."""
 
-    def child(self, **labels):
-        return self
+    __slots__ = ("value", "count", "lock")
 
-    def _noop(self, *a, **k):
-        class _N:
-            def inc(self, *a, **k):
-                pass
+    def __init__(self) -> None:
+        self.value = 0.0
+        self.count = 0
+        self.lock = threading.Lock()
 
-            def dec(self, *a, **k):
-                pass
+    def inc(self, amount: float = 1.0) -> None:
+        with self.lock:
+            self.value += amount
 
-            def set(self, *a, **k):
-                pass
+    def dec(self, amount: float = 1.0) -> None:
+        with self.lock:
+            self.value -= amount
 
-            def observe(self, *a, **k):
-                pass
+    def set(self, value: float) -> None:
+        with self.lock:
+            self.value = float(value)
 
-        return _N()
+    def observe(self, value: float) -> None:
+        with self.lock:
+            self.value += float(value)
+            self.count += 1
 
-    counter = gauge = histogram = _noop
 
+class SimpleMetrics:
+    """Dict-backed MetricsHierarchy stand-in when prometheus_client is
+    unavailable: same counter/gauge/histogram/child surface, and a
+    minimal Prometheus text-exposition `render()` so StatusServer
+    /metrics still serves real numbers."""
+
+    _KINDS = {"counter": "counter", "gauge": "gauge",
+              "histogram": "histogram"}
+
+    def __init__(self, labels: Optional[Dict[str, str]] = None,
+                 store: Optional[Dict] = None):
+        self.labels = {k: "" for k in HIERARCHY_LABELS}
+        self.labels.update(labels or {})
+        # (kind, name, label_items) -> _SimpleValue; shared across children
+        self._store: Dict[Tuple[str, str, Tuple], _SimpleValue] = (
+            store if store is not None else {})
+
+    def child(self, **labels: str) -> "SimpleMetrics":
+        merged = dict(self.labels)
+        merged.update(labels)
+        return SimpleMetrics(labels=merged, store=self._store)
+
+    def _series(self, kind: str, name: str, extra: Dict[str, str]):
+        labels = dict(self.labels)
+        labels.update({k: str(v) for k, v in extra.items()})
+        key = (kind, name, tuple(sorted(labels.items())))
+        val = self._store.get(key)
+        if val is None:
+            val = self._store.setdefault(key, _SimpleValue())
+        return val
+
+    def counter(self, name: str, doc: str = "", **extra: str):
+        return self._series("counter", name, extra)
+
+    def gauge(self, name: str, doc: str = "", **extra: str):
+        return self._series("gauge", name, extra)
+
+    def histogram(self, name: str, doc: str = "", **extra: str):
+        return self._series("histogram", name, extra)
+
+    def render(self) -> bytes:
+        """Prometheus text exposition from the dict store. Histograms
+        expose only _count and _sum series (no buckets)."""
+        by_name: Dict[Tuple[str, str], list] = {}
+        for (kind, name, label_items), val in sorted(self._store.items()):
+            by_name.setdefault((kind, name), []).append((label_items, val))
+        lines = []
+        for (kind, name), series in by_name.items():
+            full = PREFIX + name
+            lines.append(f"# TYPE {full} {self._KINDS[kind]}")
+            for label_items, val in series:
+                lbl = ",".join(
+                    f'{k}="{v}"' for k, v in label_items)
+                if kind == "histogram":
+                    lines.append(f"{full}_count{{{lbl}}} {val.count}")
+                    lines.append(f"{full}_sum{{{lbl}}} {val.value}")
+                else:
+                    lines.append(f"{full}{{{lbl}}} {val.value}")
+        return ("\n".join(lines) + "\n").encode() if lines else b""
+
+
+# kept for back-compat with external callers; SimpleMetrics is what
+# make_metrics now degrades to
+class NullMetrics(SimpleMetrics):  # pragma: no cover
     def render(self) -> bytes:
         return b""
 
 
+_warned_no_prom = False
+
+
 def make_metrics(namespace: str = "") -> MetricsHierarchy:
+    global _warned_no_prom
     if _HAVE_PROM:
         return MetricsHierarchy(labels={"dynamo_namespace": namespace})
-    return NullMetrics()  # pragma: no cover
+    if not _warned_no_prom:  # pragma: no cover
+        _warned_no_prom = True
+        log.warning(
+            "prometheus_client is not installed: /metrics degrades to the "
+            "dict-backed text fallback (no histogram buckets)")
+    return SimpleMetrics(labels={"dynamo_namespace": namespace})

@@ -23,6 +23,7 @@ import asyncio
 import logging
 import random
 import struct
+import time as _time
 from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
 import msgpack
@@ -802,6 +803,7 @@ class PushRouter:
         return RemoteEngine(self._pool, addr, self.endpoint_path)
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        t_route = _time.monotonic()
         allowed = context.metadata.get("allowed_instances")
         iid, addr = self._pick(
             context.metadata.get("target_instance"),
@@ -809,6 +811,11 @@ class PushRouter:
         )
         # report the choice so wrappers (session affinity) can pin to it
         context.metadata["routed_instance"] = iid
+        # latency spine: router-hop pick cost, accumulated across
+        # migration retries (the metadata dict rides to the worker)
+        ph = context.metadata.setdefault("phases", {})
+        ph["route_s"] = (ph.get("route_s", 0.0)
+                         + (_time.monotonic() - t_route))
         engine = RemoteEngine(self._pool, addr, self.endpoint_path)
         self._inflight[iid] = self._inflight.get(iid, 0) + 1
         try:

@@ -19,11 +19,18 @@ class StatusServer:
         self.host = host
         self.port = port
         self._checks: Dict[str, Callable[[], bool]] = {}
+        self._timeline: Optional[Callable[[int], dict]] = None
         self._started_at = time.time()
         self._runner: Optional[web.AppRunner] = None
 
     def add_check(self, name: str, fn: Callable[[], bool]) -> None:
         self._checks[name] = fn
+
+    def add_timeline(self, fn: Callable[[int], dict]) -> None:
+        """Install the /debug/timeline source: fn(last_n) -> Chrome-trace
+        dict (the worker wires the engine flight recorder's
+        to_chrome_trace here; see docs/observability.md)."""
+        self._timeline = fn
 
     async def start(self) -> str:
         app = web.Application()
@@ -32,6 +39,7 @@ class StatusServer:
                 web.get("/live", self._live),
                 web.get("/health", self._health),
                 web.get("/metrics", self._metrics),
+                web.get("/debug/timeline", self._debug_timeline),
             ]
         )
         self._runner = web.AppRunner(app, access_log=None)
@@ -67,3 +75,16 @@ class StatusServer:
 
     async def _metrics(self, request) -> web.Response:
         return web.Response(body=self.runtime.metrics.render(), content_type="text/plain")
+
+    async def _debug_timeline(self, request) -> web.Response:
+        """Flight-recorder ring as Chrome-trace JSON (open in Perfetto /
+        chrome://tracing). `?last_n=N` bounds the record count."""
+        if self._timeline is None:
+            return web.json_response(
+                {"error": "no timeline source on this process"}, status=404)
+        try:
+            last_n = int(request.query.get("last_n", 0)) or None
+        except ValueError:
+            last_n = None
+        trace = self._timeline(last_n)
+        return web.json_response(trace)

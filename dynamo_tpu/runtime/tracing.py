@@ -80,6 +80,7 @@ class Span:
     kind: int = 1  # OTLP SpanKind: 1=internal, 2=server, 3=client
     attributes: Dict[str, Any] = field(default_factory=dict)
     status_error: Optional[str] = None
+    events: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def traceparent(self) -> str:
@@ -87,6 +88,16 @@ class Span:
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
+
+    def add_event(self, name: str,
+                  attributes: Optional[Dict[str, Any]] = None) -> None:
+        """Timestamped point event inside the span (OTLP span events) —
+        the latency spine's phase marks ride these."""
+        self.events.append({
+            "name": name,
+            "time_ns": time.time_ns(),
+            "attributes": dict(attributes or {}),
+        })
 
     def record_error(self, err: str) -> None:
         self.status_error = err
@@ -98,6 +109,10 @@ class _NoopSpan:
     traceparent = None
 
     def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str,
+                  attributes: Optional[Dict[str, Any]] = None) -> None:
         pass
 
     def record_error(self, err: str) -> None:
@@ -122,14 +137,18 @@ class OtlpSpanExporter:
     from a daemon thread; drops on failure (telemetry is best-effort)."""
 
     def __init__(self, endpoint: str, service_name: str = "dynamo_tpu",
-                 flush_interval_s: float = 2.0, max_batch: int = 256):
+                 flush_interval_s: float = 2.0, max_batch: int = 256,
+                 max_queue: int = 8192):
         import queue
 
         self.url = endpoint.rstrip("/") + "/v1/traces"
         self.service_name = service_name
         self.flush_interval_s = flush_interval_s
         self.max_batch = max_batch
-        self._q: "queue.Queue" = queue.Queue(maxsize=8192)
+        # bounded queue is the memory ceiling; overflow drops (counted)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self.dropped = 0  # spans dropped on queue overflow
+        self._inflight = 0  # spans popped but not yet POSTed (flush waits)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -139,7 +158,21 @@ class OtlpSpanExporter:
         try:
             self._q.put_nowait(span)
         except queue.Full:
-            pass  # full queue: drop
+            # full queue: drop, but keep the evidence — a short-lived
+            # worker seeing dropped>0 at shutdown lost tail spans
+            self.dropped += 1
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Bounded drain: wait until the batch thread has consumed AND
+        posted everything queued at call time (or the timeout expires).
+        Called on runtime shutdown so short-lived workers don't exit with
+        their tail spans still queued. Returns True when fully drained."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while self._q.qsize() > 0 or self._inflight > 0:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+        return True
 
     @staticmethod
     def _attr(k: str, v: Any) -> Dict[str, Any]:
@@ -167,6 +200,16 @@ class OtlpSpanExporter:
             out["parentSpanId"] = s.parent_span_id
         if s.status_error is not None:
             out["status"] = {"code": 2, "message": s.status_error}
+        if s.events:
+            out["events"] = [
+                {
+                    "timeUnixNano": str(e["time_ns"]),
+                    "name": e["name"],
+                    "attributes": [self._attr(k, v)
+                                   for k, v in e["attributes"].items()],
+                }
+                for e in s.events
+            ]
         return out
 
     def _loop(self) -> None:
@@ -175,12 +218,14 @@ class OtlpSpanExporter:
 
         while True:
             batch = [self._q.get()]
+            self._inflight = 1
             deadline = time.monotonic() + self.flush_interval_s
             while len(batch) < self.max_batch:
                 try:
                     batch.append(
                         self._q.get(timeout=max(0.01, deadline - time.monotonic()))
                     )
+                    self._inflight = len(batch)
                 except queue.Empty:
                     break
             payload = json.dumps({
@@ -203,6 +248,8 @@ class OtlpSpanExporter:
                 urllib.request.urlopen(req, timeout=5).read()
             except (OSError, ValueError):
                 pass  # collector down / bad endpoint: drop
+            finally:
+                self._inflight = 0
 
 
 _exporter = None
@@ -232,6 +279,21 @@ def configure_tracing(service_name: str = "dynamo_tpu") -> None:
 
 def enabled() -> bool:
     return _exporter is not None
+
+
+def flush_tracing(timeout_s: float = 5.0) -> bool:
+    """Drain the installed exporter's span queue (bounded). No-ops (True)
+    when tracing is off or the exporter has no buffering. Wired into
+    DistributedRuntime.shutdown so short-lived workers keep tail spans."""
+    exp = _exporter
+    fl = getattr(exp, "flush", None)
+    if fl is None:
+        return True
+    try:
+        return bool(fl(timeout_s))
+    except Exception:  # pragma: no cover
+        log.exception("span flush failed")
+        return False
 
 
 @contextlib.contextmanager

@@ -155,6 +155,20 @@ def parse_args(argv=None):
                    help="placeholder token id (default: vocab_size - 1)")
     p.add_argument("--status-port", type=int, default=0,
                    help="serve /live /health /metrics on this port (0 = off)")
+    # flight recorder (observability; docs/observability.md)
+    p.add_argument("--recorder-size", type=int, default=4096,
+                   help="flight-recorder ring capacity in iterations "
+                        "(0 = recorder off)")
+    p.add_argument("--anomaly-k", type=float, default=4.0,
+                   help="iteration wall time > EWMA*k fires the anomaly "
+                        "trigger (dump + optional profile window)")
+    p.add_argument("--anomaly-dump-dir", default=None,
+                   help="directory for anomaly ring dumps (unset = no dumps)")
+    p.add_argument("--anomaly-dump-last-n", type=int, default=256,
+                   help="ring records written per anomaly dump")
+    p.add_argument("--anomaly-profile-ms", type=int, default=0,
+                   help="jax.profiler capture window on anomaly, in ms "
+                        "(0 = off; traces land under the dump dir)")
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
     p.add_argument("--request-plane", default=None, choices=[None, "tcp", "nats"],
@@ -404,6 +418,11 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
         prefetch_hint_ttl_s=getattr(args, "prefetch_hint_ttl_s", 10.0),
         prefetch_pin_ttl_s=getattr(args, "prefetch_pin_ttl_s", 5.0),
         tokenizer_spec=args.tokenizer,
+        recorder_size=getattr(args, "recorder_size", 4096),
+        anomaly_k=getattr(args, "anomaly_k", 4.0),
+        anomaly_dump_dir=getattr(args, "anomaly_dump_dir", None),
+        anomaly_dump_last_n=getattr(args, "anomaly_dump_last_n", 256),
+        anomaly_profile_ms=getattr(args, "anomaly_profile_ms", 0),
     )
     if getattr(args, "shm_weights", None) or args.orbax_cache:
         # RL weight hot-swap: after update_weights the WARM TIERS hold a
@@ -575,6 +594,13 @@ async def async_main(args) -> None:
         status.add_check(
             "engine", lambda: getattr(engine, "_thread", True) is not None
         )
+        _rec = getattr(engine, "recorder", None)
+        if _rec is not None and _rec.enabled:
+            from dynamo_tpu.runtime.flight_recorder import to_chrome_trace
+
+            status.add_timeline(
+                lambda last_n=None: to_chrome_trace(_rec.snapshot(last_n))
+            )
         await status.start()
     from dynamo_tpu.worker_common import serve_worker
 
@@ -668,7 +694,10 @@ async def async_main(args) -> None:
             try:
                 plane.close()  # releases followers from their replay loops
             except Exception:
-                pass
+                # best-effort: after a group break the plane socket may
+                # already be dead; the exit path below is what matters
+                log.debug("step-plane close failed during teardown",
+                          exc_info=True)
         await _safe(runtime.shutdown())
     if promotion_failed:
         raise SystemExit(1)

@@ -247,7 +247,11 @@ async def serve_worker(
                             req.get("request_id"), discard=True
                         )
                     except Exception:
-                        pass
+                        # discard is best-effort cleanup after a dead
+                        # puller; the parked TTL reclaims on failure
+                        log.debug("parked-KV discard for %s failed "
+                                  "(TTL will reclaim)",
+                                  req.get("request_id"), exc_info=True)
             return
         yield await engine.export_parked_kv(
             req.get("request_id"), discard=bool(req.get("discard"))
@@ -419,6 +423,40 @@ async def serve_worker(
 
         engine.on_fpm(_update_compile_gauges)
         _update_compile_gauges()
+
+    # latency spine -> /metrics: per-finished-request phase durations as
+    # histograms labeled by phase (queue_wait/ttft/kv_onboard/...; ITL
+    # samples fold into one phase="itl" histogram). Fired from the engine
+    # step thread via on_phases; histogram observe is lock-cheap.
+    if hasattr(engine, "on_phases"):
+        _pm = runtime.metrics.child(dynamo_namespace=namespace)
+
+        def _observe_phases(phases: dict) -> None:
+            for key, val in phases.items():
+                if key == "itl_s" and isinstance(val, list):
+                    h = _pm.histogram(
+                        "request_phase_seconds",
+                        "per-request latency spine phase durations",
+                        phase="itl")
+                    for s in val:
+                        h.observe(float(s))
+                elif isinstance(val, (int, float)):
+                    _pm.histogram(
+                        "request_phase_seconds",
+                        "per-request latency spine phase durations",
+                        phase=key.removesuffix("_s"),
+                    ).observe(float(val))
+
+        engine.on_phases(_observe_phases)
+
+    # flight recorder: fired-anomaly counter onto the shared registry, and
+    # advertise the recorder via metadata so tooling knows /debug/timeline
+    # is live on this worker's status port
+    _rec = getattr(engine, "recorder", None)
+    if _rec is not None and getattr(_rec, "enabled", False):
+        _rec.bind_metrics(
+            runtime.metrics.child(dynamo_namespace=namespace))
+        metadata["flight_recorder"] = True
 
     async def kv_prefetch(request, context):
         hint = (request or {}).get("kv_prefetch") or {}

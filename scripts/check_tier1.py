@@ -45,7 +45,7 @@ def main() -> int:
     args = ap.parse_args()
     required = args.require if args.require is not None else [
         "test_sched_packing.py", "test_ragged_mixed.py",
-        "test_dynlint.py",
+        "test_dynlint.py", "test_flight_recorder.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -1,0 +1,58 @@
+"""Pull a worker's flight-recorder timeline for Perfetto.
+
+Fetches `/debug/timeline` from a worker's status port (``--status-port``
+on `python -m dynamo_tpu.worker` / any process that wired
+`StatusServer.add_timeline`) and writes the Chrome-trace JSON to a file
+you can open in https://ui.perfetto.dev or chrome://tracing. Run:
+
+    python scripts/dump_timeline.py --url http://worker-host:9090 \
+        [--last-n 1024] [--out timeline.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def fetch_timeline(base_url: str, last_n: int = 0,
+                   timeout_s: float = 10.0) -> dict:
+    url = base_url.rstrip("/") + "/debug/timeline"
+    if last_n > 0:
+        url += f"?last_n={last_n}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="status server base URL, e.g. http://host:9090")
+    ap.add_argument("--last-n", type=int, default=0,
+                    help="bound the record count (0 = whole ring)")
+    ap.add_argument("--out", default="timeline.json")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args()
+    try:
+        trace = fetch_timeline(args.url, args.last_n, args.timeout)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print("error: no timeline source on that process — is the "
+                  "flight recorder enabled (--recorder-size > 0)?",
+                  file=sys.stderr)
+            return 2
+        raise
+    events = trace.get("traceEvents", [])
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    slices = sum(1 for e in events if e.get("ph") == "X")
+    print(f"wrote {args.out}: {len(events)} events "
+          f"({slices} iteration slices) — open in ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -345,6 +345,35 @@ def test_r003_missing_rpc_timeout():
     """) == []
 
 
+def test_r004_recorder_blocking_io():
+    # positive: blocking calls in hot-path-named functions of a
+    # flight_recorder file
+    src = """
+        class FR:
+            def append(self, rec):
+                self._q.put(rec)
+
+            def _record_anomaly(self, rec):
+                with open("/tmp/d.json", "w") as f:
+                    f.write("x")
+    """
+    assert _rules(src, path="dynamo_tpu/runtime/flight_recorder.py") == [
+        "DYN-R004", "DYN-R004", "DYN-R004"]  # put, open, write
+    # negative 1: the non-blocking hand-off spelling and the dump thread
+    # are both allowed
+    assert _rules("""
+        class FR:
+            def append(self, rec):
+                self._q.put_nowait(rec)
+
+            def _write_dump(self, dump):
+                with open("/tmp/d.json", "w") as f:
+                    f.write("x")
+    """, path="dynamo_tpu/runtime/flight_recorder.py") == []
+    # negative 2: same code outside a flight_recorder file is out of scope
+    assert _rules(src, path="dynamo_tpu/runtime/other.py") == []
+
+
 # -- baseline ratchet -------------------------------------------------------
 
 

@@ -1,0 +1,392 @@
+"""Flight recorder + per-request latency spine (fast tier-1 suite).
+
+Covers the observability tentpole: ring wraparound semantics, record
+fields against real SimRunner mixed plans, phase-spine monotonicity and
+request-plane hop propagation, Chrome-trace export schema (every event
+carries ph/ts/pid/name), the /debug/timeline status route, the EWMA
+anomaly trigger's fire-once-per-excursion contract (with on-disk dump),
+the recorder-on-vs-off byte-identity acceptance, and the
+prometheus-free SimpleMetrics text-exposition fallback.
+"""
+
+import asyncio
+import json
+import os
+import time
+import types
+
+import pytest
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.flight_recorder import (
+    FlightRecorder,
+    IterationRecord,
+    to_chrome_trace,
+)
+
+
+def _rec(seq, wall_s=0.004, kind="decode", **over):
+    base = dict(
+        seq=seq, ts=1700000000.0 + seq * 0.01, wall_s=wall_s, kind=kind,
+        decode_seqs=2, decode_steps=4, n_chunks=0, chunk_tokens=0,
+        charged_tokens=0, ragged=False, fused=False, n_waiting=0,
+        n_running=2, kv_usage=0.25, g2_blocks=0, g3_blocks=0,
+        prefetch_hits=0, compile_variants=1, compile_calls=seq + 1,
+    )
+    base.update(over)
+    return IterationRecord(**base)
+
+
+# -- ring semantics ---------------------------------------------------------
+
+
+def test_ring_wraparound():
+    fr = FlightRecorder(capacity=8, anomaly_k=0.0)
+    for i in range(20):
+        fr.append(_rec(i))
+    assert len(fr) == 8
+    assert fr.total_appended == 20
+    snap = fr.snapshot()
+    assert [r.seq for r in snap] == list(range(12, 20))  # oldest→newest
+    assert [r.seq for r in fr.snapshot(3)] == [17, 18, 19]
+    assert fr.snapshot(0) == []
+
+
+def test_disabled_recorder_is_noop():
+    fr = FlightRecorder(capacity=0)
+    assert not fr.enabled
+    fr.append(_rec(0))  # must not raise
+    assert len(fr) == 0
+    assert fr.snapshot() == []
+    assert fr.to_chrome_trace()["traceEvents"][0]["ph"] == "M"
+    assert fr.stats()["enabled"] is False
+
+
+# -- engine integration: record fields vs SimRunner plans -------------------
+
+
+def _mk_engine(recorder_size=128, decode_base_s=0.0):
+    runner = SimRunner(
+        num_pages=256, page_size=4, max_pages_per_seq=32,
+        timing=SimTiming(speed=1.0 if decode_base_s else 0.0,
+                         decode_base_s=decode_base_s),
+    )
+    return InferenceEngine(
+        runner, max_batch=4, chunk_size=16, recorder_size=recorder_size,
+        anomaly_k=0.0,
+    )
+
+
+async def _gen(engine, prompt, max_tokens, metadata=None, first_token=None):
+    toks = []
+    final = None
+    ctx = Context(metadata=metadata or {})
+    async for item in engine.generate(
+        {"token_ids": prompt, "sampling": {"temperature": 0.0},
+         "stop": {"max_tokens": max_tokens, "stop_ids": [],
+                  "ignore_eos": True}}, ctx,
+    ):
+        assert item.get("finish_reason") != "error", item
+        toks.extend(item.get("token_ids") or [])
+        if first_token is not None and toks:
+            first_token.set()
+        if item.get("finish_reason"):
+            final = item
+            break
+    return toks, final
+
+
+async def test_record_fields_vs_sim_mixed_plan():
+    """A prefill landing while another sequence decodes must produce a
+    kind="mixed" record whose plan-composition fields match what the
+    scheduler actually composed, and total chunk_tokens across the run
+    must equal the prompt tokens served."""
+    engine = _mk_engine(decode_base_s=0.002)
+    p1, p2 = list(range(300, 316)), list(range(400, 408))
+    engine.start()
+    try:
+        seen_first = asyncio.Event()
+        t1 = asyncio.create_task(
+            _gen(engine, p1, 100, first_token=seen_first))
+        await asyncio.wait_for(seen_first.wait(), timeout=30)
+        t2 = asyncio.create_task(_gen(engine, p2, 4))
+        await asyncio.gather(t1, t2)
+    finally:
+        engine.stop()
+    recs = engine.recorder.snapshot()
+    assert recs, "no iteration records appended"
+    seqs = [r.seq for r in recs]
+    assert seqs == sorted(seqs)  # iteration counter is monotonic
+    kinds = {r.kind for r in recs}
+    assert kinds <= {"prefill", "decode", "mixed"}
+    assert "mixed" in kinds, kinds
+    # every prompt token was served through some prefill/mixed record
+    assert sum(r.chunk_tokens for r in recs) == len(p1) + len(p2)
+    mixed = [r for r in recs if r.kind == "mixed"]
+    for r in mixed:
+        assert r.decode_seqs >= 1 and r.decode_steps >= 1
+        assert r.n_chunks >= 1 and r.chunk_tokens > 0
+        assert not r.fused  # SimRunner has no fused mixed program
+    for r in recs:
+        assert 0.0 <= r.kv_usage <= 1.0
+        assert r.wall_s >= 0.0 and r.charged_tokens >= 0
+        if r.kind == "decode":
+            assert r.n_chunks == 0 and r.chunk_tokens == 0
+        if r.kind == "prefill":
+            assert r.decode_seqs == 0 and r.n_chunks == 1
+
+
+# -- latency spine ----------------------------------------------------------
+
+
+async def test_phase_spine_monotonic_and_hop_propagation():
+    """Upstream hop stamps (frontend/router durations riding
+    ctx.metadata) must survive into the final item's phases next to the
+    engine-side stamps, and the engine stamps must be internally
+    consistent: ttft <= e2e, every duration non-negative."""
+    engine = _mk_engine()
+    engine.start()
+    try:
+        toks, final = await _gen(
+            engine, list(range(300, 312)), 8,
+            metadata={"phases": {"frontend_queue_s": 0.25, "route_s": 0.125,
+                                 "bogus": "dropped"},
+                      "migration_attempt": 2},
+        )
+    finally:
+        engine.stop()
+    assert len(toks) == 8
+    ph = final["phases"]
+    # hop propagation: upstream durations arrive verbatim, non-numerics drop
+    assert ph["frontend_queue_s"] == 0.25
+    assert ph["route_s"] == 0.125
+    assert "bogus" not in ph
+    assert ph["migration_attempts"] == 2.0
+    # engine-side spine: present and monotonically consistent
+    assert 0.0 <= ph["queue_wait_s"] <= ph["e2e_s"]
+    assert 0.0 <= ph["ttft_s"] <= ph["e2e_s"]
+    itl = ph.get("itl_s", [])
+    assert isinstance(itl, list) and all(v >= 0.0 for v in itl)
+    assert len(itl) <= 512
+
+
+def test_frontend_finish_phases_folds_e2e_and_events():
+    from dynamo_tpu.frontend.migration import Migration
+
+    events = []
+    root = types.SimpleNamespace(
+        add_event=lambda name, attributes=None: events.append(name))
+    item = {"finish_reason": "stop",
+            "phases": {"queue_wait_s": 0.01, "ttft_s": 0.02,
+                       "itl_s": [0.001]}}
+    Migration._finish_phases(item, root, time.monotonic() - 1.0)
+    assert item["phases"]["frontend_e2e_s"] >= 1.0
+    assert "phase.ttft_s" in events and "phase.frontend_e2e_s" in events
+    assert "phase.itl_s" not in events  # lists are not scalar span events
+    # a worker item with no phase dict still gets the frontend stamp
+    bare = {"finish_reason": "stop", "phases": "corrupt"}
+    Migration._finish_phases(bare, root, time.monotonic())
+    assert isinstance(bare["phases"], dict)
+    assert "frontend_e2e_s" in bare["phases"]
+
+
+# -- Chrome-trace export ----------------------------------------------------
+
+
+def _trace_records():
+    out = [_rec(i) for i in range(4)]
+    out.append(_rec(4, kind="mixed", n_chunks=2, chunk_tokens=24,
+                    charged_tokens=32, ragged=True, fused=True))
+    out.append(_rec(5, wall_s=0.5, anomaly=True))
+    return out
+
+
+def test_chrome_trace_schema():
+    trace = to_chrome_trace(_trace_records(), pid=7)
+    body = json.dumps(trace)  # must be pure-JSON serializable
+    assert json.loads(body)["displayTimeUnit"] == "ms"
+    events = trace["traceEvents"]
+    assert events
+    for ev in events:
+        for key in ("ph", "ts", "pid", "name"):
+            assert key in ev, (key, ev)
+        assert ev["pid"] == 7
+    slices = [e for e in events if e["ph"] == "X"]
+    assert len(slices) == 6
+    for s in slices:
+        assert s["dur"] >= 0 and s["name"] in ("prefill", "decode", "mixed")
+    mixed = [s for s in slices if s["name"] == "mixed"][0]
+    assert mixed["args"]["charged_tokens"] == 32
+    assert mixed["args"]["ragged"] is True
+    counters = {e["name"] for e in events if e["ph"] == "C"}
+    assert counters == {"queue", "scheduled_tokens", "kv"}
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(instants) == 1 and instants[0]["name"] == "anomaly"
+    # slices are ordered by wall-clock like the ring
+    assert [s["ts"] for s in slices] == sorted(s["ts"] for s in slices)
+
+
+async def test_debug_timeline_route():
+    """/debug/timeline on the status server returns the recorder's
+    Chrome-trace JSON (404 before a source is installed)."""
+    aiohttp = pytest.importorskip("aiohttp")
+    from dynamo_tpu.runtime.status import StatusServer
+
+    fr = FlightRecorder(capacity=16, anomaly_k=0.0)
+    for i in range(6):
+        fr.append(_rec(i))
+    srv = StatusServer(types.SimpleNamespace(metrics=None),
+                      port=0, host="127.0.0.1")
+    base = await srv.start()
+    try:
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"{base}/debug/timeline") as resp:
+                assert resp.status == 404  # no source yet
+            srv.add_timeline(lambda last_n=None: fr.to_chrome_trace(last_n))
+            async with http.get(f"{base}/debug/timeline") as resp:
+                assert resp.status == 200
+                trace = await resp.json()
+            async with http.get(f"{base}/debug/timeline?last_n=2") as resp:
+                bounded = await resp.json()
+    finally:
+        await srv.stop()
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(slices) == 6
+    for ev in trace["traceEvents"]:
+        for key in ("ph", "ts", "pid", "name"):
+            assert key in ev
+    assert len([e for e in bounded["traceEvents"] if e["ph"] == "X"]) == 2
+
+
+# -- anomaly trigger --------------------------------------------------------
+
+
+def test_anomaly_fires_once_per_excursion(tmp_path):
+    dump_dir = str(tmp_path / "dumps")
+    fr = FlightRecorder(
+        capacity=64, anomaly_k=3.0, anomaly_min_samples=8,
+        anomaly_dump_dir=dump_dir, anomaly_dump_last_n=16,
+    )
+    seq = 0
+    for _ in range(12):  # warmup: steady 4ms baseline
+        fr.append(_rec(seq, wall_s=0.004))
+        seq += 1
+    assert fr.anomalies_fired == 0
+    # sustained excursion: 5 stalled iterations -> ONE fire, on the first
+    fired = []
+    for _ in range(5):
+        r = _rec(seq, wall_s=1.0)
+        fr.append(r)
+        fired.append(r.anomaly)
+        seq += 1
+    assert fired == [True, False, False, False, False]
+    assert fr.anomalies_fired == 1
+    # the stall must not have dragged the EWMA up
+    assert fr.stats()["ewma_s"]["decode"] < 0.01
+    # recovery re-arms; the next excursion fires exactly once more
+    for _ in range(3):
+        fr.append(_rec(seq, wall_s=0.004))
+        seq += 1
+    for _ in range(2):
+        fr.append(_rec(seq, wall_s=1.0))
+        seq += 1
+    assert fr.anomalies_fired == 2
+    # per-kind independence: a fresh kind has its own warmup
+    fr.append(_rec(seq, wall_s=5.0, kind="prefill"))
+    assert fr.anomalies_fired == 2
+    # the daemon writer lands both dumps on disk as valid JSON
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        files = sorted(os.listdir(dump_dir)) if os.path.isdir(dump_dir) else []
+        files = [f for f in files if f.endswith(".json")]
+        if len(files) >= 2:
+            break
+        time.sleep(0.02)
+    assert len(files) == 2, files
+    with open(os.path.join(dump_dir, files[0]), encoding="utf-8") as f:
+        dump = json.load(f)
+    assert dump["trigger_seq"] == 12
+    assert dump["k"] == 3.0
+    assert dump["trigger"]["anomaly"] is True
+    assert dump["records"], "dump carries no ring records"
+    # the ring snapshot predates the trigger's own append
+    assert dump["records"][-1]["seq"] == 11
+
+
+def test_anomaly_trigger_without_dump_dir_only_counts():
+    fr = FlightRecorder(capacity=32, anomaly_k=2.0, anomaly_min_samples=4)
+    for i in range(6):
+        fr.append(_rec(i, wall_s=0.004))
+    fr.append(_rec(6, wall_s=1.0))
+    assert fr.anomalies_fired == 1
+    assert fr.dumps_written == 0 and fr.dumps_dropped == 0
+
+
+# -- recorder on/off byte identity ------------------------------------------
+
+
+async def _serve_prompts(recorder_size):
+    engine = _mk_engine(recorder_size=recorder_size)
+    engine.start()
+    try:
+        prompts = [list(range(300 + 10 * i, 300 + 10 * i + 6 + i))
+                   for i in range(4)]
+        outs = await asyncio.gather(
+            *[_gen(engine, p, 8) for p in prompts])
+        return [toks for toks, _ in outs], engine.recorder
+    finally:
+        engine.stop()
+
+
+async def test_recorder_on_off_byte_identity():
+    """Acceptance: the recorder must be observability-only — identical
+    token outputs with the ring on and off."""
+    on, rec_on = await _serve_prompts(recorder_size=256)
+    off, rec_off = await _serve_prompts(recorder_size=0)
+    assert on == off, (on, off)
+    assert rec_on.total_appended > 0
+    assert rec_off.total_appended == 0
+
+
+# -- metrics fallback (satellite) -------------------------------------------
+
+
+def test_simple_metrics_text_exposition():
+    """prometheus_client-free fallback: dict counters rendering a minimal
+    text exposition (the container has the real client, so the fallback
+    is exercised directly)."""
+    from dynamo_tpu.runtime.metrics import SimpleMetrics
+
+    m = SimpleMetrics(labels={"dynamo_namespace": "ns"})
+    c = m.counter("requests_total", "requests")
+    c.inc()
+    c.inc(2)
+    m.gauge("queue_depth", "depth").set(7)
+    h = m.child(dynamo_component="engine").histogram(
+        "request_phase_seconds", "phase latency", phase="ttft")
+    h.observe(0.5)
+    h.observe(1.5)
+    text = m.render().decode()
+    lines = text.splitlines()
+    assert "# TYPE dynamo_requests_total counter" in lines
+    assert "# TYPE dynamo_queue_depth gauge" in lines
+    assert "# TYPE dynamo_request_phase_seconds histogram" in lines
+
+    def value(prefix):
+        hits = [ln for ln in lines if ln.startswith(prefix)]
+        assert len(hits) == 1, (prefix, hits)
+        assert 'dynamo_namespace="ns"' in hits[0]
+        return float(hits[0].rsplit(" ", 1)[1])
+
+    assert value("dynamo_requests_total{") == 3.0
+    assert value("dynamo_queue_depth{") == 7.0
+    assert value("dynamo_request_phase_seconds_count{") == 2
+    assert value("dynamo_request_phase_seconds_sum{") == 2.0
+    hist_line = [ln for ln in lines
+                 if ln.startswith("dynamo_request_phase_seconds_count")][0]
+    assert 'phase="ttft"' in hist_line
+    assert 'dynamo_component="engine"' in hist_line
+    # shared store: children share series, render is idempotent
+    assert m.render() == m.render()

@@ -74,6 +74,60 @@ def test_otlp_wire_format():
     assert w["status"]["code"] == 2
 
 
+def test_otlp_wire_span_events():
+    """Phase marks ride OTLP span events with nanosecond stamps."""
+    exp = OtlpSpanExporter.__new__(OtlpSpanExporter)  # no thread
+    from dynamo_tpu.runtime.tracing import Span, SpanContext
+
+    s = Span(name="n", context=SpanContext("a" * 32, "b" * 16),
+             parent_span_id=None, start_ns=1, end_ns=2)
+    s.add_event("phase.ttft_s", {"seconds": 0.25})
+    s.add_event("migration", {"attempt": 1})
+    w = exp._wire(s)
+    assert [e["name"] for e in w["events"]] == ["phase.ttft_s", "migration"]
+    ev = w["events"][0]
+    assert int(ev["timeUnixNano"]) > 0
+    assert ev["attributes"] == [
+        {"key": "seconds", "value": {"doubleValue": 0.25}}]
+
+
+def test_otlp_exporter_bounded_queue_and_flush():
+    """The span queue is the memory ceiling: overflow drops (counted, not
+    raised), and flush() drains within its bound — here via a stubbed
+    queue so no exporter thread or network is involved."""
+    import queue as queue_mod
+
+    from dynamo_tpu.runtime.tracing import Span, SpanContext, flush_tracing
+
+    exp = OtlpSpanExporter.__new__(OtlpSpanExporter)  # no thread
+    exp._q = queue_mod.Queue(maxsize=2)
+    exp.dropped = 0
+    exp._inflight = 0
+    mk = lambda i: Span(name=f"s{i}", context=SpanContext("a" * 32, "b" * 16),
+                        parent_span_id=None, start_ns=1, end_ns=2)
+    for i in range(5):
+        exp.export(mk(i))
+    assert exp._q.qsize() == 2 and exp.dropped == 3
+    # queue still holding spans and nothing consuming: flush times out
+    assert exp.flush(timeout_s=0.1) is False
+    while not exp._q.empty():
+        exp._q.get_nowait()
+    assert exp.flush(timeout_s=0.1) is True
+    # inflight batch also blocks the drain until the POST completes
+    exp._inflight = 2
+    assert exp.flush(timeout_s=0.1) is False
+    exp._inflight = 0
+    assert exp.flush(timeout_s=0.1) is True
+    # module-level flush: True with no exporter, delegates otherwise
+    set_exporter(None)
+    assert flush_tracing(0.1) is True
+    set_exporter(exp)
+    try:
+        assert flush_tracing(0.1) is True
+    finally:
+        set_exporter(None)
+
+
 # -- e2e: one trace across disagg prefill + decode hops ---------------------
 
 
