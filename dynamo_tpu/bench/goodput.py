@@ -52,12 +52,16 @@ class Stack:
     entry: Any  # ModelEntry: .chain is the frontend pipeline
     broker: Any = None  # MiniNatsServer when --request-plane nats booted one
     nats_env_prev: Any = False  # False = untouched; None/str = prior value
+    fleet: Any = None  # FleetObserver over the workers' digest publishers
+    slo: Any = None  # SloEngine bound to `fleet` (--digest-period > 0)
 
     async def generate(self, request, context):
         async for item in self.entry.chain.generate(request, context):
             yield item
 
     async def close(self) -> None:
+        if self.fleet is not None:
+            await self.fleet.stop()
         await self.watcher.stop()
         await self.frontend_runtime.shutdown()
         for w in self.workers:
@@ -192,7 +196,8 @@ async def _boot_rest(args, mocker, disagg, plane, realm, card,
         )
         engine = _make_engine(args, mocker)
         w = await serve_worker(
-            rt, engine, card, component=component, disagg_role=role
+            rt, engine, card, component=component, disagg_role=role,
+            digest_period_s=getattr(args, "digest_period", 0.0),
         )
         worker_runtimes.append(rt)
         workers.append(w)
@@ -237,8 +242,30 @@ async def _boot_rest(args, mocker, disagg, plane, realm, card,
             f"stack not routable: {len(entry.instance_ids)}/{args.workers} "
             f"workers (+{len(entry.prefill_instance_ids)} prefill)"
         )
+    fleet = slo_engine = None
+    if getattr(args, "digest_period", 0.0) > 0:
+        # fleet observability ride-along: the inproc event bus is
+        # process-global, so the frontend runtime's subscriber reaches the
+        # workers' digest publishers directly
+        from dynamo_tpu.planner.slo import SloEngine, parse_slo_config
+        from dynamo_tpu.runtime.event_plane import FLEET_DIGEST_SUBJECT
+        from dynamo_tpu.runtime.fleet_observer import FleetObserver
+
+        fleet = FleetObserver(
+            frt.event_subscriber([FLEET_DIGEST_SUBJECT]),
+            window_s=getattr(args, "digest_window", 60.0),
+        )
+        for w in workers:
+            addr = (w.instance.metadata or {}).get("digest_publisher")
+            if addr:
+                fleet.connect_publisher(addr)
+        await fleet.start()
+        spec = getattr(args, "slo", None) or (
+            f"ttft:p95<{args.ttft_slo:g},itl:p95<{args.itl_slo:g}")
+        slo_engine = SloEngine(fleet, parse_slo_config(spec))
     return Stack(frt, worker_runtimes, workers, watcher, entry,
-                 broker=broker, nats_env_prev=nats_env_prev)
+                 broker=broker, nats_env_prev=nats_env_prev,
+                 fleet=fleet, slo=slo_engine)
 
 
 async def run_goodput(args) -> GoodputReport:
@@ -290,6 +317,16 @@ async def run_goodput(args) -> GoodputReport:
                         agg[k] += st.get(k, 0)
             for k, v in getattr(runner, "stats", {}).items():
                 sim_stats[k] = sim_stats.get(k, 0) + v
+        # fleet digest ride-along: flush each worker's tail window, then
+        # snapshot the observer + SLO attainment before teardown
+        fleet_view = slo_view = None
+        if stack.fleet is not None:
+            for w in stack.workers:
+                if w.digest_pub is not None:
+                    await w.digest_pub.publish_once()
+            await asyncio.sleep(0.05)  # inproc bus delivery
+            fleet_view = stack.fleet.fleet()
+            slo_view = stack.slo.evaluate()
     finally:
         await stack.close()
     report = compute_goodput(
@@ -308,6 +345,27 @@ async def run_goodput(args) -> GoodputReport:
         }
     if sim_stats:
         report.extras["sim"] = sim_stats
+    if fleet_view is not None:
+        report.extras["fleet"] = {
+            "n_workers": fleet_view["n_workers"],
+            "received": fleet_view["received"],
+            "dropped_stale": fleet_view["dropped_stale"],
+            "phases": fleet_view["fleet"]["phases"],
+            "workers": {
+                k: {"requests": row["counters"]["requests"],
+                    "phases": row["phases"]}
+                for k, row in fleet_view["workers"].items()
+            },
+        }
+    if slo_view is not None:
+        report.extras["slo"] = {
+            "state": slo_view["state"],
+            "targets": {
+                name: {"state": s["state"], "fast": s["fast"],
+                       "slow": s["slow"]}
+                for name, s in slo_view["fleet"].items()
+            },
+        }
     # per-request latency spine: queue_wait / TTFT / ITL / kv_onboard
     # breakdowns from the phase stamps that rode each final item
     phase_agg = aggregate_phases(results)
@@ -427,6 +485,16 @@ def parse_args(argv=None):
     # SLOs (reference benchmarking.md interactive defaults)
     p.add_argument("--ttft-slo", type=float, default=2.0, help="seconds")
     p.add_argument("--itl-slo", type=float, default=0.05, help="seconds")
+    # fleet observability ride-along (runtime/fleet_observer.py)
+    p.add_argument("--digest-period", type=float, default=0.0,
+                   help="worker fleet-digest publish period in seconds; "
+                        ">0 adds extras.fleet + extras.slo (SLO "
+                        "attainment) to the report")
+    p.add_argument("--digest-window", type=float, default=60.0,
+                   help="fleet observer aggregation window")
+    p.add_argument("--slo", default=None,
+                   help="burn-rate SLO spec 'phase:pNN<seconds,...' "
+                        "(default derives from --ttft-slo/--itl-slo)")
     return p.parse_args(argv)
 
 

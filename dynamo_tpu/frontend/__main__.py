@@ -73,6 +73,16 @@ def parse_args(argv=None):
                         "--kv-overlap-score-weight)")
     p.add_argument("--request-trace", default=None,
                    help="JSONL per-request trace path (also DYN_REQUEST_TRACE)")
+    p.add_argument("--status-port", type=int, default=0,
+                   help="serve /live /health /metrics /debug/fleet "
+                        "/debug/routing on this side port (0 = off); boots "
+                        "the fleet digest observer + SLO engine")
+    p.add_argument("--slo", default=None,
+                   help="SLO targets as 'phase:pNN<seconds,...' (e.g. "
+                        "'ttft:p99<0.5,itl:p50<0.02') or a policy JSON "
+                        "dict; default ttft:p99<2,itl:p50<0.05,e2e:p95<10")
+    p.add_argument("--digest-window", type=float, default=60.0,
+                   help="fleet observer aggregation window in seconds")
     p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
     p.add_argument("--discovery-root", default=None, help="file backend root dir")
     p.add_argument("--http-workers", type=int, default=1,
@@ -155,11 +165,81 @@ async def async_main(args) -> None:
 
         grpc_server = KServeGrpcServer(manager, host=args.http_host, port=args.grpc_port)
         await grpc_server.start()
+    status = None
+    observer = None
+    fleet_tasks = []
+    if args.status_port:
+        from dynamo_tpu.planner.slo import SloEngine, parse_slo_config
+        from dynamo_tpu.runtime.event_plane import FLEET_DIGEST_SUBJECT
+        from dynamo_tpu.runtime.fleet_observer import (
+            FleetObserver,
+            routing_debug_payload,
+        )
+        from dynamo_tpu.runtime.status import StatusServer
+
+        observer = FleetObserver(
+            runtime.event_subscriber([FLEET_DIGEST_SUBJECT]),
+            window_s=args.digest_window,
+        )
+        await observer.start()
+        slo = SloEngine(observer, parse_slo_config(args.slo))
+        slo.bind_metrics(runtime.metrics)
+
+        async def _watch_digests():
+            # connect each worker's digest publisher as it registers
+            # (planner/__main__.py fpm-publisher idiom)
+            try:
+                async for ev in runtime.discovery.watch("services/"):
+                    addr = (ev.instance.metadata or {}).get("digest_publisher")
+                    if ev.kind == "put" and addr:
+                        observer.connect_publisher(addr)
+            except asyncio.CancelledError:
+                pass
+
+        async def _export_slo():
+            # keep the /metrics SLO gauges warm even when nothing polls
+            # /debug/fleet
+            try:
+                while True:
+                    await asyncio.sleep(5.0)
+                    slo.evaluate()
+            except asyncio.CancelledError:
+                pass
+
+        loop = asyncio.get_running_loop()
+        fleet_tasks = [loop.create_task(_watch_digests()),
+                       loop.create_task(_export_slo())]
+
+        def _fleet_view(q):
+            win = q.get("window_s")
+            view = observer.fleet(window_s=float(win) if win else None)
+            view["slo"] = slo.evaluate()
+            return view
+
+        def _routing_view(q):
+            try:
+                last_n = int(q.get("last_n", 64))
+            except ValueError:
+                last_n = 64
+            return routing_debug_payload(
+                manager.routing_audits(), rid=q.get("rid"), last_n=last_n)
+
+        status = StatusServer(runtime, port=args.status_port)
+        status.add_debug("fleet", _fleet_view)
+        status.add_debug("routing", _routing_view)
+        url = await status.start()
+        log.info("status server at %s (/debug/fleet, /debug/routing)", url)
     try:
         await asyncio.Event().wait()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        for t in fleet_tasks:
+            t.cancel()
+        if status is not None:
+            await status.stop()
+        if observer is not None:
+            await observer.stop()
         if grpc_server is not None:
             await grpc_server.stop()
         await svc.stop()

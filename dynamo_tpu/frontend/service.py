@@ -39,6 +39,8 @@ class ModelEntry:
     chain: AsyncEngine
     instance_ids: Set[int] = field(default_factory=set)
     teardown: Any = None  # async callable closing chain-owned resources
+    sink: Any = None  # router egress engine (KvPushRouter / RemoteKvRouter /
+    #   _ClientEngine) — exposed so /debug/routing can reach audit rings
     prefill_router: Any = None  # PrefillRouter operator in the chain
     prefill_client: Any = None
     prefill_instance_ids: Set[int] = field(default_factory=set)
@@ -78,6 +80,26 @@ class ModelManager:
 
     def list_models(self) -> list:
         return sorted(self.models)
+
+    def routing_audits(self) -> Dict[str, Any]:
+        """{label: RoutingAudit} across entries — the /debug/routing
+        source (runtime/fleet_observer.py routing_debug_payload). Labels
+        name the model and which router recorded the decision."""
+        audits: Dict[str, Any] = {}
+        for name, entry in self.models.items():
+            if not entry.owns_client:
+                continue  # adapter entries share the base client/sink
+            for label, obj in (
+                (f"{name}/kv", getattr(entry.sink, "router", None)),
+                (f"{name}/push", getattr(entry.client, "router", None)),
+                (f"{name}/prefill_kv", entry.prefill_kv_router),
+                (f"{name}/prefill_push",
+                 getattr(entry.prefill_client, "router", None)),
+            ):
+                audit = getattr(obj, "audit", None)
+                if audit is not None:
+                    audits[label] = audit
+        return audits
 
 
 class ModelWatcher:
@@ -128,11 +150,20 @@ class ModelWatcher:
         self._ready = asyncio.Event()
         # prefill-role instances seen before their model entry existed
         self._pending_prefill: Dict[str, list] = {}
+        # sink built for a model before its entry exists (see _build_sink)
+        self._sinks: Dict[str, Any] = {}
         # chain_factory(entry_args...) -> AsyncEngine; overridable (kv router)
         self._chain_factory = chain_factory or self._default_chain
 
     def _build_sink(self, card: ModelCard, client: EndpointClient):
-        """Router egress engine per router_mode. Returns (sink, teardown)."""
+        """Router egress engine per router_mode. Returns (sink, teardown).
+        The sink is also remembered per model so _on_put can stash it on
+        the ModelEntry (routing-audit introspection at /debug/routing)."""
+        sink, teardown = self._make_sink(card, client)
+        self._sinks[card.name] = sink
+        return sink, teardown
+
+    def _make_sink(self, card: ModelCard, client: EndpointClient):
         if self.router_mode == "kv":
             from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter
 
@@ -261,6 +292,7 @@ class ModelWatcher:
                 client=client,
                 chain=chain,
                 teardown=teardown,
+                sink=self._sinks.pop(card.name, None),
                 prefill_router=prefill_router,
             )
             self.manager.models[card.name] = entry

@@ -14,6 +14,7 @@ the only thing that notices).
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Tuple
 
 from dynamo_tpu.lint.core import LintContext, Rule
@@ -154,9 +155,87 @@ class RecorderBlockingIo(Rule):
                        "dump thread (queue.put_nowait) instead")
 
 
+# Prometheus label sets are bounded or they are a slow memory leak: every
+# distinct label value materializes a time series that lives for the rest
+# of the process (and the scraper's retention window). A request id, block
+# hash, or per-boot UUID in a label turns /metrics into an unbounded
+# allocation — per-request detail belongs in the routing audit ring and
+# the /debug/fleet JSON, not in metric labels.
+_METRIC_FACTORIES = {"counter", "gauge", "histogram", "child"}
+# label NAMES that are per-request / per-object by construction
+_UNBOUNDED_LABEL_RE = re.compile(
+    r"(^|_)(rid|request_id|req_id|block_hash|hash|hashes|uuid|"
+    r"session_id|trace_id|span_id)($|_)"
+)
+# label VALUE expressions that resolve to request ids / generated UUIDs
+_UNBOUNDED_VALUE_RE = re.compile(
+    r"(^|\.)(rid|request_id|req_id|block_hash|uuid4|uuid1|hex)($|\.)"
+)
+_CTX_ID_RE = re.compile(r"^(ctx|context|request|req)\.(id|rid)$")
+
+
+class MetricLabelCardinality(Rule):
+    id = "DYN-R005"
+    description = "unbounded-cardinality metric label (rid/hash/uuid)"
+
+    def _value_unbounded(self, ctx: LintContext, node: ast.AST):
+        """Reason string when the label-value expression is per-request /
+        per-object; None when it looks bounded."""
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            resolved = ctx.resolve(node)
+            if resolved is None:
+                # `uuid.uuid4().hex`: attribute on a call result
+                if isinstance(node, ast.Attribute):
+                    return self._value_unbounded(ctx, node.value)
+                return None
+            if _CTX_ID_RE.match(resolved):
+                return f"`{resolved}` is a per-request id"
+            if _UNBOUNDED_VALUE_RE.search(resolved):
+                return f"`{resolved}` is per-request / per-object"
+            return None
+        if isinstance(node, ast.Call):
+            resolved = ctx.resolve(node.func)
+            if resolved and _UNBOUNDED_VALUE_RE.search(resolved):
+                return f"`{resolved}(...)` generates a fresh value per call"
+            return None
+        if isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    reason = self._value_unbounded(ctx, part.value)
+                    if reason:
+                        return reason
+        return None
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_FACTORIES):
+            return
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue  # **labels expansion: unverifiable statically
+            if _UNBOUNDED_LABEL_RE.search(kw.arg):
+                ctx.report(self.id, node,
+                           f"metric label `{kw.arg}` is per-request / "
+                           "per-object: every distinct value materializes "
+                           "a Prometheus series forever — keep labels "
+                           "bounded (model, phase, slo, window) and put "
+                           "per-request detail in /debug/routing or the "
+                           "flight recorder")
+                continue
+            reason = self._value_unbounded(ctx, kw.value)
+            if reason:
+                ctx.report(self.id, node,
+                           f"metric label `{kw.arg}` takes {reason}: "
+                           "unbounded label values leak a series per "
+                           "value — use a bounded label set and put "
+                           "per-request detail in /debug/routing or the "
+                           "flight recorder")
+
+
 RUNTIME_RULES = (
     SharedMutableState,
     ExceptPassSwallow,
     MissingRpcTimeout,
     RecorderBlockingIo,
+    MetricLabelCardinality,
 )

@@ -52,6 +52,8 @@ def parse_args(argv=None):
     p.add_argument("--anomaly-dump-last-n", type=int, default=256)
     p.add_argument("--status-port", type=int, default=0,
                    help="serve /live /health /metrics /debug/timeline here")
+    p.add_argument("--digest-period", type=float, default=2.0,
+                   help="fleet digest publish period in seconds (0 = off)")
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"])
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
@@ -118,6 +120,7 @@ async def async_main(args) -> None:
         runtime, engine, card,
         namespace=args.namespace, component=args.component, endpoint=args.endpoint,
         disagg_role=args.disagg_role,
+        digest_period_s=args.digest_period,
     )
     print(f"mocker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
     try:

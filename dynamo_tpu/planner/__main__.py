@@ -12,10 +12,12 @@ import logging
 import sys
 
 from dynamo_tpu.planner.connector import LocalProcessConnector, VirtualConnector
-from dynamo_tpu.planner.observer import FpmObserver
+from dynamo_tpu.planner.observer import FleetLoadObserver, FpmObserver
 from dynamo_tpu.planner.planner import Planner, PlannerConfig, SloConfig
 from dynamo_tpu.router.protocols import FPM_SUBJECT
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.event_plane import FLEET_DIGEST_SUBJECT
+from dynamo_tpu.runtime.fleet_observer import FleetObserver
 from dynamo_tpu.runtime.logging_util import configure_logging
 
 log = logging.getLogger("dynamo_tpu.planner.main")
@@ -39,6 +41,10 @@ def parse_args(argv=None):
     )
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
+    p.add_argument("--legacy-fpm", action="store_true",
+                   help="observe the per-iteration FPM stream instead of "
+                        "the periodic fleet digest plane (workers started "
+                        "with --digest-period 0)")
     return p.parse_args(argv)
 
 
@@ -49,7 +55,15 @@ async def async_main(args) -> None:
         kw["root"] = args.discovery_root
     runtime = DistributedRuntime(discovery_backend=args.discovery_backend, **kw)
 
-    observer = FpmObserver(runtime.event_subscriber([FPM_SUBJECT]))
+    if args.legacy_fpm:
+        observer = FpmObserver(runtime.event_subscriber([FPM_SUBJECT]))
+        publisher_key = "fpm_publisher"
+    else:
+        # default source: compact periodic digests (one message per worker
+        # per period instead of one per engine iteration)
+        observer = FleetLoadObserver(FleetObserver(
+            runtime.event_subscriber([FLEET_DIGEST_SUBJECT])))
+        publisher_key = "digest_publisher"
     if args.connector == "local":
         if not args.local_worker_cmd:
             sys.exit("--local-worker-cmd required for the local connector")
@@ -67,10 +81,10 @@ async def async_main(args) -> None:
     )
     planner = Planner(observer, connector, config)
 
-    # wire FPM publishers as workers come and go
+    # wire load publishers as workers come and go
     async def watch_workers():
         async for ev in runtime.discovery.watch("services/"):
-            addr = (ev.instance.metadata or {}).get("fpm_publisher")
+            addr = (ev.instance.metadata or {}).get(publisher_key)
             if ev.kind == "put" and addr:
                 observer.connect_publisher(addr)
 

@@ -94,3 +94,53 @@ class FpmObserver:
             wl.last_seen = max(s.get("ts", 0) for s in recent)
             out.append(wl)
         return out
+
+
+class FleetLoadObserver:
+    """OBSERVE stage over the fleet digest plane (runtime/fleet_observer):
+    adapts periodic worker digests into the WorkerLoad rows the Planner
+    consumes. One digest summarizes a whole publish period, so the
+    per-iteration FPM stream stays off the planner's wire — this replaces
+    FpmObserver as the default source (FpmObserver remains for
+    --legacy-fpm and in-process tests)."""
+
+    def __init__(self, fleet, window_s: float = 30.0):
+        # `fleet` is a runtime.fleet_observer.FleetObserver
+        self.fleet = fleet
+        self.window_s = window_s
+
+    def connect_publisher(self, address: str) -> None:
+        self.fleet.connect_publisher(address)
+
+    async def start(self) -> None:
+        await self.fleet.start()
+
+    async def stop(self) -> None:
+        await self.fleet.stop()
+
+    def loads(self, now: Optional[float] = None) -> List[WorkerLoad]:
+        out: List[WorkerLoad] = []
+        for worker, digests in sorted(
+                self.fleet.window_digests(now, self.window_s).items()):
+            wl = WorkerLoad(worker=worker, n_samples=len(digests))
+            dec_tok = dec_iters = dec_wall = pre_tok = 0.0
+            span = 0.0
+            for d in digests:
+                c = d.get("counters") or {}
+                dec_tok += c.get("decode_tokens", 0)
+                dec_iters += c.get("decode_iters", 0)
+                dec_wall += c.get("decode_wall_s", 0.0)
+                pre_tok += c.get("prefill_tokens", 0)
+                span += d.get("period_s", 0.0) or 0.0
+            span = max(span, 1e-6)
+            wl.decode_tok_s = dec_tok / span
+            wl.prefill_tok_s = pre_tok / span
+            if dec_iters:
+                wl.mean_decode_step_s = dec_wall / dec_iters
+            latest_q = digests[-1].get("queue") or {}
+            wl.mean_running = float(latest_q.get("n_running", 0))
+            wl.mean_waiting = float(latest_q.get("n_waiting", 0))
+            wl.kv_usage = float(latest_q.get("kv_usage", 0.0))
+            wl.last_seen = digests[-1].get("ts", 0.0)
+            out.append(wl)
+        return out

@@ -1,0 +1,246 @@
+"""SLO attainment engine: declared percentile targets scored against the
+fleet digest plane with multi-window burn rates.
+
+A target declares "phase P at percentile q must stay under T seconds"
+(e.g. TTFT p99 <= 0.5s). For each target the engine computes, over a
+FAST and a SLOW window of digest histograms, the *burn rate*:
+
+    burn = observed_fraction_over_threshold / allowed_fraction
+
+where allowed_fraction = 1 - q (a p99 target tolerates 1% of requests
+over the threshold; burn 1.0 means the error budget is being consumed
+exactly as fast as it accrues). Multi-window state (the Google SRE
+burn-rate alerting shape, adapted to serving):
+
+    BREACH  both windows burning (>= breach_burn): sustained violation
+    WARN    exactly one window burning: entering (fast only) or
+            recovering from (slow only) a violation
+    OK      neither window burning
+
+The two-window AND keeps a single burst spike from paging (fast trips,
+slow doesn't -> WARN) while a sustained breach is caught within one fast
+window. States are computed per-worker and fleet-wide; /metrics gets the
+fleet-level gauges (bounded label set — per-worker detail lives only in
+the /debug/fleet JSON, per DYN-R005's cardinality rule).
+
+Config formats:
+- dict/JSON: {"targets": [{"phase": "ttft", "percentile": 0.99,
+  "threshold_s": 0.5}, ...], "fast_window_s": 30, "slow_window_s": 120}
+- compact CLI string: "ttft:p99<0.5,itl:p50<0.02,e2e:p95<4"
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from dynamo_tpu.runtime.fleet_observer import (
+    FleetObserver,
+    hist_count,
+    hist_frac_over,
+    hist_quantile,
+)
+
+log = logging.getLogger("dynamo_tpu.planner.slo")
+
+OK = "OK"
+WARN = "WARN"
+BREACH = "BREACH"
+_STATE_CODE = {OK: 0, WARN: 1, BREACH: 2}
+
+
+@dataclass
+class SloTarget:
+    phase: str            # spine phase name without _s: ttft | itl | e2e | ...
+    percentile: float     # 0.99 -> "p99 must be under threshold"
+    threshold_s: float
+
+    @property
+    def name(self) -> str:
+        return f"{self.phase}_p{round(self.percentile * 100):g}"
+
+    @property
+    def allowed_fraction(self) -> float:
+        return max(1e-6, 1.0 - self.percentile)
+
+
+@dataclass
+class SloPolicy:
+    targets: List[SloTarget] = field(default_factory=list)
+    fast_window_s: float = 30.0
+    slow_window_s: float = 120.0
+    breach_burn: float = 1.0   # burning threshold for both states
+    min_samples: int = 8       # below this a window abstains (reads OK)
+
+
+def default_policy() -> SloPolicy:
+    return SloPolicy(targets=[
+        SloTarget("ttft", 0.99, 2.0),
+        SloTarget("itl", 0.5, 0.05),
+        SloTarget("e2e", 0.95, 10.0),
+    ])
+
+
+def parse_slo_config(spec: Any) -> SloPolicy:
+    """Accepts a policy dict, a JSON string of one, or the compact
+    "phase:pNN<seconds[,...]" CLI form. None/"" -> default_policy()."""
+    if spec is None or spec == "":
+        return default_policy()
+    if isinstance(spec, SloPolicy):
+        return spec
+    if isinstance(spec, str):
+        s = spec.strip()
+        if s.startswith("{"):
+            spec = json.loads(s)
+        else:
+            targets = []
+            for part in s.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                try:
+                    head, thr = part.split("<", 1)
+                    phase, pct = head.split(":p", 1)
+                    targets.append(SloTarget(
+                        phase.strip(), float(pct) / 100.0, float(thr)))
+                except ValueError as e:
+                    raise ValueError(
+                        f"bad SLO spec {part!r} (want phase:pNN<seconds)"
+                    ) from e
+            return SloPolicy(targets=targets)
+    if isinstance(spec, dict):
+        pol = SloPolicy(
+            fast_window_s=float(spec.get("fast_window_s", 30.0)),
+            slow_window_s=float(spec.get("slow_window_s", 120.0)),
+            breach_burn=float(spec.get("breach_burn", 1.0)),
+            min_samples=int(spec.get("min_samples", 8)),
+        )
+        for t in spec.get("targets") or []:
+            pol.targets.append(SloTarget(
+                str(t["phase"]), float(t["percentile"]),
+                float(t["threshold_s"])))
+        return pol if pol.targets else default_policy()
+    raise TypeError(f"cannot parse SLO config from {type(spec).__name__}")
+
+
+class SloEngine:
+    """Scores a FleetObserver's digest windows against an SloPolicy."""
+
+    def __init__(self, observer: FleetObserver,
+                 policy: Optional[SloPolicy] = None):
+        self.observer = observer
+        self.policy = policy or default_policy()
+        self._m_burn = None
+        self._m_state = None
+        self._m_value = None
+
+    def bind_metrics(self, metrics) -> None:
+        """Fleet-level gauges on the shared registry: burn rate per
+        (slo, window), numeric state per slo, measured percentile per
+        slo. Labels are target names + window tags — bounded."""
+        node = metrics.child(dynamo_component="slo")
+        self._m_burn = node
+        self._m_state = node
+        self._m_value = node
+
+    def _window_score(self, target: SloTarget, window_s: float,
+                      now: Optional[float], worker=None) -> Dict[str, Any]:
+        hists = self.observer.phase_hists(now, window_s, worker=worker)
+        h = hists.get(target.phase)
+        n = hist_count(h) if h else 0
+        if not h or n < self.policy.min_samples:
+            return {"n": n, "value_s": None, "frac_over": None, "burn": None}
+        frac = hist_frac_over(h, target.threshold_s) or 0.0
+        return {
+            "n": n,
+            "value_s": round(hist_quantile(h, target.percentile), 6),
+            "frac_over": round(frac, 6),
+            "burn": round(frac / target.allowed_fraction, 4),
+        }
+
+    def _state(self, fast: Dict[str, Any], slow: Dict[str, Any]) -> str:
+        thr = self.policy.breach_burn
+        fast_burning = fast["burn"] is not None and fast["burn"] >= thr
+        slow_burning = slow["burn"] is not None and slow["burn"] >= thr
+        if fast_burning and slow_burning:
+            return BREACH
+        if fast_burning or slow_burning:
+            return WARN
+        return OK
+
+    def _score_scope(self, now: Optional[float], worker=None
+                     ) -> Dict[str, Any]:
+        out = {}
+        for t in self.policy.targets:
+            fast = self._window_score(t, self.policy.fast_window_s, now,
+                                      worker)
+            slow = self._window_score(t, self.policy.slow_window_s, now,
+                                      worker)
+            out[t.name] = {
+                "phase": t.phase,
+                "percentile": t.percentile,
+                "threshold_s": t.threshold_s,
+                "state": self._state(fast, slow),
+                "fast": fast,
+                "slow": slow,
+            }
+        return out
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Full attainment view: fleet-wide and per-worker states. `now`
+        is observer-monotonic (tests pass synthetic clocks)."""
+        fleet = self._score_scope(now)
+        workers = {}
+        for w in self.observer.workers(now):
+            scored = self._score_scope(now, worker=w)
+            workers[f"{w[0]:x}.{w[1]}"] = {
+                "states": {name: s["state"] for name, s in scored.items()},
+                "targets": scored,
+            }
+        overall = OK
+        for s in fleet.values():
+            if _STATE_CODE[s["state"]] > _STATE_CODE[overall]:
+                overall = s["state"]
+        result = {
+            "state": overall,
+            "fleet": fleet,
+            "workers": workers,
+            "policy": {
+                "fast_window_s": self.policy.fast_window_s,
+                "slow_window_s": self.policy.slow_window_s,
+                "breach_burn": self.policy.breach_burn,
+                "targets": [
+                    {"phase": t.phase, "percentile": t.percentile,
+                     "threshold_s": t.threshold_s}
+                    for t in self.policy.targets
+                ],
+            },
+        }
+        self._export_metrics(fleet)
+        return result
+
+    def _export_metrics(self, fleet: Dict[str, Any]) -> None:
+        if self._m_burn is None:
+            return
+        for name, s in fleet.items():
+            self._m_state.gauge(
+                "slo_state",
+                "SLO attainment state (0=OK 1=WARN 2=BREACH)",
+                slo=name,
+            ).set(_STATE_CODE[s["state"]])
+            for win in ("fast", "slow"):
+                burn = s[win]["burn"]
+                self._m_burn.gauge(
+                    "slo_burn_rate",
+                    "error-budget burn rate per SLO target and window",
+                    slo=name, window=win,
+                ).set(burn if burn is not None else 0.0)
+                val = s[win]["value_s"]
+                if val is not None:
+                    self._m_value.gauge(
+                        "slo_measured_seconds",
+                        "measured percentile value per SLO target and window",
+                        slo=name, window=win,
+                    ).set(val)

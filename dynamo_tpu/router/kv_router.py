@@ -77,6 +77,11 @@ class KvRouter:
         )
         self._started = False
         self._known_workers: set = set()
+        # routing decision audit ring (per-instance, never module-global —
+        # DYN-R001), queried by the frontend's /debug/routing
+        from dynamo_tpu.runtime.fleet_observer import RoutingAudit
+
+        self.audit = RoutingAudit()
         # replica sync (reference kv_router router-replica-sync): frontends
         # running parallel router replicas broadcast add/prefill_done/free
         # deltas so every replica's load view includes the others' in-flight
@@ -396,10 +401,13 @@ class KvRouter:
                 healthy = [w for w in workers if w[0] not in sick]
                 if healthy:
                     workers = healthy
+        cand_audit: List[dict] = []
         worker, overlap = self.selector.select(
             workers, len(hashes), overlaps, self.sequences,
-            host_overlaps=host_overlaps,
+            host_overlaps=host_overlaps, audit=cand_audit,
         )
+        if collect is not None:
+            collect["candidates"] = cand_audit
         return worker, overlap, hashes
 
     def remote_host_hint(
@@ -619,6 +627,16 @@ class KvPushRouter:
         self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap
         context.metadata["routed_instance"] = worker[0]
+        # routing decision audit: per-candidate cost breakdown, joinable to
+        # the phase spine by rid (/debug/routing?rid=...)
+        self.router.audit.record(
+            rid, "kv", worker,
+            candidates=collect.get("candidates"),
+            overlap_blocks=overlap,
+            total_blocks=len(hashes),
+            remote_hint=hint is not None,
+            prefetch_hint=pf is not None,
+        )
         # latency spine: KV-aware selection cost (admission wait included —
         # that's real time the router held the request), accumulated across
         # migration retries; the metadata dict rides to the worker

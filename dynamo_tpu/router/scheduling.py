@@ -55,8 +55,12 @@ class WorkerSelector:
         overlaps: OverlapScores,
         sequences: ActiveSequences,
         host_overlaps: Optional[Dict[Worker, int]] = None,
+        audit: Optional[List[dict]] = None,
     ) -> Tuple[Worker, int]:
-        """Returns (worker, device_overlap_blocks). Raises if no workers."""
+        """Returns (worker, device_overlap_blocks). Raises if no workers.
+
+        `audit`, when given, is filled with one per-candidate cost
+        breakdown dict (routing decision audit, /debug/routing)."""
         if not workers:
             raise RuntimeError("no workers available for KV routing")
         cfg = self.config
@@ -73,6 +77,17 @@ class WorkerSelector:
             prefill = new_blocks + sequences.prefill_blocks(w)
             decode = sequences.decode_blocks(w)
             costs.append(cfg.prefill_load_scale * prefill + decode)
+            if audit is not None:
+                audit.append({
+                    "worker": list(w),
+                    "overlap_blocks": dev,
+                    "host_overlap_blocks": host,
+                    "credit": round(credit, 3),
+                    "new_blocks": round(new_blocks, 3),
+                    "prefill_blocks": round(prefill, 3),
+                    "decode_blocks": round(decode, 3),
+                    "cost": round(costs[-1], 3),
+                })
 
         if cfg.temperature <= 0.0:
             best = min(range(len(workers)), key=lambda i: (costs[i], workers[i]))
@@ -92,4 +107,7 @@ class WorkerSelector:
                     best = i
                     break
         w = workers[best]
+        if audit is not None:
+            for i, entry in enumerate(audit[-len(workers):]):
+                entry["chosen"] = i == best
         return w, overlaps.scores.get(w, 0)

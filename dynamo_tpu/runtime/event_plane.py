@@ -31,6 +31,8 @@ log = logging.getLogger("dynamo_tpu.event_plane")
 KV_EVENT_SUBJECT = "kv_events"
 FPM_SUBJECT = "fpm"
 SEQ_SYNC_SUBJECT = "seq_sync"
+# periodic per-worker observability digests (runtime/fleet_observer.py)
+FLEET_DIGEST_SUBJECT = "fleet_digest"
 
 
 class EventPublisher:

@@ -620,6 +620,11 @@ class PushRouter:
         self._ext_load_ts: Dict[int, float] = {}  # last update (monotonic)
         self._weights: Dict[int, float] = {}  # published device capacity
         self._sick: Dict[int, float] = {}  # instance_id -> retry-after
+        # routing decision audit ring (per-router instance, DYN-R001),
+        # queried by the frontend's /debug/routing
+        from dynamo_tpu.runtime.fleet_observer import RoutingAudit
+
+        self.audit = RoutingAudit()
 
     def update_instance(self, instance_id: int, address: Optional[str]) -> None:
         if address is None:
@@ -816,6 +821,27 @@ class PushRouter:
         ph = context.metadata.setdefault("phases", {})
         ph["route_s"] = (ph.get("route_s", 0.0)
                          + (_time.monotonic() - t_route))
+        # routing decision audit: candidate loads as the picker saw them,
+        # joinable to the phase spine by rid (/debug/routing?rid=...)
+        sick = set(self._sick)
+        target = context.metadata.get("target_instance")
+        self.audit.record(
+            context.id, self.mode, iid,
+            candidates=[
+                {
+                    "instance": i,
+                    "load": self.load_of(i),
+                    "weight": self._weights.get(i, 1.0),
+                    "sick": i in sick,
+                    "chosen": i == iid,
+                }
+                for i in sorted(
+                    self._instances if allowed is None
+                    else (j for j in self._instances if j in set(allowed))
+                )
+            ],
+            pinned=target is not None,
+        )
         engine = RemoteEngine(self._pool, addr, self.endpoint_path)
         self._inflight[iid] = self._inflight.get(iid, 0) + 1
         try:

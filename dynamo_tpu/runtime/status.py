@@ -20,6 +20,7 @@ class StatusServer:
         self.port = port
         self._checks: Dict[str, Callable[[], bool]] = {}
         self._timeline: Optional[Callable[[int], dict]] = None
+        self._debug: Dict[str, Callable[[Dict[str, str]], dict]] = {}
         self._started_at = time.time()
         self._runner: Optional[web.AppRunner] = None
 
@@ -32,6 +33,13 @@ class StatusServer:
         to_chrome_trace here; see docs/observability.md)."""
         self._timeline = fn
 
+    def add_debug(self, name: str, fn: Callable[[Dict[str, str]], dict]) -> None:
+        """Install a GET /debug/<name> JSON source: fn(query_params) ->
+        payload dict. Must be registered before start(). The frontend
+        wires /debug/fleet and /debug/routing here
+        (docs/observability.md "Fleet view")."""
+        self._debug[name] = fn
+
     async def start(self) -> str:
         app = web.Application()
         app.add_routes(
@@ -40,6 +48,10 @@ class StatusServer:
                 web.get("/health", self._health),
                 web.get("/metrics", self._metrics),
                 web.get("/debug/timeline", self._debug_timeline),
+            ]
+            + [
+                web.get(f"/debug/{name}", self._make_debug(fn))
+                for name, fn in self._debug.items()
             ]
         )
         self._runner = web.AppRunner(app, access_log=None)
@@ -88,3 +100,14 @@ class StatusServer:
             last_n = None
         trace = self._timeline(last_n)
         return web.json_response(trace)
+
+    def _make_debug(self, fn):
+        async def handler(request) -> web.Response:
+            try:
+                payload = fn(dict(request.query))
+            except Exception as e:
+                log.warning("debug source failed", exc_info=True)
+                return web.json_response({"error": str(e)}, status=500)
+            return web.json_response(payload)
+
+        return handler

@@ -155,6 +155,9 @@ def parse_args(argv=None):
                    help="placeholder token id (default: vocab_size - 1)")
     p.add_argument("--status-port", type=int, default=0,
                    help="serve /live /health /metrics on this port (0 = off)")
+    p.add_argument("--digest-period", type=float, default=2.0,
+                   help="fleet digest publish period in seconds (0 = off; "
+                        "docs/observability.md Fleet view)")
     # flight recorder (observability; docs/observability.md)
     p.add_argument("--recorder-size", type=int, default=4096,
                    help="flight-recorder ring capacity in iterations "
@@ -622,6 +625,7 @@ async def async_main(args) -> None:
                 endpoint=args.endpoint, disagg_role=args.disagg_role,
                 disagg_chunk_pages=args.disagg_chunk_pages,
                 http_address=args.http_address,
+                digest_period_s=args.digest_period,
             )
 
         shadow = ShadowServer(
@@ -636,6 +640,7 @@ async def async_main(args) -> None:
             disagg_role=args.disagg_role,
             disagg_chunk_pages=args.disagg_chunk_pages,
             http_address=args.http_address,
+            digest_period_s=args.digest_period,
         )
         print(f"worker serving {card.name} at {path}", flush=True)
     promotion_failed = False

@@ -35,6 +35,7 @@ class ServedWorker:
         self.engine = engine
         self.instance = instance
         self.publisher = publisher
+        self.digest_pub = None  # DigestPublisher when digests are on
         self._close_hooks = list(close_hooks or [])
 
     async def stop(self) -> None:
@@ -159,6 +160,7 @@ async def serve_worker(
     endpoint: str = "generate",
     publish_kv_events: bool = True,
     publish_fpm: bool = True,
+    digest_period_s: float = 2.0,  # fleet digest publish period (0 = off)
     dp_rank: int = 0,
     disagg_role: Optional[str] = None,  # None/"both" | "prefill" | "decode"
     disagg_chunk_pages: int = 16,  # P->D pull chunk size (0 = monolithic)
@@ -215,6 +217,30 @@ async def serve_worker(
 
         engine.on_fpm(on_fpm)
         metadata["fpm_publisher"] = pub.address
+
+    # fleet digest plane (runtime/fleet_observer.py): compact periodic
+    # summaries — phase histograms, queue depth, KV tier occupancy,
+    # prefetch/compile counters — pushed over the event plane so the
+    # frontend's FleetObserver / SLO engine and the planner never scrape.
+    # Accumulation hooks run on the engine step thread (bucket increments
+    # only); the publish task lives on the event loop.
+    digest_pub = None
+    if digest_period_s and digest_period_s > 0:
+        from dynamo_tpu.runtime.fleet_observer import (
+            DigestBuilder, DigestPublisher,
+        )
+
+        builder = DigestBuilder(instance_id, dp_rank)
+        engine.on_fpm(builder.observe_fpm)
+        if hasattr(engine, "on_phases"):
+            engine.on_phases(builder.observe_phases)
+        digest_pub = DigestPublisher(
+            builder, runtime.event_publisher(), engine=engine,
+            period_s=digest_period_s,
+        )
+        digest_pub.start()
+        metadata["digest_publisher"] = digest_pub.address
+        metadata["digest_period_s"] = digest_pub.period_s
 
     # disagg endpoints: prefill workers serve parked-KV pulls; decode
     # workers (and aggregated) accept transfer-carrying requests.
@@ -501,6 +527,11 @@ async def serve_worker(
             await c.close()
 
     close_hooks = [_close_fetch_clients]
+    if digest_pub is not None:
+        # final flush on stop: the last partial window still reaches the
+        # observer (the chaos suite's mid-window death is the case where
+        # it does NOT flush — SIGKILL — and the observer must cope)
+        close_hooks.append(digest_pub.stop)
     handler = DisaggDecodeAdapter(engine, runtime, chunk_pages=disagg_chunk_pages)
 
     engine.start()
@@ -512,4 +543,6 @@ async def serve_worker(
     )
     _served["inst"] = inst  # rl load_adapter republishes this card
     log.info("worker %x serving %s (role=%s)", instance_id, card.name, disagg_role or "both")
-    return ServedWorker(runtime, engine, inst, publisher, close_hooks=close_hooks)
+    served = ServedWorker(runtime, engine, inst, publisher, close_hooks=close_hooks)
+    served.digest_pub = digest_pub
+    return served

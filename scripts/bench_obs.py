@@ -1,16 +1,25 @@
-"""Flight-recorder overhead A/B: ITL with the ring on vs off.
+"""Observability overhead A/B: recorder ring and fleet digests, on vs off.
 
-Serves an identical deterministic trace through an in-process
-InferenceEngine over SimRunner (CPU, no JAX) twice — recorder enabled
-(default ring size) and recorder disabled (`recorder_size=0`) — and
-reports per-request latency percentiles plus a hash of every emitted
+Default mode serves an identical deterministic trace through an
+in-process InferenceEngine over SimRunner (CPU, no JAX) twice — recorder
+enabled (default ring size) and recorder disabled (`recorder_size=0`) —
+and reports per-request latency percentiles plus a hash of every emitted
 token stream. Acceptance (docs/perf_notes.md): ITL p50 within 2% and
 byte-identical token hashes across the two arms. Run:
 
     python scripts/bench_obs.py [--n-requests 48] [--isl 64] [--osl 32]
 
-Prints one JSON line {"metric": "flight_recorder_overhead",
-"on": {...}, "off": {...}, "itl_p50_ratio": ..., "tokens_match": ...}.
+`--fleet` measures the fleet DIGEST plane instead: a multi-worker mocker
+fleet (one engine per worker, requests round-robined) with per-worker
+DigestBuilder/DigestPublisher feeding a live FleetObserver, vs the same
+fleet with digests off. Acceptance (ISSUE 6): ITL p50 delta under 0.5%
+and byte-identical tokens. Run:
+
+    python scripts/bench_obs.py --fleet [--n-workers 4] \
+        [--digest-period 0.5]
+
+Either mode prints one JSON line with {"on": {...}, "off": {...},
+"itl_p50_ratio": ..., "tokens_match": ...}.
 """
 
 from __future__ import annotations
@@ -100,6 +109,135 @@ async def _run_arm(args, recorder_size: int) -> dict:
     }
 
 
+async def _run_fleet_arm(args, digest_period: float) -> dict:
+    """One fleet arm: n_workers engines, requests round-robined. When
+    `digest_period` > 0 every engine gets the full worker-side digest
+    path (phase/FPM hooks on the step thread + periodic publish) and a
+    FleetObserver consumes the stream live, so the measured cost covers
+    both ends of the plane."""
+    from dynamo_tpu.runtime.event_plane import (
+        FLEET_DIGEST_SUBJECT,
+        InProcEventPublisher,
+        InProcEventSubscriber,
+    )
+    from dynamo_tpu.runtime.fleet_observer import (
+        DigestBuilder,
+        DigestPublisher,
+        FleetObserver,
+    )
+
+    engines = []
+    for _ in range(args.n_workers):
+        runner = SimRunner(
+            num_pages=args.num_pages, page_size=args.page_size,
+            max_pages_per_seq=args.max_pages_per_seq,
+            timing=SimTiming(speed=args.sim_speed,
+                             decode_base_s=args.decode_base_ms / 1000.0),
+        )
+        engine = InferenceEngine(
+            runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
+            recorder_size=0,
+        )
+        engine.start()
+        engines.append(engine)
+
+    observer = None
+    digest_pubs = []
+    if digest_period > 0:
+        observer = FleetObserver(
+            InProcEventSubscriber([FLEET_DIGEST_SUBJECT]), window_s=60.0)
+        for i, engine in enumerate(engines):
+            builder = DigestBuilder(i)
+            engine.on_fpm(builder.observe_fpm)
+            engine.on_phases(builder.observe_phases)
+            dp = DigestPublisher(builder, InProcEventPublisher(),
+                                 engine=engine, period_s=digest_period)
+            dp.start()
+            observer.connect_publisher(dp.address)
+            digest_pubs.append(dp)
+        await observer.start()
+
+    itls: list = []
+    digest = hashlib.sha256()
+    t0 = time.perf_counter()
+    try:
+        async def one(i, prompt):
+            engine = engines[i % len(engines)]
+            toks = []
+            first = last = None
+            steps = []
+            async for item in engine.generate(
+                {"token_ids": prompt, "sampling": {"temperature": 0.0},
+                 "stop": {"max_tokens": args.osl, "stop_ids": [],
+                          "ignore_eos": True}}, Context(),
+            ):
+                ids = item.get("token_ids") or []
+                now = time.perf_counter()
+                if ids:
+                    if first is None:
+                        first = now
+                    elif last is not None:
+                        steps.append((now - last) / len(ids))
+                    last = now
+                    toks.extend(ids)
+                if item.get("finish_reason"):
+                    break
+            return toks, steps
+
+        outs = await asyncio.gather(
+            *[one(i, p) for i, p in enumerate(_prompts(args))])
+        for dp in digest_pubs:  # flush the tail window into the observer
+            await dp.publish_once()
+        if digest_pubs:
+            await asyncio.sleep(0.05)
+    finally:
+        if observer is not None:
+            await observer.stop()
+        for dp in digest_pubs:
+            await dp.stop(flush=False)
+        for engine in engines:
+            engine.stop()
+    wall = time.perf_counter() - t0
+    for toks, steps in outs:
+        digest.update(json.dumps(toks).encode())
+        itls.extend(steps)
+    out = {
+        "digest_period_s": digest_period,
+        "n_workers": args.n_workers,
+        "wall_s": round(wall, 4),
+        "requests": len(outs),
+        "output_tokens": sum(len(t) for t, _ in outs),
+        "itl_p50_s": round(_pct(itls, 0.5), 6),
+        "itl_p99_s": round(_pct(itls, 0.99), 6),
+        "tokens_sha256": digest.hexdigest(),
+    }
+    if observer is not None:
+        view = observer.fleet()
+        out["digests_received"] = view["received"]
+        out["digest_workers"] = view["n_workers"]
+        itl_pct = view["fleet"]["phases"].get("itl") or {}
+        out["fleet_itl_p50_s"] = itl_pct.get("p50_s")
+    return out
+
+
+async def _main_fleet(args) -> dict:
+    await _run_fleet_arm(args, digest_period=0.0)  # warmup
+    on = await _run_fleet_arm(args, digest_period=args.digest_period)
+    off = await _run_fleet_arm(args, digest_period=0.0)
+    return {
+        "metric": "fleet_digest_overhead",
+        "n_requests": args.n_requests,
+        "n_workers": args.n_workers,
+        "isl": args.isl,
+        "osl": args.osl,
+        "on": on,
+        "off": off,
+        "itl_p50_ratio": round(
+            on["itl_p50_s"] / max(off["itl_p50_s"], 1e-12), 4),
+        "tokens_match": on["tokens_sha256"] == off["tokens_sha256"],
+    }
+
+
 async def _main(args) -> dict:
     # interleave a warmup arm first so allocator/interpreter noise lands
     # outside the measured pair
@@ -134,8 +272,14 @@ def main() -> int:
     ap.add_argument("--decode-base-ms", type=float, default=1.0,
                     help="simulated decode dispatch cost: the recorder's "
                          "per-iteration cost is measured against this")
+    ap.add_argument("--fleet", action="store_true",
+                    help="measure the fleet digest plane (multi-worker "
+                         "A/B) instead of the flight recorder")
+    ap.add_argument("--n-workers", type=int, default=4)
+    ap.add_argument("--digest-period", type=float, default=0.5,
+                    help="digest publish period for the --fleet on-arm")
     args = ap.parse_args()
-    report = asyncio.run(_main(args))
+    report = asyncio.run(_main_fleet(args) if args.fleet else _main(args))
     print(json.dumps(report))
     return 0 if report["tokens_match"] else 1
 

@@ -1,12 +1,21 @@
-"""Pull a worker's flight-recorder timeline for Perfetto.
+"""Pull flight-recorder timelines for Perfetto — one worker or a fleet.
 
-Fetches `/debug/timeline` from a worker's status port (``--status-port``
+Fetches `/debug/timeline` from each worker's status port (``--status-port``
 on `python -m dynamo_tpu.worker` / any process that wired
-`StatusServer.add_timeline`) and writes the Chrome-trace JSON to a file
-you can open in https://ui.perfetto.dev or chrome://tracing. Run:
+`StatusServer.add_timeline`) and writes Chrome-trace JSON you can open in
+https://ui.perfetto.dev or chrome://tracing. Run:
 
-    python scripts/dump_timeline.py --url http://worker-host:9090 \
+    # single worker (back-compat)
+    python scripts/dump_timeline.py --url http://worker-host:9090
+
+    # fleet merge: one Perfetto process-track group per worker
+    python scripts/dump_timeline.py \
+        --worker http://worker-a:9090 --worker b=http://worker-b:9091 \
         [--last-n 1024] [--out timeline.json]
+
+`--worker` is repeatable and accepts `label=URL`; each worker's events
+land under their own pid so Perfetto renders per-worker track groups with
+a shared wall-clock axis (cross-worker stalls line up visually).
 """
 
 from __future__ import annotations
@@ -27,31 +36,72 @@ def fetch_timeline(base_url: str, last_n: int = 0,
         return json.loads(resp.read())
 
 
+def merge_traces(traces: list) -> dict:
+    """[(label, chrome_trace_dict)] -> one trace; worker i's events get
+    pid=i and a process_name of the label, so each worker renders as its
+    own Perfetto track group on the shared time axis."""
+    events = []
+    for pid, (label, trace) in enumerate(traces):
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if (ev.get("ph") == "M" and ev.get("name") == "process_name"):
+                ev["args"] = {"name": f"worker {label}"}
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _parse_worker(spec: str) -> tuple:
+    """'label=URL' or bare 'URL' -> (label, URL)."""
+    if "=" in spec and not spec.split("=", 1)[0].startswith("http"):
+        label, url = spec.split("=", 1)
+        return label, url
+    return spec.rstrip("/").rsplit(":", 1)[-1], spec
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--url", required=True,
-                    help="status server base URL, e.g. http://host:9090")
+    ap.add_argument("--url", default=None,
+                    help="single status server base URL (back-compat)")
+    ap.add_argument("--worker", action="append", default=[],
+                    metavar="[LABEL=]URL",
+                    help="worker status URL; repeat for a fleet merge")
     ap.add_argument("--last-n", type=int, default=0,
-                    help="bound the record count (0 = whole ring)")
+                    help="bound the record count per worker (0 = whole ring)")
     ap.add_argument("--out", default="timeline.json")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args()
-    try:
-        trace = fetch_timeline(args.url, args.last_n, args.timeout)
-    except urllib.error.HTTPError as e:
-        if e.code == 404:
-            print("error: no timeline source on that process — is the "
-                  "flight recorder enabled (--recorder-size > 0)?",
-                  file=sys.stderr)
-            return 2
-        raise
+    targets = [_parse_worker(w) for w in args.worker]
+    if args.url:
+        targets.insert(0, _parse_worker(args.url))
+    if not targets:
+        ap.error("need --url or at least one --worker")
+    traces, failed = [], []
+    for label, url in targets:
+        try:
+            traces.append((label, fetch_timeline(url, args.last_n,
+                                                 args.timeout)))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                print(f"error: {url}: no timeline source — is the flight "
+                      "recorder enabled (--recorder-size > 0)?",
+                      file=sys.stderr)
+                failed.append(url)
+                continue
+            raise
+        except (urllib.error.URLError, OSError) as e:
+            print(f"error: {url}: {e}", file=sys.stderr)
+            failed.append(url)
+    if not traces:
+        return 2
+    trace = merge_traces(traces) if len(traces) > 1 else traces[0][1]
     events = trace.get("traceEvents", [])
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(trace, f)
     slices = sum(1 for e in events if e.get("ph") == "X")
-    print(f"wrote {args.out}: {len(events)} events "
+    print(f"wrote {args.out}: {len(traces)} worker(s), {len(events)} events "
           f"({slices} iteration slices) — open in ui.perfetto.dev")
-    return 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

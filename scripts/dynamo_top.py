@@ -1,0 +1,177 @@
+"""Live fleet view over the frontend's `/debug/fleet` endpoint.
+
+A `top`-style terminal dashboard for a dynamo_tpu fleet: one row per
+worker (queue depth, KV tier occupancy, windowed latency percentiles,
+prefetch hit rate) plus fleet-wide percentiles and SLO attainment states
+from the burn-rate engine (docs/observability.md "Fleet view"). Run:
+
+    python scripts/dynamo_top.py --url http://frontend-host:9090 \
+        [--interval 2] [--window 60] [--plain] [--once]
+
+Uses curses when stdout is a terminal; `--plain`/`--once` (or a pipe)
+fall back to plain text snapshots.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_STATE_GLYPH = {"OK": "ok", "WARN": "WARN", "BREACH": "BREACH"}
+
+
+def fetch_fleet(base_url: str, window_s: float = 0.0,
+                timeout_s: float = 5.0) -> dict:
+    url = base_url.rstrip("/") + "/debug/fleet"
+    if window_s > 0:
+        url += f"?window_s={window_s:g}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def _ms(block: dict, phase: str, pct: str) -> str:
+    p = (block or {}).get(phase)
+    if not p or p.get(pct) is None:
+        return "-"
+    return f"{p[pct] * 1000.0:.1f}"
+
+
+def _worker_slo(view: dict, wkey: str) -> str:
+    states = (((view.get("slo") or {}).get("workers") or {})
+              .get(wkey, {}).get("states") or {})
+    worst = "OK"
+    order = {"OK": 0, "WARN": 1, "BREACH": 2}
+    for s in states.values():
+        if order.get(s, 0) > order.get(worst, 0):
+            worst = s
+    return _STATE_GLYPH.get(worst, worst) if states else "-"
+
+
+def render(view: dict) -> list:
+    """The dashboard as a list of text lines (shared by plain + curses)."""
+    slo = view.get("slo") or {}
+    lines = [
+        f"dynamo_top — {view.get('n_workers', 0)} workers, window "
+        f"{view.get('window_s', 0):g}s, digests rx={view.get('received', 0)} "
+        f"dropped={view.get('dropped_stale', 0)}   SLO: "
+        f"{slo.get('state', '-')}"
+    ]
+    fleet_targets = slo.get("fleet") or {}
+    if fleet_targets:
+        parts = []
+        for name, t in sorted(fleet_targets.items()):
+            fast = (t.get("fast") or {}).get("value_s")
+            shown = f"{fast * 1000:.0f}ms" if fast is not None else "-"
+            parts.append(
+                f"{name}<{t.get('threshold_s', 0) * 1000:g}ms "
+                f"[{t.get('state', '-')}] now={shown}")
+        lines.append("  " + "  ".join(parts))
+    lines.append("")
+    hdr = (f"{'WORKER':<14} {'RUN':>4} {'WAIT':>4} {'KV%':>5} {'G2':>6} "
+           f"{'G3':>6} {'REQ':>6} {'TTFT99':>8} {'ITL50':>7} {'E2E95':>8} "
+           f"{'PFHIT%':>6} {'SLO':>6}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for wkey, row in sorted((view.get("workers") or {}).items()):
+        q = row.get("queue") or {}
+        kv = row.get("kv") or {}
+        pf = row.get("prefetch") or {}
+        phases = row.get("phases") or {}
+        hits = pf.get("hits", pf.get("hit", 0))
+        misses = pf.get("misses", pf.get("miss", 0))
+        total = (hits or 0) + (misses or 0)
+        pf_pct = f"{100.0 * hits / total:.0f}" if total else "-"
+        kv_usage = kv.get("g1_usage")
+        lines.append(
+            f"{wkey:<14} {q.get('n_running', 0):>4} {q.get('n_waiting', 0):>4} "
+            f"{(100.0 * kv_usage if kv_usage is not None else 0):>5.1f} "
+            f"{kv.get('g2_blocks', 0) or 0:>6} {kv.get('g3_blocks', 0) or 0:>6} "
+            f"{(row.get('counters') or {}).get('requests', 0):>6} "
+            f"{_ms(phases, 'ttft', 'p99_s'):>8} {_ms(phases, 'itl', 'p50_s'):>7} "
+            f"{_ms(phases, 'e2e', 'p95_s'):>8} {pf_pct:>6} "
+            f"{_worker_slo(view, wkey):>6}"
+        )
+    fleet_phases = ((view.get("fleet") or {}).get("phases")) or {}
+    if fleet_phases:
+        lines.append("")
+        lines.append(
+            f"{'fleet':<14} {'':>4} {'':>4} {'':>5} {'':>6} {'':>6} "
+            f"{sum((r.get('counters') or {}).get('requests', 0) for r in (view.get('workers') or {}).values()):>6} "
+            f"{_ms(fleet_phases, 'ttft', 'p99_s'):>8} "
+            f"{_ms(fleet_phases, 'itl', 'p50_s'):>7} "
+            f"{_ms(fleet_phases, 'e2e', 'p95_s'):>8}")
+    return lines
+
+
+def _plain_loop(args) -> int:
+    while True:
+        try:
+            view = fetch_fleet(args.url, args.window, args.timeout)
+            print("\n".join(render(view)), flush=True)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"fetch failed: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+        if args.once:
+            return 0
+        print(flush=True)
+        time.sleep(args.interval)
+
+
+def _curses_loop(args) -> int:
+    import curses
+
+    def run(scr):
+        curses.use_default_colors()
+        scr.nodelay(True)
+        scr.timeout(int(args.interval * 1000))
+        err = None
+        while True:
+            try:
+                view = fetch_fleet(args.url, args.window, args.timeout)
+                lines = render(view)
+                err = None
+            except (urllib.error.URLError, OSError) as e:
+                lines, err = [f"fetch failed: {e}"], e
+            scr.erase()
+            maxy, maxx = scr.getmaxyx()
+            for i, line in enumerate(lines[: maxy - 1]):
+                scr.addnstr(i, 0, line, maxx - 1)
+            scr.addnstr(maxy - 1, 0,
+                        "q to quit" + ("  (retrying)" if err else ""),
+                        maxx - 1)
+            scr.refresh()
+            ch = scr.getch()
+            if ch in (ord("q"), ord("Q")):
+                return 0
+
+    return curses.wrapper(run)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="frontend status base URL, e.g. http://host:9090")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--window", type=float, default=0.0,
+                    help="percentile window in seconds (0 = server default)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--plain", action="store_true",
+                    help="plain text snapshots instead of curses")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (implies --plain)")
+    args = ap.parse_args()
+    if args.once or args.plain or not sys.stdout.isatty():
+        return _plain_loop(args)
+    try:
+        return _curses_loop(args)
+    except ImportError:  # no curses on this platform
+        return _plain_loop(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

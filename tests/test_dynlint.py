@@ -374,6 +374,45 @@ def test_r004_recorder_blocking_io():
     assert _rules(src, path="dynamo_tpu/runtime/other.py") == []
 
 
+def test_r005_metric_label_cardinality():
+    # positive: per-request / per-object label NAMES at metric call sites
+    assert _rules("""
+        def f(metrics, rid, context, h):
+            metrics.counter("requests_total", "d", rid=rid).inc()
+            metrics.histogram("ttft_seconds", "d",
+                              request_id=context.id).observe(1.0)
+            metrics.gauge("kv_blocks", "d", block_hash=h).set(1)
+    """) == ["DYN-R005", "DYN-R005", "DYN-R005"]
+    # positive: bounded-looking NAME with an unbounded VALUE — a request
+    # id reaching a label through renaming or an f-string still leaks a
+    # series per request
+    assert _rules("""
+        import uuid
+
+        def f(metrics, context):
+            metrics.counter("x", "d", source=context.id).inc()
+            metrics.gauge("y", "d", origin=f"req-{context.id}").set(1)
+            metrics.child(worker=uuid.uuid4().hex)
+    """) == ["DYN-R005", "DYN-R005", "DYN-R005"]
+    # negative: the bounded label sets the codebase actually uses
+    assert _rules("""
+        def f(metrics, model, fam):
+            metrics.counter("requests_total", "d", model=model,
+                            finish="stop").inc()
+            metrics.gauge("slo_state", "d", slo="ttft_p99",
+                          window="fast").set(0)
+            metrics.histogram("phase_seconds", "d", phase="itl").observe(1)
+            metrics.child(dynamo_component="slo")
+            metrics.gauge("compile_variants", "d", family=fam).set(2)
+    """) == []
+    # negative: non-metric .child() calls (Context.child) take positional
+    # args and never label kwargs — out of scope
+    assert _rules("""
+        def f(ctx):
+            return ctx.child(f"{ctx.id}-c0")
+    """) == []
+
+
 # -- baseline ratchet -------------------------------------------------------
 
 

@@ -1,0 +1,504 @@
+"""Fleet observability plane: digest publish/aggregate, SLO burn-rate
+states, and the routing audit ring (ISSUE 6 satellite 4).
+
+The robustness contract under churn is the point of most of these: a
+worker that dies mid-window leaves its counted samples and then ages
+out; late/duplicate digests are dropped by seq, never double-counted;
+and a worker with a skewed wall clock cannot move fleet percentiles
+because windowing uses the observer's LOCAL receive time.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.planner.slo import (
+    BREACH,
+    OK,
+    WARN,
+    SloEngine,
+    SloPolicy,
+    SloTarget,
+    default_policy,
+    parse_slo_config,
+)
+from dynamo_tpu.runtime.fleet_observer import (
+    FLEET_DIGEST_SUBJECT,
+    HIST_BOUNDS,
+    HIST_NBOUNDS,
+    DigestBuilder,
+    DigestPublisher,
+    FleetObserver,
+    RoutingAudit,
+    hist_count,
+    hist_frac_over,
+    hist_observe,
+    hist_quantile,
+    merge_hist,
+    new_hist,
+    routing_debug_payload,
+)
+
+
+# -- mergeable histogram math -------------------------------------------------
+
+def _hist_of(values):
+    h = new_hist()
+    for v in values:
+        hist_observe(h, v)
+    return h
+
+
+def test_hist_observe_bucketing():
+    h = _hist_of([0.0, 0.0001, 0.00025])  # at/below the base bound
+    assert h[0] == 3
+    h = new_hist()
+    hist_observe(h, 1e9)  # absurd sample lands in the overflow bucket
+    assert h[HIST_NBOUNDS] == 1
+    assert hist_count(h) == 1
+
+
+def test_hist_quantile_brackets_true_value():
+    # log-spaced buckets: the estimate must land in the sample's bucket
+    for val in (0.0007, 0.013, 0.9):
+        h = _hist_of([val] * 100)
+        for q in (0.5, 0.95, 0.99):
+            est = hist_quantile(h, q)
+            assert est is not None
+            # within one bucket's bounds of the true value
+            assert est <= val * 2.0 and est >= val / 2.0, (val, q, est)
+
+
+def test_hist_quantile_empty_and_order():
+    assert hist_quantile(new_hist(), 0.5) is None
+    h = _hist_of([0.001] * 90 + [1.0] * 10)
+    p50, p99 = hist_quantile(h, 0.5), hist_quantile(h, 0.99)
+    assert p50 < 0.01 < p99
+
+
+def test_merge_hist_elementwise_and_version_skew():
+    a = _hist_of([0.001] * 5)
+    b = _hist_of([0.001] * 3 + [1.0] * 2)
+    merged = merge_hist([x for x in a], b)
+    assert hist_count(merged) == 10
+    # a version-skewed worker sending a SHORTER counts vector merges
+    # without error (clamped to the shared layout)
+    short = [1, 2, 3]
+    merged2 = merge_hist(new_hist(), short)
+    assert merged2[:3] == [1, 2, 3] and hist_count(merged2) == 6
+
+
+def test_hist_frac_over():
+    assert hist_frac_over(new_hist(), 0.1) is None
+    h = _hist_of([0.001] * 75 + [1.0] * 25)
+    frac = hist_frac_over(h, 0.02)
+    assert abs(frac - 0.25) < 0.01
+    # threshold above every bucket bound -> nothing over
+    assert hist_frac_over(h, HIST_BOUNDS[-1] * 4) == 0.0
+
+
+# -- DigestBuilder ------------------------------------------------------------
+
+class _Fpm:
+    def __init__(self, kind, scheduled_tokens=0, wall_time_s=0.0,
+                 n_running=0, n_waiting=0, kv_usage=0.0):
+        self.kind = kind
+        self.scheduled_tokens = scheduled_tokens
+        self.wall_time_s = wall_time_s
+        self.n_running = n_running
+        self.n_waiting = n_waiting
+        self.kv_usage = kv_usage
+
+
+def test_digest_builder_phases_and_counters():
+    b = DigestBuilder(0xabc, dp_rank=1)
+    b.observe_phases({"ttft_s": 0.2, "itl_s": [0.01, 0.02, 0.03],
+                      "e2e_s": 0.5, "ignored_s": 9.9})
+    b.observe_fpm(_Fpm("prefill", scheduled_tokens=128))
+    b.observe_fpm(_Fpm("decode", scheduled_tokens=8, wall_time_s=0.004,
+                       n_running=3, n_waiting=1, kv_usage=0.25))
+    d = b.build(period_s=2.0)
+    assert d["worker"] == [0xabc, 1] and d["seq"] == 1
+    assert d["period_s"] == 2.0
+    # phase keys lose the _s suffix; itl flattens its per-request list
+    assert hist_count(d["phases"]["ttft"]) == 1
+    assert hist_count(d["phases"]["itl"]) == 3
+    assert "ignored" not in d["phases"]
+    c = d["counters"]
+    assert c == {"requests": 1, "decode_tokens": 8, "prefill_tokens": 128,
+                 "decode_iters": 1, "decode_wall_s": 0.004}
+    assert d["queue"] == {"n_running": 3, "n_waiting": 1, "kv_usage": 0.25}
+    # build() closes the window: the next digest starts empty, seq bumps
+    d2 = b.build(period_s=2.0)
+    assert d2["seq"] == 2 and d2["phases"] == {}
+    assert d2["counters"]["requests"] == 0
+
+
+def test_digest_builder_engine_probe_is_getattr_guarded():
+    class _Engine:  # partial engine: no host_pool/prefetch/runner attrs
+        pass
+
+    d = DigestBuilder(1).build(engine=_Engine(), period_s=1.0)
+    assert d["kv"] == {"g1_usage": 0.0, "g2_blocks": 0, "g3_blocks": 0}
+    assert "prefetch" not in d and "compile" not in d
+
+
+# -- FleetObserver windowing / dedup / churn ---------------------------------
+
+def _digest(worker, seq, ts=1000.0, itl=None, counters=None):
+    phases = {}
+    if itl is not None:
+        phases["itl"] = _hist_of(itl)
+    d = {"worker": list(worker), "seq": seq, "ts": ts, "period_s": 2.0,
+         "phases": phases,
+         "queue": {"n_running": 1, "n_waiting": 0, "kv_usage": 0.1}}
+    if counters:
+        d["counters"] = counters
+    return d
+
+
+def test_ingest_drops_duplicates_and_late_arrivals():
+    obs = FleetObserver(None, window_s=60.0)
+    assert obs.ingest(_digest((1, 0), seq=1, itl=[0.01] * 4), now=0.0)
+    assert obs.ingest(_digest((1, 0), seq=2, itl=[0.01] * 4), now=1.0)
+    # duplicate (replayed) and late (out-of-order) digests are dropped —
+    # a redelivered digest must never double-count fleet samples
+    assert not obs.ingest(_digest((1, 0), seq=2, itl=[0.01] * 4), now=2.0)
+    assert not obs.ingest(_digest((1, 0), seq=1, itl=[0.01] * 4), now=3.0)
+    assert obs.received == 2 and obs.dropped_stale == 2
+    assert hist_count(obs.phase_hists(now=5.0)["itl"]) == 8
+    # a different worker's seq space is independent
+    assert obs.ingest(_digest((2, 0), seq=1), now=4.0)
+
+
+def test_clock_skew_does_not_corrupt_percentiles():
+    """Windowing is by LOCAL receive time: a worker whose wall clock is
+    hours off (ts in the past or future) still lands in the current
+    window, and its ts cannot evict other workers' samples."""
+    obs = FleetObserver(None, window_s=60.0)
+    obs.ingest(_digest((1, 0), seq=1, ts=1e12, itl=[0.01] * 50), now=100.0)
+    obs.ingest(_digest((2, 0), seq=1, ts=-5000.0, itl=[0.01] * 50), now=101.0)
+    obs.ingest(_digest((3, 0), seq=1, ts=2000.0, itl=[0.01] * 50), now=102.0)
+    view = obs.fleet(now=110.0)
+    assert view["n_workers"] == 3
+    ph = view["fleet"]["phases"]["itl"]
+    assert ph["n"] == 150
+    # all samples identical -> every percentile sits in the same bucket,
+    # regardless of the senders' claimed timestamps
+    assert 0.005 < ph["p50_s"] < 0.02 and 0.005 < ph["p99_s"] < 0.02
+
+
+def test_worker_death_mid_window_then_ages_out():
+    obs = FleetObserver(None, window_s=10.0)
+    obs.ingest(_digest((1, 0), seq=1, itl=[0.01] * 8), now=0.0)
+    obs.ingest(_digest((2, 0), seq=1, itl=[0.01] * 8), now=0.0)
+    obs.ingest(_digest((2, 0), seq=2, itl=[0.01] * 8), now=5.0)
+    # worker 1 died at t=0; its in-window samples still count at t=8
+    assert obs.workers(now=8.0) == [(1, 0), (2, 0)]
+    assert hist_count(obs.phase_hists(now=8.0)["itl"]) == 24
+    # past the window the dead worker drops out of the view...
+    assert obs.workers(now=12.0) == [(2, 0)]
+    # ...and past gone_after_s (3x window) its state is forgotten
+    assert obs.workers(now=45.0) == []
+    assert (1, 0) not in obs._digests
+    # a rebooted worker restarting at seq 1 is accepted again
+    assert obs.ingest(_digest((1, 0), seq=1), now=46.0)
+
+
+def test_fleet_payload_shape():
+    obs = FleetObserver(None, window_s=60.0)
+    obs.ingest(_digest((0xab, 1), seq=1, itl=[0.01] * 10,
+                       counters={"requests": 2, "decode_tokens": 20,
+                                 "prefill_tokens": 64, "decode_iters": 10,
+                                 "decode_wall_s": 0.1}), now=0.0)
+    obs.ingest(_digest((0xab, 1), seq=2, itl=[0.01] * 10,
+                       counters={"requests": 3, "decode_tokens": 30,
+                                 "prefill_tokens": 0, "decode_iters": 10,
+                                 "decode_wall_s": 0.1}), now=1.0)
+    view = obs.fleet(now=2.0)
+    row = view["workers"]["ab.1"]
+    assert row["digests"] == 2 and row["last_seq"] == 2
+    # counters sum across the window's digests
+    assert row["counters"]["requests"] == 5
+    assert row["counters"]["decode_tokens"] == 50
+    assert row["phases"]["itl"]["n"] == 20
+    assert view["received"] == 2 and view["dropped_stale"] == 0
+    # explicit narrower window re-filters (only the now=1.0 digest is
+    # newer than the 2.0 - 1.5 cutoff)
+    assert obs.fleet(now=2.0, window_s=1.5)["workers"]["ab.1"]["digests"] == 1
+
+
+def test_window_digests_adapter_surface():
+    obs = FleetObserver(None, window_s=60.0)
+    obs.ingest(_digest((1, 0), seq=1), now=0.0)
+    obs.ingest(_digest((1, 0), seq=2), now=30.0)
+    per = obs.window_digests(now=35.0, window_s=10.0)
+    assert [d["seq"] for d in per[(1, 0)]] == [2]
+
+
+# -- digest plumbing end-to-end over the in-proc event plane ------------------
+
+@pytest.mark.asyncio
+async def test_digest_publish_to_observer_roundtrip():
+    from dynamo_tpu.runtime.event_plane import (
+        InProcEventPublisher,
+        InProcEventSubscriber,
+    )
+
+    pub = InProcEventPublisher()
+    builder = DigestBuilder(7, dp_rank=0)
+    dp = DigestPublisher(builder, pub, period_s=5.0)  # manual publishes
+    sub = InProcEventSubscriber([FLEET_DIGEST_SUBJECT])
+    obs = FleetObserver(sub, window_s=60.0)
+    obs.connect_publisher(dp.address)
+    await obs.start()
+    try:
+        builder.observe_phases({"ttft_s": 0.1, "itl_s": [0.01, 0.02]})
+        await dp.publish_once()
+        await dp.publish_once()  # empty window: still a valid digest
+        for _ in range(100):
+            if obs.received >= 2:
+                break
+            await asyncio.sleep(0.01)
+        assert obs.received == 2 and dp.published == 2
+        view = obs.fleet()
+        assert view["workers"]["7.0"]["phases"]["ttft"]["n"] == 1
+        assert view["fleet"]["phases"]["itl"]["n"] == 2
+    finally:
+        await obs.stop()
+        await dp.stop(flush=False)
+
+
+# -- SLO attainment engine ----------------------------------------------------
+
+def _policy():
+    # itl p50 < 20ms; allowed fraction 0.5 -> burn = frac_over / 0.5
+    return SloPolicy(targets=[SloTarget("itl", 0.5, 0.02)],
+                     fast_window_s=30.0, slow_window_s=120.0,
+                     breach_burn=1.0, min_samples=8)
+
+
+GOOD = [0.005] * 100   # all under threshold
+BAD = [1.0] * 100      # all over threshold
+
+
+def test_slo_abstains_below_min_samples():
+    obs = FleetObserver(None, window_s=120.0)
+    slo = SloEngine(obs, _policy())
+    # empty observer: no data -> OK (abstain), burns are None
+    view = slo.evaluate(now=0.0)
+    assert view["state"] == OK
+    t = view["fleet"]["itl_p50"]
+    assert t["fast"]["burn"] is None and t["slow"]["burn"] is None
+    # under min_samples: still abstains even though every sample is bad
+    obs.ingest(_digest((1, 0), seq=1, itl=[1.0] * 7), now=0.0)
+    assert slo.evaluate(now=1.0)["state"] == OK
+
+
+def test_slo_ok_warn_breach_recovery_cycle():
+    """The acceptance transition: healthy -> burst (fast window trips,
+    slow still diluted -> WARN) -> sustained (both windows -> BREACH) ->
+    burst ages out -> OK again."""
+    obs = FleetObserver(None, window_s=120.0)
+    slo = SloEngine(obs, _policy())
+    w = (1, 0)
+
+    # t=0..90: healthy traffic
+    obs.ingest(_digest(w, seq=1, itl=GOOD), now=0.0)
+    obs.ingest(_digest(w, seq=2, itl=GOOD), now=60.0)
+    obs.ingest(_digest(w, seq=3, itl=GOOD), now=90.0)
+    v = slo.evaluate(now=100.0)
+    assert v["state"] == OK
+    assert v["workers"]["1.0"]["states"]["itl_p50"] == OK
+
+    # t=110: a burst lands. Fast window [80,110] holds 100 good + 100
+    # bad (burn 1.0 -> burning); slow window [-10,110] holds 300 good +
+    # 100 bad (frac 0.25, burn 0.5 -> not burning): WARN, not a page.
+    obs.ingest(_digest(w, seq=4, itl=BAD), now=110.0)
+    v = slo.evaluate(now=110.0)
+    t = v["fleet"]["itl_p50"]
+    assert t["fast"]["burn"] >= 1.0 and t["slow"]["burn"] < 1.0
+    assert v["state"] == WARN
+
+    # t=115..125: the burst sustains; slow window is now majority-bad
+    obs.ingest(_digest(w, seq=5, itl=BAD), now=115.0)
+    obs.ingest(_digest(w, seq=6, itl=BAD), now=120.0)
+    obs.ingest(_digest(w, seq=7, itl=BAD), now=125.0)
+    v = slo.evaluate(now=126.0)
+    t = v["fleet"]["itl_p50"]
+    assert t["fast"]["burn"] >= 1.0 and t["slow"]["burn"] >= 1.0
+    assert v["state"] == BREACH
+    assert v["workers"]["1.0"]["states"]["itl_p50"] == BREACH
+
+    # t=200: fresh healthy traffic; the bad digests age out of the fast
+    # window first (recovery passes back through WARN territory), and
+    # once they leave the slow window too the state returns to OK
+    obs.ingest(_digest(w, seq=8, itl=GOOD), now=200.0)
+    obs.ingest(_digest(w, seq=9, itl=GOOD), now=210.0)
+    v = slo.evaluate(now=220.0)
+    assert v["fleet"]["itl_p50"]["fast"]["burn"] < 1.0
+    v = slo.evaluate(now=300.0)
+    assert v["state"] == OK
+
+
+def test_slo_fleet_state_is_worst_target():
+    pol = SloPolicy(targets=[SloTarget("itl", 0.5, 0.02),
+                             SloTarget("ttft", 0.5, 10.0)],
+                    fast_window_s=30.0, slow_window_s=30.0, min_samples=8)
+    obs = FleetObserver(None, window_s=60.0)
+    d = _digest((1, 0), seq=1, itl=BAD)
+    d["phases"]["ttft"] = _hist_of([0.1] * 100)  # well under its target
+    obs.ingest(d, now=0.0)
+    v = SloEngine(obs, pol).evaluate(now=1.0)
+    assert v["fleet"]["ttft_p50"]["state"] == OK
+    assert v["fleet"]["itl_p50"]["state"] == BREACH
+    assert v["state"] == BREACH
+
+
+def test_slo_metrics_export_uses_bounded_labels():
+    from dynamo_tpu.runtime.metrics import MetricsHierarchy
+
+    obs = FleetObserver(None, window_s=60.0)
+    obs.ingest(_digest((1, 0), seq=1, itl=BAD), now=0.0)
+    slo = SloEngine(obs, _policy())
+    metrics = MetricsHierarchy()
+    slo.bind_metrics(metrics)
+    slo.evaluate(now=1.0)
+    text = metrics.render()
+    if isinstance(text, bytes):
+        text = text.decode()
+    assert 'slo_state{' in text and 'slo="itl_p50"' in text
+    assert 'slo_burn_rate{' in text and 'window="fast"' in text
+
+
+def test_parse_slo_config_forms():
+    # None/empty -> defaults
+    assert len(parse_slo_config(None).targets) == 3
+    assert parse_slo_config("").targets == default_policy().targets
+    # compact CLI form
+    pol = parse_slo_config("ttft:p99<0.5, itl:p50<0.02")
+    assert [(t.phase, t.percentile, t.threshold_s) for t in pol.targets] == \
+        [("ttft", 0.99, 0.5), ("itl", 0.5, 0.02)]
+    assert pol.targets[0].name == "ttft_p99"
+    # dict / JSON forms
+    cfg = {"targets": [{"phase": "e2e", "percentile": 0.95,
+                        "threshold_s": 4.0}],
+           "fast_window_s": 10, "slow_window_s": 40, "breach_burn": 2.0}
+    for spec in (cfg, __import__("json").dumps(cfg)):
+        pol = parse_slo_config(spec)
+        assert pol.targets[0].phase == "e2e"
+        assert pol.fast_window_s == 10.0 and pol.breach_burn == 2.0
+    # dict with no targets falls back to defaults; passthrough; errors
+    assert len(parse_slo_config({}).targets) == 3
+    assert parse_slo_config(pol) is pol
+    with pytest.raises(ValueError):
+        parse_slo_config("ttft-p99-0.5")
+    with pytest.raises(TypeError):
+        parse_slo_config(42)
+
+
+# -- routing audit ring -------------------------------------------------------
+
+def test_routing_audit_ring_bounds_and_rid_join():
+    audit = RoutingAudit(capacity=8)
+    for i in range(20):
+        audit.record(f"req-{i}", "kv", [i, 0],
+                     candidates=[{"worker": [i, 0], "chosen": True}],
+                     overlap_blocks=i)
+    assert len(audit) == 8 and audit.recorded == 20
+    # the ring keeps the newest entries
+    assert [e["rid"] for e in audit.query(last_n=2)] == ["req-18", "req-19"]
+    # rid join: decision joins to that request's phase spine by id
+    hits = audit.query(rid="req-15")
+    assert len(hits) == 1 and hits[0]["overlap_blocks"] == 15
+    assert hits[0]["chosen"] == [15, 0]
+    assert audit.query(rid="req-0") == []  # evicted
+
+
+def test_routing_debug_payload_merges_routers():
+    kv, push = RoutingAudit(), RoutingAudit()
+    kv.record("r1", "kv", [1, 0], candidates=[{"worker": [1, 0]}])
+    push.record("r1", "round_robin", 2)
+    push.record("r2", "round_robin", 3)
+    payload = routing_debug_payload({"m/kv": kv, "m/push": push})
+    assert payload["n"] == 3 and payload["recorded"] == 3
+    routers = {d["router"] for d in payload["decisions"]}
+    assert routers == {"m/kv", "m/push"}
+    # ts-sorted across rings
+    ts = [d["ts"] for d in payload["decisions"]]
+    assert ts == sorted(ts)
+    # rid filter joins the SAME request across both routers
+    joined = routing_debug_payload({"m/kv": kv, "m/push": push}, rid="r1")
+    assert payload_rids(joined) == ["r1", "r1"]
+    # last_n bounds the merged view
+    assert routing_debug_payload({"m/kv": kv, "m/push": push},
+                                 last_n=1)["n"] == 1
+
+
+def payload_rids(payload):
+    return [d["rid"] for d in payload["decisions"]]
+
+
+def test_selector_audit_capture():
+    """WorkerSelector.select fills the audit list with one scored entry
+    per candidate and flags the chosen one."""
+    from dynamo_tpu.router.protocols import OverlapScores
+    from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector
+    from dynamo_tpu.router.sequences import ActiveSequences
+
+    sel = WorkerSelector(KvRouterConfig())
+    workers = [(1, 0), (2, 0)]
+    audit = []
+    best, overlap = sel.select(
+        workers,
+        total_blocks=8,
+        overlaps=OverlapScores(scores={(1, 0): 4}, total_blocks=8),
+        sequences=ActiveSequences(),
+        audit=audit,
+    )
+    assert best == (1, 0) and overlap == 4  # cache-greedy argmin
+    assert len(audit) == 2
+    assert sum(1 for e in audit if e["chosen"]) == 1
+    chosen = next(e for e in audit if e["chosen"])
+    assert tuple(chosen["worker"]) == best
+    for e in audit:
+        assert {"worker", "overlap_blocks", "credit", "new_blocks",
+                "cost", "chosen"} <= set(e)
+    # the cheaper candidate is the one with overlap credit
+    costs = {tuple(e["worker"]): e["cost"] for e in audit}
+    assert costs[(1, 0)] < costs[(2, 0)]
+
+
+# -- /debug/<name> plumbing on the status server ------------------------------
+
+@pytest.mark.asyncio
+async def test_status_server_debug_routes():
+    import aiohttp
+
+    from dynamo_tpu.runtime.metrics import MetricsHierarchy
+    from dynamo_tpu.runtime.status import StatusServer
+
+    class _Rt:
+        metrics = MetricsHierarchy()
+
+    srv = StatusServer(_Rt(), port=0, host="127.0.0.1")
+    srv.add_debug("fleet", lambda q: {"echo": q.get("window_s", "default")})
+
+    def _boom(q):
+        raise RuntimeError("source exploded")
+
+    srv.add_debug("routing", _boom)
+    base = await srv.start()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(base + "/debug/fleet?window_s=5") as resp:
+                assert resp.status == 200
+                assert (await resp.json()) == {"echo": "5"}
+            async with sess.get(base + "/debug/fleet") as resp:
+                assert (await resp.json()) == {"echo": "default"}
+            # a throwing source surfaces as 500 + error JSON, not a crash
+            async with sess.get(base + "/debug/routing") as resp:
+                assert resp.status == 500
+                assert "source exploded" in (await resp.json())["error"]
+    finally:
+        await srv.stop()
