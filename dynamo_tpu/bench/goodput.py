@@ -104,6 +104,7 @@ def _make_engine(args, mocker: bool):
                 speed=args.sim_speed,
                 prefill_cost=getattr(args, "sim_prefill_cost", "ragged"),
             ),
+            spec_accept_rate=getattr(args, "spec_accept_rate", None),
         )
     else:
         from dynamo_tpu.engine.model_runner import ModelRunner
@@ -131,6 +132,9 @@ def _make_engine(args, mocker: bool):
         prefetch=getattr(args, "prefetch", False),
         prefetch_max_inflight=getattr(args, "prefetch_max_inflight", 4),
         prefetch_bandwidth_mbps=getattr(args, "prefetch_bandwidth_mbps", 0.0),
+        spec_ngram=getattr(args, "spec_ngram", False),
+        spec_k=getattr(args, "spec_k", 4),
+        spec_max_tokens=getattr(args, "spec_max_tokens", 0),
     )
 
 
@@ -306,6 +310,7 @@ async def run_goodput(args) -> GoodputReport:
         # variants <= |T buckets|) is checked off this artifact
         compile_stats = {}
         sim_stats = {}
+        spec_stats = {}
         for w in stack.workers:
             runner = getattr(w.engine, "runner", None)
             if hasattr(runner, "compile_stats"):
@@ -317,6 +322,8 @@ async def run_goodput(args) -> GoodputReport:
                         agg[k] += st.get(k, 0)
             for k, v in getattr(runner, "stats", {}).items():
                 sim_stats[k] = sim_stats.get(k, 0) + v
+            for k, v in getattr(w.engine, "spec_stats", {}).items():
+                spec_stats[k] = spec_stats.get(k, 0) + v
         # fleet digest ride-along: flush each worker's tail window, then
         # snapshot the observer + SLO attainment before teardown
         fleet_view = slo_view = None
@@ -345,6 +352,17 @@ async def run_goodput(args) -> GoodputReport:
         }
     if sim_stats:
         report.extras["sim"] = sim_stats
+    if spec_stats.get("verify_iters"):
+        report.extras["spec"] = {
+            **spec_stats,
+            "accept_rate": round(
+                spec_stats["accepted"] / max(1, spec_stats["drafted"]), 4
+            ),
+            "tokens_per_step": round(
+                spec_stats["spec_emitted"]
+                / max(1, spec_stats["verify_rows"]), 4
+            ),
+        }
     if fleet_view is not None:
         report.extras["fleet"] = {
             "n_workers": fleet_view["n_workers"],
@@ -457,6 +475,17 @@ def parse_args(argv=None):
                         "(1 = legacy single-chunk MixedPlan)")
     p.add_argument("--mixed-min-chunk", type=int, default=16,
                    help="fair-share floor per packed prefill sequence")
+    p.add_argument("--spec-ngram", action="store_true",
+                   help="speculative decoding: n-gram drafts verified as "
+                        "ragged rows of the mixed dispatch")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft length K per speculating sequence")
+    p.add_argument("--spec-max-tokens", type=int, default=0,
+                   help="per-iteration drafted-token cap (0 = leftover "
+                        "mixed prefill budget)")
+    p.add_argument("--spec-accept-rate", type=float, default=None,
+                   help="mocker-only oracle drafter accept rate (A/B knob; "
+                        "overrides n-gram lookup)")
     p.add_argument("--host-kv-blocks", type=int, default=0)
     p.add_argument("--disk-kv-blocks", type=int, default=0)
     p.add_argument("--prefetch", action="store_true",

@@ -37,6 +37,10 @@ from dynamo_tpu.engine.scheduler import (
     Sequence,
     SeqState,
 )
+from dynamo_tpu.engine.ngram_draft import (
+    accept_deterministic,
+    propose as ngram_propose,
+)
 from dynamo_tpu.frontend.protocols import engine_output
 from dynamo_tpu.runtime.annotations import annotate
 from dynamo_tpu.runtime.context import Context
@@ -95,6 +99,12 @@ class InferenceEngine:
         anomaly_dump_dir: Optional[str] = None,  # None = count, don't dump
         anomaly_dump_last_n: int = 256,  # ring records per anomaly dump
         anomaly_profile_ms: int = 0,  # >0: jax.profiler window per dump
+        spec_ngram: bool = False,  # n-gram/prompt-lookup speculative
+        #   decoding: draft from each sequence's own token history, verify
+        #   as K+1-token ragged rows in the mixed dispatch
+        spec_k: int = 4,  # draft tokens proposed per sequence per step
+        spec_max_tokens: int = 0,  # per-iteration cap on drafted tokens
+        #   (0 = bounded only by the mixed pool leftover)
     ):
         self.runner = runner
         # fused mixed dispatch (one program per iteration instead of two):
@@ -179,7 +189,37 @@ class InferenceEngine:
             mixed_min_chunk=mixed_min_chunk,
             host_tier=self.host_pool,
             host_onboard=self._onboard_from_host if self.host_pool is not None else None,
+            spec_max_tokens=spec_max_tokens,
+            # ragged runners sample at most seg_cap rows per dispatch;
+            # budgeting verify tokens to RAGGED_MAX_SEGS (= 96, minus one
+            # slot per decode row / chunk) keeps every verify dispatch
+            # inside the gather the compiled program already has — the
+            # no-new-compile-families invariant (docs/ragged_attention.md)
+            spec_seg_budget=(
+                96 if hasattr(runner, "ensure_ragged_bucket") else 0
+            ),
         )
+        # n-gram speculative decoding (docs/spec_decode.md): drafts ride
+        # the mixed dispatch as ragged verify rows, so both the runner
+        # verify hook and a non-zero mixed pool are required
+        self.spec_ngram = bool(spec_ngram)
+        self.spec_k = max(1, int(spec_k))
+        self._spec_on = (
+            self.spec_ngram
+            and mixed_prefill_tokens > 0
+            and hasattr(runner, "verify_spec")
+        )
+        if self.spec_ngram and not self._spec_on:
+            log.warning(
+                "spec_ngram requested but unavailable "
+                "(runner verify_spec=%s, mixed_prefill_tokens=%d); disabled",
+                hasattr(runner, "verify_spec"), mixed_prefill_tokens,
+            )
+        # cumulative counters for goodput extras["spec"] / fleet digests
+        self.spec_stats = {
+            "drafted": 0, "accepted": 0, "rejected": 0,
+            "verify_rows": 0, "verify_iters": 0, "spec_emitted": 0,
+        }
         # The scheduler caps a mixed plan at max_batch decode rows +
         # mixed_prefill_tokens chunk tokens, so registering that exact sum
         # as a ragged T bucket makes the token budget BE the compile
@@ -617,6 +657,7 @@ class InferenceEngine:
         from dynamo_tpu.parallel.multihost import GroupBroken
 
         self._drain_inbox()
+        self._propose_drafts()
         plan = self.scheduler.step_plan()
         if plan is None:
             if not self.scheduler.has_work():
@@ -627,7 +668,8 @@ class InferenceEngine:
         # plan-composition fields for this iteration's flight record;
         # branches fill in what they actually served
         rinfo = {"decode_seqs": 0, "decode_steps": 0, "n_chunks": 0,
-                 "chunk_tokens": 0, "fused": False, "ragged": False}
+                 "chunk_tokens": 0, "fused": False, "ragged": False,
+                 "spec_rows": 0, "spec_drafted": 0, "spec_emitted": 0}
         decode_done = False
         try:
             if isinstance(plan, PrefillPlan):
@@ -635,7 +677,55 @@ class InferenceEngine:
                 kind, n_tok = "prefill", len(plan.chunk)
                 rinfo.update(n_chunks=1, chunk_tokens=len(plan.chunk))
             elif isinstance(plan, MixedPlan):
-                if self._mixed_fusible(plan):
+                spec = any(s.spec_draft for s in plan.decode.seqs)
+                if spec and self._mixed_fusible(plan):
+                    # verify rows + packed prefill chunks share ONE ragged
+                    # flat-token dispatch (the tentpole path)
+                    res = self._run_spec_verify(plan.decode, plan.prefills)
+                    if res is None:
+                        spec = False  # drafts shed; plain paths below
+                    else:
+                        chunk_logits, sinfo = res
+                        served = plan.prefills[:len(chunk_logits)]
+                        rinfo.update(
+                            decode_seqs=len(plan.decode.seqs),
+                            decode_steps=1,
+                            n_chunks=len(served),
+                            chunk_tokens=sum(len(p.chunk) for p in served),
+                            fused=True, ragged=True, **sinfo,
+                        )
+                        decode_done = True
+                        self._finish_packed_prefills(served, chunk_logits)
+                        kind = "mixed"
+                        n_tok = (len(plan.decode.seqs) + sinfo["spec_drafted"]
+                                 + sum(len(p.chunk) for p in served))
+                elif spec:
+                    # two-dispatch split (cpu / non-fused runners): the
+                    # verify dispatch serves the decode half, the packed
+                    # prefill path serves the chunks
+                    res = self._run_spec_verify(plan.decode, [])
+                    if res is None:
+                        spec = False
+                    else:
+                        _, sinfo = res
+                        decode_done = True
+                        t1 = time.monotonic()
+                        self._publish_fpm(
+                            "decode", t1 - t0, len(plan.decode.seqs)
+                        )
+                        self._run_prefills(plan.prefills)
+                        kind = "prefill"
+                        n_tok = sum(len(p.chunk) for p in plan.prefills)
+                        t0 = t1
+                        rinfo.update(
+                            decode_seqs=len(plan.decode.seqs),
+                            decode_steps=1,
+                            n_chunks=len(plan.prefills),
+                            chunk_tokens=n_tok, **sinfo,
+                        )
+                if spec:
+                    pass  # served above
+                elif self._mixed_fusible(plan):
                     chunk_logits = self._run_mixed_dispatch(plan)
                     served = plan.prefills[:len(chunk_logits)]
                     rinfo.update(
@@ -653,27 +743,7 @@ class InferenceEngine:
                     # (e.g. in a chunk's sampling extras) must only
                     # fail the prefill sequences
                     decode_done = True
-                    for pplan, lg in zip(plan.prefills, chunk_logits):
-                        # per-chunk isolation: one chunk's sampling extras
-                        # failing must not error sibling prefills whose KV
-                        # landed in the same dispatch
-                        try:
-                            self.scheduler.complete_prefill(pplan)
-                            self._finish_prefill(pplan, lg)
-                        except GroupBroken:
-                            raise
-                        except Exception:
-                            log.exception(
-                                "packed chunk bookkeeping failed; erroring %s",
-                                pplan.seq.request_id,
-                            )
-                            try:
-                                self._emit(pplan.seq, [], "error")
-                                self.scheduler.abort(pplan.seq.request_id)
-                            except Exception:
-                                log.exception("failed to fail sequence %s",
-                                              pplan.seq.request_id)
-                            self._recover_poisoned_pools()
+                    self._finish_packed_prefills(plan.prefills, chunk_logits)
                     # one dispatch ran both halves — a per-kind wall split
                     # doesn't exist; observers ignore the mixed kind
                     kind = "mixed"
@@ -701,10 +771,20 @@ class InferenceEngine:
                         chunk_tokens=n_tok,
                     )
             else:
-                self._run_decode(plan)
-                kind, n_tok = "decode", len(plan.seqs)
-                rinfo.update(decode_seqs=len(plan.seqs),
-                             decode_steps=plan.n_steps)
+                res = None
+                if any(s.spec_draft for s in plan.seqs):
+                    res = self._run_spec_verify(plan, [])
+                if res is not None:
+                    _, sinfo = res
+                    kind = "decode"
+                    n_tok = len(plan.seqs) + sinfo["spec_drafted"]
+                    rinfo.update(decode_seqs=len(plan.seqs),
+                                 decode_steps=1, **sinfo)
+                else:
+                    self._run_decode(plan)
+                    kind, n_tok = "decode", len(plan.seqs)
+                    rinfo.update(decode_seqs=len(plan.seqs),
+                                 decode_steps=plan.n_steps)
         except GroupBroken:
             raise  # unrecoverable: handled by _loop's fail-fast
         except Exception:
@@ -792,6 +872,10 @@ class InferenceEngine:
             prefetch_hits=hits,
             compile_variants=variants,
             compile_calls=calls,
+            accepted_per_step=(
+                rinfo.get("spec_emitted", 0) / rinfo["spec_rows"]
+                if rinfo.get("spec_rows") else 0.0
+            ),
         ))
 
     def _recover_poisoned_pools(self) -> None:
@@ -1282,6 +1366,165 @@ class InferenceEngine:
             logprobs=lp_entries,
         )
 
+    def _finish_packed_prefills(self, prefills, chunk_logits) -> None:
+        """Bookkeeping for prefill chunks whose KV landed in a shared
+        dispatch, with per-chunk isolation: one chunk's sampling extras
+        failing must not error sibling prefills (or the already-emitted
+        decode half)."""
+        from dynamo_tpu.parallel.multihost import GroupBroken
+
+        for pplan, lg in zip(prefills, chunk_logits):
+            try:
+                self.scheduler.complete_prefill(pplan)
+                self._finish_prefill(pplan, lg)
+            except GroupBroken:
+                raise
+            except Exception:
+                log.exception(
+                    "packed chunk bookkeeping failed; erroring %s",
+                    pplan.seq.request_id,
+                )
+                try:
+                    self._emit(pplan.seq, [], "error")
+                    self.scheduler.abort(pplan.seq.request_id)
+                except Exception:
+                    log.exception("failed to fail sequence %s",
+                                  pplan.seq.request_id)
+                self._recover_poisoned_pools()
+
+    # -- speculative decoding (n-gram drafting + ragged verify) -------------
+    def _warn_spec_once(self, rid: str, what: str) -> None:
+        """One-shot (per request) warning that speculation was degraded;
+        the set is pruned when the request finishes or aborts, so a
+        long-lived worker's memory stays bounded."""
+        if rid in self._spec_sampling_warned:
+            return
+        self._spec_sampling_warned.add(rid)
+        log.warning("request %s: %s", rid, what)
+
+    def _propose_drafts(self) -> None:
+        """Propose this iteration's draft tokens (step thread, before
+        step_plan so the scheduler can charge them against the mixed
+        pool). Speculation is opportunistic per iteration: any running
+        sequence needing sampling extras the verify dispatch cannot
+        honor (masks, logprobs, penalties, bias) pauses speculation for
+        the whole batch — the verify program samples every row with the
+        plain keyed sampler, so partial speculation would silently drop
+        a sibling's extras."""
+        running = [
+            s for s in self.scheduler.active if s.state == SeqState.RUNNING
+        ]
+        for s in running:
+            s.spec_draft = []
+        if not self._spec_on or not running:
+            return
+        blocked = [
+            s for s in running
+            if s.guided_m is not None
+            or s.logit_bias
+            or _batch_logprobs([s]) >= 0
+            or _batch_penalties([s])
+        ]
+        if blocked:
+            for s in blocked:
+                self._warn_spec_once(
+                    s.request_id,
+                    "guided/logprobs/penalties/bias sampling is "
+                    "incompatible with speculative verification — "
+                    "speculation paused while this request is in the batch",
+                )
+            return
+        oracle = getattr(self.runner, "spec_draft", None)
+        for s in running:
+            draft = None
+            if oracle is not None:
+                draft = oracle(s.tokens[-1], s.computed_len, self.spec_k)
+            if draft is None:
+                draft = ngram_propose(s.tokens, self.spec_k)
+            s.spec_draft = [int(t) for t in draft] if draft else []
+
+    def _run_spec_verify(self, dplan: DecodePlan, prefills):
+        """ONE ragged flat-token dispatch verifying every speculating
+        row's draft (a K+1-token segment: the last real token + K draft
+        tokens) alongside the plain decode rows and, on fused runners,
+        the packed prefill chunks. Acceptance is the deterministic
+        (one-hot q) specialization of spec_decode.accept_and_finalize:
+        emit target samples through the first mismatch (+ bonus token on
+        a full match), so temperature-0 output is byte-identical to
+        plain decode. Rejected drafts cost nothing durable — their KV
+        sits past computed_len on unshared pages and the next step
+        overwrites it, so pages never leak and the prefix-hash lineage
+        (tokens/hashing.py) only ever advances over committed tokens.
+
+        Returns (chunk_logits, rinfo_spec) or None when the runner
+        can't shape the dispatch (drafts are dropped; the caller reruns
+        the plain path)."""
+        if hasattr(self.runner, "ensure_ragged_bucket"):
+            from dynamo_tpu.engine.model_runner import BucketOverflowError
+        else:
+            # SimRunner buckets saturate instead of overflowing, and the
+            # mocker process must stay jax-free — catch nothing there
+            BucketOverflowError = ()
+
+        seqs = dplan.seqs
+        drafts = [list(s.spec_draft) for s in seqs]
+        for s in seqs:
+            s.spec_draft = []  # consumed (or shed) either way
+        tokens = [s.tokens[-1] for s in seqs]
+        positions = [s.computed_len for s in seqs]
+        tables = [s.pages for s in seqs]
+        step0 = self._next_step()
+        chunks = [
+            {
+                "tokens": p.chunk, "start": p.start_pos,
+                "table": p.seq.pages, "prior": p.start_pos,
+                "adapter": p.seq.adapter_idx,
+            }
+            for p in prefills
+        ]
+        n_drafted = sum(len(d) for d in drafts)
+        with annotate("engine.spec_verify", batch=len(seqs),
+                      drafted=n_drafted, chunks=len(chunks)):
+            try:
+                rows, chunk_logits = self.runner.verify_spec(
+                    tokens, positions, tables, drafts,
+                    _sampling_params(seqs), step0, chunks=chunks,
+                )
+            except BucketOverflowError as e:
+                log.warning(
+                    "spec verify overflows runner buckets (%s); dropping "
+                    "this iteration's drafts", e,
+                )
+                return None
+            n_rows = sum(1 for d in drafts if d)
+            accepted = emitted_spec = 0
+            for i, seq in enumerate(seqs):
+                emitted = accept_deterministic(drafts[i], rows[i])
+                if drafts[i]:
+                    accepted += len(emitted) - 1
+                    emitted_spec += len(emitted)
+                emit: List[int] = []
+                reason = None
+                for token in emitted:
+                    reason = self.scheduler.complete_decode(seq, token)
+                    if reason != "stop":
+                        emit.append(token)
+                    if reason:
+                        break
+                self._emit(seq, emit, reason)
+        st = self.spec_stats
+        st["verify_iters"] += 1
+        st["verify_rows"] += n_rows
+        st["drafted"] += n_drafted
+        st["accepted"] += accepted
+        st["rejected"] += n_drafted - accepted
+        st["spec_emitted"] += emitted_spec
+        return chunk_logits, {
+            "spec_rows": n_rows,
+            "spec_drafted": n_drafted,
+            "spec_emitted": emitted_spec,
+        }
+
     def _mixed_fusible(self, plan: MixedPlan) -> bool:
         """Whether this MixedPlan can run as ONE dispatch (runner
         decode_multi_with_prefill). Feature planes the fused program
@@ -1417,19 +1660,26 @@ class InferenceEngine:
         page_tables = [s.pages for s in seqs]
         step0 = self._step_counter + 1
         gamma = getattr(self.runner, "spec_gamma", 0)
-        if getattr(self.runner, "has_draft", False):
-            # the speculative verify distribution must equal the draft's
-            # view of the model, so penalties/logprobs are NOT applied on
-            # this path — surface the drop instead of silently ignoring it
-            if _batch_logprobs(seqs) >= 0 or _batch_penalties(seqs):
-                for s in seqs:
-                    if s.request_id not in self._spec_sampling_warned:
-                        self._spec_sampling_warned.add(s.request_id)
-                        log.warning(
-                            "request %s: logprobs/penalties are unsupported "
-                            "with speculative decoding and were ignored",
-                            s.request_id,
-                        )
+        use_draft_spec = getattr(self.runner, "has_draft", False)
+        if use_draft_spec and (
+            _batch_logprobs(seqs) >= 0 or _batch_penalties(seqs)
+        ):
+            # the speculative verify distribution can't honor
+            # logprobs/penalties: warn once per offending request and
+            # fall back to the PLAIN decode path below, which does. The
+            # draft model's KV pools skip these positions — that costs
+            # draft acceptance on later iterations (verify still
+            # corrects every token), never correctness.
+            for s in seqs:
+                if _batch_logprobs([s]) >= 0 or _batch_penalties([s]):
+                    self._warn_spec_once(
+                        s.request_id,
+                        "logprobs/penalties are incompatible with "
+                        "speculative verification — falling back to "
+                        "non-speculative decode",
+                    )
+            use_draft_spec = False
+        if use_draft_spec:
             # (guided requests were rejected at admission on draft workers,
             # so no mask handling is needed on this path)
             # speculative path: R fused draft-propose + target-verify
@@ -1489,12 +1739,11 @@ class InferenceEngine:
             # above) instead of letting a raise inside the shared dispatch
             # error EVERY sequence in the plan
             for s in seqs:
-                if s.request_id not in self._spec_sampling_warned:
-                    self._spec_sampling_warned.add(s.request_id)
-                    log.warning(
-                        "request %s: logprobs/penalties are unsupported on "
-                        "pipeline-parallel workers and were ignored",
+                if _batch_logprobs([s]) >= 0 or _batch_penalties([s]):
+                    self._warn_spec_once(
                         s.request_id,
+                        "logprobs/penalties are unsupported on "
+                        "pipeline-parallel workers and were ignored",
                     )
             n_lp, histories = -1, None
         lp = None
@@ -1572,8 +1821,14 @@ class InferenceEngine:
                 if seq.arrival:
                     seq.phases["ttft_s"] = max(0.0, now - seq.arrival)
             elif seq.t_last_emit and len(seq.itl) < _ITL_CAP:
-                seq.itl.append(
-                    max(0.0, now - seq.t_last_emit) / len(token_ids))
+                # a multi-token group (fused steps, accepted speculative
+                # drafts) contributes ONE ITL sample PER TOKEN — the step
+                # wall divided across the group — so itl percentiles, SLO
+                # burn rates, and goodput weight a 4-token step as 4 fast
+                # inter-token gaps, not one slow one
+                per = max(0.0, now - seq.t_last_emit) / len(token_ids)
+                n = min(len(token_ids), _ITL_CAP - len(seq.itl))
+                seq.itl.extend([per] * n)
             seq.t_last_emit = now
         self._emit_item(seq, engine_output(token_ids, finish, **extra))
 

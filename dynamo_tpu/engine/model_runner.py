@@ -1189,6 +1189,138 @@ class ModelRunner:
         chunk_logits = seg_logits[n_dec : n_dec + len(chunks)]  # [N, V]
         return toks, chunk_logits
 
+    def verify_spec(
+        self,
+        tokens: List[int],
+        positions: List[int],
+        page_tables: List[List[int]],
+        drafts: List[List[int]],
+        sampling,
+        step: int,
+        chunks: Sequence[Dict[str, Any]] = (),
+    ) -> Tuple[List[np.ndarray], jax.Array]:
+        """One speculative-verify iteration through the SAME _jit_ragged
+        program as the mixed path — zero new compile families or
+        variants, by construction.
+
+        Each speculating sequence contributes ONE segment of q_len
+        len(draft)+1 to the flat [T] axis (its last real token followed
+        by the drafted tokens); packed prefill chunks ride behind as
+        usual. The gather array is content-only (not a shape), so
+        instead of one last-token entry per segment it carries an entry
+        for EVERY verify position — the kernel's causal masking already
+        gives each flat token its correct prefix logits (chunked prefill
+        depends on the same property), and sampling at SEG_CAP rows
+        covers them all. Verify position j>0 folds j into the row seed
+        so positions draw independent randomness (temperature-0 is
+        argmax and unaffected — greedy byte-identity holds).
+
+        KV for the fed draft tokens lands at positions
+        computed_len..computed_len+K as a side effect; the engine
+        commits a prefix of it simply by advancing computed_len per
+        accepted token (stale suffix KV is overwritten or never read —
+        kv_len masking), so rollback is free and page/hash lineage only
+        ever covers committed tokens.
+
+        Returns (rows, chunk_logits): rows[i] is the np token vector of
+        length len(drafts[i])+1 sampled from the TARGET distribution at
+        each verify position; chunk_logits are the packed chunks' last-
+        token logits, device-resident, same contract as the mixed path.
+        Raises BucketOverflowError when the plan exceeds the T bucket or
+        the gather capacity (defensive — the scheduler budgets drafted
+        tokens against both)."""
+        from dynamo_tpu.ops.ragged_paged_attention import (
+            RAGGED_MAX_SEGS, build_ragged_metadata, ragged_seg_cap,
+        )
+
+        chunks = list(chunks)
+        n_rows = len(positions)
+        row_lens = [len(d) + 1 for d in drafts]
+        q_lens = row_lens + [len(c["tokens"]) for c in chunks]
+        q_starts = list(positions) + [c["start"] for c in chunks]
+        kv_lens = [p + ln for p, ln in zip(positions, row_lens)] + [
+            c["prior"] + len(c["tokens"]) for c in chunks
+        ]
+        rows = list(page_tables) + [c["table"] for c in chunks]
+        n_seg = len(q_lens)
+        t_real = sum(q_lens)
+        t_bucket = _next_bucket(self.ragged_buckets, t_real)
+        seg_cap = ragged_seg_cap(t_bucket)
+        entries = sum(row_lens) + len(chunks)
+        if n_seg > RAGGED_MAX_SEGS or entries > seg_cap:
+            raise BucketOverflowError(max(n_seg, entries), (seg_cap,))
+        md = build_ragged_metadata(
+            q_lens, q_starts, kv_lens, rows, t_bucket,
+            q_block=self.ragged_q_block, max_pages=self.max_pages_per_seq,
+        )
+        flat = np.zeros(t_bucket, np.int32)
+        off = 0
+        for tok, d in zip(tokens, drafts):
+            flat[off] = tok
+            flat[off + 1 : off + 1 + len(d)] = d
+            off += len(d) + 1
+        for c in chunks:
+            flat[off : off + len(c["tokens"])] = c["tokens"]
+            off += len(c["tokens"])
+        cu = md["cu_q_lens"]
+        gather = np.zeros(seg_cap, np.int32)
+        w = 0
+        for i in range(n_rows):
+            gather[w : w + row_lens[i]] = np.arange(cu[i], cu[i + 1])
+            w += row_lens[i]
+        chunk_entry0 = w
+        for s in range(n_rows, n_seg):
+            gather[w] = cu[s + 1] - 1
+            w += 1
+        exp = {
+            "temperature": [], "top_k": [], "top_p": [], "seeds": [],
+            "rep": [], "freq": [], "presence": [],
+        }
+        rep = list(sampling.get("rep") or [1.0] * n_rows)
+        freq = list(sampling.get("freq") or [0.0] * n_rows)
+        presence = list(sampling.get("presence") or [0.0] * n_rows)
+        for i in range(n_rows):
+            seed = int(sampling["seeds"][i])
+            for j in range(row_lens[i]):
+                exp["temperature"].append(sampling["temperature"][i])
+                exp["top_k"].append(sampling["top_k"][i])
+                exp["top_p"].append(sampling["top_p"][i])
+                exp["seeds"].append(
+                    seed if j == 0 else (seed * 1000003 + j) & 0x7FFFFFFF
+                )
+                exp["rep"].append(rep[i])
+                exp["freq"].append(freq[i])
+                exp["presence"].append(presence[i])
+        for _ in chunks:
+            exp["temperature"].append(0.0)
+            exp["top_k"].append(0)
+            exp["top_p"].append(1.0)
+            exp["seeds"].append(0)
+            exp["rep"].append(1.0)
+            exp["freq"].append(0.0)
+            exp["presence"].append(0.0)
+        sampled, seg_logits, self.k_pool, self.v_pool = self._jit_ragged(
+            self.params,
+            jnp.asarray(flat[None]),
+            jnp.asarray(md["tok_positions"])[None],
+            jnp.asarray(md["tok_page_table"]),
+            jnp.asarray(md["tok_kv_lens"]),
+            jnp.asarray(md["seg_page_table"]),
+            jnp.asarray(md["seg_kv_lens"]),
+            jnp.asarray(md["meta"]),
+            jnp.asarray(gather),
+            self.k_pool, self.v_pool,
+            self._device_sampling(exp, seg_cap), jnp.int32(step),
+        )
+        sampled_h = np.asarray(jax.device_get(sampled))  # one bulk sync
+        out: List[np.ndarray] = []
+        w = 0
+        for ln in row_lens:
+            out.append(sampled_h[w : w + ln])
+            w += ln
+        chunk_logits = seg_logits[chunk_entry0 : chunk_entry0 + len(chunks)]
+        return out, chunk_logits
+
     def compile_stats(self) -> Dict[str, Dict[str, Any]]:
         """Per step-function family: compiled-variant count, cumulative
         compile seconds, call count. Ships as worker gauges

@@ -1,0 +1,93 @@
+"""N-gram / prompt-lookup draft proposal + host-side deterministic accept.
+
+Speculative decoding without a draft model (the `--spec-ngram` path):
+propose the next K tokens by looking the current suffix up in the
+sequence's OWN token history (prompt + generated). Summarization,
+code-edit, and RAG workloads repeat long spans of their prompt, so a
+suffix match is a strong predictor there — and on mismatch-heavy text
+the verify pass simply rejects, costing only the verify row's extra
+flat tokens (scheduler-charged, see docs/spec_decode.md).
+
+This module is imported on the engine step path of MOCKER processes, so
+it must stay jax-free (plain lists + ints; `accept_deterministic` takes
+anything indexable). The distribution-preserving math lives in
+`spec_decode.accept_and_finalize`; `accept_deterministic` below is its
+exact specialization to one-hot draft distributions, proven equivalent
+by tests/test_spec_decode.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+# bound the history scanned per proposal so drafting stays O(window) per
+# sequence per iteration on the step thread, not O(context)
+NGRAM_SCAN_WINDOW = 4096
+
+
+def propose(
+    tokens: Sequence[int],
+    k: int,
+    *,
+    min_match: int = 1,
+    max_match: int = 4,
+    window: int = NGRAM_SCAN_WINDOW,
+) -> List[int]:
+    """Prompt-lookup draft: find the longest suffix of `tokens` (between
+    min_match and max_match tokens) that also occurs earlier in the
+    history, and propose the k tokens that FOLLOWED its most recent
+    earlier occurrence. Returns [] when nothing matches (the sequence
+    then decodes plainly this iteration — speculation is per-seq,
+    per-step opportunistic)."""
+    n = len(tokens)
+    if k <= 0 or n < min_match + 1:
+        return []
+    lo = max(0, n - window)
+    hist = list(tokens[lo:n])
+    h = len(hist)
+    for m in range(min(max_match, h - 1), min_match - 1, -1):
+        pattern = hist[h - m:]
+        # scan right-to-left so the most recent occurrence wins (locality:
+        # recent repetitions predict better than distant ones)
+        for s in range(h - m - 1, -1, -1):
+            if hist[s:s + m] == pattern:
+                cont = hist[s + m : s + m + k]
+                if cont:
+                    return [int(t) for t in cont]
+        # no occurrence of the longest suffix — try a shorter one
+    return []
+
+
+def accept_deterministic(
+    draft: Sequence[int], sampled: Sequence[int]
+) -> List[int]:
+    """Accept/reject a deterministic (one-hot q) draft against target
+    samples, emitting 1..len(draft)+1 tokens.
+
+    `sampled[j]` must be a token drawn from the TARGET distribution at
+    verify position j (position j fed draft[j-1], position 0 fed the
+    sequence's last real token), with independent randomness per
+    position. This is `spec_decode.accept_and_finalize` specialized to
+    q = one-hot(draft):
+
+    - accept prob of draft[j] is p(draft[j])/q(draft[j]) = p(draft[j]),
+      which is exactly P[sampled[j] == draft[j]];
+    - the rejection residual norm(max(p - q, 0)) is p restricted to
+      x != draft[j] renormalized, which is exactly the law of
+      sampled[j] conditioned on the mismatch;
+    - all-accepted appends the bonus token sampled[K] (the position the
+      verify row computed for free).
+
+    So: emit target samples up to and including the first mismatch; on a
+    full match, emit all K+1. Temperature-0 output is byte-identical to
+    non-speculative decode (sampled[j] is then argmax, and the emitted
+    stream is the greedy stream by induction).
+    """
+    out: List[int] = []
+    for j, d in enumerate(draft):
+        tok = int(sampled[j])
+        out.append(tok)
+        if tok != int(d):
+            return out  # first mismatch: the target sample corrects it
+    out.append(int(sampled[len(draft)]))  # bonus token
+    return out

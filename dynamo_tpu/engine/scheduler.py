@@ -83,6 +83,10 @@ class Sequence:
     phases: Dict[str, float] = field(default_factory=dict)
     itl: List[float] = field(default_factory=list)  # bounded ITL samples
     t_last_emit: float = 0.0  # monotonic time of the last token emission
+    # speculative decoding: draft tokens proposed for THIS iteration
+    # (engine sets before step_plan; the scheduler trims them to the
+    # mixed token budget; the engine consumes and clears after verify)
+    spec_draft: List[int] = field(default_factory=list)
 
     @property
     def n_generated(self) -> int:
@@ -154,6 +158,10 @@ class Scheduler:
         host_tier=None,  # HostKvPool-like: .match(hashes) -> n
         host_onboard=None,  # cb(pages, hashes) -> bool (imports G2→G1 data)
         max_seq_tokens: int = 0,  # model context length (0 = page cap only)
+        spec_max_tokens: int = 0,  # per-iteration cap on speculative
+        #   draft tokens (0 = bounded by the mixed pool leftover alone)
+        spec_seg_budget: int = 0,  # sampled-row slots one ragged dispatch
+        #   offers (decode rows + chunks + verify tokens); 0 = unbounded
     ):
         self.pool = pool
         self.max_batch = max_batch
@@ -177,6 +185,8 @@ class Scheduler:
         self.mixed_prefill_tokens = mixed_prefill_tokens
         self.mixed_prefill_seqs = max(1, mixed_prefill_seqs)
         self.mixed_min_chunk = max(1, mixed_min_chunk)
+        self.spec_max_tokens = max(0, spec_max_tokens)
+        self.spec_seg_budget = max(0, spec_seg_budget)
         self.host_tier = host_tier
         self.host_onboard = host_onboard
         self.waiting: deque[Sequence] = deque()
@@ -240,20 +250,72 @@ class Scheduler:
                 int((s.stop or {}).get("max_tokens", 1 << 30)) - s.n_generated,
             )
             n_steps = min(n_steps, max(1, budget))
+        # prefill chunks claim the pool FIRST (planning is side-effect
+        # free) so a speculation burst can never starve real prefills —
+        # verify rows are charged from the pool's leftover only
+        pplans = self._plan_prefills(prefill_seqs) if prefill_seq else []
+        self._trim_spec(running, pplans, cap)
+        spec_tokens = sum(len(s.spec_draft) for s in running)
+        if spec_tokens:
+            # verify rows and fused multi-step decode don't mix: a verify
+            # dispatch already advances speculating rows by up to K+1
+            n_steps = 1
         running = self._ensure_decode_capacity(running, lookahead=n_steps)
         if not running:
             if prefill_seq is not None:
                 return self._plan_prefill(prefill_seq)
             self._update_stats(0)
             return None
+        spec_tokens = sum(len(s.spec_draft) for s in running)
         if prefill_seq is None:
-            self._update_stats(len(running) * n_steps)
+            self._update_stats(len(running) * n_steps + spec_tokens)
             return DecodePlan(running, n_steps)
-        pplans = self._plan_prefills(prefill_seqs)
         self._update_stats(
-            len(running) * n_steps + sum(len(p.chunk) for p in pplans)
+            len(running) * n_steps + spec_tokens
+            + sum(len(p.chunk) for p in pplans)
         )
         return MixedPlan(prefills=pplans, decode=DecodePlan(running, n_steps))
+
+    def _trim_spec(
+        self, running: List[Sequence], pplans: List[PrefillPlan], cap: int
+    ) -> None:
+        """Fit this iteration's draft tokens to the budgets that keep the
+        verify dispatch inside the registered compile bucket: drafted
+        tokens charge the `mixed_prefill_tokens` pool AFTER prefill
+        chunks took their share (the verified +1 token per row is the
+        row's own decode slot), an optional absolute per-iteration cap,
+        and the ragged dispatch's sampled-row slots when the engine set
+        one. Per sequence, a draft is also clipped to the tokens the
+        request can still legally generate."""
+        if self.mixed_prefill_tokens <= 0:
+            for s in running:
+                s.spec_draft = []
+            return
+        left = self.mixed_prefill_tokens - sum(len(p.chunk) for p in pplans)
+        if self.spec_max_tokens:
+            left = min(left, self.spec_max_tokens)
+        seg_left = None
+        if self.spec_seg_budget:
+            # one sampled-row slot per decode row and per chunk; each
+            # drafted token needs one more (its verify position is gathered)
+            seg_left = self.spec_seg_budget - len(running) - len(pplans)
+        for s in running:
+            if not s.spec_draft:
+                continue
+            take = min(len(s.spec_draft), max(0, left))
+            if seg_left is not None:
+                take = min(take, max(0, seg_left))
+            # KV for fed draft tokens lands at computed_len+1 .. +take:
+            # stay inside the page/context cap
+            take = min(take, max(0, cap - s.computed_len - 1))
+            remaining = (
+                int((s.stop or {}).get("max_tokens", 1 << 30)) - s.n_generated
+            )
+            take = min(take, max(0, remaining))
+            s.spec_draft = s.spec_draft[:take]
+            left -= take
+            if seg_left is not None:
+                seg_left -= take
 
     # -- admission ---------------------------------------------------------
     def _admit(self) -> None:
@@ -413,7 +475,12 @@ class Scheduler:
         for seq in running:
             if seq.state != SeqState.RUNNING:  # preempted by an earlier turn
                 continue
-            last_pos = seq.computed_len + lookahead - 1
+            # a speculating row writes KV for its fed draft tokens at
+            # computed_len+1 .. +K in the SAME dispatch, so its lookahead
+            # is the draft length + 1, not the fused step count
+            last_pos = seq.computed_len + max(
+                lookahead, len(seq.spec_draft) + 1
+            ) - 1
             while True:
                 need = last_pos // self.pool.page_size + 1 - len(seq.pages)
                 if need <= 0:
@@ -447,6 +514,7 @@ class Scheduler:
         seq.n_shared_pages = 0
         seq.computed_len = 0
         seq.n_preemptions += 1
+        seq.spec_draft = []  # stale drafts must not ride the re-admission
         seq.state = SeqState.WAITING
         # re-admit with prompt = all tokens so far (already-emitted ones are
         # not re-emitted; generation resumes with the next sampled token)

@@ -180,9 +180,66 @@ class CompileKeyCardinality(Rule):
                            "`ensure_ragged_bucket`/`pack_buckets`")
 
 
+class HostSyncInStepLoop(Rule):
+    id = "DYN-J005"
+    description = "host-sync forcer inside an engine step/accept loop"
+
+    # functions on the engine's per-iteration hot path: the step loop
+    # itself, the dispatch wrappers, and the speculative accept path
+    _HOT = ("_run_decode", "_run_mixed", "_run_spec", "_run_prefill")
+
+    def _in_step_scope(self, ctx: LintContext) -> bool:
+        if "engine" not in ctx.path:
+            return False
+        scope = ctx.func
+        if scope is None:
+            return False
+        n = scope.name
+        return (n == "_loop_once" or n.startswith("accept")
+                or n.startswith(self._HOT))
+
+    def _is_sync_call(self, ctx: LintContext, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in ("item", "tolist"):
+            return True
+        name = ctx.resolve(fn) or ""
+        return name in ("numpy.asarray", "jax.device_get")
+
+    def check_call(self, ctx: LintContext, node: ast.Call) -> None:
+        if ctx.loop_depth <= 0 or not self._in_step_scope(ctx):
+            return
+        if self._is_sync_call(ctx, node):
+            what = (node.func.attr + "()"
+                    if isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("item", "tolist")
+                    else (ctx.resolve(node.func) or "host sync"))
+            ctx.report(self.id, node,
+                       f"`{what}` inside the engine step/accept loop "
+                       "forces one device sync PER TOKEN, serializing the "
+                       "accept path against the device; `jax.device_get` "
+                       "the whole batch ONCE before the loop and index "
+                       "host-side")
+            return
+        fn = node.func
+        if (isinstance(fn, ast.Name) and fn.id in ("int", "float")
+                and node.args):
+            # int(x[i]) on an already-host array is fine; int(x.item())
+            # or float(np.asarray(x)[0]) smuggles the sync inside the cast
+            for sub in ast.walk(node.args[0]):
+                if self._is_sync_call(ctx, sub):
+                    ctx.report(self.id, node,
+                               f"`{fn.id}(...)` wraps a host-sync forcer "
+                               "inside the engine step/accept loop; pull "
+                               "the device transfer out of the loop")
+                    return
+
+
 JAX_RULES = (
     TracerBranch,
     TracerMaterialize,
     ImportTimeJnp,
     CompileKeyCardinality,
+    HostSyncInStepLoop,
 )

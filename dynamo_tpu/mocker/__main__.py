@@ -44,6 +44,18 @@ def parse_args(argv=None):
     p.add_argument("--prefetch-hint-ttl-s", type=float, default=10.0)
     p.add_argument("--prefetch-pin-ttl-s", type=float, default=5.0)
     p.add_argument("--speed", type=float, default=1.0, help="timing scale; 0 = no sleeps")
+    p.add_argument("--spec-ngram", action="store_true",
+                   help="n-gram speculative decoding (verify rows billed "
+                        "like ragged prefill tokens)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft length K per speculating sequence")
+    p.add_argument("--spec-max-tokens", type=int, default=0,
+                   help="per-iteration drafted-token cap (0 = leftover "
+                        "mixed prefill budget)")
+    p.add_argument("--spec-accept-rate", type=float, default=None,
+                   help="oracle drafter: corrupt the true stream per "
+                        "position with prob 1-rate instead of n-gram "
+                        "lookup (A/B knob for bench_spec.py)")
     p.add_argument("--decode-base-ms", type=float, default=4.0)
     p.add_argument("--recorder-size", type=int, default=4096,
                    help="flight-recorder ring capacity (0 = off)")
@@ -67,10 +79,14 @@ def build_mock_engine(args) -> tuple[InferenceEngine, ModelCard]:
         page_size=args.page_size,
         max_pages_per_seq=-(-args.max_seq_len // args.page_size),
         timing=timing,
+        spec_accept_rate=getattr(args, "spec_accept_rate", None),
     )
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         decode_steps=args.decode_steps,
+        spec_ngram=getattr(args, "spec_ngram", False),
+        spec_k=getattr(args, "spec_k", 4),
+        spec_max_tokens=getattr(args, "spec_max_tokens", 0),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_root=getattr(args, "disk_kv_root", None),

@@ -66,6 +66,18 @@ class SimTiming:
             )
         return sum(chunk_lens)
 
+    def spec_charge_tokens(self, draft_lens: List[int]) -> int:
+        """Extra flat tokens one spec-verify dispatch is charged for:
+        drafted+1 per speculating row (the verify row IS a short prefill
+        chunk on the ragged path), bucket-padded under "padded" exactly
+        like a packed prefill would be. Rows with no draft are plain
+        decode rows and charge nothing here (they are covered by the
+        decode term of the dispatch)."""
+        lens = [d + 1 for d in draft_lens if d > 0]
+        if not lens:
+            return 0
+        return self.packed_charge_tokens(lens)
+
     def sleep(self, seconds: float) -> None:
         if self.speed > 0:
             time.sleep(seconds * self.speed)
@@ -155,12 +167,19 @@ class SimRunner:
         max_pages_per_seq: int = 256,
         timing: Optional[SimTiming] = None,
         vocab_size: int = 50000,
+        spec_accept_rate: Optional[float] = None,
     ):
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
         self.timing = timing or SimTiming()
         self.vocab_size = vocab_size
+        # oracle drafting knob for spec-decode A/Bs: when set, spec_draft
+        # proposes the TRUE sim stream corrupted per-token with
+        # probability (1 - rate), so benches sweep acceptance without
+        # changing the emitted bytes (verify always corrects mismatches).
+        # None = no oracle; the engine falls back to n-gram proposal.
+        self.spec_accept_rate = spec_accept_rate
         # dispatched-vs-charged token accounting for packed prefills, so
         # A/Bs can assert what the cost model billed (acceptance: ragged
         # mode bills sum(chunk_tokens), padded bills N_bucket x S_bucket)
@@ -168,6 +187,8 @@ class SimRunner:
             "packed_dispatches": 0,
             "packed_tokens_real": 0,
             "packed_tokens_charged": 0,
+            "spec_dispatches": 0,
+            "spec_tokens_charged": 0,
         }
 
     # -- ModelRunner interface ---------------------------------------------
@@ -225,13 +246,94 @@ class SimRunner:
         )
         out = np.zeros((len(tokens), n_steps), np.int32)
         for i, (tok, pos) in enumerate(zip(tokens, positions)):
+            # chained: each fused step is seeded by the PREVIOUS sampled
+            # token (like the real on-device feedback loop), so the sim
+            # stream is a pure function of (prev_token, position) and is
+            # invariant to dispatch boundaries — the property spec-decode
+            # byte-identity A/Bs assert
+            prev = tok
             for j in range(n_steps):
-                out[i, j] = _sim_token(tok, pos + 1 + j, self.vocab_size)
+                prev = _sim_token(prev, pos + 1 + j, self.vocab_size)
+                out[i, j] = prev
             if masks is not None and not masks[i, out[i, 0]]:
                 allowed = np.flatnonzero(masks[i])
                 if len(allowed):
                     out[i, 0] = int(allowed[out[i, 0] % len(allowed)])
         return out
+
+    # -- speculative decoding (n-gram / oracle drafting) --------------------
+    def spec_draft(self, last_token: int, pos: int, k: int):
+        """Oracle draft source for A/Bs: proposes the true chained sim
+        stream, corrupting each position independently with probability
+        (1 - spec_accept_rate), deterministic in (token, position).
+        Returns None when the knob is unset — the engine then uses
+        n-gram proposal like on a real runner."""
+        rate = self.spec_accept_rate
+        if rate is None:
+            return None
+        drafts: List[int] = []
+        prev = last_token
+        for j in range(k):
+            true = _sim_token(prev, pos + 1 + j, self.vocab_size)
+            u = _sim_token(prev ^ 0x5BD1E99, pos + 1 + j, self.vocab_size)
+            if (u % 10000) / 10000.0 < rate:
+                drafts.append(true)
+            else:
+                # corrupted draft: a different valid token id (stays >= 16)
+                drafts.append((true - 16 + 1) % (self.vocab_size - 16) + 16)
+            prev = true  # the oracle keeps proposing along the true stream
+        return drafts
+
+    def verify_spec(
+        self, tokens: List[int], positions: List[int], page_tables,
+        drafts: List[List[int]], sampling, step: int, chunks=(),
+    ):
+        """Speculative verify as ONE simulated ragged flat-token dispatch:
+        row i contributes len(drafts[i])+1 verify positions (a plain
+        decode row when the draft is empty). Returns (rows, chunk_logits)
+        where rows[i][j] is the target-sampled token at verify position j
+        — the token the target model emits after feeding the row's last
+        real token (j=0) or drafts[i][j-1] (j>0).
+
+        Billing: one dispatch paying the decode sweep for every row plus
+        the per-token verify compute, charged drafted+1 tokens per
+        speculating row under prefill_cost="ragged" (bucket-padded under
+        "padded"). Charges land in packed_tokens_charged so the flight
+        recorder's per-iteration charged-token delta stays honest."""
+        t = self.timing
+        spec_lens = [len(d) for d in drafts]
+        charged = t.spec_charge_tokens(spec_lens)
+        chunk_charged = 0
+        if chunks:
+            chunk_charged = t.packed_charge_tokens(
+                [len(c["tokens"]) for c in chunks]
+            )
+            self.stats["packed_tokens_real"] += sum(
+                len(c["tokens"]) for c in chunks
+            )
+        self.stats["spec_dispatches"] += 1
+        self.stats["spec_tokens_charged"] += charged
+        self.stats["packed_dispatches"] += 1
+        self.stats["packed_tokens_charged"] += charged + chunk_charged
+        t.sleep(
+            t.dispatch_overhead_s
+            + t.decode_base_s
+            + len(tokens) * t.decode_per_seq_s
+            + (charged + chunk_charged) * t.prefill_per_token_s
+        )
+        rows = []
+        for tok, pos, d in zip(tokens, positions, drafts):
+            out = np.zeros(len(d) + 1, np.int32)
+            for j in range(len(d) + 1):
+                fed = tok if j == 0 else d[j - 1]
+                out[j] = _sim_token(fed, pos + 1 + j, self.vocab_size)
+            rows.append(out)
+        chunk_logits = []
+        for c in chunks:
+            toks = c["tokens"]
+            seed = toks[-1] if toks else 0
+            chunk_logits.append(("sim-logits", seed, c["start"] + len(toks)))
+        return rows, chunk_logits
 
     def decode(self, tokens, positions, page_tables, kv_lens, sampling, step):
         return self.decode_multi(1, tokens, positions, page_tables, sampling, step)[:, 0]

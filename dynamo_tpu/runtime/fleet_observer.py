@@ -244,6 +244,18 @@ class DigestBuilder:
                     }
                 except Exception:
                     log.debug("compile stats probe failed", exc_info=True)
+            spec = getattr(engine, "spec_stats", None)
+            if spec and spec.get("verify_iters", 0) > 0:
+                rows = max(1, spec.get("verify_rows", 0))
+                digest["spec"] = {
+                    "drafted": spec.get("drafted", 0),
+                    "accepted": spec.get("accepted", 0),
+                    "rejected": spec.get("rejected", 0),
+                    "verify_iters": spec.get("verify_iters", 0),
+                    "accept_rate": (spec.get("accepted", 0)
+                                    / max(1, spec.get("drafted", 0))),
+                    "accepted_per_step": spec.get("spec_emitted", 0) / rows,
+                }
             rec = getattr(engine, "recorder", None)
             if rec is not None and getattr(rec, "enabled", False):
                 digest["recorder"] = {
@@ -466,6 +478,10 @@ class FleetObserver:
                 "kv": latest.get("kv") or {},
                 "prefetch": latest.get("prefetch") or {},
                 "compile": latest.get("compile") or {},
+                # spec stats are cumulative on the engine; surface the most
+                # recent digest that carried a block (quiet windows omit it)
+                "spec": next((d["spec"] for d in reversed(digests)
+                              if d.get("spec")), {}),
                 "counters": {k: round(v, 6) if isinstance(v, float) else v
                              for k, v in counters.items()},
                 "phases": self._pct_block(hists),

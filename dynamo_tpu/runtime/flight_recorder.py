@@ -75,6 +75,11 @@ class IterationRecord:
     compile_calls: int     # cumulative jitted calls (calls - variants
     #   growth = compile-cache hits)
     anomaly: bool = False  # this iteration fired the EWMA trigger
+    # speculative decoding: mean tokens emitted per speculating row this
+    # iteration (accepted drafts + the verified/bonus token; 0.0 when no
+    # row speculated) — the per-step multi-token factor the ITL spine
+    # divides by, surfaced in the fleet digest
+    accepted_per_step: float = 0.0
 
 
 @dataclass

@@ -122,6 +122,19 @@ def parse_args(argv=None):
                    help="HF safetensors dir for draft weights")
     p.add_argument("--spec-gamma", type=int, default=4,
                    help="draft tokens proposed per target verify pass")
+    p.add_argument("--spec-draft-model", default=None, metavar="PRESET",
+                   help="alias for --draft-model: route speculation through "
+                        "a separate draft model instead of n-gram lookup")
+    p.add_argument("--spec-ngram", action="store_true",
+                   help="draft-model-free speculation: propose the next K "
+                        "tokens by prompt/history n-gram lookup and verify "
+                        "them as ragged rows of the mixed dispatch")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="n-gram draft length K (verify rows are K+1 tokens)")
+    p.add_argument("--spec-max-tokens", type=int, default=0,
+                   help="per-iteration cap on drafted tokens admitted to "
+                        "the verify dispatch (0 = the leftover mixed "
+                        "prefill token budget)")
     # multi-LoRA
     p.add_argument("--lora", action="append", default=[],
                    help="serve a LoRA adapter: NAME=<peft_dir> (HF PEFT "
@@ -366,6 +379,8 @@ def build_runner(args, save_snapshot_ok: bool = True) -> tuple[ModelRunner, "obj
     )
     max_pages_per_seq = -(-args.max_seq_len // args.page_size)
     draft_config = draft_params = None
+    if getattr(args, "spec_draft_model", None) and not args.draft_model:
+        args.draft_model = args.spec_draft_model
     if args.draft_model or args.draft_checkpoint:
         if args.draft_checkpoint:
             from dynamo_tpu.engine.weights import config_from_hf, load_hf_checkpoint
@@ -426,6 +441,9 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
         anomaly_dump_dir=getattr(args, "anomaly_dump_dir", None),
         anomaly_dump_last_n=getattr(args, "anomaly_dump_last_n", 256),
         anomaly_profile_ms=getattr(args, "anomaly_profile_ms", 0),
+        spec_ngram=getattr(args, "spec_ngram", False),
+        spec_k=getattr(args, "spec_k", 4),
+        spec_max_tokens=getattr(args, "spec_max_tokens", 0),
     )
     if getattr(args, "shm_weights", None) or args.orbax_cache:
         # RL weight hot-swap: after update_weights the WARM TIERS hold a

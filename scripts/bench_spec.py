@@ -1,0 +1,165 @@
+"""Speculative-decoding mocker A/B: billed ITL vs accept-rate and K.
+
+Sweeps the oracle drafter's accept-rate {0.0, 0.5, 0.7, 0.9} x draft
+length K {2, 4, 8} through a full InferenceEngine + SimRunner stack and
+reports, per arm, the billed ITL p50/p99 and its ratio against the
+spec-off baseline (decode_steps=4 fused multi-step — the strongest
+non-spec configuration, not a strawman). The oracle corrupts the true
+chained sim stream per position with probability (1 - rate), so emitted
+BYTES are identical in every arm (the verify pass corrects every
+corruption) — only the step count changes, which is the whole claim.
+
+Also runs a bursty-prefill guard: a late burst of prompts arrives while
+the batch decodes, spec on vs off, and the burst's TTFT p99 must not
+regress (prefill chunks claim the mixed token pool BEFORE drafted
+tokens, so speculation can only use leftover).
+
+Deterministic, no JAX, no TPUs. Run:
+
+    python scripts/bench_spec.py [--osl 96] [--seqs 4] [--speed 1.0]
+
+Prints one JSON line {"metric": "spec_decode_itl", "baseline": {...},
+"arms": [...], "burst": {...}}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dynamo_tpu.engine.engine import InferenceEngine  # noqa: E402
+from dynamo_tpu.mocker.sim import SimRunner, SimTiming  # noqa: E402
+from dynamo_tpu.runtime.context import Context  # noqa: E402
+
+RATES = (0.0, 0.5, 0.7, 0.9)
+KS = (2, 4, 8)
+
+
+def _pct(vals, p):
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(p * len(vals)))], 6) if vals else 0.0
+
+
+def _engine(args, rate, k, spec):
+    runner = SimRunner(
+        num_pages=2048, page_size=16, max_pages_per_seq=64,
+        timing=SimTiming(speed=args.speed),
+        spec_accept_rate=rate if spec else None,
+    )
+    engine = InferenceEngine(
+        runner, max_batch=16, chunk_size=512, decode_steps=4,
+        mixed_prefill_tokens=256, mixed_prefill_seqs=4, mixed_min_chunk=16,
+        spec_ngram=spec, spec_k=k,
+    )
+    return runner, engine
+
+
+async def _serve(args, rate, k, spec, burst=0):
+    runner, engine = _engine(args, rate, k, spec)
+    engine.start()
+    try:
+        async def one(isl, osl, delay, seed):
+            await asyncio.sleep(delay * args.speed)
+            start = time.monotonic()
+            first, itls, toks = None, [], []
+            async for item in engine.generate(
+                {"token_ids": [17 + seed] * isl,
+                 "sampling": {"temperature": 0.0, "seed": seed},
+                 "stop": {"max_tokens": osl, "stop_ids": [],
+                          "ignore_eos": True}}, Context(),
+            ):
+                assert item.get("finish_reason") != "error", item
+                ids = item.get("token_ids") or []
+                toks.extend(ids)
+                if first is None and ids:
+                    first = time.monotonic() - start
+                if item.get("finish_reason"):
+                    # billed per-token ITL: the engine's latency spine
+                    # divides each multi-token step across its tokens
+                    itls = list(
+                        (item.get("phases") or {}).get("itl_s") or [])
+                    break
+            return first, itls, toks
+
+        jobs = [one(args.isl, args.osl, 0.0, i) for i in range(args.seqs)]
+        jobs += [one(args.isl, 8, 0.15, 100 + i) for i in range(burst)]
+        out = await asyncio.gather(*jobs)
+    finally:
+        engine.stop()
+    decode_itls = [v for x in out[:args.seqs] for v in x[1]]
+    sha = hashlib.sha256()
+    for x in out:
+        sha.update(",".join(str(t) for t in x[2]).encode() + b"|")
+    res = {
+        "itl_p50_s": _pct(decode_itls, 0.5),
+        "itl_p99_s": _pct(decode_itls, 0.99),
+        "output_sha": sha.hexdigest()[:16],
+        "spec": dict(engine.spec_stats),
+    }
+    st = engine.spec_stats
+    if st["verify_rows"]:
+        res["spec"]["accept_rate"] = round(
+            st["accepted"] / max(1, st["drafted"]), 4)
+        res["spec"]["tokens_per_step"] = round(
+            st["spec_emitted"] / st["verify_rows"], 4)
+    if burst:
+        res["burst_ttft_p99_s"] = _pct([x[0] for x in out[args.seqs:]], 0.99)
+    return res
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seqs", type=int, default=4,
+                    help="concurrent decoding sequences per arm")
+    ap.add_argument("--isl", type=int, default=32)
+    ap.add_argument("--osl", type=int, default=96)
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="SimTiming scale (smaller = faster bench)")
+    ap.add_argument("--burst", type=int, default=6,
+                    help="late prompts in the bursty TTFT guard")
+    args = ap.parse_args()
+
+    base = asyncio.run(_serve(args, None, 4, spec=False))
+    report = {"metric": "spec_decode_itl",
+              "seqs": args.seqs, "osl": args.osl,
+              "baseline": {k: v for k, v in base.items() if k != "spec"}}
+    arms = []
+    for k in KS:
+        for rate in RATES:
+            arm = asyncio.run(_serve(args, rate, k, spec=True))
+            assert arm["output_sha"] == base["output_sha"], (
+                f"byte-identity broken at rate={rate} k={k}")
+            arms.append({
+                "accept_rate": rate, "k": k,
+                "itl_p50_s": arm["itl_p50_s"],
+                "itl_p99_s": arm["itl_p99_s"],
+                "itl_p50_ratio": round(
+                    base["itl_p50_s"] / max(arm["itl_p50_s"], 1e-9), 3),
+                "itl_p99_ratio": round(
+                    base["itl_p99_s"] / max(arm["itl_p99_s"], 1e-9), 3),
+                "spec": arm["spec"],
+            })
+    report["arms"] = arms
+
+    # bursty-prefill TTFT guard: speculation must not starve prefills
+    boff = asyncio.run(_serve(args, None, 4, spec=False, burst=args.burst))
+    bon = asyncio.run(_serve(args, 0.7, 4, spec=True, burst=args.burst))
+    report["burst"] = {
+        "ttft_p99_off_s": boff["burst_ttft_p99_s"],
+        "ttft_p99_on_s": bon["burst_ttft_p99_s"],
+        "ttft_p99_ratio": round(
+            bon["burst_ttft_p99_s"] / max(boff["burst_ttft_p99_s"], 1e-9), 3),
+    }
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

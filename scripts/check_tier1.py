@@ -46,7 +46,7 @@ def main() -> int:
     required = args.require if args.require is not None else [
         "test_sched_packing.py", "test_ragged_mixed.py",
         "test_dynlint.py", "test_flight_recorder.py",
-        "test_fleet_observer.py",
+        "test_fleet_observer.py", "test_spec_decode.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -284,6 +284,60 @@ def test_j004_compile_key_cardinality():
     """) == []
 
 
+def test_j005_host_sync_in_step_loop():
+    """A per-token host sync (.item(), np.asarray, jax.device_get, or an
+    int()/float() wrapping one) inside an engine step/accept-path loop
+    serializes the loop against the device; the fix is one bulk
+    device_get before the loop."""
+    assert _rules("""
+        import numpy as np
+
+        def _run_spec_verify(self, rows):
+            for r in rows:
+                t = int(r.item())
+    """, path="dynamo_tpu/engine/engine.py") == ["DYN-J005", "DYN-J005"]
+    assert _rules("""
+        import numpy as np
+
+        def _run_decode(self, toks):
+            out = []
+            for i in range(4):
+                out.append(np.asarray(toks[i]))
+    """, path="dynamo_tpu/engine/engine.py") == ["DYN-J005"]
+    assert _rules("""
+        import jax
+
+        def accept_rows(rows):
+            for r in rows:
+                x = float(jax.device_get(r)[0])
+    """, path="dynamo_tpu/engine/engine.py") == ["DYN-J005", "DYN-J005"]
+
+
+def test_j005_negatives():
+    # bulk transfer BEFORE the loop + host-side Subscript indexing: clean
+    assert _rules("""
+        import jax
+        import numpy as np
+
+        def _run_spec_verify(self, toks):
+            host = np.asarray(jax.device_get(toks))
+            out = []
+            for i in range(4):
+                out.append(int(host[i]))
+    """, path="dynamo_tpu/engine/engine.py") == []
+    # same code outside an engine path or hot function: out of scope
+    assert _rules("""
+        def _run_decode(self, rows):
+            for r in rows:
+                t = r.item()
+    """, path="dynamo_tpu/bench/tool.py") == []
+    assert _rules("""
+        def helper(rows):
+            for r in rows:
+                t = r.item()
+    """, path="dynamo_tpu/engine/engine.py") == []
+
+
 # -- DYN-R: runtime invariants ----------------------------------------------
 
 

@@ -141,6 +141,26 @@ def test_digest_builder_engine_probe_is_getattr_guarded():
     d = DigestBuilder(1).build(engine=_Engine(), period_s=1.0)
     assert d["kv"] == {"g1_usage": 0.0, "g2_blocks": 0, "g3_blocks": 0}
     assert "prefetch" not in d and "compile" not in d
+    assert "spec" not in d
+
+
+def test_digest_builder_samples_spec_stats():
+    class _Engine:
+        spec_stats = {"drafted": 20, "accepted": 14, "rejected": 6,
+                      "verify_rows": 5, "verify_iters": 3,
+                      "spec_emitted": 19}
+
+    d = DigestBuilder(1).build(engine=_Engine(), period_s=1.0)
+    assert d["spec"]["drafted"] == 20
+    assert d["spec"]["accept_rate"] == 14 / 20
+    assert d["spec"]["accepted_per_step"] == 19 / 5
+
+    class _Quiet:  # engine that never speculated: no spec block at all
+        spec_stats = {"drafted": 0, "accepted": 0, "rejected": 0,
+                      "verify_rows": 0, "verify_iters": 0,
+                      "spec_emitted": 0}
+
+    assert "spec" not in DigestBuilder(2).build(engine=_Quiet())
 
 
 # -- FleetObserver windowing / dedup / churn ---------------------------------
@@ -226,6 +246,23 @@ def test_fleet_payload_shape():
     # explicit narrower window re-filters (only the now=1.0 digest is
     # newer than the 2.0 - 1.5 cutoff)
     assert obs.fleet(now=2.0, window_s=1.5)["workers"]["ab.1"]["digests"] == 1
+
+
+def test_fleet_row_surfaces_latest_spec_block():
+    obs = FleetObserver(None, window_s=60.0)
+    d1 = _digest((1, 0), seq=1)
+    d1["spec"] = {"drafted": 8, "accepted": 5, "rejected": 3,
+                  "verify_iters": 2, "accept_rate": 0.625,
+                  "accepted_per_step": 3.5}
+    obs.ingest(d1, now=0.0)
+    obs.ingest(_digest((1, 0), seq=2), now=1.0)  # quiet window: no block
+    row = obs.fleet(now=2.0)["workers"]["1.0"]
+    # the most recent NON-EMPTY spec block wins, not the latest digest's
+    assert row["spec"]["drafted"] == 8
+    assert row["spec"]["accepted_per_step"] == 3.5
+    # a worker that never speculated shows an empty block
+    obs.ingest(_digest((2, 0), seq=1), now=1.5)
+    assert obs.fleet(now=2.0)["workers"]["2.0"]["spec"] == {}
 
 
 def test_window_digests_adapter_surface():
