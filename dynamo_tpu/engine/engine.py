@@ -86,6 +86,13 @@ class InferenceEngine:
         disk_kv_root: Optional[str] = None,
         obj_kv_root: Optional[str] = None,  # G4 object store (fs backend /
         #   shared mount; S3 via kvbm.object_store.S3Backend)
+        kv_tier_quantize: bool = False,  # store demoted G2/G3/G4 blocks as
+        #   int8 + per-(token, head) scales (kvbm/quant.py) — ~2x effective
+        #   cold-tier capacity; promotion dequantizes, or passes through
+        #   natively when the device pools are int8 (kv_quantize)
+        onboard_layer_groups: int = 1,  # stream tier onboarding in this
+        #   many contiguous layer groups (FlowKV-style overlap of transfer
+        #   with the first layers' compute; 1 = whole-sequence import)
         prefetch: bool = False,  # router-hinted tier promotion ahead of
         #   dispatch (kvbm/prefetch.py; needs host_kv_blocks > 0)
         prefetch_max_inflight: int = 4,  # concurrent G3→G2 reads
@@ -134,6 +141,14 @@ class InferenceEngine:
         self.pool = PagePool(runner.num_pages, runner.page_size)
         self.host_pool = None
         self._host_events: List[KvEvent] = []
+        self.kv_tier_quantize = bool(kv_tier_quantize)
+        self.onboard_layer_groups = max(1, int(onboard_layer_groups))
+        # per-tier EWMA of measured per-block onboard seconds (the phase
+        # spine's kv_onboard_s attributed to the deepest tier each chain
+        # touched, plus the remote-pull leg). Published in fleet digests;
+        # the router's topology-aware placement consumes it as the live
+        # transfer-cost model.
+        self.kv_onboard_ewma: Dict[str, Dict[str, float]] = {}
         if (disk_kv_blocks > 0 or obj_kv_root) and host_kv_blocks <= 0:
             log.warning(
                 "disk/object KV tiers ignored: they spill from the G2 host "
@@ -143,7 +158,8 @@ class InferenceEngine:
             from dynamo_tpu.kvbm.disk_pool import DiskKvPool, TieredKv
             from dynamo_tpu.kvbm.host_pool import HostKvPool
 
-            host = HostKvPool(capacity_blocks=host_kv_blocks)
+            host = HostKvPool(capacity_blocks=host_kv_blocks,
+                              quantize=kv_tier_quantize)
             disk = None
             if disk_kv_blocks > 0:
                 import tempfile
@@ -151,12 +167,14 @@ class InferenceEngine:
                 disk = DiskKvPool(
                     disk_kv_root or tempfile.mkdtemp(prefix="dyn_kv_g3_"),
                     capacity_blocks=disk_kv_blocks,
+                    quantize=kv_tier_quantize,
                 )
             obj = None
             if obj_kv_root:
                 from dynamo_tpu.kvbm.object_store import FsBackend, ObjectKvPool
 
-                obj = ObjectKvPool(FsBackend(obj_kv_root))
+                obj = ObjectKvPool(FsBackend(obj_kv_root),
+                                   quantize=kv_tier_quantize)
             self.host_pool = TieredKv(host, disk, obj)
             self.pool.evict_hook = self._offload_page
             self.host_pool.on_evict(self._on_host_evicted)
@@ -590,6 +608,7 @@ class InferenceEngine:
         now = time.monotonic()
         if now < self._remote_fetch_backoff.get(peer, 0.0):
             return  # peer recently failed: recompute instead of stalling
+        t0 = time.perf_counter()
         try:
             # bounded timeout: a wedged peer must cost little — the
             # fallback (recompute) is always available (covers the
@@ -604,6 +623,10 @@ class InferenceEngine:
         n = int((payload or {}).get("n") or 0)
         if n <= 0:
             return
+        # the peer-pull leg of the transfer-cost model: remote blocks then
+        # onboard from local G2, so the total remote cost the router sees
+        # is ewma[remote] + ewma[host]
+        self._note_onboard([], n, time.perf_counter() - t0, tier="remote")
         self._inbox.put(("host_import", (hashes[:n], parents[:n], payload)))
 
     async def prefetch_hint_async(self, hint: Dict[str, Any]) -> bool:
@@ -2007,7 +2030,13 @@ class InferenceEngine:
         """Host-tier blocks → device pages during admission. Returns False
         when a matched block was evicted between match and get (lower-tier
         LRU churn under memory pressure) — the scheduler then recomputes
-        instead of trusting a partial import."""
+        instead of trusting a partial import.
+
+        Imports stream in `onboard_layer_groups` layer slabs (FlowKV);
+        when both the tier AND the device pools are int8-quantized the
+        blocks pass through natively (no dequantize/requantize). Measured
+        transfer time feeds the per-tier kv_onboard_ewma that topology-
+        aware routing consumes."""
         from dynamo_tpu.engine.model_runner import kv_arrays_to_payload
 
         if self.prefetch is not None:
@@ -2015,11 +2044,23 @@ class InferenceEngine:
             # synchronous import wins, the prefetch job is cancelled (a
             # duplicate in-flight import dedups via pool.register)
             self.prefetch.note_sync_onboard(hashes)
+        tiers = (self.host_pool.residency(hashes)
+                 if hasattr(self.host_pool, "residency")
+                 else ["host"] * len(hashes))
+        groups = self.onboard_layer_groups
+        t0 = time.perf_counter()
         try:
-            k, v = self.host_pool.get(hashes)
+            payload = self._native_quant_payload(hashes, tiers)
+            k = v = None
+            if payload is None:
+                k, v = self.host_pool.get(hashes)
         except KeyError:
             log.info("lower-tier block evicted before onboard; recomputing")
             return False
+        if payload is not None:
+            self.runner.import_pages(pages, 0, payload, layer_groups=groups)
+            self._note_onboard(tiers, len(hashes), time.perf_counter() - t0)
+            return True
         if k is None:
             # real engines need bytes (a hash-indexed block whose data is
             # gone — e.g. a shared G4 object deleted externally — must be
@@ -2032,10 +2073,63 @@ class InferenceEngine:
                 log.info("lower-tier block has no data; recomputing")
                 return False
             self.runner.import_pages(
-                pages, 0, {"sim": True, "data": True, "n_pages": len(pages)})
+                pages, 0, {"sim": True, "data": True, "n_pages": len(pages)},
+                layer_groups=groups)
+            self._note_onboard(tiers, len(hashes), time.perf_counter() - t0)
             return True
-        self.runner.import_pages(pages, 0, kv_arrays_to_payload(k, v))
+        self.runner.import_pages(pages, 0, kv_arrays_to_payload(k, v),
+                                 layer_groups=groups)
+        self._note_onboard(tiers, len(hashes), time.perf_counter() - t0)
         return True
+
+    def _native_quant_payload(self, hashes: List[int], tiers: List[str]):
+        """int8+scales pass-through payload when the whole chain is
+        G2-resident, the tier quantizes, and the device pools are int8
+        (kv_quantize) — else None (dense path). Raises KeyError on
+        eviction races like host_pool.get."""
+        if not getattr(self.runner, "kv_quantize", None):
+            return None
+        host = getattr(self.host_pool, "host", self.host_pool)
+        if not getattr(host, "quantize", False):
+            return None
+        if any(t != "host" for t in tiers):
+            return None
+        from dynamo_tpu.kvbm.quant import is_quantized_block
+        from dynamo_tpu.engine.model_runner import kv_quant_arrays_to_payload
+
+        blocks = [host.get_block_raw(h) for h in hashes]
+        if not blocks or not all(
+            is_quantized_block(k) and is_quantized_block(v)
+            for k, v in blocks
+        ):
+            return None
+        kq = np.stack([b[0]["q"] for b in blocks], axis=1)
+        ks = np.stack([b[0]["s"] for b in blocks], axis=1)
+        vq = np.stack([b[1]["q"] for b in blocks], axis=1)
+        vs = np.stack([b[1]["s"] for b in blocks], axis=1)
+        return kv_quant_arrays_to_payload(kq, ks, vq, vs)
+
+    def _note_onboard(self, tiers: List[str], n_blocks: int,
+                      elapsed_s: float, tier: Optional[str] = None) -> None:
+        """Fold one measured onboard into the per-tier per-block EWMA.
+        A chain spanning tiers is attributed to its DEEPEST tier — the
+        rung that dominated the transfer time (G3 file reads dwarf the
+        G2 memcpy above them)."""
+        if tier is None:
+            order = {"host": 0, "disk": 1, "obj": 2}
+            tier = "host"
+            for t in tiers:
+                if order.get(t, -1) > order[tier]:
+                    tier = t
+        per_block = elapsed_s / max(1, n_blocks)
+        e = self.kv_onboard_ewma.get(tier)
+        if e is None:
+            self.kv_onboard_ewma[tier] = {"s_per_block": per_block,
+                                          "n": n_blocks}
+            return
+        alpha = 0.25
+        e["s_per_block"] = alpha * per_block + (1 - alpha) * e["s_per_block"]
+        e["n"] += n_blocks
 
 
 def _set_future(fut: asyncio.Future, value) -> None:

@@ -318,6 +318,43 @@ def kv_arrays_to_payload(k: np.ndarray, v: np.ndarray, tp: int = 1) -> Dict[str,
     }
 
 
+def layer_group_bounds(num_layers: int, groups: int) -> List[Tuple[int, int]]:
+    """Contiguous [lo, hi) layer slabs for the streamed onboard: `groups`
+    near-equal groups, the earlier ones taking the remainder so the first
+    (blocking) transfer is never the runt."""
+    g = max(1, min(int(groups), int(num_layers)))
+    base, rem = divmod(int(num_layers), g)
+    bounds: List[Tuple[int, int]] = []
+    lo = 0
+    for i in range(g):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def kv_quant_arrays_to_payload(kq, ks, vq, vs) -> Dict[str, Any]:
+    """Native int8+scales KV payload for LOCAL tier promotion (engine →
+    runner in one process; arrays stay arrays, no byte serialization).
+    Carries the tier codec's per-(token, head) q/s pair in the pool
+    stacking [L, n, PS, Hk, D] / [L, n, PS, Hk] so an int8 device pool
+    adopts it without a dequantize/requantize round trip. The
+    CROSS-WORKER wire stays dense (kv_arrays_to_payload) — heterogeneous
+    workers keep interoperating."""
+    return {
+        "data": True,
+        "quant": "int8_ts",
+        "kq": kq, "ks": ks, "vq": vq, "vs": vs,
+        "shape": list(kq.shape),
+        "n_pages": int(kq.shape[1]),
+        "layout": KV_WIRE_LAYOUT_VERSION,
+        "page_size": int(kq.shape[2]),
+        "kv_heads": int(kq.shape[3]),
+        "head_dim": int(kq.shape[4]),
+        "layers": int(kq.shape[0]),
+    }
+
+
 def kv_payload_incompatible(
     payload: Dict[str, Any],
     page_shape: Tuple[int, int, int, int],
@@ -1658,21 +1695,108 @@ class ModelRunner:
         pool dtype — quantized pools dequantize at export)."""
         return str(np.dtype(self.dtype))
 
-    def import_pages(self, target_pages: List[int], offset: int, payload: Dict[str, Any]) -> None:
+    def _store_pages_layers(self, pool, idx, dense, lo: int):
+        """Layer-group scatter: write dense [Lg, n, PS, Hk, D] pages into
+        pool layers [lo, lo+Lg) at slots idx — the per-group unit of the
+        streamed onboard. Quantized pools fold the group on device; the
+        block-copy kernel path has a dedicated layer-sliced variant."""
+        Lg = int(dense.shape[0])
+        if isinstance(pool, dict):
+            from dynamo_tpu.models.quant import kv_pool_quantize
+
+            d = kv_pool_quantize(dense)
+            return jax.tree.map(
+                lambda a, u: a.at[lo : lo + Lg, idx].set(u), pool, d)
+        if self._kv_copy_kernel and not self._kv_copy_sharded:
+            from dynamo_tpu.ops.block_copy import scatter_pages_layers
+
+            return scatter_pages_layers(
+                pool, idx, dense.astype(pool.dtype),
+                jnp.asarray([lo], jnp.int32),
+                interpret=self._kv_copy_interpret,
+            )
+        return pool.at[lo : lo + Lg, idx].set(dense.astype(pool.dtype))
+
+    def import_pages(self, target_pages: List[int], offset: int,
+                     payload: Dict[str, Any], layer_groups: int = 1) -> None:
         """Host→device write of transferred pages into this pool's page
         slots. `offset` = first payload page to use (earlier pages were
         satisfied by the local prefix cache). Validates the payload's layout
         metadata against the local pool geometry (KvWireLayoutMismatch on
         any divergence); a cross-TP exporter is fine — the dense wire pages
-        reshard into this mesh's pool sharding on the scatter below."""
+        reshard into this mesh's pool sharding on the scatter below.
+
+        layer_groups > 1 streams the import in contiguous layer slabs
+        (FlowKV-style): each group's host staging + device scatter issues
+        independently, so the scheduler can dispatch prefill as soon as
+        the shallow layers land while deeper groups are still in flight.
+        Final pool contents are identical to a whole-sequence import."""
+        if payload.get("quant") == "int8_ts":
+            return self._import_pages_quant(
+                target_pages, offset, payload, layer_groups)
         arrays = kv_payload_to_arrays(payload, self.kv_page_shape, self.kv_wire_dtype)
         if arrays is None:
             return
         k, v = arrays
         sel = slice(offset, offset + len(target_pages))
         idx = jnp.asarray(np.asarray(target_pages, np.int32))
-        self.k_pool = self._store_pages(self.k_pool, idx, jnp.asarray(k[:, sel]))
-        self.v_pool = self._store_pages(self.v_pool, idx, jnp.asarray(v[:, sel]))
+        if layer_groups <= 1:
+            self.k_pool = self._store_pages(self.k_pool, idx, jnp.asarray(k[:, sel]))
+            self.v_pool = self._store_pages(self.v_pool, idx, jnp.asarray(v[:, sel]))
+            return
+        L = self.kv_page_shape[0]
+        for lo, hi in layer_group_bounds(L, layer_groups):
+            self.k_pool = self._store_pages_layers(
+                self.k_pool, idx, jnp.asarray(k[lo:hi, sel]), lo)
+            self.v_pool = self._store_pages_layers(
+                self.v_pool, idx, jnp.asarray(v[lo:hi, sel]), lo)
+
+    def _import_pages_quant(self, target_pages: List[int], offset: int,
+                            payload: Dict[str, Any],
+                            layer_groups: int = 1) -> None:
+        """Native int8+scales import (kv_quant_arrays_to_payload): tier
+        blocks already in the device fold land in quantized pools with NO
+        dequantize/requantize round trip — zero extra rounding on the
+        promotion path. Dense-pool runners dequantize instead (same
+        result as the dense wire, one rounding)."""
+        if payload.get("layout") != KV_WIRE_LAYOUT_VERSION:
+            raise KvWireLayoutMismatch(
+                f"kv wire layout {payload.get('layout')} != {KV_WIRE_LAYOUT_VERSION}"
+            )
+        kq, ks = np.asarray(payload["kq"]), np.asarray(payload["ks"])
+        vq, vs = np.asarray(payload["vq"]), np.asarray(payload["vs"])
+        L, PS, Hk, D = self.kv_page_shape
+        got = (kq.shape[0],) + tuple(kq.shape[2:])
+        if got != (L, PS, Hk, D):
+            raise KvWireLayoutMismatch(
+                f"quant page geometry {got} != local (L={L}, PS={PS}, "
+                f"Hk={Hk}, D={D})"
+            )
+        if not isinstance(self.k_pool, dict):
+            from dynamo_tpu.kvbm.quant import dequantize_block
+
+            dt = np.dtype(self.dtype)
+            dense = {
+                "data": True,
+                "k": dequantize_block({"q": kq, "s": ks}, dt).tobytes(),
+                "v": dequantize_block({"q": vq, "s": vs}, dt).tobytes(),
+                "shape": list(kq.shape), "dtype": str(dt),
+                "v_shape": list(vq.shape),
+                "n_pages": int(kq.shape[1]),
+                "layout": KV_WIRE_LAYOUT_VERSION,
+                "page_size": PS, "kv_heads": Hk, "head_dim": D, "layers": L,
+                "tp": 1,
+            }
+            return self.import_pages(target_pages, offset, dense, layer_groups)
+        sel = slice(offset, offset + len(target_pages))
+        idx = jnp.asarray(np.asarray(target_pages, np.int32))
+        for lo, hi in layer_group_bounds(L, max(1, layer_groups)):
+            for name, q, s in (("k_pool", kq, ks), ("v_pool", vq, vs)):
+                pool = getattr(self, name)
+                setattr(self, name, {
+                    "q": pool["q"].at[lo:hi, idx].set(jnp.asarray(q[lo:hi, sel])),
+                    "s": pool["s"].at[lo:hi, idx].set(jnp.asarray(s[lo:hi, sel])),
+                })
 
     def pools_deleted(self) -> bool:
         """True when the KV pool buffers were consumed by donation into a

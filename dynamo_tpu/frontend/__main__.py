@@ -182,6 +182,10 @@ async def async_main(args) -> None:
             window_s=args.digest_window,
         )
         await observer.start()
+        # topology-aware KV placement: routers price candidate workers by
+        # their MEASURED per-tier onboard cost (kv_onboard_s EWMAs riding
+        # the fleet digests) instead of constant credits
+        watcher.tier_cost_source = observer.onboard_costs
         slo = SloEngine(observer, parse_slo_config(args.slo))
         slo.bind_metrics(runtime.metrics)
 

@@ -136,6 +136,11 @@ class ModelWatcher:
         self.router_replica_sync = router_replica_sync
         self.migration_limit = migration_limit
         self.disagg_min_prefill_tokens = disagg_min_prefill_tokens
+        # measured onboard-cost source for topology-aware KV placement:
+        # set by __main__ once the FleetObserver exists (it is built after
+        # the watcher); routers close over the attribute so late binding
+        # just works
+        self.tier_cost_source = None
         self.affinity = None
         if session_affinity_ttl:
             from dynamo_tpu.frontend.session_affinity import AffinityCoordinator
@@ -155,6 +160,12 @@ class ModelWatcher:
         # chain_factory(entry_args...) -> AsyncEngine; overridable (kv router)
         self._chain_factory = chain_factory or self._default_chain
 
+    def _tier_costs(self):
+        """Router-facing snapshot of measured per-(worker, tier) onboard
+        costs; empty until __main__ binds a FleetObserver."""
+        src = self.tier_cost_source
+        return src() if src is not None else {}
+
     def _build_sink(self, card: ModelCard, client: EndpointClient):
         """Router egress engine per router_mode. Returns (sink, teardown).
         The sink is also remembered per model so _on_put can stash it on
@@ -173,6 +184,7 @@ class ModelWatcher:
                 use_kv_events=self.router_kv_events,
                 replica_sync=self.router_replica_sync,
                 admission=self.admission_config,
+                tier_cost_fn=self._tier_costs,
             )
             return KvPushRouter(kv_router), kv_router.stop
         if self.router_mode == "kv-remote":
@@ -399,6 +411,7 @@ class ModelWatcher:
                     block_size=card.kv_block_size,
                     config=self.router_config,
                     use_kv_events=self.router_kv_events,
+                    tier_cost_fn=self._tier_costs,
                 )
                 # eager start: the per-worker kv_state seeding must not
                 # ride the first request's TTFT

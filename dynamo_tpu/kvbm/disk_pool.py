@@ -26,6 +26,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .quant import (
+    is_quantized_block,
+    maybe_dequantize,
+    maybe_quantize,
+    pair_nbytes,
+)
+
 log = logging.getLogger("dynamo_tpu.kvbm.disk")
 
 
@@ -49,10 +56,34 @@ class BlockLayoutMismatch(ValueError):
     pass
 
 
-def encode_block(parent_hash, k: np.ndarray, v: np.ndarray) -> bytes:
-    """Shared tier codec: 8-byte LE header length, JSON header, raw k, raw
-    v. Both the G3 files and G4 objects use exactly this format so blocks
-    demote across tiers byte-for-byte."""
+def encode_block(parent_hash, k, v) -> bytes:
+    """Shared tier codec: 8-byte LE header length, JSON header, then the
+    payload segments. Both the G3 files and G4 objects use exactly this
+    format so blocks demote across tiers byte-for-byte.
+
+    Dense blocks carry two segments (raw k, raw v). Quantized blocks
+    (kvbm/quant.py dicts) carry four — k.q, k.s, v.q, v.s — with the
+    header recording quant="int8_ts", the scale shape, and the original
+    dense dtype so decode restores the exact demotion-time dict."""
+    if is_quantized_block(k):
+        header = json.dumps(
+            {
+                "shape": list(k["q"].shape),
+                "dtype": "int8",
+                "parent": parent_hash,
+                "layout": BLOCK_LAYOUT_VERSION,
+                "quant": "int8_ts",
+                "sshape": list(k["s"].shape),
+                "dt": k.get("dt", "float32"),
+            }
+        ).encode()
+        return (
+            struct.pack("<Q", len(header)) + header
+            + np.ascontiguousarray(k["q"]).tobytes()
+            + np.ascontiguousarray(k["s"]).tobytes()
+            + np.ascontiguousarray(v["q"]).tobytes()
+            + np.ascontiguousarray(v["s"]).tobytes()
+        )
     header = json.dumps(
         {
             "shape": list(k.shape),
@@ -68,18 +99,44 @@ def encode_block(parent_hash, k: np.ndarray, v: np.ndarray) -> bytes:
 
 
 def decode_block(data: bytes):
-    """Inverse of encode_block → (parent_hash, k, v). Raises
-    BlockLayoutMismatch for blocks written under another pool layout."""
+    """Inverse of encode_block → (parent_hash, k, v) — k/v are quantized
+    dicts when the block was stored quantized. Raises BlockLayoutMismatch
+    for blocks written under another pool layout and ValueError for
+    truncated payloads (including a missing/short SCALE segment on
+    quantized blocks — the quarantine path treats both as corrupt)."""
     (hlen,) = struct.unpack("<Q", data[:8])
     header = json.loads(data[8 : 8 + hlen])
     if header.get("layout") != BLOCK_LAYOUT_VERSION:
         raise BlockLayoutMismatch(
             f"block layout {header.get('layout')} != {BLOCK_LAYOUT_VERSION}"
         )
-    dtype = _np_dtype(header["dtype"])
     shape = tuple(header["shape"])
-    n = int(np.prod(shape)) * dtype.itemsize
     off = 8 + hlen
+    if header.get("quant") == "int8_ts":
+        sshape = tuple(header["sshape"])
+        nq = int(np.prod(shape))  # int8: 1 byte/elem
+        ns = int(np.prod(sshape)) * 4  # f32 scales
+        if len(data) - off != 2 * (nq + ns):
+            raise ValueError(
+                f"quantized block payload {len(data) - off}B != expected "
+                f"{2 * (nq + ns)}B (scale segment missing or truncated)"
+            )
+        dt = header.get("dt", "float32")
+
+        def seg(o, n, dtype, shp):
+            return np.frombuffer(data[o : o + n], dtype=dtype).reshape(shp)
+
+        k = {"q": seg(off, nq, np.int8, shape),
+             "s": seg(off + nq, ns, np.float32, sshape), "dt": dt}
+        v = {"q": seg(off + nq + ns, nq, np.int8, shape),
+             "s": seg(off + 2 * nq + ns, ns, np.float32, sshape), "dt": dt}
+        return header.get("parent"), k, v
+    dtype = _np_dtype(header["dtype"])
+    n = int(np.prod(shape)) * dtype.itemsize
+    if len(data) - off < 2 * n:
+        raise ValueError(
+            f"block payload {len(data) - off}B < expected {2 * n}B"
+        )
     k = np.frombuffer(data[off : off + n], dtype=dtype).reshape(shape)
     v = np.frombuffer(data[off + n : off + 2 * n], dtype=dtype).reshape(shape)
     return header.get("parent"), k, v
@@ -89,14 +146,21 @@ class DiskKvPool:
     """Content-addressed KV block store on disk. Same match/get/put surface
     as HostKvPool so the tier chain composes them uniformly."""
 
-    def __init__(self, root: str, capacity_blocks: int = 1 << 16):
+    def __init__(self, root: str, capacity_blocks: int = 1 << 16,
+                 quantize: bool = False):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.capacity = capacity_blocks
+        # quantize dense blocks on entry (blocks demoted from a quantized
+        # G2 arrive as dicts already and pass through untouched)
+        self.quantize = quantize
         # LRU index: hash → parent (file presence is authoritative for data)
         self._blocks: "OrderedDict[int, Optional[int]]" = OrderedDict()
         self._hash_only: set = set()  # sim entries with no file behind them
-        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
+        self._bytes: Dict[int, int] = {}  # hash → stored payload bytes
+        self._quant: set = set()  # hashes stored int8+scales
+        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0,
+                      "stored_bytes": 0, "quant_blocks": 0}
         self._evict_listeners: List[Any] = []
         self._lock = threading.Lock()
         # demotion: called with (hash, parent, k, v) before an LRU drop so
@@ -137,16 +201,24 @@ class DiskKvPool:
                                 name, header.get("layout"))
                     os.unlink(path)
                     continue
+                payload = max(0, os.path.getsize(path) - 8 - hlen)
                 entries.append(
-                    (os.path.getmtime(path), int(name[:-4], 16), header.get("parent"))
+                    (os.path.getmtime(path), int(name[:-4], 16),
+                     header.get("parent"), payload,
+                     header.get("quant") == "int8_ts")
                 )
             except (OSError, ValueError, struct.error):
                 try:
                     os.unlink(path)
                 except OSError:
                     pass
-        for _, h, parent in sorted(entries):
+        for _, h, parent, payload, quant in sorted(entries):
             self._blocks[h] = parent
+            self._bytes[h] = payload
+            self.stats["stored_bytes"] += payload
+            if quant:
+                self._quant.add(h)
+                self.stats["quant_blocks"] += 1
         if entries:
             log.info("G3 rescan adopted %d blocks from %s", len(entries), self.root)
         self._enforce_capacity()
@@ -166,6 +238,14 @@ class DiskKvPool:
             finally:
                 with self._lock:
                     self._outstanding -= 1
+
+    def _drop_accounting(self, block_hash: int) -> None:
+        """Caller holds self._lock. Byte/quant bookkeeping for a block
+        leaving the index (evict, clear, quarantine)."""
+        self.stats["stored_bytes"] -= self._bytes.pop(block_hash, 0)
+        if block_hash in self._quant:
+            self._quant.discard(block_hash)
+            self.stats["quant_blocks"] -= 1
 
     def pin(self, block_hash: int) -> None:
         with self._lock:
@@ -248,6 +328,10 @@ class DiskKvPool:
             self._hash_only.clear()
             self._pending.clear()
             self._pinned.clear()
+            self._bytes.clear()
+            self._quant.clear()
+            self.stats["stored_bytes"] = 0
+            self.stats["quant_blocks"] = 0
         for h in dropped:
             try:
                 _os.unlink(self._path(h))
@@ -277,9 +361,11 @@ class DiskKvPool:
         self,
         block_hash: int,
         parent_hash: Optional[int],
-        k: Optional[np.ndarray],  # [L, Hk, PS, D] one block, or None (sim)
-        v: Optional[np.ndarray],
+        k: Any,  # [L, PS, Hk, D] one token-major block, a quantized
+        v: Any,  # dict (kvbm/quant.py), or None (sim)
     ) -> None:
+        if self.quantize:
+            k, v = maybe_quantize(k), maybe_quantize(v)
         with self._lock:
             if block_hash in self._blocks:
                 self._blocks.move_to_end(block_hash)
@@ -287,6 +373,11 @@ class DiskKvPool:
             self._blocks[block_hash] = parent_hash
             if k is not None:
                 self._pending[block_hash] = (k, v)
+                self._bytes[block_hash] = pair_nbytes(k, v)
+                self.stats["stored_bytes"] += self._bytes[block_hash]
+                if is_quantized_block(k):
+                    self._quant.add(block_hash)
+                    self.stats["quant_blocks"] += 1
             else:
                 self._hash_only.add(block_hash)
             self.stats["offloaded"] += 1
@@ -319,6 +410,7 @@ class DiskKvPool:
                     break
                 parent = self._blocks.pop(h)
                 pend = self._pending.pop(h, None)
+                self._drop_accounting(h)
                 dropped.append(h)
                 self.stats["evicted"] += 1
                 if self.spill_hook is None:
@@ -408,9 +500,11 @@ class DiskKvPool:
             return None, None
         except (OSError, KeyError, ValueError, struct.error):
             # truncated or corrupt file (short header, bad JSON, short
-            # payload — e.g. half-written by a crashed process): a data
-            # miss the onboard path recomputes through, NEVER an exception
-            # into it. Unlink + drop the index entry so it stops matching.
+            # payload — including a missing/size-mismatched SCALE segment
+            # on int8+scales blocks, e.g. half-written by a crashed
+            # process): a data miss the onboard path recomputes through,
+            # NEVER an exception into it. Unlink + drop the index entry
+            # so it stops matching.
             log.warning("block %x truncated/corrupt on disk; unlinking",
                         block_hash, exc_info=True)
             try:
@@ -421,11 +515,13 @@ class DiskKvPool:
                 self._blocks.pop(block_hash, None)
                 self._hash_only.discard(block_hash)
                 self._pinned.discard(block_hash)
+                self._drop_accounting(block_hash)
             return None, None
         return k, v
 
     def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
-        """Stacked [L, n, PS, Hk, D] arrays (HostKvPool-compatible)."""
+        """Stacked dense [L, n, PS, Hk, D] arrays (HostKvPool-compatible;
+        quantized blocks dequantize here)."""
         pairs = [self.get_block(h) for h in hashes]
         # ANY data-less block fails the whole read (stale-layout file can
         # appear mid-chain under a shared root) — np.stack over a None
@@ -433,8 +529,8 @@ class DiskKvPool:
         if not pairs or any(p[0] is None for p in pairs):
             return None, None
         # token-major wire layout: page axis 1
-        k = np.stack([p[0] for p in pairs], axis=1)
-        v = np.stack([p[1] for p in pairs], axis=1)
+        k = np.stack([maybe_dequantize(p[0]) for p in pairs], axis=1)
+        v = np.stack([maybe_dequantize(p[1]) for p in pairs], axis=1)
         return k, v
 
 
@@ -495,7 +591,8 @@ class TieredKv:
 
     def get(self, hashes: List[int]) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
         """Raises KeyError if any block was evicted (from BOTH tiers) after
-        the caller's match() — concurrent spills can churn the disk LRU."""
+        the caller's match() — concurrent spills can churn the disk LRU.
+        Quantized blocks dequantize here; the stacked result is dense."""
         ks, vs = [], []
         for h in hashes:
             if h in self.host:
@@ -510,10 +607,26 @@ class TieredKv:
                 raise KeyError(h)
             if k is None:
                 return None, None
-            ks.append(k)
-            vs.append(v)
+            ks.append(maybe_dequantize(k))
+            vs.append(maybe_dequantize(v))
         # token-major wire layout: page axis 1
         return np.stack(ks, axis=1), np.stack(vs, axis=1)
+
+    def residency(self, hashes: List[int]) -> List[str]:
+        """Tier label per hash — "host" / "disk" / "obj" / "miss" — the
+        attribution the per-tier kv_onboard_s EWMA (topology-aware
+        placement) charges transfer time against."""
+        out = []
+        for h in hashes:
+            if h in self.host:
+                out.append("host")
+            elif self.disk is not None and h in self.disk:
+                out.append("disk")
+            elif self.obj is not None and h in self.obj:
+                out.append("obj")
+            else:
+                out.append("miss")
+        return out
 
     def put(self, hashes, parents, k, v) -> None:
         self.host.put(hashes, parents, k, v)

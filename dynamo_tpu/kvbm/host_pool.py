@@ -7,8 +7,12 @@ prefix-cache hits. The TPU "transfer manager" here is a host array copy —
 device↔host movement happens via the runner's export/import (the same
 primitives the P→D disagg path uses; the reference uses NIXL/GDS).
 
-Capacity is bounded in blocks; eviction is LRU. Data may be None (mocker
-workers track hash-level residency without bytes).
+Capacity is bounded in blocks; eviction is LRU. With quantize=True the
+pool stores int8+scales (kvbm/quant.py) instead of the export dtype —
+~1.94x blocks per byte at D=128 — and dequantizes on get(); an optional
+byte budget (capacity_bytes) then bounds the tier the way an operator
+actually provisions it. Data may be None (mocker workers track
+hash-level residency without bytes).
 """
 
 from __future__ import annotations
@@ -21,6 +25,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .quant import (
+    block_nbytes,
+    is_quantized_block,
+    maybe_dequantize,
+    maybe_quantize,
+    stacked_to_blocks,
+)
+
 log = logging.getLogger("dynamo_tpu.kvbm.host")
 
 
@@ -28,16 +40,28 @@ log = logging.getLogger("dynamo_tpu.kvbm.host")
 class HostBlock:
     block_hash: int
     parent_hash: Optional[int]
-    k: Any  # np.ndarray [L, PS, Hk, D] (one token-major page) or None (sim)
-    v: Any
+    k: Any  # np.ndarray [L, PS, Hk, D] (one token-major page), a
+    v: Any  # quantized dict {"q","s","dt"}, or None (sim)
     stored_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def nbytes(self) -> int:
+        return block_nbytes(self.k) + block_nbytes(self.v)
 
 
 class HostKvPool:
-    def __init__(self, capacity_blocks: int = 4096):
+    def __init__(
+        self,
+        capacity_blocks: int = 4096,
+        quantize: bool = False,
+        capacity_bytes: Optional[int] = None,
+    ):
         self.capacity = capacity_blocks
+        self.capacity_bytes = capacity_bytes
+        self.quantize = quantize
         self._blocks: "OrderedDict[int, HostBlock]" = OrderedDict()  # LRU
-        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
+        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0,
+                      "stored_bytes": 0, "quant_blocks": 0}
         self._evict_listeners: List[Any] = []
         # demotion: called with the full HostBlock before an LRU drop so a
         # lower tier (G3 disk) can absorb the data
@@ -72,19 +96,40 @@ class HostKvPool:
         v: Optional[np.ndarray],
     ) -> None:
         for i, (h, p) in enumerate(zip(hashes, parents)):
-            if h in self._blocks:
-                self._blocks.move_to_end(h)
-                continue
-            # token-major wire layout [L, n, PS, Hk, D]: page axis 1
-            kb = np.ascontiguousarray(k[:, i]) if k is not None else None
-            vb = np.ascontiguousarray(v[:, i]) if v is not None else None
-            self._blocks[h] = HostBlock(h, p, kb, vb)
-            self.stats["offloaded"] += 1
+            kb, vb = stacked_to_blocks(k, v, i)
+            self.put_block(h, p, kb, vb)
         self._enforce_capacity()
+
+    def put_block(
+        self, block_hash: int, parent_hash: Optional[int], k: Any, v: Any
+    ) -> None:
+        """Store one block. Accepts a dense [L, PS, Hk, D] page, an
+        already-quantized dict (promotion from a quantized G3 must not
+        requantize — the fold is idempotent only on exact rehydration),
+        or None (sim). Caller batches _enforce_capacity via put(); direct
+        callers (prefetch promotion) get it per block."""
+        if block_hash in self._blocks:
+            self._blocks.move_to_end(block_hash)
+            return
+        if self.quantize:
+            k, v = maybe_quantize(k), maybe_quantize(v)
+        block = HostBlock(block_hash, parent_hash, k, v)
+        self._blocks[block_hash] = block
+        self.stats["offloaded"] += 1
+        self.stats["stored_bytes"] += block.nbytes
+        if is_quantized_block(k):
+            self.stats["quant_blocks"] += 1
+        self._enforce_capacity()
+
+    def _over_budget(self) -> bool:
+        if len(self._blocks) > self.capacity:
+            return True
+        return (self.capacity_bytes is not None
+                and self.stats["stored_bytes"] > self.capacity_bytes)
 
     def _enforce_capacity(self) -> None:
         dropped: List[int] = []
-        while len(self._blocks) > self.capacity:
+        while self._over_budget():
             # LRU order, skipping pinned blocks; all-pinned → overshoot
             # until the pins release (prefetch pins are TTL-bounded)
             victim = next(
@@ -92,6 +137,9 @@ class HostKvPool:
             if victim is None:
                 break
             block = self._blocks.pop(victim)
+            self.stats["stored_bytes"] -= block.nbytes
+            if is_quantized_block(block.k):
+                self.stats["quant_blocks"] -= 1
             if self.spill_hook is not None:
                 self.spill_hook(block)
             dropped.append(victim)
@@ -107,6 +155,8 @@ class HostKvPool:
         dropped = list(self._blocks)
         self._blocks.clear()
         self._pinned.clear()
+        self.stats["stored_bytes"] = 0
+        self.stats["quant_blocks"] = 0
         if dropped:
             for cb in self._evict_listeners:
                 cb(dropped)
@@ -125,16 +175,28 @@ class HostKvPool:
     def get(
         self, hashes: List[int]
     ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
-        """Stacked [L, n, PS, Hk, D] arrays (None if sim/hash-only)."""
+        """Stacked dense [L, n, PS, Hk, D] arrays (None if sim/hash-only).
+        Quantized blocks dequantize here — the engine/wire boundary stays
+        dense regardless of tier storage."""
         blocks = [self._blocks[h] for h in hashes]
         for b in blocks:
             self._blocks.move_to_end(b.block_hash)
         self.stats["onboarded"] += len(blocks)
         if not blocks or blocks[0].k is None:
             return None, None
-        k = np.stack([b.k for b in blocks], axis=1)
-        v = np.stack([b.v for b in blocks], axis=1)
+        k = np.stack([maybe_dequantize(b.k) for b in blocks], axis=1)
+        v = np.stack([maybe_dequantize(b.v) for b in blocks], axis=1)
         return k, v
+
+    def get_block_raw(self, block_hash: int) -> Tuple[Any, Any]:
+        """One block's (k, v) exactly as stored — quantized dict when the
+        tier quantizes. The native-pass-through onboard path (int8 device
+        pools) uses this to skip the dequantize/requantize round trip.
+        Raises KeyError if evicted since the caller's match()."""
+        b = self._blocks[block_hash]
+        self._blocks.move_to_end(block_hash)
+        self.stats["onboarded"] += 1
+        return b.k, b.v
 
     def lookup_chain(self, hashes: List[int]) -> List[int]:
         return [h for h in hashes if h in self._blocks]

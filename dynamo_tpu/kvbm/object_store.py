@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from dynamo_tpu.kvbm.disk_pool import decode_block, encode_block
+from dynamo_tpu.kvbm.quant import is_quantized_block, maybe_quantize, pair_nbytes
 
 log = logging.getLogger("dynamo_tpu.kvbm.object")
 
@@ -113,11 +114,16 @@ class ObjectKvPool:
     stores are effectively unbounded — the cap only bounds the local
     index)."""
 
-    def __init__(self, backend, capacity_blocks: int = 1 << 20):
+    def __init__(self, backend, capacity_blocks: int = 1 << 20,
+                 quantize: bool = False):
         self.backend = backend
         self.capacity = capacity_blocks
+        # quantize dense blocks on entry (blocks demoted from quantized
+        # upper tiers arrive as dicts already and pass through untouched)
+        self.quantize = quantize
         self._blocks: "OrderedDict[int, Optional[int]]" = OrderedDict()
-        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0}
+        self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0,
+                      "stored_bytes": 0, "quant_blocks": 0}
         self._evict_listeners: List[Any] = []
         self._lock = threading.Lock()
         self._hash_only: set = set()  # entries with no data behind them
@@ -165,6 +171,8 @@ class ObjectKvPool:
             return len(self._blocks)
 
     def put_block(self, block_hash, parent_hash, k, v) -> None:
+        if self.quantize:
+            k, v = maybe_quantize(k), maybe_quantize(v)
         with self._lock:
             if block_hash in self._blocks:
                 self._blocks.move_to_end(block_hash)
@@ -178,6 +186,9 @@ class ObjectKvPool:
                 self.stats["offloaded"] += 1
             if k is not None:
                 self._pending[block_hash] = (k, v, parent_hash)
+                self.stats["stored_bytes"] += pair_nbytes(k, v)
+                if is_quantized_block(k):
+                    self.stats["quant_blocks"] += 1
             else:
                 self._hash_only.add(block_hash)
         if k is not None:
@@ -257,5 +268,15 @@ class ObjectKvPool:
             # same path as an externally-deleted object
             log.warning("G4 object %x has a stale block layout; ignoring",
                         block_hash)
+            return None, None
+        except (KeyError, ValueError, struct.error):
+            # truncated/corrupt object (short payload, missing scale
+            # segment on int8+scales blocks): data miss, drop the local
+            # index entry so it stops matching. The object itself stays —
+            # deletion from a shared store is the operator's GC policy.
+            log.warning("G4 object %x truncated/corrupt; ignoring",
+                        block_hash, exc_info=True)
+            with self._lock:
+                self._blocks.pop(block_hash, None)
             return None, None
         return k, v

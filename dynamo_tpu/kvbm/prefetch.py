@@ -48,6 +48,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
+from .quant import pair_nbytes, quantized_ratio
+
 log = logging.getLogger("dynamo_tpu.kvbm.prefetch")
 
 # job states
@@ -116,6 +118,12 @@ class PrefetchManager:
             "no_space": 0,         # device pool full, left to sync path
             "lost": 0,             # block evicted out from under the job
             "bytes_promoted": 0,
+            # per-hop split at the ACTUAL stored width (int8+scales tiers
+            # move ~0.52x the dense bytes): G3→G2 file-read bytes vs
+            # G2→G1 device-import bytes (always dense — the import
+            # boundary dequantizes)
+            "bytes_promoted_g3": 0,
+            "bytes_promoted_g2": 0,
             "reading_peak": 0,
             "promote_latency_sum_s": 0.0,
         }
@@ -245,9 +253,10 @@ class PrefetchManager:
             self._pump()
             return
         if k is not None:
-            # [L, PS, Hk, D] -> [L, 1, PS, Hk, D]: host put slices page axis 1
-            self.tiered.host.put([h], [job.parent], k[:, None], v[:, None])
-            nbytes = k.nbytes + v.nbytes
+            # one [L, PS, Hk, D] block — dense or quantized dict, exactly
+            # as G3 stored it; the host tier absorbs either form
+            self.tiered.host.put_block(h, job.parent, k, v)
+            nbytes = pair_nbytes(k, v)
         elif not self._sim_runner():
             # real engine, data-less read (corrupt/truncated file was
             # unlinked underneath us): nothing to promote
@@ -256,16 +265,27 @@ class PrefetchManager:
             return
         else:
             self.tiered.host.put([h], [job.parent], None, None)
-            nbytes = self.sim_block_bytes
+            nbytes = int(self.sim_block_bytes * self._tier_byte_ratio())
         if self._limited:
             self._budget_bytes -= nbytes
         self.stats["bytes_promoted"] += nbytes
+        self.stats["bytes_promoted_g3"] += nbytes
         job.state = QUEUED  # now host-resident: next stage
         self._promote_from_host(job)
         self._pump()
 
     def _sim_runner(self) -> bool:
         return not hasattr(self.engine.runner, "export_pages_device")
+
+    def _tier_byte_ratio(self) -> float:
+        """Stored-bytes scale for hash-only (sim) budget charges: 1.0 for
+        dense tiers, the int8+scales ratio when the tier quantizes."""
+        if not getattr(self.tiered.host, "quantize", False):
+            return 1.0
+        shape = getattr(self.engine.runner, "kv_page_shape", None)
+        if shape:
+            return quantized_ratio(int(shape[-1]))
+        return quantized_ratio(128)
 
     # -- G2 → G1 -------------------------------------------------------------
     def _promote_from_host(self, job: _Job) -> None:
@@ -307,6 +327,7 @@ class PrefetchManager:
             self._budget_bytes -= nbytes
         self.stats["promoted"] += 1
         self.stats["bytes_promoted"] += nbytes
+        self.stats["bytes_promoted_g2"] += nbytes
         self.stats["promote_latency_sum_s"] += now - job.t0
         self._m_bytes.inc(nbytes)
 

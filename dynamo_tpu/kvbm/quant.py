@@ -1,0 +1,126 @@
+"""Shared int8 tier codec for demoted KV blocks (G2/G3/G4).
+
+The ragged/decode kernels already consume int8 KV pools in the dict
+convention {"q": int8 [..., D], "s": f32 [...]} with one symmetric scale
+per (token, head) vector (models/quant.py kv_quantize). This module is
+the same fold in plain numpy — no jax import, so mocker workers and the
+disk writer thread can run it — applied per BLOCK at the demotion
+boundary: a block quantizes once when it leaves the device tier and the
+int8+scales pair is what G2 DRAM, G3 files, and G4 objects store.
+
+Why it matters: a bf16/fp16 KV vector is 2*D bytes; quantized it is
+D + 4 bytes (int8 payload + one f32 scale). At D=128 that is 132 vs 256
+bytes — 1.94x effective capacity for every cold tier at the same byte
+budget, which is the difference between holding a prefix cache for a
+user population and thrashing it.
+
+Promotion either dequantizes back to the pool dtype (dense-pool runners,
+the disagg wire — KV_WIRE_LAYOUT_VERSION stays dense so heterogeneous
+workers interoperate) or passes q/s through natively when the runner's
+device pool is itself int8-quantized (kv_quantize="int8"): same fold,
+same layout, zero requantization error on the hot path.
+
+A quantized block-side array is the dict {"q": int8 [L, PS, Hk, D],
+"s": float32 [L, PS, Hk], "dt": "<original dtype str>"} — "dt" records
+the pre-quantization dtype so promotion restores exactly what the
+runner exported.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if "bfloat16" in name:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def is_quantized_block(x: Any) -> bool:
+    """True for a tier-codec quantized array (dict with q/s leaves)."""
+    return isinstance(x, dict) and "q" in x and "s" in x
+
+
+def quantize_block(x: np.ndarray) -> Dict[str, Any]:
+    """Dense [..., D] → {"q": int8 [..., D], "s": f32 [...], "dt": str}.
+
+    Bit-exact match of the device-side fold (models/quant.py
+    kv_quantize): amax over the head dim in f32, s = max(amax, 1e-8)/127,
+    q = clip(round(x/s), -127, 127). np.round and jnp.round both use
+    round-half-to-even, so a tier-quantized block and a device-quantized
+    page of the same data carry identical q/s.
+    """
+    xf = np.asarray(x).astype(np.float32)
+    amax = np.max(np.abs(xf), axis=-1)
+    s = (np.maximum(amax, 1e-8) / 127.0).astype(np.float32)
+    q = np.clip(np.round(xf / s[..., None]), -127, 127).astype(np.int8)
+    return {"q": q, "s": s, "dt": str(np.asarray(x).dtype)}
+
+
+def dequantize_block(d: Dict[str, Any], dtype: Optional[Any] = None) -> np.ndarray:
+    """Inverse of quantize_block → dense [..., D] in the recorded dtype
+    (or an explicit override)."""
+    dt = _np_dtype(str(dtype)) if dtype is not None else _np_dtype(d.get("dt", "float32"))
+    return (d["q"].astype(np.float32) * d["s"][..., None]).astype(dt)
+
+
+def maybe_quantize(x: Optional[Any]) -> Optional[Any]:
+    """Quantize a dense array; pass through None (sim hash-only blocks)
+    and already-quantized dicts (re-demotion down the ladder must not
+    double-quantize)."""
+    if x is None or is_quantized_block(x):
+        return x
+    return quantize_block(x)
+
+
+def maybe_dequantize(x: Optional[Any], dtype: Optional[Any] = None) -> Optional[Any]:
+    """Densify a tier array: quantized dicts dequantize, dense arrays and
+    None pass through."""
+    if is_quantized_block(x):
+        return dequantize_block(x, dtype)
+    return x
+
+
+def block_nbytes(x: Optional[Any]) -> int:
+    """Actual stored bytes of a tier array — int8 payload + f32 scales
+    for quantized blocks, raw nbytes for dense, 0 for hash-only."""
+    if x is None:
+        return 0
+    if is_quantized_block(x):
+        return int(x["q"].nbytes) + int(x["s"].nbytes)
+    return int(np.asarray(x).nbytes)
+
+
+def quantized_ratio(head_dim: int, itemsize: int = 2) -> float:
+    """Stored-bytes ratio quantized/dense for a given head dim and dense
+    itemsize: (D + 4) / (D * itemsize). Used for hash-only (sim) byte
+    accounting where no real array exists to measure."""
+    return (head_dim + 4.0) / (head_dim * float(itemsize))
+
+
+def roundtrip_error_bound(x: np.ndarray) -> float:
+    """Max absolute error the symmetric int8 fold can introduce for this
+    data: half a quantization step per vector. Tests use it to bound
+    rehydration drift honestly rather than with a magic tolerance."""
+    amax = np.max(np.abs(np.asarray(x).astype(np.float32)), axis=-1)
+    s = np.maximum(amax, 1e-8) / 127.0
+    return float(np.max(s) * 0.5)
+
+
+def pair_nbytes(k: Optional[Any], v: Optional[Any]) -> int:
+    return block_nbytes(k) + block_nbytes(v)
+
+
+def stacked_to_blocks(
+    k: Optional[np.ndarray], v: Optional[np.ndarray], i: int
+) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """Slice block i out of stacked [L, n, PS, Hk, D] wire arrays (page
+    axis 1), contiguously — the per-block unit every tier stores."""
+    kb = np.ascontiguousarray(k[:, i]) if k is not None else None
+    vb = np.ascontiguousarray(v[:, i]) if v is not None else None
+    return kb, vb

@@ -36,6 +36,12 @@ def parse_args(argv=None):
     p.add_argument("--disk-kv-blocks", type=int, default=0,
                    help="G3 disk KV tier capacity in blocks (needs G2 on)")
     p.add_argument("--disk-kv-root", default=None)
+    p.add_argument("--kv-tier-quantize", action="store_true",
+                   help="int8 + scales storage in the G2/G3 tiers (mocker "
+                        "tiers are hash-only; affects byte accounting)")
+    p.add_argument("--onboard-layer-groups", type=int, default=1,
+                   help="stream tier onboarding in this many layer-group "
+                        "slabs (1 = whole-sequence import)")
     p.add_argument("--prefetch", action="store_true",
                    help="router-hinted predictive KV promotion (needs "
                         "--host-kv-blocks > 0)")
@@ -90,6 +96,8 @@ def build_mock_engine(args) -> tuple[InferenceEngine, ModelCard]:
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_root=getattr(args, "disk_kv_root", None),
+        kv_tier_quantize=getattr(args, "kv_tier_quantize", False),
+        onboard_layer_groups=getattr(args, "onboard_layer_groups", 1),
         prefetch=getattr(args, "prefetch", False),
         prefetch_max_inflight=getattr(args, "prefetch_max_inflight", 4),
         prefetch_bandwidth_mbps=getattr(args, "prefetch_bandwidth_mbps", 0.0),

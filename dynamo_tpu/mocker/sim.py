@@ -42,6 +42,17 @@ class SimTiming:
     # overlap, not a fictional free copy.
     onboard_base_s: float = 0.002
     onboard_per_page_s: float = 0.0002
+    # layer-streamed onboarding (import_pages layer_groups > 1): each
+    # additional layer group issues its own transfer, costing this much
+    # setup on top of its share of the per-page DMA. The model is honest
+    # about both sides of the trade: only the FIRST group blocks the
+    # dispatch (shallow layers must be resident before prefill starts);
+    # the remaining groups stream concurrently with subsequent compute,
+    # but the compute that CONSUMES the pages cannot finish before the
+    # deepest group lands — so the A/B win is bounded by the genuinely
+    # overlappable compute, never a fictional free copy. More groups =
+    # smaller blocking slice but more per-group setup overhead.
+    onboard_group_base_s: float = 0.0005
     speed: float = 1.0  # scale all sleeps; 0 disables (unit tests)
     # prefill_packed cost mode. "ragged" (default) charges
     # sum(chunk_tokens) — the flat-token dispatch the ragged runner path
@@ -189,12 +200,20 @@ class SimRunner:
             "packed_tokens_charged": 0,
             "spec_dispatches": 0,
             "spec_tokens_charged": 0,
+            "onboards_streamed": 0,
+            "onboard_overlap_s": 0.0,
         }
+        # wall-clock instant the deepest in-flight layer group of a
+        # streamed onboard lands (0.0 = nothing in flight). Dispatches
+        # that consume onboarded pages block on it before returning.
+        self._onboard_ready_t = 0.0
+        self._onboard_rest_s = 0.0
 
     # -- ModelRunner interface ---------------------------------------------
     def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int, adapter: int = 0, mm=None):
         t = self.timing
         t.sleep(t.prefill_base_s + len(tokens) * t.prefill_per_token_s)
+        self._drain_onboard()
         # "logits": seeded by the LAST prompt token + position only, so the
         # first sampled token is identical whether the prefix came from
         # cache or was recomputed (chunk-invariant); subsequent decode
@@ -216,6 +235,7 @@ class SimRunner:
         self.stats["packed_tokens_real"] += total
         self.stats["packed_tokens_charged"] += charged
         t.sleep(t.prefill_base_s + charged * t.prefill_per_token_s)
+        self._drain_onboard()
         out = []
         for c in chunks:
             toks = c["tokens"]
@@ -244,6 +264,7 @@ class SimRunner:
             t.dispatch_overhead_s
             + n_steps * (t.decode_base_s + len(tokens) * t.decode_per_seq_s)
         )
+        self._drain_onboard()
         out = np.zeros((len(tokens), n_steps), np.int32)
         for i, (tok, pos) in enumerate(zip(tokens, positions)):
             # chained: each fused step is seeded by the PREVIOUS sampled
@@ -321,6 +342,7 @@ class SimRunner:
             + len(tokens) * t.decode_per_seq_s
             + (charged + chunk_charged) * t.prefill_per_token_s
         )
+        self._drain_onboard()
         rows = []
         for tok, pos, d in zip(tokens, positions, drafts):
             out = np.zeros(len(d) + 1, np.int32)
@@ -351,8 +373,45 @@ class SimRunner:
     def export_pages(self, pages: List[int]):
         return {"data": True, "sim": True, "n_pages": len(pages)}
 
-    def import_pages(self, target_pages, offset: int, payload) -> None:
+    def import_pages(self, target_pages, offset: int, payload,
+                     layer_groups: int = 1) -> None:
         # the transfer isn't free: charge the step-time model so KVBM
         # onboarding (sync or prefetched) costs simulated wall time
         t = self.timing
-        t.sleep(t.onboard_base_s + len(target_pages) * t.onboard_per_page_s)
+        dma = len(target_pages) * t.onboard_per_page_s
+        g = max(1, int(layer_groups))
+        if g == 1 or t.speed <= 0:
+            t.sleep(t.onboard_base_s + dma)
+            return
+        # layer-streamed: block only for the first group (shallow layers
+        # must be resident before prefill issues); the remaining groups
+        # keep streaming while later compute runs. Their landing time is
+        # recorded as a wall-clock deadline that the NEXT consuming
+        # dispatch waits out — overlapped transfer is hidden only to the
+        # extent real compute covers it, never dropped. Each extra group
+        # pays its own issue setup (onboard_group_base_s), so very large
+        # G values are honestly counter-productive.
+        self.stats["onboards_streamed"] += 1
+        t.sleep(t.onboard_base_s + dma / g)
+        rest = dma * (g - 1) / g + (g - 1) * t.onboard_group_base_s
+        self._onboard_ready_t = max(
+            self._onboard_ready_t, time.monotonic() + rest * t.speed
+        )
+        self._onboard_rest_s = rest * t.speed
+
+    def _drain_onboard(self) -> None:
+        """Block until in-flight streamed layer groups have landed. Called
+        at the tail of every consuming dispatch: the dispatch's own compute
+        already advanced the clock, so only the uncovered remainder (if
+        any) is slept — that remainder is exactly the non-overlapped part
+        of the transfer."""
+        if self._onboard_ready_t <= 0.0:
+            return
+        rem = self._onboard_ready_t - time.monotonic()
+        self._onboard_ready_t = 0.0
+        hidden = self._onboard_rest_s - max(0.0, rem)
+        if hidden > 0:
+            self.stats["onboard_overlap_s"] += hidden
+        self._onboard_rest_s = 0.0
+        if rem > 0:
+            time.sleep(rem)

@@ -146,6 +146,50 @@ def _scatter_kernel(idx_ref, pool_in_ref, pages_ref, pool_ref):
     pool_ref[...] = pages_ref[...]
 
 
+def _scatter_layers_kernel(idx_ref, off_ref, pool_in_ref, pages_ref, pool_ref):
+    del idx_ref, off_ref, pool_in_ref  # consumed by the index maps
+    pool_ref[...] = pages_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
+def scatter_pages_layers(
+    pool: jax.Array,  # [L, NP, PS, Hk, D] (donated: updated in place)
+    idx: jax.Array,  # [n] int32 target page ids (unique)
+    pages: jax.Array,  # [Lg, n, PS, Hk, D] one layer GROUP of pages
+    layer_off: jax.Array,  # [1] int32 first pool layer the group lands in
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Layer-streamed import half: write a contiguous layer-group slab
+    into pool layers [layer_off, layer_off+Lg) at page slots `idx`. The
+    streamed onboard (FlowKV-style) calls this once per group so the
+    shallow layers are device-resident — and prefill can start — while
+    deeper groups are still crossing host→HBM. Same donation/aliasing
+    contract as scatter_pages; both prefetched scalars (page list, layer
+    offset) are consumed by the output index map."""
+    L, NP, PS, Hk, D = pool.shape
+    Lg = pages.shape[0]
+    n = idx.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # idx, layer_off
+        grid=(Lg, n),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # pool: aliased, unread
+            pl.BlockSpec((None, None, PS, Hk, D),
+                         lambda l, i, idx, off: (l, i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, PS, Hk, D),
+                               lambda l, i, idx, off: (off[0] + l, idx[i], 0, 0, 0)),
+    )
+    return pl.pallas_call(
+        _scatter_layers_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases={2: 0},  # pool (after idx, layer_off) → out
+        interpret=interpret,
+    )(idx, layer_off, pool, pages)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
 def scatter_pages(
     pool: jax.Array,  # [(L,) NP, PS, Hk, D] (donated: updated in place)

@@ -50,6 +50,9 @@ class KvRouter:
         admission: Optional["AdmissionConfig"] = None,
         prefetch_hints: bool = True,  # emit kv_prefetch ahead of dispatch
         #   to workers advertising a PrefetchManager (kvbm/prefetch.py)
+        tier_cost_fn=None,  # () -> {worker: {tier: s_per_block}} — measured
+        #   onboard costs (FleetObserver.onboard_costs) for topology-aware
+        #   placement; None keeps the config's constant-credit priors
     ):
         from dynamo_tpu.router.queue import AdmissionConfig, AdmissionQueue
 
@@ -96,6 +99,13 @@ class KvRouter:
         self._prefetch_client = None  # lazy: {ns}/{comp}/kv_prefetch
         self._prefetch_bad: set = set()
         self._prefetch_tasks: set = set()
+        # topology-aware placement: measured per-(worker, tier) onboard
+        # costs, snapshotted at most once a second — find_best_match is
+        # the per-request hot path and the EWMAs only move at digest
+        # cadence anyway
+        self.tier_cost_fn = tier_cost_fn
+        self._tier_costs_cache: Dict[Worker, Dict[str, float]] = {}
+        self._tier_costs_at = 0.0
         self._sync_pub = None
         self._sync_sub = None
         self._sync_inst = None
@@ -334,6 +344,24 @@ class KvRouter:
         raise RuntimeError("empty kv dump")
 
     # -- selection ---------------------------------------------------------
+    def bind_tier_costs(self, fn) -> None:
+        """Late-bind the measured-cost source (the FleetObserver is built
+        after the routers when the frontend wires its status plane)."""
+        self.tier_cost_fn = fn
+
+    def _tier_costs(self) -> Dict[Worker, Dict[str, float]]:
+        if self.tier_cost_fn is None:
+            return {}
+        now = time.monotonic()
+        if now - self._tier_costs_at > 1.0:
+            try:
+                self._tier_costs_cache = self.tier_cost_fn() or {}
+            except Exception:
+                log.debug("tier cost snapshot failed", exc_info=True)
+                self._tier_costs_cache = {}
+            self._tier_costs_at = now
+        return self._tier_costs_cache
+
     def workers(self) -> List[Worker]:
         out: List[Worker] = []
         for inst in self.client.instances.values():
@@ -405,6 +433,7 @@ class KvRouter:
         worker, overlap = self.selector.select(
             workers, len(hashes), overlaps, self.sequences,
             host_overlaps=host_overlaps, audit=cand_audit,
+            tier_costs=self._tier_costs(),
         )
         if collect is not None:
             collect["candidates"] = cand_audit

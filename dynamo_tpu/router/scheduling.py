@@ -40,7 +40,22 @@ class KvRouterConfig:
     # costs more than local host DRAM
     remote_credit: float = 0.3
     disk_credit: float = 0.3
+    # topology-aware placement: measured recompute cost of one block of
+    # prefill (page_size x per-token time; default matches the mocker's
+    # 16 tok x 40us). When select() is given measured per-(worker, tier)
+    # onboard costs (fleet-digest kv_onboard_s EWMAs), a tier's credit
+    # weight becomes 1 - min(1, onboard_s_per_block / recompute_block_s)
+    # — a tier slower than recompute earns NO credit and routing flips to
+    # recompute/peers. The constants above stay as cold-start priors for
+    # workers that haven't measured a tier yet.
+    recompute_block_s: float = 0.00064
     seed: Optional[int] = None
+
+    def credit_fraction(self, s_per_block: float) -> float:
+        """Measured credit weight for a tier: the fraction of a block's
+        recompute cost that onboarding from the tier saves."""
+        denom = max(1e-9, self.recompute_block_s)
+        return max(0.0, 1.0 - min(1.0, float(s_per_block) / denom))
 
 
 class WorkerSelector:
@@ -56,11 +71,21 @@ class WorkerSelector:
         sequences: ActiveSequences,
         host_overlaps: Optional[Dict[Worker, int]] = None,
         audit: Optional[List[dict]] = None,
+        tier_costs: Optional[Dict[Worker, Dict[str, float]]] = None,
     ) -> Tuple[Worker, int]:
         """Returns (worker, device_overlap_blocks). Raises if no workers.
 
         `audit`, when given, is filled with one per-candidate cost
-        breakdown dict (routing decision audit, /debug/routing)."""
+        breakdown dict (routing decision audit, /debug/routing).
+
+        `tier_costs` is the topology-aware input: per-(worker, tier)
+        measured onboard seconds/block (FleetObserver.onboard_costs —
+        phase-spine kv_onboard_s EWMAs off the fleet digests). A worker's
+        host credit becomes credit_fraction(host_s); the cross-worker
+        pull leg prices the network fetch PLUS the candidate's own
+        host->device onboard. Missing measurements fall back to the
+        config's constant priors, and the audit records which source
+        priced each leg."""
         if not workers:
             raise RuntimeError("no workers available for KV routing")
         cfg = self.config
@@ -69,10 +94,22 @@ class WorkerSelector:
         for w in workers:
             dev = overlaps.scores.get(w, 0)
             host = (host_overlaps or {}).get(w, 0)
-            credit = cfg.device_credit * dev + cfg.host_credit * max(0, host - dev)
+            tc = (tier_costs or {}).get(w) or {}
+            if "host" in tc:
+                host_w, host_src = cfg.credit_fraction(tc["host"]), "measured"
+            else:
+                host_w, host_src = cfg.host_credit, "prior"
+            if "remote" in tc and "host" in tc:
+                # the full peer-pull path: network fetch leg + this
+                # candidate's own host->device import of the pulled blocks
+                remote_w = cfg.credit_fraction(tc["remote"] + tc["host"])
+                remote_src = "measured"
+            else:
+                remote_w, remote_src = cfg.remote_credit, "prior"
+            credit = cfg.device_credit * dev + host_w * max(0, host - dev)
             # cluster-wide lower-tier residency: blocks any peer holds can
             # be onboarded cross-worker, so they discount every candidate
-            credit += cfg.remote_credit * max(0, cluster_host - max(dev, host))
+            credit += remote_w * max(0, cluster_host - max(dev, host))
             new_blocks = max(0.0, total_blocks - cfg.overlap_weight * credit)
             prefill = new_blocks + sequences.prefill_blocks(w)
             decode = sequences.decode_blocks(w)
@@ -83,6 +120,9 @@ class WorkerSelector:
                     "overlap_blocks": dev,
                     "host_overlap_blocks": host,
                     "credit": round(credit, 3),
+                    "host_credit_w": round(host_w, 3),
+                    "remote_credit_w": round(remote_w, 3),
+                    "credit_src": {"host": host_src, "remote": remote_src},
                     "new_blocks": round(new_blocks, 3),
                     "prefill_blocks": round(prefill, 3),
                     "decode_blocks": round(decode, 3),

@@ -217,18 +217,45 @@ class DigestBuilder:
         }
         if engine is not None:
             g2 = g3 = 0
+            tiers: Dict[str, Any] = {}
             host_pool = getattr(engine, "host_pool", None)
             if host_pool is not None:
                 try:
                     g2 = len(host_pool.host)
                     if getattr(host_pool, "disk", None) is not None:
                         g3 = len(host_pool.disk)
+                    # per-tier byte/quantization occupancy (int8 tiered
+                    # storage): stored_bytes is the ACTUAL footprint at
+                    # the stored width, quant_blocks the int8 fraction's
+                    # numerator. dynamo_top renders effective-vs-raw
+                    # capacity from these; the router's measured-cost
+                    # placement reads onboard_ewma below.
+                    for name, pool in (("host", getattr(host_pool, "host", None)),
+                                       ("disk", getattr(host_pool, "disk", None)),
+                                       ("obj", getattr(host_pool, "obj", None))):
+                        st = getattr(pool, "stats", None)
+                        if not isinstance(st, dict):
+                            continue
+                        tiers[name] = {
+                            "blocks": len(pool) if hasattr(pool, "__len__") else 0,
+                            "stored_bytes": int(st.get("stored_bytes", 0)),
+                            "quant_blocks": int(st.get("quant_blocks", 0)),
+                        }
                 except Exception:
                     log.debug("host pool size probe failed", exc_info=True)
             digest["kv"] = {
                 "g1_usage": digest["queue"].get("kv_usage", 0.0),
                 "g2_blocks": g2, "g3_blocks": g3,
             }
+            if tiers:
+                digest["kv"]["tiers"] = tiers
+            ewma = getattr(engine, "kv_onboard_ewma", None)
+            if ewma:
+                digest["kv"]["onboard_ewma"] = {
+                    t: {"s_per_block": round(float(v.get("s_per_block", 0.0)), 6),
+                        "n": int(v.get("n", 0))}
+                    for t, v in ewma.items()
+                }
             pf = getattr(engine, "prefetch", None)
             if pf is not None:
                 digest["prefetch"] = {
@@ -434,6 +461,28 @@ class FleetObserver:
                         h = merged[phase] = new_hist()
                     merge_hist(h, counts)
         return merged
+
+    def onboard_costs(self, now: Optional[float] = None,
+                      window_s: Optional[float] = None
+                      ) -> Dict[Worker, Dict[str, float]]:
+        """Per-worker measured onboarding cost: {worker: {tier:
+        s_per_block}} from the newest in-window digest that carried an
+        EWMA block. The KvRouter's topology-aware placement feeds this to
+        WorkerSelector as `tier_costs`; workers that haven't measured a
+        tier yet simply omit it (the selector falls back to its
+        constant-cost priors — cold-start safe)."""
+        out: Dict[Worker, Dict[str, float]] = {}
+        for w, digests in self._window(now, window_s).items():
+            for d in reversed(digests):
+                ewma = (d.get("kv") or {}).get("onboard_ewma")
+                if ewma:
+                    out[w] = {
+                        str(t): float(v.get("s_per_block", 0.0))
+                        for t, v in ewma.items()
+                        if isinstance(v, dict) and v.get("n", 0) > 0
+                    }
+                    break
+        return out
 
     @staticmethod
     def _pct_block(hists: Dict[str, List[int]]) -> Dict[str, Any]:

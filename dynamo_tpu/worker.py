@@ -88,6 +88,14 @@ def parse_args(argv=None):
     p.add_argument("--obj-kv-root", default=None,
                    help="G4 object-store root (shared mount; enables the "
                         "terminal KV tier)")
+    p.add_argument("--kv-tier-quantize", action="store_true",
+                   help="store demoted G2/G3/G4 blocks as int8 + per-"
+                        "(token, head) scales (~1.9x blocks per byte at "
+                        "D=128); G1 device hits stay full precision")
+    p.add_argument("--onboard-layer-groups", type=int, default=1,
+                   help="stream tier onboarding in this many layer-group "
+                        "slabs so prefill starts after the first slab "
+                        "lands (1 = whole-sequence import)")
     p.add_argument("--prefetch", action="store_true",
                    help="router-hinted predictive KV promotion (needs "
                         "--host-kv-blocks > 0); advertises kv_prefetch so "
@@ -430,6 +438,8 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_blocks=args.disk_kv_blocks, disk_kv_root=args.disk_kv_root,
         obj_kv_root=args.obj_kv_root,
+        kv_tier_quantize=getattr(args, "kv_tier_quantize", False),
+        onboard_layer_groups=getattr(args, "onboard_layer_groups", 1),
         prefetch=getattr(args, "prefetch", False),
         prefetch_max_inflight=getattr(args, "prefetch_max_inflight", 4),
         prefetch_bandwidth_mbps=getattr(args, "prefetch_bandwidth_mbps", 0.0),

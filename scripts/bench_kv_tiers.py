@@ -1,0 +1,253 @@
+"""Memory-heterogeneous KV plane A/B: int8 tiers, streamed onboard,
+topology-aware placement.
+
+Three sections, one JSON line (scripts/bench_*.py convention):
+
+  capacity   — real numpy blocks into a byte-budgeted HostKvPool, dense
+               vs int8+scales: resident blocks and replay hit-rate at the
+               SAME capacity_bytes. Acceptance: quantized holds >= 1.8x.
+  streamed   — mocker engine, long warm-G2 prefix: whole-sequence onboard
+               (layer_groups=1) vs layer-streamed (groups=N) TTFT p50.
+               The sim charges the honest overlap model (SimTiming
+               onboard_group_base_s): the win is bounded by the prefill
+               compute the deeper groups genuinely hide behind.
+  routing    — multi-worker placement sim where each worker's ACTUAL
+               host-tier onboard seconds/block is drawn independently of
+               the router's constant-credit priors (one worker's G2 sits
+               behind a pathologically slow path but holds the most
+               prefixes — the trap case). Arm A routes on priors, arm B
+               on measured per-(worker, tier) costs (what the fleet
+               digests' kv_onboard_s EWMAs feed the live router). Both
+               arms pay the IDENTICAL actual costs; only the selector's
+               credit weights differ. Acceptance: measured beats
+               overlap-only on TTFT p99.
+
+Deterministic, CPU-only:
+
+    JAX_PLATFORMS=cpu python scripts/bench_kv_tiers.py [--speed 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dynamo_tpu.engine.engine import InferenceEngine  # noqa: E402
+from dynamo_tpu.kvbm.host_pool import HostKvPool  # noqa: E402
+from dynamo_tpu.mocker.sim import SimRunner, SimTiming  # noqa: E402
+from dynamo_tpu.router.protocols import OverlapScores  # noqa: E402
+from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector  # noqa: E402
+from dynamo_tpu.router.sequences import ActiveSequences  # noqa: E402
+from dynamo_tpu.runtime.context import Context  # noqa: E402
+from dynamo_tpu.tokens.hashing import block_hashes  # noqa: E402
+
+
+# -- section 1: capacity / hit-rate at equal byte budget ---------------------
+
+def capacity_ab(n_blocks: int = 200, budget_blocks: int = 64) -> dict:
+    """Insert `n_blocks` real [L, PS, Hk, D] float16 blocks into a pool
+    byte-budgeted for `budget_blocks` DENSE blocks; replay-probe residency."""
+    L, PS, Hk, D = 4, 16, 2, 128
+    rng = np.random.default_rng(7)
+    dense_block = 2 * (L * PS * Hk * D * 2)  # k+v, float16
+    budget = budget_blocks * dense_block
+    out = {}
+    for name, quantize in (("dense", False), ("int8", True)):
+        pool = HostKvPool(capacity_blocks=10 * n_blocks, quantize=quantize,
+                          capacity_bytes=budget)
+        for h in range(1, n_blocks + 1):
+            k = rng.standard_normal((L, PS, Hk, D)).astype(np.float16)
+            v = rng.standard_normal((L, PS, Hk, D)).astype(np.float16)
+            pool.put_block(h, h - 1 if h > 1 else None, k, v)
+        resident = len(pool)
+        hits = sum(1 for h in range(1, n_blocks + 1) if h in pool)
+        out[name] = {
+            "resident_blocks": resident,
+            "stored_bytes": pool.stats["stored_bytes"],
+            "quant_blocks": pool.stats["quant_blocks"],
+            "replay_hit_rate": round(hits / n_blocks, 4),
+        }
+    out["capacity_bytes"] = budget
+    out["blocks_offered"] = n_blocks
+    out["capacity_ratio"] = round(
+        out["int8"]["resident_blocks"] / max(1, out["dense"]["resident_blocks"]), 3)
+    return out
+
+
+# -- section 2: streamed vs whole-sequence onboard TTFT ----------------------
+
+def _prompt(i: int, isl: int) -> list:
+    return [(i * 977 + j * 13) % 50000 + 1 for j in range(isl)]
+
+
+def _make_engine(args, layer_groups: int) -> InferenceEngine:
+    runner = SimRunner(
+        num_pages=256, page_size=args.page_size,
+        max_pages_per_seq=args.isl // args.page_size + 8,
+        timing=SimTiming(speed=args.speed),
+    )
+    eng = InferenceEngine(
+        runner, max_batch=2, chunk_size=args.isl + args.page_size * 8,
+        host_kv_blocks=args.n * (args.isl // args.page_size) + 64,
+        onboard_layer_groups=layer_groups,
+    )
+    warm = args.warm_blocks
+    for i in range(args.n):
+        hashes = block_hashes(_prompt(i, args.isl), args.page_size)[:warm]
+        eng.host_pool.put(hashes, [None] + hashes[:-1], None, None)
+    eng.start()
+    return eng
+
+
+async def _ttft(eng, prompt, osl: int = 4) -> float:
+    req = {
+        "token_ids": prompt,
+        "sampling": {"temperature": 0.0},
+        "stop": {"max_tokens": osl, "stop_ids": [], "ignore_eos": True},
+    }
+    t0 = time.perf_counter()
+    async for item in eng.generate(req, Context()):
+        if item["token_ids"]:
+            return time.perf_counter() - t0
+    return time.perf_counter() - t0
+
+
+async def streamed_ab(args) -> dict:
+    out = {}
+    for name, groups in (("whole", 1), ("streamed", args.layer_groups)):
+        eng = _make_engine(args, groups)
+        try:
+            ttfts = []
+            for i in range(args.n):
+                ttfts.append(await _ttft(eng, _prompt(i, args.isl)))
+            ttfts.sort()
+            out[name] = {
+                "ttft_p50_s": round(ttfts[len(ttfts) // 2], 6),
+                "ttft_mean_s": round(sum(ttfts) / len(ttfts), 6),
+                "onboards_streamed": eng.runner.stats["onboards_streamed"],
+                "overlap_hidden_s": round(
+                    eng.runner.stats["onboard_overlap_s"], 6),
+            }
+        finally:
+            eng.stop()
+    out["layer_groups"] = args.layer_groups
+    out["warm_blocks"] = args.warm_blocks
+    out["ttft_p50_delta_s"] = round(
+        out["whole"]["ttft_p50_s"] - out["streamed"]["ttft_p50_s"], 6)
+    out["ttft_p50_speedup"] = round(
+        out["whole"]["ttft_p50_s"] / max(out["streamed"]["ttft_p50_s"], 1e-9), 3)
+    return out
+
+
+# -- section 3: measured vs prior-credit placement ---------------------------
+
+def routing_ab(n_workers: int = 4, n_requests: int = 400,
+               blocks: int = 64, seed: int = 11) -> dict:
+    """Event-driven placement sim. Worker 0's host tier is slow (its G2
+    onboard costs ~6x a block's recompute) but holds EVERY prefix; the
+    fast workers each hold ~30%. Constant-credit routing is attracted to
+    the big slow tier; measured routing sees kv_onboard_s cross the
+    recompute/peer-pull cost and flips away."""
+    cfg = KvRouterConfig()
+    workers = [(i, 0) for i in range(n_workers)]
+    actual = {w: (6.0 * cfg.recompute_block_s if w[0] == 0 else
+                  0.12 * cfg.recompute_block_s) for w in workers}
+    remote_fetch_s = 0.3 * cfg.recompute_block_s  # per-block network leg
+    base_s = 0.004
+    # arrival rate sized so the fleet is stable when placement is good:
+    # a bad pick (slow-tier onboard) then shows up as tail latency, not
+    # as an unconditional backlog meltdown drowning both arms
+    mean_arrival_s = 0.02
+
+    def run(measured: bool) -> dict:
+        rng = random.Random(seed)
+        sel = WorkerSelector(KvRouterConfig())
+        seqs = ActiveSequences()
+        tier_costs = (
+            {w: {"host": actual[w], "remote": remote_fetch_s} for w in workers}
+            if measured else None
+        )
+        backlog = {w: 0.0 for w in workers}
+        inflight: dict = {}  # rid -> (worker, done_t)
+        t = 0.0
+        ttfts = []
+        for i in range(n_requests):
+            t += rng.expovariate(1.0 / mean_arrival_s)
+            for rid, (w, done) in list(inflight.items()):
+                if done <= t:
+                    seqs.free(rid)
+                    del inflight[rid]
+            host_overlaps = {workers[0]: blocks}
+            for w in workers[1:]:
+                if rng.random() < 0.3:
+                    host_overlaps[w] = blocks
+            w, _ = sel.select(workers, blocks, OverlapScores(scores={}),
+                              seqs, host_overlaps=host_overlaps,
+                              tier_costs=tier_costs)
+            local = host_overlaps.get(w, 0)
+            # actual service cost — identical model for both arms: local
+            # host onboard at the worker's TRUE speed, the rest recomputed
+            service = (base_s + local * actual[w]
+                       + (blocks - local) * cfg.recompute_block_s)
+            start = max(backlog[w], t)
+            backlog[w] = start + service
+            ttfts.append(backlog[w] - t)
+            rid = f"r{i}"
+            seqs.add_request(rid, w, blocks, local)
+            inflight[rid] = (w, backlog[w])
+        ttfts.sort()
+        return {
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 6),
+            "ttft_p99_s": round(ttfts[int(len(ttfts) * 0.99)], 6),
+            "ttft_mean_s": round(sum(ttfts) / len(ttfts), 6),
+        }
+
+    out = {"prior": run(False), "measured": run(True)}
+    out["n_workers"] = n_workers
+    out["n_requests"] = n_requests
+    out["blocks"] = blocks
+    out["slow_worker_onboard_s_per_block"] = round(actual[workers[0]], 6)
+    out["ttft_p99_delta_s"] = round(
+        out["prior"]["ttft_p99_s"] - out["measured"]["ttft_p99_s"], 6)
+    out["ttft_p99_speedup"] = round(
+        out["prior"]["ttft_p99_s"] / max(out["measured"]["ttft_p99_s"], 1e-9), 3)
+    return out
+
+
+async def _amain(args) -> int:
+    result = {
+        "metric": "kv_tiers",
+        "capacity": capacity_ab(),
+        "streamed": await streamed_ab(args),
+        "routing": routing_ab(),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=12,
+                    help="requests for the streamed-onboard arm")
+    ap.add_argument("--isl", type=int, default=1088)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--warm-blocks", type=int, default=64,
+                    help="leading blocks resident in G2 per prompt")
+    ap.add_argument("--layer-groups", type=int, default=4)
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="SimTiming speed scale")
+    args = ap.parse_args()
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

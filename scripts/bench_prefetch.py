@@ -47,6 +47,7 @@ def _make_engine(args, prefetch: bool) -> InferenceEngine:
     eng = InferenceEngine(
         runner, max_batch=2, chunk_size=args.isl,
         host_kv_blocks=args.n * (args.isl // args.page_size) + 64,
+        kv_tier_quantize=args.kv_tier_quantize,
         prefetch=prefetch,
     )
     # steady state under test: prefixes demoted to G2, cold on G1
@@ -96,6 +97,12 @@ async def _run_arm(args, prefetch: bool) -> dict:
             out["promote_latency_mean_s"] = round(
                 eng.prefetch.mean_promote_latency_s, 6)
             out["late"] = st["late"]
+            # per-tier transfer accounting at the ACTUAL stored width
+            # (int8+scales tiers move ~0.52x the dense bytes on the
+            # G3->G2 hop; the G2->G1 device import is always dense)
+            out["bytes_promoted"] = st["bytes_promoted"]
+            out["bytes_promoted_g3"] = st["bytes_promoted_g3"]
+            out["bytes_promoted_g2"] = st["bytes_promoted_g2"]
         return out
     finally:
         eng.stop()
@@ -115,6 +122,10 @@ async def _amain(args) -> int:
         "hit_rate": pf["hit_rate"],
         "promote_latency_mean_s": pf["promote_latency_mean_s"],
         "late": pf["late"],
+        "kv_tier_quantize": args.kv_tier_quantize,
+        "bytes_promoted": pf["bytes_promoted"],
+        "bytes_promoted_g3": pf["bytes_promoted_g3"],
+        "bytes_promoted_g2": pf["bytes_promoted_g2"],
         "ttft_nopf_mean_s": nopf["ttft_mean_s"],
         "ttft_pf_mean_s": pf["ttft_mean_s"],
         "ttft_delta_s": delta,
@@ -137,6 +148,9 @@ def main() -> int:
                     help="hint→arrival lead (simulated queueing delay)")
     ap.add_argument("--speed", type=float, default=1.0,
                     help="SimTiming speed scale (0 disables sleeps)")
+    ap.add_argument("--kv-tier-quantize", action="store_true",
+                    help="int8+scales tier storage: byte accounting then "
+                         "reflects the quantized stored width")
     args = ap.parse_args()
     return asyncio.run(_amain(args))
 

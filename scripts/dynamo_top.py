@@ -33,6 +33,24 @@ def fetch_fleet(base_url: str, window_s: float = 0.0,
         return json.loads(resp.read())
 
 
+def _mb(n: int) -> str:
+    return f"{n / 1e6:.1f}" if n else "-"
+
+
+def _tier_stats(kv: dict) -> tuple:
+    """(g2_mb, g3_mb, quant_pct) from the digest's per-tier occupancy —
+    stored bytes are at the ACTUAL width, so an int8 tier shows ~0.52x
+    the dense footprint for the same block count (effective capacity)."""
+    tiers = kv.get("tiers") or {}
+    g2 = (tiers.get("host") or {})
+    g3 = (tiers.get("disk") or {})
+    blocks = sum((t or {}).get("blocks", 0) for t in tiers.values())
+    quant = sum((t or {}).get("quant_blocks", 0) for t in tiers.values())
+    pct = f"{100.0 * quant / blocks:.0f}" if blocks else "-"
+    return (_mb(g2.get("stored_bytes", 0)), _mb(g3.get("stored_bytes", 0)),
+            pct)
+
+
 def _ms(block: dict, phase: str, pct: str) -> str:
     p = (block or {}).get(phase)
     if not p or p.get(pct) is None:
@@ -72,7 +90,8 @@ def render(view: dict) -> list:
         lines.append("  " + "  ".join(parts))
     lines.append("")
     hdr = (f"{'WORKER':<14} {'RUN':>4} {'WAIT':>4} {'KV%':>5} {'G2':>6} "
-           f"{'G3':>6} {'REQ':>6} {'TTFT99':>8} {'ITL50':>7} {'E2E95':>8} "
+           f"{'G3':>6} {'G2MB':>7} {'G3MB':>7} {'QNT%':>5} {'REQ':>6} "
+           f"{'TTFT99':>8} {'ITL50':>7} {'E2E95':>8} "
            f"{'PFHIT%':>6} {'SLO':>6}")
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -86,10 +105,12 @@ def render(view: dict) -> list:
         total = (hits or 0) + (misses or 0)
         pf_pct = f"{100.0 * hits / total:.0f}" if total else "-"
         kv_usage = kv.get("g1_usage")
+        g2_mb, g3_mb, quant_pct = _tier_stats(kv)
         lines.append(
             f"{wkey:<14} {q.get('n_running', 0):>4} {q.get('n_waiting', 0):>4} "
             f"{(100.0 * kv_usage if kv_usage is not None else 0):>5.1f} "
             f"{kv.get('g2_blocks', 0) or 0:>6} {kv.get('g3_blocks', 0) or 0:>6} "
+            f"{g2_mb:>7} {g3_mb:>7} {quant_pct:>5} "
             f"{(row.get('counters') or {}).get('requests', 0):>6} "
             f"{_ms(phases, 'ttft', 'p99_s'):>8} {_ms(phases, 'itl', 'p50_s'):>7} "
             f"{_ms(phases, 'e2e', 'p95_s'):>8} {pf_pct:>6} "
@@ -100,6 +121,7 @@ def render(view: dict) -> list:
         lines.append("")
         lines.append(
             f"{'fleet':<14} {'':>4} {'':>4} {'':>5} {'':>6} {'':>6} "
+            f"{'':>7} {'':>7} {'':>5} "
             f"{sum((r.get('counters') or {}).get('requests', 0) for r in (view.get('workers') or {}).values()):>6} "
             f"{_ms(fleet_phases, 'ttft', 'p99_s'):>8} "
             f"{_ms(fleet_phases, 'itl', 'p50_s'):>7} "

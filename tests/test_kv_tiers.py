@@ -1,0 +1,537 @@
+"""Memory-heterogeneous KV plane tests (tier-1).
+
+Covers the three coupled pieces of the int8 tiered-storage plane:
+
+- codec parity: the numpy tier codec (kvbm/quant.py) is bit-exact with
+  the device kernels' int8 fold (models/quant.py kv_quantize), and the
+  rehydration error respects the half-step bound the codec advertises;
+- correctness seams: fp16 G1 hits stay byte-identical with quantized
+  tiers enabled-but-unhit; layer-streamed onboarding leaves pool contents
+  identical to a whole-sequence import (dense wire, native int8+scales
+  payloads, and int8 device pools); quantized G3 files with a corrupt
+  scale segment quarantine as a miss, never an exception;
+- topology-aware placement: measured per-(worker, tier) onboard costs
+  flip the router away from a slow tier the constant priors would pick,
+  and the fleet digest / observer plumbing that carries those costs.
+"""
+
+import asyncio
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import InferenceEngine
+from dynamo_tpu.engine.model_runner import (
+    ModelRunner,
+    kv_arrays_to_payload,
+    kv_quant_arrays_to_payload,
+    layer_group_bounds,
+)
+from dynamo_tpu.kvbm.disk_pool import DiskKvPool, _np_dtype
+from dynamo_tpu.kvbm.host_pool import HostKvPool
+from dynamo_tpu.kvbm.quant import (
+    block_nbytes,
+    dequantize_block,
+    is_quantized_block,
+    maybe_dequantize,
+    maybe_quantize,
+    quantize_block,
+    quantized_ratio,
+    roundtrip_error_bound,
+)
+from dynamo_tpu.models.config import get_config
+from dynamo_tpu.router.protocols import OverlapScores
+from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector
+from dynamo_tpu.router.sequences import ActiveSequences
+from dynamo_tpu.runtime.context import Context
+
+
+# -- codec parity with the device fold ----------------------------------
+
+
+def test_codec_matches_device_int8_fold():
+    """The tier codec and the kernels' kv_quantize are the SAME fold:
+    a block quantized at demotion and a page quantized on device from
+    the same data must carry identical q and s."""
+    from dynamo_tpu.models.quant import kv_quantize
+
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((2, 4, 2, 16)) * 3).astype(np.float32)
+    d_np = quantize_block(x)
+    d_dev = kv_quantize(jax.numpy.asarray(x))
+    np.testing.assert_array_equal(d_np["q"], np.asarray(d_dev["q"]))
+    np.testing.assert_array_equal(d_np["s"], np.asarray(d_dev["s"]))
+
+
+def test_roundtrip_within_advertised_bound():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 8, 2, 32)).astype(np.float16)
+    d = quantize_block(x)
+    back = dequantize_block(d)
+    assert back.dtype == x.dtype, "dt must restore the demotion-time dtype"
+    err = np.max(np.abs(back.astype(np.float32) - x.astype(np.float32)))
+    bound = roundtrip_error_bound(x)
+    # fp16 restore adds at most one fp16 ulp on top of the int8 half-step
+    assert err <= bound + np.finfo(np.float16).eps * np.max(np.abs(x))
+    assert bound < 0.1, "bound should be a tight half-step, not a blanket"
+
+
+def test_maybe_quantize_passthrough_and_idempotence():
+    assert maybe_quantize(None) is None  # sim hash-only blocks
+    x = np.ones((1, 2, 1, 8), np.float16)
+    d = maybe_quantize(x)
+    assert is_quantized_block(d)
+    assert maybe_quantize(d) is d, "re-demotion must not double-quantize"
+    assert maybe_dequantize(x) is x  # dense passes through
+
+
+def test_stored_bytes_and_capacity_ratio():
+    x = np.zeros((2, 4, 2, 128), np.float16)
+    d = quantize_block(x)
+    assert block_nbytes(d) < block_nbytes(x)
+    assert block_nbytes(d) / block_nbytes(x) == pytest.approx(
+        quantized_ratio(128), rel=1e-6)
+    assert block_nbytes(None) == 0
+
+
+def test_quantized_host_pool_holds_more_at_equal_byte_budget():
+    """The capacity claim behind the whole plane: >= 1.8x blocks resident
+    under the SAME capacity_bytes when the tier stores int8+scales."""
+    L, PS, Hk, D = 2, 4, 2, 128
+    dense_block = 2 * (L * PS * Hk * D * 2)  # k+v, fp16
+    budget = 10 * dense_block
+    resident = {}
+    for name, q in (("dense", False), ("int8", True)):
+        pool = HostKvPool(capacity_blocks=1024, quantize=q,
+                          capacity_bytes=budget)
+        k = np.ones((L, PS, Hk, D), np.float16)
+        for h in range(1, 41):
+            pool.put_block(h, h - 1 if h > 1 else None, k, k)
+        resident[name] = len(pool)
+    assert resident["dense"] <= 10
+    assert resident["int8"] / max(1, resident["dense"]) >= 1.8
+
+
+# -- engine seams: G1 byte-identity and quantized-tier onboarding -------
+
+
+async def _generate(engine, prompt, n=4):
+    toks = []
+    req = {
+        "token_ids": prompt,
+        "sampling": {"temperature": 0.0},
+        "stop": {"max_tokens": n, "stop_ids": []},
+    }
+    async for item in engine.generate(req, Context()):
+        toks.extend(item["token_ids"])
+        if item["finish_reason"]:
+            break
+    return toks
+
+
+@pytest.fixture(scope="module")
+def quant_engine():
+    runner = ModelRunner(
+        get_config("tiny"),
+        num_pages=16,
+        page_size=4,
+        max_pages_per_seq=8,
+        decode_buckets=(1, 2),
+        prefill_buckets=(8, 16, 32),
+        seed=11,
+    )
+    engine = InferenceEngine(runner, max_batch=2, chunk_size=32,
+                             host_kv_blocks=64, kv_tier_quantize=True)
+    engine.start()
+    yield engine
+    engine.stop()
+
+
+async def test_g1_hit_byte_identical_with_quant_tiers_enabled(quant_engine):
+    """Quantization lives at the DEMOTION boundary only: while blocks are
+    device-resident, a repeat greedy request must reproduce the original
+    output byte-for-byte without touching the quantized tier."""
+    eng = quant_engine
+    assert eng.host_pool.host.quantize is True
+    prompt = list(range(30, 46))  # 16 tokens = 4 pages
+    out_a = await _generate(eng, prompt)
+    onboarded = eng.host_pool.stats["onboarded"]
+    out_b = await _generate(eng, prompt)
+    assert out_b == out_a, "G1 prefix hit must be byte-identical"
+    assert eng.host_pool.stats["onboarded"] == onboarded, \
+        "a device-resident prefix must not onboard from the quantized tier"
+
+
+async def test_quantized_tier_onboard_and_ewma(quant_engine):
+    """Churn until demotion quantizes blocks into G2, then re-request: the
+    onboard path dequantizes and serves, and the measured transfer feeds
+    the per-tier kv_onboard_ewma the router's placement consumes."""
+    eng = quant_engine
+    prompt = list(range(50, 66))
+    out_a = await _generate(eng, prompt)
+    for i in range(6):
+        await _generate(eng, [100 + 7 * i + j for j in range(16)])
+    await asyncio.sleep(0.05)
+    st = eng.host_pool.stats
+    assert st["offloaded"] > 0
+    assert st["quant_blocks"] > 0, "demoted blocks must store int8+scales"
+    assert 0 < st["stored_bytes"] < st["quant_blocks"] * 2 * (
+        2 * 4 * 2 * 64 * 2), "stored bytes must reflect the int8 width"
+    onboarded = st["onboarded"]
+    out_b = await _generate(eng, prompt)
+    assert len(out_b) == len(out_a)
+    assert eng.host_pool.stats["onboarded"] > onboarded, "should hit G2"
+    ewma = eng.kv_onboard_ewma.get("host")
+    assert ewma is not None and ewma["n"] > 0 and ewma["s_per_block"] > 0
+
+
+async def test_digest_carries_tier_occupancy_and_onboard_ewma(quant_engine):
+    """The fleet-digest fields the observer and dynamo_top read: per-tier
+    blocks/stored_bytes/quant_blocks plus the onboard EWMA."""
+    from dynamo_tpu.runtime.fleet_observer import DigestBuilder
+
+    d = DigestBuilder(1).build(engine=quant_engine)
+    tiers = d["kv"]["tiers"]
+    host = quant_engine.host_pool.host
+    assert tiers["host"]["blocks"] == len(host)
+    assert tiers["host"]["stored_bytes"] == host.stats["stored_bytes"]
+    assert tiers["host"]["quant_blocks"] == host.stats["quant_blocks"]
+    ewma = d["kv"]["onboard_ewma"]
+    assert ewma["host"]["n"] > 0 and ewma["host"]["s_per_block"] > 0
+
+
+# -- layer-streamed onboarding: identical pool contents -----------------
+
+
+def test_layer_group_bounds_cover_and_clamp():
+    assert layer_group_bounds(2, 1) == [(0, 2)]
+    assert layer_group_bounds(2, 2) == [(0, 1), (1, 2)]
+    assert layer_group_bounds(2, 5) == [(0, 1), (1, 2)]  # clamps to L
+    bounds = layer_group_bounds(7, 3)
+    assert bounds[0] == (0, 3), "first (blocking) group is never the runt"
+    assert bounds[-1][1] == 7 and all(
+        a[1] == b[0] for a, b in zip(bounds, bounds[1:]))
+
+
+@pytest.fixture(scope="module")
+def import_runner():
+    return ModelRunner(
+        get_config("tiny"),
+        num_pages=16,
+        page_size=4,
+        max_pages_per_seq=8,
+        decode_buckets=(1,),
+        prefill_buckets=(8,),
+        seed=5,
+    )
+
+
+def _wire_pages(runner, n, seed):
+    L, PS, Hk, D = runner.kv_page_shape
+    dt = _np_dtype(runner.kv_wire_dtype)
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((L, n, PS, Hk, D)).astype(dt)
+    v = rng.standard_normal((L, n, PS, Hk, D)).astype(dt)
+    return k, v
+
+
+def test_streamed_import_identical_to_whole_sequence(import_runner):
+    r = import_runner
+    k, v = _wire_pages(r, 3, seed=11)
+    payload = kv_arrays_to_payload(k, v)
+    r.import_pages([1, 2, 3], 0, payload, layer_groups=1)
+    r.import_pages([4, 5, 6], 0, payload, layer_groups=2)
+    r.import_pages([7, 8, 9], 0, payload, layer_groups=7)  # clamps to L
+    kp = np.asarray(jax.device_get(r.k_pool))
+    vp = np.asarray(jax.device_get(r.v_pool))
+    for pool in (kp, vp):
+        np.testing.assert_array_equal(pool[:, [1, 2, 3]], pool[:, [4, 5, 6]])
+        np.testing.assert_array_equal(pool[:, [1, 2, 3]], pool[:, [7, 8, 9]])
+    assert kp[:, [1, 2, 3]].any(), "import must actually write data"
+
+
+def test_streamed_quant_payload_identical_and_rehydrated(import_runner):
+    """Native int8+scales payload into a DENSE pool: both import arms
+    dequantize identically, and the landed pages equal the codec's own
+    rehydration of the q/s pair."""
+    r = import_runner
+    k, v = _wire_pages(r, 2, seed=13)
+    qk, qv = quantize_block(k), quantize_block(v)
+    payload = kv_quant_arrays_to_payload(qk["q"], qk["s"], qv["q"], qv["s"])
+    r.import_pages([10, 11], 0, payload, layer_groups=1)
+    r.import_pages([12, 13], 0, payload, layer_groups=2)
+    kp = np.asarray(jax.device_get(r.k_pool))
+    np.testing.assert_array_equal(kp[:, [10, 11]], kp[:, [12, 13]])
+    expected = (qk["q"].astype(np.float32)
+                * qk["s"][..., None]).astype(kp.dtype)
+    np.testing.assert_array_equal(kp[:, [10, 11]], expected)
+
+
+def test_streamed_import_adds_no_compile_families(import_runner):
+    """Onboarding never rides the ragged dispatch: a streamed import must
+    not create or grow any compiled step-function family (zero new
+    compile cache entries — the acceptance criterion's compile guard)."""
+    r = import_runner
+    before = r.compile_stats()
+    k, v = _wire_pages(r, 2, seed=17)
+    r.import_pages([14, 15], 0, kv_arrays_to_payload(k, v), layer_groups=2)
+    assert r.compile_stats() == before
+
+
+def test_quant_pool_native_int8_passthrough():
+    """int8 device pools adopt a quantized tier payload with NO
+    dequantize/requantize round trip: the pool's q/s slots carry the
+    tier's exact bytes, whole-sequence and streamed alike."""
+    r = ModelRunner(
+        get_config("tiny"),
+        num_pages=8,
+        page_size=4,
+        max_pages_per_seq=8,
+        decode_buckets=(1,),
+        prefill_buckets=(8,),
+        seed=7,
+        kv_quantize="int8",
+    )
+    L, PS, Hk, D = r.kv_page_shape
+    rng = np.random.default_rng(19)
+    k = rng.standard_normal((L, 2, PS, Hk, D)).astype(np.float32)
+    v = rng.standard_normal((L, 2, PS, Hk, D)).astype(np.float32)
+    qk, qv = quantize_block(k), quantize_block(v)
+    payload = kv_quant_arrays_to_payload(qk["q"], qk["s"], qv["q"], qv["s"])
+    r.import_pages([1, 2], 0, payload, layer_groups=1)
+    r.import_pages([3, 4], 0, payload, layer_groups=2)
+    pq = np.asarray(jax.device_get(r.k_pool["q"]))
+    ps = np.asarray(jax.device_get(r.k_pool["s"]))
+    for idx in ([1, 2], [3, 4]):
+        np.testing.assert_array_equal(pq[:, idx], qk["q"])
+        np.testing.assert_array_equal(ps[:, idx], qk["s"])
+
+
+# -- int8+scales disk quarantine ----------------------------------------
+
+
+@pytest.mark.parametrize("corrupt", ["scale_truncated", "half_payload"])
+def test_disk_quantized_corrupt_scale_is_miss_and_unlinked(tmp_path, corrupt):
+    """A quantized G3 file whose scale segment is missing or
+    size-mismatched (half-written by a crashed process) must quarantine
+    exactly like the dense corruption cases: (None, None) miss, file
+    unlinked, index entry dropped — never an exception into onboard."""
+    pool = DiskKvPool(str(tmp_path), capacity_blocks=8, quantize=True)
+    k = np.arange(2 * 4 * 2 * 8, dtype=np.float16).reshape(2, 4, 2, 8)
+    pool.put_block(501, None, k, k * 2)
+    pool.flush()
+    assert pool.stats["quant_blocks"] == 1
+
+    # healthy round trip first: dequantized read within the codec bound
+    kq, vq = pool.get_block(501)
+    assert is_quantized_block(kq) and is_quantized_block(vq)
+    err = np.max(np.abs(maybe_dequantize(kq).astype(np.float32)
+                        - k.astype(np.float32)))
+    assert err <= roundtrip_error_bound(k) + 1e-3
+
+    path = pool._path(501)
+    data = open(path, "rb").read()
+    (hlen,) = struct.unpack("<Q", data[:8])
+    if corrupt == "scale_truncated":
+        open(path, "wb").write(data[:-4])  # last f32 scale cut off
+    else:  # k segments only; the v half (and its scales) never landed
+        open(path, "wb").write(data[: 8 + hlen + (len(data) - 8 - hlen) // 2])
+
+    assert pool.get_block(501) == (None, None)
+    import os
+
+    assert not os.path.exists(path), "corrupt file must be unlinked"
+    assert 501 not in pool, "index entry must drop so it stops matching"
+    assert pool.stats["quant_blocks"] == 0, "accounting must drop too"
+    # healthy sibling still serves
+    pool.put_block(502, None, k, k)
+    pool.flush()
+    k2, _ = pool.get_block(502)
+    assert k2 is not None
+
+
+# -- topology-aware placement -------------------------------------------
+
+
+def test_credit_fraction_bounds_and_monotonicity():
+    cfg = KvRouterConfig()
+    rec = cfg.recompute_block_s
+    assert cfg.credit_fraction(0.0) == 1.0
+    assert cfg.credit_fraction(rec) == 0.0
+    assert cfg.credit_fraction(2 * rec) == 0.0  # clamped, never negative
+    assert cfg.credit_fraction(0.25 * rec) > cfg.credit_fraction(0.5 * rec)
+
+
+def test_measured_onboard_cost_flips_placement():
+    """The tentpole routing behavior: a worker whose host tier holds the
+    whole prefix but onboards SLOWER than recompute wins under constant
+    priors and loses once measured kv_onboard_s costs arrive."""
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    workers = [(0, 0), (1, 0)]
+    blocks = 32
+    host_overlaps = {(0, 0): blocks}  # slow worker holds everything
+
+    audit = []
+    w, _ = sel.select(workers, blocks, OverlapScores(scores={}),
+                      ActiveSequences(), host_overlaps=host_overlaps,
+                      audit=audit)
+    assert w == (0, 0), "constant priors are attracted to the big tier"
+    assert audit[0]["credit_src"] == {"host": "prior", "remote": "prior"}
+
+    rec = cfg.recompute_block_s
+    tier_costs = {
+        (0, 0): {"host": 6.0 * rec, "remote": 0.3 * rec},
+        (1, 0): {"host": 0.1 * rec, "remote": 0.3 * rec},
+    }
+    audit = []
+    w, _ = sel.select(workers, blocks, OverlapScores(scores={}),
+                      ActiveSequences(), host_overlaps=host_overlaps,
+                      audit=audit, tier_costs=tier_costs)
+    assert w == (1, 0), "measured cost crossing recompute flips placement"
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    slow, fast = by_worker[(0, 0)], by_worker[(1, 0)]
+    assert slow["credit_src"]["host"] == "measured"
+    assert slow["host_credit_w"] == 0.0, "slower than recompute: no credit"
+    # fast worker's peer-pull leg prices network fetch + its own onboard
+    assert fast["remote_credit_w"] == pytest.approx(
+        cfg.credit_fraction(0.4 * rec))
+    assert fast["cost"] < slow["cost"]
+
+
+def test_missing_measurement_falls_back_to_priors():
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    workers = [(0, 0), (1, 0)]
+    audit = []
+    # worker 1 has measured only its remote leg: host leg must stay prior
+    sel.select(workers, 8, OverlapScores(scores={}), ActiveSequences(),
+               host_overlaps={(0, 0): 8}, audit=audit,
+               tier_costs={(1, 0): {"remote": 0.0001}})
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    assert by_worker[(0, 0)]["credit_src"] == {"host": "prior",
+                                               "remote": "prior"}
+    assert by_worker[(1, 0)]["credit_src"] == {"host": "prior",
+                                               "remote": "prior"}
+    assert by_worker[(0, 0)]["host_credit_w"] == cfg.host_credit
+
+
+def test_observer_onboard_costs_from_digests():
+    """FleetObserver surfaces the newest in-window EWMA per worker,
+    skipping tiers with no samples and digests with no EWMA block."""
+    from dynamo_tpu.runtime.fleet_observer import FleetObserver
+
+    obs = FleetObserver(None)
+    obs.ingest({"worker": [7, 0], "seq": 1,
+                "kv": {"onboard_ewma": {
+                    "host": {"s_per_block": 0.002, "n": 12},
+                    "disk": {"s_per_block": 0.05, "n": 0}}}})
+    assert obs.onboard_costs() == {(7, 0): {"host": 0.002}}
+    # a newer digest WITHOUT an EWMA block must not erase the measurement
+    obs.ingest({"worker": [7, 0], "seq": 2, "kv": {}})
+    assert obs.onboard_costs() == {(7, 0): {"host": 0.002}}
+    # a newer digest WITH one supersedes it
+    obs.ingest({"worker": [7, 0], "seq": 3,
+                "kv": {"onboard_ewma": {
+                    "host": {"s_per_block": 0.004, "n": 20}}}})
+    assert obs.onboard_costs() == {(7, 0): {"host": 0.004}}
+
+
+def test_router_binds_tier_cost_fn():
+    """KvRouter passes the (cached) tier-cost snapshot into selection;
+    a crashing source degrades to priors instead of failing routing."""
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="tier-costs"),
+                            event_transport="inproc")
+    client = rt.client("dyn/w/generate")
+    calls = []
+
+    def costs():
+        calls.append(1)
+        return {(0, 0): {"host": 0.0}}
+
+    router = KvRouter(rt, client, block_size=4, use_kv_events=False,
+                      tier_cost_fn=costs)
+    assert router._tier_costs() == {(0, 0): {"host": 0.0}}
+    assert router._tier_costs() == {(0, 0): {"host": 0.0}}
+    assert len(calls) == 1, "snapshot must be cached on the hot path"
+
+    def boom():
+        raise RuntimeError("digest plane down")
+
+    router2 = KvRouter(rt, client, block_size=4, use_kv_events=False,
+                       tier_cost_fn=boom)
+    assert router2._tier_costs() == {}
+
+
+# -- simulated streamed onboarding (mocker honesty) ---------------------
+
+
+def _sim_runner(**timing_kw):
+    from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+
+    return SimRunner(num_pages=32, page_size=4, max_pages_per_seq=8,
+                     timing=SimTiming(**timing_kw))
+
+
+def test_sim_streamed_onboard_blocks_less_then_drains():
+    import time
+
+    r = _sim_runner(onboard_base_s=0.001, onboard_per_page_s=0.002,
+                    onboard_group_base_s=0.0002, speed=1.0)
+    payload = {"sim": True, "data": True, "n_pages": 8}
+    t0 = time.perf_counter()
+    r.import_pages(list(range(8)), 0, payload, layer_groups=4)
+    blocked = time.perf_counter() - t0
+    # only the first group blocks: base + dma/4, well under the whole cost
+    assert blocked < 0.001 + 8 * 0.002
+    assert r.stats["onboards_streamed"] == 1
+    assert r._onboard_rest_s > 0
+
+    # compute elapsing before the drain is genuinely hidden transfer
+    time.sleep(0.005)
+    r._drain_onboard()
+    assert r.stats["onboard_overlap_s"] == pytest.approx(0.005, abs=0.003)
+    assert r._onboard_ready_t == 0.0
+    r._drain_onboard()  # idempotent once drained
+    assert r.stats["onboards_streamed"] == 1
+
+
+def test_sim_whole_sequence_import_does_not_stream():
+    r = _sim_runner(onboard_base_s=0.0, onboard_per_page_s=0.0, speed=1.0)
+    r.import_pages([1, 2], 0, {"sim": True, "data": True, "n_pages": 2},
+                   layer_groups=1)
+    assert r.stats["onboards_streamed"] == 0
+    assert r._onboard_ready_t == 0.0
+
+
+async def test_engine_streamed_onboard_end_to_end():
+    """Mocker engine with a warm G2 prefix and onboard_layer_groups > 1:
+    admission streams the import and the EWMA records the measured cost."""
+    from dynamo_tpu.tokens.hashing import block_hashes
+
+    r = _sim_runner(prefill_base_s=1e-4, prefill_per_token_s=1e-6,
+                    decode_base_s=1e-4, decode_per_seq_s=1e-6,
+                    dispatch_overhead_s=1e-4, onboard_base_s=1e-4,
+                    onboard_per_page_s=1e-5, onboard_group_base_s=1e-5,
+                    speed=1.0)
+    eng = InferenceEngine(r, max_batch=2, chunk_size=64, host_kv_blocks=64,
+                          onboard_layer_groups=3)
+    prompt = [(17 * j) % 500 + 1 for j in range(16)]  # 4 warm blocks
+    hashes = block_hashes(prompt, 4)
+    eng.host_pool.put(hashes, [None] + hashes[:-1], None, None)
+    eng.start()
+    try:
+        out = await _generate(eng, prompt)
+        assert len(out) == 4
+        assert r.stats["onboards_streamed"] >= 1
+        assert eng.host_pool.stats["onboarded"] > 0
+        ewma = eng.kv_onboard_ewma.get("host")
+        assert ewma is not None and ewma["n"] > 0
+    finally:
+        eng.stop()
