@@ -31,9 +31,12 @@ from dynamo_tpu.bench.loadgen import (
     GoodputReport,
     aggregate_phases,
     compute_goodput,
+    compute_scenario_matrix,
     generate_burst_trace,
+    generate_scenarios,
     generate_trace,
     load_trace,
+    run_sessions_against_engine,
     run_trace_against_engine,
 )
 
@@ -135,6 +138,7 @@ def _make_engine(args, mocker: bool):
         spec_ngram=getattr(args, "spec_ngram", False),
         spec_k=getattr(args, "spec_k", 4),
         spec_max_tokens=getattr(args, "spec_max_tokens", 0),
+        enable_prefix_cache=not getattr(args, "no_prefix_cache", False),
     )
 
 
@@ -273,7 +277,13 @@ async def _boot_rest(args, mocker, disagg, plane, realm, card,
 
 
 async def run_goodput(args) -> GoodputReport:
-    if args.trace:
+    scenarios = None
+    if getattr(args, "scenarios", None):
+        scenarios = generate_scenarios(
+            args.scenarios, n_sessions=args.n_requests, rps=args.rps,
+            seed=args.seed)
+        trace = []
+    elif args.trace:
         trace = load_trace(args.trace)
     elif getattr(args, "burst_size", 0) > 0:
         trace = generate_burst_trace(
@@ -291,9 +301,16 @@ async def run_goodput(args) -> GoodputReport:
     try:
         if not args.mocker:
             await _warmup(stack, args)
-        results, duration = await run_trace_against_engine(
-            trace, stack.generate, time_scale=args.time_scale, seed=args.seed
-        )
+        if scenarios is not None:
+            results, duration = await run_sessions_against_engine(
+                scenarios, stack.generate, time_scale=args.time_scale,
+                seed=args.seed,
+            )
+        else:
+            results, duration = await run_trace_against_engine(
+                trace, stack.generate, time_scale=args.time_scale,
+                seed=args.seed,
+            )
         # aggregate worker-side prefetch counters before teardown so a
         # --prefetch A/B can tell "hints landed" from "nothing fired"
         prefetch_stats = None
@@ -384,6 +401,26 @@ async def run_goodput(args) -> GoodputReport:
                 for name, s in slo_view["fleet"].items()
             },
         }
+    if scenarios is not None:
+        # the scenario goodput matrix: per-scenario goodput, phase
+        # aggregates, and the turn-split TTFT (tree-reuse legibility)
+        report.extras["scenarios"] = compute_scenario_matrix(
+            results, duration, args.ttft_slo, args.itl_slo)
+        tree_stats = {}
+        for w in stack.workers:
+            sched = getattr(w.engine, "scheduler", None)
+            pool = getattr(w.engine, "pool", None)
+            for k, v in (("reused_prefix_tokens",
+                          getattr(sched, "reused_prefix_tokens", 0)),
+                         ("prompt_tokens", getattr(sched, "prompt_tokens_total", 0)),
+                         ("hit_blocks", getattr(pool, "match_hit_blocks", 0)),
+                         ("forks", getattr(pool, "forks", 0))):
+                tree_stats[k] = tree_stats.get(k, 0) + int(v or 0)
+        if tree_stats.get("prompt_tokens"):
+            tree_stats["hit_rate"] = round(
+                tree_stats["reused_prefix_tokens"]
+                / tree_stats["prompt_tokens"], 4)
+        report.extras["tree"] = tree_stats
     # per-request latency spine: queue_wait / TTFT / ITL / kv_onboard
     # breakdowns from the phase stamps that rode each final item
     phase_agg = aggregate_phases(results)
@@ -486,6 +523,9 @@ def parse_args(argv=None):
     p.add_argument("--spec-accept-rate", type=float, default=None,
                    help="mocker-only oracle drafter accept rate (A/B knob; "
                         "overrides n-gram lookup)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable block-hash prefix/tree KV reuse (the "
+                        "cold side of the session-tree A/B)")
     p.add_argument("--host-kv-blocks", type=int, default=0)
     p.add_argument("--disk-kv-blocks", type=int, default=0)
     p.add_argument("--prefetch", action="store_true",
@@ -499,6 +539,13 @@ def parse_args(argv=None):
                    default=[128, 256, 512])
     # workload
     p.add_argument("--trace", default=None, help="JSONL trace file (else synthetic)")
+    p.add_argument("--scenarios", nargs="+", default=None,
+                   choices=["agentic", "rag", "json", "burst"],
+                   help="scenario goodput matrix: run these session "
+                        "scenarios (--n-requests sessions EACH) instead of "
+                        "a flat trace; the report gains extras.scenarios "
+                        "(per-scenario goodput + turn-split TTFT) and "
+                        "extras.tree (prefix-tree reuse counters)")
     p.add_argument("--n-requests", type=int, default=64)
     p.add_argument("--rps", type=float, default=4.0)
     p.add_argument("--burst-size", type=int, default=0,

@@ -16,6 +16,7 @@ import json
 import math
 import random
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -258,3 +259,255 @@ async def run_trace_against_engine(
 
     await asyncio.gather(*[one(i, r) for i, r in enumerate(trace)])
     return results, time.monotonic() - t0
+
+
+# -- scenario layer (agentic session workloads) ------------------------------
+#
+# A scenario is a set of SESSIONS, each a scripted multi-turn conversation:
+# every turn re-sends the growing transcript (prompt + prior replies + new
+# user/tool tokens) after a think/tool gap, exactly the arrival shape that
+# makes prefix-tree KV reuse pay. Single-turn scenarios (guided extraction,
+# burst) degenerate to one-turn sessions so the same runner and the same
+# per-scenario goodput matrix covers all of them.
+
+GUIDED_EXTRACT_PATTERN = (
+    '\\{"name": "[a-z]{2,12}", "score": [0-9]{1,3}, '
+    '"ok": (true|false)\\}'
+)
+
+
+@dataclass
+class SessionTurn:
+    gap_s: float  # think/tool-call gap before this turn fires
+    new_input: int  # fresh tokens appended to the running transcript
+    osl: int
+    guided: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class SessionScript:
+    ts: float  # session start offset
+    session_id: str
+    scenario: str
+    turns: List[SessionTurn]
+    prefix_group: int = -1  # shared leading context (RAG corpus)
+
+
+def _agentic_sessions(n: int, rps: float, rng: random.Random
+                      ) -> List[SessionScript]:
+    """Tool-calling agent: medium system+task prompt, then 3-6 tool
+    round-trips, each appending a small tool result after a think gap."""
+    out = []
+    t = 0.0
+    for i in range(n):
+        t += rng.expovariate(rps)
+        turns = [SessionTurn(gap_s=0.0,
+                             new_input=max(32, int(rng.gauss(256, 64))),
+                             osl=max(8, int(rng.gauss(64, 16))))]
+        for _ in range(rng.randint(3, 6)):
+            turns.append(SessionTurn(
+                gap_s=rng.uniform(0.05, 0.4),  # think + tool latency
+                new_input=max(8, int(rng.gauss(48, 16))),
+                osl=max(8, int(rng.gauss(64, 16))),
+            ))
+        out.append(SessionScript(ts=t, session_id=f"agentic-{i}",
+                                 scenario="agentic", turns=turns))
+    return out
+
+
+def _rag_sessions(n: int, rps: float, rng: random.Random
+                  ) -> List[SessionScript]:
+    """Long-context RAG: a big retrieved-document context shared across
+    sessions of the same corpus group, one or two question turns."""
+    out = []
+    t = 0.0
+    for i in range(n):
+        t += rng.expovariate(rps)
+        turns = [SessionTurn(gap_s=0.0,
+                             new_input=max(64, int(rng.gauss(1024, 128))),
+                             osl=max(16, int(rng.gauss(96, 24))))]
+        if rng.random() < 0.5:  # follow-up question on the same context
+            turns.append(SessionTurn(gap_s=rng.uniform(0.1, 0.5),
+                                     new_input=max(8, int(rng.gauss(32, 8))),
+                                     osl=max(16, int(rng.gauss(96, 24)))))
+        out.append(SessionScript(ts=t, session_id=f"rag-{i}",
+                                 scenario="rag", turns=turns,
+                                 prefix_group=rng.randrange(max(1, n // 4))))
+    return out
+
+
+def _json_sessions(n: int, rps: float, rng: random.Random
+                   ) -> List[SessionScript]:
+    """Strict-JSON guided extraction: single-turn, every row constrained."""
+    guided = {"kind": "regex", "pattern": GUIDED_EXTRACT_PATTERN}
+    out = []
+    t = 0.0
+    for i in range(n):
+        t += rng.expovariate(rps)
+        out.append(SessionScript(
+            ts=t, session_id=f"json-{i}", scenario="json",
+            turns=[SessionTurn(gap_s=0.0,
+                               new_input=max(32, int(rng.gauss(192, 48))),
+                               osl=48, guided=dict(guided))],
+        ))
+    return out
+
+
+def _burst_sessions(n: int, rps: float, rng: random.Random
+                    ) -> List[SessionScript]:
+    """Burst arrivals: cohorts of 8 simultaneous single-turn requests
+    (the shape that exercises packed prefill under decode)."""
+    out = []
+    for i in range(n):
+        out.append(SessionScript(
+            ts=(i // 8) * max(0.25, 4.0 / max(rps, 0.1)),
+            session_id=f"burst-{i}", scenario="burst",
+            turns=[SessionTurn(gap_s=0.0,
+                               new_input=max(32, int(rng.gauss(256, 64))),
+                               osl=max(8, int(rng.gauss(64, 16))))],
+        ))
+    return out
+
+
+SCENARIOS = {
+    "agentic": _agentic_sessions,
+    "rag": _rag_sessions,
+    "json": _json_sessions,
+    "burst": _burst_sessions,
+}
+
+
+def generate_scenarios(
+    names: List[str],
+    n_sessions: int,
+    rps: float = 4.0,
+    seed: int = 0,
+) -> List[SessionScript]:
+    """Build the scenario mix: `n_sessions` sessions of EACH named
+    scenario, interleaved on a shared clock."""
+    out: List[SessionScript] = []
+    for name in names:
+        try:
+            gen = SCENARIOS[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown scenario {name!r} (have {sorted(SCENARIOS)})")
+        out.extend(gen(n_sessions, rps,
+                       random.Random(seed + zlib.crc32(name.encode()))))
+    out.sort(key=lambda s: s.ts)
+    return out
+
+
+@dataclass
+class TurnResult(RequestResult):
+    scenario: str = ""
+    session_id: str = ""
+    turn: int = 0  # 0-based; turns >= 1 re-send a transcript a warm
+    #               worker already holds (the tree-reuse target)
+
+
+async def run_sessions_against_engine(
+    scripts: List[SessionScript],
+    generate_fn,  # async fn(request_dict, Context) -> async iterator
+    time_scale: float = 1.0,
+    seed: int = 0,
+) -> tuple[List[TurnResult], float]:
+    """Fire scenario sessions at a generate endpoint. Turns of one session
+    run strictly in order (turn n+1's transcript includes turn n's reply);
+    sessions overlap per their start offsets. Each request stamps
+    ctx.metadata["session_id"] so a frontend with session affinity pins
+    the session to its warm worker."""
+    t0 = time.monotonic()
+    results: List[TurnResult] = []
+
+    async def one_session(script: SessionScript) -> None:
+        rng = random.Random(seed ^ zlib.crc32(script.session_id.encode()))
+        delay = script.ts * time_scale - (time.monotonic() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if script.prefix_group >= 0:
+            g = random.Random(1000 + script.prefix_group)
+            shared = max(8, int(script.turns[0].new_input * 0.75))
+            transcript = [g.randrange(300, 50000) for _ in range(shared)]
+        else:
+            transcript = []
+        for ti, turn in enumerate(script.turns):
+            if turn.gap_s > 0:
+                await asyncio.sleep(turn.gap_s * time_scale)
+            fresh = turn.new_input - (len(transcript) if ti == 0 else 0)
+            transcript.extend(
+                rng.randrange(300, 50000) for _ in range(max(1, fresh)))
+            payload: Dict[str, Any] = {
+                "token_ids": list(transcript),
+                "sampling": {"temperature": 0.0},
+                "stop": {"max_tokens": turn.osl, "stop_ids": []},
+            }
+            if turn.guided is not None:
+                payload["guided"] = turn.guided
+                payload["stop"]["stop_ids"] = [257]
+            else:
+                payload["stop"]["ignore_eos"] = True
+            ctx = Context(metadata={"session_id": script.session_id})
+            start = time.monotonic()
+            first = None
+            n_out = 0
+            reply: List[int] = []
+            phases: Dict[str, Any] = {}
+            try:
+                async for item in generate_fn(payload, ctx):
+                    toks = item.get("token_ids") or []
+                    if toks and first is None:
+                        first = time.monotonic() - start
+                    n_out += len(toks)
+                    reply.extend(toks)
+                    if item.get("finish_reason"):
+                        if item["finish_reason"] == "error":
+                            raise RuntimeError(item.get("error", "error"))
+                        if isinstance(item.get("phases"), dict):
+                            phases = item["phases"]
+                        break
+                results.append(TurnResult(
+                    ok=True, ttft_s=first, total_s=time.monotonic() - start,
+                    osl=n_out, phases=phases, scenario=script.scenario,
+                    session_id=script.session_id, turn=ti,
+                ))
+            except Exception as e:
+                results.append(TurnResult(
+                    ok=False, error=str(e), scenario=script.scenario,
+                    session_id=script.session_id, turn=ti,
+                ))
+                return  # the session's transcript is broken; stop it
+            transcript.extend(reply)
+
+    await asyncio.gather(*[one_session(s) for s in scripts])
+    return results, time.monotonic() - t0
+
+
+def compute_scenario_matrix(
+    results: List[TurnResult],
+    duration_s: float,
+    ttft_slo_s: float,
+    itl_slo_s: float,
+) -> Dict[str, Any]:
+    """Per-scenario goodput + phase aggregates + the turn-split TTFT that
+    makes tree reuse legible (turn>=2 re-sends a transcript the worker
+    already computed)."""
+    matrix: Dict[str, Any] = {}
+    for scen in sorted({r.scenario for r in results}):
+        rs = [r for r in results if r.scenario == scen]
+        rep = compute_goodput(rs, duration_s, ttft_slo_s, itl_slo_s)
+        row = json.loads(rep.to_json())
+        t1 = [r.ttft_s for r in rs if r.ok and r.turn == 0
+              and r.ttft_s is not None]
+        t2 = [r.ttft_s for r in rs if r.ok and r.turn >= 1
+              and r.ttft_s is not None]
+        row["ttft_turn1_p50_s"] = round(_pct(t1, 0.5), 4) if t1 else None
+        row["ttft_turn2plus_p50_s"] = round(_pct(t2, 0.5), 4) if t2 else None
+        phases = aggregate_phases(rs)
+        if phases:
+            row["phases"] = {k: {"n": v["n"],
+                                 "p50_s": round(v["p50_s"], 6),
+                                 "p95_s": round(v["p95_s"], 6)}
+                             for k, v in phases.items()}
+        matrix[scen] = row
+    return matrix

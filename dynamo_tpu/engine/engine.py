@@ -24,7 +24,7 @@ import numpy as np
 
 from typing import TYPE_CHECKING
 
-from dynamo_tpu.engine.kv_pool import KvEvent, PagePool
+from dynamo_tpu.engine.kv_pool import KvEvent, NoSpace, PagePool
 
 if TYPE_CHECKING:  # jax stays un-imported in mocker processes
     from dynamo_tpu.engine.model_runner import ModelRunner
@@ -64,6 +64,66 @@ class ForwardPassMetrics:
     n_running: int
     n_waiting: int
     kv_usage: float
+
+
+class GuidedMaskContext:
+    """Per-dispatch host state that advances guided DFAs BETWEEN the steps
+    of a fused decode loop (docs/agentic_serving.md). The runner's ordered
+    io_callback calls `ctx(t, prev_tokens)` once per fused step; the
+    context advances a COPY of each guided row's DFA state by the token
+    that row sampled at step t-1 and returns the [B, V] sampling mask for
+    step t. The engine's per-emitted-token `_guided_advance` stays
+    authoritative — these copies exist only so constrained rows can ride
+    full `decode_steps` loops instead of collapsing the whole plan to
+    n_steps=1.
+
+    `pending_advance=True` marks a context whose fed tokens have not been
+    folded into the states yet (the ragged tail loop: tok0 was sampled on
+    device by the ragged step), so the t=0 call advances too. A row whose
+    copy hits EOS or desyncs goes all-True for the remaining steps — the
+    engine discards tokens past a finish anyway."""
+
+    def __init__(self, B: int, vocab: int, rows, pending_advance: bool = False):
+        self.B = int(B)
+        self.vocab = int(vocab)
+        # row: [batch index, matcher, state copy, alive]
+        self.rows = [[int(i), m, int(s), True] for i, m, s in rows]
+        self.pending_advance = bool(pending_advance)
+        self.calls = 0
+
+    def _row_mask(self, m, state) -> np.ndarray:
+        row = m.allowed(state)
+        if not row.any():
+            # degrade exactly like Engine._guided_mask: force EOS rather
+            # than sampling garbage from an unextendable constraint
+            row = row.copy()
+            eos = m.lifter.eos_id
+            if 0 <= eos < row.shape[0]:
+                row[eos] = True
+        return row
+
+    def __call__(self, t, prev_tokens) -> np.ndarray:
+        self.calls += 1
+        t = int(t)
+        mask = np.ones((self.B, self.vocab), bool)
+        for row in self.rows:
+            idx, m, state, alive = row
+            if not alive:
+                continue
+            if t > 0 or self.pending_advance:
+                tok = int(prev_tokens[idx])
+                if tok == m.lifter.eos_id:
+                    row[3] = False
+                    continue
+                try:
+                    row[2] = state = m.advance(state, tok)
+                except ValueError:
+                    # desync (padding row fed a masked-out token, or the
+                    # authoritative engine already finished the request)
+                    row[3] = False
+                    continue
+            mask[idx] = self._row_mask(m, state)[: self.vocab]
+        return mask
 
 
 class InferenceEngine:
@@ -112,6 +172,9 @@ class InferenceEngine:
         spec_k: int = 4,  # draft tokens proposed per sequence per step
         spec_max_tokens: int = 0,  # per-iteration cap on drafted tokens
         #   (0 = bounded only by the mixed pool leftover)
+        enable_prefix_cache: bool = True,  # content-addressed KV reuse
+        #   (session-tree warm turns; off = every prompt prefills cold —
+        #   the A/B knob bench_agentic flips)
     ):
         self.runner = runner
         # fused mixed dispatch (one program per iteration instead of two):
@@ -139,6 +202,11 @@ class InferenceEngine:
         # kv_host_fetch endpoint (None = feature off)
         self.remote_kv_fetch = None
         self.pool = PagePool(runner.num_pages, runner.page_size)
+        # fork-on-branch CoW: the pool copies a forked tail page's device
+        # KV through the runner (None = runner can't copy; forks then
+        # share garbage tails, which only matters once a runner that
+        # writes real KV omits copy_pages — both real+sim define it)
+        self.pool.copy_hook = getattr(runner, "copy_pages", None)
         self.host_pool = None
         self._host_events: List[KvEvent] = []
         self.kv_tier_quantize = bool(kv_tier_quantize)
@@ -202,6 +270,7 @@ class InferenceEngine:
                 getattr(runner, "config", None), "max_seq_len", 0
             ) or 0,
             decode_steps=decode_steps,
+            enable_prefix_cache=enable_prefix_cache,
             mixed_prefill_tokens=mixed_prefill_tokens,
             mixed_prefill_seqs=mixed_prefill_seqs,
             mixed_min_chunk=mixed_min_chunk,
@@ -278,6 +347,7 @@ class InferenceEngine:
         self._guided_lifter = None
         self._guided_cache: Dict[str, Any] = {}
         self._guided_lock = threading.Lock()
+        self._lifter_lock = threading.Lock()  # one-time TokenLifter build
         # called (from the step thread) on unrecoverable engine failure
         # (multi-host GroupBroken): the worker wires it to process exit
         self._fatal_cb = None
@@ -327,9 +397,14 @@ class InferenceEngine:
     # -- guided decoding ---------------------------------------------------
     def _compile_guided(self, spec: Dict[str, Any]):
         """Wire spec → GuidedMatcher (cached per spec+engine). Runs in an
-        executor (DFA compilation for a big schema can take ~100ms); the
-        lock keeps concurrent first requests from each building the
-        (expensive, per-vocab) TokenLifter."""
+        executor (DFA compilation for a big schema can take ~100ms).
+        Double-checked locking: the lock only guards cache lookups and
+        the insert — DFA compilation and the per-vocab lift happen
+        OUTSIDE it, so one slow schema never serializes every concurrent
+        guided request. A racing build of the same spec keeps the first
+        inserted matcher (both are equivalent; ours is dropped). Only the
+        TokenLifter (one per engine, the truly expensive vocab scan) is
+        built under its own lock exactly once."""
         import json as _json
 
         key = _json.dumps(spec, sort_keys=True)
@@ -337,18 +412,35 @@ class InferenceEngine:
             hit = self._guided_cache.get(key)
             if hit is not None:
                 return hit
-            from dynamo_tpu.guided import compile_regex, compile_structural
-            from dynamo_tpu.guided.token_mask import TokenLifter
+        from dynamo_tpu.guided import compile_regex, compile_structural
 
-            kind = spec.get("kind")
-            if kind == "regex":
-                dfa = compile_regex(spec["pattern"])
-            elif kind == "structural":
-                dfa = compile_structural(spec)
-            else:
-                raise ValueError(f"unknown guided kind {kind!r}")
+        kind = spec.get("kind")
+        if kind == "regex":
+            dfa = compile_regex(spec["pattern"])
+        elif kind == "structural":
+            dfa = compile_structural(spec)
+        else:
+            raise ValueError(f"unknown guided kind {kind!r}")
+        matcher = self._get_lifter().lift(dfa)
+        with self._guided_lock:
+            hit = self._guided_cache.get(key)
+            if hit is not None:
+                return hit  # racer inserted first; equivalent matcher
+            # small cap: each matcher holds up to _ROW_CACHE_MAX full-vocab
+            # rows, so this bounds worker memory at tens of MB, not GB
+            while len(self._guided_cache) >= 32:
+                self._guided_cache.pop(next(iter(self._guided_cache)))
+            self._guided_cache[key] = matcher
+            return matcher
+
+    def _get_lifter(self):
+        lifter = self._guided_lifter
+        if lifter is not None:
+            return lifter
+        with self._lifter_lock:
             if self._guided_lifter is None:
                 from dynamo_tpu.frontend.tokenizer import load_tokenizer
+                from dynamo_tpu.guided.token_mask import TokenLifter
 
                 cfg = getattr(self.runner, "config", None)
                 vocab = (
@@ -357,13 +449,7 @@ class InferenceEngine:
                 self._guided_lifter = TokenLifter.for_tokenizer(
                     load_tokenizer(self.tokenizer_spec), vocab,
                 )
-            matcher = self._guided_lifter.lift(dfa)
-            # small cap: each matcher holds up to _ROW_CACHE_MAX full-vocab
-            # rows, so this bounds worker memory at tens of MB, not GB
-            while len(self._guided_cache) >= 32:
-                self._guided_cache.pop(next(iter(self._guided_cache)))
-            self._guided_cache[key] = matcher
-            return matcher
+            return self._guided_lifter
 
     def _guided_mask(self, seq: Sequence) -> Optional[np.ndarray]:
         """Sampling mask for a constrained sequence. An all-False row (no
@@ -465,6 +551,11 @@ class InferenceEngine:
         if context.metadata.get("migration_attempt"):
             seq.phases["migration_attempts"] = float(
                 context.metadata["migration_attempt"])
+        # n>1 sampling: fork-on-branch after prefill (the trunk KV is
+        # shared copy-on-write, so n choices cost one prefill). Disagg
+        # roles stream exactly one completion per worker — no fan-out.
+        if seq.disagg is None:
+            seq.n_branches = max(1, min(16, int(seq.sampling.get("n") or 1)))
         if seq.logit_bias and (
             getattr(self.runner, "has_draft", False)
             or getattr(self.runner, "pp", False)
@@ -566,6 +657,7 @@ class InferenceEngine:
         else:
             self._inbox.put(("add", seq))
         finished = False
+        n_done = 0
         try:
             while True:
                 if context.is_stopped:
@@ -582,8 +674,12 @@ class InferenceEngine:
                 item = get.result()
                 yield item
                 if item.get("finish_reason"):
-                    finished = True
-                    return
+                    # a branched request streams one finish per choice;
+                    # the stream ends when every branch has finished
+                    n_done += 1
+                    if n_done >= seq.n_branches:
+                        finished = True
+                        return
         finally:
             # runs on normal end, cancel, AND consumer break/close
             self._streams.pop(rid, None)
@@ -693,6 +789,15 @@ class InferenceEngine:
         rinfo = {"decode_seqs": 0, "decode_steps": 0, "n_chunks": 0,
                  "chunk_tokens": 0, "fused": False, "ragged": False,
                  "spec_rows": 0, "spec_drafted": 0, "spec_emitted": 0}
+        if isinstance(plan, MixedPlan):
+            _dseqs = plan.decode.seqs
+        elif isinstance(plan, DecodePlan):
+            _dseqs = plan.seqs
+        else:
+            _dseqs = []
+        rinfo["guided_rows"] = sum(
+            1 for s in _dseqs if s.guided_m is not None
+        )
         decode_done = False
         try:
             if isinstance(plan, PrefillPlan):
@@ -899,6 +1004,9 @@ class InferenceEngine:
                 rinfo.get("spec_emitted", 0) / rinfo["spec_rows"]
                 if rinfo.get("spec_rows") else 0.0
             ),
+            guided_rows=rinfo.get("guided_rows", 0),
+            tree_hit_blocks=self.pool.match_hit_blocks,
+            forks=self.pool.forks,
         ))
 
     def _recover_poisoned_pools(self) -> None:
@@ -967,6 +1075,16 @@ class InferenceEngine:
                 self.scheduler.add(arg)
             elif op == "abort":
                 self.scheduler.abort(arg)
+                # forked branches live under derived ids; an abort of the
+                # parent stream must tear them down too or their pages
+                # leak until the (never-coming) finish
+                for bid in [
+                    s.request_id
+                    for s in list(self.scheduler.active)
+                    + list(self.scheduler.waiting)
+                    if s.branch_of == arg
+                ]:
+                    self.scheduler.abort(bid)
                 parked = self._parked.pop(arg, None)
                 if parked is not None:
                     self.scheduler.release_parked(parked[0])
@@ -1354,6 +1472,12 @@ class InferenceEngine:
             token = self.runner.sample_one(
                 logits, _sampling_params([seq]), self._next_step(), **kw1,
             )
+        # fork BEFORE the parent's DFA advance: each branch samples its
+        # own first token from these logits under the same pre-advance
+        # constraint state the parent's token was sampled under
+        if (seq.n_branches > 1 and seq.branch_of is None
+                and seq.disagg is None and not seq.branches_spawned):
+            self._fork_branches(seq, logits, mask1, bias1)
         self._guided_advance(seq, token)
         if seq.disagg == "prefill":
             # disagg: first token + transfer handle; pages stay pinned for
@@ -1388,6 +1512,68 @@ class InferenceEngine:
             seq, [token] if emitted is not None else [], reason,
             logprobs=lp_entries,
         )
+
+    def _fork_branches(self, seq: Sequence, logits, mask1, bias1) -> None:
+        """Fan a just-prefilled sequence out into n_branches siblings.
+
+        Each branch shares the parent's complete trunk pages by reference
+        (copy-on-write: only the partial tail page is duplicated via the
+        pool's copy_hook), inherits the pre-advance guided DFA state, and
+        samples its own first token from the parent's prefill logits —
+        one prefill pass serves n choices. A branch that can't get pages
+        or a batch slot emits an indexed error item; the parent and the
+        other branches are unaffected."""
+        seq.branches_spawned = True  # a preempted parent must not re-fork
+        PS = self.pool.page_size
+        n_shared = seq.computed_len // PS
+        for k in range(1, seq.n_branches):
+            branch = Sequence(
+                request_id=f"{seq.request_id}#b{k}",
+                prompt=list(seq.prompt),
+                sampling=dict(seq.sampling),
+                stop=seq.stop,
+                arrival=seq.arrival,
+                adapter=seq.adapter,
+                adapter_idx=seq.adapter_idx,
+                logit_bias=seq.logit_bias,
+                mm_embeds=seq.mm_embeds,
+                mm_positions=seq.mm_positions,
+                mm_seed=seq.mm_seed,
+                guided=seq.guided,
+                guided_m=seq.guided_m,
+                guided_s=seq.guided_s,
+                branch_of=seq.request_id,
+                branch_index=k,
+            )
+            if branch.sampling.get("seed") is not None:
+                # mirror the frontend fan-out's choice-seed derivation so
+                # seeded non-greedy branches diverge deterministically
+                branch.sampling["seed"] = int(branch.sampling["seed"]) + k
+            try:
+                pages = self.pool.fork_table(seq.pages, n_shared)
+            except NoSpace:
+                self._emit_item(branch, engine_output(
+                    [], "error",
+                    error="no KV pages free to fork this choice",
+                ))
+                continue
+            if not self.scheduler.adopt_branch(branch, seq, pages):
+                self._emit_item(branch, engine_output(
+                    [], "error",
+                    error="no batch slot free to fork this choice",
+                ))
+                continue
+            kwb = {"mask": mask1} if mask1 is not None else {}
+            if bias1 is not None:
+                kwb["bias"] = bias1
+            tok = self.runner.sample_one(
+                logits, _sampling_params([branch]), self._next_step(), **kwb,
+            )
+            self._guided_advance(branch, tok)
+            reason = self.scheduler.complete_decode(
+                branch, tok, advance_computed=False
+            )
+            self._emit(branch, [tok] if reason != "stop" else [], reason)
 
     def _finish_packed_prefills(self, prefills, chunk_logits) -> None:
         """Bookkeeping for prefill chunks whose KV landed in a shared
@@ -1428,12 +1614,15 @@ class InferenceEngine:
     def _propose_drafts(self) -> None:
         """Propose this iteration's draft tokens (step thread, before
         step_plan so the scheduler can charge them against the mixed
-        pool). Speculation is opportunistic per iteration: any running
-        sequence needing sampling extras the verify dispatch cannot
-        honor (masks, logprobs, penalties, bias) pauses speculation for
-        the whole batch — the verify program samples every row with the
-        plain keyed sampler, so partial speculation would silently drop
-        a sibling's extras."""
+        pool). Speculation is opportunistic per iteration and per
+        SEQUENCE: guided and logit-bias rows simply never draft — they
+        ride the verify dispatch as single plain rows whose mask/bias
+        plumb through verify_spec's always-present sampling operands —
+        while free rows in the same batch keep drafting. Only
+        logprobs/penalties still pause the whole batch: the verify
+        program has no logprob report or penalty count table, so
+        partial speculation would silently drop those extras for every
+        row in the shared dispatch."""
         running = [
             s for s in self.scheduler.active if s.state == SeqState.RUNNING
         ]
@@ -1443,22 +1632,28 @@ class InferenceEngine:
             return
         blocked = [
             s for s in running
-            if s.guided_m is not None
-            or s.logit_bias
-            or _batch_logprobs([s]) >= 0
-            or _batch_penalties([s])
+            if _batch_logprobs([s]) >= 0 or _batch_penalties([s])
         ]
         if blocked:
             for s in blocked:
                 self._warn_spec_once(
                     s.request_id,
-                    "guided/logprobs/penalties/bias sampling is "
-                    "incompatible with speculative verification — "
-                    "speculation paused while this request is in the batch",
+                    "logprobs/penalties sampling is incompatible with "
+                    "speculative verification — speculation paused while "
+                    "this request is in the batch",
                 )
             return
         oracle = getattr(self.runner, "spec_draft", None)
         for s in running:
+            if s.guided_m is not None or s.logit_bias:
+                # per-sequence pause: this row stays a plain 1-token
+                # verify row (masked/biased); siblings keep speculating
+                self._warn_spec_once(
+                    s.request_id,
+                    "guided/bias row rides the verify dispatch without "
+                    "drafting (per-sequence speculation pause)",
+                )
+                continue
             draft = None
             if oracle is not None:
                 draft = oracle(s.tokens[-1], s.computed_len, self.spec_k)
@@ -1506,12 +1701,27 @@ class InferenceEngine:
             for p in prefills
         ]
         n_drafted = sum(len(d) for d in drafts)
+        # guided/bias rows never draft (_propose_drafts), so each owns
+        # exactly ONE verify position; its mask/bias rides the dispatch's
+        # always-present sampling operands (row-aligned dicts)
+        vkw: Dict[str, Any] = {}
+        masks = {
+            i: self._guided_mask(s)
+            for i, s in enumerate(seqs) if s.guided_m is not None
+        }
+        if masks:
+            vkw["masks"] = masks
+        brows = _batch_biases(seqs, self.runner)
+        if brows is not None:
+            vkw["biases"] = {
+                i: brows[i] for i, s in enumerate(seqs) if s.logit_bias
+            }
         with annotate("engine.spec_verify", batch=len(seqs),
                       drafted=n_drafted, chunks=len(chunks)):
             try:
                 rows, chunk_logits = self.runner.verify_spec(
                     tokens, positions, tables, drafts,
-                    _sampling_params(seqs), step0, chunks=chunks,
+                    _sampling_params(seqs), step0, chunks=chunks, **vkw,
                 )
             except BucketOverflowError as e:
                 log.warning(
@@ -1530,6 +1740,8 @@ class InferenceEngine:
                 reason = None
                 for token in emitted:
                     reason = self.scheduler.complete_decode(seq, token)
+                    if not reason:
+                        self._guided_advance(seq, token)
                     if reason != "stop":
                         emit.append(token)
                     if reason:
@@ -1567,14 +1779,20 @@ class InferenceEngine:
         ):
             return False  # packed ragged program unavailable on this runner
         seqs = plan.decode.seqs
-        if any(s.guided_m is not None for s in seqs):
-            return False  # per-step masks need the T=1 masked path
+        if any(s.guided_m is not None or s.logit_bias for s in seqs):
+            # masks and bias exist only as ragged-step / decode-loop
+            # operands: guided or biased decode rows fuse iff this plan
+            # rides the ragged flat-token program (never the padded
+            # [N, S] fallback, which would silently drop the constraint)
+            use_ragged = getattr(runner, "_use_ragged", None)
+            if (use_ragged is None
+                    or not getattr(runner, "guided_fused", False)
+                    or not use_ragged(len(seqs), len(plan.prefills))):
+                return False
         if _batch_logprobs(seqs) >= 0 or _batch_penalties(seqs):
             return False
-        if any(s.logit_bias for s in seqs) or any(
-            p.seq.logit_bias for p in plan.prefills
-        ):
-            return False  # the fused program has no bias operand
+        if any(p.seq.logit_bias for p in plan.prefills):
+            return False  # chunk-side bias keeps the two-dispatch path
         for pplan in plan.prefills:
             if self._mm_chunk(
                 pplan.seq, pplan.start_pos, len(pplan.chunk)
@@ -1604,6 +1822,32 @@ class InferenceEngine:
             tables = [s.pages for s in seqs]
             step0 = self._step_counter + 1
             self._step_counter += T
+            # guided rows ride the fused program: step 0 samples under the
+            # ragged step's mask operand; steps 1..T-1 fetch per-step masks
+            # through the decode loop's host callback, which advances a
+            # COPY of each row's DFA state by the device-sampled feedback
+            # token (pending_advance: step 0's token was sampled on device
+            # and not yet folded into the authoritative engine state)
+            mixkw: Dict[str, Any] = {}
+            guided_rows = [
+                i for i, s in enumerate(seqs) if s.guided_m is not None
+            ]
+            if guided_rows:
+                vocab = seqs[guided_rows[0]].guided_m.lifter.vocab_size
+                masks = np.ones((len(seqs), vocab), bool)
+                for i in guided_rows:
+                    masks[i] = self._guided_mask(seqs[i])
+                mixkw["masks"] = masks
+                if T > 1:
+                    mixkw["mask_fn"] = GuidedMaskContext(
+                        len(seqs), vocab,
+                        [(i, seqs[i].guided_m, seqs[i].guided_s)
+                         for i in guided_rows],
+                        pending_advance=True,
+                    )
+            biases = _batch_biases(seqs, self.runner)
+            if biases is not None:
+                mixkw["biases"] = biases
             while True:
                 # Bucket-overflow degradation: a pack the runner can't
                 # shape (pack/chunk/T bucket exceeded) sheds its newest
@@ -1623,6 +1867,7 @@ class InferenceEngine:
                             pplan.seq.pages, pplan.start_pos,
                             adapters=[s.adapter_idx for s in seqs],
                             chunk_adapter=pplan.seq.adapter_idx,
+                            **mixkw,
                         )
                         chunk_logits = [lg]
                     else:
@@ -1642,6 +1887,7 @@ class InferenceEngine:
                                     for p in prefills
                                 ],
                                 adapters=[s.adapter_idx for s in seqs],
+                                **mixkw,
                             )
                         )
                     break
@@ -1660,6 +1906,8 @@ class InferenceEngine:
                 for j in range(T):
                     token = int(sampled[i, j])
                     reason = self.scheduler.complete_decode(seq, token)
+                    if not reason:
+                        self._guided_advance(seq, token)
                     if reason != "stop":
                         emit.append(token)
                     if reason:
@@ -1736,18 +1984,30 @@ class InferenceEngine:
                 self._emit(seq, emit, reason)
             return
         masks = None
-        if any(s.guided_m is not None for s in seqs):
-            # constrained sequences need a fresh mask per sampled token —
-            # clamp to one step per dispatch (the mask is an input array,
-            # so this costs a host turnaround, not a recompile)
-            T = 1
-            vocab = next(
-                s.guided_m for s in seqs if s.guided_m is not None
-            ).lifter.vocab_size
-            masks = np.ones((len(seqs), vocab), bool)
-            for i, s in enumerate(seqs):
-                if s.guided_m is not None:
-                    masks[i] = self._guided_mask(s)
+        mask_fn = None
+        guided_rows = [i for i, s in enumerate(seqs) if s.guided_m is not None]
+        if guided_rows:
+            vocab = seqs[guided_rows[0]].guided_m.lifter.vocab_size
+            if T > 1 and getattr(self.runner, "guided_fused", False):
+                # constrained rows need a fresh mask per sampled token;
+                # instead of collapsing the whole plan to one step per
+                # dispatch, hand the runner a host callback that advances
+                # a COPY of each row's DFA state by the device-sampled
+                # feedback token between fused steps — guided rows ride
+                # the same full decode_steps loop as free rows, and the
+                # callback is identity-stable so no compile-key churn
+                mask_fn = GuidedMaskContext(
+                    len(seqs), vocab,
+                    [(i, seqs[i].guided_m, seqs[i].guided_s)
+                     for i in guided_rows],
+                )
+            else:
+                # runners without callback plumbing (PP loop) keep the
+                # legacy one-step masked dispatch
+                T = 1
+                masks = np.ones((len(seqs), vocab), bool)
+                for i in guided_rows:
+                    masks[i] = self._guided_mask(seqs[i])
         biases = _batch_biases(seqs, self.runner)
         self._step_counter += T
         n_lp = _batch_logprobs(seqs)
@@ -1774,6 +2034,8 @@ class InferenceEngine:
             self.runner, "decode_multi_ex"
         ):
             mkw = {"masks": masks} if masks is not None else {}
+            if mask_fn is not None:
+                mkw["mask_fn"] = mask_fn
             if biases is not None:
                 mkw["biases"] = biases
             sampled, lp = self.runner.decode_multi_ex(
@@ -1785,6 +2047,8 @@ class InferenceEngine:
             )
         else:
             mkw = {"masks": masks} if masks is not None else {}
+            if mask_fn is not None:
+                mkw["mask_fn"] = mask_fn
             if biases is not None:
                 mkw["biases"] = biases
             sampled = self.runner.decode_multi(
@@ -1870,7 +2134,11 @@ class InferenceEngine:
                     cb(phases)
                 except Exception:  # pragma: no cover
                     log.exception("phase listener failed")
-        entry = self._streams.get(seq.request_id)
+        if seq.branch_of is not None or seq.n_branches > 1:
+            # branched choices multiplex the parent's stream; the index
+            # tells the consumer which choice each item belongs to
+            item.setdefault("index", seq.branch_index)
+        entry = self._streams.get(seq.branch_of or seq.request_id)
         if entry is None:
             return
         out, loop = entry

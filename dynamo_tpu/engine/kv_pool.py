@@ -60,6 +60,11 @@ class PagePool:
         # cb(block_hash) when match_prefix claims a pinned hash (the
         # prefetch hit signal; the pin is dropped before the call)
         self.claim_hook = None
+        # fork-on-branch: cb(src_page, dst_page) copies device KV when a
+        # branch takes a private copy of a not-yet-complete page (CoW)
+        self.copy_hook = None
+        self.forks = 0  # fork_table calls (branch fan-outs)
+        self.match_hit_blocks = 0  # blocks served warm by match_prefix
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -124,7 +129,28 @@ class PagePool:
                 self.pinned.discard(h)
                 if self.claim_hook is not None:
                     self.claim_hook(h)
+        self.match_hit_blocks += len(pages)
         return pages, hashes
+
+    # -- fork-on-branch ----------------------------------------------------
+    def fork_table(self, pages: List[int], n_shared: int) -> List[int]:
+        """Copy-on-write fork of a sequence's page table (n>1 sampling,
+        tool-call retries): the first `n_shared` pages hold KV both
+        branches agree on and are shared by reference; the remainder —
+        typically just the partial page being written — is duplicated
+        into fresh pages via `copy_hook(src, dst)` so divergent decode
+        never clobbers the sibling. Raises NoSpace before touching
+        refcounts, so a failed fork leaves the parent untouched."""
+        n_shared = max(0, min(n_shared, len(pages)))
+        tail = pages[n_shared:]
+        fresh = self.alloc(len(tail)) if tail else []
+        for p in pages[:n_shared]:
+            self._ref_inc(p)
+        if self.copy_hook is not None:
+            for src, dst in zip(tail, fresh):
+                self.copy_hook(src, dst)
+        self.forks += 1
+        return pages[:n_shared] + fresh
 
     def _ref_inc(self, page: int) -> None:
         if page in self.cached:

@@ -47,14 +47,18 @@ def _decode_loop(
     packed,  # int32 [B + B*MP (+B if lora) + 1]: pos|pt|adapters|step
     hist,  # None (no penalties) or int32 [B, H] token history padded with
     # vocab_size — builds the on-device count table the penalties read
-    mask,  # None or bool [B, V] guided-decoding sampling mask (constrained
-    # dispatches run n_steps=1, so one mask covers the whole loop)
+    mask,  # None or bool [B, V] guided-decoding sampling mask for step 0
+    # (a constrained dispatch without mask_fn runs n_steps=1 so one mask
+    # covers the loop; with mask_fn the per-step masks come from the host)
     bias,  # None or f32 [B, V] additive logit bias (OpenAI logit_bias;
     # constant per request, so it rides full fused loops unlike masks)
     k_pool,
     v_pool,
     sampling: SamplingParams,
     lora=None,  # stacked multi-LoRA tree (models/lora.py)
+    mask_fn=None,  # static: host callback (t, prev_tokens) -> bool [B, V]
+    # advancing guided DFA states between fused steps (ordered io_callback;
+    # identity-stable per runner so the callback program compiles once)
 ):
     """n_steps decode iterations fused in one jit: forward → sample → feed
     the sampled token back, entirely on device (lax.scan). Amortizes the
@@ -112,7 +116,20 @@ def _decode_loop(
             from dynamo_tpu.engine.sampling import apply_penalties
 
             l = apply_penalties(raw, cnt, cnt_out, sampling)
-        s = sample(l, sampling, step0 + t, mask=mask, bias=bias)
+        m = mask
+        if mask_fn is not None:
+            # guided rows in a multi-step loop: the DFA advances host-side
+            # between fused steps (tok = what step t-1 sampled), so the
+            # whole constrained batch rides full decode_steps loops instead
+            # of collapsing to n_steps=1
+            from jax.experimental import io_callback
+
+            m = io_callback(
+                mask_fn,
+                jax.ShapeDtypeStruct((B, config.vocab_size), jnp.bool_),
+                t, tok, ordered=True,
+            )
+        s = sample(l, sampling, step0 + t, mask=m, bias=bias)
         outs = (s,)
         if n_logprobs >= 0:
             from dynamo_tpu.engine.sampling import top_logprobs
@@ -202,6 +219,13 @@ def _ragged_step(
     v_pool,
     sampling: SamplingParams,  # padded to SEG_CAP rows
     step,  # traced scalar int32
+    mask,  # bool [SEG_CAP, V] sampling mask, ALWAYS an operand (all-True
+    # when no row is guided — constant treedef keeps guided-on and
+    # guided-off dispatches in the same compiled variant, dynlint J004)
+    bias,  # f32 [SEG_CAP, V] additive logit bias, ALWAYS an operand
+    # (all-zero when no row is biased — same constant-treedef rule; lets
+    # logit_bias rows ride the verify/mixed dispatch instead of pausing
+    # speculation batch-wide)
 ):
     """The ragged mixed step: ONE forward serves the whole decode batch
     (each sequence a q_len=1 segment) and every packed prefill chunk from
@@ -224,8 +248,27 @@ def _ragged_step(
         ragged=(seg_pt, seg_kvl, meta),
     )
     seg_logits = logits[0]  # [SEG_CAP, V]
-    toks = sample(seg_logits, sampling, step)  # [SEG_CAP]
+    toks = sample(seg_logits, sampling, step, mask=mask, bias=bias)  # [SEG_CAP]
     return toks, seg_logits, k_pool, v_pool
+
+
+class _GuidedMaskTrampoline:
+    """Identity-stable host callback for `_decode_loop`'s per-step guided
+    masks: the jit cache keys static args by hash, so the callback-bearing
+    program must trace against ONE object per runner — the per-dispatch
+    DFA context (engine GuidedMaskContext: row matchers + state copies) is
+    swapped into `ctx` right before each dispatch. Safe with async
+    dispatch because the engine materializes every dispatch's sampled
+    tokens before it builds the next plan, so at most one context is live
+    at a time (asserted)."""
+
+    def __init__(self):
+        self.ctx = None
+
+    def __call__(self, t, prev_tokens):
+        ctx = self.ctx
+        assert ctx is not None, "guided mask callback fired without context"
+        return np.asarray(ctx(int(t), np.asarray(prev_tokens)), dtype=bool)
 
 
 class _CompiledFamily:
@@ -632,8 +675,21 @@ class ModelRunner:
         self._jit_decode_loop = _family("decode_loop", jax.jit(
             partial(_decode_loop, self.config, self.attn_impl, self._fwd_mesh),
             static_argnums=(0, 1),  # n_steps, n_logprobs
+            static_argnames=("mask_fn",),  # guided per-step mask callback
             donate_argnums=(8, 9),  # k_pool, v_pool
         ))
+        # one trampoline per runner: static-arg identity keys the jit
+        # cache, so the guided-callback program compiles once per bucket
+        self._mask_tramp = _GuidedMaskTrampoline()
+        # cached all-True ragged sampling masks per row-cap (the mask is a
+        # permanent _ragged_step operand; unconstrained dispatches reuse
+        # one device-resident array instead of re-transferring [SEG, V])
+        self._true_mask_cache: Dict[int, jax.Array] = {}
+        self._zero_bias_cache: Dict[int, jax.Array] = {}
+        # the engine's guided-fusion gate: per-step masks ride the decode
+        # loop's host callback / the ragged step's mask operand, neither
+        # of which the PP loop carries
+        self.guided_fused = not self.pp
         if self.pp:
             from dynamo_tpu.parallel.mesh import AXIS_PIPE
 
@@ -798,13 +854,14 @@ class ModelRunner:
         adapters: Optional[List[int]] = None,
         masks: Optional[np.ndarray] = None,
         biases: Optional[np.ndarray] = None,
+        mask_fn=None,
     ) -> np.ndarray:
         """n_steps fused decode iterations (one host sync total). Page
         tables must already cover positions[i] + n_steps slots. Returns
         sampled tokens [B_bucket, n_steps]."""
         toks, _ = self.decode_multi_async(
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
-            masks=masks, biases=biases,
+            masks=masks, biases=biases, mask_fn=mask_fn,
         )
         return np.asarray(jax.device_get(toks))
 
@@ -822,6 +879,7 @@ class ModelRunner:
         prompt_lens: Optional[List[int]] = None,
         masks: Optional[np.ndarray] = None,
         biases: Optional[np.ndarray] = None,
+        mask_fn=None,
     ):
         """decode_multi with the sampling extras: `histories` (per-sequence
         prompt+generated token ids) switches on repetition/frequency/
@@ -833,7 +891,7 @@ class ModelRunner:
         out = self.decode_multi_async(
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
             n_logprobs=n_logprobs, histories=histories, prompt_lens=prompt_lens,
-            masks=masks, biases=biases,
+            masks=masks, biases=biases, mask_fn=mask_fn,
         )
         if n_logprobs >= 0:
             toks, _, lp = out
@@ -856,6 +914,9 @@ class ModelRunner:
         prompt_lens: Optional[List[int]] = None,
         masks: Optional[np.ndarray] = None,  # [n, V] bool guided masks
         biases: Optional[np.ndarray] = None,  # [n, V] f32 logit_bias rows
+        mask_fn=None,  # GuidedMaskContext: per-step host-advanced masks,
+        # letting constrained rows ride full n_steps fused loops (the
+        # static `mask` covers step 0 semantics when mask_fn is None)
     ):
         """decode_multi without the host sync: returns (toks, last) DEVICE
         arrays — toks [B_bucket, n_steps] and last [B_bucket] (the final
@@ -915,10 +976,11 @@ class ModelRunner:
             mask_dev = jnp.asarray(m)
 
         if self.pp:
-            if n_logprobs >= 0 or hist is not None or biases is not None:
+            if n_logprobs >= 0 or hist is not None or biases is not None \
+                    or mask_fn is not None:
                 raise NotImplementedError(
-                    "logprobs/penalties/logit_bias are not wired on the "
-                    "pipeline-parallel decode path yet"
+                    "logprobs/penalties/logit_bias/multi-step guided masks "
+                    "are not wired on the pipeline-parallel decode path yet"
                 )
             toks, last, self.k_pool, self.v_pool = self._jit_pp_decode(
                 n_steps, self.params, tok, jnp.asarray(packed), mask_dev,
@@ -933,10 +995,15 @@ class ModelRunner:
             bz[: biases.shape[0]] = biases  # pad rows stay unbiased
             bias_dev = jnp.asarray(bz)
 
+        mkw = {}
+        if mask_fn is not None:
+            mask_fn.B = B  # callback mask rows must match the padded bucket
+            self.set_guided_ctx(mask_fn)
+            mkw["mask_fn"] = self._mask_tramp
         toks, last, lp, self.k_pool, self.v_pool = self._jit_decode_loop(
             n_steps, n_logprobs, self.params, tok, jnp.asarray(packed), hist,
             mask_dev, bias_dev, self.k_pool, self.v_pool,
-            self._device_sampling(sampling, B), self.lora,
+            self._device_sampling(sampling, B), self.lora, **mkw,
         )
         if n_logprobs >= 0:
             return toks, last, lp
@@ -956,6 +1023,9 @@ class ModelRunner:
         chunk_prior: int,
         adapters: Optional[List[int]] = None,
         chunk_adapter: int = 0,
+        masks: Optional[np.ndarray] = None,
+        mask_fn=None,
+        biases: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, jax.Array]:
         """Fused mixed iteration (_mixed_loop): the decode batch's fused
         n_steps AND one bounded prefill chunk in a single dispatch.
@@ -974,14 +1044,22 @@ class ModelRunner:
             try:
                 toks, chunk_logits = self._decode_multi_with_prefills_ragged(
                     n_steps, tokens, positions, page_tables, sampling,
-                    step, [chunk],
+                    step, [chunk], masks=masks, mask_fn=mask_fn,
+                    biases=biases,
                 )
                 return toks, chunk_logits[0]
             except BucketOverflowError as e:
+                if masks is not None or mask_fn is not None \
+                        or biases is not None:
+                    raise
                 log.warning(
                     "mixed plan (%d tokens) overflows ragged T buckets "
                     "(largest %d); using the padded fallback", e.n, e.largest,
                 )
+        elif masks is not None or mask_fn is not None or biases is not None:
+            raise NotImplementedError(
+                "guided masks / logit bias require the ragged mixed path"
+            )
         ptok, ppos, ppt, pkvl, n = self._prep_prefill(
             chunk_tokens, chunk_start, chunk_table, chunk_prior
         )
@@ -1055,6 +1133,9 @@ class ModelRunner:
         chunks: List[Dict[str, Any]],  # {"tokens", "start", "table",
         #   "prior", "adapter"} per packed chunk (distinct sequences)
         adapters: Optional[List[int]] = None,
+        masks: Optional[np.ndarray] = None,  # [n_dec, V] step-0 guided masks
+        mask_fn=None,  # GuidedMaskContext for the fused tail steps 1..n-1
+        biases: Optional[np.ndarray] = None,  # [n_dec, V] logit-bias rows
     ) -> Tuple[np.ndarray, jax.Array]:
         """Packed fused mixed iteration: the decode batch's fused n_steps
         AND the whole token-budgeted prefill chunk set in a SINGLE
@@ -1069,13 +1150,24 @@ class ModelRunner:
             try:
                 return self._decode_multi_with_prefills_ragged(
                     n_steps, tokens, positions, page_tables, sampling, step,
-                    chunks,
+                    chunks, masks=masks, mask_fn=mask_fn, biases=biases,
                 )
             except BucketOverflowError as e:
+                if masks is not None or mask_fn is not None \
+                        or biases is not None:
+                    # the padded fallback has no mask/bias plane; the
+                    # engine sheds chunks and retries rather than dropping
+                    # a guided row's constraint or a bias ban
+                    raise
                 log.warning(
                     "mixed plan (%d tokens) overflows ragged T buckets "
                     "(largest %d); using the padded fallback", e.n, e.largest,
                 )
+        elif masks is not None or mask_fn is not None or biases is not None:
+            raise NotImplementedError(
+                "guided masks / logit bias require the ragged mixed path "
+                "(the engine's _mixed_fusible gates on it)"
+            )
         ptok, ppos, ppt, pkvl, plast, padapter = self._prep_prefill_packed(
             chunks
         )
@@ -1100,6 +1192,52 @@ class ModelRunner:
             self.lora,
         )
         return np.asarray(jax.device_get(toks)), chunk_logits
+
+    # -- guided sampling masks --------------------------------------------
+    def _true_mask(self, rows: int) -> jax.Array:
+        """Device-resident all-True [rows, V] sampling mask. The ragged
+        step takes the mask as a PERMANENT operand (constant treedef =
+        no variant split between guided and free dispatches), so the
+        unconstrained common case must not pay a [rows, V] host→device
+        transfer per iteration — one cached array per row cap does."""
+        hit = self._true_mask_cache.get(rows)
+        if hit is None:
+            hit = jnp.ones((rows, self.config.vocab_size), jnp.bool_)
+            self._true_mask_cache[rows] = hit
+        return hit
+
+    def _seg_mask(self, masks: Optional[np.ndarray], seg_cap: int) -> jax.Array:
+        """Pad row-aligned guided masks to the sampled-row cap (pad rows
+        all-allowed); None = the cached all-True operand."""
+        if masks is None:
+            return self._true_mask(seg_cap)
+        m = np.ones((seg_cap, self.config.vocab_size), bool)
+        m[: masks.shape[0]] = masks
+        return jnp.asarray(m)
+
+    def _zero_bias(self, rows: int) -> jax.Array:
+        """Device-resident all-zero [rows, V] logit bias — the cached
+        no-op counterpart of _true_mask for the ragged step's permanent
+        bias operand."""
+        hit = self._zero_bias_cache.get(rows)
+        if hit is None:
+            hit = jnp.zeros((rows, self.config.vocab_size), jnp.float32)
+            self._zero_bias_cache[rows] = hit
+        return hit
+
+    def _seg_bias(self, biases: Optional[np.ndarray], seg_cap: int) -> jax.Array:
+        """Pad row-aligned logit-bias rows to the sampled-row cap (pad
+        rows zero); None = the cached all-zero operand."""
+        if biases is None:
+            return self._zero_bias(seg_cap)
+        b = np.zeros((seg_cap, self.config.vocab_size), np.float32)
+        b[: biases.shape[0]] = biases
+        return jnp.asarray(b)
+
+    def set_guided_ctx(self, ctx) -> None:
+        """Install the per-dispatch guided-DFA context the decode loop's
+        host callback reads (see _GuidedMaskTrampoline)."""
+        self._mask_tramp.ctx = ctx
 
     # -- ragged flat-token mixed path -------------------------------------
     def _use_ragged(self, n_decode: int, n_chunks: int) -> bool:
@@ -1180,6 +1318,9 @@ class ModelRunner:
         sampling,
         step: int,
         chunks: List[Dict[str, Any]],
+        masks: Optional[np.ndarray] = None,
+        mask_fn=None,
+        biases: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, jax.Array]:
         """Ragged mixed iteration, two dispatches with T-bucket-only and
         decode-bucket-only compile keys respectively:
@@ -1197,6 +1338,8 @@ class ModelRunner:
             self.params, ftok, fpos, tok_pt, tok_kvl, seg_pt, seg_kvl,
             meta, gather, self.k_pool, self.v_pool,
             self._device_sampling(sampling, seg_cap), jnp.int32(step),
+            self._seg_mask(masks, seg_cap),
+            self._seg_bias(biases, seg_cap),
         )
         B = _next_bucket(self.decode_buckets, n_dec)
         tok0 = sampled[:B]  # decode rows lead the segment order
@@ -1208,14 +1351,27 @@ class ModelRunner:
             packed[:n_dec] = [p + 1 for p in positions]
             packed[B : B + B * MP] = pt.ravel()
             packed[-1] = step + 1
+            mkw = {}
+            if mask_fn is not None:
+                # guided rows continue through the fused tail: the host
+                # callback advances each DFA copy by tok0 (still device-
+                # resident here) before masking inner step 0
+                mask_fn.B = B
+                self.set_guided_ctx(mask_fn)
+                mkw["mask_fn"] = self._mask_tramp
+            bias_dev = None
+            if biases is not None:
+                bz = np.zeros((B, self.config.vocab_size), np.float32)
+                bz[: biases.shape[0]] = biases
+                bias_dev = jnp.asarray(bz)
             # n_steps is the scheduler's fixed multi-step count, so
             # n_steps-1 adds exactly ONE decode_loop variant alongside the
             # legacy path's n_steps — bounded by design (ragged two-
             # dispatch split, docs/ragged_attention.md)
             rest, _, _, self.k_pool, self.v_pool = self._jit_decode_loop(  # dynlint: disable=DYN-J004
                 n_steps - 1, -1, self.params, tok0, jnp.asarray(packed),
-                None, None, None, self.k_pool, self.v_pool,
-                self._device_sampling(sampling, B), None,
+                None, None, bias_dev, self.k_pool, self.v_pool,
+                self._device_sampling(sampling, B), None, **mkw,
             )
             tok0_h, rest_h = jax.device_get((tok0, rest))
             toks = np.concatenate(
@@ -1235,6 +1391,11 @@ class ModelRunner:
         sampling,
         step: int,
         chunks: Sequence[Dict[str, Any]] = (),
+        masks: Optional[Dict[int, np.ndarray]] = None,  # row index ->
+        # [V] bool guided mask for that row's single verify position
+        # (guided rows never draft, so exactly one position each)
+        biases: Optional[Dict[int, np.ndarray]] = None,  # row index ->
+        # [V] f32 logit-bias row, same draft-less single-position contract
     ) -> Tuple[List[np.ndarray], jax.Array]:
         """One speculative-verify iteration through the SAME _jit_ragged
         program as the mixed path — zero new compile families or
@@ -1336,6 +1497,25 @@ class ModelRunner:
             exp["rep"].append(1.0)
             exp["freq"].append(0.0)
             exp["presence"].append(0.0)
+        row_masks = None
+        if masks:
+            # guided rows ride the verify dispatch as draft-less q_len=1
+            # segments (per-sequence speculation pause): mask only their
+            # verify position, every other entry stays all-allowed
+            row_masks = np.ones(
+                (sum(row_lens), self.config.vocab_size), bool
+            )
+            offs = np.concatenate([[0], np.cumsum(row_lens)])
+            for i, m in masks.items():
+                row_masks[offs[i]] = m
+        row_biases = None
+        if biases:
+            row_biases = np.zeros(
+                (sum(row_lens), self.config.vocab_size), np.float32
+            )
+            offs = np.concatenate([[0], np.cumsum(row_lens)])
+            for i, b in biases.items():
+                row_biases[offs[i]] = b
         sampled, seg_logits, self.k_pool, self.v_pool = self._jit_ragged(
             self.params,
             jnp.asarray(flat[None]),
@@ -1348,6 +1528,8 @@ class ModelRunner:
             jnp.asarray(gather),
             self.k_pool, self.v_pool,
             self._device_sampling(exp, seg_cap), jnp.int32(step),
+            self._seg_mask(row_masks, seg_cap),
+            self._seg_bias(row_biases, seg_cap),
         )
         sampled_h = np.asarray(jax.device_get(sampled))  # one bulk sync
         out: List[np.ndarray] = []
@@ -1651,6 +1833,32 @@ class ModelRunner:
         n = len(target_pages)
         self.k_pool = self._store_pages(self.k_pool, idx, k[:, offset : offset + n])
         self.v_pool = self._store_pages(self.v_pool, idx, v[:, offset : offset + n])
+
+    def copy_pages(self, src: int, dst: int) -> None:
+        """Fork-on-branch CoW: duplicate one page's KV into a fresh slot
+        so a branch can diverge without clobbering the sibling's partial
+        tail page. One jitted donated program (src/dst are traced
+        scalars — a single compile serves every fork); quantized dict
+        pools copy raw payload+scales, no dequant round-trip. Draft-model
+        pools mirror the page table, so a speculating runner copies those
+        too."""
+        if not hasattr(self, "_jit_copy_page"):
+            def _cp(kp, vp, s, d):
+                def one(p):
+                    if isinstance(p, dict):
+                        return jax.tree.map(
+                            lambda a: a.at[:, d].set(a[:, s]), p
+                        )
+                    return p.at[:, d].set(p[:, s])
+                return one(kp), one(vp)
+            self._jit_copy_page = jax.jit(_cp, donate_argnums=(0, 1))
+        self.k_pool, self.v_pool = self._jit_copy_page(
+            self.k_pool, self.v_pool, src, dst
+        )
+        if getattr(self, "draft_k_pool", None) is not None:
+            self.draft_k_pool, self.draft_v_pool = self._jit_copy_page(
+                self.draft_k_pool, self.draft_v_pool, src, dst
+            )
 
     # -- disagg KV transfer (host-staged DCN path, SURVEY.md §2.11) ---------
     def export_pages(self, pages: List[int]) -> Dict[str, Any]:

@@ -87,6 +87,16 @@ class Sequence:
     # (engine sets before step_plan; the scheduler trims them to the
     # mixed token budget; the engine consumes and clears after verify)
     spec_draft: List[int] = field(default_factory=list)
+    # fork-on-branch (n>1 sampling): the parent carries n_branches; each
+    # forked sibling carries branch_of=<parent request_id> and its choice
+    # index, and shares the parent's trunk pages copy-on-write
+    n_branches: int = 1
+    branch_of: Optional[str] = None
+    branch_index: int = 0
+    # set after the parent's first prefill forks (or fails to fork) its
+    # siblings: a preempted parent re-prefills, and re-forking would emit
+    # duplicate finish items for choice indices that already streamed
+    branches_spawned: bool = False
 
     @property
     def n_generated(self) -> int:
@@ -192,6 +202,11 @@ class Scheduler:
         self.waiting: deque[Sequence] = deque()
         self.active: List[Sequence] = []
         self.stats = SchedulerStats()
+        # prompt tokens served from warm KV (prefix/tree reuse): these
+        # never charge the mixed_prefill_tokens pool — chunking starts at
+        # computed_len, so only the un-reused suffix is prefill work
+        self.reused_prefix_tokens = 0
+        self.prompt_tokens_total = 0  # denominator for the tree hit rate
 
     # -- API ---------------------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -388,6 +403,9 @@ class Scheduler:
         seq.n_shared_pages = len(matched_pages)
         seq.hash_chain = hashes
         seq.computed_len = match_len
+        self.reused_prefix_tokens += match_len
+        if seq.n_preemptions == 0:  # re-admits would double-count
+            self.prompt_tokens_total += len(prompt)
         return True
 
     # -- prefill -----------------------------------------------------------
@@ -462,6 +480,29 @@ class Scheduler:
         seq.state = SeqState.RUNNING
         self.active.append(seq)
         self._register_complete_pages(seq)
+        return True
+
+    def adopt_branch(
+        self, branch: Sequence, parent: Sequence, pages: List[int]
+    ) -> bool:
+        """Admit a fork-on-branch sibling directly into the running batch.
+
+        The caller (engine._fork_branches) already fork_table'd the
+        parent's pages — the shared trunk is ref-bumped and the partial
+        tail copied — so the branch starts exactly where the parent is:
+        same computed KV, same hash chain, one prefill-sampled token away
+        from its first decode step. No prefill pass, no allocation."""
+        if len(self.active) >= self.max_batch:
+            self.pool.release(pages)
+            return False
+        branch.tokens = list(parent.tokens)
+        branch.n_prompt0 = parent.n_prompt0
+        branch.pages = pages
+        branch.computed_len = parent.computed_len
+        branch.n_shared_pages = parent.n_shared_pages
+        branch.hash_chain = list(parent.hash_chain)
+        branch.state = SeqState.RUNNING
+        self.active.append(branch)
         return True
 
     # -- decode ------------------------------------------------------------

@@ -218,6 +218,8 @@ async def async_main(args) -> None:
             win = q.get("window_s")
             view = observer.fleet(window_s=float(win) if win else None)
             view["slo"] = slo.evaluate()
+            if watcher.affinity is not None:
+                view["sessions"] = watcher.affinity.snapshot()
             return view
 
         def _routing_view(q):

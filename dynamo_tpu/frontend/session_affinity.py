@@ -54,7 +54,7 @@ class AffinityError(ValueError):
 
 class _Entry:
     __slots__ = ("state", "revision", "event", "instance_id", "leases",
-                 "idle_deadline")
+                 "idle_deadline", "turns")
 
     def __init__(self, state: str, revision: int):
         self.state = state  # "init" | "bound"
@@ -65,6 +65,7 @@ class _Entry:
         self.instance_id: Optional[int] = None
         self.leases = 0
         self.idle_deadline = 0.0
+        self.turns = 0  # requests served under this binding
 
 
 class AffinityLease:
@@ -133,6 +134,11 @@ class AffinityCoordinator:
         self._sync_pub = None
         self._sync_sub = None
         self._replica_id = f"{id(self):x}{int(time.time()*1e6):x}"
+        # observability counters (rendered by /debug/fleet "sessions" and
+        # dynamo_top's SESS column); rebinds counts stale-worker and
+        # connect-error rebind cycles, expiries counts idle-TTL reaps
+        self.stats = {"binds": 0, "rebinds": 0, "expiries": 0,
+                      "invalidations": 0}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -173,7 +179,8 @@ class AffinityCoordinator:
                     if e.state == "bound" and e.leases == 0
                     and now >= e.idle_deadline
                 ]:
-                    self.entries.pop(sid, None)
+                    if self.entries.pop(sid, None) is not None:
+                        self.stats["expiries"] += 1
         except asyncio.CancelledError:
             pass
 
@@ -209,6 +216,8 @@ class AffinityCoordinator:
             if (entry is None
                     or (entry.leases == 0 and now >= entry.idle_deadline)):
                 # claim the initializing slot (fresh or replacing expired)
+                if entry is not None:
+                    self.stats["expiries"] += 1
                 if entry is None and len(self.entries) >= self.max_entries:
                     self._evict_one_expired(now)
                     if len(self.entries) >= self.max_entries:
@@ -224,6 +233,7 @@ class AffinityCoordinator:
                     f"{entry.instance_id:x}, not {explicit:x}"
                 )
             entry.leases += 1
+            entry.turns += 1
             return AffinityLease(self, key, entry, entry.instance_id)
 
     def _evict_one_expired(self, now: float) -> None:
@@ -240,9 +250,11 @@ class AffinityCoordinator:
         entry.event = None
         entry.instance_id = int(instance_id)
         entry.leases = 1
+        entry.turns += 1
         entry.idle_deadline = self._clock() + self.ttl
         if event is not None:
             event.set()
+        self.stats["binds"] += 1
         self._publish("bind", session_id, entry.instance_id)
 
     def _release(self, session_id: str, entry: _Entry, bound: bool) -> None:
@@ -266,7 +278,28 @@ class AffinityCoordinator:
         if entry is not None and entry.event is not None:
             entry.event.set()
         if entry is not None:
+            self.stats["invalidations"] += 1
             self._publish("invalidate", key, entry.instance_id)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Observability view: table gauges, lifecycle counters, per-session
+        turn depth, and bound-session count per worker (dynamo_top SESS)."""
+        bound = [e for e in self.entries.values() if e.state == "bound"]
+        turns = sorted(e.turns for e in bound)
+        by_instance: Dict[str, int] = {}
+        for e in bound:
+            k = f"{e.instance_id:x}"
+            by_instance[k] = by_instance.get(k, 0) + 1
+        return {
+            "sessions": len(self.entries),
+            "bound": len(bound),
+            "initializing": len(self.entries) - len(bound),
+            "ttl_s": self.ttl,
+            **self.stats,
+            "turns_p50": turns[len(turns) // 2] if turns else 0,
+            "turns_max": turns[-1] if turns else 0,
+            "by_instance": by_instance,
+        }
 
     def invalidate_instance(self, instance_id: int) -> None:
         """Worker died: drop every session pinned to it (next request of each
@@ -428,6 +461,7 @@ class SessionAffinityEngine:
         if lease.target is not None and lease.target not in self.client.instances:
             lease.release()
             self.coordinator.invalidate(session_id, scope=scope)
+            self.coordinator.stats["rebinds"] += 1
             lease = await self.coordinator.acquire(
                 session_id, explicit=explicit, scope=scope
             )
@@ -450,6 +484,7 @@ class SessionAffinityEngine:
         except Exception as e:
             if getattr(e, "code", None) in self._CONNECT_ERRORS:
                 self.coordinator.invalidate(session_id, scope=scope)
+                self.coordinator.stats["rebinds"] += 1
                 # let the migration retry re-route instead of re-pinning
                 context.metadata.pop("target_instance", None)
             raise

@@ -53,6 +53,9 @@ class SimTiming:
     # overlappable compute, never a fictional free copy. More groups =
     # smaller blocking slice but more per-group setup overhead.
     onboard_group_base_s: float = 0.0005
+    # fork-on-branch CoW: one page's KV duplicated on-device when a
+    # branch takes a private copy of the shared trunk's partial tail
+    page_copy_s: float = 0.0002
     speed: float = 1.0  # scale all sleeps; 0 disables (unit tests)
     # prefill_packed cost mode. "ragged" (default) charges
     # sum(chunk_tokens) — the flat-token dispatch the ragged runner path
@@ -170,6 +173,11 @@ def _sim_token(seed: int, position: int, vocab: int = 50000) -> int:
 class SimRunner:
     """Drop-in for ModelRunner inside InferenceEngine (no JAX)."""
 
+    # guided rows ride full multi-step loops: decode_multi honors the
+    # engine's host-callback mask context between fused steps, so the
+    # scheduler never collapses a constrained plan to n_steps=1
+    guided_fused = True
+
     def __init__(
         self,
         *,
@@ -195,6 +203,11 @@ class SimRunner:
         # A/Bs can assert what the cost model billed (acceptance: ragged
         # mode bills sum(chunk_tokens), padded bills N_bucket x S_bucket)
         self.stats = {
+            # real prompt tokens prefilled through ANY path (single-chunk,
+            # packed, or verify-ridealong) — with tree reuse the scheduler
+            # only dispatches the un-reused suffix, so this counter is the
+            # honest "prefill work actually done" figure A/Bs difference
+            "prefill_tokens_real": 0,
             "packed_dispatches": 0,
             "packed_tokens_real": 0,
             "packed_tokens_charged": 0,
@@ -202,6 +215,7 @@ class SimRunner:
             "spec_tokens_charged": 0,
             "onboards_streamed": 0,
             "onboard_overlap_s": 0.0,
+            "page_copies": 0,
         }
         # wall-clock instant the deepest in-flight layer group of a
         # streamed onboard lands (0.0 = nothing in flight). Dispatches
@@ -212,6 +226,7 @@ class SimRunner:
     # -- ModelRunner interface ---------------------------------------------
     def prefill(self, tokens: List[int], start_pos: int, page_table_row, prior_len: int, adapter: int = 0, mm=None):
         t = self.timing
+        self.stats["prefill_tokens_real"] += len(tokens)
         t.sleep(t.prefill_base_s + len(tokens) * t.prefill_per_token_s)
         self._drain_onboard()
         # "logits": seeded by the LAST prompt token + position only, so the
@@ -232,6 +247,7 @@ class SimRunner:
         total = sum(len(c["tokens"]) for c in chunks)
         charged = t.packed_charge_tokens([len(c["tokens"]) for c in chunks])
         self.stats["packed_dispatches"] += 1
+        self.stats["prefill_tokens_real"] += total
         self.stats["packed_tokens_real"] += total
         self.stats["packed_tokens_charged"] += charged
         t.sleep(t.prefill_base_s + charged * t.prefill_per_token_s)
@@ -258,6 +274,7 @@ class SimRunner:
     def decode_multi(
         self, n_steps: int, tokens: List[int], positions: List[int],
         page_tables, sampling, step: int, adapters=None, masks=None,
+        mask_fn=None,
     ) -> np.ndarray:
         t = self.timing
         t.sleep(
@@ -265,21 +282,33 @@ class SimRunner:
             + n_steps * (t.decode_base_s + len(tokens) * t.decode_per_seq_s)
         )
         self._drain_onboard()
+        # step-outer: each fused step is seeded by the PREVIOUS EMITTED
+        # token (like the real on-device feedback loop, where the masked
+        # sample is what gets fed back), so the sim stream is a pure
+        # function of (prev_emitted_token, position) and is invariant to
+        # dispatch boundaries — the property spec-decode and guided
+        # byte-identity A/Bs assert. For unguided rows emitted == raw,
+        # so this matches the legacy raw-chained stream exactly.
         out = np.zeros((len(tokens), n_steps), np.int32)
-        for i, (tok, pos) in enumerate(zip(tokens, positions)):
-            # chained: each fused step is seeded by the PREVIOUS sampled
-            # token (like the real on-device feedback loop), so the sim
-            # stream is a pure function of (prev_token, position) and is
-            # invariant to dispatch boundaries — the property spec-decode
-            # byte-identity A/Bs assert
-            prev = tok
-            for j in range(n_steps):
-                prev = _sim_token(prev, pos + 1 + j, self.vocab_size)
-                out[i, j] = prev
-            if masks is not None and not masks[i, out[i, 0]]:
-                allowed = np.flatnonzero(masks[i])
-                if len(allowed):
-                    out[i, 0] = int(allowed[out[i, 0] % len(allowed)])
+        prev = list(tokens)
+        for j in range(n_steps):
+            if mask_fn is not None:
+                # the engine's host-callback mask context: advances the
+                # per-row DFA state off the step's emitted tokens, same
+                # contract the real runner's io_callback uses
+                m = np.asarray(mask_fn(j, np.asarray(prev, np.int32)))
+            elif masks is not None and j == 0:
+                m = masks
+            else:
+                m = None
+            for i in range(len(tokens)):
+                tok = _sim_token(prev[i], positions[i] + 1 + j, self.vocab_size)
+                if m is not None and not m[i, tok]:
+                    allowed = np.flatnonzero(m[i])
+                    if len(allowed):
+                        tok = int(allowed[tok % len(allowed)])
+                out[i, j] = tok
+                prev[i] = tok
         return out
 
     # -- speculative decoding (n-gram / oracle drafting) --------------------
@@ -308,6 +337,7 @@ class SimRunner:
     def verify_spec(
         self, tokens: List[int], positions: List[int], page_tables,
         drafts: List[List[int]], sampling, step: int, chunks=(),
+        masks=None,
     ):
         """Speculative verify as ONE simulated ragged flat-token dispatch:
         row i contributes len(drafts[i])+1 verify positions (a plain
@@ -329,9 +359,9 @@ class SimRunner:
             chunk_charged = t.packed_charge_tokens(
                 [len(c["tokens"]) for c in chunks]
             )
-            self.stats["packed_tokens_real"] += sum(
-                len(c["tokens"]) for c in chunks
-            )
+            real = sum(len(c["tokens"]) for c in chunks)
+            self.stats["prefill_tokens_real"] += real
+            self.stats["packed_tokens_real"] += real
         self.stats["spec_dispatches"] += 1
         self.stats["spec_tokens_charged"] += charged
         self.stats["packed_dispatches"] += 1
@@ -344,11 +374,19 @@ class SimRunner:
         )
         self._drain_onboard()
         rows = []
-        for tok, pos, d in zip(tokens, positions, drafts):
+        for ri, (tok, pos, d) in enumerate(zip(tokens, positions, drafts)):
             out = np.zeros(len(d) + 1, np.int32)
+            m = masks.get(ri) if masks else None
             for j in range(len(d) + 1):
                 fed = tok if j == 0 else d[j - 1]
                 out[j] = _sim_token(fed, pos + 1 + j, self.vocab_size)
+                if m is not None and not m[out[j]]:
+                    # guided rows ride verify draft-less (one position);
+                    # honor the mask with the same deterministic remap
+                    # sample_one / decode_multi use
+                    allowed = np.flatnonzero(m)
+                    if len(allowed):
+                        out[j] = int(allowed[out[j] % len(allowed)])
             rows.append(out)
         chunk_logits = []
         for c in chunks:
@@ -359,6 +397,13 @@ class SimRunner:
 
     def decode(self, tokens, positions, page_tables, kv_lens, sampling, step):
         return self.decode_multi(1, tokens, positions, page_tables, sampling, step)[:, 0]
+
+    def copy_pages(self, src: int, dst: int) -> None:
+        """Fork-on-branch CoW page duplication — pure billing in the sim
+        (there is no KV payload), but the cost model charges the device
+        DMA so fork A/Bs don't measure a fictional free copy."""
+        self.timing.sleep(self.timing.page_copy_s)
+        self.stats["page_copies"] += 1
 
     def embed(self, token_lists: List[List[int]]) -> np.ndarray:
         self.timing.sleep(self.timing.prefill_base_s)

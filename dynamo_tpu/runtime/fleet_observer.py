@@ -283,6 +283,21 @@ class DigestBuilder:
                                     / max(1, spec.get("drafted", 0))),
                     "accepted_per_step": spec.get("spec_emitted", 0) / rows,
                 }
+            pool = getattr(engine, "pool", None)
+            if pool is not None and hasattr(pool, "match_hit_blocks"):
+                # session-tree reuse: cumulative engine-lifetime counters
+                # (like spec above); hit_rate is reused prompt tokens over
+                # all admitted prompt tokens
+                sched = getattr(engine, "scheduler", None)
+                reused = int(getattr(sched, "reused_prefix_tokens", 0) or 0)
+                prompts = int(getattr(sched, "prompt_tokens_total", 0) or 0)
+                digest["tree"] = {
+                    "hit_blocks": int(pool.match_hit_blocks),
+                    "forks": int(getattr(pool, "forks", 0)),
+                    "reused_prefix_tokens": reused,
+                    "prompt_tokens": prompts,
+                    "hit_rate": round(reused / prompts, 4) if prompts else 0.0,
+                }
             rec = getattr(engine, "recorder", None)
             if rec is not None and getattr(rec, "enabled", False):
                 digest["recorder"] = {
@@ -531,6 +546,8 @@ class FleetObserver:
                 # recent digest that carried a block (quiet windows omit it)
                 "spec": next((d["spec"] for d in reversed(digests)
                               if d.get("spec")), {}),
+                "tree": next((d["tree"] for d in reversed(digests)
+                              if d.get("tree")), {}),
                 "counters": {k: round(v, 6) if isinstance(v, float) else v
                              for k, v in counters.items()},
                 "phases": self._pct_block(hists),

@@ -80,6 +80,10 @@ class IterationRecord:
     # row speculated) — the per-step multi-token factor the ITL spine
     # divides by, surfaced in the fleet digest
     accepted_per_step: float = 0.0
+    # agentic session-tree serving
+    guided_rows: int = 0       # constraint-masked decode rows this iteration
+    tree_hit_blocks: int = 0   # cumulative blocks served warm by match_prefix
+    forks: int = 0             # cumulative fork-on-branch fan-outs
 
 
 @dataclass

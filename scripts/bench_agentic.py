@@ -1,0 +1,205 @@
+"""Agentic session-tree A/B: prefix-tree KV reuse x guided decoding.
+
+Runs the `agentic` loadgen scenario (multi-turn tool-calling sessions
+with think/tool gaps; optionally a strict-JSON guided extraction as each
+session's final turn) through a full InferenceEngine + SimRunner stack,
+2x2: {tree reuse on, off} x {guided on, off}. Reports per arm the
+turn-split TTFT (turn 1 = cold prefill, turns >= 2 re-send a transcript
+the engine already computed), billed ITL, and the engine's tree counters,
+plus the two headline ratios:
+
+- tree_ttft_ratio: turn>=2 TTFT p50, reuse off / on  (claim: >= 2x)
+- guided_itl_overhead: ITL p50, guided on / off - 1  (claim: < 5%)
+
+The guided arm also asserts fusion: the flight recorder must show
+multi-step decode iterations carrying guided rows (no n_steps=1
+collapse). `--real` adds the compile-variant parity check on a tiny real
+ModelRunner (CPU): serving guided requests after free ones must add ZERO
+step-function families or variants.
+
+Deterministic mocker by default, no TPUs. Run:
+
+    python scripts/bench_agentic.py [--sessions 8] [--speed 1.0] [--real]
+
+Prints one JSON line {"metric": "agentic_session_tree", "arms": {...},
+"tree_ttft_ratio": ..., "guided_itl_overhead": ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dynamo_tpu.bench.loadgen import (  # noqa: E402
+    GUIDED_EXTRACT_PATTERN,
+    generate_scenarios,
+    run_sessions_against_engine,
+)
+from dynamo_tpu.engine.engine import InferenceEngine  # noqa: E402
+from dynamo_tpu.mocker.sim import SimRunner, SimTiming  # noqa: E402
+
+
+def _pct(vals, p):
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(p * len(vals)))], 6) if vals else None
+
+
+def _engine(args, prefix_cache):
+    runner = SimRunner(
+        num_pages=4096, page_size=16, max_pages_per_seq=128,
+        timing=SimTiming(speed=args.speed),
+    )
+    engine = InferenceEngine(
+        runner, max_batch=16, chunk_size=256, decode_steps=4,
+        mixed_prefill_tokens=256, mixed_prefill_seqs=4, mixed_min_chunk=16,
+        enable_prefix_cache=prefix_cache, recorder_size=4096,
+    )
+    return runner, engine
+
+
+async def _arm(args, prefix_cache, guided):
+    scripts = generate_scenarios(["agentic"], n_sessions=args.sessions,
+                                 rps=args.rps, seed=args.seed)
+    if guided:
+        # the realistic shape: the agent's final turn is a strict-JSON
+        # extraction over the whole transcript
+        for s in scripts:
+            s.turns[-1].guided = {"kind": "regex",
+                                  "pattern": GUIDED_EXTRACT_PATTERN}
+    runner, engine = _engine(args, prefix_cache)
+    engine.start()
+    try:
+        results, duration = await run_sessions_against_engine(
+            scripts, engine.generate, time_scale=args.time_scale,
+            seed=args.seed)
+    finally:
+        engine.stop()
+    bad = [r for r in results if not r.ok]
+    assert not bad, f"{len(bad)} failed turns, first: {bad[0].error}"
+    itls = [s for r in results
+            for s in (r.phases.get("itl_s") or []) if isinstance(s, float)]
+    recs = engine.recorder.snapshot()
+    arm = {
+        "turns": len(results),
+        "ttft_turn1_p50_s": _pct(
+            [r.ttft_s for r in results if r.turn == 0 and r.ttft_s], 0.5),
+        "ttft_turn2plus_p50_s": _pct(
+            [r.ttft_s for r in results if r.turn >= 1 and r.ttft_s], 0.5),
+        "itl_p50_s": _pct(itls, 0.5),
+        "itl_p99_s": _pct(itls, 0.99),
+        "output_tokens": sum(r.osl for r in results),
+        "duration_s": round(duration, 4),
+        "tree": {
+            "reused_prefix_tokens": engine.scheduler.reused_prefix_tokens,
+            "prompt_tokens": engine.scheduler.prompt_tokens_total,
+            "hit_blocks": engine.pool.match_hit_blocks,
+            "forks": engine.pool.forks,
+        },
+    }
+    if guided:
+        # fusion guard: guided rows must ride multi-step fused loops
+        fused = sum(1 for x in recs
+                    if x.guided_rows > 0 and x.decode_steps > 1)
+        assert fused > 0, "guided rows never rode a multi-step fused loop"
+        arm["guided_fused_iters"] = fused
+    return arm
+
+
+def _compile_parity(args):
+    """Tiny real ModelRunner on CPU: the SAME workload run guided vs free
+    must produce IDENTICAL compile caches — same step-function families,
+    same variant counts (masks/biases are always-present operands, not new
+    shapes). Row lifetimes are pinned equal (never-accepting pattern, no
+    EOS, fixed max_tokens) so both runs visit the same buckets."""
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.runtime.context import Context
+
+    def run(guided):
+        runner = ModelRunner(
+            get_config("tiny"), num_pages=64, page_size=4,
+            max_pages_per_seq=16, decode_buckets=(1, 2, 4, 8),
+            prefill_buckets=(8, 16, 32), seed=0,
+        )
+        engine = InferenceEngine(runner, max_batch=8, chunk_size=16,
+                                 decode_steps=4, tokenizer_spec="byte")
+        engine.start()
+
+        async def drive():
+            async def one(seed):
+                req = {"token_ids": [65 + seed % 20] * 12,
+                       "sampling": {"temperature": 0.0, "seed": seed},
+                       "stop": {"max_tokens": 16, "stop_ids": [],
+                                "ignore_eos": True}}
+                if guided:
+                    # can't accept before max_tokens -> no early EOS, the
+                    # row's lifetime matches the free run's exactly
+                    req["guided"] = {"kind": "regex",
+                                     "pattern": "[ab]{200,400}"}
+                async for item in engine.generate(req, Context()):
+                    assert item.get("finish_reason") != "error", item
+            await asyncio.gather(*[one(i) for i in range(4)])
+
+        try:
+            asyncio.run(drive())
+        finally:
+            engine.stop()
+        return {f: st["variants"] for f, st in runner.compile_stats().items()}
+
+    free = run(False)
+    guided = run(True)
+    assert guided == free, (
+        f"guided run's compile cache diverged: free={free} guided={guided}")
+    return {"families": dict(sorted(free.items())),
+            "new_families": 0, "new_variants": 0}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sessions", type=int, default=8,
+                    help="agentic sessions per arm")
+    ap.add_argument("--rps", type=float, default=8.0,
+                    help="session arrival rate")
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="SimTiming scale (smaller = faster bench)")
+    ap.add_argument("--time-scale", type=float, default=0.25,
+                    help="compresses think/tool gaps")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--real", action="store_true",
+                    help="also run the compile-variant parity check on a "
+                         "tiny real ModelRunner (CPU, needs JAX)")
+    args = ap.parse_args()
+
+    arms = {}
+    for tree in (True, False):
+        for guided in (False, True):
+            key = (f"tree_{'on' if tree else 'off'}"
+                   f"_guided_{'on' if guided else 'off'}")
+            arms[key] = asyncio.run(_arm(args, tree, guided))
+
+    warm = arms["tree_on_guided_off"]["ttft_turn2plus_p50_s"]
+    cold = arms["tree_off_guided_off"]["ttft_turn2plus_p50_s"]
+    g_on = arms["tree_on_guided_on"]["itl_p50_s"]
+    g_off = arms["tree_on_guided_off"]["itl_p50_s"]
+    report = {
+        "metric": "agentic_session_tree",
+        "sessions": args.sessions,
+        "arms": arms,
+        "tree_ttft_ratio": round(cold / max(warm, 1e-9), 3)
+        if warm and cold else None,
+        "guided_itl_overhead": round(g_on / max(g_off, 1e-9) - 1.0, 4)
+        if g_on and g_off else None,
+    }
+    if args.real:
+        report["compile_parity"] = _compile_parity(args)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

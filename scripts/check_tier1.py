@@ -47,7 +47,7 @@ def main() -> int:
         "test_sched_packing.py", "test_ragged_mixed.py",
         "test_dynlint.py", "test_flight_recorder.py",
         "test_fleet_observer.py", "test_spec_decode.py",
-        "test_kv_tiers.py",
+        "test_kv_tiers.py", "test_session_tree.py", "test_guided.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -88,9 +88,20 @@ def render(view: dict) -> list:
                 f"{name}<{t.get('threshold_s', 0) * 1000:g}ms "
                 f"[{t.get('state', '-')}] now={shown}")
         lines.append("  " + "  ".join(parts))
+    sess = view.get("sessions") or {}
+    if sess:
+        lines.append(
+            f"  sessions: {sess.get('bound', 0)} bound "
+            f"({sess.get('initializing', 0)} init), "
+            f"binds={sess.get('binds', 0)} rebinds={sess.get('rebinds', 0)} "
+            f"expiries={sess.get('expiries', 0)} "
+            f"turns p50/max={sess.get('turns_p50', 0)}/"
+            f"{sess.get('turns_max', 0)}")
+    sess_by_inst = sess.get("by_instance") or {}
     lines.append("")
     hdr = (f"{'WORKER':<14} {'RUN':>4} {'WAIT':>4} {'KV%':>5} {'G2':>6} "
            f"{'G3':>6} {'G2MB':>7} {'G3MB':>7} {'QNT%':>5} {'REQ':>6} "
+           f"{'SESS':>5} {'TREE%':>6} "
            f"{'TTFT99':>8} {'ITL50':>7} {'E2E95':>8} "
            f"{'PFHIT%':>6} {'SLO':>6}")
     lines.append(hdr)
@@ -106,12 +117,18 @@ def render(view: dict) -> list:
         pf_pct = f"{100.0 * hits / total:.0f}" if total else "-"
         kv_usage = kv.get("g1_usage")
         g2_mb, g3_mb, quant_pct = _tier_stats(kv)
+        tree = row.get("tree") or {}
+        tree_pct = (f"{100.0 * tree['hit_rate']:.0f}"
+                    if tree.get("prompt_tokens") else "-")
+        # sessions table keys by bare instance id; wkey is "{iid:x}.{dp}"
+        n_sess = sess_by_inst.get(wkey.split(".")[0], 0) if sess else "-"
         lines.append(
             f"{wkey:<14} {q.get('n_running', 0):>4} {q.get('n_waiting', 0):>4} "
             f"{(100.0 * kv_usage if kv_usage is not None else 0):>5.1f} "
             f"{kv.get('g2_blocks', 0) or 0:>6} {kv.get('g3_blocks', 0) or 0:>6} "
             f"{g2_mb:>7} {g3_mb:>7} {quant_pct:>5} "
             f"{(row.get('counters') or {}).get('requests', 0):>6} "
+            f"{n_sess:>5} {tree_pct:>6} "
             f"{_ms(phases, 'ttft', 'p99_s'):>8} {_ms(phases, 'itl', 'p50_s'):>7} "
             f"{_ms(phases, 'e2e', 'p95_s'):>8} {pf_pct:>6} "
             f"{_worker_slo(view, wkey):>6}"
@@ -123,6 +140,7 @@ def render(view: dict) -> list:
             f"{'fleet':<14} {'':>4} {'':>4} {'':>5} {'':>6} {'':>6} "
             f"{'':>7} {'':>7} {'':>5} "
             f"{sum((r.get('counters') or {}).get('requests', 0) for r in (view.get('workers') or {}).values()):>6} "
+            f"{'':>5} {'':>6} "
             f"{_ms(fleet_phases, 'ttft', 'p99_s'):>8} "
             f"{_ms(fleet_phases, 'itl', 'p50_s'):>7} "
             f"{_ms(fleet_phases, 'e2e', 'p95_s'):>8}")

@@ -430,3 +430,251 @@ def test_json_schema_pattern_cannot_break_string_context():
     for evil in ('a"b', "a\\\\b", "[\\x00-\\x7f]+", 'a|"'):
         with _pytest.raises(SchemaError):
             compile_pat(evil)
+
+
+# -- fused multi-step guided masking (host-callback contexts) ---------------
+
+
+def test_guided_mask_context_advances_copies_and_degrades():
+    from dynamo_tpu.engine.engine import GuidedMaskContext
+
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex("ab"))
+    ctx = GuidedMaskContext(3, 258, [(1, m, m.start)])
+    m0 = ctx(0, np.zeros(3, np.int32))
+    assert m0.shape == (3, 258)
+    assert m0[0].all() and m0[2].all()  # free rows stay all-allowed
+    assert m0[1][ord("a")] and not m0[1][ord("b")]
+    # t=1: row 1 emitted 'a' at step 0 → only 'b' continues the regex
+    m1 = ctx(1, np.array([5, ord("a"), 5], np.int32))
+    assert m1[1][ord("b")] and not m1[1][ord("a")]
+    # 'b' reaches the accepting state → EOS is the only continuation
+    m2 = ctx(2, np.array([5, ord("b"), 5], np.int32))
+    assert m2[1][tok.eos_id] and m2[1].sum() == 1
+    # EOS kills the row's copy: all-True for the remaining fused steps
+    m3 = ctx(3, np.array([5, tok.eos_id, 5], np.int32))
+    assert m3[1].all()
+    # the engine's authoritative DFA state was never touched
+    assert ctx.rows[0][2] != m.start
+
+
+def test_guided_mask_context_pending_advance_advances_at_t0():
+    from dynamo_tpu.engine.engine import GuidedMaskContext
+
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex("ab"))
+    ctx = GuidedMaskContext(1, 258, [(0, m, m.start)], pending_advance=True)
+    # the ragged tail: tok0 ('a') was sampled on-device and not yet folded
+    m0 = ctx(0, np.array([ord("a")], np.int32))
+    assert m0[0][ord("b")] and not m0[0][ord("a")]
+
+
+async def _sim_guided(decode_steps, prompts_specs, n=24, concurrent=True):
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+    from dynamo_tpu.runtime.context import Context
+
+    runner = SimRunner(num_pages=256, page_size=4, max_pages_per_seq=64,
+                       vocab_size=258, timing=SimTiming(speed=0.0))
+    engine = InferenceEngine(runner, max_batch=8, chunk_size=16,
+                             decode_steps=decode_steps,
+                             mixed_prefill_tokens=64, recorder_size=256,
+                             tokenizer_spec="byte")
+    engine.start()
+
+    async def one(prompt, spec):
+        toks = []
+        req = _greq(prompt, spec, max_tokens=n)
+        async for item in engine.generate(req, Context()):
+            assert item.get("finish_reason") != "error", item
+            toks.extend(item["token_ids"])
+        return toks
+
+    try:
+        outs = await asyncio.gather(
+            *[one(p, s) for p, s in prompts_specs])
+    finally:
+        engine.stop()
+    return outs, engine
+
+
+async def test_sim_guided_multistep_fused_byte_identity():
+    """The tentpole invariant on the mocker: guided rows riding full
+    multi-step fused loops (decode_steps=4, host-callback mask context)
+    emit exactly the bytes the legacy one-step-per-dispatch path does —
+    and the plan really did keep T>1 with a guided row in the batch."""
+    work = [
+        ([10, 11, 12], {"kind": "regex", "pattern": "[ab]{6,12}"}),
+        ([20, 21], None),  # a free row co-batched with the guided one
+        ([30, 31, 32], {"kind": "regex", "pattern": r"(yes|no) sir!"}),
+    ]
+    fused, e_fused = await _sim_guided(4, work)
+    legacy, _ = await _sim_guided(1, work)
+    assert fused == legacy
+    recs = e_fused.recorder.snapshot()
+    multi = [x for x in recs if x.guided_rows > 0 and x.decode_steps > 1]
+    assert multi, "guided rows never rode a multi-step fused loop"
+
+
+async def test_sim_guided_output_still_matches_constraint():
+    outs, _ = await _sim_guided(
+        4, [([1, 2, 3], {"kind": "regex", "pattern": "[ab]{3}"})])
+    text = bytes(t for t in outs[0] if t < 256).decode()
+    assert len(text) == 3 and set(text) <= {"a", "b"}
+
+
+# -- per-row speculation pause (satellite regression) ------------------------
+
+
+async def test_sim_spec_mixed_batch_keeps_free_rows_drafting():
+    """A guided row in the batch must pause speculation ONLY for itself:
+    free rows keep drafting (accept-rate speedup intact) and stay
+    byte-identical to a spec-off run; the guided row stays valid."""
+    import hashlib
+
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+    from dynamo_tpu.runtime.context import Context
+
+    free_prompts = [[3, 1, 4, 1] * 4, [2, 7] * 6]
+
+    async def run(spec_on):
+        runner = SimRunner(num_pages=256, page_size=4, max_pages_per_seq=64,
+                           vocab_size=258, timing=SimTiming(speed=0.0),
+                           spec_accept_rate=0.9 if spec_on else None)
+        engine = InferenceEngine(runner, max_batch=8, chunk_size=16,
+                                 decode_steps=4, mixed_prefill_tokens=64,
+                                 spec_ngram=spec_on, spec_k=4,
+                                 tokenizer_spec="byte")
+        engine.start()
+
+        async def one(req):
+            toks = []
+            async for item in engine.generate(req, Context()):
+                assert item.get("finish_reason") != "error", item
+                toks.extend(item["token_ids"])
+            return toks
+
+        try:
+            outs = await asyncio.gather(
+                one(_greq(free_prompts[0], None, max_tokens=24)),
+                one(_greq(free_prompts[1], None, max_tokens=24)),
+                one(_greq([40, 41], {"kind": "regex", "pattern": "[ab]{4,20}"},
+                          max_tokens=24)),
+            )
+        finally:
+            engine.stop()
+        return outs, engine.spec_stats
+
+    base, _ = await run(False)
+    spec, st = await run(True)
+    assert spec[0] == base[0] and spec[1] == base[1]  # free rows identical
+    gtext = bytes(t for t in spec[2] if t < 256).decode()
+    assert set(gtext) <= {"a", "b"}  # guided row honored its constraint
+    assert st["verify_iters"] > 0, st  # free rows really speculated
+    assert st["accepted"] > 0, st  # ...and kept the accept-rate speedup
+
+
+# -- TokenLifter row build stays outside the lock (satellite guard) ----------
+
+
+def test_matcher_row_build_runs_outside_the_lock():
+    """The vectorized per-state row build (vocab-sized, ~ms at 128k) must
+    happen OUTSIDE the matcher lock — the lock guards only the FIFO
+    insert. A regression here serializes every concurrent guided request
+    behind one slow state."""
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex("[ab]{1,8}"))
+    seen_locked = []
+    real_trans = m.dfa.trans
+
+    class Probe:
+        def __getitem__(self, key):
+            seen_locked.append(m._lock.locked())
+            return real_trans[key]
+
+    class DfaProxy:
+        trans = Probe()
+        accept = m.dfa.accept
+        start = m.dfa.start
+
+    m.dfa = DfaProxy()
+    mask = m.allowed(m.start)
+    assert mask[ord("a")] and mask[ord("b")]
+    assert seen_locked and not any(seen_locked), seen_locked
+
+
+def test_slow_state_does_not_serialize_concurrent_rows():
+    """Thread A blocks mid-build of one state's row; thread B must still
+    complete a different state's row while A is stuck."""
+    import threading
+
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex("ab[cd]"))
+    slow_state = m.start
+    fast_state = m.advance(m.start, ord("a"))
+    m._rows.clear()  # force both rows to rebuild
+    real_trans = m.dfa.trans
+    a_started, a_gate = threading.Event(), threading.Event()
+    errs = []
+
+    class Gate:
+        def __getitem__(self, key):
+            s = np.asarray(key[0])
+            if s.size and np.all(s == slow_state):
+                a_started.set()
+                if not a_gate.wait(10):
+                    errs.append("gate timed out (row build serialized?)")
+            return real_trans[key]
+
+    class DfaProxy:
+        trans = Gate()
+        accept = m.dfa.accept
+        start = m.dfa.start
+
+    m.dfa = DfaProxy()
+    ta = threading.Thread(target=lambda: m.allowed(slow_state))
+    ta.start()
+    assert a_started.wait(10)
+    done = threading.Event()
+    tb = threading.Thread(
+        target=lambda: (m.allowed(fast_state), done.set()))
+    tb.start()
+    finished_while_a_stuck = done.wait(5)
+    a_gate.set()
+    ta.join(10)
+    tb.join(10)
+    assert finished_while_a_stuck, \
+        "concurrent row build blocked behind a slow state"
+    assert not errs, errs
+
+
+def test_engine_compile_guided_single_flight_cache():
+    """Concurrent compiles of the SAME spec race benignly (first insert
+    wins, both callers get an equivalent matcher) and the winning matcher
+    is cached for later calls."""
+    import threading
+
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+
+    runner = SimRunner(num_pages=64, page_size=4, max_pages_per_seq=16,
+                       vocab_size=258, timing=SimTiming(speed=0.0))
+    engine = InferenceEngine(runner, max_batch=2, chunk_size=16,
+                             tokenizer_spec="byte")
+    spec = {"kind": "regex", "pattern": "[ab]{2,6}"}
+    got = []
+    threads = [threading.Thread(
+        target=lambda: got.append(engine._compile_guided(spec)))
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert len(got) == 4
+    assert all(g is got[0] for g in got)  # one canonical matcher
+    assert engine._compile_guided(dict(spec)) is got[0]  # cache hit
