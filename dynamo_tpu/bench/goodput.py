@@ -29,6 +29,7 @@ from typing import Any, List, Optional
 
 from dynamo_tpu.bench.loadgen import (
     GoodputReport,
+    aggregate_migration,
     aggregate_phases,
     compute_goodput,
     compute_scenario_matrix,
@@ -421,6 +422,12 @@ async def run_goodput(args) -> GoodputReport:
                 tree_stats["reused_prefix_tokens"]
                 / tree_stats["prompt_tokens"], 4)
         report.extras["tree"] = tree_stats
+    # migration counters (Migration's phase-spine stamps): how many
+    # requests migrated, how many retries they spent, and what fraction
+    # finished — the robustness headline under worker churn
+    mig = aggregate_migration(results)
+    if mig:
+        report.extras["migration"] = mig
     # per-request latency spine: queue_wait / TTFT / ITL / kv_onboard
     # breakdowns from the phase stamps that rode each final item
     phase_agg = aggregate_phases(results)

@@ -204,6 +204,31 @@ def aggregate_phases(results: List[RequestResult]) -> Dict[str, Any]:
     }
 
 
+def aggregate_migration(results: List[RequestResult]) -> Dict[str, Any]:
+    """Fold the migration counters Migration stamps into the phase spine
+    (migration_attempts / migration_succeeded) into one summary. A request
+    that attempted migration and finished ok is a success; one that
+    attempted and errored exhausted its budget (or hit a non-migratable
+    fault mid-retry). success_rate is over requests that attempted."""
+    attempted = succeeded = attempts = 0
+    for r in results:
+        n = r.phases.get("migration_attempts") if r.phases else None
+        if not n:
+            continue
+        attempted += 1
+        attempts += int(n)
+        if r.ok and r.phases.get("migration_succeeded"):
+            succeeded += 1
+    if attempted == 0:
+        return {}
+    return {
+        "requests_migrated": attempted,
+        "attempts": attempts,
+        "succeeded": succeeded,
+        "success_rate": succeeded / attempted,
+    }
+
+
 def _prompt_tokens(req: TraceRequest, rng: random.Random) -> List[int]:
     """Token-id prompt; prefix groups share leading tokens."""
     if req.prefix_group >= 0:
@@ -235,13 +260,14 @@ async def run_trace_against_engine(
         first = None
         n_out = 0
         phases: Dict[str, Any] = {}
+        ctx = Context()
         try:
             payload = {
                 "token_ids": _prompt_tokens(req, rng),
                 "sampling": {"temperature": 0.0},
                 "stop": {"max_tokens": req.osl, "stop_ids": [], "ignore_eos": True},
             }
-            async for item in generate_fn(payload, Context()):
+            async for item in generate_fn(payload, ctx):
                 n = len(item.get("token_ids") or [])
                 if n and first is None:
                     first = time.monotonic() - start
@@ -255,7 +281,15 @@ async def run_trace_against_engine(
                 osl=n_out, phases=phases,
             )
         except Exception as e:
-            results[i] = RequestResult(ok=False, error=str(e))
+            # a failed request produced no final item, so its phase spine
+            # only exists on the context (e.g. migration_attempts stamped
+            # by Migration before the retry budget ran out) — keep it, the
+            # migration success-rate needs the denominator
+            err_phases = ctx.metadata.get("phases")
+            results[i] = RequestResult(
+                ok=False, error=str(e),
+                phases=dict(err_phases) if isinstance(err_phases, dict) else {},
+            )
 
     await asyncio.gather(*[one(i, r) for i, r in enumerate(trace)])
     return results, time.monotonic() - t0
@@ -472,9 +506,14 @@ async def run_sessions_against_engine(
                     session_id=script.session_id, turn=ti,
                 ))
             except Exception as e:
+                # keep the context-side phase spine (migration counters
+                # stamped before the failure) — see run_trace_against_engine
+                err_phases = ctx.metadata.get("phases")
                 results.append(TurnResult(
                     ok=False, error=str(e), scenario=script.scenario,
                     session_id=script.session_id, turn=ti,
+                    phases=dict(err_phases)
+                    if isinstance(err_phases, dict) else {},
                 ))
                 return  # the session's transcript is broken; stop it
             transcript.extend(reply)

@@ -10,8 +10,10 @@ left off. Bounded by `migration_limit` per request.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
+import zlib
 from typing import Any, AsyncIterator, Dict
 
 from dynamo_tpu.runtime import tracing
@@ -29,9 +31,30 @@ def is_migratable(err: Exception) -> bool:
 
 
 class Migration:
-    def __init__(self, downstream: AsyncEngine, migration_limit: int = 3):
+    def __init__(
+        self,
+        downstream: AsyncEngine,
+        migration_limit: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+    ):
         self.downstream = downstream
         self.migration_limit = migration_limit
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+
+    def _backoff_s(self, rid: str, attempt: int) -> float:
+        """Jittered exponential backoff before a migration retry. The
+        jitter is derived from (rid, attempt) rather than a PRNG so chaos
+        tests replay identically, while distinct requests still decorrelate
+        (a mass disconnect must not re-dispatch as one synchronized wave
+        onto the survivors)."""
+        if self.backoff_base_s <= 0.0:
+            return 0.0
+        cap = min(self.backoff_max_s,
+                  self.backoff_base_s * (2.0 ** max(0, attempt - 1)))
+        r = zlib.crc32(f"{rid}:{attempt}".encode()) / 0xFFFFFFFF
+        return cap * (0.5 + 0.5 * r)
 
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
         retries_left = self.migration_limit
@@ -71,7 +94,10 @@ class Migration:
                                     time.monotonic() - t_dispatch,
                             })
                         if item.get("finish_reason"):
-                            self._finish_phases(item, root, t_dispatch)
+                            self._finish_phases(
+                                item, root, t_dispatch,
+                                attempts=self.migration_limit - retries_left,
+                            )
                         yield item
                     return
                 except RequestPlaneError as e:
@@ -83,6 +109,10 @@ class Migration:
                     attempts = self.migration_limit - retries_left
                     root.set_attribute("migration.attempts", attempts)
                     context.metadata["migration_attempt"] = attempts
+                    # phase spine: ride the shared phases dict so the count
+                    # survives into the final item even when a later hop
+                    # stamps the phases (goodput joins on it)
+                    ph["migration_attempts"] = attempts
                     root.add_event("migration", {"attempt": attempts})
                     request = self._replay_request(request, accumulated)
                     n_replayed = len(accumulated)
@@ -91,9 +121,16 @@ class Migration:
                         "migrating request %s after %s (%d retries left, %d tokens replayed)",
                         context.id, e.code, retries_left, n_replayed,
                     )
+                    delay = self._backoff_s(context.id, attempts)
+                    if delay > 0.0:
+                        # waits out the router's failure-cache window a
+                        # little at a time: by the second attempt the dead
+                        # instance is in cooldown and selection avoids it
+                        await asyncio.sleep(delay)
 
     @staticmethod
-    def _finish_phases(item: Dict[str, Any], root, t_dispatch: float) -> None:
+    def _finish_phases(item: Dict[str, Any], root, t_dispatch: float,
+                       attempts: int = 0) -> None:
         """Fold frontend-side stamps into the final item's phase spine and
         surface every scalar phase as a span event on the root span."""
         phases = item.get("phases")
@@ -101,6 +138,12 @@ class Migration:
             phases = {}
             item["phases"] = phases
         phases["frontend_e2e_s"] = max(0.0, time.monotonic() - t_dispatch)
+        if attempts:
+            # authoritative frontend-side count: a request that migrated
+            # and then finished is a migration SUCCESS (goodput separates
+            # these from attempts to compute the success rate)
+            phases["migration_attempts"] = attempts
+            phases["migration_succeeded"] = 1
         for key, val in phases.items():
             if isinstance(val, (int, float)):
                 root.add_event(f"phase.{key}", {"seconds": float(val)})

@@ -460,6 +460,11 @@ class ModelWatcher:
                     entry.prefill_client = None
             return
         entry.instance_ids.discard(inst.instance_id)
+        if self.affinity is not None:
+            # drop every session pinned to the corpse NOW: a migrating
+            # stream's replay would otherwise keep re-pinning a worker
+            # the router can no longer resolve until the TTL reaper runs
+            self.affinity.invalidate_instance(inst.instance_id)
         for aname in list(entry.adapter_names):
             aentry = self.manager.models.get(aname)
             if aentry is None:

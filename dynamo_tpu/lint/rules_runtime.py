@@ -232,10 +232,75 @@ class MetricLabelCardinality(Rule):
                            "flight recorder")
 
 
+# Migration and indexer-resync paths talk to workers that are, by
+# definition, suspected dead — these are the only call sites where the
+# peer being gone is the EXPECTED case, so an unbounded await there is a
+# guaranteed wedge, and conflating CancelledError (our own shutdown)
+# with transport errors (their death) retries a request the caller
+# already abandoned or logs a worker fault on a clean drain.
+_XWORKER_ATTRS = {"_dump_fn", "dump_fn", "direct", "round_trip",
+                  "request_once"}
+_XWORKER_PATH_RE = re.compile(r"(migration|indexer)")
+
+
+class MigrationAwaitHygiene(Rule):
+    id = "DYN-R006"
+    description = ("cross-worker await in migration/resync path without "
+                   "timeout, or CancelledError conflated with transport "
+                   "errors")
+
+    def _in_scope(self, ctx: LintContext) -> bool:
+        return _XWORKER_PATH_RE.search(ctx.path) is not None
+
+    def check_await(self, ctx: LintContext, node: ast.Await) -> None:
+        if not self._in_scope(ctx) or ctx.timeout_depth > 0:
+            return
+        val = node.value
+        if (isinstance(val, ast.Call)
+                and isinstance(val.func, ast.Attribute)
+                and val.func.attr in _XWORKER_ATTRS):
+            ctx.report(self.id, node,
+                       f"`await ...{val.func.attr}()` targets a worker "
+                       "this path already suspects is dead: without "
+                       "`asyncio.wait_for` the resync/migration slot "
+                       "wedges on the corpse forever")
+
+    def check_except(self, ctx: LintContext,
+                     node: ast.ExceptHandler) -> None:
+        if not self._in_scope(ctx):
+            return
+        t = node.type
+        if t is None:
+            ctx.report(self.id, node,
+                       "bare `except:` in a migration/resync path catches "
+                       "CancelledError along with transport errors — a "
+                       "clean shutdown gets handled as a worker fault; "
+                       "catch the transport types and re-raise "
+                       "CancelledError")
+            return
+        if isinstance(t, ast.Tuple):
+            names = [ctx.resolve(e) or "" for e in t.elts]
+            cancelled = [n for n in names if n.endswith("CancelledError")]
+            if cancelled and len(names) > len(cancelled):
+                ctx.report(self.id, node,
+                           "`except` mixes CancelledError with other "
+                           "exception types: shutdown (ours) and worker "
+                           "death (theirs) need opposite handling — "
+                           "split the handlers")
+            return
+        if (ctx.resolve(t) or "").endswith("BaseException"):
+            ctx.report(self.id, node,
+                       "`except BaseException` in a migration/resync path "
+                       "swallows CancelledError with the transport "
+                       "errors; catch Exception (which excludes it) and "
+                       "handle cancellation separately")
+
+
 RUNTIME_RULES = (
     SharedMutableState,
     ExceptPassSwallow,
     MissingRpcTimeout,
     RecorderBlockingIo,
     MetricLabelCardinality,
+    MigrationAwaitHygiene,
 )

@@ -36,6 +36,10 @@ def parse_args(argv=None):
     p.add_argument("--disk-kv-blocks", type=int, default=0,
                    help="G3 disk KV tier capacity in blocks (needs G2 on)")
     p.add_argument("--disk-kv-root", default=None)
+    p.add_argument("--kv-export-bytes", action="store_true",
+                   help="export tiny real KV arrays instead of hash-only "
+                        "markers, so disk-tier spills write actual files "
+                        "(chaos sims corrupt them to drive quarantine)")
     p.add_argument("--kv-tier-quantize", action="store_true",
                    help="int8 + scales storage in the G2/G3 tiers (mocker "
                         "tiers are hash-only; affects byte accounting)")
@@ -78,17 +82,29 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def build_mock_engine(args) -> tuple[InferenceEngine, ModelCard]:
-    timing = SimTiming(speed=args.speed, decode_base_s=args.decode_base_ms / 1000.0)
+def build_mock_engine(
+    args, timing=None, idle_sleep_s=None
+) -> tuple[InferenceEngine, ModelCard]:
+    """`timing` overrides the flag-derived SimTiming (calibrated fits from
+    flight-recorder dumps); `idle_sleep_s` widens the engine thread's idle
+    poll — a fleet simulator hosting hundreds of engine threads in one
+    process cannot afford 500 threads waking every 2 ms."""
+    if timing is None:
+        timing = SimTiming(speed=args.speed, decode_base_s=args.decode_base_ms / 1000.0)
     runner = SimRunner(
         num_pages=args.num_pages,
         page_size=args.page_size,
         max_pages_per_seq=-(-args.max_seq_len // args.page_size),
         timing=timing,
         spec_accept_rate=getattr(args, "spec_accept_rate", None),
+        kv_export_bytes=getattr(args, "kv_export_bytes", False),
     )
+    engine_kw = {}
+    if idle_sleep_s is not None:
+        engine_kw["idle_sleep_s"] = idle_sleep_s
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
+        **engine_kw,
         decode_steps=args.decode_steps,
         spec_ngram=getattr(args, "spec_ngram", False),
         spec_k=getattr(args, "spec_k", 4),

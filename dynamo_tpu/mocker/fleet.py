@@ -1,0 +1,600 @@
+"""Calibrated, chaos-instrumented fleet simulator (the digital twin).
+
+One process hosts hundreds of mocker workers — real scheduler, real page
+pool, real KV events, fake accelerator — on the in-proc request plane
+(`runtime/request_plane.py` `inproc://`), behind the real frontend stack
+(ModelWatcher → Migration → router). `SimTiming` can be calibrated from
+flight-recorder dumps (`SimTiming.fit_records`), traffic comes from the
+scenario matrix (bench/loadgen.py: agentic/rag/json/burst), and a
+`FaultSchedule` injects the failures production will eventually serve up:
+
+  kill         SIGKILL a worker mid-stream (endpoint aborted, digests
+               silenced, discovery unregistered — clients see the
+               migratable `disconnected`, the indexer sees the delete)
+  restart      bring a fresh worker up in a killed worker's slot
+  partition    request-plane connect/send/recv raise ConnectionResetError
+               for a window (per worker or fleet-wide)
+  delay        request-plane edges sleep `param` seconds for a window
+  corrupt_kv   garble on-disk KV tier blocks (disk_pool quarantine path)
+  digest_drop  the worker's fleet digests are silently dropped
+  digest_dup   every digest is published twice (observer seq dedup path)
+
+Schedule grammar (`FaultSchedule.parse`): events joined by `;`, each
+
+  kind@START[+DURATION][:wIDX|w*][=PARAM]
+
+  kill@10:w3                 kill worker 3 at t=10s (trace clock)
+  partition@20+5:w1          cut worker 1's request plane for 5s
+  delay@30+10:w*=0.05        50ms added to every plane edge for 10s
+  corrupt_kv@40:w2=4         garble 4 disk-tier blocks of worker 2
+  digest_drop@50+20:w4       worker 4 goes digest-silent for 20s
+  restart@60:w3              new worker in slot 3
+
+`FleetSim.run()` reports router p50 decision time, migration success
+rate, SLO attainment (goodput + SLO-engine state), and fault counts.
+The whole run is seeded: same seed + same schedule → same token streams,
+same winners, same report shape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.bench.loadgen import (
+    aggregate_migration,
+    aggregate_phases,
+    compute_goodput,
+    compute_scenario_matrix,
+    generate_scenarios,
+    run_sessions_against_engine,
+)
+from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+from dynamo_tpu.mocker.__main__ import build_mock_engine
+from dynamo_tpu.mocker.__main__ import parse_args as mocker_args
+from dynamo_tpu.runtime import request_plane as rp
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.event_plane import FLEET_DIGEST_SUBJECT
+
+log = logging.getLogger("dynamo_tpu.fleet_sim")
+
+_EVENT_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<at>[0-9.]+)"
+    r"(?:\+(?P<dur>[0-9.]+))?"
+    r"(?::w(?P<worker>\d+|\*))?"
+    r"(?:=(?P<param>[0-9.]+))?$"
+)
+
+FAULT_KINDS = ("kill", "restart", "partition", "delay", "corrupt_kv",
+               "digest_drop", "digest_dup")
+
+
+@dataclass
+class FaultEvent:
+    kind: str
+    at_s: float  # trace-clock offset into the run
+    duration_s: float = 0.0  # windowed faults; 0 = instantaneous
+    worker: Optional[int] = None  # worker slot index; None = fleet-wide
+    param: float = 0.0  # kind-specific (delay seconds, corrupt count)
+
+    def to_text(self) -> str:
+        s = f"{self.kind}@{self.at_s:g}"
+        if self.duration_s:
+            s += f"+{self.duration_s:g}"
+        s += ":w*" if self.worker is None else f":w{self.worker}"
+        if self.param:
+            s += f"={self.param:g}"
+        return s
+
+
+class FaultSchedule:
+    def __init__(self, events: List[FaultEvent]):
+        self.events = sorted(events, key=lambda e: e.at_s)
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def to_text(self) -> str:
+        return ";".join(e.to_text() for e in self.events)
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSchedule":
+        events = []
+        for raw in text.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _EVENT_RE.match(raw)
+            if m is None:
+                raise ValueError(f"bad fault event {raw!r} "
+                                 "(kind@start[+dur][:wIDX|w*][=param])")
+            kind = m.group("kind")
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (have {FAULT_KINDS})")
+            w = m.group("worker")
+            events.append(FaultEvent(
+                kind=kind,
+                at_s=float(m.group("at")),
+                duration_s=float(m.group("dur") or 0.0),
+                worker=None if w in (None, "*") else int(w),
+                param=float(m.group("param") or 0.0),
+            ))
+        return cls(events)
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        n_workers: int,
+        duration_s: float,
+        kills_per_min: float = 1.0,
+        restart_after_s: float = 20.0,
+        partitions_per_min: float = 0.5,
+        partition_s: float = 5.0,
+        digest_faults_per_min: float = 0.5,
+        digest_fault_s: float = 15.0,
+    ) -> "FaultSchedule":
+        """The worker-death day: Poisson kill arrivals, each followed by a
+        restart into the same slot, plus partition and digest-loss
+        windows. Deterministic per seed."""
+        rng = random.Random(seed)
+        events: List[FaultEvent] = []
+
+        def arrivals(rate_per_min: float):
+            t = 0.0
+            while rate_per_min > 0:
+                t += rng.expovariate(rate_per_min / 60.0)
+                if t >= duration_s:
+                    return
+                yield t
+
+        for t in arrivals(kills_per_min):
+            w = rng.randrange(n_workers)
+            events.append(FaultEvent("kill", t, worker=w))
+            if t + restart_after_s < duration_s:
+                events.append(
+                    FaultEvent("restart", t + restart_after_s, worker=w))
+        for t in arrivals(partitions_per_min):
+            events.append(FaultEvent(
+                "partition", t, duration_s=partition_s,
+                worker=rng.randrange(n_workers)))
+        for t in arrivals(digest_faults_per_min):
+            kind = rng.choice(("digest_drop", "digest_dup"))
+            events.append(FaultEvent(
+                kind, t, duration_s=digest_fault_s,
+                worker=rng.randrange(n_workers)))
+        return cls(events)
+
+
+@dataclass
+class SimWorker:
+    idx: int
+    runtime: DistributedRuntime
+    served: Any  # ServedWorker
+    engine: Any
+    alive: bool = True
+    disk_root: Optional[str] = None
+    digest_state: Dict[str, float] = field(default_factory=dict)
+
+
+class _FaultyDigestPublisher:
+    """EventPublisher proxy in front of a worker's digest publishes:
+    drops or duplicates FLEET_DIGEST_SUBJECT payloads per the fault
+    windows in `state` ({"drop_until": t, "dup_until": t}, loop clock).
+    Everything else passes through untouched."""
+
+    def __init__(self, pub, state: Dict[str, float]):
+        self._pub = pub
+        self._state = state
+
+    @property
+    def address(self) -> str:
+        return self._pub.address
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        if subject == FLEET_DIGEST_SUBJECT:
+            now = asyncio.get_event_loop().time()
+            if now < self._state.get("drop_until", 0.0):
+                return
+            await self._pub.publish(subject, payload)
+            if now < self._state.get("dup_until", 0.0):
+                await self._pub.publish(subject, payload)
+            return
+        await self._pub.publish(subject, payload)
+
+
+class FleetSim:
+    """N mocker workers + real frontend stack in one process, with the
+    fault-injection plane wired through the in-proc transport."""
+
+    def __init__(
+        self,
+        n_workers: int,
+        router_mode: str = "kv",
+        seed: int = 0,
+        speed: float = 0.02,  # SimTiming scale (0 = no sleeps)
+        decode_base_ms: float = 4.0,
+        idle_sleep_s: float = 0.05,  # engine-thread idle poll (see below)
+        num_pages: int = 128,
+        page_size: int = 16,
+        max_batch: int = 16,
+        timing=None,  # calibrated SimTiming override (fit_records)
+        digest_period_s: float = 1.0,
+        digest_window_s: float = 5.0,
+        slo: str = "ttft:p99<2.0,itl:p50<0.05",
+        migration_limit: int = 3,
+        migration_backoff_base_s: float = 0.02,
+        sick_cooldown_s: float = 2.0,
+        session_affinity_ttl: Optional[float] = None,
+        host_kv_blocks: int = 0,  # G2 tier; auto-enabled by disk_kv_blocks
+        disk_kv_blocks: int = 0,
+        disk_kv_base: Optional[str] = None,  # per-worker roots under here
+    ):
+        self.n_workers = n_workers
+        self.router_mode = router_mode
+        self.seed = seed
+        self.speed = speed
+        self.decode_base_ms = decode_base_ms
+        # hundreds of engine step threads in one process: a 2ms idle poll
+        # x 500 threads is 250k wakeups/s of pure GIL churn — widen it
+        self.idle_sleep_s = idle_sleep_s
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_batch = max_batch
+        self.timing = timing
+        self.digest_period_s = digest_period_s
+        self.digest_window_s = digest_window_s
+        self.slo = slo
+        self.migration_limit = migration_limit
+        self.migration_backoff_base_s = migration_backoff_base_s
+        self.sick_cooldown_s = sick_cooldown_s
+        self.session_affinity_ttl = session_affinity_ttl
+        # the disk tier spills from the host tier: G3 implies G2
+        if disk_kv_blocks > 0 and host_kv_blocks <= 0:
+            host_kv_blocks = max(8, disk_kv_blocks // 2)
+        self.host_kv_blocks = host_kv_blocks
+        self.disk_kv_blocks = disk_kv_blocks
+        self.disk_kv_base = disk_kv_base
+
+        self.realm = f"fleet-{seed}-{os.getpid()}-{id(self):x}"
+        self.workers: List[SimWorker] = []
+        self.frontend_runtime: Optional[DistributedRuntime] = None
+        self.manager: Optional[ModelManager] = None
+        self.watcher: Optional[ModelWatcher] = None
+        self.observer = None
+        self.slo_engine = None
+        self._digest_watch: Optional[asyncio.Task] = None
+        self._addr_to_idx: Dict[str, int] = {}
+        # fault state consulted by the in-proc fault hook; keys are worker
+        # slot indices or "*" (fleet-wide), values are loop-clock deadlines
+        self._partitions: Dict[Any, float] = {}
+        self._delays: Dict[Any, tuple] = {}  # key -> (until, seconds)
+        self.fault_counts: Dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        rp.set_inproc_fault_hook(self._fault_hook)
+        for i in range(self.n_workers):
+            await self._spawn_worker(i)
+        frt = DistributedRuntime(
+            discovery=MemDiscovery(realm=self.realm),
+            event_transport="inproc", request_plane="inproc",
+        )
+        self.frontend_runtime = frt
+        self.manager = ModelManager()
+        self.watcher = ModelWatcher(
+            frt, self.manager, router_mode=self.router_mode,
+            migration_limit=self.migration_limit,
+            session_affinity_ttl=self.session_affinity_ttl,
+        )
+        await self.watcher.start()
+        await self.watcher.wait_for_model(timeout=30)
+        from dynamo_tpu.frontend.migration import Migration
+        from dynamo_tpu.planner.slo import SloEngine, parse_slo_config
+        from dynamo_tpu.runtime.fleet_observer import FleetObserver
+
+        # the chaos schedule compresses days into seconds — scale the
+        # retry backoff and failure-cache TTL with it
+        for entry in self.manager.models.values():
+            stage = (entry.chain.get("migration")
+                     if hasattr(entry.chain, "get") else None)
+            if isinstance(stage, Migration):
+                stage.backoff_base_s = self.migration_backoff_base_s
+            client = getattr(entry, "client", None)
+            router = getattr(client, "router", None)
+            if router is not None:
+                router.sick_cooldown_s = self.sick_cooldown_s
+        self.observer = FleetObserver(
+            frt.event_subscriber([FLEET_DIGEST_SUBJECT]),
+            window_s=self.digest_window_s,
+        )
+        await self.observer.start()
+        self.slo_engine = SloEngine(self.observer, parse_slo_config(self.slo))
+
+        async def _watch_digests():
+            try:
+                async for ev in frt.discovery.watch("services/"):
+                    addr = (ev.instance.metadata or {}).get("digest_publisher")
+                    if ev.kind == "put" and addr:
+                        self.observer.connect_publisher(addr)
+            except asyncio.CancelledError:
+                pass
+
+        self._digest_watch = asyncio.get_running_loop().create_task(
+            _watch_digests())
+
+    async def _spawn_worker(self, idx: int) -> SimWorker:
+        from dynamo_tpu.worker_common import serve_worker
+
+        rt = DistributedRuntime(
+            discovery=MemDiscovery(realm=self.realm),
+            event_transport="inproc", request_plane="inproc",
+        )
+        flags = [
+            "--speed", str(self.speed),
+            "--decode-base-ms", str(self.decode_base_ms),
+            "--page-size", str(self.page_size),
+            "--num-pages", str(self.num_pages),
+            "--max-batch", str(self.max_batch),
+        ]
+        if self.host_kv_blocks > 0:
+            flags += ["--host-kv-blocks", str(self.host_kv_blocks)]
+        disk_root = None
+        if self.disk_kv_blocks > 0:
+            base = self.disk_kv_base or "/tmp/fleet_sim_kv"
+            disk_root = os.path.join(base, self.realm, f"w{idx}")
+            os.makedirs(disk_root, exist_ok=True)
+            # real (tiny) KV bytes so the disk tier writes actual files —
+            # corrupt_kv garbles them and the quarantine path runs for real
+            flags += ["--disk-kv-blocks", str(self.disk_kv_blocks),
+                      "--disk-kv-root", disk_root, "--kv-export-bytes"]
+        margs = mocker_args(flags)
+        engine, card = build_mock_engine(
+            margs, timing=self.timing, idle_sleep_s=self.idle_sleep_s)
+        digest_state: Dict[str, float] = {}
+        served = await serve_worker(
+            rt, engine, card, digest_period_s=self.digest_period_s)
+        if served.digest_pub is not None:
+            served.digest_pub.pub = _FaultyDigestPublisher(
+                served.digest_pub.pub, digest_state)
+        w = SimWorker(idx=idx, runtime=rt, served=served, engine=engine,
+                      disk_root=disk_root, digest_state=digest_state)
+        if idx < len(self.workers):
+            self.workers[idx] = w
+        else:
+            self.workers.append(w)
+        self._addr_to_idx[rt.server.address] = idx
+        return w
+
+    async def stop(self) -> None:
+        if self._digest_watch is not None:
+            self._digest_watch.cancel()
+        if self.observer is not None:
+            await self.observer.stop()
+        if self.watcher is not None:
+            await self.watcher.stop()
+        if self.frontend_runtime is not None:
+            await self.frontend_runtime.shutdown(drain_timeout=1)
+        for w in self.workers:
+            if w.alive:
+                try:
+                    await w.served.stop()
+                    await w.runtime.shutdown(drain_timeout=1)
+                except Exception:
+                    log.debug("worker %d teardown failed", w.idx,
+                              exc_info=True)
+        rp.set_inproc_fault_hook(None)
+
+    # -- fault plane -------------------------------------------------------
+    async def _fault_hook(self, direction: str, address: str) -> None:
+        idx = self._addr_to_idx.get(address)
+        now = asyncio.get_event_loop().time()
+        for key in (idx, "*"):
+            if key is None:
+                continue
+            d = self._delays.get(key)
+            if d is not None and now < d[0]:
+                await asyncio.sleep(d[1])
+            p = self._partitions.get(key)
+            if p is not None and now < p:
+                raise ConnectionResetError(f"partitioned: {address}")
+
+    def _count(self, kind: str) -> None:
+        self.fault_counts[kind] = self.fault_counts.get(kind, 0) + 1
+
+    async def kill_worker(self, idx: int) -> None:
+        """SIGKILL twin: the endpoint vanishes mid-frame (clients see
+        `disconnected`), digests go silent WITHOUT a flush, discovery gets
+        the delete (indexer expiry + router instance removal), and the
+        engine thread is joined. No goodbyes anywhere."""
+        w = self.workers[idx]
+        if not w.alive:
+            return
+        w.alive = False
+        self._count("kill")
+        w.runtime.server.abort()
+        dp = w.served.digest_pub
+        if dp is not None:
+            if dp._task is not None:
+                dp._task.cancel()
+                dp._task = None
+
+            async def _silent() -> None:
+                return None
+
+            dp.publish_once = _silent  # teardown must not flush a corpse
+        if w.runtime._hb_task is not None:
+            w.runtime._hb_task.cancel()
+        for inst in list(w.runtime._served):
+            try:
+                await w.runtime.discovery.unregister(inst)
+            except Exception:
+                log.debug("unregister during kill failed", exc_info=True)
+        w.runtime._served.clear()
+        w.engine.stop()
+
+    async def restart_worker(self, idx: int) -> None:
+        w = self.workers[idx]
+        if w.alive:
+            return
+        self._count("restart")
+        self._addr_to_idx.pop(w.runtime.server.address, None)
+        await self._spawn_worker(idx)
+
+    def partition(self, idx: Optional[int], duration_s: float) -> None:
+        self._count("partition")
+        key = "*" if idx is None else idx
+        self._partitions[key] = (
+            asyncio.get_event_loop().time() + duration_s)
+
+    def delay(self, idx: Optional[int], duration_s: float,
+              delay_s: float) -> None:
+        self._count("delay")
+        key = "*" if idx is None else idx
+        self._delays[key] = (
+            asyncio.get_event_loop().time() + duration_s, delay_s)
+
+    def corrupt_kv(self, idx: int, n_blocks: int = 4) -> int:
+        """Garble on-disk KV tier blocks of worker `idx`. disk_pool's
+        quarantine must treat each as a miss (unlink + recompute), never
+        raise into the onboard path."""
+        w = self.workers[idx]
+        self._count("corrupt_kv")
+        if not w.disk_root or not os.path.isdir(w.disk_root):
+            return 0
+        files = []
+        for dirpath, _, names in os.walk(w.disk_root):
+            files.extend(os.path.join(dirpath, f) for f in names)
+        files.sort()
+        rng = random.Random(self.seed ^ (idx << 8) ^ len(files))
+        rng.shuffle(files)
+        corrupted = 0
+        for path in files[:n_blocks]:
+            try:
+                with open(path, "r+b") as f:
+                    f.truncate(max(1, os.path.getsize(path) // 3))
+                corrupted += 1
+            except OSError:
+                continue
+        return corrupted
+
+    def digest_fault(self, idx: int, kind: str, duration_s: float) -> None:
+        self._count(kind)
+        key = "drop_until" if kind == "digest_drop" else "dup_until"
+        w = self.workers[idx]
+        w.digest_state[key] = asyncio.get_event_loop().time() + duration_s
+
+    async def apply_event(self, ev: FaultEvent, time_scale: float = 1.0,
+                          rng: Optional[random.Random] = None) -> None:
+        idx = ev.worker
+        if idx is None and ev.kind in ("kill", "restart", "corrupt_kv",
+                                       "digest_drop", "digest_dup"):
+            idx = (rng or random.Random(self.seed)).randrange(
+                len(self.workers))
+        dur = ev.duration_s * time_scale
+        if ev.kind == "kill":
+            await self.kill_worker(idx)
+        elif ev.kind == "restart":
+            await self.restart_worker(idx)
+        elif ev.kind == "partition":
+            self.partition(ev.worker, dur)
+        elif ev.kind == "delay":
+            self.delay(ev.worker, dur, ev.param)
+        elif ev.kind == "corrupt_kv":
+            self.corrupt_kv(idx, int(ev.param) or 4)
+        elif ev.kind in ("digest_drop", "digest_dup"):
+            self.digest_fault(idx, ev.kind, dur)
+
+    async def _fault_pump(self, schedule: FaultSchedule, t0: float,
+                          time_scale: float) -> None:
+        rng = random.Random(self.seed ^ 0x5EED)
+        loop = asyncio.get_event_loop()
+        try:
+            for ev in schedule.events:
+                delay = ev.at_s * time_scale - (loop.time() - t0)
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                await self.apply_event(ev, time_scale, rng)
+        except asyncio.CancelledError:
+            pass
+
+    # -- views -------------------------------------------------------------
+    def alive_workers(self) -> int:
+        return sum(1 for w in self.workers if w.alive)
+
+    def active_streams(self) -> int:
+        """In-flight server-side requests across live workers — must be 0
+        after a drained run (the zero-hung-streams assertion)."""
+        return sum(len(w.runtime.server._active)
+                   for w in self.workers if w.alive)
+
+    @property
+    def entry(self):
+        return self.manager.get("mock-model")
+
+    # -- the experiment ----------------------------------------------------
+    async def run(
+        self,
+        scenarios=("agentic", "rag", "json", "burst"),
+        n_sessions: int = 8,
+        rps: float = 4.0,
+        time_scale: float = 1.0,
+        fault_schedule: Optional[FaultSchedule] = None,
+        ttft_slo_s: float = 2.0,
+        itl_slo_s: float = 0.05,
+    ) -> Dict[str, Any]:
+        """Drive the scenario matrix through the frontend chain while the
+        fault pump walks the schedule; returns the twin's report."""
+        scripts = generate_scenarios(
+            list(scenarios), n_sessions, rps=rps, seed=self.seed)
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        pump = None
+        if fault_schedule is not None and len(fault_schedule):
+            pump = loop.create_task(
+                self._fault_pump(fault_schedule, t0, time_scale))
+        try:
+            results, duration = await run_sessions_against_engine(
+                scripts, self.entry.chain.generate,
+                time_scale=time_scale, seed=self.seed,
+            )
+        finally:
+            if pump is not None:
+                pump.cancel()
+        report = compute_goodput(results, duration, ttft_slo_s, itl_slo_s)
+        phases = aggregate_phases(results)
+        route = phases.get("route_s") or {}
+        mig = aggregate_migration(results)
+        slo_view = self.slo_engine.evaluate() if self.slo_engine else {}
+        out = {
+            "workers": self.n_workers,
+            "workers_alive": self.alive_workers(),
+            "requests": len(results),
+            "duration_s": round(duration, 3),
+            "simulated_duration_s": round(
+                duration / max(time_scale, 1e-9), 1),
+            "rps": round(len(results) / max(duration, 1e-9), 2),
+            "router_p50_decision_us": round(
+                route.get("p50_s", 0.0) * 1e6, 1),
+            "router_p95_decision_us": round(
+                route.get("p95_s", 0.0) * 1e6, 1),
+            "migration": mig,
+            "migration_success_rate": mig.get("success_rate"),
+            "slo_attainment": (report.n_slo_met / report.n_ok
+                               if report.n_ok else 0.0),
+            "slo_state": slo_view.get("state"),
+            "goodput": json.loads(report.to_json()),
+            "scenarios": compute_scenario_matrix(
+                results, duration, ttft_slo_s, itl_slo_s),
+            "faults": dict(self.fault_counts),
+            "active_streams_after": self.active_streams(),
+        }
+        return out

@@ -155,6 +155,110 @@ class SimTiming:
             speed=speed,
         )
 
+    @classmethod
+    def fit_records(cls, records, speed: float = 1.0) -> "SimTiming":
+        """Fit from flight-recorder `IterationRecord`s (runtime/
+        flight_recorder.py dumps, `records` key) — the always-on black box
+        every engine carries, so a production incident dump doubles as
+        mocker calibration input. Accepts dataclasses or dicts.
+
+        Decode iterations fit per-STEP (y = wall_s / decode_steps against
+        x = decode_seqs) so dumps taken at different multi-step settings
+        land on one model; prefill iterations fit y = wall_s against
+        x = charged_tokens (what the dispatch was actually billed).
+        `mixed` iterations are skipped — their wall time blends both
+        regimes and would bias both fits."""
+
+        def get(m, k, default=None):
+            v = getattr(m, k, None) if not isinstance(m, dict) else m.get(k)
+            return default if v is None else v
+
+        from dynamo_tpu.planner.hw_profile import fit_line
+
+        dec, pre = [], []
+        for r in records:
+            kind = get(r, "kind")
+            wall = float(get(r, "wall_s", 0.0) or 0.0)
+            if wall <= 0.0:
+                continue
+            if kind == "decode":
+                steps = max(1, int(get(r, "decode_steps", 1) or 1))
+                dec.append((int(get(r, "decode_seqs", 0) or 0),
+                            wall / steps))
+            elif kind == "prefill":
+                toks = int(get(r, "charged_tokens", 0) or 0)
+                if toks <= 0:
+                    toks = sum(get(r, "chunk_tokens", []) or [])
+                if toks > 0:
+                    pre.append((toks, wall))
+        base = cls()
+        d_int, d_slope = fit_line(dec, base.decode_base_s,
+                                  base.decode_per_seq_s)
+        p_int, p_slope = fit_line(pre, base.prefill_base_s,
+                                  base.prefill_per_token_s)
+        return cls(
+            prefill_base_s=p_int,
+            prefill_per_token_s=p_slope,
+            decode_base_s=d_int,
+            decode_per_seq_s=d_slope,
+            dispatch_overhead_s=0.0,  # folded into the decode intercept
+            speed=speed,
+        )
+
+    def calibration_error(self, records) -> dict:
+        """How well THIS timing model reproduces a set of
+        `IterationRecord`s: per-kind MAPE plus the headline itl_p50_err —
+        relative error between the median observed per-step decode time
+        and the model's prediction at the median decode batch (the bound
+        ISSUE/docs track: ≤ 15% means the twin's ITL distribution is
+        trustworthy)."""
+
+        def get(m, k, default=None):
+            v = getattr(m, k, None) if not isinstance(m, dict) else m.get(k)
+            return default if v is None else v
+
+        dec_obs, dec_pred, pre_obs, pre_pred = [], [], [], []
+        for r in records:
+            kind = get(r, "kind")
+            wall = float(get(r, "wall_s", 0.0) or 0.0)
+            if wall <= 0.0:
+                continue
+            if kind == "decode":
+                steps = max(1, int(get(r, "decode_steps", 1) or 1))
+                n = int(get(r, "decode_seqs", 0) or 0)
+                dec_obs.append(wall / steps)
+                dec_pred.append(self.decode_base_s
+                                + n * self.decode_per_seq_s)
+            elif kind == "prefill":
+                toks = int(get(r, "charged_tokens", 0) or 0)
+                if toks <= 0:
+                    toks = sum(get(r, "chunk_tokens", []) or [])
+                if toks <= 0:
+                    continue
+                pre_obs.append(wall)
+                pre_pred.append(self.prefill_base_s
+                                + toks * self.prefill_per_token_s)
+
+        def mape(obs, pred):
+            pairs = [(o, p) for o, p in zip(obs, pred) if o > 0]
+            if not pairs:
+                return None
+            return sum(abs(p - o) / o for o, p in pairs) / len(pairs)
+
+        itl_err = None
+        if dec_obs:
+            obs_p50 = float(np.median(dec_obs))
+            pred_p50 = float(np.median(dec_pred))
+            if obs_p50 > 0:
+                itl_err = abs(pred_p50 - obs_p50) / obs_p50
+        return {
+            "n_decode": len(dec_obs),
+            "n_prefill": len(pre_obs),
+            "decode_mape": mape(dec_obs, dec_pred),
+            "prefill_mape": mape(pre_obs, pre_pred),
+            "itl_p50_err": itl_err,
+        }
+
 
 def _sat_bucket(buckets, n: int) -> int:
     """Smallest bucket >= n, saturating at the largest (the mocker never
@@ -187,12 +291,18 @@ class SimRunner:
         timing: Optional[SimTiming] = None,
         vocab_size: int = 50000,
         spec_accept_rate: Optional[float] = None,
+        kv_export_bytes: bool = False,
     ):
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
         self.timing = timing or SimTiming()
         self.vocab_size = vocab_size
+        # when set, export_pages emits tiny REAL KV arrays instead of the
+        # hash-only marker, so G2/G3 offload writes actual files and the
+        # disk tier's read/decode/quarantine machinery runs for real in
+        # chaos sims (hash-only blocks never touch the filesystem)
+        self.kv_export_bytes = kv_export_bytes
         # oracle drafting knob for spec-decode A/Bs: when set, spec_draft
         # proposes the TRUE sim stream corrupted per-token with
         # probability (1 - rate), so benches sweep acceptance without
@@ -416,7 +526,19 @@ class SimRunner:
 
     # -- disagg KV transfer (simulated) ------------------------------------
     def export_pages(self, pages: List[int]):
-        return {"data": True, "sim": True, "n_pages": len(pages)}
+        if not self.kv_export_bytes:
+            return {"data": True, "sim": True, "n_pages": len(pages)}
+        from dynamo_tpu.engine.model_runner import kv_arrays_to_payload
+
+        # deterministic per-page planes, [L=1, n, PS, Hk=1, D=4] — small
+        # enough that a 500-worker sim's spills stay cheap, real enough
+        # that encode/decode_block round-trips (and corruption trips the
+        # quarantine) exactly as on a real engine
+        k = np.stack([
+            np.full((1, self.page_size, 1, 4), float(p), dtype=np.float32)
+            for p in pages
+        ], axis=1)
+        return kv_arrays_to_payload(k, k + 0.5)
 
     def import_pages(self, target_pages, offset: int, payload,
                      layer_groups: int = 1) -> None:

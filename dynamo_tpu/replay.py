@@ -47,11 +47,34 @@ def parse_args(argv=None):
     p.add_argument("--decode-base-ms", type=float, default=4.0)
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--calibrate-records", default=None, metavar="DUMP_JSON",
+                   help="flight-recorder dump (engine black box / anomaly "
+                        "dump): fit SimTiming from its IterationRecords, "
+                        "run the replay with the fitted model, and report "
+                        "the fit error bounds in the output")
     return p.parse_args(argv)
+
+
+def load_calibration(path: str, speed: float = 1.0):
+    """Fit SimTiming from a flight-recorder dump file and report the fit's
+    error against the very records it was fitted on (an upper bound on
+    twin fidelity: if the model cannot reproduce its own training data
+    within tolerance, no downstream number can be trusted)."""
+    from dynamo_tpu.mocker.sim import SimTiming
+
+    with open(path) as f:
+        dump = json.load(f)
+    records = dump.get("records", dump) if isinstance(dump, dict) else dump
+    timing = SimTiming.fit_records(records, speed=speed)
+    return timing, timing.calibration_error(records)
 
 
 async def run_replay(args) -> dict:
     realm = f"replay-{args.seed}"
+    timing, calibration = None, None
+    if args.calibrate_records:
+        timing, calibration = load_calibration(
+            args.calibrate_records, speed=args.speed)
     workers = []
     for _ in range(args.workers):
         rt = DistributedRuntime(discovery=MemDiscovery(realm=realm), event_transport="inproc")
@@ -60,7 +83,7 @@ async def run_replay(args) -> dict:
             "--decode-base-ms", str(args.decode_base_ms),
             "--page-size", str(args.page_size),
         ])
-        engine, card = build_mock_engine(margs)
+        engine, card = build_mock_engine(margs, timing=timing)
         w = await serve_worker(rt, engine, card)
         workers.append((rt, w))
 
@@ -84,7 +107,10 @@ async def run_replay(args) -> dict:
             trace, entry.chain.generate, seed=args.seed
         )
         report = compute_goodput(results, duration, args.ttft_slo, args.itl_slo)
-        return json.loads(report.to_json())
+        out = json.loads(report.to_json())
+        if calibration is not None:
+            out["calibration"] = calibration
+        return out
     finally:
         await watcher.stop()
         await frt.shutdown()

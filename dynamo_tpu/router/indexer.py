@@ -67,6 +67,20 @@ class KvIndexer:
         self.host_index.remove_worker(worker)
         self._last_event_id.pop(worker, None)
 
+    def remove_instance(self, instance_id: int, dp_size: int = 1) -> None:
+        """Expire EVERY rank of a departed instance. Discovery deletes
+        arrive per-instance, but the index is keyed per (instance, dp_rank)
+        — dropping only rank 0 leaves the other ranks' blocks crediting
+        overlap on a corpse, so the selector keeps routing prefix hits at
+        a worker that can no longer serve them. Ranks beyond the metadata
+        dp_size can exist too (a resize shrank dp, or events raced the
+        metadata update), so sweep the event-id map for stragglers."""
+        ranks = set(range(max(1, int(dp_size))))
+        ranks.update(r for (iid, r) in list(self._last_event_id)
+                     if iid == instance_id)
+        for r in ranks:
+            self.remove_worker((instance_id, r))
+
     async def _consume(self) -> None:
         try:
             async for subject, payload in self._sub.events():
@@ -100,12 +114,24 @@ class KvIndexer:
         self._resyncing.add(worker)
         spawn_tracked(self._resync(worker), logger=log)
 
+    # a worker that cannot produce its dump within this window is treated
+    # as failed — an unbounded await here wedges the resync slot forever
+    # (the worker may be the very corpse whose death triggered the resync)
+    DUMP_TIMEOUT_S = 10.0
+
     async def resync_worker(self, worker: Worker) -> None:
         """Full-state seed/resync from the worker's dump endpoint."""
         if self._dump_fn is None:
             return
         try:
-            dump = await self._dump_fn(worker[0])
+            dump = await asyncio.wait_for(
+                self._dump_fn(worker[0]), timeout=self.DUMP_TIMEOUT_S
+            )
+        except asyncio.CancelledError:
+            raise  # shutdown, not a worker fault — don't swallow
+        except asyncio.TimeoutError:
+            log.warning("kv dump from worker %s timed out", worker)
+            return
         except Exception as e:
             log.warning("kv dump from worker %s failed: %s", worker, e)
             return

@@ -302,8 +302,21 @@ class KvRouter:
                 self.admission.notify(self.admission.depth)
         elif kind == "delete":
             self._known_workers.discard(inst.instance_id)
-            self.indexer.remove_worker(worker)
-            self.sequences.remove_worker(worker)
+            meta = inst.metadata or {}
+            # expire EVERY dp rank's blocks right now — waiting for a
+            # resync leaves the selector crediting prefix overlap on a
+            # corpse (the dead worker keeps winning routing until its
+            # stale index entries age out)
+            dp = int(meta.get("dp_size", 1))
+            self.indexer.remove_instance(inst.instance_id, dp)
+            kv_addr = meta.get("kv_publisher")
+            if kv_addr:
+                try:
+                    self.indexer.disconnect_publisher(kv_addr)
+                except Exception:
+                    log.debug("disconnect %s failed", kv_addr, exc_info=True)
+            for r in range(dp):
+                self.sequences.remove_worker((inst.instance_id, r))
             if not self.workers():
                 # nothing left to route to: reject waiters loudly instead
                 # of letting them ripen into queue timeouts
@@ -682,7 +695,9 @@ class KvPushRouter:
                     first = False
                 yield item
         except RequestPlaneError as e:
-            if e.code in ("cannot_connect", "disconnected"):
+            from dynamo_tpu.runtime.request_plane import PushRouter
+
+            if e.code in PushRouter.SICK_CODES:
                 # direct() bypasses PushRouter.generate's sick-marking —
                 # record the corpse here so the migration retry's
                 # find_best_match avoids it

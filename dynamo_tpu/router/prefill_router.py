@@ -238,8 +238,10 @@ class PrefillRouter:
             log.warning("prefill hop returned no kv_transfer; falling back")
             return None
         except RequestPlaneError as e:
+            from dynamo_tpu.runtime.request_plane import PushRouter
+
             if (kv is not None and iid is not None
-                    and e.code in ("cannot_connect", "disconnected")):
+                    and e.code in PushRouter.SICK_CODES):
                 # cool the dead prefill replica so the next hop's cost
                 # selection avoids it (same contract as the decode side)
                 try:

@@ -43,7 +43,7 @@ class DistributedRuntime:
         discovery_backend: Optional[str] = None,
         event_transport: Optional[str] = None,
         host: Optional[str] = None,
-        request_plane: Optional[str] = None,  # "tcp" (default) | "nats"
+        request_plane: Optional[str] = None,  # "tcp" (default) | "nats" | "inproc"
         **discovery_kw,
     ):
         self.discovery = discovery or make_discovery(discovery_backend, **discovery_kw)
@@ -60,12 +60,18 @@ class DistributedRuntime:
             from dynamo_tpu.runtime.request_plane import NatsPushEndpoint
 
             self.server = NatsPushEndpoint()
+        elif self.request_plane == "inproc":
+            # one-process fleets (fleet simulator): registry-keyed
+            # endpoint, no listener socket — see request_plane.py
+            from dynamo_tpu.runtime.request_plane import InprocPushEndpoint
+
+            self.server = InprocPushEndpoint()
         elif self.request_plane == "tcp":
             self.server = PushEndpoint(host=self.host)
         else:
             raise ValueError(
                 f"unknown request plane {self.request_plane!r} "
-                "(expected tcp or nats)"
+                "(expected tcp, nats, or inproc)"
             )
         self._server_started = False
         self._served: List[Instance] = []

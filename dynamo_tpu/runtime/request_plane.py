@@ -402,6 +402,29 @@ class _ConnPool:
 
     async def _dial(self, address: str) -> _MuxConn:
         gen = self._gen.get(address, 0) + 1
+        if address.startswith("inproc://"):
+            # one-process fleet fast path: no socket, no listener — the
+            # "dial" is a registry lookup. Fault hooks emulate the network
+            # the sockets would have provided (partition → connect refusal).
+            hook = _INPROC_FAULT_HOOK
+            if hook is not None:
+                try:
+                    await hook("connect", address)
+                except ConnectionResetError as e:
+                    raise RequestPlaneError(
+                        f"cannot connect to {address}: {e}",
+                        code="cannot_connect",
+                    )
+            ep = _INPROC_ENDPOINTS.get(address)
+            if ep is None:
+                raise RequestPlaneError(
+                    f"cannot connect to {address}: endpoint gone",
+                    code="cannot_connect",
+                )
+            self._gen[address] = gen
+            conn = _InprocMuxConn(address, ep, gen=gen)
+            self._conns.setdefault(address, []).append(conn)
+            return conn
         if address.startswith("nats://"):
             # brokered request plane: nats://host:port/rpc.<id> — one
             # broker connection per pooled "conn", same mux surface
@@ -609,9 +632,24 @@ class PushRouter:
     # in-flight count until it publishes again.
     EXT_LOAD_TTL_S = 15.0
 
-    def __init__(self, endpoint_path: str, mode: str = RouterMode.ROUND_ROBIN):
+    # transport failures that put an instance into the failure cache:
+    # unreachable / cut / timed-out / draining replicas are all equally
+    # poor candidates for the migrating request's retry
+    SICK_CODES = ("cannot_connect", "disconnected", "connection_timeout",
+                  "draining")
+
+    def __init__(
+        self,
+        endpoint_path: str,
+        mode: str = RouterMode.ROUND_ROBIN,
+        sick_cooldown_s: Optional[float] = None,
+    ):
         self.endpoint_path = endpoint_path
         self.mode = mode
+        self.sick_cooldown_s = (
+            sick_cooldown_s if sick_cooldown_s is not None
+            else self.SICK_COOLDOWN_S
+        )
         self._pool = _ConnPool()
         self._instances: Dict[int, str] = {}  # instance_id -> address
         self._rr = 0
@@ -643,7 +681,7 @@ class PushRouter:
         import time as _time
 
         self._sick[instance_id] = _time.monotonic() + (
-            cooldown if cooldown is not None else self.SICK_COOLDOWN_S
+            cooldown if cooldown is not None else self.sick_cooldown_s
         )
 
     def sick_instances(self) -> set:
@@ -848,7 +886,7 @@ class PushRouter:
             async for item in engine.generate(request, context):
                 yield item
         except RequestPlaneError as e:
-            if e.code in ("cannot_connect", "disconnected"):
+            if e.code in self.SICK_CODES:
                 # dead/unreachable replica: cool it down so the migration
                 # retry lands on a healthy one instead of this corpse
                 self.mark_sick(iid)
@@ -1059,3 +1097,208 @@ class _NatsMuxConn:
         self.close()
         if self._reader_task is not None:
             self._reader_task.cancel()
+
+
+# ---------------------------------------------------------------------------
+# In-proc request plane — `RequestPlaneMode::Inproc`
+# ---------------------------------------------------------------------------
+# A 500-worker fleet simulator cannot afford 500 TCP listeners plus N x M
+# mux sockets in one process (fd limits, accept-loop wakeups, kernel
+# buffers). The in-proc plane keeps every request-plane semantic — the
+# same frames, the same per-stream bounded queues, the same disconnect /
+# draining / cannot_connect error codes migration classifies on — but the
+# "socket" is a registry lookup and the "wire" is a msgpack round-trip.
+# Fault hooks stand in for the network, so a sim can cut, delay, or
+# partition any worker's plane the way a real network would.
+
+_INPROC_ENDPOINTS: Dict[str, "InprocPushEndpoint"] = {}
+_INPROC_NEXT = [0]
+# async hook(direction: "connect"|"send"|"recv", address) installed by the
+# fleet simulator; may sleep (latency) or raise ConnectionResetError
+# (partition / cut). None in production.
+_INPROC_FAULT_HOOK = None
+
+
+def set_inproc_fault_hook(hook) -> None:
+    """Install (or clear, with None) the fault-injection hook applied to
+    every in-proc plane edge. Sim-only."""
+    global _INPROC_FAULT_HOOK
+    _INPROC_FAULT_HOOK = hook
+
+
+def reset_inproc() -> None:
+    """Test/sim helper: drop every registered in-proc endpoint + hook."""
+    _INPROC_ENDPOINTS.clear()
+    set_inproc_fault_hook(None)
+
+
+def _wire(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """msgpack round-trip: the in-proc plane keeps TCP-plane serialization
+    semantics (tuples become lists, payloads are copies, non-serializable
+    values fail here) so a sim fleet exercises the same wire shapes real
+    sockets would — and a frontend can never share mutable state with a
+    worker by accident."""
+    return msgpack.unpackb(msgpack.packb(obj, use_bin_type=True), raw=False)
+
+
+class InprocPushEndpoint(PushEndpoint):
+    """Request-plane server for one-process fleets: the same
+    `_handle_request` machinery as the TCP plane, addressed by an
+    `inproc://` registry key instead of a socket. `abort()` is the
+    SIGKILL twin — the endpoint vanishes without a goodbye and every
+    attached client conn sees a disconnect, exactly like a cut socket."""
+
+    def __init__(self):
+        super().__init__()
+        _INPROC_NEXT[0] += 1
+        self._address = f"inproc://rp-{_INPROC_NEXT[0]}"
+        self._inproc_conns: set = set()
+
+    @property
+    def address(self) -> str:
+        return self._address
+
+    # registry writes live in sync helpers: they are atomic with respect
+    # to the event loop (no await can interleave), which is the invariant
+    # that makes the lock-free registry safe
+    def _register(self) -> None:
+        _INPROC_ENDPOINTS[self._address] = self
+
+    def _deregister(self) -> None:
+        _INPROC_ENDPOINTS.pop(self._address, None)
+
+    async def start(self) -> str:
+        self._register()
+        return self._address
+
+    async def stop(self, drain_timeout: float = 30.0) -> None:
+        """Graceful: deregister (new dials fail), drain in-flight, kill
+        stragglers, then cut surviving conns."""
+        self._draining = True
+        self._deregister()
+        deadline = asyncio.get_event_loop().time() + drain_timeout
+        while self._active and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        for ctx in list(self._active.values()):
+            ctx.kill()
+        for conn in list(self._inproc_conns):
+            conn.close()
+
+    def abort(self) -> None:
+        """Hard-kill (sim SIGKILL): no drain, no error frames — conns are
+        cut FIRST so in-flight handlers' sends fail like a dead socket,
+        then their contexts are killed. Clients observe `disconnected`,
+        the migratable code a real worker crash produces."""
+        self._draining = True
+        self._deregister()
+        for conn in list(self._inproc_conns):
+            conn.close()
+        for ctx in list(self._active.values()):
+            ctx.kill()
+
+
+class _InprocMuxConn:
+    """Client half of the in-proc plane: the `_MuxConn` surface
+    (open/close_stream, send, closed/gen/n_streams) where "the socket" is
+    a direct `_handle_request` task on the server endpoint. Per-stream
+    queues stay bounded, so backpressure semantics match TCP (a slow
+    consumer stalls its handler's send, not the whole process)."""
+
+    _DISCONNECT = _MuxConn._DISCONNECT
+    STREAM_BUF_FRAMES = _MuxConn.STREAM_BUF_FRAMES
+
+    def __init__(self, address: str, endpoint: InprocPushEndpoint,
+                 gen: int = 0):
+        self.address = address
+        self.gen = gen
+        self.closed = False
+        self._ep = endpoint
+        self._streams: Dict[str, asyncio.Queue] = {}
+        self._ctxs: Dict[str, Context] = {}
+        self._tasks: set = set()
+        endpoint._inproc_conns.add(self)
+
+    @property
+    def n_streams(self) -> int:
+        return len(self._streams)
+
+    def open_stream(self, rid: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.STREAM_BUF_FRAMES)
+        self._streams[rid] = q
+        return q
+
+    def close_stream(self, rid: str) -> None:
+        q = self._streams.pop(rid, None)
+        # drain so a handler blocked on this (now dead) stream's full
+        # queue wakes up instead of wedging (same contract as _MuxConn)
+        while q is not None:
+            try:
+                q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+
+    async def _fault(self, direction: str) -> None:
+        hook = _INPROC_FAULT_HOOK
+        if hook is None:
+            return
+        try:
+            await hook(direction, self.address)
+        except ConnectionResetError:
+            # a partition cuts the whole "socket", not one frame: fan
+            # disconnect to every stream so nothing hangs waiting on a
+            # response that can never arrive
+            self.close()
+            raise
+
+    async def send(self, obj: Dict[str, Any]) -> None:
+        if self.closed:
+            raise ConnectionResetError(
+                f"in-proc conn to {self.address} closed")
+        await self._fault("send")
+        t = obj.get("t")
+        if t == "req":
+            if _INPROC_ENDPOINTS.get(self.address) is not self._ep:
+                # endpoint vanished or restarted under us: dead socket
+                self.close()
+                raise ConnectionResetError(f"{self.address} is gone")
+            frame = _wire(obj)
+            task = asyncio.create_task(
+                self._ep._handle_request(frame, self._respond, self._ctxs)
+            )
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+        elif t == "cancel":
+            ctx = self._ctxs.get(obj.get("id"))
+            if ctx is not None:
+                ctx.stop_generating()
+        elif t == "kill":
+            ctx = self._ctxs.get(obj.get("id"))
+            if ctx is not None:
+                ctx.kill()
+
+    async def _respond(self, obj: Dict[str, Any]) -> None:
+        """Server→client frame delivery (the handler's `send`)."""
+        await self._fault("recv")
+        if self.closed:
+            raise ConnectionResetError(
+                f"in-proc conn to {self.address} closed")
+        q = self._streams.get(obj.get("id"))
+        if q is not None:
+            # frames for unknown ids (stream abandoned client-side) drop,
+            # matching the TCP demux loop
+            await q.put(_wire(obj))
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._ep._inproc_conns.discard(self)
+        for q in self._streams.values():
+            _MuxConn._push_sentinel(q)
+        # the client side of this conn is gone: kill its in-flight server
+        # contexts the way a broken socket's handler teardown would
+        for ctx in list(self._ctxs.values()):
+            ctx.kill()
+
+    def shutdown(self) -> None:
+        self.close()

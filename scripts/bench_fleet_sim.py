@@ -1,0 +1,125 @@
+"""The simulated fleet day: N workers, a scenario-matrix load, a seeded
+worker-death schedule — one process, one JSON line of headline numbers.
+
+The ISSUE-scale run (500+ workers, 100k+ requests, a day of trace time
+compressed into wall minutes):
+
+  JAX_PLATFORMS=cpu python scripts/bench_fleet_sim.py \
+      --workers 500 --sessions 11500 --rps 0.53 --time-scale 0.083 \
+      --sim-day-s 86400 --idle-sleep-s 0.5 --seed 0
+
+Small smoke (seconds):
+
+  JAX_PLATFORMS=cpu python scripts/bench_fleet_sim.py \
+      --workers 8 --sessions 8 --rps 10 --seed 0
+
+Output: one JSON line with workers, requests, rps, router p50/p95
+decision time (µs), migration attempt/success counts and success rate,
+SLO attainment (goodput definition) and the SLO engine's state, fault
+counts, and the calibration block when --calibrate-records is given.
+docs/fleet_sim.md explains each field and the acceptance gates
+(migration success >= 99% under the kill schedule, zero hung streams).
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("bench_fleet_sim")
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--router-mode", default="kv",
+                   choices=["round_robin", "random", "kv"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sessions", type=int, default=8,
+                   help="sessions PER scenario (4 scenarios)")
+    p.add_argument("--scenarios", default="agentic,rag,json,burst")
+    p.add_argument("--rps", type=float, default=10.0,
+                   help="aggregate session-start rate (trace clock)")
+    p.add_argument("--time-scale", type=float, default=1.0,
+                   help="wall seconds per trace second (<1 compresses)")
+    p.add_argument("--sim-day-s", type=float, default=0.0,
+                   help="claimed trace-time span for the fault schedule; "
+                        "0 = use the run's own duration estimate")
+    p.add_argument("--speed", type=float, default=0.002,
+                   help="SimTiming scale (0 = no sleeps)")
+    p.add_argument("--decode-base-ms", type=float, default=4.0)
+    p.add_argument("--idle-sleep-s", type=float, default=0.05)
+    p.add_argument("--num-pages", type=int, default=128)
+    p.add_argument("--max-batch", type=int, default=16)
+    p.add_argument("--fault-schedule", default=None,
+                   help="explicit FaultSchedule text; default = generated "
+                        "worker-death day (seeded)")
+    p.add_argument("--kills-per-min", type=float, default=1.0)
+    p.add_argument("--no-faults", action="store_true")
+    p.add_argument("--ttft-slo", type=float, default=2.0)
+    p.add_argument("--itl-slo", type=float, default=0.05)
+    p.add_argument("--calibrate-records", default=None, metavar="DUMP_JSON",
+                   help="flight-recorder dump: fit SimTiming and attach "
+                        "the fit error bounds to the output")
+    p.add_argument("--session-affinity-ttl", type=float, default=0.0)
+    return p.parse_args(argv)
+
+
+async def run(args) -> dict:
+    from dynamo_tpu.mocker.fleet import FaultSchedule, FleetSim
+
+    timing = calibration = None
+    if args.calibrate_records:
+        from dynamo_tpu.replay import load_calibration
+
+        timing, calibration = load_calibration(
+            args.calibrate_records, speed=args.speed)
+
+    scenarios = [s for s in args.scenarios.split(",") if s]
+    # the fault schedule runs on the trace clock; size it to the span the
+    # traffic will actually cover so kills land DURING the run
+    n_sessions_total = args.sessions * len(scenarios)
+    est_span_s = args.sim_day_s or max(
+        30.0, n_sessions_total / max(args.rps, 1e-9) * 1.5)
+    if args.no_faults:
+        schedule = None
+    elif args.fault_schedule:
+        schedule = FaultSchedule.parse(args.fault_schedule)
+    else:
+        schedule = FaultSchedule.generate(
+            seed=args.seed, n_workers=args.workers,
+            duration_s=est_span_s, kills_per_min=args.kills_per_min)
+
+    sim = FleetSim(
+        n_workers=args.workers, router_mode=args.router_mode,
+        seed=args.seed, speed=args.speed,
+        decode_base_ms=args.decode_base_ms,
+        idle_sleep_s=args.idle_sleep_s, num_pages=args.num_pages,
+        max_batch=args.max_batch, timing=timing,
+        session_affinity_ttl=args.session_affinity_ttl or None,
+    )
+    await sim.start()
+    try:
+        report = await sim.run(
+            scenarios=scenarios, n_sessions=args.sessions, rps=args.rps,
+            time_scale=args.time_scale, fault_schedule=schedule,
+            ttft_slo_s=args.ttft_slo, itl_slo_s=args.itl_slo,
+        )
+    finally:
+        await sim.stop()
+    report["seed"] = args.seed
+    report["fault_schedule_events"] = len(schedule) if schedule else 0
+    if calibration is not None:
+        report["calibration"] = calibration
+    return report
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    report = asyncio.run(run(args))
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@ def main() -> int:
         "test_dynlint.py", "test_flight_recorder.py",
         "test_fleet_observer.py", "test_spec_decode.py",
         "test_kv_tiers.py", "test_session_tree.py", "test_guided.py",
+        "test_fleet_sim.py", "test_chaos.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -184,3 +184,206 @@ async def test_fleet_digests_survive_worker_churn():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 pass
+
+
+# -- seeded chaos at small N: the FleetSim twin ------------------------------
+#
+# The subprocess tests above prove the real-process composition; these
+# prove the same failure classes inside the fleet simulator (in-proc
+# request plane, FaultSchedule), deterministically and fast enough for
+# tier-1. They are the small-N anchors for the 500-worker simulated day
+# (scripts/bench_fleet_sim.py, docs/fleet_sim.md).
+
+
+async def _collect(entry, req, ctx=None):
+    from dynamo_tpu.runtime.context import Context
+
+    toks, final = [], None
+    async for item in entry.chain.generate(dict(req), ctx or Context()):
+        assert item.get("finish_reason") != "error", item
+        toks.extend(item.get("token_ids") or [])
+        if item.get("finish_reason"):
+            final = item
+    return toks, final
+
+
+async def test_fleet_sim_kill_bound_session_worker_migrates_byte_identical():
+    """A session tree is bound to a worker (affinity) and that worker is
+    killed mid-stream: the stream must finish its exact token budget,
+    byte-identical to an unchaosed run (replay carries the emitted
+    prefix), the session must rebind off the corpse, and no stream may
+    be left hanging server-side."""
+    from dynamo_tpu.mocker.fleet import FleetSim
+    from dynamo_tpu.runtime.context import Context
+
+    sim = FleetSim(n_workers=2, router_mode="kv", seed=21, speed=1.0,
+                   decode_base_ms=20.0, idle_sleep_s=0.01,
+                   migration_backoff_base_s=0.01, sick_cooldown_s=0.5,
+                   session_affinity_ttl=30.0)
+    await sim.start()
+    try:
+        entry = sim.entry
+        req = {"token_ids": [40, 41, 42, 43],
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 24, "ignore_eos": True}}
+        # turn 1 binds the session
+        ctx1 = Context()
+        ctx1.metadata["session_id"] = "sess-chaos"
+        expected, _ = await _collect(entry, req, ctx1)
+        assert len(expected) == 24
+        aff = sim.watcher.affinity
+        snap = aff.snapshot()
+        assert snap["bound"] == 1
+        bound_iid = int(next(iter(snap["by_instance"])), 16)
+        bound_idx = next(i for i, w in enumerate(sim.workers)
+                         if any(inst.instance_id == bound_iid
+                                for inst in w.runtime._served))
+
+        # turn 2: kill the bound worker after the first tokens land
+        ctx2 = Context()
+        ctx2.metadata["session_id"] = "sess-chaos"
+        toks, final = [], None
+        killed = False
+        async for item in entry.chain.generate(dict(req), ctx2):
+            assert item.get("finish_reason") != "error", item
+            toks.extend(item.get("token_ids") or [])
+            if toks and not killed:
+                killed = True
+                await sim.kill_worker(bound_idx)
+            if item.get("finish_reason"):
+                final = item
+        assert toks == expected  # byte-identical under migration
+        assert (final["phases"]).get("migration_succeeded") == 1
+        # the corpse holds no sessions and no streams
+        for _ in range(100):
+            snap = aff.snapshot()
+            if f"{bound_iid:x}" not in snap["by_instance"]:
+                break
+            await asyncio.sleep(0.02)
+        assert f"{bound_iid:x}" not in snap["by_instance"], snap
+        assert sim.active_streams() == 0
+    finally:
+        await sim.stop()
+
+
+async def test_fleet_sim_partition_heals_and_traffic_completes():
+    """A request-plane partition window: traffic during the window rides
+    migration/sick-cooldown onto reachable workers, and once the window
+    closes the partitioned worker serves again."""
+    from dynamo_tpu.mocker.fleet import FaultSchedule, FleetSim
+
+    sim = FleetSim(n_workers=2, router_mode="round_robin", seed=13,
+                   speed=0.02, idle_sleep_s=0.01,
+                   migration_backoff_base_s=0.01, sick_cooldown_s=0.3)
+    await sim.start()
+    try:
+        sched = FaultSchedule.parse("partition@0.2+0.4:w0")
+        report = await sim.run(scenarios=("json",), n_sessions=4, rps=10.0,
+                               fault_schedule=sched)
+        g = report["goodput"]
+        assert g["n_ok"] == g["n_requests"]
+        assert report["active_streams_after"] == 0
+        assert report["faults"].get("partition") == 1
+        # after the window, BOTH workers take traffic again
+        entry = sim.entry
+        await asyncio.sleep(0.5)
+        req = {"token_ids": [7, 8, 9],
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 4, "ignore_eos": True}}
+        for _ in range(4):
+            toks, _ = await _collect(entry, req)
+            assert len(toks) == 4
+        assert sim.alive_workers() == 2
+    finally:
+        await sim.stop()
+
+
+async def test_fleet_sim_kv_corruption_quarantines_never_raises():
+    """Corrupt on-disk G3 blocks mid-run: the next onboarding of those
+    blocks must treat them as data misses (unlink + recompute) — never an
+    exception into the dispatch path — and requests keep completing."""
+    import tempfile
+
+    from dynamo_tpu.mocker.fleet import FleetSim
+
+    base = tempfile.mkdtemp(prefix="fleet_kv_chaos_")
+    sim = FleetSim(n_workers=1, router_mode="kv", seed=9, speed=0.0,
+                   idle_sleep_s=0.01, num_pages=16, page_size=16,
+                   host_kv_blocks=8, disk_kv_blocks=64, disk_kv_base=base)
+    await sim.start()
+    try:
+        entry = sim.entry
+        prompts = [list(range(100 * g, 100 * g + 64)) for g in range(6)]
+
+        async def run_prompt(p):
+            req = {"token_ids": p, "sampling": {"temperature": 0.0},
+                   "stop": {"max_tokens": 4, "ignore_eos": True}}
+            toks, _ = await _collect(entry, req)
+            assert len(toks) == 4
+
+        # fill device pages past capacity so blocks demote G1->G2->G3
+        for p in prompts:
+            await run_prompt(p)
+        w = sim.workers[0]
+        disk = w.engine.host_pool.disk
+        disk.flush()
+        assert len(disk) > 0, "nothing ever spilled to the disk tier"
+        n_corrupted = sim.corrupt_kv(0, n_blocks=4)
+        assert n_corrupted > 0
+        assert sim.fault_counts.get("corrupt_kv") == 1
+        # re-run every prompt: any hit on a garbled block must quarantine
+        # (miss + unlink) and recompute, never error the request
+        for p in prompts:
+            await run_prompt(p)
+        assert sim.active_streams() == 0
+    finally:
+        await sim.stop()
+
+
+async def test_fleet_sim_digest_silent_worker_ages_out_without_flapping():
+    """A worker goes digest-silent (drop window) while survivors keep
+    publishing: the observer must age it out of the fleet view, and the
+    SLO engine must hold a steady state while the dead worker's samples
+    drain — abstention via min-samples, not OK<->BREACH flapping. A
+    duplicate window on a survivor must be absorbed by seq dedup."""
+    from dynamo_tpu.mocker.fleet import FleetSim
+
+    sim = FleetSim(n_workers=3, router_mode="round_robin", seed=17,
+                   speed=0.02, idle_sleep_s=0.01,
+                   digest_period_s=0.1, digest_window_s=0.8)
+    await sim.start()
+    try:
+        # light traffic so digests carry real phase samples
+        report = await sim.run(scenarios=("json",), n_sessions=3, rps=10.0)
+        g = report["goodput"]
+        assert g["n_ok"] == g["n_requests"]
+        obs = sim.observer
+        for _ in range(100):
+            if len(obs.workers()) == 3:
+                break
+            await asyncio.sleep(0.05)
+        assert len(obs.workers()) == 3
+
+        sim.digest_fault(1, "digest_drop", 30.0)  # silent for the test
+        sim.digest_fault(0, "digest_dup", 30.0)  # chatty survivor
+        states = []
+        aged_out = False
+        for _ in range(60):
+            states.append(sim.slo_engine.evaluate()["state"])
+            if len(obs.workers()) == 2:
+                aged_out = True
+                break
+            await asyncio.sleep(0.05)
+        assert aged_out, "silent worker never aged out of the fleet view"
+        # no flapping while the silent worker drained: the state never
+        # oscillated (at most one monotonic transition in the window)
+        transitions = sum(1 for a, b in zip(states, states[1:]) if a != b)
+        assert transitions <= 1, states
+        assert "BREACH" not in states, states
+        # duplicated digests were dropped by seq dedup, not double-counted
+        assert obs.dropped_stale > 0
+        before = obs.received
+        await asyncio.sleep(0.3)
+        assert obs.received > before, "survivors stopped publishing"
+    finally:
+        await sim.stop()

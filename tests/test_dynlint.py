@@ -467,6 +467,68 @@ def test_r005_metric_label_cardinality():
     """) == []
 
 
+def test_r006_migration_await_hygiene():
+    # positive: unbounded cross-worker await in an indexer/migration file
+    src = """
+        async def resync(self, worker):
+            return await self._dump_fn(worker)
+    """
+    assert _rules(src, path="dynamo_tpu/router/indexer.py") == ["DYN-R006"]
+    # negative: same await wrapped in wait_for is bounded
+    assert _rules("""
+        import asyncio
+
+        async def resync(self, worker):
+            return await asyncio.wait_for(self._dump_fn(worker), timeout=10)
+    """, path="dynamo_tpu/router/indexer.py") == []
+    # negative: an `async with asyncio.timeout(...)` scope also bounds it
+    assert _rules("""
+        import asyncio
+
+        async def resync(self, worker):
+            async with asyncio.timeout(10):
+                return await self._dump_fn(worker)
+    """, path="dynamo_tpu/router/indexer.py") == []
+    # negative: same code outside migration/resync paths is out of scope
+    assert _rules(src, path="dynamo_tpu/router/kv_router.py") == []
+
+
+def test_r006_cancelled_conflation():
+    # positive: CancelledError lumped in with transport errors
+    assert _rules("""
+        import asyncio
+
+        async def retry(self):
+            try:
+                await self.step()
+            except (asyncio.CancelledError, ConnectionError):
+                self.retries += 1
+    """, path="dynamo_tpu/frontend/migration.py") == ["DYN-R006"]
+    # positive: BaseException and bare except both swallow CancelledError
+    assert _rules("""
+        async def retry(self):
+            try:
+                await self.step()
+            except BaseException:
+                self.retries += 1
+    """, path="dynamo_tpu/frontend/migration.py") == ["DYN-R006"]
+    # negative: the compliant shape — CancelledError re-raised in its own
+    # handler before the transport/other handlers
+    assert _rules("""
+        import asyncio
+
+        async def retry(self):
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except asyncio.TimeoutError:
+                self.timeouts += 1
+            except Exception:
+                self.retries += 1
+    """, path="dynamo_tpu/frontend/migration.py") == []
+
+
 # -- baseline ratchet -------------------------------------------------------
 
 

@@ -225,6 +225,36 @@ def test_worker_death_mid_window_then_ages_out():
     assert obs.ingest(_digest((1, 0), seq=1), now=46.0)
 
 
+def test_lossy_digest_plane_under_churn():
+    """Drops, duplicates, and reordering on the digest plane while the
+    fleet churns (a worker dies, another reboots): the window must count
+    every accepted digest exactly once — drops thin the samples but never
+    corrupt them, duplicates and late arrivals are shed by seq dedup, and
+    a rebooted worker's fresh seq space is accepted after age-out."""
+    obs = FleetObserver(None, window_s=20.0)
+    w1, w2 = (1, 0), (2, 0)
+    # w1's plane drops the even seqs (2, 4) — gaps are fine, windowing is
+    # by receive time, and the odd seqs still land
+    for seq, now in ((1, 0.0), (3, 2.0), (5, 4.0)):
+        assert obs.ingest(_digest(w1, seq=seq, itl=[0.01] * 4), now=now)
+    # w2's plane duplicates every digest and delivers one of them late,
+    # out of order: only the first copy of each seq counts
+    assert obs.ingest(_digest(w2, seq=1, itl=[0.01] * 4), now=1.0)
+    assert obs.ingest(_digest(w2, seq=2, itl=[0.01] * 4), now=3.0)
+    assert not obs.ingest(_digest(w2, seq=2, itl=[0.01] * 4), now=3.1)
+    assert not obs.ingest(_digest(w2, seq=1, itl=[0.01] * 4), now=5.0)
+    assert obs.received == 5 and obs.dropped_stale == 2
+    assert hist_count(obs.phase_hists(now=6.0)["itl"]) == 20  # 5 x 4, once
+    # churn: w1 dies silently; w2 keeps publishing; view stays sane
+    assert obs.ingest(_digest(w2, seq=3, itl=[0.01] * 4), now=10.0)
+    assert obs.workers(now=30.0) == [w2]
+    # w1 reboots past gone_after_s (3x window): once a view sweep has
+    # forgotten its old seq space, a fresh seq=1 is accepted again
+    assert obs.workers(now=70.0) == []  # everyone quiet by now
+    assert obs.ingest(_digest(w1, seq=1, itl=[0.01] * 4), now=70.0)
+    assert w1 in obs.workers(now=71.0)
+
+
 def test_fleet_payload_shape():
     obs = FleetObserver(None, window_s=60.0)
     obs.ingest(_digest((0xab, 1), seq=1, itl=[0.01] * 10,
@@ -376,6 +406,41 @@ def test_slo_ok_warn_breach_recovery_cycle():
     assert v["fleet"]["itl_p50"]["fast"]["burn"] < 1.0
     v = slo.evaluate(now=300.0)
     assert v["state"] == OK
+
+
+def test_slo_abstains_while_silent_worker_drains_no_flapping():
+    """A worker goes digest-silent mid-run: as its samples age out of the
+    windows the engine passes through a thin-sample regime where a naive
+    percentile would whipsaw. min_samples must make it ABSTAIN (hold OK)
+    through the drain — the state sequence may transition at most once
+    and must never visit BREACH on the way out."""
+    obs = FleetObserver(None, window_s=120.0)
+    slo = SloEngine(obs, _policy())
+    # healthy fleet: two workers, plenty of samples
+    obs.ingest(_digest((1, 0), seq=1, itl=GOOD), now=0.0)
+    obs.ingest(_digest((2, 0), seq=1, itl=GOOD), now=0.0)
+    assert slo.evaluate(now=5.0)["state"] == OK
+    # worker 1 goes silent at t=5 with a final thin, ugly digest (7 bad
+    # samples — under min_samples on its own); worker 2 keeps publishing
+    obs.ingest(_digest((1, 0), seq=2, itl=[1.0] * 7), now=5.0)
+    states = []
+    t = 6.0
+    for i in range(30):
+        obs.ingest(_digest((2, 0), seq=2 + i, itl=GOOD), now=t)
+        states.append(slo.evaluate(now=t + 0.5)["state"])
+        t += 10.0
+    # the fleet hists still clear min_samples (w2's good traffic), and
+    # once w1's bad tail leaves the windows only good samples remain: the
+    # state must hold OK the whole way — no OK<->BREACH flapping
+    transitions = sum(1 for a, b in zip(states, states[1:]) if a != b)
+    assert transitions <= 1, states
+    assert BREACH not in states, states
+    assert states[-1] == OK
+    # and per-worker: the silent worker's OWN thin sample set abstains
+    # (its 7 bad samples never cross min_samples)
+    v = slo.evaluate(now=20.0)
+    if "1.0" in v["workers"]:
+        assert v["workers"]["1.0"]["states"]["itl_p50"] == OK
 
 
 def test_slo_fleet_state_is_worst_target():

@@ -1,0 +1,331 @@
+"""Fleet simulator: in-proc request plane, fault schedule, calibration.
+
+The twin's claim is that one process can stand in for a fleet: hundreds
+of real scheduler/page-pool/router stacks on an in-memory transport that
+keeps TCP's failure semantics (mid-stream aborts surface as the
+migratable `disconnected`, partitions as ConnectionResetError), driven
+by a seeded FaultSchedule. These tests pin the pieces at small N so the
+500-worker day (scripts/bench_fleet_sim.py, docs/fleet_sim.md) rests on
+asserted behavior rather than hope.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.mocker.fleet import FaultSchedule, FleetSim
+from dynamo_tpu.runtime import request_plane as rp
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.discovery import MemDiscovery
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+pytestmark = pytest.mark.asyncio
+
+
+# -- in-proc request plane ---------------------------------------------------
+
+
+class _Echo:
+    async def generate(self, request, context):
+        for t in request.get("token_ids", []):
+            yield {"token_ids": [t]}
+
+
+class _Slow:
+    async def generate(self, request, context):
+        for i in range(1000):
+            if context.is_stopped:
+                return
+            yield {"i": i}
+            await asyncio.sleep(0.01)
+
+
+def _rt(realm):
+    return DistributedRuntime(
+        discovery=MemDiscovery(realm=realm), event_transport="inproc",
+        request_plane="inproc",
+    )
+
+
+async def test_inproc_plane_roundtrip():
+    wrt = _rt("inproc-echo")
+    await wrt.serve_endpoint("ns/w/gen", _Echo())
+    assert wrt.server.address.startswith("inproc://")
+    crt = _rt("inproc-echo")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+    out = [item["token_ids"][0]
+           async for item in client.generate({"token_ids": [1, 2, 3]})]
+    assert out == [1, 2, 3]
+    await client.close()
+    await crt.shutdown()
+    await wrt.shutdown(drain_timeout=1)
+
+
+async def test_inproc_abort_mid_stream_is_migratable_disconnect():
+    """`abort()` is the SIGKILL twin: no drain, no goodbye frame — the
+    client must see the same `disconnected` class a cut socket produces,
+    because that is the class Migration treats as replayable."""
+    wrt = _rt("inproc-abort")
+    await wrt.serve_endpoint("ns/w/gen", _Slow())
+    crt = _rt("inproc-abort")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+    got = []
+    with pytest.raises(rp.RequestPlaneError) as ei:
+        async for item in client.generate({}):
+            got.append(item["i"])
+            if len(got) == 3:
+                wrt.server.abort()
+    assert ei.value.code == "disconnected"
+    assert got[:3] == [0, 1, 2]
+    await client.close()
+    await crt.shutdown()
+    await wrt.shutdown(drain_timeout=1)
+
+
+async def test_inproc_fault_hook_partitions_and_recovers():
+    wrt = _rt("inproc-part")
+    await wrt.serve_endpoint("ns/w/gen", _Echo())
+    addr = wrt.server.address
+    crt = _rt("inproc-part")
+    client = crt.client("ns/w/gen")
+    await client.wait_ready()
+    cut = {"on": True}
+
+    async def hook(direction, address):
+        if cut["on"] and address == addr:
+            raise ConnectionResetError("partitioned")
+
+    rp.set_inproc_fault_hook(hook)
+    try:
+        with pytest.raises(rp.RequestPlaneError) as ei:
+            async for _ in client.generate({"token_ids": [1]}):
+                pass
+        # both legal surfaces of a partition, and both are SICK_CODES —
+        # the router cools the instance instead of hammering it
+        assert ei.value.code in rp.PushRouter.SICK_CODES
+        cut["on"] = False
+        out = [i async for i in client.generate({"token_ids": [7]})]
+        assert out == [{"token_ids": [7]}]
+    finally:
+        rp.set_inproc_fault_hook(None)
+        await client.close()
+        await crt.shutdown()
+        await wrt.shutdown(drain_timeout=1)
+
+
+# -- fault schedule grammar --------------------------------------------------
+
+
+def test_fault_schedule_parse_roundtrip():
+    text = ("kill@10:w3;partition@20+5:w1;delay@30+10:w*=0.05;"
+            "corrupt_kv@40:w2=4;digest_drop@50+20:w4;restart@60:w3")
+    sched = FaultSchedule.parse(text)
+    assert len(sched) == 6
+    assert sched.to_text() == text  # already time-sorted
+    ev = sched.events[2]
+    assert (ev.kind, ev.worker, ev.duration_s, ev.param) == (
+        "delay", None, 10.0, 0.05)
+    # parse is the inverse of to_text for every event shape
+    assert FaultSchedule.parse(sched.to_text()).to_text() == sched.to_text()
+
+
+def test_fault_schedule_rejects_garbage():
+    with pytest.raises(ValueError):
+        FaultSchedule.parse("explode@10:w1")
+    with pytest.raises(ValueError):
+        FaultSchedule.parse("kill@abc")
+    with pytest.raises(ValueError):
+        FaultSchedule.parse("kill10:w1")
+
+
+def test_fault_schedule_generate_is_seeded():
+    a = FaultSchedule.generate(seed=7, n_workers=50, duration_s=600)
+    b = FaultSchedule.generate(seed=7, n_workers=50, duration_s=600)
+    c = FaultSchedule.generate(seed=8, n_workers=50, duration_s=600)
+    assert a.to_text() == b.to_text()
+    assert a.to_text() != c.to_text()
+    kinds = {e.kind for e in a.events}
+    assert "kill" in kinds and "restart" in kinds
+    # every in-range kill is followed by a restart of the same slot
+    kills = [e for e in a.events if e.kind == "kill"]
+    restarts = {(e.worker, e.at_s) for e in a.events if e.kind == "restart"}
+    for k in kills:
+        if k.at_s + 20.0 < 600:
+            assert (k.worker, k.at_s + 20.0) in restarts
+
+
+# -- SimTiming calibration ---------------------------------------------------
+
+
+def _synthetic_records(n=60, noise=0.02):
+    """IterationRecord-shaped dicts from a known linear model with a
+    deterministic +/-noise wobble — the fit must land within the
+    documented 15% ITL bound with margin."""
+    recs = []
+    for i in range(n):
+        seqs = 1 + (i % 8)
+        steps = 1 + (i % 3)
+        wob = 1.0 + noise * ((-1) ** i)
+        recs.append({"kind": "decode", "decode_seqs": seqs,
+                     "decode_steps": steps,
+                     "wall_s": steps * (0.004 + 0.0005 * seqs) * wob})
+        toks = 64 * (1 + (i % 5))
+        recs.append({"kind": "prefill", "charged_tokens": toks,
+                     "wall_s": (0.002 + 0.00002 * toks) * wob})
+    recs.append({"kind": "mixed", "wall_s": 1.0})  # must be skipped
+    return recs
+
+
+def test_sim_timing_fit_records_within_bounds():
+    from dynamo_tpu.mocker.sim import SimTiming
+
+    recs = _synthetic_records()
+    timing = SimTiming.fit_records(recs)
+    assert abs(timing.decode_base_s - 0.004) < 0.001
+    assert abs(timing.decode_per_seq_s - 0.0005) < 0.0002
+    err = timing.calibration_error(recs)
+    assert err["n_decode"] == 60 and err["n_prefill"] == 60
+    assert err["itl_p50_err"] is not None and err["itl_p50_err"] <= 0.15
+    assert err["decode_mape"] <= 0.15 and err["prefill_mape"] <= 0.15
+
+
+def test_sim_timing_fit_records_empty_falls_back_to_defaults():
+    from dynamo_tpu.mocker.sim import SimTiming
+
+    timing = SimTiming.fit_records([])
+    base = SimTiming()
+    assert timing.decode_base_s == base.decode_base_s
+    err = timing.calibration_error([])
+    assert err["n_decode"] == 0 and err["itl_p50_err"] is None
+
+
+# -- the simulator end-to-end ------------------------------------------------
+
+
+async def test_fleet_sim_seeded_run_with_kill_and_restart():
+    sim = FleetSim(n_workers=3, router_mode="kv", seed=11, speed=0.02,
+                   decode_base_ms=4.0, idle_sleep_s=0.01,
+                   migration_backoff_base_s=0.01, sick_cooldown_s=0.5)
+    await sim.start()
+    try:
+        sched = FaultSchedule.parse("kill@0.5:w1;restart@1.0:w1")
+        report = await sim.run(scenarios=("json", "agentic"), n_sessions=3,
+                               rps=8.0, fault_schedule=sched)
+    finally:
+        await sim.stop()
+    assert report["workers"] == 3
+    assert report["requests"] > 0
+    g = report["goodput"]
+    assert g["n_ok"] == g["n_requests"]  # nobody errored or hung
+    assert report["active_streams_after"] == 0  # zero hung streams
+    assert report["faults"].get("kill") == 1
+    # the restart refilled the killed slot (or the kill landed after the
+    # restart window closed — either way nobody is left dead)
+    assert report["workers_alive"] == 3
+    assert report["router_p50_decision_us"] > 0
+    assert set(report["scenarios"]) <= {"json", "agentic"}
+    assert report["slo_state"] in ("OK", "WARN", "BREACH")
+
+
+async def test_indexer_expires_killed_routing_winner():
+    """Satellite regression: the routing winner dies; its prefix blocks
+    must stop crediting overlap on EVERY dp rank once discovery delivers
+    the delete, and fresh traffic must land on the survivor."""
+    sim = FleetSim(n_workers=2, router_mode="kv", seed=3, speed=0.0,
+                   idle_sleep_s=0.01, sick_cooldown_s=0.2,
+                   migration_backoff_base_s=0.01)
+    await sim.start()
+    try:
+        entry = sim.entry
+        router = entry.sink.router  # KvRouter
+        prefix = list(range(100, 164))
+        req = {"token_ids": prefix,
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 4, "ignore_eos": True}}
+
+        async def one():
+            async for item in entry.chain.generate(dict(req), Context()):
+                if item.get("finish_reason"):
+                    assert item["finish_reason"] != "error", item
+                    return
+
+        await one()
+        # let the winner's kv events reach the indexer
+        winner = None
+        for _ in range(200):
+            winner, overlap, _ = router.find_best_match(prefix)
+            if overlap > 0:
+                break
+            await asyncio.sleep(0.02)
+        assert overlap > 0, "prefix never indexed"
+        idx = next(i for i, w in enumerate(sim.workers)
+                   if any(inst.instance_id == winner[0]
+                          for inst in w.runtime._served))
+        await sim.kill_worker(idx)
+        # discovery delete -> watcher -> KvRouter._on_instance ->
+        # indexer.remove_instance: the corpse stops scoring
+        for _ in range(200):
+            workers = router.workers()
+            if all(w[0] != winner[0] for w in workers):
+                break
+            await asyncio.sleep(0.02)
+        assert all(w[0] != winner[0] for w in router.workers())
+        w2, overlap2, hashes = router.find_best_match(prefix)
+        assert w2[0] != winner[0]
+        live = router.indexer.index.find_matches(hashes).scores
+        assert all(w[0] != winner[0] for w in live), live
+        # and the fleet still serves the same prefix
+        await one()
+    finally:
+        await sim.stop()
+
+
+async def test_migration_counters_reach_goodput_extras():
+    """A mid-stream kill must show up in the report's migration block:
+    attempts counted on the phase spine, successes on the final item,
+    aggregated into extras — the denominator the 99% gate divides by."""
+    sim = FleetSim(n_workers=2, router_mode="round_robin", seed=5,
+                   speed=1.0, decode_base_ms=25.0, idle_sleep_s=0.01,
+                   migration_backoff_base_s=0.01, sick_cooldown_s=0.5)
+    await sim.start()
+    try:
+        entry = sim.entry
+        req = {"token_ids": [1, 2, 3, 4],
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 30, "ignore_eos": True}}
+        ctx = Context()
+        toks = []
+        holder = None
+        final = None
+        async for item in entry.chain.generate(dict(req), ctx):
+            toks.extend(item.get("token_ids") or [])
+            if len(toks) >= 3 and holder is None:
+                active = [i for i, w in enumerate(sim.workers)
+                          if len(w.runtime.server._active) > 0]
+                assert active, "no worker holds the stream"
+                holder = active[0]
+                await sim.kill_worker(holder)
+            if item.get("finish_reason"):
+                assert item["finish_reason"] != "error", item
+                final = item
+        assert len(toks) == 30
+        # attempts ride the shared ctx phase dict; success is stamped on
+        # the final item (the authoritative "migrated AND finished")
+        ph = ctx.metadata.get("phases") or {}
+        assert ph.get("migration_attempts", 0) >= 1
+        fph = (final or {}).get("phases") or {}
+        assert fph.get("migration_succeeded") == 1
+        # byte-identical with an unchaosed run of the same request: the
+        # replay carried the already-emitted tokens, so the survivor
+        # continued the exact stream instead of restarting it
+        clean = []
+        async for item in entry.chain.generate(dict(req), Context()):
+            clean.extend(item.get("token_ids") or [])
+            if item.get("finish_reason"):
+                break
+        assert clean == toks
+        assert sim.active_streams() == 0
+    finally:
+        await sim.stop()
