@@ -13,6 +13,7 @@ Fills the role the reference delegates to vLLM/SGLang/TRT-LLM AsyncLLM
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import queue as thread_queue
 import threading
@@ -175,6 +176,11 @@ class InferenceEngine:
         enable_prefix_cache: bool = True,  # content-addressed KV reuse
         #   (session-tree warm turns; off = every prompt prefills cold —
         #   the A/B knob bench_agentic flips)
+        sanitize: Optional[bool] = None,  # runtime sanitizer (transfer
+        #   guard, recompile tripwire, lock-order recorder, pool audit);
+        #   None = follow DYN_SAN env
+        sanitizer: Optional[Any] = None,  # pre-built Sanitizer to share
+        #   across engines (fleet-sim); overrides `sanitize`
     ):
         self.runner = runner
         # fused mixed dispatch (one program per iteration instead of two):
@@ -348,6 +354,27 @@ class InferenceEngine:
         self._guided_cache: Dict[str, Any] = {}
         self._guided_lock = threading.Lock()
         self._lifter_lock = threading.Lock()  # one-time TokenLifter build
+        # runtime sanitizer: off unless asked (arg or DYN_SAN env). The
+        # import is local so mocker processes that never arm it pay one
+        # cheap module load at most.
+        from dynamo_tpu.runtime.sanitizer import Sanitizer, env_enabled
+
+        if sanitizer is not None:
+            self.sanitizer = sanitizer
+        elif sanitize or (sanitize is None and env_enabled()):
+            self.sanitizer = Sanitizer()
+        else:
+            self.sanitizer = None
+        if self.sanitizer is not None:
+            san = self.sanitizer
+            self._guided_lock = san.wrap_lock(
+                self._guided_lock, "engine.guided_cache"
+            )
+            self._lifter_lock = san.wrap_lock(
+                self._lifter_lock, "engine.lifter"
+            )
+            if hasattr(runner, "attach_sanitizer"):
+                runner.attach_sanitizer(san)
         # called (from the step thread) on unrecoverable engine failure
         # (multi-host GroupBroken): the worker wires it to process exit
         self._fatal_cb = None
@@ -483,6 +510,18 @@ class InferenceEngine:
             self._thread = None
         if self.prefetch is not None:
             self.prefetch.stop()
+        if self.sanitizer is not None:
+            live = (len(self.scheduler.active) + len(self.scheduler.waiting)
+                    + len(self._kv_pending))
+            self.sanitizer.audit_pool(self.pool, live_seqs=live)
+
+    def _san_scope(self, where: str):
+        """Transfer-guard scope for a steady-state dispatch (no-op
+        nullcontext when the sanitizer is off)."""
+        san = self.sanitizer
+        if san is None:
+            return contextlib.nullcontext()
+        return san.transfer_scope(where)
 
     def on_fpm(self, cb) -> None:
         """cb(ForwardPassMetrics) from the step thread."""
@@ -775,7 +814,7 @@ class InferenceEngine:
     def _loop_once(self) -> None:
         from dynamo_tpu.parallel.multihost import GroupBroken
 
-        self._drain_inbox()
+        self._drain_inbox()  # dynlint: disable=DYN-J006 — embed readback (.tolist in _run_embeds) is a request-boundary transfer; sanitizer allowlists it as "embed_readback"
         self._propose_drafts()
         plan = self.scheduler.step_plan()
         if plan is None:
@@ -943,6 +982,10 @@ class InferenceEngine:
                     log.exception("failed to fail sequence %s", seq.request_id)
             self._recover_poisoned_pools()
             return
+        if self.sanitizer is not None:
+            # arms the transfer guard + freezes the compiled-family
+            # baseline after warmup; a new variant past that is a leak
+            self.sanitizer.note_step(self.runner)
         self._publish_fpm(kind, time.monotonic() - t0, n_tok)
         self._publish_kv_events()
         self._record_iteration(
@@ -1719,10 +1762,11 @@ class InferenceEngine:
         with annotate("engine.spec_verify", batch=len(seqs),
                       drafted=n_drafted, chunks=len(chunks)):
             try:
-                rows, chunk_logits = self.runner.verify_spec(
-                    tokens, positions, tables, drafts,
-                    _sampling_params(seqs), step0, chunks=chunks, **vkw,
-                )
+                with self._san_scope("spec_verify"):
+                    rows, chunk_logits = self.runner.verify_spec(
+                        tokens, positions, tables, drafts,
+                        _sampling_params(seqs), step0, chunks=chunks, **vkw,
+                    )
             except BucketOverflowError as e:
                 log.warning(
                     "spec verify overflows runner buckets (%s); dropping "
@@ -1918,7 +1962,8 @@ class InferenceEngine:
     def _run_decode(self, plan: DecodePlan) -> None:
         with annotate("engine.decode", batch=len(plan.seqs),
                       steps=plan.n_steps):
-            self._run_decode_inner(plan)
+            with self._san_scope("decode"):
+                self._run_decode_inner(plan)
 
     def _run_decode_inner(self, plan: DecodePlan) -> None:
         """Fused multi-step decode: plan.n_steps iterations in one jit with

@@ -15,6 +15,7 @@ static shapes"):
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from functools import partial
@@ -498,6 +499,7 @@ class ModelRunner:
         kv_quantize: Optional[str] = None,  # "int8" → quantized KV pools
     ):
         self.config = config
+        self._sanitizer = None  # set by attach_sanitizer (engine opt-in)
         self.mesh_config = mesh_config or MeshConfig()
         self.mesh = make_mesh(self.mesh_config, devices)
         self.policy = ShardingPolicy(self.mesh)
@@ -835,6 +837,16 @@ class ModelRunner:
         out = self.decode_multi(1, tokens, positions, page_tables, sampling, step)
         return out[:, 0]
 
+    def attach_sanitizer(self, san) -> None:
+        """Adopt the engine's runtime sanitizer: staging / readback sites
+        below run inside named allow_transfer scopes so the engine can
+        hold `jax.transfer_guard("disallow")` across whole dispatches."""
+        self._sanitizer = san
+
+    def _allow(self, label: str):
+        san = self._sanitizer
+        return contextlib.nullcontext() if san is None else san.allow_transfer(label)
+
     def _adapter_array(self, adapters: Optional[List[int]], B: int):
         if self.lora is None:
             return None
@@ -863,7 +875,8 @@ class ModelRunner:
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
             masks=masks, biases=biases, mask_fn=mask_fn,
         )
-        return np.asarray(jax.device_get(toks))
+        with self._allow("token_readback"):
+            return np.asarray(jax.device_get(toks))
 
     def decode_multi_ex(
         self,
@@ -893,12 +906,13 @@ class ModelRunner:
             n_logprobs=n_logprobs, histories=histories, prompt_lens=prompt_lens,
             masks=masks, biases=biases, mask_fn=mask_fn,
         )
-        if n_logprobs >= 0:
-            toks, _, lp = out
-            toks_h, lp_h = jax.device_get((toks, lp))
-            return np.asarray(toks_h), tuple(np.asarray(a) for a in lp_h)
-        toks, _ = out
-        return np.asarray(jax.device_get(toks)), None
+        with self._allow("token_readback"):
+            if n_logprobs >= 0:
+                toks, _, lp = out
+                toks_h, lp_h = jax.device_get((toks, lp))
+                return np.asarray(toks_h), tuple(np.asarray(a) for a in lp_h)
+            toks, _ = out
+            return np.asarray(jax.device_get(toks)), None
 
     def decode_multi_async(
         self,
@@ -952,7 +966,8 @@ class ModelRunner:
         else:
             tok_h = np.zeros(B, np.int32)
             tok_h[:n] = tokens
-            tok = jnp.asarray(tok_h)
+            with self._allow("decode_staging"):
+                tok = jnp.asarray(tok_h)
 
         hist = None
         if histories is not None:
@@ -967,13 +982,15 @@ class ModelRunner:
                 plen_h[i] = (
                     prompt_lens[i] if prompt_lens is not None else len(h)
                 )
-            hist = (jnp.asarray(hist_h), jnp.asarray(plen_h))
+            with self._allow("decode_staging"):
+                hist = (jnp.asarray(hist_h), jnp.asarray(plen_h))
 
         mask_dev = None
         if masks is not None:
             m = np.ones((B, self.config.vocab_size), bool)
             m[: masks.shape[0]] = masks  # pad rows stay all-allowed
-            mask_dev = jnp.asarray(m)
+            with self._allow("decode_staging"):
+                mask_dev = jnp.asarray(m)
 
         if self.pp:
             if n_logprobs >= 0 or hist is not None or biases is not None \
@@ -982,10 +999,12 @@ class ModelRunner:
                     "logprobs/penalties/logit_bias/multi-step guided masks "
                     "are not wired on the pipeline-parallel decode path yet"
                 )
+            with self._allow("decode_staging"):
+                packed_dev = jnp.asarray(packed)
+                samp = self._device_sampling(sampling, B)
             toks, last, self.k_pool, self.v_pool = self._jit_pp_decode(
-                n_steps, self.params, tok, jnp.asarray(packed), mask_dev,
-                self.k_pool, self.v_pool,
-                self._device_sampling(sampling, B),
+                n_steps, self.params, tok, packed_dev, mask_dev,
+                self.k_pool, self.v_pool, samp,
             )
             return toks, last
 
@@ -993,17 +1012,21 @@ class ModelRunner:
         if biases is not None:
             bz = np.zeros((B, self.config.vocab_size), np.float32)
             bz[: biases.shape[0]] = biases  # pad rows stay unbiased
-            bias_dev = jnp.asarray(bz)
+            with self._allow("decode_staging"):
+                bias_dev = jnp.asarray(bz)
 
         mkw = {}
         if mask_fn is not None:
             mask_fn.B = B  # callback mask rows must match the padded bucket
             self.set_guided_ctx(mask_fn)
             mkw["mask_fn"] = self._mask_tramp
+        with self._allow("decode_staging"):
+            packed_dev = jnp.asarray(packed)
+            samp = self._device_sampling(sampling, B)
         toks, last, lp, self.k_pool, self.v_pool = self._jit_decode_loop(
-            n_steps, n_logprobs, self.params, tok, jnp.asarray(packed), hist,
+            n_steps, n_logprobs, self.params, tok, packed_dev, hist,
             mask_dev, bias_dev, self.k_pool, self.v_pool,
-            self._device_sampling(sampling, B), self.lora, **mkw,
+            samp, self.lora, **mkw,
         )
         if n_logprobs >= 0:
             return toks, last, lp
@@ -1516,22 +1539,28 @@ class ModelRunner:
             offs = np.concatenate([[0], np.cumsum(row_lens)])
             for i, b in biases.items():
                 row_biases[offs[i]] = b
+        with self._allow("verify_staging"):
+            staged = (
+                jnp.asarray(flat[None]),
+                jnp.asarray(md["tok_positions"])[None],
+                jnp.asarray(md["tok_page_table"]),
+                jnp.asarray(md["tok_kv_lens"]),
+                jnp.asarray(md["seg_page_table"]),
+                jnp.asarray(md["seg_kv_lens"]),
+                jnp.asarray(md["meta"]),
+                jnp.asarray(gather),
+            )
+            samp = self._device_sampling(exp, seg_cap)
+            step_d = jnp.int32(step)
+            seg_mask = self._seg_mask(row_masks, seg_cap)
+            seg_bias = self._seg_bias(row_biases, seg_cap)
         sampled, seg_logits, self.k_pool, self.v_pool = self._jit_ragged(
-            self.params,
-            jnp.asarray(flat[None]),
-            jnp.asarray(md["tok_positions"])[None],
-            jnp.asarray(md["tok_page_table"]),
-            jnp.asarray(md["tok_kv_lens"]),
-            jnp.asarray(md["seg_page_table"]),
-            jnp.asarray(md["seg_kv_lens"]),
-            jnp.asarray(md["meta"]),
-            jnp.asarray(gather),
+            self.params, *staged,
             self.k_pool, self.v_pool,
-            self._device_sampling(exp, seg_cap), jnp.int32(step),
-            self._seg_mask(row_masks, seg_cap),
-            self._seg_bias(row_biases, seg_cap),
+            samp, step_d, seg_mask, seg_bias,
         )
-        sampled_h = np.asarray(jax.device_get(sampled))  # one bulk sync
+        with self._allow("token_readback"):
+            sampled_h = np.asarray(jax.device_get(sampled))  # one bulk sync
         out: List[np.ndarray] = []
         w = 0
         for ln in row_lens:
@@ -1664,16 +1693,21 @@ class ModelRunner:
         pos[:n] = positions
         pt = self._pad_page_table(page_tables, B)
 
+        with self._allow("spec_staging"):
+            tok_d, pos_d, pt_d = jnp.asarray(tok), jnp.asarray(pos), jnp.asarray(pt)
+            samp = self._device_sampling(sampling, B)
+            step_d = jnp.int32(step)
+            adapt_d = self._adapter_array(adapters, B)
         toks, counts, self.k_pool, self.v_pool, self.draft_k_pool, self.draft_v_pool = (
             self._jit_spec(
                 gamma, n_rounds, self.params, self.draft_params,
-                jnp.asarray(tok), jnp.asarray(pos),
+                tok_d, pos_d,
                 self.k_pool, self.v_pool, self.draft_k_pool, self.draft_v_pool,
-                jnp.asarray(pt), self._device_sampling(sampling, B),
-                jnp.int32(step), self.lora, self._adapter_array(adapters, B),
+                pt_d, samp, step_d, self.lora, adapt_d,
             )
         )
-        toks_h, counts_h = jax.device_get((toks, counts))
+        with self._allow("token_readback"):
+            toks_h, counts_h = jax.device_get((toks, counts))
         return np.asarray(toks_h), np.asarray(counts_h)
 
     def draft_prefill(

@@ -21,6 +21,11 @@ from dynamo_tpu.lint.core import (
     baseline_counts,
     diff_against_baseline,
 )
+from dynamo_tpu.lint.project import (
+    ProjectIndex,
+    extract_module_facts,
+    project_violations,
+)
 
 __all__ = [
     "Violation",
@@ -33,4 +38,7 @@ __all__ = [
     "load_baseline",
     "baseline_counts",
     "diff_against_baseline",
+    "ProjectIndex",
+    "extract_module_facts",
+    "project_violations",
 ]

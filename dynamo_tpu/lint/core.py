@@ -608,11 +608,71 @@ def lint_file(path: str, rules: Optional[Sequence[Rule]] = None,
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build"}
 _SKIP_FILE_RE = re.compile(r"_pb2(_grpc)?\.py$")
 
+# bump when per-file rule semantics change: stale cached violations from
+# an older rule set must not satisfy the gate
+_CACHE_VERSION = 1
+
+
+def _load_cache(cache_path: Optional[str]) -> Dict[str, Any]:
+    if not cache_path or not os.path.exists(cache_path):
+        return {}
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    from dynamo_tpu.lint.project import FACTS_VERSION
+
+    if (data.get("version") != _CACHE_VERSION
+            or data.get("facts_version") != FACTS_VERSION):
+        return {}
+    files = data.get("files", {})
+    return files if isinstance(files, dict) else {}
+
+
+def _save_cache(cache_path: str, files: Dict[str, Any]) -> None:
+    from dynamo_tpu.lint.project import FACTS_VERSION
+
+    tmp = f"{cache_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": _CACHE_VERSION,
+                       "facts_version": FACTS_VERSION,
+                       "files": files}, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        # the cache is an optimization; a read-only tree still lints
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
 
 def lint_paths(paths: Iterable[str],
                rules: Optional[Sequence[Rule]] = None,
-               root: Optional[str] = None) -> List[Violation]:
+               root: Optional[str] = None,
+               project: bool = True,
+               cache_path: Optional[str] = None) -> List[Violation]:
+    """Lint files/trees: the per-file rule pass plus (by default) the
+    interprocedural project pass over everything collected
+    (`dynamo_tpu/lint/project.py`).
+
+    `cache_path` names an mtime+size-keyed JSON result cache: unchanged
+    files reuse their per-file violations AND their extracted call-graph
+    facts, so the project-wide pass stays cheap enough for tier-1 (only
+    edited files re-parse; linking is pure dict work). The cache is only
+    consulted for the default rule set — custom `rules` bypass it.
+    """
+    from dynamo_tpu.lint.project import (
+        extract_module_facts,
+        project_violations,
+    )
+
+    cacheable = rules is None and cache_path is not None
+    cache = _load_cache(cache_path) if cacheable else {}
     out: List[Violation] = []
+    facts: List[Dict[str, Any]] = []
+    new_cache: Dict[str, Any] = {}
     for path in paths:
         if os.path.isfile(path):
             files = [path]
@@ -628,7 +688,34 @@ def lint_paths(paths: Iterable[str],
                 )
         for f in files:
             rel = os.path.relpath(f, root) if root else f
-            out.extend(lint_file(f, rules=rules, rel_path=rel))
+            try:
+                st = os.stat(f)
+                stamp = [st.st_mtime_ns, st.st_size]
+            except OSError:
+                stamp = None
+            hit = cache.get(rel) if cacheable and stamp else None
+            if hit is not None and hit.get("stamp") == stamp:
+                vs = [Violation(**d) for d in hit["violations"]]
+                mf = hit["facts"]
+            else:
+                with open(f, encoding="utf-8") as fh:
+                    source = fh.read()
+                vs = lint_file(f, rules=rules, source=source, rel_path=rel)
+                mf = extract_module_facts(rel, source) if project else None
+            out.extend(vs)
+            if mf is not None:
+                facts.append(mf)
+            if cacheable and stamp:
+                new_cache[rel] = {
+                    "stamp": stamp,
+                    "violations": [v.as_dict() for v in vs],
+                    "facts": mf,
+                }
+    if project and facts:
+        out.extend(project_violations(facts))
+    if cacheable:
+        _save_cache(cache_path, new_cache)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out
 
 

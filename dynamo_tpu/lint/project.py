@@ -1,0 +1,641 @@
+"""dynlint interprocedural pass: a project-wide call graph with taint.
+
+The per-file rules (rules_async / rules_jax / rules_runtime) only see
+direct calls — `time.sleep` *inside* the `async def`, `.item()` *inside*
+`_loop_once`. One helper hop hides the violation: the step loop calls
+`self._readback()`, `_readback` calls `np.asarray`, and DYN-J005 is
+blind. This module closes that hole with a second pass over the whole
+lint scope:
+
+1. **Facts extraction** (`extract_module_facts`) — one extra AST walk
+   per file collecting, for every function: resolved call edges (with
+   in-loop / awaited / bare-statement / locks-held context), direct
+   blocking calls (the DYN-A001 catalog), direct sync file I/O, direct
+   device→host sync forcers (the DYN-J005 catalog), ordered lock
+   acquisitions, and whether the function is async or returns a spawned
+   task. Facts are plain dicts so `lint_paths` can cache them per file,
+   keyed by mtime.
+2. **Linking** (`ProjectIndex`) — module names come from relative
+   paths; call targets resolve through import aliases (including
+   relative imports and one-hop re-exports like a package `__init__`
+   forwarding `from pkg.impl import helper`), plain local names, and
+   single-level `self.method` references.
+3. **Taint + emission** (`project_violations`) — BFS taint from the
+   blocking / host-sync seeds over reverse call edges, a transitive
+   lock-acquisition relation, and the findings:
+
+   - DYN-A001 / DYN-A002 at a call edge leaving an `async def` into a
+     helper chain that (transitively) blocks / does file I/O,
+   - DYN-J005 at an *in-loop* call edge leaving the engine step scope
+     into a chain that forces a device sync (the interprocedural twin
+     of the per-file rule),
+   - DYN-J006 at any other call edge leaving the step scope into such
+     a chain — the transfer still happens once per iteration, it is
+     just hidden in a helper instead of being an explicit, auditable
+     bulk `device_get` at the top level,
+   - DYN-R007 for a cycle in the static lock-acquisition-order graph,
+     including order established across modules through call edges made
+     while a lock is held,
+   - DYN-A006 for a coroutine (or spawned-task handle) created by
+     calling a project `async def` as a bare statement — the coroutine
+     is never awaited, so the body never runs; cross-module creation is
+     the case per-file DYN-A004 cannot see.
+
+Findings are ordinary `Violation`s and respect the same inline
+suppression comments as the per-file rules, evaluated in the file where
+the finding is reported (the call site, not the taint root).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from dynamo_tpu.lint.core import (
+    ModuleIndex,
+    Violation,
+    _collect_suppressions,
+)
+from dynamo_tpu.lint.rules_async import _BLOCKING_CALLS
+
+__all__ = [
+    "extract_module_facts",
+    "ProjectIndex",
+    "project_violations",
+    "module_name_for",
+]
+
+# bump to invalidate cached facts when the extraction schema changes
+FACTS_VERSION = 1
+
+_LOCK_NAME_RE = re.compile(r"(^|_)r?lock$")
+
+# direct device→host sync forcers (the DYN-J005 catalog): attribute
+# calls by name, canonical dotted calls by resolved name
+_SYNC_ATTRS = ("item", "tolist")
+_SYNC_CALLS = ("numpy.asarray", "jax.device_get", "jax.block_until_ready")
+
+_SPAWN_CALLS = ("asyncio.create_task", "asyncio.ensure_future")
+_SPAWN_TAILS = (".create_task", ".ensure_future")
+
+# J005/J006 step scope: the engine's per-iteration hot path
+_HOT_PREFIXES = ("_run_decode", "_run_mixed", "_run_spec", "_run_prefill")
+
+_MAX_CHAIN = 12  # taint-chain hop bound (also the re-export hop bound)
+
+
+def module_name_for(rel_path: str) -> str:
+    """Dotted module name for a lint-scope-relative path:
+    `dynamo_tpu/lint/core.py` → `dynamo_tpu.lint.core`,
+    `pkg/__init__.py` → `pkg`."""
+    p = rel_path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [seg for seg in p.split("/") if seg]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _ProjectModuleIndex(ModuleIndex):
+    """ModuleIndex whose aliases also resolve relative imports, which
+    the per-file index deliberately ignores (it has no module name)."""
+
+    def __init__(self, module: str, is_pkg: bool) -> None:
+        super().__init__()
+        self._module = module
+        self._is_pkg = is_pkg
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if not node.level:
+            super().visit_ImportFrom(node)
+            return
+        # package the import is relative to: the module itself for
+        # __init__.py, its parent otherwise; each extra level drops one
+        parts = self._module.split(".") if self._module else []
+        if not self._is_pkg:
+            parts = parts[:-1]
+        drop = node.level - 1
+        if drop:
+            parts = parts[:-drop] if drop < len(parts) else []
+        base = ".".join(parts)
+        if node.module:
+            base = f"{base}.{node.module}" if base else node.module
+        if not base:
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.aliases[a.asname or a.name] = f"{base}.{a.name}"
+
+
+class _FactsVisitor(ast.NodeVisitor):
+    """Single walk collecting per-function facts (see module docstring).
+    Nested defs attribute their bodies to the innermost function."""
+
+    def __init__(self, module: str, index: _ProjectModuleIndex) -> None:
+        self.module = module
+        self.index = index
+        self.functions: Dict[str, Dict[str, Any]] = {}
+        self._cls_stack: List[str] = []
+        self._fn_stack: List[Dict[str, Any]] = []
+        self._loop_depth: List[int] = []
+        self._held: List[str] = []  # lock ids currently held (lexical)
+        self._awaited: Set[int] = set()
+        self._bare: Set[int] = set()
+
+    # -- scope bookkeeping -------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._cls_stack.append(node.name)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        local = f"{cls}.{node.name}" if cls else node.name
+        facts = {
+            "name": node.name,
+            "cls": cls,
+            "line": node.lineno,
+            "is_async": isinstance(node, ast.AsyncFunctionDef),
+            "calls": [],
+            "blocking": [],
+            "file_io": [],
+            "transfers": [],
+            "acquires": [],
+            "returns_spawn": False,
+        }
+        # nested defs (closures) keep attributing to the OUTER function:
+        # their body runs, at the latest, when the outer scope calls them
+        if not self._fn_stack:
+            self.functions[local] = facts
+            self._fn_stack.append(facts)
+            self._loop_depth.append(0)
+            self.generic_visit(node)
+            self._loop_depth.pop()
+            self._fn_stack.pop()
+        else:
+            self.generic_visit(node)
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def _visit_loop(self, node) -> None:
+        if self._loop_depth:
+            self._loop_depth[-1] += 1
+        self.generic_visit(node)
+        if self._loop_depth:
+            self._loop_depth[-1] -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    # -- locks -------------------------------------------------------------
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        """Canonical id for a lock-typed `with` target, or None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.index.async_lock_names:
+                return None
+            if expr.id in self.index.lock_names or _LOCK_NAME_RE.search(
+                expr.id
+            ):
+                return f"{self.module}.{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in self.index.async_lock_attrs:
+                return None
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                if (expr.attr in self.index.lock_attrs
+                        or _LOCK_NAME_RE.search(expr.attr)):
+                    cls = self._cls_stack[-1] if self._cls_stack else "?"
+                    return f"{self.module}.{cls}.{expr.attr}"
+                return None
+            resolved = self.index.resolve(expr)
+            if resolved and _LOCK_NAME_RE.search(resolved.rsplit(".", 1)[-1]):
+                return resolved
+        return None
+
+    def _visit_with(self, node) -> None:
+        acquired: List[str] = []
+        if not isinstance(node, ast.AsyncWith):
+            for item in node.items:
+                lock = self._lock_id(item.context_expr)
+                if lock is not None and self._fn_stack:
+                    self._fn_stack[-1]["acquires"].append({
+                        "lock": lock,
+                        "line": node.lineno,
+                        "held": list(self._held),
+                    })
+                    self._held.append(lock)
+                    acquired.append(lock)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # -- call context markers ---------------------------------------------
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        if isinstance(node.value, ast.Call):
+            self._bare.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if (self._fn_stack and isinstance(node.value, ast.Call)):
+            name = self.index.resolve(node.value.func) or ""
+            if name in _SPAWN_CALLS or name.endswith(_SPAWN_TAILS):
+                self._fn_stack[-1]["returns_spawn"] = True
+        self.generic_visit(node)
+
+    # -- the leaf event ----------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        facts = self._fn_stack[-1] if self._fn_stack else None
+        if facts is not None:
+            name = self.index.resolve(node.func)
+            fix = _BLOCKING_CALLS.get(name or "")
+            if fix is not None:
+                facts["blocking"].append(
+                    {"line": node.lineno, "name": name, "fix": fix}
+                )
+            elif name == "open":
+                facts["file_io"].append({"line": node.lineno})
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS):
+                facts["transfers"].append(
+                    {"line": node.lineno, "what": f".{node.func.attr}()"}
+                )
+            elif name in _SYNC_CALLS:
+                facts["transfers"].append(
+                    {"line": node.lineno, "what": name}
+                )
+            if name and name not in _BLOCKING_CALLS:
+                facts["calls"].append({
+                    "callee": name,
+                    "line": node.lineno,
+                    "col": node.col_offset,
+                    "in_loop": bool(self._loop_depth
+                                    and self._loop_depth[-1] > 0),
+                    "awaited": id(node) in self._awaited,
+                    "bare": id(node) in self._bare,
+                    "held": list(self._held),
+                })
+        self.generic_visit(node)
+
+
+def extract_module_facts(
+    rel_path: str, source: str, tree: Optional[ast.Module] = None,
+) -> Dict[str, Any]:
+    """Per-module fact dict for the project pass (JSON-serializable, so
+    `lint_paths` caches it alongside the per-file violations)."""
+    module = module_name_for(rel_path)
+    is_pkg = rel_path.replace("\\", "/").endswith("__init__.py")
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=rel_path)
+        except SyntaxError:
+            # DYN-E000 is already reported by the per-file pass
+            return {"module": module, "path": rel_path, "is_pkg": is_pkg,
+                    "aliases": {}, "functions": {},
+                    "suppress_lines": {}, "suppress_file": []}
+    index = _ProjectModuleIndex(module, is_pkg)
+    index.index_module(tree)
+    visitor = _FactsVisitor(module, index)
+    visitor.visit(tree)
+    sup_lines, sup_file = _collect_suppressions(source)
+    return {
+        "module": module,
+        "path": rel_path,
+        "is_pkg": is_pkg,
+        "aliases": dict(index.aliases),
+        "functions": visitor.functions,
+        "suppress_lines": {str(k): sorted(v) for k, v in sup_lines.items()},
+        "suppress_file": sorted(sup_file),
+    }
+
+
+class ProjectIndex:
+    """Link a set of module facts into a call graph + taint relations."""
+
+    def __init__(self, modules: Iterable[Dict[str, Any]]) -> None:
+        self.modules: Dict[str, Dict[str, Any]] = {}
+        self.functions: Dict[str, Dict[str, Any]] = {}
+        self.fn_module: Dict[str, Dict[str, Any]] = {}
+        for m in modules:
+            self.modules[m["module"]] = m
+            for local, facts in m["functions"].items():
+                q = f"{m['module']}.{local}"
+                self.functions[q] = facts
+                self.fn_module[q] = m
+        # resolved edges: caller qname -> [(callee qname, call dict)]
+        self.edges: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+        for q, facts in self.functions.items():
+            m = self.fn_module[q]
+            out: List[Tuple[str, Dict[str, Any]]] = []
+            for call in facts["calls"]:
+                callee = self._resolve_callee(
+                    m["module"], facts["cls"], call["callee"]
+                )
+                if callee is not None:
+                    out.append((callee, call))
+            self.edges[q] = out
+        self.rev: Dict[str, List[str]] = {}
+        for q, outs in self.edges.items():
+            for callee, _ in outs:
+                self.rev.setdefault(callee, []).append(q)
+
+    # -- name resolution ---------------------------------------------------
+    def _resolve_callee(
+        self, module: str, cls: Optional[str], raw: str,
+    ) -> Optional[str]:
+        if raw.startswith("self."):
+            parts = raw.split(".")
+            if len(parts) == 2 and cls is not None:
+                q = f"{module}.{cls}.{parts[1]}"
+                if q in self.functions:
+                    return q
+            return None
+        if "." not in raw:
+            for q in (f"{module}.{raw}",
+                      f"{module}.{cls}.{raw}" if cls else None):
+                if q and q in self.functions:
+                    return q
+            return None
+        return self._canon(raw, 0)
+
+    def _canon(self, name: str, depth: int) -> Optional[str]:
+        """Fully-qualified project function for a dotted name, following
+        re-export aliases (`pkg/__init__.py: from pkg.impl import f`) up
+        to a bounded number of hops."""
+        if name in self.functions:
+            return name
+        if depth >= _MAX_CHAIN:
+            return None
+        parts = name.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:i])
+            m = self.modules.get(prefix)
+            if m is None:
+                continue
+            rest = parts[i:]
+            target = m["aliases"].get(rest[0])
+            if target is not None:
+                return self._canon(".".join([target] + rest[1:]), depth + 1)
+            return None  # known module, unknown member: external enough
+        return None
+
+    # -- taint -------------------------------------------------------------
+    def _taint(self, seed_key: str) -> Dict[str, Tuple[Any, Optional[str]]]:
+        """BFS from direct seeds over reverse call edges. Returns
+        `fn -> (root_entry, via)` where `via` is the next function on the
+        chain toward the root (None when fn holds the root directly).
+        Propagation follows edges that actually execute: any call to a
+        sync callee, awaited calls to an async callee."""
+        taint: Dict[str, Tuple[Any, Optional[str]]] = {}
+        frontier: List[str] = []
+        for q, facts in self.functions.items():
+            entries = facts[seed_key]
+            if entries:
+                taint[q] = (entries[0], None)
+                frontier.append(q)
+        hops = 0
+        while frontier and hops < _MAX_CHAIN:
+            hops += 1
+            nxt: List[str] = []
+            for tainted in frontier:
+                root, _ = taint[tainted]
+                callee_async = self.functions[tainted]["is_async"]
+                for caller in self.rev.get(tainted, ()):
+                    if caller in taint:
+                        continue
+                    if callee_async and not any(
+                        c["awaited"] for q2, c in self.edges[caller]
+                        if q2 == tainted
+                    ):
+                        continue  # coroutine never awaited: body never runs
+                    taint[caller] = (root, tainted)
+                    nxt.append(caller)
+            frontier = nxt
+        return taint
+
+    def chain(self, start: str,
+              taint: Dict[str, Tuple[Any, Optional[str]]]) -> List[str]:
+        """Human-readable helper chain from `start` to the taint root."""
+        out, cur, seen = [start], start, {start}
+        while True:
+            _, via = taint[cur]
+            if via is None or via in seen:
+                return out
+            out.append(via)
+            seen.add(via)
+            cur = via
+
+    def acquires_transitive(self) -> Dict[str, Set[str]]:
+        """fn -> set of lock ids it may acquire, directly or via calls
+        (fixpoint over the call graph, hop-bounded)."""
+        acq: Dict[str, Set[str]] = {
+            q: {a["lock"] for a in f["acquires"]}
+            for q, f in self.functions.items()
+        }
+        for _ in range(_MAX_CHAIN):
+            changed = False
+            for q, outs in self.edges.items():
+                mine = acq[q]
+                before = len(mine)
+                for callee, _c in outs:
+                    mine |= acq.get(callee, set())
+                changed = changed or len(mine) != before
+            if not changed:
+                break
+        return acq
+
+    def _short(self, q: str) -> str:
+        """Compact display name: module tail + function."""
+        m = self.fn_module.get(q)
+        if m is None:
+            return q
+        local = q[len(m["module"]) + 1:] if q.startswith(m["module"]) else q
+        tail = m["module"].rsplit(".", 1)[-1]
+        return f"{tail}.{local}"
+
+
+def _in_step_scope(m: Dict[str, Any], facts: Dict[str, Any]) -> bool:
+    """The DYN-J005 hot-path predicate, lifted to facts."""
+    if "engine" not in m["path"]:
+        return False
+    n = facts["name"]
+    return (n == "_loop_once" or n.startswith("accept")
+            or n.startswith(_HOT_PREFIXES))
+
+
+def _suppressed(m: Dict[str, Any], rule: str, line: int) -> bool:
+    sup_file = set(m.get("suppress_file", ()))
+    if rule in sup_file or "*" in sup_file:
+        return True
+    sup = set(m.get("suppress_lines", {}).get(str(line), ()))
+    return rule in sup or "*" in sup
+
+
+def project_violations(
+    modules: Iterable[Dict[str, Any]],
+) -> List[Violation]:
+    """All interprocedural findings for a set of module facts."""
+    idx = ProjectIndex(modules)
+    out: List[Violation] = []
+
+    def report(m: Dict[str, Any], rule: str, line: int, col: int,
+               message: str) -> None:
+        if not _suppressed(m, rule, line):
+            out.append(Violation(rule, m["path"], line, col, message))
+
+    block_taint = idx._taint("blocking")
+    io_taint = idx._taint("file_io")
+    sync_taint = idx._taint("transfers")
+
+    for q, facts in idx.functions.items():
+        m = idx.fn_module[q]
+        step_scope = _in_step_scope(m, facts)
+        for callee, call in idx.edges[q]:
+            cfacts = idx.functions[callee]
+            executes = call["awaited"] or not cfacts["is_async"]
+
+            # DYN-A006: project coroutine / spawned task dropped on the
+            # floor — the cross-module case per-file A004 cannot see
+            if (call["bare"] and not call["awaited"]
+                    and (cfacts["is_async"] or cfacts["returns_spawn"])):
+                kind = ("coroutine" if cfacts["is_async"]
+                        else "spawned task handle")
+                where = ("another module"
+                         if idx.fn_module[callee] is not m else "this module")
+                report(
+                    m, "DYN-A006", call["line"], call["col"],
+                    f"{kind} from `{idx._short(callee)}` (defined in "
+                    f"{where}, {idx.fn_module[callee]['path']}:"
+                    f"{cfacts['line']}) is created and dropped: it is "
+                    "never awaited, so its body never runs"
+                    + (" and its exception is never retrieved"
+                       if not cfacts["is_async"] else "")
+                    + "; await it, retain the handle, or use "
+                      "`dynamo_tpu.runtime.spawn_tracked`")
+                continue  # a dropped coroutine never runs: no other taint
+
+            if not executes:
+                continue
+
+            if facts["is_async"]:
+                if callee in block_taint:
+                    root, _ = block_taint[callee]
+                    links = " -> ".join(
+                        idx._short(x)
+                        for x in [q] + idx.chain(callee, block_taint)
+                    )
+                    report(
+                        m, "DYN-A001", call["line"], call["col"],
+                        f"indirect blocking call: {links} -> "
+                        f"`{root['name']}` "
+                        f"({idx.fn_module[idx.chain(callee, block_taint)[-1]]['path']}"
+                        f":{root['line']}) runs on the event loop; "
+                        f"{root['fix']}, or offload the helper with "
+                        "`asyncio.to_thread`")
+                if callee in io_taint and call["in_loop"]:
+                    root, _ = io_taint[callee]
+                    links = " -> ".join(
+                        idx._short(x)
+                        for x in [q] + idx.chain(callee, io_taint)
+                    )
+                    report(
+                        m, "DYN-A002", call["line"], call["col"],
+                        f"indirect sync file I/O per loop iteration: "
+                        f"{links} -> `open()` "
+                        f"({idx.fn_module[idx.chain(callee, io_taint)[-1]]['path']}"
+                        f":{root['line']}); move the I/O off the loop or "
+                        "use `asyncio.to_thread`")
+
+            if step_scope and callee in sync_taint:
+                root, _ = sync_taint[callee]
+                tail = idx.chain(callee, sync_taint)[-1]
+                links = " -> ".join(
+                    idx._short(x) for x in [q] + idx.chain(callee, sync_taint)
+                )
+                if call["in_loop"]:
+                    report(
+                        m, "DYN-J005", call["line"], call["col"],
+                        f"host-sync forcer reached through a helper chain "
+                        f"inside the step/accept loop: {links} -> "
+                        f"`{root['what']}` ({idx.fn_module[tail]['path']}:"
+                        f"{root['line']}) forces one device sync PER "
+                        "ITERATION of this loop; `jax.device_get` the "
+                        "whole batch once before the loop")
+                else:
+                    report(
+                        m, "DYN-J006", call["line"], call["col"],
+                        f"implicit device→host transfer hidden in a "
+                        f"helper reachable from the step loop: {links} -> "
+                        f"`{root['what']}` ({idx.fn_module[tail]['path']}:"
+                        f"{root['line']}); make the transfer an explicit "
+                        "bulk `device_get` at the step-loop level (the "
+                        "runtime sanitizer's transfer guard allowlists "
+                        "exactly those)")
+
+    # DYN-R007: static lock-acquisition-order cycles. Direct edges come
+    # from nested `with` blocks; cross-module edges from calls made while
+    # a lock is held into functions that (transitively) acquire more.
+    acq = idx.acquires_transitive()
+    lock_edges: Dict[Tuple[str, str], Tuple[Dict[str, Any], int]] = {}
+    for q, facts in idx.functions.items():
+        m = idx.fn_module[q]
+        for a in facts["acquires"]:
+            for held in a["held"]:
+                if held != a["lock"]:
+                    lock_edges.setdefault(
+                        (held, a["lock"]), (m, a["line"])
+                    )
+        for callee, call in idx.edges[q]:
+            if not call["held"]:
+                continue
+            for lock in acq.get(callee, ()):
+                for held in call["held"]:
+                    if held != lock:
+                        lock_edges.setdefault(
+                            (held, lock), (m, call["line"])
+                        )
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in lock_edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cyc = path + [start]
+                    lo = min(range(len(cyc) - 1), key=lambda i: cyc[i])
+                    canon = tuple(cyc[lo:-1] + cyc[:lo])
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    m, line = lock_edges[(cyc[0], cyc[1])]
+                    sites = "; ".join(
+                        f"{x} -> {y} ({lock_edges[(x, y)][0]['path']}:"
+                        f"{lock_edges[(x, y)][1]})"
+                        for x, y in zip(cyc, cyc[1:])
+                    )
+                    report(
+                        m, "DYN-R007", line, 0,
+                        f"lock-acquisition-order cycle: {sites} — two "
+                        "threads taking these locks in opposite orders "
+                        "deadlock; pick one global order (see "
+                        "docs/static_analysis.md)")
+                elif nxt not in path and len(path) < _MAX_CHAIN:
+                    stack.append((nxt, path + [nxt]))
+
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out

@@ -79,16 +79,22 @@ def parse_args(argv=None):
     p.add_argument("--disagg-role", default=None, choices=[None, "prefill", "decode", "both"])
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
+    p.add_argument("--sanitize", action="store_true",
+                   help="arm the runtime sanitizer (recompile tripwire, "
+                        "lock-order recorder, task/pool audits; same as "
+                        "DYN_SAN=1)")
     return p.parse_args(argv)
 
 
 def build_mock_engine(
-    args, timing=None, idle_sleep_s=None
+    args, timing=None, idle_sleep_s=None, sanitizer=None
 ) -> tuple[InferenceEngine, ModelCard]:
     """`timing` overrides the flag-derived SimTiming (calibrated fits from
     flight-recorder dumps); `idle_sleep_s` widens the engine thread's idle
     poll — a fleet simulator hosting hundreds of engine threads in one
-    process cannot afford 500 threads waking every 2 ms."""
+    process cannot afford 500 threads waking every 2 ms. `sanitizer` is a
+    pre-built (shared) runtime Sanitizer — fleet-sim passes one instance
+    for all workers."""
     if timing is None:
         timing = SimTiming(speed=args.speed, decode_base_s=args.decode_base_ms / 1000.0)
     runner = SimRunner(
@@ -123,6 +129,8 @@ def build_mock_engine(
         anomaly_k=getattr(args, "anomaly_k", 4.0),
         anomaly_dump_dir=getattr(args, "anomaly_dump_dir", None),
         anomaly_dump_last_n=getattr(args, "anomaly_dump_last_n", 256),
+        sanitize=getattr(args, "sanitize", None) or None,
+        sanitizer=sanitizer,
     )
     card = ModelCard(
         name=args.model_name,
@@ -162,6 +170,9 @@ async def async_main(args) -> None:
         disagg_role=args.disagg_role,
         digest_period_s=args.digest_period,
     )
+    san = engine.sanitizer
+    if san is not None:
+        san.start_watchdog()  # event-loop lag gauge for the serve loop
     print(f"mocker serving {card.name} at {args.namespace}/{args.component}/{args.endpoint}", flush=True)
     try:
         stop_ev = asyncio.Event()
@@ -181,6 +192,9 @@ async def async_main(args) -> None:
         if status is not None:
             await status.stop()
         await worker.stop()
+        if san is not None:
+            await san.stop_watchdog()
+            san.audit_tasks()  # leaked fire-and-forget tasks at shutdown
         await runtime.shutdown()
 
 

@@ -237,6 +237,9 @@ class FleetSim:
         host_kv_blocks: int = 0,  # G2 tier; auto-enabled by disk_kv_blocks
         disk_kv_blocks: int = 0,
         disk_kv_base: Optional[str] = None,  # per-worker roots under here
+        sanitize: bool = True,  # fleet-sim default harness: one shared
+        #   non-strict Sanitizer across all workers; run() reports its
+        #   block and chaos tests assert zero violations
     ):
         self.n_workers = n_workers
         self.router_mode = router_mode
@@ -278,10 +281,19 @@ class FleetSim:
         self._partitions: Dict[Any, float] = {}
         self._delays: Dict[Any, tuple] = {}  # key -> (until, seconds)
         self.fault_counts: Dict[str, int] = {}
+        self.sanitizer = None
+        if sanitize:
+            from dynamo_tpu.runtime.sanitizer import Sanitizer
+
+            # non-strict: chaos faults must play out and the report show
+            # every violation, not die on the first
+            self.sanitizer = Sanitizer(strict=False)
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
         rp.set_inproc_fault_hook(self._fault_hook)
+        if self.sanitizer is not None:
+            self.sanitizer.start_watchdog()
         for i in range(self.n_workers):
             await self._spawn_worker(i)
         frt = DistributedRuntime(
@@ -358,7 +370,8 @@ class FleetSim:
                       "--disk-kv-root", disk_root, "--kv-export-bytes"]
         margs = mocker_args(flags)
         engine, card = build_mock_engine(
-            margs, timing=self.timing, idle_sleep_s=self.idle_sleep_s)
+            margs, timing=self.timing, idle_sleep_s=self.idle_sleep_s,
+            sanitizer=self.sanitizer)
         digest_state: Dict[str, float] = {}
         served = await serve_worker(
             rt, engine, card, digest_period_s=self.digest_period_s)
@@ -391,6 +404,9 @@ class FleetSim:
                 except Exception:
                     log.debug("worker %d teardown failed", w.idx,
                               exc_info=True)
+        if self.sanitizer is not None:
+            await self.sanitizer.stop_watchdog()
+            self.sanitizer.audit_tasks()
         rp.set_inproc_fault_hook(None)
 
     # -- fault plane -------------------------------------------------------
@@ -509,7 +525,9 @@ class FleetSim:
         elif ev.kind == "delay":
             self.delay(ev.worker, dur, ev.param)
         elif ev.kind == "corrupt_kv":
-            self.corrupt_kv(idx, int(ev.param) or 4)
+            # disk truncation walks + rewrites tier files: off the loop,
+            # which carries every in-flight stream of the sim (DYN-A002)
+            await asyncio.to_thread(self.corrupt_kv, idx, int(ev.param) or 4)
         elif ev.kind in ("digest_drop", "digest_dup"):
             self.digest_fault(idx, ev.kind, dur)
 
@@ -597,4 +615,6 @@ class FleetSim:
             "faults": dict(self.fault_counts),
             "active_streams_after": self.active_streams(),
         }
+        if self.sanitizer is not None:
+            out["sanitizer"] = self.sanitizer.report()
         return out

@@ -102,6 +102,12 @@ class Planner:
     # -- one tick -----------------------------------------------------------
     async def tick(self, now: Optional[float] = None) -> Dict[str, int]:
         cfg = self.config
+        if cfg.hw_profile is not None and not hasattr(self, "_profile_fit"):
+            # one-time artifact read, off the loop: the tick path itself
+            # must never touch the filesystem (DYN-A002)
+            self._profile_fit = await asyncio.to_thread(
+                self._load_profile_fit
+            )
         loads = self.observer.loads(now)
         by_comp: Dict[str, List[WorkerLoad]] = {c: [] for c in cfg.components}
         for wl in loads:
@@ -162,21 +168,27 @@ class Planner:
         needed = predicted * cfg.headroom / per_replica
         return max(1, round(needed))
 
+    def _load_profile_fit(self) -> Dict[str, float]:
+        """Read + fit the hardware-profile artifact (blocking file I/O —
+        callers must run this off the event loop; tick() uses
+        `asyncio.to_thread` exactly once)."""
+        from dynamo_tpu.planner.hw_profile import load_profile, profile_fit
+
+        try:
+            return profile_fit(load_profile(self.config.hw_profile))
+        except Exception:
+            log.warning("hw profile %s unusable; ignoring",
+                        self.config.hw_profile, exc_info=True)
+            return {}
+
     def _profile_capacity(self, comp: str) -> float:
         """Measured per-replica capacity from the hardware profile
         artifact, per component (prefill workers are floored by prefill
-        throughput, decode by decode); 0.0 when none configured."""
+        throughput, decode by decode); 0.0 when none configured or not
+        yet loaded (tick() loads it before proposing)."""
         if self.config.hw_profile is None:
             return 0.0
-        if not hasattr(self, "_profile_fit"):
-            from dynamo_tpu.planner.hw_profile import load_profile, profile_fit
-
-            try:
-                self._profile_fit = profile_fit(load_profile(self.config.hw_profile))
-            except Exception:
-                log.warning("hw profile %s unusable; ignoring",
-                            self.config.hw_profile, exc_info=True)
-                self._profile_fit = {}
+        fit = getattr(self, "_profile_fit", {})
         key = ("prefill_capacity_tok_s" if "prefill" in comp
                else "decode_capacity_tok_s")
-        return float(self._profile_fit.get(key, 0.0))
+        return float(fit.get(key, 0.0))

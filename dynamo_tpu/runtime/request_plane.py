@@ -71,7 +71,22 @@ async def _recv_frame(reader: asyncio.StreamReader) -> Optional[Dict[str, Any]]:
     return msgpack.unpackb(body, raw=False)
 
 
-def _native_codec_on() -> bool:
+def _codec_available() -> bool:
+    """Probe (and on first call possibly BUILD) the native codec —
+    blocking: `frame_codec._load` shells out to the compiler once. Only
+    reached through `_native_codec_on`, which runs it off the loop."""
+    try:
+        from dynamo_tpu.native.frame_codec import available
+
+        return available()
+    except Exception:  # toolchain missing → Python path
+        return False
+
+
+_NATIVE_AVAILABLE: Optional[bool] = None
+
+
+async def _native_codec_on() -> bool:
     """C++ frame codec (reference zero_copy_decoder.rs role): bulk-read
     both plane read loops and split frames natively — one Python call per
     socket burst instead of two awaited readexactly() per frame. Same
@@ -80,18 +95,21 @@ def _native_codec_on() -> bool:
     single-core host (1.01-1.12x, docs/perf_notes.md), and the native
     splitter additionally stays off the GIL on multi-core frontends.
     DYN_NATIVE_CODEC=0 forces the pure-Python loop (and remains the
-    safety valve if a platform's build misbehaves)."""
+    safety valve if a platform's build misbehaves).
+
+    The env decision is re-read per call (tests flip it between planes);
+    the availability probe — which may invoke the COMPILER on first use —
+    runs in a thread exactly once, so the first connection no longer
+    stalls the event loop behind a cc invocation (DYN-A001)."""
     import os
 
     raw = os.environ.get("DYN_NATIVE_CODEC", "").lower()
     if raw in ("0", "false", "off", "no"):
         return False
-    try:
-        from dynamo_tpu.native.frame_codec import available
-
-        return available()
-    except Exception:  # toolchain missing → Python path
-        return False
+    global _NATIVE_AVAILABLE
+    if _NATIVE_AVAILABLE is None:
+        _NATIVE_AVAILABLE = await asyncio.to_thread(_codec_available)
+    return _NATIVE_AVAILABLE
 
 
 async def _bulk_frames(reader: asyncio.StreamReader, splitter, on_frame):
@@ -199,7 +217,7 @@ class PushEndpoint:
                     ctx.kill()
 
         try:
-            if _native_codec_on():
+            if await _native_codec_on():
                 from dynamo_tpu.native.frame_codec import NativeSplitter
 
                 await _bulk_frames(reader, NativeSplitter(), on_frame)
@@ -339,7 +357,7 @@ class _MuxConn:
                 await q.put(frame)
 
         try:
-            if _native_codec_on():
+            if await _native_codec_on():
                 from dynamo_tpu.native.frame_codec import NativeSplitter
 
                 await _bulk_frames(self._reader, NativeSplitter(), on_frame)

@@ -30,7 +30,9 @@ async def async_main(args) -> None:
     from dynamo_tpu.worker import build_engine
 
     configure_logging()
-    engine, card = build_engine(args)
+    # build off the loop: shm weight attach polls with time.sleep and the
+    # runner may compile — neither belongs on the event loop (DYN-A001)
+    engine, card = await asyncio.to_thread(build_engine, args)
     engine.start()
     server = EngineSidecarServer(
         engine, model_name=card.name, port=args.grpc_port

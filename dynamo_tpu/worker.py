@@ -193,6 +193,11 @@ def parse_args(argv=None):
     p.add_argument("--anomaly-profile-ms", type=int, default=0,
                    help="jax.profiler capture window on anomaly, in ms "
                         "(0 = off; traces land under the dump dir)")
+    p.add_argument("--sanitize", action="store_true",
+                   help="arm the runtime sanitizer: transfer_guard around "
+                        "steady-state dispatches, recompile tripwire, "
+                        "lock-order recorder, task/pool audits (DYN_SAN=1 "
+                        "is the env equivalent)")
     p.add_argument("--discovery-backend", default=None)
     p.add_argument("--discovery-root", default=None)
     p.add_argument("--request-plane", default=None, choices=[None, "tcp", "nats"],
@@ -454,6 +459,7 @@ def build_engine(args, runner=None) -> tuple[InferenceEngine, ModelCard]:
         spec_ngram=getattr(args, "spec_ngram", False),
         spec_k=getattr(args, "spec_k", 4),
         spec_max_tokens=getattr(args, "spec_max_tokens", 0),
+        sanitize=getattr(args, "sanitize", None) or None,
     )
     if getattr(args, "shm_weights", None) or args.orbax_cache:
         # RL weight hot-swap: after update_weights the WARM TIERS hold a
@@ -583,12 +589,14 @@ async def async_main(args) -> None:
 
         plane = mh.StepPlaneLeader(spec.step_port, spec.num_processes - 1)
         plane.wait_followers()
-        leader_runner, _ = build_runner(args)
-        engine, card = build_engine(
-            args, runner=mh.ReplicatingRunner(leader_runner, plane)
+        # weight load / shm attach polls and compiles: off the loop so
+        # startup never stalls heartbeats already running on it (DYN-A001)
+        leader_runner, _ = await asyncio.to_thread(build_runner, args)
+        engine, card = await asyncio.to_thread(
+            build_engine, args, runner=mh.ReplicatingRunner(leader_runner, plane)
         )
     else:
-        engine, card = build_engine(args)
+        engine, card = await asyncio.to_thread(build_engine, args)
     group_broken_box = [False]
     stop_box = []  # filled with (loop, stop_ev) once serving starts
     if plane is not None and hasattr(engine, "on_fatal"):

@@ -108,6 +108,10 @@ async def run(args) -> dict:
         )
     finally:
         await sim.stop()
+    if sim.sanitizer is not None:
+        # refresh after stop(): the teardown audits (leaked tasks, pool
+        # partition/refcounts) land in the report too
+        report["sanitizer"] = sim.sanitizer.report()
     report["seed"] = args.seed
     report["fault_schedule_events"] = len(schedule) if schedule else 0
     if calibration is not None:

@@ -18,6 +18,13 @@ and byte-identical tokens. Run:
     python scripts/bench_obs.py --fleet [--n-workers 4] \
         [--digest-period 0.5]
 
+`--sanitizer` A/Bs the runtime sanitizer (DYN_SAN) instead: the same
+deterministic trace with the engine's sanitizer armed vs off, recorder
+off in both arms. Acceptance (PR 13, docs/perf_notes.md): ITL p50 ratio
+under 1.05 and byte-identical tokens. Run:
+
+    python scripts/bench_obs.py --sanitizer
+
 Either mode prints one JSON line with {"on": {...}, "off": {...},
 "itl_p50_ratio": ..., "tokens_match": ...}.
 """
@@ -47,7 +54,7 @@ def _prompts(args):
     ]
 
 
-async def _run_arm(args, recorder_size: int) -> dict:
+async def _run_arm(args, recorder_size: int, sanitize: bool = False) -> dict:
     runner = SimRunner(
         num_pages=args.num_pages, page_size=args.page_size,
         max_pages_per_seq=args.max_pages_per_seq,
@@ -56,7 +63,7 @@ async def _run_arm(args, recorder_size: int) -> dict:
     )
     engine = InferenceEngine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
-        recorder_size=recorder_size,
+        recorder_size=recorder_size, sanitize=sanitize or None,
     )
     engine.start()
     itls: list = []
@@ -96,8 +103,12 @@ async def _run_arm(args, recorder_size: int) -> dict:
             ttfts.append(first - t0)
         itls.extend(steps)
     rec = engine.recorder
+    san = engine.sanitizer
+    if san is not None:
+        assert san.ok(), san.report()  # overhead of a CLEAN run only
     return {
         "recorder_size": recorder_size,
+        "sanitize": sanitize,
         "wall_s": round(wall, 4),
         "requests": len(outs),
         "output_tokens": sum(len(t) for t, _, _ in outs),
@@ -238,6 +249,28 @@ async def _main_fleet(args) -> dict:
     }
 
 
+async def _main_sanitizer(args) -> dict:
+    """Runtime-sanitizer steady-state cost on the mocker hot path (no
+    jax in-process, so this isolates the note_step / wrapped-lock /
+    scope-bookkeeping overhead the guard adds to EVERY engine, real or
+    simulated). Acceptance (PR 13): itl_p50_ratio < 1.05 and
+    byte-identical tokens."""
+    await _run_arm(args, recorder_size=0)  # warmup
+    on = await _run_arm(args, recorder_size=0, sanitize=True)
+    off = await _run_arm(args, recorder_size=0)
+    return {
+        "metric": "sanitizer_overhead",
+        "n_requests": args.n_requests,
+        "isl": args.isl,
+        "osl": args.osl,
+        "on": on,
+        "off": off,
+        "itl_p50_ratio": round(
+            on["itl_p50_s"] / max(off["itl_p50_s"], 1e-12), 4),
+        "tokens_match": on["tokens_sha256"] == off["tokens_sha256"],
+    }
+
+
 async def _main(args) -> dict:
     # interleave a warmup arm first so allocator/interpreter noise lands
     # outside the measured pair
@@ -275,11 +308,20 @@ def main() -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="measure the fleet digest plane (multi-worker "
                          "A/B) instead of the flight recorder")
+    ap.add_argument("--sanitizer", action="store_true",
+                    help="measure the runtime sanitizer (DYN_SAN) "
+                         "steady-state overhead instead")
     ap.add_argument("--n-workers", type=int, default=4)
     ap.add_argument("--digest-period", type=float, default=0.5,
                     help="digest publish period for the --fleet on-arm")
     args = ap.parse_args()
-    report = asyncio.run(_main_fleet(args) if args.fleet else _main(args))
+    if args.sanitizer:
+        run = _main_sanitizer(args)
+    elif args.fleet:
+        run = _main_fleet(args)
+    else:
+        run = _main(args)
+    report = asyncio.run(run)
     print(json.dumps(report))
     return 0 if report["tokens_match"] else 1
 

@@ -42,8 +42,8 @@ async def wait_ready(base, timeout=60.0):
                     body = await r.json()
                     if body.get("data"):
                         return
-            except Exception:
-                pass
+            except (OSError, aiohttp.ClientError, asyncio.TimeoutError):
+                pass  # server still starting; poll again
             await asyncio.sleep(0.5)
     raise RuntimeError("frontend never became ready")
 

@@ -48,7 +48,7 @@ def main() -> int:
         "test_dynlint.py", "test_flight_recorder.py",
         "test_fleet_observer.py", "test_spec_decode.py",
         "test_kv_tiers.py", "test_session_tree.py", "test_guided.py",
-        "test_fleet_sim.py", "test_chaos.py",
+        "test_fleet_sim.py", "test_chaos.py", "test_sanitizer.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -104,9 +104,25 @@ def main() -> int:
             print(detail.stdout + detail.stderr, file=sys.stderr)
     ok = ok and lint_ok
 
+    # runtime-sanitizer self-check (jax-free): the lock-cycle detector,
+    # allowlist rejection, and strict-raise plumbing must work before any
+    # --sanitize run or fleet-sim chaos test can be trusted
+    san_proc = subprocess.run(
+        [sys.executable, "-c",
+         "from dynamo_tpu.runtime.sanitizer import selftest; selftest()"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=args.timeout,
+    )
+    sanitizer_ok = san_proc.returncode == 0
+    if not sanitizer_ok:
+        print("TIER-1 CHECK FAILED: sanitizer selftest", file=sys.stderr)
+        print(san_proc.stdout + san_proc.stderr, file=sys.stderr)
+    ok = ok and sanitizer_ok
+
     print(json.dumps({"metric": "tier1_collection", "ok": ok,
                       "collected": collected, "errors": errors,
-                      "missing": missing, "lint_ok": lint_ok}))
+                      "missing": missing, "lint_ok": lint_ok,
+                      "sanitizer_ok": sanitizer_ok}))
     if not ok:
         # loud: surface the collection tracebacks so the broken import is
         # visible in CI logs, not just the count

@@ -2,9 +2,12 @@
 
 Checks async-safety (DYN-A), JAX trace hygiene / compile-key
 cardinality (DYN-J), and runtime robustness (DYN-R) invariants over the
-given paths (default: dynamo_tpu/). Violations already recorded in the
-committed baseline (lint_baseline.json) are legacy debt and pass; any
-NEW violation fails. The ratchet only goes down: when you fix legacy
+given paths (default: dynamo_tpu/ AND scripts/), including the
+project-wide interprocedural pass (call-graph taint: DYN-A001/A002/J005
+through helper chains, plus DYN-J006/R007/A006 — see
+docs/static_analysis.md). Violations already recorded in the committed
+baseline (lint_baseline.json) are legacy debt and pass; any NEW
+violation fails. The ratchet only goes down: when you fix legacy
 findings, run --update-baseline and commit the shrunken file.
 
     python scripts/dynlint.py dynamo_tpu/            # gate (exit 1 on new)
@@ -40,7 +43,8 @@ DEFAULT_BASELINE = os.path.join(REPO, "lint_baseline.json")
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None,
-                    help="files/dirs to lint (default: dynamo_tpu/)")
+                    help="files/dirs to lint (default: dynamo_tpu/ and "
+                         "scripts/)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file (default: lint_baseline.json)")
     ap.add_argument("--no-baseline", action="store_true",
@@ -51,10 +55,21 @@ def main() -> int:
                     help="emit one JSON summary line (bench/PROGRESS mode)")
     ap.add_argument("--all", action="store_true",
                     help="print all findings, not just new-vs-baseline")
+    ap.add_argument("--no-project", action="store_true",
+                    help="skip the interprocedural project pass")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the mtime result cache")
+    ap.add_argument("--cache", default=os.path.join(
+                        REPO, ".dynlint_cache.json"),
+                    help="mtime-keyed result cache path")
     args = ap.parse_args()
 
-    paths = args.paths or [os.path.join(REPO, "dynamo_tpu")]
-    violations = lint_paths(paths, root=REPO)
+    paths = args.paths or [os.path.join(REPO, "dynamo_tpu"),
+                           os.path.join(REPO, "scripts")]
+    violations = lint_paths(
+        paths, root=REPO, project=not args.no_project,
+        cache_path=None if args.no_cache else args.cache,
+    )
     per_rule: dict = {}
     for v in violations:
         per_rule[v.rule] = per_rule.get(v.rule, 0) + 1

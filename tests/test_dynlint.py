@@ -584,13 +584,17 @@ def test_json_and_human_output_shapes():
 
 def test_repo_is_clean_against_committed_baseline():
     """The tree must carry no dynlint findings beyond lint_baseline.json —
-    the same ratchet check_tier1.py enforces, runnable from pytest."""
+    the same ratchet check_tier1.py enforces, runnable from pytest. Scope
+    matches the dynlint default: the package AND scripts/."""
     import os
 
     repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
     with open(os.path.join(repo, "lint_baseline.json")) as f:
         baseline = json.load(f)["counts"]
-    vs = lint_paths([os.path.join(repo, "dynamo_tpu")], root=repo)
+    vs = lint_paths(
+        [os.path.join(repo, "dynamo_tpu"), os.path.join(repo, "scripts")],
+        root=repo,
+    )
     new, regressed, _fixed = diff_against_baseline(vs, baseline)
     assert not new and not regressed, (
         "new dynlint violations (fix them or, for true-but-accepted "
@@ -598,6 +602,310 @@ def test_repo_is_clean_against_committed_baseline():
         + "\n".join(f"{v.path}:{v.line} {v.rule} {v.message}"
                     for v in new + regressed)
     )
+
+
+# -- interprocedural (project) pass: cross-module fixture packages ----------
+#
+# Each fixture seeds a violation that is INVISIBLE to the per-file pass —
+# the blocking call / host sync / lock order lives in a different function
+# or module than the site where the rule fires — and asserts both halves:
+# the project pass reports it, the per-file pass (project=False, and
+# lint_file on each file alone) does not.
+
+
+def _write_pkg(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path / "pkg")
+
+
+def _plint(tmp_path, files, **kw):
+    return lint_paths([_write_pkg(tmp_path, files)], root=str(tmp_path), **kw)
+
+
+_CHAIN_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/helpers.py": """
+        import time
+
+
+        def deep_wait():
+            time.sleep(0.2)
+
+
+        def mid():
+            return deep_wait()
+
+
+        def read_rows(path):
+            with open(path) as f:
+                return f.read()
+    """,
+    # relative import: exercises _ProjectModuleIndex's level handling
+    "pkg/svc.py": """
+        from . import helpers
+
+
+        async def handler(paths):
+            helpers.mid()
+            out = []
+            for p in paths:
+                out.append(helpers.read_rows(p))
+            return out
+    """,
+}
+
+
+def test_project_a001_two_hop_blocking_chain(tmp_path):
+    vs = _plint(tmp_path, _CHAIN_PKG)
+    a001 = [v for v in vs if v.rule == "DYN-A001"]
+    assert len(a001) == 1
+    v = a001[0]
+    assert v.path == "pkg/svc.py"
+    assert "svc.handler -> helpers.mid -> helpers.deep_wait" in v.message
+    assert "`time.sleep`" in v.message
+    assert "pkg/helpers.py" in v.message  # points at the taint root
+
+
+def test_project_a002_indirect_file_io_in_loop(tmp_path):
+    vs = _plint(tmp_path, _CHAIN_PKG)
+    a002 = [v for v in vs if v.rule == "DYN-A002"]
+    assert len(a002) == 1
+    assert a002[0].path == "pkg/svc.py"
+    assert "helpers.read_rows -> `open()`" in a002[0].message
+
+
+def test_project_findings_invisible_to_per_file_pass(tmp_path):
+    """The same package, per-file only: nothing fires. This is the whole
+    point of the project pass — one helper hop blinds the per-file rules."""
+    vs = _plint(tmp_path, _CHAIN_PKG, project=False)
+    assert [v.rule for v in vs] == []
+    for rel, src in _CHAIN_PKG.items():
+        assert _rules(src, path=rel) == []
+
+
+_STEP_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/readers.py": """
+        def fetch_token(seq):
+            return seq.tok.item()
+
+
+        def fetch_meta(plan):
+            return plan.meta.tolist()
+    """,
+    "pkg/engine.py": """
+        from pkg import readers
+
+
+        class Engine:
+            def _run_decode(self, plan):
+                out = []
+                for seq in plan.seqs:
+                    out.append(readers.fetch_token(seq))
+                total = readers.fetch_meta(plan)
+                return out, total
+    """,
+}
+
+
+def test_project_j005_j006_hidden_host_sync(tmp_path):
+    """`.item()` buried one module away from the step loop: per-iteration
+    sync (in the loop) is J005, once-per-step hidden transfer is J006."""
+    vs = _plint(tmp_path, _STEP_PKG)
+    j005 = [v for v in vs if v.rule == "DYN-J005"]
+    j006 = [v for v in vs if v.rule == "DYN-J006"]
+    assert len(j005) == 1 and len(j006) == 1
+    assert j005[0].path == "pkg/engine.py"
+    assert "PER ITERATION" in j005[0].message
+    assert "readers.fetch_token" in j005[0].message
+    assert "`.item()`" in j005[0].message
+    assert "readers.fetch_meta" in j006[0].message
+    assert "`.tolist()`" in j006[0].message
+    assert j005[0].line < j006[0].line  # loop call sits above the bulk call
+    # invisible per-file: readers.py is not engine code, engine.py never
+    # touches a sync forcer directly
+    assert [v.rule for v in _plint(tmp_path, _STEP_PKG, project=False)] == []
+
+
+_LOCK_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/alpha.py": """
+        import threading
+
+        from pkg import beta
+
+        a_lock = threading.Lock()
+
+
+        def take_a_then_b():
+            with a_lock:
+                beta.grab_b()
+
+
+        def grab_a():
+            with a_lock:
+                return 1
+    """,
+    "pkg/beta.py": """
+        import threading
+
+        from pkg import alpha
+
+        b_lock = threading.Lock()
+
+
+        def grab_b():
+            with b_lock:
+                return 2
+
+
+        def take_b_then_a():
+            with b_lock:
+                alpha.grab_a()
+    """,
+}
+
+
+def test_project_r007_cross_module_lock_cycle(tmp_path):
+    """alpha holds a_lock and calls into beta (which takes b_lock); beta
+    holds b_lock and calls into alpha (which takes a_lock). No single file
+    ever nests the two `with` blocks — only the call graph sees the cycle."""
+    vs = _plint(tmp_path, _LOCK_PKG)
+    r007 = [v for v in vs if v.rule == "DYN-R007"]
+    assert len(r007) == 1
+    msg = r007[0].message
+    assert "lock-acquisition-order cycle" in msg
+    assert "pkg.alpha.a_lock" in msg and "pkg.beta.b_lock" in msg
+    assert [v.rule for v in _plint(tmp_path, _LOCK_PKG, project=False)] == []
+
+
+def test_project_a001_through_package_reexport(tmp_path):
+    """`pkg/__init__.py` forwards impl.slow_helper; the caller only ever
+    sees `pkg.slow_helper`. Alias resolution must follow the re-export."""
+    vs = _plint(tmp_path, {
+        "pkg/__init__.py": """
+            from pkg.impl import slow_helper
+        """,
+        "pkg/impl.py": """
+            import time
+
+
+            def slow_helper():
+                time.sleep(0.5)
+        """,
+        "pkg/app.py": """
+            import pkg
+
+
+            async def handler():
+                pkg.slow_helper()
+        """,
+    })
+    a001 = [v for v in vs if v.rule == "DYN-A001"]
+    assert len(a001) == 1
+    assert a001[0].path == "pkg/app.py"
+    assert "impl.slow_helper" in a001[0].message
+
+
+def test_project_a006_coroutine_dropped_across_modules(tmp_path):
+    vs = _plint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/jobs.py": """
+            async def refresh(cache):
+                cache.clear()
+        """,
+        "pkg/svc.py": """
+            from pkg import jobs
+
+
+            def kick(cache):
+                jobs.refresh(cache)
+        """,
+    })
+    a006 = [v for v in vs if v.rule == "DYN-A006"]
+    assert len(a006) == 1
+    v = a006[0]
+    assert v.path == "pkg/svc.py"
+    assert "coroutine" in v.message and "never awaited" in v.message
+    assert "another module" in v.message and "pkg/jobs.py" in v.message
+
+
+def test_project_suppression_applies_at_reporting_site(tmp_path):
+    """Inline suppression on the call site kills the finding; a
+    suppression on the taint ROOT (the helper module) does not — the
+    finding belongs to the file where it is reported."""
+    suppressed = dict(_CHAIN_PKG)
+    suppressed["pkg/svc.py"] = """
+        from . import helpers
+
+
+        async def handler(paths):
+            helpers.mid()  # dynlint: disable=DYN-A001 — admission boundary
+            out = []
+            for p in paths:
+                out.append(helpers.read_rows(p))  # dynlint: disable=DYN-A002
+            return out
+    """
+    assert [v.rule for v in _plint(tmp_path, suppressed)] == []
+
+    root_suppressed = dict(_CHAIN_PKG)
+    root_suppressed["pkg/helpers.py"] = (
+        "# dynlint: disable-file=DYN-A001\n"
+        + textwrap.dedent(_CHAIN_PKG["pkg/helpers.py"])
+    )
+    rules = [v.rule for v in _plint(tmp_path, root_suppressed)]
+    assert "DYN-A001" in rules  # root-file suppression does NOT inherit
+
+
+def test_project_file_suppression_in_reporting_module(tmp_path):
+    files = dict(_CHAIN_PKG)
+    files["pkg/svc.py"] = (
+        "# dynlint: disable-file=DYN-A001\n"
+        "# dynlint: disable-file=DYN-A002\n"
+        + textwrap.dedent(_CHAIN_PKG["pkg/svc.py"])
+    )
+    assert [v.rule for v in _plint(tmp_path, files)] == []
+
+
+def test_lint_paths_cache_preserves_and_invalidates(tmp_path):
+    """satellite 5: the mtime-keyed cache must (a) produce identical
+    findings on a fully-cached re-run — including PROJECT findings, whose
+    facts ride in the cache — and (b) drop stale entries when a file
+    changes."""
+    import os
+
+    pkgdir = _write_pkg(tmp_path, _CHAIN_PKG)
+    cache = str(tmp_path / "cache.json")
+    key = lambda vs: [(v.rule, v.path, v.line) for v in vs]
+
+    vs1 = lint_paths([pkgdir], root=str(tmp_path), cache_path=cache)
+    assert os.path.exists(cache)
+    assert "DYN-A001" in [v.rule for v in vs1]
+
+    vs2 = lint_paths([pkgdir], root=str(tmp_path), cache_path=cache)
+    assert key(vs2) == key(vs1)  # cached facts still feed the project pass
+
+    # fix the root: the chain is broken, cached entry must be invalidated
+    helper = tmp_path / "pkg" / "helpers.py"
+    helper.write_text(textwrap.dedent("""
+        def deep_wait():
+            return 0
+
+
+        def mid():
+            return deep_wait()
+
+
+        def read_rows(path):
+            return path
+    """))
+    st = os.stat(helper)
+    os.utime(helper, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    vs3 = lint_paths([pkgdir], root=str(tmp_path), cache_path=cache)
+    assert [v.rule for v in vs3] == []
 
 
 # -- satellite 3: planes degrade gracefully after except-narrowing ----------

@@ -571,17 +571,19 @@ def test_native_codec_splitter_roundtrip():
     assert got == frames
 
 
-def test_native_codec_default_on(monkeypatch):
+async def test_native_codec_default_on(monkeypatch):
     """The native codec defaults ON when the toolchain is available
     (bench_codec A/B: native ahead on every run, docs/perf_notes.md);
-    DYN_NATIVE_CODEC=0 is the opt-out safety valve."""
+    DYN_NATIVE_CODEC=0 is the opt-out safety valve. The probe is async
+    since PR 13 — first use may invoke the compiler, which now runs in
+    a thread instead of stalling the event loop."""
     from dynamo_tpu.native.frame_codec import available
     from dynamo_tpu.runtime.request_plane import _native_codec_on
 
     monkeypatch.delenv("DYN_NATIVE_CODEC", raising=False)
-    assert _native_codec_on() == available()
+    assert await _native_codec_on() == available()
     monkeypatch.setenv("DYN_NATIVE_CODEC", "0")
-    assert _native_codec_on() is False
+    assert await _native_codec_on() is False
 
 
 async def test_native_codec_rpc_e2e(monkeypatch):
