@@ -237,6 +237,8 @@ class ExtProcServer:
         return self.port
 
     async def stop(self) -> None:
-        if self._server is not None:
-            await self._server.stop(grace=5)
-            self._server = None
+        # claim before the await: a concurrent stop() sees None instead of
+        # double-stopping the server (DYN-A007)
+        server, self._server = self._server, None
+        if server is not None:
+            await server.stop(grace=5)

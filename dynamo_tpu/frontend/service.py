@@ -57,9 +57,10 @@ class ModelEntry:
     async def close(self) -> None:
         if self.teardown is not None:
             await self.teardown()
-        if self.prefill_kv_router is not None:
-            await self.prefill_kv_router.stop()
-            self.prefill_kv_router = None
+        # claim before the await so a concurrent close() can't double-stop
+        router, self.prefill_kv_router = self.prefill_kv_router, None
+        if router is not None:
+            await router.stop()
         if self.prefill_client is not None:
             await self.prefill_client.close()
         if self.owns_client:

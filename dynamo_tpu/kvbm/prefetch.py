@@ -163,8 +163,19 @@ class PrefetchManager:
                 continue  # already warm or already being promoted
             parent = parents[i] if i < len(parents) else None
             parent = int(parent) if parent is not None else None
-            self._jobs[h] = _Job(h, parent, now, now + self.hint_ttl_s)
-            self._queue.append(h)
+            job = _Job(h, parent, now, now + self.hint_ttl_s)
+            if h in self._reading:
+                # a TTL-expired job's disk read is still in flight: adopt
+                # it instead of queueing a second read. Double-dispatch is
+                # worse than wasteful — DiskKvPool pins are a set, so the
+                # first completion's unpin strips eviction protection from
+                # the second read mid-flight, and the collapsed _reading
+                # entry breaks the max_inflight gate (found by dynmc, spec
+                # prefetch_ttl; regression schedule committed)
+                job.state = READING
+            else:
+                self._queue.append(h)
+            self._jobs[h] = job
             self.stats["hinted_blocks"] += 1
         self._pump()
 

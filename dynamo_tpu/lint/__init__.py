@@ -23,6 +23,7 @@ from dynamo_tpu.lint.core import (
 )
 from dynamo_tpu.lint.project import (
     ProjectIndex,
+    atomicity_hazards,
     extract_module_facts,
     project_violations,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "baseline_counts",
     "diff_against_baseline",
     "ProjectIndex",
+    "atomicity_hazards",
     "extract_module_facts",
     "project_violations",
 ]

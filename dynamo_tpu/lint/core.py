@@ -652,7 +652,8 @@ def lint_paths(paths: Iterable[str],
                rules: Optional[Sequence[Rule]] = None,
                root: Optional[str] = None,
                project: bool = True,
-               cache_path: Optional[str] = None) -> List[Violation]:
+               cache_path: Optional[str] = None,
+               stats: Optional[Dict[str, int]] = None) -> List[Violation]:
     """Lint files/trees: the per-file rule pass plus (by default) the
     interprocedural project pass over everything collected
     (`dynamo_tpu/lint/project.py`).
@@ -662,6 +663,10 @@ def lint_paths(paths: Iterable[str],
     facts, so the project-wide pass stays cheap enough for tier-1 (only
     edited files re-parse; linking is pure dict work). The cache is only
     consulted for the default rule set — custom `rules` bypass it.
+
+    `stats`, when given, is filled in place with `cache_hits` /
+    `cache_misses` counts (misses include uncacheable runs), so the CLI
+    can surface whether the gate actually ran warm.
     """
     from dynamo_tpu.lint.project import (
         extract_module_facts,
@@ -670,6 +675,9 @@ def lint_paths(paths: Iterable[str],
 
     cacheable = rules is None and cache_path is not None
     cache = _load_cache(cache_path) if cacheable else {}
+    if stats is not None:
+        stats.setdefault("cache_hits", 0)
+        stats.setdefault("cache_misses", 0)
     out: List[Violation] = []
     facts: List[Dict[str, Any]] = []
     new_cache: Dict[str, Any] = {}
@@ -697,11 +705,15 @@ def lint_paths(paths: Iterable[str],
             if hit is not None and hit.get("stamp") == stamp:
                 vs = [Violation(**d) for d in hit["violations"]]
                 mf = hit["facts"]
+                if stats is not None:
+                    stats["cache_hits"] += 1
             else:
                 with open(f, encoding="utf-8") as fh:
                     source = fh.read()
                 vs = lint_file(f, rules=rules, source=source, rel_path=rel)
                 mf = extract_module_facts(rel, source) if project else None
+                if stats is not None:
+                    stats["cache_misses"] += 1
             out.extend(vs)
             if mf is not None:
                 facts.append(mf)

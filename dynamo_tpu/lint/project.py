@@ -39,7 +39,22 @@ lint scope:
    - DYN-A006 for a coroutine (or spawned-task handle) created by
      calling a project `async def` as a bare statement — the coroutine
      is never awaited, so the body never runs; cross-module creation is
-     the case per-file DYN-A004 cannot see.
+     the case per-file DYN-A004 cannot see,
+   - DYN-A007 for a check-then-act span that crosses an `await`: an
+     `if`/`while` test reads `self.x`, the guarded body suspends, and
+     the same attribute is written after the suspension — any other
+     coroutine scheduled during the await can invalidate the check
+     (double-init, double-apply, lost update),
+   - DYN-R008 for instance state written under a threading lock in one
+     function but written lock-free from an `async def` elsewhere — the
+     lock documents cross-thread sharing, so the unlocked async write
+     races the locked writers.
+
+Both atomicity rules double as *dynamic seeds*: `atomicity_hazards()`
+exports the flagged sites (including suppressed ones — a suppression is
+a claim of safety, which is exactly what a model checker should try to
+refute) and `dynamo_tpu/mc` prioritizes those functions' yield points
+when exploring interleavings (docs/concurrency.md).
 
 Findings are ordinary `Violation`s and respect the same inline
 suppression comments as the per-file rules, evaluated in the file where
@@ -63,11 +78,12 @@ __all__ = [
     "extract_module_facts",
     "ProjectIndex",
     "project_violations",
+    "atomicity_hazards",
     "module_name_for",
 ]
 
 # bump to invalidate cached facts when the extraction schema changes
-FACTS_VERSION = 1
+FACTS_VERSION = 2  # v2: guard-span ("guards") + attr-write ("writes") facts
 
 _LOCK_NAME_RE = re.compile(r"(^|_)r?lock$")
 
@@ -83,6 +99,13 @@ _SPAWN_TAILS = (".create_task", ".ensure_future")
 _HOT_PREFIXES = ("_run_decode", "_run_mixed", "_run_spec", "_run_prefill")
 
 _MAX_CHAIN = 12  # taint-chain hop bound (also the re-export hop bound)
+
+# collection mutators that count as a *write* to the receiving attribute
+# for the atomicity facts (DYN-A007 / DYN-R008)
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "update", "extend", "insert", "setdefault",
+})
 
 
 def module_name_for(rel_path: str) -> str:
@@ -142,6 +165,7 @@ class _FactsVisitor(ast.NodeVisitor):
         self._fn_stack: List[Dict[str, Any]] = []
         self._loop_depth: List[int] = []
         self._held: List[str] = []  # lock ids currently held (lexical)
+        self._async_held = 0  # depth of `async with <asyncio lock>` scopes
         self._awaited: Set[int] = set()
         self._bare: Set[int] = set()
 
@@ -165,6 +189,8 @@ class _FactsVisitor(ast.NodeVisitor):
             "transfers": [],
             "acquires": [],
             "returns_spawn": False,
+            "guards": [],   # check-then-act spans crossing an await (A007)
+            "writes": [],   # self.attr writes w/ lock + async context (R008)
         }
         # nested defs (closures) keep attributing to the OUTER function:
         # their body runs, at the latest, when the outer scope calls them
@@ -190,7 +216,127 @@ class _FactsVisitor(ast.NodeVisitor):
 
     visit_For = _visit_loop
     visit_AsyncFor = _visit_loop
-    visit_While = _visit_loop
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_guard(node)
+        self._visit_loop(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_guard(node)
+        self.generic_visit(node)
+
+    # -- atomicity facts (DYN-A007 / DYN-R008) ------------------------------
+    @staticmethod
+    def _self_attr(expr: ast.AST) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return expr.attr
+        return None
+
+    def _stmt_writes(self, sub: ast.AST):
+        """Yield (attr, pos) for every write a single AST node performs on
+        `self.<attr>`: assignment, augmented assignment, item assignment or
+        deletion, and in-place collection mutators."""
+        pos = (getattr(sub, "lineno", 0), getattr(sub, "col_offset", 0))
+        if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if isinstance(sub, ast.AnnAssign) and sub.value is None:
+                return  # bare annotation, no store
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Starred):
+                        e = e.value
+                    if isinstance(e, ast.Subscript):
+                        e = e.value
+                    attr = self._self_attr(e)
+                    if attr is not None:
+                        yield attr, pos
+        elif isinstance(sub, ast.Delete):
+            for t in sub.targets:
+                if isinstance(t, ast.Subscript):
+                    t = t.value
+                attr = self._self_attr(t)
+                if attr is not None:
+                    yield attr, pos
+        elif (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _MUTATORS):
+            attr = self._self_attr(sub.func.value)
+            if attr is not None:
+                yield attr, pos
+
+    def _check_guard(self, node) -> None:
+        """DYN-A007 fact: the test reads `self.x`, the guarded body
+        suspends at an `await`, and `self.x` is written after the
+        suspension point. A write *before* the first await (the
+        cache-then-fill idiom) is atomic with the check and stays clean,
+        as does a span serialized by an `async with` lock."""
+        facts = self._fn_stack[-1] if self._fn_stack else None
+        if facts is None or not facts["is_async"] or self._async_held:
+            return
+        guard_attrs = {
+            n.attr for n in ast.walk(node.test)
+            if self._self_attr(n) is not None
+            and isinstance(n.ctx, ast.Load)
+        }
+        if not guard_attrs:
+            return
+        awaits: List[Tuple[int, int]] = []
+        writes: List[Tuple[Tuple[int, int], str]] = []
+
+        def scan(sub: ast.AST) -> None:
+            if isinstance(sub, ast.ExceptHandler):
+                # a write in an except handler compensates a FAILED await
+                # (the rollback idiom) — it is not the "act" half
+                return
+            if isinstance(sub, ast.Await):
+                awaits.append((sub.lineno, sub.col_offset))
+            for attr, pos in self._stmt_writes(sub):
+                if attr in guard_attrs:
+                    writes.append((pos, attr))
+            for child in ast.iter_child_nodes(sub):
+                scan(child)
+
+        for stmt in node.body:
+            scan(stmt)
+        if not awaits:
+            return
+        first_await = min(awaits)
+        late = [(pos, attr) for pos, attr in writes if pos > first_await]
+        if not late:
+            return
+        pos, attr = min(late)
+        facts["guards"].append({
+            "attr": attr,
+            "line": node.lineno,
+            "col": node.col_offset,
+            "await_line": first_await[0],
+            "write_line": pos[0],
+        })
+
+    def _record_writes(self, node: ast.AST) -> None:
+        facts = self._fn_stack[-1] if self._fn_stack else None
+        if facts is None:
+            return
+        for attr, pos in self._stmt_writes(node):
+            facts["writes"].append({
+                "attr": attr,
+                "line": pos[0],
+                "col": pos[1],
+                "locks": list(self._held),
+                "async_locked": self._async_held > 0,
+            })
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._record_writes(node)
+        self.generic_visit(node)
+
+    visit_AugAssign = visit_Assign
+    visit_AnnAssign = visit_Assign
+    visit_Delete = visit_Assign
 
     # -- locks -------------------------------------------------------------
     def _lock_id(self, expr: ast.AST) -> Optional[str]:
@@ -217,8 +363,21 @@ class _FactsVisitor(ast.NodeVisitor):
                 return resolved
         return None
 
+    def _is_async_lock(self, expr: ast.AST) -> bool:
+        """`async with <this>` serializes coroutines: known asyncio-lock
+        bindings, or (since a threading lock cannot appear in an `async
+        with` anyway) anything lock-named."""
+        if isinstance(expr, ast.Name):
+            return (expr.id in self.index.async_lock_names
+                    or bool(_LOCK_NAME_RE.search(expr.id)))
+        if isinstance(expr, ast.Attribute):
+            return (expr.attr in self.index.async_lock_attrs
+                    or bool(_LOCK_NAME_RE.search(expr.attr)))
+        return False
+
     def _visit_with(self, node) -> None:
         acquired: List[str] = []
+        async_acquired = 0
         if not isinstance(node, ast.AsyncWith):
             for item in node.items:
                 lock = self._lock_id(item.context_expr)
@@ -230,7 +389,13 @@ class _FactsVisitor(ast.NodeVisitor):
                     })
                     self._held.append(lock)
                     acquired.append(lock)
+        else:
+            for item in node.items:
+                if self._is_async_lock(item.context_expr):
+                    async_acquired += 1
+        self._async_held += async_acquired
         self.generic_visit(node)
+        self._async_held -= async_acquired
         for _ in acquired:
             self._held.pop()
 
@@ -257,6 +422,7 @@ class _FactsVisitor(ast.NodeVisitor):
 
     # -- the leaf event ----------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:
+        self._record_writes(node)  # in-place collection mutators
         facts = self._fn_stack[-1] if self._fn_stack else None
         if facts is not None:
             name = self.index.resolve(node.func)
@@ -475,6 +641,64 @@ def _in_step_scope(m: Dict[str, Any], facts: Dict[str, Any]) -> bool:
             or n.startswith(_HOT_PREFIXES))
 
 
+def _a007_sites(idx: "ProjectIndex"):
+    """(module, facts, guard) per check-then-act-across-await span."""
+    for q, facts in idx.functions.items():
+        m = idx.fn_module[q]
+        for g in facts.get("guards", ()):
+            yield m, facts, g
+
+
+def _r008_sites(idx: "ProjectIndex"):
+    """(module, facts, write, locked_example) per lock-free async write to
+    an attribute that some function writes under a threading lock. The
+    state key is (module, class, attr) — attribute names don't collide
+    across modules/classes the way bare names would."""
+    by_state: Dict[Tuple[str, Optional[str], str], List[Any]] = {}
+    for q, facts in idx.functions.items():
+        m = idx.fn_module[q]
+        for w in facts.get("writes", ()):
+            key = (m["module"], facts["cls"], w["attr"])
+            by_state.setdefault(key, []).append((q, facts, m, w))
+    for key in sorted(by_state, key=lambda k: (k[0], k[1] or "", k[2])):
+        ws = by_state[key]
+        locked = [x for x in ws if x[3]["locks"]]
+        if not locked:
+            continue
+        for q, facts, m, w in ws:
+            if w["locks"] or w["async_locked"]:
+                continue
+            if not facts["is_async"] or facts["name"] == "__init__":
+                continue
+            yield m, facts, w, locked[0]
+
+
+def atomicity_hazards(
+    modules: Iterable[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """DYN-A007/R008 sites as plain dicts — the dynamic-exploration seeds
+    for `dynamo_tpu/mc`. Suppressions are deliberately NOT applied here:
+    an inline suppression is a human claim that the span is safe, and a
+    claimed-safe interleaving is precisely what the model checker should
+    spend its budget trying to refute."""
+    idx = ProjectIndex(modules)
+    out: List[Dict[str, Any]] = []
+    for m, facts, g in _a007_sites(idx):
+        out.append({
+            "rule": "DYN-A007", "path": m["path"], "module": m["module"],
+            "cls": facts["cls"], "fn": facts["name"], "attr": g["attr"],
+            "line": g["line"],
+        })
+    for m, facts, w, _locked in _r008_sites(idx):
+        out.append({
+            "rule": "DYN-R008", "path": m["path"], "module": m["module"],
+            "cls": facts["cls"], "fn": facts["name"], "attr": w["attr"],
+            "line": w["line"],
+        })
+    out.sort(key=lambda h: (h["path"], h["line"], h["rule"]))
+    return out
+
+
 def _suppressed(m: Dict[str, Any], rule: str, line: int) -> bool:
     sup_file = set(m.get("suppress_file", ()))
     if rule in sup_file or "*" in sup_file:
@@ -583,6 +807,36 @@ def project_violations(
                         "bulk `device_get` at the step-loop level (the "
                         "runtime sanitizer's transfer guard allowlists "
                         "exactly those)")
+
+    # DYN-A007: check-then-act across an await — the guard's truth can
+    # change while the body is suspended
+    for m, facts, g in _a007_sites(idx):
+        report(
+            m, "DYN-A007", g["line"], g["col"],
+            f"check-then-act on `self.{g['attr']}` spans an `await` "
+            f"(line {g['await_line']}): the test result can be "
+            f"invalidated by any coroutine scheduled during the "
+            f"suspension, and the write at line {g['write_line']} then "
+            "applies a stale decision (double-init / double-apply / "
+            "lost update); re-check after the await, write BEFORE the "
+            "first await, or serialize the span with an asyncio.Lock — "
+            "this site is a prioritized dynmc yield point "
+            "(docs/concurrency.md)")
+
+    # DYN-R008: lock-protected state also written lock-free from async
+    # context — the lock proves cross-thread sharing, so the unlocked
+    # write races the locked writers
+    for m, facts, w, (lq, _lf, lm, lw) in _r008_sites(idx):
+        lock_tail = lw["locks"][0].rsplit(".", 1)[-1]
+        report(
+            m, "DYN-R008", w["line"], w["col"],
+            f"`self.{w['attr']}` is written under `{lock_tail}` in "
+            f"`{idx._short(lq)}` ({lm['path']}:{lw['line']}) but written "
+            "lock-free here from async context; the lock exists because "
+            "another thread touches this state, so this write races it — "
+            "take the same lock, or move the mutation onto the owning "
+            "thread (this site seeds dynmc exploration, "
+            "docs/concurrency.md)")
 
     # DYN-R007: static lock-acquisition-order cycles. Direct edges come
     # from nested `with` blocks; cross-module edges from calls made while

@@ -1,0 +1,55 @@
+"""dynmc — deterministic-schedule concurrency model checker.
+
+The control plane is a stack of asyncio protocol state machines
+(admission queue hand-offs, KV prefetch promotion, indexer resync,
+discovery churn, migration retries). pytest observes exactly ONE
+interleaving of those coroutines per run — whichever order the wall
+clock happens to produce. dynmc removes the wall clock: protocol specs
+run the *real production coroutines* on a virtual-clock event loop
+(`vloop.VirtualLoop`) where every ready callback, timer expiry, and
+injected fault is a schedulable choice, and an explorer
+(`explorer.Explorer`) enumerates the choice tree:
+
+- schedules are plain decision-index lists, so any run replays
+  deterministically from its schedule id;
+- a DPOR-style reduction (`footprint.py`) prunes orderings of actions
+  whose declared shared-state footprints are disjoint;
+- faults (`faults.py`) — task cancel, peer death, slow plane — appear
+  as extra one-shot candidates at every branch point;
+- failures shrink (`shrink.py`) to a minimal schedule that is committed
+  as a regression spec and replayed in tier-1;
+- the static pass seeds the search: DYN-A007/R008 sites from
+  `dynamo_tpu.lint.project.atomicity_hazards` mark the functions whose
+  yield points the explorer perturbs first.
+
+See docs/concurrency.md for the architecture and a spec-writing guide;
+`scripts/dynmc.py` is the CLI (smoke tier in check_tier1, `--deep` for
+the full budget).
+"""
+
+from dynamo_tpu.mc.explorer import ExploreResult, Explorer, RunResult, Scheduler
+from dynamo_tpu.mc.faults import Fault
+from dynamo_tpu.mc.shrink import shrink
+from dynamo_tpu.mc.spec import (
+    InvariantViolation,
+    Spec,
+    SpecEnv,
+    decode_schedule_id,
+    schedule_id,
+)
+from dynamo_tpu.mc.vloop import VirtualLoop
+
+__all__ = [
+    "Explorer",
+    "ExploreResult",
+    "RunResult",
+    "Scheduler",
+    "Fault",
+    "InvariantViolation",
+    "Spec",
+    "SpecEnv",
+    "VirtualLoop",
+    "schedule_id",
+    "decode_schedule_id",
+    "shrink",
+]

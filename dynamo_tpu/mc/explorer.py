@@ -1,0 +1,231 @@
+"""Schedule executor and interleaving explorer.
+
+`Scheduler` runs ONE schedule: it drives a `VirtualLoop` step by step,
+and at every branch point (more than one enabled action) consumes the
+next index from the schedule — 0 (stock asyncio order) once the list is
+exhausted. It records the decision actually taken at every branch point
+plus the alternative indices worth exploring (after the footprint
+reduction), which is exactly what the explorer needs to extend the
+search frontier.
+
+`Explorer` is iterative DFS over schedules: run a schedule, and for
+every branch point at or past the forced prefix, push
+`decisions[:i] + [alt]` for each unexplored alternative. Alternatives
+whose label resolves inside a function the static pass flagged
+(DYN-A007/R008, via `footprint.hazard_names`) are pushed last, so the
+LIFO frontier explores them first — static findings steer the dynamic
+search. Violating runs are recorded but not expanded (their suffix is
+already broken; the shrinker minimizes them instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Set, Tuple
+
+from dynamo_tpu.mc.footprint import branch_candidates, enabled_choices
+from dynamo_tpu.mc.spec import InvariantViolation, Spec, SpecEnv, schedule_id
+from dynamo_tpu.mc.vloop import VirtualLoop
+
+__all__ = ["Scheduler", "Explorer", "RunResult", "ExploreResult"]
+
+# branch record: (decision_index, [(alt_choice_index, alt_label), ...])
+Branch = Tuple[int, List[Tuple[int, str]]]
+
+
+@dataclass
+class RunResult:
+    spec: str
+    decisions: List[int]          # decision taken at each branch point
+    sid: str                      # schedule_id(decisions)
+    steps: int
+    violation: Optional[str]      # invariant message, or None
+    trace: List[str]              # label of the action chosen at each step
+    branches: List[Branch] = field(default_factory=list)
+    quiescent: bool = True
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+@dataclass
+class ExploreResult:
+    spec: str
+    runs: int                     # distinct schedules executed
+    violations: List[RunResult]
+    max_decisions: int
+    frontier_left: int            # schedules still unexplored at budget
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class Scheduler:
+    """Deterministically execute one schedule of one spec instance."""
+
+    def __init__(self, spec: Spec, schedule: List[int]) -> None:
+        self.spec = spec
+        self.schedule = list(schedule)
+
+    def run(self) -> RunResult:
+        spec = self.spec
+        loop = VirtualLoop()
+        env = SpecEnv(loop)
+        decisions: List[int] = []
+        branches: List[Branch] = []
+        trace: List[str] = []
+        violation: Optional[str] = None
+        steps = 0
+        quiescent = False
+        with loop:
+            try:
+                spec.build(env)
+                faults = list(spec.faults(env))
+                while steps < spec.max_steps:
+                    cands = enabled_choices(loop, spec.footprints, faults)
+                    if not cands:
+                        quiescent = True
+                        break
+                    if len(cands) > 1:
+                        di = len(decisions)
+                        want = (self.schedule[di]
+                                if di < len(self.schedule) else 0)
+                        idx = want if 0 <= want < len(cands) else 0
+                        alts = [(a, cands[a].label)
+                                for a in branch_candidates(cands)
+                                if a != idx]
+                        branches.append((di, alts))
+                        decisions.append(idx)
+                    else:
+                        idx = 0
+                    c = cands[idx]
+                    trace.append(c.label)
+                    if c.kind == "run":
+                        loop.current_footprint = c.footprint
+                        try:
+                            loop.run_handle(c.handle)
+                        finally:
+                            loop.current_footprint = None
+                    elif c.kind == "advance":
+                        loop.advance_to_next_timer()
+                    else:
+                        c.fault.fire(loop)
+                    steps += 1
+                    spec.step_invariant(env)
+                try:
+                    spec.invariant(env)
+                except InvariantViolation as e:
+                    violation = str(e)
+                if violation is None and not quiescent:
+                    violation = (f"did not quiesce within "
+                                 f"{spec.max_steps} steps")
+                if (violation is None and spec.fail_on_loop_exceptions
+                        and loop.exceptions):
+                    ctx = loop.exceptions[0]
+                    violation = ("unhandled loop exception: "
+                                 f"{ctx.get('message')}: "
+                                 f"{ctx.get('exception')!r}")
+            except InvariantViolation as e:
+                violation = str(e)
+            finally:
+                self._teardown(loop)
+        loop.close()
+        return RunResult(
+            spec=spec.name, decisions=decisions,
+            sid=schedule_id(decisions), steps=steps, violation=violation,
+            trace=trace, branches=branches, quiescent=quiescent,
+        )
+
+    @staticmethod
+    def _teardown(loop: VirtualLoop) -> None:
+        """Cancel every live task and drain, so no coroutine outlives the
+        run (a pending task warns at GC from a DIFFERENT run's context,
+        which would poison that run's exception check)."""
+        for t in loop.tasks:
+            if not t.done():
+                t.cancel()
+        for _ in range(2000):
+            handles = loop.ready_handles()
+            if handles:
+                loop.run_handle(handles[0])
+            elif loop.next_timer_due() is not None:
+                loop.advance_to_next_timer()
+            else:
+                break
+        for t in loop.tasks:
+            if t.done() and not t.cancelled():
+                t.exception()  # retrieve, silencing GC-time warnings
+
+
+class Explorer:
+    """Bounded DFS over the schedule tree of one spec.
+
+    `spec_factory` must return a FRESH spec instance per run — specs
+    hold per-run protocol state. `hazards` is the set of function names
+    the static pass flagged; matching alternatives explore first.
+    """
+
+    def __init__(
+        self,
+        spec_factory: Callable[[], Spec],
+        *,
+        max_runs: int = 200,
+        hazards: Optional[Set[str]] = None,
+        stop_on_first: bool = False,
+    ) -> None:
+        self.spec_factory = spec_factory
+        self.max_runs = max(1, int(max_runs))
+        self.hazards = hazards or set()
+        self.stop_on_first = stop_on_first
+
+    def run_schedule(self, schedule: List[int]) -> RunResult:
+        return Scheduler(self.spec_factory(), schedule).run()
+
+    def _hazardous(self, label: str) -> bool:
+        # task labels look like "name@func:line", callbacks "cb:qualname"
+        if "@" in label:
+            fn = label.rsplit("@", 1)[1].rsplit(":", 1)[0]
+        elif label.startswith("cb:"):
+            fn = label[3:].rsplit(".", 1)[-1]
+        else:
+            return False
+        return fn in self.hazards
+
+    def explore(self) -> ExploreResult:
+        frontier: List[List[int]] = [[]]
+        seen = {schedule_id([])}
+        violations: List[RunResult] = []
+        runs = 0
+        max_decisions = 0
+        name = self.spec_factory().name
+        while frontier and runs < self.max_runs:
+            sched = frontier.pop()
+            rr = self.run_schedule(sched)
+            runs += 1
+            max_decisions = max(max_decisions, len(rr.decisions))
+            if rr.violation is not None:
+                violations.append(rr)
+                if self.stop_on_first:
+                    break
+                continue  # a broken suffix is not worth extending
+            fresh: List[Tuple[bool, List[int]]] = []
+            for di, alts in rr.branches:
+                if di < len(sched):
+                    continue  # fixed by the forced prefix
+                prefix = rr.decisions[:di]
+                for alt, label in alts:
+                    s2 = prefix + [alt]
+                    sid = schedule_id(s2)
+                    if sid not in seen:
+                        seen.add(sid)
+                        fresh.append((self._hazardous(label), s2))
+            # LIFO frontier: push hazard-flagged alternatives last so they
+            # pop (and therefore run) first
+            fresh.sort(key=lambda t: t[0])
+            frontier.extend(s for _, s in fresh)
+        return ExploreResult(
+            spec=name, runs=runs, violations=violations,
+            max_decisions=max_decisions, frontier_left=len(frontier),
+        )

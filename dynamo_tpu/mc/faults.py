@@ -1,0 +1,72 @@
+"""Fault injection as scheduling choices.
+
+A fault is a one-shot action the environment can take at any branch
+point: cancel a task mid-await, kill a fake peer, fail a pending
+future, stall a plane. Representing faults as *candidates* (rather than
+spec-scripted events) means the explorer decides WHEN they land — the
+entire point, since the bugs live in the window between two particular
+yield points, not in whether the fault happens at all.
+
+Each fault fires at most once per run (`armed` resets via `reset()`
+between runs) and may gate itself on loop state via `enabled` (e.g.
+"only after the consumer parked"). The action runs synchronously at the
+branch point; anything it schedules (callbacks from `Task.cancel`,
+futures it resolves) lands on the virtual ready queue and is itself
+schedulable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["Fault", "cancel_task"]
+
+
+class Fault:
+    """One-shot environment action, offered as a branch-point candidate.
+
+    `action(loop)` performs the fault; `when(loop) -> bool` (optional)
+    gates whether it is currently offered. Exploration treats an armed,
+    enabled fault exactly like a ready handle: firing it is one more
+    decision index.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        action: Callable[[Any], None],
+        when: Optional[Callable[[Any], bool]] = None,
+    ) -> None:
+        self.name = name
+        self._action = action
+        self._when = when
+        self.armed = True
+
+    def enabled(self, loop: Any) -> bool:
+        return self._when is None or bool(self._when(loop))
+
+    def fire(self, loop: Any) -> None:
+        self.armed = False
+        self._action(loop)
+
+    def reset(self) -> None:
+        self.armed = True
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Fault({self.name!r}, armed={self.armed})"
+
+
+def cancel_task(name: str, pick: Callable[[Any], Any]) -> Fault:
+    """Fault that cancels the task `pick(loop)` returns (None → disabled).
+    Offered only while the task is alive and suspended."""
+
+    def _alive(loop: Any) -> bool:
+        t = pick(loop)
+        return t is not None and not t.done()
+
+    def _cancel(loop: Any) -> None:
+        t = pick(loop)
+        if t is not None and not t.done():
+            t.cancel()
+
+    return Fault(name, _cancel, when=_alive)

@@ -1,0 +1,143 @@
+"""Scheduling choices, shared-state footprints, and DPOR-style pruning.
+
+At every scheduler step the enabled actions are: run one ready handle
+(one candidate per handle, FIFO order — candidate 0 is what stock
+asyncio would do), advance virtual time to the next timer deadline (the
+"the loop was busy long enough for the timeout to fire" branch), or
+fire an armed fault. Exploring every permutation of those is factorial;
+most of it is noise because most actions touch disjoint state.
+
+The reduction here is footprint-based partial-order reduction in the
+DPOR spirit, deliberately conservative: each candidate carries a
+footprint — a frozenset of state keys declared per task by the spec
+(`Spec.footprints`), inherited by callbacks a task schedules, with
+`{"*"}` (conflicts with everything) as the default for anything
+undeclared. At a branch point, a candidate that conflicts with no other
+enabled candidate commutes with all of them, so only its canonical
+(default-order) position is explored; alternatives are generated only
+for candidates that conflict with something. Soundness note: with
+default `{"*"}` footprints nothing is pruned; pruning only happens
+where a spec explicitly declares independence, which keeps the
+reduction's correctness a local, reviewable claim per spec.
+
+The static seed: `hazard_names(paths)` runs the dynlint fact extractor
+over production modules and returns the function names flagged by
+DYN-A007/R008. The explorer orders alternative branches so candidates
+about to resume inside a flagged function are explored first — the
+static pass points the dynamic search at the code most likely to race.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set
+
+from dynamo_tpu.mc.vloop import VirtualLoop, task_location
+
+__all__ = ["Choice", "CONFLICTS_ALL", "branch_candidates", "hazard_names"]
+
+CONFLICTS_ALL: FrozenSet[str] = frozenset({"*"})
+
+
+@dataclass
+class Choice:
+    """One enabled action at a branch point."""
+
+    kind: str  # "run" | "advance" | "fault"
+    label: str
+    footprint: FrozenSet[str] = CONFLICTS_ALL
+    handle: Any = None  # asyncio.Handle for kind="run"
+    fault: Any = None   # Fault for kind="fault"
+
+    def conflicts(self, other: "Choice") -> bool:
+        if "*" in self.footprint or "*" in other.footprint:
+            return True
+        return bool(self.footprint & other.footprint)
+
+
+def _owner_task(handle) -> Optional[asyncio.Task]:
+    cb = getattr(handle, "_callback", None)
+    owner = getattr(cb, "__self__", None)
+    return owner if isinstance(owner, asyncio.Task) else None
+
+
+def choice_for_handle(
+    handle,
+    footprints: Dict[str, FrozenSet[str]],
+) -> Choice:
+    """Label + footprint for a ready handle. Task steps get the task's
+    declared footprint and a `name@func:line` label; bare callbacks
+    inherit the footprint of the task that scheduled them (stamped by
+    VirtualLoop.call_soon), else conflict with everything."""
+    task = _owner_task(handle)
+    if task is not None:
+        name = task.get_name()
+        fp = footprints.get(name, CONFLICTS_ALL)
+        return Choice("run", f"{name}@{task_location(task)}", fp, handle=handle)
+    cb = getattr(handle, "_callback", None)
+    while isinstance(cb, functools.partial):  # partial repr embeds 0x addrs
+        cb = cb.func
+    label = getattr(cb, "__qualname__", repr(cb))
+    inherited = getattr(handle, "_mc_footprint", None)
+    return Choice("run", f"cb:{label}", inherited or CONFLICTS_ALL,
+                  handle=handle)
+
+
+def enabled_choices(
+    loop: VirtualLoop,
+    footprints: Dict[str, FrozenSet[str]],
+    faults: Sequence[Any] = (),
+) -> List[Choice]:
+    """The full candidate list at the current state, index-stable for
+    replay: ready handles in FIFO order, then time-advance if any timer
+    is pending, then armed faults in declaration order."""
+    cands = [choice_for_handle(h, footprints) for h in loop.ready_handles()]
+    if loop.next_timer_due() is not None:
+        cands.append(Choice("advance",
+                            f"advance-time->{loop.next_timer_due():g}"))
+    for f in faults:
+        if f.armed and f.enabled(loop):
+            cands.append(Choice("fault", f"fault:{f.name}", fault=f))
+    return cands
+
+
+def branch_candidates(cands: List[Choice]) -> List[int]:
+    """Indices worth exploring as ALTERNATIVES to the default (index 0).
+    A candidate disjoint from every other enabled candidate commutes with
+    all of them — running it now vs. later yields an equivalent trace, so
+    its default-order position is canonical and it generates no branch."""
+    if len(cands) <= 1:
+        return []
+    out = []
+    for i, c in enumerate(cands):
+        if i == 0:
+            continue  # index 0 is the default path, always taken
+        if any(c.conflicts(d) for j, d in enumerate(cands) if j != i):
+            out.append(i)
+    return out
+
+
+def hazard_names(paths: Sequence[str], root: Optional[str] = None) -> Set[str]:
+    """Function names flagged DYN-A007/R008 across `paths` — the static
+    atomicity pass as dynamic-exploration seeds. Suppressed findings are
+    included on purpose (see `atomicity_hazards`)."""
+    from dynamo_tpu.lint.project import atomicity_hazards, extract_module_facts
+
+    facts = []
+    for path in paths:
+        files = [path] if os.path.isfile(path) else [
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(path) for f in sorted(fs)
+            if f.endswith(".py")
+        ]
+        for f in files:
+            rel = os.path.relpath(f, root) if root else f
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    facts.append(extract_module_facts(rel, fh.read()))
+            except OSError:
+                continue
+    return {h["fn"] for h in atomicity_hazards(facts)}

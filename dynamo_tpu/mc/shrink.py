@@ -1,0 +1,81 @@
+"""Schedule shrinking: minimal replayable reproduction of a violation.
+
+A violation usually surfaces deep in the DFS with a long decision list,
+most of which is incidental. The shrinker reduces it with three passes,
+each preserving "still violates" as the invariant:
+
+1. prefix minimization — decisions past the forced prefix default to 0,
+   so `sched[:k]` is a legal schedule; binary-search the shortest
+   failing prefix (with a linear fallback, since failure need not be
+   monotone in k);
+2. zero-out — try rewriting each non-default decision to 0, repeating
+   to a fixpoint (greedy delta debugging at granularity 1);
+3. strip trailing zeros — they are literally the default.
+
+The result is what gets committed under tests/data/mc_schedules/ as a
+regression: small enough to read as a story ("consumer checks, producer
+publishes, consumer parks") and replayed verbatim by tier-1.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+__all__ = ["shrink"]
+
+
+def _strip(sched: List[int]) -> List[int]:
+    out = list(sched)
+    while out and out[-1] == 0:
+        out.pop()
+    return out
+
+
+def shrink(
+    fails: Callable[[List[int]], bool],
+    schedule: List[int],
+    budget: int = 200,
+) -> List[int]:
+    """Minimize `schedule` while `fails(schedule)` stays True. `fails`
+    must be deterministic (replay the spec under the candidate schedule
+    and report whether it still violates). `budget` caps replay calls."""
+    calls = [0]
+
+    def check(s: List[int]) -> bool:
+        if calls[0] >= budget:
+            return False
+        calls[0] += 1
+        return fails(s)
+
+    sched = _strip(schedule)
+    if not check(sched):
+        return _strip(schedule)  # not reproducible under budget: keep as-is
+
+    # 1. shortest failing prefix: binary search first (cheap when failure
+    # is prefix-monotone), then a linear tightening pass to be safe
+    lo, hi = 0, len(sched)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if check(sched[:mid]):
+            hi = mid
+        else:
+            lo = mid + 1
+    if check(sched[:hi]):
+        sched = _strip(sched[:hi])
+    while sched and check(sched[:-1]):
+        sched = _strip(sched[:-1])
+
+    # 2. zero-out non-default decisions to a fixpoint
+    changed = True
+    while changed and calls[0] < budget:
+        changed = False
+        for i, d in enumerate(sched):
+            if d == 0:
+                continue
+            cand = _strip(sched[:i] + [0] + sched[i + 1:])
+            if check(cand):
+                sched = cand
+                changed = True
+                break
+
+    return _strip(sched)

@@ -1,0 +1,163 @@
+"""Protocol specs: production coroutines + environment + invariants.
+
+A Spec packages three things: `build(env)` constructs the protocol under
+test (REAL production objects — AdmissionQueue, KvIndexer,
+PrefetchManager — with only their I/O planes faked) and spawns the
+driver tasks; `faults(env)` declares the one-shot environment actions
+the explorer may inject; `invariant(env)` (at quiescence) and
+`step_invariant(env)` (after every scheduled action) raise
+InvariantViolation when the protocol's contract is broken.
+
+Schedules are plain decision-index lists: at every branch point (>1
+enabled action) the scheduler consumes the next index, defaulting to 0
+(stock-asyncio order) when the list is exhausted. `schedule_id` encodes
+the list as a replayable string (`s.0.1.2`), so a violation in CI is
+one `scripts/dynmc.py --replay <spec> <id>` away from a deterministic
+local reproduction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Any, Dict, FrozenSet, List, Optional
+
+__all__ = [
+    "InvariantViolation",
+    "Spec",
+    "SpecEnv",
+    "schedule_id",
+    "decode_schedule_id",
+    "LostWakeupFixture",
+]
+
+
+class InvariantViolation(AssertionError):
+    """A spec invariant failed under some interleaving."""
+
+
+def schedule_id(schedule: List[int]) -> str:
+    """`[0, 1, 2]` -> `"s.0.1.2"`; `[]` -> `"s"` (the default run)."""
+    return "s" + "".join(f".{int(d)}" for d in schedule)
+
+
+def decode_schedule_id(sid: str) -> List[int]:
+    if not sid or sid[0] != "s":
+        raise ValueError(f"not a schedule id: {sid!r}")
+    body = sid[1:]
+    if not body:
+        return []
+    if not body.startswith("."):
+        raise ValueError(f"not a schedule id: {sid!r}")
+    return [int(x) for x in body[1:].split(".")]
+
+
+class SpecEnv:
+    """Per-run world handed to the spec: the virtual loop, the named
+    driver tasks, and a scratch dict for protocol state + counters."""
+
+    def __init__(self, loop) -> None:
+        self.loop = loop
+        self.tasks: Dict[str, asyncio.Task] = {}
+        self.data: Dict[str, Any] = {}
+
+    def spawn(self, name: str, coro) -> asyncio.Task:
+        task = self.loop.create_task(coro, name=name)
+        self.tasks[name] = task
+        return task
+
+    def task(self, name: str) -> Optional[asyncio.Task]:
+        return self.tasks.get(name)
+
+
+class Spec:
+    """Base spec. Subclass and override `build` + `invariant`."""
+
+    name = "spec"
+    # hard cap on scheduled actions per run (divergence guard)
+    max_steps = 4000
+    # fixture specs are EXPECTED to violate; excluded from production gating
+    expect_violation = False
+    # task name -> shared-state footprint for the POR reduction; anything
+    # absent conflicts with everything (sound default)
+    footprints: Dict[str, FrozenSet[str]] = {}
+    # treat contexts reaching loop.call_exception_handler as violations
+    fail_on_loop_exceptions = True
+
+    def build(self, env: SpecEnv) -> None:
+        raise NotImplementedError
+
+    def faults(self, env: SpecEnv) -> list:
+        return []
+
+    def step_invariant(self, env: SpecEnv) -> None:
+        pass
+
+    def invariant(self, env: SpecEnv) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Seeded fixture: a known lost-wakeup, kept as the checker's own regression.
+# ---------------------------------------------------------------------------
+
+class LeakyQueue:
+    """Deliberately buggy hand-rolled queue: `get` checks emptiness, hits
+    a yield point, then parks WITHOUT re-checking — the textbook DYN-A007
+    shape. A put landing inside that window sees no parked waiter (it has
+    not registered yet) while the consumer parks forever next to a
+    non-empty buffer. Exists to prove dynmc finds and shrinks real lost
+    wakeups; never import this outside tests."""
+
+    def __init__(self) -> None:
+        self._items: deque = deque()
+        self._waiters: deque = deque()
+
+    def put_nowait(self, item: Any) -> None:
+        self._items.append(item)
+        while self._waiters:
+            w = self._waiters.popleft()
+            if not w.done():
+                w.set_result(None)
+                break
+
+    async def get(self) -> Any:
+        if not self._items:
+            await asyncio.sleep(0)  # BUG: check-then-park spans a yield
+            w = asyncio.get_running_loop().create_future()
+            self._waiters.append(w)
+            await w
+        return self._items.popleft()
+
+
+class LostWakeupFixture(Spec):
+    """Consumer parks on LeakyQueue.get while a timer-delayed producer
+    puts one item. The stock-asyncio order passes; the interleaving where
+    the put lands between the consumer's emptiness check and its park
+    loses the wakeup. Acceptance fixture: the explorer must find it and
+    shrink it to a handful of decisions."""
+
+    name = "fixture_lost_wakeup"
+    expect_violation = True
+    max_steps = 200
+
+    def build(self, env: SpecEnv) -> None:
+        q = LeakyQueue()
+        env.data["q"] = q
+
+        async def consumer() -> None:
+            env.data["got"] = await q.get()
+
+        async def producer() -> None:
+            await asyncio.sleep(0.01)
+            q.put_nowait("x")
+
+        env.spawn("consumer", consumer())
+        env.spawn("producer", producer())
+
+    def invariant(self, env: SpecEnv) -> None:
+        t = env.task("consumer")
+        if t is None or not t.done() or env.data.get("got") != "x":
+            raise InvariantViolation(
+                "lost wakeup: consumer parked forever while the queue "
+                "holds an item")

@@ -1,0 +1,212 @@
+"""Virtual-clock asyncio event loop with externally-scheduled callbacks.
+
+`VirtualLoop` subclasses `asyncio.AbstractEventLoop` but never runs a
+poll loop of its own: it only *collects* ready handles and timers, and a
+driver (`dynamo_tpu.mc.explorer.Scheduler`) decides which handle runs
+next and when virtual time advances. Everything else is genuine CPython
+asyncio — real `asyncio.Task`, real `asyncio.Future`, real
+`asyncio.Handle` — so coroutines under test execute with production
+semantics (including the 3.10 `wait_for` completed-before-cancelled
+hand-off this repo's AdmissionQueue depends on). The loop is installed
+via `asyncio.events._set_running_loop`, so `get_running_loop()`,
+`asyncio.sleep`, `asyncio.Queue`, locks, and `create_task` inside the
+code under test all land here.
+
+Determinism contract: no wall clock (time starts at 0.0 and only moves
+when the driver fires a timer), FIFO ready order (choice 0 always equals
+what stock asyncio would run next), and heap-with-sequence-tiebreak
+timer order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+from asyncio import events
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+__all__ = ["VirtualLoop", "task_location"]
+
+
+def task_location(task: asyncio.Task) -> str:
+    """`func:line` of the innermost suspended coroutine frame — where the
+    task will resume. Used for trace rendering and hazard prioritization."""
+    try:
+        coro = task.get_coro()
+        while True:
+            inner = getattr(coro, "cr_await", None)
+            if inner is None or not hasattr(inner, "cr_code"):
+                break
+            coro = inner
+        code = getattr(coro, "cr_code", None)
+        frame = getattr(coro, "cr_frame", None)
+        if code is None:
+            return "?"
+        line = frame.f_lineno if frame is not None else code.co_firstlineno
+        return f"{code.co_name}:{line}"
+    except Exception:
+        return "?"
+
+
+class _McHandle(asyncio.Handle):
+    # Handle is slotted; one extra slot carries the scheduling footprint
+    __slots__ = ("_mc_footprint",)
+
+
+class _McTimerHandle(asyncio.TimerHandle):
+    __slots__ = ("_mc_footprint",)
+
+
+class VirtualLoop(asyncio.AbstractEventLoop):
+    """The schedulable substrate. Public driver surface:
+
+    - `ready_handles()` — live `call_soon` handles, FIFO order
+    - `run_handle(h)` — execute one handle (removed from the queue)
+    - `next_timer_due()` / `advance_to_next_timer()` — virtual time
+    - `exceptions` — contexts passed to `call_exception_handler`
+    - `tasks` — every task the loop created, in creation order
+    """
+
+    def __init__(self) -> None:
+        self._time = 0.0
+        self._ready: Deque[asyncio.Handle] = deque()
+        self._timers: List[Tuple[float, int, asyncio.TimerHandle]] = []
+        self._seq = 0
+        self._closed = False
+        self.exceptions: List[Dict[str, Any]] = []
+        self.tasks: List[asyncio.Task] = []
+        # footprint of the handle currently executing; handles scheduled
+        # from inside it inherit this (see explorer.Scheduler)
+        self.current_footprint: Optional[frozenset] = None
+
+    # -- clock -------------------------------------------------------------
+    def time(self) -> float:
+        return self._time
+
+    # -- scheduling primitives asyncio machinery calls ---------------------
+    def call_soon(self, callback, *args, context=None) -> asyncio.Handle:
+        handle = _McHandle(callback, args, self, context)
+        handle._mc_footprint = self.current_footprint
+        self._ready.append(handle)
+        return handle
+
+    # single-threaded model checking: "threadsafe" is the same queue
+    call_soon_threadsafe = call_soon
+
+    def call_later(self, delay, callback, *args, context=None):
+        return self.call_at(self._time + max(0.0, delay), callback, *args,
+                            context=context)
+
+    def call_at(self, when, callback, *args, context=None):
+        handle = _McTimerHandle(when, callback, args, self, context)
+        handle._mc_footprint = self.current_footprint
+        self._seq += 1
+        heapq.heappush(self._timers, (when, self._seq, handle))
+        return handle
+
+    def _timer_handle_cancelled(self, handle) -> None:
+        pass  # cancelled timers are skipped lazily when popped
+
+    # -- futures / tasks ---------------------------------------------------
+    def create_future(self) -> asyncio.Future:
+        return asyncio.Future(loop=self)
+
+    def create_task(self, coro, *, name=None, context=None) -> asyncio.Task:
+        if name is None:
+            # asyncio's default Task-<n> names use a process-global counter,
+            # which would make traces differ between otherwise identical
+            # runs; number per-loop instead so replay stays byte-identical
+            name = f"task#{len(self.tasks) + 1}"
+        task = asyncio.Task(coro, loop=self, name=name)
+        self.tasks.append(task)
+        return task
+
+    # -- loop state the asyncio internals probe ----------------------------
+    def is_running(self) -> bool:
+        return not self._closed
+
+    def is_closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+        self._ready.clear()
+        self._timers.clear()
+
+    def get_debug(self) -> bool:
+        return False
+
+    def set_debug(self, enabled: bool) -> None:
+        pass
+
+    # -- error sink --------------------------------------------------------
+    def default_exception_handler(self, context) -> None:
+        self.exceptions.append(dict(context))
+
+    def call_exception_handler(self, context) -> None:
+        self.exceptions.append(dict(context))
+
+    # -- driver surface ----------------------------------------------------
+    def ready_handles(self) -> List[asyncio.Handle]:
+        """Live ready handles, FIFO. Cancelled handles are purged."""
+        while self._ready and self._ready[0]._cancelled:
+            self._ready.popleft()
+        return [h for h in self._ready if not h._cancelled]
+
+    def run_handle(self, handle: asyncio.Handle) -> None:
+        """Execute one ready handle out of the queue. Removal is by
+        identity — TimerHandle defines value equality, and two timers for
+        the same (when, callback) must stay distinct."""
+        for i, x in enumerate(self._ready):
+            if x is handle:
+                del self._ready[i]
+                break
+        else:
+            return  # already run or cancelled-and-purged
+        if not handle._cancelled:
+            handle._run()
+
+    def _purge_timers(self) -> None:
+        while self._timers and self._timers[0][2]._cancelled:
+            heapq.heappop(self._timers)
+
+    def next_timer_due(self) -> Optional[float]:
+        self._purge_timers()
+        return self._timers[0][0] if self._timers else None
+
+    def advance_to_next_timer(self) -> int:
+        """Jump virtual time to the earliest pending deadline and move
+        every timer due at that instant onto the ready queue. Returns how
+        many timers fired. Modeling note: offering this as a choice even
+        while callbacks are ready is what expresses timeout races — a
+        busy loop CAN let wall time pass before servicing a callback."""
+        self._purge_timers()
+        if not self._timers:
+            return 0
+        due = self._timers[0][0]
+        self._time = max(self._time, due)
+        fired = 0
+        while self._timers and self._timers[0][0] <= due:
+            _, _, th = heapq.heappop(self._timers)
+            if th._cancelled:
+                continue
+            # the TimerHandle itself moves to ready (stock BaseEventLoop
+            # behavior): a cancel() that lands before it runs still wins
+            self._ready.append(th)
+            fired += 1
+        return fired
+
+    def quiescent(self) -> bool:
+        self._purge_timers()
+        return not self.ready_handles() and not self._timers
+
+    # -- context manager: install as the running loop ----------------------
+    def __enter__(self) -> "VirtualLoop":
+        if events._get_running_loop() is not None:
+            raise RuntimeError("VirtualLoop cannot nest inside a running loop")
+        events._set_running_loop(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        events._set_running_loop(None)

@@ -46,6 +46,15 @@ class KvIndexer:
         self._last_event_id: Dict[Worker, int] = {}
         self._task: Optional[asyncio.Task] = None
         self._resyncing: set = set()
+        # live events arriving while a worker's dump RPC is in flight are
+        # parked here and replayed after the snapshot lands — applying them
+        # immediately would let remove_worker() wipe them and the snapshot
+        # resurrect state they superseded (found by dynmc, spec
+        # indexer_resync; regression schedule in tests/data/mc_schedules/)
+        self._resync_buffer: Dict[Worker, list] = {}
+        # bumped by remove_worker; a resync whose dump outlives the worker
+        # must not repopulate the index with a corpse's blocks
+        self._epoch: Dict[Worker, int] = {}
 
     async def start(self) -> None:
         if self._task is None:
@@ -63,6 +72,7 @@ class KvIndexer:
         self._sub.disconnect(address)
 
     def remove_worker(self, worker: Worker) -> None:
+        self._epoch[worker] = self._epoch.get(worker, 0) + 1
         self.index.remove_worker(worker)
         self.host_index.remove_worker(worker)
         self._last_event_id.pop(worker, None)
@@ -94,6 +104,13 @@ class KvIndexer:
 
     def _apply(self, ev: RouterEvent) -> None:
         worker = tuple(ev.worker)
+        buf = self._resync_buffer.get(worker)
+        if buf is not None:
+            # resync in flight: park the event un-deduped (the snapshot
+            # will rewind _last_event_id; filtering now would be against
+            # the wrong watermark) and replay it after the dump applies
+            buf.append(ev)
+            return
         last = self._last_event_id.get(worker, 0)
         if ev.event_id <= last:
             return  # replay/duplicate
@@ -120,40 +137,74 @@ class KvIndexer:
     DUMP_TIMEOUT_S = 10.0
 
     async def resync_worker(self, worker: Worker) -> None:
-        """Full-state seed/resync from the worker's dump endpoint."""
+        """Full-state seed/resync from the worker's dump endpoint.
+
+        Two orderings make the naive version wrong (both surfaced by the
+        dynmc indexer_resync spec):
+
+        - live events landing during the dump await used to be applied
+          immediately, then wiped by remove_worker() and replaced by the
+          OLDER snapshot — a remove event applied live was resurrected,
+          and _last_event_id rewound past deliveries we will never see
+          again. Events are now buffered in _apply and replayed (deduped
+          against the dump's watermark) after the snapshot lands.
+        - a discovery delete during the await bumps the worker's epoch;
+          applying the dump anyway would repopulate the index for a
+          corpse the router just expired.
+        """
         if self._dump_fn is None:
             return
+        epoch = self._epoch.get(worker, 0)
+        owns_buffer = worker not in self._resync_buffer
+        if owns_buffer:
+            self._resync_buffer[worker] = []
         try:
-            dump = await asyncio.wait_for(
-                self._dump_fn(worker[0]), timeout=self.DUMP_TIMEOUT_S
-            )
-        except asyncio.CancelledError:
-            raise  # shutdown, not a worker fault — don't swallow
-        except asyncio.TimeoutError:
-            log.warning("kv dump from worker %s timed out", worker)
-            return
-        except Exception as e:
-            log.warning("kv dump from worker %s failed: %s", worker, e)
-            return
-        self.index.remove_worker(worker)
-        # replay the snapshot as store events, parent-first so chains link
-        # (iterative chain walk — lineage chains reach thousands of blocks)
-        blocks = {int(h): (int(p) if p is not None else None) for h, p in dump.get("blocks", [])}
-        emitted = set()
-        for h0 in list(blocks):
-            chain = []
-            h = h0
-            while h is not None and h not in emitted and h in blocks:
-                chain.append(h)
-                h = blocks[h]
-            for h in reversed(chain):
-                self.index.apply_event(
-                    RouterEvent(worker=worker, event_id=0, kind="store",
-                                block_hashes=[h], parent_hash=blocks[h]),
-                    ttl=self.ttl,
+            try:
+                dump = await asyncio.wait_for(
+                    self._dump_fn(worker[0]), timeout=self.DUMP_TIMEOUT_S
                 )
-                emitted.add(h)
-        self._last_event_id[worker] = int(dump.get("last_event_id", 0))
+            except asyncio.CancelledError:
+                raise  # shutdown, not a worker fault — don't swallow
+            except asyncio.TimeoutError:
+                log.warning("kv dump from worker %s timed out", worker)
+                return
+            except Exception as e:
+                log.warning("kv dump from worker %s failed: %s", worker, e)
+                return
+            if self._epoch.get(worker, 0) != epoch:
+                log.warning(
+                    "discarding stale kv dump for %s (removed mid-resync)",
+                    worker)
+                return
+            self.index.remove_worker(worker)
+            # replay the snapshot as store events, parent-first so chains
+            # link (iterative walk — lineage chains reach thousands of
+            # blocks)
+            blocks = {int(h): (int(p) if p is not None else None)
+                      for h, p in dump.get("blocks", [])}
+            emitted = set()
+            for h0 in list(blocks):
+                chain = []
+                h = h0
+                while h is not None and h not in emitted and h in blocks:
+                    chain.append(h)
+                    h = blocks[h]
+                for h in reversed(chain):
+                    self.index.apply_event(
+                        RouterEvent(worker=worker, event_id=0, kind="store",
+                                    block_hashes=[h], parent_hash=blocks[h]),
+                        ttl=self.ttl,
+                    )
+                    emitted.add(h)
+            self._last_event_id[worker] = int(dump.get("last_event_id", 0))
+        finally:
+            if owns_buffer:
+                buffered = self._resync_buffer.pop(worker, [])
+                if self._epoch.get(worker, 0) == epoch:
+                    # replay through _apply: ids the snapshot already
+                    # covers fall to the dedup check, newer ones apply
+                    for ev in buffered:
+                        self._apply(ev)
 
     async def _resync(self, worker: Worker) -> None:
         try:

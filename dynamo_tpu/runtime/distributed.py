@@ -102,8 +102,14 @@ class DistributedRuntime:
     # -- serving ----------------------------------------------------------
     async def _ensure_server(self) -> None:
         if not self._server_started:
-            await self.server.start()
+            # flag BEFORE the await (rolled back on failure): a second
+            # caller arriving during start() must not double-start
             self._server_started = True
+            try:
+                await self.server.start()
+            except BaseException:
+                self._server_started = False
+                raise
         if self._hb_task is None:
             self._hb_task = asyncio.create_task(self._heartbeat_loop())
 

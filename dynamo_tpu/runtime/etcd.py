@@ -56,6 +56,9 @@ class EtcdDiscovery(DiscoveryBackend):
         self.lease_ttl = max(2, int(lease_ttl))
         self._session = None  # aiohttp.ClientSession, lazy
         self._lease_id: Optional[int] = None
+        # serializes lease grant: two concurrent _lease() calls would each
+        # grant, and the loser's lease leaks until its TTL (DYN-A007)
+        self._lease_lock = asyncio.Lock()
         self._mine: Dict[str, Instance] = {}
 
     async def _http(self):
@@ -72,10 +75,12 @@ class EtcdDiscovery(DiscoveryBackend):
             return await resp.json()
 
     async def _lease(self) -> int:
-        if self._lease_id is None:
-            out = await self._post("/v3/lease/grant", {"TTL": self.lease_ttl})
-            self._lease_id = int(out["ID"])
-        return self._lease_id
+        async with self._lease_lock:
+            if self._lease_id is None:
+                out = await self._post(
+                    "/v3/lease/grant", {"TTL": self.lease_ttl})
+                self._lease_id = int(out["ID"])
+            return self._lease_id
 
     # -- DiscoveryBackend ---------------------------------------------------
     async def register(self, instance: Instance) -> None:
@@ -203,13 +208,15 @@ class EtcdDiscovery(DiscoveryBackend):
                         yield DiscoveryEvent("delete", Instance.from_dict(rec))
 
     async def close(self) -> None:
-        if self._lease_id is not None:
+        # claim both fields before their awaits: a concurrent close() must
+        # not double-revoke the lease or double-close the session
+        lease, self._lease_id = self._lease_id, None
+        if lease is not None:
             try:
-                await self._post("/v3/lease/revoke", {"ID": self._lease_id})
+                await self._post("/v3/lease/revoke", {"ID": lease})
             except Exception:
                 log.debug("lease revoke failed on close; etcd TTL will "
                           "expire it", exc_info=True)
-            self._lease_id = None
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        session, self._session = self._session, None
+        if session is not None:
+            await session.close()

@@ -330,13 +330,15 @@ class DigestPublisher:
             self._task = asyncio.get_running_loop().create_task(self._loop())
 
     async def stop(self, flush: bool = True) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        # claim before the await: a concurrent stop() must see None, not
+        # re-await the half-torn-down task (DYN-A007)
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
             try:
-                await self._task
+                await task
             except asyncio.CancelledError:
                 pass
-            self._task = None
         if flush:
             await self.publish_once()
 
@@ -395,13 +397,14 @@ class FleetObserver:
             self._task = asyncio.create_task(self._consume())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        # claim before the await (DYN-A007): see ObserverPublisher.stop
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
             try:
-                await self._task
+                await task
             except asyncio.CancelledError:
                 pass
-            self._task = None
 
     async def _consume(self) -> None:
         async for subject, payload in self._sub.events():

@@ -59,6 +59,8 @@ class KubeApiClient:
         return self._session
 
     async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        # claim before the await: concurrent close() double-closing the
+        # session is the DYN-A007 check-then-act hazard
+        session, self._session = self._session, None
+        if session is not None:
+            await session.close()

@@ -42,6 +42,12 @@ def main() -> int:
                             help="also run the dynlint gate (default)")
     lint_group.add_argument("--no-lint", dest="lint", action="store_false",
                             help="skip the dynlint gate")
+    mc_group = ap.add_mutually_exclusive_group()
+    mc_group.add_argument("--mc", dest="mc", action="store_true",
+                          default=True,
+                          help="also run the dynmc smoke gate (default)")
+    mc_group.add_argument("--no-mc", dest="mc", action="store_false",
+                          help="skip the dynmc gate")
     args = ap.parse_args()
     required = args.require if args.require is not None else [
         "test_sched_packing.py", "test_ragged_mixed.py",
@@ -49,6 +55,7 @@ def main() -> int:
         "test_fleet_observer.py", "test_spec_decode.py",
         "test_kv_tiers.py", "test_session_tree.py", "test_guided.py",
         "test_fleet_sim.py", "test_chaos.py", "test_sanitizer.py",
+        "test_dynmc.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -104,6 +111,29 @@ def main() -> int:
             print(detail.stdout + detail.stderr, file=sys.stderr)
     ok = ok and lint_ok
 
+    mc_ok = True
+    if args.mc:
+        # concurrency gate: smoke-tier dynmc explores every protocol spec
+        # and must also prove its own teeth on the seeded fixtures
+        mc_proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dynmc.py"),
+             "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=args.timeout,
+        )
+        mc_ok = mc_proc.returncode == 0
+        print(mc_proc.stdout, end="")
+        if not mc_ok:
+            detail = subprocess.run(
+                [sys.executable, os.path.join(REPO, "scripts", "dynmc.py")],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=args.timeout,
+            )
+            print("TIER-1 CHECK FAILED: dynmc found an interleaving "
+                  "violation (see docs/concurrency.md)", file=sys.stderr)
+            print(detail.stdout + detail.stderr, file=sys.stderr)
+    ok = ok and mc_ok
+
     # runtime-sanitizer self-check (jax-free): the lock-cycle detector,
     # allowlist rejection, and strict-raise plumbing must work before any
     # --sanitize run or fleet-sim chaos test can be trusted
@@ -122,7 +152,7 @@ def main() -> int:
     print(json.dumps({"metric": "tier1_collection", "ok": ok,
                       "collected": collected, "errors": errors,
                       "missing": missing, "lint_ok": lint_ok,
-                      "sanitizer_ok": sanitizer_ok}))
+                      "mc_ok": mc_ok, "sanitizer_ok": sanitizer_ok}))
     if not ok:
         # loud: surface the collection tracebacks so the broken import is
         # visible in CI logs, not just the count

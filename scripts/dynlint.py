@@ -66,9 +66,11 @@ def main() -> int:
 
     paths = args.paths or [os.path.join(REPO, "dynamo_tpu"),
                            os.path.join(REPO, "scripts")]
+    cache_stats: dict = {}
     violations = lint_paths(
         paths, root=REPO, project=not args.no_project,
         cache_path=None if args.no_cache else args.cache,
+        stats=cache_stats,
     )
     per_rule: dict = {}
     for v in violations:
@@ -94,6 +96,8 @@ def main() -> int:
             "metric": "dynlint", "ok": ok, "total": len(violations),
             "new": len(new), "fixed_keys": len(fixed),
             "baseline_keys": len(baseline), "rules": per_rule,
+            "cache_hits": cache_stats.get("cache_hits", 0),
+            "cache_misses": cache_stats.get("cache_misses", 0),
         }))
         return 0 if ok else 1
 

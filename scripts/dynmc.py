@@ -1,0 +1,179 @@
+"""dynmc CLI — deterministic concurrency model checking of the control
+plane.
+
+Explores interleavings of the production protocol specs
+(dynamo_tpu/mc/protocols.py) on a virtual-clock loop. Two tiers:
+
+    python scripts/dynmc.py                  # smoke (check_tier1, <60s)
+    python scripts/dynmc.py --deep           # full budget (pre-merge)
+    python scripts/dynmc.py --spec admission_queue --runs 400
+    python scripts/dynmc.py --replay indexer_resync s.0.2.1
+    python scripts/dynmc.py --json           # one summary line
+
+Gate semantics: every production spec must hold its invariants across
+every explored interleaving, AND the checker must prove its own teeth on
+the seeded fixtures (known-bad twins + the lost-wakeup fixture, which
+must be found and shrunk to a replayable schedule of <= 12 decisions).
+A production violation is auto-shrunk and printed as a `--replay` line —
+paste it to reproduce deterministically.
+
+The static pass seeds the search: DYN-A007/R008 sites (atomicity spans
+from dynlint's fact extractor) prioritize which branch alternatives the
+explorer tries first. See docs/concurrency.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+from dynamo_tpu.mc.explorer import Explorer, Scheduler  # noqa: E402
+from dynamo_tpu.mc.footprint import hazard_names  # noqa: E402
+from dynamo_tpu.mc.protocols import ALL_SPECS, FIXTURES, SPECS  # noqa: E402
+from dynamo_tpu.mc.shrink import shrink  # noqa: E402
+from dynamo_tpu.mc.spec import decode_schedule_id, schedule_id  # noqa: E402
+
+SMOKE_RUNS = 60
+DEEP_RUNS = 700
+FIXTURE_MAX_DECISIONS = 12  # the lost-wakeup repro must shrink this small
+
+# where the static atomicity pass looks for hazard seeds
+HAZARD_PATHS = [os.path.join(REPO, "dynamo_tpu", d)
+                for d in ("router", "kvbm", "runtime", "frontend")]
+
+
+def _shrunk_sid(spec_cls, decisions) -> str:
+    def fails(sched) -> bool:
+        return Scheduler(spec_cls(), sched).run().violation is not None
+
+    return schedule_id(shrink(fails, decisions))
+
+
+def replay(name: str, sid: str) -> int:
+    spec_cls = ALL_SPECS[name]
+    rr = Scheduler(spec_cls(), decode_schedule_id(sid)).run()
+    print(f"spec={name} sid={rr.sid} steps={rr.steps} "
+          f"quiescent={rr.quiescent}")
+    for i, label in enumerate(rr.trace):
+        print(f"  {i:3d}  {label}")
+    if rr.violation:
+        print(f"VIOLATION: {rr.violation}")
+    else:
+        print("ok: all invariants held")
+    # a fixture replay "succeeds" by violating; production by passing
+    expected = getattr(spec_cls, "expect_violation", False)
+    return 0 if (rr.violation is not None) == expected else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spec", action="append", default=None,
+                    help="spec name (repeatable; default: all)")
+    ap.add_argument("--deep", action="store_true",
+                    help=f"full budget ({DEEP_RUNS} interleavings/spec "
+                         f"instead of {SMOKE_RUNS})")
+    ap.add_argument("--runs", type=int, default=None,
+                    help="override interleavings budget per spec")
+    ap.add_argument("--replay", nargs=2, metavar=("SPEC", "SID"),
+                    help="replay one schedule id and print its trace")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON summary line (CI mode)")
+    ap.add_argument("--list", action="store_true",
+                    help="list spec names and exit")
+    ap.add_argument("--no-hazards", action="store_true",
+                    help="skip the static-pass hazard seeding")
+    args = ap.parse_args()
+
+    if args.list:
+        for name, cls in ALL_SPECS.items():
+            kind = "fixture" if name in FIXTURES else "production"
+            print(f"{name:28s} {kind:10s} {cls.__doc__.split(chr(10))[0]}")
+        return 0
+    if args.replay:
+        return replay(args.replay[0], args.replay[1])
+
+    # fault exploration makes production code log its (expected) warning
+    # paths thousands of times; only genuine errors are interesting here
+    logging.disable(logging.WARNING)
+
+    budget = args.runs or (DEEP_RUNS if args.deep else SMOKE_RUNS)
+    hazards = set() if args.no_hazards else hazard_names(
+        HAZARD_PATHS, root=REPO)
+    wanted = args.spec or list(ALL_SPECS)
+    unknown = [s for s in wanted if s not in ALL_SPECS]
+    if unknown:
+        print(f"unknown spec(s): {unknown}; try --list", file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    per_spec = {}
+    prod_violations = []
+    fixtures_missed = []
+    fixture_decisions = None
+    total_runs = 0
+    for name in wanted:
+        spec_cls = ALL_SPECS[name]
+        is_fixture = name in FIXTURES
+        ex = Explorer(spec_cls, max_runs=budget, hazards=hazards,
+                      stop_on_first=is_fixture)
+        res = ex.explore()
+        total_runs += res.runs
+        per_spec[name] = res.runs
+        if is_fixture:
+            if not res.violations:
+                fixtures_missed.append(name)
+            elif name == "fixture_lost_wakeup":
+                sid = _shrunk_sid(spec_cls, res.violations[0].decisions)
+                fixture_decisions = len(decode_schedule_id(sid))
+                if not args.json:
+                    print(f"[dynmc] {name}: found and shrunk to {sid} "
+                          f"({fixture_decisions} decisions)")
+        elif res.violations:
+            rr = res.violations[0]
+            sid = _shrunk_sid(spec_cls, rr.decisions)
+            prod_violations.append(
+                {"spec": name, "sid": sid, "violation": rr.violation})
+        if not args.json and not res.violations:
+            print(f"[dynmc] {name}: {res.runs} interleavings, "
+                  f"max {res.max_decisions} decisions, clean"
+                  + (" (frontier exhausted)" if not res.frontier_left
+                     else ""))
+
+    wall_s = time.monotonic() - t0
+    fixture_ok = (not fixtures_missed
+                  and (fixture_decisions is None
+                       or fixture_decisions <= FIXTURE_MAX_DECISIONS))
+    ok = not prod_violations and fixture_ok
+    if args.json:
+        print(json.dumps({
+            "metric": "dynmc", "ok": ok,
+            "specs": sum(1 for s in wanted if s in SPECS),
+            "interleavings": total_runs,
+            "violations": len(prod_violations),
+            "fixture_ok": fixture_ok,
+            "fixture_decisions": fixture_decisions,
+            "wall_s": round(wall_s, 3),
+            "per_spec": per_spec,
+        }))
+    else:
+        for v in prod_violations:
+            print(f"[dynmc] VIOLATION in {v['spec']}: {v['violation']}\n"
+                  f"        replay: python scripts/dynmc.py --replay "
+                  f"{v['spec']} {v['sid']}")
+        for name in fixtures_missed:
+            print(f"[dynmc] fixture {name} NOT caught — the checker lost "
+                  "its teeth")
+        print(f"[dynmc] {'ok' if ok else 'FAILED'}: {total_runs} "
+              f"interleavings over {len(wanted)} specs in {wall_s:.1f}s")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

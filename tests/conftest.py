@@ -27,6 +27,11 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: deep-budget tests excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj

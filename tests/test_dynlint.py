@@ -997,3 +997,290 @@ async def test_event_plane_survives_abrupt_peer():
         await pub.close()
         await sub.close()
         await srv.stop()
+
+
+# -- DYN-A007: check-then-act spanning an await -----------------------------
+
+
+_A007_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/cachefill.py": """
+        import asyncio
+
+
+        class Loader:
+            def __init__(self):
+                self._model = None
+
+            async def ensure(self):
+                if self._model is None:
+                    await asyncio.sleep(0.1)
+                    self._model = object()
+                return self._model
+    """,
+}
+
+
+def test_a007_check_then_act_across_await(tmp_path):
+    vs = _plint(tmp_path, _A007_PKG)
+    a007 = [v for v in vs if v.rule == "DYN-A007"]
+    assert len(a007) == 1
+    v = a007[0]
+    assert v.path == "pkg/cachefill.py"
+    assert "`self._model`" in v.message
+    assert "spans an `await`" in v.message
+    assert "dynmc yield point" in v.message
+
+
+def test_a007_negative_write_before_await(tmp_path):
+    """cache-then-fill: the write is atomic with the check (no yield
+    between them), so the later await cannot invalidate it."""
+    files = dict(_A007_PKG)
+    files["pkg/cachefill.py"] = """
+        import asyncio
+
+
+        class Loader:
+            def __init__(self):
+                self._model = None
+
+            async def ensure(self):
+                if self._model is None:
+                    self._model = object()
+                    await asyncio.sleep(0.1)
+                return self._model
+    """
+    assert "DYN-A007" not in [v.rule for v in _plint(tmp_path, files)]
+
+
+def test_a007_negative_async_lock_serializes_span(tmp_path):
+    files = dict(_A007_PKG)
+    files["pkg/cachefill.py"] = """
+        import asyncio
+
+
+        class Loader:
+            def __init__(self):
+                self._model = None
+                self._lock = asyncio.Lock()
+
+            async def ensure(self):
+                async with self._lock:
+                    if self._model is None:
+                        await asyncio.sleep(0.1)
+                        self._model = object()
+                return self._model
+    """
+    assert "DYN-A007" not in [v.rule for v in _plint(tmp_path, files)]
+
+
+def test_a007_negative_rollback_in_except(tmp_path):
+    """a write inside an except handler compensates a FAILED await — the
+    rollback idiom is not the 'act' half of check-then-act."""
+    files = dict(_A007_PKG)
+    files["pkg/cachefill.py"] = """
+        import asyncio
+
+
+        class Loader:
+            def __init__(self):
+                self._model = None
+
+            async def ensure(self):
+                if self._model is None:
+                    try:
+                        await asyncio.sleep(0.1)
+                    except asyncio.CancelledError:
+                        self._model = None
+                        raise
+                return self._model
+    """
+    assert "DYN-A007" not in [v.rule for v in _plint(tmp_path, files)]
+
+
+def test_a007_negative_sync_fn(tmp_path):
+    files = dict(_A007_PKG)
+    files["pkg/cachefill.py"] = """
+        class Loader:
+            def __init__(self):
+                self._model = None
+
+            def ensure(self):
+                if self._model is None:
+                    self._model = object()
+                return self._model
+    """
+    assert "DYN-A007" not in [v.rule for v in _plint(tmp_path, files)]
+
+
+def test_a007_suppressed_for_lint_but_still_a_dynmc_seed(tmp_path):
+    """An inline suppression silences the report — but the site must keep
+    seeding dynmc: a human claim of safety is exactly what the model
+    checker should spend budget refuting."""
+    files = dict(_A007_PKG)
+    files["pkg/cachefill.py"] = """
+        import asyncio
+
+
+        class Loader:
+            def __init__(self):
+                self._model = None
+
+            async def ensure(self):
+                if self._model is None:  # dynlint: disable=DYN-A007 — benign double-init
+                    await asyncio.sleep(0.1)
+                    self._model = object()
+                return self._model
+    """
+    assert "DYN-A007" not in [v.rule for v in _plint(tmp_path, files)]
+
+    from dynamo_tpu.mc.footprint import hazard_names
+
+    _write_pkg(tmp_path, files)
+    names = hazard_names([str(tmp_path / "pkg")], root=str(tmp_path))
+    assert "ensure" in names
+
+
+# -- DYN-R008: lock-protected state written lock-free from async ------------
+
+
+_R008_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/recorder.py": """
+        import threading
+
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+
+            def flush_from_thread(self):
+                with self._lock:
+                    self._rows = []
+
+            async def append(self, row):
+                self._rows.append(row)
+    """,
+}
+
+
+def test_r008_lock_free_async_write(tmp_path):
+    vs = _plint(tmp_path, _R008_PKG)
+    r008 = [v for v in vs if v.rule == "DYN-R008"]
+    assert len(r008) == 1
+    v = r008[0]
+    assert v.path == "pkg/recorder.py"
+    assert "`self._rows`" in v.message
+    assert "_lock" in v.message
+    assert "flush_from_thread" in v.message  # points at the locked writer
+
+
+def test_r008_negative_same_lock_taken(tmp_path):
+    files = dict(_R008_PKG)
+    files["pkg/recorder.py"] = """
+        import threading
+
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+
+            def flush_from_thread(self):
+                with self._lock:
+                    self._rows = []
+
+            async def append(self, row):
+                with self._lock:
+                    self._rows.append(row)
+    """
+    assert "DYN-R008" not in [v.rule for v in _plint(tmp_path, files)]
+
+
+def test_r008_negative_disjoint_attrs_and_init(tmp_path):
+    """__init__ writes never fire (construction precedes sharing), and a
+    lock guarding a DIFFERENT attribute proves nothing about this one."""
+    files = dict(_R008_PKG)
+    files["pkg/recorder.py"] = """
+        import threading
+
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+                self._other = 0
+
+            def flush_from_thread(self):
+                with self._lock:
+                    self._other = 1
+
+            async def append(self, row):
+                self._rows.append(row)
+    """
+    assert "DYN-R008" not in [v.rule for v in _plint(tmp_path, files)]
+
+
+def test_r008_suppression(tmp_path):
+    files = dict(_R008_PKG)
+    files["pkg/recorder.py"] = """
+        import threading
+
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+
+            def flush_from_thread(self):
+                with self._lock:
+                    self._rows = []
+
+            async def append(self, row):
+                self._rows.append(row)  # dynlint: disable=DYN-R008 — loop-owned
+    """
+    assert "DYN-R008" not in [v.rule for v in _plint(tmp_path, files)]
+
+
+# -- cache hardening: stats + FACTS_VERSION invalidation --------------------
+
+
+def test_lint_cache_stats_cold_then_warm(tmp_path):
+    pkgdir = _write_pkg(tmp_path, _A007_PKG)
+    cache = str(tmp_path / "cache.json")
+    cold, warm = {}, {}
+    lint_paths([pkgdir], root=str(tmp_path), cache_path=cache, stats=cold)
+    lint_paths([pkgdir], root=str(tmp_path), cache_path=cache, stats=warm)
+    nfiles = len(_A007_PKG)
+    assert cold == {"cache_hits": 0, "cache_misses": nfiles}
+    assert warm == {"cache_hits": nfiles, "cache_misses": 0}
+
+
+def test_facts_version_bump_invalidates_cache(tmp_path, monkeypatch):
+    """Regression: cached facts carry the extractor's schema. Bumping
+    FACTS_VERSION (new fact kinds, e.g. the v2 guards/writes) must drop
+    the whole cache — stale facts would silently blind every project rule
+    that depends on the new fields, while mtimes say 'all fresh'."""
+    import dynamo_tpu.lint.project as project_mod
+
+    pkgdir = _write_pkg(tmp_path, _A007_PKG)
+    cache = str(tmp_path / "cache.json")
+    key = lambda vs: [(v.rule, v.path, v.line) for v in vs]
+
+    vs1 = lint_paths([pkgdir], root=str(tmp_path), cache_path=cache)
+    assert "DYN-A007" in [v.rule for v in vs1]
+
+    monkeypatch.setattr(project_mod, "FACTS_VERSION",
+                        project_mod.FACTS_VERSION + 1)
+    stats: dict = {}
+    vs2 = lint_paths([pkgdir], root=str(tmp_path), cache_path=cache,
+                     stats=stats)
+    assert stats["cache_hits"] == 0  # the versioned cache was dropped
+    assert stats["cache_misses"] == len(_A007_PKG)
+    assert key(vs2) == key(vs1)  # re-extraction reproduces the findings
+
+    # and the rewritten cache carries the new version: warm next run
+    warm: dict = {}
+    lint_paths([pkgdir], root=str(tmp_path), cache_path=cache, stats=warm)
+    assert warm == {"cache_hits": len(_A007_PKG), "cache_misses": 0}

@@ -1,0 +1,344 @@
+"""dynmc model-checker tests: determinism, POR, fault injection, the
+seeded lost-wakeup fixture, pinned regression schedules, and the CLI.
+
+The pinned schedules under tests/data/mc_schedules/ are the committed
+reproductions of the interleaving bugs this checker surfaced; replaying
+them here keeps both the bugs fixed AND the schedule codec stable.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.mc import (
+    Explorer,
+    Fault,
+    InvariantViolation,
+    Scheduler,
+    Spec,
+    SpecEnv,
+    VirtualLoop,
+    decode_schedule_id,
+    schedule_id,
+    shrink,
+)
+from dynamo_tpu.mc.protocols import ALL_SPECS, FIXTURES, SPECS
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+SCHEDULE_DIR = os.path.join(REPO, "tests", "data", "mc_schedules")
+
+
+# ---------------------------------------------------------------------------
+# schedule codec
+# ---------------------------------------------------------------------------
+
+def test_schedule_id_roundtrip():
+    for sched in ([], [0], [0, 1, 2], [3, 0, 0, 7]):
+        assert decode_schedule_id(schedule_id(sched)) == sched
+    assert schedule_id([]) == "s"
+    assert schedule_id([0, 1]) == "s.0.1"
+    with pytest.raises(ValueError):
+        decode_schedule_id("x.0")
+    with pytest.raises(ValueError):
+        decode_schedule_id("s0")
+
+
+# ---------------------------------------------------------------------------
+# virtual loop semantics
+# ---------------------------------------------------------------------------
+
+def test_virtual_loop_clock_and_quiescence():
+    loop = VirtualLoop()
+    order = []
+    with loop:
+        loop.create_task(_stamp(order, "a", 0.5))
+        loop.create_task(_stamp(order, "b", 0.1))
+        for _ in range(100):
+            handles = loop.ready_handles()
+            if handles:
+                loop.run_handle(handles[0])
+            elif loop.next_timer_due() is not None:
+                loop.advance_to_next_timer()
+            else:
+                break
+        assert loop.quiescent()
+    # virtual time jumped exactly to the latest deadline, timer order held
+    assert order == [("b", 0.1), ("a", 0.5)]
+    assert loop.time() == 0.5
+    assert not loop.exceptions
+
+
+async def _stamp(order, name, delay):
+    await asyncio.sleep(delay)
+    order.append((name, asyncio.get_running_loop().time()))
+
+
+# ---------------------------------------------------------------------------
+# determinism: same schedule id -> identical run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(ALL_SPECS))
+def test_replay_is_deterministic(name):
+    cls = ALL_SPECS[name]
+    first = Scheduler(cls(), []).run()
+    second = Scheduler(cls(), []).run()
+    assert first.trace == second.trace
+    assert first.sid == second.sid
+    assert first.violation == second.violation
+    assert first.steps == second.steps
+
+
+def test_nondefault_schedule_replays_identically():
+    res = Explorer(ALL_SPECS["admission_queue"], max_runs=30).explore()
+    assert res.runs > 1  # the spec genuinely branches
+    # take a run 3 levels deep and replay it twice by schedule alone
+    sched = [1, 0, 1]
+    a = Scheduler(ALL_SPECS["admission_queue"](), sched).run()
+    b = Scheduler(ALL_SPECS["admission_queue"](), sched).run()
+    assert a.trace == b.trace and a.violation == b.violation
+
+
+# ---------------------------------------------------------------------------
+# POR: disjoint footprints prune, default footprints do not
+# ---------------------------------------------------------------------------
+
+class _TwoCounters(Spec):
+    """Two tasks bump independent counters across yield points. With
+    declared disjoint footprints their orderings commute and the tree
+    collapses; with the sound default ({'*'}) every ordering branches."""
+
+    name = "two_counters"
+
+    def build(self, env: SpecEnv) -> None:
+        env.data["x"] = env.data["y"] = 0
+
+        async def bump(key):
+            for _ in range(3):
+                env.data[key] += 1
+                await asyncio.sleep(0)
+
+        env.spawn("tx", bump("x"))
+        env.spawn("ty", bump("y"))
+
+    def invariant(self, env: SpecEnv) -> None:
+        if env.data["x"] != 3 or env.data["y"] != 3:
+            raise InvariantViolation("lost increment")
+
+
+class _TwoCountersPOR(_TwoCounters):
+    footprints = {"tx": frozenset({"x"}), "ty": frozenset({"y"})}
+
+
+def test_por_prunes_disjoint_footprints():
+    full = Explorer(_TwoCounters, max_runs=500).explore()
+    por = Explorer(_TwoCountersPOR, max_runs=500).explore()
+    assert not full.violations and not por.violations
+    # disjoint tasks commute: only the canonical order remains
+    assert por.runs == 1
+    assert full.runs > por.runs
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class _FaultProbe(Spec):
+    """One worker waits on a future; the only way it resolves is the
+    injected fault. Exploration must reach the fault branch."""
+
+    name = "fault_probe"
+
+    def build(self, env: SpecEnv) -> None:
+        env.data["poked"] = False
+
+        async def worker():
+            await asyncio.sleep(0.1)
+            env.data["done"] = True
+
+        env.spawn("worker", worker())
+
+    def faults(self, env: SpecEnv) -> list:
+        def poke(loop):
+            env.data["poked"] = True
+        return [Fault("poke", poke)]
+
+    def invariant(self, env: SpecEnv) -> None:
+        pass
+
+
+def test_fault_branch_is_explored_and_traced():
+    ex = Explorer(_FaultProbe, max_runs=20)
+    # an armed fault blocks quiescence, so every run fires it — exactly
+    # once (one-shot), and its position in the trace is schedulable
+    default = ex.run_schedule([])
+    assert default.trace.count("fault:poke") == 1
+    early = ex.run_schedule([1])  # fire the fault at the first branch
+    assert early.trace.count("fault:poke") == 1
+    assert early.trace.index("fault:poke") < default.trace.index("fault:poke")
+    again = ex.run_schedule([1])
+    assert again.trace == early.trace
+    res = ex.explore()
+    assert not res.violations and res.runs > 1
+
+
+def test_admission_queue_cancel_fault_reachable():
+    ex = Explorer(SPECS["admission_queue"], max_runs=120)
+    res = ex.explore()
+    assert not res.violations
+    # the cancel fault must be an actually reachable branch somewhere
+    rr = ex.run_schedule([])
+    labels = {lbl for _, alts in rr.branches for _, lbl in alts}
+    assert "fault:cancel_req_b" in labels
+
+
+# ---------------------------------------------------------------------------
+# non-quiescence is itself a violation
+# ---------------------------------------------------------------------------
+
+class _Spinner(Spec):
+    name = "spinner"
+    max_steps = 50
+
+    def build(self, env: SpecEnv) -> None:
+        async def spin():
+            while True:
+                await asyncio.sleep(0)
+        env.spawn("spin", spin())
+
+
+def test_divergence_reported():
+    rr = Scheduler(_Spinner(), []).run()
+    assert rr.violation is not None
+    assert "did not quiesce" in rr.violation
+
+
+# ---------------------------------------------------------------------------
+# shrinker
+# ---------------------------------------------------------------------------
+
+def test_shrink_to_minimal_failing_core():
+    # fails iff decision 2 is nonzero — everything else is incidental
+    def fails(s):
+        return len(s) > 2 and s[2] != 0
+
+    out = shrink(fails, [3, 1, 2, 0, 4, 1, 1])
+    assert fails(out)
+    assert out == [0, 0, 2] or (len(out) == 3 and out[2] != 0)
+
+
+def test_shrink_keeps_unreproducible_input():
+    assert shrink(lambda s: False, [1, 2, 3]) == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance fixture: find the seeded lost wakeup and shrink it
+# ---------------------------------------------------------------------------
+
+def test_lost_wakeup_found_and_shrunk():
+    cls = FIXTURES["fixture_lost_wakeup"]
+    res = Explorer(cls, max_runs=100, stop_on_first=True).explore()
+    assert res.violations, "explorer missed the seeded lost wakeup"
+    rr = res.violations[0]
+
+    def fails(s):
+        return Scheduler(cls(), s).run().violation is not None
+
+    small = shrink(fails, rr.decisions)
+    assert len(small) <= 12
+    replay = Scheduler(cls(), small).run()
+    assert replay.violation is not None
+    assert "lost wakeup" in replay.violation
+
+
+# ---------------------------------------------------------------------------
+# production specs stay clean; buggy twins stay caught
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_production_spec_clean(name):
+    res = Explorer(SPECS[name], max_runs=60).explore()
+    assert not res.violations, (
+        f"{name} violated: {res.violations[0].violation} "
+        f"(replay: python scripts/dynmc.py --replay {name} "
+        f"{res.violations[0].sid})")
+
+
+@pytest.mark.parametrize("fname", sorted(os.listdir(SCHEDULE_DIR)))
+def test_pinned_regression_schedule(fname):
+    """Replay each committed minimal schedule: the buggy twin (or
+    fixture) must still violate under it, and the production spec it
+    guards must hold its invariants under the same decisions."""
+    with open(os.path.join(SCHEDULE_DIR, fname)) as f:
+        doc = json.load(f)
+    cls = ALL_SPECS[doc["spec"]]
+    assert decode_schedule_id(doc["sid"]) == doc["decisions"]
+    rr = Scheduler(cls(), doc["decisions"]).run()
+    assert rr.violation is not None, (
+        f"pinned schedule {doc['sid']} no longer reproduces {doc['spec']}")
+    # twins subclass their production spec; the FIXED class must pass
+    # the exact same decisions (fixture_lost_wakeup has no fixed twin)
+    prod = next((c for n, c in SPECS.items()
+                 if issubclass(cls, c) and cls is not c), None)
+    if prod is not None:
+        fixed = Scheduler(prod(), doc["decisions"]).run()
+        assert fixed.violation is None, (
+            f"production {prod.name} fails its own regression schedule: "
+            f"{fixed.violation}")
+
+
+@pytest.mark.slow
+def test_deep_interleaving_budget():
+    """Acceptance: >=500 distinct interleavings per production spec."""
+    for name, cls in SPECS.items():
+        res = Explorer(cls, max_runs=700).explore()
+        assert res.runs >= 500, f"{name} tree exhausted at {res.runs}"
+        assert not res.violations
+
+
+# ---------------------------------------------------------------------------
+# hazard seeding plumbing
+# ---------------------------------------------------------------------------
+
+def test_hazard_label_parsing():
+    ex = Explorer(_FaultProbe, hazards={"resync_worker", "on_hint"})
+    assert ex._hazardous("resyncer@resync_worker:301")
+    assert ex._hazardous("cb:PrefetchManager.on_hint")
+    assert not ex._hazardous("worker@sleep:605")
+    assert not ex._hazardous("advance-time->0.1")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_json_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "dynmc.py"),
+         "--json", "--runs", "15", "--no-hazards"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "dynmc"
+    assert doc["ok"] is True
+    assert doc["specs"] == len(SPECS)
+    assert doc["fixture_ok"] is True
+    assert doc["fixture_decisions"] <= 12
+    assert set(doc["per_spec"]) == set(ALL_SPECS)
+
+
+def test_cli_replay_pinned_fixture():
+    with open(os.path.join(SCHEDULE_DIR, "fixture_lost_wakeup.json")) as f:
+        doc = json.load(f)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "dynmc.py"),
+         "--replay", doc["spec"], doc["sid"]],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    # fixture replay succeeds BY violating (expect_violation=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "VIOLATION" in proc.stdout
