@@ -40,7 +40,9 @@ from dynamo_tpu.engine.scheduler import (
 )
 from dynamo_tpu.engine.ngram_draft import (
     accept_deterministic,
+    accept_tree,
     propose as ngram_propose,
+    propose_tree as ngram_propose_tree,
 )
 from dynamo_tpu.frontend.protocols import engine_output
 from dynamo_tpu.runtime.annotations import annotate
@@ -51,6 +53,10 @@ log = logging.getLogger("dynamo_tpu.engine")
 
 # per-request ITL sample cap: bounds the spine's memory on long generations
 _ITL_CAP = 512
+
+# cached on a matcher whose schema exceeded the device DFA table budget,
+# so the build (and its warning) happens once per matcher, not per dispatch
+_OVER_BUDGET = object()
 
 
 @dataclass
@@ -173,6 +179,20 @@ class InferenceEngine:
         spec_k: int = 4,  # draft tokens proposed per sequence per step
         spec_max_tokens: int = 0,  # per-iteration cap on drafted tokens
         #   (0 = bounded only by the mixed pool leftover)
+        spec_branches: int = 1,  # tree speculation: candidate draft
+        #   branches per sequence per verify iteration. 1 = linear-K
+        #   (exact PR 8 behavior). >1 adds alternate-continuation verify
+        #   rows sharing the sequence's trunk KV via PagePool.fork_table
+        #   ref-sharing; acceptance walks the branch trie emitting target
+        #   samples (distribution-preserving at any temperature), then
+        #   the winning branch's forked table is adopted and the losers
+        #   released — see docs/spec_decode.md
+        spec_device_draft: Optional[bool] = None,  # device-resident
+        #   n-gram proposal (runner draft_step): history lives in a
+        #   device ring, the suffix match runs as one jitted gather over
+        #   all slots, and the proposal readback is the only host touch
+        #   (sanitizer label draft_readback). None = auto (on when the
+        #   runner has draft_step); False forces the host-side scan
         enable_prefix_cache: bool = True,  # content-addressed KV reuse
         #   (session-tree warm turns; off = every prompt prefills cold —
         #   the A/B knob bench_agentic flips)
@@ -308,10 +328,33 @@ class InferenceEngine:
                 "(runner verify_spec=%s, mixed_prefill_tokens=%d); disabled",
                 hasattr(runner, "verify_spec"), mixed_prefill_tokens,
             )
+        # tree speculation: extra candidate branches per sequence ride the
+        # same verify dispatch as independent segments on forked page
+        # tables (trunk KV ref-shared); 1 = linear-K, the PR 8 contract
+        self.spec_branches = max(1, int(spec_branches))
+        # device-resident n-gram proposal: auto-on when the runner carries
+        # the draft_step ring (ModelRunner jitted gather / SimRunner numpy
+        # twin); the host scan remains as fallback and for A/Bs
+        if spec_device_draft is None:
+            spec_device_draft = hasattr(runner, "draft_step")
+        self._spec_device_draft = (
+            bool(spec_device_draft) and hasattr(runner, "draft_step")
+        )
+        self._draft_slots: Dict[str, int] = {}  # rid -> history-ring slot
+        self._draft_free: List[int] = []
+        self._draft_synced: Dict[str, int] = {}  # rid -> tokens mirrored
+        self._draft_D = 0  # per-iteration append capacity (ring bucket)
+        if self._spec_on and self._spec_device_draft:
+            # allocate + WARM the ring at construction: the draft jit's
+            # compile must land before the sanitizer's recompile tripwire
+            # freezes the per-family variant counts (warmup_steps)
+            self._draft_D = runner.ensure_draft_ring(max_batch, self.spec_k)
+            self._draft_free = list(range(max_batch))
         # cumulative counters for goodput extras["spec"] / fleet digests
         self.spec_stats = {
             "drafted": 0, "accepted": 0, "rejected": 0,
             "verify_rows": 0, "verify_iters": 0, "spec_emitted": 0,
+            "tree_rows": 0, "tree_switches": 0,
         }
         # The scheduler caps a mixed plan at max_batch decode rows +
         # mixed_prefill_tokens chunk tokens, so registering that exact sum
@@ -496,6 +539,48 @@ class InferenceEngine:
                 mask = mask.copy()
                 mask[m.lifter.eos_id] = True
         return mask
+
+    def _guided_device_plan(self, seqs: List[Sequence]):
+        """Device-resident guided plan for a fused multi-step dispatch:
+        (tables, row_entries, pending) for the runner's _guided_op, or
+        None when ANY constrained row's schema exceeds the device-table
+        cell budget — the whole batch then keeps the host io_callback
+        mask_fn (guided/device_table.py; a mixed device/host batch would
+        need a second masking path in the loop for no warm-loop win).
+        Tables compile once per matcher and ride the matcher's cache, so
+        admission churn never rebuilds them; the runner keeps the staged
+        combination device-resident across dispatches."""
+        from dynamo_tpu.guided.device_table import build_device_table
+
+        tables: List[Any] = []
+        index: Dict[int, int] = {}
+        rows: List[Any] = [None] * len(seqs)
+        for i, s in enumerate(seqs):
+            m = s.guided_m
+            if m is None:
+                continue
+            tab = getattr(m, "_device_table", None)
+            if tab is None:
+                tab = build_device_table(m)
+                if tab is None:
+                    tab = _OVER_BUDGET
+                    log.warning(
+                        "guided schema exceeds the device DFA table "
+                        "budget (DYN_GUIDED_DEVICE_MAX_ELEMS) — batches "
+                        "containing it keep the host mask callback",
+                    )
+                m._device_table = tab  # matcher-lifetime cache
+            if tab is _OVER_BUDGET:
+                return None
+            ti = index.get(tab.uid)
+            if ti is None:
+                ti = len(tables)
+                index[tab.uid] = ti
+                tables.append(tab)
+            rows[i] = (ti, int(s.guided_s))
+        if not tables:
+            return None
+        return (tables, rows, False)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -1671,6 +1756,7 @@ class InferenceEngine:
         ]
         for s in running:
             s.spec_draft = []
+            s.spec_tree = []
         if not self._spec_on or not running:
             return
         blocked = [
@@ -1687,6 +1773,8 @@ class InferenceEngine:
                 )
             return
         oracle = getattr(self.runner, "spec_draft", None)
+        tree_oracle = getattr(self.runner, "spec_draft_tree", None)
+        free: List[Sequence] = []
         for s in running:
             if s.guided_m is not None or s.logit_bias:
                 # per-sequence pause: this row stays a plain 1-token
@@ -1697,12 +1785,94 @@ class InferenceEngine:
                     "drafting (per-sequence speculation pause)",
                 )
                 continue
+            free.append(s)
+        if self.spec_branches > 1:
+            # tree mode keeps the host scan (branch enumeration needs
+            # every suffix-match site, which the device ring's
+            # single-winner gather doesn't surface)
+            for s in free:
+                tree = None
+                if tree_oracle is not None:
+                    tree = tree_oracle(
+                        s.tokens[-1], s.computed_len,
+                        self.spec_k, self.spec_branches,
+                    )
+                if tree is None:
+                    tree = ngram_propose_tree(
+                        s.tokens, self.spec_k, self.spec_branches
+                    )
+                if tree and tree[0]:
+                    s.spec_draft = [int(t) for t in tree[0]]
+                    # siblings clipped to the primary's length: the
+                    # scheduler charged pages/segments for that shape
+                    s.spec_tree = [
+                        [int(t) for t in b[: len(tree[0])]]
+                        for b in tree[1:] if b
+                    ]
+            return
+        # linear K: an oracle (SimRunner A/B knob) answers first, per row
+        # (it returns None when unset); rows it declines go through ONE
+        # fused device-ring proposal when the runner carries the ring,
+        # with the host suffix scan as the last fallback
+        pending: List[Sequence] = []
+        for s in free:
             draft = None
             if oracle is not None:
                 draft = oracle(s.tokens[-1], s.computed_len, self.spec_k)
             if draft is None:
+                pending.append(s)
+            else:
+                s.spec_draft = [int(t) for t in draft]
+        device: Dict[str, List[int]] = {}
+        if self._spec_device_draft and pending:
+            device = self._device_draft(pending)
+        for s in pending:
+            draft = device.get(s.request_id)
+            if draft is None:
                 draft = ngram_propose(s.tokens, self.spec_k)
             s.spec_draft = [int(t) for t in draft] if draft else []
+
+    def _device_draft(self, seqs: List[Sequence]) -> Dict[str, List[int]]:
+        """One fused device proposal for every free speculating row:
+        per-row token deltas append into the runner's history ring and
+        the jitted suffix-match gather proposes k tokens per slot — the
+        draft side of the warm loop touches the host exactly once (the
+        [slots, k] proposal readback). Returns rid -> draft; rows that
+        couldn't get a ring slot are absent (the host scan serves them).
+        Bit-identical to ngram_draft.propose while the history fits the
+        ring window (model_runner.DRAFT_RING_WINDOW)."""
+        if not self._draft_free and not self._draft_slots:
+            return {}  # ring was never allocated (disabled after init)
+        live = {s.request_id for s in seqs}
+        for rid in [r for r in self._draft_slots if r not in live]:
+            # finished/preempted/now-guided rows hand their slot back;
+            # a row that resumes simply resets into a fresh slot
+            self._draft_free.append(self._draft_slots.pop(rid))
+            self._draft_synced.pop(rid, None)
+        updates: List[tuple] = []
+        for s in seqs:
+            rid = s.request_id
+            slot = self._draft_slots.get(rid)
+            delta = len(s.tokens) - self._draft_synced.get(rid, 0)
+            if slot is None:
+                if not self._draft_free:
+                    continue  # more rows than slots: host scan fallback
+                slot = self._draft_free.pop()
+                self._draft_slots[rid] = slot
+                delta = -1  # fresh slot: force the cold reset below
+            if delta < 0 or delta > self._draft_D:
+                self.runner.draft_ring_reset(slot, s.tokens)
+            elif delta:
+                updates.append((slot, s.tokens[-delta:]))
+            self._draft_synced[rid] = len(s.tokens)
+        drafts, n_prop = self.runner.draft_step(updates, self.spec_k)
+        out: Dict[str, List[int]] = {}
+        for s in seqs:
+            slot = self._draft_slots.get(s.request_id)
+            if slot is not None:
+                n = int(n_prop[slot])
+                out[s.request_id] = [int(t) for t in drafts[slot][:n]]
+        return out
 
     def _run_spec_verify(self, dplan: DecodePlan, prefills):
         """ONE ragged flat-token dispatch verifying every speculating
@@ -1729,8 +1899,10 @@ class InferenceEngine:
 
         seqs = dplan.seqs
         drafts = [list(s.spec_draft) for s in seqs]
+        trees = [list(s.spec_tree) for s in seqs]
         for s in seqs:
             s.spec_draft = []  # consumed (or shed) either way
+            s.spec_tree = []
         tokens = [s.tokens[-1] for s in seqs]
         positions = [s.computed_len for s in seqs]
         tables = [s.pages for s in seqs]
@@ -1744,6 +1916,47 @@ class InferenceEngine:
             for p in prefills
         ]
         n_drafted = sum(len(d) for d in drafts)
+        # tree speculation: each extra branch is an INDEPENDENT verify
+        # segment on a forked page table — trunk (committed) pages are
+        # ref-shared, only the speculative tail is fresh, so branch KV
+        # writes never collide with the primary row's. Branch rows are
+        # appended AFTER every primary row, which keeps the row-indexed
+        # mask/bias dicts below valid, and they reuse the owning
+        # sequence's sampling params + seed: identical branch prefixes
+        # then yield identical target samples, the trie invariant
+        # accept_tree's walk relies on.
+        sp = _sampling_params(seqs)
+        branch_rows: List[List[int]] = [[] for _ in seqs]
+        forks: List[List[List[int]]] = [[] for _ in seqs]
+        n_branch_tok = 0
+        if any(trees):
+            PS = self.pool.page_size
+            for i, s in enumerate(seqs):
+                if not drafts[i]:
+                    trees[i] = []  # branches never ride without a primary
+                for b in trees[i]:
+                    try:
+                        fork = self.pool.fork_table(
+                            s.pages, n_shared=s.computed_len // PS
+                        )
+                    except NoSpace:
+                        break  # pool pressure: shed remaining branches
+                    branch_rows[i].append(len(tokens))
+                    forks[i].append(fork)
+                    tokens.append(s.tokens[-1])
+                    positions.append(s.computed_len)
+                    tables.append(fork)
+                    drafts.append([int(t) for t in b])
+                    n_branch_tok += len(b) + 1
+                    for kf in sp:
+                        sp[kf].append(sp[kf][i])
+                trees[i] = trees[i][: len(forks[i])]
+
+        def _release_forks(i: int) -> None:
+            for f in forks[i]:
+                if f is not None:
+                    self.pool.release(f)
+            forks[i] = []
         # guided/bias rows never draft (_propose_drafts), so each owns
         # exactly ONE verify position; its mask/bias rides the dispatch's
         # always-present sampling operands (row-aligned dicts)
@@ -1759,24 +1972,47 @@ class InferenceEngine:
             vkw["biases"] = {
                 i: brows[i] for i, s in enumerate(seqs) if s.logit_bias
             }
+        n_branch_rows = sum(len(r) for r in branch_rows)
         with annotate("engine.spec_verify", batch=len(seqs),
-                      drafted=n_drafted, chunks=len(chunks)):
+                      drafted=n_drafted, chunks=len(chunks),
+                      branches=n_branch_rows):
             try:
                 with self._san_scope("spec_verify"):
                     rows, chunk_logits = self.runner.verify_spec(
                         tokens, positions, tables, drafts,
-                        _sampling_params(seqs), step0, chunks=chunks, **vkw,
+                        sp, step0, chunks=chunks, **vkw,
                     )
             except BucketOverflowError as e:
+                for i in range(len(seqs)):
+                    _release_forks(i)  # no KV was committed to them
                 log.warning(
                     "spec verify overflows runner buckets (%s); dropping "
                     "this iteration's drafts", e,
                 )
                 return None
-            n_rows = sum(1 for d in drafts if d)
-            accepted = emitted_spec = 0
+            n_rows = sum(1 for d in drafts[: len(seqs)] if d)
+            accepted = emitted_spec = tree_sw = 0
             for i, seq in enumerate(seqs):
-                emitted = accept_deterministic(drafts[i], rows[i])
+                if forks[i]:
+                    emitted, winner = accept_tree(
+                        [drafts[i]] + trees[i],
+                        [rows[i]] + [rows[r] for r in branch_rows[i]],
+                    )
+                    if winner > 0:
+                        # adopt the winning branch's forked table BEFORE
+                        # committing: its fresh tail pages hold the KV of
+                        # the accepted suffix (the primary's tail is stale
+                        # past the first divergence). Trunk pages are
+                        # shared, so the swap moves one reference; the old
+                        # table's speculative tail goes back to the pool.
+                        old = seq.pages
+                        seq.pages = forks[i][winner - 1]
+                        forks[i][winner - 1] = None
+                        self.pool.release(old)
+                        tree_sw += 1
+                    _release_forks(i)  # losers (and fork-side trunk refs)
+                else:
+                    emitted = accept_deterministic(drafts[i], rows[i])
                 if drafts[i]:
                     accepted += len(emitted) - 1
                     emitted_spec += len(emitted)
@@ -1798,9 +2034,13 @@ class InferenceEngine:
         st["accepted"] += accepted
         st["rejected"] += n_drafted - accepted
         st["spec_emitted"] += emitted_spec
+        st["tree_rows"] += n_branch_rows
+        st["tree_switches"] += tree_sw
         return chunk_logits, {
             "spec_rows": n_rows,
-            "spec_drafted": n_drafted,
+            # billing-honest: branch rows cost len+1 flat tokens each on
+            # the dispatch, exactly what the scheduler charged (_spec_cost)
+            "spec_drafted": n_drafted + n_branch_tok,
             "spec_emitted": emitted_spec,
         }
 
@@ -1883,12 +2123,21 @@ class InferenceEngine:
                     masks[i] = self._guided_mask(seqs[i])
                 mixkw["masks"] = masks
                 if T > 1:
-                    mixkw["mask_fn"] = GuidedMaskContext(
-                        len(seqs), vocab,
-                        [(i, seqs[i].guided_m, seqs[i].guided_s)
-                         for i in guided_rows],
-                        pending_advance=True,
-                    )
+                    # tail steps after the ragged step 0: device DFA plan
+                    # when every schema fits the table budget (the runner
+                    # forces pending_advance — step 0's token was sampled
+                    # on device and not yet folded into the states), host
+                    # callback otherwise
+                    gdev = self._guided_device_plan(seqs)
+                    if gdev is not None:
+                        mixkw["guided_dev"] = gdev
+                    else:
+                        mixkw["mask_fn"] = GuidedMaskContext(
+                            len(seqs), vocab,
+                            [(i, seqs[i].guided_m, seqs[i].guided_s)
+                             for i in guided_rows],
+                            pending_advance=True,
+                        )
             biases = _batch_biases(seqs, self.runner)
             if biases is not None:
                 mixkw["biases"] = biases
@@ -2030,22 +2279,28 @@ class InferenceEngine:
             return
         masks = None
         mask_fn = None
+        guided_dev = None
         guided_rows = [i for i, s in enumerate(seqs) if s.guided_m is not None]
         if guided_rows:
             vocab = seqs[guided_rows[0]].guided_m.lifter.vocab_size
             if T > 1 and getattr(self.runner, "guided_fused", False):
-                # constrained rows need a fresh mask per sampled token;
-                # instead of collapsing the whole plan to one step per
-                # dispatch, hand the runner a host callback that advances
-                # a COPY of each row's DFA state by the device-sampled
-                # feedback token between fused steps — guided rows ride
-                # the same full decode_steps loop as free rows, and the
-                # callback is identity-stable so no compile-key churn
-                mask_fn = GuidedMaskContext(
-                    len(seqs), vocab,
-                    [(i, seqs[i].guided_m, seqs[i].guided_s)
-                     for i in guided_rows],
-                )
+                # constrained rows need a fresh mask per sampled token.
+                # Preferred: the device-resident DFA plan — state advance
+                # and mask gather happen in-XLA inside the fused loop,
+                # ZERO host syncs per step. Fallback (schema over the
+                # device-table budget): a host callback that advances a
+                # COPY of each row's DFA state by the device-sampled
+                # feedback token between fused steps — guided rows still
+                # ride the full decode_steps loop either way, and both
+                # paths produce byte-identical masks on bounded schemas
+                # (pinned by tests/test_guided.py)
+                guided_dev = self._guided_device_plan(seqs)
+                if guided_dev is None:
+                    mask_fn = GuidedMaskContext(
+                        len(seqs), vocab,
+                        [(i, seqs[i].guided_m, seqs[i].guided_s)
+                         for i in guided_rows],
+                    )
             else:
                 # runners without callback plumbing (PP loop) keep the
                 # legacy one-step masked dispatch
@@ -2081,6 +2336,8 @@ class InferenceEngine:
             mkw = {"masks": masks} if masks is not None else {}
             if mask_fn is not None:
                 mkw["mask_fn"] = mask_fn
+            if guided_dev is not None:
+                mkw["guided_dev"] = guided_dev
             if biases is not None:
                 mkw["biases"] = biases
             sampled, lp = self.runner.decode_multi_ex(
@@ -2094,6 +2351,8 @@ class InferenceEngine:
             mkw = {"masks": masks} if masks is not None else {}
             if mask_fn is not None:
                 mkw["mask_fn"] = mask_fn
+            if guided_dev is not None:
+                mkw["guided_dev"] = guided_dev
             if biases is not None:
                 mkw["biases"] = biases
             sampled = self.runner.decode_multi(

@@ -135,12 +135,18 @@ class PagePool:
     # -- fork-on-branch ----------------------------------------------------
     def fork_table(self, pages: List[int], n_shared: int) -> List[int]:
         """Copy-on-write fork of a sequence's page table (n>1 sampling,
-        tool-call retries): the first `n_shared` pages hold KV both
-        branches agree on and are shared by reference; the remainder —
-        typically just the partial page being written — is duplicated
-        into fresh pages via `copy_hook(src, dst)` so divergent decode
-        never clobbers the sibling. Raises NoSpace before touching
-        refcounts, so a failed fork leaves the parent untouched."""
+        tool-call retries, tree-speculation branch verify rows): the
+        first `n_shared` pages hold KV both branches agree on and are
+        shared by reference; the remainder — typically just the partial
+        page being written — is duplicated into fresh pages via
+        `copy_hook(src, dst)` so divergent decode never clobbers the
+        sibling. Raises NoSpace before touching refcounts, so a failed
+        fork leaves the parent untouched. Tree speculation forks one
+        table per candidate branch each verify iteration and releases
+        every loser (or swaps the winner in for the trunk) before
+        committing tokens — `release` drops one ref per page, so
+        trunk-shared pages survive exactly as long as some table still
+        points at them (docs/spec_decode.md)."""
         n_shared = max(0, min(n_shared, len(pages)))
         tail = pages[n_shared:]
         fresh = self.alloc(len(tail)) if tail else []

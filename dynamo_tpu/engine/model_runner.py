@@ -18,6 +18,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -59,7 +60,15 @@ def _decode_loop(
     lora=None,  # stacked multi-LoRA tree (models/lora.py)
     mask_fn=None,  # static: host callback (t, prev_tokens) -> bool [B, V]
     # advancing guided DFA states between fused steps (ordered io_callback;
-    # identity-stable per runner so the callback program compiles once)
+    # FALLBACK for schemas too large for the device table — see `guided`)
+    guided=None,  # None or (gtrans [G, V] i32, gmask [G, V] bool,
+    # gstate [B] i32, gpend scalar i32): device-resident guided DFA
+    # (guided/device_table.py). Per-step state advance and mask gather
+    # happen in-XLA inside the scan — zero host round trips, unlike the
+    # ordered-io_callback mask_fn path this replaces for bounded schemas.
+    # Unguided/dead rows sit in the shared DEAD state (all-True mask).
+    # gpend != 0 advances at t=0 too (the ragged tail: tok0 was sampled
+    # on device by the ragged step and never folded into gstate).
 ):
     """n_steps decode iterations fused in one jit: forward → sample → feed
     the sampled token back, entirely on device (lax.scan). Amortizes the
@@ -100,7 +109,14 @@ def _decode_loop(
             rows, out_tok
         ].add(1.0, mode="drop")
 
+    use_guided = guided is not None
+    if use_guided:
+        gtrans, gmask, gstate0, gpend = guided
+
     def body(carry, t):
+        gs = None
+        if use_guided:
+            carry, gs = carry[:-1], carry[-1]
         if use_pen:
             tok, kp, vp, cnt, cnt_out = carry
         else:
@@ -118,6 +134,15 @@ def _decode_loop(
 
             l = apply_penalties(raw, cnt, cnt_out, sampling)
         m = mask
+        if use_guided:
+            # device-resident guided DFA: advance each row's state by the
+            # token it fed this step (t>0, or t==0 under pending), then
+            # gather its mask row — all in-XLA, no host round trip. Dead/
+            # unguided rows self-loop in DEAD (all-True), matching the
+            # host GuidedMaskContext's alive=False semantics exactly.
+            adv = (t > 0) | (gpend != 0)
+            gs = jnp.where(adv, gtrans[gs, tok], gs)
+            m = gmask[gs]
         if mask_fn is not None:
             # guided rows in a multi-step loop: the DFA advances host-side
             # between fused steps (tok = what step t-1 sampled), so the
@@ -140,10 +165,16 @@ def _decode_loop(
             r = jnp.arange(B, dtype=jnp.int32)
             cnt = cnt.at[r, s].add(1.0)
             cnt_out = cnt_out.at[r, s].add(1.0)
-            return (s, kp, vp, cnt, cnt_out), outs
-        return (s, kp, vp), outs
+            nxt = (s, kp, vp, cnt, cnt_out)
+        else:
+            nxt = (s, kp, vp)
+        if use_guided:
+            nxt = nxt + (gs,)
+        return nxt, outs
 
     carry0 = (tokens0, k_pool, v_pool) + ((counts0, out0) if use_pen else ())
+    if use_guided:
+        carry0 = carry0 + (gstate0,)
     carry, ys = lax.scan(body, carry0, jnp.arange(n_steps, dtype=jnp.int32))
     last, k_pool, v_pool = carry[0], carry[1], carry[2]
     toks = ys[0]
@@ -218,7 +249,18 @@ def _ragged_step(
     gather_idx,  # [SEG_CAP] flat index of each segment's LAST token
     k_pool,
     v_pool,
-    sampling: SamplingParams,  # padded to SEG_CAP rows
+    sampling: SamplingParams,  # BASE rows padded to SEG_CAP (per-seq on
+    # the verify path; row_seq gathers them out to entry rows in-XLA)
+    row_seq,  # int32 [SEG_CAP] base-row index per sampled row — identity
+    # on the mixed path; on the verify path it maps each expanded verify
+    # entry back to its sequence's base sampling row, so the staged base
+    # is CACHEABLE across iterations (per-seq params are stable while
+    # the per-entry expansion used to churn a fresh host build +
+    # transfer every dispatch — the re-staging tax this removes)
+    row_j,  # int32 [SEG_CAP] verify position per row (0 = the row's own
+    # seed; j>0 folds the per-position seed (seed*1000003+j) & 0x7FFFFFFF
+    # in uint32 — bit-identical to the host expansion it replaces, since
+    # PRNGKey(s) for a uint32 seed is key data [0, s])
     step,  # traced scalar int32
     mask,  # bool [SEG_CAP, V] sampling mask, ALWAYS an operand (all-True
     # when no row is guided — constant treedef keeps guided-on and
@@ -249,8 +291,90 @@ def _ragged_step(
         ragged=(seg_pt, seg_kvl, meta),
     )
     seg_logits = logits[0]  # [SEG_CAP, V]
-    toks = sample(seg_logits, sampling, step, mask=mask, bias=bias)  # [SEG_CAP]
+    # in-XLA sampling expansion: gather each row's base (per-seq) params,
+    # then fold the verify position into the seed for j>0 rows. Matches
+    # the host-side `(seed * 1000003 + j) & 0x7FFFFFFF` fold bit-for-bit:
+    # key data for PRNGKey(uint32 s) is [0, s], uint32 wraparound agrees
+    # with the arbitrary-precision host value mod 2^31.
+    exp = jax.tree_util.tree_map(lambda a: a[row_seq], sampling)
+    base_seed = exp.key[:, 1]  # u32 [SEG_CAP]
+    eff = (base_seed * jnp.uint32(1000003) + row_j.astype(jnp.uint32)) \
+        & jnp.uint32(0x7FFFFFFF)
+    key = jnp.where(
+        row_j[:, None] > 0,
+        jnp.stack([jnp.zeros_like(eff), eff], axis=-1),
+        exp.key,
+    )
+    exp = exp._replace(key=key)
+    toks = sample(seg_logits, exp, step, mask=mask, bias=bias)  # [SEG_CAP]
     return toks, seg_logits, k_pool, v_pool
+
+
+# device n-gram draft ring width: history tokens kept per slot. Smaller
+# than the host NGRAM_SCAN_WINDOW (4096) — the match is identical for
+# sequences shorter than the window, and the ring's HBM cost is
+# SLOTS * W * 4 bytes
+DRAFT_RING_WINDOW = 512
+
+
+def _draft_ring_step(hist, lens, upd_tok, upd_n, k: int, max_match: int = 4):
+    """One fused device draft step over ALL slots: append each slot's
+    newly committed tokens to its history ring (shifting left on
+    overflow), then run the prompt-lookup suffix match and gather k
+    continuation tokens per slot — `engine.ngram_draft.propose` compiled
+    to dense [SLOTS, W] ops (longest suffix m in [1, max_match] wins,
+    most recent occurrence wins, continuation clipped at the history
+    end), bit-identical to the host scan whenever the history fits the
+    ring. Returns (hist, lens, drafts [SLOTS, k], n_prop [SLOTS]).
+
+    hist [SLOTS, W] i32 (-1 padded), lens [SLOTS] i32, upd_tok
+    [SLOTS, D] i32 (-1 padded), upd_n [SLOTS] i32. The whole warm spec
+    loop's draft side is this one dispatch: the engine stages only the
+    [SLOTS, D] committed-token delta and reads back only the proposals
+    (sanitizer label draft_readback)."""
+    SLOTS, W = hist.shape
+    D = upd_tok.shape[1]
+    i32 = jnp.int32
+    # -- append with left-shift on overflow --------------------------------
+    over = jnp.clip(lens + upd_n - W, 0, None)  # [SLOTS]
+    gidx = jnp.arange(W, dtype=i32)[None, :] + over[:, None]
+    hp = jnp.concatenate([hist, jnp.full((SLOTS, D), -1, i32)], axis=1)
+    hist = jnp.take_along_axis(hp, gidx, axis=1)
+    lens = lens - over
+    pos = lens[:, None] + jnp.arange(D, dtype=i32)[None, :]
+    valid = jnp.arange(D, dtype=i32)[None, :] < upd_n[:, None]
+    rows = jnp.broadcast_to(jnp.arange(SLOTS, dtype=i32)[:, None], pos.shape)
+    hist = hist.at[rows, jnp.where(valid, pos, W)].set(
+        jnp.where(valid, upd_tok, -1), mode="drop"
+    )
+    lens = lens + upd_n
+    # -- suffix match ------------------------------------------------------
+    hpad = jnp.concatenate(
+        [hist, jnp.full((SLOTS, max_match + k), -1, i32)], axis=1
+    )
+    s_arr = jnp.arange(W, dtype=i32)[None, :]
+    best_s = jnp.full((SLOTS,), -1, i32)
+    best_m = jnp.zeros((SLOTS,), i32)
+    for m in range(max_match, 0, -1):  # longest suffix wins
+        match = jnp.ones((SLOTS, W), bool)
+        for i in range(m):
+            sfx = jnp.take_along_axis(
+                hist, jnp.clip(lens - m + i, 0, W - 1)[:, None], axis=1
+            )  # [SLOTS, 1]
+            match = match & (hpad[:, i : i + W] == sfx)
+        # candidate start s needs the full m-gram AND >= 1 continuation
+        # token before the suffix itself: s + m <= len - 1
+        match = match & ((s_arr + m) <= (lens[:, None] - 1))
+        match = match & (lens[:, None] >= m + 1)
+        cand = jnp.where(match, s_arr, -1).max(axis=1)  # most recent
+        take = (best_s < 0) & (cand >= 0)
+        best_s = jnp.where(take, cand, best_s)
+        best_m = jnp.where(take, i32(m), best_m)
+    start = best_s + best_m
+    idx = start[:, None] + jnp.arange(k, dtype=i32)[None, :]
+    drafts = jnp.take_along_axis(hpad, jnp.clip(idx, 0, None), axis=1)
+    n_prop = jnp.where(best_s >= 0, jnp.clip(lens - start, 0, k), 0)
+    return hist, lens, drafts, n_prop
 
 
 class _GuidedMaskTrampoline:
@@ -688,6 +812,14 @@ class ModelRunner:
         # one device-resident array instead of re-transferring [SEG, V])
         self._true_mask_cache: Dict[int, jax.Array] = {}
         self._zero_bias_cache: Dict[int, jax.Array] = {}
+        # cached identity (row_seq, row_j) maps per row cap — the mixed
+        # path's no-op for the ragged step's in-XLA sampling expansion
+        self._row_map_cache: Dict[int, Tuple[jax.Array, jax.Array]] = {}
+        # device-resident guided DFA staging (combined transition/mask
+        # tables keyed by schema uids) + state scratch; see _stage_guided
+        self._guided_dev_cache: "OrderedDict[Any, Tuple[jax.Array, jax.Array]]" = (
+            OrderedDict()
+        )
         # the engine's guided-fusion gate: per-step masks ride the decode
         # loop's host callback / the ragged step's mask operand, neither
         # of which the PP loop carries
@@ -720,6 +852,20 @@ class ModelRunner:
                         self._fwd_mesh),
                 donate_argnums=(9, 10),  # k_pool, v_pool
             ))
+        # device n-gram draft ring (_draft_ring_step): registered
+        # UNCONDITIONALLY so spec-on and spec-off runners expose the same
+        # family set (pinned by test_spec_decode); it compiles only when
+        # the engine enables device drafting (ensure_draft_ring warms it
+        # before the sanitizer's recompile-tripwire freeze)
+        self._jit_draft_ring = _family("draft", jax.jit(
+            _draft_ring_step,
+            static_argnums=(4, 5),  # k, max_match
+            donate_argnums=(0, 1),  # hist, lens
+        ))
+        self._draft_ring = None  # (hist_dev, lens_dev) once ensured
+        self._draft_ring_host = None  # (np hist, np lens) mirror
+        self._draft_ring_dirty = False  # mirror edited → restage
+        self._draft_ring_shape = None  # (slots, window, delta_cap)
         # ragged flat-token mixed dispatch: default ON wherever the fused
         # mixed path runs; DYN_RAGGED_MIXED=0 forces the legacy [N, S]
         # padded path (the A/B baseline), =1 forces it on. PP/SP keep the
@@ -867,13 +1013,14 @@ class ModelRunner:
         masks: Optional[np.ndarray] = None,
         biases: Optional[np.ndarray] = None,
         mask_fn=None,
+        guided_dev=None,
     ) -> np.ndarray:
         """n_steps fused decode iterations (one host sync total). Page
         tables must already cover positions[i] + n_steps slots. Returns
         sampled tokens [B_bucket, n_steps]."""
         toks, _ = self.decode_multi_async(
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
-            masks=masks, biases=biases, mask_fn=mask_fn,
+            masks=masks, biases=biases, mask_fn=mask_fn, guided_dev=guided_dev,
         )
         with self._allow("token_readback"):
             return np.asarray(jax.device_get(toks))
@@ -893,6 +1040,7 @@ class ModelRunner:
         masks: Optional[np.ndarray] = None,
         biases: Optional[np.ndarray] = None,
         mask_fn=None,
+        guided_dev=None,
     ):
         """decode_multi with the sampling extras: `histories` (per-sequence
         prompt+generated token ids) switches on repetition/frequency/
@@ -904,7 +1052,7 @@ class ModelRunner:
         out = self.decode_multi_async(
             n_steps, tokens, positions, page_tables, sampling, step, adapters,
             n_logprobs=n_logprobs, histories=histories, prompt_lens=prompt_lens,
-            masks=masks, biases=biases, mask_fn=mask_fn,
+            masks=masks, biases=biases, mask_fn=mask_fn, guided_dev=guided_dev,
         )
         with self._allow("token_readback"):
             if n_logprobs >= 0:
@@ -931,6 +1079,12 @@ class ModelRunner:
         mask_fn=None,  # GuidedMaskContext: per-step host-advanced masks,
         # letting constrained rows ride full n_steps fused loops (the
         # static `mask` covers step 0 semantics when mask_fn is None)
+        guided_dev=None,  # (tables, row_entries, pending): device-resident
+        # guided DFA plan — tables a deduped List[DeviceGuidedTable],
+        # row_entries[i] None (unguided row) or (table_idx, local_state).
+        # Replaces mask_fn's per-step io_callback with an in-XLA
+        # advance+gather for bounded schemas (see _decode_loop `guided`);
+        # mask_fn wins when both are given (the host fallback).
     ):
         """decode_multi without the host sync: returns (toks, last) DEVICE
         arrays — toks [B_bucket, n_steps] and last [B_bucket] (the final
@@ -994,7 +1148,7 @@ class ModelRunner:
 
         if self.pp:
             if n_logprobs >= 0 or hist is not None or biases is not None \
-                    or mask_fn is not None:
+                    or mask_fn is not None or guided_dev is not None:
                 raise NotImplementedError(
                     "logprobs/penalties/logit_bias/multi-step guided masks "
                     "are not wired on the pipeline-parallel decode path yet"
@@ -1020,6 +1174,8 @@ class ModelRunner:
             mask_fn.B = B  # callback mask rows must match the padded bucket
             self.set_guided_ctx(mask_fn)
             mkw["mask_fn"] = self._mask_tramp
+        elif guided_dev is not None:
+            mkw["guided"] = self._guided_op(guided_dev, B)
         with self._allow("decode_staging"):
             packed_dev = jnp.asarray(packed)
             samp = self._device_sampling(sampling, B)
@@ -1049,6 +1205,7 @@ class ModelRunner:
         masks: Optional[np.ndarray] = None,
         mask_fn=None,
         biases: Optional[np.ndarray] = None,
+        guided_dev=None,
     ) -> Tuple[np.ndarray, jax.Array]:
         """Fused mixed iteration (_mixed_loop): the decode batch's fused
         n_steps AND one bounded prefill chunk in a single dispatch.
@@ -1068,18 +1225,19 @@ class ModelRunner:
                 toks, chunk_logits = self._decode_multi_with_prefills_ragged(
                     n_steps, tokens, positions, page_tables, sampling,
                     step, [chunk], masks=masks, mask_fn=mask_fn,
-                    biases=biases,
+                    biases=biases, guided_dev=guided_dev,
                 )
                 return toks, chunk_logits[0]
             except BucketOverflowError as e:
                 if masks is not None or mask_fn is not None \
-                        or biases is not None:
+                        or biases is not None or guided_dev is not None:
                     raise
                 log.warning(
                     "mixed plan (%d tokens) overflows ragged T buckets "
                     "(largest %d); using the padded fallback", e.n, e.largest,
                 )
-        elif masks is not None or mask_fn is not None or biases is not None:
+        elif masks is not None or mask_fn is not None or biases is not None \
+                or guided_dev is not None:
             raise NotImplementedError(
                 "guided masks / logit bias require the ragged mixed path"
             )
@@ -1159,6 +1317,7 @@ class ModelRunner:
         masks: Optional[np.ndarray] = None,  # [n_dec, V] step-0 guided masks
         mask_fn=None,  # GuidedMaskContext for the fused tail steps 1..n-1
         biases: Optional[np.ndarray] = None,  # [n_dec, V] logit-bias rows
+        guided_dev=None,  # device guided DFA plan for the fused tail
     ) -> Tuple[np.ndarray, jax.Array]:
         """Packed fused mixed iteration: the decode batch's fused n_steps
         AND the whole token-budgeted prefill chunk set in a SINGLE
@@ -1174,10 +1333,11 @@ class ModelRunner:
                 return self._decode_multi_with_prefills_ragged(
                     n_steps, tokens, positions, page_tables, sampling, step,
                     chunks, masks=masks, mask_fn=mask_fn, biases=biases,
+                    guided_dev=guided_dev,
                 )
             except BucketOverflowError as e:
                 if masks is not None or mask_fn is not None \
-                        or biases is not None:
+                        or biases is not None or guided_dev is not None:
                     # the padded fallback has no mask/bias plane; the
                     # engine sheds chunks and retries rather than dropping
                     # a guided row's constraint or a bias ban
@@ -1186,7 +1346,8 @@ class ModelRunner:
                     "mixed plan (%d tokens) overflows ragged T buckets "
                     "(largest %d); using the padded fallback", e.n, e.largest,
                 )
-        elif masks is not None or mask_fn is not None or biases is not None:
+        elif masks is not None or mask_fn is not None or biases is not None \
+                or guided_dev is not None:
             raise NotImplementedError(
                 "guided masks / logit bias require the ragged mixed path "
                 "(the engine's _mixed_fusible gates on it)"
@@ -1257,10 +1418,72 @@ class ModelRunner:
         b[: biases.shape[0]] = biases
         return jnp.asarray(b)
 
+    def _identity_rows(self, seg_cap: int) -> Tuple[jax.Array, jax.Array]:
+        """Cached identity (row_seq, row_j) maps: non-verify ragged
+        dispatches sample row i with base row i's params and no seed
+        fold, so the in-XLA expansion is a no-op gather and both arrays
+        stay device-resident across iterations (same rationale as
+        _true_mask)."""
+        hit = self._row_map_cache.get(seg_cap)
+        if hit is None:
+            hit = (
+                jnp.arange(seg_cap, dtype=jnp.int32),
+                jnp.zeros(seg_cap, jnp.int32),
+            )
+            self._row_map_cache[seg_cap] = hit
+        return hit
+
     def set_guided_ctx(self, ctx) -> None:
         """Install the per-dispatch guided-DFA context the decode loop's
         host callback reads (see _GuidedMaskTrampoline)."""
         self._mask_tramp.ctx = ctx
+
+    def stage_guided_tables(self, tables) -> Tuple[jax.Array, jax.Array, List[int]]:
+        """Stage a batch's device guided DFA (combined token-level
+        transition + mask tables, guided/device_table.py) and return
+        (trans_dev [G, V], mask_dev [G, V], state offsets per table).
+
+        Keyed by the schemas' uids so the combined arrays stay
+        device-resident across every dispatch of the same constraint set
+        — the whole point of the device path is that NOTHING guided
+        moves host→device in the warm loop except the [B] initial-state
+        vector. Bounded LRU: admission churn across many distinct
+        schema combinations evicts the oldest combination."""
+        from dynamo_tpu.guided.device_table import combine_tables
+
+        key = tuple(t.uid for t in tables)
+        hit = self._guided_dev_cache.get(key)
+        if hit is not None:
+            self._guided_dev_cache.move_to_end(key)
+            trans_dev, mask_dev, offsets = hit
+            return trans_dev, mask_dev, offsets
+        trans, mask, offsets = combine_tables(tables)
+        with self._allow("decode_staging"):
+            trans_dev = jnp.asarray(trans)
+            mask_dev = jnp.asarray(mask)
+        self._guided_dev_cache[key] = (trans_dev, mask_dev, offsets)
+        while len(self._guided_dev_cache) > 32:
+            self._guided_dev_cache.popitem(last=False)
+        return trans_dev, mask_dev, offsets
+
+    def _guided_op(self, guided_dev, B: int):
+        """Materialize a (tables, row_entries, pending) plan into the
+        _decode_loop `guided` operand tuple for a B-row bucket: combined
+        tables from the staged cache, per-row global initial states (pad
+        and unguided rows sit in DEAD), pending as a traced scalar so
+        pending-0/1 dispatches share one compiled variant."""
+        g_tables, g_rows, g_pend = guided_dev
+        trans_dev, gmask_dev, offs = self.stage_guided_tables(g_tables)
+        dead = int(trans_dev.shape[0]) - 1
+        gs0 = np.full(B, dead, np.int32)
+        for i, ent in enumerate(g_rows):
+            if ent is not None:
+                ti, st = ent
+                gs0[i] = offs[ti] + int(st)
+        with self._allow("decode_staging"):
+            gs0_dev = jnp.asarray(gs0)
+            gpend = jnp.int32(1 if g_pend else 0)
+        return (trans_dev, gmask_dev, gs0_dev, gpend)
 
     # -- ragged flat-token mixed path -------------------------------------
     def _use_ragged(self, n_decode: int, n_chunks: int) -> bool:
@@ -1344,6 +1567,10 @@ class ModelRunner:
         masks: Optional[np.ndarray] = None,
         mask_fn=None,
         biases: Optional[np.ndarray] = None,
+        guided_dev=None,  # device guided DFA plan (decode_multi_async):
+        # step 0 rides the ragged mask operand (`masks`), the fused tail
+        # rides the in-XLA advance with pending=True (tok0 was sampled
+        # on device and is not yet folded into the row states)
     ) -> Tuple[np.ndarray, jax.Array]:
         """Ragged mixed iteration, two dispatches with T-bucket-only and
         decode-bucket-only compile keys respectively:
@@ -1357,10 +1584,12 @@ class ModelRunner:
         n_dec = len(positions)
         (ftok, fpos, tok_pt, tok_kvl, seg_pt, seg_kvl, meta, gather,
          seg_cap) = self._prep_ragged(tokens, positions, page_tables, chunks)
+        row_seq, row_j = self._identity_rows(seg_cap)
         sampled, seg_logits, self.k_pool, self.v_pool = self._jit_ragged(
             self.params, ftok, fpos, tok_pt, tok_kvl, seg_pt, seg_kvl,
             meta, gather, self.k_pool, self.v_pool,
-            self._device_sampling(sampling, seg_cap), jnp.int32(step),
+            self._device_sampling(sampling, seg_cap), row_seq, row_j,
+            jnp.int32(step),
             self._seg_mask(masks, seg_cap),
             self._seg_bias(biases, seg_cap),
         )
@@ -1382,6 +1611,9 @@ class ModelRunner:
                 mask_fn.B = B
                 self.set_guided_ctx(mask_fn)
                 mkw["mask_fn"] = self._mask_tramp
+            elif guided_dev is not None:
+                g_tables, g_rows, _ = guided_dev
+                mkw["guided"] = self._guided_op((g_tables, g_rows, True), B)
             bias_dev = None
             if biases is not None:
                 bz = np.zeros((B, self.config.vocab_size), np.float32)
@@ -1493,33 +1725,24 @@ class ModelRunner:
         for s in range(n_rows, n_seg):
             gather[w] = cu[s + 1] - 1
             w += 1
-        exp = {
-            "temperature": [], "top_k": [], "top_p": [], "seeds": [],
-            "rep": [], "freq": [], "presence": [],
-        }
-        rep = list(sampling.get("rep") or [1.0] * n_rows)
-        freq = list(sampling.get("freq") or [0.0] * n_rows)
-        presence = list(sampling.get("presence") or [0.0] * n_rows)
+        # per-entry sampling expansion happens IN-XLA (_ragged_step's
+        # row_seq/row_j gather+seed-fold): the staged base is the per-
+        # SEQUENCE params — stable across verify iterations, so
+        # _device_sampling cache-hits instead of rebuilding + re-staging
+        # a fresh per-entry expansion every dispatch. Chunk (and pad)
+        # entries point at a padding base row: greedy, seed 0 — exactly
+        # the params the host expansion gave them.
+        row_seq = np.zeros(seg_cap, np.int32)
+        row_j = np.zeros(seg_cap, np.int32)
+        w2 = 0
         for i in range(n_rows):
-            seed = int(sampling["seeds"][i])
-            for j in range(row_lens[i]):
-                exp["temperature"].append(sampling["temperature"][i])
-                exp["top_k"].append(sampling["top_k"][i])
-                exp["top_p"].append(sampling["top_p"][i])
-                exp["seeds"].append(
-                    seed if j == 0 else (seed * 1000003 + j) & 0x7FFFFFFF
-                )
-                exp["rep"].append(rep[i])
-                exp["freq"].append(freq[i])
-                exp["presence"].append(presence[i])
-        for _ in chunks:
-            exp["temperature"].append(0.0)
-            exp["top_k"].append(0)
-            exp["top_p"].append(1.0)
-            exp["seeds"].append(0)
-            exp["rep"].append(1.0)
-            exp["freq"].append(0.0)
-            exp["presence"].append(0.0)
+            row_seq[w2 : w2 + row_lens[i]] = i
+            row_j[w2 : w2 + row_lens[i]] = np.arange(row_lens[i])
+            w2 += row_lens[i]
+        # chunk entries (and trailing pad rows) sample with padding
+        # params; n_rows < seg_cap whenever chunk entries exist (entries
+        # = sum(row_lens) + len(chunks) <= seg_cap and row_lens >= 1)
+        row_seq[w2:] = min(n_rows, seg_cap - 1)
         row_masks = None
         if masks:
             # guided rows ride the verify dispatch as draft-less q_len=1
@@ -1550,14 +1773,16 @@ class ModelRunner:
                 jnp.asarray(md["meta"]),
                 jnp.asarray(gather),
             )
-            samp = self._device_sampling(exp, seg_cap)
+            samp = self._device_sampling(sampling, seg_cap)
+            row_seq_d = jnp.asarray(row_seq)
+            row_j_d = jnp.asarray(row_j)
             step_d = jnp.int32(step)
             seg_mask = self._seg_mask(row_masks, seg_cap)
             seg_bias = self._seg_bias(row_biases, seg_cap)
         sampled, seg_logits, self.k_pool, self.v_pool = self._jit_ragged(
             self.params, *staged,
             self.k_pool, self.v_pool,
-            samp, step_d, seg_mask, seg_bias,
+            samp, row_seq_d, row_j_d, step_d, seg_mask, seg_bias,
         )
         with self._allow("token_readback"):
             sampled_h = np.asarray(jax.device_get(sampled))  # one bulk sync
@@ -1566,8 +1791,103 @@ class ModelRunner:
         for ln in row_lens:
             out.append(sampled_h[w : w + ln])
             w += ln
-        chunk_logits = seg_logits[chunk_entry0 : chunk_entry0 + len(chunks)]
+        if chunks:
+            # slicing with host ints stages them as dynamic-slice starts;
+            # that is dispatch staging, same budget as the operand block
+            with self._allow("verify_staging"):
+                chunk_logits = seg_logits[
+                    chunk_entry0 : chunk_entry0 + len(chunks)
+                ]
+        else:
+            chunk_logits = []  # no slice at all: a zero-length take would
+            # still stage its bounds and trip the strict transfer guard
         return out, chunk_logits
+
+    # -- device n-gram draft ring ------------------------------------------
+    def ensure_draft_ring(
+        self, slots: int, k: int, window: int = DRAFT_RING_WINDOW,
+    ) -> int:
+        """Allocate the device draft ring ([slots, window] history + per-
+        slot lengths) and WARM the draft jit — compile happens here, at
+        engine-enable time, never inside the warm loop (the sanitizer's
+        recompile tripwire freezes family variants after warmup).
+        Returns the per-iteration delta capacity D: the engine resets a
+        slot (host-mirror rewrite + cold restage) when a sequence
+        commits more than D tokens between proposals."""
+        D = max(16, int(k) + 2)
+        shape = (int(slots), int(window), D)
+        if self._draft_ring_shape == shape and self._draft_ring is not None:
+            return D
+        hist = np.full((slots, window), -1, np.int32)
+        lens = np.zeros(slots, np.int32)
+        self._draft_ring_host = (hist, lens)
+        with self._allow("spec_staging"):
+            self._draft_ring = (jnp.asarray(hist), jnp.asarray(lens))
+            zt = jnp.full((slots, D), -1, jnp.int32)
+            zn = jnp.zeros(slots, jnp.int32)
+        h, l = self._draft_ring
+        h, l, _, _ = self._jit_draft_ring(h, l, zt, zn, int(k))
+        self._draft_ring = (h, l)
+        self._draft_ring_dirty = False
+        self._draft_ring_shape = shape
+        return D
+
+    def draft_ring_reset(self, slot: int, tokens: Sequence[int]) -> None:
+        """Rewrite one slot's history (admission, slot reuse, or a delta
+        too large for the append bucket) in the HOST mirror; the next
+        draft_step restages the whole ring — cold-path by construction,
+        the warm loop only ever appends deltas."""
+        hist, lens = self._draft_ring_host
+        W = hist.shape[1]
+        tail = list(tokens)[-W:]
+        hist[slot] = -1
+        hist[slot, : len(tail)] = tail
+        lens[slot] = len(tail)
+        self._draft_ring_dirty = True
+
+    def draft_step(
+        self, updates: Sequence[Tuple[int, Sequence[int]]], k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One fused device draft step: append each (slot, delta) to the
+        ring and propose k continuation tokens per slot (see
+        _draft_ring_step). Stages only the [SLOTS, D] delta; the
+        proposal readback is the loop's single draft-side host touch
+        (sanitizer label draft_readback). Returns (drafts [SLOTS, k],
+        n_prop [SLOTS]) host arrays."""
+        slots, W, D = self._draft_ring_shape
+        hist_h, lens_h = self._draft_ring_host
+        upd_tok = np.full((slots, D), -1, np.int32)
+        upd_n = np.zeros(slots, np.int32)
+        for slot, delta in updates:
+            d = list(delta)
+            assert len(d) <= D, "draft delta exceeds ring bucket (reset)"
+            upd_tok[slot, : len(d)] = d
+            upd_n[slot] = len(d)
+            # mirror the append so a later reset/restage stays coherent
+            n = len(d)
+            if lens_h[slot] + n > W:
+                over = lens_h[slot] + n - W
+                hist_h[slot, : W - over] = hist_h[slot, over:]
+                lens_h[slot] -= over
+            hist_h[slot, lens_h[slot] : lens_h[slot] + n] = d
+            lens_h[slot] += n
+        with self._allow("spec_staging"):
+            if self._draft_ring_dirty:
+                # cold restage after slot resets: the device ring is
+                # rebuilt from the mirror (deltas above are already in
+                # the mirror, so stage ZERO updates this round)
+                self._draft_ring = (jnp.asarray(hist_h), jnp.asarray(lens_h))
+                self._draft_ring_dirty = False
+                upd_tok[:] = -1
+                upd_n[:] = 0
+            ut = jnp.asarray(upd_tok)
+            un = jnp.asarray(upd_n)
+        h, l = self._draft_ring
+        h, l, drafts, n_prop = self._jit_draft_ring(h, l, ut, un, int(k))
+        self._draft_ring = (h, l)
+        with self._allow("draft_readback"):
+            d_h, n_h = jax.device_get((drafts, n_prop))
+        return np.asarray(d_h), np.asarray(n_h)
 
     def compile_stats(self) -> Dict[str, Dict[str, Any]]:
         """Per step-function family: compiled-variant count, cumulative

@@ -87,6 +87,12 @@ class Sequence:
     # (engine sets before step_plan; the scheduler trims them to the
     # mixed token budget; the engine consumes and clears after verify)
     spec_draft: List[int] = field(default_factory=list)
+    # tree speculation: EXTRA candidate branches beyond spec_draft (which
+    # is branch 0). Each rides the verify dispatch as its own segment on
+    # a forked page table sharing the trunk; the scheduler charges every
+    # branch's tokens against the mixed pool and sheds branches before
+    # it trims the primary draft (a branch is strictly optional work)
+    spec_tree: List[List[int]] = field(default_factory=list)
     # fork-on-branch (n>1 sampling): the parent carries n_branches; each
     # forked sibling carries branch_of=<parent request_id> and its choice
     # index, and shares the parent's trunk pages copy-on-write
@@ -270,7 +276,7 @@ class Scheduler:
         # verify rows are charged from the pool's leftover only
         pplans = self._plan_prefills(prefill_seqs) if prefill_seq else []
         self._trim_spec(running, pplans, cap)
-        spec_tokens = sum(len(s.spec_draft) for s in running)
+        spec_tokens = sum(self._spec_cost(s) for s in running)
         if spec_tokens:
             # verify rows and fused multi-step decode don't mix: a verify
             # dispatch already advances speculating rows by up to K+1
@@ -281,7 +287,7 @@ class Scheduler:
                 return self._plan_prefill(prefill_seq)
             self._update_stats(0)
             return None
-        spec_tokens = sum(len(s.spec_draft) for s in running)
+        spec_tokens = sum(self._spec_cost(s) for s in running)
         if prefill_seq is None:
             self._update_stats(len(running) * n_steps + spec_tokens)
             return DecodePlan(running, n_steps)
@@ -290,6 +296,16 @@ class Scheduler:
             + sum(len(p.chunk) for p in pplans)
         )
         return MixedPlan(prefills=pplans, decode=DecodePlan(running, n_steps))
+
+    @staticmethod
+    def _spec_cost(s: Sequence) -> int:
+        """Charged verify tokens for one sequence: the primary draft's
+        tokens (its +1 verify position is the row's own decode slot)
+        plus EVERY token of every extra tree branch (a branch row's
+        position-0 entry has no decode slot to hide behind — all
+        len(b)+1 entries are extra flat tokens and sampled rows; the
+        twin bills them identically, keeping tree A/Bs honest)."""
+        return len(s.spec_draft) + sum(len(b) + 1 for b in s.spec_tree)
 
     def _trim_spec(
         self, running: List[Sequence], pplans: List[PrefillPlan], cap: int
@@ -305,6 +321,7 @@ class Scheduler:
         if self.mixed_prefill_tokens <= 0:
             for s in running:
                 s.spec_draft = []
+                s.spec_tree = []
             return
         left = self.mixed_prefill_tokens - sum(len(p.chunk) for p in pplans)
         if self.spec_max_tokens:
@@ -316,6 +333,7 @@ class Scheduler:
             seg_left = self.spec_seg_budget - len(running) - len(pplans)
         for s in running:
             if not s.spec_draft:
+                s.spec_tree = []  # branches never ride without a primary
                 continue
             take = min(len(s.spec_draft), max(0, left))
             if seg_left is not None:
@@ -327,10 +345,33 @@ class Scheduler:
                 int((s.stop or {}).get("max_tokens", 1 << 30)) - s.n_generated
             )
             take = min(take, max(0, remaining))
+            if take < len(s.spec_draft):
+                # the primary draft itself was trimmed — branches are
+                # strictly optional, shed them all before clipping it
+                s.spec_tree = []
             s.spec_draft = s.spec_draft[:take]
             left -= take
             if seg_left is not None:
                 seg_left -= take
+            # extra tree branches: each costs len(b)+1 flat tokens AND
+            # len(b)+1 sampled-row slots (no decode slot of its own) plus
+            # one ragged segment; shed whole branches from the tail when
+            # the leftover can't carry them. Branches longer than the
+            # (possibly clipped) primary are clipped to it — the fork's
+            # page capacity is only guaranteed that far.
+            kept: List[List[int]] = []
+            for b in s.spec_tree:
+                b = b[:take]
+                cost = len(b) + 1
+                if not b or cost > max(0, left) or (
+                    seg_left is not None and cost > max(0, seg_left)
+                ):
+                    continue
+                kept.append(b)
+                left -= cost
+                if seg_left is not None:
+                    seg_left -= cost
+            s.spec_tree = kept
 
     # -- admission ---------------------------------------------------------
     def _admit(self) -> None:
@@ -556,6 +597,7 @@ class Scheduler:
         seq.computed_len = 0
         seq.n_preemptions += 1
         seq.spec_draft = []  # stale drafts must not ride the re-admission
+        seq.spec_tree = []
         seq.state = SeqState.WAITING
         # re-admit with prompt = all tokens so far (already-emitted ones are
         # not re-emitted; generation resumes with the next sampled token)
@@ -602,6 +644,8 @@ class Scheduler:
         seq.finish_reason = reason
         self.pool.release(seq.pages)
         seq.pages = []
+        seq.spec_draft = []
+        seq.spec_tree = []
         if seq in self.active:
             self.active.remove(seq)
 

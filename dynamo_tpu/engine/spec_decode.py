@@ -27,6 +27,16 @@ sharing, and preemption need no extra bookkeeping.
 The reference framework inherits speculative decoding from its delegated
 engines (vLLM/TRT-LLM spec-decode configs surfaced through
 components/src/dynamo/vllm flags); this is the native TPU implementation.
+
+Relation to the host-side deterministic path: `accept_and_finalize` with
+q = one-hot(draft) degenerates to `ngram_draft.accept_deterministic`
+(proven equivalent by tests/test_spec_decode.py), and
+`ngram_draft.accept_tree` is that same specialization walked down a trie
+of candidate branches — each branch's verify row is an independent
+ragged segment on a forked page table, and identical branch prefixes
+sample identically, so the lowest-live-branch walk emits target samples
+of exactly the emitted prefix at every depth (distribution-preserving
+at any temperature; see docs/spec_decode.md).
 """
 
 from __future__ import annotations

@@ -62,6 +62,9 @@ def parse_args(argv=None):
     p.add_argument("--spec-max-tokens", type=int, default=0,
                    help="per-iteration drafted-token cap (0 = leftover "
                         "mixed prefill budget)")
+    p.add_argument("--spec-branches", type=int, default=1,
+                   help="tree speculation: candidate branches per "
+                        "speculating sequence (1 = linear K drafts)")
     p.add_argument("--spec-accept-rate", type=float, default=None,
                    help="oracle drafter: corrupt the true stream per "
                         "position with prob 1-rate instead of n-gram "
@@ -115,6 +118,7 @@ def build_mock_engine(
         spec_ngram=getattr(args, "spec_ngram", False),
         spec_k=getattr(args, "spec_k", 4),
         spec_max_tokens=getattr(args, "spec_max_tokens", 0),
+        spec_branches=getattr(args, "spec_branches", 1),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_root=getattr(args, "disk_kv_root", None),

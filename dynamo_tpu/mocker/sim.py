@@ -56,6 +56,11 @@ class SimTiming:
     # fork-on-branch CoW: one page's KV duplicated on-device when a
     # branch takes a private copy of the shared trunk's partial tail
     page_copy_s: float = 0.0002
+    # device n-gram draft ring: ONE fused append+propose dispatch per
+    # speculating iteration (engine._device_draft). Billed per call, not
+    # per row — the whole point of the ring is that proposal cost stops
+    # scaling with batch and history length
+    draft_propose_s: float = 0.0002
     speed: float = 1.0  # scale all sleeps; 0 disables (unit tests)
     # prefill_packed cost mode. "ragged" (default) charges
     # sum(chunk_tokens) — the flat-token dispatch the ragged runner path
@@ -323,6 +328,9 @@ class SimRunner:
             "packed_tokens_charged": 0,
             "spec_dispatches": 0,
             "spec_tokens_charged": 0,
+            # device draft ring: fused append+propose dispatches billed
+            # (engine._device_draft issues at most one per iteration)
+            "draft_dispatches": 0,
             "onboards_streamed": 0,
             "onboard_overlap_s": 0.0,
             "page_copies": 0,
@@ -384,7 +392,7 @@ class SimRunner:
     def decode_multi(
         self, n_steps: int, tokens: List[int], positions: List[int],
         page_tables, sampling, step: int, adapters=None, masks=None,
-        mask_fn=None,
+        mask_fn=None, guided_dev=None,
     ) -> np.ndarray:
         t = self.timing
         t.sleep(
@@ -392,6 +400,24 @@ class SimRunner:
             + n_steps * (t.decode_base_s + len(tokens) * t.decode_per_seq_s)
         )
         self._drain_onboard()
+        # device-resident guided plan: the numpy twin of the runner's
+        # in-XLA DFA walk (_decode_loop's `guided` operand) — combined
+        # transition/mask tables, per-row global states, advance-before-
+        # mask on every step after the first. Byte-identity between this
+        # and the mask_fn callback path is what pins the device tables
+        # as a pure transport change (tests/test_guided.py).
+        gtrans = gmask = gstate = None
+        gpend = False
+        if guided_dev is not None:
+            from dynamo_tpu.guided.device_table import combine_tables
+
+            g_tables, g_rows, gpend = guided_dev
+            gtrans, gmask, offs = combine_tables(g_tables)
+            gstate = np.full(len(tokens), gtrans.shape[0] - 1, np.int64)
+            for i, ent in enumerate(g_rows):
+                if ent is not None:
+                    ti, st = ent
+                    gstate[i] = offs[ti] + int(st)
         # step-outer: each fused step is seeded by the PREVIOUS EMITTED
         # token (like the real on-device feedback loop, where the masked
         # sample is what gets fed back), so the sim stream is a pure
@@ -402,7 +428,11 @@ class SimRunner:
         out = np.zeros((len(tokens), n_steps), np.int32)
         prev = list(tokens)
         for j in range(n_steps):
-            if mask_fn is not None:
+            if gtrans is not None:
+                if j > 0 or gpend:
+                    gstate = gtrans[gstate, prev]
+                m = gmask[gstate]
+            elif mask_fn is not None:
                 # the engine's host-callback mask context: advances the
                 # per-row DFA state off the step's emitted tokens, same
                 # contract the real runner's io_callback uses
@@ -443,6 +473,76 @@ class SimRunner:
                 drafts.append((true - 16 + 1) % (self.vocab_size - 16) + 16)
             prev = true  # the oracle keeps proposing along the true stream
         return drafts
+
+    def spec_draft_tree(self, last_token: int, pos: int, k: int,
+                        branches: int):
+        """Tree-draft oracle: branch 0 is exactly spec_draft's proposal;
+        extra branches follow the same true stream with an INDEPENDENT
+        corruption pattern at the same per-position accept rate. At equal
+        per-branch acceptance, the union of branches accepts strictly
+        more prefix than any single branch — the effect tree speculation
+        spends its forked verify rows to buy, which is what
+        `bench_spec.py --tree` A/Bs measure. Returns None when the
+        oracle knob is unset (the engine then uses host tree proposal)."""
+        rate = self.spec_accept_rate
+        if rate is None:
+            return None
+        out = [self.spec_draft(last_token, pos, k)]
+        for b in range(1, max(1, branches)):
+            drafts: List[int] = []
+            prev = last_token
+            for j in range(k):
+                true = _sim_token(prev, pos + 1 + j, self.vocab_size)
+                u = _sim_token(
+                    (prev ^ 0x5BD1E99) + 7919 * b, pos + 1 + j,
+                    self.vocab_size,
+                )
+                if (u % 10000) / 10000.0 < rate:
+                    drafts.append(true)
+                else:
+                    drafts.append(
+                        (true - 16 + 1 + b) % (self.vocab_size - 16) + 16
+                    )
+                prev = true
+            out.append(drafts)
+        return out
+
+    # -- device n-gram draft ring (numpy twin of ModelRunner's jitted
+    # ring; see model_runner._draft_ring_step) ------------------------------
+    def ensure_draft_ring(self, slots: int, k: int, window: int = 512) -> int:
+        self._draft_hist: List[List[int]] = [[] for _ in range(int(slots))]
+        self._draft_window = int(window)
+        return max(16, int(k) + 2)
+
+    def draft_ring_reset(self, slot: int, tokens: List[int]) -> None:
+        self._draft_hist[slot] = [int(x) for x in tokens][-self._draft_window:]
+
+    def draft_step(self, updates, k: int):
+        """Numpy twin of the fused device proposal: append each (slot,
+        delta), then propose per slot with the SAME suffix-match
+        semantics as the host scan bounded to the ring window. Billed as
+        ONE dispatch regardless of batch — the cost shape that makes
+        device drafting worth A/B-ing against the per-sequence scan."""
+        from dynamo_tpu.engine.ngram_draft import propose
+
+        t = self.timing
+        self.stats["draft_dispatches"] += 1
+        t.sleep(t.draft_propose_s)
+        W = self._draft_window
+        for slot, delta in updates:
+            h = self._draft_hist[slot]
+            h.extend(int(x) for x in delta)
+            if len(h) > W:
+                del h[: len(h) - W]
+        slots = len(self._draft_hist)
+        drafts = np.full((slots, max(1, int(k))), -1, np.int32)
+        n_prop = np.zeros(slots, np.int32)
+        for s, h in enumerate(self._draft_hist):
+            d = propose(h, int(k), window=W)
+            n_prop[s] = len(d)
+            if d:
+                drafts[s, : len(d)] = d
+        return drafts, n_prop
 
     def verify_spec(
         self, tokens: List[int], positions: List[int], page_tables,

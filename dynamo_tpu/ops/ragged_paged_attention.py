@@ -104,6 +104,13 @@ def build_ragged_metadata(
     RoPE / jnp fallback (tok_*) and the per-segment last-token gather
     (last_index). Padding tokens get tok_pos=-1 (KV write drops them) but
     tok_kv_len=1 so the jnp fallback's softmax stays finite.
+
+    Segments are fully independent — each brings its own page-table row
+    and kv_len — which is what lets speculative verify treat tree
+    branches as ordinary extra segments: a branch rides the dispatch on
+    its forked table (trunk pages shared by reference, divergent tail
+    copied), and this metadata neither knows nor cares that two
+    segments' rows alias the same physical pages.
     """
     n = len(q_lens)
     t_real = int(sum(q_lens))

@@ -282,6 +282,8 @@ class DigestBuilder:
                     "accept_rate": (spec.get("accepted", 0)
                                     / max(1, spec.get("drafted", 0))),
                     "accepted_per_step": spec.get("spec_emitted", 0) / rows,
+                    "tree_rows": spec.get("tree_rows", 0),
+                    "tree_switches": spec.get("tree_switches", 0),
                 }
             pool = getattr(engine, "pool", None)
             if pool is not None and hasattr(pool, "match_hit_blocks"):

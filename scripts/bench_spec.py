@@ -47,7 +47,7 @@ def _pct(vals, p):
     return round(vals[min(len(vals) - 1, int(p * len(vals)))], 6) if vals else 0.0
 
 
-def _engine(args, rate, k, spec):
+def _engine(args, rate, k, spec, branches=1):
     runner = SimRunner(
         num_pages=2048, page_size=16, max_pages_per_seq=64,
         timing=SimTiming(speed=args.speed),
@@ -56,13 +56,13 @@ def _engine(args, rate, k, spec):
     engine = InferenceEngine(
         runner, max_batch=16, chunk_size=512, decode_steps=4,
         mixed_prefill_tokens=256, mixed_prefill_seqs=4, mixed_min_chunk=16,
-        spec_ngram=spec, spec_k=k,
+        spec_ngram=spec, spec_k=k, spec_branches=branches,
     )
     return runner, engine
 
 
-async def _serve(args, rate, k, spec, burst=0):
-    runner, engine = _engine(args, rate, k, spec)
+async def _serve(args, rate, k, spec, burst=0, branches=1):
+    runner, engine = _engine(args, rate, k, spec, branches)
     engine.start()
     try:
         async def one(isl, osl, delay, seed):
@@ -114,6 +114,48 @@ async def _serve(args, rate, k, spec, burst=0):
     return res
 
 
+def _tree_main(args) -> int:
+    """Tree-speculation A/B: branches=N vs linear-K at EQUAL oracle
+    accept rate (the corruption knob is identical per arm, so any billed
+    ITL win comes purely from sibling branches rescuing primary-draft
+    mismatches — more emitted tokens per fixed-cost verify dispatch).
+    Greedy bytes are sha-pinned identical across baseline/linear/tree.
+
+    Defaults to ONE stream: tree speculation spends extra billed verify
+    tokens (len+1 per branch) to finish in fewer fixed-cost dispatches,
+    which is a LATENCY trade — at high decode concurrency the dispatch
+    fixed cost is already amortized across the batch and the extra
+    charged tokens erase the win (pass --seqs to see that regime).
+    Prints one JSON line {"metric": "spec_tree_itl", ...}."""
+    base = asyncio.run(_serve(args, None, args.k, spec=False))
+    report = {"metric": "spec_tree_itl", "seqs": args.seqs,
+              "osl": args.osl, "k": args.k, "branches": args.branches,
+              "baseline": {k: v for k, v in base.items() if k != "spec"}}
+    arms = []
+    for rate in (0.5, 0.7):
+        lin = asyncio.run(_serve(args, rate, args.k, spec=True))
+        tree = asyncio.run(
+            _serve(args, rate, args.k, spec=True, branches=args.branches))
+        assert lin["output_sha"] == base["output_sha"], (
+            f"linear byte-identity broken at rate={rate}")
+        assert tree["output_sha"] == base["output_sha"], (
+            f"tree byte-identity broken at rate={rate}")
+        arms.append({
+            "accept_rate": rate,
+            "itl_p50_linear_s": lin["itl_p50_s"],
+            "itl_p50_tree_s": tree["itl_p50_s"],
+            "tree_vs_linear_p50": round(
+                lin["itl_p50_s"] / max(tree["itl_p50_s"], 1e-9), 3),
+            "tree_vs_linear_p99": round(
+                lin["itl_p99_s"] / max(tree["itl_p99_s"], 1e-9), 3),
+            "linear_spec": lin["spec"],
+            "tree_spec": tree["spec"],
+        })
+    report["arms"] = arms
+    print(json.dumps(report))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seqs", type=int, default=4,
@@ -124,7 +166,19 @@ def main() -> int:
                     help="SimTiming scale (smaller = faster bench)")
     ap.add_argument("--burst", type=int, default=6,
                     help="late prompts in the bursty TTFT guard")
+    ap.add_argument("--tree", action="store_true",
+                    help="tree-speculation A/B (branches vs linear-K "
+                         "at equal accept rate) instead of the sweep")
+    ap.add_argument("--branches", type=int, default=3,
+                    help="candidate branches per sequence in --tree")
+    ap.add_argument("--k", type=int, default=8,
+                    help="draft length for --tree arms")
     args = ap.parse_args()
+
+    if args.tree:
+        if "--seqs" not in sys.argv:
+            args.seqs = 1  # single-stream latency regime (see _tree_main)
+        return _tree_main(args)
 
     base = asyncio.run(_serve(args, None, 4, spec=False))
     report = {"metric": "spec_decode_itl",

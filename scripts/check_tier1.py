@@ -48,6 +48,15 @@ def main() -> int:
                           help="also run the dynmc smoke gate (default)")
     mc_group.add_argument("--no-mc", dest="mc", action="store_false",
                           help="skip the dynmc gate")
+    san_group = ap.add_mutually_exclusive_group()
+    san_group.add_argument("--san", dest="san", action="store_true",
+                           default=True,
+                           help="also run the strict-sanitizer warm-loop "
+                                "assertion (default): a DYN_SAN=1 decode "
+                                "with speculation must finish with zero "
+                                "violations")
+    san_group.add_argument("--no-san", dest="san", action="store_false",
+                           help="skip the strict warm-loop assertion")
     args = ap.parse_args()
     required = args.require if args.require is not None else [
         "test_sched_packing.py", "test_ragged_mixed.py",
@@ -134,6 +143,64 @@ def main() -> int:
             print(detail.stdout + detail.stderr, file=sys.stderr)
     ok = ok and mc_ok
 
+    warm_ok = True
+    if args.san:
+        # strict-sanitizer warm-loop assertion: a real-runner decode with
+        # n-gram speculation (device draft ring + fused multi-step loop)
+        # under DYN_SAN=1 strict must complete with ZERO violations — the
+        # transfer guard and recompile tripwire prove the warm loop stays
+        # free of host syncs and new compile families (docs/perf_notes.md)
+        warm_code = (
+            "import asyncio\n"
+            "from dynamo_tpu.engine.engine import InferenceEngine\n"
+            "from dynamo_tpu.engine.model_runner import ModelRunner\n"
+            "from dynamo_tpu.models.config import get_config\n"
+            "from dynamo_tpu.runtime.context import Context\n"
+            "async def main():\n"
+            "    runner = ModelRunner(get_config('tiny'), num_pages=96,\n"
+            "        page_size=4, max_pages_per_seq=16,\n"
+            "        decode_buckets=(1, 2, 4), prefill_buckets=(8, 16),\n"
+            "        seed=7)\n"
+            "    engine = InferenceEngine(runner, max_batch=4,\n"
+            "        chunk_size=16, mixed_prefill_tokens=32,\n"
+            "        decode_steps=4, spec_ngram=True, spec_k=3)\n"
+            "    assert engine.sanitizer is not None\n"
+            "    assert engine.sanitizer.strict\n"
+            "    engine.start()\n"
+            "    try:\n"
+            "        async def one(p, i):\n"
+            "            async for item in engine.generate(\n"
+            "                {'token_ids': p,\n"
+            "                 'sampling': {'temperature': 0.0,\n"
+            "                              'seed': 11 + i},\n"
+            "                 'stop': {'max_tokens': 48,\n"
+            "                          'stop_ids': []}}, Context()):\n"
+            "                assert item.get('finish_reason') != 'error', \\\n"
+            "                    item\n"
+            "                if item['finish_reason']:\n"
+            "                    break\n"
+            "        await asyncio.gather(*[one([3, 1, 4, 1] * (2 + i), i)\n"
+            "                               for i in range(3)])\n"
+            "    finally:\n"
+            "        engine.stop()\n"
+            "    assert engine.sanitizer.ok(), engine.sanitizer.report()\n"
+            "asyncio.run(main())\n"
+            "print('warm-loop-clean')\n"
+        )
+        warm_proc = subprocess.run(
+            [sys.executable, "-c", warm_code],
+            cwd=REPO, env=dict(env, DYN_SAN="1"), capture_output=True,
+            text=True, timeout=args.timeout,
+        )
+        warm_ok = (warm_proc.returncode == 0
+                   and "warm-loop-clean" in warm_proc.stdout)
+        if not warm_ok:
+            print("TIER-1 CHECK FAILED: strict-sanitizer warm-loop "
+                  "assertion (host sync or recompile in the warm decode "
+                  "loop)", file=sys.stderr)
+            print(warm_proc.stdout + warm_proc.stderr, file=sys.stderr)
+    ok = ok and warm_ok
+
     # runtime-sanitizer self-check (jax-free): the lock-cycle detector,
     # allowlist rejection, and strict-raise plumbing must work before any
     # --sanitize run or fleet-sim chaos test can be trusted
@@ -152,7 +219,8 @@ def main() -> int:
     print(json.dumps({"metric": "tier1_collection", "ok": ok,
                       "collected": collected, "errors": errors,
                       "missing": missing, "lint_ok": lint_ok,
-                      "mc_ok": mc_ok, "sanitizer_ok": sanitizer_ok}))
+                      "mc_ok": mc_ok, "sanitizer_ok": sanitizer_ok,
+                      "warm_loop_ok": warm_ok}))
     if not ok:
         # loud: surface the collection tracebacks so the broken import is
         # visible in CI logs, not just the count

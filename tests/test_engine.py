@@ -427,3 +427,51 @@ def test_uncapped_generation_stops_at_model_context():
     assert item["finish_reason"] == "error"
     assert "exceeds" in item["error"]
     eng.stop()
+
+
+def test_decode_multi_async_chains_without_intermediate_readback():
+    """Double-buffered dispatch primitive: dispatch N+1 may consume
+    dispatch N's `last` DEVICE array as its token input — two chained
+    async dispatches with ONE readback at the end must produce the same
+    stream as one fused dispatch of the combined length, and a chained
+    array whose bucket does not match must be rejected loudly."""
+    import jax
+    import numpy as np
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+
+    runner = ModelRunner(get_config("tiny"), num_pages=64, page_size=4,
+                         max_pages_per_seq=16, decode_buckets=(1, 2, 4),
+                         prefill_buckets=(8, 16), seed=3)
+    prompts = [[5, 6, 7, 8], [9, 1, 2, 3]]
+    samp = {"temperature": [0.0, 0.0], "top_k": [0, 0],
+            "top_p": [1.0, 1.0], "seeds": [11, 12]}
+    pts, first = [], []
+    for i, p in enumerate(prompts):
+        pt = list(range(4 * i, 4 * i + 4))
+        logits = runner.prefill(p, 0, pt, 0)
+        pts.append(pt)
+        first.append(int(np.argmax(np.asarray(logits))))
+    positions = [len(p) for p in prompts]
+
+    # one fused 8-step dispatch (the reference stream)
+    want = runner.decode_multi(
+        8, first, positions, pts, samp, 0)[:2, :]
+
+    # two chained 4-step async dispatches, no host sync in between
+    toks_a, last = runner.decode_multi_async(
+        4, first, positions, pts, samp, 0)
+    assert isinstance(last, jax.Array)
+    toks_b, _ = runner.decode_multi_async(
+        4, last, [p + 4 for p in positions], pts, samp, 4)
+    got = np.concatenate(
+        [np.asarray(jax.device_get(t))[:2] for t in (toks_a, toks_b)],
+        axis=1)
+    assert (got == np.asarray(want)).all(), (got, want)
+
+    # a chained array from a different bucket must fail loudly, not
+    # silently re-bucket (the pipeline contract is a stable bucket)
+    with pytest.raises(ValueError, match="bucket"):
+        runner.decode_multi_async(2, last, [positions[0] + 4],
+                                  [pts[0]], {"temperature": [0.0], "top_k": [0],
+                                             "top_p": [1.0], "seeds": [11]}, 4)

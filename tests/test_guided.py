@@ -678,3 +678,109 @@ def test_engine_compile_guided_single_flight_cache():
     assert len(got) == 4
     assert all(g is got[0] for g in got)  # one canonical matcher
     assert engine._compile_guided(dict(spec)) is got[0]  # cache hit
+
+
+# -- device-resident DFA tables (zero-host-sync guided decode) ---------------
+
+import logging
+
+from dynamo_tpu.guided.device_table import (
+    DeviceGuidedTable,
+    build_device_table,
+    combine_tables,
+)
+
+
+def test_device_table_matches_matcher_rows():
+    """The dense [S+1, V] tables must be byte-identical to the host
+    matcher: mask row s == matcher.allowed(s) (+ force-EOS degrade), and
+    every allowed transition == matcher.advance. EOS and banned tokens
+    route to the all-True self-looping DEAD row."""
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex(r'\{"k": (true|false)\}'))
+    tab = build_device_table(m)
+    assert tab is not None and tab.start == m.start
+    for s in range(tab.n_states):
+        want = m.allowed(s).copy()
+        if not want.any():
+            want[tok.eos_id] = True  # degrade rule
+        assert (tab.mask[s] == want).all(), s
+        assert (tab.trans[s][~want] == tab.dead).all(), s
+        for t in np.nonzero(want)[0]:
+            t = int(t)
+            if t == tok.eos_id:
+                assert tab.trans[s, t] == tab.dead  # EOS is terminal
+            else:
+                assert tab.trans[s, t] == m.advance(s, t), (s, t)
+    assert (tab.trans[tab.dead] == tab.dead).all()
+    assert tab.mask[tab.dead].all()
+
+
+def test_device_table_budget_refusal_and_uid():
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    m = lf.lift(compile_regex("[ab]{2}"))
+    assert build_device_table(m, max_elems=4) is None
+    a = build_device_table(m)
+    b = build_device_table(m)
+    assert a.uid != b.uid  # uids key the staging cache across rebuilds
+
+
+def test_combine_tables_offsets_and_dead_remap():
+    tok = ByteTokenizer()
+    lf = TokenLifter.for_tokenizer(tok, 258)
+    ta = build_device_table(lf.lift(compile_regex("[ab]{2}")))
+    tb = build_device_table(lf.lift(compile_regex("(yes|no)")))
+    trans, mask, offs = combine_tables([ta, tb])
+    G = ta.n_states + tb.n_states
+    assert trans.shape == (G + 1, 258)
+    assert offs == [0, ta.n_states]
+    for t, o in ((ta, 0), (tb, ta.n_states)):
+        for s in range(t.n_states):
+            assert (mask[o + s] == t.mask[s]).all()
+            local = t.trans[s]
+            want = np.where(local >= t.dead, G, local + o)
+            assert (trans[o + s] == want).all()
+    # the single shared DEAD row self-loops all-True
+    assert (trans[G] == G).all() and mask[G].all()
+
+
+async def test_sim_guided_device_plan_byte_identical_to_host_fallback(
+        monkeypatch, caplog):
+    """Satellite: on bounded schemas the device DFA plan and the host
+    io_callback fallback must emit identical bytes. Forcing the
+    fallback (tiny cell budget) warns per over-budget schema, once —
+    the sentinel is cached on the matcher, not re-logged per dispatch."""
+    import dynamo_tpu.guided.device_table as dt
+    from dynamo_tpu.engine.engine import InferenceEngine
+
+    work = [
+        ([10, 11, 12], {"kind": "regex", "pattern": "[ab]{6,12}"}),
+        ([20, 21], None),  # a free row co-batched with the guided ones
+        ([30, 31, 32], {"kind": "regex", "pattern": r"(yes|no) sir!"}),
+    ]
+
+    plans = []
+    orig = InferenceEngine._guided_device_plan
+
+    def spy(self, seqs):
+        out = orig(self, seqs)
+        plans.append(out is not None)
+        return out
+
+    monkeypatch.setattr(InferenceEngine, "_guided_device_plan", spy)
+    dev, _ = await _sim_guided(4, work)
+    assert any(plans), "device guided plan never engaged"
+
+    plans.clear()
+    monkeypatch.setattr(dt, "DEVICE_TABLE_MAX_ELEMS", 4)
+    with caplog.at_level(logging.WARNING, logger="dynamo_tpu.engine"):
+        host, _ = await _sim_guided(4, work)
+    assert not any(plans), "budget monkeypatch did not force the fallback"
+    assert host == dev
+    warns = [r for r in caplog.records
+             if "device DFA table budget" in r.getMessage()]
+    # one warning per over-budget schema first seen in a batch (the
+    # early whole-batch return may defer the second schema's build)
+    assert 1 <= len(warns) <= 2, [r.getMessage() for r in caplog.records]

@@ -107,7 +107,7 @@ def test_default_allowlist_is_the_documented_set():
     assert DEFAULT_ALLOWLIST == frozenset({
         "decode_staging", "spec_staging", "verify_staging",
         "sampling_staging", "token_readback", "embed_readback",
-        "kv_tier_io", "weight_reload",
+        "draft_readback", "kv_tier_io", "weight_reload",
     })
 
 
@@ -163,6 +163,25 @@ def test_recompile_tripwire_strict_raises_and_sim_runner_noop():
     for _ in range(8):
         san2.note_step(_NoFamilies())
     assert san2.ok() and san2.report()["steps"] == 8
+
+
+def test_recompile_tripwire_exempts_admission_families():
+    """A new prefill ('forward') bucket after warmup is admission-boundary
+    work — a first-of-its-size prompt or a preempted sequence re-prefilling
+    past its old bucket — and must be counted, not raised, even in strict
+    mode (found by a live-worker drive: an over-context request preempted,
+    re-prefilled into a bigger bucket, and killed the step thread)."""
+    san = Sanitizer(strict=True, transfer_guard=False, warmup_steps=1)
+    r = _FakeRunner()
+    r._families["forward"] = _Fam(2)
+    san.note_step(r)
+    r._families["forward"].variants = 3  # admission growth: soft
+    san.note_step(r)
+    assert san.ok()
+    assert san.counters["admission_recompiles"] == 1
+    r._families["decode"].variants += 1  # steady-state growth: still hard
+    with pytest.raises(SanitizerViolation, match="recompile"):
+        san.note_step(r)
 
 
 # -- lock-order recorder ----------------------------------------------------

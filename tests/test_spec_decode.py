@@ -541,3 +541,251 @@ async def test_real_runner_spec_byte_identity_and_zero_new_variants(
     fams_on = {k: v["variants"] for k, v in r_on.compile_stats().items()}
     assert set(fams_on) == set(fams_off), (fams_off, fams_on)
     assert fams_on["ragged"] == fams_off["ragged"], (fams_off, fams_on)
+
+
+# -- tree speculation -------------------------------------------------------
+
+from dynamo_tpu.engine.ngram_draft import accept_tree, propose_tree
+
+
+def test_propose_tree_branch0_equals_propose_and_dedups():
+    toks = [1, 2, 3, 9, 1, 2, 3, 7, 1, 2, 3]
+    assert propose_tree(toks, 4, 1) == [propose(toks, 4)]
+    tree = propose_tree(toks, 4, 3)
+    assert tree[0] == propose(toks, 4)
+    assert len({tuple(b) for b in tree}) == len(tree)  # deduped
+    assert all(len(b) <= len(tree[0]) for b in tree[1:])  # clipped
+    assert propose_tree([5], 4, 2) == []  # too short to match anything
+
+
+def test_accept_tree_one_branch_equals_accept_deterministic():
+    rng = np.random.default_rng(0)
+    for _ in range(300):
+        k = int(rng.integers(1, 5))
+        draft = rng.integers(0, 9, k).tolist()
+        row = rng.integers(0, 9, k + 1).tolist()
+        out, winner = accept_tree([draft], [row])
+        assert out == accept_deterministic(draft, row)
+        assert winner == 0
+
+
+def test_accept_tree_walks_trie_and_reports_winner():
+    # branch 1 rescues a primary mismatch at depth 1 and carries the
+    # walk to its own bonus token
+    out, w = accept_tree([[5, 6, 7], [5, 8, 7]],
+                         [[5, 8, 1, 0], [5, 8, 7, 3]])
+    assert (out, w) == ([5, 8, 7, 3], 1)
+    # mismatch everywhere at depth 0: the primary's sample corrects
+    out, w = accept_tree([[4], [6]], [[9, 0], [9, 0]])
+    assert (out, w) == ([9], 0)
+    # primary full match beats a diverging sibling: bonus from row 0
+    out, w = accept_tree([[5, 6], [5, 9]], [[5, 6, 42], [5, 9, 7]])
+    assert (out, w) == ([5, 6, 42], 0)
+
+
+def test_accept_tree_statistical_pin_preserves_target_distribution():
+    """temp>0 losslessness for the TREE walk: verify rows of branches
+    sharing a drafted prefix sample identically on that prefix (same
+    params, same seed, same fed tokens — the property real verify rows
+    have by construction). Model that with one lazy target sample per
+    distinct prefix; the marginal of emitted[j] given the walk reached
+    depth j must then equal the target law p for ANY draft tree."""
+    rng = np.random.default_rng(7)
+    V, N = 5, 20000
+    p = np.asarray([0.4, 0.25, 0.15, 0.12, 0.08])
+    drafts = [[0, 1, 2], [0, 0, 1], [1, 1, 1]]
+    counts = np.zeros((4, V))
+    reached = np.zeros(4)
+    for _ in range(N):
+        cache = {}
+
+        def sample_for(prefix):
+            if prefix not in cache:
+                cache[prefix] = int(rng.choice(V, p=p))
+            return cache[prefix]
+
+        rows = [[sample_for(tuple(d[:j])) for j in range(len(d) + 1)]
+                for d in drafts]
+        out, _ = accept_tree(drafts, rows)
+        for j, t in enumerate(out):
+            counts[j, t] += 1
+            reached[j] += 1
+    for j in range(4):
+        if reached[j] < 2000:
+            continue
+        emp = counts[j] / reached[j]
+        assert np.abs(emp - p).max() < 0.03, (j, emp, reached[j])
+
+
+def _tree_engine(spec=False, rate=None, k=4, branches=1, speed=0.0):
+    runner = SimRunner(num_pages=512, page_size=4, max_pages_per_seq=64,
+                       timing=SimTiming(speed=speed),
+                       spec_accept_rate=rate)
+    engine = InferenceEngine(
+        runner, max_batch=8, chunk_size=16, decode_steps=4,
+        mixed_prefill_tokens=64, spec_ngram=spec, spec_k=k,
+        spec_branches=branches,
+    )
+    return runner, engine
+
+
+async def test_sim_tree_greedy_byte_identity_and_switches():
+    """Tree verify rows must not perturb greedy output: sha-identical to
+    plain AND to linear-K speculation, across the oracle tree drafter
+    (corrupted siblings) and the host n-gram tree — and at least one
+    branch adoption must actually happen so the fork/adopt path is
+    exercised, not just compiled."""
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6] * 4,
+               [2, 7] * 10, [1, 2, 3, 4, 5] * 5]
+
+    async def run(spec, rate, branches):
+        _, e = _tree_engine(spec, rate, branches=branches)
+        e.start()
+        try:
+            outs = await asyncio.gather(
+                *[_sim_collect(e, p) for p in prompts])
+            return outs, e.spec_stats
+        finally:
+            e.stop()
+
+    base, _ = await run(False, None, 1)
+    want = _sha(base)
+    linear, st_lin = await run(True, 0.6, 1)
+    assert _sha(linear) == want
+    assert st_lin["tree_rows"] == 0 and st_lin["tree_switches"] == 0
+    switched = 0
+    for rate, branches in ((0.6, 2), (0.5, 3), (None, 3)):
+        outs, st = await run(True, rate, branches)
+        assert _sha(outs) == want, (rate, branches, base, outs)
+        if rate is not None:
+            assert st["tree_rows"] > 0, st  # branches actually dispatched
+        switched += st["tree_switches"]
+    assert switched > 0, "no branch adoption ever happened"
+
+
+async def test_sim_tree_kv_pool_state_matches_plain():
+    """Fork/adopt/release accounting: after identical traffic the pool
+    must hold zero live refs and the same free-page count and prefix
+    hash registry as plain decoding — losing branches, adopted trunks
+    and aborted forks all balance out."""
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6] * 4, [2, 7] * 10]
+
+    async def run(spec, branches):
+        r, e = _tree_engine(spec, 0.7, branches=branches)
+        e.start()
+        try:
+            await asyncio.gather(*[_sim_collect(e, p) for p in prompts])
+            follow = await _sim_collect(e, prompts[0][:16] + [8, 8])
+        finally:
+            e.stop()
+        pool = e.scheduler.pool
+        assert not pool.ref, pool.ref  # no live refs after all finished
+        return (sorted(pool.by_hash.keys()), pool.n_free), follow
+
+    plain, follow_plain = await run(False, 1)
+    tree, follow_tree = await run(True, 3)
+    assert plain == tree
+    assert follow_plain == follow_tree
+
+
+async def test_sim_tree_abort_releases_forks():
+    """A client that walks away mid-stream while tree verify rows are in
+    flight must leak nothing: scheduler drains and the pool drops every
+    ref (trunk AND branch forks)."""
+    r, e = _tree_engine(True, 0.8, branches=3, speed=1.0)
+    r.timing.decode_base_s = 0.02
+    r.timing.dispatch_overhead_s = 0.0
+    e.start()
+    try:
+        req = {"token_ids": [4, 2] * 12,
+               "sampling": {"temperature": 0.0, "seed": 3},
+               "stop": {"max_tokens": 64, "stop_ids": []}}
+        got = 0
+        gen = e.generate(req, Context())
+        async for item in gen:
+            got += len(item["token_ids"])
+            if got >= 2:
+                break
+        await gen.aclose()
+        for _ in range(100):
+            if not e.scheduler.active and not e.pool.ref:
+                break
+            await asyncio.sleep(0.05)
+        assert not e.scheduler.active
+        assert not e.pool.ref, e.pool.ref
+    finally:
+        e.stop()
+
+
+# -- device-resident draft ring --------------------------------------------
+
+
+def test_sim_draft_ring_matches_host_propose():
+    r = SimRunner(num_pages=64, page_size=4, max_pages_per_seq=16,
+                  timing=SimTiming(speed=0.0))
+    D = r.ensure_draft_ring(4, 3)
+    assert D >= 3 + 2
+    toks = [1, 2, 3, 9, 1, 2, 3, 7, 1, 2]
+    r.draft_ring_reset(0, toks)
+    r.draft_ring_reset(1, toks[:6])
+    drafts, n_prop = r.draft_step([], 3)
+    assert [int(t) for t in drafts[0][: n_prop[0]]] == propose(toks, 3)
+    # appending the tail as a delta must land in the same state
+    drafts, n_prop = r.draft_step([(1, toks[6:])], 3)
+    assert [int(t) for t in drafts[1][: n_prop[1]]] == propose(toks, 3)
+    assert r.stats["draft_dispatches"] == 2
+
+
+async def test_sim_engine_device_draft_byte_identity():
+    """With no oracle configured, the engine routes drafting through the
+    runner's draft ring; greedy output must stay byte-identical to both
+    plain decode and host n-gram drafting."""
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6] * 4, [2, 7] * 10]
+
+    async def run(spec, device):
+        r, e = _tree_engine(spec, None)
+        if not device:
+            e._spec_device_draft = False
+        e.start()
+        try:
+            outs = await asyncio.gather(
+                *[_sim_collect(e, p) for p in prompts])
+            return outs, r.stats.get("draft_dispatches", 0)
+        finally:
+            e.stop()
+
+    base, _ = await run(False, False)
+    host, n_host = await run(True, False)
+    dev, n_dev = await run(True, True)
+    assert _sha(host) == _sha(base)
+    assert _sha(dev) == _sha(base)
+    assert n_host == 0 and n_dev > 0, (n_host, n_dev)
+
+
+def test_real_runner_draft_ring_matches_host_propose():
+    """The jitted gather ring must be bit-identical to ngram_draft.propose
+    for histories within the ring window, across resets and chained
+    delta appends."""
+    runner = ModelRunner(get_config("tiny"), num_pages=16, page_size=4,
+                         max_pages_per_seq=4, seed=0)
+    D = runner.ensure_draft_ring(3, 4)
+    rng = np.random.default_rng(5)
+    hists = [rng.integers(16, 30, size=int(rng.integers(2, 60))).tolist()
+             for _ in range(3)]
+    for s, h in enumerate(hists):
+        runner.draft_ring_reset(s, h)
+    drafts, n_prop = runner.draft_step([], 4)
+    for s, h in enumerate(hists):
+        got = [int(t) for t in drafts[s][: int(n_prop[s])]]
+        assert got == propose(h, 4), (s, h, got)
+    for _ in range(5):
+        upd = []
+        for s in range(3):
+            d = rng.integers(16, 30, size=int(rng.integers(0, D))).tolist()
+            hists[s].extend(d)
+            if d:
+                upd.append((s, d))
+        drafts, n_prop = runner.draft_step(upd, 4)
+        for s, h in enumerate(hists):
+            got = [int(t) for t in drafts[s][: int(n_prop[s])]]
+            assert got == propose(h, 4), (s, h, got)
