@@ -123,6 +123,7 @@ def _cpu_mocker_fallback(metric_name: str, err, diag: dict) -> bool:
                 "tpu_unavailable": True,
                 "substrate": "cpu-mocker",
                 "fallback_basis": basis,
+                "notes": "pending real-chip actuator A/B",
                 "error": str(err),
                 **diag,
             }

@@ -363,6 +363,14 @@ class InferenceEngine:
         # variant instead of rounding up to the next power of two.
         if hasattr(runner, "ensure_ragged_bucket"):
             runner.ensure_ragged_bucket(mixed_prefill_tokens + max_batch)
+        # planner retune ceilings: the ragged bucket registered above and
+        # the draft ring sized below are compile-time commitments — a
+        # live retune (engine.retune) may move knobs DOWN and back up to
+        # these init values, never past them (a new compile family on the
+        # warm path is exactly what the recompile tripwire forbids)
+        self._mixed_tokens_init = int(mixed_prefill_tokens)
+        self._spec_k_init = self.spec_k
+        self.retunes = 0
         self.idle_sleep_s = idle_sleep_s
         self._inbox: thread_queue.Queue = thread_queue.Queue()
         self._streams: Dict[str, tuple[asyncio.Queue, asyncio.AbstractEventLoop]] = {}
@@ -440,6 +448,42 @@ class InferenceEngine:
 
     def on_fatal(self, cb) -> None:
         self._fatal_cb = cb
+
+    def retune(self, *, mixed_prefill_tokens: Optional[int] = None,
+               mixed_prefill_seqs: Optional[int] = None,
+               spec_k: Optional[int] = None) -> Dict[str, int]:
+        """Planner actuation surface: adjust the co-scheduling knobs of a
+        LIVE engine. Each knob is an int the step thread reads fresh
+        every iteration (plain attribute stores are atomic under the
+        GIL), so no pause is needed. Up-retunes are clamped to the
+        compile-time commitments made at construction: the ragged bucket
+        registered for `mixed_prefill_tokens + max_batch` and the draft
+        ring sized for the initial K — exceeding either would mint a new
+        compile family on the warm path. A DOWNWARD K retune on a
+        device-draft runner re-keys the draft jit (bounded: at most
+        init-K variants ever exist); strict-sanitizer deployments that
+        retune K should pre-warm the alternate Ks. Returns the values
+        actually in effect (callers journal these, not what they asked
+        for)."""
+        sched = self.scheduler
+        if mixed_prefill_tokens is not None:
+            cap = (self._mixed_tokens_init
+                   if hasattr(self.runner, "ensure_ragged_bucket")
+                   else max(self._mixed_tokens_init, mixed_prefill_tokens))
+            sched.mixed_prefill_tokens = max(0, min(int(mixed_prefill_tokens),
+                                                    cap))
+        if mixed_prefill_seqs is not None:
+            sched.mixed_prefill_seqs = max(1, int(mixed_prefill_seqs))
+        if spec_k is not None:
+            cap = (self._spec_k_init if self._spec_device_draft
+                   else max(self._spec_k_init, int(spec_k)))
+            self.spec_k = max(1, min(int(spec_k), cap))
+        self.retunes += 1
+        return {
+            "mixed_prefill_tokens": sched.mixed_prefill_tokens,
+            "mixed_prefill_seqs": sched.mixed_prefill_seqs,
+            "spec_k": self.spec_k,
+        }
 
     def _fail_everything(self, message: str) -> None:
         """Terminate every active/waiting/pending sequence with an error

@@ -83,6 +83,14 @@ def parse_args(argv=None):
                         "dict; default ttft:p99<2,itl:p50<0.05,e2e:p95<10")
     p.add_argument("--digest-window", type=float, default=60.0,
                    help="fleet observer aggregation window in seconds")
+    p.add_argument("--actuate", action="store_true",
+                   help="run the planner actuation engine: SLO burn + "
+                        "digest load drive drain/scale decisions through "
+                        "the connector handshake (needs --status-port; "
+                        "journal at /debug/planner)")
+    p.add_argument("--actuator-decisions-root", default=None,
+                   help="VirtualConnector root dir for scale decisions "
+                        "(default /tmp/dynamo_actuator)")
     p.add_argument("--discovery-backend", default=None, help="mem|file (env DYN_DISCOVERY_BACKEND)")
     p.add_argument("--discovery-root", default=None, help="file backend root dir")
     p.add_argument("--http-workers", type=int, default=1,
@@ -96,6 +104,9 @@ def parse_args(argv=None):
 
 async def async_main(args) -> None:
     configure_logging()
+    if args.actuate and not args.status_port:
+        raise SystemExit("--actuate requires --status-port (the actuator "
+                         "senses through the fleet digest observer)")
     kw = {}
     if args.discovery_root:
         kw["root"] = args.discovery_root
@@ -167,6 +178,7 @@ async def async_main(args) -> None:
         await grpc_server.start()
     status = None
     observer = None
+    actuator = None
     fleet_tasks = []
     if args.status_port:
         from dynamo_tpu.planner.slo import SloEngine, parse_slo_config
@@ -197,6 +209,12 @@ async def async_main(args) -> None:
                     addr = (ev.instance.metadata or {}).get("digest_publisher")
                     if ev.kind == "put" and addr:
                         observer.connect_publisher(addr)
+                    elif ev.kind == "delete":
+                        # drop the dead worker's load rows NOW instead of
+                        # waiting out the 3x-window age-out — the actuator
+                        # otherwise senses ghost load and scales against
+                        # workers that no longer exist
+                        observer.forget_instance(ev.instance.instance_id)
             except asyncio.CancelledError:
                 pass
 
@@ -230,11 +248,55 @@ async def async_main(args) -> None:
             return routing_debug_payload(
                 manager.routing_audits(), rid=q.get("rid"), last_n=last_n)
 
+        if args.actuate:
+            from dynamo_tpu.planner.actuator import Actuator
+            from dynamo_tpu.planner.connector import VirtualConnector
+            from dynamo_tpu.planner.observer import FleetLoadObserver
+
+            connector = VirtualConnector(
+                args.actuator_decisions_root or "/tmp/dynamo_actuator")
+            loads = FleetLoadObserver(observer, window_s=args.digest_window)
+
+            async def _drain(worker):
+                # frontend-side drain: mark the instance sick on every
+                # model's router so NEW traffic migrates off; session
+                # pins resolve before the sick filter, so bound trees
+                # finish where they are
+                routers = [r for r in (
+                    getattr(getattr(e, "client", None), "router", None)
+                    for e in manager.models.values()) if r is not None]
+                for r in routers:
+                    r.mark_sick(int(worker[0]), cooldown=60.0)
+                return bool(routers)
+
+            # no twin oracle at the frontend (no flight-recorder feed
+            # crosses the process boundary yet): scale/drain decisions
+            # apply unrehearsed, journaled as such; retunes need a
+            # worker admin channel and stay off (retune_fn=None)
+            actuator = Actuator(
+                loads, slo, connector,
+                shadow=None,
+                affinity=watcher.affinity,
+                drain_fn=_drain,
+                replicas_fn=lambda: len(observer.workers()),
+            )
+            actuator.start()
+
+            def _planner_view(q):
+                try:
+                    last_n = int(q.get("last_n", 32))
+                except ValueError:
+                    last_n = 32
+                return actuator.debug_payload(last_n=last_n)
+
         status = StatusServer(runtime, port=args.status_port)
         status.add_debug("fleet", _fleet_view)
         status.add_debug("routing", _routing_view)
+        if actuator is not None:
+            status.add_debug("planner", _planner_view)
         url = await status.start()
-        log.info("status server at %s (/debug/fleet, /debug/routing)", url)
+        log.info("status server at %s (/debug/fleet, /debug/routing%s)",
+                 url, ", /debug/planner" if actuator is not None else "")
     try:
         await asyncio.Event().wait()
     except (KeyboardInterrupt, asyncio.CancelledError):
@@ -242,6 +304,8 @@ async def async_main(args) -> None:
     finally:
         for t in fleet_tasks:
             t.cancel()
+        if actuator is not None:
+            await actuator.stop()
         if status is not None:
             await status.stop()
         if observer is not None:

@@ -644,6 +644,175 @@ class SpawnTrackedSpec(Spec):
 
 
 # ---------------------------------------------------------------------------
+# actuator_apply — decide->rehearse->apply claim protocol (DYN-A007)
+# ---------------------------------------------------------------------------
+
+class _ActLoads:
+    """One busy worker row; only the attributes the Actuator senses."""
+
+    class _Row:
+        worker = W
+        n_samples = 8
+        mean_waiting = 10.0
+        mean_running = 4.0
+        kv_usage = 0.9
+        prefill_tok_s = 100.0
+        decode_tok_s = 100.0
+
+    def loads(self, now=None):
+        return [self._Row()]
+
+
+class _ActSlo:
+    """Permanently breached fleet view: the condition never clears, so
+    re-validation after the rehearsal await always passes — the CLAIM is
+    the only thing standing between two overlapping ticks."""
+
+    class _Policy:
+        breach_burn = 2.0
+
+    policy = _Policy()
+
+    def evaluate(self, now=None):
+        from dynamo_tpu.planner.slo import BREACH
+
+        return {"state": BREACH,
+                "fleet": {"ttft_p99": {"phase": "ttft", "state": BREACH,
+                                       "fast": {"burn": 4.0}}},
+                "workers": {}}
+
+
+class _ActConnector:
+    """Recording connector with a yield inside the apply — the window a
+    second unclaimed tick would need to double-send."""
+
+    def __init__(self, applied):
+        self.applied = applied
+
+    async def scale_to(self, component, target):
+        await asyncio.sleep(0)
+        self.applied.append((component, int(target)))
+
+
+class _SlowOracle:
+    """Rehearsal that parks across a timer: the decide->apply span is
+    forced open so the explorer can land a whole second tick inside it."""
+
+    async def rehearse(self, decision):
+        await asyncio.sleep(0.01)
+        return {"improves": True, "oracle": "static"}
+
+
+class ActuatorApplySpec(Spec):
+    """Three actuation ticks race over a breached fleet (the live shape:
+    the periodic loop fires while an operator-triggered tick runs, or
+    two frontends share a decisions root), with one tick cancellable
+    mid-flight (actuator.stop during a rehearsal). The
+    decide->rehearse->apply span crosses the rehearsal await, so the
+    REAL Actuator claims the (kind, target) in `_inflight` BEFORE
+    awaiting and re-checks after (planner/actuator.py `_execute`).
+    Contract: the breach is acted on at most once — overlapping ticks
+    must not double-scale — exactly once when nothing is cancelled,
+    decisions reach terminal journal status, and no claim outlives its
+    tick (cancellation included: the finally must release)."""
+
+    name = "actuator_apply"
+
+    actuator_cls = None  # default: the production Actuator
+
+    def build(self, env: SpecEnv) -> None:
+        from dynamo_tpu.planner.actuator import Actuator, ActuatorConfig
+
+        applied: List[Any] = []
+        env.data["applied"] = applied
+        cls = self.actuator_cls or Actuator
+        act = cls(
+            _ActLoads(), _ActSlo(), _ActConnector(applied),
+            ActuatorConfig(hysteresis_ticks=1, cooldown_s=1e9,
+                           flap_guard_s=1e9, min_samples=1,
+                           waiting_high=1.0),
+            shadow=_SlowOracle(),
+            replicas_fn=lambda: 1,
+            clock=env.loop.time,
+        )
+        env.data["act"] = act
+
+        async def ticker(name: str) -> None:
+            try:
+                await act.tick()
+            except asyncio.CancelledError:
+                env.data["cancelled"] = True
+                raise
+
+        env.spawn("tick_a", ticker("a"))
+        env.spawn("tick_b", ticker("b"))
+        env.spawn("tick_c", ticker("c"))
+
+    def faults(self, env: SpecEnv) -> list:
+        return [cancel_task("cancel_tick_b",
+                            lambda loop: env.task("tick_b"))]
+
+    def invariant(self, env: SpecEnv) -> None:
+        act = env.data["act"]
+        applied = env.data["applied"]
+        cancelled = env.data.get("cancelled", False)
+        for t in ("tick_a", "tick_b", "tick_c"):
+            task = env.task(t)
+            _iv(task is not None and task.done(), f"{t} parked forever")
+        _iv(len(applied) <= 1,
+            f"breach applied {len(applied)}x (claim protocol broken: "
+            f"{applied})")
+        if not cancelled:
+            _iv(len(applied) == 1, "sustained breach never acted on")
+        _iv(not act._inflight, f"leaked in-flight claims: {act._inflight}")
+        from dynamo_tpu.planner.actuator import TERMINAL
+
+        stuck = [d for d in act.journal.decisions()
+                 if d.status not in TERMINAL]
+        # a cancelled tick may orphan ITS decision mid-rehearsal; any
+        # other non-terminal decision is a journaling bug
+        _iv(len(stuck) <= (1 if cancelled else 0),
+            f"decisions stuck non-terminal: "
+            f"{[(d.decision_id, d.status) for d in stuck]}")
+
+
+class _RacyActuator:
+    """Buggy twin: claims the target AFTER the rehearsal await — the
+    pre-claim-protocol shape. Two overlapping ticks both pass the gates,
+    both rehearse, both apply: a double-scale."""
+
+    def __new__(cls, *a, **kw):
+        from dynamo_tpu.planner.actuator import Actuator
+
+        class _Twin(Actuator):
+            async def _execute(self, d):
+                key = d.target_key
+                if key in self._inflight:
+                    self._finish(d, "skipped", note="in-flight")
+                    return
+                self._record(d, "rehearsed")
+                d.verdict = await self.shadow.rehearse(d)  # BUG: no claim
+                self._inflight.add(key)                    # ...until here
+                try:
+                    if await self._apply(d):
+                        self._cooldown_until[key] = (
+                            self.clock() + self.config.cooldown_s)
+                        self._finish(d, "applied")
+                    else:
+                        self._finish(d, "failed")
+                finally:
+                    self._inflight.discard(key)
+
+        return _Twin(*a, **kw)
+
+
+class ActuatorApplyBuggySpec(ActuatorApplySpec):
+    name = "actuator_apply_buggy"
+    expect_violation = True
+    actuator_cls = _RacyActuator
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -656,6 +825,7 @@ SPECS: Dict[str, Any] = {
         IndexerChurnSpec,
         MigrationHandoffSpec,
         SpawnTrackedSpec,
+        ActuatorApplySpec,
     )
 }
 
@@ -666,6 +836,7 @@ FIXTURES: Dict[str, Any] = {
         PrefetchTtlBuggySpec,
         IndexerResyncBuggySpec,
         IndexerChurnBuggySpec,
+        ActuatorApplyBuggySpec,
     )
 }
 

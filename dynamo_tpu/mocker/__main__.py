@@ -54,6 +54,12 @@ def parse_args(argv=None):
     p.add_argument("--prefetch-hint-ttl-s", type=float, default=10.0)
     p.add_argument("--prefetch-pin-ttl-s", type=float, default=5.0)
     p.add_argument("--speed", type=float, default=1.0, help="timing scale; 0 = no sleeps")
+    p.add_argument("--mixed-prefill-tokens", type=int, default=256,
+                   help="per-iteration prefill token pool when co-scheduled "
+                        "with decode (the prefill:decode ratio knob the "
+                        "planner actuator retunes)")
+    p.add_argument("--mixed-prefill-seqs", type=int, default=8,
+                   help="max distinct prefills packed per iteration")
     p.add_argument("--spec-ngram", action="store_true",
                    help="n-gram speculative decoding (verify rows billed "
                         "like ragged prefill tokens)")
@@ -115,6 +121,8 @@ def build_mock_engine(
         runner, max_batch=args.max_batch, chunk_size=args.chunk_size,
         **engine_kw,
         decode_steps=args.decode_steps,
+        mixed_prefill_tokens=getattr(args, "mixed_prefill_tokens", 256),
+        mixed_prefill_seqs=getattr(args, "mixed_prefill_seqs", 8),
         spec_ngram=getattr(args, "spec_ngram", False),
         spec_k=getattr(args, "spec_k", 4),
         spec_max_tokens=getattr(args, "spec_max_tokens", 0),

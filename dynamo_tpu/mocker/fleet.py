@@ -44,6 +44,7 @@ import logging
 import os
 import random
 import re
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -240,6 +241,22 @@ class FleetSim:
         sanitize: bool = True,  # fleet-sim default harness: one shared
         #   non-strict Sanitizer across all workers; run() reports its
         #   block and chaos tests assert zero violations
+        mixed_prefill_tokens: int = 256,  # per-worker co-scheduling knobs
+        mixed_prefill_seqs: int = 8,      # (the actuator retunes these live)
+        spec_ngram: bool = False,
+        spec_k: int = 4,
+        spec_accept_rate: Optional[float] = None,
+        actuate: bool = False,  # run the planner actuation engine live:
+        #   sense (FleetLoadObserver + SloEngine) → decide → rehearse in a
+        #   twin fork → apply (retune/drain in-proc, scale via the
+        #   VirtualConnector handshake + this sim's decision poller)
+        actuator_config=None,  # planner.actuator.ActuatorConfig override
+        decisions_root: Optional[str] = None,  # VirtualConnector root
+        shadow: Any = "twin",  # "twin" = TwinRehearsal fork oracle,
+        #   "off"/None = apply unrehearsed, or a custom oracle object
+        install_fault_hook: bool = True,  # rehearsal forks run inside a
+        #   live sim and must NOT touch the module-global in-proc fault
+        #   hook (it belongs to the outer experiment)
     ):
         self.n_workers = n_workers
         self.router_mode = router_mode
@@ -266,6 +283,21 @@ class FleetSim:
         self.host_kv_blocks = host_kv_blocks
         self.disk_kv_blocks = disk_kv_blocks
         self.disk_kv_base = disk_kv_base
+        self.mixed_prefill_tokens = mixed_prefill_tokens
+        self.mixed_prefill_seqs = mixed_prefill_seqs
+        self.spec_ngram = spec_ngram
+        self.spec_k = spec_k
+        self.spec_accept_rate = spec_accept_rate
+        self.actuate = actuate
+        self.actuator_config = actuator_config
+        self.decisions_root = decisions_root
+        self.shadow = shadow
+        self._install_fault_hook = install_fault_hook
+        self.actuator = None
+        self.connector = None
+        self._decision_poller: Optional[asyncio.Task] = None
+        self._decision_offset = 0
+        self.scale_events: Dict[str, int] = {}  # up/down applied by poller
 
         self.realm = f"fleet-{seed}-{os.getpid()}-{id(self):x}"
         self.workers: List[SimWorker] = []
@@ -276,6 +308,7 @@ class FleetSim:
         self.slo_engine = None
         self._digest_watch: Optional[asyncio.Task] = None
         self._addr_to_idx: Dict[str, int] = {}
+        self._iid_to_idx: Dict[int, int] = {}  # instance id -> worker slot
         # fault state consulted by the in-proc fault hook; keys are worker
         # slot indices or "*" (fleet-wide), values are loop-clock deadlines
         self._partitions: Dict[Any, float] = {}
@@ -291,7 +324,8 @@ class FleetSim:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
-        rp.set_inproc_fault_hook(self._fault_hook)
+        if self._install_fault_hook:
+            rp.set_inproc_fault_hook(self._fault_hook)
         if self.sanitizer is not None:
             self.sanitizer.start_watchdog()
         for i in range(self.n_workers):
@@ -337,11 +371,60 @@ class FleetSim:
                     addr = (ev.instance.metadata or {}).get("digest_publisher")
                     if ev.kind == "put" and addr:
                         self.observer.connect_publisher(addr)
+                    elif ev.kind == "delete":
+                        # a dead worker's digests must leave the load
+                        # aggregates NOW, not at the 3x-window age-out —
+                        # the actuator otherwise scales against ghost load
+                        self.observer.forget_instance(
+                            ev.instance.instance_id)
             except asyncio.CancelledError:
                 pass
 
         self._digest_watch = asyncio.get_running_loop().create_task(
             _watch_digests())
+        if self.actuate:
+            await self._start_actuator()
+
+    async def _start_actuator(self) -> None:
+        from dynamo_tpu.planner.actuator import Actuator, ActuatorConfig
+        from dynamo_tpu.planner.connector import VirtualConnector
+        from dynamo_tpu.planner.observer import FleetLoadObserver
+
+        root = self.decisions_root or os.path.join(
+            "/tmp/fleet_actuator", self.realm)
+        self.connector = VirtualConnector(root)
+        loads = FleetLoadObserver(self.observer,
+                                  window_s=self.digest_window_s)
+        oracle = self.shadow
+        if oracle == "twin":
+            from dynamo_tpu.planner.shadow import TwinRehearsal
+
+            oracle = TwinRehearsal(self._recorder_records, self.live_state)
+        elif oracle in ("off", False):
+            oracle = None
+        cfg = self.actuator_config
+        if cfg is None:
+            # scale the anti-flap clocks with the sim's digest cadence:
+            # a compressed day ticks in sub-second periods
+            cfg = ActuatorConfig(
+                tick_interval_s=max(0.25, self.digest_period_s),
+                hysteresis_ticks=2,
+                cooldown_s=2.0 * self.digest_window_s,
+                flap_guard_s=4.0 * self.digest_window_s,
+                min_samples=2,
+                component="decode",
+            )
+        self.actuator = Actuator(
+            loads, self.slo_engine, self.connector, cfg,
+            shadow=oracle,
+            affinity=getattr(self.watcher, "affinity", None),
+            retune_fn=self._retune_by_worker,
+            drain_fn=self._drain_by_worker,
+            replicas_fn=self.alive_workers,
+        )
+        self.actuator.start()
+        self._decision_poller = asyncio.get_running_loop().create_task(
+            self._poll_decisions())
 
     async def _spawn_worker(self, idx: int) -> SimWorker:
         from dynamo_tpu.worker_common import serve_worker
@@ -356,7 +439,13 @@ class FleetSim:
             "--page-size", str(self.page_size),
             "--num-pages", str(self.num_pages),
             "--max-batch", str(self.max_batch),
+            "--mixed-prefill-tokens", str(self.mixed_prefill_tokens),
+            "--mixed-prefill-seqs", str(self.mixed_prefill_seqs),
         ]
+        if self.spec_ngram:
+            flags += ["--spec-ngram", "--spec-k", str(self.spec_k)]
+            if self.spec_accept_rate is not None:
+                flags += ["--spec-accept-rate", str(self.spec_accept_rate)]
         if self.host_kv_blocks > 0:
             flags += ["--host-kv-blocks", str(self.host_kv_blocks)]
         disk_root = None
@@ -385,9 +474,15 @@ class FleetSim:
         else:
             self.workers.append(w)
         self._addr_to_idx[rt.server.address] = idx
+        self._iid_to_idx[served.instance.instance_id] = idx
         return w
 
     async def stop(self) -> None:
+        if self.actuator is not None:
+            await self.actuator.stop()
+        if self._decision_poller is not None:
+            self._decision_poller.cancel()
+            self._decision_poller = None
         if self._digest_watch is not None:
             self._digest_watch.cancel()
         if self.observer is not None:
@@ -407,7 +502,8 @@ class FleetSim:
         if self.sanitizer is not None:
             await self.sanitizer.stop_watchdog()
             self.sanitizer.audit_tasks()
-        rp.set_inproc_fault_hook(None)
+        if self._install_fault_hook:
+            rp.set_inproc_fault_hook(None)
 
     # -- fault plane -------------------------------------------------------
     async def _fault_hook(self, direction: str, address: str) -> None:
@@ -507,6 +603,232 @@ class FleetSim:
         key = "drop_until" if kind == "digest_drop" else "dup_until"
         w = self.workers[idx]
         w.digest_state[key] = asyncio.get_event_loop().time() + duration_s
+
+    # -- actuation plane ---------------------------------------------------
+    def _routers(self) -> List[Any]:
+        out = []
+        for entry in (self.manager.models if self.manager else {}).values():
+            router = getattr(getattr(entry, "client", None), "router", None)
+            if router is not None:
+                out.append(router)
+        return out
+
+    def _recorder_records(self) -> List[Any]:
+        """The recent flight-recorder window across live workers — the
+        calibration feed for shadow rehearsal (SimTiming.fit_records)."""
+        records: List[Any] = []
+        for w in self.workers:
+            if not w.alive:
+                continue
+            rec = getattr(w.engine, "recorder", None)
+            if rec is not None and getattr(rec, "enabled", False):
+                records.extend(rec.snapshot(256))
+        return records
+
+    def live_state(self) -> Dict[str, Any]:
+        """Fork-from-live-state snapshot: everything
+        `FleetSim.fork_from_live` needs to rebuild a miniature of THIS
+        fleet as currently tuned (live retunes included — knobs are read
+        off a live engine, not the constructor args)."""
+        alive = [w for w in self.workers if w.alive]
+        sched = alive[0].engine.scheduler if alive else None
+        return {
+            "n_workers": len(alive) or self.n_workers,
+            "router_mode": self.router_mode,
+            "seed": self.seed,
+            "speed": self.speed,
+            "decode_base_ms": self.decode_base_ms,
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "max_batch": self.max_batch,
+            "mixed_prefill_tokens": int(getattr(
+                sched, "mixed_prefill_tokens", self.mixed_prefill_tokens)),
+            "mixed_prefill_seqs": int(getattr(
+                sched, "mixed_prefill_seqs", self.mixed_prefill_seqs)),
+            "spec_ngram": self.spec_ngram,
+            "spec_k": int(getattr(alive[0].engine, "spec_k", self.spec_k)
+                          if alive else self.spec_k),
+            "spec_accept_rate": self.spec_accept_rate,
+            "slo": self.slo,
+            "session_affinity_ttl": self.session_affinity_ttl,
+        }
+
+    @classmethod
+    def fork_from_live(cls, state: Dict[str, Any], *, timing=None,
+                       overrides: Optional[Dict[str, Any]] = None
+                       ) -> "FleetSim":
+        """Build a rehearsal fork from a `live_state()` snapshot.
+        `overrides` mutates the candidate world (n_workers /
+        mixed_prefill_tokens / mixed_prefill_seqs / spec_k); everything
+        else — knob values, router mode, page geometry — carries over.
+        The fork never installs the global in-proc fault hook, runs
+        sanitizer-off, and gets its own discovery realm and seed, so it
+        can run INSIDE a live sim without touching the experiment."""
+        o = dict(overrides or {})
+        n = int(o.pop("n_workers", state.get("n_workers") or 1))
+        sim = cls(
+            n_workers=max(1, n),
+            router_mode=state.get("router_mode", "kv"),
+            seed=int(state.get("seed", 0)) ^ 0xF0CC,
+            speed=float(state.get("speed", 0.02)),
+            decode_base_ms=float(state.get("decode_base_ms", 4.0)),
+            idle_sleep_s=0.01,
+            num_pages=int(state.get("num_pages", 128)),
+            page_size=int(state.get("page_size", 16)),
+            max_batch=int(state.get("max_batch", 16)),
+            timing=timing,
+            digest_period_s=0.5,
+            digest_window_s=5.0,
+            slo=state.get("slo") or "ttft:p99<2.0,itl:p50<0.05",
+            session_affinity_ttl=state.get("session_affinity_ttl"),
+            mixed_prefill_tokens=int(o.pop(
+                "mixed_prefill_tokens",
+                state.get("mixed_prefill_tokens", 256))),
+            mixed_prefill_seqs=int(o.pop(
+                "mixed_prefill_seqs", state.get("mixed_prefill_seqs", 8))),
+            spec_ngram=bool(state.get("spec_ngram", False)),
+            spec_k=int(o.pop("spec_k", state.get("spec_k", 4))),
+            spec_accept_rate=state.get("spec_accept_rate"),
+            sanitize=False,
+            actuate=False,
+            shadow="off",
+            install_fault_hook=False,
+        )
+        if o:
+            raise ValueError(f"unknown fork overrides: {sorted(o)}")
+        return sim
+
+    async def _retune_by_worker(self, worker, params: Dict[str, Any]
+                                ) -> bool:
+        """Actuator retune delivery: the in-proc analog of the worker
+        `rl` admin endpoint. Returns False for unknown/dead workers."""
+        idx = self._iid_to_idx.get(int(worker[0]))
+        if idx is None or not self.workers[idx].alive:
+            return False
+        allowed = {k: v for k, v in params.items()
+                   if k in ("mixed_prefill_tokens", "mixed_prefill_seqs",
+                            "spec_k")}
+        if not allowed:
+            return False
+        applied = self.workers[idx].engine.retune(**allowed)
+        log.info("retuned worker %d: %s", idx, applied)
+        return True
+
+    async def _drain_by_worker(self, worker) -> bool:
+        """Actuator drain delivery: mark the instance sick on every
+        router so NEW traffic migrates off it. Session-affinity pins
+        resolve before the sick filter, so bound session trees keep
+        streaming to it until their TTL — no mid-session rebind."""
+        iid = int(worker[0])
+        idx = self._iid_to_idx.get(iid)
+        if idx is None or not self.workers[idx].alive:
+            return False
+        routers = self._routers()
+        for router in routers:
+            router.mark_sick(iid, cooldown=10.0 * self.sick_cooldown_s)
+        return bool(routers)
+
+    async def _decommission_worker(self, idx: int,
+                                   drain_timeout_s: float = 2.0) -> None:
+        """Planner scale-down: the graceful opposite of kill_worker. New
+        traffic routes away first (mark_sick), in-flight streams get
+        `drain_timeout_s` to finish, then the worker tears down cleanly —
+        digests flush, discovery sees the delete (which also drops its
+        load rows via forget_instance)."""
+        w = self.workers[idx]
+        if not w.alive:
+            return
+        iid = w.served.instance.instance_id
+        for router in self._routers():
+            router.mark_sick(iid, cooldown=10.0 * drain_timeout_s)
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + drain_timeout_s
+        while loop.time() < deadline and len(w.runtime.server._active):
+            await asyncio.sleep(0.05)
+        w.alive = False
+        self._count("scale_down")
+        self._addr_to_idx.pop(w.runtime.server.address, None)
+        self._iid_to_idx.pop(iid, None)
+        try:
+            await w.served.stop()
+            await w.runtime.shutdown(drain_timeout=1)
+        except Exception:
+            log.debug("decommission of worker %d failed", idx,
+                      exc_info=True)
+
+    async def _apply_scale(self, target: int) -> None:
+        """Realize a connector scale decision against the twin fleet:
+        revive dead slots (or append fresh ones) on the way up; on the
+        way down, decommission workers carrying the FEWEST bound session
+        trees first (AffinityCoordinator.snapshot) — draining respects
+        sessions by construction."""
+        target = max(1, int(target))
+        alive = [w for w in self.workers if w.alive]
+        if target > len(alive):
+            need = target - len(alive)
+            self.scale_events["up"] = self.scale_events.get("up", 0) + need
+            for w in [w for w in self.workers if not w.alive][:need]:
+                self._addr_to_idx.pop(w.runtime.server.address, None)
+                await self._spawn_worker(w.idx)
+                need -= 1
+            for _ in range(need):
+                await self._spawn_worker(len(self.workers))
+        elif target < len(alive):
+            excess = len(alive) - target
+            self.scale_events["down"] = (
+                self.scale_events.get("down", 0) + excess)
+            bound: Dict[str, int] = {}
+            aff = getattr(self.watcher, "affinity", None)
+            if aff is not None:
+                bound = aff.snapshot().get("by_instance") or {}
+            victims = sorted(
+                alive,
+                key=lambda w: (
+                    bound.get(f"{w.served.instance.instance_id:x}", 0),
+                    -w.idx,
+                ),
+            )[:excess]
+            for w in victims:
+                await self._decommission_worker(w.idx)
+
+    @staticmethod
+    def _append_line(path, line: str) -> None:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+    async def _poll_decisions(self) -> None:
+        """The external-actuator half of the VirtualConnector handshake,
+        played by the twin: tail decisions.jsonl, realize each scale
+        decision against the fleet, append the ack. This is the same
+        file contract a k8s operator or LocalProcessConnector deployment
+        would honor — the planner can't tell the difference."""
+        path = self.connector.root / "decisions.jsonl"
+        ack_path = self.connector.root / "acks.jsonl"
+        try:
+            while True:
+                await asyncio.sleep(max(0.1, self.digest_period_s / 2))
+                try:
+                    text = await asyncio.to_thread(path.read_text)
+                except FileNotFoundError:
+                    continue
+                lines = text.splitlines()
+                fresh = lines[self._decision_offset:]
+                self._decision_offset = len(lines)
+                for line in fresh:
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue
+                    await self._apply_scale(int(d.get("target_replicas", 0)))
+                    ack = json.dumps({
+                        "decision_id": d.get("decision_id"),
+                        "ts": time.time(),
+                        "applied_replicas": self.alive_workers(),
+                    })
+                    await asyncio.to_thread(
+                        self._append_line, ack_path, ack)
+        except asyncio.CancelledError:
+            pass
 
     async def apply_event(self, ev: FaultEvent, time_scale: float = 1.0,
                           rng: Optional[random.Random] = None) -> None:
@@ -617,4 +939,12 @@ class FleetSim:
         }
         if self.sanitizer is not None:
             out["sanitizer"] = self.sanitizer.report()
+        if self.actuator is not None:
+            out["actuation"] = {
+                "ticks": self.actuator.ticks,
+                "decisions": len(self.actuator.journal),
+                "counts": dict(self.actuator.journal.counts),
+                "scale_events": dict(self.scale_events),
+                "acked": self.connector.acked() if self.connector else 0,
+            }
         return out

@@ -1,4 +1,10 @@
 """SLA-driven autoscaling planner (analog of reference dynamo.planner,
 docs/design-docs/planner-design.md): a control loop OBSERVE → PREDICT →
 PROPOSE → CONSTRAIN → EXECUTE over FPM engine metrics, scaling prefill and
-decode worker counts through pluggable connectors."""
+decode worker counts through pluggable connectors.
+
+The SLA loop is closed by `actuator.py` (sense SLO burn + digest load →
+decide → rehearse → apply, with hysteresis/cooldown/flap-guard) and
+`shadow.py` (twin-rehearsed shadow decisions: a calibrated FleetSim
+fork vets every scale/retune before it touches the fleet). See
+docs/planner.md."""

@@ -1,0 +1,685 @@
+"""DECIDE + EXECUTE stage: the planner actuation engine.
+
+Senses two loops and turns them into concrete fleet actions:
+
+- slow outer loop: SLO burn state from `planner/slo.py` (multi-window
+  burn rates over the digest plane) — scale replicas, drain BREACH
+  workers;
+- fast inner loop: per-worker load rows from `FleetLoadObserver` plus
+  the digest `act`/`spec` blocks — retune `mixed_prefill_tokens` /
+  `mixed_prefill_seqs` (the prefill:decode ratio knob) and spec-decode K
+  from measured accept rates.
+
+Actions are delivered through three seams so the same engine drives the
+twin (FleetSim), a local deployment, and k8s:
+
+- `connector.scale_to(component, target)` — the existing
+  `planner/connector.py` handshake (Virtual / LocalProcess / Kubernetes);
+- `retune_fn(worker, params)` — per-worker knob delivery (FleetSim calls
+  `InferenceEngine.retune`; a remote deployment would ride the worker's
+  `rl` admin endpoint);
+- `drain_fn(worker)` — migrate NEW traffic off a worker (router
+  `mark_sick`); explicit session-affinity pins resolve before the sick
+  filter, so draining never rebinds a bound session mid-stream.
+
+Anti-flap machinery, in order of evaluation per proposal:
+
+1. hysteresis — a sensed condition must hold `hysteresis_ticks`
+   consecutive ticks before it proposes anything (one burst spike moves
+   nothing);
+2. cooldown — after an apply, the same (kind, target) is quiet for
+   `cooldown_s`;
+3. flap guard — the INVERSE direction on a target applied within
+   `flap_guard_s` is refused outright (scale-up at t, scale-down at
+   t+ε never happens, whatever the windows say).
+
+The headline mechanism is **shadow actuation** (`planner/shadow.py`):
+before an apply, the decision is rehearsed in a calibrated FleetSim fork
+of current fleet state and rejected if the twin predicts it won't
+improve the breached SLO. The decide→rehearse→apply span crosses an
+await — the classic DYN-A007 check-then-act hazard — so the target is
+CLAIMED (added to `_inflight`) before the rehearsal await and every
+sensed precondition is re-validated after it; the dynmc spec
+`actuator_apply` model-checks exactly this protocol (mc/protocols.py).
+
+Every decision is journaled (proposed → rehearsed → applied / rejected /
+skipped / stale / failed) in a bounded ring plus an optional JSONL file
+that round-trips via `DecisionJournal.load`; `/debug/planner` serves
+`Actuator.debug_payload()` and fleet digests carry the worker-side knob
+state (`DigestBuilder` `act` block).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dynamo_tpu.planner.slo import BREACH, OK
+
+log = logging.getLogger("dynamo_tpu.planner.actuator")
+
+Worker = Tuple[int, int]
+
+
+def worker_key(w) -> str:
+    """Canonical worker key, matching SloEngine / /debug/fleet rows."""
+    return f"{w[0]:x}.{w[1]}"
+
+
+@dataclass
+class ActuatorConfig:
+    tick_interval_s: float = 5.0
+    # anti-flap machinery (see module docstring for the evaluation order)
+    hysteresis_ticks: int = 3
+    cooldown_s: float = 60.0
+    flap_guard_s: float = 300.0
+    # sensing floors: a worker row below min_samples digests abstains
+    min_samples: int = 3
+    # replica scaling
+    component: str = "decode"
+    min_replicas: int = 1
+    max_replicas: int = 0  # 0 = uncapped
+    waiting_high: float = 4.0   # fleet mean waiting per worker -> scale up
+    running_low: float = 0.5    # fleet mean running per worker -> scale down
+    kv_low: float = 0.5         # and mean kv usage below this
+    # draining BREACH workers
+    drain_max_fraction: float = 0.25  # of sensed workers at once
+    drain_cooldown_s: float = 120.0
+    # spec-decode K retune from measured accept rates
+    spec_accept_low: float = 0.35
+    spec_accept_high: float = 0.8
+    spec_k_min: int = 1
+    spec_k_max: int = 8
+    spec_min_drafted: int = 64
+    # prefill:decode ratio knob (mixed pool budget)
+    mixed_tokens_min: int = 64
+    mixed_tokens_max: int = 1024
+    mixed_step: float = 1.5  # multiplicative retune step
+    # shadow rehearsal: which action kinds are twin-gated. Drain is an
+    # emergency action (a BREACH worker is already hurting users) and is
+    # never held behind a rehearsal.
+    shadow_kinds: Tuple[str, ...] = ("scale", "retune")
+    journal_capacity: int = 512
+    journal_path: Optional[str] = None
+
+
+@dataclass
+class Decision:
+    """One proposed action, through its whole lifecycle."""
+
+    decision_id: int
+    ts: float
+    trigger: Dict[str, Any]
+    # {"kind": scale|drain|retune, "target": str, "direction": -1|0|1,
+    #  "component": str|None, "worker": [iid, dp]|None, "params": {...}}
+    action: Dict[str, Any]
+    status: str = "proposed"
+    verdict: Optional[Dict[str, Any]] = None  # shadow rehearsal outcome
+    applied_ts: Optional[float] = None
+    note: str = ""
+
+    @property
+    def target_key(self) -> str:
+        return f"{self.action.get('kind')}:{self.action.get('target')}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Decision":
+        return cls(**{k: d.get(k) for k in (
+            "decision_id", "ts", "trigger", "action", "status", "verdict",
+            "applied_ts", "note")})
+
+
+TERMINAL = ("applied", "rejected", "skipped", "stale", "failed")
+
+
+class DecisionJournal:
+    """Bounded in-memory ring + optional JSONL append log. Every status
+    transition appends one line; `load` folds the lines back (last line
+    per decision id wins), so the journal round-trips across processes
+    and every applied action stays attributable to its decision + verdict."""
+
+    def __init__(self, capacity: int = 512, path: Optional[str] = None):
+        self.capacity = max(1, int(capacity))
+        self.path = Path(path) if path else None
+        self._order: List[int] = []
+        self._by_id: Dict[int, Decision] = {}
+        self.counts: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def record(self, d: Decision) -> None:
+        if d.decision_id not in self._by_id:
+            self._order.append(d.decision_id)
+            self._by_id[d.decision_id] = d
+            while len(self._order) > self.capacity:
+                self._by_id.pop(self._order.pop(0), None)
+        if d.status in TERMINAL:
+            self.counts[d.status] = self.counts.get(d.status, 0) + 1
+        if self.path is not None:
+            try:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(d.to_dict()) + "\n")
+            except OSError:
+                log.debug("journal append failed", exc_info=True)
+
+    def decisions(self, last_n: Optional[int] = None) -> List[Decision]:
+        ids = self._order[-last_n:] if last_n else list(self._order)
+        return [self._by_id[i] for i in ids]
+
+    @classmethod
+    def load(cls, path: str, capacity: int = 512) -> "DecisionJournal":
+        j = cls(capacity=capacity)  # no path: loading must not re-append
+        try:
+            lines = Path(path).read_text().splitlines()
+        except FileNotFoundError:
+            return j
+        folded: Dict[int, Decision] = {}
+        order: List[int] = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = Decision.from_dict(json.loads(line))
+            except (ValueError, TypeError):
+                continue
+            if d.decision_id not in folded:
+                order.append(d.decision_id)
+            folded[d.decision_id] = d
+        for i in order[-capacity:]:
+            j.record(folded[i])
+        return j
+
+    def to_payload(self, last_n: int = 32) -> Dict[str, Any]:
+        return {
+            "n": len(self._order),
+            "counts": dict(self.counts),
+            "decisions": [d.to_dict() for d in self.decisions(last_n)],
+        }
+
+
+class Actuator:
+    """The decision engine. `tick()` senses, decides, rehearses, applies;
+    `start()` runs it periodically. All collaborators are injected so the
+    same engine runs over the live fleet, the twin, and dynmc's faked
+    planes (the spec drives the REAL class)."""
+
+    def __init__(
+        self,
+        loads,                      # FleetLoadObserver-like: .loads(now)
+        slo,                        # SloEngine-like: .evaluate(now)
+        connector=None,             # planner.connector.Connector
+        config: Optional[ActuatorConfig] = None,
+        *,
+        shadow=None,                # planner.shadow rehearsal oracle
+        affinity=None,              # AffinityCoordinator (or .snapshot fn)
+        retune_fn: Optional[Callable] = None,  # async (worker, params)
+        drain_fn: Optional[Callable] = None,   # async (worker)
+        replicas_fn: Optional[Callable[[], int]] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.loads = loads
+        self.slo = slo
+        self.connector = connector
+        self.config = config or ActuatorConfig()
+        self.shadow = shadow
+        self.affinity = affinity
+        self.retune_fn = retune_fn
+        self.drain_fn = drain_fn
+        self.replicas_fn = replicas_fn
+        self.clock = clock or time.monotonic
+        self.journal = DecisionJournal(self.config.journal_capacity,
+                                       self.config.journal_path)
+        self._next_id = 1
+        self._streaks: Dict[str, int] = {}
+        self._cooldown_until: Dict[str, float] = {}
+        self._last_dir: Dict[str, Tuple[int, float]] = {}
+        self._inflight: set = set()
+        self._draining: Dict[str, float] = {}  # worker key -> drained at
+        self._task: Optional[asyncio.Task] = None
+        self.ticks = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        # claim before the await (DYN-A007): a concurrent stop must see
+        # None, not cancel-and-await a half-torn-down task
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                try:
+                    await self.tick()
+                except Exception:
+                    log.exception("actuator tick failed")
+                await asyncio.sleep(self.config.tick_interval_s)
+        except asyncio.CancelledError:
+            raise
+
+    # -- SENSE + DECIDE ------------------------------------------------------
+    async def tick(self, now: Optional[float] = None) -> List[Decision]:
+        """One sense→decide→rehearse→apply pass. Returns the decisions
+        this tick produced (terminal status set)."""
+        self.ticks += 1
+        view = self.slo.evaluate(now)
+        rows = [r for r in self.loads.loads(now)
+                if r.n_samples >= self.config.min_samples]
+        proposals = (
+            self._sense_scale(view, rows)
+            + self._sense_drain(view, rows)
+            + self._sense_spec(now, rows)
+            + self._sense_ratio(view, rows)
+        )
+        # hysteresis bookkeeping: conditions not re-asserted this tick
+        # lose their streak (a sustained condition keeps its key alive)
+        asserted = {key for key, _ in proposals}
+        for key in list(self._streaks):
+            if key not in asserted:
+                self._streaks.pop(key)
+        out: List[Decision] = []
+        for key, build in proposals:
+            streak = self._streaks.get(key, 0) + 1
+            self._streaks[key] = streak
+            if streak < self.config.hysteresis_ticks:
+                continue
+            self._streaks[key] = 0  # fires at most once per hysteresis run
+            d = build()
+            if d is None:
+                continue
+            if not self._admit(d):
+                out.append(d)
+                continue
+            await self._execute(d)
+            out.append(d)
+        self._expire_drains()
+        return out
+
+    def _decision(self, trigger: Dict[str, Any],
+                  action: Dict[str, Any]) -> Decision:
+        d = Decision(self._next_id, time.time(), trigger, action)
+        self._next_id += 1
+        return d
+
+    def _fleet_means(self, rows) -> Dict[str, float]:
+        n = max(1, len(rows))
+        return {
+            "waiting": sum(r.mean_waiting for r in rows) / n,
+            "running": sum(r.mean_running for r in rows) / n,
+            "kv": sum(r.kv_usage for r in rows) / n,
+            "prefill_tok_s": sum(r.prefill_tok_s for r in rows),
+            "decode_tok_s": sum(r.decode_tok_s for r in rows),
+        }
+
+    def _burning(self, view: Dict[str, Any], phase: str) -> Optional[dict]:
+        """The fleet-level target on `phase` whose fast window is burning
+        (the early signal the ratio knob steers on), if any."""
+        for name, s in (view.get("fleet") or {}).items():
+            if s.get("phase") != phase:
+                continue
+            fast = s.get("fast") or {}
+            if fast.get("burn") is not None and \
+                    fast["burn"] >= self.slo.policy.breach_burn:
+                return {"target": name, **{k: fast.get(k)
+                                           for k in ("burn", "value_s", "n")}}
+        return None
+
+    def _sense_scale(self, view, rows) -> List[Tuple[str, Callable]]:
+        cfg = self.config
+        cur = self.replicas_fn() if self.replicas_fn else len(rows)
+        if cur <= 0 or self.connector is None:
+            return []
+        means = self._fleet_means(rows) if rows else None
+        props: List[Tuple[str, Callable]] = []
+        if view.get("state") == BREACH and (
+                means is None or means["waiting"] >= cfg.waiting_high):
+            breached = [n for n, s in (view.get("fleet") or {}).items()
+                        if s.get("state") == BREACH]
+
+            def _up(breached=breached, cur=cur, means=means):
+                target = cur + 1
+                if cfg.max_replicas and target > cfg.max_replicas:
+                    return None
+                return self._decision(
+                    {"rule": "fleet_breach", "slo": breached,
+                     "mean_waiting": means and round(means["waiting"], 3),
+                     "replicas": cur},
+                    {"kind": "scale", "target": cfg.component,
+                     "component": cfg.component, "worker": None,
+                     "direction": 1, "params": {"replicas": target}},
+                )
+
+            props.append(("fleet_breach", _up))
+        elif (view.get("state") == OK and means is not None
+              and means["waiting"] <= 0.0
+              and means["running"] < cfg.running_low
+              and means["kv"] < cfg.kv_low
+              and cur > cfg.min_replicas):
+
+            def _down(cur=cur, means=means):
+                return self._decision(
+                    {"rule": "fleet_idle",
+                     "mean_running": round(means["running"], 3),
+                     "mean_kv": round(means["kv"], 3), "replicas": cur},
+                    {"kind": "scale", "target": cfg.component,
+                     "component": cfg.component, "worker": None,
+                     "direction": -1,
+                     "params": {"replicas": cur - 1}},
+                )
+
+            props.append(("fleet_idle", _down))
+        return props
+
+    def _sense_drain(self, view, rows) -> List[Tuple[str, Callable]]:
+        if self.drain_fn is None or not rows:
+            return []
+        cfg = self.config
+        budget = max(1, int(cfg.drain_max_fraction * len(rows)))
+        if len(self._draining) >= budget:
+            return []
+        known = {worker_key(r.worker): r.worker for r in rows}
+        props: List[Tuple[str, Callable]] = []
+        for wkey, wview in sorted((view.get("workers") or {}).items()):
+            if wkey not in known or wkey in self._draining:
+                continue
+            breached = [n for n, s in (wview.get("states") or {}).items()
+                        if s == BREACH]
+            if not breached:
+                continue
+
+            def _drain(wkey=wkey, w=known[wkey], breached=breached):
+                bound = self._bound_sessions(wkey)
+                return self._decision(
+                    {"rule": "worker_breach", "worker": wkey,
+                     "slo": breached, "bound_sessions": bound},
+                    {"kind": "drain", "target": wkey,
+                     "component": None, "worker": list(w),
+                     "direction": 0, "params": {"bound_sessions": bound}},
+                )
+
+            props.append((f"breach:{wkey}", _drain))
+        return props
+
+    def _sense_spec(self, now, rows) -> List[Tuple[str, Callable]]:
+        if self.retune_fn is None:
+            return []
+        cfg = self.config
+        props: List[Tuple[str, Callable]] = []
+        for wkey, latest in sorted(self._latest_digests(now).items()):
+            spec = latest.get("spec") or {}
+            act = latest.get("act") or {}
+            k = int(act.get("spec_k") or 0)
+            drafted = int(spec.get("drafted") or 0)
+            rate = spec.get("accept_rate")
+            if not k or rate is None or drafted < cfg.spec_min_drafted:
+                continue
+            w = tuple(latest.get("worker") or (0, 0))
+            if rate < cfg.spec_accept_low and k > cfg.spec_k_min:
+                new_k, direction, rule = k - 1, -1, "spec_accept_low"
+            elif rate > cfg.spec_accept_high and k < cfg.spec_k_max:
+                new_k, direction, rule = k + 1, 1, "spec_accept_high"
+            else:
+                continue
+
+            def _retune(wkey=wkey, w=w, k=k, new_k=new_k,
+                        direction=direction, rule=rule, rate=rate,
+                        drafted=drafted):
+                return self._decision(
+                    {"rule": rule, "worker": wkey,
+                     "accept_rate": round(float(rate), 4),
+                     "drafted": drafted, "spec_k": k},
+                    {"kind": "retune", "target": f"spec:{wkey}",
+                     "component": None, "worker": list(w),
+                     "direction": direction, "params": {"spec_k": new_k}},
+                )
+
+            props.append((f"spec:{wkey}:{direction}", _retune))
+        return props
+
+    def _sense_ratio(self, view, rows) -> List[Tuple[str, Callable]]:
+        """The prefill:decode ratio shift. In a homogeneous fleet the
+        ratio IS the per-worker mixed pool budget: growing
+        `mixed_prefill_tokens` moves iteration capacity toward prefill
+        (TTFT), shrinking it protects decode (ITL). Role-split
+        deployments realize the same decision as paired scale_to calls
+        on their prefill/decode components — same trigger, different
+        delivery (docs/planner.md)."""
+        if self.retune_fn is None or not rows:
+            return []
+        cfg = self.config
+        cur = self._fleet_mixed_tokens()
+        if cur is None:
+            return []
+        ttft = self._burning(view, "ttft")
+        itl = self._burning(view, "itl")
+        means = self._fleet_means(rows)
+        if ttft and not itl and means["waiting"] > 0 \
+                and cur < cfg.mixed_tokens_max:
+            new = min(cfg.mixed_tokens_max, int(cur * cfg.mixed_step))
+            direction, rule, trig = 1, "ttft_burn", ttft
+        elif itl and not ttft and cur > cfg.mixed_tokens_min:
+            new = max(cfg.mixed_tokens_min, int(cur / cfg.mixed_step))
+            direction, rule, trig = -1, "itl_burn", itl
+        else:
+            return []
+        workers = [list(r.worker) for r in rows]
+
+        def _ratio(new=new, direction=direction, rule=rule, trig=trig,
+                   cur=cur, workers=workers):
+            return self._decision(
+                {"rule": rule, **trig, "mixed_prefill_tokens": cur},
+                {"kind": "retune", "target": "fleet:mixed",
+                 "component": None, "worker": None, "direction": direction,
+                 "params": {"mixed_prefill_tokens": new,
+                            "workers": workers}},
+            )
+
+        return [(f"ratio:{direction}", _ratio)]
+
+    # -- digest access (fast-loop knob state rides the digest act block) -----
+    def _latest_digests(self, now) -> Dict[str, dict]:
+        fleet = getattr(self.loads, "fleet", None)
+        if fleet is None:
+            return {}
+        out = {}
+        for w, digests in fleet.window_digests(now).items():
+            for d in reversed(digests):
+                if d.get("act") or d.get("spec"):
+                    out[worker_key(w)] = d
+                    break
+        return out
+
+    def _fleet_mixed_tokens(self) -> Optional[int]:
+        vals = [int((d.get("act") or {}).get("mixed_prefill_tokens") or 0)
+                for d in self._latest_digests(None).values()]
+        vals = [v for v in vals if v > 0]
+        if not vals:
+            return None
+        return sorted(vals)[len(vals) // 2]  # fleet median
+
+    def _bound_sessions(self, wkey: str) -> int:
+        snap = None
+        if self.affinity is not None:
+            fn = getattr(self.affinity, "snapshot", self.affinity)
+            try:
+                snap = fn()
+            except Exception:
+                log.debug("affinity snapshot failed", exc_info=True)
+        if not isinstance(snap, dict):
+            return 0
+        iid_hex = wkey.split(".", 1)[0]
+        return int((snap.get("by_instance") or {}).get(iid_hex, 0))
+
+    # -- gates ---------------------------------------------------------------
+    def _admit(self, d: Decision) -> bool:
+        now = self.clock()
+        key, direction = d.target_key, int(d.action.get("direction") or 0)
+        until = self._cooldown_until.get(key, 0.0)
+        if now < until:
+            self._finish(d, "skipped",
+                         note=f"cooldown {until - now:.1f}s left")
+            return False
+        last = self._last_dir.get(key)
+        if (direction and last is not None and last[0] == -direction
+                and now - last[1] < self.config.flap_guard_s):
+            self._finish(d, "skipped", note="flap-guard: inverse of a "
+                         f"recent apply ({now - last[1]:.1f}s ago)")
+            return False
+        return True
+
+    # -- REHEARSE + APPLY ----------------------------------------------------
+    async def _execute(self, d: Decision) -> None:
+        key = d.target_key
+        if key in self._inflight:
+            self._finish(d, "skipped", note="in-flight")
+            return
+        # CLAIM before the rehearsal await: two overlapping ticks must
+        # never both pass the gates and double-apply (DYN-A007; the
+        # dynmc `actuator_apply` spec checks this exact protocol)
+        self._inflight.add(key)
+        try:
+            if self.shadow is not None and \
+                    d.action["kind"] in self.config.shadow_kinds:
+                self._record(d, "rehearsed")
+                try:
+                    d.verdict = await self.shadow.rehearse(d)
+                except Exception as e:
+                    # the oracle is advisory: its failure must not wedge
+                    # actuation, but it IS recorded on the decision
+                    log.warning("shadow rehearsal failed: %s", e)
+                    d.verdict = {"improves": True, "oracle": "error",
+                                 "error": str(e)}
+                if not (d.verdict or {}).get("improves", True):
+                    self._finish(d, "rejected", note="shadow: twin predicts "
+                                 "no improvement")
+                    return
+                # the world moved while the twin ran: re-validate
+                if not self._still_valid(d):
+                    self._finish(d, "stale",
+                                 note="condition cleared during rehearsal")
+                    return
+            ok = await self._apply(d)
+            if ok:
+                now = self.clock()
+                cool = (self.config.drain_cooldown_s
+                        if d.action["kind"] == "drain"
+                        else self.config.cooldown_s)
+                self._cooldown_until[key] = now + cool
+                direction = int(d.action.get("direction") or 0)
+                if direction:
+                    self._last_dir[key] = (direction, now)
+                d.applied_ts = time.time()
+                self._finish(d, "applied")
+            else:
+                self._finish(d, "failed", note=d.note or "apply failed")
+        finally:
+            self._inflight.discard(key)
+
+    def _still_valid(self, d: Decision) -> bool:
+        kind = d.action.get("kind")
+        try:
+            view = self.slo.evaluate()
+        except Exception:
+            return True
+        if kind == "scale":
+            direction = int(d.action.get("direction") or 0)
+            if direction > 0:
+                return view.get("state") != OK
+            return view.get("state") == OK
+        if kind == "drain":
+            wkey = d.action.get("target")
+            states = ((view.get("workers") or {}).get(wkey) or {}) \
+                .get("states") or {}
+            return BREACH in states.values()
+        return True
+
+    async def _apply(self, d: Decision) -> bool:
+        kind = d.action.get("kind")
+        params = d.action.get("params") or {}
+        if kind == "scale":
+            if self.connector is None:
+                d.note = "no connector"
+                return False
+            await self.connector.scale_to(
+                d.action["component"], int(params["replicas"]))
+            return True
+        if kind == "drain":
+            w = tuple(d.action["worker"])
+            ok = await self.drain_fn(w)
+            if ok:
+                self._draining[d.action["target"]] = self.clock()
+            return bool(ok)
+        if kind == "retune":
+            knobs = {k: v for k, v in params.items() if k != "workers"}
+            targets = params.get("workers") or [d.action.get("worker")]
+            ok_any = False
+            for w in targets:
+                if w is None:
+                    continue
+                try:
+                    if await self.retune_fn(tuple(w), knobs):
+                        ok_any = True
+                except Exception:
+                    log.warning("retune of %s failed", w, exc_info=True)
+            return ok_any
+        d.note = f"unknown action kind {kind!r}"
+        return False
+
+    def _expire_drains(self) -> None:
+        now = self.clock()
+        for wkey, at in list(self._draining.items()):
+            if now - at > self.config.drain_cooldown_s:
+                del self._draining[wkey]
+
+    # -- journal plumbing ----------------------------------------------------
+    def _record(self, d: Decision, status: str) -> None:
+        d.status = status
+        self.journal.record(d)
+
+    def _finish(self, d: Decision, status: str, note: str = "") -> None:
+        if note:
+            d.note = note
+        self._record(d, status)
+        log.info("decision %d %s: %s %s%s", d.decision_id, status,
+                 d.action.get("kind"), d.action.get("target"),
+                 f" ({note})" if note else "")
+
+    # -- /debug/planner ------------------------------------------------------
+    def debug_payload(self, last_n: int = 32) -> Dict[str, Any]:
+        now = self.clock()
+        out = {
+            "ticks": self.ticks,
+            "config": asdict(self.config),
+            "journal": self.journal.to_payload(last_n),
+            "inflight": sorted(self._inflight),
+            "streaks": dict(self._streaks),
+            "cooldowns": {k: round(u - now, 1)
+                          for k, u in self._cooldown_until.items()
+                          if u > now},
+            "draining": sorted(self._draining),
+        }
+        acked = getattr(self.connector, "acked", None)
+        if callable(acked):
+            try:
+                out["acked"] = acked()
+            except Exception:
+                log.debug("connector ack probe failed", exc_info=True)
+        return out

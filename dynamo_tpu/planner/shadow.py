@@ -1,0 +1,208 @@
+"""Shadow actuation: rehearse a planner decision in the twin before it
+touches the fleet.
+
+The oracle is `TwinRehearsal`: it fits a `SimTiming` from the recent
+flight-recorder window (`SimTiming.fit_records` — the same calibration
+path the 500-worker twin uses), forks a miniature FleetSim from live
+fleet state (`FleetSim.fork_from_live`), runs the SAME short workload
+through the baseline fork and a candidate fork with the decision
+applied, and compares the SLO metric the decision claims to improve.
+A decision whose predicted metric is not at least `min_improvement`
+better than baseline is rejected — the twin is a what-if oracle, not
+just a test rig.
+
+Honesty rules (all recorded on the verdict):
+
+- abstain, don't guess: too few flight-recorder records, or a baseline
+  latency below the signal floor (speed-0 sims have no timing signal),
+  yields `improves=True` with `oracle="abstain"` — the actuator applies,
+  but the journal shows the rehearsal didn't vouch for it;
+- miniature forks exaggerate scale steps: +1 worker in an 8-worker fork
+  is +12.5% capacity where +1 in a 500-worker fleet is +0.2%. The fork
+  answers the DIRECTION question ("does more capacity move this
+  metric?"), not the magnitude one; `fork_workers` on the verdict keeps
+  that visible.
+
+The rehearsal fork never installs the in-proc fault hook (that module
+global belongs to the LIVE sim) and runs sanitizer-off with its own
+discovery realm, so a rehearsal inside a running FleetSim cannot
+perturb the experiment it is vetting.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("dynamo_tpu.planner.shadow")
+
+# goodput-report key per (phase, percentile) — the metrics a rehearsal
+# can score (bench/loadgen.py GoodputReport)
+_METRIC_KEYS = {
+    ("ttft", 50): "ttft_p50_s",
+    ("ttft", 99): "ttft_p99_s",
+    ("itl", 50): "itl_p50_s",
+    ("itl", 99): "itl_p99_s",
+}
+
+
+def metric_for_decision(decision) -> Tuple[str, str]:
+    """(metric_name, goodput_key) the rehearsal scores this decision on.
+    Ratio/scale decisions carry the burning SLO target name in their
+    trigger; spec retunes are scored on ITL (that's what K moves)."""
+    trig = getattr(decision, "trigger", None) or {}
+    names: List[str] = []
+    t = trig.get("target")
+    if isinstance(t, str):
+        names.append(t)
+    names.extend(s for s in (trig.get("slo") or []) if isinstance(s, str))
+    rule = str(trig.get("rule") or "")
+    if rule.startswith("spec_"):
+        names.insert(0, "itl_p50")
+    for name in names:
+        try:
+            phase, pct = name.rsplit("_p", 1)
+            key = _METRIC_KEYS.get((phase, int(round(float(pct)))))
+        except ValueError:
+            continue
+        if key:
+            return name, key
+    return "ttft_p99", "ttft_p99_s"
+
+
+class TwinRehearsal:
+    """The rehearsal oracle the Actuator awaits. `records_fn` yields the
+    recent flight-recorder window (IterationRecords or dicts) and
+    `state_fn` a `FleetSim.live_state()` snapshot; both are plain
+    callables so the oracle works inside FleetSim (twin-in-twin), a
+    local deployment scraping recorder dumps, or tests feeding canned
+    windows."""
+
+    def __init__(
+        self,
+        records_fn: Callable[[], List[Any]],
+        state_fn: Callable[[], Dict[str, Any]],
+        *,
+        min_records: int = 32,
+        min_improvement: float = 0.05,
+        signal_floor_s: float = 1e-3,
+        fork_workers: int = 6,
+        n_sessions: int = 6,
+        rps: float = 8.0,
+        scenarios: Tuple[str, ...] = ("burst",),
+        time_scale: float = 1.0,
+        max_records: int = 2048,
+    ):
+        self.records_fn = records_fn
+        self.state_fn = state_fn
+        self.min_records = min_records
+        self.min_improvement = min_improvement
+        self.signal_floor_s = signal_floor_s
+        self.fork_workers = fork_workers
+        self.n_sessions = n_sessions
+        self.rps = rps
+        self.scenarios = tuple(scenarios)
+        self.time_scale = time_scale
+        self.max_records = max_records
+        self.rehearsals = 0
+
+    # -- candidate realization ----------------------------------------------
+    def _candidate_overrides(self, decision, fork_n: int
+                             ) -> Optional[Dict[str, Any]]:
+        """Map the decision onto fork constructor overrides; None means
+        the twin can't realize this action kind (abstain)."""
+        action = getattr(decision, "action", None) or {}
+        kind = action.get("kind")
+        params = action.get("params") or {}
+        if kind == "scale":
+            direction = int(action.get("direction") or 0)
+            return {"n_workers": max(1, fork_n + direction)}
+        if kind == "retune":
+            out = {}
+            for knob in ("mixed_prefill_tokens", "mixed_prefill_seqs",
+                         "spec_k"):
+                if params.get(knob) is not None:
+                    out[knob] = int(params[knob])
+            return out or None
+        return None
+
+    async def rehearse(self, decision) -> Dict[str, Any]:
+        self.rehearsals += 1
+        metric, key = metric_for_decision(decision)
+        base = {"metric": metric, "oracle": "twin"}
+        records = list(self.records_fn() or [])[-self.max_records:]
+        if len(records) < self.min_records:
+            return {**base, "improves": True, "oracle": "abstain",
+                    "reason": f"{len(records)} records < {self.min_records}"}
+        state = dict(self.state_fn() or {})
+        fork_n = max(1, min(self.fork_workers,
+                            int(state.get("n_workers") or 1)))
+        overrides = self._candidate_overrides(decision, fork_n)
+        if overrides is None:
+            return {**base, "improves": True, "oracle": "abstain",
+                    "reason": "action not twin-realizable"}
+        from dynamo_tpu.mocker.sim import SimTiming
+
+        timing = SimTiming.fit_records(
+            records, speed=max(float(state.get("speed") or 0.0), 0.0))
+        baseline = await self._measure(state, {"n_workers": fork_n}, timing)
+        if baseline is None:
+            return {**base, "improves": True, "oracle": "abstain",
+                    "reason": "baseline fork failed"}
+        if baseline.get(key, 0.0) < self.signal_floor_s:
+            return {**base, "improves": True, "oracle": "abstain",
+                    "reason": f"no latency signal (baseline "
+                              f"{baseline.get(key, 0.0):.2g}s)"}
+        cand = await self._measure(
+            state, {"n_workers": fork_n, **overrides}, timing)
+        if cand is None:
+            return {**base, "improves": True, "oracle": "abstain",
+                    "reason": "candidate fork failed"}
+        b, p = float(baseline[key]), float(cand[key])
+        improves = p <= b * (1.0 - self.min_improvement)
+        return {
+            **base,
+            "improves": improves,
+            "baseline_s": round(b, 6),
+            "predicted_s": round(p, 6),
+            "fork_workers": fork_n,
+            "records": len(records),
+        }
+
+    async def _measure(self, state: Dict[str, Any],
+                       overrides: Dict[str, Any],
+                       timing) -> Optional[Dict[str, float]]:
+        from dynamo_tpu.mocker.fleet import FleetSim
+
+        sim = None
+        try:
+            sim = FleetSim.fork_from_live(state, timing=timing,
+                                          overrides=overrides)
+            await sim.start()
+            report = await sim.run(
+                scenarios=self.scenarios, n_sessions=self.n_sessions,
+                rps=self.rps, time_scale=self.time_scale)
+            return report.get("goodput") or {}
+        except Exception:
+            log.warning("rehearsal fork failed", exc_info=True)
+            return None
+        finally:
+            if sim is not None:
+                try:
+                    await sim.stop()
+                except Exception:
+                    log.debug("rehearsal fork teardown failed",
+                              exc_info=True)
+
+
+class StaticOracle:
+    """Constant-verdict oracle for tests and wiring without a twin."""
+
+    def __init__(self, improves: bool = True, **extra: Any):
+        self.improves = improves
+        self.extra = extra
+        self.rehearsals = 0
+
+    async def rehearse(self, decision) -> Dict[str, Any]:
+        self.rehearsals += 1
+        return {"improves": self.improves, "oracle": "static", **self.extra}

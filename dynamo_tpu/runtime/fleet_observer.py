@@ -306,6 +306,19 @@ class DigestBuilder:
                     "appended": rec.total_appended,
                     "anomalies_fired": rec.anomalies_fired,
                 }
+            # actuation state: the live co-scheduling knob values plus the
+            # retune counter, so the planner's fast loop reads CURRENT
+            # knobs off the digest plane (planner/actuator.py) and
+            # dynamo_top's ACT column shows what the actuator last did
+            sched = getattr(engine, "scheduler", None)
+            if sched is not None and hasattr(sched, "mixed_prefill_tokens"):
+                digest["act"] = {
+                    "mixed_prefill_tokens": int(sched.mixed_prefill_tokens),
+                    "mixed_prefill_seqs": int(
+                        getattr(sched, "mixed_prefill_seqs", 0) or 0),
+                    "spec_k": int(getattr(engine, "spec_k", 0) or 0),
+                    "retunes": int(getattr(engine, "retunes", 0) or 0),
+                }
         return digest
 
 
@@ -437,6 +450,18 @@ class FleetObserver:
         self._digests.pop(tuple(worker), None)
         self._last_seq.pop(tuple(worker), None)
 
+    def forget_instance(self, instance_id: int) -> int:
+        """Drop every (instance_id, dp_rank) worker immediately — wired
+        to discovery DELETE events so a killed worker's already-ingested
+        digests stop feeding load aggregates the moment the fleet knows
+        it is gone, instead of lingering until the 3x-window age-out. An
+        actuator scaling against that ghost load would fight a worker
+        that no longer exists. Returns the number of workers dropped."""
+        victims = [w for w in self._digests if w[0] == instance_id]
+        for w in victims:
+            self.forget(w)
+        return len(victims)
+
     # -- aggregation --------------------------------------------------------
     def _window(self, now: Optional[float], window_s: Optional[float]
                 ) -> Dict[Worker, List[dict]]:
@@ -553,6 +578,8 @@ class FleetObserver:
                               if d.get("spec")), {}),
                 "tree": next((d["tree"] for d in reversed(digests)
                               if d.get("tree")), {}),
+                "act": next((d["act"] for d in reversed(digests)
+                             if d.get("act")), {}),
                 "counters": {k: round(v, 6) if isinstance(v, float) else v
                              for k, v in counters.items()},
                 "phases": self._pct_block(hists),

@@ -1,0 +1,194 @@
+"""Planner actuation A/B on the fleet twin: the SAME shifting bursty
+trace against the SAME deliberately mis-tuned fleet, once with a static
+config (control) and once with the actuation engine live
+(planner/actuator.py). The fleet starts with a starved mixed-prefill
+token budget and too few workers, so burst cohorts queue behind chunked
+prefill and TTFT p99 blows through the SLO.
+
+Each arm runs ONE day as two back-to-back halves of the same trace on
+one live fleet:
+
+- morning: the breach window. The static arm just suffers; the actuated
+  arm's SloEngine burn trips the actuator, which retunes the
+  prefill:decode ratio and scales replicas through the VirtualConnector
+  handshake (twin-rehearsed when --shadow twin).
+- afternoon: the SAME trace again. This is the scored half — the
+  actuated fleet has converged, so the A/B compares steady states
+  instead of charging the actuated arm for the transient the actuator
+  exists to end.
+
+  JAX_PLATFORMS=cpu python scripts/bench_fleet_actuator.py \
+      --out-dir docs/bench/actuator_ab
+
+Emits one JSON file per arm (static.json / actuated.json) plus a
+verdict line; exit code 1 when the A/B gate fails (static's afternoon
+holds the SLO, or the actuated afternoon violates it). docs/planner.md
+documents the decision pipeline this exercises; docs/perf_notes.md
+holds the dated results.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("bench_fleet_actuator")
+    p.add_argument("--workers", type=int, default=2,
+                   help="starting replicas (the actuator may scale up)")
+    p.add_argument("--sessions", type=int, default=24,
+                   help="sessions PER scenario")
+    p.add_argument("--scenarios", default="burst,agentic",
+                   help="shifting mix: burst cohorts + agentic background")
+    p.add_argument("--rps", type=float, default=6.0)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--speed", type=float, default=1.0,
+                   help="SimTiming scale; 1.0 = calibrated v5e-ish costs "
+                        "(this A/B needs real latency signal)")
+    p.add_argument("--time-scale", type=float, default=1.0)
+    p.add_argument("--mixed-prefill-tokens", type=int, default=64,
+                   help="the mis-tuned static budget (a 256-token prompt "
+                        "needs 4 chunked steps)")
+    p.add_argument("--mixed-prefill-seqs", type=int, default=8)
+    p.add_argument("--ttft-slo", type=float, default=1.0)
+    p.add_argument("--itl-slo", type=float, default=10.0,
+                   help="kept slack so the ratio shift is TTFT-driven")
+    p.add_argument("--max-replicas", type=int, default=5)
+    p.add_argument("--digest-period", type=float, default=0.2)
+    p.add_argument("--digest-window", type=float, default=3.0)
+    p.add_argument("--tick-interval", type=float, default=0.25)
+    p.add_argument("--cooldown", type=float, default=1.5,
+                   help="short: the ratio knob walks 64->96->... during "
+                        "the run instead of moving once")
+    p.add_argument("--shadow", default="twin",
+                   choices=["twin", "static", "off"],
+                   help="rehearsal oracle for the actuated arm")
+    p.add_argument("--arm", default="both",
+                   choices=["both", "static", "actuated"])
+    p.add_argument("--out-dir", default=None,
+                   help="write <arm>.json files here (else stdout only)")
+    return p.parse_args(argv)
+
+
+async def run_arm(args, actuate: bool) -> dict:
+    from dynamo_tpu.mocker.fleet import FleetSim
+
+    kwargs = dict(
+        n_workers=args.workers, router_mode="kv", seed=args.seed,
+        speed=args.speed, idle_sleep_s=0.01,
+        digest_period_s=args.digest_period,
+        digest_window_s=args.digest_window,
+        slo=f"ttft:p99<{args.ttft_slo:g},itl:p50<{args.itl_slo:g}",
+        mixed_prefill_tokens=args.mixed_prefill_tokens,
+        mixed_prefill_seqs=args.mixed_prefill_seqs,
+    )
+    if actuate:
+        from dynamo_tpu.planner.actuator import ActuatorConfig
+        from dynamo_tpu.planner.shadow import StaticOracle
+
+        shadow = {"twin": "twin", "static": StaticOracle(improves=True),
+                  "off": "off"}[args.shadow]
+        kwargs.update(
+            actuate=True, shadow=shadow,
+            actuator_config=ActuatorConfig(
+                tick_interval_s=args.tick_interval,
+                hysteresis_ticks=2,
+                cooldown_s=args.cooldown,
+                flap_guard_s=600.0,  # this run never needs the inverse
+                min_samples=1,
+                waiting_high=0.5,
+                max_replicas=args.max_replicas,
+            ),
+        )
+    sim = FleetSim(**kwargs)
+    scenarios = [s for s in args.scenarios.split(",") if s]
+    halves = {}
+    await sim.start()
+    try:
+        for half in ("morning", "afternoon"):
+            halves[half] = await sim.run(
+                scenarios=scenarios, n_sessions=args.sessions,
+                rps=args.rps, time_scale=args.time_scale,
+                ttft_slo_s=args.ttft_slo, itl_slo_s=args.itl_slo,
+            )
+    finally:
+        await sim.stop()
+
+    def _summary(report):
+        goodput = report.get("goodput") or {}
+        return {
+            "ttft_p99_s": goodput.get("ttft_p99_s"),
+            "ttft_p50_s": goodput.get("ttft_p50_s"),
+            "itl_p50_s": goodput.get("itl_p50_s"),
+            "slo_attainment": report.get("slo_attainment"),
+            "slo_state": report.get("slo_state"),
+            "workers_alive_end": report.get("workers_alive"),
+            "requests": report.get("requests"),
+            "duration_s": report.get("duration_s"),
+            "actuation": report.get("actuation"),
+            "goodput": goodput,
+        }
+
+    return {
+        "arm": "actuated" if actuate else "static",
+        "config": {
+            "workers_start": args.workers,
+            "mixed_prefill_tokens_start": args.mixed_prefill_tokens,
+            "scenarios": args.scenarios,
+            "sessions_per_scenario": args.sessions,
+            "rps": args.rps,
+            "seed": args.seed,
+            "speed": args.speed,
+            "ttft_slo_s": args.ttft_slo,
+            "shadow": args.shadow if actuate else None,
+        },
+        "morning": _summary(halves["morning"]),
+        "afternoon": _summary(halves["afternoon"]),
+    }
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    out = {}
+    if args.arm in ("both", "static"):
+        out["static"] = asyncio.run(run_arm(args, actuate=False))
+    if args.arm in ("both", "actuated"):
+        out["actuated"] = asyncio.run(run_arm(args, actuate=True))
+    for arm, rep in out.items():
+        print(json.dumps(rep))
+        if args.out_dir:
+            os.makedirs(args.out_dir, exist_ok=True)
+            path = os.path.join(args.out_dir, f"{arm}.json")
+            with open(path, "w") as f:
+                json.dump(rep, f, indent=2, sort_keys=True)
+                f.write("\n")
+    if args.arm != "both":
+        return 0
+    slo = args.ttft_slo
+    static_p99 = out["static"]["afternoon"].get("ttft_p99_s") or 0.0
+    act_p99 = out["actuated"]["afternoon"].get("ttft_p99_s") or 0.0
+    act = out["actuated"]["afternoon"].get("actuation") or {}
+    verdict = {
+        "ttft_slo_s": slo,
+        "static_afternoon_ttft_p99_s": static_p99,
+        "actuated_afternoon_ttft_p99_s": act_p99,
+        "static_violates": static_p99 > slo,
+        "actuated_holds": 0.0 < act_p99 <= slo,
+        "decisions_applied": (act.get("counts") or {}).get("applied", 0),
+        "ab_pass": (static_p99 > slo >= act_p99 > 0.0
+                    and (act.get("counts") or {}).get("applied", 0) >= 1),
+    }
+    print(json.dumps({"verdict": verdict}))
+    if args.out_dir:
+        with open(os.path.join(args.out_dir, "verdict.json"), "w") as f:
+            json.dump(verdict, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0 if verdict["ab_pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,17 @@ def fetch_fleet(base_url: str, window_s: float = 0.0,
         return json.loads(resp.read())
 
 
+def fetch_planner(base_url: str, timeout_s: float = 5.0) -> dict:
+    """Actuator journal from `/debug/planner`; {} when the frontend runs
+    without `--actuate` (the endpoint 404s) or the fetch fails."""
+    url = base_url.rstrip("/") + "/debug/planner"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError):
+        return {}
+
+
 def _mb(n: int) -> str:
     return f"{n / 1e6:.1f}" if n else "-"
 
@@ -69,7 +80,38 @@ def _worker_slo(view: dict, wkey: str) -> str:
     return _STATE_GLYPH.get(worst, worst) if states else "-"
 
 
-def render(view: dict) -> list:
+def _act_cell(act: dict) -> str:
+    """`tokens/seqs K<spec_k>` — the live co-scheduling knobs off the
+    worker's last digest, so a retune is visible the next refresh."""
+    if not act:
+        return "-"
+    tok = act.get("mixed_prefill_tokens")
+    seqs = act.get("mixed_prefill_seqs")
+    k = act.get("spec_k") or 0
+    cell = f"{tok}/{seqs}" if tok is not None else "-"
+    return f"{cell} K{k}" if k else cell
+
+
+def _planner_line(planner: dict) -> str:
+    """One-line actuator summary: tick count, terminal-status tallies,
+    and the most recent journal entry with its trigger rule."""
+    journal = planner.get("journal") or {}
+    counts = journal.get("counts") or {}
+    tallies = " ".join(f"{k}={counts[k]}" for k in sorted(counts)) or "idle"
+    line = f"  planner: ticks={planner.get('ticks', 0)} {tallies}"
+    decisions = journal.get("decisions") or []
+    if decisions:
+        d = decisions[-1]
+        a = d.get("action") or {}
+        arrow = {1: "+1", -1: "-1"}.get(a.get("direction"), "")
+        rule = (d.get("trigger") or {}).get("rule", "-")
+        line += (f" | last #{d.get('decision_id')}: {a.get('kind')} "
+                 f"{a.get('target')} {arrow} -> {d.get('status')} "
+                 f"(rule={rule})")
+    return line
+
+
+def render(view: dict, planner: dict = None) -> list:
     """The dashboard as a list of text lines (shared by plain + curses)."""
     slo = view.get("slo") or {}
     lines = [
@@ -97,11 +139,13 @@ def render(view: dict) -> list:
             f"expiries={sess.get('expiries', 0)} "
             f"turns p50/max={sess.get('turns_p50', 0)}/"
             f"{sess.get('turns_max', 0)}")
+    if planner:
+        lines.append(_planner_line(planner))
     sess_by_inst = sess.get("by_instance") or {}
     lines.append("")
     hdr = (f"{'WORKER':<14} {'RUN':>4} {'WAIT':>4} {'KV%':>5} {'G2':>6} "
            f"{'G3':>6} {'G2MB':>7} {'G3MB':>7} {'QNT%':>5} {'REQ':>6} "
-           f"{'SESS':>5} {'TREE%':>6} "
+           f"{'SESS':>5} {'TREE%':>6} {'ACT':>10} "
            f"{'TTFT99':>8} {'ITL50':>7} {'E2E95':>8} "
            f"{'PFHIT%':>6} {'SLO':>6}")
     lines.append(hdr)
@@ -128,7 +172,7 @@ def render(view: dict) -> list:
             f"{kv.get('g2_blocks', 0) or 0:>6} {kv.get('g3_blocks', 0) or 0:>6} "
             f"{g2_mb:>7} {g3_mb:>7} {quant_pct:>5} "
             f"{(row.get('counters') or {}).get('requests', 0):>6} "
-            f"{n_sess:>5} {tree_pct:>6} "
+            f"{n_sess:>5} {tree_pct:>6} {_act_cell(row.get('act') or {}):>10} "
             f"{_ms(phases, 'ttft', 'p99_s'):>8} {_ms(phases, 'itl', 'p50_s'):>7} "
             f"{_ms(phases, 'e2e', 'p95_s'):>8} {pf_pct:>6} "
             f"{_worker_slo(view, wkey):>6}"
@@ -140,7 +184,7 @@ def render(view: dict) -> list:
             f"{'fleet':<14} {'':>4} {'':>4} {'':>5} {'':>6} {'':>6} "
             f"{'':>7} {'':>7} {'':>5} "
             f"{sum((r.get('counters') or {}).get('requests', 0) for r in (view.get('workers') or {}).values()):>6} "
-            f"{'':>5} {'':>6} "
+            f"{'':>5} {'':>6} {'':>10} "
             f"{_ms(fleet_phases, 'ttft', 'p99_s'):>8} "
             f"{_ms(fleet_phases, 'itl', 'p50_s'):>7} "
             f"{_ms(fleet_phases, 'e2e', 'p95_s'):>8}")
@@ -151,7 +195,8 @@ def _plain_loop(args) -> int:
     while True:
         try:
             view = fetch_fleet(args.url, args.window, args.timeout)
-            print("\n".join(render(view)), flush=True)
+            planner = fetch_planner(args.url, args.timeout)
+            print("\n".join(render(view, planner)), flush=True)
         except (urllib.error.URLError, OSError) as e:
             print(f"fetch failed: {e}", file=sys.stderr)
             if args.once:
@@ -173,7 +218,7 @@ def _curses_loop(args) -> int:
         while True:
             try:
                 view = fetch_fleet(args.url, args.window, args.timeout)
-                lines = render(view)
+                lines = render(view, fetch_planner(args.url, args.timeout))
                 err = None
             except (urllib.error.URLError, OSError) as e:
                 lines, err = [f"fetch failed: {e}"], e

@@ -405,3 +405,66 @@ async def test_fleet_sim_digest_silent_worker_ages_out_without_flapping():
     finally:
         await sim.stop()
     assert _san_clean(sim), sim.sanitizer.report()
+
+
+async def test_fleet_sim_actuator_live_under_shifting_bursty_trace():
+    """The SLA loop closed under chaos: a deterministic FaultSchedule
+    (digest plane loss + duplication, no kills) runs against a shifting
+    bursty trace — multi-turn agentic sessions pinned by affinity plus a
+    burst wave — while the actuator holds an unmeetable TTFT SLO in
+    BREACH. Contract: the actuator applies at least one decision (scale
+    up through the connector handshake, realized by the sim's poller),
+    never flaps (an up is never followed by a down — flap guard), every
+    stream drains (zero hung), no bound session is rebound mid-stream by
+    actuation, and the sanitizer stays clean with the actuator live."""
+    from dynamo_tpu.mocker.fleet import FaultSchedule, FleetSim
+    from dynamo_tpu.planner.actuator import ActuatorConfig
+    from dynamo_tpu.planner.shadow import StaticOracle
+
+    sim = FleetSim(
+        n_workers=3, router_mode="kv", seed=23,
+        speed=0.0, idle_sleep_s=0.01,
+        digest_period_s=0.2, digest_window_s=3.0,
+        migration_backoff_base_s=0.01, sick_cooldown_s=0.3,
+        session_affinity_ttl=5.0,
+        slo="ttft:p99<0.000001,itl:p50<10",
+        actuate=True, shadow=StaticOracle(improves=True),
+        actuator_config=ActuatorConfig(
+            tick_interval_s=0.2, hysteresis_ticks=2, cooldown_s=30.0,
+            flap_guard_s=60.0, min_samples=1, waiting_high=0.0),
+    )
+    # the digest plane degrades mid-run; the actuator must keep its
+    # footing on the samples that do land (seq dedup + forget-on-delete)
+    sched = FaultSchedule.parse(
+        "digest_drop@0.5+1.0:w1; digest_dup@0.8+1.0:w0")
+    await sim.start()
+    try:
+        report = await sim.run(
+            scenarios=("agentic", "burst"), n_sessions=4, rps=6.0,
+            fault_schedule=sched)
+        for _ in range(40):  # let the poller realize the last decision
+            if sim.alive_workers() > 3 and sim.connector.acked() >= 1:
+                break
+            await asyncio.sleep(0.1)
+        payload = sim.actuator.debug_payload()
+        rebinds = sim.watcher.affinity.snapshot()["rebinds"]
+        alive = sim.alive_workers()
+        acked = sim.connector.acked()
+        assert sim.active_streams() == 0  # zero hung streams
+    finally:
+        await sim.stop()
+    g = report["goodput"]
+    assert g["n_ok"] == g["n_requests"]  # every stream completed
+    act = report["actuation"]
+    assert act["counts"].get("applied", 0) >= 1, payload
+    assert alive == 4 and acked >= 1  # decision realized + acked
+    # zero flapping: the fleet only ever scaled UP this run
+    assert act["scale_events"].get("up") == 1
+    assert "down" not in act["scale_events"]
+    applied = [d for d in payload["journal"]["decisions"]
+               if d["status"] == "applied"]
+    assert all(d["action"]["direction"] >= 0 for d in applied)
+    # actuation never rebound a bound session mid-stream
+    assert rebinds == 0
+    assert report["faults"] == {"digest_drop": 1, "digest_dup": 1}
+    assert _san_clean(sim), sim.sanitizer.report()

@@ -225,6 +225,27 @@ def test_worker_death_mid_window_then_ages_out():
     assert obs.ingest(_digest((1, 0), seq=1), now=46.0)
 
 
+def test_forget_instance_drops_ghost_load_immediately():
+    """A discovery DELETE forgets the dead instance NOW, not at the
+    3x-window age-out: a planner scaling against the window would
+    otherwise count load from workers that no longer exist (and a drain
+    decision could target a ghost). All dp ranks of the instance go."""
+    obs = FleetObserver(None, window_s=10.0)
+    obs.ingest(_digest((1, 0), seq=1, itl=[0.01] * 8), now=0.0)
+    obs.ingest(_digest((1, 1), seq=1, itl=[0.01] * 8), now=0.0)
+    obs.ingest(_digest((2, 0), seq=1, itl=[0.01] * 8), now=0.0)
+    assert obs.workers(now=1.0) == [(1, 0), (1, 1), (2, 0)]
+    assert obs.forget_instance(1) == 2  # both dp ranks dropped
+    assert obs.workers(now=1.0) == [(2, 0)]
+    assert hist_count(obs.phase_hists(now=1.0)["itl"]) == 8
+    # idempotent; unknown instances are a no-op
+    assert obs.forget_instance(1) == 0
+    assert obs.forget_instance(999) == 0
+    # the instance may come back (restart reuses the id): seq restarts
+    assert obs.ingest(_digest((1, 0), seq=1, itl=[0.01] * 8), now=2.0)
+    assert obs.workers(now=3.0) == [(1, 0), (2, 0)]
+
+
 def test_lossy_digest_plane_under_churn():
     """Drops, duplicates, and reordering on the digest plane while the
     fleet churns (a worker dies, another reboots): the window must count
