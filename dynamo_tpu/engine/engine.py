@@ -151,8 +151,12 @@ class InferenceEngine:
         host_kv_blocks: int = 0,  # G2 host-tier capacity (0 = disabled)
         disk_kv_blocks: int = 0,  # G3 disk-tier capacity (needs G2 enabled)
         disk_kv_root: Optional[str] = None,
+        disk_kv_bytes: Optional[int] = None,  # G3 byte budget: exceeding
+        #   it spills LRU blocks down to G4 even with block slots free
         obj_kv_root: Optional[str] = None,  # G4 object store (fs backend /
         #   shared mount; S3 via kvbm.object_store.S3Backend)
+        slice_id: Optional[str] = None,  # topology label (ICI island) for
+        #   link-class routing; advertised as kv_slice metadata
         kv_tier_quantize: bool = False,  # store demoted G2/G3/G4 blocks as
         #   int8 + per-(token, head) scales (kvbm/quant.py) — ~2x effective
         #   cold-tier capacity; promotion dequantizes, or passes through
@@ -243,6 +247,7 @@ class InferenceEngine:
         # the router's topology-aware placement consumes it as the live
         # transfer-cost model.
         self.kv_onboard_ewma: Dict[str, Dict[str, float]] = {}
+        self.slice_id = str(slice_id) if slice_id is not None else None
         if (disk_kv_blocks > 0 or obj_kv_root) and host_kv_blocks <= 0:
             log.warning(
                 "disk/object KV tiers ignored: they spill from the G2 host "
@@ -262,6 +267,7 @@ class InferenceEngine:
                     disk_kv_root or tempfile.mkdtemp(prefix="dyn_kv_g3_"),
                     capacity_blocks=disk_kv_blocks,
                     quantize=kv_tier_quantize,
+                    capacity_bytes=disk_kv_bytes,
                 )
             obj = None
             if obj_kv_root:
@@ -269,6 +275,9 @@ class InferenceEngine:
 
                 obj = ObjectKvPool(FsBackend(obj_kv_root),
                                    quantize=kv_tier_quantize)
+                # shared-tier residency events for the router's G4 index
+                # (fires from the writer/spill thread → step thread)
+                obj.store_listener = self._on_obj_stored
             self.host_pool = TieredKv(host, disk, obj)
             self.pool.evict_hook = self._offload_page
             self.host_pool.on_evict(self._on_host_evicted)
@@ -889,8 +898,14 @@ class InferenceEngine:
             return
         # the peer-pull leg of the transfer-cost model: remote blocks then
         # onboard from local G2, so the total remote cost the router sees
-        # is ewma[remote] + ewma[host]
-        self._note_onboard([], n, time.perf_counter() - t0, tier="remote")
+        # is ewma[remote] + ewma[host]. When the router tagged the hint
+        # with the link class, the same sample also feeds the per-class
+        # EWMA (remote_ici / remote_dcn) the link-aware selector prefers.
+        elapsed = time.perf_counter() - t0
+        self._note_onboard([], n, elapsed, tier="remote")
+        link = hint.get("link")
+        if link in ("ici", "dcn"):
+            self._note_onboard([], n, elapsed, tier=f"remote_{link}")
         self._inbox.put(("host_import", (hashes[:n], parents[:n], payload)))
 
     async def prefetch_hint_async(self, hint: Dict[str, Any]) -> bool:
@@ -1293,6 +1308,13 @@ class InferenceEngine:
             elif op == "prefetch_disk":
                 if self.prefetch is not None:
                     self.prefetch.on_disk_read(*arg)
+            elif op == "prefetch_obj":
+                if self.prefetch is not None:
+                    self.prefetch.on_obj_read(*arg)
+            elif op == "obj_event":
+                h, parent = arg
+                self._host_events.append(
+                    KvEvent("store", [h], parent, tier="obj"))
             elif op == "reload_weights":
                 path, fut, loop = arg
                 try:
@@ -2581,6 +2603,16 @@ class InferenceEngine:
 
     def _on_host_evicted(self, hashes: List[int]) -> None:
         self._host_events.append(KvEvent("remove", hashes, tier="host"))
+        if getattr(self.host_pool, "obj", None) is not None:
+            # terminal tier is G4: the block left the shared store too,
+            # so the router's obj_index residency must expire with it
+            self._host_events.append(KvEvent("remove", hashes, tier="obj"))
+
+    def _on_obj_stored(self, block_hash: int, parent: Optional[int]) -> None:
+        """G4 store_listener — may fire from the writer/spill thread, so
+        hand the event to the step thread via the inbox (the KvEvent list
+        is step-thread-owned)."""
+        self._inbox.put(("obj_event", (block_hash, parent)))
 
     def _host_export(self, hashes: List[int], fut, loop) -> None:
         """Serve a peer's cross-worker onboarding pull: the leading run of

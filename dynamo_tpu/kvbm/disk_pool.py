@@ -147,10 +147,16 @@ class DiskKvPool:
     as HostKvPool so the tier chain composes them uniformly."""
 
     def __init__(self, root: str, capacity_blocks: int = 1 << 16,
-                 quantize: bool = False):
+                 quantize: bool = False,
+                 capacity_bytes: Optional[int] = None):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.capacity = capacity_blocks
+        # optional byte budget (how an operator actually provisions an
+        # NVMe partition): eviction under byte pressure spills data-bearing
+        # blocks down to the G4 object store via spill_hook, same as the
+        # block-count LRU
+        self.capacity_bytes = capacity_bytes
         # quantize dense blocks on entry (blocks demoted from a quantized
         # G2 arrive as dicts already and pass through untouched)
         self.quantize = quantize
@@ -395,13 +401,20 @@ class DiskKvPool:
                     return
             time.sleep(0.005)
 
+    def _over_budget(self) -> bool:
+        """Caller holds self._lock."""
+        if len(self._blocks) > self.capacity:
+            return True
+        return (self.capacity_bytes is not None
+                and self.stats["stored_bytes"] > self.capacity_bytes)
+
     def _enforce_capacity(self) -> None:
         dropped: List[int] = []
         unlink_now: List[int] = []
         spill_mem = []
         spill_deferred = []
         with self._lock:
-            while len(self._blocks) > self.capacity:
+            while self._over_budget():
                 # LRU order, skipping prefetch-pinned blocks; all pinned →
                 # overshoot until the pins release (pins are TTL-bounded)
                 h = next(

@@ -60,6 +60,9 @@ class FsBackend:
         except FileNotFoundError:
             pass
 
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
     def list_keys(self) -> List[str]:
         return [n for n in os.listdir(self.root) if n.endswith(".kvb")]
 
@@ -94,6 +97,13 @@ class S3Backend:  # pragma: no cover - requires boto3 + network
     def delete(self, key: str) -> None:
         self._s3.delete_object(Bucket=self.bucket, Key=self.prefix + key)
 
+    def exists(self, key: str) -> bool:
+        try:
+            self._s3.head_object(Bucket=self.bucket, Key=self.prefix + key)
+            return True
+        except Exception:
+            return False
+
     def list_keys(self) -> List[str]:
         out, token = [], None
         while True:
@@ -115,19 +125,33 @@ class ObjectKvPool:
     index)."""
 
     def __init__(self, backend, capacity_blocks: int = 1 << 20,
-                 quantize: bool = False):
+                 quantize: bool = False, dedup: bool = True):
         self.backend = backend
         self.capacity = capacity_blocks
         # quantize dense blocks on entry (blocks demoted from quantized
         # upper tiers arrive as dicts already and pass through untouched)
         self.quantize = quantize
+        # fleet-wide content-hash dedup: before writing a demoted block,
+        # probe the (shared) backend — a peer already stored this content,
+        # so adopt its object instead of re-uploading identical bytes
+        self.dedup = dedup
         self._blocks: "OrderedDict[int, Optional[int]]" = OrderedDict()
         self.stats = {"offloaded": 0, "onboarded": 0, "evicted": 0,
-                      "stored_bytes": 0, "quant_blocks": 0}
+                      "stored_bytes": 0, "quant_blocks": 0,
+                      "dedup_hits": 0, "dedup_bytes_saved": 0}
         self._evict_listeners: List[Any] = []
+        # fleet placement: called with (hash, parent) when a block becomes
+        # locally indexed (write queued OR dedup-adopted) — the engine
+        # forwards these as tier="obj" KV events so the router's G4 index
+        # credits the shared tier. May fire from the spill/writer thread;
+        # the listener must be thread-safe (the engine posts to its inbox).
+        self.store_listener = None
         self._lock = threading.Lock()
         self._hash_only: set = set()  # entries with no data behind them
         self._pending: Dict[int, Tuple[np.ndarray, np.ndarray, Optional[int]]] = {}
+        # prefetch pins: hashes capacity enforcement must not drop while a
+        # promotion read is queued/in flight (brief, TTL-bounded)
+        self._pinned: set = set()
         import queue
 
         self._write_q: "queue.Queue" = queue.Queue()
@@ -142,6 +166,14 @@ class ObjectKvPool:
         if self._blocks:
             log.info("G4 adopted %d existing objects", len(self._blocks))
 
+    def pin(self, block_hash: int) -> None:
+        with self._lock:
+            self._pinned.add(block_hash)
+
+    def unpin(self, block_hash: int) -> None:
+        with self._lock:
+            self._pinned.discard(block_hash)
+
     def _key(self, block_hash: int) -> str:
         return f"{block_hash & 0xFFFFFFFFFFFFFFFF:016x}.kvb"
 
@@ -154,6 +186,7 @@ class ObjectKvPool:
             self._blocks.clear()
             self._hash_only.clear()
             self._pending.clear()
+            self._pinned.clear()
         if dropped:
             for cb in self._evict_listeners:
                 cb(dropped)
@@ -173,6 +206,7 @@ class ObjectKvPool:
     def put_block(self, block_hash, parent_hash, k, v) -> None:
         if self.quantize:
             k, v = maybe_quantize(k), maybe_quantize(v)
+        deduped = False
         with self._lock:
             if block_hash in self._blocks:
                 self._blocks.move_to_end(block_hash)
@@ -184,22 +218,66 @@ class ObjectKvPool:
             else:
                 self._blocks[block_hash] = parent_hash
                 self.stats["offloaded"] += 1
-            if k is not None:
+        # shared-store dedup probe OUTSIDE the lock (backend IO): the
+        # block is content-addressed, so an existing object with this key
+        # IS this block — adopt it and skip the duplicate upload
+        if (k is not None and self.dedup
+                and self.backend.exists(self._key(block_hash))):
+            deduped = True
+        with self._lock:
+            if block_hash not in self._blocks:
+                return  # evicted during the probe
+            if deduped:
+                self._hash_only.discard(block_hash)
+                self.stats["dedup_hits"] += 1
+                self.stats["dedup_bytes_saved"] += pair_nbytes(k, v)
+            elif k is not None:
                 self._pending[block_hash] = (k, v, parent_hash)
                 self.stats["stored_bytes"] += pair_nbytes(k, v)
                 if is_quantized_block(k):
                     self.stats["quant_blocks"] += 1
             else:
                 self._hash_only.add(block_hash)
-        if k is not None:
+        if k is not None and not deduped:
             self._write_q.put(block_hash)
+        if self.store_listener is not None:
+            try:
+                self.store_listener(block_hash, parent_hash)
+            except Exception:
+                log.exception("G4 store listener failed for %x", block_hash)
         self._enforce_capacity()
 
     def _write_loop(self) -> None:
         while True:
-            h = self._write_q.get()
-            if h is None:
+            item = self._write_q.get()
+            if item is None:
                 return
+            if isinstance(item, tuple) and item[0] == "read":
+                # async promotion read (G4→G2 prefetch): backend IO rides
+                # this thread like the writes so the step thread never
+                # blocks on an object fetch
+                _, h, parent, cb = item
+                with self._lock:
+                    present = h in self._blocks
+                    pending = self._pending.get(h)
+                    hash_only = h in self._hash_only
+                k = v = None
+                if present and pending is not None:
+                    k, v = pending[0], pending[1]
+                elif present and not hash_only:
+                    try:
+                        k, v = self.get_block(h)
+                    except KeyError:
+                        present = False
+                    except Exception:
+                        log.exception("G4 async read failed for %x", h)
+                        k = v = None
+                try:
+                    cb(h, parent, k, v, present)
+                except Exception:
+                    log.exception("G4 read callback failed for %x", h)
+                continue
+            h = item
             with self._lock:
                 entry = self._pending.get(h)
             if entry is None:
@@ -231,8 +309,15 @@ class ObjectKvPool:
         dropped: List[int] = []
         with self._lock:
             while len(self._blocks) > self.capacity:
-                h, _ = self._blocks.popitem(last=False)
+                # LRU order, skipping prefetch-pinned blocks; all pinned →
+                # overshoot until the pins release (pins are TTL-bounded)
+                h = next(
+                    (b for b in self._blocks if b not in self._pinned), None)
+                if h is None:
+                    break
+                self._blocks.pop(h)
                 self._pending.pop(h, None)
+                self._hash_only.discard(h)
                 dropped.append(h)
                 self.stats["evicted"] += 1
         if dropped:
@@ -278,5 +363,22 @@ class ObjectKvPool:
                         block_hash, exc_info=True)
             with self._lock:
                 self._blocks.pop(block_hash, None)
+                self._pinned.discard(block_hash)
             return None, None
         return k, v
+
+    def read_block_async(self, block_hash: int, cb) -> bool:
+        """Queue a block read on the writer thread (G4→G2 prefetch
+        promotion: object-store IO off the step thread, behind any queued
+        writes for the same block). `cb(block_hash, parent, k, v, found)`
+        fires on the writer thread — k/v None for hash-only (sim) or
+        quarantined (stale-layout/corrupt) objects, found=False if the
+        block left the index before the read ran. Returns False (cb never
+        fires) when the block is already absent."""
+        with self._lock:
+            if block_hash not in self._blocks:
+                return False
+            parent = self._blocks[block_hash]
+            self._blocks.move_to_end(block_hash)
+        self._write_q.put(("read", block_hash, parent, cb))
+        return True

@@ -124,6 +124,7 @@ class PrefetchManager:
             # boundary dequantizes)
             "bytes_promoted_g3": 0,
             "bytes_promoted_g2": 0,
+            "bytes_promoted_g4": 0,  # G4→G2 object-store fetch bytes
             "reading_peak": 0,
             "promote_latency_sum_s": 0.0,
         }
@@ -208,6 +209,7 @@ class PrefetchManager:
     # -- dispatch ------------------------------------------------------------
     def _pump(self) -> None:
         disk = self.tiered.disk
+        obj = getattr(self.tiered, "obj", None)
         while self._queue:
             if self._limited and self._budget_bytes <= 0:
                 break
@@ -232,9 +234,24 @@ class PrefetchManager:
                     self._reading.discard(h)
                     disk.unpin(h)
                     self._drop(job, "lost")
+            elif obj is not None and h in obj:
+                # G4-only: the shared object store serves promotions too
+                # (a peer's demoted block, or our own after G3 churn) —
+                # the fetch rides G4's writer thread like G3's file reads
+                if len(self._reading) >= self.max_inflight:
+                    break
+                self._queue.popleft()
+                job.state = READING
+                self._reading.add(h)
+                self.stats["reading_peak"] = max(
+                    self.stats["reading_peak"], len(self._reading))
+                obj.pin(h)
+                if not obj.read_block_async(h, self._on_obj_read):
+                    self._reading.discard(h)
+                    obj.unpin(h)
+                    self._drop(job, "lost")
             else:
-                # not in a tier we promote from (evicted, or G4-only —
-                # object-store reads stay on the synchronous onboard path)
+                # not in any tier we promote from (evicted underneath us)
                 self._queue.popleft()
                 self._drop(job, "lost")
 
@@ -242,19 +259,35 @@ class PrefetchManager:
         self.stats[reason] += 1
         self._jobs.pop(job.h, None)
 
-    # -- G3 → G2 -------------------------------------------------------------
+    # -- G3/G4 → G2 ----------------------------------------------------------
     def _on_disk_read(self, h: int, parent: Optional[int], k, v,
                       found: bool) -> None:
         """Disk writer thread: hand the bytes back to the step thread."""
         self.engine._inbox.put(("prefetch_disk", (h, parent, k, v, found)))
 
+    def _on_obj_read(self, h: int, parent: Optional[int], k, v,
+                     found: bool) -> None:
+        """G4 writer thread: hand the bytes back to the step thread."""
+        self.engine._inbox.put(("prefetch_obj", (h, parent, k, v, found)))
+
     def on_disk_read(self, h: int, parent: Optional[int], k, v,
                      found: bool) -> None:
         """Step thread (inbox op "prefetch_disk")."""
+        self._on_lower_read(h, k, v, found, self.tiered.disk,
+                            "bytes_promoted_g3")
+
+    def on_obj_read(self, h: int, parent: Optional[int], k, v,
+                    found: bool) -> None:
+        """Step thread (inbox op "prefetch_obj")."""
+        self._on_lower_read(h, k, v, found,
+                            getattr(self.tiered, "obj", None),
+                            "bytes_promoted_g4")
+
+    def _on_lower_read(self, h: int, k, v, found: bool, pool,
+                       hop_stat: str) -> None:
         self._reading.discard(h)
-        disk = self.tiered.disk
-        if disk is not None:
-            disk.unpin(h)
+        if pool is not None:
+            pool.unpin(h)
         job = self._jobs.get(h)
         if job is None or job.state != READING:
             self._pump()  # job cancelled/superseded while the read ran
@@ -265,12 +298,12 @@ class PrefetchManager:
             return
         if k is not None:
             # one [L, PS, Hk, D] block — dense or quantized dict, exactly
-            # as G3 stored it; the host tier absorbs either form
+            # as the lower tier stored it; the host tier absorbs either
             self.tiered.host.put_block(h, job.parent, k, v)
             nbytes = pair_nbytes(k, v)
         elif not self._sim_runner():
-            # real engine, data-less read (corrupt/truncated file was
-            # unlinked underneath us): nothing to promote
+            # real engine, data-less read (corrupt/truncated block was
+            # quarantined underneath us): nothing to promote
             self._drop(job, "lost")
             self._pump()
             return
@@ -280,7 +313,7 @@ class PrefetchManager:
         if self._limited:
             self._budget_bytes -= nbytes
         self.stats["bytes_promoted"] += nbytes
-        self.stats["bytes_promoted_g3"] += nbytes
+        self.stats[hop_stat] += nbytes
         job.state = QUEUED  # now host-resident: next stage
         self._promote_from_host(job)
         self._pump()

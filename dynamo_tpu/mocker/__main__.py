@@ -36,6 +36,15 @@ def parse_args(argv=None):
     p.add_argument("--disk-kv-blocks", type=int, default=0,
                    help="G3 disk KV tier capacity in blocks (needs G2 on)")
     p.add_argument("--disk-kv-root", default=None)
+    p.add_argument("--disk-kv-bytes", type=int, default=None,
+                   help="G3 byte budget: exceeding it spills LRU blocks "
+                        "to the G4 object tier (needs --obj-kv-root)")
+    p.add_argument("--obj-kv-root", default=None,
+                   help="G4 object-store root (fs backend / shared "
+                        "mount); enables the fleet-shared KV tier")
+    p.add_argument("--slice-id", default=None,
+                   help="topology label: workers sharing a slice-id are "
+                        "one ICI island; cross-slice pulls are DCN-class")
     p.add_argument("--kv-export-bytes", action="store_true",
                    help="export tiny real KV arrays instead of hash-only "
                         "markers, so disk-tier spills write actual files "
@@ -130,6 +139,9 @@ def build_mock_engine(
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         disk_kv_blocks=getattr(args, "disk_kv_blocks", 0),
         disk_kv_root=getattr(args, "disk_kv_root", None),
+        disk_kv_bytes=getattr(args, "disk_kv_bytes", None),
+        obj_kv_root=getattr(args, "obj_kv_root", None),
+        slice_id=getattr(args, "slice_id", None),
         kv_tier_quantize=getattr(args, "kv_tier_quantize", False),
         onboard_layer_groups=getattr(args, "onboard_layer_groups", 1),
         prefetch=getattr(args, "prefetch", False),

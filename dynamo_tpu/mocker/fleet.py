@@ -74,7 +74,7 @@ _EVENT_RE = re.compile(
 )
 
 FAULT_KINDS = ("kill", "restart", "partition", "delay", "corrupt_kv",
-               "digest_drop", "digest_dup")
+               "digest_drop", "digest_dup", "partition_slice")
 
 
 @dataclass
@@ -238,6 +238,15 @@ class FleetSim:
         host_kv_blocks: int = 0,  # G2 tier; auto-enabled by disk_kv_blocks
         disk_kv_blocks: int = 0,
         disk_kv_base: Optional[str] = None,  # per-worker roots under here
+        disk_kv_bytes: Optional[int] = None,  # G3 byte budget (spills →G4)
+        obj_kv_base: Optional[str] = None,  # ONE shared G4 root for the
+        #   whole fleet (content-addressed → fleet-wide prefix dedup);
+        #   None with slices > 1 auto-provisions one under disk_kv_base
+        slices: int = 1,  # ICI islands: worker i lives on slice i%slices;
+        #   cross-slice peer pulls pay the DCN charge below
+        dcn_delay_s: float = 0.0,  # per-pull latency on cross-slice KV
+        #   fetches (the declarative multi-slice topology, realized via
+        #   the same loop-clock charging as the per-edge delay plane)
         sanitize: bool = True,  # fleet-sim default harness: one shared
         #   non-strict Sanitizer across all workers; run() reports its
         #   block and chaos tests assert zero violations
@@ -283,6 +292,14 @@ class FleetSim:
         self.host_kv_blocks = host_kv_blocks
         self.disk_kv_blocks = disk_kv_blocks
         self.disk_kv_base = disk_kv_base
+        self.disk_kv_bytes = disk_kv_bytes
+        self.slices = max(1, int(slices))
+        self.dcn_delay_s = float(dcn_delay_s)
+        self.obj_kv_base = obj_kv_base  # explicit root, or None =
+        #   auto-provision under the realm (see _obj_root)
+        # slice-level partitions: label -> loop-clock deadline; an active
+        # entry severs every cross-slice pull touching that slice
+        self._slice_partitions: Dict[str, float] = {}
         self.mixed_prefill_tokens = mixed_prefill_tokens
         self.mixed_prefill_seqs = mixed_prefill_seqs
         self.spec_ngram = spec_ngram
@@ -364,6 +381,9 @@ class FleetSim:
         )
         await self.observer.start()
         self.slo_engine = SloEngine(self.observer, parse_slo_config(self.slo))
+        # topology-aware placement: the routers' tier_cost_fn closes over
+        # this attribute, so binding after sink construction still works
+        self.watcher.tier_cost_source = self.observer.onboard_costs
 
         async def _watch_digests():
             try:
@@ -457,6 +477,14 @@ class FleetSim:
             # corrupt_kv garbles them and the quarantine path runs for real
             flags += ["--disk-kv-blocks", str(self.disk_kv_blocks),
                       "--disk-kv-root", disk_root, "--kv-export-bytes"]
+            if self.disk_kv_bytes:
+                flags += ["--disk-kv-bytes", str(self.disk_kv_bytes)]
+        obj_root = self._obj_root()
+        if obj_root:
+            os.makedirs(obj_root, exist_ok=True)
+            flags += ["--obj-kv-root", obj_root]
+        if self.slices > 1:
+            flags += ["--slice-id", self.slice_of(idx)]
         margs = mocker_args(flags)
         engine, card = build_mock_engine(
             margs, timing=self.timing, idle_sleep_s=self.idle_sleep_s,
@@ -464,6 +492,18 @@ class FleetSim:
         digest_state: Dict[str, float] = {}
         served = await serve_worker(
             rt, engine, card, digest_period_s=self.digest_period_s)
+        if self.slices > 1 and getattr(engine, "remote_kv_fetch", None):
+            # multi-slice topology: cross-slice peer pulls pay the DCN
+            # charge (or sever under a slice partition). Wrapping the
+            # fetch — which _pull_remote_host times — means the worker's
+            # measured remote EWMA honestly reflects the link class.
+            inner = engine.remote_kv_fetch
+
+            async def _fetch(hint, _inner=inner, _src=idx):
+                await self._charge_link(_src, hint)
+                return await _inner(hint)
+
+            engine.remote_kv_fetch = _fetch
         if served.digest_pub is not None:
             served.digest_pub.pub = _FaultyDigestPublisher(
                 served.digest_pub.pub, digest_state)
@@ -504,6 +544,94 @@ class FleetSim:
             self.sanitizer.audit_tasks()
         if self._install_fault_hook:
             rp.set_inproc_fault_hook(None)
+
+    # -- multi-slice topology ----------------------------------------------
+    def slice_of(self, idx: int) -> str:
+        """Worker slot -> slice label. Round-robin so labels stay stable
+        for slots appended by scale-up."""
+        return f"s{idx % self.slices}"
+
+    def _obj_root(self) -> Optional[str]:
+        """The fleet-shared G4 directory: ONE root for every worker —
+        that sharing is what makes content-hash dedup fleet-wide."""
+        if self.obj_kv_base:
+            return self.obj_kv_base
+        if self.slices > 1 and self.disk_kv_blocks > 0:
+            return os.path.join(self.disk_kv_base or "/tmp/fleet_sim_kv",
+                                self.realm, "g4_shared")
+        return None
+
+    async def _charge_link(self, src_idx: int, hint: Dict[str, Any]) -> None:
+        """Charge the link class of a peer KV pull: same-slice = free
+        (ICI is modeled as transport baseline), cross-slice = the DCN
+        delay, severed entirely while either slice is partitioned. Runs
+        inside the timed fetch, so measured remote EWMAs see it."""
+        dst_idx = self._iid_to_idx.get(int(hint.get("instance") or 0))
+        if dst_idx is None:
+            return
+        a, b = self.slice_of(src_idx), self.slice_of(dst_idx)
+        if a == b:
+            return
+        now = asyncio.get_event_loop().time()
+        for s in (a, b):
+            p = self._slice_partitions.get(s)
+            if p is not None and now < p:
+                raise ConnectionResetError(
+                    f"slice {s} partitioned ({a}<->{b} pull)")
+        d = self._delays.get(("edge", a, b)) or self._delays.get(
+            ("edge", b, a))
+        if d is not None and now < d[0]:
+            await asyncio.sleep(d[1])
+        elif self.dcn_delay_s > 0:
+            await asyncio.sleep(self.dcn_delay_s)
+
+    def partition_slice(self, slice_label: str, duration_s: float) -> None:
+        """Sever every cross-slice KV pull into/out of a slice. Pulls
+        degrade to local rehydration/recompute via _pull_remote_host's
+        failure path — requests keep streaming."""
+        self._count("partition_slice")
+        self._slice_partitions[str(slice_label)] = (
+            asyncio.get_event_loop().time() + duration_s)
+
+    def delay_edge(self, a: str, b: str, duration_s: float,
+                   delay_s: float) -> None:
+        """Per-edge DCN degradation between two slices (overrides the
+        uniform dcn_delay_s while active)."""
+        self._count("delay_edge")
+        self._delays[("edge", str(a), str(b))] = (
+            asyncio.get_event_loop().time() + duration_s, delay_s)
+
+    def kv_fabric_report(self) -> Dict[str, Any]:
+        """Fleet-wide fabric counters: G4 occupancy/dedup, promoted-from-
+        G4 bytes, and the router's prefix-economy actions."""
+        out = {"slices": self.slices, "dedup_hits": 0,
+               "dedup_bytes_saved": 0, "obj_stored_bytes": 0,
+               "obj_blocks": 0, "bytes_promoted_g4": 0,
+               "replications": 0, "hot_trunks": 0}
+        for w in self.workers:
+            hp = getattr(w.engine, "host_pool", None)
+            obj = getattr(hp, "obj", None)
+            if obj is not None:
+                st = getattr(obj, "stats", {})
+                out["dedup_hits"] += int(st.get("dedup_hits", 0))
+                out["dedup_bytes_saved"] += int(
+                    st.get("dedup_bytes_saved", 0))
+                out["obj_stored_bytes"] += int(st.get("stored_bytes", 0))
+                out["obj_blocks"] = max(out["obj_blocks"], len(obj))
+            pf = getattr(w.engine, "prefetch", None)
+            if pf is not None:
+                out["bytes_promoted_g4"] += int(
+                    getattr(pf, "stats", {}).get("bytes_promoted_g4", 0))
+        for entry in (self.manager.models if self.manager else {}).values():
+            kvr = getattr(getattr(entry, "sink", None), "router", None)
+            ps = getattr(kvr, "prefix_stats", None)
+            if isinstance(ps, dict):
+                out["replications"] += int(ps.get("replications", 0))
+                out["hot_trunks"] += int(ps.get("hot_trunks", 0))
+        stored = out["obj_stored_bytes"]
+        out["dedup_ratio"] = round(
+            (stored + out["dedup_bytes_saved"]) / stored, 3) if stored else 0.0
+        return out
 
     # -- fault plane -------------------------------------------------------
     async def _fault_hook(self, direction: str, address: str) -> None:
@@ -850,6 +978,9 @@ class FleetSim:
             # disk truncation walks + rewrites tier files: off the loop,
             # which carries every in-flight stream of the sim (DYN-A002)
             await asyncio.to_thread(self.corrupt_kv, idx, int(ev.param) or 4)
+        elif ev.kind == "partition_slice":
+            # param carries the numeric slice index (labels are s<i>)
+            self.partition_slice(f"s{int(ev.param)}", dur)
         elif ev.kind in ("digest_drop", "digest_dup"):
             self.digest_fault(idx, ev.kind, dur)
 
@@ -937,6 +1068,8 @@ class FleetSim:
             "faults": dict(self.fault_counts),
             "active_streams_after": self.active_streams(),
         }
+        if self.slices > 1 or self._obj_root():
+            out["kv_fabric"] = self.kv_fabric_report()
         if self.sanitizer is not None:
             out["sanitizer"] = self.sanitizer.report()
         if self.actuator is not None:

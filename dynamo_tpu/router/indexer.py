@@ -40,6 +40,10 @@ class KvIndexer:
             index = make_block_index(ttl_mode=ttl is not None)
         self.index = index
         self.host_index = BlockIndex()  # G2-tier residency (partial credits)
+        # G4 shared-object-tier residency: the store is fleet-shared, so
+        # any worker's entry credits every candidate (cluster-max in the
+        # selector); keyed per-worker anyway so departures expire cleanly
+        self.obj_index = BlockIndex()
         self._sub = subscriber
         self._dump_fn = dump_fn
         self.ttl = ttl
@@ -75,6 +79,7 @@ class KvIndexer:
         self._epoch[worker] = self._epoch.get(worker, 0) + 1
         self.index.remove_worker(worker)
         self.host_index.remove_worker(worker)
+        self.obj_index.remove_worker(worker)
         self._last_event_id.pop(worker, None)
 
     def remove_instance(self, instance_id: int, dp_size: int = 1) -> None:
@@ -121,7 +126,12 @@ class KvIndexer:
             )
             self._schedule_resync(worker)
         self._last_event_id[worker] = ev.event_id
-        target = self.host_index if ev.tier == "host" else self.index
+        if ev.tier == "host":
+            target = self.host_index
+        elif ev.tier == "obj":
+            target = self.obj_index
+        else:
+            target = self.index
         target.apply_event(ev, ttl=self.ttl)
 
     # -- recovery ----------------------------------------------------------

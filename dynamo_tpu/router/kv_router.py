@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import OrderedDict
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 from dynamo_tpu.router.indexer import KvIndexer
@@ -106,6 +107,17 @@ class KvRouter:
         self.tier_cost_fn = tier_cost_fn
         self._tier_costs_cache: Dict[Worker, Dict[str, float]] = {}
         self._tier_costs_at = 0.0
+        # fleet-wide prefix economy: per-trunk (first block hash)
+        # popularity counters drive ONE-shot replication of hot trunks
+        # onto slices that don't hold them yet — repeat traffic for a
+        # popular system prompt then finds a same-slice (ICI) holder
+        # instead of hot-spotting the DCN link to the original slice
+        self.prefix_stats = {"replications": 0, "hot_trunks": 0}
+        self._trunk_pop: "OrderedDict[int, int]" = OrderedDict()
+        self._trunk_replicated: Dict[int, float] = {}  # trunk -> mono ts
+        self.replicate_hot_threshold = 8
+        self.replicate_cooldown_s = 30.0
+        self._trunk_cap = 4096
         self._sync_pub = None
         self._sync_sub = None
         self._sync_inst = None
@@ -382,6 +394,40 @@ class KvRouter:
             out.extend((inst.instance_id, r) for r in range(dp))
         return sorted(out)
 
+    def _slice_of(self, instance_id: int) -> Optional[str]:
+        """Worker's slice label from discovery metadata (kv_slice,
+        worker_common). None = topology unknown → flat link pricing."""
+        inst = self.client.instances.get(instance_id)
+        if inst is None:
+            return None
+        s = (inst.metadata or {}).get("kv_slice")
+        return str(s) if s is not None else None
+
+    def _link_classes(
+        self, workers: List[Worker], host_overlaps: Dict[Worker, int],
+    ) -> Dict[Worker, str]:
+        """Per-candidate link class of the peer-pull path to the best G2
+        holder: same slice = "ici", cross-slice = "dcn". Candidates (or
+        holders) without slice metadata stay absent → the selector's
+        flat "remote" prior, which is exactly PR 9's behavior."""
+        holder, best_n = None, 0
+        for w, n in sorted(host_overlaps.items()):
+            if n > best_n:
+                holder, best_n = w, n
+        out: Dict[Worker, str] = {}
+        if holder is None:
+            return out
+        h_slice = self._slice_of(holder[0])
+        if h_slice is None:
+            return out
+        for w in workers:
+            if w[0] == holder[0]:
+                continue  # own lower tier, not a peer pull
+            w_slice = self._slice_of(w[0])
+            if w_slice is not None:
+                out[w] = "ici" if w_slice == h_slice else "dcn"
+        return out
+
     def find_best_match(
         self, token_ids: List[int], adapter: Optional[str] = None,
         mm_seed: Optional[int] = None, pinned_instance: Optional[int] = None,
@@ -408,10 +454,12 @@ class KvRouter:
         )
         overlaps = self.indexer.index.find_matches(hashes)
         host_overlaps = self.indexer.host_index.find_matches(hashes).scores
+        obj_overlaps = self.indexer.obj_index.find_matches(hashes).scores
         if collect is not None:
             # callers (remote_host_hint) reuse these instead of a second
             # radix walk on the per-request hot path
             collect["host_overlaps"] = host_overlaps
+            collect["obj_overlaps"] = obj_overlaps
         workers = self.workers()
         if allowed_instances is not None:
             workers = [w for w in workers if w[0] in allowed_instances]
@@ -447,6 +495,8 @@ class KvRouter:
             workers, len(hashes), overlaps, self.sequences,
             host_overlaps=host_overlaps, audit=cand_audit,
             tier_costs=self._tier_costs(),
+            link_class=self._link_classes(workers, host_overlaps),
+            obj_overlaps=obj_overlaps,
         )
         if collect is not None:
             collect["candidates"] = cand_audit
@@ -482,12 +532,20 @@ class KvRouter:
         # would waste MB-scale transfer and eat the per-pull block cap
         chain = hashes[local_best:peer_n]
         anchor = hashes[local_best - 1] if local_best > 0 else seed
-        return {
+        hint = {
             "instance": peer[0],
             "path": f"{ns}/{comp}/kv_host_fetch",
             "hashes": chain,
             "parents": [anchor] + chain[:-1],
         }
+        # link class of the pull (both endpoints' slices known): the
+        # worker notes its onboard EWMA under remote_<link> so the
+        # selector's per-class pricing learns real ICI vs DCN costs
+        sel_slice = self._slice_of(selected[0])
+        peer_slice = self._slice_of(peer[0])
+        if sel_slice is not None and peer_slice is not None:
+            hint["link"] = "ici" if sel_slice == peer_slice else "dcn"
+        return hint
 
     # -- predictive prefetch (kvbm/prefetch.py) -----------------------------
     def prefetch_hint(
@@ -564,6 +622,99 @@ class KvRouter:
             # it — hints are an optimization, never worth a retry storm
             self._prefetch_bad.add(instance_id)
             log.debug("kv_prefetch hint to %x failed: %s", instance_id, e)
+
+    # -- fleet-wide prefix economy ------------------------------------------
+    def note_popularity(self, hashes: List[int]) -> Optional[int]:
+        """Bump the request trunk's popularity counter (trunk = first
+        block hash — the stable identity of a shared system prompt / RAG
+        corpus prefix). LRU-capped so one-off prompts age out."""
+        if not hashes:
+            return None
+        trunk = hashes[0]
+        pop = self._trunk_pop
+        pop[trunk] = pop.get(trunk, 0) + 1
+        pop.move_to_end(trunk)
+        if pop[trunk] == self.replicate_hot_threshold:
+            self.prefix_stats["hot_trunks"] += 1
+        while len(pop) > self._trunk_cap:
+            pop.popitem(last=False)
+        return trunk
+
+    def maybe_replicate(
+        self, hashes: List[int], seed: Optional[int],
+        host_overlaps: Optional[Dict[Worker, int]] = None,
+    ) -> None:
+        """Replicate a hot trunk onto ONE slice that holds none of it,
+        via the ordinary prefetch + peer-pull path. Dedup keeps the
+        fleet's G4 copy single; this spends host-tier bytes on an extra
+        slice only once popularity proves the trunk earns them, so
+        repeat traffic stops crossing DCN for a prefix every slice
+        wants. Cooldown-limited per trunk; fire-and-forget like every
+        prefetch hint."""
+        trunk = self.note_popularity(hashes)
+        if trunk is None or not self.prefetch_hints:
+            return
+        if self._trunk_pop.get(trunk, 0) < self.replicate_hot_threshold:
+            return
+        now = time.monotonic()
+        last = self._trunk_replicated.get(trunk)
+        if last is not None and now - last < self.replicate_cooldown_s:
+            return
+        host = (host_overlaps if host_overlaps is not None
+                else self.indexer.host_index.find_matches(hashes).scores)
+        src, src_n = None, 0
+        for w, n in sorted(host.items()):
+            if n > src_n:
+                src, src_n = w, n
+        if src is None:
+            return  # nothing in any G2 to pull from yet
+        # slices that already hold (part of) the trunk, any tier
+        dev = self.indexer.index.find_matches(hashes).scores
+        holder_slices = set()
+        for w, n in list(host.items()) + list(dev.items()):
+            if n > 0:
+                s = self._slice_of(w[0])
+                if s is not None:
+                    holder_slices.add(s)
+        if not holder_slices:
+            return  # no topology metadata: nothing to spread across
+        target = None
+        for w in self.workers():
+            if w[0] == src[0] or w[0] in self._prefetch_bad:
+                continue
+            s = self._slice_of(w[0])
+            if s is None or s in holder_slices:
+                continue
+            inst = self.client.instances.get(w[0])
+            if inst is None or not (inst.metadata or {}).get("kv_prefetch"):
+                continue
+            target = w
+            break
+        if target is None:
+            return  # every slice already holds it (or can't prefetch)
+        self._trunk_replicated[trunk] = now
+        if len(self._trunk_replicated) > self._trunk_cap:
+            for k in sorted(self._trunk_replicated,
+                            key=self._trunk_replicated.get)[
+                                :len(self._trunk_replicated)
+                                - self._trunk_cap]:
+                self._trunk_replicated.pop(k, None)
+        self.prefix_stats["replications"] += 1
+        chain = hashes[:src_n]
+        ns, comp = self.client.path.split("/")[:2]
+        remote: Dict[str, Any] = {
+            "instance": src[0],
+            "path": f"{ns}/{comp}/kv_host_fetch",
+            "hashes": chain,
+            "parents": [seed] + chain[:-1],
+        }
+        t_slice, s_slice = self._slice_of(target[0]), self._slice_of(src[0])
+        if t_slice is not None and s_slice is not None:
+            remote["link"] = "ici" if t_slice == s_slice else "dcn"
+        self.emit_prefetch(target[0], {
+            "hashes": chain, "parents": [seed] + chain[:-1],
+            "remote": remote,
+        })
 
     # -- lifecycle charging -------------------------------------------------
     def add_request(
@@ -649,22 +800,29 @@ class KvPushRouter:
         )
         from dynamo_tpu.tokens.hashing import request_seed
 
+        seed = request_seed(request.get("adapter"), mm_seed)
         hint = self.router.remote_host_hint(
-            hashes, worker, overlap,
-            request_seed(request.get("adapter"), mm_seed),
+            hashes, worker, overlap, seed,
             host_overlaps=collect.get("host_overlaps"),
         )
         if hint is not None:
             request = dict(request)
             request["kv_remote_host"] = hint
         pf = self.router.prefetch_hint(
-            hashes, worker, overlap,
-            request_seed(request.get("adapter"), mm_seed),
+            hashes, worker, overlap, seed,
             host_overlaps=collect.get("host_overlaps"),
             remote=hint,
         )
         if pf is not None:
             self.router.emit_prefetch(worker[0], pf)
+        # prefix economy: count the trunk; replicate it onto a cold
+        # slice once it proves hot (fire-and-forget, never on the
+        # request's critical path)
+        try:
+            self.router.maybe_replicate(
+                hashes, seed, host_overlaps=collect.get("host_overlaps"))
+        except Exception:
+            log.debug("hot-trunk replication failed", exc_info=True)
         rid = context.id
         self.router.add_request(rid, worker, hashes, overlap)
         context.metadata["kv_overlap_blocks"] = overlap

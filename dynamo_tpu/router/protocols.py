@@ -35,7 +35,7 @@ class RouterEvent:
     kind: str  # "store" | "remove" | "clear"
     block_hashes: List[int] = field(default_factory=list)
     parent_hash: Optional[int] = None  # lineage anchor of block_hashes[0]
-    tier: str = "device"  # "device" (G1) | "host" (G2) — overlap credit tier
+    tier: str = "device"  # "device" (G1) | "host" (G2) | "obj" (G4 shared)
 
     def to_wire(self) -> Dict[str, Any]:
         return {

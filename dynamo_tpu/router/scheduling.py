@@ -40,6 +40,17 @@ class KvRouterConfig:
     # costs more than local host DRAM
     remote_credit: float = 0.3
     disk_credit: float = 0.3
+    # link-class priors for the peer-pull leg: a same-slice ICI pull is
+    # near host-tier speed; a cross-slice DCN pull is far dearer. Used
+    # when the candidate's link class to the holding peer is known but no
+    # per-class EWMA has been measured yet ("remote" stays the flat
+    # unknown-link prior). Measured keys: remote_ici / remote_dcn.
+    remote_ici_credit: float = 0.45
+    remote_dcn_credit: float = 0.15
+    # G4 shared-object-tier credit: any worker can rehydrate a block the
+    # fleet's object store holds; slower than a peer-G2 pull over ICI,
+    # comparable to DCN (object stores sit behind the slice fabric)
+    obj_credit: float = 0.15
     # topology-aware placement: measured recompute cost of one block of
     # prefill (page_size x per-token time; default matches the mocker's
     # 16 tok x 40us). When select() is given measured per-(worker, tier)
@@ -57,6 +68,13 @@ class KvRouterConfig:
         denom = max(1e-9, self.recompute_block_s)
         return max(0.0, 1.0 - min(1.0, float(s_per_block) / denom))
 
+    def prior_seconds(self, credit: float) -> float:
+        """Inverse of credit_fraction: the per-block seconds a constant
+        prior credit implies. Lets the selector mix a measured leg with a
+        prior leg in ONE unit (seconds) — credit_fraction(prior_seconds(c))
+        == c, so an all-prior path reproduces the constant exactly."""
+        return (1.0 - min(1.0, max(0.0, credit))) * self.recompute_block_s
+
 
 class WorkerSelector:
     def __init__(self, config: Optional[KvRouterConfig] = None):
@@ -72,6 +90,8 @@ class WorkerSelector:
         host_overlaps: Optional[Dict[Worker, int]] = None,
         audit: Optional[List[dict]] = None,
         tier_costs: Optional[Dict[Worker, Dict[str, float]]] = None,
+        link_class: Optional[Dict[Worker, str]] = None,
+        obj_overlaps: Optional[Dict[Worker, int]] = None,
     ) -> Tuple[Worker, int]:
         """Returns (worker, device_overlap_blocks). Raises if no workers.
 
@@ -80,36 +100,78 @@ class WorkerSelector:
 
         `tier_costs` is the topology-aware input: per-(worker, tier)
         measured onboard seconds/block (FleetObserver.onboard_costs —
-        phase-spine kv_onboard_s EWMAs off the fleet digests). A worker's
-        host credit becomes credit_fraction(host_s); the cross-worker
-        pull leg prices the network fetch PLUS the candidate's own
-        host->device onboard. Missing measurements fall back to the
-        config's constant priors, and the audit records which source
-        priced each leg."""
+        phase-spine kv_onboard_s EWMAs off the fleet digests), including
+        per-link-class peer-pull legs (remote_ici / remote_dcn) and the
+        G4 rehydration leg (obj). A worker's host credit becomes
+        credit_fraction(host_s); the cross-worker pull leg prices the
+        network fetch PLUS the candidate's own host->device onboard.
+        Missing measurements fall back PER LEG to the config's constant
+        priors (converted to seconds via prior_seconds so a measured leg
+        still counts when its partner is cold), and the audit records
+        which source priced each leg.
+
+        `link_class` maps each candidate to the link class ("ici"/"dcn")
+        of its peer-pull path to the best holding peer; None/missing =
+        unknown topology → the flat "remote" pricing (PR 9 behavior).
+
+        `obj_overlaps` is per-worker G4 residency. The object store is
+        SHARED, so the fleet-wide max credits every candidate — a block
+        any worker demoted to G4 is one rehydration away from all of
+        them."""
         if not workers:
             raise RuntimeError("no workers available for KV routing")
         cfg = self.config
         costs: List[float] = []
         cluster_host = max((host_overlaps or {}).values(), default=0)
+        cluster_obj = max((obj_overlaps or {}).values(), default=0)
         for w in workers:
             dev = overlaps.scores.get(w, 0)
             host = (host_overlaps or {}).get(w, 0)
             tc = (tier_costs or {}).get(w) or {}
-            if "host" in tc:
-                host_w, host_src = cfg.credit_fraction(tc["host"]), "measured"
+            link = (link_class or {}).get(w)
+            host_meas = "host" in tc
+            # one unit (seconds/block) for every leg: measured EWMAs as-is,
+            # cold legs at their prior credit's implied seconds — so a
+            # worker reporting only ONE of host/remote still gets its
+            # measurement priced instead of dropping to the flat prior
+            host_s = (tc["host"] if host_meas
+                      else cfg.prior_seconds(cfg.host_credit))
+            host_w = (cfg.credit_fraction(host_s) if host_meas
+                      else cfg.host_credit)
+            host_src = "measured" if host_meas else "prior"
+            r_key = None
+            if link is not None and f"remote_{link}" in tc:
+                r_key = f"remote_{link}"  # per-link-class EWMA
+            elif "remote" in tc:
+                r_key = "remote"  # flat measured fetch leg
+            if r_key is not None:
+                remote_leg_s, remote_src = tc[r_key], "measured"
             else:
-                host_w, host_src = cfg.host_credit, "prior"
-            if "remote" in tc and "host" in tc:
-                # the full peer-pull path: network fetch leg + this
-                # candidate's own host->device import of the pulled blocks
-                remote_w = cfg.credit_fraction(tc["remote"] + tc["host"])
-                remote_src = "measured"
+                prior_c = {"ici": cfg.remote_ici_credit,
+                           "dcn": cfg.remote_dcn_credit}.get(
+                               link, cfg.remote_credit)
+                # the prior is for the FULL pull path (fetch + host
+                # import); subtract the host leg so it isn't paid twice
+                remote_leg_s = max(0.0, cfg.prior_seconds(prior_c)
+                                   - cfg.prior_seconds(cfg.host_credit))
+                remote_src = "prior"
+            # the full peer-pull path: network fetch leg + this
+            # candidate's own host->device import of the pulled blocks
+            remote_w = cfg.credit_fraction(remote_leg_s + host_s)
+            if "obj" in tc:
+                # G4 rehydration lands in G2 first, then imports
+                obj_w, obj_src = cfg.credit_fraction(tc["obj"] + host_s), \
+                    "measured"
             else:
-                remote_w, remote_src = cfg.remote_credit, "prior"
+                obj_w, obj_src = cfg.obj_credit, "prior"
             credit = cfg.device_credit * dev + host_w * max(0, host - dev)
             # cluster-wide lower-tier residency: blocks any peer holds can
             # be onboarded cross-worker, so they discount every candidate
             credit += remote_w * max(0, cluster_host - max(dev, host))
+            # shared G4 tier: blocks beyond every G1/G2/peer run are still
+            # one object-store rehydration away for any candidate
+            credit += obj_w * max(0, cluster_obj - max(dev, host,
+                                                       cluster_host))
             new_blocks = max(0.0, total_blocks - cfg.overlap_weight * credit)
             prefill = new_blocks + sequences.prefill_blocks(w)
             decode = sequences.decode_blocks(w)
@@ -119,10 +181,14 @@ class WorkerSelector:
                     "worker": list(w),
                     "overlap_blocks": dev,
                     "host_overlap_blocks": host,
+                    "obj_overlap_blocks": (obj_overlaps or {}).get(w, 0),
+                    "link_class": link,
                     "credit": round(credit, 3),
                     "host_credit_w": round(host_w, 3),
                     "remote_credit_w": round(remote_w, 3),
-                    "credit_src": {"host": host_src, "remote": remote_src},
+                    "obj_credit_w": round(obj_w, 3),
+                    "credit_src": {"host": host_src, "remote": remote_src,
+                                   "obj": obj_src},
                     "new_blocks": round(new_blocks, 3),
                     "prefill_blocks": round(prefill, 3),
                     "decode_blocks": round(decode, 3),

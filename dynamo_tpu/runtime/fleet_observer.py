@@ -241,12 +241,23 @@ class DigestBuilder:
                             "stored_bytes": int(st.get("stored_bytes", 0)),
                             "quant_blocks": int(st.get("quant_blocks", 0)),
                         }
+                        if "dedup_hits" in st:
+                            # G4 prefix economy: fleet-shared store, so
+                            # dedup hits are bytes the fleet did NOT
+                            # store twice (dynamo_top's dedup ratio)
+                            tiers[name]["dedup_hits"] = int(
+                                st.get("dedup_hits", 0))
+                            tiers[name]["dedup_bytes_saved"] = int(
+                                st.get("dedup_bytes_saved", 0))
                 except Exception:
                     log.debug("host pool size probe failed", exc_info=True)
             digest["kv"] = {
                 "g1_usage": digest["queue"].get("kv_usage", 0.0),
                 "g2_blocks": g2, "g3_blocks": g3,
             }
+            kv_slice = getattr(engine, "slice_id", None)
+            if kv_slice is not None:
+                digest["kv"]["slice"] = str(kv_slice)
             if tiers:
                 digest["kv"]["tiers"] = tiers
             ewma = getattr(engine, "kv_onboard_ewma", None)

@@ -179,6 +179,12 @@ async def serve_worker(
         metadata["http_address"] = http_address
     if disagg_role:
         metadata["disagg_role"] = disagg_role
+    # topology label for link-class routing: same kv_slice = ICI island,
+    # different = DCN hop (engine slice_id wins; env for bare deploys)
+    kv_slice = getattr(engine, "slice_id", None) \
+        or _os.environ.get("DYN_KV_SLICE")
+    if kv_slice:
+        metadata["kv_slice"] = str(kv_slice)
     if device_weight is None:
         mesh = getattr(getattr(engine, "runner", None), "mesh_config", None)
         if mesh is not None:

@@ -65,6 +65,7 @@ def main() -> int:
         "test_kv_tiers.py", "test_session_tree.py", "test_guided.py",
         "test_fleet_sim.py", "test_chaos.py", "test_sanitizer.py",
         "test_dynmc.py", "test_planner_actuator.py",
+        "test_kv_fabric.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -143,7 +143,8 @@ def render(view: dict, planner: dict = None) -> list:
         lines.append(_planner_line(planner))
     sess_by_inst = sess.get("by_instance") or {}
     lines.append("")
-    hdr = (f"{'WORKER':<14} {'RUN':>4} {'WAIT':>4} {'KV%':>5} {'G2':>6} "
+    hdr = (f"{'WORKER':<14} {'LINK':>5} {'RUN':>4} {'WAIT':>4} {'KV%':>5} "
+           f"{'G2':>6} "
            f"{'G3':>6} {'G2MB':>7} {'G3MB':>7} {'QNT%':>5} {'REQ':>6} "
            f"{'SESS':>5} {'TREE%':>6} {'ACT':>10} "
            f"{'TTFT99':>8} {'ITL50':>7} {'E2E95':>8} "
@@ -167,7 +168,8 @@ def render(view: dict, planner: dict = None) -> list:
         # sessions table keys by bare instance id; wkey is "{iid:x}.{dp}"
         n_sess = sess_by_inst.get(wkey.split(".")[0], 0) if sess else "-"
         lines.append(
-            f"{wkey:<14} {q.get('n_running', 0):>4} {q.get('n_waiting', 0):>4} "
+            f"{wkey:<14} {kv.get('slice', '-') or '-':>5} "
+            f"{q.get('n_running', 0):>4} {q.get('n_waiting', 0):>4} "
             f"{(100.0 * kv_usage if kv_usage is not None else 0):>5.1f} "
             f"{kv.get('g2_blocks', 0) or 0:>6} {kv.get('g3_blocks', 0) or 0:>6} "
             f"{g2_mb:>7} {g3_mb:>7} {quant_pct:>5} "
@@ -177,11 +179,23 @@ def render(view: dict, planner: dict = None) -> list:
             f"{_ms(phases, 'e2e', 'p95_s'):>8} {pf_pct:>6} "
             f"{_worker_slo(view, wkey):>6}"
         )
+    # fleet-wide prefix-economy line: dedup ratio from the shared G4
+    # tier's counters (bytes the fleet did NOT store twice vs stored)
+    stored = saved = 0
+    for r in (view.get("workers") or {}).values():
+        obj = ((r.get("kv") or {}).get("tiers") or {}).get("obj") or {}
+        stored += obj.get("stored_bytes", 0) or 0
+        saved += obj.get("dedup_bytes_saved", 0) or 0
+    if stored or saved:
+        ratio = (stored + saved) / stored if stored else float("inf")
+        lines.append(
+            f"  kv fabric: G4 {_mb(stored)}MB stored, {_mb(saved)}MB "
+            f"deduped (ratio {ratio:.2f}x)")
     fleet_phases = ((view.get("fleet") or {}).get("phases")) or {}
     if fleet_phases:
         lines.append("")
         lines.append(
-            f"{'fleet':<14} {'':>4} {'':>4} {'':>5} {'':>6} {'':>6} "
+            f"{'fleet':<14} {'':>5} {'':>4} {'':>4} {'':>5} {'':>6} {'':>6} "
             f"{'':>7} {'':>7} {'':>5} "
             f"{sum((r.get('counters') or {}).get('requests', 0) for r in (view.get('workers') or {}).values()):>6} "
             f"{'':>5} {'':>6} {'':>10} "

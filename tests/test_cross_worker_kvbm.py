@@ -139,7 +139,9 @@ def _fake_router(host_events):
         host_index.apply_event(ev)
     return SimpleNamespace(
         indexer=SimpleNamespace(host_index=host_index),
-        client=SimpleNamespace(path="ns/comp/generate"),
+        client=SimpleNamespace(path="ns/comp/generate", instances={}),
+        # no discovery metadata -> topology unknown -> flat link pricing
+        _slice_of=lambda iid: None,
     )
 
 

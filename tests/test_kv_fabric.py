@@ -1,0 +1,532 @@
+"""Cross-slice KV fabric tests (tier-1).
+
+Covers the three coupled pieces of the fleet-wide KV fabric:
+
+- link-class cost model: per-leg prior/measured mixing in the selector
+  (a worker reporting only one of host/remote still gets its measurement
+  priced), per-link-class EWMAs + link classes steering spill onto the
+  holder's ICI siblings instead of cross-slice DCN, and the class priors
+  reproducing the config constants exactly on all-prior paths;
+- G4 as a live shared tier: content-hash dedup across pools sharing one
+  backend (store once fleet-wide, peer-pull byte-identical), G3 byte
+  pressure spilling dense AND int8+scales blocks into the object store
+  intact, quarantine parity with G3 (truncated/corrupt/missing-scale
+  objects are misses, never exceptions; stale-layout objects are ignored
+  WITHOUT poisoning the G3 copy), tier="obj" residency events reaching
+  the router's G4 index, and prefetch promotion out of G4 counted under
+  bytes_promoted_g4;
+- fleet-wide prefix economy: popularity counters marking hot trunks and
+  cooldown-gated replication targeting a cold slice via the ordinary
+  prefetch + peer-pull path; FleetSim multi-slice topology smoke and the
+  chaos posture — a partitioned slice degrades cross-slice pulls to
+  local rehydration with zero hung streams.
+"""
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.kvbm.disk_pool import (
+    BLOCK_LAYOUT_VERSION,
+    DiskKvPool,
+    TieredKv,
+    encode_block,
+)
+from dynamo_tpu.kvbm.host_pool import HostKvPool
+from dynamo_tpu.kvbm.object_store import FsBackend, ObjectKvPool
+from dynamo_tpu.kvbm.quant import is_quantized_block, quantize_block
+from dynamo_tpu.router.protocols import OverlapScores, RouterEvent
+from dynamo_tpu.router.scheduling import KvRouterConfig, WorkerSelector
+from dynamo_tpu.router.sequences import ActiveSequences
+
+
+def _block(seed: int, L=2, PS=4, Hk=2, D=8):
+    r = np.random.default_rng(seed)
+    k = r.standard_normal((L, PS, Hk, D)).astype(np.float16)
+    v = r.standard_normal((L, PS, Hk, D)).astype(np.float16)
+    return k, v
+
+
+# -- selector: per-leg prior/measured mixing ----------------------------
+
+
+def test_partial_tier_costs_host_without_remote():
+    """A worker that measured ONLY its host leg: the peer-pull path must
+    price measured-host + prior-remote, not collapse to the flat prior."""
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    rec = cfg.recompute_block_s
+    workers = [(0, 0), (1, 0)]
+    audit = []
+    sel.select(workers, 16, OverlapScores(scores={}), ActiveSequences(),
+               host_overlaps={(0, 0): 16}, audit=audit,
+               tier_costs={(1, 0): {"host": 0.1 * rec}})
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    w1 = by_worker[(1, 0)]
+    assert w1["credit_src"] == {"host": "measured", "remote": "prior",
+                                "obj": "prior"}
+    # prior fetch leg = prior_seconds(remote) - prior_seconds(host), then
+    # the candidate's MEASURED host import is added back
+    leg = cfg.prior_seconds(cfg.remote_credit) - cfg.prior_seconds(
+        cfg.host_credit)
+    assert w1["remote_credit_w"] == pytest.approx(
+        cfg.credit_fraction(leg + 0.1 * rec))
+    assert w1["host_credit_w"] == pytest.approx(
+        cfg.credit_fraction(0.1 * rec))
+
+
+def test_partial_tier_costs_remote_without_host():
+    """The other mix: a measured fetch leg combines with the prior host
+    import instead of being dropped."""
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    rec = cfg.recompute_block_s
+    workers = [(0, 0), (1, 0)]
+    audit = []
+    sel.select(workers, 16, OverlapScores(scores={}), ActiveSequences(),
+               host_overlaps={(0, 0): 16}, audit=audit,
+               tier_costs={(1, 0): {"remote": 0.1 * rec}})
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    w1 = by_worker[(1, 0)]
+    assert w1["credit_src"] == {"host": "prior", "remote": "measured",
+                                "obj": "prior"}
+    assert w1["host_credit_w"] == cfg.host_credit
+    assert w1["remote_credit_w"] == pytest.approx(cfg.credit_fraction(
+        0.1 * rec + cfg.prior_seconds(cfg.host_credit)))
+
+
+def test_all_prior_path_reproduces_config_constants():
+    """Legacy parity: with no measurements at all, per-leg mixing must
+    collapse exactly to the constant-credit behavior (PR 9)."""
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    audit = []
+    sel.select([(0, 0), (1, 0)], 8, OverlapScores(scores={}),
+               ActiveSequences(), host_overlaps={(0, 0): 8}, audit=audit)
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    assert by_worker[(1, 0)]["remote_credit_w"] == pytest.approx(
+        cfg.remote_credit)
+    assert by_worker[(0, 0)]["host_credit_w"] == pytest.approx(
+        cfg.host_credit)
+
+
+# -- selector: link classes ---------------------------------------------
+
+
+def test_link_class_steers_spill_to_ici_sibling():
+    """The tentpole placement behavior: with the holder loaded, per-class
+    EWMAs send the spill to the holder's ICI sibling; the flat model
+    prices both peers identically and its tie-break lands cross-slice."""
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    rec = cfg.recompute_block_s
+    holder, dcn_peer, ici_peer = (0, 0), (1, 0), (2, 0)
+    workers = [holder, dcn_peer, ici_peer]
+    seqs = ActiveSequences()
+    seqs.add_request("r0", holder, 64, 0)  # holder is busy
+    host_overlaps = {holder: 8}
+
+    link_costs = {w: {"host": 0.1 * rec, "remote_ici": 0.2 * rec,
+                      "remote_dcn": 4.0 * rec} for w in workers}
+    w, _ = sel.select(workers, 8, OverlapScores(scores={}), seqs,
+                      host_overlaps=host_overlaps, tier_costs=link_costs,
+                      link_class={dcn_peer: "dcn", ici_peer: "ici"})
+    assert w == ici_peer, "per-class pricing must prefer the ICI sibling"
+
+    flat_costs = {w: {"host": 0.1 * rec, "remote": 2.1 * rec}
+                  for w in workers}
+    w, _ = sel.select(workers, 8, OverlapScores(scores={}), seqs,
+                      host_overlaps=host_overlaps, tier_costs=flat_costs)
+    assert w == dcn_peer, \
+        "flat pricing cannot tell the peers apart; tie-break goes DCN"
+
+
+def test_link_class_priors_used_when_class_known_but_unmeasured():
+    """Link class known, no per-class EWMA yet: the class PRIOR prices
+    the leg, and an all-prior path reproduces the constant exactly."""
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    workers = [(0, 0), (1, 0), (2, 0)]
+    audit = []
+    sel.select(workers, 8, OverlapScores(scores={}), ActiveSequences(),
+               host_overlaps={(0, 0): 8}, audit=audit,
+               link_class={(1, 0): "ici", (2, 0): "dcn"})
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    assert by_worker[(1, 0)]["link_class"] == "ici"
+    assert by_worker[(1, 0)]["remote_credit_w"] == pytest.approx(
+        cfg.remote_ici_credit)
+    assert by_worker[(2, 0)]["remote_credit_w"] == pytest.approx(
+        cfg.remote_dcn_credit)
+    assert by_worker[(1, 0)]["credit_src"]["remote"] == "prior"
+
+
+def test_obj_overlaps_credit_every_candidate():
+    """The G4 store is shared: the cluster-max obj residency discounts
+    every candidate, not just the worker that demoted the blocks."""
+    cfg = KvRouterConfig()
+    sel = WorkerSelector(cfg)
+    rec = cfg.recompute_block_s
+    workers = [(0, 0), (1, 0)]
+    audit = []
+    sel.select(workers, 10, OverlapScores(scores={}), ActiveSequences(),
+               obj_overlaps={(0, 0): 6}, audit=audit)
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    for w in workers:
+        assert by_worker[w]["new_blocks"] == pytest.approx(
+            10 - cfg.obj_credit * 6)
+        assert by_worker[w]["credit_src"]["obj"] == "prior"
+    # measured G4 rehydration EWMA replaces the prior (obj leg + host leg)
+    audit = []
+    sel.select(workers, 10, OverlapScores(scores={}), ActiveSequences(),
+               obj_overlaps={(0, 0): 6}, audit=audit,
+               tier_costs={(1, 0): {"obj": 0.2 * rec, "host": 0.1 * rec}})
+    by_worker = {tuple(e["worker"]): e for e in audit}
+    assert by_worker[(1, 0)]["obj_credit_w"] == pytest.approx(
+        cfg.credit_fraction(0.3 * rec))
+    assert by_worker[(1, 0)]["credit_src"]["obj"] == "measured"
+
+
+# -- G4: fleet-wide dedup + peer pull -----------------------------------
+
+
+def test_g4_dedup_two_pools_one_backend_peer_pull_identical(tmp_path):
+    """Two workers' pools over ONE shared backend: the second demotion of
+    identical content adopts the existing object (no second upload), and
+    a peer-pull of the block is byte-identical to what was stored."""
+    root = str(tmp_path)
+    pool_a = ObjectKvPool(FsBackend(root))
+    pool_b = ObjectKvPool(FsBackend(root))
+    stored = []
+    pool_b.store_listener = lambda h, p: stored.append((h, p))
+    k, v = _block(7)
+    h = 0xA1B2
+    pool_a.put_block(h, None, k, v)
+    pool_a.flush()
+    assert pool_a.stats["stored_bytes"] == k.nbytes + v.nbytes
+
+    pool_b.put_block(h, None, k, v)
+    pool_b.flush()
+    assert pool_b.stats["dedup_hits"] == 1
+    assert pool_b.stats["dedup_bytes_saved"] == k.nbytes + v.nbytes
+    assert pool_b.stats["stored_bytes"] == 0, "adopted, not re-uploaded"
+    assert stored == [(h, None)], "local index insert still fires events"
+    assert len([f for f in os.listdir(root) if f.endswith(".kvb")]) == 1
+
+    k2, v2 = pool_b.get_block(h)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+    # a fresh pool (worker joining later) adopts the shared store
+    pool_c = ObjectKvPool(FsBackend(root))
+    assert h in pool_c
+    k3, v3 = pool_c.get_block(h)
+    np.testing.assert_array_equal(k, k3)
+
+
+# -- G4: quarantine parity with G3 --------------------------------------
+
+
+@pytest.mark.parametrize("garble", ["truncate", "header", "scale"])
+def test_g4_quarantine_is_miss_and_ignore(tmp_path, garble):
+    """Truncated payloads, garbage headers, and quantized objects with a
+    missing scale segment: all read as (None, None) — never an exception
+    — and the local index entry drops so the hash stops matching. The
+    object itself stays (shared-store GC is the operator's policy)."""
+    root = str(tmp_path)
+    pool = ObjectKvPool(FsBackend(root))
+    if garble == "scale":
+        k, v = _block(11)
+        k, v = quantize_block(k), quantize_block(v)
+    else:
+        k, v = _block(11)
+    h = 0xBEEF
+    pool.put_block(h, None, k, v)
+    pool.flush()
+    path = os.path.join(root, f"{h:016x}.kvb")
+    data = open(path, "rb").read()
+    if garble == "truncate":
+        open(path, "wb").write(data[: len(data) // 3])
+    elif garble == "header":
+        open(path, "wb").write(struct.pack("<Q", 16) + b"not json here!!!"
+                               + data[24:])
+    else:  # chop the trailing scale segment off the quantized payload
+        open(path, "wb").write(data[:-8])
+    # force a backend read (drop the pending-write cache path)
+    pool2 = ObjectKvPool(FsBackend(root))
+    assert h in pool2
+    assert pool2.get_block(h) == (None, None)
+    assert h not in pool2, "quarantined hash must stop matching"
+    assert os.path.exists(path), "shared object is never deleted"
+
+
+def test_g4_stale_layout_ignored_without_poisoning_g3(tmp_path):
+    """An object written under another pool layout is a data miss but
+    KEEPS its index entry (peers on the other layout still use it) — and
+    a same-hash G3 copy keeps serving: residency prefers disk and the
+    bytes come back intact."""
+    g3_root = str(tmp_path / "g3")
+    g4_root = str(tmp_path / "g4")
+    os.makedirs(g4_root)
+    k, v = _block(23)
+    h = 0xCAFE
+    # G4 object under a stale layout version
+    data = encode_block(None, k, v)
+    (hlen,) = struct.unpack("<Q", data[:8])
+    import json as _json
+
+    header = _json.loads(data[8:8 + hlen])
+    header["layout"] = BLOCK_LAYOUT_VERSION - 1
+    raw = _json.dumps(header).encode()
+    stale = struct.pack("<Q", len(raw)) + raw + data[8 + hlen:]
+    open(os.path.join(g4_root, f"{h:016x}.kvb"), "wb").write(stale)
+
+    host = HostKvPool(capacity_blocks=4)
+    disk = DiskKvPool(g3_root, capacity_blocks=16)
+    obj = ObjectKvPool(FsBackend(g4_root))
+    tiered = TieredKv(host, disk, obj)
+    disk.put_block(h, None, k, v)
+    disk.flush()
+
+    assert obj.get_block(h) == (None, None)
+    assert h in obj, "stale-layout entry stays indexed (not quarantined)"
+    assert tiered.residency([h]) == ["disk"], "G3 copy is untouched"
+    k2, v2 = disk.get_block(h)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+
+
+# -- G3 -> G4 byte-pressure demotion ------------------------------------
+
+
+def test_disk_byte_pressure_spills_dense_and_quant_to_g4(tmp_path):
+    """DiskKvPool with a byte budget chained to an ObjectKvPool: crossing
+    the budget demotes LRU blocks into the object store with their
+    payloads intact — dense stays dense, int8+scales stays quantized."""
+    g3_root = str(tmp_path / "g3")
+    g4_root = str(tmp_path / "g4")
+    os.makedirs(g4_root)
+    k, v = _block(31)
+    pair_bytes = k.nbytes + v.nbytes
+    host = HostKvPool(capacity_blocks=4)
+    disk = DiskKvPool(g3_root, capacity_blocks=64,
+                      capacity_bytes=int(2.5 * pair_bytes))
+    obj = ObjectKvPool(FsBackend(g4_root))
+    TieredKv(host, disk, obj)  # wires disk.spill_hook = obj.put_block
+
+    blocks = {}
+    for i, h in enumerate([0x10, 0x11, 0x12]):
+        kk, vv = _block(100 + i)
+        blocks[h] = (kk, vv)
+        disk.put_block(h, None, kk, vv)
+    kk, vv = _block(200)
+    kq, vq = quantize_block(kk), quantize_block(vv)
+    blocks[0x13] = (kq, vq)
+    disk.put_block(0x13, None, kq, vq)  # 4th block: over budget
+    disk.flush()
+    obj.flush()
+
+    spilled = [h for h in blocks if h not in disk]
+    assert spilled, "byte pressure never demoted anything"
+    assert all(h in obj for h in spilled)
+    assert disk.stats["stored_bytes"] <= disk.capacity_bytes
+    for h in spilled:
+        want_k, want_v = blocks[h]
+        got_k, got_v = obj.get_block(h)
+        if is_quantized_block(want_k):
+            assert is_quantized_block(got_k), "int8+scales must survive"
+            np.testing.assert_array_equal(want_k["q"], got_k["q"])
+            np.testing.assert_array_equal(want_k["s"], got_k["s"])
+            np.testing.assert_array_equal(want_v["q"], got_v["q"])
+        else:
+            np.testing.assert_array_equal(want_k, got_k)
+            np.testing.assert_array_equal(want_v, got_v)
+
+
+# -- engine: tier="obj" events + G4 prefetch promotion ------------------
+
+
+def _sim_engine(tmp, **kw):
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.mocker.sim import SimRunner, SimTiming
+
+    runner = SimRunner(num_pages=32, page_size=4, max_pages_per_seq=8,
+                       timing=SimTiming(speed=0))
+    return InferenceEngine(runner, max_batch=2, chunk_size=64,
+                           host_kv_blocks=8, obj_kv_root=tmp, **kw)
+
+
+def test_engine_emits_tier_obj_events_on_g4_store(tmp_path):
+    """A block landing in G4 (store listener, possibly on the writer /
+    spill thread) surfaces as a tier="obj" KV event so the router's G4
+    index learns the shared residency."""
+    eng = _sim_engine(str(tmp_path))
+    obj = eng.host_pool.obj
+    assert obj is not None
+    obj.put_block(0x77, None, None, None)  # hash-only (sim) store
+    eng._drain_inbox()
+    evs = [e for e in eng._host_events if e.tier == "obj"]
+    assert len(evs) == 1
+    assert evs[0].kind == "store" and evs[0].block_hashes == [0x77]
+
+
+def test_prefetch_promotes_from_g4_and_counts_bytes(tmp_path):
+    """G4-only residency served by the prefetch path: the hint promotes
+    the blocks through the object store's writer thread into G2 and the
+    hop lands in bytes_promoted_g4 (the acceptance counter)."""
+    eng = _sim_engine(str(tmp_path), prefetch=True)
+    pf = eng.prefetch
+    assert pf is not None
+    obj = eng.host_pool.obj
+    obj.put_block(0x101, None, None, None)
+    obj.put_block(0x102, 0x101, None, None)
+    eng._drain_inbox()  # consume the obj_event noise first
+    pf.on_hint({"hashes": [0x101, 0x102], "parents": [None, 0x101]})
+    # the async G4 reads ride the writer thread; wait for the results to
+    # land in the engine inbox, then run the step-thread side
+    import time
+
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        eng._drain_inbox()
+        if pf.stats["bytes_promoted_g4"] > 0 and 0x102 in eng.host_pool:
+            break
+        time.sleep(0.01)
+    assert pf.stats["bytes_promoted_g4"] > 0
+    assert 0x101 in eng.host_pool and 0x102 in eng.host_pool
+
+
+# -- router: fleet-wide prefix economy ----------------------------------
+
+
+def _mem_router(**kw):
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.runtime.discovery import MemDiscovery
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = DistributedRuntime(discovery=MemDiscovery(realm="kv-fabric"),
+                            event_transport="inproc")
+    client = rt.client("dyn/w/generate")
+    return KvRouter(rt, client, block_size=4, use_kv_events=False, **kw)
+
+
+def test_note_popularity_marks_hot_trunks_and_ages_out():
+    router = _mem_router()
+    for _ in range(router.replicate_hot_threshold):
+        router.note_popularity([42, 43])
+    assert router.prefix_stats["hot_trunks"] == 1
+    router.note_popularity([42, 43])
+    assert router.prefix_stats["hot_trunks"] == 1, "counted once per trunk"
+    # LRU cap: one-off prompts age out instead of growing forever
+    router._trunk_cap = 2
+    router.note_popularity([1])
+    router.note_popularity([2])
+    assert 42 not in router._trunk_pop
+    assert len(router._trunk_pop) == 2
+
+
+async def test_maybe_replicate_targets_cold_slice_once():
+    """A trunk crossing the popularity threshold replicates ONCE onto a
+    prefetch-capable worker of a slice holding none of it, via a
+    prefetch hint whose remote leg names the G2 source; the cooldown
+    stops repeat replication."""
+    from dynamo_tpu.runtime.component import Instance
+
+    router = _mem_router()
+    for iid, sl in ((1, "s0"), (2, "s0"), (3, "s1")):
+        router.client.instances[iid] = Instance(
+            namespace="dyn", component="w", endpoint="generate",
+            instance_id=iid,
+            metadata={"dp_size": 1, "kv_slice": sl, "kv_prefetch": True})
+    hashes = [0x500, 0x501, 0x502]
+    ev = RouterEvent(worker=(1, 0), event_id=1, kind="store",
+                     block_hashes=hashes, parent_hash=None, tier="host")
+    router.indexer.host_index.apply_event(ev, ttl=router.indexer.ttl)
+    emitted = []
+    router.emit_prefetch = lambda iid, hint: emitted.append((iid, hint))
+
+    for _ in range(router.replicate_hot_threshold + 3):
+        router.maybe_replicate(hashes, seed=None)
+    assert router.prefix_stats["replications"] == 1, "cooldown-gated"
+    assert len(emitted) == 1
+    target, hint = emitted[0]
+    assert target == 3, "only the cold slice's worker qualifies"
+    assert hint["hashes"] == hashes
+    remote = hint["remote"]
+    assert remote["instance"] == 1, "pull from the best G2 holder"
+    assert remote["link"] == "dcn", "replication crosses slices once"
+
+
+def test_indexer_routes_obj_tier_events():
+    router = _mem_router()
+    idx = router.indexer
+    idx._apply(RouterEvent(worker=(9, 0), event_id=1, kind="store",
+                           block_hashes=[0x900], tier="obj"))
+    assert idx.obj_index.find_matches([0x900]).scores == {(9, 0): 1}
+    assert idx.index.find_matches([0x900]).scores == {}
+    idx.remove_worker((9, 0))
+    assert idx.obj_index.find_matches([0x900]).scores == {}
+
+
+# -- FleetSim: multi-slice topology + chaos posture ---------------------
+
+
+async def test_fleet_sim_multi_slice_smoke_and_fabric_report():
+    """Declarative multi-slice FleetSim: slice labels reach the workers'
+    discovery metadata, the shared G4 root auto-provisions, and run()
+    reports the kv_fabric block."""
+    from dynamo_tpu.mocker.fleet import FleetSim
+
+    base = tempfile.mkdtemp(prefix="fleet_fabric_")
+    sim = FleetSim(n_workers=2, router_mode="kv", seed=5, speed=0.0,
+                   idle_sleep_s=0.01, num_pages=16, page_size=16,
+                   host_kv_blocks=8, disk_kv_blocks=32, disk_kv_base=base,
+                   slices=2, dcn_delay_s=0.001)
+    await sim.start()
+    try:
+        metas = [w.served.instance.metadata for w in sim.workers]
+        assert [m.get("kv_slice") for m in metas] == ["s0", "s1"]
+        for w in sim.workers:
+            assert w.engine.host_pool.obj is not None, "shared G4 missing"
+        report = await sim.run(scenarios=("json",), n_sessions=4, rps=20.0)
+        g = report["goodput"]
+        assert g["n_ok"] == g["n_requests"]
+        fabric = report["kv_fabric"]
+        assert fabric["slices"] == 2
+        assert set(fabric) >= {"dedup_hits", "dedup_ratio", "obj_blocks",
+                               "bytes_promoted_g4", "replications",
+                               "hot_trunks"}
+    finally:
+        await sim.stop()
+
+
+async def test_fleet_sim_partition_slice_degrades_to_local_no_hung_streams():
+    """Chaos posture: a slice partition severs cross-slice pulls mid-run;
+    pulls degrade to local rehydration/recompute and every stream still
+    completes — zero hung streams, zero hard sanitizer violations."""
+    from dynamo_tpu.mocker.fleet import FaultSchedule, FleetSim
+
+    base = tempfile.mkdtemp(prefix="fleet_fabric_part_")
+    # seed/pool sizing mirror the passing multi-slice smoke: the tiny
+    # 16-page pools fit every seed-5 json session, so any hung stream
+    # here is the partition's fault, not capacity starvation
+    sim = FleetSim(n_workers=2, router_mode="kv", seed=5, speed=0.0,
+                   idle_sleep_s=0.01, num_pages=16, page_size=16,
+                   host_kv_blocks=8, disk_kv_blocks=32, disk_kv_base=base,
+                   slices=2, dcn_delay_s=0.001,
+                   migration_backoff_base_s=0.01, sick_cooldown_s=0.3)
+    await sim.start()
+    try:
+        sched = FaultSchedule.parse("partition_slice@0.1+0.5=1")
+        report = await sim.run(scenarios=("json",), n_sessions=4, rps=20.0,
+                               fault_schedule=sched)
+        g = report["goodput"]
+        assert g["n_ok"] == g["n_requests"], "partitioned pulls must not fail requests"
+        assert report["active_streams_after"] == 0, "zero hung streams"
+        assert report["faults"].get("partition_slice") == 1
+        assert "kv_fabric" in report
+    finally:
+        await sim.stop()
+    hard = [v for v in sim.sanitizer.violations if v["kind"] != "loop_lag"]
+    assert not hard, sim.sanitizer.report()

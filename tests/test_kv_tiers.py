@@ -379,7 +379,8 @@ def test_measured_onboard_cost_flips_placement():
                       ActiveSequences(), host_overlaps=host_overlaps,
                       audit=audit)
     assert w == (0, 0), "constant priors are attracted to the big tier"
-    assert audit[0]["credit_src"] == {"host": "prior", "remote": "prior"}
+    assert audit[0]["credit_src"] == {"host": "prior", "remote": "prior",
+                                      "obj": "prior"}
 
     rec = cfg.recompute_block_s
     tier_costs = {
@@ -407,14 +408,17 @@ def test_missing_measurement_falls_back_to_priors():
     workers = [(0, 0), (1, 0)]
     audit = []
     # worker 1 has measured only its remote leg: host leg must stay prior
+    # while the measured fetch leg still gets priced (per-leg fallback)
     sel.select(workers, 8, OverlapScores(scores={}), ActiveSequences(),
                host_overlaps={(0, 0): 8}, audit=audit,
                tier_costs={(1, 0): {"remote": 0.0001}})
     by_worker = {tuple(e["worker"]): e for e in audit}
     assert by_worker[(0, 0)]["credit_src"] == {"host": "prior",
-                                               "remote": "prior"}
+                                               "remote": "prior",
+                                               "obj": "prior"}
     assert by_worker[(1, 0)]["credit_src"] == {"host": "prior",
-                                               "remote": "prior"}
+                                               "remote": "measured",
+                                               "obj": "prior"}
     assert by_worker[(0, 0)]["host_credit_w"] == cfg.host_credit
 
 
