@@ -989,6 +989,44 @@ class ModelRunner:
         hold `jax.transfer_guard("disallow")` across whole dispatches."""
         self._sanitizer = san
 
+    def layout_table(self):
+        """(name, live array, declared NamedSharding) rows for every model
+        param and KV pool — the statically-derived layout contract
+        (ShardingPolicy over parallel/mesh.py's canonical spec tables)
+        zipped with the arrays that must satisfy it. The sanitizer's
+        layout guard diffs live `jax.Array.sharding` against these at
+        warm-path entry; dynlint's DYN-S rules check the same tables
+        statically (docs/static_analysis.md)."""
+        rows = []
+
+        def _walk(prefix, tree, shardings):
+            leaves = jax.tree_util.tree_leaves_with_path(tree)
+            wants = jax.tree_util.tree_leaves(shardings)
+            for (path, leaf), want in zip(leaves, wants):
+                name = prefix + "/".join(
+                    str(getattr(k, "key", k)) for k in path
+                )
+                rows.append((name.rstrip("/"), leaf, want))
+
+        _walk("params/", self.params,
+              self.policy.params_sharding(self.params))
+        _walk("k_pool/", self.k_pool,
+              self.policy.kv_pool_sharding_tree(self.k_pool))
+        _walk("v_pool/", self.v_pool,
+              self.policy.kv_pool_sharding_tree(self.v_pool))
+        if getattr(self, "draft_params", None) is not None:
+            _walk("draft_params/", self.draft_params,
+                  self.policy.params_sharding(self.draft_params))
+        if getattr(self, "draft_k_pool", None) is not None:
+            _walk("draft_k_pool/", self.draft_k_pool,
+                  self.policy.kv_pool_sharding_tree(self.draft_k_pool))
+            _walk("draft_v_pool/", self.draft_v_pool,
+                  self.policy.kv_pool_sharding_tree(self.draft_v_pool))
+        if getattr(self, "lora", None) is not None:
+            _walk("lora/", self.lora,
+                  self.policy.params_sharding(self.lora))
+        return rows
+
     def _allow(self, label: str):
         san = self._sanitizer
         return contextlib.nullcontext() if san is None else san.allow_transfer(label)
@@ -2224,9 +2262,11 @@ class ModelRunner:
         idx = jnp.asarray(np.asarray(pages, np.int32))
         if self.multihost:
             if not hasattr(self, "_jit_export_repl"):
-                from jax.sharding import NamedSharding, PartitionSpec as P
+                from jax.sharding import NamedSharding
 
-                repl = NamedSharding(self.mesh, P())
+                from dynamo_tpu.parallel.mesh import SPEC_REPLICATED
+
+                repl = NamedSharding(self.mesh, SPEC_REPLICATED)
                 self._jit_export_repl = jax.jit(
                     lambda kp, vp, i: (
                         self._dense_pages(kp, i), self._dense_pages(vp, i)

@@ -73,6 +73,7 @@ from dynamo_tpu.lint.core import (
     _collect_suppressions,
 )
 from dynamo_tpu.lint.rules_async import _BLOCKING_CALLS
+from dynamo_tpu.lint.shard_facts import extract_shard_facts
 
 __all__ = [
     "extract_module_facts",
@@ -83,7 +84,7 @@ __all__ = [
 ]
 
 # bump to invalidate cached facts when the extraction schema changes
-FACTS_VERSION = 2  # v2: guard-span ("guards") + attr-write ("writes") facts
+FACTS_VERSION = 3  # v3: sharding/layout facts ("shard", lint/shard_facts.py)
 
 _LOCK_NAME_RE = re.compile(r"(^|_)r?lock$")
 
@@ -469,7 +470,7 @@ def extract_module_facts(
         except SyntaxError:
             # DYN-E000 is already reported by the per-file pass
             return {"module": module, "path": rel_path, "is_pkg": is_pkg,
-                    "aliases": {}, "functions": {},
+                    "aliases": {}, "functions": {}, "shard": {},
                     "suppress_lines": {}, "suppress_file": []}
     index = _ProjectModuleIndex(module, is_pkg)
     index.index_module(tree)
@@ -482,6 +483,7 @@ def extract_module_facts(
         "is_pkg": is_pkg,
         "aliases": dict(index.aliases),
         "functions": visitor.functions,
+        "shard": extract_shard_facts(module, tree, index),
         "suppress_lines": {str(k): sorted(v) for k, v in sup_lines.items()},
         "suppress_file": sorted(sup_file),
     }
@@ -890,6 +892,12 @@ def project_violations(
                         "docs/static_analysis.md)")
                 elif nxt not in path and len(path) < _MAX_CHAIN:
                     stack.append((nxt, path + [nxt]))
+
+    # DYN-S001..S005: sharding/layout contract rules over the shard
+    # facts (lint/rules_shard.py), same suppression semantics
+    from dynamo_tpu.lint.rules_shard import shard_project_violations
+
+    shard_project_violations(idx, report)
 
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out

@@ -1,0 +1,489 @@
+"""dynshard project rules: DYN-S001..S005 over the extracted shard facts.
+
+Evaluated inside `project_violations` (lint/project.py) with the same
+reporting-site suppression semantics as the concurrency rules. Each rule
+protects one layout contract (docs/static_analysis.md):
+
+- **DYN-S001** — spec mismatch at a call boundary: a tensor pinned to
+  one `PartitionSpec` (via `with_sharding_constraint` / `device_put`)
+  reaches a callee whose declared `in_specs`/`in_shardings` disagree.
+  XLA will silently insert the reshard — on a pod that is an all-gather
+  over DCN. Propagation follows bare-parameter forwarding through the
+  PR-13 call graph, so the declaration may be any number of helper hops
+  away; the finding carries the full chain with `file:line` per hop.
+- **DYN-S002** — a spec references a mesh-axis name that no reachable
+  mesh constructor defines: a typo'd axis silently means "replicate".
+- **DYN-S003** — a large parameter / KV tensor enters an explicitly
+  specced scope fully replicated via an *inline* literal. Deliberate
+  replication must come from the canonical spec tables
+  (`parallel/mesh.py`) so the decision is a reviewable declaration.
+- **DYN-S004** — buffer-donation conflict: an argument donated via
+  `donate_argnums` is aliased with another argument of the same call or
+  read again after the call. Donated buffers are invalidated; the read
+  returns garbage (or XLA errors) only on hardware, never under tests
+  that skip donation.
+- **DYN-S005** — role divergence: the same logical tensor (argument
+  name + rank) is declared with different specs in prefill- vs
+  decode-role functions without a declared `*reshard*` helper carrying
+  it across — the disaggregated-serving seam (ROADMAP item 5) where an
+  implicit layout change becomes KV-sized wire traffic.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["shard_project_violations", "SHARD_RULE_IDS"]
+
+SHARD_RULE_IDS = ("DYN-S001", "DYN-S002", "DYN-S003", "DYN-S004",
+                  "DYN-S005")
+
+# tensors big enough that silent full replication is a real cost: model
+# params / weights, embeddings, KV pools and page tensors (but NOT the
+# tiny per-sequence metadata that happens to carry a kv_ prefix, like
+# kv_lens)
+_LARGE_RE = re.compile(
+    r"(^|_)(params|weights?|embed|embedding|lm_head|pages)(_|$)|pool")
+
+# state that persists across the prefill→decode handoff — the only
+# tensors whose cross-role layout agreement matters for disaggregated
+# serving (activations like `q` are recomputed per role, and identical
+# names across different attention ops are not the same logical tensor)
+_SEAM_RE = re.compile(r"pool|pages|cache|(^|_)kv(_|$)")
+
+_MAX_HOPS = 12
+
+_UNRESOLVED = object()
+
+
+def _fold_entry(e: Any, const_env: Dict[str, Any],
+                defaults: Dict[str, str]) -> Any:
+    """Concrete value for one spec entry: None, an axis string, or a
+    list of axis strings; _UNRESOLVED when it cannot be folded."""
+    if e is None or isinstance(e, str) and e != "?":
+        return e
+    if isinstance(e, list):
+        return e if all(isinstance(x, str) for x in e) else _UNRESOLVED
+    if isinstance(e, dict):
+        if "param" in e:
+            v = defaults.get(e["param"])
+            return v if v is not None else _UNRESOLVED
+        if "ref" in e:
+            v = const_env.get(e["ref"])
+            if isinstance(v, (str, list)):
+                return v
+            return _UNRESOLVED
+    return _UNRESOLVED
+
+
+class _Linker:
+    """Cross-module resolution state shared by all S rules."""
+
+    def __init__(self, idx) -> None:
+        self.idx = idx
+        self.shards: Dict[str, Dict[str, Any]] = {}
+        for mname, m in idx.modules.items():
+            sh = m.get("shard")
+            if sh:
+                self.shards[mname] = sh
+        # dotted constant env: "pkg.mod.AXIS_MODEL" -> "model", tuple
+        # constants -> ["data", ...]
+        self.const_env: Dict[str, Any] = {}
+        for mname, sh in self.shards.items():
+            for name, v in sh.get("consts", {}).items():
+                self.const_env[f"{mname}.{name}"] = v
+        # canonical spec table: "pkg.mod.SPEC_X" -> folded entries
+        self.spec_table: Dict[str, List[Any]] = {}
+        for mname, sh in self.shards.items():
+            for name, sc in sh.get("spec_consts", {}).items():
+                folded = [_fold_entry(e, self.const_env, {})
+                          for e in sc.get("entries", [])]
+                if not any(f is _UNRESOLVED for f in folded):
+                    self.spec_table[f"{mname}.{name}"] = folded
+        # mesh axes defined anywhere in scope
+        self.defined_axes: set = set()
+        self.has_mesh = False
+        for sh in self.shards.values():
+            for decl in sh.get("axes", []):
+                self.has_mesh = True
+                for e in decl.get("axes", []):
+                    f = _fold_entry(e, self.const_env, {})
+                    if isinstance(f, str):
+                        self.defined_axes.add(f)
+                    elif isinstance(f, list):
+                        self.defined_axes.update(f)
+        # qname -> (module dict, shard fn facts)
+        self.fns: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {}
+        for mname, sh in self.shards.items():
+            m = idx.modules[mname]
+            for local, f in sh.get("functions", {}).items():
+                self.fns[f"{mname}.{local}"] = (m, f)
+
+    # -- spec resolution ---------------------------------------------------
+    def resolve_spec(self, spec: Optional[Dict[str, Any]],
+                     defaults: Optional[Dict[str, str]] = None,
+                     ) -> Optional[List[Any]]:
+        """Concrete entry list for a spec descriptor, or None."""
+        if not isinstance(spec, dict):
+            return None
+        if "ref" in spec and "entries" not in spec:
+            return self.spec_table.get(spec["ref"])
+        folded = [_fold_entry(e, self.const_env, defaults or {})
+                  for e in spec.get("entries", [])]
+        if any(f is _UNRESOLVED for f in folded):
+            return None
+        return folded
+
+    def partial_axes(self, spec: Optional[Dict[str, Any]],
+                     defaults: Dict[str, str]) -> List[Tuple[str, int]]:
+        """(axis, line) for every axis string a spec mentions, even when
+        other entries stay symbolic — S002 checks names, not shapes."""
+        if not isinstance(spec, dict):
+            return []
+        line = spec.get("line", 0)
+        entries = spec.get("entries")
+        if entries is None and "ref" in spec:
+            return []  # checked where the table entry is defined
+        out: List[Tuple[str, int]] = []
+        for e in entries or []:
+            f = _fold_entry(e, self.const_env, defaults)
+            if isinstance(f, str):
+                out.append((f, line))
+            elif isinstance(f, list):
+                out.extend((x, line) for x in f)
+        return out
+
+    def resolve_callee(self, mname: str, cls: Optional[str],
+                       raw: str) -> Optional[str]:
+        q = self.idx._resolve_callee(mname, cls, raw)
+        if q is not None and q in self.fns:
+            return q
+        return None
+
+    def short(self, q: str) -> str:
+        return self.idx._short(q)
+
+
+def _norm(entries: List[Any]) -> Tuple[Any, ...]:
+    """Comparison form: trailing Nones stripped (P("x") == P("x", None)
+    for any array the spec can apply to), tuple entries hashable."""
+    out = [tuple(e) if isinstance(e, list) else e for e in entries]
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def _fmt(entries: List[Any]) -> str:
+    def one(e: Any) -> str:
+        if e is None:
+            return "None"
+        if isinstance(e, (list, tuple)):
+            return "(" + ", ".join(repr(x) for x in e) + ")"
+        return repr(e)
+    return "P(" + ", ".join(one(e) for e in entries) + ")"
+
+
+def _declared_specs(lk: _Linker) -> Dict[str, Dict[int, Dict[str, Any]]]:
+    """fn qname -> {param position -> declared spec + declaration site}.
+
+    Seeds: a function that forwards its own parameter straight into a
+    `shard_map` boundary, and `jax.jit(fn, in_shardings=...)`
+    declarations. Propagation: a function that forwards its parameter
+    bare into a callee with a declared spec inherits that requirement
+    (fixpoint, hop-bounded) — this is what makes the 2-hop S001 fire."""
+    declared: Dict[str, Dict[int, Dict[str, Any]]] = {}
+    for q, (m, f) in lk.fns.items():
+        for b in f.get("boundaries", []):
+            for j, a in enumerate(b.get("args", [])):
+                if a.get("param") is None:
+                    continue
+                entries = lk.resolve_spec(a.get("spec"),
+                                          f.get("param_defaults"))
+                if entries is None:
+                    continue
+                declared.setdefault(q, {}).setdefault(a["param"], {
+                    "entries": entries,
+                    "site": (m["path"], b.get("decl_line", b["line"])),
+                    "hops": [],
+                })
+    for mname, sh in lk.shards.items():
+        m = lk.idx.modules[mname]
+        for jd in sh.get("jit_decls", []):
+            q = lk.resolve_callee(mname, None, jd["fn"])
+            if q is None:
+                continue
+            _, f = lk.fns[q]
+            for pos, spec in enumerate(jd.get("in", [])):
+                entries = lk.resolve_spec(spec, f.get("param_defaults"))
+                if entries is None:
+                    continue
+                declared.setdefault(q, {}).setdefault(pos, {
+                    "entries": entries,
+                    "site": (m["path"], jd["line"]),
+                    "hops": [],
+                })
+    for _ in range(_MAX_HOPS):
+        changed = False
+        for q, (m, f) in lk.fns.items():
+            for fl in f.get("flows", []):
+                callee = lk.resolve_callee(m["module"], f.get("cls"),
+                                           fl["callee"])
+                if callee is None:
+                    continue
+                cdecl = declared.get(callee, {})
+                for j, a in enumerate(fl.get("args", [])):
+                    if not (isinstance(a, dict) and "param" in a):
+                        continue
+                    d = cdecl.get(j)
+                    if d is None:
+                        continue
+                    slot = declared.setdefault(q, {})
+                    if a["param"] in slot:
+                        continue
+                    slot[a["param"]] = {
+                        "entries": d["entries"],
+                        "site": d["site"],
+                        "hops": [(lk.short(callee), m["path"],
+                                  fl["line"])] + d["hops"],
+                    }
+                    changed = True
+        if not changed:
+            break
+    return declared
+
+
+def _s001(lk: _Linker, declared, report: Callable) -> None:
+    for q, (m, f) in lk.fns.items():
+        defaults = f.get("param_defaults", {})
+        # direct: constrained local straight into a shard_map boundary
+        for b in f.get("boundaries", []):
+            for a in b.get("args", []):
+                actual = a.get("actual")
+                if not actual:
+                    continue
+                have = lk.resolve_spec(actual.get("spec"), defaults)
+                want = lk.resolve_spec(a.get("spec"), defaults)
+                if have is None or want is None:
+                    continue
+                if _norm(have) != _norm(want):
+                    report(
+                        m, "DYN-S001", b["line"], b.get("col", 0),
+                        f"spec mismatch at shard_map boundary: "
+                        f"`{a.get('name') or '<arg>'}` is constrained to "
+                        f"{_fmt(have)} ({m['path']}:{actual['line']}) but "
+                        f"the boundary declares {_fmt(want)} "
+                        f"({m['path']}:{b.get('decl_line', b['line'])}); "
+                        "XLA inserts an implicit reshard (an all-gather "
+                        "on a pod mesh) — align the specs via the "
+                        "canonical tables in parallel/mesh.py or reshard "
+                        "explicitly")
+        # interprocedural: constrained local forwarded into a callee
+        # whose (possibly inherited) declared spec disagrees
+        for fl in f.get("flows", []):
+            callee = lk.resolve_callee(m["module"], f.get("cls"),
+                                       fl["callee"])
+            if callee is None:
+                continue
+            cdecl = declared.get(callee)
+            if not cdecl:
+                continue
+            for j, a in enumerate(fl.get("args", [])):
+                if not (isinstance(a, dict) and "spec" in a):
+                    continue
+                d = cdecl.get(j)
+                if d is None:
+                    continue
+                have = lk.resolve_spec(a["spec"], defaults)
+                if have is None:
+                    continue
+                if _norm(have) == _norm(d["entries"]):
+                    continue
+                site_path, site_line = d["site"]
+                chain = [f"`{a.get('var', '<arg>')}` constrained to "
+                         f"{_fmt(have)} ({m['path']}:{a['line']})",
+                         f"{lk.short(callee)} ({m['path']}:{fl['line']})"]
+                chain += [f"{label} ({path}:{line})"
+                          for label, path, line in d["hops"]]
+                chain.append(f"declared {_fmt(d['entries'])} "
+                             f"({site_path}:{site_line})")
+                report(
+                    m, "DYN-S001", fl["line"], fl.get("col", 0),
+                    "spec mismatch at call boundary: "
+                    + " -> ".join(chain)
+                    + "; the callee's contract disagrees with the "
+                      "caller's layout, so XLA reshards implicitly — "
+                      "align the specs or route through a declared "
+                      "reshard helper")
+
+
+def _s002(lk: _Linker, report: Callable) -> None:
+    if not lk.has_mesh or not lk.defined_axes:
+        return  # no mesh constructor in scope: nothing to check against
+    shown = ", ".join(sorted(lk.defined_axes))
+    for mname, sh in lk.shards.items():
+        m = lk.idx.modules[mname]
+        fn_defaults: Dict[str, Dict[str, str]] = {
+            f["name"]: f.get("param_defaults", {})
+            for f in sh.get("functions", {}).values()
+        }
+        for spec in sh.get("specs", []):
+            defaults = fn_defaults.get(spec.get("fn") or "", {})
+            for axis, line in lk.partial_axes(spec, defaults):
+                if axis not in lk.defined_axes:
+                    report(
+                        m, "DYN-S002", spec.get("line", line),
+                        spec.get("col", 0),
+                        f"spec references mesh axis '{axis}' which no "
+                        f"reachable mesh constructor defines (defined: "
+                        f"{shown}); an unknown axis name silently means "
+                        "'replicate' — fix the name or add the axis to "
+                        "the mesh")
+
+
+def _s003(lk: _Linker, report: Callable) -> None:
+    def fully_replicated(entries: List[Any]) -> bool:
+        return all(e is None for e in entries)
+
+    for q, (m, f) in lk.fns.items():
+        defaults = f.get("param_defaults", {})
+        for b in f.get("boundaries", []):
+            for a in b.get("args", []):
+                name = a.get("name")
+                spec = a.get("spec")
+                if (not name or not _LARGE_RE.search(name)
+                        or not isinstance(spec, dict)
+                        or "entries" not in spec):
+                    continue  # table refs are declared decisions
+                entries = lk.resolve_spec(spec, defaults)
+                if entries is None or not fully_replicated(entries):
+                    continue
+                report(
+                    m, "DYN-S003", b["line"], b.get("col", 0),
+                    f"large tensor `{name}` enters the shard_map scope "
+                    f"fully replicated by the inline literal "
+                    f"{_fmt(entries)} "
+                    f"({m['path']}:{spec.get('line', b['line'])}); if "
+                    "replication is deliberate, import the canonical "
+                    "declaration from parallel/mesh.py (e.g. "
+                    "SPEC_REPLICATED) so the memory cost is a reviewed "
+                    "decision, otherwise give it a sharded spec")
+    for mname, sh in lk.shards.items():
+        m = lk.idx.modules[mname]
+        for jd in sh.get("jit_decls", []):
+            q = lk.resolve_callee(mname, None, jd["fn"])
+            params = lk.fns[q][1]["params"] if q else []
+            for pos, spec in enumerate(jd.get("in", [])):
+                if not isinstance(spec, dict) or "entries" not in spec:
+                    continue
+                name = params[pos] if pos < len(params) else None
+                if not name or not _LARGE_RE.search(name):
+                    continue
+                entries = lk.resolve_spec(spec)
+                if entries is None or not all(e is None for e in entries):
+                    continue
+                report(
+                    m, "DYN-S003", jd["line"], 0,
+                    f"large tensor `{name}` enters the pjitted scope "
+                    f"fully replicated by the inline in_shardings "
+                    f"literal {_fmt(entries)}; import the canonical "
+                    "declaration from parallel/mesh.py or shard it")
+
+
+def _s004(lk: _Linker, report: Callable) -> None:
+    for q, (m, f) in lk.fns.items():
+        for dc in f.get("donate_calls", []):
+            for d in dc.get("donated", []):
+                if "conflict_line" not in d:
+                    continue
+                if d["why"] == "aliased":
+                    msg = (f"donated buffer `{d['name']}` is passed "
+                           f"twice to `{dc['binding']}` (donate binding "
+                           f"at {m['path']}:{dc['decl_line']}): the "
+                           "donated operand aliases another argument, "
+                           "so the kernel reads a buffer XLA already "
+                           "reused — pass a copy or stop donating it")
+                else:
+                    msg = (f"donated buffer `{d['name']}` is read at "
+                           f"{m['path']}:{d['conflict_line']} after "
+                           f"being donated to `{dc['binding']}` "
+                           f"({m['path']}:{dc['line']}, donate binding "
+                           f"at line {dc['decl_line']}): donation "
+                           "invalidates the buffer, so the later read "
+                           "returns garbage on device — rebind the name "
+                           "to the call's result or drop the donation")
+                report(m, "DYN-S004", dc["line"], dc.get("col", 0), msg)
+
+
+def _s005(lk: _Linker, report: Callable) -> None:
+    # params of declared reshard helpers: tensors they carry are exempt
+    # (the helper IS the declared layout change)
+    reshard_params: set = set()
+    for q, (_m, f) in lk.fns.items():
+        if f.get("is_reshard"):
+            reshard_params.update(f.get("params", []))
+    sites: Dict[Tuple[str, int], List[Dict[str, Any]]] = {}
+    for q, (m, f) in lk.fns.items():
+        role = f.get("role")
+        if role is None or f.get("is_reshard"):
+            continue
+        defaults = f.get("param_defaults", {})
+        for b in f.get("boundaries", []):
+            for a in b.get("args", []):
+                name = a.get("name")
+                entries = lk.resolve_spec(a.get("spec"), defaults)
+                if not name or not _SEAM_RE.search(name) or entries is None:
+                    continue
+                sites.setdefault((name, len(entries)), []).append({
+                    "role": role, "spec": entries, "m": m,
+                    "line": b["line"], "col": b.get("col", 0),
+                    "fn": f["name"],
+                })
+        for c in f.get("constraints", []):
+            entries = lk.resolve_spec(c.get("spec"), defaults)
+            if entries is None or not _SEAM_RE.search(c["var"]):
+                continue
+            sites.setdefault((c["var"], len(entries)), []).append({
+                "role": role, "spec": entries, "m": m,
+                "line": c["line"], "col": 0, "fn": f["name"],
+            })
+    for (name, _rank), ss in sorted(sites.items()):
+        if name in reshard_params:
+            continue
+        pre = [s for s in ss if s["role"] == "prefill"]
+        dec = [s for s in ss if s["role"] == "decode"]
+        done = False
+        for p in pre:
+            for d in dec:
+                if _norm(p["spec"]) == _norm(d["spec"]):
+                    continue
+                report(
+                    d["m"], "DYN-S005", d["line"], d["col"],
+                    f"role divergence for `{name}`: prefill "
+                    f"`{p['fn']}` declares {_fmt(p['spec'])} "
+                    f"({p['m']['path']}:{p['line']}) but decode "
+                    f"`{d['fn']}` declares {_fmt(d['spec'])} — the "
+                    "layouts disagree across the prefill/decode seam "
+                    "with no declared reshard helper in between, so a "
+                    "disaggregated deployment reshards KV-sized state "
+                    "on the wire implicitly; share one canonical spec "
+                    "from parallel/mesh.py or route through a "
+                    "`*reshard*` helper that takes this tensor")
+                done = True
+                break
+            if done:
+                break
+
+
+def shard_project_violations(idx, report: Callable) -> None:
+    """Run all S rules. `report(module, rule, line, col, message)` is
+    the suppression-aware emitter owned by project_violations."""
+    lk = _Linker(idx)
+    if not lk.shards:
+        return
+    declared = _declared_specs(lk)
+    _s001(lk, declared, report)
+    _s002(lk, report)
+    _s003(lk, report)
+    _s004(lk, report)
+    _s005(lk, report)

@@ -1,0 +1,597 @@
+"""dynshard fact extraction: sharding/layout contracts as plain dicts.
+
+The concurrency facts (`lint/project.py`) made helper-hidden blocking
+calls visible; this module does the same for *layouts*. One extra walk
+over the already-parsed tree collects, per module:
+
+- **mesh-axis declarations** — `Mesh(devs, ("data", ...))` /
+  `jax.make_mesh(shape, axes)` constructor calls, with module-level
+  string/tuple constants (`AXIS_MODEL = "model"`,
+  `ALL_AXES = (AXIS_DATA, ...)`) folded so the axis *names* survive,
+- **spec constants** — module-level `SPEC_X = P(...)` assignments: the
+  canonical, importable layout tables (`parallel/mesh.py`) that rules
+  treat as *declared* layout decisions,
+- **every `PartitionSpec` literal** with its axis entries (constants
+  folded, function parameters kept symbolic as `{"param": name}`,
+  cross-module references as `{"ref": dotted}`),
+- per function: **boundaries** (a `shard_map`-wrapped callable invoked
+  with its `in_specs`, or a `jax.jit(fn, in_shardings=...)`
+  declaration), **constraints** (locals pinned by
+  `with_sharding_constraint` / `device_put` with a `NamedSharding`),
+  **flows** (call args that are bare parameters or constrained locals —
+  the propagation edges DYN-S001 walks), **donation facts**
+  (`donate_argnums` bindings, call sites, and any use of a donated
+  buffer after the call), and the function's serving **role**
+  (prefill / decode, by name) for DYN-S005.
+
+Everything is JSON-serializable and rides the same mtime-keyed facts
+cache as the concurrency facts (FACTS_VERSION gates staleness). Rule
+evaluation lives in `lint/rules_shard.py`.
+
+Spec encoding: a spec is `{"entries": [...]}` or `{"ref": "dotted"}`,
+where each entry is `None`, an axis string, a list of axis strings (a
+tuple entry), `{"param": name}`, `{"ref": dotted}`, or `"?"` (opaque —
+rules skip comparisons that touch one).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional
+
+__all__ = ["extract_shard_facts", "SHARD_SCHEMA"]
+
+# facts-dict key under which these ride in extract_module_facts output
+SHARD_SCHEMA = 1
+
+_ROLE_PREFILL_RE = re.compile(r"prefill", re.IGNORECASE)
+_ROLE_DECODE_RE = re.compile(r"decode", re.IGNORECASE)
+_RESHARD_RE = re.compile(r"reshard", re.IGNORECASE)
+
+
+def _is(name: Optional[str], *tails: str) -> bool:
+    if name is None:
+        return False
+    return any(name == t or name.endswith("." + t) for t in tails)
+
+
+def _display(node: ast.AST) -> Optional[str]:
+    """Logical-tensor display name for a call argument: `k_pool` for a
+    bare name, `k_pool` for `self.k_pool`, `embed` for
+    `params["embed"]`. None when the expression has no stable name."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)):
+        return node.slice.value
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """`self.k_pool` → "self.k_pool", bare names as-is — the donation
+    tracker's canonical buffer identity."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return None
+
+
+class _ShardVisitor(ast.NodeVisitor):
+    """Single walk; nested defs attribute to the outermost function,
+    mirroring the concurrency facts visitor."""
+
+    def __init__(self, module: str, index) -> None:
+        self.module = module
+        self.index = index  # _ProjectModuleIndex (alias resolution)
+        self.consts: Dict[str, Any] = {}       # NAME -> str | [str, ...]
+        self.spec_consts: Dict[str, Any] = {}  # NAME -> {"entries", "line"}
+        self.axes: List[Dict[str, Any]] = []   # mesh constructor decls
+        self.specs: List[Dict[str, Any]] = []  # every spec literal seen
+        self.functions: Dict[str, Dict[str, Any]] = {}
+        self.donate_bindings: Dict[str, Dict[str, Any]] = {}
+        self.jit_decls: List[Dict[str, Any]] = []
+        self._cls: List[str] = []
+        self._fn: List[Dict[str, Any]] = []
+        self._env: List[Dict[str, Any]] = []
+
+    # -- spec / axis parsing ----------------------------------------------
+    def _entry(self, node: ast.AST) -> Any:
+        env = self._env[-1] if self._env else None
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return None
+            if isinstance(node.value, str):
+                return node.value
+            return "?"
+        if isinstance(node, (ast.Tuple, ast.List)):
+            sub = [self._entry(e) for e in node.elts]
+            return sub if all(isinstance(s, str) for s in sub) else "?"
+        if isinstance(node, ast.Name):
+            if env is not None and node.id in env["params"]:
+                return {"param": node.id}
+            v = self.consts.get(node.id)
+            if isinstance(v, str):
+                return v
+            dotted = self.index.resolve(node)
+            if dotted and "." in dotted:
+                return {"ref": dotted}
+            return "?"
+        if isinstance(node, ast.Attribute):
+            dotted = self.index.resolve(node)
+            if dotted and not dotted.startswith("self."):
+                return {"ref": dotted}
+            return "?"
+        return "?"
+
+    def _spec_value(self, node: ast.AST) -> Optional[Dict[str, Any]]:
+        """Spec descriptor for an expression, or None when it is not
+        recognizably a PartitionSpec."""
+        env = self._env[-1] if self._env else None
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Call):
+            name = self.index.resolve(node.func)
+            if _is(name, "PartitionSpec", "P"):
+                return {"entries": [self._entry(a) for a in node.args],
+                        "line": line}
+            if _is(name, "NamedSharding"):
+                spec_arg = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "spec":
+                        spec_arg = kw.value
+                return self._spec_value(spec_arg) if spec_arg is not None \
+                    else None
+            return None
+        if isinstance(node, ast.Name):
+            if env is not None and node.id in env["specs"]:
+                d = dict(env["specs"][node.id])
+                d["line"] = line
+                return d
+            if node.id in self.spec_consts:
+                return {"ref": f"{self.module}.{node.id}", "line": line}
+            dotted = self.index.resolve(node)
+            if dotted and "." in dotted:
+                return {"ref": dotted, "line": line}
+            return None
+        if isinstance(node, ast.Attribute):
+            dotted = self.index.resolve(node)
+            if dotted and not dotted.startswith("self."):
+                return {"ref": dotted, "line": line}
+            return None
+        return None
+
+    def _spec_list(self, node: ast.AST) -> Optional[List[Any]]:
+        """in_specs / in_shardings value → list of spec descriptors
+        (None entries for unrecognized elements)."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [self._spec_value(e) for e in node.elts]
+        one = self._spec_value(node)
+        return [one] if one is not None else None
+
+    # -- module-level constants -------------------------------------------
+    def _module_assign(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            return
+        name = node.targets[0].id
+        v = node.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            self.consts[name] = v.value
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            elts = [self._entry(e) for e in v.elts]
+            if all(isinstance(e, str) for e in elts):
+                self.consts[name] = elts
+        elif isinstance(v, ast.Call):
+            ctor = self.index.resolve(v.func)
+            if _is(ctor, "PartitionSpec", "P"):
+                self.spec_consts[name] = {
+                    "entries": [self._entry(a) for a in v.args],
+                    "line": node.lineno,
+                }
+
+    # -- function scope ----------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+
+    @staticmethod
+    def _role_for(name: str) -> Optional[str]:
+        p = bool(_ROLE_PREFILL_RE.search(name))
+        d = bool(_ROLE_DECODE_RE.search(name))
+        if p == d:
+            return None
+        return "prefill" if p else "decode"
+
+    def _visit_function(self, node) -> None:
+        self._record_donate_decorator(node)
+        if self._fn:
+            # nested def: keep attributing to the outer function, but its
+            # params become opaque (they shadow nothing we track)
+            self.generic_visit(node)
+            return
+        cls = self._cls[-1] if self._cls else None
+        local = f"{cls}.{node.name}" if cls else node.name
+        params = [a.arg for a in node.args.args]
+        defaults: Dict[str, str] = {}
+        pos_defaults = node.args.defaults
+        for p, d in zip(params[len(params) - len(pos_defaults):],
+                        pos_defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value, str):
+                defaults[p] = d.value
+        for a, d in zip(node.args.kwonlyargs, node.args.kw_defaults):
+            if (d is not None and isinstance(d, ast.Constant)
+                    and isinstance(d.value, str)):
+                defaults[a.arg] = d.value
+        facts = {
+            "name": node.name,
+            "cls": cls,
+            "line": node.lineno,
+            "params": params,
+            "param_defaults": defaults,
+            "role": self._role_for(node.name),
+            "is_reshard": bool(_RESHARD_RE.search(node.name)),
+            "boundaries": [],
+            "constraints": [],
+            "flows": [],
+            "donate_calls": [],
+        }
+        env = {
+            "params": set(params),
+            "specs": {},        # local name -> spec descriptor
+            "shard_maps": {},   # local name -> {"in": [...], "line"}
+            "constraints": {},  # local name -> {"spec", "line"}
+            "loads": {},        # dotted name -> [line, ...]
+            "stores": {},       # dotted name -> [line, ...]
+        }
+        self.functions[local] = facts
+        self._fn.append(facts)
+        self._env.append(env)
+        self.generic_visit(node)
+        self._finish_donations(facts, env)
+        self._env.pop()
+        self._fn.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- donation bindings -------------------------------------------------
+    def _jit_donate(self, call: ast.Call) -> Optional[List[int]]:
+        """donate_argnums of a `jax.jit(...)` call, or None."""
+        if not _is(self.index.resolve(call.func), "jit"):
+            return None
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return [v.value]
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    return [e.value for e in v.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)]
+        return None
+
+    def _record_donate_decorator(self, node) -> None:
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            target: Optional[ast.Call] = None
+            fn = self.index.resolve(dec.func)
+            if _is(fn, "jit"):
+                target = dec
+            elif (_is(fn, "partial") and dec.args
+                    and _is(self.index.resolve(dec.args[0]), "jit")):
+                target = dec
+            if target is None:
+                continue
+            donate = None
+            for kw in target.keywords:
+                if kw.arg == "donate_argnums":
+                    v = kw.value
+                    if isinstance(v, ast.Constant) and isinstance(
+                            v.value, int):
+                        donate = [v.value]
+                    elif isinstance(v, (ast.Tuple, ast.List)):
+                        donate = [e.value for e in v.elts
+                                  if isinstance(e, ast.Constant)
+                                  and isinstance(e.value, int)]
+            if donate:
+                self.donate_bindings[node.name] = {
+                    "donate": donate, "line": node.lineno,
+                }
+
+    def _record_jit_binding(self, target: ast.AST, value: ast.AST) -> None:
+        """`f = jax.jit(g, in_shardings=..., donate_argnums=...)` — the
+        in_shardings declare g's per-arg layout contract; donate_argnums
+        feed the donation tracker."""
+        call: Optional[ast.Call] = None
+        if isinstance(value, ast.Call):
+            if _is(self.index.resolve(value.func), "jit"):
+                call = value
+            else:  # single-level wrapper, e.g. _family("x", jax.jit(f))
+                for a in value.args:
+                    if isinstance(a, ast.Call) and _is(
+                            self.index.resolve(a.func), "jit"):
+                        call = a
+                        break
+        if call is None:
+            return
+        name = _dotted(target)
+        if name is None:
+            return
+        inner = (call.args[0] if call.args else None)
+        inner_name = None
+        if inner is not None:
+            if isinstance(inner, ast.Call) and _is(
+                    self.index.resolve(inner.func), "partial") and inner.args:
+                inner = inner.args[0]
+            inner_name = _dotted(inner)
+        in_specs = None
+        for kw in call.keywords:
+            if kw.arg == "in_shardings":
+                in_specs = self._spec_list(kw.value)
+        if in_specs and inner_name:
+            self.jit_decls.append({
+                "fn": inner_name, "in": in_specs, "line": call.lineno,
+            })
+        donate = self._jit_donate(call)
+        if donate:
+            self.donate_bindings[name.split(".")[-1]] = {
+                "donate": donate, "line": call.lineno,
+            }
+
+    # -- assignments -------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self._fn:
+            self._module_assign(node)
+            for t in node.targets:
+                self._record_jit_binding(t, node.value)
+            self.generic_visit(node)
+            return
+        env = self._env[-1]
+        self._track_stores(node)
+        for t in node.targets:
+            self._record_jit_binding(t, node.value)
+        target = node.targets[0] if len(node.targets) == 1 else None
+        tname = target.id if isinstance(target, ast.Name) else None
+        if tname is not None:
+            # a rebind drops whatever layout/spec the old value carried
+            env["specs"].pop(tname, None)
+            env["shard_maps"].pop(tname, None)
+            env["constraints"].pop(tname, None)
+            v = node.value
+            if isinstance(v, ast.Call):
+                name = self.index.resolve(v.func)
+                if _is(name, "PartitionSpec", "P"):
+                    env["specs"][tname] = {
+                        "entries": [self._entry(a) for a in v.args],
+                        "line": node.lineno,
+                    }
+                elif _is(name, "shard_map"):
+                    in_specs = None
+                    for kw in v.keywords:
+                        if kw.arg == "in_specs":
+                            in_specs = self._spec_list(kw.value)
+                    if in_specs is not None:
+                        env["shard_maps"][tname] = {
+                            "in": in_specs, "line": node.lineno,
+                        }
+                elif _is(name, "with_sharding_constraint", "device_put"):
+                    spec = (self._spec_value(v.args[1])
+                            if len(v.args) > 1 else None)
+                    for kw in v.keywords:
+                        if kw.arg in ("shardings", "sharding", "device"):
+                            spec = self._spec_value(kw.value)
+                    if spec is not None:
+                        env["constraints"][tname] = {
+                            "spec": spec, "line": node.lineno,
+                        }
+                        self._fn[-1]["constraints"].append({
+                            "var": tname, "spec": spec,
+                            "line": node.lineno,
+                        })
+            elif isinstance(v, (ast.Name, ast.Attribute)):
+                # local alias of a spec: another local, or a module-level
+                # table constant (`pool = SPEC_KV_PAGES`) — keep the ref
+                spec = self._spec_value(v)
+                if spec is not None:
+                    env["specs"][tname] = spec
+        self.generic_visit(node)
+
+    # -- loads/stores for the donation tracker ----------------------------
+    def _track_stores(self, node: ast.Assign) -> None:
+        # the store takes effect when the whole statement finishes, so a
+        # multi-line `x, self.k_pool = jit_fn(..., self.k_pool)` rebind
+        # covers reads after the donation call it wraps
+        env = self._env[-1]
+        line = getattr(node, "end_lineno", node.lineno)
+        for t in node.targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if isinstance(e, ast.Starred):
+                    e = e.value
+                d = _dotted(e)
+                if d is not None:
+                    env["stores"].setdefault(d, []).append(line)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if self._env and isinstance(node.ctx, ast.Load):
+            self._env[-1]["loads"].setdefault(node.id, []).append(
+                node.lineno)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        d = _dotted(node)
+        if d is not None and self._env and isinstance(node.ctx, ast.Load):
+            self._env[-1]["loads"].setdefault(d, []).append(node.lineno)
+            return  # don't double-count the base Name
+        self.generic_visit(node)
+
+    # -- calls -------------------------------------------------------------
+    def _record_mesh(self, node: ast.Call, name: Optional[str]) -> None:
+        if not _is(name, "Mesh", "make_mesh"):
+            return
+        axis_arg = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg in ("axis_names", "axis_name"):
+                axis_arg = kw.value
+        if axis_arg is None:
+            return
+        entry = self._entry(axis_arg)
+        axes: List[Any]
+        if isinstance(entry, str):
+            axes = [entry]
+        elif isinstance(entry, list):
+            axes = entry
+        elif isinstance(entry, dict):
+            axes = [entry]  # cross-module const, folded at link time
+        else:
+            return
+        self.axes.append({"axes": axes, "line": node.lineno})
+
+    def _boundary_args(self, call: ast.Call,
+                       in_specs: List[Any]) -> List[Dict[str, Any]]:
+        env = self._env[-1]
+        params = self._fn[-1]["params"]
+        out = []
+        for j, arg in enumerate(call.args):
+            spec = in_specs[j] if j < len(in_specs) else None
+            name = _display(arg)
+            pidx = (params.index(arg.id)
+                    if isinstance(arg, ast.Name) and arg.id in params
+                    else None)
+            actual = None
+            if isinstance(arg, ast.Name) and arg.id in env["constraints"]:
+                actual = env["constraints"][arg.id]
+            out.append({"name": name, "param": pidx, "spec": spec,
+                        "actual": actual})
+        return out
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self.index.resolve(node.func)
+        if _is(name, "PartitionSpec", "P"):
+            fn = self._fn[-1]["name"] if self._fn else None
+            self.specs.append({
+                "entries": [self._entry(a) for a in node.args],
+                "line": node.lineno, "col": node.col_offset, "fn": fn,
+            })
+        self._record_mesh(node, name)
+        if self._fn:
+            env = self._env[-1]
+            facts = self._fn[-1]
+            # shard_map boundary: a previously-bound wrapper invoked, or
+            # the immediate `shard_map(...)(args)` form
+            sm = None
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in env["shard_maps"]):
+                sm = env["shard_maps"][node.func.id]
+            elif (isinstance(node.func, ast.Call)
+                    and _is(self.index.resolve(node.func.func),
+                            "shard_map")):
+                in_specs = None
+                for kw in node.func.keywords:
+                    if kw.arg == "in_specs":
+                        in_specs = self._spec_list(kw.value)
+                if in_specs is not None:
+                    sm = {"in": in_specs, "line": node.func.lineno}
+            if sm is not None:
+                facts["boundaries"].append({
+                    "line": node.lineno, "col": node.col_offset,
+                    "decl_line": sm["line"],
+                    "args": self._boundary_args(node, sm["in"]),
+                })
+            # flow edges: bare params / constrained locals into a call
+            callee = _dotted(node.func) or (
+                name if name and "." in name else None)
+            if callee is not None and sm is None:
+                params = facts["params"]
+                flow_args: List[Any] = []
+                interesting = False
+                for arg in node.args:
+                    d: Any = None
+                    if isinstance(arg, ast.Name):
+                        if arg.id in env["constraints"]:
+                            c = env["constraints"][arg.id]
+                            d = {"spec": c["spec"], "line": c["line"],
+                                 "var": arg.id}
+                            interesting = True
+                        elif arg.id in params:
+                            d = {"param": params.index(arg.id)}
+                            interesting = True
+                    flow_args.append(d)
+                if interesting:
+                    facts["flows"].append({
+                        "callee": callee, "line": node.lineno,
+                        "col": node.col_offset, "args": flow_args,
+                    })
+            # donation call sites
+            base = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            binding = self.donate_bindings.get(base or "")
+            if binding is not None and not any(
+                    isinstance(a, ast.Starred) for a in node.args):
+                # a Starred arg shifts every later position by an amount
+                # only known at runtime: donate indices are unmappable
+                donated = []
+                names_at = [_dotted(a) for a in node.args]
+                for i in binding["donate"]:
+                    if i < len(names_at) and names_at[i] is not None:
+                        aliased = names_at.count(names_at[i]) > 1
+                        donated.append({"name": names_at[i],
+                                        "aliased": aliased})
+                if donated:
+                    facts["donate_calls"].append({
+                        "line": node.lineno, "col": node.col_offset,
+                        "end_line": getattr(node, "end_lineno",
+                                            node.lineno),
+                        "binding": base, "decl_line": binding["line"],
+                        "donated": donated,
+                    })
+        self.generic_visit(node)
+
+    def _finish_donations(self, facts: Dict[str, Any],
+                          env: Dict[str, Any]) -> None:
+        """Resolve donate-call conflicts now that every load/store line
+        in the function is known: a donated buffer read after the call,
+        with no re-binding in between, is a use-after-donate."""
+        for dc in facts["donate_calls"]:
+            end = dc.get("end_line", dc["line"])
+            for d in dc["donated"]:
+                if d["aliased"]:
+                    d["conflict_line"] = dc["line"]
+                    d["why"] = "aliased"
+                    continue
+                stores = sorted(env["stores"].get(d["name"], []))
+                loads = sorted(env["loads"].get(d["name"], []))
+                for load_line in loads:
+                    if load_line <= end:
+                        continue  # args of the call itself are not reads
+                    rebound = any(dc["line"] <= s <= load_line
+                                  for s in stores)
+                    if not rebound:
+                        d["conflict_line"] = load_line
+                        d["why"] = "reused"
+                        break
+
+
+def extract_shard_facts(module: str, tree: ast.Module,
+                        index) -> Dict[str, Any]:
+    """Shard facts for one module (see module docstring). `index` is the
+    project-aware alias index already built by extract_module_facts."""
+    v = _ShardVisitor(module, index)
+    v.visit(tree)
+    return {
+        "schema": SHARD_SCHEMA,
+        "consts": v.consts,
+        "spec_consts": v.spec_consts,
+        "axes": v.axes,
+        "specs": v.specs,
+        "functions": v.functions,
+        "jit_decls": v.jit_decls,
+    }

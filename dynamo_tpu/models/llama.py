@@ -284,9 +284,11 @@ def forward(
     if attn_impl == "ring":
         # sequence parallelism: pin activations sharded over the seq mesh
         # axis from the embedding on, so every projection runs on S/n tokens
-        from jax.sharding import NamedSharding, PartitionSpec as _P
+        from jax.sharding import NamedSharding
 
-        h = lax.with_sharding_constraint(h, NamedSharding(mesh, _P(None, "seq", None)))
+        from dynamo_tpu.parallel.mesh import SPEC_SEQ_ACT
+
+        h = lax.with_sharding_constraint(h, NamedSharding(mesh, SPEC_SEQ_ACT))
 
     lora_layers = (lora or {}).get("layers", {})
     if lora_layers and c.is_mla:

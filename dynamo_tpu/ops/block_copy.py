@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.parallel.mesh import AXIS_MODEL, kv_pool_specs
+
 
 def _copy_kernel(idx_ref, src_ref, dst_ref):
     dst_ref[...] = src_ref[...]
@@ -87,7 +89,7 @@ def gather_pages_sharded(
     pool: jax.Array,  # [L, NP, PS, Hk, D], kv-heads sharded over `axis`
     idx: jax.Array,  # [n] int32 page ids, replicated
     mesh,
-    axis: str = "model",
+    axis: str = AXIS_MODEL,
     *,
     head_major: bool = False,
     interpret: bool = False,
@@ -101,9 +103,9 @@ def gather_pages_sharded(
 
     from jax.sharding import PartitionSpec as P
 
-    pool_spec = P(None, None, None, axis, None)
+    pool_spec = kv_pool_specs(axis)
     out_spec = (P(None, None, axis, None, None) if head_major
-                else P(None, None, None, axis, None))
+                else pool_spec)
     fn = jax.shard_map(
         functools.partial(
             gather_pages, head_major=head_major, interpret=interpret
@@ -122,7 +124,7 @@ def scatter_pages_sharded(
     pages: jax.Array,  # [L, n, PS, Hk, D] dense pages (head-sharded or
     #   replicated — GSPMD reshards to match)
     mesh,
-    axis: str = "model",
+    axis: str = AXIS_MODEL,
     *,
     interpret: bool = False,
 ) -> jax.Array:
@@ -130,7 +132,7 @@ def scatter_pages_sharded(
 
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, None, None, axis, None)
+    spec = kv_pool_specs(axis)
     fn = jax.shard_map(
         functools.partial(scatter_pages, interpret=interpret),
         mesh=mesh,

@@ -35,6 +35,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.parallel.mesh import AXIS_MODEL, prefill_attention_specs
+
 NEG_INF = -1e30
 
 
@@ -172,7 +174,7 @@ def prefill_paged_attention_sharded(
     q_len: jax.Array,
     kv_lens: jax.Array,
     mesh,
-    axis_name: str = "model",
+    axis_name: str = AXIS_MODEL,
     window=None,  # traced int32 scalar (see prefill_paged_attention)
     *,
     q_block: int = 128,
@@ -184,11 +186,10 @@ def prefill_paged_attention_sharded(
     model-axis shard runs the kernel over its local kv-heads."""
     from jax.sharding import PartitionSpec as P
 
-    heads = P(None, None, axis_name, None, None)
-    pool = P(None, None, axis_name, None)
+    heads, pool, scales = prefill_attention_specs(axis_name)
     if isinstance(k_pool_l, dict):  # int8 KV: scales [NP, PS, Hk] shard
         # the same head axis
-        pool = {"q": pool, "s": P(None, None, axis_name)}
+        pool = {"q": pool, "s": scales}
     part = functools.partial(
         prefill_paged_attention, q_block=q_block, scale=scale,
         softcap=softcap, interpret=interpret,

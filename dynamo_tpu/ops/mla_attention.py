@@ -29,6 +29,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.parallel.mesh import AXIS_MODEL, SPEC_MLA_LATENT_POOL
+
 NEG_INF = -1e30
 
 
@@ -313,7 +315,7 @@ def prefill_mla_attention_sharded(
     q_len: jax.Array,
     kv_lens: jax.Array,
     mesh,
-    axis_name: str = "model",
+    axis_name: str = AXIS_MODEL,
     *,
     dc: int,
     scale: float,
@@ -332,7 +334,7 @@ def prefill_mla_attention_sharded(
             prefill_mla_attention, dc=dc, scale=scale, interpret=interpret
         ),
         mesh=mesh,
-        in_specs=(P(None, None, axis_name, None), P(None, None, None, None),
+        in_specs=(P(None, None, axis_name, None), SPEC_MLA_LATENT_POOL,
                   P(None, None), P(None), P(None), P(None)),
         out_specs=P(None, None, axis_name, None),
         check_vma=False,
@@ -347,7 +349,7 @@ def decode_mla_attention_sharded(
     page_table: jax.Array,
     kv_lens: jax.Array,
     mesh,
-    axis_name: str = "model",
+    axis_name: str = AXIS_MODEL,
     *,
     dc: int,
     scale: float,
@@ -364,7 +366,7 @@ def decode_mla_attention_sharded(
             decode_mla_attention, dc=dc, scale=scale, interpret=interpret
         ),
         mesh=mesh,
-        in_specs=(P(None, axis_name, None), P(None, None, None, None),
+        in_specs=(P(None, axis_name, None), SPEC_MLA_LATENT_POOL,
                   P(None, None), P(None)),
         out_specs=P(None, axis_name, None),
         check_vma=False,

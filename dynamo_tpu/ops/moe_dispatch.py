@@ -30,6 +30,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dynamo_tpu.parallel.mesh import AXIS_EXPERT, SPEC_REPLICATED, moe_specs
+
 
 def router_topk(logits: jax.Array, k: int, scoring: str = "softmax",
                 norm_topk: bool = True, bias=None, routed_scale: float = 1.0,
@@ -167,7 +169,7 @@ def moe_ep(
     mesh: Mesh,
     n_experts_active: int,
     capacity_factor: float = 2.0,
-    axis: str = "expert",
+    axis: str = AXIS_EXPERT,
     model_axis=None,  # set to "model" for EP x TP expert weights
     scoring: str = "softmax",
     norm_topk: bool = True,
@@ -196,19 +198,20 @@ def moe_ep(
             topk_groups=topk_groups,
         )
 
+    tok_spec, gate_up_spec, down_spec = moe_specs(axis, ma)
     in_specs = [
-        P(axis, None),
-        P(),
-        P(axis, None, ma),  # [n_exp, E, F]: F TP-sharded when ma set
-        P(axis, None, ma),
-        P(axis, ma, None),  # [n_exp, F, E]
+        tok_spec,
+        SPEC_REPLICATED,  # w_router [E, n_exp]
+        gate_up_spec,  # [n_exp, E, F]: F TP-sharded when ma set
+        gate_up_spec,
+        down_spec,  # [n_exp, F, E]
     ]
     args = [x, w_router, we_gate, we_up, we_down]
     if has_bias:
-        in_specs.append(P())
+        in_specs.append(SPEC_REPLICATED)
         args.append(router_bias)
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=P(axis, None)
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=tok_spec
     )
     return fn(*args)
 

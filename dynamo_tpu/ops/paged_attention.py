@@ -33,6 +33,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.parallel.mesh import AXIS_MODEL, attention_specs
+
 NEG_INF = -1e30
 
 
@@ -165,7 +167,7 @@ def decode_paged_attention_sharded(
     page_table: jax.Array,  # [B, MP] replicated
     kv_lens: jax.Array,  # [B] replicated
     mesh,
-    axis_name: str = "model",
+    axis_name: str = AXIS_MODEL,
     window=None,  # traced int32 scalar (see decode_paged_attention)
     *,
     scale=None,
@@ -178,11 +180,10 @@ def decode_paged_attention_sharded(
     block all-reduce happens later in the out-projection as usual)."""
     from jax.sharding import PartitionSpec as P
 
-    heads = P(None, axis_name, None, None)
-    pool = P(None, None, axis_name, None)
+    heads, pool, scales = attention_specs(axis_name)
     if isinstance(k_pool_l, dict):  # int8 KV: scales [NP, PS, Hk] shard
         # the same head axis
-        pool = {"q": pool, "s": P(None, None, axis_name)}
+        pool = {"q": pool, "s": scales}
     rep2 = P(None, None)
     rep1 = P(None)
     part = functools.partial(

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import AXIS_PIPE, SPEC_REPLICATED, pipe_specs
 from dynamo_tpu.models.llama import (
     _write_kv,
     paged_attention_jnp,
@@ -64,7 +65,7 @@ def pp_forward(
     page_table: jax.Array,  # [B, MP]
     kv_lens: jax.Array,  # [B]
     mesh: Mesh,
-    axis: str = "pipe",
+    axis: str = AXIS_PIPE,
     n_microbatches: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, T, V], k_pool, v_pool) — numerically the plain
@@ -82,7 +83,8 @@ def pp_forward(
     hd = c.head_dim
     G = c.n_heads // c.n_kv_heads
 
-    layer_spec = jax.tree.map(lambda _: P(axis), params["layers"])
+    stage_spec = pipe_specs(axis)
+    layer_spec = jax.tree.map(lambda _: stage_spec, params["layers"])
     tied = params.get("lm_head") is None
 
     def body(layers, embed, norm_f, *rest):
@@ -156,12 +158,15 @@ def pp_forward(
         # full hidden states (non-last stages contributed zeros)
         return lax.psum(out, axis), kp, vp
 
+    # embed/norm_f ride replicated BY DESIGN: every stage embeds its own
+    # microbatch locally (a stage-0-only embed would serialize the ramp)
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(layer_spec, P(), P(),
-                  P(axis), P(axis), P(), P(), P(), P()),
-        out_specs=(P(), P(axis), P(axis)),
+        in_specs=(layer_spec, SPEC_REPLICATED, SPEC_REPLICATED,
+                  stage_spec, stage_spec, SPEC_REPLICATED, SPEC_REPLICATED,
+                  SPEC_REPLICATED, SPEC_REPLICATED),
+        out_specs=(SPEC_REPLICATED, stage_spec, stage_spec),
         check_vma=False,
     )
     hidden, kp, vp = fn(

@@ -57,6 +57,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.parallel.mesh import AXIS_MODEL, attention_specs
+
 NEG_INF = -1e30
 
 # decode batch (<=64) + packed chunks (<=32) in one mixed iteration
@@ -348,7 +350,7 @@ def ragged_paged_attention_sharded(
     seg_kv_lens: jax.Array,
     meta: jax.Array,
     mesh,
-    axis_name: str = "model",
+    axis_name: str = AXIS_MODEL,
     window=None,
     *,
     q_block: int = DEFAULT_Q_BLOCK,
@@ -360,10 +362,9 @@ def ragged_paged_attention_sharded(
     model-axis shard runs the kernel over its local kv-heads."""
     from jax.sharding import PartitionSpec as P
 
-    heads = P(None, axis_name, None, None)
-    pool = P(None, None, axis_name, None)
+    heads, pool, scales = attention_specs(axis_name)
     if isinstance(k_pool_l, dict):  # int8 KV: scales [NP, PS, Hk]
-        pool = {"q": pool, "s": P(None, None, axis_name)}
+        pool = {"q": pool, "s": scales}
     part = functools.partial(
         ragged_paged_attention, q_block=q_block, scale=scale,
         softcap=softcap, interpret=interpret,

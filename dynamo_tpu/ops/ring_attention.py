@@ -26,6 +26,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.parallel.mesh import AXIS_SEQ, ring_specs
+
 NEG_INF = -1e30
 
 
@@ -82,7 +84,7 @@ def ring_attention(
     kv_positions: jax.Array,  # [B, S] (use a huge sentinel for padding slots
     #         so no query position reaches them)
     mesh: Mesh,
-    axis_name: str = "seq",
+    axis_name: str = AXIS_SEQ,
     return_stats: bool = False,
 ):
     """Full causal attention over a sequence sharded across `axis_name`.
@@ -91,9 +93,7 @@ def ring_attention(
     fp32 stats for merging with attention over disjoint context."""
     D = q.shape[-1]
     scale = D**-0.5
-    seq = P(None, axis_name)
-    spec_q = P(None, axis_name, None, None, None)
-    spec_kv = P(None, axis_name, None, None)
+    spec_q, spec_kv, seq = ring_specs(axis_name)
 
     fn = jax.shard_map(
         partial(_ring_attention_sharded, axis_name=axis_name, scale=scale),

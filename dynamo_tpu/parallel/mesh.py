@@ -27,6 +27,103 @@ AXIS_SEQ = "seq"
 AXIS_PIPE = "pipe"
 ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_EXPERT, AXIS_SEQ, AXIS_PIPE)
 
+# -- canonical layout tables (the dynshard contract surface) ---------------
+# Every sharded op imports its PartitionSpecs from HERE instead of
+# re-spelling the literals inline: dynlint's DYN-S rules treat these
+# module-level declarations as the reviewed layout contract
+# (docs/static_analysis.md), and the runtime layout guard
+# (runtime/sanitizer.py) diffs live `jax.Array.sharding` against the
+# policy built from the same table. Replication in particular must be
+# spelled with a named constant — an inline `P()` on a large tensor is
+# exactly the silent full-replication DYN-S003 exists to catch.
+
+SPEC_REPLICATED = P()
+
+# ring attention (ops/ring_attention.py): q [B, S, Hk, G, D],
+# k/v [B, S, Hk, D], positions [B, S] — S sharded over the ring axis
+SPEC_RING_Q = P(None, AXIS_SEQ, None, None, None)
+SPEC_RING_KV = P(None, AXIS_SEQ, None, None)
+SPEC_RING_POS = P(None, AXIS_SEQ)
+# sequence-parallel activations [B, S, E] (models/llama.py ring path)
+SPEC_SEQ_ACT = P(None, AXIS_SEQ, None)
+
+# attention wrappers (ops/*_attention.py): flat-token / decode q
+# [T|B, Hk, G, D] and prefill q [B, S, Hk, G, D] shard kv-heads on
+# `model`; per-layer paged KV [NP, PS, Hk, D] + int8 scales [NP, PS, Hk]
+SPEC_HEADS_TOK = P(None, AXIS_MODEL, None, None)
+SPEC_HEADS_BATCH = P(None, None, AXIS_MODEL, None, None)
+SPEC_KV_PAGES = P(None, None, AXIS_MODEL, None)
+SPEC_KV_SCALES = P(None, None, AXIS_MODEL)
+# layer-stacked pools [L, NP, PS, Hk, D] (ops/block_copy.py exports)
+SPEC_KV_POOL = P(None, None, None, AXIS_MODEL, None)
+# MLA latent pool [NP, PS, 1, Dl]: Hk == 1 by construction (the cache is
+# per-token latent, not per-head), so it CANNOT shard kv-heads and is
+# small enough to replicate — deliberately, hence a named declaration
+SPEC_MLA_LATENT_POOL = P(None, None, None, None)
+
+# MoE dispatch (ops/moe_dispatch.py): tokens [T, E] over `expert`,
+# expert weights [n_exp, E, F] EP-sharded (+F on `model` for EP x TP)
+SPEC_MOE_TOKENS = P(AXIS_EXPERT, None)
+SPEC_MOE_GATE_UP = P(AXIS_EXPERT, None, AXIS_MODEL)
+SPEC_MOE_DOWN = P(AXIS_EXPERT, AXIS_MODEL, None)
+
+# pipeline parallel (ops/pipeline_parallel.py): layer-stacked leaves and
+# per-stage KV pools shard their leading [L] axis on `pipe`
+SPEC_PIPE_STAGE = P(AXIS_PIPE)
+
+
+def ring_specs(axis: str = AXIS_SEQ) -> Tuple[P, P, P]:
+    """(q, kv, positions) ring-attention specs for a ring over `axis`."""
+    if axis == AXIS_SEQ:
+        return SPEC_RING_Q, SPEC_RING_KV, SPEC_RING_POS
+    return (P(None, axis, None, None, None), P(None, axis, None, None),
+            P(None, axis))
+
+
+def attention_specs(axis: str = AXIS_MODEL) -> Tuple[P, P, P]:
+    """(heads, kv_pages, kv_scales) for flat-token/decode attention."""
+    if axis == AXIS_MODEL:
+        return SPEC_HEADS_TOK, SPEC_KV_PAGES, SPEC_KV_SCALES
+    return (P(None, axis, None, None), P(None, None, axis, None),
+            P(None, None, axis))
+
+
+def prefill_attention_specs(axis: str = AXIS_MODEL) -> Tuple[P, P, P]:
+    """(heads, kv_pages, kv_scales) for batched [B, S, ...] prefill."""
+    if axis == AXIS_MODEL:
+        return SPEC_HEADS_BATCH, SPEC_KV_PAGES, SPEC_KV_SCALES
+    return (P(None, None, axis, None, None), P(None, None, axis, None),
+            P(None, None, axis))
+
+
+def moe_specs(axis: str = AXIS_EXPERT,
+              model_axis: Optional[str] = None) -> Tuple[P, P, P]:
+    """(tokens, we_gate/we_up, we_down) EP dispatch specs."""
+    if axis == AXIS_EXPERT and model_axis == AXIS_MODEL:
+        return SPEC_MOE_TOKENS, SPEC_MOE_GATE_UP, SPEC_MOE_DOWN
+    return (P(axis, None), P(axis, None, model_axis),
+            P(axis, model_axis, None))
+
+
+def pipe_specs(axis: str = AXIS_PIPE) -> P:
+    """Leading-[L]-axis stage spec for pipeline-parallel leaves."""
+    return SPEC_PIPE_STAGE if axis == AXIS_PIPE else P(axis)
+
+
+def kv_pool_specs(axis: str = AXIS_MODEL) -> P:
+    """Layer-stacked [L, NP, PS, Hk, D] pool spec (block_copy exports)."""
+    return SPEC_KV_POOL if axis == AXIS_MODEL else P(None, None, None,
+                                                     axis, None)
+
+
+def reshard_kv_pages(kv_pages, mesh: Mesh,
+                     spec: P = SPEC_KV_PAGES):
+    """Declared reshard helper for the prefill→decode KV handoff
+    (ROADMAP item 5 seam): moving KV state between role-specialized
+    layouts MUST go through here so the layout change is an explicit,
+    greppable declaration — DYN-S005 exempts tensors it carries."""
+    return jax.device_put(kv_pages, NamedSharding(mesh, spec))
+
 
 @dataclass(frozen=True)
 class MeshConfig:

@@ -333,6 +333,36 @@ def follower_loop(runner, sock: socket.socket) -> None:
 # -- worker-group entrypoint helpers -----------------------------------------
 
 
+def _layout_guard_check(runner) -> str:
+    """Run the strict layout guard over the live engine twice: once on
+    the honest placement (must be clean) and once after seeding a spec
+    drift — silently re-placing one sharded param replicated, exactly
+    the implicit all-gather the guard exists to catch (must raise).
+    Returns a deterministic signature string for the group-parity
+    print."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from dynamo_tpu.parallel.mesh import SPEC_REPLICATED
+    from dynamo_tpu.runtime.sanitizer import Sanitizer, SanitizerViolation
+
+    san = Sanitizer(strict=True, transfer_guard=False, warmup_steps=1)
+    runner.attach_sanitizer(san)
+    checked = san.check_layouts(runner)  # raises on any live mismatch
+    drifted = jax.device_put(
+        runner.params["layers"]["wq"],
+        NamedSharding(runner.mesh, SPEC_REPLICATED),
+    )
+    drifted.block_until_ready()
+    runner.params["layers"]["wq"] = drifted
+    try:
+        san.check_layouts(runner)
+        caught = False
+    except SanitizerViolation as e:
+        caught = "layout" in str(e) and "wq" in str(e)
+    return f"GUARD checked={checked} drift_caught={caught}"
+
+
 def selftest_main(argv=None) -> None:
     """`python -m dynamo_tpu.parallel.multihost --process-id K --num N
     --coordinator H:P` — join an N-process group (1 virtual CPU device
@@ -349,6 +379,11 @@ def selftest_main(argv=None) -> None:
     p.add_argument("--axis", default="model", choices=["model", "pipe"],
                    help="mesh axis the group spans: TP (default) or "
                         "pipeline stages (GPipe serving path)")
+    p.add_argument("--layout-guard", action="store_true",
+                   help="after the serving flow, run the sanitizer's "
+                        "layout guard over the live params/pools (must be "
+                        "clean), then seed one spec drift and require the "
+                        "guard to catch it as a hard violation")
     args = p.parse_args(argv)
 
     spec = MultihostSpec(
@@ -382,7 +417,8 @@ def selftest_main(argv=None) -> None:
         out = runner.decode_multi(3, [tok0], [7], [[0, 1, 2]], s, 3)
         payload = runner.export_pages([0, 1])  # replicated-gather path
         runner.import_pages([3, 4], 0, payload)
-        print(f"MULTIHOST_SELFTEST pipe {[tok0] + out[0].tolist()}",
+        guard = f" {_layout_guard_check(runner)}" if args.layout_guard else ""
+        print(f"MULTIHOST_SELFTEST pipe {[tok0] + out[0].tolist()}{guard}",
               flush=True)
         return
     # ... then the _ex variants (penalties + logprobs), REPLICATED_METHODS
@@ -398,7 +434,8 @@ def selftest_main(argv=None) -> None:
     payload = runner.export_pages([0, 1])  # replicated-gather path
     runner.import_pages([3, 4], 0, payload)
     lp_sig = [round(float(lp1[0]), 4)] + [round(float(v), 4) for v in lp[0][0]]
-    print(f"MULTIHOST_SELFTEST {[tok] + out[0].tolist()} LP {lp_sig}",
+    guard = f" {_layout_guard_check(runner)}" if args.layout_guard else ""
+    print(f"MULTIHOST_SELFTEST {[tok] + out[0].tolist()} LP {lp_sig}{guard}",
           flush=True)
 
 

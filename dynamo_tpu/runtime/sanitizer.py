@@ -232,6 +232,47 @@ class Sanitizer:
         with jax.transfer_guard("allow"):
             yield
 
+    # -- layout guard ------------------------------------------------------
+    def check_layouts(self, runner: Any) -> int:
+        """Diff live ``jax.Array.sharding`` for every row of the runner's
+        statically-derived layout table (ModelRunner.layout_table —
+        ShardingPolicy over parallel/mesh.py's canonical spec tables)
+        against the declared NamedSharding. Any inequivalence is a HARD
+        violation carrying both specs: the array was silently re-placed,
+        which means an implicit reshard/all-gather is hiding in the path
+        that produced it — the dynamic twin of dynlint DYN-S001/S003.
+        Runs once at warm-path entry (note_step), when params and pools
+        are in their steady-state placement. Runners without a
+        layout_table (mocker SimRunner — the whole fleet sim runs
+        jax-free) no-op. Returns the number of rows checked."""
+        # no jax gate needed: the guard only reads attributes the arrays
+        # already carry, and jax-free runners (SimRunner) simply have no
+        # layout_table
+        table_fn = getattr(runner, "layout_table", None)
+        if table_fn is None:
+            return 0
+        checked = 0
+        for name, arr, want in table_fn():
+            live = getattr(arr, "sharding", None)
+            if live is None:
+                continue
+            checked += 1
+            try:
+                same = live.is_equivalent_to(want, arr.ndim)
+            except Exception:
+                same = live == want
+            if not same:
+                self._violation(
+                    "layout",
+                    f"{name}: live sharding {live} diverges from the "
+                    f"declared spec {want.spec} on mesh "
+                    f"{dict(want.mesh.shape)} — the array was silently "
+                    "re-placed (implicit reshard/all-gather) after the "
+                    "policy applied the canonical table",
+                )
+        self.counters["layout_checked"] = checked
+        return checked
+
     # -- recompile tripwire ------------------------------------------------
     def mark_warm(self) -> None:
         self._warm = True
@@ -250,6 +291,10 @@ class Sanitizer:
             if self._steps >= self.warmup_steps:
                 self.mark_warm()
                 self._warm_variants = variants
+                # warm-path entry: params/pools are in steady-state
+                # placement — snapshot and diff their live layouts once
+                if runner is not None:
+                    self.check_layouts(runner)
             return
         for name, n in variants.items():
             base = self._warm_variants.get(name)
@@ -484,4 +529,40 @@ def selftest() -> bool:
         pass
     else:
         raise AssertionError("strict mode did not raise")
+
+    # layout guard plumbing, still jax-free: a runner WITHOUT a
+    # layout_table must no-op (the fleet sim's SimRunner path), and a
+    # mismatched table row must fire a "layout" violation with both
+    # sides in the message
+    class _Placement:
+        def __init__(self, tag):
+            self.tag = tag
+            self.spec = tag
+            self.mesh = type("M", (), {"shape": {}})()
+
+        def is_equivalent_to(self, other, ndim):
+            return self.tag == other.tag
+
+        def __str__(self):
+            return self.tag
+
+    class _Arr:
+        ndim = 2
+
+        def __init__(self, tag):
+            self.sharding = _Placement(tag)
+
+    class _Runner:
+        def layout_table(self):
+            return [("params/good", _Arr("P('model')"),
+                     _Placement("P('model')")),
+                    ("params/drifted", _Arr("P()"),
+                     _Placement("P('model')"))]
+
+    lay = Sanitizer(strict=False, transfer_guard=False)
+    assert lay.check_layouts(object()) == 0, "table-less runner must no-op"
+    assert lay.check_layouts(_Runner()) == 2
+    bad = [v for v in lay.violations if v["kind"] == "layout"]
+    assert len(bad) == 1 and "params/drifted" in bad[0]["message"], \
+        "layout drift not detected"
     return True

@@ -640,6 +640,11 @@ async def async_main(args) -> None:
             status.add_timeline(
                 lambda last_n=None: to_chrome_trace(_rec.snapshot(last_n))
             )
+        _san = getattr(engine, "sanitizer", None)
+        if _san is not None:
+            # GET /debug/sanitizer: violations + counters (layout_checked
+            # proves the DYN-S layout guard ran at the warm transition)
+            status.add_debug("sanitizer", lambda _q: _san.report())
         await status.start()
     from dynamo_tpu.worker_common import serve_worker
 

@@ -65,7 +65,7 @@ def main() -> int:
         "test_kv_tiers.py", "test_session_tree.py", "test_guided.py",
         "test_fleet_sim.py", "test_chaos.py", "test_sanitizer.py",
         "test_dynmc.py", "test_planner_actuator.py",
-        "test_kv_fabric.py",
+        "test_kv_fabric.py", "test_dynshard.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -120,6 +120,32 @@ def main() -> int:
                   "(see docs/static_analysis.md)", file=sys.stderr)
             print(detail.stdout + detail.stderr, file=sys.stderr)
     ok = ok and lint_ok
+
+    shard_ok = True
+    lint_elapsed_s = None
+    if args.lint:
+        # sharding/layout contract gate: the DYN-S project pass must come
+        # back clean (warm cache — the full-pass gate above already paid
+        # the parse cost), and its runtime rides the JSON line so CI can
+        # watch the warm-cache lint budget (<=10s, docs/perf_notes.md)
+        shard_proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dynlint.py"),
+             "--shard", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=args.timeout,
+        )
+        shard_ok = shard_proc.returncode == 0
+        print(shard_proc.stdout, end="")
+        try:
+            lint_elapsed_s = json.loads(
+                shard_proc.stdout.splitlines()[-1]).get("elapsed_s")
+        except (ValueError, IndexError):
+            pass
+        if not shard_ok:
+            print("TIER-1 CHECK FAILED: new DYN-S layout-contract "
+                  "violations (see docs/static_analysis.md)",
+                  file=sys.stderr)
+            print(shard_proc.stdout + shard_proc.stderr, file=sys.stderr)
+    ok = ok and shard_ok
 
     mc_ok = True
     if args.mc:
@@ -185,6 +211,8 @@ def main() -> int:
             "    finally:\n"
             "        engine.stop()\n"
             "    assert engine.sanitizer.ok(), engine.sanitizer.report()\n"
+            "    assert engine.sanitizer.counters.get(\n"
+            "        'layout_checked', 0) > 0, 'layout guard never ran'\n"
             "asyncio.run(main())\n"
             "print('warm-loop-clean')\n"
         )
@@ -220,6 +248,8 @@ def main() -> int:
     print(json.dumps({"metric": "tier1_collection", "ok": ok,
                       "collected": collected, "errors": errors,
                       "missing": missing, "lint_ok": lint_ok,
+                      "shard_ok": shard_ok,
+                      "lint_elapsed_s": lint_elapsed_s,
                       "mc_ok": mc_ok, "sanitizer_ok": sanitizer_ok,
                       "warm_loop_ok": warm_ok}))
     if not ok:

@@ -1,17 +1,19 @@
 """dynlint CLI — AST invariant checker with a baseline ratchet.
 
 Checks async-safety (DYN-A), JAX trace hygiene / compile-key
-cardinality (DYN-J), and runtime robustness (DYN-R) invariants over the
-given paths (default: dynamo_tpu/ AND scripts/), including the
-project-wide interprocedural pass (call-graph taint: DYN-A001/A002/J005
-through helper chains, plus DYN-J006/R007/A006 — see
-docs/static_analysis.md). Violations already recorded in the committed
+cardinality (DYN-J), runtime robustness (DYN-R), and sharding/layout
+contract (DYN-S) invariants over the given paths (default: dynamo_tpu/,
+scripts/, recipes/, and the native/ shims), including the project-wide
+interprocedural pass (call-graph taint: DYN-A001/A002/J005 through
+helper chains, plus DYN-J006/R007/A006, and spec propagation:
+DYN-S001..S005 — see docs/static_analysis.md). Violations already recorded in the committed
 baseline (lint_baseline.json) are legacy debt and pass; any NEW
 violation fails. The ratchet only goes down: when you fix legacy
 findings, run --update-baseline and commit the shrunken file.
 
     python scripts/dynlint.py dynamo_tpu/            # gate (exit 1 on new)
     python scripts/dynlint.py --all                  # list everything
+    python scripts/dynlint.py --shard --all          # layout rules only
     python scripts/dynlint.py --update-baseline      # ratchet the baseline
     python scripts/dynlint.py --json                 # one summary line
 
@@ -25,6 +27,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, REPO)
@@ -57,6 +60,9 @@ def main() -> int:
                     help="print all findings, not just new-vs-baseline")
     ap.add_argument("--no-project", action="store_true",
                     help="skip the interprocedural project pass")
+    ap.add_argument("--shard", action="store_true",
+                    help="report only the sharding/layout contract rules "
+                         "(DYN-S001..S005)")
     ap.add_argument("--no-cache", action="store_true",
                     help="ignore and do not write the mtime result cache")
     ap.add_argument("--cache", default=os.path.join(
@@ -64,14 +70,26 @@ def main() -> int:
                     help="mtime-keyed result cache path")
     args = ap.parse_args()
 
-    paths = args.paths or [os.path.join(REPO, "dynamo_tpu"),
-                           os.path.join(REPO, "scripts")]
+    paths = args.paths or [
+        p for p in (
+            os.path.join(REPO, "dynamo_tpu"),
+            os.path.join(REPO, "scripts"),
+            os.path.join(REPO, "recipes"),
+            os.path.join(REPO, "native"),
+        ) if os.path.isdir(p)
+    ]
     cache_stats: dict = {}
+    t0 = time.monotonic()
     violations = lint_paths(
         paths, root=REPO, project=not args.no_project,
         cache_path=None if args.no_cache else args.cache,
         stats=cache_stats,
     )
+    elapsed_s = round(time.monotonic() - t0, 3)
+    if args.shard:
+        from dynamo_tpu.lint.rules_shard import SHARD_RULE_IDS
+
+        violations = [v for v in violations if v.rule in SHARD_RULE_IDS]
     per_rule: dict = {}
     for v in violations:
         per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
@@ -98,6 +116,7 @@ def main() -> int:
             "baseline_keys": len(baseline), "rules": per_rule,
             "cache_hits": cache_stats.get("cache_hits", 0),
             "cache_misses": cache_stats.get("cache_misses", 0),
+            "elapsed_s": elapsed_s,
         }))
         return 0 if ok else 1
 
@@ -113,7 +132,8 @@ def main() -> int:
               file=sys.stderr)
     else:
         print(f"dynlint: ok — {len(violations)} finding(s), all covered "
-              f"by baseline ({len(fixed)} key(s) improved)"
+              f"by baseline ({len(fixed)} key(s) improved) in {elapsed_s}s "
+              f"({cache_stats.get('cache_hits', 0)} cached)"
               + ("; run --update-baseline to ratchet down" if fixed else ""))
     return 0 if ok else 1
 

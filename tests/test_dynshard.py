@@ -1,0 +1,564 @@
+"""dynshard: sharding/layout contract rules + runtime layout guard.
+
+Static half: fixture-package tests proving every DYN-S rule catches its
+seeded violation (including the interprocedural 2-hop S001 chain and a
+reporting-site suppression), and that editing only a PartitionSpec
+literal invalidates exactly that module's facts-cache entry while the
+untouched modules re-link from cache.
+
+Dynamic half: the sanitizer's layout guard sees zero mismatches on a
+real sharded tiny-model runner, catches a seeded spec drift as a hard
+violation, rides the engine's warm transition without perturbing tokens,
+and (when this jaxlib supports multi-process CPU computations) holds
+across a 2-process jax.distributed mesh via the multihost selftest's
+--layout-guard flag.
+"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dynamo_tpu.lint import diff_against_baseline, lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_pkg(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path / "pkg")
+
+
+def _plint(tmp_path, files, **kw):
+    return lint_paths([_write_pkg(tmp_path, files)], root=str(tmp_path), **kw)
+
+
+def _srules(vs):
+    return [v.rule for v in vs if v.rule.startswith("DYN-S")]
+
+
+# -- DYN-S001: spec mismatch at a call boundary -----------------------------
+
+
+_S001_DIRECT = {
+    "pkg/__init__.py": "",
+    "pkg/ops.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+
+        def _kernel(x):
+            return x
+
+
+        def run(x, mesh):
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("data", None)))
+            f = shard_map(_kernel, mesh=mesh, in_specs=(P("model", None),),
+                          out_specs=P("model", None))
+            return f(x)
+    """,
+}
+
+
+def test_s001_direct_boundary_mismatch(tmp_path):
+    vs = [v for v in _plint(tmp_path, _S001_DIRECT)
+          if v.rule == "DYN-S001"]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.path == "pkg/ops.py"
+    # both specs and the file:line of each side ride the message
+    assert "P('data', None)" in v.message
+    assert "P('model', None)" in v.message
+    assert "pkg/ops.py:" in v.message
+    assert "reshard" in v.message
+
+
+# 2-hop propagation: the declaration lives two helper calls away from the
+# constraint — invisible to any per-file pass.
+_S001_CHAIN = {
+    "pkg/__init__.py": "",
+    "pkg/kernels.py": """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+
+        def _body(kv_pages):
+            return kv_pages
+
+
+        def launch(kv_pages, mesh):
+            f = shard_map(_body, mesh=mesh, in_specs=(P(None, "model"),),
+                          out_specs=P(None, "model"))
+            return f(kv_pages)
+    """,
+    "pkg/mid.py": """
+        from . import kernels
+
+
+        def stage(kv_pages, mesh):
+            return kernels.launch(kv_pages, mesh)
+    """,
+    "pkg/svc.py": """
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from . import mid
+
+
+        def run(kv_pages, mesh):
+            kv_pages = jax.device_put(
+                kv_pages, NamedSharding(mesh, P("data", None)))
+            return mid.stage(kv_pages, mesh)
+    """,
+}
+
+
+def test_s001_two_hop_interprocedural_chain(tmp_path):
+    vs = [v for v in _plint(tmp_path, _S001_CHAIN)
+          if v.rule == "DYN-S001"]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.path == "pkg/svc.py"  # reported where the caller diverges
+    # full propagation chain, one file:line per hop: constraint ->
+    # forwarding helper -> boundary owner -> declaration site
+    assert "`kv_pages` constrained to P('data', None)" in v.message
+    assert "mid.stage (pkg/svc.py:" in v.message
+    assert "kernels.launch (pkg/mid.py:" in v.message
+    assert "declared P(None, 'model') (pkg/kernels.py:" in v.message
+
+
+def test_s001_chain_invisible_to_per_file_pass(tmp_path):
+    assert _srules(_plint(tmp_path, _S001_CHAIN, project=False)) == []
+
+
+def test_s001_matching_specs_are_clean(tmp_path):
+    files = dict(_S001_CHAIN)
+    files["pkg/svc.py"] = files["pkg/svc.py"].replace(
+        'P("data", None)', 'P(None, "model")')
+    assert _srules(_plint(tmp_path, files)) == []
+
+
+# -- DYN-S002: spec references an undefined mesh axis -----------------------
+
+
+_S002_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/meshdef.py": """
+        from jax.sharding import Mesh
+
+        AXIS_DATA = "data"
+        AXIS_MODEL = "model"
+
+
+        def make(devs):
+            return Mesh(devs, (AXIS_DATA, AXIS_MODEL))
+    """,
+    "pkg/specs.py": """
+        from jax.sharding import PartitionSpec as P
+
+
+        def good():
+            return P("data", "model")
+
+
+        def typo():
+            return P("data", "modle")
+    """,
+}
+
+
+def test_s002_unknown_axis_fires_and_names_defined_set(tmp_path):
+    vs = [v for v in _plint(tmp_path, _S002_PKG) if v.rule == "DYN-S002"]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.path == "pkg/specs.py"
+    assert "'modle'" in v.message
+    assert "data, model" in v.message  # the defined axes, for the fix
+    assert "replicate" in v.message
+
+
+def test_s002_silent_when_no_mesh_constructor_in_scope(tmp_path):
+    files = {k: v for k, v in _S002_PKG.items() if "meshdef" not in k}
+    assert _srules(_plint(tmp_path, files)) == []
+
+
+# -- DYN-S003: large tensor enters a specced scope replicated inline --------
+
+
+_S003_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/apply.py": """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+
+        def _kern(w_params, x):
+            return x
+
+
+        def apply(w_params, x, mesh):
+            f = shard_map(_kern, mesh=mesh,
+                          in_specs=(P(None, None), P("data", None)),
+                          out_specs=P("data", None))
+            return f(w_params, x)
+    """,
+}
+
+
+def test_s003_inline_replicated_large_tensor(tmp_path):
+    vs = [v for v in _plint(tmp_path, _S003_PKG) if v.rule == "DYN-S003"]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.path == "pkg/apply.py"
+    assert "`w_params`" in v.message
+    assert "SPEC_REPLICATED" in v.message  # points at the canonical table
+
+
+def test_s003_table_ref_is_a_declared_decision(tmp_path):
+    files = dict(_S003_PKG)
+    files["pkg/apply.py"] = """
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        SPEC_REPLICATED = P(None, None)
+
+
+        def _kern(w_params, x):
+            return x
+
+
+        def apply(w_params, x, mesh):
+            f = shard_map(_kern, mesh=mesh,
+                          in_specs=(SPEC_REPLICATED, P("data", None)),
+                          out_specs=P("data", None))
+            return f(w_params, x)
+    """
+    assert _srules(_plint(tmp_path, files)) == []
+
+
+def test_s003_suppression_at_reporting_site(tmp_path):
+    files = dict(_S003_PKG)
+    files["pkg/apply.py"] = files["pkg/apply.py"].replace(
+        "return f(w_params, x)",
+        "return f(w_params, x)  # dynlint: disable=DYN-S003 — tiny model")
+    assert _srules(_plint(tmp_path, files)) == []
+
+
+# -- DYN-S004: donate_argnums conflicts -------------------------------------
+
+
+_S004_REUSED = {
+    "pkg/__init__.py": "",
+    "pkg/donate.py": """
+        import jax
+
+
+        def _update(kv_pool, delta):
+            return kv_pool + delta
+
+        step = jax.jit(_update, donate_argnums=(0,))
+
+
+        def tick(kv_pool, delta):
+            out = step(kv_pool, delta)
+            return out + kv_pool.sum()
+    """,
+}
+
+
+def test_s004_use_after_donate(tmp_path):
+    vs = [v for v in _plint(tmp_path, _S004_REUSED)
+          if v.rule == "DYN-S004"]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.path == "pkg/donate.py"
+    assert "`kv_pool`" in v.message and "read at" in v.message
+    assert "`step`" in v.message and "garbage" in v.message
+
+
+def test_s004_aliased_donated_argument(tmp_path):
+    files = dict(_S004_REUSED)
+    files["pkg/donate.py"] = files["pkg/donate.py"].replace(
+        "out = step(kv_pool, delta)\n            return out + kv_pool.sum()",
+        "return step(kv_pool, kv_pool)")
+    vs = [v for v in _plint(tmp_path, files) if v.rule == "DYN-S004"]
+    assert len(vs) == 1
+    assert "passed twice" in vs[0].message
+    assert "aliases another argument" in vs[0].message
+
+
+def test_s004_rebind_after_donation_is_clean(tmp_path):
+    files = dict(_S004_REUSED)
+    files["pkg/donate.py"] = files["pkg/donate.py"].replace(
+        "out = step(kv_pool, delta)\n            return out + kv_pool.sum()",
+        "kv_pool = step(kv_pool, delta)\n            return kv_pool.sum()")
+    assert _srules(_plint(tmp_path, files)) == []
+
+
+# -- DYN-S005: prefill/decode role divergence -------------------------------
+
+
+_S005_PKG = {
+    "pkg/__init__.py": "",
+    "pkg/roles.py": """
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+
+        def prefill_attn(kv_pool, mesh):
+            kv_pool = jax.lax.with_sharding_constraint(
+                kv_pool, NamedSharding(mesh, P(None, "model")))
+            return kv_pool
+
+
+        def decode_attn(kv_pool, mesh):
+            kv_pool = jax.lax.with_sharding_constraint(
+                kv_pool, NamedSharding(mesh, P("model", None)))
+            return kv_pool
+    """,
+}
+
+
+def test_s005_role_divergence_across_the_seam(tmp_path):
+    vs = [v for v in _plint(tmp_path, _S005_PKG) if v.rule == "DYN-S005"]
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.path == "pkg/roles.py"
+    assert "`kv_pool`" in v.message
+    assert "P(None, 'model')" in v.message
+    assert "P('model', None)" in v.message
+    assert "prefill" in v.message and "decode" in v.message
+
+
+def test_s005_declared_reshard_helper_exempts(tmp_path):
+    files = dict(_S005_PKG)
+    files["pkg/roles.py"] += textwrap.dedent("""
+
+        def reshard_kv_for_decode(kv_pool, mesh):
+            return jax.device_put(
+                kv_pool, NamedSharding(mesh, P("model", None)))
+    """)
+    assert _srules(_plint(tmp_path, files)) == []
+
+
+def test_s005_activation_names_are_not_seam_tensors(tmp_path):
+    files = dict(_S005_PKG)
+    files["pkg/roles.py"] = files["pkg/roles.py"].replace("kv_pool", "q")
+    assert _srules(_plint(tmp_path, files)) == []
+
+
+# -- facts cache: a spec-literal edit invalidates exactly one module --------
+
+
+def test_cache_spec_edit_invalidates_only_that_module(tmp_path):
+    """Satellite 3: shard facts ride the mtime-keyed cache. Editing only
+    a PartitionSpec literal must miss that module's entry on the next
+    run while every untouched module re-links its project findings from
+    cache — and the S001 verdict must flip with the edit."""
+    cache = str(tmp_path / "cache.json")
+    n_files = len(_S001_CHAIN)  # __init__ + kernels + mid + svc
+    pkg = _write_pkg(tmp_path, _S001_CHAIN)  # write ONCE: mtimes must hold
+
+    s1 = {}
+    vs1 = lint_paths([pkg], root=str(tmp_path), cache_path=cache, stats=s1)
+    assert s1 == {"cache_hits": 0, "cache_misses": n_files}
+    assert [v.rule for v in vs1 if v.rule == "DYN-S001"]
+
+    s2 = {}
+    vs2 = lint_paths([pkg], root=str(tmp_path), cache_path=cache, stats=s2)
+    assert s2 == {"cache_hits": n_files, "cache_misses": 0}
+    assert ([(v.rule, v.path, v.line) for v in vs1]
+            == [(v.rule, v.path, v.line) for v in vs2])
+
+    # edit ONLY the boundary's PartitionSpec literal so the declared spec
+    # now matches the caller's constraint
+    fixed = _S001_CHAIN["pkg/kernels.py"].replace(
+        'P(None, "model")', 'P("data", None)')
+    (tmp_path / "pkg" / "kernels.py").write_text(textwrap.dedent(fixed))
+    s3 = {}
+    vs3 = lint_paths([str(tmp_path / "pkg")], root=str(tmp_path),
+                     cache_path=cache, stats=s3)
+    assert s3 == {"cache_hits": n_files - 1, "cache_misses": 1}
+    assert [v.rule for v in vs3 if v.rule == "DYN-S001"] == []
+
+
+# -- whole-repo cleanliness: the shipped tree holds its own contract --------
+
+
+def test_repo_tree_is_dynshard_clean():
+    """The burned-down tree: zero DYN-S findings outside the baseline
+    (which is empty), over the full default lint scope."""
+    paths = [p for p in (
+        os.path.join(REPO, "dynamo_tpu"),
+        os.path.join(REPO, "scripts"),
+        os.path.join(REPO, "recipes"),
+        os.path.join(REPO, "native"),
+    ) if os.path.isdir(p)]
+    vs = [v for v in lint_paths(paths, root=REPO)
+          if v.rule.startswith("DYN-S")]
+    new, regressed, _fixed = diff_against_baseline(vs, {})
+    assert not new and not regressed, "\n".join(
+        f"{v.path}:{v.line} {v.rule} {v.message}" for v in new + regressed)
+
+
+# -- runtime layout guard: static table vs live jax.Array.sharding ----------
+
+
+def test_layout_guard_clean_on_sharded_runner_then_catches_drift():
+    """The static↔runtime handshake on a real TP=2 tiny model (two of
+    the 8 virtual CPU devices): every param/KV-pool row must match the
+    policy's declared spec, then one silently re-placed param (the
+    implicit all-gather S-rules guard against) must raise a hard
+    violation naming both specs."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.parallel.mesh import SPEC_REPLICATED, MeshConfig
+    from dynamo_tpu.runtime.sanitizer import Sanitizer, SanitizerViolation
+
+    runner = ModelRunner(
+        get_config("tiny"), MeshConfig(model=2),
+        num_pages=32, page_size=4, max_pages_per_seq=8,
+        decode_buckets=(1, 2), prefill_buckets=(8,), seed=0,
+    )
+    san = Sanitizer(strict=True, transfer_guard=False, warmup_steps=1)
+    runner.attach_sanitizer(san)
+    checked = san.check_layouts(runner)
+    assert checked > 0 and san.ok()
+
+    drifted = jax.device_put(
+        runner.params["layers"]["wq"],
+        NamedSharding(runner.mesh, SPEC_REPLICATED),
+    )
+    drifted.block_until_ready()
+    runner.params["layers"]["wq"] = drifted
+    with pytest.raises(SanitizerViolation) as ei:
+        san.check_layouts(runner)
+    msg = str(ei.value)
+    assert "layout" in msg and "params/layers/wq" in msg
+    assert "diverges from the declared spec" in msg
+
+
+async def test_layout_guard_rides_engine_and_does_not_perturb_tokens():
+    """The guard arms automatically at the engine's warm transition
+    (note_step) and must observe without perturbing: tokens with the
+    sanitizer attached are byte-identical to the sanitizer-off run."""
+    from dynamo_tpu.engine.engine import InferenceEngine
+    from dynamo_tpu.engine.model_runner import ModelRunner
+    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.sanitizer import Sanitizer
+
+    runner = ModelRunner(
+        get_config("tiny"), num_pages=64, page_size=4,
+        max_pages_per_seq=16, decode_buckets=(1, 2, 4),
+        prefill_buckets=(8, 16), seed=3,
+    )
+    prompts = [[5, 6, 7, 8, 9], [9, 8, 7, 6, 5]]
+
+    def req(p):
+        return {"token_ids": p,
+                "sampling": {"temperature": 0.0, "seed": 0},
+                "stop": {"max_tokens": 6, "stop_ids": []}}
+
+    async def collect(engine, p):
+        toks = []
+        async for item in engine.generate(req(p), Context()):
+            toks.extend(item["token_ids"])
+        return toks
+
+    eng_off = InferenceEngine(runner, max_batch=4, chunk_size=16)
+    assert eng_off.sanitizer is None
+    eng_off.start()
+    try:
+        baseline = [await collect(eng_off, p) for p in prompts]
+    finally:
+        eng_off.stop()
+    assert all(len(t) == 6 for t in baseline)
+
+    san = Sanitizer(strict=True, warmup_steps=3)
+    eng_on = InferenceEngine(runner, max_batch=4, chunk_size=16,
+                             sanitizer=san)
+    eng_on.start()
+    try:
+        await collect(eng_on, [4, 4, 4, 4, 4])  # warm the buckets
+        guarded = [await collect(eng_on, p) for p in prompts]
+    finally:
+        eng_on.stop()
+
+    assert guarded == baseline  # byte-identical token streams
+    assert san.ok(), san.report()
+    assert san.counters.get("layout_checked", 0) > 0, (
+        "layout guard never ran at the warm transition")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def test_two_process_mesh_layout_guard(tmp_path):
+    """2-process jax.distributed group (TP=2, 1 CPU device each) running
+    the real tiny-model selftest with --layout-guard: the live layout
+    check must be clean (a mismatch raises, failing the process), the
+    seeded spec drift must be caught, and both ranks must print the
+    identical signature line."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.parallel.multihost",
+             "--process-id", str(k), "--num", "2",
+             "--coordinator", f"127.0.0.1:{port}", "--layout-guard"],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for k in range(2)
+    ]
+    try:
+        loop = asyncio.get_running_loop()
+        outs = await asyncio.wait_for(
+            asyncio.gather(*[
+                loop.run_in_executor(None, p.communicate) for p in procs
+            ]),
+            timeout=300,
+        )
+        joined = "".join(out for out, _ in outs)
+        if "Multiprocess computations aren't implemented" in joined:
+            pytest.skip("this jaxlib cannot run multi-process CPU "
+                        "computations (same limitation as the seed's "
+                        "multihost selftests)")
+        lines = []
+        for p, (out, _) in zip(procs, outs):
+            assert p.returncode == 0, out
+            sig = [l for l in out.splitlines()
+                   if "MULTIHOST_SELFTEST" in l]
+            assert sig, out
+            assert "GUARD checked=" in sig[0], sig[0]
+            assert "drift_caught=True" in sig[0], sig[0]
+            lines.append(sig[0])
+        assert len(set(lines)) == 1, lines
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
