@@ -48,6 +48,7 @@ from dynamo_tpu.frontend.protocols import engine_output
 from dynamo_tpu.runtime.annotations import annotate
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.flight_recorder import FlightRecorder, IterationRecord
+from dynamo_tpu.runtime import tracing
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -725,6 +726,12 @@ class InferenceEngine:
                 k: float(v) for k, v in upstream.items()
                 if isinstance(v, (int, float))
             })
+        # causal trace: remember the route span this request arrived
+        # under; the step thread reconstructs the worker's phase spans
+        # from the spine at finish (see _emit_worker_spans)
+        tp = context.metadata.get("traceparent")
+        if isinstance(tp, str):
+            seq.tp = tp
         if context.metadata.get("migration_attempt"):
             seq.phases["migration_attempts"] = float(
                 context.metadata["migration_attempt"])
@@ -1167,6 +1174,14 @@ class InferenceEngine:
             self._rec_prev_charged = cum
             if delta > 0:
                 charged = delta
+        trace_ids: List[str] = []
+        if tracing.enabled():
+            # bounded join key: the traces this iteration served (string
+            # parses over <=8 cached traceparents — step-thread cheap)
+            for s in self.scheduler.active[:8]:
+                pctx = tracing.parse_traceparent(s.tp)
+                if pctx is not None and pctx.trace_id not in trace_ids:
+                    trace_ids.append(pctx.trace_id)
         rec.append(IterationRecord(
             seq=self._step_counter,
             ts=ts,
@@ -1194,6 +1209,7 @@ class InferenceEngine:
             guided_rows=rinfo.get("guided_rows", 0),
             tree_hit_blocks=self.pool.match_hit_blocks,
             forks=self.pool.forks,
+            trace_ids=trace_ids,
         ))
 
     def _recover_poisoned_pools(self) -> None:
@@ -2498,6 +2514,16 @@ class InferenceEngine:
                 phases["e2e_s"] = max(0.0, time.monotonic() - seq.arrival)
             if seq.itl:
                 phases["itl_s"] = list(seq.itl)
+            pctx = tracing.parse_traceparent(seq.tp)
+            if pctx is not None:
+                # trace id rides the spine so digests / incident bundles
+                # can join aggregates back to individual traces
+                phases["trace_id"] = pctx.trace_id
+            try:
+                self._emit_worker_spans(seq, phases,
+                                        item.get("finish_reason"))
+            except Exception:  # pragma: no cover
+                log.exception("worker span synthesis failed")
             item.setdefault("phases", phases)
             for cb in self._phase_listeners:
                 try:
@@ -2513,6 +2539,56 @@ class InferenceEngine:
             return
         out, loop = entry
         loop.call_soon_threadsafe(out.put_nowait, item)
+
+    def _emit_worker_spans(self, seq: Sequence, phases: Dict[str, Any],
+                           finish: str) -> None:
+        """Synthesize the worker's phase spans retroactively at finish.
+
+        The phase spine measures durations on the step thread; only at
+        the final item is the whole story known, so the spans are
+        reconstructed from (now - e2e) backwards instead of holding live
+        spans open across engine iterations: queue -> kv_onboard
+        (tier-labeled) -> prefill -> stream, all children of one
+        worker.request span parented on the route span's traceparent."""
+        if seq.tp is None or not tracing.enabled():
+            return
+        e2e = float(phases.get("e2e_s") or 0.0)
+        if e2e <= 0.0:
+            return
+        end_ns = time.time_ns()
+        t0 = end_ns - int(e2e * 1e9)
+        root = tracing.record_span(
+            "worker.request", t0, end_ns, parent=seq.tp,
+            attributes={
+                "request.id": seq.request_id,
+                "finish_reason": finish,
+                "n_tokens": len(seq.tokens),
+                "preemptions": seq.n_preemptions,
+            })
+        if root is None:
+            return
+        wtp = root.traceparent
+        qw = max(0.0, float(phases.get("queue_wait_s") or 0.0))
+        ob = max(0.0, float(phases.get("kv_onboard_s") or 0.0))
+        ttft = max(qw + ob, float(phases.get("ttft_s") or 0.0))
+        # clamp each cut into [t0, end_ns] — clock skew between the
+        # spine's monotonic stamps and this wall-clock anchor must not
+        # produce a child escaping its parent
+        cut = [min(end_ns, t0 + int(s * 1e9))
+               for s in (qw, qw + ob, ttft)]
+        attrs = {"request.id": seq.request_id}
+        tracing.record_span("worker.queue", t0, cut[0], parent=wtp,
+                            attributes=attrs)
+        if ob > 0.0:
+            tracing.record_span(
+                "worker.kv_onboard", cut[0], cut[1], parent=wtp,
+                attributes=dict(attrs, **{
+                    "kv.tier": seq.onboard_tier or "G2"}))
+        tracing.record_span("worker.prefill", cut[1], cut[2], parent=wtp,
+                            attributes=attrs)
+        tracing.record_span(
+            "worker.stream", cut[2], end_ns, parent=wtp,
+            attributes=dict(attrs, n_itl_samples=len(seq.itl)))
 
     # -- disagg export (called from the asyncio side) -----------------------
     async def export_host_blocks(self, hashes: List[int]) -> Dict[str, Any]:
@@ -2674,7 +2750,8 @@ class InferenceEngine:
                     tier="host")
         )
 
-    def _onboard_from_host(self, pages: List[int], hashes: List[int]) -> bool:
+    def _onboard_from_host(self, pages: List[int], hashes: List[int],
+                           seq: Optional[Sequence] = None) -> bool:
         """Host-tier blocks → device pages during admission. Returns False
         when a matched block was evicted between match and get (lower-tier
         LRU churn under memory pressure) — the scheduler then recomputes
@@ -2695,6 +2772,13 @@ class InferenceEngine:
         tiers = (self.host_pool.residency(hashes)
                  if hasattr(self.host_pool, "residency")
                  else ["host"] * len(hashes))
+        if seq is not None and tiers:
+            # deepest rung dominates the transfer — it labels the
+            # worker.kv_onboard span (same attribution as the EWMA)
+            order = {"host": 0, "disk": 1, "obj": 2}
+            label = {"host": "G2", "disk": "G3", "obj": "G4"}
+            deepest = max(tiers, key=lambda t: order.get(t, -1))
+            seq.onboard_tier = label.get(deepest, deepest)
         groups = self.onboard_layer_groups
         t0 = time.perf_counter()
         try:

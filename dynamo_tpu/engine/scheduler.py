@@ -81,6 +81,13 @@ class Sequence:
     # phase durations, seeded with upstream-hop stamps from ctx.metadata
     # and attached to the final emitted item as item["phases"]
     phases: Dict[str, float] = field(default_factory=dict)
+    # causal trace: the traceparent this request arrived with (route
+    # span); the engine synthesizes the worker's queue/onboard/prefill/
+    # stream spans under it retroactively at finish
+    tp: Optional[str] = None
+    # deepest KV tier the admission onboard touched (G2/G3/G4) — labels
+    # the worker.kv_onboard span
+    onboard_tier: Optional[str] = None
     itl: List[float] = field(default_factory=list)  # bounded ITL samples
     t_last_emit: float = 0.0  # monotonic time of the last token emission
     # speculative decoding: draft tokens proposed for THIS iteration
@@ -172,7 +179,7 @@ class Scheduler:
         mixed_prefill_seqs: int = 8,
         mixed_min_chunk: int = 16,
         host_tier=None,  # HostKvPool-like: .match(hashes) -> n
-        host_onboard=None,  # cb(pages, hashes) -> bool (imports G2→G1 data)
+        host_onboard=None,  # cb(pages, hashes, seq=None) -> bool (G2→G1)
         max_seq_tokens: int = 0,  # model context length (0 = page cap only)
         spec_max_tokens: int = 0,  # per-iteration cap on speculative
         #   draft tokens (0 = bounded by the mixed pool leftover alone)
@@ -424,7 +431,7 @@ class Scheduler:
 
         if host_n:
             t_onboard = time.monotonic()
-            if self.host_onboard(fresh[:host_n], host_hashes):
+            if self.host_onboard(fresh[:host_n], host_hashes, seq):
                 # latency spine: lower-tier KV promotion paid at admission
                 seq.phases["kv_onboard_s"] = (
                     seq.phases.get("kv_onboard_s", 0.0)

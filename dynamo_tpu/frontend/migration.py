@@ -98,6 +98,7 @@ class Migration:
                                 item, root, t_dispatch,
                                 attempts=self.migration_limit - retries_left,
                             )
+                            self._maybe_tail(item, context)
                         yield item
                     return
                 except RequestPlaneError as e:
@@ -114,6 +115,11 @@ class Migration:
                     # stamps the phases (goodput joins on it)
                     ph["migration_attempts"] = attempts
                     root.add_event("migration", {"attempt": attempts})
+                    # tail-based sampling: a migrated request is always
+                    # interesting — set the tail-keep bit on the metadata
+                    # traceparent so every retry hop's spans inherit it
+                    # (the ring keeps the WHOLE trace, early spans included)
+                    tracing.mark_tail(context.metadata)
                     request = self._replay_request(request, accumulated)
                     n_replayed = len(accumulated)
                     accumulated = []  # folded into the replayed prompt
@@ -147,6 +153,42 @@ class Migration:
         for key, val in phases.items():
             if isinstance(val, (int, float)):
                 root.add_event(f"phase.{key}", {"seconds": float(val)})
+
+    @staticmethod
+    def _maybe_tail(item: Dict[str, Any], context: Context) -> None:
+        """Finish-time tail marking: migrated requests and SLO-threshold
+        excursions (DYN_TRACE_TAIL_TTFT_S / DYN_TRACE_TAIL_E2E_S, seconds)
+        must survive sampling. A zero-length marker span carries the
+        inherited tail flag into the span ring — late marking works
+        because the ring samples at read time."""
+        import os
+
+        phases = item.get("phases") or {}
+        reason = None
+        if phases.get("migration_attempts"):
+            reason = "migration"
+        elif item.get("finish_reason") == "error":
+            reason = "error"
+        else:
+            for env, key in (("DYN_TRACE_TAIL_TTFT_S", "ttft_s"),
+                             ("DYN_TRACE_TAIL_E2E_S", "e2e_s")):
+                raw = os.environ.get(env)
+                if not raw:
+                    continue
+                try:
+                    if float(phases.get(key) or 0.0) > float(raw):
+                        reason = f"{key}_excursion"
+                        break
+                except ValueError:
+                    continue
+        if reason is None:
+            return
+        tp = tracing.mark_tail(context.metadata)
+        if tp is not None:
+            now = time.time_ns()
+            tracing.record_span(
+                "trace.tail", now, now, parent=tp,
+                attributes={"reason": reason, "request.id": context.id})
 
     @staticmethod
     def _replay_request(request: Dict[str, Any], accumulated: list[int]) -> Dict[str, Any]:

@@ -48,6 +48,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
+from dynamo_tpu.runtime import tracing
+
 from .quant import pair_nbytes, quantized_ratio
 
 log = logging.getLogger("dynamo_tpu.kvbm.prefetch")
@@ -59,7 +61,8 @@ PROMOTED = "promoted"    # registered + pinned in the device pool
 
 
 class _Job:
-    __slots__ = ("h", "parent", "state", "t0", "deadline", "pin_deadline")
+    __slots__ = ("h", "parent", "state", "t0", "deadline", "pin_deadline",
+                 "tp")
 
     def __init__(self, h: int, parent: Optional[int], t0: float, deadline: float):
         self.h = h
@@ -68,6 +71,7 @@ class _Job:
         self.t0 = t0
         self.deadline = deadline
         self.pin_deadline = 0.0
+        self.tp = None  # traceparent of the hinting route span, if any
 
 
 class PrefetchManager:
@@ -159,12 +163,14 @@ class PrefetchManager:
             return
         self.stats["hints"] += 1
         now = self._clock()
+        hint_tp = hint.get("traceparent")
         for i, h in enumerate(hashes):
             if h in self._jobs or h in self.pool.by_hash:
                 continue  # already warm or already being promoted
             parent = parents[i] if i < len(parents) else None
             parent = int(parent) if parent is not None else None
             job = _Job(h, parent, now, now + self.hint_ttl_s)
+            job.tp = hint_tp
             if h in self._reading:
                 # a TTL-expired job's disk read is still in flight: adopt
                 # it instead of queueing a second read. Double-dispatch is
@@ -374,6 +380,15 @@ class PrefetchManager:
         self.stats["bytes_promoted_g2"] += nbytes
         self.stats["promote_latency_sum_s"] += now - job.t0
         self._m_bytes.inc(nbytes)
+        if job.tp is not None:
+            # promotions span several engine ticks; reconstruct the
+            # interval retroactively under the route span that hinted it
+            end_ns = time.time_ns()
+            start_ns = end_ns - max(0, int((now - job.t0) * 1e9))
+            tracing.record_span(
+                "kv.prefetch.promote", start_ns, end_ns, parent=job.tp,
+                attributes={"kv.block_hash": h, "kv.tier": "G2->G1",
+                            "kv.bytes": nbytes})
 
     # -- accounting hooks ----------------------------------------------------
     def _on_claim(self, h: int) -> None:

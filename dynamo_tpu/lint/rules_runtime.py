@@ -296,6 +296,87 @@ class MigrationAwaitHygiene(Rule):
                        "handle cancellation separately")
 
 
+# A tracing span is a scope, not a value: `tracing.span(...)` returns a
+# context manager, and only `with` (or an ExitStack.enter_context, which
+# is `with` with the scope hoisted) guarantees the span is closed —
+# exported to the ring, error recorded, duration stamped — on EVERY exit
+# edge, including the exception and cancellation ones. A span call that
+# is never entered silently records nothing; one entered by hand
+# (`__enter__` without try/finally) leaks open on the error path, which
+# is exactly the path forensics needs the span for.
+_SPAN_CALL_RE = re.compile(r"(^|\.)tracing\.span$")
+
+
+class SpanScopeLeak(Rule):
+    id = "DYN-R009"
+    description = ("tracing span not scoped by `with`/enter_context "
+                   "(never closes on exception exit edges)")
+
+    def _is_span_call(self, ctx: LintContext, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        resolved = ctx.resolve(node.func)
+        return bool(resolved and _SPAN_CALL_RE.search(resolved))
+
+    def check_function(self, ctx: LintContext, scope) -> None:
+        span_calls: List[ast.Call] = []
+        safe: set = set()          # id() of span calls with a safe scope
+        assigned: Dict[str, List[ast.Call]] = {}  # name -> its span calls
+        safe_names: set = set()    # names entered/propagated somewhere
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # nested defs get their own check_function
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        ce = item.context_expr
+                        if self._is_span_call(ctx, ce):
+                            safe.add(id(ce))
+                        elif isinstance(ce, ast.Name):
+                            safe_names.add(ce.id)
+                elif isinstance(child, ast.Call):
+                    if self._is_span_call(ctx, child):
+                        span_calls.append(child)
+                    fn = child.func
+                    if (isinstance(fn, ast.Attribute)
+                            and fn.attr == "enter_context" and child.args):
+                        arg = child.args[0]
+                        if self._is_span_call(ctx, arg):
+                            safe.add(id(arg))
+                        elif isinstance(arg, ast.Name):
+                            safe_names.add(arg.id)
+                elif isinstance(child, ast.Assign):
+                    if (self._is_span_call(ctx, child.value)
+                            and len(child.targets) == 1
+                            and isinstance(child.targets[0], ast.Name)):
+                        assigned.setdefault(
+                            child.targets[0].id, []).append(child.value)
+                elif isinstance(child, ast.Return):
+                    # returning the unopened cm propagates the scoping
+                    # duty to the caller — their `with` closes it
+                    if isinstance(child.value, ast.Name):
+                        safe_names.add(child.value.id)
+                    elif self._is_span_call(ctx, child.value):
+                        safe.add(id(child.value))
+                visit(child)
+
+        visit(scope.node)
+        for name in safe_names:
+            for call in assigned.get(name, ()):
+                safe.add(id(call))
+        for call in span_calls:
+            if id(call) not in safe:
+                ctx.report(self.id, call,
+                           "`tracing.span(...)` opened without a `with` "
+                           "scope: on an exception exit edge the span is "
+                           "never closed or exported, so the one request "
+                           "forensics needs is the one with no trace — "
+                           "use `with tracing.span(...) as s:` (or "
+                           "ExitStack.enter_context)")
+
+
 RUNTIME_RULES = (
     SharedMutableState,
     ExceptPassSwallow,
@@ -303,4 +384,5 @@ RUNTIME_RULES = (
     RecorderBlockingIo,
     MetricLabelCardinality,
     MigrationAwaitHygiene,
+    SpanScopeLeak,
 )

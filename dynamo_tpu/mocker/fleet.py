@@ -266,6 +266,11 @@ class FleetSim:
         install_fault_hook: bool = True,  # rehearsal forks run inside a
         #   live sim and must NOT touch the module-global in-proc fault
         #   hook (it belongs to the outer experiment)
+        incident_dir: Optional[str] = None,  # arm black-box forensics:
+        #   on fleet SLO BREACH, sanitizer violation, or flight-recorder
+        #   anomaly, snapshot a correlated bundle here (runtime/incident.py)
+        incident_min_interval_s: float = 5.0,
+        incident_max_bundles: int = 8,
     ):
         self.n_workers = n_workers
         self.router_mode = router_mode
@@ -310,6 +315,12 @@ class FleetSim:
         self.decisions_root = decisions_root
         self.shadow = shadow
         self._install_fault_hook = install_fault_hook
+        self.incident_dir = incident_dir
+        self.incident_min_interval_s = incident_min_interval_s
+        self.incident_max_bundles = incident_max_bundles
+        self.incidents = None  # runtime/incident.py IncidentCapturer
+        self._incident_task: Optional[asyncio.Task] = None
+        self._incident_viol_seen = 0  # sanitizer violations already seen
         self.actuator = None
         self.connector = None
         self._decision_poller: Optional[asyncio.Task] = None
@@ -342,9 +353,20 @@ class FleetSim:
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
         if self._install_fault_hook:
+            # the outer (real) experiment also owns process-wide tracing:
+            # env-gated + idempotent, so a bare sim is a no-op and a
+            # DYN_TRACE_RING run arms ONE shared ring that every in-proc
+            # worker exports into — the fleet merge comes for free
+            from dynamo_tpu.runtime.tracing import configure_tracing
+
+            configure_tracing(service_name="fleet-sim")
             rp.set_inproc_fault_hook(self._fault_hook)
         if self.sanitizer is not None:
             self.sanitizer.start_watchdog()
+        if self.incident_dir:
+            # armed BEFORE workers spawn so each engine's flight-recorder
+            # anomaly hook can pull the trigger from its step thread
+            self._arm_incidents()
         for i in range(self.n_workers):
             await self._spawn_worker(i)
         frt = DistributedRuntime(
@@ -402,6 +424,9 @@ class FleetSim:
 
         self._digest_watch = asyncio.get_running_loop().create_task(
             _watch_digests())
+        if self.incidents is not None:
+            self._incident_task = asyncio.get_running_loop().create_task(
+                self._incident_watch())
         if self.actuate:
             await self._start_actuator()
 
@@ -489,6 +514,20 @@ class FleetSim:
         engine, card = build_mock_engine(
             margs, timing=self.timing, idle_sleep_s=self.idle_sleep_s,
             sanitizer=self.sanitizer)
+        rec = getattr(engine, "recorder", None)
+        if (self.incidents is not None and rec is not None
+                and getattr(rec, "enabled", False)):
+            # fires on the engine step thread; trigger() is the sanctioned
+            # non-blocking hand-off (DYN-R004) — never snapshot inline here
+            cap = self.incidents
+
+            def _on_anomaly(r, _w=idx, _cap=cap):
+                _cap.trigger("recorder_anomaly", {
+                    "worker": _w, "iteration": int(r.seq),
+                    "wall_s": float(r.wall_s), "kind": r.kind,
+                })
+
+            rec.on_anomaly(_on_anomaly)
         digest_state: Dict[str, float] = {}
         served = await serve_worker(
             rt, engine, card, digest_period_s=self.digest_period_s)
@@ -523,6 +562,9 @@ class FleetSim:
         if self._decision_poller is not None:
             self._decision_poller.cancel()
             self._decision_poller = None
+        if self._incident_task is not None:
+            self._incident_task.cancel()
+            self._incident_task = None
         if self._digest_watch is not None:
             self._digest_watch.cancel()
         if self.observer is not None:
@@ -542,8 +584,114 @@ class FleetSim:
         if self.sanitizer is not None:
             await self.sanitizer.stop_watchdog()
             self.sanitizer.audit_tasks()
+        if self.incidents is not None:
+            # drain off the loop: close() joins the writer thread, which
+            # may be mid-bundle (snapshot + JSONL write)
+            await asyncio.to_thread(self.incidents.close, 5.0)
         if self._install_fault_hook:
             rp.set_inproc_fault_hook(None)
+
+    # -- black-box forensics -----------------------------------------------
+    def _arm_incidents(self) -> None:
+        """Wire the incident capturer's evidence sources. Every source is
+        a snapshot-style read (lambdas re-resolve live objects at capture
+        time — the actuator, for instance, starts after arming). The
+        bundle deliberately carries `live_state` + `recorder` so
+        `scripts/dyn_incident.py replay` can fit a SimTiming and fork a
+        twin of the fleet as it was tuned at the moment of the breach."""
+        from dynamo_tpu.runtime.incident import IncidentCapturer
+
+        cap = IncidentCapturer(
+            self.incident_dir,
+            min_interval_s=self.incident_min_interval_s,
+            max_bundles=self.incident_max_bundles,
+        )
+        cap.register("live_state", self.live_state)
+        cap.register("slo", lambda: (
+            self.slo_engine.evaluate() if self.slo_engine else {}))
+        cap.register("digests", lambda: (
+            self.observer.window_digests(None) if self.observer else {}))
+        cap.register("kv_links", lambda: (
+            self.observer.onboard_costs(None) if self.observer else {}))
+        cap.register("routing", self._routing_section)
+        cap.register("recorder", self._recorder_records)
+        cap.register("traces", self._trace_section)
+        cap.register("faults", lambda: dict(self.fault_counts))
+        cap.register("actuator", lambda: (
+            [d.to_dict() for d in self.actuator.journal.decisions(64)]
+            if self.actuator else []))
+        if self.sanitizer is not None:
+            cap.register("sanitizer", self.sanitizer.report)
+        self.incidents = cap
+
+    def _routing_section(self):
+        from dynamo_tpu.runtime.fleet_observer import routing_debug_payload
+
+        if self.manager is None:
+            return {}
+        return routing_debug_payload(
+            self.manager.routing_audits(), last_n=256)
+
+    @staticmethod
+    def _trace_section():
+        """The breaching window's spans: the process span ring read
+        UNSAMPLED (evidence beats budgets), plus the tail-marked trace
+        ids the sampler would have kept anyway."""
+        from dynamo_tpu.runtime import tracing
+
+        ring = tracing.span_ring()
+        if ring is None:
+            return {"n": 0, "spans": [],
+                    "note": "span ring not armed (set DYN_TRACE_RING)"}
+        spans = ring.snapshot(last_n=2048, sampled=False)
+        return {
+            "n": len(spans),
+            "tail_traces": ring.tail_trace_ids(),
+            "spans": [tracing.span_to_dict(s) for s in spans],
+        }
+
+    async def _incident_watch(self) -> None:
+        """Poll the SLO engine and sanitizer on the digest cadence; pull
+        the trigger on the OK/WARN -> BREACH transition (not while it
+        stays breached — the rate limiter backs that up) and on every
+        fresh sanitizer violation batch."""
+        prev_state = "OK"
+        try:
+            while True:
+                await asyncio.sleep(max(0.25, self.digest_period_s))
+                cap = self.incidents
+                if cap is None:
+                    return
+                state = prev_state
+                if self.slo_engine is not None:
+                    try:
+                        view = self.slo_engine.evaluate()
+                    except Exception:
+                        log.debug("incident SLO poll failed", exc_info=True)
+                        view = {}
+                    state = view.get("state") or prev_state
+                    if state == "BREACH" and prev_state != "BREACH":
+                        breached = sorted(
+                            name for name, s in
+                            (view.get("fleet") or {}).items()
+                            if s.get("state") == "BREACH")
+                        cap.trigger("slo_breach", {
+                            "targets": breached,
+                            "workers_alive": self.alive_workers(),
+                        })
+                prev_state = state
+                if self.sanitizer is not None:
+                    n = len(self.sanitizer.violations)
+                    if n > self._incident_viol_seen:
+                        last = self.sanitizer.violations[-1]
+                        self._incident_viol_seen = n
+                        cap.trigger("sanitizer_violation", {
+                            "violations": n,
+                            "kind": last.get("kind"),
+                            "message": last.get("message"),
+                        })
+        except asyncio.CancelledError:
+            pass
 
     # -- multi-slice topology ----------------------------------------------
     def slice_of(self, idx: int) -> str:
@@ -1072,6 +1220,8 @@ class FleetSim:
             out["kv_fabric"] = self.kv_fabric_report()
         if self.sanitizer is not None:
             out["sanitizer"] = self.sanitizer.report()
+        if self.incidents is not None:
+            out["incidents"] = self.incidents.stats()
         if self.actuator is not None:
             out["actuation"] = {
                 "ticks": self.actuator.ticks,

@@ -315,9 +315,35 @@ class Actuator:
 
     def _decision(self, trigger: Dict[str, Any],
                   action: Dict[str, Any]) -> Decision:
+        tids = self._window_trace_ids()
+        if tids:
+            # causal link: the journal entry names the request traces that
+            # rode the digest windows this decision sensed, so an operator
+            # can walk actuation -> breaching window -> concrete spans
+            trigger = dict(trigger, trace_ids=tids)
         d = Decision(self._next_id, time.time(), trigger, action)
         self._next_id += 1
         return d
+
+    def _window_trace_ids(self, limit: int = 16) -> List[str]:
+        """Trace ids carried by the latest digest window of each worker
+        (bounded) — the sampling reservoirs DigestBuilder attached."""
+        fleet = getattr(self.loads, "fleet", None)
+        if fleet is None:
+            return []
+        out: List[str] = []
+        try:
+            for _w, digests in sorted(fleet.window_digests(None).items()):
+                for d in reversed(digests):
+                    for tid in d.get("trace_ids") or []:
+                        if tid not in out:
+                            out.append(tid)
+                            if len(out) >= limit:
+                                return out
+                    break  # latest digest per worker carries the window
+        except Exception:
+            log.debug("window trace-id gather failed", exc_info=True)
+        return out
 
     def _fleet_means(self, rows) -> Dict[str, float]:
         n = max(1, len(rows))

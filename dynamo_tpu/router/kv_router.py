@@ -31,6 +31,7 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.distributed import DistributedRuntime, EndpointClient
 from dynamo_tpu.runtime.request_plane import RequestPlaneError
 from dynamo_tpu.runtime.tasks import spawn_tracked
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.tokens.hashing import block_hashes
 
 log = logging.getLogger("dynamo_tpu.router")
@@ -780,69 +781,93 @@ class KvPushRouter:
     async def generate(self, request: Dict[str, Any], context: Context) -> AsyncIterator[Any]:
         t_route = time.monotonic()
         await self.router.start()
-        # admission gate: parks here while every worker is saturated;
-        # raises queue_full / queue_timeout (→ HTTP 429) on rejection
-        await self.router.admission.acquire(request.get("priority"))
-        token_ids = request.get("token_ids") or []
-        mm = request.get("mm")
-        mm_seed = None
-        if mm:
-            from dynamo_tpu.tokens.hashing import mm_content_seed
+        # route hop span: covers admission wait + KV-aware selection; the
+        # downstream direct() rpc and any prefetch promotions child off it
+        with tracing.span(
+            "route.kv", parent=context.metadata.get("traceparent"),
+        ) as rspan:
+            # admission gate: parks here while every worker is saturated;
+            # raises queue_full / queue_timeout (→ HTTP 429) on rejection
+            await self.router.admission.acquire(request.get("priority"))
+            token_ids = request.get("token_ids") or []
+            mm = request.get("mm")
+            mm_seed = None
+            if mm:
+                from dynamo_tpu.tokens.hashing import mm_content_seed
 
-            mm_seed = mm_content_seed(mm["data"])
-        collect: Dict[str, Any] = {}
-        allowed = context.metadata.get("allowed_instances")
-        worker, overlap, hashes = self.router.find_best_match(
-            token_ids, adapter=request.get("adapter"), mm_seed=mm_seed,
-            pinned_instance=context.metadata.get("target_instance"),
-            collect=collect,
-            allowed_instances=set(allowed) if allowed is not None else None,
-        )
-        from dynamo_tpu.tokens.hashing import request_seed
+                mm_seed = mm_content_seed(mm["data"])
+            collect: Dict[str, Any] = {}
+            allowed = context.metadata.get("allowed_instances")
+            worker, overlap, hashes = self.router.find_best_match(
+                token_ids, adapter=request.get("adapter"), mm_seed=mm_seed,
+                pinned_instance=context.metadata.get("target_instance"),
+                collect=collect,
+                allowed_instances=(set(allowed) if allowed is not None
+                                   else None),
+            )
+            from dynamo_tpu.tokens.hashing import request_seed
 
-        seed = request_seed(request.get("adapter"), mm_seed)
-        hint = self.router.remote_host_hint(
-            hashes, worker, overlap, seed,
-            host_overlaps=collect.get("host_overlaps"),
-        )
-        if hint is not None:
-            request = dict(request)
-            request["kv_remote_host"] = hint
-        pf = self.router.prefetch_hint(
-            hashes, worker, overlap, seed,
-            host_overlaps=collect.get("host_overlaps"),
-            remote=hint,
-        )
-        if pf is not None:
-            self.router.emit_prefetch(worker[0], pf)
-        # prefix economy: count the trunk; replicate it onto a cold
-        # slice once it proves hot (fire-and-forget, never on the
-        # request's critical path)
-        try:
-            self.router.maybe_replicate(
-                hashes, seed, host_overlaps=collect.get("host_overlaps"))
-        except Exception:
-            log.debug("hot-trunk replication failed", exc_info=True)
-        rid = context.id
-        self.router.add_request(rid, worker, hashes, overlap)
-        context.metadata["kv_overlap_blocks"] = overlap
-        context.metadata["routed_instance"] = worker[0]
-        # routing decision audit: per-candidate cost breakdown, joinable to
-        # the phase spine by rid (/debug/routing?rid=...)
-        self.router.audit.record(
-            rid, "kv", worker,
-            candidates=collect.get("candidates"),
-            overlap_blocks=overlap,
-            total_blocks=len(hashes),
-            remote_hint=hint is not None,
-            prefetch_hint=pf is not None,
-        )
-        # latency spine: KV-aware selection cost (admission wait included —
-        # that's real time the router held the request), accumulated across
-        # migration retries; the metadata dict rides to the worker
-        ph = context.metadata.setdefault("phases", {})
-        ph["route_s"] = (ph.get("route_s", 0.0)
-                        + (time.monotonic() - t_route))
+            seed = request_seed(request.get("adapter"), mm_seed)
+            rtp = getattr(rspan, "traceparent", None)
+            hint = self.router.remote_host_hint(
+                hashes, worker, overlap, seed,
+                host_overlaps=collect.get("host_overlaps"),
+            )
+            if hint is not None:
+                if rtp:
+                    # the worker's peer pull happens ticks later on the
+                    # engine side; the hint carries the route span so the
+                    # kv.peer_pull hop joins the request's trace
+                    hint["traceparent"] = rtp
+                request = dict(request)
+                request["kv_remote_host"] = hint
+            pf = self.router.prefetch_hint(
+                hashes, worker, overlap, seed,
+                host_overlaps=collect.get("host_overlaps"),
+                remote=hint,
+            )
+            if pf is not None:
+                # the prefetch pipeline finishes ticks after this span
+                # closes; hand it the route span so promotions land in the
+                # request's trace (kvbm/prefetch.py record_span)
+                if rtp:
+                    pf["traceparent"] = rtp
+                self.router.emit_prefetch(worker[0], pf)
+            # prefix economy: count the trunk; replicate it onto a cold
+            # slice once it proves hot (fire-and-forget, never on the
+            # request's critical path)
+            try:
+                self.router.maybe_replicate(
+                    hashes, seed,
+                    host_overlaps=collect.get("host_overlaps"))
+            except Exception:
+                log.debug("hot-trunk replication failed", exc_info=True)
+            rid = context.id
+            self.router.add_request(rid, worker, hashes, overlap)
+            context.metadata["kv_overlap_blocks"] = overlap
+            context.metadata["routed_instance"] = worker[0]
+            # routing decision audit: per-candidate cost breakdown,
+            # joinable to the phase spine by rid (/debug/routing?rid=...)
+            self.router.audit.record(
+                rid, "kv", worker,
+                candidates=collect.get("candidates"),
+                overlap_blocks=overlap,
+                total_blocks=len(hashes),
+                remote_hint=hint is not None,
+                prefetch_hint=pf is not None,
+            )
+            # latency spine: KV-aware selection cost (admission wait
+            # included — that's real time the router held the request),
+            # accumulated across migration retries; the metadata dict
+            # rides to the worker
+            ph = context.metadata.setdefault("phases", {})
+            ph["route_s"] = (ph.get("route_s", 0.0)
+                            + (time.monotonic() - t_route))
+            rspan.set_attribute("request.id", rid)
+            rspan.set_attribute("router.mode", "kv")
+            rspan.set_attribute("routed.instance", worker[0])
+            rspan.set_attribute("kv.overlap_blocks", overlap)
+            tracing.child_traceparent(context.metadata, rspan)
         first = True
         try:
             async for item in self.router.client.direct(

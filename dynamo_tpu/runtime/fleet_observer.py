@@ -152,6 +152,10 @@ class DigestBuilder:
     swaps the accumulation dicts wholesale, so a torn read costs at most
     one sample landing in the next window."""
 
+    # bounded per-window trace-id reservoir: enough to join a breaching
+    # window back to concrete traces, small enough to never bloat a digest
+    MAX_TRACE_IDS = 16
+
     def __init__(self, instance_id: int, dp_rank: int = 0):
         self.worker = [instance_id, dp_rank]
         self.seq = 0
@@ -160,11 +164,17 @@ class DigestBuilder:
                           "prefill_tokens": 0, "decode_iters": 0,
                           "decode_wall_s": 0.0}
         self._last_fpm: Dict[str, Any] = {}
+        self._trace_ids: List[str] = []
 
     # -- engine hooks (step thread) -----------------------------------------
     def observe_phases(self, phases: Dict[str, Any]) -> None:
         hists = self._hists
         self._counters["requests"] += 1
+        tid = phases.get("trace_id")
+        if (isinstance(tid, str) and len(self._trace_ids) < self.MAX_TRACE_IDS
+                and tid not in self._trace_ids):
+            # list append only (step thread); the window close swaps it
+            self._trace_ids.append(tid)
         for key in DIGEST_PHASES:
             val = phases.get(key)
             if val is None:
@@ -201,6 +211,7 @@ class DigestBuilder:
         `engine` (optional) is sampled for KV tier / prefetch / compile
         state — getattr-guarded so mockers and partial engines work."""
         hists, self._hists = self._hists, {}
+        trace_ids, self._trace_ids = self._trace_ids, []
         counters = dict(self._counters)
         for k in self._counters:
             self._counters[k] = 0 if isinstance(self._counters[k], int) else 0.0
@@ -215,6 +226,10 @@ class DigestBuilder:
             "queue": dict(self._last_fpm) or
                      {"n_running": 0, "n_waiting": 0, "kv_usage": 0.0},
         }
+        if trace_ids:
+            # join key back to the distributed span rings: the traces this
+            # window's requests belonged to (bounded reservoir)
+            digest["trace_ids"] = trace_ids
         if engine is not None:
             g2 = g3 = 0
             tiers: Dict[str, Any] = {}

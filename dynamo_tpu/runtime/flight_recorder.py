@@ -84,6 +84,10 @@ class IterationRecord:
     guided_rows: int = 0       # constraint-masked decode rows this iteration
     tree_hit_blocks: int = 0   # cumulative blocks served warm by match_prefix
     forks: int = 0             # cumulative fork-on-branch fan-outs
+    # causal tracing: trace ids of the requests this iteration served
+    # (bounded by the engine at append time) — joins the per-iteration
+    # timeline to the distributed span rings and incident bundles
+    trace_ids: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -136,6 +140,16 @@ class FlightRecorder:
         # metrics are bind-time optional (worker_common re-homes them onto
         # the status-port hierarchy); None until bound
         self._m_anomalies = None
+        # anomaly-fire hooks (incident capture arming): called on the STEP
+        # thread with the triggering record — handlers must be hand-off
+        # cheap (put_nowait into their own queue), never blocking I/O
+        self._anomaly_hooks: List[Any] = []
+
+    def on_anomaly(self, cb) -> None:
+        """Register cb(rec: IterationRecord) fired when the EWMA trigger
+        trips. Runs on the engine step thread — the handler must hand off
+        (DYN-R004 applies to it exactly like it applies to append)."""
+        self._anomaly_hooks.append(cb)
 
     def bind_metrics(self, metrics) -> None:
         """Re-home the fired-dumps counter onto a shared MetricsHierarchy
@@ -181,6 +195,11 @@ class FlightRecorder:
                     except queue.Full:
                         self.dumps_dropped += 1
                     self._ensure_dump_thread()
+                for hook in self._anomaly_hooks:
+                    try:
+                        hook(rec)
+                    except Exception:  # pragma: no cover
+                        log.exception("anomaly hook failed")
             # anomalous samples do NOT move the EWMA: the baseline keeps
             # tracking steady state so a sustained stall stays anomalous
             return
@@ -333,6 +352,7 @@ def to_chrome_trace(records: List[IterationRecord],
                 "fused": rec.fused,
                 "compile_variants": rec.compile_variants,
                 "compile_calls": rec.compile_calls,
+                "trace_ids": list(getattr(rec, "trace_ids", []) or []),
             },
         })
         events.append({

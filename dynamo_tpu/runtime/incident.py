@@ -1,0 +1,263 @@
+"""Black-box incident forensics: an armed capturer that snapshots a
+correlated evidence bundle the moment the fleet goes wrong.
+
+Production incidents die of evidence loss: by the time a human looks,
+the flight-recorder ring has rotated, the span ring has evicted the
+breaching window, and the routing audit no longer remembers who sent
+the victim requests where. The `IncidentCapturer` inverts that: it is
+armed up front with *sources* — zero-cost callables that snapshot live
+state (SLO view, span ring, recorder rings, routing audits, actuator
+journal, KV-link EWMAs, fleet digest window) — and a *trigger* that any
+watchdog may pull (SLO BREACH transition, sanitizer hard violation,
+flight-recorder anomaly excursion). On trigger it writes one versioned
+JSONL bundle joining all of it, rate-limited and disk-bounded.
+
+Threading contract (DYN-R004): `trigger()` is safe from ANY thread —
+the engine step thread's anomaly hook, the event loop's SLO watch — and
+never blocks: the rate-limit check is a lock-guarded clock compare and
+the hand-off is a `queue.put_nowait`. Gathering and writing happen on
+one daemon writer thread; sources therefore must be snapshot-style reads
+(ring copies, dict reads — GIL-atomic), never loop-affine awaits.
+
+Bundle format (`dynamo_tpu.incident/v1`), one JSONL file per incident:
+
+    line 1   header {"v": 1, "schema", "reason", "ts", "seq",
+                     "detail", "sections": [names...]}
+    line 2+  one line per section {"section": name, "data": ...}
+             (a failing source records {"section": name, "error": ...}
+             instead — one bad source never voids the bundle)
+
+`read_bundle` is the inverse; `scripts/dyn_incident.py` inspects and
+replays bundles through a calibrated FleetSim fork.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import asdict, is_dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger("dynamo_tpu.incident")
+
+BUNDLE_VERSION = 1
+BUNDLE_SCHEMA = "dynamo_tpu.incident/v1"
+BUNDLE_PREFIX = "incident-"
+BUNDLE_SUFFIX = ".jsonl"
+
+
+def _key(k: Any) -> str:
+    """JSON object keys: Worker tuples become 'iid.endpoint' strings —
+    the same join key /debug/fleet uses."""
+    if isinstance(k, str):
+        return k
+    if isinstance(k, tuple):
+        return ".".join(str(p) for p in k)
+    return str(k)
+
+
+def jsonable(obj: Any) -> Any:
+    """Recursively coerce live snapshot objects (dataclasses, tuple-keyed
+    dicts, sets) into plain JSON values. Unknown leaves degrade to repr —
+    a bundle must never fail to serialize."""
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    if is_dataclass(obj) and not isinstance(obj, type):
+        return jsonable(asdict(obj))
+    if isinstance(obj, dict):
+        return {_key(k): jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [jsonable(v) for v in obj]
+    return repr(obj)
+
+
+class IncidentCapturer:
+    """Armed bundle writer: `register()` evidence sources once, then any
+    watchdog `trigger()`s. Rate-limited (`min_interval_s` between
+    accepted triggers), disk-bounded (`max_bundles` newest kept)."""
+
+    def __init__(self, out_dir: str, *, min_interval_s: float = 5.0,
+                 max_bundles: int = 16):
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.min_interval_s = float(min_interval_s)
+        self.max_bundles = max(1, int(max_bundles))
+        self._sources: Dict[str, Callable[[], Any]] = {}
+        self._lock = threading.Lock()  # guards clock/seq/counters
+        self._last_ts: Optional[float] = None  # monotonic, last ACCEPTED
+        self._seq = 0
+        self._closed = False
+        self.captured = 0    # bundles fully written
+        self.suppressed = 0  # triggers dropped by rate limit / full queue
+        self.errors = 0      # source or serialization failures (non-fatal)
+        self._q: "queue.Queue" = queue.Queue(maxsize=8)
+        self._thread = threading.Thread(
+            target=self._run, name="dyn-incident-writer", daemon=True)
+        self._thread.start()
+
+    # -- arming ------------------------------------------------------------
+    def register(self, name: str, fn: Callable[[], Any]) -> None:
+        """Attach an evidence source. Registration order = bundle section
+        order. Sources run on the writer thread: snapshot reads only."""
+        self._sources[str(name)] = fn
+
+    # -- the trigger (any thread, never blocks) ----------------------------
+    def trigger(self, reason: str, detail: Optional[Dict[str, Any]] = None
+                ) -> bool:
+        """Pull the capture cord. Returns True if a bundle was enqueued,
+        False if suppressed (rate limit, closed, or writer backlog)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return False
+            if (self._last_ts is not None
+                    and now - self._last_ts < self.min_interval_s):
+                self.suppressed += 1
+                return False
+            self._last_ts = now
+            self._seq += 1
+            seq = self._seq
+        try:
+            self._q.put_nowait((seq, str(reason), dict(detail or {}),
+                                time.time()))
+        except queue.Full:
+            with self._lock:
+                self.suppressed += 1
+                # the slot was not used — give it back so the next
+                # trigger after the backlog drains is not rate-limited
+                self._last_ts = None
+            return False
+        return True
+
+    # -- writer thread -----------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._write_bundle(*item)
+            except Exception:
+                with self._lock:
+                    self.errors += 1
+                log.exception("incident bundle write failed")
+
+    def _write_bundle(self, seq: int, reason: str,
+                      detail: Dict[str, Any], ts: float) -> None:
+        lines: List[str] = []
+        names: List[str] = []
+        for name, fn in list(self._sources.items()):
+            try:
+                data = jsonable(fn())
+                line = json.dumps({"section": name, "data": data})
+            except Exception as e:
+                with self._lock:
+                    self.errors += 1
+                log.warning("incident source %r failed: %r", name, e)
+                line = json.dumps({"section": name, "error": repr(e)})
+            lines.append(line)
+            names.append(name)
+        header = {
+            "v": BUNDLE_VERSION,
+            "schema": BUNDLE_SCHEMA,
+            "reason": reason,
+            "ts": ts,
+            "seq": seq,
+            "detail": jsonable(detail),
+            "sections": names,
+        }
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(ts))
+        fname = f"{BUNDLE_PREFIX}{stamp}-{seq:04d}-{reason}{BUNDLE_SUFFIX}"
+        path = os.path.join(self.out_dir, fname)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for line in lines:
+                f.write(line + "\n")
+        os.replace(tmp, path)  # readers never see a half bundle
+        with self._lock:
+            self.captured += 1
+        log.warning("incident bundle captured: %s (reason=%s, %d sections)",
+                    path, reason, len(names))
+        self._prune()
+
+    def _prune(self) -> None:
+        names = sorted(
+            n for n in os.listdir(self.out_dir)
+            if n.startswith(BUNDLE_PREFIX) and n.endswith(BUNDLE_SUFFIX))
+        for n in names[:max(0, len(names) - self.max_bundles)]:
+            try:
+                os.unlink(os.path.join(self.out_dir, n))
+            except OSError:
+                log.debug("bundle prune failed: %s", n, exc_info=True)
+
+    # -- lifecycle / views -------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "captured": self.captured,
+                "suppressed": self.suppressed,
+                "errors": self.errors,
+                "pending": self._q.qsize(),
+                "min_interval_s": self.min_interval_s,
+                "max_bundles": self.max_bundles,
+                "dir": self.out_dir,
+            }
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Drain the writer (in-flight bundles finish) and stop. Stats
+        stay readable after close; triggers are refused."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=timeout_s)
+
+
+# -- bundle reading ---------------------------------------------------------
+def list_bundles(out_dir: str) -> List[str]:
+    """Bundle paths in `out_dir`, oldest first."""
+    try:
+        names = sorted(
+            n for n in os.listdir(out_dir)
+            if n.startswith(BUNDLE_PREFIX) and n.endswith(BUNDLE_SUFFIX))
+    except FileNotFoundError:
+        return []
+    return [os.path.join(out_dir, n) for n in names]
+
+
+def read_bundle(path: str) -> Dict[str, Any]:
+    """Inverse of the writer: {"header": {...}, "sections": {name: data}}.
+    A section that failed at capture time maps to {"error": "..."}."""
+    header: Optional[Dict[str, Any]] = None
+    sections: Dict[str, Any] = {}
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            obj = json.loads(raw)
+            if header is None:
+                if obj.get("schema") != BUNDLE_SCHEMA:
+                    raise ValueError(
+                        f"{path}: not an incident bundle "
+                        f"(schema={obj.get('schema')!r})")
+                if int(obj.get("v", 0)) > BUNDLE_VERSION:
+                    raise ValueError(
+                        f"{path}: bundle v{obj['v']} is newer than this "
+                        f"reader (v{BUNDLE_VERSION})")
+                header = obj
+                continue
+            name = obj.get("section")
+            if not name:
+                continue
+            sections[name] = (obj["data"] if "data" in obj
+                              else {"error": obj.get("error")})
+    if header is None:
+        raise ValueError(f"{path}: empty bundle")
+    return {"header": header, "sections": sections}

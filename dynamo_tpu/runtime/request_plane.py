@@ -28,6 +28,7 @@ from typing import Any, AsyncIterator, Dict, Optional, Tuple
 
 import msgpack
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.context import CancellationError, Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.tasks import spawn_tracked
@@ -256,8 +257,6 @@ class PushEndpoint:
         # server-hop span: continues the trace the caller's metadata carries
         # (reference: span per ingress hop, logging.rs:76-105) and re-points
         # the metadata so the engine's own egress calls nest under this hop
-        from dynamo_tpu.runtime import tracing
-
         attrs = {"rpc.endpoint": path, "request.id": rid}
         try:
             # metadata is raw wire input — a malformed value must not crash
@@ -865,39 +864,49 @@ class PushRouter:
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
         t_route = _time.monotonic()
-        allowed = context.metadata.get("allowed_instances")
-        iid, addr = self._pick(
-            context.metadata.get("target_instance"),
-            set(allowed) if allowed is not None else None,
-        )
-        # report the choice so wrappers (session affinity) can pin to it
-        context.metadata["routed_instance"] = iid
-        # latency spine: router-hop pick cost, accumulated across
-        # migration retries (the metadata dict rides to the worker)
-        ph = context.metadata.setdefault("phases", {})
-        ph["route_s"] = (ph.get("route_s", 0.0)
-                         + (_time.monotonic() - t_route))
-        # routing decision audit: candidate loads as the picker saw them,
-        # joinable to the phase spine by rid (/debug/routing?rid=...)
-        sick = set(self._sick)
-        target = context.metadata.get("target_instance")
-        self.audit.record(
-            context.id, self.mode, iid,
-            candidates=[
-                {
-                    "instance": i,
-                    "load": self.load_of(i),
-                    "weight": self._weights.get(i, 1.0),
-                    "sick": i in sick,
-                    "chosen": i == iid,
-                }
-                for i in sorted(
-                    self._instances if allowed is None
-                    else (j for j in self._instances if j in set(allowed))
-                )
-            ],
-            pinned=target is not None,
-        )
+        # route hop span: covers the pick + audit; downstream rpc spans
+        # child off it (child_traceparent), so the merged timeline reads
+        # frontend -> route -> worker with no gap
+        with tracing.span(
+            "route.push", parent=context.metadata.get("traceparent"),
+        ) as rspan:
+            allowed = context.metadata.get("allowed_instances")
+            iid, addr = self._pick(
+                context.metadata.get("target_instance"),
+                set(allowed) if allowed is not None else None,
+            )
+            # report the choice so wrappers (session affinity) can pin to it
+            context.metadata["routed_instance"] = iid
+            # latency spine: router-hop pick cost, accumulated across
+            # migration retries (the metadata dict rides to the worker)
+            ph = context.metadata.setdefault("phases", {})
+            ph["route_s"] = (ph.get("route_s", 0.0)
+                             + (_time.monotonic() - t_route))
+            # routing decision audit: candidate loads as the picker saw
+            # them, joinable to the phase spine by rid (/debug/routing?rid=)
+            sick = set(self._sick)
+            target = context.metadata.get("target_instance")
+            self.audit.record(
+                context.id, self.mode, iid,
+                candidates=[
+                    {
+                        "instance": i,
+                        "load": self.load_of(i),
+                        "weight": self._weights.get(i, 1.0),
+                        "sick": i in sick,
+                        "chosen": i == iid,
+                    }
+                    for i in sorted(
+                        self._instances if allowed is None
+                        else (j for j in self._instances if j in set(allowed))
+                    )
+                ],
+                pinned=target is not None,
+            )
+            rspan.set_attribute("request.id", context.id)
+            rspan.set_attribute("router.mode", str(self.mode))
+            rspan.set_attribute("routed.instance", iid)
+            tracing.child_traceparent(context.metadata, rspan)
         engine = RemoteEngine(self._pool, addr, self.endpoint_path)
         self._inflight[iid] = self._inflight.get(iid, 0) + 1
         try:

@@ -48,6 +48,7 @@ class StatusServer:
                 web.get("/health", self._health),
                 web.get("/metrics", self._metrics),
                 web.get("/debug/timeline", self._debug_timeline),
+                web.get("/debug/traces", self._debug_traces),
             ]
             + [
                 web.get(f"/debug/{name}", self._make_debug(fn))
@@ -86,7 +87,37 @@ class StatusServer:
         )
 
     async def _metrics(self, request) -> web.Response:
+        from dynamo_tpu.runtime import tracing
+
+        if tracing.enabled():
+            # silent span loss must be visible: the bounded exporter
+            # queue's cumulative drop count rides every scrape
+            self.runtime.metrics.gauge(
+                "tracing_dropped_spans",
+                "spans dropped by the bounded trace exporter queue/ring",
+            ).set(tracing.dropped_spans())
         return web.Response(body=self.runtime.metrics.render(), content_type="text/plain")
+
+    async def _debug_traces(self, request) -> web.Response:
+        """Per-process span ring as JSON (`?trace_id=` filters one trace,
+        unsampled; `?last_n=N` bounds the span count). The fleet-merge
+        exporter (`scripts/dump_timeline.py --trace`) joins these rings
+        across workers by trace_id into one Perfetto timeline."""
+        from dynamo_tpu.runtime import tracing
+
+        ring = tracing.span_ring()
+        if ring is None:
+            return web.json_response(
+                {"error": "span ring not armed (set DYN_TRACE_RING)"},
+                status=404)
+        try:
+            last_n = int(request.query.get("last_n", 0))
+        except ValueError:
+            last_n = 0
+        payload = ring.payload(
+            trace_id=request.query.get("trace_id") or None, last_n=last_n)
+        payload["dropped_spans"] = tracing.dropped_spans()
+        return web.json_response(payload)
 
     async def _debug_timeline(self, request) -> web.Response:
         """Flight-recorder ring as Chrome-trace JSON (open in Perfetto /

@@ -19,6 +19,18 @@ How a trace forms:
 - Migration stamps `migration.attempt` on retries (the reference's
   TraceLink role) so replayed hops are distinguishable.
 
+Tail-based sampling rides the W3C flags byte: bit 0x02 is the
+"tail-keep" mark. Any hop that learns a request is interesting after
+the fact — a migration replay, an SLO-threshold excursion — calls
+`mark_tail(metadata)`, and because downstream hops child off the same
+traceparent string the mark propagates with zero extra plumbing. The
+`SpanRing` exporter keeps every span in a bounded ring and applies the
+sampling decision at READ time (snapshot/export), so a trace that turns
+interesting late is still whole; unmarked traces survive a snapshot
+only when a deterministic hash of their trace_id clears `keep_prob` —
+every worker computes the same hash, so a sampled trace is kept (or
+dropped) fleet-wide with no coordination.
+
 Disabled (no exporter) the only cost is forwarding an existing
 traceparent string; span objects are created only when an exporter is
 installed.
@@ -39,6 +51,11 @@ from typing import Any, Dict, List, Optional
 
 log = logging.getLogger("dynamo_tpu.tracing")
 
+# W3C trace flags: bit 0 (0x01) = sampled; we claim bit 1 (0x02) as the
+# tail-keep mark (migrated / SLO-breaching requests are always kept by
+# the SpanRing regardless of the probabilistic sampling decision)
+TAIL_FLAG = 0x02
+
 
 @dataclass
 class SpanContext:
@@ -49,6 +66,13 @@ class SpanContext:
     @property
     def traceparent(self) -> str:
         return f"00-{self.trace_id}-{self.span_id}-{self.flags}"
+
+    @property
+    def tail(self) -> bool:
+        try:
+            return bool(int(self.flags, 16) & TAIL_FLAG)
+        except ValueError:
+            return False
 
 
 def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
@@ -68,6 +92,80 @@ def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
         return None
     return SpanContext(trace_id=parts[1].lower(), span_id=parts[2].lower(),
                        flags=parts[3][:2] or "01")
+
+
+@dataclass
+class TraceContext:
+    """The compact trace context that rides Context.metadata across every
+    hop: trace id, the parent span at this hop, flags (with the tail-keep
+    bit). A thin, explicit view over the traceparent string — helpers for
+    code that reasons about the trace rather than opening a span."""
+
+    trace_id: str
+    span_id: str  # the parent span for anything opened at this hop
+    flags: str = "01"
+
+    @property
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags}"
+
+    @property
+    def tail(self) -> bool:
+        try:
+            return bool(int(self.flags, 16) & TAIL_FLAG)
+        except ValueError:
+            return False
+
+    @classmethod
+    def from_metadata(cls, metadata: Optional[Dict[str, Any]]
+                      ) -> Optional["TraceContext"]:
+        ctx = parse_traceparent((metadata or {}).get("traceparent"))
+        if ctx is None:
+            return None
+        return cls(ctx.trace_id, ctx.span_id, ctx.flags)
+
+    def with_tail(self) -> "TraceContext":
+        try:
+            flags = int(self.flags, 16) | TAIL_FLAG
+        except ValueError:
+            flags = 0x01 | TAIL_FLAG
+        return TraceContext(self.trace_id, self.span_id, f"{flags:02x}")
+
+    def apply(self, metadata: Dict[str, Any]) -> None:
+        metadata["traceparent"] = self.traceparent
+
+
+def mark_tail(metadata: Dict[str, Any]) -> Optional[str]:
+    """Set the tail-keep bit on the metadata traceparent (and return the
+    rewritten value). Called when a request turns interesting after the
+    fact — a migration replay, an SLO-threshold excursion — so every
+    LATER hop's spans inherit the mark for free. No-op without a valid
+    traceparent."""
+    tc = TraceContext.from_metadata(metadata)
+    if tc is None:
+        return None
+    tc = tc.with_tail()
+    tc.apply(metadata)
+    return tc.traceparent
+
+
+def trace_keep(trace_id: str, keep_prob: float) -> bool:
+    """Coordination-free sampling agreement: a deterministic hash of the
+    trace_id against `keep_prob`, so every worker in the fleet keeps (or
+    drops) the same traces without talking to each other."""
+    if keep_prob >= 1.0:
+        return True
+    if keep_prob <= 0.0:
+        return False
+    try:
+        # FNV-1a over the hex id: cheap, stable across processes (unlike
+        # hash()), uniform enough for a sampling decision
+        acc = 0x811C9DC5
+        for ch in trace_id:
+            acc = ((acc ^ ord(ch)) * 0x01000193) & 0xFFFFFFFF
+        return (acc / 0xFFFFFFFF) < keep_prob
+    except TypeError:
+        return False
 
 
 @dataclass
@@ -132,6 +230,119 @@ class MemorySpanExporter:
         self.spans.append(span)
 
 
+def span_to_dict(s: Span) -> Dict[str, Any]:
+    """JSON form for /debug/traces and incident bundles (inverse-friendly:
+    dump_timeline --trace consumes exactly this shape)."""
+    return {
+        "name": s.name,
+        "trace_id": s.context.trace_id,
+        "span_id": s.context.span_id,
+        "parent_span_id": s.parent_span_id,
+        "flags": s.context.flags,
+        "start_ns": s.start_ns,
+        "end_ns": s.end_ns,
+        "kind": s.kind,
+        "attributes": dict(s.attributes),
+        "status_error": s.status_error,
+        "events": [dict(e) for e in s.events],
+    }
+
+
+class SpanRing:
+    """Bounded in-process span ring with tail-based sampling at READ time.
+
+    Every finished span lands in the ring (O(1) append, deque-bounded —
+    the ring is the memory ceiling, natural FIFO eviction). The sampling
+    decision happens when someone reads the ring (`snapshot`,
+    `/debug/traces`, an incident bundle): a trace survives if ANY of its
+    spans carried the tail-keep flag (migrated / SLO-breaching requests)
+    or if the deterministic `trace_keep` hash clears `keep_prob`. Late
+    marking therefore keeps the WHOLE trace — the early spans are still
+    in the ring when the mark arrives. `spans_for` (incident forensics)
+    never samples: evidence beats budgets once a trace id is named."""
+
+    def __init__(self, capacity: int = 4096, keep_prob: float = 1.0):
+        from collections import deque
+
+        self.capacity = max(16, int(capacity))
+        self.keep_prob = float(keep_prob)
+        self._ring = deque(maxlen=self.capacity)
+        # bounded memory of tail-marked trace ids (survives ring eviction
+        # of the marking span; bounded so a long-lived worker can't grow it)
+        self._tail: "deque" = deque(maxlen=self.capacity)
+        self._tail_set: set = set()
+        self.exported = 0
+
+    def export(self, span: Span) -> None:
+        self._ring.append(span)
+        self.exported += 1
+        if span.context.tail and span.context.trace_id not in self._tail_set:
+            if len(self._tail) == self._tail.maxlen:
+                self._tail_set.discard(self._tail[0])
+            self._tail.append(span.context.trace_id)
+            self._tail_set.add(span.context.trace_id)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def keeps(self, trace_id: str) -> bool:
+        return trace_id in self._tail_set or trace_keep(trace_id,
+                                                        self.keep_prob)
+
+    def tail_trace_ids(self) -> List[str]:
+        """Tail-marked trace ids still remembered (incident bundles list
+        these so forensics knows which traces were kept by policy)."""
+        return sorted(self._tail_set)
+
+    def spans_for(self, trace_id: str) -> List[Span]:
+        """Every ring span of one trace, oldest first — unsampled (the
+        incident path and trace-id queries want ALL the evidence)."""
+        return [s for s in self._ring if s.context.trace_id == trace_id]
+
+    def snapshot(self, last_n: int = 0, sampled: bool = True) -> List[Span]:
+        spans = list(self._ring)
+        if sampled:
+            spans = [s for s in spans if self.keeps(s.context.trace_id)]
+        if last_n > 0:
+            spans = spans[-last_n:]
+        return spans
+
+    def payload(self, trace_id: Optional[str] = None,
+                last_n: int = 0) -> Dict[str, Any]:
+        """The /debug/traces JSON body."""
+        if trace_id:
+            spans = self.spans_for(trace_id)
+        else:
+            spans = self.snapshot(last_n=last_n)
+        return {
+            "n": len(spans),
+            "exported": self.exported,
+            "capacity": self.capacity,
+            "keep_prob": self.keep_prob,
+            "tail_traces": len(self._tail_set),
+            "spans": [span_to_dict(s) for s in spans],
+        }
+
+
+class MultiExporter:
+    """Fan a span out to several exporters (ring + OTLP coexist)."""
+
+    def __init__(self, *exporters):
+        self.exporters = [e for e in exporters if e is not None]
+
+    def export(self, span: Span) -> None:
+        for e in self.exporters:
+            e.export(span)
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        ok = True
+        for e in self.exporters:
+            fl = getattr(e, "flush", None)
+            if fl is not None:
+                ok = bool(fl(timeout_s)) and ok
+        return ok
+
+
 class OtlpSpanExporter:
     """Batch spans to an OTLP/HTTP collector (/v1/traces, JSON encoding)
     from a daemon thread; drops on failure (telemetry is best-effort)."""
@@ -159,8 +370,17 @@ class OtlpSpanExporter:
             self._q.put_nowait(span)
         except queue.Full:
             # full queue: drop, but keep the evidence — a short-lived
-            # worker seeing dropped>0 at shutdown lost tail spans
+            # worker seeing dropped>0 at shutdown lost tail spans. The
+            # FIRST drop warns (once): silent span loss hides exactly the
+            # traces an overloaded process most needs; after that the
+            # `dynamo_trace_dropped_spans` gauge carries the count.
             self.dropped += 1
+            if self.dropped == 1:
+                log.warning(
+                    "span queue full (maxsize=%d): dropping spans — the "
+                    "collector at %s is slow or down; further drops are "
+                    "counted on the dropped_spans gauge, not logged",
+                    self._q.maxsize, getattr(self, "url", "?"))
 
     def flush(self, timeout_s: float = 5.0) -> bool:
         """Bounded drain: wait until the batch thread has consumed AND
@@ -266,19 +486,62 @@ def set_exporter(exporter) -> None:
 
 def configure_tracing(service_name: str = "dynamo_tpu") -> None:
     """Idempotent env-driven setup: DYN_OTLP_ENDPOINT enables span export
-    (shared with the OTLP log handler endpoint, like the reference)."""
+    (shared with the OTLP log handler endpoint, like the reference);
+    DYN_TRACE_RING=N arms the bounded in-process SpanRing (queryable at
+    /debug/traces, merged fleet-wide by dump_timeline --trace) with
+    DYN_TRACE_KEEP as the probabilistic keep fraction (default 1.0;
+    tail-marked traces are always kept). Both may coexist."""
     global _configured
     if _configured:
         return
     _configured = True
     endpoint = os.environ.get("DYN_OTLP_TRACES_ENDPOINT") \
         or os.environ.get("DYN_OTLP_ENDPOINT")
+    exporters = []
+    try:
+        ring_cap = int(os.environ.get("DYN_TRACE_RING", "0"))
+    except ValueError:
+        ring_cap = 0
+    if ring_cap > 0:
+        try:
+            keep = float(os.environ.get("DYN_TRACE_KEEP", "1.0"))
+        except ValueError:
+            keep = 1.0
+        exporters.append(SpanRing(capacity=ring_cap, keep_prob=keep))
     if endpoint:
-        set_exporter(OtlpSpanExporter(endpoint, service_name=service_name))
+        exporters.append(OtlpSpanExporter(endpoint,
+                                          service_name=service_name))
+    if len(exporters) == 1:
+        set_exporter(exporters[0])
+    elif exporters:
+        set_exporter(MultiExporter(*exporters))
 
 
 def enabled() -> bool:
     return _exporter is not None
+
+
+def span_ring() -> Optional[SpanRing]:
+    """The installed SpanRing, if any (directly or inside a
+    MultiExporter) — the /debug/traces and incident-bundle source."""
+    exp = _exporter
+    if isinstance(exp, SpanRing):
+        return exp
+    for e in getattr(exp, "exporters", ()):
+        if isinstance(e, SpanRing):
+            return e
+    return None
+
+
+def dropped_spans() -> int:
+    """Spans lost to bounded-queue overflow across the installed
+    exporter(s) — surfaced as a /metrics gauge by worker_common so
+    silent span loss is visible without reading logs."""
+    exp = _exporter
+    total = int(getattr(exp, "dropped", 0) or 0)
+    for e in getattr(exp, "exporters", ()):
+        total += int(getattr(e, "dropped", 0) or 0)
+    return total
 
 
 def flush_tracing(timeout_s: float = 5.0) -> bool:
@@ -310,6 +573,9 @@ def span(name: str, parent: Optional[str] = None, kind: int = 1,
     ctx = SpanContext(
         trace_id=pctx.trace_id if pctx else secrets.token_hex(16),
         span_id=secrets.token_hex(8),
+        # inherit flags so a tail-keep mark set upstream rides every
+        # child traceparent this hop writes downstream
+        flags=pctx.flags if pctx else "01",
     )
     s = Span(
         name=name,
@@ -342,3 +608,40 @@ def child_traceparent(metadata: Dict[str, Any], s) -> None:
     tp = getattr(s, "traceparent", None)
     if tp is not None:
         metadata["traceparent"] = tp
+
+
+def record_span(name: str, start_ns: int, end_ns: int,
+                parent: Optional[str] = None, kind: int = 1,
+                attributes: Optional[Dict[str, Any]] = None,
+                ) -> Optional[Span]:
+    """Record an already-measured interval as a finished span.
+
+    The worker's phase spine measures durations on the step thread and
+    only knows the full story at request finish; promotions in the KV
+    prefetcher span several engine ticks. Both reconstruct their spans
+    retroactively from (start_ns, end_ns) instead of holding a live span
+    open across threads. Inherits trace id and the tail-keep flag from
+    `parent`; no exporter installed -> None, zero allocation beyond the
+    parse."""
+    if _exporter is None:
+        return None
+    pctx = parse_traceparent(parent)
+    ctx = SpanContext(
+        trace_id=pctx.trace_id if pctx else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+        flags=pctx.flags if pctx else "01",
+    )
+    s = Span(
+        name=name,
+        context=ctx,
+        parent_span_id=pctx.span_id if pctx else None,
+        start_ns=int(start_ns),
+        end_ns=int(end_ns),
+        kind=kind,
+        attributes=dict(attributes or {}),
+    )
+    try:
+        _exporter.export(s)
+    except Exception:
+        log.exception("span export failed")
+    return s

@@ -505,6 +505,8 @@ async def serve_worker(
     _fetch_clients: dict = {}
 
     async def _remote_kv_fetch(hint):
+        from dynamo_tpu.runtime import tracing
+
         path = hint["path"]
         client = _fetch_clients.get(path)
         if client is None:
@@ -514,17 +516,28 @@ async def serve_worker(
             # request; direct() surfaces cannot_connect on its own
             _fetch_clients[path] = client
             await client.start()
-        # first pull after client creation races the discovery watch: give
-        # the target instance a moment to appear instead of failing into
-        # the engine's 30s peer backoff
-        deadline = asyncio.get_running_loop().time() + 2.0
-        while (int(hint["instance"]) not in client.instances
-               and asyncio.get_running_loop().time() < deadline):
-            await asyncio.sleep(0.05)
-        req = {"hashes": [int(h) for h in hint["hashes"][:MAX_HOST_FETCH_BLOCKS]]}
-        async for item in client.direct(req, int(hint["instance"])):
-            return item
-        return None
+        # cross-worker onboarding pull as a traced hop: the router stamped
+        # the route span's traceparent into the hint, so this transfer
+        # joins the request's trace with tier + size attribution
+        with tracing.span(
+            "kv.peer_pull", parent=hint.get("traceparent"), kind=3,
+            attributes={
+                "kv.n_blocks": len(hint.get("hashes") or []),
+                "kv.peer_instance": int(hint["instance"]),
+            },
+        ):
+            # first pull after client creation races the discovery watch:
+            # give the target instance a moment to appear instead of
+            # failing into the engine's 30s peer backoff
+            deadline = asyncio.get_running_loop().time() + 2.0
+            while (int(hint["instance"]) not in client.instances
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.05)
+            req = {"hashes":
+                   [int(h) for h in hint["hashes"][:MAX_HOST_FETCH_BLOCKS]]}
+            async for item in client.direct(req, int(hint["instance"])):
+                return item
+            return None
 
     engine.remote_kv_fetch = _remote_kv_fetch
 

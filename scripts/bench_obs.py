@@ -25,6 +25,16 @@ under 1.05 and byte-identical tokens. Run:
 
     python scripts/bench_obs.py --sanitizer
 
+`--trace` A/Bs causal tracing (runtime/tracing.py) instead: every
+request carries a traceparent in BOTH arms; the on-arm installs a
+SpanRing exporter (keep_prob 1.0 — worst case, every span retained) so
+the engine synthesizes and exports the full worker span spine per
+request, the off-arm runs with tracing disarmed. Acceptance (ISSUE 20,
+check_tier1 `trace_ok`): ITL p50 ratio under 1.05 and byte-identical
+tokens. Run:
+
+    python scripts/bench_obs.py --trace
+
 Either mode prints one JSON line with {"on": {...}, "off": {...},
 "itl_p50_ratio": ..., "tokens_match": ...}.
 """
@@ -54,7 +64,21 @@ def _prompts(args):
     ]
 
 
-async def _run_arm(args, recorder_size: int, sanitize: bool = False) -> dict:
+async def _run_arm(args, recorder_size: int, sanitize: bool = False,
+                   trace: bool = None) -> dict:
+    """One A/B arm. `trace=None` leaves process tracing untouched (the
+    recorder/sanitizer metrics); True/False arm or disarm the SpanRing
+    exporter — BOTH trace arms stamp a traceparent on every request so
+    the off-arm measures exactly what the on-arm pays on top of."""
+    from dynamo_tpu.runtime import tracing
+
+    ring = None
+    if trace is not None:
+        if trace:
+            ring = tracing.SpanRing(capacity=16384, keep_prob=1.0)
+            tracing.set_exporter(ring)
+        else:
+            tracing.set_exporter(None)
     runner = SimRunner(
         num_pages=args.num_pages, page_size=args.page_size,
         max_pages_per_seq=args.max_pages_per_seq,
@@ -71,14 +95,17 @@ async def _run_arm(args, recorder_size: int, sanitize: bool = False) -> dict:
     digest = hashlib.sha256()
     t0 = time.perf_counter()
     try:
-        async def one(prompt):
+        async def one(i, prompt):
+            md = None
+            if trace is not None:
+                md = {"traceparent": f"00-{i + 1:032x}-{i + 1:016x}-01"}
             toks = []
             first = last = None
             steps = []
             async for item in engine.generate(
                 {"token_ids": prompt, "sampling": {"temperature": 0.0},
                  "stop": {"max_tokens": args.osl, "stop_ids": [],
-                          "ignore_eos": True}}, Context(),
+                          "ignore_eos": True}}, Context(metadata=md),
             ):
                 ids = item.get("token_ids") or []
                 now = time.perf_counter()
@@ -93,7 +120,8 @@ async def _run_arm(args, recorder_size: int, sanitize: bool = False) -> dict:
                     break
             return toks, first, steps
 
-        outs = await asyncio.gather(*[one(p) for p in _prompts(args)])
+        outs = await asyncio.gather(
+            *[one(i, p) for i, p in enumerate(_prompts(args))])
     finally:
         engine.stop()
     wall = time.perf_counter() - t0
@@ -106,7 +134,7 @@ async def _run_arm(args, recorder_size: int, sanitize: bool = False) -> dict:
     san = engine.sanitizer
     if san is not None:
         assert san.ok(), san.report()  # overhead of a CLEAN run only
-    return {
+    out = {
         "recorder_size": recorder_size,
         "sanitize": sanitize,
         "wall_s": round(wall, 4),
@@ -118,6 +146,12 @@ async def _run_arm(args, recorder_size: int, sanitize: bool = False) -> dict:
         "records_appended": rec.total_appended,
         "tokens_sha256": digest.hexdigest(),
     }
+    if trace is not None:
+        out["trace"] = bool(trace)
+    if ring is not None:
+        out["spans_exported"] = ring.exported
+        out["spans_dropped"] = tracing.dropped_spans()
+    return out
 
 
 async def _run_fleet_arm(args, digest_period: float) -> dict:
@@ -271,6 +305,35 @@ async def _main_sanitizer(args) -> dict:
     }
 
 
+async def _main_trace(args) -> dict:
+    """Causal-tracing steady-state cost on the mocker hot path: the
+    traceparent parse + worker span synthesis/export per request, at
+    keep_prob 1.0 (sampling happens at ring-READ time, so the hot path
+    pays the same regardless — this is the honest worst case).
+    Acceptance (ISSUE 20): itl_p50_ratio < 1.05, byte-identical
+    tokens, and the on-arm actually exported spans."""
+    from dynamo_tpu.runtime import tracing
+
+    try:
+        await _run_arm(args, recorder_size=0, trace=False)  # warmup
+        on = await _run_arm(args, recorder_size=0, trace=True)
+        off = await _run_arm(args, recorder_size=0, trace=False)
+    finally:
+        tracing.set_exporter(None)  # leave the process disarmed
+    return {
+        "metric": "trace_overhead",
+        "n_requests": args.n_requests,
+        "isl": args.isl,
+        "osl": args.osl,
+        "on": on,
+        "off": off,
+        "itl_p50_ratio": round(
+            on["itl_p50_s"] / max(off["itl_p50_s"], 1e-12), 4),
+        "tokens_match": on["tokens_sha256"] == off["tokens_sha256"],
+        "spans_exported": on.get("spans_exported", 0),
+    }
+
+
 async def _main(args) -> dict:
     # interleave a warmup arm first so allocator/interpreter noise lands
     # outside the measured pair
@@ -311,11 +374,16 @@ def main() -> int:
     ap.add_argument("--sanitizer", action="store_true",
                     help="measure the runtime sanitizer (DYN_SAN) "
                          "steady-state overhead instead")
+    ap.add_argument("--trace", action="store_true",
+                    help="measure causal tracing (span synthesis + ring "
+                         "export) overhead instead")
     ap.add_argument("--n-workers", type=int, default=4)
     ap.add_argument("--digest-period", type=float, default=0.5,
                     help="digest publish period for the --fleet on-arm")
     args = ap.parse_args()
-    if args.sanitizer:
+    if args.trace:
+        run = _main_trace(args)
+    elif args.sanitizer:
         run = _main_sanitizer(args)
     elif args.fleet:
         run = _main_fleet(args)

@@ -57,6 +57,16 @@ def main() -> int:
                                 "violations")
     san_group.add_argument("--no-san", dest="san", action="store_false",
                            help="skip the strict warm-loop assertion")
+    trace_group = ap.add_mutually_exclusive_group()
+    trace_group.add_argument("--trace", dest="trace", action="store_true",
+                             default=True,
+                             help="also run the causal-tracing overhead "
+                                  "gate (default): bench_obs --trace must "
+                                  "show byte-identical tokens and ITL p50 "
+                                  "ratio under 1.05")
+    trace_group.add_argument("--no-trace", dest="trace",
+                             action="store_false",
+                             help="skip the tracing overhead gate")
     args = ap.parse_args()
     required = args.require if args.require is not None else [
         "test_sched_packing.py", "test_ragged_mixed.py",
@@ -66,6 +76,7 @@ def main() -> int:
         "test_fleet_sim.py", "test_chaos.py", "test_sanitizer.py",
         "test_dynmc.py", "test_planner_actuator.py",
         "test_kv_fabric.py", "test_dynshard.py",
+        "test_tracing.py", "test_incident.py",
     ]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
@@ -230,6 +241,37 @@ def main() -> int:
             print(warm_proc.stdout + warm_proc.stderr, file=sys.stderr)
     ok = ok and warm_ok
 
+    trace_ok = True
+    if args.trace:
+        # causal-tracing gate: tracing ON must not change a single token
+        # and must keep ITL p50 within 5% of tracing OFF (ISSUE 20 —
+        # observability that perturbs the observed system is worse than
+        # none). The span count assertion keeps the gate honest: an
+        # accidentally-disarmed on-arm would "pass" by measuring nothing.
+        trace_proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_obs.py"),
+             "--trace", "--n-requests", "24", "--osl", "24"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=args.timeout,
+        )
+        trace_report = {}
+        try:
+            trace_report = json.loads(
+                trace_proc.stdout.splitlines()[-1])
+        except (ValueError, IndexError):
+            pass
+        trace_ok = (trace_proc.returncode == 0
+                    and trace_report.get("tokens_match") is True
+                    and trace_report.get("spans_exported", 0) > 0
+                    and float(trace_report.get("itl_p50_ratio", 99.0))
+                    < 1.05)
+        if not trace_ok:
+            print("TIER-1 CHECK FAILED: tracing overhead gate (tokens "
+                  "diverged, no spans exported, or ITL p50 ratio >= "
+                  "1.05)", file=sys.stderr)
+            print(trace_proc.stdout + trace_proc.stderr, file=sys.stderr)
+    ok = ok and trace_ok
+
     # runtime-sanitizer self-check (jax-free): the lock-cycle detector,
     # allowlist rejection, and strict-raise plumbing must work before any
     # --sanitize run or fleet-sim chaos test can be trusted
@@ -251,7 +293,7 @@ def main() -> int:
                       "shard_ok": shard_ok,
                       "lint_elapsed_s": lint_elapsed_s,
                       "mc_ok": mc_ok, "sanitizer_ok": sanitizer_ok,
-                      "warm_loop_ok": warm_ok}))
+                      "warm_loop_ok": warm_ok, "trace_ok": trace_ok}))
     if not ok:
         # loud: surface the collection tracebacks so the broken import is
         # visible in CI logs, not just the count

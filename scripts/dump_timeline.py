@@ -1,8 +1,10 @@
-"""Pull flight-recorder timelines for Perfetto — one worker or a fleet.
+"""Pull flight-recorder timelines or span rings for Perfetto — one
+worker or a fleet.
 
-Fetches `/debug/timeline` from each worker's status port (``--status-port``
-on `python -m dynamo_tpu.worker` / any process that wired
-`StatusServer.add_timeline`) and writes Chrome-trace JSON you can open in
+Fetches `/debug/timeline` (iteration records) or, with ``--trace``,
+`/debug/traces` (causal span rings) from each worker's status port
+(``--status-port`` on `python -m dynamo_tpu.worker` / any process that
+wired `StatusServer`) and writes Chrome-trace JSON you can open in
 https://ui.perfetto.dev or chrome://tracing. Run:
 
     # single worker (back-compat)
@@ -13,9 +15,19 @@ https://ui.perfetto.dev or chrome://tracing. Run:
         --worker http://worker-a:9090 --worker b=http://worker-b:9091 \
         [--last-n 1024] [--out timeline.json]
 
-`--worker` is repeatable and accepts `label=URL`; each worker's events
-land under their own pid so Perfetto renders per-worker track groups with
-a shared wall-clock axis (cross-worker stalls line up visually).
+    # fleet-merged causal traces: per-worker span rings joined by
+    # trace_id — one request's frontend->route->worker span chain lines
+    # up across the processes that served it
+    python scripts/dump_timeline.py --trace \
+        --worker fe=http://frontend:9090 --worker w0=http://worker:9091 \
+        [--trace-id HEX32] [--out spans.json]
+
+`--worker` is repeatable and accepts `label=URL`; duplicate URLs are
+fetched once (the first label wins — no duplicate pid track groups).
+Each worker's events land under their own pid so Perfetto renders
+per-worker track groups with a shared wall-clock axis. A worker that
+can't serve its ring mid-pull (restarting, 404, connection refused) is
+skipped with a note; the exit is nonzero only when EVERY pull fails.
 """
 
 from __future__ import annotations
@@ -36,6 +48,20 @@ def fetch_timeline(base_url: str, last_n: int = 0,
         return json.loads(resp.read())
 
 
+def fetch_traces(base_url: str, last_n: int = 0,
+                 trace_id: str = "", timeout_s: float = 10.0) -> dict:
+    url = base_url.rstrip("/") + "/debug/traces"
+    params = []
+    if trace_id:
+        params.append(f"trace_id={trace_id}")
+    elif last_n > 0:
+        params.append(f"last_n={last_n}")
+    if params:
+        url += "?" + "&".join(params)
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
 def merge_traces(traces: list) -> dict:
     """[(label, chrome_trace_dict)] -> one trace; worker i's events get
     pid=i and a process_name of the label, so each worker renders as its
@@ -51,12 +77,80 @@ def merge_traces(traces: list) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def merge_span_rings(rings: list) -> dict:
+    """[(label, /debug/traces payload)] -> one Chrome trace joined by
+    trace_id.
+
+    Spans from every ring are deduped on (trace_id, span_id) — a fleet
+    whose workers share a ring (the in-proc sim) or a worker polled
+    twice contributes each span once. Tracks: pid = the worker that
+    recorded the span, tid = the trace (thread_name carries the
+    trace_id prefix + tail mark), so one request's causal chain reads
+    as one lane per process with a shared wall-clock axis."""
+    events = []
+    seen = set()
+    tids: dict = {}  # trace_id -> tid (stable across workers)
+    tails = set()
+    for pid, (label, payload) in enumerate(rings):
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": f"worker {label}"}})
+        for s in payload.get("spans", []):
+            key = (s.get("trace_id"), s.get("span_id"))
+            if key in seen:
+                continue
+            seen.add(key)
+            trace_id = s.get("trace_id") or "?"
+            tid = tids.setdefault(trace_id, len(tids) + 1)
+            if int(s.get("flags", 0)) & 0x02:
+                tails.add(trace_id)
+            start_us = int(s.get("start_ns", 0)) / 1e3
+            dur_us = max(0.0,
+                         (int(s.get("end_ns", 0))
+                          - int(s.get("start_ns", 0))) / 1e3)
+            args = dict(s.get("attributes") or {})
+            args["trace_id"] = trace_id
+            args["span_id"] = s.get("span_id")
+            if s.get("parent_span_id"):
+                args["parent_span_id"] = s["parent_span_id"]
+            if s.get("status_error"):
+                args["error"] = s["status_error"]
+            events.append({
+                "ph": "X", "cat": "span", "name": s.get("name", "span"),
+                "ts": start_us, "dur": dur_us, "pid": pid, "tid": tid,
+                "args": args,
+            })
+        for trace_id, tid in tids.items():
+            mark = " [tail]" if trace_id in tails else ""
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid,
+                           "args": {"name": f"trace {trace_id[:8]}{mark}"}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"n_traces": len(tids), "n_spans": len(seen)}}
+
+
 def _parse_worker(spec: str) -> tuple:
     """'label=URL' or bare 'URL' -> (label, URL)."""
     if "=" in spec and not spec.split("=", 1)[0].startswith("http"):
         label, url = spec.split("=", 1)
         return label, url
     return spec.rstrip("/").rsplit(":", 1)[-1], spec
+
+
+def dedupe_targets(targets: list) -> list:
+    """Drop repeated URLs (first label wins) so a worker listed twice —
+    a copy-pasted flag, a frontend that is also a worker — doesn't render
+    duplicate pid track groups or double-count its spans."""
+    seen = set()
+    out = []
+    for label, url in targets:
+        key = url.rstrip("/")
+        if key in seen:
+            print(f"note: skipping duplicate worker URL {url} "
+                  f"(label {label!r})", file=sys.stderr)
+            continue
+        seen.add(key)
+        out.append((label, url))
+    return out
 
 
 def main() -> int:
@@ -66,42 +160,71 @@ def main() -> int:
     ap.add_argument("--worker", action="append", default=[],
                     metavar="[LABEL=]URL",
                     help="worker status URL; repeat for a fleet merge")
+    ap.add_argument("--trace", action="store_true",
+                    help="pull /debug/traces span rings instead of the "
+                         "flight-recorder timeline")
+    ap.add_argument("--trace-id", default="",
+                    help="with --trace: one trace, unsampled, from every "
+                         "worker that holds spans for it")
     ap.add_argument("--last-n", type=int, default=0,
                     help="bound the record count per worker (0 = whole ring)")
-    ap.add_argument("--out", default="timeline.json")
+    ap.add_argument("--out", default=None,
+                    help="output path (default timeline.json, or "
+                         "spans.json with --trace)")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args()
+    out_path = args.out or ("spans.json" if args.trace else "timeline.json")
     targets = [_parse_worker(w) for w in args.worker]
     if args.url:
         targets.insert(0, _parse_worker(args.url))
     if not targets:
         ap.error("need --url or at least one --worker")
-    traces, failed = [], []
+    targets = dedupe_targets(targets)
+    fetched, failed = [], []
     for label, url in targets:
         try:
-            traces.append((label, fetch_timeline(url, args.last_n,
-                                                 args.timeout)))
+            if args.trace:
+                fetched.append((label, fetch_traces(
+                    url, args.last_n, args.trace_id, args.timeout)))
+            else:
+                fetched.append((label, fetch_timeline(url, args.last_n,
+                                                      args.timeout)))
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                print(f"error: {url}: no timeline source — is the flight "
-                      "recorder enabled (--recorder-size > 0)?",
-                      file=sys.stderr)
+                what = ("span ring (DYN_TRACE_RING)" if args.trace
+                        else "flight recorder (--recorder-size)")
+                print(f"note: {url}: no {what} — skipping", file=sys.stderr)
                 failed.append(url)
                 continue
             raise
         except (urllib.error.URLError, OSError) as e:
-            print(f"error: {url}: {e}", file=sys.stderr)
+            print(f"note: {url}: {e} — skipping", file=sys.stderr)
             failed.append(url)
-    if not traces:
+    if not fetched:
+        print("error: every worker pull failed", file=sys.stderr)
         return 2
-    trace = merge_traces(traces) if len(traces) > 1 else traces[0][1]
+    if args.trace:
+        trace = merge_span_rings(fetched)
+        events = trace["traceEvents"]
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        other = trace.get("otherData", {})
+        print(f"wrote {out_path}: {len(fetched)} worker(s), "
+              f"{other.get('n_spans', 0)} spans across "
+              f"{other.get('n_traces', 0)} traces"
+              + (f" ({len(failed)} worker(s) skipped)" if failed else "")
+              + " — open in ui.perfetto.dev")
+        return 0
+    trace = merge_traces(fetched) if len(fetched) > 1 else fetched[0][1]
     events = trace.get("traceEvents", [])
-    with open(args.out, "w", encoding="utf-8") as f:
+    with open(out_path, "w", encoding="utf-8") as f:
         json.dump(trace, f)
     slices = sum(1 for e in events if e.get("ph") == "X")
-    print(f"wrote {args.out}: {len(traces)} worker(s), {len(events)} events "
-          f"({slices} iteration slices) — open in ui.perfetto.dev")
-    return 1 if failed else 0
+    print(f"wrote {out_path}: {len(fetched)} worker(s), {len(events)} events "
+          f"({slices} iteration slices)"
+          + (f" ({len(failed)} worker(s) skipped)" if failed else "")
+          + " — open in ui.perfetto.dev")
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,300 @@
+"""Inspect and replay black-box incident bundles (runtime/incident.py).
+
+    # what did the fleet capture?
+    python scripts/dyn_incident.py list /tmp/incidents
+
+    # one bundle: header + per-section inventory; drill into a section
+    # or join every evidence stream on one request id
+    python scripts/dyn_incident.py show BUNDLE [--section slo] [--rid RID]
+
+    # the forensics loop: re-score the bundle's own digest evidence
+    # through a fresh SLO engine (deterministic — same bundle, same
+    # verdict, every time), and optionally rehearse the incident in a
+    # FleetSim fork calibrated from the bundle's flight-recorder records
+    python scripts/dyn_incident.py replay BUNDLE [--sim] [--json]
+
+`replay` has two halves, by design:
+
+- the **verdict** is recomputed offline from evidence that is already in
+  the bundle (digest window x SLO policy). No clocks, no sleeps, no
+  traffic: byte-identical bundles produce byte-identical verdicts, which
+  is what lets a test (or a postmortem) assert "the breach the capturer
+  saw is the breach the evidence shows".
+- `--sim` additionally forks a miniature of the incident fleet —
+  `SimTiming.fit_records` on the bundle's recorder rings gives the twin
+  the victim's measured step-time model, `FleetSim.fork_from_live` on
+  the bundle's `live_state` gives it the victim's live tuning — and
+  re-runs seeded traffic under a fault schedule reconstructed from the
+  bundle's fault counts. That run answers "does the incident reproduce
+  under rehearsal", with `calibration_error` bounding how much to trust
+  the twin's timing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.runtime.incident import list_bundles, read_bundle  # noqa: E402
+
+
+# -- offline verdict --------------------------------------------------------
+class _BundleObserver:
+    """FleetObserver stand-in scoring a bundle's captured digest window.
+
+    The bundle IS the window: both SLO windows see the same merged
+    histograms, so a sustained breach (both windows burning at capture
+    time) re-scores as BREACH and a healthy window as OK. Per-worker
+    scoring is skipped (workers() -> []) — fleet scope is the verdict."""
+
+    def __init__(self, digests: Dict[str, List[dict]]):
+        self._digests = digests or {}
+
+    def phase_hists(self, now=None, window_s=None, worker=None):
+        from dynamo_tpu.runtime.fleet_observer import merge_hist, new_hist
+
+        merged: Dict[str, List[int]] = {}
+        for _w, ds in sorted(self._digests.items()):
+            for d in ds or []:
+                for phase, counts in (d.get("phases") or {}).items():
+                    h = merged.get(phase)
+                    if h is None:
+                        h = merged[phase] = new_hist()
+                    merge_hist(h, counts)
+        return merged
+
+    def workers(self, now=None):
+        return []
+
+
+def offline_verdict(bundle: Dict[str, Any]) -> Dict[str, Any]:
+    """Re-score the bundle's digest evidence with the bundle's own SLO
+    policy. Pure function of the bundle — the deterministic half of
+    replay."""
+    from dynamo_tpu.planner.slo import SloEngine, parse_slo_config
+
+    sections = bundle["sections"]
+    slo = sections.get("slo") or {}
+    policy = parse_slo_config(slo.get("policy") or None)
+    engine = SloEngine(_BundleObserver(sections.get("digests") or {}),
+                       policy)
+    view = engine.evaluate()
+    captured = slo.get("state")
+    return {
+        "captured_state": captured,
+        "replay_state": view["state"],
+        "reproduced": (captured is None or view["state"] == captured),
+        "targets": {name: s["state"]
+                    for name, s in (view.get("fleet") or {}).items()},
+    }
+
+
+# -- twin rehearsal ---------------------------------------------------------
+def _schedule_from_faults(faults: Dict[str, Any], duration_s: float):
+    """Reconstruct a representative chaos schedule from the bundle's
+    fault counters: the same *kinds* of abuse, compressed into the
+    rehearsal window (capped — a day of kills needn't all replay)."""
+    from dynamo_tpu.mocker.fleet import FaultEvent, FaultSchedule
+
+    events = []
+    kills = min(int(faults.get("kill", 0) or 0), 4)
+    for i in range(kills):
+        events.append(FaultEvent(
+            "kill", at_s=duration_s * (i + 1) / (kills + 1)))
+    partitions = min(int(faults.get("partition", 0) or 0), 2)
+    for i in range(partitions):
+        events.append(FaultEvent(
+            "partition", at_s=duration_s * (i + 1) / (partitions + 2),
+            duration_s=duration_s / 4))
+    return FaultSchedule(events)
+
+
+async def rehearse(bundle: Dict[str, Any], *, duration_s: float = 3.0,
+                   n_sessions: int = 4, rps: float = 8.0,
+                   time_scale: float = 1.0) -> Dict[str, Any]:
+    """Fork a calibrated twin of the incident fleet and re-run it under
+    a schedule reconstructed from the bundle's fault counts."""
+    from dynamo_tpu.mocker.fleet import FleetSim
+    from dynamo_tpu.mocker.sim import SimTiming
+
+    sections = bundle["sections"]
+    records = sections.get("recorder") or []
+    records = [r for r in records if isinstance(r, dict)]
+    timing = None
+    calibration = None
+    if records:
+        timing = SimTiming.fit_records(records)
+        calibration = timing.calibration_error(records)
+    state = sections.get("live_state") or {}
+    if not isinstance(state, dict) or not state:
+        raise ValueError("bundle has no live_state section — cannot fork")
+    sim = FleetSim.fork_from_live(state, timing=timing)
+    schedule = _schedule_from_faults(sections.get("faults") or {},
+                                     duration_s)
+    await sim.start()
+    try:
+        report = await sim.run(
+            scenarios=("agentic", "rag"), n_sessions=n_sessions, rps=rps,
+            time_scale=time_scale, fault_schedule=schedule)
+    finally:
+        await sim.stop()
+    return {
+        "calibration": calibration,
+        "faults_replayed": schedule.to_text(),
+        "slo_state": report.get("slo_state"),
+        "slo_attainment": report.get("slo_attainment"),
+        "migration": report.get("migration_success_rate"),
+        "workers_alive": report.get("workers_alive"),
+        "requests": report.get("requests"),
+    }
+
+
+# -- joins for `show --rid` -------------------------------------------------
+def join_rid(bundle: Dict[str, Any], rid: str) -> Dict[str, Any]:
+    """Everything the bundle knows about one request id: its routing
+    decisions, its spans (and thereby its trace ids), and the recorder
+    iterations that served its traces."""
+    sections = bundle["sections"]
+    routing = [d for d in (sections.get("routing") or {}).get(
+        "decisions", []) if d.get("rid") == rid]
+    spans = [s for s in (sections.get("traces") or {}).get("spans", [])
+             if (s.get("attributes") or {}).get("request.id") == rid]
+    trace_ids = sorted({s["trace_id"] for s in spans})
+    spans = [s for s in (sections.get("traces") or {}).get("spans", [])
+             if s.get("trace_id") in trace_ids] or spans
+    iters = [
+        {"worker_seq": r.get("seq"), "ts": r.get("ts"),
+         "kind": r.get("kind"), "wall_s": r.get("wall_s")}
+        for r in sections.get("recorder") or []
+        if isinstance(r, dict)
+        and set(r.get("trace_ids") or []) & set(trace_ids)
+    ]
+    return {"rid": rid, "trace_ids": trace_ids, "routing": routing,
+            "spans": sorted(spans, key=lambda s: s.get("start_ns", 0)),
+            "iterations": iters}
+
+
+# -- CLI --------------------------------------------------------------------
+def _summarize(path: str) -> Dict[str, Any]:
+    b = read_bundle(path)
+    h = b["header"]
+    s = b["sections"]
+    return {
+        "path": path,
+        "reason": h.get("reason"),
+        "ts": h.get("ts"),
+        "slo_state": (s.get("slo") or {}).get("state"),
+        "spans": (s.get("traces") or {}).get("n", 0),
+        "records": len(s.get("recorder") or []),
+        "routing": (s.get("routing") or {}).get("n", 0),
+        "sections": h.get("sections"),
+    }
+
+
+def cmd_list(args) -> int:
+    paths = list_bundles(args.dir)
+    if not paths:
+        print(f"no incident bundles under {args.dir}", file=sys.stderr)
+        return 1
+    for p in paths:
+        try:
+            s = _summarize(p)
+        except (OSError, ValueError) as e:
+            print(f"{p}: unreadable ({e})", file=sys.stderr)
+            continue
+        print(f"{s['path']}: reason={s['reason']} slo={s['slo_state']} "
+              f"spans={s['spans']} records={s['records']} "
+              f"routing={s['routing']}")
+    return 0
+
+
+def cmd_show(args) -> int:
+    bundle = read_bundle(args.bundle)
+    if args.rid:
+        print(json.dumps(join_rid(bundle, args.rid), indent=2))
+        return 0
+    if args.section:
+        data = bundle["sections"].get(args.section)
+        if data is None:
+            print(f"no section {args.section!r} (have: "
+                  f"{bundle['header'].get('sections')})", file=sys.stderr)
+            return 1
+        print(json.dumps(data, indent=2))
+        return 0
+    out = dict(bundle["header"])
+    out["inventory"] = {
+        name: (len(data) if isinstance(data, (list, dict)) else type(
+            data).__name__)
+        for name, data in bundle["sections"].items()
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_replay(args) -> int:
+    bundle = read_bundle(args.bundle)
+    out: Dict[str, Any] = {
+        "bundle": args.bundle,
+        "reason": bundle["header"].get("reason"),
+        "verdict": offline_verdict(bundle),
+    }
+    if args.sim:
+        out["rehearsal"] = asyncio.run(rehearse(
+            bundle, duration_s=args.duration, n_sessions=args.sessions,
+            rps=args.rps, time_scale=args.time_scale))
+    v = out["verdict"]
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"{args.bundle}: captured={v['captured_state']} "
+              f"replayed={v['replay_state']} "
+              f"reproduced={v['reproduced']}")
+        if args.sim:
+            r = out["rehearsal"]
+            cal = r.get("calibration") or {}
+            print(f"  rehearsal: slo_state={r['slo_state']} "
+                  f"attainment={r['slo_attainment']} "
+                  f"faults={r['faults_replayed'] or '(none)'} "
+                  f"itl_err={cal.get('itl_p50_err')}")
+    return 0 if v["reproduced"] else 3
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("list", help="inventory a bundle directory")
+    p.add_argument("dir")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("show", help="dump one bundle (or one section)")
+    p.add_argument("bundle")
+    p.add_argument("--section", default=None)
+    p.add_argument("--rid", default=None,
+                   help="join routing/spans/iterations on one request id")
+    p.set_defaults(fn=cmd_show)
+
+    p = sub.add_parser(
+        "replay", help="re-score the evidence; --sim rehearses in a twin")
+    p.add_argument("bundle")
+    p.add_argument("--sim", action="store_true",
+                   help="also run the calibrated FleetSim fork")
+    p.add_argument("--duration", type=float, default=3.0)
+    p.add_argument("--sessions", type=int, default=4)
+    p.add_argument("--rps", type=float, default=8.0)
+    p.add_argument("--time-scale", type=float, default=1.0)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_replay)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -468,3 +468,75 @@ async def test_fleet_sim_actuator_live_under_shifting_bursty_trace():
     assert rebinds == 0
     assert report["faults"] == {"digest_drop": 1, "digest_dup": 1}
     assert _san_clean(sim), sim.sanitizer.report()
+
+
+async def test_fleet_sim_migration_keeps_trace_contiguous_and_tail_marked():
+    """Trace continuity under migration: a request whose worker is
+    SIGKILLed mid-stream re-dispatches INTO THE CALLER'S TRACE — the
+    re-issued route hop and the surviving worker's spans carry the same
+    trace_id as the first attempt — the frontend root records the
+    attempt, and the trace is tail-marked so even a keep_prob=0 sampler
+    keeps the whole chain (migrated requests are always interesting)."""
+    from dynamo_tpu.mocker.fleet import FleetSim
+    from dynamo_tpu.runtime import tracing
+    from dynamo_tpu.runtime.context import Context
+
+    ring = tracing.SpanRing(capacity=4096, keep_prob=0.0)  # tail-only
+    tracing.set_exporter(ring)
+    sim = FleetSim(n_workers=2, router_mode="kv", seed=21, speed=1.0,
+                   decode_base_ms=20.0, idle_sleep_s=0.01,
+                   migration_backoff_base_s=0.01, sick_cooldown_s=0.5,
+                   session_affinity_ttl=30.0)
+    try:
+        await sim.start()
+        entry = sim.entry
+        req = {"token_ids": [60, 61, 62, 63],
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 24, "ignore_eos": True}}
+        # turn 1 binds the session (its spans live in their own trace —
+        # no traceparent, not tail-marked, so keep_prob=0 drops them)
+        ctx1 = Context()
+        ctx1.metadata["session_id"] = "sess-trace"
+        expected, _ = await _collect(entry, req, ctx1)
+        assert len(expected) == 24
+        snap = sim.watcher.affinity.snapshot()
+        bound_iid = int(next(iter(snap["by_instance"])), 16)
+        bound_idx = next(i for i, w in enumerate(sim.workers)
+                         if any(inst.instance_id == bound_iid
+                                for inst in w.runtime._served))
+
+        # turn 2 carries a caller traceparent; kill the bound worker
+        # after the first tokens land
+        caller = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        ctx2 = Context(metadata={"session_id": "sess-trace",
+                                 "traceparent": caller})
+        toks, killed = [], False
+        async for item in entry.chain.generate(dict(req), ctx2):
+            assert item.get("finish_reason") != "error", item
+            toks.extend(item.get("token_ids") or [])
+            if toks and not killed:
+                killed = True
+                await sim.kill_worker(bound_idx)
+        assert toks == expected  # byte-identical under migration
+    finally:
+        await sim.stop()
+        tracing.set_exporter(None)
+
+    # keep_prob=0: ONLY tail-kept traces survive sampling — the migrated
+    # request's whole chain must, under the caller's trace id
+    assert "ab" * 16 in ring.tail_trace_ids()
+    spans = ring.snapshot(sampled=True)
+    assert spans, "tail-marked trace sampled away"
+    assert {s.context.trace_id for s in spans} == {"ab" * 16}
+    names = [s.name for s in spans]
+    root = next(s for s in spans if s.name == "frontend.request")
+    assert root.parent_span_id == "cd" * 8  # continues the caller's span
+    assert root.attributes.get("migration.attempts") == 1
+    assert any(e["name"] == "migration" for e in root.events)
+    # contiguity across the kill: BOTH dispatch attempts' route hops and
+    # at least one worker-side span share the trace
+    assert sum(1 for n in names if n.startswith("route.")) >= 2, names
+    assert any(n.startswith("worker.") for n in names), names
+    tail = next(s for s in spans if s.name == "trace.tail")
+    assert tail.attributes.get("reason") == "migration"
+    assert _san_clean(sim), sim.sanitizer.report()

@@ -1284,3 +1284,87 @@ def test_facts_version_bump_invalidates_cache(tmp_path, monkeypatch):
     warm: dict = {}
     lint_paths([pkgdir], root=str(tmp_path), cache_path=cache, stats=warm)
     assert warm == {"cache_hits": len(_A007_PKG), "cache_misses": 0}
+
+
+# -- DYN-R009: tracing span scope leak --------------------------------------
+
+
+def test_r009_assigned_span_never_entered():
+    vs = _lint("""
+        from dynamo_tpu.runtime import tracing
+
+        def dispatch(md):
+            s = tracing.span("route.push", parent=md.get("traceparent"))
+            s.set_attribute("worker", 3)
+            return 1
+    """)
+    assert [v.rule for v in vs] == ["DYN-R009"]
+    assert "with tracing.span" in vs[0].message
+
+
+def test_r009_bare_call_and_alias_are_not_an_escape():
+    assert _rules("""
+        from dynamo_tpu.runtime import tracing as tr
+
+        async def hop():
+            tr.span("worker.request")
+    """) == ["DYN-R009"]
+
+
+def test_r009_negative_scoped_spans():
+    """Every sanctioned scoping idiom: direct `with`, enter_context
+    (direct and via name), assigned-then-entered, and returning the
+    unopened cm (the caller's `with` closes it)."""
+    assert _rules("""
+        import contextlib
+
+        from dynamo_tpu.runtime import tracing
+
+        def ok1(md):
+            with tracing.span("route.push") as s:
+                s.set_attribute("k", 1)
+
+        def ok2(stack: contextlib.ExitStack):
+            stack.enter_context(tracing.span("onboard.g3"))
+
+        def ok3(md):
+            s = tracing.span("route.kv", parent=md.get("traceparent"))
+            with s:
+                pass
+
+        def ok4(stack):
+            s = tracing.span("kv.pull")
+            stack.enter_context(s)
+
+        def ok5():
+            return tracing.span("frontend.request")
+
+        def ok6():
+            s = tracing.span("stream.tail")
+            return s
+    """) == []
+
+
+def test_r009_nested_function_scopes_checked_independently():
+    """A leak inside a nested def is the NESTED function's finding; the
+    enclosing function's clean span stays clean."""
+    assert _rules("""
+        from dynamo_tpu.runtime import tracing
+
+        def outer():
+            def inner():
+                s = tracing.span("leak.inner")
+                return None
+            with tracing.span("outer.ok"):
+                inner()
+    """) == ["DYN-R009"]
+
+
+def test_r009_suppression():
+    assert _rules("""
+        from dynamo_tpu.runtime import tracing
+
+        def manual():
+            s = tracing.span("manual")  # dynlint: disable=DYN-R009 — closed by callback
+            return None
+    """) == []

@@ -174,9 +174,22 @@ async def test_single_trace_spans_disagg_request(mem_spans):
     prefill = [s for s in spans if "prefill" in s.name]
     decode = [s for s in spans if "decode" in s.name]
     assert prefill and decode, f"need prefill+decode hops, got {names}"
-    # both worker hops are children of the frontend root span
-    assert all(s.parent_span_id == root.context.span_id
-               for s in prefill + decode)
+    # every hop hangs off the frontend root through an unbroken parent
+    # chain (root -> route.* -> rpc / worker.request -> worker.*): no
+    # orphans, no flat siblings pretending to be causality
+    by_id = {s.context.span_id: s for s in spans}
+
+    def _reaches_root(s, hops=0):
+        if s is root:
+            return True
+        parent = by_id.get(s.parent_span_id)
+        return (parent is not None and hops < 8
+                and _reaches_root(parent, hops + 1))
+
+    orphans = [s.name for s in spans if not _reaches_root(s)]
+    assert not orphans, f"spans not connected to the root: {orphans}"
+    # the route hop sits between the frontend root and the worker hops
+    assert any(s.name.startswith("route.") for s in spans), names
 
 
 async def test_migration_attempt_recorded(mem_spans):
@@ -221,3 +234,124 @@ def test_trace_annotations_gate(monkeypatch):
             pass
     finally:
         ann._enabled.cache_clear()
+
+
+# -- dump_timeline --trace: fleet merge, dedupe, partial-failure pulls ------
+def _load_dump_timeline():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "dump_timeline", os.path.join(repo, "scripts", "dump_timeline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _span(trace_id, span_id, name="route.push", flags="01", start=1000,
+          end=2000, **attrs):
+    return {"name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_span_id": None, "flags": flags, "start_ns": start,
+            "end_ns": end, "attributes": attrs}
+
+
+def test_merge_span_rings_dedupes_and_tracks():
+    dt = _load_dump_timeline()
+    shared = _span("aa" * 16, "11" * 8)  # same span seen by both workers
+    merged = dt.merge_span_rings([
+        ("fe", {"spans": [shared,
+                          _span("aa" * 16, "22" * 8, "frontend.request")]}),
+        ("w0", {"spans": [dict(shared),
+                          _span("bb" * 16, "33" * 8, "worker.decode",
+                                flags="03")]}),
+    ])
+    other = merged["otherData"]
+    assert other["n_spans"] == 3  # shared span counted once
+    assert other["n_traces"] == 2
+    slices = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert len(slices) == 3
+    # pid = the worker that recorded it; tid = the trace (stable)
+    fe = [e for e in slices if e["pid"] == 0]
+    assert {e["name"] for e in fe} == {"route.push", "frontend.request"}
+    assert len({e["tid"] for e in fe}) == 1  # one trace -> one lane
+    # tail flag (0x02) surfaces in the thread_name metadata
+    names = [e for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"]
+    tails = [e for e in names if "[tail]" in e["args"]["name"]]
+    assert tails and all(("bb" * 16)[:8] in e["args"]["name"]
+                         for e in tails)
+    # µs conversion from ns
+    assert slices[0]["ts"] == 1.0 and slices[0]["dur"] == 1.0
+
+
+def test_dedupe_targets_first_label_wins(capsys):
+    dt = _load_dump_timeline()
+    out = dt.dedupe_targets([
+        ("fe", "http://h:9090"),
+        ("copy", "http://h:9090/"),  # trailing slash: same URL
+        ("w1", "http://h:9091"),
+    ])
+    assert out == [("fe", "http://h:9090"), ("w1", "http://h:9091")]
+    assert "duplicate worker URL" in capsys.readouterr().err
+
+
+def test_dump_timeline_skips_404_and_refused_workers(tmp_path, monkeypatch,
+                                                     capsys):
+    import http.server
+    import json as _json
+    import socket
+    import sys as _sys
+    import threading
+
+    dt = _load_dump_timeline()
+    payload = {"spans": [_span("cc" * 16, "44" * 8, "frontend.request")]}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.server.ok and self.path.startswith("/debug/traces"):
+                body = _json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(404)
+
+        def log_message(self, *a):
+            pass
+
+    servers = []
+    for ok in (True, False):
+        srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+        srv.ok = ok
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+    # a refused port: bind, note the port, close the listener
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    out = tmp_path / "spans.json"
+    urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+    try:
+        monkeypatch.setattr(_sys, "argv", [
+            "dump_timeline.py", "--trace", "--out", str(out),
+            "--worker", f"good={urls[0]}", "--worker", f"bare={urls[1]}",
+            "--worker", f"dead=http://127.0.0.1:{dead_port}",
+            "--timeout", "5"])
+        assert dt.main() == 0  # partial failure: still a merge
+        err = capsys.readouterr().err
+        assert "no span ring" in err and "skipping" in err.lower()
+        merged = _json.loads(out.read_text())
+        assert merged["otherData"]["n_spans"] == 1
+        # every pull failing IS an error exit
+        monkeypatch.setattr(_sys, "argv", [
+            "dump_timeline.py", "--trace", "--out", str(out),
+            "--worker", f"dead=http://127.0.0.1:{dead_port}",
+            "--timeout", "5"])
+        assert dt.main() == 2
+    finally:
+        for s in servers:
+            s.shutdown()
+            s.server_close()
